@@ -1,0 +1,16 @@
+"""cycloneml_tpu — a TPU-native distributed ML framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design with the capabilities of
+wmeddie/CycloneML (an Apache Spark 3.3 fork): distributed datasets over a
+device mesh, an MLlib-compatible estimator/pipeline API, a BLAS offload
+boundary compiled to XLA:TPU, tree-aggregate gradient reductions as
+``jax.lax.psum`` over ICI, and a host control plane for dispatch, heartbeat
+and checkpointing. See SURVEY.md at the repo root for the reference map.
+"""
+
+__version__ = "0.1.0"
+
+from cycloneml_tpu.conf import CycloneConf
+from cycloneml_tpu.context import CycloneContext
+
+__all__ = ["CycloneConf", "CycloneContext", "__version__"]

@@ -1,0 +1,203 @@
+"""CycloneContext — the driver entry point.
+
+Analog of ``SparkContext`` (ref: core/src/main/scala/org/apache/spark/
+SparkContext.scala:83): owns the conf, the device mesh (≈ executor fleet),
+the listener bus + event journal (≈ LiveListenerBus + EventLoggingListener),
+dataset factories (≈ parallelize/textFile), broadcast, accumulators, and
+shutdown. Unlike the reference there is no DAG scheduler: "jobs" are
+jit-compiled SPMD steps on the mesh, so the scheduling layer collapses to
+step dispatch + the event journal.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cycloneml_tpu import mesh as mesh_mod
+from cycloneml_tpu.conf import (
+    APP_NAME, CHECKPOINT_DIR, CycloneConf, DEFAULT_PARALLELISM,
+    EVENT_LOG_DIR, EVENT_LOG_ENABLED, MASTER,
+)
+from cycloneml_tpu.util.events import (
+    ApplicationEnd, ApplicationStart, CycloneEvent, EventJournal, JobEnd,
+    JobStart, ListenerBus, MeshUp,
+)
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_active_lock = threading.Lock()
+_active_context: Optional["CycloneContext"] = None
+
+
+class Broadcast:
+    """Replicated pytree on every device (replaces TorrentBroadcast,
+    ref: core/.../broadcast/TorrentBroadcast.scala:58 — replication is an
+    XLA transfer onto the replicated sharding, no torrent protocol needed)."""
+
+    def __init__(self, ctx: "CycloneContext", value: Any, bid: int):
+        self.id = bid
+        self._value = value
+        self._device_value = None
+        self._ctx = ctx
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @property
+    def device_value(self) -> Any:
+        if self._device_value is None:
+            self._device_value = self._ctx.mesh_runtime.device_put_replicated(self._value)
+        return self._device_value
+
+    def unpersist(self) -> None:
+        self._device_value = None
+
+    def destroy(self) -> None:
+        self._device_value = None
+        self._value = None
+
+
+class Accumulator:
+    """Driver-merged counter (ref: util/AccumulatorV2.scala:44). In the SPMD
+    model task-side partials are device scalars summed into host state after
+    each step."""
+
+    def __init__(self, initial: float = 0.0, name: str = ""):
+        self.name = name
+        self._value = initial
+        self._lock = threading.Lock()
+
+    def add(self, v) -> None:
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class CycloneContext:
+    def __init__(self, conf: Optional[CycloneConf] = None,
+                 master: Optional[str] = None, app_name: Optional[str] = None):
+        global _active_context
+        with _active_lock:
+            if _active_context is not None and not _active_context._stopped:
+                raise RuntimeError(
+                    "An active CycloneContext already exists in this process; "
+                    "use CycloneContext.get_or_create() or stop() it first.")
+        self.conf = (conf or CycloneConf()).clone()
+        if master is not None:
+            self.conf.set(MASTER, master)
+        if app_name is not None:
+            self.conf.set(APP_NAME, app_name)
+        self.app_id = f"cyclone-{int(time.time())}-{uuid.uuid4().hex[:6]}"
+        self.app_name = self.conf.get(APP_NAME)
+
+        self.listener_bus = ListenerBus()
+        self._journal: Optional[EventJournal] = None
+        if self.conf.get(EVENT_LOG_ENABLED):
+            d = self.conf.get(EVENT_LOG_DIR)
+            os.makedirs(d, exist_ok=True)
+            self._journal = EventJournal(os.path.join(d, f"{self.app_id}.jsonl"))
+            self.listener_bus.add_listener(self._journal)
+        self.listener_bus.start()
+
+        self.mesh_runtime = mesh_mod.get_or_create(self.conf.get(MASTER))
+        self._next_broadcast = 0
+        self._next_job = 0
+        self._stopped = False
+        self._accumulators: List[Accumulator] = []
+
+        self.listener_bus.post(ApplicationStart(app_name=self.app_name, app_id=self.app_id))
+        self.listener_bus.post(MeshUp(
+            n_devices=self.mesh_runtime.n_devices,
+            platform=self.mesh_runtime.platform,
+            mesh_shape=str(dict(zip(self.mesh_runtime.mesh.axis_names,
+                                    self.mesh_runtime.mesh.devices.shape)))))
+        with _active_lock:
+            _active_context = self
+        atexit.register(self.stop)
+
+    # -- factories -------------------------------------------------------------
+    @classmethod
+    def get_or_create(cls, conf: Optional[CycloneConf] = None, **kw) -> "CycloneContext":
+        with _active_lock:
+            if _active_context is not None and not _active_context._stopped:
+                return _active_context
+        return cls(conf, **kw)
+
+    @property
+    def default_parallelism(self) -> int:
+        n = self.conf.get(DEFAULT_PARALLELISM)
+        return n if n > 0 else self.mesh_runtime.n_devices
+
+    def broadcast(self, value: Any) -> Broadcast:
+        self._next_broadcast += 1
+        return Broadcast(self, value, self._next_broadcast)
+
+    def accumulator(self, initial: float = 0.0, name: str = "") -> Accumulator:
+        acc = Accumulator(initial, name)
+        self._accumulators.append(acc)
+        return acc
+
+    def parallelize(self, data, num_partitions: Optional[int] = None):
+        from cycloneml_tpu.dataset.dataset import PartitionedDataset
+        return PartitionedDataset.from_sequence(
+            self, list(data), num_partitions or self.default_parallelism)
+
+    def read_libsvm(self, path: str, n_features: Optional[int] = None):
+        from cycloneml_tpu.dataset.io import read_libsvm
+        return read_libsvm(self, path, n_features)
+
+    # -- job bracketing (events only; execution is jit dispatch) --------------
+    def run_job(self, description: str, fn: Callable[[], Any]) -> Any:
+        self._next_job += 1
+        jid = self._next_job
+        self.listener_bus.post(JobStart(job_id=jid, description=description))
+        try:
+            out = fn()
+        except Exception as e:
+            self.listener_bus.post(JobEnd(job_id=jid, succeeded=False, error=str(e)))
+            raise
+        self.listener_bus.post(JobEnd(job_id=jid, succeeded=True))
+        return out
+
+    @property
+    def checkpoint_dir(self) -> str:
+        return self.conf.get(CHECKPOINT_DIR)
+
+    def set_checkpoint_dir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.conf.set(CHECKPOINT_DIR, path)
+
+    def stop(self) -> None:
+        global _active_context
+        if self._stopped:
+            return
+        self._stopped = True
+        self.listener_bus.post(ApplicationEnd(app_id=self.app_id))
+        self.listener_bus.stop()
+        if self._journal is not None:
+            self._journal.close()
+        with _active_lock:
+            if _active_context is self:
+                _active_context = None
+
+    def __enter__(self) -> "CycloneContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

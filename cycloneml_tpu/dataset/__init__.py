@@ -1,0 +1,4 @@
+from cycloneml_tpu.dataset.dataset import PartitionedDataset, InstanceDataset
+from cycloneml_tpu.dataset.instance import Instance, blockify_arrays
+
+__all__ = ["PartitionedDataset", "InstanceDataset", "Instance", "blockify_arrays"]

@@ -1,0 +1,358 @@
+"""Distributed dataset abstractions.
+
+Two tiers replace the reference's RDD (ref: core/src/main/scala/org/apache/
+spark/rdd/RDD.scala:83):
+
+- ``PartitionedDataset`` — host-resident partitioned collection with the RDD
+  functional surface (map/filter/mapPartitions/reduce/treeAggregate/collect,
+  lazy lineage, caching, checkpoint). Control-plane work (ETL-ish, object
+  data) runs in host threads; this is deliberately thin — the numeric path
+  does not live here.
+
+- ``InstanceDataset`` — the numeric tier: dense device arrays (X, y, w)
+  row-sharded over the mesh (the InstanceBlock physical layout, ref:
+  ml/feature/Instance.scala:39). Aggregations are jit-compiled shard_map
+  programs whose psums replace treeAggregate (ref RDD.scala:1223); persist
+  maps to device/host placement; checkpoint writes npz shards.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import functools
+
+import os
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.dataset.instance import blockify_arrays, rows_to_dense
+from cycloneml_tpu.linalg.vectors import Vector
+from cycloneml_tpu.parallel import collectives
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_POOL: Optional[cf.ThreadPoolExecutor] = None
+
+
+def _pool() -> cf.ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = cf.ThreadPoolExecutor(max_workers=os.cpu_count() or 8,
+                                      thread_name_prefix="cyclone-task")
+    return _POOL
+
+
+class PartitionedDataset:
+    """Host-tier RDD analog: lazy, lineage-based, partitioned."""
+
+    def __init__(self, ctx, partitions_fn: Callable[[], List[List[Any]]],
+                 num_partitions: int, name: str = ""):
+        self.ctx = ctx
+        self._compute = partitions_fn
+        self.num_partitions = num_partitions
+        self.name = name or "dataset"
+        self._cached: Optional[List[List[Any]]] = None
+        self._checkpoint_path: Optional[str] = None
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def from_sequence(cls, ctx, data: List[Any], num_partitions: int) -> "PartitionedDataset":
+        data = list(data)
+        n = max(1, num_partitions)
+
+        def compute():
+            size = (len(data) + n - 1) // n if data else 0
+            return [data[i * size:(i + 1) * size] for i in range(n)]
+
+        return cls(ctx, compute, n, "parallelize")
+
+    # -- materialization ------------------------------------------------------
+    def _partitions(self) -> List[List[Any]]:
+        if self._cached is not None:
+            return self._cached
+        if self._checkpoint_path is not None:
+            import pickle
+            with open(self._checkpoint_path, "rb") as fh:
+                return pickle.load(fh)
+        return self._compute()
+
+    def cache(self) -> "PartitionedDataset":
+        return self.persist()
+
+    def persist(self) -> "PartitionedDataset":
+        if self._cached is None:
+            self._cached = self._partitions()
+        return self
+
+    def unpersist(self) -> "PartitionedDataset":
+        self._cached = None
+        return self
+
+    def checkpoint(self) -> "PartitionedDataset":
+        """Truncate lineage by writing partitions to the checkpoint dir
+        (ref: RDD.scala:1631, ReliableCheckpointRDD.scala:147)."""
+        import pickle
+        d = self.ctx.checkpoint_dir
+        if not d:
+            raise RuntimeError("checkpoint dir not set; call set_checkpoint_dir")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{self.name}-{id(self)}.pkl")
+        with open(path, "wb") as fh:
+            pickle.dump(self._partitions(), fh)
+        self._checkpoint_path = path
+        self._compute = lambda: None  # lineage truncated
+        return self
+
+    # -- transformations (lazy) -----------------------------------------------
+    def _derive(self, fn: Callable[[List[List[Any]]], List[List[Any]]],
+                name: str, num_partitions: Optional[int] = None) -> "PartitionedDataset":
+        parent = self
+
+        def compute():
+            return fn(parent._partitions())
+
+        return PartitionedDataset(self.ctx, compute,
+                                  num_partitions or self.num_partitions, name)
+
+    def map(self, f: Callable) -> "PartitionedDataset":
+        return self._derive(lambda ps: [[f(x) for x in p] for p in ps], "map")
+
+    def filter(self, f: Callable) -> "PartitionedDataset":
+        return self._derive(lambda ps: [[x for x in p if f(x)] for p in ps], "filter")
+
+    def flat_map(self, f: Callable) -> "PartitionedDataset":
+        return self._derive(
+            lambda ps: [[y for x in p for y in f(x)] for p in ps], "flatMap")
+
+    def map_partitions(self, f: Callable[[Iterable], Iterable]) -> "PartitionedDataset":
+        return self._derive(lambda ps: [list(f(iter(p))) for p in ps], "mapPartitions")
+
+    def map_partitions_with_index(self, f: Callable[[int, Iterable], Iterable]) -> "PartitionedDataset":
+        return self._derive(
+            lambda ps: [list(f(i, iter(p))) for i, p in enumerate(ps)],
+            "mapPartitionsWithIndex")
+
+    def zip_with_index(self) -> "PartitionedDataset":
+        def fn(ps):
+            out, i = [], 0
+            for p in ps:
+                out.append([(x, i + j) for j, x in enumerate(p)])
+                i += len(p)
+            return out
+        return self._derive(fn, "zipWithIndex")
+
+    def repartition(self, n: int) -> "PartitionedDataset":
+        def fn(ps):
+            flat = [x for p in ps for x in p]
+            size = (len(flat) + n - 1) // n if flat else 0
+            return [flat[i * size:(i + 1) * size] for i in range(n)]
+        return self._derive(fn, "repartition", n)
+
+    coalesce = repartition
+
+    def group_by_key(self) -> "PartitionedDataset":
+        """Hash-partition key/value pairs (host-tier shuffle analog)."""
+        n = self.num_partitions
+
+        def fn(ps):
+            buckets: List[dict] = [dict() for _ in range(n)]
+            for p in ps:
+                for k, v in p:
+                    buckets[hash(k) % n].setdefault(k, []).append(v)
+            return [list(b.items()) for b in buckets]
+        return self._derive(fn, "groupByKey", n)
+
+    def reduce_by_key(self, f: Callable) -> "PartitionedDataset":
+        return self.group_by_key().map(
+            lambda kv: (kv[0], functools.reduce(f, kv[1])))
+
+    def union(self, other: "PartitionedDataset") -> "PartitionedDataset":
+        parent = self
+
+        def compute():
+            return parent._partitions() + other._partitions()
+        return PartitionedDataset(self.ctx, compute,
+                                  self.num_partitions + other.num_partitions, "union")
+
+    # -- actions (eager, threaded over partitions) ----------------------------
+    def _run_per_partition(self, f: Callable[[List[Any]], Any]) -> List[Any]:
+        parts = self._partitions()
+        return list(_pool().map(f, parts))
+
+    def collect(self) -> List[Any]:
+        return [x for p in self._partitions() for x in p]
+
+    def count(self) -> int:
+        return sum(self._run_per_partition(len))
+
+    def take(self, n: int) -> List[Any]:
+        out: List[Any] = []
+        for p in self._partitions():
+            out.extend(p[: n - len(out)])
+            if len(out) >= n:
+                break
+        return out
+
+    def first(self) -> Any:
+        got = self.take(1)
+        if not got:
+            raise ValueError("empty dataset")
+        return got[0]
+
+    def reduce(self, f: Callable) -> Any:
+        partials = [functools.reduce(f, p) for p in self._run_per_partition(list) if p]
+        if not partials:
+            raise ValueError("empty dataset")
+        return functools.reduce(f, partials)
+
+    def aggregate(self, zero: Any, seq_op: Callable, comb_op: Callable) -> Any:
+        import copy
+        partials = self._run_per_partition(
+            lambda p: functools.reduce(seq_op, p, copy.deepcopy(zero)))
+        return functools.reduce(comb_op, partials, copy.deepcopy(zero))
+
+    def tree_aggregate(self, zero: Any, seq_op: Callable, comb_op: Callable,
+                       depth: int = 2) -> Any:
+        """Log-depth host reduction (ref RDD.scala:1223). The numeric tier
+        uses psum instead; this is the object-data fallback."""
+        import copy
+        partials = self._run_per_partition(
+            lambda p: functools.reduce(seq_op, p, copy.deepcopy(zero)))
+        while len(partials) > 2 and depth > 1:
+            scale = max(2, int(np.ceil(len(partials) ** (1.0 / depth))))
+            groups = [partials[i::scale] for i in range(scale)]
+            partials = [functools.reduce(comb_op, g) for g in groups if g]
+            depth -= 1
+        return functools.reduce(comb_op, partials, copy.deepcopy(zero))
+
+    def foreach(self, f: Callable) -> None:
+        self._run_per_partition(lambda p: [f(x) for x in p])
+
+    def is_empty(self) -> bool:
+        return not self.take(1)
+
+    # -- bridge to the numeric tier -------------------------------------------
+    def to_instance_dataset(self, n_features: Optional[int] = None,
+                            label_fn=None, weight_fn=None, features_fn=None) -> "InstanceDataset":
+        rows = self.collect()
+        features_fn = features_fn or (lambda r: r.features)
+        label_fn = label_fn or (lambda r: getattr(r, "label", 0.0))
+        weight_fn = weight_fn or (lambda r: getattr(r, "weight", 1.0))
+        feats = [features_fn(r) for r in rows]
+        x = rows_to_dense(feats, n_features)
+        y = np.array([label_fn(r) for r in rows], dtype=np.float64)
+        w = np.array([weight_fn(r) for r in rows], dtype=np.float64)
+        return InstanceDataset.from_numpy(self.ctx, x, y, w)
+
+
+class InstanceDataset:
+    """Numeric tier: row-sharded device arrays with static shapes.
+
+    The unit every estimator trains on. ``x`` is (n_pad, d), ``y``/``w`` are
+    (n_pad,), all sharded over (replica, data); padding rows carry w=0.
+    """
+
+    def __init__(self, ctx, x, y, w, n_rows: int, n_features: int):
+        self.ctx = ctx
+        self._x = x
+        self._y = y
+        self._w = w
+        self._host: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self.n_rows = n_rows
+        self.n_features = n_features
+
+    def _restore_device(self) -> None:
+        if self._x is None and self._host is not None:
+            rt = self.ctx.mesh_runtime
+            self._x = rt.device_put_sharded_rows(self._host[0])
+            self._y = rt.device_put_sharded_rows(self._host[1])
+            self._w = rt.device_put_sharded_rows(self._host[2])
+
+    @property
+    def x(self):
+        self._restore_device()
+        return self._x
+
+    @property
+    def y(self):
+        self._restore_device()
+        return self._y
+
+    @property
+    def w(self):
+        self._restore_device()
+        return self._w
+
+    @classmethod
+    def from_numpy(cls, ctx, x: np.ndarray, y: Optional[np.ndarray] = None,
+                   w: Optional[np.ndarray] = None, dtype=np.float32) -> "InstanceDataset":
+        rt = ctx.mesh_runtime
+        x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism, dtype=dtype)
+        return cls(ctx,
+                   rt.device_put_sharded_rows(x_p),
+                   rt.device_put_sharded_rows(y_p),
+                   rt.device_put_sharded_rows(w_p),
+                   n, x.shape[1])
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    def tree_aggregate_fn(self, fn: Callable):
+        """Compile ``fn(x_shard, y_shard, w_shard, *extras) -> pytree`` into a
+        mesh-wide psum aggregation; returns jitted callable taking extras."""
+        rt = self.ctx.mesh_runtime
+        ds = self
+
+        compiled_cache = {}
+
+        def call(*extras):
+            key = tuple(getattr(e, "shape", None) for e in extras)
+            if key not in compiled_cache:
+                compiled_cache[key] = collectives.tree_aggregate(
+                    fn, rt, ds.x, ds.y, ds.w)
+            return compiled_cache[key](ds.x, ds.y, ds.w, *extras)
+
+        return call
+
+    def map_batches(self, fn: Callable):
+        """Apply a jitted elementwise/rowwise fn over the sharded arrays,
+        returning new sharded arrays (stays on device)."""
+        import jax
+        return jax.jit(fn)(self.x, self.y, self.w)
+
+    def persist_host(self) -> "InstanceDataset":
+        """Spill to host memory and release device HBM (≈ MEMORY_AND_DISK
+        tier, ref LogisticRegression.scala:968 persists blocks). Arrays are
+        transparently re-placed on the mesh at next access."""
+        self._host = (np.asarray(self._x), np.asarray(self._y), np.asarray(self._w))
+        for a in (self._x, self._y, self._w):
+            try:
+                a.delete()
+            except Exception:
+                pass
+        self._x = self._y = self._w = None
+        return self
+
+    def checkpoint(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, x=np.asarray(self.x), y=np.asarray(self.y),
+                 w=np.asarray(self.w), n_rows=self.n_rows,
+                 n_features=self.n_features)
+        return path
+
+    @classmethod
+    def restore(cls, ctx, path: str) -> "InstanceDataset":
+        z = np.load(path if path.endswith(".npz") else path + ".npz")
+        rt = ctx.mesh_runtime
+        return cls(ctx, rt.device_put_sharded_rows(z["x"]),
+                   rt.device_put_sharded_rows(z["y"]),
+                   rt.device_put_sharded_rows(z["w"]),
+                   int(z["n_rows"]), int(z["n_features"]))
+
+    def to_numpy(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Unpadded host copies."""
+        n = self.n_rows
+        return (np.asarray(self.x)[:n], np.asarray(self.y)[:n], np.asarray(self.w)[:n])

@@ -1,0 +1,79 @@
+"""Instance blocking — the physical unit of ML data.
+
+The reference stacks rows into per-partition matrices so aggregators can use
+level-2/3 BLAS (ref: ml/feature/Instance.scala:39 InstanceBlock,
+blokifyWithMaxMemUsage:146,182). On TPU the same idea is carried further:
+the whole dataset becomes dense device arrays ``(rows, features)`` row-sharded
+over the mesh, padded with zero-weight rows so every shard is equal-sized and
+shapes stay static for XLA. Zero weight makes padding exactly neutral in all
+weighted aggregators — the invariant every estimator relies on.
+
+Sparse handling (SURVEY §7 hard-parts): XLA requires static shapes, so sparse
+rows are densified block-wise at ingest (scipy CSR → dense numpy → device).
+For very wide sparse data a hashed/feature-sub-block path can be added at
+this boundary without touching estimators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from cycloneml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
+
+
+@dataclass
+class Instance:
+    """One labeled weighted row (ref Instance.scala case class Instance)."""
+
+    label: float
+    weight: float
+    features: Vector
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def blockify_arrays(
+    x: np.ndarray,
+    y: Optional[np.ndarray],
+    w: Optional[np.ndarray],
+    n_shards: int,
+    rows_multiple: int = 8,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Pad (x, y, w) to a shard-divisible row count with zero-weight rows.
+
+    Returns (x_pad, y_pad, w_pad, n_true). Row count is padded to a multiple
+    of ``n_shards * rows_multiple`` (sublane-friendly shards).
+    """
+    n = x.shape[0]
+    if y is None:
+        y = np.zeros(n, dtype=dtype)
+    if w is None:
+        w = np.ones(n, dtype=dtype)
+    target = max(_round_up(n, n_shards * rows_multiple), n_shards * rows_multiple)
+    pad = target - n
+    x_pad = np.zeros((target, x.shape[1]), dtype=dtype)
+    x_pad[:n] = x
+    y_pad = np.zeros(target, dtype=dtype)
+    y_pad[:n] = y
+    w_pad = np.zeros(target, dtype=dtype)
+    w_pad[:n] = w
+    return x_pad, y_pad, w_pad, n
+
+
+def rows_to_dense(features: Sequence[Vector], n_features: Optional[int] = None) -> np.ndarray:
+    """Stack a sequence of (possibly sparse) vectors into a dense matrix."""
+    if n_features is None:
+        n_features = max(f.size for f in features)
+    out = np.zeros((len(features), n_features), dtype=np.float64)
+    for i, f in enumerate(features):
+        if isinstance(f, SparseVector):
+            out[i, f.indices] = f.values
+        else:
+            out[i, : f.size] = f.to_array()
+    return out

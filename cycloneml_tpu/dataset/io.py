@@ -1,0 +1,56 @@
+"""Data ingest.
+
+Host ingest (SURVEY §7: parallel sharded readers → dense blocks → device
+feed). LibSVM parity matters most: the reference's MLlib reads libsvm via
+``MLUtils.loadLibSVMFile`` / the ``libsvm`` datasource, 1-based indices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+
+def parse_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a libsvm file to dense (X, y). Indices are 1-based on disk."""
+    labels = []
+    rows = []
+    max_idx = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            idx = []
+            vals = []
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                idx.append(int(i) - 1)
+                vals.append(float(v))
+            if idx:
+                max_idx = max(max_idx, max(idx))
+            rows.append((np.array(idx, dtype=np.int32), np.array(vals)))
+    d = n_features if n_features is not None else max_idx + 1
+    x = np.zeros((len(rows), d), dtype=np.float64)
+    for r, (idx, vals) in enumerate(rows):
+        x[r, idx] = vals
+    return x, np.array(labels, dtype=np.float64)
+
+
+def read_libsvm(ctx, path: str, n_features: Optional[int] = None) -> InstanceDataset:
+    x, y = parse_libsvm(path, n_features)
+    return InstanceDataset.from_numpy(ctx, x, y)
+
+
+def read_csv(ctx, path: str, label_col: int = 0, delimiter: str = ",",
+             skip_header: bool = False) -> InstanceDataset:
+    data = np.loadtxt(path, delimiter=delimiter, skiprows=1 if skip_header else 0)
+    y = data[:, label_col]
+    x = np.delete(data, label_col, axis=1)
+    return InstanceDataset.from_numpy(ctx, x, y)

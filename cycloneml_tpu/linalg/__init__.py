@@ -1,0 +1,13 @@
+from cycloneml_tpu.linalg.vectors import (
+    Vector, DenseVector, SparseVector, Vectors,
+)
+from cycloneml_tpu.linalg.matrices import (
+    Matrix, DenseMatrix, SparseMatrix, Matrices,
+)
+from cycloneml_tpu.linalg import blas as BLAS
+
+__all__ = [
+    "Vector", "DenseVector", "SparseVector", "Vectors",
+    "Matrix", "DenseMatrix", "SparseMatrix", "Matrices",
+    "BLAS",
+]

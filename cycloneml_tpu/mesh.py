@@ -1,0 +1,151 @@
+"""Device-mesh runtime.
+
+TPU-native replacement for the reference's driver bring-up + executor
+registration (ref: SparkContext.scala:83 → SparkEnv.createDriverEnv →
+CoarseGrainedSchedulerBackend registration, SURVEY §3.1). There is no
+executor fleet to register: the "cluster" is a ``jax.sharding.Mesh`` over all
+attached devices; gang scheduling (ref: BarrierTaskContext.scala:43) is
+inherent — every jitted step is an SPMD program over the whole mesh.
+
+Master-URL grammar (≈ SparkContext.scala:3058 master parsing):
+  ``local-mesh[N]``   N host-platform devices (test fixture; requires
+                      ``--xla_force_host_platform_device_count=N``)
+  ``local-mesh[*]``   all visible devices of the default platform
+  ``tpu``             all attached TPU devices
+  ``multihost``       ``jax.distributed.initialize()`` then all global devices
+
+The mesh is laid out ``(replica, data)``: ``data`` is the intra-slice axis
+whose collectives ride ICI; ``replica`` crosses slices/hosts over DCN and is
+1 on a single slice. ``tree_aggregate`` maps to a psum over ``data`` followed
+by a psum over ``replica`` — the hierarchical ICI-then-DCN reduction that
+replaces the reference's log-depth ``treeAggregate`` (ref: RDD.scala:1223).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+DATA_AXIS = "data"
+REPLICA_AXIS = "replica"
+MODEL_AXIS = "model"
+
+_LOCAL_MESH_RE = re.compile(r"local-mesh\[(\d+|\*)\]")
+
+
+class MeshRuntime:
+    """Owns the global device mesh and sharding helpers."""
+
+    def __init__(self, master: str = "tpu", n_replicas: int = 1,
+                 model_parallelism: int = 1):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        self._jax = jax
+        devices = self._resolve_devices(master)
+        n = len(devices)
+        if n % (n_replicas * model_parallelism) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by replicas({n_replicas}) x "
+                f"model({model_parallelism})")
+        data = n // (n_replicas * model_parallelism)
+        dev_grid = np.array(devices).reshape(n_replicas, data, model_parallelism)
+        self.mesh = Mesh(dev_grid, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS))
+        self.master = master
+        self.n_devices = n
+        self.platform = devices[0].platform
+        self._P = PartitionSpec
+        self._NamedSharding = NamedSharding
+        logger.info("Mesh up: %d %s devices, shape %s", n, self.platform,
+                    dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
+
+    @staticmethod
+    def _resolve_devices(master: str):
+        import jax
+        m = _LOCAL_MESH_RE.fullmatch(master)
+        if m is not None:
+            want = m.group(1)
+            devices = jax.devices()
+            if want != "*":
+                want_n = int(want)
+                if len(devices) < want_n:
+                    raise RuntimeError(
+                        f"local-mesh[{want_n}] needs {want_n} devices but only "
+                        f"{len(devices)} are visible; set XLA_FLAGS="
+                        f"--xla_force_host_platform_device_count={want_n}")
+                devices = devices[:want_n]
+            return devices
+        if master == "multihost":
+            jax.distributed.initialize()
+            return jax.devices()
+        if master == "tpu":
+            try:
+                return jax.devices("tpu")
+            except RuntimeError:
+                logger.warning("no TPU attached; falling back to default platform")
+                return jax.devices()
+        raise ValueError(f"cannot parse master URL: {master!r}")
+
+    # -- sharding helpers ------------------------------------------------------
+    def data_sharding(self, extra_axes: int = 1):
+        """Shard leading (row/block) dim over replica+data, replicate the rest."""
+        spec = self._P((REPLICA_AXIS, DATA_AXIS), *([None] * extra_axes))
+        return self._NamedSharding(self.mesh, spec)
+
+    def replicated(self):
+        return self._NamedSharding(self.mesh, self._P())
+
+    def model_sharding(self, axis_index: int, ndim: int):
+        """Shard dimension ``axis_index`` over the model axis (feature-dim TP
+        for coefficient/Gram objects that exceed one device's HBM,
+        SURVEY §5.7(a))."""
+        spec = [None] * ndim
+        spec[axis_index] = MODEL_AXIS
+        return self._NamedSharding(self.mesh, self._P(*spec))
+
+    @property
+    def data_parallelism(self) -> int:
+        return (self.mesh.devices.shape[0] * self.mesh.devices.shape[1])
+
+    def device_put_sharded_rows(self, arr: np.ndarray):
+        """Place a host array on the mesh, rows sharded over replica×data."""
+        import jax
+        return jax.device_put(arr, self.data_sharding(arr.ndim - 1))
+
+    def device_put_replicated(self, tree):
+        import jax
+        return jax.device_put(tree, self.replicated())
+
+
+_active: Optional[MeshRuntime] = None
+
+
+_active_lock = __import__("threading").Lock()
+
+
+def get_or_create(master: str = "tpu", **kw) -> MeshRuntime:
+    global _active
+    with _active_lock:
+        if _active is None:
+            _active = MeshRuntime(master, **kw)
+        elif _active.master != master:
+            raise RuntimeError(
+                f"A mesh is already active for master {_active.master!r}; "
+                f"cannot re-initialise for {master!r}. Stop all contexts and "
+                "call mesh.reset() first.")
+        return _active
+
+
+def active() -> Optional[MeshRuntime]:
+    return _active
+
+
+def reset() -> None:
+    global _active
+    _active = None

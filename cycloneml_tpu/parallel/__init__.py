@@ -1,0 +1,5 @@
+from cycloneml_tpu.parallel.collectives import (
+    tree_aggregate, psum_over_mesh, all_gather_hosts, barrier,
+)
+
+__all__ = ["tree_aggregate", "psum_over_mesh", "all_gather_hosts", "barrier"]

@@ -1,0 +1,142 @@
+"""Collective operations over the device mesh.
+
+This module is the data-plane communication backend (SURVEY §2.7/§5.8): the
+reference moves gradients with ``RDD.treeAggregate`` (ref: rdd/RDD.scala:1223
+— log-depth reduction over executor partitions through the Netty shuffle);
+here the same reduction is a ``jax.lax.psum`` compiled into the step program,
+riding ICI within a slice and DCN across the ``replica`` axis. Barrier-mode
+``allGather`` (ref: BarrierTaskContext.scala:183) maps to
+``jax.lax.all_gather``; dense repartition (shuffle) maps to
+``jax.lax.all_to_all``.
+
+``tree_aggregate(fn, dataset_arrays)`` is the workhorse: it shard_maps ``fn``
+over the row-sharded arrays, psums the per-shard partials hierarchically
+(data axis = ICI, then replica axis = DCN), and returns the replicated
+result — semantically identical to the reference's
+``treeAggregate(zero)(seqOp, combOp, depth)`` with commutative combOp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions (check_vma vs check_rep kwarg)."""
+    import jax
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
+    """Hierarchical psum: intra-slice (ICI) first, then cross-slice (DCN).
+
+    Inside shard_map only. Two psums rather than one over a tuple of axes so
+    XLA schedules the ICI reduction before the (slower) DCN hop — the analog
+    of treeAggregate's ``depth`` levels.
+    """
+    import jax
+    out = x
+    for ax in axes:
+        out = jax.lax.psum(out, ax)
+    return out
+
+
+def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays):
+    """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
+
+    ``arrays`` fixes how many leading arguments are row-sharded; the returned
+    jitted callable takes ``(*arrays, *extras)`` where extras (e.g. current
+    coefficients) are replicated. ``fn`` receives each device's local shard of
+    every sharded array plus the extras, returns a pytree of partials;
+    partials are psum'd hierarchically over the mesh. Callers compile once,
+    call per iteration.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = runtime.mesh
+    row_spec = P((REPLICA_AXIS, DATA_AXIS))
+
+    def sharded(*all_args):
+        arrs = all_args[: len(arrays)]
+        rest = all_args[len(arrays):]
+
+        def local(*a):
+            partial = fn(*a, *rest)
+            return jax.tree_util.tree_map(
+                lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
+
+        in_specs = tuple([row_spec] * len(arrs) + [P()] * len(rest))
+        return shard_map_compat(local, mesh, in_specs, P())(*arrs, *rest)
+
+    return jax.jit(sharded)
+
+
+def all_gather_hosts(runtime: MeshRuntime, fn: Callable, *arrays):
+    """Barrier allGather analog: every shard computes ``fn(local)`` and all
+    results are gathered to every participant (ref BarrierTaskContext:183)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = runtime.mesh
+    row_spec = P((REPLICA_AXIS, DATA_AXIS))
+
+    def sharded(*arrs):
+        def local(*a):
+            v = fn(*a)
+            v = jax.lax.all_gather(v, DATA_AXIS)
+            return jax.lax.all_gather(v, REPLICA_AXIS).reshape((-1,) + v.shape[1:])
+        return shard_map_compat(local, mesh, (row_spec,) * len(arrs), P())(*arrs)
+
+    return jax.jit(sharded)(*arrays)
+
+
+def barrier(runtime: MeshRuntime) -> None:
+    """Global sync point (ref BarrierTaskContext.barrier:169): a jitted psum
+    of a token over the whole mesh, blocked on completion."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    tok = runtime.device_put_sharded_rows(
+        __import__("numpy").zeros((runtime.data_parallelism,), dtype="float32"))
+
+    @jax.jit
+    def sync(t):
+        def local(x):
+            return psum_over_mesh(jnp.sum(x))
+        return shard_map_compat(local, runtime.mesh,
+                                (P((REPLICA_AXIS, DATA_AXIS)),), P())(t)
+
+    sync(tok).block_until_ready()
+
+
+def all_to_all_repartition(runtime: MeshRuntime, array, split_dim: int = 0):
+    """Dense all-to-all over the data axis — on-device shuffle primitive for
+    numeric repartition (replaces the sort-shuffle path for dense data,
+    ref: shuffle/sort/SortShuffleManager.scala:73 / SURVEY §2.7 shuffle row).
+    ``array`` is row-sharded; each shard's rows are split into n_data groups
+    and exchanged so group g lands on device g.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = runtime.mesh
+    nd = runtime.data_parallelism
+    row_spec = P((REPLICA_AXIS, DATA_AXIS))
+
+    @jax.jit
+    def go(x):
+        def local(xl):
+            b = xl.shape[0] // nd
+            xs = xl.reshape((nd, b) + xl.shape[1:])
+            out = jax.lax.all_to_all(xs, (REPLICA_AXIS, DATA_AXIS), 0, 0, tiled=False)
+            return out.reshape((-1,) + xl.shape[1:])
+        return shard_map_compat(local, mesh, (row_spec,), row_spec)(x)
+
+    return go(array)

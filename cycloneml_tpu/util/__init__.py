@@ -1,0 +1,4 @@
+from cycloneml_tpu.util.logging import get_logger
+from cycloneml_tpu.util.events import EventJournal, ListenerBus, CycloneEvent
+
+__all__ = ["get_logger", "EventJournal", "ListenerBus", "CycloneEvent"]

@@ -1,0 +1,175 @@
+"""Structured event journal.
+
+TPU-native analog of the reference's LiveListenerBus + EventLoggingListener
+(ref: core/.../scheduler/LiveListenerBus.scala:45,
+EventLoggingListener.scala:50, util/JsonProtocol.scala:57). Every runtime
+transition (mesh up, job/step start+end, checkpoint, failure) is posted as a
+typed event; listeners fold events into status stores; an optional JSON-lines
+journal on disk replays into a history view.
+
+Single dispatch thread per bus — the same single-threaded event-loop design
+the reference uses to avoid locking (DAGScheduler event loop :2568).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class CycloneEvent:
+    """Base event; subclasses add typed payloads (≈ SparkListenerEvent)."""
+
+    time_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+
+    @property
+    def event_type(self) -> str:
+        return type(self).__name__
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["Event"] = self.event_type
+        return d
+
+
+@dataclass
+class MeshUp(CycloneEvent):
+    n_devices: int = 0
+    platform: str = ""
+    mesh_shape: str = ""
+
+
+@dataclass
+class JobStart(CycloneEvent):
+    job_id: int = 0
+    description: str = ""
+
+
+@dataclass
+class JobEnd(CycloneEvent):
+    job_id: int = 0
+    succeeded: bool = True
+    error: str = ""
+
+
+@dataclass
+class StepCompleted(CycloneEvent):
+    """One jitted step of an iterative job (≈ stage completed + TaskMetrics)."""
+
+    job_id: int = 0
+    step: int = 0
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class CheckpointWritten(CycloneEvent):
+    path: str = ""
+    step: int = 0
+
+
+@dataclass
+class WorkerLost(CycloneEvent):
+    worker_id: str = ""
+    reason: str = ""
+
+
+@dataclass
+class ApplicationStart(CycloneEvent):
+    app_name: str = ""
+    app_id: str = ""
+
+
+@dataclass
+class ApplicationEnd(CycloneEvent):
+    app_id: str = ""
+
+
+class ListenerBus:
+    """Async event bus with a single dispatch thread (≈ LiveListenerBus:45)."""
+
+    def __init__(self):
+        self._listeners: List[Callable[[CycloneEvent], None]] = []
+        self._queue: "queue.Queue[Optional[CycloneEvent]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._dropped = 0
+        self._posted = 0
+
+    def add_listener(self, fn: Callable[[CycloneEvent], None]) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[CycloneEvent], None]) -> None:
+        self._listeners.remove(fn)
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._thread = threading.Thread(target=self._run, name="cyclone-listener-bus", daemon=True)
+        self._thread.start()
+
+    def post(self, event: CycloneEvent) -> None:
+        self._posted += 1
+        if self._started:
+            self._queue.put(event)
+        else:
+            self._dispatch(event)
+
+    def _dispatch(self, event: CycloneEvent) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event)
+            except Exception:  # listener errors never kill the bus
+                pass
+
+    def _run(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            self._dispatch(ev)
+
+    def stop(self) -> None:
+        if self._started and self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=5)
+            self._started = False
+
+    @property
+    def metrics(self) -> Dict[str, int]:
+        return {"posted": self._posted, "dropped": self._dropped, "queued": self._queue.qsize()}
+
+
+class EventJournal:
+    """JSON-lines event log (≈ EventLoggingListener:50 + JsonProtocol:57)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def __call__(self, event: CycloneEvent) -> None:
+        line = json.dumps(event.to_json(), default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @staticmethod
+    def replay(path: str) -> List[Dict[str, Any]]:
+        """Read a journal back (history-server analog, ref: FsHistoryProvider.scala:84)."""
+        events = []
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+        return events

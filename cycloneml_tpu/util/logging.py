@@ -1,0 +1,22 @@
+"""Logging (analog of the reference's internal/Logging trait)."""
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level = os.environ.get("CYCLONE_LOG_LEVEL", "WARNING").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root = logging.getLogger("cycloneml_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        _CONFIGURED = True
+    return logging.getLogger(name if name.startswith("cycloneml_tpu") else f"cycloneml_tpu.{name}")

@@ -1,0 +1,61 @@
+"""Config system tests (ref semantics: ConfigBuilder/ConfigEntry/SparkConf)."""
+
+import pytest
+
+from cycloneml_tpu.conf import (
+    AGGREGATION_DEPTH, ConfigBuilder, CycloneConf, HEARTBEAT_INTERVAL_MS,
+    NETWORK_TIMEOUT_MS, TASK_MAX_FAILURES, registered_entries,
+)
+
+
+def test_defaults():
+    conf = CycloneConf(load_defaults=False)
+    assert conf.get(AGGREGATION_DEPTH) == 2
+    assert conf.get(TASK_MAX_FAILURES) == 4
+
+
+def test_set_and_typed_read():
+    conf = CycloneConf(load_defaults=False)
+    conf.set(AGGREGATION_DEPTH, 5)
+    assert conf.get(AGGREGATION_DEPTH) == 5
+    conf.set("cyclone.treeAggregate.depth", "7")
+    assert conf.get(AGGREGATION_DEPTH) == 7
+
+
+def test_validator():
+    conf = CycloneConf(load_defaults=False)
+    conf.set(AGGREGATION_DEPTH, 0)
+    with pytest.raises(ValueError):
+        conf.get(AGGREGATION_DEPTH)
+
+
+def test_fallback_entry():
+    conf = CycloneConf(load_defaults=False)
+    # NETWORK_TIMEOUT falls back to heartbeat interval like spark.network.timeout
+    assert conf.get(NETWORK_TIMEOUT_MS) == conf.get(HEARTBEAT_INTERVAL_MS)
+    conf.set(NETWORK_TIMEOUT_MS, 1234)
+    assert conf.get(NETWORK_TIMEOUT_MS) == 1234
+
+
+def test_clone_isolated():
+    a = CycloneConf(load_defaults=False).set("k", "v")
+    b = a.clone().set("k", "w")
+    assert a.get("k") == "v" and b.get("k") == "w"
+
+
+def test_registry_has_docs():
+    for key, entry in registered_entries().items():
+        assert entry.doc, f"{key} missing doc"
+
+
+def test_duplicate_registration_rejected():
+    ConfigBuilder("cyclone.test.dup").doc("x").int_conf(1)
+    with pytest.raises(ValueError):
+        ConfigBuilder("cyclone.test.dup").doc("x").int_conf(2)
+
+
+def test_bool_parse():
+    conf = CycloneConf(load_defaults=False)
+    from cycloneml_tpu.conf import EVENT_LOG_ENABLED
+    conf.set("cyclone.eventLog.enabled", "true")
+    assert conf.get(EVENT_LOG_ENABLED) is True

@@ -1,0 +1,116 @@
+"""Dataset tier tests (≈ RDDSuite subset + InstanceBlock behavior), on the
+local-mesh[8] fixture (replaces local-cluster, ref SparkContext.scala:3058)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.dataset import InstanceDataset
+from cycloneml_tpu.dataset.instance import blockify_arrays
+
+
+def test_parallelize_collect(ctx):
+    ds = ctx.parallelize(range(100), 8)
+    assert ds.num_partitions == 8
+    assert ds.collect() == list(range(100))
+    assert ds.count() == 100
+
+
+def test_map_filter_chain(ctx):
+    ds = ctx.parallelize(range(20), 4).map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert ds.collect() == [x * 2 for x in range(20) if (x * 2) % 4 == 0]
+
+
+def test_flat_map_and_map_partitions(ctx):
+    ds = ctx.parallelize([1, 2, 3], 2).flat_map(lambda x: [x, x])
+    assert sorted(ds.collect()) == [1, 1, 2, 2, 3, 3]
+    sums = ctx.parallelize(range(10), 5).map_partitions(lambda it: [sum(it)])
+    assert sum(sums.collect()) == 45
+
+
+def test_reduce_aggregate_tree_aggregate(ctx):
+    ds = ctx.parallelize(range(1, 101), 8)
+    assert ds.reduce(lambda a, b: a + b) == 5050
+    agg = ds.aggregate(0, lambda acc, x: acc + x, lambda a, b: a + b)
+    assert agg == 5050
+    tree = ds.tree_aggregate(0, lambda acc, x: acc + x, lambda a, b: a + b, depth=3)
+    assert tree == 5050
+
+
+def test_group_reduce_by_key(ctx):
+    pairs = ctx.parallelize([("a", 1), ("b", 2), ("a", 3)], 3)
+    out = dict(pairs.reduce_by_key(lambda a, b: a + b).collect())
+    assert out == {"a": 4, "b": 2}
+
+
+def test_zip_with_index_and_take(ctx):
+    ds = ctx.parallelize("abcdef", 3).zip_with_index()
+    assert ds.collect() == [(c, i) for i, c in enumerate("abcdef")]
+    assert ds.take(2) == [("a", 0), ("b", 1)]
+
+
+def test_cache_and_checkpoint(ctx, tmp_path):
+    calls = []
+    ds = ctx.parallelize(range(10), 2).map(lambda x: calls.append(1) or x)
+    ds.persist()
+    ds.collect()
+    n1 = len(calls)
+    ds.collect()
+    assert len(calls) == n1  # cached, no recompute
+    ctx.set_checkpoint_dir(str(tmp_path))
+    ds2 = ctx.parallelize(range(5), 2).map(lambda x: x + 1)
+    ds2.checkpoint()
+    assert ds2.collect() == [1, 2, 3, 4, 5]
+
+
+def test_broadcast_and_accumulator(ctx):
+    b = ctx.broadcast({"w": np.arange(3.0)})
+    np.testing.assert_allclose(b.value["w"], [0, 1, 2])
+    acc = ctx.accumulator(0.0, "hits")
+    ctx.parallelize(range(10), 4).foreach(lambda x: acc.add(1))
+    assert acc.value == 10
+
+
+def test_blockify_padding_invariants():
+    x = np.arange(20.0).reshape(10, 2)
+    xp, yp, wp, n = blockify_arrays(x, None, None, n_shards=8)
+    assert n == 10
+    assert xp.shape[0] % 8 == 0
+    assert wp[:10].sum() == 10 and wp[10:].sum() == 0  # padding has zero weight
+    np.testing.assert_allclose(xp[:10], x)
+
+
+def test_instance_dataset_sharded_aggregate(ctx):
+    """The psum path must equal the host sum exactly in f64."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 4)
+    y = rng.randn(100)
+    ds = InstanceDataset.from_numpy(ctx, x, y, dtype=np.float64)
+    agg = ds.tree_aggregate_fn(
+        lambda xs, ys, ws: {"sx": jnp.sum(xs * ws[:, None], axis=0),
+                            "sy": jnp.sum(ys * ws),
+                            "cnt": jnp.sum(ws)})
+    out = agg()
+    np.testing.assert_allclose(np.asarray(out["sx"]), x.sum(axis=0), rtol=1e-12)
+    np.testing.assert_allclose(float(out["sy"]), y.sum(), rtol=1e-12)
+    assert float(out["cnt"]) == 100
+
+
+def test_instance_dataset_checkpoint_roundtrip(ctx, tmp_path):
+    x = np.random.RandomState(1).randn(32, 3)
+    ds = InstanceDataset.from_numpy(ctx, x, dtype=np.float64)
+    p = ds.checkpoint(str(tmp_path / "ck.npz"))
+    back = InstanceDataset.restore(ctx, p)
+    x2, _, _ = back.to_numpy()
+    np.testing.assert_allclose(x2, x)
+
+
+def test_events_journal(tmp_path):
+    from cycloneml_tpu.util.events import EventJournal, JobStart, ListenerBus
+    bus = ListenerBus()
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    bus.add_listener(j)
+    bus.post(JobStart(job_id=1, description="test"))
+    j.close()
+    events = EventJournal.replay(str(tmp_path / "events.jsonl"))
+    assert events[0]["Event"] == "JobStart" and events[0]["job_id"] == 1

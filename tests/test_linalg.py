@@ -1,0 +1,183 @@
+"""linalg + BLAS dispatch tests (ref: BLASSuite / VectorsSuite / MatricesSuite
+in mllib-local; numeric ground truth from numpy/scipy)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.linalg import (
+    BLAS, DenseMatrix, DenseVector, Matrices, SparseMatrix, SparseVector,
+    Vectors,
+)
+
+
+# -- vectors -----------------------------------------------------------------
+
+def test_dense_sparse_roundtrip():
+    dv = Vectors.dense(0.0, 1.5, 0.0, 3.0)
+    sv = dv.to_sparse()
+    assert sv.indices.tolist() == [1, 3]
+    assert sv.values.tolist() == [1.5, 3.0]
+    assert sv.to_dense() == dv
+    assert dv == sv  # cross-type equality like the reference
+
+
+def test_sparse_factory_pairs():
+    sv = Vectors.sparse(5, [(3, 3.0), (1, 1.0)])
+    assert sv.indices.tolist() == [1, 3]
+    assert sv[3] == 3.0 and sv[0] == 0.0
+
+
+def test_norm_and_sqdist():
+    v = Vectors.dense(3.0, -4.0)
+    assert Vectors.norm(v, 1) == 7.0
+    assert Vectors.norm(v, 2) == 5.0
+    assert Vectors.norm(v, np.inf) == 4.0
+    u = Vectors.sparse(2, [0], [1.0])
+    assert Vectors.sqdist(v, u) == pytest.approx(4.0 + 16.0)
+
+
+def test_argmax_matches_reference_semantics():
+    assert Vectors.dense(1.0, 5.0, 2.0).argmax() == 1
+    # sparse with all negatives: a structural zero wins
+    sv = SparseVector(3, [0, 1], [-2.0, -1.0])
+    assert sv.argmax() == 2
+    assert SparseVector(3, [1], [7.0]).argmax() == 1
+
+
+def test_compressed_picks_smaller():
+    mostly_zero = Vectors.dense([0.0] * 100 + [1.0])
+    assert isinstance(mostly_zero.compressed(), SparseVector)
+    dense = Vectors.dense(list(range(1, 11)))
+    assert isinstance(dense.compressed(), DenseVector)
+
+
+# -- matrices ----------------------------------------------------------------
+
+def test_dense_matrix_column_major_ctor():
+    # reference ctor is column-major: values [1,2,3,4] with 2x2 -> [[1,3],[2,4]]
+    m = Matrices.dense(2, 2, [1, 2, 3, 4])
+    assert m[0, 0] == 1 and m[1, 0] == 2 and m[0, 1] == 3 and m[1, 1] == 4
+    assert m.values.tolist() == [1, 2, 3, 4]
+
+
+def test_sparse_matrix_csc_ctor():
+    # CSC: colptrs=[0,1,2], row_indices=[1,0], values=[5,7] -> [[0,7],[5,0]]
+    m = Matrices.sparse(2, 2, [0, 1, 2], [1, 0], [5.0, 7.0])
+    assert m[1, 0] == 5.0 and m[0, 1] == 7.0
+    assert m.num_actives() == 2
+    t = m.transpose()
+    assert t[0, 1] == 5.0 and t[1, 0] == 7.0
+
+
+def test_matrix_multiply():
+    a = Matrices.from_array(np.arange(6.0).reshape(2, 3))
+    b = Matrices.from_array(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(a.multiply(b).to_array(), a.to_array() @ b.to_array())
+    v = Vectors.dense(1.0, 2.0, 3.0)
+    np.testing.assert_allclose(a.multiply(v).to_array(), a.to_array() @ v.to_array())
+
+
+# -- BLAS --------------------------------------------------------------------
+
+def test_axpy_dense_and_sparse():
+    y = DenseVector(np.ones(4))
+    BLAS.axpy(2.0, Vectors.dense(1, 2, 3, 4), y)
+    np.testing.assert_allclose(y.to_array(), [3, 5, 7, 9])
+    y2 = DenseVector(np.zeros(4))
+    BLAS.axpy(3.0, Vectors.sparse(4, [1, 3], [1.0, 2.0]), y2)
+    np.testing.assert_allclose(y2.to_array(), [0, 3, 0, 6])
+
+
+def test_dot_all_combinations():
+    d1, d2 = Vectors.dense(1, 2, 3), Vectors.dense(4, 5, 6)
+    s1 = Vectors.sparse(3, [0, 2], [1.0, 3.0])
+    s2 = Vectors.sparse(3, [1, 2], [5.0, 6.0])
+    assert BLAS.dot(d1, d2) == 32.0
+    assert BLAS.dot(s1, d2) == 4.0 + 18.0
+    assert BLAS.dot(d2, s1) == 22.0
+    assert BLAS.dot(s1, s2) == 18.0
+
+
+def test_scal_and_copy():
+    v = Vectors.dense(1.0, 2.0)
+    BLAS.scal(3.0, v)
+    np.testing.assert_allclose(v.to_array(), [3, 6])
+    y = Vectors.zeros(2)
+    BLAS.copy(v, y)
+    np.testing.assert_allclose(y.to_array(), [3, 6])
+
+
+def test_gemv_variants():
+    a_np = np.arange(6.0).reshape(2, 3)
+    a = Matrices.from_array(a_np)
+    x = Vectors.dense(1.0, 1.0, 1.0)
+    y = DenseVector(np.ones(2))
+    BLAS.gemv(2.0, a, x, 0.5, y)
+    np.testing.assert_allclose(y.to_array(), 2.0 * (a_np @ np.ones(3)) + 0.5)
+    # sparse x
+    xs = Vectors.sparse(3, [2], [2.0])
+    y2 = DenseVector(np.zeros(2))
+    BLAS.gemv(1.0, a, xs, 0.0, y2)
+    np.testing.assert_allclose(y2.to_array(), a_np[:, 2] * 2.0)
+    # sparse A
+    a_sp = SparseMatrix.from_array(a_np)
+    y3 = DenseVector(np.zeros(2))
+    BLAS.gemv(1.0, a_sp, x, 0.0, y3)
+    np.testing.assert_allclose(y3.to_array(), a_np.sum(axis=1))
+
+
+def test_gemm_variants():
+    a_np = np.random.RandomState(0).randn(4, 3)
+    b_np = np.random.RandomState(1).randn(3, 5)
+    c = Matrices.zeros(4, 5)
+    BLAS.gemm(1.5, Matrices.from_array(a_np), Matrices.from_array(b_np), 0.0, c)
+    np.testing.assert_allclose(c.to_array(), 1.5 * a_np @ b_np, rtol=1e-12)
+    # sparse A
+    c2 = Matrices.ones(4, 5)
+    BLAS.gemm(1.0, SparseMatrix.from_array(a_np), Matrices.from_array(b_np), 2.0, c2)
+    np.testing.assert_allclose(c2.to_array(), a_np @ b_np + 2.0, rtol=1e-12)
+
+
+def test_spr_matches_packed_outer():
+    rng = np.random.RandomState(2)
+    v = rng.randn(5)
+    u = np.zeros(15)
+    BLAS.spr(1.0, Vectors.dense(v), u)
+    full = BLAS.unpack_upper(u, 5)
+    np.testing.assert_allclose(full, np.outer(v, v), rtol=1e-12)
+    # sparse update accumulates identically
+    sv = Vectors.dense(v).to_sparse()
+    u2 = np.zeros(15)
+    BLAS.spr(2.0, sv, u2)
+    np.testing.assert_allclose(u2, 2.0 * u, rtol=1e-12)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.RandomState(3)
+    m = rng.randn(6, 6)
+    sym = m + m.T
+    np.testing.assert_allclose(BLAS.unpack_upper(BLAS.pack_upper(sym), 6), sym)
+
+
+def test_syr():
+    rng = np.random.RandomState(4)
+    a0 = rng.randn(4, 4)
+    a = Matrices.from_array(a0.copy())
+    x = Vectors.dense(rng.randn(4))
+    BLAS.syr(0.7, x, a)
+    np.testing.assert_allclose(
+        a.to_array(), a0 + 0.7 * np.outer(x.to_array(), x.to_array()), rtol=1e-12)
+    # sparse x path
+    a2 = Matrices.zeros(4, 4)
+    xs = Vectors.sparse(4, [1, 3], [2.0, 3.0])
+    BLAS.syr(1.0, xs, a2)
+    expected = np.zeros((4, 4))
+    expected[np.ix_([1, 3], [1, 3])] = np.outer([2.0, 3.0], [2.0, 3.0])
+    np.testing.assert_allclose(a2.to_array(), expected)
+
+
+def test_device_gemm_large_routes_through_jax():
+    rng = np.random.RandomState(5)
+    a = rng.randn(300, 300)
+    b = rng.randn(300, 300)
+    np.testing.assert_allclose(BLAS.device_gemm(a, b), a @ b, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,71 @@
+"""Param system tests (ref: ml/param/params.scala semantics)."""
+
+import pytest
+
+from cycloneml_tpu.ml.param import Param, ParamMap, Params, ParamValidators
+
+
+class Thing(Params):
+    def __init__(self, uid=None):
+        super().__init__(uid)
+        self.maxIter = self._param("maxIter", "max iterations",
+                                   ParamValidators.gt_eq(0), default=100)
+        self.regParam = self._param("regParam", "regularization",
+                                    ParamValidators.gt_eq(0.0), default=0.0)
+        self.solver = self._param("solver", "solver name",
+                                  ParamValidators.in_array(["auto", "l-bfgs"]),
+                                  default="auto")
+
+
+def test_defaults_and_set():
+    t = Thing()
+    assert t.get("maxIter") == 100
+    t.set("maxIter", 5)
+    assert t.get("maxIter") == 5
+    assert t.is_set(t.maxIter)
+    t.clear(t.maxIter)
+    assert t.get("maxIter") == 100
+
+
+def test_validation():
+    t = Thing()
+    with pytest.raises(ValueError):
+        t.set("maxIter", -1)
+    with pytest.raises(ValueError):
+        t.set("solver", "bogus")
+
+
+def test_copy_isolated():
+    t = Thing()
+    t.set("regParam", 0.5)
+    c = t.copy()
+    c.set("regParam", 0.9)
+    assert t.get("regParam") == 0.5
+    assert c.get("regParam") == 0.9
+    assert c.uid == t.uid  # copy keeps uid like the reference
+
+
+def test_extract_param_map_and_extra():
+    t = Thing()
+    t.set("maxIter", 7)
+    extra = ParamMap().put(t.regParam, 0.3)
+    m = t.extract_param_map(extra)
+    assert m.get(t.maxIter) == 7
+    assert m.get(t.regParam) == 0.3
+    assert m.get(t.solver) == "auto"
+
+
+def test_json_roundtrip():
+    t = Thing()
+    t.set("maxIter", 42).set("solver", "l-bfgs")
+    d = t._params_to_json()
+    t2 = Thing()
+    t2._set_params_from_json(d)
+    assert t2.get("maxIter") == 42
+    assert t2.get("solver") == "l-bfgs"
+
+
+def test_explain_params():
+    t = Thing()
+    s = t.explain_params()
+    assert "maxIter" in s and "default: 100" in s
