@@ -66,16 +66,14 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays):
     row_spec = P((REPLICA_AXIS, DATA_AXIS))
 
     def sharded(*all_args):
-        arrs = all_args[: len(arrays)]
-        rest = all_args[len(arrays):]
-
         def local(*a):
-            partial = fn(*a, *rest)
+            partial = fn(*a)
             return jax.tree_util.tree_map(
                 lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
 
-        in_specs = tuple([row_spec] * len(arrs) + [P()] * len(rest))
-        return shard_map_compat(local, mesh, in_specs, P())(*arrs, *rest)
+        n_extras = len(all_args) - len(arrays)
+        in_specs = tuple([row_spec] * len(arrays) + [P()] * n_extras)
+        return shard_map_compat(local, mesh, in_specs, P())(*all_args)
 
     return jax.jit(sharded)
 
