@@ -300,20 +300,17 @@ class InstanceDataset:
     def shape(self) -> Tuple[int, int]:
         return (self.n_rows, self.n_features)
 
-    def tree_aggregate_fn(self, fn: Callable):
+    def tree_aggregate_fn(self, fn: Callable, auto_psum: bool = True):
         """Compile ``fn(x_shard, y_shard, w_shard, *extras) -> pytree`` into a
-        mesh-wide psum aggregation; returns jitted callable taking extras."""
+        mesh-wide psum aggregation; returns jitted callable taking extras.
+        With ``auto_psum=False``, ``fn`` runs its own collectives (pmax etc.)."""
         rt = self.ctx.mesh_runtime
         ds = self
-
-        compiled_cache = {}
+        compiled = collectives.tree_aggregate(fn, rt, ds.x, ds.y, ds.w,
+                                              auto_psum=auto_psum)
 
         def call(*extras):
-            key = tuple(getattr(e, "shape", None) for e in extras)
-            if key not in compiled_cache:
-                compiled_cache[key] = collectives.tree_aggregate(
-                    fn, rt, ds.x, ds.y, ds.w)
-            return compiled_cache[key](ds.x, ds.y, ds.w, *extras)
+            return compiled(ds.x, ds.y, ds.w, *extras)
 
         return call
 

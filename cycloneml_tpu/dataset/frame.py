@@ -1,0 +1,152 @@
+"""MLFrame — a lightweight named-column frame for the estimator API.
+
+The reference's ``ml.*`` API runs on Spark SQL DataFrames with column params
+(featuresCol/labelCol/predictionCol...). Rebuilding Catalyst is out of scope
+for the ML north star (SURVEY §7 step 10); what estimators actually need is a
+typed, named-column, row-aligned container that can hand its numeric columns
+to the device tier. ``MLFrame`` is exactly that: a dict of numpy columns
+(1-D scalars or 2-D vector columns) with select/withColumn semantics, plus a
+bridge to ``InstanceDataset``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from cycloneml_tpu.dataset.dataset import InstanceDataset
+from cycloneml_tpu.dataset.instance import compute_dtype, rows_to_dense
+from cycloneml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
+
+
+class MLFrame:
+    """Immutable named-column table. Columns are numpy arrays sharing row
+    count; vector columns are 2-D (n, d)."""
+
+    def __init__(self, ctx, columns: Dict[str, np.ndarray]):
+        self.ctx = ctx
+        self._cols: Dict[str, np.ndarray] = {}
+        n = None
+        for name, col in columns.items():
+            arr = self._coerce(col)
+            if n is None:
+                n = arr.shape[0]
+            elif arr.shape[0] != n:
+                raise ValueError(
+                    f"column {name!r} has {arr.shape[0]} rows, expected {n}")
+            self._cols[name] = arr
+        self.n_rows = n or 0
+
+    @staticmethod
+    def _coerce(col) -> np.ndarray:
+        if isinstance(col, np.ndarray):
+            return col
+        if len(col) and isinstance(col[0], Vector):
+            return rows_to_dense(col)
+        return np.asarray(col)
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def from_rows(cls, ctx, rows: Sequence, schema: Sequence[str]) -> "MLFrame":
+        cols: Dict[str, list] = {name: [] for name in schema}
+        for row in rows:
+            for name, v in zip(schema, row):
+                cols[name].append(v)
+        return cls(ctx, {k: cls._coerce(v) for k, v in cols.items()})
+
+    @classmethod
+    def from_instance_dataset(cls, ds: InstanceDataset,
+                              features_col: str = "features",
+                              label_col: str = "label",
+                              weight_col: Optional[str] = None) -> "MLFrame":
+        x, y, w = ds.to_numpy()
+        cols = {features_col: x, label_col: y}
+        if weight_col:
+            cols[weight_col] = w
+        return cls(ds.ctx, cols)
+
+    # -- column ops ------------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(f"column {name!r} not in {self.columns}")
+        return self._cols[name]
+
+    def col(self, name: str) -> np.ndarray:
+        return self[name]
+
+    def with_column(self, name: str, values) -> "MLFrame":
+        cols = dict(self._cols)
+        cols[name] = self._coerce(values)
+        return MLFrame(self.ctx, cols)
+
+    def select(self, *names: str) -> "MLFrame":
+        return MLFrame(self.ctx, {n: self[n] for n in names})
+
+    def drop(self, *names: str) -> "MLFrame":
+        return MLFrame(self.ctx, {k: v for k, v in self._cols.items()
+                                  if k not in names})
+
+    def with_column_renamed(self, old: str, new: str) -> "MLFrame":
+        cols = {}
+        for k, v in self._cols.items():
+            cols[new if k == old else k] = v
+        return MLFrame(self.ctx, cols)
+
+    def filter_rows(self, mask: np.ndarray) -> "MLFrame":
+        return MLFrame(self.ctx, {k: v[mask] for k, v in self._cols.items()})
+
+    def sample(self, fraction: float, seed: int = 0) -> "MLFrame":
+        rng = np.random.RandomState(seed)
+        mask = rng.rand(self.n_rows) < fraction
+        return self.filter_rows(mask)
+
+    def random_split(self, weights: Sequence[float], seed: int = 0) -> List["MLFrame"]:
+        rng = np.random.RandomState(seed)
+        total = float(sum(weights))
+        u = rng.rand(self.n_rows)
+        bounds = np.cumsum([w / total for w in weights])
+        out = []
+        lo = 0.0
+        for hi in bounds:
+            out.append(self.filter_rows((u >= lo) & (u < hi)))
+            lo = hi
+        return out
+
+    def limit(self, n: int) -> "MLFrame":
+        return MLFrame(self.ctx, {k: v[:n] for k, v in self._cols.items()})
+
+    def count(self) -> int:
+        return self.n_rows
+
+    def collect(self) -> List[tuple]:
+        names = self.columns
+        return [tuple(self._cols[c][i] for c in names) for i in range(self.n_rows)]
+
+    def head(self, n: int = 5):
+        return self.limit(n).collect()
+
+    # -- bridge to device tier ------------------------------------------------
+    def to_instance_dataset(self, features_col: str = "features",
+                            label_col: Optional[str] = "label",
+                            weight_col: Optional[str] = None,
+                            dtype=None) -> InstanceDataset:
+        if dtype is None:
+            dtype = compute_dtype()
+        x = self[features_col]
+        if x.ndim == 1:
+            x = x[:, None]
+        y = self[label_col] if label_col and label_col in self else None
+        w = self[weight_col] if weight_col and weight_col in self else None
+        return InstanceDataset.from_numpy(self.ctx, x, y, w, dtype=dtype)
+
+    def __repr__(self) -> str:
+        shapes = {k: v.shape for k, v in self._cols.items()}
+        return f"MLFrame({self.n_rows} rows, {shapes})"

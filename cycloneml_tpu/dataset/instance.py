@@ -24,6 +24,17 @@ import numpy as np
 from cycloneml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
 
 
+def compute_dtype():
+    """The effective device float dtype: float64 only when jax x64 is enabled
+    (CPU parity tests); on TPU the MXU path is float32 and requesting f64
+    would silently canonicalize anyway — this makes the choice explicit."""
+    try:
+        import jax
+        return np.float64 if jax.config.jax_enable_x64 else np.float32
+    except Exception:
+        return np.float32
+
+
 @dataclass
 class Instance:
     """One labeled weighted row (ref Instance.scala case class Instance)."""

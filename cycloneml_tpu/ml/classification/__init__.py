@@ -1,0 +1,5 @@
+from cycloneml_tpu.ml.classification.logistic_regression import (
+    LogisticRegression, LogisticRegressionModel,
+)
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel"]

@@ -1,0 +1,3 @@
+from cycloneml_tpu.ml.stat.summarizer import Summarizer, SummaryStats
+
+__all__ = ["Summarizer", "SummaryStats"]

@@ -1,0 +1,157 @@
+"""Model persistence.
+
+Mirrors the reference's MLWriter/MLReader layout (ref: ml/util/ReadWrite.scala
+— MLWriter:157, MLReader:323, MLWritable:274, DefaultParamsWriter/Reader):
+a model directory containing ``metadata/part-00000`` with
+{class, timestamp, uid, paramMap, defaultParamMap} JSON, and a ``data/``
+directory for learned state (npz here instead of Parquet). Pipelines persist
+stages under ``stages/<idx>_<uid>/`` exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+VERSION = "0.1.0"
+
+
+def _metadata_path(path: str) -> str:
+    return os.path.join(path, "metadata", "part-00000")
+
+
+def save_metadata(instance, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
+    os.makedirs(os.path.join(path, "metadata"), exist_ok=True)
+    meta = {
+        "class": f"{type(instance).__module__}.{type(instance).__qualname__}",
+        "timestamp": int(time.time() * 1000),
+        "cycloneVersion": VERSION,
+        "uid": instance.uid,
+        "paramMap": instance._params_to_json(),
+        "defaultParamMap": instance._default_params_to_json(),
+    }
+    if extra:
+        meta.update(extra)
+    with open(_metadata_path(path), "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+
+
+def load_metadata(path: str) -> Dict[str, Any]:
+    with open(_metadata_path(path), encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def instantiate_from_metadata(meta: Dict[str, Any]):
+    module, _, name = meta["class"].rpartition(".")
+    cls = getattr(importlib.import_module(module), name)
+    obj = cls.__new__(cls)
+    cls.__init__(obj, uid=meta["uid"]) if _init_takes_uid(cls) else cls.__init__(obj)
+    obj._set_params_from_json(meta.get("defaultParamMap", {}), default=True)
+    obj._set_params_from_json(meta.get("paramMap", {}))
+    return obj
+
+
+def _init_takes_uid(cls) -> bool:
+    import inspect
+    try:
+        return "uid" in inspect.signature(cls.__init__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def save_arrays(path: str, **arrays) -> None:
+    os.makedirs(os.path.join(path, "data"), exist_ok=True)
+    np.savez(os.path.join(path, "data", "data.npz"), **arrays)
+
+
+def load_arrays(path: str) -> Dict[str, np.ndarray]:
+    z = np.load(os.path.join(path, "data", "data.npz"), allow_pickle=False)
+    return {k: z[k] for k in z.files}
+
+
+class MLWritable:
+    """Mixin giving ``save(path)`` (ref MLWritable:274). Subclasses override
+    ``_save_data(path)`` to write learned state."""
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        if os.path.exists(path):
+            if not overwrite:
+                raise IOError(f"Path exists: {path}; use overwrite=True")
+            shutil.rmtree(path)
+        os.makedirs(path)
+        save_metadata(self, path)
+        self._save_data(path)
+
+    def write(self) -> "_Writer":
+        return _Writer(self)
+
+    def _save_data(self, path: str) -> None:
+        pass
+
+
+class _Writer:
+    """Fluent writer (ref MLWriter:157)."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_Writer":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        self._instance.save(path, overwrite=self._overwrite)
+
+
+class MLReadable:
+    """Mixin giving ``load(path)`` (ref MLReadable/MLReader:323)."""
+
+    @classmethod
+    def load(cls, path: str):
+        meta = load_metadata(path)
+        obj = instantiate_from_metadata(meta)
+        if not isinstance(obj, cls):
+            raise TypeError(f"{path} holds {type(obj).__name__}, expected {cls.__name__}")
+        obj._load_data(path, meta)
+        return obj
+
+    @classmethod
+    def read(cls) -> "_Reader":
+        return _Reader(cls)
+
+    def _load_data(self, path: str, meta: Dict[str, Any]) -> None:
+        pass
+
+
+class _Reader:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def load(self, path: str):
+        return self._cls.load(path)
+
+
+def save_pipeline_stages(stages, path: str) -> None:
+    os.makedirs(os.path.join(path, "stages"), exist_ok=True)
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, "stages", f"{i}_{stage.uid}"), overwrite=True)
+
+
+def load_pipeline_stages(path: str):
+    sdir = os.path.join(path, "stages")
+    entries = sorted(os.listdir(sdir), key=lambda s: int(s.split("_", 1)[0]))
+    out = []
+    for e in entries:
+        spath = os.path.join(sdir, e)
+        meta = load_metadata(spath)
+        obj = instantiate_from_metadata(meta)
+        obj._load_data(spath, meta)
+        out.append(obj)
+    return out

@@ -50,7 +50,8 @@ def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
     return out
 
 
-def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays):
+def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
+                   auto_psum: bool = True):
     """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
 
     ``arrays`` fixes how many leading arguments are row-sharded; the returned
@@ -68,6 +69,9 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays):
     def sharded(*all_args):
         def local(*a):
             partial = fn(*a)
+            if not auto_psum:
+                # fn performs its own collectives (e.g. pmax/pmin stats)
+                return partial
             return jax.tree_util.tree_map(
                 lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
 
