@@ -287,7 +287,10 @@ class InstanceDataset:
 
     @classmethod
     def from_numpy(cls, ctx, x: np.ndarray, y: Optional[np.ndarray] = None,
-                   w: Optional[np.ndarray] = None, dtype=np.float32) -> "InstanceDataset":
+                   w: Optional[np.ndarray] = None, dtype=None) -> "InstanceDataset":
+        if dtype is None:
+            from cycloneml_tpu.dataset.instance import compute_dtype
+            dtype = compute_dtype()
         rt = ctx.mesh_runtime
         x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism, dtype=dtype)
         return cls(ctx,

@@ -143,8 +143,10 @@ class MLFrame:
         x = self[features_col]
         if x.ndim == 1:
             x = x[:, None]
-        y = self[label_col] if label_col and label_col in self else None
-        w = self[weight_col] if weight_col and weight_col in self else None
+        # explicit column names must exist — a typo'd labelCol silently
+        # training on zero labels is worse than an error
+        y = self[label_col] if label_col else None
+        w = self[weight_col] if weight_col else None
         return InstanceDataset.from_numpy(self.ctx, x, y, w, dtype=dtype)
 
     def __repr__(self) -> str:

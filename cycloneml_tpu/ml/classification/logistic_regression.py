@@ -121,8 +121,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                     f"Binomial family requires <= 2 label classes, found "
                     f"{num_classes} (the reference rejects this too)")
             num_classes = max(num_classes, 2)
-        histogram = np.array(
-            [float(w_host[(y_host == k)].sum()) for k in range(num_classes)])
+        histogram = np.bincount(y_host.astype(np.int64), weights=w_host,
+                                minlength=num_classes)[:num_classes]
 
         fit_intercept = self.get("fitIntercept")
         standardize = self.get("standardization")

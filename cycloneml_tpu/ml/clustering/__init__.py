@@ -1,0 +1,3 @@
+from cycloneml_tpu.ml.clustering.kmeans import KMeans, KMeansModel
+
+__all__ = ["KMeans", "KMeansModel"]

@@ -1,0 +1,3 @@
+from cycloneml_tpu.ml.recommendation.als import ALS, ALSModel
+
+__all__ = ["ALS", "ALSModel"]

@@ -1,0 +1,279 @@
+"""Alternating least squares matrix factorization.
+
+Re-design of the reference ALS (ref: ml/recommendation/ALS.scala:606, 1,829
+LoC — block-partitioned factors ``makeBlocks:1605``, per-block normal
+equations ``computeFactors:1689`` built from rank-1 ``dspr`` updates
+(``NormalEquation:872``, ``add:897``), ``CholeskySolver:770``,
+``NNLSSolver:804``; implicit feedback per Hu/Koren/Volinsky with the YᵀY
+trick). TPU-first formulation:
+
+- ratings live as COO arrays (user, item, rating) row-sharded over the mesh —
+  the analog of the reference's in/out blocks without the custom
+  shuffle: each half-step builds EVERY entity's normal equations with one
+  ``segment_sum`` of v vᵀ outer products (an (nnz,r,r) tensor contraction XLA
+  fuses), psums them across shards (replacing the block all-to-all exchange),
+  and solves all entities at once with a **batched Cholesky** on the MXU.
+- explicit: A_u = Σ v vᵀ + λ·n_u·I (ALS-WR scaling, as the reference),
+  b_u = Σ r·v.
+- implicit: A_u = YᵀY + Σ (c−1) v vᵀ + λ·n_u·I with c = 1+α|r|,
+  b_u = Σ c·v for observed p=1 (ref the ``YtY`` path in computeFactors).
+- nonnegative=True replaces the solve with batched projected Newton steps
+  (clamped); the reference's NNLSSolver:804 is a host active-set method —
+  same constraint, device-friendly iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.param import ParamValidators as V
+from cycloneml_tpu.ml.shared import HasMaxIter, HasPredictionCol, HasRegParam, HasSeed
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable, load_arrays, save_arrays
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _ALSParams(HasMaxIter, HasRegParam, HasPredictionCol, HasSeed):
+    def _declare_als_params(self):
+        self._p_max_iter(10)
+        self._p_reg_param(0.1)
+        self._p_prediction_col()
+        self._p_seed(0)
+        self.rankParam = self._param("rank", "factor dimension (> 0)", V.gt(0), default=10)
+        self.userCol = self._param("userCol", "user id column", default="user")
+        self.itemCol = self._param("itemCol", "item id column", default="item")
+        self.ratingCol = self._param("ratingCol", "rating column", default="rating")
+        self.implicitPrefs = self._param("implicitPrefs",
+                                         "implicit preference mode", default=False)
+        self.alpha = self._param("alpha", "implicit confidence scale (>= 0)",
+                                 V.gt_eq(0.0), default=1.0)
+        self.nonnegative = self._param("nonnegative",
+                                       "constrain factors >= 0", default=False)
+        self.coldStartStrategy = self._param(
+            "coldStartStrategy", "nan or drop for unseen ids",
+            V.in_array(["nan", "drop"]), default="nan")
+
+
+class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid)
+        self._declare_als_params()
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    def set_rank(self, v):
+        return self.set("rank", v)
+
+    def set_max_iter(self, v):
+        return self.set("maxIter", v)
+
+    def set_reg_param(self, v):
+        return self.set("regParam", v)
+
+    def set_implicit_prefs(self, v):
+        return self.set("implicitPrefs", v)
+
+    def _fit(self, frame: MLFrame) -> "ALSModel":
+        users_raw = np.asarray(frame[self.get("userCol")]).astype(np.int64)
+        items_raw = np.asarray(frame[self.get("itemCol")]).astype(np.int64)
+        ratings = np.asarray(frame[self.get("ratingCol")]).astype(np.float64)
+
+        user_ids, users = np.unique(users_raw, return_inverse=True)
+        item_ids, items = np.unique(items_raw, return_inverse=True)
+        n_users, n_items = len(user_ids), len(item_ids)
+        rank = self.get("rank")
+
+        u_fac, i_fac = self._train(users, items, ratings, n_users, n_items, rank,
+                                   frame.ctx)
+        model = ALSModel(user_ids, item_ids, u_fac, i_fac, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        return model
+
+    def _train(self, users, items, ratings, n_users, n_items, rank, ctx):
+        import jax
+        import jax.numpy as jnp
+
+        rt = ctx.mesh_runtime
+        implicit = self.get("implicitPrefs")
+        reg = self.get("regParam")
+        alpha = self.get("alpha")
+        nonneg = self.get("nonnegative")
+        dtype = np.float32
+
+        # shard COO triplets over the mesh with zero-weight padding
+        nnz = len(ratings)
+        shards = rt.data_parallelism
+        pad = (-nnz) % (shards * 8)
+        u_arr = np.concatenate([users, np.zeros(pad, np.int32)]).astype(np.int32)
+        i_arr = np.concatenate([items, np.zeros(pad, np.int32)]).astype(np.int32)
+        r_arr = np.concatenate([ratings, np.zeros(pad)]).astype(dtype)
+        m_arr = np.concatenate([np.ones(nnz), np.zeros(pad)]).astype(dtype)
+        u_dev = rt.device_put_sharded_rows(u_arr)
+        i_dev = rt.device_put_sharded_rows(i_arr)
+        r_dev = rt.device_put_sharded_rows(r_arr)
+        m_dev = rt.device_put_sharded_rows(m_arr)
+
+        from cycloneml_tpu.parallel import collectives
+
+        hi = jax.lax.Precision.HIGHEST
+
+        def make_half_step(n_dst: int):
+            """Build + solve normal equations for every destination entity
+            given source factors: one psum'd SPMD program."""
+
+            def local(dst_idx, src_idx, r, mask, src_fac, yty):
+                v = src_fac[src_idx]                       # (nnz_local, rank)
+                if implicit:
+                    c_minus_1 = (alpha * jnp.abs(r)) * mask
+                    p = (r > 0).astype(v.dtype) * mask
+                    outer = jnp.einsum("bi,bj->bij", v * c_minus_1[:, None], v,
+                                       precision=hi)
+                    bvec = v * ((1.0 + c_minus_1) * p)[:, None]
+                else:
+                    outer = jnp.einsum("bi,bj->bij", v * mask[:, None], v,
+                                       precision=hi)
+                    bvec = v * (r * mask)[:, None]
+                a_sum = jax.ops.segment_sum(outer, dst_idx, num_segments=n_dst)
+                b_sum = jax.ops.segment_sum(bvec, dst_idx, num_segments=n_dst)
+                cnt = jax.ops.segment_sum(mask, dst_idx, num_segments=n_dst)
+                return {"A": a_sum, "b": b_sum, "n": cnt}
+
+            agg = collectives.tree_aggregate(local, rt, u_dev, i_dev, r_dev, m_dev)
+
+            @jax.jit
+            def solve(aggregated, yty):
+                a, b, cnt = aggregated["A"], aggregated["b"], aggregated["n"]
+                # ALS-WR: λ scaled by each entity's rating count (ref solver
+                # call sites in computeFactors:1689)
+                lam = reg * jnp.maximum(cnt, 1.0)
+                eye = jnp.eye(rank, dtype=a.dtype)
+                a = a + lam[:, None, None] * eye[None, :, :]
+                if implicit:
+                    a = a + yty[None, :, :]
+                if nonneg:
+                    return _batched_pnewton(a, b)
+                return jnp.linalg.solve(a, b[..., None])[..., 0]
+
+            return agg, solve
+
+        rng = np.random.RandomState(self.get("seed"))
+        # reference init: abs(normal)/sqrt(rank) scaled unit-ish factors
+        u_fac = jnp.asarray(np.abs(rng.normal(size=(n_users, rank))) / np.sqrt(rank),
+                            dtype=dtype)
+        i_fac = jnp.asarray(np.abs(rng.normal(size=(n_items, rank))) / np.sqrt(rank),
+                            dtype=dtype)
+
+        agg_users, solve_users = make_half_step(n_users)
+        agg_items, solve_items = make_half_step(n_items)
+
+        @jax.jit
+        def yty_of(f):
+            return jnp.dot(f.T, f, precision=hi)
+
+        zero_yty = jnp.zeros((rank, rank), dtype=dtype)
+        for _ in range(self.get("maxIter")):
+            yty = yty_of(i_fac) if implicit else zero_yty
+            out = agg_users(u_dev, i_dev, r_dev, m_dev, i_fac, yty)
+            # block per half-step: at most one collective program in flight —
+            # concurrent shard_map executions abort/deadlock the virtual-device
+            # CPU backend, and on TPU the next step depends on this one anyway
+            u_fac = jax.block_until_ready(solve_users(out, yty))
+            yty = yty_of(u_fac) if implicit else zero_yty
+            # swap dst/src: destination = items, source = users
+            out = agg_items(i_dev, u_dev, r_dev, m_dev, u_fac, yty)
+            i_fac = jax.block_until_ready(solve_items(out, yty))
+
+        return np.asarray(u_fac, dtype=np.float64), np.asarray(i_fac, dtype=np.float64)
+
+
+def _batched_pnewton(a, b, iters: int = 40):
+    """Batched projected-Newton NNLS: x ← max(0, x − H⁻¹∇) with damped steps.
+    Device-friendly replacement for the reference's host NNLSSolver:804."""
+    import jax
+    import jax.numpy as jnp
+
+    x0 = jnp.maximum(jnp.linalg.solve(a, b[..., None])[..., 0], 0.0)
+
+    def body(x, _):
+        grad = jnp.einsum("bij,bj->bi", a, x) - b
+        step = jnp.linalg.solve(a, grad[..., None])[..., 0]
+        x1 = jnp.maximum(x - 0.7 * step, 0.0)
+        return x1, None
+
+    x, _ = jax.lax.scan(body, x0, None, length=iters)
+    return x
+
+
+class ALSModel(Model, _ALSParams, MLWritable, MLReadable):
+    def __init__(self, user_ids: Optional[np.ndarray] = None,
+                 item_ids: Optional[np.ndarray] = None,
+                 user_factors: Optional[np.ndarray] = None,
+                 item_factors: Optional[np.ndarray] = None, uid=None):
+        super().__init__(uid)
+        self._declare_als_params()
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self.user_factors = user_factors
+        self.item_factors = item_factors
+
+    @property
+    def rank(self) -> int:
+        return self.user_factors.shape[1]
+
+    def _lookup(self, raw_ids: np.ndarray, ids: np.ndarray) -> np.ndarray:
+        pos = np.searchsorted(ids, raw_ids)
+        pos = np.clip(pos, 0, len(ids) - 1)
+        ok = ids[pos] == raw_ids
+        return np.where(ok, pos, -1)
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        users = np.asarray(frame[self.get("userCol")]).astype(np.int64)
+        items = np.asarray(frame[self.get("itemCol")]).astype(np.int64)
+        up = self._lookup(users, self.user_ids)
+        ip = self._lookup(items, self.item_ids)
+        known = (up >= 0) & (ip >= 0)
+        pred = np.full(len(users), np.nan)
+        pred[known] = np.einsum(
+            "bi,bi->b", self.user_factors[up[known]], self.item_factors[ip[known]])
+        out = frame.with_column(self.get("predictionCol"), pred)
+        if self.get("coldStartStrategy") == "drop":
+            out = out.filter_rows(~np.isnan(pred))
+        return out
+
+    def recommend_for_all_users(self, num_items: int) -> MLFrame:
+        """Top-N items per user via one factor matmul (ref
+        recommendForAllUsers — blocked BLAS-3 there, single MXU matmul here)."""
+        scores = self.user_factors @ self.item_factors.T
+        top = np.argsort(-scores, axis=1)[:, :num_items]
+        rows_user = np.repeat(self.user_ids, num_items)
+        rows_item = self.item_ids[top.ravel()]
+        rows_score = np.take_along_axis(scores, top, axis=1).ravel()
+        from cycloneml_tpu.context import CycloneContext
+        return MLFrame(CycloneContext.get_or_create(), {
+            "user": rows_user, "item": rows_item, "rating": rows_score})
+
+    def recommend_for_all_items(self, num_users: int) -> MLFrame:
+        scores = self.item_factors @ self.user_factors.T
+        top = np.argsort(-scores, axis=1)[:, :num_users]
+        from cycloneml_tpu.context import CycloneContext
+        return MLFrame(CycloneContext.get_or_create(), {
+            "item": np.repeat(self.item_ids, num_users),
+            "user": self.user_ids[top.ravel()],
+            "rating": np.take_along_axis(scores, top, axis=1).ravel()})
+
+    def _save_data(self, path: str) -> None:
+        save_arrays(path, user_ids=self.user_ids, item_ids=self.item_ids,
+                    user_factors=self.user_factors, item_factors=self.item_factors)
+
+    def _load_data(self, path: str, meta) -> None:
+        arrs = load_arrays(path)
+        self.user_ids = arrs["user_ids"]
+        self.item_ids = arrs["item_ids"]
+        self.user_factors = arrs["user_factors"]
+        self.item_factors = arrs["item_factors"]

@@ -1,0 +1,5 @@
+from cycloneml_tpu.ml.regression.linear_regression import (
+    LinearRegression, LinearRegressionModel,
+)
+
+__all__ = ["LinearRegression", "LinearRegressionModel"]

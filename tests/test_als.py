@@ -1,0 +1,115 @@
+"""ALS tests (BASELINE config 4 family): explicit low-rank recovery, implicit
+ranking, ALS-WR regularization behavior, NNLS mode, cold start, persistence."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.recommendation import ALS, ALSModel
+
+
+def _ratings(seed=51, n_users=40, n_items=30, rank=3, frac=0.5):
+    rng = np.random.RandomState(seed)
+    u = rng.randn(n_users, rank)
+    v = rng.randn(n_items, rank)
+    full = u @ v.T
+    mask = rng.rand(n_users, n_items) < frac
+    users, items = np.nonzero(mask)
+    return users, items, full[users, items], full, mask
+
+
+def test_explicit_recovers_low_rank(ctx):
+    users, items, r, full, mask = _ratings()
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    model = ALS(rank=3, maxIter=15, regParam=0.01, seed=1).fit(frame)
+    out = model.transform(frame)
+    rmse = float(np.sqrt(np.mean((out["prediction"] - r) ** 2)))
+    assert rmse < 0.05
+    # held-out entries also predicted well (low-rank generalization)
+    hu, hi = np.nonzero(~mask)
+    hold = MLFrame(ctx, {"user": hu, "item": hi, "rating": full[hu, hi]})
+    out_h = model.transform(hold)
+    rmse_h = float(np.sqrt(np.nanmean((out_h["prediction"] - full[hu, hi]) ** 2)))
+    assert rmse_h < 0.5
+
+
+def test_regularization_shrinks_factors(ctx):
+    users, items, r, _, _ = _ratings(seed=52)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    small = ALS(rank=3, maxIter=10, regParam=0.01, seed=2).fit(frame)
+    big = ALS(rank=3, maxIter=10, regParam=10.0, seed=2).fit(frame)
+    assert np.linalg.norm(big.user_factors) < np.linalg.norm(small.user_factors)
+
+
+def test_implicit_ranks_observed_higher(ctx):
+    rng = np.random.RandomState(53)
+    n_users, n_items = 30, 25
+    # block structure: users < 15 like items < 12
+    users, items, counts = [], [], []
+    for u in range(n_users):
+        liked = range(0, 12) if u < 15 else range(12, 25)
+        for i in liked:
+            if rng.rand() < 0.6:
+                users.append(u)
+                items.append(i)
+                counts.append(rng.randint(1, 5))
+    frame = MLFrame(ctx, {"user": np.array(users), "item": np.array(items),
+                          "rating": np.array(counts, dtype=float)})
+    model = ALS(rank=4, maxIter=10, regParam=0.05, implicitPrefs=True,
+                alpha=10.0, seed=3).fit(frame)
+    scores = model.user_factors @ model.item_factors.T
+    # group-0 users should prefer group-0 items on average
+    assert scores[:15, :12].mean() > scores[:15, 12:].mean() + 0.1
+    assert scores[15:, 12:].mean() > scores[15:, :12].mean() + 0.1
+
+
+def test_nonnegative_factors(ctx):
+    users, items, r, _, _ = _ratings(seed=54)
+    r = np.abs(r) + 0.1
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    model = ALS(rank=3, maxIter=8, regParam=0.1, nonnegative=True, seed=4).fit(frame)
+    assert model.user_factors.min() >= 0.0
+    assert model.item_factors.min() >= 0.0
+    out = model.transform(frame)
+    rmse = float(np.sqrt(np.mean((out["prediction"] - r) ** 2)))
+    assert rmse < 1.0
+
+
+def test_cold_start_nan_and_drop(ctx):
+    users, items, r, _, _ = _ratings(seed=55)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    model = ALS(rank=3, maxIter=5, seed=5).fit(frame)
+    probe = MLFrame(ctx, {"user": np.array([users[0], 9999]),
+                          "item": np.array([items[0], 0]),
+                          "rating": np.array([1.0, 1.0])})
+    out = model.transform(probe)
+    assert np.isfinite(out["prediction"][0])
+    assert np.isnan(out["prediction"][1])
+    model.set("coldStartStrategy", "drop")
+    out2 = model.transform(probe)
+    assert out2.n_rows == 1
+
+
+def test_recommend_for_all_users(ctx):
+    users, items, r, full, _ = _ratings(seed=56)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    model = ALS(rank=3, maxIter=10, regParam=0.01, seed=6).fit(frame)
+    recs = model.recommend_for_all_users(5)
+    assert recs.n_rows == 40 * 5
+    # top recommendation for user 0 should be among its true top items
+    u0 = recs.filter_rows(np.asarray(recs["user"]) == model.user_ids[0])
+    top_true = set(np.argsort(-full[0])[:8])
+    assert int(u0["item"][0]) in top_true
+
+
+def test_save_load(ctx, tmp_path):
+    users, items, r, _, _ = _ratings(seed=57)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    model = ALS(rank=3, maxIter=5, seed=7).fit(frame)
+    p = str(tmp_path / "als")
+    model.save(p)
+    back = ALSModel.load(p)
+    np.testing.assert_allclose(back.user_factors, model.user_factors)
+    o1 = model.transform(frame)["prediction"]
+    o2 = back.transform(frame)["prediction"]
+    np.testing.assert_allclose(o1, o2)

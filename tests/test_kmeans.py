@@ -1,0 +1,96 @@
+"""KMeans tests (BASELINE config 3 family): correctness vs sklearn on
+well-separated blobs, cost parity, cosine mode, weights, persistence."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.clustering import KMeans, KMeansModel
+
+
+def _blobs(ctx, n=600, d=8, k=4, seed=31, spread=0.3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 5
+    labels = rng.randint(0, k, n)
+    x = centers[labels] + spread * rng.randn(n, d)
+    return MLFrame(ctx, {"features": x}), x, labels, centers
+
+
+def test_recovers_well_separated_blobs(ctx):
+    frame, x, labels, true_centers = _blobs(ctx)
+    model = KMeans(k=4, seed=1, maxIter=50).fit(frame)
+    got = np.asarray(model.cluster_centers_matrix().to_array())
+    # each true center has a found center within spread
+    for c in true_centers:
+        assert np.min(np.linalg.norm(got - c, axis=1)) < 0.5
+    # assignments agree with nearest-true-center partition
+    pred = model.transform(frame)["prediction"]
+    from scipy.stats import mode
+    # cluster purity ~ 1
+    purity = np.mean([
+        mode(labels[pred == c], keepdims=False).count / max((pred == c).sum(), 1)
+        for c in np.unique(pred)])
+    assert purity > 0.99
+
+
+def test_cost_close_to_sklearn(ctx):
+    from sklearn.cluster import KMeans as SkKMeans
+    frame, x, _, _ = _blobs(ctx, seed=32, spread=1.0)
+    ours = KMeans(k=4, seed=3, maxIter=100, tol=1e-8).fit(frame)
+    sk = SkKMeans(n_clusters=4, n_init=10, tol=1e-10, random_state=0).fit(x)
+    our_cost = ours.compute_cost(frame)
+    assert our_cost <= sk.inertia_ * 1.05
+
+
+def test_training_cost_and_iterations_recorded(ctx):
+    frame, _, _, _ = _blobs(ctx, seed=33)
+    m = KMeans(k=4, maxIter=30).fit(frame)
+    assert m.training_cost > 0
+    assert 1 <= m.num_iterations <= 30
+    assert m.training_cost == pytest.approx(m.compute_cost(frame), rel=1e-4)
+
+
+def test_random_init_mode(ctx):
+    frame, _, _, _ = _blobs(ctx, seed=34)
+    m = KMeans(k=4, initMode="random", seed=5, maxIter=50).fit(frame)
+    assert len(m.cluster_centers) == 4
+
+
+def test_cosine_distance_clusters_by_angle(ctx):
+    rng = np.random.RandomState(35)
+    x = np.vstack([
+        np.array([1.0, 0.0])[None, :] * rng.uniform(1, 10, (100, 1)),
+        np.array([0.0, 1.0])[None, :] * rng.uniform(1, 10, (100, 1))])
+    x += 0.02 * rng.randn(*x.shape)
+    frame = MLFrame(ctx, {"features": x})
+    m = KMeans(k=2, distanceMeasure="cosine", seed=7, maxIter=30).fit(frame)
+    pred = m.transform(frame)["prediction"]
+    assert len(set(pred[:100])) == 1 and len(set(pred[100:])) == 1
+    assert pred[0] != pred[150]
+    # centers are unit-norm in cosine mode
+    for c in m.cluster_centers:
+        assert np.linalg.norm(c) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_weighted_kmeans_pulls_centers(ctx):
+    x = np.array([[0.0], [1.0], [10.0], [11.0]])
+    w = np.array([1.0, 1.0, 100.0, 100.0])
+    frame = MLFrame(ctx, {"features": x, "w": w})
+    km = KMeans(k=2, maxIter=20, seed=2)
+    km.set("weightCol", "w")
+    m = km.fit(frame)
+    centers = sorted(float(c[0]) for c in m.cluster_centers)
+    assert centers[0] == pytest.approx(0.5, abs=1e-6)
+    assert centers[1] == pytest.approx(10.5, abs=1e-6)
+
+
+def test_save_load(ctx, tmp_path):
+    frame, _, _, _ = _blobs(ctx, seed=36)
+    m = KMeans(k=3, maxIter=10).fit(frame)
+    p = str(tmp_path / "km")
+    m.save(p)
+    back = KMeansModel.load(p)
+    np.testing.assert_allclose(back.cluster_centers_matrix().to_array(),
+                               m.cluster_centers_matrix().to_array())
+    np.testing.assert_allclose(back.transform(frame)["prediction"],
+                               m.transform(frame)["prediction"])

@@ -108,10 +108,16 @@ def test_threshold_and_probability_columns(ctx):
     assert "prediction" in out and "probability" in out and "rawPrediction" in out
     probs = out["probability"]
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-8)
-    # high threshold forces all-negative predictions
-    model.set("threshold", 0.999999)
-    out2 = model.transform(frame)
-    assert out2["prediction"].sum() <= y.sum()  # strictly fewer positives
+    # extreme thresholds force all-negative / all-positive predictions
+    model.set("threshold", 0.9999999)
+    assert model.transform(frame)["prediction"].sum() == 0.0
+    model.set("threshold", 1e-9)
+    assert model.transform(frame)["prediction"].sum() == float(frame.n_rows)
+    model.set("threshold", 0.5)
+    # predict() agrees with transform() under a non-default threshold
+    model.set("threshold", 0.9)
+    preds = model.transform(frame)["prediction"]
+    assert model.predict(x[0]) == preds[0]
     model.set("threshold", 0.5)
 
 

@@ -1,0 +1,92 @@
+"""RowMatrix tests (BASELINE config 5 family): Gramian/covariance/PCA/SVD
+parity vs numpy/scipy, Lanczos path vs full eigh."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.linalg.distributed import RowMatrix
+
+
+def _rm(ctx, n=200, d=12, seed=41):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d) @ np.diag(np.linspace(3, 0.2, d))
+    return RowMatrix.from_numpy(ctx, x), x
+
+
+def test_gramian(ctx):
+    rm, x = _rm(ctx)
+    np.testing.assert_allclose(rm.compute_gramian().to_array(), x.T @ x,
+                               rtol=1e-10)
+
+
+def test_covariance(ctx):
+    rm, x = _rm(ctx, seed=42)
+    np.testing.assert_allclose(rm.compute_covariance().to_array(),
+                               np.cov(x, rowvar=False), rtol=1e-8, atol=1e-10)
+
+
+def test_pca_vs_numpy(ctx):
+    rm, x = _rm(ctx, seed=43)
+    pcs, var = rm.compute_principal_components_and_variance(3)
+    cov = np.cov(x, rowvar=False)
+    vals, vecs = np.linalg.eigh(cov)
+    order = np.argsort(vals)[::-1]
+    ref_var = vals[order] / vals.sum()
+    np.testing.assert_allclose(var.to_array(), ref_var[:3], rtol=1e-8)
+    for j in range(3):
+        ref = vecs[:, order[j]]
+        got = pcs.to_array()[:, j]
+        assert abs(abs(ref @ got) - 1.0) < 1e-8  # same subspace direction
+
+
+def test_svd_small_matches_numpy(ctx):
+    rm, x = _rm(ctx, seed=44)
+    res = rm.compute_svd(5, compute_u=True)
+    u_np, s_np, vt_np = np.linalg.svd(x, full_matrices=False)
+    np.testing.assert_allclose(res.s.to_array(), s_np[:5], rtol=1e-8)
+    # V columns span the same directions
+    v = res.V.to_array()
+    for j in range(5):
+        assert abs(abs(vt_np[j] @ v[:, j]) - 1.0) < 1e-8
+    # rank-5 reconstruction matches numpy's rank-5 truncation
+    u = res.U.to_numpy()[:, : len(res.s)]
+    recon = u @ np.diag(res.s.to_array()) @ v.T
+    ref_recon = u_np[:, :5] @ np.diag(s_np[:5]) @ vt_np[:5]
+    np.testing.assert_allclose(recon, ref_recon, atol=1e-7)
+
+
+def test_svd_lanczos_path(ctx):
+    rm, x = _rm(ctx, n=100, d=30, seed=45)
+    res = rm.compute_svd(4, max_gram_dim=8)  # force Lanczos
+    s_np = np.linalg.svd(x, compute_uv=False)
+    np.testing.assert_allclose(res.s.to_array(), s_np[:4], rtol=1e-6)
+
+
+def test_multiply(ctx):
+    from cycloneml_tpu.linalg.matrices import Matrices
+    rm, x = _rm(ctx, seed=46)
+    b = np.random.RandomState(0).randn(x.shape[1], 4)
+    out = rm.multiply(Matrices.from_array(b))
+    np.testing.assert_allclose(out.to_numpy(), x @ b, rtol=1e-8, atol=1e-9)
+
+
+def test_column_similarities(ctx):
+    rm, x = _rm(ctx, seed=47)
+    sim = rm.column_similarities().to_array()
+    d = x.shape[1]
+    for i in range(d):
+        for j in range(i + 1, d):
+            ref = x[:, i] @ x[:, j] / np.linalg.norm(x[:, i]) / np.linalg.norm(x[:, j])
+            assert sim[i, j] == pytest.approx(ref, rel=1e-8)
+    assert np.allclose(np.tril(sim), 0.0)
+
+
+def test_svd_rcond_truncates_rank(ctx):
+    rng = np.random.RandomState(48)
+    base = rng.randn(100, 3)
+    x = np.hstack([base, base @ rng.randn(3, 3)])  # rank 3 in 6 cols
+    rm = RowMatrix.from_numpy(ctx, x)
+    res = rm.compute_svd(6, r_cond=1e-6)
+    assert len(res.s) == 3
+
+
