@@ -1,0 +1,8 @@
+from cycloneml_tpu.ml.evaluation.evaluators import (
+    Evaluator, BinaryClassificationEvaluator, MulticlassClassificationEvaluator,
+    RegressionEvaluator, ClusteringEvaluator, RankingEvaluator,
+)
+
+__all__ = ["Evaluator", "BinaryClassificationEvaluator",
+           "MulticlassClassificationEvaluator", "RegressionEvaluator",
+           "ClusteringEvaluator", "RankingEvaluator"]

@@ -1,0 +1,265 @@
+"""Evaluators.
+
+Parity with ref ml/evaluation: Evaluator.scala, BinaryClassificationEvaluator
+(areaUnderROC/areaUnderPR via the mllib BinaryClassificationMetrics curves),
+MulticlassClassificationEvaluator (accuracy, f1, precision/recall variants,
+logLoss, hammingLoss), RegressionEvaluator (rmse/mse/mae/r2/var),
+ClusteringEvaluator (silhouette), RankingEvaluator (MAP/NDCG/precision@k).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.param import Params, ParamValidators as V
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable
+
+
+class Evaluator(Params, MLWritable, MLReadable):
+    """Base (ref Evaluator.scala): evaluate + isLargerBetter."""
+
+    def evaluate(self, frame: MLFrame) -> float:
+        raise NotImplementedError
+
+    @property
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.rawPredictionCol = self._param("rawPredictionCol",
+                                            "raw prediction/score column",
+                                            default="rawPrediction")
+        self.labelCol = self._param("labelCol", "label column", default="label")
+        self.weightCol = self._param("weightCol", "weight column", default="")
+        self.metricName = self._param(
+            "metricName", "areaUnderROC|areaUnderPR",
+            V.in_array(["areaUnderROC", "areaUnderPR"]), default="areaUnderROC")
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def evaluate(self, frame: MLFrame) -> float:
+        raw = frame[self.get("rawPredictionCol")]
+        score = raw[:, 1] if raw.ndim == 2 else np.asarray(raw, dtype=np.float64)
+        y = np.asarray(frame[self.get("labelCol")], dtype=np.float64)
+        wcol = self.get("weightCol")
+        w = np.asarray(frame[wcol], dtype=np.float64) if wcol else np.ones(len(y))
+        order = np.argsort(-score, kind="stable")
+        y, w, s = y[order], w[order], score[order]
+        tps = np.cumsum(w * y)
+        fps = np.cumsum(w * (1 - y))
+        # tied scores form one curve point — keep only each tie-group's last
+        # cumulative value, else the metric depends on row order within ties
+        last_of_group = np.append(s[1:] != s[:-1], True)
+        tps, fps = tps[last_of_group], fps[last_of_group]
+        tp_tot, fp_tot = tps[-1], fps[-1]
+        if self.get("metricName") == "areaUnderROC":
+            tpr = np.concatenate([[0.0], tps / max(tp_tot, 1e-300)])
+            fpr = np.concatenate([[0.0], fps / max(fp_tot, 1e-300)])
+            return float(np.trapezoid(tpr, fpr))
+        precision = tps / np.maximum(tps + fps, 1e-300)
+        recall = tps / max(tp_tot, 1e-300)
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[1.0], precision])
+        return float(np.trapezoid(precision, recall))
+
+
+class MulticlassClassificationEvaluator(Evaluator):
+    _METRICS = ["f1", "accuracy", "weightedPrecision", "weightedRecall",
+                "weightedFMeasure", "weightedTruePositiveRate",
+                "weightedFalsePositiveRate", "logLoss", "hammingLoss"]
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.predictionCol = self._param("predictionCol", "prediction column",
+                                         default="prediction")
+        self.labelCol = self._param("labelCol", "label column", default="label")
+        self.probabilityCol = self._param("probabilityCol",
+                                          "probability column (for logLoss)",
+                                          default="probability")
+        self.metricName = self._param("metricName", "metric",
+                                      V.in_array(self._METRICS), default="f1")
+        self.beta = self._param("beta", "F-beta", V.gt(0.0), default=1.0)
+        for k, v in kw.items():
+            self.set(k, v)
+
+    @property
+    def is_larger_better(self) -> bool:
+        return self.get("metricName") not in ("logLoss", "hammingLoss")
+
+    def evaluate(self, frame: MLFrame) -> float:
+        metric = self.get("metricName")
+        y = np.asarray(frame[self.get("labelCol")], dtype=np.int64)
+        if metric == "logLoss":
+            probs = frame[self.get("probabilityCol")]
+            p = np.clip(probs[np.arange(len(y)), y], 1e-15, 1.0)
+            return float(-np.log(p).mean())
+        pred = np.asarray(frame[self.get("predictionCol")], dtype=np.int64)
+        if metric == "accuracy":
+            return float((pred == y).mean())
+        if metric == "hammingLoss":
+            return float((pred != y).mean())
+        classes = np.unique(np.concatenate([y, pred]))
+        n = len(y)
+        weights = np.array([(y == c).sum() / n for c in classes])
+        prec, rec, tpr, fpr = [], [], [], []
+        for c in classes:
+            tp = float(((pred == c) & (y == c)).sum())
+            fp = float(((pred == c) & (y != c)).sum())
+            fn = float(((pred != c) & (y == c)).sum())
+            tn = n - tp - fp - fn
+            prec.append(tp / max(tp + fp, 1e-300))
+            rec.append(tp / max(tp + fn, 1e-300))
+            tpr.append(tp / max(tp + fn, 1e-300))
+            fpr.append(fp / max(fp + tn, 1e-300))
+        prec, rec = np.array(prec), np.array(rec)
+        if metric == "weightedPrecision":
+            return float((weights * prec).sum())
+        if metric in ("weightedRecall", "weightedTruePositiveRate"):
+            return float((weights * rec).sum())
+        if metric == "weightedFalsePositiveRate":
+            return float((weights * np.array(fpr)).sum())
+        # 'f1' is always beta=1 (as the reference); 'weightedFMeasure' honours beta
+        beta2 = (self.get("beta") if metric == "weightedFMeasure" else 1.0) ** 2
+        f = (1 + beta2) * prec * rec / np.maximum(beta2 * prec + rec, 1e-300)
+        return float((weights * f).sum())
+
+
+class RegressionEvaluator(Evaluator):
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.predictionCol = self._param("predictionCol", "prediction column",
+                                         default="prediction")
+        self.labelCol = self._param("labelCol", "label column", default="label")
+        self.metricName = self._param(
+            "metricName", "rmse|mse|mae|r2|var",
+            V.in_array(["rmse", "mse", "mae", "r2", "var"]), default="rmse")
+        for k, v in kw.items():
+            self.set(k, v)
+
+    @property
+    def is_larger_better(self) -> bool:
+        return self.get("metricName") in ("r2", "var")
+
+    def evaluate(self, frame: MLFrame) -> float:
+        y = np.asarray(frame[self.get("labelCol")], dtype=np.float64)
+        pred = np.asarray(frame[self.get("predictionCol")], dtype=np.float64)
+        resid = y - pred
+        m = self.get("metricName")
+        if m == "rmse":
+            return float(np.sqrt((resid ** 2).mean()))
+        if m == "mse":
+            return float((resid ** 2).mean())
+        if m == "mae":
+            return float(np.abs(resid).mean())
+        if m == "var":
+            return float(pred.var())
+        sst = ((y - y.mean()) ** 2).sum()
+        return float(1.0 - (resid ** 2).sum() / max(sst, 1e-300))
+
+
+class ClusteringEvaluator(Evaluator):
+    """Silhouette with squared euclidean distance (ref
+    ClusteringEvaluator.scala — same default metric)."""
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.predictionCol = self._param("predictionCol", "cluster column",
+                                         default="prediction")
+        self.featuresCol = self._param("featuresCol", "features column",
+                                       default="features")
+        self.metricName = self._param("metricName", "silhouette",
+                                      V.in_array(["silhouette"]),
+                                      default="silhouette")
+        self.distanceMeasure = self._param(
+            "distanceMeasure", "squaredEuclidean|cosine",
+            V.in_array(["squaredEuclidean", "cosine"]),
+            default="squaredEuclidean")
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def evaluate(self, frame: MLFrame) -> float:
+        x = frame[self.get("featuresCol")].astype(np.float64)
+        if self.get("distanceMeasure") == "cosine":
+            x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        labels = np.asarray(frame[self.get("predictionCol")]).astype(int)
+        classes = np.unique(labels)
+        if len(classes) < 2:
+            return 1.0
+        # squared-euclidean silhouette via the cluster-moment trick the
+        # reference uses (O(n·k) not O(n²)): ||x-y||² summed over cluster C =
+        # |C|·||x||² - 2 x·S_C + Q_C
+        sums = {c: x[labels == c].sum(axis=0) for c in classes}
+        sqs = {c: (x[labels == c] ** 2).sum() for c in classes}
+        cnt = {c: int((labels == c).sum()) for c in classes}
+        sil = np.zeros(len(x))
+        for i in range(len(x)):
+            xi = x[i]
+            xi_sq = float(xi @ xi)
+            own = labels[i]
+            def mean_d(c, exclude_self):
+                n_c = cnt[c] - (1 if exclude_self else 0)
+                if n_c == 0:
+                    return 0.0
+                s = sums[c] - (xi if exclude_self else 0.0)
+                q = sqs[c] - (xi_sq if exclude_self else 0.0)
+                return (n_c * xi_sq - 2.0 * float(xi @ s) + q) / n_c
+            a = mean_d(own, True)
+            b = min(mean_d(c, False) for c in classes if c != own)
+            denom = max(a, b)
+            sil[i] = (b - a) / denom if denom > 0 else 0.0
+        return float(sil.mean())
+
+
+class RankingEvaluator(Evaluator):
+    """(ref RankingEvaluator.scala / mllib RankingMetrics): label and
+    prediction columns hold arrays of ids (object columns)."""
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.predictionCol = self._param("predictionCol", "predicted id arrays",
+                                         default="prediction")
+        self.labelCol = self._param("labelCol", "relevant id arrays",
+                                    default="label")
+        self.metricName = self._param(
+            "metricName", "ranking metric",
+            V.in_array(["meanAveragePrecision", "meanAveragePrecisionAtK",
+                        "precisionAtK", "ndcgAtK", "recallAtK"]),
+            default="meanAveragePrecision")
+        self.k = self._param("k", "cutoff (> 0)", V.gt(0), default=10)
+        for k_, v in kw.items():
+            self.set(k_, v)
+
+    def evaluate(self, frame: MLFrame) -> float:
+        preds = frame[self.get("predictionCol")]
+        labels = frame[self.get("labelCol")]
+        metric = self.get("metricName")
+        k = self.get("k")
+        vals = []
+        for p, l in zip(preds, labels):
+            rel = set(l)
+            p = list(p)
+            if metric in ("meanAveragePrecision", "meanAveragePrecisionAtK"):
+                cut = k if metric.endswith("AtK") else len(p)
+                hits, score = 0, 0.0
+                for rank, item in enumerate(p[:cut]):
+                    if item in rel:
+                        hits += 1
+                        score += hits / (rank + 1)
+                vals.append(score / max(min(len(rel), cut), 1))
+            elif metric == "precisionAtK":
+                vals.append(sum(1 for i in p[:k] if i in rel) / k)
+            elif metric == "recallAtK":
+                vals.append(sum(1 for i in p[:k] if i in rel) / max(len(rel), 1))
+            else:  # ndcgAtK
+                dcg = sum(1.0 / np.log2(r + 2) for r, item in enumerate(p[:k])
+                          if item in rel)
+                idcg = sum(1.0 / np.log2(r + 2)
+                           for r in range(min(len(rel), k)))
+                vals.append(dcg / max(idcg, 1e-300))
+        return float(np.mean(vals)) if vals else 0.0

@@ -1,0 +1,46 @@
+from cycloneml_tpu.ml.feature.scalers import (
+    StandardScaler, StandardScalerModel, MinMaxScaler, MinMaxScalerModel,
+    MaxAbsScaler, MaxAbsScalerModel, RobustScaler, RobustScalerModel,
+    Normalizer,
+)
+from cycloneml_tpu.ml.feature.transforms import (
+    Binarizer, Bucketizer, ElementwiseProduct, PolynomialExpansion, DCT,
+    VectorAssembler, VectorSlicer, VectorSizeHint, Interaction,
+    QuantileDiscretizer, Imputer, ImputerModel,
+)
+from cycloneml_tpu.ml.feature.text import (
+    Tokenizer, RegexTokenizer, StopWordsRemover, NGram, HashingTF, IDF,
+    IDFModel, CountVectorizer, CountVectorizerModel, FeatureHasher,
+)
+from cycloneml_tpu.ml.feature.indexers import (
+    StringIndexer, StringIndexerModel, IndexToString, OneHotEncoder,
+    OneHotEncoderModel, VectorIndexer, VectorIndexerModel,
+)
+from cycloneml_tpu.ml.feature.selectors import (
+    ChiSqSelector, ChiSqSelectorModel, VarianceThresholdSelector,
+    VarianceThresholdSelectorModel, UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+)
+from cycloneml_tpu.ml.feature.pca import PCA, PCAModel
+from cycloneml_tpu.ml.feature.lsh import (
+    MinHashLSH, MinHashLSHModel, BucketedRandomProjectionLSH,
+    BucketedRandomProjectionLSHModel,
+)
+from cycloneml_tpu.ml.feature.word2vec import Word2Vec, Word2VecModel
+
+__all__ = [
+    "StandardScaler", "StandardScalerModel", "MinMaxScaler", "MinMaxScalerModel",
+    "MaxAbsScaler", "MaxAbsScalerModel", "RobustScaler", "RobustScalerModel",
+    "Normalizer", "Binarizer", "Bucketizer", "ElementwiseProduct",
+    "PolynomialExpansion", "DCT", "VectorAssembler", "VectorSlicer",
+    "VectorSizeHint", "Interaction", "QuantileDiscretizer", "Imputer",
+    "ImputerModel", "Tokenizer", "RegexTokenizer", "StopWordsRemover", "NGram",
+    "HashingTF", "IDF", "IDFModel", "CountVectorizer", "CountVectorizerModel",
+    "FeatureHasher", "StringIndexer", "StringIndexerModel", "IndexToString",
+    "OneHotEncoder", "OneHotEncoderModel", "VectorIndexer", "VectorIndexerModel",
+    "ChiSqSelector", "ChiSqSelectorModel", "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel", "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel", "PCA", "PCAModel", "MinHashLSH",
+    "MinHashLSHModel", "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel", "Word2Vec", "Word2VecModel",
+]

@@ -1,3 +1,7 @@
 from cycloneml_tpu.ml.stat.summarizer import Summarizer, SummaryStats
+from cycloneml_tpu.ml.stat.tests import (
+    ANOVATest, ChiSquareTest, Correlation, FValueTest, KolmogorovSmirnovTest,
+)
 
-__all__ = ["Summarizer", "SummaryStats"]
+__all__ = ["Summarizer", "SummaryStats", "ChiSquareTest", "Correlation",
+           "KolmogorovSmirnovTest", "ANOVATest", "FValueTest"]

@@ -1,0 +1,7 @@
+from cycloneml_tpu.ml.tuning.tuning import (
+    ParamGridBuilder, CrossValidator, CrossValidatorModel,
+    TrainValidationSplit, TrainValidationSplitModel,
+)
+
+__all__ = ["ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
+           "TrainValidationSplit", "TrainValidationSplitModel"]

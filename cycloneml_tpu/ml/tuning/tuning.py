@@ -1,0 +1,209 @@
+"""Model selection / hyperparameter tuning.
+
+Parity with ref ml/tuning: ParamGridBuilder, CrossValidator.scala:80
+(k-fold, fits folds concurrently via a thread pool sized by ``parallelism``
+— setParallelism:119; same here), TrainValidationSplit.scala.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from itertools import product
+from typing import List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.param import Param, ParamMap, ParamValidators as V
+from cycloneml_tpu.ml.shared import HasSeed
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable
+
+
+class ParamGridBuilder:
+    """(ref ParamGridBuilder in tuning/ParamGridBuilder.scala)."""
+
+    def __init__(self):
+        self._grid = {}
+
+    def add_grid(self, param: Param, values) -> "ParamGridBuilder":
+        self._grid[param] = list(values)
+        return self
+
+    def base_on(self, param_map: ParamMap) -> "ParamGridBuilder":
+        for p, v in param_map.items():
+            self._grid[p] = [v]
+        return self
+
+    def build(self) -> List[ParamMap]:
+        if not self._grid:
+            return [ParamMap()]
+        keys = list(self._grid)
+        out = []
+        for combo in product(*(self._grid[k] for k in keys)):
+            pm = ParamMap()
+            for k, v in zip(keys, combo):
+                pm.put(k, v)
+            out.append(pm)
+        return out
+
+
+class _ValidatorParams(HasSeed):
+    def _p_validator(self):
+        self._p_seed(42)
+        self.parallelism = self._param("parallelism",
+                                       "concurrent fits (>= 1)", V.gt_eq(1),
+                                       default=1)
+
+    def set_estimator(self, est: Estimator):
+        self._estimator = est
+        return self
+
+    def set_estimator_param_maps(self, maps: List[ParamMap]):
+        self._param_maps = list(maps)
+        return self
+
+    def set_evaluator(self, ev):
+        self._evaluator = ev
+        return self
+
+    def _fit_score_one(self, pm: ParamMap, train: MLFrame, valid: MLFrame) -> float:
+        model = self._estimator.fit(train, pm)
+        return self._evaluator.evaluate(model.transform(valid))
+
+
+class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
+    """(ref CrossValidator.scala:80)."""
+
+    def __init__(self, uid=None, estimator=None, estimator_param_maps=None,
+                 evaluator=None, **kw):
+        super().__init__(uid)
+        self._p_validator()
+        self.numFolds = self._param("numFolds", "folds (>= 2)", V.gt_eq(2),
+                                    default=3)
+        self.foldCol = self._param("foldCol", "user-supplied fold column",
+                                   default="")
+        if estimator is not None:
+            self.set_estimator(estimator)
+        if estimator_param_maps is not None:
+            self.set_estimator_param_maps(estimator_param_maps)
+        if evaluator is not None:
+            self.set_evaluator(evaluator)
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def _fit(self, frame: MLFrame) -> "CrossValidatorModel":
+        n_folds = self.get("numFolds")
+        fold_col = self.get("foldCol")
+        if fold_col:
+            folds = np.asarray(frame[fold_col]).astype(int)
+        else:
+            rng = np.random.RandomState(self.get("seed"))
+            folds = rng.randint(0, n_folds, frame.n_rows)
+        maps = self._param_maps
+        metrics = np.zeros(len(maps))
+        jobs = []
+        for f in range(n_folds):
+            train = frame.filter_rows(folds != f)
+            valid = frame.filter_rows(folds == f)
+            for mi, pm in enumerate(maps):
+                jobs.append((mi, pm, train, valid))
+        par = self.get("parallelism")
+        if par > 1:
+            with cf.ThreadPoolExecutor(max_workers=par) as pool:
+                results = list(pool.map(
+                    lambda j: (j[0], self._fit_score_one(j[1], j[2], j[3])), jobs))
+        else:
+            results = [(mi, self._fit_score_one(pm, tr, va))
+                       for mi, pm, tr, va in jobs]
+        for mi, score in results:
+            metrics[mi] += score
+        metrics /= n_folds
+        best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
+                       else np.argmin(metrics))
+        best = self._estimator.fit(frame, maps[best_idx])
+        model = CrossValidatorModel(best, metrics.tolist(), uid=self.uid)
+        self._copy_values(model)
+        return model._set_parent(self)
+
+
+class CrossValidatorModel(Model, _ValidatorParams, MLWritable, MLReadable):
+    def __init__(self, best_model: Optional[Model] = None,
+                 avg_metrics: Optional[List[float]] = None, uid=None):
+        super().__init__(uid)
+        self._p_validator()
+        self.numFolds = self._param("numFolds", "folds", default=3)
+        self.foldCol = self._param("foldCol", "fold column", default="")
+        self.best_model = best_model
+        self.avg_metrics = list(avg_metrics or [])
+
+    def _transform(self, frame):
+        return self.best_model.transform(frame)
+
+    def _save_data(self, path):
+        import json, os
+        self.best_model.save(os.path.join(path, "bestModel"), overwrite=True)
+        with open(os.path.join(path, "metrics.json"), "w") as fh:
+            json.dump(self.avg_metrics, fh)
+
+    def _load_data(self, path, meta):
+        import json, os
+        from cycloneml_tpu.ml.util_io import instantiate_from_metadata, load_metadata
+        bp = os.path.join(path, "bestModel")
+        bm_meta = load_metadata(bp)
+        self.best_model = instantiate_from_metadata(bm_meta)
+        self.best_model._load_data(bp, bm_meta)
+        with open(os.path.join(path, "metrics.json")) as fh:
+            self.avg_metrics = json.load(fh)
+
+
+class TrainValidationSplit(Estimator, _ValidatorParams, MLWritable, MLReadable):
+    """(ref TrainValidationSplit.scala)."""
+
+    def __init__(self, uid=None, estimator=None, estimator_param_maps=None,
+                 evaluator=None, **kw):
+        super().__init__(uid)
+        self._p_validator()
+        self.trainRatio = self._param("trainRatio", "train fraction",
+                                      V.in_range(0, 1, False, False),
+                                      default=0.75)
+        if estimator is not None:
+            self.set_estimator(estimator)
+        if estimator_param_maps is not None:
+            self.set_estimator_param_maps(estimator_param_maps)
+        if evaluator is not None:
+            self.set_evaluator(evaluator)
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def _fit(self, frame: MLFrame) -> "TrainValidationSplitModel":
+        rng = np.random.RandomState(self.get("seed"))
+        mask = rng.rand(frame.n_rows) < self.get("trainRatio")
+        train, valid = frame.filter_rows(mask), frame.filter_rows(~mask)
+        maps = self._param_maps
+        par = self.get("parallelism")
+        if par > 1:
+            with cf.ThreadPoolExecutor(max_workers=par) as pool:
+                metrics = list(pool.map(
+                    lambda pm: self._fit_score_one(pm, train, valid), maps))
+        else:
+            metrics = [self._fit_score_one(pm, train, valid) for pm in maps]
+        metrics = np.asarray(metrics)
+        best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
+                       else np.argmin(metrics))
+        best = self._estimator.fit(frame, maps[best_idx])
+        model = TrainValidationSplitModel(best, metrics.tolist(), uid=self.uid)
+        self._copy_values(model)
+        return model._set_parent(self)
+
+
+class TrainValidationSplitModel(CrossValidatorModel):
+    def __init__(self, best_model=None, validation_metrics=None, uid=None):
+        super().__init__(best_model, validation_metrics, uid=uid)
+        self.trainRatio = self._param("trainRatio", "train fraction",
+                                      default=0.75)
+
+    @property
+    def validation_metrics(self):
+        # property, not an alias: _load_data rebinds avg_metrics after init
+        return self.avg_metrics

@@ -1,0 +1,211 @@
+"""Evaluator + tuning + stat tests, cross-checked against sklearn/scipy."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.evaluation import (
+    BinaryClassificationEvaluator, ClusteringEvaluator,
+    MulticlassClassificationEvaluator, RankingEvaluator, RegressionEvaluator,
+)
+from cycloneml_tpu.ml.stat import (
+    ANOVATest, ChiSquareTest, Correlation, FValueTest, KolmogorovSmirnovTest,
+)
+from cycloneml_tpu.ml.tuning import (
+    CrossValidator, ParamGridBuilder, TrainValidationSplit,
+)
+
+
+def test_binary_evaluator_auc_vs_sklearn(ctx):
+    from sklearn.metrics import average_precision_score, roc_auc_score
+    rng = np.random.RandomState(70)
+    y = rng.randint(0, 2, 500).astype(float)
+    score = y + rng.randn(500)
+    f = MLFrame(ctx, {"label": y, "rawPrediction": score})
+    ev = BinaryClassificationEvaluator()
+    assert ev.evaluate(f) == pytest.approx(roc_auc_score(y, score), abs=1e-10)
+    ev.set("metricName", "areaUnderPR")
+    assert ev.evaluate(f) == pytest.approx(average_precision_score(y, score), abs=0.01)
+
+
+def test_multiclass_evaluator_vs_sklearn(ctx):
+    from sklearn.metrics import accuracy_score, f1_score, precision_score, recall_score
+    rng = np.random.RandomState(71)
+    y = rng.randint(0, 3, 400).astype(float)
+    pred = np.where(rng.rand(400) < 0.7, y, rng.randint(0, 3, 400)).astype(float)
+    f = MLFrame(ctx, {"label": y, "prediction": pred})
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    assert ev.evaluate(f) == pytest.approx(accuracy_score(y, pred))
+    ev.set("metricName", "f1")
+    assert ev.evaluate(f) == pytest.approx(
+        f1_score(y, pred, average="weighted"), abs=1e-10)
+    ev.set("metricName", "weightedPrecision")
+    assert ev.evaluate(f) == pytest.approx(
+        precision_score(y, pred, average="weighted"), abs=1e-10)
+    ev.set("metricName", "weightedRecall")
+    assert ev.evaluate(f) == pytest.approx(
+        recall_score(y, pred, average="weighted"), abs=1e-10)
+
+
+def test_regression_evaluator(ctx):
+    y = np.array([1.0, 2.0, 3.0, 4.0])
+    p = np.array([1.1, 1.9, 3.2, 3.8])
+    f = MLFrame(ctx, {"label": y, "prediction": p})
+    ev = RegressionEvaluator(metricName="rmse")
+    assert ev.evaluate(f) == pytest.approx(np.sqrt(np.mean((y - p) ** 2)))
+    assert not ev.is_larger_better
+    ev.set("metricName", "r2")
+    from sklearn.metrics import r2_score
+    assert ev.evaluate(f) == pytest.approx(r2_score(y, p))
+    assert ev.is_larger_better
+
+
+def test_clustering_evaluator_vs_sklearn(ctx):
+    from sklearn.metrics import silhouette_score
+    rng = np.random.RandomState(72)
+    x = np.vstack([rng.randn(50, 3), rng.randn(50, 3) + 5])
+    labels = np.array([0] * 50 + [1] * 50, dtype=float)
+    f = MLFrame(ctx, {"features": x, "prediction": labels})
+    ours = ClusteringEvaluator().evaluate(f)
+    ref = silhouette_score(x, labels, metric="sqeuclidean")
+    assert ours == pytest.approx(ref, abs=1e-8)
+
+
+def test_ranking_evaluator(ctx):
+    preds = np.empty(2, dtype=object)
+    labels = np.empty(2, dtype=object)
+    preds[0], labels[0] = [1, 2, 3], [1, 3]
+    preds[1], labels[1] = [4, 5], [9]
+    f = MLFrame(ctx, {"prediction": preds, "label": labels})
+    ev = RankingEvaluator(metricName="precisionAtK", k=2)
+    assert ev.evaluate(f) == pytest.approx((1 / 2 + 0) / 2)
+    ev.set("metricName", "meanAveragePrecision")
+    # doc0: hits at rank1 (1/1) and rank3 (2/3) → (1 + 2/3)/2; doc1: 0
+    assert ev.evaluate(f) == pytest.approx(((1 + 2 / 3) / 2) / 2)
+
+
+def test_chisquare_vs_scipy(ctx):
+    from scipy.stats import chi2_contingency
+    rng = np.random.RandomState(73)
+    y = rng.randint(0, 2, 200).astype(float)
+    x0 = np.where(rng.rand(200) < 0.8, y, 1 - y)  # dependent
+    x1 = rng.randint(0, 3, 200).astype(float)     # independent
+    f = MLFrame(ctx, {"features": np.column_stack([x0, x1]), "label": y})
+    res = ChiSquareTest.test(f, "features", "label")
+    table = np.zeros((2, 2))
+    np.add.at(table, (x0.astype(int), y.astype(int)), 1)
+    ref = chi2_contingency(table, correction=False)
+    assert res["statistics"][0] == pytest.approx(ref.statistic)
+    assert res["pValues"][0] == pytest.approx(ref.pvalue)
+    assert res["pValues"][0] < 0.001 < res["pValues"][1]
+
+
+def test_anova_fvalue_ks(ctx):
+    from scipy.stats import f_oneway
+    rng = np.random.RandomState(74)
+    y = rng.randint(0, 3, 150).astype(float)
+    x = rng.randn(150, 2)
+    x[:, 0] += y  # group-dependent
+    f = MLFrame(ctx, {"features": x, "label": y})
+    res = ANOVATest.test(f, "features", "label")
+    groups = [x[y == c, 0] for c in range(3)]
+    ref = f_oneway(*groups)
+    assert res["fValues"][0] == pytest.approx(ref.statistic)
+    assert res["pValues"][0] == pytest.approx(ref.pvalue)
+    # F-value regression test
+    yy = x[:, 0] * 2 + 0.1 * rng.randn(150)
+    f2 = MLFrame(ctx, {"features": x, "label": yy})
+    res2 = FValueTest.test(f2, "features", "label")
+    assert res2["pValues"][0] < 1e-10
+    assert res2["pValues"][1] > 0.001
+    # KS
+    f3 = MLFrame(ctx, {"sample": rng.randn(500)})
+    ks = KolmogorovSmirnovTest.test(f3, "sample", "norm", 0.0, 1.0)
+    assert ks["pValue"] > 0.01
+    f4 = MLFrame(ctx, {"sample": rng.randn(500) + 3})
+    ks2 = KolmogorovSmirnovTest.test(f4, "sample", "norm", 0.0, 1.0)
+    assert ks2["pValue"] < 1e-10
+
+
+def test_correlation_pearson_spearman(ctx):
+    rng = np.random.RandomState(75)
+    a = rng.randn(200)
+    x = np.column_stack([a, 2 * a + 0.01 * rng.randn(200), rng.randn(200)])
+    f = MLFrame(ctx, {"features": x})
+    c = Correlation.corr(f, "features").to_array()
+    np.testing.assert_allclose(np.diag(c), 1.0)
+    assert c[0, 1] > 0.999
+    assert abs(c[0, 2]) < 0.2
+    cs = Correlation.corr(f, "features", "spearman").to_array()
+    from scipy.stats import spearmanr
+    ref = spearmanr(x).statistic
+    np.testing.assert_allclose(cs, ref, atol=1e-10)
+
+
+def test_param_grid_builder():
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    lr = LogisticRegression()
+    grid = (ParamGridBuilder()
+            .add_grid(lr.get_param("regParam"), [0.01, 0.1])
+            .add_grid(lr.get_param("maxIter"), [5, 10, 20])
+            .build())
+    assert len(grid) == 6
+
+
+def test_cross_validator_picks_better_model(ctx):
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    rng = np.random.RandomState(76)
+    n, d = 300, 5
+    x = rng.randn(n, d)
+    true = rng.randn(d)
+    y = (x @ true + 0.5 * rng.randn(n) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    lr = LogisticRegression(maxIter=50)
+    grid = (ParamGridBuilder()
+            .add_grid(lr.get_param("regParam"), [0.001, 100.0])
+            .build())
+    cv = CrossValidator(estimator=lr, estimator_param_maps=grid,
+                        evaluator=BinaryClassificationEvaluator(),
+                        numFolds=3, parallelism=2)
+    model = cv.fit(frame)
+    assert len(model.avg_metrics) == 2
+    assert model.avg_metrics[0] > model.avg_metrics[1]  # small reg wins
+    assert model.best_model.get("regParam") == 0.001
+    out = model.transform(frame)
+    assert "prediction" in out
+
+
+def test_train_validation_split(ctx):
+    from cycloneml_tpu.ml.regression import LinearRegression
+    rng = np.random.RandomState(77)
+    x = rng.randn(200, 3)
+    y = x @ np.array([1.0, -2.0, 0.5]) + 0.1 * rng.randn(200)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    linreg = LinearRegression()
+    grid = (ParamGridBuilder()
+            .add_grid(linreg.get_param("regParam"), [0.0, 50.0])
+            .build())
+    tvs = TrainValidationSplit(estimator=linreg, estimator_param_maps=grid,
+                               evaluator=RegressionEvaluator(metricName="rmse"))
+    model = tvs.fit(frame)
+    assert model.best_model.get("regParam") == 0.0
+
+
+def test_cv_model_persistence(ctx, tmp_path):
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    rng = np.random.RandomState(78)
+    x = rng.randn(120, 3)
+    y = (x[:, 0] > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    lr = LogisticRegression(maxIter=20)
+    grid = ParamGridBuilder().add_grid(lr.get_param("regParam"), [0.01, 0.1]).build()
+    cv = CrossValidator(estimator=lr, estimator_param_maps=grid,
+                        evaluator=BinaryClassificationEvaluator(), numFolds=2)
+    model = cv.fit(frame)
+    p = str(tmp_path / "cv")
+    model.save(p)
+    from cycloneml_tpu.ml.tuning import CrossValidatorModel
+    back = CrossValidatorModel.load(p)
+    assert back.avg_metrics == model.avg_metrics
+    np.testing.assert_allclose(back.transform(frame)["prediction"],
+                               model.transform(frame)["prediction"])
