@@ -1,5 +1,15 @@
 from cycloneml_tpu.ml.classification.logistic_regression import (
     LogisticRegression, LogisticRegressionModel,
 )
+from cycloneml_tpu.ml.classification.trees import (
+    DecisionTreeClassificationModel, DecisionTreeClassifier,
+    GBTClassificationModel, GBTClassifier,
+    RandomForestClassificationModel, RandomForestClassifier,
+)
 
-__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+__all__ = [
+    "LogisticRegression", "LogisticRegressionModel",
+    "DecisionTreeClassifier", "DecisionTreeClassificationModel",
+    "RandomForestClassifier", "RandomForestClassificationModel",
+    "GBTClassifier", "GBTClassificationModel",
+]

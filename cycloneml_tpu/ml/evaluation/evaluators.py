@@ -251,7 +251,10 @@ class RankingEvaluator(Evaluator):
                     if item in rel:
                         hits += 1
                         score += hits / (rank + 1)
-                vals.append(score / max(min(len(rel), cut), 1))
+                # ref RankingMetrics: MAP divides by labSet.size; only the
+                # AtK variant divides by min(labSet.size, k)
+                denom = min(len(rel), cut) if metric.endswith("AtK") else len(rel)
+                vals.append(score / max(denom, 1))
             elif metric == "precisionAtK":
                 vals.append(sum(1 for i in p[:k] if i in rel) / k)
             elif metric == "recallAtK":

@@ -155,17 +155,25 @@ class OneHotEncoderModel(Model, MLWritable, MLReadable):
     def _transform(self, frame):
         out = frame
         drop = self.get("dropLast")
+        keep = self.get("handleInvalid") == "keep"
         for c_in, c_out, size in zip(self.get("inputCols"),
                                      self.get("outputCols"),
                                      self.category_sizes):
             col = np.asarray(frame[c_in]).astype(int)
-            width = size - 1 if drop else size
+            # ref OneHotEncoderModel.configedCategorySize: with keep, an
+            # extra "invalid" category at index `size`; dropLast removes it
+            # (keep) or the true last category (error)
+            if keep:
+                width = size + 1 if not drop else size
+            else:
+                width = size - 1 if drop else size
             invalid = (col < 0) | (col >= size)
-            if invalid.any() and self.get("handleInvalid") == "error":
+            if invalid.any() and not keep:
                 raise ValueError(f"index out of range in {c_in!r}")
+            eff = np.where(invalid, size, col)
             enc = np.zeros((len(col), max(width, 0)))
-            valid = ~invalid & (col < width)
-            enc[np.nonzero(valid)[0], col[valid]] = 1.0
+            valid = eff < width
+            enc[np.nonzero(valid)[0], eff[valid]] = 1.0
             out = out.with_column(c_out, enc)
         return out
 

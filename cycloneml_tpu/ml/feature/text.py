@@ -282,12 +282,17 @@ class CountVectorizerModel(Model, _InOutCol, MLWritable, MLReadable):
         return frame.with_column(self.get("outputCol"), out)
 
     def _save_data(self, path):
-        save_arrays(path, vocab=np.asarray(self.vocabulary, dtype=object))
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "vocabulary.json"), "w") as fh:
+            json.dump(list(self.vocabulary), fh)
 
     def _load_data(self, path, meta):
+        import json
         import os
-        z = np.load(os.path.join(path, "data", "data.npz"), allow_pickle=True)
-        self.vocabulary = [str(t) for t in z["vocab"]]
+        with open(os.path.join(path, "vocabulary.json")) as fh:
+            self.vocabulary = json.load(fh)
         self._index = {t: i for i, t in enumerate(self.vocabulary)}
 
 

@@ -188,14 +188,16 @@ class Word2VecModel(Model, _W2VParams, MLWritable, MLReadable):
         return out
 
     def _save_data(self, path):
+        import json
         import os
-        np.savez(os.path.join(path, "data.npz"),
-                 vocab=np.asarray(self.vocabulary, dtype=object),
-                 vectors=self.vectors)
+        save_arrays(path, vectors=self.vectors)
+        with open(os.path.join(path, "vocabulary.json"), "w") as fh:
+            json.dump(list(self.vocabulary), fh)
 
     def _load_data(self, path, meta):
+        import json
         import os
-        z = np.load(os.path.join(path, "data.npz"), allow_pickle=True)
-        self.vocabulary = [str(w) for w in z["vocab"]]
-        self.vectors = z["vectors"]
+        self.vectors = load_arrays(path)["vectors"]
+        with open(os.path.join(path, "vocabulary.json")) as fh:
+            self.vocabulary = json.load(fh)
         self._index = {w: i for i, w in enumerate(self.vocabulary)}

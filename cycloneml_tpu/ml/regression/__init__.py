@@ -1,5 +1,15 @@
 from cycloneml_tpu.ml.regression.linear_regression import (
     LinearRegression, LinearRegressionModel,
 )
+from cycloneml_tpu.ml.regression.trees import (
+    DecisionTreeRegressionModel, DecisionTreeRegressor,
+    GBTRegressionModel, GBTRegressor,
+    RandomForestRegressionModel, RandomForestRegressor,
+)
 
-__all__ = ["LinearRegression", "LinearRegressionModel"]
+__all__ = [
+    "LinearRegression", "LinearRegressionModel",
+    "DecisionTreeRegressor", "DecisionTreeRegressionModel",
+    "RandomForestRegressor", "RandomForestRegressionModel",
+    "GBTRegressor", "GBTRegressionModel",
+]
