@@ -1,3 +1,17 @@
 from cycloneml_tpu.ml.clustering.kmeans import KMeans, KMeansModel
+from cycloneml_tpu.ml.clustering.gaussian_mixture import (
+    GaussianMixture, GaussianMixtureModel, MultivariateGaussian,
+)
+from cycloneml_tpu.ml.clustering.bisecting_kmeans import (
+    BisectingKMeans, BisectingKMeansModel,
+)
+from cycloneml_tpu.ml.clustering.power_iteration import PowerIterationClustering
+from cycloneml_tpu.ml.clustering.lda import LDA, LDAModel
 
-__all__ = ["KMeans", "KMeansModel"]
+__all__ = [
+    "KMeans", "KMeansModel",
+    "GaussianMixture", "GaussianMixtureModel", "MultivariateGaussian",
+    "BisectingKMeans", "BisectingKMeansModel",
+    "PowerIterationClustering",
+    "LDA", "LDAModel",
+]

@@ -1,0 +1,27 @@
+"""Shared distance kernels for the clustering family.
+
+One definition of the ``‖x‖² + ‖c‖² − 2x·cᵀ`` MXU distance expansion and the
+unit-row normalization (cosine mode), used by KMeans and BisectingKMeans on
+both the device (jnp) and host (np) paths so the clamp/epsilon constants
+cannot diverge between call sites.
+"""
+
+from __future__ import annotations
+
+_NORM_EPS = 1e-12
+
+
+def pairwise_sq_dists(xp, x, c, precision=None):
+    """(n, k) squared euclidean distances via one matmul; ``xp`` is np or jnp."""
+    if precision is None:
+        dot = xp.dot(x, c.T)
+    else:
+        dot = xp.dot(x, c.T, precision=precision)
+    return (xp.sum(x * x, axis=1)[:, None]
+            + xp.sum(c * c, axis=1)[None, :] - 2.0 * dot)
+
+
+def normalize_rows(xp, x):
+    """Rows scaled to unit L2 norm (cosine-distance preprocessing)."""
+    n = xp.sqrt(xp.sum(x * x, axis=1))[:, None]
+    return x / xp.maximum(n, _NORM_EPS)

@@ -1,0 +1,318 @@
+"""Bisecting k-means (divisive hierarchical clustering).
+
+Re-design of the reference (ref: mllib/clustering/BisectingKMeans.scala —
+level-by-level bisection of divisible clusters, binary-tree node indexing
+root=1/children 2i,2i+1, ClusteringTreeNode predict-by-descent; ml wrapper
+ml/clustering/BisectingKMeans.scala delegates). TPU-first formulation:
+
+- the per-row cluster assignment lives as a sharded device array alongside X;
+  a level's splits ALL train together: child centers stacked (m, 2, d), each
+  row competes only between its own node's two children via a node→slot
+  lookup table, distances + center sums are two MXU matmuls psum'd over the
+  mesh — the reference's per-cluster ``summarize`` aggregation collapsed into
+  one SPMD program per inner iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.dataset import InstanceDataset
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.clustering._util import normalize_rows, pairwise_sq_dists
+from cycloneml_tpu.ml.param import ParamValidators as V
+from cycloneml_tpu.ml.shared import (
+    HasFeaturesCol, HasMaxIter, HasPredictionCol, HasSeed, HasWeightCol,
+)
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable, load_arrays, save_arrays
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _BKMParams(HasFeaturesCol, HasPredictionCol, HasMaxIter, HasSeed,
+                 HasWeightCol):
+    def _declare_bkm_params(self):
+        self._p_features_col()
+        self._p_prediction_col()
+        self._p_max_iter(20)
+        self._p_seed(17)
+        self._p_weight_col()
+        self.k = self._param("k", "desired number of leaf clusters (> 1)",
+                             V.gt(1), default=4)
+        self.minDivisibleClusterSize = self._param(
+            "minDivisibleClusterSize",
+            "min points (>=1) or fraction (<1) for a divisible cluster",
+            V.gt(0.0), default=1.0)
+        self.distanceMeasure = self._param(
+            "distanceMeasure", "euclidean or cosine",
+            V.in_array(["euclidean", "cosine"]), default="euclidean")
+
+
+class BisectingKMeans(Estimator, _BKMParams, MLWritable, MLReadable):
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid)
+        self._declare_bkm_params()
+        for key, v in kwargs.items():
+            self.set(key, v)
+
+    def set_k(self, v):
+        return self.set("k", v)
+
+    def set_max_iter(self, v):
+        return self.set("maxIter", v)
+
+    def set_seed(self, v):
+        return self.set("seed", v)
+
+    def _fit(self, frame: MLFrame) -> "BisectingKMeansModel":
+        ds = frame.to_instance_dataset(
+            self.get("featuresCol"), label_col=None,
+            weight_col=self.get("weightCol") or None)
+        return self._fit_dataset(ds)
+
+    def _fit_dataset(self, ds: InstanceDataset) -> "BisectingKMeansModel":
+        import jax
+        import jax.numpy as jnp
+
+        k = self.get("k")
+        cosine = self.get("distanceMeasure") == "cosine"
+        rng = np.random.RandomState(self.get("seed"))
+        dtype = ds.x.dtype
+        hi = jax.lax.Precision.HIGHEST
+
+        if cosine:
+            norm = jax.jit(lambda x: normalize_rows(jnp, x))
+            ds = InstanceDataset(ds.ctx, norm(ds.x), ds.y, ds.w,
+                                 ds.n_rows, ds.n_features)
+
+        # assignment = binary-tree node index per row (ref node indexing);
+        # starts at root=1, sharded like x
+        assign = jnp.ones_like(ds.y, dtype=jnp.int32)
+
+        # root stats: weighted mean, row count, and cost about the mean
+        def root_stats(x, y, w, center):
+            s = jnp.dot(w[None, :], x, precision=hi)[0]
+            real = (w > 0).astype(x.dtype)
+            d2 = jnp.sum((x - center[None, :]) ** 2, axis=1)
+            return {"sum": s, "wsum": jnp.sum(w), "count": jnp.sum(real),
+                    "cost": jnp.sum(w * d2)}
+
+        root_agg = ds.tree_aggregate_fn(root_stats)
+        out = root_agg(jnp.zeros(ds.n_features, dtype))
+        total_n = float(out["count"])
+        root_center = np.asarray(out["sum"], np.float64) / max(
+            float(out["wsum"]), 1e-300)
+        if cosine:
+            root_center /= max(np.linalg.norm(root_center), 1e-12)
+        root_cost = float(root_agg(jnp.asarray(root_center, dtype))["cost"])
+
+        # divisibility gates on POINT COUNT like the reference (a cluster of
+        # fractional-weight rows is still divisible), plus a nonzero-cost
+        # check (ref BisectingKMeans.divisibleLeaves: cost > EPSILON * size)
+        min_size = self.get("minDivisibleClusterSize")
+        min_n = min_size if min_size >= 1.0 else min_size * total_n
+
+        nodes: Dict[int, np.ndarray] = {1: root_center}
+        sizes: Dict[int, float] = {1: total_n}
+        costs: Dict[int, float] = {1: root_cost}
+        leaves = {1}
+
+        def level_step(x, y, w, assigned, slot_of, child_centers):
+            # slot_of: (max_node+1,) node index -> split slot (or -1)
+            slot = slot_of[assigned]                               # (b,)
+            active = slot >= 0
+            cc = child_centers.reshape(-1, x.shape[1])             # (2m, d)
+            d2 = pairwise_sq_dists(jnp, x, cc, precision=hi)       # (b, 2m)
+            sl = jnp.maximum(slot, 0)
+            d_left = jnp.take_along_axis(d2, (2 * sl)[:, None], axis=1)[:, 0]
+            d_right = jnp.take_along_axis(d2, (2 * sl + 1)[:, None], axis=1)[:, 0]
+            side = (d_right < d_left).astype(jnp.int32)            # 0/1
+            cidx = jnp.where(active, 2 * sl + side, 0)
+            wm = w * active.astype(w.dtype)
+            onehot = jax.nn.one_hot(cidx, cc.shape[0], dtype=x.dtype)
+            onehot_w = onehot * wm[:, None]
+            real = jnp.logical_and(active, w > 0).astype(x.dtype)
+            sums = jnp.dot(onehot_w.T, x, precision=hi)            # (2m, d)
+            wsums = jnp.sum(onehot_w, axis=0)
+            counts = jnp.sum(onehot * real[:, None], axis=0)       # row counts
+            mind = jnp.maximum(jnp.minimum(d_left, d_right), 0.0)
+            child_cost = jnp.dot(onehot_w.T, mind, precision=hi)   # (2m,)
+            new_assign = jnp.where(active, 2 * assigned + side, assigned)
+            return {"sums": sums, "wsums": wsums, "counts": counts,
+                    "child_cost": child_cost}, new_assign
+
+        # compiled once per (m, table-size) shape; cache across levels
+        agg_cache = {}
+
+        while len(leaves) < k:
+            divisible = sorted(
+                [n for n in leaves
+                 if sizes[n] >= min_n and sizes[n] > 1
+                 and costs[n] > 1e-12 * sizes[n]],
+                key=lambda n: -sizes[n])
+            if not divisible:
+                break
+            m = min(len(divisible), k - len(leaves))
+            splitting = divisible[:m]
+            # table must cover EVERY live node index: jnp clamps
+            # out-of-bounds gathers, which would alias non-splitting leaves
+            # into the last slot
+            max_node = max(leaves)
+            slot_of = np.full(max_node + 1, -1, np.int32)
+            for s, node in enumerate(splitting):
+                slot_of[node] = s
+            # init children by ± perturbation of parent (ref splitCenter)
+            child = np.empty((m, 2, ds.n_features))
+            for s, node in enumerate(splitting):
+                c = nodes[node]
+                level = max(1e-4 * np.linalg.norm(c), 1e-4)
+                noise = rng.rand(ds.n_features)
+                child[s, 0] = c - level * noise
+                child[s, 1] = c + level * noise
+
+            key = (m, max_node + 1)
+            if key not in agg_cache:
+                agg_cache[key] = _compile_level(ds, level_step)
+            run = agg_cache[key]
+
+            new_assign = None
+            for _ in range(max(1, self.get("maxIter"))):
+                stats, new_assign = run(
+                    assign, jnp.asarray(slot_of),
+                    jnp.asarray(child, dtype=dtype))
+                wsums = np.asarray(stats["wsums"], np.float64)
+                sums = np.asarray(stats["sums"], np.float64)
+                flat = child.reshape(-1, ds.n_features)
+                moved_child = np.where(wsums[:, None] > 0,
+                                       sums / np.maximum(wsums[:, None], 1e-300),
+                                       flat)
+                if cosine:
+                    moved_child = moved_child / np.maximum(
+                        np.linalg.norm(moved_child, axis=1, keepdims=True), 1e-12)
+                moved = np.linalg.norm(moved_child - flat, axis=1).max()
+                child = moved_child.reshape(m, 2, ds.n_features)
+                if moved < 1e-6:
+                    break
+            assign = new_assign
+            counts = np.asarray(stats["counts"], np.float64)
+            child_cost = np.asarray(stats["child_cost"], np.float64)
+            for s, node in enumerate(splitting):
+                leaves.discard(node)
+                for side in (0, 1):
+                    ci = 2 * node + side
+                    nodes[ci] = child[s, side]
+                    sizes[ci] = counts[2 * s + side]
+                    costs[ci] = child_cost[2 * s + side]
+                    leaves.add(ci)
+
+        leaf_idx = sorted(leaves)
+        centers = np.stack([nodes[i] for i in leaf_idx])
+        model = BisectingKMeansModel(
+            centers,
+            node_index=np.asarray(leaf_idx, np.int64),
+            tree_nodes=nodes, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        return model
+
+
+def _compile_level(ds: InstanceDataset, level_step):
+    """Compile the level program: stats psum'd, assignment stays sharded."""
+    import jax
+    from cycloneml_tpu.parallel import collectives
+
+    rt = ds.ctx.mesh_runtime
+
+    def fn(x, y, w, assigned, slot_of, child_centers):
+        return level_step(x, y, w, assigned, slot_of, child_centers)
+
+    # 4 row-sharded leading args (x, y, w, assign); ds.y stands in for the
+    # assign slot only to declare its sharding
+    compiled = collectives.tree_aggregate_with_state(fn, rt,
+                                                     ds.x, ds.y, ds.w, ds.y)
+
+    def run(assign, slot_of, child):
+        return compiled(ds.x, ds.y, ds.w, assign, slot_of, child)
+
+    return run
+
+
+class BisectingKMeansModel(Model, _BKMParams, MLWritable, MLReadable):
+    """Prediction descends the tree root→leaf choosing the nearer child
+    (ref ClusteringTreeNode.predict)."""
+
+    def __init__(self, centers: Optional[np.ndarray] = None,
+                 node_index: Optional[np.ndarray] = None,
+                 tree_nodes: Optional[Dict[int, np.ndarray]] = None, uid=None):
+        super().__init__(uid)
+        self._declare_bkm_params()
+        self._centers = np.asarray(centers) if centers is not None else None
+        self._node_index = (np.asarray(node_index)
+                            if node_index is not None else None)
+        self._tree = dict(tree_nodes) if tree_nodes else None
+
+    @property
+    def cluster_centers(self):
+        return [row for row in self._centers]
+
+    def _assign(self, x: np.ndarray) -> np.ndarray:
+        if x.ndim == 1:
+            x = x[:, None]
+        if self.get("distanceMeasure") == "cosine":
+            x = normalize_rows(np, x)
+        leaf_set = set(int(i) for i in self._node_index)
+        if self._tree:
+            out = np.empty(x.shape[0])
+            leaf_pos = {int(n): i for i, n in enumerate(self._node_index)}
+            for r in range(x.shape[0]):
+                node = 1
+                while node not in leaf_set:
+                    left, right = self._tree.get(2 * node), self._tree.get(2 * node + 1)
+                    if left is None or right is None:
+                        break
+                    dl = np.sum((x[r] - left) ** 2)
+                    dr = np.sum((x[r] - right) ** 2)
+                    node = 2 * node + (1 if dr < dl else 0)
+                out[r] = leaf_pos.get(node, 0)
+            return out.astype(np.float64)
+        d2 = pairwise_sq_dists(np, x, self._centers)
+        return d2.argmin(1).astype(np.float64)
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        x = frame[self.get("featuresCol")]
+        return frame.with_column(self.get("predictionCol"), self._assign(x))
+
+    def predict(self, features) -> int:
+        arr = features.to_array() if hasattr(features, "to_array") else np.asarray(features)
+        return int(self._assign(arr[None, :])[0])
+
+    def compute_cost(self, frame: MLFrame) -> float:
+        x = frame[self.get("featuresCol")]
+        if x.ndim == 1:
+            x = x[:, None]
+        assign = self._assign(x).astype(int)
+        if self.get("distanceMeasure") == "cosine":
+            # cosine distance 1 - cos(x, c), not squared-euclidean on the
+            # normalized vectors (which would double it)
+            xn = normalize_rows(np, x)
+            cn = normalize_rows(np, self._centers[assign])
+            return float(np.sum(1.0 - np.sum(xn * cn, axis=1)))
+        return float(np.sum((x - self._centers[assign]) ** 2))
+
+    def _save_data(self, path: str) -> None:
+        tree_idx = np.asarray(sorted(self._tree), np.int64) if self._tree else np.zeros(0, np.int64)
+        tree_centers = (np.stack([self._tree[i] for i in tree_idx])
+                        if len(tree_idx) else np.zeros((0, self._centers.shape[1])))
+        save_arrays(path, centers=self._centers, node_index=self._node_index,
+                    tree_idx=tree_idx, tree_centers=tree_centers)
+
+    def _load_data(self, path: str, meta) -> None:
+        arrs = load_arrays(path)
+        self._centers = arrs["centers"]
+        self._node_index = arrs["node_index"]
+        self._tree = {int(i): c for i, c in
+                      zip(arrs["tree_idx"], arrs["tree_centers"])}

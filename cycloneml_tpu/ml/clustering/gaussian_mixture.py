@@ -1,0 +1,258 @@
+"""Gaussian mixture model via distributed EM.
+
+Re-design of the reference (ref: ml/clustering/GaussianMixture.scala:
+per-partition aggregation of responsibility-weighted sufficient stats with a
+``treeAggregate``-style reduce; mllib/clustering/GaussianMixture.scala:43
+runs the same EM over RDD[Vector]). TPU-first formulation:
+
+- E-step: all k component log-densities for a row block as ONE batched
+  triangular solve + matmul against the stacked Cholesky factors — an
+  (n, k) MXU program, not the reference's per-row MultivariateGaussian.pdf.
+- M-step sufficient stats (resp sums, resp-weighted mean sums, resp-weighted
+  scatter matrices x xᵀ) accumulate per shard and merge with one
+  hierarchical psum — this IS the reference's treeAggregate.
+- driver updates weights/means/covs (tiny, O(k d²)) and checks the
+  log-likelihood delta against tol, exactly the reference loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.dataset import InstanceDataset
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.param import ParamValidators as V
+from cycloneml_tpu.ml.shared import (
+    HasFeaturesCol, HasMaxIter, HasPredictionCol, HasProbabilityCol, HasSeed,
+    HasTol, HasWeightCol,
+)
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable, load_arrays, save_arrays
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MIN_COV_EIG = 1e-6  # diagonal jitter keeping Cholesky factorizable
+
+
+class MultivariateGaussian(NamedTuple):
+    """Parity with ref stat/distribution/MultivariateGaussian.scala."""
+    mean: np.ndarray
+    cov: np.ndarray
+
+
+class _GMMParams(HasFeaturesCol, HasPredictionCol, HasProbabilityCol,
+                 HasMaxIter, HasSeed, HasTol, HasWeightCol):
+    def _declare_gmm_params(self):
+        self._p_features_col()
+        self._p_prediction_col()
+        self._p_probability_col()
+        self._p_max_iter(100)
+        self._p_seed(17)
+        self._p_tol(0.01)
+        self._p_weight_col()
+        self.k = self._param("k", "number of mixture components (> 1)",
+                             V.gt(1), default=2)
+
+
+class GaussianMixture(Estimator, _GMMParams, MLWritable, MLReadable):
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid)
+        self._declare_gmm_params()
+        for key, v in kwargs.items():
+            self.set(key, v)
+
+    def set_k(self, v):
+        return self.set("k", v)
+
+    def set_max_iter(self, v):
+        return self.set("maxIter", v)
+
+    def set_seed(self, v):
+        return self.set("seed", v)
+
+    def set_tol(self, v):
+        return self.set("tol", v)
+
+    def _fit(self, frame: MLFrame) -> "GaussianMixtureModel":
+        ds = frame.to_instance_dataset(
+            self.get("featuresCol"), label_col=None,
+            weight_col=self.get("weightCol") or None)
+        return self._fit_dataset(ds)
+
+    def _fit_dataset(self, ds: InstanceDataset) -> "GaussianMixtureModel":
+        import jax
+        import jax.numpy as jnp
+
+        k, d = self.get("k"), ds.n_features
+        dtype = ds.x.dtype
+
+        weights, means, covs = self._init_params(ds, k)
+
+        def em_stats(x, y, w, wts, mus, chols):
+            # log N(x | mu_j, Sigma_j) for all j via solves against the
+            # stacked Cholesky factors: z_j = L_j^{-1} (x - mu_j)
+            diff = x[:, None, :] - mus[None, :, :]                  # (b,k,d)
+            z = jax.vmap(
+                lambda L, dv: jax.scipy.linalg.solve_triangular(
+                    L, dv.T, lower=True).T,
+                in_axes=(0, 1), out_axes=1)(chols, diff)            # (b,k,d)
+            maha = jnp.sum(z * z, axis=2)                           # (b,k)
+            logdet = jnp.sum(jnp.log(
+                jax.vmap(jnp.diag)(chols)), axis=1)                 # (k,)
+            logpdf = (-0.5 * (maha + d * jnp.log(2.0 * jnp.pi))
+                      - logdet[None, :])
+            logw = jnp.log(jnp.maximum(wts, 1e-300))
+            joint = logpdf + logw[None, :]                          # (b,k)
+            lse = jax.scipy.special.logsumexp(joint, axis=1)        # (b,)
+            resp = jnp.exp(joint - lse[:, None]) * w[:, None]       # (b,k)
+            # padding rows (w=0) contribute nothing
+            return {
+                "loglik": jnp.sum(jnp.where(w > 0, lse * w, 0.0)),
+                "resp_sum": jnp.sum(resp, axis=0),                  # (k,)
+                "mean_sum": jnp.dot(resp.T, x,
+                                    precision=jax.lax.Precision.HIGHEST),
+                # scatter: sum_i r_ij x_i x_iᵀ  — one gemm per component
+                "scatter": jnp.einsum(
+                    "bk,bi,bj->kij", resp, x, x,
+                    precision=jax.lax.Precision.HIGHEST),
+            }
+
+        step = ds.tree_aggregate_fn(em_stats)
+        prev_ll = -np.inf
+        ll = -np.inf
+        it = 0
+        for it in range(1, self.get("maxIter") + 1):
+            chols = np.linalg.cholesky(covs + _MIN_COV_EIG * np.eye(d))
+            out = step(weights.astype(dtype), means.astype(dtype),
+                       chols.astype(dtype))
+            rs = np.asarray(out["resp_sum"], dtype=np.float64)
+            ms = np.asarray(out["mean_sum"], dtype=np.float64)
+            sc = np.asarray(out["scatter"], dtype=np.float64)
+            ll = float(out["loglik"])
+            total = rs.sum()
+            weights = rs / max(total, 1e-300)
+            means = ms / np.maximum(rs[:, None], 1e-300)
+            covs = (sc / np.maximum(rs[:, None, None], 1e-300)
+                    - means[:, :, None] * means[:, None, :])
+            covs = 0.5 * (covs + np.transpose(covs, (0, 2, 1)))
+            if abs(ll - prev_ll) < self.get("tol") and it > 1:
+                prev_ll = ll
+                break
+            prev_ll = ll
+
+        model = GaussianMixtureModel(weights, means, covs, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        model.num_iterations = it
+        model.log_likelihood = ll
+        return model
+
+    def _init_params(self, ds: InstanceDataset, k: int):
+        """Reference init (mllib GaussianMixture.initialize): sample rows,
+        split into k slices, empirical mean/cov per slice. Only the sampled
+        rows leave the device (gather of ~max(2k,100) indices); the global
+        variance fallback comes from a one-pass moment aggregation."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(self.get("seed"))
+        n, d = ds.n_rows, ds.n_features
+        n_sample = min(n, max(2 * k, 100))
+        idx = np.sort(rng.choice(n, size=n_sample, replace=False))
+        # padding lives past row n_rows, so real-row gathers are safe
+        sample = np.array(ds.x[jnp.asarray(idx)], dtype=np.float64)  # writable copy
+        rng.shuffle(sample)
+        slices = np.array_split(sample, k)
+
+        if all(len(s) > 1 for s in slices):
+            # normal case (n_sample >= 2k): no global pass needed
+            mean_all = var0 = None
+        else:
+            # degenerate slices fall back to global moments (one-pass)
+            def moments(x, y, w, _z):
+                real = (w > 0).astype(x.dtype)
+                return {"s1": jnp.sum(x * real[:, None], axis=0),
+                        "s2": jnp.sum(x * x * real[:, None], axis=0),
+                        "n": jnp.sum(real)}
+
+            mo = ds.tree_aggregate_fn(moments)(jnp.zeros((), ds.x.dtype))
+            cnt = max(float(mo["n"]), 1.0)
+            mean_all = np.asarray(mo["s1"], np.float64) / cnt
+            var0 = np.maximum(np.asarray(mo["s2"], np.float64) / cnt
+                              - mean_all ** 2, 0.0) + _MIN_COV_EIG
+        means = np.stack([s.mean(axis=0) if len(s) else mean_all
+                          for s in slices])
+        covs = np.stack([
+            np.diag(s.var(axis=0) + _MIN_COV_EIG) if len(s) > 1 else np.diag(var0)
+            for s in slices])
+        weights = np.full(k, 1.0 / k)
+        return weights, means, covs
+
+
+class GaussianMixtureModel(Model, _GMMParams, MLWritable, MLReadable):
+    def __init__(self, weights: Optional[np.ndarray] = None,
+                 means: Optional[np.ndarray] = None,
+                 covs: Optional[np.ndarray] = None, uid=None):
+        super().__init__(uid)
+        self._declare_gmm_params()
+        self.weights = np.asarray(weights) if weights is not None else None
+        self._means = np.asarray(means) if means is not None else None
+        self._covs = np.asarray(covs) if covs is not None else None
+        self.num_iterations = 0
+        self.log_likelihood = float("nan")
+
+    @property
+    def gaussians(self) -> List[MultivariateGaussian]:
+        return [MultivariateGaussian(m, c)
+                for m, c in zip(self._means, self._covs)]
+
+    def _log_resp(self, x: np.ndarray) -> np.ndarray:
+        d = x.shape[1]
+        k = len(self.weights)
+        from scipy.linalg import solve_triangular
+
+        out = np.empty((x.shape[0], k))
+        for j in range(k):
+            L = np.linalg.cholesky(self._covs[j] + _MIN_COV_EIG * np.eye(d))
+            z = solve_triangular(L, (x - self._means[j]).T, lower=True)
+            out[:, j] = (-0.5 * (np.sum(z * z, axis=0) + d * np.log(2 * np.pi))
+                         - np.log(np.diag(L)).sum()
+                         + np.log(max(self.weights[j], 1e-300)))
+        return out
+
+    def _probability(self, x: np.ndarray) -> np.ndarray:
+        lr = self._log_resp(x)
+        lse = np.logaddexp.reduce(lr, axis=1)
+        return np.exp(lr - lse[:, None])
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        x = np.asarray(frame[self.get("featuresCol")], dtype=np.float64)
+        if x.ndim == 1:
+            x = x[:, None]
+        prob = self._probability(x)
+        out = frame
+        if self.get("probabilityCol"):
+            out = out.with_column(self.get("probabilityCol"), prob)
+        out = out.with_column(self.get("predictionCol"),
+                              prob.argmax(1).astype(np.float64))
+        return out
+
+    def predict(self, features) -> int:
+        arr = features.to_array() if hasattr(features, "to_array") else np.asarray(features)
+        return int(self._probability(np.atleast_2d(arr)).argmax(1)[0])
+
+    def predict_probability(self, features) -> np.ndarray:
+        arr = features.to_array() if hasattr(features, "to_array") else np.asarray(features)
+        return self._probability(np.atleast_2d(arr))[0]
+
+    def _save_data(self, path: str) -> None:
+        save_arrays(path, weights=self.weights, means=self._means,
+                    covs=self._covs)
+
+    def _load_data(self, path: str, meta) -> None:
+        arrs = load_arrays(path)
+        self.weights = arrs["weights"]
+        self._means = arrs["means"]
+        self._covs = arrs["covs"]

@@ -28,6 +28,7 @@ from cycloneml_tpu.dataset.dataset import InstanceDataset
 from cycloneml_tpu.dataset.frame import MLFrame
 from cycloneml_tpu.linalg.matrices import DenseMatrix
 from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.clustering._util import normalize_rows, pairwise_sq_dists
 from cycloneml_tpu.ml.param import ParamValidators as V
 from cycloneml_tpu.ml.shared import (
     HasFeaturesCol, HasMaxIter, HasPredictionCol, HasSeed, HasTol, HasWeightCol,
@@ -90,8 +91,7 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
         if cosine:
             # cosine distance clusters on the unit sphere: normalize once
-            norm = jax.jit(lambda x: x / jnp.maximum(
-                jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12))
+            norm = jax.jit(lambda x: normalize_rows(jnp, x))
             ds = InstanceDataset(ds.ctx, norm(ds.x), ds.y, ds.w,
                                  ds.n_rows, ds.n_features)
 
@@ -101,9 +101,7 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
         def lloyd_step(x, y, w, c):
             # (b,k) squared distances via the MXU
-            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
-                  + jnp.sum(c * c, axis=1)[None, :]
-                  - 2.0 * jnp.dot(x, c.T, precision=hi))
+            d2 = pairwise_sq_dists(jnp, x, c, precision=hi)
             assign = jnp.argmin(d2, axis=1)
             onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * w[:, None]
             sums = jnp.dot(onehot.T, x, precision=hi)        # (k,d) center sums
@@ -160,9 +158,7 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
         hi = jax.lax.Precision.HIGHEST
 
         def min_d2(x, y, w, c):
-            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
-                  + jnp.sum(c * c, axis=1)[None, :]
-                  - 2.0 * jnp.dot(x, c.T, precision=hi))
+            d2 = pairwise_sq_dists(jnp, x, c, precision=hi)
             md = jnp.maximum(jnp.min(d2, axis=1), 0.0) * (w > 0)
             return md
 
@@ -246,9 +242,8 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         if x.ndim == 1:
             x = x[:, None]
         if self.get("distanceMeasure") == "cosine":
-            x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
-        d2 = (x * x).sum(1)[:, None] + (self._centers ** 2).sum(1)[None, :] \
-            - 2.0 * x @ self._centers.T
+            x = normalize_rows(np, x)
+        d2 = pairwise_sq_dists(np, x, self._centers)
         return d2.argmin(1).astype(np.float64)
 
     def _transform(self, frame: MLFrame) -> MLFrame:
@@ -266,9 +261,8 @@ class KMeansModel(Model, _KMeansParams, MLWritable, MLReadable):
         if x.ndim == 1:
             x = x[:, None]
         if self.get("distanceMeasure") == "cosine":
-            x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
-        d2 = (x * x).sum(1)[:, None] + (self._centers ** 2).sum(1)[None, :] \
-            - 2.0 * x @ self._centers.T
+            x = normalize_rows(np, x)
+        d2 = pairwise_sq_dists(np, x, self._centers)
         return float(np.maximum(d2.min(1), 0.0).sum())
 
     def _save_data(self, path: str) -> None:

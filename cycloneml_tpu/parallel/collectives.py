@@ -51,7 +51,7 @@ def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
 
 
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
-                   auto_psum: bool = True):
+                   auto_psum: bool = True, with_state: bool = False):
     """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
 
     ``arrays`` fixes how many leading arguments are row-sharded; the returned
@@ -60,26 +60,45 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
     every sharded array plus the extras, returns a pytree of partials;
     partials are psum'd hierarchically over the mesh. Callers compile once,
     call per iteration.
+
+    With ``with_state=True``, ``fn`` returns ``(stats, rows)``: ``stats`` is
+    psum'd (replicated result) while ``rows`` keeps the input row sharding
+    (e.g. an updated per-row assignment vector).
     """
     import jax
     from jax.sharding import PartitionSpec as P
+    if with_state and not auto_psum:
+        # stats would be emitted unreduced under a replicated out_spec —
+        # silently wrong with check_vma disabled
+        raise ValueError("with_state=True requires auto_psum=True")
     mesh = runtime.mesh
     row_spec = P((REPLICA_AXIS, DATA_AXIS))
 
+    def _reduce(partial):
+        if not auto_psum:
+            # fn performs its own collectives (e.g. pmax/pmin stats)
+            return partial
+        return jax.tree_util.tree_map(
+            lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
+
     def sharded(*all_args):
         def local(*a):
-            partial = fn(*a)
-            if not auto_psum:
-                # fn performs its own collectives (e.g. pmax/pmin stats)
-                return partial
-            return jax.tree_util.tree_map(
-                lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
+            if with_state:
+                stats, rows = fn(*a)
+                return _reduce(stats), rows
+            return _reduce(fn(*a))
 
         n_extras = len(all_args) - len(arrays)
         in_specs = tuple([row_spec] * len(arrays) + [P()] * n_extras)
-        return shard_map_compat(local, mesh, in_specs, P())(*all_args)
+        out_specs = (P(), row_spec) if with_state else P()
+        return shard_map_compat(local, mesh, in_specs, out_specs)(*all_args)
 
     return jax.jit(sharded)
+
+
+def tree_aggregate_with_state(fn: Callable, runtime: MeshRuntime, *arrays):
+    """Shorthand for :func:`tree_aggregate` with ``with_state=True``."""
+    return tree_aggregate(fn, runtime, *arrays, with_state=True)
 
 
 def all_gather_hosts(runtime: MeshRuntime, fn: Callable, *arrays):

@@ -1,0 +1,251 @@
+"""GaussianMixture / BisectingKMeans / PowerIterationClustering / LDA tests
+(ref test models: GaussianMixtureSuite, BisectingKMeansSuite,
+PowerIterationClusteringSuite, LDASuite — correctness vs closed-form or
+sklearn references, persistence round-trips)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.clustering import (
+    LDA, BisectingKMeans, BisectingKMeansModel, GaussianMixture,
+    GaussianMixtureModel, LDAModel, PowerIterationClustering,
+)
+
+
+def _gmm_blobs(ctx, n=900, seed=7):
+    rng = np.random.RandomState(seed)
+    means = np.array([[-4.0, 0.0], [4.0, 1.0], [0.0, 6.0]])
+    covs = np.array([[[0.5, 0.1], [0.1, 0.3]],
+                     [[0.4, -0.1], [-0.1, 0.6]],
+                     [[0.3, 0.0], [0.0, 0.3]]])
+    labels = rng.randint(0, 3, n)
+    x = np.stack([rng.multivariate_normal(means[c], covs[c]) for c in labels])
+    return MLFrame(ctx, {"features": x}), x, labels, means
+
+
+class TestGaussianMixture:
+    def test_recovers_components(self, ctx):
+        frame, x, labels, true_means = _gmm_blobs(ctx)
+        model = GaussianMixture(k=3, seed=11, maxIter=60, tol=1e-6).fit(frame)
+        got = np.stack([g.mean for g in model.gaussians])
+        for m in true_means:
+            assert np.min(np.linalg.norm(got - m, axis=1)) < 0.3
+        assert np.isclose(model.weights.sum(), 1.0)
+        # soft assignments should be confident on separated blobs
+        out = model.transform(frame)
+        prob = out["probability"]
+        assert prob.shape == (x.shape[0], 3)
+        assert np.all(np.isclose(prob.sum(1), 1.0, atol=1e-6))
+        assert (prob.max(1) > 0.9).mean() > 0.95
+
+    def test_loglik_matches_sklearn(self, ctx):
+        from sklearn.mixture import GaussianMixture as SkGMM
+        frame, x, _, _ = _gmm_blobs(ctx, seed=8)
+        ours = GaussianMixture(k=3, seed=3, maxIter=100, tol=1e-7).fit(frame)
+        sk = SkGMM(n_components=3, n_init=3, random_state=0,
+                   tol=1e-8, reg_covar=1e-6).fit(x)
+        # per-sample average loglik within 1%
+        ours_ll = ours.log_likelihood / x.shape[0]
+        assert ours_ll >= sk.score(x) - abs(sk.score(x)) * 0.01
+
+    def test_weighted_rows(self, ctx):
+        rng = np.random.RandomState(9)
+        x = np.concatenate([rng.randn(50, 2) - 5, rng.randn(500, 2) + 5])
+        w = np.concatenate([np.full(50, 10.0), np.ones(500)])
+        frame = MLFrame(ctx, {"features": x, "w": w})
+        m = GaussianMixture(k=2, seed=5, maxIter=50, weightCol="w").fit(frame)
+        # upweighted small blob must still claim ~half the mixture weight
+        assert 0.25 < m.weights.min() < 0.75
+
+    def test_persistence_roundtrip(self, ctx, tmp_path):
+        frame, x, _, _ = _gmm_blobs(ctx)
+        m = GaussianMixture(k=3, seed=2, maxIter=30).fit(frame)
+        p = str(tmp_path / "gmm")
+        m.save(p)
+        m2 = GaussianMixtureModel.load(p)
+        np.testing.assert_allclose(m2.weights, m.weights)
+        np.testing.assert_allclose(
+            np.stack([g.cov for g in m2.gaussians]),
+            np.stack([g.cov for g in m.gaussians]))
+        assert m2.predict(x[0]) == m.predict(x[0])
+
+
+class TestBisectingKMeans:
+    def test_separated_blobs(self, ctx):
+        rng = np.random.RandomState(21)
+        centers = np.array([[-8, -8], [-8, 8], [8, -8], [8, 8]], float)
+        labels = rng.randint(0, 4, 800)
+        x = centers[labels] + 0.4 * rng.randn(800, 2)
+        frame = MLFrame(ctx, {"features": x})
+        model = BisectingKMeans(k=4, seed=3, maxIter=30).fit(frame)
+        assert len(model.cluster_centers) == 4
+        got = np.stack(model.cluster_centers)
+        for c in centers:
+            assert np.min(np.linalg.norm(got - c, axis=1)) < 0.5
+        pred = model.transform(frame)["prediction"]
+        # every blob maps to exactly one predicted cluster
+        for b in range(4):
+            assert len(np.unique(pred[labels == b])) == 1
+
+    def test_respects_k_and_cost(self, ctx):
+        rng = np.random.RandomState(22)
+        x = rng.randn(500, 6)
+        frame = MLFrame(ctx, {"features": x})
+        m = BisectingKMeans(k=5, seed=1).fit(frame)
+        assert len(m.cluster_centers) == 5
+        assert m.compute_cost(frame) > 0
+
+    def test_min_divisible_cluster_size(self, ctx):
+        rng = np.random.RandomState(23)
+        x = np.concatenate([rng.randn(490, 2), rng.randn(10, 2) + 50])
+        frame = MLFrame(ctx, {"features": x})
+        # requiring >=300 points per divisible cluster stops early:
+        # 500 -> (490, 10); only 490 divisible -> (~245, ~245); stop at 3
+        m = BisectingKMeans(k=8, seed=1, minDivisibleClusterSize=300.0).fit(frame)
+        assert len(m.cluster_centers) == 3
+
+    def test_fractional_weights_still_divisible(self, ctx):
+        # divisibility gates on point count, not weight sum (ref behavior)
+        rng = np.random.RandomState(25)
+        centers = np.array([[-8.0, 0.0], [8.0, 0.0]])
+        labels = rng.randint(0, 2, 400)
+        x = centers[labels] + 0.3 * rng.randn(400, 2)
+        frame = MLFrame(ctx, {"features": x,
+                              "w": np.full(400, 1e-3)})
+        m = BisectingKMeans(k=2, seed=1, weightCol="w").fit(frame)
+        assert len(m.cluster_centers) == 2
+
+    def test_identical_points_not_split(self, ctx):
+        # a zero-cost cluster must not burn the k budget on phantom leaves
+        x = np.concatenate([np.zeros((50, 2)),
+                            np.random.RandomState(26).randn(50, 2) + 10])
+        frame = MLFrame(ctx, {"features": x})
+        m = BisectingKMeans(k=4, seed=1).fit(frame)
+        got = np.stack(m.cluster_centers)
+        # the zero blob stays one cluster; no center is a perturbation orphan
+        pred = m.transform(frame)["prediction"]
+        assert len(np.unique(pred[:50])) == 1
+
+    def test_persistence_roundtrip(self, ctx, tmp_path):
+        rng = np.random.RandomState(24)
+        x = rng.randn(300, 3)
+        frame = MLFrame(ctx, {"features": x})
+        m = BisectingKMeans(k=3, seed=9).fit(frame)
+        p = str(tmp_path / "bkm")
+        m.save(p)
+        m2 = BisectingKMeansModel.load(p)
+        pred1 = m.transform(frame)["prediction"]
+        pred2 = m2.transform(frame)["prediction"]
+        np.testing.assert_array_equal(pred1, pred2)
+
+
+class TestPowerIterationClustering:
+    def test_two_circles(self, ctx):
+        # ref PowerIterationClusteringSuite: concentric circles with
+        # gaussian affinities separate into rings
+        rng = np.random.RandomState(31)
+        n1, n2 = 40, 80
+        t1 = rng.rand(n1) * 2 * np.pi
+        t2 = rng.rand(n2) * 2 * np.pi
+        pts = np.concatenate([
+            np.stack([np.cos(t1), np.sin(t1)], 1) * 1.0,
+            np.stack([np.cos(t2), np.sin(t2)], 1) * 6.0,
+        ])
+        n = n1 + n2
+        src, dst, wt = [], [], []
+        for i in range(n):
+            for j in range(i + 1, n):
+                d2 = np.sum((pts[i] - pts[j]) ** 2)
+                src.append(i)
+                dst.append(j)
+                wt.append(np.exp(-d2 / 2.0))
+        frame = MLFrame(ctx, {"src": np.array(src, float),
+                              "dst": np.array(dst, float),
+                              "weight": np.array(wt)})
+        # generous maxIter; the acceleration criterion stops it (~400 here)
+        pic = PowerIterationClustering(k=2, maxIter=1000, weightCol="weight",
+                                       seed=5)
+        out = pic.assign_clusters(frame)
+        ids = out["id"].astype(int)
+        clusters = out["cluster"].astype(int)
+        order = np.argsort(ids)
+        c = clusters[order]
+        # each ring is pure
+        assert len(np.unique(c[:n1])) == 1
+        assert len(np.unique(c[n1:])) == 1
+        assert c[0] != c[-1]
+
+    def test_degree_init_and_unweighted(self, ctx):
+        # two cliques joined by nothing
+        edges = [(i, j) for i in range(5) for j in range(i + 1, 5)]
+        edges += [(i + 5, j + 5) for i, j in edges]
+        src = np.array([e[0] for e in edges], float)
+        dst = np.array([e[1] for e in edges], float)
+        frame = MLFrame(ctx, {"src": src, "dst": dst})
+        out = PowerIterationClustering(k=2, initMode="degree",
+                                       maxIter=30).assign_clusters(frame)
+        c = out["cluster"][np.argsort(out["id"])]
+        assert len(np.unique(c[:5])) == 1
+        assert len(np.unique(c[5:])) == 1
+
+
+class TestLDA:
+    def _corpus(self, ctx, n_docs=200, seed=41):
+        # two disjoint topics over a 20-word vocab
+        rng = np.random.RandomState(seed)
+        beta = np.zeros((2, 20))
+        beta[0, :10] = 1 / 10
+        beta[1, 10:] = 1 / 10
+        docs = np.zeros((n_docs, 20))
+        doc_topic = rng.rand(n_docs) < 0.5
+        for d in range(n_docs):
+            t = int(doc_topic[d])
+            words = rng.choice(20, size=60, p=beta[t])
+            docs[d] = np.bincount(words, minlength=20)
+        return MLFrame(ctx, {"features": docs}), docs, doc_topic
+
+    def test_online_recovers_topics(self, ctx):
+        frame, docs, doc_topic = self._corpus(ctx)
+        lda = LDA(k=2, seed=3, maxIter=50, optimizer="online",
+                  subsamplingRate=1.0, learningOffset=10.0).fit(frame)
+        topics = lda.topics_matrix()  # (vocab, k)
+        assert topics.shape == (20, 2)
+        # each topic concentrates on one half of the vocabulary
+        mass_lo = topics[:10].sum(0)
+        mass_hi = topics[10:].sum(0)
+        assert max(mass_lo) > 0.9 and max(mass_hi) > 0.9
+        # transform: doc-topic mixtures match the generating topic
+        out = lda.transform(frame)
+        theta = out["topicDistribution"]
+        assert np.all(np.isclose(theta.sum(1), 1.0, atol=1e-6))
+        hard = theta.argmax(1)
+        agree = max((hard == doc_topic).mean(), (hard != doc_topic).mean())
+        assert agree > 0.95
+
+    def test_em_batch_mode(self, ctx):
+        frame, docs, _ = self._corpus(ctx, seed=42)
+        lda = LDA(k=2, seed=1, maxIter=30, optimizer="em").fit(frame)
+        t = lda.topics_matrix()
+        assert np.all(np.isclose(t.sum(0), 1.0, atol=1e-6))
+
+    def test_describe_topics_and_perplexity(self, ctx):
+        frame, docs, _ = self._corpus(ctx, seed=43)
+        lda = LDA(k=2, seed=2, maxIter=40, optimizer="online",
+                  subsamplingRate=1.0).fit(frame)
+        desc = lda.describe_topics(5)
+        assert len(desc) == 2
+        idx, wts = desc[0]
+        assert len(idx) == 5 and np.all(np.diff(wts) <= 0)
+        pp = lda.log_perplexity(frame)
+        # perplexity of a 2-topic/20-word corpus is far below uniform log(20)
+        assert 0 < pp < np.log(20)
+
+    def test_persistence_roundtrip(self, ctx, tmp_path):
+        frame, docs, _ = self._corpus(ctx, seed=44)
+        m = LDA(k=2, seed=7, maxIter=20).fit(frame)
+        p = str(tmp_path / "lda")
+        m.save(p)
+        m2 = LDAModel.load(p)
+        np.testing.assert_allclose(m2.topics_matrix(), m.topics_matrix())
+        assert m2.vocab_size == 20
