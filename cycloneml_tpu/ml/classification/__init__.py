@@ -1,6 +1,15 @@
 from cycloneml_tpu.ml.classification.logistic_regression import (
     LogisticRegression, LogisticRegressionModel,
 )
+from cycloneml_tpu.ml.classification.linear_svc import LinearSVC, LinearSVCModel
+from cycloneml_tpu.ml.classification.naive_bayes import NaiveBayes, NaiveBayesModel
+from cycloneml_tpu.ml.classification.fm import (
+    FMClassificationModel, FMClassifier,
+)
+from cycloneml_tpu.ml.classification.mlp import (
+    MultilayerPerceptronClassificationModel, MultilayerPerceptronClassifier,
+)
+from cycloneml_tpu.ml.classification.one_vs_rest import OneVsRest, OneVsRestModel
 from cycloneml_tpu.ml.classification.trees import (
     DecisionTreeClassificationModel, DecisionTreeClassifier,
     GBTClassificationModel, GBTClassifier,
@@ -9,6 +18,11 @@ from cycloneml_tpu.ml.classification.trees import (
 
 __all__ = [
     "LogisticRegression", "LogisticRegressionModel",
+    "LinearSVC", "LinearSVCModel",
+    "NaiveBayes", "NaiveBayesModel",
+    "FMClassifier", "FMClassificationModel",
+    "MultilayerPerceptronClassifier", "MultilayerPerceptronClassificationModel",
+    "OneVsRest", "OneVsRestModel",
     "DecisionTreeClassifier", "DecisionTreeClassificationModel",
     "RandomForestClassifier", "RandomForestClassificationModel",
     "GBTClassifier", "GBTClassificationModel",

@@ -26,7 +26,9 @@ from cycloneml_tpu.linalg.matrices import DenseMatrix
 from cycloneml_tpu.linalg.vectors import DenseVector, Vectors
 from cycloneml_tpu.ml.base import Predictor, ProbabilisticClassificationModel
 from cycloneml_tpu.ml.optim import LBFGS, OWLQN, aggregators
-from cycloneml_tpu.ml.optim.loss import DistributedLossFunction, l2_regularization
+from cycloneml_tpu.ml.optim.loss import (
+    DistributedLossFunction, l2_regularization, standardize_dataset,
+)
 from cycloneml_tpu.ml.param import ParamValidators as V
 from cycloneml_tpu.ml.shared import (
     HasAggregationDepth, HasElasticNetParam, HasFitIntercept, HasMaxBlockSizeInMB,
@@ -131,11 +133,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         l2 = (1.0 - alpha) * reg
         l1 = alpha * reg
 
-        inv_std = np.where(features_std > 0, 1.0 / np.where(features_std > 0, features_std, 1.0), 0.0)
-
-        # scale feature blocks in place on device — stays in HBM (≈ :968 persist)
-        scaled = jax.jit(lambda x, s: x * s)(ds.x, jnp.asarray(inv_std))
-        ds_std = InstanceDataset(ds.ctx, scaled, ds.y, ds.w, ds.n_rows, d)
+        ds_std, inv_std = standardize_dataset(ds, features_std)
 
         if is_multinomial:
             agg = aggregators.multinomial_logistic(d, num_classes, fit_intercept)

@@ -1,0 +1,137 @@
+"""One-vs-rest multiclass reduction.
+
+Re-design of the reference (ref: ml/classification/OneVsRest.scala — fits
+one binary copy of the base classifier per class over relabeled data, with
+a ``parallelism`` thread pool; the model picks the class whose binary
+margin is largest). The relabel is a host-side column swap; each binary fit
+runs the base estimator's own SPMD program.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import ClassificationModel, Estimator, Model
+from cycloneml_tpu.ml.param import ParamValidators as V
+from cycloneml_tpu.ml.shared import (
+    HasFeaturesCol, HasLabelCol, HasPredictionCol, HasRawPredictionCol,
+    HasWeightCol,
+)
+from cycloneml_tpu.ml.util_io import (
+    MLReadable, MLWritable, load_pipeline_stages, save_pipeline_stages,
+)
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _OVRParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
+                 HasRawPredictionCol, HasWeightCol):
+    def _declare_ovr_params(self):
+        self._p_features_col()
+        self._p_label_col()
+        self._p_prediction_col()
+        self._p_raw_prediction_col()
+        self._p_weight_col()
+        self.parallelism = self._param(
+            "parallelism", "max concurrent binary fits (>= 1)",
+            V.gt_eq(1), default=1)
+
+
+class OneVsRest(Estimator, _OVRParams, MLWritable, MLReadable):
+    def __init__(self, classifier: Optional[Estimator] = None, uid=None,
+                 **kwargs):
+        super().__init__(uid)
+        self._declare_ovr_params()
+        self.classifier = classifier
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    def set_classifier(self, clf: Estimator) -> "OneVsRest":
+        self.classifier = clf
+        return self
+
+    def set_parallelism(self, v):
+        return self.set("parallelism", v)
+
+    def _fit(self, frame: MLFrame) -> "OneVsRestModel":
+        if self.classifier is None:
+            raise ValueError("classifier must be set")
+        label_col = self.get("labelCol")
+        y = np.asarray(frame[label_col])
+        num_classes = int(y.max()) + 1
+
+        def fit_one(c: int):
+            binary = (y == c).astype(np.float64)
+            sub = frame.with_column("_ovr_label", binary)
+            clf = self.classifier.copy()
+            clf.set("labelCol", "_ovr_label")
+            clf.set("featuresCol", self.get("featuresCol"))
+            wc = self.get("weightCol")
+            if wc and "weightCol" in clf._params:
+                clf.set("weightCol", wc)
+            return clf.fit(sub)
+
+        par = self.get("parallelism")
+        if par > 1:
+            with cf.ThreadPoolExecutor(max_workers=par) as pool:
+                models = list(pool.map(fit_one, range(num_classes)))
+        else:
+            models = [fit_one(c) for c in range(num_classes)]
+
+        model = OneVsRestModel(models, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        return model
+
+    def copy(self, extra=None) -> "OneVsRest":
+        that = super().copy(extra)
+        that.classifier = self.classifier.copy() if self.classifier else None
+        return that
+
+    def _save_data(self, path: str) -> None:
+        save_pipeline_stages([self.classifier], path)
+
+    def _load_data(self, path: str, meta) -> None:
+        self.classifier = load_pipeline_stages(path)[0]
+
+
+class OneVsRestModel(Model, _OVRParams, MLWritable, MLReadable):
+    def __init__(self, models: Optional[List[ClassificationModel]] = None,
+                 uid=None):
+        super().__init__(uid)
+        self._declare_ovr_params()
+        self.models = list(models or [])
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.models)
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        x = frame[self.get("featuresCol")]
+        if x.ndim == 1:
+            x = x[:, None]
+        # margin of the positive class from each binary model
+        margins = np.stack(
+            [m._raw_prediction(x)[:, 1] for m in self.models], axis=1)
+        out = frame
+        if self.get("rawPredictionCol"):
+            out = out.with_column(self.get("rawPredictionCol"), margins)
+        out = out.with_column(self.get("predictionCol"),
+                              margins.argmax(1).astype(np.float64))
+        return out
+
+    def copy(self, extra=None) -> "OneVsRestModel":
+        that = super().copy(extra)
+        that.models = [m.copy() for m in self.models]
+        return that
+
+    def _save_data(self, path: str) -> None:
+        save_pipeline_stages(self.models, path)
+
+    def _load_data(self, path: str, meta) -> None:
+        self.models = load_pipeline_stages(path)

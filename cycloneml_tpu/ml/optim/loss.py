@@ -54,6 +54,32 @@ class DistributedLossFunction:
         return loss, grad
 
 
+def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray):
+    """Scale feature blocks by 1/std in HBM (≈ the reference persisting
+    standardized blocks, LogisticRegression.scala:968). Zero-variance
+    features scale to 0, matching the reference's exclusion. Returns
+    (standardized dataset, inv_std)."""
+    import jax
+    import jax.numpy as jnp
+
+    inv_std = np.where(features_std > 0, 1.0 / np.where(
+        features_std > 0, features_std, 1.0), 0.0)
+    scaled = jax.jit(lambda x, s: x * s)(ds.x, jnp.asarray(inv_std))
+    return InstanceDataset(ds.ctx, scaled, ds.y, ds.w, ds.n_rows,
+                           ds.n_features), inv_std
+
+
+def validate_binary_labels(y: np.ndarray, what: str) -> None:
+    """Reject anything outside {0, 1} — catches the ±1 SVM convention that
+    would silently corrupt margin-based losses (the aggregators map y via
+    2y−1)."""
+    bad = ~np.isin(y, (0.0, 1.0))
+    if bad.any():
+        raise ValueError(
+            f"{what} requires labels in {{0, 1}}, found "
+            f"{np.unique(y[bad])[:5]}")
+
+
 def l2_regularization(reg_param: float, d: int, fit_intercept: bool,
                       features_std: Optional[np.ndarray] = None,
                       standardize: bool = True) -> Optional[Callable]:

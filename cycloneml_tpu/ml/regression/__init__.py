@@ -1,6 +1,7 @@
 from cycloneml_tpu.ml.regression.linear_regression import (
     LinearRegression, LinearRegressionModel,
 )
+from cycloneml_tpu.ml.regression.fm import FMRegressionModel, FMRegressor
 from cycloneml_tpu.ml.regression.trees import (
     DecisionTreeRegressionModel, DecisionTreeRegressor,
     GBTRegressionModel, GBTRegressor,
@@ -9,6 +10,7 @@ from cycloneml_tpu.ml.regression.trees import (
 
 __all__ = [
     "LinearRegression", "LinearRegressionModel",
+    "FMRegressor", "FMRegressionModel",
     "DecisionTreeRegressor", "DecisionTreeRegressionModel",
     "RandomForestRegressor", "RandomForestRegressionModel",
     "GBTRegressor", "GBTRegressionModel",

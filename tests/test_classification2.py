@@ -1,0 +1,282 @@
+"""LinearSVC / NaiveBayes / FM / MLP / OneVsRest tests (ref suites:
+LinearSVCSuite, NaiveBayesSuite, FMClassifierSuite, FMRegressorSuite,
+MultilayerPerceptronClassifierSuite, OneVsRestSuite)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.classification import (
+    FMClassificationModel, FMClassifier, LinearSVC, LinearSVCModel,
+    LogisticRegression, MultilayerPerceptronClassificationModel,
+    MultilayerPerceptronClassifier, NaiveBayes, NaiveBayesModel, OneVsRest,
+    OneVsRestModel,
+)
+from cycloneml_tpu.ml.regression import FMRegressionModel, FMRegressor
+
+
+def _binary(ctx, n=500, d=6, seed=3, sep=2.0):
+    rng = np.random.RandomState(seed)
+    beta = rng.randn(d)
+    x = rng.randn(n, d)
+    y = (x @ beta + 0.1 * rng.randn(n) > 0).astype(np.float64)
+    x[y == 1] += sep * beta / np.linalg.norm(beta) * 0.5
+    return MLFrame(ctx, {"features": x, "label": y}), x, y
+
+
+class TestLinearSVC:
+    def test_separates_and_matches_sklearn(self, ctx):
+        from sklearn.svm import LinearSVC as SkSVC
+        frame, x, y = _binary(ctx)
+        ours = LinearSVC(regParam=0.01, maxIter=200, tol=1e-9).fit(frame)
+        pred = ours.transform(frame)["prediction"]
+        acc = (pred == y).mean()
+        sk = SkSVC(C=1.0 / (0.01 * len(y)), loss="hinge", max_iter=20000,
+                   tol=1e-10).fit(x, y)
+        sk_acc = sk.score(x, y)
+        assert acc >= sk_acc - 0.02
+        # hinge objective of our solution should be <= sklearn's (we solve
+        # the same problem: mean hinge + reg/2 ||b||^2 in standardized space)
+
+    def test_threshold_on_margin(self, ctx):
+        frame, x, y = _binary(ctx, seed=4)
+        m = LinearSVC(regParam=0.1, maxIter=50).fit(frame)
+        hi = m.copy()
+        hi.set("threshold", 1e6)
+        assert np.all(hi.transform(frame)["prediction"] == 0.0)
+        lo = m.copy()
+        lo.set("threshold", -1e6)
+        assert np.all(lo.transform(frame)["prediction"] == 1.0)
+
+    def test_rejects_multiclass(self, ctx):
+        rng = np.random.RandomState(5)
+        frame = MLFrame(ctx, {"features": rng.randn(30, 3),
+                              "label": rng.randint(0, 3, 30).astype(float)})
+        with pytest.raises(ValueError, match="labels in"):
+            LinearSVC().fit(frame)
+
+    def test_rejects_plus_minus_one_labels(self, ctx):
+        # the ±1 SVM convention must error, not silently corrupt the hinge
+        rng = np.random.RandomState(5)
+        frame = MLFrame(ctx, {"features": rng.randn(30, 3),
+                              "label": rng.choice([-1.0, 1.0], 30)})
+        with pytest.raises(ValueError, match="labels in"):
+            LinearSVC().fit(frame)
+
+    def test_persistence(self, ctx, tmp_path):
+        frame, x, y = _binary(ctx, seed=6)
+        m = LinearSVC(regParam=0.05, maxIter=30).fit(frame)
+        p = str(tmp_path / "svc")
+        m.save(p)
+        m2 = LinearSVCModel.load(p)
+        np.testing.assert_allclose(m2.coefficients.to_array(),
+                                   m.coefficients.to_array())
+        assert m2.intercept == m.intercept
+
+
+class TestNaiveBayes:
+    def _counts(self, ctx, n=400, d=12, k=3, seed=7):
+        rng = np.random.RandomState(seed)
+        profiles = rng.dirichlet(np.ones(d) * 0.4, size=k)
+        y = rng.randint(0, k, n).astype(np.float64)
+        x = np.stack([rng.multinomial(40, profiles[int(c)]) for c in y]) \
+            .astype(np.float64)
+        return MLFrame(ctx, {"features": x, "label": y}), x, y
+
+    def test_multinomial_matches_sklearn(self, ctx):
+        from sklearn.naive_bayes import MultinomialNB
+        frame, x, y = self._counts(ctx)
+        ours = NaiveBayes(smoothing=1.0).fit(frame)
+        sk = MultinomialNB(alpha=1.0).fit(x, y)
+        # priors use the REFERENCE's smoothed formula log(n_c+λ)-log(n+kλ)
+        # (sklearn's class_log_prior_ is unsmoothed — small difference)
+        counts = np.array([(y == c).sum() for c in range(3)], float)
+        expect_pi = np.log(counts + 1.0) - np.log(counts.sum() + 3.0)
+        np.testing.assert_allclose(ours.pi, expect_pi, atol=1e-9)
+        np.testing.assert_allclose(ours.theta.to_array(),
+                                   sk.feature_log_prob_, atol=1e-9)
+        pred = ours.transform(frame)["prediction"]
+        assert (pred == sk.predict(x)).mean() > 0.98
+
+    def test_bernoulli_matches_sklearn(self, ctx):
+        from sklearn.naive_bayes import BernoulliNB
+        rng = np.random.RandomState(8)
+        x = (rng.rand(300, 10) < 0.3).astype(np.float64)
+        y = rng.randint(0, 2, 300).astype(np.float64)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        ours = NaiveBayes(modelType="bernoulli", smoothing=1.0).fit(frame)
+        sk = BernoulliNB(alpha=1.0).fit(x, y)
+        np.testing.assert_allclose(ours.theta.to_array(),
+                                   sk.feature_log_prob_, atol=1e-9)
+        np.testing.assert_array_equal(
+            ours.transform(frame)["prediction"], sk.predict(x))
+
+    def test_gaussian_matches_sklearn(self, ctx):
+        from sklearn.naive_bayes import GaussianNB
+        rng = np.random.RandomState(9)
+        x = np.concatenate([rng.randn(100, 4) - 1, rng.randn(100, 4) + 1])
+        y = np.concatenate([np.zeros(100), np.ones(100)])
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        ours = NaiveBayes(modelType="gaussian").fit(frame)
+        sk = GaussianNB().fit(x, y)
+        agree = (ours.transform(frame)["prediction"] == sk.predict(x)).mean()
+        assert agree > 0.99
+
+    def test_complement_mode(self, ctx):
+        from sklearn.naive_bayes import ComplementNB
+        frame, x, y = self._counts(ctx, seed=10)
+        ours = NaiveBayes(modelType="complement", smoothing=1.0).fit(frame)
+        sk = ComplementNB(alpha=1.0, norm=False).fit(x, y)
+        agree = (ours.transform(frame)["prediction"] == sk.predict(x)).mean()
+        assert agree > 0.95
+
+    def test_rejects_negative_features(self, ctx):
+        frame = MLFrame(ctx, {"features": np.array([[1.0, -1.0]]),
+                              "label": np.array([0.0])})
+        with pytest.raises(ValueError, match="nonnegative"):
+            NaiveBayes().fit(frame)
+
+    def test_persistence(self, ctx, tmp_path):
+        frame, x, y = self._counts(ctx, seed=11)
+        m = NaiveBayes().fit(frame)
+        p = str(tmp_path / "nb")
+        m.save(p)
+        m2 = NaiveBayesModel.load(p)
+        np.testing.assert_allclose(m2.theta.to_array(), m.theta.to_array())
+
+
+class TestFM:
+    def test_classifier_learns_xor_interaction(self, ctx):
+        # pure pairwise-interaction structure a linear model cannot fit
+        rng = np.random.RandomState(12)
+        x = rng.choice([-1.0, 1.0], size=(600, 2))
+        y = (x[:, 0] * x[:, 1] > 0).astype(np.float64)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = FMClassifier(factorSize=4, maxIter=200, stepSize=0.1,
+                         seed=5).fit(frame)
+        acc = (m.transform(frame)["prediction"] == y).mean()
+        assert acc > 0.95
+        # probabilities well-formed
+        prob = m.transform(frame)["probability"]
+        assert np.all(np.isclose(prob.sum(1), 1.0))
+
+    def test_regressor_fits_quadratic(self, ctx):
+        rng = np.random.RandomState(13)
+        x = rng.randn(500, 3)
+        y = 2.0 + x @ np.array([1.0, -2.0, 0.5]) + 1.5 * x[:, 0] * x[:, 1]
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = FMRegressor(factorSize=4, maxIter=400, stepSize=0.1,
+                        seed=3).fit(frame)
+        pred = m.transform(frame)["prediction"]
+        r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+        assert r2 > 0.95
+
+    def test_minibatch_and_gd_solver(self, ctx):
+        rng = np.random.RandomState(14)
+        x = rng.randn(300, 3)
+        y = x @ np.array([1.0, 0.5, -1.0])
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = FMRegressor(factorSize=2, maxIter=150, solver="gd",
+                        stepSize=0.05, miniBatchFraction=0.5, seed=2).fit(frame)
+        pred = m.transform(frame)["prediction"]
+        assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+    def test_persistence(self, ctx, tmp_path):
+        rng = np.random.RandomState(15)
+        x = rng.randn(100, 3)
+        y = (x[:, 0] > 0).astype(np.float64)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = FMClassifier(factorSize=2, maxIter=20, seed=1).fit(frame)
+        p = str(tmp_path / "fm")
+        m.save(p)
+        m2 = FMClassificationModel.load(p)
+        np.testing.assert_allclose(m2.factors.to_array(),
+                                   m.factors.to_array())
+        np.testing.assert_array_equal(m2.transform(frame)["prediction"],
+                                      m.transform(frame)["prediction"])
+
+
+class TestMLP:
+    def test_learns_xor(self, ctx):
+        rng = np.random.RandomState(16)
+        x = rng.choice([-1.0, 1.0], size=(400, 2)) + 0.1 * rng.randn(400, 2)
+        y = (x[:, 0] * x[:, 1] > 0).astype(np.float64)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = MultilayerPerceptronClassifier(
+            layers=[2, 8, 2], maxIter=300, seed=5).fit(frame)
+        acc = (m.transform(frame)["prediction"] == y).mean()
+        assert acc > 0.95
+
+    def test_three_class_blobs(self, ctx):
+        rng = np.random.RandomState(17)
+        centers = np.array([[0, 4], [-4, -2], [4, -2]], float)
+        y = rng.randint(0, 3, 450).astype(np.float64)
+        x = centers[y.astype(int)] + 0.5 * rng.randn(450, 2)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = MultilayerPerceptronClassifier(
+            layers=[2, 5, 3], maxIter=200, seed=2).fit(frame)
+        out = m.transform(frame)
+        assert (out["prediction"] == y).mean() > 0.97
+        prob = out["probability"]
+        assert np.all(np.isclose(prob.sum(1), 1.0, atol=1e-6))
+
+    def test_initial_weights_and_validation(self, ctx):
+        rng = np.random.RandomState(18)
+        frame = MLFrame(ctx, {"features": rng.randn(50, 3),
+                              "label": rng.randint(0, 2, 50).astype(float)})
+        with pytest.raises(ValueError, match="input layer"):
+            MultilayerPerceptronClassifier(layers=[4, 2], maxIter=5).fit(frame)
+        with pytest.raises(ValueError, match="initialWeights"):
+            MultilayerPerceptronClassifier(
+                layers=[3, 2], maxIter=5,
+                initialWeights=np.zeros(3)).fit(frame)
+
+    def test_persistence(self, ctx, tmp_path):
+        rng = np.random.RandomState(19)
+        x = rng.randn(80, 3)
+        y = (x[:, 0] > 0).astype(np.float64)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = MultilayerPerceptronClassifier(layers=[3, 4, 2], maxIter=30,
+                                           seed=1).fit(frame)
+        p = str(tmp_path / "mlp")
+        m.save(p)
+        m2 = MultilayerPerceptronClassificationModel.load(p)
+        np.testing.assert_allclose(m2.weights.to_array(),
+                                   m.weights.to_array())
+        np.testing.assert_array_equal(m2.transform(frame)["prediction"],
+                                      m.transform(frame)["prediction"])
+
+
+class TestOneVsRest:
+    def test_multiclass_via_binary_lr(self, ctx):
+        rng = np.random.RandomState(20)
+        centers = np.array([[0, 5], [-5, -3], [5, -3]], float)
+        y = rng.randint(0, 3, 360).astype(np.float64)
+        x = centers[y.astype(int)] + 0.6 * rng.randn(360, 2)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        ovr = OneVsRest(classifier=LogisticRegression(maxIter=50))
+        model = ovr.fit(frame)
+        assert model.num_classes == 3
+        acc = (model.transform(frame)["prediction"] == y).mean()
+        assert acc > 0.97
+
+    def test_parallelism(self, ctx):
+        rng = np.random.RandomState(21)
+        y = rng.randint(0, 4, 200).astype(np.float64)
+        x = np.eye(4)[y.astype(int)] + 0.1 * rng.randn(200, 4)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = OneVsRest(classifier=LogisticRegression(maxIter=20),
+                      parallelism=4).fit(frame)
+        assert (m.transform(frame)["prediction"] == y).mean() > 0.95
+
+    def test_persistence(self, ctx, tmp_path):
+        rng = np.random.RandomState(22)
+        y = rng.randint(0, 3, 150).astype(np.float64)
+        x = np.eye(3)[y.astype(int)] + 0.1 * rng.randn(150, 3)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        m = OneVsRest(classifier=LogisticRegression(maxIter=20)).fit(frame)
+        p = str(tmp_path / "ovr")
+        m.save(p)
+        m2 = OneVsRestModel.load(p)
+        np.testing.assert_array_equal(m2.transform(frame)["prediction"],
+                                      m.transform(frame)["prediction"])
