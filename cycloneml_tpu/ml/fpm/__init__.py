@@ -1,0 +1,5 @@
+from cycloneml_tpu.ml.fpm.fpm import (
+    FPGrowth, FPGrowthModel, PrefixSpan,
+)
+
+__all__ = ["FPGrowth", "FPGrowthModel", "PrefixSpan"]

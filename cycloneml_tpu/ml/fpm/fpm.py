@@ -1,0 +1,369 @@
+"""Frequent-pattern mining: FPGrowth, AssociationRules, PrefixSpan.
+
+Re-design of the reference (ref: ml/fpm/FPGrowth.scala:129 wrapping
+mllib/fpm/FPGrowth.scala — parallel FP-growth (PFP) with group-dependent
+conditional transactions; mllib/fpm/AssociationRules.scala single-consequent
+rules with lift; mllib/fpm/PrefixSpan.scala:62 prefix-projected sequential
+patterns).
+
+These are object-data (control-plane) algorithms: transactions are ragged
+item lists, not dense blocks, so they run on the host tier
+(``PartitionedDataset``), exactly where the reference runs them (CPU
+executors). PFP sharding: items are hashed into groups; each partition emits
+group-conditional transactions; each group's FP-tree is mined independently
+(the ``group_by_key``→mine step ≈ the reference's shuffle) — the TPU plays
+no role here and shouldn't.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.param import ParamValidators as PV
+from cycloneml_tpu.ml.shared import Params
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable
+
+
+# -- FP-tree ------------------------------------------------------------------
+
+class _FPNode:
+    __slots__ = ("item", "count", "children", "parent")
+
+    def __init__(self, item, parent):
+        self.item = item
+        self.count = 0
+        self.children: Dict = {}
+        self.parent = parent
+
+
+class _FPTree:
+    """Prefix tree over rank-ordered transactions (ref mllib/fpm/FPTree.scala)."""
+
+    def __init__(self):
+        self.root = _FPNode(None, None)
+        self.summaries: Dict[object, List[_FPNode]] = defaultdict(list)
+
+    def add(self, items: Sequence, count: int = 1) -> None:
+        node = self.root
+        for it in items:
+            child = node.children.get(it)
+            if child is None:
+                child = _FPNode(it, node)
+                node.children[it] = child
+                self.summaries[it].append(child)
+            child.count += count
+            node = child
+
+    def _conditional_base(self, item) -> List[Tuple[List, int]]:
+        out = []
+        for node in self.summaries[item]:
+            path = []
+            p = node.parent
+            while p is not None and p.item is not None:
+                path.append(p.item)
+                p = p.parent
+            if path:
+                out.append((list(reversed(path)), node.count))
+        return out
+
+    def extract(self, min_count: int, validate=lambda it: True):
+        """Yield (itemset_suffix_list, support_count)."""
+        for item, nodes in self.summaries.items():
+            count = sum(n.count for n in nodes)
+            if count >= min_count and validate(item):
+                yield [item], count
+                cond = _FPTree()
+                for path, c in self._conditional_base(item):
+                    cond.add(path, c)
+                for suffix, c in cond.extract(min_count):
+                    yield suffix + [item], c
+
+
+# -- FPGrowth -----------------------------------------------------------------
+
+class _FPGrowthParams(Params):
+    def _declare_fp_params(self):
+        self._param("itemsCol", "items column name", default="items")
+        self._param("minSupport", "minimum itemset support",
+                    PV.in_range(0.0, 1.0), default=0.3)
+        self._param("minConfidence", "minimum rule confidence",
+                    PV.in_range(0.0, 1.0), default=0.8)
+        self._param("numPartitions", "mining parallelism (0 = input's)",
+                    default=0)
+        self._param("predictionCol", "prediction column", default="prediction")
+
+
+class FPGrowth(Estimator, _FPGrowthParams, MLWritable, MLReadable):
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid)
+        self._declare_fp_params()
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    def set_items_col(self, v):
+        return self.set("itemsCol", v)
+
+    def set_min_support(self, v):
+        return self.set("minSupport", v)
+
+    def set_min_confidence(self, v):
+        return self.set("minConfidence", v)
+
+    def _fit(self, frame: MLFrame) -> "FPGrowthModel":
+        items = frame[self.get("itemsCol")]
+        transactions = [list(t) for t in items if t is not None]
+        return self._fit_transactions(frame.ctx, transactions)
+
+    def _fit_transactions(self, ctx, transactions: List[List]) -> "FPGrowthModel":
+        from cycloneml_tpu.dataset.dataset import PartitionedDataset
+
+        n = len(transactions)
+        if n == 0:
+            raise ValueError("empty input")
+        min_count = int(math.ceil(self.get("minSupport") * n))
+        min_count = max(min_count, 1)
+        num_groups = self.get("numPartitions") or max(
+            ctx.mesh_runtime.data_parallelism, 1)
+
+        data = PartitionedDataset.from_sequence(ctx, transactions, num_groups)
+
+        # pass 1: item frequencies (≈ genFreqItems' reduceByKey)
+        def count_part(part):
+            c = Counter()
+            for t in part:
+                c.update(set(t))
+            return c
+        counts = Counter()
+        for c in data._run_per_partition(count_part):
+            counts.update(c)
+        freq = {it: c for it, c in counts.items() if c >= min_count}
+        # rank: descending frequency, ties by repr for determinism
+        rank = {it: r for r, (it, _) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], repr(kv[0]))))}
+
+        # pass 2: group-conditional transactions → per-group FP-trees
+        # (≈ genCondTransactions + partitionBy(gid) + mine per group)
+        def mine_part(part):
+            # part: list of (gid, filtered_transaction)
+            trees: Dict[int, _FPTree] = defaultdict(_FPTree)
+            for gid, t in part:
+                trees[gid].add(t)
+            out = []
+            for gid, tree in trees.items():
+                out.extend(
+                    (tuple(s), c) for s, c in tree.extract(
+                        min_count,
+                        validate=lambda it, g=gid: rank[it] % num_groups == g))
+            return out
+
+        def cond_transactions(part):
+            out = []
+            for t in part:
+                filtered = sorted({it for it in t if it in rank},
+                                  key=lambda it: rank[it])
+                seen = set()
+                for i in range(len(filtered) - 1, -1, -1):
+                    gid = rank[filtered[i]] % num_groups
+                    if gid not in seen:
+                        seen.add(gid)
+                        out.append((gid, filtered[:i + 1]))
+            return out
+
+        grouped = data.map_partitions(lambda p: cond_transactions(list(p)))
+        # route each conditional transaction to its group's partition so each
+        # group is mined exactly once
+        def route(ps):
+            buckets = [[] for _ in range(num_groups)]
+            for p in ps:
+                for gid, t in p:
+                    buckets[gid].append((gid, t))
+            return buckets
+        routed = grouped._derive(route, num_groups)
+        mined: List[Tuple[Tuple, int]] = []
+        for part_out in routed._run_per_partition(lambda p: mine_part(list(p))):
+            mined.extend(part_out)
+
+        itemsets = [(list(s), c) for s, c in mined]
+        itemsets.sort(key=lambda ic: (-ic[1], len(ic[0]), repr(ic[0])))
+        model = FPGrowthModel(itemsets, n, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        return model
+
+
+class FPGrowthModel(Model, _FPGrowthParams, MLWritable, MLReadable):
+    def __init__(self, freq_itemsets: Optional[List[Tuple[List, int]]] = None,
+                 num_training_records: int = 0, uid=None):
+        super().__init__(uid)
+        self._declare_fp_params()
+        self.freq_itemsets = freq_itemsets or []
+        self.num_training_records = num_training_records
+        self._rules: Optional[List[dict]] = None
+
+    @property
+    def association_rules(self) -> List[dict]:
+        """Single-consequent rules with confidence+lift+support
+        (ref mllib/fpm/AssociationRules.scala)."""
+        if self._rules is None:
+            self._rules = _association_rules(
+                self.freq_itemsets, self.num_training_records,
+                self.get("minConfidence"))
+        return self._rules
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        rules = [(frozenset(r["antecedent"]), r["consequent"])
+                 for r in self.association_rules]
+        preds = []
+        for t in frame[self.get("itemsCol")]:
+            have = set(t) if t is not None else set()
+            out = []
+            for ante, cons in rules:
+                if ante <= have:
+                    for c in cons:
+                        if c not in have and c not in out:
+                            out.append(c)
+            preds.append(out)
+        return frame.with_column(self.get("predictionCol"),
+                                 np.array(preds, dtype=object))
+
+    def _save_data(self, path: str) -> None:
+        import json
+        import os
+        with open(os.path.join(path, "itemsets.json"), "w") as f:
+            json.dump({"n": self.num_training_records,
+                       "sets": [[list(map(str, s)), c]
+                                for s, c in self.freq_itemsets]}, f)
+
+    def _load_data(self, path: str, meta) -> None:
+        import json
+        import os
+        with open(os.path.join(path, "itemsets.json")) as f:
+            d = json.load(f)
+        self.num_training_records = d["n"]
+        self.freq_itemsets = [(s, c) for s, c in d["sets"]]
+
+
+def _association_rules(itemsets: List[Tuple[List, int]], n: int,
+                       min_confidence: float) -> List[dict]:
+    support = {frozenset(s): c for s, c in itemsets}
+    rules = []
+    for s, c in itemsets:
+        if len(s) < 2:
+            continue
+        fs = frozenset(s)
+        for item in s:
+            ante = fs - {item}
+            ante_count = support.get(ante)
+            if not ante_count:
+                continue
+            conf = c / ante_count
+            if conf >= min_confidence:
+                cons_count = support.get(frozenset([item]))
+                lift = (conf / (cons_count / n)) if cons_count else float("nan")
+                rules.append({
+                    "antecedent": sorted(ante, key=repr),
+                    "consequent": [item],
+                    "confidence": conf,
+                    "lift": lift,
+                    "support": c / n,
+                })
+    rules.sort(key=lambda r: (-r["confidence"], repr(r["antecedent"])))
+    return rules
+
+
+# -- PrefixSpan ---------------------------------------------------------------
+
+class PrefixSpan(Params):
+    """Sequential pattern mining by prefix projection
+    (ref mllib/fpm/PrefixSpan.scala:62; ml/fpm/PrefixSpan.scala wrapper).
+
+    Sequences are lists of itemsets: ``[["a"], ["a","b"], ["c"]]``.
+    ``find_frequent_sequential_patterns`` returns (pattern, freq) pairs where
+    a pattern is a list of itemsets.
+    """
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid)
+        self._param("minSupport", "minimum sequence support",
+                    PV.in_range(0.0, 1.0), default=0.1)
+        self._param("maxPatternLength", "max number of items per pattern",
+                    PV.gt(0), default=10)
+        self._param("maxLocalProjDBSize", "projected-db size cutoff",
+                    default=32000000)
+        self._param("sequenceCol", "sequence column", default="sequence")
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    def set_min_support(self, v):
+        return self.set("minSupport", v)
+
+    def set_max_pattern_length(self, v):
+        return self.set("maxPatternLength", v)
+
+    def find_frequent_sequential_patterns(self, frame_or_sequences):
+        if isinstance(frame_or_sequences, MLFrame):
+            seqs = [s for s in frame_or_sequences[self.get("sequenceCol")]
+                    if s is not None]
+        else:
+            seqs = list(frame_or_sequences)
+        n = len(seqs)
+        if n == 0:
+            raise ValueError("empty input")
+        min_count = max(int(math.ceil(self.get("minSupport") * n)), 1)
+        max_len = self.get("maxPatternLength")
+
+        # canonicalize: itemsets as frozensets; item order by repr
+        db = [[frozenset(s) for s in seq] for seq in seqs]
+        all_items = sorted({it for seq in db for s in seq for it in s},
+                           key=repr)
+        results: List[Tuple[List[Tuple], int]] = []
+        self._mine([], list(range(n)), db, all_items, min_count, max_len,
+                   results)
+        results.sort(key=lambda pc: (-pc[1], len(pc[0]), repr(pc[0])))
+        return [([sorted(s, key=repr) for s in pat], c) for pat, c in results]
+
+    @staticmethod
+    def _matches(pattern: List[FrozenSet], seq: List[FrozenSet]) -> bool:
+        """True iff ∃ j1<…<jk with pattern[m] ⊆ seq[jm] (the reference's
+        subsequence-of-itemsets semantics)."""
+        j = 0
+        for pset in pattern:
+            while j < len(seq) and not pset <= seq[j]:
+                j += 1
+            if j == len(seq):
+                return False
+            j += 1
+        return True
+
+    # Recursion over candidate extensions: S-extension starts a new itemset
+    # with one item; I-extension grows the last itemset (items canonically
+    # after its current members, so each multi-item itemset is generated
+    # exactly once). Support is re-counted against the parent's support set,
+    # which shrinks monotonically — semantics identical to the reference's
+    # prefix projection, simpler bookkeeping (no partial-postfix encoding).
+    def _mine(self, prefix: List[FrozenSet], support_idx: List[int], db,
+              all_items, min_count: int, max_len: int, results) -> None:
+        n_items = sum(len(s) for s in prefix)
+        if n_items >= max_len:
+            return
+        candidates = []
+        for item in all_items:
+            candidates.append(prefix + [frozenset([item])])  # S-extension
+        if prefix:
+            last = prefix[-1]
+            last_max = max(map(repr, last))
+            for item in all_items:
+                if item not in last and repr(item) > last_max:
+                    candidates.append(prefix[:-1] + [last | {item}])
+        for cand in candidates:
+            sup = [i for i in support_idx if self._matches(cand, db[i])]
+            if len(sup) >= min_count:
+                results.append(([tuple(s) for s in cand], len(sup)))
+                self._mine(cand, sup, db, all_items, min_count, max_len,
+                           results)

@@ -7,6 +7,15 @@ from cycloneml_tpu.ml.regression.trees import (
     GBTRegressionModel, GBTRegressor,
     RandomForestRegressionModel, RandomForestRegressor,
 )
+from cycloneml_tpu.ml.regression.glm import (
+    GeneralizedLinearRegression, GeneralizedLinearRegressionModel,
+)
+from cycloneml_tpu.ml.regression.aft import (
+    AFTSurvivalRegression, AFTSurvivalRegressionModel,
+)
+from cycloneml_tpu.ml.regression.isotonic import (
+    IsotonicRegression, IsotonicRegressionModel,
+)
 
 __all__ = [
     "LinearRegression", "LinearRegressionModel",
@@ -14,4 +23,7 @@ __all__ = [
     "DecisionTreeRegressor", "DecisionTreeRegressionModel",
     "RandomForestRegressor", "RandomForestRegressionModel",
     "GBTRegressor", "GBTRegressionModel",
+    "GeneralizedLinearRegression", "GeneralizedLinearRegressionModel",
+    "AFTSurvivalRegression", "AFTSurvivalRegressionModel",
+    "IsotonicRegression", "IsotonicRegressionModel",
 ]

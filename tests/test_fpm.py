@@ -1,0 +1,160 @@
+"""FPGrowth / PrefixSpan tests (hand-checked baskets, brute-force oracles —
+the reference's FPGrowthSuite/PrefixSpanSuite use the same style of small
+enumerable fixtures)."""
+
+import itertools
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.fpm import FPGrowth, FPGrowthModel, PrefixSpan
+
+
+def _brute_force_itemsets(transactions, min_count):
+    """Oracle: enumerate all itemsets over observed items."""
+    items = sorted({i for t in transactions for i in t})
+    out = {}
+    for r in range(1, len(items) + 1):
+        for combo in itertools.combinations(items, r):
+            c = sum(1 for t in transactions if set(combo) <= set(t))
+            if c >= min_count:
+                out[frozenset(combo)] = c
+    return out
+
+
+BASKETS = [
+    ["r", "z", "h", "k", "p"],
+    ["z", "y", "x", "w", "v", "u", "t", "s"],
+    ["s", "x", "o", "n", "r"],
+    ["x", "z", "y", "m", "t", "s", "q", "e"],
+    ["z"],
+    ["x", "z", "y", "r", "q", "t", "p"],
+]
+
+
+def test_fpgrowth_matches_bruteforce(ctx):
+    frame = MLFrame(ctx, {"items": np.array(BASKETS, dtype=object)})
+    model = FPGrowth(minSupport=0.5, minConfidence=0.5).fit(frame)
+    got = {frozenset(s): c for s, c in model.freq_itemsets}
+    want = _brute_force_itemsets(BASKETS, min_count=3)
+    assert got == want
+
+
+def test_fpgrowth_min_support_1(ctx):
+    # minSupport so low every observed itemset combination survives
+    tx = [["a", "b"], ["a", "c"], ["a", "b", "c"]]
+    frame = MLFrame(ctx, {"items": np.array(tx, dtype=object)})
+    model = FPGrowth(minSupport=0.34).fit(frame)
+    got = {frozenset(s): c for s, c in model.freq_itemsets}
+    assert got == _brute_force_itemsets(tx, min_count=2)
+
+
+def test_fpgrowth_association_rules_and_transform(ctx):
+    tx = [["a", "b"], ["a", "b", "c"], ["a", "b", "c"], ["c", "d"], ["a", "d"]]
+    frame = MLFrame(ctx, {"items": np.array(tx, dtype=object)})
+    model = FPGrowth(minSupport=0.4, minConfidence=0.6).fit(frame)
+    rules = {(tuple(r["antecedent"]), tuple(r["consequent"])): r
+             for r in model.association_rules}
+    # {a}→{b}: support({a,b})=3, support({a})=4 → conf 0.75; lift = .75/(3/5)
+    r = rules[(("a",), ("b",))]
+    assert r["confidence"] == pytest.approx(3 / 4)
+    assert r["lift"] == pytest.approx((3 / 4) / (3 / 5))
+    assert r["support"] == pytest.approx(3 / 5)
+    # transform: basket {a} should predict b (from a→b)
+    pred = model.transform(MLFrame(ctx, {
+        "items": np.array([["a"], ["x"]], dtype=object)}))["prediction"]
+    assert "b" in pred[0]
+    assert list(pred[1]) == []
+
+
+def test_fpgrowth_persistence(ctx, tmp_path):
+    frame = MLFrame(ctx, {"items": np.array(BASKETS, dtype=object)})
+    model = FPGrowth(minSupport=0.5).fit(frame)
+    path = str(tmp_path / "fp")
+    model.save(path)
+    m2 = FPGrowthModel.load(path)
+    assert {frozenset(s): c for s, c in m2.freq_itemsets} == \
+        {frozenset(s): c for s, c in model.freq_itemsets}
+
+
+# -- PrefixSpan ---------------------------------------------------------------
+
+SEQDB = [
+    [["a"], ["a", "b", "c"], ["a", "c"], ["d"], ["c", "f"]],
+    [["a", "d"], ["c"], ["b", "c"], ["a", "e"]],
+    [["e", "f"], ["a", "b"], ["d", "f"], ["c"], ["b"]],
+    [["e"], ["g"], ["a", "f"], ["c"], ["b"], ["c"]],
+]
+
+
+def _brute_force_patterns(db, min_count, max_len):
+    """Oracle: BFS over the pattern lattice with subsequence matching."""
+    def matches(pattern, seq):
+        j = 0
+        for ps in pattern:
+            while j < len(seq) and not set(ps) <= set(seq[j]):
+                j += 1
+            if j == len(seq):
+                return False
+            j += 1
+        return True
+
+    items = sorted({i for seq in db for s in seq for i in s})
+    found = {}
+    frontier = [[]]
+    while frontier:
+        new_frontier = []
+        for pat in frontier:
+            cands = [pat + [[i]] for i in items]
+            if pat:
+                last = pat[-1]
+                cands += [pat[:-1] + [sorted(last + [i])] for i in items
+                          if i not in last and i > max(last)]
+            for cand in cands:
+                if sum(len(s) for s in cand) > max_len:
+                    continue
+                c = sum(1 for seq in db if matches(cand, seq))
+                if c >= min_count:
+                    key = tuple(tuple(s) for s in cand)
+                    if key not in found:
+                        found[key] = c
+                        new_frontier.append(cand)
+        frontier = new_frontier
+    return found
+
+
+def test_prefixspan_matches_bruteforce(ctx):
+    ps = PrefixSpan(minSupport=0.5, maxPatternLength=3)
+    got = {tuple(tuple(s) for s in pat): c
+           for pat, c in ps.find_frequent_sequential_patterns(SEQDB)}
+    want = _brute_force_patterns(SEQDB, min_count=2, max_len=3)
+    assert got == want
+    # the classic fixture facts: <(a)(c)> appears in all 4 sequences
+    assert got[(("a",), ("c",))] == 4
+
+
+def test_prefixspan_multi_item_itemsets(ctx):
+    db = [
+        [["a", "b"], ["c"]],
+        [["a", "b"], ["c"]],
+        [["a"], ["b"], ["c"]],
+    ]
+    ps = PrefixSpan(minSupport=0.6, maxPatternLength=3)
+    got = {tuple(tuple(s) for s in pat): c
+           for pat, c in ps.find_frequent_sequential_patterns(db)}
+    # itemset pattern <(ab)> has support 2; sequence pattern <(a)(c)> support 3
+    assert got[(("a", "b"),)] == 2
+    assert got[(("a",), ("c",))] == 3
+    assert got[(("a", "b"), ("c",))] == 2
+
+
+def test_prefixspan_frame_input(ctx):
+    frame = MLFrame(ctx, {"sequence": np.array(SEQDB, dtype=object)})
+    ps = PrefixSpan(minSupport=1.0, maxPatternLength=2)
+    got = ps.find_frequent_sequential_patterns(frame)
+    # only patterns present in every sequence survive
+    for pat, c in got:
+        assert c == 4
+    assert any(pat == [["a"]] for pat, _ in got)

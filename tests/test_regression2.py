@@ -1,0 +1,302 @@
+"""GLM / AFT / Isotonic parity tests.
+
+GLM families are checked against sklearn's unpenalized GLM solvers (exact MLE
+for the same likelihood — the reference's own suites assert against R glm the
+same way). AFT is checked against a scipy.optimize fit of the identical
+censored-Weibull NLL; Isotonic against sklearn's PAV.
+"""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.regression import (
+    AFTSurvivalRegression, GeneralizedLinearRegression, IsotonicRegression,
+)
+
+
+def _xy(seed=0, n=400, d=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    beta = np.array([0.5, -0.3, 0.2, 0.1])[:d]
+    return rng, x, beta
+
+
+# -- GLM ----------------------------------------------------------------------
+
+def test_glm_poisson_log_vs_sklearn(ctx):
+    from sklearn.linear_model import PoissonRegressor
+    rng, x, beta = _xy(0)
+    y = rng.poisson(np.exp(x @ beta + 0.3)).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="poisson", maxIter=50).fit(frame)
+    sk = PoissonRegressor(alpha=0.0, max_iter=500, tol=1e-10).fit(x, y)
+    np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_, atol=1e-6)
+    np.testing.assert_allclose(m.intercept, sk.intercept_, atol=1e-6)
+    s = m.summary
+    assert s.deviance < s.null_deviance
+    assert s.num_iterations <= 50
+    assert np.isfinite(s.aic)
+    # significant features have small p-values, noise intercept-ish ones don't
+    assert (s.p_values[:2] < 1e-4).all()
+
+
+def test_glm_gamma_log_vs_sklearn(ctx):
+    from sklearn.linear_model import GammaRegressor
+    rng, x, beta = _xy(1)
+    y = rng.gamma(2.0, np.exp(x @ beta + 0.3) / 2.0)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="gamma", link="log",
+                                    maxIter=50).fit(frame)
+    sk = GammaRegressor(alpha=0.0, max_iter=500, tol=1e-10).fit(x, y)
+    np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_, atol=1e-6)
+    # dispersion via Pearson chi2 / dof should be near 1/shape = 0.5
+    assert 0.3 < m.summary.dispersion < 0.8
+
+
+def test_glm_binomial_logit_matches_logreg(ctx):
+    from sklearn.linear_model import LogisticRegression as SKL
+    rng, x, beta = _xy(2)
+    p = 1.0 / (1.0 + np.exp(-(x @ beta + 0.3)))
+    y = (rng.rand(len(p)) < p).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="binomial").fit(frame)
+    sk = SKL(C=np.inf, tol=1e-10, max_iter=1000).fit(x, y)
+    np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_[0], atol=1e-5)
+
+
+def test_glm_gaussian_identity_is_ols(ctx):
+    rng, x, beta = _xy(3)
+    y = x @ beta + 0.3 + 0.1 * rng.randn(len(x))
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression().fit(frame)
+    ref = np.linalg.lstsq(np.c_[x, np.ones(len(y))], y, rcond=None)[0]
+    np.testing.assert_allclose(m.coefficients.to_array(), ref[:-1], atol=1e-8)
+    np.testing.assert_allclose(m.intercept, ref[-1], atol=1e-8)
+    # standard errors match the classic OLS formula
+    resid = y - (x @ ref[:-1] + ref[-1])
+    sigma2 = resid @ resid / (len(y) - x.shape[1] - 1)
+    xa = np.c_[x, np.ones(len(y))]
+    se_ref = np.sqrt(np.diag(np.linalg.inv(xa.T @ xa)) * sigma2)
+    np.testing.assert_allclose(m.summary.coefficient_standard_errors, se_ref,
+                               rtol=1e-6)
+
+
+def test_glm_tweedie_vs_sklearn(ctx):
+    from sklearn.linear_model import TweedieRegressor
+    rng, x, beta = _xy(4)
+    y = np.maximum(rng.gamma(2.0, np.exp(x @ beta) / 2.0)
+                   * (rng.rand(len(x)) > 0.2), 0.0)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="tweedie", variancePower=1.5,
+                                    linkPower=0.0, maxIter=100,
+                                    tol=1e-10).fit(frame)
+    sk = TweedieRegressor(power=1.5, alpha=0.0, link="log", max_iter=20000,
+                          tol=1e-14).fit(x, y)
+    np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_, atol=1e-6)
+
+
+def test_glm_offset(ctx):
+    rng, x, beta = _xy(5)
+    y = rng.poisson(np.exp(x @ beta + 0.5)).astype(float)
+    offset = np.full(len(y), 0.5)
+    frame = MLFrame(ctx, {"features": x, "label": y, "off": offset})
+    m = GeneralizedLinearRegression(family="poisson",
+                                    offsetCol="off").fit(frame)
+    # with the true offset supplied, the intercept should shrink toward 0
+    m0 = GeneralizedLinearRegression(family="poisson").fit(frame)
+    assert abs(m.intercept) < abs(m0.intercept)
+    np.testing.assert_allclose(m.coefficients.to_array(),
+                               m0.coefficients.to_array(), atol=0.05)
+
+
+def test_glm_offset_transform_and_residuals(ctx):
+    rng, x, beta = _xy(8)
+    y = rng.poisson(np.exp(x @ beta + 0.5)).astype(float)
+    offset = np.full(len(y), 0.5)
+    frame = MLFrame(ctx, {"features": x, "label": y, "off": offset})
+    m = GeneralizedLinearRegression(family="poisson", offsetCol="off",
+                                    linkPredictionCol="eta").fit(frame)
+    out = m.transform(frame)
+    # transform must apply the offset: prediction == exp(Xβ + b + offset)
+    eta = x @ m.coefficients.to_array() + m.intercept + offset
+    np.testing.assert_allclose(out["prediction"], np.exp(eta), rtol=1e-10)
+    np.testing.assert_allclose(out["eta"], eta, rtol=1e-10)
+    # all four residual types are finite and consistent
+    for rt in ("response", "working", "pearson", "deviance"):
+        r = m.summary.residuals(rt)
+        assert np.isfinite(r).all() and r.shape == y.shape
+    # deviance residuals sum of squares equals the model deviance
+    dev_r = m.summary.residuals("deviance")
+    np.testing.assert_allclose((dev_r ** 2).sum(), m.summary.deviance,
+                               rtol=1e-8)
+
+
+def test_glm_tweedie_residuals_no_crash(ctx):
+    rng, x, beta = _xy(9)
+    y = np.maximum(rng.gamma(2.0, np.exp(x @ beta) / 2.0)
+                   * (rng.rand(len(x)) > 0.2), 0.0)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="tweedie",
+                                    variancePower=1.5).fit(frame)
+    for rt in ("response", "working", "pearson", "deviance"):
+        assert np.isfinite(m.summary.residuals(rt)).all()
+
+
+def test_glm_bad_variance_power_rejected(ctx):
+    rng, x, beta = _xy(10)
+    y = np.abs(x @ beta) + 1.0
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    with pytest.raises(ValueError):
+        GeneralizedLinearRegression(family="tweedie",
+                                    variancePower=-1.0).fit(frame)
+
+
+def test_aft_quantiles_col(ctx):
+    x, y, censor = _aft_data(seed=13)
+    frame = MLFrame(ctx, {"features": x, "label": y, "censor": censor})
+    m = AFTSurvivalRegression(quantilesCol="q",
+                              quantileProbabilities=[0.25, 0.5]).fit(frame)
+    out = m.transform(frame)
+    assert out["q"].shape == (len(y), 2)
+    np.testing.assert_allclose(out["q"], m.predict_quantiles(x), rtol=1e-12)
+
+
+def test_glm_weights(ctx):
+    # integer weights ≡ row replication (the defining property of weighted GLM)
+    rng, x, beta = _xy(6, n=120)
+    y = rng.poisson(np.exp(x @ beta)).astype(float)
+    w = rng.randint(1, 4, len(y)).astype(float)
+    frame_w = MLFrame(ctx, {"features": x, "label": y, "w": w})
+    rep = np.repeat(np.arange(len(y)), w.astype(int))
+    frame_r = MLFrame(ctx, {"features": x[rep], "label": y[rep]})
+    mw = GeneralizedLinearRegression(family="poisson", weightCol="w").fit(frame_w)
+    mr = GeneralizedLinearRegression(family="poisson").fit(frame_r)
+    np.testing.assert_allclose(mw.coefficients.to_array(),
+                               mr.coefficients.to_array(), atol=1e-7)
+
+
+def test_glm_persistence(ctx, tmp_path):
+    rng, x, beta = _xy(7)
+    y = rng.poisson(np.exp(x @ beta)).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = GeneralizedLinearRegression(family="poisson", link="log").fit(frame)
+    path = str(tmp_path / "glm")
+    m.save(path)
+    from cycloneml_tpu.ml.regression import GeneralizedLinearRegressionModel
+    m2 = GeneralizedLinearRegressionModel.load(path)
+    np.testing.assert_allclose(m2.coefficients.to_array(),
+                               m.coefficients.to_array())
+    assert m2.get("family") == "poisson"
+    pred1 = m.transform(frame)["prediction"]
+    pred2 = m2.transform(frame)["prediction"]
+    np.testing.assert_allclose(pred1, pred2)
+
+
+# -- AFT ----------------------------------------------------------------------
+
+def _aft_data(seed=10, n=500, d=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    beta = np.array([0.4, -0.2, 0.3])[:d]
+    sigma = 0.7
+    # Weibull AFT: log T = Xβ + b + σ W, W ~ Gumbel(min)
+    w_noise = np.log(-np.log(1.0 - rng.rand(n)))
+    t = np.exp(x @ beta + 1.0 + sigma * w_noise)
+    c = np.exp(x @ np.zeros(d) + 1.5 + rng.randn(n))  # censoring times
+    y = np.minimum(t, c)
+    censor = (t <= c).astype(float)  # 1 = event observed
+    return x, y, censor
+
+
+def _aft_nll_numpy(params, x, y, censor):
+    d = x.shape[1]
+    beta, icpt, log_sigma = params[:d], params[d], params[d + 1]
+    sigma = np.exp(log_sigma)
+    eps = (np.log(y) - x @ beta - icpt) / sigma
+    ll = censor * (eps - log_sigma) - np.exp(eps)
+    return -ll.mean()
+
+
+def test_aft_matches_scipy_mle(ctx):
+    from scipy.optimize import minimize
+    x, y, censor = _aft_data()
+    frame = MLFrame(ctx, {"features": x, "label": y, "censor": censor})
+    m = AFTSurvivalRegression(maxIter=200, tol=1e-9).fit(frame)
+    res = minimize(_aft_nll_numpy, np.zeros(x.shape[1] + 2),
+                   args=(x, y, censor), method="L-BFGS-B",
+                   options={"maxiter": 1000, "ftol": 1e-14, "gtol": 1e-10})
+    ref_beta = res.x[:x.shape[1]]
+    np.testing.assert_allclose(m.coefficients.to_array(), ref_beta, atol=1e-3)
+    np.testing.assert_allclose(m.intercept, res.x[x.shape[1]], atol=1e-3)
+    np.testing.assert_allclose(m.scale, np.exp(res.x[-1]), atol=1e-3)
+    # recovered parameters near the generating ones
+    assert abs(m.scale - 0.7) < 0.15
+
+
+def test_aft_quantiles_median_consistency(ctx):
+    x, y, censor = _aft_data(seed=11)
+    frame = MLFrame(ctx, {"features": x, "label": y, "censor": censor})
+    m = AFTSurvivalRegression(quantileProbabilities=[0.5]).fit(frame)
+    q = m.predict_quantiles(x[:5])
+    lam = np.exp(x[:5] @ m.coefficients.to_array() + m.intercept)
+    np.testing.assert_allclose(
+        q[:, 0], lam * (-np.log(0.5)) ** m.scale, rtol=1e-10)
+
+
+def test_aft_persistence(ctx, tmp_path):
+    x, y, censor = _aft_data(seed=12)
+    frame = MLFrame(ctx, {"features": x, "label": y, "censor": censor})
+    m = AFTSurvivalRegression().fit(frame)
+    path = str(tmp_path / "aft")
+    m.save(path)
+    from cycloneml_tpu.ml.regression import AFTSurvivalRegressionModel
+    m2 = AFTSurvivalRegressionModel.load(path)
+    np.testing.assert_allclose(m2.coefficients.to_array(),
+                               m.coefficients.to_array())
+    assert m2.scale == m.scale
+
+
+# -- Isotonic -----------------------------------------------------------------
+
+def test_isotonic_vs_sklearn(ctx):
+    from sklearn.isotonic import IsotonicRegression as SKIso
+    rng = np.random.RandomState(20)
+    f = rng.uniform(0, 10, 300)
+    y = 0.5 * f + rng.randn(300)
+    frame = MLFrame(ctx, {"features": f, "label": y})
+    m = IsotonicRegression().fit(frame)
+    sk = SKIso(out_of_bounds="clip").fit(f, y)
+    np.testing.assert_allclose(m.transform(frame)["prediction"],
+                               sk.predict(f), atol=1e-9)
+    # out-of-range clamping
+    np.testing.assert_allclose(
+        m._predict_batch(np.array([-100.0, 100.0])),
+        sk.predict(np.array([-100.0, 100.0])), atol=1e-9)
+
+
+def test_isotonic_weighted_and_antitonic(ctx):
+    from sklearn.isotonic import IsotonicRegression as SKIso
+    rng = np.random.RandomState(21)
+    f = rng.uniform(0, 5, 200)
+    y = -0.7 * f + rng.randn(200)
+    w = rng.uniform(0.5, 2.0, 200)
+    frame = MLFrame(ctx, {"features": f, "label": y, "w": w})
+    m = IsotonicRegression(isotonic=False, weightCol="w").fit(frame)
+    sk = SKIso(increasing=False, out_of_bounds="clip").fit(f, y, sample_weight=w)
+    np.testing.assert_allclose(m.transform(frame)["prediction"],
+                               sk.predict(f), atol=1e-9)
+
+
+def test_isotonic_persistence(ctx, tmp_path):
+    rng = np.random.RandomState(22)
+    f = rng.uniform(0, 10, 100)
+    y = f + rng.randn(100)
+    frame = MLFrame(ctx, {"features": f, "label": y})
+    m = IsotonicRegression().fit(frame)
+    path = str(tmp_path / "iso")
+    m.save(path)
+    from cycloneml_tpu.ml.regression import IsotonicRegressionModel
+    m2 = IsotonicRegressionModel.load(path)
+    np.testing.assert_allclose(m2.boundaries, m.boundaries)
+    np.testing.assert_allclose(m2.predictions, m.predictions)
