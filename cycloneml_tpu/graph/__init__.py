@@ -1,0 +1,4 @@
+from cycloneml_tpu.graph.graph import Graph
+from cycloneml_tpu.graph.pregel import pregel
+
+__all__ = ["Graph", "pregel"]

@@ -1,0 +1,220 @@
+"""Property graphs on the device mesh.
+
+Re-design of GraphX (ref: graphx/src/main/scala/org/apache/spark/graphx/ —
+Graph, VertexRDD.scala:55, EdgeRDD.scala:39, impl/GraphImpl.scala:35). The
+reference stores a vertex-cut partitioning: edges are hash-partitioned and a
+routing table ships vertex attributes to every partition that references
+them. The TPU-native layout keeps the same split but exploits the mesh:
+
+- **Edges** are the sharded axis: ``(src, dst, attr, valid)`` arrays padded to
+  equal-size shards and row-sharded over ``(replica, data)`` — the analog of
+  GraphX's ``EdgePartition`` (ref impl/EdgePartition.scala).
+- **Vertex state** is replicated (the degenerate-but-fast routing table: every
+  device sees all vertex attributes; gathers are local HBM reads).
+- ``aggregate_messages`` — the core primitive (ref Graph.aggregateMessages /
+  GraphImpl.aggregateMessagesWithActiveSet) — compiles to one shard_map
+  program: per-edge message computation, ``segment_{sum,min,max}`` into a
+  dense vertex vector per shard, then a hierarchical ``psum``/``pmin``/
+  ``pmax`` over ICI-then-DCN. No shuffle, no routing-table RPC.
+
+Vertex ids are dense ``[0, n)`` indices; ``Graph.from_edges`` remaps arbitrary
+int64 ids and keeps the mapping for user-facing results.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.mesh import DATA_AXIS, REPLICA_AXIS
+from cycloneml_tpu.parallel.collectives import shard_map_compat
+
+_EDGE_ROWS_MULTIPLE = 8
+
+
+def _pad_edges(arrs: Sequence[np.ndarray], n_shards: int):
+    """Pad 1-D edge arrays to a shard-divisible length; returns padded arrays
+    plus a float validity mask (padding edges carry valid=0, src=dst=0)."""
+    e = arrs[0].shape[0]
+    m = n_shards * _EDGE_ROWS_MULTIPLE
+    target = max(((e + m - 1) // m) * m, m)
+    out = []
+    for a in arrs:
+        pad = np.zeros((target,) + a.shape[1:], dtype=a.dtype)
+        pad[:e] = a
+        out.append(pad)
+    valid = np.zeros(target, dtype=np.float32)
+    valid[:e] = 1.0
+    return out, valid
+
+
+class Graph:
+    """Immutable property graph over the mesh (ref graphx/Graph.scala)."""
+
+    def __init__(self, ctx, src: np.ndarray, dst: np.ndarray,
+                 edge_attr: Optional[np.ndarray] = None,
+                 n_vertices: Optional[int] = None,
+                 vertex_ids: Optional[np.ndarray] = None):
+        import jax.numpy as jnp
+
+        self.ctx = ctx
+        rt = ctx.mesh_runtime
+        src = np.asarray(src, dtype=np.int32)
+        dst = np.asarray(dst, dtype=np.int32)
+        self.n_edges = int(src.shape[0])
+        if n_vertices is None:
+            n_vertices = int(max(src.max(initial=-1), dst.max(initial=-1)) + 1)
+        self.n_vertices = n_vertices
+        # external-id mapping (identity when built from dense indices)
+        self.vertex_ids = (np.arange(n_vertices, dtype=np.int64)
+                           if vertex_ids is None else np.asarray(vertex_ids))
+        if edge_attr is None:
+            edge_attr = np.ones(self.n_edges, dtype=np.float32)
+        edge_attr = np.asarray(edge_attr, dtype=np.float32)
+        # host copies for structural ops (reverse/subgraph re-shard from here)
+        self._h_src, self._h_dst, self._h_attr = src, dst, edge_attr
+        (src_p, dst_p, attr_p), valid = _pad_edges(
+            [src, dst, edge_attr], rt.data_parallelism)
+        self.src = rt.device_put_sharded_rows(src_p)
+        self.dst = rt.device_put_sharded_rows(dst_p)
+        self.edge_attr = rt.device_put_sharded_rows(attr_p)
+        self.valid = rt.device_put_sharded_rows(valid)
+        self._agg_cache: Dict = {}
+
+    # -- construction ----------------------------------------------------------
+    @classmethod
+    def from_edges(cls, ctx, edges: Sequence[Tuple[int, int]],
+                   edge_attr: Optional[np.ndarray] = None) -> "Graph":
+        """Build from (srcId, dstId) pairs with arbitrary int ids
+        (ref Graph.fromEdgeTuples)."""
+        arr = np.asarray(list(edges), dtype=np.int64)
+        if arr.size == 0:
+            raise ValueError("empty edge list")
+        ids = np.unique(arr)
+        remap = {v: i for i, v in enumerate(ids.tolist())}
+        src = np.array([remap[s] for s in arr[:, 0]], dtype=np.int32)
+        dst = np.array([remap[d] for d in arr[:, 1]], dtype=np.int32)
+        return cls(ctx, src, dst, edge_attr, n_vertices=len(ids), vertex_ids=ids)
+
+    # -- structural operators (host-side edge rewrites, ref Graph.scala) -------
+    def reverse(self) -> "Graph":
+        return Graph(self.ctx, self._h_dst, self._h_src, self._h_attr,
+                     self.n_vertices, self.vertex_ids)
+
+    def subgraph(self, edge_pred: Callable[[int, int, float], bool]) -> "Graph":
+        keep = np.array([edge_pred(int(s), int(d), float(a)) for s, d, a in
+                         zip(self._h_src, self._h_dst, self._h_attr)], dtype=bool)
+        return Graph(self.ctx, self._h_src[keep], self._h_dst[keep],
+                     self._h_attr[keep], self.n_vertices, self.vertex_ids)
+
+    def map_edges(self, f: Callable[[np.ndarray], np.ndarray]) -> "Graph":
+        return Graph(self.ctx, self._h_src, self._h_dst, f(self._h_attr),
+                     self.n_vertices, self.vertex_ids)
+
+    def undirected(self) -> "Graph":
+        """Symmetrize: add reversed edges, drop duplicates and self-loops."""
+        pairs = np.stack([np.concatenate([self._h_src, self._h_dst]),
+                          np.concatenate([self._h_dst, self._h_src])], axis=1)
+        attr = np.concatenate([self._h_attr, self._h_attr])
+        keep = pairs[:, 0] != pairs[:, 1]
+        pairs, attr = pairs[keep], attr[keep]
+        _, idx = np.unique(pairs, axis=0, return_index=True)
+        return Graph(self.ctx, pairs[idx, 0], pairs[idx, 1], attr[idx],
+                     self.n_vertices, self.vertex_ids)
+
+    # -- the core primitive ----------------------------------------------------
+    def message_program(self, to_dst: Optional[Callable] = None,
+                        to_src: Optional[Callable] = None,
+                        merge: str = "sum", n_extras: int = 0):
+        """Compile an aggregate-messages program (ref GraphX
+        ``aggregateMessages``; GraphImpl.scala:35 ships vertex attrs via
+        routing tables — here they're replicated and gathered locally).
+
+        ``to_dst``/``to_src``: ``fn(src_attr_e, dst_attr_e, edge_attr_e,
+        *extras) -> msgs`` computed per edge; messages are merged into a dense
+        ``(n_vertices, ...)`` array with ``merge`` ∈ {sum,min,max}. Returns a
+        jitted callable ``(vertex_attrs, *extras) -> merged``; vertices that
+        receive no message hold the merge identity (0 / +inf / −inf).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        rt = self.ctx.mesh_runtime
+        n = self.n_vertices
+        fill = {"sum": 0.0, "min": np.inf, "max": -np.inf}[merge]
+        seg = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}[merge]
+        xreduce = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                   "max": jax.lax.pmax}[merge]
+
+        def combine(a, b):
+            if merge == "sum":
+                return a + b
+            return jnp.minimum(a, b) if merge == "min" else jnp.maximum(a, b)
+
+        def local(src, dst, eattr, valid, vattr, *extras):
+            out = None
+            for fn, idx in ((to_dst, dst), (to_src, src)):
+                if fn is None:
+                    continue
+                msgs = fn(_gather(vattr, src), _gather(vattr, dst), eattr, *extras)
+                mask = valid.reshape((-1,) + (1,) * (msgs.ndim - 1)) > 0
+                msgs = jnp.where(mask, msgs, jnp.asarray(fill, msgs.dtype))
+                c = seg(msgs, idx, num_segments=n)
+                out = c if out is None else combine(out, c)
+            for ax in (DATA_AXIS, REPLICA_AXIS):
+                out = jax.tree_util.tree_map(lambda t: xreduce(t, ax), out)
+            return out
+
+        row = P((REPLICA_AXIS, DATA_AXIS))
+        f = shard_map_compat(local, rt.mesh,
+                             (row, row, row, row) + (P(),) * (1 + n_extras), P())
+        return jax.jit(lambda vattr, *ex: f(self.src, self.dst, self.edge_attr,
+                                            self.valid, vattr, *ex))
+
+    def aggregate_messages(self, vertex_attrs, to_dst=None, to_src=None,
+                           merge: str = "sum", extras: Tuple = ()):
+        """One-shot aggregate (compiles and runs; loops should use
+        :meth:`message_program` once and iterate)."""
+        prog = self.message_program(to_dst, to_src, merge, len(extras))
+        return prog(vertex_attrs, *extras)
+
+    # -- degrees (ref GraphOps.{in,out}Degrees) --------------------------------
+    def _degrees(self, to_dst, to_src) -> np.ndarray:
+        import jax.numpy as jnp
+        one = (lambda s, d, e: jnp.ones_like(e))
+        out = self.aggregate_messages(
+            jnp.zeros(self.n_vertices, dtype=np.float32),
+            to_dst=one if to_dst else None, to_src=one if to_src else None)
+        return np.asarray(out)
+
+    def in_degrees(self) -> np.ndarray:
+        return self._degrees(True, False)
+
+    def out_degrees(self) -> np.ndarray:
+        return self._degrees(False, True)
+
+    def degrees(self) -> np.ndarray:
+        return self._degrees(True, True)
+
+    # -- dense adjacency (for closure-based algorithms; MXU-friendly) ----------
+    def adjacency(self, symmetric: bool = False):
+        """Dense boolean adjacency as float32 device array. O(n²) memory — the
+        deliberate trade for algorithms that become pure matmuls on the MXU
+        (triangle counting, transitive closure); fine for n up to ~16k."""
+        import jax.numpy as jnp
+        a = np.zeros((self.n_vertices, self.n_vertices), dtype=np.float32)
+        a[self._h_src, self._h_dst] = 1.0
+        if symmetric:
+            a = np.maximum(a, a.T)
+        np.fill_diagonal(a, 0.0)
+        return jnp.asarray(a)
+
+
+def _gather(vattr, idx):
+    """Gather per-edge vertex attributes from replicated vertex state (pytree
+    of arrays with leading vertex dim)."""
+    import jax
+    return jax.tree_util.tree_map(lambda t: t[idx], vattr)

@@ -1,0 +1,231 @@
+"""Graph algorithm library.
+
+Re-designs of graphx/lib (ref: graphx/src/main/scala/org/apache/spark/graphx/
+lib/): PageRank, ConnectedComponents, StronglyConnectedComponents,
+LabelPropagation, ShortestPaths, TriangleCount, SVDPlusPlus. Each algorithm
+compiles its message program(s) once and iterates a host loop reading only a
+convergence scalar — the Pregel pattern without per-superstep RDD
+materialization. Closure-based algorithms (SCC, triangles) instead use the
+dense adjacency form: transitive closure and triangle counting are pure MXU
+matmul chains, which beats edge-iteration on TPU for graphs that fit O(n²)
+HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from cycloneml_tpu.graph.graph import Graph
+
+
+def pagerank(graph: Graph, num_iter: int = 20, reset_prob: float = 0.15,
+             tol: Optional[float] = None,
+             personalized_src: Optional[int] = None) -> np.ndarray:
+    """PageRank (ref lib/PageRank.scala — run/runUntilConvergence/
+    runWithOptions personalized). Returns per-vertex ranks (Spark semantics:
+    ranks sum ≈ n, each init 1.0; rank = resetProb + (1−resetProb)·Σ
+    incoming rank/outDegree)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = graph.n_vertices
+    out_deg = jnp.asarray(graph.out_degrees())
+    inv_deg = jnp.where(out_deg > 0, 1.0 / jnp.maximum(out_deg, 1.0), 0.0)
+    prog = graph.message_program(
+        to_dst=lambda sa, da, e: sa, merge="sum")
+
+    if personalized_src is None:
+        reset = jnp.full((n,), reset_prob, dtype=jnp.float32)
+    else:
+        reset = jnp.zeros((n,), dtype=jnp.float32).at[personalized_src].set(reset_prob)
+
+    ranks = jnp.ones((n,), dtype=jnp.float32)
+    for _ in range(num_iter):
+        contrib = prog(ranks * inv_deg)
+        new = reset + (1.0 - reset_prob) * contrib
+        if tol is not None and float(jnp.max(jnp.abs(new - ranks))) < tol:
+            ranks = new
+            break
+        ranks = new
+    return np.asarray(ranks)
+
+
+def connected_components(graph: Graph, max_iter: int = 100) -> np.ndarray:
+    """Connected components: each vertex labeled with the smallest vertex id
+    in its component, edges treated as undirected (ref
+    lib/ConnectedComponents.scala — Pregel with min-merge)."""
+    import jax.numpy as jnp
+
+    prog = graph.message_program(
+        to_dst=lambda sa, da, e: sa, to_src=lambda sa, da, e: da, merge="min")
+    labels = jnp.arange(graph.n_vertices, dtype=jnp.float32)
+    for _ in range(max_iter):
+        msg = prog(labels)
+        new = jnp.minimum(labels, msg)
+        if bool(jnp.all(new == labels)):
+            break
+        labels = new
+    return np.asarray(labels).astype(np.int64)
+
+
+def label_propagation(graph: Graph, max_iter: int = 5) -> np.ndarray:
+    """Community detection by label propagation (ref
+    lib/LabelPropagation.scala): each vertex adopts the most frequent label
+    among neighbors; ties break to the smallest label (deterministic, where
+    the reference's hashmap order is not). Dense (n_vertices)-wide histogram
+    messages — one segment-sum per superstep."""
+    import jax.numpy as jnp
+
+    n = graph.n_vertices
+    onehot = lambda lab: jnp.eye(n, dtype=jnp.float32)[lab.astype(jnp.int32)]
+    prog = graph.message_program(
+        to_dst=lambda sa, da, e: onehot(sa),
+        to_src=lambda sa, da, e: onehot(da), merge="sum")
+    labels = jnp.arange(n, dtype=jnp.float32)
+    for _ in range(max_iter):
+        counts = prog(labels)  # (n, n) label histogram per vertex
+        total = counts.sum(axis=1)
+        best = jnp.argmax(counts, axis=1).astype(jnp.float32)  # first max = min label
+        labels = jnp.where(total > 0, best, labels)
+    return np.asarray(labels).astype(np.int64)
+
+
+def shortest_paths(graph: Graph, landmarks: Sequence[int],
+                   max_iter: int = 0) -> np.ndarray:
+    """Hop-count shortest path distances to landmark vertices following edge
+    direction (ref lib/ShortestPaths.scala — messages flow dst→src with
+    incremented maps). Returns (n_vertices, n_landmarks); unreachable = inf."""
+    import jax.numpy as jnp
+
+    n = graph.n_vertices
+    lm = np.asarray(list(landmarks), dtype=np.int64)
+    dist = np.full((n, len(lm)), np.inf, dtype=np.float32)
+    dist[lm, np.arange(len(lm))] = 0.0
+    dist = jnp.asarray(dist)
+    prog = graph.message_program(
+        to_src=lambda sa, da, e: da + 1.0, merge="min")
+    for _ in range(max_iter or n):
+        new = jnp.minimum(dist, prog(dist))
+        if bool(jnp.all(new == dist)):
+            break
+        dist = new
+    return np.asarray(dist)
+
+
+def triangle_count(graph: Graph) -> np.ndarray:
+    """Per-vertex triangle counts (ref lib/TriangleCount.scala — the
+    reference canonicalizes then intersects neighbor sets per edge; on TPU
+    the count is diag(A³)/2 for the symmetrized loop-free adjacency: two
+    MXU matmuls)."""
+    import jax
+    import jax.numpy as jnp
+
+    a = graph.adjacency(symmetric=True)
+
+    @jax.jit
+    def tri(a):
+        return jnp.sum(jnp.dot(a, a, precision=jax.lax.Precision.HIGHEST) * a,
+                       axis=1) / 2.0
+
+    return np.asarray(tri(a)).astype(np.int64)
+
+
+def strongly_connected_components(graph: Graph) -> np.ndarray:
+    """SCC labels (smallest vertex id per component). The reference
+    (lib/StronglyConnectedComponents.scala) runs iterative trim + forward/
+    backward Pregel coloring; the TPU form computes the boolean transitive
+    closure by log₂(n) squarings of (I ∨ A) — matmul chains on the MXU —
+    then labels v with min{j : v⇝j ∧ j⇝v}."""
+    import jax
+    import jax.numpy as jnp
+
+    n = graph.n_vertices
+    a = np.zeros((n, n), dtype=np.float32)
+    a[graph._h_src, graph._h_dst] = 1.0
+    np.fill_diagonal(a, 1.0)
+
+    @jax.jit
+    def square(r):
+        rr = jnp.dot(r, r, precision=jax.lax.Precision.HIGHEST)
+        return jnp.minimum(rr + r, 1.0) > 0
+
+    r = jnp.asarray(a) > 0
+    steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    for _ in range(steps):
+        r = square(r.astype(jnp.float32))
+    m = jnp.logical_and(r, r.T)
+    labels = jnp.argmax(m, axis=1)  # first True = smallest mutual-reach id
+    return np.asarray(labels).astype(np.int64)
+
+
+def svd_plus_plus(graph: Graph, rank: int = 8, max_iter: int = 10,
+                  min_val: float = 0.0, max_val: float = 5.0,
+                  gamma1: float = 0.007, gamma2: float = 0.007,
+                  gamma6: float = 0.005, gamma7: float = 0.015,
+                  seed: int = 0) -> Dict[str, np.ndarray]:
+    """SVD++ collaborative filtering on a bipartite rating graph
+    (ref lib/SVDPlusPlus.scala; Koren KDD'08). Edges are (user → item) with
+    rating attrs. The reference does per-edge stochastic updates inside
+    Pregel supersteps; here each epoch is a *batch* gradient step built from
+    four message programs (neighbor-factor sums, error back-propagation to
+    p/q/y and biases) — deterministic and MXU-batched. Returns factors,
+    biases, mean and final training RMSE."""
+    import jax
+    import jax.numpy as jnp
+
+    n = graph.n_vertices
+    rng = np.random.RandomState(seed)
+    mu = float(np.average(graph._h_attr))
+    out_deg = graph.out_degrees()
+    norm_u = np.where(out_deg > 0, 1.0 / np.sqrt(np.maximum(out_deg, 1.0)), 0.0)
+
+    # neighbor y-sum per user: Σ_{j∈N(u)} y_j
+    nsum_prog = graph.message_program(
+        to_src=lambda sa, da, e: da, merge="sum")
+
+    def _err(sa, da, e):
+        pe, q, b = sa["pe"], da["q"], sa["b"] + da["b"]
+        pred = mu + b + jnp.sum(pe * q, axis=1)
+        pred = jnp.clip(pred, min_val, max_val)
+        return e - pred
+
+    grad_q = graph.message_program(
+        to_dst=lambda sa, da, e: _err(sa, da, e)[:, None] * sa["pe"], merge="sum")
+    grad_p = graph.message_program(
+        to_src=lambda sa, da, e: _err(sa, da, e)[:, None] * da["q"], merge="sum")
+    grad_b_u = graph.message_program(to_src=lambda sa, da, e: _err(sa, da, e),
+                                     merge="sum")
+    grad_b_i = graph.message_program(to_dst=lambda sa, da, e: _err(sa, da, e),
+                                     merge="sum")
+    # y gradient: for each edge (u,j), y_j += norm_u * acc_u where
+    # acc_u = Σ_i err(u,i)·q_i (== the p-gradient message)
+    grad_y = graph.message_program(
+        to_dst=lambda sa, da, e: sa["acc"] * sa["nrm"][:, None], merge="sum")
+    sq_err = graph.message_program(
+        to_src=lambda sa, da, e: _err(sa, da, e) ** 2, merge="sum")
+
+    p = jnp.asarray(rng.randn(n, rank).astype(np.float32) * 0.1)
+    q = jnp.asarray(rng.randn(n, rank).astype(np.float32) * 0.1)
+    y = jnp.asarray(rng.randn(n, rank).astype(np.float32) * 0.1)
+    b = jnp.zeros((n,), dtype=jnp.float32)
+    nrm = jnp.asarray(norm_u.astype(np.float32))
+
+    for _ in range(max_iter):
+        nsum = nsum_prog(y)
+        pe = p + nrm[:, None] * nsum
+        state = {"pe": pe, "q": q, "b": b}
+        acc = grad_p(state)
+        p = p + gamma2 * (acc - gamma7 * p)
+        q = q + gamma2 * (grad_q(state) - gamma7 * q)
+        y = y + gamma2 * (grad_y({"pe": pe, "q": q, "b": b, "acc": acc,
+                                  "nrm": nrm}) - gamma7 * y)
+        b = b + gamma1 * ((grad_b_u(state) + grad_b_i(state)) - gamma6 * b)
+
+    nsum = nsum_prog(y)
+    pe = p + nrm[:, None] * nsum
+    total_sq = float(jnp.sum(sq_err({"pe": pe, "q": q, "b": b})))
+    rmse = float(np.sqrt(total_sq / max(graph.n_edges, 1)))
+    return {"p": np.asarray(p), "q": np.asarray(q), "y": np.asarray(y),
+            "bias": np.asarray(b), "mean": mu, "rmse": rmse}

@@ -1,0 +1,90 @@
+"""Pregel BSP loop.
+
+Re-design of GraphX's Pregel (ref: graphx/.../Pregel.scala:59, loop at :115).
+The reference iterates: aggregateMessages → joinVertices(vprog) → next active
+set, materializing a new message RDD per superstep. Here each superstep is
+two compiled shard_map programs (message merge + receipt counts) and a jitted
+vertex program; the host loop only reads one scalar (number of active
+vertices) per superstep — the same role DAGScheduler's per-iteration job
+played, at per-step instead of per-task granularity.
+
+Semantics preserved: initial message delivered to every vertex; a vertex runs
+``vprog`` only when it received a message; only vertices that received a
+message in superstep t send in t+1; termination when no messages remain or
+``max_iter`` is hit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def pregel(graph, vertex_attrs, initial_msg, vprog: Callable,
+           send_to_dst: Optional[Callable] = None,
+           send_to_src: Optional[Callable] = None,
+           merge: str = "sum", max_iter: int = 20):
+    """Run Pregel; returns final vertex attrs (device array / pytree).
+
+    - ``vprog(attr, msg, has_msg) -> attr`` — vectorized over all vertices;
+      applied only where ``has_msg`` (masking handled here).
+    - ``send_*(src_attr, dst_attr, edge_attr, src_active, dst_active) ->
+      (msgs, send_mask)`` — per-edge; masked sends get the merge identity.
+    - ``merge`` ∈ {sum, min, max}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fill = {"sum": 0.0, "min": np.inf, "max": -np.inf}[merge]
+
+    def _wrap(user_fn):
+        if user_fn is None:
+            return None
+
+        def fn(sa, da, e):
+            (s_attr, s_act), (d_attr, d_act) = sa, da
+            msgs, mask = user_fn(s_attr, d_attr, e, s_act, d_act)
+            m = mask.reshape(mask.shape + (1,) * (msgs.ndim - mask.ndim))
+            return jnp.where(m > 0, msgs, jnp.asarray(fill, msgs.dtype))
+        return fn
+
+    def _cnt(user_fn):
+        if user_fn is None:
+            return None
+
+        def fn(sa, da, e):
+            (s_attr, s_act), (d_attr, d_act) = sa, da
+            _, mask = user_fn(s_attr, d_attr, e, s_act, d_act)
+            return mask.astype(jnp.float32)
+        return fn
+
+    msg_prog = graph.message_program(_wrap(send_to_dst), _wrap(send_to_src), merge)
+    cnt_prog = graph.message_program(_cnt(send_to_dst), _cnt(send_to_src), "sum")
+
+    @jax.jit
+    def apply_vprog(attrs, msgs, has):
+        new = vprog(attrs, msgs, has)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(
+                has.reshape(has.shape + (1,) * (a.ndim - has.ndim)), b, a),
+            attrs, new)
+
+    n = graph.n_vertices
+    attrs = jax.tree_util.tree_map(jnp.asarray, vertex_attrs)
+    # superstep 0: everyone gets the initial message
+    init = jnp.broadcast_to(jnp.asarray(initial_msg),
+                            (n,) + np.shape(np.asarray(initial_msg)))
+    attrs = apply_vprog(attrs, init, jnp.ones(n, dtype=bool))
+    active = jnp.ones(n, dtype=jnp.float32)
+
+    for _ in range(max_iter):
+        state = (attrs, active)
+        counts = cnt_prog(state)
+        has = counts > 0
+        if not bool(jnp.any(has)):
+            break
+        msgs = msg_prog(state)
+        attrs = apply_vprog(attrs, msgs, has)
+        active = has.astype(jnp.float32)
+    return attrs
