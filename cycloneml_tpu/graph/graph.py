@@ -23,7 +23,7 @@ int64 ids and keeps the mapping for user-facing results.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -56,8 +56,6 @@ class Graph:
                  edge_attr: Optional[np.ndarray] = None,
                  n_vertices: Optional[int] = None,
                  vertex_ids: Optional[np.ndarray] = None):
-        import jax.numpy as jnp
-
         self.ctx = ctx
         rt = ctx.mesh_runtime
         src = np.asarray(src, dtype=np.int32)
@@ -80,7 +78,6 @@ class Graph:
         self.dst = rt.device_put_sharded_rows(dst_p)
         self.edge_attr = rt.device_put_sharded_rows(attr_p)
         self.valid = rt.device_put_sharded_rows(valid)
-        self._agg_cache: Dict = {}
 
     # -- construction ----------------------------------------------------------
     @classmethod
@@ -143,7 +140,6 @@ class Graph:
 
         rt = self.ctx.mesh_runtime
         n = self.n_vertices
-        fill = {"sum": 0.0, "min": np.inf, "max": -np.inf}[merge]
         seg = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
                "max": jax.ops.segment_max}[merge]
         xreduce = {"sum": jax.lax.psum, "min": jax.lax.pmin,
@@ -161,7 +157,7 @@ class Graph:
                     continue
                 msgs = fn(_gather(vattr, src), _gather(vattr, dst), eattr, *extras)
                 mask = valid.reshape((-1,) + (1,) * (msgs.ndim - 1)) > 0
-                msgs = jnp.where(mask, msgs, jnp.asarray(fill, msgs.dtype))
+                msgs = jnp.where(mask, msgs, merge_identity(msgs.dtype, merge))
                 c = seg(msgs, idx, num_segments=n)
                 out = c if out is None else combine(out, c)
             for ax in (DATA_AXIS, REPLICA_AXIS):
@@ -211,6 +207,19 @@ class Graph:
             a = np.maximum(a, a.T)
         np.fill_diagonal(a, 0.0)
         return jnp.asarray(a)
+
+
+def merge_identity(dtype, merge: str):
+    """The merge op's identity element in the message dtype — integer label
+    dtypes get iinfo bounds so vertex ids above 2^24 stay exact (float32
+    labels would collapse distinct large ids)."""
+    import jax.numpy as jnp
+    if merge == "sum":
+        return jnp.asarray(0, dtype)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if merge == "min" else info.min, dtype)
+    return jnp.asarray(np.inf if merge == "min" else -np.inf, dtype)
 
 
 def _gather(vattr, idx):

@@ -60,7 +60,7 @@ def connected_components(graph: Graph, max_iter: int = 100) -> np.ndarray:
 
     prog = graph.message_program(
         to_dst=lambda sa, da, e: sa, to_src=lambda sa, da, e: da, merge="min")
-    labels = jnp.arange(graph.n_vertices, dtype=jnp.float32)
+    labels = jnp.arange(graph.n_vertices, dtype=jnp.int32)
     for _ in range(max_iter):
         msg = prog(labels)
         new = jnp.minimum(labels, msg)
@@ -76,18 +76,19 @@ def label_propagation(graph: Graph, max_iter: int = 5) -> np.ndarray:
     among neighbors; ties break to the smallest label (deterministic, where
     the reference's hashmap order is not). Dense (n_vertices)-wide histogram
     messages — one segment-sum per superstep."""
+    import jax
     import jax.numpy as jnp
 
     n = graph.n_vertices
-    onehot = lambda lab: jnp.eye(n, dtype=jnp.float32)[lab.astype(jnp.int32)]
+    onehot = lambda lab: jax.nn.one_hot(lab, n, dtype=jnp.float32)
     prog = graph.message_program(
         to_dst=lambda sa, da, e: onehot(sa),
         to_src=lambda sa, da, e: onehot(da), merge="sum")
-    labels = jnp.arange(n, dtype=jnp.float32)
+    labels = jnp.arange(n, dtype=jnp.int32)
     for _ in range(max_iter):
         counts = prog(labels)  # (n, n) label histogram per vertex
         total = counts.sum(axis=1)
-        best = jnp.argmax(counts, axis=1).astype(jnp.float32)  # first max = min label
+        best = jnp.argmax(counts, axis=1).astype(jnp.int32)  # first max = min label
         labels = jnp.where(total > 0, best, labels)
     return np.asarray(labels).astype(np.int64)
 

@@ -3,10 +3,10 @@
 Re-design of GraphX's Pregel (ref: graphx/.../Pregel.scala:59, loop at :115).
 The reference iterates: aggregateMessages → joinVertices(vprog) → next active
 set, materializing a new message RDD per superstep. Here each superstep is
-two compiled shard_map programs (message merge + receipt counts) and a jitted
-vertex program; the host loop only reads one scalar (number of active
-vertices) per superstep — the same role DAGScheduler's per-iteration job
-played, at per-step instead of per-task granularity.
+ONE compiled shard_map edge pass (message merge; for sum-merge a receipt
+count rides along as an extra channel, for min/max-merge receipt is detected
+against the merge identity) plus a jitted vertex program; the host loop reads
+one scalar per superstep.
 
 Semantics preserved: initial message delivered to every vertex; a vertex runs
 ``vprog`` only when it received a message; only vertices that received a
@@ -20,6 +20,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from cycloneml_tpu.graph.graph import merge_identity
+
 
 def pregel(graph, vertex_attrs, initial_msg, vprog: Callable,
            send_to_dst: Optional[Callable] = None,
@@ -31,12 +33,17 @@ def pregel(graph, vertex_attrs, initial_msg, vprog: Callable,
       applied only where ``has_msg`` (masking handled here).
     - ``send_*(src_attr, dst_attr, edge_attr, src_active, dst_active) ->
       (msgs, send_mask)`` — per-edge; masked sends get the merge identity.
+      (min/max caveat: a deliberately-sent message exactly equal to the merge
+      identity is indistinguishable from no message.)
     - ``merge`` ∈ {sum, min, max}.
     """
     import jax
     import jax.numpy as jnp
 
-    fill = {"sum": 0.0, "min": np.inf, "max": -np.inf}[merge]
+    if send_to_dst is None and send_to_src is None:
+        raise ValueError("need at least one send function")
+
+    shape_box = []  # trailing message shape, captured at trace time
 
     def _wrap(user_fn):
         if user_fn is None:
@@ -45,22 +52,20 @@ def pregel(graph, vertex_attrs, initial_msg, vprog: Callable,
         def fn(sa, da, e):
             (s_attr, s_act), (d_attr, d_act) = sa, da
             msgs, mask = user_fn(s_attr, d_attr, e, s_act, d_act)
+            if not shape_box:
+                shape_box.append(msgs.shape[1:])
+            ident = merge_identity(msgs.dtype, merge)
             m = mask.reshape(mask.shape + (1,) * (msgs.ndim - mask.ndim))
-            return jnp.where(m > 0, msgs, jnp.asarray(fill, msgs.dtype))
+            masked = jnp.where(m > 0, msgs, ident)
+            if merge != "sum":
+                return masked
+            # receipt count rides as an extra channel: one edge pass total
+            flat = masked.reshape((masked.shape[0], -1))
+            cnt = (mask > 0).astype(flat.dtype)[:, None]
+            return jnp.concatenate([flat, cnt], axis=1)
         return fn
 
-    def _cnt(user_fn):
-        if user_fn is None:
-            return None
-
-        def fn(sa, da, e):
-            (s_attr, s_act), (d_attr, d_act) = sa, da
-            _, mask = user_fn(s_attr, d_attr, e, s_act, d_act)
-            return mask.astype(jnp.float32)
-        return fn
-
-    msg_prog = graph.message_program(_wrap(send_to_dst), _wrap(send_to_src), merge)
-    cnt_prog = graph.message_program(_cnt(send_to_dst), _cnt(send_to_src), "sum")
+    prog = graph.message_program(_wrap(send_to_dst), _wrap(send_to_src), merge)
 
     @jax.jit
     def apply_vprog(attrs, msgs, has):
@@ -79,12 +84,16 @@ def pregel(graph, vertex_attrs, initial_msg, vprog: Callable,
     active = jnp.ones(n, dtype=jnp.float32)
 
     for _ in range(max_iter):
-        state = (attrs, active)
-        counts = cnt_prog(state)
-        has = counts > 0
+        merged = prog((attrs, active))
+        if merge == "sum":
+            has = merged[:, -1] > 0
+            msgs = merged[:, :-1].reshape((n,) + shape_box[0])
+        else:
+            cmp = merged != merge_identity(merged.dtype, merge)
+            has = cmp.reshape(n, -1).any(axis=1)
+            msgs = merged
         if not bool(jnp.any(has)):
             break
-        msgs = msg_prog(state)
         attrs = apply_vprog(attrs, msgs, has)
         active = has.astype(jnp.float32)
     return attrs

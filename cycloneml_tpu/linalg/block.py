@@ -1,0 +1,283 @@
+"""2-D distributed matrices: BlockMatrix / CoordinateMatrix / IndexedRowMatrix.
+
+Re-design of ``mllib/linalg/distributed`` (ref: BlockMatrix.scala,
+CoordinateMatrix.scala, IndexedRowMatrix.scala). The reference's BlockMatrix
+is an RDD of ((blockRow, blockCol) → Matrix) with a GridPartitioner, and
+multiply is a hand-built block-join + shuffle + per-block gemm + reduce. On
+TPU none of that machinery is needed: a BlockMatrix is **one dense device
+array with a 2-D NamedSharding** — rows over the (replica, data) mesh axes,
+columns over the model axis. ``multiply`` is a single sharded ``jnp.dot``:
+XLA inserts the all-gathers/reduce-scatters that the reference's
+simulateMultiply/cogroup pipeline (BlockMatrix.scala:477) does by hand, and
+the per-block gemms land on the MXU. "Blocks" (rowsPerBlock × colsPerBlock)
+are exactly the per-device shards.
+
+CoordinateMatrix keeps host COO entries (the ingest form) and converts;
+IndexedRowMatrix pairs an int64 row-index vector with row-sharded data.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.linalg.distributed import RowMatrix
+from cycloneml_tpu.linalg.matrices import DenseMatrix
+from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS
+
+
+def _grid_sharding(rt):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(rt.mesh, P((REPLICA_AXIS, DATA_AXIS), MODEL_AXIS))
+
+
+def _pad_to(arr: np.ndarray, rm: int, cm: int) -> np.ndarray:
+    m = ((arr.shape[0] + rm - 1) // rm) * rm
+    n = ((arr.shape[1] + cm - 1) // cm) * cm
+    if (m, n) == arr.shape:
+        return arr
+    out = np.zeros((m, n), dtype=arr.dtype)
+    out[: arr.shape[0], : arr.shape[1]] = arr
+    return out
+
+
+class BlockMatrix:
+    """Grid-sharded dense distributed matrix (ref BlockMatrix.scala:132)."""
+
+    def __init__(self, ctx, arr, num_rows: int, num_cols: int):
+        self.ctx = ctx
+        self._arr = arr  # (m_pad, n_pad) device array, 2-D sharded
+        self._num_rows = num_rows
+        self._num_cols = num_cols
+
+    # -- construction ----------------------------------------------------------
+    @classmethod
+    def from_numpy(cls, ctx, a: np.ndarray, dtype=None) -> "BlockMatrix":
+        import jax
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        rt = ctx.mesh_runtime
+        dtype = dtype or compute_dtype()
+        rows_mult = rt.data_parallelism * 8
+        cols_mult = rt.mesh.devices.shape[2] * 8
+        pad = _pad_to(np.asarray(a, dtype=dtype), rows_mult, cols_mult)
+        arr = jax.device_put(pad, _grid_sharding(rt))
+        return cls(ctx, arr, a.shape[0], a.shape[1])
+
+    @property
+    def rows_per_block(self) -> int:
+        """Per-device shard height — the physical block size (metadata parity
+        with ref rowsPerBlock)."""
+        return self._arr.shape[0] // self.ctx.mesh_runtime.data_parallelism
+
+    @property
+    def cols_per_block(self) -> int:
+        return self._arr.shape[1] // self.ctx.mesh_runtime.mesh.devices.shape[2]
+
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def num_cols(self) -> int:
+        return self._num_cols
+
+    def validate(self) -> None:
+        """(ref validate:199) — shape/sharding invariants."""
+        assert self._arr.shape[0] >= self._num_rows
+        assert self._arr.shape[1] >= self._num_cols
+
+    # -- algebra (each one sharded jit program; XLA plans the collectives) -----
+    def _ewise(self, other: "BlockMatrix", op) -> "BlockMatrix":
+        import jax
+        if (self._num_rows, self._num_cols) != (other._num_rows, other._num_cols):
+            raise ValueError("dimension mismatch")
+        # physical pads can differ between construction paths (from_numpy
+        # pads to mesh multiples; transpose/multiply outputs keep theirs) —
+        # align to the common physical shape before the elementwise op
+        m = max(self._arr.shape[0], other._arr.shape[0])
+        n = max(self._arr.shape[1], other._arr.shape[1])
+        a = _pad_device_rows(_pad_device_cols(self._arr, n), m)
+        b = _pad_device_rows(_pad_device_cols(other._arr, n), m)
+        out = jax.jit(op)(a, b)
+        return BlockMatrix(self.ctx, out, self._num_rows, self._num_cols)
+
+    def add(self, other: "BlockMatrix") -> "BlockMatrix":
+        return self._ewise(other, lambda a, b: a + b)
+
+    def subtract(self, other: "BlockMatrix") -> "BlockMatrix":
+        return self._ewise(other, lambda a, b: a - b)
+
+    def scale(self, alpha: float) -> "BlockMatrix":
+        import jax
+        return BlockMatrix(self.ctx, jax.jit(lambda a: a * alpha)(self._arr),
+                           self._num_rows, self._num_cols)
+
+    def multiply(self, other: "BlockMatrix") -> "BlockMatrix":
+        """A @ B as one sharded matmul (replaces simulateMultiply + shuffle,
+        ref BlockMatrix.scala:477)."""
+        import jax
+        import jax.numpy as jnp
+        if self._num_cols != other._num_rows:
+            raise ValueError(
+                f"A.cols({self._num_cols}) != B.rows({other._num_rows})")
+        rt = self.ctx.mesh_runtime
+        k = max(self._arr.shape[1], other._arr.shape[0])
+        a = _pad_device_cols(self._arr, k)
+        b = _pad_device_rows(other._arr, k)
+        out_sh = _grid_sharding(rt)
+        f = jax.jit(lambda x, y: jax.lax.with_sharding_constraint(
+            jnp.dot(x, y, precision=jax.lax.Precision.HIGHEST), out_sh))
+        return BlockMatrix(self.ctx, f(a, b), self._num_rows, other._num_cols)
+
+    def transpose(self) -> "BlockMatrix":
+        import jax
+        rt = self.ctx.mesh_runtime
+        out_sh = _grid_sharding(rt)
+        f = jax.jit(lambda x: jax.lax.with_sharding_constraint(x.T, out_sh))
+        return BlockMatrix(self.ctx, f(self._arr), self._num_cols, self._num_rows)
+
+    # -- conversions -----------------------------------------------------------
+    def to_local_matrix(self) -> DenseMatrix:
+        a = np.asarray(self._arr)[: self._num_rows, : self._num_cols]
+        return DenseMatrix.from_array(np.asarray(a, dtype=np.float64))
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self._arr)[: self._num_rows, : self._num_cols]
+
+    def to_indexed_row_matrix(self) -> "IndexedRowMatrix":
+        return IndexedRowMatrix.from_numpy(
+            self.ctx, np.arange(self._num_rows, dtype=np.int64), self.to_numpy())
+
+    def to_coordinate_matrix(self) -> "CoordinateMatrix":
+        a = self.to_numpy()
+        i, j = np.nonzero(a)
+        return CoordinateMatrix(self.ctx, i.astype(np.int64), j.astype(np.int64),
+                                a[i, j], self._num_rows, self._num_cols)
+
+
+def _pad_device_cols(arr, k: int):
+    import jax.numpy as jnp
+    if arr.shape[1] == k:
+        return arr
+    return jnp.pad(arr, ((0, 0), (0, k - arr.shape[1])))
+
+
+def _pad_device_rows(arr, k: int):
+    import jax.numpy as jnp
+    if arr.shape[0] == k:
+        return arr
+    return jnp.pad(arr, ((0, k - arr.shape[0]), (0, 0)))
+
+
+class MatrixEntry(NamedTuple):
+    i: int
+    j: int
+    value: float
+
+
+class CoordinateMatrix:
+    """COO-form distributed matrix (ref CoordinateMatrix.scala:52) — the
+    ingest format for very sparse data; converts to the dense sharded forms
+    for compute (XLA needs static dense shapes; SURVEY §7 sparse note)."""
+
+    def __init__(self, ctx, rows: np.ndarray, cols: np.ndarray,
+                 values: np.ndarray, num_rows: Optional[int] = None,
+                 num_cols: Optional[int] = None):
+        self.ctx = ctx
+        self.rows = np.asarray(rows, dtype=np.int64)
+        self.cols = np.asarray(cols, dtype=np.int64)
+        self.values = np.asarray(values, dtype=np.float64)
+        self._num_rows = int(num_rows if num_rows is not None
+                             else (self.rows.max(initial=-1) + 1))
+        self._num_cols = int(num_cols if num_cols is not None
+                             else (self.cols.max(initial=-1) + 1))
+
+    @classmethod
+    def from_entries(cls, ctx, entries, num_rows=None, num_cols=None):
+        e = [(int(i), int(j), float(v)) for i, j, v in entries]
+        return cls(ctx, np.array([x[0] for x in e]), np.array([x[1] for x in e]),
+                   np.array([x[2] for x in e]), num_rows, num_cols)
+
+    def entries(self):
+        return [MatrixEntry(int(i), int(j), float(v))
+                for i, j, v in zip(self.rows, self.cols, self.values)]
+
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def num_cols(self) -> int:
+        return self._num_cols
+
+    def transpose(self) -> "CoordinateMatrix":
+        return CoordinateMatrix(self.ctx, self.cols, self.rows, self.values,
+                                self._num_cols, self._num_rows)
+
+    def to_numpy(self) -> np.ndarray:
+        a = np.zeros((self._num_rows, self._num_cols))
+        np.add.at(a, (self.rows, self.cols), self.values)
+        return a
+
+    def to_block_matrix(self) -> BlockMatrix:
+        return BlockMatrix.from_numpy(self.ctx, self.to_numpy())
+
+    def to_indexed_row_matrix(self) -> "IndexedRowMatrix":
+        return IndexedRowMatrix.from_numpy(
+            self.ctx, np.arange(self._num_rows, dtype=np.int64), self.to_numpy())
+
+    def to_row_matrix(self) -> RowMatrix:
+        return RowMatrix.from_numpy(self.ctx, self.to_numpy())
+
+
+class IndexedRowMatrix:
+    """Row-indexed distributed matrix (ref IndexedRowMatrix.scala:45):
+    a RowMatrix whose rows carry meaningful int64 indices."""
+
+    def __init__(self, ctx, indices: np.ndarray, row_matrix: RowMatrix,
+                 num_rows: Optional[int] = None):
+        self.ctx = ctx
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.row_matrix = row_matrix
+        self._num_rows = int(num_rows if num_rows is not None
+                             else (self.indices.max(initial=-1) + 1))
+
+    @classmethod
+    def from_numpy(cls, ctx, indices: np.ndarray, x: np.ndarray,
+                   num_rows: Optional[int] = None) -> "IndexedRowMatrix":
+        return cls(ctx, indices, RowMatrix.from_numpy(ctx, x), num_rows)
+
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def num_cols(self) -> int:
+        return self.row_matrix.num_cols()
+
+    def compute_gramian_matrix(self) -> DenseMatrix:
+        return self.row_matrix.compute_gramian()
+
+    def compute_svd(self, k: int, compute_u: bool = False, **kw):
+        return self.row_matrix.compute_svd(k, compute_u=compute_u, **kw)
+
+    def multiply(self, b) -> "IndexedRowMatrix":
+        return IndexedRowMatrix(self.ctx, self.indices,
+                                self.row_matrix.multiply(b), self._num_rows)
+
+    def column_similarities(self) -> DenseMatrix:
+        return self.row_matrix.column_similarities()
+
+    def to_row_matrix(self) -> RowMatrix:
+        return self.row_matrix
+
+    def to_numpy(self) -> np.ndarray:
+        """Dense (num_rows, num_cols) with rows placed at their indices."""
+        stored = self.row_matrix.to_numpy()
+        out = np.zeros((self._num_rows, stored.shape[1]), dtype=stored.dtype)
+        out[self.indices] = stored
+        return out
+
+    def to_block_matrix(self) -> BlockMatrix:
+        return BlockMatrix.from_numpy(self.ctx, self.to_numpy())
+
+    def to_coordinate_matrix(self) -> CoordinateMatrix:
+        a = self.to_numpy()
+        i, j = np.nonzero(a)
+        return CoordinateMatrix(self.ctx, i, j, a[i, j],
+                                self._num_rows, a.shape[1])

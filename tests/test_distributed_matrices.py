@@ -1,0 +1,116 @@
+"""BlockMatrix/CoordinateMatrix/IndexedRowMatrix + random generators
+(ref: mllib/.../linalg/distributed/BlockMatrixSuite.scala etc.,
+mllib/random/RandomRDDsSuite.scala)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.random import RandomDatasets
+from cycloneml_tpu.linalg.block import (BlockMatrix, CoordinateMatrix,
+                                        IndexedRowMatrix)
+
+
+@pytest.fixture(scope="module")
+def ab(ctx):
+    rng = np.random.RandomState(0)
+    a = rng.randn(30, 17)
+    b = rng.randn(17, 11)
+    return (a, b, BlockMatrix.from_numpy(ctx, a), BlockMatrix.from_numpy(ctx, b))
+
+
+def test_block_matrix_roundtrip(ctx, ab):
+    a, _, bm, _ = ab
+    assert bm.num_rows() == 30 and bm.num_cols() == 17
+    bm.validate()
+    assert np.allclose(bm.to_numpy(), a)
+    assert np.allclose(bm.to_local_matrix().to_array(), a)
+
+
+def test_block_matrix_multiply(ctx, ab):
+    a, b, bma, bmb = ab
+    c = bma.multiply(bmb)
+    assert c.num_rows() == 30 and c.num_cols() == 11
+    assert np.allclose(c.to_numpy(), a @ b, atol=1e-8)
+
+
+def test_block_matrix_add_scale_transpose(ctx, ab):
+    a, _, bma, _ = ab
+    s = bma.add(bma).subtract(bma.scale(0.5))
+    assert np.allclose(s.to_numpy(), 1.5 * a)
+    t = bma.transpose()
+    assert t.num_rows() == 17 and np.allclose(t.to_numpy(), a.T)
+    # (AᵀA) via the sharded path
+    g = t.multiply(bma)
+    assert np.allclose(g.to_numpy(), a.T @ a, atol=1e-8)
+
+
+def test_block_matrix_mixed_padding_paths(ctx, ab):
+    # transpose() output has different physical padding than from_numpy();
+    # elementwise ops must align the pads (regression)
+    a, _, bma, _ = ab
+    other = BlockMatrix.from_numpy(ctx, a.T)
+    s = bma.transpose().add(other)
+    assert np.allclose(s.to_numpy(), 2.0 * a.T)
+
+
+def test_block_matrix_conversions(ctx, ab):
+    a, _, bma, _ = ab
+    irm = bma.to_indexed_row_matrix()
+    assert np.allclose(irm.to_numpy(), a)
+    cm = bma.to_coordinate_matrix()
+    assert np.allclose(cm.to_numpy(), a)
+
+
+def test_coordinate_matrix(ctx):
+    cm = CoordinateMatrix.from_entries(
+        ctx, [(0, 0, 1.0), (1, 2, 3.0), (4, 1, -2.0)])
+    assert cm.num_rows() == 5 and cm.num_cols() == 3
+    t = cm.transpose()
+    assert t.num_rows() == 3 and np.allclose(t.to_numpy(), cm.to_numpy().T)
+    assert np.allclose(cm.to_block_matrix().to_numpy(), cm.to_numpy())
+    es = cm.entries()
+    assert (es[1].i, es[1].j, es[1].value) == (1, 2, 3.0)
+
+
+def test_indexed_row_matrix(ctx):
+    rng = np.random.RandomState(1)
+    x = rng.randn(20, 6)
+    idx = np.arange(20, dtype=np.int64)[::-1].copy()
+    irm = IndexedRowMatrix.from_numpy(ctx, idx, x)
+    assert irm.num_rows() == 20 and irm.num_cols() == 6
+    assert np.allclose(irm.compute_gramian_matrix().to_array(), x.T @ x, atol=1e-8)
+    dense = irm.to_numpy()
+    assert np.allclose(dense[idx], x)
+    svd = irm.compute_svd(3)
+    ref = np.linalg.svd(x, compute_uv=False)
+    assert np.allclose(np.asarray(svd.s.to_array()), ref[:3], atol=1e-6)
+
+
+def test_random_normal_moments(ctx):
+    ds = RandomDatasets.normal(ctx, 40_000, 4, seed=7, mean=2.0, std=3.0)
+    x, _, w = ds.to_numpy()
+    assert ds.n_rows == 40_000 and x.shape == (40_000, 4)
+    assert np.all(w == 1.0)
+    assert abs(x.mean() - 2.0) < 0.1 and abs(x.std() - 3.0) < 0.1
+
+
+def test_random_determinism_and_shard_independence(ctx):
+    a = RandomDatasets.uniform(ctx, 1000, 2, seed=5)
+    b = RandomDatasets.uniform(ctx, 1000, 2, seed=5)
+    c = RandomDatasets.uniform(ctx, 1000, 2, seed=6)
+    assert np.array_equal(a.to_numpy()[0], b.to_numpy()[0])
+    assert not np.array_equal(a.to_numpy()[0], c.to_numpy()[0])
+    # different shards produced different streams
+    xa = a.to_numpy()[0]
+    assert len(np.unique(np.round(xa[:, 0], 6))) > 900
+
+
+def test_random_families(ctx):
+    p = RandomDatasets.poisson(ctx, 20_000, seed=1, lam=4.0).to_numpy()[0]
+    assert abs(p.mean() - 4.0) < 0.15
+    e = RandomDatasets.exponential(ctx, 20_000, seed=2, mean=2.5).to_numpy()[0]
+    assert abs(e.mean() - 2.5) < 0.15
+    g = RandomDatasets.gamma(ctx, 20_000, seed=3, shape=2.0, scale=1.5).to_numpy()[0]
+    assert abs(g.mean() - 3.0) < 0.2
+    ln = RandomDatasets.log_normal(ctx, 20_000, seed=4).to_numpy()[0]
+    assert abs(ln.mean() - np.exp(0.5)) < 0.2
