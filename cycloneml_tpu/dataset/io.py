@@ -16,7 +16,17 @@ from cycloneml_tpu.dataset.dataset import InstanceDataset
 
 
 def parse_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
-    """Parse a libsvm file to dense (X, y). Indices are 1-based on disk."""
+    """Parse a libsvm file to dense (X, y). Indices are 1-based on disk.
+
+    Fast path: the multithreaded C++ parser (native/host.py); this Python
+    loop is the fallback when the toolchain is unavailable."""
+    try:
+        from cycloneml_tpu.native.host import parse_libsvm_native
+        got = parse_libsvm_native(path, n_features)
+        if got is not None:
+            return np.asarray(got[0], dtype=np.float64), got[1]
+    except Exception:
+        pass
     labels = []
     rows = []
     max_idx = 0
@@ -50,7 +60,15 @@ def read_libsvm(ctx, path: str, n_features: Optional[int] = None) -> InstanceDat
 
 def read_csv(ctx, path: str, label_col: int = 0, delimiter: str = ",",
              skip_header: bool = False) -> InstanceDataset:
-    data = np.loadtxt(path, delimiter=delimiter, skiprows=1 if skip_header else 0)
+    data = None
+    try:
+        from cycloneml_tpu.native.host import parse_csv_native
+        data = parse_csv_native(path, delimiter, skip_header)
+    except Exception:
+        pass
+    if data is None:
+        data = np.loadtxt(path, delimiter=delimiter,
+                          skiprows=1 if skip_header else 0)
     y = data[:, label_col]
     x = np.delete(data, label_col, axis=1)
     return InstanceDataset.from_numpy(ctx, x, y)

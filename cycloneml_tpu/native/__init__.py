@@ -1,0 +1,70 @@
+"""Native host runtime — lazy build & load.
+
+Compiles ``src/cyclone_host.cpp`` into a shared library on first use (g++ is
+in the image; no pip deps). Every consumer goes through :mod:`host`, which
+falls back to pure-Python implementations when the toolchain is unavailable,
+so the framework never hard-depends on the .so.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "cyclone_host.cpp")
+_LIB_DIR = os.path.join(_HERE, "_lib")
+_LIB = os.path.join(_LIB_DIR, "libcyclone_host.so")
+
+_lock = threading.Lock()
+_lib_handle = None
+_build_failed = False
+
+
+def _needs_build() -> bool:
+    return (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the native library; returns its path or None on failure."""
+    global _build_failed
+    with _lock:
+        if not force and not _needs_build():
+            return _LIB
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+               _SRC, "-o", _LIB, "-lzstd", "-lpthread", "-ldl"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            _build_failed = False
+            return _LIB
+        except Exception:
+            # -march=native can be unsupported in exotic sandboxes; retry plain
+            try:
+                cmd.remove("-march=native")
+                subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+                _build_failed = False
+                return _LIB
+            except Exception:
+                _build_failed = True
+                return None
+
+
+def load():
+    """ctypes handle to the built library, or None (fallbacks engage)."""
+    global _lib_handle
+    if _lib_handle is not None:
+        return _lib_handle
+    if _build_failed:
+        return None
+    path = build()
+    if path is None:
+        return None
+    import ctypes
+    with _lock:
+        if _lib_handle is None:
+            _lib_handle = ctypes.CDLL(path)
+    return _lib_handle

@@ -1,0 +1,369 @@
+"""ctypes surface over the native host library, with pure-Python fallbacks.
+
+Three subsystems (SURVEY §2.6 native inventory):
+- :func:`parse_libsvm_native` / :func:`parse_csv_native` — multithreaded C++
+  parsers feeding dense arrays (the ingest path to ``InstanceDataset``).
+- :class:`CompressionCodec` — zstd/lz4 block codecs (ref:
+  core/.../io/CompressionCodec.scala:63-71; zlib stands in when the .so is
+  unavailable).
+- :class:`KVStore` — log-structured persistent KV (ref: common/kvstore
+  LevelDB.java), used by the event journal / status store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.native import load
+
+
+def _fn(lib, name, restype, argtypes):
+    f = getattr(lib, name)
+    f.restype = restype
+    f.argtypes = argtypes
+    return f
+
+
+_c_i64 = ctypes.c_int64
+_c_vp = ctypes.c_void_p
+
+
+class _Lib:
+    """Typed function table, built once."""
+
+    _instance = None
+
+    def __init__(self, lib):
+        self.svm_open = _fn(lib, "svm_open", _c_vp,
+                            [ctypes.c_char_p, ctypes.c_int,
+                             ctypes.POINTER(_c_i64), ctypes.POINTER(_c_i64)])
+        self.svm_fill = _fn(lib, "svm_fill", ctypes.c_int,
+                            [_c_vp, _c_vp, _c_vp, _c_i64, _c_i64])
+        self.svm_free = _fn(lib, "svm_free", None, [_c_vp])
+        self.csv_open = _fn(lib, "csv_open", _c_vp,
+                            [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                             ctypes.c_int, ctypes.POINTER(_c_i64),
+                             ctypes.POINTER(_c_i64)])
+        self.csv_fill = _fn(lib, "csv_fill", ctypes.c_int,
+                            [_c_vp, _c_vp, _c_i64, _c_i64])
+        self.csv_free = _fn(lib, "csv_free", None, [_c_vp])
+        self.zstd_bound = _fn(lib, "codec_zstd_bound", _c_i64, [_c_i64])
+        self.zstd_compress = _fn(lib, "codec_zstd_compress", _c_i64,
+                                 [_c_vp, _c_i64, _c_vp, _c_i64, ctypes.c_int])
+        self.zstd_decompress = _fn(lib, "codec_zstd_decompress", _c_i64,
+                                   [_c_vp, _c_i64, _c_vp, _c_i64])
+        self.lz4_available = _fn(lib, "codec_lz4_available", ctypes.c_int, [])
+        self.lz4_bound = _fn(lib, "codec_lz4_bound", _c_i64, [_c_i64])
+        self.lz4_compress = _fn(lib, "codec_lz4_compress", _c_i64,
+                                [_c_vp, _c_i64, _c_vp, _c_i64])
+        self.lz4_decompress = _fn(lib, "codec_lz4_decompress", _c_i64,
+                                  [_c_vp, _c_i64, _c_vp, _c_i64])
+        self.kv_open = _fn(lib, "kv_open", _c_vp, [ctypes.c_char_p])
+        self.kv_put = _fn(lib, "kv_put", ctypes.c_int,
+                          [_c_vp, _c_vp, _c_i64, _c_vp, _c_i64])
+        self.kv_get = _fn(lib, "kv_get", _c_i64,
+                          [_c_vp, _c_vp, _c_i64, _c_vp, _c_i64])
+        self.kv_delete = _fn(lib, "kv_delete", ctypes.c_int, [_c_vp, _c_vp, _c_i64])
+        self.kv_count = _fn(lib, "kv_count", _c_i64, [_c_vp])
+        self.kv_flush = _fn(lib, "kv_flush", ctypes.c_int, [_c_vp])
+        self.kv_compact = _fn(lib, "kv_compact", ctypes.c_int, [_c_vp])
+        self.kv_iter = _fn(lib, "kv_iter", _c_vp, [_c_vp])
+        self.kv_iter_next = _fn(lib, "kv_iter_next", _c_i64, [_c_vp, _c_vp, _c_i64])
+        self.kv_iter_free = _fn(lib, "kv_iter_free", None, [_c_vp])
+        self.kv_close = _fn(lib, "kv_close", None, [_c_vp])
+
+
+def _lib() -> Optional[_Lib]:
+    if _Lib._instance is None:
+        raw = load()
+        if raw is None:
+            return None
+        _Lib._instance = _Lib(raw)
+    return _Lib._instance
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# loaders
+# ---------------------------------------------------------------------------
+
+def parse_libsvm_native(path: str, n_features: Optional[int] = None,
+                        n_threads: int = 0) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Dense (X float32, y float64) via the C++ parser; None → use fallback."""
+    lib = _lib()
+    if lib is None:
+        return None
+    nr, nf = _c_i64(), _c_i64()
+    h = lib.svm_open(path.encode(), n_threads, ctypes.byref(nr), ctypes.byref(nf))
+    if not h:
+        return None
+    try:
+        rows = nr.value
+        d = n_features if n_features is not None else nf.value
+        x = np.zeros((rows, max(d, 1)), dtype=np.float32)
+        y = np.zeros(rows, dtype=np.float32)
+        rc = lib.svm_fill(h, x.ctypes.data_as(_c_vp), y.ctypes.data_as(_c_vp),
+                          rows, x.shape[1])
+        if rc != 0:
+            return None
+        return x[:, :d] if d else x, y.astype(np.float64)
+    finally:
+        lib.svm_free(h)
+
+
+def parse_csv_native(path: str, delimiter: str = ",", skip_header: bool = False,
+                     n_threads: int = 0) -> Optional[np.ndarray]:
+    lib = _lib()
+    if lib is None:
+        return None
+    nr, nc = _c_i64(), _c_i64()
+    h = lib.csv_open(path.encode(), delimiter.encode()[0], int(skip_header),
+                     n_threads, ctypes.byref(nr), ctypes.byref(nc))
+    if not h:
+        return None
+    try:
+        x = np.zeros((nr.value, max(nc.value, 1)), dtype=np.float64)
+        if lib.csv_fill(h, x.ctypes.data_as(_c_vp), nr.value, x.shape[1]) != 0:
+            return None
+        return x
+    finally:
+        lib.csv_free(h)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class CompressionCodec:
+    """Block codec with a 9-byte header (codec id + uncompressed length) so
+    streams are self-describing, matching the reference's codec-per-conf
+    model (``cyclone.io.compression.codec``)."""
+
+    ZSTD, LZ4, ZLIB = 1, 2, 3
+    _names = {1: "zstd", 2: "lz4", 3: "zlib"}
+
+    def __init__(self, codec: str = "zstd", level: int = 3):
+        self.level = level
+        lib = _lib()
+        if codec == "zstd" and lib is not None:
+            self._id = self.ZSTD
+        elif codec == "lz4" and lib is not None and lib.lz4_available():
+            self._id = self.LZ4
+        else:
+            self._id = self.ZLIB  # pure-python stand-in
+        self.name = self._names[self._id]
+
+    def compress(self, data: bytes) -> bytes:
+        lib = _lib()
+        hdr = struct.pack("<BQ", self._id, len(data))
+        if self._id == self.ZSTD:
+            cap = lib.zstd_bound(len(data))
+            out = ctypes.create_string_buffer(cap)
+            n = lib.zstd_compress(data, len(data), out, cap, self.level)
+            if n < 0:
+                raise IOError("zstd compression failed")
+            return hdr + out.raw[:n]
+        if self._id == self.LZ4:
+            cap = lib.lz4_bound(len(data))
+            out = ctypes.create_string_buffer(cap)
+            n = lib.lz4_compress(data, len(data), out, cap)
+            if n < 0:
+                raise IOError("lz4 compression failed")
+            return hdr + out.raw[:n]
+        return hdr + zlib.compress(data, self.level)
+
+    @staticmethod
+    def decompress(blob: bytes) -> bytes:
+        cid, n = struct.unpack("<BQ", blob[:9])
+        payload = blob[9:]
+        if cid == CompressionCodec.ZLIB:
+            return zlib.decompress(payload)
+        lib = _lib()
+        if lib is None:
+            raise IOError("native codec required for this stream")
+        out = ctypes.create_string_buffer(max(n, 1))
+        if cid == CompressionCodec.ZSTD:
+            r = lib.zstd_decompress(payload, len(payload), out, max(n, 1))
+        else:
+            r = lib.lz4_decompress(payload, len(payload), out, max(n, 1))
+        if r < 0:
+            raise IOError("decompression failed")
+        return out.raw[:r]
+
+
+# ---------------------------------------------------------------------------
+# kvstore
+# ---------------------------------------------------------------------------
+
+class KVStore:
+    """Persistent KV on the native log-structured store; pure-Python engine
+    with the identical on-disk format when the .so is unavailable."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lib = _lib()
+        self._py: Optional[_PyKv] = None
+        if self._lib is not None:
+            self._h = self._lib.kv_open(path.encode())
+            if not self._h:
+                raise IOError(f"cannot open kvstore at {path}")
+        else:
+            self._py = _PyKv(path)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if self._py is not None:
+            return self._py.put(key, value)
+        if self._lib.kv_put(self._h, key, len(key), value, len(value)) != 0:
+            raise IOError("kv put failed")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if self._py is not None:
+            return self._py.get(key)
+        cap = 1 << 16
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            n = self._lib.kv_get(self._h, key, len(key), out, cap)
+            if n < 0:
+                return None
+            if n <= cap:
+                return out.raw[:n]
+            cap = n
+
+    def delete(self, key: bytes) -> bool:
+        if self._py is not None:
+            return self._py.delete(key)
+        return self._lib.kv_delete(self._h, key, len(key)) == 0
+
+    def __len__(self) -> int:
+        if self._py is not None:
+            return len(self._py.index)
+        return self._lib.kv_count(self._h)
+
+    def keys(self) -> Iterator[bytes]:
+        if self._py is not None:
+            yield from list(self._py.index.keys())
+            return
+        it = self._lib.kv_iter(self._h)
+        try:
+            cap = 1 << 12
+            buf = ctypes.create_string_buffer(cap)
+            while True:
+                n = self._lib.kv_iter_next(it, buf, cap)
+                if n < 0:
+                    break
+                if n > cap:
+                    cap, buf = n, ctypes.create_string_buffer(n)
+                    continue
+                yield buf.raw[:n]
+        finally:
+            self._lib.kv_iter_free(it)
+
+    def flush(self) -> None:
+        if self._py is not None:
+            return self._py.flush()
+        self._lib.kv_flush(self._h)
+
+    def compact(self) -> None:
+        if self._py is not None:
+            return self._py.compact()
+        if self._lib.kv_compact(self._h) != 0:
+            raise IOError("compaction failed")
+
+    def close(self) -> None:
+        if self._py is not None:
+            return self._py.close()
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+
+_TOMB = 0xFFFFFFFF
+
+
+class _PyKv:
+    """Same record format as the C++ store: [u32 klen][u32 vlen][k][v]."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.index = {}
+        self.f = open(path, "a+b")
+        self._load()
+
+    def _load(self):
+        self.f.seek(0)
+        pos = 0
+        while True:
+            hdr = self.f.read(8)
+            if len(hdr) < 8:
+                break
+            klen, vlen = struct.unpack("<II", hdr)
+            key = self.f.read(klen)
+            if len(key) < klen:
+                break
+            if vlen == _TOMB:
+                self.index.pop(key, None)
+                pos += 8 + klen
+            else:
+                val = self.f.read(vlen)
+                if len(val) < vlen:
+                    break
+                self.index[key] = (pos + 8 + klen, vlen)
+                pos += 8 + klen + vlen
+        self.total = pos
+        self.f.seek(pos)
+        self.f.truncate(pos)
+
+    def put(self, key: bytes, value: bytes):
+        self.f.seek(self.total)
+        self.f.write(struct.pack("<II", len(key), len(value)) + key + value)
+        self.index[key] = (self.total + 8 + len(key), len(value))
+        self.total += 8 + len(key) + len(value)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        ent = self.index.get(key)
+        if ent is None:
+            return None
+        self.f.flush()
+        self.f.seek(ent[0])
+        v = self.f.read(ent[1])
+        self.f.seek(self.total)
+        return v
+
+    def delete(self, key: bytes) -> bool:
+        if key not in self.index:
+            return False
+        self.f.seek(self.total)
+        self.f.write(struct.pack("<II", len(key), _TOMB) + key)
+        self.total += 8 + len(key)
+        del self.index[key]
+        return True
+
+    def flush(self):
+        self.f.flush()
+
+    def compact(self):
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as nf:
+            nindex, pos = {}, 0
+            for k, (off, vlen) in self.index.items():
+                self.f.seek(off)
+                v = self.f.read(vlen)
+                nf.write(struct.pack("<II", len(k), vlen) + k + v)
+                nindex[k] = (pos + 8 + len(k), vlen)
+                pos += 8 + len(k) + vlen
+        self.f.close()
+        os.replace(tmp, self.path)
+        self.f = open(self.path, "a+b")
+        self.index, self.total = nindex, pos
+
+    def close(self):
+        self.f.close()

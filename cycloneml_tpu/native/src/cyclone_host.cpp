@@ -1,0 +1,478 @@
+// cyclone_host — native host-side runtime for the TPU framework.
+//
+// TPU-native equivalents of the reference's JNI substrate (SURVEY §2.6):
+//   * loader: multithreaded libsvm/CSV → dense buffers (replaces the
+//     HadoopRDD/text ingest path feeding MLUtils.loadLibSVMFile).
+//   * codec: zstd (linked) + lz4 (dlopen'd) block compression — the
+//     CompressionCodec plugin point (ref: core/.../io/CompressionCodec.scala:63)
+//     for spill/checkpoint/event-log streams.
+//   * kvstore: log-structured append-only KV with in-memory index and
+//     compaction — the common/kvstore LevelDB.java analog backing the
+//     status store / history provider.
+//
+// Pure C ABI (loaded via ctypes; no pybind11 in the image). All functions
+// are thread-safe at the handle level.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dlfcn.h>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <zstd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// loader
+// ---------------------------------------------------------------------------
+
+struct SvmRow {
+  float label;
+  std::vector<std::pair<int32_t, float>> feats;
+};
+
+struct SvmFile {
+  std::vector<SvmRow> rows;
+  int64_t n_features = 0;
+};
+
+static void parse_svm_range(const char* data, size_t begin, size_t end,
+                            std::vector<SvmRow>* out, int64_t* max_idx) {
+  size_t pos = begin;
+  int64_t local_max = -1;
+  while (pos < end) {
+    size_t eol = pos;
+    while (eol < end && data[eol] != '\n') eol++;
+    const char* p = data + pos;
+    const char* stop = data + eol;
+    pos = eol + 1;
+    while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+    if (p >= stop || *p == '#') continue;
+    SvmRow row;
+    char* next = nullptr;
+    row.label = strtof(p, &next);
+    if (next == p) continue;
+    p = next;
+    while (p < stop) {
+      while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+      if (p >= stop) break;
+      long idx = strtol(p, &next, 10);
+      if (next == p || *next != ':') break;
+      p = next + 1;
+      float v = strtof(p, &next);
+      if (next == p) break;
+      p = next;
+      row.feats.emplace_back((int32_t)(idx - 1), v);  // libsvm is 1-based
+      if (idx - 1 > local_max) local_max = idx - 1;
+    }
+    out->push_back(std::move(row));
+  }
+  *max_idx = local_max;
+}
+
+// Parse whole file with n threads; returns handle, row/feature counts.
+void* svm_open(const char* path, int n_threads, int64_t* n_rows,
+               int64_t* n_features) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) return nullptr;
+  size_t size = (size_t)f.tellg();
+  f.seekg(0);
+  std::vector<char> buf(size);
+  if (size && !f.read(buf.data(), size)) return nullptr;
+
+  int nt = n_threads > 0 ? n_threads
+                         : (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (size < (size_t)(nt * 4096)) nt = 1;
+
+  // chunk boundaries snapped to newlines
+  std::vector<size_t> bounds(nt + 1, 0);
+  bounds[nt] = size;
+  for (int i = 1; i < nt; i++) {
+    size_t b = size * i / nt;
+    while (b < size && buf[b] != '\n') b++;
+    bounds[i] = b < size ? b + 1 : size;
+  }
+  std::vector<std::vector<SvmRow>> parts(nt);
+  std::vector<int64_t> maxes(nt, -1);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < nt; i++)
+    threads.emplace_back(parse_svm_range, buf.data(), bounds[i], bounds[i + 1],
+                         &parts[i], &maxes[i]);
+  for (auto& t : threads) t.join();
+
+  auto* out = new SvmFile();
+  int64_t mx = -1;
+  for (int i = 0; i < nt; i++) {
+    if (maxes[i] > mx) mx = maxes[i];
+    for (auto& r : parts[i]) out->rows.push_back(std::move(r));
+  }
+  out->n_features = mx + 1;
+  *n_rows = (int64_t)out->rows.size();
+  *n_features = out->n_features;
+  return out;
+}
+
+// Fill dense row-major x (n_rows × n_features) and y (n_rows).
+int svm_fill(void* h, float* x, float* y, int64_t n_rows, int64_t n_features) {
+  auto* f = (SvmFile*)h;
+  if ((int64_t)f->rows.size() != n_rows) return -1;
+  memset(x, 0, sizeof(float) * (size_t)(n_rows * n_features));
+  for (int64_t r = 0; r < n_rows; r++) {
+    y[r] = f->rows[r].label;
+    float* row = x + r * n_features;
+    for (auto& kv : f->rows[r].feats)
+      if (kv.first >= 0 && kv.first < n_features) row[kv.first] = kv.second;
+  }
+  return 0;
+}
+
+void svm_free(void* h) { delete (SvmFile*)h; }
+
+// CSV: numeric rectangular parse. Returns handle + dims.
+struct CsvFile {
+  std::vector<std::vector<double>> rows;
+  int64_t n_cols = 0;
+};
+
+static void parse_csv_range(const char* data, size_t begin, size_t end,
+                            char delim, std::vector<std::vector<double>>* out) {
+  size_t pos = begin;
+  while (pos < end) {
+    size_t eol = pos;
+    while (eol < end && data[eol] != '\n') eol++;
+    const char* p = data + pos;
+    const char* stop = data + eol;
+    pos = eol + 1;
+    while (p < stop && (*p == ' ' || *p == '\r')) p++;
+    if (p >= stop) continue;
+    std::vector<double> row;
+    while (p < stop) {
+      char* next = nullptr;
+      double v = strtod(p, &next);
+      if (next == p) { // non-numeric cell → NaN, skip to delim
+        v = NAN;
+        next = (char*)p;
+        while (next < stop && *next != delim) next++;
+      }
+      row.push_back(v);
+      p = next;
+      while (p < stop && *p != delim) p++;
+      if (p < stop) p++;  // skip delim
+    }
+    if (!row.empty()) out->push_back(std::move(row));
+  }
+}
+
+void* csv_open(const char* path, char delim, int skip_header, int n_threads,
+               int64_t* n_rows, int64_t* n_cols) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) return nullptr;
+  size_t size = (size_t)f.tellg();
+  f.seekg(0);
+  std::vector<char> buf(size);
+  if (size && !f.read(buf.data(), size)) return nullptr;
+  size_t start = 0;
+  if (skip_header) {
+    while (start < size && buf[start] != '\n') start++;
+    if (start < size) start++;
+  }
+  int nt = n_threads > 0 ? n_threads
+                         : (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (size - start < (size_t)(nt * 4096)) nt = 1;
+  std::vector<size_t> bounds(nt + 1, start);
+  bounds[nt] = size;
+  for (int i = 1; i < nt; i++) {
+    size_t b = start + (size - start) * i / nt;
+    while (b < size && buf[b] != '\n') b++;
+    bounds[i] = b < size ? b + 1 : size;
+  }
+  std::vector<std::vector<std::vector<double>>> parts(nt);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < nt; i++)
+    threads.emplace_back(parse_csv_range, buf.data(), bounds[i], bounds[i + 1],
+                         delim, &parts[i]);
+  for (auto& t : threads) t.join();
+  auto* out = new CsvFile();
+  for (auto& p : parts)
+    for (auto& r : p) out->rows.push_back(std::move(r));
+  int64_t nc = 0;
+  for (auto& r : out->rows)
+    if ((int64_t)r.size() > nc) nc = (int64_t)r.size();
+  out->n_cols = nc;
+  *n_rows = (int64_t)out->rows.size();
+  *n_cols = nc;
+  return out;
+}
+
+int csv_fill(void* h, double* x, int64_t n_rows, int64_t n_cols) {
+  auto* f = (CsvFile*)h;
+  if ((int64_t)f->rows.size() != n_rows) return -1;
+  for (int64_t r = 0; r < n_rows; r++) {
+    double* row = x + r * n_cols;
+    for (int64_t c = 0; c < n_cols; c++)
+      row[c] = c < (int64_t)f->rows[r].size() ? f->rows[r][c] : 0.0;
+  }
+  return 0;
+}
+
+void csv_free(void* h) { delete (CsvFile*)h; }
+
+// ---------------------------------------------------------------------------
+// codec (ref CompressionCodec.scala:63-71 — zstd & lz4 block codecs)
+// ---------------------------------------------------------------------------
+
+int64_t codec_zstd_bound(int64_t n) { return (int64_t)ZSTD_compressBound((size_t)n); }
+
+int64_t codec_zstd_compress(const void* src, int64_t n, void* dst, int64_t cap,
+                            int level) {
+  size_t r = ZSTD_compress(dst, (size_t)cap, src, (size_t)n, level);
+  return ZSTD_isError(r) ? -1 : (int64_t)r;
+}
+
+int64_t codec_zstd_decompress(const void* src, int64_t n, void* dst, int64_t cap) {
+  size_t r = ZSTD_decompress(dst, (size_t)cap, src, (size_t)n);
+  return ZSTD_isError(r) ? -1 : (int64_t)r;
+}
+
+// lz4 via dlopen (liblz4.so.1 ships without headers/link-name in this image)
+typedef int (*lz4_compress_fn)(const char*, char*, int, int);
+typedef int (*lz4_decompress_fn)(const char*, char*, int, int);
+typedef int (*lz4_bound_fn)(int);
+
+static std::once_flag lz4_once;
+static lz4_compress_fn lz4_compress_p = nullptr;
+static lz4_decompress_fn lz4_decompress_p = nullptr;
+static lz4_bound_fn lz4_bound_p = nullptr;
+
+static void lz4_init() {
+  void* lib = dlopen("liblz4.so.1", RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) lib = dlopen("liblz4.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) return;
+  lz4_compress_p = (lz4_compress_fn)dlsym(lib, "LZ4_compress_default");
+  lz4_decompress_p = (lz4_decompress_fn)dlsym(lib, "LZ4_decompress_safe");
+  lz4_bound_p = (lz4_bound_fn)dlsym(lib, "LZ4_compressBound");
+}
+
+int codec_lz4_available() {
+  std::call_once(lz4_once, lz4_init);
+  return lz4_compress_p && lz4_decompress_p && lz4_bound_p ? 1 : 0;
+}
+
+int64_t codec_lz4_bound(int64_t n) {
+  if (!codec_lz4_available()) return -1;
+  return (int64_t)lz4_bound_p((int)n);
+}
+
+int64_t codec_lz4_compress(const void* src, int64_t n, void* dst, int64_t cap) {
+  if (!codec_lz4_available()) return -1;
+  int r = lz4_compress_p((const char*)src, (char*)dst, (int)n, (int)cap);
+  return r <= 0 ? -1 : (int64_t)r;
+}
+
+int64_t codec_lz4_decompress(const void* src, int64_t n, void* dst, int64_t cap) {
+  if (!codec_lz4_available()) return -1;
+  int r = lz4_decompress_p((const char*)src, (char*)dst, (int)n, (int)cap);
+  return r < 0 ? -1 : (int64_t)r;
+}
+
+// ---------------------------------------------------------------------------
+// kvstore (ref common/kvstore/.../LevelDB.java) — log-structured file KV
+// ---------------------------------------------------------------------------
+// Record: [u32 klen][u32 vlen][key][value]; vlen == 0xFFFFFFFF is a tombstone.
+
+struct KvStore {
+  std::string path;
+  FILE* f = nullptr;
+  std::unordered_map<std::string, std::pair<int64_t, uint32_t>> index;  // key → (value offset, vlen)
+  std::mutex mu;
+  int64_t live_bytes = 0, total_bytes = 0;
+};
+
+static const uint32_t KV_TOMBSTONE = 0xFFFFFFFFu;
+
+static bool kv_load_index(KvStore* s) {
+  fseeko(s->f, 0, SEEK_SET);
+  int64_t pos = 0;
+  uint32_t hdr[2];
+  std::vector<char> kbuf;
+  for (;;) {
+    if (fread(hdr, sizeof(uint32_t), 2, s->f) != 2) break;
+    uint32_t klen = hdr[0], vlen = hdr[1];
+    kbuf.resize(klen);
+    if (klen && fread(kbuf.data(), 1, klen, s->f) != klen) break;
+    int64_t voff = pos + 8 + klen;
+    std::string key(kbuf.data(), klen);
+    if (vlen == KV_TOMBSTONE) {
+      auto it = s->index.find(key);
+      if (it != s->index.end()) {
+        s->live_bytes -= 8 + klen + it->second.second;
+        s->index.erase(it);
+      }
+      pos = voff;
+    } else {
+      if (fseeko(s->f, vlen, SEEK_CUR) != 0) break;
+      auto it = s->index.find(key);
+      if (it != s->index.end()) s->live_bytes -= 8 + klen + it->second.second;
+      s->index[key] = {voff, vlen};
+      s->live_bytes += 8 + klen + vlen;
+      pos = voff + vlen;
+    }
+  }
+  s->total_bytes = pos;
+  // truncate any torn tail write
+  fseeko(s->f, pos, SEEK_SET);
+  return true;
+}
+
+void* kv_open(const char* path) {
+  auto* s = new KvStore();
+  s->path = path;
+  s->f = fopen(path, "a+b");
+  if (!s->f) { delete s; return nullptr; }
+  kv_load_index(s);
+  fseeko(s->f, s->total_bytes, SEEK_SET);
+  return s;
+}
+
+int kv_put(void* h, const void* k, int64_t klen, const void* v, int64_t vlen) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  fseeko(s->f, s->total_bytes, SEEK_SET);
+  uint32_t hdr[2] = {(uint32_t)klen, (uint32_t)vlen};
+  if (fwrite(hdr, sizeof(uint32_t), 2, s->f) != 2) return -1;
+  if (klen && fwrite(k, 1, (size_t)klen, s->f) != (size_t)klen) return -1;
+  if (vlen && fwrite(v, 1, (size_t)vlen, s->f) != (size_t)vlen) return -1;
+  std::string key((const char*)k, (size_t)klen);
+  auto it = s->index.find(key);
+  if (it != s->index.end()) s->live_bytes -= 8 + klen + it->second.second;
+  s->index[key] = {s->total_bytes + 8 + klen, (uint32_t)vlen};
+  s->total_bytes += 8 + klen + vlen;
+  s->live_bytes += 8 + klen + vlen;
+  return 0;
+}
+
+int64_t kv_get(void* h, const void* k, int64_t klen, void* out, int64_t cap) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->index.find(std::string((const char*)k, (size_t)klen));
+  if (it == s->index.end()) return -1;
+  uint32_t vlen = it->second.second;
+  if ((int64_t)vlen > cap) return (int64_t)vlen;  // caller re-calls with room
+  fflush(s->f);
+  fseeko(s->f, it->second.first, SEEK_SET);
+  if (vlen && fread(out, 1, vlen, s->f) != vlen) return -1;
+  fseeko(s->f, s->total_bytes, SEEK_SET);
+  return (int64_t)vlen;
+}
+
+int kv_delete(void* h, const void* k, int64_t klen) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string key((const char*)k, (size_t)klen);
+  auto it = s->index.find(key);
+  if (it == s->index.end()) return -1;
+  fseeko(s->f, s->total_bytes, SEEK_SET);
+  uint32_t hdr[2] = {(uint32_t)klen, KV_TOMBSTONE};
+  fwrite(hdr, sizeof(uint32_t), 2, s->f);
+  fwrite(k, 1, (size_t)klen, s->f);
+  s->live_bytes -= 8 + klen + it->second.second;
+  s->index.erase(it);
+  s->total_bytes += 8 + klen;
+  return 0;
+}
+
+int64_t kv_count(void* h) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  return (int64_t)s->index.size();
+}
+
+int kv_flush(void* h) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  return fflush(s->f);
+}
+
+// Rewrite only live records (ref LevelDB compaction); returns 0 on success.
+int kv_compact(void* h) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string tmp = s->path + ".compact";
+  FILE* nf = fopen(tmp.c_str(), "wb");
+  if (!nf) return -1;
+  fflush(s->f);
+  std::unordered_map<std::string, std::pair<int64_t, uint32_t>> nindex;
+  int64_t pos = 0;
+  std::vector<char> vbuf;
+  for (auto& kv : s->index) {
+    uint32_t vlen = kv.second.second;
+    vbuf.resize(vlen);
+    fseeko(s->f, kv.second.first, SEEK_SET);
+    if (vlen && fread(vbuf.data(), 1, vlen, s->f) != vlen) { fclose(nf); return -1; }
+    uint32_t hdr[2] = {(uint32_t)kv.first.size(), vlen};
+    fwrite(hdr, sizeof(uint32_t), 2, nf);
+    fwrite(kv.first.data(), 1, kv.first.size(), nf);
+    if (vlen) fwrite(vbuf.data(), 1, vlen, nf);
+    nindex[kv.first] = {pos + 8 + (int64_t)kv.first.size(), vlen};
+    pos += 8 + kv.first.size() + vlen;
+  }
+  fclose(nf);
+  fclose(s->f);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    s->f = fopen(s->path.c_str(), "a+b");
+    return -1;
+  }
+  s->f = fopen(s->path.c_str(), "a+b");
+  s->index = std::move(nindex);
+  s->total_bytes = s->live_bytes = pos;
+  return 0;
+}
+
+struct KvIter {
+  KvStore* s;
+  std::vector<std::string> keys;
+  size_t pos = 0;
+};
+
+void* kv_iter(void* h) {
+  auto* s = (KvStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto* it = new KvIter();
+  it->s = s;
+  it->keys.reserve(s->index.size());
+  for (auto& kv : s->index) it->keys.push_back(kv.first);
+  return it;
+}
+
+// Writes next key into kbuf; returns klen, or -1 at end, or required size if
+// kcap too small (iterator does not advance in that case).
+int64_t kv_iter_next(void* hi, void* kbuf, int64_t kcap) {
+  auto* it = (KvIter*)hi;
+  if (it->pos >= it->keys.size()) return -1;
+  const std::string& k = it->keys[it->pos];
+  if ((int64_t)k.size() > kcap) return (int64_t)k.size();
+  memcpy(kbuf, k.data(), k.size());
+  it->pos++;
+  return (int64_t)k.size();
+}
+
+void kv_iter_free(void* hi) { delete (KvIter*)hi; }
+
+void kv_close(void* h) {
+  auto* s = (KvStore*)h;
+  if (s->f) fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"
