@@ -1,0 +1,5 @@
+from cycloneml_tpu.sql.session import CycloneSession
+from cycloneml_tpu.sql.column import Column, col, lit
+from cycloneml_tpu.sql import functions
+
+__all__ = ["CycloneSession", "Column", "col", "lit", "functions"]

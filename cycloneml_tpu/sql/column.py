@@ -1,0 +1,604 @@
+"""Column expression trees.
+
+Analog of Catalyst ``Expression`` + the user-facing ``Column`` (ref:
+sql/catalyst/.../expressions/Expression.scala, sql/core/.../Column.scala).
+Every expression evaluates **vectorized over a columnar batch** (dict of
+numpy arrays) — the whole-stage-codegen analog: where the reference fuses
+operators into Janino-compiled Java loops (ref WholeStageCodegenExec.scala:626),
+here the fused loop is a chain of numpy/XLA array ops; no codegen subsystem
+exists because the array runtime *is* the codegen (SURVEY §2.6 Janino row).
+
+Null semantics: floats use NaN as null; object/string arrays use None.
+``isNull``/``coalesce`` understand both.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Expr:
+    """Base expression. ``eval(batch)`` returns a numpy array of batch length
+    (or a scalar for literals, broadcast by consumers)."""
+
+    children: List["Expr"] = []
+
+    def eval(self, batch) -> np.ndarray:
+        raise NotImplementedError
+
+    def references(self) -> set:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    @property
+    def foldable(self) -> bool:
+        return bool(self.children) and all(c.foldable for c in self.children)
+
+    def fold(self) -> "Expr":
+        """Constant-fold: if every input is a literal, evaluate now
+        (ref: catalyst/optimizer/expressions.scala ConstantFolding)."""
+        new_children = [c.fold() for c in self.children]
+        me = self.with_children(new_children)
+        if me.foldable:
+            return Literal(me.eval({"__len__": 1}))
+        return me
+
+    def with_children(self, children: List["Expr"]) -> "Expr":
+        return self
+
+    def transform(self, fn: Callable[["Expr"], Optional["Expr"]]) -> "Expr":
+        """Bottom-up rewrite."""
+        new = self.with_children([c.transform(fn) for c in self.children])
+        replaced = fn(new)
+        return replaced if replaced is not None else new
+
+    def find_aggregates(self) -> List["AggExpr"]:
+        out = []
+        if isinstance(self, AggExpr):
+            out.append(self)
+        for c in self.children:
+            out.extend(c.find_aggregates())
+        return out
+
+    def name_hint(self) -> str:
+        return str(self)
+
+
+def _batch_len(batch) -> int:
+    for k, v in batch.items():
+        if k != "__len__":
+            return len(v)
+    return batch.get("__len__", 0)
+
+
+class ColumnRef(Expr):
+    def __init__(self, name: str):
+        self.name = name
+        self.children = []
+
+    def eval(self, batch):
+        if self.name not in batch:
+            raise KeyError(f"column {self.name!r} not found in "
+                           f"{[k for k in batch if k != '__len__']}")
+        return batch[self.name]
+
+    def references(self):
+        return {self.name}
+
+    @property
+    def foldable(self):
+        return False
+
+    def name_hint(self):
+        return self.name.split(".")[-1]
+
+    def __str__(self):
+        return self.name
+
+
+class Literal(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+        self.children = []
+
+    def eval(self, batch):
+        return self.value
+
+    @property
+    def foldable(self):
+        return True
+
+    def fold(self):
+        return self
+
+    def __str__(self):
+        return repr(self.value)
+
+
+class BinaryOp(Expr):
+    _ops = {
+        "+": np.add, "-": np.subtract, "*": np.multiply,
+        "/": lambda a, b: np.divide(np.asarray(a, dtype=float), b),
+        "%": np.mod,
+        "=": lambda a, b: np.asarray(a) == np.asarray(b),
+        "!=": lambda a, b: np.asarray(a) != np.asarray(b),
+        "<": np.less, "<=": np.less_equal,
+        ">": np.greater, ">=": np.greater_equal,
+        "and": np.logical_and, "or": np.logical_or,
+    }
+
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.children = [left, right]
+
+    def with_children(self, c):
+        return BinaryOp(self.op, c[0], c[1])
+
+    def eval(self, batch):
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        return self._ops[self.op](a, b)
+
+    def __str__(self):
+        return f"({self.children[0]} {self.op} {self.children[1]})"
+
+
+class UnaryOp(Expr):
+    _ops = {"-": np.negative, "not": np.logical_not}
+
+    def __init__(self, op: str, child: Expr):
+        self.op = op
+        self.children = [child]
+
+    def with_children(self, c):
+        return UnaryOp(self.op, c[0])
+
+    def eval(self, batch):
+        return self._ops[self.op](self.children[0].eval(batch))
+
+    def __str__(self):
+        return f"({self.op} {self.children[0]})"
+
+
+def _is_null_arr(v) -> np.ndarray:
+    v = np.atleast_1d(np.asarray(v))
+    if v.dtype.kind == "f":
+        return np.isnan(v)
+    if v.dtype == object:
+        return np.array([x is None for x in v])
+    return np.zeros(v.shape, dtype=bool)
+
+
+def _narrow_object(out: np.ndarray) -> np.ndarray:
+    """Cast an object array to float64 ONLY when every non-null element is
+    already numeric (None → NaN); strings keep their type."""
+    vals = [x for x in out if x is not None]
+    if vals and all(isinstance(x, (int, float, bool, np.integer, np.floating,
+                                   np.bool_)) for x in vals):
+        return np.array([np.nan if x is None else float(x) for x in out])
+    return out
+
+
+class Func(Expr):
+    """Scalar functions, all vectorized."""
+
+    _fns = {
+        "abs": np.abs, "sqrt": np.sqrt, "exp": np.exp, "log": np.log,
+        "floor": np.floor, "ceil": np.ceil, "round": np.round,
+        "upper": lambda v: np.array([None if x is None else str(x).upper() for x in np.atleast_1d(v)], dtype=object),
+        "lower": lambda v: np.array([None if x is None else str(x).lower() for x in np.atleast_1d(v)], dtype=object),
+        "length": lambda v: np.array([0 if x is None else len(str(x)) for x in np.atleast_1d(v)]),
+        "isnull": _is_null_arr,
+        "isnotnull": lambda v: ~_is_null_arr(v),
+    }
+
+    def __init__(self, name: str, *args: Expr):
+        self.name = name.lower()
+        self.children = list(args)
+
+    def with_children(self, c):
+        return Func(self.name, *c)
+
+    def eval(self, batch):
+        if self.name == "concat":
+            parts = [np.atleast_1d(c.eval(batch)) for c in self.children]
+            n = max(len(p) for p in parts)
+            parts = [np.broadcast_to(p, (n,)) if len(p) != n else p for p in parts]
+            return np.array(["".join(str(x) for x in row) for row in zip(*parts)],
+                            dtype=object)
+        if self.name == "coalesce":
+            out = None
+            for c in self.children:
+                v = np.atleast_1d(c.eval(batch))
+                if out is None:
+                    out = np.array(v, copy=True)
+                    continue
+                mask = _is_null_arr(out)
+                if mask.any():
+                    v = np.broadcast_to(v, out.shape)
+                    out[mask] = v[mask]
+            return out
+        if self.name == "like":
+            v, pat = self.children[0].eval(batch), self.children[1].eval(batch)
+            # re.escape (3.7+) leaves % and _ untouched — substitute after escaping
+            rx = re.compile(
+                "^" + re.escape(str(pat)).replace("%", ".*").replace("_", ".") + "$")
+            return np.array([bool(rx.match(str(x))) if x is not None else False
+                             for x in np.atleast_1d(v)])
+        return self._fns[self.name](
+            np.atleast_1d(np.asarray(self.children[0].eval(batch))))
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.children))})"
+
+
+class CaseWhen(Expr):
+    """CASE WHEN ... THEN ... [ELSE ...] END (pairs flattened in children:
+    [cond1, val1, cond2, val2, ..., else])."""
+
+    def __init__(self, branches: Sequence[Expr], otherwise: Optional[Expr] = None):
+        self.n_branches = len(branches) // 2
+        self.children = list(branches) + ([otherwise] if otherwise is not None else [])
+        self.has_else = otherwise is not None
+
+    def with_children(self, c):
+        if self.has_else:
+            return CaseWhen(c[:-1], c[-1])
+        return CaseWhen(c, None)
+
+    def eval(self, batch):
+        n = _batch_len(batch)
+        conds = [np.broadcast_to(np.atleast_1d(self.children[2 * i].eval(batch)), (n,))
+                 for i in range(self.n_branches)]
+        vals = [np.broadcast_to(np.atleast_1d(np.asarray(
+            self.children[2 * i + 1].eval(batch), dtype=object)), (n,))
+            for i in range(self.n_branches)]
+        if self.has_else:
+            out = np.array(np.broadcast_to(np.atleast_1d(np.asarray(
+                self.children[-1].eval(batch), dtype=object)), (n,)), copy=True)
+        else:
+            out = np.full(n, None, dtype=object)
+        taken = np.zeros(n, dtype=bool)
+        for cond, val in zip(conds, vals):
+            fire = np.asarray(cond, dtype=bool) & ~taken
+            out[fire] = val[fire]
+            taken |= fire
+        return _narrow_object(out)
+
+    def __str__(self):
+        return "CASE WHEN ..."
+
+
+class InExpr(Expr):
+    def __init__(self, child: Expr, values: Sequence[Any]):
+        self.children = [child]
+        self.values = list(values)
+
+    def with_children(self, c):
+        return InExpr(c[0], self.values)
+
+    def eval(self, batch):
+        v = np.atleast_1d(self.children[0].eval(batch))
+        return np.isin(v, self.values)
+
+    def __str__(self):
+        return f"({self.children[0]} IN {self.values})"
+
+
+class Cast(Expr):
+    _np = {"double": np.float64, "bigint": np.int64, "boolean": bool,
+           "string": object}
+
+    def __init__(self, child: Expr, to: str):
+        self.children = [child]
+        self.to = to
+
+    def with_children(self, c):
+        return Cast(c[0], self.to)
+
+    def eval(self, batch):
+        v = np.atleast_1d(self.children[0].eval(batch))
+        if self.to == "string":
+            return np.array([str(x) for x in v], dtype=object)
+        return v.astype(self._np[self.to])
+
+    def __str__(self):
+        return f"cast({self.children[0]} as {self.to})"
+
+
+class Alias(Expr):
+    def __init__(self, child: Expr, name: str):
+        self.children = [child]
+        self.name = name
+
+    def with_children(self, c):
+        return Alias(c[0], self.name)
+
+    def fold(self):
+        # folding must not strip the output name
+        return Alias(self.children[0].fold(), self.name)
+
+    def eval(self, batch):
+        return self.children[0].eval(batch)
+
+    def name_hint(self):
+        return self.name
+
+    def __str__(self):
+        return f"{self.children[0]} AS {self.name}"
+
+
+# ---------------------------------------------------------------------------
+# aggregates (ref: catalyst/expressions/aggregate/)
+# ---------------------------------------------------------------------------
+
+class AggExpr(Expr):
+    """Aggregate over groups. ``agg(values, codes, n_groups)`` reduces the
+    child values per group code — vectorized bincount/ufunc.at, the hash-
+    aggregate analog (ref: execution/aggregate/HashAggregateExec.scala)."""
+
+    fn = ""
+
+    def __init__(self, child: Optional[Expr]):
+        self.children = [child] if child is not None else []
+
+    def with_children(self, c):
+        return type(self)(c[0] if c else None)
+
+    def eval(self, batch):
+        raise RuntimeError("aggregate expression outside aggregation")
+
+    def agg(self, values: Optional[np.ndarray], codes: np.ndarray,
+            n_groups: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def name_hint(self):
+        arg = str(self.children[0]) if self.children else "*"
+        return f"{self.fn}({arg})"
+
+    def __str__(self):
+        return self.name_hint()
+
+
+class SumAgg(AggExpr):
+    fn = "sum"
+
+    def agg(self, values, codes, n):
+        return np.bincount(codes, weights=np.asarray(values, dtype=float),
+                           minlength=n)
+
+
+class CountAgg(AggExpr):
+    fn = "count"
+
+    def agg(self, values, codes, n):
+        if values is None:  # COUNT(*)
+            return np.bincount(codes, minlength=n).astype(np.int64)
+        mask = ~_is_null_arr(values)
+        return np.bincount(codes[mask], minlength=n).astype(np.int64)
+
+
+class AvgAgg(AggExpr):
+    fn = "avg"
+
+    def agg(self, values, codes, n):
+        s = np.bincount(codes, weights=np.asarray(values, dtype=float), minlength=n)
+        c = np.bincount(codes, minlength=n)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return s / c
+
+
+class MinAgg(AggExpr):
+    fn = "min"
+
+    def agg(self, values, codes, n):
+        v = np.asarray(values)
+        if v.dtype == object or v.dtype.kind in "US":
+            out = [None] * n
+            for code, val in zip(codes, v):
+                if out[code] is None or val < out[code]:
+                    out[code] = val
+            return np.array(out, dtype=object)
+        out = np.full(n, np.inf)
+        np.minimum.at(out, codes, np.asarray(v, dtype=float))
+        return out
+
+
+class MaxAgg(AggExpr):
+    fn = "max"
+
+    def agg(self, values, codes, n):
+        v = np.asarray(values)
+        if v.dtype == object or v.dtype.kind in "US":
+            out = [None] * n
+            for code, val in zip(codes, v):
+                if out[code] is None or val > out[code]:
+                    out[code] = val
+            return np.array(out, dtype=object)
+        out = np.full(n, -np.inf)
+        np.maximum.at(out, codes, np.asarray(v, dtype=float))
+        return out
+
+
+class CountDistinctAgg(AggExpr):
+    fn = "count_distinct"
+
+    def agg(self, values, codes, n):
+        pairs = set(zip(codes.tolist(), np.asarray(values).tolist()))
+        out = np.zeros(n, dtype=np.int64)
+        for code, _ in pairs:
+            out[code] += 1
+        return out
+
+
+class FirstAgg(AggExpr):
+    fn = "first"
+
+    def agg(self, values, codes, n):
+        out = np.full(n, None, dtype=object)
+        seen = np.zeros(n, dtype=bool)
+        for code, val in zip(codes, np.asarray(values, dtype=object)):
+            if not seen[code]:
+                out[code] = val
+                seen[code] = True
+        return _narrow_object(out)
+
+
+class CollectListAgg(AggExpr):
+    fn = "collect_list"
+
+    def agg(self, values, codes, n):
+        out = [[] for _ in range(n)]
+        for code, val in zip(codes, np.asarray(values, dtype=object)):
+            out[code].append(val)
+        return np.array(out, dtype=object)
+
+
+# ---------------------------------------------------------------------------
+# user-facing Column
+# ---------------------------------------------------------------------------
+
+def _to_expr(v) -> Expr:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, Expr):
+        return v
+    return Literal(v)
+
+
+class Column:
+    """Operator-overloaded wrapper (ref sql/core/.../Column.scala)."""
+
+    def __init__(self, expr: Expr):
+        self.expr = expr
+
+    def _bin(self, op, other, flip=False):
+        a, b = self.expr, _to_expr(other)
+        if flip:
+            a, b = b, a
+        return Column(BinaryOp(op, a, b))
+
+    def __add__(self, o):
+        return self._bin("+", o)
+
+    def __radd__(self, o):
+        return self._bin("+", o, True)
+
+    def __sub__(self, o):
+        return self._bin("-", o)
+
+    def __rsub__(self, o):
+        return self._bin("-", o, True)
+
+    def __mul__(self, o):
+        return self._bin("*", o)
+
+    def __rmul__(self, o):
+        return self._bin("*", o, True)
+
+    def __truediv__(self, o):
+        return self._bin("/", o)
+
+    def __mod__(self, o):
+        return self._bin("%", o)
+
+    def __neg__(self):
+        return Column(UnaryOp("-", self.expr))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("=", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin("!=", o)
+
+    def __lt__(self, o):
+        return self._bin("<", o)
+
+    def __le__(self, o):
+        return self._bin("<=", o)
+
+    def __gt__(self, o):
+        return self._bin(">", o)
+
+    def __ge__(self, o):
+        return self._bin(">=", o)
+
+    def __and__(self, o):
+        return self._bin("and", o)
+
+    def __or__(self, o):
+        return self._bin("or", o)
+
+    def __invert__(self):
+        return Column(UnaryOp("not", self.expr))
+
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    def cast(self, to: str) -> "Column":
+        return Column(Cast(self.expr, to))
+
+    def is_null(self) -> "Column":
+        return Column(Func("isnull", self.expr))
+
+    def is_not_null(self) -> "Column":
+        return Column(Func("isnotnull", self.expr))
+
+    def isin(self, *values) -> "Column":
+        vals = values[0] if len(values) == 1 and isinstance(values[0], (list, tuple)) else values
+        return Column(InExpr(self.expr, vals))
+
+    def like(self, pattern: str) -> "Column":
+        return Column(Func("like", self.expr, Literal(pattern)))
+
+    def when(self, cond: "Column", value) -> "Column":
+        """Extend a CASE chain (pair with functions.when)."""
+        if isinstance(self.expr, CaseWhen) and not self.expr.has_else:
+            branches = self.expr.children + [_to_expr(cond), _to_expr(value)]
+            return Column(CaseWhen(branches))
+        raise ValueError("when() chains only onto functions.when(...)")
+
+    def otherwise(self, value) -> "Column":
+        if isinstance(self.expr, CaseWhen) and not self.expr.has_else:
+            return Column(CaseWhen(self.expr.children, _to_expr(value)))
+        raise ValueError("otherwise() requires a when(...) chain")
+
+    def asc(self) -> "Column":
+        return Column(SortOrder(self.expr, ascending=True))
+
+    def desc(self) -> "Column":
+        return Column(SortOrder(self.expr, ascending=False))
+
+    def __repr__(self):
+        return f"Column<{self.expr}>"
+
+
+class SortOrder(Expr):
+    def __init__(self, child: Expr, ascending: bool = True):
+        self.children = [child]
+        self.ascending = ascending
+
+    def with_children(self, c):
+        return SortOrder(c[0], self.ascending)
+
+    def fold(self):
+        return SortOrder(self.children[0].fold(), self.ascending)
+
+    def eval(self, batch):
+        return self.children[0].eval(batch)
+
+    def __str__(self):
+        return f"{self.children[0]} {'ASC' if self.ascending else 'DESC'}"
+
+
+def col(name: str) -> Column:
+    return Column(ColumnRef(name))
+
+
+def lit(value) -> Column:
+    return Column(Literal(value))

@@ -1,0 +1,236 @@
+"""DataFrame — the user surface over logical plans.
+
+Analog of ``Dataset``/``DataFrame`` + ``RelationalGroupedDataset`` (ref:
+sql/core/.../Dataset.scala:83, RelationalGroupedDataset.scala). Lazy: every
+method builds a plan; actions (collect/count/show/to_dict) run
+``QueryExecution`` = optimize → execute (ref QueryExecution.scala:56 phases,
+minus the physical-planning phase the one-tree design doesn't need)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+
+import numpy as np
+
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.column import (Alias, Column, ColumnRef, Expr,
+                                      SortOrder, col)
+from cycloneml_tpu.sql.optimizer import optimize
+from cycloneml_tpu.sql.plan import (Aggregate, Distinct, Filter, Join, Limit,
+                                    LogicalPlan, Project, Sort, Union)
+from cycloneml_tpu.sql.types import StructType, infer_schema
+
+
+class Row:
+    """Lightweight named row (ref: catalyst Row)."""
+
+    def __init__(self, names: List[str], values: Tuple):
+        self.__dict__["_names"] = names
+        self.__dict__["_values"] = values
+
+    def __getattr__(self, name):
+        try:
+            return self._values[self._names.index(name)]
+        except ValueError:
+            raise AttributeError(name)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            return self._values[self._names.index(i)]
+        return self._values[i]
+
+    def as_dict(self) -> Dict:
+        return dict(zip(self._names, self._values))
+
+    def __eq__(self, other):
+        if isinstance(other, Row):
+            return self._values == other._values
+        return tuple(self._values) == tuple(other)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={v!r}" for n, v in zip(self._names, self._values))
+        return f"Row({inner})"
+
+
+def _to_exprs(cols: Sequence, existing: List[str]) -> List[Expr]:
+    out = []
+    for c in cols:
+        if isinstance(c, Column):
+            e = c.expr
+            if not isinstance(e, (Alias, ColumnRef)):
+                e = Alias(e, e.name_hint())
+            out.append(e)
+        elif isinstance(c, str):
+            if c == "*":
+                out.extend(ColumnRef(n) for n in existing)
+            else:
+                out.append(ColumnRef(c))
+        else:
+            raise TypeError(f"cannot select {c!r}")
+    return out
+
+
+class DataFrame:
+    def __init__(self, plan: LogicalPlan, session=None):
+        self.plan = plan
+        self.session = session
+
+    # -- transformations -------------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame(Project(self.plan, _to_exprs(cols, self.columns)),
+                         self.session)
+
+    def filter(self, cond: TUnion[Column, str]) -> "DataFrame":
+        if isinstance(cond, str):
+            from cycloneml_tpu.sql.parser import parse_expression
+            cond = Column(parse_expression(cond))
+        return DataFrame(Filter(self.plan, cond.expr), self.session)
+
+    where = filter
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        exprs = [ColumnRef(n) for n in self.columns if n != name]
+        exprs.append(Alias(c.expr, name))
+        return DataFrame(Project(self.plan, exprs), self.session)
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [Alias(ColumnRef(n), new) if n == old else ColumnRef(n)
+                 for n in self.columns]
+        return DataFrame(Project(self.plan, exprs), self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        exprs = [ColumnRef(n) for n in self.columns if n not in names]
+        return DataFrame(Project(self.plan, exprs), self.session)
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, _to_exprs(cols, self.columns))
+
+    groupBy = group_by
+
+    def agg(self, *cols) -> "DataFrame":
+        return GroupedData(self, []).agg(*cols)
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        pairs = [(k, k) if isinstance(k, str) else k for k in on]
+        return DataFrame(Join(self.plan, other.plan, pairs, how), self.session)
+
+    def order_by(self, *cols) -> "DataFrame":
+        orders = []
+        for c in cols:
+            if isinstance(c, str):
+                orders.append(SortOrder(ColumnRef(c)))
+            elif isinstance(c.expr, SortOrder):
+                orders.append(c.expr)
+            else:
+                orders.append(SortOrder(c.expr))
+        return DataFrame(Sort(self.plan, orders), self.session)
+
+    orderBy = sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(Limit(self.plan, n), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(Union(self.plan, other.plan), self.session)
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(Distinct(self.plan), self.session)
+
+    # -- actions ---------------------------------------------------------------
+    def optimized_plan(self) -> LogicalPlan:
+        return optimize(self.plan)
+
+    def to_dict(self) -> Dict[str, np.ndarray]:
+        return self.optimized_plan().execute()
+
+    def collect(self) -> List[Row]:
+        batch = self.to_dict()
+        names = list(batch)
+        n = len(batch[names[0]]) if names else 0
+        return [Row(names, tuple(batch[c][i] for c in names)) for i in range(n)]
+
+    def count(self) -> int:
+        batch = self.to_dict()
+        for v in batch.values():
+            return len(v)
+        return 0
+
+    def first(self) -> Optional[Row]:
+        rows = self.limit(1).collect()
+        return rows[0] if rows else None
+
+    def show(self, n: int = 20) -> None:
+        batch = self.limit(n).to_dict()
+        names = list(batch)
+        widths = {c: max(len(c), *(len(str(v)) for v in batch[c][:n])) if len(batch[c]) else len(c)
+                  for c in names}
+        line = "+" + "+".join("-" * (widths[c] + 2) for c in names) + "+"
+        print(line)
+        print("|" + "|".join(f" {c:<{widths[c]}} " for c in names) + "|")
+        print(line)
+        count = len(batch[names[0]]) if names else 0
+        for i in range(count):
+            print("|" + "|".join(f" {str(batch[c][i]):<{widths[c]}} "
+                                 for c in names) + "|")
+        print(line)
+
+    def explain(self) -> str:
+        s = ("== Logical Plan ==\n" + self.plan.tree_string()
+             + "== Optimized Plan ==\n" + self.optimized_plan().tree_string())
+        print(s)
+        return s
+
+    # -- metadata --------------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.output()
+
+    @property
+    def schema(self) -> StructType:
+        return infer_schema(self.to_dict())
+
+    def __getitem__(self, name: str) -> Column:
+        return col(name)
+
+    # -- bridges ---------------------------------------------------------------
+    def to_mlframe(self, ctx):
+        from cycloneml_tpu.dataset.frame import MLFrame
+        return MLFrame(ctx, self.to_dict())
+
+    def __repr__(self):
+        return f"DataFrame[{', '.join(self.columns)}]"
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, group_exprs: List[Expr]):
+        self.df = df
+        self.group_exprs = group_exprs
+
+    def agg(self, *cols) -> DataFrame:
+        exprs = []
+        for c in cols:
+            e = c.expr if isinstance(c, Column) else ColumnRef(c)
+            if not isinstance(e, (Alias, ColumnRef)):
+                e = Alias(e, e.name_hint())
+            exprs.append(e)
+        return DataFrame(Aggregate(self.df.plan, self.group_exprs, exprs),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        return self.agg(F.count("*").alias("count"))
+
+    def sum(self, *names: str) -> DataFrame:
+        return self.agg(*[F.sum(n).alias(f"sum({n})") for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        return self.agg(*[F.avg(n).alias(f"avg({n})") for n in names])
+
+    def min(self, *names: str) -> DataFrame:
+        return self.agg(*[F.min(n).alias(f"min({n})") for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        return self.agg(*[F.max(n).alias(f"max({n})") for n in names])

@@ -1,0 +1,86 @@
+"""User-facing SQL functions (ref: sql/core/.../functions.scala surface)."""
+
+from __future__ import annotations
+
+from cycloneml_tpu.sql.column import (AvgAgg, CaseWhen, CollectListAgg, Column,
+                                      CountAgg, CountDistinctAgg, FirstAgg,
+                                      Func, Literal, MaxAgg, MinAgg, SumAgg,
+                                      _to_expr, col, lit)
+
+__all__ = ["col", "lit", "sum", "avg", "mean", "count", "count_distinct",
+           "min", "max", "first", "collect_list", "abs", "sqrt", "exp", "log",
+           "floor", "ceil", "round", "upper", "lower", "length", "concat",
+           "coalesce", "when", "isnull"]
+
+
+def _c(name_or_col) -> Column:
+    return name_or_col if isinstance(name_or_col, Column) else col(name_or_col)
+
+
+def sum(c) -> Column:  # noqa: A001 — mirrors the reference's name
+    return Column(SumAgg(_c(c).expr))
+
+
+def avg(c) -> Column:
+    return Column(AvgAgg(_c(c).expr))
+
+
+mean = avg
+
+
+def count(c="*") -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(CountAgg(None))
+    return Column(CountAgg(_c(c).expr))
+
+
+def count_distinct(c) -> Column:
+    return Column(CountDistinctAgg(_c(c).expr))
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(MinAgg(_c(c).expr))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(MaxAgg(_c(c).expr))
+
+
+def first(c) -> Column:
+    return Column(FirstAgg(_c(c).expr))
+
+
+def collect_list(c) -> Column:
+    return Column(CollectListAgg(_c(c).expr))
+
+
+def _scalar(fname):
+    def f(c) -> Column:
+        return Column(Func(fname, _c(c).expr))
+    f.__name__ = fname
+    return f
+
+
+abs = _scalar("abs")  # noqa: A001
+sqrt = _scalar("sqrt")
+exp = _scalar("exp")
+log = _scalar("log")
+floor = _scalar("floor")
+ceil = _scalar("ceil")
+round = _scalar("round")  # noqa: A001
+upper = _scalar("upper")
+lower = _scalar("lower")
+length = _scalar("length")
+isnull = _scalar("isnull")
+
+
+def concat(*cols) -> Column:
+    return Column(Func("concat", *[_c(c).expr for c in cols]))
+
+
+def coalesce(*cols) -> Column:
+    return Column(Func("coalesce", *[_c(c).expr for c in cols]))
+
+
+def when(cond: Column, value) -> Column:
+    return Column(CaseWhen([cond.expr, _to_expr(value)]))

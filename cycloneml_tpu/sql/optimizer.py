@@ -1,0 +1,179 @@
+"""Rule-based plan optimizer.
+
+Analog of Catalyst's ``Optimizer`` batches (ref: catalyst/optimizer/
+Optimizer.scala:42, defaultBatches:77) with the rules that matter for a
+columnar in-memory engine: constant folding, filter combination + pushdown
+(through projects and to either side of joins), project collapsing, and
+column pruning into scans. Fixed-point iteration like RuleExecutor
+(ref: catalyst/rules/RuleExecutor.scala)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from cycloneml_tpu.sql.column import (Alias, BinaryOp, ColumnRef, Expr,
+                                      Literal)
+from cycloneml_tpu.sql.plan import (Aggregate, Distinct, Filter, Join, Limit,
+                                    LogicalPlan, Project, Scan, Sort, Union)
+
+
+def split_conjuncts(e: Expr) -> List[Expr]:
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return split_conjuncts(e.children[0]) + split_conjuncts(e.children[1])
+    return [e]
+
+
+def join_conjuncts(parts: List[Expr]) -> Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = BinaryOp("and", out, p)
+    return out
+
+
+def fold_constants(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    if isinstance(plan, Filter):
+        return Filter(plan.children[0], plan.cond.fold())
+    if isinstance(plan, Project):
+        return Project(plan.children[0], [e.fold() for e in plan.exprs])
+    return None
+
+
+def combine_filters(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    if isinstance(plan, Filter) and isinstance(plan.children[0], Filter):
+        inner = plan.children[0]
+        return Filter(inner.children[0],
+                      BinaryOp("and", inner.cond, plan.cond))
+    return None
+
+
+def _substitute(e: Expr, mapping) -> Expr:
+    return e.transform(lambda node: mapping.get(node.name)
+                       if isinstance(node, ColumnRef) else None)
+
+
+def push_filter_through_project(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Filter(Project(c)) → Project(Filter(c)) when the condition only uses
+    columns the project passes through or cheap deterministic exprs."""
+    if not (isinstance(plan, Filter) and isinstance(plan.children[0], Project)):
+        return None
+    proj = plan.children[0]
+    mapping = {}
+    for e in proj.exprs:
+        mapping[e.name_hint()] = e.children[0] if isinstance(e, Alias) else e
+    refs = plan.cond.references()
+    if not refs <= set(mapping):
+        return None
+    new_cond = _substitute(plan.cond, mapping)
+    return Project(Filter(proj.children[0], new_cond), proj.exprs)
+
+
+def push_filter_through_join(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Send single-sided conjuncts below an inner join (ref
+    PushPredicateThroughJoin)."""
+    if not (isinstance(plan, Filter) and isinstance(plan.children[0], Join)):
+        return None
+    join = plan.children[0]
+    if join.how != "inner":
+        return None
+    left, right = join.children
+    lcols, rcols = set(left.output()), set(right.output())
+    l_parts, r_parts, keep = [], [], []
+    for c in split_conjuncts(plan.cond):
+        refs = c.references()
+        if refs and refs <= lcols:
+            l_parts.append(c)
+        elif refs and refs <= rcols:
+            r_parts.append(c)
+        else:
+            keep.append(c)
+    if not l_parts and not r_parts:
+        return None
+    if l_parts:
+        left = Filter(left, join_conjuncts(l_parts))
+    if r_parts:
+        right = Filter(right, join_conjuncts(r_parts))
+    new = Join(left, right, join.on, join.how)
+    return Filter(new, join_conjuncts(keep)) if keep else new
+
+
+def collapse_projects(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    if not (isinstance(plan, Project) and isinstance(plan.children[0], Project)):
+        return None
+    inner = plan.children[0]
+    mapping = {}
+    for e in inner.exprs:
+        mapping[e.name_hint()] = e.children[0] if isinstance(e, Alias) else e
+    if not all(e.references() <= set(mapping) for e in plan.exprs):
+        return None
+    new_exprs = []
+    for e in plan.exprs:
+        sub = _substitute(e, mapping)
+        if not isinstance(sub, Alias):
+            sub = Alias(sub, e.name_hint())
+        new_exprs.append(sub)
+    return Project(inner.children[0], new_exprs)
+
+
+def prune_columns(plan: LogicalPlan) -> LogicalPlan:
+    """Top-down required-column propagation into Scan.columns (ref
+    ColumnPruning + V2 column pushdown)."""
+
+    def required_of(p: LogicalPlan, needed: set) -> LogicalPlan:
+        if isinstance(p, Scan):
+            cols = [c for c in p.data if c in needed]
+            if not cols and p.data:
+                # keep one column so batch row-count survives (a pure-literal
+                # projection still emits one value per input row)
+                cols = [next(iter(p.data))]
+            return Scan(p.data, p.name, cols)
+        if isinstance(p, Project):
+            child_needed = set()
+            for e in p.exprs:
+                child_needed |= e.references()
+            return Project(required_of(p.children[0], child_needed), p.exprs)
+        if isinstance(p, Filter):
+            return Filter(required_of(p.children[0],
+                                      needed | p.cond.references()), p.cond)
+        if isinstance(p, Aggregate):
+            child_needed = set()
+            for e in p.group_exprs + p.agg_exprs:
+                child_needed |= e.references()
+            return Aggregate(required_of(p.children[0], child_needed),
+                             p.group_exprs, p.agg_exprs)
+        if isinstance(p, Join):
+            lcols = set(p.children[0].output())
+            rcols = set(p.children[1].output())
+            lneed = (needed & lcols) | {l for l, _ in p.on}
+            rneed = (needed & rcols) | {r for _, r in p.on}
+            return Join(required_of(p.children[0], lneed),
+                        required_of(p.children[1], rneed), p.on, p.how)
+        if isinstance(p, Sort):
+            child_needed = set(needed)
+            for o in p.orders:
+                child_needed |= o.references()
+            return Sort(required_of(p.children[0], child_needed), p.orders)
+        if isinstance(p, (Limit, Distinct, Union)):
+            # these preserve/require their full schema
+            return p.with_children([required_of(c, set(c.output()))
+                                    for c in p.children])
+        return p.with_children([required_of(c, set(c.output()))
+                                for c in p.children])
+
+    return required_of(plan, set(plan.output()))
+
+
+_REWRITE_RULES = [fold_constants, combine_filters, push_filter_through_project,
+                  push_filter_through_join, collapse_projects]
+
+
+def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:
+    """Fixed-point rewrite batches then one pruning pass."""
+    for _ in range(max_iterations):
+        changed = False
+        for rule in _REWRITE_RULES:
+            new = plan.transform_up(rule)
+            if new.tree_string() != plan.tree_string():
+                plan, changed = new, True
+        if not changed:
+            break
+    return prune_columns(plan)

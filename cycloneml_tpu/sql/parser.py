@@ -1,0 +1,494 @@
+"""SQL text frontend.
+
+Recursive-descent parser for the SELECT subset that covers the reference's
+common query shapes (ref: sql/catalyst/.../parser/ — the ANTLR grammar
+SqlBaseParser.g4; a generated parser is unnecessary at this grammar size):
+
+  SELECT [DISTINCT] items FROM src [JOINs] [WHERE] [GROUP BY] [HAVING]
+  [ORDER BY] [LIMIT], expressions with arithmetic/comparison/AND/OR/NOT,
+  function calls, CASE WHEN, IN, BETWEEN, LIKE, IS [NOT] NULL, subqueries in
+  FROM, and table aliases. Produces the same LogicalPlan nodes the DataFrame
+  API builds — one analyzer path (ref Analyzer.scala batches collapse into
+  name resolution done lazily at execution).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from cycloneml_tpu.sql.column import (Alias, BinaryOp, CaseWhen, ColumnRef,
+                                      CountAgg, Expr, Func, InExpr, Literal,
+                                      SortOrder, UnaryOp)
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.plan import (Aggregate, Distinct, Filter, Join, Limit,
+                                    LogicalPlan, Project, Sort)
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d*|\.\d+|\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)
+    | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having", "order",
+    "limit", "as", "and", "or", "not", "in", "is", "null", "like", "between",
+    "case", "when", "then", "else", "end", "join", "inner", "left", "right",
+    "full", "outer", "cross", "on", "asc", "desc", "true", "false", "union",
+    "all", "using",
+}
+
+_AGG_FNS = {"sum": F.sum, "avg": F.avg, "mean": F.avg, "min": F.min,
+            "max": F.max, "count": F.count, "count_distinct": F.count_distinct,
+            "first": F.first, "collect_list": F.collect_list}
+
+
+def tokenize(s: str) -> List[Tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN_RE.match(s, pos)
+        if not m or m.end() == pos:
+            if s[pos:].strip():
+                raise ValueError(f"cannot tokenize SQL at: {s[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        if m.group("num"):
+            out.append(("num", m.group("num")))
+        elif m.group("str"):
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("op"):
+            out.append(("op", m.group("op")))
+        else:
+            word = m.group("ident")
+            kind = "kw" if word.lower() in _KEYWORDS else "ident"
+            out.append((kind, word.lower() if kind == "kw" else word))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]], catalog=None):
+        self.toks = tokens
+        self.i = 0
+        self.catalog = catalog or {}
+
+    # -- token helpers ---------------------------------------------------------
+    def peek(self, k: int = 0) -> Tuple[str, str]:
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else ("eof", "")
+
+    def next(self) -> Tuple[str, str]:
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> bool:
+        k, v = self.peek()
+        if k == kind and (value is None or v == value):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kind: str, value: Optional[str] = None) -> str:
+        k, v = self.next()
+        if k != kind or (value is not None and v != value):
+            raise ValueError(f"expected {value or kind}, got {v!r} "
+                             f"(token {self.i - 1})")
+        return v
+
+    # -- query -----------------------------------------------------------------
+    def parse_query(self) -> LogicalPlan:
+        self.expect("kw", "select")
+        distinct = self.accept("kw", "distinct")
+        items = self.parse_select_list()
+        self.expect("kw", "from")
+        plan = self.parse_table_ref()
+        while self.peek()[0] == "kw" and self.peek()[1] in (
+                "join", "inner", "left", "right", "full", "cross"):
+            plan = self.parse_join(plan)
+        where = None
+        if self.accept("kw", "where"):
+            where = self.parse_expr()
+        group: List[Expr] = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group = [self.parse_expr()]
+            while self.accept("op", ","):
+                group.append(self.parse_expr())
+        having = None
+        if self.accept("kw", "having"):
+            having = self.parse_expr()
+        orders: List[SortOrder] = []
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            orders.append(self.parse_order_item())
+            while self.accept("op", ","):
+                orders.append(self.parse_order_item())
+        limit = None
+        if self.accept("kw", "limit"):
+            limit = int(self.expect("num"))
+
+        if where is not None:
+            plan = Filter(plan, where)
+        expanded: List[Expr] = []
+        for e in items:  # SELECT * expands against the FROM schema
+            if isinstance(e, ColumnRef) and e.name == "*":
+                expanded.extend(ColumnRef(n) for n in plan.output())
+            else:
+                expanded.append(e)
+        items = expanded
+        has_agg = group or any(e.find_aggregates() for e in items)
+        if has_agg:
+            # Split SELECT items: expressions matching a GROUP BY key project
+            # that key's aggregate output (possibly re-aliased); everything
+            # else becomes an aggregate output. proj preserves SELECT order.
+            key_out = {str(g): g.name_hint() for g in group}
+            aggs: List[Expr] = []
+            proj: List[Expr] = []
+            for e in items:
+                base = e.children[0] if isinstance(e, Alias) else e
+                if str(base) in key_out:
+                    src = key_out[str(base)]
+                    proj.append(Alias(ColumnRef(src), e.name_hint())
+                                if e.name_hint() != src else ColumnRef(src))
+                else:
+                    aggs.append(e)
+                    proj.append(ColumnRef(e.name_hint()))
+            if having is not None:
+                aggs = aggs + [Alias(having, "__having__")]
+            # ORDER BY runs pre-projection (aggregate outputs + group keys
+            # are in scope there): aggregate order exprs map to (possibly
+            # hidden) aggregate output columns; plain refs to select aliases
+            # map back to the underlying group-key output
+            alias_map = {}
+            for e in items:
+                base = e.children[0] if isinstance(e, Alias) else e
+                if str(base) in key_out:
+                    alias_map[e.name_hint()] = key_out[str(base)]
+            new_orders: List[SortOrder] = []
+            for i, o in enumerate(orders):
+                child = o.children[0]
+                if child.find_aggregates():
+                    name = None
+                    for e in aggs:
+                        b = e.children[0] if isinstance(e, Alias) else e
+                        if str(b) == str(child):
+                            name = e.name_hint()
+                            break
+                    if name is None:
+                        name = f"__sort_{i}"
+                        aggs = aggs + [Alias(child, name)]
+                    new_orders.append(SortOrder(ColumnRef(name), o.ascending))
+                else:
+                    rewritten = child.transform(
+                        lambda node: ColumnRef(alias_map[node.name])
+                        if isinstance(node, ColumnRef)
+                        and node.name in alias_map else None)
+                    new_orders.append(SortOrder(rewritten, o.ascending))
+            plan = Aggregate(plan, group, aggs)
+            if having is not None:
+                plan = Filter(plan, ColumnRef("__having__"))
+            if new_orders:
+                plan = Sort(plan, new_orders)
+                orders = []
+            plan = Project(plan, proj)
+        else:
+            # ORDER BY may reference columns the SELECT drops (Spark resolves
+            # sort attributes against the child schema): sort below the project
+            pre = plan
+            out_names = {(e.name_hint()) for e in items}
+            hidden = orders and any(not (o.references() <= out_names)
+                                    for o in orders)
+            if hidden:
+                plan = Project(Sort(pre, orders), items)
+                orders = []
+            else:
+                plan = Project(plan, items)
+            if having is not None:
+                # HAVING without grouping/aggregates: post-projection filter
+                plan = Filter(plan, having)
+        if distinct:
+            plan = Distinct(plan)
+        if orders:
+            plan = Sort(plan, orders)
+        if limit is not None:
+            plan = Limit(plan, limit)
+        return plan
+
+    def parse_select_list(self) -> List[Expr]:
+        items = [self.parse_select_item()]
+        while self.accept("op", ","):
+            items.append(self.parse_select_item())
+        return items
+
+    def parse_select_item(self) -> Expr:
+        if self.peek() == ("op", "*"):
+            self.next()
+            return ColumnRef("*")
+        e = self.parse_expr()
+        if self.accept("kw", "as"):
+            return Alias(e, self.expect("ident"))
+        if self.peek()[0] == "ident":
+            return Alias(e, self.next()[1])
+        if not isinstance(e, (ColumnRef, Alias)):
+            return Alias(e, e.name_hint())
+        return e
+
+    def parse_order_item(self) -> SortOrder:
+        e = self.parse_expr()
+        asc = True
+        if self.accept("kw", "desc"):
+            asc = False
+        else:
+            self.accept("kw", "asc")
+        return SortOrder(e, asc)
+
+    def parse_table_ref(self) -> LogicalPlan:
+        if self.accept("op", "("):
+            sub = self.parse_query()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            if self.peek()[0] == "ident":
+                self.next()  # alias name — columns are unqualified
+            return sub
+        name = self.expect("ident")
+        if name not in self.catalog:
+            raise ValueError(f"table {name!r} not found; registered: "
+                             f"{list(self.catalog)}")
+        plan = self.catalog[name]
+        self.accept("kw", "as")
+        if self.peek()[0] == "ident":
+            self.next()
+        return plan
+
+    def parse_join(self, left: LogicalPlan) -> LogicalPlan:
+        how = "inner"
+        if self.accept("kw", "cross"):
+            how = "cross"
+        elif self.accept("kw", "left"):
+            self.accept("kw", "outer")
+            how = "left"
+        elif self.accept("kw", "right"):
+            self.accept("kw", "outer")
+            how = "right"
+        elif self.accept("kw", "full"):
+            self.accept("kw", "outer")
+            how = "outer"
+        else:
+            self.accept("kw", "inner")
+        self.expect("kw", "join")
+        right = self.parse_table_ref()
+        pairs: List[Tuple[str, str]] = []
+        if self.accept("kw", "using"):
+            self.expect("op", "(")
+            pairs.append((self.expect("ident"),) * 2)
+            while self.accept("op", ","):
+                pairs.append((self.expect("ident"),) * 2)
+            self.expect("op", ")")
+        elif self.accept("kw", "on"):
+            pairs.append(self.parse_eq_pair())
+            while self.accept("kw", "and"):
+                pairs.append(self.parse_eq_pair())
+        elif how != "cross":
+            raise ValueError("JOIN requires ON or USING")
+        return Join(left, right, pairs, how)
+
+    def parse_eq_pair(self) -> Tuple[str, str]:
+        a = self.parse_qualified_name()
+        self.expect("op", "=")
+        b = self.parse_qualified_name()
+        return (a, b)
+
+    def parse_qualified_name(self) -> str:
+        name = self.expect("ident")
+        if self.accept("op", "."):
+            name = self.expect("ident")  # qualifier dropped: names are global
+        return name
+
+    # -- expressions (precedence climbing) ------------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        e = self.parse_and()
+        while self.accept("kw", "or"):
+            e = BinaryOp("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Expr:
+        e = self.parse_not()
+        while self.accept("kw", "and"):
+            e = BinaryOp("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Expr:
+        if self.accept("kw", "not"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Expr:
+        e = self.parse_additive()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            op = "!=" if v == "<>" else v
+            return BinaryOp(op, e, self.parse_additive())
+        if k == "kw" and v == "is":
+            self.next()
+            neg = self.accept("kw", "not")
+            self.expect("kw", "null")
+            out = Func("isnull", e)
+            return UnaryOp("not", out) if neg else out
+        neg = False
+        if k == "kw" and v == "not":
+            # NOT IN / NOT LIKE / NOT BETWEEN
+            nk, nv = self.peek(1)
+            if nk == "kw" and nv in ("in", "like", "between"):
+                self.next()
+                neg = True
+                k, v = self.peek()
+        if k == "kw" and v == "in":
+            self.next()
+            self.expect("op", "(")
+            vals = [self.parse_literal_value()]
+            while self.accept("op", ","):
+                vals.append(self.parse_literal_value())
+            self.expect("op", ")")
+            out = InExpr(e, vals)
+            return UnaryOp("not", out) if neg else out
+        if k == "kw" and v == "like":
+            self.next()
+            pat = self.expect("str")
+            out = Func("like", e, Literal(pat))
+            return UnaryOp("not", out) if neg else out
+        if k == "kw" and v == "between":
+            self.next()
+            lo = self.parse_additive()
+            self.expect("kw", "and")
+            hi = self.parse_additive()
+            out = BinaryOp("and", BinaryOp(">=", e, lo), BinaryOp("<=", e, hi))
+            return UnaryOp("not", out) if neg else out
+        return e
+
+    def parse_literal_value(self):
+        k, v = self.next()
+        if k == "num":
+            return float(v) if "." in v else int(v)
+        if k == "str":
+            return v
+        if (k, v) == ("op", "-"):
+            k2, v2 = self.next()
+            if k2 == "num":
+                return -(float(v2) if "." in v2 else int(v2))
+        raise ValueError(f"expected literal, got {v!r}")
+
+    def parse_additive(self) -> Expr:
+        e = self.parse_multiplicative()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("+", "-"):
+                self.next()
+                e = BinaryOp(v, e, self.parse_multiplicative())
+            else:
+                return e
+
+    def parse_multiplicative(self) -> Expr:
+        e = self.parse_unary()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("*", "/", "%"):
+                self.next()
+                e = BinaryOp(v, e, self.parse_unary())
+            else:
+                return e
+
+    def parse_unary(self) -> Expr:
+        if self.accept("op", "-"):
+            return UnaryOp("-", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        k, v = self.peek()
+        if k == "num":
+            self.next()
+            return Literal(float(v) if "." in v else int(v))
+        if k == "str":
+            self.next()
+            return Literal(v)
+        if (k, v) == ("kw", "null"):
+            self.next()
+            return Literal(None)
+        if (k, v) == ("kw", "true"):
+            self.next()
+            return Literal(True)
+        if (k, v) == ("kw", "false"):
+            self.next()
+            return Literal(False)
+        if (k, v) == ("kw", "case"):
+            return self.parse_case()
+        if (k, v) == ("op", "("):
+            self.next()
+            e = self.parse_expr()
+            self.expect("op", ")")
+            return e
+        if k == "ident":
+            name = self.next()[1]
+            if self.accept("op", "("):
+                return self.parse_call(name)
+            if self.accept("op", "."):
+                return ColumnRef(self.expect("ident"))
+            return ColumnRef(name)
+        raise ValueError(f"unexpected token {v!r} in expression")
+
+    def parse_call(self, name: str) -> Expr:
+        lname = name.lower()
+        if lname == "count" and self.peek() == ("op", "*"):
+            self.next()
+            self.expect("op", ")")
+            return CountAgg(None)
+        if lname == "count" and self.peek() == ("kw", "distinct"):
+            self.next()
+            arg = self.parse_expr()
+            self.expect("op", ")")
+            from cycloneml_tpu.sql.column import CountDistinctAgg
+            return CountDistinctAgg(arg)
+        args = []
+        if not self.accept("op", ")"):
+            args.append(self.parse_expr())
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+        if lname in _AGG_FNS:
+            from cycloneml_tpu.sql.column import Column
+            return _AGG_FNS[lname](Column(args[0])).expr
+        return Func(lname, *args)
+
+    def parse_case(self) -> Expr:
+        self.expect("kw", "case")
+        branches: List[Expr] = []
+        while self.accept("kw", "when"):
+            cond = self.parse_expr()
+            self.expect("kw", "then")
+            branches.extend([cond, self.parse_expr()])
+        otherwise = None
+        if self.accept("kw", "else"):
+            otherwise = self.parse_expr()
+        self.expect("kw", "end")
+        return CaseWhen(branches, otherwise)
+
+
+def parse_sql(sql: str, catalog) -> LogicalPlan:
+    p = _Parser(tokenize(sql), catalog)
+    plan = p.parse_query()
+    if p.peek()[0] != "eof":
+        raise ValueError(f"trailing tokens after query: {p.peek()}")
+    return plan
+
+
+def parse_expression(s: str) -> Expr:
+    p = _Parser(tokenize(s))
+    e = p.parse_expr()
+    if p.peek()[0] != "eof":
+        raise ValueError(f"trailing tokens in expression: {p.peek()}")
+    return e

@@ -1,0 +1,415 @@
+"""Logical plans + columnar execution.
+
+Analog of Catalyst's ``LogicalPlan`` tree and the physical operators in one
+layer (ref: sql/catalyst/.../plans/logical/basicLogicalOperators.scala;
+execution: HashAggregateExec, SortMergeJoinExec, SortExec). The reference
+needs separate logical/physical trees because physical operators carry
+codegen/exchange machinery; here execution is vectorized columnar numpy (the
+Tungsten-equivalent memory layout is numpy's contiguous arrays — SURVEY §2.6
+UnsafeRow row) and a plan node *is* executable, so one tree serves both.
+Exchange/shuffle nodes do not exist: this is the host ETL tier; the numeric
+path exchanges data with compiled collectives (SURVEY §2.7).
+
+Batches: dict[str, np.ndarray] (all equal length). Joins/aggregates factorize
+keys with np.unique — the hash-shuffle analog without the shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.sql.column import (AggExpr, ColumnRef, Expr, SortOrder,
+                                      _batch_len as _batch_n)
+
+Batch = Dict[str, np.ndarray]
+
+
+class LogicalPlan:
+    children: List["LogicalPlan"] = []
+
+    def output(self) -> List[str]:
+        raise NotImplementedError
+
+    def execute(self) -> Batch:
+        raise NotImplementedError
+
+    def with_children(self, children: List["LogicalPlan"]) -> "LogicalPlan":
+        return self
+
+    def transform_up(self, fn: Callable[["LogicalPlan"], Optional["LogicalPlan"]]):
+        new = self.with_children([c.transform_up(fn) for c in self.children])
+        out = fn(new)
+        return out if out is not None else new
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + repr(self) + "\n"
+        return s + "".join(c.tree_string(indent + 1) for c in self.children)
+
+
+class Scan(LogicalPlan):
+    """In-memory columnar table; ``columns`` narrows materialization (the
+    column-pruning target, ref DataSource pushdown)."""
+
+    def __init__(self, data: Batch, name: str = "scan",
+                 columns: Optional[List[str]] = None):
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        self.name = name
+        self.columns = columns
+        self.children = []
+
+    def output(self):
+        return self.columns if self.columns is not None else list(self.data)
+
+    def execute(self):
+        if self.columns is None:
+            return dict(self.data)
+        return {k: self.data[k] for k in self.columns}
+
+    def __repr__(self):
+        cols = f" cols={self.columns}" if self.columns is not None else ""
+        return f"Scan({self.name}{cols})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: List[Expr]):
+        self.children = [child]
+        self.exprs = exprs
+
+    def with_children(self, c):
+        return Project(c[0], self.exprs)
+
+    def output(self):
+        return [e.name_hint() for e in self.exprs]
+
+    def execute(self):
+        batch = self.children[0].execute()
+        n = _batch_n(batch)
+        out: Batch = {}
+        for e in self.exprs:
+            v = np.atleast_1d(np.asarray(e.eval(batch)))
+            if v.shape[0] != n and v.shape[0] == 1:
+                v = np.broadcast_to(v, (n,) + v.shape[1:]).copy()
+            out[e.name_hint()] = v
+        return out
+
+    def __repr__(self):
+        return f"Project({', '.join(map(str, self.exprs))})"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, cond: Expr):
+        self.children = [child]
+        self.cond = cond
+
+    def with_children(self, c):
+        return Filter(c[0], self.cond)
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        mask = np.asarray(self.cond.eval(batch), dtype=bool)
+        if mask.ndim == 0:
+            if bool(mask):
+                return batch
+            return {k: v[:0] for k, v in batch.items()}
+        return {k: v[mask] for k, v in batch.items()}
+
+    def __repr__(self):
+        return f"Filter({self.cond})"
+
+
+def _factorize(cols: Sequence[np.ndarray]) -> Tuple[np.ndarray, int, np.ndarray]:
+    """Combine key columns into dense group codes.
+
+    Returns (codes, n_groups, representative_row_index_per_group)."""
+    n = len(cols[0])
+    codes = np.zeros(n, dtype=np.int64)
+    for c in cols:
+        c = np.asarray(c)
+        if c.dtype == object:
+            c = np.array([repr(x) for x in c])
+        _, inv = np.unique(c, return_inverse=True)
+        codes = codes * (inv.max(initial=0) + 1) + inv
+    uniq, first_idx, inv = np.unique(codes, return_index=True, return_inverse=True)
+    return inv, len(uniq), first_idx
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregation. ``agg_exprs`` may be arbitrary expressions over
+    AggExpr results (e.g. sum(x)/count(x) + 1)."""
+
+    def __init__(self, child: LogicalPlan, group_exprs: List[Expr],
+                 agg_exprs: List[Expr]):
+        self.children = [child]
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+
+    def with_children(self, c):
+        return Aggregate(c[0], self.group_exprs, self.agg_exprs)
+
+    def output(self):
+        return ([e.name_hint() for e in self.group_exprs]
+                + [e.name_hint() for e in self.agg_exprs])
+
+    def execute(self):
+        batch = self.children[0].execute()
+        n = _batch_n(batch)
+        if self.group_exprs:
+            keys = [np.atleast_1d(e.eval(batch)) for e in self.group_exprs]
+            codes, n_groups, first_idx = _factorize(keys)
+        else:
+            keys = []
+            codes = np.zeros(n, dtype=np.int64)
+            n_groups, first_idx = 1, np.array([0] if n else [0])
+
+        # compute each distinct aggregate once
+        agg_results: Dict[str, np.ndarray] = {}
+        group_batch: Batch = {}
+        for e, vals in zip(self.group_exprs, keys):
+            group_batch[e.name_hint()] = (vals[first_idx] if n else vals[:0])
+        for e in self.agg_exprs:
+            for a in e.find_aggregates():
+                key = f"__agg_{a}"
+                if key in agg_results:
+                    continue
+                child_vals = (np.atleast_1d(a.children[0].eval(batch))
+                              if a.children else None)
+                if child_vals is not None and child_vals.shape[0] != n:
+                    child_vals = np.broadcast_to(child_vals, (n,)).copy()
+                agg_results[key] = a.agg(child_vals, codes, n_groups)
+        group_batch.update(agg_results)
+        group_batch["__len__"] = n_groups
+
+        out: Batch = {}
+        for e in self.group_exprs:
+            out[e.name_hint()] = group_batch[e.name_hint()]
+        for e in self.agg_exprs:
+            rewritten = e.transform(
+                lambda node: ColumnRef(f"__agg_{node}")
+                if isinstance(node, AggExpr) else None)
+            v = np.atleast_1d(np.asarray(rewritten.eval(group_batch)))
+            if v.shape[0] == 1 and n_groups != 1:
+                v = np.broadcast_to(v, (n_groups,)).copy()
+            out[e.name_hint()] = v
+        return out
+
+    def __repr__(self):
+        return (f"Aggregate(keys=[{', '.join(map(str, self.group_exprs))}], "
+                f"aggs=[{', '.join(map(str, self.agg_exprs))}])")
+
+
+class Join(LogicalPlan):
+    """Equi-join via key factorization + searchsorted probe — the hash/sort-
+    merge join analog (ref: execution/joins/SortMergeJoinExec.scala) without
+    an exchange."""
+
+    HOW = ("inner", "left", "right", "outer", "left_semi", "left_anti", "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 on: List[Tuple[str, str]], how: str = "inner"):
+        if how not in self.HOW:
+            raise ValueError(f"unknown join type {how!r}")
+        self.children = [left, right]
+        self.on = on
+        self.how = how
+
+    def with_children(self, c):
+        return Join(c[0], c[1], self.on, self.how)
+
+    def output(self):
+        left, right = self.children[0].output(), self.children[1].output()
+        if self.how in ("left_semi", "left_anti"):
+            return left
+        rkeys = {r for _, r in self.on}
+        dup = [c for c in right if c in left and c not in rkeys]
+        if dup:
+            raise ValueError(
+                f"ambiguous columns {dup}; rename before joining")
+        return left + [c for c in right if c not in rkeys]
+
+    def execute(self):
+        lb = self.children[0].execute()
+        rb = self.children[1].execute()
+        nl, nr = _batch_n(lb), _batch_n(rb)
+        if self.how == "cross":
+            li = np.repeat(np.arange(nl), nr)
+            ri = np.tile(np.arange(nr), nl)
+            return self._emit(lb, rb, li, ri, None, None)
+
+        lkeys = [np.asarray(lb[l]) for l, _ in self.on]
+        rkeys = [np.asarray(rb[r]) for _, r in self.on]
+        codes, _, _ = _factorize([np.concatenate([lk, rk])
+                                  for lk, rk in zip(lkeys, rkeys)])
+        lcodes, rcodes = codes[:nl], codes[nl:]
+        order = np.argsort(rcodes, kind="stable")
+        sorted_r = rcodes[order]
+        starts = np.searchsorted(sorted_r, lcodes, "left")
+        ends = np.searchsorted(sorted_r, lcodes, "right")
+        counts = ends - starts
+
+        if self.how == "left_semi":
+            mask = counts > 0
+            return {k: v[mask] for k, v in lb.items()}
+        if self.how == "left_anti":
+            mask = counts == 0
+            return {k: v[mask] for k, v in lb.items()}
+
+        li = np.repeat(np.arange(nl), counts)
+        ri = order[np.concatenate([np.arange(s, e) for s, e in zip(starts, ends)])
+                   ] if li.size else np.array([], dtype=np.int64)
+        l_unmatched = (np.nonzero(counts == 0)[0]
+                       if self.how in ("left", "outer") else None)
+        r_unmatched = None
+        if self.how in ("right", "outer"):
+            matched_r = np.zeros(nr, dtype=bool)
+            matched_r[ri] = True
+            r_unmatched = np.nonzero(~matched_r)[0]
+        return self._emit(lb, rb, li, ri, l_unmatched, r_unmatched)
+
+    def _emit(self, lb, rb, li, ri, l_unmatched, r_unmatched):
+        rkeys = {r for _, r in self.on}
+        key_map = dict(self.on)
+        out: Batch = {}
+
+        def _nulls(template, count):
+            if template.dtype == object or template.dtype.kind in "US":
+                return np.full(count, None, dtype=object)
+            return np.full(count, np.nan)
+
+        n_lu = len(l_unmatched) if l_unmatched is not None else 0
+        n_ru = len(r_unmatched) if r_unmatched is not None else 0
+        for k, v in lb.items():
+            parts = [v[li]]
+            if n_lu:
+                parts.append(v[l_unmatched])
+            if n_ru:
+                # left key columns take the right key values for right-unmatched
+                rk = key_map.get(k)
+                parts.append(np.asarray(rb[rk])[r_unmatched] if rk is not None
+                             else _nulls(v, n_ru))
+            out[k] = _concat(parts)
+        for k, v in rb.items():
+            if k in rkeys:
+                continue
+            parts = [v[ri]]
+            if n_lu:
+                parts.append(_nulls(v, n_lu))
+            if n_ru:
+                parts.append(v[r_unmatched])
+            out[k] = _concat(parts)
+        return out
+
+    def __repr__(self):
+        return f"Join({self.how}, on={self.on})"
+
+
+def _concat(parts: List[np.ndarray]) -> np.ndarray:
+    if len(parts) == 1:
+        return parts[0]
+    if any(p.dtype == object for p in parts):
+        parts = [np.asarray(p, dtype=object) for p in parts]
+    elif any(np.issubdtype(p.dtype, np.floating) for p in parts):
+        parts = [np.asarray(p, dtype=np.float64) for p in parts]
+    return np.concatenate(parts)
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: List[SortOrder]):
+        self.children = [child]
+        self.orders = orders
+
+    def with_children(self, c):
+        return Sort(c[0], self.orders)
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        keys = []
+        for o in self.orders:
+            v = np.atleast_1d(o.eval(batch))
+            if v.dtype == object or v.dtype.kind in "US":
+                # rank object values by their natural order when comparable;
+                # repr-ranking only as a last resort (mixed types)
+                try:
+                    _, inv = np.unique(v, return_inverse=True)
+                except TypeError:
+                    _, inv = np.unique(np.array([repr(x) for x in v]),
+                                       return_inverse=True)
+                v = inv
+            v = np.asarray(v, dtype=float)
+            keys.append(v if o.ascending else -v)
+        idx = np.lexsort(tuple(reversed(keys)))
+        return {k: v[idx] for k, v in batch.items()}
+
+    def __repr__(self):
+        return f"Sort({', '.join(map(str, self.orders))})"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    def with_children(self, c):
+        return Limit(c[0], self.n)
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        return {k: v[: self.n] for k, v in batch.items()}
+
+    def __repr__(self):
+        return f"Limit({self.n})"
+
+
+class Union(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        if left.output() != right.output():
+            raise ValueError(f"union schema mismatch: {left.output()} vs "
+                             f"{right.output()}")
+        self.children = [left, right]
+
+    def with_children(self, c):
+        return Union(c[0], c[1])
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        a = self.children[0].execute()
+        b = self.children[1].execute()
+        return {k: _concat([np.asarray(a[k]), np.asarray(b[k])]) for k in a}
+
+    def __repr__(self):
+        return "Union"
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        self.children = [child]
+
+    def with_children(self, c):
+        return Distinct(c[0])
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        cols = [batch[k] for k in batch]
+        if not cols or not len(cols[0]):
+            return batch
+        _, _, first_idx = _factorize(cols)
+        first_idx = np.sort(first_idx)
+        return {k: v[first_idx] for k, v in batch.items()}
+
+    def __repr__(self):
+        return "Distinct"

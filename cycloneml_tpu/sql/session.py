@@ -1,0 +1,95 @@
+"""CycloneSession — the SQL entry point.
+
+Analog of ``SparkSession`` (ref: sql/core/.../SparkSession.scala:83): owns
+the temp-view catalog, builds DataFrames from host data or files, and parses
+SQL text. Views are named logical plans (ref: catalog + Analyzer relation
+resolution)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from cycloneml_tpu.sql.dataframe import DataFrame
+from cycloneml_tpu.sql.parser import parse_sql
+from cycloneml_tpu.sql.plan import Scan
+
+
+class CycloneSession:
+    def __init__(self, ctx=None):
+        self.ctx = ctx
+        self._catalog: Dict[str, Scan] = {}
+
+    # -- construction ----------------------------------------------------------
+    def create_data_frame(self, data, schema: Optional[Sequence[str]] = None
+                          ) -> DataFrame:
+        """From a columnar dict, a list of tuples + schema, or list of dicts."""
+        if isinstance(data, dict):
+            cols = {k: np.asarray(v) for k, v in data.items()}
+        elif data and isinstance(data[0], dict):
+            names = list(data[0])
+            cols = {n: np.asarray([row[n] for row in data]) for n in names}
+        else:
+            if schema is None:
+                raise ValueError("schema required for row data")
+            cols = {n: np.asarray([row[i] for row in data])
+                    for i, n in enumerate(schema)}
+        cols = {k: (v if v.dtype.kind not in "US" else v.astype(object))
+                for k, v in cols.items()}
+        return DataFrame(Scan(cols, "memory"), self)
+
+    createDataFrame = create_data_frame
+
+    def range(self, n: int) -> DataFrame:
+        return DataFrame(Scan({"id": np.arange(n, dtype=np.int64)}, "range"), self)
+
+    # -- catalog ---------------------------------------------------------------
+    def register_temp_view(self, name: str, df: DataFrame) -> None:
+        """(ref Dataset.createOrReplaceTempView)"""
+        batch = df.to_dict()  # views materialize: plans are cheap, data is host
+        self._catalog[name] = Scan(batch, name)
+
+    def table(self, name: str) -> DataFrame:
+        if name not in self._catalog:
+            raise KeyError(f"table {name!r} not registered")
+        return DataFrame(self._catalog[name], self)
+
+    def catalog_tables(self) -> List[str]:
+        return list(self._catalog)
+
+    # -- SQL -------------------------------------------------------------------
+    def sql(self, query: str) -> DataFrame:
+        return DataFrame(parse_sql(query, self._catalog), self)
+
+    # -- readers ---------------------------------------------------------------
+    def read_csv(self, path: str, header: bool = True,
+                 delimiter: str = ",") -> DataFrame:
+        """Numeric CSV via the native loader; header row names the columns."""
+        names: Optional[List[str]] = None
+        if header:
+            with open(path) as fh:
+                names = [c.strip() for c in fh.readline().rstrip("\n").split(delimiter)]
+        data = None
+        try:
+            from cycloneml_tpu.native.host import parse_csv_native
+            data = parse_csv_native(path, delimiter, skip_header=header)
+        except Exception:
+            pass
+        if data is None:
+            data = np.loadtxt(path, delimiter=delimiter,
+                              skiprows=1 if header else 0, ndmin=2)
+        if names is None:
+            names = [f"_c{i}" for i in range(data.shape[1])]
+        cols = {n: data[:, i] for i, n in enumerate(names[: data.shape[1]])}
+        return DataFrame(Scan(cols, path), self)
+
+    def read_libsvm(self, path: str, n_features: Optional[int] = None) -> DataFrame:
+        from cycloneml_tpu.dataset.io import parse_libsvm
+        x, y = parse_libsvm(path, n_features)
+        return DataFrame(Scan({"label": y, "features": x}, path), self)
+
+    # -- bridges ---------------------------------------------------------------
+    def from_mlframe(self, frame) -> DataFrame:
+        return DataFrame(Scan({k: frame[k] for k in frame.columns}, "mlframe"),
+                         self)

@@ -1,0 +1,89 @@
+"""SQL type system.
+
+Compact analog of ``sql/catalyst/.../types`` (ref: DataType.scala,
+StructType.scala). Columnar batches are dicts of numpy arrays, so types map
+onto numpy dtypes; vector columns (2-D float arrays) get ``VectorType`` —
+the ml.linalg UDT equivalent (ref: mllib/.../linalg/VectorUDT.scala)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+class DataType:
+    name = "data"
+
+    def __repr__(self):
+        return self.name
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+
+class DoubleType(DataType):
+    name = "double"
+
+
+class LongType(DataType):
+    name = "bigint"
+
+
+class BooleanType(DataType):
+    name = "boolean"
+
+
+class StringType(DataType):
+    name = "string"
+
+
+class VectorType(DataType):
+    name = "vector"
+
+
+@dataclass
+class StructField:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclass
+class StructType:
+    fields: List[StructField] = field(default_factory=list)
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def __getitem__(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}: {f.dtype}" for f in self.fields)
+        return f"struct<{inner}>"
+
+
+def infer_type(arr: np.ndarray) -> DataType:
+    if arr.ndim == 2:
+        return VectorType()
+    if arr.dtype == bool:
+        return BooleanType()
+    if np.issubdtype(arr.dtype, np.integer):
+        return LongType()
+    if np.issubdtype(arr.dtype, np.floating):
+        return DoubleType()
+    return StringType()
+
+
+def infer_schema(cols) -> StructType:
+    return StructType([StructField(k, infer_type(np.asarray(v)))
+                       for k, v in cols.items()])

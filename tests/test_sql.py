@@ -1,0 +1,284 @@
+"""SQL/DataFrame engine tests (ref: sql/core/src/test — DataFrameSuite,
+DataFrameAggregateSuite, DataFrameJoinSuite, SQLQuerySuite golden-file style
+assertions)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.sql import CycloneSession, col, functions as F, lit
+from cycloneml_tpu.sql.optimizer import optimize
+from cycloneml_tpu.sql.plan import Filter, Join, Project, Scan
+
+
+@pytest.fixture()
+def spark():
+    return CycloneSession()
+
+
+@pytest.fixture()
+def people(spark):
+    return spark.create_data_frame({
+        "name": ["alice", "bob", "carol", "dave", "eve"],
+        "age": [30, 25, 35, 25, 40],
+        "dept": [1, 2, 1, 2, 3],
+        "salary": [100.0, 80.0, 120.0, 90.0, 150.0],
+    })
+
+
+def test_select_filter_collect(people):
+    rows = (people.filter(col("age") > 26)
+            .select("name", (col("salary") / 10).alias("s10"))
+            .collect())
+    assert [r.name for r in rows] == ["alice", "carol", "eve"]
+    assert [r.s10 for r in rows] == [10.0, 12.0, 15.0]
+
+
+def test_with_column_case_when(people):
+    df = people.with_column(
+        "band", F.when(col("age") < 30, "young").otherwise("old"))
+    got = {r.name: r.band for r in df.collect()}
+    assert got == {"alice": "old", "bob": "young", "carol": "old",
+                   "dave": "young", "eve": "old"}
+
+
+def test_group_by_agg(people):
+    out = (people.group_by("dept")
+           .agg(F.sum("salary").alias("total"),
+                F.avg("age").alias("avg_age"),
+                F.count("*").alias("n"))
+           .order_by("dept").collect())
+    assert [(r.dept, r.total, r.n) for r in out] == [
+        (1, 220.0, 2), (2, 170.0, 2), (3, 150.0, 1)]
+    assert out[0].avg_age == 32.5
+
+
+def test_agg_expression_over_aggregates(people):
+    out = people.agg((F.sum("salary") / F.count("*")).alias("mean_sal")).collect()
+    assert out[0].mean_sal == pytest.approx(108.0)
+
+
+def test_global_agg_min_max_distinct(people):
+    row = people.agg(F.min("age").alias("lo"), F.max("age").alias("hi"),
+                     F.count_distinct("age").alias("nd")).collect()[0]
+    assert (row.lo, row.hi, row.nd) == (25, 40, 4)
+
+
+def test_join_inner_left(spark, people):
+    depts = spark.create_data_frame({
+        "dept": [1, 2, 4], "dname": ["eng", "sales", "ghost"]})
+    j = people.join(depts, on="dept").order_by("name")
+    assert [(r.name, r.dname) for r in j.collect()] == [
+        ("alice", "eng"), ("bob", "sales"), ("carol", "eng"), ("dave", "sales")]
+    lj = people.join(depts, on="dept", how="left").order_by("name")
+    got = {r.name: r.dname for r in lj.collect()}
+    assert got["eve"] is None and got["alice"] == "eng"
+
+
+def test_join_semi_anti_outer(spark, people):
+    depts = spark.create_data_frame({"dept": [1, 4], "dname": ["eng", "ghost"]})
+    semi = people.join(depts, on="dept", how="left_semi")
+    assert sorted(r.name for r in semi.collect()) == ["alice", "carol"]
+    anti = people.join(depts, on="dept", how="left_anti")
+    assert sorted(r.name for r in anti.collect()) == ["bob", "dave", "eve"]
+    outer = people.join(depts, on="dept", how="outer")
+    batch = outer.to_dict()
+    assert len(batch["name"]) == 6  # 5 left rows + unmatched dept 4
+    ghost = [i for i, d in enumerate(batch["dname"]) if d == "ghost"]
+    assert len(ghost) == 1 and batch["dept"][ghost[0]] == 4
+
+
+def test_sort_limit_union_distinct(spark, people):
+    top2 = people.order_by(col("salary").desc()).limit(2)
+    assert [r.name for r in top2.collect()] == ["eve", "carol"]
+    u = top2.union(top2).distinct()
+    assert u.count() == 2
+    asc = people.order_by("age", col("salary").desc()).collect()
+    assert [r.name for r in asc[:2]] == ["dave", "bob"]  # age 25: 90 > 80
+
+
+def test_string_functions(people):
+    df = people.select(F.upper(col("name")).alias("u"),
+                       F.length(col("name")).alias("l"),
+                       F.concat(col("name"), lit("!")).alias("c"))
+    r = df.collect()[0]
+    assert (r.u, r.l, r.c) == ("ALICE", 5, "alice!")
+    liked = people.filter(col("name").like("%ve%")).collect()
+    assert sorted(r.name for r in liked) == ["dave", "eve"]
+
+
+def test_isin_between_null(spark):
+    df = spark.create_data_frame({"x": [1.0, np.nan, 3.0, 4.0]})
+    assert df.filter(col("x").is_null()).count() == 1
+    assert df.filter(col("x").is_not_null()).count() == 3
+    assert df.filter(col("x").isin(1.0, 4.0)).count() == 2
+    row = df.select(F.coalesce(col("x"), lit(-1.0)).alias("y")).collect()
+    assert row[1].y == -1.0
+
+
+# -- optimizer ---------------------------------------------------------------
+
+def test_optimizer_pushes_filter_below_project(people):
+    df = people.select("name", "age", (col("salary") * 2).alias("s2")) \
+               .filter(col("age") > 26)
+    plan = optimize(df.plan)
+    # filter must now sit under the project
+    assert isinstance(plan, Project)
+    assert isinstance(plan.children[0], Filter)
+    assert [r.s2 for r in df.collect()] == [200.0, 240.0, 300.0]
+
+
+def test_optimizer_pushes_filters_into_join_sides(spark, people):
+    depts = spark.create_data_frame({"dept": [1, 2], "dname": ["eng", "sales"]})
+    df = people.join(depts, on="dept").filter(
+        (col("age") > 24) & (col("dname") == "eng"))
+    plan = optimize(df.plan)
+    join = plan
+    while not isinstance(join, Join):
+        join = join.children[0]
+    assert isinstance(join.children[0], Filter)  # age pushed left
+    assert isinstance(join.children[1], Filter)  # dname pushed right
+    assert sorted(r.name for r in df.collect()) == ["alice", "carol"]
+
+
+def test_optimizer_prunes_scan_columns(people):
+    df = people.select("name")
+    plan = optimize(df.plan)
+    scan = plan
+    while not isinstance(scan, Scan):
+        scan = scan.children[0]
+    assert scan.columns == ["name"]
+
+
+def test_constant_folding(people):
+    df = people.filter(col("age") > (lit(10) + lit(16)))
+    plan = optimize(df.plan)
+    s = plan.tree_string()
+    assert "26" in s and "+" not in s.split("Filter")[1].split("\n")[0]
+
+
+# -- SQL text ----------------------------------------------------------------
+
+def test_sql_basic(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql(
+        "SELECT name, salary * 2 AS s2 FROM people WHERE age >= 30 "
+        "ORDER BY salary DESC LIMIT 2").collect()
+    assert [(r.name, r.s2) for r in out] == [("eve", 300.0), ("carol", 240.0)]
+
+
+def test_sql_group_having(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql(
+        "SELECT dept, sum(salary) AS total, count(*) AS n FROM people "
+        "GROUP BY dept HAVING sum(salary) > 160 ORDER BY dept").collect()
+    assert [(r.dept, r.total, r.n) for r in out] == [(1, 220.0, 2), (2, 170.0, 2)]
+
+
+def test_sql_join(spark, people):
+    spark.register_temp_view("p", people)
+    spark.register_temp_view("d", spark.create_data_frame(
+        {"dept": [1, 2], "dname": ["eng", "sales"]}))
+    out = spark.sql(
+        "SELECT p.name, d.dname FROM p JOIN d ON p.dept = d.dept "
+        "WHERE p.age < 30 ORDER BY name").collect()
+    assert [(r.name, r.dname) for r in out] == [("bob", "sales"), ("dave", "sales")]
+
+
+def test_sql_case_in_between(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql(
+        "SELECT name, CASE WHEN age BETWEEN 25 AND 30 THEN 'mid' "
+        "ELSE 'other' END AS band FROM people WHERE dept IN (1, 2) "
+        "ORDER BY name").collect()
+    assert [(r.name, r.band) for r in out] == [
+        ("alice", "mid"), ("bob", "mid"), ("carol", "other"), ("dave", "mid")]
+
+
+def test_sql_subquery_distinct(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql(
+        "SELECT DISTINCT dept FROM (SELECT dept, age FROM people WHERE age > 24) t "
+        "ORDER BY dept").collect()
+    assert [r.dept for r in out] == [1, 2, 3]
+
+
+def test_sql_star_and_count_distinct(spark, people):
+    spark.register_temp_view("people", people)
+    assert spark.sql("SELECT * FROM people").count() == 5
+    row = spark.sql("SELECT count(DISTINCT age) AS nd FROM people").collect()[0]
+    assert row.nd == 4
+
+
+def test_sql_aliased_group_key(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql("SELECT dept AS d, count(*) AS n FROM people GROUP BY dept "
+                    "ORDER BY d").collect()
+    assert [(r.d, r.n) for r in out] == [(1, 2), (2, 2), (3, 1)]
+
+
+def test_sql_order_by_aggregate(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql("SELECT dept, count(*) AS n FROM people GROUP BY dept "
+                    "ORDER BY count(*) DESC, dept").collect()
+    assert [r.dept for r in out] == [1, 2, 3]
+    # aggregate not in the select list at all
+    out2 = spark.sql("SELECT dept FROM people GROUP BY dept "
+                     "ORDER BY sum(salary) DESC").collect()
+    assert [r.dept for r in out2] == [1, 2, 3]  # 220 > 170 > 150
+
+
+def test_sql_having_column_order(spark, people):
+    spark.register_temp_view("people", people)
+    df = spark.sql("SELECT sum(salary) AS total, dept FROM people "
+                   "GROUP BY dept HAVING sum(salary) > 160 ORDER BY dept")
+    assert df.columns == ["total", "dept"]
+    assert [(r.total, r.dept) for r in df.collect()] == [(220.0, 1), (170.0, 2)]
+
+
+def test_sql_having_without_group(spark, people):
+    spark.register_temp_view("people", people)
+    out = spark.sql("SELECT name FROM people HAVING name = 'eve'").collect()
+    assert [r.name for r in out] == ["eve"]
+
+
+def test_alias_survives_constant_folding(people):
+    df = people.select((lit(1) + lit(1)).alias("x"))
+    assert df.optimized_plan().output() == ["x"]
+    assert df.collect()[0].x == 2
+
+
+def test_case_when_keeps_string_type(people):
+    df = people.select(F.when(col("age") < 30, "1").otherwise("2").alias("s"))
+    vals = [r.s for r in df.collect()]
+    assert vals == ["2", "1", "2", "1", "2"]
+
+
+def test_sort_numeric_object_column(spark):
+    df = spark.create_data_frame({"x": np.array([10, 9, 2], dtype=object)})
+    assert [r.x for r in df.order_by("x").collect()] == [2, 9, 10]
+
+
+def test_isnull_on_literal(spark):
+    df = spark.create_data_frame({"x": [1.0]})
+    assert df.select(F.isnull(lit(None)).alias("b")).collect()[0].b
+
+
+def test_filter_string_expression(people):
+    assert people.filter("age > 26 and dept = 1").count() == 2
+
+
+def test_mlframe_bridge(spark, people):
+    """DataFrame → MLFrame → estimator input columns."""
+    class _Ctx:  # MLFrame only touches .ctx opaquely
+        pass
+    mf = people.select("age", "salary").to_mlframe(_Ctx())
+    assert mf.columns == ["age", "salary"] and mf.n_rows == 5
+
+
+def test_show_and_explain(people, capsys):
+    people.show(2)
+    out = capsys.readouterr().out
+    assert "alice" in out and "|" in out
+    people.filter(col("age") > 26).explain()
+    out = capsys.readouterr().out
+    assert "Logical Plan" in out and "Optimized" in out
