@@ -140,6 +140,37 @@ class DataFrame:
     def distinct(self) -> "DataFrame":
         return DataFrame(Distinct(self.plan), self.session)
 
+    def drop_duplicates(self, subset=None) -> "DataFrame":
+        """(ref Dataset.dropDuplicates; stateful across batches when
+        streaming — StreamingDeduplicateExec)"""
+        from cycloneml_tpu.streaming.stateful import Deduplicate
+        return DataFrame(Deduplicate(self.plan, list(subset) if subset else None),
+                         self.session)
+
+    dropDuplicates = drop_duplicates
+
+    # -- streaming -------------------------------------------------------------
+    @property
+    def is_streaming(self) -> bool:
+        from cycloneml_tpu.streaming.query import is_streaming_plan
+        return is_streaming_plan(self.plan)
+
+    def with_watermark(self, event_col: str, delay_seconds: float) -> "DataFrame":
+        """(ref Dataset.withWatermark — delay is seconds, not a SQL interval
+        string; the host tier's event-time unit is a float epoch)"""
+        from cycloneml_tpu.streaming.stateful import Watermark
+        return DataFrame(Watermark(self.plan, event_col, delay_seconds),
+                         self.session)
+
+    withWatermark = with_watermark
+
+    @property
+    def write_stream(self):
+        from cycloneml_tpu.streaming.query import DataStreamWriter
+        return DataStreamWriter(self)
+
+    writeStream = write_stream
+
     # -- actions ---------------------------------------------------------------
     def optimized_plan(self) -> LogicalPlan:
         return optimize(self.plan)

@@ -51,9 +51,19 @@ class CycloneSession:
         self._catalog[name] = Scan(batch, name)
 
     def table(self, name: str) -> DataFrame:
+        if name in getattr(self, "_stream_tables", {}):
+            # live view over a streaming memory sink (ref: memory.scala —
+            # the table reflects whatever the query has committed so far)
+            sink = self._stream_tables[name]
+            return DataFrame(Scan(sink.to_batch(), name), self)
         if name not in self._catalog:
             raise KeyError(f"table {name!r} not registered")
         return DataFrame(self._catalog[name], self)
+
+    def register_memory_stream_table(self, name: str, sink) -> None:
+        if not hasattr(self, "_stream_tables"):
+            self._stream_tables: Dict[str, object] = {}
+        self._stream_tables[name] = sink
 
     def catalog_tables(self) -> List[str]:
         return list(self._catalog)
@@ -61,6 +71,14 @@ class CycloneSession:
     # -- SQL -------------------------------------------------------------------
     def sql(self, query: str) -> DataFrame:
         return DataFrame(parse_sql(query, self._catalog), self)
+
+    @property
+    def read_stream(self):
+        """(ref SparkSession.readStream)"""
+        from cycloneml_tpu.streaming.query import DataStreamReader
+        return DataStreamReader(self)
+
+    readStream = read_stream
 
     # -- readers ---------------------------------------------------------------
     def read_csv(self, path: str, header: bool = True,

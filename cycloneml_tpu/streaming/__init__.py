@@ -1,0 +1,32 @@
+"""Structured streaming: incrementalized execution of SQL plans.
+
+TPU-native analog of the reference's structured-streaming engine
+(ref: sql/core/.../execution/streaming/StreamExecution.scala:69,
+MicroBatchExecution.scala:39). The engine re-executes a logical plan over
+each micro-batch of source data; stateful operators (aggregation,
+deduplication, stream-stream join) merge per-batch partials into a versioned
+state store; offset and commit logs give exactly-once semantics across
+restarts.
+
+What deliberately does NOT port (SURVEY §2.4): continuous-processing mode
+(ContinuousExecution.scala:42 — epoch-level RPC push; micro-batch covers the
+semantics and the latency floor here is the Python driver, not the engine)
+and the DStream WAL/receiver machinery (the sources below are pull-based and
+replayable, so a write-ahead log is redundant).
+"""
+
+from cycloneml_tpu.streaming.metadata_log import MetadataLog
+from cycloneml_tpu.streaming.sinks import (ConsoleSink, FileSink,
+                                           ForeachBatchSink, MemorySink)
+from cycloneml_tpu.streaming.sources import (FileStreamSource, MemoryStream,
+                                             RateSource, StreamingScan)
+from cycloneml_tpu.streaming.state import StateStoreProvider
+from cycloneml_tpu.streaming.query import (DataStreamReader, DataStreamWriter,
+                                           StreamingQuery)
+
+__all__ = [
+    "MetadataLog", "MemoryStream", "FileStreamSource", "RateSource",
+    "StreamingScan", "MemorySink", "FileSink", "ForeachBatchSink",
+    "ConsoleSink", "StateStoreProvider", "StreamingQuery", "DataStreamReader",
+    "DataStreamWriter",
+]

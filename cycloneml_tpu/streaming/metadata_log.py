@@ -1,0 +1,73 @@
+"""Atomic file-based metadata logs.
+
+Analog of HDFSMetadataLog / OffsetSeqLog / CommitLog (ref: sql/core/.../
+streaming/HDFSMetadataLog.scala, OffsetSeqLog.scala, CommitLog.scala and the
+atomic-rename discipline of CheckpointFileManager.scala): one JSON file per
+batch id, written to a temp name then renamed so readers never observe a
+partial entry. The pair (offsets written before a batch runs, commit written
+after the sink accepts it) is what makes restart recovery exactly-once for
+replayable sources and idempotent sinks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class MetadataLog:
+    """Monotonic batch-id → JSON-dict log with atomic writes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, batch_id: int) -> str:
+        return os.path.join(self.path, str(batch_id))
+
+    def add(self, batch_id: int, metadata: Dict[str, Any]) -> bool:
+        """Write entry if absent; False if the batch id already exists."""
+        target = self._file(batch_id)
+        if os.path.exists(target):
+            return False
+        fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(metadata, fh)
+            os.replace(tmp, target)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return True
+
+    def get(self, batch_id: int) -> Optional[Dict[str, Any]]:
+        target = self._file(batch_id)
+        if not os.path.exists(target):
+            return None
+        with open(target, encoding="utf-8") as fh:
+            return json.load(fh)
+
+    def batch_ids(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.path):
+            if name.isdigit():
+                out.append(int(name))
+        return sorted(out)
+
+    def latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        ids = self.batch_ids()
+        if not ids:
+            return None
+        return ids[-1], self.get(ids[-1])
+
+    def purge(self, keep_last: int = 100) -> None:
+        """Drop entries older than the newest ``keep_last`` (≈ the reference's
+        minBatchesToRetain compaction)."""
+        ids = self.batch_ids()
+        for bid in ids[:-keep_last] if keep_last else ids:
+            try:
+                os.unlink(self._file(bid))
+            except OSError:
+                pass

@@ -1,0 +1,433 @@
+"""Micro-batch stream execution engine.
+
+Analog of StreamExecution / MicroBatchExecution (ref: sql/core/.../execution/
+streaming/StreamExecution.scala:69, MicroBatchExecution.scala:39). Each
+micro-batch: resolve new source offsets → write the offset log → execute the
+incrementalized plan → commit state + sink → write the commit log. Restart
+recovery replays the last uncommitted batch at the logged offsets against the
+last committed state version — exactly-once given replayable sources and
+idempotent sinks (the same contract the reference documents).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.sql.plan import Aggregate, Batch, Join, LogicalPlan, Scan
+from cycloneml_tpu.streaming.metadata_log import MetadataLog
+from cycloneml_tpu.streaming.sinks import (ConsoleSink, FileSink,
+                                           ForeachBatchSink, MemorySink, Sink)
+from cycloneml_tpu.streaming.sources import (FileStreamSource, RateSource,
+                                             Source, StreamingScan)
+from cycloneml_tpu.streaming.state import StateStoreProvider
+from cycloneml_tpu.streaming.stateful import (Deduplicate, StatefulAggregation,
+                                              StatefulDedup, StatefulJoin,
+                                              Watermark)
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# -- plan utilities ------------------------------------------------------------
+
+def find_nodes(plan: LogicalPlan, pred: Callable[[LogicalPlan], bool]
+               ) -> List[LogicalPlan]:
+    out = []
+    if pred(plan):
+        out.append(plan)
+    for c in plan.children:
+        out.extend(find_nodes(c, pred))
+    return out
+
+
+def replace_node(plan: LogicalPlan, target: LogicalPlan,
+                 replacement: LogicalPlan) -> LogicalPlan:
+    """Rebuild the tree with ``target`` (by identity) swapped out."""
+    if plan is target:
+        return replacement
+    new_children = [replace_node(c, target, replacement) for c in plan.children]
+    if all(n is o for n, o in zip(new_children, plan.children)):
+        return plan
+    return plan.with_children(new_children)
+
+
+def is_streaming_plan(plan: LogicalPlan) -> bool:
+    return bool(find_nodes(plan, lambda n: isinstance(n, StreamingScan)))
+
+
+class MicroBatchExecution:
+    """Drives one streaming query's batch loop."""
+
+    def __init__(self, plan: LogicalPlan, sink: Sink, mode: str,
+                 checkpoint_dir: str, session=None):
+        self.plan = plan
+        self.sink = sink
+        self.mode = mode
+        self.session = session
+        self.checkpoint_dir = checkpoint_dir
+        self.offset_log = MetadataLog(os.path.join(checkpoint_dir, "offsets"))
+        self.commit_log = MetadataLog(os.path.join(checkpoint_dir, "commits"))
+
+        self.scans: List[StreamingScan] = find_nodes(
+            plan, lambda n: isinstance(n, StreamingScan))
+        if not self.scans:
+            raise ValueError("plan has no streaming source")
+        names = set()
+        for i, s in enumerate(self.scans):
+            if s.name in names:
+                s.name = f"{s.name}#{i}"
+            names.add(s.name)
+        self.watermarks: List[Watermark] = find_nodes(
+            plan, lambda n: isinstance(n, Watermark))
+        self._wm_col = self.watermarks[0].event_col if self.watermarks else None
+
+        # locate the (single) stateful operator, topmost first; operators on
+        # purely static subtrees execute batch-style and carry no state
+        self.stateful_node: Optional[LogicalPlan] = None
+        self.stateful_op: Optional[Any] = None
+        aggs = [a for a in find_nodes(plan, lambda n: isinstance(n, Aggregate))
+                if is_streaming_plan(a)]
+        dedups = [d for d in find_nodes(plan,
+                                        lambda n: isinstance(n, Deduplicate))
+                  if is_streaming_plan(d)]
+        joins = [j for j in find_nodes(plan, lambda n: isinstance(n, Join))
+                 if is_streaming_plan(j.children[0])
+                 and is_streaming_plan(j.children[1])]
+        if len(aggs) + len(dedups) + len(joins) > 1:
+            raise ValueError("streaming supports one stateful operator per "
+                             "query (ref: UnsupportedOperationChecker)")
+        state_path = os.path.join(checkpoint_dir, "state")
+        if aggs:
+            self.stateful_node = aggs[0]
+            self.stateful_op = StatefulAggregation(aggs[0], mode, self._wm_col)
+        elif dedups:
+            self.stateful_node = dedups[0]
+            self.stateful_op = StatefulDedup(dedups[0], self._wm_col)
+        elif joins:
+            wm_cols = {w.event_col: w.delay for w in self.watermarks}
+            self.stateful_node = joins[0]
+            self.stateful_op = StatefulJoin(joins[0], wm_cols)
+        elif mode == "complete":
+            raise ValueError("complete mode requires an aggregation")
+        self.state_provider = (StateStoreProvider(state_path)
+                               if self.stateful_op is not None else None)
+        self._batch_lock = threading.Lock()
+        for s in self.scans:
+            if hasattr(s.source, "set_log_dir"):
+                s.source.set_log_dir(
+                    os.path.join(checkpoint_dir, "sources", s.name))
+
+        # recovery (ref: StreamExecution.populateStartOffsets)
+        self.watermark: Optional[float] = None
+        self._committed_offsets: Dict[str, int] = {s.name: 0 for s in self.scans}
+        self._pending: Optional[Dict[str, Any]] = None
+        self.batch_id = 0
+        latest = self.offset_log.latest()
+        if latest is not None:
+            bid, entry = latest
+            if self.commit_log.get(bid) is not None:
+                self.batch_id = bid + 1
+                self._committed_offsets = dict(entry["offsets"])
+                self.watermark = entry.get("watermark")
+            else:
+                self.batch_id = bid
+                self._pending = entry
+                prev = self.offset_log.get(bid - 1)
+                if prev is not None:
+                    self._committed_offsets = dict(prev["offsets"])
+                    self.watermark = prev.get("watermark")
+        self._wm_dirty = self.watermark is not None
+
+    # -- one batch -------------------------------------------------------------
+    def construct_next_batch(self) -> bool:
+        """Returns True if a batch was run. Serialized: the processing-time
+        trigger thread and user calls (process_all_available) may overlap."""
+        with self._batch_lock:
+            return self._construct_next_batch_locked()
+
+    def _construct_next_batch_locked(self) -> bool:
+        if self._pending is not None:
+            entry = self._pending
+            self._pending = None
+            self._run_batch(entry["offsets"], entry.get("watermark"))
+            return True
+        ends = {s.name: s.source.latest_offset() for s in self.scans}
+        has_data = any(ends[n] > self._committed_offsets.get(n, 0)
+                       for n in ends)
+        if not has_data and not self._wm_dirty:
+            return False
+        self._wm_dirty = False
+        entry = {"offsets": ends, "watermark": self.watermark}
+        self.offset_log.add(self.batch_id, entry)
+        self._run_batch(ends, self.watermark)
+        return True
+
+    def _run_batch(self, ends: Dict[str, int], watermark: Optional[float]) -> None:
+        t0 = time.perf_counter()
+        n_in = 0
+        for s in self.scans:
+            start = self._committed_offsets.get(s.name, 0)
+            s.current = s.source.get_batch(start, ends[s.name])
+            n_in += len(next(iter(s.current.values()))) if s.current else 0
+
+        out = self._execute(watermark)
+
+        self.sink.add_batch(self.batch_id, out, self.mode)
+        self.commit_log.add(self.batch_id, {"watermark": watermark})
+        for s in self.scans:
+            s.source.commit(ends[s.name])
+            s.current = None
+        self._committed_offsets = dict(ends)
+        self.batch_id += 1
+        self._advance_watermark()
+        self.last_progress = {
+            "batchId": self.batch_id - 1,
+            "numInputRows": int(n_in),
+            "durationMs": int((time.perf_counter() - t0) * 1000),
+            "watermark": self.watermark,
+            "stateRows": (len(self._last_store) if self._last_store is not None
+                          else 0),
+        }
+
+    _last_store = None
+
+    def _execute(self, watermark: Optional[float]) -> Batch:
+        self._last_store = None
+        if self.stateful_op is None:
+            return self.plan.execute()
+        store = self.state_provider.get_store(self.batch_id)
+        node = self.stateful_node
+        if isinstance(self.stateful_op, StatefulJoin):
+            new_l = node.children[0].execute()
+            new_r = node.children[1].execute()
+            result = self.stateful_op.process_batch(new_l, new_r, store,
+                                                    watermark)
+        elif isinstance(self.stateful_op, StatefulAggregation):
+            child_batch = node.children[0].execute()
+            result = self.stateful_op.process_batch(child_batch, store,
+                                                    watermark)
+        else:
+            child_batch = node.children[0].execute()
+            result = self.stateful_op.process_batch(child_batch, store,
+                                                    watermark)
+        self._last_store = store
+        store.commit()
+        above = replace_node(self.plan, node, Scan(result, "stateful"))
+        return above.execute() if above is not node else result
+
+    def _advance_watermark(self) -> None:
+        new_wm = self.watermark
+        candidates = [w.observed_max - w.delay for w in self.watermarks
+                      if w.observed_max is not None]
+        if candidates:
+            candidate = min(candidates)  # multiple watermark ops: global min
+            if new_wm is None or candidate > new_wm:
+                new_wm = candidate
+                self._wm_dirty = True
+        self.watermark = new_wm
+
+
+class StreamingQuery:
+    """User handle (ref: StreamingQuery.scala / StreamingQueryManager)."""
+
+    def __init__(self, execution: MicroBatchExecution, trigger: Dict[str, Any],
+                 name: str = ""):
+        self.id = uuid.uuid4().hex
+        self.name = name or f"query-{self.id[:8]}"
+        self._exec = execution
+        self._trigger = trigger
+        self._active = True
+        self._exception: Optional[Exception] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self.recent_progress: List[Dict[str, Any]] = []
+
+        if "processingTime" in trigger:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"stream-{self.name}", daemon=True)
+            self._thread.start()
+        elif trigger.get("once") or trigger.get("availableNow"):
+            try:
+                self.process_all_available()
+            finally:
+                self._active = False
+
+    def _record(self, ran: bool) -> None:
+        if ran:
+            self.recent_progress.append(self._exec.last_progress)
+            del self.recent_progress[:-100]
+
+    def process_all_available(self) -> None:
+        """Run batches until sources are drained (≈ Trigger.AvailableNow /
+        StreamTest's ProcessAllAvailable)."""
+        if self._exception:
+            raise self._exception
+        while True:
+            ran = self._exec.construct_next_batch()
+            self._record(ran)
+            if not ran:
+                return
+
+    def _loop(self) -> None:
+        interval = float(self._trigger["processingTime"])
+        delay = 0.0  # first attempt immediately, then poll at the interval
+        while not self._stop_evt.wait(delay):
+            delay = interval
+            try:
+                self._record(self._exec.construct_next_batch())
+            except Exception as e:  # surfaced via .exception, as the ref does
+                self._exception = e
+                self._active = False
+                return
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._active = False
+
+    def await_termination(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    @property
+    def is_active(self) -> bool:
+        return self._active
+
+    @property
+    def exception(self) -> Optional[Exception]:
+        return self._exception
+
+    @property
+    def last_progress(self) -> Optional[Dict[str, Any]]:
+        return self.recent_progress[-1] if self.recent_progress else None
+
+    @property
+    def status(self) -> Dict[str, Any]:
+        return {"isActive": self._active,
+                "batchId": self._exec.batch_id,
+                "watermark": self._exec.watermark}
+
+
+class DataStreamReader:
+    """(ref: DataStreamReader.scala) — ``session.read_stream.format(...)``."""
+
+    def __init__(self, session):
+        self._session = session
+        self._format = "csv"
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[List[str]] = None
+
+    def format(self, fmt: str) -> "DataStreamReader":
+        self._format = fmt
+        return self
+
+    def option(self, key: str, value) -> "DataStreamReader":
+        self._options[key] = value
+        return self
+
+    def schema(self, cols: List[str]) -> "DataStreamReader":
+        self._schema = list(cols)
+        return self
+
+    def load(self, path: Optional[str] = None):
+        from cycloneml_tpu.sql.dataframe import DataFrame
+        if self._format == "rate":
+            src: Source = RateSource(
+                int(self._options.get("rowsPerSecond", 10)))
+        elif self._format in ("csv", "text", "file"):
+            fmt = "text" if self._format == "text" else "csv"
+            src = FileStreamSource(
+                path or self._options["path"], fmt=fmt,
+                pattern=self._options.get("pattern", "*"),
+                header=bool(self._options.get("header", True)),
+                delimiter=self._options.get("delimiter", ","))
+        else:
+            raise ValueError(f"unknown stream format {self._format!r}")
+        return DataFrame(StreamingScan(src, self._format), self._session)
+
+    def csv(self, path: str):
+        return self.format("csv").load(path)
+
+    def text(self, path: str):
+        return self.format("text").load(path)
+
+
+class DataStreamWriter:
+    """(ref: DataStreamWriter.scala) — ``df.write_stream...start()``."""
+
+    def __init__(self, df):
+        self._df = df
+        self._mode = "append"
+        self._format = "memory"
+        self._options: Dict[str, Any] = {}
+        # default = continuous micro-batches ASAP (ref: Trigger.ProcessingTime(0))
+        self._trigger: Dict[str, Any] = {"processingTime": 0.1}
+        self._name = ""
+        self._foreach: Optional[Callable] = None
+        self.sink: Optional[Sink] = None
+
+    def output_mode(self, mode: str) -> "DataStreamWriter":
+        if mode not in ("append", "update", "complete"):
+            raise ValueError(f"unknown output mode {mode!r}")
+        self._mode = mode
+        return self
+
+    def format(self, fmt: str) -> "DataStreamWriter":
+        self._format = fmt
+        return self
+
+    def option(self, key: str, value) -> "DataStreamWriter":
+        self._options[key] = value
+        return self
+
+    def query_name(self, name: str) -> "DataStreamWriter":
+        self._name = name
+        return self
+
+    def trigger(self, once: bool = False, available_now: bool = False,
+                processing_time: Optional[float] = None) -> "DataStreamWriter":
+        if processing_time is not None:
+            self._trigger = {"processingTime": processing_time}
+        elif once:
+            self._trigger = {"once": True}
+        elif available_now:
+            self._trigger = {"availableNow": True}
+        return self
+
+    def foreach_batch(self, fn: Callable) -> "DataStreamWriter":
+        self._foreach = fn
+        self._format = "foreach_batch"
+        return self
+
+    def start(self, path: Optional[str] = None) -> StreamingQuery:
+        session = self._df.session
+        ckpt = self._options.get("checkpointLocation") or tempfile.mkdtemp(
+            prefix="cyclone-stream-")
+        if self._format == "memory":
+            sink: Sink = MemorySink()
+        elif self._format == "console":
+            sink = ConsoleSink(int(self._options.get("numRows", 20)))
+        elif self._format in ("csv", "json"):
+            sink = FileSink(path or self._options["path"], self._format)
+        elif self._format == "foreach_batch":
+            sink = ForeachBatchSink(self._foreach, session)
+        else:
+            raise ValueError(f"unknown sink format {self._format!r}")
+        self.sink = sink
+        execution = MicroBatchExecution(self._df.plan, sink, self._mode,
+                                        ckpt, session)
+        q = StreamingQuery(execution, dict(self._trigger), self._name)
+        q.sink = sink
+        if self._format == "memory" and session is not None and self._name:
+            session.register_memory_stream_table(self._name, sink)
+        return q

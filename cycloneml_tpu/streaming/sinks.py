@@ -1,0 +1,131 @@
+"""Streaming sinks.
+
+Analog of the reference's Sink connectors (ref: sql/core/.../execution/
+streaming/Sink.scala, memory.scala MemorySink, FileStreamSink.scala,
+console.scala, ForeachBatchSink.scala). ``add_batch(batch_id, batch)`` must
+be idempotent per batch id — together with the commit log this closes the
+exactly-once loop on restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.sql.plan import Batch
+
+
+class Sink:
+    def add_batch(self, batch_id: int, batch: Batch, mode: str) -> None:
+        raise NotImplementedError
+
+
+class MemorySink(Sink):
+    """Collects output rows on the driver (≈ MemorySink for CheckAnswer)."""
+
+    def __init__(self):
+        self._batches: Dict[int, Batch] = {}
+        self._order: List[int] = []
+
+    def add_batch(self, batch_id: int, batch: Batch, mode: str) -> None:
+        if batch_id in self._batches:
+            return  # replayed batch after recovery — idempotent
+        if mode == "complete":
+            self._batches.clear()
+            self._order.clear()
+        self._batches[batch_id] = batch
+        self._order.append(batch_id)
+
+    def to_batch(self, schema: Optional[List[str]] = None) -> Batch:
+        from cycloneml_tpu.streaming.sources import _concat_batches
+        parts = [self._batches[b] for b in self._order]
+        live = [p for p in parts if p and len(next(iter(p.values()))) > 0]
+        if not live:
+            return {c: np.array([]) for c in (schema or [])}
+        return _concat_batches(live, list(live[0]))
+
+    def rows(self) -> List[tuple]:
+        batch = self.to_batch()
+        cols = list(batch)
+        n = len(batch[cols[0]]) if cols else 0
+        return [tuple(batch[c][i] for c in cols) for i in range(n)]
+
+    def clear(self) -> None:
+        self._batches.clear()
+        self._order.clear()
+
+
+class FileSink(Sink):
+    """Part-file-per-batch writer with a manifest log (ref:
+    FileStreamSink.scala's _spark_metadata commit protocol — readers trust
+    only manifested files, making rewrites after crash invisible)."""
+
+    def __init__(self, path: str, fmt: str = "csv"):
+        self.path = path
+        self.fmt = fmt
+        self.manifest_dir = os.path.join(path, "_manifest")
+        os.makedirs(self.manifest_dir, exist_ok=True)
+
+    def add_batch(self, batch_id: int, batch: Batch, mode: str) -> None:
+        marker = os.path.join(self.manifest_dir, str(batch_id))
+        if os.path.exists(marker):
+            return
+        cols = list(batch)
+        n = len(batch[cols[0]]) if cols else 0
+        part = os.path.join(self.path, f"part-{batch_id:05d}.{self.fmt}")
+        with open(part + ".tmp", "w", encoding="utf-8") as fh:
+            if self.fmt == "json":
+                for i in range(n):
+                    fh.write(json.dumps(
+                        {c: _py(batch[c][i]) for c in cols}) + "\n")
+            else:
+                fh.write(",".join(cols) + "\n")
+                for i in range(n):
+                    fh.write(",".join(str(_py(batch[c][i])) for c in cols) + "\n")
+        os.replace(part + ".tmp", part)
+        with open(marker, "w") as fh:
+            fh.write(part)
+
+    def committed_files(self) -> List[str]:
+        out = []
+        for name in sorted(os.listdir(self.manifest_dir), key=lambda s: int(s)):
+            with open(os.path.join(self.manifest_dir, name)) as fh:
+                out.append(fh.read())
+        return out
+
+
+class ForeachBatchSink(Sink):
+    """(ref: ForeachBatchSink.scala) — hands (DataFrame, batch_id) to user
+    code; the user owns idempotence, as in the reference."""
+
+    def __init__(self, fn: Callable, session=None):
+        self.fn = fn
+        self.session = session
+
+    def add_batch(self, batch_id: int, batch: Batch, mode: str) -> None:
+        from cycloneml_tpu.sql.dataframe import DataFrame
+        from cycloneml_tpu.sql.plan import Scan
+        self.fn(DataFrame(Scan(batch, f"batch-{batch_id}"), self.session),
+                batch_id)
+
+
+class ConsoleSink(Sink):
+    def __init__(self, num_rows: int = 20):
+        self.num_rows = num_rows
+
+    def add_batch(self, batch_id: int, batch: Batch, mode: str) -> None:
+        from cycloneml_tpu.sql.dataframe import DataFrame
+        from cycloneml_tpu.sql.plan import Scan
+        print(f"-------------------------------------------\n"
+              f"Batch: {batch_id}\n"
+              f"-------------------------------------------")
+        DataFrame(Scan(batch, "console")).show(self.num_rows)
+
+
+def _py(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

@@ -1,0 +1,203 @@
+"""Streaming sources.
+
+Analog of the reference's Source / MicroBatchStream connectors (ref:
+sql/core/.../execution/streaming/Source.scala, memory stream
+``MemoryStream`` in sources/memory.scala, FileStreamSource.scala,
+RateStreamProvider). A source exposes a monotonically increasing offset;
+``get_batch(start, end)`` must be replayable — the recovery contract that
+lets the engine re-run an uncommitted batch after a crash.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.sql.plan import Batch, LogicalPlan
+
+
+def _empty_like(schema: List[str]) -> Batch:
+    return {c: np.array([], dtype=object) for c in schema}
+
+
+def _concat_batches(parts: List[Batch], schema: List[str]) -> Batch:
+    """Column-wise concat of non-empty batches (dtype coercion via the plan
+    layer's _concat so mixed int/float/object chunks behave like Union)."""
+    from cycloneml_tpu.sql.plan import _concat
+    parts = [p for p in parts if p and len(next(iter(p.values()))) > 0]
+    if not parts:
+        return _empty_like(schema)
+    return {c: _concat([np.asarray(p[c]) for p in parts]) for c in schema}
+
+
+class Source:
+    schema: List[str] = []
+
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def get_batch(self, start: int, end: int) -> Batch:
+        """Rows in offset range (start, end] — replayable."""
+        raise NotImplementedError
+
+    def commit(self, end: int) -> None:
+        """Source may discard data up to ``end`` (≈ Source.commit)."""
+
+
+class StreamingScan(LogicalPlan):
+    """Leaf plan node standing for 'the current micro-batch of a source'.
+
+    The reference swaps a StreamingExecutionRelation for a per-batch
+    LocalRelation during logical planning (MicroBatchExecution.scala:39);
+    here the engine assigns ``current`` before executing the plan.
+    """
+
+    def __init__(self, source: Source, name: str = "streaming"):
+        self.children = []
+        self.source = source
+        self.name = name
+        self.current: Optional[Batch] = None
+
+    def output(self):
+        return list(self.source.schema)
+
+    def execute(self):
+        if self.current is None:
+            raise RuntimeError(
+                "streaming plan executed outside a micro-batch; use "
+                "write_stream.start() (or .to_batch() for a snapshot)")
+        return self.current
+
+    def __repr__(self):
+        return f"StreamingScan({self.name})"
+
+
+class MemoryStream(Source):
+    """Driver-held source for tests (≈ MemoryStream — the backbone of the
+    reference's StreamTest AddData harness). Offset = number of chunks."""
+
+    def __init__(self, schema: List[str]):
+        self.schema = list(schema)
+        self._chunks: List[Batch] = []
+        self._lock = threading.Lock()
+
+    def add_data(self, data=None, **cols) -> int:
+        """Append a chunk (columnar dict or kwargs); returns the new offset."""
+        chunk = dict(data) if data is not None else {}
+        chunk.update(cols)
+        batch = {c: np.asarray(chunk[c]) for c in self.schema}
+        with self._lock:
+            self._chunks.append(batch)
+            return len(self._chunks)
+
+    def latest_offset(self) -> int:
+        with self._lock:
+            return len(self._chunks)
+
+    def get_batch(self, start: int, end: int) -> Batch:
+        with self._lock:
+            return _concat_batches(self._chunks[start:end], self.schema)
+
+    def to_df(self, session=None):
+        from cycloneml_tpu.sql.dataframe import DataFrame
+        return DataFrame(StreamingScan(self, "memory"), session)
+
+
+class FileStreamSource(Source):
+    """Directory-watching source (ref: FileStreamSource.scala — offsets are
+    positions in the sorted log of files ever seen). Supports csv (numeric,
+    header names columns) and single-column text."""
+
+    def __init__(self, path: str, fmt: str = "csv", pattern: str = "*",
+                 header: bool = True, delimiter: str = ","):
+        self.path = path
+        self.fmt = fmt
+        self.pattern = pattern
+        self.header = header
+        self.delimiter = delimiter
+        self._seen: List[str] = []
+        self._log_path: Optional[str] = None
+        self.schema = self._infer_schema()
+
+    def set_log_dir(self, path: str) -> None:
+        """Persist the seen-file log in the query checkpoint so logged offsets
+        stay replayable across restarts (ref: FileStreamSource.scala keeps its
+        file log under <checkpoint>/sources/<id> for exactly this reason —
+        directory listing order is not stable when files keep arriving)."""
+        os.makedirs(path, exist_ok=True)
+        self._log_path = os.path.join(path, "files")
+        if os.path.exists(self._log_path):
+            with open(self._log_path, encoding="utf-8") as fh:
+                self._seen = [ln.rstrip("\n") for ln in fh if ln.strip()]
+
+    def _list_files(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.path, self.pattern)))
+
+    def _infer_schema(self) -> List[str]:
+        if self.fmt == "text":
+            return ["value"]
+        files = self._list_files()
+        if not files:
+            raise ValueError(f"file source needs at least one file in "
+                             f"{self.path!r} to infer a schema")
+        with open(files[0]) as fh:
+            head = fh.readline().rstrip("\n")
+        if self.header:
+            return [c.strip() for c in head.split(self.delimiter)]
+        return [f"_c{i}" for i in range(len(head.split(self.delimiter)))]
+
+    def _refresh(self) -> None:
+        known = set(self._seen)
+        new = [f for f in self._list_files() if f not in known]
+        if not new:
+            return
+        self._seen.extend(new)
+        if self._log_path is not None:
+            tmp = self._log_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write("\n".join(self._seen) + "\n")
+            os.replace(tmp, self._log_path)
+
+    def latest_offset(self) -> int:
+        self._refresh()
+        return len(self._seen)
+
+    def _read_file(self, f: str) -> Batch:
+        if self.fmt == "text":
+            with open(f) as fh:
+                lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
+            return {"value": np.array(lines, dtype=object)}
+        data = np.loadtxt(f, delimiter=self.delimiter,
+                          skiprows=1 if self.header else 0, ndmin=2)
+        if data.size == 0:
+            return _empty_like(self.schema)
+        return {c: data[:, i] for i, c in enumerate(self.schema)}
+
+    def get_batch(self, start: int, end: int) -> Batch:
+        self._refresh()
+        return _concat_batches([self._read_file(f) for f in self._seen[start:end]],
+                               self.schema)
+
+
+class RateSource(Source):
+    """Synthetic load source (ref: RateStreamProvider): ``rows_per_second``
+    rows with monotonically increasing ``value`` and a ``timestamp``."""
+
+    schema = ["timestamp", "value"]
+
+    def __init__(self, rows_per_second: int = 10):
+        self.rows_per_second = rows_per_second
+        self._start = time.time()
+
+    def latest_offset(self) -> int:
+        return int((time.time() - self._start) * self.rows_per_second)
+
+    def get_batch(self, start: int, end: int) -> Batch:
+        values = np.arange(start, end, dtype=np.int64)
+        ts = self._start + values / float(self.rows_per_second)
+        return {"timestamp": ts, "value": values}

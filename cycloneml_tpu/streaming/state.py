@@ -1,0 +1,177 @@
+"""Versioned per-query state stores.
+
+Analog of the reference's StateStore stack (ref: sql/core/.../streaming/
+state/StateStore.scala, HDFSBackedStateStoreProvider.scala:73 snapshot+delta
+layout, RocksDBStateStoreProvider.scala:30). A provider owns the state of one
+stateful operator; each micro-batch loads version ``v`` (the last committed
+batch), mutates a copy, and commits version ``v+1`` as a delta file. Every
+``snapshot_interval`` commits a full snapshot is written so recovery replays
+a bounded number of deltas. Values are arbitrary pickled Python objects keyed
+by tuples — the host ETL tier's row format is columnar numpy, but state is
+touched per-group, so a keyed map is the right shape (the reference's
+UnsafeRow-keyed maps serve the same role).
+
+When the native host runtime is available, snapshot/delta bytes go through
+the zstd codec (ref: the reference compresses state snapshots via its codec
+plugin point, io/CompressionCodec.scala:63).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+Key = Tuple
+_TOMBSTONE = "__cyclone_tombstone__"
+
+
+def _maybe_compress(data: bytes) -> bytes:
+    try:
+        from cycloneml_tpu.native.host import CompressionCodec, native_available
+        if native_available():
+            return b"Z" + CompressionCodec("zstd").compress(data)
+    except Exception:
+        pass
+    return b"R" + data
+
+
+def _maybe_decompress(blob: bytes) -> bytes:
+    tag, payload = blob[:1], blob[1:]
+    if tag == b"Z":
+        from cycloneml_tpu.native.host import CompressionCodec
+        return CompressionCodec.decompress(payload)
+    return payload
+
+
+class StateStore:
+    """One mutable version of a keyed state map. Mutations are buffered and
+    applied on ``commit`` (≈ StateStore.scala's abort/commit contract)."""
+
+    def __init__(self, provider: "StateStoreProvider", version: int,
+                 contents: Dict[Key, Any]):
+        self._provider = provider
+        self.version = version
+        self._contents = contents
+        self._updates: Dict[Key, Any] = {}
+        self._committed = False
+
+    def get(self, key: Key) -> Optional[Any]:
+        if key in self._updates:
+            v = self._updates[key]
+            return None if v is _TOMBSTONE else v
+        return self._contents.get(key)
+
+    def put(self, key: Key, value: Any) -> None:
+        self._updates[key] = value
+
+    def remove(self, key: Key) -> None:
+        self._updates[key] = _TOMBSTONE
+
+    def items(self) -> Iterator[Tuple[Key, Any]]:
+        for k, v in self._contents.items():
+            if k not in self._updates:
+                yield k, v
+        for k, v in self._updates.items():
+            if v is not _TOMBSTONE:
+                yield k, v
+
+    def __len__(self) -> int:
+        n = sum(1 for k in self._contents if k not in self._updates)
+        return n + sum(1 for v in self._updates.values() if v is not _TOMBSTONE)
+
+    def commit(self) -> int:
+        """Persist as version+1; returns the new version."""
+        if self._committed:
+            raise RuntimeError("state store already committed")
+        self._committed = True
+        return self._provider._commit(self.version, self._contents, self._updates)
+
+    def abort(self) -> None:
+        self._updates.clear()
+
+
+class StateStoreProvider:
+    """Snapshot+delta file layout under ``<dir>``:
+    ``<v>.delta`` (changed keys + tombstones) and ``<v>.snapshot``."""
+
+    def __init__(self, path: str, snapshot_interval: int = 10):
+        self.path = path
+        self.snapshot_interval = max(1, snapshot_interval)
+        os.makedirs(path, exist_ok=True)
+
+    # -- file helpers ----------------------------------------------------------
+    def _write(self, name: str, obj: Any) -> None:
+        blob = _maybe_compress(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".tmp-")
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, os.path.join(self.path, name))
+
+    def _read(self, name: str) -> Any:
+        with open(os.path.join(self.path, name), "rb") as fh:
+            return pickle.loads(_maybe_decompress(fh.read()))
+
+    def _versions(self, suffix: str):
+        out = []
+        for name in os.listdir(self.path):
+            if name.endswith(suffix):
+                stem = name[: -len(suffix)]
+                if stem.isdigit():
+                    out.append(int(stem))
+        return sorted(out)
+
+    # -- public ----------------------------------------------------------------
+    def get_store(self, version: int) -> StateStore:
+        """Load state as of ``version`` (0 = empty) for the next batch."""
+        if version == 0:
+            return StateStore(self, 0, {})
+        contents = self._load(version)
+        return StateStore(self, version, contents)
+
+    def _load(self, version: int) -> Dict[Key, Any]:
+        snaps = [v for v in self._versions(".snapshot") if v <= version]
+        base_version = snaps[-1] if snaps else 0
+        contents: Dict[Key, Any] = (
+            dict(self._read(f"{base_version}.snapshot")) if snaps else {})
+        for v in range(base_version + 1, version + 1):
+            delta = self._read(f"{v}.delta")
+            for k, val in delta.items():
+                if val == _TOMBSTONE:
+                    contents.pop(k, None)
+                else:
+                    contents[k] = val
+        return contents
+
+    def _commit(self, version: int, contents: Dict[Key, Any],
+                updates: Dict[Key, Any]) -> int:
+        new_version = version + 1
+        delta = {k: (_TOMBSTONE if v is _TOMBSTONE else v)
+                 for k, v in updates.items()}
+        self._write(f"{new_version}.delta", delta)
+        if new_version % self.snapshot_interval == 0:
+            merged = dict(contents)
+            for k, v in updates.items():
+                if v is _TOMBSTONE:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            self._write(f"{new_version}.snapshot", merged)
+        return new_version
+
+    def latest_version(self) -> int:
+        versions = self._versions(".delta") + self._versions(".snapshot")
+        return max(versions) if versions else 0
+
+    def purge(self, keep_version: int) -> None:
+        """Drop files not needed to reconstruct ``keep_version`` onward."""
+        snaps = [v for v in self._versions(".snapshot") if v <= keep_version]
+        if not snaps:
+            return
+        floor = snaps[-1]
+        for v in self._versions(".delta"):
+            if v <= floor:
+                os.unlink(os.path.join(self.path, f"{v}.delta"))
+        for v in snaps[:-1]:
+            os.unlink(os.path.join(self.path, f"{v}.snapshot"))

@@ -1,0 +1,361 @@
+"""Stateful streaming operators.
+
+Analogs of the reference's stateful physical operators: streaming aggregation
+(ref: sql/core/.../execution/streaming/statefulOperators.scala
+StateStoreSaveExec/StateStoreRestoreExec), streaming deduplication
+(StreamingDeduplicateExec), stream-stream join
+(StreamingSymmetricHashJoinExec + SymmetricHashJoinStateManager), and event-
+time watermarks (EventTimeWatermarkExec).
+
+Aggregations are incrementalized by keeping *mergeable partials* per group in
+the state store (sum/count/min/max merge directly; avg as (sum,count);
+count_distinct as a value set) — the same partial-aggregate shape the
+reference's HashAggregateExec produces before its state-store save.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.sql.column import AggExpr, Alias, ColumnRef, Expr
+from cycloneml_tpu.sql.plan import (Aggregate, Batch, Join, LogicalPlan, Scan,
+                                    _factorize)
+from cycloneml_tpu.streaming.state import StateStore
+
+
+class Watermark(LogicalPlan):
+    """Pass-through marker: ``event_col`` lags at most ``delay`` seconds
+    behind the max observed event time (ref: EventTimeWatermarkExec). The
+    engine reads ``observed_max`` after each batch to advance the global
+    watermark."""
+
+    def __init__(self, child: LogicalPlan, event_col: str, delay: float):
+        self.children = [child]
+        self.event_col = event_col
+        self.delay = float(delay)
+        self.observed_max: Optional[float] = None
+
+    def with_children(self, c):
+        w = Watermark(c[0], self.event_col, self.delay)
+        w.observed_max = self.observed_max
+        return w
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        col = batch.get(self.event_col)
+        if col is not None and len(col):
+            m = float(np.max(np.asarray(col, dtype=float)))
+            self.observed_max = m if self.observed_max is None else max(
+                self.observed_max, m)
+        return batch
+
+    def __repr__(self):
+        return f"Watermark({self.event_col}, delay={self.delay}s)"
+
+
+class Deduplicate(LogicalPlan):
+    """dropDuplicates(subset) — batch execution dedups within the batch;
+    the streaming engine adds cross-batch state (StreamingDeduplicateExec)."""
+
+    def __init__(self, child: LogicalPlan, subset: Optional[List[str]] = None):
+        self.children = [child]
+        self.subset = subset
+
+    def with_children(self, c):
+        return Deduplicate(c[0], self.subset)
+
+    def output(self):
+        return self.children[0].output()
+
+    def execute(self):
+        batch = self.children[0].execute()
+        cols = self.subset or list(batch)
+        n = len(next(iter(batch.values()))) if batch else 0
+        if n == 0:
+            return batch
+        keys = [np.asarray(batch[c]) for c in cols]
+        _, _, first_idx = _factorize(keys)
+        first_idx = np.sort(first_idx)
+        return {c: np.asarray(v)[first_idx] for c, v in batch.items()}
+
+    def __repr__(self):
+        return f"Deduplicate({self.subset or '*'})"
+
+
+# -- mergeable partials for each aggregate kind --------------------------------
+
+def _batch_partials(a: AggExpr, batch: Batch, codes: np.ndarray,
+                    n_groups: int, n_rows: int) -> List[Any]:
+    values = None
+    if a.children:
+        values = np.atleast_1d(a.children[0].eval(batch))
+        if values.shape[0] != n_rows:
+            values = np.broadcast_to(values, (n_rows,)).copy()
+    if a.fn == "avg":
+        s = np.bincount(codes, weights=np.asarray(values, dtype=float),
+                        minlength=n_groups)
+        c = np.bincount(codes, minlength=n_groups)
+        return [(float(s[i]), int(c[i])) for i in range(n_groups)]
+    if a.fn == "count_distinct":
+        sets: List[set] = [set() for _ in range(n_groups)]
+        for g, v in zip(codes, values):
+            sets[g].add(v.item() if isinstance(v, np.generic) else v)
+        return sets
+    if a.fn == "collect_list":
+        lists: List[list] = [[] for _ in range(n_groups)]
+        for g, v in zip(codes, values):
+            lists[g].append(v.item() if isinstance(v, np.generic) else v)
+        return lists
+    if a.fn == "first":
+        out: List[Any] = [None] * n_groups
+        seen = [False] * n_groups
+        for g, v in zip(codes, values):
+            if not seen[g]:
+                out[g] = v.item() if isinstance(v, np.generic) else v
+                seen[g] = True
+        return out
+    # sum / count / min / max: the per-group result IS the mergeable partial
+    arr = a.agg(values, codes, n_groups)
+    return [x.item() if isinstance(x, np.generic) else x for x in arr]
+
+
+def _merge_partial(fn: str, old: Any, new: Any) -> Any:
+    if old is None:
+        return new
+    if fn in ("sum", "count"):
+        return old + new
+    if fn == "min":
+        return min(old, new)
+    if fn == "max":
+        return max(old, new)
+    if fn == "avg":
+        return (old[0] + new[0], old[1] + new[1])
+    if fn == "count_distinct":
+        return old | new
+    if fn == "collect_list":
+        return old + new
+    if fn == "first":
+        return old
+    raise ValueError(f"aggregate {fn!r} is not supported in streaming "
+                     f"(not mergeable)")
+
+
+def _finalize_partial(fn: str, p: Any) -> Any:
+    if fn == "avg":
+        return p[0] / p[1] if p[1] else float("nan")
+    if fn == "count_distinct":
+        return len(p)
+    return p
+
+
+class StatefulAggregation:
+    """Incremental group-by over micro-batches.
+
+    Per batch: evaluate group keys + per-aggregate partials on the new rows,
+    merge into the keyed state store, then emit per the output mode:
+    ``complete`` = all groups, ``update`` = groups touched this batch,
+    ``append`` = watermark-expired groups only (emitted once, then evicted) —
+    the same mode semantics as the reference (InternalOutputModes).
+    """
+
+    def __init__(self, agg: Aggregate, mode: str,
+                 watermark_col: Optional[str] = None):
+        self.agg = agg
+        self.mode = mode
+        self.agg_ids = []
+        seen = set()
+        for e in agg.agg_exprs:
+            for a in e.find_aggregates():
+                key = f"__agg_{a}"
+                if key not in seen:
+                    seen.add(key)
+                    self.agg_ids.append((key, a))
+        self.watermark_key_idx: Optional[int] = None
+        if watermark_col is not None:
+            for i, g in enumerate(agg.group_exprs):
+                base = g.children[0] if isinstance(g, Alias) else g
+                if isinstance(base, ColumnRef) and base.name == watermark_col:
+                    self.watermark_key_idx = i  # exact event-time key
+                    break
+            else:
+                for i, g in enumerate(agg.group_exprs):
+                    if watermark_col in g.references():
+                        self.watermark_key_idx = i  # derived (e.g. bucketed)
+                        break
+        if mode == "append" and self.watermark_key_idx is None:
+            raise ValueError(
+                "append mode on a streaming aggregation requires a watermark "
+                "on (a derivative of) one of the grouping columns "
+                "(ref: UnsupportedOperationChecker)")
+
+    def process_batch(self, batch: Batch, store: StateStore,
+                      watermark: Optional[float]) -> Batch:
+        n = len(next(iter(batch.values()))) if batch else 0
+        touched: List[Tuple] = []
+        if n > 0:
+            keys = [np.atleast_1d(g.eval(batch)) for g in self.agg.group_exprs]
+            if keys:
+                codes, n_groups, first_idx = _factorize(keys)
+            else:
+                codes = np.zeros(n, dtype=np.int64)
+                n_groups, first_idx = 1, np.array([0])
+            partials = {key: _batch_partials(a, batch, codes, n_groups, n)
+                        for key, a in self.agg_ids}
+            for g in range(n_groups):
+                row = first_idx[g]
+                key = tuple(
+                    k[row].item() if isinstance(k[row], np.generic) else k[row]
+                    for k in keys)
+                if (self.mode == "append" and watermark is not None
+                        and float(key[self.watermark_key_idx]) < watermark):
+                    continue  # late data: its group was already finalized
+                state = store.get(key) or {}
+                for pkey, a in self.agg_ids:
+                    state[pkey] = _merge_partial(a.fn, state.get(pkey),
+                                                 partials[pkey][g])
+                store.put(key, state)
+                touched.append(key)
+
+        if self.mode == "complete":
+            return self._emit([(k, v) for k, v in store.items()])
+        if self.mode == "update":
+            return self._emit([(k, store.get(k)) for k in touched])
+        # append: emit + evict groups whose event-time key < watermark
+        out: List[Tuple[Tuple, Dict]] = []
+        if watermark is not None:
+            for k, v in list(store.items()):
+                if float(k[self.watermark_key_idx]) < watermark:
+                    out.append((k, v))
+                    store.remove(k)
+        return self._emit(out)
+
+    def _emit(self, groups: List[Tuple[Tuple, Dict]]) -> Batch:
+        group_batch: Batch = {}
+        n = len(groups)
+        for i, g in enumerate(self.agg.group_exprs):
+            group_batch[g.name_hint()] = np.array(
+                [k[i] for k, _ in groups], dtype=object)
+        for pkey, a in self.agg_ids:
+            group_batch[pkey] = np.array(
+                [_finalize_partial(a.fn, v[pkey]) for _, v in groups],
+                dtype=object)
+        group_batch["__len__"] = n
+        out: Batch = {}
+        for g in self.agg.group_exprs:
+            out[g.name_hint()] = _narrow(group_batch[g.name_hint()])
+        for e in self.agg.agg_exprs:
+            rewritten = e.transform(
+                lambda node: ColumnRef(f"__agg_{node}")
+                if isinstance(node, AggExpr) else None)
+            v = np.atleast_1d(np.asarray(rewritten.eval(group_batch)))
+            if v.shape[0] != n:
+                v = np.broadcast_to(v, (n,)).copy() if n else v[:0]
+            out[e.name_hint()] = _narrow(v)
+        return out
+
+
+class StatefulDedup:
+    """Cross-batch dropDuplicates (ref: StreamingDeduplicateExec). With a
+    watermarked event-time column in the key, expired keys are evicted."""
+
+    def __init__(self, dedup: Deduplicate, watermark_col: Optional[str] = None):
+        self.subset = dedup.subset
+        self.watermark_col = watermark_col
+
+    def process_batch(self, batch: Batch, store: StateStore,
+                      watermark: Optional[float]) -> Batch:
+        cols = self.subset or list(batch)
+        n = len(next(iter(batch.values()))) if batch else 0
+        keep = []
+        for i in range(n):
+            key = tuple(
+                batch[c][i].item() if isinstance(batch[c][i], np.generic)
+                else batch[c][i] for c in cols)
+            if store.get(key) is None:
+                ts = (float(batch[self.watermark_col][i])
+                      if self.watermark_col in batch else 0.0)
+                store.put(key, ts)
+                keep.append(i)
+        if watermark is not None and self.watermark_col is not None:
+            for k, ts in list(store.items()):
+                if ts < watermark:
+                    store.remove(k)
+        idx = np.asarray(keep, dtype=np.int64)
+        return {c: np.asarray(v)[idx] for c, v in batch.items()}
+
+
+class StatefulJoin:
+    """Inner stream-stream join (ref: StreamingSymmetricHashJoinExec): both
+    inputs are buffered in state; each batch joins its new rows against the
+    other side's full buffer, so every matching pair is emitted exactly once.
+    Watermarked event-time columns bound the buffers."""
+
+    LEFT = ("__join_left__",)
+    RIGHT = ("__join_right__",)
+
+    def __init__(self, join: Join, watermark_cols: Dict[str, float]):
+        if join.how != "inner":
+            raise ValueError("streaming stream-stream join supports inner only "
+                             "(outer joins need watermark range analysis)")
+        self.join = join
+        self.watermark_cols = watermark_cols
+
+    def _concat(self, a: Optional[Batch], b: Batch) -> Batch:
+        from cycloneml_tpu.streaming.sources import _concat_batches
+        if a is None or not a:
+            return b
+        if not b or not len(next(iter(b.values()))):
+            return a
+        return _concat_batches([a, b], list(a))
+
+    def _evict(self, batch: Batch, watermark: Optional[float]) -> Batch:
+        if watermark is None or not batch:
+            return batch
+        for c in self.watermark_cols:
+            if c in batch:
+                mask = np.asarray(batch[c], dtype=float) >= watermark
+                return {k: np.asarray(v)[mask] for k, v in batch.items()}
+        return batch
+
+    def process_batch(self, new_left: Batch, new_right: Batch,
+                      store: StateStore, watermark: Optional[float]) -> Batch:
+        buf_l: Optional[Batch] = store.get(self.LEFT)
+        buf_r: Optional[Batch] = store.get(self.RIGHT)
+
+        def run(lb: Batch, rb: Batch) -> Optional[Batch]:
+            if not lb or not rb:
+                return None
+            if not len(next(iter(lb.values()))) or not len(next(iter(rb.values()))):
+                return None
+            j = self.join.with_children([Scan(lb, "l"), Scan(rb, "r")])
+            return j.execute()
+
+        full_r = self._concat(buf_r, new_right)
+        parts = [run(new_left, full_r), run(buf_l or {}, new_right)]
+        parts = [p for p in parts if p is not None]
+
+        store.put(self.LEFT, self._evict(self._concat(buf_l, new_left), watermark))
+        store.put(self.RIGHT, self._evict(full_r, watermark))
+
+        if not parts:
+            out_cols = self.join.output()
+            return {c: np.array([]) for c in out_cols}
+        return {c: np.concatenate([np.asarray(p[c]) for p in parts])
+                for c in parts[0]}
+
+
+def _narrow(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype == object and len(arr):
+        first = arr[0]
+        if isinstance(first, (int, np.integer)) and all(
+                isinstance(x, (int, np.integer)) for x in arr):
+            return arr.astype(np.int64)
+        if isinstance(first, (float, int, np.floating, np.integer)) and all(
+                isinstance(x, (float, int, np.floating, np.integer)) for x in arr):
+            return arr.astype(np.float64)
+    return arr
