@@ -301,3 +301,29 @@ TASK_MAX_FAILURES = (
     .check_value(lambda v: v >= 1, "must be >= 1")
     .int_conf(4)
 )
+
+METRICS_SINKS = (
+    ConfigBuilder("cyclone.metrics.sinks")
+    .doc("Comma-separated metric sinks: console, csv, prometheus "
+         "(ref: metrics/MetricsSystem.scala:70 + conf/metrics.properties).")
+    .str_conf("")
+)
+
+METRICS_PERIOD_S = (
+    ConfigBuilder("cyclone.metrics.period")
+    .doc("Push-sink report period in seconds (ref: CsvSink pollPeriod).")
+    .float_conf(10.0)
+)
+
+METRICS_CSV_DIR = (
+    ConfigBuilder("cyclone.metrics.csv.dir")
+    .doc("Directory for the CSV metrics sink.")
+    .str_conf("/tmp/cyclone-metrics")
+)
+
+PROMETHEUS_PORT = (
+    ConfigBuilder("cyclone.metrics.prometheus.port")
+    .doc("Port for the pull-based /metrics endpoint; 0 picks a free port "
+         "(ref: PrometheusServlet.scala).")
+    .int_conf(0)
+)

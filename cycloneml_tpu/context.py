@@ -23,12 +23,15 @@ import numpy as np
 from cycloneml_tpu import mesh as mesh_mod
 from cycloneml_tpu.conf import (
     APP_NAME, CHECKPOINT_DIR, CycloneConf, DEFAULT_PARALLELISM,
-    EVENT_LOG_DIR, EVENT_LOG_ENABLED, MASTER,
+    EVENT_LOG_DIR, EVENT_LOG_ENABLED, MASTER, METRICS_CSV_DIR,
+    METRICS_PERIOD_S, METRICS_SINKS, PROMETHEUS_PORT,
 )
 from cycloneml_tpu.util.events import (
     ApplicationEnd, ApplicationStart, CycloneEvent, EventJournal, JobEnd,
-    JobStart, ListenerBus, MeshUp,
+    JobStart, ListenerBus, MeshUp, StepCompleted,
 )
+from cycloneml_tpu.util.metrics import ConsoleSink, CsvSink, MetricsSystem
+from cycloneml_tpu.util.status import AppStatusListener
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -115,11 +118,34 @@ class CycloneContext:
             self.listener_bus.add_listener(self._journal)
         self.listener_bus.start()
 
+        self._status_listener = AppStatusListener()
+        self.listener_bus.add_listener(self._status_listener)
+
         self.mesh_runtime = mesh_mod.get_or_create(self.conf.get(MASTER))
         self._next_broadcast = 0
         self._next_job = 0
+        self._job_stack: List[int] = []
+        self._job_steps: Dict[int, int] = {}
         self._stopped = False
         self._accumulators: List[Accumulator] = []
+
+        self.metrics = MetricsSystem("driver", self.conf.get(METRICS_PERIOD_S))
+        for name in [s.strip() for s in self.conf.get(METRICS_SINKS).split(",")
+                     if s.strip()]:
+            if name == "console":
+                self.metrics.register_sink(ConsoleSink())
+            elif name == "csv":
+                self.metrics.register_sink(CsvSink(self.conf.get(METRICS_CSV_DIR)))
+            elif name == "prometheus":
+                self.prometheus_port = self.metrics.start_prometheus(
+                    self.conf.get(PROMETHEUS_PORT))
+            else:
+                logger.warning("unknown metrics sink %r", name)
+        self.metrics.registry.gauge("mesh.devices",
+                                    lambda: self.mesh_runtime.n_devices)
+        self.metrics.registry.gauge(
+            "listenerBus.queued", lambda: self.listener_bus.metrics["queued"])
+        self.metrics.start()
 
         self.listener_bus.post(ApplicationStart(app_name=self.app_name, app_id=self.app_id))
         self.listener_bus.post(MeshUp(
@@ -167,13 +193,45 @@ class CycloneContext:
         self._next_job += 1
         jid = self._next_job
         self.listener_bus.post(JobStart(job_id=jid, description=description))
+        self._job_stack.append(jid)
+        self.metrics.registry.counter("jobs.started").inc()
         try:
-            out = fn()
+            with self.metrics.registry.timer("job.duration"):
+                out = fn()
         except Exception as e:
             self.listener_bus.post(JobEnd(job_id=jid, succeeded=False, error=str(e)))
+            self.metrics.registry.counter("jobs.failed").inc()
             raise
+        finally:
+            self._job_stack.pop()
         self.listener_bus.post(JobEnd(job_id=jid, succeeded=True))
+        self.metrics.registry.counter("jobs.succeeded").inc()
         return out
+
+    @property
+    def current_job_id(self) -> int:
+        return self._job_stack[-1] if self._job_stack else 0
+
+    def record_step(self, step_metrics: Dict[str, float]) -> None:
+        """Post per-step metrics (≈ TaskMetrics travelling with each task;
+        here one jitted step = one 'stage' of work)."""
+        jid = self.current_job_id
+        step = self._job_steps.get(jid, 0)
+        self._job_steps[jid] = step + 1
+        self.listener_bus.post(StepCompleted(job_id=jid, step=step,
+                                             metrics=dict(step_metrics)))
+        reg = self.metrics.registry
+        reg.counter("steps.completed").inc()
+        for k, v in step_metrics.items():
+            try:
+                reg.histogram(f"step.{k}").update(float(v))
+            except (TypeError, ValueError):
+                pass
+
+    @property
+    def status_store(self):
+        """Live application status (≈ AppStatusStore:35, REST api/v1)."""
+        return self._status_listener.store
 
     @property
     def checkpoint_dir(self) -> str:
@@ -189,6 +247,7 @@ class CycloneContext:
             return
         self._stopped = True
         self.listener_bus.post(ApplicationEnd(app_id=self.app_id))
+        self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:
             self._journal.close()

@@ -41,6 +41,11 @@ class Estimator(PipelineStage):
     def fit(self, frame: MLFrame, params: Optional[ParamMap] = None):
         if params is not None:
             return self.copy(params).fit(frame)
+        ctx = getattr(frame, "ctx", None)
+        if ctx is not None and hasattr(ctx, "run_job"):
+            # every fit is a tracked job in the status store / event journal
+            return ctx.run_job(f"{type(self).__name__}.fit",
+                               lambda: self._fit(frame))
         return self._fit(frame)
 
     def _fit(self, frame: MLFrame):

@@ -33,6 +33,7 @@ class DistributedLossFunction:
                  l2_reg_fn: Optional[Callable] = None,
                  weight_sum: Optional[float] = None):
         self._agg_call = dataset.tree_aggregate_fn(agg)
+        self._ctx = dataset.ctx
         self.l2_reg_fn = l2_reg_fn
         if weight_sum is None:
             import jax.numpy as jnp
@@ -51,6 +52,9 @@ class DistributedLossFunction:
             rl, rg = self.l2_reg_fn(coef)
             loss += rl
             grad += rg
+        if hasattr(self._ctx, "record_step"):
+            # one distributed gradient evaluation ≈ one stage's TaskMetrics
+            self._ctx.record_step({"loss": loss})
         return loss, grad
 
 

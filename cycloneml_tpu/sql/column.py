@@ -120,6 +120,32 @@ class Literal(Expr):
         return repr(self.value)
 
 
+class WindowExpr(Expr):
+    """Tumbling event-time window bucket: floor((t - offset)/width)*width +
+    offset, i.e. the window START (ref: TimeWindow in catalyst; the streaming
+    engine reads ``width`` to finalize a window only once the watermark
+    passes its END — window-start comparison alone would close still-open
+    windows)."""
+
+    def __init__(self, child: Expr, width: float, offset: float = 0.0):
+        self.children = [child]
+        self.width = float(width)
+        self.offset = float(offset)
+
+    def with_children(self, c):
+        return WindowExpr(c[0], self.width, self.offset)
+
+    def eval(self, batch):
+        t = np.asarray(self.children[0].eval(batch), dtype=float)
+        return np.floor((t - self.offset) / self.width) * self.width + self.offset
+
+    def name_hint(self):
+        return "window"
+
+    def __str__(self):
+        return f"window({self.children[0]}, {self.width})"
+
+
 class BinaryOp(Expr):
     _ops = {
         "+": np.add, "-": np.subtract, "*": np.multiply,

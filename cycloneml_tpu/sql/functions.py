@@ -46,6 +46,13 @@ def max(c) -> Column:  # noqa: A001
     return Column(MaxAgg(_c(c).expr))
 
 
+def window(c, width: float, offset: float = 0.0) -> Column:
+    """Tumbling window bucket (start time) of ``width`` seconds
+    (ref: functions.window / catalyst TimeWindow)."""
+    from cycloneml_tpu.sql.column import WindowExpr
+    return Column(WindowExpr(_c(c).expr, width, offset))
+
+
 def first(c) -> Column:
     return Column(FirstAgg(_c(c).expr))
 

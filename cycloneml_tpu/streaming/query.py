@@ -114,8 +114,12 @@ class MicroBatchExecution:
             wm_cols = {w.event_col: w.delay for w in self.watermarks}
             self.stateful_node = joins[0]
             self.stateful_op = StatefulJoin(joins[0], wm_cols)
-        elif mode == "complete":
-            raise ValueError("complete mode requires an aggregation")
+        if mode == "complete" and not isinstance(self.stateful_op,
+                                                 StatefulAggregation):
+            # dedup/join emit per-batch increments; complete-mode sinks
+            # replace their contents, silently losing earlier rows
+            raise ValueError("complete mode requires a streaming aggregation "
+                             "(ref: UnsupportedOperationChecker)")
         self.state_provider = (StateStoreProvider(state_path)
                                if self.stateful_op is not None else None)
         self._batch_lock = threading.Lock()
@@ -187,6 +191,12 @@ class MicroBatchExecution:
         self._committed_offsets = dict(ends)
         self.batch_id += 1
         self._advance_watermark()
+        if self.batch_id % 20 == 0:
+            # bound checkpoint growth (≈ minBatchesToRetain compaction)
+            self.offset_log.purge(keep_last=100)
+            self.commit_log.purge(keep_last=100)
+            if self.state_provider is not None:
+                self.state_provider.purge(max(1, self.batch_id - 100))
         self.last_progress = {
             "batchId": self.batch_id - 1,
             "numInputRows": int(n_in),
@@ -208,7 +218,7 @@ class MicroBatchExecution:
             new_l = node.children[0].execute()
             new_r = node.children[1].execute()
             result = self.stateful_op.process_batch(new_l, new_r, store,
-                                                    watermark)
+                                                    watermark, self.batch_id)
         elif isinstance(self.stateful_op, StatefulAggregation):
             child_batch = node.children[0].execute()
             result = self.stateful_op.process_batch(child_batch, store,
@@ -350,7 +360,8 @@ class DataStreamReader:
                 path or self._options["path"], fmt=fmt,
                 pattern=self._options.get("pattern", "*"),
                 header=bool(self._options.get("header", True)),
-                delimiter=self._options.get("delimiter", ","))
+                delimiter=self._options.get("delimiter", ","),
+                schema=self._schema)
         else:
             raise ValueError(f"unknown stream format {self._format!r}")
         return DataFrame(StreamingScan(src, self._format), self._session)

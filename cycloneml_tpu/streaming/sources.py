@@ -114,7 +114,8 @@ class FileStreamSource(Source):
     header names columns) and single-column text."""
 
     def __init__(self, path: str, fmt: str = "csv", pattern: str = "*",
-                 header: bool = True, delimiter: str = ","):
+                 header: bool = True, delimiter: str = ",",
+                 schema: Optional[List[str]] = None):
         self.path = path
         self.fmt = fmt
         self.pattern = pattern
@@ -122,7 +123,8 @@ class FileStreamSource(Source):
         self.delimiter = delimiter
         self._seen: List[str] = []
         self._log_path: Optional[str] = None
-        self.schema = self._infer_schema()
+        # explicit schema lets a query start on a still-empty directory
+        self.schema = list(schema) if schema else self._infer_schema()
 
     def set_log_dir(self, path: str) -> None:
         """Persist the seen-file log in the query checkpoint so logged offsets

@@ -19,7 +19,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from cycloneml_tpu.sql.column import AggExpr, Alias, ColumnRef, Expr
+from cycloneml_tpu.sql.column import (AggExpr, Alias, ColumnRef, Expr,
+                                      WindowExpr)
 from cycloneml_tpu.sql.plan import (Aggregate, Batch, Join, LogicalPlan, Scan,
                                     _factorize)
 from cycloneml_tpu.streaming.state import StateStore
@@ -176,17 +177,28 @@ class StatefulAggregation:
                     seen.add(key)
                     self.agg_ids.append((key, a))
         self.watermark_key_idx: Optional[int] = None
+        self.window_width = 0.0  # 0 = point events (raw event-time key)
         if watermark_col is not None:
             for i, g in enumerate(agg.group_exprs):
                 base = g.children[0] if isinstance(g, Alias) else g
                 if isinstance(base, ColumnRef) and base.name == watermark_col:
-                    self.watermark_key_idx = i  # exact event-time key
+                    self.watermark_key_idx = i
+                    break
+                if (isinstance(base, WindowExpr)
+                        and watermark_col in base.references()):
+                    self.watermark_key_idx = i
+                    self.window_width = base.width
                     break
             else:
-                for i, g in enumerate(agg.group_exprs):
-                    if watermark_col in g.references():
-                        self.watermark_key_idx = i  # derived (e.g. bucketed)
-                        break
+                derived = [i for i, g in enumerate(agg.group_exprs)
+                           if watermark_col in g.references()]
+                if derived and mode == "append":
+                    raise ValueError(
+                        "append mode needs the event-time grouping key to be "
+                        f"the watermarked column {watermark_col!r} itself or "
+                        "F.window() over it — an arbitrary derived expression "
+                        "has no known window end, so windows would be closed "
+                        "while still open")
         if mode == "append" and self.watermark_key_idx is None:
             raise ValueError(
                 "append mode on a streaming aggregation requires a watermark "
@@ -212,7 +224,7 @@ class StatefulAggregation:
                     k[row].item() if isinstance(k[row], np.generic) else k[row]
                     for k in keys)
                 if (self.mode == "append" and watermark is not None
-                        and float(key[self.watermark_key_idx]) < watermark):
+                        and self._expired(key, watermark)):
                     continue  # late data: its group was already finalized
                 state = store.get(key) or {}
                 for pkey, a in self.agg_ids:
@@ -225,14 +237,20 @@ class StatefulAggregation:
             return self._emit([(k, v) for k, v in store.items()])
         if self.mode == "update":
             return self._emit([(k, store.get(k)) for k in touched])
-        # append: emit + evict groups whose event-time key < watermark
+        # append: emit + evict groups whose window END passed the watermark
         out: List[Tuple[Tuple, Dict]] = []
         if watermark is not None:
             for k, v in list(store.items()):
-                if float(k[self.watermark_key_idx]) < watermark:
+                if self._expired(k, watermark):
                     out.append((k, v))
                     store.remove(k)
         return self._emit(out)
+
+    def _expired(self, key: Tuple, watermark: float) -> bool:
+        t = float(key[self.watermark_key_idx])
+        if self.window_width > 0:
+            return t + self.window_width <= watermark  # window end passed
+        return t < watermark  # point event-time key
 
     def _emit(self, groups: List[Tuple[Tuple, Dict]]) -> Batch:
         group_batch: Batch = {}
@@ -293,10 +311,10 @@ class StatefulJoin:
     """Inner stream-stream join (ref: StreamingSymmetricHashJoinExec): both
     inputs are buffered in state; each batch joins its new rows against the
     other side's full buffer, so every matching pair is emitted exactly once.
-    Watermarked event-time columns bound the buffers."""
-
-    LEFT = ("__join_left__",)
-    RIGHT = ("__join_right__",)
+    Buffers are stored as one chunk per micro-batch under ("L"/"R", batch_id)
+    keys, so each state delta carries only that batch's new rows (the
+    referenced SymmetricHashJoinStateManager keys per-row for the same
+    reason); watermarked event-time columns bound the buffers."""
 
     def __init__(self, join: Join, watermark_cols: Dict[str, float]):
         if join.how != "inner":
@@ -305,46 +323,66 @@ class StatefulJoin:
         self.join = join
         self.watermark_cols = watermark_cols
 
-    def _concat(self, a: Optional[Batch], b: Batch) -> Batch:
-        from cycloneml_tpu.streaming.sources import _concat_batches
-        if a is None or not a:
-            return b
-        if not b or not len(next(iter(b.values()))):
-            return a
-        return _concat_batches([a, b], list(a))
+    @staticmethod
+    def _rows(b: Optional[Batch]) -> int:
+        return len(next(iter(b.values()))) if b else 0
 
-    def _evict(self, batch: Batch, watermark: Optional[float]) -> Batch:
-        if watermark is None or not batch:
-            return batch
-        for c in self.watermark_cols:
-            if c in batch:
-                mask = np.asarray(batch[c], dtype=float) >= watermark
-                return {k: np.asarray(v)[mask] for k, v in batch.items()}
-        return batch
+    def _side_chunks(self, store: StateStore, side: str) -> List[Tuple[Tuple, Batch]]:
+        return sorted(((k, v) for k, v in store.items() if k[0] == side),
+                      key=lambda kv: kv[0][1])
+
+    def _evict_chunks(self, store: StateStore, side: str,
+                      watermark: Optional[float]) -> None:
+        if watermark is None:
+            return
+        for key, chunk in self._side_chunks(store, side):
+            col = next((c for c in self.watermark_cols if c in chunk), None)
+            if col is None:
+                return
+            mask = np.asarray(chunk[col], dtype=float) >= watermark
+            if mask.all():
+                continue  # untouched chunks produce no delta entry
+            if not mask.any():
+                store.remove(key)
+            else:
+                store.put(key, {c: np.asarray(v)[mask]
+                                for c, v in chunk.items()})
 
     def process_batch(self, new_left: Batch, new_right: Batch,
-                      store: StateStore, watermark: Optional[float]) -> Batch:
-        buf_l: Optional[Batch] = store.get(self.LEFT)
-        buf_r: Optional[Batch] = store.get(self.RIGHT)
+                      store: StateStore, watermark: Optional[float],
+                      batch_id: int) -> Batch:
+        from cycloneml_tpu.streaming.sources import _concat_batches
 
-        def run(lb: Batch, rb: Batch) -> Optional[Batch]:
-            if not lb or not rb:
+        def gather(side: str) -> Optional[Batch]:
+            chunks = [v for _, v in self._side_chunks(store, side)
+                      if self._rows(v)]
+            if not chunks:
                 return None
-            if not len(next(iter(lb.values()))) or not len(next(iter(rb.values()))):
+            return _concat_batches(chunks, list(chunks[0]))
+
+        def run(lb: Optional[Batch], rb: Optional[Batch]) -> Optional[Batch]:
+            if not self._rows(lb) or not self._rows(rb):
                 return None
             j = self.join.with_children([Scan(lb, "l"), Scan(rb, "r")])
             return j.execute()
 
-        full_r = self._concat(buf_r, new_right)
-        parts = [run(new_left, full_r), run(buf_l or {}, new_right)]
+        buf_l, buf_r = gather("L"), gather("R")
+        full_r = (_concat_batches([b for b in (buf_r, new_right)
+                                   if self._rows(b)],
+                                  list(new_right or buf_r))
+                  if (self._rows(buf_r) or self._rows(new_right)) else None)
+        parts = [run(new_left, full_r), run(buf_l, new_right)]
         parts = [p for p in parts if p is not None]
 
-        store.put(self.LEFT, self._evict(self._concat(buf_l, new_left), watermark))
-        store.put(self.RIGHT, self._evict(full_r, watermark))
+        if self._rows(new_left):
+            store.put(("L", batch_id), new_left)
+        if self._rows(new_right):
+            store.put(("R", batch_id), new_right)
+        self._evict_chunks(store, "L", watermark)
+        self._evict_chunks(store, "R", watermark)
 
         if not parts:
-            out_cols = self.join.output()
-            return {c: np.array([]) for c in out_cols}
+            return {c: np.array([]) for c in self.join.output()}
         return {c: np.concatenate([np.asarray(p[c]) for p in parts])
                 for c in parts[0]}
 

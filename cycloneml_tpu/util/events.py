@@ -133,7 +133,20 @@ class ListenerBus:
             ev = self._queue.get()
             if ev is None:
                 return
+            if isinstance(ev, threading.Event):
+                ev.set()  # flush marker for wait_until_empty
+                continue
             self._dispatch(ev)
+
+    def wait_until_empty(self, timeout: float = 10.0) -> bool:
+        """Block until every event posted so far has been dispatched
+        (≈ LiveListenerBus.waitUntilEmpty, used throughout the reference's
+        tests to make async listener state deterministic)."""
+        if not self._started:
+            return True
+        marker = threading.Event()
+        self._queue.put(marker)
+        return marker.wait(timeout)
 
     def stop(self) -> None:
         if self._started and self._thread is not None:

@@ -1,0 +1,288 @@
+"""Metrics system: registries, sources, periodic sinks.
+
+Analog of the reference's Dropwizard-based MetricsSystem (ref:
+core/.../metrics/MetricsSystem.scala:70, sinks in core/.../metrics/sink/:
+PrometheusServlet, CsvSink, ConsoleSink, GraphiteSink). One registry per
+instance (driver / history server); sources register named metrics; sinks
+poll the registry on a period. The Prometheus surface is both a text
+exposition string and an optional stdlib HTTP endpoint (/metrics) — the
+PrometheusServlet analog without a servlet container.
+"""
+
+from __future__ import annotations
+
+import http.server
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Value supplier polled at report time (≈ Dropwizard Gauge)."""
+
+    def __init__(self, fn: Callable[[], float]):
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        try:
+            return float(self._fn())
+        except Exception:
+            return float("nan")
+
+
+class Histogram:
+    """Streaming moments + reservoir-free quantile estimate over a sliding
+    window of the last ``window`` samples."""
+
+    def __init__(self, window: int = 1024):
+        self._window = window
+        self._samples: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._samples.append(v)
+            if len(self._samples) > self._window:
+                self._samples.pop(0)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean,
+                "p50": self.quantile(0.5), "p95": self.quantile(0.95),
+                "max": self.quantile(1.0)}
+
+
+class Timer(Histogram):
+    """Histogram of durations in seconds with a context-manager API.
+    Start times live on a per-thread stack, so one shared registry timer is
+    safe under nesting (Pipeline.fit → stage.fit both time 'job.duration')
+    and concurrent threads."""
+
+    def __init__(self, window: int = 1024):
+        super().__init__(window)
+        self._local = threading.local()
+
+    def __enter__(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(time.perf_counter())
+        return self
+
+    def __exit__(self, *exc):
+        self.update(time.perf_counter() - self._local.stack.pop())
+
+
+class MetricsRegistry:
+    """Named metric map (≈ com.codahale.metrics.MetricRegistry)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, factory: Callable[[], Any]):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram)
+
+    def timer(self, name: str) -> Timer:
+        return self._get_or_create(name, Timer)
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(fn))
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def values(self) -> Dict[str, float]:
+        """Flatten to name → scalar(s) for sinks."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.count
+            elif isinstance(m, Gauge):
+                out[name] = m.value
+            elif isinstance(m, Histogram):
+                for k, v in m.snapshot().items():
+                    out[f"{name}.{k}"] = v
+        return out
+
+
+# -- sinks ---------------------------------------------------------------------
+
+class Sink:
+    def report(self, values: Dict[str, float]) -> None:
+        raise NotImplementedError
+
+
+class ConsoleSink(Sink):
+    def report(self, values: Dict[str, float]) -> None:
+        for k in sorted(values):
+            print(f"metric {k} = {values[k]}")
+
+
+class CsvSink(Sink):
+    """One CSV file per metric, a row per report (ref: CsvSink.scala)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def report(self, values: Dict[str, float]) -> None:
+        now = int(time.time())
+        for k, v in values.items():
+            path = os.path.join(self.directory, f"{k}.csv")
+            new = not os.path.exists(path)
+            with open(path, "a", encoding="utf-8") as fh:
+                if new:
+                    fh.write("t,value\n")
+                fh.write(f"{now},{v}\n")
+
+
+def prometheus_text(values: Dict[str, float], prefix: str = "cyclone") -> str:
+    """Prometheus exposition format (ref: PrometheusServlet.scala /
+    PrometheusResource.scala)."""
+    lines = []
+    for k in sorted(values):
+        v = values[k]
+        safe = f"{prefix}_{k}".replace(".", "_").replace("-", "_")
+        if isinstance(v, float) and math.isnan(v):
+            continue
+        lines.append(f"{safe} {v}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusEndpoint(Sink):
+    """Serves /metrics over HTTP from a daemon thread."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0):
+        self.registry = registry
+        reg = registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = prometheus_text(reg.values()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr noise
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                       Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="cyclone-prometheus", daemon=True)
+        self._thread.start()
+
+    def report(self, values: Dict[str, float]) -> None:
+        pass  # pull-based
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MetricsSystem:
+    """Owns the registry and drives push sinks on a period
+    (ref: MetricsSystem.scala:70 start/report lifecycle)."""
+
+    def __init__(self, instance: str = "driver", period_s: float = 10.0):
+        self.instance = instance
+        self.registry = MetricsRegistry()
+        self.period_s = period_s
+        self._sinks: List[Sink] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._endpoint: Optional[PrometheusEndpoint] = None
+
+    def register_sink(self, sink: Sink) -> None:
+        self._sinks.append(sink)
+
+    def start_prometheus(self, port: int = 0) -> int:
+        self._endpoint = PrometheusEndpoint(self.registry, port)
+        return self._endpoint.port
+
+    def start(self) -> None:
+        if self._thread is not None or not self._sinks:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"metrics-{self.instance}",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.report()
+
+    def report(self) -> None:
+        values = self.registry.values()
+        for s in self._sinks:
+            try:
+                s.report(values)
+            except Exception:
+                pass  # a broken sink must not kill the app
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
+        if self._sinks:
+            self.report()  # final flush, as the reference does on stop

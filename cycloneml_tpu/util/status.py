@@ -1,0 +1,159 @@
+"""Application status store + history provider.
+
+Analog of the reference's status-tracking stack (ref:
+core/.../status/AppStatusListener.scala:46 folds ListenerBus events into
+AppStatusStore.scala:35 backed by common/kvstore; REST surface
+status/api/v1/ApiRootResource.scala; history replay
+deploy/history/FsHistoryProvider.scala:84). ``AppStatusListener`` subscribes
+to the live bus; ``HistoryProvider`` rebuilds the same store by replaying a
+JSON-lines event journal — the history-server path. ``api_v1`` returns the
+REST-shaped dicts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from cycloneml_tpu.util.events import CycloneEvent, EventJournal
+
+
+class AppStatusStore:
+    """In-memory status model (≈ AppStatusStore over InMemoryStore.java)."""
+
+    def __init__(self):
+        self.app: Dict[str, Any] = {}
+        self.mesh: Dict[str, Any] = {}
+        self.jobs: Dict[int, Dict[str, Any]] = {}
+        self.checkpoints: List[Dict[str, Any]] = []
+        self.worker_failures: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
+    def application_info(self) -> Dict[str, Any]:
+        return dict(self.app, mesh=dict(self.mesh))
+
+    def job_list(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [self._job_public(j) for j in self.jobs.values()]
+
+    def job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        j = self.jobs.get(job_id)
+        return self._job_public(j) if j else None
+
+    @staticmethod
+    def _job_public(j: Dict[str, Any]) -> Dict[str, Any]:
+        out = {k: v for k, v in j.items() if k != "steps"}
+        out["numSteps"] = len(j.get("steps", []))
+        return out
+
+    def steps(self, job_id: int) -> List[Dict[str, Any]]:
+        j = self.jobs.get(job_id)
+        return list(j.get("steps", [])) if j else []
+
+
+class AppStatusListener:
+    """Folds typed events into the store (ref: AppStatusListener.scala:46)."""
+
+    def __init__(self, store: Optional[AppStatusStore] = None):
+        self.store = store or AppStatusStore()
+
+    def __call__(self, event: CycloneEvent) -> None:
+        self.on_event(event.to_json())
+
+    def on_event(self, e: Dict[str, Any]) -> None:
+        s = self.store
+        kind = e.get("Event")
+        if kind == "ApplicationStart":
+            s.app.update(id=e.get("app_id"), name=e.get("app_name"),
+                         startTime=e.get("time_ms"), endTime=None)
+        elif kind == "ApplicationEnd":
+            s.app["endTime"] = e.get("time_ms")
+        elif kind == "MeshUp":
+            s.mesh.update(nDevices=e.get("n_devices"),
+                          platform=e.get("platform"),
+                          shape=e.get("mesh_shape"))
+        elif kind == "JobStart":
+            with s._lock:
+                s.jobs[e["job_id"]] = {
+                    "jobId": e["job_id"],
+                    "description": e.get("description", ""),
+                    "submissionTime": e.get("time_ms"),
+                    "completionTime": None, "status": "RUNNING",
+                    "steps": [],
+                }
+        elif kind == "JobEnd":
+            with s._lock:
+                j = s.jobs.setdefault(e["job_id"], {"jobId": e["job_id"],
+                                                    "steps": []})
+                j["completionTime"] = e.get("time_ms")
+                j["status"] = ("SUCCEEDED" if e.get("succeeded", True)
+                               else "FAILED")
+                if e.get("error"):
+                    j["error"] = e["error"]
+        elif kind == "StepCompleted":
+            with s._lock:
+                j = s.jobs.setdefault(e.get("job_id", 0),
+                                      {"jobId": e.get("job_id", 0),
+                                       "steps": []})
+                j["steps"].append({"step": e.get("step"),
+                                   "metrics": e.get("metrics", {}),
+                                   "time": e.get("time_ms")})
+        elif kind == "CheckpointWritten":
+            s.checkpoints.append({"path": e.get("path"),
+                                  "step": e.get("step"),
+                                  "time": e.get("time_ms")})
+        elif kind == "WorkerLost":
+            s.worker_failures.append({"workerId": e.get("worker_id"),
+                                      "reason": e.get("reason"),
+                                      "time": e.get("time_ms")})
+
+
+class HistoryProvider:
+    """Replays event journals into status stores (ref:
+    FsHistoryProvider.scala:84 — list, lazy-load, serve)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._stores: Dict[str, AppStatusStore] = {}
+
+    def applications(self) -> List[Dict[str, Any]]:
+        out = []
+        if not os.path.isdir(self.log_dir):
+            return out
+        for name in sorted(os.listdir(self.log_dir)):
+            if name.endswith(".jsonl"):
+                out.append({"id": name[:-6],
+                            "logPath": os.path.join(self.log_dir, name)})
+        return out
+
+    def load(self, app_id: str) -> AppStatusStore:
+        if app_id in self._stores:
+            return self._stores[app_id]
+        path = os.path.join(self.log_dir, f"{app_id}.jsonl")
+        listener = AppStatusListener()
+        for e in EventJournal.replay(path):
+            listener.on_event(e)
+        self._stores[app_id] = listener.store
+        return listener.store
+
+
+def api_v1(store: AppStatusStore, route: str,
+           job_id: Optional[int] = None) -> Any:
+    """Tiny REST dispatcher shaped like status/api/v1 paths:
+    'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
+    'checkpoints', 'workers/failures'."""
+    if route == "applications":
+        return [store.application_info()]
+    if route == "jobs":
+        return store.job_list()
+    if route == "jobs/<id>":
+        return store.job(job_id)
+    if route == "jobs/<id>/steps":
+        return store.steps(job_id)
+    if route == "checkpoints":
+        return list(store.checkpoints)
+    if route == "workers/failures":
+        return list(store.worker_failures)
+    raise KeyError(f"unknown route {route!r}")

@@ -1,0 +1,211 @@
+"""Observability tests: metrics system, status store, history replay.
+
+Models the reference's status/metrics coverage (ref:
+AppStatusListenerSuite, MetricsSystemSuite, FsHistoryProviderSuite).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.util.events import (ApplicationEnd, ApplicationStart,
+                                       CheckpointWritten, JobEnd, JobStart,
+                                       ListenerBus, MeshUp, StepCompleted,
+                                       WorkerLost)
+from cycloneml_tpu.util.metrics import (ConsoleSink, CsvSink, MetricsRegistry,
+                                        MetricsSystem, prometheus_text)
+from cycloneml_tpu.util.status import (AppStatusListener, HistoryProvider,
+                                       api_v1)
+
+
+# -- metrics primitives ----------------------------------------------------------
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2)
+    state = {"v": 7.0}
+    reg.gauge("g", lambda: state["v"])
+    for i in range(10):
+        reg.histogram("h").update(float(i))
+    with reg.timer("t"):
+        pass
+    vals = reg.values()
+    assert vals["c"] == 3
+    assert vals["g"] == 7.0
+    assert vals["h.count"] == 10 and vals["h.mean"] == 4.5
+    assert vals["h.p50"] == 4.0 and vals["h.max"] == 9.0
+    assert vals["t.count"] == 1
+    state["v"] = 9.0
+    assert reg.values()["g"] == 9.0
+
+
+def test_timer_nesting_and_threads():
+    """One shared registry timer must survive nesting (Pipeline.fit wraps
+    stage fits) and concurrent use without corrupting durations."""
+    import threading as th
+    reg = MetricsRegistry()
+    t = reg.timer("d")
+    with t:
+        time.sleep(0.05)
+        with t:
+            time.sleep(0.01)
+    snap = t.snapshot()
+    assert snap["count"] == 2
+    assert snap["max"] >= 0.055  # outer duration not clobbered by inner
+
+    def worker():
+        with t:
+            time.sleep(0.02)
+
+    threads = [th.Thread(target=worker) for _ in range(4)]
+    for x in threads:
+        x.start()
+    for x in threads:
+        x.join()
+    assert t.count == 6
+
+
+def test_csv_sink(tmp_path):
+    sink = CsvSink(str(tmp_path))
+    sink.report({"a.b": 1.5})
+    sink.report({"a.b": 2.5})
+    lines = open(tmp_path / "a.b.csv").read().strip().split("\n")
+    assert lines[0] == "t,value"
+    assert len(lines) == 3
+    assert lines[1].endswith(",1.5") and lines[2].endswith(",2.5")
+
+
+def test_prometheus_text_format():
+    text = prometheus_text({"jobs.started": 3, "step.loss.mean": 0.25})
+    assert "cyclone_jobs_started 3" in text
+    assert "cyclone_step_loss_mean 0.25" in text
+
+
+def test_prometheus_http_endpoint():
+    ms = MetricsSystem("driver", period_s=100)
+    ms.registry.counter("hits").inc(5)
+    port = ms.start_prometheus(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "cyclone_hits 5" in body
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=5)
+    finally:
+        ms.stop()
+
+
+def test_metrics_system_periodic_report():
+    ms = MetricsSystem("driver", period_s=0.02)
+    seen = []
+
+    class Probe:
+        def report(self, values):
+            seen.append(dict(values))
+
+    ms.register_sink(Probe())
+    ms.registry.counter("x").inc()
+    ms.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not seen:
+        time.sleep(0.02)
+    ms.stop()
+    assert seen and seen[-1]["x"] == 1
+
+
+# -- status store ---------------------------------------------------------------
+
+def _feed(listener):
+    listener(ApplicationStart(app_name="app", app_id="app-1"))
+    listener(MeshUp(n_devices=8, platform="cpu", mesh_shape="{}"))
+    listener(JobStart(job_id=1, description="LogisticRegression.fit"))
+    listener(StepCompleted(job_id=1, step=0, metrics={"loss": 0.69}))
+    listener(StepCompleted(job_id=1, step=1, metrics={"loss": 0.42}))
+    listener(JobEnd(job_id=1, succeeded=True))
+    listener(JobStart(job_id=2, description="bad"))
+    listener(JobEnd(job_id=2, succeeded=False, error="boom"))
+    listener(CheckpointWritten(path="/ck/step2", step=2))
+    listener(WorkerLost(worker_id="w0", reason="heartbeat timeout"))
+    listener(ApplicationEnd(app_id="app-1"))
+
+
+def test_status_listener_folds_events():
+    listener = AppStatusListener()
+    _feed(listener)
+    s = listener.store
+    info = s.application_info()
+    assert info["id"] == "app-1" and info["endTime"] is not None
+    assert info["mesh"]["nDevices"] == 8
+    jobs = {j["jobId"]: j for j in s.job_list()}
+    assert jobs[1]["status"] == "SUCCEEDED" and jobs[1]["numSteps"] == 2
+    assert jobs[2]["status"] == "FAILED" and jobs[2]["error"] == "boom"
+    steps = s.steps(1)
+    assert [st["metrics"]["loss"] for st in steps] == [0.69, 0.42]
+    assert s.checkpoints[0]["step"] == 2
+    assert s.worker_failures[0]["workerId"] == "w0"
+
+
+def test_api_v1_routes():
+    listener = AppStatusListener()
+    _feed(listener)
+    s = listener.store
+    assert api_v1(s, "applications")[0]["name"] == "app"
+    assert len(api_v1(s, "jobs")) == 2
+    assert api_v1(s, "jobs/<id>", 1)["status"] == "SUCCEEDED"
+    assert len(api_v1(s, "jobs/<id>/steps", 1)) == 2
+    assert api_v1(s, "checkpoints")[0]["path"] == "/ck/step2"
+    assert api_v1(s, "workers/failures")[0]["reason"] == "heartbeat timeout"
+    with pytest.raises(KeyError):
+        api_v1(s, "nope")
+
+
+def test_history_provider_replays_journal(tmp_path):
+    """History-server path: JSON-lines journal → same store as live bus
+    (ref: FsHistoryProvider.scala:84)."""
+    from cycloneml_tpu.util.events import EventJournal
+    path = tmp_path / "app-42.jsonl"
+    journal = EventJournal(str(path))
+    bus = ListenerBus()
+    bus.add_listener(journal)
+    _feed(bus.post)  # synchronous dispatch (bus not started)
+    journal.close()
+
+    hp = HistoryProvider(str(tmp_path))
+    apps = hp.applications()
+    assert [a["id"] for a in apps] == ["app-42"]
+    store = hp.load("app-42")
+    assert store.application_info()["id"] == "app-1"
+    assert store.job(1)["status"] == "SUCCEEDED"
+    assert [st["metrics"]["loss"] for st in store.steps(1)] == [0.69, 0.42]
+
+
+# -- end-to-end: a real fit shows up in status + metrics ------------------------
+
+def test_fit_tracked_in_status_store(ctx):
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4)
+    y = (x @ np.array([1.0, -2.0, 0.5, 0.0]) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    before = len(ctx.status_store.job_list())
+    LogisticRegression(maxIter=5).fit(frame)
+    assert ctx.listener_bus.wait_until_empty()
+    jobs = ctx.status_store.job_list()
+    assert len(jobs) > before
+    fit_jobs = [j for j in jobs if "LogisticRegression.fit" in j["description"]]
+    assert fit_jobs and fit_jobs[-1]["status"] == "SUCCEEDED"
+    steps = ctx.status_store.steps(fit_jobs[-1]["jobId"])
+    assert len(steps) >= 2  # one StepCompleted per gradient evaluation
+    losses = [st["metrics"]["loss"] for st in steps]
+    assert losses[-1] < losses[0]  # loss decreased over iterations
+    vals = ctx.metrics.registry.values()
+    assert vals["steps.completed"] >= len(steps)
+    assert vals["jobs.succeeded"] >= 1
+    assert vals["mesh.devices"] == 8

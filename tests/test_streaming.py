@@ -165,6 +165,40 @@ def test_append_mode_watermark_eviction(session):
     q.stop()
 
 
+def test_append_mode_windowed_aggregation(session):
+    """Windowed groups finalize only when the watermark passes the window
+    END; on-time rows for a still-open window must not be dropped."""
+    ms = MemoryStream(["ts", "v"])
+    df = (ms.to_df(session).with_watermark("ts", 5.0)
+          .group_by(F.window("ts", 10.0).alias("win"))
+          .agg(F.sum("v").alias("s")))
+    q = start_memory_query(df, mode="append")
+    ms.add_data(ts=[12.0, 16.0], v=[1.0, 1.0])
+    q.process_all_available()  # watermark -> 11; window [10,20) still open
+    assert q.sink.rows() == []
+    ms.add_data(ts=[19.0], v=[100.0])  # on-time for the open window
+    q.process_all_available()  # watermark -> 14; still open
+    assert q.sink.rows() == []
+    ms.add_data(ts=[26.0], v=[7.0])
+    q.process_all_available()  # watermark -> 21 >= 20: window finalizes
+    assert (10.0, 102.0) in q.sink.rows()
+
+
+def test_append_mode_arbitrary_derived_key_rejected(session):
+    ms = MemoryStream(["ts", "v"])
+    df = (ms.to_df(session).with_watermark("ts", 5.0)
+          .group_by((col("ts") * 2).alias("k")).agg(F.sum("v").alias("s")))
+    with pytest.raises(ValueError, match="window"):
+        start_memory_query(df, mode="append")
+
+
+def test_complete_mode_requires_aggregation(session):
+    ms = MemoryStream(["id"])
+    with pytest.raises(ValueError, match="aggregation"):
+        start_memory_query(ms.to_df(session).drop_duplicates(["id"]),
+                           mode="complete")
+
+
 def test_append_mode_without_watermark_rejected(session):
     ms = MemoryStream(["k"])
     df = ms.to_df(session).group_by("k").agg(F.count("*").alias("n"))
@@ -328,6 +362,53 @@ def test_file_source_and_file_sink(session, tmp_path):
     # replaying an already-manifested batch id is a no-op
     sink.add_batch(0, {"a": np.array([9.0]), "b": np.array([9.0])}, "append")
     assert len(sink.committed_files()) == 2
+
+
+def test_file_source_explicit_schema_on_empty_dir(session, tmp_path):
+    """A query can start on an empty directory when the schema is given
+    up-front (inference would fail with zero files)."""
+    src_dir = tmp_path / "in"
+    src_dir.mkdir()
+    df = (session.read_stream.format("csv").schema(["a", "b"])
+          .load(str(src_dir)))
+    q = start_memory_query(df)
+    q.process_all_available()
+    assert q.sink.rows() == []
+    (src_dir / "f.csv").write_text("a,b\n1,2\n")
+    q.process_all_available()
+    assert q.sink.rows() == [(1.0, 2.0)]
+
+
+def test_checkpoint_purged_over_many_batches(session, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    ms = MemoryStream(["k", "v"])
+    df = ms.to_df(session).group_by("k").agg(F.sum("v").alias("s"))
+    q = start_memory_query(df, mode="update", ckpt=ckpt)
+    for i in range(130):
+        ms.add_data(k=["a"], v=[1.0])
+        q.process_all_available()
+    q.stop()
+    n_offsets = len(os.listdir(os.path.join(ckpt, "offsets")))
+    assert n_offsets <= 110  # old entries purged, not unbounded
+    # state still consistent after purge
+    assert dict(q.sink.rows()[-1:]) == {"a": 130.0}
+
+
+def test_join_state_deltas_are_incremental(session, tmp_path):
+    """Join buffer deltas must carry only the batch's new rows, not the
+    whole buffer re-pickled (quadratic checkpoint growth otherwise)."""
+    ckpt = str(tmp_path / "ck")
+    left, right = MemoryStream(["id", "l"]), MemoryStream(["id", "r"])
+    df = left.to_df(session).join(right.to_df(session), on="id")
+    q = start_memory_query(df, ckpt=ckpt)
+    sizes = []
+    for i in range(6):
+        left.add_data(id=[i], l=[float(i)])
+        q.process_all_available()
+        delta = os.path.join(ckpt, "state", f"{i + 1}.delta")
+        sizes.append(os.path.getsize(delta))
+    # near-constant delta size as the buffer grows (was growing linearly)
+    assert sizes[-1] < sizes[0] * 3
 
 
 def test_rate_source(session):
