@@ -291,8 +291,10 @@ HEARTBEAT_INTERVAL_MS = (
 
 NETWORK_TIMEOUT_MS = (
     ConfigBuilder("cyclone.network.timeout")
-    .doc("Control-plane RPC timeout in ms.")
-    .fallback_conf(HEARTBEAT_INTERVAL_MS)
+    .doc("Control-plane RPC / worker-liveness timeout in ms. Must be well "
+         "above the heartbeat interval or jitter expires healthy workers "
+         "(the reference defaults to 120s vs a 10s heartbeat).")
+    .int_conf(120000)
 )
 
 TASK_MAX_FAILURES = (

@@ -128,6 +128,7 @@ class CycloneContext:
         self._job_steps: Dict[int, int] = {}
         self._stopped = False
         self._accumulators: List[Accumulator] = []
+        self._heartbeats = None
 
         self.metrics = MetricsSystem("driver", self.conf.get(METRICS_PERIOD_S))
         for name in [s.strip() for s in self.conf.get(METRICS_SINKS).split(",")
@@ -234,6 +235,37 @@ class CycloneContext:
         return self._status_listener.store
 
     @property
+    def heartbeat_receiver(self):
+        """Host-worker liveness registry (≈ HeartbeatReceiver endpoint).
+        Created lazily — single-host runs have no worker fleet to track."""
+        if self._heartbeats is None:
+            from cycloneml_tpu.conf import NETWORK_TIMEOUT_MS
+            from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
+            self._heartbeats = HeartbeatReceiver(
+                timeout_s=self.conf.get(NETWORK_TIMEOUT_MS) / 1000.0,
+                listener_bus=self.listener_bus)
+            self._heartbeats.start()
+        return self._heartbeats
+
+    def rebuild_mesh(self, master: Optional[str] = None):
+        """Elastic recovery (SURVEY §5.3): tear down the mesh and bring up a
+        new one — possibly smaller, possibly a spare slice — after device or
+        host loss. Device-resident data dies with the old mesh; callers
+        restore datasets from host copies or checkpoints and resume from the
+        last optimizer-state checkpoint (lineage recomputation does not
+        translate to TPU; checkpoint-based recovery does)."""
+        mesh_mod.reset()
+        self.mesh_runtime = mesh_mod.get_or_create(
+            master or self.conf.get(MASTER))
+        self.listener_bus.post(MeshUp(
+            n_devices=self.mesh_runtime.n_devices,
+            platform=self.mesh_runtime.platform,
+            mesh_shape=str(dict(zip(self.mesh_runtime.mesh.axis_names,
+                                    self.mesh_runtime.mesh.devices.shape)))))
+        logger.info("mesh rebuilt: %d devices", self.mesh_runtime.n_devices)
+        return self.mesh_runtime
+
+    @property
     def checkpoint_dir(self) -> str:
         return self.conf.get(CHECKPOINT_DIR)
 
@@ -247,6 +279,8 @@ class CycloneContext:
             return
         self._stopped = True
         self.listener_bus.post(ApplicationEnd(app_id=self.app_id))
+        if self._heartbeats is not None:
+            self._heartbeats.stop()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

@@ -39,6 +39,33 @@ class OptimState:
     converged: bool = False
     converged_reason: str = ""
     loss_history: List[float] = field(default_factory=list)
+    # curvature memory, carried so training can checkpoint/resume EXACTLY
+    # (the reference has no mid-training checkpointing at all — SURVEY §5.4
+    # flags step-level checkpoint as the required improvement)
+    hist_s: List[np.ndarray] = field(default_factory=list)
+    hist_y: List[np.ndarray] = field(default_factory=list)
+    raw_grad: Optional[np.ndarray] = None  # OWLQN: grad before pseudo-grad
+
+    def to_pytree(self) -> dict:
+        return {"x": self.x, "value": self.value, "grad": self.grad,
+                "iteration": self.iteration,
+                "converged": self.converged,
+                "converged_reason": self.converged_reason,
+                "loss_history": list(self.loss_history),
+                "hist_s": list(self.hist_s), "hist_y": list(self.hist_y),
+                "raw_grad": self.raw_grad}
+
+    @classmethod
+    def from_pytree(cls, t: dict) -> "OptimState":
+        return cls(x=np.asarray(t["x"]), value=float(t["value"]),
+                   grad=np.asarray(t["grad"]), iteration=int(t["iteration"]),
+                   converged=bool(t.get("converged", False)),
+                   converged_reason=str(t.get("converged_reason", "")),
+                   loss_history=[float(v) for v in t["loss_history"]],
+                   hist_s=[np.asarray(s) for s in t["hist_s"]],
+                   hist_y=[np.asarray(y) for y in t["hist_y"]],
+                   raw_grad=(np.asarray(t["raw_grad"])
+                             if t.get("raw_grad") is not None else None))
 
 
 class _History:
@@ -160,14 +187,25 @@ class LBFGS:
             return "gradient converged"
         return None
 
-    def iterations(self, f: LossGrad, x0: np.ndarray):
-        """Generator of OptimState per iteration (like Breeze .iterations)."""
-        x = np.asarray(x0, dtype=np.float64).copy()
-        value, grad = f(x)
-        state = OptimState(x=x, value=float(value), grad=np.asarray(grad, dtype=np.float64))
-        state.loss_history.append(state.value)
+    def iterations(self, f: LossGrad, x0: np.ndarray,
+                   resume: Optional[OptimState] = None):
+        """Generator of OptimState per iteration (like Breeze .iterations).
+        Pass a checkpointed ``resume`` state to continue exactly where a
+        previous run stopped (same curvature memory → identical trajectory)."""
         hist = _History(self.m)
+        if resume is not None:
+            state = resume
+            hist.s = [np.asarray(s) for s in resume.hist_s]
+            hist.y = [np.asarray(y) for y in resume.hist_y]
+        else:
+            x = np.asarray(x0, dtype=np.float64).copy()
+            value, grad = f(x)
+            state = OptimState(x=x, value=float(value),
+                               grad=np.asarray(grad, dtype=np.float64))
+            state.loss_history.append(state.value)
         yield state
+        if state.converged:
+            return  # resumed from a finished checkpoint: nothing to do
         while True:
             d = hist.direction(state.grad)
             init_alpha = 1.0 if state.iteration > 0 else \
@@ -188,7 +226,8 @@ class LBFGS:
             state = OptimState(
                 x=x_new, value=float(v_new), grad=g_new,
                 iteration=state.iteration + 1,
-                loss_history=state.loss_history + [float(v_new)])
+                loss_history=state.loss_history + [float(v_new)],
+                hist_s=list(hist.s), hist_y=list(hist.y))
             reason = self._converged(state, f_old)
             if reason is not None:
                 state.converged = True
@@ -197,9 +236,10 @@ class LBFGS:
             if state.converged:
                 return
 
-    def minimize(self, f: LossGrad, x0: np.ndarray) -> OptimState:
+    def minimize(self, f: LossGrad, x0: np.ndarray,
+                 resume: Optional[OptimState] = None) -> OptimState:
         state = None
-        for state in self.iterations(f, x0):
+        for state in self.iterations(f, x0, resume=resume):
             pass
         return state
 
@@ -230,22 +270,28 @@ class OWLQN(LBFGS):
         pg = np.where(at_zero & (grad - lam > 0), grad - lam, pg)
         return pg
 
-    def minimize(self, f: LossGrad, x0: np.ndarray) -> OptimState:
-        state = None
-        for state in self.iterations(f, x0):
-            pass
-        return state
-
-    def iterations(self, f: LossGrad, x0: np.ndarray):
-        x = np.asarray(x0, dtype=np.float64).copy()
-        value, grad = f(x)
-        value = float(value) + self._l1(x)
-        grad = np.asarray(grad, dtype=np.float64)
-        state = OptimState(x=x, value=value, grad=self._pseudo_grad(x, grad))
-        state.loss_history.append(state.value)
+    def iterations(self, f: LossGrad, x0: np.ndarray,
+                   resume: Optional[OptimState] = None):
         hist = _History(self.m)
+        if resume is not None:
+            state = resume
+            x = np.asarray(resume.x, dtype=np.float64)
+            hist.s = [np.asarray(s) for s in resume.hist_s]
+            hist.y = [np.asarray(y) for y in resume.hist_y]
+            raw_grad = (np.asarray(resume.raw_grad)
+                        if resume.raw_grad is not None else resume.grad)
+        else:
+            x = np.asarray(x0, dtype=np.float64).copy()
+            value, grad = f(x)
+            value = float(value) + self._l1(x)
+            grad = np.asarray(grad, dtype=np.float64)
+            state = OptimState(x=x, value=value,
+                               grad=self._pseudo_grad(x, grad), raw_grad=grad)
+            state.loss_history.append(state.value)
+            raw_grad = grad
         yield state
-        raw_grad = grad
+        if state.converged:
+            return  # resumed from a finished checkpoint: nothing to do
         while True:
             d = hist.direction(state.grad)
             # project direction onto the pseudo-gradient descent orthant
@@ -282,7 +328,9 @@ class OWLQN(LBFGS):
             state = OptimState(
                 x=x_new, value=float(v_new), grad=pg_new,
                 iteration=state.iteration + 1,
-                loss_history=state.loss_history + [float(v_new)])
+                loss_history=state.loss_history + [float(v_new)],
+                hist_s=list(hist.s), hist_y=list(hist.y),
+                raw_grad=raw_grad_new)
             reason = self._converged(state, f_old)
             if reason is not None:
                 state.converged = True

@@ -1,0 +1,213 @@
+"""Failure detection and recovery.
+
+TPU-native analog of the reference's resilience stack (SURVEY §5.3):
+
+- ``HeartbeatReceiver`` ≈ the driver's HeartbeatReceiver endpoint
+  (core/.../HeartbeatReceiver.scala): host workers ping; silent workers are
+  expired and announced on the listener bus as WorkerLost.
+- ``HealthTracker`` ≈ scheduler/HealthTracker.scala:52: repeated failures
+  exclude a worker from further placement.
+- ``retry_step`` ≈ TaskSetManager.handleFailedTask:853 / maxTaskFailures:58,
+  at the granularity that exists here: a failed jitted step is retried whole,
+  exactly like a barrier stage (any task failure retries the whole stage —
+  the model SURVEY §5.3 notes maps to a failed pjit step).
+- ``train_with_checkpoints`` = the recovery model that REPLACES lineage
+  recomputation on TPU: periodic optimizer-state checkpoints + resume, so a
+  lost mesh costs at most ``interval`` steps of recompute.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+from cycloneml_tpu.util.events import WorkerLost
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class HeartbeatReceiver:
+    """Expires workers whose last heartbeat is older than ``timeout_s``."""
+
+    def __init__(self, timeout_s: float = 120.0, check_interval_s: float = 1.0,
+                 listener_bus=None):
+        self.timeout_s = timeout_s
+        self.check_interval_s = check_interval_s
+        self.listener_bus = listener_bus
+        self._last: Dict[str, float] = {}
+        self._lost: Dict[str, str] = {}
+        self._callbacks: List[Callable[[str, str], None]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, worker_id: str) -> None:
+        with self._lock:
+            self._last[worker_id] = time.monotonic()
+            self._lost.pop(worker_id, None)  # re-registration revives
+
+    def heartbeat(self, worker_id: str) -> bool:
+        """Returns False if the worker was already expired (it must
+        re-register, as the reference asks executors to do)."""
+        with self._lock:
+            if worker_id in self._lost:
+                return False
+            if worker_id not in self._last:
+                return False
+            self._last[worker_id] = time.monotonic()
+            return True
+
+    def on_worker_lost(self, fn: Callable[[str, str], None]) -> None:
+        self._callbacks.append(fn)
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._last)
+
+    def lost_workers(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._lost)
+
+    def check_now(self) -> List[str]:
+        """Single expiry sweep (the timer thread calls this; tests call it
+        directly for determinism)."""
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for w, t in list(self._last.items()):
+                if now - t > self.timeout_s:
+                    del self._last[w]
+                    reason = (f"no heartbeat for {now - t:.1f}s "
+                              f"(timeout {self.timeout_s}s)")
+                    self._lost[w] = reason
+                    expired.append(w)
+        for w in expired:
+            logger.warning("worker %s lost: %s", w, self._lost[w])
+            if self.listener_bus is not None:
+                self.listener_bus.post(WorkerLost(worker_id=w,
+                                                  reason=self._lost[w]))
+            for fn in self._callbacks:
+                try:
+                    fn(w, self._lost[w])
+                except Exception:
+                    logger.exception("worker-lost callback failed")
+        return expired
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="cyclone-heartbeat", daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            self.check_now()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class HealthTracker:
+    """Excludes workers after repeated failures (ref: HealthTracker.scala:52
+    — per-executor failure counts with a threshold)."""
+
+    def __init__(self, max_failures: int = 2):
+        self.max_failures = max_failures
+        self._failures: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def record_failure(self, worker_id: str) -> None:
+        with self._lock:
+            self._failures[worker_id] = self._failures.get(worker_id, 0) + 1
+
+    def record_success(self, worker_id: str) -> None:
+        with self._lock:
+            self._failures.pop(worker_id, None)
+
+    def is_excluded(self, worker_id: str) -> bool:
+        with self._lock:
+            return self._failures.get(worker_id, 0) >= self.max_failures
+
+    def excluded(self) -> List[str]:
+        with self._lock:
+            return sorted(w for w, n in self._failures.items()
+                          if n >= self.max_failures)
+
+
+def retry_step(fn: Callable[[], Any], max_failures: int = 4,
+               on_failure: Optional[Callable[[int, Exception], None]] = None,
+               retryable=(Exception,)) -> Any:
+    """Run one step with whole-step retry (barrier-stage semantics)."""
+    last: Optional[Exception] = None
+    for attempt in range(max_failures):
+        try:
+            return fn()
+        except retryable as e:  # noqa: PERF203 — retry loop
+            last = e
+            logger.warning("step failed (attempt %d/%d): %s",
+                           attempt + 1, max_failures, e)
+            if on_failure is not None:
+                on_failure(attempt, e)
+    raise RuntimeError(
+        f"step failed {max_failures} times; aborting job "
+        f"(≈ TaskSetManager 'Task failed {max_failures} times')") from last
+
+
+def train_with_checkpoints(optimizer, loss_grad, x0,
+                           checkpointer: TrainingCheckpointer,
+                           interval: int = 5,
+                           max_step_failures: int = 4,
+                           on_step: Optional[Callable] = None):
+    """Drive ``optimizer.iterations`` with periodic state checkpoints and
+    automatic resume from the newest checkpoint.
+
+    On entry: if the checkpointer holds a state, training continues from it
+    (exactly — the full curvature memory is saved). Each iteration runs under
+    ``retry_step``. Returns the final OptimState.
+    """
+    from cycloneml_tpu.ml.optim.lbfgs import OptimState
+
+    resume = None
+    latest = checkpointer.latest_step()
+    if latest is not None:
+        resume = OptimState.from_pytree(checkpointer.restore(latest))
+        logger.info("resuming training from checkpoint step %d", latest)
+
+    it = optimizer.iterations(loss_grad, x0, resume=resume)
+    state = None
+
+    def next_state():
+        return next(it, None)
+
+    def rebuild(attempt, exc):
+        # a generator dies when an exception escapes next(); restart the
+        # iteration stream from the last good optimizer state
+        nonlocal it
+        base = state if state is not None else resume
+        it = optimizer.iterations(loss_grad, x0, resume=base)
+
+    while True:
+        s = retry_step(next_state, max_failures=max_step_failures,
+                       on_failure=rebuild)
+        if s is None:
+            break
+        if state is not None and s.iteration <= state.iteration:
+            continue  # rebuilt stream re-yields its resume point
+        state = s
+        if on_step is not None:
+            on_step(state)
+        if state.iteration > 0 and state.iteration % interval == 0:
+            checkpointer.save(state.iteration, state.to_pytree(),
+                              metadata={"loss": state.value})
+        if state.converged:
+            break
+    if state is not None and checkpointer.latest_step() != state.iteration:
+        checkpointer.save(state.iteration, state.to_pytree(),
+                          metadata={"loss": state.value, "final": True})
+    return state

@@ -62,6 +62,16 @@ class AppStatusListener:
     def __call__(self, event: CycloneEvent) -> None:
         self.on_event(event.to_json())
 
+    def _ensure_job(self, job_id: int) -> Dict[str, Any]:
+        """Full job skeleton even for out-of-order or untracked events —
+        job_id 0 collects steps recorded outside any run_job bracket."""
+        return self.store.jobs.setdefault(job_id, {
+            "jobId": job_id,
+            "description": "(untracked)" if job_id == 0 else "",
+            "submissionTime": None, "completionTime": None,
+            "status": "RUNNING", "steps": [],
+        })
+
     def on_event(self, e: Dict[str, Any]) -> None:
         s = self.store
         kind = e.get("Event")
@@ -76,17 +86,12 @@ class AppStatusListener:
                           shape=e.get("mesh_shape"))
         elif kind == "JobStart":
             with s._lock:
-                s.jobs[e["job_id"]] = {
-                    "jobId": e["job_id"],
-                    "description": e.get("description", ""),
-                    "submissionTime": e.get("time_ms"),
-                    "completionTime": None, "status": "RUNNING",
-                    "steps": [],
-                }
+                j = self._ensure_job(e["job_id"])
+                j["description"] = e.get("description", "")
+                j["submissionTime"] = e.get("time_ms")
         elif kind == "JobEnd":
             with s._lock:
-                j = s.jobs.setdefault(e["job_id"], {"jobId": e["job_id"],
-                                                    "steps": []})
+                j = self._ensure_job(e["job_id"])
                 j["completionTime"] = e.get("time_ms")
                 j["status"] = ("SUCCEEDED" if e.get("succeeded", True)
                                else "FAILED")
@@ -94,9 +99,7 @@ class AppStatusListener:
                     j["error"] = e["error"]
         elif kind == "StepCompleted":
             with s._lock:
-                j = s.jobs.setdefault(e.get("job_id", 0),
-                                      {"jobId": e.get("job_id", 0),
-                                       "steps": []})
+                j = self._ensure_job(e.get("job_id", 0))
                 j["steps"].append({"step": e.get("step"),
                                    "metrics": e.get("metrics", {}),
                                    "time": e.get("time_ms")})

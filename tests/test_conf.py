@@ -30,11 +30,19 @@ def test_validator():
 
 
 def test_fallback_entry():
+    from cycloneml_tpu.conf import ConfigBuilder
+    fb = (ConfigBuilder("cyclone.test.fallbackChild")
+          .doc("falls back like spark.network.timeout once did")
+          .fallback_conf(HEARTBEAT_INTERVAL_MS))
     conf = CycloneConf(load_defaults=False)
-    # NETWORK_TIMEOUT falls back to heartbeat interval like spark.network.timeout
-    assert conf.get(NETWORK_TIMEOUT_MS) == conf.get(HEARTBEAT_INTERVAL_MS)
-    conf.set(NETWORK_TIMEOUT_MS, 1234)
-    assert conf.get(NETWORK_TIMEOUT_MS) == 1234
+    assert conf.get(fb) == conf.get(HEARTBEAT_INTERVAL_MS)
+    conf.set(HEARTBEAT_INTERVAL_MS, 777)
+    assert conf.get(fb) == 777  # follows the parent until set directly
+    conf.set(fb, 1234)
+    assert conf.get(fb) == 1234
+    # liveness timeout now has a real default well above the heartbeat
+    # interval (spurious-expiry guard)
+    assert conf.get(NETWORK_TIMEOUT_MS) >= 10 * conf.get(HEARTBEAT_INTERVAL_MS)
 
 
 def test_clone_isolated():

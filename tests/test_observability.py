@@ -164,6 +164,20 @@ def test_api_v1_routes():
         api_v1(s, "nope")
 
 
+def test_untracked_steps_do_not_break_job_list():
+    """StepCompleted outside any run_job bracket (job_id 0) and out-of-order
+    JobEnd must still yield fully-formed job dicts."""
+    listener = AppStatusListener()
+    listener(StepCompleted(job_id=0, step=0, metrics={"loss": 1.0}))
+    listener(JobEnd(job_id=7, succeeded=True))  # JobEnd before JobStart
+    jobs = {j["jobId"]: j for j in listener.store.job_list()}
+    assert jobs[0]["description"] == "(untracked)"
+    assert jobs[7]["status"] == "SUCCEEDED" and jobs[7]["description"] == ""
+    for j in jobs.values():
+        assert {"description", "status", "submissionTime",
+                "completionTime"} <= set(j)
+
+
 def test_history_provider_replays_journal(tmp_path):
     """History-server path: JSON-lines journal → same store as live bus
     (ref: FsHistoryProvider.scala:84)."""
