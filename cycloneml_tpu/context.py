@@ -129,6 +129,7 @@ class CycloneContext:
         self._stopped = False
         self._accumulators: List[Accumulator] = []
         self._heartbeats = None
+        self._hb_lock = threading.Lock()
 
         self.metrics = MetricsSystem("driver", self.conf.get(METRICS_PERIOD_S))
         for name in [s.strip() for s in self.conf.get(METRICS_SINKS).split(",")
@@ -238,14 +239,15 @@ class CycloneContext:
     def heartbeat_receiver(self):
         """Host-worker liveness registry (≈ HeartbeatReceiver endpoint).
         Created lazily — single-host runs have no worker fleet to track."""
-        if self._heartbeats is None:
-            from cycloneml_tpu.conf import NETWORK_TIMEOUT_MS
-            from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
-            self._heartbeats = HeartbeatReceiver(
-                timeout_s=self.conf.get(NETWORK_TIMEOUT_MS) / 1000.0,
-                listener_bus=self.listener_bus)
-            self._heartbeats.start()
-        return self._heartbeats
+        with self._hb_lock:  # double-start would orphan a sweep thread
+            if self._heartbeats is None:
+                from cycloneml_tpu.conf import NETWORK_TIMEOUT_MS
+                from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
+                self._heartbeats = HeartbeatReceiver(
+                    timeout_s=self.conf.get(NETWORK_TIMEOUT_MS) / 1000.0,
+                    listener_bus=self.listener_bus)
+                self._heartbeats.start()
+            return self._heartbeats
 
     def rebuild_mesh(self, master: Optional[str] = None):
         """Elastic recovery (SURVEY §5.3): tear down the mesh and bring up a

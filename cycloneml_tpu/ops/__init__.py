@@ -1,0 +1,15 @@
+"""Hand-written TPU kernels for the hot ops.
+
+XLA fusion already covers most of what the reference's JNI BLAS layer did
+(SURVEY §2.6: Janino codegen and netlib dispatch both collapse into jit).
+These Pallas kernels target the residual wins: keeping the whole
+aggregate-block pipeline (margin → multiplier → transpose-matmul) resident
+in VMEM across a row-tile grid, so HBM sees each instance block exactly once
+per L-BFGS evaluation instead of once per op.
+"""
+
+from cycloneml_tpu.ops.kernels import (fused_binary_logistic, fused_gramian,
+                                       fused_kmeans_assign, pallas_available)
+
+__all__ = ["fused_binary_logistic", "fused_gramian", "fused_kmeans_assign",
+           "pallas_available"]

@@ -75,7 +75,8 @@ class HeartbeatReceiver:
         """Single expiry sweep (the timer thread calls this; tests call it
         directly for determinism)."""
         now = time.monotonic()
-        expired = []
+        expired = []  # (worker, reason) captured under the lock — a
+        # concurrent register() may pop self._lost before we notify
         with self._lock:
             for w, t in list(self._last.items()):
                 if now - t > self.timeout_s:
@@ -83,18 +84,17 @@ class HeartbeatReceiver:
                     reason = (f"no heartbeat for {now - t:.1f}s "
                               f"(timeout {self.timeout_s}s)")
                     self._lost[w] = reason
-                    expired.append(w)
-        for w in expired:
-            logger.warning("worker %s lost: %s", w, self._lost[w])
+                    expired.append((w, reason))
+        for w, reason in expired:
+            logger.warning("worker %s lost: %s", w, reason)
             if self.listener_bus is not None:
-                self.listener_bus.post(WorkerLost(worker_id=w,
-                                                  reason=self._lost[w]))
+                self.listener_bus.post(WorkerLost(worker_id=w, reason=reason))
             for fn in self._callbacks:
                 try:
-                    fn(w, self._lost[w])
+                    fn(w, reason)
                 except Exception:
                     logger.exception("worker-lost callback failed")
-        return expired
+        return [w for w, _ in expired]
 
     def start(self) -> None:
         if self._thread is None:
@@ -104,7 +104,10 @@ class HeartbeatReceiver:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.check_interval_s):
-            self.check_now()
+            try:
+                self.check_now()
+            except Exception:  # the sweep must survive listener errors
+                logger.exception("heartbeat sweep failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -180,26 +183,34 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
         logger.info("resuming training from checkpoint step %d", latest)
 
     it = optimizer.iterations(loss_grad, x0, resume=resume)
-    state = None
-
-    def next_state():
-        return next(it, None)
-
-    def rebuild(attempt, exc):
-        # a generator dies when an exception escapes next(); restart the
-        # iteration stream from the last good optimizer state
-        nonlocal it
-        base = state if state is not None else resume
-        it = optimizer.iterations(loss_grad, x0, resume=base)
-
+    # the resume state was already delivered (checkpointed + on_step'd) by
+    # the previous run; its re-yield below is skipped, not re-announced
+    state = resume
+    fail_count = 0
     while True:
-        s = retry_step(next_state, max_failures=max_step_failures,
-                       on_failure=rebuild)
+        try:
+            s = next(it, None)
+        except Exception as e:
+            # a generator dies when an exception escapes next(); the retry
+            # budget counts failures of the SAME step across stream rebuilds
+            # (a rebuilt stream re-yields its resume point, which must not
+            # reset the count — that would retry a permanent failure forever)
+            fail_count += 1
+            logger.warning("step failed (attempt %d/%d): %s",
+                           fail_count, max_step_failures, e)
+            if fail_count >= max_step_failures:
+                raise RuntimeError(
+                    f"step failed {max_step_failures} times; aborting job "
+                    f"(≈ TaskSetManager 'Task failed {max_step_failures} "
+                    f"times')") from e
+            it = optimizer.iterations(loss_grad, x0, resume=state)
+            continue
         if s is None:
             break
         if state is not None and s.iteration <= state.iteration:
-            continue  # rebuilt stream re-yields its resume point
+            continue  # re-yield of the resume point after a rebuild
         state = s
+        fail_count = 0  # real progress resets the per-step budget
         if on_step is not None:
             on_step(state)
         if state.iteration > 0 and state.iteration % interval == 0:

@@ -1,0 +1,92 @@
+"""Pallas kernel parity tests (interpret mode on the CPU mesh; the same
+kernels lower to Mosaic on TPU — bench.py exercises that path on hardware).
+
+Parity targets are the pure-jnp aggregator/Gramian implementations, which are
+themselves tested against sklearn/scipy golden numbers elsewhere.
+"""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.ops import (fused_binary_logistic, fused_gramian,
+                               fused_kmeans_assign)
+from cycloneml_tpu.ml.optim import aggregators
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(42)
+    n, d = 300, 37  # deliberately unaligned with tiles/lanes
+    x = rng.randn(n, d)
+    y = (rng.rand(n) > 0.4).astype(np.float64)
+    w = rng.rand(n) + 0.5
+    return x, y, w
+
+
+@pytest.mark.parametrize("fit_intercept", [True, False])
+def test_fused_logistic_matches_aggregator(data, fit_intercept, ctx):
+    x, y, w = data
+    d = x.shape[1]
+    rng = np.random.RandomState(0)
+    coef = rng.randn(d + (1 if fit_intercept else 0))
+
+    ref = aggregators.binary_logistic(d, fit_intercept)(
+        np.asarray(x, np.float32), np.asarray(y, np.float32),
+        np.asarray(w, np.float32), np.asarray(coef, np.float32))
+    got = fused_binary_logistic(x, y, w, coef, d, fit_intercept,
+                                interpret=True, row_tile=128)
+
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got["count"]), float(ref["count"]),
+                               rtol=1e-6)
+
+
+def test_fused_logistic_padding_rows_inert(ctx):
+    """Rows added by tile padding (w=0) must not change any output."""
+    rng = np.random.RandomState(1)
+    d = 17
+    coef = rng.randn(d + 1)
+    x, y, w = rng.randn(100, d), (rng.rand(100) > 0.5).astype(float), np.ones(100)
+    small = fused_binary_logistic(x, y, w, coef, d, True,
+                                  interpret=True, row_tile=128)
+    # same data with explicit zero-weight junk rows appended
+    x2 = np.vstack([x, rng.randn(60, d) * 100])
+    y2 = np.concatenate([y, np.ones(60)])
+    w2 = np.concatenate([w, np.zeros(60)])
+    big = fused_binary_logistic(x2, y2, w2, coef, d, True,
+                                interpret=True, row_tile=128)
+    np.testing.assert_allclose(float(big["loss"]), float(small["loss"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(big["grad"]),
+                               np.asarray(small["grad"]), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_kmeans_assign(ctx):
+    rng = np.random.RandomState(7)
+    x = rng.randn(500, 23)
+    centers = rng.randn(11, 23)
+    best, dist = fused_kmeans_assign(x, centers, interpret=True, row_tile=128)
+    d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(best), d2.argmin(1))
+    np.testing.assert_allclose(np.asarray(dist), d2.min(1), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fused_kmeans_padded_centers_never_win(ctx):
+    rng = np.random.RandomState(8)
+    x = rng.randn(50, 5) * 1000  # huge distances; padded centers are at 0
+    centers = rng.randn(3, 5) * 1000
+    best, _ = fused_kmeans_assign(x, centers, interpret=True, row_tile=128)
+    assert np.asarray(best).max() < 3
+
+
+def test_fused_gramian(ctx):
+    rng = np.random.RandomState(3)
+    x = rng.randn(400, 19)
+    g = fused_gramian(x, interpret=True, row_tile=128)
+    np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-3)
+    # symmetry is exact, not approximate
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g).T)

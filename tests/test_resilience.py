@@ -216,6 +216,46 @@ def test_train_with_checkpoints_crash_and_resume(tmp_path):
     assert ck.latest_step() == final.iteration  # final state checkpointed
 
 
+def test_permanent_failure_after_progress_aborts(tmp_path):
+    """A loss fn that starts failing permanently AFTER some good steps must
+    exhaust the retry budget and abort — not loop forever (the rebuilt
+    stream's re-yield of the resume point must not reset the count)."""
+    f, x0 = _quadratic(d=6, seed=2)
+    evals = {"n": 0}
+
+    def dies_later(x):
+        evals["n"] += 1
+        if evals["n"] > 5:
+            raise RuntimeError("permanent")
+        return f(x)
+
+    ck = TrainingCheckpointer(str(tmp_path))
+    with pytest.raises(RuntimeError, match="failed 4 times"):
+        train_with_checkpoints(LBFGS(max_iter=50, tol=1e-12), dies_later, x0,
+                               ck, interval=2, max_step_failures=4)
+    # exactly budget+good evals: 5 good + 4 failed attempts
+    assert evals["n"] == 9
+
+
+def test_resume_does_not_replay_on_step(tmp_path):
+    """The restored checkpoint state was already announced by the previous
+    run; the resumed run must not fire on_step for it again."""
+    f, x0 = _quadratic(d=6, seed=4)
+    ck = TrainingCheckpointer(str(tmp_path))
+    first_run = []
+    states = []
+    for s in LBFGS(max_iter=50, tol=1e-12).iterations(f, x0):
+        first_run.append(s.iteration)
+        states.append(s)
+        if s.iteration == 4:
+            ck.save(4, s.to_pytree())
+            break
+    second_run = []
+    train_with_checkpoints(LBFGS(max_iter=50, tol=1e-12), f, x0, ck,
+                           interval=3, on_step=lambda s: second_run.append(s.iteration))
+    assert second_run[0] == 5  # starts after the checkpointed iteration
+
+
 def test_train_with_checkpoints_transient_retry(tmp_path):
     f, x0 = _quadratic(d=5, seed=9)
     evals = {"n": 0}
@@ -275,7 +315,7 @@ def test_elastic_mesh_rebuild_resume(ctx, tmp_path):
         ctx.rebuild_mesh("local-mesh[4]")
         assert ctx.mesh_runtime.n_devices == 4
         ds4 = InstanceDataset.restore(ctx, data_ck)
-        resume = OptimState.from_pytree(opt_ck.restore())
+        assert opt_ck.latest_step() == 6  # train_with_checkpoints restores it
         final = train_with_checkpoints(LBFGS(max_iter=30, tol=1e-9),
                                        make_loss(ds4), None, opt_ck,
                                        interval=5)
