@@ -323,6 +323,13 @@ METRICS_CSV_DIR = (
     .str_conf("/tmp/cyclone-metrics")
 )
 
+PLUGINS = (
+    ConfigBuilder("cyclone.plugins")
+    .doc("Comma-separated plugin class paths loaded at context start "
+         "(ref: api/plugin/SparkPlugin.java:37, spark.plugins).")
+    .str_conf("")
+)
+
 PROMETHEUS_PORT = (
     ConfigBuilder("cyclone.metrics.prometheus.port")
     .doc("Port for the pull-based /metrics endpoint; 0 picks a free port "

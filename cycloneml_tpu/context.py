@@ -149,6 +149,11 @@ class CycloneContext:
             "listenerBus.queued", lambda: self.listener_bus.metrics["queued"])
         self.metrics.start()
 
+        from cycloneml_tpu.conf import PLUGINS
+        from cycloneml_tpu.plugin import load_plugins
+        self._plugins = load_plugins(
+            self, self.conf.get(PLUGINS).split(","))
+
         self.listener_bus.post(ApplicationStart(app_name=self.app_name, app_id=self.app_id))
         self.listener_bus.post(MeshUp(
             n_devices=self.mesh_runtime.n_devices,
@@ -240,6 +245,8 @@ class CycloneContext:
         """Host-worker liveness registry (≈ HeartbeatReceiver endpoint).
         Created lazily — single-host runs have no worker fleet to track."""
         with self._hb_lock:  # double-start would orphan a sweep thread
+            if self._stopped:
+                raise RuntimeError("context is stopped")
             if self._heartbeats is None:
                 from cycloneml_tpu.conf import NETWORK_TIMEOUT_MS
                 from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
@@ -249,7 +256,23 @@ class CycloneContext:
                 self._heartbeats.start()
             return self._heartbeats
 
-    def rebuild_mesh(self, master: Optional[str] = None):
+    def with_resources(self, profile) -> "CycloneContext":
+        """Stage-level scheduling decision (ref: RDD.withResources,
+        rdd/RDD.scala:1806): ensure the mesh matches the profile's slice
+        topology, rebuilding it when it does not. Raises if the attached
+        hardware cannot satisfy the request."""
+        if profile.satisfied_by(self.mesh_runtime):
+            return self
+        import jax
+        available = len(jax.devices())
+        if profile.min_devices and available < profile.min_devices:
+            raise RuntimeError(
+                f"resource profile needs {profile.min_devices} devices; "
+                f"{available} attached")
+        self.rebuild_mesh(**profile.mesh_kwargs())
+        return self
+
+    def rebuild_mesh(self, master: Optional[str] = None, **mesh_kwargs):
         """Elastic recovery (SURVEY §5.3): tear down the mesh and bring up a
         new one — possibly smaller, possibly a spare slice — after device or
         host loss. Device-resident data dies with the old mesh; callers
@@ -258,7 +281,7 @@ class CycloneContext:
         translate to TPU; checkpoint-based recovery does)."""
         mesh_mod.reset()
         self.mesh_runtime = mesh_mod.get_or_create(
-            master or self.conf.get(MASTER))
+            master or self.conf.get(MASTER), **mesh_kwargs)
         self.listener_bus.post(MeshUp(
             n_devices=self.mesh_runtime.n_devices,
             platform=self.mesh_runtime.platform,
@@ -281,8 +304,14 @@ class CycloneContext:
             return
         self._stopped = True
         self.listener_bus.post(ApplicationEnd(app_id=self.app_id))
-        if self._heartbeats is not None:
-            self._heartbeats.stop()
+        for p in getattr(self, "_plugins", []):
+            try:
+                p.shutdown()
+            except Exception:
+                logger.exception("plugin shutdown failed")
+        with self._hb_lock:  # pairs with lazy create: no post-stop starts
+            if self._heartbeats is not None:
+                self._heartbeats.stop()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

@@ -1,0 +1,142 @@
+"""PMML model export.
+
+Analog of the reference's PMML support (ref: mllib/src/main/scala/org/apache/
+spark/mllib/pmml/PMMLExportable.scala + pmml/export/
+{GeneralizedLinearPMMLModelExport, LogisticRegressionPMMLModelExport,
+KMeansPMMLModelExport}.scala — built on JPMML there; a direct PMML 4.2 XML
+writer here, same document structure). Covered model families match the
+reference's: linear regression, binary logistic regression, and k-means.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import List, Optional
+
+import numpy as np
+
+PMML_NS = "http://www.dmg.org/PMML-4_2"
+
+
+def _root(description: str) -> ET.Element:
+    root = ET.Element("PMML", {"version": "4.2", "xmlns": PMML_NS})
+    header = ET.SubElement(root, "Header",
+                           {"description": description})
+    ET.SubElement(header, "Application",
+                  {"name": "CycloneML-TPU", "version": "0.1"})
+    return root
+
+
+def _data_dictionary(root: ET.Element, n_features: int,
+                     target: Optional[str] = None,
+                     categorical_target: bool = False) -> List[str]:
+    names = [f"field_{i}" for i in range(n_features)]
+    dd = ET.SubElement(root, "DataDictionary",
+                       {"numberOfFields": str(n_features + (1 if target else 0))})
+    for n in names:
+        ET.SubElement(dd, "DataField",
+                      {"name": n, "optype": "continuous", "dataType": "double"})
+    if target:
+        ET.SubElement(dd, "DataField",
+                      {"name": target,
+                       "optype": ("categorical" if categorical_target
+                                  else "continuous"),
+                       "dataType": ("string" if categorical_target
+                                    else "double")})
+    return names
+
+
+def _mining_schema(parent: ET.Element, names: List[str],
+                   target: Optional[str] = None) -> None:
+    ms = ET.SubElement(parent, "MiningSchema")
+    for n in names:
+        ET.SubElement(ms, "MiningField", {"name": n, "usageType": "active"})
+    if target:
+        ET.SubElement(ms, "MiningField",
+                      {"name": target, "usageType": "predicted"})
+
+
+def _regression_table(parent: ET.Element, names: List[str],
+                      coef: np.ndarray, intercept: float,
+                      target_category: Optional[str] = None) -> None:
+    attrs = {"intercept": repr(float(intercept))}
+    if target_category is not None:
+        attrs["targetCategory"] = target_category
+    table = ET.SubElement(parent, "RegressionTable", attrs)
+    for n, c in zip(names, np.asarray(coef, dtype=float)):
+        ET.SubElement(table, "NumericPredictor",
+                      {"name": n, "coefficient": repr(float(c))})
+
+
+def linear_regression_to_pmml(model) -> str:
+    """(ref GeneralizedLinearPMMLModelExport.scala)"""
+    coef = np.asarray(model.coefficients)
+    root = _root("linear regression")
+    names = _data_dictionary(root, coef.shape[0], target="target")
+    rm = ET.SubElement(root, "RegressionModel",
+                       {"modelName": "linear regression",
+                        "functionName": "regression"})
+    _mining_schema(rm, names, "target")
+    _regression_table(rm, names, coef, model.intercept)
+    return ET.tostring(root, encoding="unicode")
+
+
+def logistic_regression_to_pmml(model) -> str:
+    """(ref LogisticRegressionPMMLModelExport.scala — binary only, with the
+    softmax normalization and a zero-coefficient table for category 0)"""
+    coef = np.asarray(model.coefficients)
+    root = _root("logistic regression")
+    names = _data_dictionary(root, coef.shape[0], target="target",
+                             categorical_target=True)
+    rm = ET.SubElement(root, "RegressionModel",
+                       {"modelName": "logistic regression",
+                        "functionName": "classification",
+                        "normalizationMethod": "logit"})
+    _mining_schema(rm, names, "target")
+    _regression_table(rm, names, coef, model.intercept, target_category="1")
+    _regression_table(rm, names, np.zeros_like(coef), 0.0,
+                      target_category="0")
+    return ET.tostring(root, encoding="unicode")
+
+
+def kmeans_to_pmml(model) -> str:
+    """(ref KMeansPMMLModelExport.scala — ClusteringModel with squared
+    euclidean compare function)"""
+    centers = np.asarray(model._centers, dtype=float)
+    k, d = centers.shape
+    root = _root("k-means clustering")
+    names = _data_dictionary(root, d)
+    cm = ET.SubElement(root, "ClusteringModel",
+                       {"modelName": "k-means", "functionName": "clustering",
+                        "modelClass": "centerBased",
+                        "numberOfClusters": str(k)})
+    _mining_schema(cm, names)
+    comp = ET.SubElement(cm, "ComparisonMeasure", {"kind": "distance"})
+    ET.SubElement(comp, "squaredEuclidean")
+    for n in names:
+        ET.SubElement(cm, "ClusteringField",
+                      {"field": n, "compareFunction": "absDiff"})
+    for i in range(k):
+        cl = ET.SubElement(cm, "Cluster", {"name": f"cluster_{i}"})
+        arr = ET.SubElement(cl, "Array", {"n": str(d), "type": "real"})
+        arr.text = " ".join(repr(float(v)) for v in centers[i])
+    return ET.tostring(root, encoding="unicode")
+
+
+def to_pmml(model, path: Optional[str] = None) -> str:
+    """Dispatch on model type (ref PMMLExportable.toPMML); optionally write
+    to ``path``."""
+    name = type(model).__name__
+    if name == "LinearRegressionModel":
+        xml = linear_regression_to_pmml(model)
+    elif name == "LogisticRegressionModel":
+        xml = logistic_regression_to_pmml(model)
+    elif name == "KMeansModel":
+        xml = kmeans_to_pmml(model)
+    else:
+        raise TypeError(f"PMML export not supported for {name} "
+                        "(reference covers GLM/logistic/k-means)")
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(xml)
+    return xml

@@ -171,8 +171,11 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
     automatic resume from the newest checkpoint.
 
     On entry: if the checkpointer holds a state, training continues from it
-    (exactly — the full curvature memory is saved). Each iteration runs under
-    ``retry_step``. Returns the final OptimState.
+    (exactly — the full curvature memory is saved). Failed iterations are
+    retried by rebuilding the iteration stream from the last good state,
+    with the budget counted per step across rebuilds (``retry_step`` is the
+    standalone utility for callers retrying idempotent steps directly).
+    Returns the final OptimState.
     """
     from cycloneml_tpu.ml.optim.lbfgs import OptimState
 

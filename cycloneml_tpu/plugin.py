@@ -1,0 +1,57 @@
+"""Application plugin API.
+
+Analog of the reference's plugin framework (ref: core/.../api/plugin/
+SparkPlugin.java:37, DriverPlugin.java:33, ExecutorPlugin.java:32 and the
+PluginContainer that loads ``spark.plugins``). The executor side collapses
+into the driver on TPU (SPMD steps, no task executors), so one hook set
+covers both: ``init`` at context start, ``shutdown`` at stop, plus the event
+bus and metrics registry for instrumentation — the same surfaces the
+reference hands plugins (listener bus registration, metric registration).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CyclonePlugin:
+    """Subclass and list the class path in ``cyclone.plugins``."""
+
+    def init(self, ctx, extra_conf: Dict[str, str]) -> None:
+        """Called once after the mesh is up (≈ DriverPlugin.init)."""
+
+    def shutdown(self) -> None:
+        """Called at context stop (≈ DriverPlugin.shutdown)."""
+
+    def registered_metrics(self) -> Dict[str, Any]:
+        """Optional name → callable gauges merged into the registry
+        (≈ registering with the plugin MetricRegistry)."""
+        return {}
+
+
+def load_plugins(ctx, class_paths: List[str]) -> List[CyclonePlugin]:
+    """Instantiate 'pkg.module.Class' paths (ref: Utils.loadExtensions)."""
+    out: List[CyclonePlugin] = []
+    for path in class_paths:
+        path = path.strip()
+        if not path:
+            continue
+        mod_name, _, cls_name = path.rpartition(".")
+        try:
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            plugin: CyclonePlugin = cls()
+            plugin.init(ctx, ctx.conf.get_all())
+            for name, fn in (plugin.registered_metrics() or {}).items():
+                ctx.metrics.registry.gauge(f"plugin.{name}", fn)
+            out.append(plugin)
+            logger.info("loaded plugin %s", path)
+        except Exception:
+            # a broken plugin must not take down the app (the reference
+            # logs and continues likewise)
+            logger.exception("failed to load plugin %s", path)
+    return out

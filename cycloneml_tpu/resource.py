@@ -1,0 +1,118 @@
+"""Resource profiles — accelerator-aware job requirements.
+
+Analog of the reference's stage-level scheduling surface (ref:
+resource/ResourceProfile.scala:48 with its defaults object :252,
+TaskResourceRequests / ExecutorResourceRequests, ResourceProfileManager.scala:39,
+``RDD.withResources`` rdd/RDD.scala:1806). On TPU "the mesh IS the resource"
+(SURVEY §2.7): a profile names the slice topology a job wants — device
+count, data/model parallel split, replica (DCN) groups — instead of
+per-executor GPU counts and discovery scripts. ``CycloneContext.with_resources``
+checks the active mesh against the profile and rebuilds it when allowed,
+which is the stage-level-scheduling decision this platform actually has.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class ResourceProfile:
+    """What a job needs from the mesh.
+
+    ``min_devices``: devices the SPMD program requires (0 = any).
+    ``model_parallelism``: feature-dim shards (the ``model`` mesh axis).
+    ``replicas``: DCN replica groups (the ``replica`` axis).
+    ``memory_per_device_mb``: advisory HBM need, validated against the
+    platform when known.
+    """
+
+    min_devices: int = 0
+    model_parallelism: int = 1
+    replicas: int = 1
+    memory_per_device_mb: int = 0
+    id: int = field(default=0, compare=False)
+
+    def satisfied_by(self, mesh_runtime) -> bool:
+        shape = dict(zip(mesh_runtime.mesh.axis_names,
+                         mesh_runtime.mesh.devices.shape))
+        if self.min_devices and mesh_runtime.n_devices < self.min_devices:
+            return False
+        if shape.get("model", 1) != self.model_parallelism:
+            return False
+        if shape.get("replica", 1) != self.replicas:
+            return False
+        return True
+
+    def mesh_kwargs(self) -> Dict[str, int]:
+        return {"n_replicas": self.replicas,
+                "model_parallelism": self.model_parallelism}
+
+
+class ResourceProfileBuilder:
+    """Fluent builder (ref: TaskResourceRequests/ExecutorResourceRequests
+    feeding ResourceProfileBuilder)."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def devices(self, n: int) -> "ResourceProfileBuilder":
+        self._kw["min_devices"] = n
+        return self
+
+    def model_parallel(self, n: int) -> "ResourceProfileBuilder":
+        self._kw["model_parallelism"] = n
+        return self
+
+    def replicas(self, n: int) -> "ResourceProfileBuilder":
+        self._kw["replicas"] = n
+        return self
+
+    def memory_per_device_mb(self, mb: int) -> "ResourceProfileBuilder":
+        self._kw["memory_per_device_mb"] = mb
+        return self
+
+    def build(self) -> ResourceProfile:
+        return ResourceProfileManager.instance().register(
+            ResourceProfile(**self._kw))
+
+
+class ResourceProfileManager:
+    """Registry with sequential ids (ref: ResourceProfileManager.scala:39);
+    id 0 is the default profile (ref: defaults object :252)."""
+
+    _instance: Optional["ResourceProfileManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._next_id = 1
+        self._profiles: Dict[int, ResourceProfile] = {0: ResourceProfile()}
+
+    @classmethod
+    def instance(cls) -> "ResourceProfileManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, profile: ResourceProfile) -> ResourceProfile:
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            registered = ResourceProfile(
+                min_devices=profile.min_devices,
+                model_parallelism=profile.model_parallelism,
+                replicas=profile.replicas,
+                memory_per_device_mb=profile.memory_per_device_mb,
+                id=pid)
+            self._profiles[pid] = registered
+            return registered
+
+    def get(self, pid: int) -> ResourceProfile:
+        return self._profiles[pid]
+
+    @staticmethod
+    def default_profile() -> ResourceProfile:
+        return ResourceProfileManager.instance().get(0)

@@ -1,0 +1,95 @@
+"""cyclone-submit — application launcher.
+
+Analog of ``spark-submit`` (ref: core/.../deploy/SparkSubmit.scala:75,
+``runMain`` path :158-180, argument parsing in SparkSubmitArguments).
+Cluster-manager plumbing (YARN/K8s/standalone Master) does not port: a
+TPU job IS a host process attached to its slice, so submission reduces to
+seeding configuration (via the ``CYCLONE_CONF_*`` environment channel that
+``CycloneConf`` reads, ≈ spark-defaults.conf + --conf) and running the user
+program in-process, exactly like the reference's client deploy mode.
+
+    python -m cycloneml_tpu.submit [options] app.py [app args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import List, Optional
+
+from cycloneml_tpu.conf import CycloneConf
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cyclone-submit",
+        description="Run an application on a Cyclone TPU mesh.")
+    p.add_argument("--master", help="mesh master URL (tpu, local-mesh[N], "
+                                    "multihost)")
+    p.add_argument("--name", help="application name")
+    p.add_argument("--conf", action="append", default=[], metavar="K=V",
+                   help="arbitrary config entry (repeatable)")
+    p.add_argument("--properties-file", metavar="FILE",
+                   help="file of 'key value' or 'key=value' lines "
+                        "(≈ spark-defaults.conf)")
+    p.add_argument("--py-files", metavar="PATHS",
+                   help="comma-separated dirs/zips prepended to sys.path")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("app", help="python file to run")
+    p.add_argument("app_args", nargs=argparse.REMAINDER,
+                   help="arguments passed to the application")
+    return p
+
+
+def _conf_env_key(key: str) -> str:
+    return CycloneConf.ENV_PREFIX + key.replace(".", "__")
+
+
+def parse_properties_file(path: str) -> List[tuple]:
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" in line:
+                k, _, v = line.partition("=")
+            else:
+                k, _, v = line.partition(" ")
+            out.append((k.strip(), v.strip()))
+    return out
+
+
+def submit(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+
+    pairs = []
+    if args.properties_file:
+        pairs.extend(parse_properties_file(args.properties_file))
+    for kv in args.conf:
+        if "=" not in kv:
+            raise SystemExit(f"--conf expects K=V, got {kv!r}")
+        k, _, v = kv.partition("=")
+        pairs.append((k, v))
+    if args.master:
+        pairs.append(("cyclone.master", args.master))
+    if args.name:
+        pairs.append(("cyclone.app.name", args.name))
+    for k, v in pairs:
+        os.environ[_conf_env_key(k)] = v
+        if args.verbose:
+            print(f"cyclone-submit: conf {k}={v}", file=sys.stderr)
+
+    if args.py_files:
+        # reversed so the first listed path wins the import race
+        for p in reversed(args.py_files.split(",")):
+            sys.path.insert(0, p)
+
+    sys.argv = [args.app] + list(args.app_args)
+    runpy.run_path(args.app, run_name="__main__")
+
+
+if __name__ == "__main__":
+    submit()

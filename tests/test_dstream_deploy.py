@@ -1,0 +1,315 @@
+"""Tests: DStream API, submit CLI, plugins, resource profiles, PMML export.
+
+Models the reference's coverage (ref: streaming BasicOperationsSuite /
+WindowOperationsSuite with ManualClock, SparkSubmitSuite, PMMLModelExport
+suites, ResourceProfileSuite).
+"""
+
+import os
+import sys
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.streaming.dstream import StreamingContext
+
+
+@pytest.fixture
+def ssc(ctx):
+    s = StreamingContext(ctx, batch_duration=0.05)
+    yield s
+    s.stop()
+
+
+# -- DStream basic operations (≈ BasicOperationsSuite, ManualClock-style) ------
+
+def test_dstream_map_filter(ssc):
+    out = []
+    stream = ssc.queue_stream([[1, 2, 3], [4, 5]])
+    stream.map(lambda x: x * 10).filter(lambda x: x > 15).collect_to(out)
+    ssc.run_one_interval()
+    ssc.run_one_interval()
+    assert out == [(0, [20, 30]), (1, [40, 50])]
+
+
+def test_dstream_flatmap_reduce_by_key(ssc):
+    out = []
+    stream = ssc.queue_stream([["a b a"], ["b b"]])
+    (stream.flat_map(str.split).map(lambda w: (w, 1))
+     .reduce_by_key(lambda a, b: a + b).collect_to(out))
+    ssc.run_one_interval()
+    ssc.run_one_interval()
+    assert dict(out[0][1]) == {"a": 2, "b": 1}
+    assert dict(out[1][1]) == {"b": 2}
+
+
+def test_dstream_union_count_reduce(ssc):
+    out_c, out_r = [], []
+    a = ssc.queue_stream([[1, 2]])
+    b = ssc.queue_stream([[3]])
+    u = a.union(b)
+    u.count().collect_to(out_c)
+    u.reduce(lambda x, y: x + y).collect_to(out_r)
+    ssc.run_one_interval()
+    assert out_c == [(0, [3])] and out_r == [(0, [6])]
+
+
+def test_dstream_window_operations(ssc):
+    """(≈ WindowOperationsSuite): sliding window over 3 intervals."""
+    out = []
+    stream = ssc.queue_stream([[1], [2], [3], [4]])
+    stream.window(window_length=3).collect_to(out)
+    for _ in range(4):
+        ssc.run_one_interval()
+    assert out == [(0, [1]), (1, [1, 2]), (2, [1, 2, 3]), (3, [2, 3, 4])]
+
+
+def test_dstream_reduce_by_key_and_window(ssc):
+    out = []
+    stream = ssc.queue_stream([[("k", 1)], [("k", 2)], [("k", 4)]])
+    stream.reduce_by_key_and_window(lambda a, b: a + b, 2).collect_to(out)
+    for _ in range(3):
+        ssc.run_one_interval()
+    assert [dict(b)["k"] for _, b in out] == [1, 3, 6]
+
+
+def test_dstream_long_window_retention(ssc):
+    """Windows wider than the default retention must still see all their
+    intervals (retention follows the widest registered window)."""
+    out = []
+    stream = ssc.queue_stream([[1]] * 120)
+    stream.window(window_length=110).count().collect_to(out)
+    for _ in range(115):
+        ssc.run_one_interval()
+    # at t=114 the window covers intervals 5..114 → 110 records
+    assert out[-1] == (114, [110])
+
+
+def test_streaming_context_restart(ctx):
+    ssc = StreamingContext(ctx, batch_duration=0.02)
+    out = []
+    ssc.queue_stream([], default=["t"]).collect_to(out)
+    ssc.start()
+    import time
+    deadline = time.time() + 5
+    while time.time() < deadline and not out:
+        time.sleep(0.02)
+    ssc.stop()
+    n = len(out)
+    assert n > 0
+    ssc.start()  # restart must tick again, not spin down instantly
+    deadline = time.time() + 5
+    while time.time() < deadline and len(out) <= n:
+        time.sleep(0.02)
+    ssc.stop()
+    assert len(out) > n
+
+
+def test_dstream_update_state_by_key(ssc):
+    """(ref StateDStream updateStateByKey): running counts; None drops."""
+    out = []
+    stream = ssc.queue_stream([[("a", 1), ("b", 1)], [("a", 1)],
+                               [("stop_b", 1)]])
+
+    def update(new_vals, old):
+        if old is not None and not new_vals and old >= 99:
+            return None
+        return (old or 0) + sum(new_vals)
+
+    stream.update_state_by_key(update).collect_to(out)
+    for _ in range(3):
+        ssc.run_one_interval()
+    assert dict(out[0][1]) == {"a": 1, "b": 1}
+    assert dict(out[1][1]) == {"a": 2, "b": 1}
+    assert dict(out[2][1])["a"] == 2  # state persists without new data
+
+
+def test_dstream_transform_uses_datasets(ssc):
+    out = []
+    stream = ssc.queue_stream([[3, 1, 2]])
+    stream.transform(lambda ds: ds.map(lambda x: x + 100)).collect_to(out)
+    ssc.run_one_interval()
+    assert sorted(out[0][1]) == [101, 102, 103]
+
+
+def test_dstream_foreach_rdd(ssc):
+    got = []
+    stream = ssc.queue_stream([[1, 2, 3]])
+    stream.foreach_rdd(lambda ds, t: got.append((t, ds.count())))
+    ssc.run_one_interval()
+    assert got == [(0, 3)]
+
+
+def test_dstream_file_input(ctx, tmp_path):
+    ssc = StreamingContext(ctx, 0.05)
+    out = []
+    (tmp_path / "pre.txt").write_text("old\n")  # pre-existing file skipped
+    stream = ssc.text_file_stream(str(tmp_path))
+    stream.collect_to(out)
+    ssc.run_one_interval()
+    (tmp_path / "new.txt").write_text("hello\nworld\n")
+    ssc.run_one_interval()
+    assert out == [(0, []), (1, ["hello", "world"])]
+    ssc.stop()
+
+
+def test_dstream_real_clock(ctx):
+    import time
+    ssc = StreamingContext(ctx, batch_duration=0.02)
+    out = []
+    src = ssc.queue_stream([], default=["tick"])
+    src.collect_to(out)
+    ssc.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(out) < 3:
+        time.sleep(0.02)
+    ssc.stop()
+    assert len(out) >= 3 and out[0][1] == ["tick"]
+
+
+# -- submit CLI -----------------------------------------------------------------
+
+def test_submit_runs_app_with_conf(tmp_path, monkeypatch):
+    from cycloneml_tpu.submit import submit
+    app = tmp_path / "app.py"
+    out_file = tmp_path / "out.txt"
+    app.write_text(
+        "import sys, os\n"
+        "from cycloneml_tpu.conf import CycloneConf\n"
+        "conf = CycloneConf()\n"
+        "open(sys.argv[1], 'w').write(\n"
+        "    conf.get('cyclone.app.name') + '|' +\n"
+        "    conf.get('cyclone.eventLog.dir') + '|' + sys.argv[2])\n")
+    props = tmp_path / "props.conf"
+    props.write_text("cyclone.eventLog.dir /tmp/ev-from-props\n")
+    for k in list(os.environ):
+        if k.startswith("CYCLONE_CONF_"):
+            monkeypatch.delenv(k)
+    monkeypatch.setattr(sys, "argv", ["cyclone-submit"])
+    submit(["--name", "myapp", "--properties-file", str(props),
+            "--conf", "cyclone.custom=1", str(app), str(out_file), "ARG"])
+    assert out_file.read_text() == "myapp|/tmp/ev-from-props|ARG"
+    assert os.environ["CYCLONE_CONF_cyclone__custom"] == "1"
+
+
+def test_submit_rejects_bad_conf():
+    from cycloneml_tpu.submit import submit
+    with pytest.raises(SystemExit):
+        submit(["--conf", "novalue", "x.py"])
+
+
+# -- plugins --------------------------------------------------------------------
+
+class _TestPlugin:
+    """Module-level so load_plugins can import it by path."""
+    inited = []
+    shut = []
+
+    def init(self, ctx, extra_conf):
+        _TestPlugin.inited.append(ctx.app_id)
+
+    def shutdown(self):
+        _TestPlugin.shut.append(True)
+
+    def registered_metrics(self):
+        return {"answer": lambda: 42.0}
+
+
+def test_plugin_loading(ctx):
+    import types
+    from cycloneml_tpu.plugin import load_plugins
+    mod = types.ModuleType("cyclone_test_plugin_mod")
+    mod.TestPlugin = _TestPlugin
+    sys.modules["cyclone_test_plugin_mod"] = mod
+    plugins = load_plugins(ctx, ["cyclone_test_plugin_mod.TestPlugin",
+                                 "no.such.Plugin", ""])
+    assert len(plugins) == 1  # broken path logged, not raised
+    assert _TestPlugin.inited
+    assert ctx.metrics.registry.values()["plugin.answer"] == 42.0
+    plugins[0].shutdown()
+    assert _TestPlugin.shut
+
+
+# -- resource profiles ----------------------------------------------------------
+
+def test_resource_profile_builder_and_satisfaction(ctx):
+    from cycloneml_tpu.resource import (ResourceProfileBuilder,
+                                        ResourceProfileManager)
+    p = (ResourceProfileBuilder().devices(4).model_parallel(1)
+         .replicas(1).build())
+    assert p.id >= 1
+    assert ResourceProfileManager.instance().get(p.id) == p
+    assert ResourceProfileManager.default_profile().id == 0
+    assert p.satisfied_by(ctx.mesh_runtime)  # 8-device mesh, model=1
+    big = ResourceProfileBuilder().devices(1000).build()
+    assert not big.satisfied_by(ctx.mesh_runtime)
+    with pytest.raises(RuntimeError, match="1000 devices"):
+        ctx.with_resources(big)
+    # satisfied profile is a no-op (same mesh object)
+    mesh_before = ctx.mesh_runtime
+    assert ctx.with_resources(p).mesh_runtime is mesh_before
+
+
+def test_resource_profile_mesh_rebuild(ctx):
+    from cycloneml_tpu.resource import ResourceProfileBuilder
+    p = ResourceProfileBuilder().model_parallel(2).build()
+    try:
+        ctx.with_resources(p)
+        shape = dict(zip(ctx.mesh_runtime.mesh.axis_names,
+                         ctx.mesh_runtime.mesh.devices.shape))
+        assert shape["model"] == 2
+        # an explicit replicas(1) profile is NOT satisfied by this 2-way
+        # model mesh, and a 2-replica ask is not satisfied by replica=1
+        two_rep = ResourceProfileBuilder().replicas(2).build()
+        assert not two_rep.satisfied_by(ctx.mesh_runtime)
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+    assert ctx.mesh_runtime.n_devices == 8
+    assert ctx.listener_bus.wait_until_empty()
+    # with_resources rebuilds announce MeshUp like rebuild_mesh does
+    assert ctx.status_store.mesh["nDevices"] == 8
+
+
+# -- PMML -----------------------------------------------------------------------
+
+def _strip_ns(xml):
+    return xml.replace(f' xmlns="http://www.dmg.org/PMML-4_2"', "")
+
+
+def test_pmml_linear_regression():
+    from cycloneml_tpu.ml.pmml import to_pmml
+    from cycloneml_tpu.ml.regression.linear_regression import LinearRegressionModel
+    m = LinearRegressionModel(coefficients=np.array([1.5, -2.0]), intercept=0.5)
+    root = ET.fromstring(_strip_ns(to_pmml(m)))
+    rm = root.find("RegressionModel")
+    assert rm.get("functionName") == "regression"
+    table = rm.find("RegressionTable")
+    assert float(table.get("intercept")) == 0.5
+    coefs = [float(p.get("coefficient"))
+             for p in table.findall("NumericPredictor")]
+    assert coefs == [1.5, -2.0]
+
+
+def test_pmml_logistic_and_kmeans(tmp_path):
+    from cycloneml_tpu.ml.pmml import to_pmml
+    from cycloneml_tpu.ml.classification.logistic_regression import (
+        LogisticRegressionModel)
+    from cycloneml_tpu.ml.clustering.kmeans import KMeansModel
+    lr = LogisticRegressionModel(coefficient_matrix=np.array([[0.3, 0.7]]),
+                                 intercept_vector=np.array([0.1]))
+    xml = _strip_ns(to_pmml(lr))
+    rm = ET.fromstring(xml).find("RegressionModel")
+    assert rm.get("normalizationMethod") == "logit"
+    assert len(rm.findall("RegressionTable")) == 2  # categories 1 and 0
+
+    km = KMeansModel(centers=np.array([[0.0, 1.0], [5.0, 5.0]]))
+    path = str(tmp_path / "km.pmml")
+    xml = _strip_ns(to_pmml(km, path))
+    cm = ET.fromstring(xml).find("ClusteringModel")
+    assert cm.get("numberOfClusters") == "2"
+    assert len(cm.findall("Cluster")) == 2
+    assert os.path.exists(path)
+
+    with pytest.raises(TypeError, match="not supported"):
+        to_pmml(object())
