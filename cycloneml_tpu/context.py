@@ -270,6 +270,12 @@ class CycloneContext:
                 f"resource profile needs {profile.min_devices} devices; "
                 f"{available} attached")
         self.rebuild_mesh(**profile.mesh_kwargs())
+        if not profile.satisfied_by(self.mesh_runtime):
+            # e.g. master 'local-mesh[4]' cannot grow to an 8-device ask
+            raise RuntimeError(
+                f"mesh for master {self.conf.get(MASTER)!r} "
+                f"({self.mesh_runtime.n_devices} devices) cannot satisfy "
+                f"profile {profile}")
         return self
 
     def rebuild_mesh(self, master: Optional[str] = None, **mesh_kwargs):

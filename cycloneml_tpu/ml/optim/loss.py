@@ -37,8 +37,10 @@ class DistributedLossFunction:
         self.l2_reg_fn = l2_reg_fn
         if weight_sum is None:
             import jax.numpy as jnp
+            # w is the last sharded array for both the dense (x, y, w) and
+            # sparse (indices, values, y, w) dataset tiers
             ws = dataset.tree_aggregate_fn(
-                lambda x, y, w: {"ws": jnp.sum(w)})()
+                lambda *arrs: {"ws": jnp.sum(arrs[-1])})()
             weight_sum = float(ws["ws"])
         self.weight_sum = weight_sum
         self.n_evals = 0

@@ -1,0 +1,119 @@
+"""Block aggregators over ELL sparse blocks.
+
+Sparse twins of ``aggregators`` (same contract: sums, not means, psum'd by
+``tree_aggregate``): margins come from gathers (``coef[indices]·values``
+replacing the dense gemv, ref BinaryLogisticBlockAggregator.scala:97) and
+gradients from ``segment_sum`` scatter-adds into the d-dim coefficient space
+(replacing the transpose gemv :130) — O(nnz) instead of the dense path's
+O(b·d). Measured on a v5e chip at Criteo shape (200k rows × 39 nnz,
+d=2^18): ~55 ms/gradient for the scatter, ~114 ms/full eval ≈ 0.07 Gnnz/s —
+a workload whose dense form (210 GB) cannot exist on the chip at all.
+Pre-sorting contributions at ingest to hit the sorted segment path was
+measured SLOWER (the permutation gather costs more than the scatter saves),
+so the direct scatter stays.
+
+Signature: ``(indices, values, y, w, coef) -> {"loss","grad","count"}`` with
+``indices/values (b, k)``, padding slots (0, 0.0) and padding rows w=0 —
+both exactly neutral: value 0 kills the gather term, weight 0 kills the row.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Agg = Callable[..., Dict[str, jnp.ndarray]]
+
+
+def _margins(indices, values, beta, b0):
+    """x·β per row via gather: Σ_k values[i,k] · β[indices[i,k]]."""
+    return jnp.sum(values * jnp.take(beta, indices, axis=0), axis=1) + b0
+
+
+def _scatter_grad(indices, values, mult, d):
+    """Σ_i mult_i · x_i as a segment-sum: scatter-add of
+    (mult[:,None]·values) into d bins keyed by indices."""
+    contrib = (mult[:, None] * values).reshape(-1)
+    return jax.ops.segment_sum(contrib, indices.reshape(-1).astype(jnp.int32),
+                               num_segments=d)
+
+
+def _split(coef, d, fit_intercept):
+    if fit_intercept:
+        return coef[:d], coef[d]
+    return coef, jnp.zeros((), coef.dtype)
+
+
+def binary_logistic_sparse(d: int, fit_intercept: bool = True) -> Agg:
+    """Sparse binomial logistic (dense twin: aggregators.binary_logistic)."""
+
+    def agg(indices, values, y, w, coef):
+        beta, b0 = _split(coef, d, fit_intercept)
+        margin = _margins(indices, values, beta, b0)
+        loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
+        mult = w * (jax.nn.sigmoid(margin) - y)
+        g = _scatter_grad(indices, values, mult, d)
+        grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
+                if fit_intercept else g)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+def least_squares_sparse(d: int, fit_intercept: bool = True) -> Agg:
+    """Sparse squared loss (dense twin: aggregators.least_squares)."""
+
+    def agg(indices, values, y, w, coef):
+        beta, b0 = _split(coef, d, fit_intercept)
+        err = _margins(indices, values, beta, b0) - y
+        loss = 0.5 * jnp.sum(w * err * err)
+        mult = w * err
+        g = _scatter_grad(indices, values, mult, d)
+        grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
+                if fit_intercept else g)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+def hinge_sparse(d: int, fit_intercept: bool = True) -> Agg:
+    """Sparse hinge loss (dense twin: aggregators.hinge)."""
+
+    def agg(indices, values, y, w, coef):
+        beta, b0 = _split(coef, d, fit_intercept)
+        margin = _margins(indices, values, beta, b0)
+        ysign = 2.0 * y - 1.0
+        active = (1.0 - ysign * margin) > 0
+        loss = jnp.sum(w * jnp.maximum(0.0, 1.0 - ysign * margin))
+        mult = jnp.where(active, -ysign * w, 0.0)
+        g = _scatter_grad(indices, values, mult, d)
+        grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
+                if fit_intercept else g)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+def sparse_summary(d: int) -> Agg:
+    """Single-pass weighted feature moments over ELL blocks
+    (dense twin: ml/stat Summarizer's aggregation, ref Summarizer.scala:214):
+    returns per-feature weighted sum and sum-of-squares plus weight/count —
+    enough for mean/variance/std standardization of sparse data (zero entries
+    contribute 0 to sums; the caller folds in the implicit zeros)."""
+
+    def agg(indices, values, y, w, coef_unused):
+        wk = w[:, None] * values
+        seg = indices.reshape(-1).astype(jnp.int32)
+        s1 = jax.ops.segment_sum((wk).reshape(-1), seg, num_segments=d)
+        s2 = jax.ops.segment_sum((wk * values).reshape(-1), seg,
+                                 num_segments=d)
+        nnz = jax.ops.segment_sum(
+            jnp.broadcast_to(w[:, None], values.shape).reshape(-1)
+            * (values != 0).reshape(-1), seg, num_segments=d)
+        return {"sum": s1, "sum_sq": s2, "nnz_weight": nnz,
+                "weight_sum": jnp.sum(w),
+                "count": jnp.sum((w > 0).astype(jnp.float32))}
+
+    return agg

@@ -46,12 +46,18 @@ def load_plugins(ctx, class_paths: List[str]) -> List[CyclonePlugin]:
             cls = getattr(importlib.import_module(mod_name), cls_name)
             plugin: CyclonePlugin = cls()
             plugin.init(ctx, ctx.conf.get_all())
-            for name, fn in (plugin.registered_metrics() or {}).items():
-                ctx.metrics.registry.gauge(f"plugin.{name}", fn)
-            out.append(plugin)
-            logger.info("loaded plugin %s", path)
         except Exception:
             # a broken plugin must not take down the app (the reference
             # logs and continues likewise)
             logger.exception("failed to load plugin %s", path)
+            continue
+        # init succeeded: the plugin owns resources now, so it must reach
+        # the shutdown list even if its metric registration breaks
+        out.append(plugin)
+        try:
+            for name, fn in (plugin.registered_metrics() or {}).items():
+                ctx.metrics.registry.gauge(f"plugin.{name}", fn)
+        except Exception:
+            logger.exception("plugin %s metric registration failed", path)
+        logger.info("loaded plugin %s", path)
     return out

@@ -98,15 +98,11 @@ class ResourceProfileManager:
             return cls._instance
 
     def register(self, profile: ResourceProfile) -> ResourceProfile:
+        import dataclasses
         with self._lock:
             pid = self._next_id
             self._next_id += 1
-            registered = ResourceProfile(
-                min_devices=profile.min_devices,
-                model_parallelism=profile.model_parallelism,
-                replicas=profile.replicas,
-                memory_per_device_mb=profile.memory_per_device_mb,
-                id=pid)
+            registered = dataclasses.replace(profile, id=pid)
             self._profiles[pid] = registered
             return registered
 

@@ -88,7 +88,9 @@ class StreamingContext:
             for s in self._inputs:
                 s.compute_batch(t)
             for stream, action in self._outputs:
-                action(stream.batch_for(t), t)
+                batch = stream.batch_for(t)
+                if batch is not None:  # None = no RDD this interval
+                    action(batch, t)
             for s in self._inputs:
                 s.gc(t)
 
@@ -128,8 +130,13 @@ class DStream:
 
     # -- stateless transformations --------------------------------------------
     def _derive(self, fn: Callable[[List[Any]], List[Any]]) -> "DStream":
+        """``None`` batches mean 'no RDD this interval' (a slid window off
+        its slide boundary) and propagate untouched — downstream operators
+        and output actions must not observe a fabricated empty batch."""
         parent = self
-        return DStream(self.ssc, lambda t: fn(parent.batch_for(t)))
+        return DStream(self.ssc,
+                       lambda t: (None if (b := parent.batch_for(t)) is None
+                                  else fn(b)))
 
     def map(self, f: Callable) -> "DStream":
         return self._derive(lambda b: [f(x) for x in b])
@@ -139,9 +146,6 @@ class DStream:
 
     def filter(self, f: Callable) -> "DStream":
         return self._derive(lambda b: [x for x in b if f(x)])
-
-    def glom_count(self) -> "DStream":
-        return self._derive(lambda b: [len(b)])
 
     def count(self) -> "DStream":
         return self._derive(lambda b: [len(b)])
@@ -161,8 +165,13 @@ class DStream:
 
     def union(self, other: "DStream") -> "DStream":
         parent = self
-        return DStream(self.ssc,
-                       lambda t: parent.batch_for(t) + other.batch_for(t))
+
+        def compute(t):
+            a, b = parent.batch_for(t), other.batch_for(t)
+            if a is None and b is None:
+                return None
+            return (a or []) + (b or [])
+        return DStream(self.ssc, compute)
 
     def transform(self, f: Callable[[List[Any]], List[Any]]) -> "DStream":
         """(ref DStream.transform — arbitrary per-batch RDD work). ``f``
@@ -171,8 +180,10 @@ class DStream:
         ssc = self.ssc
 
         def compute(t):
-            ds = ssc.ctx.parallelize(parent.batch_for(t))
-            out = f(ds)
+            b = parent.batch_for(t)
+            if b is None:
+                return None
+            out = f(ssc.ctx.parallelize(b))
             return out.collect() if hasattr(out, "collect") else list(out)
         return DStream(ssc, compute)
 
@@ -185,7 +196,7 @@ class DStream:
 
         def compute(t):
             if slide > 1 and (t + 1) % slide != 0:
-                return []
+                return None  # no RDD at off-slide intervals (ref semantics)
             out: List[Any] = []
             for i in range(max(0, t - window_length + 1), t + 1):
                 out.extend(parent.batch_for(i))
@@ -213,7 +224,7 @@ class DStream:
                 return list(state.items())
             last_t[0] = t
             grouped: Dict[Any, List[Any]] = {}
-            for k, v in parent.batch_for(t):
+            for k, v in parent.batch_for(t) or []:
                 grouped.setdefault(k, []).append(v)
             for k in set(state) | set(grouped):
                 new_state = update(grouped.get(k, []), state.get(k))

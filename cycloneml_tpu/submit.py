@@ -54,10 +54,13 @@ def parse_properties_file(path: str) -> List[tuple]:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            if "=" in line:
-                k, _, v = line.partition("=")
+            # 'key value' (spark-defaults style) wins over 'key=value' so a
+            # whitespace-separated value may itself contain '=' (-Dfoo=bar)
+            head = line.split(None, 1)
+            if len(head) == 2 and "=" not in head[0]:
+                k, v = head
             else:
-                k, _, v = line.partition(" ")
+                k, _, v = line.partition("=")
             out.append((k.strip(), v.strip()))
     return out
 

@@ -65,6 +65,17 @@ def test_dstream_window_operations(ssc):
     assert out == [(0, [1]), (1, [1, 2]), (2, [1, 2, 3]), (3, [2, 3, 4])]
 
 
+def test_dstream_slide_suppresses_offbeat_output(ssc):
+    """slide=2: no RDD (and no output action) at off-slide intervals —
+    not a fabricated empty batch that count() would turn into 0."""
+    out = []
+    stream = ssc.queue_stream([[1], [2], [3], [4]])
+    stream.window(window_length=2, slide=2).count().collect_to(out)
+    for _ in range(4):
+        ssc.run_one_interval()
+    assert out == [(1, [2]), (3, [2])]  # only at slide boundaries
+
+
 def test_dstream_reduce_by_key_and_window(ssc):
     out = []
     stream = ssc.queue_stream([[("k", 1)], [("k", 2)], [("k", 4)]])
@@ -193,6 +204,19 @@ def test_submit_runs_app_with_conf(tmp_path, monkeypatch):
     assert os.environ["CYCLONE_CONF_cyclone__custom"] == "1"
 
 
+def test_properties_file_value_containing_equals(tmp_path):
+    from cycloneml_tpu.submit import parse_properties_file
+    p = tmp_path / "p.conf"
+    p.write_text("cyclone.extra.opts -Dfoo=bar\n"
+                 "cyclone.simple=plain\n"
+                 "# comment\n"
+                 "cyclone.spaced value with spaces\n")
+    got = dict(parse_properties_file(str(p)))
+    assert got["cyclone.extra.opts"] == "-Dfoo=bar"
+    assert got["cyclone.simple"] == "plain"
+    assert got["cyclone.spaced"] == "value with spaces"
+
+
 def test_submit_rejects_bad_conf():
     from cycloneml_tpu.submit import submit
     with pytest.raises(SystemExit):
@@ -229,6 +253,30 @@ def test_plugin_loading(ctx):
     assert ctx.metrics.registry.values()["plugin.answer"] == 42.0
     plugins[0].shutdown()
     assert _TestPlugin.shut
+
+
+class _BadMetricsPlugin:
+    shut = []
+
+    def init(self, ctx, extra_conf):
+        pass
+
+    def shutdown(self):
+        _BadMetricsPlugin.shut.append(True)
+
+    def registered_metrics(self):
+        raise RuntimeError("metrics broke")
+
+
+def test_plugin_with_broken_metrics_still_shut_down(ctx):
+    import types
+    from cycloneml_tpu.plugin import load_plugins
+    mod = types.ModuleType("cyclone_bad_metrics_mod")
+    mod.P = _BadMetricsPlugin
+    sys.modules["cyclone_bad_metrics_mod"] = mod
+    plugins = load_plugins(ctx, ["cyclone_bad_metrics_mod.P"])
+    # init succeeded → the plugin must be tracked so shutdown() runs
+    assert len(plugins) == 1
 
 
 # -- resource profiles ----------------------------------------------------------
