@@ -263,17 +263,23 @@ class CycloneContext:
         hardware cannot satisfy the request."""
         if profile.satisfied_by(self.mesh_runtime):
             return self
-        import jax
-        available = len(jax.devices())
-        if profile.min_devices and available < profile.min_devices:
-            raise RuntimeError(
-                f"resource profile needs {profile.min_devices} devices; "
-                f"{available} attached")
+        # validate feasibility BEFORE the destructive rebuild — a failed
+        # request must not leave the caller without its previous mesh/data
+        master = self.conf.get(MASTER)
+        n = mesh_mod.probe_device_count(master)
+        if n is not None:
+            if profile.min_devices and n < profile.min_devices:
+                raise RuntimeError(
+                    f"resource profile needs {profile.min_devices} devices; "
+                    f"master {master!r} provides {n}")
+            split = profile.replicas * profile.model_parallelism
+            if n % split != 0:
+                raise RuntimeError(
+                    f"{n} devices not divisible by replicas×model = {split}")
         self.rebuild_mesh(**profile.mesh_kwargs())
         if not profile.satisfied_by(self.mesh_runtime):
-            # e.g. master 'local-mesh[4]' cannot grow to an 8-device ask
             raise RuntimeError(
-                f"mesh for master {self.conf.get(MASTER)!r} "
+                f"mesh for master {master!r} "
                 f"({self.mesh_runtime.n_devices} devices) cannot satisfy "
                 f"profile {profile}")
         return self

@@ -123,6 +123,18 @@ class MeshRuntime:
         return jax.device_put(tree, self.replicated())
 
 
+def probe_device_count(master: str) -> Optional[int]:
+    """Devices a master URL would select, WITHOUT building a mesh — lets
+    callers validate a resource request before tearing down the active mesh.
+    None when unknowable up-front (multihost initializes on construction)."""
+    if master == "multihost":
+        return None
+    try:
+        return len(MeshRuntime._resolve_devices(master))
+    except Exception:
+        return None
+
+
 _active: Optional[MeshRuntime] = None
 
 

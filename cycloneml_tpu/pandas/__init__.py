@@ -1,0 +1,16 @@
+"""pandas-style API over columnar batches.
+
+Analog of the reference's pandas API on Spark (ref: python/pyspark/pandas/
+— frame.py, series.py, groupby.py; SURVEY §2.5). The reference compiles
+pandas idioms onto lazy Spark SQL plans because its data is distributed
+JVM rows; here the host ETL tier is already columnar numpy, so the facade
+evaluates eagerly and bridges to the plan-based ``sql.DataFrame`` (and on to
+MLFrame/device tiers) when distribution matters. Coverage follows the
+pandas-on-Spark core: selection/assignment, boolean masking, sort_values,
+groupby-agg, merge, fillna/dropna/isna, describe, value_counts, reductions,
+apply, to/from pandas.
+"""
+
+from cycloneml_tpu.pandas.frame import CycloneFrame, CycloneSeries, read_csv
+
+__all__ = ["CycloneFrame", "CycloneSeries", "read_csv"]

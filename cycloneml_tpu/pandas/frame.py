@@ -1,0 +1,363 @@
+"""CycloneFrame / CycloneSeries — the pandas-facade implementation.
+
+Columns are numpy arrays of equal length; the implicit index is positional
+(the reference's pandas-on-Spark attaches a distributed default index for
+the same reason — frame.py's NATURAL_ORDER_COLUMN — which collapses to row
+order here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _is_null(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype.kind == "f":
+        return np.isnan(arr)
+    if arr.dtype == object:
+        return np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                         for v in arr], dtype=bool)
+    return np.zeros(len(arr), dtype=bool)
+
+
+class CycloneSeries:
+    """1-D labeled column (ref: pyspark/pandas/series.py)."""
+
+    def __init__(self, values, name: str = ""):
+        self.values = np.asarray(values)
+        self.name = name
+
+    # -- arithmetic / comparison (elementwise, numpy semantics) ---------------
+    def _binop(self, other, op) -> "CycloneSeries":
+        rhs = other.values if isinstance(other, CycloneSeries) else other
+        return CycloneSeries(op(self.values, rhs), self.name)
+
+    def __add__(self, o):
+        return self._binop(o, np.add)
+
+    def __sub__(self, o):
+        return self._binop(o, np.subtract)
+
+    def __mul__(self, o):
+        return self._binop(o, np.multiply)
+
+    def __truediv__(self, o):
+        return self._binop(o, np.divide)
+
+    def __eq__(self, o):  # noqa: PYI032 — pandas-style elementwise eq
+        return self._binop(o, np.equal)
+
+    def __ne__(self, o):  # noqa: PYI032
+        return self._binop(o, np.not_equal)
+
+    def __lt__(self, o):
+        return self._binop(o, np.less)
+
+    def __le__(self, o):
+        return self._binop(o, np.less_equal)
+
+    def __gt__(self, o):
+        return self._binop(o, np.greater)
+
+    def __ge__(self, o):
+        return self._binop(o, np.greater_equal)
+
+    def __and__(self, o):
+        return self._binop(o, np.logical_and)
+
+    def __or__(self, o):
+        return self._binop(o, np.logical_or)
+
+    def __invert__(self):
+        return CycloneSeries(np.logical_not(self.values), self.name)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __iter__(self):
+        return iter(self.values)
+
+    def __getitem__(self, i):
+        return self.values[i]
+
+    # -- reductions ------------------------------------------------------------
+    def sum(self):
+        return self.values.sum()
+
+    def mean(self):
+        return float(np.mean(self.values))
+
+    def std(self):
+        n = len(self.values)
+        return float(np.std(self.values, ddof=1)) if n > 1 else float("nan")
+
+    def min(self):
+        return self.values.min()
+
+    def max(self):
+        return self.values.max()
+
+    def count(self) -> int:
+        return int((~_is_null(self.values)).sum())
+
+    def nunique(self) -> int:
+        return len(np.unique(self.values[~_is_null(self.values)]))
+
+    # -- transforms ------------------------------------------------------------
+    def map(self, f: Callable) -> "CycloneSeries":
+        return CycloneSeries(np.array([f(v) for v in self.values]), self.name)
+
+    apply = map
+
+    def astype(self, dtype) -> "CycloneSeries":
+        return CycloneSeries(self.values.astype(dtype), self.name)
+
+    def isna(self) -> "CycloneSeries":
+        return CycloneSeries(_is_null(self.values), self.name)
+
+    def fillna(self, value) -> "CycloneSeries":
+        out = self.values.copy()
+        out[_is_null(out)] = value
+        return CycloneSeries(out, self.name)
+
+    def unique(self) -> np.ndarray:
+        seen, out = set(), []
+        for v in self.values:
+            if v not in seen:
+                seen.add(v)
+                out.append(v)
+        return np.array(out, dtype=self.values.dtype)
+
+    def value_counts(self) -> "CycloneSeries":
+        vals, counts = np.unique(self.values, return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        s = CycloneSeries(counts[order], self.name)
+        s.index = vals[order]
+        return s
+
+    def to_numpy(self) -> np.ndarray:
+        return self.values
+
+    def to_list(self) -> list:
+        return self.values.tolist()
+
+    def __repr__(self):
+        return f"CycloneSeries({self.name!r}, {self.values!r})"
+
+
+class _GroupBy:
+    """(ref: pyspark/pandas/groupby.py) — delegates to the SQL aggregate."""
+
+    def __init__(self, frame: "CycloneFrame", keys: List[str]):
+        self._frame = frame
+        self._keys = keys
+
+    def _agg(self, fns: Dict[str, str], suffix: bool) -> "CycloneFrame":
+        from cycloneml_tpu.sql import functions as F
+        from cycloneml_tpu.sql.session import CycloneSession
+        df = CycloneSession().create_data_frame(
+            {k: v for k, v in self._frame._cols.items()})
+        agg_cols = []
+        for col, fn in fns.items():
+            fobj = {"sum": F.sum, "mean": F.avg, "avg": F.avg, "min": F.min,
+                    "max": F.max, "count": F.count}[fn]
+            agg_cols.append(fobj(col).alias(f"{col}_{fn}" if suffix else col))
+        out = df.group_by(*self._keys).agg(*agg_cols).to_dict()
+        return CycloneFrame(out)
+
+    def agg(self, spec: Dict[str, str]) -> "CycloneFrame":
+        return self._agg(spec, suffix=True)
+
+    def _all_numeric(self, fn: str) -> "CycloneFrame":
+        cols = {c: fn for c in self._frame.columns
+                if c not in self._keys
+                and self._frame._cols[c].dtype != object}
+        # plain pandas naming: df.groupby(k).sum() keeps column names
+        return self._agg(cols, suffix=False)
+
+    def sum(self):
+        return self._all_numeric("sum")
+
+    def mean(self):
+        return self._all_numeric("mean")
+
+    def min(self):
+        return self._all_numeric("min")
+
+    def max(self):
+        return self._all_numeric("max")
+
+    def count(self):
+        first = [c for c in self._frame.columns if c not in self._keys][:1]
+        return self._agg({c: "count" for c in first}, suffix=False)
+
+
+class CycloneFrame:
+    """2-D table (ref: pyspark/pandas/frame.py)."""
+
+    def __init__(self, data: Union[Dict[str, Any], "CycloneFrame"]):
+        if isinstance(data, CycloneFrame):
+            self._cols = {k: v.copy() for k, v in data._cols.items()}
+            return
+        cols = {}
+        n = None
+        for k, v in data.items():
+            arr = v.values if isinstance(v, CycloneSeries) else np.asarray(v)
+            if arr.dtype.kind in "US":
+                arr = arr.astype(object)
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(f"column {k!r}: length {len(arr)} != {n}")
+            cols[k] = arr
+        self._cols = cols
+
+    # -- metadata --------------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols)
+
+    @property
+    def shape(self):
+        n = len(next(iter(self._cols.values()))) if self._cols else 0
+        return (n, len(self._cols))
+
+    @property
+    def dtypes(self) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self._cols.items()}
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    # -- selection -------------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return CycloneSeries(self._cols[key], key)
+        if isinstance(key, list):
+            return CycloneFrame({k: self._cols[k] for k in key})
+        if isinstance(key, CycloneSeries):  # boolean mask
+            mask = np.asarray(key.values, dtype=bool)
+            return CycloneFrame({k: v[mask] for k, v in self._cols.items()})
+        raise TypeError(f"cannot index with {type(key).__name__}")
+
+    def __setitem__(self, key: str, value) -> None:
+        arr = value.values if isinstance(value, CycloneSeries) else value
+        arr = np.asarray(arr)
+        if arr.ndim == 0:
+            arr = np.full(len(self), arr[()])
+        if self._cols and len(arr) != len(self):
+            raise ValueError(
+                f"column {key!r}: length {len(arr)} != {len(self)}")
+        self._cols[key] = arr
+
+    def assign(self, **kw) -> "CycloneFrame":
+        out = CycloneFrame(self)
+        for k, v in kw.items():
+            out[k] = v(out) if callable(v) else v
+        return out
+
+    def drop(self, columns: Sequence[str]) -> "CycloneFrame":
+        drop = set([columns] if isinstance(columns, str) else columns)
+        return CycloneFrame({k: v for k, v in self._cols.items()
+                             if k not in drop})
+
+    def rename(self, columns: Dict[str, str]) -> "CycloneFrame":
+        return CycloneFrame({columns.get(k, k): v
+                             for k, v in self._cols.items()})
+
+    # -- rows ------------------------------------------------------------------
+    def head(self, n: int = 5) -> "CycloneFrame":
+        return CycloneFrame({k: v[:n] for k, v in self._cols.items()})
+
+    def tail(self, n: int = 5) -> "CycloneFrame":
+        return CycloneFrame({k: v[-n:] if n else v[:0]
+                             for k, v in self._cols.items()})
+
+    def sort_values(self, by, ascending: bool = True) -> "CycloneFrame":
+        keys = [by] if isinstance(by, str) else list(by)
+        order = np.lexsort([self._cols[k] for k in reversed(keys)])
+        if not ascending:
+            order = order[::-1]
+        return CycloneFrame({k: v[order] for k, v in self._cols.items()})
+
+    # -- missing data ----------------------------------------------------------
+    def isna(self) -> "CycloneFrame":
+        return CycloneFrame({k: _is_null(v) for k, v in self._cols.items()})
+
+    def fillna(self, value) -> "CycloneFrame":
+        return CycloneFrame({k: CycloneSeries(v).fillna(value).values
+                             for k, v in self._cols.items()})
+
+    def dropna(self) -> "CycloneFrame":
+        if not self._cols:
+            return CycloneFrame({})
+        keep = ~np.logical_or.reduce([_is_null(v)
+                                      for v in self._cols.values()])
+        return CycloneFrame({k: v[keep] for k, v in self._cols.items()})
+
+    # -- combine ---------------------------------------------------------------
+    def merge(self, other: "CycloneFrame", on, how: str = "inner"
+              ) -> "CycloneFrame":
+        from cycloneml_tpu.sql.session import CycloneSession
+        s = CycloneSession()
+        left = s.create_data_frame(dict(self._cols))
+        right = s.create_data_frame(dict(other._cols))
+        return CycloneFrame(left.join(right, on=on, how=how).to_dict())
+
+    def groupby(self, by) -> _GroupBy:
+        return _GroupBy(self, [by] if isinstance(by, str) else list(by))
+
+    # -- stats -----------------------------------------------------------------
+    def describe(self) -> "CycloneFrame":
+        stats = ["count", "mean", "std", "min", "max"]
+        out: Dict[str, list] = {"summary": stats}
+        for k, v in self._cols.items():
+            if v.dtype == object:
+                continue
+            s = CycloneSeries(v)
+            out[k] = [s.count(), s.mean(), s.std(), s.min(), s.max()]
+        return CycloneFrame({k: np.asarray(v, dtype=object)
+                             if k == "summary" else np.asarray(v, dtype=float)
+                             for k, v in out.items()})
+
+    def apply(self, f: Callable, axis: int = 0):
+        if axis == 0:
+            return CycloneFrame({k: np.asarray(f(CycloneSeries(v, k)))
+                                 for k, v in self._cols.items()})
+        rows = self.to_records()
+        return CycloneSeries(np.array([f(r) for r in rows]))
+
+    # -- bridges ---------------------------------------------------------------
+    def to_records(self) -> List[Dict[str, Any]]:
+        cols = self.columns
+        return [{c: self._cols[c][i] for c in cols}
+                for i in range(len(self))]
+
+    def to_dict(self) -> Dict[str, np.ndarray]:
+        return dict(self._cols)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: v for k, v in self._cols.items()})
+
+    @classmethod
+    def from_pandas(cls, pdf) -> "CycloneFrame":
+        return cls({c: pdf[c].to_numpy() for c in pdf.columns})
+
+    def to_sql_df(self, session=None):
+        from cycloneml_tpu.sql.session import CycloneSession
+        return (session or CycloneSession()).create_data_frame(
+            dict(self._cols))
+
+    def __repr__(self):
+        n, m = self.shape
+        return f"CycloneFrame({n} rows x {m} cols: {self.columns})"
+
+
+def read_csv(path: str, header: bool = True,
+             delimiter: str = ",") -> CycloneFrame:
+    from cycloneml_tpu.sql.session import CycloneSession
+    return CycloneFrame(
+        CycloneSession().read_csv(path, header, delimiter).to_dict())

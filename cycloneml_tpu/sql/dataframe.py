@@ -171,6 +171,18 @@ class DataFrame:
 
     writeStream = write_stream
 
+    @property
+    def write(self):
+        """(ref Dataset.write → DataFrameWriter)"""
+        from cycloneml_tpu.sql.io import DataFrameWriter
+        return DataFrameWriter(self)
+
+    def to_pandas_frame(self):
+        """Bridge to the pandas-style API (≈ pandas-on-Spark's
+        DataFrame.pandas_api)."""
+        from cycloneml_tpu.pandas import CycloneFrame
+        return CycloneFrame(self.to_dict())
+
     # -- actions ---------------------------------------------------------------
     def optimized_plan(self) -> LogicalPlan:
         return optimize(self.plan)

@@ -102,6 +102,14 @@ class CycloneSession:
         cols = {n: data[:, i] for i, n in enumerate(names[: data.shape[1]])}
         return DataFrame(Scan(cols, path), self)
 
+    def read_parquet(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.io import read_parquet
+        return DataFrame(Scan(read_parquet(path), path), self)
+
+    def read_json(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.io import read_json
+        return DataFrame(Scan(read_json(path), path), self)
+
     def read_libsvm(self, path: str, n_features: Optional[int] = None) -> DataFrame:
         from cycloneml_tpu.dataset.io import parse_libsvm
         x, y = parse_libsvm(path, n_features)

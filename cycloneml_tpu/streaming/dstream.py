@@ -199,7 +199,9 @@ class DStream:
                 return None  # no RDD at off-slide intervals (ref semantics)
             out: List[Any] = []
             for i in range(max(0, t - window_length + 1), t + 1):
-                out.extend(parent.batch_for(i))
+                b = parent.batch_for(i)
+                if b is not None:  # parent itself may be a slid window
+                    out.extend(b)
             return out
         return DStream(self.ssc, compute)
 

@@ -54,11 +54,14 @@ def parse_properties_file(path: str) -> List[tuple]:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            # 'key value' (spark-defaults style) wins over 'key=value' so a
-            # whitespace-separated value may itself contain '=' (-Dfoo=bar)
+            # three accepted shapes: 'key value' (spark-defaults), 'key=value'
+            # and 'key = value'; a whitespace-separated value may itself
+            # contain '=' (-Dfoo=bar)
             head = line.split(None, 1)
             if len(head) == 2 and "=" not in head[0]:
                 k, v = head
+                if v.startswith("="):  # 'key = value' java-properties style
+                    v = v[1:].lstrip()
             else:
                 k, _, v = line.partition("=")
             out.append((k.strip(), v.strip()))

@@ -210,11 +210,25 @@ def test_properties_file_value_containing_equals(tmp_path):
     p.write_text("cyclone.extra.opts -Dfoo=bar\n"
                  "cyclone.simple=plain\n"
                  "# comment\n"
-                 "cyclone.spaced value with spaces\n")
+                 "cyclone.spaced value with spaces\n"
+                 "cyclone.java.style = local[4]\n")
     got = dict(parse_properties_file(str(p)))
     assert got["cyclone.extra.opts"] == "-Dfoo=bar"
     assert got["cyclone.simple"] == "plain"
     assert got["cyclone.spaced"] == "value with spaces"
+    assert got["cyclone.java.style"] == "local[4]"  # 'k = v' form
+
+
+def test_chained_slid_windows(ssc):
+    """A window over a slid window must treat the parent's None intervals
+    as empty, not crash."""
+    out = []
+    stream = ssc.queue_stream([[1], [2], [3], [4]])
+    stream.window(2, slide=2).window(2, 1).count().collect_to(out)
+    for _ in range(4):
+        ssc.run_one_interval()
+    # inner emits [1,2] at t=1, [3,4] at t=3; outer windows of width 2
+    assert out == [(0, [0]), (1, [2]), (2, [2]), (3, [2])]
 
 
 def test_submit_rejects_bad_conf():
