@@ -126,13 +126,12 @@ class MeshRuntime:
 def probe_device_count(master: str) -> Optional[int]:
     """Devices a master URL would select, WITHOUT building a mesh — lets
     callers validate a resource request before tearing down the active mesh.
-    None when unknowable up-front (multihost initializes on construction)."""
+    None when unknowable up-front (multihost initializes on construction);
+    a master that definitively cannot be built (e.g. local-mesh[8] with 4
+    visible devices) RAISES, so callers fail before any teardown."""
     if master == "multihost":
         return None
-    try:
-        return len(MeshRuntime._resolve_devices(master))
-    except Exception:
-        return None
+    return len(MeshRuntime._resolve_devices(master))
 
 
 _active: Optional[MeshRuntime] = None

@@ -190,8 +190,8 @@ class _GroupBy:
         return self._all_numeric("max")
 
     def count(self):
-        first = [c for c in self._frame.columns if c not in self._keys][:1]
-        return self._agg({c: "count" for c in first}, suffix=False)
+        rest = [c for c in self._frame.columns if c not in self._keys]
+        return self._agg({c: "count" for c in rest}, suffix=False)
 
 
 class CycloneFrame:

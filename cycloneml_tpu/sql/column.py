@@ -120,6 +120,38 @@ class Literal(Expr):
         return repr(self.value)
 
 
+class UdfExpr(Expr):
+    """Row-wise Python UDF (ref: the Python-UDF execution path,
+    sql/core/.../execution/python/ArrowPythonRunner.scala:39 + worker.py UDF
+    eval loop — no worker processes here, the driver IS Python, so a UDF is
+    a vectorized host call; keep UDFs off the jit path)."""
+
+    def __init__(self, fn, children: List["Expr"], name: str = "udf"):
+        self.fn = fn
+        self.children = list(children)
+        self.fn_name = name
+
+    def with_children(self, c):
+        return UdfExpr(self.fn, c, self.fn_name)
+
+    def eval(self, batch):
+        args = [np.atleast_1d(c.eval(batch)) for c in self.children]
+        n = max((len(a) for a in args), default=_batch_len(batch))
+        args = [np.broadcast_to(a, (n,)) if a.shape[0] != n else a
+                for a in args]
+        if args:
+            out = np.array([self.fn(*row) for row in zip(*args)])
+        else:  # zero-arg UDF still emits one value per row
+            out = np.array([self.fn() for _ in range(n)])
+        return _narrow_object(out) if out.dtype == object else out
+
+    def name_hint(self):
+        return f"{self.fn_name}({', '.join(str(c) for c in self.children)})"
+
+    def __str__(self):
+        return self.name_hint()
+
+
 class WindowExpr(Expr):
     """Tumbling event-time window bucket: floor((t - offset)/width)*width +
     offset, i.e. the window START (ref: TimeWindow in catalyst; the streaming

@@ -46,6 +46,19 @@ def max(c) -> Column:  # noqa: A001
     return Column(MaxAgg(_c(c).expr))
 
 
+def udf(fn, name: str = "") -> "Column":
+    """Wrap a Python function as a column expression factory
+    (ref: functions.udf / pyspark.sql.functions.udf):
+    ``double = F.udf(lambda v: v * 2); df.select(double(col("x")))``."""
+    from cycloneml_tpu.sql.column import UdfExpr
+
+    def make(*cols) -> Column:
+        exprs = [_c(c).expr for c in cols]
+        return Column(UdfExpr(fn, exprs, name or getattr(fn, "__name__",
+                                                         "udf")))
+    return make
+
+
 def window(c, width: float, offset: float = 0.0) -> Column:
     """Tumbling window bucket (start time) of ``width`` seconds
     (ref: functions.window / catalyst TimeWindow)."""

@@ -75,9 +75,12 @@ def read_json(path: str) -> Batch:
         arr = np.array(vals, dtype=object)
         if all(isinstance(v, (int, float)) and not isinstance(v, bool)
                for v in vals):
-            arr = np.array(vals, dtype=np.float64)
-            if all(float(v).is_integer() for v in vals):
-                arr = arr.astype(np.int64)
+            # trust the parsed token types: 1.0 stays float, 1 stays int —
+            # a whole-valued float column must survive a JSON round-trip
+            if all(isinstance(v, int) for v in vals):
+                arr = np.array(vals, dtype=np.int64)
+            else:
+                arr = np.array(vals, dtype=np.float64)
         out[n] = arr
     return out
 

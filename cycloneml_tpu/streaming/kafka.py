@@ -1,0 +1,93 @@
+"""Kafka streaming source.
+
+Analog of the reference's kafka-0-10-sql connector (ref: external/
+kafka-0-10-sql — KafkaSource/KafkaMicroBatchStream reading (key, value,
+topic, partition, offset, timestamp) rows with per-partition offset ranges).
+The kafka client library is optional: pass ``consumer_factory`` for tests or
+embedded brokers; without it the constructor needs ``kafka-python``
+installed (gated import, not bundled — the reference ships its connector as
+a separate artifact for the same reason).
+
+Offsets: the engine's single monotonically-increasing int offset maps to a
+row count; per-partition Kafka offsets are tracked internally and snapshots
+of consumed-but-uncommitted rows are buffered so ``get_batch`` stays
+replayable until ``commit`` (the Source contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.sql.plan import Batch
+from cycloneml_tpu.streaming.sources import Source
+
+SCHEMA = ["key", "value", "topic", "partition", "offset", "timestamp"]
+
+
+class KafkaSource(Source):
+    schema = SCHEMA
+
+    def __init__(self, topic: str,
+                 bootstrap_servers: str = "localhost:9092",
+                 consumer_factory: Optional[Callable] = None,
+                 poll_timeout_ms: int = 200):
+        self.topic = topic
+        self.poll_timeout_ms = poll_timeout_ms
+        if consumer_factory is not None:
+            self._consumer = consumer_factory()
+        else:
+            try:
+                from kafka import KafkaConsumer  # gated optional dep
+            except ImportError as e:
+                raise ImportError(
+                    "KafkaSource needs the 'kafka-python' package (or pass "
+                    "consumer_factory=); it is not bundled with "
+                    "cycloneml_tpu") from e
+            self._consumer = KafkaConsumer(
+                topic, bootstrap_servers=bootstrap_servers,
+                enable_auto_commit=False, auto_offset_reset="earliest")
+        self._rows: List[tuple] = []  # replay buffer of consumed rows
+        self._base = 0  # engine offset of _rows[0]
+
+    def _poll(self) -> None:
+        records = self._consumer.poll(timeout_ms=self.poll_timeout_ms)
+        for batch in records.values():
+            for r in batch:
+                self._rows.append((
+                    r.key.decode() if isinstance(r.key, bytes) else r.key,
+                    r.value.decode() if isinstance(r.value, bytes) else r.value,
+                    getattr(r, "topic", self.topic),
+                    getattr(r, "partition", 0),
+                    getattr(r, "offset", 0),
+                    getattr(r, "timestamp", 0),
+                ))
+
+    def latest_offset(self) -> int:
+        self._poll()
+        return self._base + len(self._rows)
+
+    def get_batch(self, start: int, end: int) -> Batch:
+        lo, hi = start - self._base, end - self._base
+        rows = self._rows[max(0, lo):hi]
+        cols = list(zip(*rows)) if rows else [[] for _ in SCHEMA]
+        out: Batch = {}
+        for name, vals in zip(SCHEMA, cols):
+            arr = np.array(vals, dtype=object)
+            if name in ("partition", "offset", "timestamp") and len(vals):
+                arr = np.array(vals, dtype=np.int64)
+            out[name] = arr
+        return out
+
+    def commit(self, end: int) -> None:
+        """Discard replay rows up to ``end`` and commit consumer offsets."""
+        drop = end - self._base
+        if drop > 0:
+            self._rows = self._rows[drop:]
+            self._base = end
+        if hasattr(self._consumer, "commit"):
+            try:
+                self._consumer.commit()
+            except Exception:
+                pass  # commit is an optimization; replay covers recovery

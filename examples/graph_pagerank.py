@@ -1,0 +1,23 @@
+"""PageRank over a property graph (≈ examples/src/main/python/pagerank.py
+and the GraphX lib, ref: graphx/.../lib/PageRank.scala)."""
+
+import numpy as np
+
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.graph.graph import Graph
+from cycloneml_tpu.graph.lib import pagerank
+
+
+def main():
+    ctx = CycloneContext.get_or_create()
+    # tiny web: 0 <-> 1, both point at 2
+    g = Graph.from_edges(ctx, [(0, 1), (1, 0), (0, 2), (1, 2)])
+    ranks = pagerank(g, tol=1e-6)
+    for v, r in enumerate(np.asarray(ranks)):
+        print(f"vertex {v}: rank {r:.4f}")
+    assert np.argmax(ranks) == 2
+    return ranks
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""KMeans clustering on the mesh (≈ examples/src/main/python/ml/
+kmeans_example.py)."""
+
+import numpy as np
+
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.clustering import KMeans
+
+
+def main():
+    ctx = CycloneContext.get_or_create()
+    rng = np.random.RandomState(1)
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    x = np.concatenate([rng.randn(300, 2) + c for c in centers])
+    frame = MLFrame(ctx, {"features": x})
+
+    model = KMeans(k=3, seed=1).fit(frame)
+    print("centers:")
+    for c in model.cluster_centers:
+        print("  ", np.round(np.asarray(c), 2))
+    print("training cost:", model.training_cost)
+    return model
+
+
+if __name__ == "__main__":
+    main()

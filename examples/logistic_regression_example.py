@@ -1,0 +1,36 @@
+"""Distributed LogisticRegression on the mesh (≈ examples/src/main/python/
+ml/logistic_regression_with_elastic_net.py in the reference).
+
+Run: python -m cycloneml_tpu.submit --master local-mesh[8] \
+         examples/logistic_regression_example.py
+(local-mesh needs JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+import numpy as np
+
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.classification import LogisticRegression
+
+
+def main():
+    ctx = CycloneContext.get_or_create()
+    rng = np.random.RandomState(7)
+    x = rng.randn(2000, 10)
+    y = (x @ rng.randn(10) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+
+    lr = LogisticRegression(maxIter=20, regParam=0.01, elasticNetParam=0.5)
+    model = lr.fit(frame)
+    print("coefficients:", np.asarray(model.coefficients))
+    print("intercept:", model.intercept)
+    summary = model.summary
+    print("final loss:", summary.objective_history[-1])
+    pred = model.transform(frame)
+    acc = float((pred["prediction"] == y).mean())
+    print(f"train accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

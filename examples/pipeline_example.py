@@ -1,0 +1,42 @@
+"""Estimator/Transformer/Pipeline with persistence (≈ the reference's
+examples/src/main/python/ml/pipeline_example.py)."""
+
+import tempfile
+
+import numpy as np
+
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Pipeline, PipelineModel
+from cycloneml_tpu.ml.classification import LogisticRegression
+from cycloneml_tpu.ml.feature import StandardScaler
+
+
+def main():
+    ctx = CycloneContext.get_or_create()
+    rng = np.random.RandomState(0)
+    x = rng.randn(500, 6) * 10 + 3
+    y = (x @ rng.randn(6) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+
+    pipeline = Pipeline(stages=[
+        StandardScaler(inputCol="features", outputCol="scaled",
+                       withMean=True),
+        LogisticRegression(featuresCol="scaled", maxIter=15),
+    ])
+    model = pipeline.fit(frame)
+    out = model.transform(frame)
+    acc = float((out["prediction"] == y).mean())
+    print(f"pipeline train accuracy: {acc:.3f}")
+
+    path = tempfile.mkdtemp(prefix="pipeline-model-") + "/model"
+    model.save(path)
+    reloaded = PipelineModel.load(path)
+    out2 = reloaded.transform(frame)
+    assert (out2["prediction"] == out["prediction"]).all()
+    print("persistence round-trip OK:", path)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

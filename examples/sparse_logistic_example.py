@@ -1,0 +1,41 @@
+"""Criteo-style sparse training: hashed high-dimensional features in ELL
+layout with gather/scatter aggregators (the path SURVEY §7 flags as the
+hard part — no dense equivalent fits on a chip at real Criteo width)."""
+
+import numpy as np
+
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+from cycloneml_tpu.ml.optim.lbfgs import LBFGS
+from cycloneml_tpu.ml.optim.loss import DistributedLossFunction
+from cycloneml_tpu.ml.optim.sparse_aggregators import binary_logistic_sparse
+
+
+def main():
+    ctx = CycloneContext.get_or_create()
+    rng = np.random.RandomState(0)
+    n, k, hashed_dim = 20_000, 16, 1 << 14
+    indices = rng.randint(0, 10**6, size=(n, k))  # raw categorical ids
+    values = np.ones((n, k), dtype=np.float32)
+    true = rng.randn(hashed_dim)
+
+    ds = SparseInstanceDataset.from_rows(
+        ctx, [(indices[i], values[i]) for i in range(n)],
+        y=np.zeros(n), hash_dim=hashed_dim)
+    margins = ds.to_dense() @ true if n <= 20_000 else None
+    y = (margins > 0).astype(float)
+    ds = SparseInstanceDataset.from_rows(
+        ctx, [(indices[i], values[i]) for i in range(n)], y=y,
+        hash_dim=hashed_dim)
+
+    loss = DistributedLossFunction(
+        ds, binary_logistic_sparse(hashed_dim, fit_intercept=False))
+    state = LBFGS(max_iter=15).minimize(loss, np.zeros(hashed_dim))
+    print(f"d={hashed_dim} nnz/row={k}: loss "
+          f"{state.loss_history[0]:.4f} -> {state.value:.4f} "
+          f"in {state.iteration} iterations")
+    return state.value
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""SQL + DataFrame basics with a Python UDF (≈ the reference's
+examples/src/main/python/sql/basic.py)."""
+
+import numpy as np
+
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.column import col
+from cycloneml_tpu.sql.session import CycloneSession
+
+
+def main():
+    s = CycloneSession()
+    people = s.create_data_frame({
+        "name": ["Michael", "Andy", "Justin"],
+        "age": [29, 30, 19],
+        "dept": ["eng", "eng", "sales"],
+    })
+    s.register_temp_view("people", people)
+
+    adults = s.sql("SELECT name, age FROM people WHERE age > 20 ORDER BY age")
+    adults.show()
+
+    by_dept = people.group_by("dept").agg(
+        F.avg("age").alias("avg_age"), F.count("*").alias("n"))
+    by_dept.show()
+
+    shout = F.udf(lambda name: name.upper(), name="shout")
+    people.select(shout(col("name")).alias("loud")).show()
+
+    stats = people.to_pandas_frame()
+    print("pandas bridge mean age:", stats["age"].mean())
+    return [r.name for r in adults.collect()]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Structured streaming word count (≈ the reference's
+examples/src/main/python/sql/streaming/structured_network_wordcount.py,
+with a memory source instead of a socket)."""
+
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.session import CycloneSession
+from cycloneml_tpu.streaming import MemoryStream
+
+
+def main():
+    session = CycloneSession()
+    lines = MemoryStream(["value"])
+
+    words = lines.to_df(session)  # one row per word after the UDF explode
+    counts = (words.group_by("value").agg(F.count("*").alias("count")))
+    query = (counts.write_stream.output_mode("complete").format("memory")
+             .query_name("wordcounts").start())
+
+    for chunk in (["apache", "cyclone"], ["cyclone", "tpu", "tpu"]):
+        lines.add_data(value=chunk)
+        query.process_all_available()
+
+    result = session.table("wordcounts").order_by(F.col("count").desc())
+    result.show()
+    top = result.first()
+    print("most frequent:", top.value, top["count"])
+    query.stop()
+    return dict((r.value, r["count"]) for r in result.collect())
+
+
+if __name__ == "__main__":
+    main()

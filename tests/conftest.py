@@ -36,3 +36,21 @@ def ctx():
     c = CycloneContext(conf)
     yield c
     c.stop()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def thread_audit():
+    """Leak check for NON-daemon threads (≈ SparkFunSuite's ThreadAudit,
+    SparkFunSuite.scala:44-49). Daemon threads (listener buses, trigger
+    loops, metrics) die with the process and are exempt, as the reference
+    exempts its known daemon pools."""
+    import threading
+    # process-lifetime pools, exempt like the reference exempts its known
+    # pools (rpc/netty/forkjoin): the shared partition-task executor
+    allowed_prefixes = ("cyclone-task",)
+    before = {t.name for t in threading.enumerate() if not t.daemon}
+    yield
+    leaked = [t for t in threading.enumerate()
+              if not t.daemon and t.is_alive() and t.name not in before
+              and not t.name.startswith(allowed_prefixes)]
+    assert not leaked, f"non-daemon threads leaked by tests: {leaked}"

@@ -313,6 +313,20 @@ def test_resource_profile_builder_and_satisfaction(ctx):
     assert ctx.with_resources(p).mesh_runtime is mesh_before
 
 
+def test_probe_raises_before_destructive_rebuild(ctx):
+    """An infeasible master must fail BEFORE mesh teardown, not leave the
+    context meshless after a destructive reset."""
+    from cycloneml_tpu import mesh as mesh_mod
+    from cycloneml_tpu.resource import ResourceProfileBuilder
+    with pytest.raises(RuntimeError, match="needs 1000 devices"):
+        mesh_mod.probe_device_count("local-mesh[1000]")
+    mesh_before = ctx.mesh_runtime
+    p = ResourceProfileBuilder().replicas(3).build()  # 8 % 3 != 0
+    with pytest.raises(RuntimeError, match="divisible"):
+        ctx.with_resources(p)
+    assert ctx.mesh_runtime is mesh_before  # old mesh untouched
+
+
 def test_resource_profile_mesh_rebuild(ctx):
     from cycloneml_tpu.resource import ResourceProfileBuilder
     p = ResourceProfileBuilder().model_parallel(2).build()

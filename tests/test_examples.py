@@ -1,0 +1,26 @@
+"""Smoke tests running the example programs on the test mesh
+(≈ the reference running its examples in CI via run-tests)."""
+
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = [
+    "examples/logistic_regression_example.py",
+    "examples/pipeline_example.py",
+    "examples/structured_streaming_wordcount.py",
+    "examples/sql_example.py",
+    "examples/kmeans_example.py",
+    "examples/sparse_logistic_example.py",
+    "examples/graph_pagerank.py",
+]
+
+
+@pytest.mark.parametrize("path", EXAMPLES)
+def test_example_runs(ctx, path, capsys):
+    mod = runpy.run_path(path)
+    result = mod["main"]()
+    assert result is not None
+    out = capsys.readouterr().out
+    assert out.strip()  # every example prints something meaningful

@@ -85,6 +85,7 @@ def test_groupby_matches_pandas(frame):
     assert agg["a_min"].to_list() == [2, 1]
     cnt = frame.groupby("k").count().sort_values("k")
     assert cnt["a"].to_list() == [2, 2]
+    assert cnt["b"].to_list() == [2, 2]  # all non-key columns counted
 
 
 def test_merge_matches_pandas(frame):
@@ -154,6 +155,11 @@ def test_json_roundtrip(tmp_path):
     # integers detected as ints from JSON
     (tmp_path / "ints.json").write_text('{"v": 1}\n{"v": 2}\n')
     assert s.read_json(str(tmp_path / "ints.json")).to_dict()["v"].dtype.kind == "i"
+    # whole-valued FLOATS keep their float dtype through a round-trip
+    whole = s.create_data_frame({"f": [1.0, 2.0]})
+    wp = str(tmp_path / "whole.json")
+    whole.write.json(wp)
+    assert s.read_json(wp).to_dict()["f"].dtype.kind == "f"
 
 
 def test_csv_writer_and_save_modes(tmp_path):
