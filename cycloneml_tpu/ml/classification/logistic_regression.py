@@ -58,6 +58,15 @@ class _LogisticRegressionParams(HasMaxIter, HasRegParam, HasElasticNetParam,
         self.family = self._param(
             "family", "label distribution family",
             V.in_array(["auto", "binomial", "multinomial"]), default="auto")
+        # step-level training checkpoints — the improvement SURVEY §5.4
+        # flags over the reference, which only persists finished models (the
+        # param NAME mirrors the reference's checkpointInterval on ALS/trees)
+        self.checkpointDir = self._param(
+            "checkpointDir", "directory for mid-training optimizer "
+            "checkpoints; fit() resumes from the newest one", default="")
+        self.checkpointInterval = self._param(
+            "checkpointInterval", "iterations between checkpoints",
+            V.gt(0), default=10)
 
 
 class LogisticRegression(Predictor, _LogisticRegressionParams,
@@ -172,7 +181,24 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         else:
             opt = LBFGS(max_iter=self.get("maxIter"), tol=self.get("tol"))
 
-        state = opt.minimize(loss_fn, x0)
+        if self.get("checkpointDir"):
+            import hashlib
+            from cycloneml_tpu.parallel.resilience import train_with_checkpoints
+            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+            # resuming someone else's checkpoint would silently return the
+            # wrong model — bind the dir to this dataset+params
+            fp = hashlib.sha1(repr((
+                ds.n_rows, d, num_classes, float(weight_sum),
+                np.asarray(histogram).round(6).tolist(),
+                np.asarray(features_std).round(6).tolist(),
+                reg, alpha, self.get("tol"), fit_intercept, standardize,
+            )).encode()).hexdigest()[:16]
+            state = train_with_checkpoints(
+                opt, loss_fn, x0,
+                TrainingCheckpointer(self.get("checkpointDir")),
+                interval=self.get("checkpointInterval"), fingerprint=fp)
+        else:
+            state = opt.minimize(loss_fn, x0)
         if state.converged_reason == "max iterations reached":
             logger.warning("LogisticRegression did not converge in %d iterations",
                            self.get("maxIter"))

@@ -161,6 +161,18 @@ def _strong_wolfe(f: LossGrad, x: np.ndarray, value: float, grad: np.ndarray,
     return alpha, v, g
 
 
+def _reopen(resume: OptimState, max_iter: int) -> OptimState:
+    """'max iterations reached' is a budget stop, not convergence: a resumed
+    run with a larger budget continues (real convergence reasons hold)."""
+    import dataclasses
+    if (resume.converged
+            and resume.converged_reason == "max iterations reached"
+            and resume.iteration < max_iter):
+        return dataclasses.replace(resume, converged=False,
+                                   converged_reason="")
+    return resume
+
+
 class LBFGS:
     """Limited-memory BFGS (Breeze-LBFGS semantics).
 
@@ -194,7 +206,7 @@ class LBFGS:
         previous run stopped (same curvature memory → identical trajectory)."""
         hist = _History(self.m)
         if resume is not None:
-            state = resume
+            state = _reopen(resume, self.max_iter)
             hist.s = [np.asarray(s) for s in resume.hist_s]
             hist.y = [np.asarray(y) for y in resume.hist_y]
         else:
@@ -274,7 +286,7 @@ class OWLQN(LBFGS):
                    resume: Optional[OptimState] = None):
         hist = _History(self.m)
         if resume is not None:
-            state = resume
+            state = _reopen(resume, self.max_iter)
             x = np.asarray(resume.x, dtype=np.float64)
             hist.s = [np.asarray(s) for s in resume.hist_s]
             hist.y = [np.asarray(y) for y in resume.hist_y]

@@ -166,7 +166,8 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
                            checkpointer: TrainingCheckpointer,
                            interval: int = 5,
                            max_step_failures: int = 4,
-                           on_step: Optional[Callable] = None):
+                           on_step: Optional[Callable] = None,
+                           fingerprint: Optional[str] = None):
     """Drive ``optimizer.iterations`` with periodic state checkpoints and
     automatic resume from the newest checkpoint.
 
@@ -182,6 +183,14 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
     resume = None
     latest = checkpointer.latest_step()
     if latest is not None:
+        if fingerprint is not None:
+            saved = checkpointer.metadata(latest).get("fingerprint")
+            if saved is not None and saved != fingerprint:
+                raise ValueError(
+                    f"checkpoint dir {checkpointer.directory!r} holds state "
+                    f"for a DIFFERENT training run (fingerprint {saved} != "
+                    f"{fingerprint}); resuming it would silently return the "
+                    "wrong model — clear the directory or use a new one")
         resume = OptimState.from_pytree(checkpointer.restore(latest))
         logger.info("resuming training from checkpoint step %d", latest)
 
@@ -218,10 +227,12 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
             on_step(state)
         if state.iteration > 0 and state.iteration % interval == 0:
             checkpointer.save(state.iteration, state.to_pytree(),
-                              metadata={"loss": state.value})
+                              metadata={"loss": state.value,
+                                        "fingerprint": fingerprint})
         if state.converged:
             break
     if state is not None and checkpointer.latest_step() != state.iteration:
         checkpointer.save(state.iteration, state.to_pytree(),
-                          metadata={"loss": state.value, "final": True})
+                          metadata={"loss": state.value, "final": True,
+                                    "fingerprint": fingerprint})
     return state

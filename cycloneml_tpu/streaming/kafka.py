@@ -32,9 +32,10 @@ class KafkaSource(Source):
     def __init__(self, topic: str,
                  bootstrap_servers: str = "localhost:9092",
                  consumer_factory: Optional[Callable] = None,
-                 poll_timeout_ms: int = 200):
+                 poll_timeout_ms: int = 200, decode: bool = True):
         self.topic = topic
         self.poll_timeout_ms = poll_timeout_ms
+        self.decode = decode  # False = binary key/value (ref exposes binary)
         if consumer_factory is not None:
             self._consumer = consumer_factory()
         else:
@@ -51,13 +52,20 @@ class KafkaSource(Source):
         self._rows: List[tuple] = []  # replay buffer of consumed rows
         self._base = 0  # engine offset of _rows[0]
 
+    def _decode(self, v):
+        if not (self.decode and isinstance(v, bytes)):
+            return v
+        try:
+            return v.decode()
+        except UnicodeDecodeError:
+            return v  # non-text payload (avro/protobuf): stay binary
+
     def _poll(self) -> None:
         records = self._consumer.poll(timeout_ms=self.poll_timeout_ms)
         for batch in records.values():
             for r in batch:
                 self._rows.append((
-                    r.key.decode() if isinstance(r.key, bytes) else r.key,
-                    r.value.decode() if isinstance(r.value, bytes) else r.value,
+                    self._decode(r.key), self._decode(r.value),
                     getattr(r, "topic", self.topic),
                     getattr(r, "partition", 0),
                     getattr(r, "offset", 0),
@@ -74,10 +82,10 @@ class KafkaSource(Source):
         cols = list(zip(*rows)) if rows else [[] for _ in SCHEMA]
         out: Batch = {}
         for name, vals in zip(SCHEMA, cols):
-            arr = np.array(vals, dtype=object)
-            if name in ("partition", "offset", "timestamp") and len(vals):
-                arr = np.array(vals, dtype=np.int64)
-            out[name] = arr
+            if name in ("partition", "offset", "timestamp"):
+                out[name] = np.array(vals, dtype=np.int64)  # empty-safe
+            else:
+                out[name] = np.array(vals, dtype=object)
         return out
 
     def commit(self, end: int) -> None:

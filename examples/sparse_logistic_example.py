@@ -19,10 +19,10 @@ def main():
     values = np.ones((n, k), dtype=np.float32)
     true = rng.randn(hashed_dim)
 
-    ds = SparseInstanceDataset.from_rows(
-        ctx, [(indices[i], values[i]) for i in range(n)],
-        y=np.zeros(n), hash_dim=hashed_dim)
-    margins = ds.to_dense() @ true if n <= 20_000 else None
+    # labels from the true weights via the same hashed gather (no densify)
+    from cycloneml_tpu.dataset.sparse import hash_features
+    hidx, hval = hash_features(indices, values, hashed_dim)
+    margins = (true[hidx] * hval).sum(axis=1)
     y = (margins > 0).astype(float)
     ds = SparseInstanceDataset.from_rows(
         ctx, [(indices[i], values[i]) for i in range(n)], y=y,

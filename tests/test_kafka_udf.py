@@ -35,11 +35,10 @@ def _rec(key, value, offset, ts=0):
                            offset=offset, timestamp=ts)
 
 
-def test_kafka_source_streaming_query(session=None):
+def test_kafka_source_streaming_query():
     s = CycloneSession()
     consumer = FakeConsumer()
     src = KafkaSource("t", consumer_factory=lambda: consumer)
-    df = src.to_df(s) if hasattr(src, "to_df") else None
     from cycloneml_tpu.streaming.sources import StreamingScan
     from cycloneml_tpu.sql.dataframe import DataFrame
     df = DataFrame(StreamingScan(src, "kafka"), s)
@@ -70,6 +69,20 @@ def test_kafka_replay_buffer_before_commit():
     consumer.feed(_rec(b"c", b"3", 2))
     end2 = src.latest_offset()
     assert src.get_batch(end, end2)["value"].tolist() == ["3"]
+
+
+def test_kafka_binary_payloads_survive():
+    """Non-UTF8 payloads (avro/protobuf) must not kill the source."""
+    consumer = FakeConsumer()
+    src = KafkaSource("t", consumer_factory=lambda: consumer)
+    consumer.feed(_rec(b"\x93\xff", b"\x00\x01\xfe", 0))
+    end = src.latest_offset()
+    batch = src.get_batch(0, end)
+    assert batch["value"][0] == b"\x00\x01\xfe"  # kept as bytes
+    # empty batches keep int64 schema for the numeric columns
+    empty = src.get_batch(end, end)
+    for c in ("partition", "offset", "timestamp"):
+        assert empty[c].dtype == np.int64 and len(empty[c]) == 0
 
 
 def test_kafka_requires_client_without_factory():

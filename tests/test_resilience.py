@@ -275,6 +275,55 @@ def test_train_with_checkpoints_transient_retry(tmp_path):
     np.testing.assert_allclose(final.x, baseline.x, rtol=1e-10)
 
 
+def test_logistic_regression_checkpoint_resume(ctx, tmp_path):
+    """Estimator-level wiring: fit() with checkpointDir resumes a killed
+    training run and lands on the uninterrupted result."""
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(200, 6)
+    y = (x @ rng.randn(6) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    ck = str(tmp_path / "lr-ck")
+
+    full = LogisticRegression(maxIter=40, tol=1e-9).fit(frame)
+
+    # 'crash' after 3 iterations (checkpoint every 2), then resume to 40
+    LogisticRegression(maxIter=3, tol=1e-9, checkpointDir=ck,
+                       checkpointInterval=2).fit(frame)
+    assert os.listdir(ck)
+    resumed = LogisticRegression(maxIter=40, tol=1e-9, checkpointDir=ck,
+                                 checkpointInterval=2).fit(frame)
+    np.testing.assert_allclose(
+        np.asarray(resumed.coefficients), np.asarray(full.coefficients),
+        rtol=1e-8)
+    # resumed history continues the interrupted run, not a fresh start
+    assert resumed.summary.total_iterations == full.summary.total_iterations
+
+
+def test_checkpoint_fingerprint_guards_reuse(ctx, tmp_path):
+    """A checkpoint dir bound to one dataset must refuse to resume a fit on
+    different data instead of silently returning the old model."""
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 4)
+    ck = str(tmp_path / "ck")
+    frame_a = MLFrame(ctx, {"features": x,
+                            "label": (x[:, 0] > 0).astype(float)})
+    frame_b = MLFrame(ctx, {"features": x,
+                            "label": (x[:, 1] > 0).astype(float)})
+    LogisticRegression(maxIter=5, checkpointDir=ck).fit(frame_a)
+    with pytest.raises(ValueError, match="DIFFERENT training run"):
+        LogisticRegression(maxIter=5, checkpointDir=ck).fit(frame_b)
+    # different hyperparameters on the same data are also a different run
+    with pytest.raises(ValueError, match="DIFFERENT training run"):
+        LogisticRegression(maxIter=5, regParam=0.5,
+                           checkpointDir=ck).fit(frame_a)
+
+
 # -- distributed end-to-end: failure, mesh rebuild, resume ----------------------
 
 def test_elastic_mesh_rebuild_resume(ctx, tmp_path):
