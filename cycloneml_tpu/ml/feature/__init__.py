@@ -27,6 +27,8 @@ from cycloneml_tpu.ml.feature.lsh import (
     BucketedRandomProjectionLSHModel,
 )
 from cycloneml_tpu.ml.feature.word2vec import Word2Vec, Word2VecModel
+from cycloneml_tpu.ml.feature.formula import (RFormula, RFormulaModel,
+                                              SQLTransformer)
 
 __all__ = [
     "StandardScaler", "StandardScalerModel", "MinMaxScaler", "MinMaxScalerModel",
@@ -43,4 +45,5 @@ __all__ = [
     "UnivariateFeatureSelectorModel", "PCA", "PCAModel", "MinHashLSH",
     "MinHashLSHModel", "BucketedRandomProjectionLSH",
     "BucketedRandomProjectionLSHModel", "Word2Vec", "Word2VecModel",
+    "RFormula", "RFormulaModel", "SQLTransformer",
 ]

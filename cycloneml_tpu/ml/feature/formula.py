@@ -1,0 +1,232 @@
+"""RFormula and SQLTransformer — the last two reference feature transformers.
+
+RFormula (ref: ml/feature/RFormula.scala + RFormulaParser.scala): an R-style
+model formula ``label ~ term + term`` compiled into a feature-assembly
+pipeline. Supported grammar (the subset the reference's own docs
+illustrate): ``y ~ a + b``, ``y ~ .`` (all non-label columns), ``a:b``
+interaction terms, ``y ~ . - c`` exclusion. String columns one-hot encode
+with the last category dropped (R's dummy coding, exactly the reference's
+behavior); the label string-indexes when categorical.
+
+SQLTransformer (ref: ml/feature/SQLTransformer.scala): runs a SQL statement
+with the ``__THIS__`` placeholder bound to the input frame — powered by this
+framework's own SQL engine.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.base import Estimator, Model
+from cycloneml_tpu.ml.util_io import MLReadable, MLWritable
+
+
+def _is_string_col(arr: np.ndarray) -> bool:
+    return arr.dtype == object or arr.dtype.kind in "US"
+
+
+def _parse_formula(formula: str) -> Tuple[str, List[str], List[str]]:
+    """Returns (label, include_terms, exclude_terms); '.' may appear in
+    include_terms; interactions are 'a:b' strings."""
+    if "~" not in formula:
+        raise ValueError(f"formula needs '~': {formula!r}")
+    lhs, rhs = formula.split("~", 1)
+    label = lhs.strip()
+    include: List[str] = []
+    exclude: List[str] = []
+    # split on +/- at top level, tracking sign; anything the tokenizer does
+    # not consume (R operators like '*', '^', '(') must be REJECTED — R's
+    # a*b means a + b + a:b, and silently dropping the '*' would train on
+    # the wrong design matrix
+    consumed = 0
+    for m in re.finditer(r"([+-]?)\s*([\w.]+(?::[\w.]+)*)\s*", rhs):
+        residue = rhs[consumed:m.start()].strip()
+        if residue:
+            raise ValueError(
+                f"unsupported formula operator {residue!r} in {formula!r} "
+                "(supported: '+', '-', ':', '.')")
+        consumed = m.end()
+        sign, term = m.group(1), m.group(2).strip()
+        (exclude if sign == "-" else include).append(term)
+    residue = rhs[consumed:].strip()
+    if residue:
+        raise ValueError(
+            f"unsupported formula operator {residue!r} in {formula!r} "
+            "(supported: '+', '-', ':', '.')")
+    if not include:
+        raise ValueError(f"formula has no terms: {formula!r}")
+    return label, include, exclude
+
+
+class RFormula(Estimator, MLWritable, MLReadable):
+    """(ref RFormula.scala) — fit() resolves '.', indexes string columns,
+    and returns an RFormulaModel producing featuresCol (+ labelCol)."""
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.formula = self._param("formula", "R model formula", default="")
+        self.featuresCol = self._param("featuresCol", "output features",
+                                       default="features")
+        self.labelCol = self._param("labelCol", "output label",
+                                    default="label")
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def _fit(self, frame: MLFrame) -> "RFormulaModel":
+        label, include, exclude = _parse_formula(self.get("formula"))
+        cols = [c for c in frame.columns if c != label]
+        terms: List[str] = []
+        for t in include:
+            if t == ".":
+                terms.extend(c for c in cols if c not in terms)
+            elif t not in terms:
+                terms.append(t)
+        terms = [t for t in terms if t not in exclude]
+
+        # category dictionaries for string columns (ref: StringIndexer order
+        # = descending frequency, ties lexicographic)
+        categories: Dict[str, List] = {}
+        for t in terms:
+            for c in t.split(":"):
+                if c in frame.columns and _is_string_col(frame[c]) \
+                        and c not in categories:
+                    categories[c] = _freq_order(frame[c])
+        label_categories: Optional[List] = None
+        if label in frame.columns and _is_string_col(frame[label]):
+            label_categories = _freq_order(frame[label])
+
+        m = RFormulaModel(terms=terms, label=label, categories=categories,
+                          label_categories=label_categories, uid=self.uid)
+        self._copy_values(m)
+        return m._set_parent(self)
+
+
+def _freq_order(arr: np.ndarray) -> List[str]:
+    # categories are ALWAYS str labels (same rule pre/post persistence),
+    # ordered by StringIndexer's shared frequencyDesc logic
+    from cycloneml_tpu.ml.feature.indexers import ordered_labels
+    return ordered_labels([str(v) for v in arr])
+
+
+class RFormulaModel(Model, MLWritable, MLReadable):
+    def __init__(self, terms: Optional[List[str]] = None, label: str = "",
+                 categories: Optional[Dict[str, List]] = None,
+                 label_categories: Optional[List] = None, uid=None):
+        super().__init__(uid)
+        self.formula = self._param("formula", "R model formula", default="")
+        self.featuresCol = self._param("featuresCol", "output features",
+                                       default="features")
+        self.labelCol = self._param("labelCol", "output label",
+                                    default="label")
+        self.terms = terms or []
+        self.label = label
+        self.categories = categories or {}
+        self.label_categories = label_categories
+
+    @staticmethod
+    def _code(lookup: Dict[str, int], v, col: str) -> int:
+        try:
+            return lookup[str(v)]
+        except KeyError:
+            raise ValueError(
+                f"column {col!r} has category {v!r} unseen at fit time "
+                "(ref RFormula handleInvalid='error')") from None
+
+    def _encode_col(self, frame: MLFrame, c: str) -> np.ndarray:
+        arr = frame[c]
+        if c in self.categories:
+            cats = self.categories[c]
+            lookup = {v: i for i, v in enumerate(cats)}
+            codes = np.array([self._code(lookup, v, c) for v in arr])
+            # dummy coding: k-1 columns, last category dropped (ref/R)
+            out = np.zeros((len(arr), max(len(cats) - 1, 1)))
+            mask = codes < len(cats) - 1
+            out[np.arange(len(arr))[mask], codes[mask]] = 1.0
+            return out if len(cats) > 1 else out[:, :0]
+        a = np.asarray(arr, dtype=np.float64)
+        return a[:, None] if a.ndim == 1 else a
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
+        parts = []
+        for t in self.terms:
+            factors = [self._encode_col(frame, c) for c in t.split(":")]
+            block = factors[0]
+            for f in factors[1:]:  # interaction = pairwise products
+                block = (block[:, :, None] * f[:, None, :]).reshape(
+                    len(f), -1)
+            parts.append(block)
+        feats = (np.concatenate(parts, axis=1) if parts
+                 else np.zeros((frame.n_rows, 0)))
+        out = frame.with_column(self.get("featuresCol"), feats)
+        if self.label in frame.columns:
+            y = frame[self.label]
+            if self.label_categories is not None:
+                lookup = {v: i for i, v in enumerate(self.label_categories)}
+                y = np.array([float(self._code(lookup, v, self.label))
+                              for v in y])
+            else:
+                y = np.asarray(y, dtype=np.float64)
+            out = out.with_column(self.get("labelCol"), y)
+        return out
+
+    def _save_data(self, path):
+        import json
+        import os
+        with open(os.path.join(path, "formula.json"), "w") as fh:
+            # categories are already str labels (see _freq_order), so JSON
+            # round-trips them without changing lookup behavior
+            json.dump({"terms": self.terms, "label": self.label,
+                       "categories": self.categories,
+                       "label_categories": self.label_categories}, fh)
+
+    def _load_data(self, path, meta):
+        import json
+        import os
+        with open(os.path.join(path, "formula.json")) as fh:
+            d = json.load(fh)
+        self.terms = d["terms"]
+        self.label = d["label"]
+        self.categories = d["categories"]
+        self.label_categories = d["label_categories"]
+
+
+class SQLTransformer:
+    """(ref SQLTransformer.scala) — ``SELECT ... FROM __THIS__`` over the
+    frame via the built-in SQL engine. Vector (2-D) columns ride through
+    projections as object arrays; SQL expressions apply to scalar columns."""
+
+    def __init__(self, uid=None, statement: str = "", **kw):
+        self.uid = uid or f"SQLTransformer_{id(self):x}"
+        self.statement = statement or kw.get("statement", "")
+
+    def transform(self, frame: MLFrame) -> MLFrame:
+        from cycloneml_tpu.sql.session import CycloneSession
+        session = CycloneSession()
+        batch = {}
+        vector_cols = {}
+        for c in frame.columns:
+            arr = frame[c]
+            if arr.ndim == 2:  # vector column → opaque object rows
+                obj = np.empty(arr.shape[0], dtype=object)
+                for i in range(arr.shape[0]):
+                    obj[i] = arr[i]
+                batch[c] = obj
+                vector_cols[c] = arr
+            else:
+                batch[c] = arr
+        df = session.create_data_frame(batch)
+        # the placeholder IS the temp-view name — no textual substitution
+        session.register_temp_view("__THIS__", df)
+        result = session.sql(self.statement).to_dict()
+        cols: Dict[str, np.ndarray] = {}
+        for name, arr in result.items():
+            if name in vector_cols and arr.dtype == object and len(arr) \
+                    and isinstance(arr[0], np.ndarray):
+                cols[name] = np.stack(arr)
+            else:
+                cols[name] = arr
+        return MLFrame(frame.ctx, cols)

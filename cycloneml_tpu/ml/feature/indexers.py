@@ -19,6 +19,22 @@ from cycloneml_tpu.ml.param import ParamValidators as V
 from cycloneml_tpu.ml.util_io import MLReadable, MLWritable, load_arrays, save_arrays
 
 
+def ordered_labels(col, order: str = "frequencyDesc"):
+    """Category ordering shared by StringIndexer and RFormula (ref:
+    StringIndexer.scala stringOrderType — frequencyDesc ties break
+    lexicographically)."""
+    uniq, counts = np.unique(col, return_counts=True)
+    if order == "frequencyDesc":
+        idx = np.lexsort((uniq, -counts))
+    elif order == "frequencyAsc":
+        idx = np.lexsort((uniq, counts))
+    elif order == "alphabetAsc":
+        idx = np.argsort(uniq)
+    else:
+        idx = np.argsort(uniq)[::-1]
+    return [str(u) for u in uniq[idx]]
+
+
 class StringIndexer(Estimator, _InOutCol, MLWritable, MLReadable):
     """Map strings to indices by descending frequency (ref StringIndexer.scala;
     orderType variants supported)."""
@@ -38,17 +54,7 @@ class StringIndexer(Estimator, _InOutCol, MLWritable, MLReadable):
 
     def _fit(self, frame) -> "StringIndexerModel":
         col = [str(v) for v in frame[self.get("inputCol")]]
-        uniq, counts = np.unique(col, return_counts=True)
-        order = self.get("stringOrderType")
-        if order == "frequencyDesc":
-            idx = np.lexsort((uniq, -counts))
-        elif order == "frequencyAsc":
-            idx = np.lexsort((uniq, counts))
-        elif order == "alphabetAsc":
-            idx = np.argsort(uniq)
-        else:
-            idx = np.argsort(uniq)[::-1]
-        labels = [str(u) for u in uniq[idx]]
+        labels = ordered_labels(col, self.get("stringOrderType"))
         m = StringIndexerModel(labels, uid=self.uid)
         self._copy_values(m)
         return m._set_parent(self)

@@ -185,7 +185,10 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
     if latest is not None:
         if fingerprint is not None:
             saved = checkpointer.metadata(latest).get("fingerprint")
-            if saved is not None and saved != fingerprint:
+            if saved != fingerprint:
+                # missing (None) counts as a mismatch too: a dir written
+                # without fingerprints is unverifiable, and resuming foreign
+                # state silently returns the wrong model
                 raise ValueError(
                     f"checkpoint dir {checkpointer.directory!r} holds state "
                     f"for a DIFFERENT training run (fingerprint {saved} != "

@@ -53,12 +53,18 @@ class KafkaSource(Source):
         self._base = 0  # engine offset of _rows[0]
 
     def _decode(self, v):
+        """decode=True asserts a text topic: column type is then uniformly
+        str. Binary protocols must set decode=False (uniform bytes) — a
+        per-message fallback would yield a content-dependent str/bytes mix
+        that corrupts downstream deserializers."""
         if not (self.decode and isinstance(v, bytes)):
             return v
         try:
             return v.decode()
-        except UnicodeDecodeError:
-            return v  # non-text payload (avro/protobuf): stay binary
+        except UnicodeDecodeError as e:
+            raise ValueError(
+                f"topic {self.topic!r} carries non-UTF8 payloads; construct "
+                "KafkaSource(..., decode=False) for binary data") from e
 
     def _poll(self) -> None:
         records = self._consumer.poll(timeout_ms=self.poll_timeout_ms)

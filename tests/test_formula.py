@@ -1,0 +1,115 @@
+"""RFormula + SQLTransformer tests (ref: RFormulaSuite, SQLTransformerSuite
+— the reference's suites assert dummy-coded features against R)."""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.feature import RFormula, RFormulaModel, SQLTransformer
+
+
+@pytest.fixture
+def frame(ctx):
+    return MLFrame(ctx, {
+        "y": np.array([1.0, 0.0, 1.0, 0.0]),
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([10.0, 20.0, 30.0, 40.0]),
+        "s": np.array(["x", "y", "x", "z"], dtype=object),
+    })
+
+
+def test_rformula_numeric_terms(frame, ctx):
+    model = RFormula(formula="y ~ a + b").fit(frame)
+    out = model.transform(frame)
+    np.testing.assert_allclose(out["features"],
+                               np.column_stack([frame["a"], frame["b"]]))
+    np.testing.assert_allclose(out["label"], frame["y"])
+
+
+def test_rformula_dot_and_exclusion(frame, ctx):
+    model = RFormula(formula="y ~ . - s").fit(frame)
+    out = model.transform(frame)
+    assert out["features"].shape == (4, 2)  # a, b; s excluded, y is label
+
+
+def test_rformula_string_dummy_coding(frame, ctx):
+    """String columns one-hot with the LAST category dropped (R dummy
+    coding; category order = frequency desc, ties lexicographic)."""
+    model = RFormula(formula="y ~ s").fit(frame)
+    out = model.transform(frame)
+    # counts: x=2, y=1, z=1 → order [x, y, z]; dropped category = z
+    feats = out["features"]
+    assert feats.shape == (4, 2)
+    np.testing.assert_allclose(feats[0], [1.0, 0.0])  # x
+    np.testing.assert_allclose(feats[1], [0.0, 1.0])  # y
+    np.testing.assert_allclose(feats[3], [0.0, 0.0])  # z (dropped)
+
+
+def test_rformula_interaction(frame, ctx):
+    model = RFormula(formula="y ~ a:b").fit(frame)
+    out = model.transform(frame)
+    np.testing.assert_allclose(out["features"][:, 0], frame["a"] * frame["b"])
+
+
+def test_rformula_string_label(ctx):
+    frame = MLFrame(ctx, {"cls": np.array(["pos", "neg", "pos"], dtype=object),
+                          "v": np.array([1.0, 2.0, 3.0])})
+    model = RFormula(formula="cls ~ v").fit(frame)
+    out = model.transform(frame)
+    # pos is more frequent → index 0
+    np.testing.assert_allclose(out["label"], [0.0, 1.0, 0.0])
+
+
+def test_rformula_persistence(frame, ctx, tmp_path):
+    model = RFormula(formula="y ~ a + s").fit(frame)
+    path = str(tmp_path / "rf")
+    model.save(path)
+    back = RFormulaModel.load(path)
+    np.testing.assert_allclose(back.transform(frame)["features"],
+                               model.transform(frame)["features"])
+
+
+def test_rformula_rejects_unsupported_operators(frame, ctx):
+    with pytest.raises(ValueError, match="unsupported formula operator"):
+        RFormula(formula="y ~ a*b").fit(frame)
+    with pytest.raises(ValueError, match="no terms"):
+        RFormula(formula="y ~ ").fit(frame)
+
+
+def test_rformula_unseen_category_errors(frame, ctx):
+    model = RFormula(formula="y ~ s").fit(frame)
+    bad = MLFrame(ctx, {"y": np.array([1.0]),
+                        "s": np.array(["never-seen"], dtype=object)})
+    with pytest.raises(ValueError, match="unseen at fit time"):
+        model.transform(bad)
+
+
+def test_rformula_nonstring_categories_survive_persistence(ctx, tmp_path):
+    """Object columns holding non-str values (ints) must encode identically
+    before and after save/load (categories are canonical str labels)."""
+    frame = MLFrame(ctx, {"y": np.array([1.0, 0.0, 1.0]),
+                          "c": np.array([10, 20, 10], dtype=object)})
+    model = RFormula(formula="y ~ c").fit(frame)
+    before = model.transform(frame)["features"]
+    path = str(tmp_path / "rf")
+    model.save(path)
+    after = RFormulaModel.load(path).transform(frame)["features"]
+    np.testing.assert_allclose(before, after)
+
+
+def test_sql_transformer_scalar(frame, ctx):
+    t = SQLTransformer(statement="SELECT a, b, a + b AS ab FROM __THIS__ "
+                                 "WHERE a > 1")
+    out = t.transform(frame)
+    assert out.columns == ["a", "b", "ab"]
+    np.testing.assert_allclose(out["ab"], [22.0, 33.0, 44.0])
+
+
+def test_sql_transformer_vector_passthrough(ctx):
+    frame = MLFrame(ctx, {"features": np.arange(8.0).reshape(4, 2),
+                          "v": np.array([1.0, 2.0, 3.0, 4.0])})
+    t = SQLTransformer(statement="SELECT features, v * 10 AS v10 "
+                                 "FROM __THIS__")
+    out = t.transform(frame)
+    assert out["features"].shape == (4, 2)  # 2-D column survives projection
+    np.testing.assert_allclose(out["v10"], [10.0, 20.0, 30.0, 40.0])

@@ -71,16 +71,25 @@ def test_kafka_replay_buffer_before_commit():
     assert src.get_batch(end, end2)["value"].tolist() == ["3"]
 
 
-def test_kafka_binary_payloads_survive():
-    """Non-UTF8 payloads (avro/protobuf) must not kill the source."""
+def test_kafka_binary_payloads_need_decode_false():
+    """decode=True asserts a text topic: binary payloads raise a clear
+    configuration error; decode=False gives uniform bytes (never a
+    content-dependent str/bytes mix)."""
     consumer = FakeConsumer()
     src = KafkaSource("t", consumer_factory=lambda: consumer)
     consumer.feed(_rec(b"\x93\xff", b"\x00\x01\xfe", 0))
-    end = src.latest_offset()
-    batch = src.get_batch(0, end)
-    assert batch["value"][0] == b"\x00\x01\xfe"  # kept as bytes
+    with pytest.raises(ValueError, match="decode=False"):
+        src.latest_offset()
+
+    consumer2 = FakeConsumer()
+    src2 = KafkaSource("t", consumer_factory=lambda: consumer2, decode=False)
+    # a payload that HAPPENS to be valid UTF-8 still stays bytes
+    consumer2.feed(_rec(b"k", b"\x0a\x03abc", 0), _rec(b"k", b"\x00\xfe", 1))
+    end = src2.latest_offset()
+    batch = src2.get_batch(0, end)
+    assert all(isinstance(v, bytes) for v in batch["value"])
     # empty batches keep int64 schema for the numeric columns
-    empty = src.get_batch(end, end)
+    empty = src2.get_batch(end, end)
     for c in ("partition", "offset", "timestamp"):
         assert empty[c].dtype == np.int64 and len(empty[c]) == 0
 
