@@ -37,6 +37,7 @@ REPLICA_AXIS = "replica"
 MODEL_AXIS = "model"
 
 _LOCAL_MESH_RE = re.compile(r"local-mesh\[(\d+|\*)\]")
+_MULTIHOST_RE = re.compile(r"multihost\[([^,\]]+),(\d+),(\d+)\]")
 
 
 class MeshRuntime:
@@ -82,7 +83,19 @@ class MeshRuntime:
                 devices = devices[:want_n]
             return devices
         if master == "multihost":
-            jax.distributed.initialize()
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize()  # env/cloud auto-detection
+            return jax.devices()
+        m = _MULTIHOST_RE.fullmatch(master)
+        if m is not None:
+            # explicit form for local-cluster-style testing and bare-metal
+            # pods: multihost[<coordinator host:port>,<num_procs>,<proc_id>]
+            # (≈ the reference's local-cluster[n,c,m] master,
+            # SparkContext.scala:3058 — real separate processes, one mesh)
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize(coordinator_address=m.group(1),
+                                           num_processes=int(m.group(2)),
+                                           process_id=int(m.group(3)))
             return jax.devices()
         if master == "tpu":
             try:

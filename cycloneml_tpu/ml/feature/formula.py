@@ -38,25 +38,29 @@ def _parse_formula(formula: str) -> Tuple[str, List[str], List[str]]:
     label = lhs.strip()
     include: List[str] = []
     exclude: List[str] = []
-    # split on +/- at top level, tracking sign; anything the tokenizer does
-    # not consume (R operators like '*', '^', '(') must be REJECTED — R's
-    # a*b means a + b + a:b, and silently dropping the '*' would train on
+    # strict scanner: term, then (+|- term)* — anything else (R operators
+    # like '*', '^', '(', or two terms with no operator) must be REJECTED:
+    # R's a*b means a + b + a:b, and silently reinterpreting would train on
     # the wrong design matrix
-    consumed = 0
-    for m in re.finditer(r"([+-]?)\s*([\w.]+(?::[\w.]+)*)\s*", rhs):
-        residue = rhs[consumed:m.start()].strip()
-        if residue:
-            raise ValueError(
-                f"unsupported formula operator {residue!r} in {formula!r} "
-                "(supported: '+', '-', ':', '.')")
-        consumed = m.end()
-        sign, term = m.group(1), m.group(2).strip()
+    term_re = r"[\w.]+(?::[\w.]+)*"
+    pos = 0
+    first = True
+    while pos < len(rhs):
+        pat = (rf"\s*(?:([+-])\s*)?({term_re})" if first
+               else rf"\s*([+-])\s*({term_re})")
+        m = re.match(pat, rhs[pos:])
+        if m is None:
+            break
+        sign, term = m.group(1) or "+", m.group(2)
         (exclude if sign == "-" else include).append(term)
-    residue = rhs[consumed:].strip()
+        pos += m.end()
+        first = False
+    residue = rhs[pos:].strip()
     if residue:
         raise ValueError(
-            f"unsupported formula operator {residue!r} in {formula!r} "
-            "(supported: '+', '-', ':', '.')")
+            f"unsupported formula syntax at {residue!r} in {formula!r} "
+            "(supported: terms joined by '+' or '-', interactions 'a:b', "
+            "and '.')")
     if not include:
         raise ValueError(f"formula has no terms: {formula!r}")
     return label, include, exclude
@@ -194,20 +198,28 @@ class RFormulaModel(Model, MLWritable, MLReadable):
         self.label_categories = d["label_categories"]
 
 
-class SQLTransformer:
-    """(ref SQLTransformer.scala) — ``SELECT ... FROM __THIS__`` over the
-    frame via the built-in SQL engine. Vector (2-D) columns ride through
-    projections as object arrays; SQL expressions apply to scalar columns."""
+from cycloneml_tpu.ml.base import Transformer  # noqa: E402 — after Estimator
 
-    def __init__(self, uid=None, statement: str = "", **kw):
-        self.uid = uid or f"SQLTransformer_{id(self):x}"
-        self.statement = statement or kw.get("statement", "")
 
-    def transform(self, frame: MLFrame) -> MLFrame:
+class SQLTransformer(Transformer, MLWritable, MLReadable):
+    """(ref SQLTransformer.scala — extends Transformer so it composes in
+    pipelines and persists) — ``SELECT ... FROM __THIS__`` over the frame
+    via the built-in SQL engine. Vector (2-D) columns ride through
+    projections (aliased or not) as object rows re-stacked on the way out;
+    SQL expressions apply to scalar columns."""
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.statement = self._param("statement", "SQL statement with the "
+                                     "__THIS__ placeholder", default="")
+        for k, v in kw.items():
+            self.set(k, v)
+
+    def _transform(self, frame: MLFrame) -> MLFrame:
         from cycloneml_tpu.sql.session import CycloneSession
         session = CycloneSession()
         batch = {}
-        vector_cols = {}
+        vector_widths = {}
         for c in frame.columns:
             arr = frame[c]
             if arr.ndim == 2:  # vector column → opaque object rows
@@ -215,18 +227,20 @@ class SQLTransformer:
                 for i in range(arr.shape[0]):
                     obj[i] = arr[i]
                 batch[c] = obj
-                vector_cols[c] = arr
+                vector_widths[c] = arr.shape[1]
             else:
                 batch[c] = arr
         df = session.create_data_frame(batch)
         # the placeholder IS the temp-view name — no textual substitution
         session.register_temp_view("__THIS__", df)
-        result = session.sql(self.statement).to_dict()
+        result = session.sql(self.get("statement")).to_dict()
         cols: Dict[str, np.ndarray] = {}
         for name, arr in result.items():
-            if name in vector_cols and arr.dtype == object and len(arr) \
+            if arr.dtype == object and len(arr) \
                     and isinstance(arr[0], np.ndarray):
-                cols[name] = np.stack(arr)
+                cols[name] = np.stack(arr)  # any vector projection, aliased too
+            elif len(arr) == 0 and name in vector_widths:
+                cols[name] = np.zeros((0, vector_widths[name]))
             else:
                 cols[name] = arr
         return MLFrame(frame.ctx, cols)

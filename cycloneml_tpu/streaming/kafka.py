@@ -32,10 +32,15 @@ class KafkaSource(Source):
     def __init__(self, topic: str,
                  bootstrap_servers: str = "localhost:9092",
                  consumer_factory: Optional[Callable] = None,
-                 poll_timeout_ms: int = 200, decode: bool = True):
+                 poll_timeout_ms: int = 200, decode: bool = True,
+                 decode_key: bool = False):
         self.topic = topic
         self.poll_timeout_ms = poll_timeout_ms
-        self.decode = decode  # False = binary key/value (ref exposes binary)
+        # per-FIELD contracts (the reference exposes key and value as
+        # independently-castable binary): values decode by default (text
+        # topics), keys stay opaque bytes by default (hashed ids etc.)
+        self.decode = decode
+        self.decode_key = decode_key
         if consumer_factory is not None:
             self._consumer = consumer_factory()
         else:
@@ -52,26 +57,28 @@ class KafkaSource(Source):
         self._rows: List[tuple] = []  # replay buffer of consumed rows
         self._base = 0  # engine offset of _rows[0]
 
-    def _decode(self, v):
-        """decode=True asserts a text topic: column type is then uniformly
-        str. Binary protocols must set decode=False (uniform bytes) — a
-        per-message fallback would yield a content-dependent str/bytes mix
-        that corrupts downstream deserializers."""
-        if not (self.decode and isinstance(v, bytes)):
+    def _decode(self, v, enabled: bool, field: str):
+        """An enabled field asserts text: its column type is then uniformly
+        str. Binary fields keep uniform bytes — a per-message fallback would
+        yield a content-dependent str/bytes mix that corrupts downstream
+        deserializers."""
+        if not (enabled and isinstance(v, bytes)):
             return v
         try:
             return v.decode()
         except UnicodeDecodeError as e:
+            flag = "decode_key" if field == "key" else "decode"
             raise ValueError(
-                f"topic {self.topic!r} carries non-UTF8 payloads; construct "
-                "KafkaSource(..., decode=False) for binary data") from e
+                f"topic {self.topic!r} carries non-UTF8 {field}s; construct "
+                f"KafkaSource(..., {flag}=False) for binary data") from e
 
     def _poll(self) -> None:
         records = self._consumer.poll(timeout_ms=self.poll_timeout_ms)
         for batch in records.values():
             for r in batch:
                 self._rows.append((
-                    self._decode(r.key), self._decode(r.value),
+                    self._decode(r.key, self.decode_key, "key"),
+                    self._decode(r.value, self.decode, "value"),
                     getattr(r, "topic", self.topic),
                     getattr(r, "partition", 0),
                     getattr(r, "offset", 0),

@@ -70,10 +70,13 @@ def test_rformula_persistence(frame, ctx, tmp_path):
 
 
 def test_rformula_rejects_unsupported_operators(frame, ctx):
-    with pytest.raises(ValueError, match="unsupported formula operator"):
+    with pytest.raises(ValueError, match="unsupported formula"):
         RFormula(formula="y ~ a*b").fit(frame)
     with pytest.raises(ValueError, match="no terms"):
         RFormula(formula="y ~ ").fit(frame)
+    # adjacent terms with no operator (typo for a:b / a+b) must also fail
+    with pytest.raises(ValueError, match="unsupported formula"):
+        RFormula(formula="y ~ a b").fit(frame)
 
 
 def test_rformula_unseen_category_errors(frame, ctx):
@@ -113,3 +116,33 @@ def test_sql_transformer_vector_passthrough(ctx):
     out = t.transform(frame)
     assert out["features"].shape == (4, 2)  # 2-D column survives projection
     np.testing.assert_allclose(out["v10"], [10.0, 20.0, 30.0, 40.0])
+    # aliased vector projections re-stack too
+    t2 = SQLTransformer(statement="SELECT features AS f FROM __THIS__")
+    assert t2.transform(frame)["f"].shape == (4, 2)
+    # filtering away every row keeps the (0, k) vector shape
+    t3 = SQLTransformer(statement="SELECT features FROM __THIS__ "
+                                  "WHERE v > 99")
+    assert t3.transform(frame)["features"].shape == (0, 2)
+
+
+def test_sql_transformer_in_pipeline(ctx, tmp_path):
+    """(ref SQLTransformer extends Transformer for exactly this)"""
+    from cycloneml_tpu.ml.base import Pipeline, PipelineModel
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    rng = np.random.RandomState(0)
+    frame = MLFrame(ctx, {"a": rng.randn(100), "b": rng.randn(100),
+                          "label": (rng.rand(100) > 0.5).astype(float)})
+    pipe = Pipeline(stages=[
+        SQLTransformer(statement="SELECT a, b, a * b AS ab, label "
+                                 "FROM __THIS__"),
+        RFormula(formula="label ~ a + b + ab"),
+        LogisticRegression(maxIter=5),
+    ])
+    model = pipe.fit(frame)
+    out = model.transform(frame)
+    assert out["features"].shape == (100, 3)
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    reloaded = PipelineModel.load(path)
+    np.testing.assert_allclose(reloaded.transform(frame)["prediction"],
+                               out["prediction"])

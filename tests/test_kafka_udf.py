@@ -71,13 +71,19 @@ def test_kafka_replay_buffer_before_commit():
     assert src.get_batch(end, end2)["value"].tolist() == ["3"]
 
 
-def test_kafka_binary_payloads_need_decode_false():
-    """decode=True asserts a text topic: binary payloads raise a clear
-    configuration error; decode=False gives uniform bytes (never a
-    content-dependent str/bytes mix)."""
+def test_kafka_field_decode_contracts():
+    """Keys are opaque bytes by default (hashed ids); values decode by
+    default (text topics). Each field's type is uniform per configuration,
+    never a content-dependent str/bytes mix."""
     consumer = FakeConsumer()
     src = KafkaSource("t", consumer_factory=lambda: consumer)
-    consumer.feed(_rec(b"\x93\xff", b"\x00\x01\xfe", 0))
+    consumer.feed(_rec(b"\x93\xff", b"hello", 0))  # binary key + text value
+    end = src.latest_offset()
+    batch = src.get_batch(0, end)
+    assert batch["key"][0] == b"\x93\xff" and batch["value"][0] == "hello"
+
+    # binary VALUES under decode=True are a configuration error
+    consumer.feed(_rec(b"k", b"\x00\x01\xfe", 1))
     with pytest.raises(ValueError, match="decode=False"):
         src.latest_offset()
 
