@@ -233,14 +233,36 @@ class SQLTransformer(Transformer, MLWritable, MLReadable):
         df = session.create_data_frame(batch)
         # the placeholder IS the temp-view name — no textual substitution
         session.register_temp_view("__THIS__", df)
-        result = session.sql(self.get("statement")).to_dict()
+        out_df = session.sql(self.get("statement"))
+        # map OUTPUT names (including aliases of plain vector projections)
+        # back to source widths so empty results keep their (0, k) shape
+        out_widths = dict(vector_widths)
+        for name, src in _projection_sources(out_df.plan).items():
+            if src in vector_widths:
+                out_widths[name] = vector_widths[src]
+        result = out_df.to_dict()
         cols: Dict[str, np.ndarray] = {}
         for name, arr in result.items():
             if arr.dtype == object and len(arr) \
                     and isinstance(arr[0], np.ndarray):
                 cols[name] = np.stack(arr)  # any vector projection, aliased too
-            elif len(arr) == 0 and name in vector_widths:
-                cols[name] = np.zeros((0, vector_widths[name]))
+            elif len(arr) == 0 and name in out_widths:
+                cols[name] = np.zeros((0, out_widths[name]))
             else:
                 cols[name] = arr
         return MLFrame(frame.ctx, cols)
+
+
+def _projection_sources(plan) -> Dict[str, str]:
+    """output column name → source column name for plain (possibly aliased)
+    column projections anywhere in the plan tree."""
+    from cycloneml_tpu.sql.column import Alias, ColumnRef
+    out: Dict[str, str] = {}
+    for e in getattr(plan, "exprs", []) or []:
+        base = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(base, ColumnRef):
+            out[e.name_hint()] = base.name
+    for c in plan.children:
+        for name, src in _projection_sources(c).items():
+            out.setdefault(name, src)
+    return out

@@ -598,6 +598,11 @@ class Column:
     def alias(self, name: str) -> "Column":
         return Column(Alias(self.expr, name))
 
+    def over(self, spec) -> "Column":
+        """Bind to a window spec (ref Column.over): ``F.sum("v").over(w)``."""
+        from cycloneml_tpu.sql.window import over as _over
+        return _over(self, spec)
+
     def cast(self, to: str) -> "Column":
         return Column(Cast(self.expr, to))
 

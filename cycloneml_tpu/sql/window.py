@@ -1,0 +1,327 @@
+"""SQL window (analytic) functions.
+
+Analog of the reference's window-function stack (ref: sql/core/.../execution/
+window/WindowExec.scala + catalyst windowExpressions.scala; API surface
+pyspark.sql.Window / Column.over). The reference sorts each partition and
+streams frames; here partitions factorize to codes and every function is a
+vectorized pass over the ordered batch — the host tier's columnar idiom.
+
+Frames follow the reference's defaults: an aggregate over a window WITH an
+ORDER BY uses the running frame (unbounded preceding → current row, with
+RANGE semantics: peers by order key share a value); without ORDER BY it uses
+the whole partition.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.sql.column import (AggExpr, Alias, Column, ColumnRef, Expr,
+                                      SortOrder, _batch_len)
+from cycloneml_tpu.sql.plan import _factorize
+
+
+class WindowSpec:
+    """(ref pyspark.sql.Window) — ``Window.partition_by("k").order_by("t")``."""
+
+    def __init__(self, partition_exprs: Optional[List[Expr]] = None,
+                 order: Optional[List[SortOrder]] = None):
+        self.partition_exprs = partition_exprs or []
+        self.order = order or []
+
+    @staticmethod
+    def _exprs(cols) -> List[Expr]:
+        out = []
+        for c in cols:
+            out.append(ColumnRef(c) if isinstance(c, str) else c.expr)
+        return out
+
+    def partition_by(self, *cols) -> "WindowSpec":
+        return WindowSpec(self.partition_exprs + self._exprs(cols),
+                          list(self.order))
+
+    def order_by(self, *cols) -> "WindowSpec":
+        orders = []
+        for c in cols:
+            if isinstance(c, str):
+                orders.append(SortOrder(ColumnRef(c)))
+            elif isinstance(c.expr, SortOrder):
+                orders.append(c.expr)
+            else:
+                orders.append(SortOrder(c.expr))
+        return WindowSpec(list(self.partition_exprs), self.order + orders)
+
+
+class Window:
+    @staticmethod
+    def partition_by(*cols) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols) -> WindowSpec:
+        return WindowSpec().order_by(*cols)
+
+    orderBy = order_by
+
+
+class WindowFnExpr(Expr):
+    """A window function bound to a spec; evaluates against the WHOLE batch
+    (window functions are the one expression kind that needs global row
+    context, which is why the reference plans a dedicated WindowExec)."""
+
+    def __init__(self, fn: str, spec: WindowSpec,
+                 child: Optional[Expr] = None, args: tuple = ()):
+        self.fn = fn
+        self.spec = spec
+        self.children = [child] if child is not None else []
+        self.args = args
+
+    def with_children(self, c):
+        return WindowFnExpr(self.fn, self.spec, c[0] if c else None,
+                            self.args)
+
+    def references(self) -> set:
+        """Partition/order columns live in the spec, not children — without
+        them column pruning would drop the very columns the window needs."""
+        out = super().references()
+        for e in self.spec.partition_exprs:
+            out |= e.references()
+        for so in self.spec.order:
+            out |= so.references()
+        return out
+
+    def name_hint(self):
+        return f"{self.fn}() OVER (...)"
+
+    def __str__(self):
+        return self.name_hint()
+
+    # -- evaluation -------------------------------------------------------------
+    def _partition_codes(self, batch, n):
+        if not self.spec.partition_exprs:
+            return np.zeros(n, dtype=np.int64), 1
+        keys = [np.atleast_1d(e.eval(batch)) for e in self.spec.partition_exprs]
+        codes, n_groups, _ = _factorize(keys)
+        return codes, n_groups
+
+    def _order_within(self, batch, codes, n):
+        """Stable order: partition, then the ORDER BY keys."""
+        keys: List[np.ndarray] = []
+        for so in reversed(self.spec.order):
+            k = np.atleast_1d(so.children[0].eval(batch))
+            if not so.ascending:
+                k = _invert_for_sort(k)
+            keys.append(k)
+        keys.append(codes)
+        return np.lexsort(keys)
+
+    def eval(self, batch):
+        n = _batch_len(batch)
+        if n == 0:
+            return np.array([])
+        codes, _ = self._partition_codes(batch, n)
+        perm = self._order_within(batch, codes, n)  # sorted row ids
+        sorted_codes = codes[perm]
+        # first index of each partition run in sorted order
+        starts = np.zeros(n, dtype=bool)
+        starts[0] = True
+        starts[1:] = sorted_codes[1:] != sorted_codes[:-1]
+        part_start_idx = np.maximum.accumulate(np.where(starts,
+                                                        np.arange(n), 0))
+        pos_in_part = np.arange(n) - part_start_idx  # 0-based row number
+
+        if self.spec.order:
+            order_keys = [np.atleast_1d(so.children[0].eval(batch))[perm]
+                          for so in self.spec.order]
+            new_peer = np.zeros(n, dtype=bool)
+            new_peer[0] = True
+            for k in order_keys:
+                new_peer[1:] |= k[1:] != k[:-1]
+            new_peer |= starts
+        else:
+            new_peer = starts.copy()
+
+        out_sorted = self._compute(batch, perm, starts, part_start_idx,
+                                   pos_in_part, new_peer, sorted_codes, n)
+        out = np.empty_like(np.asarray(out_sorted))
+        out[perm] = out_sorted
+        return out
+
+    def _compute(self, batch, perm, starts, part_start_idx, pos_in_part,
+                 new_peer, sorted_codes, n):
+        fn = self.fn
+        if fn == "row_number":
+            return pos_in_part + 1
+        if fn == "rank":
+            # rank = position of the first peer in the partition + 1
+            peer_first = np.maximum.accumulate(
+                np.where(new_peer, np.arange(n), 0))
+            return peer_first - part_start_idx + 1
+        if fn == "dense_rank":
+            # count of peer-group changes since partition start
+            group_no = np.cumsum(new_peer)
+            start_group = np.maximum.accumulate(
+                np.where(starts, np.cumsum(new_peer), 0))
+            return group_no - start_group + 1
+        if fn == "percent_rank":
+            part_sizes = np.bincount(sorted_codes)[sorted_codes]
+            peer_first = np.maximum.accumulate(
+                np.where(new_peer, np.arange(n), 0))
+            rank = peer_first - part_start_idx + 1
+            return np.where(part_sizes > 1,
+                            (rank - 1) / np.maximum(part_sizes - 1, 1), 0.0)
+        if fn == "cume_dist":
+            # rows ≤ current peer group / partition size
+            part_sizes = np.bincount(sorted_codes)[sorted_codes]
+            last_of_peer = np.zeros(n, dtype=bool)
+            last_of_peer[:-1] = new_peer[1:]
+            last_of_peer[-1] = True
+            peer_last_pos = _bfill(np.where(last_of_peer,
+                                            pos_in_part.astype(float),
+                                            np.nan))
+            return (peer_last_pos + 1) / part_sizes
+        if fn == "ntile":
+            buckets = int(self.args[0])
+            s = np.bincount(sorted_codes)[sorted_codes]
+            small = s // buckets
+            big = s % buckets  # first `big` buckets get one extra row
+            cutoff = big * (small + 1)
+            r = pos_in_part
+            return np.where(
+                r < cutoff,
+                r // np.maximum(small + 1, 1) + 1,
+                big + (r - cutoff) // np.maximum(small, 1) + 1
+            ).astype(np.int64)
+        if fn in ("lag", "lead"):
+            offset = self.args[0] if self.args else 1
+            default = self.args[1] if len(self.args) > 1 else np.nan
+            vals = np.atleast_1d(self.children[0].eval(batch))[perm]
+            shift = offset if fn == "lag" else -offset
+            out = np.roll(vals, shift)
+            idx = np.arange(n)
+            src = idx - shift
+            invalid = ((src < part_start_idx)
+                       | (src >= part_start_idx
+                          + np.bincount(sorted_codes)[sorted_codes]))
+            out = out.astype(np.float64) if out.dtype.kind in "if" else out
+            return np.where(invalid, default, out)
+        if isinstance(self._agg(), AggExpr):
+            return self._agg_over(batch, perm, starts, sorted_codes, new_peer, n)
+        raise ValueError(f"unknown window function {self.fn!r}")
+
+    def _agg(self) -> Optional[AggExpr]:
+        if self.children and isinstance(self.children[0], AggExpr):
+            return self.children[0]
+        return None
+
+    def _agg_over(self, batch, perm, starts, sorted_codes, new_peer, n):
+        agg = self._agg()
+        child_vals = (np.atleast_1d(agg.children[0].eval(batch))[perm]
+                      if agg.children else np.ones(n))
+        child_vals = np.asarray(child_vals, dtype=np.float64)
+        if not self.spec.order:
+            # whole-partition frame
+            per_part = agg.agg(child_vals, sorted_codes,
+                               int(sorted_codes.max()) + 1)
+            return np.asarray(per_part, dtype=np.float64)[sorted_codes]
+        # running frame (unbounded preceding → current ROW), then RANGE
+        # semantics: peers (equal order keys) all take the frame value of
+        # their last member — matching the reference's default frame
+        if agg.fn in ("sum", "count", "avg"):
+            vals = child_vals if agg.fn != "count" else np.ones(n)
+            run = np.cumsum(vals)
+            # subtract the running value just before each partition start
+            base = _ffill(np.where(starts, run - vals, np.nan))
+            run = run - base
+            if agg.fn == "avg":
+                run = run / (np.arange(n) - np.maximum.accumulate(
+                    np.where(starts, np.arange(n), 0)) + 1)
+        elif agg.fn in ("min", "max"):
+            # segmented cummin/cummax: vectorized via pandas' C groupby
+            import pandas as pd
+            g = pd.Series(child_vals).groupby(sorted_codes)
+            run = (g.cummin() if agg.fn == "min" else g.cummax()).to_numpy()
+        else:
+            raise ValueError(
+                f"aggregate {agg.fn!r} unsupported over an ordered window")
+        # RANGE frame: propagate the last peer's value backwards over ties
+        last_of_peer = np.zeros(n, dtype=bool)
+        last_of_peer[:-1] = new_peer[1:]
+        last_of_peer[-1] = True
+        peer_val = np.where(last_of_peer, run, np.nan)
+        return _bfill(peer_val)
+
+
+def _invert_for_sort(k: np.ndarray) -> np.ndarray:
+    if k.dtype.kind in "if":
+        return -k.astype(np.float64)
+    # descending for object/string keys: EQUAL values must share a code
+    # (distinct positional ranks would break ties that the next ORDER BY
+    # key should resolve)
+    _, inverse = np.unique(k, return_inverse=True)
+    return -inverse
+
+
+def _ffill(a: np.ndarray) -> np.ndarray:
+    idx = np.where(~np.isnan(a), np.arange(len(a)), 0)
+    np.maximum.accumulate(idx, out=idx)
+    return a[idx]
+
+
+def _bfill(a: np.ndarray) -> np.ndarray:
+    return _ffill(a[::-1])[::-1]
+
+
+# -- API ------------------------------------------------------------------------
+
+def over(column_or_fn, spec: WindowSpec) -> Column:
+    """Bind an expression to a window: ``F.over(F.sum('v'), w)`` or via
+    ``Column.over``."""
+    expr = column_or_fn.expr if isinstance(column_or_fn, Column) else column_or_fn
+    base = expr.children[0] if isinstance(expr, Alias) else expr
+    if isinstance(base, AggExpr):
+        return Column(WindowFnExpr("agg", spec, base))
+    if isinstance(base, WindowFnExpr):
+        return Column(WindowFnExpr(base.fn, spec, base.children[0]
+                                   if base.children else None, base.args))
+    raise ValueError(f"{expr} is not a window function or aggregate")
+
+
+def row_number() -> Column:
+    return Column(WindowFnExpr("row_number", WindowSpec()))
+
+
+def rank() -> Column:
+    return Column(WindowFnExpr("rank", WindowSpec()))
+
+
+def dense_rank() -> Column:
+    return Column(WindowFnExpr("dense_rank", WindowSpec()))
+
+
+def percent_rank() -> Column:
+    return Column(WindowFnExpr("percent_rank", WindowSpec()))
+
+
+def cume_dist() -> Column:
+    return Column(WindowFnExpr("cume_dist", WindowSpec()))
+
+
+def ntile(n: int) -> Column:
+    return Column(WindowFnExpr("ntile", WindowSpec(), args=(n,)))
+
+
+def lag(col, offset: int = 1, default=np.nan) -> Column:
+    c = col if isinstance(col, Column) else Column(ColumnRef(col))
+    return Column(WindowFnExpr("lag", WindowSpec(), c.expr,
+                               (offset, default)))
+
+
+def lead(col, offset: int = 1, default=np.nan) -> Column:
+    c = col if isinstance(col, Column) else Column(ColumnRef(col))
+    return Column(WindowFnExpr("lead", WindowSpec(), c.expr,
+                               (offset, default)))

@@ -119,10 +119,13 @@ def test_sql_transformer_vector_passthrough(ctx):
     # aliased vector projections re-stack too
     t2 = SQLTransformer(statement="SELECT features AS f FROM __THIS__")
     assert t2.transform(frame)["f"].shape == (4, 2)
-    # filtering away every row keeps the (0, k) vector shape
+    # filtering away every row keeps the (0, k) vector shape — aliased too
     t3 = SQLTransformer(statement="SELECT features FROM __THIS__ "
                                   "WHERE v > 99")
     assert t3.transform(frame)["features"].shape == (0, 2)
+    t4 = SQLTransformer(statement="SELECT features AS f FROM __THIS__ "
+                                  "WHERE v > 99")
+    assert t4.transform(frame)["f"].shape == (0, 2)
 
 
 def test_sql_transformer_in_pipeline(ctx, tmp_path):

@@ -1,0 +1,146 @@
+"""SQL window-function tests, cross-checked against pandas groupby idioms
+(the reference's DataFrameWindowFunctionsSuite asserts the same shapes)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.column import col
+from cycloneml_tpu.sql.session import CycloneSession
+from cycloneml_tpu.sql.window import (Window, dense_rank, lag, lead,
+                                      percent_rank, rank, row_number)
+
+
+@pytest.fixture
+def df():
+    return CycloneSession().create_data_frame({
+        "k": ["a", "a", "a", "b", "b"],
+        "t": [3.0, 1.0, 2.0, 2.0, 1.0],
+        "v": [30.0, 10.0, 20.0, 200.0, 100.0],
+    })
+
+
+def _pdf(df):
+    return pd.DataFrame({c: v for c, v in df.to_dict().items()})
+
+
+def test_row_number(df):
+    w = Window.partition_by("k").order_by("t")
+    out = df.with_column("rn", row_number().over(w)).to_dict()
+    pdf = _pdf(df)
+    want = pdf.groupby("k")["t"].rank(method="first").astype(int)
+    np.testing.assert_array_equal(out["rn"], want.to_numpy())
+
+
+def test_rank_and_dense_rank_with_ties():
+    s = CycloneSession()
+    df = s.create_data_frame({"k": ["a"] * 4, "t": [1.0, 2.0, 2.0, 3.0]})
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("r", rank().over(w))
+             .with_column("dr", dense_rank().over(w))
+             .with_column("pr", percent_rank().over(w)).to_dict())
+    np.testing.assert_array_equal(out["r"], [1, 2, 2, 4])
+    np.testing.assert_array_equal(out["dr"], [1, 2, 2, 3])
+    np.testing.assert_allclose(out["pr"], [0.0, 1 / 3, 1 / 3, 1.0])
+
+
+def test_lag_lead(df):
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("prev", lag("v").over(w))
+             .with_column("next", lead("v").over(w))
+             .order_by("k", "t").to_dict())
+    np.testing.assert_allclose(out["prev"], [np.nan, 10.0, 20.0,
+                                             np.nan, 100.0])
+    np.testing.assert_allclose(out["next"], [20.0, 30.0, np.nan,
+                                             200.0, np.nan])
+    out2 = df.with_column("p", lag("v", 1, default=-1.0).over(w)).to_dict()
+    assert -1.0 in out2["p"]
+
+
+def test_running_sum_matches_pandas(df):
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("cum", F.sum("v").over(w))
+             .order_by("k", "t").to_dict())
+    pdf = _pdf(df).sort_values(["k", "t"])
+    want = pdf.groupby("k")["v"].cumsum()
+    np.testing.assert_allclose(out["cum"], want.to_numpy())
+
+
+def test_whole_partition_agg_without_order(df):
+    w = Window.partition_by("k")
+    out = (df.with_column("total", F.sum("v").over(w))
+             .with_column("mx", F.max("v").over(w)).to_dict())
+    np.testing.assert_allclose(out["total"], [60.0, 60.0, 60.0, 300.0, 300.0])
+    np.testing.assert_allclose(out["mx"], [30.0, 30.0, 30.0, 200.0, 200.0])
+
+
+def test_running_min_max_avg(df):
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("mn", F.min("v").over(w))
+             .with_column("av", F.avg("v").over(w))
+             .order_by("k", "t").to_dict())
+    np.testing.assert_allclose(out["mn"], [10.0, 10.0, 10.0, 100.0, 100.0])
+    np.testing.assert_allclose(out["av"], [10.0, 15.0, 20.0, 100.0, 150.0])
+
+
+def test_range_frame_peers_share_value():
+    """Ties on the order key take the frame value of the LAST peer (RANGE
+    default, as the reference)."""
+    s = CycloneSession()
+    df = s.create_data_frame({"k": ["a"] * 3, "t": [1.0, 1.0, 2.0],
+                              "v": [5.0, 7.0, 1.0]})
+    w = Window.partition_by("k").order_by("t")
+    out = df.with_column("cum", F.sum("v").over(w)).to_dict()
+    np.testing.assert_allclose(out["cum"], [12.0, 12.0, 13.0])
+
+
+def test_descending_order_and_global_window():
+    s = CycloneSession()
+    df = s.create_data_frame({"t": [1.0, 3.0, 2.0]})
+    out = df.with_column(
+        "rn", row_number().over(Window.order_by(col("t").desc()))).to_dict()
+    np.testing.assert_array_equal(out["rn"], [3, 1, 2])
+
+
+def test_count_over_window(df):
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("c", F.count("*").over(w))
+             .order_by("k", "t").to_dict())
+    np.testing.assert_array_equal(out["c"], [1, 2, 3, 1, 2])
+
+
+def test_window_in_select_survives_pruning(df):
+    """select() (optimizer prunes columns) must keep partition/order cols
+    referenced only by the window spec."""
+    w = Window.partition_by("k").order_by("t")
+    out = df.select("v", row_number().over(w).alias("rn"))
+    got = out.order_by("rn").collect()
+    assert [r.rn for r in got][:3] == [1, 1, 2]
+
+
+def test_descending_string_ties_fall_through(df):
+    """Equal string keys under desc() must tie-break to the NEXT order key,
+    not freeze in reversed input order."""
+    s = CycloneSession()
+    d = s.create_data_frame({"g": ["p", "p"], "name": ["b", "b"],
+                             "t": [1.0, 2.0]})
+    w = Window.partition_by("g").order_by(col("name").desc(), "t")
+    out = d.with_column("rn", row_number().over(w)).to_dict()
+    np.testing.assert_array_equal(out["rn"], [1, 2])
+
+
+def test_ntile_and_cume_dist():
+    s = CycloneSession()
+    d = s.create_data_frame({"k": ["a"] * 5, "t": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    from cycloneml_tpu.sql.window import cume_dist, ntile
+    w = Window.partition_by("k").order_by("t")
+    out = (d.with_column("n2", ntile(2).over(w))
+             .with_column("cd", cume_dist().over(w)).to_dict())
+    np.testing.assert_array_equal(out["n2"], [1, 1, 1, 2, 2])
+    np.testing.assert_allclose(out["cd"], [0.2, 0.4, 0.6, 0.8, 1.0])
+
+
+def test_non_window_expr_rejected(df):
+    with pytest.raises(ValueError, match="not a window function"):
+        col("v").over(Window.partition_by("k"))
