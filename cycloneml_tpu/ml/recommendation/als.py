@@ -57,6 +57,15 @@ class _ALSParams(HasMaxIter, HasRegParam, HasPredictionCol, HasSeed):
         self.coldStartStrategy = self._param(
             "coldStartStrategy", "nan or drop for unseen ids",
             V.in_array(["nan", "drop"]), default="nan")
+        # the reference's checkpointInterval truncates RDD lineage
+        # (ALS.scala setCheckpointInterval); here it snapshots the factor
+        # matrices so a killed fit resumes mid-training (SURVEY §5.4)
+        self.checkpointDir = self._param(
+            "checkpointDir", "directory for mid-training factor checkpoints",
+            default="")
+        self.checkpointInterval = self._param(
+            "checkpointInterval", "iterations between checkpoints",
+            V.gt(0), default=10)
 
 
 class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
@@ -176,8 +185,38 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         def yty_of(f):
             return jnp.dot(f.T, f, precision=hi)
 
+        ck = None
+        ck_fp = None
+        start_iter = 0
+        if self.get("checkpointDir"):
+            import hashlib
+            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+            ck = TrainingCheckpointer(self.get("checkpointDir"))
+            # bind the dir to this dataset+hyperparameters: resuming foreign
+            # factors silently returns the wrong model (or crashes on shape)
+            ck_fp = hashlib.sha1(repr((
+                rank, n_users, n_items, len(ratings),
+                float(np.sum(ratings)), self.get("implicitPrefs"),
+                self.get("regParam"), self.get("alpha"),
+                self.get("nonnegative"), self.get("seed"),
+            )).encode()).hexdigest()[:16]
+            latest = ck.latest_step()
+            if latest is not None:
+                saved_fp = ck.metadata(latest).get("fingerprint")
+                if saved_fp != ck_fp:
+                    raise ValueError(
+                        f"checkpoint dir {ck.directory!r} holds factors for "
+                        f"a DIFFERENT ALS run (fingerprint {saved_fp} != "
+                        f"{ck_fp}); clear the directory or use a new one")
+                saved = ck.restore(latest)
+                u_fac = jnp.asarray(saved["u_fac"], dtype)
+                i_fac = jnp.asarray(saved["i_fac"], dtype)
+                start_iter = int(saved["iteration"])
+                logger.info("ALS resuming from checkpoint iteration %d",
+                            start_iter)
+
         zero_yty = jnp.zeros((rank, rank), dtype=dtype)
-        for _ in range(self.get("maxIter")):
+        for it in range(start_iter, self.get("maxIter")):
             yty = yty_of(i_fac) if implicit else zero_yty
             out = agg_users(u_dev, i_dev, r_dev, m_dev, i_fac, yty)
             # block per half-step: at most one collective program in flight —
@@ -188,6 +227,12 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
             # swap dst/src: destination = items, source = users
             out = agg_items(i_dev, u_dev, r_dev, m_dev, u_fac, yty)
             i_fac = jax.block_until_ready(solve_items(out, yty))
+            if ck is not None and (it + 1) % self.get("checkpointInterval") == 0 \
+                    and (it + 1) < self.get("maxIter"):
+                ck.save(it + 1, {"u_fac": np.asarray(u_fac),
+                                 "i_fac": np.asarray(i_fac),
+                                 "iteration": it + 1},
+                        metadata={"fingerprint": ck_fp})
 
         return np.asarray(u_fac, dtype=np.float64), np.asarray(i_fac, dtype=np.float64)
 

@@ -51,12 +51,24 @@ def _substitute(e: Expr, mapping) -> Expr:
                        if isinstance(node, ColumnRef) else None)
 
 
+def _contains_window(e: Expr) -> bool:
+    from cycloneml_tpu.sql.window import WindowFnExpr
+    if isinstance(e, WindowFnExpr):
+        return True
+    return any(_contains_window(c) for c in e.children)
+
+
 def push_filter_through_project(plan: LogicalPlan) -> Optional[LogicalPlan]:
     """Filter(Project(c)) → Project(Filter(c)) when the condition only uses
-    columns the project passes through or cheap deterministic exprs."""
+    columns the project passes through or cheap deterministic exprs. NEVER
+    past a window function: filtering first would change the rows the
+    window computes over (ref: PushPredicateThroughNonJoin excludes window
+    projects for the same reason)."""
     if not (isinstance(plan, Filter) and isinstance(plan.children[0], Project)):
         return None
     proj = plan.children[0]
+    if any(_contains_window(e) for e in proj.exprs):
+        return None
     mapping = {}
     for e in proj.exprs:
         mapping[e.name_hint()] = e.children[0] if isinstance(e, Alias) else e

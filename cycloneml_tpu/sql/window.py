@@ -71,28 +71,38 @@ class Window:
 class WindowFnExpr(Expr):
     """A window function bound to a spec; evaluates against the WHOLE batch
     (window functions are the one expression kind that needs global row
-    context, which is why the reference plans a dedicated WindowExec)."""
+    context, which is why the reference plans a dedicated WindowExec).
+
+    The spec's partition/order expressions are stored IN ``children`` (after
+    the optional value child) so the generic Expr machinery — references()
+    for column pruning, transform()/with_children() for optimizer
+    substitution — sees and rewrites them like any other subexpression."""
 
     def __init__(self, fn: str, spec: WindowSpec,
                  child: Optional[Expr] = None, args: tuple = ()):
         self.fn = fn
-        self.spec = spec
-        self.children = [child] if child is not None else []
         self.args = args
+        self._has_child = child is not None
+        self._n_part = len(spec.partition_exprs)
+        self.children = (([child] if child is not None else [])
+                         + list(spec.partition_exprs) + list(spec.order))
+
+    @property
+    def spec(self) -> WindowSpec:
+        off = 1 if self._has_child else 0
+        return WindowSpec(self.children[off:off + self._n_part],
+                          self.children[off + self._n_part:])
+
+    @property
+    def _child(self) -> Optional[Expr]:
+        return self.children[0] if self._has_child else None
 
     def with_children(self, c):
-        return WindowFnExpr(self.fn, self.spec, c[0] if c else None,
+        off = 1 if self._has_child else 0
+        spec = WindowSpec(c[off:off + self._n_part],
+                          c[off + self._n_part:])
+        return WindowFnExpr(self.fn, spec, c[0] if self._has_child else None,
                             self.args)
-
-    def references(self) -> set:
-        """Partition/order columns live in the spec, not children — without
-        them column pruning would drop the very columns the window needs."""
-        out = super().references()
-        for e in self.spec.partition_exprs:
-            out |= e.references()
-        for so in self.spec.order:
-            out |= so.references()
-        return out
 
     def name_hint(self):
         return f"{self.fn}() OVER (...)"
@@ -199,7 +209,7 @@ class WindowFnExpr(Expr):
         if fn in ("lag", "lead"):
             offset = self.args[0] if self.args else 1
             default = self.args[1] if len(self.args) > 1 else np.nan
-            vals = np.atleast_1d(self.children[0].eval(batch))[perm]
+            vals = np.atleast_1d(self._child.eval(batch))[perm]
             shift = offset if fn == "lag" else -offset
             out = np.roll(vals, shift)
             idx = np.arange(n)
@@ -214,20 +224,26 @@ class WindowFnExpr(Expr):
         raise ValueError(f"unknown window function {self.fn!r}")
 
     def _agg(self) -> Optional[AggExpr]:
-        if self.children and isinstance(self.children[0], AggExpr):
-            return self.children[0]
-        return None
+        c = self._child
+        return c if isinstance(c, AggExpr) else None
 
     def _agg_over(self, batch, perm, starts, sorted_codes, new_peer, n):
         agg = self._agg()
         child_vals = (np.atleast_1d(agg.children[0].eval(batch))[perm]
                       if agg.children else np.ones(n))
-        child_vals = np.asarray(child_vals, dtype=np.float64)
+        numeric = child_vals.dtype.kind in "ifb"
+        if numeric:
+            child_vals = np.asarray(child_vals, dtype=np.float64)
         if not self.spec.order:
-            # whole-partition frame
+            # whole-partition frame; AggExpr handles object dtypes itself
+            # (min/max/first over strings work like in groupBy)
             per_part = agg.agg(child_vals, sorted_codes,
                                int(sorted_codes.max()) + 1)
-            return np.asarray(per_part, dtype=np.float64)[sorted_codes]
+            return np.asarray(per_part)[sorted_codes]
+        if not numeric:
+            raise ValueError(
+                f"ordered-window {agg.fn!r} needs a numeric column; use an "
+                "unordered partition window for string min/max")
         # running frame (unbounded preceding → current ROW), then RANGE
         # semantics: peers (equal order keys) all take the frame value of
         # their last member — matching the reference's default frame
@@ -286,8 +302,7 @@ def over(column_or_fn, spec: WindowSpec) -> Column:
     if isinstance(base, AggExpr):
         return Column(WindowFnExpr("agg", spec, base))
     if isinstance(base, WindowFnExpr):
-        return Column(WindowFnExpr(base.fn, spec, base.children[0]
-                                   if base.children else None, base.args))
+        return Column(WindowFnExpr(base.fn, spec, base._child, base.args))
     raise ValueError(f"{expr} is not a window function or aggregate")
 
 

@@ -113,3 +113,38 @@ def test_save_load(ctx, tmp_path):
     o1 = model.transform(frame)["prediction"]
     o2 = back.transform(frame)["prediction"]
     np.testing.assert_allclose(o1, o2)
+
+
+def test_checkpoint_resume_matches_uninterrupted(ctx, tmp_path):
+    """checkpointDir lets a killed fit resume mid-training and land on the
+    uninterrupted run's factors (deterministic seeded solves)."""
+    users, items, r, _, _ = _ratings(seed=3)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    full = ALS(rank=3, maxIter=6, seed=9).fit(frame)
+
+    ck = str(tmp_path / "als-ck")
+    ALS(rank=3, maxIter=2, seed=9, checkpointDir=ck,
+        checkpointInterval=1).fit(frame)
+    resumed = ALS(rank=3, maxIter=6, seed=9, checkpointDir=ck,
+                  checkpointInterval=1).fit(frame)
+    np.testing.assert_allclose(resumed.user_factors, full.user_factors,
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(resumed.item_factors, full.item_factors,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_checkpoint_fingerprint_guards_foreign_resume(ctx, tmp_path):
+    users, items, r, _, _ = _ratings(seed=3)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    ck = str(tmp_path / "ck")
+    ALS(rank=3, maxIter=3, seed=9, checkpointDir=ck,
+        checkpointInterval=1).fit(frame)
+    # different rank on the same dir must refuse, not crash on shapes
+    with pytest.raises(ValueError, match="DIFFERENT ALS run"):
+        ALS(rank=4, maxIter=3, seed=9, checkpointDir=ck,
+            checkpointInterval=1).fit(frame)
+    # different ratings likewise
+    frame2 = MLFrame(ctx, {"user": users, "item": items, "rating": r + 1.0})
+    with pytest.raises(ValueError, match="DIFFERENT ALS run"):
+        ALS(rank=3, maxIter=3, seed=9, checkpointDir=ck,
+            checkpointInterval=1).fit(frame2)

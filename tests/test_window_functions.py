@@ -141,6 +141,39 @@ def test_ntile_and_cume_dist():
     np.testing.assert_allclose(out["cd"], [0.2, 0.4, 0.6, 0.8, 1.0])
 
 
+def test_filter_not_pushed_below_window(df):
+    """A filter above a window projection must NOT push below it — the
+    window computes over the pre-filter rows."""
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("rn", row_number().over(w))
+             .filter(col("v") > 15.0)
+             .order_by("k", "t").to_dict())
+    # a: rows t=2,3 survive with rn computed over ALL three a-rows
+    np.testing.assert_array_equal(out["rn"], [2, 3, 1, 2])
+
+
+def test_window_over_derived_column_survives_collapse(df):
+    """Project-collapse substitution must rewrite exprs INSIDE the window
+    spec (order key derived in a previous with_column)."""
+    w = Window.partition_by("k").order_by("t2")
+    out = (df.with_column("t2", col("t") * -1.0)
+             .with_column("rn", row_number().over(w))
+             .order_by("k", "t").to_dict())
+    # t2 = -t: rank 1 goes to the LARGEST t in each partition; rows are
+    # then displayed sorted by (k, t) ascending
+    np.testing.assert_array_equal(out["rn"], [3, 2, 1, 2, 1])
+
+
+def test_string_min_max_over_partition(df):
+    w = Window.partition_by("k")
+    out = df.with_column("mx", F.max("k").over(w)).to_dict()
+    assert list(out["mx"]) == ["a", "a", "a", "b", "b"]
+    with pytest.raises(ValueError, match="numeric"):
+        df.with_column(
+            "m", F.max("k").over(Window.partition_by("k").order_by("t"))
+        ).to_dict()
+
+
 def test_non_window_expr_rejected(df):
     with pytest.raises(ValueError, match="not a window function"):
         col("v").over(Window.partition_by("k"))
