@@ -1,0 +1,89 @@
+"""PySpark-compatible naming surface.
+
+The goal stated for this framework is that "a user of the reference should
+be able to switch and find everything they need". The native API already
+mirrors the reference's shapes; this module additionally mirrors its NAMES,
+so the canonical PySpark idiom works verbatim:
+
+    from cycloneml_tpu.compat import SparkSession, SparkConf, Window
+    spark = (SparkSession.builder.master("local-mesh[8]")
+             .appName("app").getOrCreate())
+    df = spark.createDataFrame({...})
+    spark.stop()
+
+(ref: python/pyspark/sql/session.py SparkSession.Builder; pyspark.SparkConf/
+SparkContext; pyspark.sql.functions/Window/types).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cycloneml_tpu.conf import APP_NAME, CycloneConf as SparkConf, MASTER
+from cycloneml_tpu.context import CycloneContext as SparkContext
+from cycloneml_tpu.sql import functions  # noqa: F401 — pyspark.sql.functions
+from cycloneml_tpu.sql.column import Column, col, lit  # noqa: F401
+from cycloneml_tpu.sql.session import CycloneSession
+from cycloneml_tpu.sql.window import Window  # noqa: F401
+
+
+class SparkSession(CycloneSession):
+    """CycloneSession with the builder entry point (ref SparkSession.scala:83
+    / pyspark session.py Builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._conf = SparkConf()
+
+        def master(self, m: str) -> "SparkSession.Builder":
+            self._conf.set(MASTER, m)
+            return self
+
+        def appName(self, name: str) -> "SparkSession.Builder":
+            self._conf.set(APP_NAME, name)
+            return self
+
+        app_name = appName
+
+        def config(self, key: str, value) -> "SparkSession.Builder":
+            self._conf.set(key, value)
+            return self
+
+        def getOrCreate(self) -> "SparkSession":
+            ctx = SparkContext.get_or_create(self._conf)
+            return SparkSession(ctx)
+
+        get_or_create = getOrCreate
+
+    builder: "SparkSession.Builder"
+
+    @property
+    def sparkContext(self) -> SparkContext:
+        return self.ctx
+
+    spark_context = sparkContext
+
+    @property
+    def conf(self):
+        return self.ctx.conf
+
+    def stop(self) -> None:
+        if self.ctx is not None:
+            self.ctx.stop()
+
+
+class _BuilderDescriptor:
+    """``SparkSession.builder`` must yield a FRESH builder per access, like
+    the reference's object Builder factory."""
+
+    def __get__(self, obj, objtype=None) -> SparkSession.Builder:
+        return SparkSession.Builder()
+
+
+SparkSession.builder = _BuilderDescriptor()
+
+
+def getActiveSession() -> Optional[SparkSession]:
+    from cycloneml_tpu import context as _c
+    active = _c._active_context
+    return SparkSession(active) if active is not None else None

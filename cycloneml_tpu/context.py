@@ -302,6 +302,14 @@ class CycloneContext:
         logger.info("mesh rebuilt: %d devices", self.mesh_runtime.n_devices)
         return self.mesh_runtime
 
+    def profile(self, log_dir: str):
+        """Capture a device trace for a code region (≈ §5.1: per-step
+        XPlane traces replace the reference's per-task metrics UI):
+        ``with ctx.profile('/tmp/trace'): step()`` then inspect with
+        TensorBoard/xprof."""
+        import jax
+        return jax.profiler.trace(log_dir)
+
     @property
     def checkpoint_dir(self) -> str:
         return self.conf.get(CHECKPOINT_DIR)

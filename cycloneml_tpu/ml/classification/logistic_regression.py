@@ -271,6 +271,9 @@ class LogisticRegressionModel(ProbabilisticClassificationModel,
     def intercept_vector(self) -> DenseVector:
         return Vectors.dense(self._icpt)
 
+    def evaluate(self, frame: MLFrame) -> "BinaryLogisticRegressionSummary":
+        return _lr_evaluate(self, frame)
+
     @property
     def num_classes(self) -> int:
         return self._num_classes
@@ -320,11 +323,97 @@ class LogisticRegressionModel(ProbabilisticClassificationModel,
                 f"numClasses={self._num_classes}, numFeatures={self.num_features})")
 
 
+def _lr_evaluate(model, frame: MLFrame) -> "BinaryLogisticRegressionSummary":
+    """(ref LogisticRegressionModel.evaluate) — score the frame and return
+    the binary metrics summary."""
+    if model._is_multinomial:
+        raise ValueError("evaluate() summary is binary-only "
+                         "(ref BinaryLogisticRegressionSummary)")
+    out = model.transform(frame)
+    probs = np.asarray(out[model.get("probabilityCol")])
+    scores = probs[:, 1] if probs.ndim == 2 else probs
+    try:
+        label_col = model.get("labelCol")
+    except KeyError:  # models carry prediction cols; labelCol is estimator-side
+        label_col = "label"
+    labels = np.asarray(frame[label_col], dtype=np.float64)
+    preds = np.asarray(out[model.get("predictionCol")], dtype=np.float64)
+    return BinaryLogisticRegressionSummary(scores, labels, predictions=preds)
+
+
 class LogisticRegressionTrainingSummary:
     """Objective history + iteration count (ref LogisticRegressionSummary /
-    BinaryLogisticRegressionTrainingSummary — metric methods live on the
-    evaluation module; here the summary carries the optimizer trace)."""
+    BinaryLogisticRegressionTrainingSummary — the optimizer trace; rich
+    binary metrics come from ``model.evaluate(frame)``)."""
 
     def __init__(self, objective_history, total_iterations):
         self.objective_history = objective_history
         self.total_iterations = total_iterations
+
+
+class BinaryLogisticRegressionSummary:
+    """Binary metrics over a scored frame (ref:
+    BinaryLogisticRegressionSummary — roc/pr curves, areaUnderROC,
+    threshold sweeps; computed vectorized from one sorted pass)."""
+
+    def __init__(self, scores: np.ndarray, labels: np.ndarray,
+                 predictions: Optional[np.ndarray] = None):
+        if len(scores) == 0:
+            raise ValueError("cannot summarize an empty frame")
+        self._predictions = predictions
+        order = np.argsort(-scores, kind="stable")
+        s, y = scores[order], labels[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1.0 - y)
+        last = np.append(s[1:] != s[:-1], True)  # ties form one curve point
+        self._thresholds = s[last]
+        self._tps, self._fps = tps[last], fps[last]
+        self._p = max(float(tps[-1]), 1e-300)
+        self._n = max(float(fps[-1]), 1e-300)
+        self._total = len(y)
+        self._labels = labels
+        self._scores = scores
+
+    @property
+    def roc(self) -> np.ndarray:
+        """(FPR, TPR) points including the (0,0) and (1,1) endpoints."""
+        fpr = np.concatenate([[0.0], self._fps / self._n, [1.0]])
+        tpr = np.concatenate([[0.0], self._tps / self._p, [1.0]])
+        return np.column_stack([fpr, tpr])
+
+    @property
+    def area_under_roc(self) -> float:
+        r = self.roc
+        return float(np.trapezoid(r[:, 1], r[:, 0]))
+
+    areaUnderROC = area_under_roc
+
+    @property
+    def pr(self) -> np.ndarray:
+        """(recall, precision) points, starting at recall 0 (ref prepends
+        (0, p) with the first point's precision)."""
+        recall = self._tps / self._p
+        precision = self._tps / np.maximum(self._tps + self._fps, 1e-300)
+        return np.column_stack([np.concatenate([[0.0], recall]),
+                                np.concatenate([[precision[0]], precision])])
+
+    def precision_by_threshold(self) -> np.ndarray:
+        p = self._tps / np.maximum(self._tps + self._fps, 1e-300)
+        return np.column_stack([self._thresholds, p])
+
+    def recall_by_threshold(self) -> np.ndarray:
+        return np.column_stack([self._thresholds, self._tps / self._p])
+
+    def f_measure_by_threshold(self, beta: float = 1.0) -> np.ndarray:
+        p = self._tps / np.maximum(self._tps + self._fps, 1e-300)
+        r = self._tps / self._p
+        b2 = beta * beta
+        f = (1 + b2) * p * r / np.maximum(b2 * p + r, 1e-300)
+        return np.column_stack([self._thresholds, f])
+
+    @property
+    def accuracy(self) -> float:
+        # the model's own predictions (threshold-aware) when available
+        pred = (self._predictions if self._predictions is not None
+                else (self._scores > 0.5).astype(np.float64))
+        return float((pred == self._labels).mean())

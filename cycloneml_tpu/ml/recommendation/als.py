@@ -209,9 +209,15 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
                         f"a DIFFERENT ALS run (fingerprint {saved_fp} != "
                         f"{ck_fp}); clear the directory or use a new one")
                 saved = ck.restore(latest)
+                start_iter = int(saved["iteration"])
+                if start_iter >= self.get("maxIter"):
+                    raise ValueError(
+                        f"checkpoint is at iteration {start_iter} but "
+                        f"maxIter={self.get('maxIter')}; returning it as-is "
+                        "would be an over-trained model — raise maxIter or "
+                        "clear the checkpoint directory")
                 u_fac = jnp.asarray(saved["u_fac"], dtype)
                 i_fac = jnp.asarray(saved["i_fac"], dtype)
-                start_iter = int(saved["iteration"])
                 logger.info("ALS resuming from checkpoint iteration %d",
                             start_iter)
 

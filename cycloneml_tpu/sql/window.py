@@ -240,7 +240,7 @@ class WindowFnExpr(Expr):
             per_part = agg.agg(child_vals, sorted_codes,
                                int(sorted_codes.max()) + 1)
             return np.asarray(per_part)[sorted_codes]
-        if not numeric:
+        if not numeric and agg.fn != "count":  # count never reads the values
             raise ValueError(
                 f"ordered-window {agg.fn!r} needs a numeric column; use an "
                 "unordered partition window for string min/max")
