@@ -51,11 +51,19 @@ class SparkSession(CycloneSession):
 
         def getOrCreate(self) -> "SparkSession":
             ctx = SparkContext.get_or_create(self._conf)
-            return SparkSession(ctx)
+            # PySpark returns the SAME session (shared temp-view catalog)
+            # while its context is alive
+            active = SparkSession._active
+            if active is not None and active.ctx is ctx:
+                return active
+            session = SparkSession(ctx)
+            SparkSession._active = session
+            return session
 
         get_or_create = getOrCreate
 
     builder: "SparkSession.Builder"
+    _active: Optional["SparkSession"] = None
 
     @property
     def sparkContext(self) -> SparkContext:
@@ -68,6 +76,8 @@ class SparkSession(CycloneSession):
         return self.ctx.conf
 
     def stop(self) -> None:
+        if SparkSession._active is self:
+            SparkSession._active = None
         if self.ctx is not None:
             self.ctx.stop()
 
@@ -85,5 +95,10 @@ SparkSession.builder = _BuilderDescriptor()
 
 def getActiveSession() -> Optional[SparkSession]:
     from cycloneml_tpu import context as _c
-    active = _c._active_context
-    return SparkSession(active) if active is not None else None
+    ctx = _c._active_context
+    if ctx is None:
+        return None
+    if SparkSession._active is not None and SparkSession._active.ctx is ctx:
+        return SparkSession._active
+    SparkSession._active = SparkSession(ctx)
+    return SparkSession._active

@@ -244,6 +244,10 @@ class LogisticRegressionModel(ProbabilisticClassificationModel,
                  num_classes: int = 2, is_multinomial: bool = False, uid=None):
         super().__init__(uid)
         self._declare_lr_params()
+        # the model carries labelCol so evaluate() scores the right column
+        # (ref: LogisticRegressionModel extends HasLabelCol via its summary)
+        self.labelCol = self._param("labelCol", "label column name",
+                                    default="label")
         self._coef = np.asarray(coefficient_matrix) if coefficient_matrix is not None else None
         self._icpt = np.asarray(intercept_vector) if intercept_vector is not None else None
         self._num_classes = num_classes
@@ -332,10 +336,7 @@ def _lr_evaluate(model, frame: MLFrame) -> "BinaryLogisticRegressionSummary":
     out = model.transform(frame)
     probs = np.asarray(out[model.get("probabilityCol")])
     scores = probs[:, 1] if probs.ndim == 2 else probs
-    try:
-        label_col = model.get("labelCol")
-    except KeyError:  # models carry prediction cols; labelCol is estimator-side
-        label_col = "label"
+    label_col = model.get("labelCol")
     labels = np.asarray(frame[label_col], dtype=np.float64)
     preds = np.asarray(out[model.get("predictionCol")], dtype=np.float64)
     return BinaryLogisticRegressionSummary(scores, labels, predictions=preds)
@@ -361,16 +362,10 @@ class BinaryLogisticRegressionSummary:
         if len(scores) == 0:
             raise ValueError("cannot summarize an empty frame")
         self._predictions = predictions
-        order = np.argsort(-scores, kind="stable")
-        s, y = scores[order], labels[order]
-        tps = np.cumsum(y)
-        fps = np.cumsum(1.0 - y)
-        last = np.append(s[1:] != s[:-1], True)  # ties form one curve point
-        self._thresholds = s[last]
-        self._tps, self._fps = tps[last], fps[last]
-        self._p = max(float(tps[-1]), 1e-300)
-        self._n = max(float(fps[-1]), 1e-300)
-        self._total = len(y)
+        from cycloneml_tpu.ml.evaluation.evaluators import binary_curve_points
+        (self._thresholds, self._tps, self._fps,
+         self._p, self._n) = binary_curve_points(scores, labels)
+        self._total = len(labels)
         self._labels = labels
         self._scores = scores
 

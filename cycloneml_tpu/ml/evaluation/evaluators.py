@@ -29,6 +29,26 @@ class Evaluator(Params, MLWritable, MLReadable):
         return True
 
 
+def binary_curve_points(score: np.ndarray, y: np.ndarray,
+                        w: Optional[np.ndarray] = None):
+    """Shared sorted-pass curve machinery (≈ mllib
+    BinaryClassificationMetrics): descending-score cumulative TP/FP with
+    tied scores collapsed to one point (each tie-group's LAST cumulative —
+    else the metric depends on row order within ties). Returns
+    (thresholds, tps, fps, tp_total, fp_total) with totals floored at
+    1e-300 for safe division."""
+    if w is None:
+        w = np.ones(len(y))
+    order = np.argsort(-score, kind="stable")
+    y, w, s = y[order], w[order], score[order]
+    tps = np.cumsum(w * y)
+    fps = np.cumsum(w * (1 - y))
+    last_of_group = np.append(s[1:] != s[:-1], True)
+    tps, fps, thresholds = tps[last_of_group], fps[last_of_group], s[last_of_group]
+    return (thresholds, tps, fps,
+            max(float(tps[-1]), 1e-300), max(float(fps[-1]), 1e-300))
+
+
 class BinaryClassificationEvaluator(Evaluator):
     def __init__(self, uid=None, **kw):
         super().__init__(uid)
@@ -49,21 +69,13 @@ class BinaryClassificationEvaluator(Evaluator):
         y = np.asarray(frame[self.get("labelCol")], dtype=np.float64)
         wcol = self.get("weightCol")
         w = np.asarray(frame[wcol], dtype=np.float64) if wcol else np.ones(len(y))
-        order = np.argsort(-score, kind="stable")
-        y, w, s = y[order], w[order], score[order]
-        tps = np.cumsum(w * y)
-        fps = np.cumsum(w * (1 - y))
-        # tied scores form one curve point — keep only each tie-group's last
-        # cumulative value, else the metric depends on row order within ties
-        last_of_group = np.append(s[1:] != s[:-1], True)
-        tps, fps = tps[last_of_group], fps[last_of_group]
-        tp_tot, fp_tot = tps[-1], fps[-1]
+        _, tps, fps, tp_tot, fp_tot = binary_curve_points(score, y, w)
         if self.get("metricName") == "areaUnderROC":
-            tpr = np.concatenate([[0.0], tps / max(tp_tot, 1e-300)])
-            fpr = np.concatenate([[0.0], fps / max(fp_tot, 1e-300)])
+            tpr = np.concatenate([[0.0], tps / tp_tot])
+            fpr = np.concatenate([[0.0], fps / fp_tot])
             return float(np.trapezoid(tpr, fpr))
         precision = tps / np.maximum(tps + fps, 1e-300)
-        recall = tps / max(tp_tot, 1e-300)
+        recall = tps / tp_tot
         recall = np.concatenate([[0.0], recall])
         precision = np.concatenate([[1.0], precision])
         return float(np.trapezoid(precision, recall))

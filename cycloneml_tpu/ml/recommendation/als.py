@@ -210,7 +210,8 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
                         f"{ck_fp}); clear the directory or use a new one")
                 saved = ck.restore(latest)
                 start_iter = int(saved["iteration"])
-                if start_iter >= self.get("maxIter"):
+                if start_iter > self.get("maxIter"):
+                    # equality is fine: the checkpoint IS the requested model
                     raise ValueError(
                         f"checkpoint is at iteration {start_iter} but "
                         f"maxIter={self.get('maxIter')}; returning it as-is "

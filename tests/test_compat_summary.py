@@ -25,6 +25,12 @@ def test_spark_session_builder(ctx):
     # fresh builder per access (no shared mutable conf)
     b1, b2 = SparkSession.builder, SparkSession.builder
     assert b1 is not b2
+    # getOrCreate returns the SAME session: temp views carry across calls
+    spark.register_temp_view("compat_t", df)
+    again = SparkSession.builder.getOrCreate()
+    assert again is spark
+    assert again.table("compat_t").count() == 3
+    assert getActiveSession() is spark
 
 
 def test_compat_functions_and_window():
@@ -70,6 +76,17 @@ def test_binary_summary_known_values():
     np.testing.assert_allclose(s.recall_by_threshold()[:, 1],
                                [0.5, 0.5, 1.0, 1.0])
     assert s.accuracy == pytest.approx(0.5)
+
+
+def test_evaluate_respects_custom_label_col(ctx):
+    rng = np.random.RandomState(4)
+    x = rng.randn(150, 3)
+    y = (x @ rng.randn(3) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "target": y,
+                          "label": np.zeros(150)})  # decoy column
+    model = LogisticRegression(maxIter=10, labelCol="target").fit(frame)
+    s = model.evaluate(frame)
+    assert s.accuracy > 0.9  # scored against 'target', not the decoy
 
 
 def test_summary_accuracy_respects_threshold(ctx):
