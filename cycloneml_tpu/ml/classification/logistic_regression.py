@@ -31,8 +31,9 @@ from cycloneml_tpu.ml.optim.loss import (
 )
 from cycloneml_tpu.ml.param import ParamValidators as V
 from cycloneml_tpu.ml.shared import (
-    HasAggregationDepth, HasElasticNetParam, HasFitIntercept, HasMaxBlockSizeInMB,
-    HasMaxIter, HasRegParam, HasStandardization, HasThreshold, HasTol,
+    HasAggregationDepth, HasElasticNetParam, HasFitIntercept, HasLabelCol,
+    HasMaxBlockSizeInMB, HasMaxIter, HasRegParam, HasStandardization,
+    HasThreshold, HasTol,
 )
 from cycloneml_tpu.ml.stat import Summarizer
 from cycloneml_tpu.ml.util_io import MLReadable, MLWritable, load_arrays, save_arrays
@@ -234,7 +235,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
 
 
 class LogisticRegressionModel(ProbabilisticClassificationModel,
-                              _LogisticRegressionParams, MLWritable, MLReadable):
+                              _LogisticRegressionParams, HasLabelCol,
+                              MLWritable, MLReadable):
     """Fitted model (ref LogisticRegressionModel at
     ml/classification/LogisticRegression.scala:1106-ish): margins, sigmoid/
     softmax probabilities, threshold-aware binary prediction."""
@@ -246,8 +248,7 @@ class LogisticRegressionModel(ProbabilisticClassificationModel,
         self._declare_lr_params()
         # the model carries labelCol so evaluate() scores the right column
         # (ref: LogisticRegressionModel extends HasLabelCol via its summary)
-        self.labelCol = self._param("labelCol", "label column name",
-                                    default="label")
+        self._p_label_col()
         self._coef = np.asarray(coefficient_matrix) if coefficient_matrix is not None else None
         self._icpt = np.asarray(intercept_vector) if intercept_vector is not None else None
         self._num_classes = num_classes

@@ -86,34 +86,38 @@ class GradientDescent:
         import jax
         import jax.numpy as jnp
 
-        from cycloneml_tpu.parallel import collectives
+        from cycloneml_tpu.mesh import DATA_AXIS, REPLICA_AXIS
 
-        rt = dataset.ctx.mesh_runtime
         frac = self.mini_batch_fraction
-        arrays = ((dataset.indices, dataset.values, dataset.y, dataset.w)
-                  if hasattr(dataset, "indices")
-                  else (dataset.x, dataset.y, dataset.w))
 
         def fn(*args):
-            # works for both tiers: (rows..., w, coef, step) with w second
-            # to last of the row group; per-shard Bernoulli mask via the
-            # step-folded key keeps shapes static
+            # works for both tiers: (rows..., w, coef, step) with w the last
+            # row-sharded array; per-shard Bernoulli mask (keyed on step AND
+            # both mesh axes — every shard must sample independently) keeps
+            # shapes static
             *rows, w, coef, step = args
             if frac < 1.0:
                 key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
-                key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(REPLICA_AXIS))
                 w = w * (jax.random.uniform(key, w.shape) < frac)
             return agg(*rows, w, coef)
 
-        compiled = collectives.tree_aggregate(fn, rt, *arrays)
+        compiled = dataset.tree_aggregate_fn(fn)
 
         w = np.asarray(x0, dtype=np.float64).copy()
         history: list = []
         prev = None
         for t in range(1, self.num_iterations + 1):
-            out = compiled(*arrays, jnp.asarray(w, jnp.float32),
+            out = compiled(jnp.asarray(w, jnp.float32),
                            jnp.asarray(t, jnp.int32))
-            count = max(float(out["count"]), 1e-300)
+            count = float(out["count"])
+            if count <= 0:
+                # empty mini-batch: no update, no history entry (the
+                # reference skips when miniBatchSize == 0) — recording 0.0
+                # would fake convergence
+                continue
             loss = float(out["loss"]) / count
             grad = np.asarray(out["grad"], dtype=np.float64) / count
             w, reg = self.updater.compute(w, grad, self.step_size, t,

@@ -1,0 +1,34 @@
+"""Sessionization-style window analytics (≈ the reference's window-function
+examples in examples/src/main/python/sql/)."""
+
+import numpy as np
+
+from cycloneml_tpu.sql import functions as F
+from cycloneml_tpu.sql.column import col
+from cycloneml_tpu.sql.session import CycloneSession
+from cycloneml_tpu.sql.window import Window, lag, rank, row_number
+
+
+def main():
+    s = CycloneSession()
+    df = s.create_data_frame({
+        "user": ["u1", "u1", "u1", "u2", "u2"],
+        "ts": [1.0, 5.0, 9.0, 2.0, 3.0],
+        "spend": [10.0, 20.0, 5.0, 50.0, 25.0],
+    })
+    w = Window.partition_by("user").order_by("ts")
+    out = (df.with_column("visit", row_number().over(w))
+             .with_column("cum_spend", F.sum("spend").over(w))
+             .with_column("gap", col("ts") - lag("ts").over(w))
+             .with_column("spend_rank",
+                          rank().over(Window.partition_by("user")
+                                      .order_by(col("spend").desc()))))
+    out.order_by("user", "ts").show()
+    top = out.filter(col("spend_rank") == 1).order_by("user").collect()
+    print("biggest purchase per user:",
+          [(r.user, r.spend) for r in top])
+    return [(r.user, r.spend) for r in top]
+
+
+if __name__ == "__main__":
+    main()

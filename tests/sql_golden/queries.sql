@@ -1,0 +1,18 @@
+-- basic projection + filter + order
+SELECT name, salary FROM emp WHERE salary > 75 ORDER BY salary DESC
+-- aggregation with HAVING-style filter via nested ordering
+SELECT dept, COUNT(*) AS n, AVG(salary) AS avg_sal FROM emp GROUP BY dept ORDER BY dept
+-- join + projection
+SELECT e.name, d.floor FROM emp e JOIN dept d ON e.dept = d.dept ORDER BY e.id
+-- expression arithmetic and aliasing
+SELECT name, salary * 1.1 AS raised FROM emp ORDER BY raised DESC LIMIT 3
+-- CASE WHEN
+SELECT name, CASE WHEN salary >= 100 THEN 'senior' ELSE 'junior' END AS band FROM emp ORDER BY id
+-- IN and BETWEEN
+SELECT name FROM emp WHERE dept IN ('eng', 'hr') AND salary BETWEEN 60 AND 125 ORDER BY name
+-- LIKE
+SELECT name FROM emp WHERE name LIKE '%a%' ORDER BY name
+-- global aggregate expressions
+SELECT COUNT(*) AS n, MIN(salary) AS lo, MAX(salary) AS hi, SUM(salary) / COUNT(*) AS mean FROM emp
+-- distinct
+SELECT DISTINCT dept FROM emp ORDER BY dept

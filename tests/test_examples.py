@@ -14,6 +14,8 @@ EXAMPLES = [
     "examples/kmeans_example.py",
     "examples/sparse_logistic_example.py",
     "examples/graph_pagerank.py",
+    "examples/window_analytics_example.py",
+    "examples/streaming_etl_to_parquet.py",
 ]
 
 
