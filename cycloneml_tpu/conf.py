@@ -304,6 +304,19 @@ TASK_MAX_FAILURES = (
     .int_conf(4)
 )
 
+MATMUL_PRECISION = (
+    ConfigBuilder("cyclone.compute.matmulPrecision")
+    .doc("Aggregator matmul precision: 'highest' (default) = multi-pass f32 "
+         "on the MXU, matching the reference's f64 loss curves to ~1e-6; "
+         "'default' = the backend's native (bf16-multiply) mode. Measured "
+         "NEUTRAL for gemv-shaped binary aggregators on v5e (they are "
+         "HBM-bound); only consider it for genuinely MXU-bound shapes "
+         "(wide multinomial). Resolved when an aggregator is built.")
+    .check_value(lambda v: v in ("highest", "default"),
+                 "must be 'highest' or 'default'")
+    .str_conf("highest")
+)
+
 METRICS_SINKS = (
     ConfigBuilder("cyclone.metrics.sinks")
     .doc("Comma-separated metric sinks: console, csv, prometheus "

@@ -32,7 +32,23 @@ import jax.numpy as jnp
 
 Agg = Callable[..., Dict[str, jnp.ndarray]]
 
-_HI = jax.lax.Precision.HIGHEST
+def matmul_precision():
+    """Matmul precision for the aggregator hot path, resolved from
+    ``cyclone.compute.matmulPrecision`` when an aggregator is BUILT (each
+    fit builds its aggregators, so a session change applies to the next
+    fit). See the config entry's doc for the measured guidance: 'highest'
+    is both the parity choice AND at least as fast for the gemv-shaped
+    binary path on v5e (HBM-bound); 'default' exists for MXU-bound shapes
+    like wide multinomial."""
+    from cycloneml_tpu import context as _c
+    from cycloneml_tpu.conf import CycloneConf, MATMUL_PRECISION
+    conf = (_c._active_context.conf if _c._active_context is not None
+            else CycloneConf())
+    # a ValueError from an invalid setting must surface — silently falling
+    # back would make the misconfiguration invisible for every fit
+    name = conf.get(MATMUL_PRECISION)
+    return (jax.lax.Precision.DEFAULT if name == "default"
+            else jax.lax.Precision.HIGHEST)
 
 
 def _split_coef(coef, d, fit_intercept):
@@ -48,12 +64,14 @@ def binary_logistic(d: int, fit_intercept: bool = True) -> Agg:
     algebraically the same stable form the reference branches on label.
     """
 
+    prec = matmul_precision()
+
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        margin = jnp.dot(x, beta, precision=_HI) + b0          # forward gemv:97
+        margin = jnp.dot(x, beta, precision=prec) + b0          # forward gemv:97
         loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
         multiplier = w * (jax.nn.sigmoid(margin) - y)          # :112 multiplier
-        g = jnp.dot(x.T, multiplier, precision=_HI)            # backward gemv:130
+        g = jnp.dot(x.T, multiplier, precision=prec)            # backward gemv:130
         grad = jnp.concatenate([g, jnp.sum(multiplier)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
 
@@ -66,6 +84,8 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
     all k vectors rather than k-1, making the problem over-parameterised
     exactly like this)."""
 
+    prec = matmul_precision()
+
     def agg(x, y, w, coef):
         if fit_intercept:
             wmat = coef[: d * k].reshape(k, d)
@@ -73,7 +93,7 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
         else:
             wmat = coef.reshape(k, d)
             b = jnp.zeros((k,), coef.dtype)
-        margins = jnp.dot(x, wmat.T, precision=_HI) + b        # (bsz, k)
+        margins = jnp.dot(x, wmat.T, precision=prec) + b        # (bsz, k)
         log_z = jax.nn.logsumexp(margins, axis=1)
         y_idx = y.astype(jnp.int32)
         picked = jnp.take_along_axis(margins, y_idx[:, None], axis=1)[:, 0]
@@ -81,7 +101,7 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
         probs = jax.nn.softmax(margins, axis=1)
         onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
         mult = w[:, None] * (probs - onehot)                   # (bsz, k)
-        gw = jnp.dot(mult.T, x, precision=_HI)                 # (k, d)
+        gw = jnp.dot(mult.T, x, precision=prec)                 # (k, d)
         if fit_intercept:
             grad = jnp.concatenate([gw.reshape(-1), jnp.sum(mult, axis=0)])
         else:
@@ -94,12 +114,14 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
 def least_squares(d: int, fit_intercept: bool = True) -> Agg:
     """Squared loss ½ w (x·β + β₀ − y)² (ref LeastSquaresBlockAggregator)."""
 
+    prec = matmul_precision()
+
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        err = jnp.dot(x, beta, precision=_HI) + b0 - y
+        err = jnp.dot(x, beta, precision=prec) + b0 - y
         loss = 0.5 * jnp.sum(w * err * err)
         mult = w * err
-        g = jnp.dot(x.T, mult, precision=_HI)
+        g = jnp.dot(x.T, mult, precision=prec)
         grad = jnp.concatenate([g, jnp.sum(mult)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
 
@@ -110,14 +132,16 @@ def hinge(d: int, fit_intercept: bool = True) -> Agg:
     """Hinge loss for LinearSVC (ref HingeBlockAggregator): labels in {0,1}
     mapped to ±1 as 2y−1; loss_i = w_i max(0, 1 − ŷ_i m_i)."""
 
+    prec = matmul_precision()
+
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        margin = jnp.dot(x, beta, precision=_HI) + b0
+        margin = jnp.dot(x, beta, precision=prec) + b0
         ysign = 2.0 * y - 1.0
         active = (1.0 - ysign * margin) > 0
         loss = jnp.sum(w * jnp.maximum(0.0, 1.0 - ysign * margin))
         mult = jnp.where(active, -ysign * w, 0.0)
-        g = jnp.dot(x.T, mult, precision=_HI)
+        g = jnp.dot(x.T, mult, precision=prec)
         grad = jnp.concatenate([g, jnp.sum(mult)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
 
@@ -129,10 +153,12 @@ def huber(d: int, fit_intercept: bool = True, epsilon: float = 1.35) -> Agg:
     following Owen 2007 as the reference does): coef = [β, β₀?, σ];
     loss_i = w_i (σ + ℓ_ε((y−μ)/σ) σ)."""
 
+    prec = matmul_precision()
+
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef[:-1], d, fit_intercept)
         sigma = coef[-1]
-        mu = jnp.dot(x, beta, precision=_HI) + b0
+        mu = jnp.dot(x, beta, precision=prec) + b0
         r = (y - mu) / sigma
         abs_r = jnp.abs(r)
         outlier = abs_r > epsilon
@@ -144,7 +170,7 @@ def huber(d: int, fit_intercept: bool = True, epsilon: float = 1.35) -> Agg:
         # d/dmu and d/dsigma — matches the reference's piecewise gradients
         dmu = jnp.where(outlier, -2.0 * epsilon * jnp.sign(r), -2.0 * r)
         mult = w * dmu
-        g = jnp.dot(x.T, mult, precision=_HI)
+        g = jnp.dot(x.T, mult, precision=prec)
         dsig_i = jnp.where(outlier,
                            1.0 - epsilon * epsilon,
                            1.0 - r * r)

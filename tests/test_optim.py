@@ -196,3 +196,26 @@ def test_distributed_logistic_end_to_end_lbfgs(ctx):
     sk = SkLR(C=1.0 / (reg * n), tol=1e-10, max_iter=10000).fit(x, y)
     np.testing.assert_allclose(st.x[:d], sk.coef_[0], atol=1e-4)
     np.testing.assert_allclose(st.x[d], sk.intercept_[0], atol=1e-4)
+
+
+def test_matmul_precision_config(ctx):
+    """'cyclone.compute.matmulPrecision' steers the aggregator hot path at
+    build time; invalid values are rejected by the typed registry."""
+    import jax
+    import pytest
+    from cycloneml_tpu.conf import MATMUL_PRECISION
+    from cycloneml_tpu.ml.optim.aggregators import matmul_precision
+
+    assert matmul_precision() == jax.lax.Precision.HIGHEST  # default
+    ctx.conf.set(MATMUL_PRECISION, "default")
+    try:
+        assert matmul_precision() == jax.lax.Precision.DEFAULT
+    finally:
+        ctx.conf.set(MATMUL_PRECISION, "highest")
+    assert matmul_precision() == jax.lax.Precision.HIGHEST
+    ctx.conf.set(MATMUL_PRECISION, "bogus")
+    try:
+        with pytest.raises(ValueError):
+            matmul_precision()  # misconfiguration surfaces at build time
+    finally:
+        ctx.conf.set(MATMUL_PRECISION, "highest")
