@@ -17,7 +17,7 @@ from cycloneml_tpu.sql.column import (Alias, Column, ColumnRef, Expr,
                                       SortOrder, col)
 from cycloneml_tpu.sql.optimizer import optimize
 from cycloneml_tpu.sql.plan import (Aggregate, Distinct, Filter, Join, Limit,
-                                    LogicalPlan, Project, Sort, Union)
+                                    LogicalPlan, Project, Scan, Sort, Union)
 from cycloneml_tpu.sql.types import StructType, infer_schema
 
 
@@ -139,6 +139,64 @@ class DataFrame:
 
     def distinct(self) -> "DataFrame":
         return DataFrame(Distinct(self.plan), self.session)
+
+    def describe(self, *cols) -> "DataFrame":
+        """(ref Dataset.describe) — count/mean/stddev/min/max summary.
+        Nulls are EXCLUDED like the reference (count = non-null count);
+        string columns report count/min/max (lexicographic) with null
+        moments; unknown column names error instead of silently vanishing."""
+        names = list(cols or self.columns)
+        missing = [c for c in names if c not in self.columns]
+        if missing:
+            raise KeyError(f"describe: unknown columns {missing}")
+
+        def compute(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            out: Dict[str, list] = {"summary": ["count", "mean", "stddev",
+                                                "min", "max"]}
+            for c in names:
+                v = batch[c]
+                if v.dtype == object or v.dtype.kind in "US":
+                    nn = [x for x in v if x is not None]
+                    out[c] = [float(len(nn)), None, None,
+                              min(nn, default=None), max(nn, default=None)]
+                    continue
+                f = np.asarray(v, dtype=np.float64)
+                f = f[~np.isnan(f)]
+                n = len(f)
+                out[c] = [float(n),
+                          float(np.mean(f)) if n else None,
+                          float(np.std(f, ddof=1)) if n > 1 else None,
+                          float(np.min(f)) if n else None,
+                          float(np.max(f)) if n else None]
+            return {k: np.array(vals, dtype=object)
+                    for k, vals in out.items()}
+
+        from cycloneml_tpu.sql.plan import MapBatch
+        return DataFrame(MapBatch(self.plan, compute, "describe",
+                                  ["summary"] + names), self.session)
+
+    def sample(self, fraction: float, seed: Optional[int] = None
+               ) -> "DataFrame":
+        """(ref Dataset.sample) — Bernoulli row sample without replacement
+        (lazy; on streams it resamples each micro-batch)."""
+        def compute(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            n = len(next(iter(batch.values()))) if batch else 0
+            mask = np.random.RandomState(seed).rand(n) < fraction
+            return {k: v[mask] for k, v in batch.items()}
+
+        from cycloneml_tpu.sql.plan import MapBatch
+        return DataFrame(MapBatch(self.plan, compute, "sample"), self.session)
+
+    @property
+    def na(self) -> "DataFrameNaFunctions":
+        """(ref Dataset.na → DataFrameNaFunctions)"""
+        return DataFrameNaFunctions(self)
+
+    def fillna(self, value, subset=None) -> "DataFrame":
+        return self.na.fill(value, subset)
+
+    def dropna(self, how: str = "any", subset=None) -> "DataFrame":
+        return self.na.drop(how, subset)
 
     def drop_duplicates(self, subset=None) -> "DataFrame":
         """(ref Dataset.dropDuplicates; stateful across batches when
@@ -277,3 +335,83 @@ class GroupedData:
 
     def max(self, *names: str) -> DataFrame:
         return self.agg(*[F.max(n).alias(f"max({n})") for n in names])
+
+
+class DataFrameNaFunctions:
+    """(ref DataFrameNaFunctions.scala) — null handling: NaN for float
+    columns, None for object columns. All operations are lazy MapBatch
+    nodes; ``subset`` accepts a name or list and unknown names error."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    @staticmethod
+    def _null_mask(v: np.ndarray) -> np.ndarray:
+        from cycloneml_tpu.pandas.frame import _is_null  # one shared predicate
+        return _is_null(v)
+
+    def _subset(self, subset) -> List[str]:
+        if subset is None:
+            return list(self._df.columns)
+        names = [subset] if isinstance(subset, str) else list(subset)
+        missing = [c for c in names if c not in self._df.columns]
+        if missing:
+            raise KeyError(f"na: unknown columns {missing}")
+        return names
+
+    def _map(self, fn, name: str) -> DataFrame:
+        from cycloneml_tpu.sql.plan import MapBatch
+        return DataFrame(MapBatch(self._df.plan, fn, name), self._df.session)
+
+    def fill(self, value, subset=None) -> DataFrame:
+        targets = self._subset(subset)
+        value_is_str = isinstance(value, str)
+
+        def compute(batch):
+            out = dict(batch)
+            for c in targets:
+                v = out[c]
+                # fill only type-matching columns, like the reference:
+                # numeric values touch numeric columns, strings touch
+                # string/object columns
+                is_str_col = v.dtype == object or v.dtype.kind in "US"
+                if is_str_col != value_is_str:
+                    continue
+                mask = self._null_mask(v)
+                if mask.any():
+                    filled = v.copy()
+                    filled[mask] = value
+                    out[c] = filled
+            return out
+        return self._map(compute, "fillna")
+
+    def drop(self, how: str = "any", subset=None) -> DataFrame:
+        targets = self._subset(subset)
+
+        def compute(batch):
+            masks = [self._null_mask(batch[c]) for c in targets]
+            if not masks:
+                return batch
+            bad = (np.logical_or.reduce(masks) if how == "any"
+                   else np.logical_and.reduce(masks))
+            return {k: v[~bad] for k, v in batch.items()}
+        return self._map(compute, "dropna")
+
+    def replace(self, to_replace, value, subset=None) -> DataFrame:
+        targets = self._subset(subset)
+        if isinstance(to_replace, dict):
+            mapping = dict(to_replace)
+        elif isinstance(to_replace, (list, tuple)):
+            mapping = {old: value for old in to_replace}
+        else:
+            mapping = {to_replace: value}
+
+        def compute(batch):
+            out = dict(batch)
+            for c in targets:
+                v = out[c].copy()
+                for old, new in mapping.items():
+                    v[v == old] = new
+                out[c] = v
+            return out
+        return self._map(compute, "replace")

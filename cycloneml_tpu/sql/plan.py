@@ -413,3 +413,30 @@ class Distinct(LogicalPlan):
 
     def __repr__(self):
         return "Distinct"
+
+
+class MapBatch(LogicalPlan):
+    """Host-tier batch→batch function node (sample / na fill-drop-replace /
+    describe). Keeps those surfaces LAZY like every other method — they
+    build plans, actions execute — and therefore usable per-micro-batch on
+    streaming plans. ``output_cols`` overrides the child schema when the
+    function changes it (describe)."""
+
+    def __init__(self, child: LogicalPlan, fn: Callable[[Batch], Batch],
+                 name: str, output_cols: Optional[List[str]] = None):
+        self.children = [child]
+        self.fn = fn
+        self.name = name
+        self.output_cols = output_cols
+
+    def with_children(self, c):
+        return MapBatch(c[0], self.fn, self.name, self.output_cols)
+
+    def output(self):
+        return self.output_cols or self.children[0].output()
+
+    def execute(self):
+        return self.fn(self.children[0].execute())
+
+    def __repr__(self):
+        return f"MapBatch({self.name})"

@@ -282,3 +282,41 @@ def test_show_and_explain(people, capsys):
     people.filter(col("age") > 26).explain()
     out = capsys.readouterr().out
     assert "Logical Plan" in out and "Optimized" in out
+
+
+def test_describe_sample_na():
+    """(ref Dataset.describe / sample / na functions)"""
+    s = CycloneSession()
+    df = s.create_data_frame({"a": [1.0, 2.0, 3.0, np.nan],
+                              "tag": ["x", None, "y", "z"]})
+    d = {r.summary: r.a for r in df.describe("a").collect()}
+    assert d["count"] == 3.0  # non-null count (ref excludes nulls)
+    assert d["max"] == 3.0 and d["min"] == 1.0
+    assert d["mean"] == pytest.approx(2.0)
+
+    filled = df.na.fill(0.0, subset=["a"]).to_dict()
+    assert not np.isnan(filled["a"]).any()
+    # type-matched fill: a numeric value leaves string columns alone, and a
+    # string value leaves numeric columns alone (no crash, no corruption)
+    mixed = df.na.fill("unknown").to_dict()
+    assert "unknown" in mixed["tag"].tolist()
+    assert np.isnan(mixed["a"]).any()
+    dropped = df.na.drop()
+    assert dropped.count() == 2  # rows with NaN a or None tag removed
+    only_a = df.dropna(subset="a")  # bare-string subset accepted
+    assert only_a.count() == 3
+    with pytest.raises(KeyError, match="unknown columns"):
+        df.dropna(subset=["aeg"])
+    with pytest.raises(KeyError, match="unknown columns"):
+        df.describe("aeg")
+    rep = df.na.replace(["x", "y"], "Z", subset=["tag"]).to_dict()
+    assert rep["tag"].tolist().count("Z") == 2
+    # string columns appear in describe with count/min/max
+    ds = {r.summary: r.tag for r in df.describe("tag").collect()}
+    assert ds["count"] == 3.0 and ds["min"] == "x" and ds["max"] == "z"
+
+    sampled = s.range(1000).sample(0.3, seed=42)
+    n = sampled.count()
+    assert 200 < n < 400  # Bernoulli around 300
+    # deterministic under a fixed seed
+    assert s.range(1000).sample(0.3, seed=42).count() == n
