@@ -1,13 +1,21 @@
 """Benchmark driver — prints ONE JSON line.
 
-Primary metric: end-to-end ``LogisticRegression`` distributed-gradient
-throughput on the attached TPU (the north-star path, BASELINE.json), scored
-against the reference's committed BLAS throughput record: dgemm[N,N]
-best-java = 2409.7 M ops/s on its CI hardware
-(ref: mllib-local/benchmarks/BLASBenchmark-results.txt:158-169 — the only
-committed kernel-throughput number; no end-to-end MLlib training numbers are
-committed, see BASELINE.md). vs_baseline therefore compares our measured
-device GEMM M ops/s inside the training step against 2409.7.
+Headline metric: END-TO-END ``LogisticRegression.fit`` sustained aggregator
+throughput (the north-star path, BASELINE.json parity condition is fit
+wall-clock). Each loss/grad evaluation does 4·n·d flops (forward margin
+matmul + transpose-matmul gradient — ref BinaryLogisticBlockAggregator
+gemv:97/:130); we report achieved M ops/s over the whole fit wall-clock,
+including dispatch, line search, optimizer state updates and readbacks.
+
+``vs_baseline`` scores that end-to-end rate against the reference's best
+COMMITTED kernel rate: dgemm[N,N] hand-optimized-java = 2409.7 M ops/s
+(ref: mllib-local/benchmarks/BLASBenchmark-results.txt:158-169). That is the
+reference's compute-bound upper bound — its real fit pays Spark job dispatch,
+RPC and shuffle on top of the kernel, so beating its *kernel* rate end-to-end
+is a strictly conservative comparison (no end-to-end MLlib training numbers
+are committed in the reference, see BASELINE.md).
+
+Secondary (stderr): raw device GEMM throughput and fit latency breakdown.
 """
 
 from __future__ import annotations
@@ -22,7 +30,7 @@ REF_DGEMM_MOPS = 2409.7  # BLASBenchmark-results.txt:158-169 (java best)
 
 
 def bench_gemm(dim: int = 2048, iters: int = 400) -> float:
-    """Sustained f32-accumulate GEMM M ops/s on device.
+    """Sustained f32-accumulate GEMM M ops/s on device (secondary metric).
 
     A data-dependent scan chain with a scalar readback: per-call dispatch
     latency (~70 ms through the TPU relay) is amortised over ``iters``
@@ -53,42 +61,86 @@ def bench_gemm(dim: int = 2048, iters: int = 400) -> float:
     return 2.0 * dim ** 3 / dt / 1e6
 
 
-def bench_logreg_fit(n: int = 200_000, d: int = 256, iters: int = 25):
-    """Wall-clock of a distributed LR fit (fixed iteration count)."""
+def bench_logreg_fit(n: int = 1_000_000, d: int = 512, iters: int = 25):
+    """End-to-end distributed LR fit (fixed iteration budget).
+
+    Returns (wall_s, iterations, evals, dispatches, n, d). A first fit at the
+    SAME shapes warms the XLA compile cache (and the relay), so the timed
+    second fit measures steady-state training — data placement included,
+    compilation excluded, matching how the reference's training benchmarks
+    time warmed persisted-input fits.
+    """
     from cycloneml_tpu import CycloneConf, CycloneContext
     from cycloneml_tpu.dataset.frame import MLFrame
     from cycloneml_tpu.ml.classification import LogisticRegression
 
     ctx = CycloneContext.get_or_create(
         CycloneConf().set("cyclone.app.name", "bench"))
-    rng = np.random.RandomState(0)
-    x = rng.randn(n, d).astype(np.float32)
-    true = rng.randn(d)
-    y = (x @ true + rng.randn(n) > 0).astype(np.float32)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, d), dtype=np.float32)
+    true = rng.standard_normal(d)
+    y = (x @ true + rng.standard_normal(n) > 0).astype(np.float32)
     frame = MLFrame(ctx, {"features": x, "label": y})
     lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
+    t0 = time.perf_counter()
+    lr.fit(frame)
+    warm_s = time.perf_counter() - t0
+    print(f"info: warm-up fit (compiles + relay warmup) took {warm_s:.2f}s",
+          file=sys.stderr)
     t0 = time.perf_counter()
     model = lr.fit(frame)
     dt = time.perf_counter() - t0
     its = model.summary.total_iterations
-    return dt, its, n * d
+    evals = getattr(model.summary, "total_evals", None)
+    dispatches = getattr(model.summary, "total_dispatches", None)
+    return dt, its, evals, dispatches, n, d
 
 
 def main() -> None:
-    gemm_mops = bench_gemm()
+    err = None
     try:
-        fit_s, fit_iters, nd = bench_logreg_fit()
-        print(f"info: LogisticRegression.fit n*d={nd} took {fit_s:.2f}s "
-              f"({fit_iters} iterations, {fit_s / max(fit_iters,1) * 1e3:.1f} ms/iter)",
-              file=sys.stderr)
+        fit_s, its, evals, dispatches, n, d = bench_logreg_fit()
     except Exception as e:  # bench must still emit its line
-        print(f"info: logreg bench failed: {e}", file=sys.stderr)
-    print(json.dumps({
-        "metric": "device_gemm_f32_throughput",
-        "value": round(gemm_mops, 1),
-        "unit": "M ops/s",
-        "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
-    }))
+        err = e
+        fit_s = None
+    try:
+        gemm_mops = bench_gemm()
+        print(f"info: device_gemm_f32 {gemm_mops:.1f} M ops/s "
+              f"({gemm_mops / REF_DGEMM_MOPS:.0f}x ref java dgemm)",
+              file=sys.stderr)
+    except Exception as e:
+        gemm_mops = None
+        print(f"info: gemm bench failed: {e}", file=sys.stderr)
+
+    if fit_s is not None:
+        evals_n = evals if evals else its  # conservative if not exposed
+        mops = 4.0 * n * d * evals_n / fit_s / 1e6
+        print(f"info: LogisticRegression.fit n={n} d={d} took {fit_s:.2f}s: "
+              f"{its} iterations ({fit_s / max(its, 1) * 1e3:.1f} ms/iter), "
+              f"{evals_n} loss/grad evals, {dispatches} device dispatches",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "logreg_fit_e2e_throughput",
+            "value": round(mops, 1),
+            "unit": "M ops/s",
+            "vs_baseline": round(mops / REF_DGEMM_MOPS, 2),
+        }))
+    elif gemm_mops is not None:
+        print(f"info: logreg bench failed: {err}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "device_gemm_f32_throughput",
+            "value": round(gemm_mops, 1),
+            "unit": "M ops/s",
+            "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
+        }))
+    else:
+        # both benches errored: say so instead of faking a 0.0 measurement
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+        }))
 
 
 if __name__ == "__main__":

@@ -315,6 +315,11 @@ class InstanceDataset:
         def call(*extras):
             return compiled(ds.x, ds.y, ds.w, *extras)
 
+        # expose the raw program + sharded operands so callers (e.g. the
+        # device-resident line search) can inline this aggregation inside a
+        # larger jitted program instead of dispatching it standalone
+        call.compiled = compiled
+        call.arrays = lambda: (ds.x, ds.y, ds.w)
         return call
 
     def map_batches(self, fn: Callable):

@@ -154,6 +154,8 @@ class SparseInstanceDataset:
         def call(*extras):
             return compiled(ds.indices, ds.values, ds.y, ds.w, *extras)
 
+        call.compiled = compiled
+        call.arrays = lambda: (ds.indices, ds.values, ds.y, ds.w)
         return call
 
     def to_dense(self) -> np.ndarray:

@@ -227,7 +227,9 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         model._set_parent(self)
         model.summary = LogisticRegressionTrainingSummary(
             objective_history=list(state.loss_history),
-            total_iterations=state.iteration)
+            total_iterations=state.iteration,
+            total_evals=loss_fn.n_evals,
+            total_dispatches=loss_fn.n_dispatches)
         return model
 
     def copy(self, extra=None) -> "LogisticRegression":
@@ -348,9 +350,15 @@ class LogisticRegressionTrainingSummary:
     BinaryLogisticRegressionTrainingSummary — the optimizer trace; rich
     binary metrics come from ``model.evaluate(frame)``)."""
 
-    def __init__(self, objective_history, total_iterations):
+    def __init__(self, objective_history, total_iterations,
+                 total_evals=None, total_dispatches=None):
         self.objective_history = objective_history
         self.total_iterations = total_iterations
+        # optimizer-path telemetry: loss/grad evaluations and host->device
+        # round trips (the fused line search makes dispatches ~ iterations,
+        # not ~ evals)
+        self.total_evals = total_evals
+        self.total_dispatches = total_dispatches
 
 
 class BinaryLogisticRegressionSummary:

@@ -114,6 +114,15 @@ def _strong_wolfe(f: LossGrad, x: np.ndarray, value: float, grad: np.ndarray,
     if d_dot_g0 >= 0:
         raise ValueError("direction is not a descent direction")
 
+    # fused path: a DistributedLossFunction runs the whole bracket+zoom
+    # search in ONE device dispatch (vs one dispatch per phi eval here)
+    fused = getattr(f, "device_line_search", None)
+    if fused is not None:
+        out = fused(x, direction, value, d_dot_g0, init_alpha,
+                    c1, c2, max_evals)
+        if out is not None:
+            return out
+
     def phi(alpha: float):
         v, g = f(x + alpha * direction)
         return v, g, float(np.dot(direction, g))

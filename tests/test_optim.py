@@ -219,3 +219,78 @@ def test_matmul_precision_config(ctx):
             matmul_precision()  # misconfiguration surfaces at build time
     finally:
         ctx.conf.set(MATMUL_PRECISION, "highest")
+
+
+# -- device-resident (fused) line search --------------------------------------
+
+class _HostPathOnly:
+    """Strips device_line_search so _strong_wolfe takes the per-eval path."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def __call__(self, coef):
+        return self._f(coef)
+
+
+def test_fused_line_search_matches_host_trajectory(ctx):
+    """The one-dispatch bracket+zoom while_loop must reproduce the host
+    Nocedal-Wright search decision-for-decision (dense path, f64 on the test
+    mesh, so trajectories are bitwise-comparable)."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    rng = np.random.RandomState(5)
+    n, d = 400, 24
+    x = rng.randn(n, d)
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    l2 = l2_regularization(0.1, d, True, standardize=True)
+    agg = aggregators.binary_logistic(d, fit_intercept=True)
+    fused_loss = DistributedLossFunction(ds, agg, l2)
+    host_loss = _HostPathOnly(DistributedLossFunction(ds, agg, l2))
+
+    fused = list(LBFGS(max_iter=15, tol=1e-12).iterations(fused_loss, np.zeros(d + 1)))
+    host = list(LBFGS(max_iter=15, tol=1e-12).iterations(host_loss, np.zeros(d + 1)))
+    assert len(fused) == len(host)
+    for a, b in zip(fused, host):
+        np.testing.assert_allclose(a.x, b.x, rtol=1e-12, atol=1e-14)
+        assert abs(a.value - b.value) < 1e-12
+
+
+def test_fused_line_search_dispatch_count(ctx):
+    """The point of the fusion: host->device round trips per iteration must
+    be ~1 (one line-search dispatch), NOT one per phi evaluation."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    rng = np.random.RandomState(2)
+    n, d = 600, 32
+    x = rng.randn(n, d)
+    true = rng.randn(d)
+    y = (x @ true + rng.randn(n) > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    loss = DistributedLossFunction(
+        ds, aggregators.binary_logistic(d, fit_intercept=True),
+        l2_regularization(0.01, d, True, standardize=True))
+    st = LBFGS(max_iter=20, tol=0.0).minimize(loss, np.zeros(d + 1))
+    assert st.iteration >= 5
+    # initial eval = 1 dispatch; each iteration = 1 fused line-search dispatch
+    assert loss.n_dispatches <= st.iteration + 2, \
+        (loss.n_dispatches, st.iteration, loss.n_evals)
+    assert loss.n_evals > loss.n_dispatches  # multiple evals rode each dispatch
+
+
+def test_fused_line_search_sparse_tier(ctx):
+    """The sparse (Criteo-path) aggregation also fuses: same dispatch bound."""
+    from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+    from cycloneml_tpu.ml.optim.sparse_aggregators import binary_logistic_sparse
+
+    rng = np.random.RandomState(3)
+    n, k, D = 512, 6, 100
+    idx = rng.randint(0, D, size=(n, k)).astype(np.int32)
+    val = np.abs(rng.randn(n, k))
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    sds = SparseInstanceDataset.from_ell(ctx, idx, val, y=y, n_features=D)
+    loss = DistributedLossFunction(sds, binary_logistic_sparse(D, False))
+    st = LBFGS(max_iter=10, tol=0.0).minimize(loss, np.zeros(D))
+    assert loss.n_dispatches <= st.iteration + 2
+    assert np.all(np.isfinite(st.x))
