@@ -108,7 +108,14 @@ class GradientDescent:
 
         w = np.asarray(x0, dtype=np.float64).copy()
         history: list = []
-        prev = None
+        # seed regVal from the INITIAL weights with a zero gradient, exactly
+        # as runMiniBatchSGD does before the loop (GradientDescent.scala:249
+        # "compute the initial regVal") — each history entry then pairs the
+        # pre-update stochastic loss with the reg value of the weights the
+        # loss was evaluated AT, not the post-update ones
+        _, reg = self.updater.compute(w, np.zeros_like(w), 0.0, 1,
+                                      self.reg_param)
+        updates = 0
         for t in range(1, self.num_iterations + 1):
             out = compiled(jnp.asarray(w, jnp.float32),
                            jnp.asarray(t, jnp.int32))
@@ -120,13 +127,20 @@ class GradientDescent:
                 continue
             loss = float(out["loss"]) / count
             grad = np.asarray(out["grad"], dtype=np.float64) / count
+            history.append(loss + reg)
+            prev_w = w
             w, reg = self.updater.compute(w, grad, self.step_size, t,
                                           self.reg_param)
-            history.append(loss + reg)
-            if prev is not None and self.convergence_tol > 0:
-                denom = max(abs(prev), abs(history[-1]), 1e-12)
-                if abs(prev - history[-1]) / denom < self.convergence_tol:
+            updates += 1
+            # reference convergence test (GradientDescent.isConverged):
+            # ‖w_t − w_{t−1}‖ < tol · max(‖w_{t−1}‖, 1); never checked on the
+            # first ACTUAL update (the reference's previousWeights is still
+            # None then — w₁ vs the user-supplied x0 is not a convergence
+            # signal, and skipped empty mini-batches don't count)
+            if self.convergence_tol > 0 and updates > 1:
+                delta = float(np.linalg.norm(w - prev_w))
+                if delta < self.convergence_tol * max(
+                        float(np.linalg.norm(prev_w)), 1.0):
                     logger.info("GradientDescent converged at iteration %d", t)
                     break
-            prev = history[-1]
         return w, history

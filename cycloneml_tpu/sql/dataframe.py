@@ -177,11 +177,41 @@ class DataFrame:
 
     def sample(self, fraction: float, seed: Optional[int] = None
                ) -> "DataFrame":
-        """(ref Dataset.sample) — Bernoulli row sample without replacement
-        (lazy; on streams it resamples each micro-batch)."""
+        """(ref Dataset.sample) — Bernoulli row sample without replacement.
+
+        The seed is resolved at plan-construction time (the reference draws
+        ``Utils.random.nextLong`` in Dataset.sample for the same reason): a
+        sampled DataFrame is self-consistent — count/collect/write all see
+        the same rows. On streams, the per-batch seed folds in a fingerprint
+        of the batch content, so distinct micro-batches sample independently
+        while re-execution of the same batch (recovery replay) is exact.
+        """
+        import random as _random
+        import zlib
+        plan_seed = (_random.SystemRandom().randrange(2 ** 31)
+                     if seed is None else int(seed))
+        # fingerprinting costs O(data) per execution, so it is scoped to
+        # streaming plans — batch plans get plan_seed alone, which already
+        # makes repeated actions agree (the batch content is fixed)
+        streaming = self.is_streaming
+
+        def _fingerprint(batch: Dict[str, np.ndarray]) -> int:
+            crc = 0
+            for k in sorted(batch):
+                v = np.asarray(batch[k])
+                crc = zlib.crc32(k.encode(), crc)
+                if v.dtype == object:
+                    for item in v.tolist():
+                        crc = zlib.crc32(str(item).encode(), crc)
+                else:
+                    crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+            return crc
+
         def compute(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
             n = len(next(iter(batch.values()))) if batch else 0
-            mask = np.random.RandomState(seed).rand(n) < fraction
+            s = (plan_seed ^ _fingerprint(batch)) & 0x7FFFFFFF \
+                if streaming else plan_seed
+            mask = np.random.RandomState(s).rand(n) < fraction
             return {k: v[mask] for k, v in batch.items()}
 
         from cycloneml_tpu.sql.plan import MapBatch

@@ -257,10 +257,19 @@ class WindowFnExpr(Expr):
                 run = run / (np.arange(n) - np.maximum.accumulate(
                     np.where(starts, np.arange(n), 0)) + 1)
         elif agg.fn in ("min", "max"):
-            # segmented cummin/cummax: vectorized via pandas' C groupby
-            import pandas as pd
-            g = pd.Series(child_vals).groupby(sorted_codes)
-            run = (g.cummin() if agg.fn == "min" else g.cummax()).to_numpy()
+            # segmented cummin/cummax: pandas' C groupby when available,
+            # otherwise a per-partition numpy accumulate (pandas is an
+            # optional bridge dependency, never a hard one)
+            try:
+                import pandas as pd
+                g = pd.Series(child_vals).groupby(sorted_codes)
+                run = (g.cummin() if agg.fn == "min" else g.cummax()).to_numpy()
+            except ImportError:
+                op = np.minimum if agg.fn == "min" else np.maximum
+                bounds = np.flatnonzero(starts).tolist() + [n]
+                run = np.empty(n, dtype=np.float64)
+                for s, e in zip(bounds[:-1], bounds[1:]):
+                    run[s:e] = op.accumulate(child_vals[s:e])
         else:
             raise ValueError(
                 f"aggregate {agg.fn!r} unsupported over an ordered window")

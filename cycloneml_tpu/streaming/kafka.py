@@ -12,11 +12,23 @@ Offsets: the engine's single monotonically-increasing int offset maps to a
 row count; per-partition Kafka offsets are tracked internally and snapshots
 of consumed-but-uncommitted rows are buffered so ``get_batch`` stays
 replayable until ``commit`` (the Source contract).
+
+Restart durability: under a checkpointed query the engine calls
+``set_log_dir`` (same hook as FileStreamSource), and the source persists
+(a) the committed engine offset + per-partition Kafka offsets and (b) a WAL
+of consumed-but-uncommitted rows. A restarted query therefore rebuilds the
+exact replay buffer — engine offsets recovered from the query's offset log
+map to the same rows — and seeks the consumer past everything already
+WAL'd, preserving the exactly-once restart contract (ref: KafkaSource logs
+per-partition offset ranges in the offset log for the same reason).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+import base64
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +68,103 @@ class KafkaSource(Source):
                 enable_auto_commit=False, auto_offset_reset="earliest")
         self._rows: List[tuple] = []  # replay buffer of consumed rows
         self._base = 0  # engine offset of _rows[0]
+        self._log_dir: Optional[str] = None
+        self._wal_fh = None  # append handle for the pending-row WAL
+        # (topic, partition) -> next Kafka offset; string-encoded only at the
+        # offsets.json boundary
+        self._pp_committed: Dict[Tuple[str, int], int] = {}
+        # next expected Kafka offset per partition over EVERYTHING buffered or
+        # committed — the dedup filter that makes re-delivery (failed seek,
+        # group-rebalance replay, auto_offset_reset=earliest) harmless, and
+        # the counter that synthesizes offsets for records lacking one
+        self._pp_next: Dict[Tuple[str, int], int] = {}
+
+    # -- checkpoint persistence -------------------------------------------
+    def set_log_dir(self, path: str) -> None:
+        """Recover committed base + pending rows from a query checkpoint.
+
+        ``offsets.json`` holds the state at the last commit (committed engine
+        offset, per-partition next-Kafka-offset); ``wal.jsonl`` holds every
+        consumed-but-uncommitted row. Loading both rebuilds ``_rows``/``_base``
+        exactly as the previous instance had them; the consumer is then
+        seeked past the recovered positions, and the per-partition dedup
+        filter drops any re-delivered row even if the seek could not land.
+        Idempotent: a second call only re-points the WAL (recovery state is
+        loaded once — re-loading would double-append the replay buffer).
+        """
+        os.makedirs(path, exist_ok=True)
+        wal_p = os.path.join(path, "wal.jsonl")
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+            self._wal_fh = None
+        first = self._log_dir is None
+        self._log_dir = path
+        if not first:
+            self._wal_fh = open(wal_p, "a", encoding="utf-8")
+            return
+        meta_p = os.path.join(path, "offsets.json")
+        if os.path.exists(meta_p) and os.path.getsize(meta_p) > 0:
+            with open(meta_p, encoding="utf-8") as fh:
+                meta = json.load(fh)
+            self._base = int(meta["base"])
+            self._pp_committed = {_tp_from_str(k): int(v)
+                                  for k, v in meta.get("partitions", {}).items()}
+            self._pp_next.update(self._pp_committed)
+        if os.path.exists(wal_p):
+            with open(wal_p, encoding="utf-8") as fh:
+                lines = fh.readlines()
+            for i, line in enumerate(lines):
+                if not line.strip():
+                    continue
+                try:
+                    row = _row_from_json(json.loads(line))
+                except ValueError:
+                    if i == len(lines) - 1:
+                        break  # torn final record from a crash mid-append
+                    raise  # corruption mid-log is NOT survivable silently
+                tp = (row[2], int(row[3]))
+                if int(row[4]) < self._pp_committed.get(tp, 0):
+                    # crash between meta write and WAL compaction left a
+                    # committed row behind
+                    continue
+                self._rows.append(row)
+                self._pp_next[tp] = max(self._pp_next.get(tp, 0),
+                                        int(row[4]) + 1)
+        self._wal_fh = open(wal_p, "a", encoding="utf-8")
+        self._seek()
+
+    def _seek(self) -> None:
+        """Best-effort: point a real consumer at the recovered offsets.
+
+        A subscribed kafka-python consumer has no partition assignment until
+        its first poll, so one zero-timeout poll forces assignment first; its
+        records go through the normal ingest path (the dedup filter drops
+        anything already recovered). Failure is safe — re-delivered rows are
+        deduped — seeking just avoids re-reading from the earliest offset.
+        """
+        if not self._pp_next or not hasattr(self._consumer, "seek"):
+            return
+        # the forced-assignment poll uses the NORMAL ingest path: decode/IO
+        # errors must surface exactly as they would on any other poll — only
+        # the seek itself is best-effort (the dedup filter covers its failure)
+        self._ingest(self._consumer.poll(timeout_ms=0))
+        try:
+            from kafka import TopicPartition
+            for (topic, part), off in list(self._pp_next.items()):
+                self._consumer.seek(TopicPartition(topic, part), off)
+        except Exception:
+            pass  # fake/embedded consumers replay from their own state
+
+    def close(self) -> None:
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+            self._wal_fh = None
+
+    def __del__(self):  # best-effort: queries have no source-close hook yet
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _decode(self, v, enabled: bool, field: str):
         """An enabled field asserts text: its column type is then uniformly
@@ -73,17 +182,47 @@ class KafkaSource(Source):
                 f"KafkaSource(..., {flag}=False) for binary data") from e
 
     def _poll(self) -> None:
-        records = self._consumer.poll(timeout_ms=self.poll_timeout_ms)
-        for batch in records.values():
-            for r in batch:
-                self._rows.append((
-                    self._decode(r.key, self.decode_key, "key"),
-                    self._decode(r.value, self.decode, "value"),
-                    getattr(r, "topic", self.topic),
-                    getattr(r, "partition", 0),
-                    getattr(r, "offset", 0),
-                    getattr(r, "timestamp", 0),
-                ))
+        self._ingest(self._consumer.poll(timeout_ms=self.poll_timeout_ms))
+
+    def _ingest(self, records) -> None:
+        """Normalize, dedup, buffer and WAL a poll() result.
+
+        Records lacking a real ``.offset`` get a synthesized per-partition
+        monotonic one (so the recovery filter never misreads a default);
+        records whose offset sits below the partition's next-expected
+        position are re-deliveries and are dropped.
+        """
+        wrote = False
+        try:
+            for batch in records.values():
+                for r in batch:
+                    topic = getattr(r, "topic", self.topic)
+                    part = int(getattr(r, "partition", 0))
+                    tp = (topic, part)
+                    off = getattr(r, "offset", None)
+                    if off is None:
+                        off = self._pp_next.get(tp, 0)
+                    elif int(off) < self._pp_next.get(tp, 0):
+                        continue  # already buffered or committed
+                    row = (
+                        self._decode(r.key, self.decode_key, "key"),
+                        self._decode(r.value, self.decode, "value"),
+                        topic, part, int(off),
+                        getattr(r, "timestamp", 0),
+                    )
+                    # buffer + WAL per record, and only THEN mark seen: an
+                    # exception on a later record in the same poll (decode
+                    # error) must not strand earlier rows as
+                    # seen-but-never-buffered
+                    self._rows.append(row)
+                    if self._wal_fh is not None:
+                        self._wal_fh.write(json.dumps(_row_to_json(row)) + "\n")
+                        wrote = True
+                    self._pp_next[tp] = int(off) + 1
+        finally:
+            if wrote:
+                self._wal_fh.flush()
+                os.fsync(self._wal_fh.fileno())
 
     def latest_offset(self) -> int:
         self._poll()
@@ -105,10 +244,81 @@ class KafkaSource(Source):
         """Discard replay rows up to ``end`` and commit consumer offsets."""
         drop = end - self._base
         if drop > 0:
+            for row in self._rows[:drop]:
+                tp = (row[2], int(row[3]))
+                self._pp_committed[tp] = max(
+                    self._pp_committed.get(tp, 0), int(row[4]) + 1)
             self._rows = self._rows[drop:]
             self._base = end
+            self._persist_commit()
         if hasattr(self._consumer, "commit"):
             try:
                 self._consumer.commit()
             except Exception:
                 pass  # commit is an optimization; replay covers recovery
+
+    def _persist_commit(self) -> None:
+        """Atomically rewrite offsets.json, then compact the WAL down to the
+        still-pending rows. Order matters for crash safety: a crash between
+        the two leaves committed rows in the WAL, which recovery tolerates
+        (their Kafka offsets sit below the committed per-partition positions
+        and set_log_dir filters them out)."""
+        if self._log_dir is None:
+            return
+        meta_p = os.path.join(self._log_dir, "offsets.json")
+        tmp = meta_p + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"base": self._base,
+                       "partitions": {_tp_to_str(k): v
+                                      for k, v in self._pp_committed.items()}},
+                      fh)
+            fh.flush()
+            os.fsync(fh.fileno())  # replace is only atomic if the tmp is durable
+        os.replace(tmp, meta_p)
+        wal_p = os.path.join(self._log_dir, "wal.jsonl")
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+        wtmp = wal_p + ".tmp"
+        with open(wtmp, "w", encoding="utf-8") as fh:
+            for row in self._rows:
+                fh.write(json.dumps(_row_to_json(row)) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(wtmp, wal_p)
+        try:  # make both renames themselves durable
+            dfd = os.open(self._log_dir, os.O_RDONLY)
+            os.fsync(dfd)
+            os.close(dfd)
+        except OSError:
+            pass  # directory fsync unsupported on this platform
+        self._wal_fh = open(wal_p, "a", encoding="utf-8")
+
+
+def _tp_to_str(tp: Tuple[str, int]) -> str:
+    """offsets.json key encoding; partition LAST so rpartition('-') inverts
+    it even for topic names containing '-'."""
+    return f"{tp[0]}-{tp[1]}"
+
+
+def _tp_from_str(s: str) -> Tuple[str, int]:
+    topic, _, part = s.rpartition("-")
+    return topic, int(part)
+
+
+def _row_to_json(row: tuple) -> list:
+    """JSON-safe row encoding; bytes fields round-trip via base64 tags."""
+    out = []
+    for v in row:
+        if isinstance(v, bytes):
+            out.append({"b64": base64.b64encode(v).decode("ascii")})
+        elif isinstance(v, (np.integer, np.floating)):
+            out.append(v.item())
+        else:
+            out.append(v)
+    return out
+
+
+def _row_from_json(vals: list) -> tuple:
+    return tuple(base64.b64decode(v["b64"])
+                 if isinstance(v, dict) and "b64" in v else v
+                 for v in vals)

@@ -223,9 +223,13 @@ class StatefulAggregation:
                 key = tuple(
                     k[row].item() if isinstance(k[row], np.generic) else k[row]
                     for k in keys)
-                if (self.mode == "append" and watermark is not None
+                if (self.mode in ("append", "update") and watermark is not None
+                        and self.watermark_key_idx is not None
                         and self._expired(key, watermark)):
-                    continue  # late data: its group was already finalized
+                    # late data: append already finalized the group; update
+                    # already evicted it (StateStoreSaveExec drops late rows
+                    # in both modes)
+                    continue
                 state = store.get(key) or {}
                 for pkey, a in self.agg_ids:
                     state[pkey] = _merge_partial(a.fn, state.get(pkey),
@@ -236,6 +240,14 @@ class StatefulAggregation:
         if self.mode == "complete":
             return self._emit([(k, v) for k, v in store.items()])
         if self.mode == "update":
+            # update mode also evicts watermark-expired groups (without
+            # emitting them — they were already emitted on their last change;
+            # ref: StateStoreSaveExec update-mode removeKeysOlderThanWatermark)
+            # so long-running queries don't leak state without bound
+            if watermark is not None and self.watermark_key_idx is not None:
+                for k, _ in list(store.items()):
+                    if self._expired(k, watermark):
+                        store.remove(k)
             return self._emit([(k, store.get(k)) for k in touched])
         # append: emit + evict groups whose window END passed the watermark
         out: List[Tuple[Tuple, Dict]] = []

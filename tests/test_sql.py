@@ -320,3 +320,38 @@ def test_describe_sample_na():
     assert 200 < n < 400  # Bernoulli around 300
     # deterministic under a fixed seed
     assert s.range(1000).sample(0.3, seed=42).count() == n
+
+
+def test_sample_self_consistent_without_seed():
+    """seed=None resolves to a concrete seed at plan-build time (ref
+    Dataset.sample draws Utils.random.nextLong): the same sampled DataFrame
+    must agree with itself across actions."""
+    s = CycloneSession()
+    sampled = s.range(1000).sample(0.5)
+    n = sampled.count()
+    assert n == sampled.count() == len(sampled.collect())
+    # two independently-built samples differ (overwhelmingly likely)
+    other = s.range(1000).sample(0.5)
+    assert (other.count() != n
+            or [r.id for r in other.collect()] != [r.id for r in sampled.collect()])
+
+
+def test_sample_streaming_batches_independent():
+    """Distinct micro-batches must sample independently even under a fixed
+    seed — the mask depends on batch content, not just the seed."""
+    import numpy as np
+    from cycloneml_tpu.streaming.sources import MemoryStream
+    s = CycloneSession()
+    src = MemoryStream(["v"])
+    df = src.to_df(s).sample(0.5, seed=7)
+    q = df.write_stream.format("memory").start()
+    src.add_data(v=np.arange(0, 400))
+    q.process_all_available()
+    n1 = len(q.sink.rows())
+    src.add_data(v=np.arange(400, 800))
+    q.process_all_available()
+    rows = [r[0] for r in q.sink.rows()]
+    q.stop()
+    first = set(v % 400 for v in rows[:n1])
+    second = set(v % 400 for v in rows[n1:])
+    assert first != second  # same positions would mean the mask repeated

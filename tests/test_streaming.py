@@ -114,6 +114,36 @@ def test_streaming_agg_update_mode(session):
     assert q.sink.rows() == [("x", 6.0, 3, 2.0)]
 
 
+def test_update_mode_watermark_evicts_state(session):
+    """Update mode with a watermark must evict expired groups (without
+    re-emitting them) and drop late rows — otherwise long-running update
+    queries leak state without bound (ref: StateStoreSaveExec evicts in
+    update mode too)."""
+    ms = MemoryStream(["ts", "v"])
+    df = (ms.to_df(session)
+          .with_watermark("ts", 10.0)
+          .group_by("ts").agg(F.sum("v").alias("s")))
+    q = start_memory_query(df, mode="update")
+    ms.add_data(ts=[100.0, 100.0], v=[1.0, 2.0])
+    q.process_all_available()
+    assert sorted(q.sink.rows()) == [(100.0, 3.0)]
+    # advance the watermark far past group 100: it must be evicted
+    ms.add_data(ts=[200.0], v=[5.0])
+    q.process_all_available()
+    sp = q._exec.state_provider
+    keys = [k for k, _ in sp.get_store(sp.latest_version()).items()]
+    assert (100.0,) not in keys  # expired group evicted
+    assert (200.0,) in keys
+    # a late row for the evicted group is dropped, not resurrected
+    q.sink.clear()
+    ms.add_data(ts=[100.0], v=[99.0])
+    q.process_all_available()
+    assert all(r[0] != 100.0 for r in q.sink.rows())
+    keys = [k for k, _ in sp.get_store(sp.latest_version()).items()]
+    assert (100.0,) not in keys
+    q.stop()
+
+
 def test_streaming_agg_complete_mode_with_sort_above(session):
     ms = MemoryStream(["k"])
     df = (ms.to_df(session).group_by("k").agg(F.count("*").alias("n"))

@@ -84,6 +84,27 @@ def test_running_min_max_avg(df):
     np.testing.assert_allclose(out["av"], [10.0, 15.0, 20.0, 100.0, 150.0])
 
 
+def test_running_min_max_without_pandas(df, monkeypatch):
+    """Ordered-window min/max must work when pandas is absent (it is an
+    optional bridge dependency): the numpy per-partition accumulate fallback
+    must produce the same result."""
+    import builtins
+    real_import = builtins.__import__
+
+    def no_pandas(name, *a, **k):
+        if name == "pandas" or name.startswith("pandas."):
+            raise ImportError("pandas blocked for test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_pandas)
+    w = Window.partition_by("k").order_by("t")
+    out = (df.with_column("mn", F.min("v").over(w))
+             .with_column("mx", F.max("v").over(w))
+             .order_by("k", "t").to_dict())
+    np.testing.assert_allclose(out["mn"], [10.0, 10.0, 10.0, 100.0, 100.0])
+    np.testing.assert_allclose(out["mx"], [10.0, 20.0, 30.0, 100.0, 200.0])
+
+
 def test_range_frame_peers_share_value():
     """Ties on the order key take the frame value of the LAST peer (RANGE
     default, as the reference)."""
