@@ -260,8 +260,24 @@ class InstanceDataset:
         self._y = y
         self._w = w
         self._host: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        # (y, w) host twins kept when construction started from numpy —
+        # estimators read label histograms/weights every fit, and a
+        # device→host readback through a TPU relay costs seconds
+        self._yw_host: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.n_rows = n_rows
         self.n_features = n_features
+
+    def y_host(self) -> np.ndarray:
+        """Padded label vector as numpy, without a device readback when the
+        dataset was built from host arrays."""
+        if self._yw_host is not None:
+            return self._yw_host[0]
+        return np.asarray(self.y)
+
+    def w_host(self) -> np.ndarray:
+        if self._yw_host is not None:
+            return self._yw_host[1]
+        return np.asarray(self.w)
 
     def _restore_device(self) -> None:
         if self._x is None and self._host is not None:
@@ -293,11 +309,13 @@ class InstanceDataset:
             dtype = compute_dtype()
         rt = ctx.mesh_runtime
         x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism, dtype=dtype)
-        return cls(ctx,
-                   rt.device_put_sharded_rows(x_p),
-                   rt.device_put_sharded_rows(y_p),
-                   rt.device_put_sharded_rows(w_p),
-                   n, x.shape[1])
+        ds = cls(ctx,
+                 rt.device_put_sharded_rows(x_p),
+                 rt.device_put_sharded_rows(y_p),
+                 rt.device_put_sharded_rows(w_p),
+                 n, x.shape[1])
+        ds._yw_host = (y_p, w_p)
+        return ds
 
     @property
     def shape(self) -> Tuple[int, int]:

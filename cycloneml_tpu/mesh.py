@@ -40,6 +40,36 @@ _LOCAL_MESH_RE = re.compile(r"local-mesh\[(\d+|\*)\]")
 _MULTIHOST_RE = re.compile(r"multihost\[([^,\]]+),(\d+),(\d+)\]")
 
 
+_comp_cache_enabled = False
+
+
+def _enable_compilation_cache(jax) -> None:
+    """Persist compiled XLA executables on disk across processes.
+
+    TPU compiles are the dominant fixed cost (tens of seconds per program
+    through a remote backend), and every new process would otherwise pay
+    them again — the reference ships pre-compiled JVM bytecode and never
+    has this problem, so matching its warm-start behavior requires the
+    persistent cache. Off-switch: CYCLONE_NO_COMPILATION_CACHE=1.
+    """
+    global _comp_cache_enabled
+    if _comp_cache_enabled or __import__("os").environ.get(
+            "CYCLONE_NO_COMPILATION_CACHE"):
+        return
+    import os
+    path = os.environ.get(
+        "CYCLONE_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/cycloneml_tpu/xla-cache"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _comp_cache_enabled = True
+    except Exception as e:  # cache is an optimization, never a hard failure
+        logger.info("persistent compilation cache unavailable: %s", e)
+
+
 class MeshRuntime:
     """Owns the global device mesh and sharding helpers."""
 
@@ -50,6 +80,12 @@ class MeshRuntime:
 
         self._jax = jax
         devices = self._resolve_devices(master)
+        if devices and devices[0].platform != "cpu":
+            # TPU/accelerator only: XLA:CPU AOT cache entries record compile-
+            # machine features that the loader may refuse or execute with
+            # different codegen (observed: prefer-no-scatter mismatch causing
+            # reduction-order drift in tests); CPU compiles are cheap anyway
+            _enable_compilation_cache(jax)
         n = len(devices)
         if n % (n_replicas * model_parallelism) != 0:
             raise ValueError(
@@ -173,3 +209,5 @@ def active() -> Optional[MeshRuntime]:
 def reset() -> None:
     global _active
     _active = None
+    from cycloneml_tpu.parallel import collectives
+    collectives.clear_program_cache()

@@ -120,8 +120,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
 
         # label histogram via one psum pass (≈ the summary treeAggregate at
         # LogisticRegression.scala:515 area)
-        y_host = np.asarray(ds.y)
-        w_host = np.asarray(ds.w)
+        y_host = ds.y_host()
+        w_host = ds.w_host()
         num_classes = int(y_host.max()) + 1 if ds.n_rows else 2
         family = self.get("family")
         if family == "auto":

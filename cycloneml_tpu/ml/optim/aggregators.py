@@ -63,8 +63,14 @@ def binary_logistic(d: int, fit_intercept: bool = True) -> Agg:
     loss_i = w_i * (softplus(m_i) - y_i * m_i) with margin m = x·β + β₀ —
     algebraically the same stable form the reference branches on label.
     """
+    return _binary_logistic(d, fit_intercept, matmul_precision())
 
-    prec = matmul_precision()
+
+@functools.lru_cache(maxsize=None)
+def _binary_logistic(d: int, fit_intercept: bool, prec) -> Agg:
+    # factories are lru-cached on their semantic parameters so repeated fits
+    # hand tree_aggregate the SAME function object — program-cache identity
+    # (collectives._program_cache) is what prevents a recompile per fit
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
@@ -83,8 +89,11 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
     (ref MultinomialLogisticBlockAggregator.scala; the reference also keeps
     all k vectors rather than k-1, making the problem over-parameterised
     exactly like this)."""
+    return _multinomial_logistic(d, k, fit_intercept, matmul_precision())
 
-    prec = matmul_precision()
+
+@functools.lru_cache(maxsize=None)
+def _multinomial_logistic(d: int, k: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         if fit_intercept:
@@ -113,8 +122,11 @@ def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
 
 def least_squares(d: int, fit_intercept: bool = True) -> Agg:
     """Squared loss ½ w (x·β + β₀ − y)² (ref LeastSquaresBlockAggregator)."""
+    return _least_squares(d, fit_intercept, matmul_precision())
 
-    prec = matmul_precision()
+
+@functools.lru_cache(maxsize=None)
+def _least_squares(d: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
@@ -131,8 +143,11 @@ def least_squares(d: int, fit_intercept: bool = True) -> Agg:
 def hinge(d: int, fit_intercept: bool = True) -> Agg:
     """Hinge loss for LinearSVC (ref HingeBlockAggregator): labels in {0,1}
     mapped to ±1 as 2y−1; loss_i = w_i max(0, 1 − ŷ_i m_i)."""
+    return _hinge(d, fit_intercept, matmul_precision())
 
-    prec = matmul_precision()
+
+@functools.lru_cache(maxsize=None)
+def _hinge(d: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
@@ -152,8 +167,11 @@ def huber(d: int, fit_intercept: bool = True, epsilon: float = 1.35) -> Agg:
     """Huber loss with jointly-optimised scale σ (ref HuberBlockAggregator,
     following Owen 2007 as the reference does): coef = [β, β₀?, σ];
     loss_i = w_i (σ + ℓ_ε((y−μ)/σ) σ)."""
+    return _huber(d, fit_intercept, float(epsilon), matmul_precision())
 
-    prec = matmul_precision()
+
+@functools.lru_cache(maxsize=None)
+def _huber(d: int, fit_intercept: bool, epsilon: float, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef[:-1], d, fit_intercept)

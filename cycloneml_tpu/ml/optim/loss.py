@@ -10,12 +10,20 @@ L-BFGS iteration instead of one Spark job (SURVEY §3.3).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from cycloneml_tpu.dataset.dataset import InstanceDataset
 from cycloneml_tpu.parallel import collectives
+
+
+def _weight_sum_agg(*arrs):
+    """w is the last sharded array for both the dense (x, y, w) and sparse
+    (indices, values, y, w) dataset tiers."""
+    import jax.numpy as jnp
+    return {"ws": jnp.sum(arrs[-1])}
 
 
 class DistributedLossFunction:
@@ -36,16 +44,13 @@ class DistributedLossFunction:
         self._ctx = dataset.ctx
         self.l2_reg_fn = l2_reg_fn
         if weight_sum is None:
-            import jax.numpy as jnp
-            # w is the last sharded array for both the dense (x, y, w) and
-            # sparse (indices, values, y, w) dataset tiers
-            ws = dataset.tree_aggregate_fn(
-                lambda *arrs: {"ws": jnp.sum(arrs[-1])})()
+            # _weight_sum_agg is module-level so its program is cached across
+            # fits (a fresh lambda here cost a full XLA recompile per fit)
+            ws = dataset.tree_aggregate_fn(_weight_sum_agg)()
             weight_sum = float(ws["ws"])
         self.weight_sum = weight_sum
         self.n_evals = 0
         self.n_dispatches = 0  # host->device round trips (the relay cost)
-        self._ls_cache: dict = {}
 
     def __call__(self, coef: np.ndarray) -> Tuple[float, np.ndarray]:
         self.n_evals += 1
@@ -87,16 +92,33 @@ class DistributedLossFunction:
         # line-search arithmetic follows the data tier's dtype: f32 on TPU,
         # f64 under x64 tests (where it then matches the host path exactly)
         cdt = np.dtype(arrays[-1].dtype)
-        key = (float(c1), float(c2), int(max_evals), cdt.str)
-        fn = self._ls_cache.get(key)
+        l2_t = getattr(self.l2_reg_fn, "traceable", None) \
+            if self.l2_reg_fn is not None else None
+        # the cache is module-level and keyed on PROGRAM identity (the cached
+        # aggregation program + the cached l2 traceable): repeated fits with
+        # the same configuration reuse one compiled executable instead of
+        # paying a ~30 s TPU recompile per fit. weight_sum is a runtime
+        # argument for the same reason — baking it in would fork the cache.
+        key = (self._agg_call.compiled, l2_t, float(c1), float(c2),
+               int(max_evals), cdt.str)
+        fn = _ls_program_cache.get(key)
         if fn is None:
-            fn = self._build_line_search(c1, c2, max_evals, cdt)
-            self._ls_cache[key] = fn
+            fn = _build_line_search(self._agg_call.compiled, l2_t,
+                                    c1, c2, max_evals, cdt)
+            _ls_program_cache[key] = fn
+            # bounded: standardization=False fits key on a fresh l2 fn per
+            # fit and would otherwise grow this without limit (eviction only
+            # costs future reuse — the caller holds its own reference)
+            while len(_ls_program_cache) > 64:
+                _ls_program_cache.pop(next(iter(_ls_program_cache)))
+        else:
+            _ls_program_cache[key] = _ls_program_cache.pop(key)  # LRU touch
         out = jax.device_get(fn(*arrays,
                                 np.asarray(x, dtype=cdt),
                                 np.asarray(direction, dtype=cdt),
                                 cdt.type(value), cdt.type(dg0),
-                                cdt.type(init_alpha)))
+                                cdt.type(init_alpha),
+                                cdt.type(self.weight_sum)))
         alpha, v, g, evals = out
         self.n_evals += int(evals)
         self.n_dispatches += 1
@@ -105,126 +127,137 @@ class DistributedLossFunction:
             self._ctx.record_step({"loss": loss, "line_search_evals": int(evals)})
         return float(alpha), loss, np.asarray(g, dtype=np.float64)
 
-    def _build_line_search(self, c1: float, c2: float, max_evals: int,
-                           cdt: np.dtype):
-        import jax
-        import jax.numpy as jnp
 
-        compiled = self._agg_call.compiled
-        ws = cdt.type(self.weight_sum)  # divide, matching the host path's
-        # `loss / weight_sum` bit-for-bit (a reciprocal-multiply drifts in
-        # the last ulp, which 40 unregularized iterations amplify)
-        l2_t = getattr(self.l2_reg_fn, "traceable", None) \
-            if self.l2_reg_fn is not None else None
+_ls_program_cache: dict = {}
 
-        def program(*args):
-            arrays = args[:-5]
-            x0, dirn, value0, dg0, init_alpha = args[-5:]
 
-            def phi(alpha):
-                coef = x0 + alpha * dirn
-                out = compiled(*arrays, coef)
-                loss = (out["loss"] / ws).astype(cdt)
-                grad = (out["grad"] / ws).astype(cdt)
-                if l2_t is not None:
-                    rl, rg = l2_t(coef)
-                    loss = loss + rl
-                    grad = grad + rg
-                return loss, grad, jnp.dot(dirn, grad)
+def _build_line_search(compiled, l2_t, c1: float, c2: float, max_evals: int,
+                       cdt: np.dtype):
+    import jax
+    import jax.numpy as jnp
 
-            d = x0.shape[0]
-            zero = cdt.type(0.0)
-            state = dict(
-                phase=jnp.int32(0),   # 0 bracket, 1 zoom, 2 done
-                evals=jnp.int32(0), bi=jnp.int32(0), zj=jnp.int32(0),
-                alpha_prev=zero, v_prev=value0, d_prev=dg0,
-                alpha_next=init_alpha,
-                lo=zero, hi=zero,
-                v_lo=zero, d_lo=zero,
-                v_hi=zero,
-                res_alpha=zero, res_v=value0,
-                res_g=jnp.zeros((d,), cdt),
+    def program(*args):
+        arrays = args[:-6]
+        x0, dirn, value0, dg0, init_alpha, ws = args[-6:]
+        # divide by ws, matching the host path's `loss / weight_sum`
+        # bit-for-bit (a reciprocal-multiply drifts in the last ulp,
+        # which 40 unregularized iterations amplify)
+
+        def phi(alpha):
+            coef = x0 + alpha * dirn
+            out = compiled(*arrays, coef)
+            loss = (out["loss"] / ws).astype(cdt)
+            grad = (out["grad"] / ws).astype(cdt)
+            if l2_t is not None:
+                rl, rg = l2_t(coef)
+                loss = loss + rl
+                grad = grad + rg
+            return loss, grad, jnp.dot(dirn, grad)
+
+        d = x0.shape[0]
+        zero = cdt.type(0.0)
+        state = dict(
+            phase=jnp.int32(0),   # 0 bracket, 1 zoom, 2 done
+            evals=jnp.int32(0), bi=jnp.int32(0), zj=jnp.int32(0),
+            alpha_prev=zero, v_prev=value0, d_prev=dg0,
+            alpha_next=init_alpha,
+            lo=zero, hi=zero,
+            v_lo=zero, d_lo=zero,
+            v_hi=zero,
+            res_alpha=zero, res_v=value0,
+            res_g=jnp.zeros((d,), cdt),
+        )
+
+        def cond(s):
+            return s["phase"] < 2
+
+        def body(s):
+            in_bracket = s["phase"] == 0
+            alpha = jnp.where(in_bracket, s["alpha_next"],
+                              0.5 * (s["lo"] + s["hi"]))
+            v, g, dg = phi(alpha)
+            armijo_fail = v > value0 + c1 * alpha * dg0
+            wolfe_ok = jnp.abs(dg) <= -c2 * dg0
+
+            # -- bracket phase (Nocedal-Wright alg 3.5) --
+            b_zoom_a = armijo_fail | ((s["bi"] > 0) & (v >= s["v_prev"]))
+            b_done = (~b_zoom_a) & wolfe_ok
+            b_zoom_b = (~b_zoom_a) & (~b_done) & (dg >= 0)
+            b_cont = ~(b_zoom_a | b_done | b_zoom_b)
+            # budget exhausted while still bracketing: accept current eval
+            # (the host path's fallback re-evaluates at the next doubled α;
+            # this branch is unreachable in practice — 30 doublings)
+            b_exhaust = b_cont & (s["bi"] + 1 >= max_evals)
+            enter_zoom = b_zoom_a | b_zoom_b
+
+            # -- zoom phase (alg 3.6) --
+            z_hi_a = armijo_fail | (v >= s["v_lo"])
+            z_done = (~z_hi_a) & wolfe_ok
+            z_flip = (~z_hi_a) & (~z_done) & (dg * (s["hi"] - s["lo"]) >= 0)
+            z_hi = jnp.where(z_hi_a, alpha, jnp.where(z_flip, s["lo"], s["hi"]))
+            z_v_hi = jnp.where(z_hi_a, v, jnp.where(z_flip, s["v_lo"], s["v_hi"]))
+            z_lo = jnp.where(z_hi_a, s["lo"], alpha)
+            z_v_lo = jnp.where(z_hi_a, s["v_lo"], v)
+            z_d_lo = jnp.where(z_hi_a, s["d_lo"], dg)
+            z_exhaust = (jnp.abs(z_hi - z_lo) < 1e-12) | \
+                (s["zj"] + 1 >= max_evals)
+
+            phase = jnp.where(
+                in_bracket,
+                jnp.where(b_done | b_exhaust, 2,
+                          jnp.where(enter_zoom, 1, 0)),
+                jnp.where(z_done | z_exhaust, 2, 1)).astype(jnp.int32)
+
+            # zoom bracket: freshly entered from bracket phase, or updated
+            lo = jnp.where(in_bracket,
+                           jnp.where(b_zoom_a, s["alpha_prev"], alpha),
+                           z_lo)
+            v_lo = jnp.where(in_bracket,
+                             jnp.where(b_zoom_a, s["v_prev"], v), z_v_lo)
+            d_lo = jnp.where(in_bracket,
+                             jnp.where(b_zoom_a, s["d_prev"], dg), z_d_lo)
+            hi = jnp.where(in_bracket,
+                           jnp.where(b_zoom_a, alpha, s["alpha_prev"]),
+                           z_hi)
+            v_hi = jnp.where(in_bracket,
+                             jnp.where(b_zoom_a, v, s["v_prev"]), z_v_hi)
+
+            # result: bracket records only on termination; zoom records
+            # every eval (the host zoom's running ``best``)
+            set_res = jnp.where(in_bracket, b_done | b_exhaust, True)
+            return dict(
+                phase=phase,
+                evals=s["evals"] + 1,
+                bi=s["bi"] + in_bracket.astype(jnp.int32),
+                zj=s["zj"] + (~in_bracket).astype(jnp.int32),
+                alpha_prev=jnp.where(in_bracket & b_cont, alpha,
+                                     s["alpha_prev"]),
+                v_prev=jnp.where(in_bracket & b_cont, v, s["v_prev"]),
+                d_prev=jnp.where(in_bracket & b_cont, dg, s["d_prev"]),
+                alpha_next=jnp.where(in_bracket & b_cont, alpha * 2.0,
+                                     s["alpha_next"]),
+                lo=lo, hi=hi, v_lo=v_lo, d_lo=d_lo, v_hi=v_hi,
+                res_alpha=jnp.where(set_res, alpha, s["res_alpha"]),
+                res_v=jnp.where(set_res, v, s["res_v"]),
+                res_g=jnp.where(set_res, g, s["res_g"]),
             )
 
-            def cond(s):
-                return s["phase"] < 2
+        final = jax.lax.while_loop(cond, body, state)
+        return (final["res_alpha"], final["res_v"], final["res_g"],
+                final["evals"])
 
-            def body(s):
-                in_bracket = s["phase"] == 0
-                alpha = jnp.where(in_bracket, s["alpha_next"],
-                                  0.5 * (s["lo"] + s["hi"]))
-                v, g, dg = phi(alpha)
-                armijo_fail = v > value0 + c1 * alpha * dg0
-                wolfe_ok = jnp.abs(dg) <= -c2 * dg0
+    return jax.jit(program)
 
-                # -- bracket phase (Nocedal-Wright alg 3.5) --
-                b_zoom_a = armijo_fail | ((s["bi"] > 0) & (v >= s["v_prev"]))
-                b_done = (~b_zoom_a) & wolfe_ok
-                b_zoom_b = (~b_zoom_a) & (~b_done) & (dg >= 0)
-                b_cont = ~(b_zoom_a | b_done | b_zoom_b)
-                # budget exhausted while still bracketing: accept current eval
-                # (the host path's fallback re-evaluates at the next doubled α;
-                # this branch is unreachable in practice — 30 doublings)
-                b_exhaust = b_cont & (s["bi"] + 1 >= max_evals)
-                enter_zoom = b_zoom_a | b_zoom_b
 
-                # -- zoom phase (alg 3.6) --
-                z_hi_a = armijo_fail | (v >= s["v_lo"])
-                z_done = (~z_hi_a) & wolfe_ok
-                z_flip = (~z_hi_a) & (~z_done) & (dg * (s["hi"] - s["lo"]) >= 0)
-                z_hi = jnp.where(z_hi_a, alpha, jnp.where(z_flip, s["lo"], s["hi"]))
-                z_v_hi = jnp.where(z_hi_a, v, jnp.where(z_flip, s["v_lo"], s["v_hi"]))
-                z_lo = jnp.where(z_hi_a, s["lo"], alpha)
-                z_v_lo = jnp.where(z_hi_a, s["v_lo"], v)
-                z_d_lo = jnp.where(z_hi_a, s["d_lo"], dg)
-                z_exhaust = (jnp.abs(z_hi - z_lo) < 1e-12) | \
-                    (s["zj"] + 1 >= max_evals)
+_scale_rows = None
 
-                phase = jnp.where(
-                    in_bracket,
-                    jnp.where(b_done | b_exhaust, 2,
-                              jnp.where(enter_zoom, 1, 0)),
-                    jnp.where(z_done | z_exhaust, 2, 1)).astype(jnp.int32)
 
-                # zoom bracket: freshly entered from bracket phase, or updated
-                lo = jnp.where(in_bracket,
-                               jnp.where(b_zoom_a, s["alpha_prev"], alpha),
-                               z_lo)
-                v_lo = jnp.where(in_bracket,
-                                 jnp.where(b_zoom_a, s["v_prev"], v), z_v_lo)
-                d_lo = jnp.where(in_bracket,
-                                 jnp.where(b_zoom_a, s["d_prev"], dg), z_d_lo)
-                hi = jnp.where(in_bracket,
-                               jnp.where(b_zoom_a, alpha, s["alpha_prev"]),
-                               z_hi)
-                v_hi = jnp.where(in_bracket,
-                                 jnp.where(b_zoom_a, v, s["v_prev"]), z_v_hi)
-
-                # result: bracket records only on termination; zoom records
-                # every eval (the host zoom's running ``best``)
-                set_res = jnp.where(in_bracket, b_done | b_exhaust, True)
-                return dict(
-                    phase=phase,
-                    evals=s["evals"] + 1,
-                    bi=s["bi"] + in_bracket.astype(jnp.int32),
-                    zj=s["zj"] + (~in_bracket).astype(jnp.int32),
-                    alpha_prev=jnp.where(in_bracket & b_cont, alpha,
-                                         s["alpha_prev"]),
-                    v_prev=jnp.where(in_bracket & b_cont, v, s["v_prev"]),
-                    d_prev=jnp.where(in_bracket & b_cont, dg, s["d_prev"]),
-                    alpha_next=jnp.where(in_bracket & b_cont, alpha * 2.0,
-                                         s["alpha_next"]),
-                    lo=lo, hi=hi, v_lo=v_lo, d_lo=d_lo, v_hi=v_hi,
-                    res_alpha=jnp.where(set_res, alpha, s["res_alpha"]),
-                    res_v=jnp.where(set_res, v, s["res_v"]),
-                    res_g=jnp.where(set_res, g, s["res_g"]),
-                )
-
-            final = jax.lax.while_loop(cond, body, state)
-            return (final["res_alpha"], final["res_v"], final["res_g"],
-                    final["evals"])
-
-        return jax.jit(program)
+def _get_scale_rows():
+    global _scale_rows
+    if _scale_rows is None:
+        import jax
+        _scale_rows = jax.jit(lambda x, s: x * s)
+    return _scale_rows
 
 
 def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray):
@@ -237,7 +270,7 @@ def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray):
 
     inv_std = np.where(features_std > 0, 1.0 / np.where(
         features_std > 0, features_std, 1.0), 0.0)
-    scaled = jax.jit(lambda x, s: x * s)(ds.x, jnp.asarray(inv_std))
+    scaled = _get_scale_rows()(ds.x, jnp.asarray(inv_std))
     return InstanceDataset(ds.ctx, scaled, ds.y, ds.w, ds.n_rows,
                            ds.n_features), inv_std
 
@@ -256,6 +289,22 @@ def validate_binary_labels(y: np.ndarray, what: str) -> None:
 def l2_regularization(reg_param: float, d: int, fit_intercept: bool,
                       features_std: Optional[np.ndarray] = None,
                       standardize: bool = True) -> Optional[Callable]:
+    if standardize:
+        # cached: a stable fn (and .traceable) identity per parameter set is
+        # what lets the device line-search program cache hit across fits
+        return _l2_standardized(float(reg_param), int(d), bool(fit_intercept))
+    return _l2_regularization(reg_param, d, fit_intercept, features_std,
+                              standardize)
+
+
+@functools.lru_cache(maxsize=None)
+def _l2_standardized(reg_param: float, d: int, fit_intercept: bool):
+    return _l2_regularization(reg_param, d, fit_intercept, None, True)
+
+
+def _l2_regularization(reg_param: float, d: int, fit_intercept: bool,
+                       features_std: Optional[np.ndarray] = None,
+                       standardize: bool = True) -> Optional[Callable]:
     """L2 penalty matching the reference's L2RegFunction semantics
     (ref: ml/optim/regularizer — applied to feature coefficients only, never
     the intercept; when ``standardization=false`` the penalty is computed in

@@ -22,6 +22,7 @@ both exactly neutral: value 0 kills the gather term, weight 0 kills the row.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict
 
 import jax
@@ -49,6 +50,7 @@ def _split(coef, d, fit_intercept):
     return coef, jnp.zeros((), coef.dtype)
 
 
+@functools.lru_cache(maxsize=None)
 def binary_logistic_sparse(d: int, fit_intercept: bool = True) -> Agg:
     """Sparse binomial logistic (dense twin: aggregators.binary_logistic)."""
 
@@ -65,6 +67,7 @@ def binary_logistic_sparse(d: int, fit_intercept: bool = True) -> Agg:
     return agg
 
 
+@functools.lru_cache(maxsize=None)
 def least_squares_sparse(d: int, fit_intercept: bool = True) -> Agg:
     """Sparse squared loss (dense twin: aggregators.least_squares)."""
 
@@ -81,6 +84,7 @@ def least_squares_sparse(d: int, fit_intercept: bool = True) -> Agg:
     return agg
 
 
+@functools.lru_cache(maxsize=None)
 def hinge_sparse(d: int, fit_intercept: bool = True) -> Agg:
     """Sparse hinge loss (dense twin: aggregators.hinge)."""
 
@@ -99,6 +103,7 @@ def hinge_sparse(d: int, fit_intercept: bool = True) -> Agg:
     return agg
 
 
+@functools.lru_cache(maxsize=None)
 def sparse_summary(d: int) -> Agg:
     """Single-pass weighted feature moments over ELL blocks
     (dense twin: ml/stat Summarizer's aggregation, ref Summarizer.scala:214):

@@ -44,34 +44,46 @@ class Summarizer:
 
     @staticmethod
     def summarize(dataset: InstanceDataset) -> SummaryStats:
-        import jax.numpy as jnp
-
-        def moments(x, y, w):
-            wcol = w[:, None]
-            present = (wcol > 0)
-            s1 = jnp.sum(wcol * x, axis=0)
-            s2 = jnp.sum(wcol * x * x, axis=0)
-            neg_inf = jnp.asarray(-jnp.inf, x.dtype)
-            pos_inf = jnp.asarray(jnp.inf, x.dtype)
-            return {
-                "s1": s1,
-                "s2": s2,
-                "w": jnp.sum(w),
-                "w2": jnp.sum(w * w),
-                "cnt": jnp.sum(present.astype(x.dtype)),
-                "nnz": jnp.sum(jnp.where(present & (x != 0), 1.0, 0.0), axis=0),
-                "mx": jnp.max(jnp.where(present, x, neg_inf), axis=0),
-                "mn": jnp.min(jnp.where(present, x, pos_inf), axis=0),
-                "l1": jnp.sum(wcol * jnp.abs(x), axis=0),
-            }
-
-        agg = dataset.tree_aggregate_fn(_psum_parts(moments), auto_psum=False)
+        # the aggregation fn is a module-level singleton so the compiled
+        # program is shared across calls/fits (collectives program cache)
+        agg = dataset.tree_aggregate_fn(_get_moments_fn(), auto_psum=False)
         return _finalize(agg(), dataset)
 
     @staticmethod
     def mean_std(dataset: InstanceDataset):
         s = Summarizer.summarize(dataset)
         return s.mean, s.std
+
+
+def _moments(x, y, w):
+    import jax.numpy as jnp
+    wcol = w[:, None]
+    present = (wcol > 0)
+    s1 = jnp.sum(wcol * x, axis=0)
+    s2 = jnp.sum(wcol * x * x, axis=0)
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    pos_inf = jnp.asarray(jnp.inf, x.dtype)
+    return {
+        "s1": s1,
+        "s2": s2,
+        "w": jnp.sum(w),
+        "w2": jnp.sum(w * w),
+        "cnt": jnp.sum(present.astype(x.dtype)),
+        "nnz": jnp.sum(jnp.where(present & (x != 0), 1.0, 0.0), axis=0),
+        "mx": jnp.max(jnp.where(present, x, neg_inf), axis=0),
+        "mn": jnp.min(jnp.where(present, x, pos_inf), axis=0),
+        "l1": jnp.sum(wcol * jnp.abs(x), axis=0),
+    }
+
+
+_moments_fn = None
+
+
+def _get_moments_fn():
+    global _moments_fn
+    if _moments_fn is None:
+        _moments_fn = _psum_parts(_moments)
+    return _moments_fn
 
 
 def _psum_parts(moments):

