@@ -42,17 +42,20 @@ class MLFrame:
     @staticmethod
     def _coerce(col) -> np.ndarray:
         if isinstance(col, np.ndarray):
-            arr = col
+            # copy: the frame is documented immutable and caches its device
+            # placement, so it must not alias a WRITABLE caller-owned buffer
+            # the caller may mutate (stale cached device data, silently).
+            # Already-read-only arrays (columns of another frame flowing
+            # through select/with_column) are safe to alias — nobody can
+            # write them.
+            arr = col if not col.flags.writeable else col.copy()
         elif len(col) and isinstance(col[0], Vector):
             arr = rows_to_dense(col)
         else:
             arr = np.asarray(col)
-        # enforce the documented immutability: device-side dataset caching
-        # assumes columns never change, so in-place writes through
-        # frame["col"] must raise instead of silently training on stale data
-        view = arr.view()
-        view.flags.writeable = False
-        return view
+        # and in-place writes through frame["col"] must raise, not corrupt
+        arr.flags.writeable = False
+        return arr
 
     # -- construction ---------------------------------------------------------
     @classmethod

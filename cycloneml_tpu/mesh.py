@@ -70,6 +70,17 @@ def _enable_compilation_cache(jax) -> None:
         logger.info("persistent compilation cache unavailable: %s", e)
 
 
+def _disable_compilation_cache(jax) -> None:
+    global _comp_cache_enabled
+    if not _comp_cache_enabled:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _comp_cache_enabled = False
+    except Exception:
+        pass
+
+
 class MeshRuntime:
     """Owns the global device mesh and sharding helpers."""
 
@@ -86,6 +97,11 @@ class MeshRuntime:
             # different codegen (observed: prefer-no-scatter mismatch causing
             # reduction-order drift in tests); CPU compiles are cheap anyway
             _enable_compilation_cache(jax)
+        else:
+            # a reset()+rebuild onto CPU must also UNDO a previously enabled
+            # cache, or the CPU mesh inherits the TPU mesh's cache dir and
+            # hits the exact AOT hazard above
+            _disable_compilation_cache(jax)
         n = len(devices)
         if n % (n_replicas * model_parallelism) != 0:
             raise ValueError(

@@ -36,7 +36,7 @@ extern "C" {
 // ---------------------------------------------------------------------------
 
 struct SvmRow {
-  float label;
+  double label;  // f64: regression targets must survive the round trip
   std::vector<std::pair<int32_t, float>> feats;
 };
 
@@ -59,7 +59,7 @@ static void parse_svm_range(const char* data, size_t begin, size_t end,
     if (p >= stop || *p == '#') continue;
     SvmRow row;
     char* next = nullptr;
-    row.label = strtof(p, &next);
+    row.label = strtod(p, &next);
     if (next == p) continue;
     p = next;
     while (p < stop) {
@@ -128,7 +128,7 @@ int svm_fill(void* h, float* x, float* y, int64_t n_rows, int64_t n_features) {
   if ((int64_t)f->rows.size() != n_rows) return -1;
   memset(x, 0, sizeof(float) * (size_t)(n_rows * n_features));
   for (int64_t r = 0; r < n_rows; r++) {
-    y[r] = f->rows[r].label;
+    y[r] = (float)f->rows[r].label;
     float* row = x + r * n_features;
     for (auto& kv : f->rows[r].feats)
       if (kv.first >= 0 && kv.first < n_features) row[kv.first] = kv.second;
@@ -137,6 +137,132 @@ int svm_fill(void* h, float* x, float* y, int64_t n_rows, int64_t n_features) {
 }
 
 void svm_free(void* h) { delete (SvmFile*)h; }
+
+// -- streaming libsvm (bounded memory) --------------------------------------
+//
+// The whole-file loader above materializes every row before filling a dense
+// buffer — fine for datasets that fit driver RAM, unusable for the
+// Criteo-1TB class. The stream reads a fixed byte window at a time,
+// multithread-parses it, and hands rows out chunk-by-chunk in CSR form
+// (labels + per-row nnz + flat (index, value) pairs); peak memory is
+// O(window + parsed-window rows), independent of file size.
+
+struct SvmStream {
+  FILE* f = nullptr;
+  std::string carry;            // partial trailing line of the last window
+  std::vector<SvmRow> pending;  // parsed rows not yet handed out
+  size_t ppos = 0;
+  int64_t buf_bytes;
+  int nt;
+  bool eof = false;
+  int64_t max_idx = -1;  // max feature index seen so far (running)
+};
+
+void* svm_stream_open(const char* path, int64_t buf_bytes, int n_threads) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new SvmStream();
+  s->f = f;
+  s->buf_bytes = buf_bytes > 0 ? buf_bytes : (8 << 20);
+  s->nt = n_threads > 0 ? n_threads : (int)std::thread::hardware_concurrency();
+  if (s->nt < 1) s->nt = 1;
+  return s;
+}
+
+static bool svm_stream_refill(SvmStream* s) {
+  // read one window, snap to the last newline, parse it in parallel
+  std::vector<char> buf;
+  buf.reserve(s->carry.size() + (size_t)s->buf_bytes);
+  buf.insert(buf.end(), s->carry.begin(), s->carry.end());
+  s->carry.clear();
+  size_t old = buf.size();
+  buf.resize(old + (size_t)s->buf_bytes);
+  size_t got = fread(buf.data() + old, 1, (size_t)s->buf_bytes, s->f);
+  buf.resize(old + got);
+  if (got < (size_t)s->buf_bytes) s->eof = true;
+  if (buf.empty()) return false;
+
+  size_t end = buf.size();
+  if (!s->eof) {
+    // hold back the partial final line for the next window
+    size_t last_nl = end;
+    while (last_nl > 0 && buf[last_nl - 1] != '\n') last_nl--;
+    if (last_nl == 0) {
+      // a single line longer than the window: grow the carry and retry
+      s->carry.assign(buf.begin(), buf.end());
+      return svm_stream_refill(s);
+    }
+    s->carry.assign(buf.begin() + last_nl, buf.end());
+    end = last_nl;
+  }
+
+  int nt = s->nt;
+  if (end < (size_t)(nt * 4096)) nt = 1;
+  std::vector<size_t> bounds(nt + 1, 0);
+  bounds[nt] = end;
+  for (int i = 1; i < nt; i++) {
+    size_t b = end * i / nt;
+    while (b < end && buf[b] != '\n') b++;
+    bounds[i] = b < end ? b + 1 : end;
+  }
+  std::vector<std::vector<SvmRow>> parts(nt);
+  std::vector<int64_t> maxes(nt, -1);
+  std::vector<std::thread> threads;
+  for (int i = 0; i < nt; i++)
+    threads.emplace_back(parse_svm_range, buf.data(), bounds[i], bounds[i + 1],
+                         &parts[i], &maxes[i]);
+  for (auto& t : threads) t.join();
+  s->pending.clear();
+  s->ppos = 0;
+  for (int i = 0; i < nt; i++) {
+    if (maxes[i] > s->max_idx) s->max_idx = maxes[i];
+    for (auto& r : parts[i]) s->pending.push_back(std::move(r));
+  }
+  return !s->pending.empty();
+}
+
+// Fill up to max_rows rows (CSR: y, row_nnz, flat idx/val capped at cap_nnz).
+// Returns rows filled; 0 at end of stream; -2 if a single row's nnz exceeds
+// cap_nnz (caller must grow the buffer). max_feature reports the running
+// max feature index + 1 over everything parsed so far.
+int64_t svm_stream_next(void* h, double* y, int32_t* row_nnz, int32_t* idx,
+                        float* val, int64_t max_rows, int64_t cap_nnz,
+                        int64_t* max_feature) {
+  auto* s = (SvmStream*)h;
+  int64_t rows = 0, used = 0;
+  while (rows < max_rows) {
+    if (s->ppos >= s->pending.size()) {
+      if (s->eof) break;
+      if (!svm_stream_refill(s)) break;
+      continue;
+    }
+    SvmRow& r = s->pending[s->ppos];
+    int64_t nnz = (int64_t)r.feats.size();
+    if (nnz > cap_nnz) return -2;
+    if (used + nnz > cap_nnz) break;  // chunk full by nnz
+    y[rows] = r.label;
+    row_nnz[rows] = (int32_t)nnz;
+    for (auto& kv : r.feats) {
+      idx[used] = kv.first;
+      val[used] = kv.second;
+      used++;
+    }
+    rows++;
+    s->ppos++;
+  }
+  if (s->ppos >= s->pending.size() && s->eof) {
+    s->pending.clear();  // release the last window's rows promptly
+    s->ppos = 0;
+  }
+  *max_feature = s->max_idx + 1;
+  return rows;
+}
+
+void svm_stream_free(void* h) {
+  auto* s = (SvmStream*)h;
+  if (s->f) fclose(s->f);
+  delete s;
+}
 
 // CSV: numeric rectangular parse. Returns handle + dims.
 struct CsvFile {

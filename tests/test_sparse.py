@@ -139,6 +139,153 @@ def test_read_libsvm_sparse(ctx, tmp_path):
     np.testing.assert_allclose(ds.to_dense(), want, rtol=1e-6)
 
 
+def _write_libsvm(path, rows, y):
+    with open(path, "w") as fh:
+        for label, (idx, val) in zip(y, rows):
+            feats = " ".join(f"{i + 1}:{v:.9g}" for i, v in zip(idx, val))
+            fh.write(f"{label:g} {feats}\n")
+
+
+def test_streamed_ingest_matches_from_rows(ctx, tmp_path):
+    """Multi-chunk streamed ingest aggregates identically to the in-memory
+    path (row order is a permutation, so compare order-invariant sums and
+    the trained gradient)."""
+    rows, dense, y, w = _random_sparse(n=500, d=40, k=6, seed=3)
+    p = str(tmp_path / "big.libsvm")
+    _write_libsvm(p, rows, y)
+    ds = SparseInstanceDataset.from_libsvm_stream(ctx, p, chunk_rows=64)
+    assert ds.n_rows == 500 and ds.n_features == 40
+    ref = SparseInstanceDataset.from_rows(ctx, rows, y=y, n_features=40)
+    # order-invariant checks: per-feature sums and a full gradient
+    np.testing.assert_allclose(ds.to_dense().sum(0), ref.to_dense().sum(0),
+                               rtol=1e-4)
+    coef = np.linspace(-1, 1, 40)
+    g1 = ds.tree_aggregate_fn(binary_logistic_sparse(40, False))(coef)
+    g2 = ref.tree_aggregate_fn(binary_logistic_sparse(40, False))(coef)
+    # row order is permuted, so f32 scatter-adds reduce in a different
+    # order — atol absorbs the last-ulp noise on near-cancelling elements
+    np.testing.assert_allclose(np.asarray(g1["grad"]), np.asarray(g2["grad"]),
+                               rtol=1e-4, atol=5e-5)
+    np.testing.assert_allclose(float(g1["loss"]), float(g2["loss"]), rtol=1e-5)
+
+
+def test_streamed_ingest_shards_over_mesh(ctx, tmp_path):
+    rows, dense, y, w = _random_sparse(n=300, d=20, k=4, seed=5)
+    p = str(tmp_path / "s.libsvm")
+    _write_libsvm(p, rows, y)
+    ds = SparseInstanceDataset.from_libsvm_stream(ctx, p, chunk_rows=32)
+    assert len(ds.indices.sharding.device_set) == 8  # all mesh devices
+    assert ds.indices.shape[0] % 8 == 0
+
+
+def test_streamed_ingest_widens_k_on_device(ctx, tmp_path):
+    """A later chunk with a wider row must widen already-placed chunks."""
+    rows = [(np.array([0]), np.array([1.0]))] * 40          # k=1 chunk
+    rows += [(np.arange(5), np.ones(5))] * 40               # k=5 chunk
+    y = [1.0] * 80
+    p = str(tmp_path / "w.libsvm")
+    _write_libsvm(p, rows, y)
+    ds = SparseInstanceDataset.from_libsvm_stream(ctx, p, chunk_rows=40)
+    assert ds.k_max == 5
+    dense = ds.to_dense()
+    assert dense.shape == (80, 5)
+    np.testing.assert_allclose(dense.sum(), 40 * 1.0 + 40 * 5.0)
+
+
+def test_streamed_ingest_small_file_no_blowup(ctx, tmp_path):
+    """A small file must not be padded to n_dev × chunk_rows rows: shard
+    equalization pads to the widest shard's ACTUAL rows, not the chunk
+    budget."""
+    rows, dense, y, w = _random_sparse(n=100, d=10, k=3, seed=1)
+    p = str(tmp_path / "tiny.libsvm")
+    _write_libsvm(p, rows, y)
+    ds = SparseInstanceDataset.from_libsvm_stream(ctx, p)  # default 65536
+    assert ds.n_rows == 100
+    assert ds.indices.shape[0] <= 100 * 8  # ≤ one shard's rows per device
+
+
+def test_read_libsvm_sparse_f64_labels(ctx, tmp_path):
+    """Regression targets must survive the parse at f64 (the device tier
+    stores f32, but the returned label vector must not round-trip through
+    it)."""
+    p = tmp_path / "r.libsvm"
+    p.write_text("0.123456789012 1:1.0\n-7.000000123 2:2.0\n")
+    ds, y = read_libsvm_sparse(ctx, str(p))
+    np.testing.assert_array_equal(y, [0.123456789012, -7.000000123])
+
+
+def test_streamed_ingest_k_max_overflow(ctx, tmp_path):
+    p = str(tmp_path / "o.libsvm")
+    _write_libsvm(p, [(np.arange(4), np.ones(4))], [1.0])
+    with pytest.raises(ValueError, match="nonzeros"):
+        SparseInstanceDataset.from_libsvm_stream(ctx, p, k_max=2)
+
+
+def test_stream_chunks_native_matches_python(tmp_path):
+    """The C++ scanner and the pure-Python fallback yield identical rows."""
+    from cycloneml_tpu.native import host
+    rows, dense, y, w = _random_sparse(n=211, d=30, k=5, seed=9)
+    p = str(tmp_path / "n.libsvm")
+    _write_libsvm(p, rows, y)
+
+    def drain(gen):
+        ys, nnzs, idxs, vals = [], [], [], []
+        for cy, cnnz, cfi, cfv, mf in gen:
+            ys.append(cy); nnzs.append(cnnz); idxs.append(cfi); vals.append(cfv)
+        return (np.concatenate(ys), np.concatenate(nnzs),
+                np.concatenate(idxs), np.concatenate(vals), mf)
+
+    py = drain(host._stream_libsvm_py(p, 50, 50 * 64))
+    if host.native_available():
+        nat = drain(host.stream_libsvm_chunks(p, chunk_rows=50))
+        for a, b in zip(py, nat):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+    # chunk semantics: same totals as the original rows
+    assert py[1].sum() == sum(len(r[0]) for r in rows)
+    np.testing.assert_allclose(py[0], y)
+    assert py[4] == 30 or py[4] == max(int(r[0].max()) for r in rows) + 1
+
+
+def test_streamed_ingest_bounded_driver_memory(ctx, tmp_path):
+    """Driver RSS during ingest stays bounded by chunk size, not file size
+    (the Criteo prerequisite; VERDICT round-1 item 3). The per-line Python
+    path held every row object simultaneously — several times the file size;
+    here the file is ~25 MB and chunk buffers are ~1 MB, so a modest delta
+    proves chunks are not accumulating host-side. Device placement memory
+    (which on the CPU test platform is also RAM) is excluded by measuring
+    only up to the stream-drain, via the raw chunk iterator."""
+    import resource
+    from cycloneml_tpu.native import host
+    n, k = 240_000, 8
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "big.libsvm")
+    cols = rng.randint(0, 1000, size=(n, k))
+    vals = rng.rand(n, k)
+    with open(p, "w") as fh:
+        for i in range(n):
+            feats = " ".join(f"{c + 1}:{v:.6f}"
+                             for c, v in zip(cols[i], vals[i]))
+            fh.write(f"{i % 2} {feats}\n")
+    del cols, vals
+    import os
+    fsize = os.path.getsize(p)
+    assert fsize > 20e6
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KB on linux
+    total = 0
+    for cy, cnnz, cfi, cfv, mf in host.stream_libsvm_chunks(
+            p, chunk_rows=4096, buf_bytes=2 << 20):
+        total += len(cy)
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert total == n
+    # ru_maxrss is a high-water mark: the whole-file path spikes it by
+    # several times the file size in row objects (>75 MB here); chunked
+    # streaming holds only window + chunk buffers — a CONSTANT (~20 MB:
+    # 2 MB window + parsed-window rows + cap_nnz chunk arrays + allocator
+    # slack) independent of file size, asserted with headroom below one
+    # file size
+    assert (rss1 - rss0) * 1024 < min(30e6, fsize), (rss0, rss1, fsize)
+
+
 def test_sparse_padding_rows_neutral(ctx):
     """Mesh padding rows (w=0, slots (0,0.0)) contribute nothing even though
     their index column 0 is a real feature."""
