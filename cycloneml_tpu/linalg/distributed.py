@@ -54,7 +54,19 @@ class RowMatrix:
 
     # -- gramian ---------------------------------------------------------------
     def compute_gramian(self) -> DenseMatrix:
-        """XᵀX (ref computeGramianMatrix:130 — treeAggregate of spr:147)."""
+        """XᵀX (ref computeGramianMatrix:130 — treeAggregate of spr:147).
+
+        On a mesh with a model axis (model_parallelism > 1) and a divisible
+        feature dim, the Gram matrix is computed feature-sharded via the
+        ppermute ring (SURVEY §5.7a) — no device materializes the full
+        (d, d) — and gathered to the host here. Use
+        :meth:`compute_gramian_sharded` to keep it on the mesh when d is too
+        large to gather.
+        """
+        sharded = self.compute_gramian_sharded()
+        if sharded is not None:
+            return DenseMatrix.from_array(
+                np.asarray(sharded, dtype=np.float64))
         import jax
         import jax.numpy as jnp
 
@@ -63,6 +75,18 @@ class RowMatrix:
                 "bi,bj->ij", x * (w > 0)[:, None].astype(x.dtype), x,
                 precision=jax.lax.Precision.HIGHEST))()
         return DenseMatrix.from_array(np.asarray(out, dtype=np.float64))
+
+    def compute_gramian_sharded(self):
+        """Model-axis-sharded Gramian (``P(model, None)`` device array), or
+        None when the mesh has no model axis / d does not divide it."""
+        from cycloneml_tpu.parallel import feature_sharding as fs
+        rt = self.dataset.ctx.mesh_runtime
+        d = self.num_cols()
+        m = fs.model_parallelism(rt)
+        if m <= 1 or d % m != 0:
+            return None
+        x_tp = fs.feature_sharded_put(rt, self.dataset.x)
+        return fs.gramian_feature_sharded(rt, x_tp, w=self.dataset.w)
 
     # -- covariance / pca ------------------------------------------------------
     def compute_covariance(self) -> DenseMatrix:

@@ -167,7 +167,20 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 l2, d, fit_intercept, features_std=features_std,
                 standardize=standardize) if l2 > 0 else None
 
-        loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
+        rt = ds.ctx.mesh_runtime
+        from cycloneml_tpu.parallel import feature_sharding as fs
+        m = fs.model_parallelism(rt)
+        if not is_multinomial and m > 1 and d % m == 0:
+            # model axis present: feature-shard the blocks and coefficients
+            # (SURVEY §5.7a — the path for d beyond one device's HBM). The
+            # mesh layout is the user's explicit opt-in; binomial only (the
+            # multinomial aggregator stays replicated for now).
+            x_tp = fs.feature_sharded_put(rt, ds_std.x)
+            loss_fn = fs.FeatureShardedLossFunction(
+                rt, x_tp, ds_std.y, ds_std.w, d, fit_intercept, l2_fn,
+                weight_sum, ctx=ds.ctx)
+        else:
+            loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
 
         if l1 > 0:
             n_feat_coords = d * num_classes if is_multinomial else d

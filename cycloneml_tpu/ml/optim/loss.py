@@ -154,99 +154,119 @@ def _build_line_search(compiled, l2_t, c1: float, c2: float, max_evals: int,
                 grad = grad + rg
             return loss, grad, jnp.dot(dirn, grad)
 
-        d = x0.shape[0]
-        zero = cdt.type(0.0)
-        state = dict(
-            phase=jnp.int32(0),   # 0 bracket, 1 zoom, 2 done
-            evals=jnp.int32(0), bi=jnp.int32(0), zj=jnp.int32(0),
-            alpha_prev=zero, v_prev=value0, d_prev=dg0,
-            alpha_next=init_alpha,
-            lo=zero, hi=zero,
-            v_lo=zero, d_lo=zero,
-            v_hi=zero,
-            res_alpha=zero, res_v=value0,
-            res_g=jnp.zeros((d,), cdt),
-        )
-
-        def cond(s):
-            return s["phase"] < 2
-
-        def body(s):
-            in_bracket = s["phase"] == 0
-            alpha = jnp.where(in_bracket, s["alpha_next"],
-                              0.5 * (s["lo"] + s["hi"]))
-            v, g, dg = phi(alpha)
-            armijo_fail = v > value0 + c1 * alpha * dg0
-            wolfe_ok = jnp.abs(dg) <= -c2 * dg0
-
-            # -- bracket phase (Nocedal-Wright alg 3.5) --
-            b_zoom_a = armijo_fail | ((s["bi"] > 0) & (v >= s["v_prev"]))
-            b_done = (~b_zoom_a) & wolfe_ok
-            b_zoom_b = (~b_zoom_a) & (~b_done) & (dg >= 0)
-            b_cont = ~(b_zoom_a | b_done | b_zoom_b)
-            # budget exhausted while still bracketing: accept current eval
-            # (the host path's fallback re-evaluates at the next doubled α;
-            # this branch is unreachable in practice — 30 doublings)
-            b_exhaust = b_cont & (s["bi"] + 1 >= max_evals)
-            enter_zoom = b_zoom_a | b_zoom_b
-
-            # -- zoom phase (alg 3.6) --
-            z_hi_a = armijo_fail | (v >= s["v_lo"])
-            z_done = (~z_hi_a) & wolfe_ok
-            z_flip = (~z_hi_a) & (~z_done) & (dg * (s["hi"] - s["lo"]) >= 0)
-            z_hi = jnp.where(z_hi_a, alpha, jnp.where(z_flip, s["lo"], s["hi"]))
-            z_v_hi = jnp.where(z_hi_a, v, jnp.where(z_flip, s["v_lo"], s["v_hi"]))
-            z_lo = jnp.where(z_hi_a, s["lo"], alpha)
-            z_v_lo = jnp.where(z_hi_a, s["v_lo"], v)
-            z_d_lo = jnp.where(z_hi_a, s["d_lo"], dg)
-            z_exhaust = (jnp.abs(z_hi - z_lo) < 1e-12) | \
-                (s["zj"] + 1 >= max_evals)
-
-            phase = jnp.where(
-                in_bracket,
-                jnp.where(b_done | b_exhaust, 2,
-                          jnp.where(enter_zoom, 1, 0)),
-                jnp.where(z_done | z_exhaust, 2, 1)).astype(jnp.int32)
-
-            # zoom bracket: freshly entered from bracket phase, or updated
-            lo = jnp.where(in_bracket,
-                           jnp.where(b_zoom_a, s["alpha_prev"], alpha),
-                           z_lo)
-            v_lo = jnp.where(in_bracket,
-                             jnp.where(b_zoom_a, s["v_prev"], v), z_v_lo)
-            d_lo = jnp.where(in_bracket,
-                             jnp.where(b_zoom_a, s["d_prev"], dg), z_d_lo)
-            hi = jnp.where(in_bracket,
-                           jnp.where(b_zoom_a, alpha, s["alpha_prev"]),
-                           z_hi)
-            v_hi = jnp.where(in_bracket,
-                             jnp.where(b_zoom_a, v, s["v_prev"]), z_v_hi)
-
-            # result: bracket records only on termination; zoom records
-            # every eval (the host zoom's running ``best``)
-            set_res = jnp.where(in_bracket, b_done | b_exhaust, True)
-            return dict(
-                phase=phase,
-                evals=s["evals"] + 1,
-                bi=s["bi"] + in_bracket.astype(jnp.int32),
-                zj=s["zj"] + (~in_bracket).astype(jnp.int32),
-                alpha_prev=jnp.where(in_bracket & b_cont, alpha,
-                                     s["alpha_prev"]),
-                v_prev=jnp.where(in_bracket & b_cont, v, s["v_prev"]),
-                d_prev=jnp.where(in_bracket & b_cont, dg, s["d_prev"]),
-                alpha_next=jnp.where(in_bracket & b_cont, alpha * 2.0,
-                                     s["alpha_next"]),
-                lo=lo, hi=hi, v_lo=v_lo, d_lo=d_lo, v_hi=v_hi,
-                res_alpha=jnp.where(set_res, alpha, s["res_alpha"]),
-                res_v=jnp.where(set_res, v, s["res_v"]),
-                res_g=jnp.where(set_res, g, s["res_g"]),
-            )
-
-        final = jax.lax.while_loop(cond, body, state)
-        return (final["res_alpha"], final["res_v"], final["res_g"],
-                final["evals"])
+        g_zero = jnp.zeros((x0.shape[0],), cdt)
+        return wolfe_search(phi, g_zero, value0, dg0, init_alpha,
+                            c1, c2, max_evals, cdt)
 
     return jax.jit(program)
+
+
+def wolfe_search(phi, g_zero, value0, dg0, init_alpha,
+                 c1: float, c2: float, max_evals: int, cdt):
+    """Traced strong-Wolfe bracket+zoom (Nocedal-Wright alg 3.5/3.6) as a
+    ``lax.while_loop`` state machine — the device-resident twin of the host
+    search in ``lbfgs._strong_wolfe``.
+
+    ``phi(alpha) -> (value, grad_pytree, dg)``; ``g_zero`` is a zero pytree
+    matching the gradient structure (any sharding — the feature-sharded
+    path threads a (beta_sharded, b0_scalar) pair through unchanged).
+    Returns ``(alpha, value, grad_pytree, evals)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    zero = cdt.type(0.0)
+    state = dict(
+        phase=jnp.int32(0),   # 0 bracket, 1 zoom, 2 done
+        evals=jnp.int32(0), bi=jnp.int32(0), zj=jnp.int32(0),
+        alpha_prev=zero, v_prev=value0, d_prev=dg0,
+        alpha_next=init_alpha,
+        lo=zero, hi=zero,
+        v_lo=zero, d_lo=zero,
+        v_hi=zero,
+        res_alpha=zero, res_v=value0,
+        res_g=g_zero,
+    )
+
+    def cond(s):
+        return s["phase"] < 2
+
+    def body(s):
+        in_bracket = s["phase"] == 0
+        alpha = jnp.where(in_bracket, s["alpha_next"],
+                          0.5 * (s["lo"] + s["hi"]))
+        v, g, dg = phi(alpha)
+        armijo_fail = v > value0 + c1 * alpha * dg0
+        wolfe_ok = jnp.abs(dg) <= -c2 * dg0
+
+        # -- bracket phase (Nocedal-Wright alg 3.5) --
+        b_zoom_a = armijo_fail | ((s["bi"] > 0) & (v >= s["v_prev"]))
+        b_done = (~b_zoom_a) & wolfe_ok
+        b_zoom_b = (~b_zoom_a) & (~b_done) & (dg >= 0)
+        b_cont = ~(b_zoom_a | b_done | b_zoom_b)
+        # budget exhausted while still bracketing: accept current eval
+        # (the host path's fallback re-evaluates at the next doubled α;
+        # this branch is unreachable in practice — 30 doublings)
+        b_exhaust = b_cont & (s["bi"] + 1 >= max_evals)
+        enter_zoom = b_zoom_a | b_zoom_b
+
+        # -- zoom phase (alg 3.6) --
+        z_hi_a = armijo_fail | (v >= s["v_lo"])
+        z_done = (~z_hi_a) & wolfe_ok
+        z_flip = (~z_hi_a) & (~z_done) & (dg * (s["hi"] - s["lo"]) >= 0)
+        z_hi = jnp.where(z_hi_a, alpha, jnp.where(z_flip, s["lo"], s["hi"]))
+        z_v_hi = jnp.where(z_hi_a, v, jnp.where(z_flip, s["v_lo"], s["v_hi"]))
+        z_lo = jnp.where(z_hi_a, s["lo"], alpha)
+        z_v_lo = jnp.where(z_hi_a, s["v_lo"], v)
+        z_d_lo = jnp.where(z_hi_a, s["d_lo"], dg)
+        z_exhaust = (jnp.abs(z_hi - z_lo) < 1e-12) | \
+            (s["zj"] + 1 >= max_evals)
+
+        phase = jnp.where(
+            in_bracket,
+            jnp.where(b_done | b_exhaust, 2,
+                      jnp.where(enter_zoom, 1, 0)),
+            jnp.where(z_done | z_exhaust, 2, 1)).astype(jnp.int32)
+
+        # zoom bracket: freshly entered from bracket phase, or updated
+        lo = jnp.where(in_bracket,
+                       jnp.where(b_zoom_a, s["alpha_prev"], alpha),
+                       z_lo)
+        v_lo = jnp.where(in_bracket,
+                         jnp.where(b_zoom_a, s["v_prev"], v), z_v_lo)
+        d_lo = jnp.where(in_bracket,
+                         jnp.where(b_zoom_a, s["d_prev"], dg), z_d_lo)
+        hi = jnp.where(in_bracket,
+                       jnp.where(b_zoom_a, alpha, s["alpha_prev"]),
+                       z_hi)
+        v_hi = jnp.where(in_bracket,
+                         jnp.where(b_zoom_a, v, s["v_prev"]), z_v_hi)
+
+        # result: bracket records only on termination; zoom records
+        # every eval (the host zoom's running ``best``)
+        set_res = jnp.where(in_bracket, b_done | b_exhaust, True)
+        return dict(
+            phase=phase,
+            evals=s["evals"] + 1,
+            bi=s["bi"] + in_bracket.astype(jnp.int32),
+            zj=s["zj"] + (~in_bracket).astype(jnp.int32),
+            alpha_prev=jnp.where(in_bracket & b_cont, alpha,
+                                 s["alpha_prev"]),
+            v_prev=jnp.where(in_bracket & b_cont, v, s["v_prev"]),
+            d_prev=jnp.where(in_bracket & b_cont, dg, s["d_prev"]),
+            alpha_next=jnp.where(in_bracket & b_cont, alpha * 2.0,
+                                 s["alpha_next"]),
+            lo=lo, hi=hi, v_lo=v_lo, d_lo=d_lo, v_hi=v_hi,
+            res_alpha=jnp.where(set_res, alpha, s["res_alpha"]),
+            res_v=jnp.where(set_res, v, s["res_v"]),
+            res_g=jax.tree_util.tree_map(
+                lambda gn, gs: jnp.where(set_res, gn, gs),
+                g, s["res_g"]),
+        )
+
+    final = jax.lax.while_loop(cond, body, state)
+    return (final["res_alpha"], final["res_v"], final["res_g"],
+            final["evals"])
 
 
 _scale_rows = None
@@ -341,4 +361,9 @@ def _l2_regularization(reg_param: float, d: int, fit_intercept: bool,
     # jnp twin for inlining inside jitted programs (device line search)
     import jax.numpy as jnp
     fn.traceable = make(jnp)
+    # introspection for paths that re-derive the penalty in another layout
+    # (the feature-sharded line search applies reg directly to its sharded
+    # beta slice — only valid for the standardized, uniform-λ penalty)
+    fn.reg_param = float(reg_param)
+    fn.is_standardized = std is None
     return fn

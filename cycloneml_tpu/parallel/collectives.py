@@ -67,11 +67,14 @@ def clear_program_cache() -> None:
     """Drop cached programs (mesh teardown/rebuild)."""
     _program_cache.clear()
     import sys
-    # layering: collectives must not import ml.*; clear the optimizer-side
-    # cache only if that module is loaded (its entries close over the mesh)
-    loss_mod = sys.modules.get("cycloneml_tpu.ml.optim.loss")
-    if loss_mod is not None:
-        loss_mod._ls_program_cache.clear()
+    # layering: collectives must not import ml.*; clear sibling caches only
+    # if those modules are loaded (their entries close over the mesh)
+    for name, attr in (("cycloneml_tpu.ml.optim.loss", "_ls_program_cache"),
+                       ("cycloneml_tpu.parallel.feature_sharding",
+                        "_program_cache")):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            getattr(mod, attr).clear()
 
 
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
