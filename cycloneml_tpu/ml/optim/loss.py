@@ -105,14 +105,9 @@ class DistributedLossFunction:
         if fn is None:
             fn = _build_line_search(self._agg_call.compiled, l2_t,
                                     c1, c2, max_evals, cdt)
-            _ls_program_cache[key] = fn
             # bounded: standardization=False fits key on a fresh l2 fn per
-            # fit and would otherwise grow this without limit (eviction only
-            # costs future reuse — the caller holds its own reference)
-            while len(_ls_program_cache) > 64:
-                _ls_program_cache.pop(next(iter(_ls_program_cache)))
-        else:
-            _ls_program_cache[key] = _ls_program_cache.pop(key)  # LRU touch
+            # fit and would otherwise grow this without limit
+            _ls_program_cache.put(key, fn)
         out = jax.device_get(fn(*arrays,
                                 np.asarray(x, dtype=cdt),
                                 np.asarray(direction, dtype=cdt),
@@ -128,7 +123,7 @@ class DistributedLossFunction:
         return float(alpha), loss, np.asarray(g, dtype=np.float64)
 
 
-_ls_program_cache: dict = {}
+_ls_program_cache = collectives.BoundedProgramCache(64)
 
 
 def _build_line_search(compiled, l2_t, c1: float, c2: float, max_evals: int,

@@ -50,31 +50,55 @@ def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
     return out
 
 
-# (fn, mesh, n_sharded, auto_psum, with_state) -> jitted program. Program
-# identity (not just trace identity) must be stable across estimator fits:
-# every fresh ``jax.jit`` object restarts tracing AND XLA compilation, and a
-# TPU compile costs tens of seconds — per-fit closures were recompiling the
-# same aggregation every fit. Callers make ``fn`` stable (lru-cached
-# factories); shapes/dtypes are handled by jit's own cache underneath.
-# LRU-bounded: callers that still pass per-fit closures insert entries that
-# can never hit again; eviction is safe because every caller holds its own
-# reference to the program it is using — only future reuse is lost.
-_PROGRAM_CACHE_MAX = 256
-_program_cache = __import__("collections").OrderedDict()
+class BoundedProgramCache:
+    """LRU cache for compiled-program identity.
+
+    Program identity (not just trace identity) must be stable across
+    estimator fits: every fresh ``jax.jit`` object restarts tracing AND XLA
+    compilation, and a TPU compile costs tens of seconds — per-fit closures
+    were recompiling the same aggregation every fit. Callers make their key
+    fns stable (lru-cached factories); shapes/dtypes are handled by jit's
+    own cache underneath. LRU-bounded: callers that still pass per-fit
+    closures insert entries that can never hit again; eviction is safe
+    because every caller holds its own reference to the program it is using
+    — only future reuse is lost. Entries close over the Mesh, so every
+    instance registers itself for clearing on mesh teardown.
+    """
+
+    _instances: list = []
+
+    def __init__(self, maxsize: int):
+        import collections
+        self._max = maxsize
+        self._d = collections.OrderedDict()
+        BoundedProgramCache._instances.append(self)
+
+    def get(self, key):
+        v = self._d.get(key)
+        if v is not None:
+            self._d.move_to_end(key)
+        return v
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        while len(self._d) > self._max:
+            self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+# (fn, mesh, n_sharded, auto_psum, with_state) -> jitted program
+_program_cache = BoundedProgramCache(256)
 
 
 def clear_program_cache() -> None:
-    """Drop cached programs (mesh teardown/rebuild)."""
-    _program_cache.clear()
-    import sys
-    # layering: collectives must not import ml.*; clear sibling caches only
-    # if those modules are loaded (their entries close over the mesh)
-    for name, attr in (("cycloneml_tpu.ml.optim.loss", "_ls_program_cache"),
-                       ("cycloneml_tpu.parallel.feature_sharding",
-                        "_program_cache")):
-        mod = sys.modules.get(name)
-        if mod is not None:
-            getattr(mod, attr).clear()
+    """Drop ALL cached programs everywhere (mesh teardown/rebuild)."""
+    for cache in BoundedProgramCache._instances:
+        cache.clear()
 
 
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
@@ -105,7 +129,6 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
     except TypeError:  # unhashable fn: build uncached
         key, cached = None, None
     if cached is not None:
-        _program_cache.move_to_end(key)
         return cached
     mesh = runtime.mesh
     row_spec = P((REPLICA_AXIS, DATA_AXIS))
@@ -131,9 +154,7 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
 
     jitted = jax.jit(sharded)
     if key is not None:
-        _program_cache[key] = jitted
-        while len(_program_cache) > _PROGRAM_CACHE_MAX:
-            _program_cache.popitem(last=False)
+        _program_cache.put(key, jitted)
     return jitted
 
 

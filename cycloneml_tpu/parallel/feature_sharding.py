@@ -31,27 +31,16 @@ from typing import Optional, Tuple
 import numpy as np
 
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
-from cycloneml_tpu.parallel.collectives import psum_over_mesh, shard_map_compat
+from cycloneml_tpu.parallel.collectives import (BoundedProgramCache,
+                                                psum_over_mesh,
+                                                shard_map_compat)
 
-# program-identity cache (see collectives._program_cache for the rationale);
-# bounded LRU, cleared by collectives.clear_program_cache on mesh teardown —
-# entries close over the Mesh. The gram_ring key varies by (d, rows, dtype),
-# so eviction matters for long-lived processes over many datasets.
-_PROGRAM_CACHE_MAX = 64
-_program_cache = __import__("collections").OrderedDict()
-
-
-def _cache_put(key, value):
-    _program_cache[key] = value
-    while len(_program_cache) > _PROGRAM_CACHE_MAX:
-        _program_cache.popitem(last=False)
-
-
-def _cache_get(key):
-    v = _program_cache.get(key)
-    if v is not None:
-        _program_cache.move_to_end(key)
-    return v
+# program-identity cache (see collectives.BoundedProgramCache); the
+# gram_ring key varies by (d, rows, dtype), so eviction matters for
+# long-lived processes over many datasets
+_program_cache = BoundedProgramCache(64)
+_cache_put = _program_cache.put
+_cache_get = _program_cache.get
 
 
 def model_parallelism(runtime: MeshRuntime) -> int:
@@ -171,12 +160,13 @@ class FeatureShardedLossFunction:
         return beta, b0
 
     def __call__(self, coef: np.ndarray) -> Tuple[float, np.ndarray]:
+        import jax
         self.n_evals += 1
         self.n_dispatches += 1
         cdt = np.dtype(self._x.dtype)
         beta, b0 = self._split(coef, cdt)
-        loss_t, gb_t, gb0_t, _ = self._prog(self._x, self._y, self._w,
-                                            beta, b0)
+        loss_t, gb_t, gb0_t, _ = jax.device_get(
+            self._prog(self._x, self._y, self._w, beta, b0))  # one transfer
         loss = float(loss_t) / self.weight_sum
         gb = np.asarray(gb_t, dtype=np.float64) / self.weight_sum
         if self.fit_intercept:

@@ -148,3 +148,65 @@ def test_checkpoint_fingerprint_guards_foreign_resume(ctx, tmp_path):
     with pytest.raises(ValueError, match="DIFFERENT ALS run"):
         ALS(rank=3, maxIter=3, seed=9, checkpointDir=ck,
             checkpointInterval=1).fit(frame2)
+
+
+def test_chunked_aggregation_matches_unchunked(ctx):
+    """A tiny chunk budget (forcing many scan chunks) must produce the same
+    factors as the single-chunk path — chunking is a memory layout, not a
+    math change."""
+    users, items, r, _, _ = _ratings(seed=5)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    big = ALS(rank=3, maxIter=5, seed=2).fit(frame)
+    small = ALS(rank=3, maxIter=5, seed=2,
+                aggregationChunkBytes=4096).fit(frame)
+    np.testing.assert_allclose(small.user_factors, big.user_factors,
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(small.item_factors, big.item_factors,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_normal_eq_memory_proportional_to_entities(ctx):
+    """MovieLens-25M shape (25M ratings, rank 64): compile the user-side
+    normal-equation aggregation and assert XLA's planned temp memory is
+    entities-proportional, NOT nnz-proportional (VERDICT r1 item 5).
+
+    The un-chunked build materializes (nnz/shard, r, r) ≈ 48 GB per shard;
+    the chunked scan needs the (n_users, r, r) accumulator (~2.7 GB) plus
+    one chunk. Compile-only: no 25M-row run on the CPU mesh."""
+    import jax
+    from cycloneml_tpu.ml.recommendation.als import _normal_eq_local
+    from cycloneml_tpu.parallel import collectives
+
+    rt = ctx.mesh_runtime
+    n_users, rank = 162_541, 64
+    shards = rt.data_parallelism
+    nnz = 25_000_000
+    budget = 256 << 20
+    shard0 = -(-nnz // shards)
+    n_chunks = max(1, -(-shard0 * rank * rank * 4 // budget))
+    chunk = -(-shard0 // n_chunks)
+    chunk += (-chunk) % 8
+    total = chunk * n_chunks * shards
+
+    local = _normal_eq_local(n_users, rank, n_chunks, False, 1.0)
+    prog = collectives.tree_aggregate(
+        local, rt, np.zeros(0, np.int32), np.zeros(0, np.int32),
+        np.zeros(0, np.float32), np.zeros(0, np.float32))
+
+    S = jax.ShapeDtypeStruct
+    row_sharding = rt.data_sharding(extra_axes=0)
+    args = (S((total,), np.int32, sharding=row_sharding),
+            S((total,), np.int32, sharding=row_sharding),
+            S((total,), np.float32, sharding=row_sharding),
+            S((total,), np.float32, sharding=row_sharding),
+            S((n_users, rank), np.float32, sharding=rt.replicated()),
+            S((rank, rank), np.float32, sharding=rt.replicated()))
+    compiled = prog.lower(*args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+        pytest.skip("memory_analysis unavailable on this backend")
+    temp = int(ma.temp_size_in_bytes)
+    entities_bytes = n_users * rank * rank * 4          # the accumulator
+    nnz_bytes_per_shard = shard0 * rank * rank * 4      # the un-chunked blob
+    assert temp < 4 * entities_bytes, (temp, entities_bytes)
+    assert temp < nnz_bytes_per_shard / 3, (temp, nnz_bytes_per_shard)
