@@ -289,6 +289,22 @@ HEARTBEAT_INTERVAL_MS = (
     .int_conf(10000)
 )
 
+DRIVER_HEARTBEAT_ADDRESS = (
+    ConfigBuilder("cyclone.driver.heartbeatAddress")
+    .doc("host:port of the driver's HeartbeatServer. When set, this process "
+         "runs a HeartbeatSender pinging it every "
+         "cyclone.executor.heartbeatInterval ms — the over-the-wire worker "
+         "liveness loop (ref: HeartbeatReceiver.scala:37). Empty = no "
+         "cross-process heartbeats (single-host runs).")
+    .str_conf("")
+)
+
+WORKER_ID = (
+    ConfigBuilder("cyclone.worker.id")
+    .doc("Identity reported in heartbeats; defaults to host:pid.")
+    .str_conf("")
+)
+
 NETWORK_TIMEOUT_MS = (
     ConfigBuilder("cyclone.network.timeout")
     .doc("Control-plane RPC / worker-liveness timeout in ms. Must be well "

@@ -131,6 +131,22 @@ class CycloneContext:
         self._heartbeats = None
         self._hb_lock = threading.Lock()
 
+        # cross-process liveness: when a driver heartbeat address is
+        # configured, this process pings it over TCP (the wire leg of
+        # HeartbeatReceiver; ref HeartbeatReceiver.scala:37)
+        self._hb_sender = None
+        from cycloneml_tpu.conf import (DRIVER_HEARTBEAT_ADDRESS,
+                                        HEARTBEAT_INTERVAL_MS, WORKER_ID)
+        hb_addr = self.conf.get(DRIVER_HEARTBEAT_ADDRESS)
+        if hb_addr:
+            import socket as _socket
+            from cycloneml_tpu.parallel.resilience import HeartbeatSender
+            wid = self.conf.get(WORKER_ID) or \
+                f"{_socket.gethostname()}:{os.getpid()}"
+            self._hb_sender = HeartbeatSender(
+                wid, hb_addr,
+                interval_s=self.conf.get(HEARTBEAT_INTERVAL_MS) / 1000.0)
+
         self.metrics = MetricsSystem("driver", self.conf.get(METRICS_PERIOD_S))
         for name in [s.strip() for s in self.conf.get(METRICS_SINKS).split(",")
                      if s.strip()]:
@@ -256,6 +272,21 @@ class CycloneContext:
                 self._heartbeats.start()
             return self._heartbeats
 
+    def start_heartbeat_server(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the driver-side TCP heartbeat endpoint (≈ the
+        HeartbeatReceiver RPC endpoint registration). Point each worker's
+        ``cyclone.driver.heartbeatAddress`` at the returned server's
+        ``.address``; expiry lands on the listener bus as WorkerLost."""
+        from cycloneml_tpu.parallel.resilience import HeartbeatServer
+        receiver = self.heartbeat_receiver  # raises if stopped; outside the
+        # lock below because it takes _hb_lock itself
+        with self._hb_lock:  # no double-start, no post-stop leak
+            if self._stopped:
+                raise RuntimeError("context is stopped")
+            if getattr(self, "_hb_server", None) is None:
+                self._hb_server = HeartbeatServer(receiver, host, port)
+        return self._hb_server
+
     def with_resources(self, profile) -> "CycloneContext":
         """Stage-level scheduling decision (ref: RDD.withResources,
         rdd/RDD.scala:1806): ensure the mesh matches the profile's slice
@@ -332,6 +363,10 @@ class CycloneContext:
         with self._hb_lock:  # pairs with lazy create: no post-stop starts
             if self._heartbeats is not None:
                 self._heartbeats.stop()
+        if self._hb_sender is not None:
+            self._hb_sender.stop()
+        if getattr(self, "_hb_server", None) is not None:
+            self._hb_server.stop()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

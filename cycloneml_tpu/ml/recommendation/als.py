@@ -269,7 +269,9 @@ def _normal_eq_local(n_dst: int, rank: int, n_chunks: int, implicit: bool,
     hi = jax.lax.Precision.HIGHEST
 
     def local(dst_idx, src_idx, r, mask, src_fac, yty):
-        def chunk_partials(d_i, s_i, r_c, m_c):
+        def body(carry, ch):
+            a, b, cnt = carry
+            d_i, s_i, r_c, m_c = ch
             v = src_fac[s_i]                       # (chunk, rank)
             if implicit:
                 c_minus_1 = (alpha * jnp.abs(r_c)) * m_c
@@ -281,14 +283,11 @@ def _normal_eq_local(n_dst: int, rank: int, n_chunks: int, implicit: bool,
                 outer = jnp.einsum("bi,bj->bij", v * m_c[:, None], v,
                                    precision=hi)
                 bvec = v * (r_c * m_c)[:, None]
-            return (jax.ops.segment_sum(outer, d_i, num_segments=n_dst),
-                    jax.ops.segment_sum(bvec, d_i, num_segments=n_dst),
-                    jax.ops.segment_sum(m_c, d_i, num_segments=n_dst))
-
-        def body(carry, ch):
-            a, b, cnt = carry
-            da, db, dc = chunk_partials(*ch)
-            return (a + da, b + db, cnt + dc), None
+            # scatter-add straight into the (donated) scan carry: per-chunk
+            # work stays O(chunk·r²) — a dense segment_sum + carry add would
+            # read/write the full (n_dst, r, r) accumulator every chunk
+            return (a.at[d_i].add(outer), b.at[d_i].add(bvec),
+                    cnt.at[d_i].add(m_c)), None
 
         zeros = (jnp.zeros((n_dst, rank, rank), src_fac.dtype),
                  jnp.zeros((n_dst, rank), src_fac.dtype),

@@ -116,6 +116,116 @@ class HeartbeatReceiver:
             self._thread = None
 
 
+class HeartbeatServer:
+    """TCP endpoint feeding a :class:`HeartbeatReceiver` — the over-the-wire
+    leg of the heartbeat loop (ref: HeartbeatReceiver.scala:37 is an RPC
+    endpoint; workers ping the driver, not an in-process object).
+
+    Line protocol (one request per connection):
+      ``REG <worker_id>`` → ``OK``         register / revive
+      ``HB <worker_id>``  → ``OK`` | ``EXPIRED``   expired workers must
+      re-register, exactly as the reference asks executors to re-register.
+    """
+
+    def __init__(self, receiver: HeartbeatReceiver, host: str = "127.0.0.1",
+                 port: int = 0):
+        import socketserver
+
+        recv = receiver
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    # a client that never sends a newline must not pin this
+                    # handler thread forever (half-open probes, stalls)
+                    self.request.settimeout(5.0)
+                    line = self.rfile.readline(256).decode("utf-8", "replace")
+                    parts = line.split()
+                    if len(parts) != 2:
+                        self.wfile.write(b"ERR\n")
+                        return
+                    cmd, worker = parts
+                    if cmd == "REG":
+                        recv.register(worker)
+                        self.wfile.write(b"OK\n")
+                    elif cmd == "HB":
+                        ok = recv.heartbeat(worker)
+                        self.wfile.write(b"OK\n" if ok else b"EXPIRED\n")
+                    else:
+                        self.wfile.write(b"ERR\n")
+                except OSError:
+                    # connect-then-close probes (port scans, TCP liveness
+                    # checks) are normal background noise, not errors
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="cyclone-heartbeat-server", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class HeartbeatSender:
+    """Worker-side loop pinging a :class:`HeartbeatServer` over TCP.
+
+    Registers on first contact; on an ``EXPIRED`` reply it re-registers
+    (the receiver's revive contract). Connection errors are retried at the
+    next interval — a dead driver must not crash the worker (the reference's
+    executor retries heartbeats HEARTBEAT_MAX_FAILURES times).
+    """
+
+    def __init__(self, worker_id: str, address: str,
+                 interval_s: float = 1.0):
+        host, _, port = address.rpartition(":")
+        self.worker_id = worker_id
+        self._addr = (host or "127.0.0.1", int(port))
+        self.interval_s = interval_s
+        self._registered = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"cyclone-heartbeat-{worker_id}",
+            daemon=True)
+        self._thread.start()
+
+    def _send(self, msg: str) -> str:
+        import socket
+        with socket.create_connection(self._addr, timeout=5) as s:
+            s.sendall((msg + "\n").encode())
+            return s.makefile("r").readline().strip()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self._registered:
+                    if self._send(f"REG {self.worker_id}") == "OK":
+                        self._registered = True
+                else:
+                    if self._send(f"HB {self.worker_id}") == "EXPIRED":
+                        self._registered = False  # re-register next tick
+                        continue
+            except OSError:
+                pass  # driver unreachable: retry next interval
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
 class HealthTracker:
     """Excludes workers after repeated failures (ref: HealthTracker.scala:52
     — per-executor failure counts with a threshold)."""

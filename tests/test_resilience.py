@@ -379,3 +379,53 @@ def test_heartbeat_receiver_on_context(ctx):
     hb.register("host-0")
     assert hb.heartbeat("host-0")
     assert "host-0" in hb.live_workers()
+
+
+def test_heartbeat_over_the_wire():
+    """Cross-process leg: a real TCP server feeding the receiver, a real
+    sender thread pinging it. Stop the sender -> expiry -> WorkerLost on the
+    bus; an expired worker's next ping gets EXPIRED and it re-registers."""
+    import time
+    from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                                   HeartbeatSender,
+                                                   HeartbeatServer)
+
+    bus = ListenerBus()
+    bus.start()
+    lost = []
+    bus.add_listener(lambda e: lost.append(e.worker_id)
+                     if isinstance(e, WorkerLost) else None)
+
+    recv = HeartbeatReceiver(timeout_s=0.8, check_interval_s=0.1,
+                             listener_bus=bus)
+    server = HeartbeatServer(recv)
+    try:
+        s1 = HeartbeatSender("w1", server.address, interval_s=0.1)
+        s2 = HeartbeatSender("w2", server.address, interval_s=0.1)
+        deadline = time.time() + 5
+        while set(recv.live_workers()) != {"w1", "w2"}:
+            assert time.time() < deadline, recv.live_workers()
+            time.sleep(0.05)
+
+        s1.stop()  # "kill" w1: its pings cease
+        deadline = time.time() + 5
+        while "w1" not in recv.lost_workers():
+            recv.check_now()
+            assert time.time() < deadline
+            time.sleep(0.1)
+        bus.wait_until_empty()
+        assert lost == ["w1"]
+        assert "w2" in recv.live_workers()  # the survivor is untouched
+
+        # a stopped-then-revived worker re-registers through the EXPIRED
+        # reply path and becomes live again
+        s1b = HeartbeatSender("w1", server.address, interval_s=0.1)
+        deadline = time.time() + 5
+        while "w1" not in recv.live_workers():
+            assert time.time() < deadline
+            time.sleep(0.05)
+        s1b.stop()
+        s2.stop()
+    finally:
+        server.stop()
+        bus.stop()
