@@ -135,6 +135,7 @@ class CycloneContext:
         # configured, this process pings it over TCP (the wire leg of
         # HeartbeatReceiver; ref HeartbeatReceiver.scala:37)
         self._hb_sender = None
+        self._hb_server = None
         from cycloneml_tpu.conf import (DRIVER_HEARTBEAT_ADDRESS,
                                         HEARTBEAT_INTERVAL_MS, WORKER_ID)
         hb_addr = self.conf.get(DRIVER_HEARTBEAT_ADDRESS)
@@ -283,8 +284,15 @@ class CycloneContext:
         with self._hb_lock:  # no double-start, no post-stop leak
             if self._stopped:
                 raise RuntimeError("context is stopped")
-            if getattr(self, "_hb_server", None) is None:
+            if self._hb_server is None:
                 self._hb_server = HeartbeatServer(receiver, host, port)
+            elif (host, port) not in ((self._hb_server.host,
+                                       self._hb_server.port),
+                                      ("127.0.0.1", 0)):
+                raise ValueError(
+                    f"heartbeat server already bound to "
+                    f"{self._hb_server.address}; cannot rebind to "
+                    f"{host}:{port}")
         return self._hb_server
 
     def with_resources(self, profile) -> "CycloneContext":
@@ -365,7 +373,7 @@ class CycloneContext:
                 self._heartbeats.stop()
         if self._hb_sender is not None:
             self._hb_sender.stop()
-        if getattr(self, "_hb_server", None) is not None:
+        if self._hb_server is not None:
             self._hb_server.stop()
         self.metrics.stop()
         self.listener_bus.stop()

@@ -25,7 +25,7 @@ from cycloneml_tpu.dataset.frame import MLFrame
 from cycloneml_tpu.linalg.matrices import DenseMatrix
 from cycloneml_tpu.linalg.vectors import DenseVector, Vectors
 from cycloneml_tpu.ml.base import Predictor, ProbabilisticClassificationModel
-from cycloneml_tpu.ml.optim import LBFGS, OWLQN, aggregators
+from cycloneml_tpu.ml.optim import LBFGS, LBFGSB, OWLQN, aggregators
 from cycloneml_tpu.ml.optim.loss import (
     DistributedLossFunction, l2_regularization, standardize_dataset,
 )
@@ -68,6 +68,33 @@ class _LogisticRegressionParams(HasMaxIter, HasRegParam, HasElasticNetParam,
         self.checkpointInterval = self._param(
             "checkpointInterval", "iterations between checkpoints",
             V.gt(0), default=10)
+        # box constraints on the solution select the bound-constrained
+        # optimizer, exactly as the reference's createOptimizer does
+        # (LogisticRegression.scala:777-814, BreezeLBFGSB at :788);
+        # shapes follow the reference: coefficient bounds are
+        # (numClasses-ish, d) matrices (binomial: (1, d)), intercept
+        # bounds are vectors
+        self.lowerBoundsOnCoefficients = self._param(
+            "lowerBoundsOnCoefficients",
+            "(k, d) lower bounds on coefficients", default=None)
+        self.upperBoundsOnCoefficients = self._param(
+            "upperBoundsOnCoefficients",
+            "(k, d) upper bounds on coefficients", default=None)
+        self.lowerBoundsOnIntercepts = self._param(
+            "lowerBoundsOnIntercepts", "(k,) lower bounds on intercepts",
+            default=None)
+        self.upperBoundsOnIntercepts = self._param(
+            "upperBoundsOnIntercepts", "(k,) upper bounds on intercepts",
+            default=None)
+
+    def _opt(self, name):
+        """Optional param: None when never set (these have no default)."""
+        return self.get(name) if self.is_defined(self.get_param(name)) else None
+
+    def _has_bounds(self) -> bool:
+        return any(self._opt(p) is not None for p in (
+            "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+            "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts"))
 
 
 class LogisticRegression(Predictor, _LogisticRegressionParams,
@@ -102,6 +129,47 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
 
     def set_threshold(self, v):
         return self.set("threshold", v)
+
+    def _flat_bounds(self, d, num_classes, is_multinomial, fit_intercept,
+                     n_coef, features_std):
+        """Flatten user bounds into the optimizer's coefficient layout, in
+        STANDARDIZED space: β_std = β_orig·std, so coefficient bounds scale
+        by featuresStd exactly as the reference's createBounds does
+        (LogisticRegression.scala:2085-2156). Intercepts are unscaled."""
+        k_rows = num_classes if is_multinomial else 1
+        n_feat = d * k_rows
+        out = []
+        for cp, ip, fill in (
+                ("lowerBoundsOnCoefficients", "lowerBoundsOnIntercepts",
+                 -np.inf),
+                ("upperBoundsOnCoefficients", "upperBoundsOnIntercepts",
+                 np.inf)):
+            b = np.full(n_coef, fill)
+            cb = self._opt(cp)
+            if cb is not None:
+                cb = np.asarray(cb, dtype=np.float64)
+                if cb.ndim == 1 and k_rows == 1 and cb.size == d:
+                    cb = cb[None, :]  # binomial convenience: a plain vector
+                if cb.shape != (k_rows, d):
+                    # exact-shape check: size alone would silently accept a
+                    # TRANSPOSED multinomial matrix and scramble the box
+                    raise ValueError(
+                        f"{cp} must have shape ({k_rows}, {d}); "
+                        f"got {cb.shape}")
+                b[:n_feat] = (cb
+                              * np.asarray(features_std)[None, :]).reshape(-1)
+            ib = self._opt(ip)
+            if ib is not None:
+                if not fit_intercept:
+                    raise ValueError(
+                        f"{ip} requires fitIntercept=True")
+                ib = np.asarray(ib, dtype=np.float64).reshape(-1)
+                if ib.size != k_rows:
+                    raise ValueError(
+                        f"{ip} must have {k_rows} entries; got {ib.size}")
+                b[n_feat:] = ib
+            out.append(b)
+        return out[0], out[1]
 
     def _fit(self, frame: MLFrame) -> "LogisticRegressionModel":
         ds = frame.to_instance_dataset(
@@ -182,7 +250,22 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         else:
             loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
 
-        if l1 > 0:
+        if self._has_bounds():
+            # box-constrained path (ref createOptimizer selects BreezeLBFGSB
+            # whenever bounds are set, LogisticRegression.scala:788; bounds
+            # are only legal with none/L2 regularization there too)
+            if alpha != 0.0:
+                # the reference rejects ANY nonzero elasticNetParam with
+                # bounds, regardless of regParam
+                raise ValueError(
+                    "coefficient bounds are only supported with none or L2 "
+                    "regularization (elasticNetParam must be 0, as the "
+                    "reference enforces)")
+            lo, hi = self._flat_bounds(d, num_classes, is_multinomial,
+                                       fit_intercept, n_coef, features_std)
+            opt = LBFGSB(lo, hi, max_iter=self.get("maxIter"),
+                         tol=self.get("tol"))
+        elif l1 > 0:
             n_feat_coords = d * num_classes if is_multinomial else d
             l1_vec = np.zeros(n_coef)
             per_coord = np.full(n_feat_coords, l1)

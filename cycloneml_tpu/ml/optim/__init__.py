@@ -1,4 +1,4 @@
-from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OWLQN, OptimState
+from cycloneml_tpu.ml.optim.lbfgs import LBFGS, LBFGSB, OWLQN, OptimState
 from cycloneml_tpu.ml.optim import aggregators
 
-__all__ = ["LBFGS", "OWLQN", "OptimState", "aggregators"]
+__all__ = ["LBFGS", "LBFGSB", "OWLQN", "OptimState", "aggregators"]

@@ -201,3 +201,88 @@ def test_pipeline_with_lr(ctx, tmp_path):
     pm.save(p)
     back = PipelineModel.load(p)
     np.testing.assert_allclose(back.transform(frame)["prediction"], out["prediction"])
+
+
+# -- coefficient bounds (LBFGS-B path) -----------------------------------------
+
+def _bounded_problem(ctx, n=300, d=5, seed=11):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    true = np.array([2.0, -1.5, 0.8, -0.3, 1.1])
+    y = (x @ true + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return MLFrame(ctx, {"features": x, "label": y})
+
+
+def test_lr_coefficient_bounds_respected(ctx):
+    """lowerBounds/upperBounds select the bound-constrained optimizer (ref
+    LogisticRegression.scala:788) and the trained coefficients respect the
+    box in ORIGINAL feature space."""
+    frame = _bounded_problem(ctx)
+    lr = LogisticRegression(
+        maxIter=100, regParam=0.01, tol=1e-10,
+        lowerBoundsOnCoefficients=np.zeros((1, 5)))  # nonnegative
+    m = lr.fit(frame)
+    coefs = m.coefficients.to_array()
+    assert np.all(coefs >= -1e-9), coefs
+    # the unbounded fit has negative coefficients, so the box truly binds
+    free = LogisticRegression(maxIter=100, regParam=0.01, tol=1e-10).fit(frame)
+    assert np.any(free.coefficients.to_array() < 0)
+    assert np.any(np.isclose(coefs, 0.0, atol=1e-6))
+
+
+def test_lr_wide_bounds_match_unbounded(ctx):
+    frame = _bounded_problem(ctx, seed=13)
+    wide = LogisticRegression(
+        maxIter=100, regParam=0.05, tol=1e-10,
+        lowerBoundsOnCoefficients=np.full((1, 5), -1e6),
+        upperBoundsOnCoefficients=np.full((1, 5), 1e6),
+        lowerBoundsOnIntercepts=np.array([-1e6]),
+        upperBoundsOnIntercepts=np.array([1e6])).fit(frame)
+    free = LogisticRegression(maxIter=100, regParam=0.05, tol=1e-10).fit(frame)
+    np.testing.assert_allclose(wide.coefficients.to_array(),
+                               free.coefficients.to_array(),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(wide.intercept, free.intercept,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_lr_intercept_bounds(ctx):
+    frame = _bounded_problem(ctx, seed=17)
+    m = LogisticRegression(
+        maxIter=80, tol=1e-9,
+        lowerBoundsOnIntercepts=np.array([0.5])).fit(frame)
+    assert m.intercept >= 0.5 - 1e-9
+
+
+def test_lr_bounds_reject_elastic_net(ctx):
+    frame = _bounded_problem(ctx)
+    with pytest.raises(ValueError, match="none or L2"):
+        LogisticRegression(
+            regParam=0.1, elasticNetParam=0.5,
+            lowerBoundsOnCoefficients=np.zeros((1, 5))).fit(frame)
+
+
+def test_lr_bounds_shape_validation(ctx):
+    frame = _bounded_problem(ctx)
+    with pytest.raises(ValueError, match="shape"):
+        LogisticRegression(
+            lowerBoundsOnCoefficients=np.zeros((1, 3))).fit(frame)
+    with pytest.raises(ValueError, match="fitIntercept"):
+        LogisticRegression(
+            fitIntercept=False,
+            lowerBoundsOnIntercepts=np.array([0.0])).fit(frame)
+
+
+def test_lr_multinomial_bounds(ctx):
+    rng = np.random.RandomState(23)
+    n, d, k = 400, 4, 3
+    x = rng.randn(n, d)
+    w = rng.randn(k, d)
+    y = np.argmax(x @ w.T + 0.2 * rng.randn(n, k), axis=1).astype(np.float64)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    m = LogisticRegression(
+        maxIter=100, regParam=0.01, tol=1e-9,
+        lowerBoundsOnCoefficients=np.zeros((k, d))).fit(frame)
+    cm = m.coefficient_matrix.to_array()
+    assert cm.shape == (k, d)
+    assert np.all(cm >= -1e-9)
