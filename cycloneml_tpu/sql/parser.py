@@ -37,12 +37,24 @@ _KEYWORDS = {
     "limit", "as", "and", "or", "not", "in", "is", "null", "like", "between",
     "case", "when", "then", "else", "end", "join", "inner", "left", "right",
     "full", "outer", "cross", "on", "asc", "desc", "true", "false", "union",
-    "all", "using",
+    "all", "using", "over", "partition", "exists", "create", "replace",
+    "temporary", "temp", "view", "table", "insert", "into", "values",
 }
 
 _AGG_FNS = {"sum": F.sum, "avg": F.avg, "mean": F.avg, "min": F.min,
             "max": F.max, "count": F.count, "count_distinct": F.count_distinct,
             "first": F.first, "collect_list": F.collect_list}
+
+# window-only functions: meaningless without an OVER clause
+_WINDOW_FNS = {"row_number", "rank", "dense_rank", "percent_rank",
+               "cume_dist", "ntile", "lag", "lead"}
+
+
+def _contains_window(e: Expr) -> bool:
+    from cycloneml_tpu.sql.window import WindowFnExpr
+    if isinstance(e, WindowFnExpr):
+        return True
+    return any(_contains_window(c) for c in e.children)
 
 
 def tokenize(s: str) -> List[Tuple[str, str]]:
@@ -72,6 +84,12 @@ class _Parser:
         self.toks = tokens
         self.i = 0
         self.catalog = catalog or {}
+        # table-alias scoping: alias -> {column -> actual output name}.
+        # Names stay global EXCEPT when a join duplicates a column (self
+        # joins): the right side's duplicates are renamed to a mangled
+        # internal name and qualified references resolve through this map.
+        self.alias_cols: dict = {}
+        self._last_select_had_tail = False
 
     # -- token helpers ---------------------------------------------------------
     def peek(self, k: int = 0) -> Tuple[str, str]:
@@ -97,16 +115,120 @@ class _Parser:
                              f"(token {self.i - 1})")
         return v
 
+    # -- statements (ref SqlBaseParser.g4 statement rule) ----------------------
+    def parse_statement(self):
+        """Returns ("query", plan) or a DDL/DML tuple the session executes:
+        ("create_view", name, plan, replace) | ("ctas", name, plan, replace)
+        | ("insert", name, plan)."""
+        k, v = self.peek()
+        if (k, v) == ("kw", "create"):
+            self.next()
+            replace = False
+            if self.accept("kw", "or"):
+                self.expect("kw", "replace")
+                replace = True
+            self.accept("kw", "temporary") or self.accept("kw", "temp")
+            if self.accept("kw", "view"):
+                name = self.expect("ident")
+                self.expect("kw", "as")
+                return ("create_view", name, self.parse_query(), replace)
+            self.expect("kw", "table")
+            name = self.expect("ident")
+            self.expect("kw", "as")
+            return ("ctas", name, self.parse_query(), replace)
+        if (k, v) == ("kw", "insert"):
+            self.next()
+            self.expect("kw", "into")
+            self.accept("kw", "table")
+            name = self.expect("ident")
+            if self.accept("kw", "values"):
+                return ("insert", name, self.parse_values(name))
+            return ("insert", name, self.parse_query())
+        return ("query", self.parse_query())
+
+    def parse_values(self, table: str) -> LogicalPlan:
+        """VALUES (...), (...) — column names/order follow the target."""
+        from cycloneml_tpu.sql.plan import Scan
+        if table not in self.catalog:
+            raise ValueError(f"table {table!r} not found")
+        names = self.catalog[table].output()
+        rows = []
+        while True:
+            self.expect("op", "(")
+            row = [self.parse_literal_value()]
+            while self.accept("op", ","):
+                row.append(self.parse_literal_value())
+            self.expect("op", ")")
+            if len(row) != len(names):
+                raise ValueError(
+                    f"VALUES row has {len(row)} items; {table!r} has "
+                    f"{len(names)} columns {names}")
+            rows.append(row)
+            if not self.accept("op", ","):
+                break
+        import numpy as _np
+        cols = {}
+        for i, n in enumerate(names):
+            vals = [r[i] for r in rows]
+            if any(v is None for v in vals):
+                # NULL literal: NaN in numeric columns, None in object ones
+                # (all-NULL rows can't prove numeric — keep them as objects
+                # and let the concat against the target column coerce)
+                if any(isinstance(v, (int, float)) for v in vals) and \
+                        all(isinstance(v, (int, float)) or v is None
+                            for v in vals):
+                    vals = [_np.nan if v is None else float(v) for v in vals]
+                    cols[n] = _np.asarray(vals, dtype=_np.float64)
+                    continue
+                cols[n] = _np.asarray(vals, dtype=object)
+                continue
+            cols[n] = _np.asarray(vals)
+        return Scan(cols, "values")
+
     # -- query -----------------------------------------------------------------
     def parse_query(self) -> LogicalPlan:
+        """select [UNION [ALL] select]* (ref SqlBaseParser.g4 setOperation;
+        plain UNION deduplicates, exactly SQL's bag-vs-set semantics)."""
+        from cycloneml_tpu.sql.plan import Union
+        plan = self.parse_select()
+        unioned = False
+        while self.accept("kw", "union"):
+            is_all = self.accept("kw", "all")
+            plan = Union(plan, self.parse_select())
+            if not is_all:
+                plan = Distinct(plan)
+            unioned = True
+        if unioned and self._last_select_had_tail:
+            # standard SQL binds a trailing ORDER BY/LIMIT to the whole
+            # union; this one-pass parser bound it to the last branch —
+            # refuse rather than silently return the wrong rows
+            raise ValueError(
+                "ORDER BY/LIMIT directly after UNION is not supported; wrap "
+                "the union in a subquery: SELECT * FROM (... UNION ...) "
+                "ORDER BY ...")
+        return plan
+
+    def parse_select(self) -> LogicalPlan:
         self.expect("kw", "select")
         distinct = self.accept("kw", "distinct")
-        items = self.parse_select_list()
+        # the select list textually precedes FROM but must resolve against
+        # the FROM clause's aliases (self-join disambiguation): skip ahead,
+        # parse FROM + joins to build the alias scope, then rewind
+        sel_start = self.i
+        self._skip_select_list()
         self.expect("kw", "from")
-        plan = self.parse_table_ref()
+        plan, alias = self.parse_table_ref()
+        self._register_alias(plan, alias)
         while self.peek()[0] == "kw" and self.peek()[1] in (
                 "join", "inner", "left", "right", "full", "cross"):
             plan = self.parse_join(plan)
+        after_from = self.i
+        self.i = sel_start
+        items = self._demangle_select_items(self.parse_select_list())
+        if self.peek() != ("kw", "from"):
+            raise ValueError(f"expected FROM after select list, got "
+                             f"{self.peek()}")
+        self.i = after_from
         where = None
         if self.accept("kw", "where"):
             where = self.parse_expr()
@@ -128,6 +250,9 @@ class _Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("num"))
+        # parse_query uses this to refuse ambiguous trailing clauses on the
+        # last UNION branch
+        self._last_select_had_tail = bool(orders) or limit is not None
 
         if where is not None:
             plan = Filter(plan, where)
@@ -138,6 +263,10 @@ class _Parser:
             else:
                 expanded.append(e)
         items = expanded
+        if group and any(_contains_window(e) for e in items):
+            raise NotImplementedError(
+                "window functions over GROUP BY output are not supported in "
+                "SQL text yet; aggregate into a subquery in FROM first")
         has_agg = group or any(e.find_aggregates() for e in items)
         if has_agg:
             # Split SELECT items: expressions matching a GROUP BY key project
@@ -216,6 +345,22 @@ class _Parser:
             plan = Limit(plan, limit)
         return plan
 
+    def _skip_select_list(self) -> None:
+        """Advance past the select list to its FROM at paren depth 0
+        (subqueries in the list carry their own FROM at depth > 0)."""
+        depth = 0
+        while True:
+            k, v = self.peek()
+            if k == "eof":
+                raise ValueError("SELECT without FROM")
+            if k == "op" and v == "(":
+                depth += 1
+            elif k == "op" and v == ")":
+                depth -= 1
+            elif (k, v) == ("kw", "from") and depth == 0:
+                return
+            self.i += 1
+
     def parse_select_list(self) -> List[Expr]:
         items = [self.parse_select_item()]
         while self.accept("op", ","):
@@ -235,6 +380,42 @@ class _Parser:
             return Alias(e, e.name_hint())
         return e
 
+    @staticmethod
+    def _demangle(name: str):
+        """'__b__salary' -> ('b', 'salary'), or None if not mangled."""
+        if not name.startswith("__"):
+            return None
+        parts = name.split("__", 2)
+        if len(parts) == 3 and parts[1] and parts[2]:
+            return parts[1], parts[2]
+        return None
+
+    def _demangle_select_items(self, items: List[Expr]) -> List[Expr]:
+        """Rename mangled self-join columns for display: b.salary shows as
+        'salary' when unambiguous, 'b_salary' when the same short name is
+        also selected from the other side (a dict-batch engine cannot carry
+        two columns with one name — silent overwrite would drop data)."""
+        def target(e: Expr) -> str:
+            if isinstance(e, ColumnRef):
+                dm = self._demangle(e.name)
+                if dm:
+                    return dm[1]
+            return e.name_hint()
+
+        from collections import Counter
+        counts = Counter(target(e) for e in items)
+        out = []
+        for e in items:
+            if isinstance(e, ColumnRef):
+                dm = self._demangle(e.name)
+                if dm:
+                    qual, col = dm
+                    name = col if counts[col] == 1 else f"{qual}_{col}"
+                    out.append(Alias(e, name))
+                    continue
+            out.append(e)
+        return out
+
     def parse_order_item(self) -> SortOrder:
         e = self.parse_expr()
         asc = True
@@ -244,23 +425,30 @@ class _Parser:
             self.accept("kw", "asc")
         return SortOrder(e, asc)
 
-    def parse_table_ref(self) -> LogicalPlan:
+    def parse_table_ref(self) -> Tuple[LogicalPlan, Optional[str]]:
         if self.accept("op", "("):
             sub = self.parse_query()
             self.expect("op", ")")
             self.accept("kw", "as")
+            alias = None
             if self.peek()[0] == "ident":
-                self.next()  # alias name — columns are unqualified
-            return sub
+                alias = self.next()[1]
+            return sub, alias
         name = self.expect("ident")
         if name not in self.catalog:
             raise ValueError(f"table {name!r} not found; registered: "
                              f"{list(self.catalog)}")
-        plan = self.catalog[name]
+        from cycloneml_tpu.sql.plan import Relation
+        plan = Relation(name, self.catalog)  # late-bound: views see updates
+        alias = name  # a bare table is addressable by its own name
         self.accept("kw", "as")
         if self.peek()[0] == "ident":
-            self.next()
-        return plan
+            alias = self.next()[1]
+        return plan, alias
+
+    def _register_alias(self, plan: LogicalPlan, alias: Optional[str]) -> None:
+        if alias:
+            self.alias_cols[alias] = {c: c for c in plan.output()}
 
     def parse_join(self, left: LogicalPlan) -> LogicalPlan:
         how = "inner"
@@ -278,20 +466,57 @@ class _Parser:
         else:
             self.accept("kw", "inner")
         self.expect("kw", "join")
-        right = self.parse_table_ref()
+        right, ralias = self.parse_table_ref()
+        # self-join disambiguation: duplicates on the right get a mangled
+        # name; qualified refs (b.col) resolve through alias_cols
+        left_out = set(left.output())
+        dup = [c for c in right.output() if c in left_out]
+        if dup:
+            if not ralias:
+                raise ValueError(
+                    f"columns {dup} exist on both join sides; alias the "
+                    "right-hand relation to disambiguate")
+            mapping = {c: (f"__{ralias}__{c}" if c in dup else c)
+                       for c in right.output()}
+            right = Project(right, [
+                Alias(ColumnRef(c), mapping[c]) if mapping[c] != c
+                else ColumnRef(c) for c in right.output()])
+            self.alias_cols[ralias] = mapping
+        else:
+            self._register_alias(right, ralias)
         pairs: List[Tuple[str, str]] = []
         if self.accept("kw", "using"):
+            rmap = self.alias_cols.get(ralias or "", {})
             self.expect("op", "(")
-            pairs.append((self.expect("ident"),) * 2)
+            k = self.expect("ident")
+            pairs.append((k, rmap.get(k, k)))
             while self.accept("op", ","):
-                pairs.append((self.expect("ident"),) * 2)
+                k = self.expect("ident")
+                pairs.append((k, rmap.get(k, k)))
             self.expect("op", ")")
         elif self.accept("kw", "on"):
             pairs.append(self.parse_eq_pair())
             while self.accept("kw", "and"):
                 pairs.append(self.parse_eq_pair())
+            # ON may be written either way around (b.id = a.id); Join needs
+            # (left_col, right_col) — orient each pair by side membership
+            lo, ro = set(left.output()), set(right.output())
+            oriented = []
+            for x, y in pairs:
+                if y in lo and x in ro and not (x in lo and y in ro):
+                    x, y = y, x
+                oriented.append((x, y))
+            pairs = oriented
         elif how != "cross":
             raise ValueError("JOIN requires ON or USING")
+        if ralias in self.alias_cols:
+            # the join coalesces right KEY columns into the left-side name;
+            # qualified refs to them must resolve to the surviving column
+            amap = self.alias_cols[ralias]
+            inv = {v: k for k, v in amap.items()}
+            for lcol, rcol in pairs:
+                if rcol in inv:
+                    amap[inv[rcol]] = lcol
         return Join(left, right, pairs, how)
 
     def parse_eq_pair(self) -> Tuple[str, str]:
@@ -303,7 +528,8 @@ class _Parser:
     def parse_qualified_name(self) -> str:
         name = self.expect("ident")
         if self.accept("op", "."):
-            name = self.expect("ident")  # qualifier dropped: names are global
+            col = self.expect("ident")
+            return self.alias_cols.get(name, {}).get(col, col)
         return name
 
     # -- expressions (precedence climbing) ------------------------------------
@@ -351,6 +577,13 @@ class _Parser:
         if k == "kw" and v == "in":
             self.next()
             self.expect("op", "(")
+            if self.peek() == ("kw", "select"):
+                # IN (SELECT ...) — uncorrelated list subquery
+                from cycloneml_tpu.sql.plan import InSubquery
+                sub = self.parse_query()
+                self.expect("op", ")")
+                out = InSubquery(e, sub)
+                return UnaryOp("not", out) if neg else out
             vals = [self.parse_literal_value()]
             while self.accept("op", ","):
                 vals.append(self.parse_literal_value())
@@ -377,6 +610,12 @@ class _Parser:
             return float(v) if "." in v else int(v)
         if k == "str":
             return v
+        if (k, v) == ("kw", "null"):
+            return None  # engine null (NaN for numeric columns)
+        if (k, v) == ("kw", "true"):
+            return True
+        if (k, v) == ("kw", "false"):
+            return False
         if (k, v) == ("op", "-"):
             k2, v2 = self.next()
             if k2 == "num":
@@ -427,8 +666,21 @@ class _Parser:
             return Literal(False)
         if (k, v) == ("kw", "case"):
             return self.parse_case()
+        if (k, v) == ("kw", "exists"):
+            self.next()
+            self.expect("op", "(")
+            from cycloneml_tpu.sql.plan import ExistsSubquery
+            sub = self.parse_query()
+            self.expect("op", ")")
+            return ExistsSubquery(sub)
         if (k, v) == ("op", "("):
             self.next()
+            if self.peek() == ("kw", "select"):
+                # (SELECT ...) as a value — scalar subquery
+                from cycloneml_tpu.sql.plan import ScalarSubquery
+                sub = self.parse_query()
+                self.expect("op", ")")
+                return ScalarSubquery(sub)
             e = self.parse_expr()
             self.expect("op", ")")
             return e
@@ -437,7 +689,8 @@ class _Parser:
             if self.accept("op", "("):
                 return self.parse_call(name)
             if self.accept("op", "."):
-                return ColumnRef(self.expect("ident"))
+                col = self.expect("ident")
+                return ColumnRef(self.alias_cols.get(name, {}).get(col, col))
             return ColumnRef(name)
         raise ValueError(f"unexpected token {v!r} in expression")
 
@@ -446,7 +699,7 @@ class _Parser:
         if lname == "count" and self.peek() == ("op", "*"):
             self.next()
             self.expect("op", ")")
-            return CountAgg(None)
+            return self._maybe_over(CountAgg(None))
         if lname == "count" and self.peek() == ("kw", "distinct"):
             self.next()
             arg = self.parse_expr()
@@ -459,10 +712,68 @@ class _Parser:
             while self.accept("op", ","):
                 args.append(self.parse_expr())
             self.expect("op", ")")
+        if lname in _WINDOW_FNS:
+            return self.parse_window_fn(lname, args)
         if lname in _AGG_FNS:
             from cycloneml_tpu.sql.column import Column
-            return _AGG_FNS[lname](Column(args[0])).expr
+            return self._maybe_over(_AGG_FNS[lname](Column(args[0])).expr)
         return Func(lname, *args)
+
+    # -- window clause (ref SqlBaseParser.g4 windowSpec / functionCall OVER) ---
+    def _maybe_over(self, agg_expr: Expr) -> Expr:
+        if not self.accept("kw", "over"):
+            return agg_expr
+        from cycloneml_tpu.sql.column import Column
+        from cycloneml_tpu.sql.window import over
+        return over(Column(agg_expr), self.parse_window_spec()).expr
+
+    def parse_window_fn(self, lname: str, args: List[Expr]) -> Expr:
+        from cycloneml_tpu.sql import window as W
+        from cycloneml_tpu.sql.column import Column
+        if lname in ("lag", "lead"):
+            if not args:
+                raise ValueError(f"{lname}() needs a value argument")
+            offset = 1
+            default = None
+            if len(args) > 1:
+                if not isinstance(args[1], Literal):
+                    raise ValueError(f"{lname}() offset must be a literal")
+                offset = int(args[1].value)
+            if len(args) > 2:
+                if not isinstance(args[2], Literal):
+                    raise ValueError(f"{lname}() default must be a literal")
+                default = args[2].value
+            import numpy as _np
+            fn = W.lag if lname == "lag" else W.lead
+            base = fn(Column(args[0]), offset,
+                      _np.nan if default is None else default)
+        elif lname == "ntile":
+            if len(args) != 1 or not isinstance(args[0], Literal):
+                raise ValueError("ntile(n) needs a literal bucket count")
+            base = W.ntile(int(args[0].value))
+        else:
+            base = getattr(W, lname)()
+        self.expect("kw", "over")  # window functions REQUIRE a window
+        from cycloneml_tpu.sql.window import over
+        return over(base, self.parse_window_spec()).expr
+
+    def parse_window_spec(self):
+        from cycloneml_tpu.sql.window import WindowSpec
+        self.expect("op", "(")
+        parts: List[Expr] = []
+        orders: List[SortOrder] = []
+        if self.accept("kw", "partition"):
+            self.expect("kw", "by")
+            parts.append(self.parse_expr())
+            while self.accept("op", ","):
+                parts.append(self.parse_expr())
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            orders.append(self.parse_order_item())
+            while self.accept("op", ","):
+                orders.append(self.parse_order_item())
+        self.expect("op", ")")
+        return WindowSpec(parts, orders)
 
     def parse_case(self) -> Expr:
         self.expect("kw", "case")
@@ -484,6 +795,16 @@ def parse_sql(sql: str, catalog) -> LogicalPlan:
     if p.peek()[0] != "eof":
         raise ValueError(f"trailing tokens after query: {p.peek()}")
     return plan
+
+
+def parse_sql_statement(sql: str, catalog):
+    """Statement entry: SELECT plus CREATE VIEW / CREATE TABLE AS /
+    INSERT INTO (ref SqlBaseParser.g4 statement)."""
+    p = _Parser(tokenize(sql), catalog)
+    stmt = p.parse_statement()
+    if p.peek()[0] != "eof":
+        raise ValueError(f"trailing tokens after statement: {p.peek()}")
+    return stmt
 
 
 def parse_expression(s: str) -> Expr:

@@ -72,6 +72,66 @@ class Scan(LogicalPlan):
         return f"Scan({self.name}{cols})"
 
 
+class Relation(LogicalPlan):
+    """Late-bound catalog reference (ref: UnresolvedRelation → the analyzer's
+    relation lookup). Resolving at EXECUTE time — not parse time — is what
+    makes a view over a table observe later INSERTs / CREATE OR REPLACEs,
+    matching the reference's lazy analysis."""
+
+    def __init__(self, name: str, catalog):
+        self.children = []
+        self.name = name
+        self.catalog = catalog
+
+    def _resolve(self) -> LogicalPlan:
+        if self.name not in self.catalog:
+            raise ValueError(f"table or view {self.name!r} not found; "
+                             f"registered: {list(self.catalog)}")
+        return self.catalog[self.name]
+
+    def output(self):
+        return self._resolve().output()
+
+    def execute(self):
+        return self._resolve().execute()
+
+    def __repr__(self):
+        return f"Relation({self.name})"
+
+
+def find_relations(plan: LogicalPlan) -> List[str]:
+    """Names of all late-bound relations in a plan tree (cycle detection).
+
+    Walks EVERY Expr-valued attribute of every node (exprs, cond, orders,
+    group/agg expressions, ...) — subquery expressions hold plans outside
+    ``children``, and missing any attribute would let a recursive view slip
+    past the guard and blow the stack at query time."""
+    out: List[str] = []
+
+    def walk(p: LogicalPlan):
+        if isinstance(p, Relation):
+            out.append(p.name)
+        for c in p.children:
+            walk(c)
+        for v in vars(p).values():
+            if isinstance(v, Expr):
+                _walk_expr(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, Expr):
+                        _walk_expr(item)
+
+    def _walk_expr(e):
+        sub = getattr(e, "plan", None)
+        if sub is not None:
+            walk(sub)
+        for c in e.children:
+            _walk_expr(c)
+
+    walk(plan)
+    return out
+
+
 class Project(LogicalPlan):
     def __init__(self, child: LogicalPlan, exprs: List[Expr]):
         self.children = [child]
@@ -440,3 +500,97 @@ class MapBatch(LogicalPlan):
 
     def __repr__(self):
         return f"MapBatch({self.name})"
+
+
+# -- subquery expressions -------------------------------------------------------
+# (ref: catalyst subquery.scala — ScalarSubquery / ListQuery / Exists; the
+# reference rewrites them into joins in RewriteSubquery batches, this engine
+# executes the subplan directly at expression-eval time. Uncorrelated only:
+# the subplan cannot see outer attributes.)
+
+class _SubqueryMixin:
+    @property
+    def foldable(self) -> bool:
+        return False  # constant-folding must not execute subplans at
+        # optimize time (and a folded array literal would be wrong anyway)
+
+    def _sub_batch(self) -> Batch:
+        return self.plan.execute()
+
+    def _first_col(self) -> np.ndarray:
+        batch = self._sub_batch()
+        names = [k for k in batch if k != "__len__"]
+        if not names:
+            raise ValueError("subquery produced no columns")
+        return np.atleast_1d(np.asarray(batch[names[0]]))
+
+
+class InSubquery(_SubqueryMixin, Expr):
+    """``expr IN (SELECT ...)`` — membership against the subquery's first
+    output column (ref ListQuery). NULL propagation follows the engine's
+    NaN-as-null convention: NaN never matches."""
+
+    def __init__(self, needle: Expr, plan: LogicalPlan):
+        self.children = [needle]
+        self.plan = plan
+
+    def with_children(self, c):
+        return InSubquery(c[0], self.plan)
+
+    def eval(self, batch):
+        hay = self._first_col()
+        vals = np.atleast_1d(self.children[0].eval(batch))
+        if vals.dtype == object or hay.dtype == object:
+            hs = set(hay.tolist())
+            return np.array([v in hs for v in vals.tolist()])
+        return np.isin(vals, hay)
+
+    def name_hint(self):
+        return f"{self.children[0]} IN (subquery)"
+
+    def __str__(self):
+        return self.name_hint()
+
+
+class ExistsSubquery(_SubqueryMixin, Expr):
+    """``EXISTS (SELECT ...)`` — true iff the subquery returns any row."""
+
+    def __init__(self, plan: LogicalPlan):
+        self.children = []
+        self.plan = plan
+
+    def eval(self, batch):
+        col = self._first_col()
+        n = batch.get("__len__") if isinstance(batch, dict) else None
+        if n is None:
+            vals = [v for k, v in batch.items() if k != "__len__"]
+            n = len(np.atleast_1d(vals[0])) if vals else 1
+        return np.full(n, len(col) > 0)
+
+    def name_hint(self):
+        return "EXISTS (subquery)"
+
+    def __str__(self):
+        return self.name_hint()
+
+
+class ScalarSubquery(_SubqueryMixin, Expr):
+    """``(SELECT ...)`` as a value — must yield exactly one row/column
+    (ref ScalarSubquery; the reference also raises on >1 row)."""
+
+    def __init__(self, plan: LogicalPlan):
+        self.children = []
+        self.plan = plan
+
+    def eval(self, batch):
+        col = self._first_col()
+        if len(col) != 1:
+            raise ValueError(
+                f"scalar subquery returned {len(col)} rows; expected 1")
+        return col[0]
+
+    def name_hint(self):
+        return "scalarsubquery()"
+
+    def __str__(self):
+        return self.name_hint()

@@ -12,14 +12,15 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from cycloneml_tpu.sql.dataframe import DataFrame
-from cycloneml_tpu.sql.parser import parse_sql
-from cycloneml_tpu.sql.plan import Scan
+from cycloneml_tpu.sql.plan import LogicalPlan, Scan
 
 
 class CycloneSession:
     def __init__(self, ctx=None):
         self.ctx = ctx
-        self._catalog: Dict[str, Scan] = {}
+        # Scan for base tables / CTAS snapshots; arbitrary plans for views
+        # (INSERT distinguishes them by isinstance)
+        self._catalog: Dict[str, LogicalPlan] = {}
 
     # -- construction ----------------------------------------------------------
     def create_data_frame(self, data, schema: Optional[Sequence[str]] = None
@@ -70,7 +71,63 @@ class CycloneSession:
 
     # -- SQL -------------------------------------------------------------------
     def sql(self, query: str) -> DataFrame:
-        return DataFrame(parse_sql(query, self._catalog), self)
+        """Execute a statement. SELECT returns its DataFrame; CREATE VIEW /
+        CREATE TABLE AS / INSERT INTO mutate the catalog and return an empty
+        DataFrame (the reference's DDL/DML also returns an empty Dataset)."""
+        from cycloneml_tpu.sql.parser import parse_sql_statement
+        stmt = parse_sql_statement(query, self._catalog)
+        kind = stmt[0]
+        if kind == "query":
+            return DataFrame(stmt[1], self)
+        if kind == "create_view":
+            _, name, plan, replace = stmt
+            if name in self._catalog and not replace:
+                raise ValueError(
+                    f"view {name!r} already exists; use CREATE OR REPLACE")
+            from cycloneml_tpu.sql.plan import find_relations
+            if name in find_relations(plan):
+                raise ValueError(
+                    f"recursive view {name!r} is not allowed (the reference "
+                    "rejects self-referencing views too)")
+            # a view is a NAMED PLAN — lazy, recomputed per query, exactly
+            # the reference's temp-view semantics (Dataset.createTempView)
+            self._catalog[name] = plan
+        elif kind == "ctas":
+            _, name, plan, replace = stmt
+            if name in self._catalog and not replace:
+                raise ValueError(
+                    f"table {name!r} already exists; use CREATE OR REPLACE")
+            self._catalog[name] = Scan(plan.execute(), name)  # materialized
+        elif kind == "insert":
+            _, name, plan = stmt
+            target = self._catalog.get(name)
+            if not isinstance(target, Scan):
+                raise ValueError(
+                    f"INSERT target {name!r} is not a base table"
+                    + ("" if target is not None else " (not registered)"))
+            new = plan.execute()
+            new_names = [k for k in new if k != "__len__"]
+            if len(new_names) != len(target.data):
+                raise ValueError(
+                    f"INSERT provides {len(new_names)} columns; "
+                    f"{name!r} has {len(target.data)}")
+            from cycloneml_tpu.sql.plan import _concat
+            # BY POSITION, as SQL INSERT without a column list (the source
+            # may be arbitrary select expressions); incoming NULLs coerce to
+            # the TARGET column's convention (NaN numeric, None object)
+            merged = {}
+            for k, src in zip(target.data, new_names):
+                tcol = np.asarray(target.data[k])
+                ncol = np.asarray(new[src])
+                if tcol.dtype.kind in "if" and ncol.dtype == object:
+                    ncol = np.array([np.nan if v is None else float(v)
+                                     for v in ncol.tolist()])
+                elif tcol.dtype == object and ncol.dtype.kind == "f":
+                    ncol = np.array([None if np.isnan(v) else v
+                                     for v in ncol.tolist()], dtype=object)
+                merged[k] = _concat([tcol, ncol])
+            self._catalog[name] = Scan(merged, name)
+        return DataFrame(Scan({}, "empty"), self)
 
     @property
     def read_stream(self):

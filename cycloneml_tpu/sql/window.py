@@ -110,6 +110,12 @@ class WindowFnExpr(Expr):
     def __str__(self):
         return self.name_hint()
 
+    def find_aggregates(self):
+        # the wrapped AggExpr belongs to THIS window evaluation, not to a
+        # surrounding GROUP BY — a windowed select is a Project, never an
+        # Aggregate (ref: the reference plans Window above Aggregate)
+        return []
+
     # -- evaluation -------------------------------------------------------------
     def _partition_codes(self, batch, n):
         if not self.spec.partition_exprs:

@@ -16,3 +16,185 @@ SELECT name FROM emp WHERE name LIKE '%a%' ORDER BY name
 SELECT COUNT(*) AS n, MIN(salary) AS lo, MAX(salary) AS hi, SUM(salary) / COUNT(*) AS mean FROM emp
 -- distinct
 SELECT DISTINCT dept FROM emp ORDER BY dept
+-- negative literals and unary minus
+SELECT name, -salary AS neg FROM emp WHERE salary > -1 ORDER BY neg LIMIT 2
+-- modulo and integer arithmetic
+SELECT id, id % 2 AS parity FROM emp ORDER BY id
+-- nested expressions with parens
+SELECT name, (salary + 10) * 2 AS x FROM emp ORDER BY x DESC LIMIT 2
+-- NOT / OR precedence
+SELECT name FROM emp WHERE NOT (dept = 'eng' OR salary < 80) ORDER BY name
+-- IS NULL / IS NOT NULL on nullable column
+SELECT item FROM inv WHERE qty IS NULL ORDER BY item
+-- no-sqlite IS NOT NULL with arithmetic
+SELECT item, qty + 0 AS q FROM inv WHERE qty IS NOT NULL ORDER BY item
+-- no-sqlite aggregates over a column with nulls
+SELECT COUNT(*) AS rows_n, SUM(qty) AS total FROM inv WHERE qty IS NOT NULL
+-- null comparisons exclude rows
+SELECT item FROM inv WHERE qty > 5 ORDER BY item
+-- no-sqlite CASE with null branch
+SELECT item, CASE WHEN qty IS NULL THEN -1 ELSE qty END AS q FROM inv ORDER BY item
+-- string functions
+SELECT upper(name) AS u, length(name) AS l FROM emp ORDER BY name LIMIT 3
+-- lower + like combined
+SELECT lower(dept) AS d FROM emp WHERE dept LIKE 'e%' ORDER BY id
+-- abs and round
+SELECT id, abs(50 - salary) AS dist FROM emp ORDER BY dist LIMIT 3
+-- window: row_number per partition
+SELECT name, ROW_NUMBER() OVER (PARTITION BY dept ORDER BY salary DESC) AS rn FROM emp ORDER BY name
+-- window: rank with ties
+SELECT name, RANK() OVER (ORDER BY grade) AS r FROM scores ORDER BY name
+-- window: dense_rank with ties
+SELECT name, DENSE_RANK() OVER (ORDER BY grade) AS r FROM scores ORDER BY name
+-- window: percent_rank
+SELECT name, PERCENT_RANK() OVER (ORDER BY grade) AS pr FROM scores ORDER BY name
+-- window: cume_dist
+SELECT name, CUME_DIST() OVER (ORDER BY grade) AS cd FROM scores ORDER BY name
+-- window: ntile buckets
+SELECT name, NTILE(2) OVER (ORDER BY grade) AS bucket FROM scores ORDER BY name
+-- window: lag and lead
+SELECT name, LAG(salary) OVER (ORDER BY id) AS prev, LEAD(salary) OVER (ORDER BY id) AS next FROM emp ORDER BY id
+-- window: lag with offset and default
+SELECT name, LAG(salary, 2, 0) OVER (ORDER BY id) AS prev2 FROM emp ORDER BY id
+-- window: partition sum (no order -> whole partition)
+SELECT name, SUM(salary) OVER (PARTITION BY dept) AS dept_total FROM emp ORDER BY id
+-- window: running sum (order -> unbounded preceding to current)
+SELECT name, SUM(salary) OVER (ORDER BY id) AS running FROM emp ORDER BY id
+-- window: running sum per partition
+SELECT name, SUM(salary) OVER (PARTITION BY dept ORDER BY id) AS run FROM emp ORDER BY id
+-- window: avg over partition
+SELECT name, AVG(salary) OVER (PARTITION BY dept) AS dept_avg FROM emp ORDER BY id
+-- window: min and max over partition
+SELECT name, MIN(salary) OVER (PARTITION BY dept) AS lo, MAX(salary) OVER (PARTITION BY dept) AS hi FROM emp ORDER BY id
+-- window: count over partition
+SELECT name, COUNT(*) OVER (PARTITION BY dept) AS dept_n FROM emp ORDER BY id
+-- window: expression over window result
+SELECT name, salary - AVG(salary) OVER (PARTITION BY dept) AS delta FROM emp ORDER BY id
+-- window: row_number over multi-column order
+SELECT name, ROW_NUMBER() OVER (ORDER BY dept, salary DESC) AS rn FROM emp ORDER BY rn
+-- subquery: IN (SELECT ...)
+SELECT name FROM emp WHERE dept IN (SELECT dept FROM dept WHERE floor >= 2) ORDER BY name
+-- subquery: NOT IN (SELECT ...)
+SELECT name FROM emp WHERE dept NOT IN (SELECT dept FROM dept WHERE floor >= 2) ORDER BY name
+-- subquery: scalar in WHERE
+SELECT name FROM emp WHERE salary > (SELECT AVG(salary) FROM emp) ORDER BY name
+-- subquery: scalar arithmetic in WHERE
+SELECT name FROM emp WHERE salary >= (SELECT MAX(salary) FROM emp) - 25 ORDER BY name
+-- subquery: scalar in SELECT
+SELECT name, salary - (SELECT AVG(salary) FROM emp) AS diff FROM emp ORDER BY id
+-- subquery: EXISTS true
+SELECT COUNT(*) AS n FROM emp WHERE EXISTS (SELECT dept FROM dept WHERE floor = 1)
+-- subquery: EXISTS false
+SELECT COUNT(*) AS n FROM emp WHERE EXISTS (SELECT dept FROM dept WHERE floor = 99)
+-- subquery: NOT EXISTS
+SELECT COUNT(*) AS n FROM emp WHERE NOT EXISTS (SELECT dept FROM dept WHERE floor = 99)
+-- subquery in FROM
+SELECT dept, n FROM (SELECT dept, COUNT(*) AS n FROM emp GROUP BY dept) WHERE n > 1 ORDER BY dept
+-- subquery in FROM joined to a table
+SELECT s.dept, s.n, d.floor FROM (SELECT dept, COUNT(*) AS n FROM emp GROUP BY dept) s JOIN dept d ON s.dept = d.dept ORDER BY s.dept
+-- nested subqueries
+SELECT name FROM emp WHERE salary > (SELECT AVG(salary) FROM (SELECT salary FROM emp WHERE dept = 'eng')) ORDER BY name
+-- join: left outer keeps unmatched left rows
+SELECT e.name, d.floor FROM emp e LEFT JOIN dept d ON e.dept = d.dept ORDER BY e.id
+-- join: right outer keeps unmatched right rows
+SELECT d.dept, d.floor, e.name FROM emp e RIGHT JOIN dept d ON e.dept = d.dept ORDER BY d.dept, e.name
+-- join: full outer
+SELECT d.dept, e.name FROM emp e FULL OUTER JOIN dept d ON e.dept = d.dept ORDER BY d.dept, e.name
+-- join: many-to-many duplicate keys
+SELECT a.tag, b.val FROM t1 a JOIN t2 b ON a.tag = b.tag ORDER BY a.tag, b.val
+-- join: USING syntax
+SELECT name, floor FROM emp JOIN dept USING (dept) ORDER BY name
+-- join: cross join row count
+SELECT COUNT(*) AS n FROM t1 CROSS JOIN t2
+-- join: self join
+SELECT a.name AS lo_name, b.name AS hi_name FROM emp a JOIN emp b ON a.dept = b.dept WHERE a.salary < b.salary ORDER BY lo_name, hi_name
+-- join then aggregate
+SELECT d.floor, COUNT(*) AS n FROM emp e JOIN dept d ON e.dept = d.dept GROUP BY d.floor ORDER BY d.floor
+-- join with extra filter in WHERE
+SELECT e.name FROM emp e JOIN dept d ON e.dept = d.dept WHERE d.floor >= 2 AND e.salary > 80 ORDER BY e.name
+-- group by expression
+SELECT salary >= 85 AS senior, COUNT(*) AS n FROM emp GROUP BY salary >= 85 ORDER BY senior
+-- group by with multiple aggregates
+SELECT dept, COUNT(*) AS n, SUM(salary) AS total, MIN(salary) AS lo, MAX(salary) AS hi FROM emp GROUP BY dept ORDER BY dept
+-- having filters groups
+SELECT dept, COUNT(*) AS n FROM emp GROUP BY dept HAVING COUNT(*) > 1 ORDER BY dept
+-- having on avg
+SELECT dept, AVG(salary) AS a FROM emp GROUP BY dept HAVING AVG(salary) > 80 ORDER BY dept
+-- count distinct
+SELECT COUNT(DISTINCT dept) AS nd FROM emp
+-- group by two keys
+SELECT dept, salary >= 85 AS senior, COUNT(*) AS n FROM emp GROUP BY dept, salary >= 85 ORDER BY dept, senior
+-- order by aggregate not in select
+SELECT dept FROM emp GROUP BY dept ORDER BY SUM(salary) DESC
+-- aggregate expression arithmetic
+SELECT dept, SUM(salary) / COUNT(*) AS mean FROM emp GROUP BY dept ORDER BY dept
+-- aggregate of expression
+SELECT dept, SUM(salary * 2) AS dbl FROM emp GROUP BY dept ORDER BY dept
+-- empty group result
+SELECT dept, COUNT(*) AS n FROM emp WHERE salary > 1000 GROUP BY dept ORDER BY dept
+-- no-sqlite global aggregate over empty input
+SELECT COUNT(*) AS n, SUM(salary) AS s FROM emp WHERE salary > 1000
+-- union all keeps duplicates
+SELECT dept FROM (SELECT dept FROM emp UNION ALL SELECT dept FROM dept) ORDER BY dept
+-- union deduplicates
+SELECT dept FROM (SELECT dept FROM emp UNION SELECT dept FROM dept) ORDER BY dept
+-- union all of filtered branches
+SELECT name FROM (SELECT name FROM emp WHERE dept = 'eng' UNION ALL SELECT name FROM emp WHERE salary < 75) ORDER BY name
+-- case without else yields null
+SELECT name, CASE WHEN salary > 100 THEN 'top' END AS tag FROM emp ORDER BY id
+-- case with multiple branches
+SELECT name, CASE WHEN salary >= 100 THEN 'a' WHEN salary >= 80 THEN 'b' ELSE 'c' END AS band FROM emp ORDER BY id
+-- between boundaries are inclusive
+SELECT name FROM emp WHERE salary BETWEEN 80 AND 100 ORDER BY name
+-- not between
+SELECT name FROM emp WHERE salary NOT BETWEEN 80 AND 100 ORDER BY name
+-- not in literal list
+SELECT name FROM emp WHERE dept NOT IN ('eng') ORDER BY name
+-- not like
+SELECT name FROM emp WHERE name NOT LIKE '%a%' ORDER BY name
+-- like anchored prefix and suffix
+SELECT name FROM emp WHERE name LIKE 'a%' OR name LIKE '%e' ORDER BY name
+-- like single-char wildcard
+SELECT name FROM emp WHERE name LIKE '_ob' ORDER BY name
+-- in list of numbers
+SELECT name FROM emp WHERE id IN (1, 3, 5) ORDER BY id
+-- order by multiple keys mixed directions
+SELECT name, dept, salary FROM emp ORDER BY dept ASC, salary DESC
+-- order by expression
+SELECT name, salary FROM emp ORDER BY salary % 100 LIMIT 3
+-- limit larger than rows
+SELECT name FROM emp WHERE dept = 'hr' ORDER BY name LIMIT 10
+-- limit zero
+SELECT name FROM emp LIMIT 0
+-- distinct on expression output
+SELECT DISTINCT salary >= 85 AS senior FROM emp ORDER BY senior
+-- distinct over join
+SELECT DISTINCT d.floor FROM emp e JOIN dept d ON e.dept = d.dept ORDER BY d.floor
+-- select star
+SELECT * FROM dept ORDER BY dept
+-- select star with filter
+SELECT * FROM emp WHERE dept = 'hr' ORDER BY id
+-- scalar subquery from another table
+SELECT name FROM emp WHERE salary > (SELECT MIN(floor) FROM dept) * 20 ORDER BY id
+-- window + subquery combined
+SELECT name, rn FROM (SELECT name, ROW_NUMBER() OVER (PARTITION BY dept ORDER BY salary DESC) AS rn FROM emp) WHERE rn = 1 ORDER BY name
+-- top earner per dept via window in FROM subquery
+SELECT dept, name FROM (SELECT dept, name, RANK() OVER (PARTITION BY dept ORDER BY salary DESC) AS r FROM emp) WHERE r = 1 ORDER BY dept
+-- aggregate over union
+SELECT COUNT(*) AS n FROM (SELECT dept FROM emp UNION ALL SELECT dept FROM dept)
+-- arithmetic precedence
+SELECT 2 + 3 * 4 AS a, (2 + 3) * 4 AS b FROM dept LIMIT 1
+-- comparison chain via AND
+SELECT name FROM emp WHERE salary >= 80 AND salary <= 100 AND dept = 'sales' ORDER BY name
+-- boolean literals
+SELECT name FROM emp WHERE true AND NOT false ORDER BY id LIMIT 2
+-- string equality and inequality
+SELECT name FROM emp WHERE dept <> 'eng' AND dept != 'hr' ORDER BY name
+-- division produces floats
+SELECT id, salary / 3 AS third FROM emp ORDER BY id LIMIT 3
+-- count star vs count column with nulls
+SELECT COUNT(*) AS all_rows, COUNT(qty) AS non_null FROM inv
+-- group by over nullable column
+SELECT kind, COUNT(*) AS n FROM inv GROUP BY kind ORDER BY kind
+-- join on t1/t2 left with missing matches
+SELECT a.tag, a.x, b.val FROM t1 a LEFT JOIN t2 b ON a.tag = b.tag ORDER BY a.tag, a.x, b.val

@@ -355,3 +355,128 @@ def test_sample_streaming_batches_independent():
     first = set(v % 400 for v in rows[:n1])
     second = set(v % 400 for v in rows[n1:])
     assert first != second  # same positions would mean the mask repeated
+
+
+# -- SQL statements: views / CTAS / INSERT -------------------------------------
+
+def _stmt_session():
+    s = CycloneSession()
+    s.register_temp_view("emp", s.create_data_frame({
+        "id": [1, 2, 3], "dept": ["a", "a", "b"],
+        "salary": [10.0, 20.0, 30.0]}))
+    return s
+
+
+def test_create_view_is_lazy_and_sees_inserts():
+    s = _stmt_session()
+    s.sql("CREATE VIEW rich AS SELECT id FROM emp WHERE salary >= 20")
+    assert s.sql("SELECT COUNT(*) AS n FROM rich").to_dict()["n"][0] == 2
+    s.sql("INSERT INTO emp VALUES (4, 'b', 50.0)")
+    # the view re-resolves its base table: the insert is visible
+    assert s.sql("SELECT COUNT(*) AS n FROM rich").to_dict()["n"][0] == 3
+    with pytest.raises(ValueError, match="already exists"):
+        s.sql("CREATE VIEW rich AS SELECT id FROM emp")
+    s.sql("CREATE OR REPLACE VIEW rich AS SELECT id FROM emp")
+    assert s.sql("SELECT COUNT(*) AS n FROM rich").to_dict()["n"][0] == 4
+
+
+def test_recursive_view_rejected():
+    s = _stmt_session()
+    s.sql("CREATE VIEW v AS SELECT id FROM emp")
+    with pytest.raises(ValueError, match="recursive"):
+        s.sql("CREATE OR REPLACE VIEW v AS SELECT id FROM v")
+
+
+def test_ctas_materializes():
+    s = _stmt_session()
+    s.sql("CREATE TABLE snap AS SELECT dept, COUNT(*) AS n FROM emp GROUP BY dept")
+    s.sql("INSERT INTO emp VALUES (4, 'c', 1.0)")
+    # a TABLE is a snapshot: the later insert is NOT visible
+    assert s.sql("SELECT COUNT(*) AS n FROM snap").to_dict()["n"].sum() == 2
+
+
+def test_insert_select_positional():
+    s = _stmt_session()
+    s.sql("INSERT INTO emp SELECT id + 10, dept, salary * 2 FROM emp WHERE dept = 'b'")
+    out = s.sql("SELECT id, salary FROM emp WHERE id > 10").to_dict()
+    assert out["id"].tolist() == [13] and out["salary"].tolist() == [60.0]
+    with pytest.raises(ValueError, match="columns"):
+        s.sql("INSERT INTO emp SELECT id FROM emp")
+    with pytest.raises(ValueError, match="3 columns"):
+        s.sql("INSERT INTO emp VALUES (1, 'x')")
+
+
+def test_insert_into_view_rejected():
+    s = _stmt_session()
+    s.sql("CREATE VIEW v AS SELECT id FROM emp")
+    with pytest.raises(ValueError, match="not a base table"):
+        s.sql("INSERT INTO v VALUES (9)")
+
+
+def test_window_requires_over():
+    s = _stmt_session()
+    with pytest.raises(ValueError, match="expected over"):
+        s.sql("SELECT ROW_NUMBER() FROM emp")
+
+
+def test_window_over_group_by_rejected():
+    s = _stmt_session()
+    with pytest.raises(NotImplementedError, match="window functions"):
+        s.sql("SELECT dept, RANK() OVER (ORDER BY COUNT(*)) FROM emp GROUP BY dept")
+
+
+def test_scalar_subquery_multi_row_rejected():
+    s = _stmt_session()
+    with pytest.raises(ValueError, match="scalar subquery"):
+        s.sql("SELECT id FROM emp WHERE salary > (SELECT salary FROM emp)").collect()
+
+
+def test_self_join_both_sides_selected():
+    """a.salary and b.salary must surface as TWO columns (the ambiguous one
+    qualifies as b_salary), and ON order must not matter."""
+    s = _stmt_session()
+    out = s.sql("SELECT a.salary, b.salary FROM emp a JOIN emp b "
+                "ON a.id = b.id ORDER BY a.id").to_dict()
+    assert list(out) == ["salary", "b_salary"]
+    np.testing.assert_allclose(out["salary"], out["b_salary"])
+    # reversed ON orientation parses to the same join
+    out2 = s.sql("SELECT a.salary, b.salary FROM emp a JOIN emp b "
+                 "ON b.id = a.id ORDER BY a.id").to_dict()
+    np.testing.assert_allclose(out2["salary"], out["salary"])
+
+
+def test_self_join_inequality_condition():
+    s = _stmt_session()
+    out = s.sql("SELECT a.id, b.id FROM emp a JOIN emp b ON a.dept = b.dept "
+                "WHERE a.salary < b.salary ORDER BY a.id").to_dict()
+    assert out["id"].tolist() == [1]
+    assert out["b_id"].tolist() == [2]
+
+
+def test_union_trailing_order_rejected():
+    s = _stmt_session()
+    with pytest.raises(ValueError, match="wrap the union"):
+        s.sql("SELECT id FROM emp UNION ALL SELECT id FROM emp ORDER BY id")
+    with pytest.raises(ValueError, match="wrap the union"):
+        s.sql("SELECT id FROM emp UNION ALL SELECT id FROM emp LIMIT 1")
+
+
+def test_insert_null_literal():
+    s = _stmt_session()
+    s.sql("INSERT INTO emp VALUES (4, NULL, NULL)")
+    out = s.sql("SELECT dept, salary FROM emp WHERE id = 4").to_dict()
+    assert out["dept"][0] is None
+    assert np.isnan(out["salary"][0])
+    assert s.sql("SELECT COUNT(salary) AS n FROM emp").to_dict()["n"][0] == 3
+
+
+def test_recursive_view_guard_in_order_by():
+    """The cycle walk must see subquery plans inside ORDER BY/aggregates."""
+    s = _stmt_session()
+    s.sql("CREATE VIEW v AS SELECT id FROM emp")
+    with pytest.raises(ValueError, match="recursive"):
+        s.sql("CREATE OR REPLACE VIEW v AS SELECT id FROM emp "
+              "ORDER BY (SELECT MAX(id) FROM v)")
+    with pytest.raises(ValueError, match="recursive"):
+        s.sql("CREATE OR REPLACE VIEW v AS SELECT id FROM emp "
+              "WHERE id IN (SELECT id FROM v)")

@@ -1,15 +1,24 @@
-"""Golden-file SQL query tests.
+"""Golden-file SQL query tests + an independent-oracle cross-check.
 
 Analog of the reference's SQLQueryTestSuite (ref: sql/core/src/test/
 resources/sql-tests/ — committed .sql inputs with .out golden results,
 regenerated with an env flag and reviewed as diffs). Queries live in
-``tests/sql_golden/queries.sql`` (one per line, '--' comments); goldens in
-``queries.sql.out``. Regenerate with:
+``tests/sql_golden/queries.sql`` (one per line, '--' comments; a comment
+line starting with '-- no-sqlite' marks the NEXT query as not comparable to
+sqlite — engine-specific null/NaN semantics). Goldens in ``queries.sql.out``.
+Regenerate with:
 
     CYCLONE_REGEN_GOLDEN=1 python -m pytest tests/test_sql_golden.py
+
+Beyond the self-referential golden check, every untagged query also runs
+through **sqlite3** on the same fixture data and the result SETS must match
+— an oracle the engine does not share a line of code with (the reference
+compares against Hive/PostgreSQL goldens in the same spirit).
 """
 
+import math
 import os
+import sqlite3
 
 import numpy as np
 import pytest
@@ -20,19 +29,38 @@ HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sql_golden")
 QUERIES = os.path.join(HERE, "queries.sql")
 GOLDEN = QUERIES + ".out"
 
-
-def _fixture_session() -> CycloneSession:
-    s = CycloneSession()
-    s.register_temp_view("emp", s.create_data_frame({
+TABLES = {
+    "emp": {
         "id": [1, 2, 3, 4, 5],
         "name": ["alice", "bob", "carol", "dan", "eve"],
         "dept": ["eng", "eng", "sales", "sales", "hr"],
         "salary": [120.0, 100.0, 80.0, 85.0, 70.0],
-    }))
-    s.register_temp_view("dept", s.create_data_frame({
+    },
+    "dept": {
         "dept": ["eng", "sales", "hr", "legal"],
         "floor": [3, 2, 1, 4],
-    }))
+    },
+    # ties for rank-family windows
+    "scores": {
+        "name": ["ann", "ben", "cal", "deb", "eli"],
+        "grade": [90.0, 80.0, 90.0, 70.0, 80.0],
+    },
+    # nullable numeric column (NaN = engine null) + categorical
+    "inv": {
+        "item": ["bolt", "nut", "washer", "screw"],
+        "qty": [10.0, float("nan"), 3.0, float("nan")],
+        "kind": ["metal", "metal", "metal", "wood"],
+    },
+    # duplicate join keys + unmatched rows on both sides
+    "t1": {"tag": ["a", "a", "b", "c"], "x": [1, 2, 3, 4]},
+    "t2": {"tag": ["a", "b", "b", "d"], "val": [10, 20, 30, 40]},
+}
+
+
+def _fixture_session() -> CycloneSession:
+    s = CycloneSession()
+    for name, cols in TABLES.items():
+        s.register_temp_view(name, s.create_data_frame(cols))
     return s
 
 
@@ -53,15 +81,27 @@ def _cell(v) -> str:
 
 
 def _load_queries():
+    """[(query, sqlite_comparable)]"""
+    out = []
+    no_sqlite = False
     with open(QUERIES, encoding="utf-8") as fh:
-        return [ln.strip() for ln in fh
-                if ln.strip() and not ln.strip().startswith("--")]
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            if ln.startswith("--"):
+                if ln.startswith("-- no-sqlite"):
+                    no_sqlite = True
+                continue
+            out.append((ln, not no_sqlite))
+            no_sqlite = False
+    return out
 
 
 def test_golden_queries():
     session = _fixture_session()
     blocks = []
-    for q in _load_queries():
+    for q, _ in _load_queries():
         blocks.append(f"-- !query\n{q}\n-- !result\n"
                       f"{_render(session.sql(q))}\n")
     rendered = "\n".join(blocks)
@@ -75,3 +115,58 @@ def test_golden_queries():
         "SQL results diverged from the committed golden file; if the change "
         "is intentional regenerate with CYCLONE_REGEN_GOLDEN=1 and review "
         "the diff")
+
+
+# -- sqlite oracle --------------------------------------------------------------
+
+def _sqlite_conn():
+    conn = sqlite3.connect(":memory:")
+    for name, cols in TABLES.items():
+        names = list(cols)
+        conn.execute(f"CREATE TABLE {name} ({', '.join(names)})")
+        rows = zip(*[cols[c] for c in names])
+        conn.executemany(
+            f"INSERT INTO {name} VALUES ({', '.join('?' * len(names))})",
+            [[None if isinstance(v, float) and math.isnan(v) else v
+              for v in row] for row in rows])
+    return conn
+
+
+def _norm(v):
+    if v is None:
+        return "NULL"
+    if isinstance(v, (bool, np.bool_)):
+        return f"{int(v)}"
+    if isinstance(v, (int, np.integer)):
+        return f"{v:.6g}"
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return "NULL" if math.isnan(f) else f"{f:.6g}"
+    return str(v)
+
+
+def test_sqlite_cross_check():
+    """Every untagged golden query must produce the same multiset of rows as
+    sqlite3 on identical data — an oracle with no shared code. Booleans
+    normalize to 0/1 (sqlite has no bool), engine-NaN to NULL."""
+    session = _fixture_session()
+    conn = _sqlite_conn()
+    checked = 0
+    old_sqlite = sqlite3.sqlite_version_info < (3, 39)
+    for q, comparable in _load_queries():
+        if not comparable:
+            continue
+        if old_sqlite and ("FULL OUTER" in q or "RIGHT JOIN" in q):
+            continue  # sqlite grew these join types in 3.39 (2022)
+        got = session.sql(q).to_dict()
+        cols = list(got)
+        n = len(got[cols[0]]) if cols else 0
+        ours = sorted(tuple(_norm(got[c][i]) for c in cols)
+                      for i in range(n))
+        theirs = sorted(tuple(_norm(v) for v in row)
+                        for row in conn.execute(q).fetchall())
+        assert ours == theirs, (
+            f"divergence from sqlite on:\n  {q}\n"
+            f"ours   : {ours[:8]}\nsqlite : {theirs[:8]}")
+        checked += 1
+    assert checked >= 90  # the suite must stay broad
