@@ -313,6 +313,15 @@ NETWORK_TIMEOUT_MS = (
     .int_conf(120000)
 )
 
+SHUFFLE_SPILL_ROW_BUDGET = (
+    ConfigBuilder("cyclone.shuffle.spill.rowBudget")
+    .doc("Values held in memory per host-shuffle bucket before spilling a "
+         "sorted compressed run to disk (ref: ExternalAppendOnlyMap.scala:55 "
+         "/ spark.shuffle.spill).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(1 << 20)
+)
+
 TASK_MAX_FAILURES = (
     ConfigBuilder("cyclone.task.maxFailures")
     .doc("Retries per step before aborting (ref: TaskSetManager.scala:58).")

@@ -273,6 +273,15 @@ class CycloneContext:
                 self._heartbeats.start()
             return self._heartbeats
 
+    def start_ui(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve the live status web UI (≈ SparkUI.scala:40 — jobs/steps/
+        failures over the status store). Returns the server; ``.url`` is the
+        address. Stopped automatically with the context."""
+        from cycloneml_tpu.util.webui import StatusWebUI
+        if getattr(self, "_web_ui", None) is None:
+            self._web_ui = StatusWebUI(self.status_store, host, port)
+        return self._web_ui
+
     def start_heartbeat_server(self, host: str = "127.0.0.1", port: int = 0):
         """Start the driver-side TCP heartbeat endpoint (≈ the
         HeartbeatReceiver RPC endpoint registration). Point each worker's
@@ -375,6 +384,8 @@ class CycloneContext:
             self._hb_sender.stop()
         if self._hb_server is not None:
             self._hb_server.stop()
+        if getattr(self, "_web_ui", None) is not None:
+            self._web_ui.stop()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

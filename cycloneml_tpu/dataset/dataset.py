@@ -153,14 +153,29 @@ class PartitionedDataset:
     coalesce = repartition
 
     def group_by_key(self) -> "PartitionedDataset":
-        """Hash-partition key/value pairs (host-tier shuffle analog)."""
+        """Hash-partition key/value pairs (host-tier shuffle analog).
+
+        Partition assignment uses a PYTHONHASHSEED-independent hash (the
+        reference's Partitioner contract: every process must agree), and
+        each bucket aggregates through an ExternalAppendOnlyMap that spills
+        sorted runs to disk past ``cyclone.shuffle.spill.rowBudget`` values
+        (ref ExternalAppendOnlyMap.scala:55) — grouping beyond host RAM
+        degrades to disk instead of OOM."""
         n = self.num_partitions
+        from cycloneml_tpu.conf import SHUFFLE_SPILL_ROW_BUDGET
+        budget = int(self.ctx.conf.get(SHUFFLE_SPILL_ROW_BUDGET)) \
+            if hasattr(self.ctx, "conf") else 1 << 20
 
         def fn(ps):
-            buckets: List[dict] = [dict() for _ in range(n)]
+            from cycloneml_tpu.dataset.spill import (ExternalAppendOnlyMap,
+                                                     stable_hash)
+            # budget is PER BUCKET, matching the conf doc (≈ the reference's
+            # per-collection numElementsForceSpillThreshold)
+            buckets = [ExternalAppendOnlyMap(row_budget=budget)
+                       for _ in range(n)]
             for p in ps:
                 for k, v in p:
-                    buckets[hash(k) % n].setdefault(k, []).append(v)
+                    buckets[stable_hash(k) % n].insert(k, v)
             return [list(b.items()) for b in buckets]
         return self._derive(fn, "groupByKey", n)
 

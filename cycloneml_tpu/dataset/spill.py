@@ -1,0 +1,166 @@
+"""Out-of-core host-tier aggregation.
+
+Analog of the reference's spillable collections
+(ref: core/.../util/collection/ExternalAppendOnlyMap.scala:55 — an
+append-only map that sorts and spills to disk past a memory threshold, then
+hash-merges the spilled runs with the in-memory map). The host tier's
+``group_by_key`` routes every pair through :class:`ExternalAppendOnlyMap`,
+so grouping datasets larger than host RAM degrades to disk instead of OOM.
+
+Spill files are sequences of independently-compressed chunks (the native
+zstd/lz4 codec, ref CompressionCodec.scala:63), each a pickled run of
+``(key, values)`` entries sorted by a PYTHONHASHSEED-independent key hash —
+reading back streams one chunk at a time, and the k-way heap merge keeps
+one entry per run in memory.
+"""
+
+from __future__ import annotations
+
+import heapq
+import hashlib
+import os
+import pickle
+import struct
+import tempfile
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+def stable_hash(key: Any) -> int:
+    """Deterministic partitioner hash: identical across processes and runs
+    (the builtin ``hash`` is salted per-process by PYTHONHASHSEED for
+    str/bytes, which would scatter one key to different partitions on
+    different hosts — the reference's Partitioner contract requires
+    cross-executor agreement).
+
+    Equal keys MUST hash equal (1 == 1.0 == True must co-partition), so
+    numerics use Python's own numeric hash — which is salt-free and equal
+    across equal values — while str/bytes/tuples get a salt-free digest.
+    Other types fall back to their ``__hash__``: deterministic exactly when
+    the type's own hash is (a custom value-based __hash__ qualifies; the
+    default id() hash does not, and such keys were never cross-process
+    stable under any scheme)."""
+    if isinstance(key, str):
+        digest = hashlib.md5(key.encode("utf-8")).digest()
+    elif isinstance(key, (bytes, bytearray)):
+        digest = hashlib.md5(bytes(key)).digest()
+    elif isinstance(key, tuple):
+        digest = hashlib.md5(
+            b"|".join(str(stable_hash(k)).encode() for k in key)).digest()
+    else:
+        # numerics (incl. numpy scalars and bool) + custom-hash objects
+        return hash(key) & 0x7FFFFFFFFFFFFFFF
+    return int.from_bytes(digest[:8], "little")
+
+
+_CHUNK_ENTRIES = 4096
+
+
+class _SpillFile:
+    """One sorted run: [u32 length][compressed pickled chunk]..."""
+
+    def __init__(self, path: str, codec):
+        self.path = path
+        self.codec = codec
+
+    @classmethod
+    def write(cls, entries: List[Tuple[int, Any, list]], spill_dir: str,
+              codec) -> "_SpillFile":
+        fd, path = tempfile.mkstemp(prefix="spill-", suffix=".run",
+                                    dir=spill_dir)
+        with os.fdopen(fd, "wb") as fh:
+            for i in range(0, len(entries), _CHUNK_ENTRIES):
+                blob = codec.compress(
+                    pickle.dumps(entries[i:i + _CHUNK_ENTRIES],
+                                 protocol=pickle.HIGHEST_PROTOCOL))
+                fh.write(struct.pack("<I", len(blob)))
+                fh.write(blob)
+        return cls(path, codec)
+
+    def __iter__(self) -> Iterator[Tuple[int, Any, list]]:
+        with open(self.path, "rb") as fh:
+            while True:
+                hdr = fh.read(4)
+                if len(hdr) < 4:
+                    return
+                (n,) = struct.unpack("<I", hdr)
+                from cycloneml_tpu.native.host import CompressionCodec
+                chunk = pickle.loads(CompressionCodec.decompress(fh.read(n)))
+                yield from chunk
+
+    def delete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ExternalAppendOnlyMap:
+    """Append-only (key -> list of values) map that spills sorted runs to
+    disk past ``row_budget`` inserted values, then streams a k-way merge.
+
+    ``items()`` yields ``(key, [values])`` exactly once per key with values
+    from every run concatenated (insertion order within a run preserved;
+    runs concatenate in spill order, memory last — the reference's merge
+    order too). Peak memory during the merge is one chunk per run.
+    """
+
+    def __init__(self, row_budget: int = 1 << 20,
+                 spill_dir: Optional[str] = None, codec: str = "zstd"):
+        from cycloneml_tpu.native.host import CompressionCodec
+        self.row_budget = max(int(row_budget), 1)
+        self._spill_dir = spill_dir or tempfile.gettempdir()
+        self._codec = CompressionCodec(codec)
+        self._map: dict = {}
+        self._rows = 0
+        self._spills: List[_SpillFile] = []
+        self.spill_count = 0
+
+    def insert(self, key: Any, value: Any) -> None:
+        self._map.setdefault(key, []).append(value)
+        self._rows += 1
+        if self._rows >= self.row_budget:
+            self._spill()
+
+    def insert_all(self, pairs) -> None:
+        for k, v in pairs:
+            self.insert(k, v)
+
+    def _sorted_entries(self) -> List[Tuple[int, Any, list]]:
+        return sorted(((stable_hash(k), k, vs) for k, vs in self._map.items()),
+                      key=lambda e: (e[0], repr(e[1])))
+
+    def _spill(self) -> None:
+        if not self._map:
+            return
+        self._spills.append(_SpillFile.write(
+            self._sorted_entries(), self._spill_dir, self._codec))
+        self.spill_count += 1
+        self._map = {}
+        self._rows = 0
+
+    def items(self) -> Iterator[Tuple[Any, list]]:
+        """Stream merged (key, values) groups; consumes the map."""
+        if not self._spills:
+            yield from self._map.items()
+            self._map = {}
+            return
+        runs: List[Iterator] = [iter(s) for s in self._spills]
+        runs.append(iter(self._sorted_entries()))
+        self._map = {}
+        merged = heapq.merge(*runs, key=lambda e: (e[0], repr(e[1])))
+        cur_key, cur_vals, have = None, None, False
+        for h, k, vs in merged:
+            if have and k == cur_key:
+                cur_vals.extend(vs)
+            else:
+                if have:
+                    yield cur_key, cur_vals
+                cur_key, cur_vals, have = k, list(vs), True
+        if have:
+            yield cur_key, cur_vals
+        for s in self._spills:
+            s.delete()
+        self._spills = []
+
+    def __len__(self) -> int:
+        return len(self._map)

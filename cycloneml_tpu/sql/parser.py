@@ -193,6 +193,13 @@ class _Parser:
         plan = self.parse_select()
         unioned = False
         while self.accept("kw", "union"):
+            if self._last_select_had_tail:
+                # ORDER BY/LIMIT on a non-final branch is invalid SQL —
+                # refuse rather than silently sort one branch
+                raise ValueError(
+                    "ORDER BY/LIMIT directly after UNION is not supported; "
+                    "wrap the union in a subquery: SELECT * FROM "
+                    "(... UNION ...) ORDER BY ...")
             is_all = self.accept("kw", "all")
             plan = Union(plan, self.parse_select())
             if not is_all:

@@ -85,10 +85,23 @@ class CycloneSession:
                 raise ValueError(
                     f"view {name!r} already exists; use CREATE OR REPLACE")
             from cycloneml_tpu.sql.plan import find_relations
-            if name in find_relations(plan):
-                raise ValueError(
-                    f"recursive view {name!r} is not allowed (the reference "
-                    "rejects self-referencing views too)")
+            # transitive cycle check: a view may reference OTHER views that
+            # (would) reference this one — direct-only checking lets mutual
+            # recursion through and blows the stack at query time
+            seen = set()
+            frontier = list(find_relations(plan))
+            while frontier:
+                nm = frontier.pop()
+                if nm == name:
+                    raise ValueError(
+                        f"recursive view {name!r} is not allowed (the "
+                        "reference rejects self-referencing views too)")
+                if nm in seen:
+                    continue
+                seen.add(nm)
+                sub = self._catalog.get(nm)
+                if sub is not None and not isinstance(sub, Scan):
+                    frontier.extend(find_relations(sub))
             # a view is a NAMED PLAN — lazy, recomputed per query, exactly
             # the reference's temp-view semantics (Dataset.createTempView)
             self._catalog[name] = plan

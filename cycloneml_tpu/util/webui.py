@@ -1,0 +1,140 @@
+"""Minimal web UI over the live status store.
+
+The 20%-of-SparkUI that carries 80% of the value (ref:
+core/src/main/scala/org/apache/spark/ui/SparkUI.scala:40 — jobs, stages,
+executors tabs over the AppStatusStore): one static HTML page that polls
+the REST-shaped ``api_v1`` routes and renders application info, the job
+list with per-job steps, recorded checkpoints and worker failures. Served
+by a stdlib ThreadingHTTPServer — no framework, no assets, one file.
+
+Start with ``ctx.start_ui()`` (returns the server; ``.port`` for the bound
+port) or construct :class:`StatusWebUI` directly around any AppStatusStore
+(including one replayed by HistoryProvider — that IS the history server UI).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from cycloneml_tpu.util.status import AppStatusStore, api_v1
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Cyclone UI</title>
+<style>
+ body { font: 14px -apple-system, Segoe UI, sans-serif; margin: 2em;
+        color: #1a1a2e; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.6em; }
+ table { border-collapse: collapse; min-width: 40em; }
+ th, td { text-align: left; padding: .3em .9em; border-bottom: 1px solid #ddd; }
+ th { background: #f2f2f7; }
+ .muted { color: #888; } .ok { color: #0a7d38; } .bad { color: #b00020; }
+</style></head><body>
+<h1>Cyclone <span id="app" class="muted"></span></h1>
+<h2>Jobs</h2><div id="jobs" class="muted">loading…</div>
+<h2>Checkpoints</h2><div id="ckpts" class="muted">none</div>
+<h2>Worker failures</h2><div id="fails" class="muted">none</div>
+<script>
+async function j(r) { return (await fetch('/api/v1/' + r)).json(); }
+function table(rows, cols) {
+  if (!rows.length) return '<span class="muted">none</span>';
+  let h = '<table><tr>' + cols.map(c => '<th>' + c + '</th>').join('') +
+          '</tr>';
+  for (const r of rows)
+    h += '<tr>' + cols.map(c => '<td>' + (r[c] ?? '') + '</td>').join('') +
+         '</tr>';
+  return h + '</table>';
+}
+async function refresh() {
+  const apps = await j('applications');
+  if (apps.length) document.getElementById('app').textContent =
+    (apps[0].name || '') + ' — ' + (apps[0].id || '');
+  const jobs = await j('jobs');
+  let html = table(jobs, ['jobId', 'description', 'status', 'numSteps']);
+  for (const job of jobs.slice(-5).reverse()) {
+    const steps = await j('jobs/' + job.jobId + '/steps');
+    if (steps.length)
+      html += '<h2>Job ' + job.jobId + ' steps</h2>' +
+              table(steps.slice(-20), Object.keys(steps[0]));
+  }
+  document.getElementById('jobs').innerHTML = html;
+  const cks = await j('checkpoints');
+  if (cks.length) document.getElementById('ckpts').innerHTML =
+    table(cks, Object.keys(cks[0]));
+  const fails = await j('workers/failures');
+  if (fails.length) document.getElementById('fails').innerHTML =
+    table(fails, Object.keys(fails[0]));
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class StatusWebUI:
+    """Serves the page at ``/`` and JSON under ``/api/v1/...``."""
+
+    def __init__(self, store: AppStatusStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr spam per request
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path in ("/", "/index.html"):
+                        body = _PAGE.encode()
+                        ctype = "text/html; charset=utf-8"
+                    elif self.path.startswith("/api/v1/"):
+                        body = json.dumps(
+                            ui._route(self.path[len("/api/v1/"):]),
+                            default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except KeyError:
+                    self.send_error(404)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self.store = store
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="cyclone-webui", daemon=True)
+        self._thread.start()
+
+    def _route(self, route: str):
+        parts = route.strip("/").split("/")
+        if len(parts) == 1:
+            return api_v1(self.store, parts[0])
+        if len(parts) in (2, 3) and parts[0] == "jobs":
+            try:
+                job_id = int(parts[1])
+            except ValueError:
+                raise KeyError(route) from None  # 404, not a 500 traceback
+            if len(parts) == 2:
+                return api_v1(self.store, "jobs/<id>", job_id)
+            if parts[2] == "steps":
+                return api_v1(self.store, "jobs/<id>/steps", job_id)
+        if parts == ["workers", "failures"]:
+            return api_v1(self.store, "workers/failures")
+        raise KeyError(route)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)

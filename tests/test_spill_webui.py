@@ -1,0 +1,158 @@
+"""Host-tier spill (ExternalAppendOnlyMap) + status web UI tests."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.dataset.spill import ExternalAppendOnlyMap, stable_hash
+
+
+def test_stable_hash_deterministic_across_processes():
+    """Partition assignment must not depend on PYTHONHASHSEED (the builtin
+    hash is salted per process — the round-1 advisory)."""
+    keys = ["alpha", "beta", ("k", 3), 42, 3.5]
+    ours = [stable_hash(k) % 16 for k in keys]
+    code = ("from cycloneml_tpu.dataset.spill import stable_hash;"
+            "print([stable_hash(k) % 16 for k in "
+            "['alpha', 'beta', ('k', 3), 42, 3.5]])")
+    for seed in ("0", "12345"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin",
+                 "PYTHONPATH": REPO},
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr[-500:]
+        assert eval(out.stdout.strip()) == ours
+
+
+def test_external_map_no_spill_matches_dict():
+    m = ExternalAppendOnlyMap(row_budget=1000)
+    for i in range(100):
+        m.insert(i % 7, i)
+    got = dict(m.items())
+    assert m.spill_count == 0
+    for k in range(7):
+        assert got[k] == list(range(k, 100, 7))
+
+
+def test_external_map_spills_and_merges(tmp_path):
+    """Past the budget, sorted runs hit disk; items() must still yield each
+    key exactly once with ALL its values."""
+    m = ExternalAppendOnlyMap(row_budget=50, spill_dir=str(tmp_path))
+    n, k = 1000, 13
+    for i in range(n):
+        m.insert(f"key{i % k}", i)
+    assert m.spill_count >= n // 50 - 1
+    got = dict(m.items())
+    assert len(got) == k
+    for j in range(k):
+        assert sorted(got[f"key{j}"]) == list(range(j, n, k))
+    # spill files are cleaned up after the merge
+    assert not list(tmp_path.glob("spill-*"))
+
+
+def test_external_map_mixed_key_types(tmp_path):
+    m = ExternalAppendOnlyMap(row_budget=10, spill_dir=str(tmp_path))
+    keys = [1, "one", (1, 2), 2.5]
+    for rep in range(30):
+        for key in keys:
+            m.insert(key, rep)
+    got = dict(m.items())
+    assert set(got) == set(keys)
+    for key in keys:
+        assert sorted(got[key]) == list(range(30))
+
+
+def test_group_by_key_spills_with_small_budget(ctx):
+    """The dataset path spills under a small conf budget and produces the
+    same groups as the in-memory path."""
+    from cycloneml_tpu.conf import SHUFFLE_SPILL_ROW_BUDGET
+    data = [(i % 5, i) for i in range(500)]
+    old = ctx.conf.get(SHUFFLE_SPILL_ROW_BUDGET)
+    ctx.conf.set(SHUFFLE_SPILL_ROW_BUDGET, 64)
+    try:
+        grouped = dict(ctx.parallelize(data, 4).group_by_key().collect())
+    finally:
+        ctx.conf.set(SHUFFLE_SPILL_ROW_BUDGET, old)
+    assert set(grouped) == set(range(5))
+    for k in range(5):
+        assert sorted(grouped[k]) == list(range(k, 500, 5))
+
+
+def test_reduce_by_key_unchanged(ctx):
+    data = [("a", 1), ("b", 2), ("a", 3), ("b", 4)]
+    out = dict(ctx.parallelize(data, 2).reduce_by_key(lambda x, y: x + y).collect())
+    assert out == {"a": 4, "b": 6}
+
+
+# -- web UI ---------------------------------------------------------------------
+
+def test_webui_serves_page_and_api(ctx):
+    ui = ctx.start_ui()
+    try:
+        page = urllib.request.urlopen(ui.url, timeout=5).read().decode()
+        assert "Cyclone" in page and "/api/v1/" in page
+        apps = json.loads(urllib.request.urlopen(
+            ui.url + "api/v1/applications", timeout=5).read())
+        assert apps and apps[0]["id"] == ctx.app_id
+        jobs = json.loads(urllib.request.urlopen(
+            ui.url + "api/v1/jobs", timeout=5).read())
+        assert isinstance(jobs, list)
+        with pytest.raises(Exception):
+            urllib.request.urlopen(ui.url + "api/v1/nope", timeout=5)
+        # idempotent: second call returns the same server
+        assert ctx.start_ui() is ui
+    finally:
+        ui.stop()
+        ctx._web_ui = None
+
+
+def test_stable_hash_equal_keys_copartition():
+    """1 == 1.0 == True must land in the same partition AND the same group
+    (the builtin-hash invariant the stable hash must preserve)."""
+    assert stable_hash(1) == stable_hash(1.0) == stable_hash(True)
+    assert stable_hash(np.int64(3)) == stable_hash(3)
+    m = ExternalAppendOnlyMap(row_budget=2)
+    m.insert(1, "a"); m.insert(1.0, "b"); m.insert(True, "c")
+    m.insert(2, "x")
+    got = {k: sorted(v) for k, v in m.items()}
+    assert len(got) == 2
+    assert sorted(got[1]) == ["a", "b", "c"]
+
+
+def test_mutually_recursive_views_rejected():
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    s.register_temp_view("emp", s.create_data_frame({"id": [1, 2]}))
+    s.sql("CREATE VIEW a AS SELECT id FROM emp")
+    s.sql("CREATE VIEW b AS SELECT id FROM a")
+    with pytest.raises(ValueError, match="recursive"):
+        s.sql("CREATE OR REPLACE VIEW a AS SELECT id FROM b")
+
+
+def test_union_tail_on_first_branch_rejected():
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    s.register_temp_view("emp", s.create_data_frame({"id": [1, 2]}))
+    with pytest.raises(ValueError, match="wrap the union"):
+        s.sql("SELECT id FROM emp ORDER BY id UNION ALL SELECT id FROM emp")
+
+
+def test_webui_bad_job_id_is_404(ctx):
+    ui = ctx.start_ui()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(ui.url + "api/v1/jobs/abc", timeout=5)
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ui.url + "api/v1/workers/oops", timeout=5)
+    finally:
+        ui.stop()
+        ctx._web_ui = None
