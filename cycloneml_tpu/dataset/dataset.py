@@ -159,8 +159,10 @@ class PartitionedDataset:
         reference's Partitioner contract: every process must agree), and
         each bucket aggregates through an ExternalAppendOnlyMap that spills
         sorted runs to disk past ``cyclone.shuffle.spill.rowBudget`` values
-        (ref ExternalAppendOnlyMap.scala:55) — grouping beyond host RAM
-        degrades to disk instead of OOM."""
+        per bucket (ref ExternalAppendOnlyMap.scala:55). The spill bounds
+        the AGGREGATION working set; input partitions and the grouped
+        output partitions are still materialized in memory (this tier's
+        partitions are in-memory lists by construction)."""
         n = self.num_partitions
         from cycloneml_tpu.conf import SHUFFLE_SPILL_ROW_BUDGET
         budget = int(self.ctx.conf.get(SHUFFLE_SPILL_ROW_BUDGET)) \
@@ -173,9 +175,13 @@ class PartitionedDataset:
             # per-collection numElementsForceSpillThreshold)
             buckets = [ExternalAppendOnlyMap(row_budget=budget)
                        for _ in range(n)]
+            assign: dict = {}  # keys repeat: hash each distinct key once
             for p in ps:
                 for k, v in p:
-                    buckets[stable_hash(k) % n].insert(k, v)
+                    b = assign.get(k)
+                    if b is None:
+                        b = assign[k] = stable_hash(k) % n
+                    buckets[b].insert(k, v)
             return [list(b.items()) for b in buckets]
         return self._derive(fn, "groupByKey", n)
 

@@ -5,7 +5,9 @@ Analog of the reference's spillable collections
 append-only map that sorts and spills to disk past a memory threshold, then
 hash-merges the spilled runs with the in-memory map). The host tier's
 ``group_by_key`` routes every pair through :class:`ExternalAppendOnlyMap`,
-so grouping datasets larger than host RAM degrades to disk instead of OOM.
+bounding the aggregation's working set (the host tier's input/output
+partitions themselves remain in-memory lists — the spill removes the
+grouping-map blowup, not the partition materialization).
 
 Spill files are sequences of independently-compressed chunks (the native
 zstd/lz4 codec, ref CompressionCodec.scala:63), each a pickled run of
@@ -17,7 +19,6 @@ one entry per run in memory.
 from __future__ import annotations
 
 import heapq
-import hashlib
 import os
 import pickle
 import struct
@@ -34,22 +35,31 @@ def stable_hash(key: Any) -> int:
 
     Equal keys MUST hash equal (1 == 1.0 == True must co-partition), so
     numerics use Python's own numeric hash — which is salt-free and equal
-    across equal values — while str/bytes/tuples get a salt-free digest.
-    Other types fall back to their ``__hash__``: deterministic exactly when
-    the type's own hash is (a custom value-based __hash__ qualifies; the
-    default id() hash does not, and such keys were never cross-process
-    stable under any scheme)."""
+    across equal values — while str/bytes/tuples/frozensets get a salt-free
+    CRC64-style digest (crc32 over the bytes and their length; this is a
+    partitioner, not a cryptographic hash — speed matters on the per-record
+    shuffle path). Other types fall back to their ``__hash__``:
+    deterministic exactly when the type's own hash is (a custom value-based
+    __hash__ qualifies; the default id() hash does not)."""
+    import zlib
     if isinstance(key, str):
-        digest = hashlib.md5(key.encode("utf-8")).digest()
+        b = key.encode("utf-8")
     elif isinstance(key, (bytes, bytearray)):
-        digest = hashlib.md5(bytes(key)).digest()
+        b = bytes(key)
     elif isinstance(key, tuple):
-        digest = hashlib.md5(
-            b"|".join(str(stable_hash(k)).encode() for k in key)).digest()
+        h = 1099511628211
+        for k in key:
+            h = (h * 31 + stable_hash(k)) & 0x7FFFFFFFFFFFFFFF
+        return h
+    elif isinstance(key, frozenset):
+        # order-independent: sum of element hashes (commutative), salt-free
+        return (sum(stable_hash(k) for k in key) + len(key)) \
+            & 0x7FFFFFFFFFFFFFFF
     else:
         # numerics (incl. numpy scalars and bool) + custom-hash objects
         return hash(key) & 0x7FFFFFFFFFFFFFFF
-    return int.from_bytes(digest[:8], "little")
+    return (zlib.crc32(b) | (zlib.crc32(b[::-1]) << 32)) \
+        & 0x7FFFFFFFFFFFFFFF
 
 
 _CHUNK_ENTRIES = 4096
@@ -139,28 +149,42 @@ class ExternalAppendOnlyMap:
         self._rows = 0
 
     def items(self) -> Iterator[Tuple[Any, list]]:
-        """Stream merged (key, values) groups; consumes the map."""
+        """Stream merged (key, values) groups; consumes the map. Spill
+        files are removed even if the iterator is abandoned or the merge
+        raises (generator finalization runs the finally)."""
         if not self._spills:
             yield from self._map.items()
             self._map = {}
             return
-        runs: List[Iterator] = [iter(s) for s in self._spills]
-        runs.append(iter(self._sorted_entries()))
-        self._map = {}
-        merged = heapq.merge(*runs, key=lambda e: (e[0], repr(e[1])))
-        cur_key, cur_vals, have = None, None, False
-        for h, k, vs in merged:
-            if have and k == cur_key:
-                cur_vals.extend(vs)
-            else:
-                if have:
-                    yield cur_key, cur_vals
-                cur_key, cur_vals, have = k, list(vs), True
-        if have:
-            yield cur_key, cur_vals
+        try:
+            runs: List[Iterator] = [iter(s) for s in self._spills]
+            runs.append(iter(self._sorted_entries()))
+            self._map = {}
+            merged = heapq.merge(*runs, key=lambda e: (e[0], repr(e[1])))
+            cur_key, cur_vals, have = None, None, False
+            for h, k, vs in merged:
+                if have and k == cur_key:
+                    cur_vals.extend(vs)
+                else:
+                    if have:
+                        yield cur_key, cur_vals
+                    cur_key, cur_vals, have = k, list(vs), True
+            if have:
+                yield cur_key, cur_vals
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Delete any remaining spill files."""
         for s in self._spills:
             s.delete()
         self._spills = []
+
+    def __del__(self):  # a dropped, never-drained map must not leak /tmp
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __len__(self) -> int:
         return len(self._map)

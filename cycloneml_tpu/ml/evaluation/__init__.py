@@ -1,8 +1,10 @@
 from cycloneml_tpu.ml.evaluation.evaluators import (
     Evaluator, BinaryClassificationEvaluator, MulticlassClassificationEvaluator,
+    MultilabelClassificationEvaluator,
     RegressionEvaluator, ClusteringEvaluator, RankingEvaluator,
 )
 
 __all__ = ["Evaluator", "BinaryClassificationEvaluator",
-           "MulticlassClassificationEvaluator", "RegressionEvaluator",
+           "MulticlassClassificationEvaluator",
+           "MultilabelClassificationEvaluator", "RegressionEvaluator",
            "ClusteringEvaluator", "RankingEvaluator"]

@@ -278,3 +278,91 @@ class RankingEvaluator(Evaluator):
                            for r in range(min(len(rel), k)))
                 vals.append(dcg / max(idcg, 1e-300))
         return float(np.mean(vals)) if vals else 0.0
+
+
+class MultilabelClassificationEvaluator(Evaluator):
+    """(ref MultilabelClassificationEvaluator.scala:35 / mllib
+    MultilabelMetrics): label and prediction columns hold per-row ARRAYS of
+    label ids (object columns). Document-based metrics average per-row set
+    statistics; micro metrics pool TP/FP/FN over all rows; the ByLabel
+    variants restrict to ``metricLabel``.
+    """
+
+    def __init__(self, uid=None, **kw):
+        super().__init__(uid)
+        self.predictionCol = self._param(
+            "predictionCol", "predicted label-id arrays", default="prediction")
+        self.labelCol = self._param("labelCol", "true label-id arrays",
+                                    default="label")
+        self.metricName = self._param(
+            "metricName", "multilabel metric",
+            V.in_array(["subsetAccuracy", "accuracy", "hammingLoss",
+                        "precision", "recall", "f1Measure",
+                        "precisionByLabel", "recallByLabel",
+                        "f1MeasureByLabel", "microPrecision", "microRecall",
+                        "microF1Measure"]),
+            default="f1Measure")
+        self.metricLabel = self._param(
+            "metricLabel", "label for the ByLabel metrics (>= 0)",
+            V.gt_eq(0.0), default=0.0)
+        for k_, v in kw.items():
+            self.set(k_, v)
+
+    @property
+    def is_larger_better(self) -> bool:
+        return self.get("metricName") != "hammingLoss"
+
+    def evaluate(self, frame: MLFrame) -> float:
+        preds = [set(p) for p in frame[self.get("predictionCol")]]
+        labels = [set(l) for l in frame[self.get("labelCol")]]
+        n = len(labels)
+        if n == 0:
+            return 0.0
+        metric = self.get("metricName")
+        inter = [len(p & l) for p, l in zip(preds, labels)]
+
+        if metric == "subsetAccuracy":
+            return float(np.mean([p == l for p, l in zip(preds, labels)]))
+        if metric == "accuracy":
+            return float(np.mean([
+                i / max(len(p | l), 1)
+                for i, p, l in zip(inter, preds, labels)]))
+        if metric == "hammingLoss":
+            # reference MultilabelMetrics.numLabels counts distinct ids from
+            # the TRUE labels only (predicted-only ids do not widen the
+            # denominator)
+            num_labels = len(set().union(*labels))
+            wrong = sum(len(p) + len(l) - 2 * i
+                        for i, p, l in zip(inter, preds, labels))
+            return wrong / (n * max(num_labels, 1))
+        if metric == "precision":
+            return float(np.mean([i / max(len(p), 1)
+                                  for i, p in zip(inter, preds)]))
+        if metric == "recall":
+            return float(np.mean([i / max(len(l), 1)
+                                  for i, l in zip(inter, labels)]))
+        if metric == "f1Measure":
+            return float(np.mean([
+                2.0 * i / max(len(p) + len(l), 1)
+                for i, p, l in zip(inter, preds, labels)]))
+
+        if metric.startswith("micro"):
+            tp = sum(inter)
+            fp = sum(len(p) - i for i, p in zip(inter, preds))
+            fn = sum(len(l) - i for i, l in zip(inter, labels))
+            if metric == "microPrecision":
+                return tp / max(tp + fp, 1)
+            if metric == "microRecall":
+                return tp / max(tp + fn, 1)
+            return 2.0 * tp / max(2 * tp + fp + fn, 1)
+
+        # ByLabel family
+        lab = self.get("metricLabel")
+        tp = sum(1 for p, l in zip(preds, labels) if lab in p and lab in l)
+        fp = sum(1 for p, l in zip(preds, labels) if lab in p and lab not in l)
+        fn = sum(1 for p, l in zip(preds, labels) if lab not in p and lab in l)
+        if metric == "precisionByLabel":
+            return tp / max(tp + fp, 1)
+        if metric == "recallByLabel":
+            return tp / max(tp + fn, 1)
+        return 2.0 * tp / max(2 * tp + fp + fn, 1)  # f1MeasureByLabel

@@ -209,3 +209,40 @@ def test_cv_model_persistence(ctx, tmp_path):
     assert back.avg_metrics == model.avg_metrics
     np.testing.assert_allclose(back.transform(frame)["prediction"],
                                model.transform(frame)["prediction"])
+
+
+def test_multilabel_evaluator_matches_reference_semantics(ctx):
+    """Worked example from the reference's MultilabelMetrics docs/suite
+    shape: per-row label sets, document + micro + by-label metrics."""
+    from cycloneml_tpu.ml.evaluation import MultilabelClassificationEvaluator
+    preds = [{0.0, 1.0}, {0.0, 2.0}, set(), {2.0}, {2.0, 0.0}, {0.0, 1.0, 2.0}, {1.0}]
+    labels = [{0.0, 1.0}, {0.0, 2.0}, {0.0}, {2.0}, {2.0, 0.0}, {0.0, 1.0}, {1.0, 2.0}]
+    frame = MLFrame(ctx, {
+        "prediction": np.array([np.array(sorted(p)) for p in preds],
+                               dtype=object),
+        "label": np.array([np.array(sorted(l)) for l in labels],
+                          dtype=object)})
+
+    def m(name, **kw):
+        return MultilabelClassificationEvaluator(
+            metricName=name, **kw).evaluate(frame)
+
+    n = 7
+    # hand-computed from the sets above
+    assert m("subsetAccuracy") == pytest.approx(4 / n)
+    assert m("hammingLoss") == pytest.approx(
+        (0 + 0 + 1 + 0 + 0 + 1 + 1) / (n * 3))
+    assert m("precision") == pytest.approx(
+        np.mean([1, 1, 0, 1, 1, 2 / 3, 1]))
+    assert m("recall") == pytest.approx(np.mean([1, 1, 0, 1, 1, 1, 0.5]))
+    assert m("f1Measure") == pytest.approx(np.mean(
+        [1, 1, 0, 1, 1, 2 * 2 / 5, 2 * 1 / 3]))
+    tp, fp, fn = 10, 1, 2   # pooled over all rows
+    assert m("microPrecision") == pytest.approx(tp / (tp + fp))
+    assert m("microRecall") == pytest.approx(tp / (tp + fn))
+    assert m("microF1Measure") == pytest.approx(2 * tp / (2 * tp + fp + fn))
+    assert m("precisionByLabel", metricLabel=0.0) == pytest.approx(1.0)
+    assert m("recallByLabel", metricLabel=0.0) == pytest.approx(4 / 5)
+    # larger-better orientation flips for loss metrics
+    assert not MultilabelClassificationEvaluator(
+        metricName="hammingLoss").is_larger_better
