@@ -313,6 +313,16 @@ NETWORK_TIMEOUT_MS = (
     .int_conf(120000)
 )
 
+LBFGS_DEVICE_CHUNK = (
+    ConfigBuilder("cyclone.ml.lbfgs.deviceChunk")
+    .doc("L-BFGS iterations fused into one device dispatch for eligible "
+         "fits (dense tier, standardized-or-no L2, no L1/bounds/"
+         "checkpointing). 0 disables the chunked optimizer (host loop with "
+         "fused line search, one dispatch per iteration).")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(16)
+)
+
 SHUFFLE_SPILL_ROW_BUDGET = (
     ConfigBuilder("cyclone.shuffle.spill.rowBudget")
     .doc("Values held in memory per host-shuffle bucket before spilling a "

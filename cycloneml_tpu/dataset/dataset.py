@@ -175,13 +175,9 @@ class PartitionedDataset:
             # per-collection numElementsForceSpillThreshold)
             buckets = [ExternalAppendOnlyMap(row_budget=budget)
                        for _ in range(n)]
-            assign: dict = {}  # keys repeat: hash each distinct key once
             for p in ps:
                 for k, v in p:
-                    b = assign.get(k)
-                    if b is None:
-                        b = assign[k] = stable_hash(k) % n
-                    buckets[b].insert(k, v)
+                    buckets[stable_hash(k) % n].insert(k, v)
             return [list(b.items()) for b in buckets]
         return self._derive(fn, "groupByKey", n)
 

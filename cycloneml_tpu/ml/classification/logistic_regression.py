@@ -277,6 +277,20 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                         l1_reg=l1_vec)
         else:
             opt = LBFGS(max_iter=self.get("maxIter"), tol=self.get("tol"))
+            # chunked device optimizer: K whole iterations per dispatch
+            # (two-loop + Wolfe + convergence all on device). Eligible when
+            # the loss is the dense replicated tier with a standardized (or
+            # no) L2, and no checkpointing (checkpoints want per-iteration
+            # states).
+            from cycloneml_tpu.conf import LBFGS_DEVICE_CHUNK
+            chunk = int(ds.ctx.conf.get(LBFGS_DEVICE_CHUNK)) \
+                if hasattr(ds.ctx, "conf") else 0
+            if (chunk > 0 and not self.get("checkpointDir")
+                    and isinstance(loss_fn, DistributedLossFunction)
+                    and (l2_fn is None or hasattr(l2_fn, "traceable"))):
+                from cycloneml_tpu.ml.optim.device_lbfgs import DeviceLBFGS
+                opt = DeviceLBFGS(max_iter=self.get("maxIter"),
+                                  tol=self.get("tol"), chunk=chunk)
 
         if self.get("checkpointDir"):
             import hashlib

@@ -1,0 +1,297 @@
+"""Device-resident chunked L-BFGS.
+
+The host optimizer (``lbfgs.LBFGS``) pays one device dispatch per
+iteration even with the fused line search — through a TPU relay that is
+~70-200 ms of pure latency per L-BFGS step while the gradient math itself
+takes single-digit milliseconds. This module runs WHOLE CHUNKS of K
+iterations inside one jitted program: the two-loop recursion over a
+fixed-size (m, n) curvature ring buffer, the strong-Wolfe search
+(``loss.wolfe_search`` — the same traced state machine the per-iteration
+fused path uses), the curvature-condition history update, and the
+Breeze-style convergence tests all stay on device; the host sees one
+dispatch and one small readback per chunk.
+
+Structure beaten, not emulated: the reference pays one Spark JOB per loss
+evaluation (RDDLossFunction.scala:56) — ~30 jobs per iteration; the host
+path here pays 1 dispatch per iteration; this path pays 1/K.
+
+Semantics match ``lbfgs.LBFGS`` (same Wolfe machine, same two-loop, same
+curvature condition sᵀy > 1e-10·yᵀy, same convergence tests) computed in
+the data tier's dtype — f64 under the CPU test config (trajectories match
+the host path), f32 on TPU (last-ulp drift; the convergence thresholds are
+~1e-6 relative, within f32's resolution for these well-scaled problems).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OptimState
+from cycloneml_tpu.parallel.collectives import BoundedProgramCache
+
+_program_cache = BoundedProgramCache(32)
+
+
+def _build_chunk(compiled, l2_t, m: int, K: int, c1: float, c2: float,
+                 max_ls: int, cdt: np.dtype):
+    """jit program: K L-BFGS iterations on device.
+
+    Args: (*arrays, coef, S, Y, k_hist, f0, g0, first, ws, tol, grad_tol,
+    it_limit, need_init) → (coef, S, Y, k_hist, f, g, losses(K), n_iters,
+    evals, converged_code, f0, g0). ``l2_t`` is the penalty's jnp twin
+    (``l2_regularization(...).traceable``) — the SAME implementation the
+    fused line search inlines, so the two device paths cannot drift.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.ml.optim.loss import wolfe_search
+
+    def program(*args):
+        (arrays, coef0, S0, Y0, k0, f_in, g_in, first,
+         ws, tol, grad_tol, it_limit, need_init) = \
+            (args[:-12], *args[-12:])
+
+        def f_and_g(coef):
+            out = compiled(*arrays, coef)
+            loss = (out["loss"] / ws).astype(cdt)
+            grad = (out["grad"] / ws).astype(cdt)
+            if l2_t is not None:
+                rl, rg = l2_t(coef)
+                loss = loss + rl
+                grad = grad + rg
+            return loss, grad
+
+        def two_loop(S, Y, k, g):
+            idxs_bwd = jnp.arange(m - 1, -1, -1)
+
+            def bwd(q, i):
+                valid = i >= m - k
+                sy = jnp.dot(Y[i], S[i])
+                rho = jnp.where(valid, 1.0 / jnp.where(valid, sy, 1.0), 0.0)
+                a = rho * jnp.dot(S[i], q)
+                return q - a * Y[i], (a, rho)
+
+            q, (alphas, rhos) = jax.lax.scan(bwd, g, idxs_bwd)
+            last_sy = jnp.dot(S[m - 1], Y[m - 1])
+            last_yy = jnp.dot(Y[m - 1], Y[m - 1])
+            gamma = jnp.where(k > 0, last_sy / jnp.maximum(last_yy, 1e-300),
+                              1.0)
+            r = gamma * q
+
+            def fwd(r, inp):
+                i, a, rho = inp
+                beta = rho * jnp.dot(Y[i], r)
+                return r + (a - beta) * S[i], None
+
+            # forward pass visits oldest→newest: reverse the bwd outputs
+            r, _ = jax.lax.scan(
+                fwd, r, (idxs_bwd[::-1], alphas[::-1], rhos[::-1]))
+            return -r
+
+        zero = cdt.type(0.0)
+
+        def body(carry):
+            (coef, S, Y, k, f, g, it, evals, done, losses) = carry
+            d = two_loop(S, Y, k, g)
+            dg0 = jnp.dot(d, g)
+            # non-descent: reset history, steepest descent (host semantics)
+            bad = dg0 >= 0
+            d = jnp.where(bad, -g, d)
+            k = jnp.where(bad, 0, k)
+            dg0 = jnp.where(bad, -jnp.dot(g, g), dg0)
+            gnorm = jnp.sqrt(jnp.maximum(jnp.dot(g, g), 1e-300))
+            # host semantics: the scaled step min(1, 1/||g||) applies on the
+            # very first iteration AND on every steepest-descent restart
+            init_alpha = jnp.where(
+                (first & (it == 0)) | bad,
+                jnp.minimum(1.0, 1.0 / gnorm), cdt.type(1.0))
+
+            def phi(alpha):
+                v, grad = f_and_g(coef + alpha * d)
+                return v, grad, jnp.dot(d, grad)
+
+            alpha, f_new, g_new, ev = wolfe_search(
+                phi, jnp.zeros_like(g), f, dg0, init_alpha,
+                c1, c2, max_ls, cdt)
+            s = alpha * d
+            y = g_new - g
+            # curvature condition (host _History.update)
+            keep = jnp.dot(s, y) > 1e-10 * jnp.dot(y, y)
+            S = jnp.where(keep, jnp.roll(S, -1, axis=0).at[-1].set(s), S)
+            Y = jnp.where(keep, jnp.roll(Y, -1, axis=0).at[-1].set(y), Y)
+            k = jnp.where(keep, jnp.minimum(k + 1, m), k)
+            # Breeze-style convergence (host LBFGS._converged)
+            denom = jnp.maximum(jnp.maximum(jnp.abs(f_new), jnp.abs(f)),
+                                1e-6)
+            f_conv = jnp.abs(f - f_new) <= tol * denom
+            gn = jnp.sqrt(jnp.maximum(jnp.dot(g_new, g_new), 0.0))
+            xn = jnp.sqrt(jnp.maximum(jnp.dot(coef + s, coef + s), 0.0))
+            g_conv = gn <= grad_tol * jnp.maximum(xn, 1.0)
+            code = jnp.where(f_conv, 1,
+                             jnp.where(g_conv, 2, 0)).astype(jnp.int32)
+            losses = losses.at[it].set(f_new)
+            return (coef + s, S, Y, k, f_new, g_new, it + 1,
+                    evals + ev, code, losses)
+
+        def cond(carry):
+            it, done = carry[6], carry[8]
+            return (it < jnp.minimum(K, it_limit)) & (done == 0)
+
+        # fused initial evaluation: a fresh fit computes f(x0)/∇f(x0) inside
+        # THIS dispatch instead of paying a separate round trip for it
+        f0, g0 = jax.lax.cond(need_init,
+                              lambda: f_and_g(coef0),
+                              lambda: (f_in, g_in))
+        evals0 = jnp.where(need_init, 1, 0).astype(jnp.int32)
+        losses0 = jnp.full((K,), jnp.nan, cdt)
+        init = (coef0, S0, Y0, k0, f0, g0, jnp.int32(0), evals0,
+                jnp.int32(0), losses0)
+        (coef, S, Y, k, f, g, it, evals, code, losses) = \
+            jax.lax.while_loop(cond, body, init)
+        return coef, S, Y, k, f, g, losses, it, evals, code, f0, g0
+
+    return jax.jit(program)
+
+
+class DeviceLBFGS(LBFGS):
+    """L-BFGS running ``chunk`` iterations per device dispatch.
+
+    Works with a ``DistributedLossFunction`` over the dense tier whose L2
+    term (if any) is the standardized uniform penalty — the same
+    preconditions as the fused line search, checked by the caller
+    (LogisticRegression selects this optimizer automatically when they
+    hold and no checkpointing is requested; ``cyclone.ml.lbfgs.deviceChunk``
+    sizes or disables it).
+    """
+
+    def __init__(self, max_iter: int = 100, m: int = 10, tol: float = 1e-6,
+                 grad_tol: Optional[float] = None, chunk: int = 8,
+                 c1: float = 1e-4, c2: float = 0.9, max_ls: int = 30):
+        super().__init__(max_iter, m, tol, grad_tol)
+        self.chunk = max(int(chunk), 1)
+        self.c1, self.c2, self.max_ls = c1, c2, max_ls
+
+    def iterations(self, f, x0: np.ndarray,
+                   resume: Optional[OptimState] = None):
+        import jax
+        import jax.numpy as jnp
+
+        arrays = f._agg_call.arrays()
+        cdt = np.dtype(arrays[-1].dtype)
+        n = len(np.asarray(x0))
+        l2_t = getattr(f.l2_reg_fn, "traceable", None) \
+            if f.l2_reg_fn is not None else None
+        if f.l2_reg_fn is not None and l2_t is None:
+            raise ValueError(
+                "DeviceLBFGS needs a regularizer with a traceable (jnp) "
+                "twin; use the host LBFGS otherwise")
+        key = ("lbfgs_chunk", f._agg_call.compiled, l2_t, self.m, self.chunk,
+               float(self.c1), float(self.c2), int(self.max_ls), cdt.str)
+        prog = _program_cache.get(key)
+        if prog is None:
+            prog = _build_chunk(f._agg_call.compiled, l2_t, self.m,
+                                self.chunk, self.c1, self.c2, self.max_ls,
+                                cdt)
+            _program_cache.put(key, prog)
+
+        if resume is not None:
+            from cycloneml_tpu.ml.optim.lbfgs import _reopen
+            state = _reopen(resume, self.max_iter)
+            S = np.zeros((self.m, n), dtype=cdt)
+            Y = np.zeros((self.m, n), dtype=cdt)
+            hk = min(len(resume.hist_s), self.m)
+            for i, (s_, y_) in enumerate(zip(resume.hist_s[-self.m:],
+                                             resume.hist_y[-self.m:])):
+                S[self.m - hk + i] = np.asarray(s_)
+                Y[self.m - hk + i] = np.asarray(y_)
+            k_hist = hk
+            # iteration-0 resumes must keep the host path's scaled first
+            # step (init_alpha = min(1, 1/||g||))
+            first = state.iteration == 0
+            need_init = False
+            yield state
+            if state.converged:
+                return
+            coef = jnp.asarray(state.x, cdt)
+            f_d = cdt.type(state.value)
+            g_d = jnp.asarray(state.grad, cdt)
+        else:
+            # fresh fit: f(x0) is computed INSIDE the first chunk dispatch;
+            # the iteration-0 state is yielded when that chunk returns
+            state = None
+            S = np.zeros((self.m, n), dtype=cdt)
+            Y = np.zeros((self.m, n), dtype=cdt)
+            k_hist = 0
+            first = True
+            need_init = True
+            coef = jnp.asarray(np.asarray(x0, dtype=cdt))
+            f_d = cdt.type(0.0)
+            g_d = jnp.zeros(n, cdt)
+
+        S_d, Y_d = jnp.asarray(S), jnp.asarray(Y)
+        k_d = jnp.int32(k_hist)
+        while True:
+            # big state (coef/S/Y/grad) stays ON DEVICE between chunks —
+            # only scalars and the per-iteration loss vector come back per
+            # dispatch; the full f64 state materializes on yield only when
+            # a consumer touches the arrays (np.asarray forces the copy)
+            base_iter = state.iteration if state is not None else 0
+            (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d, evals_d,
+             code_d, f0_d, g0_d) = prog(
+                *arrays, coef, S_d, Y_d, k_d, f_d, g_d,
+                np.bool_(first), cdt.type(f.weight_sum),
+                cdt.type(self.tol), cdt.type(self.grad_tol),
+                np.int32(max(self.max_iter - base_iter, 0)),
+                np.bool_(need_init))
+            f_h, losses, it, evals, code, k_h, f0_h = jax.device_get(
+                (f_d, losses_d, it_d, evals_d, code_d, k_d, f0_d))
+            coef = coef_d
+            first = False
+            f.n_evals += int(evals)
+            f.n_dispatches += 1
+            if need_init:
+                state = OptimState(
+                    x=np.asarray(x0, np.float64).copy(),
+                    value=float(f0_h), grad=g0_d,
+                    loss_history=[float(f0_h)])
+                need_init = False
+                yield state
+            n_new = int(it)
+            losses = [float(v) for v in losses[:n_new]]
+            hk = int(k_h)
+            # device slices: no host transfer unless a consumer (the
+            # checkpoint/resume path) actually reads them
+            hist_s = [S_d[i] for i in range(self.m - hk, self.m)]
+            hist_y = [Y_d[i] for i in range(self.m - hk, self.m)]
+            state = OptimState(
+                x=coef_d, value=float(f_h), grad=g_d,
+                iteration=state.iteration + n_new,
+                loss_history=state.loss_history + losses,
+                hist_s=hist_s, hist_y=hist_y)
+            if hasattr(f, "_ctx") and hasattr(f._ctx, "record_step"):
+                f._ctx.record_step({"loss": state.value,
+                                    "chunk_iterations": n_new})
+            # precedence matches host _converged: a budget stop outranks
+            # the value/gradient tests (the estimator's non-convergence
+            # warning keys off this reason)
+            if state.iteration >= self.max_iter:
+                state.converged = True
+                state.converged_reason = "max iterations reached"
+            elif int(code) == 1:
+                state.converged = True
+                state.converged_reason = "function value converged"
+            elif int(code) == 2:
+                state.converged = True
+                state.converged_reason = "gradient converged"
+            if state.converged:
+                # terminal state: hand back host-f64 arrays as the host
+                # optimizer does
+                state.x = np.asarray(coef_d, np.float64)
+                state.grad = np.asarray(g_d, np.float64)
+            yield state
+            if state.converged:
+                return
+            f_d = cdt.type(f_h)

@@ -208,17 +208,27 @@ def test_fit_tracked_in_status_store(ctx):
     x = rng.randn(64, 4)
     y = (x @ np.array([1.0, -2.0, 0.5, 0.0]) > 0).astype(float)
     frame = MLFrame(ctx, {"features": x, "label": y})
+    from cycloneml_tpu.conf import LBFGS_DEVICE_CHUNK
     before = len(ctx.status_store.job_list())
-    LogisticRegression(maxIter=5).fit(frame)
+    old_chunk = ctx.conf.get(LBFGS_DEVICE_CHUNK)
+    ctx.conf.set(LBFGS_DEVICE_CHUNK, 2)  # force >= 2 recorded steps
+    try:
+        LogisticRegression(maxIter=5, tol=0.0).fit(frame)
+    finally:
+        ctx.conf.set(LBFGS_DEVICE_CHUNK, old_chunk)
     assert ctx.listener_bus.wait_until_empty()
     jobs = ctx.status_store.job_list()
     assert len(jobs) > before
     fit_jobs = [j for j in jobs if "LogisticRegression.fit" in j["description"]]
     assert fit_jobs and fit_jobs[-1]["status"] == "SUCCEEDED"
     steps = ctx.status_store.steps(fit_jobs[-1]["jobId"])
-    assert len(steps) >= 2  # one StepCompleted per gradient evaluation
+    # chunked device L-BFGS records one step PER CHUNK (covering several
+    # iterations); the host path records one per gradient evaluation
+    total_iters = sum(st["metrics"].get("chunk_iterations", 1)
+                      for st in steps)
+    assert total_iters >= 2 and len(steps) >= 2
     losses = [st["metrics"]["loss"] for st in steps]
-    assert losses[-1] < losses[0]  # loss decreased over iterations
+    assert losses[-1] < losses[0]  # loss decreased over the fit
     vals = ctx.metrics.registry.values()
     assert vals["steps.completed"] >= len(steps)
     assert vals["jobs.succeeded"] >= 1
