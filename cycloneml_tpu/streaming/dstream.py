@@ -6,11 +6,14 @@ JobScheduler). A clock discretizes input into per-interval batches; each
 batch is a ``PartitionedDataset`` (the RDD analog), and DStream operators are
 lazy per-batch transformations plus windowed/stateful variants.
 
-What deliberately does not port: receivers + WAL (ReceiverTracker,
-ReceivedBlockTracker) — inputs here are pull-based and replayable like the
-structured sources, so block-level write-ahead logging has nothing to
-protect. Structured streaming (query.py) is the primary engine; this surface
-exists for parity with the reference's DStream programs.
+Push-based ingestion exists too: :class:`Receiver` (ref Receiver.scala:43)
+runs user code in its own thread storing records into
+:class:`ReceiverInputDStream`, optionally write-ahead-logged record-by-
+record (:class:`WriteAheadLog` ≈ ReceivedBlockTracker + FileBasedWAL) so a
+crashed driver replays unprocessed records; ``socket_text_stream`` is the
+classic concrete receiver. Structured streaming (query.py) remains the
+primary engine; this surface exists for parity with the reference's
+DStream programs.
 """
 
 from __future__ import annotations
@@ -61,12 +64,28 @@ class StreamingContext:
         self._inputs.append(s)
         return s
 
+    def receiver_stream(self, receiver: "Receiver",
+                        wal_dir: Optional[str] = None) -> "DStream":
+        """(ref receiverStream): push-based input via a Receiver; records
+        are write-ahead-logged before visibility when ``wal_dir`` is set."""
+        s = ReceiverInputDStream(self, receiver, wal_dir)
+        self._inputs.append(s)
+        return s
+
+    def socket_text_stream(self, host: str, port: int,
+                           wal_dir: Optional[str] = None) -> "DStream":
+        """(ref socketTextStream)"""
+        return self.receiver_stream(SocketReceiver(host, port), wal_dir)
+
     # -- lifecycle (ref JobGenerator clock + JobScheduler) ---------------------
     def start(self) -> None:
         if self._started:
             return
         self._stop_evt.clear()  # allow stop() → start() restart
         self._started = True
+        for s in self._inputs:  # ReceiverTracker.start analog
+            if isinstance(s, ReceiverInputDStream):
+                s.start_receiver()
         self._thread = threading.Thread(target=self._loop,
                                         name="cyclone-dstream-clock",
                                         daemon=True)
@@ -92,10 +111,15 @@ class StreamingContext:
                 if batch is not None:  # None = no RDD this interval
                     action(batch, t)
             for s in self._inputs:
+                if hasattr(s, "post_interval"):
+                    s.post_interval(t)  # outputs done: WAL may truncate
                 s.gc(t)
 
     def stop(self) -> None:
         self._stop_evt.set()
+        for s in self._inputs:
+            if isinstance(s, ReceiverInputDStream):
+                s.stop_receiver()
         if self._thread is not None:
             self._thread.join(timeout=10)
         self._started = False
@@ -307,3 +331,215 @@ class FileInputDStream(InputDStream):
                 with open(f, encoding="utf-8") as fh:
                     lines.extend(ln.rstrip("\n") for ln in fh if ln.strip())
         self._batches[t] = lines
+
+
+# -- receivers + write-ahead log ------------------------------------------------
+
+class Receiver:
+    """Push-based ingestion endpoint (ref: streaming/receiver/Receiver.scala:43
+    — user code runs on_start in its own thread and calls ``store`` for each
+    arriving record; the supervisor buffers records into blocks).
+
+    Subclass and implement ``on_start`` (spawn whatever reads your source and
+    calls ``self.store(record)``) and optionally ``on_stop``.
+    """
+
+    def __init__(self):
+        self._supervisor: Optional["ReceiverInputDStream"] = None
+        self._stopped = threading.Event()
+
+    def store(self, record: Any) -> None:
+        if self._supervisor is not None:
+            self._supervisor._store(record)
+
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def on_start(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+
+class WriteAheadLog:
+    """Record-level WAL (ref: streaming/util/FileBasedWriteAheadLog.scala:55
+    via ReceivedBlockTracker): every stored record is appended — compressed
+    with the native codec — BEFORE it becomes visible to batch generation,
+    so a crashed driver replays unconsumed records on restart. ``clean``
+    truncates entries already folded into processed batches."""
+
+    def __init__(self, path: str):
+        import struct as _struct
+        from cycloneml_tpu.native.host import CompressionCodec
+        self._struct = _struct
+        self._codec = CompressionCodec()
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._consumed = 0  # records already folded into batches
+        marker = path + ".consumed"
+        if os.path.exists(marker):
+            with open(marker, encoding="utf-8") as fh:
+                self._consumed = int(fh.read().strip() or 0)
+        self._fh = open(path, "ab")
+
+    def append(self, record: Any) -> None:
+        import pickle
+        blob = self._codec.compress(
+            pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL))
+        self._fh.write(self._struct.pack("<I", len(blob)))
+        self._fh.write(blob)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def recover(self) -> List[Any]:
+        """Records appended but not yet marked consumed (torn tails from a
+        crash mid-append are ignored, standard WAL practice)."""
+        import pickle
+        from cycloneml_tpu.native.host import CompressionCodec
+        out: List[Any] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "rb") as fh:
+            i = 0
+            while True:
+                hdr = fh.read(4)
+                if len(hdr) < 4:
+                    break
+                (n,) = self._struct.unpack("<I", hdr)
+                blob = fh.read(n)
+                if len(blob) < n:
+                    break  # torn tail
+                try:
+                    rec = pickle.loads(CompressionCodec.decompress(blob))
+                except Exception:
+                    break
+                if i >= self._consumed:
+                    out.append(rec)
+                i += 1
+        return out
+
+    def mark_consumed(self, n_more: int) -> None:
+        self._consumed += n_more
+        tmp = self.path + ".consumed.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(str(self._consumed))
+        os.replace(tmp, self.path + ".consumed")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class ReceiverInputDStream(InputDStream):
+    """Receiver-fed input stream (ref: ReceiverInputDStream.scala:41 +
+    ReceiverTracker/ReceiverSupervisor): the receiver thread stores records
+    into the current buffer (each WAL'd first when ``wal_dir`` is set);
+    every interval rotates the buffer into that interval's batch. On
+    construction with an existing WAL, unconsumed records become the first
+    batch — driver-crash recovery without re-asking the source."""
+
+    def __init__(self, ssc: StreamingContext, receiver: Receiver,
+                 wal_dir: Optional[str] = None):
+        super().__init__(ssc)
+        self.receiver = receiver
+        receiver._supervisor = self
+        self._buffer: List[Any] = []
+        self._pending_consume = {}
+        self._buf_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._wal: Optional[WriteAheadLog] = None
+        if wal_dir:
+            self._wal = WriteAheadLog(os.path.join(wal_dir, "received.wal"))
+            recovered = self._wal.recover()
+            if recovered:
+                self._buffer.extend(recovered)
+                logger.info("receiver WAL recovered %d records",
+                            len(recovered))
+
+    def _store(self, record: Any) -> None:
+        with self._buf_lock:
+            if self._wal is not None:
+                self._wal.append(record)  # durable BEFORE visible
+            self._buffer.append(record)
+
+    def start_receiver(self) -> None:
+        if self._thread is None:
+            self.receiver._stopped.clear()  # stop() -> start() restart
+            if self._wal is not None and self._wal._fh.closed:
+                self._wal = WriteAheadLog(self._wal.path)
+            self._thread = threading.Thread(
+                target=self._run_receiver,
+                name=f"cyclone-receiver-{type(self.receiver).__name__}",
+                daemon=True)
+            self._thread.start()
+
+    def _run_receiver(self) -> None:
+        try:
+            self.receiver.on_start()
+        except Exception:
+            logger.exception("receiver failed")
+
+    def stop_receiver(self) -> None:
+        self.receiver._stopped.set()
+        try:
+            self.receiver.on_stop()
+        except Exception:
+            logger.exception("receiver on_stop failed")
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._wal is not None:
+            self._wal.close()
+
+    def compute_batch(self, t: int) -> None:
+        with self._buf_lock:
+            batch, self._buffer = self._buffer, []
+        self._batches[t] = batch
+        if self._wal is not None and batch:
+            # consumed-marking is DEFERRED to post_interval: marking here
+            # (before the interval's output actions run) would let a crash
+            # mid-processing lose the records the WAL exists to protect
+            self._pending_consume[t] = len(batch)
+
+    _pending_consume: Dict[int, int]
+
+    def post_interval(self, t: int) -> None:
+        n = self._pending_consume.pop(t, 0)
+        if self._wal is not None and n:
+            self._wal.mark_consumed(n)
+
+
+class SocketReceiver(Receiver):
+    """The classic socketTextStream receiver (ref: SocketReceiver in
+    SocketInputDStream.scala:58): lines from a TCP connection."""
+
+    def __init__(self, host: str, port: int):
+        super().__init__()
+        self.host, self.port = host, port
+
+    def on_start(self) -> None:
+        import socket
+        self._sock = socket.create_connection((self.host, self.port))
+        try:
+            fh = self._sock.makefile("r", encoding="utf-8", errors="replace")
+            for line in fh:
+                if self.is_stopped():
+                    return
+                line = line.rstrip("\n")
+                if line:
+                    self.store(line)
+        except OSError:
+            pass  # on_stop closed the socket to unblock this read
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def on_stop(self) -> None:
+        sock = getattr(self, "_sock", None)
+        if sock is not None:
+            try:
+                sock.close()  # unblocks the blocking readline
+            except OSError:
+                pass

@@ -244,6 +244,108 @@ class MicroBatchExecution:
         self.watermark = new_wm
 
 
+class ContinuousExecution(MicroBatchExecution):
+    """Continuous processing (ref: continuous/ContinuousExecution.scala:42).
+
+    Rows are processed AS THEY ARRIVE — the driver loop polls sources at
+    sub-epoch cadence and pushes every new delta straight through the
+    (stateless) plan to the sink — while offsets/commits are logged once
+    per EPOCH (``epoch_interval`` seconds), the reference's epoch-marker
+    model. Recovery restarts from the last committed epoch, so rows
+    processed after it are emitted again: **at-least-once**, exactly the
+    reference's continuous-mode guarantee (its micro-batch mode is the
+    exactly-once one, here too). Stateless append-mode plans only, as the
+    reference restricts (no aggregations/dedup/joins/watermarks).
+    """
+
+    def __init__(self, plan: LogicalPlan, sink: Sink, mode: str,
+                 checkpoint_dir: str, session=None,
+                 epoch_interval: float = 1.0):
+        super().__init__(plan, sink, mode, checkpoint_dir, session)
+        if self.stateful_op is not None or self.watermarks:
+            raise ValueError(
+                "continuous processing supports stateless queries only "
+                "(ref: UnsupportedOperationChecker continuous checks)")
+        if mode != "append":
+            raise ValueError("continuous processing requires append mode")
+        self.epoch_interval = float(epoch_interval)
+        # a logged-but-uncommitted epoch is NOT replayed exactly; the rows
+        # since the previous epoch re-emit (at-least-once)
+        self._pending = None
+        self._last_epoch_time = time.monotonic()
+        self._epoch_start_offsets = dict(self._committed_offsets)
+        # sinks deduplicate on batch id (the micro-batch exactly-once
+        # contract); each DELTA inside an epoch therefore needs its own id:
+        # epoch_id * 2^20 + seq. After a restart the epoch id advances, so
+        # re-emitted rows carry fresh ids — duplicates allowed, loss not
+        # (at-least-once).
+        self._delta_seq = 0
+
+    def _construct_next_batch_locked(self) -> bool:
+        ends = {s.name: s.source.latest_offset() for s in self.scans}
+        has_data = any(ends[n] > self._committed_offsets.get(n, 0)
+                       for n in ends)
+        if has_data:
+            self._run_delta(ends)
+        now = time.monotonic()
+        if (now - self._last_epoch_time >= self.epoch_interval
+                and self._committed_offsets != self._epoch_start_offsets):
+            self._commit_epoch()
+            self._last_epoch_time = now
+        return has_data
+
+    def _run_delta(self, ends: Dict[str, int]) -> None:
+        t0 = time.perf_counter()
+        n_in = 0
+        for s in self.scans:
+            start = self._committed_offsets.get(s.name, 0)
+            s.current = s.source.get_batch(start, ends[s.name])
+            n_in += len(next(iter(s.current.values()))) if s.current else 0
+        out = self.plan.execute()
+        self.sink.add_batch(self.batch_id * (1 << 20) + self._delta_seq,
+                            out, self.mode)
+        self._delta_seq += 1
+        for s in self.scans:
+            s.current = None
+        self._committed_offsets = dict(ends)
+        self.last_progress = {
+            "batchId": self.batch_id,
+            "numInputRows": int(n_in),
+            "durationMs": int((time.perf_counter() - t0) * 1000),
+            "watermark": None,
+            "stateRows": 0,
+        }
+
+    def _commit_epoch(self) -> None:
+        """Write the epoch marker: one offset+commit log entry covering
+        everything processed since the previous epoch."""
+        entry = {"offsets": dict(self._committed_offsets), "watermark": None}
+        # a crash between a previous epoch's offset and commit writes leaves
+        # a stale offset entry at this id; MetadataLog.add refuses to
+        # overwrite, so advance to a fresh id rather than letting the next
+        # commit vouch for the stale offsets
+        while not self.offset_log.add(self.batch_id, entry):
+            self.batch_id += 1
+        self.commit_log.add(self.batch_id, {"watermark": None})
+        for s in self.scans:
+            s.source.commit(self._committed_offsets[s.name])
+        self._epoch_start_offsets = dict(self._committed_offsets)
+        self.batch_id += 1
+        self._delta_seq = 0
+        if self.batch_id % 20 == 0:
+            # the micro-batch purge lives in _run_batch, which this path
+            # bypasses — a 1 s epoch would otherwise grow the checkpoint by
+            # ~172k files/day
+            self.offset_log.purge(keep_last=100)
+            self.commit_log.purge(keep_last=100)
+
+    def finalize(self) -> None:
+        """Flush a final epoch on clean shutdown."""
+        with self._batch_lock:
+            if self._committed_offsets != self._epoch_start_offsets:
+                self._commit_epoch()
+
+
 class StreamingQuery:
     """User handle (ref: StreamingQuery.scala / StreamingQueryManager)."""
 
@@ -259,7 +361,14 @@ class StreamingQuery:
         self._stop_evt = threading.Event()
         self.recent_progress: List[Dict[str, Any]] = []
 
-        if "processingTime" in trigger:
+        if "continuous" in trigger:
+            # sub-epoch polling: rows flow as they arrive, epochs commit on
+            # the engine's own clock
+            self._thread = threading.Thread(
+                target=self._continuous_loop,
+                name=f"stream-{self.name}", daemon=True)
+            self._thread.start()
+        elif "processingTime" in trigger:
             self._thread = threading.Thread(
                 target=self._loop, name=f"stream-{self.name}", daemon=True)
             self._thread.start()
@@ -297,10 +406,25 @@ class StreamingQuery:
                 self._active = False
                 return
 
+    def _continuous_loop(self) -> None:
+        poll = min(0.005, float(self._trigger["continuous"]) / 10.0)
+        while not self._stop_evt.wait(poll):
+            try:
+                self._record(self._exec.construct_next_batch())
+            except Exception as e:
+                self._exception = e
+                self._active = False
+                return
+
     def stop(self) -> None:
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if hasattr(self._exec, "finalize"):
+            try:
+                self._exec.finalize()  # continuous mode: flush final epoch
+            except Exception:
+                pass
         self._active = False
 
     def await_termination(self, timeout: Optional[float] = None) -> bool:
@@ -406,8 +530,12 @@ class DataStreamWriter:
         return self
 
     def trigger(self, once: bool = False, available_now: bool = False,
-                processing_time: Optional[float] = None) -> "DataStreamWriter":
-        if processing_time is not None:
+                processing_time: Optional[float] = None,
+                continuous: Optional[float] = None) -> "DataStreamWriter":
+        if continuous is not None:
+            # (ref Trigger.Continuous) — epoch checkpoint interval in seconds
+            self._trigger = {"continuous": float(continuous)}
+        elif processing_time is not None:
             self._trigger = {"processingTime": processing_time}
         elif once:
             self._trigger = {"once": True}
@@ -435,8 +563,13 @@ class DataStreamWriter:
         else:
             raise ValueError(f"unknown sink format {self._format!r}")
         self.sink = sink
-        execution = MicroBatchExecution(self._df.plan, sink, self._mode,
-                                        ckpt, session)
+        if "continuous" in self._trigger:
+            execution: MicroBatchExecution = ContinuousExecution(
+                self._df.plan, sink, self._mode, ckpt, session,
+                epoch_interval=float(self._trigger["continuous"]))
+        else:
+            execution = MicroBatchExecution(self._df.plan, sink, self._mode,
+                                            ckpt, session)
         q = StreamingQuery(execution, dict(self._trigger), self._name)
         q.sink = sink
         if self._format == "memory" and session is not None and self._name:
