@@ -30,15 +30,10 @@ from cycloneml_tpu.util.logging import get_logger
 logger = get_logger(__name__)
 
 
-def rows_to_ell(rows, n_features: Optional[int] = None,
-                k_max: Optional[int] = None
-                ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Convert [(indices, values)] rows (or SparseVectors) to ELL arrays.
-
-    Returns (indices (n, k_max) int32, values (n, k_max) f32, n_features).
-    Rows longer than ``k_max`` raise — truncation would silently corrupt
-    gradients.
-    """
+def _rows_to_pairs(rows, n_features: Optional[int] = None):
+    """Normalize [(indices, values)] rows / SparseVectors to array pairs,
+    inferring the feature dimension — the ONE row parser shared by the
+    pure-ELL and hybrid builders."""
     pairs = []
     d = n_features or 0
     for r in rows:
@@ -50,6 +45,20 @@ def rows_to_ell(rows, n_features: Optional[int] = None,
         if idx.size:
             d = max(d, int(idx.max()) + 1)
         pairs.append((idx, val))
+    return pairs, d
+
+
+def rows_to_ell(rows, n_features: Optional[int] = None,
+                k_max: Optional[int] = None
+                ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Convert [(indices, values)] rows (or SparseVectors) to ELL arrays.
+
+    Returns (indices (n, k_max) int32, values (n, k_max) f32, n_features).
+    Rows longer than ``k_max`` raise — truncation would silently corrupt
+    gradients (use ``SparseInstanceDataset.from_rows_hybrid`` for
+    arbitrary row lengths).
+    """
+    pairs, d = _rows_to_pairs(rows, n_features)
     k = max((p[0].size for p in pairs), default=1)
     if k_max is not None:
         if k > k_max:
@@ -95,10 +104,19 @@ def hash_features(indices: np.ndarray, values: np.ndarray,
 class SparseInstanceDataset:
     """Row-sharded ELL blocks on the mesh: indices/values (n_pad, k), y/w
     (n_pad,), padding rows carrying w=0 (the same neutrality invariant as
-    the dense tier)."""
+    the dense tier).
+
+    Optionally HYBRID (ELL + COO): rows wider than the ELL width keep their
+    first k slots in ELL and spill the excess into per-shard COO arrays
+    (local row id, column, value) — the standard ELL+COO sparse format.
+    Margins then add a per-row segment-sum of the COO tail to the ELL
+    gather, so arbitrary row lengths (tf-idf text, power-law graphs) work
+    without feature hashing and without widening every row to the longest
+    one (which is what pure ELL would cost).
+    """
 
     def __init__(self, ctx, indices, values, y, w, n_rows: int,
-                 n_features: int):
+                 n_features: int, coo_row=None, coo_idx=None, coo_val=None):
         self.ctx = ctx
         self.indices = indices
         self.values = values
@@ -106,6 +124,16 @@ class SparseInstanceDataset:
         self.w = w
         self.n_rows = n_rows
         self.n_features = n_features
+        # hybrid overflow tail (all three set, or none): row ids are LOCAL
+        # to the shard, so each shard's COO slice aggregates into its own
+        # row block
+        self.coo_row = coo_row
+        self.coo_idx = coo_idx
+        self.coo_val = coo_val
+
+    @property
+    def is_hybrid(self) -> bool:
+        return self.coo_row is not None
 
     @classmethod
     def from_ell(cls, ctx, indices: np.ndarray, values: np.ndarray,
@@ -256,6 +284,63 @@ class SparseInstanceDataset:
                    global_arrays[2], global_arrays[3], n_true, d)
 
     @classmethod
+    def from_rows_hybrid(cls, ctx, rows, y=None, w=None,
+                         n_features: Optional[int] = None,
+                         k_ell: int = 16) -> "SparseInstanceDataset":
+        """Build the ELL+COO hybrid: each row's first ``k_ell`` nonzeros go
+        to ELL, the excess to a per-shard COO tail with SHARD-LOCAL row ids
+        (entries must land on the shard that owns their row). COO slices
+        pad to a uniform per-shard length with (row 0, col 0, val 0.0)
+        entries — value 0 keeps them exactly neutral."""
+        from cycloneml_tpu.dataset.instance import blockify_arrays
+        rt = ctx.mesh_runtime
+        shards = rt.data_parallelism
+
+        pairs, d = _rows_to_pairs(rows, n_features)
+        n = len(pairs)
+        k = max(1, min(k_ell, max((p[0].size for p in pairs), default=1)))
+
+        # pad row count exactly like the dense tier so shard row blocks line
+        # up with blockify's layout for y/w
+        _, y_p, w_p, n_true = blockify_arrays(
+            np.zeros((n, 1)), y, w, shards, dtype=np.float32)
+        n_pad = len(y_p)
+        rows_per_shard = n_pad // shards
+
+        indices = np.zeros((n_pad, k), dtype=np.int32)
+        values = np.zeros((n_pad, k), dtype=np.float32)
+        per_shard_coo: list = [[] for _ in range(shards)]
+        for i, (idx, val) in enumerate(pairs):
+            m = min(idx.size, k)
+            indices[i, :m] = idx[:m]
+            values[i, :m] = val[:m]
+            if idx.size > k:
+                shard, local = divmod(i, rows_per_shard)
+                for j in range(k, idx.size):
+                    per_shard_coo[shard].append(
+                        (local, int(idx[j]), float(val[j])))
+        tail = max((len(c) for c in per_shard_coo), default=0)
+        tail = max(tail, 1)
+        coo_row = np.zeros((shards * tail,), dtype=np.int32)
+        coo_idx = np.zeros((shards * tail,), dtype=np.int32)
+        coo_val = np.zeros((shards * tail,), dtype=np.float32)
+        for s, entries in enumerate(per_shard_coo):
+            for j, (lr, ci, cv) in enumerate(entries):
+                coo_row[s * tail + j] = lr
+                coo_idx[s * tail + j] = ci
+                coo_val[s * tail + j] = cv
+
+        return cls(ctx,
+                   rt.device_put_sharded_rows(indices),
+                   rt.device_put_sharded_rows(values),
+                   rt.device_put_sharded_rows(y_p.astype(np.float32)),
+                   rt.device_put_sharded_rows(w_p.astype(np.float32)),
+                   n_true, d,
+                   coo_row=rt.device_put_sharded_rows(coo_row),
+                   coo_idx=rt.device_put_sharded_rows(coo_idx),
+                   coo_val=rt.device_put_sharded_rows(coo_val))
+
+    @classmethod
     def from_scipy(cls, ctx, csr, y=None, w=None,
                    hash_dim: Optional[int] = None) -> "SparseInstanceDataset":
         """From a scipy.sparse CSR matrix."""
@@ -275,20 +360,25 @@ class SparseInstanceDataset:
         return self.indices.shape[1]
 
     def tree_aggregate_fn(self, fn: Callable, auto_psum: bool = True):
-        """Compile ``fn(idx_shard, val_shard, y_shard, w_shard, *extras)``
-        into a mesh-wide psum aggregation — the sparse twin of
-        ``InstanceDataset.tree_aggregate_fn``."""
+        """Compile ``fn(idx_shard, val_shard, [coo_row, coo_idx, coo_val,]
+        y_shard, w_shard, *extras)`` into a mesh-wide psum aggregation —
+        the sparse twin of ``InstanceDataset.tree_aggregate_fn``. Hybrid
+        datasets pass their COO tail as three extra row-sharded arrays
+        (use the ``*_hybrid`` aggregators)."""
         rt = self.ctx.mesh_runtime
-        compiled = collectives.tree_aggregate(
-            fn, rt, self.indices, self.values, self.y, self.w,
-            auto_psum=auto_psum)
-        ds = self
+        if self.is_hybrid:
+            arrays = (self.indices, self.values, self.coo_row,
+                      self.coo_idx, self.coo_val, self.y, self.w)
+        else:
+            arrays = (self.indices, self.values, self.y, self.w)
+        compiled = collectives.tree_aggregate(fn, rt, *arrays,
+                                              auto_psum=auto_psum)
 
         def call(*extras):
-            return compiled(ds.indices, ds.values, ds.y, ds.w, *extras)
+            return compiled(*arrays, *extras)
 
         call.compiled = compiled
-        call.arrays = lambda: (ds.indices, ds.values, ds.y, ds.w)
+        call.arrays = lambda: arrays
         return call
 
     def to_dense(self) -> np.ndarray:
@@ -300,12 +390,25 @@ class SparseInstanceDataset:
         with EXPLICIT zero row weights will drop those rows here too.)
         """
         mask = np.asarray(self.w) > 0
-        idx = np.asarray(self.indices)[mask]
-        val = np.asarray(self.values)[mask]
-        out = np.zeros((idx.shape[0], self.n_features))
+        idx = np.asarray(self.indices)
+        val = np.asarray(self.values)
+        full = np.zeros((idx.shape[0], self.n_features))
         for i in range(idx.shape[0]):
-            np.add.at(out[i], idx[i], val[i])
-        return out
+            np.add.at(full[i], idx[i], val[i])
+        if self.is_hybrid:
+            rt = self.ctx.mesh_runtime
+            shards = rt.data_parallelism
+            rows_per_shard = idx.shape[0] // shards
+            crow = np.asarray(self.coo_row)
+            cidx = np.asarray(self.coo_idx)
+            cval = np.asarray(self.coo_val)
+            per_shard = len(crow) // shards
+            for s in range(shards):
+                sl = slice(s * per_shard, (s + 1) * per_shard)
+                np.add.at(full,
+                          (s * rows_per_shard + crow[sl], cidx[sl]),
+                          cval[sl])
+        return full[mask]
 
 
 def read_libsvm_sparse(ctx, path: str, n_features: Optional[int] = None,

@@ -125,3 +125,63 @@ def sparse_summary(d: int) -> Agg:
                 "count": jnp.sum((w > 0).astype(jnp.float32))}
 
     return agg
+
+
+# -- hybrid (ELL + COO overflow) aggregators ------------------------------------
+# Rows wider than the ELL width carry a COO tail (shard-local row ids);
+# margins add a per-row segment-sum of the tail to the ELL gather, and
+# gradients scatter the tail's contributions by column. Padding COO entries
+# are (row 0, col 0, val 0.0) — exactly neutral in both directions.
+
+def _margins_hybrid(indices, values, coo_row, coo_idx, coo_val, beta, b0):
+    base = _margins(indices, values, beta, b0)
+    tail = jax.ops.segment_sum(coo_val * jnp.take(beta, coo_idx, axis=0),
+                               coo_row.astype(jnp.int32),
+                               num_segments=indices.shape[0])
+    return base + tail
+
+
+def _scatter_grad_hybrid(indices, values, coo_row, coo_idx, coo_val,
+                         mult, d):
+    g = _scatter_grad(indices, values, mult, d)
+    return g + jax.ops.segment_sum(mult[coo_row] * coo_val,
+                                   coo_idx.astype(jnp.int32),
+                                   num_segments=d)
+
+
+@functools.lru_cache(maxsize=None)
+def binary_logistic_sparse_hybrid(d: int, fit_intercept: bool = True) -> Agg:
+    """Hybrid twin of :func:`binary_logistic_sparse`."""
+
+    def agg(indices, values, coo_row, coo_idx, coo_val, y, w, coef):
+        beta, b0 = _split(coef, d, fit_intercept)
+        margin = _margins_hybrid(indices, values, coo_row, coo_idx, coo_val,
+                                 beta, b0)
+        loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
+        mult = w * (jax.nn.sigmoid(margin) - y)
+        g = _scatter_grad_hybrid(indices, values, coo_row, coo_idx, coo_val,
+                                 mult, d)
+        grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
+                if fit_intercept else g)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+@functools.lru_cache(maxsize=None)
+def least_squares_sparse_hybrid(d: int, fit_intercept: bool = True) -> Agg:
+    """Hybrid twin of :func:`least_squares_sparse`."""
+
+    def agg(indices, values, coo_row, coo_idx, coo_val, y, w, coef):
+        beta, b0 = _split(coef, d, fit_intercept)
+        err = _margins_hybrid(indices, values, coo_row, coo_idx, coo_val,
+                              beta, b0) - y
+        loss = 0.5 * jnp.sum(w * err * err)
+        mult = w * err
+        g = _scatter_grad_hybrid(indices, values, coo_row, coo_idx, coo_val,
+                                 mult, d)
+        grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
+                if fit_intercept else g)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg

@@ -363,11 +363,17 @@ class Receiver:
 
 
 class WriteAheadLog:
-    """Record-level WAL (ref: streaming/util/FileBasedWriteAheadLog.scala:55
-    via ReceivedBlockTracker): every stored record is appended — compressed
-    with the native codec — BEFORE it becomes visible to batch generation,
-    so a crashed driver replays unconsumed records on restart. ``clean``
-    truncates entries already folded into processed batches."""
+    """Record WAL (ref: streaming/util/FileBasedWriteAheadLog.scala:55 via
+    ReceivedBlockTracker): stored records append (compressed with the
+    native codec, flushed per record, **fsynced at block boundaries** —
+    the reference also logs at block granularity) so a crashed driver
+    replays unconsumed records on restart. On open, a torn tail from a
+    crash mid-append is TRUNCATED before new appends (appending after
+    garbage would strand everything written later). ``mark_consumed``
+    advances a durable prefix counter; once consumption passes a
+    threshold the log compacts to just the live suffix."""
+
+    COMPACT_MIN = 4096
 
     def __init__(self, path: str):
         import struct as _struct
@@ -376,12 +382,40 @@ class WriteAheadLog:
         self._codec = CompressionCodec()
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._consumed = 0  # records already folded into batches
+        self._consumed = 0  # records already folded into processed batches
         marker = path + ".consumed"
         if os.path.exists(marker):
             with open(marker, encoding="utf-8") as fh:
                 self._consumed = int(fh.read().strip() or 0)
+        self._count, valid_bytes = self._scan()
+        if os.path.exists(path):
+            with open(path, "r+b") as fh:
+                fh.truncate(valid_bytes)  # drop any torn tail BEFORE append
         self._fh = open(path, "ab")
+
+    def _scan(self):
+        """(record count, byte offset of the last valid record boundary)."""
+        import pickle
+        from cycloneml_tpu.native.host import CompressionCodec
+        count, pos = 0, 0
+        if not os.path.exists(self.path):
+            return 0, 0
+        with open(self.path, "rb") as fh:
+            while True:
+                hdr = fh.read(4)
+                if len(hdr) < 4:
+                    break
+                (n,) = self._struct.unpack("<I", hdr)
+                blob = fh.read(n)
+                if len(blob) < n:
+                    break
+                try:
+                    pickle.loads(CompressionCodec.decompress(blob))
+                except Exception:
+                    break
+                count += 1
+                pos += 4 + n
+        return count, pos
 
     def append(self, record: Any) -> None:
         import pickle
@@ -389,6 +423,12 @@ class WriteAheadLog:
             pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL))
         self._fh.write(self._struct.pack("<I", len(blob)))
         self._fh.write(blob)
+        self._fh.flush()  # reaches the OS; fsync happens per block
+        self._count += 1
+
+    def sync(self) -> None:
+        """Durability point: called at block rotation, before the block
+        becomes visible to batch generation."""
         self._fh.flush()
         os.fsync(self._fh.fileno())
 
@@ -425,8 +465,39 @@ class WriteAheadLog:
         with open(tmp, "w", encoding="utf-8") as fh:
             fh.write(str(self._consumed))
         os.replace(tmp, self.path + ".consumed")
+        if (self._consumed >= self.COMPACT_MIN
+                and self._consumed * 2 >= self._count):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the log to just the unconsumed suffix (the 'clean' of
+        FileBasedWriteAheadLog — without it the log grows forever)."""
+        import pickle
+        live = self.recover()
+        self._fh.close()
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as fh:
+            for rec in live:
+                blob = self._codec.compress(
+                    pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
+                fh.write(self._struct.pack("<I", len(blob)))
+                fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        self._consumed = 0
+        self._count = len(live)
+        ctmp = self.path + ".consumed.tmp"
+        with open(ctmp, "w", encoding="utf-8") as fh:
+            fh.write("0")
+        os.replace(ctmp, self.path + ".consumed")
+        self._fh = open(self.path, "ab")
 
     def close(self) -> None:
+        try:
+            self.sync()
+        except (OSError, ValueError):
+            pass
         self._fh.close()
 
 
@@ -444,7 +515,7 @@ class ReceiverInputDStream(InputDStream):
         self.receiver = receiver
         receiver._supervisor = self
         self._buffer: List[Any] = []
-        self._pending_consume = {}
+        self._consume_queue = []
         self._buf_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._wal: Optional[WriteAheadLog] = None
@@ -459,7 +530,9 @@ class ReceiverInputDStream(InputDStream):
     def _store(self, record: Any) -> None:
         with self._buf_lock:
             if self._wal is not None:
-                self._wal.append(record)  # durable BEFORE visible
+                # flushed per record, fsynced at block rotation (the
+                # reference logs at block granularity too)
+                self._wal.append(record)
             self._buffer.append(record)
 
     def start_receiver(self) -> None:
@@ -494,19 +567,30 @@ class ReceiverInputDStream(InputDStream):
     def compute_batch(self, t: int) -> None:
         with self._buf_lock:
             batch, self._buffer = self._buffer, []
+            if self._wal is not None and batch:
+                self._wal.sync()  # block boundary: durable before visible
         self._batches[t] = batch
         if self._wal is not None and batch:
             # consumed-marking is DEFERRED to post_interval: marking here
             # (before the interval's output actions run) would let a crash
             # mid-processing lose the records the WAL exists to protect
-            self._pending_consume[t] = len(batch)
+            self._consume_queue.append([t, len(batch), False])
 
-    _pending_consume: Dict[int, int]
+    # [interval, n_records, outputs_done] in WAL order; consumption is a
+    # PREFIX counter, so an interval whose outputs FAILED must block the
+    # consumption of every later interval — marking out of order would
+    # skip the failed interval's records and lose them on restart
+    _consume_queue: List[list]
 
     def post_interval(self, t: int) -> None:
-        n = self._pending_consume.pop(t, 0)
-        if self._wal is not None and n:
-            self._wal.mark_consumed(n)
+        for entry in self._consume_queue:
+            if entry[0] == t:
+                entry[2] = True
+                break
+        while self._consume_queue and self._consume_queue[0][2]:
+            _, n, _ = self._consume_queue.pop(0)
+            if self._wal is not None:
+                self._wal.mark_consumed(n)
 
 
 class SocketReceiver(Receiver):

@@ -275,11 +275,22 @@ class ContinuousExecution(MicroBatchExecution):
         self._last_epoch_time = time.monotonic()
         self._epoch_start_offsets = dict(self._committed_offsets)
         # sinks deduplicate on batch id (the micro-batch exactly-once
-        # contract); each DELTA inside an epoch therefore needs its own id:
-        # epoch_id * 2^20 + seq. After a restart the epoch id advances, so
-        # re-emitted rows carry fresh ids — duplicates allowed, loss not
-        # (at-least-once).
+        # contract); each DELTA therefore needs an id no other delta — in
+        # THIS run or any previous crashed run — ever used, or a dedup sink
+        # would silently drop re-emitted rows (losing, not duplicating).
+        # A persisted run counter namespaces ids: run * 2^40 + epoch * 2^20
+        # + seq.
         self._delta_seq = 0
+        run_file = os.path.join(checkpoint_dir, "continuous-runs")
+        run_id = 0
+        if os.path.exists(run_file):
+            with open(run_file, encoding="utf-8") as fh:
+                run_id = int(fh.read().strip() or 0) + 1
+        tmp = run_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(str(run_id))
+        os.replace(tmp, run_file)
+        self._run_id = run_id
 
     def _construct_next_batch_locked(self) -> bool:
         ends = {s.name: s.source.latest_offset() for s in self.scans}
@@ -302,7 +313,8 @@ class ContinuousExecution(MicroBatchExecution):
             s.current = s.source.get_batch(start, ends[s.name])
             n_in += len(next(iter(s.current.values()))) if s.current else 0
         out = self.plan.execute()
-        self.sink.add_batch(self.batch_id * (1 << 20) + self._delta_seq,
+        self.sink.add_batch(self._run_id * (1 << 40)
+                            + self.batch_id * (1 << 20) + self._delta_seq,
                             out, self.mode)
         self._delta_seq += 1
         for s in self.scans:

@@ -278,3 +278,108 @@ def test_wal_not_consumed_until_outputs_ran(ctx, tmp_path):
         assert out and out[0][1] == ["r1", "r2"]  # replayed, not lost
     finally:
         ssc2.stop()
+
+
+def test_wal_failed_interval_blocks_later_consumption(ctx, tmp_path):
+    """An interval whose outputs FAILED must not have its records marked
+    consumed by a LATER successful interval (prefix-counter skew)."""
+    wal_dir = str(tmp_path / "wal")
+    ssc = StreamingContext(ctx, batch_duration=10.0)
+    rec = ListReceiver(["a", "b"])
+    stream = ssc.receiver_stream(rec, wal_dir=wal_dir)
+    calls = []
+
+    def flaky_action(batch, t):
+        calls.append((t, list(batch)))
+        if t == 0:
+            raise RuntimeError("first interval crashes")
+
+    ssc._register_output(stream, flaky_action)
+    ssc.start()
+    assert rec.started.wait(5)
+    with pytest.raises(RuntimeError):
+        ssc.run_one_interval()          # t=0 fails: [a, b] unconsumed
+    # receiver produces more; t=1 succeeds
+    rec2_items = ["c"]
+    for it in rec2_items:
+        rec.store(it)
+    ssc.run_one_interval()              # t=1 ok, but t=0 blocks consumption
+    ssc.stop()
+
+    ssc2 = StreamingContext(ctx, batch_duration=10.0)
+    out = []
+    ssc2.receiver_stream(ListReceiver([]), wal_dir=wal_dir).collect_to(out)
+    ssc2.start()
+    try:
+        ssc2.run_one_interval()
+        # ALL records replay — a, b (failed interval) AND c (consumption
+        # was blocked behind the failed prefix)
+        assert out and out[0][1] == ["a", "b", "c"]
+    finally:
+        ssc2.stop()
+
+
+def test_wal_append_after_torn_tail_recoverable(tmp_path):
+    """Reopening a WAL with a torn tail must truncate the garbage so new
+    appends remain reachable by recover()."""
+    wal = WriteAheadLog(str(tmp_path / "w.wal"))
+    wal.append({"n": 1})
+    wal.close()
+    with open(str(tmp_path / "w.wal"), "ab") as fh:
+        fh.write(b"\x60\x00\x00\x00torn")
+    wal2 = WriteAheadLog(str(tmp_path / "w.wal"))
+    wal2.append({"n": 2})   # must land at a valid boundary
+    wal2.close()
+    wal3 = WriteAheadLog(str(tmp_path / "w.wal"))
+    assert [r["n"] for r in wal3.recover()] == [1, 2]
+    wal3.close()
+
+
+def test_wal_compaction_bounds_growth(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "w.wal"))
+    wal.COMPACT_MIN = 8
+    for i in range(20):
+        wal.append(i)
+    wal.sync()
+    wal.mark_consumed(18)   # crosses the threshold: compacts to the suffix
+    assert wal._count == 2 and wal._consumed == 0
+    assert wal.recover() == [18, 19]
+    wal.append(20)
+    assert wal.recover() == [18, 19, 20]
+    wal.close()
+
+
+def test_continuous_restart_reuses_no_sink_ids(tmp_path):
+    """Crash BEFORE the first epoch commit: the restarted run must emit
+    with fresh sink ids (a dedup sink would otherwise drop the re-emitted
+    rows — loss, not duplication)."""
+    ck = str(tmp_path / "ck")
+    s = CycloneSession()
+    src = MemoryStream(["v"])
+    df = src.to_df(s).select(col("v"))
+    q = (df.write_stream.format("memory")
+         .option("checkpointLocation", ck).trigger(continuous=60.0).start())
+    src.add_data(v=np.array([1.0]))
+    deadline = time.time() + 10
+    while not q.sink.rows():
+        assert time.time() < deadline
+        time.sleep(0.01)
+    first_ids = set(getattr(q.sink, "_seen", set()) or [])
+    q._stop_evt.set()          # hard stop: NO final epoch flush (crash-like)
+    q._thread.join(timeout=10)
+
+    s2 = CycloneSession()
+    src2 = MemoryStream(["v"])
+    src2.add_data(v=np.array([1.0]))   # replayed (no epoch was committed)
+    df2 = src2.to_df(s2).select(col("v"))
+    q2 = (df2.write_stream.format("memory")
+          .option("checkpointLocation", ck).trigger(continuous=60.0).start())
+    try:
+        deadline = time.time() + 10
+        while not q2.sink.rows():
+            assert time.time() < deadline, "re-emitted rows were dropped"
+            time.sleep(0.01)
+        assert [r[0] for r in q2.sink.rows()] == [1.0]
+        assert q2._exec._run_id > q._exec._run_id
+    finally:
+        q2.stop()

@@ -297,3 +297,88 @@ def test_sparse_padding_rows_neutral(ctx):
     np.testing.assert_allclose(float(np.asarray(out["grad"])[0]), -7.5,
                                rtol=1e-5)
     assert float(out["count"]) == 3.0
+
+
+# -- hybrid (ELL + COO) tier ----------------------------------------------------
+
+def _random_varlen_sparse(n=240, d=60, seed=0, long_every=17, long_len=40):
+    """Mostly-short rows with occasional very long ones — the tf-idf/power-
+    law shape pure ELL handles badly (width = longest row)."""
+    rng = np.random.RandomState(seed)
+    rows, dense = [], np.zeros((n, d))
+    for i in range(n):
+        nnz = long_len if i % long_every == 0 else rng.randint(1, 6)
+        idx = np.sort(rng.choice(d, size=min(nnz, d), replace=False))
+        val = rng.randn(len(idx))
+        rows.append((idx, val))
+        dense[i, idx] = val
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    w = rng.rand(n) + 0.5
+    return rows, dense, y, w
+
+
+def test_hybrid_to_dense_roundtrip(ctx):
+    rows, dense, y, w = _random_varlen_sparse()
+    ds = SparseInstanceDataset.from_rows_hybrid(ctx, rows, y=y, w=w,
+                                                n_features=60, k_ell=8)
+    assert ds.is_hybrid and ds.k_max == 8
+    np.testing.assert_allclose(ds.to_dense(), dense, rtol=1e-6, atol=1e-7)
+
+
+def test_hybrid_aggregation_matches_dense(ctx):
+    from cycloneml_tpu.ml.optim.sparse_aggregators import (
+        binary_logistic_sparse_hybrid, least_squares_sparse_hybrid)
+    rows, dense, y, w = _random_varlen_sparse(seed=3)
+    d = 60
+    sds = SparseInstanceDataset.from_rows_hybrid(ctx, rows, y=y, w=w,
+                                                 n_features=d, k_ell=8)
+    dds = InstanceDataset.from_numpy(ctx, dense, y, w)
+    coef = np.linspace(-1, 1, d)
+    for hyb, dense_agg in (
+            (binary_logistic_sparse_hybrid(d, False),
+             aggregators.binary_logistic(d, fit_intercept=False)),
+            (least_squares_sparse_hybrid(d, False),
+             aggregators.least_squares(d, fit_intercept=False))):
+        got = sds.tree_aggregate_fn(hyb)(coef)
+        want = dds.tree_aggregate_fn(lambda x, yy, ww, c: dense_agg(x, yy, ww, c))(coef)
+        np.testing.assert_allclose(float(got["loss"]), float(want["loss"]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(got["grad"]),
+                                   np.asarray(want["grad"]),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_hybrid_training_matches_dense(ctx):
+    """Full L-BFGS over the hybrid tier lands on the dense solution —
+    arbitrary row lengths train WITHOUT hashing and without widening ELL
+    to the longest row (the round-1 flagged limitation)."""
+    from cycloneml_tpu.ml.optim.sparse_aggregators import (
+        binary_logistic_sparse_hybrid)
+    rows, dense, y, w = _random_varlen_sparse(seed=5)
+    d = 60
+    sds = SparseInstanceDataset.from_rows_hybrid(ctx, rows, y=y, w=w,
+                                                 n_features=d, k_ell=8)
+    dds = InstanceDataset.from_numpy(ctx, dense, y, w)
+    s = LBFGS(max_iter=40, tol=1e-10).minimize(
+        DistributedLossFunction(
+            sds, binary_logistic_sparse_hybrid(d, fit_intercept=False)),
+        np.zeros(d))
+    de = LBFGS(max_iter=40, tol=1e-10).minimize(
+        DistributedLossFunction(
+            dds, aggregators.binary_logistic(d, fit_intercept=False)),
+        np.zeros(d))
+    assert abs(s.value - de.value) < 1e-6
+    # unregularized near-flat optimum: reduction-order drift between the
+    # hybrid and dense programs leaves a few % on individual coefficients
+    # while the loss agrees to 1e-6 (same caveat as the pure-ELL test)
+    np.testing.assert_allclose(s.x, de.x, rtol=5e-2, atol=1e-3)
+
+
+def test_hybrid_all_short_rows_has_trivial_tail(ctx):
+    """No row exceeds k_ell: the COO tail is a single neutral pad entry per
+    shard and results still match from_rows exactly."""
+    rows, dense, y, w = _random_sparse(n=120, d=20, k=4, seed=9)
+    hyb = SparseInstanceDataset.from_rows_hybrid(ctx, rows, y=y, w=w,
+                                                 n_features=20, k_ell=8)
+    ref = SparseInstanceDataset.from_rows(ctx, rows, y=y, w=w, n_features=20)
+    np.testing.assert_allclose(hyb.to_dense(), ref.to_dense(), rtol=1e-6)
