@@ -99,6 +99,32 @@ def logistic_regression_to_pmml(model) -> str:
     return ET.tostring(root, encoding="unicode")
 
 
+def linear_svc_to_pmml(model) -> str:
+    """(ref BinaryClassificationPMMLModelExport.scala with
+    NormalizationMethod.NONE and the model threshold, as the factory builds
+    for SVMModel at PMMLModelExportFactory.scala:45-48)"""
+    coef = np.asarray(model.coefficients)
+    root = _root("linear SVM")
+    names = _data_dictionary(root, coef.shape[0], target="target",
+                             categorical_target=True)
+    rm = ET.SubElement(root, "RegressionModel",
+                       {"modelName": "linear SVM",
+                        "functionName": "classification",
+                        "normalizationMethod": "none"})
+    _mining_schema(rm, names, "target")
+    _regression_table(rm, names, coef, model.intercept, target_category="1")
+    # category-0 table carries the decision threshold as its intercept,
+    # exactly the reference's thresholdTable
+    threshold = 0.0
+    try:
+        threshold = float(model.get("threshold"))
+    except Exception:
+        pass
+    _regression_table(rm, names, np.zeros_like(coef), threshold,
+                      target_category="0")
+    return ET.tostring(root, encoding="unicode")
+
+
 def kmeans_to_pmml(model) -> str:
     """(ref KMeansPMMLModelExport.scala — ClusteringModel with squared
     euclidean compare function)"""
@@ -133,9 +159,13 @@ def to_pmml(model, path: Optional[str] = None) -> str:
         xml = logistic_regression_to_pmml(model)
     elif name == "KMeansModel":
         xml = kmeans_to_pmml(model)
+    elif name == "LinearSVCModel":
+        xml = linear_svc_to_pmml(model)
     else:
         raise TypeError(f"PMML export not supported for {name} "
-                        "(reference covers GLM/logistic/k-means)")
+                        "(reference covers GLM/ridge/lasso — all "
+                        "LinearRegressionModel here — logistic, linear "
+                        "SVM, and k-means)")
     if path is not None:
         with open(path, "w", encoding="utf-8") as fh:
             fh.write(xml)

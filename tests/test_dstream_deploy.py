@@ -389,3 +389,21 @@ def test_pmml_logistic_and_kmeans(tmp_path):
 
     with pytest.raises(TypeError, match="not supported"):
         to_pmml(object())
+
+
+def test_pmml_linear_svc():
+    from cycloneml_tpu.ml.classification.linear_svc import LinearSVCModel
+    from cycloneml_tpu.ml.pmml import to_pmml
+    m = LinearSVCModel(coefficients=np.array([0.4, -1.2]), intercept=0.2)
+    rm = ET.fromstring(_strip_ns(to_pmml(m))).find("RegressionModel")
+    assert rm.get("modelName") == "linear SVM"
+    assert rm.get("normalizationMethod") == "none"
+    tables = rm.findall("RegressionTable")
+    assert len(tables) == 2
+    by_cat = {t.get("targetCategory"): t for t in tables}
+    assert float(by_cat["1"].get("intercept")) == 0.2
+    coefs = [float(p.get("coefficient"))
+             for p in by_cat["1"].findall("NumericPredictor")]
+    assert coefs == [0.4, -1.2]
+    # category-0 table carries the decision threshold (ref thresholdTable)
+    assert float(by_cat["0"].get("intercept")) == 0.0
