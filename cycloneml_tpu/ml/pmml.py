@@ -5,7 +5,9 @@ spark/mllib/pmml/PMMLExportable.scala + pmml/export/
 {GeneralizedLinearPMMLModelExport, LogisticRegressionPMMLModelExport,
 KMeansPMMLModelExport}.scala — built on JPMML there; a direct PMML 4.2 XML
 writer here, same document structure). Covered model families match the
-reference's: linear regression, binary logistic regression, and k-means.
+reference's factory (PMMLModelExportFactory.scala:35): linear regression
+(incl. the ridge/lasso parameterizations), binary logistic regression,
+linear SVM, and k-means.
 """
 
 from __future__ import annotations
@@ -81,48 +83,46 @@ def linear_regression_to_pmml(model) -> str:
     return ET.tostring(root, encoding="unicode")
 
 
-def logistic_regression_to_pmml(model) -> str:
-    """(ref LogisticRegressionPMMLModelExport.scala — binary only, with the
-    softmax normalization and a zero-coefficient table for category 0)"""
+def _binary_classification_pmml(model, name: str, norm_method: str,
+                                category0_intercept: float) -> str:
+    """The shared two-table binary exporter (ref:
+    BinaryClassificationPMMLModelExport.scala — the reference uses ONE
+    class parameterized exactly like this for logistic and SVM)."""
     coef = np.asarray(model.coefficients)
-    root = _root("logistic regression")
+    root = _root(name)
     names = _data_dictionary(root, coef.shape[0], target="target",
                              categorical_target=True)
     rm = ET.SubElement(root, "RegressionModel",
-                       {"modelName": "logistic regression",
+                       {"modelName": name,
                         "functionName": "classification",
-                        "normalizationMethod": "logit"})
+                        "normalizationMethod": norm_method})
     _mining_schema(rm, names, "target")
     _regression_table(rm, names, coef, model.intercept, target_category="1")
-    _regression_table(rm, names, np.zeros_like(coef), 0.0,
+    # the category-0 table carries the decision threshold as its intercept
+    # (the reference's thresholdTable; 0.0 for logistic)
+    _regression_table(rm, names, np.zeros_like(coef), category0_intercept,
                       target_category="0")
     return ET.tostring(root, encoding="unicode")
+
+
+def logistic_regression_to_pmml(model) -> str:
+    """(ref factory case at PMMLModelExportFactory.scala:49-53: binary only,
+    logit normalization; the category-0 intercept encodes the decision
+    threshold in margin space, -log(1/t - 1) — 0.0 at the default 0.5)"""
+    try:
+        t = float(model.get("threshold"))
+    except KeyError:
+        t = 0.5
+    t = min(max(t, 1e-12), 1 - 1e-12)
+    return _binary_classification_pmml(model, "logistic regression",
+                                       "logit", -float(np.log(1.0 / t - 1.0)))
 
 
 def linear_svc_to_pmml(model) -> str:
-    """(ref BinaryClassificationPMMLModelExport.scala with
-    NormalizationMethod.NONE and the model threshold, as the factory builds
-    for SVMModel at PMMLModelExportFactory.scala:45-48)"""
-    coef = np.asarray(model.coefficients)
-    root = _root("linear SVM")
-    names = _data_dictionary(root, coef.shape[0], target="target",
-                             categorical_target=True)
-    rm = ET.SubElement(root, "RegressionModel",
-                       {"modelName": "linear SVM",
-                        "functionName": "classification",
-                        "normalizationMethod": "none"})
-    _mining_schema(rm, names, "target")
-    _regression_table(rm, names, coef, model.intercept, target_category="1")
-    # category-0 table carries the decision threshold as its intercept,
-    # exactly the reference's thresholdTable
-    threshold = 0.0
-    try:
-        threshold = float(model.get("threshold"))
-    except Exception:
-        pass
-    _regression_table(rm, names, np.zeros_like(coef), threshold,
-                      target_category="0")
-    return ET.tostring(root, encoding="unicode")
+    """(ref factory case at PMMLModelExportFactory.scala:45-48:
+    NormalizationMethod.NONE with the model threshold)"""
+    return _binary_classification_pmml(model, "linear SVM", "none",
+                                       float(model.get("threshold")))
 
 
 def kmeans_to_pmml(model) -> str:

@@ -7,6 +7,14 @@ vectorized Parquet reader maps to pyarrow (Arrow IS the reference's columnar
 interchange, SURVEY §2.6) feeding numpy columns zero-copy where dtypes allow;
 JSON is line-delimited records like the reference's default. Save modes
 follow the reference: error (default) / overwrite / append / ignore.
+
+Hive-style partitioning both ways (ref: datasources/PartitioningUtils.scala
+parsePartitions + DataFrameWriter.partitionBy): reading a directory tree of
+``key=value`` subdirectories reconstructs the partition columns with the
+reference's type inference (int, then float, else string;
+``__HIVE_DEFAULT_PARTITION__`` → null), and ``partition_by`` writes one
+subdirectory per distinct key tuple with the partition columns dropped from
+the data files.
 """
 
 from __future__ import annotations
@@ -19,6 +27,100 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from cycloneml_tpu.sql.plan import Batch
+
+
+_HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _parse_partition_value(raw: str):
+    """(ref PartitioningUtils.inferPartitionColumnValue): int → float →
+    string; the Hive null marker → None."""
+    from urllib.parse import unquote
+    raw = unquote(raw)
+    if raw == _HIVE_NULL:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+def discover_partitions(path: str):
+    """Walk a Hive-partitioned directory tree. Returns
+    ``[(file, {col: value})]`` (empty partition dict for a flat layout) —
+    ref PartitioningUtils.parsePartitions."""
+    out = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        rel = os.path.relpath(root, path)
+        parts: Dict[str, object] = {}
+        ok = True
+        if rel != ".":
+            for seg in rel.split(os.sep):
+                if "=" not in seg:
+                    ok = False
+                    break
+                k, _, v = seg.partition("=")
+                parts[k] = _parse_partition_value(v)
+        if not ok:
+            continue
+        for f in sorted(files):
+            if not f.startswith(("_", ".")):
+                out.append((os.path.join(root, f), parts))
+    return out
+
+
+def _read_partitioned(path: str, read_one) -> Optional[Batch]:
+    """Partition-aware directory read: None when ``path`` is not a
+    partitioned dir (caller falls back to the flat path)."""
+    if not os.path.isdir(path):
+        return None
+    entries = discover_partitions(path)
+    if not entries or not any(parts for _, parts in entries):
+        return None
+    batches: List[Batch] = []
+    part_cols: List[str] = []
+    for _, parts in entries:
+        for k in parts:
+            if k not in part_cols:
+                part_cols.append(k)
+    # a null partition's representation follows the column's OTHER values:
+    # string columns carry object None, numeric ones NaN
+    col_is_str = {k: any(isinstance(parts.get(k), str)
+                         for _, parts in entries)
+                  for k in part_cols}
+    for f, parts in entries:
+        b = read_one(f)
+        n = len(next(iter(b.values()))) if b else 0
+        for k in part_cols:
+            v = parts.get(k)
+            if v is None:
+                b[k] = (np.array([None] * n, dtype=object)
+                        if col_is_str[k] else np.full(n, np.nan))
+            elif isinstance(v, str):
+                b[k] = np.array([v] * n, dtype=object)
+            else:
+                b[k] = np.full(n, v)
+        batches.append(b)
+    from cycloneml_tpu.sql.plan import _concat
+    names: List[str] = []
+    for b in batches:
+        for k in b:
+            if k not in names:
+                names.append(k)
+    # ragged schemas (a data column present in only some files) fill with
+    # nulls, exactly like the flat JSON reader's per-record union
+    for b in batches:
+        n = len(next(iter(b.values()))) if b else 0
+        for k in names:
+            if k not in b:
+                b[k] = np.array([None] * n, dtype=object)
+    return {k: _concat([np.asarray(b[k]) for b in batches])
+            for k in names}
 
 
 def _expand(path: str) -> List[str]:
@@ -35,15 +137,27 @@ def _expand(path: str) -> List[str]:
 
 
 def read_parquet(path: str) -> Batch:
+    partitioned = _read_partitioned(path, _read_parquet_file)
+    if partitioned is not None:
+        return partitioned
+    from cycloneml_tpu.sql.plan import _concat
+    files = [p for p in _expand(path) if os.path.exists(p)]
+    if not files:
+        return {}  # e.g. an empty partitioned dataset's bare directory
+    batches = [_read_parquet_file(p) for p in files]
+    if len(batches) == 1:
+        return batches[0]
+    return {k: _concat([np.asarray(b[k]) for b in batches])
+            for k in batches[0]}
+
+
+def _read_parquet_file(path: str) -> Batch:
     import pyarrow.parquet as pq
-    tables = [pq.read_table(p) for p in _expand(path)]
-    import pyarrow as pa
-    table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    table = pq.read_table(path)
     out: Batch = {}
     for name in table.column_names:
         col = table.column(name).to_numpy(zero_copy_only=False)
-        out[name] = (col.astype(object)
-                     if col.dtype.kind in "US" else col)
+        out[name] = (col.astype(object) if col.dtype.kind in "US" else col)
     return out
 
 
@@ -57,6 +171,13 @@ def write_parquet(batch: Batch, path: str) -> None:
 
 def read_json(path: str) -> Batch:
     """Line-delimited JSON records (the reference's default JSON shape)."""
+    partitioned = _read_partitioned(path, _read_json_flat)
+    if partitioned is not None:
+        return partitioned
+    return _read_json_flat(path)
+
+
+def _read_json_flat(path: str) -> Batch:
     rows: List[Dict] = []
     for p in _expand(path):
         with open(p, encoding="utf-8") as fh:
@@ -121,6 +242,16 @@ class DataFrameWriter:
         self._df = df
         self._mode = "error"
         self._options: Dict[str, str] = {}
+        self._partition_cols: List[str] = []
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        """(ref DataFrameWriter.partitionBy) — write one key=value
+        subdirectory per distinct tuple, dropping the partition columns
+        from the data files."""
+        self._partition_cols = list(cols)
+        return self
+
+    partitionBy = partition_by
 
     def mode(self, m: str) -> "DataFrameWriter":
         if m not in ("error", "errorifexists", "overwrite", "append",
@@ -155,17 +286,80 @@ class DataFrameWriter:
                 return f"{base}-part{i}{ext}"
         return path
 
+    def _prepare_dir(self, path: str) -> bool:
+        """Save-mode semantics for a partitioned DIRECTORY dataset."""
+        import shutil
+        if os.path.isdir(path):
+            if self._mode == "error":
+                raise FileExistsError(
+                    f"path {path} already exists (SaveMode.ErrorIfExists)")
+            if self._mode == "ignore":
+                return False
+            if self._mode == "overwrite":
+                shutil.rmtree(path)
+            # append: keep existing partitions, add new part files
+        os.makedirs(path, exist_ok=True)
+        return True
+
+    def _write_partitioned(self, path: str, ext: str, write_one) -> None:
+        from urllib.parse import quote
+        if not self._prepare_dir(path):
+            return
+        batch = self._df.to_dict()
+        cols = list(batch)
+        missing = [c for c in self._partition_cols if c not in cols]
+        if missing:
+            raise KeyError(f"partition columns {missing} not in {cols}")
+        data_cols = [c for c in cols if c not in self._partition_cols]
+        if not data_cols:
+            raise ValueError("cannot partition by every column")
+        from cycloneml_tpu.sql.plan import _factorize
+        n = len(batch[cols[0]])
+        keys = [np.asarray(batch[c]) for c in self._partition_cols]
+        codes, n_groups, first_idx = _factorize(keys) if n else             (np.zeros(0, np.int64), 0, np.zeros(0, np.int64))
+        for g in range(n_groups):
+            mask = codes == g
+            segs = []
+            for c, k in zip(self._partition_cols, keys):
+                v = k[first_idx[g]]
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    raw = _HIVE_NULL
+                elif isinstance(v, (np.floating, float)):
+                    raw = repr(float(v))
+                elif isinstance(v, (np.integer, int)):
+                    raw = str(int(v))
+                else:
+                    raw = quote(str(v), safe="")
+                segs.append(f"{c}={raw}")
+            sub = os.path.join(path, *segs)
+            os.makedirs(sub, exist_ok=True)
+            i = 0
+            while os.path.exists(os.path.join(sub, f"part-{i}{ext}")):
+                i += 1  # append mode: fresh part file beside existing ones
+            write_one({c: np.asarray(batch[c])[mask] for c in data_cols},
+                      os.path.join(sub, f"part-{i}{ext}"))
+
     def parquet(self, path: str) -> None:
+        if self._partition_cols:
+            self._write_partitioned(path, ".parquet", write_parquet)
+            return
         target = self._prepare(path)
         if target:
             write_parquet(self._df.to_dict(), target)
 
     def json(self, path: str) -> None:
+        if self._partition_cols:
+            self._write_partitioned(path, ".json", write_json)
+            return
         target = self._prepare(path)
         if target:
             write_json(self._df.to_dict(), target)
 
     def csv(self, path: str) -> None:
+        if self._partition_cols:
+            raise NotImplementedError(
+                "partitioned CSV reads lack header/type recovery; use "
+                "parquet or json for partitioned datasets")
         target = self._prepare(path)
         if target:
             write_csv(self._df.to_dict(), target,

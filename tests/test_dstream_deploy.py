@@ -407,3 +407,8 @@ def test_pmml_linear_svc():
     assert coefs == [0.4, -1.2]
     # category-0 table carries the decision threshold (ref thresholdTable)
     assert float(by_cat["0"].get("intercept")) == 0.0
+    m.set("threshold", 0.5)
+    rm2 = ET.fromstring(_strip_ns(to_pmml(m))).find("RegressionModel")
+    by_cat2 = {t.get("targetCategory"): t
+               for t in rm2.findall("RegressionTable")}
+    assert float(by_cat2["0"].get("intercept")) == 0.5

@@ -212,3 +212,121 @@ def test_read_parquet_directory(tmp_path):
     (tmp_path / "_SUCCESS").write_text("")  # marker files skipped
     back = s.read_parquet(str(tmp_path))
     assert sorted(back.to_dict()["v"].tolist()) == [1.0, 2.0]
+
+
+# -- Hive-style partitioning ----------------------------------------------------
+
+def _part_df(s):
+    return s.create_data_frame({
+        "dept": ["eng", "eng", "hr", "sales", "sales"],
+        "year": [2024, 2025, 2024, 2024, 2025],
+        "salary": [10.0, 20.0, 30.0, 40.0, 50.0],
+    })
+
+
+def test_partitioned_parquet_roundtrip(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    path = str(tmp_path / "ds")
+    _part_df(s).write.partition_by("dept", "year").parquet(path)
+    # layout: dept=eng/year=2024/part-0.parquet etc., partition cols dropped
+    # from the files themselves
+    assert os.path.isdir(os.path.join(path, "dept=eng", "year=2024"))
+    import pyarrow.parquet as pq
+    one = pq.read_table(os.path.join(path, "dept=eng", "year=2024",
+                                     "part-0.parquet"))
+    assert one.column_names == ["salary"]
+
+    back = s.read_parquet(path).order_by("salary").to_dict()
+    assert back["salary"].tolist() == [10.0, 20.0, 30.0, 40.0, 50.0]
+    assert back["dept"].tolist() == ["eng", "eng", "hr", "sales", "sales"]
+    assert back["year"].tolist() == [2024, 2025, 2024, 2024, 2025]
+    assert back["year"].dtype.kind == "i"  # int inference, as the reference
+
+
+def test_partitioned_json_and_pruning_by_filter(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    from cycloneml_tpu.sql.column import col
+    s = CycloneSession()
+    path = str(tmp_path / "j")
+    _part_df(s).write.partition_by("dept").json(path)
+    df = s.read_json(path)
+    out = df.filter(col("dept") == "sales").order_by("salary").to_dict()
+    assert out["salary"].tolist() == [40.0, 50.0]
+
+
+def test_partitioned_save_modes(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    path = str(tmp_path / "m")
+    w = _part_df(s).write.partition_by("dept")
+    w.parquet(path)
+    with pytest.raises(FileExistsError):
+        _part_df(s).write.partition_by("dept").parquet(path)
+    # append adds part files; row count doubles
+    _part_df(s).write.mode("append").partition_by("dept").parquet(path)
+    assert len(s.read_parquet(path).to_dict()["salary"]) == 10
+    # overwrite replaces everything
+    _part_df(s).write.mode("overwrite").partition_by("dept").parquet(path)
+    assert len(s.read_parquet(path).to_dict()["salary"]) == 5
+    # ignore is a no-op
+    _part_df(s).write.mode("ignore").partition_by("dept").parquet(path)
+    assert len(s.read_parquet(path).to_dict()["salary"]) == 5
+
+
+def test_partition_by_validation(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    with pytest.raises(KeyError, match="partition columns"):
+        _part_df(s).write.partition_by("nope").parquet(str(tmp_path / "x"))
+    with pytest.raises(ValueError, match="every column"):
+        (_part_df(s).write.partition_by("dept", "year", "salary")
+         .parquet(str(tmp_path / "y")))
+
+
+def test_partitioned_ragged_schema_fills_null(tmp_path):
+    """A data column present in only some partition files must fill null in
+    the others (flat JSON union semantics), never come back ragged."""
+    import json as _json
+    from cycloneml_tpu.sql.session import CycloneSession
+    path = tmp_path / "r"
+    (path / "dept=eng").mkdir(parents=True)
+    (path / "dept=hr").mkdir(parents=True)
+    (path / "dept=eng" / "part-0.json").write_text(
+        _json.dumps({"salary": 1.0, "bonus": 5.0}) + "\n")
+    (path / "dept=hr" / "part-0.json").write_text(
+        _json.dumps({"salary": 2.0}) + "\n")
+    s = CycloneSession()
+    out = s.read_json(str(path)).order_by("salary").to_dict()
+    assert len(out["bonus"]) == 2 == len(out["salary"])
+    assert out["bonus"][0] == 5.0 and out["bonus"][1] is None
+
+
+def test_partitioned_empty_write_reads_back_empty(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    empty = s.create_data_frame({"dept": [], "salary": []})
+    path = str(tmp_path / "e")
+    empty.write.partition_by("dept").parquet(path)
+    assert s.read_parquet(path).count() == 0
+
+
+def test_pmml_logistic_threshold_encoded():
+    import xml.etree.ElementTree as ET
+    from cycloneml_tpu.ml.classification.logistic_regression import (
+        LogisticRegressionModel)
+    from cycloneml_tpu.ml.pmml import to_pmml
+
+    def cat0_intercept(m):
+        xml = to_pmml(m).replace(
+            ' xmlns="http://www.dmg.org/PMML-4_2"', "")
+        rm = ET.fromstring(xml).find("RegressionModel")
+        by = {t.get("targetCategory"): t
+              for t in rm.findall("RegressionTable")}
+        return float(by["0"].get("intercept"))
+
+    m = LogisticRegressionModel(coefficient_matrix=np.array([[1.0]]),
+                                intercept_vector=np.array([0.0]))
+    assert cat0_intercept(m) == pytest.approx(0.0)  # default threshold 0.5
+    m.set("threshold", 0.7)
+    assert cat0_intercept(m) == pytest.approx(-np.log(1 / 0.7 - 1))
