@@ -16,6 +16,7 @@ EXAMPLES = [
     "examples/graph_pagerank.py",
     "examples/window_analytics_example.py",
     "examples/streaming_etl_to_parquet.py",
+    "examples/streamed_ingest_monitoring_example.py",
 ]
 
 
