@@ -433,3 +433,60 @@ def read_libsvm_sparse(ctx, path: str, n_features: Optional[int] = None,
     parts = [c for dev_chunks in labels for c in dev_chunks]
     y = (np.concatenate(parts) if parts else np.zeros(0))
     return ds, y
+
+
+_scale_gather = None
+
+
+def _get_scale_gather():
+    """Module-level cached jit: a fresh lambda per call would recompile the
+    gather-scale program on every fit (see loss._get_scale_rows)."""
+    global _scale_gather
+    if _scale_gather is None:
+        import jax
+        import jax.numpy as jnp
+        _scale_gather = jax.jit(lambda v, i, s: v * jnp.take(s, i, axis=0))
+    return _scale_gather
+
+
+def sparse_feature_std(ds: SparseInstanceDataset) -> np.ndarray:
+    """Per-feature std over a sparse dataset, implicit zeros included —
+    the unbiased weighted formula the dense Summarizer uses
+    (MultivariateOnlineSummarizer.variance), computed from one psum pass
+    of per-feature weighted sums/squares."""
+    from cycloneml_tpu.ml.optim.sparse_aggregators import (
+        sparse_summary, sparse_summary_hybrid)
+    summ = (sparse_summary_hybrid if ds.is_hybrid else sparse_summary)
+    out = ds.tree_aggregate_fn(summ(ds.n_features))(
+        np.zeros(1, dtype=np.float32))
+    w = float(out["weight_sum"])
+    s1 = np.asarray(out["sum"], dtype=np.float64)
+    s2 = np.asarray(out["sum_sq"], dtype=np.float64)
+    denom = w - float(out["weight_sq_sum"]) / max(w, 1e-300)
+    mean = s1 / max(w, 1e-300)
+    if denom <= 0:
+        return np.zeros_like(mean)
+    var = np.maximum((s2 - w * mean * mean) / denom, 0.0)
+    return np.sqrt(var)
+
+
+def standardize_sparse_dataset(ds: SparseInstanceDataset,
+                               features_std: np.ndarray
+                               ) -> Tuple[SparseInstanceDataset, np.ndarray]:
+    """Scale stored values by 1/std WITHOUT centering (the reference's
+    sparse standardization keeps sparsity for exactly this reason,
+    LogisticRegression.scala:968 note); zero-variance features scale to 0.
+    Device-side: values gather their feature's scale by column id."""
+    import jax
+    import jax.numpy as jnp
+
+    inv_std = np.where(features_std > 0, 1.0 / np.where(
+        features_std > 0, features_std, 1.0), 0.0)
+    inv = jnp.asarray(inv_std, dtype=jnp.float32)
+    scale = _get_scale_gather()
+    values = scale(ds.values, ds.indices, inv)
+    coo_val = (scale(ds.coo_val, ds.coo_idx, inv)
+               if ds.is_hybrid else None)
+    return SparseInstanceDataset(
+        ds.ctx, ds.indices, values, ds.y, ds.w, ds.n_rows, ds.n_features,
+        coo_row=ds.coo_row, coo_idx=ds.coo_idx, coo_val=coo_val), inv_std

@@ -171,11 +171,141 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             out.append(b)
         return out[0], out[1]
 
-    def _fit(self, frame: MLFrame) -> "LogisticRegressionModel":
+    def _optimize(self, opt, loss_fn, x0, fp_parts):
+        """Shared optimize tail for the dense and sparse fit paths:
+        checkpointed training (fingerprint-bound to dataset+params) when a
+        checkpointDir is set, plain minimize otherwise, plus the
+        non-convergence warning."""
+        if self.get("checkpointDir"):
+            import hashlib
+            from cycloneml_tpu.parallel.resilience import (
+                train_with_checkpoints)
+            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+            # resuming someone else's checkpoint would silently return the
+            # wrong model — bind the dir to this dataset+params
+            fp = hashlib.sha1(repr(fp_parts).encode()).hexdigest()[:16]
+            state = train_with_checkpoints(
+                opt, loss_fn, x0,
+                TrainingCheckpointer(self.get("checkpointDir")),
+                interval=self.get("checkpointInterval"), fingerprint=fp)
+        else:
+            state = opt.minimize(loss_fn, x0)
+        if state.converged_reason == "max iterations reached":
+            logger.warning(
+                "LogisticRegression did not converge in %d iterations",
+                self.get("maxIter"))
+        return state
+
+    def _fit(self, frame) -> "LogisticRegressionModel":
+        from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+        if isinstance(frame, SparseInstanceDataset):
+            # the reference trains transparently on sparse vectors; here
+            # the sparse tier has its own fit path (ELL/hybrid aggregators)
+            return self._fit_sparse(frame)
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"),
             self.get("weightCol") or None)  # f64 under x64 config, else f32
         return self._fit_dataset(ds)
+
+    def _fit_sparse(self, ds) -> "LogisticRegressionModel":
+        """Binomial logistic regression over the sparse (ELL / ELL+COO
+        hybrid) tier: same statistical semantics as the dense path —
+        std-only standardization (sparsity-preserving, as the reference),
+        log-odds intercept init, elastic net via OWL-QN/L-BFGS, LBFGS-B
+        under bounds — with gather/segment-sum aggregators instead of
+        block matmuls."""
+        from cycloneml_tpu.dataset.sparse import (sparse_feature_std,
+                                                  standardize_sparse_dataset)
+        from cycloneml_tpu.ml.optim.sparse_aggregators import (
+            binary_logistic_sparse, binary_logistic_sparse_hybrid)
+
+        d = ds.n_features
+        w_host = np.asarray(ds.w)
+        y_host = np.asarray(ds.y)
+        mask = w_host > 0
+        num_classes = int(y_host[mask].max()) + 1 if mask.any() else 2
+        family = self.get("family")
+        if family == "multinomial" or (family == "auto" and num_classes > 2):
+            raise NotImplementedError(
+                "sparse-tier training is binomial only; hash or densify "
+                "for multinomial")
+        if num_classes > 2:
+            # family="binomial" with >2 label classes: reject exactly as
+            # the dense path (and the reference) does
+            raise ValueError(
+                f"Binomial family requires <= 2 label classes, found "
+                f"{num_classes} (the reference rejects this too)")
+        histogram = np.bincount(y_host[mask].astype(np.int64),
+                                weights=w_host[mask], minlength=2)[:2]
+        weight_sum = float(w_host[mask].sum())
+
+        fit_intercept = self.get("fitIntercept")
+        standardize = self.get("standardization")
+        reg = self.get("regParam")
+        alpha = self.get("elasticNetParam")
+        l2 = (1.0 - alpha) * reg
+        l1 = alpha * reg
+
+        features_std = sparse_feature_std(ds)
+        ds_std, inv_std = standardize_sparse_dataset(ds, features_std)
+
+        agg = (binary_logistic_sparse_hybrid(d, fit_intercept)
+               if ds.is_hybrid else binary_logistic_sparse(d, fit_intercept))
+        n_coef = d + (1 if fit_intercept else 0)
+        x0 = np.zeros(n_coef)
+        if fit_intercept and 0 < histogram[1] < weight_sum:
+            p1 = histogram[1] / weight_sum
+            x0[d] = np.log(p1 / (1.0 - p1))
+        l2_fn = l2_regularization(
+            l2, d, fit_intercept, features_std=features_std,
+            standardize=standardize) if l2 > 0 else None
+        loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
+
+        if self._has_bounds():
+            if alpha != 0.0:
+                raise ValueError(
+                    "coefficient bounds are only supported with none or L2 "
+                    "regularization (elasticNetParam must be 0, as the "
+                    "reference enforces)")
+            lo, hi = self._flat_bounds(d, 2, False, fit_intercept, n_coef,
+                                       features_std)
+            opt = LBFGSB(lo, hi, max_iter=self.get("maxIter"),
+                         tol=self.get("tol"))
+        elif l1 > 0:
+            l1_vec = np.zeros(n_coef)
+            per = np.full(d, l1)
+            if not standardize:
+                per = np.where(features_std > 0,
+                               l1 / np.where(features_std > 0,
+                                             features_std, 1.0), 0.0)
+            l1_vec[:d] = per
+            opt = OWLQN(max_iter=self.get("maxIter"), tol=self.get("tol"),
+                        l1_reg=l1_vec)
+        else:
+            opt = LBFGS(max_iter=self.get("maxIter"), tol=self.get("tol"))
+        state = self._optimize(opt, loss_fn, x0, (
+            ds.n_rows, d, 2, float(weight_sum),
+            np.asarray(histogram).round(6).tolist(),
+            np.asarray(features_std).round(6).tolist(),
+            reg, alpha, self.get("tol"), fit_intercept, standardize,
+            "sparse",
+        ))
+
+        sol = state.x
+        beta = sol[:d] * inv_std
+        icpt = float(sol[d]) if fit_intercept else 0.0
+        model = LogisticRegressionModel(
+            coefficient_matrix=beta[None, :],
+            intercept_vector=np.array([icpt]),
+            num_classes=2, is_multinomial=False, uid=self.uid)
+        self._copy_values(model)
+        model._set_parent(self)
+        model.summary = LogisticRegressionTrainingSummary(
+            objective_history=list(state.loss_history),
+            total_iterations=state.iteration,
+            total_evals=loss_fn.n_evals,
+            total_dispatches=loss_fn.n_dispatches)
+        return model
 
     def _fit_dataset(self, ds: InstanceDataset) -> "LogisticRegressionModel":
         import jax
@@ -292,27 +422,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 opt = DeviceLBFGS(max_iter=self.get("maxIter"),
                                   tol=self.get("tol"), chunk=chunk)
 
-        if self.get("checkpointDir"):
-            import hashlib
-            from cycloneml_tpu.parallel.resilience import train_with_checkpoints
-            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
-            # resuming someone else's checkpoint would silently return the
-            # wrong model — bind the dir to this dataset+params
-            fp = hashlib.sha1(repr((
-                ds.n_rows, d, num_classes, float(weight_sum),
-                np.asarray(histogram).round(6).tolist(),
-                np.asarray(features_std).round(6).tolist(),
-                reg, alpha, self.get("tol"), fit_intercept, standardize,
-            )).encode()).hexdigest()[:16]
-            state = train_with_checkpoints(
-                opt, loss_fn, x0,
-                TrainingCheckpointer(self.get("checkpointDir")),
-                interval=self.get("checkpointInterval"), fingerprint=fp)
-        else:
-            state = opt.minimize(loss_fn, x0)
-        if state.converged_reason == "max iterations reached":
-            logger.warning("LogisticRegression did not converge in %d iterations",
-                           self.get("maxIter"))
+        state = self._optimize(opt, loss_fn, x0, (
+            ds.n_rows, d, num_classes, float(weight_sum),
+            np.asarray(histogram).round(6).tolist(),
+            np.asarray(features_std).round(6).tolist(),
+            reg, alpha, self.get("tol"), fit_intercept, standardize,
+        ))
 
         sol = state.x
         if is_multinomial:

@@ -122,6 +122,7 @@ def sparse_summary(d: int) -> Agg:
             * (values != 0).reshape(-1), seg, num_segments=d)
         return {"sum": s1, "sum_sq": s2, "nnz_weight": nnz,
                 "weight_sum": jnp.sum(w),
+                "weight_sq_sum": jnp.sum(w * w),
                 "count": jnp.sum((w > 0).astype(jnp.float32))}
 
     return agg
@@ -183,5 +184,28 @@ def least_squares_sparse_hybrid(d: int, fit_intercept: bool = True) -> Agg:
         grad = (jnp.concatenate([g, jnp.sum(mult)[None]])
                 if fit_intercept else g)
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+@functools.lru_cache(maxsize=None)
+def sparse_summary_hybrid(d: int) -> Agg:
+    """Hybrid twin of :func:`sparse_summary`: the COO tail's entries fold
+    into the same per-feature moments (their row's weight gathered by the
+    shard-local row id)."""
+    base = sparse_summary(d)
+
+    def agg(indices, values, coo_row, coo_idx, coo_val, y, w, coef_unused):
+        out = base(indices, values, y, w, coef_unused)
+        cw = jnp.take(w, coo_row.astype(jnp.int32), axis=0)
+        seg = coo_idx.astype(jnp.int32)
+        out = dict(out)
+        out["sum"] = out["sum"] + jax.ops.segment_sum(
+            cw * coo_val, seg, num_segments=d)
+        out["sum_sq"] = out["sum_sq"] + jax.ops.segment_sum(
+            cw * coo_val * coo_val, seg, num_segments=d)
+        out["nnz_weight"] = out["nnz_weight"] + jax.ops.segment_sum(
+            cw * (coo_val != 0), seg, num_segments=d)
+        return out
 
     return agg
