@@ -284,6 +284,22 @@ class InstanceDataset:
         self.n_rows = n_rows
         self.n_features = n_features
 
+    def attach_host_labels(self, y: np.ndarray, w: np.ndarray) -> "InstanceDataset":
+        """Attach padded host twins of (y, w) so ``y_host``/``w_host`` never
+        pay a device readback — the supported way for external constructors
+        (generators, chunked loaders) to install the cache ``from_numpy``
+        sets internally."""
+        self._yw_host = (y, w)
+        return self
+
+    def to_instance_dataset(self, features_col=None, label_col=None,
+                            weight_col=None, dtype=None) -> "InstanceDataset":
+        """An InstanceDataset is already device-placed instance blocks:
+        every estimator's ``frame.to_instance_dataset(...)`` bridge accepts
+        one transparently (column names and dtype are frame concepts and are
+        ignored — the data is used as placed)."""
+        return self
+
     def y_host(self) -> np.ndarray:
         """Padded label vector as numpy, without a device readback when the
         dataset was built from host arrays."""

@@ -21,12 +21,14 @@ from cycloneml_tpu.mesh import DATA_AXIS, REPLICA_AXIS
 from cycloneml_tpu.parallel.collectives import shard_map_compat
 
 
-def _generate(ctx, n_rows: int, n_cols: int, seed: int,
-              sampler: Callable) -> InstanceDataset:
-    """Run ``sampler(key, shape)`` per shard; returns an InstanceDataset with
-    padding rows masked out via w=0 (the blockify invariant)."""
+def _shard_generate(ctx, n_rows: int, seed: int, local_fn: Callable,
+                    n_out: int):
+    """Shared per-shard generation scaffolding: pad the row count to the
+    blockify invariant, run ``local_fn(key, per_shard_rows)`` (key =
+    ``fold_in(seed, shard_index)``) on every shard inside one shard_map
+    program, and return ``(outputs, w_mask, total_rows, dtype)`` where
+    ``w_mask`` zeroes the padding rows."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from cycloneml_tpu.dataset.instance import compute_dtype
 
@@ -38,22 +40,76 @@ def _generate(ctx, n_rows: int, n_cols: int, seed: int,
     dt = compute_dtype()
 
     def local(tok):
-        idx = jax.lax.axis_index(REPLICA_AXIS) * d_size + jax.lax.axis_index(DATA_AXIS)
+        idx = (jax.lax.axis_index(REPLICA_AXIS) * d_size
+               + jax.lax.axis_index(DATA_AXIS))
         key = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
-        return sampler(key, (per, n_cols)).astype(dt)
+        return local_fn(key, per)
 
     row = P((REPLICA_AXIS, DATA_AXIS))
     tok = rt.device_put_sharded_rows(np.zeros(nd, dtype=np.float32))
-    x = jax.jit(shard_map_compat(local, rt.mesh, (row,), row))(tok)
+    out_spec = row if n_out == 1 else (row,) * n_out
+    out = jax.jit(shard_map_compat(local, rt.mesh, (row,), out_spec))(tok)
     w = np.zeros(total, dtype=dt)
     w[:n_rows] = 1.0
+    return out, w, total, dt
+
+
+def _generate(ctx, n_rows: int, n_cols: int, seed: int,
+              sampler: Callable) -> InstanceDataset:
+    """Run ``sampler(key, shape)`` per shard; returns an InstanceDataset with
+    padding rows masked out via w=0 (the blockify invariant)."""
+    from cycloneml_tpu.dataset.instance import compute_dtype
+
+    dt = compute_dtype()
+    x, w, total, dt = _shard_generate(
+        ctx, n_rows, seed,
+        lambda key, per: sampler(key, (per, n_cols)).astype(dt), n_out=1)
+    rt = ctx.mesh_runtime
     return InstanceDataset(ctx, x, rt.device_put_sharded_rows(np.zeros(total, dtype=dt)),
                            rt.device_put_sharded_rows(w), n_rows, n_cols)
+
+
+def generate_classification(ctx, n_rows: int, n_cols: int, seed: int = 0,
+                            noise: float = 1.0) -> InstanceDataset:
+    """Labeled synthetic binary-classification dataset, generated entirely
+    on device (the benchmark/scale-test feeder; ref RandomRDDs +
+    LogisticRegressionDataGenerator, mllib/util/LogisticRegressionDataGenerator.scala:33).
+
+    Each shard draws its feature rows from ``fold_in(seed, shard)`` and
+    labels them with a shared ground-truth weight vector drawn from
+    ``fold_in(seed, 2**31 - 1)``: ``y = 1[x·beta + noise·eps > 0]``. Zero
+    host→device transfer of X; only the (n,) labels are read back once so
+    estimators get their host label histogram for free."""
+    import jax
+    import jax.numpy as jnp
+
+    def local(key, per):
+        kx, ke = jax.random.split(key)
+        beta = jax.random.normal(
+            jax.random.fold_in(jax.random.PRNGKey(seed), 2 ** 31 - 1),
+            (n_cols,), dtype=jnp.float32)
+        x = jax.random.normal(kx, (per, n_cols), dtype=jnp.float32)
+        margin = x @ beta + noise * jax.random.normal(ke, (per,),
+                                                      dtype=jnp.float32)
+        return x.astype(dt), (margin > 0).astype(dt)
+
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    dt = compute_dtype()
+    (x, y), w, total, dt = _shard_generate(ctx, n_rows, seed, local, n_out=2)
+    rt = ctx.mesh_runtime
+    ds = InstanceDataset(ctx, x, y, rt.device_put_sharded_rows(w),
+                         n_rows, n_cols)
+    # one small readback: estimators consult the host label histogram each
+    # fit — (n,) not (n, d), so this stays cheap even through a TPU relay
+    return ds.attach_host_labels(np.asarray(y).astype(np.float64),
+                                 w.astype(np.float64))
 
 
 class RandomDatasets:
     """Static factory surface mirroring RandomRDDs (vector variants; the
     scalar variants are n_cols=1)."""
+
+    classification = staticmethod(generate_classification)
 
     @staticmethod
     def normal(ctx, n_rows: int, n_cols: int = 1, seed: int = 0,

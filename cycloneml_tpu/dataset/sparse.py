@@ -222,6 +222,14 @@ class SparseInstanceDataset:
         for cy, cnnz, cfi, cfv, mf in stream_libsvm_chunks(
                 path, chunk_rows=chunk_rows, n_threads=n_threads):
             max_feature = max(max_feature, mf)
+            if (hash_dim is None and n_features is not None
+                    and max_feature > n_features):
+                # fail on the offending chunk, not after streaming (and
+                # device-placing) the rest of a multi-GB file
+                raise ValueError(
+                    f"observed feature index {max_feature - 1} >= declared "
+                    f"n_features={n_features}; pass "
+                    f"n_features>={max_feature} or hash_dim to fold indices")
             ck = max(int(cnnz.max()) if len(cnnz) else 1, 1)
             if k_max is not None and ck > k_max:
                 raise ValueError(f"row has {ck} nonzeros > k_max={k_max}")

@@ -170,6 +170,12 @@ void* svm_stream_open(const char* path, int64_t buf_bytes, int n_threads) {
 }
 
 static bool svm_stream_refill(SvmStream* s) {
+  // read windows until one parses to at least one row (comment-only windows
+  // and longer-than-window lines retry) or genuine EOF. A loop, not
+  // recursion: each skipped window must release its buffer and stack frame
+  // before the next (a multi-GB comment region would otherwise hold every
+  // window alive at once).
+ retry:
   // read one window, snap to the last newline, parse it in parallel
   std::vector<char> buf;
   buf.reserve(s->carry.size() + (size_t)s->buf_bytes);
@@ -190,7 +196,7 @@ static bool svm_stream_refill(SvmStream* s) {
     if (last_nl == 0) {
       // a single line longer than the window: grow the carry and retry
       s->carry.assign(buf.begin(), buf.end());
-      return svm_stream_refill(s);
+      goto retry;
     }
     s->carry.assign(buf.begin() + last_nl, buf.end());
     end = last_nl;
@@ -218,6 +224,9 @@ static bool svm_stream_refill(SvmStream* s) {
     if (maxes[i] > s->max_idx) s->max_idx = maxes[i];
     for (auto& r : parts[i]) s->pending.push_back(std::move(r));
   }
+  // a window of only comments/blank lines parses to zero rows; that is not
+  // end-of-stream
+  if (s->pending.empty() && !s->eof) goto retry;
   return !s->pending.empty();
 }
 

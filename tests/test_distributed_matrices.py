@@ -114,3 +114,22 @@ def test_random_families(ctx):
     assert abs(g.mean() - 3.0) < 0.2
     ln = RandomDatasets.log_normal(ctx, 20_000, seed=4).to_numpy()[0]
     assert abs(ln.mean() - np.exp(0.5)) < 0.2
+
+
+def test_generate_classification_trains(ctx):
+    """Device-generated labeled data feeds any estimator directly (the
+    InstanceDataset.to_instance_dataset bridge) and is learnable."""
+    from cycloneml_tpu.dataset.random import generate_classification
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    ds = generate_classification(ctx, 4000, 16, seed=3)
+    x, y, w = ds.to_numpy()
+    assert x.shape == (4000, 16) and set(np.unique(y)) <= {0.0, 1.0}
+    assert ds.to_instance_dataset("anything", "else") is ds
+    # host label twins attached: no device readback on y_host
+    assert ds._yw_host is not None and len(ds.y_host()) >= 4000
+    assert np.array_equal(
+        x, generate_classification(ctx, 4000, 16, seed=3).to_numpy()[0])
+    m = LogisticRegression(maxIter=20, regParam=0.0).fit(ds)
+    pred = (x @ np.asarray(m.coefficients) + m.intercept) > 0
+    assert ((pred == (y > 0.5)).mean()) > 0.9

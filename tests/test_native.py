@@ -114,3 +114,27 @@ def test_kvstore_python_engine_interop(tmp_path):
     kv2 = KVStore(path)
     assert kv2.get(b"k3") == b"v3" and len(kv2) == 2
     kv2.close()
+
+
+def test_stream_survives_all_comment_window(tmp_path):
+    """A full read window of only comments/blank lines is not end-of-stream
+    (advisor r2: svm_stream_refill returned false mid-file, truncating
+    everything after such a window)."""
+    from cycloneml_tpu.native.host import stream_libsvm_chunks
+    p = tmp_path / "gap.svm"
+    with open(p, "w") as fh:
+        for i in range(10):
+            fh.write(f"1 {i + 1}:1.0\n")
+        # > buf_bytes of pure comment lines in the middle of the file
+        for _ in range(200):
+            fh.write("# padding comment line, no data here\n")
+        for i in range(10):
+            fh.write(f"0 {i + 1}:2.0\n")
+    rows = 0
+    labels = []
+    for y, nnz, fi, fv, mf in stream_libsvm_chunks(
+            str(p), chunk_rows=7, buf_bytes=512):
+        rows += len(y)
+        labels.extend(y.tolist())
+    assert rows == 20
+    assert labels[:10] == [1.0] * 10 and labels[10:] == [0.0] * 10

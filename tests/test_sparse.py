@@ -382,3 +382,17 @@ def test_hybrid_all_short_rows_has_trivial_tail(ctx):
                                                  n_features=20, k_ell=8)
     ref = SparseInstanceDataset.from_rows(ctx, rows, y=y, w=w, n_features=20)
     np.testing.assert_allclose(hyb.to_dense(), ref.to_dense(), rtol=1e-6)
+
+
+def test_stream_rejects_undersized_n_features(ctx, tmp_path):
+    """Declared n_features below the observed max index must raise, not let
+    gathers clip out-of-range ids silently (advisor r2)."""
+    from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+    p = str(tmp_path / "wide.svm")
+    with open(p, "w") as fh:
+        fh.write("1 1:1.0 9:2.0\n0 2:1.0\n")
+    with pytest.raises(ValueError, match="n_features"):
+        SparseInstanceDataset.from_libsvm_stream(ctx, p, n_features=4)
+    # hash_dim folds indices instead and stays legal
+    ds = SparseInstanceDataset.from_libsvm_stream(ctx, p, hash_dim=4)
+    assert ds.n_features == 4
