@@ -53,9 +53,139 @@ def parse_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarra
     return x, np.array(labels, dtype=np.float64)
 
 
-def read_libsvm(ctx, path: str, n_features: Optional[int] = None) -> InstanceDataset:
+#: files above this size route through the out-of-core chunked readers
+#: instead of whole-file materialization (override per-call with streamed=)
+DENSE_STREAM_THRESHOLD = 256 << 20
+
+
+def read_libsvm(ctx, path: str, n_features: Optional[int] = None,
+                streamed: Optional[bool] = None) -> InstanceDataset:
+    """Dense libsvm ingest. Large files (``streamed=None`` and size over
+    :data:`DENSE_STREAM_THRESHOLD`, or ``streamed=True``) stream CSR chunks
+    from the native scanner and densify block-by-block straight onto the
+    mesh — the driver never holds the densified matrix (out-of-core path;
+    ref MLUtils.scala:77 via HadoopRDD.scala:87 partition streaming).
+    Streaming requires ``n_features`` (dense chunk width is fixed up-front;
+    without it, fall back to the whole-file parser or use the sparse tier's
+    ``from_libsvm_stream``, which can infer it)."""
+    if streamed is None:
+        streamed = (n_features is not None
+                    and os.path.getsize(path) > DENSE_STREAM_THRESHOLD)
+    if streamed:
+        if n_features is None:
+            raise ValueError("streamed dense libsvm ingest requires "
+                             "n_features (chunk width is fixed up-front)")
+        return InstanceDataset.from_dense_chunks(
+            ctx, _libsvm_dense_chunks(path, n_features), n_features)
     x, y = parse_libsvm(path, n_features)
     return InstanceDataset.from_numpy(ctx, x, y)
+
+
+def _libsvm_dense_chunks(path: str, n_features: int,
+                         chunk_rows: int = 65536):
+    """Yield (x, y, None) dense blocks from the bounded-memory CSR streamer;
+    densification is per-chunk, so peak host memory is one block."""
+    from cycloneml_tpu.native.host import stream_libsvm_chunks
+    for cy, cnnz, cfi, cfv, mf in stream_libsvm_chunks(
+            path, chunk_rows=chunk_rows):
+        if mf > n_features:
+            raise ValueError(
+                f"observed feature index {mf - 1} >= declared "
+                f"n_features={n_features}")
+        m = len(cy)
+        x = np.zeros((m, n_features), dtype=np.float32)
+        rows = np.repeat(np.arange(m), cnnz)
+        x[rows, cfi] = cfv
+        yield x, cy, None
+
+
+def read_npy_chunked(ctx, path: str, label_col: Optional[int] = None,
+                     chunk_rows: int = 65536) -> InstanceDataset:
+    """Out-of-core ingest of a .npy 2-D array: chunks are read with plain
+    ``file.read`` (no mmap — mapped pages would count toward driver RSS and
+    defeat the bounded-memory contract) and placed on the mesh as they
+    arrive. ``label_col`` splits one column off as the label."""
+    import numpy.lib.format as npf
+
+    with open(path, "rb") as fh:
+        version = npf.read_magic(fh)
+        if version == (1, 0):
+            shape, fortran, dt = npf.read_array_header_1_0(fh)
+        elif version == (2, 0):
+            shape, fortran, dt = npf.read_array_header_2_0(fh)
+        else:
+            shape, fortran, dt = npf._read_array_header(fh, version)
+        if fortran or len(shape) != 2:
+            raise ValueError("read_npy_chunked requires a C-order 2-D array")
+        n, d_file = shape
+        d = d_file - (1 if label_col is not None else 0)
+        row_bytes = d_file * dt.itemsize
+
+        def chunks():
+            done = 0
+            while done < n:
+                m = min(chunk_rows, n - done)
+                buf = fh.read(m * row_bytes)
+                if len(buf) != m * row_bytes:
+                    raise IOError(f"truncated .npy payload in {path!r}")
+                block = np.frombuffer(buf, dtype=dt).reshape(m, d_file)
+                if label_col is None:
+                    yield block, None, None
+                else:
+                    y = block[:, label_col].astype(np.float64)
+                    yield np.delete(block, label_col, axis=1), y, None
+                done += m
+
+        return InstanceDataset.from_dense_chunks(ctx, chunks(), d)
+
+
+def read_csv_chunked(ctx, path: str, label_col: int = 0, delimiter: str = ",",
+                     skip_header: bool = False,
+                     chunk_rows: int = 65536) -> InstanceDataset:
+    """Out-of-core CSV ingest: parse line batches and place each block on
+    the mesh as it is read; driver peak memory is one block."""
+    def first_data_line(fh):
+        if skip_header:
+            fh.readline()
+        for line in fh:  # blank lines anywhere (incl. leading) are skipped
+            if line.strip():
+                return line
+        return None
+
+    def chunks():
+        with open(path) as fh:
+            first = first_data_line(fh)
+            if first is None:
+                return
+            d_file = len(first.split(delimiter))
+            batch = [first]
+            for line in fh:
+                if not line.strip():
+                    continue
+                batch.append(line)
+                if len(batch) >= chunk_rows:
+                    yield _csv_block(batch, delimiter, d_file, label_col)
+                    batch = []
+            if batch:
+                yield _csv_block(batch, delimiter, d_file, label_col)
+
+    # peek the width for from_dense_chunks without consuming the stream
+    with open(path) as fh:
+        head = first_data_line(fh)
+    if head is None:
+        raise ValueError(f"{path!r} has no data rows")
+    d = len(head.split(delimiter)) - 1
+    return InstanceDataset.from_dense_chunks(ctx, chunks(), d)
+
+
+def _csv_block(lines, delimiter, d_file, label_col):
+    data = np.loadtxt(lines, delimiter=delimiter, ndmin=2)
+    if data.shape[1] != d_file:
+        raise ValueError(f"ragged CSV: expected {d_file} columns, "
+                         f"got {data.shape[1]}")
+    y = data[:, label_col]
+    x = np.delete(data, label_col, axis=1)
+    return x, y, None
 
 
 def read_csv(ctx, path: str, label_col: int = 0, delimiter: str = ",",

@@ -36,7 +36,7 @@ class FMClassifier(Predictor, _FMParams, MLWritable, MLReadable):
     def _fit(self, frame: MLFrame) -> "FMClassificationModel":
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"), None)
-        validate_binary_labels(np.asarray(ds.y)[:ds.n_rows], "FMClassifier")
+        validate_binary_labels(ds.unpad(np.asarray(ds.y)), "FMClassifier")
         d = ds.n_features
         coef, history = train_fm(
             ds, d, "logistic", self.get("factorSize"),

@@ -80,7 +80,7 @@ class LinearSVC(Predictor, _LinearSVCParams, MLWritable, MLReadable):
         standardize = self.get("standardization")
         reg = self.get("regParam")
 
-        validate_binary_labels(np.asarray(ds.y)[:ds.n_rows], "LinearSVC")
+        validate_binary_labels(ds.unpad(np.asarray(ds.y)), "LinearSVC")
         ds_std, inv_std = standardize_dataset(ds, features_std)
 
         agg = aggregators.hinge(d, fit_intercept)

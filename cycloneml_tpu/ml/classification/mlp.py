@@ -104,7 +104,7 @@ class MultilayerPerceptronClassifier(Predictor, _MLPParams,
             raise ValueError(f"input layer size {layers[0]} != "
                              f"feature dim {ds.n_features}")
         k = layers[-1]
-        y_real = np.asarray(ds.y)[:ds.n_rows]
+        y_real = ds.unpad(np.asarray(ds.y))
         if ds.n_rows and (y_real.min() < 0 or y_real.max() >= k
                           or np.any(y_real != np.floor(y_real))):
             raise ValueError(

@@ -236,7 +236,7 @@ def _boost(est, binned: BinnedDataset, w: np.ndarray, first_target: np.ndarray,
         if x_for_pred is None:
             # one host copy of the raw features for residual updates
             x_for_pred = _unbin(binned)
-        f_pred = f_pred + tw * tree.predict_raw(x_for_pred)[:, 0][:binned.n_rows]
+        f_pred = f_pred + tw * tree.predict_raw(x_for_pred)[:, 0]
         target = neg_gradient(f_pred)
     return forests, weights
 
@@ -247,7 +247,7 @@ def _unbin(binned: BinnedDataset) -> np.ndarray:
     Simpler and exact: reconstruct from bins via thresholds — value in bin b
     of feature f satisfies th[b-1] < v <= th[b]; any v in that interval gives
     the same path, so use th[b] (and th[last]+1 for the top bin)."""
-    bins = np.asarray(binned.bins)[:binned.n_rows]
+    bins = np.asarray(binned.bins)[binned.valid_idx]
     d = binned.n_features
     out = np.empty(bins.shape, dtype=np.float64)
     for f in range(d):

@@ -301,7 +301,8 @@ class BinnedDataset:
     """Bucketized features on device, reusable across trees/boosting rounds."""
 
     def __init__(self, ctx, bins, thresholds: np.ndarray, n_bins: np.ndarray,
-                 n_rows: int, n_features: int):
+                 n_rows: int, n_features: int,
+                 valid_mask: "np.ndarray | None" = None):
         self.ctx = ctx
         self.bins = bins                    # [n_pad, d] int32, row-sharded
         self.thresholds = thresholds        # [d, B-1] float64 host
@@ -309,6 +310,10 @@ class BinnedDataset:
         self.max_bins = int(n_bins.max())
         self.n_rows = n_rows
         self.n_features = n_features
+        # real-row positions in padded space: chunked loaders interleave
+        # padding per shard, so [:n_rows] slicing is NOT equivalent
+        self.valid_idx = (np.nonzero(valid_mask)[0] if valid_mask is not None
+                          else np.arange(n_rows))
         # compiled-program caches shared across grow_forest calls (GBT runs
         # many rounds over the same binned data — recompiling per round
         # would dominate fit time)
@@ -321,7 +326,7 @@ class BinnedDataset:
         import jax
         import jax.numpy as jnp
 
-        x_host = np.asarray(ds.x, dtype=np.float64)[:ds.n_rows]
+        x_host = ds.unpad(np.asarray(ds.x, dtype=np.float64))
         if ds.n_rows > sample_cap:
             rng = np.random.RandomState(seed)
             idx = rng.choice(ds.n_rows, size=sample_cap, replace=False)
@@ -343,7 +348,8 @@ class BinnedDataset:
 
         rt = ds.ctx.mesh_runtime
         bins = jax.jit(binize, out_shardings=rt.data_sharding(extra_axes=1))(ds.x)
-        return cls(ds.ctx, bins, thresholds, n_bins, ds.n_rows, ds.n_features)
+        return cls(ds.ctx, bins, thresholds, n_bins, ds.n_rows,
+                   ds.n_features, valid_mask=ds._valid_mask)
 
 
 # ---------------------------------------------------------------------------
@@ -395,17 +401,20 @@ def grow_forest(binned: BinnedDataset, y: np.ndarray, w: np.ndarray,
         cnt_host = rng.poisson(cfg.subsampling_rate, size=(n_pad, T)).astype(np.float32)
     else:
         cnt_host = (rng.rand(n_pad, T) < cfg.subsampling_rate).astype(np.float32)
-    cnt_host[n:] = 0.0
+    vi = binned.valid_idx
+    keep = np.zeros(n_pad, dtype=bool)
+    keep[vi] = True
+    cnt_host[~keep] = 0.0
 
     y_host = np.zeros(n_pad, dtype=np.float64)
-    y_host[:n] = y
+    y_host[vi] = y
     w_host = np.zeros(n_pad, dtype=np.float64)
-    w_host[:n] = w
+    w_host[vi] = w
 
     # stat channels per (row, tree): [n_pad, T, C]
     if classification:
         onehot = np.zeros((n_pad, K), dtype=np.float64)
-        onehot[np.arange(n), np.clip(y.astype(np.int64), 0, K - 1)] = 1.0
+        onehot[vi, np.clip(y.astype(np.int64), 0, K - 1)] = 1.0
         chans = np.concatenate(
             [cnt_host[:, :, None].astype(np.float64),
              onehot[:, None, :] * (w_host[:, None] * cnt_host.astype(np.float64))[:, :, None]],

@@ -24,6 +24,11 @@ jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: scale/ledger tests (minutes, subprocesses)")
+
 from cycloneml_tpu import mesh as mesh_mod  # noqa: E402
 from cycloneml_tpu.conf import CycloneConf  # noqa: E402
 from cycloneml_tpu.context import CycloneContext  # noqa: E402

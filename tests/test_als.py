@@ -210,3 +210,54 @@ def test_normal_eq_memory_proportional_to_entities(ctx):
     nnz_bytes_per_shard = shard0 * rank * rank * 4      # the un-chunked blob
     assert temp < 4 * entities_bytes, (temp, entities_bytes)
     assert temp < nnz_bytes_per_shard / 3, (temp, nnz_bytes_per_shard)
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_blocked_matches_replicated(ctx, implicit):
+    """Factor-sharded (blocked) ALS must match the replicated path: same
+    init, same normal equations, different partitioning — the dst-sharded
+    accumulator plus one src all-gather is algebraically identical to the
+    replicated psum (ref ALS.scala:1605 block structure)."""
+    users, items, r, _, _ = _ratings(seed=53)
+    if implicit:
+        r = np.abs(r)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    kw = dict(rank=3, maxIter=5, regParam=0.05, seed=4,
+              implicitPrefs=implicit, alpha=0.5)
+    rep = ALS(shardFactors="never", **kw).fit(frame)
+    blk = ALS(shardFactors="always", **kw).fit(frame)
+    np.testing.assert_allclose(blk.user_factors, rep.user_factors,
+                               rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(blk.item_factors, rep.item_factors,
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_blocked_nonnegative_and_checkpoint(ctx, tmp_path):
+    users, items, r, _, _ = _ratings(seed=54)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": np.abs(r)})
+    m = ALS(rank=3, maxIter=4, regParam=0.05, seed=5, nonnegative=True,
+            shardFactors="always").fit(frame)
+    assert (m.user_factors >= 0).all() and (m.item_factors >= 0).all()
+    # checkpointed blocked run resumes to the same factors
+    ckdir = str(tmp_path / "ck")
+    kw = dict(rank=3, maxIter=6, regParam=0.05, seed=6,
+              shardFactors="always")
+    full = ALS(**kw).fit(frame)
+    ALS(maxIter=4, checkpointDir=ckdir, checkpointInterval=2,
+        **{k: v for k, v in kw.items() if k != "maxIter"}
+        ).set("maxIter", 4).fit(frame)
+    resumed = ALS(checkpointDir=ckdir, checkpointInterval=2, **kw).fit(frame)
+    np.testing.assert_allclose(resumed.user_factors, full.user_factors,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_auto_mode_switches_on_threshold(ctx):
+    users, items, r, _, _ = _ratings(seed=55)
+    frame = MLFrame(ctx, {"user": users, "item": items, "rating": r})
+    # tiny threshold forces the blocked path through "auto"
+    m = ALS(rank=3, maxIter=3, regParam=0.05, seed=7,
+            factorShardingThresholdBytes=64).fit(frame)
+    rep = ALS(rank=3, maxIter=3, regParam=0.05, seed=7,
+              shardFactors="never").fit(frame)
+    np.testing.assert_allclose(m.user_factors, rep.user_factors,
+                               rtol=2e-3, atol=2e-4)
