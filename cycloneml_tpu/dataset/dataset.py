@@ -271,7 +271,8 @@ class InstanceDataset:
     (n_pad,), all sharded over (replica, data); padding rows carry w=0.
     """
 
-    def __init__(self, ctx, x, y, w, n_rows: int, n_features: int):
+    def __init__(self, ctx, x, y, w, n_rows: int, n_features: int,
+                 valid_mask: Optional[np.ndarray] = None):
         self.ctx = ctx
         self._x = x
         self._y = y
@@ -283,9 +284,29 @@ class InstanceDataset:
         self._yw_host: Optional[Tuple[np.ndarray, np.ndarray]] = None
         # real-row mask when padding is interleaved per shard (chunked
         # loaders); None means padding sits at the global tail ([:n_rows])
-        self._valid_mask: Optional[np.ndarray] = None
+        self._valid_mask: Optional[np.ndarray] = valid_mask
         self.n_rows = n_rows
         self.n_features = n_features
+
+    def derive(self, x=None, y=None, w=None,
+               n_features: Optional[int] = None) -> "InstanceDataset":
+        """A dataset with some arrays replaced and THIS dataset's row
+        metadata (row count, interleaved-padding mask, host label twins when
+        y/w are unchanged) preserved. Every row-aligned transformation
+        (standardization, normalization, X·B products) must construct its
+        result through this — a raw ``InstanceDataset(...)`` call silently
+        drops the padding mask and corrupts chunk-loaded datasets."""
+        ds = InstanceDataset(self.ctx,
+                             self._x if x is None else x,
+                             self._y if y is None else y,
+                             self._w if w is None else w,
+                             self.n_rows,
+                             self.n_features if n_features is None
+                             else n_features,
+                             valid_mask=self._valid_mask)
+        if y is None and w is None:
+            ds._yw_host = self._yw_host
+        return ds
 
     def attach_host_labels(self, y: np.ndarray, w: np.ndarray) -> "InstanceDataset":
         """Attach padded host twins of (y, w) so ``y_host``/``w_host`` never
@@ -398,6 +419,12 @@ class InstanceDataset:
                   else np.asarray(cy, dtype=dtype))
             cw = (np.ones(m, dtype=dtype) if cw is None
                   else np.asarray(cw, dtype=dtype))
+            if len(cy) != m or len(cw) != m:
+                # a silent mismatch would shift every later label in the
+                # shard against its features
+                raise ValueError(
+                    f"chunk {ci}: y/w lengths ({len(cy)}/{len(cw)}) != "
+                    f"x rows ({m})")
             # split every chunk across ALL devices (rotating the remainder)
             # so shard row counts stay balanced regardless of chunk count —
             # whole-chunk round-robin left shards up to one chunk apart,

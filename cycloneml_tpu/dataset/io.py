@@ -69,8 +69,15 @@ def read_libsvm(ctx, path: str, n_features: Optional[int] = None,
     without it, fall back to the whole-file parser or use the sparse tier's
     ``from_libsvm_stream``, which can infer it)."""
     if streamed is None:
-        streamed = (n_features is not None
-                    and os.path.getsize(path) > DENSE_STREAM_THRESHOLD)
+        big = os.path.getsize(path) > DENSE_STREAM_THRESHOLD
+        streamed = n_features is not None and big
+        if big and not streamed:
+            from cycloneml_tpu.util.logging import get_logger
+            get_logger(__name__).warning(
+                "read_libsvm: %s exceeds the streaming threshold but "
+                "n_features was not given — falling back to WHOLE-FILE "
+                "driver materialization; pass n_features to stream, or use "
+                "SparseInstanceDataset.from_libsvm_stream (infers it)", path)
     if streamed:
         if n_features is None:
             raise ValueError("streamed dense libsvm ingest requires "

@@ -155,8 +155,7 @@ class RowMatrix:
             vs = jnp.asarray(vecs / sigmas[None, :])
             ux = jax.jit(lambda x, m: jnp.dot(
                 x, m, precision=jax.lax.Precision.HIGHEST))(self.dataset.x, vs)
-            ds = InstanceDataset(self.dataset.ctx, ux, self.dataset.y,
-                                 self.dataset.w, n, int(sigmas.size))
+            ds = self.dataset.derive(x=ux, n_features=int(sigmas.size))
             u = RowMatrix(ds)
         return SVDResult(u, s, v)
 
@@ -218,8 +217,7 @@ class RowMatrix:
         barr = jnp.asarray(np.asarray(b.to_array(), dtype=self.dataset.x.dtype))
         out = jax.jit(lambda x, m: jnp.dot(
             x, m, precision=jax.lax.Precision.HIGHEST))(self.dataset.x, barr)
-        ds = InstanceDataset(self.dataset.ctx, out, self.dataset.y,
-                             self.dataset.w, self.num_rows(), b.num_cols)
+        ds = self.dataset.derive(x=out, n_features=b.num_cols)
         return RowMatrix(ds)
 
     def column_similarities(self) -> DenseMatrix:

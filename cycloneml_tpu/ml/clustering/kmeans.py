@@ -92,8 +92,7 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
         if cosine:
             # cosine distance clusters on the unit sphere: normalize once
             norm = jax.jit(lambda x: normalize_rows(jnp, x))
-            ds = InstanceDataset(ds.ctx, norm(ds.x), ds.y, ds.w,
-                                 ds.n_rows, ds.n_features)
+            ds = ds.derive(x=norm(ds.x))
 
         centers = self._init_centers(ds, k)
 

@@ -286,8 +286,7 @@ def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray):
     inv_std = np.where(features_std > 0, 1.0 / np.where(
         features_std > 0, features_std, 1.0), 0.0)
     scaled = _get_scale_rows()(ds.x, jnp.asarray(inv_std))
-    return InstanceDataset(ds.ctx, scaled, ds.y, ds.w, ds.n_rows,
-                           ds.n_features), inv_std
+    return ds.derive(x=scaled), inv_std
 
 
 def validate_binary_labels(y: np.ndarray, what: str) -> None:

@@ -126,6 +126,44 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         model._set_parent(self)
         return model
 
+    def _checkpoint_setup(self, rank, n_users, n_items, ratings):
+        """Shared checkpoint plumbing for both trainers: returns
+        ``(ck, ck_fp, start_iter, saved_u, saved_i)`` with factors in
+        ENTITY order (or None when starting fresh). The fingerprint binds
+        the directory to this dataset+hyperparameters — resuming foreign
+        factors silently returns the wrong model (or crashes on shape)."""
+        if not self.get("checkpointDir"):
+            return None, None, 0, None, None
+        import hashlib
+        from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+        ck = TrainingCheckpointer(self.get("checkpointDir"))
+        ck_fp = hashlib.sha1(repr((
+            rank, n_users, n_items, len(ratings),
+            float(np.sum(ratings)), self.get("implicitPrefs"),
+            self.get("regParam"), self.get("alpha"),
+            self.get("nonnegative"), self.get("seed"),
+        )).encode()).hexdigest()[:16]
+        latest = ck.latest_step()
+        if latest is None:
+            return ck, ck_fp, 0, None, None
+        saved_fp = ck.metadata(latest).get("fingerprint")
+        if saved_fp != ck_fp:
+            raise ValueError(
+                f"checkpoint dir {ck.directory!r} holds factors for "
+                f"a DIFFERENT ALS run (fingerprint {saved_fp} != "
+                f"{ck_fp}); clear the directory or use a new one")
+        saved = ck.restore(latest)
+        start_iter = int(saved["iteration"])
+        if start_iter > self.get("maxIter"):
+            # equality is fine: the checkpoint IS the requested model
+            raise ValueError(
+                f"checkpoint is at iteration {start_iter} but "
+                f"maxIter={self.get('maxIter')}; returning it as-is "
+                "would be an over-trained model — raise maxIter or "
+                "clear the checkpoint directory")
+        logger.info("ALS resuming from checkpoint iteration %d", start_iter)
+        return ck, ck_fp, start_iter, saved["u_fac"], saved["i_fac"]
+
     def _train(self, users, items, ratings, n_users, n_items, rank, ctx):
         import jax
         import jax.numpy as jnp
@@ -142,7 +180,8 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         reg = self.get("regParam")
         alpha = self.get("alpha")
         nonneg = self.get("nonnegative")
-        dtype = np.float32
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        dtype = compute_dtype()  # f32 on TPU; f64 under the x64 test config
 
         # shard COO triplets over the mesh with zero-weight padding, row
         # count shaped so each shard splits evenly into scan chunks: the
@@ -216,42 +255,11 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         def yty_of(f):
             return jnp.dot(f.T, f, precision=hi)
 
-        ck = None
-        ck_fp = None
-        start_iter = 0
-        if self.get("checkpointDir"):
-            import hashlib
-            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
-            ck = TrainingCheckpointer(self.get("checkpointDir"))
-            # bind the dir to this dataset+hyperparameters: resuming foreign
-            # factors silently returns the wrong model (or crashes on shape)
-            ck_fp = hashlib.sha1(repr((
-                rank, n_users, n_items, len(ratings),
-                float(np.sum(ratings)), self.get("implicitPrefs"),
-                self.get("regParam"), self.get("alpha"),
-                self.get("nonnegative"), self.get("seed"),
-            )).encode()).hexdigest()[:16]
-            latest = ck.latest_step()
-            if latest is not None:
-                saved_fp = ck.metadata(latest).get("fingerprint")
-                if saved_fp != ck_fp:
-                    raise ValueError(
-                        f"checkpoint dir {ck.directory!r} holds factors for "
-                        f"a DIFFERENT ALS run (fingerprint {saved_fp} != "
-                        f"{ck_fp}); clear the directory or use a new one")
-                saved = ck.restore(latest)
-                start_iter = int(saved["iteration"])
-                if start_iter > self.get("maxIter"):
-                    # equality is fine: the checkpoint IS the requested model
-                    raise ValueError(
-                        f"checkpoint is at iteration {start_iter} but "
-                        f"maxIter={self.get('maxIter')}; returning it as-is "
-                        "would be an over-trained model — raise maxIter or "
-                        "clear the checkpoint directory")
-                u_fac = jnp.asarray(saved["u_fac"], dtype)
-                i_fac = jnp.asarray(saved["i_fac"], dtype)
-                logger.info("ALS resuming from checkpoint iteration %d",
-                            start_iter)
+        ck, ck_fp, start_iter, saved_u, saved_i = self._checkpoint_setup(
+            rank, n_users, n_items, ratings)
+        if saved_u is not None:
+            u_fac = jnp.asarray(saved_u, dtype)
+            i_fac = jnp.asarray(saved_i, dtype)
 
         zero_yty = jnp.zeros((rank, rank), dtype=dtype)
         for it in range(start_iter, self.get("maxIter")):
@@ -307,7 +315,8 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         reg = self.get("regParam")
         alpha = self.get("alpha")
         nonneg = self.get("nonnegative")
-        dtype = np.float32
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        dtype = compute_dtype()  # f32 on TPU; f64 under the x64 test config
         budget = int(self.get("aggregationChunkBytes"))
 
         n_loc_u = -(-n_users // D)
@@ -422,37 +431,10 @@ class ALS(Estimator, _ALSParams, MLWritable, MLReadable):
         u0 = np.abs(rng.normal(size=(n_users, rank))) / np.sqrt(rank)
         i0 = np.abs(rng.normal(size=(n_items, rank))) / np.sqrt(rank)
 
-        ck = None
-        ck_fp = None
-        start_iter = 0
-        if self.get("checkpointDir"):
-            import hashlib
-            from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
-            ck = TrainingCheckpointer(self.get("checkpointDir"))
-            ck_fp = hashlib.sha1(repr((
-                rank, n_users, n_items, len(ratings),
-                float(np.sum(ratings)), self.get("implicitPrefs"),
-                self.get("regParam"), self.get("alpha"),
-                self.get("nonnegative"), self.get("seed"),
-            )).encode()).hexdigest()[:16]
-            latest = ck.latest_step()
-            if latest is not None:
-                saved_fp = ck.metadata(latest).get("fingerprint")
-                if saved_fp != ck_fp:
-                    raise ValueError(
-                        f"checkpoint dir {ck.directory!r} holds factors for "
-                        f"a DIFFERENT ALS run (fingerprint {saved_fp} != "
-                        f"{ck_fp}); clear the directory or use a new one")
-                saved = ck.restore(latest)
-                start_iter = int(saved["iteration"])
-                if start_iter > self.get("maxIter"):
-                    raise ValueError(
-                        f"checkpoint is at iteration {start_iter} but "
-                        f"maxIter={self.get('maxIter')}; raise maxIter or "
-                        "clear the checkpoint directory")
-                u0, i0 = saved["u_fac"], saved["i_fac"]
-                logger.info("blocked ALS resuming from checkpoint "
-                            "iteration %d", start_iter)
+        ck, ck_fp, start_iter, saved_u, saved_i = self._checkpoint_setup(
+            rank, n_users, n_items, ratings)
+        if saved_u is not None:
+            u0, i0 = saved_u, saved_i
 
         u_fac = to_layout(u0.astype(dtype), n_loc_u)
         i_fac = to_layout(i0.astype(dtype), n_loc_i)
