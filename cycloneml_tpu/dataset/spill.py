@@ -65,6 +65,28 @@ def stable_hash(key: Any) -> int:
 _CHUNK_ENTRIES = 4096
 
 
+def read_frame(fh) -> "bytes | None":
+    """Read one [u32 len][payload] frame; returns the raw payload (b"" for
+    a zero-length frame) or None at clean EOF. The ONE definition of the
+    spill/exchange frame format — file runs, disk partitions, and the wire
+    protocol all read through here."""
+    hdr = fh.read(4)
+    if len(hdr) < 4:
+        return None
+    (n,) = struct.unpack("<I", hdr)
+    return fh.read(n) if n else b""
+
+
+def iter_frames(fh) -> Iterator[Any]:
+    """Yield the decoded records of every frame in a chunked spill stream."""
+    from cycloneml_tpu.native.host import CompressionCodec
+    while True:
+        blob = read_frame(fh)
+        if blob is None:
+            return
+        yield from pickle.loads(CompressionCodec.decompress(blob))
+
+
 class _SpillFile:
     """One sorted run: [u32 length][compressed pickled chunk]..."""
 
@@ -88,14 +110,7 @@ class _SpillFile:
 
     def __iter__(self) -> Iterator[Tuple[int, Any, list]]:
         with open(self.path, "rb") as fh:
-            while True:
-                hdr = fh.read(4)
-                if len(hdr) < 4:
-                    return
-                (n,) = struct.unpack("<I", hdr)
-                from cycloneml_tpu.native.host import CompressionCodec
-                chunk = pickle.loads(CompressionCodec.decompress(fh.read(n)))
-                yield from chunk
+            yield from iter_frames(fh)
 
     def delete(self) -> None:
         try:
@@ -188,3 +203,104 @@ class ExternalAppendOnlyMap:
 
     def __len__(self) -> int:
         return len(self._map)
+
+
+class SpilledPartition:
+    """A disk-backed partition: a sequence of records stored as
+    independently-compressed pickled chunks (same on-disk shape as a spill
+    run, minus the sort). Iterating streams one chunk at a time; ``len`` is
+    O(1). This is the storage the host tier's shuffle outputs use past the
+    row budget — the analog of the reference's shuffle block files
+    (ref ShuffleBlockResolver; ExternalSorter.scala:93 writes the same
+    chunked spill shape).
+    """
+
+    def __init__(self, path: str, n_rows: int, owned: bool = False):
+        self.path = path
+        self.n_rows = n_rows
+        # owned partitions are temp shuffle outputs: deleted on GC so lazy
+        # re-materialization cannot leak /tmp; checkpoint copies are not
+        # owned (their files belong to the checkpoint directory)
+        self._owned = owned
+
+    @classmethod
+    def writer(cls, spill_dir: Optional[str] = None,
+               codec: str = "zstd") -> "_PartitionWriter":
+        return _PartitionWriter(spill_dir or tempfile.gettempdir(), codec)
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def __iter__(self) -> Iterator[Any]:
+        with open(self.path, "rb") as fh:
+            yield from iter_frames(fh)
+
+    def __getitem__(self, idx):
+        """List-style indexing for the take()/head() paths (streams, then
+        stops); scalar access is O(position) — this is shuffle storage, not
+        a random-access store."""
+        import itertools
+        if isinstance(idx, slice):
+            start, stop, step = idx.indices(self.n_rows)
+            if step < 0:  # rare path; correctness over streaming
+                return list(self)[idx]
+            return list(itertools.islice(iter(self), start, stop, step))
+        if idx < 0:
+            idx += self.n_rows
+        if not 0 <= idx < self.n_rows:
+            raise IndexError(idx)
+        return next(itertools.islice(iter(self), idx, None))
+
+    def delete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def __del__(self):
+        if getattr(self, "_owned", False):
+            self.delete()
+
+
+class _PartitionWriter:
+    """Buffered append-side of a SpilledPartition."""
+
+    def __init__(self, spill_dir: str, codec: str):
+        from cycloneml_tpu.native.host import CompressionCodec
+        fd, self._path = tempfile.mkstemp(prefix="part-", suffix=".blk",
+                                          dir=spill_dir)
+        self._fh = os.fdopen(fd, "wb")
+        self._codec = CompressionCodec(codec)
+        self._buf: list = []
+        self._rows = 0
+
+    def append(self, record: Any) -> None:
+        self._buf.append(record)
+        self._rows += 1
+        if len(self._buf) >= _CHUNK_ENTRIES:
+            self._flush()
+
+    def extend(self, records) -> None:
+        for r in records:
+            self.append(r)
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        blob = self._codec.compress(
+            pickle.dumps(self._buf, protocol=pickle.HIGHEST_PROTOCOL))
+        self._fh.write(struct.pack("<I", len(blob)))
+        self._fh.write(blob)
+        self._buf = []
+
+    def finish(self) -> SpilledPartition:
+        self._flush()
+        self._fh.close()
+        return SpilledPartition(self._path, self._rows, owned=True)
+
+    def abort(self) -> None:
+        try:
+            self._fh.close()
+            os.unlink(self._path)
+        except OSError:
+            pass

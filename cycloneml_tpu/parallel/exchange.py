@@ -1,0 +1,270 @@
+"""Cross-process hash exchange — the host-tier shuffle fabric.
+
+The multihost analog of the reference's ShuffleExchangeExec + block transfer
+service (ref: sql/core/.../exchange/ShuffleExchangeExec.scala:115,
+core/.../network/netty/NettyBlockTransferService.scala): every worker
+streams its keyed records to the worker that owns each record's hash bucket
+over plain TCP, and the receive side appends straight into disk-backed
+bucket files — NEITHER side ever materializes a partition in memory, so a
+group-by/join can span processes whose combined data exceeds any single
+process's RAM.
+
+Design points, TPU-first framing:
+- This fabric carries only host-tier OBJECT data (ETL, keyed joins). The
+  numeric path never touches it — tensors shuffle via XLA collectives
+  (``all_to_all_repartition``) on the mesh.
+- Bucket ownership is static: bucket ``b`` of ``n_buckets`` lives on worker
+  ``b % n_workers``. Partitioning uses :func:`stable_hash`, the same
+  PYTHONHASHSEED-independent hash the in-process shuffle uses, so every
+  process routes identically (the reference's Partitioner contract).
+- Wire format mirrors the spill-file shape: ``[u32 len][zstd(pickled
+  (bucket_id, [records]))]`` frames, a zero-length frame meaning "this
+  sender is done". One connection per (sender, receiver) pair.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from cycloneml_tpu.dataset.spill import (ExternalAppendOnlyMap,
+                                         SpilledPartition, stable_hash)
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SEND_CHUNK = 2048  # records per frame
+
+
+class _BucketStore:
+    """Receive-side storage: per-bucket disk-backed writers (bounded RAM)."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._writers: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        self._spill_dir = spill_dir
+
+    def append(self, bucket: int, records: List[Any]) -> None:
+        with self._lock:
+            w = self._writers.get(bucket)
+            if w is None:
+                w = self._writers[bucket] = SpilledPartition.writer(
+                    self._spill_dir)
+            w.extend(records)
+
+    def finish(self) -> Dict[int, SpilledPartition]:
+        with self._lock:
+            out = {b: w.finish() for b, w in self._writers.items()}
+            self._writers = {}
+            return out
+
+
+class HashExchange:
+    """One exchange round among ``n_workers`` cooperating processes.
+
+    Usage (identical on every worker)::
+
+        ex = HashExchange(rank, addresses, n_buckets)   # starts listening
+        ex.put_all(pairs)        # route (key, value) records everywhere
+        buckets = ex.finish()    # barrier; {bucket_id: SpilledPartition}
+
+    ``addresses[rank]`` must be this worker's own ``host:port``. The
+    ``finish`` barrier completes when every peer's DONE frame has arrived.
+    """
+
+    def __init__(self, rank: int, addresses: List[str], n_buckets: int,
+                 spill_dir: Optional[str] = None):
+        self.rank = rank
+        self.addresses = list(addresses)
+        self.n_workers = len(addresses)
+        self.n_buckets = n_buckets
+        self._store = _BucketStore(spill_dir)
+        self._done = threading.Semaphore(0)
+        self._failed: List[str] = []
+        self._send_bufs: Dict[int, List[Tuple[int, Any]]] = {}
+        self._socks: Dict[int, socket.socket] = {}
+        from cycloneml_tpu.native.host import CompressionCodec
+        self._codec = CompressionCodec("zstd")
+        self._server = self._serve()
+
+    # -- receive side -------------------------------------------------------
+    def _serve(self):
+        store, done, failed = self._store, self._done, self._failed
+        host, port = self.addresses[self.rank].rsplit(":", 1)
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    from cycloneml_tpu.dataset.spill import read_frame
+                    fh = self.request.makefile("rb")
+                    while True:
+                        blob = read_frame(fh)
+                        if blob is None:
+                            failed.append("connection dropped before DONE")
+                            done.release()
+                            return
+                        if not blob:  # zero-length frame: sender finished
+                            done.release()
+                            return
+                        from cycloneml_tpu.native.host import CompressionCodec
+                        bucket, records = pickle.loads(
+                            CompressionCodec.decompress(blob))
+                        store.append(bucket, records)
+                except Exception as e:  # surfaced at finish()
+                    failed.append(repr(e))
+                    done.release()  # unblock the barrier so finish() can
+                    #                raise the REAL error, not a timeout
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        srv = Server((host, int(port)), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name=f"exchange-server-{self.rank}")
+        t.start()
+        return srv
+
+    # -- send side ----------------------------------------------------------
+    def _owner(self, bucket: int) -> int:
+        return bucket % self.n_workers
+
+    def _sock(self, peer: int) -> socket.socket:
+        s = self._socks.get(peer)
+        if s is None:
+            import time
+            host, port = self.addresses[peer].rsplit(":", 1)
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=120)
+                    break
+                except OSError:
+                    # peers start independently; retry until the receiver
+                    # has bound its port (the reference's block transfer
+                    # retries the same way, RetryingBlockTransferor)
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            self._socks[peer] = s
+        return s
+
+    def _send_frame(self, peer: int, bucket: int,
+                    records: List[Any]) -> None:
+        blob = self._codec.compress(
+            pickle.dumps((bucket, records),
+                         protocol=pickle.HIGHEST_PROTOCOL))
+        self._sock(peer).sendall(struct.pack("<I", len(blob)) + blob)
+
+    def put(self, key: Any, value: Any) -> None:
+        bucket = stable_hash(key) % self.n_buckets
+        peer = self._owner(bucket)
+        if peer == self.rank:  # loopback skips the wire
+            self._store.append(bucket, [(key, value)])
+            return
+        buf = self._send_bufs.setdefault(peer, [])
+        buf.append((bucket, (key, value)))
+        if len(buf) >= _SEND_CHUNK:
+            self._flush_peer(peer)
+
+    def put_all(self, pairs: Iterable[Tuple[Any, Any]]) -> None:
+        for k, v in pairs:
+            self.put(k, v)
+
+    def _flush_peer(self, peer: int) -> None:
+        buf = self._send_bufs.get(peer)
+        if not buf:
+            return
+        by_bucket: Dict[int, List[Any]] = {}
+        for bucket, rec in buf:
+            by_bucket.setdefault(bucket, []).append(rec)
+        for bucket, records in by_bucket.items():
+            self._send_frame(peer, bucket, records)
+        self._send_bufs[peer] = []
+
+    # -- completion ---------------------------------------------------------
+    def finish(self, timeout: float = 300.0) -> Dict[int, SpilledPartition]:
+        """Flush, signal DONE to every peer, await every peer's DONE, and
+        return this worker's buckets as disk-backed partitions."""
+        for peer in range(self.n_workers):
+            if peer == self.rank:
+                continue
+            self._flush_peer(peer)
+            self._sock(peer).sendall(struct.pack("<I", 0))
+        # expect one DONE per remote peer
+        for _ in range(self.n_workers - 1):
+            if not self._done.acquire(timeout=timeout):
+                if self._failed:
+                    raise IOError(
+                        f"exchange receive failed: {self._failed[:3]}")
+                raise TimeoutError(
+                    f"exchange barrier timed out on rank {self.rank}")
+        if self._failed:
+            raise IOError(f"exchange receive failed: {self._failed[:3]}")
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._server.shutdown()
+        self._server.server_close()
+        return self._store.finish()
+
+
+def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
+                          addresses: List[str], n_buckets: int,
+                          row_budget: int = 1 << 20,
+                          ) -> Iterator[Tuple[Any, list]]:
+    """Distributed groupByKey: exchange, then stream each owned bucket
+    through a spilling aggregation map. Yields ``(key, [values])`` for the
+    keys THIS worker owns; memory stays O(row_budget + one chunk)."""
+    ex = HashExchange(rank, addresses, n_buckets)
+    ex.put_all(pairs)
+    buckets = ex.finish()  # eager: the barrier must not wait on a consumer
+
+    def stream():
+        for b in sorted(buckets):
+            agg = ExternalAppendOnlyMap(row_budget=row_budget)
+            part = buckets[b]
+            agg.insert_all(iter(part))
+            part.delete()
+            yield from agg.items()
+
+    return stream()
+
+
+def exchange_join(left: Iterable[Tuple[Any, Any]],
+                  right: Iterable[Tuple[Any, Any]], rank: int,
+                  addresses: List[str], n_buckets: int,
+                  row_budget: int = 1 << 20,
+                  ) -> Iterator[Tuple[Any, Tuple[Any, Any]]]:
+    """Distributed inner hash join: both sides exchange on the same bucket
+    map (records tagged by side), then each owned key yields the cross
+    product — the reference's shuffled hash join
+    (ShuffledHashJoinExec.scala:39). Yields ``(key, (lv, rv))``."""
+    ex = HashExchange(rank, addresses, n_buckets)
+    ex.put_all((k, (0, v)) for k, v in left)
+    ex.put_all((k, (1, v)) for k, v in right)
+    buckets = ex.finish()  # eager: the barrier must not wait on a consumer
+
+    def stream():
+        for b in sorted(buckets):
+            agg = ExternalAppendOnlyMap(row_budget=row_budget)
+            part = buckets[b]
+            agg.insert_all(iter(part))
+            part.delete()
+            for k, tagged_vals in agg.items():
+                lvs = [v for t, v in tagged_vals if t == 0]
+                if not lvs:
+                    continue
+                for t, rv in tagged_vals:
+                    if t == 1:
+                        for lv in lvs:
+                            yield k, (lv, rv)
+
+    return stream()

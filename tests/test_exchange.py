@@ -1,0 +1,143 @@
+"""Cross-process hash exchange (round-3 verdict item 5).
+
+Two REAL processes shuffle keyed records to each other over TCP and
+aggregate/join datasets whose combined size exceeds any single process's
+row budget many times over, with bounded RSS — the host-tier analog of the
+reference's ShuffleExchangeExec + ExternalSorter pipeline (tensor data
+never rides this fabric; it shuffles via XLA collectives on the mesh).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+AGG_WORKER = textwrap.dedent("""
+    import json, os, resource, sys
+    rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                  sys.argv[3], sys.argv[4])
+    from cycloneml_tpu.parallel.exchange import exchange_group_by_key
+    from cycloneml_tpu.dataset.spill import stable_hash
+    base_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+    N_KEYS, PER_KEY = 50_000, 8           # 400k records per worker
+    VALUE = "v" * 200                      # ~200 B payload per record
+
+    def pairs():                           # generated lazily: the dataset
+        for i in range(N_KEYS * PER_KEY):  # never exists in memory at once
+            yield (rank * 31 + i) % N_KEYS, VALUE
+
+    groups = exchange_group_by_key(pairs(), rank, [addr0, addr1],
+                                   n_buckets=64, row_budget=20_000)
+    n_keys = n_vals = 0
+    key_sum = 0
+    for k, vs in groups:
+        n_keys += 1
+        n_vals += len(vs)
+        key_sum += k
+        assert all(v == VALUE for v in vs)
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    with open(os.path.join(outdir, f"agg_{rank}.json"), "w") as fh:
+        json.dump({"n_keys": n_keys, "n_vals": n_vals, "key_sum": key_sum,
+                   "peak_mb": peak_mb, "delta_mb": peak_mb - base_mb}, fh)
+""")
+
+JOIN_WORKER = textwrap.dedent("""
+    import json, os, sys
+    rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                  sys.argv[3], sys.argv[4])
+    from cycloneml_tpu.parallel.exchange import exchange_join
+
+    # each worker holds HALF of each side (keys interleaved by parity)
+    left = [(k, f"L{k}.{rank}") for k in range(rank, 40, 2)]
+    right = [(k, f"R{k}.{rank}") for k in range(rank, 60, 2) if k % 3 == 0]
+    rows = sorted(exchange_join(left, right, rank, [addr0, addr1],
+                                n_buckets=16, row_budget=100))
+    with open(os.path.join(outdir, f"join_{rank}.json"), "w") as fh:
+        json.dump(rows, fh)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_two(script, tmp_path):
+    wp = tmp_path / "worker.py"
+    wp.write_text(script)
+    addrs = [f"localhost:{_free_port()}", f"localhost:{_free_port()}"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(wp), str(r), addrs[0], addrs[1], str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=280)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+
+def test_two_process_groupby_bounded_rss(tmp_path):
+    """800k records (~160 MB with per-value payloads) shuffle between two
+    processes and aggregate with a 20k-row budget (40x smaller than the
+    data): every key lands on exactly one owner with all 16 values, and
+    each worker's peak RSS stays far below the dataset it processed."""
+    _run_two(AGG_WORKER, tmp_path)
+    res = [json.load(open(tmp_path / f"agg_{r}.json")) for r in range(2)]
+    # complete, disjoint ownership of the keyspace
+    assert res[0]["n_keys"] + res[1]["n_keys"] == 50_000
+    assert res[0]["key_sum"] + res[1]["key_sum"] == sum(range(50_000))
+    assert res[0]["n_vals"] + res[1]["n_vals"] == 2 * 50_000 * 8
+    # each side held ~80 MB of record payloads; bounded processing keeps
+    # the RSS growth OVER the import baseline (the package import is
+    # ~150 MB of numpy/jax, unrelated to data volume) at buffers + the
+    # 20k-row budget — far below the data processed
+    for r in res:
+        assert r["delta_mb"] < 60, r
+
+
+def test_two_process_inner_join(tmp_path):
+    _run_two(JOIN_WORKER, tmp_path)
+    rows = sorted(sum((json.load(open(tmp_path / f"join_{r}.json"))
+                       for r in range(2)), []))
+    rows = [(k, tuple(pair)) for k, pair in rows]
+    # expected inner join computed directly
+    left = [(k, f"L{k}.{k % 2}") for k in range(40)]
+    right = [(k, f"R{k}.{k % 2}") for k in range(60) if k % 3 == 0]
+    lmap = dict(left)
+    expect = sorted((k, (lmap[k], rv)) for k, rv in right if k in lmap)
+    assert rows == expect
+
+
+def test_group_by_key_output_partitions_spill(ctx):
+    """In-process shuffle outputs past the row budget become disk-backed
+    partitions, and the RDD surface (collect/count/take) streams them."""
+    from cycloneml_tpu.conf import SHUFFLE_SPILL_ROW_BUDGET
+    from cycloneml_tpu.dataset.dataset import PartitionedDataset
+    from cycloneml_tpu.dataset.spill import SpilledPartition
+
+    old = ctx.conf.get(SHUFFLE_SPILL_ROW_BUDGET)
+    ctx.conf.set(SHUFFLE_SPILL_ROW_BUDGET, "64")
+    try:
+        data = [(i % 500, i) for i in range(4000)]
+        pd = PartitionedDataset.from_sequence(ctx, data, 2)
+        grouped = pd.group_by_key()
+        parts = grouped._partitions()
+        assert any(isinstance(p, SpilledPartition) for p in parts), \
+            [type(p).__name__ for p in parts]
+        got = dict(grouped.collect())
+        assert len(got) == 500
+        assert sorted(got[7]) == list(range(7, 4000, 500))
+        assert grouped.count() == 500
+        assert len(grouped.take(10)) == 10
+    finally:
+        ctx.conf.set(SHUFFLE_SPILL_ROW_BUDGET, str(old))
