@@ -323,6 +323,18 @@ LBFGS_DEVICE_CHUNK = (
     .int_conf(16)
 )
 
+USE_PALLAS_KERNELS = (
+    ConfigBuilder("cyclone.ml.usePallasKernels")
+    .doc("Route the binomial LogisticRegression aggregator and the KMeans "
+         "assignment step through the hand-written Pallas kernels "
+         "(ops/kernels.py) instead of the XLA-fused jnp aggregators. "
+         "Default off: the committed A/B microbenchmark "
+         "(benchmarks/PALLAS_AB.md) shows XLA fusion within ~1.5x (slightly "
+         "ahead) on gemv-shaped MLlib workloads — the kernels are the "
+         "escape hatch for shapes XLA schedules poorly.")
+    .bool_conf(False)
+)
+
 SHUFFLE_SPILL_ROW_BUDGET = (
     ConfigBuilder("cyclone.shuffle.spill.rowBudget")
     .doc("Values held in memory per host-shuffle bucket before spilling a "

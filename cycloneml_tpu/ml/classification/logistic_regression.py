@@ -355,7 +355,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 features_std=np.tile(features_std, num_classes),
                 standardize=standardize) if l2 > 0 else None
         else:
-            agg = aggregators.binary_logistic(d, fit_intercept)
+            from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+            use_pallas = (hasattr(ds.ctx, "conf")
+                          and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
+            agg = (aggregators.binary_logistic_pallas(d, fit_intercept)
+                   if use_pallas
+                   else aggregators.binary_logistic(d, fit_intercept))
             n_coef = d + (1 if fit_intercept else 0)
             x0 = np.zeros(n_coef)
             if fit_intercept and 0 < histogram[1:].sum() < weight_sum:

@@ -97,16 +97,33 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
         centers = self._init_centers(ds, k)
 
         hi = jax.lax.Precision.HIGHEST
+        from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+        use_pallas = (hasattr(ds.ctx, "conf")
+                      and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
 
-        def lloyd_step(x, y, w, c):
-            # (b,k) squared distances via the MXU
-            d2 = pairwise_sq_dists(jnp, x, c, precision=hi)
-            assign = jnp.argmin(d2, axis=1)
-            onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * w[:, None]
-            sums = jnp.dot(onehot.T, x, precision=hi)        # (k,d) center sums
-            counts = jnp.sum(onehot, axis=0)                  # (k,)
-            cost = jnp.sum(w * jnp.maximum(jnp.min(d2, axis=1), 0.0))
-            return {"sums": sums, "counts": counts, "cost": cost}
+        if use_pallas:
+            from cycloneml_tpu.ops.kernels import fused_kmeans_assign
+
+            def lloyd_step(x, y, w, c):
+                # fused distance+argmin kernel (the (T, k) tile never
+                # leaves VMEM), then segment-sum center updates
+                best, dist = fused_kmeans_assign(x, c)
+                wv = w.astype(x.dtype)
+                sums = jax.ops.segment_sum(x * wv[:, None], best,
+                                           num_segments=k)
+                counts = jax.ops.segment_sum(wv, best, num_segments=k)
+                cost = jnp.sum(wv * dist.astype(x.dtype))
+                return {"sums": sums, "counts": counts, "cost": cost}
+        else:
+            def lloyd_step(x, y, w, c):
+                # (b,k) squared distances via the MXU
+                d2 = pairwise_sq_dists(jnp, x, c, precision=hi)
+                assign = jnp.argmin(d2, axis=1)
+                onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * w[:, None]
+                sums = jnp.dot(onehot.T, x, precision=hi)    # (k,d) center sums
+                counts = jnp.sum(onehot, axis=0)              # (k,)
+                cost = jnp.sum(w * jnp.maximum(jnp.min(d2, axis=1), 0.0))
+                return {"sums": sums, "counts": counts, "cost": cost}
 
         step = ds.tree_aggregate_fn(lloyd_step)
         tol = self.get("tol")

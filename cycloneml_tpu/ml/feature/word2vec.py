@@ -44,6 +44,12 @@ class _W2VParams(_InOutCol, HasMaxIter, HasSeed):
         self.maxSentenceLength = self._param("maxSentenceLength",
                                              "sentence truncation", V.gt(0),
                                              default=1000)
+        # "ns" (default, negative sampling — the TPU-native batched form) or
+        # "hs" (hierarchical softmax over a Huffman tree, the reference's
+        # exact objective, Word2Vec.scala:73 createBinaryTree — loss curves
+        # become comparable with the reference/word2vec.c)
+        self.solver = self._param("solver", "ns | hs",
+                                  V.in_array(["ns", "hs"]), default="ns")
 
 
 class Word2Vec(Estimator, _W2VParams, MLWritable, MLReadable):
@@ -90,13 +96,16 @@ class Word2Vec(Estimator, _W2VParams, MLWritable, MLReadable):
         centers = np.asarray(centers, np.int32)
         contexts = np.asarray(contexts, np.int32)
 
+        rng = np.random.RandomState(self.get("seed"))
+        w_in = jnp.asarray(
+            (rng.rand(n_vocab, dim) - 0.5) / dim, dtype=jnp.float32)
+        if self.get("solver") == "hs":
+            return self._fit_hs(vocab, counts, centers, contexts, w_in, rng)
+
         # unigram^(3/4) negative-sampling table
         freq = np.array([counts[w] for w in vocab], dtype=np.float64) ** 0.75
         neg_probs = jnp.asarray(freq / freq.sum(), dtype=jnp.float32)
 
-        rng = np.random.RandomState(self.get("seed"))
-        w_in = jnp.asarray(
-            (rng.rand(n_vocab, dim) - 0.5) / dim, dtype=jnp.float32)
         w_out = jnp.zeros((n_vocab, dim), dtype=jnp.float32)
         n_neg = self.get("negative")
         lr = self.get("stepSize")
@@ -137,6 +146,122 @@ class Word2Vec(Estimator, _W2VParams, MLWritable, MLReadable):
         m = Word2VecModel(vocab, vectors, uid=self.uid)
         self._copy_values(m)
         return m._set_parent(self)
+
+    def _fit_hs(self, vocab, counts, centers, contexts, w_in, rng):
+        """Hierarchical-softmax skip-gram (the reference's exact objective,
+        Word2Vec.scala:73): a Huffman tree over word frequencies gives each
+        word a root path of inner nodes + branch bits; each (center,
+        context) pair updates the CONTEXT word's input vector against the
+        CENTER word's path (word2vec.c / the reference's orientation). All
+        path updates for a batch run as one jitted gather/scatter program —
+        the per-pair inner loop of the reference becomes an (b, L, dim)
+        einsum. Per-epoch mean loss is recorded on the model
+        (``training_loss_``) so curves are comparable with word2vec.c/
+        gensim hs runs."""
+        import jax
+        import jax.numpy as jnp
+
+        n_vocab = len(vocab)
+        dim = self.get("vectorSize")
+        lr = self.get("stepSize")
+        points, codes, lengths = _huffman_paths(
+            np.array([counts[w] for w in vocab], dtype=np.int64))
+        L = points.shape[1]
+        pts = jnp.asarray(points)               # (V, L) inner-node ids
+        cds = jnp.asarray(codes, jnp.float32)   # (V, L) branch bits
+        msk = jnp.asarray(
+            np.arange(L)[None, :] < lengths[:, None], jnp.float32)
+        w_node = jnp.zeros((max(n_vocab - 1, 1), dim), jnp.float32)
+
+        @jax.jit
+        def step(w_in, w_node, c_idx, ctx_idx):
+            vin = w_in[ctx_idx]                        # (b, dim)
+            nodes = pts[c_idx]                         # (b, L)
+            code = cds[c_idx]
+            mask = msk[c_idx]
+            vn = w_node[nodes]                         # (b, L, dim)
+            dot = jnp.einsum("bd,bld->bl", vin, vn)
+            score = jax.nn.sigmoid(dot)
+            # word2vec.c: g = (1 - code - sigmoid(dot)); here as gradient of
+            # -log sigma((1-2*code) * dot)
+            g = (score - (1.0 - code)) * mask          # (b, L)
+            d_vin = jnp.einsum("bl,bld->bd", g, vn)
+            d_vn = g[:, :, None] * vin[:, None, :]
+            w_in = w_in.at[ctx_idx].add(-lr * d_vin)
+            w_node = w_node.at[nodes.reshape(-1)].add(
+                -lr * d_vn.reshape(-1, vin.shape[1]))
+            sign = 1.0 - 2.0 * code
+            loss = -jnp.sum(mask * jax.nn.log_sigmoid(sign * dot))
+            return w_in, w_node, loss
+
+        batch = 8192
+        n_pairs = len(centers)
+        loss_history = []
+        for _epoch in range(self.get("maxIter")):
+            perm = rng.permutation(n_pairs)
+            total = 0.0
+            for s0 in range(0, n_pairs, batch):
+                sel = perm[s0: s0 + batch]
+                w_in, w_node, loss = step(w_in, w_node,
+                                          jnp.asarray(centers[sel]),
+                                          jnp.asarray(contexts[sel]))
+                total += float(loss)
+            loss_history.append(total / n_pairs)
+
+        m = Word2VecModel(vocab, np.asarray(w_in, dtype=np.float64),
+                          uid=self.uid)
+        m.training_loss_ = loss_history
+        self._copy_values(m)
+        return m._set_parent(self)
+
+
+def _huffman_paths(freqs: np.ndarray):
+    """Huffman tree over word frequencies (ref createBinaryTree,
+    Word2Vec.scala / word2vec.c CreateBinaryTree): returns
+    ``(points (V, L) int32, codes (V, L) int8, lengths (V,))`` — for word w,
+    ``points[w, :len]`` are the inner-node ids on the root→leaf path and
+    ``codes[w, :len]`` the branch bits taken. Unused slots point at node 0
+    with mask 0 (neutral under the masked update)."""
+    import heapq
+    v = len(freqs)
+    if v == 1:
+        return (np.zeros((1, 1), np.int32), np.zeros((1, 1), np.int8),
+                np.ones(1, np.int64))
+    # nodes 0..v-1 = leaves; v..2v-2 = inner nodes in creation order
+    heap = [(int(f), i) for i, f in enumerate(freqs)]
+    heapq.heapify(heap)
+    parent = np.zeros(2 * v - 1, np.int64)
+    branch = np.zeros(2 * v - 1, np.int8)
+    nxt = v
+    while len(heap) > 1:
+        f1, n1 = heapq.heappop(heap)
+        f2, n2 = heapq.heappop(heap)
+        parent[n1], parent[n2] = nxt, nxt
+        branch[n2] = 1  # the heavier/second pop takes the 1-branch
+        heapq.heappush(heap, (f1 + f2, nxt))
+        nxt += 1
+    root = nxt - 1
+    lengths = np.zeros(v, np.int64)
+    paths, codes_l = [], []
+    for w in range(v):
+        path, code = [], []
+        node = w
+        while node != root:
+            code.append(int(branch[node]))
+            node = parent[node]
+            path.append(node - v)  # inner-node id in [0, v-1)
+        path.reverse()
+        code.reverse()
+        paths.append(path)
+        codes_l.append(code)
+        lengths[w] = len(path)
+    L = int(lengths.max())
+    points = np.zeros((v, L), np.int32)
+    codes = np.zeros((v, L), np.int8)
+    for w in range(v):
+        points[w, :lengths[w]] = paths[w]
+        codes[w, :lengths[w]] = codes_l[w]
+    return points, codes, lengths
 
 
 class Word2VecModel(Model, _W2VParams, MLWritable, MLReadable):

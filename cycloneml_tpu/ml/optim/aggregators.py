@@ -207,3 +207,20 @@ def autodiff_check(agg_loss_only: Callable, d: int):
     hand-derived gradients above (SURVEY §7 step 5: 'where jax.grad can
     replace hand-written gradients (verify parity!)')."""
     return jax.grad(agg_loss_only)
+
+
+def binary_logistic_pallas(d: int, fit_intercept: bool = True) -> Agg:
+    """Pallas-kernel twin of :func:`binary_logistic` — identical contract,
+    one fused VMEM pass per row tile (ops/kernels.fused_binary_logistic).
+    Selected by ``cyclone.ml.usePallasKernels``; math is f32 in-kernel."""
+    return _binary_logistic_pallas(d, fit_intercept)
+
+
+@functools.lru_cache(maxsize=None)
+def _binary_logistic_pallas(d: int, fit_intercept: bool) -> Agg:
+    from cycloneml_tpu.ops.kernels import fused_binary_logistic
+
+    def agg(x, y, w, coef):
+        return fused_binary_logistic(x, y, w, coef, d, fit_intercept)
+
+    return agg

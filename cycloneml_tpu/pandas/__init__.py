@@ -8,9 +8,13 @@ evaluates eagerly and bridges to the plan-based ``sql.DataFrame`` (and on to
 MLFrame/device tiers) when distribution matters. Coverage follows the
 pandas-on-Spark core: selection/assignment, boolean masking, sort_values,
 groupby-agg, merge, fillna/dropna/isna, describe, value_counts, reductions,
-apply, to/from pandas.
+apply, to/from pandas — plus label indexes (set_index/reset_index,
+loc/iloc, aligned Series arithmetic), rolling/expanding windows, the
+.str/.dt accessors, and concat/pivot_table.
 """
 
-from cycloneml_tpu.pandas.frame import CycloneFrame, CycloneSeries, read_csv
+from cycloneml_tpu.pandas.frame import (CycloneFrame, CycloneSeries, concat,
+                                        pivot_table, read_csv)
 
-__all__ = ["CycloneFrame", "CycloneSeries", "read_csv"]
+__all__ = ["CycloneFrame", "CycloneSeries", "concat", "pivot_table",
+           "read_csv"]

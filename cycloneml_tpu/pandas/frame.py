@@ -23,16 +23,38 @@ def _is_null(arr: np.ndarray) -> np.ndarray:
 
 
 class CycloneSeries:
-    """1-D labeled column (ref: pyspark/pandas/series.py)."""
+    """1-D labeled column (ref: pyspark/pandas/series.py). ``index`` is an
+    optional label array; None means positional (RangeIndex)."""
 
-    def __init__(self, values, name: str = ""):
+    def __init__(self, values, name: str = "", index=None):
         self.values = np.asarray(values)
         self.name = name
+        self.index = None if index is None else np.asarray(index)
 
     # -- arithmetic / comparison (elementwise, numpy semantics) ---------------
     def _binop(self, other, op) -> "CycloneSeries":
-        rhs = other.values if isinstance(other, CycloneSeries) else other
-        return CycloneSeries(op(self.values, rhs), self.name)
+        if isinstance(other, CycloneSeries):
+            if (self.index is not None and other.index is not None
+                    and not np.array_equal(self.index, other.index)):
+                # label alignment on the index union, NaN where one side is
+                # missing — the pandas contract (frame.py align paths)
+                union = np.unique(np.concatenate([self.index, other.index]))
+
+                def reindexed(s):
+                    pos = {k: i for i, k in enumerate(s.index)}
+                    out = np.full(len(union), np.nan)
+                    for j, k in enumerate(union):
+                        if k in pos:
+                            out[j] = s.values[pos[k]]
+                    return out
+
+                return CycloneSeries(op(reindexed(self), reindexed(other)),
+                                     self.name, index=union)
+            rhs = other.values
+        else:
+            rhs = other
+        return CycloneSeries(op(self.values, rhs), self.name,
+                             index=self.index)
 
     def __add__(self, o):
         return self._binop(o, np.add)
@@ -137,6 +159,24 @@ class CycloneSeries:
         s.index = vals[order]
         return s
 
+    def rolling(self, window: int, min_periods: Optional[int] = None
+                ) -> "_Rolling":
+        return _Rolling(self.values, window,
+                        window if min_periods is None else min_periods,
+                        self.name, self.index)
+
+    def expanding(self, min_periods: int = 1) -> "_Rolling":
+        return _Rolling(self.values, None, min_periods, self.name,
+                        self.index)
+
+    @property
+    def str(self) -> "_StrAccessor":
+        return _StrAccessor(self)
+
+    @property
+    def dt(self) -> "_DtAccessor":
+        return _DtAccessor(self)
+
     def to_numpy(self) -> np.ndarray:
         return self.values
 
@@ -145,6 +185,261 @@ class CycloneSeries:
 
     def __repr__(self):
         return f"CycloneSeries({self.name!r}, {self.values!r})"
+
+
+class _Rolling:
+    """Rolling (fixed window) / expanding (window=None) aggregations over a
+    1-D numeric array — NaN where fewer than ``min_periods`` observations
+    exist, matching pandas (ref: pyspark/pandas/window.py Rolling)."""
+
+    def __init__(self, values: np.ndarray, window: Optional[int],
+                 min_periods: int, name: str, index):
+        self._v = np.asarray(values, dtype=np.float64)
+        self._window = window
+        self._min = min_periods
+        self._name = name
+        self._index = index
+
+    def _apply(self, fn) -> CycloneSeries:
+        v, n = self._v, len(self._v)
+        out = np.full(n, np.nan)
+        for i in range(n):
+            lo = 0 if self._window is None else max(0, i + 1 - self._window)
+            win = v[lo:i + 1]
+            win = win[~np.isnan(win)]
+            if len(win) >= self._min and len(win):
+                out[i] = fn(win)
+        return CycloneSeries(out, self._name, index=self._index)
+
+    def sum(self):
+        return self._apply(np.sum)
+
+    def mean(self):
+        return self._apply(np.mean)
+
+    def min(self):
+        return self._apply(np.min)
+
+    def max(self):
+        return self._apply(np.max)
+
+    def std(self):
+        return self._apply(lambda w: np.std(w, ddof=1)
+                           if len(w) > 1 else np.nan)
+
+    def count(self):
+        return self._apply(len)
+
+
+class _FrameRolling:
+    """Column-wise rolling over a frame's numeric columns."""
+
+    def __init__(self, frame: "CycloneFrame", window, min_periods):
+        self._frame = frame
+        self._window = window
+        self._min = min_periods
+
+    def _apply(self, op: str) -> "CycloneFrame":
+        out = {}
+        for k, v in self._frame._cols.items():
+            if v.dtype.kind in "if":
+                r = _Rolling(v, self._window,
+                             self._min if self._min is not None
+                             else (self._window or 1), k, None)
+                out[k] = getattr(r, op)().values
+        return self._frame._like(out)
+
+    def sum(self):
+        return self._apply("sum")
+
+    def mean(self):
+        return self._apply("mean")
+
+    def min(self):
+        return self._apply("min")
+
+    def max(self):
+        return self._apply("max")
+
+    def std(self):
+        return self._apply("std")
+
+
+class _StrAccessor:
+    """Vectorized string methods (ref: pyspark/pandas/strings.py)."""
+
+    def __init__(self, s: CycloneSeries):
+        self._s = s
+
+    def _map(self, f, dtype=object) -> CycloneSeries:
+        vals = [None if v is None else f(v) for v in self._s.values]
+        return CycloneSeries(np.array(vals, dtype=dtype), self._s.name,
+                             index=self._s.index)
+
+    def lower(self):
+        return self._map(str.lower)
+
+    def upper(self):
+        return self._map(str.upper)
+
+    def strip(self):
+        return self._map(str.strip)
+
+    def len(self):
+        return self._map(len, dtype=np.int64)
+
+    def contains(self, pat: str, regex: bool = True):
+        import re
+        if regex:
+            rx = re.compile(pat)
+            return self._map(lambda v: rx.search(v) is not None, dtype=bool)
+        return self._map(lambda v: pat in v, dtype=bool)
+
+    def startswith(self, pat: str):
+        return self._map(lambda v: v.startswith(pat), dtype=bool)
+
+    def endswith(self, pat: str):
+        return self._map(lambda v: v.endswith(pat), dtype=bool)
+
+    def replace(self, pat: str, repl: str, regex: bool = True):
+        import re
+        if regex:
+            rx = re.compile(pat)
+            return self._map(lambda v: rx.sub(repl, v))
+        return self._map(lambda v: v.replace(pat, repl))
+
+    def slice(self, start=None, stop=None, step=None):
+        return self._map(lambda v: v[start:stop:step])
+
+    def split(self, pat: str = " "):
+        return self._map(lambda v: v.split(pat))
+
+    def cat(self, sep: str = "") -> str:
+        return sep.join(v for v in self._s.values if v is not None)
+
+
+class _DtAccessor:
+    """Datetime component accessors over datetime64 columns (ref:
+    pyspark/pandas/datetimes.py)."""
+
+    def __init__(self, s: CycloneSeries):
+        self._v = np.asarray(s.values, dtype="datetime64[s]")
+        self._name = s.name
+        self._index = s.index
+
+    def _series(self, vals, dtype=np.int64) -> CycloneSeries:
+        return CycloneSeries(np.asarray(vals, dtype=dtype), self._name,
+                             index=self._index)
+
+    @property
+    def year(self):
+        return self._series(self._v.astype("M8[Y]").astype(np.int64) + 1970)
+
+    @property
+    def month(self):
+        return self._series(
+            self._v.astype("M8[M]").astype(np.int64) % 12 + 1)
+
+    @property
+    def day(self):
+        return self._series((self._v.astype("M8[D]")
+                             - self._v.astype("M8[M]").astype("M8[D]"))
+                            .astype(np.int64) + 1)
+
+    @property
+    def hour(self):
+        return self._series((self._v.astype("M8[h]")
+                             - self._v.astype("M8[D]").astype("M8[h]"))
+                            .astype(np.int64))
+
+    @property
+    def minute(self):
+        return self._series((self._v.astype("M8[m]")
+                             - self._v.astype("M8[h]").astype("M8[m]"))
+                            .astype(np.int64))
+
+    @property
+    def second(self):
+        return self._series((self._v.astype("M8[s]")
+                             - self._v.astype("M8[m]").astype("M8[s]"))
+                            .astype(np.int64))
+
+    @property
+    def dayofweek(self):
+        # 1970-01-01 is a Thursday = 3 under pandas' Monday=0 convention
+        return self._series(
+            (self._v.astype("M8[D]").astype(np.int64) + 3) % 7)
+
+    @property
+    def date(self):
+        return CycloneSeries(self._v.astype("M8[D]"), self._name,
+                             index=self._index)
+
+
+class _LocIndexer:
+    """Label-based row access (ref: pyspark/pandas/indexing.py loc)."""
+
+    def __init__(self, frame: "CycloneFrame"):
+        self._f = frame
+
+    def __getitem__(self, key):
+        f = self._f
+        idx = f.index
+        if isinstance(key, tuple) and len(key) == 2:
+            rows, cols = key
+            sub = self[rows]
+            if isinstance(sub, dict):  # unique row label -> row mapping
+                if isinstance(cols, str):
+                    return sub[cols]
+                return {c: sub[c] for c in cols}
+            if isinstance(cols, str):
+                return sub[cols]
+            return sub[list(cols)]
+        if isinstance(key, CycloneSeries):  # boolean mask
+            return f[key]
+        if isinstance(key, slice):
+            # label slices are INCLUSIVE on both ends in pandas
+            lo = 0 if key.start is None else int(
+                np.nonzero(idx == key.start)[0][0])
+            hi = len(f) - 1 if key.stop is None else int(
+                np.nonzero(idx == key.stop)[0][-1])
+            return f._take(np.arange(lo, hi + 1))
+        if isinstance(key, (list, np.ndarray)):
+            # every row matching each label, label order outer (pandas
+            # duplicate-label semantics)
+            pos = []
+            for k in key:
+                hits = np.nonzero(idx == k)[0]
+                if not len(hits):
+                    raise KeyError(k)
+                pos.extend(hits)
+            return f._take(np.array(pos, dtype=np.int64))
+        pos = np.nonzero(idx == key)[0]
+        if not len(pos):
+            raise KeyError(key)
+        if len(pos) == 1:
+            return {c: f._cols[c][pos[0]] for c in f.columns}
+        return f._take(pos)
+
+
+class _ILocIndexer:
+    """Position-based row access."""
+
+    def __init__(self, frame: "CycloneFrame"):
+        self._f = frame
+
+    def __getitem__(self, key):
+        f = self._f
+        if isinstance(key, int):
+            n = len(f)
+            if key < 0:
+                key += n
+            if not 0 <= key < n:
+                raise IndexError(key)
+            return {c: f._cols[c][key] for c in f.columns}
+        if isinstance(key, slice):
+            return f._take(np.arange(len(f))[key])
+        return f._take(np.asarray(key))
 
 
 class _GroupBy:
@@ -198,8 +493,13 @@ class CycloneFrame:
     """2-D table (ref: pyspark/pandas/frame.py)."""
 
     def __init__(self, data: Union[Dict[str, Any], "CycloneFrame"]):
+        self._index: Optional[np.ndarray] = None  # None = positional
+        self._index_name: str = "index"
         if isinstance(data, CycloneFrame):
             self._cols = {k: v.copy() for k, v in data._cols.items()}
+            self._index = (None if data._index is None
+                           else data._index.copy())
+            self._index_name = data._index_name
             return
         cols = {}
         n = None
@@ -213,6 +513,58 @@ class CycloneFrame:
                 raise ValueError(f"column {k!r}: length {len(arr)} != {n}")
             cols[k] = arr
         self._cols = cols
+
+    # -- index ----------------------------------------------------------------
+    @property
+    def index(self) -> np.ndarray:
+        return (np.arange(len(self)) if self._index is None
+                else self._index)
+
+    def set_index(self, col: str) -> "CycloneFrame":
+        """(ref pandas set_index) — the column becomes the row-label index
+        and leaves the data columns."""
+        out = CycloneFrame({k: v for k, v in self._cols.items()
+                            if k != col})
+        out._index = np.asarray(self._cols[col])
+        out._index_name = col
+        return out
+
+    def reset_index(self, drop: bool = False) -> "CycloneFrame":
+        cols: Dict[str, Any] = {}
+        if not drop and self._index is not None:
+            cols[self._index_name] = self._index
+        cols.update(self._cols)
+        return CycloneFrame(cols)
+
+    def _like(self, cols: Dict[str, np.ndarray]) -> "CycloneFrame":
+        """A frame with these columns and THIS frame's index metadata."""
+        out = CycloneFrame(cols)
+        out._index = self._index
+        out._index_name = self._index_name
+        return out
+
+    def _take(self, pos: np.ndarray) -> "CycloneFrame":
+        """Row subset by position, index carried along."""
+        out = CycloneFrame({k: v[pos] for k, v in self._cols.items()})
+        if self._index is not None:
+            out._index = self._index[pos]
+            out._index_name = self._index_name
+        return out
+
+    @property
+    def loc(self) -> _LocIndexer:
+        return _LocIndexer(self)
+
+    @property
+    def iloc(self) -> _ILocIndexer:
+        return _ILocIndexer(self)
+
+    def rolling(self, window: int,
+                min_periods: Optional[int] = None) -> _FrameRolling:
+        return _FrameRolling(self, window, min_periods)
+
+    def expanding(self, min_periods: int = 1) -> _FrameRolling:
+        return _FrameRolling(self, None, min_periods)
 
     # -- metadata --------------------------------------------------------------
     @property
@@ -234,12 +586,12 @@ class CycloneFrame:
     # -- selection -------------------------------------------------------------
     def __getitem__(self, key):
         if isinstance(key, str):
-            return CycloneSeries(self._cols[key], key)
+            return CycloneSeries(self._cols[key], key, index=self._index)
         if isinstance(key, list):
-            return CycloneFrame({k: self._cols[k] for k in key})
+            return self._like({k: self._cols[k] for k in key})
         if isinstance(key, CycloneSeries):  # boolean mask
             mask = np.asarray(key.values, dtype=bool)
-            return CycloneFrame({k: v[mask] for k, v in self._cols.items()})
+            return self._take(np.nonzero(mask)[0])
         raise TypeError(f"cannot index with {type(key).__name__}")
 
     def __setitem__(self, key: str, value) -> None:
@@ -269,18 +621,25 @@ class CycloneFrame:
 
     # -- rows ------------------------------------------------------------------
     def head(self, n: int = 5) -> "CycloneFrame":
-        return CycloneFrame({k: v[:n] for k, v in self._cols.items()})
+        # pandas semantics: negative n means "all but the last |n| rows"
+        return self._take(np.arange(len(self))[:n])
 
     def tail(self, n: int = 5) -> "CycloneFrame":
-        return CycloneFrame({k: v[-n:] if n else v[:0]
-                             for k, v in self._cols.items()})
+        total = np.arange(len(self))
+        return self._take(total[-n:] if n else total[:0])
 
     def sort_values(self, by, ascending: bool = True) -> "CycloneFrame":
         keys = [by] if isinstance(by, str) else list(by)
         order = np.lexsort([self._cols[k] for k in reversed(keys)])
         if not ascending:
             order = order[::-1]
-        return CycloneFrame({k: v[order] for k, v in self._cols.items()})
+        return self._take(order)
+
+    def sort_index(self, ascending: bool = True) -> "CycloneFrame":
+        order = np.argsort(self.index, kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self._take(order)
 
     # -- missing data ----------------------------------------------------------
     def isna(self) -> "CycloneFrame":
@@ -295,7 +654,7 @@ class CycloneFrame:
             return CycloneFrame({})
         keep = ~np.logical_or.reduce([_is_null(v)
                                       for v in self._cols.values()])
-        return CycloneFrame({k: v[keep] for k, v in self._cols.items()})
+        return self._take(np.nonzero(keep)[0])
 
     # -- combine ---------------------------------------------------------------
     def merge(self, other: "CycloneFrame", on, how: str = "inner"
@@ -340,7 +699,10 @@ class CycloneFrame:
 
     def to_pandas(self):
         import pandas as pd
-        return pd.DataFrame({k: v for k, v in self._cols.items()})
+        pdf = pd.DataFrame({k: v for k, v in self._cols.items()})
+        if self._index is not None:
+            pdf.index = pd.Index(self._index, name=self._index_name)
+        return pdf
 
     @classmethod
     def from_pandas(cls, pdf) -> "CycloneFrame":
@@ -361,3 +723,83 @@ def read_csv(path: str, header: bool = True,
     from cycloneml_tpu.sql.session import CycloneSession
     return CycloneFrame(
         CycloneSession().read_csv(path, header, delimiter).to_dict())
+
+
+def concat(frames: Sequence[CycloneFrame], axis: int = 0,
+           ignore_index: bool = False) -> CycloneFrame:
+    """(ref pandas concat) — axis=0 stacks rows over the column UNION
+    (missing columns fill NaN/None); axis=1 joins columns positionally."""
+    frames = list(frames)
+    if not frames:
+        return CycloneFrame({})
+    if axis == 1:
+        cols: Dict[str, np.ndarray] = {}
+        for f in frames:
+            for k, v in f._cols.items():
+                name = k
+                i = 1
+                while name in cols:  # pandas keeps duplicates; we suffix
+                    name = f"{k}_{i}"
+                    i += 1
+                cols[name] = v
+        return CycloneFrame(cols)
+    names: List[str] = []
+    for f in frames:
+        for k in f.columns:
+            if k not in names:
+                names.append(k)
+    out: Dict[str, np.ndarray] = {}
+    for k in names:
+        parts = []
+        for f in frames:
+            if k in f._cols:
+                parts.append(np.asarray(f._cols[k], dtype=object)
+                             if any(k not in g._cols for g in frames)
+                             else f._cols[k])
+            else:
+                parts.append(np.full(len(f), None, dtype=object))
+        out[k] = np.concatenate(parts)
+    res = CycloneFrame(out)
+    if not ignore_index:
+        res._index = np.concatenate([f.index for f in frames])
+    return res
+
+
+def pivot_table(frame: CycloneFrame, values: str, index: str, columns: str,
+                aggfunc: str = "mean") -> CycloneFrame:
+    """(ref pandas pivot_table / pyspark/pandas/frame.py pivot_table) — one
+    output row per distinct ``index`` value, one column per distinct
+    ``columns`` value, cells aggregated with ``aggfunc``."""
+    if aggfunc not in ("mean", "sum", "min", "max", "count"):
+        raise ValueError(f"unsupported aggfunc {aggfunc!r}")
+    iv = np.asarray(frame._cols[index])
+    cv = np.asarray(frame._cols[columns])
+    vv = np.asarray(frame._cols[values], dtype=np.float64)
+    # one factorized pass: flat group id = row_code * n_cols + col_code
+    # (a per-cell boolean mask scan is O(rows * cells))
+    rows, r_code = np.unique(iv, return_inverse=True)
+    cols, c_code = np.unique(cv, return_inverse=True)
+    n_cells = len(rows) * len(cols)
+    flat = r_code * len(cols) + c_code
+    counts = np.bincount(flat, minlength=n_cells).astype(np.float64)
+    if aggfunc in ("mean", "sum", "count"):
+        sums = np.bincount(flat, weights=vv, minlength=n_cells)
+        counts_nan = np.where(counts > 0, counts, np.nan)
+        cell = {"sum": sums, "count": counts_nan,
+                "mean": np.divide(sums, counts,
+                                  out=np.full(n_cells, np.nan),
+                                  where=counts > 0)}[aggfunc]
+        if aggfunc == "sum":
+            cell = np.where(counts > 0, cell, np.nan)
+    else:
+        cell = np.full(n_cells, np.inf if aggfunc == "min" else -np.inf)
+        (np.minimum if aggfunc == "min" else np.maximum).at(cell, flat, vv)
+        cell = np.where(counts > 0, cell, np.nan)
+    grid = cell.reshape(len(rows), len(cols))
+    # the index is attached directly — building it as a data column could
+    # collide with a pivot column that stringifies to the same name
+    res = CycloneFrame({str(c): grid[:, j] for j, c in enumerate(cols)})
+    res._index = rows
+    res._index_name = index
+    return res
+

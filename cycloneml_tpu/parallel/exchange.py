@@ -62,6 +62,13 @@ class _BucketStore:
             self._writers = {}
             return out
 
+    def abort(self) -> None:
+        """Delete partially written bucket files (failure path)."""
+        with self._lock:
+            for w in self._writers.values():
+                w.abort()
+            self._writers = {}
+
 
 class HashExchange:
     """One exchange round among ``n_workers`` cooperating processes.
@@ -190,30 +197,40 @@ class HashExchange:
     # -- completion ---------------------------------------------------------
     def finish(self, timeout: float = 300.0) -> Dict[int, SpilledPartition]:
         """Flush, signal DONE to every peer, await every peer's DONE, and
-        return this worker's buckets as disk-backed partitions."""
-        for peer in range(self.n_workers):
-            if peer == self.rank:
-                continue
-            self._flush_peer(peer)
-            self._sock(peer).sendall(struct.pack("<I", 0))
-        # expect one DONE per remote peer
-        for _ in range(self.n_workers - 1):
-            if not self._done.acquire(timeout=timeout):
-                if self._failed:
-                    raise IOError(
-                        f"exchange receive failed: {self._failed[:3]}")
-                raise TimeoutError(
-                    f"exchange barrier timed out on rank {self.rank}")
-        if self._failed:
-            raise IOError(f"exchange receive failed: {self._failed[:3]}")
-        for s in self._socks.values():
-            try:
-                s.close()
-            except OSError:
-                pass
-        self._server.shutdown()
-        self._server.server_close()
-        return self._store.finish()
+        return this worker's buckets as disk-backed partitions. Sockets,
+        the listening server, and (on failure) partially written bucket
+        files are released on every exit path — a crashed peer must not
+        leak ports, threads, or /tmp in a long-lived worker."""
+        ok = False
+        try:
+            for peer in range(self.n_workers):
+                if peer == self.rank:
+                    continue
+                self._flush_peer(peer)
+                self._sock(peer).sendall(struct.pack("<I", 0))
+            # expect one DONE per remote peer
+            for _ in range(self.n_workers - 1):
+                if not self._done.acquire(timeout=timeout):
+                    if self._failed:
+                        raise IOError(
+                            f"exchange receive failed: {self._failed[:3]}")
+                    raise TimeoutError(
+                        f"exchange barrier timed out on rank {self.rank}")
+            if self._failed:
+                raise IOError(f"exchange receive failed: {self._failed[:3]}")
+            ok = True
+            return self._store.finish()
+        finally:
+            for s in self._socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._socks = {}
+            self._server.shutdown()
+            self._server.server_close()
+            if not ok:
+                self._store.abort()
 
 
 def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,

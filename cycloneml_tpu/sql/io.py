@@ -169,6 +169,153 @@ def write_parquet(batch: Batch, path: str) -> None:
     pq.write_table(table, path)
 
 
+def read_orc(path: str) -> Batch:
+    """ORC via pyarrow (ref: execution/datasources/orc/OrcFileFormat.scala);
+    same directory/partition-discovery semantics as parquet."""
+    partitioned = _read_partitioned(path, _read_orc_file)
+    if partitioned is not None:
+        return partitioned
+    from cycloneml_tpu.sql.plan import _concat
+    files = [p for p in _expand(path) if os.path.exists(p)]
+    if not files:
+        return {}
+    batches = [_read_orc_file(p) for p in files]
+    if len(batches) == 1:
+        return batches[0]
+    return {k: _concat([np.asarray(b[k]) for b in batches])
+            for k in batches[0]}
+
+
+def _read_orc_file(path: str) -> Batch:
+    import pyarrow.orc as po
+    table = po.ORCFile(path).read()
+    out: Batch = {}
+    for name in table.column_names:
+        col = table.column(name).to_numpy(zero_copy_only=False)
+        out[name] = (col.astype(object) if col.dtype.kind in "US" else col)
+    return out
+
+
+def write_orc(batch: Batch, path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.orc as po
+    table = pa.table({k: pa.array(v.tolist() if v.dtype == object else v)
+                      for k, v in batch.items()})
+    po.write_table(table, path)
+
+
+def _jdbc_connect(url: str):
+    """sqlite-backed JDBC-style URLs: ``jdbc:sqlite:/path/to.db`` (also bare
+    ``sqlite:`` and plain paths). The reader/writer interface mirrors
+    JDBCRelation (ref: execution/datasources/jdbc/JDBCRelation.scala:35);
+    other engines slot in behind the same URL dispatch."""
+    import sqlite3
+    for prefix in ("jdbc:sqlite:", "sqlite:"):
+        if url.startswith(prefix):
+            return sqlite3.connect(url[len(prefix):])
+    if url.startswith("jdbc:"):
+        raise ValueError(
+            f"unsupported JDBC url {url!r}: only jdbc:sqlite: is built in")
+    return sqlite3.connect(url)
+
+
+def read_jdbc(url: str, table: str, partition_column: Optional[str] = None,
+              num_partitions: int = 1) -> Batch:
+    """Read a table (or ``(subquery) alias``) into a columnar batch.
+
+    With ``partition_column`` (numeric), the read is split into
+    ``num_partitions`` range slices — the reference's partitioned JDBC scan
+    (JDBCRelation.columnPartition) — and the slices are concatenated; here
+    that exercises the partition-planning interface rather than parallel
+    connections."""
+    con = _jdbc_connect(url)
+    try:
+        cur = con.cursor()
+        if partition_column and num_partitions > 1:
+            lo, hi = cur.execute(
+                f"SELECT MIN({partition_column}), MAX({partition_column}) "
+                f"FROM {table}").fetchone()
+            rows: List[tuple] = []
+            names = None
+            if lo is None:
+                bounds = []
+            else:
+                step = (hi - lo) / num_partitions
+                bounds = [(lo + i * step, lo + (i + 1) * step)
+                          for i in range(num_partitions)]
+            for i, (a, b) in enumerate(bounds):
+                last = i == len(bounds) - 1
+                cond = (f"{partition_column} >= {a!r} AND "
+                        + (f"{partition_column} <= {b!r}" if last
+                           else f"{partition_column} < {b!r}"))
+                if i == 0:
+                    # NULL keys ride the first slice, as the reference's
+                    # JDBCRelation.columnPartition appends
+                    cond = f"({cond}) OR {partition_column} IS NULL"
+                cur.execute(f"SELECT * FROM {table} WHERE {cond}")
+                if names is None:
+                    names = [c[0] for c in cur.description]
+                rows.extend(cur.fetchall())
+            if names is None:
+                cur.execute(f"SELECT * FROM {table} LIMIT 0")
+                names = [c[0] for c in cur.description]
+        else:
+            cur.execute(f"SELECT * FROM {table}")
+            names = [c[0] for c in cur.description]
+            rows = cur.fetchall()
+    finally:
+        con.close()
+    out: Batch = {}
+    for i, n in enumerate(names):
+        vals = [r[i] for r in rows]
+        if all(isinstance(v, int) for v in vals) and vals:
+            out[n] = np.array(vals, dtype=np.int64)
+        elif all(isinstance(v, (int, float)) and v is not None
+                 for v in vals) and vals:
+            out[n] = np.array(vals, dtype=np.float64)
+        else:
+            out[n] = np.array(vals, dtype=object)
+    return out
+
+
+def write_jdbc(batch: Batch, url: str, table: str,
+               mode: str = "error") -> None:
+    con = _jdbc_connect(url)
+    try:
+        cur = con.cursor()
+        exists = cur.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+            (table,)).fetchone() is not None
+        if exists:
+            if mode == "error":
+                raise FileExistsError(
+                    f"table {table!r} already exists (SaveMode.ErrorIfExists)")
+            if mode == "ignore":
+                return
+            if mode == "overwrite":
+                cur.execute(f"DROP TABLE {table}")
+                exists = False
+        cols = list(batch)
+        if not exists:
+            def sqltype(v: np.ndarray) -> str:
+                if v.dtype.kind in "iub":
+                    return "INTEGER"
+                if v.dtype.kind == "f":
+                    return "REAL"
+                return "TEXT"
+            decls = ", ".join(f'"{c}" {sqltype(np.asarray(batch[c]))}'
+                              for c in cols)
+            cur.execute(f"CREATE TABLE {table} ({decls})")
+        n = len(batch[cols[0]]) if cols else 0
+        ph = ", ".join("?" for _ in cols)
+        cur.executemany(
+            f"INSERT INTO {table} VALUES ({ph})",
+            ([_py(batch[c][i]) for c in cols] for i in range(n)))
+        con.commit()
+    finally:
+        con.close()
+
+
 def read_json(path: str) -> Batch:
     """Line-delimited JSON records (the reference's default JSON shape)."""
     partitioned = _read_partitioned(path, _read_json_flat)
@@ -236,7 +383,7 @@ def _py(v):
 class DataFrameWriter:
     """(ref DataFrameWriter.scala) — ``df.write.mode(...).parquet(path)``."""
 
-    _FORMATS = ("parquet", "json", "csv")
+    _FORMATS = ("parquet", "json", "csv", "orc", "jdbc")
 
     def __init__(self, df):
         self._df = df
@@ -354,6 +501,21 @@ class DataFrameWriter:
         target = self._prepare(path)
         if target:
             write_json(self._df.to_dict(), target)
+
+    def orc(self, path: str) -> None:
+        if self._partition_cols:
+            self._write_partitioned(path, ".orc", write_orc)
+            return
+        target = self._prepare(path)
+        if target:
+            write_orc(self._df.to_dict(), target)
+
+    def jdbc(self, url: str, table: str) -> None:
+        """(ref DataFrameWriter.jdbc) — save-mode semantics apply to the
+        TABLE, not a file path."""
+        if self._partition_cols:
+            raise NotImplementedError("partitionBy does not apply to jdbc")
+        write_jdbc(self._df.to_dict(), url, table, mode=self._mode)
 
     def csv(self, path: str) -> None:
         if self._partition_cols:

