@@ -781,6 +781,9 @@ def pivot_table(frame: CycloneFrame, values: str, index: str, columns: str,
     cols, c_code = np.unique(cv, return_inverse=True)
     n_cells = len(rows) * len(cols)
     flat = r_code * len(cols) + c_code
+    # pandas skips NaN values: they contribute to neither sums nor counts
+    ok = ~np.isnan(vv)
+    flat, vv = flat[ok], vv[ok]
     counts = np.bincount(flat, minlength=n_cells).astype(np.float64)
     if aggfunc in ("mean", "sum", "count"):
         sums = np.bincount(flat, weights=vv, minlength=n_cells)

@@ -245,9 +245,13 @@ def read_jdbc(url: str, table: str, partition_column: Optional[str] = None,
                           for i in range(num_partitions)]
             for i, (a, b) in enumerate(bounds):
                 last = i == len(bounds) - 1
-                cond = (f"{partition_column} >= {a!r} AND "
-                        + (f"{partition_column} <= {b!r}" if last
-                           else f"{partition_column} < {b!r}"))
+                # the final slice leaves its upper bound OPEN: float step
+                # rounding can land lo + n*step below MAX and silently drop
+                # the top rows (the reference's columnPartition does the
+                # same, JDBCRelation.scala)
+                cond = (f"{partition_column} >= {a!r}" if last
+                        else (f"{partition_column} >= {a!r} AND "
+                              f"{partition_column} < {b!r}"))
                 if i == 0:
                     # NULL keys ride the first slice, as the reference's
                     # JDBCRelation.columnPartition appends

@@ -132,8 +132,9 @@ def test_concat_rows_and_columns():
                       pd.DataFrame({"x": [3], "z": [9.5]})])
     assert got.columns == list(want.columns)
     assert [int(v) for v in got["x"].values] == [1, 2, 3]
-    assert got["y"].values[2] is None and np.isnan(want["y"].isna().pipe(
-        lambda s: 0) or np.nan) or want["y"].isna().iloc[2]
+    # missing columns fill with None, matching pandas' NaN there
+    assert got["y"].values[2] is None
+    assert bool(want["y"].isna().iloc[2])
     side = concat([a, CycloneFrame({"w": [7, 8]})], axis=1)
     assert side.columns == ["x", "y", "w"]
 
@@ -197,3 +198,17 @@ def test_pivot_table_name_collision_and_count():
                         aggfunc="count")
     np.testing.assert_allclose(
         cnt["z"].values, wc["z"].to_numpy(dtype=float), equal_nan=True)
+
+
+def test_pivot_table_nan_values_skipped():
+    f = CycloneFrame({"k": ["a", "a", "b"], "c": ["u", "u", "u"],
+                      "v": [1.0, np.nan, 3.0]})
+    pf = pd.DataFrame({"k": ["a", "a", "b"], "c": ["u", "u", "u"],
+                       "v": [1.0, np.nan, 3.0]})
+    for agg in ("sum", "mean", "count"):
+        got = pivot_table(f, values="v", index="k", columns="c", aggfunc=agg)
+        want = pd.pivot_table(pf, values="v", index="k", columns="c",
+                              aggfunc=agg)
+        np.testing.assert_allclose(got["u"].values,
+                                   want["u"].to_numpy(dtype=float),
+                                   equal_nan=True, err_msg=agg)

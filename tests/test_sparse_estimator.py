@@ -99,3 +99,25 @@ def test_sparse_fit_binomial_family_rejects_multiclass(ctx):
     sds = SparseInstanceDataset.from_rows(ctx, rows, y=y3, n_features=10)
     with pytest.raises(ValueError, match="Binomial family"):
         LogisticRegression(maxIter=5, family="binomial").fit(sds)
+
+
+@pytest.mark.slow
+def test_criteo_class_end_to_end(tmp_path, monkeypatch):
+    """BASELINE config-1 analog at committed-test scale: synthetic
+    hashed-sparse libsvm (~0.25 GB) -> streamed bounded-memory ELL ingest
+    -> sparse-tier LR fit -> AUC, with the driver's ingest staging bounded
+    (the full-size 2 GB run is recorded in BASELINE.md's round-3 ledger).
+    Runs examples/criteo_class_demo.py verbatim — the demo IS the test."""
+    import io
+    import runpy
+    import sys
+    monkeypatch.setenv("CRITEO_DEMO_PATH", str(tmp_path / "criteo.svm"))
+    monkeypatch.setattr(sys, "argv", ["criteo_class_demo", "0.25", "19"])
+    out = io.StringIO()
+    from contextlib import redirect_stdout
+    with redirect_stdout(out):
+        runpy.run_path("examples/criteo_class_demo.py", run_name="__main__")
+    text = out.getvalue()
+    assert "AUC=" in text, text
+    auc = float(text.split("AUC=")[1].split()[0].rstrip(","))
+    assert auc > 0.65, text
