@@ -136,6 +136,23 @@ def _expand(path: str) -> List[str]:
     return matches or [path]
 
 
+def table_to_batch(table) -> Batch:
+    """Arrow table -> columnar numpy batch (strings as object arrays) —
+    the ONE conversion shared by parquet/orc eager readers and FileScan."""
+    out: Batch = {}
+    for name in table.column_names:
+        col = table.column(name).to_numpy(zero_copy_only=False)
+        out[name] = (col.astype(object) if col.dtype.kind in "US" else col)
+    return out
+
+
+def has_part_siblings(path: str) -> bool:
+    """True when SaveMode.append left base-partN.ext files beside ``path``
+    (single-file fast paths must then fall back to expanded reads)."""
+    base, ext = os.path.splitext(path)
+    return bool(glob.glob(f"{base}-part*{ext}"))
+
+
 def read_parquet(path: str) -> Batch:
     partitioned = _read_partitioned(path, _read_parquet_file)
     if partitioned is not None:
@@ -153,12 +170,7 @@ def read_parquet(path: str) -> Batch:
 
 def _read_parquet_file(path: str) -> Batch:
     import pyarrow.parquet as pq
-    table = pq.read_table(path)
-    out: Batch = {}
-    for name in table.column_names:
-        col = table.column(name).to_numpy(zero_copy_only=False)
-        out[name] = (col.astype(object) if col.dtype.kind in "US" else col)
-    return out
+    return table_to_batch(pq.read_table(path))
 
 
 def write_parquet(batch: Batch, path: str) -> None:
@@ -188,12 +200,7 @@ def read_orc(path: str) -> Batch:
 
 def _read_orc_file(path: str) -> Batch:
     import pyarrow.orc as po
-    table = po.ORCFile(path).read()
-    out: Batch = {}
-    for name in table.column_names:
-        col = table.column(name).to_numpy(zero_copy_only=False)
-        out[name] = (col.astype(object) if col.dtype.kind in "US" else col)
-    return out
+    return table_to_batch(po.ORCFile(path).read())
 
 
 def write_orc(batch: Batch, path: str) -> None:
@@ -269,6 +276,12 @@ def read_jdbc(url: str, table: str, partition_column: Optional[str] = None,
             rows = cur.fetchall()
     finally:
         con.close()
+    return rows_to_batch(names, rows)
+
+
+def rows_to_batch(names, rows) -> Batch:
+    """DB-API result rows -> typed columnar batch (shared by read_jdbc and
+    FileScan's pushed-WHERE path)."""
     out: Batch = {}
     for i, n in enumerate(names):
         vals = [r[i] for r in rows]
@@ -318,6 +331,29 @@ def write_jdbc(batch: Batch, url: str, table: str,
         con.commit()
     finally:
         con.close()
+
+
+def read_avro(path: str) -> Batch:
+    """Avro container files via the pure-Python codec (`sql.avro`; ref:
+    external/avro AvroFileFormat); directory/part expansion like parquet."""
+    from cycloneml_tpu.sql.avro import read_avro_file
+    from cycloneml_tpu.sql.plan import _concat
+    partitioned = _read_partitioned(path, read_avro_file)
+    if partitioned is not None:
+        return partitioned
+    files = [p for p in _expand(path) if os.path.exists(p)]
+    if not files:
+        return {}
+    batches = [read_avro_file(p) for p in files]
+    if len(batches) == 1:
+        return batches[0]
+    return {k: _concat([np.asarray(b[k]) for b in batches])
+            for k in batches[0]}
+
+
+def write_avro(batch: Batch, path: str) -> None:
+    from cycloneml_tpu.sql.avro import write_avro as _write
+    _write(batch, path)
 
 
 def read_json(path: str) -> Batch:
@@ -387,7 +423,7 @@ def _py(v):
 class DataFrameWriter:
     """(ref DataFrameWriter.scala) — ``df.write.mode(...).parquet(path)``."""
 
-    _FORMATS = ("parquet", "json", "csv", "orc", "jdbc")
+    _FORMATS = ("parquet", "json", "csv", "orc", "avro", "jdbc")
 
     def __init__(self, df):
         self._df = df
@@ -513,6 +549,14 @@ class DataFrameWriter:
         target = self._prepare(path)
         if target:
             write_orc(self._df.to_dict(), target)
+
+    def avro(self, path: str) -> None:
+        if self._partition_cols:
+            self._write_partitioned(path, ".avro", write_avro)
+            return
+        target = self._prepare(path)
+        if target:
+            write_avro(self._df.to_dict(), target)
 
     def jdbc(self, url: str, table: str) -> None:
         """(ref DataFrameWriter.jdbc) — save-mode semantics apply to the

@@ -13,8 +13,9 @@ from typing import List, Optional
 
 from cycloneml_tpu.sql.column import (Alias, BinaryOp, ColumnRef, Expr,
                                       Literal)
-from cycloneml_tpu.sql.plan import (Aggregate, Distinct, Filter, Join, Limit,
-                                    LogicalPlan, Project, Scan, Sort, Union)
+from cycloneml_tpu.sql.plan import (Aggregate, Distinct, FileScan, Filter,
+                                    Join, Limit, LogicalPlan, Project, Scan,
+                                    Sort, Union)
 
 
 def split_conjuncts(e: Expr) -> List[Expr]:
@@ -108,6 +109,47 @@ def push_filter_through_join(plan: LogicalPlan) -> Optional[LogicalPlan]:
     return Filter(new, join_conjuncts(keep)) if keep else new
 
 
+# plan-expression op symbol -> FileScan filter op name
+_PUSHABLE_OPS = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le",
+                 ">": "gt", ">=": "ge", "=": "eq"}
+
+
+def push_filters_into_filescan(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Filter(FileScan) → Filter(FileScan[pushed]) for conjuncts of shape
+    ``col <cmp> literal`` (ref: V2 SupportsPushDownFilters — the scan's
+    pushed filters are a superset guarantee, so the Filter node stays for
+    exact semantics; parquet maps them to row-group pruning, jdbc to
+    WHERE)."""
+    if not (isinstance(plan, Filter)
+            and isinstance(plan.children[0], FileScan)):
+        return None
+    scan = plan.children[0]
+    pushed = list(scan.filters)
+    new = []
+    for c in split_conjuncts(plan.cond):
+        t = _as_simple_predicate(c)
+        if t is not None and t not in pushed:
+            new.append(t)
+    if not new:
+        return None
+    return Filter(scan.with_pushdown(filters=pushed + new), plan.cond)
+
+
+def _as_simple_predicate(e: Expr):
+    if not (isinstance(e, BinaryOp) and e.op in _PUSHABLE_OPS
+            and len(e.children) == 2):
+        return None
+    op = _PUSHABLE_OPS[e.op]
+    a, b = e.children
+    if isinstance(a, ColumnRef) and isinstance(b, Literal):
+        return (a.name, op, b.value)
+    if isinstance(b, ColumnRef) and isinstance(a, Literal):
+        flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                "eq": "eq", "ne": "ne"}
+        return (b.name, flip[op], a.value)
+    return None
+
+
 def collapse_projects(plan: LogicalPlan) -> Optional[LogicalPlan]:
     if not (isinstance(plan, Project) and isinstance(plan.children[0], Project)):
         return None
@@ -138,6 +180,12 @@ def prune_columns(plan: LogicalPlan) -> LogicalPlan:
                 # projection still emits one value per input row)
                 cols = [next(iter(p.data))]
             return Scan(p.data, p.name, cols)
+        if isinstance(p, FileScan):
+            schema = p.output()
+            cols = [c for c in schema if c in needed]
+            if not cols and schema:
+                cols = [schema[0]]
+            return p.with_pushdown(columns=cols)
         if isinstance(p, Project):
             child_needed = set()
             for e in p.exprs:
@@ -175,7 +223,8 @@ def prune_columns(plan: LogicalPlan) -> LogicalPlan:
 
 
 _REWRITE_RULES = [fold_constants, combine_filters, push_filter_through_project,
-                  push_filter_through_join, collapse_projects]
+                  push_filter_through_join, push_filters_into_filescan,
+                  collapse_projects]
 
 
 def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:

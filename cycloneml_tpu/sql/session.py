@@ -184,6 +184,30 @@ class CycloneSession:
         from cycloneml_tpu.sql.io import read_orc
         return DataFrame(Scan(read_orc(path), path), self)
 
+    def read_avro(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.io import read_avro
+        return DataFrame(Scan(read_avro(path), path), self)
+
+    # -- lazy connector scans (V2 pushdown surface) ------------------------
+    def scan_parquet(self, path: str) -> DataFrame:
+        """Lazy scan: nothing is read until an action; the optimizer pushes
+        required columns + simple predicates into the connector
+        (FileScan ≈ DataSourceV2 SupportsPushDown*)."""
+        from cycloneml_tpu.sql.plan import FileScan
+        return DataFrame(FileScan("parquet", path), self)
+
+    def scan_orc(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.plan import FileScan
+        return DataFrame(FileScan("orc", path), self)
+
+    def scan_avro(self, path: str) -> DataFrame:
+        from cycloneml_tpu.sql.plan import FileScan
+        return DataFrame(FileScan("avro", path), self)
+
+    def scan_jdbc(self, url: str, table: str) -> DataFrame:
+        from cycloneml_tpu.sql.plan import FileScan
+        return DataFrame(FileScan("jdbc", f"{url}::{table}", table), self)
+
     def read_jdbc(self, url: str, table: str,
                   partition_column: Optional[str] = None,
                   num_partitions: int = 1) -> DataFrame:
