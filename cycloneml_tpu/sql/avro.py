@@ -159,10 +159,36 @@ def _np_column(vals: List[Any], typ) -> np.ndarray:
 
 # -- container file -----------------------------------------------------------
 
+def _read_header(fh) -> Tuple[Dict[str, bytes], bytes]:
+    """Magic + file-metadata map + sync marker (the ONE header parser).
+    Spec: a negative map-block count means 'count, blockSIZE, then |count|
+    entries' — the size appears once per BLOCK, not per entry."""
+    if fh.read(4) != MAGIC:
+        raise ValueError("not an avro container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        count = _read_long(fh)
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            _read_long(fh)  # block byte size
+        for _ in range(count):
+            k = _read_bytes(fh).decode()
+            meta[k] = _read_bytes(fh)
+    return meta, fh.read(16)
+
+
 def write_avro(batch: Dict[str, np.ndarray], path: str,
                codec: str = "deflate", block_rows: int = 4096) -> None:
-    schema = _schema_for(batch, os.path.splitext(
-        os.path.basename(path))[0] or "record")
+    import re
+    raw = os.path.splitext(os.path.basename(path))[0]
+    # spec §Names: [A-Za-z_][A-Za-z0-9_]* — part/append file names carry
+    # dashes and leading digits that Java avro/fastavro reject
+    name = re.sub(r"\W", "_", raw) or "record"
+    if name[0].isdigit():
+        name = "_" + name
+    schema = _schema_for(batch, name)
     cols = list(batch)
     types = {f["name"]: f["type"] for f in schema["fields"]}
     n = len(batch[cols[0]]) if cols else 0
@@ -203,21 +229,9 @@ def write_avro(batch: Dict[str, np.ndarray], path: str,
 
 def read_avro_file(path: str) -> Dict[str, np.ndarray]:
     with open(path, "rb") as fh:
-        if fh.read(4) != MAGIC:
-            raise ValueError(f"{path!r} is not an avro container file")
-        meta: Dict[str, bytes] = {}
-        while True:
-            count = _read_long(fh)
-            if count == 0:
-                break
-            for _ in range(abs(count)):
-                if count < 0:
-                    _read_long(fh)  # block byte size (spec allows it)
-                k = _read_bytes(fh).decode()
-                meta[k] = _read_bytes(fh)
+        meta, sync = _read_header(fh)
         schema = json.loads(meta["avro.schema"])
         codec = meta.get("avro.codec", b"null").decode()
-        sync = fh.read(16)
         fields = schema["fields"]
         out: Dict[str, List[Any]] = {f["name"]: [] for f in fields}
         while True:
@@ -244,17 +258,6 @@ def read_avro_file(path: str) -> Dict[str, np.ndarray]:
 def avro_schema_names(path: str) -> List[str]:
     """Column names from the header only (no data blocks read)."""
     with open(path, "rb") as fh:
-        if fh.read(4) != MAGIC:
-            raise ValueError(f"{path!r} is not an avro container file")
-        meta: Dict[str, bytes] = {}
-        while True:
-            count = _read_long(fh)
-            if count == 0:
-                break
-            for _ in range(abs(count)):
-                if count < 0:
-                    _read_long(fh)
-                k = _read_bytes(fh).decode()
-                meta[k] = _read_bytes(fh)
+        meta, _ = _read_header(fh)
         return [f["name"]
                 for f in json.loads(meta["avro.schema"])["fields"]]

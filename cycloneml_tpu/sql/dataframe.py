@@ -273,7 +273,11 @@ class DataFrame:
 
     # -- actions ---------------------------------------------------------------
     def optimized_plan(self) -> LogicalPlan:
-        return optimize(self.plan)
+        # QueryExecution phases: analyze -> optimize -> execute (ref
+        # QueryExecution.scala:56; analysis validates references/relations
+        # with did-you-mean errors before any numpy runs)
+        from cycloneml_tpu.sql.analyzer import analyze
+        return optimize(analyze(self.plan))
 
     def to_dict(self) -> Dict[str, np.ndarray]:
         return self.optimized_plan().execute()

@@ -109,8 +109,11 @@ def push_filter_through_join(plan: LogicalPlan) -> Optional[LogicalPlan]:
     return Filter(new, join_conjuncts(keep)) if keep else new
 
 
-# plan-expression op symbol -> FileScan filter op name
-_PUSHABLE_OPS = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le",
+# plan-expression op symbol -> FileScan filter op name. "!=" is NOT
+# pushable: native scans (SQL WHERE, pyarrow) use three-valued logic and
+# drop NULL rows the engine's numpy Filter would keep — the residual
+# Filter cannot resurrect rows the scan never returned.
+_PUSHABLE_OPS = {"==": "eq", "<": "lt", "<=": "le",
                  ">": "gt", ">=": "ge", "=": "eq"}
 
 
@@ -145,7 +148,7 @@ def _as_simple_predicate(e: Expr):
         return (a.name, op, b.value)
     if isinstance(b, ColumnRef) and isinstance(a, Literal):
         flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
-                "eq": "eq", "ne": "ne"}
+                "eq": "eq"}
         return (b.name, flip[op], a.value)
     return None
 

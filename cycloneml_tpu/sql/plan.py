@@ -166,7 +166,10 @@ class FileScan(LogicalPlan):
         from cycloneml_tpu.sql import io as sio
         cached = getattr(self, "_dir_batch", None)
         if cached is not None:
-            return cached
+            # re-applying this node's full filter set is idempotent for the
+            # filters the cached read already honored and applies any added
+            # since the cache was taken (superset in, exact-or-superset out)
+            return self._post_filter(cached)
         if self.fmt == "parquet":
             if self._plain_file():
                 import pyarrow.parquet as pq
@@ -219,9 +222,17 @@ class FileScan(LogicalPlan):
         return {k: np.asarray(v)[mask] for k, v in batch.items()}
 
     def with_pushdown(self, columns=None, filters=None) -> "FileScan":
-        return FileScan(self.fmt, self.path, self.name,
-                        self.columns if columns is None else columns,
-                        self.filters if filters is None else filters)
+        out = FileScan(self.fmt, self.path, self.name,
+                       self.columns if columns is None else columns,
+                       self.filters if filters is None else filters)
+        # carry the schema and any directory materialization: optimizer
+        # clones (pushdown, pruning) must not re-read the dataset —
+        # _materialize re-applies the clone's own filters to a cached batch
+        out._schema = self._schema
+        cached = getattr(self, "_dir_batch", None)
+        if cached is not None:
+            out._dir_batch = cached
+        return out
 
     def __repr__(self):
         extra = ""

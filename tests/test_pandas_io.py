@@ -536,3 +536,57 @@ def test_avro_uint64_out_of_range_rejected(tmp_path):
     with pytest.raises(ValueError, match="uint64"):
         write_avro({"u": np.array([1 << 63], dtype=np.uint64)},
                    str(tmp_path / "u.avro"))
+
+
+def test_filescan_ne_not_pushed_null_semantics(tmp_path):
+    """col != literal must keep NULL rows (numpy semantics) — native scans
+    drop them under SQL three-valued logic, so != is never pushed."""
+    from cycloneml_tpu.sql.functions import col
+    from cycloneml_tpu.sql.optimizer import optimize
+    from cycloneml_tpu.sql.plan import FileScan
+    s = CycloneSession()
+    df = s.create_data_frame({"id": [1, 2, 3],
+                              "tag": np.array(["a", None, "b"], object)})
+    url = f"jdbc:sqlite:{tmp_path / 'n.db'}"
+    df.write.jdbc(url, "t")
+    q = s.scan_jdbc(url, "t").filter(col("tag") != "a")
+    scan = [n for n in _walk(optimize(q.plan))
+            if isinstance(n, FileScan)][0]
+    assert not scan.filters  # nothing pushed
+    assert sorted(r.id for r in q.collect()) == [2, 3]
+
+
+def test_filescan_directory_read_once(tmp_path, monkeypatch):
+    """One query over a partitioned dataset reads each part file once,
+    shared across analysis, pushdown clones, and execution."""
+    from cycloneml_tpu.sql import avro as av
+    s = CycloneSession()
+    df = s.create_data_frame({"a": [1, 2, 3], "g": ["x", "y", "x"]})
+    d = str(tmp_path / "byg")
+    df.write.partition_by("g").avro(d)
+    calls = {"n": 0}
+    orig = av.read_avro_file
+
+    def counting(path):
+        calls["n"] += 1
+        return orig(path)
+
+    monkeypatch.setattr(av, "read_avro_file", counting)
+    rows = s.scan_avro(d).filter("a > 1").select("a").order_by("a").collect()
+    assert [r.a for r in rows] == [2, 3]
+    assert calls["n"] <= 2, calls  # 2 part files, each read at most once
+
+
+def test_avro_schema_name_sanitized(tmp_path):
+    from cycloneml_tpu.sql.avro import _read_header
+    import json as _json
+    s = CycloneSession()
+    df = s.create_data_frame({"a": [1]})
+    p = str(tmp_path / "2-bad name.avro")
+    df.write.avro(p)
+    with open(p, "rb") as fh:
+        meta, _ = _read_header(fh)
+    name = _json.loads(meta["avro.schema"])["name"]
+    import re
+    assert re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", name), name
+    assert s.read_avro(p).count() == 1

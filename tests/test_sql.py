@@ -480,3 +480,36 @@ def test_recursive_view_guard_in_order_by():
     with pytest.raises(ValueError, match="recursive"):
         s.sql("CREATE OR REPLACE VIEW v AS SELECT id FROM emp "
               "WHERE id IN (SELECT id FROM v)")
+
+
+def test_analyzer_rule_batches():
+    """Analysis phase (ref Analyzer.scala batches + CheckAnalysis):
+    unresolved references fail at analysis with did-you-mean hints, bad
+    join keys and non-aggregated selects are rejected, and opaque scopes
+    (subqueries, windows) never false-positive."""
+    from cycloneml_tpu.sql.analyzer import AnalysisException
+    s = _stmt_session()
+    s.register_temp_view("t", s.create_data_frame(
+        {"price": [1.0, 2.0], "qty": [3, 4], "cat": ["a", "b"]}))
+
+    with pytest.raises(AnalysisException, match="did you mean.*price"):
+        s.sql("SELECT prise FROM t").collect()
+    with pytest.raises(AnalysisException, match="WHERE clause"):
+        s.sql("SELECT price FROM t WHERE quantity > 1").collect()
+    with pytest.raises(AnalysisException,
+                       match="neither aggregated nor in GROUP BY"):
+        s.sql("SELECT cat, price FROM t GROUP BY cat").collect()
+    with pytest.raises(ValueError, match="not found"):
+        s.sql("SELECT * FROM missing_table").collect()
+    # join key validation
+    a = s.create_data_frame({"k": [1], "v": [2.0]})
+    b = s.create_data_frame({"k": [1], "w": [3.0]})
+    with pytest.raises(AnalysisException, match="join key"):
+        a.join(b, on=[("nope", "k")]).collect()
+    # legitimate queries (windows, subqueries, aggregates) pass analysis
+    assert s.sql("SELECT cat, SUM(price) AS sp FROM t GROUP BY cat"
+                 ).count() == 2
+    assert s.sql("SELECT price, ROW_NUMBER() OVER (ORDER BY price) AS r "
+                 "FROM t").count() == 2
+    assert s.sql("SELECT price FROM t WHERE qty IN (SELECT qty FROM t)"
+                 ).count() == 2
