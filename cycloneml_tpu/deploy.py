@@ -1,0 +1,348 @@
+"""Standalone deploy mode — Master / Worker daemons.
+
+Analog of the reference's standalone cluster manager (ref:
+core/.../deploy/master/Master.scala, deploy/worker/Worker.scala,
+deploy/Client.scala): a Master daemon tracks registered Workers over the
+same TCP fabric the heartbeat/exchange layers use, and ``submit`` hands it
+an application which the Master schedules onto Workers; each Worker
+launches the driver/worker PROCESS with the ``multihost[...]`` environment
+so the processes join one jax.distributed mesh (the executor-allocation
+role of the reference's Master collapses into mesh formation — SURVEY
+layer-map note).
+
+Protocol: JSON lines over TCP. Worker -> Master: ``register``,
+``heartbeat``, ``poll`` (fetch assigned launches), ``app_update``.
+Client -> Master: ``submit``, ``status``. Master state (registered
+workers, app history) persists to a JSON file so a restarted Master
+recovers its cluster view (the recovery-file analog of
+``FileSystemPersistenceEngine``; leader election / ZooKeeper HA stays out
+of scope, as PARITY documents).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+WORKER_TIMEOUT_S = 60.0
+
+
+def _send(addr: str, msg: dict, timeout: float = 30.0) -> dict:
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall((json.dumps(msg) + "\n").encode())
+        fh = s.makefile("r")
+        line = fh.readline()
+    return json.loads(line) if line.strip() else {}
+
+
+class MasterDaemon:
+    """Cluster manager: registration, liveness, app scheduling, status."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 state_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._workers: Dict[str, dict] = {}   # id -> {addr?, last_seen, ...}
+        self._apps: Dict[str, dict] = {}      # id -> {state, assignments...}
+        self._launches: Dict[str, List[dict]] = {}  # worker id -> queue
+        self._state_path = state_path
+        self._rr = 0  # spreadOut rotation cursor
+        self._load_state()
+        master = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline()
+                    if not line.strip():
+                        return
+                    reply = master._dispatch(json.loads(line))
+                except Exception as e:  # malformed request must not kill us
+                    reply = {"ok": False, "error": repr(e)}
+                self.wfile.write((json.dumps(reply) + "\n").encode())
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = (f"{host}:{self._server.server_address[1]}")
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="cyclone-master")
+        self._thread.start()
+        logger.info("cyclone master listening on %s", self.address)
+
+    # -- persistence (FileSystemPersistenceEngine analog) ------------------
+    def _load_state(self) -> None:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding="utf-8") as fh:
+                st = json.load(fh)
+            self._workers = st.get("workers", {})
+            self._apps = st.get("apps", {})
+            # a recovered worker is UNKNOWN until it re-registers (its
+            # daemon may have died with the old master); recovered RUNNING
+            # apps cannot complete — their launch queues were volatile —
+            # so they fail explicitly rather than hang (the reference
+            # master re-schedules; a lost app is surfaced, not stuck)
+            for w in self._workers.values():
+                w["state"] = "UNKNOWN"
+            for a in self._apps.values():
+                if a.get("state") == "RUNNING":
+                    a["state"] = "FAILED"
+                    a["reason"] = "master restarted mid-run"
+
+    def _save_state(self) -> None:
+        if not self._state_path:
+            return
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"workers": self._workers, "apps": self._apps}, fh)
+        os.replace(tmp, self._state_path)
+
+    # -- protocol -----------------------------------------------------------
+    def _dispatch(self, msg: dict) -> dict:
+        kind = msg.get("kind")
+        with self._lock:
+            if kind == "register":
+                wid = msg["worker_id"]
+                self._workers[wid] = {"cores": int(msg.get("cores", 1)),
+                                      "host": msg.get("host", "127.0.0.1"),
+                                      "last_seen": time.time(),
+                                      "state": "ALIVE"}
+                self._launches.setdefault(wid, [])
+                self._save_state()
+                return {"ok": True}
+            if kind == "heartbeat":
+                w = self._workers.get(msg["worker_id"])
+                if w is None:
+                    return {"ok": False, "error": "unregistered"}
+                w["last_seen"] = time.time()
+                w["state"] = "ALIVE"
+                return {"ok": True}
+            if kind == "poll":
+                wid = msg["worker_id"]
+                w = self._workers.get(wid)
+                if w is None or w["state"] == "UNKNOWN":
+                    # recovered/unknown workers must RE-register so the
+                    # master learns their host and liveness afresh
+                    return {"ok": False, "error": "unregistered"}
+                w["last_seen"] = time.time()
+                q = self._launches.get(wid, [])
+                out, self._launches[wid] = list(q), []
+                return {"ok": True, "launches": out}
+            if kind == "app_update":
+                app = self._apps.get(msg["app_id"])
+                if app is not None:
+                    app["procs"][str(msg["proc_id"])] = {
+                        "state": msg["state"],
+                        "exit_code": msg.get("exit_code")}
+                    if msg["state"] == "FAILED":
+                        # fail fast (ref Master removes the app on executor
+                        # failure): siblings may hang on a dead coordinator
+                        # — kill them rather than wait for all reports
+                        if app["state"] != "FAILED":
+                            app["state"] = "FAILED"
+                            for wid in app["workers"]:
+                                self._launches.setdefault(wid, []).append(
+                                    {"kill": msg["app_id"]})
+                    elif (len(app["procs"]) == app["n_procs"]
+                          and all(p["state"] == "FINISHED"
+                                  for p in app["procs"].values())):
+                        app["state"] = "FINISHED"
+                    self._save_state()
+                return {"ok": True}
+            if kind == "submit":
+                return self._submit(msg)
+            if kind == "status":
+                self._expire()
+                return {"ok": True, "workers": {
+                    k: {"state": v["state"], "cores": v["cores"]}
+                    for k, v in self._workers.items()},
+                    "apps": {k: {"state": a["state"],
+                                 "workers": a["workers"]}
+                             for k, a in self._apps.items()}}
+        return {"ok": False, "error": f"unknown kind {kind!r}"}
+
+    def _expire(self) -> None:
+        now = time.time()
+        for w in self._workers.values():
+            if (w["state"] == "ALIVE"
+                    and now - w["last_seen"] > WORKER_TIMEOUT_S):
+                w["state"] = "DEAD"
+
+    def _submit(self, msg: dict) -> dict:
+        """Schedule an app onto n_procs ALIVE workers (round-robin, the
+        reference's spreadOut placement); each launch carries the
+        multihost coordinator address so the processes form ONE mesh."""
+        self._expire()
+        n = int(msg.get("n_procs", 1))
+        alive = [k for k, v in self._workers.items() if v["state"] == "ALIVE"]
+        if len(alive) < n:
+            return {"ok": False,
+                    "error": f"need {n} workers, have {len(alive)} alive"}
+        app_id = f"app-{uuid.uuid4().hex[:8]}"
+        # spreadOut rotation: consecutive submissions land on different
+        # workers (ref Master.scala spreadOutApps)
+        start = self._rr % len(alive)
+        self._rr += 1
+        chosen = (alive[start:] + alive[:start])[:n]
+        # the coordinator lives on proc 0's HOST; the port is probed here
+        # (briefly unreserved — the same window every launcher that assigns
+        # remote ports accepts; collisions surface as a failed app, retry)
+        coord_host = self._workers[chosen[0]].get("host", "127.0.0.1")
+        with socket.socket() as s:
+            s.bind(("", 0))
+            coord_port = s.getsockname()[1]
+        self._apps[app_id] = {"state": "RUNNING", "n_procs": n,
+                              "workers": chosen, "procs": {}}
+        for i, wid in enumerate(chosen):
+            self._launches.setdefault(wid, []).append({
+                "app_id": app_id, "proc_id": i, "n_procs": n,
+                "coordinator": f"{coord_host}:{coord_port}",
+                "app_path": msg["app_path"],
+                "args": msg.get("args", []),
+                "env": msg.get("env", {})})
+        self._save_state()
+        return {"ok": True, "app_id": app_id, "workers": chosen}
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class WorkerDaemon:
+    """Registers with the Master, polls for launches, runs app processes
+    (ref Worker.scala ExecutorRunner/DriverRunner collapse into one
+    process launch that joins the mesh)."""
+
+    def __init__(self, master_addr: str, worker_id: Optional[str] = None,
+                 cores: int = 1, poll_interval_s: float = 0.2,
+                 host: str = "127.0.0.1"):
+        self.master = master_addr
+        self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self.cores = cores
+        self.host = host
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # app_id -> [Popen]: live processes only (pruned on exit)
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self._register()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"cyclone-{self.worker_id}")
+        self._thread.start()
+
+    def _register(self) -> None:
+        rep = _send(self.master, {"kind": "register",
+                                  "worker_id": self.worker_id,
+                                  "host": self.host, "cores": self.cores})
+        if not rep.get("ok"):
+            raise RuntimeError(f"registration failed: {rep}")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                rep = _send(self.master, {"kind": "poll",
+                                          "worker_id": self.worker_id})
+                if not rep.get("ok") and rep.get("error") == "unregistered":
+                    # a restarted master forgot us — re-register (the
+                    # reference worker re-registers on MasterChanged)
+                    self._register()
+                for launch in rep.get("launches", []):
+                    if "kill" in launch:
+                        self._kill(launch["kill"])
+                    else:
+                        self._launch(launch)
+            except Exception as e:
+                logger.warning("worker %s poll failed: %s", self.worker_id, e)
+            self._stop.wait(self.poll_interval_s)
+
+    def _kill(self, app_id: str) -> None:
+        with self._lock:
+            procs = self._procs.pop(app_id, [])
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    def _launch(self, launch: dict) -> None:
+        env = dict(os.environ)
+        env.update(launch.get("env", {}))
+        env["CYCLONE_MASTER_URL"] = (
+            f"multihost[{launch['coordinator']},{launch['n_procs']},"
+            f"{launch['proc_id']}]")
+        env["CYCLONE_APP_ID"] = launch["app_id"]
+        env["CYCLONE_PROC_ID"] = str(launch["proc_id"])
+        proc = subprocess.Popen(
+            [sys.executable, launch["app_path"], *launch.get("args", [])],
+            env=env)
+        with self._lock:
+            self._procs.setdefault(launch["app_id"], []).append(proc)
+        threading.Thread(target=self._wait, daemon=True,
+                         args=(proc, launch)).start()
+
+    def _wait(self, proc: subprocess.Popen, launch: dict) -> None:
+        code = proc.wait()
+        with self._lock:  # prune: a long-lived daemon must not accumulate
+            live = self._procs.get(launch["app_id"], [])
+            if proc in live:
+                live.remove(proc)
+            if not live:
+                self._procs.pop(launch["app_id"], None)
+        try:
+            _send(self.master, {
+                "kind": "app_update", "app_id": launch["app_id"],
+                "proc_id": launch["proc_id"],
+                "state": "FINISHED" if code == 0 else "FAILED",
+                "exit_code": code})
+        except Exception as e:
+            logger.warning("app_update failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            procs = [p for ps in self._procs.values() for p in ps]
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
+               args: Optional[List[str]] = None,
+               env: Optional[Dict[str, str]] = None) -> str:
+    """Client-side submit (ref deploy/Client.scala): returns the app id."""
+    rep = _send(master_addr, {"kind": "submit", "app_path": app_path,
+                              "n_procs": n_procs, "args": args or [],
+                              "env": env or {}})
+    if not rep.get("ok"):
+        raise RuntimeError(f"submit rejected: {rep.get('error')}")
+    return rep["app_id"]
+
+
+def app_status(master_addr: str, app_id: Optional[str] = None) -> dict:
+    st = _send(master_addr, {"kind": "status"})
+    if app_id is not None:
+        return st["apps"].get(app_id, {"state": "UNKNOWN"})
+    return st
+
+
+def wait_for_app(master_addr: str, app_id: str,
+                 timeout_s: float = 300.0) -> str:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        state = app_status(master_addr, app_id)["state"]
+        if state in ("FINISHED", "FAILED"):
+            return state
+        time.sleep(0.2)
+    raise TimeoutError(f"app {app_id} still running after {timeout_s}s")

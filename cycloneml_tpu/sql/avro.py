@@ -185,7 +185,7 @@ def write_avro(batch: Dict[str, np.ndarray], path: str,
     raw = os.path.splitext(os.path.basename(path))[0]
     # spec §Names: [A-Za-z_][A-Za-z0-9_]* — part/append file names carry
     # dashes and leading digits that Java avro/fastavro reject
-    name = re.sub(r"\W", "_", raw) or "record"
+    name = re.sub(r"[^A-Za-z0-9_]", "_", raw) or "record"
     if name[0].isdigit():
         name = "_" + name
     schema = _schema_for(batch, name)

@@ -93,9 +93,84 @@ def submit(argv: Optional[List[str]] = None) -> None:
         for p in reversed(args.py_files.split(",")):
             sys.path.insert(0, p)
 
+    if args.master and args.master.startswith("cyclone://"):
+        # standalone cluster mode (ref deploy/Client.scala): hand the app
+        # to the Master daemon, which schedules it onto Worker daemons.
+        # --conf/--name settings ride along as env — the app runs in a
+        # WORKER subprocess, which never sees this client's os.environ
+        from cycloneml_tpu.deploy import submit_app, wait_for_app
+        addr = args.master[len("cyclone://"):]
+        n = int(os.environ.get("CYCLONE_SUBMIT_PROCS", "1"))
+        fwd = {_conf_env_key(k): v for k, v in pairs}
+        if args.py_files:
+            fwd["PYTHONPATH"] = (args.py_files.replace(",", os.pathsep)
+                                 + os.pathsep
+                                 + os.environ.get("PYTHONPATH", ""))
+        app_id = submit_app(addr, args.app, n_procs=n,
+                            args=list(args.app_args), env=fwd)
+        print(f"cyclone-submit: {app_id} submitted to {addr}",
+              file=sys.stderr)
+        try:
+            state = wait_for_app(addr, app_id)
+        except TimeoutError as e:
+            raise SystemExit(f"cyclone-submit: {e}") from None
+        print(f"cyclone-submit: {app_id} {state}", file=sys.stderr)
+        if state != "FINISHED":
+            raise SystemExit(1)
+        return
+
     sys.argv = [args.app] + list(args.app_args)
     runpy.run_path(args.app, run_name="__main__")
 
 
+def master_main(argv: Optional[List[str]] = None) -> None:
+    """``python -m cycloneml_tpu.submit master [--host H] [--port P]`` —
+    run a standalone Master daemon (ref deploy/master/Master.scala)."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="cyclone-master")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7077)
+    ap.add_argument("--state", default="",
+                    help="recovery file (FileSystemPersistenceEngine analog)")
+    ns = ap.parse_args(argv)
+    from cycloneml_tpu.deploy import MasterDaemon
+    m = MasterDaemon(ns.host, ns.port, state_path=ns.state or None)
+    print(f"cyclone-master: listening on cyclone://{m.address}",
+          file=sys.stderr)
+    try:
+        while True:
+            import time
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        m.stop()
+
+
+def worker_main(argv: Optional[List[str]] = None) -> None:
+    """``python -m cycloneml_tpu.submit worker MASTER`` — run a Worker
+    daemon (ref deploy/worker/Worker.scala)."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="cyclone-worker")
+    ap.add_argument("master", help="cyclone://host:port")
+    ap.add_argument("--cores", type=int, default=1)
+    ns = ap.parse_args(argv)
+    from cycloneml_tpu.deploy import WorkerDaemon
+    addr = ns.master[len("cyclone://"):] if ns.master.startswith(
+        "cyclone://") else ns.master
+    w = WorkerDaemon(addr, cores=ns.cores)
+    print(f"cyclone-worker: {w.worker_id} registered with {addr}",
+          file=sys.stderr)
+    try:
+        while True:
+            import time
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        w.stop()
+
+
 if __name__ == "__main__":
-    submit()
+    if len(sys.argv) > 1 and sys.argv[1] == "master":
+        master_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker_main(sys.argv[2:])
+    else:
+        submit()
