@@ -320,6 +320,12 @@ class InstanceDataset:
         # real-row mask when padding is interleaved per shard (chunked
         # loaders); None means padding sits at the global tail ([:n_rows])
         self._valid_mask: Optional[np.ndarray] = valid_mask
+        self._disk_path: Optional[str] = None  # DISK storage tier source
+        self._storage_cb = None  # StorageManager notification hook
+        # padded geometry captured up-front so storage accounting never
+        # has to touch (and possibly restore) the device arrays
+        self._n_pad = int(x.shape[0]) if x is not None else 0
+        self._itemsize = int(np.dtype(str(x.dtype)).itemsize) if x is not None else 4
         self.n_rows = n_rows
         self.n_features = n_features
 
@@ -331,10 +337,12 @@ class InstanceDataset:
         (standardization, normalization, X·B products) must construct its
         result through this — a raw ``InstanceDataset(...)`` call silently
         drops the padding mask and corrupts chunk-loaded datasets."""
+        # property access (not _x) so an evicted dataset restores instead
+        # of silently deriving a dataset with no arrays at all
         ds = InstanceDataset(self.ctx,
-                             self._x if x is None else x,
-                             self._y if y is None else y,
-                             self._w if w is None else w,
+                             self.x if x is None else x,
+                             self.y if y is None else y,
+                             self.w if w is None else w,
                              self.n_rows,
                              self.n_features if n_features is None
                              else n_features,
@@ -372,11 +380,64 @@ class InstanceDataset:
         return np.asarray(self.w)
 
     def _restore_device(self) -> None:
+        restored = False
         if self._x is None and self._host is not None:
             rt = self.ctx.mesh_runtime
             self._x = rt.device_put_sharded_rows(self._host[0])
             self._y = rt.device_put_sharded_rows(self._host[1])
             self._w = rt.device_put_sharded_rows(self._host[2])
+            restored = True
+        elif self._x is None and self._disk_path:
+            # DISK storage tier (StorageManager eviction): reload the npz
+            # block and re-place it on the mesh transparently
+            z = np.load(self._disk_path)
+            rt = self.ctx.mesh_runtime
+            self._x = rt.device_put_sharded_rows(z["x"])
+            self._y = rt.device_put_sharded_rows(z["y"])
+            self._w = rt.device_put_sharded_rows(z["w"])
+            restored = True
+        if restored and self._storage_cb is not None:
+            # lazy restores must reach the StorageManager's accounting, or
+            # device usage silently exceeds its budget until a touch()
+            self._storage_cb(self)
+
+    def release_device(self) -> None:
+        """Free the device arrays (data must already live in a durable
+        tier — host tuple or disk file)."""
+        if self._host is None and not self._disk_path:
+            raise RuntimeError("release_device would drop the only copy")
+        for a in (self._x, self._y, self._w):
+            try:
+                a.delete()
+            except Exception:
+                pass
+        self._x = self._y = self._w = None
+
+    def persist_disk(self, path: str) -> "InstanceDataset":
+        """Spill to an npz file and release BOTH device and host copies
+        (the DISK storage tier; symmetric to :meth:`persist_host`).
+        Writes from the host tuple when present — never re-uploads an
+        evicted dataset to the device just to read it back."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if self._host is not None:
+            x, y, w = self._host
+        else:
+            x, y, w = (np.asarray(self.x), np.asarray(self.y),
+                       np.asarray(self.w))
+        extra = ({"valid_mask": self._valid_mask}
+                 if self._valid_mask is not None else {})
+        np.savez(path, x=x, y=y, w=w, n_rows=self.n_rows,
+                 n_features=self.n_features, **extra)
+        self._disk_path = path if path.endswith(".npz") else path + ".npz"
+        self._host = None
+        if self._x is not None:
+            self.release_device()
+        return self
+
+    def padded_bytes(self) -> int:
+        """Storage footprint of the padded block (metadata only — never
+        touches, and so never restores, the arrays)."""
+        return self._n_pad * (self.n_features + 2) * self._itemsize
 
     @property
     def x(self):

@@ -1,0 +1,179 @@
+"""Storage tiers with eviction — the BlockManager memory-store analog.
+
+Ref: core/.../storage/BlockManager.scala + memory/StorageMemoryPool: the
+reference caches RDD blocks in a bounded memory store and evicts LRU
+blocks to disk (or drops them) under pressure. Here the cached unit is a
+whole ``InstanceDataset`` (the physical block of the numeric tier) and the
+tiers map to the platform:
+
+- DEVICE: arrays live in HBM (the default placement)
+- HOST: ``persist_host()`` — numpy in driver RAM, HBM released
+- DISK: npz spill file; re-placed on the mesh transparently at next access
+
+``StorageManager`` tracks registered datasets with per-tier byte budgets
+and evicts least-recently-used datasets down a tier when a budget is
+exceeded — ``MEMORY_AND_DISK`` semantics (data is never dropped; eviction
+always lands in a durable tier, matching this framework's
+checkpoint-based recovery story).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StorageLevel:
+    DEVICE = "DEVICE"
+    HOST = "HOST"
+    DISK = "DISK"
+
+
+_ORDER = [StorageLevel.DEVICE, StorageLevel.HOST, StorageLevel.DISK]
+
+
+class StorageManager:
+    """Bounded multi-tier dataset cache with LRU demotion.
+
+    ``device_budget``/``host_budget`` are byte budgets for the DEVICE and
+    HOST tiers (None = unbounded). Exceeding a budget demotes the least
+    recently used dataset to the next tier; DISK is unbounded.
+    """
+
+    def __init__(self, device_budget: Optional[int] = None,
+                 host_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="cyclone-store-")
+        self._lock = threading.RLock()
+        # id(ds) -> {ds, level, bytes, last_used, path}
+        self._entries: Dict[int, dict] = {}
+
+    # -- public surface ------------------------------------------------------
+    def persist(self, ds, level: str = StorageLevel.DEVICE):
+        """Register a dataset under management at ``level``; may trigger
+        evictions of older datasets to keep budgets. Lazy restores through
+        ``ds.x`` notify the manager, so accounting tracks the normal read
+        path — not just explicit ``touch()`` calls."""
+        if level not in _ORDER:
+            raise ValueError(f"unknown storage level {level!r}")
+        import weakref
+        with self._lock:
+            self._entries[id(ds)] = {"ds": ds, "level": level,
+                                     "bytes": ds.padded_bytes(),
+                                     "last_used": time.monotonic(),
+                                     "path": None}
+            ref = weakref.ref(self)
+            ds._storage_cb = lambda d: (ref() and ref()._on_restore(d))
+            self._apply_level(self._entries[id(ds)], level)
+            self._enforce()
+        return ds
+
+    def _on_restore(self, ds) -> None:
+        """A managed dataset re-placed itself on device via its property
+        access: relabel, drop the now-redundant host copy, re-enforce."""
+        with self._lock:
+            e = self._entries.get(id(ds))
+            if e is None:
+                return
+            e["level"] = StorageLevel.DEVICE
+            e["last_used"] = time.monotonic()
+            ds._host = None  # device copy is authoritative again
+            self._enforce()
+
+    def touch(self, ds) -> None:
+        """Record an access without moving data."""
+        with self._lock:
+            e = self._entries.get(id(ds))
+            if e is None:
+                return
+            e["last_used"] = time.monotonic()
+            if e["ds"]._x is not None:
+                e["level"] = StorageLevel.DEVICE
+            self._enforce()
+
+    def unpersist(self, ds) -> None:
+        """Stop managing ``ds``. Data is NEVER dropped: a DISK-tier dataset
+        is pulled back to the host tier before its spill file is removed."""
+        with self._lock:
+            e = self._entries.pop(id(ds), None)
+            ds._storage_cb = None
+            if e is None:
+                return
+            if e["level"] == StorageLevel.DISK and e["path"]:
+                z = __import__("numpy").load(e["path"]
+                                             if e["path"].endswith(".npz")
+                                             else e["path"] + ".npz")
+                ds._host = (z["x"], z["y"], z["w"])
+                ds._disk_path = None
+            if e["path"]:
+                try:
+                    os.unlink(e["path"] if e["path"].endswith(".npz")
+                              else e["path"] + ".npz")
+                except OSError:
+                    pass
+
+    def level_of(self, ds) -> Optional[str]:
+        e = self._entries.get(id(ds))
+        return e["level"] if e else None
+
+    def usage(self) -> Dict[str, int]:
+        with self._lock:
+            out = {lvl: 0 for lvl in _ORDER}
+            for e in self._entries.values():
+                out[e["level"]] += e["bytes"]
+            return out
+
+    # -- mechanics -----------------------------------------------------------
+    def _apply_level(self, e: dict, level: str) -> None:
+        ds = e["ds"]
+        if level == StorageLevel.DEVICE:
+            ds.x  # property access re-places evicted arrays on the mesh
+        elif level == StorageLevel.HOST:
+            if ds._x is not None:
+                ds.persist_host()
+        elif level == StorageLevel.DISK:
+            if e["path"] is None:
+                e["path"] = os.path.join(
+                    self._spill_dir, f"block-{id(ds)}")
+            # persist_disk writes from the HOST tuple when present — a
+            # HOST->DISK demotion never round-trips through device HBM
+            ds.persist_disk(e["path"])
+        e["level"] = level
+
+    def _enforce(self) -> None:
+        for level, budget in ((StorageLevel.DEVICE, self.device_budget),
+                              (StorageLevel.HOST, self.host_budget)):
+            if budget is None:
+                continue
+            while True:
+                entries = [e for e in self._entries.values()
+                           if e["level"] == level]
+                used = sum(e["bytes"] for e in entries)
+                # the most-recently-used entry is never evicted: it may be
+                # the dataset an in-flight property access just restored —
+                # demoting it mid-access would hand the caller None arrays
+                # (an over-budget SINGLE block stays put, like the
+                # reference keeping a block larger than the store)
+                candidates = sorted(entries,
+                                    key=lambda e: e["last_used"])[:-1]
+                if used <= budget or not candidates:
+                    if used > budget:
+                        logger.warning(
+                            "storage: %s over budget (%d > %d) with no "
+                            "evictable entry", level, used, budget)
+                    break
+                victim = candidates[0]
+                nxt = _ORDER[_ORDER.index(level) + 1]
+                logger.info("storage: evicting %d bytes %s -> %s",
+                            victim["bytes"], level, nxt)
+                self._apply_level(victim, nxt)
+
