@@ -48,6 +48,30 @@ def _send(addr: str, msg: dict, timeout: float = 30.0) -> dict:
     return json.loads(line) if line.strip() else {}
 
 
+# pool size a worker keeps pre-probed with the master; submits draw from it
+COORD_PORT_POOL = 4
+# a probed-but-unbound port goes stale as other processes bind; entries
+# older than this are discarded rather than handed to a coordinator
+COORD_PORT_TTL_S = 30.0
+
+
+def _probe_free_ports(n: int) -> List[int]:
+    """``n`` DISTINCT free ports on this machine: all sockets are held open
+    while collecting so the kernel cannot hand the same ephemeral port
+    twice. (Briefly unreserved after close — the same window every launcher
+    that assigns ports ahead of bind accepts.)"""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 class MasterDaemon:
     """Cluster manager: registration, liveness, app scheduling, status."""
 
@@ -117,9 +141,13 @@ class MasterDaemon:
         with self._lock:
             if kind == "register":
                 wid = msg["worker_id"]
+                now = time.time()
                 self._workers[wid] = {"cores": int(msg.get("cores", 1)),
                                       "host": msg.get("host", "127.0.0.1"),
-                                      "last_seen": time.time(),
+                                      "coord_ports":
+                                          [[int(p), now] for p in
+                                           msg.get("coord_ports", [])],
+                                      "last_seen": now,
                                       "state": "ALIVE"}
                 self._launches.setdefault(wid, [])
                 self._save_state()
@@ -134,14 +162,26 @@ class MasterDaemon:
             if kind == "poll":
                 wid = msg["worker_id"]
                 w = self._workers.get(wid)
-                if w is None or w["state"] == "UNKNOWN":
+                if w is None or w["state"] in ("UNKNOWN", "DEAD"):
                     # recovered/unknown workers must RE-register so the
-                    # master learns their host and liveness afresh
+                    # master learns their host and liveness afresh; an
+                    # expired-DEAD worker that is polling again is alive —
+                    # re-registration restores it (and refreshes its port
+                    # pool, which went stale while it was away)
                     return {"ok": False, "error": "unregistered"}
-                w["last_seen"] = time.time()
+                now = time.time()
+                w["last_seen"] = now
+                pool = self._fresh_ports(w, now)
+                for p in msg.get("coord_ports", []):
+                    if (len(pool) < COORD_PORT_POOL
+                            and all(p != q[0] for q in pool)):
+                        pool.append([int(p), now])
                 q = self._launches.get(wid, [])
                 out, self._launches[wid] = list(q), []
-                return {"ok": True, "launches": out}
+                # ask the worker to re-probe only when submits have drawn
+                # the pool down or entries aged out (no bind/close per poll)
+                return {"ok": True, "launches": out,
+                        "need_ports": max(0, COORD_PORT_POOL - len(pool))}
             if kind == "app_update":
                 app = self._apps.get(msg["app_id"])
                 if app is not None:
@@ -175,6 +215,14 @@ class MasterDaemon:
                              for k, a in self._apps.items()}}
         return {"ok": False, "error": f"unknown kind {kind!r}"}
 
+    @staticmethod
+    def _fresh_ports(w: dict, now: float) -> List[list]:
+        """Drop aged-out pool entries in place and return the live pool."""
+        pool = [e for e in w.setdefault("coord_ports", [])
+                if now - e[1] <= COORD_PORT_TTL_S]
+        w["coord_ports"] = pool
+        return pool
+
     def _expire(self) -> None:
         now = time.time()
         for w in self._workers.values():
@@ -198,13 +246,24 @@ class MasterDaemon:
         start = self._rr % len(alive)
         self._rr += 1
         chosen = (alive[start:] + alive[:start])[:n]
-        # the coordinator lives on proc 0's HOST; the port is probed here
-        # (briefly unreserved — the same window every launcher that assigns
-        # remote ports accepts; collisions surface as a failed app, retry)
-        coord_host = self._workers[chosen[0]].get("host", "127.0.0.1")
-        with socket.socket() as s:
-            s.bind(("", 0))
-            coord_port = s.getsockname()[1]
+        # the coordinator lives on proc 0's HOST, so the port must be
+        # probed THERE: workers keep a pool of pre-probed ports with the
+        # master (register + poll top-ups); a submit draws one. A
+        # master-side probe is meaningful ONLY for a worker on this same
+        # machine — for a remote worker with a drained pool the submit is
+        # rejected for retry rather than guessing a remote port.
+        w0 = self._workers[chosen[0]]
+        coord_host = w0.get("host", "127.0.0.1")
+        pool = self._fresh_ports(w0, time.time())
+        if pool:
+            coord_port = pool.pop(0)[0]
+        elif coord_host in ("127.0.0.1", "localhost",
+                            self._server.server_address[0]):
+            coord_port = _probe_free_ports(1)[0]
+        else:
+            return {"ok": False, "retryable": True,
+                    "error": f"worker {chosen[0]} has no fresh probed "
+                             f"coordinator port; retry after its next poll"}
         self._apps[app_id] = {"state": "RUNNING", "n_procs": n,
                               "workers": chosen, "procs": {}}
         for i, wid in enumerate(chosen):
@@ -245,21 +304,31 @@ class WorkerDaemon:
         self._thread.start()
 
     def _register(self) -> None:
+        # coordinator ports are probed HERE (where a proc-0 coordinator
+        # would bind) so the master never guesses ports on a remote host
         rep = _send(self.master, {"kind": "register",
                                   "worker_id": self.worker_id,
-                                  "host": self.host, "cores": self.cores})
+                                  "host": self.host, "cores": self.cores,
+                                  "coord_ports":
+                                      _probe_free_ports(COORD_PORT_POOL)})
         if not rep.get("ok"):
             raise RuntimeError(f"registration failed: {rep}")
 
     def _loop(self) -> None:
+        top_up: List[int] = []
         while not self._stop.is_set():
             try:
                 rep = _send(self.master, {"kind": "poll",
-                                          "worker_id": self.worker_id})
+                                          "worker_id": self.worker_id,
+                                          "coord_ports": top_up})
+                top_up = []
                 if not rep.get("ok") and rep.get("error") == "unregistered":
                     # a restarted master forgot us — re-register (the
                     # reference worker re-registers on MasterChanged)
                     self._register()
+                # re-probe only when submits drained the master-side pool
+                if rep.get("need_ports"):
+                    top_up = _probe_free_ports(int(rep["need_ports"]))
                 for launch in rep.get("launches", []):
                     if "kill" in launch:
                         self._kill(launch["kill"])
@@ -267,6 +336,10 @@ class WorkerDaemon:
                         self._launch(launch)
             except Exception as e:
                 logger.warning("worker %s poll failed: %s", self.worker_id, e)
+                # drop unsent probes: after an outage the master would stamp
+                # them fresh on arrival, defeating COORD_PORT_TTL_S — the
+                # next need_ports reply triggers a NEW probe instead
+                top_up = []
             self._stop.wait(self.poll_interval_s)
 
     def _kill(self, app_id: str) -> None:
@@ -279,9 +352,16 @@ class WorkerDaemon:
     def _launch(self, launch: dict) -> None:
         env = dict(os.environ)
         env.update(launch.get("env", {}))
-        env["CYCLONE_MASTER_URL"] = (
+        master_url = (
             f"multihost[{launch['coordinator']},{launch['n_procs']},"
             f"{launch['proc_id']}]")
+        env["CYCLONE_MASTER_URL"] = master_url
+        # Seed the normal conf channel too — OVERRIDING any forwarded
+        # cyclone.master (e.g. the cyclone://host:port the client submitted
+        # with) so an unmodified app calling CycloneContext.get_or_create()
+        # joins the mesh, the way the reference worker rewrites spark.master
+        # for launched processes.
+        env["CYCLONE_CONF_cyclone__master"] = master_url
         env["CYCLONE_APP_ID"] = launch["app_id"]
         env["CYCLONE_PROC_ID"] = str(launch["proc_id"])
         proc = subprocess.Popen(
@@ -320,14 +400,22 @@ class WorkerDaemon:
 
 def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
                args: Optional[List[str]] = None,
-               env: Optional[Dict[str, str]] = None) -> str:
-    """Client-side submit (ref deploy/Client.scala): returns the app id."""
-    rep = _send(master_addr, {"kind": "submit", "app_path": app_path,
-                              "n_procs": n_procs, "args": args or [],
-                              "env": env or {}})
-    if not rep.get("ok"):
-        raise RuntimeError(f"submit rejected: {rep.get('error')}")
-    return rep["app_id"]
+               env: Optional[Dict[str, str]] = None,
+               retries: int = 10, retry_wait_s: float = 0.5) -> str:
+    """Client-side submit (ref deploy/Client.scala): returns the app id.
+
+    Retryable rejections (a remote worker's probed-port pool momentarily
+    drained) are retried here so callers see them only when persistent."""
+    for attempt in range(retries + 1):
+        rep = _send(master_addr, {"kind": "submit", "app_path": app_path,
+                                  "n_procs": n_procs, "args": args or [],
+                                  "env": env or {}})
+        if rep.get("ok"):
+            return rep["app_id"]
+        if not rep.get("retryable") or attempt == retries:
+            raise RuntimeError(f"submit rejected: {rep.get('error')}")
+        time.sleep(retry_wait_s)
+    raise AssertionError("unreachable")
 
 
 def app_status(master_addr: str, app_id: Optional[str] = None) -> dict:

@@ -273,6 +273,11 @@ class _StrAccessor:
 
     def _map(self, f, dtype=object) -> CycloneSeries:
         vals = [None if v is None else f(v) for v in self._s.values]
+        if dtype is not object and any(v is None for v in vals):
+            # pandas propagates nulls as NaN rather than failing the cast:
+            # len() -> float64 with NaN, boolean tests -> object with NaN
+            vals = [np.nan if v is None else v for v in vals]
+            dtype = np.float64 if dtype is np.int64 else object
         return CycloneSeries(np.array(vals, dtype=dtype), self._s.name,
                              index=self._s.index)
 
@@ -398,11 +403,37 @@ class _LocIndexer:
         if isinstance(key, CycloneSeries):  # boolean mask
             return f[key]
         if isinstance(key, slice):
-            # label slices are INCLUSIVE on both ends in pandas
-            lo = 0 if key.start is None else int(
-                np.nonzero(idx == key.start)[0][0])
-            hi = len(f) - 1 if key.stop is None else int(
-                np.nonzero(idx == key.stop)[0][-1])
+            # label slices are INCLUSIVE on both ends in pandas; on a
+            # monotonic index a missing bound slices to its insertion
+            # point, otherwise it is KeyError; duplicate bound labels on a
+            # non-monotonic index are rejected (pandas contract)
+            try:
+                inc = bool(np.all(idx[:-1] <= idx[1:]))
+                dec = not inc and bool(np.all(idx[:-1] >= idx[1:]))
+            except TypeError:  # unorderable mixed-type labels
+                inc = dec = False
+            rev = idx[::-1] if dec else None
+
+            def _bound(label, side):
+                hits = np.nonzero(idx == label)[0]
+                if len(hits) > 1 and not (inc or dec):
+                    raise KeyError(
+                        f"Cannot get {side} slice bound for non-unique "
+                        f"label: {label!r}")
+                if len(hits):
+                    return int(hits[0] if side == "left" else hits[-1])
+                if inc:
+                    p = int(np.searchsorted(
+                        idx, label, side="left" if side == "left" else "right"))
+                    return p if side == "left" else p - 1
+                if dec:
+                    p = int(np.searchsorted(
+                        rev, label, side="right" if side == "left" else "left"))
+                    return (len(f) - p) if side == "left" else len(f) - p - 1
+                raise KeyError(label)
+            lo = 0 if key.start is None else _bound(key.start, "left")
+            hi = (len(f) - 1 if key.stop is None
+                  else _bound(key.stop, "right"))
             return f._take(np.arange(lo, hi + 1))
         if isinstance(key, (list, np.ndarray)):
             # every row matching each label, label order outer (pandas
@@ -590,7 +621,19 @@ class CycloneFrame:
         if isinstance(key, list):
             return self._like({k: self._cols[k] for k in key})
         if isinstance(key, CycloneSeries):  # boolean mask
-            mask = np.asarray(key.values, dtype=bool)
+            vals = np.asarray(key.values)
+            has_null = (
+                any(v is None or (isinstance(v, float) and np.isnan(v))
+                    for v in vals)
+                if vals.dtype == object
+                else vals.dtype.kind == "f" and bool(np.isnan(vals).any()))
+            if has_null:
+                # pandas contract: a mask with nulls is an error, never a
+                # silent truthy-NaN selection (NaN casts to True)
+                raise ValueError(
+                    "Cannot mask with non-boolean array containing NA / "
+                    "NaN values")
+            mask = vals.astype(bool)
             return self._take(np.nonzero(mask)[0])
         raise TypeError(f"cannot index with {type(key).__name__}")
 

@@ -89,6 +89,71 @@ def test_submit_two_process_mesh(cluster):
     assert all(abs(r["total"] - 8.0) < 1e-9 for r in results)
 
 
+def test_cluster_app_joins_via_conf_path(cluster):
+    """An UNMODIFIED app — plain CycloneContext.get_or_create(), no
+    CYCLONE_MASTER_URL reading — joins the mesh because the Worker seeds
+    CYCLONE_CONF_cyclone__master, overriding the cyclone:// master URL the
+    client submitted with (advisor r3 medium; the reference worker rewrites
+    spark.master for launched processes the same way)."""
+    m, workers, tmp_path = cluster
+    app = tmp_path / "conf_app.py"
+    app.write_text(textwrap.dedent(f"""
+        import json, os, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from cycloneml_tpu.context import CycloneContext
+        ctx = CycloneContext.get_or_create()
+        with open(os.path.join({str(tmp_path)!r}, "conf_app.json"), "w") as fh:
+            json.dump({{"n_devices": ctx.mesh_runtime.n_devices}}, fh)
+        ctx.stop()
+    """))
+    # simulate cyclone-submit forwarding the client-side master URL — the
+    # worker must OVERRIDE it or get_or_create() dies parsing cyclone://
+    env = {"CYCLONE_CONF_cyclone__master": f"cyclone://{m.address}",
+           "JAX_PLATFORMS": "", "XLA_FLAGS": ""}
+    app_id = submit_app(m.address, str(app), n_procs=1, env=env)
+    assert wait_for_app(m.address, app_id, timeout_s=240) == "FINISHED"
+    got = __import__("json").load(open(tmp_path / "conf_app.json"))
+    assert got["n_devices"] == 4
+
+
+def test_coordinator_port_probed_on_worker(cluster):
+    """The jax.distributed coordinator port comes from the proc-0 WORKER's
+    own probe (register/poll handshake), not a master-side bind that says
+    nothing about a remote host (advisor r3)."""
+    from cycloneml_tpu.deploy import _send
+    m, workers, tmp_path = cluster
+    _send(m.address, {"kind": "register", "worker_id": "w-port",
+                      "host": "10.9.9.9", "cores": 1,
+                      "coord_ports": [45123, 45124]})
+    app = tmp_path / "noop2.py"
+    app.write_text("pass\n")
+    # force scheduling onto the fake worker: submit until it's chosen
+    for _ in range(4):
+        rep = _send(m.address, {"kind": "submit", "app_path": str(app),
+                                "n_procs": 1})
+        assert rep["ok"]
+        if rep["workers"] == ["w-port"]:
+            break
+    assert rep["workers"] == ["w-port"]
+    with m._lock:
+        launch = m._launches["w-port"][-1]
+    assert launch["coordinator"] == "10.9.9.9:45123"
+    # a REMOTE worker with a drained pool is a retryable rejection, never
+    # a master-side probe of a port on somebody else's machine
+    with m._lock:
+        m._workers["w-port"]["coord_ports"].clear()
+    for _ in range(4):
+        rep = _send(m.address, {"kind": "submit", "app_path": str(app),
+                                "n_procs": 1})
+        if not rep["ok"]:
+            break
+    assert rep["ok"] is False and rep["retryable"] is True
+
+
 def test_failed_app_and_insufficient_workers(cluster):
     m, workers, tmp_path = cluster
     bad = tmp_path / "bad.py"
@@ -174,3 +239,44 @@ def test_worker_reregisters_after_master_restart(tmp_path):
     finally:
         w.stop()
         m2.stop()
+
+
+def test_dead_worker_restored_by_reregister(cluster):
+    """A worker that missed heartbeats long enough to be expired DEAD is
+    told to re-register on its next poll (fresh port pool included) and
+    becomes schedulable again (review r4)."""
+    from cycloneml_tpu.deploy import _send
+    m, workers, tmp_path = cluster
+    wid = workers[0].worker_id
+    with m._lock:
+        m._workers[wid]["state"] = "DEAD"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = app_status(m.address)
+        if st["workers"][wid]["state"] == "ALIVE":
+            break
+        time.sleep(0.1)
+    assert st["workers"][wid]["state"] == "ALIVE"
+    with m._lock:
+        assert m._workers[wid]["coord_ports"]  # pool refreshed on register
+
+
+def test_stale_pool_ports_aged_out(cluster):
+    """Pool entries older than COORD_PORT_TTL_S are never handed to a
+    coordinator (review r4: the probe-to-bind race must stay bounded)."""
+    import cycloneml_tpu.deploy as dep
+    m, workers, tmp_path = cluster
+    dep._send(m.address, {"kind": "register", "worker_id": "w-stale",
+                          "host": "10.8.8.8", "cores": 1,
+                          "coord_ports": [40001]})
+    with m._lock:  # age the entry far past the TTL
+        m._workers["w-stale"]["coord_ports"][0][1] -= (
+            dep.COORD_PORT_TTL_S + 1)
+    app = tmp_path / "noop3.py"
+    app.write_text("pass\n")
+    for _ in range(4):
+        rep = dep._send(m.address, {"kind": "submit", "app_path": str(app),
+                                    "n_procs": 1})
+        if not rep.get("ok"):
+            break
+    assert rep["ok"] is False and rep["retryable"] is True

@@ -212,3 +212,90 @@ def test_pivot_table_nan_values_skipped():
         np.testing.assert_allclose(got["u"].values,
                                    want["u"].to_numpy(dtype=float),
                                    equal_nan=True, err_msg=agg)
+
+
+def test_loc_label_slice_missing_and_nonunique():
+    """Missing boundary labels raise KeyError (not IndexError) and label
+    slices over a non-unique unsorted index are rejected, matching pandas
+    (advisor r3)."""
+    cf = CycloneFrame({"k": ["b", "a", "c", "a"],
+                       "n": [1, 2, 3, 4]}).set_index("k")
+    pdf = pd.DataFrame({"k": ["b", "a", "c", "a"],
+                        "n": [1, 2, 3, 4]}).set_index("k")
+    with pytest.raises(KeyError):
+        cf.loc["zz":"c"]
+    with pytest.raises(KeyError):
+        cf.loc["b":"zz"]
+    # pandas: "Cannot get left slice bound for non-unique label"
+    with pytest.raises(KeyError):
+        pdf.loc["a":"c"]
+    with pytest.raises(KeyError):
+        cf.loc["a":"c"]
+    # a sorted non-unique index still slices fine in both
+    cs = CycloneFrame({"k": ["a", "a", "b", "c"],
+                       "n": [1, 2, 3, 4]}).set_index("k")
+    ps = pd.DataFrame({"k": ["a", "a", "b", "c"],
+                       "n": [1, 2, 3, 4]}).set_index("k")
+    np.testing.assert_array_equal(cs.loc["a":"b"]["n"].values,
+                                  ps.loc["a":"b"]["n"].to_numpy())
+    # on a MONOTONIC index a missing bound slices to its insertion point
+    # (searchsorted), matching pandas — no KeyError
+    cm = CycloneFrame({"k": ["a", "b", "d"], "n": [1, 2, 3]}).set_index("k")
+    pm = pd.DataFrame({"k": ["a", "b", "d"], "n": [1, 2, 3]}).set_index("k")
+    for sl in [slice("a", "c"), slice("c", "d"), slice("c", "cc"),
+               slice(None, "c"), slice("c", None)]:
+        np.testing.assert_array_equal(cm.loc[sl]["n"].values,
+                                      pm.loc[sl]["n"].to_numpy())
+    # decreasing index slices too
+    cd = CycloneFrame({"k": ["d", "b", "a"], "n": [3, 2, 1]}).set_index("k")
+    pdd = pd.DataFrame({"k": ["d", "b", "a"], "n": [3, 2, 1]}).set_index("k")
+    np.testing.assert_array_equal(cd.loc["c":"a"]["n"].values,
+                                  pdd.loc["c":"a"]["n"].to_numpy())
+
+
+def test_str_accessor_with_nulls():
+    """len()/contains()/startswith()/endswith() over columns containing
+    None propagate NaN instead of raising on the int64/bool cast
+    (advisor r3; pandas object-dtype null semantics)."""
+    vals = ["abc", None, "bd"]
+    cs = CycloneFrame({"s": vals})["s"]
+    # object dtype is the oracle: pandas 3.0's default str dtype fills
+    # nulls with False for boolean ops, but our columns are object-backed
+    ps = pd.Series(vals, dtype=object)
+    got = cs.str.len()
+    exp = ps.str.len()
+    assert got.values[0] == 3 and got.values[2] == 2
+    assert np.isnan(got.values[1]) and np.isnan(exp.iloc[1])
+    for meth, arg in [("contains", "b"), ("startswith", "a"),
+                      ("endswith", "d")]:
+        g = getattr(cs.str, meth)(arg).values
+        e = getattr(ps.str, meth)(arg)
+        assert list(g[[0, 2]]) == list(e.iloc[[0, 2]])
+        assert g[1] is np.nan or (isinstance(g[1], float) and np.isnan(g[1]))
+        assert e.iloc[1] is None or (isinstance(e.iloc[1], float)
+                                     and np.isnan(e.iloc[1]))
+
+
+def test_boolean_mask_with_nulls_raises():
+    """Masking with a null-carrying boolean result raises like pandas
+    instead of truthy-NaN selecting every null row (review r4)."""
+    cf = CycloneFrame({"s": ["abc", None, "bd"], "n": [1, 2, 3]})
+    with pytest.raises(ValueError, match="NaN"):
+        cf[cf["s"].str.contains("b")]
+    pdf = pd.DataFrame({"s": pd.Series(["abc", None, "bd"], dtype=object),
+                        "n": [1, 2, 3]})
+    with pytest.raises(ValueError):
+        pdf[pdf["s"].str.contains("b")]
+    # a clean boolean mask still selects
+    np.testing.assert_array_equal(
+        cf[cf["n"] > 1]["n"].values, pdf[pdf["n"] > 1]["n"].to_numpy())
+
+
+def test_boolean_mask_float_nan_raises():
+    """A float mask carrying NaN must raise too (NaN casts to True) —
+    review r4 follow-up to the object-mask guard."""
+    cf = CycloneFrame({"n": [1, 2, 3]})
+    from cycloneml_tpu.pandas.frame import CycloneSeries
+    bad = CycloneSeries(np.array([1.0, np.nan, 0.0]), "m")
+    with pytest.raises(ValueError, match="NaN"):
+        cf[bad]
