@@ -341,7 +341,17 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         l2 = (1.0 - alpha) * reg
         l1 = alpha * reg
 
-        ds_std, inv_std = standardize_dataset(ds, features_std)
+        # fitWithMean (ref LogisticRegression.scala:946-955, SPARK-34448):
+        # with a free intercept, train on CENTERED standardized features —
+        # decorrelates the intercept from offset features so small-variance
+        # columns condition properly. Allowed exactly when the intercept is
+        # unbounded; the intercept is mapped back after optimization.
+        fit_with_mean = fit_intercept and all(
+            self._opt(p) is None for p in ("lowerBoundsOnIntercepts",
+                                           "upperBoundsOnIntercepts"))
+        ds_std, inv_std = standardize_dataset(
+            ds, features_std, center_mean=stats.mean if fit_with_mean else None)
+        scaled_mean = stats.mean * inv_std if fit_with_mean else None
 
         if is_multinomial:
             agg = aggregators.multinomial_logistic(d, num_classes, fit_intercept)
@@ -432,12 +442,18 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             np.asarray(histogram).round(6).tolist(),
             np.asarray(features_std).round(6).tolist(),
             reg, alpha, self.get("tol"), fit_intercept, standardize,
+            fit_with_mean,
         ))
 
         sol = state.x
         if is_multinomial:
             wmat = sol[: d * num_classes].reshape(num_classes, d) * inv_std[None, :]
             icpt = sol[d * num_classes:] if fit_intercept else np.zeros(num_classes)
+            if fit_with_mean:
+                # un-adapt: centered-problem intercepts back to original
+                # space (ref LogisticRegression.scala:1018-1024 dgemv adapt)
+                icpt = icpt - sol[: d * num_classes].reshape(
+                    num_classes, d) @ scaled_mean
             if reg == 0.0:
                 # center for identifiability, as the reference does when the
                 # multinomial problem has no regularization
@@ -450,6 +466,9 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         else:
             beta = sol[:d] * inv_std
             icpt = float(sol[d]) if fit_intercept else 0.0
+            if fit_with_mean:
+                # ref LogisticRegression.scala:1027-1031: solution(num) -= adapt
+                icpt -= float(sol[:d] @ scaled_mean)
             model = LogisticRegressionModel(
                 coefficient_matrix=beta[None, :], intercept_vector=np.array([icpt]),
                 num_classes=2, is_multinomial=False, uid=self.uid)

@@ -275,17 +275,43 @@ def _get_scale_rows():
     return _scale_rows
 
 
-def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray):
+_center_scale_rows = None
+
+
+def _get_center_scale_rows():
+    global _center_scale_rows
+    if _center_scale_rows is None:
+        import jax
+        _center_scale_rows = jax.jit(lambda x, s, mu: (x - mu) * s)
+    return _center_scale_rows
+
+
+def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray,
+                        center_mean: Optional[np.ndarray] = None):
     """Scale feature blocks by 1/std in HBM (≈ the reference persisting
     standardized blocks, LogisticRegression.scala:968). Zero-variance
-    features scale to 0, matching the reference's exclusion. Returns
-    (standardized dataset, inv_std)."""
+    features scale to 0, matching the reference's exclusion.
+
+    ``center_mean`` additionally centers: x̂ = (x − μ)/σ — the reference's
+    ``fitWithMean`` conditioning fix (SPARK-34448,
+    LogisticRegression.scala:946-955). The reference implements centering
+    as a margin offset inside the aggregator to keep sparse blocks sparse;
+    this dense tier centers the (already dense) standardized copy
+    directly, which is the same objective with the same memory footprint
+    and keeps the aggregator program-cache identity. Padded rows carry
+    w=0, so their shifted values never contribute.
+
+    Returns (standardized dataset, inv_std)."""
     import jax
     import jax.numpy as jnp
 
     inv_std = np.where(features_std > 0, 1.0 / np.where(
         features_std > 0, features_std, 1.0), 0.0)
-    scaled = _get_scale_rows()(ds.x, jnp.asarray(inv_std))
+    if center_mean is not None:
+        scaled = _get_center_scale_rows()(
+            ds.x, jnp.asarray(inv_std), jnp.asarray(center_mean))
+    else:
+        scaled = _get_scale_rows()(ds.x, jnp.asarray(inv_std))
     return ds.derive(x=scaled), inv_std
 
 

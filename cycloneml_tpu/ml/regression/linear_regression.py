@@ -114,11 +114,17 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
             model.summary = LinearRegressionTrainingSummary([0.0], 0)
             return model
 
+        # glmnet semantics (the reference's parity target): the penalty is
+        # applied on the label-standardized problem, so the user's regParam
+        # is divided by the label std (ref LinearRegression.scala:396
+        # effectiveRegParam = regParam / yStd; WeightedLeastSquares.scala:209)
+        eff_reg = reg / y_std
         if solver == "normal":
-            coef, icpt, history = self._solve_normal(ds, stats, y_mean, y_std, reg)
+            coef, icpt, history = self._solve_normal(ds, stats, y_mean,
+                                                     y_std, eff_reg)
         else:
             coef, icpt, history = self._solve_quasi_newton(
-                ds, stats, y_mean, y_std, reg, alpha)
+                ds, stats, y_mean, y_std, eff_reg, alpha)
 
         model = LinearRegressionModel(coef, icpt, uid=self.uid)
         self._copy_values(model)
@@ -149,12 +155,12 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
             # centered normal equations: (XᵀWX − w x̄x̄ᵀ) β = XᵀWy − w x̄ ȳ
             xtx = xtx - w_sum * np.outer(x_mean, x_mean)
             xty = xty - w_sum * x_mean * y_mean
-        # L2: lambda scaled like the reference (on standardized coefs when
-        # standardization=true): penalty_j = reg * w_sum * (std_j^2 or 1)
+        # L2 diag: ``reg`` arrives already divided by σy (glmnet scaling);
+        # std-space λ on β̂=β·σx/σy maps to reg·w_sum·σx² on original β
+        # (one σy cancels against the 1/σy²-scaled loss), and
+        # standardization=false drops the σx² factor
+        # (ref WeightedLeastSquares.scala:213-228)
         if reg > 0:
-            # std-space L2 on β̂=β·σx/σy maps to reg·w_sum·σx² on original β
-            # (σy² cancels between the 1/σy²-scaled loss and the penalty);
-            # standardization=false drops the σx² factor
             std = stats.std
             if standardize:
                 diag = reg * w_sum * std * std

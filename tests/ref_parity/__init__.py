@@ -1,0 +1,3 @@
+"""Bit-exact reproductions of the reference's test-data generators, so its
+committed R-computed golden constants can be asserted against this
+framework's estimators (round-3 verdict item 1)."""

@@ -1,0 +1,148 @@
+"""Bit-exact ports of the reference's synthetic test-data generators.
+
+Each function reproduces the sequence of RNG draws of its Scala
+counterpart so the datasets — and therefore the R-computed golden
+constants the reference's suites assert against — carry over exactly:
+
+- ``generate_logistic_input`` ≈ ml/classification/
+  LogisticRegressionSuite.scala:3021 (object LogisticRegressionSuite)
+- ``generate_multinomial_logistic_input`` ≈ same file :3061
+- ``generate_linear_input`` ≈ mllib/util/LinearDataGenerator.scala:120
+- ``generate_glm_input`` ≈ ml/regression/
+  GeneralizedLinearRegressionSuite.scala:1713 (gaussian families only:
+  poisson/gamma noise uses commons-math3, out of reproduction scope)
+- ``binary_dataset_with_weights`` ≈ ml/classification/
+  LogisticRegressionSuite.scala:75 (the ``binaryDataset`` every weighted
+  golden LR test fits, including its Spark-SQL ``rand(seed)`` weight
+  column over 4 parallelize partitions)
+"""
+
+import math
+
+import numpy as np
+
+from tests.ref_parity.scala_rng import (JavaRandom, XORShiftRandom,
+                                        sql_rand_column)
+
+
+def generate_logistic_input(offset, scale, n_points, seed):
+    """y = logistic(offset + scale*x), x ~ N(0,1): all gaussians first,
+    then one uniform per label draw (the Scala draw order)."""
+    rnd = JavaRandom(seed)
+    x1 = [rnd.next_gaussian() for _ in range(n_points)]
+    y = []
+    for i in range(n_points):
+        p = 1.0 / (1.0 + math.exp(-(offset + scale * x1[i])))
+        y.append(1.0 if rnd.next_double() < p else 0.0)
+    return np.array(x1).reshape(-1, 1), np.array(y)
+
+
+def generate_multinomial_logistic_input(weights, x_mean, x_variance,
+                                        add_intercept, n_points, seed):
+    """K-class softmax sampling over gaussian features; one row's features
+    are drawn fully before the next row (Array.fill order), then labels
+    consume one uniform each."""
+    rnd = JavaRandom(seed)
+    x_dim = len(x_mean)
+    w_dim = x_dim + 1 if add_intercept else x_dim
+    n_classes = len(weights) // w_dim + 1
+
+    x = np.empty((n_points, x_dim))
+    for i in range(n_points):
+        for j in range(x_dim):
+            x[i, j] = rnd.next_gaussian()
+    x = x * np.sqrt(np.asarray(x_variance)) + np.asarray(x_mean)
+
+    y = np.empty(n_points)
+    for idx in range(n_points):
+        margins = np.zeros(n_classes)
+        for i in range(n_classes - 1):
+            m = 0.0
+            for j in range(x_dim):
+                m += weights[i * w_dim + j] * x[idx, j]
+            if add_intercept:
+                m += weights[(i + 1) * w_dim - 1]
+            margins[i + 1] = m
+        max_margin = margins.max()
+        if max_margin > 0:
+            margins -= max_margin
+        probs = np.exp(margins)
+        cum = np.cumsum(probs / probs.sum())
+        p = rnd.next_double()
+        y[idx] = int(np.searchsorted(cum, p, side="right"))
+    return x, y
+
+
+def generate_linear_input(intercept, weights, x_mean, x_variance, n_points,
+                          seed, eps, sparsity=0.0):
+    """label = w·x + intercept + eps*N(0,1); features are uniform draws
+    rescaled to the requested mean/variance. Draw order per row: all
+    feature uniforms, then the noise gaussian. NOTE the gaussian shares
+    the same LCG stream (java.util.Random interleaves them)."""
+    if sparsity != 0.0:
+        raise NotImplementedError("sparse variant not needed by the goldens")
+    rnd = JavaRandom(seed)
+    w = np.asarray(weights)
+    d = len(w)
+    scale = np.sqrt(12.0 * np.asarray(x_variance))
+    mean = np.asarray(x_mean)
+    X = np.empty((n_points, d))
+    y = np.empty(n_points)
+    for i in range(n_points):
+        for j in range(d):
+            X[i, j] = (rnd.next_double() - 0.5) * scale[j] + mean[j]
+        y[i] = float(X[i] @ w) + intercept + eps * rnd.next_gaussian()
+    return X, y
+
+
+def generate_glm_input(intercept, coefficients, x_mean, x_variance,
+                       n_points, seed, noise_level, family, link):
+    """Gaussian-family GLM data: features from java.util.Random uniforms,
+    noise from a SEPARATE XORShiftRandom gaussian stream
+    (StandardNormalGenerator.setSeed(seed))."""
+    if family != "gaussian":
+        raise NotImplementedError(
+            "poisson/gamma noise uses commons-math3; gaussian only")
+    rnd = JavaRandom(seed)
+    noise = XORShiftRandom(seed)
+    w = np.asarray(coefficients)
+    d = len(w)
+    scale = np.sqrt(12.0 * np.asarray(x_variance))
+    mean = np.asarray(x_mean)
+    X = np.empty((n_points, d))
+    y = np.empty(n_points)
+    for i in range(n_points):
+        for j in range(d):
+            X[i, j] = (rnd.next_double() - 0.5) * scale[j] + mean[j]
+        eta = float(X[i] @ w) + intercept
+        if link == "identity":
+            mu = eta
+        elif link == "log":
+            mu = math.exp(eta)
+        elif link == "sqrt":
+            mu = eta * eta
+        elif link == "inverse":
+            mu = 1.0 / eta
+        else:
+            raise ValueError(link)
+        y[i] = mu + noise_level * noise.next_gaussian()
+    return X, y
+
+
+# the binaryDataset shared by every weighted golden LR test
+# (LogisticRegressionSuite.scala:75-89): 10k points, seed 42, 4-partition
+# DataFrame with a rand(42) weight column
+_BINARY_COEF = [-0.57997, 0.912083, -0.371077, -0.819866, 2.688191]
+_BINARY_XMEAN = [5.843, 3.057, 3.758, 1.199]
+_BINARY_XVAR = [0.6856, 0.1899, 3.116, 0.581]
+_SMALLVAR_XMEAN = [5.843, 3.057, 3.758, 10.199]
+_SMALLVAR_XVAR = [0.6856, 0.1899, 3.116, 0.0001]
+
+
+def binary_dataset_with_weights(seed=42, n_points=10000, small_var=False):
+    x_mean = _SMALLVAR_XMEAN if small_var else _BINARY_XMEAN
+    x_var = _SMALLVAR_XVAR if small_var else _BINARY_XVAR
+    X, y = generate_multinomial_logistic_input(
+        _BINARY_COEF, x_mean, x_var, True, n_points, seed)
+    w = np.array(sql_rand_column(seed, n_points, 4))
+    return X, y, w

@@ -1,0 +1,168 @@
+"""Bit-exact Python ports of the RNGs the reference's test suites draw
+their synthetic datasets from:
+
+- ``JavaRandom``: java.util.Random — the 48-bit LCG specified in the JDK
+  javadoc (``scala.util.Random`` delegates to it). Used by
+  ``generateMultinomialLogisticInput`` / ``LinearDataGenerator`` etc.
+- ``XORShiftRandom``: the reference's ``core/src/main/scala/org/apache/
+  spark/util/random/XORShiftRandom.scala`` — java.util.Random with
+  ``next(bits)`` replaced by a 64-bit xorshift whose seed is hashed with
+  scala.util.hashing.MurmurHash3.bytesHash. Spark SQL's ``rand(seed)``
+  column draws ``new XORShiftRandom(seed + partitionIndex).nextDouble()``
+  per row (``sql/catalyst/.../expressions/randomExpressions.scala:44``),
+  and mllib's ``StandardNormalGenerator`` is ``XORShiftRandom
+  .nextGaussian`` (``mllib/random/RandomDataGenerator.scala:70``).
+
+These are reimplementations from the published algorithm specs, not
+translations: the goal is reproducing the reference's exact test datasets
+so its committed R oracle constants apply to our estimators.
+"""
+
+import math
+
+_M32 = 0xFFFFFFFF
+_M48 = 0xFFFFFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+_LCG_MULT = 0x5DEECE66D
+_LCG_ADD = 0xB
+
+
+class JavaRandom:
+    """java.util.Random: 48-bit LCG; nextGaussian is the Marsaglia polar
+    method exactly as the JDK documents it."""
+
+    def __init__(self, seed: int):
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> None:
+        self._seed = (seed ^ _LCG_MULT) & _M48
+        self._next_gaussian = None
+
+    def _next(self, bits: int) -> int:
+        self._seed = (self._seed * _LCG_MULT + _LCG_ADD) & _M48
+        return self._seed >> (48 - bits)
+
+    def next_int(self) -> int:
+        v = self._next(32)
+        return v - (1 << 32) if v >= (1 << 31) else v
+
+    def next_double(self) -> float:
+        return ((self._next(26) << 27) + self._next(27)) * (2.0 ** -53)
+
+    def next_gaussian(self) -> float:
+        if self._next_gaussian is not None:
+            g, self._next_gaussian = self._next_gaussian, None
+            return g
+        while True:
+            v1 = 2.0 * self.next_double() - 1.0
+            v2 = 2.0 * self.next_double() - 1.0
+            s = v1 * v1 + v2 * v2
+            if 0.0 < s < 1.0:
+                break
+        mult = math.sqrt(-2.0 * math.log(s) / s)
+        self._next_gaussian = v2 * mult
+        return v1 * mult
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _murmur_mix(h: int, k: int) -> int:
+    k = (k * 0xCC9E2D51) & _M32
+    k = _rotl32(k, 15)
+    k = (k * 0x1B873593) & _M32
+    h ^= k
+    h = _rotl32(h, 13)
+    return (h * 5 + 0xE6546B64) & _M32
+
+
+def _murmur_mix_last(h: int, k: int) -> int:
+    k = (k * 0xCC9E2D51) & _M32
+    k = _rotl32(k, 15)
+    k = (k * 0x1B873593) & _M32
+    return h ^ k
+
+
+def murmur3_bytes_hash(data: bytes, seed: int) -> int:
+    """scala.util.hashing.MurmurHash3.bytesHash (x86_32, little-endian
+    4-byte blocks). Returns an unsigned 32-bit value."""
+    h = seed & _M32
+    n = len(data)
+    i = 0
+    while n - i >= 4:
+        k = (data[i] | (data[i + 1] << 8) | (data[i + 2] << 16)
+             | (data[i + 3] << 24))
+        h = _murmur_mix(h, k)
+        i += 4
+    k = 0
+    rem = n - i
+    if rem == 3:
+        k ^= data[i + 2] << 16
+    if rem >= 2:
+        k ^= data[i + 1] << 8
+    if rem >= 1:
+        k ^= data[i]
+        h = _murmur_mix_last(h, k)
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+_ARRAY_SEED = 0x3C074A61  # MurmurHash3.arraySeed
+
+
+def _xorshift_hash_seed(seed: int) -> int:
+    """XORShiftRandom.hashSeed: murmur the big-endian long bytes twice;
+    high word is the SIGN-EXTENDED second hash (Scala Int.toLong)."""
+    data = (seed & _M64).to_bytes(8, "big")
+    low = murmur3_bytes_hash(data, _ARRAY_SEED)
+    high = murmur3_bytes_hash(data, low)
+    # (highBits.toLong << 32) | (lowBits & 0xFFFFFFFFL) on SIGNED ints —
+    # as unsigned 64-bit two's complement the sign extension is absorbed
+    # by the << 32 mask
+    return ((high << 32) | low) & _M64
+
+
+class XORShiftRandom(JavaRandom):
+    """The reference's XORShiftRandom: java.util.Random protocol with
+    ``next(bits)`` replaced by a 64-bit xorshift returning the LOW bits."""
+
+    def __init__(self, init: int):
+        self.set_seed(init)
+
+    def set_seed(self, seed: int) -> None:
+        self._seed64 = _xorshift_hash_seed(seed)
+        self._next_gaussian = None
+
+    def _next(self, bits: int) -> int:
+        s = self._seed64
+        s = (s ^ (s << 21)) & _M64
+        s ^= s >> 35  # unsigned value, so >> is Java's >>>
+        s = (s ^ (s << 4)) & _M64
+        self._seed64 = s
+        return s & ((1 << bits) - 1)
+
+
+def parallelize_slice_bounds(length: int, num_slices: int):
+    """ParallelCollectionRDD.slice positions (core/.../rdd/
+    ParallelCollectionRDD.scala:116): slice i covers
+    [i*length//num_slices, (i+1)*length//num_slices)."""
+    return [(i * length // num_slices, (i + 1) * length // num_slices)
+            for i in range(num_slices)]
+
+
+def sql_rand_column(seed: int, n_rows: int, n_partitions: int):
+    """The ``rand(seed)`` column Spark SQL evaluates over a DataFrame with
+    ``n_partitions`` even parallelize partitions: partition p draws from
+    ``new XORShiftRandom(seed + p)`` one nextDouble per row."""
+    out = []
+    for p, (lo, hi) in enumerate(
+            parallelize_slice_bounds(n_rows, n_partitions)):
+        rng = XORShiftRandom(seed + p)
+        out.extend(rng.next_double() for _ in range(hi - lo))
+    return out

@@ -41,8 +41,11 @@ def test_ridge_standardized_vs_sklearn(ctx):
                          tol=1e-12, maxIter=1000).fit(frame)
     sx = x.std(axis=0, ddof=1)
     sy = y.std(ddof=1)
-    sk = ElasticNet(alpha=reg, l1_ratio=0.0, tol=1e-12, max_iter=100000).fit(
-        x / sx, y / sy)
+    # glmnet semantics (proven by tests/test_ref_golden_parity.py): the
+    # user's regParam is divided by the label std before penalizing the
+    # y-standardized problem — so sklearn's alpha here is reg/sy
+    sk = ElasticNet(alpha=reg / sy, l1_ratio=0.0, tol=1e-12,
+                    max_iter=100000).fit(x / sx, y / sy)
     np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_ * sy / sx,
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(m.intercept, sk.intercept_ * sy, rtol=1e-4)
@@ -67,8 +70,9 @@ def test_elasticnet_lasso_vs_sklearn(ctx):
                          maxIter=2000).fit(frame)
     sx = x.std(axis=0, ddof=1)
     sy = y.std(ddof=1)
-    sk = ElasticNet(alpha=reg, l1_ratio=a, tol=1e-14, max_iter=200000).fit(
-        x / sx, y / sy)
+    # alpha = reg/sy: glmnet label-std scaling (see ridge test note)
+    sk = ElasticNet(alpha=reg / sy, l1_ratio=a, tol=1e-14,
+                    max_iter=200000).fit(x / sx, y / sy)
     np.testing.assert_allclose(m.coefficients.to_array(), sk.coef_ * sy / sx,
                                atol=1e-4)
     ours_nz = set(np.nonzero(np.abs(m.coefficients.to_array()) > 1e-10)[0])

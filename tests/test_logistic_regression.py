@@ -242,8 +242,11 @@ def test_lr_wide_bounds_match_unbounded(ctx):
     np.testing.assert_allclose(wide.coefficients.to_array(),
                                free.coefficients.to_array(),
                                rtol=1e-5, atol=1e-7)
+    # intercept bounds disable fitWithMean (centered conditioning), so the
+    # two runs solve differently-conditioned problems that agree only to
+    # optimizer tolerance — same as the reference
     np.testing.assert_allclose(wide.intercept, free.intercept,
-                               rtol=1e-5, atol=1e-7)
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_lr_intercept_bounds(ctx):
