@@ -105,11 +105,42 @@ def generate_classification(ctx, n_rows: int, n_cols: int, seed: int = 0,
                                  w.astype(np.float64))
 
 
+def generate_regression(ctx, n_rows: int, n_cols: int, seed: int = 0,
+                        noise: float = 0.1) -> InstanceDataset:
+    """Labeled synthetic linear-regression dataset generated entirely on
+    device (ref mllib/util/LinearDataGenerator.scala:120 — the epsilon-shape
+    BASELINE config-2 feeder): ``y = x·beta + noise·eps`` with a shared
+    ground-truth ``beta ~ N(0,1)`` drawn from ``fold_in(seed, 2^31-1)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    dt = compute_dtype()
+
+    def local(key, per):
+        kx, ke = jax.random.split(key)
+        beta = jax.random.normal(
+            jax.random.fold_in(jax.random.PRNGKey(seed), 2 ** 31 - 1),
+            (n_cols,), dtype=jnp.float32)
+        x = jax.random.normal(kx, (per, n_cols), dtype=jnp.float32)
+        y = x @ beta + noise * jax.random.normal(ke, (per,),
+                                                 dtype=jnp.float32)
+        return x.astype(dt), y.astype(dt)
+
+    (x, y), w, total, dt = _shard_generate(ctx, n_rows, seed, local, n_out=2)
+    rt = ctx.mesh_runtime
+    ds = InstanceDataset(ctx, x, y, rt.device_put_sharded_rows(w),
+                         n_rows, n_cols)
+    return ds.attach_host_labels(np.asarray(y).astype(np.float64),
+                                 w.astype(np.float64))
+
+
 class RandomDatasets:
     """Static factory surface mirroring RandomRDDs (vector variants; the
     scalar variants are n_cols=1)."""
 
     classification = staticmethod(generate_classification)
+    regression = staticmethod(generate_regression)
 
     @staticmethod
     def normal(ctx, n_rows: int, n_cols: int = 1, seed: int = 0,

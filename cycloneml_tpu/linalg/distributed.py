@@ -37,9 +37,15 @@ class SVDResult(NamedTuple):
 
 class RowMatrix:
     """Row-oriented distributed matrix without meaningful row indices
-    (ref RowMatrix.scala:47)."""
+    (ref RowMatrix.scala:47).
 
-    def __init__(self, dataset: InstanceDataset):
+    Backed by either the dense device tier (``InstanceDataset``) or the
+    sparse ELL tier (``SparseInstanceDataset``) — the reference's RowMatrix
+    is likewise storage-agnostic over dense/sparse vectors. Gramian and the
+    Lanczos SVD operator dispatch on the tier; the sparse large-d path is
+    the NYTimes-class bag-of-words configuration (BASELINE config 5)."""
+
+    def __init__(self, dataset):
         self.dataset = dataset
 
     @classmethod
@@ -69,6 +75,37 @@ class RowMatrix:
                 np.asarray(sharded, dtype=np.float64))
         import jax
         import jax.numpy as jnp
+        from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+
+        if isinstance(self.dataset, SparseInstanceDataset):
+            # small-d sparse Gramian: densify each ELL block on device
+            # (scatter into (block, d)) and run the same einsum; for large
+            # d use compute_svd's Lanczos operator instead of materializing
+            # (d, d)
+            d = self.num_cols()
+            if self.dataset.is_hybrid:
+                def agg(indices, values, coo_row, coo_idx, coo_val, y, w):
+                    n_b = indices.shape[0]
+                    dense = jnp.zeros((n_b, d), values.dtype)
+                    dense = dense.at[
+                        jnp.arange(n_b)[:, None], indices].add(values)
+                    dense = dense.at[coo_row, coo_idx].add(coo_val)
+                    return jnp.einsum(
+                        "bi,bj->ij",
+                        dense * (w > 0)[:, None].astype(values.dtype),
+                        dense, precision=jax.lax.Precision.HIGHEST)
+            else:
+                def agg(indices, values, y, w):
+                    n_b = indices.shape[0]
+                    dense = jnp.zeros((n_b, d), values.dtype)
+                    dense = dense.at[
+                        jnp.arange(n_b)[:, None], indices].add(values)
+                    return jnp.einsum(
+                        "bi,bj->ij",
+                        dense * (w > 0)[:, None].astype(values.dtype),
+                        dense, precision=jax.lax.Precision.HIGHEST)
+            out = self.dataset.tree_aggregate_fn(agg)()
+            return DenseMatrix.from_array(np.asarray(out, dtype=np.float64))
 
         out = self.dataset.tree_aggregate_fn(
             lambda x, y, w: jnp.einsum(
@@ -79,7 +116,10 @@ class RowMatrix:
     def compute_gramian_sharded(self):
         """Model-axis-sharded Gramian (``P(model, None)`` device array), or
         None when the mesh has no model axis / d does not divide it."""
+        from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
         from cycloneml_tpu.parallel import feature_sharding as fs
+        if isinstance(self.dataset, SparseInstanceDataset):
+            return None  # the ring is a dense-block pipeline
         rt = self.dataset.ctx.mesh_runtime
         d = self.num_cols()
         m = fs.model_parallelism(rt)
@@ -152,6 +192,11 @@ class RowMatrix:
             # U = X V Σ⁻¹, rows stay sharded on device
             import jax
             import jax.numpy as jnp
+            from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+            if isinstance(self.dataset, SparseInstanceDataset):
+                raise NotImplementedError(
+                    "compute_u over the sparse tier: project with "
+                    "multiply() after densifying, or request V/σ only")
             vs = jnp.asarray(vecs / sigmas[None, :])
             ux = jax.jit(lambda x, m: jnp.dot(
                 x, m, precision=jax.lax.Precision.HIGHEST))(self.dataset.x, vs)
@@ -159,32 +204,64 @@ class RowMatrix:
             u = RowMatrix(ds)
         return SVDResult(u, s, v)
 
-    def _lanczos(self, k: int, tol: float, max_iter: int):
-        """Lanczos with full reorthogonalization on the driver; the matvec
-        q ↦ XᵀXq is a jit-compiled distributed psum (the reference ships the
-        same product through treeAggregate inside ARPACK's reverse
-        communication loop, EigenValueDecomposition.scala:87)."""
+    def _gram_matvec_fn(self):
+        """q ↦ XᵀXq as one jitted psum aggregate — dense blocks use two
+        MXU gemvs; sparse (ELL / ELL+COO) blocks use the gather/segment-sum
+        pair the sparse training aggregators are built from. The reference
+        ships the same product through treeAggregate inside ARPACK's
+        reverse-communication loop (EigenValueDecomposition.scala:87)."""
         import jax
         import jax.numpy as jnp
+        from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
 
         d = self.num_cols()
-        matvec_agg = self.dataset.tree_aggregate_fn(
+        if isinstance(self.dataset, SparseInstanceDataset):
+            from cycloneml_tpu.ml.optim import sparse_aggregators as sa
+            if self.dataset.is_hybrid:
+                def agg(indices, values, coo_row, coo_idx, coo_val, y, w, q):
+                    m = sa._margins_hybrid(indices, values, coo_row,
+                                           coo_idx, coo_val, q, 0.0)
+                    m = m * (w > 0).astype(values.dtype)
+                    return sa._scatter_grad_hybrid(
+                        indices, values, coo_row, coo_idx, coo_val, m, d)
+            else:
+                def agg(indices, values, y, w, q):
+                    m = sa._margins(indices, values, q, 0.0)
+                    m = m * (w > 0).astype(values.dtype)
+                    return sa._scatter_grad(indices, values, m, d)
+            return self.dataset.tree_aggregate_fn(agg), \
+                self.dataset.values.dtype
+        return self.dataset.tree_aggregate_fn(
             lambda x, y, w, q: jnp.dot(
                 x.T, jnp.dot(x, q, precision=jax.lax.Precision.HIGHEST)
                 * (w > 0).astype(x.dtype),
-                precision=jax.lax.Precision.HIGHEST))
+                precision=jax.lax.Precision.HIGHEST)), self.dataset.x.dtype
 
-        dt = self.dataset.x.dtype  # metadata read, no device->host transfer
+    def _lanczos(self, k: int, tol: float, max_iter: int):
+        """Lanczos with full reorthogonalization on the driver; the matvec
+        is the distributed psum from :meth:`_gram_matvec_fn`."""
+        d = self.num_cols()
+        matvec_agg, dt = self._gram_matvec_fn()
 
         def matvec(q: np.ndarray) -> np.ndarray:
             return np.asarray(matvec_agg(q.astype(dt)), dtype=np.float64)
 
         rng = np.random.RandomState(0)
-        m = min(max(3 * k, 20), d, max_iter)
+        m = min(d, max_iter)
+        min_steps = min(max(3 * k, 20), m)
+        # the Ritz-stability stop cannot resolve below the matvec dtype's
+        # noise floor: on the f32 device path converged values still jitter
+        # at ~eps relative, so flooring at 32·eps stops when further steps
+        # only chase quantization (f64 keeps the user's tol)
+        try:
+            ritz_tol = max(tol, 32.0 * float(np.finfo(np.dtype(dt)).eps))
+        except ValueError:  # non-float dt cannot happen for matvec, but
+            ritz_tol = max(tol, 1e-12)
         q = rng.randn(d)
         q /= np.linalg.norm(q)
         qs = [q]
         alphas, betas = [], []
+        prev_ritz = None
         for j in range(m):
             z = matvec(qs[j])
             a = float(qs[j] @ z)
@@ -197,6 +274,21 @@ class RowMatrix:
             b = float(np.linalg.norm(z))
             if b < tol:
                 break
+            # grow the subspace past the 3k floor until the wanted Ritz
+            # values stop moving — clustered tails need more than 3k steps
+            # (ARPACK's restart loop plays this role in the reference)
+            if j + 1 >= min_steps and (j + 1) % 5 == 0:
+                t = np.diag(alphas)
+                for i, bb in enumerate(betas):
+                    t[i, i + 1] = t[i + 1, i] = bb
+                ritz = np.sort(np.linalg.eigvalsh(t))[::-1][:k]
+                if prev_ritz is not None and len(prev_ritz) == len(ritz):
+                    denom = np.maximum(np.abs(ritz), 1e-300)
+                    if np.max(np.abs(ritz - prev_ritz) / denom) < ritz_tol:
+                        betas.append(b)
+                        qs.append(z / b)
+                        break
+                prev_ritz = ritz
             betas.append(b)
             qs.append(z / b)
         t = np.diag(alphas)
