@@ -344,6 +344,32 @@ SHUFFLE_SPILL_ROW_BUDGET = (
     .int_conf(1 << 20)
 )
 
+EXCHANGE_ADDRESSES = (
+    ConfigBuilder("cyclone.exchange.addresses")
+    .doc("Comma-separated host:port exchange endpoints, one per cooperating "
+         "process, identical on every process. When set (with "
+         "cyclone.exchange.rank), host-tier shuffles — "
+         "PartitionedDataset.group_by_key/reduce_by_key and SQL "
+         "Aggregate/Join — route cross-process through the HashExchange "
+         "fabric (≈ ShuffleExchangeExec + block transfer); empty = "
+         "single-process shuffles.")
+    .str_conf("")
+)
+
+EXCHANGE_RANK = (
+    ConfigBuilder("cyclone.exchange.rank")
+    .doc("This process's index into cyclone.exchange.addresses.")
+    .int_conf(-1)
+)
+
+EXCHANGE_NUM_BUCKETS = (
+    ConfigBuilder("cyclone.exchange.numBuckets")
+    .doc("Hash buckets per exchange round (≈ shuffle partitions; bucket b "
+         "is owned by process b % n_processes).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(64)
+)
+
 TASK_MAX_FAILURES = (
     ConfigBuilder("cyclone.task.maxFailures")
     .doc("Retries per step before aborting (ref: TaskSetManager.scala:58).")

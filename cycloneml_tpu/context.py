@@ -40,6 +40,15 @@ _active_lock = threading.Lock()
 _active_context: Optional["CycloneContext"] = None
 
 
+def active_context() -> Optional["CycloneContext"]:
+    """The live context, or None (used by layers — e.g. the SQL engine's
+    exchange routing — that cannot thread a ctx handle through)."""
+    with _active_lock:
+        if _active_context is not None and not _active_context._stopped:
+            return _active_context
+    return None
+
+
 class Broadcast:
     """Replicated pytree on every device (replaces TorrentBroadcast,
     ref: core/.../broadcast/TorrentBroadcast.scala:58 — replication is an

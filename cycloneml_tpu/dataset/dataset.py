@@ -125,8 +125,12 @@ class PartitionedDataset:
         def compute():
             return fn(parent._partitions())
 
-        return PartitionedDataset(self.ctx, compute,
-                                  num_partitions or self.num_partitions, name)
+        # `is None`, not falsy-or: a rank owning ZERO exchange buckets
+        # legitimately derives a 0-partition dataset
+        return PartitionedDataset(
+            self.ctx, compute,
+            self.num_partitions if num_partitions is None else num_partitions,
+            name)
 
     def map(self, f: Callable) -> "PartitionedDataset":
         return self._derive(lambda ps: [[f(x) for x in p] for p in ps], "map")
@@ -182,9 +186,29 @@ class PartitionedDataset:
         budget = int(self.ctx.conf.get(SHUFFLE_SPILL_ROW_BUDGET)) \
             if hasattr(self.ctx, "conf") else 1 << 20
 
+        from cycloneml_tpu.parallel.exchange import (
+            active_exchange_group, exchange_group_partitions)
+        group = active_exchange_group() if hasattr(self.ctx, "conf") else None
+        if group is not None:
+            # multihost: route the shuffle over the wire fabric — every
+            # cooperating process runs this same lineage SPMD-style and
+            # keeps the groups it owns (ShuffleExchangeExec analog). The
+            # exchange is a collective: materializing this dataset on one
+            # rank requires every rank to reach the same point.
+            rank, addresses, n_buckets = group
+
+            n_owned = sum(1 for b in range(n_buckets)
+                          if b % len(addresses) == rank)
+
+            def fn(ps):
+                return exchange_group_partitions(
+                    (kv for p in ps for kv in p), rank, addresses,
+                    n_buckets, row_budget=budget)
+            return self._derive(fn, "groupByKey(exchange)", n_owned)
+
         def fn(ps):
             from cycloneml_tpu.dataset.spill import (ExternalAppendOnlyMap,
-                                                     SpilledPartition,
+                                                     materialize_grouped,
                                                      stable_hash)
             # budget is PER BUCKET, matching the conf doc (≈ the reference's
             # per-collection numElementsForceSpillThreshold)
@@ -193,27 +217,10 @@ class PartitionedDataset:
             for p in ps:
                 for k, v in p:
                     buckets[stable_hash(k) % n].insert(k, v)
-            # output partitions spill too: a bucket whose group count
-            # exceeds the row budget streams to a disk-backed partition
-            # instead of materializing (r2 verdict item 5 — partitions were
-            # in-memory lists even when the grouping map spilled)
-            out = []
-            for b in buckets:
-                groups = b.items()
-                head = []
-                rows = 0
-                for kv in groups:
-                    head.append(kv)
-                    rows += len(kv[1])  # VALUE count: one hot key with
-                    if rows > budget:   # budget+ values must spill too
-                        w = SpilledPartition.writer()
-                        w.extend(head)
-                        w.extend(groups)
-                        out.append(w.finish())
-                        break
-                else:
-                    out.append(head)
-            return out
+            # output partitions spill too (r2 verdict item 5): the shared
+            # materializer turns each bucket's stream into a list or a
+            # disk-backed partition past the budget
+            return [materialize_grouped(b.items(), budget) for b in buckets]
         return self._derive(fn, "groupByKey", n)
 
     def reduce_by_key(self, f: Callable) -> "PartitionedDataset":

@@ -304,3 +304,23 @@ class _PartitionWriter:
             os.unlink(self._path)
         except OSError:
             pass
+
+
+def materialize_grouped(groups, row_budget: int):
+    """Materialize a ``(key, [values])`` stream as ONE output partition:
+    a plain list while the cumulative VALUE count stays within
+    ``row_budget``, switching to a disk-backed :class:`SpilledPartition`
+    the moment it exceeds it — the shuffle OUTPUT-spill contract shared by
+    the in-process ``group_by_key`` and the cross-process exchange (one
+    hot key with budget+ values must spill too)."""
+    head = []
+    rows = 0
+    for kv in groups:
+        head.append(kv)
+        rows += len(kv[1])
+        if rows > row_budget:
+            w = SpilledPartition.writer()
+            w.extend(head)
+            w.extend(groups)
+            return w.finish()
+    return head

@@ -70,6 +70,104 @@ class _BucketStore:
             self._writers = {}
 
 
+class _RoundState:
+    """Receive-side state of ONE exchange round on one process."""
+
+    def __init__(self, spill_dir=None):
+        self.store = _BucketStore(spill_dir)
+        self.done = threading.Semaphore(0)
+        self.failed: List[str] = []
+
+
+class _ExchangeServer:
+    """Process-lived receive service for one listen address, routing every
+    frame by its ROUND id into that round's state.
+
+    Back-to-back exchange rounds reuse the same port; without round
+    routing, a fast peer's round-N+1 connection could be accepted by this
+    process's still-draining round-N server and its records silently
+    discarded (review r4). Here an early round-N+1 frame simply CREATES
+    round N+1's state and waits there — the reference's block-transfer
+    service is likewise process-lived, with blocks addressed by shuffle id
+    rather than by whichever server instance happens to be listening."""
+
+    _instances: Dict[str, "_ExchangeServer"] = {}
+    _ilock = threading.Lock()
+
+    @classmethod
+    def get(cls, address: str) -> "_ExchangeServer":
+        with cls._ilock:
+            srv = cls._instances.get(address)
+            if srv is None:
+                srv = cls._instances[address] = cls(address)
+            return srv
+
+    def __init__(self, address: str):
+        self._lock = threading.Lock()
+        self._rounds: Dict[int, _RoundState] = {}
+        server = self
+        host, port = address.rsplit(":", 1)
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                current: Optional[_RoundState] = None
+                try:
+                    from cycloneml_tpu.dataset.spill import read_frame
+                    from cycloneml_tpu.native.host import CompressionCodec
+                    fh = self.request.makefile("rb")
+                    while True:
+                        blob = read_frame(fh)
+                        if blob is None:
+                            if current is not None:
+                                current.failed.append(
+                                    "connection dropped before DONE")
+                                current.done.release()
+                            return
+                        round_id, bucket, records = pickle.loads(
+                            CompressionCodec.decompress(blob))
+                        current = server.round_state(round_id)
+                        if bucket is None:  # DONE marker for this round
+                            current.done.release()
+                            current = None
+                        else:
+                            current.store.append(bucket, records)
+                except Exception as e:  # surfaced at that round's finish()
+                    if current is not None:
+                        current.failed.append(repr(e))
+                        current.done.release()  # unblock the barrier so
+                        # finish() raises the REAL error, not a timeout
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, int(port)), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True,
+                             name=f"exchange-server-{address}")
+        t.start()
+
+    def round_state(self, round_id: int, spill_dir=None) -> _RoundState:
+        with self._lock:
+            st = self._rounds.get(round_id)
+            if st is None:
+                st = self._rounds[round_id] = _RoundState(spill_dir)
+            return st
+
+    def drop_round(self, round_id: int) -> None:
+        with self._lock:
+            self._rounds.pop(round_id, None)
+
+
+_round_lock = threading.Lock()
+_round_box = [0]
+
+
+def _next_round_id() -> int:
+    with _round_lock:
+        _round_box[0] += 1
+        return _round_box[0]
+
+
 class HashExchange:
     """One exchange round among ``n_workers`` cooperating processes.
 
@@ -79,62 +177,29 @@ class HashExchange:
         ex.put_all(pairs)        # route (key, value) records everywhere
         buckets = ex.finish()    # barrier; {bucket_id: SpilledPartition}
 
-    ``addresses[rank]`` must be this worker's own ``host:port``. The
-    ``finish`` barrier completes when every peer's DONE frame has arrived.
+    ``addresses[rank]`` must be this worker's own ``host:port``; the
+    listening server is process-lived and shared across rounds (frames
+    carry a round id). The ``finish`` barrier completes when every peer's
+    DONE frame for THIS round has arrived. ``round_id`` defaults to a
+    per-process counter — correct under the SPMD discipline that every
+    cooperating process constructs its exchanges in the same order; pass
+    it explicitly otherwise.
     """
 
     def __init__(self, rank: int, addresses: List[str], n_buckets: int,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 round_id: Optional[int] = None):
         self.rank = rank
         self.addresses = list(addresses)
         self.n_workers = len(addresses)
         self.n_buckets = n_buckets
-        self._store = _BucketStore(spill_dir)
-        self._done = threading.Semaphore(0)
-        self._failed: List[str] = []
+        self.round_id = _next_round_id() if round_id is None else round_id
+        self._server = _ExchangeServer.get(self.addresses[rank])
+        self._state = self._server.round_state(self.round_id, spill_dir)
         self._send_bufs: Dict[int, List[Tuple[int, Any]]] = {}
         self._socks: Dict[int, socket.socket] = {}
         from cycloneml_tpu.native.host import CompressionCodec
         self._codec = CompressionCodec("zstd")
-        self._server = self._serve()
-
-    # -- receive side -------------------------------------------------------
-    def _serve(self):
-        store, done, failed = self._store, self._done, self._failed
-        host, port = self.addresses[self.rank].rsplit(":", 1)
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    from cycloneml_tpu.dataset.spill import read_frame
-                    fh = self.request.makefile("rb")
-                    while True:
-                        blob = read_frame(fh)
-                        if blob is None:
-                            failed.append("connection dropped before DONE")
-                            done.release()
-                            return
-                        if not blob:  # zero-length frame: sender finished
-                            done.release()
-                            return
-                        from cycloneml_tpu.native.host import CompressionCodec
-                        bucket, records = pickle.loads(
-                            CompressionCodec.decompress(blob))
-                        store.append(bucket, records)
-                except Exception as e:  # surfaced at finish()
-                    failed.append(repr(e))
-                    done.release()  # unblock the barrier so finish() can
-                    #                raise the REAL error, not a timeout
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        srv = Server((host, int(port)), Handler)
-        t = threading.Thread(target=srv.serve_forever, daemon=True,
-                             name=f"exchange-server-{self.rank}")
-        t.start()
-        return srv
 
     # -- send side ----------------------------------------------------------
     def _owner(self, bucket: int) -> int:
@@ -161,10 +226,11 @@ class HashExchange:
             self._socks[peer] = s
         return s
 
-    def _send_frame(self, peer: int, bucket: int,
-                    records: List[Any]) -> None:
+    def _send_frame(self, peer: int, bucket: Optional[int],
+                    records: Optional[List[Any]]) -> None:
+        # bucket None = this round's DONE marker
         blob = self._codec.compress(
-            pickle.dumps((bucket, records),
+            pickle.dumps((self.round_id, bucket, records),
                          protocol=pickle.HIGHEST_PROTOCOL))
         self._sock(peer).sendall(struct.pack("<I", len(blob)) + blob)
 
@@ -172,7 +238,7 @@ class HashExchange:
         bucket = stable_hash(key) % self.n_buckets
         peer = self._owner(bucket)
         if peer == self.rank:  # loopback skips the wire
-            self._store.append(bucket, [(key, value)])
+            self._state.store.append(bucket, [(key, value)])
             return
         buf = self._send_bufs.setdefault(peer, [])
         buf.append((bucket, (key, value)))
@@ -196,30 +262,33 @@ class HashExchange:
 
     # -- completion ---------------------------------------------------------
     def finish(self, timeout: float = 300.0) -> Dict[int, SpilledPartition]:
-        """Flush, signal DONE to every peer, await every peer's DONE, and
-        return this worker's buckets as disk-backed partitions. Sockets,
-        the listening server, and (on failure) partially written bucket
-        files are released on every exit path — a crashed peer must not
-        leak ports, threads, or /tmp in a long-lived worker."""
+        """Flush, signal this round's DONE to every peer, await every
+        peer's DONE, and return this worker's buckets as disk-backed
+        partitions. Sender sockets, the round's server-side state, and (on
+        failure) partially written bucket files are released on every exit
+        path — a crashed peer must not leak threads or /tmp in a
+        long-lived worker. (The listening SERVER outlives the round by
+        design: later rounds on the same address reuse it.)"""
         ok = False
+        state = self._state
         try:
             for peer in range(self.n_workers):
                 if peer == self.rank:
                     continue
                 self._flush_peer(peer)
-                self._sock(peer).sendall(struct.pack("<I", 0))
+                self._send_frame(peer, None, None)
             # expect one DONE per remote peer
             for _ in range(self.n_workers - 1):
-                if not self._done.acquire(timeout=timeout):
-                    if self._failed:
+                if not state.done.acquire(timeout=timeout):
+                    if state.failed:
                         raise IOError(
-                            f"exchange receive failed: {self._failed[:3]}")
+                            f"exchange receive failed: {state.failed[:3]}")
                     raise TimeoutError(
                         f"exchange barrier timed out on rank {self.rank}")
-            if self._failed:
-                raise IOError(f"exchange receive failed: {self._failed[:3]}")
+            if state.failed:
+                raise IOError(f"exchange receive failed: {state.failed[:3]}")
             ok = True
-            return self._store.finish()
+            return state.store.finish()
         finally:
             for s in self._socks.values():
                 try:
@@ -227,10 +296,34 @@ class HashExchange:
                 except OSError:
                     pass
             self._socks = {}
-            self._server.shutdown()
-            self._server.server_close()
+            self._server.drop_round(self.round_id)
             if not ok:
-                self._store.abort()
+                state.store.abort()
+
+
+def active_exchange_group() -> Optional[Tuple[int, List[str], int]]:
+    """(rank, addresses, n_buckets) when the active context configures a
+    cross-process exchange group (``cyclone.exchange.addresses`` +
+    ``cyclone.exchange.rank``), else None. This is the switch that routes
+    host-tier shuffles — ``PartitionedDataset.group_by_key`` and SQL
+    Aggregate/Join — through the wire fabric instead of the in-process
+    hash partitioner."""
+    from cycloneml_tpu.conf import (EXCHANGE_ADDRESSES, EXCHANGE_NUM_BUCKETS,
+                                    EXCHANGE_RANK)
+    from cycloneml_tpu.context import active_context
+    ctx = active_context()
+    if ctx is None or not hasattr(ctx, "conf"):
+        return None
+    addrs_s = ctx.conf.get(EXCHANGE_ADDRESSES)
+    if not addrs_s:
+        return None
+    addresses = [a.strip() for a in addrs_s.split(",") if a.strip()]
+    rank = ctx.conf.get(EXCHANGE_RANK)
+    if not 0 <= rank < len(addresses):
+        raise ValueError(
+            f"cyclone.exchange.rank={rank} out of range for "
+            f"{len(addresses)} exchange addresses")
+    return rank, addresses, ctx.conf.get(EXCHANGE_NUM_BUCKETS)
 
 
 def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
@@ -255,15 +348,49 @@ def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
     return stream()
 
 
+def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
+                              addresses: List[str], n_buckets: int,
+                              row_budget: int = 1 << 20) -> List[Any]:
+    """Distributed groupByKey materialized as OUTPUT PARTITIONS (one per
+    owned bucket) for the RDD surface: small buckets become lists, buckets
+    whose value count exceeds ``row_budget`` become disk-backed
+    :class:`SpilledPartition` sequences — the same output-spill contract as
+    the in-process ``group_by_key``."""
+    ex = HashExchange(rank, addresses, n_buckets)
+    ex.put_all(pairs)
+    buckets = ex.finish()
+    from cycloneml_tpu.dataset.spill import materialize_grouped
+    out: List[Any] = []
+    owned = [b for b in range(n_buckets) if b % len(addresses) == rank]
+    for b in owned:
+        if b not in buckets:
+            out.append([])  # owned but empty: keep partition indexing stable
+            continue
+        agg = ExternalAppendOnlyMap(row_budget=row_budget)
+        part = buckets[b]
+        agg.insert_all(iter(part))
+        part.delete()
+        out.append(materialize_grouped(agg.items(), row_budget))
+    return out
+
+
 def exchange_join(left: Iterable[Tuple[Any, Any]],
                   right: Iterable[Tuple[Any, Any]], rank: int,
                   addresses: List[str], n_buckets: int,
-                  row_budget: int = 1 << 20,
+                  row_budget: int = 1 << 20, how: str = "inner",
                   ) -> Iterator[Tuple[Any, Tuple[Any, Any]]]:
-    """Distributed inner hash join: both sides exchange on the same bucket
-    map (records tagged by side), then each owned key yields the cross
+    """Distributed hash join: both sides exchange on the same bucket map
+    (records tagged by side), then each owned key yields the cross
     product — the reference's shuffled hash join
-    (ShuffledHashJoinExec.scala:39). Yields ``(key, (lv, rv))``."""
+    (ShuffledHashJoinExec.scala:39). Yields ``(key, (lv, rv))``.
+
+    ``how`` ∈ inner/left/right/outer: unmatched left rows yield
+    ``(k, (lv, None))`` and unmatched right rows ``(k, (None, rv))``, the
+    RDD ``leftOuterJoin``/``rightOuterJoin``/``fullOuterJoin`` convention —
+    all rows of a key are co-located after the exchange, so the owner can
+    decide matched-ness locally."""
+    if how not in ("inner", "left", "right", "outer"):
+        raise ValueError(f"unknown join type {how!r}")
     ex = HashExchange(rank, addresses, n_buckets)
     ex.put_all((k, (0, v)) for k, v in left)
     ex.put_all((k, (1, v)) for k, v in right)
@@ -277,11 +404,16 @@ def exchange_join(left: Iterable[Tuple[Any, Any]],
             part.delete()
             for k, tagged_vals in agg.items():
                 lvs = [v for t, v in tagged_vals if t == 0]
-                if not lvs:
-                    continue
-                for t, rv in tagged_vals:
-                    if t == 1:
+                rvs = [v for t, v in tagged_vals if t == 1]
+                if lvs and rvs:
+                    for rv in rvs:
                         for lv in lvs:
                             yield k, (lv, rv)
+                elif lvs and how in ("left", "outer"):
+                    for lv in lvs:
+                        yield k, (lv, None)
+                elif rvs and how in ("right", "outer"):
+                    for rv in rvs:
+                        yield k, (None, rv)
 
     return stream()

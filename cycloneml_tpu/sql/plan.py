@@ -369,9 +369,76 @@ def _factorize(cols: Sequence[np.ndarray]) -> Tuple[np.ndarray, int, np.ndarray]
     return inv, len(uniq), first_idx
 
 
+def _key_tuples(cols: List[np.ndarray], n: int) -> List[tuple]:
+    """Evaluated key columns → per-row key tuples, broadcasting scalar
+    results (e.g. a folded constant group expr) to the row count so keys
+    and rows stay aligned."""
+    bcast = []
+    for c in cols:
+        v = np.atleast_1d(np.asarray(c))
+        if v.shape[0] != n:
+            v = np.broadcast_to(v, (n,))
+        bcast.append(v.tolist())
+    return list(zip(*bcast))
+
+
+def _rows_of(batch: Batch, names: List[str], n: int) -> List[tuple]:
+    """Columnar → row tuples of Python scalars (the wire format the host
+    exchange carries; ≈ the reference's UnsafeRow serialization into
+    shuffle blocks)."""
+    if not names:
+        return [()] * n
+    return _key_tuples([batch[k] for k in names], n)
+
+
+def _batch_of(rows: List[tuple], names: List[str],
+              templates: Batch) -> Batch:
+    """Row tuples → columnar, restoring each column's local dtype."""
+    cols = list(zip(*rows)) if rows else [[] for _ in names]
+    out: Batch = {}
+    for i, k in enumerate(names):
+        t = np.atleast_1d(np.asarray(templates[k]))
+        if t.dtype == object or t.dtype.kind in "US":
+            out[k] = np.array(list(cols[i]), dtype=object)
+        else:
+            out[k] = np.asarray(list(cols[i]), dtype=t.dtype)
+    return out
+
+
+def _exchange_keyed_rows(sides: List[Tuple[List[tuple], List[tuple]]],
+                         group: Tuple[int, List[str], int]
+                         ) -> List[List[tuple]]:
+    """One exchange round over tagged row streams: ``sides[i]`` is
+    ``(keys, rows)`` for input i; returns, per input, the rows whose key
+    this process owns. The ShuffleExchangeExec analog for the columnar
+    engine — both join sides ride the SAME round so matching keys
+    co-locate."""
+    from cycloneml_tpu.parallel.exchange import HashExchange
+    rank, addresses, n_buckets = group
+    ex = HashExchange(rank, addresses, n_buckets)
+    for tag, (keys, rows) in enumerate(sides):
+        ex.put_all((k, (tag, r)) for k, r in zip(keys, rows))
+    buckets = ex.finish()
+    out: List[List[tuple]] = [[] for _ in sides]
+    for b in sorted(buckets):
+        part = buckets[b]
+        for _k, (tag, row) in part:
+            out[tag].append(row)
+        part.delete()
+    return out
+
+
 class Aggregate(LogicalPlan):
     """Group-by aggregation. ``agg_exprs`` may be arbitrary expressions over
-    AggExpr results (e.g. sum(x)/count(x) + 1)."""
+    AggExpr results (e.g. sum(x)/count(x) + 1).
+
+    Multihost: when the active context configures an exchange group
+    (``cyclone.exchange.addresses``), the child's rows are first hash-
+    exchanged on the evaluated group key so each process aggregates ONLY
+    the groups it owns — scan → exchange → per-bucket columnar aggregate,
+    the reference's partial/final HashAggregateExec split around
+    ShuffleExchangeExec (ShuffleExchangeExec.scala:115). The union of all
+    processes' results is the single-process result."""
 
     def __init__(self, child: LogicalPlan, group_exprs: List[Expr],
                  agg_exprs: List[Expr]):
@@ -389,6 +456,29 @@ class Aggregate(LogicalPlan):
     def execute(self):
         batch = self.children[0].execute()
         n = _batch_n(batch)
+
+        from cycloneml_tpu.parallel.exchange import active_exchange_group
+        group = active_exchange_group()
+        if group is not None:
+            from cycloneml_tpu.dataset.spill import stable_hash
+            rank, addresses, n_buckets = group
+            names = [k for k in batch if k != "__len__"]
+            if self.group_exprs:
+                keys = _key_tuples([e.eval(batch)
+                                    for e in self.group_exprs], n)
+            else:
+                # global aggregate: one key — its bucket's owner emits the
+                # single result row, every other process emits zero rows
+                keys = [()] * n
+                owner = (stable_hash(()) % n_buckets) % len(addresses)
+            rows = _rows_of(batch, names, n)
+            (owned,) = _exchange_keyed_rows([(keys, rows)], group)
+            if not self.group_exprs and rank != owner:
+                return {e.name_hint(): np.array([])
+                        for e in (*self.group_exprs, *self.agg_exprs)}
+            batch = _batch_of(owned, names, batch)
+            n = len(owned)
+
         if self.group_exprs:
             keys = [np.atleast_1d(e.eval(batch)) for e in self.group_exprs]
             codes, n_groups, first_idx = _factorize(keys)
@@ -466,6 +556,32 @@ class Join(LogicalPlan):
         lb = self.children[0].execute()
         rb = self.children[1].execute()
         nl, nr = _batch_n(lb), _batch_n(rb)
+
+        from cycloneml_tpu.parallel.exchange import active_exchange_group
+        group = active_exchange_group()
+        if group is not None and self.how != "cross":
+            # multihost shuffled hash join: both sides ride ONE exchange
+            # round keyed on the join key, so every row of a key lands on
+            # its owner — the local factorize/probe below then computes any
+            # join type (incl. outer null-extension and semi/anti) exactly,
+            # per owned keyspace (ref ShuffledHashJoinExec.scala:39).
+            lnames = [k for k in lb if k != "__len__"]
+            rnames = [k for k in rb if k != "__len__"]
+            lkeys = _key_tuples([lb[l] for l, _ in self.on], nl)
+            rkeys = _key_tuples([rb[r] for _, r in self.on], nr)
+            lrows = _rows_of(lb, lnames, nl)
+            rrows = _rows_of(rb, rnames, nr)
+            lowned, rowned = _exchange_keyed_rows(
+                [(lkeys, lrows), (rkeys, rrows)], group)
+            lb = _batch_of(lowned, lnames, lb)
+            rb = _batch_of(rowned, rnames, rb)
+            nl, nr = len(lowned), len(rowned)
+        elif group is not None:
+            raise NotImplementedError(
+                "cross join is not routed through the hash exchange (no "
+                "key); the reference broadcasts one side — collect the "
+                "smaller side and cross-join locally")
+
         if self.how == "cross":
             li = np.repeat(np.arange(nl), nr)
             ri = np.tile(np.arange(nr), nl)

@@ -1,0 +1,238 @@
+"""Distributed SQL execution over the cross-process exchange (round-3
+verdict item 3): when ``cyclone.exchange.addresses`` is configured, SQL
+Aggregate/Join and PartitionedDataset.group_by_key route their shuffles
+through the HashExchange wire fabric — scan → exchange → per-bucket
+columnar op, the ShuffleExchangeExec analog. Two REAL processes run the
+same query SPMD-style on local slices; the union of their results must
+equal the single-process answer, with bounded RSS past the row budget."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SQL_WORKER = textwrap.dedent("""
+    import json, os, resource, sys
+    import numpy as np
+    rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                  sys.argv[3], sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax; jax.config.update("jax_platforms", "cpu")
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.sql.session import CycloneSession
+    base_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+    conf = (CycloneConf()
+            .set("cyclone.master", "local-mesh[1]")
+            .set("cyclone.exchange.addresses", addr0 + "," + addr1)
+            .set("cyclone.exchange.rank", str(rank))
+            .set("cyclone.exchange.numBuckets", "16")
+            .set("cyclone.shuffle.spill.rowBudget", "5000"))
+    ctx = CycloneContext.get_or_create(conf)
+    session = CycloneSession(ctx)
+
+    # each process holds HALF the fact table: 200k rows, 1000 keys — far
+    # over the 5k row budget; keys interleave across processes so every
+    # group spans both
+    N, K = 200_000, 1000
+    ids = (np.arange(N) * 2 + rank) % K
+    vals = np.arange(N, dtype=np.float64) + rank
+    fact = session.create_data_frame({"k": ids, "v": vals})
+    session.register_temp_view("fact", fact)
+
+    # dims: each process holds a slice; some keys have no fact rows and
+    # some fact keys no dim row -> outer join must null-extend both ways
+    dk = np.arange(rank, K + 100, 2)
+    dim = session.create_data_frame(
+        {"k": dk, "name": np.array([f"n{int(x)}" for x in dk], object)})
+    session.register_temp_view("dim", dim)
+
+    agg = session.sql(
+        "SELECT k, COUNT(*) AS c, SUM(v) AS s FROM fact GROUP BY k"
+    ).to_dict()
+    j = session.sql(
+        "SELECT d.k AS k, d.name AS name, f.c AS c FROM dim d FULL OUTER "
+        "JOIN (SELECT k, COUNT(*) AS c FROM fact GROUP BY k) f ON d.k = f.k"
+    ).to_dict()
+    tot = session.sql("SELECT COUNT(*) AS n, SUM(v) AS s FROM fact").to_dict()
+
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    out = {
+        "agg": {int(k): [int(c), float(s)] for k, c, s in
+                zip(agg["k"], agg["c"], agg["s"])},
+        "join": sorted(
+            [None if (isinstance(k, float) and np.isnan(k)) else int(k),
+             None if n is None else str(n),
+             None if (isinstance(c, float) and np.isnan(c)) else int(c)]
+            for k, n, c in zip(j["k"], j["name"], j["c"])),
+        "total": [[int(n), float(s)] for n, s in zip(
+            np.atleast_1d(tot["n"]), np.atleast_1d(tot["s"]))],
+        "delta_mb": int(peak_mb - base_mb),
+    }
+    with open(os.path.join(outdir, f"sql_{rank}.json"), "w") as fh:
+        json.dump(out, fh)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_two(script, tmp_path, timeout=280):
+    wp = tmp_path / "worker.py"
+    wp.write_text(script)
+    addrs = [f"localhost:{_free_port()}", f"localhost:{_free_port()}"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, str(wp), str(r), addrs[0], addrs[1], str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+
+def _single_process_oracle():
+    """The same query single-process (no exchange conf)."""
+    ids = np.concatenate([(np.arange(200_000) * 2 + r) % 1000
+                          for r in range(2)])
+    vals = np.concatenate([np.arange(200_000, dtype=np.float64) + r
+                           for r in range(2)])
+    agg = {}
+    for k in range(1000):
+        m = ids == k
+        agg[k] = [int(m.sum()), float(vals[m].sum())]
+    dk = np.sort(np.concatenate([np.arange(r, 1100, 2) for r in range(2)]))
+    join = []
+    for k in dk:
+        k = int(k)
+        if k in agg:
+            join.append([k, f"n{k}", agg[k][0]])
+        else:
+            join.append([k, f"n{k}", None])
+    # fact keys with no dim row: dim covers 0..1099 → none missing
+    return agg, sorted(join), [len(ids), float(vals.sum())]
+
+
+def test_two_process_sql_groupby_outer_join(tmp_path):
+    _run_two(SQL_WORKER, tmp_path)
+    res = [json.load(open(tmp_path / f"sql_{r}.json")) for r in range(2)]
+
+    exp_agg, exp_join, exp_total = _single_process_oracle()
+
+    # aggregation: disjoint ownership, union == oracle
+    got_agg = {}
+    for r in res:
+        for k, v in r["agg"].items():
+            assert int(k) not in got_agg, "key owned by both processes"
+            got_agg[int(k)] = v
+    assert got_agg == exp_agg
+
+    # full outer join: union == oracle (incl. null-extended rows)
+    got_join = sorted(sum((r["join"] for r in res), []))
+    assert got_join == [list(x) for x in exp_join]
+
+    # global aggregate: exactly one process emitted the single result row
+    totals = sum((r["total"] for r in res), [])
+    assert totals == [exp_total]
+
+    # bounded RSS: each side processed ~200k fact rows with a 5k budget;
+    # growth over the import baseline stays well under the full data
+    for r in res:
+        assert r["delta_mb"] < 200, r["delta_mb"]
+
+
+def test_exchange_join_outer_modes(tmp_path):
+    """exchange_join left/right/outer yield None-extended pairs (verdict:
+    the distributed join surface beyond inner)."""
+    script = textwrap.dedent("""
+        import json, os, sys
+        rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                      sys.argv[3], sys.argv[4])
+        from cycloneml_tpu.parallel.exchange import exchange_join
+        out = {}
+        for how in ["left", "right", "outer"]:
+            left = [(k, f"L{k}.{rank}") for k in range(rank, 10, 2)]
+            right = [(k, f"R{k}.{rank}") for k in range(rank, 16, 2)
+                     if k % 3 == 0]
+            # SAME addresses for all three back-to-back rounds: the
+            # process-lived server must route frames by round id even when
+            # one rank races ahead into the next round (review r4)
+            rows = sorted(exchange_join(left, right, rank, [addr0, addr1],
+                                        n_buckets=8, how=how))
+            out[how] = rows
+        with open(os.path.join(outdir, f"oj_{rank}.json"), "w") as fh:
+            json.dump(out, fh)
+    """)
+    _run_two(script, tmp_path)
+    res = [json.load(open(tmp_path / f"oj_{r}.json")) for r in range(2)]
+
+    left = {k: f"L{k}.{k % 2}" for k in range(10)}
+    right = {k: f"R{k}.{k % 2}" for k in range(16) if k % 3 == 0}
+    for how in ("left", "right", "outer"):
+        got = sorted((k, tuple(p)) for r in res for k, p in r[how])
+        exp = []
+        keys = set(left) | set(right)
+        for k in sorted(keys):
+            lv, rv = left.get(k), right.get(k)
+            if lv and rv:
+                exp.append((k, (lv, rv)))
+            elif lv and how in ("left", "outer"):
+                exp.append((k, (lv, None)))
+            elif rv and how in ("right", "outer"):
+                exp.append((k, (None, rv)))
+        assert got == sorted(exp), how
+
+
+def test_rdd_group_by_key_routes_through_exchange(tmp_path):
+    """PartitionedDataset.group_by_key auto-routes cross-process when the
+    exchange conf is set; owned groups union to the full answer."""
+    script = textwrap.dedent("""
+        import json, os, sys
+        rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                      sys.argv[3], sys.argv[4])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from cycloneml_tpu.conf import CycloneConf
+        from cycloneml_tpu.context import CycloneContext
+        from cycloneml_tpu.dataset.dataset import PartitionedDataset
+        conf = (CycloneConf().set("cyclone.master", "local-mesh[1]")
+                .set("cyclone.exchange.addresses", addr0 + "," + addr1)
+                .set("cyclone.exchange.rank", str(rank))
+                .set("cyclone.exchange.numBuckets", "8"))
+        ctx = CycloneContext.get_or_create(conf)
+        data = [((i * 2 + rank) % 50, i) for i in range(2000)]
+        pd = PartitionedDataset.from_sequence(ctx, data, 2)
+        got = {str(k): sorted(vs) for k, vs in pd.group_by_key().collect()}
+        red = dict(pd.reduce_by_key(lambda a, b: a + b).collect())
+        with open(os.path.join(outdir, f"rdd_{rank}.json"), "w") as fh:
+            json.dump({"groups": got,
+                       "reduced": {str(k): v for k, v in red.items()}}, fh)
+    """)
+    _run_two(script, tmp_path)
+    res = [json.load(open(tmp_path / f"rdd_{r}.json")) for r in range(2)]
+    all_pairs = [((i * 2 + r) % 50, i) for r in range(2) for i in range(2000)]
+    exp = {}
+    for k, v in all_pairs:
+        exp.setdefault(k, []).append(v)
+    exp = {k: sorted(vs) for k, vs in exp.items()}
+    got = {}
+    for r in res:
+        for k, vs in r["groups"].items():
+            assert int(k) not in got
+            got[int(k)] = vs
+    assert got == exp
+    got_red = {int(k): v for r in res for k, v in r["reduced"].items()}
+    assert got_red == {k: sum(vs) for k, vs in exp.items()}
