@@ -14,12 +14,15 @@ accumulating as special cases (the round-2 verdict's analyzer critique).
 from __future__ import annotations
 
 import difflib
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
-from cycloneml_tpu.sql.column import (AggExpr, Alias, ColumnRef, Expr,
-                                      UdfExpr, WindowExpr)
+import numpy as np
+
+from cycloneml_tpu.sql.column import (AggExpr, Alias, BinaryOp, Cast,
+                                      ColumnRef, Expr, Literal, UdfExpr,
+                                      UnaryOp, WindowExpr)
 from cycloneml_tpu.sql.plan import (Aggregate, Filter, Join, LogicalPlan,
-                                    Project, Relation, Sort,
+                                    Project, Relation, Scan, Sort,
                                     _SubqueryMixin)
 
 
@@ -109,22 +112,257 @@ def check_aggregation(plan: LogicalPlan) -> None:
                 f"neither aggregated nor in GROUP BY {sorted(grouped)}")
 
 
-#: batches run in order; each rule visits every node (RuleExecutor shape —
-#: today's rules are checks (fixed point in one pass); rewriting rules
-#: (coercion, alias resolution) append here rather than growing plan
-#: construction special cases
+# -- type inference -----------------------------------------------------------
+# kinds: 'int' 'float' 'bool' 'str' 'datetime' 'null' 'unknown'. Inference
+# is BEST-EFFORT from Scan dtypes upward (this engine is otherwise
+# schemaless); 'unknown' disables coercion for that expression rather than
+# risking a wrong rewrite — eval keeps its numpy fallbacks for those.
+
+_KIND = {"i": "int", "u": "int", "f": "float", "b": "bool",
+         "U": "str", "S": "str", "M": "datetime"}
+
+
+def _kind_of_array(v: np.ndarray) -> str:
+    if v.dtype == object:
+        for x in v[:64]:  # first non-null element decides
+            if x is None:
+                continue
+            if isinstance(x, str):
+                return "str"
+            if isinstance(x, (bool, np.bool_)):
+                return "bool"
+            if isinstance(x, (int, np.integer)):
+                return "int"
+            if isinstance(x, (float, np.floating)):
+                return "float"
+            return "unknown"
+        return "null"
+    return _KIND.get(v.dtype.kind, "unknown")
+
+
+#: per-analyze() schema memo (id(plan) → schema): _visit calls coerce_types
+#: at every node, and each call walks to the Scans — memoization keeps one
+#: analysis pass linear instead of O(depth²). Driver-side single-threaded,
+#: like the rest of plan analysis.
+_SCHEMA_MEMO: Optional[Dict[int, Dict[str, str]]] = None
+
+
+def infer_schema(plan: LogicalPlan) -> Dict[str, str]:
+    """Column → kind map for a plan's output (ref: every LogicalPlan's
+    ``schema`` in Catalyst; here derived bottom-up from Scan arrays)."""
+    memo = _SCHEMA_MEMO
+    if memo is not None and id(plan) in memo:
+        return memo[id(plan)]
+    out = _infer_schema(plan)
+    if memo is not None:
+        memo[id(plan)] = out
+    return out
+
+
+def _infer_schema(plan: LogicalPlan) -> Dict[str, str]:
+    if isinstance(plan, Relation):
+        return infer_schema(plan._resolve())
+    if isinstance(plan, Scan):
+        return {k: _kind_of_array(np.atleast_1d(np.asarray(v)))
+                for k, v in plan.data.items()
+                if plan.columns is None or k in plan.columns}
+    if isinstance(plan, Project):
+        schema = infer_schema(plan.children[0])
+        return {e.name_hint(): expr_type(e, schema) for e in plan.exprs}
+    if isinstance(plan, Aggregate):
+        schema = infer_schema(plan.children[0])
+        out = {e.name_hint(): expr_type(e, schema) for e in plan.group_exprs}
+        out.update({e.name_hint(): expr_type(e, schema)
+                    for e in plan.agg_exprs})
+        return out
+    if isinstance(plan, Join):
+        out = dict(infer_schema(plan.children[0]))
+        right = infer_schema(plan.children[1])
+        for c in plan.output():
+            if c not in out and c in right:
+                out[c] = right[c]
+        return out
+    if len(plan.children) == 1:
+        # Filter/Sort/Limit/Distinct and friends preserve the child schema
+        child = infer_schema(plan.children[0])
+        return {c: child.get(c, "unknown") for c in plan.output()}
+    return {c: "unknown" for c in plan.output()}
+
+
+_CAST_KIND = {"double": "float", "bigint": "int", "boolean": "bool",
+              "string": "str"}
+_NUMERIC = ("int", "float")
+_CMP_OPS = ("=", "!=", "<", "<=", ">", ">=")
+_ARITH_OPS = ("+", "-", "*", "%")
+
+
+def expr_type(e: Expr, schema: Dict[str, str]) -> str:
+    """Best-effort static type of an expression under ``schema``."""
+    if isinstance(e, ColumnRef):
+        return schema.get(e.name, "unknown")
+    if isinstance(e, Literal):
+        v = e.value
+        if v is None:
+            return "null"
+        if isinstance(v, (bool, np.bool_)):
+            return "bool"
+        if isinstance(v, (int, np.integer)):
+            return "int"
+        if isinstance(v, (float, np.floating)):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        return "unknown"
+    if isinstance(e, Alias):
+        return expr_type(e.children[0], schema)
+    if isinstance(e, Cast):
+        return _CAST_KIND.get(e.to, "unknown")
+    if isinstance(e, UnaryOp):
+        return "bool" if e.op == "not" else expr_type(e.children[0], schema)
+    if isinstance(e, BinaryOp):
+        if e.op in _CMP_OPS or e.op in ("and", "or"):
+            return "bool"
+        if e.op == "/":
+            return "float"
+        lt = expr_type(e.children[0], schema)
+        rt = expr_type(e.children[1], schema)
+        if "unknown" in (lt, rt):
+            return "unknown"
+        return "float" if "float" in (lt, rt) else lt
+    if isinstance(e, AggExpr):
+        fn = getattr(e, "fn", "")
+        if fn in ("count",):
+            return "int"
+        if fn in ("sum", "avg", "mean", "stddev", "variance"):
+            return "float"
+        if e.children:
+            return expr_type(e.children[0], schema)
+        return "unknown"
+    return "unknown"
+
+
+def _coerce_expr(e: Expr, schema: Dict[str, str]) -> Expr:
+    """Insert explicit Casts / raise for mismatched BinaryOp operand types
+    (ref: catalyst/analysis/TypeCoercion.scala — Division, PromoteStrings,
+    ImplicitTypeCasts; CheckAnalysis data-type-mismatch errors). Unknown
+    types leave the expression untouched."""
+    if _has_opaque(e):
+        return e
+    kids = [_coerce_expr(c, schema) for c in e.children]
+    if kids != e.children:
+        e = e.with_children(kids)
+    if not isinstance(e, BinaryOp):
+        return e
+    l, r = e.children
+    lt, rt = expr_type(l, schema), expr_type(r, schema)
+    op = e.op
+
+    def cast(side: Expr, to: str) -> Expr:
+        return Cast(side, to)
+
+    if op == "/":
+        # Division: both operands ride the double lane (TypeCoercion's
+        # Division rule) so eval's / needs no float special case
+        if lt in ("int", "str", "bool"):
+            l = cast(l, "double")
+        if rt in ("int", "str", "bool"):
+            r = cast(r, "double")
+        if (l, r) != tuple(e.children):
+            return BinaryOp(op, l, r)
+        return e
+    if op in _ARITH_OPS:
+        if ("bool" in (lt, rt)
+                and (lt in _NUMERIC or rt in _NUMERIC)):
+            raise AnalysisException(
+                f"cannot resolve '({l} {op} {r})' due to data type "
+                f"mismatch: '{lt}' and '{rt}' (boolean arithmetic — the "
+                f"reference rejects this too)")
+        if lt == "str" and (rt in _NUMERIC or rt == "str"):
+            l = cast(l, "double")
+        if rt == "str" and (lt in _NUMERIC or lt == "str"):
+            r = cast(r, "double")
+        if (l, r) != tuple(e.children):
+            return BinaryOp(op, l, r)
+        return e
+    if op in _CMP_OPS:
+        if ("bool" in (lt, rt) and "str" in (lt, rt)) or (
+                "bool" in (lt, rt) and (lt in _NUMERIC or rt in _NUMERIC)
+                and op not in ("=", "!=")):
+            raise AnalysisException(
+                f"cannot resolve '({l} {op} {r})' due to data type "
+                f"mismatch: '{lt}' vs '{rt}'")
+        if lt == "str" and rt in _NUMERIC:
+            l = cast(l, "double")  # PromoteStrings: the STRING side casts
+        elif rt == "str" and lt in _NUMERIC:
+            r = cast(r, "double")
+        elif lt == "bool" and rt in _NUMERIC:
+            l = cast(l, "double")  # BooleanEquality (= / != only, above)
+        elif rt == "bool" and lt in _NUMERIC:
+            r = cast(r, "double")
+        if (l, r) != tuple(e.children):
+            return BinaryOp(op, l, r)
+        return e
+    if op in ("and", "or"):
+        for side, t in ((l, lt), (r, rt)):
+            if t not in ("bool", "unknown", "null"):
+                raise AnalysisException(
+                    f"cannot resolve '({l} {op} {r})': argument of "
+                    f"{op.upper()} must be boolean, got '{t}'")
+    return e
+
+
+def _coerce_named(e: Expr, schema: Dict[str, str]) -> Expr:
+    """Coerce an OUTPUT expression while preserving its pre-coercion
+    name_hint: upstream operators already reference this column by the
+    name built at parse time (e.g. ``(id + '1')``), so a rewrite that
+    changes the printed form must alias back to the original name."""
+    old_name = e.name_hint()
+    out = _coerce_expr(e, schema)
+    if out is not e and out.name_hint() != old_name:
+        out = Alias(out, old_name)
+    return out
+
+
+def coerce_types(plan: LogicalPlan) -> None:
+    """The coercion batch: rewrite each operator's expressions against its
+    child schema. Mutates expression lists in place (plans are one-tree
+    executables here; the reference transforms immutably)."""
+    if isinstance(plan, Project):
+        schema = infer_schema(plan.children[0])
+        plan.exprs = [_coerce_named(e, schema) for e in plan.exprs]
+    elif isinstance(plan, Filter):
+        schema = infer_schema(plan.children[0])
+        plan.cond = _coerce_expr(plan.cond, schema)
+    elif isinstance(plan, Aggregate):
+        schema = infer_schema(plan.children[0])
+        plan.group_exprs = [_coerce_named(e, schema)
+                            for e in plan.group_exprs]
+        plan.agg_exprs = [_coerce_named(e, schema)
+                          for e in plan.agg_exprs]
+
+
+#: batches run in order; each rule visits every node (RuleExecutor shape);
+#: checks are fixed point in one pass, coercion rewrites in place — new
+#: resolution rules append here rather than growing plan construction
+#: special cases
 _BATCHES: List[List[Callable[[LogicalPlan], None]]] = [
     [check_relations],
     [check_references, check_aggregation],
+    [coerce_types],
 ]
 
 
 def analyze(plan: LogicalPlan) -> LogicalPlan:
     """Run the analysis batches; returns the (validated) plan or raises
     :class:`AnalysisException`."""
-    for batch in _BATCHES:
-        for rule in batch:
-            _visit(plan, rule)
+    global _SCHEMA_MEMO
+    _SCHEMA_MEMO = {}
+    try:
+        for batch in _BATCHES:
+            for rule in batch:
+                _visit(plan, rule)
+    finally:
+        _SCHEMA_MEMO = None
     return plan
 
 

@@ -362,7 +362,36 @@ class Cast(Expr):
     def eval(self, batch):
         v = np.atleast_1d(self.children[0].eval(batch))
         if self.to == "string":
-            return np.array([str(x) for x in v], dtype=object)
+            return np.array([None if x is None else str(x) for x in v],
+                            dtype=object)
+        if self.to in ("double", "bigint") and (
+                v.dtype == object or v.dtype.kind in "US"):
+            # Spark cast semantics (Cast.scala): an unparseable string
+            # casts to NULL, it does not error the query. NULL rides as
+            # NaN in the float lane; an int cast with any failure/null
+            # widens to float64 to carry them. Integer strings parse via
+            # int() so > 2^53 ids survive exactly (floats would round).
+            if self.to == "bigint":
+                vals: list = []
+                exact = True
+                for x in v:
+                    try:
+                        vals.append(int(x))
+                    except (TypeError, ValueError):
+                        try:
+                            vals.append(int(float(x)))  # '3.7' -> 3
+                        except (TypeError, ValueError, OverflowError):
+                            vals.append(np.nan)
+                            exact = False
+                return np.asarray(
+                    vals, dtype=np.int64 if exact else np.float64)
+            out = np.empty(v.shape[0], dtype=np.float64)
+            for i, x in enumerate(v):
+                try:
+                    out[i] = float(x) if x is not None else np.nan
+                except (TypeError, ValueError):
+                    out[i] = np.nan
+            return out
         return v.astype(self._np[self.to])
 
     def __str__(self):

@@ -703,6 +703,23 @@ class _Parser:
 
     def parse_call(self, name: str) -> Expr:
         lname = name.lower()
+        if lname == "cast":
+            # CAST(expr AS type) — type names map onto the engine's four
+            # cast lanes (ref SqlBaseParser.g4 CAST / Cast.scala)
+            from cycloneml_tpu.sql.column import Cast
+            arg = self.parse_expr()
+            self.expect("kw", "as")
+            ty = self.next()[1].lower()
+            self.expect("op", ")")
+            lane = {"double": "double", "float": "double", "real": "double",
+                    "bigint": "bigint", "int": "bigint", "integer": "bigint",
+                    "long": "bigint", "smallint": "bigint",
+                    "boolean": "boolean", "bool": "boolean",
+                    "string": "string", "varchar": "string",
+                    "text": "string"}.get(ty)
+            if lane is None:
+                raise ValueError(f"unsupported cast target {ty!r}")
+            return Cast(arg, lane)
         if lname == "count" and self.peek() == ("op", "*"):
             self.next()
             self.expect("op", ")")

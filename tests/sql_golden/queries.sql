@@ -198,3 +198,11 @@ SELECT COUNT(*) AS all_rows, COUNT(qty) AS non_null FROM inv
 SELECT kind, COUNT(*) AS n FROM inv GROUP BY kind ORDER BY kind
 -- join on t1/t2 left with missing matches
 SELECT a.tag, a.x, b.val FROM t1 a LEFT JOIN t2 b ON a.tag = b.tag ORDER BY a.tag, a.x, b.val
+-- no-sqlite integer division widens to double (Spark Division rule; sqlite truncates)
+SELECT id, id / 2 AS half FROM emp ORDER BY id
+-- no-sqlite string-numeric comparison promotes the string side (PromoteStrings)
+SELECT name FROM emp WHERE id = '3'
+-- no-sqlite explicit CAST, unparseable strings become null
+SELECT CAST(floor AS STRING) AS fs, CAST(dept AS DOUBLE) AS fd FROM dept ORDER BY floor
+-- no-sqlite string arithmetic casts to double
+SELECT name, id + '10' AS shifted FROM emp ORDER BY id
