@@ -20,6 +20,7 @@ outgrows one device (SURVEY §5.7a).
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -175,6 +176,7 @@ class SparseInstanceDataset:
                            k_max: Optional[int] = None,
                            chunk_rows: int = 65536,
                            n_threads: int = 0,
+                           n_readers: int = 1,
                            collect_labels: Optional[list] = None
                            ) -> "SparseInstanceDataset":
         """Bounded-memory sharded ingest: stream a libsvm file chunk-by-chunk
@@ -198,10 +200,19 @@ class SparseInstanceDataset:
         ``collect_labels``: pass an empty list to receive per-device lists of
         f64 label chunks in DATASET row order (labels would otherwise only be
         readable back from the device tier as f32).
+
+        ``n_readers > 1`` splits the FILE into byte ranges parsed by
+        concurrent reader threads (the HadoopRDD split analog —
+        HadoopRDD.scala:87; ctypes releases the GIL during the native
+        parse, so readers genuinely overlap with each other and with the
+        driver's pack/placement work). Chunks interleave across readers, a
+        permutation of file order — the same exchangeability contract the
+        round-robin placement already states.
         """
         import jax
         import jax.numpy as jnp
-        from cycloneml_tpu.native.host import stream_libsvm_chunks
+        from cycloneml_tpu.native.host import (native_available,
+                                               stream_libsvm_chunks)
 
         rt = ctx.mesh_runtime
         if rt.mesh.devices.shape[2] != 1:
@@ -219,8 +230,60 @@ class SparseInstanceDataset:
         max_feature = 0
         ci = 0
 
-        for cy, cnnz, cfi, cfv, mf in stream_libsvm_chunks(
-                path, chunk_rows=chunk_rows, n_threads=n_threads):
+        def chunk_source():
+            if n_readers <= 1 or not native_available():
+                yield from stream_libsvm_chunks(
+                    path, chunk_rows=chunk_rows, n_threads=n_threads)
+                return
+            import queue
+            import threading as _th
+            size = os.path.getsize(path)
+            bounds = [(i * size // n_readers, (i + 1) * size // n_readers)
+                      for i in range(n_readers)]
+            per_reader_threads = max(
+                1, (n_threads or (os.cpu_count() or 1)) // n_readers)
+            q: "queue.Queue" = queue.Queue(maxsize=2 * n_readers)
+            stop = _th.Event()
+
+            def put_or_stop(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.5)
+                        return True
+                    except queue.Full:
+                        continue
+                return False  # consumer gone: drop, do not block forever
+
+            def run(rng):
+                try:
+                    for ch in stream_libsvm_chunks(
+                            path, chunk_rows=chunk_rows,
+                            n_threads=per_reader_threads, byte_range=rng):
+                        if not put_or_stop(("chunk", ch)):
+                            return
+                except Exception as e:  # surfaced in the consumer
+                    put_or_stop(("error", e))
+                finally:
+                    put_or_stop(("done", None))
+
+            threads = [_th.Thread(target=run, args=(b,), daemon=True)
+                       for b in bounds]
+            for t in threads:
+                t.start()
+            done = 0
+            try:
+                while done < len(threads):
+                    kind, payload = q.get()
+                    if kind == "done":
+                        done += 1
+                    elif kind == "error":
+                        raise payload
+                    else:
+                        yield payload
+            finally:
+                stop.set()  # a consumer error must not strand readers
+
+        for cy, cnnz, cfi, cfv, mf in chunk_source():
             max_feature = max(max_feature, mf)
             if (hash_dim is None and n_features is not None
                     and max_feature > n_features):

@@ -48,6 +48,9 @@ class _Lib:
         self.svm_free = _fn(lib, "svm_free", None, [_c_vp])
         self.svm_stream_open = _fn(lib, "svm_stream_open", _c_vp,
                                    [ctypes.c_char_p, _c_i64, ctypes.c_int])
+        self.svm_stream_open_range = _fn(
+            lib, "svm_stream_open_range", _c_vp,
+            [ctypes.c_char_p, _c_i64, ctypes.c_int, _c_i64, _c_i64])
         self.svm_stream_next = _fn(lib, "svm_stream_next", _c_i64,
                                    [_c_vp, _c_vp, _c_vp, _c_vp, _c_vp,
                                     _c_i64, _c_i64, ctypes.POINTER(_c_i64)])
@@ -128,7 +131,8 @@ def parse_libsvm_native(path: str, n_features: Optional[int] = None,
 
 def stream_libsvm_chunks(path: str, chunk_rows: int = 65536,
                          cap_nnz: Optional[int] = None,
-                         buf_bytes: int = 8 << 20, n_threads: int = 0):
+                         buf_bytes: int = 8 << 20, n_threads: int = 0,
+                         byte_range: Optional[Tuple[int, int]] = None):
     """Yield ``(y, row_nnz, flat_idx, flat_val, max_feature)`` CSR chunks of a
     libsvm file with bounded memory (the Criteo-class ingest path; the
     reference's analog streams HadoopRDD partitions through
@@ -139,14 +143,26 @@ def stream_libsvm_chunks(path: str, chunk_rows: int = 65536,
     line streamer with identical chunk semantics. ``max_feature`` is the
     running (1 + max feature index) over everything parsed SO FAR — only
     final after the last chunk.
+
+    ``byte_range=(start, end)`` reads one HadoopRDD-style split: skip the
+    partial first line when ``start > 0``, own every line starting at
+    offset <= ``end``. Concatenating all splits of a partition of the file
+    reproduces the single-reader row set exactly.
     """
     if cap_nnz is None:
         cap_nnz = chunk_rows * 64
     lib = _lib()
     if lib is None:
+        if byte_range is not None:
+            raise NotImplementedError(
+                "byte_range needs the native scanner (not built here)")
         yield from _stream_libsvm_py(path, chunk_rows, cap_nnz)
         return
-    h = lib.svm_stream_open(path.encode(), buf_bytes, n_threads)
+    if byte_range is not None:
+        h = lib.svm_stream_open_range(path.encode(), buf_bytes, n_threads,
+                                      byte_range[0], byte_range[1])
+    else:
+        h = lib.svm_stream_open(path.encode(), buf_bytes, n_threads)
     if not h:
         raise IOError(f"cannot open {path!r}")
     try:

@@ -79,6 +79,87 @@ static void parse_svm_range(const char* data, size_t begin, size_t end,
   *max_idx = local_max;
 }
 
+// Flat CSR output for the STREAM path: per-row std::vector allocations in
+// SvmRow dominate single-core parse time at Criteo row rates; the flat
+// form appends into four growing arrays and hands chunks out via memcpy.
+struct SvmFlat {
+  std::vector<double> y;
+  std::vector<int32_t> nnz;
+  std::vector<int32_t> idx;
+  std::vector<float> val;
+};
+
+static inline const char* svm_skip_ws(const char* p, const char* stop) {
+  while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+  return p;
+}
+
+static void parse_svm_range_flat(const char* data, size_t begin, size_t end,
+                                 SvmFlat* out, int64_t* max_idx) {
+  size_t pos = begin;
+  int64_t local_max = -1;
+  while (pos < end) {
+    size_t eol = pos;
+    while (eol < end && data[eol] != '\n') eol++;
+    const char* p = data + pos;
+    const char* stop = data + eol;
+    pos = eol + 1;
+    p = svm_skip_ws(p, stop);
+    if (p >= stop || *p == '#') continue;
+    char* next = nullptr;
+    double label = strtod(p, &next);
+    if (next == p) continue;
+    p = next;
+    int32_t count = 0;
+    while (p < stop) {
+      p = svm_skip_ws(p, stop);
+      if (p >= stop) break;
+      // manual index parse (strtol's locale/overflow machinery is the
+      // single hottest line at tens of millions of tokens)
+      const char* q = p;
+      bool neg = false;
+      if (*q == '-' || *q == '+') { neg = (*q == '-'); q++; }
+      const char* d0 = q;
+      long idxv = 0;
+      while (q < stop && *q >= '0' && *q <= '9') {
+        idxv = idxv * 10 + (*q - '0');
+        q++;
+      }
+      if (q == d0 || q - d0 > 18 || q >= stop || *q != ':') break;
+      if (neg) idxv = -idxv;
+      p = q + 1;
+      // fast value path: a plain integer token (the common hashed-count
+      // case) converts directly; anything else falls back to strtof
+      float v;
+      q = p;
+      neg = false;
+      if (q < stop && (*q == '-' || *q == '+')) { neg = (*q == '-'); q++; }
+      d0 = q;
+      long mant = 0;
+      while (q < stop && *q >= '0' && *q <= '9') {
+        mant = mant * 10 + (*q - '0');
+        q++;
+      }
+      if (q > d0 && q - d0 <= 18 &&
+          (q >= stop || *q == ' ' || *q == '\t' || *q == '\r')) {
+        v = (float)(neg ? -mant : mant);
+        p = q;
+      } else {
+        v = strtof(p, &next);
+        if (next == p) break;
+        p = next;
+      }
+      out->idx.push_back((int32_t)(idxv - 1));  // libsvm is 1-based
+      out->val.push_back(v);
+      count++;
+      if (idxv - 1 > local_max) local_max = idxv - 1;
+    }
+    out->y.push_back(label);
+    out->nnz.push_back(count);
+  }
+  *max_idx = local_max;
+}
+
 // Parse whole file with n threads; returns handle, row/feature counts.
 void* svm_open(const char* path, int n_threads, int64_t* n_rows,
                int64_t* n_features) {
@@ -149,13 +230,18 @@ void svm_free(void* h) { delete (SvmFile*)h; }
 
 struct SvmStream {
   FILE* f = nullptr;
-  std::string carry;            // partial trailing line of the last window
-  std::vector<SvmRow> pending;  // parsed rows not yet handed out
-  size_t ppos = 0;
+  std::string carry;  // partial trailing line of the last window
+  SvmFlat pend;       // parsed rows not yet handed out (flat CSR)
+  size_t prow = 0;    // next pending row
+  size_t pnz = 0;     // offset of that row's nonzeros in pend.idx/val
   int64_t buf_bytes;
   int nt;
   bool eof = false;
   int64_t max_idx = -1;  // max feature index seen so far (running)
+  int64_t pos = 0;       // absolute file offset of the next unread byte
+  int64_t limit = -1;    // split end (-1 = whole file): lines STARTING at
+                         // offset <= limit are ours (HadoopRDD
+                         // LineRecordReader split semantics)
 };
 
 void* svm_stream_open(const char* path, int64_t buf_bytes, int n_threads) {
@@ -166,6 +252,40 @@ void* svm_stream_open(const char* path, int64_t buf_bytes, int n_threads) {
   s->buf_bytes = buf_bytes > 0 ? buf_bytes : (8 << 20);
   s->nt = n_threads > 0 ? n_threads : (int)std::thread::hardware_concurrency();
   if (s->nt < 1) s->nt = 1;
+  return s;
+}
+
+void svm_stream_free(void* h);
+
+// Byte-range split reader (ref: core/.../rdd/HadoopRDD.scala:87 +
+// LineRecordReader): a split [start, end) skips through the first newline
+// when start > 0 (that partial/boundary line belongs to the previous
+// split, which reads one line PAST its end), and keeps every line whose
+// first byte sits at offset <= end.
+void* svm_stream_open_range(const char* path, int64_t buf_bytes,
+                            int n_threads, int64_t start, int64_t end) {
+  auto* s = (SvmStream*)svm_stream_open(path, buf_bytes, n_threads);
+  if (!s) return nullptr;
+  if (start > 0) {
+    if (fseek(s->f, (long)start, SEEK_SET) != 0) {
+      svm_stream_free(s);
+      return nullptr;
+    }
+    s->pos = start;
+    // discard through the first newline
+    int c;
+    while ((c = fgetc(s->f)) != EOF) {
+      s->pos++;
+      if (c == '\n') break;
+    }
+    if (c == EOF) s->eof = true;
+    // the skip consumed past the split end: every line starting in
+    // [start, end] belonged to the previous split's read-one-line-past-
+    // end — emitting the next line here would duplicate it with the
+    // split that owns it (splits narrower than one line)
+    if (end >= 0 && s->pos > end) s->eof = true;
+  }
+  s->limit = end;
   return s;
 }
 
@@ -182,11 +302,27 @@ static bool svm_stream_refill(SvmStream* s) {
   buf.insert(buf.end(), s->carry.begin(), s->carry.end());
   s->carry.clear();
   size_t old = buf.size();
+  int64_t win_start = s->pos - (int64_t)old;  // abs offset of buf[0]
   buf.resize(old + (size_t)s->buf_bytes);
   size_t got = fread(buf.data() + old, 1, (size_t)s->buf_bytes, s->f);
   buf.resize(old + got);
+  s->pos += (int64_t)got;
   if (got < (size_t)s->buf_bytes) s->eof = true;
   if (buf.empty()) return false;
+
+  if (s->limit >= 0 && win_start + (int64_t)buf.size() > s->limit) {
+    // split end inside this window: keep through the first newline at
+    // abs offset >= limit (the line STARTING at limit is still ours;
+    // the next split discards it as its partial first line)
+    size_t cut = s->limit > win_start ? (size_t)(s->limit - win_start) : 0;
+    while (cut < buf.size() && buf[cut] != '\n') cut++;
+    if (cut < buf.size()) {
+      buf.resize(cut + 1);
+      s->eof = true;
+    }
+    // newline not in window yet: the final line spills past it — fall
+    // through; the carry logic keeps reading until it completes
+  }
 
   size_t end = buf.size();
   if (!s->eof) {
@@ -211,23 +347,31 @@ static bool svm_stream_refill(SvmStream* s) {
     while (b < end && buf[b] != '\n') b++;
     bounds[i] = b < end ? b + 1 : end;
   }
-  std::vector<std::vector<SvmRow>> parts(nt);
+  std::vector<SvmFlat> parts(nt);
   std::vector<int64_t> maxes(nt, -1);
   std::vector<std::thread> threads;
   for (int i = 0; i < nt; i++)
-    threads.emplace_back(parse_svm_range, buf.data(), bounds[i], bounds[i + 1],
-                         &parts[i], &maxes[i]);
+    threads.emplace_back(parse_svm_range_flat, buf.data(), bounds[i],
+                         bounds[i + 1], &parts[i], &maxes[i]);
   for (auto& t : threads) t.join();
-  s->pending.clear();
-  s->ppos = 0;
+  s->pend.y.clear();
+  s->pend.nnz.clear();
+  s->pend.idx.clear();
+  s->pend.val.clear();
+  s->prow = 0;
+  s->pnz = 0;
   for (int i = 0; i < nt; i++) {
     if (maxes[i] > s->max_idx) s->max_idx = maxes[i];
-    for (auto& r : parts[i]) s->pending.push_back(std::move(r));
+    SvmFlat& p = s->pend;
+    p.y.insert(p.y.end(), parts[i].y.begin(), parts[i].y.end());
+    p.nnz.insert(p.nnz.end(), parts[i].nnz.begin(), parts[i].nnz.end());
+    p.idx.insert(p.idx.end(), parts[i].idx.begin(), parts[i].idx.end());
+    p.val.insert(p.val.end(), parts[i].val.begin(), parts[i].val.end());
   }
   // a window of only comments/blank lines parses to zero rows; that is not
   // end-of-stream
-  if (s->pending.empty() && !s->eof) goto retry;
-  return !s->pending.empty();
+  if (s->pend.y.empty() && !s->eof) goto retry;
+  return !s->pend.y.empty();
 }
 
 // Fill up to max_rows rows (CSR: y, row_nnz, flat idx/val capped at cap_nnz).
@@ -240,28 +384,40 @@ int64_t svm_stream_next(void* h, double* y, int32_t* row_nnz, int32_t* idx,
   auto* s = (SvmStream*)h;
   int64_t rows = 0, used = 0;
   while (rows < max_rows) {
-    if (s->ppos >= s->pending.size()) {
+    if (s->prow >= s->pend.y.size()) {
       if (s->eof) break;
       if (!svm_stream_refill(s)) break;
       continue;
     }
-    SvmRow& r = s->pending[s->ppos];
-    int64_t nnz = (int64_t)r.feats.size();
-    if (nnz > cap_nnz) return -2;
-    if (used + nnz > cap_nnz) break;  // chunk full by nnz
-    y[rows] = r.label;
-    row_nnz[rows] = (int32_t)nnz;
-    for (auto& kv : r.feats) {
-      idx[used] = kv.first;
-      val[used] = kv.second;
-      used++;
+    // take as many whole pending rows as fit the row and nnz caps, then
+    // bulk-copy their flat index/value slices
+    size_t take = 0;
+    int64_t take_nnz = 0;
+    while (s->prow + take < s->pend.y.size() &&
+           rows + (int64_t)take < max_rows) {
+      int64_t n = s->pend.nnz[s->prow + take];
+      if (n > cap_nnz) return -2;
+      if (used + take_nnz + n > cap_nnz) break;
+      take_nnz += n;
+      take++;
     }
-    rows++;
-    s->ppos++;
+    if (take == 0) break;  // chunk full by nnz
+    memcpy(y + rows, s->pend.y.data() + s->prow, take * sizeof(double));
+    memcpy(row_nnz + rows, s->pend.nnz.data() + s->prow,
+           take * sizeof(int32_t));
+    memcpy(idx + used, s->pend.idx.data() + s->pnz,
+           (size_t)take_nnz * sizeof(int32_t));
+    memcpy(val + used, s->pend.val.data() + s->pnz,
+           (size_t)take_nnz * sizeof(float));
+    rows += (int64_t)take;
+    used += take_nnz;
+    s->prow += take;
+    s->pnz += (size_t)take_nnz;
   }
-  if (s->ppos >= s->pending.size() && s->eof) {
-    s->pending.clear();  // release the last window's rows promptly
-    s->ppos = 0;
+  if (s->prow >= s->pend.y.size() && s->eof) {
+    s->pend = SvmFlat();  // release the last window's rows promptly
+    s->prow = 0;
+    s->pnz = 0;
   }
   *max_feature = s->max_idx + 1;
   return rows;

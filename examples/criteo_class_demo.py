@@ -75,9 +75,10 @@ def main() -> None:
     rss_before = rss_mb()
     t0 = time.perf_counter()
     labels: list = []
+    n_readers = int(os.environ.get("CRITEO_READERS", "4"))
     ds = SparseInstanceDataset.from_libsvm_stream(
         ctx, path, hash_dim=d_hash, chunk_rows=65536,
-        collect_labels=labels)
+        n_readers=n_readers, collect_labels=labels)
     ingest_s = time.perf_counter() - t0
     print(f"streamed ELL ingest: {ingest_s:.0f}s "
           f"({size_gb / max(ingest_s, 1e-9) * 1024:.0f} MB/s), "

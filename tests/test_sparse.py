@@ -396,3 +396,57 @@ def test_stream_rejects_undersized_n_features(ctx, tmp_path):
     # hash_dim folds indices instead and stays legal
     ds = SparseInstanceDataset.from_libsvm_stream(ctx, p, hash_dim=4)
     assert ds.n_features == 4
+
+
+def test_sharded_readers_equal_single_reader(ctx, tmp_path):
+    """N-way byte-range split ingest (HadoopRDD split analog) produces the
+    SAME dataset as the single reader — same rows, same labels, just a
+    permuted order (round-3 verdict item 7)."""
+    from cycloneml_tpu.native.host import native_available
+    if not native_available():
+        pytest.skip("byte-range splits need the native scanner")
+    rng = np.random.RandomState(3)
+    path = tmp_path / "split.svm"
+    with open(path, "w") as fh:
+        for i in range(4000):
+            nnz = rng.randint(1, 9)
+            idx = np.sort(rng.choice(300, nnz, replace=False))
+            feats = " ".join(f"{j + 1}:{rng.rand():.4f}" for j in idx)
+            fh.write(f"{i % 2} {feats}\n")
+
+    def row_set(ds):
+        dense = ds.to_dense()
+        y = np.asarray(ds.y)[np.asarray(ds.w) > 0]
+        return sorted((float(yy),) + tuple(np.round(r, 4))
+                      for yy, r in zip(y, dense))
+
+    single = SparseInstanceDataset.from_libsvm_stream(
+        ctx, str(path), n_features=301, chunk_rows=512)
+    multi = SparseInstanceDataset.from_libsvm_stream(
+        ctx, str(path), n_features=301, chunk_rows=512, n_readers=4)
+    assert multi.n_rows == single.n_rows == 4000
+    assert row_set(multi) == row_set(single)
+
+
+def test_splits_narrower_than_one_line(ctx, tmp_path):
+    """Byte-range splits smaller than a single line must not duplicate the
+    following line (review r4 — [1,1,1,0,0] repro)."""
+    from cycloneml_tpu.native.host import native_available, stream_libsvm_chunks
+    if not native_available():
+        pytest.skip("native scanner absent")
+    path = tmp_path / "long.svm"
+    lines = []
+    for i in range(2):
+        feats = " ".join(f"{j + 1}:0.5" for j in range(40))
+        lines.append(f"{i} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    import os as _os
+    size = _os.path.getsize(path)
+    n_splits = 5
+    total = 0
+    for i in range(n_splits):
+        b = (i * size // n_splits, (i + 1) * size // n_splits)
+        for y, nnz, fi, fv, mf in stream_libsvm_chunks(
+                str(path), chunk_rows=64, byte_range=b):
+            total += len(y)
+    assert total == 2
