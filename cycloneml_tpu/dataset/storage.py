@@ -39,6 +39,31 @@ class StorageLevel:
 _ORDER = [StorageLevel.DEVICE, StorageLevel.HOST, StorageLevel.DISK]
 
 
+def _spill_file(path: str) -> str:
+    """The on-disk name persist_disk writes for a spill ``path``."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _unlink_spill(path: Optional[str]) -> None:
+    if path:
+        try:
+            os.unlink(_spill_file(path))
+        except OSError:
+            pass
+
+
+def _cleanup_entry(mgr_ref, key: int) -> None:
+    """weakref.finalize hook: a GC'd managed dataset drops its entry and
+    its spill file (ContextCleaner analog — module-level so the finalizer
+    itself never pins the manager or the dataset)."""
+    mgr = mgr_ref()
+    if mgr is None:
+        return
+    with mgr._lock:
+        e = mgr._entries.pop(key, None)
+    _unlink_spill(e["path"] if e else None)
+
+
 class StorageManager:
     """Bounded multi-tier dataset cache with LRU demotion.
 
@@ -54,7 +79,10 @@ class StorageManager:
         self.host_budget = host_budget
         self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="cyclone-store-")
         self._lock = threading.RLock()
-        # id(ds) -> {ds, level, bytes, last_used, path}
+        # id(ds) -> {ds (weakref), level, bytes, last_used, path}; entries
+        # hold their dataset WEAKLY: the manager accounts for blocks, it
+        # does not extend their lifetime (the reference's ContextCleaner
+        # drops BlockManager entries for GC'd RDDs the same way)
         self._entries: Dict[int, dict] = {}
 
     # -- public surface ------------------------------------------------------
@@ -67,13 +95,15 @@ class StorageManager:
             raise ValueError(f"unknown storage level {level!r}")
         import weakref
         with self._lock:
-            self._entries[id(ds)] = {"ds": ds, "level": level,
-                                     "bytes": ds.padded_bytes(),
-                                     "last_used": time.monotonic(),
-                                     "path": None}
+            key = id(ds)
+            entry = {"ds": weakref.ref(ds), "level": level,
+                     "bytes": ds.padded_bytes(),
+                     "last_used": time.monotonic(), "path": None}
+            self._entries[key] = entry
             ref = weakref.ref(self)
             ds._storage_cb = lambda d: (ref() and ref()._on_restore(d))
-            self._apply_level(self._entries[id(ds)], level)
+            weakref.finalize(ds, _cleanup_entry, ref, key)
+            self._apply_level(entry, level)
             self._enforce()
         return ds
 
@@ -96,7 +126,7 @@ class StorageManager:
             if e is None:
                 return
             e["last_used"] = time.monotonic()
-            if e["ds"]._x is not None:
+            if ds._x is not None:
                 e["level"] = StorageLevel.DEVICE
             self._enforce()
 
@@ -109,17 +139,10 @@ class StorageManager:
             if e is None:
                 return
             if e["level"] == StorageLevel.DISK and e["path"]:
-                z = __import__("numpy").load(e["path"]
-                                             if e["path"].endswith(".npz")
-                                             else e["path"] + ".npz")
+                z = __import__("numpy").load(_spill_file(e["path"]))
                 ds._host = (z["x"], z["y"], z["w"])
                 ds._disk_path = None
-            if e["path"]:
-                try:
-                    os.unlink(e["path"] if e["path"].endswith(".npz")
-                              else e["path"] + ".npz")
-                except OSError:
-                    pass
+            _unlink_spill(e["path"])
 
     def level_of(self, ds) -> Optional[str]:
         e = self._entries.get(id(ds))
@@ -127,14 +150,22 @@ class StorageManager:
 
     def usage(self) -> Dict[str, int]:
         with self._lock:
+            self._prune()
             out = {lvl: 0 for lvl in _ORDER}
             for e in self._entries.values():
                 out[e["level"]] += e["bytes"]
             return out
 
     # -- mechanics -----------------------------------------------------------
+    def _prune(self) -> None:
+        dead = [k for k, e in self._entries.items() if e["ds"]() is None]
+        for k in dead:
+            _unlink_spill(self._entries.pop(k)["path"])
+
     def _apply_level(self, e: dict, level: str) -> None:
-        ds = e["ds"]
+        ds = e["ds"]()
+        if ds is None:
+            return
         if level == StorageLevel.DEVICE:
             ds.x  # property access re-places evicted arrays on the mesh
         elif level == StorageLevel.HOST:
@@ -149,7 +180,20 @@ class StorageManager:
             ds.persist_disk(e["path"])
         e["level"] = level
 
+    @staticmethod
+    def _shares_arrays(ds) -> bool:
+        """True when ``ds`` shares device arrays with a live relative
+        (``derive()`` lineage): demoting it would delete buffers the
+        relative still serves, so such entries are not eviction
+        candidates until the sharing side dies."""
+        p = getattr(ds, "_array_parent", None)
+        if p is not None and p() is not None:
+            return True
+        kids = getattr(ds, "_derived_children", None)
+        return bool(kids) and len(kids) > 0
+
     def _enforce(self) -> None:
+        self._prune()
         for level, budget in ((StorageLevel.DEVICE, self.device_budget),
                               (StorageLevel.HOST, self.host_budget)):
             if budget is None:
@@ -163,8 +207,10 @@ class StorageManager:
                 # demoting it mid-access would hand the caller None arrays
                 # (an over-budget SINGLE block stays put, like the
                 # reference keeping a block larger than the store)
-                candidates = sorted(entries,
-                                    key=lambda e: e["last_used"])[:-1]
+                candidates = [e for e in sorted(
+                    entries, key=lambda e: e["last_used"])[:-1]
+                    if e["ds"]() is not None
+                    and not self._shares_arrays(e["ds"]())]
                 if used <= budget or not candidates:
                     if used > budget:
                         logger.warning(
@@ -177,3 +223,15 @@ class StorageManager:
                             victim["bytes"], level, nxt)
                 self._apply_level(victim, nxt)
 
+
+    def close(self) -> None:
+        """Release every spill file and the spill directory (context
+        shutdown). Managed datasets are left wherever they are — a
+        DISK-tier dataset still referenced keeps its data only if the
+        caller restored it first, which is why unpersist() promotes."""
+        import shutil
+        with self._lock:
+            for e in self._entries.values():
+                _unlink_spill(e["path"])
+            self._entries = {}
+        shutil.rmtree(self._spill_dir, ignore_errors=True)

@@ -352,6 +352,11 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         ds_std, inv_std = standardize_dataset(
             ds, features_std, center_mean=stats.mean if fit_with_mean else None)
         scaled_mean = stats.mean * inv_std if fit_with_mean else None
+        # the standardized training blocks register with the context's
+        # storage tiers for the fit's duration (≈ the reference persisting
+        # instance blocks MEMORY_AND_DISK): under a tight device budget
+        # their pressure demotes cold cached datasets, not the fit
+        ds_std.persist()
 
         if is_multinomial:
             agg = aggregators.multinomial_logistic(d, num_classes, fit_intercept)
@@ -437,13 +442,16 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 opt = DeviceLBFGS(max_iter=self.get("maxIter"),
                                   tol=self.get("tol"), chunk=chunk)
 
-        state = self._optimize(opt, loss_fn, x0, (
-            ds.n_rows, d, num_classes, float(weight_sum),
-            np.asarray(histogram).round(6).tolist(),
-            np.asarray(features_std).round(6).tolist(),
-            reg, alpha, self.get("tol"), fit_intercept, standardize,
-            fit_with_mean,
-        ))
+        try:
+            state = self._optimize(opt, loss_fn, x0, (
+                ds.n_rows, d, num_classes, float(weight_sum),
+                np.asarray(histogram).round(6).tolist(),
+                np.asarray(features_std).round(6).tolist(),
+                reg, alpha, self.get("tol"), fit_intercept, standardize,
+                fit_with_mean,
+            ))
+        finally:
+            ds_std.unpersist()
 
         sol = state.x
         if is_multinomial:

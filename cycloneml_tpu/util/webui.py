@@ -34,6 +34,7 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>Cyclone <span id="app" class="muted"></span></h1>
 <h2>Jobs</h2><div id="jobs" class="muted">loading…</div>
+<h2>Storage</h2><div id="storage" class="muted">none</div>
 <h2>Checkpoints</h2><div id="ckpts" class="muted">none</div>
 <h2>Worker failures</h2><div id="fails" class="muted">none</div>
 <script>
@@ -60,6 +61,9 @@ async function refresh() {
               table(steps.slice(-20), Object.keys(steps[0]));
   }
   document.getElementById('jobs').innerHTML = html;
+  const st = await j('storage');
+  if (st.length) document.getElementById('storage').innerHTML =
+    table(st, ['tier', 'bytes']);
   const cks = await j('checkpoints');
   if (cks.length) document.getElementById('ckpts').innerHTML =
     table(cks, Object.keys(cks[0]));
@@ -76,7 +80,10 @@ class StatusWebUI:
     """Serves the page at ``/`` and JSON under ``/api/v1/...``."""
 
     def __init__(self, store: AppStatusStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, storage_usage=None):
+        # live storage-tier accounting (≈ the reference's Storage tab over
+        # the BlockManager): a zero-arg callable returning {tier: bytes}
+        self._storage_usage = storage_usage
         ui = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -115,6 +122,11 @@ class StatusWebUI:
 
     def _route(self, route: str):
         parts = route.strip("/").split("/")
+        if parts == ["storage"]:
+            if self._storage_usage is None:
+                return []
+            return [{"tier": k, "bytes": v}
+                    for k, v in self._storage_usage().items()]
         if len(parts) == 1:
             return api_v1(self.store, parts[0])
         if len(parts) in (2, 3) and parts[0] == "jobs":
