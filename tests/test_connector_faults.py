@@ -1,0 +1,239 @@
+"""Connector failure-mode tests (round-3 verdict item 9): fault-injecting
+fakes that rebalance mid-stream, re-deliver records, and split shards —
+asserting the durable-checkpoint logic yields EXACTLY-ONCE batch contents
+across faults and restarts (ref: external/kafka-0-10-sql's exactly-once
+offset-range contract; external/kinesis-asl resharding + KCL checkpoints).
+"""
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.streaming.kafka import KafkaSource
+from cycloneml_tpu.streaming.kinesis import KinesisSource
+
+
+class FaultyKafkaConsumer:
+    """Log-backed fake with kafka-python's poll surface plus fault hooks:
+    ``rebalance()`` drops group state so the next poll re-delivers from the
+    last broker-committed position; ``rebalance(from_start=True)`` models a
+    lost consumer group (auto_offset_reset=earliest) re-delivering the
+    WHOLE topic."""
+
+    def __init__(self):
+        self.log = {}        # (topic, part) -> [records]
+        self.pos = {}        # delivery cursor per partition
+        self.committed_pos = {}
+        self.commits = 0
+
+    def feed(self, topic, part, *values):
+        from types import SimpleNamespace
+        tp = (topic, part)
+        recs = self.log.setdefault(tp, [])
+        for v in values:
+            recs.append(SimpleNamespace(
+                key=None, value=v, topic=topic, partition=part,
+                offset=len(recs), timestamp=1000 + len(recs)))
+
+    def poll(self, timeout_ms=0):
+        out = {}
+        for tp, recs in self.log.items():
+            i = self.pos.get(tp, 0)
+            if i < len(recs):
+                out[str(tp)] = recs[i:]
+                self.pos[tp] = len(recs)
+        return out
+
+    def commit(self):
+        self.committed_pos = dict(self.pos)
+        self.commits += 1
+
+    def rebalance(self, from_start=False):
+        self.pos = {} if from_start else dict(self.committed_pos)
+
+
+def test_kafka_rebalance_redelivery_exactly_once():
+    """A group rebalance re-delivers records after the last broker commit;
+    the per-partition dedup filter keeps batch contents exactly-once."""
+    consumer = FaultyKafkaConsumer()
+    src = KafkaSource("t", consumer_factory=lambda: consumer)
+
+    consumer.feed("t", 0, b"a0", b"a1")
+    consumer.feed("t", 1, b"b0")
+    end1 = src.latest_offset()
+    assert end1 == 3
+    got1 = sorted(src.get_batch(0, end1)["value"].tolist())
+    assert got1 == ["a0", "a1", "b0"]
+    src.commit(end1)
+
+    # new records arrive, consumer polls them, THEN the group rebalances
+    # before the broker commit: the next poll re-delivers them
+    consumer.feed("t", 0, b"a2")
+    consumer.feed("t", 1, b"b1", b"b2")
+    end2 = src.latest_offset()
+    consumer.rebalance()               # re-deliver everything uncommitted
+    end2b = src.latest_offset()        # the re-delivery poll
+    assert end2b == end2 == 6          # dedup: no phantom growth
+    got2 = sorted(src.get_batch(end1, end2)["value"].tolist())
+    assert got2 == ["a2", "b1", "b2"]
+    src.commit(end2)
+
+
+def test_kafka_lost_group_full_replay_exactly_once():
+    """auto_offset_reset=earliest after total group loss re-delivers the
+    whole topic; nothing duplicates."""
+    consumer = FaultyKafkaConsumer()
+    src = KafkaSource("t", consumer_factory=lambda: consumer)
+    consumer.feed("t", 0, b"x", b"y", b"z")
+    end = src.latest_offset()
+    src.get_batch(0, end)
+    src.commit(end)
+
+    consumer.rebalance(from_start=True)
+    consumer.feed("t", 0, b"w")
+    end2 = src.latest_offset()
+    assert end2 == 4  # exactly one new row despite the full replay
+    assert src.get_batch(end, end2)["value"].tolist() == ["w"]
+
+
+def test_kafka_restart_with_full_redelivery(tmp_path):
+    """Crash with uncommitted rows in the WAL; the restarted source's NEW
+    consumer replays the topic from offset 0 (no seek on the fake). WAL
+    recovery + dedup must reproduce the pending batch exactly once."""
+    log = str(tmp_path / "kafka_ck")
+    consumer = FaultyKafkaConsumer()
+    src = KafkaSource("t", consumer_factory=lambda: consumer)
+    src.set_log_dir(log)
+    consumer.feed("t", 0, b"r0", b"r1")
+    consumer.feed("t", 1, b"s0")
+    end1 = src.latest_offset()
+    src.get_batch(0, end1)
+    src.commit(end1)
+    consumer.feed("t", 0, b"r2")
+    consumer.feed("t", 1, b"s1")
+    end2 = src.latest_offset()  # engine logged end2, then CRASH
+
+    # restart: fresh source; the fake consumer lost its position entirely
+    # and re-delivers every record ever written
+    consumer.rebalance(from_start=True)
+    src2 = KafkaSource("t", consumer_factory=lambda: consumer)
+    src2.set_log_dir(log)
+    end2b = src2.latest_offset()
+    assert end2b == end2  # replayed rows deduped against WAL recovery
+    replay = sorted(src2.get_batch(end1, end2)["value"].tolist())
+    assert replay == ["r2", "s1"]
+    src2.commit(end2)
+    # a third instance starts clean: no pending rows, no duplicates
+    consumer.rebalance(from_start=True)
+    src3 = KafkaSource("t", consumer_factory=lambda: consumer)
+    src3.set_log_dir(log)
+    assert src3.latest_offset() == end2
+
+
+class SplittingKinesisClient:
+    """Kinesis fake whose shards can SPLIT: the parent's iterator chain
+    ends (NextShardIterator None once drained and closed) and two children
+    appear in list_shards — the resharding surface of the real service."""
+
+    def __init__(self):
+        self._seq = 0
+        self.shards = {"shard-p": {"recs": [], "closed": False}}
+
+    def put(self, shard, data):
+        self._seq += 1
+        self.shards[shard]["recs"].append(
+            {"Data": data, "PartitionKey": "k",
+             "SequenceNumber": f"{self._seq:020d}",
+             "ApproximateArrivalTimestamp": 1700000000 + self._seq})
+
+    def split(self, parent, *children):
+        self.shards[parent]["closed"] = True
+        for c in children:
+            self.shards.setdefault(c, {"recs": [], "closed": False})
+
+    def list_shards(self, StreamName):
+        return {"Shards": [{"ShardId": s} for s in self.shards]}
+
+    def get_shard_iterator(self, StreamName, ShardId, ShardIteratorType,
+                           StartingSequenceNumber=None):
+        recs = self.shards[ShardId]["recs"]
+        if ShardIteratorType == "TRIM_HORIZON":
+            pos = 0
+        else:
+            pos = sum(1 for r in recs
+                      if int(r["SequenceNumber"])
+                      <= int(StartingSequenceNumber))
+        return {"ShardIterator": f"{ShardId}:{pos}"}
+
+    def get_records(self, ShardIterator, Limit):
+        sid, pos = ShardIterator.rsplit(":", 1)
+        sh = self.shards[sid]
+        pos = int(pos)
+        recs = sh["recs"][pos: pos + Limit]
+        new_pos = pos + len(recs)
+        drained = new_pos >= len(sh["recs"])
+        nxt = None if (sh["closed"] and drained) else f"{sid}:{new_pos}"
+        return {"Records": recs, "NextShardIterator": nxt}
+
+
+def test_kinesis_shard_split_exactly_once(tmp_path):
+    fake = SplittingKinesisClient()
+    fake.put("shard-p", b"p0")
+    fake.put("shard-p", b"p1")
+    src = KinesisSource("s", client_factory=lambda: fake)
+    src.set_log_dir(str(tmp_path / "ck"))
+    end1 = src.latest_offset()
+    assert sorted(src.get_batch(0, end1)["data"].tolist()) == ["p0", "p1"]
+    src.commit(end1)
+
+    # SPLIT: parent closes, children carry the post-split records
+    fake.split("shard-p", "shard-c1", "shard-c2")
+    fake.put("shard-c1", b"c1a")
+    fake.put("shard-c2", b"c2a")
+    end2 = src.latest_offset()
+    got = sorted(src.get_batch(end1, end2)["data"].tolist())
+    assert got == ["c1a", "c2a"]
+    src.commit(end2)
+
+    # the closed parent must not replay on later polls
+    fake.put("shard-c1", b"c1b")
+    end3 = src.latest_offset()
+    assert src.get_batch(end2, end3)["data"].tolist() == ["c1b"]
+    src.commit(end3)
+
+    # restart after the split: children resume AFTER their committed
+    # sequence numbers, the parent stays consumed — no loss, no dups
+    fake.put("shard-c2", b"c2b")
+    src2 = KinesisSource("s", client_factory=lambda: fake)
+    src2.set_log_dir(str(tmp_path / "ck"))
+    end4 = src2.latest_offset()
+    got = src2.get_batch(src2._base, end4)["data"].tolist()
+    assert got == ["c2b"]
+
+
+def test_kinesis_split_mid_pending_restart(tmp_path):
+    """Crash between consuming post-split records and committing them: the
+    restarted source re-reads the children from their committed positions
+    and reproduces the pending rows exactly once."""
+    fake = SplittingKinesisClient()
+    fake.put("shard-p", b"p0")
+    src = KinesisSource("s", client_factory=lambda: fake)
+    src.set_log_dir(str(tmp_path / "ck"))
+    end1 = src.latest_offset()
+    src.get_batch(0, end1)
+    src.commit(end1)
+
+    fake.split("shard-p", "shard-c1")
+    fake.put("shard-c1", b"c0")
+    fake.put("shard-c1", b"c1")
+    end2 = src.latest_offset()  # consumed but NOT committed -> crash
+
+    src2 = KinesisSource("s", client_factory=lambda: fake)
+    src2.set_log_dir(str(tmp_path / "ck"))
+    end2b = src2.latest_offset()
+    assert end2b - src2._base == 2  # the two pending child rows, once
+    got = sorted(src2.get_batch(src2._base, end2b)["data"].tolist())
+    assert got == ["c0", "c1"]
+    src2.commit(end2b)
+    src3 = KinesisSource("s", client_factory=lambda: fake)
+    src3.set_log_dir(str(tmp_path / "ck"))
+    assert src3.latest_offset() == src3._base  # nothing pending
