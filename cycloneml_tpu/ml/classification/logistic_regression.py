@@ -349,14 +349,35 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         fit_with_mean = fit_intercept and all(
             self._opt(p) is None for p in ("lowerBoundsOnIntercepts",
                                            "upperBoundsOnIntercepts"))
-        ds_std, inv_std = standardize_dataset(
-            ds, features_std, center_mean=stats.mean if fit_with_mean else None)
+
+        rt = ds.ctx.mesh_runtime
+        from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+        from cycloneml_tpu.parallel import feature_sharding as fs
+        m = fs.model_parallelism(rt)
+        tp_active = (not is_multinomial) and m > 1 and d % m == 0
+        use_pallas = (not is_multinomial and hasattr(ds.ctx, "conf")
+                      and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
+        # plain binomial path: standardization (and fitWithMean centering)
+        # folds INTO the aggregator read — no standardized copy exists, so
+        # the fit's HBM working set is X itself, and the pre-fit
+        # standardize pass disappears (r3 verdict item 4). The
+        # multinomial / feature-sharded / pallas paths keep the
+        # materialized copy for now.
+        use_scaled = not (is_multinomial or tp_active or use_pallas)
+        from cycloneml_tpu.ml.optim.loss import inv_std_vector
+        inv_std = inv_std_vector(features_std)
         scaled_mean = stats.mean * inv_std if fit_with_mean else None
-        # the standardized training blocks register with the context's
-        # storage tiers for the fit's duration (≈ the reference persisting
-        # instance blocks MEMORY_AND_DISK): under a tight device budget
-        # their pressure demotes cold cached datasets, not the fit
-        ds_std.persist()
+        if use_scaled:
+            ds_std = ds
+        else:
+            ds_std, inv_std = standardize_dataset(
+                ds, features_std,
+                center_mean=stats.mean if fit_with_mean else None)
+            # the standardized copy registers with the context's storage
+            # tiers for the fit's duration (≈ the reference persisting
+            # instance blocks MEMORY_AND_DISK): under a tight device
+            # budget its pressure demotes cold cached datasets, not the fit
+            ds_std.persist()
 
         if is_multinomial:
             agg = aggregators.multinomial_logistic(d, num_classes, fit_intercept)
@@ -370,12 +391,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 features_std=np.tile(features_std, num_classes),
                 standardize=standardize) if l2 > 0 else None
         else:
-            from cycloneml_tpu.conf import USE_PALLAS_KERNELS
-            use_pallas = (hasattr(ds.ctx, "conf")
-                          and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
-            agg = (aggregators.binary_logistic_pallas(d, fit_intercept)
-                   if use_pallas
-                   else aggregators.binary_logistic(d, fit_intercept))
+            if use_scaled:
+                agg = aggregators.binary_logistic_scaled(d, fit_intercept)
+            elif use_pallas:
+                agg = aggregators.binary_logistic_pallas(d, fit_intercept)
+            else:
+                agg = aggregators.binary_logistic(d, fit_intercept)
             n_coef = d + (1 if fit_intercept else 0)
             x0 = np.zeros(n_coef)
             if fit_intercept and 0 < histogram[1:].sum() < weight_sum:
@@ -385,10 +406,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 l2, d, fit_intercept, features_std=features_std,
                 standardize=standardize) if l2 > 0 else None
 
-        rt = ds.ctx.mesh_runtime
-        from cycloneml_tpu.parallel import feature_sharding as fs
-        m = fs.model_parallelism(rt)
-        if not is_multinomial and m > 1 and d % m == 0:
+        if tp_active:
             # model axis present: feature-shard the blocks and coefficients
             # (SURVEY §5.7a — the path for d beyond one device's HBM). The
             # mesh layout is the user's explicit opt-in; binomial only (the
@@ -397,6 +415,15 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             loss_fn = fs.FeatureShardedLossFunction(
                 rt, x_tp, ds_std.y, ds_std.w, d, fit_intercept, l2_fn,
                 weight_sum, ctx=ds.ctx)
+        elif use_scaled:
+            import jax.numpy as jnp
+            xdt = ds.x.dtype
+            mu_or_zero = (scaled_mean if fit_with_mean
+                          else np.zeros(d))
+            loss_fn = DistributedLossFunction(
+                ds, agg, l2_fn, weight_sum,
+                extra_args=(jnp.asarray(inv_std.astype(xdt)),
+                            jnp.asarray(mu_or_zero.astype(xdt))))
         else:
             loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
 
@@ -451,7 +478,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 fit_with_mean,
             ))
         finally:
-            ds_std.unpersist()
+            if ds_std is not ds:  # the scaled path trains on ds itself
+                ds_std.unpersist()
 
         sol = state.x
         if is_multinomial:

@@ -84,6 +84,43 @@ def _binary_logistic(d: int, fit_intercept: bool, prec) -> Agg:
     return agg
 
 
+def binary_logistic_scaled(d: int, fit_intercept: bool = True) -> Agg:
+    """Binomial logistic loss over RAW feature blocks with standardization
+    folded into the read: margin = x·(inv_std∘β̂) − scaled_mean·β̂ + β₀ and
+    grad_β̂ = inv_std∘(xᵀmult) − scaled_mean·Σmult are algebraically the
+    aggregation over x̂ = (x−μ)/σ without EVER materializing x̂ — the
+    standardized copy (2× the HBM working set and one full read+write
+    pass per fit) disappears (r3 verdict item 4: "fold standardization
+    into the aggregator read"; the reference instead persists scaled
+    instance blocks, LogisticRegression.scala:968).
+
+    Signature: ``agg(x, y, w, inv_std, scaled_mean, coef)`` — inv_std and
+    scaled_mean ride as REPLICATED arguments (not closure constants), so
+    the compiled program is reused across datasets. Pass
+    ``scaled_mean=zeros`` when not centering (fitWithMean off).
+    """
+    return _binary_logistic_scaled(d, fit_intercept, matmul_precision())
+
+
+@functools.lru_cache(maxsize=None)
+def _binary_logistic_scaled(d: int, fit_intercept: bool, prec) -> Agg:
+
+    def agg(x, y, w, inv_std, scaled_mean, coef):
+        beta, b0 = _split_coef(coef, d, fit_intercept)
+        sb = inv_std * beta
+        margin = (jnp.dot(x, sb, precision=prec)
+                  - jnp.dot(scaled_mean, beta, precision=prec) + b0)
+        loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
+        multiplier = w * (jax.nn.sigmoid(margin) - y)
+        msum = jnp.sum(multiplier)
+        g = (inv_std * jnp.dot(x.T, multiplier, precision=prec)
+             - scaled_mean * msum)
+        grad = jnp.concatenate([g, msum[None]]) if fit_intercept else g
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
 def multinomial_logistic(d: int, k: int, fit_intercept: bool = True) -> Agg:
     """Softmax cross-entropy over k classes with k full coefficient vectors
     (ref MultinomialLogisticBlockAggregator.scala; the reference also keeps

@@ -39,8 +39,29 @@ class DistributedLossFunction:
 
     def __init__(self, dataset: InstanceDataset, agg: Callable,
                  l2_reg_fn: Optional[Callable] = None,
-                 weight_sum: Optional[float] = None):
-        self._agg_call = dataset.tree_aggregate_fn(agg)
+                 weight_sum: Optional[float] = None,
+                 extra_args: tuple = ()):
+        # ``extra_args``: replicated device arrays the aggregator takes
+        # BEFORE the coefficients (e.g. inv_std/scaled_mean for the
+        # fold-standardization-into-the-read aggregators). They join the
+        # fixed argument tuple so DeviceLBFGS's fused program threads them
+        # through unchanged and the compiled program stays dataset-generic.
+        base = dataset.tree_aggregate_fn(agg)
+        if extra_args:
+            extra = tuple(extra_args)
+
+            # delegate to base per call (NOT a snapshot tuple): base reads
+            # ds.x/ds.y/ds.w through their properties each invocation, so
+            # a StorageManager-evicted dataset transparently restores
+            # instead of dispatching on deleted buffers
+            def call(*coef):
+                return base(*extra, *coef)
+
+            call.compiled = base.compiled
+            call.arrays = lambda: base.arrays() + extra
+            self._agg_call = call
+        else:
+            self._agg_call = base
         self._ctx = dataset.ctx
         self.l2_reg_fn = l2_reg_fn
         if weight_sum is None:
@@ -286,6 +307,14 @@ def _get_center_scale_rows():
     return _center_scale_rows
 
 
+def inv_std_vector(features_std: np.ndarray) -> np.ndarray:
+    """1/σ per feature with zero-variance features excluded to 0 — the one
+    place the reference's exclusion rule (LogisticRegression.scala:649
+    featuresStd != 0 guard) is encoded."""
+    return np.where(features_std > 0, 1.0 / np.where(
+        features_std > 0, features_std, 1.0), 0.0)
+
+
 def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray,
                         center_mean: Optional[np.ndarray] = None):
     """Scale feature blocks by 1/std in HBM (≈ the reference persisting
@@ -305,8 +334,7 @@ def standardize_dataset(ds: InstanceDataset, features_std: np.ndarray,
     import jax
     import jax.numpy as jnp
 
-    inv_std = np.where(features_std > 0, 1.0 / np.where(
-        features_std > 0, features_std, 1.0), 0.0)
+    inv_std = inv_std_vector(features_std)
     if center_mean is not None:
         scaled = _get_center_scale_rows()(
             ds.x, jnp.asarray(inv_std), jnp.asarray(center_mean))

@@ -44,10 +44,20 @@ class Summarizer:
 
     @staticmethod
     def summarize(dataset: InstanceDataset) -> SummaryStats:
+        # datasets are immutable (transformations derive NEW datasets), so
+        # the moment set is a property of the object: cache it, and a
+        # re-fit on the same frame-cached dataset (grid search, warmed
+        # benchmarks) skips the whole pass — and, through the TPU relay,
+        # one ~0.1-0.6 s dispatch round-trip
+        cached = getattr(dataset, "_summary_cache", None)
+        if cached is not None:
+            return cached
         # the aggregation fn is a module-level singleton so the compiled
         # program is shared across calls/fits (collectives program cache)
         agg = dataset.tree_aggregate_fn(_get_moments_fn(), auto_psum=False)
-        return _finalize(agg(), dataset)
+        out = _finalize(agg(), dataset)
+        dataset._summary_cache = out
+        return out
 
     @staticmethod
     def mean_std(dataset: InstanceDataset):

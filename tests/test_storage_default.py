@@ -22,26 +22,34 @@ def _frame(ctx, seed, n=1500, d=48):
 
 
 def test_fit_under_tight_budget_demotes_cold_dataset(ctx):
-    """An LR fit whose standardized blocks exceed the device budget demotes
+    """An LR fit whose training blocks exceed the device budget demotes
     the COLD cached dataset (LRU, unshared) — not its own blocks — and
-    still converges to the unbudgeted solution."""
+    still converges to the unbudgeted solution. (The binomial fit trains
+    on the frame blocks directly — standardization folds into the
+    aggregator read — so the pressure IS the hot frame's registration.)"""
     mgr = ctx.storage
     cold = _frame(ctx, 31)
     cold_ds = cold.to_instance_dataset("features", "label", None)
     assert mgr.level_of(cold_ds) == StorageLevel.DEVICE
 
     hot = _frame(ctx, 32)
-    # unbudgeted oracle (also caches hot's device blocks)
+    # unbudgeted oracle on a THROWAWAY equal frame so `hot` stays cold
     oracle = LogisticRegression(maxIter=60, regParam=0.05,
-                                tol=1e-10).fit(hot)
+                                tol=1e-10).fit(_frame(ctx, 32))
 
     old_budget = mgr.device_budget
-    # room for the hot frame + its std copy, NOT for the cold dataset too
-    hot_ds = hot.to_instance_dataset("features", "label", None)
-    mgr.device_budget = 2 * hot_ds.padded_bytes() + cold_ds.padded_bytes() // 2
+    # room for the hot training blocks, NOT for the cold dataset too
+    probe = _frame(ctx, 32).to_instance_dataset("features", "label", None)
+    hot_bytes = probe.padded_bytes()
+    mgr.unpersist(probe)
+    mgr.device_budget = hot_bytes + cold_ds.padded_bytes() // 2
+    hot_ds = None
     try:
+        # the fit's frame registration lands mid-run and squeezes the
+        # cold dataset off the device
         model = LogisticRegression(maxIter=60, regParam=0.05,
                                    tol=1e-10).fit(hot)
+        hot_ds = hot.to_instance_dataset("features", "label", None)
         # the cold dataset was demoted off the device MID-RUN
         assert mgr.level_of(cold_ds) in (StorageLevel.HOST,
                                          StorageLevel.DISK)
@@ -55,7 +63,8 @@ def test_fit_under_tight_budget_demotes_cold_dataset(ctx):
     finally:
         mgr.device_budget = old_budget
         mgr.unpersist(cold_ds)
-        mgr.unpersist(hot_ds)
+        if hot_ds is not None:
+            mgr.unpersist(hot_ds)
 
 
 def test_shared_array_datasets_are_not_eviction_candidates(ctx):
