@@ -13,6 +13,26 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 
+def _narrow_object(out: np.ndarray) -> np.ndarray:
+    """Cast an object array to a numeric dtype when every non-null element
+    is numeric (None → NaN); ints stay int64 when no nulls, anything mixed
+    or stringy keeps object — the dtype-restoring step for values that
+    round-tripped through tuples/lists."""
+    vals = [x for x in out if x is not None]
+    if not vals:
+        return out
+    if all(isinstance(x, (bool, np.bool_)) for x in vals):
+        return out.astype(bool) if len(vals) == len(out) else out
+    if all(isinstance(x, (int, np.integer)) for x in vals):
+        if len(vals) == len(out):
+            return out.astype(np.int64)
+        return np.array([np.nan if x is None else float(x) for x in out])
+    if all(isinstance(x, (int, float, np.integer, np.floating))
+           for x in vals):
+        return np.array([np.nan if x is None else float(x) for x in out])
+    return out
+
+
 def _is_null(arr: np.ndarray) -> np.ndarray:
     if arr.dtype.kind == "f":
         return np.isnan(arr)
@@ -182,6 +202,33 @@ class CycloneSeries:
 
     def to_list(self) -> list:
         return self.values.tolist()
+
+    def unstack(self) -> "CycloneFrame":
+        """Series with a tuple (MultiIndex) index → frame: the LAST index
+        level becomes the columns (ref pandas Series.unstack; NaN where a
+        (row, column) pair is absent, ValueError on duplicate pairs)."""
+        idx = self.index
+        if not (len(idx) and isinstance(idx[0], tuple)):
+            raise ValueError("unstack needs a MultiIndex (tuple labels)")
+        if len(set(idx)) != len(idx):
+            raise ValueError(
+                "Index contains duplicate entries, cannot reshape")
+        rows = sorted({t[:-1] for t in idx})
+        cols = sorted({t[-1] for t in idx})
+        data = {c: np.full(len(rows), np.nan) for c in cols}
+        rpos = {r: i for i, r in enumerate(rows)}
+        for t, v in zip(idx, self.values):
+            data[t[-1]][rpos[t[:-1]]] = v
+        out = CycloneFrame(data)
+        row_labels = [r[0] if len(r) == 1 else r for r in rows]
+        out._index = np.array(row_labels, dtype=object)
+        names = getattr(self, "index_name", None)
+        if isinstance(names, list) and len(names) == len(idx[0]):
+            rest = names[:-1]
+            out._index_name = rest[0] if len(rest) == 1 else rest
+        else:
+            out._index_name = "index"
+        return out
 
     def __repr__(self):
         return f"CycloneSeries({self.name!r}, {self.values!r})"
@@ -390,6 +437,17 @@ class _LocIndexer:
     def __getitem__(self, key):
         f = self._f
         idx = f.index
+        if (isinstance(f._index_name, list) and isinstance(key, tuple)
+                and len(key) == len(f._index_name)):
+            # MultiIndex label lookup: a full tuple addresses one label
+            # (takes precedence over the (rows, cols) reading, as pandas';
+            # no match falls THROUGH so loc[(label_tuple), col] still works)
+            pos = np.array([i for i, t in enumerate(idx) if t == key],
+                           dtype=np.int64)
+            if len(pos) == 1:
+                return {c: f._cols[c][pos[0]] for c in f.columns}
+            if len(pos):
+                return f._take(pos)
         if isinstance(key, tuple) and len(key) == 2:
             rows, cols = key
             sub = self[rows]
@@ -437,10 +495,15 @@ class _LocIndexer:
             return f._take(np.arange(lo, hi + 1))
         if isinstance(key, (list, np.ndarray)):
             # every row matching each label, label order outer (pandas
-            # duplicate-label semantics)
+            # duplicate-label semantics). Tuple labels (MultiIndex) compare
+            # elementwise — numpy would broadcast a tuple against the index
             pos = []
             for k in key:
-                hits = np.nonzero(idx == k)[0]
+                if isinstance(k, tuple):
+                    hits = np.array([i for i, t in enumerate(idx) if t == k],
+                                    dtype=np.int64)
+                else:
+                    hits = np.nonzero(idx == k)[0]
                 if not len(hits):
                     raise KeyError(k)
                 pos.extend(hits)
@@ -519,6 +582,38 @@ class _GroupBy:
         rest = [c for c in self._frame.columns if c not in self._keys]
         return self._agg({c: "count" for c in rest}, suffix=False)
 
+    def apply(self, func) -> Union["CycloneSeries", "CycloneFrame"]:
+        """(ref pandas groupby.apply / pyspark.pandas groupby.py apply):
+        call ``func`` on each group's sub-frame, groups in sorted key
+        order. Scalar results → a Series indexed by group key; Series
+        results → a frame (one row per group, index = group key)."""
+        f = self._frame
+        key_tuples = list(zip(*[f._cols[k] for k in self._keys]))
+        order = {}
+        for i, t in enumerate(key_tuples):
+            order.setdefault(t, []).append(i)
+        results = []
+        labels = []
+        for t in sorted(order):
+            pos = np.asarray(order[t], dtype=np.int64)
+            sub = f._take(pos)
+            results.append(func(sub))
+            labels.append(t[0] if len(self._keys) == 1 else t)
+        label_arr = np.array(labels, dtype=object)
+        name = (self._keys[0] if len(self._keys) == 1
+                else list(self._keys))
+        if all(isinstance(r, CycloneSeries) for r in results):
+            cols = list(results[0].index)
+            out = CycloneFrame({c: _narrow_object(np.array(
+                [r.values[list(r.index).index(c)] for r in results],
+                dtype=object)) for c in cols})
+            out._index = label_arr
+            out._index_name = name
+            return out
+        out_s = CycloneSeries(_narrow_object(np.array(results, dtype=object)),
+                              None, index=label_arr)
+        return out_s
+
 
 class CycloneFrame:
     """2-D table (ref: pyspark/pandas/frame.py)."""
@@ -551,19 +646,36 @@ class CycloneFrame:
         return (np.arange(len(self)) if self._index is None
                 else self._index)
 
-    def set_index(self, col: str) -> "CycloneFrame":
-        """(ref pandas set_index) — the column becomes the row-label index
-        and leaves the data columns."""
+    def set_index(self, col) -> "CycloneFrame":
+        """(ref pandas set_index) — the column(s) become the row-label
+        index and leave the data columns. A LIST of columns builds a
+        MultiIndex analog: the index holds per-row label TUPLES and the
+        index name is the level-name list (ref pyspark/pandas/indexes/
+        multi.py — tuple-labelled rows over the same frame machinery)."""
+        cols = [col] if isinstance(col, str) else list(col)
         out = CycloneFrame({k: v for k, v in self._cols.items()
-                            if k != col})
-        out._index = np.asarray(self._cols[col])
-        out._index_name = col
+                            if k not in cols})
+        if len(cols) == 1:
+            out._index = np.asarray(self._cols[cols[0]])
+            out._index_name = cols[0]
+        else:
+            idx = np.empty(len(self), dtype=object)
+            for i in range(len(self)):
+                idx[i] = tuple(self._cols[c][i] for c in cols)
+            out._index = idx
+            out._index_name = list(cols)
         return out
 
     def reset_index(self, drop: bool = False) -> "CycloneFrame":
         cols: Dict[str, Any] = {}
         if not drop and self._index is not None:
-            cols[self._index_name] = self._index
+            if isinstance(self._index_name, list):
+                # MultiIndex: expand the label tuples back into columns
+                for j, nm in enumerate(self._index_name):
+                    cols[nm] = _narrow_object(np.array(
+                        [t[j] for t in self._index], dtype=object))
+            else:
+                cols[self._index_name] = self._index
         cols.update(self._cols)
         return CycloneFrame(cols)
 
@@ -616,8 +728,11 @@ class CycloneFrame:
 
     # -- selection -------------------------------------------------------------
     def __getitem__(self, key):
-        if isinstance(key, str):
-            return CycloneSeries(self._cols[key], key, index=self._index)
+        if isinstance(key, str) or (np.isscalar(key) and key in self._cols):
+            # (scalar non-string column labels come from unstack's levels)
+            s = CycloneSeries(self._cols[key], key, index=self._index)
+            s.index_name = self._index_name  # unstack needs the level names
+            return s
         if isinstance(key, list):
             return self._like({k: self._cols[k] for k in key})
         if isinstance(key, CycloneSeries):  # boolean mask
@@ -700,13 +815,54 @@ class CycloneFrame:
         return self._take(np.nonzero(keep)[0])
 
     # -- combine ---------------------------------------------------------------
-    def merge(self, other: "CycloneFrame", on, how: str = "inner"
+    def merge(self, other: "CycloneFrame", on, how: str = "inner",
+              validate: Optional[str] = None, indicator: bool = False
               ) -> "CycloneFrame":
         from cycloneml_tpu.sql.session import CycloneSession
+        keys = [on] if isinstance(on, str) else list(on)
+        if validate is not None:
+            # (ref pandas merge validate=): check key uniqueness per side
+            # BEFORE joining; MergeError semantics via ValueError
+            v = {"one_to_one": "1:1", "one_to_many": "1:m",
+                 "many_to_one": "m:1", "many_to_many": "m:m"}.get(
+                     validate, validate)
+            if v not in ("1:1", "1:m", "m:1", "m:m"):
+                raise ValueError(f"not a valid argument for validate: "
+                                 f"{validate!r}")
+
+            def _unique(frame):
+                seen = set()
+                for t in zip(*[frame._cols[k] for k in keys]):
+                    if t in seen:
+                        return False
+                    seen.add(t)
+                return True
+            if v in ("1:1", "1:m") and not _unique(self):
+                raise ValueError(
+                    "Merge keys are not unique in left dataset; not a "
+                    f"{validate} merge")
+            if v in ("1:1", "m:1") and not _unique(other):
+                raise ValueError(
+                    "Merge keys are not unique in right dataset; not a "
+                    f"{validate} merge")
         s = CycloneSession()
-        left = s.create_data_frame(dict(self._cols))
-        right = s.create_data_frame(dict(other._cols))
-        return CycloneFrame(left.join(right, on=on, how=how).to_dict())
+        lcols = dict(self._cols)
+        rcols = dict(other._cols)
+        if indicator:
+            # provenance markers ride the join; NaN-ness afterwards says
+            # which side produced each row (ref pandas indicator=True)
+            lcols["__cyclone_lm"] = np.ones(len(self))
+            rcols["__cyclone_rm"] = np.ones(len(other))
+        left = s.create_data_frame(lcols)
+        right = s.create_data_frame(rcols)
+        out = left.join(right, on=on, how=how).to_dict()
+        if indicator:
+            lm = np.asarray(out.pop("__cyclone_lm"), dtype=np.float64)
+            rm = np.asarray(out.pop("__cyclone_rm"), dtype=np.float64)
+            out["_merge"] = np.where(
+                np.isnan(lm), "right_only",
+                np.where(np.isnan(rm), "left_only", "both")).astype(object)
+        return CycloneFrame(out)
 
     def groupby(self, by) -> _GroupBy:
         return _GroupBy(self, [by] if isinstance(by, str) else list(by))
@@ -744,7 +900,11 @@ class CycloneFrame:
         import pandas as pd
         pdf = pd.DataFrame({k: v for k, v in self._cols.items()})
         if self._index is not None:
-            pdf.index = pd.Index(self._index, name=self._index_name)
+            if isinstance(self._index_name, list):
+                pdf.index = pd.MultiIndex.from_tuples(
+                    list(self._index), names=self._index_name)
+            else:
+                pdf.index = pd.Index(self._index, name=self._index_name)
         return pdf
 
     @classmethod
@@ -809,10 +969,15 @@ def concat(frames: Sequence[CycloneFrame], axis: int = 0,
 
 
 def pivot_table(frame: CycloneFrame, values: str, index: str, columns: str,
-                aggfunc: str = "mean") -> CycloneFrame:
+                aggfunc: str = "mean", margins: bool = False,
+                margins_name: str = "All") -> CycloneFrame:
     """(ref pandas pivot_table / pyspark/pandas/frame.py pivot_table) — one
     output row per distinct ``index`` value, one column per distinct
-    ``columns`` value, cells aggregated with ``aggfunc``."""
+    ``columns`` value, cells aggregated with ``aggfunc``.
+
+    ``margins=True`` appends an ``All`` column (per-row aggregate over the
+    raw records) and an ``All`` row (per-column aggregate), aggregated
+    over the UNDERLYING rows — not over cell results — matching pandas."""
     if aggfunc not in ("mean", "sum", "min", "max", "count"):
         raise ValueError(f"unsupported aggfunc {aggfunc!r}")
     iv = np.asarray(frame._cols[index])
@@ -842,10 +1007,35 @@ def pivot_table(frame: CycloneFrame, values: str, index: str, columns: str,
         (np.minimum if aggfunc == "min" else np.maximum).at(cell, flat, vv)
         cell = np.where(counts > 0, cell, np.nan)
     grid = cell.reshape(len(rows), len(cols))
+
+    def _agg_flat(v, codes, n):
+        cnt = np.bincount(codes, minlength=n).astype(np.float64)
+        if aggfunc == "count":
+            return np.where(cnt > 0, cnt, np.nan)
+        if aggfunc in ("mean", "sum"):
+            s = np.bincount(codes, weights=v, minlength=n)
+            if aggfunc == "sum":
+                return np.where(cnt > 0, s, np.nan)
+            return np.divide(s, cnt, out=np.full(n, np.nan), where=cnt > 0)
+        m = np.full(n, np.inf if aggfunc == "min" else -np.inf)
+        (np.minimum if aggfunc == "min" else np.maximum).at(m, codes, v)
+        return np.where(cnt > 0, m, np.nan)
+
+    out_cols = {str(c): grid[:, j] for j, c in enumerate(cols)}
+    out_rows = rows
+    if margins:
+        row_all = _agg_flat(vv, r_code[ok], len(rows))   # All column
+        col_all = _agg_flat(vv, c_code[ok], len(cols))   # All row
+        grand = _agg_flat(vv, np.zeros(len(vv), np.int64), 1)[0]
+        out_cols = {k: np.concatenate([v, [col_all[j]]])
+                    for j, (k, v) in enumerate(out_cols.items())}
+        out_cols[margins_name] = np.concatenate([row_all, [grand]])
+        out_rows = np.concatenate([rows.astype(object),
+                                   np.array([margins_name], object)])
     # the index is attached directly — building it as a data column could
     # collide with a pivot column that stringifies to the same name
-    res = CycloneFrame({str(c): grid[:, j] for j, c in enumerate(cols)})
-    res._index = rows
+    res = CycloneFrame(out_cols)
+    res._index = out_rows
     res._index_name = index
     return res
 
