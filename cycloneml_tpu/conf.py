@@ -344,6 +344,32 @@ SHUFFLE_SPILL_ROW_BUDGET = (
     .int_conf(1 << 20)
 )
 
+ADAPTIVE_ENABLED = (
+    ConfigBuilder("cyclone.sql.adaptive.enabled")
+    .doc("Adaptive query execution over the exchange fabric: runtime size "
+         "statistics pick broadcast joins and coalesce small shuffle "
+         "output partitions (ref AdaptiveSparkPlanExec).")
+    .bool_conf(True)
+)
+
+AUTO_BROADCAST_JOIN_THRESHOLD = (
+    ConfigBuilder("cyclone.sql.autoBroadcastJoinThreshold")
+    .doc("Max bytes for a join side to be broadcast to every process "
+         "instead of hash-exchanging both sides (Spark's conf name and "
+         "10 MB default; -1 disables).")
+    .int_conf(10 * 1024 * 1024)
+)
+
+ADVISORY_PARTITION_ROWS = (
+    ConfigBuilder("cyclone.sql.adaptive.advisoryPartitionRows")
+    .doc("Post-shuffle coalescing target: adjacent owned output "
+         "partitions smaller than this merge until they reach it (≈ "
+         "CoalesceShufflePartitions' advisoryPartitionSizeInBytes, in "
+         "rows for the host object tier).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(1 << 16)
+)
+
 STORAGE_DEVICE_BUDGET = (
     ConfigBuilder("cyclone.storage.deviceBudget")
     .doc("Byte budget for DEVICE-tier managed datasets (context-owned "

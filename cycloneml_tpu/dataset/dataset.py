@@ -121,16 +121,25 @@ class PartitionedDataset:
     def _derive(self, fn: Callable[[List[List[Any]]], List[List[Any]]],
                 name: str, num_partitions: Optional[int] = None) -> "PartitionedDataset":
         parent = self
+        box: List["PartitionedDataset"] = []
 
         def compute():
-            return fn(parent._partitions())
+            parts = fn(parent._partitions())
+            # partition-count metadata follows what fn actually produced
+            # (AQE coalescing and exchange ownership decide counts at
+            # materialization, not at derive time)
+            if box:
+                box[0].num_partitions = len(parts)
+            return parts
 
         # `is None`, not falsy-or: a rank owning ZERO exchange buckets
         # legitimately derives a 0-partition dataset
-        return PartitionedDataset(
+        ds = PartitionedDataset(
             self.ctx, compute,
             self.num_partitions if num_partitions is None else num_partitions,
             name)
+        box.append(ds)
+        return ds
 
     def map(self, f: Callable) -> "PartitionedDataset":
         return self._derive(lambda ps: [[f(x) for x in p] for p in ps], "map")
@@ -199,11 +208,17 @@ class PartitionedDataset:
 
             n_owned = sum(1 for b in range(n_buckets)
                           if b % len(addresses) == rank)
+            from cycloneml_tpu.conf import (ADAPTIVE_ENABLED,
+                                            ADVISORY_PARTITION_ROWS)
+            advisory = (self.ctx.conf.get(ADVISORY_PARTITION_ROWS)
+                        if self.ctx.conf.get(ADAPTIVE_ENABLED) else None)
 
             def fn(ps):
+                # _derive syncs num_partitions to whatever this returns,
+                # so the AQE-coalesced count is never misreported
                 return exchange_group_partitions(
                     (kv for p in ps for kv in p), rank, addresses,
-                    n_buckets, row_budget=budget)
+                    n_buckets, row_budget=budget, advisory_rows=advisory)
             return self._derive(fn, "groupByKey(exchange)", n_owned)
 
         def fn(ps):

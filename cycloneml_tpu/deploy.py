@@ -15,8 +15,9 @@ Protocol: JSON lines over TCP. Worker -> Master: ``register``,
 Client -> Master: ``submit``, ``status``. Master state (registered
 workers, app history) persists to a JSON file so a restarted Master
 recovers its cluster view (the recovery-file analog of
-``FileSystemPersistenceEngine``; leader election / ZooKeeper HA stays out
-of scope, as PARITY documents).
+``FileSystemPersistenceEngine``), and HA mode runs multiple masters
+contending for a file-lock leadership (the ZooKeeperLeaderElectionAgent
+analog) with worker/client failover across the master group.
 """
 
 from __future__ import annotations
@@ -73,11 +74,32 @@ def _probe_free_ports(n: int) -> List[int]:
 
 
 class MasterDaemon:
-    """Cluster manager: registration, liveness, app scheduling, status."""
+    """Cluster manager: registration, liveness, app scheduling, status.
+
+    HA mode (``ha_dir``): multiple masters contend for a file lock (the
+    ZooKeeperLeaderElectionAgent analog — ref deploy/master/
+    ZooKeeperLeaderElectionAgent.scala + FileSystemPersistenceEngine); the
+    lock holder is LEADER and serves requests, standbys answer every
+    request with a retryable ``not-leader`` error while waiting on the
+    lock. A dead leader's lock releases with its process/close, the
+    acquiring standby loads the shared recovery file, and workers fail
+    over to it (their poll rotation + re-registration)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 state_path: Optional[str] = None):
+                 state_path: Optional[str] = None,
+                 ha_dir: Optional[str] = None):
         self._lock = threading.Lock()
+        self._ha_dir = ha_dir
+        self._lock_fh = None
+        self._leader = ha_dir is None  # non-HA masters lead unconditionally
+        if ha_dir is not None:
+            os.makedirs(ha_dir, exist_ok=True)
+            state_path = os.path.join(ha_dir, "master-state.json")
+            self._lock_fh = open(os.path.join(ha_dir, "leader.lock"), "a+")
+            self._try_acquire_leadership()
+            self._elector = threading.Thread(
+                target=self._election_loop, daemon=True,
+                name="cyclone-master-elector")
         self._workers: Dict[str, dict] = {}   # id -> {addr?, last_seen, ...}
         self._apps: Dict[str, dict] = {}      # id -> {state, assignments...}
         self._launches: Dict[str, List[dict]] = {}  # worker id -> queue
@@ -106,7 +128,39 @@ class MasterDaemon:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="cyclone-master")
         self._thread.start()
-        logger.info("cyclone master listening on %s", self.address)
+        if self._ha_dir is not None:
+            self._elector.start()
+        logger.info("cyclone master listening on %s (leader=%s)",
+                    self.address, self._leader)
+
+    # -- HA leader election (file-lock ZooKeeper analog) -------------------
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def _try_acquire_leadership(self) -> None:
+        import fcntl
+        try:
+            fcntl.flock(self._lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            self._leader = True
+        except OSError:
+            self._leader = False
+
+    def _election_loop(self) -> None:
+        import fcntl
+        while not self._leader and not getattr(self, "_stopped", False):
+            try:
+                fcntl.flock(self._lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                time.sleep(0.2)
+                continue
+            with self._lock:
+                # recover the dead leader's cluster view from the shared
+                # recovery file BEFORE serving (ref Master.scala
+                # ElectedLeader -> beginRecovery)
+                self._load_state()
+                self._leader = True
+            logger.info("master %s elected leader", self.address)
 
     # -- persistence (FileSystemPersistenceEngine analog) ------------------
     def _load_state(self) -> None:
@@ -128,8 +182,8 @@ class MasterDaemon:
                     a["reason"] = "master restarted mid-run"
 
     def _save_state(self) -> None:
-        if not self._state_path:
-            return
+        if not self._state_path or not self._leader:
+            return  # a deposed/stopping master must not clobber the file
         tmp = self._state_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump({"workers": self._workers, "apps": self._apps}, fh)
@@ -138,6 +192,10 @@ class MasterDaemon:
     # -- protocol -----------------------------------------------------------
     def _dispatch(self, msg: dict) -> dict:
         kind = msg.get("kind")
+        if not self._leader:
+            # standby: every caller (worker poll rotation, HA-aware
+            # clients) treats this as "try the next master"
+            return {"ok": False, "error": "not-leader", "retryable": True}
         with self._lock:
             if kind == "register":
                 wid = msg["worker_id"]
@@ -277,8 +335,19 @@ class MasterDaemon:
         return {"ok": True, "app_id": app_id, "workers": chosen}
 
     def stop(self) -> None:
+        # order matters for split-brain safety: drop leadership FIRST (so
+        # in-flight handlers stop persisting — _save_state is
+        # leader-guarded), stop serving, and only then release the flock
+        # the next leader is waiting on
+        self._stopped = True
+        self._leader = False
         self._server.shutdown()
         self._server.server_close()
+        if self._lock_fh is not None:
+            try:
+                self._lock_fh.close()  # releases the leader flock
+            except OSError:
+                pass
 
 
 class WorkerDaemon:
@@ -289,7 +358,12 @@ class WorkerDaemon:
     def __init__(self, master_addr: str, worker_id: Optional[str] = None,
                  cores: int = 1, poll_interval_s: float = 0.2,
                  host: str = "127.0.0.1"):
-        self.master = master_addr
+        # comma-separated list = HA master group: the worker rotates to the
+        # next address when the current one is unreachable or answers
+        # not-leader (ref Worker.scala MasterChanged handling)
+        self.masters = [a.strip() for a in master_addr.split(",")
+                        if a.strip()]
+        self._mi = 0
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self.cores = cores
         self.host = host
@@ -303,14 +377,33 @@ class WorkerDaemon:
                                         name=f"cyclone-{self.worker_id}")
         self._thread.start()
 
+    @property
+    def master(self) -> str:
+        return self.masters[self._mi % len(self.masters)]
+
+    def _ask(self, msg: dict) -> dict:
+        """Send to the current master, failing over through the HA group:
+        an unreachable or standby (not-leader) master rotates to the next
+        address and re-registers there."""
+        for _ in range(len(self.masters)):
+            try:
+                rep = _send(self.master, msg)
+            except OSError:
+                self._mi += 1
+                continue
+            if not rep.get("ok") and rep.get("error") == "not-leader":
+                self._mi += 1
+                continue
+            return rep
+        return {"ok": False, "error": "no leader reachable"}
+
     def _register(self) -> None:
         # coordinator ports are probed HERE (where a proc-0 coordinator
         # would bind) so the master never guesses ports on a remote host
-        rep = _send(self.master, {"kind": "register",
-                                  "worker_id": self.worker_id,
-                                  "host": self.host, "cores": self.cores,
-                                  "coord_ports":
-                                      _probe_free_ports(COORD_PORT_POOL)})
+        rep = self._ask({"kind": "register",
+                         "worker_id": self.worker_id,
+                         "host": self.host, "cores": self.cores,
+                         "coord_ports": _probe_free_ports(COORD_PORT_POOL)})
         if not rep.get("ok"):
             raise RuntimeError(f"registration failed: {rep}")
 
@@ -318,9 +411,9 @@ class WorkerDaemon:
         top_up: List[int] = []
         while not self._stop.is_set():
             try:
-                rep = _send(self.master, {"kind": "poll",
-                                          "worker_id": self.worker_id,
-                                          "coord_ports": top_up})
+                rep = self._ask({"kind": "poll",
+                                 "worker_id": self.worker_id,
+                                 "coord_ports": top_up})
                 top_up = []
                 if not rep.get("ok") and rep.get("error") == "unregistered":
                     # a restarted master forgot us — re-register (the
@@ -381,7 +474,7 @@ class WorkerDaemon:
             if not live:
                 self._procs.pop(launch["app_id"], None)
         try:
-            _send(self.master, {
+            self._ask({
                 "kind": "app_update", "app_id": launch["app_id"],
                 "proc_id": launch["proc_id"],
                 "state": "FINISHED" if code == 0 else "FAILED",
@@ -398,6 +491,27 @@ class WorkerDaemon:
                 p.terminate()
 
 
+def _send_ha(master_addr: str, msg: dict) -> dict:
+    """Client-side send across a comma-separated HA master group: skip
+    unreachable and standby (not-leader) masters."""
+    addrs = [a.strip() for a in master_addr.split(",") if a.strip()]
+    last: dict = {"ok": False, "error": "no master address"}
+    for a in addrs:
+        try:
+            rep = _send(a, msg)
+        except OSError as e:
+            # unreachable during an election is as transient as a standby
+            # reply — callers must retry either way (review r4: a plain
+            # error here made retry behavior depend on address order)
+            last = {"ok": False, "error": repr(e), "retryable": True}
+            continue
+        if not rep.get("ok") and rep.get("error") == "not-leader":
+            last = rep
+            continue
+        return rep
+    return last
+
+
 def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
                args: Optional[List[str]] = None,
                env: Optional[Dict[str, str]] = None,
@@ -405,11 +519,12 @@ def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
     """Client-side submit (ref deploy/Client.scala): returns the app id.
 
     Retryable rejections (a remote worker's probed-port pool momentarily
-    drained) are retried here so callers see them only when persistent."""
+    drained, an HA election in progress) are retried here so callers see
+    them only when persistent."""
     for attempt in range(retries + 1):
-        rep = _send(master_addr, {"kind": "submit", "app_path": app_path,
-                                  "n_procs": n_procs, "args": args or [],
-                                  "env": env or {}})
+        rep = _send_ha(master_addr, {"kind": "submit", "app_path": app_path,
+                                     "n_procs": n_procs, "args": args or [],
+                                     "env": env or {}})
         if rep.get("ok"):
             return rep["app_id"]
         if not rep.get("retryable") or attempt == retries:
@@ -419,7 +534,11 @@ def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
 
 
 def app_status(master_addr: str, app_id: Optional[str] = None) -> dict:
-    st = _send(master_addr, {"kind": "status"})
+    st = _send_ha(master_addr, {"kind": "status"})
+    if not st.get("ok", True):
+        # election in progress / no leader: surface a typed error the
+        # wait loop can ride out instead of a KeyError
+        raise IOError(f"no reachable leader: {st.get('error')}")
     if app_id is not None:
         return st["apps"].get(app_id, {"state": "UNKNOWN"})
     return st
@@ -429,7 +548,11 @@ def wait_for_app(master_addr: str, app_id: str,
                  timeout_s: float = 300.0) -> str:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        state = app_status(master_addr, app_id)["state"]
+        try:
+            state = app_status(master_addr, app_id)["state"]
+        except (IOError, OSError):
+            time.sleep(0.2)  # HA election window: keep waiting
+            continue
         if state in ("FINISHED", "FAILED"):
             return state
         time.sleep(0.2)

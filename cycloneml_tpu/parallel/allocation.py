@@ -1,0 +1,97 @@
+"""Dynamic allocation — the ExecutorAllocationManager analog.
+
+Ref: core/.../ExecutorAllocationManager.scala:100. The reference grows and
+shrinks its executor fleet against pending task backlog; on a TPU slice
+the resource pool is the DEVICE set, so the elastic dimension here is the
+MESH: after a failure-driven downsize (``rebuild_mesh`` onto fewer
+devices — SURVEY §5.3 recovery), this manager watches the platform's
+visible device count and SCALES THE MESH BACK UP when capacity returns
+(a restored chip/host makes ``jax.devices()`` exceed the mesh in use).
+
+Scale-up tears down compiled state the same way downsizing does, so it
+never fires mid-training silently: the manager emits a ``MeshUp`` event
+through the rebuilt context and invokes ``on_scale`` so the driver can
+restore datasets from host copies / checkpoints and resume from the last
+optimizer checkpoint — the same recovery contract as the downsize path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ExecutorAllocationManager:
+    """Polls device availability; scales the mesh up when capacity exceeds
+    the mesh currently in use for ``stable_checks`` consecutive polls.
+
+    ``auto=True`` performs the rebuild itself (then calls ``on_scale``
+    with the new runtime); ``auto=False`` only calls ``on_scale`` with the
+    available count, leaving the rebuild to the driver (the reference's
+    advisory-vs-enforced split between allocation manager and backend).
+    """
+
+    def __init__(self, ctx, poll_interval_s: float = 1.0,
+                 stable_checks: int = 2, auto: bool = True,
+                 on_scale: Optional[Callable] = None):
+        self.ctx = ctx
+        self.poll_interval_s = poll_interval_s
+        self.stable_checks = max(1, stable_checks)
+        self.auto = auto
+        self.on_scale = on_scale
+        self._stop = threading.Event()
+        self._streak = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cyclone-allocation")
+        self._thread.start()
+
+    @staticmethod
+    def _available() -> int:
+        import jax
+        return len(jax.devices())
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                avail = self._available()
+                used = self.ctx.mesh_runtime.n_devices
+                if avail > used:
+                    self._streak += 1
+                    if self._streak >= self.stable_checks:
+                        if getattr(self.ctx, "_job_stack", None):
+                            # a job (fit/transform bracketed by run_job) is
+                            # in flight: rebuilding now would tear the mesh
+                            # out from under its compiled step — defer to
+                            # the next poll (the reference's allocation
+                            # manager likewise won't kill busy executors)
+                            logger.info(
+                                "allocation: scale-up deferred, job active")
+                        else:
+                            self._scale_up(avail)
+                            self._streak = 0
+                else:
+                    self._streak = 0
+            except Exception:
+                logger.exception("allocation poll failed")
+            self._stop.wait(self.poll_interval_s)
+
+    def _scale_up(self, avail: int) -> None:
+        logger.info("allocation: %d devices available, mesh uses %d — "
+                    "scaling up", avail, self.ctx.mesh_runtime.n_devices)
+        if self.auto:
+            # rebuild onto the CONFIGURED master (conf cyclone.master):
+            # under multihost every process must re-form ONE coordinated
+            # mesh from its own conf, never a per-process local-mesh
+            rt = self.ctx.rebuild_mesh()
+            if self.on_scale is not None:
+                self.on_scale(rt)
+        elif self.on_scale is not None:
+            self.on_scale(avail)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -234,16 +234,21 @@ class HashExchange:
                          protocol=pickle.HIGHEST_PROTOCOL))
         self._sock(peer).sendall(struct.pack("<I", len(blob)) + blob)
 
-    def put(self, key: Any, value: Any) -> None:
-        bucket = stable_hash(key) % self.n_buckets
+    def put_to_bucket(self, bucket: int, key: Any, value: Any) -> None:
+        """Route a record to an EXPLICIT bucket (control-plane collectives
+        address peers directly: bucket i of n_workers buckets is worker
+        i's)."""
         peer = self._owner(bucket)
-        if peer == self.rank:  # loopback skips the wire
+        if peer == self.rank:
             self._state.store.append(bucket, [(key, value)])
             return
         buf = self._send_bufs.setdefault(peer, [])
         buf.append((bucket, (key, value)))
         if len(buf) >= _SEND_CHUNK:
             self._flush_peer(peer)
+
+    def put(self, key: Any, value: Any) -> None:
+        self.put_to_bucket(stable_hash(key) % self.n_buckets, key, value)
 
     def put_all(self, pairs: Iterable[Tuple[Any, Any]]) -> None:
         for k, v in pairs:
@@ -326,6 +331,28 @@ def active_exchange_group() -> Optional[Tuple[int, List[str], int]]:
     return rank, addresses, ctx.conf.get(EXCHANGE_NUM_BUCKETS)
 
 
+def exchange_allgather(value: Any, rank: int, addresses: List[str],
+                       timeout: float = 300.0) -> Dict[int, Any]:
+    """Control-plane allGather over the exchange fabric: every process's
+    ``value`` is delivered to every process; returns {rank: value}. The
+    tiny collective AQE runs BEFORE choosing an execution strategy (ref
+    AdaptiveSparkPlanExec reading materialized shuffle statistics)."""
+    n = len(addresses)
+    ex = HashExchange(rank, addresses, n_buckets=n)
+    for peer in range(n):
+        ex.put_to_bucket(peer, rank, value)
+    buckets = ex.finish(timeout=timeout)
+    out: Dict[int, Any] = {}
+    for part in buckets.values():
+        for sender, v in part:
+            out[int(sender)] = v
+        if hasattr(part, "delete"):
+            part.delete()
+    if len(out) != n:
+        raise IOError(f"allgather incomplete: got ranks {sorted(out)}")
+    return out
+
+
 def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
                           addresses: List[str], n_buckets: int,
                           row_budget: int = 1 << 20,
@@ -350,12 +377,20 @@ def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
 
 def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
                               addresses: List[str], n_buckets: int,
-                              row_budget: int = 1 << 20) -> List[Any]:
+                              row_budget: int = 1 << 20,
+                              advisory_rows: Optional[int] = None
+                              ) -> List[Any]:
     """Distributed groupByKey materialized as OUTPUT PARTITIONS (one per
     owned bucket) for the RDD surface: small buckets become lists, buckets
     whose value count exceeds ``row_budget`` become disk-backed
     :class:`SpilledPartition` sequences — the same output-spill contract as
-    the in-process ``group_by_key``."""
+    the in-process ``group_by_key``.
+
+    ``advisory_rows``: AQE post-shuffle coalescing (ref
+    CoalesceShufflePartitions): adjacent small LIST partitions merge until
+    they reach the advisory VALUE count, so a 64-bucket shuffle of a small
+    dataset does not fan downstream work over 64 near-empty partitions.
+    Disk-backed partitions never merge (they are big by definition)."""
     ex = HashExchange(rank, addresses, n_buckets)
     ex.put_all(pairs)
     buckets = ex.finish()
@@ -371,7 +406,26 @@ def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
         agg.insert_all(iter(part))
         part.delete()
         out.append(materialize_grouped(agg.items(), row_budget))
-    return out
+    if advisory_rows is None:
+        return out
+    coalesced: List[Any] = []
+    acc: List[Any] = []
+    acc_rows = 0
+    for p in out:
+        if isinstance(p, list):
+            acc.extend(p)
+            acc_rows += sum(len(v) for _, v in p)
+            if acc_rows >= advisory_rows:
+                coalesced.append(acc)
+                acc, acc_rows = [], 0
+        else:  # spilled partition: emit as-is, flushing the accumulator
+            if acc:
+                coalesced.append(acc)
+                acc, acc_rows = [], 0
+            coalesced.append(p)
+    if acc:
+        coalesced.append(acc)
+    return coalesced or [[]]
 
 
 def exchange_join(left: Iterable[Tuple[Any, Any]],

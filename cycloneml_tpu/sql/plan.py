@@ -26,6 +26,11 @@ from cycloneml_tpu.sql.column import (AggExpr, ColumnRef, Expr, SortOrder,
 
 Batch = Dict[str, np.ndarray]
 
+#: AQE observability: the strategy chosen for the most recently executed
+#: multihost join ("broadcast_left"/"broadcast_right"/"exchange"); None
+#: when no exchange group is active
+LAST_JOIN_STRATEGY: Optional[str] = None
+
 
 class LogicalPlan:
     children: List["LogicalPlan"] = []
@@ -559,23 +564,58 @@ class Join(LogicalPlan):
 
         from cycloneml_tpu.parallel.exchange import active_exchange_group
         group = active_exchange_group()
+        # observable (module-level: optimization rebuilds plan nodes, so a
+        # node attribute would vanish from the user's handle): which
+        # execution strategy AQE picked for the most recent join
+        global LAST_JOIN_STRATEGY
+        LAST_JOIN_STRATEGY = None
+        self._aqe_strategy = None
         if group is not None and self.how != "cross":
-            # multihost shuffled hash join: both sides ride ONE exchange
-            # round keyed on the join key, so every row of a key lands on
-            # its owner — the local factorize/probe below then computes any
-            # join type (incl. outer null-extension and semi/anti) exactly,
-            # per owned keyspace (ref ShuffledHashJoinExec.scala:39).
             lnames = [k for k in lb if k != "__len__"]
             rnames = [k for k in rb if k != "__len__"]
-            lkeys = _key_tuples([lb[l] for l, _ in self.on], nl)
-            rkeys = _key_tuples([rb[r] for _, r in self.on], nr)
-            lrows = _rows_of(lb, lnames, nl)
-            rrows = _rows_of(rb, rnames, nr)
-            lowned, rowned = _exchange_keyed_rows(
-                [(lkeys, lrows), (rkeys, rrows)], group)
-            lb = _batch_of(lowned, lnames, lb)
-            rb = _batch_of(rowned, rnames, rb)
-            nl, nr = len(lowned), len(rowned)
+            side = self._adaptive_broadcast_side(lb, rb, nl, nr, group)
+            if side is not None:
+                # AQE broadcast-hash join (ref AdaptiveSparkPlanExec +
+                # DynamicJoinSelection): runtime size statistics chose to
+                # ship the SMALL side everywhere and keep the big side
+                # local — no exchange of the big side at all. Valid only
+                # for join types where the broadcast side never emits
+                # unmatched rows (they would duplicate across processes).
+                from cycloneml_tpu.parallel.exchange import \
+                    exchange_allgather
+                rank, addresses, _ = group
+                if side == "right":
+                    rows = exchange_allgather(
+                        _rows_of(rb, rnames, nr), rank, addresses)
+                    merged = [r for k in sorted(rows) for r in rows[k]]
+                    rb = _batch_of(merged, rnames, rb)
+                    nr = len(merged)
+                else:
+                    rows = exchange_allgather(
+                        _rows_of(lb, lnames, nl), rank, addresses)
+                    merged = [r for k in sorted(rows) for r in rows[k]]
+                    lb = _batch_of(merged, lnames, lb)
+                    nl = len(merged)
+                self._aqe_strategy = f"broadcast_{side}"
+                LAST_JOIN_STRATEGY = self._aqe_strategy
+            else:
+                # multihost shuffled hash join: both sides ride ONE
+                # exchange round keyed on the join key, so every row of a
+                # key lands on its owner — the local factorize/probe below
+                # then computes any join type (incl. outer null-extension
+                # and semi/anti) exactly, per owned keyspace
+                # (ref ShuffledHashJoinExec.scala:39).
+                lkeys = _key_tuples([lb[l] for l, _ in self.on], nl)
+                rkeys = _key_tuples([rb[r] for _, r in self.on], nr)
+                lrows = _rows_of(lb, lnames, nl)
+                rrows = _rows_of(rb, rnames, nr)
+                lowned, rowned = _exchange_keyed_rows(
+                    [(lkeys, lrows), (rkeys, rrows)], group)
+                lb = _batch_of(lowned, lnames, lb)
+                rb = _batch_of(rowned, rnames, rb)
+                nl, nr = len(lowned), len(rowned)
+                self._aqe_strategy = "exchange"
+                LAST_JOIN_STRATEGY = "exchange"
         elif group is not None:
             raise NotImplementedError(
                 "cross join is not routed through the hash exchange (no "
@@ -616,6 +656,49 @@ class Join(LogicalPlan):
             matched_r[ri] = True
             r_unmatched = np.nonzero(~matched_r)[0]
         return self._emit(lb, rb, li, ri, l_unmatched, r_unmatched)
+
+    def _adaptive_broadcast_side(self, lb, rb, nl, nr, group):
+        """Pick a side to broadcast, or None for the shuffled join.
+
+        Eligibility by join type (the broadcast side must never emit
+        unmatched rows, which each process would duplicate): right side
+        for inner/left/left_semi/left_anti, left side for inner/right.
+        The decision uses GLOBAL runtime sizes (an allgather of local
+        batch bytes — the materialized-statistics read of
+        AdaptiveSparkPlanExec) against Spark's
+        autoBroadcastJoinThreshold."""
+        from cycloneml_tpu.conf import (ADAPTIVE_ENABLED,
+                                        AUTO_BROADCAST_JOIN_THRESHOLD)
+        from cycloneml_tpu.context import active_context
+        from cycloneml_tpu.parallel.exchange import exchange_allgather
+        ctx = active_context()
+        if ctx is None or not ctx.conf.get(ADAPTIVE_ENABLED):
+            return None
+        threshold = ctx.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+        if threshold < 0:
+            return None
+
+        def _bytes(batch, n):
+            total = 0
+            for k, v in batch.items():
+                if k == "__len__":
+                    continue
+                v = np.atleast_1d(np.asarray(v))
+                total += (v.nbytes if v.dtype != object
+                          else n * 48)  # rough object-row estimate
+            return total
+
+        rank, addresses, _ = group
+        sizes = exchange_allgather((_bytes(lb, nl), _bytes(rb, nr)),
+                                   rank, addresses)
+        tot_l = sum(v[0] for v in sizes.values())
+        tot_r = sum(v[1] for v in sizes.values())
+        if (self.how in ("inner", "left", "left_semi", "left_anti")
+                and tot_r <= threshold and tot_r <= tot_l):
+            return "right"
+        if self.how in ("inner", "right") and tot_l <= threshold:
+            return "left"
+        return None
 
     def _emit(self, lb, rb, li, ri, l_unmatched, r_unmatched):
         rkeys = {r for _, r in self.on}

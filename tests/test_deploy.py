@@ -280,3 +280,81 @@ def test_stale_pool_ports_aged_out(cluster):
         if not rep.get("ok"):
             break
     assert rep["ok"] is False and rep["retryable"] is True
+
+
+def test_ha_leader_election_failover(tmp_path):
+    """Two masters share an HA dir: the standby answers not-leader, takes
+    over when the leader dies (file lock released), recovers the shared
+    state, and the worker + clients fail over to it
+    (ZooKeeperLeaderElectionAgent analog)."""
+    from cycloneml_tpu.deploy import MasterDaemon, _send
+    ha = str(tmp_path / "ha")
+    m1 = MasterDaemon(port=0, ha_dir=ha)
+    m2 = MasterDaemon(port=0, ha_dir=ha)
+    assert m1.is_leader and not m2.is_leader
+    # standby refuses work with a retryable marker
+    rep = _send(m2.address, {"kind": "status"})
+    assert rep["ok"] is False and rep["error"] == "not-leader"
+
+    group = f"{m1.address},{m2.address}"
+    w = WorkerDaemon(group, worker_id="w-ha", poll_interval_s=0.1)
+    time.sleep(0.3)
+    assert app_status(group)["workers"]["w-ha"]["state"] == "ALIVE"
+
+    # leader dies -> standby acquires the lock, loads state, serves
+    m1.stop()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not m2.is_leader:
+        time.sleep(0.1)
+    assert m2.is_leader
+    # worker re-registers with the new leader via its rotation
+    deadline = time.monotonic() + 15
+    st = {}
+    while time.monotonic() < deadline:
+        st = app_status(group)
+        if st.get("workers", {}).get("w-ha", {}).get("state") == "ALIVE":
+            break
+        time.sleep(0.2)
+    assert st["workers"]["w-ha"]["state"] == "ALIVE"
+
+    # an app submitted through the GROUP address runs on the new leader
+    app = tmp_path / "ha_app.py"
+    app.write_text("pass\n")
+    app_id = submit_app(group, str(app), n_procs=1)
+    assert wait_for_app(group, app_id, timeout_s=60) == "FINISHED"
+    w.stop()
+    m2.stop()
+
+
+def test_allocation_manager_scales_mesh_back_up(ctx):
+    """Dynamic allocation scale-UP (ExecutorAllocationManager analog):
+    after a failure-driven downsize to 4 devices, the manager notices 8
+    visible devices and rebuilds the mesh to use them."""
+    from cycloneml_tpu.parallel.allocation import ExecutorAllocationManager
+    assert ctx.mesh_runtime.n_devices == 8
+    try:
+        ctx.rebuild_mesh("local-mesh[4]")
+        assert ctx.mesh_runtime.n_devices == 4
+        events = []
+        mgr = ExecutorAllocationManager(
+            ctx, poll_interval_s=0.1, stable_checks=2,
+            on_scale=lambda rt: events.append(rt.n_devices))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not events:
+            time.sleep(0.1)
+        mgr.stop()
+        assert events and events[0] == 8
+        assert ctx.mesh_runtime.n_devices == 8
+        # training works on the scaled-up mesh
+        rng = np.random.RandomState(5)
+        from cycloneml_tpu.dataset.dataset import InstanceDataset
+        from cycloneml_tpu.ml.classification import LogisticRegression
+        x = rng.randn(160, 8)
+        y = (rng.rand(160) > 0.5).astype(np.float64)
+        ds = InstanceDataset.from_numpy(ctx, x, y)
+        m = LogisticRegression(maxIter=20, regParam=0.1).fit(ds)
+        assert np.isfinite(m.coefficients.to_array()).all()
+    finally:
+        from cycloneml_tpu import mesh as mesh_mod
+        if ctx.mesh_runtime.n_devices != 8:
+            ctx.rebuild_mesh("local-mesh[8]")

@@ -236,3 +236,85 @@ def test_rdd_group_by_key_routes_through_exchange(tmp_path):
     assert got == exp
     got_red = {int(k): v for r in res for k, v in r["reduced"].items()}
     assert got_red == {k: sum(vs) for k, vs in exp.items()}
+
+
+def test_adaptive_broadcast_join_and_coalescing(tmp_path):
+    """AQE (ref AdaptiveSparkPlanExec): runtime size statistics choose a
+    BROADCAST join for a small side (no exchange of the big side), fall
+    back to the shuffled join when the threshold disables it, and
+    post-shuffle coalescing merges near-empty output partitions."""
+    script = textwrap.dedent("""
+        import json, os, sys
+        import numpy as np
+        rank, addr0, addr1, outdir = (int(sys.argv[1]), sys.argv[2],
+                                      sys.argv[3], sys.argv[4])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from cycloneml_tpu.conf import CycloneConf
+        from cycloneml_tpu.context import CycloneContext
+        from cycloneml_tpu.sql.session import CycloneSession
+        from cycloneml_tpu.sql.plan import Join
+        from cycloneml_tpu.dataset.dataset import PartitionedDataset
+        conf = (CycloneConf().set("cyclone.master", "local-mesh[1]")
+                .set("cyclone.exchange.addresses", addr0 + "," + addr1)
+                .set("cyclone.exchange.rank", str(rank))
+                .set("cyclone.exchange.numBuckets", "16"))
+        ctx = CycloneContext.get_or_create(conf)
+        s = CycloneSession(ctx)
+
+        # big fact slice per process; tiny dim -> AQE must broadcast it
+        N = 50_000
+        fact = s.create_data_frame(
+            {"k": (np.arange(N) * 2 + rank) % 100,
+             "v": np.arange(N, dtype=np.float64)})
+        dim = s.create_data_frame(
+            {"k": np.arange(rank, 100, 2),
+             "name": np.array([f"n{i}" for i in range(rank, 100, 2)],
+                              object)})
+        s.register_temp_view("fact", fact)
+        s.register_temp_view("dim", dim)
+
+        import cycloneml_tpu.sql.plan as plan_mod
+        df = s.table("fact").join(s.table("dim"), on="k", how="inner")
+        out = df.to_dict()
+        strategy = plan_mod.LAST_JOIN_STRATEGY
+
+        # threshold -1 forces the shuffled path; results must agree
+        ctx.conf.set("cyclone.sql.autoBroadcastJoinThreshold", "-1")
+        df2 = s.table("fact").join(s.table("dim"), on="k", how="inner")
+        out2 = df2.to_dict()
+        strategy2 = plan_mod.LAST_JOIN_STRATEGY
+
+        # post-shuffle coalescing: 16 buckets of a tiny dataset collapse
+        pd_small = PartitionedDataset.from_sequence(
+            ctx, [(i % 10, i) for i in range(200)], 2)
+        grouped = pd_small.group_by_key()
+        parts = grouped._partitions()
+
+        bc_sum = float(np.sum(out["v"]))
+        ex_rows = sorted(zip(np.asarray(out2["k"]).tolist(),
+                             np.asarray(out2["v"]).tolist()))
+        bc_rows = sorted(zip(np.asarray(out["k"]).tolist(),
+                             np.asarray(out["v"]).tolist()))
+        with open(os.path.join(outdir, f"aqe_{rank}.json"), "w") as fh:
+            json.dump({"strategy": strategy, "strategy2": strategy2,
+                       "n_rows": len(out["k"]),
+                       "bc_equals_ex": bc_rows == ex_rows,
+                       "n_parts": len(parts),
+                       "grouped_n": len(grouped.collect()),
+                       "sum": bc_sum}, fh)
+    """)
+    _run_two(script, tmp_path)
+    res = [json.load(open(tmp_path / f"aqe_{r}.json")) for r in range(2)]
+    for r in res:
+        assert r["strategy"].startswith("broadcast"), r
+        assert r["strategy2"] == "exchange", r
+    # broadcast keeps each process's LOCAL fact rows: the union of row
+    # counts equals the single-process inner join; per-process results
+    # equal that process's exchange-mode result ONLY in aggregate, so
+    # compare totals
+    assert res[0]["n_rows"] + res[1]["n_rows"] == 100_000
+    # coalescing collapsed the 16-bucket shuffle of 200 rows
+    for r in res:
+        assert r["n_parts"] <= 2, r["n_parts"]
+    assert res[0]["grouped_n"] + res[1]["grouped_n"] == 10
