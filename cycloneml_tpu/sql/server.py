@@ -1,0 +1,154 @@
+"""Remote SQL service — the Thriftserver role.
+
+Analog of ``sql/hive-thriftserver`` (HiveThriftServer2): external clients
+submit SQL text over the wire and receive result sets, sharing one
+server-side session/catalog. The PROTOCOL is deliberately not Hive's
+thrift (no JVM, no SASL): JSON lines over TCP, the same wire style as the
+deploy/heartbeat/exchange fabric, with a DB-API-ish Python client. What
+carries over is the functional contract: concurrent remote clients, one
+shared catalog, statement-at-a-time execution, typed errors.
+
+Requests:  ``{"sql": "..."}``
+Responses: ``{"ok": true, "columns": [...], "rows": [[...], ...]}`` or
+           ``{"ok": false, "error": "...", "kind": "AnalysisException"}``
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import socket
+import socketserver
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _json_value(v: Any):
+    """Result-set cell → STRICT-JSON value: every non-finite float (NaN,
+    ±Infinity) maps to SQL NULL — bare ``Infinity`` tokens would break any
+    non-Python JSON parser on the wire. bool checks BEFORE int (bool is an
+    int subclass)."""
+    if v is None:
+        return None
+    if isinstance(v, (np.bool_, bool)):
+        return bool(v)
+    if isinstance(v, (np.floating, float)):
+        f = float(v)
+        return f if math.isfinite(f) else None
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    return str(v)
+
+
+class CycloneSQLServer:
+    """Serve ``session.sql`` to remote clients (one statement per
+    request; the ThreadingTCPServer gives statement-level concurrency —
+    the session catalog itself is driver-side state, as in the
+    reference's shared HiveThriftServer2 SQLContext)."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        # statements serialize: the session catalog is a plain dict with
+        # check-then-act DDL/DML sequences (the same discipline as
+        # MasterDaemon._dispatch; HiveServer2's sync mode likewise runs
+        # one statement at a time per session)
+        self._stmt_lock = threading.Lock()
+        server = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    if not line.strip():
+                        continue
+                    try:
+                        req = json.loads(line)
+                        reply = server._run(req["sql"])
+                    except Exception as e:
+                        reply = {"ok": False, "error": str(e),
+                                 "kind": type(e).__name__}
+                    self.wfile.write(
+                        (json.dumps(reply) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self.address = f"{self.host}:{self.port}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="cyclone-sqlsrv")
+        self._thread.start()
+        logger.info("cyclone SQL server listening on %s", self.address)
+
+    def _run(self, sql: str) -> dict:
+        with self._stmt_lock:
+            df = self.session.sql(sql)
+            collected = df.collect()  # the one batch->rows pivot
+            cols = (list(collected[0]._names) if collected
+                    else df.columns)  # plan schema, no re-execution
+        rows = [[_json_value(v) for v in r._values] for r in collected]
+        return {"ok": True, "columns": cols, "rows": rows}
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class SQLClient:
+    """Minimal DB-API-flavored client: ``execute`` returns (columns,
+    rows); typed server errors re-raise by kind (AnalysisException and
+    friends surface as such, like HiveServer2's typed SQLExceptions)."""
+
+    def __init__(self, address: str, timeout: Optional[float] = None):
+        # timeout=None (default) blocks until the statement finishes: the
+        # wire has NO request ids, so a timed-out request would leave its
+        # late reply in the stream and desynchronize every later execute —
+        # hence any timeout hit PERMANENTLY fails this connection
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._fh = self._sock.makefile("rw")
+        self._broken = False
+
+    def execute(self, sql: str) -> Tuple[List[str], List[list]]:
+        if self._broken:
+            raise IOError("connection desynchronized by an earlier "
+                          "timeout; open a new SQLClient")
+        self._fh.write(json.dumps({"sql": sql}) + "\n")
+        self._fh.flush()
+        try:
+            line = self._fh.readline()
+        except (socket.timeout, TimeoutError):
+            self._broken = True
+            raise
+        if not line:
+            raise IOError("SQL server closed the connection")
+        rep = json.loads(line)
+        if not rep.get("ok"):
+            kind = rep.get("kind", "")
+            if kind == "AnalysisException":
+                from cycloneml_tpu.sql.analyzer import AnalysisException
+                raise AnalysisException(rep.get("error"))
+            raise RuntimeError(f"{kind}: {rep.get('error')}")
+        return rep["columns"], rep["rows"]
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,74 @@
+"""Remote SQL service (the Thriftserver role): concurrent clients over
+TCP against one shared session/catalog, DDL/DML visible across
+connections, typed error propagation."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.sql.analyzer import AnalysisException
+from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+from cycloneml_tpu.sql.session import CycloneSession
+
+
+@pytest.fixture()
+def server():
+    s = CycloneSession()
+    df = s.create_data_frame({
+        "k": np.array(["a", "b", "a", "c"], dtype=object),
+        "v": np.array([1.0, 2.0, 3.0, np.nan]),
+    })
+    s.register_temp_view("t", df)
+    srv = CycloneSQLServer(s)
+    yield srv
+    srv.stop()
+
+
+def test_query_and_null_mapping(server):
+    with SQLClient(server.address) as c:
+        cols, rows = c.execute(
+            "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k")
+        assert cols == ["k", "s"]
+        assert rows == [["a", 4.0], ["b", 2.0], ["c", None]]  # NaN -> NULL
+
+
+def test_ddl_visible_across_connections(server):
+    with SQLClient(server.address) as c1:
+        c1.execute("CREATE TABLE agg AS SELECT k, COUNT(*) AS n FROM t "
+                   "GROUP BY k")
+    with SQLClient(server.address) as c2:  # shared catalog, new connection
+        cols, rows = c2.execute("SELECT * FROM agg ORDER BY k")
+        assert cols == ["k", "n"]
+        assert [r[0] for r in rows] == ["a", "b", "c"]
+
+
+def test_typed_errors_propagate(server):
+    with SQLClient(server.address) as c:
+        with pytest.raises(AnalysisException, match="cannot resolve"):
+            c.execute("SELECT nope FROM t")
+        # the connection survives an error and keeps serving
+        cols, rows = c.execute("SELECT COUNT(*) AS n FROM t")
+        assert rows == [[4]]
+
+
+def test_concurrent_clients(server):
+    results = []
+    errors = []
+
+    def run(i):
+        try:
+            with SQLClient(server.address) as c:
+                _, rows = c.execute(
+                    f"SELECT COUNT(*) AS n FROM t WHERE v >= {i % 3}")
+                results.append(rows[0][0])
+        except Exception as e:  # surfaced in the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert len(results) == 8
