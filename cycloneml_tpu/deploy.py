@@ -119,15 +119,10 @@ class MasterDaemon:
                     reply = {"ok": False, "error": repr(e)}
                 self.wfile.write((json.dumps(reply) + "\n").encode())
 
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
+        from cycloneml_tpu.util.tcp import start_tcp_server
+        self._server = start_tcp_server(host, port, Handler,
+                                        "cyclone-master")
         self.address = (f"{host}:{self._server.server_address[1]}")
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="cyclone-master")
-        self._thread.start()
         if self._ha_dir is not None:
             self._elector.start()
         logger.info("cyclone master listening on %s (leader=%s)",

@@ -357,13 +357,13 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         tp_active = (not is_multinomial) and m > 1 and d % m == 0
         use_pallas = (not is_multinomial and hasattr(ds.ctx, "conf")
                       and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
-        # plain binomial path: standardization (and fitWithMean centering)
-        # folds INTO the aggregator read — no standardized copy exists, so
-        # the fit's HBM working set is X itself, and the pre-fit
-        # standardize pass disappears (r3 verdict item 4). The
-        # multinomial / feature-sharded / pallas paths keep the
-        # materialized copy for now.
-        use_scaled = not (is_multinomial or tp_active or use_pallas)
+        # plain binomial AND multinomial paths: standardization (and
+        # fitWithMean centering) folds INTO the aggregator read — no
+        # standardized copy exists, so the fit's HBM working set is X
+        # itself, and the pre-fit standardize pass disappears (r3 verdict
+        # item 4). The feature-sharded / pallas paths keep the
+        # materialized copy.
+        use_scaled = not (tp_active or use_pallas)
         from cycloneml_tpu.ml.optim.loss import inv_std_vector
         inv_std = inv_std_vector(features_std)
         scaled_mean = stats.mean * inv_std if fit_with_mean else None
@@ -380,7 +380,10 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             ds_std.persist()
 
         if is_multinomial:
-            agg = aggregators.multinomial_logistic(d, num_classes, fit_intercept)
+            # always the scaled aggregator: the TP/pallas alternatives are
+            # binomial-only, so use_scaled cannot be False here
+            agg = aggregators.multinomial_logistic_scaled(
+                d, num_classes, fit_intercept)
             n_coef = d * num_classes + (num_classes if fit_intercept else 0)
             x0 = np.zeros(n_coef)
             if fit_intercept and histogram.min() > 0:

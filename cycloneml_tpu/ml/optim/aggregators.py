@@ -157,6 +157,51 @@ def _multinomial_logistic(d: int, k: int, fit_intercept: bool, prec) -> Agg:
     return agg
 
 
+def multinomial_logistic_scaled(d: int, k: int,
+                                fit_intercept: bool = True) -> Agg:
+    """Multinomial twin of :func:`binary_logistic_scaled`: softmax
+    cross-entropy over RAW feature blocks with standardization (and
+    fitWithMean centering) folded into the read — margins are
+    x·(W∘inv_std)ᵀ − W·scaled_mean + b, gradients unscale per class. The
+    standardized copy never materializes for multinomial fits either."""
+    return _multinomial_logistic_scaled(d, k, fit_intercept,
+                                        matmul_precision())
+
+
+@functools.lru_cache(maxsize=None)
+def _multinomial_logistic_scaled(d: int, k: int, fit_intercept: bool,
+                                 prec) -> Agg:
+
+    def agg(x, y, w, inv_std, scaled_mean, coef):
+        if fit_intercept:
+            wmat = coef[: d * k].reshape(k, d)
+            b = coef[d * k:]
+        else:
+            wmat = coef.reshape(k, d)
+            b = jnp.zeros((k,), coef.dtype)
+        wmat_s = wmat * inv_std[None, :]
+        offset = jnp.dot(wmat, scaled_mean, precision=prec)      # (k,)
+        margins = (jnp.dot(x, wmat_s.T, precision=prec)
+                   - offset[None, :] + b)                        # (bsz, k)
+        log_z = jax.nn.logsumexp(margins, axis=1)
+        y_idx = y.astype(jnp.int32)
+        picked = jnp.take_along_axis(margins, y_idx[:, None], axis=1)[:, 0]
+        loss = jnp.sum(w * (log_z - picked))
+        probs = jax.nn.softmax(margins, axis=1)
+        onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
+        mult = w[:, None] * (probs - onehot)                     # (bsz, k)
+        msum = jnp.sum(mult, axis=0)                             # (k,)
+        gw = (jnp.dot(mult.T, x, precision=prec) * inv_std[None, :]
+              - msum[:, None] * scaled_mean[None, :])            # (k, d)
+        if fit_intercept:
+            grad = jnp.concatenate([gw.reshape(-1), msum])
+        else:
+            grad = gw.reshape(-1)
+        return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
 def least_squares(d: int, fit_intercept: bool = True) -> Agg:
     """Squared loss ½ w (x·β + β₀ − y)² (ref LeastSquaresBlockAggregator)."""
     return _least_squares(d, fit_intercept, matmul_precision())

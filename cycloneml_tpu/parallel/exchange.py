@@ -137,14 +137,9 @@ class _ExchangeServer:
                         current.done.release()  # unblock the barrier so
                         # finish() raises the REAL error, not a timeout
 
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, int(port)), Handler)
-        t = threading.Thread(target=self._server.serve_forever, daemon=True,
-                             name=f"exchange-server-{address}")
-        t.start()
+        from cycloneml_tpu.util.tcp import start_tcp_server
+        self._server = start_tcp_server(host, int(port), Handler,
+                                        f"exchange-server-{address}")
 
     def round_state(self, round_id: int, spill_dir=None) -> _RoundState:
         with self._lock:

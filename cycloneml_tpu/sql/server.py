@@ -43,6 +43,8 @@ def _json_value(v: Any):
         return f if math.isfinite(f) else None
     if isinstance(v, (np.integer, int)):
         return int(v)
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return [_json_value(x) for x in v]  # array cells stay arrays
     return str(v)
 
 
@@ -76,16 +78,11 @@ class CycloneSQLServer:
                         (json.dumps(reply) + "\n").encode())
                     self.wfile.flush()
 
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
+        from cycloneml_tpu.util.tcp import start_tcp_server
+        self._server = start_tcp_server(host, port, Handler,
+                                        "cyclone-sqlsrv")
         self.host, self.port = self._server.server_address
         self.address = f"{self.host}:{self.port}"
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="cyclone-sqlsrv")
-        self._thread.start()
         logger.info("cyclone SQL server listening on %s", self.address)
 
     def _run(self, sql: str) -> dict:
@@ -122,9 +119,11 @@ class SQLClient:
         if self._broken:
             raise IOError("connection desynchronized by an earlier "
                           "timeout; open a new SQLClient")
-        self._fh.write(json.dumps({"sql": sql}) + "\n")
-        self._fh.flush()
         try:
+            # a SEND-side timeout can leave a partial request on the wire
+            # — just as fatal to framing as a missed reply
+            self._fh.write(json.dumps({"sql": sql}) + "\n")
+            self._fh.flush()
             line = self._fh.readline()
         except (socket.timeout, TimeoutError):
             self._broken = True

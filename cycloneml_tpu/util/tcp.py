@@ -1,0 +1,26 @@
+"""Shared TCP service scaffolding for the host control/data fabrics.
+
+Every host-tier service (deploy master, exchange receive, heartbeats,
+remote SQL) is the same shape: a ThreadingTCPServer with reuse-addr and
+daemon handler threads, served from a daemon thread. One helper keeps
+shutdown/config fixes in one place."""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def start_tcp_server(host: str, port: int, handler_cls,
+                     name: str) -> socketserver.ThreadingTCPServer:
+    """Bind, serve_forever on a daemon thread, return the server (its
+    ``server_address`` carries the bound port when ``port=0``)."""
+    srv = _Server((host, int(port)), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True, name=name)
+    t.start()
+    return srv

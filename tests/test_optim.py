@@ -386,3 +386,42 @@ def test_lbfgsb_degenerate_and_corner_cases():
     hi = np.array([5.0, 2.0, 5.0])
     mixed = LBFGSB(lo, hi, max_iter=100, tol=1e-12).minimize(f, np.zeros(3))
     np.testing.assert_allclose(mixed.x, [0.0, 2.0, 0.0], atol=1e-8)
+
+
+def test_scaled_aggregators_grad_matches_autodiff():
+    """The fold-standardization-into-the-read aggregators: hand-derived
+    gradients (inv_std unscaling + scaled_mean offset terms) against
+    autodiff, and equality with the plain aggregator on pre-standardized
+    data."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(11)
+    b, d, k = 16, 5, 3
+    x = jnp.asarray(rng.randn(b, d) * 2.0 + 1.0)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, b))
+    inv_std = jnp.asarray(rng.uniform(0.5, 2.0, d))
+    mu = jnp.asarray(rng.randn(d))
+
+    for agg, coef_len, y in (
+            (aggregators.binary_logistic_scaled(d, True), d + 1,
+             jnp.asarray((rng.rand(b) > 0.5).astype(np.float64))),
+            (aggregators.multinomial_logistic_scaled(d, k, True),
+             d * k + k, jnp.asarray(rng.randint(0, k, b).astype(float))),
+            (aggregators.multinomial_logistic_scaled(d, k, False),
+             d * k, jnp.asarray(rng.randint(0, k, b).astype(float)))):
+        coef = jnp.asarray(rng.randn(coef_len))
+        out = agg(x, y, w, inv_std, mu, coef)
+        auto = jax.grad(lambda c: agg(x, y, w, inv_std, mu, c)["loss"])(coef)
+        np.testing.assert_allclose(np.asarray(out["grad"]),
+                                   np.asarray(auto), rtol=1e-8, atol=1e-8)
+
+    # scaled agg on raw x == plain agg on standardized x
+    y2 = jnp.asarray(rng.randint(0, k, b).astype(float))
+    coef = jnp.asarray(rng.randn(d * k + k))
+    # the scaled agg's contract: x̂ = x·inv_std − scaled_mean
+    x_hat = x * inv_std[None, :] - mu[None, :]
+    got = aggregators.multinomial_logistic_scaled(d, k, True)(
+        x, y2, w, inv_std, mu, coef)
+    want = aggregators.multinomial_logistic(d, k, True)(x_hat, y2, w, coef)
+    np.testing.assert_allclose(float(got["loss"]), float(want["loss"]),
+                               rtol=1e-10)
