@@ -1,0 +1,41 @@
+"""Remote SQL service (the Thriftserver role): start a CycloneSQLServer
+over a shared session and query it from SQLClient connections — DDL made
+by one connection is visible to the next (≈ the reference's
+examples using beeline against the thriftserver)."""
+
+import numpy as np
+
+from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+from cycloneml_tpu.sql.session import CycloneSession
+
+
+def main():
+    session = CycloneSession()
+    sales = session.create_data_frame({
+        "region": np.array(["east", "west", "east", "south"], dtype=object),
+        "amount": np.array([120.0, 80.0, 200.0, 50.0]),
+    })
+    session.register_temp_view("sales", sales)
+
+    server = CycloneSQLServer(session)
+    print(f"serving SQL on {server.address}")
+    try:
+        with SQLClient(server.address) as c:
+            cols, rows = c.execute(
+                "SELECT region, SUM(amount) AS total FROM sales "
+                "GROUP BY region ORDER BY total DESC")
+            print(cols)
+            for r in rows:
+                print(r)
+            c.execute("CREATE TABLE top AS SELECT region FROM sales "
+                      "WHERE amount > 100")
+        with SQLClient(server.address) as c2:  # new connection, same catalog
+            _, rows2 = c2.execute("SELECT COUNT(*) AS n FROM top")
+            print("top regions:", rows2[0][0])
+        return {"regions": len(rows), "top": rows2[0][0]}
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ EXAMPLES = [
     "examples/window_analytics_example.py",
     "examples/streaming_etl_to_parquet.py",
     "examples/streamed_ingest_monitoring_example.py",
+    "examples/sql_server_example.py",
 ]
 
 
