@@ -147,6 +147,14 @@ class CycloneContext:
         self._next_broadcast = 0
         self._next_job = 0
         self._job_stack: List[int] = []
+        # job/rebuild mutual exclusion: run_job brackets count themselves
+        # in under this condition, and a mesh rebuild (allocation scale-up)
+        # may only begin while the count is zero — closing the window where
+        # a job starting between "is a job active?" and rebuild_mesh() had
+        # its compiled step torn down mid-flight (advisor r4)
+        self._job_cond = threading.Condition()
+        self._active_jobs = 0
+        self._mesh_rebuild_in_flight = False
         self._job_steps: Dict[int, int] = {}
         self._stopped = False
         self._accumulators: List[Accumulator] = []
@@ -236,6 +244,10 @@ class CycloneContext:
 
     # -- job bracketing (events only; execution is jit dispatch) --------------
     def run_job(self, description: str, fn: Callable[[], Any]) -> Any:
+        with self._job_cond:
+            while self._mesh_rebuild_in_flight:
+                self._job_cond.wait()
+            self._active_jobs += 1
         self._next_job += 1
         jid = self._next_job
         self.listener_bus.post(JobStart(job_id=jid, description=description))
@@ -250,9 +262,29 @@ class CycloneContext:
             raise
         finally:
             self._job_stack.pop()
+            with self._job_cond:
+                self._active_jobs -= 1
+                self._job_cond.notify_all()
         self.listener_bus.post(JobEnd(job_id=jid, succeeded=True))
         self.metrics.registry.counter("jobs.succeeded").inc()
         return out
+
+    def try_begin_mesh_rebuild(self) -> bool:
+        """Atomically claim the mesh for a rebuild IFF no ``run_job``
+        bracket is active. While claimed, new jobs block at entry until
+        :meth:`end_mesh_rebuild` — so a fit starting concurrently with an
+        allocation scale-up either runs entirely before the rebuild or
+        entirely on the rebuilt mesh, never across it."""
+        with self._job_cond:
+            if self._active_jobs or self._mesh_rebuild_in_flight:
+                return False
+            self._mesh_rebuild_in_flight = True
+            return True
+
+    def end_mesh_rebuild(self) -> None:
+        with self._job_cond:
+            self._mesh_rebuild_in_flight = False
+            self._job_cond.notify_all()
 
     @property
     def current_job_id(self) -> int:
@@ -412,6 +444,21 @@ class CycloneContext:
             self._web_ui.stop()
         if getattr(self, "storage", None) is not None:
             self.storage.close()  # spill files + dir, never leaked to /tmp
+        try:
+            # release the exchange listener THIS context's conf introduced
+            # (servers are shared across rounds, not across contexts with
+            # different addresses — advisor r4)
+            from cycloneml_tpu.conf import EXCHANGE_ADDRESSES, EXCHANGE_RANK
+            addrs_s = self.conf.get(EXCHANGE_ADDRESSES)
+            if addrs_s:
+                addrs = [a.strip() for a in addrs_s.split(",") if a.strip()]
+                rank = self.conf.get(EXCHANGE_RANK)
+                if 0 <= rank < len(addrs):
+                    from cycloneml_tpu.parallel.exchange import \
+                        _ExchangeServer
+                    _ExchangeServer.close_address(addrs[rank])
+        except Exception:
+            logger.exception("exchange server shutdown failed")
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

@@ -62,35 +62,51 @@ class ExecutorAllocationManager:
                 if avail > used:
                     self._streak += 1
                     if self._streak >= self.stable_checks:
-                        if getattr(self.ctx, "_job_stack", None):
-                            # a job (fit/transform bracketed by run_job) is
-                            # in flight: rebuilding now would tear the mesh
-                            # out from under its compiled step — defer to
-                            # the next poll (the reference's allocation
-                            # manager likewise won't kill busy executors)
+                        # claim the job/rebuild gate ATOMICALLY: a job
+                        # (fit/transform bracketed by run_job) in flight
+                        # defers the rebuild, and once claimed, new jobs
+                        # block until the rebuild ends — the bare
+                        # _job_stack check had a poll-to-rebuild window
+                        # where a starting fit lost its mesh (advisor r4;
+                        # the reference likewise won't kill busy executors)
+                        begin = getattr(self.ctx, "try_begin_mesh_rebuild",
+                                        None)
+                        if begin is None or begin():
+                            rt = None
+                            try:
+                                rt = self._rebuild(avail)
+                            finally:
+                                # release BEFORE on_scale: the callback's
+                                # contract is "restore datasets and resume
+                                # fits", and fits enter run_job — invoking
+                                # it under the gate would deadlock against
+                                # the very jobs it restarts
+                                if begin is not None:
+                                    self.ctx.end_mesh_rebuild()
+                            if self.on_scale is not None:
+                                self.on_scale(rt if self.auto else avail)
+                            self._streak = 0
+                        else:
                             logger.info(
                                 "allocation: scale-up deferred, job active")
-                        else:
-                            self._scale_up(avail)
-                            self._streak = 0
                 else:
                     self._streak = 0
             except Exception:
                 logger.exception("allocation poll failed")
             self._stop.wait(self.poll_interval_s)
 
-    def _scale_up(self, avail: int) -> None:
+    def _rebuild(self, avail: int):
+        """The gated slice of scale-up: mesh teardown/rebuild only. The
+        ``on_scale`` notification happens OUTSIDE the job gate, in the
+        poll loop."""
         logger.info("allocation: %d devices available, mesh uses %d — "
                     "scaling up", avail, self.ctx.mesh_runtime.n_devices)
-        if self.auto:
-            # rebuild onto the CONFIGURED master (conf cyclone.master):
-            # under multihost every process must re-form ONE coordinated
-            # mesh from its own conf, never a per-process local-mesh
-            rt = self.ctx.rebuild_mesh()
-            if self.on_scale is not None:
-                self.on_scale(rt)
-        elif self.on_scale is not None:
-            self.on_scale(avail)
+        if not self.auto:
+            return None
+        # rebuild onto the CONFIGURED master (conf cyclone.master):
+        # under multihost every process must re-form ONE coordinated
+        # mesh from its own conf, never a per-process local-mesh
+        return self.ctx.rebuild_mesh()
 
     def stop(self) -> None:
         self._stop.set()

@@ -105,6 +105,7 @@ class _ExchangeServer:
     def __init__(self, address: str):
         self._lock = threading.Lock()
         self._rounds: Dict[int, _RoundState] = {}
+        self.orphan_failures: List[str] = []
         server = self
         host, port = address.rsplit(":", 1)
 
@@ -136,6 +137,15 @@ class _ExchangeServer:
                         current.failed.append(repr(e))
                         current.done.release()  # unblock the barrier so
                         # finish() raises the REAL error, not a timeout
+                    else:
+                        # died before any frame named its round (corrupt/
+                        # truncated FIRST frame, or a stray non-protocol
+                        # connection): no round to attribute. Stash it
+                        # server-level — a round that later TIMES OUT
+                        # reports it as the likely cause (advisor r4) —
+                        # rather than eagerly failing healthy in-flight
+                        # rounds whose real peers are streaming fine
+                        server.record_orphan(f"pre-parse failure: {e!r}")
 
         from cycloneml_tpu.util.tcp import start_tcp_server
         self._server = start_tcp_server(host, int(port), Handler,
@@ -151,6 +161,27 @@ class _ExchangeServer:
     def drop_round(self, round_id: int) -> None:
         with self._lock:
             self._rounds.pop(round_id, None)
+
+    def record_orphan(self, err: str) -> None:
+        with self._lock:
+            self.orphan_failures.append(err)
+            del self.orphan_failures[:-8]  # bounded: keep the last few
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @classmethod
+    def close_address(cls, address: str) -> None:
+        """Shut down and forget the server bound for ``address`` (if any).
+        Servers are process-lived across ROUNDS by design, but a context
+        whose conf introduced the address releases its port on ``stop()``
+        — repeated contexts with different exchange addresses must not
+        accumulate bound listeners (advisor r4)."""
+        with cls._ilock:
+            srv = cls._instances.pop(address, None)
+        if srv is not None:
+            srv.close()
 
 
 _round_lock = threading.Lock()
@@ -283,6 +314,12 @@ class HashExchange:
                     if state.failed:
                         raise IOError(
                             f"exchange receive failed: {state.failed[:3]}")
+                    orphans = list(self._server.orphan_failures)
+                    if orphans:
+                        raise IOError(
+                            f"exchange barrier timed out on rank "
+                            f"{self.rank}; unattributed receive failures "
+                            f"(likely cause): {orphans[-3:]}")
                     raise TimeoutError(
                         f"exchange barrier timed out on rank {self.rank}")
             if state.failed:

@@ -372,17 +372,38 @@ class Cast(Expr):
             # widens to float64 to carry them. Integer strings parse via
             # int() so > 2^53 ids survive exactly (floats would round).
             if self.to == "bigint":
+                i64_min, i64_max = -(1 << 63), (1 << 63) - 1
                 vals: list = []
                 exact = True
                 for x in v:
                     try:
-                        vals.append(int(x))
+                        iv = int(x)
                     except (TypeError, ValueError):
                         try:
-                            vals.append(int(float(x)))  # '3.7' -> 3
+                            iv = int(float(x))  # '3.7' -> 3
                         except (TypeError, ValueError, OverflowError):
                             vals.append(np.nan)
                             exact = False
+                            continue
+                    if not i64_min <= iv <= i64_max:
+                        # out-of-int64-range casts to NULL like any other
+                        # unparseable value — np.asarray would otherwise
+                        # raise OverflowError and error the whole query
+                        vals.append(np.nan)
+                        exact = False
+                        continue
+                    vals.append(iv)
+                if not exact and any(
+                        isinstance(x, int) and abs(x) > (1 << 53)
+                        for x in vals):
+                    # the NULL-carrying lane is float64 (the engine's null
+                    # convention), so a column mixing NULLs with ids above
+                    # 2^53 loses exactness — loudly, not silently
+                    import warnings
+                    warnings.warn(
+                        "CAST to BIGINT: column contains NULLs/overflows "
+                        "alongside integers > 2^53; those integers lose "
+                        "precision in the float64 null-carrying lane")
                 return np.asarray(
                     vals, dtype=np.int64 if exact else np.float64)
             out = np.empty(v.shape[0], dtype=np.float64)

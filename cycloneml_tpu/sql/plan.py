@@ -461,6 +461,7 @@ class Aggregate(LogicalPlan):
     def execute(self):
         batch = self.children[0].execute()
         n = _batch_n(batch)
+        truncate_to_zero = False
 
         from cycloneml_tpu.parallel.exchange import active_exchange_group
         group = active_exchange_group()
@@ -478,9 +479,14 @@ class Aggregate(LogicalPlan):
                 owner = (stable_hash(()) % n_buckets) % len(addresses)
             rows = _rows_of(batch, names, n)
             (owned,) = _exchange_keyed_rows([(keys, rows)], group)
-            if not self.group_exprs and rank != owner:
-                return {e.name_hint(): np.array([])
-                        for e in (*self.group_exprs, *self.agg_exprs)}
+            truncate_to_zero = bool(not self.group_exprs and rank != owner)
+            if truncate_to_zero:
+                # non-owner of the single global-aggregate key: evaluate
+                # over an EMPTY owned batch and slice the result to zero
+                # rows below, so each emitted column keeps the dtype the
+                # owner's real rows carry (COUNT int64, AVG float64) and
+                # the documented cross-rank union stays type-stable
+                owned = []
             batch = _batch_of(owned, names, batch)
             n = len(owned)
 
@@ -521,6 +527,8 @@ class Aggregate(LogicalPlan):
             if v.shape[0] == 1 and n_groups != 1:
                 v = np.broadcast_to(v, (n_groups,)).copy()
             out[e.name_hint()] = v
+        if truncate_to_zero:
+            out = {k: v[:0] for k, v in out.items()}
         return out
 
     def __repr__(self):

@@ -358,3 +358,49 @@ def test_allocation_manager_scales_mesh_back_up(ctx):
         from cycloneml_tpu import mesh as mesh_mod
         if ctx.mesh_runtime.n_devices != 8:
             ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_job_gate_serializes_scale_up_and_jobs(ctx):
+    """The run_job/rebuild gate (advisor r5 TOCTOU): a claimed rebuild
+    blocks new jobs until it ends, and an active job blocks the claim."""
+    import threading
+
+    assert ctx.try_begin_mesh_rebuild()
+    # a second claim while one is in flight is refused
+    assert not ctx.try_begin_mesh_rebuild()
+    started = threading.Event()
+    ran = []
+
+    def job():
+        started.set()
+        ctx.run_job("gated", lambda: ran.append(1))
+
+    t = threading.Thread(target=job)
+    t.start()
+    started.wait(5)
+    time.sleep(0.3)
+    assert not ran  # blocked at the gate while the rebuild is claimed
+    ctx.end_mesh_rebuild()
+    t.join(timeout=5)
+    assert ran == [1]
+    # with a job ACTIVE the claim is refused (the window the bare
+    # _job_stack check left open)
+    gate_result = []
+    barrier = threading.Event()
+    release = threading.Event()
+
+    def slow_job():
+        def body():
+            barrier.set()
+            release.wait(5)
+        ctx.run_job("slow", body)
+
+    t2 = threading.Thread(target=slow_job)
+    t2.start()
+    barrier.wait(5)
+    gate_result.append(ctx.try_begin_mesh_rebuild())
+    release.set()
+    t2.join(timeout=5)
+    assert gate_result == [False]
+    assert ctx.try_begin_mesh_rebuild()  # free again after the job ends
+    ctx.end_mesh_rebuild()

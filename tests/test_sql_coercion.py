@@ -148,3 +148,27 @@ def test_coerced_group_key_keeps_its_name(session):
     session.register_temp_view("big", df)
     v = session.sql("SELECT CAST(sid AS BIGINT) AS v FROM big").to_dict()["v"]
     assert int(v[0]) == 9007199254740993
+
+
+def test_bigint_cast_overflow_is_null_not_error(session):
+    """A string integer outside int64 range casts to NULL (non-ANSI
+    Cast.scala overflow semantics), instead of OverflowError at numpy
+    array build erroring the whole query (advisor r5)."""
+    df = session.create_data_frame({
+        "sid": np.array(["12", "99999999999999999999999999",
+                         str(-(1 << 64)), "7"], dtype=object)})
+    session.register_temp_view("huge", df)
+    v = session.sql("SELECT CAST(sid AS BIGINT) AS v FROM huge"
+                    ).to_dict()["v"]
+    assert v.dtype == np.float64  # NULLs ride the float lane
+    assert v[0] == 12.0 and v[3] == 7.0
+    assert np.isnan(v[1]) and np.isnan(v[2])
+    # boundary values still parse exactly via the int lane
+    df2 = session.create_data_frame({
+        "sid": np.array([str((1 << 63) - 1), str(-(1 << 63))],
+                        dtype=object)})
+    session.register_temp_view("edge", df2)
+    v2 = session.sql("SELECT CAST(sid AS BIGINT) AS v FROM edge"
+                     ).to_dict()["v"]
+    assert v2.dtype == np.int64
+    assert v2[0] == (1 << 63) - 1 and v2[1] == -(1 << 63)
