@@ -344,6 +344,16 @@ SHUFFLE_SPILL_ROW_BUDGET = (
     .int_conf(1 << 20)
 )
 
+SQL_WAREHOUSE_DIR = (
+    ConfigBuilder("cyclone.sql.warehouse.dir")
+    .doc("Warehouse directory for the PERSISTENT catalog (Spark's "
+         "spark.sql.warehouse.dir; the metastore analog — "
+         "HiveExternalCatalog.scala:56). When set, CREATE TABLE AS / "
+         "INSERT INTO write table metadata + parquet parts here and "
+         "survive process restart; empty = in-memory tables only.")
+    .str_conf("")
+)
+
 ADAPTIVE_ENABLED = (
     ConfigBuilder("cyclone.sql.adaptive.enabled")
     .doc("Adaptive query execution over the exchange fabric: runtime size "

@@ -74,9 +74,11 @@ class _RoundState:
     """Receive-side state of ONE exchange round on one process."""
 
     def __init__(self, spill_dir=None):
+        import time
         self.store = _BucketStore(spill_dir)
         self.done = threading.Semaphore(0)
         self.failed: List[str] = []
+        self.created = time.monotonic()
 
 
 class _ExchangeServer:
@@ -105,7 +107,7 @@ class _ExchangeServer:
     def __init__(self, address: str):
         self._lock = threading.Lock()
         self._rounds: Dict[int, _RoundState] = {}
-        self.orphan_failures: List[str] = []
+        self.orphan_failures: List[Tuple[float, str]] = []
         server = self
         host, port = address.rsplit(":", 1)
 
@@ -163,9 +165,18 @@ class _ExchangeServer:
             self._rounds.pop(round_id, None)
 
     def record_orphan(self, err: str) -> None:
+        import time
         with self._lock:
-            self.orphan_failures.append(err)
+            self.orphan_failures.append((time.monotonic(), err))
             del self.orphan_failures[:-8]  # bounded: keep the last few
+
+    def orphans_since(self, t0: float) -> List[str]:
+        """Pre-parse failures recorded after ``t0`` — a timed-out round
+        only reports orphans from ITS OWN lifetime, so a stale probe from
+        hours ago can't masquerade as the cause of a later dead-peer
+        timeout (review r5)."""
+        with self._lock:
+            return [e for ts, e in self.orphan_failures if ts >= t0]
 
     def close(self) -> None:
         self._server.shutdown()
@@ -314,7 +325,7 @@ class HashExchange:
                     if state.failed:
                         raise IOError(
                             f"exchange receive failed: {state.failed[:3]}")
-                    orphans = list(self._server.orphan_failures)
+                    orphans = self._server.orphans_since(state.created)
                     if orphans:
                         raise IOError(
                             f"exchange barrier timed out on rank "

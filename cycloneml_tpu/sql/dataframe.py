@@ -280,7 +280,11 @@ class DataFrame:
         return optimize(analyze(self.plan))
 
     def to_dict(self) -> Dict[str, np.ndarray]:
-        return self.optimized_plan().execute()
+        from cycloneml_tpu.sql.session import session_conf_scope
+        # execute under THIS session's conf overlay: plan nodes reading
+        # runtime conf (AQE thresholds etc.) see per-session SET values
+        with session_conf_scope(getattr(self.session, "session_conf", None)):
+            return self.optimized_plan().execute()
 
     def collect(self) -> List[Row]:
         batch = self.to_dict()

@@ -39,6 +39,7 @@ _KEYWORDS = {
     "full", "outer", "cross", "on", "asc", "desc", "true", "false", "union",
     "all", "using", "over", "partition", "exists", "create", "replace",
     "temporary", "temp", "view", "table", "insert", "into", "values",
+    "drop", "if",
 }
 
 _AGG_FNS = {"sum": F.sum, "avg": F.avg, "mean": F.avg, "min": F.min,
@@ -136,6 +137,16 @@ class _Parser:
             name = self.expect("ident")
             self.expect("kw", "as")
             return ("ctas", name, self.parse_query(), replace)
+        if (k, v) == ("kw", "drop"):
+            self.next()
+            obj = "view" if self.accept("kw", "view") else "table"
+            if obj == "table":
+                self.expect("kw", "table")
+            if_exists = False
+            if self.accept("kw", "if"):
+                self.expect("kw", "exists")
+                if_exists = True
+            return ("drop", obj, self.expect("ident"), if_exists)
         if (k, v) == ("kw", "insert"):
             self.next()
             self.expect("kw", "into")

@@ -680,9 +680,12 @@ class Join(LogicalPlan):
         from cycloneml_tpu.context import active_context
         from cycloneml_tpu.parallel.exchange import exchange_allgather
         ctx = active_context()
-        if ctx is None or not ctx.conf.get(ADAPTIVE_ENABLED):
+        # per-session SET (server connections each carry their own session
+        # conf overlay) takes precedence over the context conf
+        from cycloneml_tpu.sql.session import resolve_conf
+        if ctx is None or not resolve_conf(ctx, ADAPTIVE_ENABLED):
             return None
-        threshold = ctx.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+        threshold = resolve_conf(ctx, AUTO_BROADCAST_JOIN_THRESHOLD)
         if threshold < 0:
             return None
 

@@ -1,12 +1,16 @@
 """Remote SQL service — the Thriftserver role.
 
 Analog of ``sql/hive-thriftserver`` (HiveThriftServer2): external clients
-submit SQL text over the wire and receive result sets, sharing one
-server-side session/catalog. The PROTOCOL is deliberately not Hive's
-thrift (no JVM, no SASL): JSON lines over TCP, the same wire style as the
-deploy/heartbeat/exchange fabric, with a DB-API-ish Python client. What
-carries over is the functional contract: concurrent remote clients, one
-shared catalog, statement-at-a-time execution, typed errors.
+submit SQL text over the wire and receive result sets. Each CONNECTION
+gets its own session — temp views and SET conf are connection-local —
+layered over one shared catalog (tables, and the persistent warehouse
+when configured), exactly the SparkSQLSessionManager contract
+(ref: sql/hive-thriftserver/.../SparkSQLSessionManager.scala:39). The
+PROTOCOL is deliberately not Hive's thrift (no JVM, no SASL): JSON lines
+over TCP, the same wire style as the deploy/heartbeat/exchange fabric,
+with a DB-API-ish Python client. What carries over is the functional
+contract: concurrent remote clients, shared catalog, per-connection
+session state, statement-at-a-time execution, typed errors.
 
 Requests:  ``{"sql": "..."}``
 Responses: ``{"ok": true, "columns": [...], "rows": [[...], ...]}`` or
@@ -65,12 +69,16 @@ class CycloneSQLServer:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                # one SESSION per connection: temp views and SET conf are
+                # private to this client; catalog tables (shared layer +
+                # warehouse) are visible to every connection
+                sess = server.session.new_session()
                 for line in self.rfile:
                     if not line.strip():
                         continue
                     try:
                         req = json.loads(line)
-                        reply = server._run(req["sql"])
+                        reply = server._run(req["sql"], sess)
                     except Exception as e:
                         reply = {"ok": False, "error": str(e),
                                  "kind": type(e).__name__}
@@ -85,9 +93,10 @@ class CycloneSQLServer:
         self.address = f"{self.host}:{self.port}"
         logger.info("cyclone SQL server listening on %s", self.address)
 
-    def _run(self, sql: str) -> dict:
+    def _run(self, sql: str, sess=None) -> dict:
+        sess = sess if sess is not None else self.session
         with self._stmt_lock:
-            df = self.session.sql(sql)
+            df = sess.sql(sql)
             collected = df.collect()  # the one batch->rows pivot
             cols = (list(collected[0]._names) if collected
                     else df.columns)  # plan schema, no re-execution

@@ -3,10 +3,18 @@
 Analog of ``SparkSession`` (ref: sql/core/.../SparkSession.scala:83): owns
 the temp-view catalog, builds DataFrames from host data or files, and parses
 SQL text. Views are named logical plans (ref: catalog + Analyzer relation
-resolution)."""
+resolution). Name resolution layers per-session TEMP VIEWS over tables
+shared across sessions over an optional PERSISTENT warehouse
+(:mod:`cycloneml_tpu.sql.catalog`); ``new_session()`` forks the session
+state over the shared layers — the SparkSession.newSession contract the
+SQL server uses to give every connection its own session
+(ref: sql/hive-thriftserver/.../SparkSQLSessionManager.scala:39)."""
 
 from __future__ import annotations
 
+import contextlib
+import re
+import threading
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -14,13 +22,105 @@ import numpy as np
 from cycloneml_tpu.sql.dataframe import DataFrame
 from cycloneml_tpu.sql.plan import LogicalPlan, Scan
 
+_SET_KV_RE = re.compile(r"^\s*SET\s+([\w.\-]+)\s*=\s*(.+?)\s*;?\s*$",
+                        re.IGNORECASE)
+_SET_GET_RE = re.compile(r"^\s*SET\s+([\w.\-]+)\s*;?\s*$", re.IGNORECASE)
+
+# session-conf overlay active during plan execution: plan nodes read
+# runtime conf (AQE thresholds etc.) through here first, so two server
+# connections with different SET values execute with their OWN settings
+_overlay = threading.local()
+
+
+def current_conf_overlay() -> Dict[str, str]:
+    return getattr(_overlay, "conf", None) or {}
+
+
+@contextlib.contextmanager
+def session_conf_scope(conf: Optional[Dict[str, str]]):
+    prev = getattr(_overlay, "conf", None)
+    _overlay.conf = conf
+    try:
+        yield
+    finally:
+        _overlay.conf = prev
+
+
+def resolve_conf(ctx, entry):
+    """Read a registered config entry honoring the active SESSION overlay
+    (per-connection ``SET`` values) over the context conf — the one lookup
+    every plan-time conf read should use."""
+    raw = current_conf_overlay().get(entry.key)
+    if raw is not None:
+        v = entry._convert(raw)
+        if entry.validator is not None and not entry.validator(v):
+            raise ValueError(
+                f"Invalid value {v!r} for {entry.key}: "
+                f"{entry.validator_msg}")
+        return v
+    if ctx is not None:
+        return ctx.conf.get(entry)
+    return entry.default
+
+
+def _append_batch(target: Dict[str, np.ndarray], new, name: str
+                  ) -> Dict[str, np.ndarray]:
+    """BY POSITION, as SQL INSERT without a column list (the source may be
+    arbitrary select expressions); incoming NULLs coerce to the TARGET
+    column's convention (NaN numeric, None object)."""
+    from cycloneml_tpu.sql.catalog import coerce_insert_column
+    from cycloneml_tpu.sql.plan import _concat
+    tnames = [k for k in target if k != "__len__"]
+    new_names = [k for k in new if k != "__len__"]
+    if len(new_names) != len(tnames):
+        raise ValueError(
+            f"INSERT provides {len(new_names)} columns; "
+            f"{name!r} has {len(tnames)}")
+    merged = {}
+    for k, src in zip(tnames, new_names):
+        tcol = np.asarray(target[k])
+        ncol = coerce_insert_column(tcol.dtype, np.asarray(new[src]))
+        merged[k] = _concat([tcol, ncol])
+    return merged
+
 
 class CycloneSession:
-    def __init__(self, ctx=None):
-        self.ctx = ctx
+    def __init__(self, ctx=None, warehouse: Optional[str] = None,
+                 _parent: Optional["CycloneSession"] = None):
+        from cycloneml_tpu.sql.catalog import (PersistentCatalog,
+                                               SessionCatalog)
+        self.ctx = ctx if ctx is not None or _parent is None else _parent.ctx
         # Scan for base tables / CTAS snapshots; arbitrary plans for views
         # (INSERT distinguishes them by isinstance)
-        self._catalog: Dict[str, LogicalPlan] = {}
+        self._temp: Dict[str, LogicalPlan] = {}
+        if _parent is not None:
+            self._shared = _parent._shared
+            self._external = _parent._external
+            base = _parent._temp
+            # session conf starts from the parent's as defaults (the
+            # reference's newSession clones SQLConf)
+            self.session_conf: Dict[str, str] = dict(_parent.session_conf)
+        else:
+            self._shared: Dict[str, LogicalPlan] = {}
+            if warehouse is None and ctx is not None:
+                from cycloneml_tpu.conf import SQL_WAREHOUSE_DIR
+                warehouse = ctx.conf.get(SQL_WAREHOUSE_DIR) or None
+            self._external = (PersistentCatalog(warehouse)
+                              if warehouse else None)
+            base = None
+            self.session_conf = {}
+        self._catalog = SessionCatalog(self._temp, self._shared,
+                                       base_temp=base,
+                                       external=self._external)
+
+    def new_session(self) -> "CycloneSession":
+        """A sibling session: own temp views and session conf, SHARED
+        tables and persistent catalog (ref SparkSession.newSession)."""
+        return CycloneSession(_parent=self)
+
+    @property
+    def external_catalog(self):
+        return self._external
 
     # -- construction ----------------------------------------------------------
     def create_data_frame(self, data, schema: Optional[Sequence[str]] = None
@@ -49,7 +149,7 @@ class CycloneSession:
     def register_temp_view(self, name: str, df: DataFrame) -> None:
         """(ref Dataset.createOrReplaceTempView)"""
         batch = df.to_dict()  # views materialize: plans are cheap, data is host
-        self._catalog[name] = Scan(batch, name)
+        self._temp[name] = Scan(batch, name)
 
     def table(self, name: str) -> DataFrame:
         if name in getattr(self, "_stream_tables", {}):
@@ -72,8 +172,33 @@ class CycloneSession:
     # -- SQL -------------------------------------------------------------------
     def sql(self, query: str) -> DataFrame:
         """Execute a statement. SELECT returns its DataFrame; CREATE VIEW /
-        CREATE TABLE AS / INSERT INTO mutate the catalog and return an empty
-        DataFrame (the reference's DDL/DML also returns an empty Dataset)."""
+        CREATE TABLE AS / INSERT INTO / DROP mutate the catalog and SET
+        reads/writes session conf; DDL/DML return an empty DataFrame (the
+        reference's DDL also returns an empty Dataset)."""
+        m = _SET_KV_RE.match(query)
+        if m:
+            key, value = m.group(1), m.group(2).strip("'\"")
+            from cycloneml_tpu.conf import _REGISTRY
+            entry = _REGISTRY.get(key)
+            if entry is not None:
+                # validate at SET time: a bad value must fail HERE, not as
+                # an untyped error deep inside some later join
+                v = entry._convert(value)
+                if entry.validator is not None and not entry.validator(v):
+                    raise ValueError(
+                        f"Invalid value {v!r} for {key}: "
+                        f"{entry.validator_msg}")
+            self.session_conf[key] = value
+            return self.create_data_frame(
+                {"key": np.array([key], dtype=object),
+                 "value": np.array([value], dtype=object)})
+        m = _SET_GET_RE.match(query)
+        if m and m.group(1).upper() not in ("TRUE", "FALSE"):
+            key = m.group(1)
+            value = self.session_conf.get(key, "<undefined>")
+            return self.create_data_frame(
+                {"key": np.array([key], dtype=object),
+                 "value": np.array([str(value)], dtype=object)})
         from cycloneml_tpu.sql.parser import parse_sql_statement
         stmt = parse_sql_statement(query, self._catalog)
         kind = stmt[0]
@@ -81,7 +206,7 @@ class CycloneSession:
             return DataFrame(stmt[1], self)
         if kind == "create_view":
             _, name, plan, replace = stmt
-            if name in self._catalog and not replace:
+            if name in self._temp and not replace:
                 raise ValueError(
                     f"view {name!r} already exists; use CREATE OR REPLACE")
             from cycloneml_tpu.sql.plan import find_relations
@@ -104,43 +229,87 @@ class CycloneSession:
                     frontier.extend(find_relations(sub))
             # a view is a NAMED PLAN — lazy, recomputed per query, exactly
             # the reference's temp-view semantics (Dataset.createTempView)
-            self._catalog[name] = plan
+            self._temp[name] = plan
         elif kind == "ctas":
             _, name, plan, replace = stmt
-            if name in self._catalog and not replace:
+            # a same-named temp view would SHADOW the new table, making it
+            # silently unreachable in this session; with REPLACE the view
+            # yields (the old single-namespace behavior), without it this
+            # is an error
+            if name in self._temp and not replace:
                 raise ValueError(
-                    f"table {name!r} already exists; use CREATE OR REPLACE")
-            self._catalog[name] = Scan(plan.execute(), name)  # materialized
+                    f"temp view {name!r} already exists; DROP VIEW it "
+                    "or use CREATE OR REPLACE")
+            with session_conf_scope(self.session_conf):
+                batch = plan.execute()  # BEFORE unshadowing: the plan is
+                # late-bound and may SELECT from the view it replaces
+            self._temp.pop(name, None)
+            if self._external is not None:
+                # CREATE TABLE is a CATALOG table: it lands in the
+                # warehouse and survives this process (HiveExternalCatalog
+                # role); existence checking happens under the catalog lock
+                self._external.create(name, batch, replace=replace)
+            else:
+                if name in self._shared and not replace:
+                    raise ValueError(
+                        f"table {name!r} already exists; "
+                        "use CREATE OR REPLACE")
+                # no warehouse configured: shared across sibling sessions,
+                # process-lived
+                self._shared[name] = Scan(batch, name)
         elif kind == "insert":
             _, name, plan = stmt
-            target = self._catalog.get(name)
-            if not isinstance(target, Scan):
-                raise ValueError(
-                    f"INSERT target {name!r} is not a base table"
-                    + ("" if target is not None else " (not registered)"))
-            new = plan.execute()
-            new_names = [k for k in new if k != "__len__"]
-            if len(new_names) != len(target.data):
-                raise ValueError(
-                    f"INSERT provides {len(new_names)} columns; "
-                    f"{name!r} has {len(target.data)}")
-            from cycloneml_tpu.sql.plan import _concat
-            # BY POSITION, as SQL INSERT without a column list (the source
-            # may be arbitrary select expressions); incoming NULLs coerce to
-            # the TARGET column's convention (NaN numeric, None object)
-            merged = {}
-            for k, src in zip(target.data, new_names):
-                tcol = np.asarray(target.data[k])
-                ncol = np.asarray(new[src])
-                if tcol.dtype.kind in "if" and ncol.dtype == object:
-                    ncol = np.array([np.nan if v is None else float(v)
-                                     for v in ncol.tolist()])
-                elif tcol.dtype == object and ncol.dtype.kind == "f":
-                    ncol = np.array([None if np.isnan(v) else v
-                                     for v in ncol.tolist()], dtype=object)
-                merged[k] = _concat([tcol, ncol])
-            self._catalog[name] = Scan(merged, name)
+            self._insert(name, plan)
+        elif kind == "drop":
+            _, obj, name, if_exists = stmt
+            self._drop(obj, name, if_exists)
         return DataFrame(Scan({}, "empty"), self)
+
+    def _insert(self, name: str, plan: LogicalPlan) -> None:
+        with session_conf_scope(self.session_conf):
+            new = plan.execute()
+        # in-memory layers first (temp shadows shared shadows base), then
+        # the persistent layer — the same resolution order as reads. A hit
+        # in the BASE session's views copies-on-write into THIS session's
+        # temp layer: a server connection appending to a driver-seeded
+        # view must never mutate what other connections see (review r5)
+        base = self._catalog.base_temp or {}
+        for layer in (self._temp, self._shared, base):
+            if name in layer:
+                target = layer[name]
+                if not isinstance(target, Scan):
+                    raise ValueError(
+                        f"INSERT target {name!r} is not a base table")
+                dest = self._temp if layer is base else layer
+                dest[name] = Scan(
+                    _append_batch(target.data, new, name), name)
+                return
+        if self._external is not None and self._external.exists(name):
+            self._external.insert(name, new)
+            return
+        raise ValueError(
+            f"INSERT target {name!r} is not a base table (not registered)")
+
+    def _drop(self, obj: str, name: str, if_exists: bool) -> None:
+        if obj == "view":
+            if name in self._temp:
+                del self._temp[name]
+            elif name in (self._catalog.base_temp or {}):
+                # visible through the base session but not ours to delete
+                raise ValueError(
+                    f"view {name!r} belongs to the base session; it "
+                    "cannot be dropped from a derived session")
+            elif not if_exists:
+                raise ValueError(f"view {name!r} not found")
+            return
+        if name in self._shared:
+            del self._shared[name]
+        elif self._external is not None and self._external.exists(name):
+            self._external.drop(name)
+        elif name in self._temp:  # lenient: DROP TABLE on a temp scan
+            del self._temp[name]
+        elif not if_exists:
+            raise ValueError(f"table {name!r} not found")
 
     @property
     def read_stream(self):

@@ -1,0 +1,240 @@
+"""Durable catalog + per-connection SQL sessions (round-4 verdict item 1):
+CREATE TABLE AS metadata AND data survive process restart via the
+warehouse directory (HiveExternalCatalog role), the SQL server shares the
+catalog across connections while giving each connection its OWN session
+(SparkSQLSessionManager role), and temp views / SET conf never leak
+between connections."""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+from cycloneml_tpu.sql.session import CycloneSession
+
+
+@pytest.fixture()
+def warehouse(tmp_path):
+    return str(tmp_path / "warehouse")
+
+
+def _seed(session):
+    df = session.create_data_frame({
+        "k": np.array(["a", "b", "a", "c"], dtype=object),
+        "v": np.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    session.register_temp_view("t", df)
+
+
+def test_ctas_survives_process_restart(warehouse):
+    """The restart test the verdict demands — in a REAL second process."""
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    s.sql("CREATE TABLE agg AS SELECT k, SUM(v) AS sv FROM t GROUP BY k")
+    del s  # 'kill' the first server/session
+    code = textwrap.dedent(f"""
+        import numpy as np
+        from cycloneml_tpu.sql.session import CycloneSession
+        s = CycloneSession(warehouse={warehouse!r})
+        assert s.catalog_tables() == ['agg'], s.catalog_tables()
+        out = s.sql('SELECT * FROM agg ORDER BY k').to_dict()
+        assert out['k'].tolist() == ['a', 'b', 'c']
+        np.testing.assert_allclose(out['sv'], [4.0, 2.0, 4.0])
+        s.sql("INSERT INTO agg VALUES ('z', 9.0)")
+        print('OK')
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    # the INSERT from the second process is visible back here
+    s3 = CycloneSession(warehouse=warehouse)
+    out = s3.sql("SELECT * FROM agg ORDER BY k").to_dict()
+    assert out["k"].tolist() == ["a", "b", "c", "z"]
+    np.testing.assert_allclose(out["sv"], [4.0, 2.0, 4.0, 9.0])
+
+
+def test_sql_server_restart_sees_catalog(warehouse):
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    srv = CycloneSQLServer(s)
+    with SQLClient(srv.address) as c:
+        c.execute("CREATE TABLE kept AS SELECT k, v FROM t WHERE v > 1.5")
+    srv.stop()
+    # a brand-new server over a brand-new session: tables persist
+    s2 = CycloneSession(warehouse=warehouse)
+    srv2 = CycloneSQLServer(s2)
+    try:
+        with SQLClient(srv2.address) as c:
+            cols, rows = c.execute("SELECT * FROM kept ORDER BY v")
+            assert cols == ["k", "v"]
+            assert [r[1] for r in rows] == [2.0, 3.0, 4.0]
+    finally:
+        srv2.stop()
+
+
+def test_two_client_temp_view_isolation(warehouse):
+    """Same temp-view name, different contents, no collision — and each
+    connection's SET conf is its own (verdict item 2)."""
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    srv = CycloneSQLServer(s)
+    try:
+        with SQLClient(srv.address) as c1, SQLClient(srv.address) as c2:
+            c1.execute("CREATE OR REPLACE TEMP VIEW mine AS "
+                       "SELECT k FROM t WHERE v <= 1.0")
+            c2.execute("CREATE OR REPLACE TEMP VIEW mine AS "
+                       "SELECT k FROM t WHERE v >= 3.0")
+            _, r1 = c1.execute("SELECT COUNT(*) AS n FROM mine")
+            _, r2 = c2.execute("SELECT COUNT(*) AS n FROM mine")
+            assert r1 == [[1]]  # only v=1.0
+            assert r2 == [[2]]  # v=3.0 and v=4.0
+            # session conf: SET in one connection is invisible in the other
+            c1.execute("SET cyclone.sql.autoBroadcastJoinThreshold = 111")
+            c2.execute("SET cyclone.sql.autoBroadcastJoinThreshold = 222")
+            _, g1 = c1.execute("SET cyclone.sql.autoBroadcastJoinThreshold")
+            _, g2 = c2.execute("SET cyclone.sql.autoBroadcastJoinThreshold")
+            assert g1 == [["cyclone.sql.autoBroadcastJoinThreshold", "111"]]
+            assert g2 == [["cyclone.sql.autoBroadcastJoinThreshold", "222"]]
+            # catalog tables REMAIN shared: c1's CTAS is visible to c2
+            c1.execute("CREATE TABLE shared_tbl AS SELECT k FROM t")
+            _, rows = c2.execute("SELECT COUNT(*) AS n FROM shared_tbl")
+            assert rows == [[4]]
+    finally:
+        srv.stop()
+
+
+def test_temp_view_shadows_persistent_table(warehouse):
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    s.sql("CREATE TABLE shadow AS SELECT k FROM t")
+    df = s.create_data_frame({"k": np.array(["only"], dtype=object)})
+    s.register_temp_view("shadow", df)
+    out = s.sql("SELECT * FROM shadow").to_dict()
+    assert out["k"].tolist() == ["only"]  # temp wins, Spark's order
+    s.sql("DROP VIEW shadow")
+    out = s.sql("SELECT * FROM shadow").to_dict()
+    assert len(out["k"]) == 4  # the table resurfaces
+
+
+def test_drop_table_and_if_exists(warehouse):
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    s.sql("CREATE TABLE d1 AS SELECT k FROM t")
+    assert "d1" in s.catalog_tables()
+    s.sql("DROP TABLE d1")
+    assert "d1" not in s.catalog_tables()
+    with pytest.raises(ValueError, match="not found"):
+        s.sql("DROP TABLE d1")
+    s.sql("DROP TABLE IF EXISTS d1")  # no error
+    with pytest.raises(ValueError, match="already exists"):
+        s.sql("CREATE TABLE e1 AS SELECT k FROM t")
+        s.sql("CREATE TABLE e1 AS SELECT k FROM t")
+    s.sql("CREATE OR REPLACE TABLE e1 AS SELECT k FROM t WHERE v > 3.5")
+    out = s.sql("SELECT * FROM e1").to_dict()
+    assert out["k"].tolist() == ["c"]
+
+
+def test_insert_coercion_and_multipart_read(warehouse):
+    """INSERT appends PART files; reads concatenate; NULLs coerce to the
+    target column's convention across the parquet boundary."""
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    s.sql("CREATE TABLE parts AS SELECT k, v FROM t WHERE v < 1.5")
+    s.sql("INSERT INTO parts VALUES ('x', NULL)")
+    s.sql("INSERT INTO parts VALUES (NULL, 7.5)")
+    s2 = CycloneSession(warehouse=warehouse)
+    out = s2.sql("SELECT * FROM parts").to_dict()
+    assert out["k"].tolist() == ["a", "x", None]
+    assert out["v"][0] == 1.0 and np.isnan(out["v"][1]) and out["v"][2] == 7.5
+    # three INSERTs → three part files on disk
+    cat = s2.external_catalog
+    assert cat is not None and cat._read_meta("parts")["parts"] == 3
+
+
+def test_no_warehouse_tables_shared_in_process(tmp_path):
+    """Without a warehouse dir, CTAS lands in the process-shared layer:
+    sibling sessions see it, a new 'process' (fresh base session) does
+    not — the documented in-memory fallback."""
+    s = CycloneSession()
+    _seed(s)
+    s.sql("CREATE TABLE mem AS SELECT k FROM t")
+    sib = s.new_session()
+    assert sib.sql("SELECT COUNT(*) AS n FROM mem").to_dict()["n"][0] == 4
+    fresh = CycloneSession()
+    assert "mem" not in fresh.catalog_tables()
+
+
+def test_concurrent_create_same_table(warehouse):
+    """8 threads CREATE OR REPLACE the same table: unique staging dirs
+    mean no FileExistsError/clobber; the survivor is one complete write
+    (review r5)."""
+    import threading
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    errors = []
+
+    def create(i):
+        try:
+            sess = s.new_session()
+            sess.sql("CREATE OR REPLACE TABLE racy AS "
+                     "SELECT k, v FROM t")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=create, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    out = CycloneSession(warehouse=warehouse).sql(
+        "SELECT COUNT(*) AS n FROM racy").to_dict()
+    assert out["n"][0] == 4
+    # no staging debris
+    import os
+    left = [e for e in os.listdir(warehouse) if ".stage." in e]
+    assert not left, left
+
+
+def test_insert_into_base_view_copies_on_write(warehouse):
+    """INSERT INTO a driver-seeded view from a derived session stays
+    connection-local (review r5: it used to write through to the base)."""
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    child = s.new_session()
+    child.sql("INSERT INTO t VALUES ('zz', 99.0)")
+    assert child.sql("SELECT COUNT(*) AS n FROM t").to_dict()["n"][0] == 5
+    # base session and sibling connections still see the original 4 rows
+    assert s.sql("SELECT COUNT(*) AS n FROM t").to_dict()["n"][0] == 4
+    assert s.new_session().sql(
+        "SELECT COUNT(*) AS n FROM t").to_dict()["n"][0] == 4
+    # and the child cannot DROP the base session's view
+    with pytest.raises(ValueError, match="base session"):
+        s.new_session().sql("DROP VIEW t")
+
+
+def test_set_validates_registered_keys_eagerly(warehouse):
+    s = CycloneSession(warehouse=warehouse)
+    with pytest.raises(ValueError):
+        s.sql("SET cyclone.sql.autoBroadcastJoinThreshold = 10MB")
+    with pytest.raises(ValueError):
+        s.sql("SET cyclone.sql.adaptive.enabled = maybe")
+    s.sql("SET cyclone.sql.adaptive.enabled = false")  # valid bool ok
+    # unregistered keys pass through as free-form strings
+    s.sql("SET my.app.key = anything goes")
+    _, = s.sql("SET my.app.key").to_dict()["value"]
+
+
+def test_ctas_rejects_shadowing_temp_view(warehouse):
+    s = CycloneSession(warehouse=warehouse)
+    _seed(s)
+    with pytest.raises(ValueError, match="temp view"):
+        s.sql("CREATE TABLE t AS SELECT k FROM t")
+    # with REPLACE the view yields (old single-namespace behavior)
+    s.sql("CREATE OR REPLACE TABLE t AS SELECT k FROM t WHERE v > 2.5")
+    out = s.sql("SELECT * FROM t ORDER BY k").to_dict()
+    assert out["k"].tolist() == ["a", "c"]
