@@ -344,6 +344,16 @@ SHUFFLE_SPILL_ROW_BUDGET = (
     .int_conf(1 << 20)
 )
 
+AUTH_SECRET = (
+    ConfigBuilder("cyclone.authenticate.secret")
+    .doc("Shared secret for the TCP fabric (exchange, deploy, heartbeats, "
+         "SQL server): every connection performs a mutual HMAC-SHA256 "
+         "challenge-response before any protocol byte (the role of "
+         "spark.authenticate / SaslRpcHandler.java:44). Empty = open "
+         "fabric. Spawned daemons inherit via CYCLONE_AUTH_SECRET.")
+    .str_conf("")
+)
+
 SQL_WAREHOUSE_DIR = (
     ConfigBuilder("cyclone.sql.warehouse.dir")
     .doc("Warehouse directory for the PERSISTENT catalog (Spark's "

@@ -41,11 +41,13 @@ WORKER_TIMEOUT_S = 60.0
 
 
 def _send(addr: str, msg: dict, timeout: float = 30.0) -> dict:
+    from cycloneml_tpu.util.tcp import check_not_challenge, connect_authed
     host, port = addr.rsplit(":", 1)
-    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+    with connect_authed(host, port, timeout=timeout) as s:
         s.sendall((json.dumps(msg) + "\n").encode())
         fh = s.makefile("r")
         line = fh.readline()
+    check_not_challenge(line)
     return json.loads(line) if line.strip() else {}
 
 

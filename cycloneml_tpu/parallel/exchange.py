@@ -247,12 +247,14 @@ class HashExchange:
         if s is None:
             import time
             host, port = self.addresses[peer].rsplit(":", 1)
+            from cycloneml_tpu.util.tcp import connect_authed
             deadline = time.monotonic() + 60
             while True:
                 try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=120)
+                    s = connect_authed(host, port, timeout=120)
                     break
+                except PermissionError:
+                    raise  # wrong secret never resolves by retrying
                 except OSError:
                     # peers start independently; retry until the receiver
                     # has bound its port (the reference's block transfer

@@ -158,16 +158,10 @@ class HeartbeatServer:
                     # checks) are normal background noise, not errors
                     pass
 
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
+        from cycloneml_tpu.util.tcp import start_tcp_server
+        self._server = start_tcp_server(host, port, Handler,
+                                        "cyclone-heartbeat-server")
         self.host, self.port = self._server.server_address
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            name="cyclone-heartbeat-server", daemon=True)
-        self._thread.start()
 
     @property
     def address(self) -> str:
@@ -176,7 +170,6 @@ class HeartbeatServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
-        self._thread.join(timeout=5)
 
 
 class HeartbeatSender:
@@ -202,10 +195,13 @@ class HeartbeatSender:
         self._thread.start()
 
     def _send(self, msg: str) -> str:
-        import socket
-        with socket.create_connection(self._addr, timeout=5) as s:
+        from cycloneml_tpu.util.tcp import (check_not_challenge,
+                                            connect_authed)
+        with connect_authed(self._addr[0], self._addr[1], timeout=5) as s:
             s.sendall((msg + "\n").encode())
-            return s.makefile("r").readline().strip()
+            reply = s.makefile("r").readline().strip()
+        check_not_challenge(reply)
+        return reply
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -217,6 +213,12 @@ class HeartbeatSender:
                     if self._send(f"HB {self.worker_id}") == "EXPIRED":
                         self._registered = False  # re-register next tick
                         continue
+            except PermissionError:
+                # wrong fabric secret: retrying can never succeed — stop
+                # the loop loudly instead of spinning silently forever
+                logger.error("heartbeat authentication rejected for %s; "
+                             "stopping sender", self.worker_id)
+                return
             except OSError:
                 pass  # driver unreachable: retry next interval
             self._stop.wait(self.interval_s)

@@ -125,21 +125,29 @@ class PersistentCatalog:
 
     # -- catalog surface ----------------------------------------------------
     def tables(self) -> List[str]:
-        try:
-            entries = os.listdir(self.location)
-        except FileNotFoundError:
-            return []
-        return sorted(
-            e for e in entries
-            if _NAME_RE.match(e)
-            and os.path.exists(os.path.join(self.location, e, "_meta.json")))
+        # under the lock: a concurrent CREATE OR REPLACE swaps the table
+        # dir via rename-out/rename-in, and only lock-free readers could
+        # observe the in-between instant where the name is absent
+        with self._lock():
+            try:
+                entries = os.listdir(self.location)
+            except FileNotFoundError:
+                return []
+            return sorted(
+                e for e in entries
+                if _NAME_RE.match(e)
+                and os.path.exists(os.path.join(self.location, e,
+                                                "_meta.json")))
 
     def exists(self, name: str) -> bool:
-        return (bool(_NAME_RE.match(name))
-                and os.path.exists(self._meta_path(name)))
+        if not _NAME_RE.match(name):
+            return False
+        with self._lock():
+            return os.path.exists(self._meta_path(name))
 
     def schema(self, name: str) -> List[str]:
-        return list(self._read_meta(name)["columns"])
+        with self._lock():
+            return list(self._read_meta(name)["columns"])
 
     def create(self, name: str, batch: Dict[str, np.ndarray],
                replace: bool = False) -> None:
@@ -209,11 +217,23 @@ class PersistentCatalog:
 
     def read(self, name: str) -> Dict[str, np.ndarray]:
         from cycloneml_tpu.sql.io import read_parquet
-        with self._lock():
-            meta = self._read_meta(name)
-            parts = [read_parquet(os.path.join(
-                self._dir(name), f"part-{i:05d}.parquet"))
-                for i in range(meta["parts"])]
+        for attempt in (0, 1):
+            # lock only the meta snapshot: part files are immutable once
+            # written (INSERT appends new parts; REPLACE/DROP rename the
+            # whole dir), so decoding outside the lock can't see torn
+            # data — at worst a concurrent REPLACE removes the dir
+            # mid-read, surfacing as FileNotFoundError, and one retry
+            # reads the replacement consistently
+            with self._lock():
+                meta = self._read_meta(name)
+            try:
+                parts = [read_parquet(os.path.join(
+                    self._dir(name), f"part-{i:05d}.parquet"))
+                    for i in range(meta["parts"])]
+                break
+            except FileNotFoundError:
+                if attempt:
+                    raise
         if len(parts) == 1:
             batch = parts[0]
         else:

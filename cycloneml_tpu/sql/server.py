@@ -58,7 +58,8 @@ class CycloneSQLServer:
     the session catalog itself is driver-side state, as in the
     reference's shared HiveThriftServer2 SQLContext)."""
 
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
         self.session = session
         # statements serialize: the session catalog is a plain dict with
         # check-then-act DDL/DML sequences (the same discipline as
@@ -88,7 +89,7 @@ class CycloneSQLServer:
 
         from cycloneml_tpu.util.tcp import start_tcp_server
         self._server = start_tcp_server(host, port, Handler,
-                                        "cyclone-sqlsrv")
+                                        "cyclone-sqlsrv", secret=secret)
         self.host, self.port = self._server.server_address
         self.address = f"{self.host}:{self.port}"
         logger.info("cyclone SQL server listening on %s", self.address)
@@ -113,14 +114,16 @@ class SQLClient:
     rows); typed server errors re-raise by kind (AnalysisException and
     friends surface as such, like HiveServer2's typed SQLExceptions)."""
 
-    def __init__(self, address: str, timeout: Optional[float] = None):
+    def __init__(self, address: str, timeout: Optional[float] = None,
+                 secret: Optional[str] = None):
         # timeout=None (default) blocks until the statement finishes: the
         # wire has NO request ids, so a timed-out request would leave its
         # late reply in the stream and desynchronize every later execute —
         # hence any timeout hit PERMANENTLY fails this connection
+        from cycloneml_tpu.util.tcp import connect_authed
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._sock = connect_authed(host, int(port), secret=secret,
+                                    timeout=timeout)
         self._fh = self._sock.makefile("rw")
         self._broken = False
 
@@ -139,6 +142,8 @@ class SQLClient:
             raise
         if not line:
             raise IOError("SQL server closed the connection")
+        from cycloneml_tpu.util.tcp import check_not_challenge
+        check_not_challenge(line)
         rep = json.loads(line)
         if not rep.get("ok"):
             kind = rep.get("kind", "")

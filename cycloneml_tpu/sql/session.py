@@ -240,6 +240,13 @@ class CycloneSession:
                 raise ValueError(
                     f"temp view {name!r} already exists; DROP VIEW it "
                     "or use CREATE OR REPLACE")
+            if name in (self._catalog.base_temp or {}):
+                # the base session's view is not ours to unshadow (and on
+                # the warehouse path it resolves AHEAD of catalog tables)
+                # — a table by this name would be silently unreachable
+                raise ValueError(
+                    f"{name!r} names a base-session view here; a table "
+                    "by that name would be shadowed — pick another name")
             with session_conf_scope(self.session_conf):
                 batch = plan.execute()  # BEFORE unshadowing: the plan is
                 # late-bound and may SELECT from the view it replaces

@@ -3,12 +3,166 @@
 Every host-tier service (deploy master, exchange receive, heartbeats,
 remote SQL) is the same shape: a ThreadingTCPServer with reuse-addr and
 daemon handler threads, served from a daemon thread. One helper keeps
-shutdown/config fixes in one place."""
+shutdown/config fixes in one place.
+
+Authentication: when a shared secret is configured
+(``cyclone.authenticate.secret`` on the active context, or the
+``CYCLONE_AUTH_SECRET`` env var for daemons that predate a context), every
+connection performs a MUTUAL HMAC-SHA256 challenge-response before a
+single protocol byte flows — the role SASL DIGEST-MD5 / AES auth plays on
+every channel in the reference (ref: common/network-common/.../sasl/
+SaslRpcHandler.java:44, crypto/AuthRpcHandler.java). One handshake covers
+all four services (exchange, deploy, heartbeats, SQL server) because they
+all build on this module. The secret itself never crosses the wire; each
+side proves possession by MACing the other's fresh nonce, so the exchange
+also defeats replay. (Transport encryption remains out of scope, as does
+the reference's optional SASL encryption layer.)"""
 
 from __future__ import annotations
 
+import hmac
+import os
+import socket
 import socketserver
 import threading
+from hashlib import sha256
+from typing import Optional
+
+_MAGIC = b"CYAUTH1"
+_HANDSHAKE_TIMEOUT_S = 20.0
+
+
+def shared_secret(explicit: Optional[str] = None) -> Optional[str]:
+    """Resolve the fabric secret: explicit arg > active context conf >
+    ``CYCLONE_AUTH_SECRET`` env (how spawned daemons inherit it)."""
+    if explicit:
+        return explicit
+    try:
+        from cycloneml_tpu.context import active_context
+        ctx = active_context()
+        if ctx is not None and hasattr(ctx, "conf"):
+            from cycloneml_tpu.conf import AUTH_SECRET
+            s = ctx.conf.get(AUTH_SECRET)
+            if s:
+                return s
+    except Exception:
+        pass
+    return os.environ.get("CYCLONE_AUTH_SECRET") or None
+
+
+def _mac(secret: str, role: bytes, nonce: bytes) -> bytes:
+    return hmac.new(secret.encode(), role + b"|" + nonce,
+                    sha256).hexdigest().encode()
+
+
+def _recv_line(sock: socket.socket, maxlen: int = 256) -> bytes:
+    """Byte-at-a-time line read on the RAW socket: nothing beyond the
+    newline is consumed, so buffered readers created afterwards see the
+    stream exactly where the handshake left it."""
+    buf = bytearray()
+    while len(buf) < maxlen:
+        b = sock.recv(1)
+        if not b:
+            break
+        if b == b"\n":
+            return bytes(buf)
+        buf += b
+    return bytes(buf)
+
+
+def server_handshake(sock: socket.socket, secret: str) -> bool:
+    """Server side: challenge, verify the client's proof, return ours.
+    False (after best-effort DENY) on any mismatch or malformed reply."""
+    prev = sock.gettimeout()
+    try:
+        sock.settimeout(_HANDSHAKE_TIMEOUT_S)
+        nonce_s = os.urandom(16).hex().encode()
+        sock.sendall(_MAGIC + b" " + nonce_s + b"\n")
+        parts = _recv_line(sock).split()
+        if len(parts) != 3 or parts[0] != _MAGIC:
+            sock.sendall(b"CYDENY\n")
+            return False
+        nonce_c, proof = parts[1], parts[2]
+        if not hmac.compare_digest(proof, _mac(secret, b"client", nonce_s)):
+            sock.sendall(b"CYDENY\n")
+            return False
+        sock.sendall(b"CYOK " + _mac(secret, b"server", nonce_c) + b"\n")
+        return True
+    except OSError:
+        return False
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
+
+
+def client_handshake(sock: socket.socket, secret: str) -> None:
+    """Client side; raises PermissionError on rejection or when the
+    SERVER fails its proof (a secretless imposter endpoint)."""
+    prev = sock.gettimeout()
+    try:
+        sock.settimeout(_HANDSHAKE_TIMEOUT_S)
+        parts = _recv_line(sock).split()
+        if len(parts) != 2 or parts[0] != _MAGIC:
+            raise PermissionError(
+                "peer did not issue an auth challenge (secret configured "
+                "here but not on the server?)")
+        nonce_s = parts[1]
+        nonce_c = os.urandom(16).hex().encode()
+        sock.sendall(_MAGIC + b" " + nonce_c + b" "
+                     + _mac(secret, b"client", nonce_s) + b"\n")
+        reply = _recv_line(sock).split()
+        if len(reply) != 2 or reply[0] != b"CYOK" or not hmac.compare_digest(
+                reply[1], _mac(secret, b"server", nonce_c)):
+            raise PermissionError("fabric authentication rejected")
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
+
+
+def connect_authed(host: str, port: int, secret: Optional[str] = None,
+                   timeout: Optional[float] = None) -> socket.socket:
+    """``create_connection`` + client handshake when a secret resolves."""
+    s = socket.create_connection((host, int(port)), timeout=timeout)
+    sec = shared_secret(secret)
+    if sec:
+        try:
+            client_handshake(s, sec)
+        except BaseException:
+            s.close()
+            raise
+    return s
+
+
+def check_not_challenge(line) -> None:
+    """Line-protocol clients call this on each reply: a reply that is the
+    server's AUTH CHALLENGE means the server requires a secret this client
+    did not resolve — fail loudly instead of mis-parsing the challenge as
+    protocol data and retrying forever (the reverse misconfiguration of a
+    wrong secret)."""
+    probe = line if isinstance(line, bytes) else str(line).encode()
+    if probe.startswith(_MAGIC):
+        raise PermissionError(
+            "server requires fabric authentication but no secret is "
+            "configured on this client (set cyclone.authenticate.secret "
+            "or CYCLONE_AUTH_SECRET)")
+
+
+def _authed_handler(handler_cls, secret: str):
+    class AuthedHandler(handler_cls):
+        def handle(self):
+            # raw-socket handshake BEFORE the protocol handler reads:
+            # _recv_line never over-consumes, so rfile/makefile readers
+            # pick up exactly at the first protocol byte
+            if not server_handshake(self.request, secret):
+                return
+            super().handle()
+
+    AuthedHandler.__name__ = f"Authed{handler_cls.__name__}"
+    return AuthedHandler
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -16,10 +170,16 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def start_tcp_server(host: str, port: int, handler_cls,
-                     name: str) -> socketserver.ThreadingTCPServer:
+def start_tcp_server(host: str, port: int, handler_cls, name: str,
+                     secret: Optional[str] = None
+                     ) -> socketserver.ThreadingTCPServer:
     """Bind, serve_forever on a daemon thread, return the server (its
-    ``server_address`` carries the bound port when ``port=0``)."""
+    ``server_address`` carries the bound port when ``port=0``). The
+    fabric secret is resolved ONCE at bind time; when set, every
+    connection must pass the mutual handshake before its handler runs."""
+    sec = shared_secret(secret)
+    if sec:
+        handler_cls = _authed_handler(handler_cls, sec)
     srv = _Server((host, int(port)), handler_cls)
     t = threading.Thread(target=srv.serve_forever, daemon=True, name=name)
     t.start()

@@ -1,0 +1,192 @@
+"""Shared-secret authentication across the TCP fabric (round-4 verdict
+item 3): one mutual HMAC challenge-response in util/tcp.py covers the
+exchange, deploy master, heartbeat, and SQL server wires — wrong-secret
+connections are rejected per service, right-secret end-to-end flows stay
+green. Ref: common/network-common/.../sasl/SaslRpcHandler.java:44."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.util.tcp import (client_handshake, connect_authed,
+                                    server_handshake, start_tcp_server)
+
+SECRET = "round5-fabric-secret"
+
+
+def test_handshake_unit_right_and_wrong():
+    a, b = socket.socketpair()
+    try:
+        res = {}
+        t = threading.Thread(
+            target=lambda: res.update(ok=server_handshake(a, SECRET)))
+        t.start()
+        client_handshake(b, SECRET)  # no raise
+        t.join(5)
+        assert res["ok"] is True
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        res = {}
+        t = threading.Thread(
+            target=lambda: res.update(ok=server_handshake(a, SECRET)))
+        t.start()
+        with pytest.raises(PermissionError):
+            client_handshake(b, "not-the-secret")
+        t.join(5)
+        assert res["ok"] is False
+    finally:
+        a.close()
+        b.close()
+
+
+def test_sql_server_auth(monkeypatch):
+    from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+    from cycloneml_tpu.sql.session import CycloneSession
+    s = CycloneSession()
+    df = s.create_data_frame({"v": np.array([1.0, 2.0])})
+    s.register_temp_view("t", df)
+    srv = CycloneSQLServer(s, secret=SECRET)
+    try:
+        with SQLClient(srv.address, secret=SECRET) as c:
+            _, rows = c.execute("SELECT SUM(v) AS sv FROM t")
+            assert rows == [[3.0]]
+        host, port = srv.address.rsplit(":", 1)
+        with pytest.raises(PermissionError):
+            connect_authed(host, int(port), secret="wrong")
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_auth(monkeypatch):
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", SECRET)
+    from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                                   HeartbeatSender,
+                                                   HeartbeatServer)
+    recv = HeartbeatReceiver(timeout_s=30)
+    srv = HeartbeatServer(recv)
+    try:
+        sender = HeartbeatSender("w1", srv.address, interval_s=0.1)
+        deadline = 50
+        import time
+        while deadline and "w1" not in recv._last:
+            time.sleep(0.1)
+            deadline -= 1
+        sender.stop()
+        assert "w1" in recv._last
+        with pytest.raises(PermissionError):
+            connect_authed(srv.host, srv.port, secret="wrong")
+    finally:
+        srv.stop()
+
+
+def test_deploy_master_auth(monkeypatch):
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", SECRET)
+    from cycloneml_tpu.deploy import MasterDaemon, _send
+    m = MasterDaemon(port=0)
+    try:
+        rep = _send(m.address, {"cmd": "STATUS"})
+        assert isinstance(rep, dict) and rep  # authed round-trip works
+        host, port = m.address.rsplit(":", 1)
+        with pytest.raises(PermissionError):
+            connect_authed(host, int(port), secret="wrong")
+    finally:
+        m.stop()
+
+
+def test_exchange_auth(monkeypatch):
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", SECRET)
+    from cycloneml_tpu.parallel.exchange import HashExchange
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    addrs = [f"127.0.0.1:{free_port()}", f"127.0.0.1:{free_port()}"]
+    out = {}
+
+    def worker(rank):
+        ex = HashExchange(rank, addrs, n_buckets=4, round_id=991991)
+        ex.put_all([(i, rank) for i in range(20)])
+        out[rank] = {b: list(p) for b, p in ex.finish(timeout=30).items()}
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    # every key landed with its owner: the authed fabric carried data
+    n = sum(len(v) for d in out.values() for v in d.values())
+    assert n == 40, out
+    host, port = addrs[0].rsplit(":", 1)
+    with pytest.raises(PermissionError):
+        connect_authed(host, int(port), secret="wrong")
+
+
+def test_secretless_fabric_stays_open():
+    """No secret configured → no handshake, plain protocol (the
+    reference's spark.authenticate=false default)."""
+    import socketserver
+
+    class Echo(socketserver.StreamRequestHandler):
+        def handle(self):
+            self.wfile.write(self.rfile.readline())
+
+    srv = start_tcp_server("127.0.0.1", 0, Echo, "echo-test")
+    try:
+        host, port = srv.server_address
+        with connect_authed(host, port, secret=None) as s:
+            s.sendall(b"ping\n")
+            assert s.makefile("rb").readline() == b"ping\n"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_secretless_client_fails_loudly_on_authed_server(monkeypatch):
+    """The reverse misconfiguration: server authed, client secretless —
+    line clients must raise PermissionError on the challenge instead of
+    mis-parsing it / silently spinning (review r5)."""
+    from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+    from cycloneml_tpu.sql.session import CycloneSession
+    srv = CycloneSQLServer(CycloneSession(), secret=SECRET)
+    try:
+        monkeypatch.delenv("CYCLONE_AUTH_SECRET", raising=False)
+        with SQLClient(srv.address) as c:  # no secret resolves
+            with pytest.raises(PermissionError, match="requires fabric"):
+                c.execute("SELECT 1 AS one")
+    finally:
+        srv.stop()
+    # heartbeat sender: stops its loop on the same detection
+    from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                                   HeartbeatSender,
+                                                   HeartbeatServer)
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", SECRET)
+    recv = HeartbeatReceiver(timeout_s=30)
+    hsrv = HeartbeatServer(recv)
+    monkeypatch.delenv("CYCLONE_AUTH_SECRET")
+    try:
+        import time
+        sender = HeartbeatSender("w2", hsrv.address, interval_s=0.05)
+        time.sleep(0.8)
+        assert "w2" not in recv._last
+        assert not sender._thread.is_alive()  # loop stopped loudly
+    finally:
+        hsrv.stop()
+
+
+def test_ctas_rejects_base_session_view_name(tmp_path):
+    from cycloneml_tpu.sql.session import CycloneSession
+    base = CycloneSession(warehouse=str(tmp_path / "wh"))
+    df = base.create_data_frame({"v": np.array([1.0])})
+    base.register_temp_view("seeded", df)
+    child = base.new_session()
+    with pytest.raises(ValueError, match="base-session view"):
+        child.sql("CREATE TABLE seeded AS SELECT v FROM seeded")
