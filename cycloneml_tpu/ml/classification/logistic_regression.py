@@ -493,10 +493,19 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 # space (ref LogisticRegression.scala:1018-1024 dgemv adapt)
                 icpt = icpt - sol[: d * num_classes].reshape(
                     num_classes, d) @ scaled_mean
-            if reg == 0.0:
-                # center for identifiability, as the reference does when the
-                # multinomial problem has no regularization
-                wmat = wmat - wmat.mean(axis=0, keepdims=True)
+            if not self._has_bounds():
+                if reg == 0.0:
+                    # center coefficients for identifiability, as the
+                    # reference does when the multinomial problem has no
+                    # regularization (LogisticRegression.scala:656-674,
+                    # following glmnet)
+                    wmat = wmat - wmat.mean(axis=0, keepdims=True)
+                # intercepts are NEVER regularized, so their additive
+                # constant stays free under ANY regParam — the reference
+                # centers them unconditionally for multinomial
+                # (LogisticRegression.scala:676-681); without this, L1
+                # fits match glmnet in coefficients but drift in
+                # intercepts by a shared constant
                 if fit_intercept:
                     icpt = icpt - icpt.mean()
             model = LogisticRegressionModel(

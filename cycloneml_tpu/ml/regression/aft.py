@@ -49,6 +49,16 @@ class _AFTParams(HasMaxIter, HasTol, HasFitIntercept, HasAggregationDepth,
                     default=[0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99])
         self._param("quantilesCol", "quantiles output column", default="")
 
+    def set_censor_col(self, v):
+        return self.set("censorCol", v)
+
+    def set_quantile_probabilities(self, v):
+        """(ref AFTSurvivalRegression[Model].setQuantileProbabilities)"""
+        return self.set("quantileProbabilities", list(v))
+
+    def set_quantiles_col(self, v):
+        return self.set("quantilesCol", v)
+
 
 class AFTSurvivalRegression(Predictor, _AFTParams, MLWritable, MLReadable):
     def __init__(self, uid=None, **kwargs):
@@ -56,12 +66,6 @@ class AFTSurvivalRegression(Predictor, _AFTParams, MLWritable, MLReadable):
         self._declare_aft_params()
         for k, v in kwargs.items():
             self.set(k, v)
-
-    def set_censor_col(self, v):
-        return self.set("censorCol", v)
-
-    def set_quantile_probabilities(self, v):
-        return self.set("quantileProbabilities", list(v))
 
     def _fit(self, frame: MLFrame) -> "AFTSurvivalRegressionModel":
         x = np.asarray(frame[self.get("featuresCol")], dtype=np.float64)

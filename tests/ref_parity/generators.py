@@ -97,14 +97,31 @@ def generate_linear_input(intercept, weights, x_mean, x_variance, n_points,
 
 def generate_glm_input(intercept, coefficients, x_mean, x_variance,
                        n_points, seed, noise_level, family, link):
-    """Gaussian-family GLM data: features from java.util.Random uniforms,
-    noise from a SEPARATE XORShiftRandom gaussian stream
-    (StandardNormalGenerator.setSeed(seed))."""
-    if family != "gaussian":
-        raise NotImplementedError(
-            "poisson/gamma noise uses commons-math3; gaussian only")
+    """GLM data: features from java.util.Random uniforms; noise from the
+    family's generator stream — gaussian uses XORShiftRandom
+    (StandardNormalGenerator), poisson/gamma use commons-math3
+    Well19937c-backed samplers with the sampled MEAN subtracted
+    (GeneralizedLinearRegressionSuite.scala:1728-1744:
+    ``label = mu + noiseLevel * (generator.nextValue() - mean)``)."""
+    from tests.ref_parity.commons_rng import GammaSampler, PoissonSampler
+
+    class _Gauss:
+        def __init__(self, s):
+            self._r = XORShiftRandom(s)
+
+        def next_value(self):
+            return self._r.next_gaussian()
+
+    if family == "gaussian":
+        gen, gen_mean = _Gauss(seed), 0.0
+    elif family == "poisson":
+        gen, gen_mean = PoissonSampler(1.0, seed), 1.0
+    elif family == "gamma":
+        gen, gen_mean = GammaSampler(1.0, 1.0, seed), 1.0
+    else:
+        raise NotImplementedError(family)
     rnd = JavaRandom(seed)
-    noise = XORShiftRandom(seed)
+    noise = gen
     w = np.asarray(coefficients)
     d = len(w)
     scale = np.sqrt(12.0 * np.asarray(x_variance))
@@ -125,8 +142,64 @@ def generate_glm_input(intercept, coefficients, x_mean, x_variance,
             mu = 1.0 / eta
         else:
             raise ValueError(link)
-        y[i] = mu + noise_level * noise.next_gaussian()
+        y[i] = mu + noise_level * (noise.next_value() - gen_mean)
     return X, y
+
+
+def generate_aft_input(num_features, x_mean, x_variance, n_points, seed,
+                       weibull_shape, weibull_scale, exponential_mean):
+    """AFTSurvivalRegressionSuite.scala:96 generateAFTInput: features are
+    java.util.Random uniforms rescaled to mean/variance; the label is a
+    Weibull draw, censored against an Exponential draw — both from their
+    OWN commons-math3 Well19937c streams seeded identically. Draw order:
+    ALL feature rows first, then (weibull, exponential) pairs per row."""
+    from tests.ref_parity.commons_rng import (ExponentialSampler,
+                                              WeibullSampler)
+    weibull = WeibullSampler(weibull_shape, weibull_scale, seed)
+    exponential = ExponentialSampler(exponential_mean, seed)
+    rnd = JavaRandom(seed)
+    X = np.empty((n_points, num_features))
+    for i in range(n_points):
+        for j in range(num_features):
+            X[i, j] = rnd.next_double()
+    X = (X - 0.5) * np.sqrt(12.0 * np.asarray(x_variance)) \
+        + np.asarray(x_mean)
+    label = np.empty(n_points)
+    censor = np.empty(n_points)
+    for i in range(n_points):
+        w = weibull.next_value()
+        e = exponential.next_value()
+        label[i] = w
+        censor[i] = 1.0 if w <= e else 0.0
+    return X, label, censor
+
+
+# the multinomialDataset family (LogisticRegressionSuite.scala:105-155):
+# 3-class softmax draws with a rand(seed) weight column over 4 partitions
+_MULTI_COEF = [-0.57997, 0.912083, -0.371077, -0.819866, 2.688191,
+               -0.16624, -0.84355, -0.048509, -0.301789, 4.170682]
+_MULTI_XMEAN = [5.843, 3.057, 3.758, 1.199]
+_MULTI_XVAR = [0.6856, 0.1899, 3.116, 0.581]
+_MULTI_SMALLVAR_XMEAN = [5.843, 3.057, 3.758, 10.199]
+_MULTI_SMALLVAR_XVAR = [0.6856, 0.1899, 3.116, 0.001]
+
+
+def multinomial_dataset(seed=42, n_points=10000, small_var=False):
+    x_mean = _MULTI_SMALLVAR_XMEAN if small_var else _MULTI_XMEAN
+    x_var = _MULTI_SMALLVAR_XVAR if small_var else _MULTI_XVAR
+    X, y = generate_multinomial_logistic_input(
+        _MULTI_COEF, x_mean, x_var, True, n_points, seed)
+    w = np.array(sql_rand_column(seed, n_points, 4))
+    return X, y, w
+
+
+def multinomial_dataset_zero_var(seed=42, n_points=100):
+    """multinomialDatasetWithZeroVar: 2 features, one with zero variance,
+    weight identically 1.0 (lit(1.0))."""
+    X, y = generate_multinomial_logistic_input(
+        [-0.57997, 0.912083, -0.371077, -0.16624, -0.84355, -0.048509],
+        [5.843, 3.0], [0.6856, 0.0], True, n_points, seed)
+    return X, y, np.ones(n_points)
 
 
 # the binaryDataset shared by every weighted golden LR test

@@ -67,6 +67,35 @@ def _dataset(name):
             gen._BINARY_COEF, gen._BINARY_XMEAN, gen._BINARY_XVAR,
             True, 10000, 42)
         out = {"features": X, "label": y}
+    elif name.startswith("glm_poisson_") or name.startswith("glm_gamma_"):
+        # GeneralizedLinearRegressionSuite.scala:87-126 datasetPoisson*/
+        # datasetGamma*: noise streams are commons-math3 Well19937c ports
+        _, fam, link = name.split("_", 2)
+        log_like = link == "log"
+        icpt = 0.25 if log_like else 2.5
+        coef = [0.22, 0.06] if log_like else [2.2, 0.6]
+        X, y = gen.generate_glm_input(icpt, coef, [2.9, 10.5], [0.7, 1.2],
+                                      10000, 42, 0.01, fam, link)
+        out = {"features": X, "label": y}
+    elif name == "multinomial_weighted":
+        X, y, w = gen.multinomial_dataset()
+        out = {"features": X, "label": y, "weight": w}
+    elif name == "multinomial_smallvar":
+        X, y, w = gen.multinomial_dataset(n_points=50000, small_var=True)
+        out = {"features": X, "label": y, "weight": w}
+    elif name == "multinomial_zero_var":
+        X, y, w = gen.multinomial_dataset_zero_var()
+        out = {"features": X, "label": y, "weight": w}
+    elif name == "aft_univariate":
+        # AFTSurvivalRegressionSuite.scala:41 datasetUnivariate
+        X, label, censor = gen.generate_aft_input(
+            1, [5.5], [0.8], 1000, 42, 1.0, 2.0, 2.0)
+        out = {"features": X, "label": label, "censor": censor}
+    elif name == "aft_multivariate":
+        # AFTSurvivalRegressionSuite.scala:43 datasetMultivariate
+        X, label, censor = gen.generate_aft_input(
+            2, [0.9, -1.3], [0.7, 1.2], 1000, 42, 1.5, 2.5, 2.0)
+        out = {"features": X, "label": label, "censor": censor}
     else:
         raise KeyError(name)
     _cache[name] = out
@@ -132,6 +161,127 @@ def test_glm_golden(ctx, case):
     params.setdefault("maxIter", 100)
     params.setdefault("tol", 1e-6)
     _check(GeneralizedLinearRegression(**params).fit(frame), case)
+
+
+@pytest.mark.parametrize("case", GOLDEN["multinomial_logistic_regression"],
+                         ids=lambda c: c["id"])
+def test_multinomial_logistic_golden(ctx, case):
+    """Multinomial LR vs the glmnet constants the reference commits
+    (LogisticRegressionSuite.scala:1470+): coefficient MATRICES at the
+    reference's own tolerances, plus the pivoting invariant (class-sums
+    are zero for unregularized softmax from zero init)."""
+    data = _dataset(case["dataset"])
+    frame = MLFrame(ctx, data)
+    params = dict(case["params"])
+    params.setdefault("family", "multinomial")
+    # drive OUR optimizer to the objective's optimum: the R constants ARE
+    # the optimum, and the assertion tolerances stay the reference's own.
+    # (The suite's maxIter/tol are breeze-calibrated; our OWLQN stopping
+    # rule needs a tighter tol to reach the same point — convergence
+    # verified: at tol=1e-10 the L1 fits land within ~1e-4 of glmnet.)
+    params["maxIter"] = max(int(params.get("maxIter", 0)), 800)
+    params["tol"] = 1e-10
+    lr = LogisticRegression(**params)
+    lr.set("weightCol", "weight")
+    model = lr.fit(frame)
+    coef = np.asarray(model.coefficient_matrix.to_array(), dtype=np.float64)
+    icpt = np.asarray(model.intercept_vector.to_array(), dtype=np.float64)
+    exp_coef = np.asarray(case["coefficients"])
+    if case.get("sum_to_zero"):
+        np.testing.assert_allclose(coef.sum(axis=0), 0.0, atol=1e-5,
+                                   err_msg=case["ref"])
+        if case["params"].get("fitIntercept", True):
+            np.testing.assert_allclose(icpt.sum(), 0.0, atol=1e-5,
+                                       err_msg=case["ref"])
+    if "coef_abs_tol" in case:
+        np.testing.assert_allclose(coef, exp_coef, rtol=0,
+                                   atol=case["coef_abs_tol"],
+                                   err_msg=case["ref"])
+    else:
+        # tiny atol floor covers exact-zero entries under a rel tolerance
+        # (the reference's ~= relTol treats those via its own epsilon)
+        np.testing.assert_allclose(coef, exp_coef,
+                                   rtol=case["coef_rel_tol"], atol=1e-3,
+                                   err_msg=case["ref"])
+    if case.get("intercepts") is not None:
+        exp_icpt = np.asarray(case["intercepts"])
+        if "icpt_abs_tol" in case:
+            np.testing.assert_allclose(icpt, exp_icpt, rtol=0,
+                                       atol=case["icpt_abs_tol"],
+                                       err_msg=case["ref"])
+        else:
+            np.testing.assert_allclose(icpt, exp_icpt,
+                                       rtol=case["icpt_rel_tol"],
+                                       atol=1e-4, err_msg=case["ref"])
+
+
+@pytest.mark.parametrize("case", GOLDEN["glm_literal"],
+                         ids=lambda c: c["id"])
+def test_glm_literal_golden(ctx, case):
+    """GLM configs whose datasets the reference embeds as literals —
+    tweedie grids, poisson-with-zeros, intercept-only, weight+offset
+    (GeneralizedLinearRegressionSuite.scala:484-895)."""
+    rows = case["data"]
+    data = {"label": np.asarray(rows["label"], dtype=np.float64),
+            "features": np.asarray(rows["features"],
+                                   dtype=np.float64).reshape(
+                                       len(rows["label"]), -1)}
+    if "weight" in rows:
+        data["weight"] = np.asarray(rows["weight"], dtype=np.float64)
+    if "offset" in rows:
+        data["offset"] = np.asarray(rows["offset"], dtype=np.float64)
+    frame = MLFrame(ctx, data)
+    params = dict(case["params"])
+    params.setdefault("maxIter", 100)
+    params.setdefault("tol", 1e-7)
+    model = GeneralizedLinearRegression(**params).fit(frame)
+    tol = case["abs_tol"]
+    np.testing.assert_allclose(float(model.intercept), case["intercept"],
+                               atol=tol, rtol=0, err_msg=case["ref"])
+    if case["coefficients"]:
+        np.testing.assert_allclose(
+            np.asarray(model.coefficients.to_array(), dtype=np.float64),
+            case["coefficients"], atol=tol, rtol=0, err_msg=case["ref"])
+    if "deviance" in case:
+        np.testing.assert_allclose(model.summary.deviance,
+                                   case["deviance"], atol=1e-3, rtol=0,
+                                   err_msg=case["ref"])
+
+
+@pytest.mark.parametrize("case", GOLDEN["aft"], ids=lambda c: c["id"])
+def test_aft_golden(ctx, case):
+    """AFT survival regression vs the reference's committed R survreg
+    constants (AFTSurvivalRegressionSuite.scala:130-337), on bit-exact
+    reproductions of generateAFTInput (Weibull/Exponential draws from
+    the Well19937c port)."""
+    from cycloneml_tpu.ml.regression import AFTSurvivalRegression
+    data = _dataset(case["dataset"])
+    frame = MLFrame(ctx, data)
+    params = dict(case["params"])
+    params.setdefault("maxIter", 200)
+    params.setdefault("tol", 1e-9)
+    model = AFTSurvivalRegression(**params).fit(frame)
+    rtol = case["rel_tol"]
+    if case["intercept"] == 0.0:
+        assert abs(model.intercept) < 1e-12, case["ref"]
+    else:
+        np.testing.assert_allclose(model.intercept, case["intercept"],
+                                   rtol=rtol, err_msg=case["ref"])
+    np.testing.assert_allclose(
+        np.asarray(model.coefficients.to_array(), dtype=np.float64),
+        case["coefficients"], rtol=rtol, err_msg=case["ref"])
+    np.testing.assert_allclose(model.scale, case["scale"], rtol=rtol,
+                               err_msg=case["ref"])
+    pr = case.get("predict")
+    if pr:
+        x = np.asarray([pr["features"]])
+        np.testing.assert_allclose(
+            float(model._predict_batch(x)[0]), pr["response"], rtol=rtol,
+            err_msg=case["ref"])
+        model.set_quantile_probabilities(pr["quantile_probs"])
+        np.testing.assert_allclose(
+            model.predict_quantiles(x)[0], pr["quantiles"], rtol=rtol,
+            err_msg=case["ref"])
 
 
 def test_rng_ports_match_jdk_vectors():
