@@ -74,6 +74,30 @@ def main():
         pal = _time_chain(pal_step, coef0, (x, y, w))
         rows.append(("logistic", f"{n}x{d}", xla, pal))
 
+    # -- SCALED binomial logistic (folded standardization, raw X) --------
+    from cycloneml_tpu.ops.kernels import fused_binary_logistic_scaled
+    for n, d in [(131072, 512), (262144, 128)]:
+        x = jnp.asarray(rng.randn(n, d), jnp.float32)
+        y = jnp.asarray(rng.rand(n) > 0.5, jnp.float32)
+        w = jnp.ones(n, jnp.float32)
+        inv_std = jnp.asarray(1.0 / (rng.rand(d) + 0.5), jnp.float32)
+        smean = jnp.asarray(rng.randn(d), jnp.float32)
+        coef0 = jnp.asarray(rng.randn(d + 1), jnp.float32)
+        agg_s = aggregators.binary_logistic_scaled(d, True)
+
+        def xla_step(coef, xv, yv, wv, isv, smv):
+            out = agg_s(xv, yv, wv, isv, smv, coef)
+            return coef - 1e-9 * out["grad"]
+
+        def pal_step(coef, xv, yv, wv, isv, smv):
+            out = fused_binary_logistic_scaled(
+                xv, yv, wv, isv, smv, coef, d, True)
+            return coef - 1e-9 * out["grad"]
+
+        xla = _time_chain(xla_step, coef0, (x, y, w, inv_std, smean))
+        pal = _time_chain(pal_step, coef0, (x, y, w, inv_std, smean))
+        rows.append(("logistic_scaled", f"{n}x{d}", xla, pal))
+
     # -- kmeans assignment: (n, d) x (k, d) ------------------------------
     hi = jax.lax.Precision.HIGHEST
     for n, d, k in [(131072, 128, 100), (65536, 256, 1000)]:

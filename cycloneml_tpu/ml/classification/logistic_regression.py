@@ -27,7 +27,7 @@ from cycloneml_tpu.linalg.vectors import DenseVector, Vectors
 from cycloneml_tpu.ml.base import Predictor, ProbabilisticClassificationModel
 from cycloneml_tpu.ml.optim import LBFGS, LBFGSB, OWLQN, aggregators
 from cycloneml_tpu.ml.optim.loss import (
-    DistributedLossFunction, l2_regularization, standardize_dataset,
+    DistributedLossFunction, l2_regularization,
 )
 from cycloneml_tpu.ml.param import ParamValidators as V
 from cycloneml_tpu.ml.shared import (
@@ -357,27 +357,16 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         tp_active = (not is_multinomial) and m > 1 and d % m == 0
         use_pallas = (not is_multinomial and hasattr(ds.ctx, "conf")
                       and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
-        # plain binomial AND multinomial paths: standardization (and
-        # fitWithMean centering) folds INTO the aggregator read — no
-        # standardized copy exists, so the fit's HBM working set is X
-        # itself, and the pre-fit standardize pass disappears (r3 verdict
-        # item 4). The feature-sharded / pallas paths keep the
-        # materialized copy.
-        use_scaled = not (tp_active or use_pallas)
+        # EVERY fit path folds standardization (and fitWithMean centering)
+        # INTO the aggregator read — no standardized copy exists anywhere:
+        # replicated binomial/multinomial since r4; the feature-sharded TP
+        # program and the Pallas kernel path since r5 (r4 verdict item 3 —
+        # the paths that exist for models too big for one chip must not
+        # carry 2× the memory they need). The fit's HBM working set is X
+        # itself and the pre-fit standardize pass disappears.
         from cycloneml_tpu.ml.optim.loss import inv_std_vector
         inv_std = inv_std_vector(features_std)
         scaled_mean = stats.mean * inv_std if fit_with_mean else None
-        if use_scaled:
-            ds_std = ds
-        else:
-            ds_std, inv_std = standardize_dataset(
-                ds, features_std,
-                center_mean=stats.mean if fit_with_mean else None)
-            # the standardized copy registers with the context's storage
-            # tiers for the fit's duration (≈ the reference persisting
-            # instance blocks MEMORY_AND_DISK): under a tight device
-            # budget its pressure demotes cold cached datasets, not the fit
-            ds_std.persist()
 
         if is_multinomial:
             # always the scaled aggregator: the TP/pallas alternatives are
@@ -394,12 +383,11 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 features_std=np.tile(features_std, num_classes),
                 standardize=standardize) if l2 > 0 else None
         else:
-            if use_scaled:
-                agg = aggregators.binary_logistic_scaled(d, fit_intercept)
-            elif use_pallas:
-                agg = aggregators.binary_logistic_pallas(d, fit_intercept)
+            if use_pallas:
+                agg = aggregators.binary_logistic_pallas_scaled(
+                    d, fit_intercept)
             else:
-                agg = aggregators.binary_logistic(d, fit_intercept)
+                agg = aggregators.binary_logistic_scaled(d, fit_intercept)
             n_coef = d + (1 if fit_intercept else 0)
             x0 = np.zeros(n_coef)
             if fit_intercept and 0 < histogram[1:].sum() < weight_sum:
@@ -409,26 +397,24 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 l2, d, fit_intercept, features_std=features_std,
                 standardize=standardize) if l2 > 0 else None
 
+        mu_or_zero = scaled_mean if fit_with_mean else np.zeros(d)
         if tp_active:
-            # model axis present: feature-shard the blocks and coefficients
-            # (SURVEY §5.7a — the path for d beyond one device's HBM). The
-            # mesh layout is the user's explicit opt-in; binomial only (the
+            # model axis present: feature-shard the RAW blocks, the
+            # coefficients, AND the standardization vectors (SURVEY §5.7a
+            # — the path for d beyond one device's HBM; binomial only, the
             # multinomial aggregator stays replicated for now).
-            x_tp = fs.feature_sharded_put(rt, ds_std.x)
+            x_tp = fs.feature_sharded_put(rt, ds.x)
             loss_fn = fs.FeatureShardedLossFunction(
-                rt, x_tp, ds_std.y, ds_std.w, d, fit_intercept, l2_fn,
-                weight_sum, ctx=ds.ctx)
-        elif use_scaled:
+                rt, x_tp, ds.y, ds.w, d, fit_intercept, l2_fn,
+                weight_sum, ctx=ds.ctx, inv_std=inv_std,
+                scaled_mean=mu_or_zero)
+        else:
             import jax.numpy as jnp
             xdt = ds.x.dtype
-            mu_or_zero = (scaled_mean if fit_with_mean
-                          else np.zeros(d))
             loss_fn = DistributedLossFunction(
                 ds, agg, l2_fn, weight_sum,
                 extra_args=(jnp.asarray(inv_std.astype(xdt)),
                             jnp.asarray(mu_or_zero.astype(xdt))))
-        else:
-            loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, weight_sum)
 
         if self._has_bounds():
             # box-constrained path (ref createOptimizer selects BreezeLBFGSB
@@ -472,17 +458,13 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 opt = DeviceLBFGS(max_iter=self.get("maxIter"),
                                   tol=self.get("tol"), chunk=chunk)
 
-        try:
-            state = self._optimize(opt, loss_fn, x0, (
-                ds.n_rows, d, num_classes, float(weight_sum),
-                np.asarray(histogram).round(6).tolist(),
-                np.asarray(features_std).round(6).tolist(),
-                reg, alpha, self.get("tol"), fit_intercept, standardize,
-                fit_with_mean,
-            ))
-        finally:
-            if ds_std is not ds:  # the scaled path trains on ds itself
-                ds_std.unpersist()
+        state = self._optimize(opt, loss_fn, x0, (
+            ds.n_rows, d, num_classes, float(weight_sum),
+            np.asarray(histogram).round(6).tolist(),
+            np.asarray(features_std).round(6).tolist(),
+            reg, alpha, self.get("tol"), fit_intercept, standardize,
+            fit_with_mean,
+        ))
 
         sol = state.x
         if is_multinomial:

@@ -291,18 +291,20 @@ def autodiff_check(agg_loss_only: Callable, d: int):
     return jax.grad(agg_loss_only)
 
 
-def binary_logistic_pallas(d: int, fit_intercept: bool = True) -> Agg:
-    """Pallas-kernel twin of :func:`binary_logistic` — identical contract,
-    one fused VMEM pass per row tile (ops/kernels.fused_binary_logistic).
-    Selected by ``cyclone.ml.usePallasKernels``; math is f32 in-kernel."""
-    return _binary_logistic_pallas(d, fit_intercept)
+def binary_logistic_pallas_scaled(d: int, fit_intercept: bool = True) -> Agg:
+    """Pallas twin of :func:`binary_logistic_scaled`: raw feature blocks,
+    standardization folded around the kernel's row pass
+    (ops/kernels.fused_binary_logistic_scaled) — the kernel path no longer
+    needs the standardized copy either."""
+    return _binary_logistic_pallas_scaled(d, fit_intercept)
 
 
 @functools.lru_cache(maxsize=None)
-def _binary_logistic_pallas(d: int, fit_intercept: bool) -> Agg:
-    from cycloneml_tpu.ops.kernels import fused_binary_logistic
+def _binary_logistic_pallas_scaled(d: int, fit_intercept: bool) -> Agg:
+    from cycloneml_tpu.ops.kernels import fused_binary_logistic_scaled
 
-    def agg(x, y, w, coef):
-        return fused_binary_logistic(x, y, w, coef, d, fit_intercept)
+    def agg(x, y, w, inv_std, scaled_mean, coef):
+        return fused_binary_logistic_scaled(
+            x, y, w, inv_std, scaled_mean, coef, d, fit_intercept)
 
     return agg

@@ -97,6 +97,54 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
     return {"loss": loss[0, 0], "grad": grad, "count": aux[0, 1]}
 
 
+def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
+                                 d: int, fit_intercept: bool = True,
+                                 interpret: Optional[bool] = None,
+                                 row_tile: int = ROW_TILE
+                                 ) -> Dict[str, jnp.ndarray]:
+    """Folded-standardization twin of :func:`fused_binary_logistic`: the
+    kernel reads RAW feature rows — no standardized copy — because the
+    scaling is algebra OUTSIDE the row pass:
+
+      margin = x·(inv_std∘β) + (β₀ − scaled_mean·β)   (scaled vector +
+                                                       offset fold into the
+                                                       kernel's β/β₀ slots)
+      grad_β̂ = inv_std∘(Σ mult·x) − scaled_mean·Σmult (O(d) correction on
+                                                       the kernel's raw sums)
+
+    Same contract as ``aggregators.binary_logistic_scaled``; the kernel
+    itself is byte-identical to the unscaled one, so the A/B numbers carry.
+    """
+    if interpret is None:
+        interpret = not pallas_available()
+    dtype = jnp.float32
+    x = jnp.asarray(x, dtype)
+    y = jnp.asarray(y, dtype)
+    w = jnp.asarray(w, dtype)
+    coef = jnp.asarray(coef, dtype)
+    inv_std = jnp.asarray(inv_std, dtype)
+    scaled_mean = jnp.asarray(scaled_mean, dtype)
+    beta = coef[:d] if fit_intercept else coef
+    b0 = coef[d] if fit_intercept else jnp.zeros((), dtype)
+    sb = inv_std * beta
+    off = b0 - jnp.dot(scaled_mean, beta)
+
+    x, y, w, n_pad, d_pad = _pad_rows_cols(x, y, w, row_tile)
+    beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
+    grid = (n_pad // row_tile,)
+    kernel = functools.partial(_run_logistic, row_tile=row_tile, d_pad=d_pad,
+                               grid=grid, interpret=interpret)
+    loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
+                                 beta_p, off)
+    msum = aux[0, 0]
+    g = inv_std * grad_row[0, :d] - scaled_mean * msum
+    if fit_intercept:
+        grad = jnp.concatenate([g, msum[None]])
+    else:
+        grad = g
+    return {"loss": loss[0, 0], "grad": grad, "count": aux[0, 1]}
+
+
 def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
     def kern(b0_ref, x_ref, y_ref, w_ref, beta_ref,
              loss_ref, grad_ref, aux_ref):

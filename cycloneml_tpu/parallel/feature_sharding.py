@@ -51,8 +51,9 @@ def feature_sharded_put(runtime: MeshRuntime, x):
     """Place (or re-place) a row-block array with features over ``model``.
 
     ``x`` may be a host array or an already device-resident row-sharded
-    array (the standardized dataset's blocks); resharding happens device-side
-    in the latter case. The feature dim must divide the model axis.
+    array (the RAW dataset's blocks — standardization folds into the TP
+    read); resharding happens device-side in the latter case. The feature
+    dim must divide the model axis.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -70,15 +71,27 @@ def beta_sharding(runtime: MeshRuntime):
 
 
 def binary_logistic_tp_program(runtime: MeshRuntime):
-    """Compiled ``(x, y, w, beta, b0) -> (loss, grad_beta, grad_b0, count)``.
+    """Compiled ``(x, y, w, beta, b0, inv_std, scaled_mean) ->
+    (loss, grad_beta, grad_b0, count)`` over RAW feature blocks.
 
-    The sparse twin of ``aggregators.binary_logistic`` for feature-sharded
-    dense blocks (ref BinaryLogisticBlockAggregator.scala:41): identical
-    math, with the margin assembled by a single psum over ``model``. loss /
-    count / grad_b0 are identical on every model shard (computed from the
-    full margins), so they reduce over the data axes only; grad_beta stays
-    model-sharded — it IS the output layout the optimizer wants when d is
-    too big to replicate.
+    The feature-sharded twin of ``aggregators.binary_logistic_scaled``
+    (ref BinaryLogisticBlockAggregator.scala:41): standardization and
+    fitWithMean centering fold INTO the read — ``inv_std`` and
+    ``scaled_mean`` are MODEL-SHARDED d-vectors (the same layout as beta),
+    so the path that exists precisely for models too big for one chip
+    carries X itself, not a standardized copy at 2× the HBM (r4 verdict
+    item 3). Margin assembly stays one psum over ``model`` — the scaling
+    contributions ride inside the same reduction:
+
+      margin = Σ_shards [x_blk·(inv_std_blk∘β_blk) − scaled_mean_blk·β_blk]
+               + β₀
+      grad_β_blk = inv_std_blk∘Σrows(x_blkᵀ mult) − scaled_mean_blk·Σmult
+
+    loss / count / grad_b0 are identical on every model shard (computed
+    from the full margins), so they reduce over the data axes only;
+    grad_beta stays model-sharded — it IS the output layout the optimizer
+    wants when d is too big to replicate. Pass inv_std=ones,
+    scaled_mean=zeros for the identity read.
     """
     key = ("binlog_tp", runtime.mesh)
     prog = _cache_get(key)
@@ -92,24 +105,29 @@ def binary_logistic_tp_program(runtime: MeshRuntime):
     rowfeat = P((REPLICA_AXIS, DATA_AXIS), MODEL_AXIS)
     rows = P((REPLICA_AXIS, DATA_AXIS))
 
-    def program(x, y, w, beta, b0):
-        def local(xb, yb, wb, bb, b0s):
-            pm = jnp.dot(xb, bb, precision=jax.lax.Precision.HIGHEST)
+    def program(x, y, w, beta, b0, inv_std, scaled_mean):
+        def local(xb, yb, wb, bb, b0s, isb, smb):
+            sb = isb * bb
+            pm = (jnp.dot(xb, sb, precision=jax.lax.Precision.HIGHEST)
+                  - jnp.dot(smb, bb, precision=jax.lax.Precision.HIGHEST))
             margin = jax.lax.psum(pm, MODEL_AXIS) + b0s
             loss = jnp.sum(wb * (jax.nn.softplus(margin) - yb * margin))
             mult = wb * (jax.nn.sigmoid(margin) - yb)
-            gb = jnp.dot(xb.T, mult, precision=jax.lax.Precision.HIGHEST)
-            gb0 = jnp.sum(mult)
+            gb_raw = jnp.dot(xb.T, mult, precision=jax.lax.Precision.HIGHEST)
+            gb0 = psum_over_mesh(jnp.sum(mult))  # global Σmult: the
+            # centering term needs it, and model shards agree on it
+            gb = isb * psum_over_mesh(gb_raw) - smb * gb0
             count = jnp.sum(wb)
             # rows are split over (data, replica): sum those axes; model
             # shards already agree on the scalars (full-margin computation)
-            return (psum_over_mesh(loss), psum_over_mesh(gb),
-                    psum_over_mesh(gb0), psum_over_mesh(count))
+            return (psum_over_mesh(loss), gb, gb0, psum_over_mesh(count))
 
         return shard_map_compat(
             local, mesh,
-            in_specs=(rowfeat, rows, rows, P(MODEL_AXIS), P()),
-            out_specs=(P(), P(MODEL_AXIS), P(), P()))(x, y, w, beta, b0)
+            in_specs=(rowfeat, rows, rows, P(MODEL_AXIS), P(),
+                      P(MODEL_AXIS), P(MODEL_AXIS)),
+            out_specs=(P(), P(MODEL_AXIS), P(), P()))(
+                x, y, w, beta, b0, inv_std, scaled_mean)
 
     prog = jax.jit(program)
     _cache_put(key, prog)
@@ -131,7 +149,10 @@ class FeatureShardedLossFunction:
 
     def __init__(self, runtime: MeshRuntime, x_sharded, y, w, d: int,
                  fit_intercept: bool, l2_reg_fn=None,
-                 weight_sum: Optional[float] = None, ctx=None):
+                 weight_sum: Optional[float] = None, ctx=None,
+                 inv_std: Optional[np.ndarray] = None,
+                 scaled_mean: Optional[np.ndarray] = None):
+        import jax
         import jax.numpy as jnp
         self._rt = runtime
         self._ctx = ctx
@@ -141,6 +162,17 @@ class FeatureShardedLossFunction:
         self.l2_reg_fn = l2_reg_fn
         self._prog = binary_logistic_tp_program(runtime)
         self._beta_sharding = beta_sharding(runtime)
+        # standardization vectors ride MODEL-SHARDED next to beta (folded
+        # read over RAW x — no standardized dataset copy on this path)
+        cdt = np.dtype(x_sharded.dtype)
+        inv_std = (np.ones(d) if inv_std is None
+                   else np.asarray(inv_std, dtype=np.float64))
+        scaled_mean = (np.zeros(d) if scaled_mean is None
+                       else np.asarray(scaled_mean, dtype=np.float64))
+        self._inv_std = jax.device_put(inv_std.astype(cdt),
+                                       self._beta_sharding)
+        self._scaled_mean = jax.device_put(scaled_mean.astype(cdt),
+                                           self._beta_sharding)
         if weight_sum is None:
             weight_sum = float(np.asarray(jnp.sum(self._w)))
         self.weight_sum = weight_sum
@@ -166,7 +198,8 @@ class FeatureShardedLossFunction:
         cdt = np.dtype(self._x.dtype)
         beta, b0 = self._split(coef, cdt)
         loss_t, gb_t, gb0_t, _ = jax.device_get(
-            self._prog(self._x, self._y, self._w, beta, b0))  # one transfer
+            self._prog(self._x, self._y, self._w, beta, b0,
+                       self._inv_std, self._scaled_mean))  # one transfer
         loss = float(loss_t) / self.weight_sum
         gb = np.asarray(gb_t, dtype=np.float64) / self.weight_sum
         if self.fit_intercept:
@@ -207,7 +240,8 @@ class FeatureShardedLossFunction:
         out = jax.device_get(prog(
             self._x, self._y, self._w, beta0, b0, dbeta, db0,
             cdt.type(value), cdt.type(dg0), cdt.type(init_alpha),
-            cdt.type(self.weight_sum), cdt.type(reg)))
+            cdt.type(self.weight_sum), cdt.type(reg),
+            self._inv_std, self._scaled_mean))
         alpha, v, gb, gb0, evals = out
         self.n_evals += int(evals)
         self.n_dispatches += 1
@@ -233,11 +267,12 @@ def _build_tp_line_search(runtime: MeshRuntime, c1: float, c2: float,
     tp_prog = binary_logistic_tp_program(runtime)
 
     def program(x, y, w, beta0, b0, dbeta, db0,
-                value0, dg0, init_alpha, ws, reg):
+                value0, dg0, init_alpha, ws, reg, inv_std, scaled_mean):
         def phi(alpha):
             beta = beta0 + alpha * dbeta
             b0a = b0 + alpha * db0
-            loss_t, gb, gb0, _ = tp_prog(x, y, w, beta, b0a)
+            loss_t, gb, gb0, _ = tp_prog(x, y, w, beta, b0a,
+                                         inv_std, scaled_mean)
             loss = (loss_t / ws).astype(cdt)
             gbn = (gb / ws).astype(cdt)
             gb0n = (gb0 / ws).astype(cdt)

@@ -128,3 +128,97 @@ def test_gramian_sharded_none_without_model_axis(ctx):
     from cycloneml_tpu.linalg.distributed import RowMatrix
     rm = RowMatrix(InstanceDataset.from_numpy(ctx, np.eye(8)))
     assert rm.compute_gramian_sharded() is None
+
+
+def test_tp_scaled_fold_matches_replicated_scaled(tp_ctx, ctx):
+    """r4 verdict item 3: the TP program folds standardization into the
+    read. Features with wildly different scales + centering: the TP fit
+    must land on the replicated scaled-aggregator fit."""
+    rng = np.random.RandomState(11)
+    n, d = 320, 16
+    scales = np.logspace(-2, 3, d)
+    x = rng.randn(n, d) * scales[None, :] + 5.0
+    logits = ((x - 5.0) / scales) @ rng.randn(d)  # O(1) per-feature signal
+    y = (logits + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    assert 0.2 < y.mean() < 0.8  # well-posed two-class problem
+
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    ds_tp = InstanceDataset.from_numpy(tp_ctx, x, y)
+    ds_rep = InstanceDataset.from_numpy(ctx, x, y)
+    lr = LogisticRegression(maxIter=80, regParam=0.05, tol=1e-10)
+    m_tp = lr._fit_dataset(ds_tp)
+    m_rep = lr._fit_dataset(ds_rep)
+    np.testing.assert_allclose(m_tp.coefficients.to_array(),
+                               m_rep.coefficients.to_array(),
+                               rtol=1e-5, atol=1e-9)
+    np.testing.assert_allclose(m_tp.intercept, m_rep.intercept, rtol=1e-5)
+
+
+def test_tp_fit_working_set_has_no_standardized_copy(tp_ctx):
+    """Assert the fit's extra device footprint is ONE resharded copy of X
+    (the TP placement), not two (+ a standardized copy, as before r5)."""
+    import gc
+
+    import jax
+
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    rng = np.random.RandomState(7)
+    n, d = 4096, 64
+    x = (rng.randn(n, d) * np.linspace(0.1, 30, d)[None, :])
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+
+    def live_bytes():
+        gc.collect()
+        return sum(a.nbytes for a in jax.live_arrays())
+
+    # NEW regime: the fit reshards RAW X only (standardization folded)
+    ds = InstanceDataset.from_numpy(tp_ctx, x, y)
+    _ = ds.x  # materialize the dataset's device representation
+    x_bytes = ds.x.nbytes
+    base = live_bytes()
+    LogisticRegression(maxIter=8, regParam=0.1).fit(ds)
+    new_delta = live_bytes() - base
+
+    # OLD regime (pre-r5): a standardized COPY of the dataset is built
+    # and THAT is resharded — reconstruct it to measure what the fold
+    # saves, robust to backend-internal reshard overheads
+    from cycloneml_tpu.ml.optim.loss import standardize_dataset
+    base2 = live_bytes()
+    ds_std, _inv = standardize_dataset(ds, x.std(axis=0))
+    x_tp_old = fs.feature_sharded_put(tp_ctx.mesh_runtime, ds_std.x)
+    old_delta = live_bytes() - base2
+    del x_tp_old, ds_std
+
+    assert new_delta <= old_delta - x_bytes, (
+        f"fit footprint {new_delta} not >=1×X below the old "
+        f"standardized-copy construction {old_delta} (X={x_bytes})")
+
+
+def test_pallas_scaled_kernel_matches_scaled_aggregator(ctx):
+    """fused_binary_logistic_scaled (interpret mode) == the XLA scaled
+    aggregator on raw blocks with centering."""
+    from cycloneml_tpu.ops.kernels import fused_binary_logistic_scaled
+    rng = np.random.RandomState(3)
+    n, d = 300, 20
+    x = rng.randn(n, d) * np.linspace(0.5, 8, d)[None, :] + 2.0
+    y = (rng.rand(n) > 0.4).astype(np.float64)
+    w = rng.rand(n) + 0.25
+    std = x.std(axis=0)
+    inv_std = 1.0 / std
+    scaled_mean = x.mean(axis=0) * inv_std
+    coef = rng.randn(d + 1)
+
+    agg = aggregators.binary_logistic_scaled(d, fit_intercept=True)
+    import jax.numpy as jnp
+    exp = agg(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+              jnp.asarray(inv_std), jnp.asarray(scaled_mean),
+              jnp.asarray(coef))
+    got = fused_binary_logistic_scaled(
+        x, y, w, inv_std, scaled_mean, coef, d, True, interpret=True)
+    np.testing.assert_allclose(float(got["loss"]), float(exp["loss"]),
+                               rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(exp["grad"]), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(float(got["count"]), float(exp["count"]),
+                               rtol=1e-6)
