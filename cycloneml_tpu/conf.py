@@ -380,12 +380,50 @@ AUTO_BROADCAST_JOIN_THRESHOLD = (
     .int_conf(10 * 1024 * 1024)
 )
 
+SKEW_JOIN_ENABLED = (
+    ConfigBuilder("cyclone.sql.adaptive.skewJoin.enabled")
+    .doc("AQE skew-join handling (Spark's conf name; ref "
+         "OptimizeSkewedJoin.scala:55): a shuffle-join bucket whose "
+         "byte estimate exceeds skewedPartitionFactor x the median AND "
+         "skewedPartitionThresholdInBytes is SPLIT across processes — "
+         "the splittable side's rows spread round-robin while the other "
+         "side's rows for that bucket are duplicated everywhere.")
+    .bool_conf(True)
+)
+
+SKEW_JOIN_FACTOR = (
+    ConfigBuilder("cyclone.sql.adaptive.skewJoin.skewedPartitionFactor")
+    .doc("A bucket is skew-eligible when its size exceeds this factor "
+         "times the median bucket size (Spark's default 5).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(5)
+)
+
+SKEW_JOIN_THRESHOLD = (
+    ConfigBuilder(
+        "cyclone.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes")
+    .doc("Minimum estimated bucket bytes before skew splitting applies "
+         "(Spark's default 256m).")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(256 * 1024 * 1024)
+)
+
+ADVISORY_PARTITION_BYTES = (
+    ConfigBuilder("cyclone.sql.adaptive.advisoryPartitionSizeInBytes")
+    .doc("Byte target for AQE post-shuffle coalescing (Spark's conf name "
+         "and semantics; CoalesceShufflePartitions): adjacent small "
+         "output partitions merge until their ESTIMATED bytes reach "
+         "this. 0 falls back to the row-count target "
+         "(advisoryPartitionRows).")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(64 * 1024 * 1024)
+)
+
 ADVISORY_PARTITION_ROWS = (
     ConfigBuilder("cyclone.sql.adaptive.advisoryPartitionRows")
-    .doc("Post-shuffle coalescing target: adjacent owned output "
-         "partitions smaller than this merge until they reach it (≈ "
-         "CoalesceShufflePartitions' advisoryPartitionSizeInBytes, in "
-         "rows for the host object tier).")
+    .doc("Row-count FALLBACK for AQE post-shuffle coalescing, applied "
+         "only when advisoryPartitionSizeInBytes is set to 0 — the byte "
+         "target (Spark's semantics) takes precedence by default.")
     .check_value(lambda v: v >= 1, "must be >= 1")
     .int_conf(1 << 16)
 )

@@ -209,16 +209,24 @@ class PartitionedDataset:
             n_owned = sum(1 for b in range(n_buckets)
                           if b % len(addresses) == rank)
             from cycloneml_tpu.conf import (ADAPTIVE_ENABLED,
+                                            ADVISORY_PARTITION_BYTES,
                                             ADVISORY_PARTITION_ROWS)
+            adaptive = self.ctx.conf.get(ADAPTIVE_ENABLED)
             advisory = (self.ctx.conf.get(ADVISORY_PARTITION_ROWS)
-                        if self.ctx.conf.get(ADAPTIVE_ENABLED) else None)
+                        if adaptive else None)
+            # byte target takes precedence (Spark's
+            # advisoryPartitionSizeInBytes semantics); rows are the
+            # fallback when it is explicitly zeroed
+            advisory_b = (self.ctx.conf.get(ADVISORY_PARTITION_BYTES)
+                          if adaptive else None)
 
             def fn(ps):
                 # _derive syncs num_partitions to whatever this returns,
                 # so the AQE-coalesced count is never misreported
                 return exchange_group_partitions(
                     (kv for p in ps for kv in p), rank, addresses,
-                    n_buckets, row_budget=budget, advisory_rows=advisory)
+                    n_buckets, row_budget=budget, advisory_rows=advisory,
+                    advisory_bytes=advisory_b)
             return self._derive(fn, "groupByKey(exchange)", n_owned)
 
         def fn(ps):

@@ -398,6 +398,74 @@ def exchange_allgather(value: Any, rank: int, addresses: List[str],
     return out
 
 
+def estimate_bucket_bytes(buckets: Iterable[int], rows: Iterable[Any],
+                          sample_per_bucket: int = 4) -> Dict[int, int]:
+    """Per-bucket byte ESTIMATES for pre-bucketed rows: pickled sizes of
+    the first few rows per bucket extrapolated by row count — the
+    stand-in for the reference's exact map-output sizes
+    (MapOutputStatistics), which our streaming exchange never
+    materializes as files first. Callers pass the bucket id per row so
+    the (key-pickling) hash happens once across stats + routing."""
+    counts: Dict[int, int] = {}
+    sampled: Dict[int, Tuple[int, int]] = {}  # bucket -> (n_sampled, bytes)
+    for b, r in zip(buckets, rows):
+        counts[b] = counts.get(b, 0) + 1
+        ns, sb = sampled.get(b, (0, 0))
+        if ns < sample_per_bucket:
+            sampled[b] = (ns + 1,
+                          sb + len(pickle.dumps(r,
+                                                pickle.HIGHEST_PROTOCOL)))
+    out = {}
+    for b, c in counts.items():
+        ns, sb = sampled[b]
+        out[b] = int(c * (sb / max(ns, 1)))
+    return out
+
+
+def plan_skew_splits(global_sizes: List[Dict[int, int]],
+                     can_split: Tuple[bool, bool], factor: float,
+                     threshold: int) -> Dict[int, int]:
+    """Pick buckets to split and WHICH side per bucket (0=left, 1=right).
+
+    The reference's eligibility rule (OptimizeSkewedJoin.scala:55): a
+    side's bucket is skewed when its bytes exceed BOTH ``threshold`` and
+    ``factor`` x the median of that side's non-empty buckets; a side may
+    only split when the join type keeps its unmatched-row emission
+    per-row local (inner both, left-outer left, right-outer right). When
+    both sides of one bucket qualify, the LARGER splits and the smaller
+    duplicates."""
+    skewed: List[Dict[int, int]] = []
+    for sizes in global_sizes:
+        vals = sorted(v for v in sizes.values() if v > 0)
+        if not vals:
+            skewed.append({})
+            continue
+        med = vals[len(vals) // 2]
+        cut = max(threshold, int(factor * med))
+        skewed.append({b: v for b, v in sizes.items() if v > cut})
+    out: Dict[int, int] = {}
+    for b in set(skewed[0]) | set(skewed[1]):
+        c0 = can_split[0] and b in skewed[0]
+        c1 = can_split[1] and b in skewed[1]
+        if c0 and c1:
+            out[b] = 0 if skewed[0][b] >= skewed[1][b] else 1
+        elif c0:
+            out[b] = 0
+        elif c1:
+            out[b] = 1
+    return out
+
+
+def split_bucket_label(bucket: int, peer: int, n_buckets: int,
+                       n_workers: int) -> int:
+    """Synthetic bucket label that (a) routes to ``peer`` under the
+    ``label % n_workers`` ownership map and (b) stays unique per
+    (bucket, peer) — how one skewed bucket's rows address EVERY process
+    while still arriving grouped."""
+    base = ((n_buckets + n_workers - 1) // n_workers) * n_workers
+    return base + bucket * n_workers + peer
+
+
 def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
                           addresses: List[str], n_buckets: int,
                           row_budget: int = 1 << 20,
@@ -420,10 +488,24 @@ def exchange_group_by_key(pairs: Iterable[Tuple[Any, Any]], rank: int,
     return stream()
 
 
+def _grouped_list_bytes(p: List[Tuple[Any, Any]]) -> int:
+    """Estimated bytes of a list partition of (key, values) groups:
+    pickled sizes of the first few groups extrapolated by group count."""
+    if not p:
+        return 0
+    s = 0
+    cnt = 0
+    for kv in p[:4]:
+        s += len(pickle.dumps(kv, pickle.HIGHEST_PROTOCOL))
+        cnt += 1
+    return int(len(p) * (s / cnt))
+
+
 def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
                               addresses: List[str], n_buckets: int,
                               row_budget: int = 1 << 20,
-                              advisory_rows: Optional[int] = None
+                              advisory_rows: Optional[int] = None,
+                              advisory_bytes: Optional[int] = None
                               ) -> List[Any]:
     """Distributed groupByKey materialized as OUTPUT PARTITIONS (one per
     owned bucket) for the RDD surface: small buckets become lists, buckets
@@ -431,11 +513,13 @@ def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
     :class:`SpilledPartition` sequences — the same output-spill contract as
     the in-process ``group_by_key``.
 
-    ``advisory_rows``: AQE post-shuffle coalescing (ref
-    CoalesceShufflePartitions): adjacent small LIST partitions merge until
-    they reach the advisory VALUE count, so a 64-bucket shuffle of a small
-    dataset does not fan downstream work over 64 near-empty partitions.
-    Disk-backed partitions never merge (they are big by definition)."""
+    AQE post-shuffle coalescing (ref CoalesceShufflePartitions): adjacent
+    small LIST partitions merge until they reach ``advisory_bytes``
+    (Spark's advisoryPartitionSizeInBytes semantics, over estimated
+    pickled bytes) or, when no byte target is set, ``advisory_rows`` —
+    so a 64-bucket shuffle of a small dataset does not fan downstream
+    work over 64 near-empty partitions. Disk-backed partitions never
+    merge (they are big by definition)."""
     ex = HashExchange(rank, addresses, n_buckets)
     ex.put_all(pairs)
     buckets = ex.finish()
@@ -451,22 +535,25 @@ def exchange_group_partitions(pairs: Iterable[Tuple[Any, Any]], rank: int,
         agg.insert_all(iter(part))
         part.delete()
         out.append(materialize_grouped(agg.items(), row_budget))
-    if advisory_rows is None:
+    if advisory_rows is None and not advisory_bytes:
         return out
+    by_bytes = bool(advisory_bytes)
+    target = advisory_bytes if by_bytes else advisory_rows
     coalesced: List[Any] = []
     acc: List[Any] = []
-    acc_rows = 0
+    acc_n = 0
     for p in out:
         if isinstance(p, list):
             acc.extend(p)
-            acc_rows += sum(len(v) for _, v in p)
-            if acc_rows >= advisory_rows:
+            acc_n += (_grouped_list_bytes(p) if by_bytes
+                      else sum(len(v) for _, v in p))
+            if acc_n >= target:
                 coalesced.append(acc)
-                acc, acc_rows = [], 0
+                acc, acc_n = [], 0
         else:  # spilled partition: emit as-is, flushing the accumulator
             if acc:
                 coalesced.append(acc)
-                acc, acc_rows = [], 0
+                acc, acc_n = [], 0
             coalesced.append(p)
     if acc:
         coalesced.append(acc)

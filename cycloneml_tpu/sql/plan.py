@@ -410,19 +410,84 @@ def _batch_of(rows: List[tuple], names: List[str],
     return out
 
 
+# AQE observability: which buckets the most recent exchanged join SPLIT
+# for skew, as {bucket: split_side (0=left, 1=right)}
+LAST_SKEW_SPLITS: Dict[int, int] = {}
+
+
 def _exchange_keyed_rows(sides: List[Tuple[List[tuple], List[tuple]]],
-                         group: Tuple[int, List[str], int]
-                         ) -> List[List[tuple]]:
+                         group: Tuple[int, List[str], int],
+                         skew: Optional[dict] = None) -> List[List[tuple]]:
     """One exchange round over tagged row streams: ``sides[i]`` is
     ``(keys, rows)`` for input i; returns, per input, the rows whose key
     this process owns. The ShuffleExchangeExec analog for the columnar
     engine — both join sides ride the SAME round so matching keys
-    co-locate."""
-    from cycloneml_tpu.parallel.exchange import HashExchange
+    co-locate.
+
+    ``skew`` (two-sided joins only): ``{"factor", "threshold",
+    "can_split": (left, right)}`` enables the OptimizeSkewedJoin analog
+    (ref execution/adaptive/OptimizeSkewedJoin.scala:55). A control-plane
+    allgather of per-bucket byte ESTIMATES runs first; a bucket skewed on
+    a splittable side then routes that side's rows ROUND-ROBIN across all
+    processes while the other side's rows for the bucket are DUPLICATED
+    to every process — the hot key's join work spreads over the fleet.
+    This is sound exactly when the split side is the only side emitting
+    unmatched rows (the reference's canSplitLeftSide/canSplitRightSide
+    rule): every split-side row meets the bucket's FULL other side on
+    whichever process it lands, so matched-ness stays per-row local."""
+    from cycloneml_tpu.parallel.exchange import (HashExchange,
+                                                 estimate_bucket_bytes,
+                                                 exchange_allgather,
+                                                 plan_skew_splits,
+                                                 split_bucket_label)
+    from cycloneml_tpu.dataset.spill import stable_hash
     rank, addresses, n_buckets = group
+    n_workers = len(addresses)
+    global LAST_SKEW_SPLITS
+    splits: Dict[int, int] = {}
+    side_buckets: List[List[int]] = []
+    if skew is not None and len(sides) == 2 and n_workers > 1 \
+            and any(skew["can_split"]):
+        # hash each key ONCE: the stats pass and the routing loop below
+        # share these bucket ids (stable_hash pickles non-numeric keys —
+        # a second full pass would double that cost)
+        side_buckets = [[stable_hash(k) % n_buckets for k in keys]
+                        for keys, _ in sides]
+        local = [estimate_bucket_bytes(bs, rows)
+                 for bs, (_, rows) in zip(side_buckets, sides)]
+        gathered = exchange_allgather(local, rank, addresses)
+        totals: List[Dict[int, int]] = [{}, {}]
+        for per_rank in gathered.values():
+            for s in (0, 1):
+                for b, v in per_rank[s].items():
+                    totals[s][b] = totals[s].get(b, 0) + v
+        splits = plan_skew_splits(totals, skew["can_split"],
+                                  skew["factor"], skew["threshold"])
+    if skew is not None:  # join-only observability, like LAST_JOIN_STRATEGY
+        LAST_SKEW_SPLITS = dict(splits)
+
     ex = HashExchange(rank, addresses, n_buckets)
-    for tag, (keys, rows) in enumerate(sides):
-        ex.put_all((k, (tag, r)) for k, r in zip(keys, rows))
+    if not splits:
+        for tag, (keys, rows) in enumerate(sides):
+            ex.put_all((k, (tag, r)) for k, r in zip(keys, rows))
+    else:
+        rr = {b: rank for b in splits}  # start at own rank: spreads evenly
+        for tag, (keys, rows) in enumerate(sides):
+            buckets_t = side_buckets[tag]
+            for (k, r), b in zip(zip(keys, rows), buckets_t):
+                side = splits.get(b)
+                if side is None:
+                    ex.put_to_bucket(b, k, (tag, r))
+                elif tag == side:  # split side: one chunk per row
+                    p = rr[b] = (rr[b] + 1) % n_workers
+                    ex.put_to_bucket(
+                        split_bucket_label(b, p, n_buckets, n_workers),
+                        k, (tag, r))
+                else:  # duplicated side: every process gets the row
+                    for p in range(n_workers):
+                        ex.put_to_bucket(
+                            split_bucket_label(b, p, n_buckets, n_workers),
+                            k, (tag, r))
     buckets = ex.finish()
     out: List[List[tuple]] = [[] for _ in sides]
     for b in sorted(buckets):
@@ -618,12 +683,14 @@ class Join(LogicalPlan):
                 lrows = _rows_of(lb, lnames, nl)
                 rrows = _rows_of(rb, rnames, nr)
                 lowned, rowned = _exchange_keyed_rows(
-                    [(lkeys, lrows), (rkeys, rrows)], group)
+                    [(lkeys, lrows), (rkeys, rrows)], group,
+                    skew=self._skew_config())
                 lb = _batch_of(lowned, lnames, lb)
                 rb = _batch_of(rowned, rnames, rb)
                 nl, nr = len(lowned), len(rowned)
-                self._aqe_strategy = "exchange"
-                LAST_JOIN_STRATEGY = "exchange"
+                self._aqe_strategy = ("exchange_skew_split"
+                                      if LAST_SKEW_SPLITS else "exchange")
+                LAST_JOIN_STRATEGY = self._aqe_strategy
         elif group is not None:
             raise NotImplementedError(
                 "cross join is not routed through the hash exchange (no "
@@ -664,6 +731,31 @@ class Join(LogicalPlan):
             matched_r[ri] = True
             r_unmatched = np.nonzero(~matched_r)[0]
         return self._emit(lb, rb, li, ri, l_unmatched, r_unmatched)
+
+    def _skew_config(self) -> Optional[dict]:
+        """Skew-split settings for this join type, honoring per-session
+        SET overlays; None disables. Split eligibility mirrors the
+        reference's canSplitLeftSide/canSplitRightSide: a side may split
+        only when the join emits no unmatched rows from the OTHER side
+        (inner both; left-outer left; right-outer right; semi/anti keep
+        only left rows so the left splits too)."""
+        can = {"inner": (True, True), "left": (True, False),
+               "right": (False, True), "left_semi": (True, False),
+               "left_anti": (True, False)}.get(self.how)
+        if can is None:
+            return None
+        from cycloneml_tpu.conf import (ADAPTIVE_ENABLED, SKEW_JOIN_ENABLED,
+                                        SKEW_JOIN_FACTOR,
+                                        SKEW_JOIN_THRESHOLD)
+        from cycloneml_tpu.context import active_context
+        from cycloneml_tpu.sql.session import resolve_conf
+        ctx = active_context()
+        if ctx is None or not resolve_conf(ctx, ADAPTIVE_ENABLED) \
+                or not resolve_conf(ctx, SKEW_JOIN_ENABLED):
+            return None
+        return {"factor": float(resolve_conf(ctx, SKEW_JOIN_FACTOR)),
+                "threshold": int(resolve_conf(ctx, SKEW_JOIN_THRESHOLD)),
+                "can_split": can}
 
     def _adaptive_broadcast_side(self, lb, rb, nl, nr, group):
         """Pick a side to broadcast, or None for the shuffled join.

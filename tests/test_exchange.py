@@ -141,3 +141,57 @@ def test_group_by_key_output_partitions_spill(ctx):
         assert len(grouped.take(10)) == 10
     finally:
         ctx.conf.set(SHUFFLE_SPILL_ROW_BUDGET, str(old))
+
+
+def test_plan_skew_splits_rules():
+    """Eligibility mirrors OptimizeSkewedJoin: threshold AND factor x
+    median, per side, gated by can_split; larger side wins a tie."""
+    from cycloneml_tpu.parallel.exchange import plan_skew_splits
+    left = {0: 10_000, 1: 100, 2: 120, 3: 90}
+    right = {0: 50_000, 1: 80, 2: 70, 3: 95}
+    # both sides skewed on bucket 0: right is larger -> split side 1
+    s = plan_skew_splits([left, right], (True, True), 5.0, 1000)
+    assert s == {0: 1}
+    # right not splittable (left join): left splits
+    s = plan_skew_splits([left, right], (True, False), 5.0, 1000)
+    assert s == {0: 0}
+    # threshold above the hot bucket: nothing splits
+    s = plan_skew_splits([left, right], (True, True), 5.0, 10**9)
+    assert s == {}
+    # factor too high relative to median: nothing splits
+    s = plan_skew_splits([{0: 300, 1: 100, 2: 100}, {}], (True, True),
+                         5.0, 0)
+    assert s == {}
+
+
+def test_split_bucket_label_routing():
+    from cycloneml_tpu.parallel.exchange import split_bucket_label
+    n_buckets, n_workers = 16, 3
+    seen = set()
+    for b in range(n_buckets):
+        for p in range(n_workers):
+            lab = split_bucket_label(b, p, n_buckets, n_workers)
+            assert lab % n_workers == p  # routes to the chosen peer
+            assert lab >= n_buckets      # never collides with real buckets
+            assert lab not in seen
+            seen.add(lab)
+
+
+def test_byte_based_coalescing(tmp_path):
+    """advisoryPartitionSizeInBytes semantics: list partitions merge by
+    ESTIMATED bytes; a large byte target collapses small partitions, a
+    tiny one keeps them apart."""
+    from cycloneml_tpu.parallel.exchange import exchange_group_partitions
+    # single-worker exchange: loopback address
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    addrs = [f"127.0.0.1:{port}"]
+    pairs = [(k, k * 1.0) for k in range(64)]
+    merged = exchange_group_partitions(iter(pairs), 0, addrs, 16,
+                                       advisory_bytes=1 << 20)
+    assert len(merged) == 1  # everything fits one 1MB-target partition
+    pairs = [(k, k * 1.0) for k in range(64)]
+    apart = exchange_group_partitions(iter(pairs), 0, addrs, 16,
+                                      advisory_bytes=1)
+    assert len(apart) == 16  # 1-byte target: no merging across buckets
