@@ -52,16 +52,40 @@ def _pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def _auto_row_tile(n: int, row_tile: int) -> int:
+    """A tile that DIVIDES n when one exists: row padding copies the whole
+    X operand (at bench scale that is a second ~10 GB HBM allocation — an
+    OOM, not a slowdown), so dividing beats the default tile size. Falls
+    back to the requested tile (with padding) for ns with no small
+    divisor — loudly, when the operand is big enough for the copy to
+    matter."""
+    if n % row_tile == 0:
+        return row_tile
+    for t in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if n >= t and n % t == 0:
+            return t
+    if n > 1 << 20:
+        import warnings
+        warnings.warn(
+            f"pallas kernel: no row tile divides n={n}; padding will COPY "
+            "the full operand in HBM — pad the input to a multiple of 8 "
+            "rows upstream to avoid it")
+    return row_tile
+
+
 def _pad_rows_cols(x, y, w, row_tile: int):
     """Zero-pad rows to the tile multiple and features to the lane multiple;
-    padding rows carry w=0 so they contribute nothing to any sum."""
+    padding rows carry w=0 so they contribute nothing to any sum. The row
+    tile is re-chosen to DIVIDE n when possible (see _auto_row_tile) and
+    returned — row padding copies the whole X operand otherwise."""
     n, d = x.shape
+    row_tile = _auto_row_tile(n, row_tile)
     n_pad, d_pad = _pad_to(max(n, row_tile), row_tile), _pad_to(d, LANE)
     if n_pad != n or d_pad != d:
         x = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
         y = jnp.pad(y, (0, n_pad - n))
         w = jnp.pad(w, (0, n_pad - n))
-    return x, y, w, n_pad, d_pad
+    return x, y, w, n_pad, d_pad, row_tile
 
 
 # -- fused binary logistic loss + gradient -------------------------------------
@@ -81,7 +105,7 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
     beta = coef[:d] if fit_intercept else coef
     b0 = coef[d] if fit_intercept else jnp.zeros((), dtype)
 
-    x, y, w, n_pad, d_pad = _pad_rows_cols(x, y, w, row_tile)
+    x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
     beta_p = jnp.pad(beta, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
 
@@ -129,7 +153,7 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
     sb = inv_std * beta
     off = b0 - jnp.dot(scaled_mean, beta)
 
-    x, y, w, n_pad, d_pad = _pad_rows_cols(x, y, w, row_tile)
+    x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
     beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
     kernel = functools.partial(_run_logistic, row_tile=row_tile, d_pad=d_pad,
@@ -158,8 +182,8 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
             grad_ref[:] = jnp.zeros_like(grad_ref)
 
         xv = x_ref[:]
-        yv = y_ref[:]
-        wv = w_ref[:]
+        yv = y_ref[:]          # (T, 1) — Mosaic rejects 1-D blocks that
+        wv = w_ref[:]          # don't align to the T(1024) XLA layout
         # matvecs with a width-1 output don't lower to the MXU (Mosaic:
         # non-constant reduction accumulator); broadcast-multiply + reduce on
         # the VPU instead — the pass is HBM-bound, not FLOP-bound
@@ -209,6 +233,7 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
     centers = jnp.asarray(centers, jnp.float32)
     n, d = x.shape
     k = centers.shape[0]
+    row_tile = _auto_row_tile(n, row_tile)
     n_pad = _pad_to(max(n, row_tile), row_tile)
     d_pad = _pad_to(d, LANE)
     k_pad = _pad_to(k, 8)
@@ -263,6 +288,7 @@ def fused_gramian(x, interpret: Optional[bool] = None,
         interpret = not pallas_available()
     x = jnp.asarray(x, jnp.float32)
     n, d = x.shape
+    row_tile = _auto_row_tile(n, row_tile)
     n_pad = _pad_to(max(n, row_tile), row_tile)
     d_pad = _pad_to(d, LANE)
     x_p = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
