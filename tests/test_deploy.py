@@ -404,3 +404,44 @@ def test_job_gate_serializes_scale_up_and_jobs(ctx):
     assert gate_result == [False]
     assert ctx.try_begin_mesh_rebuild()  # free again after the job ends
     ctx.end_mesh_rebuild()
+
+
+def test_coordinator_port_race_auto_relaunch(cluster):
+    """r4 verdict item 10: a pooled coordinator port taken between probe
+    and bind fails attempt 0; the MASTER relaunches once with a fresh
+    port and the app FINISHES — no client-side retry."""
+    import socket
+
+    m, workers, tmp_path = cluster
+    app = tmp_path / "race_app.py"
+    out = tmp_path / "race_out.txt"
+    app.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from cycloneml_tpu.context import CycloneContext
+        ctx = CycloneContext.get_or_create()
+        with open({str(out)!r}, "w") as fh:
+            fh.write("ran on attempt")
+        ctx.stop()
+    """))
+    # steal the port the scheduler will hand out: bind it ourselves and
+    # seed the chosen worker's pool with ONLY that port
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    stolen = blocker.getsockname()[1]
+    try:
+        with m._lock:
+            # poison the FIRST-rotation worker's pool only: attempt 0
+            # draws the stolen port; the relaunch rotates to the other
+            # worker and draws a genuinely free one
+            first = list(m._workers)[m._rr % len(m._workers)]
+            m._workers[first]["coord_ports"] = [[stolen, time.time()]]
+        app_id = submit_app(m.address, str(app), n_procs=1)
+        assert wait_for_app(m.address, app_id, timeout_s=120) == "FINISHED"
+        st = app_status(m.address)
+        assert st["apps"][app_id]["attempt"] == 1  # relaunched exactly once
+        assert out.read_text() == "ran on attempt"
+    finally:
+        blocker.close()

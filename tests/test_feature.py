@@ -327,3 +327,85 @@ def test_word2vec_hierarchical_softmax(ctx):
     out = m.transform(f)
     v = out["vec"]
     assert np.linalg.norm(v[0] - v[2]) < np.linalg.norm(v[0] - v[1])
+
+
+def test_word2vec_ns_matches_numpy_oracle(ctx):
+    """r4 verdict item 9: the jitted negative-sampling solver agrees with
+    the independent f64 numpy oracle (tests/ref_parity/w2v_oracle.py) —
+    same data pipeline and negative draws, update math derived from
+    scratch. Vectors must track closely and nearest neighbours match."""
+    import jax
+    import jax.numpy as jnp
+    from tests.ref_parity import w2v_oracle as wo
+
+    rng = np.random.RandomState(0)
+    topics = [["cat", "dog", "pet", "fur", "paw"],
+              ["car", "road", "wheel", "fuel", "drive"],
+              ["sun", "moon", "star", "sky", "orbit"]]
+    sentences = []
+    for _ in range(120):
+        t = topics[rng.randint(3)]
+        sentences.append([t[rng.randint(5)] for _ in range(8)])
+
+    dim, window, epochs, seed, n_neg, lr = 12, 2, 2, 7, 5, 0.025
+    from cycloneml_tpu.dataset.frame import MLFrame
+    frame = MLFrame(ctx, {"text": np.array(
+        [" ".join(s).split() for s in sentences], dtype=object)})
+    m = Word2Vec(vectorSize=dim, windowSize=window, maxIter=epochs,
+                 seed=seed, minCount=1, negative=n_neg, stepSize=lr,
+                 inputCol="text").fit(frame)
+
+    # reconstruct the estimator's negative draws (same PRNG discipline)
+    vocab, counts, centers, _ = wo.build_pipeline(sentences, 1, window)
+    freq = np.array([counts[w] for w in vocab], dtype=np.float64) ** 0.75
+    neg_probs = jnp.asarray(freq / freq.sum(), dtype=jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    prng = np.random.RandomState(seed)
+    prng.rand(len(vocab), dim)  # init consumed before permutations
+    draws = []
+    n_pairs = len(centers)
+    for _ in range(epochs):
+        perm = prng.permutation(n_pairs)
+        for s0 in range(0, n_pairs, wo.BATCH):
+            sel = perm[s0: s0 + wo.BATCH]
+            key, sub = jax.random.split(key)
+            draws.append(np.asarray(jax.random.choice(
+                sub, len(vocab), shape=(len(sel), n_neg), p=neg_probs)))
+
+    ovocab, ovecs = wo.oracle_ns(
+        sentences, dim=dim, window=window, lr=lr, epochs=epochs,
+        seed=seed, neg_draws=draws)
+    assert m.vocabulary == ovocab
+    # f32 solver vs f64 oracle on the same trajectory
+    np.testing.assert_allclose(m.vectors, ovecs, atol=2e-4)
+    # nearest-neighbour agreement on every topical word
+    from cycloneml_tpu.ml.feature.word2vec import Word2VecModel
+    om = Word2VecModel(ovocab, ovecs)
+    for w in ("cat", "car", "sun"):
+        ours = [x for x, _ in m.find_synonyms(w, 3)]
+        theirs = [x for x, _ in om.find_synonyms(w, 3)]
+        assert ours == theirs, (w, ours, theirs)
+
+
+def test_word2vec_hs_matches_numpy_oracle(ctx):
+    """The hierarchical-softmax solver against the oracle: identical
+    Huffman trajectory in f32 vs f64 — loss CURVES track and vectors
+    agree (no external gensim exists in-env; the oracle is the trusted
+    comparator, ref Word2Vec.scala:73)."""
+    from tests.ref_parity import w2v_oracle as wo
+
+    rng = np.random.RandomState(1)
+    words = [f"w{i}" for i in range(30)]
+    sentences = [[words[rng.randint(30)] for _ in range(10)]
+                 for _ in range(80)]
+    dim, window, epochs, seed, lr = 10, 2, 3, 5, 0.025
+    from cycloneml_tpu.dataset.frame import MLFrame
+    frame = MLFrame(ctx, {"text": np.array(sentences, dtype=object)})
+    m = Word2Vec(vectorSize=dim, windowSize=window, maxIter=epochs,
+                 seed=seed, minCount=1, solver="hs", stepSize=lr,
+                 inputCol="text").fit(frame)
+    ovocab, ovecs, olosses = wo.oracle_hs(
+        sentences, dim=dim, window=window, lr=lr, epochs=epochs, seed=seed)
+    assert m.vocabulary == ovocab
+    np.testing.assert_allclose(m.training_loss_, olosses, rtol=1e-4)
+    np.testing.assert_allclose(m.vectors, ovecs, atol=2e-4)

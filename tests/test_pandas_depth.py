@@ -6,6 +6,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
+import cycloneml_tpu.pandas as cp
 from cycloneml_tpu.pandas import CycloneFrame, concat, pivot_table
 
 
@@ -411,3 +412,226 @@ def test_pivot_table_margins():
             np.testing.assert_allclose(
                 got[str(c)].values, want[c].to_numpy(dtype=float),
                 equal_nan=True, err_msg=f"{fn}/{c}")
+
+
+# -- r5 tranche: datetime index + resample, merge-on-index, astype,
+#    iteration protocols — each parity-tested against REAL pandas
+#    (r4 verdict item 8; ref python/pyspark/pandas/frame.py,
+#    data_type_ops/, indexes/datetimes.py)
+
+class TestDateRangeParity:
+    @pytest.mark.parametrize("kw", [
+        dict(start="2024-01-01", periods=5, freq="D"),
+        dict(start="2024-01-01", end="2024-01-10", freq="D"),
+        dict(start="2024-01-01", periods=8, freq="h"),
+        dict(start="2024-01-01", periods=6, freq="15min"),
+        dict(start="2024-01-01", end="2024-06-30", freq="ME"),
+        dict(start="2024-01-03", periods=4, freq="W"),
+        dict(start="2024-02-27", periods=3, freq="2D"),
+    ], ids=lambda kw: kw.get("freq"))
+    def test_matches_pandas(self, kw):
+        ours = cp.date_range(**kw)
+        theirs = pd.date_range(**kw).values
+        np.testing.assert_array_equal(ours, theirs)
+
+
+class TestResampleParity:
+    def _pair(self):
+        ts = pd.date_range("2024-03-01", periods=50, freq="7h")
+        rng = np.random.RandomState(0)
+        vals = rng.randn(50)
+        qty = rng.randint(0, 10, 50).astype(np.float64)
+        pdf = pd.DataFrame({"v": vals, "q": qty}, index=ts)
+        ours = cp.CycloneFrame({"t": ts.values, "v": vals, "q": qty}
+                               ).set_index("t")
+        return pdf, ours
+
+    @pytest.mark.parametrize("fn", ["sum", "mean", "count", "min", "max"])
+    def test_daily(self, fn):
+        pdf, ours = self._pair()
+        exp = getattr(pdf.resample("D"), fn)()
+        got = getattr(ours.resample("D"), fn)()
+        np.testing.assert_array_equal(got.index, exp.index.values)
+        for c in ("v", "q"):
+            np.testing.assert_allclose(got[c].to_numpy(),
+                                       exp[c].to_numpy(), equal_nan=True)
+
+    def test_monthly_and_on_column(self):
+        ts = pd.date_range("2024-01-15", periods=10, freq="11D")
+        vals = np.arange(10.0)
+        pdf = pd.DataFrame({"t": ts, "v": vals})
+        exp = pdf.resample("ME", on="t").sum()
+        ours = cp.CycloneFrame({"t": ts.values, "v": vals})
+        got = ours.resample("ME", on="t").sum()
+        np.testing.assert_array_equal(got.index, exp.index.values)
+        np.testing.assert_allclose(got["v"].to_numpy(),
+                                   exp["v"].to_numpy())
+
+    def test_empty_bins_materialize(self):
+        # a 3-day gap: pandas emits the empty day with sum 0 / mean NaN
+        ts = pd.to_datetime(["2024-01-01", "2024-01-01", "2024-01-04"])
+        vals = np.array([1.0, 2.0, 4.0])
+        pdf = pd.DataFrame({"v": vals}, index=ts)
+        ours = cp.CycloneFrame({"t": ts.values, "v": vals}).set_index("t")
+        for fn in ("sum", "mean"):
+            exp = getattr(pdf.resample("D"), fn)()
+            got = getattr(ours.resample("D"), fn)()
+            assert len(got) == 4
+            np.testing.assert_allclose(got["v"].to_numpy(),
+                                       exp["v"].to_numpy(), equal_nan=True)
+
+
+class TestMergeOnIndex:
+    def _frames(self):
+        left = {"k": np.array(["a", "b", "c", "d"], object),
+                "lv": np.arange(4.0)}
+        right = {"rv": np.array([10.0, 20.0, 30.0])}
+        ridx = np.array(["b", "c", "z"], object)
+        pl = pd.DataFrame(left)
+        pr = pd.DataFrame(right, index=ridx)
+        cl = cp.CycloneFrame(left)
+        cr = cp.CycloneFrame({"idx": ridx, **right}).set_index("idx")
+        return pl, pr, cl, cr
+
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_left_on_right_index(self, how):
+        pl, pr, cl, cr = self._frames()
+        exp = pl.merge(pr, left_on="k", right_index=True, how=how)
+        got = cl.merge(cr, left_on="k", right_index=True, how=how)
+        assert sorted(got.columns) == sorted(exp.columns)
+        ge = got.sort_values("lv")
+        pe = exp.sort_values("lv")
+        np.testing.assert_array_equal(ge["k"].to_numpy(),
+                                      pe["k"].to_numpy())
+        np.testing.assert_allclose(ge["rv"].to_numpy(),
+                                   pe["rv"].to_numpy(), equal_nan=True)
+
+    def test_both_indexes(self):
+        lidx = np.array(["a", "b", "c"], object)
+        l = pd.DataFrame({"lv": [1.0, 2.0, 3.0]}, index=lidx)
+        r = pd.DataFrame({"rv": [5.0, 6.0]},
+                         index=np.array(["b", "c"], object))
+        exp = l.merge(r, left_index=True, right_index=True)
+        cl = cp.CycloneFrame({"i": lidx, "lv": np.array([1.0, 2.0, 3.0])}
+                             ).set_index("i")
+        crr = cp.CycloneFrame({"i": np.array(["b", "c"], object),
+                               "rv": np.array([5.0, 6.0])}).set_index("i")
+        got = cl.merge(crr, left_index=True, right_index=True)
+        ge = got.sort_index()
+        pe = exp.sort_index()
+        np.testing.assert_array_equal(ge.index, pe.index.values)
+        np.testing.assert_allclose(ge["lv"].to_numpy(), pe["lv"].to_numpy())
+        np.testing.assert_allclose(ge["rv"].to_numpy(), pe["rv"].to_numpy())
+
+
+class TestAstypeParity:
+    def test_float_to_int_and_back(self):
+        data = {"a": np.array([1.0, 2.0, 3.0]),
+                "b": np.array([1, 2, 3], dtype=np.int64)}
+        exp = pd.DataFrame(data).astype({"a": "int64", "b": "float64"})
+        got = cp.CycloneFrame(data).astype({"a": "int64", "b": "float64"})
+        assert got["a"].to_numpy().dtype == exp["a"].to_numpy().dtype
+        assert got["b"].to_numpy().dtype == exp["b"].to_numpy().dtype
+        np.testing.assert_array_equal(got["a"].to_numpy(),
+                                      exp["a"].to_numpy())
+
+    def test_nan_to_int_raises_like_pandas(self):
+        data = {"a": np.array([1.0, np.nan])}
+        with pytest.raises(ValueError, match="non-finite"):
+            pd.DataFrame(data).astype("int64")
+        with pytest.raises(ValueError, match="non-finite"):
+            cp.CycloneFrame(data).astype("int64")
+
+    def test_object_strings_parse(self):
+        data = {"a": np.array(["1", "2", "3"], object)}
+        exp = pd.DataFrame(data).astype("int64")
+        got = cp.CycloneFrame(data).astype("int64")
+        np.testing.assert_array_equal(got["a"].to_numpy(),
+                                      exp["a"].to_numpy())
+
+    def test_astype_str_preserves_nan(self):
+        # pandas >= 2: str cast stringifies values but NaN SURVIVES
+        data = {"a": np.array([1.5, np.nan])}
+        exp = pd.DataFrame(data).astype(str)["a"].to_numpy()
+        got = cp.CycloneFrame(data).astype(str)["a"].to_numpy()
+        assert got[0] == exp[0] == "1.5"
+        assert isinstance(got[1], float) and np.isnan(got[1])
+        assert isinstance(exp[1], float) and np.isnan(exp[1])
+
+
+class TestIterationParity:
+    def _data(self):
+        return {"x": np.array([1, 2, 3], dtype=np.int64),
+                "y": np.array(["a", "b", "c"], object)}
+
+    def test_iterrows(self):
+        data = self._data()
+        exp = [(i, row.to_dict()) for i, row in
+               pd.DataFrame(data).iterrows()]
+        got = [(i, dict(zip(["x", "y"], row.values))) for i, row in
+               cp.CycloneFrame(data).iterrows()]
+        assert got == exp
+
+    def test_itertuples(self):
+        data = self._data()
+        exp = [tuple(t) for t in pd.DataFrame(data).itertuples()]
+        got = [tuple(t) for t in cp.CycloneFrame(data).itertuples()]
+        assert got == exp
+        # field access + index=False variant
+        t0 = next(iter(cp.CycloneFrame(data).itertuples()))
+        assert t0.Index == 0 and t0.x == 1 and t0.y == "a"
+        exp2 = [tuple(t) for t in
+                pd.DataFrame(data).itertuples(index=False)]
+        got2 = [tuple(t) for t in
+                cp.CycloneFrame(data).itertuples(index=False)]
+        assert got2 == exp2
+
+
+class TestR5ReviewRegressions:
+    def test_resample_multiplier_anchors_start_of_day(self):
+        ts = pd.to_datetime(["2024-01-01 00:07", "2024-01-01 00:20"])
+        vals = np.array([1.0, 2.0])
+        exp = pd.DataFrame({"v": vals}, index=ts).resample("15min").sum()
+        got = cp.CycloneFrame({"t": ts.values, "v": vals}
+                              ).set_index("t").resample("15min").sum()
+        np.testing.assert_array_equal(got.index, exp.index.values)
+        np.testing.assert_allclose(got["v"].to_numpy(),
+                                   exp["v"].to_numpy())
+
+    def test_resample_skips_nan(self):
+        ts = pd.to_datetime(["2024-01-01", "2024-01-01", "2024-01-02"])
+        vals = np.array([1.0, np.nan, 5.0])
+        pdf = pd.DataFrame({"v": vals}, index=ts)
+        ours = cp.CycloneFrame({"t": ts.values, "v": vals}).set_index("t")
+        for fn in ("sum", "mean", "count"):
+            exp = getattr(pdf.resample("D"), fn)()["v"].to_numpy()
+            got = getattr(ours.resample("D"), fn)()["v"].to_numpy()
+            np.testing.assert_allclose(got.astype(np.float64),
+                                       exp.astype(np.float64),
+                                       equal_nan=True)
+
+    def test_date_range_end_periods(self):
+        for freq in ("D", "h", "ME"):
+            exp = pd.date_range(end="2024-03-10", periods=4,
+                                freq=freq).values
+            got = cp.date_range(end="2024-03-10", periods=4, freq=freq)
+            np.testing.assert_array_equal(got, exp)
+        with pytest.raises(ValueError):
+            cp.date_range(periods=4)
+
+    def test_mixed_merge_keeps_column_side_index(self):
+        left = {"k": np.array(["a", "b", "c"], object),
+                "lv": np.arange(3.0)}
+        pl = pd.DataFrame(left, index=np.array([10, 11, 12]))
+        right = {"rv": np.array([1.0, 2.0])}
+        ridx = np.array(["b", "c"], object)
+        pr = pd.DataFrame(right, index=ridx)
+        exp = pl.merge(pr, left_on="k", right_index=True, how="inner")
+        cl0 = cp.CycloneFrame({"i": np.array([10, 11, 12]), **left}
+                              ).set_index("i")
+        cr = cp.CycloneFrame({"i": ridx, **right}).set_index("i")
+        got = cl0.merge(cr, left_on="k", right_index=True, how="inner")
+        ge, pe = got.sort_index(), exp.sort_index()
+        np.testing.assert_array_equal(ge.index, pe.index.values)
+        np.testing.assert_array_equal(ge["k"].to_numpy(),
+                                      pe["k"].to_numpy())
