@@ -12,10 +12,11 @@ from __future__ import annotations
 from typing import List, Optional
 
 from cycloneml_tpu.sql.column import (Alias, BinaryOp, ColumnRef, Expr,
-                                      Literal)
+                                      Literal, UnaryOp)
 from cycloneml_tpu.sql.plan import (Aggregate, Distinct, FileScan, Filter,
-                                    Join, Limit, LogicalPlan, Project, Scan,
-                                    Sort, Union)
+                                    InSubquery, Join, Limit, LogicalPlan,
+                                    Project, Scan, Sort, Union,
+                                    _SubqueryMixin)
 
 
 def split_conjuncts(e: Expr) -> List[Expr]:
@@ -225,13 +226,190 @@ def prune_columns(plan: LogicalPlan) -> LogicalPlan:
     return required_of(plan, set(plan.output()))
 
 
-_REWRITE_RULES = [fold_constants, combine_filters, push_filter_through_project,
+def _bool_literal(e: Expr) -> Optional[bool]:
+    """Python bool of a boolean Literal — folding produces numpy bools
+    (np.True_), which are neither ``is True`` nor bool instances."""
+    import numpy as _np
+    if isinstance(e, Literal) and isinstance(e.value, (bool, _np.bool_)):
+        return bool(e.value)
+    return None
+
+
+def _simplify_bool(e: Expr) -> Expr:
+    """Bottom-up boolean algebra (ref BooleanSimplification +
+    SimplifyConditionals' literal cases): NOT pushes through AND/OR by
+    De Morgan and flips comparisons; TRUE/FALSE literals collapse their
+    AND/OR parent."""
+    kids = [_simplify_bool(c) for c in e.children]
+    e = e.with_children(kids) if kids else e
+    if isinstance(e, UnaryOp) and e.op == "not":
+        c = e.children[0]
+        if isinstance(c, UnaryOp) and c.op == "not":
+            return c.children[0]
+        cb = _bool_literal(c)
+        if cb is not None:
+            return Literal(not cb)
+        if isinstance(c, BinaryOp) and c.op in ("and", "or"):
+            flip = "or" if c.op == "and" else "and"
+            return _simplify_bool(BinaryOp(
+                flip, UnaryOp("not", c.children[0]),
+                UnaryOp("not", c.children[1])))
+        # NOTE: NOT(a < b) is deliberately NOT flipped to a >= b — under
+        # the engine's numpy two-valued semantics NaN<b is False, so the
+        # negation KEEPS NaN rows while the flipped comparison drops
+        # them (Catalyst can flip because its 3VL makes both NULL)
+        return e
+    if isinstance(e, BinaryOp) and e.op in ("and", "or"):
+        a, b = e.children
+        for x, other in ((a, b), (b, a)):
+            xb = _bool_literal(x)
+            if xb is not None:
+                if e.op == "and":
+                    return other if xb else Literal(False)
+                return Literal(True) if xb else other
+    return e
+
+
+def boolean_simplification(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    if isinstance(plan, Filter):
+        new = _simplify_bool(plan.cond)
+        if str(new) != str(plan.cond):
+            return Filter(plan.children[0], new)
+    return None
+
+
+def prune_filters(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Filter(TRUE) disappears (ref PruneFilters); Filter(FALSE) stays —
+    it is already a cheap empty-result evaluation."""
+    if isinstance(plan, Filter) and _bool_literal(plan.cond) is True:
+        return plan.children[0]
+    return None
+
+
+def combine_limits(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Limit(n, Limit(m, c)) → Limit(min(n, m), c) (ref CombineLimits)."""
+    if isinstance(plan, Limit) and isinstance(plan.children[0], Limit):
+        inner = plan.children[0]
+        return Limit(inner.children[0], min(plan.n, inner.n))
+    return None
+
+
+def push_limit_through(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Limit descends through Project (row-preserving) and into both
+    sides of a Union, keeping the outer limit (ref LimitPushDown)."""
+    if not isinstance(plan, Limit):
+        return None
+    child = plan.children[0]
+    if isinstance(child, Project):
+        if any(_contains_window(e) for e in child.exprs):
+            # window exprs live in Project here (Spark's separate Window
+            # node is why Catalyst's LimitPushDown needs no such guard):
+            # limiting first would change what the window computes over
+            return None
+        return Project(Limit(child.children[0], plan.n), child.exprs)
+    if isinstance(child, Union):
+        l, r = child.children
+        if isinstance(l, Limit) and l.n <= plan.n \
+                and isinstance(r, Limit) and r.n <= plan.n:
+            return None  # already pushed
+        return Limit(Union(Limit(l, plan.n), Limit(r, plan.n)), plan.n)
+    return None
+
+
+def dedupe_distinct_sort(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Distinct(Distinct(c)) → Distinct(c); Sort(Sort(c)) keeps only the
+    OUTER order (ref EliminateSorts — the inner ordering is overwritten)."""
+    if isinstance(plan, Distinct) and isinstance(plan.children[0], Distinct):
+        return plan.children[0]
+    if isinstance(plan, Sort) and isinstance(plan.children[0], Sort):
+        return Sort(plan.children[0].children[0], plan.orders)
+    return None
+
+
+def rewrite_in_subquery_as_semi_join(plan: LogicalPlan
+                                     ) -> Optional[LogicalPlan]:
+    """Filter(c IN (SELECT ...)) → left_semi Join (ref
+    RewritePredicateSubquery). Beyond Catalyst-parity form, this matters
+    operationally here: a semi JOIN rides the cross-process exchange
+    (and its AQE broadcast/skew machinery) while an InSubquery predicate
+    re-executes its subplan privately on every process."""
+    if not isinstance(plan, Filter):
+        return None
+    conjuncts = split_conjuncts(plan.cond)
+    for i, c in enumerate(conjuncts):
+        if isinstance(c, InSubquery) \
+                and isinstance(c.children[0], ColumnRef):
+            sub = c.plan
+            sub_cols = sub.output()
+            if not sub_cols:
+                continue
+            needle = c.children[0].name
+            sub_key = sub_cols[0]
+            # factorize-based join keys treat NaN==NaN; InSubquery's
+            # documented semantics is "NaN never matches" — drop null
+            # keys from the build side so a NaN probe matches nothing
+            from cycloneml_tpu.sql.column import Func
+            sub = Filter(sub, UnaryOp(
+                "not", Func("isnull", ColumnRef(sub_key))))
+            if sub_key in plan.children[0].output() \
+                    and sub_key != needle:
+                # name collision with a left column: alias the subquery
+                # key out of the way
+                alias = f"__cyclone_inq_{sub_key}"
+                sub = Project(sub, [Alias(ColumnRef(sub_key), alias)])
+                sub_key = alias
+            joined = Join(plan.children[0], sub, [(needle, sub_key)],
+                          "left_semi")
+            rest = conjuncts[:i] + conjuncts[i + 1:]
+            return Filter(joined, join_conjuncts(rest)) if rest else joined
+    return None
+
+
+def optimize_subqueries(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Run the optimizer on every plan a subquery EXPRESSION holds (ref
+    OptimizeSubqueries) — without this, pushdown/pruning never reach
+    IN/EXISTS/scalar subplans.
+
+    Runs as a dedicated PASS from :func:`optimize`, not in the rewrite
+    loop: subplans do not print in ``tree_string``, so the loop's
+    change detection would discard the work. Copy-on-write throughout —
+    subquery exprs are shallow-copied before their plan is replaced
+    (``with_children`` may return ``self`` for leaf exprs, and mutating
+    the original would reach back into the user's DataFrame plan)."""
+    import copy as _copy
+    changed = [False]
+
+    def fix_expr(e: Expr) -> Expr:
+        kids = [fix_expr(c) for c in e.children]
+        e = e.with_children(kids) if kids else e
+        if isinstance(e, _SubqueryMixin):
+            new_plan = optimize(e.plan)
+            if new_plan.tree_string() != e.plan.tree_string():
+                e = _copy.copy(e)
+                e.plan = new_plan
+                changed[0] = True
+        return e
+
+    if isinstance(plan, Filter):
+        cond = fix_expr(plan.cond)
+        if changed[0]:
+            return Filter(plan.children[0], cond)
+    elif isinstance(plan, Project):
+        exprs = [fix_expr(e) for e in plan.exprs]
+        if changed[0]:
+            return Project(plan.children[0], exprs)
+    return None
+
+
+_REWRITE_RULES = [fold_constants, boolean_simplification, combine_filters,
+                  prune_filters, push_filter_through_project,
                   push_filter_through_join, push_filters_into_filescan,
-                  collapse_projects]
+                  collapse_projects, combine_limits, push_limit_through,
+                  dedupe_distinct_sort, rewrite_in_subquery_as_semi_join]
 
 
 def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:
-    """Fixed-point rewrite batches then one pruning pass."""
+    """Fixed-point rewrite batches, a subquery-plan pass, then pruning."""
     for _ in range(max_iterations):
         changed = False
         for rule in _REWRITE_RULES:
@@ -240,4 +418,5 @@ def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:
                 plan, changed = new, True
         if not changed:
             break
+    plan = plan.transform_up(optimize_subqueries)
     return prune_columns(plan)
