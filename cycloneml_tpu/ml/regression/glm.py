@@ -70,6 +70,10 @@ class Family:
     def clean_mu(self, mu):
         return mu
 
+    def validate_label(self, y_host: np.ndarray) -> None:
+        """Driver-side label-domain check before training (no-op for
+        most families; Tweedie enforces the reference's require()s)."""
+
 
 class Tweedie(Family):
     def __init__(self, variance_power: float):
@@ -83,18 +87,37 @@ class Tweedie(Family):
             return jnp.maximum(y, 0.1)
         return y
 
+    def validate_label(self, y_host: np.ndarray) -> None:
+        # label-domain validation (ref Tweedie.initialize:624-632): the
+        # compound-Poisson band allows y=0; p>=2 needs strictly positive
+        # labels — without this, y=0 at p>2 silently NaNs the deviance.
+        # Driver-side on the HOST labels (initialize runs inside jit)
+        p = self.variance_power
+        if 1.0 <= p < 2.0:
+            if np.any(y_host < 0):
+                raise ValueError(
+                    f"tweedie({p}) labels must be non-negative")
+        elif p >= 2.0:
+            if np.any(y_host <= 0):
+                raise ValueError(
+                    f"tweedie({p}) labels must be positive")
+
     def variance(self, mu):
         import jax.numpy as jnp
         return jnp.power(jnp.maximum(mu, _EPS), self.variance_power)
 
     def unit_deviance(self, y, mu):
-        # ref :646 — 2[y(y^{1-p}−mu^{1-p})/(1−p) − (y^{2-p}−mu^{2-p})/(2−p)];
-        # the p∈{0,1,2} limit cases are the Gaussian/Poisson/Gamma subclasses
+        # ref :646 — 2[y(y1^{1-p}−mu^{1-p})/(1−p) − (y^{2-p}−mu^{2-p})/(2−p)];
+        # the p∈{0,1,2} limit cases are the Gaussian/Poisson/Gamma
+        # subclasses. y floors to delta ONLY in the first term and only
+        # for compound-Poisson 1<=p<2 (the reference's deviance:648 — the
+        # second term must keep RAW y so a y=0 row contributes its full
+        # mu^{2-p}/(2-p) deviance, not a delta-perturbed ~0)
         import jax.numpy as jnp
         p = self.variance_power
-        y1 = jnp.maximum(y, 0.1) if p >= 1 else y
+        y1 = jnp.maximum(y, 0.1) if 1.0 <= p < 2.0 else y
         return 2.0 * (y * (jnp.power(y1, 1 - p) - jnp.power(mu, 1 - p)) / (1 - p)
-                      - (jnp.power(y1, 2 - p) - jnp.power(mu, 2 - p)) / (2 - p))
+                      - (jnp.power(y, 2 - p) - jnp.power(mu, 2 - p)) / (2 - p))
 
     def clean_mu(self, mu):
         import jax.numpy as jnp
@@ -118,8 +141,13 @@ class Gaussian(Tweedie):
         return (y - mu) ** 2
 
     def aic(self, y, mu, w, w_sum, deviance, rank):
-        return w_sum * (math.log(deviance / w_sum * 2.0 * math.pi) + 1.0) + 2.0 \
-            + 2.0 * rank
+        # ref :704-711 (+ summary's 2·rank): numInstances (row COUNT, not
+        # weight sum) scales the log-likelihood term, and Σlog w subtracts
+        # — R's weighted-gaussian aic
+        n = float(len(np.atleast_1d(y)))
+        return (n * (math.log(deviance / n * 2.0 * math.pi) + 1.0) + 2.0
+                - float(np.sum(np.log(np.maximum(w, _EPS))))
+                + 2.0 * rank)
 
     def clean_mu(self, mu):
         return mu
@@ -143,11 +171,16 @@ class Binomial(Family):
         return 2.0 * (ylogy(y, mu) + ylogy(1.0 - y, 1.0 - mu))
 
     def aic(self, y, mu, w, w_sum, deviance, rank):
-        # ref :747 — binomial counts with w trials, rounded
+        # ref :745-759 — wt=round(w) trials, but successes round y*w with
+        # the RAW weight (y=0.7, w=0.7: round(0.49)=0 successes of 1
+        # trial, not round(0.7·1)=1)
         from scipy import stats as sps
-        wt = np.round(w).astype(np.int64)
+        # Java math.round = floor(x + 0.5) (half-UP), not numpy's
+        # half-even — they diverge on exact .5 trials/successes
+        wt = np.floor(w + 0.5).astype(np.int64)
         ok = wt > 0
-        ll = sps.binom.logpmf(np.round(y[ok] * wt[ok]), wt[ok], np.clip(mu[ok], _EPS, 1 - _EPS))
+        ll = sps.binom.logpmf(np.floor(y[ok] * w[ok] + 0.5), wt[ok],
+                              np.clip(mu[ok], _EPS, 1 - _EPS))
         return -2.0 * float(ll.sum()) + 2.0 * rank
 
     def clean_mu(self, mu):
@@ -482,6 +515,7 @@ class GeneralizedLinearRegression(Predictor, _GLRParams, MLWritable, MLReadable)
         from cycloneml_tpu.context import CycloneContext
 
         fam, link = self._family_link()
+        fam.validate_label(np.asarray(y, dtype=np.float64))
         n, d = x.shape
         if d > self.MAX_FEATURES:
             raise ValueError(f"GLM supports at most {self.MAX_FEATURES} features")

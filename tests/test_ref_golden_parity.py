@@ -292,3 +292,44 @@ def test_rng_ports_match_jdk_vectors():
     assert JavaRandom(42).next_int() == -1170105035
     assert JavaRandom(0).next_int() == -1155484576
     assert JavaRandom(42).next_double() == 0.7275636800328681
+
+
+@pytest.mark.parametrize("case", GOLDEN["glm_summary"],
+                         ids=lambda c: c["id"])
+def test_glm_summary_golden(ctx, case):
+    """GLM TRAINING-SUMMARY statistics vs the R summary() constants the
+    reference commits (GeneralizedLinearRegressionSuite.scala:897-1496):
+    four residual types, coefficient standard errors, t/p-values,
+    dispersion, null/residual deviance + dofs, and AIC — all at the
+    reference's absTol 1e-3."""
+    rows = case["data"]
+    data = {"label": np.asarray(rows["label"], dtype=np.float64),
+            "weight": np.asarray(rows["weight"], dtype=np.float64),
+            "offset": np.asarray(rows["offset"], dtype=np.float64),
+            "features": np.asarray(rows["features"], dtype=np.float64)}
+    frame = MLFrame(ctx, data)
+    params = dict(case["params"])
+    params.update(weightCol="weight", offsetCol="offset",
+                  maxIter=100, tol=1e-10)
+    model = GeneralizedLinearRegression(**params).fit(frame)
+    s = model.summary
+    tol = dict(atol=1e-3, rtol=0)
+    np.testing.assert_allclose(model.coefficients.to_array(),
+                               case["coefficients"], **tol,
+                               err_msg=case["ref"])
+    np.testing.assert_allclose(model.intercept, case["intercept"], **tol)
+    for kind, exp in case["residuals"].items():
+        np.testing.assert_allclose(s.residuals(kind), exp, **tol,
+                                   err_msg=f"{case['ref']} {kind}")
+    np.testing.assert_allclose(s.coefficient_standard_errors,
+                               case["se_coef"], **tol)
+    np.testing.assert_allclose(s.t_values, case["t_values"], **tol)
+    np.testing.assert_allclose(s.p_values, case["p_values"], **tol)
+    np.testing.assert_allclose(s.dispersion, case["dispersion"], **tol)
+    np.testing.assert_allclose(s.null_deviance, case["null_deviance"],
+                               **tol)
+    np.testing.assert_allclose(s.deviance, case["deviance"], **tol)
+    assert s.degrees_of_freedom == case["dof_null"]
+    assert s.residual_degree_of_freedom == case["dof_resid"]
+    if case.get("aic") is not None:
+        np.testing.assert_allclose(s.aic, case["aic"], **tol)

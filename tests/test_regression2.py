@@ -300,3 +300,24 @@ def test_isotonic_persistence(ctx, tmp_path):
     m2 = IsotonicRegressionModel.load(path)
     np.testing.assert_allclose(m2.boundaries, m.boundaries)
     np.testing.assert_allclose(m2.predictions, m.predictions)
+
+
+def test_tweedie_label_domain_validation(ctx):
+    """ref Tweedie.initialize:624-632: y=0 is legal in the compound-
+    Poisson band (1<=p<2) but must RAISE for p>=2 — silently NaN
+    deviances are not an answer (review r5)."""
+    from cycloneml_tpu.ml.regression import GeneralizedLinearRegression
+    x = np.array([[1.0], [2.0], [3.0]])
+    y0 = np.array([0.0, 1.0, 2.0])
+    frame = MLFrame(ctx, {"features": x, "label": y0})
+    m = GeneralizedLinearRegression(family="tweedie", variancePower=1.5,
+                                    maxIter=5).fit(frame)
+    assert np.isfinite(m.summary.deviance)
+    with pytest.raises(ValueError, match="positive"):
+        GeneralizedLinearRegression(family="tweedie", variancePower=2.5,
+                                    maxIter=5).fit(frame)
+    with pytest.raises(ValueError, match="non-negative"):
+        GeneralizedLinearRegression(
+            family="tweedie", variancePower=1.5, maxIter=5).fit(
+                MLFrame(ctx, {"features": x,
+                              "label": np.array([-1.0, 1.0, 2.0])}))
