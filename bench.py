@@ -94,10 +94,12 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     excluded.
 
     Default shape n=2M × d=1280: one loss/grad eval streams the 10.2 GB
-    feature block ONCE (the binomial aggregator folds standardization into
-    the read and XLA fuses the margin and gradient passes over each block
-    tile), so the fit is HBM-bound — the honest ceiling for a
-    generalized-linear sweep on any hardware. No standardized copy exists
+    feature block ONCE — at this scale ``usePallasKernels=auto`` selects
+    the fused single-pass Pallas kernel (margin + loss + gradient in one
+    VMEM-resident row pass, Kahan-compensated accumulation; see
+    benchmarks/PALLAS_AB.md) with standardization folded into the read —
+    so the fit is HBM-bound, the honest ceiling for a generalized-linear
+    sweep on any hardware. No standardized copy exists
     (r4: binary_logistic_scaled), so X itself is the working set and n can
     fill one chip's 16 GB HBM.
     """

@@ -328,12 +328,22 @@ USE_PALLAS_KERNELS = (
     .doc("Route the binomial LogisticRegression aggregator and the KMeans "
          "assignment step through the hand-written Pallas kernels "
          "(ops/kernels.py) instead of the XLA-fused jnp aggregators. "
-         "Default off: the committed A/B microbenchmark "
-         "(benchmarks/PALLAS_AB.md) shows XLA fusion within ~1.5x (slightly "
-         "ahead) on gemv-shaped MLlib workloads — the kernels are the "
-         "escape hatch for shapes XLA schedules poorly.")
-    .bool_conf(False)
+         "'auto' (default) uses the fused single-pass logistic kernel for "
+         "HBM-scale dense binomial fits on natively-lowered backends "
+         "(TPU), where the committed head-to-head (benchmarks/PALLAS_AB.md) "
+         "shows it ~10-16% faster end-to-end than the XLA path, and the "
+         "XLA path everywhere else (small shapes are within noise and the "
+         "interpreted kernel is slow on CPU). 'true'/'false' force one "
+         "path for both estimators.")
+    .check_value(lambda v: str(v).lower() in ("auto", "true", "false"),
+                 "must be auto, true or false")
+    .str_conf("auto")
 )
+
+# elements of X above which the fused Pallas logistic kernel wins on
+# real hardware (the 2026-07-31 head-to-heads at n=2M x d=1280 = 2.56e9;
+# the committed small-shape A/B (~6.7e7) shows XLA/pallas within noise)
+PALLAS_AUTO_MIN_ELEMENTS = 1 << 28
 
 SHUFFLE_SPILL_ROW_BUDGET = (
     ConfigBuilder("cyclone.shuffle.spill.rowBudget")

@@ -351,12 +351,22 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                                            "upperBoundsOnIntercepts"))
 
         rt = ds.ctx.mesh_runtime
-        from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+        from cycloneml_tpu.conf import (PALLAS_AUTO_MIN_ELEMENTS,
+                                        USE_PALLAS_KERNELS)
+        from cycloneml_tpu.ops.kernels import pallas_available
         from cycloneml_tpu.parallel import feature_sharding as fs
         m = fs.model_parallelism(rt)
         tp_active = (not is_multinomial) and m > 1 and d % m == 0
-        use_pallas = (not is_multinomial and hasattr(ds.ctx, "conf")
-                      and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
+        pal_conf = (str(ds.ctx.conf.get(USE_PALLAS_KERNELS)).lower()
+                    if hasattr(ds.ctx, "conf") else "false")
+        # auto: the fused one-pass kernel wins on real hardware once X is
+        # HBM-scale (committed head-to-head, benchmarks/PALLAS_AB.md);
+        # below that the two paths are within relay noise and the XLA
+        # path keeps CPU tests off the slow interpreter
+        use_pallas = (not is_multinomial) and (
+            pal_conf == "true"
+            or (pal_conf == "auto" and pallas_available()
+                and ds.n_rows * d >= PALLAS_AUTO_MIN_ELEMENTS))
         # EVERY fit path folds standardization (and fitWithMean centering)
         # INTO the aggregator read — no standardized copy exists anywhere:
         # replicated binomial/multinomial since r4; the feature-sharded TP

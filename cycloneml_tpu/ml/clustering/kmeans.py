@@ -98,8 +98,12 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
         hi = jax.lax.Precision.HIGHEST
         from cycloneml_tpu.conf import USE_PALLAS_KERNELS
-        use_pallas = (hasattr(ds.ctx, "conf")
-                      and bool(ds.ctx.conf.get(USE_PALLAS_KERNELS)))
+        # explicit opt-in only: the assignment kernel has no measured win
+        # over XLA at any committed shape (PALLAS_AB.md), so 'auto' keeps
+        # the XLA path here
+        use_pallas = (hasattr(ds.ctx, "conf") and
+                      str(ds.ctx.conf.get(USE_PALLAS_KERNELS)).lower()
+                      == "true")
 
         if use_pallas:
             from cycloneml_tpu.ops.kernels import fused_kmeans_assign

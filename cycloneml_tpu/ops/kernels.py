@@ -171,7 +171,7 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
 
 def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
     def kern(b0_ref, x_ref, y_ref, w_ref, beta_ref,
-             loss_ref, grad_ref, aux_ref):
+             loss_ref, grad_ref, aux_ref, closs_ref, cgrad_ref, caux_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -180,6 +180,9 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
             loss_ref[:] = jnp.zeros_like(loss_ref)
             aux_ref[:] = jnp.zeros_like(aux_ref)
             grad_ref[:] = jnp.zeros_like(grad_ref)
+            closs_ref[:] = jnp.zeros_like(closs_ref)
+            cgrad_ref[:] = jnp.zeros_like(cgrad_ref)
+            caux_ref[:] = jnp.zeros_like(caux_ref)
 
         xv = x_ref[:]
         yv = y_ref[:]          # (T, 1) — Mosaic rejects 1-D blocks that
@@ -190,13 +193,27 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
         margin = jnp.sum(xv * beta_ref[:], axis=1,
                          keepdims=True) + b0_ref[0, 0]       # (T, 1)
         mult = wv * (jax.nn.sigmoid(margin) - yv)
-        loss_ref[:] += jnp.sum(wv * (jax.nn.softplus(margin)
-                                     - yv * margin)).reshape(1, 1)
-        aux_ref[:] += jnp.concatenate(
+        v_loss = jnp.sum(wv * (jax.nn.softplus(margin)
+                               - yv * margin)).reshape(1, 1)
+        v_aux = jnp.concatenate(
             [jnp.sum(mult)[None], jnp.sum(wv)[None]]).reshape(1, 2)
-        grad_ref[:] += jnp.sum(mult * xv, axis=0, keepdims=True)
+        v_grad = jnp.sum(mult * xv, axis=0, keepdims=True)
+        # Kahan-compensated accumulation across the (sequential) grid: a
+        # plain f32 `+=` over thousands of row tiles drifts ~n_tiles ulps,
+        # which is enough to break the strong-Wolfe first-try acceptance
+        # when this kernel feeds the chunked device L-BFGS (measured: 46
+        # line-search evals vs 10 for the tree-reducing XLA path at
+        # n=2M×d=1280). The running compensation keeps the total at ~1 ulp
+        # — cheaper than the XLA tree and exact enough for the Wolfe tests.
+        for acc, comp, v in ((loss_ref, closs_ref, v_loss),
+                             (grad_ref, cgrad_ref, v_grad),
+                             (aux_ref, caux_ref, v_aux)):
+            yk = v - comp[:]
+            t = acc[:] + yk
+            comp[:] = (t - acc[:]) - yk
+            acc[:] = t
 
-    return pl.pallas_call(
+    outs = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -210,14 +227,21 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
             pl.BlockSpec((1, 1), lambda i: (0, 0)),
             pl.BlockSpec((1, d_pad), lambda i: (0, 0)),
             pl.BlockSpec((1, 2), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
         ],
         interpret=interpret,
     )(b0.reshape(1, 1), x, y, w, beta_p)
+    return outs[:3]
 
 
 # -- fused KMeans assignment ----------------------------------------------------
