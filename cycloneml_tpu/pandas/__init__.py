@@ -11,11 +11,19 @@ groupby-agg, merge, fillna/dropna/isna, describe, value_counts, reductions,
 apply, to/from pandas — plus label indexes (set_index/reset_index,
 loc/iloc, aligned Series arithmetic), rolling/expanding windows, the
 .str/.dt accessors, concat/pivot_table, datetime ranges + resample,
-merge-on-index, pandas-semantics astype, and iterrows/itertuples.
+merge-on-index, pandas-semantics astype, and iterrows/itertuples — and the
+long-tail tranche: frame/series reductions, rank/quantile/corr/cov,
+cum* ops, shift/diff/pct_change, where/mask/isin/clip, nlargest,
+duplicated/drop_duplicates, melt/stack/transpose/join/combine_first,
+groupby transform/shift/rank/cumcount/ngroup/filter/size, and
+get_dummies/cut/qcut/crosstab.
 """
 
 from cycloneml_tpu.pandas.frame import (CycloneFrame, CycloneSeries, concat,
-                                        date_range, pivot_table, read_csv)
+                                        crosstab, cut, date_range,
+                                        get_dummies, melt, pivot_table,
+                                        qcut, read_csv)
 
-__all__ = ["CycloneFrame", "CycloneSeries", "concat", "date_range",
-           "pivot_table", "read_csv"]
+__all__ = ["CycloneFrame", "CycloneSeries", "concat", "crosstab", "cut",
+           "date_range", "get_dummies", "melt", "pivot_table", "qcut",
+           "read_csv"]

@@ -42,6 +42,53 @@ def _is_null(arr: np.ndarray) -> np.ndarray:
     return np.zeros(len(arr), dtype=bool)
 
 
+_NAN_KEY = object()  # all NaN/None keys compare equal, as pandas does
+
+
+def _norm_key(v):
+    if v is None or (isinstance(v, (float, np.floating)) and np.isnan(v)):
+        return _NAN_KEY
+    return v
+
+
+def _label_array(labels) -> np.ndarray:
+    """1-D object array of labels — tuples STAY single labels (np.array
+    would explode a list of tuples into a 2-D array)."""
+    out = np.empty(len(labels), dtype=object)
+    for i, l in enumerate(labels):
+        out[i] = l
+    return out
+
+
+def _duplicated_mask(cols: Sequence[np.ndarray], keep) -> np.ndarray:
+    """True where the row's key tuple has been seen before (keep='first'),
+    will be seen again (keep='last'), or appears more than once
+    (keep=False) — the pandas duplicated() contract (NaN keys equal)."""
+    n = len(cols[0])
+    keys = list(zip(*[[_norm_key(v) for v in np.asarray(c, dtype=object)]
+                      for c in cols]))
+    out = np.zeros(n, dtype=bool)
+    if keep == "first":
+        seen = set()
+        for i, k in enumerate(keys):
+            out[i] = k in seen
+            seen.add(k)
+    elif keep == "last":
+        seen = set()
+        for i in range(n - 1, -1, -1):
+            out[i] = keys[i] in seen
+            seen.add(keys[i])
+    elif keep is False:
+        from collections import Counter
+        counts = Counter(keys)
+        for i, k in enumerate(keys):
+            out[i] = counts[k] > 1
+    else:
+        raise ValueError(f"keep must be 'first', 'last' or False, "
+                         f"got {keep!r}")
+    return out
+
+
 class CycloneSeries:
     """1-D labeled column (ref: pyspark/pandas/series.py). ``index`` is an
     optional label array; None means positional (RangeIndex)."""
@@ -124,22 +171,28 @@ class CycloneSeries:
     def __getitem__(self, i):
         return self.values[i]
 
-    # -- reductions ------------------------------------------------------------
+    # -- reductions (skipna=True — the pandas default) -------------------------
+    def _notnull(self) -> np.ndarray:
+        return self.values[~_is_null(self.values)]
+
     def sum(self):
-        return self.values.sum()
+        v = self.values
+        return v[~_is_null(v)].sum() if v.dtype.kind in "fO" else v.sum()
 
     def mean(self):
-        return float(np.mean(self.values))
+        return float(np.mean(self._notnull()))
 
     def std(self):
-        n = len(self.values)
-        return float(np.std(self.values, ddof=1)) if n > 1 else float("nan")
+        v = self._notnull()
+        return float(np.std(v, ddof=1)) if len(v) > 1 else float("nan")
 
     def min(self):
-        return self.values.min()
+        v = self._notnull()
+        return v.min() if len(v) else np.nan
 
     def max(self):
-        return self.values.max()
+        v = self._notnull()
+        return v.max() if len(v) else np.nan
 
     def count(self) -> int:
         return int((~_is_null(self.values)).sum())
@@ -178,6 +231,223 @@ class CycloneSeries:
         s = CycloneSeries(counts[order], self.name)
         s.index = vals[order]
         return s
+
+    def notna(self) -> "CycloneSeries":
+        return CycloneSeries(~_is_null(self.values), self.name,
+                             index=self.index)
+
+    def abs(self) -> "CycloneSeries":
+        return CycloneSeries(np.abs(self.values), self.name,
+                             index=self.index)
+
+    def round(self, decimals: int = 0) -> "CycloneSeries":
+        return CycloneSeries(np.round(self.values, decimals), self.name,
+                             index=self.index)
+
+    def clip(self, lower=None, upper=None) -> "CycloneSeries":
+        v = self.values
+        if lower is not None:
+            v = np.maximum(v, lower)
+        if upper is not None:
+            v = np.minimum(v, upper)
+        return CycloneSeries(v, self.name, index=self.index)
+
+    def diff(self, periods: int = 1) -> "CycloneSeries":
+        shifted = self.shift(periods)
+        return CycloneSeries(
+            self.values.astype(np.float64) - shifted.values,
+            self.name, index=self.index)
+
+    def shift(self, periods: int = 1, fill_value=None) -> "CycloneSeries":
+        """(ref pandas shift) — numeric columns widen to float64 so the
+        hole can hold NaN; a non-null ``fill_value`` keeps the dtype
+        (promoted only as the fill itself demands), as pandas does."""
+        v = self.values
+        if v.dtype == object:
+            out = np.full(len(v), fill_value, dtype=object)
+        elif fill_value is None:
+            out = np.full(len(v), np.nan, dtype=np.float64)
+            v = v.astype(np.float64)
+        else:
+            dt = np.result_type(v.dtype, np.min_scalar_type(fill_value))
+            out = np.full(len(v), fill_value, dtype=dt)
+            v = v.astype(dt)
+        if periods >= 0:
+            out[periods:] = v[:len(v) - periods] if periods else v
+        else:
+            out[:periods] = v[-periods:]
+        return CycloneSeries(out, self.name, index=self.index)
+
+    def pct_change(self, periods: int = 1) -> "CycloneSeries":
+        prev = self.shift(periods).values
+        return CycloneSeries(self.values.astype(np.float64) / prev - 1.0,
+                             self.name, index=self.index)
+
+    def _nan_cum(self, op, identity) -> "CycloneSeries":
+        """Cumulative op that SKIPS NaNs (they stay NaN in place but do not
+        poison the running value) — the pandas contract."""
+        v = self.values.astype(np.float64)
+        null = np.isnan(v)
+        filled = np.where(null, identity, v)
+        out = op(filled)
+        out = np.where(null, np.nan, out)
+        return CycloneSeries(out, self.name, index=self.index)
+
+    def cumsum(self):
+        return self._nan_cum(np.cumsum, 0.0)
+
+    def cumprod(self):
+        return self._nan_cum(np.cumprod, 1.0)
+
+    def cummax(self):
+        return self._nan_cum(np.maximum.accumulate, -np.inf)
+
+    def cummin(self):
+        return self._nan_cum(np.minimum.accumulate, np.inf)
+
+    def rank(self, method: str = "average",
+             ascending: bool = True) -> "CycloneSeries":
+        """(ref pandas Series.rank, na_option='keep') — average/min/max/
+        dense via scipy rankdata; 'first' by stable sort position."""
+        v = self.values.astype(np.float64)
+        null = np.isnan(v)
+        body = v[~null]
+        if not ascending:
+            body = -body
+        if method == "first":
+            order = np.argsort(body, kind="stable")
+            r = np.empty(len(body), dtype=np.float64)
+            r[order] = np.arange(1, len(body) + 1, dtype=np.float64)
+        else:
+            from scipy.stats import rankdata
+            r = rankdata(body, method=method).astype(np.float64)
+        out = np.full(len(v), np.nan)
+        out[~null] = r
+        return CycloneSeries(out, self.name, index=self.index)
+
+    def quantile(self, q=0.5, interpolation: str = "linear"):
+        v = self.values.astype(np.float64)
+        v = v[~np.isnan(v)]
+        if np.isscalar(q):
+            return float(np.quantile(v, q, method=interpolation)) \
+                if len(v) else float("nan")
+        vals = (np.quantile(v, list(q), method=interpolation)
+                if len(v) else np.full(len(list(q)), np.nan))
+        return CycloneSeries(vals, self.name, index=np.asarray(q))
+
+    def median(self):
+        v = self.values.astype(np.float64)
+        return float(np.median(v[~np.isnan(v)]))
+
+    def var(self):
+        v = self._notnull()
+        return float(np.var(v, ddof=1)) if len(v) > 1 else float("nan")
+
+    def prod(self):
+        v = self.values
+        if v.dtype.kind == "f":
+            return v[~np.isnan(v)].prod()
+        return v.prod()
+
+    def mode(self) -> "CycloneSeries":
+        v = self.values
+        v = v[~_is_null(v)]
+        vals, counts = np.unique(v, return_counts=True)
+        return CycloneSeries(np.sort(vals[counts == counts.max()]),
+                             self.name)
+
+    def idxmax(self):
+        v = self.values.astype(np.float64)
+        return self._label(int(np.nanargmax(v)))
+
+    def idxmin(self):
+        v = self.values.astype(np.float64)
+        return self._label(int(np.nanargmin(v)))
+
+    def _label(self, pos: int):
+        return pos if self.index is None else self.index[pos]
+
+    def any(self) -> bool:
+        # skipna=True (the pandas default): NaN is not truthy here
+        return bool(np.asarray(self._notnull(), dtype=bool).any())
+
+    def all(self) -> bool:
+        return bool(np.asarray(self._notnull(), dtype=bool).all())
+
+    def isin(self, values) -> "CycloneSeries":
+        vset = {_norm_key(v) for v in values}
+        return CycloneSeries(
+            np.array([_norm_key(v) in vset for v in self.values],
+                     dtype=bool),
+            self.name, index=self.index)
+
+    def between(self, left, right,
+                inclusive: str = "both") -> "CycloneSeries":
+        v = self.values
+        lo = v >= left if inclusive in ("both", "left") else v > left
+        hi = v <= right if inclusive in ("both", "right") else v < right
+        return CycloneSeries(lo & hi, self.name, index=self.index)
+
+    def where(self, cond, other=np.nan) -> "CycloneSeries":
+        c = np.asarray(cond.values if isinstance(cond, CycloneSeries)
+                       else cond, dtype=bool)
+        o = other.values if isinstance(other, CycloneSeries) else other
+        v = self.values
+        if v.dtype.kind in "iub" and not isinstance(o, np.ndarray) \
+                and (o is np.nan or (isinstance(o, float) and np.isnan(o))):
+            v = v.astype(np.float64)  # hole must hold NaN
+        return CycloneSeries(np.where(c, v, o), self.name, index=self.index)
+
+    def mask(self, cond, other=np.nan) -> "CycloneSeries":
+        c = np.asarray(cond.values if isinstance(cond, CycloneSeries)
+                       else cond, dtype=bool)
+        return self.where(~c, other)
+
+    def _nl(self, n: int, largest: bool) -> "CycloneSeries":
+        v = self.values.astype(np.float64)
+        pos = np.nonzero(~np.isnan(v))[0]
+        key = -v[pos] if largest else v[pos]
+        order = pos[np.argsort(key, kind="stable")][:n]
+        idx = (self.index[order] if self.index is not None else order)
+        return CycloneSeries(self.values[order], self.name, index=idx)
+
+    def nlargest(self, n: int = 5) -> "CycloneSeries":
+        return self._nl(n, True)
+
+    def nsmallest(self, n: int = 5) -> "CycloneSeries":
+        return self._nl(n, False)
+
+    def duplicated(self, keep: str = "first") -> "CycloneSeries":
+        return CycloneSeries(_duplicated_mask([self.values], keep),
+                             self.name, index=self.index)
+
+    def drop_duplicates(self, keep: str = "first") -> "CycloneSeries":
+        m = ~_duplicated_mask([self.values], keep)
+        pos = np.nonzero(m)[0]
+        return CycloneSeries(
+            self.values[pos], self.name,
+            index=self.index[pos] if self.index is not None else pos)
+
+    def sort_values(self, ascending: bool = True) -> "CycloneSeries":
+        order = np.argsort(self.values, kind="stable")
+        if not ascending:
+            order = order[::-1]
+        idx = self.index[order] if self.index is not None else order
+        return CycloneSeries(self.values[order], self.name, index=idx)
+
+    def _pairwise_complete(self, other: "CycloneSeries"):
+        a = self.values.astype(np.float64)
+        b = np.asarray(other.values, dtype=np.float64)
+        ok = ~(np.isnan(a) | np.isnan(b))
+        return a[ok], b[ok]
+
+    def corr(self, other: "CycloneSeries") -> float:
+        a, b = self._pairwise_complete(other)
+        return float(np.corrcoef(a, b)[0, 1])
+
+    def cov(self, other: "CycloneSeries") -> float:
+        a, b = self._pairwise_complete(other)
+        return float(np.cov(a, b, ddof=1)[0, 1])
 
     def rolling(self, window: int, min_periods: Optional[int] = None
                 ) -> "_Rolling":
@@ -582,16 +852,22 @@ class _GroupBy:
         rest = [c for c in self._frame.columns if c not in self._keys]
         return self._agg({c: "count" for c in rest}, suffix=False)
 
+    def _groups(self) -> Dict[tuple, list]:
+        """key tuple → row positions, first-appearance order preserved."""
+        f = self._frame
+        key_tuples = list(zip(*[f._cols[k] for k in self._keys]))
+        order: Dict[tuple, list] = {}
+        for i, t in enumerate(key_tuples):
+            order.setdefault(t, []).append(i)
+        return order
+
     def apply(self, func) -> Union["CycloneSeries", "CycloneFrame"]:
         """(ref pandas groupby.apply / pyspark.pandas groupby.py apply):
         call ``func`` on each group's sub-frame, groups in sorted key
         order. Scalar results → a Series indexed by group key; Series
         results → a frame (one row per group, index = group key)."""
         f = self._frame
-        key_tuples = list(zip(*[f._cols[k] for k in self._keys]))
-        order = {}
-        for i, t in enumerate(key_tuples):
-            order.setdefault(t, []).append(i)
+        order = self._groups()
         results = []
         labels = []
         for t in sorted(order):
@@ -599,7 +875,7 @@ class _GroupBy:
             sub = f._take(pos)
             results.append(func(sub))
             labels.append(t[0] if len(self._keys) == 1 else t)
-        label_arr = np.array(labels, dtype=object)
+        label_arr = _label_array(labels)
         name = (self._keys[0] if len(self._keys) == 1
                 else list(self._keys))
         if all(isinstance(r, CycloneSeries) for r in results):
@@ -613,6 +889,156 @@ class _GroupBy:
         out_s = CycloneSeries(_narrow_object(np.array(results, dtype=object)),
                               None, index=label_arr)
         return out_s
+
+    def _per_group_scalar(self, fn: Callable) -> "CycloneFrame":
+        """One scalar per (group, non-key numeric column) via the group
+        machinery, sorted-key order (pandas sorts groups by default)."""
+        f = self._frame
+        order = self._groups()
+        data_cols = [c for c in f.columns
+                     if c not in self._keys and f._cols[c].dtype != object]
+        labels, rows = [], {c: [] for c in data_cols}
+        for t in sorted(order):
+            pos = np.asarray(order[t], dtype=np.int64)
+            labels.append(t[0] if len(self._keys) == 1 else t)
+            for c in data_cols:
+                rows[c].append(fn(f._cols[c][pos]))
+        out = CycloneFrame({c: np.asarray(v) for c, v in rows.items()})
+        out._index = _label_array(labels)
+        out._index_name = (self._keys[0] if len(self._keys) == 1
+                           else list(self._keys))
+        return out
+
+    def std(self):
+        return self._per_group_scalar(
+            lambda v: np.std(v.astype(np.float64), ddof=1)
+            if len(v) > 1 else np.nan)
+
+    def var(self):
+        return self._per_group_scalar(
+            lambda v: np.var(v.astype(np.float64), ddof=1)
+            if len(v) > 1 else np.nan)
+
+    def median(self):
+        return self._per_group_scalar(
+            lambda v: np.median(v[~_is_null(v)].astype(np.float64)))
+
+    def nunique(self):
+        return self._per_group_scalar(
+            lambda v: len(np.unique(v[~_is_null(v)])))
+
+    def _first_last(self, last: bool) -> "CycloneFrame":
+        """First/last NON-NULL value per column per group, object columns
+        included — the pandas first()/last() contract."""
+        f = self._frame
+        order = self._groups()
+        data_cols = [c for c in f.columns if c not in self._keys]
+        labels, rows = [], {c: [] for c in data_cols}
+        for t in sorted(order):
+            pos = order[t][::-1] if last else order[t]
+            labels.append(t[0] if len(self._keys) == 1 else t)
+            for c in data_cols:
+                vals = f._cols[c]
+                rows[c].append(next(
+                    (vals[i] for i in pos
+                     if _norm_key(vals[i]) is not _NAN_KEY), np.nan))
+        out = CycloneFrame({
+            c: _narrow_object(np.array(v, dtype=object))
+            for c, v in rows.items()})
+        out._index = _label_array(labels)
+        out._index_name = (self._keys[0] if len(self._keys) == 1
+                           else list(self._keys))
+        return out
+
+    def first(self):
+        return self._first_last(last=False)
+
+    def last(self):
+        return self._first_last(last=True)
+
+    def size(self) -> CycloneSeries:
+        order = self._groups()
+        labels = [t[0] if len(self._keys) == 1 else t
+                  for t in sorted(order)]
+        return CycloneSeries(
+            np.array([len(order[t]) for t in sorted(order)],
+                     dtype=np.int64),
+            None, index=_label_array(labels))
+
+    # -- row-shaped (length-preserving) group ops -------------------------
+    def _scatter(self, per_group: Callable, dtype=np.float64
+                 ) -> Dict[str, np.ndarray]:
+        """Apply ``per_group(values) -> values`` within each group and
+        scatter results back to original row order, per non-key column."""
+        f = self._frame
+        order = self._groups()
+        data_cols = [c for c in f.columns
+                     if c not in self._keys and f._cols[c].dtype != object]
+        out = {c: np.empty(len(f), dtype=dtype) for c in data_cols}
+        for t, pos_list in order.items():
+            pos = np.asarray(pos_list, dtype=np.int64)
+            for c in data_cols:
+                out[c][pos] = per_group(f._cols[c][pos])
+        return out
+
+    def transform(self, fn) -> "CycloneFrame":
+        """(ref pandas groupby.transform) — broadcast a group aggregate
+        back over the group's rows. ``fn`` is an agg name (NaN-skipping,
+        like the pandas aggregates) or a callable (applied verbatim)."""
+        if callable(fn):
+            g = fn
+        else:
+            g = {"sum": np.nansum, "mean": np.nanmean, "min": np.nanmin,
+                 "max": np.nanmax, "median": np.nanmedian,
+                 "prod": np.nanprod,
+                 "count": lambda v: np.count_nonzero(~np.isnan(v)),
+                 "std": lambda v: np.nanstd(v, ddof=1),
+                 "var": lambda v: np.nanvar(v, ddof=1)}[fn]
+        return self._frame._like(self._scatter(
+            lambda v: np.full(len(v), g(v.astype(np.float64)))))
+
+    def cumsum(self) -> "CycloneFrame":
+        return self._frame._like(self._scatter(
+            lambda v: np.cumsum(v.astype(np.float64))))
+
+    def shift(self, periods: int = 1) -> "CycloneFrame":
+        return self._frame._like(self._scatter(
+            lambda v: CycloneSeries(v).shift(periods).values))
+
+    def rank(self, method: str = "average") -> "CycloneFrame":
+        return self._frame._like(self._scatter(
+            lambda v: CycloneSeries(v).rank(method).values))
+
+    def cumcount(self) -> CycloneSeries:
+        out = np.empty(len(self._frame), dtype=np.int64)
+        for pos_list in self._groups().values():
+            out[np.asarray(pos_list)] = np.arange(len(pos_list))
+        return CycloneSeries(out, index=self._frame._index)
+
+    def ngroup(self) -> CycloneSeries:
+        """Group number by SORTED key order (the pandas contract)."""
+        order = self._groups()
+        out = np.empty(len(self._frame), dtype=np.int64)
+        for g, t in enumerate(sorted(order)):
+            out[np.asarray(order[t])] = g
+        return CycloneSeries(out, index=self._frame._index)
+
+    def filter(self, func) -> "CycloneFrame":
+        """Rows of groups where ``func(group_frame)`` is truthy, original
+        row order (ref pandas groupby.filter)."""
+        keep: list = []
+        f = self._frame
+        for pos_list in self._groups().values():
+            pos = np.asarray(pos_list, dtype=np.int64)
+            if func(f._take(pos)):
+                keep.extend(pos_list)
+        return f._take(np.asarray(sorted(keep), dtype=np.int64))
+
+    def head(self, n: int = 5) -> "CycloneFrame":
+        keep: list = []
+        for pos_list in self._groups().values():
+            keep.extend(pos_list[:n])
+        return self._frame._take(np.asarray(sorted(keep), dtype=np.int64))
 
 
 def _astype_pandas(arr: np.ndarray, dtype) -> np.ndarray:
@@ -973,12 +1399,14 @@ class CycloneFrame:
 
     def drop(self, columns: Sequence[str]) -> "CycloneFrame":
         drop = set([columns] if isinstance(columns, str) else columns)
-        return CycloneFrame({k: v for k, v in self._cols.items()
-                             if k not in drop})
+        return self._like({k: v for k, v in self._cols.items()
+                           if k not in drop})
 
     def rename(self, columns: Dict[str, str]) -> "CycloneFrame":
-        return CycloneFrame({columns.get(k, k): v
-                             for k, v in self._cols.items()})
+        # _like: renaming columns must not drop the row index (pandas
+        # keeps it; join/add_prefix/add_suffix all route through here)
+        return self._like({columns.get(k, k): v
+                           for k, v in self._cols.items()})
 
     # -- rows ------------------------------------------------------------------
     def head(self, n: int = 5) -> "CycloneFrame":
@@ -1004,11 +1432,11 @@ class CycloneFrame:
 
     # -- missing data ----------------------------------------------------------
     def isna(self) -> "CycloneFrame":
-        return CycloneFrame({k: _is_null(v) for k, v in self._cols.items()})
+        return self._like({k: _is_null(v) for k, v in self._cols.items()})
 
     def fillna(self, value) -> "CycloneFrame":
-        return CycloneFrame({k: CycloneSeries(v).fillna(value).values
-                             for k, v in self._cols.items()})
+        return self._like({k: CycloneSeries(v).fillna(value).values
+                           for k, v in self._cols.items()})
 
     def dropna(self) -> "CycloneFrame":
         if not self._cols:
@@ -1201,6 +1629,324 @@ class CycloneFrame:
         rows = self.to_records()
         return CycloneSeries(np.array([f(r) for r in rows]))
 
+    # -- frame reductions (→ Series over the column labels) --------------
+    def _reduce(self, fn: str, numeric_only: bool = False) -> CycloneSeries:
+        names, vals = [], []
+        for k, v in self._cols.items():
+            if v.dtype == object:
+                if numeric_only:
+                    continue
+                if fn in ("mean", "std", "var", "median"):
+                    raise TypeError(
+                        f"Could not convert column {k!r} to numeric for "
+                        f"{fn} (pass numeric_only=True)")
+            names.append(k)
+            vals.append(getattr(CycloneSeries(v), fn)())
+        return CycloneSeries(np.asarray(vals), fn,
+                             index=np.array(names, dtype=object))
+
+    def sum(self, numeric_only: bool = False):
+        return self._reduce("sum", numeric_only)
+
+    def mean(self, numeric_only: bool = False):
+        return self._reduce("mean", numeric_only)
+
+    def std(self, numeric_only: bool = False):
+        return self._reduce("std", numeric_only)
+
+    def var(self, numeric_only: bool = False):
+        return self._reduce("var", numeric_only)
+
+    def median(self, numeric_only: bool = False):
+        return self._reduce("median", numeric_only)
+
+    def min(self, numeric_only: bool = False):
+        return self._reduce("min", numeric_only)
+
+    def max(self, numeric_only: bool = False):
+        return self._reduce("max", numeric_only)
+
+    def nunique(self) -> CycloneSeries:
+        return self._reduce("nunique")
+
+    def any(self) -> CycloneSeries:
+        return self._reduce("any")
+
+    def all(self) -> CycloneSeries:
+        return self._reduce("all")
+
+    def idxmax(self) -> CycloneSeries:
+        return CycloneSeries(
+            np.array([CycloneSeries(v, k, index=self._index).idxmax()
+                      for k, v in self._cols.items()], dtype=object),
+            "idxmax", index=np.array(self.columns, dtype=object))
+
+    def idxmin(self) -> CycloneSeries:
+        return CycloneSeries(
+            np.array([CycloneSeries(v, k, index=self._index).idxmin()
+                      for k, v in self._cols.items()], dtype=object),
+            "idxmin", index=np.array(self.columns, dtype=object))
+
+    def quantile(self, q=0.5, numeric_only: bool = False):
+        names = [k for k, v in self._cols.items()
+                 if not (numeric_only and v.dtype == object)]
+        if np.isscalar(q):
+            return CycloneSeries(
+                np.array([CycloneSeries(self._cols[k]).quantile(q)
+                          for k in names]),
+                q, index=np.array(names, dtype=object))
+        # list of quantiles → a frame indexed by q (the pandas shape)
+        out = CycloneFrame({
+            k: np.array([CycloneSeries(self._cols[k]).quantile(x)
+                         for x in q]) for k in names})
+        out._index = np.asarray(q, dtype=np.float64)
+        return out
+
+    # -- elementwise / cumulative (column-at-a-time Series delegation) ----
+    def _per_column(self, method: str, *a, **kw) -> "CycloneFrame":
+        return self._like({
+            k: getattr(CycloneSeries(v, k), method)(*a, **kw).values
+            for k, v in self._cols.items()})
+
+    def abs(self) -> "CycloneFrame":
+        return self._per_column("abs")
+
+    def round(self, decimals: int = 0) -> "CycloneFrame":
+        return self._per_column("round", decimals)
+
+    def clip(self, lower=None, upper=None) -> "CycloneFrame":
+        return self._per_column("clip", lower, upper)
+
+    def diff(self, periods: int = 1) -> "CycloneFrame":
+        return self._per_column("diff", periods)
+
+    def shift(self, periods: int = 1, fill_value=None) -> "CycloneFrame":
+        return self._per_column("shift", periods, fill_value)
+
+    def cumsum(self) -> "CycloneFrame":
+        return self._per_column("cumsum")
+
+    def cummax(self) -> "CycloneFrame":
+        return self._per_column("cummax")
+
+    def cummin(self) -> "CycloneFrame":
+        return self._per_column("cummin")
+
+    def rank(self, method: str = "average",
+             ascending: bool = True) -> "CycloneFrame":
+        return self._per_column("rank", method, ascending)
+
+    def isin(self, values) -> "CycloneFrame":
+        if isinstance(values, dict):
+            return self._like({
+                k: (CycloneSeries(v).isin(values[k]).values
+                    if k in values else np.zeros(len(v), dtype=bool))
+                for k, v in self._cols.items()})
+        return self._per_column("isin", values)
+
+    def where(self, cond, other=np.nan) -> "CycloneFrame":
+        if isinstance(cond, CycloneFrame):
+            return self._like({
+                k: CycloneSeries(v).where(cond._cols[k], other).values
+                for k, v in self._cols.items()})
+        return self._per_column("where", cond, other)
+
+    def mask(self, cond, other=np.nan) -> "CycloneFrame":
+        if isinstance(cond, CycloneFrame):
+            return self.where(
+                cond._like({k: ~np.asarray(v, dtype=bool)
+                            for k, v in cond._cols.items()}), other)
+        c = np.asarray(cond.values if isinstance(cond, CycloneSeries)
+                       else cond, dtype=bool)
+        return self.where(~c, other)
+
+    # -- ordering / dedup -------------------------------------------------
+    def nlargest(self, n: int, columns) -> "CycloneFrame":
+        keys = [columns] if isinstance(columns, str) else list(columns)
+        key_arr = np.lexsort(
+            [-self._cols[k].astype(np.float64) for k in reversed(keys)])
+        return self._take(key_arr[:n])
+
+    def nsmallest(self, n: int, columns) -> "CycloneFrame":
+        keys = [columns] if isinstance(columns, str) else list(columns)
+        key_arr = np.lexsort(
+            [self._cols[k].astype(np.float64) for k in reversed(keys)])
+        return self._take(key_arr[:n])
+
+    def duplicated(self, subset=None, keep="first") -> CycloneSeries:
+        cols = ([subset] if isinstance(subset, str) else list(subset)) \
+            if subset is not None else self.columns
+        return CycloneSeries(
+            _duplicated_mask([self._cols[c] for c in cols], keep),
+            index=self._index)
+
+    def drop_duplicates(self, subset=None, keep="first") -> "CycloneFrame":
+        m = ~self.duplicated(subset, keep).values
+        return self._take(np.nonzero(m)[0])
+
+    # -- reshaping --------------------------------------------------------
+    def melt(self, id_vars=None, value_vars=None, var_name: str = "variable",
+             value_name: str = "value") -> "CycloneFrame":
+        """(ref pandas melt / pyspark.pandas frame.py melt) — wide→long."""
+        ids = ([id_vars] if isinstance(id_vars, str) else list(id_vars)) \
+            if id_vars is not None else []
+        vals = ([value_vars] if isinstance(value_vars, str)
+                else list(value_vars)) if value_vars is not None \
+            else [c for c in self.columns if c not in ids]
+        n = len(self)
+        out: Dict[str, np.ndarray] = {}
+        for c in ids:
+            out[c] = np.tile(self._cols[c], len(vals))
+        out[var_name] = np.repeat(np.array(vals, dtype=object), n)
+        out[value_name] = _narrow_object(np.concatenate(
+            [np.asarray(self._cols[c], dtype=object) for c in vals]))
+        return CycloneFrame(out)
+
+    def stack(self) -> CycloneSeries:
+        """columns → innermost index level: a Series with (row_label,
+        column) tuple index, in row-major order (pandas 3 future_stack
+        semantics: NaNs are KEPT)."""
+        labels = self.index
+        names = self.columns
+        idx = np.empty(len(self) * len(names), dtype=object)
+        vals = np.empty(len(self) * len(names), dtype=object)
+        p = 0
+        for i in range(len(self)):
+            for c in names:
+                idx[p] = (labels[i], c)
+                vals[p] = self._cols[c][i]
+                p += 1
+        return CycloneSeries(_narrow_object(vals), None, index=idx)
+
+    @property
+    def T(self) -> "CycloneFrame":
+        return self.transpose()
+
+    def transpose(self) -> "CycloneFrame":
+        """Duplicate index labels cannot transpose — the columnar dict
+        would silently overwrite one of them (pandas keeps both; an
+        error beats silent row loss here)."""
+        labels = self.index
+        if len(set(map(_norm_key, labels))) != len(labels):
+            raise ValueError(
+                "cannot transpose a frame with duplicate index labels")
+        rows = self.columns
+        out = CycloneFrame({
+            labels[j]: _narrow_object(
+                np.array([self._cols[c][j] for c in rows], dtype=object))
+            for j in range(len(self))})
+        out._index = np.array(rows, dtype=object)
+        return out
+
+    def join(self, other: "CycloneFrame", how: str = "left",
+             lsuffix: str = "", rsuffix: str = "") -> "CycloneFrame":
+        """Index-on-index merge (ref pandas DataFrame.join)."""
+        overlap = set(self.columns) & set(other.columns)
+        if overlap and not (lsuffix or rsuffix):
+            raise ValueError(
+                f"columns overlap but no suffix specified: {sorted(overlap)}")
+        lf = self.rename({c: c + lsuffix for c in overlap}) if overlap \
+            else self
+        rf = other.rename({c: c + rsuffix for c in overlap}) if overlap \
+            else other
+        return lf.merge(rf, left_index=True, right_index=True, how=how)
+
+    def combine_first(self, other: "CycloneFrame") -> "CycloneFrame":
+        """Label-aligned coalesce: self's non-null values win, holes fill
+        from ``other``; result over the SORTED index/column union
+        (pandas Index.union sorts)."""
+        union = sorted(set(self.index) | set(other.index))
+        cols = self.columns + [c for c in other.columns
+                               if c not in self.columns]
+        lpos = {k: i for i, k in enumerate(self.index)}
+        rpos = {k: i for i, k in enumerate(other.index)}
+        out: Dict[str, np.ndarray] = {}
+        for c in cols:
+            vals = np.empty(len(union), dtype=object)
+            for i, lab in enumerate(union):
+                v = None
+                if c in self._cols and lab in lpos:
+                    v = self._cols[c][lpos[lab]]
+                if (v is None or (isinstance(v, float) and np.isnan(v))) \
+                        and c in other._cols and lab in rpos:
+                    v = other._cols[c][rpos[lab]]
+                vals[i] = np.nan if v is None else v
+            out[c] = _narrow_object(vals)
+        res = CycloneFrame(out)
+        if self._index is not None or other._index is not None:
+            res._index = np.array(union, dtype=object)
+            res._index_name = self._index_name
+        return res
+
+    def sample(self, n: Optional[int] = None, frac: Optional[float] = None,
+               random_state: Optional[int] = None) -> "CycloneFrame":
+        if n is None:
+            # pandas default: ONE row when neither n nor frac is given
+            n = 1 if frac is None else int(round(frac * len(self)))
+        rng = np.random.RandomState(random_state)
+        return self._take(rng.choice(len(self), size=n, replace=False))
+
+    # -- small conveniences ----------------------------------------------
+    def copy(self) -> "CycloneFrame":
+        return CycloneFrame(self)
+
+    def equals(self, other: "CycloneFrame") -> bool:
+        if self.columns != other.columns or len(self) != len(other):
+            return False
+        if list(map(_norm_key, self.index)) != \
+                list(map(_norm_key, other.index)):
+            return False
+        for k in self.columns:
+            a, b = self._cols[k], other._cols[k]
+            na, nb = _is_null(a), _is_null(b)
+            if not np.array_equal(na, nb):
+                return False
+            if not all(x == y for x, y in zip(a[~na], b[~nb])):
+                return False
+        return True
+
+    def pop(self, col: str) -> CycloneSeries:
+        return CycloneSeries(self._cols.pop(col), col, index=self._index)
+
+    def insert(self, loc: int, column: str, value) -> None:
+        if column in self._cols:
+            raise ValueError(f"cannot insert {column}, already exists")
+        arr = np.asarray(value.values if isinstance(value, CycloneSeries)
+                         else value)
+        if self._cols and len(arr) != len(self):
+            raise ValueError(
+                f"column {column!r}: length {len(arr)} != {len(self)}")
+        items = list(self._cols.items())
+        items.insert(loc, (column, arr))
+        self._cols = dict(items)
+
+    def add_prefix(self, prefix: str) -> "CycloneFrame":
+        return self.rename({c: prefix + c for c in self.columns})
+
+    def add_suffix(self, suffix: str) -> "CycloneFrame":
+        return self.rename({c: c + suffix for c in self.columns})
+
+    def corr(self) -> "CycloneFrame":
+        return self._pairwise_stat("corr")
+
+    def cov(self) -> "CycloneFrame":
+        return self._pairwise_stat("cov")
+
+    def _pairwise_stat(self, fn: str) -> "CycloneFrame":
+        """Pairwise-complete-observation corr/cov over numeric columns —
+        each (i, j) cell drops only rows where THAT pair has a null,
+        matching pandas."""
+        names = [k for k, v in self._cols.items() if v.dtype != object]
+        out = {k: np.empty(len(names)) for k in names}
+        for i, a in enumerate(names):
+            sa = CycloneSeries(self._cols[a])
+            for j, b in enumerate(names):
+                out[b][i] = 1.0 if (fn == "corr" and a == b) else \
+                    getattr(sa, fn)(CycloneSeries(self._cols[b]))
+        res = CycloneFrame(out)
+        res._index = np.array(names, dtype=object)
+        return res
+
     # -- bridges ---------------------------------------------------------------
     def to_records(self) -> List[Dict[str, Any]]:
         cols = self.columns
@@ -1353,3 +2099,121 @@ def pivot_table(frame: CycloneFrame, values: str, index: str, columns: str,
     res._index_name = index
     return res
 
+
+
+def melt(frame: CycloneFrame, id_vars=None, value_vars=None,
+         var_name: str = "variable", value_name: str = "value"
+         ) -> CycloneFrame:
+    """Module-level twin of :meth:`CycloneFrame.melt` (ref pd.melt)."""
+    return frame.melt(id_vars, value_vars, var_name, value_name)
+
+
+def get_dummies(data, prefix: Optional[str] = None, prefix_sep: str = "_",
+                dtype=bool) -> CycloneFrame:
+    """One-hot encode (ref pd.get_dummies / pyspark.pandas namespace.py).
+
+    A Series encodes to sorted-category indicator columns; a frame
+    encodes every object column in place, keeping numeric columns."""
+    if isinstance(data, CycloneSeries):
+        vals = data.values
+        cats = sorted(set(vals[~_is_null(vals)]))
+        # pandas: a bare Series encodes to unprefixed category columns
+        name = prefix if prefix is not None else ""
+        cols = {}
+        for c in cats:
+            key = f"{name}{prefix_sep}{c}" if name else str(c)
+            cols[key] = np.asarray(vals == c, dtype=dtype)
+        return CycloneFrame(cols)
+    # pandas column order: untouched columns first, then every object
+    # column's dummies appended in original column order
+    out: Dict[str, np.ndarray] = {
+        k: v for k, v in data._cols.items() if v.dtype != object}
+    for k, v in data._cols.items():
+        if v.dtype == object:
+            sub = get_dummies(CycloneSeries(v, k), prefix=prefix or k,
+                              prefix_sep=prefix_sep, dtype=dtype)
+            out.update(sub._cols)
+    return data._like(out)
+
+
+def cut(x, bins, labels=None, right: bool = True) -> CycloneSeries:
+    """Fixed-width binning (ref pd.cut). ``labels=False`` → integer bin
+    codes (−1 for out-of-range/NaN, pandas' NaN analog in code space);
+    a label list maps codes onto it. Interval-object labels (pandas'
+    default) are not materialized — pass labels explicitly."""
+    v = np.asarray(x.values if isinstance(x, CycloneSeries) else x,
+                   dtype=np.float64)
+    if np.isscalar(bins):
+        # pandas: interior edges split [lo, hi] EXACTLY; only the OPEN
+        # boundary edge is nudged outward afterwards so the extreme
+        # value lands in its bin (edges[0] for right-closed bins,
+        # edges[-1] for left-closed)
+        lo, hi = np.nanmin(v), np.nanmax(v)
+        span = (hi - lo) or 1.0
+        edges = np.linspace(lo, hi, int(bins) + 1)
+        if right:
+            edges[0] = lo - 0.001 * span
+        else:
+            edges[-1] = hi + 0.001 * span
+    else:
+        edges = np.asarray(bins, dtype=np.float64)
+    codes = np.searchsorted(edges, v, side="left" if right else "right") - 1
+    if right:
+        # right-closed: x == left edge of bin 0 belongs to NO bin unless
+        # the edge itself equals x (pandas half-open (a, b] intervals)
+        codes = np.where(v == edges[0], -1, codes)
+    codes = np.where(np.isnan(v) | (codes < 0) | (codes >= len(edges) - 1),
+                     -1, codes).astype(np.int64)
+    if labels is False or labels is None:
+        return CycloneSeries(codes, getattr(x, "name", ""))
+    lab = np.asarray(labels, dtype=object)
+    if len(lab) != len(edges) - 1:
+        raise ValueError(
+            "Bin labels must be one fewer than the number of bin edges")
+    out = np.where(codes >= 0, lab[np.clip(codes, 0, len(lab) - 1)], None)
+    return CycloneSeries(out, getattr(x, "name", ""))
+
+
+def qcut(x, q, labels=None, duplicates: str = "raise") -> CycloneSeries:
+    """Quantile binning (ref pd.qcut): equal-count bins by sample
+    quantiles; same label semantics as :func:`cut`. Duplicate quantile
+    edges (heavily tied data) RAISE like pandas unless
+    ``duplicates='drop'`` merges them."""
+    v = np.asarray(x.values if isinstance(x, CycloneSeries) else x,
+                   dtype=np.float64)
+    qs = np.linspace(0, 1, q + 1) if np.isscalar(q) else np.asarray(q)
+    edges = np.nanquantile(v, qs)
+    if len(np.unique(edges)) != len(edges):
+        if duplicates != "drop":
+            raise ValueError(
+                f"Bin edges must be unique: {edges!r}. You can drop "
+                f"duplicate edges by setting the 'duplicates' kwarg")
+        edges = np.unique(edges)
+    edges[0] = edges[0] - 1e-9 * (abs(edges[0]) + 1)
+    return cut(x, edges, labels=labels, right=True)
+
+
+def crosstab(index, columns, rownames=None, colnames=None) -> CycloneFrame:
+    """Frequency table of two label arrays (ref pd.crosstab): rows/cols
+    sorted, int64 counts. Column labels keep their original type (an
+    int-valued ``columns`` yields int column keys, as pandas does);
+    ``colnames`` is carried as ``_columns_name`` (display metadata — the
+    engine has no columns-index object to attach it to)."""
+    iv = np.asarray(index.values if isinstance(index, CycloneSeries)
+                    else index, dtype=object)
+    cv = np.asarray(columns.values if isinstance(columns, CycloneSeries)
+                    else columns, dtype=object)
+    rows = sorted(set(iv))
+    cols = sorted(set(cv))
+    rpos = {r: i for i, r in enumerate(rows)}
+    cpos = {c: j for j, c in enumerate(cols)}
+    grid = np.zeros((len(rows), len(cols)), dtype=np.int64)
+    for a, b in zip(iv, cv):
+        grid[rpos[a], cpos[b]] += 1
+    out = CycloneFrame({c: grid[:, j] for j, c in enumerate(cols)})
+    out._index = _label_array(rows)
+    out._index_name = (rownames[0] if rownames else
+                       getattr(index, "name", "") or "row_0")
+    out._columns_name = (colnames[0] if colnames else
+                         getattr(columns, "name", "") or "col_0")
+    return out
