@@ -9,7 +9,7 @@ column pruning into scans. Fixed-point iteration like RuleExecutor
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from cycloneml_tpu.sql.column import (Alias, BinaryOp, ColumnRef, Expr,
                                       Literal, UnaryOp)
@@ -401,6 +401,191 @@ def optimize_subqueries(plan: LogicalPlan) -> Optional[LogicalPlan]:
     return None
 
 
+def _estimated_rows(p: LogicalPlan) -> Optional[int]:
+    """Row-count estimate for join reordering. The engine is eager —
+    Scan nodes HOLD their arrays — so base cardinalities are exact, the
+    thing Catalyst's CBO needs ANALYZE TABLE statistics for. Filters use
+    the same default selectivity Catalyst does without column stats
+    (ref: catalyst/plans/logical/statsEstimation — filter default)."""
+    if isinstance(p, Scan):
+        return len(next(iter(p.data.values()))) if p.data else 0
+    from cycloneml_tpu.sql.plan import Relation
+    if isinstance(p, Relation):
+        try:
+            return _estimated_rows(p._resolve())
+        except ValueError:
+            return None
+    if isinstance(p, (Project, Sort, Distinct)):
+        return _estimated_rows(p.children[0])
+    if isinstance(p, Limit):
+        est = _estimated_rows(p.children[0])
+        return None if est is None else min(est, p.n)
+    if isinstance(p, Filter):
+        est = _estimated_rows(p.children[0])
+        return None if est is None else max(1, est // 2)
+    return None
+
+
+def reorder_joins(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Greedy cost-based reorder of an inner-join chain (ref: ReorderJoin,
+    catalyst/optimizer/joins.scala:40, and CostBasedJoinReorder.scala:36 —
+    the greedy min-cardinality analog of JoinReorderDP:143, affordable
+    because base cardinalities are exact here, see _estimated_rows).
+
+    Flattens consecutive inner equi-joins into (relations, edges), then
+    builds a left-deep tree: start from the smallest relation, repeatedly
+    attach the smallest relation CONNECTED to the joined set (never a
+    cross product). The engine drops the right-side key column of each
+    join, so later edges are rewired to the surviving equivalent column
+    and a Project restores the original output names at the top."""
+    if not (isinstance(plan, Join) and plan.how == "inner"):
+        return None
+
+    rels: List[LogicalPlan] = []
+    # (left_col, left_rel_idx, right_col, right_rel_idx) — ownership is
+    # resolved PER SUBTREE during flattening, never by bare column name:
+    # a pair like ('k', 'k') is legal (the right key is dropped from the
+    # join output), so a global name→relation map would be ambiguous
+    edges: List[Tuple[str, int, str, int]] = []
+
+    def flatten(p: LogicalPlan) -> Optional[List[int]]:
+        if isinstance(p, Join) and p.how == "inner":
+            li = flatten(p.children[0])
+            ri = flatten(p.children[1])
+            if li is None or ri is None:
+                return None
+            for a, b in p.on:
+                la = [i for i in li if a in rels[i].output()]
+                rb = [i for i in ri if b in rels[i].output()]
+                if len(la) != 1 or len(rb) != 1:
+                    # endpoint name absent (derived column) or present in
+                    # several base relations of its side — bail
+                    return None
+                edges.append((a, la[0], b, rb[0]))
+            return li + ri
+        rels.append(p)
+        return [len(rels) - 1]
+
+    if flatten(plan) is None or len(rels) < 3:
+        return None
+    ests = [_estimated_rows(r) for r in rels]
+    if any(e is None for e in ests):
+        return None
+
+    # union-find over QUALIFIED (rel_idx, name) columns: inner equi-join
+    # edges make their endpoints value-equal, and the restore projection
+    # below may substitute any class member for any other. Bare names
+    # are NOT identity — two dimension tables may both call their key
+    # 'k' without those columns being related.
+    parent: dict = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, ia, b, ib in edges:
+        ra, rb = find((ia, a)), find((ib, b))
+        if ra != rb:
+            parent[ra] = rb
+
+    joined = {min(range(len(rels)), key=lambda i: (ests[i], i))}
+    order = [next(iter(joined))]
+    plan_edges: List[List[Tuple[str, str]]] = [[]]
+    remaining = set(range(len(rels))) - joined
+    surviving: dict = {}  # qualified dropped key -> qualified survivor
+    dropped = set()
+    while remaining:
+        connected = [i for i in remaining
+                     if any(ia == i and ib in joined
+                            or ib == i and ia in joined
+                            for _, ia, _, ib in edges)]
+        if not connected:
+            return None  # disconnected → would need a cross product
+        nxt = min(connected, key=lambda i: (ests[i], i))
+        pairs = []
+        for a, ia, b, ib in edges:
+            if ia == nxt and ib in joined:
+                a, ia, b, ib = b, ib, a, ia
+            elif not (ib == nxt and ia in joined):
+                continue
+            cur = (ia, a)
+            while cur in surviving:
+                cur = surviving[cur]
+            pairs.append((cur[1], b))
+            surviving[(ib, b)] = cur
+            dropped.add((ib, b))
+        order.append(nxt)
+        plan_edges.append(pairs)
+        joined.add(nxt)
+        remaining.discard(nxt)
+
+    # the new tree's output: R0's columns plus each later relation's
+    # non-dropped columns. Bail if bare names collide — the original
+    # tree resolved the collision via its own key drops; ours cannot.
+    surv_q = [(order[0], c) for c in rels[order[0]].output()]
+    for idx in order[1:]:
+        surv_q += [(idx, c) for c in rels[idx].output()
+                   if (idx, c) not in dropped]
+    bare = [c for _, c in surv_q]
+    if len(set(bare)) != len(bare):
+        return None
+
+    new = rels[order[0]]
+    for idx, pairs in zip(order[1:], plan_edges[1:]):
+        new = Join(new, rels[idx], pairs, "inner")
+    if new.tree_string() == plan.tree_string():
+        return None
+
+    # restore the original output schema: each original column name maps
+    # to a SURVIVING member of its value-equivalence class
+    members: dict = {}
+    for i, r in enumerate(rels):
+        for c in r.output():
+            members.setdefault(find((i, c)), []).append((i, c))
+    surv_set = set(surv_q)
+    exprs = []
+    for nm in plan.output():
+        insts = [(i, nm) for i, r in enumerate(rels) if nm in r.output()]
+        roots = {find(q) for q in insts}
+        if len(roots) != 1:
+            return None  # same name, unrelated columns — ambiguous
+        cand = [q for q in members[roots.pop()] if q in surv_set]
+        if not cand:
+            return None
+        cand.sort(key=lambda q: q[1] != nm)  # prefer the same-name member
+        src = cand[0][1]
+        exprs.append(Alias(ColumnRef(src), nm)
+                     if src != nm else ColumnRef(nm))
+    plain = all(isinstance(e, ColumnRef) for e in exprs)
+    if not (plain and [e.name for e in exprs] == bare):
+        new = Project(new, exprs)
+    return new
+
+
+def _reorder_pass(plan: LogicalPlan) -> LogicalPlan:
+    """Top-down join-reorder application: the WIDEST inner-join chain is
+    flattened and reordered as a whole (a bottom-up transform would lock
+    each 3-relation subchain before the full chain was ever seen), then
+    the pass descends only into the chain's base relations."""
+    if isinstance(plan, Join) and plan.how == "inner":
+        new = reorder_joins(plan) or plan
+
+        def into_bases(p: LogicalPlan) -> LogicalPlan:
+            if isinstance(p, Join) and p.how == "inner":
+                return p.with_children([into_bases(c) for c in p.children])
+            return _reorder_pass(p)
+
+        if isinstance(new, Project):
+            return Project(into_bases(new.children[0]), new.exprs)
+        return into_bases(new)
+    if not plan.children:
+        return plan
+    return plan.with_children([_reorder_pass(c) for c in plan.children])
+
+
 _REWRITE_RULES = [fold_constants, boolean_simplification, combine_filters,
                   prune_filters, push_filter_through_project,
                   push_filter_through_join, push_filters_into_filescan,
@@ -409,7 +594,9 @@ _REWRITE_RULES = [fold_constants, boolean_simplification, combine_filters,
 
 
 def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:
-    """Fixed-point rewrite batches, a subquery-plan pass, then pruning."""
+    """Fixed-point rewrite batches, a join-reorder pass (after filter
+    pushdown so estimates see the filtered relations), a subquery-plan
+    pass, then pruning."""
     for _ in range(max_iterations):
         changed = False
         for rule in _REWRITE_RULES:
@@ -418,5 +605,14 @@ def optimize(plan: LogicalPlan, max_iterations: int = 10) -> LogicalPlan:
                 plan, changed = new, True
         if not changed:
             break
+    plan = _reorder_pass(plan)
+    # collapse the reorderer's restore projections into user projections
+    # NOW — otherwise the first re-optimize of this plan would do it and
+    # the optimizer would not be idempotent
+    for _ in range(3):
+        new = plan.transform_up(collapse_projects)
+        if new.tree_string() == plan.tree_string():
+            break
+        plan = new
     plan = plan.transform_up(optimize_subqueries)
     return prune_columns(plan)

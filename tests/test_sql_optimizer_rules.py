@@ -162,3 +162,212 @@ def test_exists_subquery_plan_not_mutated(session):
     df = DataFrame(Filter(t.plan, ExistsSubquery(sub_plan)), session)
     df.to_dict()
     assert sub_plan.tree_string() == before
+
+
+# -- join reordering (ref ReorderJoin joins.scala:40 / CostBasedJoinReorder)
+
+
+def _join_chain_sizes(plan):
+    """Left-deep inner-join chain → relation row counts, build order."""
+    from cycloneml_tpu.sql.optimizer import _estimated_rows
+    from cycloneml_tpu.sql.plan import Join as J
+    sizes = []
+
+    def walk(p):
+        if isinstance(p, J) and p.how == "inner":
+            walk(p.children[0])
+            sizes.append(_estimated_rows(p.children[1]))
+        else:
+            sizes.append(_estimated_rows(p))
+    walk(plan)
+    return sizes
+
+
+def _find_top_join(plan):
+    from cycloneml_tpu.sql.plan import Join as J
+    found = []
+
+    def walk(p):
+        if isinstance(p, J) and not found:
+            found.append(p)
+            return
+        for c in p.children:
+            walk(c)
+    walk(plan)
+    return found[0]
+
+
+@pytest.fixture()
+def star(session):
+    """A star schema with deliberately bad user join order: fact (100
+    rows) joined FIRST, tiny dims later."""
+    s = session
+    rng = np.random.RandomState(0)
+    s.register_temp_view("fact", s.create_data_frame({
+        "fk1": rng.randint(0, 4, 100).astype(np.int64),
+        "fk2": rng.randint(0, 3, 100).astype(np.int64),
+        "x": rng.randn(100)}))
+    s.register_temp_view("dim1", s.create_data_frame({
+        "d1": np.arange(4, dtype=np.int64),
+        "n1": np.array(list("abcd"), dtype=object)}))
+    s.register_temp_view("dim2", s.create_data_frame({
+        "d2": np.arange(3, dtype=np.int64),
+        "n2": np.array(list("pqr"), dtype=object)}))
+    return s
+
+
+def test_reorder_joins_smallest_first(star):
+    df = star.sql(
+        "SELECT n1, n2, x FROM fact "
+        "JOIN dim1 ON fact.fk1 = dim1.d1 "
+        "JOIN dim2 ON fact.fk2 = dim2.d2")
+    sizes = _join_chain_sizes(_find_top_join(df.optimized_plan()))
+    # greedy starts from the smallest relation (dim2, 3 rows) and the
+    # fact table joins as soon as connectivity requires it
+    assert sizes[0] == 3
+    # results identical to the unoptimized order
+    got = df.to_dict()
+    import cycloneml_tpu.sql.optimizer as O
+    orig = O.reorder_joins
+    O.reorder_joins = lambda p: None
+    try:
+        want = star.sql(
+            "SELECT n1, n2, x FROM fact "
+            "JOIN dim1 ON fact.fk1 = dim1.d1 "
+            "JOIN dim2 ON fact.fk2 = dim2.d2").to_dict()
+    finally:
+        O.reorder_joins = orig
+    assert list(got) == list(want)
+    for c in got:
+        l = sorted(map(str, got[c]))
+        r = sorted(map(str, want[c]))
+        assert l == r, c
+
+
+def test_reorder_preserves_output_names_and_rows(star):
+    """The engine drops the right-side key column of each join, so
+    reordering changes WHICH name survives — the rule must restore the
+    original output schema via a projection."""
+    df = star.sql(
+        "SELECT * FROM fact "
+        "JOIN dim1 ON fact.fk1 = dim1.d1 "
+        "JOIN dim2 ON fact.fk2 = dim2.d2")
+    out = df.to_dict()
+    assert set(out) == {"fk1", "fk2", "x", "n1", "n2"}
+    assert len(out["x"]) == 100
+    # fk1 must hold the JOIN KEY values even though the reordered tree
+    # surfaced dim1.d1 instead
+    np.testing.assert_array_equal(np.sort(np.unique(out["fk1"])),
+                                  np.arange(4))
+
+
+def test_reorder_declines_two_relations_and_outer(star):
+    from cycloneml_tpu.sql.optimizer import reorder_joins
+    df2 = star.sql("SELECT x, n1 FROM fact JOIN dim1 ON fact.fk1 = dim1.d1")
+    assert reorder_joins(_find_top_join(df2.plan)) is None
+    dfo = star.sql(
+        "SELECT x, n1, n2 FROM fact "
+        "LEFT JOIN dim1 ON fact.fk1 = dim1.d1 "
+        "LEFT JOIN dim2 ON fact.fk2 = dim2.d2")
+    # outer joins are not reorderable; execution still correct
+    assert len(dfo.to_dict()["x"]) == 100
+
+
+def test_reorder_fixed_point(star):
+    """Optimizing an already-optimized plan must not keep rewriting
+    (projection wrappers piling up would show as tree churn)."""
+    from cycloneml_tpu.sql.optimizer import optimize
+    df = star.sql(
+        "SELECT n1, n2, x FROM fact "
+        "JOIN dim1 ON fact.fk1 = dim1.d1 "
+        "JOIN dim2 ON fact.fk2 = dim2.d2")
+    p1 = df.optimized_plan()
+    p2 = optimize(p1)
+    assert p2.tree_string() == p1.tree_string()
+
+
+def test_reorder_same_name_key_pairs(session):
+    """A ('k', 'k') join pair is legal (the right key column is dropped);
+    edge ownership must resolve per subtree, not by bare name — and the
+    equi-condition must never be silently dropped."""
+    s = session
+    s.register_temp_view("big", s.create_data_frame({
+        "k": np.arange(50, dtype=np.int64) % 5,
+        "x": np.arange(50, dtype=np.int64)}))
+    s.register_temp_view("mid", s.create_data_frame({
+        "k": np.arange(5, dtype=np.int64),
+        "m": np.arange(5, dtype=np.int64) * 10}))
+    s.register_temp_view("tiny", s.create_data_frame({
+        "m2": np.array([0, 10], dtype=np.int64)}))
+    df = s.sql("SELECT x, m FROM big "
+               "JOIN mid ON big.k = mid.k "
+               "JOIN tiny ON mid.m = tiny.m2")
+    out = df.to_dict()
+    # 2 surviving m values × 10 fact rows each
+    assert len(out["x"]) == 20
+    assert set(out["m"].tolist()) == {0, 10}
+
+
+def test_reorder_shared_key_names_correct_values(session):
+    """Review r5: two dimension tables both calling their key 'k' must
+    not cross-wire the restore projection (value-equivalence classes are
+    tracked per qualified column, not by bare name)."""
+    s = session
+    rng = np.random.RandomState(1)
+    s.register_temp_view("f2", s.create_data_frame({
+        "p": rng.randint(0, 4, 40).astype(np.int64),
+        "q": rng.randint(0, 2, 40).astype(np.int64),
+        "val": rng.randn(40)}))
+    s.register_temp_view("dd1", s.create_data_frame({
+        "k": np.arange(4, dtype=np.int64),
+        "n1": np.array(list("abcd"), dtype=object)}))
+    s.register_temp_view("dd2", s.create_data_frame({
+        "k": np.arange(2, dtype=np.int64),
+        "n2": np.array(list("pq"), dtype=object)}))
+    q = ("SELECT p, q, n1, n2 FROM f2 "
+         "JOIN dd1 ON f2.p = dd1.k "
+         "JOIN dd2 ON f2.q = dd2.k")
+    got = s.sql(q).to_dict()
+    import cycloneml_tpu.sql.optimizer as O
+    orig = O.reorder_joins
+    O.reorder_joins = lambda p: None
+    try:
+        want = s.sql(q).to_dict()
+    finally:
+        O.reorder_joins = orig
+    # join order changes ROW order (hash joins don't preserve it, as in
+    # the reference) — compare the row SETS
+    def rows(d):
+        return sorted(zip(*(d[c] for c in ("p", "q", "n1", "n2"))))
+    assert rows(got) == rows(want)
+    # q values must be 0/1 (dd2's domain), never p's 0..3
+    assert set(got["q"].tolist()) <= {0, 1}
+
+
+def test_reorder_considers_whole_chain(session):
+    """4-relation chain: the dedicated top-down pass flattens the WHOLE
+    chain, so the globally smallest relation leads — a bottom-up rule
+    would lock the inner 3-relation subchain first."""
+    s = session
+    rng = np.random.RandomState(2)
+    s.register_temp_view("f4", s.create_data_frame({
+        "a": rng.randint(0, 6, 60).astype(np.int64),
+        "b": rng.randint(0, 5, 60).astype(np.int64),
+        "c": rng.randint(0, 2, 60).astype(np.int64)}))
+    s.register_temp_view("da", s.create_data_frame({
+        "ka": np.arange(6, dtype=np.int64),
+        "na": np.arange(6, dtype=np.int64) * 2}))
+    s.register_temp_view("db", s.create_data_frame({
+        "kb": np.arange(5, dtype=np.int64),
+        "nb": np.arange(5, dtype=np.int64) * 3}))
+    s.register_temp_view("dc", s.create_data_frame({
+        "kc": np.arange(2, dtype=np.int64),
+        "nc": np.arange(2, dtype=np.int64) * 5}))
+    df = s.sql("SELECT na, nb, nc FROM f4 "
+               "JOIN da ON f4.a = da.ka "
+               "JOIN db ON f4.b = db.kb "
+               "JOIN dc ON f4.c = dc.kc")
+    sizes = _join_chain_sizes(_find_top_join(df.optimized_plan()))
+    assert sizes[0] == 2  # dc (2 rows) leads the whole chain
+    out = df.to_dict()
+    assert len(out["na"]) == 60
