@@ -27,8 +27,8 @@ from cycloneml_tpu.conf import (
     METRICS_PERIOD_S, METRICS_SINKS, PROMETHEUS_PORT,
 )
 from cycloneml_tpu.util.events import (
-    ApplicationEnd, ApplicationStart, CycloneEvent, EventJournal, JobEnd,
-    JobStart, ListenerBus, MeshUp, StepCompleted,
+    ApplicationEnd, ApplicationStart, BlocksMigrated, CycloneEvent,
+    EventJournal, JobEnd, JobStart, ListenerBus, MeshUp, StepCompleted,
 )
 from cycloneml_tpu.util.metrics import ConsoleSink, CsvSink, MetricsSystem
 from cycloneml_tpu.util.status import AppStatusListener
@@ -388,13 +388,55 @@ class CycloneContext:
                 f"profile {profile}")
         return self
 
+    def decommission(self, master: Optional[str] = None, **mesh_kwargs):
+        """Planned scale-down with cached-block MIGRATION (ref:
+        storage/BlockManagerDecommissioner.scala:40 — a draining executor
+        pushes its cached RDD blocks to surviving peers before exiting).
+
+        On a device mesh the draining unit is the device set, so while the
+        OLD mesh is still alive every device-tier managed dataset is
+        pulled to the host tier (the migration hop; on multihost JAX the
+        re-place below is a resharding device transfer), the mesh is
+        rebuilt onto the surviving devices, and the datasets are re-placed
+        there eagerly — bit-identical data, no recompute from source, no
+        checkpoint read. UNPLANNED loss still takes :meth:`rebuild_mesh`'s
+        checkpoint-based contract: after a crash there is no live mesh to
+        migrate from, which is exactly the reference's split between
+        decommissioning and failure recovery."""
+        if not self.try_begin_mesh_rebuild():
+            raise RuntimeError(
+                "cannot decommission while jobs are active; retry when "
+                "run_job brackets have drained")
+        try:
+            # raises BEFORE any teardown if a dataset cannot leave the
+            # device tier — the old mesh stays intact on failure
+            migrated, moved_bytes = self.storage.migrate_device_to_host()
+            rt = self._rebuild_mesh_locked(master, **mesh_kwargs)
+            for ds in migrated:
+                ds.x  # eager re-place on the surviving devices
+            self.listener_bus.post(BlocksMigrated(
+                n_datasets=len(migrated), bytes=moved_bytes,
+                n_devices=rt.n_devices))
+            logger.info("decommission: migrated %d cached datasets "
+                        "(%d bytes) onto %d devices",
+                        len(migrated), moved_bytes, rt.n_devices)
+            return rt
+        finally:
+            self.end_mesh_rebuild()
+
     def rebuild_mesh(self, master: Optional[str] = None, **mesh_kwargs):
         """Elastic recovery (SURVEY §5.3): tear down the mesh and bring up a
         new one — possibly smaller, possibly a spare slice — after device or
         host loss. Device-resident data dies with the old mesh; callers
         restore datasets from host copies or checkpoints and resume from the
         last optimizer-state checkpoint (lineage recomputation does not
-        translate to TPU; checkpoint-based recovery does)."""
+        translate to TPU; checkpoint-based recovery does). For a PLANNED
+        scale-down prefer :meth:`decommission`, which migrates cached
+        blocks instead."""
+        return self._rebuild_mesh_locked(master, **mesh_kwargs)
+
+    def _rebuild_mesh_locked(self, master: Optional[str] = None,
+                             **mesh_kwargs):
         mesh_mod.reset()
         self.mesh_runtime = mesh_mod.get_or_create(
             master or self.conf.get(MASTER), **mesh_kwargs)

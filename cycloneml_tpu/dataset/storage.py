@@ -130,6 +130,35 @@ class StorageManager:
                 e["level"] = StorageLevel.DEVICE
             self._enforce()
 
+    def migrate_device_to_host(self):
+        """Pull every live DEVICE-tier dataset to the host tier under the
+        manager's lock (the decommission hop — ref
+        BlockManagerDecommissioner.scala:40 pushing a draining executor's
+        cached blocks out). Raises on the first failure WITHOUT touching
+        the rest: the caller must not tear the mesh down when a dataset
+        could not leave it — a DEVICE-only dataset has no other copy and
+        no lineage, so losing its devices loses the data."""
+        migrated = []
+        moved_bytes = 0
+        with self._lock:
+            for e in self._entries.values():
+                ds = e["ds"]()
+                if ds is None or e["level"] != StorageLevel.DEVICE \
+                        or not hasattr(ds, "persist_host"):
+                    continue
+                try:
+                    ds.persist_host()
+                except Exception as exc:
+                    raise RuntimeError(
+                        f"decommission aborted: dataset {id(ds):#x} could "
+                        f"not be migrated off the device tier ({exc!r}); "
+                        "the mesh is untouched — free host memory or "
+                        "checkpoint the dataset and retry") from exc
+                e["level"] = StorageLevel.HOST
+                migrated.append(ds)
+                moved_bytes += e["bytes"]
+        return migrated, moved_bytes
+
     def unpersist(self, ds) -> None:
         """Stop managing ``ds``. Data is NEVER dropped: a DISK-tier dataset
         is pulled back to the host tier before its spill file is removed."""

@@ -46,6 +46,17 @@ class MeshUp(CycloneEvent):
 
 
 @dataclass
+class BlocksMigrated(CycloneEvent):
+    """Planned decommission moved cached dataset blocks off the draining
+    devices before the mesh shrank (≈ the decommission listener events
+    around BlockManagerDecommissioner)."""
+
+    n_datasets: int = 0
+    bytes: int = 0
+    n_devices: int = 0
+
+
+@dataclass
 class JobStart(CycloneEvent):
     job_id: int = 0
     description: str = ""

@@ -94,3 +94,92 @@ def test_storage_usage_in_web_ui(ctx):
         assert tiers["DEVICE"] >= ds.padded_bytes()
     finally:
         ctx.storage.unpersist(ds)
+
+
+def test_decommission_migrates_cached_blocks(ctx):
+    """Planned scale-down MIGRATES cached device-tier datasets instead of
+    recomputing them (ref BlockManagerDecommissioner.scala:40 — draining
+    executors push their cached blocks to survivors): after
+    ctx.decommission() onto a 4-device mesh, the managed dataset's data
+    is bit-identical, its arrays are sharded over the SURVIVING devices,
+    no checkpoint was read, and a BlocksMigrated event is posted."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.util.events import BlocksMigrated
+
+    rng = np.random.RandomState(11)
+    x = rng.randn(640, 16)
+    y = (x[:, 0] - 0.2 * x[:, 1] > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y).persist()
+    before = np.asarray(ds.x).copy()
+    events = []
+    ctx.listener_bus.add_listener(events.append)
+    try:
+        rt = ctx.decommission(master="local-mesh[4]")
+        assert rt.n_devices == 4
+        assert ctx.mesh_runtime.n_devices == 4
+        arr = ds.x
+        # re-placed over the surviving device set, eagerly
+        assert len(arr.sharding.device_set) == 4
+        assert ctx.storage.level_of(ds) == StorageLevel.DEVICE
+        # bit-identical data: migrated, not recomputed/restored
+        np.testing.assert_array_equal(np.asarray(arr), before)
+        ctx.listener_bus.wait_until_empty()
+        mig = [e for e in events if isinstance(e, BlocksMigrated)]
+        assert mig and mig[0].n_datasets >= 1 and mig[0].n_devices == 4
+        assert mig[0].bytes > 0
+        # the migrated dataset trains on the shrunken mesh
+        m = LogisticRegression(maxIter=10, regParam=0.01).fit(ds)
+        assert m.summary.total_iterations > 0
+    finally:
+        ctx.listener_bus.remove_listener(events.append) \
+            if events.append in ctx.listener_bus._listeners else None
+        ctx.rebuild_mesh(master="local-mesh[8]")
+
+
+def test_decommission_blocked_while_job_active(ctx):
+    """The decommission takes the job/rebuild gate: it must refuse while
+    a run_job bracket is open rather than tearing the mesh down under a
+    compiled step."""
+    import threading
+    entered = threading.Event()
+    release = threading.Event()
+
+    def job():
+        def body():
+            entered.set()
+            release.wait(5)
+            return 0
+        ctx.run_job("gate-test", body)
+
+    t = threading.Thread(target=job)
+    t.start()
+    try:
+        assert entered.wait(5)
+        with pytest.raises(RuntimeError, match="decommission"):
+            ctx.decommission(master="local-mesh[8]")
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_decommission_aborts_before_teardown_on_migration_failure(ctx):
+    """Review fix: a dataset that cannot leave the device tier ABORTS
+    the decommission with the old mesh intact — a DEVICE-only dataset
+    has no other copy, so tearing down its devices would lose data."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    rng = np.random.RandomState(3)
+    ds = InstanceDataset.from_numpy(ctx, rng.randn(64, 4),
+                                    (rng.rand(64) > 0.5).astype(float)
+                                    ).persist()
+    orig = ds.persist_host
+    ds.persist_host = lambda: (_ for _ in ()).throw(MemoryError("boom"))
+    n_before = ctx.mesh_runtime.n_devices
+    try:
+        with pytest.raises(RuntimeError, match="decommission aborted"):
+            ctx.decommission(master="local-mesh[4]")
+        assert ctx.mesh_runtime.n_devices == n_before  # mesh untouched
+        assert ctx.storage.level_of(ds) == StorageLevel.DEVICE
+    finally:
+        ds.persist_host = orig
+        ctx.storage.unpersist(ds)
