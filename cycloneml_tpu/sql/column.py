@@ -288,6 +288,19 @@ class Func(Expr):
                 "^" + re.escape(str(pat)).replace("%", ".*").replace("_", ".") + "$")
             return np.array([bool(rx.match(str(x))) if x is not None else False
                              for x in np.atleast_1d(v)])
+        if self.name in ("startswith", "endswith", "contains_str",
+                         "str_eq"):
+            # LikeSimplification targets: anchored LIKEs rewritten to
+            # plain string ops — no per-row regex machinery (str_eq is
+            # the wildcard-free case; like the regex path it compares
+            # the STRINGIFIED value and is False for NULL)
+            v = np.atleast_1d(self.children[0].eval(batch))
+            p = str(self.children[1].eval(batch))
+            op = {"startswith": str.startswith, "endswith": str.endswith,
+                  "contains_str": str.__contains__,
+                  "str_eq": str.__eq__}[self.name]
+            return np.array([False if x is None else op(str(x), p)
+                             for x in v])
         return self._fns[self.name](
             np.atleast_1d(np.asarray(self.children[0].eval(batch))))
 

@@ -326,6 +326,177 @@ def dedupe_distinct_sort(plan: LogicalPlan) -> Optional[LogicalPlan]:
     return None
 
 
+def _null_rejected_sides(cond: Expr, lcols: set, rcols: set):
+    """Sides of a join whose NULL-extended rows this predicate filters
+    out. Under the engine's two-valued numpy semantics a comparison on a
+    NaN/None value is False, so any comparison conjunct rejects the
+    nulls of every column it references; ``!=`` does NOT (NaN != x is
+    True in numpy) and NOT-wrapped conditions do not (NOT keeps NaN
+    rows) — except NOT(isnull(c)), which is IS NOT NULL."""
+    from cycloneml_tpu.sql.column import Func
+    rejected = set()
+    for c in split_conjuncts(cond):
+        refs = None
+        if isinstance(c, BinaryOp) and c.op in ("==", "=", "<", "<=",
+                                                ">", ">="):
+            refs = c.references()
+        elif isinstance(c, Func) and c.name == "isnotnull":
+            refs = c.references()
+        elif isinstance(c, UnaryOp) and c.op == "not" \
+                and isinstance(c.children[0], Func) \
+                and c.children[0].name == "isnull":
+            refs = c.references()
+        if not refs:
+            continue
+        if refs & lcols:
+            rejected.add("left")
+        if refs & rcols:
+            rejected.add("right")
+    return rejected
+
+
+def eliminate_outer_join(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Downgrade an outer join whose parent Filter rejects the NULLs the
+    outer side would produce (ref EliminateOuterJoin,
+    catalyst/optimizer/joins.scala): a null-rejecting predicate over the
+    right side turns LEFT→INNER (the null-extended rows were doomed),
+    over the left side RIGHT→INNER, and FULL OUTER sheds whichever
+    side(s) are rejected."""
+    if not (isinstance(plan, Filter) and isinstance(plan.children[0], Join)):
+        return None
+    join = plan.children[0]
+    if join.how not in ("left", "right", "outer"):
+        return None
+    left, right = join.children
+    # join-KEY columns are excluded from the rejection sets: the joined
+    # output carries ONE column per key pair whose provenance/null
+    # pattern differs from either child's raw column (a left join's key
+    # is never null-extended even though the name is in both children's
+    # output), so a filter on the key says nothing about the outer
+    # side's null-extended rows
+    keys = {l for l, _ in join.on} | {r for _, r in join.on}
+    rej = _null_rejected_sides(plan.cond, set(left.output()) - keys,
+                               set(right.output()) - keys)
+    new_how = join.how
+    if join.how == "left" and "right" in rej:
+        new_how = "inner"
+    elif join.how == "right" and "left" in rej:
+        new_how = "inner"
+    elif join.how == "outer":
+        # rejecting a side's NULLs kills the rows where THAT side was
+        # null-extended — i.e. the OTHER side's unmatched rows go too:
+        # reject(right) leaves matched + right-unmatched = RIGHT outer
+        if rej == {"left", "right"}:
+            new_how = "inner"
+        elif "right" in rej:
+            new_how = "right"
+        elif "left" in rej:
+            new_how = "left"
+    if new_how == join.how:
+        return None
+    return Filter(Join(left, right, join.on, new_how), plan.cond)
+
+
+def constant_propagation(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """``a = 5 AND f(a)`` → ``a = 5 AND f(5)`` (ref ConstantPropagation):
+    equality-with-literal conjuncts substitute into their siblings,
+    enabling further folding/pushdown."""
+    if not isinstance(plan, Filter):
+        return None
+    conjuncts = split_conjuncts(plan.cond)
+    consts = {}
+    for c in conjuncts:
+        if isinstance(c, BinaryOp) and c.op in ("==", "=") \
+                and len(c.children) == 2:
+            a, b = c.children
+            if isinstance(a, ColumnRef) and isinstance(b, Literal):
+                consts.setdefault(a.name, b)
+            elif isinstance(b, ColumnRef) and isinstance(a, Literal):
+                consts.setdefault(b.name, a)
+    if not consts:
+        return None
+    changed = False
+    out = []
+    for c in conjuncts:
+        # never rewrite the defining equality itself
+        if isinstance(c, BinaryOp) and c.op in ("==", "=") and any(
+                isinstance(x, ColumnRef) and x.name in consts
+                and isinstance(y, Literal)
+                for x, y in (c.children, c.children[::-1])):
+            out.append(c)
+            continue
+        new = c.transform(lambda node: consts.get(node.name)
+                          if isinstance(node, ColumnRef) else None)
+        if str(new) != str(c):
+            changed = True
+        out.append(new)
+    if not changed:
+        return None
+    return Filter(plan.children[0], join_conjuncts(out))
+
+
+def simplify_casts(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """CAST(CAST(x AS t) AS t) → CAST(x AS t) (ref SimplifyCasts — the
+    engine's casts are idempotent per target type)."""
+    from cycloneml_tpu.sql.column import Cast
+
+    def fix(e: Expr) -> Expr:
+        kids = [fix(c) for c in e.children]
+        e = e.with_children(kids) if kids else e
+        if isinstance(e, Cast) and isinstance(e.children[0], Cast) \
+                and e.children[0].to == e.to:
+            return e.children[0]
+        return e
+
+    if isinstance(plan, Filter):
+        new = fix(plan.cond)
+        if str(new) != str(plan.cond):
+            return Filter(plan.children[0], new)
+    elif isinstance(plan, Project):
+        new_exprs = [fix(e) for e in plan.exprs]
+        if any(str(a) != str(b) for a, b in zip(new_exprs, plan.exprs)):
+            return Project(plan.children[0], new_exprs)
+    return None
+
+
+def like_simplification(plan: LogicalPlan) -> Optional[LogicalPlan]:
+    """Anchored LIKE patterns lose the regex (ref LikeSimplification):
+    'abc%' → startswith, '%abc' → endswith, '%abc%' → contains, and a
+    wildcard-free pattern → equality-shaped exact match."""
+    from cycloneml_tpu.sql.column import Func
+
+    def fix(e: Expr) -> Expr:
+        kids = [fix(c) for c in e.children]
+        e = e.with_children(kids) if kids else e
+        if isinstance(e, Func) and e.name == "like" \
+                and isinstance(e.children[1], Literal):
+            pat = str(e.children[1].value)
+            if "_" in pat:
+                return e  # single-char wildcard needs the regex
+            body = pat.strip("%")
+            if "%" in body:
+                return e  # interior wildcard needs the regex
+            child = e.children[0]
+            if pat.endswith("%") and pat.startswith("%") and len(pat) > 1:
+                return Func("contains_str", child, Literal(body))
+            if pat.endswith("%"):
+                return Func("startswith", child, Literal(body))
+            if pat.startswith("%"):
+                return Func("endswith", child, Literal(body))
+            return Func("str_eq", child, Literal(body))
+        return e
+
+    if isinstance(plan, Filter):
+        new = fix(plan.cond)
+        if str(new) != str(plan.cond):
+            return Filter(plan.children[0], new)
+    elif isinstance(plan, Project):
+        new_exprs = [fix(e) for e in plan.exprs]
+        if any(str(a) != str(b) for a, b in zip(new_exprs, plan.exprs)):
+            return Project(plan.children[0], new_exprs)
+    return None
+
+
 def rewrite_in_subquery_as_semi_join(plan: LogicalPlan
                                      ) -> Optional[LogicalPlan]:
     """Filter(c IN (SELECT ...)) → left_semi Join (ref
@@ -587,7 +758,9 @@ def _reorder_pass(plan: LogicalPlan) -> LogicalPlan:
 
 
 _REWRITE_RULES = [fold_constants, boolean_simplification, combine_filters,
-                  prune_filters, push_filter_through_project,
+                  prune_filters, constant_propagation, simplify_casts,
+                  like_simplification, eliminate_outer_join,
+                  push_filter_through_project,
                   push_filter_through_join, push_filters_into_filescan,
                   collapse_projects, combine_limits, push_limit_through,
                   dedupe_distinct_sort, rewrite_in_subquery_as_semi_join]

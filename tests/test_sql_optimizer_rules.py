@@ -371,3 +371,116 @@ def test_reorder_considers_whole_chain(session):
     assert sizes[0] == 2  # dc (2 rows) leads the whole chain
     out = df.to_dict()
     assert len(out["na"]) == 60
+
+
+# -- r5 second batch: EliminateOuterJoin / ConstantPropagation /
+#    SimplifyCasts / LikeSimplification
+
+
+def test_eliminate_outer_join_downgrades(session):
+    """A null-rejecting filter over the outer side downgrades the join
+    (ref EliminateOuterJoin, joins.scala): LEFT+reject(right) -> INNER,
+    FULL+reject(right) -> LEFT. NOT-wrapped comparisons do NOT downgrade
+    (two-valued NaN semantics keeps NaN rows under NOT)."""
+    s = session
+    s.register_temp_view("lo", s.create_data_frame({
+        "k": np.array([1, 2, 3], dtype=np.int64),
+        "a": np.array([10.0, 20.0, 30.0])}))
+    s.register_temp_view("ro", s.create_data_frame({
+        "k2": np.array([1, 2], dtype=np.int64),
+        "b": np.array([5.0, 50.0])}))
+
+    def top_join_how(df):
+        return _find_top_join(df.optimized_plan()).how
+
+    q = ("SELECT k, a, b FROM lo LEFT JOIN ro ON lo.k = ro.k2 "
+         "WHERE b > 4")
+    df = s.sql(q)
+    assert top_join_how(df) == "inner"
+    out = df.to_dict()
+    assert sorted(out["k"].tolist()) == [1, 2]  # k=3's NULL b rejected
+
+    # IS NOT NULL also rejects
+    df = s.sql("SELECT k, b FROM lo LEFT JOIN ro ON lo.k = ro.k2 "
+               "WHERE b IS NOT NULL")
+    assert top_join_how(df) == "inner"
+
+    # full outer: rejecting b (right side) kills the left-unmatched
+    # null-extended rows — what remains is a RIGHT outer join
+    df = s.sql("SELECT k, a, b FROM lo FULL OUTER JOIN ro "
+               "ON lo.k = ro.k2 WHERE b > 4")
+    assert top_join_how(df) == "right"
+    out = df.to_dict()
+    assert sorted(x for x in out["b"].tolist()) == [5.0, 50.0]
+
+    # NOT(b < 100) KEEPS NULL rows -> no downgrade
+    df = s.sql("SELECT k, b FROM lo LEFT JOIN ro ON lo.k = ro.k2 "
+               "WHERE NOT (b < 4)")
+    assert top_join_how(df) == "left"
+    out = df.to_dict()
+    assert len(out["k"]) == 3  # k=3 survives with NULL b
+
+
+def test_constant_propagation(session):
+    df = session.sql("SELECT k FROM t WHERE k = 5 AND v > k - 1")
+    # k substitutes into the sibling: v > 4 folds to a literal compare
+    plan_s = df.optimized_plan().tree_string()
+    assert "k - 1" not in plan_s.replace("k = 5", "")
+    assert df.to_dict()["k"].tolist() == [5]
+
+
+def test_simplify_casts_and_like(session):
+    from cycloneml_tpu.sql.column import Cast, col
+    from cycloneml_tpu.sql.dataframe import DataFrame
+    from cycloneml_tpu.sql.plan import Project
+    t = session.table("t")
+    e = Cast(Cast(col("v").expr, "bigint"), "bigint")
+    df = DataFrame(Project(t.plan, [e]), session)
+    s = df.optimized_plan().tree_string()
+    assert s.count("cast") <= s.count("CAST") + 1  # nested same-cast gone
+    vals = list(df.to_dict().values())[0]
+    assert vals.tolist() == [1, 2, 3, 4, 5, 6, 7, 8]
+
+    s2 = CycloneSession()
+    s2.register_temp_view("names", s2.create_data_frame({
+        "s": np.array(["apple", "grape", "applet", None, "pineapple"],
+                      dtype=object)}))
+    for pat, want in [("app%", ["apple", "applet"]),
+                      ("%ple", ["apple", "pineapple"]),
+                      ("%ppl%", ["apple", "applet", "pineapple"])]:
+        df = s2.sql(f"SELECT s FROM names WHERE s LIKE '{pat}'")
+        plan_s = df.optimized_plan().tree_string()
+        assert "like" not in plan_s, (pat, plan_s)  # regex rewritten away
+        assert sorted(df.to_dict()["s"].tolist()) == sorted(want), pat
+    # single-char wildcard keeps the regex path
+    df = s2.sql("SELECT s FROM names WHERE s LIKE 'appl_'")
+    assert "like" in df.optimized_plan().tree_string()
+    assert df.to_dict()["s"].tolist() == ["apple"]
+
+
+def test_outer_join_key_filter_does_not_downgrade(session):
+    """Review fix: a filter on the JOIN KEY must not downgrade a left
+    join — the joined output's key column is never null-extended, so
+    'k > 0' rejects nothing the outer join produced."""
+    s2 = CycloneSession()
+    s2.register_temp_view("lk", s2.create_data_frame({
+        "k": np.array([1, 2], dtype=np.int64),
+        "v": np.array([10.0, 20.0])}))
+    s2.register_temp_view("rk", s2.create_data_frame({
+        "k": np.array([1], dtype=np.int64),
+        "w": np.array([100.0])}))
+    df = s2.sql("SELECT k, v, w FROM lk LEFT JOIN rk ON lk.k = rk.k "
+                "WHERE k > 0")
+    assert _find_top_join(df.optimized_plan()).how == "left"
+    out = df.to_dict()
+    assert sorted(out["k"].tolist()) == [1, 2]  # k=2 row survives
+
+
+def test_like_wildcard_free_becomes_string_equality(session):
+    s2 = CycloneSession()
+    s2.register_temp_view("names2", s2.create_data_frame({
+        "s": np.array(["apple", "applet", None], dtype=object)}))
+    df = s2.sql("SELECT s FROM names2 WHERE s LIKE 'apple'")
+    plan_s = df.optimized_plan().tree_string()
+    assert "like" not in plan_s and "str_eq" in plan_s
+    assert df.to_dict()["s"].tolist() == ["apple"]
