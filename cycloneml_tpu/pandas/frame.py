@@ -169,6 +169,14 @@ class CycloneSeries:
         return iter(self.values)
 
     def __getitem__(self, i):
+        if isinstance(i, str) and self.index is not None:
+            # label lookup, as pandas: s['col'] on an iterrows row
+            pos = np.nonzero(self.index == i)[0]
+            if len(pos) == 0:
+                raise KeyError(i)
+            return self.values[pos[0]] if len(pos) == 1 \
+                else CycloneSeries(self.values[pos], self.name,
+                                  index=self.index[pos])
         return self.values[i]
 
     # -- reductions (skipna=True — the pandas default) -------------------------

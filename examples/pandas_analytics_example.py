@@ -1,0 +1,78 @@
+"""pandas-facade analytics round trip (≈ the pandas-on-Spark quickstart,
+ref: python/pyspark/pandas — frame.py/groupby.py/namespace.py).
+
+Builds a small sales table, walks the r5 long-tail surface — groupby
+transform/rank, merge-on-index, cut/get_dummies, duplicated, nlargest,
+pivot — then bridges to the SQL tier where a 3-table star query runs
+through the cost-based join reorderer, and brings the result back as a
+frame.
+"""
+
+import numpy as np
+
+import cycloneml_tpu.pandas as cp
+from cycloneml_tpu.pandas import CycloneFrame, cut, get_dummies
+from cycloneml_tpu.sql.session import CycloneSession
+
+
+def main():
+    rng = np.random.RandomState(7)
+    n = 400
+    sales = CycloneFrame({
+        "store": rng.randint(0, 4, n).astype(np.int64),
+        "sku": rng.randint(0, 3, n).astype(np.int64),
+        "units": rng.poisson(5, n).astype(np.int64),
+        "price": np.round(rng.uniform(1, 30, n), 2),
+    })
+    sales["revenue"] = sales["units"].to_numpy() * sales["price"].to_numpy()
+
+    # groupby row-shaped ops: share of the store's revenue, rank in store
+    g = sales.groupby("store")
+    share = sales["revenue"].to_numpy() / g.transform("sum")["revenue"].values
+    sales["rev_share"] = share
+    sales["rev_rank"] = g.rank()["revenue"].values
+
+    # binning + one-hot
+    sales["price_band"] = cut(sales["price"], [0, 10, 20, 30],
+                              labels=["lo", "mid", "hi"]).values
+    bands = get_dummies(sales["price_band"])
+    print("price bands:", {c: int(bands[c].sum()) for c in bands.columns})
+
+    # top sellers and dedup
+    top = sales.nlargest(3, "revenue")
+    print("top-3 revenue rows:", np.round(top["revenue"].values, 2))
+    dup_pairs = int(sales.duplicated(subset=["store", "sku"]).sum())
+    print(f"{dup_pairs} rows repeat a (store, sku) pair")
+
+    # merge-on-index: store dimension table
+    stores = CycloneFrame({
+        "store": np.arange(4, dtype=np.int64),
+        "city": np.array(["tokyo", "osaka", "kyoto", "nara"], dtype=object),
+    }).set_index("store")
+    by_store = g.sum().join(stores)  # index-on-index
+    print("revenue by city:",
+          {c: round(float(r), 1) for c, r in zip(by_store["city"].values,
+                                                 by_store["revenue"].values)})
+
+    # SQL bridge: the 3-table star rides the cost-based join reorderer
+    s = CycloneSession()
+    s.register_temp_view("sales", sales[["store", "sku", "revenue"]]
+                         .to_sql_df(s))
+    s.register_temp_view("stores", stores.reset_index().to_sql_df(s))
+    s.register_temp_view("skus", CycloneFrame({
+        "sku": np.arange(3, dtype=np.int64),
+        "name": np.array(["widget", "gadget", "gizmo"], dtype=object),
+    }).to_sql_df(s))
+    df = s.sql(
+        "SELECT city, name, SUM(revenue) AS rev FROM sales "
+        "JOIN stores ON sales.store = stores.store "
+        "JOIN skus ON sales.sku = skus.sku "
+        "GROUP BY city, name ORDER BY rev DESC LIMIT 5")
+    out = CycloneFrame(df.to_dict())
+    print("top city/sku pairs:")
+    for _, row in out.iterrows():
+        print(f"  {row['city']:6s} {row['name']:7s} {row['rev']:8.1f}")
+
+
+if __name__ == "__main__":
+    main()
