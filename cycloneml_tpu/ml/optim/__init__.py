@@ -1,4 +1,7 @@
 from cycloneml_tpu.ml.optim.lbfgs import LBFGS, LBFGSB, OWLQN, OptimState
+from cycloneml_tpu.ml.optim.wls import (WeightedLeastSquares,
+                                        WeightedLeastSquaresModel)
 from cycloneml_tpu.ml.optim import aggregators
 
-__all__ = ["LBFGS", "LBFGSB", "OWLQN", "OptimState", "aggregators"]
+__all__ = ["LBFGS", "LBFGSB", "OWLQN", "OptimState", "WeightedLeastSquares",
+           "WeightedLeastSquaresModel", "aggregators"]

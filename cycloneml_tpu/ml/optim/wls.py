@@ -1,0 +1,255 @@
+"""WeightedLeastSquares — the reference's normal-equation solver component.
+
+Semantics port of ml/optim/WeightedLeastSquares.scala:101-326 and
+NormalEquationSolver.scala:59-153 (CholeskySolver + QuasiNewtonSolver),
+TPU-shaped: the moment aggregation (the reference's ``treeAggregate(new
+Aggregator)``) is ONE jitted device pass producing {wSum, bBar, bbBar,
+aBar, aaBar, abBar}; the (d+1)-sized standardized normal-equation solve
+then runs on the driver in f64, exactly where the reference solves after
+its aggregate.
+
+Distinctions that matter for golden parity (and differ from the
+LinearRegression l-bfgs path):
+
+- moments are POPULATION-weighted (aVar = aaBar − aBar², divided by wSum)
+  — glmnet's convention, NOT the Summarizer's unbiased denominator;
+- the intercept is an APPENDED column of the standardized system (getAtA
+  at :312), not a centering trick, and the quasi-Newton cost function
+  pins it to bBar − aBar·β every evaluation (NormalEquationSolver.scala:
+  134-144);
+- zero-variance features get zero coefficients via the bStd/aStd=0
+  mapping (:290);
+- a constant label short-circuits with fitIntercept (or an all-zero
+  label), refuses regularization when the label is standardized, and
+  otherwise trains with bStd = |bBar| (:117-141).
+
+GLM's IRLS and LinearRegression's 'normal' solver are this component's
+estimator-level callers in the reference (SURVEY §2.3 optimizers row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+AUTO = "auto"
+CHOLESKY = "cholesky"
+QUASI_NEWTON = "quasi-newton"
+
+MAX_NUM_FEATURES = 4096  # ref WeightedLeastSquares.MAX_NUM_FEATURES:335
+
+
+class WeightedLeastSquaresModel:
+    def __init__(self, coefficients: np.ndarray, intercept: float,
+                 diag_inv_atwa: np.ndarray, objective_history):
+        self.coefficients = coefficients
+        self.intercept = intercept
+        self.diag_inv_atwa = diag_inv_atwa
+        self.objective_history = list(objective_history)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(x) @ self.coefficients + self.intercept
+
+
+_agg_jit = None
+
+
+def _moments(x, y, w):
+    """One device pass for the summary moments (ref Aggregator.add/merge;
+    the psum over blocks replaces treeAggregate). The jitted kernel is
+    module-cached so repeated fits at one shape (IRLS iterations,
+    hyperparameter sweeps) compile once and dispatch thereafter."""
+    import jax
+    import jax.numpy as jnp
+
+    global _agg_jit
+    if _agg_jit is None:
+        @jax.jit
+        def agg(x, y, w):
+            return {
+                "w_sum": jnp.sum(w),
+                "b_sum": jnp.sum(w * y),
+                "bb_sum": jnp.sum(w * y * y),
+                "a_sum": jnp.sum(x * w[:, None], axis=0),
+                "ab_sum": jnp.sum(x * (w * y)[:, None], axis=0),
+                "aa_sum": jnp.einsum("bi,bj->ij", x * w[:, None], x,
+                                     precision=jax.lax.Precision.HIGHEST),
+            }
+        _agg_jit = agg
+
+    out = _agg_jit(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+    return {k: np.asarray(v, dtype=np.float64) for k, v in out.items()}
+
+
+class WeightedLeastSquares:
+    """Normal-equation WLS with the reference's exact solver semantics."""
+
+    def __init__(self, fit_intercept: bool, reg_param: float = 0.0,
+                 elastic_net_param: float = 0.0,
+                 standardize_features: bool = True,
+                 standardize_label: bool = True,
+                 solver_type: str = AUTO,
+                 max_iter: int = 100, tol: float = 1e-6):
+        if reg_param < 0:
+            raise ValueError("regParam must be >= 0")
+        if not 0.0 <= elastic_net_param <= 1.0:
+            raise ValueError("elasticNetParam must be in [0, 1]")
+        if solver_type not in (AUTO, CHOLESKY, QUASI_NEWTON):
+            raise ValueError(f"unknown solver {solver_type!r}")
+        self.fit_intercept = fit_intercept
+        self.reg_param = float(reg_param)
+        self.elastic_net_param = float(elastic_net_param)
+        self.standardize_features = standardize_features
+        self.standardize_label = standardize_label
+        self.solver_type = solver_type
+        self.max_iter = max_iter
+        self.tol = tol
+
+    # -- public ----------------------------------------------------------
+    def fit(self, x, y, w: Optional[np.ndarray] = None
+            ) -> WeightedLeastSquaresModel:
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
+        if d > MAX_NUM_FEATURES:
+            raise ValueError(
+                f"WeightedLeastSquares supports at most {MAX_NUM_FEATURES} "
+                f"features, got {d}")
+        if w is None:
+            w = np.ones(n)
+        m = _moments(x, y, np.asarray(w, dtype=np.float64))
+        return self._solve_from_moments(m, d)
+
+    # -- the reference algorithm -----------------------------------------
+    def _solve_from_moments(self, m, d: int) -> WeightedLeastSquaresModel:
+        w_sum = m["w_sum"]
+        if w_sum <= 0:
+            raise ValueError("sum of weights must be positive")
+        raw_b_bar = m["b_sum"] / w_sum
+        raw_bb_bar = m["bb_sum"] / w_sum
+        raw_b_std = float(np.sqrt(max(raw_bb_bar - raw_b_bar ** 2, 0.0)))
+
+        if raw_b_std == 0.0:
+            if self.fit_intercept or raw_b_bar == 0.0:
+                # ref :121-136: constant label needs no training
+                return WeightedLeastSquaresModel(
+                    np.zeros(d), float(raw_b_bar) if self.fit_intercept
+                    else 0.0, np.zeros(1), [0.0])
+            if self.reg_param > 0.0 and self.standardize_label:
+                raise ValueError(
+                    "The standard deviation of the label is zero. Model "
+                    "cannot be regularized when labels are standardized")
+        b_std = abs(float(raw_b_bar)) if raw_b_std == 0.0 else raw_b_std
+        b_bar = float(raw_b_bar) / b_std
+        bb_bar = float(raw_bb_bar) / (b_std * b_std)
+
+        raw_a_bar = m["a_sum"] / w_sum
+        raw_aa_bar = m["aa_sum"] / w_sum
+        raw_ab_bar = m["ab_sum"] / w_sum
+        a_var = np.maximum(np.diag(raw_aa_bar) - raw_a_bar ** 2, 0.0)
+        a_std = np.sqrt(a_var)
+        live = a_std > 0
+        inv_std = np.where(live, 1.0 / np.where(live, a_std, 1.0), 0.0)
+
+        a_bar = raw_a_bar * inv_std
+        ab_bar = raw_ab_bar * inv_std / b_std
+        aa_bar = raw_aa_bar * np.outer(inv_std, inv_std)
+
+        eff_reg = self.reg_param / b_std
+        eff_l1 = self.elastic_net_param * eff_reg
+        eff_l2 = (1.0 - self.elastic_net_param) * eff_reg
+
+        # L2 onto the standardized diagonal (ref :213-231)
+        lam = np.full(d, eff_l2)
+        if not self.standardize_features:
+            lam = np.where(live, lam * inv_std * inv_std, 0.0)
+        if not self.standardize_label:
+            lam = lam * b_std
+        aa_bar = aa_bar + np.diag(lam)
+
+        # augmented system: intercept rides as an appended bias column
+        if self.fit_intercept:
+            ata = np.block([[aa_bar, a_bar[:, None]],
+                            [a_bar[None, :], np.ones((1, 1))]])
+            atb = np.concatenate([ab_bar, [b_bar]])
+        else:
+            ata = aa_bar
+            atb = ab_bar
+
+        use_qn = (self.solver_type == QUASI_NEWTON
+                  or (self.solver_type == AUTO
+                      and self.elastic_net_param != 0.0
+                      and self.reg_param != 0.0))
+        if use_qn:
+            sol, history, aa_inv = self._quasi_newton(
+                ata, atb, a_bar, b_bar, bb_bar, a_std, eff_l1, d)
+        else:
+            try:
+                sol, history, aa_inv = self._cholesky(ata, atb)
+            except np.linalg.LinAlgError:
+                if self.solver_type != AUTO:
+                    raise
+                # ref :266-273: auto falls back to QN on singular AtA
+                sol, history, aa_inv = self._quasi_newton(
+                    ata, atb, a_bar, b_bar, bb_bar, a_std, None, d)
+
+        if self.fit_intercept:
+            coef_std, intercept = sol[:d], float(sol[d]) * b_std
+        else:
+            coef_std, intercept = sol, 0.0
+        coef = coef_std * np.where(live, b_std * inv_std, 0.0)
+
+        if aa_inv is not None:
+            mult = np.concatenate([a_var, [1.0]]) if self.fit_intercept \
+                else a_var
+            with np.errstate(divide="ignore"):
+                diag = np.where(mult > 0,
+                                np.diag(aa_inv) / (w_sum * mult), np.inf)
+        else:
+            diag = np.zeros(1)
+        return WeightedLeastSquaresModel(coef, intercept, diag, history)
+
+    def _cholesky(self, ata, atb):
+        # np.linalg.cholesky raises LinAlgError on non-PD — the reference's
+        # SingularMatrixException analog
+        chol = np.linalg.cholesky(ata)
+        sol = np.linalg.solve(chol.T, np.linalg.solve(chol, atb))
+        inv = np.linalg.inv(ata)
+        return sol, [0.0], inv
+
+    def _quasi_newton(self, ata, atb, a_bar, b_bar, bb_bar, a_std,
+                      eff_l1, d: int):
+        from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OWLQN
+
+        k = ata.shape[0]
+
+        def f(coef):
+            coef = np.asarray(coef, dtype=np.float64).copy()
+            if self.fit_intercept:
+                # ref NormalEquationCostFun:134-144 — the bias coordinate
+                # is pinned to its optimum given the features
+                coef[d] = b_bar - float(coef[:d] @ a_bar)
+            aax = ata @ coef
+            loss = 0.5 * bb_bar - float(atb @ coef) + 0.5 * float(coef @ aax)
+            return loss, aax - atb
+
+        x0 = np.zeros(k)
+        if self.fit_intercept:
+            x0[d] = b_bar
+        if eff_l1:
+            l1_vec = np.zeros(k)
+            for i in range(d):
+                if self.standardize_features:
+                    l1_vec[i] = eff_l1
+                else:
+                    l1_vec[i] = eff_l1 / a_std[i] if a_std[i] != 0 else 0.0
+            opt = OWLQN(max_iter=self.max_iter, tol=self.tol, l1_reg=l1_vec)
+        else:
+            opt = LBFGS(max_iter=self.max_iter, tol=self.tol)
+        state = None
+        for state in opt.iterations(f, x0):
+            pass
+        sol = np.asarray(state.x, dtype=np.float64).copy()
+        if self.fit_intercept:
+            sol[d] = b_bar - float(sol[:d] @ a_bar)
+        return sol, list(state.loss_history), None

@@ -93,6 +93,31 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
             solver = "normal" if (alpha * reg == 0.0 and d <= MAX_FEATURES_FOR_NORMAL) \
                 else "l-bfgs"
 
+        if solver == "normal":
+            # delegate to the WLS COMPONENT exactly as the reference does
+            # (LinearRegression.scala:446-448: WeightedLeastSquares with
+            # solverType=Auto, standardizeLabel=true) — population-weighted
+            # moments, appended-bias system, Cholesky with singular→QN
+            # fallback, and the constant-label/zero-variance degeneracies
+            # live in ONE place (ml/optim/wls.py)
+            from cycloneml_tpu.ml.optim.wls import (AUTO,
+                                                    WeightedLeastSquares)
+            wls = WeightedLeastSquares(
+                fit_intercept=self.get("fitIntercept"), reg_param=reg,
+                elastic_net_param=alpha,
+                standardize_features=self.get("standardization"),
+                standardize_label=True, solver_type=AUTO,
+                max_iter=self.get("maxIter"), tol=self.get("tol"))
+            wm = wls.fit(ds.x, ds.y, ds.w)
+            model = LinearRegressionModel(wm.coefficients, wm.intercept,
+                                          uid=self.uid)
+            self._copy_values(model)
+            model._set_parent(self)
+            model.summary = LinearRegressionTrainingSummary(
+                wm.objective_history,
+                max(len(wm.objective_history) - 1, 0))
+            return model
+
         stats = Summarizer.summarize(ds)
         x_mean, x_std = stats.mean, stats.std
         w_sum = stats.weight_sum
@@ -106,73 +131,41 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         y_var = max((float(ymom["s2"]) - w_sum * y_mean ** 2) / denom, 0.0) if denom > 0 else 0.0
         y_std = float(np.sqrt(y_var))
         if y_std == 0.0:
-            # constant label: exact fit with zero coefficients (ref behavior)
-            model = LinearRegressionModel(np.zeros(d), y_mean if self.get("fitIntercept") else 0.0,
-                                          uid=self.uid)
-            self._copy_values(model)
-            model._set_parent(self)
-            model.summary = LinearRegressionTrainingSummary([0.0], 0)
-            return model
+            # constant label (ref LinearRegression.scala:388-414, mirroring
+            # WeightedLeastSquares.scala:117-141): with an intercept (or an
+            # all-zero label) the exact fit is zero coefficients; WITHOUT
+            # an intercept a nonzero constant label still needs solving —
+            # the reference sets yStd = |yMean| so the label is "not scaled
+            # anymore" and proceeds, and REFUSES regularization because the
+            # label-standardized penalty is undefined at σy=0
+            if self.get("fitIntercept") or y_mean == 0.0:
+                model = LinearRegressionModel(
+                    np.zeros(d), y_mean if self.get("fitIntercept") else 0.0,
+                    uid=self.uid)
+                self._copy_values(model)
+                model._set_parent(self)
+                model.summary = LinearRegressionTrainingSummary([0.0], 0)
+                return model
+            if reg > 0.0:
+                raise ValueError(
+                    "The standard deviation of the label is zero. Model "
+                    "cannot be regularized when labels are standardized "
+                    "(ref WeightedLeastSquares require)")
+            y_std = abs(y_mean)
 
         # glmnet semantics (the reference's parity target): the penalty is
         # applied on the label-standardized problem, so the user's regParam
         # is divided by the label std (ref LinearRegression.scala:396
         # effectiveRegParam = regParam / yStd; WeightedLeastSquares.scala:209)
         eff_reg = reg / y_std
-        if solver == "normal":
-            coef, icpt, history = self._solve_normal(ds, stats, y_mean,
-                                                     y_std, eff_reg)
-        else:
-            coef, icpt, history = self._solve_quasi_newton(
-                ds, stats, y_mean, y_std, eff_reg, alpha)
+        coef, icpt, history = self._solve_quasi_newton(
+            ds, stats, y_mean, y_std, eff_reg, alpha)
 
         model = LinearRegressionModel(coef, icpt, uid=self.uid)
         self._copy_values(model)
         model._set_parent(self)
         model.summary = LinearRegressionTrainingSummary(history, max(len(history) - 1, 0))
         return model
-
-    # -- normal equations (WLS) -----------------------------------------------
-    def _solve_normal(self, ds, stats, y_mean, y_std, reg):
-        """AᵀWA via device Gramian psum, driver Cholesky with L2 diag
-        (ref WeightedLeastSquares 'auto'/'normal' path). Solved in original
-        space with the centering trick."""
-        import jax.numpy as jnp
-
-        fit_intercept = self.get("fitIntercept")
-        standardize = self.get("standardization")
-        import jax
-        gram = ds.tree_aggregate_fn(
-            lambda x, y, w: {
-                "xtx": jnp.einsum("bi,bj->ij", x * w[:, None], x,
-                                  precision=jax.lax.Precision.HIGHEST),
-                "xty": jnp.sum(x * (w * y)[:, None], axis=0)})()
-        xtx = np.asarray(gram["xtx"], dtype=np.float64)
-        xty = np.asarray(gram["xty"], dtype=np.float64)
-        w_sum = stats.weight_sum
-        x_mean = stats.mean
-        if fit_intercept:
-            # centered normal equations: (XᵀWX − w x̄x̄ᵀ) β = XᵀWy − w x̄ ȳ
-            xtx = xtx - w_sum * np.outer(x_mean, x_mean)
-            xty = xty - w_sum * x_mean * y_mean
-        # L2 diag: ``reg`` arrives already divided by σy (glmnet scaling);
-        # std-space λ on β̂=β·σx/σy maps to reg·w_sum·σx² on original β
-        # (one σy cancels against the 1/σy²-scaled loss), and
-        # standardization=false drops the σx² factor
-        # (ref WeightedLeastSquares.scala:213-228)
-        if reg > 0:
-            std = stats.std
-            if standardize:
-                diag = reg * w_sum * std * std
-            else:
-                diag = np.full_like(x_mean, reg * w_sum)
-            xtx = xtx + np.diag(diag)
-        try:
-            coef = np.linalg.solve(xtx, xty)
-        except np.linalg.LinAlgError:
-            coef = np.linalg.lstsq(xtx, xty, rcond=None)[0]
-        icpt = y_mean - float(coef @ x_mean) if fit_intercept else 0.0
-        return coef, icpt, [0.0]  # ref: normal solver reports objectiveHistory [0.0]
 
     # -- quasi-Newton in doubly standardized space -----------------------------
     def _solve_quasi_newton(self, ds, stats, y_mean, y_std, reg, alpha):

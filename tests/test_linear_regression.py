@@ -52,14 +52,22 @@ def test_ridge_standardized_vs_sklearn(ctx):
 
 
 def test_normal_solver_equals_lbfgs_with_l2(ctx):
+    """The two solvers agree to ~1e-4 relative under L2 — not exactly:
+    since r5 the normal path IS the WLS component (population-weighted
+    moments, glmnet's convention, as the reference's WeightedLeastSquares
+    uses) while the l-bfgs path standardizes with the Summarizer's
+    UNBIASED std (as the reference's l-bfgs path does, LinearRegression
+    .scala:396) — the reference's own two paths carry the same n/(n−1)
+    penalty-scale gap."""
     frame, _, _ = _frame(ctx, seed=23)
     reg = 0.2
     m1 = LinearRegression(regParam=reg, solver="normal").fit(frame)
     m2 = LinearRegression(regParam=reg, solver="l-bfgs", tol=1e-13,
                           maxIter=2000).fit(frame)
     np.testing.assert_allclose(m1.coefficients.to_array(),
-                               m2.coefficients.to_array(), rtol=1e-5, atol=1e-8)
-    np.testing.assert_allclose(m1.intercept, m2.intercept, rtol=1e-5)
+                               m2.coefficients.to_array(), rtol=3e-4,
+                               atol=1e-8)
+    np.testing.assert_allclose(m1.intercept, m2.intercept, rtol=3e-4)
 
 
 def test_elasticnet_lasso_vs_sklearn(ctx):

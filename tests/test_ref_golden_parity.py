@@ -86,6 +86,24 @@ def _dataset(name):
     elif name == "multinomial_zero_var":
         X, y, w = gen.multinomial_dataset_zero_var()
         out = {"features": X, "label": y, "weight": w}
+    elif name.startswith("wls_"):
+        # WeightedLeastSquaresSuite.scala:35-105 — tiny FIXED matrices
+        # (no RNG): A, b, w straight from the suite's beforeAll
+        A = np.array([[0.0, 5.0], [1.0, 7.0], [2.0, 11.0], [3.0, 13.0]])
+        w = np.array([1.0, 2.0, 3.0, 4.0])
+        if name == "wls_instances":
+            out = {"features": A, "label": np.array([17.0, 19.0, 23.0, 29.0]),
+                   "weight": w}
+        elif name == "wls_const_label":
+            out = {"features": A, "label": np.full(4, 17.0), "weight": w}
+        elif name == "wls_const_zero_label":
+            out = {"features": A, "label": np.zeros(4), "weight": w}
+        elif name == "wls_const_features":
+            out = {"features": np.array([[1.0, 5.0], [1.0, 7.0],
+                                         [1.0, 11.0], [1.0, 13.0]]),
+                   "label": np.array([17.0, 19.0, 23.0, 29.0]), "weight": w}
+        else:
+            raise KeyError(name)
     elif name == "aft_univariate":
         # AFTSurvivalRegressionSuite.scala:41 datasetUnivariate
         X, label, censor = gen.generate_aft_input(
@@ -151,6 +169,36 @@ def test_linear_regression_golden(ctx, case):
     params.setdefault("maxIter", 300)
     params.setdefault("tol", 1e-9)
     _check(LinearRegression(**params).fit(frame), case)
+
+
+@pytest.mark.parametrize("case", GOLDEN["wls"], ids=lambda c: c["id"])
+def test_wls_golden(ctx, case):
+    """The reference's WeightedLeastSquares suite fits 4-row FIXED
+    matrices against R lm/glmnet constants across every solver knob —
+    fitIntercept x regParam x elasticNet x standardization x
+    Cholesky/quasi-Newton — including constant-label and constant-feature
+    degeneracies (ref WeightedLeastSquaresSuite.scala; the suite drives
+    the WLS COMPONENT directly, as the reference's does, with the
+    reference's tol=1e-14 / maxIter=100000 and POPULATION-weighted
+    moments — glmnet's convention)."""
+    from cycloneml_tpu.ml.optim.wls import (CHOLESKY, QUASI_NEWTON,
+                                            WeightedLeastSquares)
+    data = _dataset(case["dataset"])
+    p = dict(case["params"])
+    solver = {"normal": CHOLESKY, "l-bfgs": QUASI_NEWTON}[p.pop("solver")]
+    std = p.pop("standardization", True)
+    wls = WeightedLeastSquares(
+        fit_intercept=p.pop("fitIntercept"),
+        reg_param=p.pop("regParam", 0.0),
+        elastic_net_param=p.pop("elasticNetParam", 0.0),
+        standardize_features=std, standardize_label=True,
+        solver_type=solver, max_iter=100000, tol=1e-14)
+    model = wls.fit(data["features"], data["label"], data["weight"])
+    tol = case["abs_tol"]
+    np.testing.assert_allclose(model.coefficients, case["coefficients"],
+                               atol=tol, rtol=0, err_msg=case["ref"])
+    np.testing.assert_allclose(model.intercept, case["intercept"],
+                               atol=tol, rtol=0, err_msg=case["ref"])
 
 
 @pytest.mark.parametrize("case", GOLDEN["glm"], ids=lambda c: c["id"])

@@ -484,3 +484,22 @@ def test_like_wildcard_free_becomes_string_equality(session):
     plan_s = df.optimized_plan().tree_string()
     assert "like" not in plan_s and "str_eq" in plan_s
     assert df.to_dict()["s"].tolist() == ["apple"]
+
+
+def test_outer_elimination_enables_reordering(star):
+    """Integration: a LEFT join downgraded to INNER by a null-rejecting
+    filter joins the reorderable chain — the downgrade runs in the
+    rewrite loop, reordering in its later pass."""
+    df = star.sql(
+        "SELECT n1, n2, x FROM fact "
+        "JOIN dim1 ON fact.fk1 = dim1.d1 "
+        "LEFT JOIN dim2 ON fact.fk2 = dim2.d2 "
+        "WHERE n2 IS NOT NULL")
+    top = _find_top_join(df.optimized_plan())
+    assert top.how == "inner"  # the LEFT join was downgraded
+    sizes = _join_chain_sizes(top)
+    # 3-relation chain, led by the FILTERED dim2 (est 3//2=1 — the
+    # pushed-down IS NOT NULL shrank its estimate below dim1's 4)
+    assert len(sizes) == 3 and sizes[0] == 1
+    out = df.to_dict()
+    assert len(out["x"]) == 100  # every fk2 matches a dim2 row
