@@ -109,7 +109,10 @@ class WeightedLeastSquares:
     # -- public ----------------------------------------------------------
     def fit(self, x, y, w: Optional[np.ndarray] = None
             ) -> WeightedLeastSquaresModel:
-        x = np.asarray(x, dtype=np.float64)
+        """``x``/``y``/``w`` may be numpy OR live (possibly sharded)
+        device arrays — they pass straight into the jitted moment pass
+        with no host round-trip, so a mesh-sharded dataset aggregates in
+        place and only the O(d²) moments come back to the driver."""
         n, d = x.shape
         if d > MAX_NUM_FEATURES:
             raise ValueError(
@@ -117,7 +120,7 @@ class WeightedLeastSquares:
                 f"features, got {d}")
         if w is None:
             w = np.ones(n)
-        m = _moments(x, y, np.asarray(w, dtype=np.float64))
+        m = _moments(x, y, w)
         return self._solve_from_moments(m, d)
 
     # -- the reference algorithm -----------------------------------------

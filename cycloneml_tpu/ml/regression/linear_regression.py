@@ -6,14 +6,16 @@ Re-design of the reference estimator (ref: ml/regression/LinearRegression.scala,
   f(β̂) = 1/(2n) Σ wᵢ((x̂ᵢ−x̄̂)·β̂ − (ŷᵢ−ȳ̂))² + regParam·(α‖β̄‖₁ + (1−α)/2‖β̄‖²)
 
 in doubly-standardized space (features AND label divided by their std, the
-glmnet convention the reference follows), trained without an intercept via
-the centering trick, with the intercept recovered in closed form
-(ȳ − β·x̄). ``standardization=false`` penalises original-space β exactly as
-the reference's DifferentiableRegularization does. Solvers mirror
-``solver`` param: "l-bfgs"/OWL-QN for elastic net, "normal" = weighted
-least squares via a device-side Gramian psum + driver Cholesky
-(ref: ml/optim/WeightedLeastSquares.scala, NormalEquationSolver.scala),
-"auto" picks normal when d ≤ 4096 and α·regParam == 0.
+glmnet convention the reference follows). ``standardization=false``
+penalises original-space β exactly as the reference's
+DifferentiableRegularization does. Solvers mirror ``solver``: "l-bfgs"/
+OWL-QN trains without an intercept via the centering trick (intercept
+recovered in closed form ȳ − β·x̄, Summarizer unbiased std — the
+reference's l-bfgs path); "normal" DELEGATES to the
+``ml.optim.wls.WeightedLeastSquares`` component exactly as the reference
+does (LinearRegression.scala:446-448 — population-weighted moments,
+appended-bias standardized system, Cholesky with singular→quasi-Newton
+fallback); "auto" picks normal when d ≤ 4096 and α·regParam == 0.
 """
 
 from __future__ import annotations
@@ -38,7 +40,10 @@ from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
 
-MAX_FEATURES_FOR_NORMAL = 4096  # ref WeightedLeastSquares.MAX_NUM_FEATURES
+# the component owns the real cap (wls.py raises at fit time) — this
+# alias only steers the auto-solver choice
+from cycloneml_tpu.ml.optim.wls import \
+    MAX_NUM_FEATURES as MAX_FEATURES_FOR_NORMAL  # noqa: E402
 
 
 class _LinearRegressionParams(HasMaxIter, HasRegParam, HasElasticNetParam,

@@ -86,6 +86,30 @@ def _dataset(name):
     elif name == "multinomial_zero_var":
         X, y, w = gen.multinomial_dataset_zero_var()
         out = {"features": X, "label": y, "weight": w}
+    elif name == "gmm_dense_univariate":
+        # GaussianMixtureSuite.scala:304-310 denseData (literal)
+        out = {"features": np.array(
+            [-5.1971, -2.5359, -3.8220, -5.2211, -5.0602, 4.7118, 6.8989,
+             3.4592, 4.6322, 5.7048, 4.6567, 5.5026, 4.5605, 5.2043,
+             6.2734])[:, None]}
+    elif name == "gmm_r_multivariate":
+        # GaussianMixtureSuite.scala:316-326 rData (literal, R rmvnorm
+        # draws committed in the suite)
+        out = {"features": np.array([
+            [-0.6264538, 0.1836433], [-0.8356286, 1.5952808],
+            [0.3295078, -0.8204684], [0.4874291, 0.7383247],
+            [0.5757814, -0.3053884], [1.5117812, 0.3898432],
+            [-0.6212406, -2.2146999], [11.1249309, 9.9550664],
+            [9.9838097, 10.9438362], [10.8212212, 10.5939013],
+            [10.9189774, 10.7821363], [10.0745650, 8.0106483],
+            [10.6198257, 9.9438713], [9.8442045, 8.5292476],
+            [9.5218499, 10.4179416]])}
+    elif name == "linreg_eval_100":
+        # RegressionEvaluatorSuite.scala:47-49 — same generator as
+        # linreg_dense at n=100
+        X, y = gen.generate_linear_input(6.3, [4.7, 7.2], [0.9, -1.3],
+                                         [0.7, 1.2], 100, 42, 0.1)
+        out = {"features": X, "label": y}
     elif name.startswith("wls_"):
         # WeightedLeastSquaresSuite.scala:35-105 — tiny FIXED matrices
         # (no RNG): A, b, w straight from the suite's beforeAll
@@ -199,6 +223,54 @@ def test_wls_golden(ctx, case):
                                atol=tol, rtol=0, err_msg=case["ref"])
     np.testing.assert_allclose(model.intercept, case["intercept"],
                                atol=tol, rtol=0, err_msg=case["ref"])
+
+
+@pytest.mark.parametrize("case", GOLDEN["regression_evaluator"],
+                         ids=lambda c: c["id"])
+def test_regression_evaluator_golden(ctx, case):
+    """The reference validates RegressionEvaluator against R rminer's
+    mmetric on a glmnet fit of the same bit-exact dataset
+    (RegressionEvaluatorSuite.scala:56-83)."""
+    from cycloneml_tpu.ml.evaluation import RegressionEvaluator
+    data = _dataset(case["dataset"])
+    frame = MLFrame(ctx, data)
+    model = LinearRegression().fit(frame)
+    pred = model.transform(frame)
+    for metric, want in case["metrics"].items():
+        got = RegressionEvaluator(metricName=metric).evaluate(pred)
+        np.testing.assert_allclose(got, want, atol=case["abs_tol"], rtol=0,
+                                   err_msg=f"{case['ref']} ({metric})")
+
+
+@pytest.mark.parametrize("case", GOLDEN["gmm"], ids=lambda c: c["id"])
+def test_gmm_golden(ctx, case):
+    """GaussianMixture vs the reference suite's committed mixtures —
+    incl. the R mixtools mvnormalmixEM constants — compared sorted by
+    weight at the reference's absTol 1e-3 (modelEquals,
+    GaussianMixtureSuite.scala:329-340). Well-separated clusters make
+    the EM optimum init-independent, which is why the reference can pin
+    R's numbers despite a different initialization."""
+    from cycloneml_tpu.ml.clustering import GaussianMixture
+    data = _dataset(case["dataset"])
+    frame = MLFrame(ctx, data)
+    model = GaussianMixture(k=case["k"], seed=11, maxIter=200,
+                            tol=1e-6).fit(frame)
+    got = sorted(zip(model.weights,
+                     np.asarray(model._means),
+                     np.asarray(model._covs)), key=lambda t: t[0])
+    tol = case["abs_tol"]
+    for (w, mu, cov), ew, emu, ecov in zip(
+            got, case["weights"], case["means"], case["covs"]):
+        np.testing.assert_allclose(w, ew, atol=tol, rtol=0,
+                                   err_msg=case["ref"])
+        np.testing.assert_allclose(mu, emu, atol=tol, rtol=0,
+                                   err_msg=case["ref"])
+        np.testing.assert_allclose(cov, ecov, atol=tol, rtol=0,
+                                   err_msg=case["ref"])
+    if "log_likelihood" in case:
+        np.testing.assert_allclose(
+            model.log_likelihood, case["log_likelihood"],
+            atol=case["llk_abs_tol"], rtol=0, err_msg=case["ref"])
 
 
 @pytest.mark.parametrize("case", GOLDEN["glm"], ids=lambda c: c["id"])
