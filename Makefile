@@ -1,0 +1,16 @@
+# Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
+# also enforced by tests/test_graftlint.py) and `make test`.
+
+.PHONY: lint lint-json test
+
+lint:
+	python -m cycloneml_tpu.analysis cycloneml_tpu \
+	    --baseline cycloneml_tpu/analysis/baseline.json
+
+lint-json:
+	python -m cycloneml_tpu.analysis cycloneml_tpu \
+	    --baseline cycloneml_tpu/analysis/baseline.json --json
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,44 @@
+"""graftlint — AST-based JAX/TPU hazard analyzer.
+
+The framework hangs off one narrow dispatch boundary (MLlib -> BLAS ->
+``tree_aggregate`` -> ``jax.lax.psum``), so a single silent host-device
+sync, tracer leak, or mismatched collective axis name inside a jitted hot
+path wrecks the perf story without any functional test failing. This
+package encodes those failure modes as enforced lint rules:
+
+- **JX001** implicit host sync in jit-reachable code (``float()`` /
+  ``int()`` / ``bool()`` / ``.item()`` / ``np.asarray`` on a traced value)
+  and piecemeal device->host pulls from an aggregate program's output
+  where one ``jax.device_get`` would do.
+- **JX002** Python ``if`` / ``while`` branching on a traced value where
+  ``lax.cond`` / ``lax.while_loop`` is required.
+- **JX003** PRNG key reuse — the same key consumed by two ``jax.random.*``
+  draws without an intervening ``split`` / ``fold_in``.
+- **JX004** fp64 literal/dtype drift in device code without a
+  ``jax_enable_x64`` guard.
+- **JX005** collective axis names validated against the axes declared in
+  ``cycloneml_tpu/mesh.py``.
+- **JX006** jitted function mutating ``self`` / ``global`` / ``nonlocal``
+  state (the side effect runs once at trace time, then silently freezes).
+
+Rules fire only where they matter: a call-graph pass
+(:mod:`.reachability`) computes which functions are jit-reachable, seeded
+from ``@jax.jit`` / ``pjit`` decorations, functions handed to tracing
+entry points (``jit``, ``shard_map``, ``tree_aggregate_fn``,
+``lax.while_loop``, ...), ``jax.lax`` call sites, and returned jnp-kernel
+closures.
+
+Usage::
+
+    python -m cycloneml_tpu.analysis <paths> [--json] [--baseline FILE]
+
+``tests/test_graftlint.py`` runs the analyzer over ``cycloneml_tpu/`` as
+part of tier-1 and fails on any finding not grandfathered in
+``cycloneml_tpu/analysis/baseline.json``. See ``docs/graftlint.md``.
+"""
+
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, analyze_paths
+from cycloneml_tpu.analysis.report import render_json, render_text
+
+__all__ = ["AnalysisContext", "Finding", "analyze_paths", "render_json",
+           "render_text"]

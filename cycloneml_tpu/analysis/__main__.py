@@ -1,0 +1,92 @@
+"""graftlint CLI.
+
+::
+
+    python -m cycloneml_tpu.analysis <paths...> [options]
+
+Options:
+    --json                 machine-readable output
+    --baseline FILE        subtract grandfathered findings (exit 0 when
+                           everything new is clean)
+    --write-baseline FILE  write the current findings as the new baseline
+                           and exit 0 (regeneration workflow)
+    --rules JX001,JX003    run a subset of the rule pack
+    --list-rules           print the rule pack and exit
+
+Exit codes: 0 clean (after baseline), 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from cycloneml_tpu.analysis import baseline as baseline_mod
+from cycloneml_tpu.analysis.engine import analyze_paths, collect_files
+from cycloneml_tpu.analysis.report import render_json, render_text
+from cycloneml_tpu.analysis.rules import ALL_RULES, default_rules, rules_by_id
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cycloneml_tpu.analysis",
+        description="graftlint: AST-based JAX/TPU hazard analyzer")
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--baseline", metavar="FILE", default=None)
+    parser.add_argument("--write-baseline", metavar="FILE", default=None)
+    parser.add_argument("--rules", metavar="IDS", default=None,
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            doc = (sys.modules[cls.__module__].__doc__ or "").strip()
+            first_line = doc.splitlines()[0] if doc else ""
+            print(f"{cls.rule_id}  {first_line}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    if args.rules:
+        wanted = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        known = {cls.rule_id for cls in ALL_RULES}
+        unknown = [r for r in wanted if r not in known]
+        if unknown or not wanted:
+            # a typo'd rule id silently not running would be an invisible
+            # hole in the gate — fail loudly instead
+            print(f"unknown rule id(s): {unknown or args.rules!r}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+        rules = rules_by_id(wanted)
+    else:
+        rules = default_rules()
+
+    findings = analyze_paths(args.paths, rules=rules)
+
+    if args.write_baseline:
+        baseline_mod.write_baseline(args.write_baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
+        return 0
+
+    grandfathered = 0
+    if args.baseline:
+        try:
+            known = baseline_mod.load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        findings, grandfathered = baseline_mod.apply_baseline(findings, known)
+
+    out = (render_json(findings, grandfathered) if args.as_json
+           else render_text(findings, grandfathered,
+                            len(collect_files(args.paths))))
+    print(out, end="" if args.as_json else "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

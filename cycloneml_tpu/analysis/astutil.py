@@ -1,0 +1,285 @@
+"""Shared AST helpers: dotted names, scope-aware function tables, taint.
+
+Everything here is pure ``ast`` — no imports of the analyzed code, so the
+analyzer can run over broken or import-cycle-heavy modules (and over test
+fixtures that would crash at import time on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Attribute reads that are static under tracing: touching them on a tracer
+# yields a host value without forcing a device sync, so they launder taint.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type"}
+
+# Builtins whose result is a host scalar/bool regardless of the argument —
+# they END taint (the sync itself is JX001's business, not the taint pass).
+LAUNDER_CALLS = {"len", "isinstance", "hasattr", "callable", "type", "repr",
+                 "str", "id", "getattr"}
+
+# Call prefixes that produce traced values inside traced code.
+TRACED_PREFIXES = ("jnp.", "jax.numpy.", "jax.nn.", "jax.lax.", "lax.",
+                   "jax.scipy.", "jax.random.", "jrandom.")
+
+# jnp/jax calls that answer static METADATA questions (host bools/dtypes,
+# never tracers) — `if jnp.issubdtype(dtype, jnp.integer):` is fine.
+STATIC_QUERY_CALLS = {"issubdtype", "iinfo", "finfo", "dtype", "result_type",
+                      "can_cast", "promote_types", "isdtype", "zeros_like_p"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.psum`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def last_component(dotted: Optional[str]) -> Optional[str]:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def assigned_names(target: ast.AST) -> List[str]:
+    """Plain names bound by an assignment target (tuples unpacked,
+    attribute/subscript targets skipped — those mutate, not bind)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return assigned_names(target.value)
+    return []
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method/closure in an analyzed module."""
+
+    qualname: str                       # dotted, nesting flattened: a.b.c
+    node: ast.AST                       # FunctionDef / AsyncFunctionDef / Lambda
+    module_path: str                    # repo-relative posix path
+    parent: Optional["FunctionInfo"]    # lexically enclosing function
+    class_name: Optional[str] = None    # immediate enclosing class, if any
+    params: Set[str] = field(default_factory=set)
+    calls: Set[str] = field(default_factory=set)        # dotted callee names
+    has_lax_call: bool = False          # jax.lax.* / lax.* call in OWN body
+    is_jit_decorated: bool = False
+    is_returned_kernel: bool = False    # returned closure doing jnp math
+    passed_to_tracer: bool = False      # handed to jit/shard_map/scan/...
+    jit_reachable: bool = False         # final verdict (reachability pass)
+
+    @property
+    def params_traced(self) -> bool:
+        """Are this function's parameters themselves traced values?
+
+        True for direct trace seeds — jitted functions, functions handed
+        to tracing entry points, returned jnp-kernel closures, and
+        lax-calling functions (their arguments are the traced operands).
+        False for helpers that are merely reachable through the call
+        graph: those commonly take a MIX of traced arrays and static
+        config (`_split_coef(coef, d, fit_intercept)`), and seeding every
+        parameter would flag `if fit_intercept:` — pure noise. Values
+        assigned from jnp/jax expressions still taint either way.
+        """
+        return (self.is_jit_decorated or self.passed_to_tracer
+                or self.is_returned_kernel or self.has_lax_call)
+
+    def __hash__(self):  # identity hashing: one info per def site
+        return id(self)
+
+
+def iter_own_statements(fn_node: ast.AST):
+    """Walk every node of a function body WITHOUT descending into nested
+    function/class defs (those get their own FunctionInfo)."""
+    stack = list(getattr(fn_node, "body", []))
+    while stack:
+        node = stack.pop(0)
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def expr_is_traced_producer(expr: ast.AST) -> bool:
+    """Does evaluating ``expr`` call into jnp/jax land (so its value is a
+    device array / tracer under a jit trace)?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and (name.startswith(TRACED_PREFIXES)
+                         or name in ("jnp", "lax")):
+                return True
+    return False
+
+
+class TaintTracker:
+    """Forward may-taint analysis over one function body.
+
+    Tainted = "holds a traced value / device array when this function is
+    traced". Parameters of a jit-reachable function are traced by
+    construction; names assigned from tainted expressions or jnp/jax calls
+    become tainted; ``.shape`` / ``len()`` / ``isinstance()`` reads launder.
+    Two passes give a cheap fixpoint for names used before a later
+    (loop-carried) assignment.
+    """
+
+    def __init__(self, fn_node: ast.AST, seed_params: bool = True):
+        self.tainted: Set[str] = set()
+        if seed_params:
+            args = getattr(fn_node, "args", None)
+            if args is not None:
+                for a in (list(args.posonlyargs) + list(args.args)
+                          + list(args.kwonlyargs)):
+                    self.tainted.add(a.arg)
+                if args.vararg:
+                    self.tainted.add(args.vararg.arg)
+                if args.kwarg:
+                    self.tainted.add(args.kwarg.arg)
+        for _ in range(2):
+            for stmt in iter_own_statements(fn_node):
+                self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            if self.expr_tainted(stmt.value):
+                for t in stmt.targets:
+                    self.tainted.update(assigned_names(t))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if self.expr_tainted(stmt.value):
+                self.tainted.update(assigned_names(stmt.target))
+        elif isinstance(stmt, ast.AugAssign):
+            if self.expr_tainted(stmt.value):
+                self.tainted.update(assigned_names(stmt.target))
+        elif isinstance(stmt, ast.For):
+            if self.expr_tainted(stmt.iter):
+                self.tainted.update(self._loop_tainted_targets(stmt))
+        elif isinstance(stmt, ast.withitem) and stmt.optional_vars is not None:
+            if self.expr_tainted(stmt.context_expr):
+                self.tainted.update(assigned_names(stmt.optional_vars))
+
+    @staticmethod
+    def _loop_tainted_targets(stmt: ast.For) -> List[str]:
+        """Loop targets that actually receive traced values. Dict KEYS are
+        static Python objects under tracing (the dict's structure is fixed
+        per trace), so ``for k, v in parts.items():`` taints only ``v``;
+        same for the index of ``enumerate()``."""
+        target, it = stmt.target, stmt.iter
+        pair = (isinstance(target, ast.Tuple) and len(target.elts) == 2)
+        if isinstance(it, ast.Call):
+            attr = it.func.attr if isinstance(it.func, ast.Attribute) else None
+            if attr == "keys":
+                return []
+            if attr == "items" and pair:
+                return assigned_names(target.elts[1])
+            if (isinstance(it.func, ast.Name) and it.func.id == "enumerate"
+                    and pair):
+                return assigned_names(target.elts[1])
+        return assigned_names(target)
+
+    def expr_tainted(self, expr: ast.AST) -> bool:
+        return self._tainted(expr)
+
+    def _tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self._tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name:
+                base = last_component(name)
+                if base in LAUNDER_CALLS or base in STATIC_QUERY_CALLS:
+                    return False
+                # host coercions end taint; flagging them is JX001's job
+                if name in ("float", "int", "bool"):
+                    return False
+                if name.startswith(TRACED_PREFIXES):
+                    return True
+            # method call on a tainted receiver: x.sum(), x.at[i].set(v)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr not in STATIC_QUERY_CALLS \
+                    and self._tainted(node.func.value):
+                return True
+            return any(self._tainted(a) for a in node.args) or any(
+                self._tainted(kw.value) for kw in node.keywords)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a static trace-time branch
+            # (a tracer is never None) — the canonical optional-arg pattern.
+            if (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+                    and isinstance(node.comparators[0], ast.Constant)
+                    and node.comparators[0].value is None):
+                return False
+            return self._tainted(node.left) or any(
+                self._tainted(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value) or self._tainted(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self._tainted(node.left) or self._tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return (self._tainted(node.body) or self._tainted(node.test)
+                    or self._tainted(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._tainted(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self._tainted(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self._tainted(node.elt)
+        if isinstance(node, ast.Slice):
+            return any(self._tainted(p) for p in
+                       (node.lower, node.upper, node.step) if p is not None)
+        return False
+
+
+def collect_suppressions(source_lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Map 1-based line -> set of suppressed rule ids (or {"all"}).
+
+    ``# graftlint: disable=JX001`` inline suppresses that line;
+    on a line of its own it suppresses the NEXT line as well (so the
+    directive can sit above a long statement). Comma-separated rule lists
+    and ``disable=all`` are accepted.
+    """
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source_lines, start=1):
+        marker = "graftlint:"
+        pos = line.find(marker)
+        if pos < 0 or "#" not in line[:pos]:
+            continue
+        directive = line[pos + len(marker):].strip()
+        if not directive.startswith("disable"):
+            continue
+        _, _, rules = directive.partition("=")
+        ids = {r.strip().upper() for r in rules.split(",") if r.strip()}
+        if not ids:
+            continue
+        out.setdefault(i, set()).update(ids)
+        if line[:pos].rstrip().rstrip("#").strip() == "":
+            # own-line directive: also covers the following line
+            out.setdefault(i + 1, set()).update(ids)
+    return out

@@ -1,0 +1,62 @@
+"""Baseline handling: grandfathered findings that predate a rule.
+
+The baseline is a committed JSON file mapping finding fingerprints
+``rule:path:function`` to an allowed occurrence count. Matching on the
+enclosing function instead of the line number keeps the baseline stable
+across unrelated edits; a refactor that *adds* occurrences inside an
+already-baselined function still fails, which is the intent — new hazards
+in old code are still new hazards.
+
+Regenerate with::
+
+    python -m cycloneml_tpu.analysis cycloneml_tpu --write-baseline \
+        cycloneml_tpu/analysis/baseline.json
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Dict, List, Tuple
+
+from cycloneml_tpu.analysis.engine import Finding
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        fp = f"{entry['rule']}:{entry['path']}:{entry.get('function', '')}"
+        out[fp] = out.get(fp, 0) + int(entry.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    counts = collections.Counter(f.fingerprint for f in findings)
+    entries = []
+    for fp in sorted(counts):
+        rule, fpath, function = fp.split(":", 2)
+        entries.append({"rule": rule, "path": fpath, "function": function,
+                        "count": counts[fp]})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, int]) -> Tuple[List[Finding], int]:
+    """Return (new findings, number grandfathered). Within a fingerprint,
+    the first ``count`` occurrences (by line order) are grandfathered."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    grandfathered = 0
+    for f in findings:
+        left = budget.get(f.fingerprint, 0)
+        if left > 0:
+            budget[f.fingerprint] = left - 1
+            grandfathered += 1
+        else:
+            new.append(f)
+    return new, grandfathered

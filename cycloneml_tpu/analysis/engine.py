@@ -1,0 +1,161 @@
+"""graftlint engine: file walker, rule registry, suppressions, findings.
+
+The engine parses every ``.py`` file under the given paths once, runs the
+jit-reachability pass over the whole file set (rules need cross-module
+call-graph context), then applies each registered rule per module.
+Findings carry a stable fingerprint ``(rule, path, function)`` so the
+committed baseline survives line-number churn.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+from cycloneml_tpu.analysis.astutil import collect_suppressions
+from cycloneml_tpu.analysis.reachability import (FunctionInfo,
+                                                 ModuleFunctions,
+                                                 compute_reachability)
+
+DEFAULT_AXES = ("data", "replica", "model")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    function: str = ""   # enclosing function qualname ("" = module level)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.function}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "function": self.function,
+                "message": self.message}
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    tree: ast.Module
+    source_lines: List[str]
+    mf: ModuleFunctions
+    functions: List[FunctionInfo] = field(default_factory=list)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    has_x64_guard: bool = False
+
+
+@dataclass
+class AnalysisContext:
+    """Cross-module state every rule receives."""
+
+    modules: Dict[str, ModuleInfo]
+    valid_axes: Sequence[str] = DEFAULT_AXES
+    # names of module-level constants that hold a valid axis name
+    axis_constant_names: Set[str] = field(default_factory=set)
+
+
+def _discover_axes(modules: Dict[str, ModuleInfo]):
+    """Pull the declared mesh axis names out of ``mesh.py`` if it is part
+    of the analyzed set: module-level ``X_AXIS = "name"`` assignments."""
+    axes: List[str] = []
+    names: Set[str] = set()
+    for path, mod in modules.items():
+        if os.path.basename(path) != "mesh.py":
+            continue
+        for stmt in mod.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id.endswith("_AXIS")
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                axes.append(stmt.value.value)
+                names.add(stmt.targets[0].id)
+    return (tuple(axes) if axes else DEFAULT_AXES,
+            names or {"DATA_AXIS", "REPLICA_AXIS", "MODEL_AXIS"})
+
+
+def load_module(path: str, rel: str) -> Optional[ModuleInfo]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    lines = source.splitlines()
+    mf = ModuleFunctions(rel, tree)
+    return ModuleInfo(
+        path=rel, tree=tree, source_lines=lines, mf=mf,
+        functions=mf.functions,
+        suppressions=collect_suppressions(lines),
+        has_x64_guard=("jax_enable_x64" in source or "enable_x64" in source))
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def _relpath(path: str, roots: Sequence[str]) -> str:
+    """Repo-relative stable path: relative to the parent of the analyzed
+    root so ``cycloneml_tpu/ml/...`` stays stable wherever the CLI runs."""
+    ap = os.path.abspath(path)
+    for r in roots:
+        base = os.path.dirname(os.path.abspath(r).rstrip(os.sep))
+        if ap.startswith(base + os.sep):
+            return os.path.relpath(ap, base).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def analyze_paths(paths: Sequence[str], rules=None,
+                  valid_axes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the rule pack over ``paths`` (files or directories).
+
+    Returns findings AFTER inline-suppression filtering, sorted by
+    (path, line). Baseline filtering is the caller's business
+    (:mod:`.baseline`) so reporters can show both views.
+    """
+    if rules is None:
+        from cycloneml_tpu.analysis.rules import default_rules
+        rules = default_rules()
+
+    modules: Dict[str, ModuleInfo] = {}
+    for f in collect_files(paths):
+        mod = load_module(f, _relpath(f, paths))
+        if mod is not None:
+            modules[mod.path] = mod
+    compute_reachability(modules)
+
+    axes, axis_names = _discover_axes(modules)
+    ctx = AnalysisContext(
+        modules=modules,
+        valid_axes=tuple(valid_axes) if valid_axes is not None else axes,
+        axis_constant_names=axis_names)
+
+    findings: List[Finding] = []
+    for mod in modules.values():
+        for rule in rules:
+            for finding in rule.check(mod, ctx):
+                suppressed = mod.suppressions.get(finding.line, set())
+                if finding.rule in suppressed or "ALL" in suppressed:
+                    continue
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,300 @@
+"""Jit-reachability: which functions run under a JAX trace.
+
+The rule pack must fire inside traced code and stay quiet in host driver
+code (a ``float()`` in a result-postprocessing loop is correct; the same
+``float()`` inside a jitted aggregator is a silent per-step device sync).
+This is a deliberately simple call-graph pass, not a type system:
+
+Seeds
+-----
+1. ``@jax.jit`` / ``@jit`` / ``@pjit`` / ``@pmap`` decorated functions
+   (including ``functools.partial(jax.jit, ...)`` decorator forms).
+2. Functions passed by name to a tracing entry point anywhere in their
+   module: ``jax.jit(f)``, ``shard_map(f, ...)``, ``lax.while_loop(c, b,
+   ...)``, ``dataset.tree_aggregate_fn(f)``, ``jax.grad(f)``, ...
+3. Functions whose own body (not nested defs) calls ``jax.lax.*`` —
+   collectives and control-flow primitives only run traced.
+4. Returned kernel closures: a nested function that its enclosing factory
+   returns and whose body does jnp/jax math. This is how every block
+   aggregator in ``ml/optim/aggregators.py`` reaches ``tree_aggregate``
+   (the factory's *caller* passes the closure in, which a name-based
+   graph cannot see).
+
+Propagation
+-----------
+``f -> g`` edges when ``f``'s body calls ``g`` resolved through (in
+order): the lexical scope chain (nested siblings / enclosing function
+locals), same-class methods via ``self.m()`` / ``cls.m()``, module-level
+functions, and explicit ``from mod import name`` imports across the
+analyzed file set. There is NO global match-any-same-name fallback —
+a false edge would spray host-only rules across driver code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
+                                            dotted_name, iter_own_statements,
+                                            last_component)
+
+JIT_DECORATORS = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+                  "jax.experimental.pjit.pjit", "partial_jit"}
+
+# call targets whose function-valued arguments are traced
+TRACING_ENTRYPOINTS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "shard_map", "shard_map_compat", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "remat", "checkpoint",
+    "custom_vjp", "custom_jvp", "named_call", "tree_aggregate",
+    "tree_aggregate_fn", "tree_aggregate_with_state", "all_gather_hosts",
+}
+
+
+class ModuleFunctions(ast.NodeVisitor):
+    """Collect FunctionInfo for every def in one module, with lexical
+    nesting, per-function call lists, and tracer-argument sightings."""
+
+    def __init__(self, module_path: str, tree: ast.Module):
+        self.module_path = module_path
+        self.functions: List[FunctionInfo] = []
+        # names seen as fn-valued args to tracing entry points, scoped to
+        # the enclosing function ("" = module level)
+        self.traced_args: Set[tuple] = set()
+        self._fn_stack: List[FunctionInfo] = []
+        self._class_stack: List[str] = []
+        self.imports: Dict[str, str] = {}   # local name -> source module
+        self.visit(tree)
+        # module-level `go = jax.jit(fn)` style wrapping
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                if name and last_component(name) in TRACING_ENTRYPOINTS:
+                    for arg in (list(sub.args)
+                                + [kw.value for kw in sub.keywords]):
+                        if isinstance(arg, ast.Name):
+                            self.traced_args.add(("", arg.id))
+
+    # -- scope bookkeeping ---------------------------------------------------
+    def _qualname(self, name: str) -> str:
+        parts = [f.qualname for f in self._fn_stack[-1:]]
+        if parts:
+            return f"{parts[0]}.{name}"
+        if self._class_stack:
+            return ".".join(self._class_stack + [name])
+        return name
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        for alias in node.names:
+            self.imports[alias.asname or alias.name] = (
+                f"{node.module}.{alias.name}" if node.module else alias.name)
+
+    def visit_Import(self, node: ast.Import):
+        # `import pkg.mod as m` binds m -> pkg.mod, giving m.f() an edge;
+        # un-aliased `import pkg.mod` binds only the top package — skip
+        for alias in node.names:
+            if alias.asname:
+                self.imports[alias.asname] = alias.name
+
+    def _visit_function(self, node):
+        parent = self._fn_stack[-1] if self._fn_stack else None
+        info = FunctionInfo(
+            qualname=self._qualname(node.name), node=node,
+            module_path=self.module_path, parent=parent,
+            class_name=self._class_stack[-1] if self._class_stack else None)
+        args = node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            info.params.add(a.arg)
+        info.is_jit_decorated = any(
+            self._decorator_is_jit(d) for d in node.decorator_list)
+        self._scan_body(info)
+        self.functions.append(info)
+        self._fn_stack.append(info)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    @staticmethod
+    def _decorator_is_jit(dec: ast.AST) -> bool:
+        name = dotted_name(dec)
+        if name in JIT_DECORATORS:
+            return True
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name in JIT_DECORATORS:       # @jax.jit(static_argnums=...)
+                return True
+            if name and last_component(name) == "partial" and dec.args:
+                return dotted_name(dec.args[0]) in JIT_DECORATORS
+        return False
+
+    def _scan_body(self, info: FunctionInfo) -> None:
+        scope = info.parent.qualname if info.parent else ""
+        has_jnp_math = False
+        for sub in iter_own_statements(info.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = call_name(sub)
+            if not name:
+                continue
+            info.calls.add(name)
+            if name.startswith(("jax.lax.", "lax.")):
+                info.has_lax_call = True
+            if name.startswith(("jnp.", "jax.numpy.", "jax.nn.",
+                                "jax.scipy.", "jax.random.")):
+                has_jnp_math = True
+            if last_component(name) in TRACING_ENTRYPOINTS:
+                for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                    if isinstance(arg, ast.Name):
+                        self.traced_args.add((scope_key(info), arg.id))
+                        self.traced_args.add((scope, arg.id))
+        # returned kernel closure: nested + returned + jnp math
+        if info.parent is not None and has_jnp_math:
+            parent_returns = _names_in_returns(info.parent.node)
+            fname = getattr(info.node, "name", None)
+            if fname and fname in parent_returns:
+                info.is_returned_kernel = True
+
+
+def scope_key(info: FunctionInfo) -> str:
+    return info.qualname
+
+
+def _names_in_returns(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in iter_own_statements(fn_node):
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            for n in ast.walk(stmt.value):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def compute_reachability(modules: Dict[str, "object"]) -> None:
+    """Mark ``jit_reachable`` on every FunctionInfo across the file set.
+
+    ``modules`` maps path -> ModuleInfo (engine.ModuleInfo: needs
+    ``.functions`` (List[FunctionInfo]), ``.mf`` (ModuleFunctions)).
+    """
+    # resolution tables
+    by_module_toplevel: Dict[str, Dict[str, FunctionInfo]] = {}
+    by_module_class: Dict[str, Dict[str, FunctionInfo]] = {}
+    for path, mod in modules.items():
+        top: Dict[str, FunctionInfo] = {}
+        meth: Dict[str, FunctionInfo] = {}
+        for fn in mod.functions:
+            simple = fn.qualname.rsplit(".", 1)[-1]
+            if fn.parent is None and fn.class_name is None:
+                top[simple] = fn
+            if fn.class_name is not None and fn.parent is None:
+                meth[f"{fn.class_name}.{simple}"] = fn
+                meth.setdefault(simple, fn)
+        by_module_toplevel[path] = top
+        by_module_class[path] = meth
+
+    # module-name index for `from pkg.mod import f` resolution
+    modname_to_path: Dict[str, str] = {}
+    for path in modules:
+        dotted = path[:-3].replace("/", ".") if path.endswith(".py") else path
+        modname_to_path[dotted] = path
+        if dotted.endswith(".__init__"):
+            modname_to_path[dotted[: -len(".__init__")]] = path
+
+    # parent qualname -> nested children, built once per module (resolve()
+    # runs once per call edge — rebuilding this there would be O(F*E))
+    children_by_module: Dict[str, Dict[str, List[FunctionInfo]]] = {}
+    for path, mod in modules.items():
+        children: Dict[str, List[FunctionInfo]] = {}
+        for fn in mod.functions:
+            if fn.parent is not None:
+                children.setdefault(fn.parent.qualname, []).append(fn)
+        children_by_module[path] = children
+
+    def resolve(caller: FunctionInfo, mod, callee: str) -> List[FunctionInfo]:
+        simple = last_component(callee)
+        # scope chain: nested siblings and enclosing functions' children
+        scope = caller
+        children = children_by_module[caller.module_path]
+        while scope is not None:
+            for child in children.get(scope.qualname, []):
+                if child.qualname.rsplit(".", 1)[-1] == simple:
+                    return [child]
+            scope = scope.parent
+        # self.method() / cls.method()
+        if callee.startswith(("self.", "cls.")) and caller.class_name:
+            hit = by_module_class[caller.module_path].get(
+                f"{caller.class_name}.{simple}")
+            if hit is not None:
+                return [hit]
+        # module-level function, same module
+        hit = by_module_toplevel[caller.module_path].get(simple)
+        if hit is not None and "." not in callee:
+            return [hit]
+        # explicit from-import
+        src = mod.mf.imports.get(simple if "." not in callee
+                                 else callee.split(".", 1)[0])
+        if src is not None:
+            if "." in callee:  # `import pkg.mod as m; m.f()`
+                target_mod, target_fn = src, simple
+            else:
+                target_mod, _, target_fn = src.rpartition(".")
+            tpath = modname_to_path.get(target_mod)
+            if tpath is not None:
+                hit = by_module_toplevel[tpath].get(target_fn)
+                if hit is not None:
+                    return [hit]
+        return []
+
+    # seeds
+    worklist: List[FunctionInfo] = []
+    for path, mod in modules.items():
+        for fn in mod.functions:
+            simple = fn.qualname.rsplit(".", 1)[-1]
+            scope = fn.parent.qualname if fn.parent else ""
+            if (scope, simple) in mod.mf.traced_args:
+                fn.passed_to_tracer = True
+            if (fn.is_jit_decorated or fn.passed_to_tracer
+                    or fn.has_lax_call or fn.is_returned_kernel):
+                fn.jit_reachable = True
+                worklist.append(fn)
+
+    # propagate: call edges + nesting (a function nested inside traced
+    # code is itself traced when called — closures are near-always called
+    # by their creator's trace). Interleaved to a fixpoint: a closure
+    # reached only through the nesting rule must still propagate to ITS
+    # callees.
+    while True:
+        while worklist:
+            fn = worklist.pop()
+            mod = modules[fn.module_path]
+            for callee in fn.calls:
+                for target in resolve(fn, mod, callee):
+                    if not target.jit_reachable:
+                        target.jit_reachable = True
+                        worklist.append(target)
+        for mod in modules.values():
+            for fn in mod.functions:
+                if fn.jit_reachable:
+                    continue
+                p = fn.parent
+                while p is not None:
+                    if p.jit_reachable:
+                        fn.jit_reachable = True
+                        worklist.append(fn)
+                        break
+                    p = p.parent
+        if not worklist:
+            break

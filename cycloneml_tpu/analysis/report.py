@@ -1,0 +1,36 @@
+"""Finding reporters: human-readable text and machine-readable JSON."""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import List, Optional
+
+from cycloneml_tpu.analysis.engine import Finding
+
+
+def render_text(findings: List[Finding], grandfathered: int = 0,
+                total_files: Optional[int] = None) -> str:
+    lines = []
+    for f in findings:
+        where = f"  [{f.function}]" if f.function else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}{where}")
+    by_rule = collections.Counter(f.rule for f in findings)
+    summary = ", ".join(f"{r}×{n}" for r, n in sorted(by_rule.items()))
+    tail = f"{len(findings)} finding(s)"
+    if summary:
+        tail += f" ({summary})"
+    if grandfathered:
+        tail += f"; {grandfathered} baselined"
+    if total_files is not None:
+        tail += f"; {total_files} file(s) scanned"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding], grandfathered: int = 0) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict() for f in findings],
+         "grandfathered": grandfathered,
+         "count": len(findings)},
+        indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,32 @@
+"""graftlint rule registry.
+
+Adding a rule: subclass :class:`~cycloneml_tpu.analysis.rules.base.Rule`,
+give it the next ``JXnnn`` id, and list it here. Each rule ships with a
+paired should-flag / should-pass fixture under
+``tests/fixtures/graftlint/`` pinning its precision.
+"""
+
+from cycloneml_tpu.analysis.rules.base import Rule
+from cycloneml_tpu.analysis.rules.jx001_host_sync import HostSyncRule
+from cycloneml_tpu.analysis.rules.jx002_traced_control_flow import \
+    TracedControlFlowRule
+from cycloneml_tpu.analysis.rules.jx003_prng_reuse import PRNGReuseRule
+from cycloneml_tpu.analysis.rules.jx004_fp64_drift import FP64DriftRule
+from cycloneml_tpu.analysis.rules.jx005_collective_axes import \
+    CollectiveAxisRule
+from cycloneml_tpu.analysis.rules.jx006_jit_mutation import JitMutationRule
+
+ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
+             FP64DriftRule, CollectiveAxisRule, JitMutationRule)
+
+
+def default_rules():
+    return [cls() for cls in ALL_RULES]
+
+
+def rules_by_id(ids):
+    wanted = {i.strip().upper() for i in ids}
+    return [cls() for cls in ALL_RULES if cls.rule_id in wanted]
+
+
+__all__ = ["Rule", "ALL_RULES", "default_rules", "rules_by_id"]

@@ -1,0 +1,26 @@
+"""Rule protocol: one class per rule id, registered in rules/__init__."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+
+
+class Rule:
+    """A graftlint rule. Subclasses set ``rule_id`` and implement
+    :meth:`check` yielding findings for one module (cross-module context
+    arrives via ``ctx``)."""
+
+    rule_id: str = "JX000"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str,
+                function: str = "") -> Finding:
+        return Finding(rule=self.rule_id, path=mod.path,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, function=function)

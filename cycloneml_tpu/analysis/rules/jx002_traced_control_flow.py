@@ -1,0 +1,55 @@
+"""JX002 — Python control flow on a traced value.
+
+Inside jit-reachable code, a Python ``if``/``while`` whose condition
+depends on a traced value raises ``TracerBoolConversionError`` at trace
+time — or, when the function happens to run eagerly first, silently
+specializes the trace to one branch. ``jax.lax.cond`` /
+``jax.lax.while_loop`` / ``jnp.where`` are the staged equivalents.
+
+Deliberately NOT flagged (static under tracing):
+- conditions over closure variables / constants (``if fit_intercept:``),
+- ``x is None`` / ``x is not None`` (a tracer is never None),
+- shape/dtype/ndim reads (``if x.ndim == 2:``) — static metadata,
+- ``isinstance`` / ``hasattr`` / ``len`` guards.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cycloneml_tpu.analysis.astutil import TaintTracker, iter_own_statements
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+
+class TracedControlFlowRule(Rule):
+    rule_id = "JX002"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if not fn.jit_reachable:
+                continue
+            taint = TaintTracker(fn.node, seed_params=fn.params_traced)
+            for node in iter_own_statements(fn.node):
+                if isinstance(node, ast.If) and taint.expr_tainted(node.test):
+                    yield self.finding(
+                        mod, node,
+                        "Python `if` on a traced value inside jit-reachable "
+                        "code; use `jax.lax.cond` / `jnp.where` (or hoist "
+                        "the decision to a static argument)",
+                        fn.qualname)
+                elif isinstance(node, ast.While) \
+                        and taint.expr_tainted(node.test):
+                    yield self.finding(
+                        mod, node,
+                        "Python `while` on a traced value inside "
+                        "jit-reachable code; use `jax.lax.while_loop`",
+                        fn.qualname)
+                elif isinstance(node, ast.Assert) \
+                        and taint.expr_tainted(node.test):
+                    yield self.finding(
+                        mod, node,
+                        "`assert` on a traced value inside jit-reachable "
+                        "code; use `checkify` or validate outside the trace",
+                        fn.qualname)

@@ -1,0 +1,204 @@
+"""JX003 — PRNG key reuse.
+
+JAX keys are consumed, not mutated: passing the same key to two
+``jax.random.*`` draws yields IDENTICAL (perfectly correlated) samples —
+the classic silent-correctness bug in sampling loops (minibatch masks,
+negative sampling, dropout). Keys must be threaded through
+``jax.random.split`` / ``fold_in``.
+
+Two detection shapes, both per function:
+
+1. sequential reuse: the same key name consumed by two draw calls with no
+   intervening reassignment (``split``/``fold_in`` rebinding counts);
+2. loop reuse: a draw inside a ``for``/``while`` body consuming a key
+   that is neither assigned inside the loop body nor derived per
+   iteration — every iteration then draws the same numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from cycloneml_tpu.analysis.astutil import (assigned_names, call_name,
+                                            last_component)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+KEY_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+                 "clone"}
+# jax.random draws that CONSUME their key argument (first positional)
+NON_CONSUMING = {"PRNGKey", "key", "split", "fold_in", "key_data",
+                 "wrap_key_data", "key_impl", "clone"}
+# JAX key-threading modules ONLY. Deliberately NOT bare `random` or a
+# generic `.random` suffix: `np.random.*` / stdlib `random.*` are STATEFUL
+# RNGs whose repeated calls draw fresh samples — matching them would turn
+# every `np.random.choice(xs)` pair into a bogus "key reuse" finding.
+# (`import jax.random as random` is a miss we accept; the repo uses
+# `jax.random` / `jrandom`.)
+RANDOM_MODULES = ("jax.random", "jrandom", "jr")
+
+
+def _is_random_call(name: Optional[str]) -> bool:
+    if not name or "." not in name:
+        return False
+    mod, _, fn = name.rpartition(".")
+    return mod in RANDOM_MODULES or mod.endswith("jax.random")
+
+
+class PRNGReuseRule(Rule):
+    rule_id = "JX003"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        for fn in mod.functions:
+            yield from self._check_function(mod, fn)
+
+    def _check_function(self, mod: ModuleInfo, fn) -> Iterator[Finding]:
+        body = list(getattr(fn.node, "body", []))
+        yield from self._scan_block(mod, fn, body, consumed=set(),
+                                    key_names=set(), flagged=set())
+
+    def _scan_block(self, mod: ModuleInfo, fn, stmts: List[ast.stmt],
+                    consumed: Set[str], key_names: Set[str],
+                    flagged: Set[int]):
+        """Linear scan in source order; recurses into compound statements.
+        ``consumed``: key names already used by one draw. ``key_names``:
+        names known to hold PRNG keys. ``flagged``: ids of call nodes
+        already reported (loop check + sequential scan overlap)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                body_assigned = self._names_assigned(stmt.body)
+                if isinstance(stmt, ast.For):
+                    # `for key in jax.random.split(key, n):` rebinds the
+                    # key per iteration — the idiomatic fan-out
+                    body_assigned |= set(assigned_names(stmt.target))
+                for node in self._calls_in(stmt.body):
+                    key = self._consumed_key(node)
+                    if key is None or id(node) in flagged:
+                        continue
+                    # a name a jax.random draw consumes IS a key — so a
+                    # function parameter counts even before any assignment
+                    if (key in key_names or key in fn.params) \
+                            and key not in body_assigned:
+                        yield self.finding(
+                            mod, node,
+                            f"PRNG key `{key}` drawn from inside a loop "
+                            f"without per-iteration `split`/`fold_in` — "
+                            f"every iteration gets identical samples",
+                            fn.qualname)
+                        flagged.add(id(node))
+                        consumed.add(key)
+                # also run the sequential scan inside the body
+                yield from self._scan_block(mod, fn, stmt.body, consumed,
+                                            key_names, flagged)
+                continue
+            if isinstance(stmt, ast.If):
+                yield from self._scan_calls(mod, fn, [stmt.test], consumed,
+                                            key_names, flagged)
+                # mutually exclusive branches: at most ONE executes, so a
+                # draw per branch is not reuse — scan each against the
+                # pre-branch state, then merge (may-consumed afterwards)
+                snap = set(consumed)
+                yield from self._scan_block(mod, fn, stmt.body, consumed,
+                                            key_names, flagged)
+                yield from self._scan_block(mod, fn, stmt.orelse, snap,
+                                            key_names, flagged)
+                consumed.update(snap)
+                continue
+            if isinstance(stmt, (ast.With, ast.Try)):
+                inner = list(getattr(stmt, "body", []))
+                for h in getattr(stmt, "handlers", []):
+                    inner.extend(h.body)
+                inner.extend(getattr(stmt, "orelse", []))
+                inner.extend(getattr(stmt, "finalbody", []))
+                yield from self._scan_block(mod, fn, inner, consumed,
+                                            key_names, flagged)
+                continue
+            # assignments: key production / rebinding clears consumption
+            if isinstance(stmt, ast.Assign):
+                names = [n for t in stmt.targets for n in assigned_names(t)]
+                produced = self._produces_key(stmt.value)
+                for n in names:
+                    consumed.discard(n)
+                    if produced:
+                        key_names.add(n)
+            # draws anywhere in this simple statement
+            yield from self._scan_calls(mod, fn, [stmt], consumed,
+                                        key_names, flagged)
+
+    def _scan_calls(self, mod: ModuleInfo, fn, nodes, consumed: Set[str],
+                    key_names: Set[str], flagged: Set[int]):
+        for node in self._calls_in(nodes):
+            key = self._consumed_key(node)
+            if key is None or id(node) in flagged:
+                continue
+            if key in consumed:
+                yield self.finding(
+                    mod, node,
+                    f"PRNG key `{key}` reused by a second `jax.random` "
+                    f"draw without `split`/`fold_in` — the two draws are "
+                    f"perfectly correlated",
+                    fn.qualname)
+                flagged.add(id(node))
+            consumed.add(key)
+            key_names.add(key)
+
+    @staticmethod
+    def _walk_pruned(stmts: List[ast.stmt]):
+        """Every node under ``stmts`` EXCLUDING subtrees of nested
+        function/lambda/class defs (ast.walk's `continue` would still
+        descend — the skip must happen at enqueue time)."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @classmethod
+    def _calls_in(cls, stmts: List[ast.stmt]) -> List[ast.Call]:
+        return [n for n in cls._walk_pruned(stmts)
+                if isinstance(n, ast.Call)]
+
+    @classmethod
+    def _names_assigned(cls, stmts: List[ast.stmt]) -> Set[str]:
+        out: Set[str] = set()
+        for node in cls._walk_pruned(stmts):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    out.update(assigned_names(t))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                out.update(assigned_names(node.target))
+        return out
+
+    @staticmethod
+    def _consumed_key(call: ast.Call) -> Optional[str]:
+        """Name of the key consumed by this jax.random draw, if any."""
+        name = call_name(call)
+        if not _is_random_call(name):
+            return None
+        if last_component(name) in NON_CONSUMING:
+            return None
+        args = list(call.args)
+        key_arg = args[0] if args else None
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+        if isinstance(key_arg, ast.Name):
+            return key_arg.id
+        return None
+
+    @staticmethod
+    def _produces_key(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if _is_random_call(name) \
+                        and last_component(name) in KEY_PRODUCERS:
+                    return True
+        return False

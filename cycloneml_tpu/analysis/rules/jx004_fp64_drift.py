@@ -1,0 +1,75 @@
+"""JX004 — fp64 literal/dtype drift in device code.
+
+Without ``jax.config.update("jax_enable_x64", True)``, JAX silently
+downcasts every float64 request to float32 — so device code that asks
+for ``jnp.float64`` / ``dtype="float64"`` is either a silent downcast
+(TPU default) or, where x64 IS enabled, a 2x memory + severe MXU perf
+hit smuggled into a hot path. Either way an explicit module-level guard
+(any mention of ``jax_enable_x64``) is required context for fp64 in
+jit-reachable code; absent that, it's flagged.
+
+``np.float64`` on the HOST side (optimizer state, readbacks) is idiomatic
+and untouched — only jit-reachable functions are scanned.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from cycloneml_tpu.analysis.astutil import (call_name, dotted_name,
+                                            iter_own_statements)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+F64_DOTTED = {"jnp.float64", "jax.numpy.float64", "np.float64",
+              "numpy.float64", "jnp.complex128", "jax.numpy.complex128"}
+F64_STRINGS = {"float64", "f64", "complex128"}
+
+
+class FP64DriftRule(Rule):
+    rule_id = "JX004"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        if mod.has_x64_guard:
+            return
+        for fn in mod.functions:
+            if not fn.jit_reachable:
+                continue
+            for node in iter_own_statements(fn.node):
+                hit = self._f64_use(node)
+                if hit:
+                    yield self.finding(
+                        mod, node,
+                        f"{hit} in jit-reachable code without a "
+                        f"`jax_enable_x64` guard in the module — silently "
+                        f"downcast to float32 on default TPU configs (or a "
+                        f"2x HBM + MXU perf hit where x64 is on); pass the "
+                        f"dtype in from the data tier or guard the module",
+                        fn.qualname)
+
+    @staticmethod
+    def _f64_use(node: ast.AST) -> Optional[str]:
+        # dtype=<f64> keyword or positional dtype constants
+        if isinstance(node, ast.keyword) and node.arg == "dtype":
+            v = node.value
+            name = dotted_name(v)
+            if name in F64_DOTTED:
+                return f"`dtype={name}`"
+            if isinstance(v, ast.Constant) and v.value in F64_STRINGS:
+                return f'`dtype="{v.value}"`'
+            return None
+        # direct casts: jnp.float64(x) / x.astype("float64")
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in F64_DOTTED:
+                return f"`{name}(...)` cast"
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args):
+                arg = node.args[0]
+                aname = dotted_name(arg)
+                if aname in F64_DOTTED:
+                    return f"`.astype({aname})`"
+                if isinstance(arg, ast.Constant) and arg.value in F64_STRINGS:
+                    return f'`.astype("{arg.value}")`'
+        return None

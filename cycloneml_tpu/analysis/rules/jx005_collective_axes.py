@@ -1,0 +1,91 @@
+"""JX005 — collective axis names must exist on the mesh.
+
+``jax.lax.psum(x, "dta")`` raises a NameError-like failure only when the
+program is actually traced inside a ``shard_map``/``pmap`` with that axis
+— i.e. at runtime, on the device path, possibly only on the multihost
+config that CI doesn't run. The mesh axes are declared exactly once
+(``cycloneml_tpu/mesh.py``: ``DATA_AXIS``/``REPLICA_AXIS``/
+``MODEL_AXIS``), so every string-literal axis name handed to a collective
+is checked against them at lint time.
+
+Variables are skipped unless they can be resolved: a ``Name``/
+``Attribute`` whose final component is one of the declared
+``*_AXIS`` constants passes; anything else dynamic is ignored (the rule
+is for typos, not dataflow).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from cycloneml_tpu.analysis.astutil import call_name, dotted_name, \
+    iter_own_statements, last_component
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+# collective -> index of the positional axis-name argument
+COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+               "all_gather": 1, "ppermute": 1, "pshuffle": 1,
+               "psum_scatter": 1, "all_to_all": 1, "axis_index": 0,
+               "axis_size": 0, "pbroadcast": 1}
+# only axis_name NAMES a mesh axis; `axis=` on all_gather/all_to_all/
+# psum_scatter is the integer ARRAY axis and must not shadow the
+# positional name slot
+AXIS_KWARGS = ("axis_name",)
+
+
+class CollectiveAxisRule(Rule):
+    rule_id = "JX005"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        valid = set(ctx.valid_axes)
+        const_names = set(ctx.axis_constant_names)
+        for fn in mod.functions:
+            for node in iter_own_statements(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not name or not name.startswith(("jax.lax.", "lax.")):
+                    continue
+                op = last_component(name)
+                if op not in COLLECTIVES:
+                    continue
+                axis_arg = self._axis_argument(node, COLLECTIVES[op])
+                if axis_arg is None:
+                    continue
+                for bad in self._invalid_axes(axis_arg, valid, const_names):
+                    yield self.finding(
+                        mod, node,
+                        f"`{op}` over unknown mesh axis {bad!r}; declared "
+                        f"axes are {sorted(valid)} (mesh.py) — a typo here "
+                        f"only fails at trace time on the device path",
+                        fn.qualname)
+
+    @staticmethod
+    def _axis_argument(call: ast.Call, pos: int) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg in AXIS_KWARGS:
+                return kw.value
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None
+
+    @staticmethod
+    def _invalid_axes(node: ast.AST, valid, const_names) -> List[str]:
+        """Invalid string-literal axis names in ``node`` (tuple/list of
+        axes checked element-wise; unresolvable dynamics skipped)."""
+        items = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+            else [node]
+        bad: List[str] = []
+        for item in items:
+            if isinstance(item, ast.Constant) and isinstance(item.value, str):
+                if item.value not in valid:
+                    bad.append(item.value)
+                continue
+            name = dotted_name(item)
+            if name is not None:
+                final = last_component(name)
+                if final.endswith("_AXIS") and final not in const_names:
+                    bad.append(final)
+        return bad

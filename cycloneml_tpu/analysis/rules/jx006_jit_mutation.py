@@ -1,0 +1,75 @@
+"""JX006 — jitted function mutating ``self`` / ``global`` / ``nonlocal``.
+
+A side effect inside traced code runs exactly once, at trace time, then
+never again: ``self.n_steps += 1`` inside a jitted step silently freezes
+at its trace-time value while every cached re-execution skips it. The
+same applies to ``global``/``nonlocal`` rebinding and to in-place
+container mutation of ``self`` attributes. State must flow through the
+function's arguments/returns (the carry), or live on the host side of
+the dispatch boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from cycloneml_tpu.analysis.astutil import assigned_names, iter_own_statements
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+MUTATING_METHODS = {"append", "extend", "insert", "add", "update", "pop",
+                    "remove", "clear", "setdefault", "discard"}
+
+
+class JitMutationRule(Rule):
+    rule_id = "JX006"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if not fn.jit_reachable:
+                continue
+            declared: Set[str] = set()
+            for node in iter_own_statements(fn.node):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    declared.update(node.names)
+            for node in iter_own_statements(fn.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if self._is_self_attribute(t):
+                            yield self.finding(
+                                mod, node,
+                                "assignment to `self.*` inside jit-reachable "
+                                "code runs once at trace time and then "
+                                "silently freezes; thread state through the "
+                                "carry/returns instead",
+                                fn.qualname)
+                        else:
+                            hit = declared.intersection(assigned_names(t))
+                            if hit:
+                                yield self.finding(
+                                    mod, node,
+                                    f"rebinding global/nonlocal "
+                                    f"{sorted(hit)} inside jit-reachable "
+                                    f"code is a trace-time-only side "
+                                    f"effect; return the value instead",
+                                    fn.qualname)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in MUTATING_METHODS \
+                        and self._is_self_attribute(node.func.value):
+                    yield self.finding(
+                        mod, node,
+                        f"`self.*.{node.func.attr}(...)` inside "
+                        f"jit-reachable code mutates host state at trace "
+                        f"time only; accumulate through the carry instead",
+                        fn.qualname)
+
+    @staticmethod
+    def _is_self_attribute(node: ast.AST) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in ("self", "cls")

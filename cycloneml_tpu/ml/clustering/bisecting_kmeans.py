@@ -102,7 +102,8 @@ class BisectingKMeans(Estimator, _BKMParams, MLWritable, MLReadable):
                     "cost": jnp.sum(w * d2)}
 
         root_agg = ds.tree_aggregate_fn(root_stats)
-        out = root_agg(jnp.zeros(ds.n_features, dtype))
+        # one transfer for all root stats, not one per field (graftlint JX001)
+        out = jax.device_get(root_agg(jnp.zeros(ds.n_features, dtype)))
         total_n = float(out["count"])
         root_center = np.asarray(out["sum"], np.float64) / max(
             float(out["wsum"]), 1e-300)

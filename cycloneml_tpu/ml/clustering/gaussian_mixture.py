@@ -126,8 +126,10 @@ class GaussianMixture(Estimator, _GMMParams, MLWritable, MLReadable):
         it = 0
         for it in range(1, self.get("maxIter") + 1):
             chols = np.linalg.cholesky(covs + _MIN_COV_EIG * np.eye(d))
-            out = step(weights.astype(dtype), means.astype(dtype),
-                       chols.astype(dtype))
+            # one transfer for the whole EM stat pytree (graftlint JX001)
+            out = jax.device_get(step(weights.astype(dtype),
+                                      means.astype(dtype),
+                                      chols.astype(dtype)))
             rs = np.asarray(out["resp_sum"], dtype=np.float64)
             ms = np.asarray(out["mean_sum"], dtype=np.float64)
             sc = np.asarray(out["scatter"], dtype=np.float64)

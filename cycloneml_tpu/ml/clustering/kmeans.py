@@ -134,7 +134,8 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
         cost = float("inf")
         it = 0
         for it in range(1, self.get("maxIter") + 1):
-            out = step(centers.astype(dtype))
+            # one transfer per Lloyd step, not three (graftlint JX001)
+            out = jax.device_get(step(centers.astype(dtype)))
             counts = np.asarray(out["counts"], dtype=np.float64)
             sums = np.asarray(out["sums"], dtype=np.float64)
             cost = float(out["cost"])

@@ -166,7 +166,8 @@ class LDA(Estimator, _LDAParams, MLWritable, MLReadable):
         import jax.random as jrandom
         for t in range(self.get("maxIter")):
             key = jrandom.PRNGKey(self.get("seed") * 100003 + t)
-            out = step(jnp.asarray(lam, dtype=dtype), key)
+            # one transfer per E-step, not one per stat (graftlint JX001)
+            out = jax.device_get(step(jnp.asarray(lam, dtype=dtype), key))
             sstats = np.asarray(out["sstats"], np.float64)
             batch_docs = float(out["n_batch"])
             if batch_docs <= 0:

@@ -107,10 +107,13 @@ def train_fm(ds: InstanceDataset, d: int, loss_type: str, factor_size: int,
     for t in range(max_iter):
         key = jax.random.PRNGKey(seed * 65537 + t)
         out = run(coef_j, key)
-        wsum = float(out["wsum"])
+        # fetch ONLY the scalars, in one transfer (graftlint JX001);
+        # grad/wsum stay on device — they feed straight into apply_update
+        wsum, loss_sum = map(float, jax.device_get((out["wsum"],
+                                                    out["loss"])))
         if wsum <= 0:
             continue
-        loss = float(out["loss"]) / wsum
+        loss = loss_sum / wsum
         history.append(loss)
         coef_j, opt_state = apply_update(coef_j, opt_state, out["grad"],
                                          out["wsum"])

@@ -117,8 +117,9 @@ class GradientDescent:
                                       self.reg_param)
         updates = 0
         for t in range(1, self.num_iterations + 1):
-            out = compiled(jnp.asarray(w, jnp.float32),
-                           jnp.asarray(t, jnp.int32))
+            # one transfer for count+loss+grad, not three (graftlint JX001)
+            out = jax.device_get(compiled(jnp.asarray(w, jnp.float32),
+                                          jnp.asarray(t, jnp.int32)))
             count = float(out["count"])
             if count <= 0:
                 # empty mini-batch: no update, no history entry (the

@@ -563,8 +563,11 @@ class GeneralizedLinearRegression(Predictor, _GLRParams, MLWritable, MLReadable)
         history = []
         w_sum = float(w.sum())
         for it in range(max(max_iter, 1)):
-            out = agg(jnp.asarray(beta), jnp.asarray(icpt),
-                      jnp.asarray(1.0 if it == 0 else 0.0))
+            # one transfer for the whole IRLS stat pytree — this loop was
+            # paying NINE separate device->host round trips per iteration
+            # (graftlint JX001)
+            out = jax.device_get(agg(jnp.asarray(beta), jnp.asarray(icpt),
+                                     jnp.asarray(1.0 if it == 0 else 0.0)))
             xtx = np.asarray(out["xtx"], dtype=np.float64)
             xty = np.asarray(out["xty"], dtype=np.float64)
             if fit_icpt:

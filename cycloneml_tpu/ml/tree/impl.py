@@ -343,8 +343,11 @@ class BinnedDataset:
                 # side="left": bin = #thresholds < v, so v <= th[b] ⇔ bin <= b
                 # — matches the raw-feature rule "value <= threshold goes left"
                 return jnp.searchsorted(th, col, side="left").astype(jnp.int32)
+            # follow the thresholds' dtype (f64 under x64 tests, f32 on
+            # TPU) instead of requesting float64 outright — the latter is
+            # a silent downcast on default TPU configs (graftlint JX004)
             return jax.vmap(one, in_axes=(1, 0), out_axes=1)(
-                x.astype(jnp.float64), th_dev)
+                x.astype(th_dev.dtype), th_dev)
 
         rt = ds.ctx.mesh_runtime
         bins = jax.jit(binize, out_shardings=rt.data_sharding(extra_axes=1))(ds.x)

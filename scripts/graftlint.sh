@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# graftlint entry point — the exact invocation tier-1 enforces
+# (tests/test_graftlint.py::test_self_run_is_clean_modulo_baseline).
+# Usage: scripts/graftlint.sh [extra args...]   e.g. --json
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m cycloneml_tpu.analysis cycloneml_tpu \
+    --baseline cycloneml_tpu/analysis/baseline.json "$@"

@@ -1,0 +1,33 @@
+"""JX001 should-flag fixtures: implicit host syncs. Never imported."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_float_coercion(x):
+    # float() on a traced value inside a jitted function
+    scale = float(jnp.max(x))              # JX001
+    return x * scale
+
+
+@jax.jit
+def traced_item_pull(x):
+    total = jnp.sum(x)
+    return x / total.item()                # JX001
+
+
+@jax.jit
+def traced_host_materialize(x):
+    host = np.asarray(x * 2.0)             # JX001
+    return jnp.asarray(host)
+
+
+def piecemeal_driver(ds, coef):
+    run = ds.tree_aggregate_fn(lambda x, y, w, c: {"loss": 0.0})
+    for _ in range(10):
+        out = run(coef)
+        loss = float(out["loss"])          # pull 1
+        count = float(out["count"])        # pull 2 -> JX001 (batch them)
+        coef = coef - loss / count
+    return coef

@@ -1,0 +1,42 @@
+"""JX001 should-pass fixtures: legitimate host/device boundaries."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def stays_on_device(x):
+    # jnp math on traced values: no host sync anywhere
+    scale = jnp.max(x)
+    return x * scale
+
+
+@jax.jit
+def static_metadata_is_free(x):
+    # shape/ndim/dtype reads are static under tracing, and float() of a
+    # host config value is not a sync
+    rows = float(x.shape[0])
+    return x / rows
+
+
+def host_factory(d, fit_intercept):
+    # host-side coercions in a BUILDER (not jit-reachable) are fine
+    m = int(d) + int(bool(fit_intercept))
+    return np.zeros(m)
+
+
+def batched_driver(ds, coef):
+    run = ds.tree_aggregate_fn(lambda x, y, w, c: {"loss": 0.0})
+    for _ in range(10):
+        # ONE explicit transfer for the whole output pytree
+        out = jax.device_get(run(coef))
+        loss = float(out["loss"])
+        count = float(out["count"])
+        coef = coef - loss / count
+    return coef
+
+
+def single_pull_driver(ds, coef):
+    run = ds.tree_aggregate_fn(lambda x, y, w, c: {"loss": 0.0})
+    out = run(coef)
+    return float(out["loss"])  # a single conversion IS the one transfer

@@ -1,0 +1,27 @@
+"""JX002 should-flag fixtures: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_traced(x):
+    m = jnp.mean(x)
+    if m > 0:                       # JX002: traced comparison
+        return x - m
+    return x + m
+
+
+@jax.jit
+def loop_on_traced(x):
+    while jnp.sum(x) > 1.0:         # JX002: traced while condition
+        x = x * 0.5
+    return x
+
+
+def kernel_factory(d):
+    def kernel(x, coef):
+        margin = jnp.dot(x, coef)
+        if margin.sum() > 0:        # JX002: inside a returned jnp kernel
+            return margin
+        return -margin
+    return kernel

@@ -1,0 +1,34 @@
+"""JX002 should-pass fixtures: static branching that tracing allows."""
+import jax
+import jax.numpy as jnp
+
+
+def make_agg(d, fit_intercept):
+    def agg(x, y, w, coef):
+        if fit_intercept:                  # closure config: static per trace
+            beta, b0 = coef[:d], coef[d]
+        else:
+            beta, b0 = coef, 0.0
+        margin = jnp.dot(x, beta) + b0
+        return {"loss": jnp.sum(w * (margin - y) ** 2)}
+    return agg
+
+
+@jax.jit
+def shape_branch(x):
+    if x.ndim == 2:                        # static metadata
+        return x.sum(axis=1)
+    return x
+
+
+@jax.jit
+def optional_arg(x, mask=None):
+    if mask is None:                       # a tracer is never None
+        return x
+    return x * mask
+
+
+@jax.jit
+def staged_branch(x):
+    m = jnp.mean(x)
+    return jnp.where(m > 0, x - m, x + m)  # the staged equivalent

@@ -1,0 +1,29 @@
+"""JX003 should-flag fixtures: PRNG key reuse."""
+import jax
+import jax.numpy as jnp
+
+
+def sequential_reuse(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (8,))
+    b = jax.random.uniform(key, (8,))       # JX003: same key, second draw
+    return a + b
+
+
+def loop_reuse(seed, steps):
+    key = jax.random.PRNGKey(seed)
+    total = jnp.zeros((4,))
+    for _ in range(steps):
+        total += jax.random.normal(key, (4,))   # JX003: identical each iter
+    return total
+
+
+def one_line_reuse(key):
+    return jax.random.normal(key, (2,)), jax.random.uniform(key, (2,))  # JX003
+
+
+def param_key_loop_reuse(key, steps):
+    total = jnp.zeros((4,))
+    for _ in range(steps):
+        total += jax.random.normal(key, (4,))   # JX003: param key, no split
+    return total

@@ -1,0 +1,77 @@
+"""JX003 should-pass fixtures: correctly threaded keys."""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def split_between_draws(seed):
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (8,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (8,))
+    return a + b
+
+
+def split_in_loop(seed, steps):
+    key = jax.random.PRNGKey(seed)
+    total = jnp.zeros((4,))
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        total += jax.random.normal(sub, (4,))
+    return total
+
+
+def fold_in_per_step(seed, steps):
+    base = jax.random.PRNGKey(seed)
+    total = jnp.zeros((4,))
+    for t in range(steps):
+        step_key = jax.random.fold_in(base, t)   # derived, not reused
+        total += jax.random.normal(step_key, (4,))
+    return total
+
+
+def fresh_key_per_iteration(seed, steps):
+    total = jnp.zeros((4,))
+    for t in range(steps):
+        key = jax.random.PRNGKey(seed * 65537 + t)  # reassigned in body
+        total += jax.random.normal(key, (4,))
+    return total
+
+
+def split_fanout_loop(key, n):
+    # `for key in split(key, n)` rebinds the key per iteration
+    acc = 0.0
+    for key in jax.random.split(key, n):
+        acc += jax.random.normal(key)
+    return acc
+
+
+def nested_def_has_own_key(key, n):
+    # the draw consumes the nested function's parameter, not the
+    # enclosing loop's key
+    for t in range(n):
+        def sample(k):
+            return jax.random.normal(k)
+        sample(jax.random.fold_in(key, t))
+
+
+def one_draw_per_branch(key, symmetric):
+    # mutually exclusive branches: at most one draw executes per call
+    if symmetric:
+        return jax.random.normal(key, (2,))
+    else:
+        return jax.random.uniform(key, (2,))
+
+
+def stateful_rngs_are_not_keys(xs):
+    # np.random / stdlib random are STATEFUL — repeated calls draw fresh
+    # samples; they must never be mistaken for jax key consumption
+    a = np.random.choice(xs)
+    b = np.random.choice(xs)
+    c = random.choice(xs)
+    d = random.choice(xs)
+    rng = np.random.RandomState(0)
+    return a + b + c + d + rng.choice(xs) + rng.choice(xs)

@@ -1,0 +1,19 @@
+"""JX004 should-flag fixtures: fp64 drift in device code, no x64 guard."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f64_dtype_kwarg(x):
+    acc = jnp.zeros(x.shape, dtype=jnp.float64)     # JX004
+    return acc + x
+
+
+@jax.jit
+def f64_string_dtype(x):
+    return x.astype("float64")                       # JX004
+
+
+@jax.jit
+def f64_cast_call(x):
+    return jnp.float64(1.5) * x                      # JX004

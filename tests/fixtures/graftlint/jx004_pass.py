@@ -1,0 +1,23 @@
+"""JX004 should-pass fixtures: guarded or host-side fp64, data-tier dtype."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# module-level guard: fp64 below is a deliberate, visible choice
+jax.config.update("jax_enable_x64", True)
+
+
+@jax.jit
+def guarded_f64(x):
+    return jnp.zeros(x.shape, dtype=jnp.float64) + x
+
+
+@jax.jit
+def dtype_from_data(x):
+    # following the operand's dtype adapts to whatever the tier runs in
+    return jnp.zeros(x.shape, dtype=x.dtype) + x
+
+
+def host_readback(out):
+    # np.float64 on the HOST side of the boundary is idiomatic
+    return np.asarray(out, dtype=np.float64)

@@ -1,0 +1,24 @@
+"""JX005 should-flag fixtures: collective over an undeclared mesh axis."""
+import jax
+import jax.numpy as jnp
+
+
+def bad_axis_literal(x):
+    return jax.lax.psum(x, "dta")                       # JX005: typo
+
+
+def bad_axis_in_tuple(x):
+    return jax.lax.pmean(x, ("data", "replicas"))       # JX005: "replicas"
+
+
+def bad_axis_kwarg(x):
+    return jax.lax.all_gather(x, axis_name="batch")     # JX005
+
+
+def bad_axis_index():
+    return jax.lax.axis_index("modle")                  # JX005: typo
+
+
+def int_axis_kwarg_does_not_shadow(x):
+    # axis=0 is the integer ARRAY axis; the NAME is still positional
+    return jax.lax.all_gather(x, "dta", axis=0)         # JX005

@@ -1,0 +1,27 @@
+"""JX005 should-pass fixtures: declared axes and resolvable constants."""
+import jax
+import jax.numpy as jnp
+
+from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS
+
+
+def good_axis_literals(x):
+    x = jax.lax.psum(x, "data")
+    x = jax.lax.pmean(x, ("data", "replica"))
+    return jax.lax.pmax(x, "model")
+
+
+def good_axis_constants(x):
+    x = jax.lax.psum(x, DATA_AXIS)
+    return jax.lax.all_gather(x, REPLICA_AXIS)
+
+
+def dynamic_axis_is_skipped(x, axes):
+    # dataflow the rule does not attempt: variables pass through
+    for ax in axes:
+        x = jax.lax.psum(x, ax)
+    return x
+
+
+def good_axis_index():
+    return jax.lax.axis_index(MODEL_AXIS)

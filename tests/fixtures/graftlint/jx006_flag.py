@@ -1,0 +1,25 @@
+"""JX006 should-flag fixtures: trace-time-only side effects."""
+import jax
+import jax.numpy as jnp
+
+_calls = 0
+
+
+class Model:
+    def __init__(self):
+        self.n_steps = 0
+        self.history = []
+
+    @jax.jit
+    def step(self, x):
+        self.n_steps += 1                  # JX006: frozen after first trace
+        self.history.append(1)             # JX006: mutates host list at trace
+        self.last_loss: float = 0.0        # JX006: annotated, same hazard
+        return x * 2.0
+
+
+@jax.jit
+def bump_global(x):
+    global _calls
+    _calls = _calls + 1                    # JX006: trace-time only
+    return x + 1.0

@@ -1,0 +1,25 @@
+"""JX006 should-pass fixtures: state through the carry; host-side stats."""
+import jax
+import jax.numpy as jnp
+
+
+class Model:
+    def __init__(self):
+        self.n_steps = 0
+
+    def fit_step(self, x):
+        # host driver (NOT jitted) may mutate freely
+        self.n_steps += 1
+        return self._step(x)
+
+    @staticmethod
+    @jax.jit
+    def _step(x):
+        return x * 2.0
+
+
+@jax.jit
+def carry_state(carry, x):
+    # state flows through arguments and returns — the staged idiom
+    count, total = carry
+    return (count + 1, total + jnp.sum(x)), x * 2.0

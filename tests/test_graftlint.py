@@ -1,0 +1,179 @@
+"""graftlint: per-rule precision tests + the tier-1 self-run gate.
+
+Each rule has a paired should-flag / should-pass fixture under
+``tests/fixtures/graftlint/``. Flag fixtures carry a ``# JXnnn`` marker
+comment on every line the rule must report — the test asserts the
+reported line set EQUALS the marker line set, pinning both recall (no
+missed hazard) and precision (no extra noise) per rule.
+
+The gate test runs the analyzer over ``cycloneml_tpu/`` exactly the way
+the CLI does and fails on any finding not grandfathered in
+``cycloneml_tpu/analysis/baseline.json`` — this is the permanent CI gate
+for every future PR. Pure ``ast``: no jax import, no device work.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from cycloneml_tpu.analysis import analyze_paths
+from cycloneml_tpu.analysis.baseline import (apply_baseline, load_baseline,
+                                             write_baseline)
+from cycloneml_tpu.analysis.__main__ import main as graftlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+PACKAGE = os.path.join(REPO, "cycloneml_tpu")
+BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
+
+RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006")
+
+
+def marker_lines(path: str, rule: str):
+    """1-based lines carrying a `# <rule>` marker comment."""
+    pat = re.compile(rf"#.*{rule}")
+    with open(path) as fh:
+        return {i for i, line in enumerate(fh, 1) if pat.search(line)}
+
+
+def findings_for(path: str, rule: str):
+    return [f for f in analyze_paths([path]) if f.rule == rule]
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_flags_exactly_the_marked_lines(rule):
+    path = os.path.join(FIXTURES, f"{rule.lower()}_flag.py")
+    expected = marker_lines(path, rule)
+    assert expected, f"fixture {path} has no marker lines"
+    got = {f.line for f in findings_for(path, rule)}
+    assert got == expected, (
+        f"{rule}: flagged lines {sorted(got)} != marked {sorted(expected)}")
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_pass_fixture_is_totally_clean(rule):
+    # pass fixtures must be clean under the WHOLE pack, not just their
+    # own rule — a pass example for one rule must not trip another
+    path = os.path.join(FIXTURES, f"{rule.lower()}_pass.py")
+    findings = analyze_paths([path])
+    assert findings == [], [
+        f"{f.rule}@{f.line}: {f.message}" for f in findings]
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(jnp.max(x))  # graftlint: disable=JX001\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+
+
+def test_own_line_suppression_covers_next_line(tmp_path):
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # graftlint: disable=JX001\n"
+        "    return float(jnp.max(x))\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(jnp.max(x))  # graftlint: disable=JX002\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert [f.rule for f in analyze_paths([str(p)])] == ["JX001"]
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    flag = os.path.join(FIXTURES, "jx001_flag.py")
+    findings = analyze_paths([flag])
+    assert findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings)
+    new, grandfathered = apply_baseline(findings, load_baseline(str(bl)))
+    assert new == [] and grandfathered == len(findings)
+
+
+def test_baseline_does_not_cover_new_occurrences(tmp_path):
+    flag = os.path.join(FIXTURES, "jx001_flag.py")
+    findings = analyze_paths([flag])
+    bl = tmp_path / "baseline.json"
+    # grandfather all but one occurrence
+    write_baseline(str(bl), findings[:-1])
+    new, _ = apply_baseline(findings, load_baseline(str(bl)))
+    assert len(new) == 1
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    flag = os.path.join(FIXTURES, "jx002_flag.py")
+    assert graftlint_main([flag, "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] >= 1
+    assert all(f["rule"] == "JX002" for f in payload["findings"])
+
+    clean = os.path.join(FIXTURES, "jx002_pass.py")
+    assert graftlint_main([clean]) == 0
+
+    assert graftlint_main([]) == 2
+
+
+def test_cli_rule_subset(capsys):
+    flag = os.path.join(FIXTURES, "jx001_flag.py")
+    # jx001_flag also has no JX005 hazards; restricting to JX005 is clean
+    assert graftlint_main([flag, "--rules", "JX005"]) == 0
+    assert graftlint_main([flag, "--rules", "JX001"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_runs_as_module():
+    # the exact invocation docs/Makefile/CI use
+    proc = subprocess.run(
+        [sys.executable, "-m", "cycloneml_tpu.analysis", "cycloneml_tpu",
+         "--baseline", os.path.join("cycloneml_tpu", "analysis",
+                                    "baseline.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+def test_self_run_is_clean_modulo_baseline():
+    """The permanent gate: every non-baselined finding in cycloneml_tpu/
+    fails tier-1. Fix the hazard, or — only where a fix needs a design
+    change — regenerate the baseline (docs/graftlint.md)."""
+    findings = analyze_paths([PACKAGE])
+    new, _ = apply_baseline(findings, load_baseline(BASELINE))
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: {f.rule} {f.message}" for f in new)
+
+
+def test_mesh_axes_discovered_from_source():
+    """JX005 must validate against the axes mesh.py DECLARES, not a
+    hardcoded copy that could drift."""
+    from cycloneml_tpu.analysis.engine import (ModuleInfo, _discover_axes,
+                                               load_module)
+    mesh_py = os.path.join(PACKAGE, "mesh.py")
+    mod = load_module(mesh_py, "cycloneml_tpu/mesh.py")
+    axes, names = _discover_axes({mod.path: mod})
+    assert set(axes) == {"data", "replica", "model"}
+    assert names == {"DATA_AXIS", "REPLICA_AXIS", "MODEL_AXIS"}
