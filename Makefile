@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-json test
+.PHONY: lint lint-json test chaos
 
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
@@ -14,3 +14,7 @@ lint-json:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    --continue-on-collection-errors -p no:cacheprovider
+
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+	    -p no:cacheprovider

@@ -327,6 +327,18 @@ class CycloneContext:
                 self._heartbeats.start()
             return self._heartbeats
 
+    def mesh_supervisor(self, **kw):
+        """Degraded-mesh recovery supervisor wired to this context's
+        heartbeat receiver: worker loss (heartbeat expiry or a step's
+        DeviceLostError) → program-cache clear + mesh rebuild over the
+        survivors + re-shard + resume-from-checkpoint. Pass the result as
+        ``train_with_checkpoints(..., supervisor=...)``; see
+        docs/resilience.md for the failure model."""
+        from cycloneml_tpu.parallel.resilience import MeshSupervisor
+        sup = MeshSupervisor(self, **kw)
+        sup.attach(self.heartbeat_receiver)
+        return sup
+
     def start_ui(self, host: str = "127.0.0.1", port: int = 0):
         """Serve the live status web UI (≈ SparkUI.scala:40 — jobs/steps/
         failures over the status store). Returns the server; ``.url`` is the

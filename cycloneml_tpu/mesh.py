@@ -188,6 +188,30 @@ class MeshRuntime:
         return jax.device_put(tree, self.replicated())
 
 
+def safe_fit_parallelism(requested: int) -> int:
+    """Cap thread-parallel estimator fits for the active mesh.
+
+    Every jitted step is a gang-scheduled SPMD program over the WHOLE mesh;
+    two programs dispatched concurrently from different threads interleave
+    their per-device executions and deadlock XLA's collective rendezvous
+    (observed: OneVsRest(parallelism=4) hanging the suite on local-mesh[8]
+    once shard_map was un-broken). A >1 pool is therefore only honored on
+    single-device meshes, where no cross-device rendezvous exists; the
+    reference's ``parallelism`` param parallelizes independent Spark jobs
+    across a cluster, a resource this mesh model does not have.
+    """
+    if requested <= 1:
+        return requested
+    rt = active()
+    if rt is not None and rt.n_devices > 1:
+        logger.info(
+            "capping fit parallelism %d -> 1: concurrent SPMD dispatch "
+            "onto a shared %d-device mesh would deadlock its collectives",
+            requested, rt.n_devices)
+        return 1
+    return requested
+
+
 def probe_device_count(master: str) -> Optional[int]:
     """Devices a master URL would select, WITHOUT building a mesh — lets
     callers validate a resource request before tearing down the active mesh.

@@ -76,7 +76,8 @@ class OneVsRest(Estimator, _OVRParams, MLWritable, MLReadable):
                 clf.set("weightCol", wc)
             return clf.fit(sub)
 
-        par = self.get("parallelism")
+        from cycloneml_tpu.mesh import safe_fit_parallelism
+        par = safe_fit_parallelism(self.get("parallelism"))
         if par > 1:
             with cf.ThreadPoolExecutor(max_workers=par) as pool:
                 models = list(pool.map(fit_one, range(num_classes)))

@@ -109,6 +109,13 @@ class DistributedLossFunction:
                 not hasattr(self.l2_reg_fn, "traceable"):
             return None
         import jax
+
+        from cycloneml_tpu.parallel import faults
+
+        # the fused program dispatches the aggregation from INSIDE one XLA
+        # program, so the tree_aggregate-level injection point never sees
+        # these steps — fire it here, once per fused dispatch
+        faults.inject("collectives.step")
         arrays = self._agg_call.arrays()
         # line-search arithmetic follows the data tier's dtype: f32 on TPU,
         # f64 under x64 tests (where it then matches the host path exactly)

@@ -108,7 +108,8 @@ class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
             valid = frame.filter_rows(folds == f)
             for mi, pm in enumerate(maps):
                 jobs.append((mi, pm, train, valid))
-        par = self.get("parallelism")
+        from cycloneml_tpu.mesh import safe_fit_parallelism
+        par = safe_fit_parallelism(self.get("parallelism"))
         if par > 1:
             with cf.ThreadPoolExecutor(max_workers=par) as pool:
                 results = list(pool.map(
@@ -181,7 +182,8 @@ class TrainValidationSplit(Estimator, _ValidatorParams, MLWritable, MLReadable):
         mask = rng.rand(frame.n_rows) < self.get("trainRatio")
         train, valid = frame.filter_rows(mask), frame.filter_rows(~mask)
         maps = self._param_maps
-        par = self.get("parallelism")
+        from cycloneml_tpu.mesh import safe_fit_parallelism
+        par = safe_fit_parallelism(self.get("parallelism"))
         if par > 1:
             with cf.ThreadPoolExecutor(max_workers=par) as pool:
                 metrics = list(pool.map(

@@ -25,12 +25,13 @@ from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
-    """jax.shard_map across jax versions (check_vma vs check_rep kwarg)."""
+    """jax.shard_map across jax versions (check_vma vs check_rep kwarg;
+    jax<0.5 has no ``jax.shard_map`` at all → AttributeError)."""
     import jax
     try:
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
-    except TypeError:
+    except (AttributeError, TypeError):
         from jax.experimental.shard_map import shard_map as _sm
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=False)
@@ -89,6 +90,30 @@ class BoundedProgramCache:
 
     def __len__(self) -> int:
         return len(self._d)
+
+
+def _instrument_dispatch(jitted):
+    """Route every dispatch of an aggregation program through the chaos
+    harness's ``collectives.step`` injection point (faults.py). When no
+    injector is installed the cost is one global read per step; the raw
+    program stays reachable as ``__wrapped__`` for callers that inline it
+    into larger jitted programs (e.g. the device-resident line search)."""
+    import jax
+
+    from cycloneml_tpu.parallel import faults
+
+    @functools.wraps(jitted)
+    def dispatch(*args, **kwargs):
+        # trace-time calls (this program inlined into a larger jitted
+        # program, e.g. the fused line search) must not count as a step:
+        # compiles are cached across fits, so counting them would make the
+        # fault schedule depend on compile-cache state
+        if not any(isinstance(a, jax.core.Tracer) for a in args):
+            faults.inject("collectives.step")
+        return jitted(*args, **kwargs)
+
+    dispatch.__wrapped__ = jitted
+    return dispatch
 
 
 # (fn, mesh, n_sharded, auto_psum, with_state) -> jitted program
@@ -152,7 +177,7 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         out_specs = (P(), row_spec) if with_state else P()
         return shard_map_compat(local, mesh, in_specs, out_specs)(*all_args)
 
-    jitted = jax.jit(sharded)
+    jitted = _instrument_dispatch(jax.jit(sharded))
     if key is not None:
         _program_cache.put(key, jitted)
     return jitted

@@ -1,0 +1,211 @@
+"""Deterministic fault injection — the chaos harness.
+
+PR 1's graftlint tests the code's hazards at the AST; this module tests the
+*runtime* failure paths the same way: deliberately, repeatably, under a
+seed. The reference exercises its failure model with FailureSuite /
+DistributedSuite (executor loss via local-cluster) and a fault-injecting
+FileSystem (ref: core/src/test/scala/org/apache/spark/FailureSuite.scala);
+on TPU the failure surface is different — a lost device kills the whole
+SPMD program, a mid-save crash can orphan a checkpoint, a flaky DCN hop
+fails one collective — so the injection points live where those faults
+really land:
+
+======================== =================================================
+point                    fired from
+======================== =================================================
+``collectives.step``     every dispatch of a ``tree_aggregate`` program
+                         (the per-iteration gradient/stats reduction)
+``checkpoint.save``      ``TrainingCheckpointer.save`` entry
+``checkpoint.commit``    after checkpoint files are written, before the
+                         atomic rename (a crash here = orphaned tmp dir)
+``checkpoint.restore``   ``TrainingCheckpointer.restore`` entry
+``heartbeat.send``       every ``HeartbeatSender._send`` TCP round trip
+======================== =================================================
+
+Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the
+injection point, the invocation numbers (1-based, counted only while an
+injector is active) and the fault to fire — an exception instance, a
+``delay`` (slow step), or a callable action. Probabilistic windows draw
+from a ``random.Random(seed)`` owned by the schedule, so a fixed seed
+replays the identical fault sequence. When no injector is installed every
+``inject()`` site is a single global read — the hot path pays nothing.
+
+Usage::
+
+    sched = FaultSchedule(seed=0)
+    sched.at("collectives.step", 3, TransientCollectiveError("DCN flake"))
+    sched.at("collectives.step", 7, DeviceLostError(lost_workers=["h1"]))
+    sched.window("heartbeat.send", 2, 6, ConnectionResetError(), p=0.5)
+    with FaultInjector(sched) as inj:
+        train_with_checkpoints(...)
+    assert inj.log  # every fired fault, in order
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class FaultInjected(Exception):
+    """Base for injected failures (mixed into OSError subclasses too, so
+    recovery code that matches on the real error types still works)."""
+
+
+class TransientCollectiveError(FaultInjected):
+    """A collective that would succeed on retry (DCN flake, preempted
+    step) — the retry-with-backoff class."""
+
+
+class DeviceLostError(FaultInjected):
+    """A device/slice is gone: the compiled program and every array on the
+    old mesh are dead. Retrying the step cannot help; recovery is a mesh
+    rebuild over the survivors + resume from checkpoint (SURVEY §5.3)."""
+
+    def __init__(self, msg: str = "device lost",
+                 lost_workers: Sequence[str] = ()):
+        super().__init__(msg)
+        self.lost_workers = list(lost_workers)
+
+
+class MidSaveCrash(FaultInjected):
+    """Stands in for the process dying mid-checkpoint-save: everything
+    written so far must stay invisible to ``latest_step`` discovery."""
+
+
+class InjectedConnectionReset(ConnectionResetError, FaultInjected):
+    """Peer reset on a fabric socket — OSError subclass, so production
+    handlers (retry next interval) treat it exactly like the real thing."""
+
+
+class SlowStep(FaultInjected):
+    """Marker recorded in the injector log for delay faults (the fault
+    itself is a sleep, not a raise)."""
+
+
+class _Spec:
+    __slots__ = ("point", "first", "last", "fault", "p", "delay_s")
+
+    def __init__(self, point: str, first: int, last: int, fault: Any,
+                 p: float, delay_s: float):
+        self.point = point
+        self.first = first
+        self.last = last
+        self.fault = fault
+        self.p = p
+        self.delay_s = delay_s
+
+
+class FaultSchedule:
+    """Declarative fault plan: (point, invocation window) -> fault."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._specs: List[_Spec] = []
+
+    def at(self, point: str, invocation, fault: Any = None, *,
+           delay_s: float = 0.0) -> "FaultSchedule":
+        """Fire ``fault`` at specific 1-based invocation number(s) of
+        ``point``. ``fault`` is an exception instance (raised), a callable
+        (called with the injection-site kwargs), or None with ``delay_s``
+        (a slow step)."""
+        invs = invocation if isinstance(invocation, (list, tuple, set, range)) \
+            else [invocation]
+        for n in invs:
+            self._specs.append(_Spec(point, int(n), int(n), fault, 1.0, delay_s))
+        return self
+
+    def window(self, point: str, first: int, last: int, fault: Any = None, *,
+               p: float = 1.0, delay_s: float = 0.0) -> "FaultSchedule":
+        """Fire ``fault`` on invocations ``first..last`` (inclusive) of
+        ``point``, each with probability ``p`` drawn from the schedule's
+        seeded RNG — deterministic under a fixed seed."""
+        self._specs.append(_Spec(point, int(first), int(last), fault, p, delay_s))
+        return self
+
+    def specs_for(self, point: str) -> List[_Spec]:
+        return [s for s in self._specs if s.point == point]
+
+
+_lock = threading.Lock()
+_active: Optional["FaultInjector"] = None
+
+
+class FaultInjector:
+    """Counts invocations per injection point and fires scheduled faults.
+
+    Use as a context manager (installs/uninstalls the process-global
+    injector). ``log`` records every fired fault as
+    ``(point, invocation, fault_name)`` — assert on it for determinism.
+    """
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self.counts: Dict[str, int] = {}
+        self.log: List[Tuple[str, int, str]] = []
+        self._rng = random.Random(schedule.seed)
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall(self)
+
+    # -- firing ----------------------------------------------------------------
+    def fire(self, point: str, **info) -> None:
+        with self._lock:
+            n = self.counts.get(point, 0) + 1
+            self.counts[point] = n
+            spec = None
+            for s in self.schedule.specs_for(point):
+                if s.first <= n <= s.last:
+                    # probabilistic windows draw exactly one sample per
+                    # in-window invocation -> a fixed seed replays exactly
+                    if s.p >= 1.0 or self._rng.random() < s.p:
+                        spec = s
+                        break
+            if spec is None:
+                return
+            fault = spec.fault
+            name = (type(fault).__name__ if isinstance(fault, BaseException)
+                    else getattr(fault, "__name__", "SlowStep"))
+            self.log.append((point, n, name))
+        logger.warning("chaos: injecting %s at %s#%d", name, point, n)
+        if spec.delay_s:
+            time.sleep(spec.delay_s)
+        if fault is None:
+            return
+        if isinstance(fault, BaseException):
+            raise fault
+        fault(point=point, invocation=n, **info)
+
+
+def install(injector: FaultInjector) -> None:
+    global _active
+    with _lock:
+        if _active is not None and _active is not injector:
+            raise RuntimeError("a FaultInjector is already installed")
+        _active = injector
+
+
+def uninstall(injector: Optional[FaultInjector] = None) -> None:
+    global _active
+    with _lock:
+        if injector is None or _active is injector:
+            _active = None
+
+
+def inject(point: str, **info) -> None:
+    """Injection site: a no-op global read unless an injector is active."""
+    inj = _active
+    if inj is not None:
+        inj.fire(point, **info)

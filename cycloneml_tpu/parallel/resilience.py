@@ -14,19 +14,91 @@ TPU-native analog of the reference's resilience stack (SURVEY §5.3):
 - ``train_with_checkpoints`` = the recovery model that REPLACES lineage
   recomputation on TPU: periodic optimizer-state checkpoints + resume, so a
   lost mesh costs at most ``interval`` steps of recompute.
+- ``MeshSupervisor`` = the missing limb the chaos harness exposed: on
+  device/worker loss it rebuilds the mesh over the survivors, clears the
+  compiled-program cache, re-shards the data, and hands the train loop a
+  loss function on the new mesh so it can resume from checkpoint.
+
+Failure taxonomy (docs/resilience.md): **transient** failures (flaky
+collectives, I/O hiccups) are retried with exponential backoff + jitter;
+**permanent** failures (``TypeError``, JAX tracing errors — a retry
+re-traces the same bug) abort immediately; **device loss** is neither — the
+step can never succeed on the dead mesh, but the *job* survives via mesh
+rebuild + checkpoint resume.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+from cycloneml_tpu.util.checkpoint import CheckpointCorrupt, TrainingCheckpointer
 from cycloneml_tpu.util.events import WorkerLost
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+# -- failure classification -----------------------------------------------------
+
+# specific runtime tokens only — broad English phrases ("halted", "device
+# lost") substring-match ordinary error text and would misroute transient/
+# permanent failures into a full mesh rebuild
+_DEVICE_LOSS_MARKERS = ("DATA_LOSS", "SLICE_LOST", "DEVICE_SHUTTING_DOWN")
+
+
+def _permanent_types() -> tuple:
+    """Exception types a retry can never fix: the step function itself is
+    wrong, and re-running it re-traces the same bug."""
+    types: list = [TypeError, SyntaxError, NameError]
+    try:
+        import jax
+        types.append(jax.errors.JAXTypeError)  # Tracer/Concretization family
+        types.append(jax.errors.UnexpectedTracerError)
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        pass
+    return tuple(types)
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when the failure means the mesh (or part of it) is gone — the
+    recovery is a rebuild, not a retry."""
+    from cycloneml_tpu.parallel.faults import DeviceLostError
+    if isinstance(exc, DeviceLostError):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``'device_loss'`` | ``'permanent'`` | ``'transient'``.
+
+    Device loss is checked first: a dead device often surfaces as a
+    RuntimeError whose *text* is the only signal. Permanent = the class of
+    errors where the step function itself is broken (TypeError, tracing
+    errors); everything else is presumed transient and worth a backoff
+    retry, matching the reference's default of retrying every task failure
+    (TaskSetManager.handleFailedTask) but without its blind spot for
+    deterministic bugs.
+    """
+    if is_device_loss(exc):
+        return "device_loss"
+    if isinstance(exc, _permanent_types()):
+        return "permanent"
+    return "transient"
+
+
+def backoff_delay(attempt: int, base_s: float = 0.05, max_s: float = 2.0,
+                  rng: Optional[random.Random] = None) -> float:
+    """Exponential backoff with full jitter: ``min(max, base·2^attempt)``
+    scaled by a uniform draw in [0.5, 1] — deterministic under a caller-
+    seeded ``rng`` (the chaos suite's reproducibility contract)."""
+    if base_s <= 0:
+        return 0.0
+    r = rng.random() if rng is not None else random.random()
+    return min(max_s, base_s * (2.0 ** attempt)) * (0.5 + 0.5 * r)
 
 
 class HeartbeatReceiver:
@@ -195,11 +267,17 @@ class HeartbeatSender:
         self._thread.start()
 
     def _send(self, msg: str) -> str:
+        from cycloneml_tpu.parallel import faults
         from cycloneml_tpu.util.tcp import (check_not_challenge,
                                             connect_authed)
+        faults.inject("heartbeat.send", worker_id=self.worker_id, msg=msg)
         with connect_authed(self._addr[0], self._addr[1], timeout=5) as s:
             s.sendall((msg + "\n").encode())
-            reply = s.makefile("r").readline().strip()
+            rfile = s.makefile("r")
+            try:
+                reply = rfile.readline().strip()
+            finally:
+                rfile.close()  # one leaked file object per ping otherwise
         check_not_challenge(reply)
         return reply
 
@@ -257,21 +335,180 @@ class HealthTracker:
 
 def retry_step(fn: Callable[[], Any], max_failures: int = 4,
                on_failure: Optional[Callable[[int, Exception], None]] = None,
-               retryable=(Exception,)) -> Any:
-    """Run one step with whole-step retry (barrier-stage semantics)."""
+               retryable=(Exception,), backoff_base_s: float = 0.02,
+               backoff_max_s: float = 2.0,
+               rng: Optional[random.Random] = None) -> Any:
+    """Run one step with whole-step retry (barrier-stage semantics).
+
+    Transient failures are retried with exponential backoff + jitter;
+    **permanent** failures (``classify_failure``: TypeError / tracing
+    errors) propagate immediately — retrying a deterministic bug
+    ``max_failures`` times only delays the abort and hammers the mesh.
+    ``rng`` seeds the jitter for deterministic chaos replays.
+    """
+    if rng is None:
+        rng = random.Random(0xC1C10)  # deterministic by default
     last: Optional[Exception] = None
     for attempt in range(max_failures):
         try:
             return fn()
         except retryable as e:  # noqa: PERF203 — retry loop
+            if classify_failure(e) == "permanent":
+                logger.error("step failed permanently (%s: %s); not retrying",
+                             type(e).__name__, e)
+                raise
             last = e
             logger.warning("step failed (attempt %d/%d): %s",
                            attempt + 1, max_failures, e)
             if on_failure is not None:
                 on_failure(attempt, e)
+            if attempt + 1 < max_failures:
+                time.sleep(backoff_delay(attempt, backoff_base_s,
+                                         backoff_max_s, rng))
     raise RuntimeError(
         f"step failed {max_failures} times; aborting job "
         f"(≈ TaskSetManager 'Task failed {max_failures} times')") from last
+
+
+class MeshDegradedError(RuntimeError):
+    """Recovery is impossible: too few surviving devices, or the rebuild
+    budget is exhausted."""
+
+
+class MeshSupervisor:
+    """Automated degraded-mesh recovery (SURVEY §5.3, the unplanned-loss
+    side of :meth:`CycloneContext.decommission`).
+
+    Wires the liveness stack into the recovery stack: worker-loss events
+    from a :class:`HeartbeatReceiver` (and ``DeviceLostError``s raised by a
+    step) mark workers dead in a :class:`HealthTracker`; ``recover()`` then
+
+    1. drops every compiled program (``clear_program_cache`` — they close
+       over the dead mesh),
+    2. rebuilds the mesh over the surviving devices
+       (``ctx.rebuild_mesh``), and
+    3. calls ``on_rebuild(runtime)`` so the caller re-shards its data onto
+       the new mesh — its return value (if not None) becomes the new loss
+       function for :func:`train_with_checkpoints`, which resumes from the
+       newest verifiable checkpoint.
+
+    ``worker_devices`` maps worker ids to the device count each one
+    contributes; without it the supervisor rebuilds onto whatever the
+    master URL still resolves (re-enumeration — right for ``tpu`` masters
+    where the runtime discovers survivors itself).
+    """
+
+    def __init__(self, ctx, *,
+                 worker_devices: Optional[Dict[str, int]] = None,
+                 master_for: Optional[Callable[[int], str]] = None,
+                 health: Optional["HealthTracker"] = None,
+                 on_rebuild: Optional[Callable[[Any], Any]] = None,
+                 min_devices: int = 1, max_rebuilds: int = 2):
+        self.ctx = ctx
+        self.worker_devices = dict(worker_devices or {})
+        self._master_for = master_for
+        self.health = health if health is not None else HealthTracker()
+        self.on_rebuild = on_rebuild
+        self.min_devices = min_devices
+        self.max_rebuilds = max_rebuilds
+        self.rebuilds = 0
+        self._lost: Dict[str, str] = {}
+        self._pending: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def attach(self, receiver: "HeartbeatReceiver") -> "MeshSupervisor":
+        """Subscribe to a receiver's worker-lost events (heartbeat-driven
+        loss detection feeding the same recovery path as step errors)."""
+        receiver.on_worker_lost(self.note_worker_lost)
+        return self
+
+    def note_worker_lost(self, worker_id: str, reason: str) -> None:
+        """Record a lost worker; the rebuild itself happens on the training
+        thread (``recover``), never on the heartbeat sweep thread — tearing
+        down the mesh under a running step would race the step itself."""
+        self.health.record_failure(worker_id)
+        with self._lock:
+            self._lost[worker_id] = reason
+            self._pending = f"worker {worker_id} lost: {reason}"
+        logger.warning("mesh degraded: worker %s lost (%s)", worker_id, reason)
+
+    def pending_loss(self) -> Optional[str]:
+        with self._lock:
+            return self._pending
+
+    def lost_workers(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._lost)
+
+    def surviving_devices(self) -> Optional[int]:
+        """Devices contributed by workers not known to be lost; None when
+        no ``worker_devices`` map was given (re-enumerate instead)."""
+        if not self.worker_devices:
+            return None
+        with self._lock:
+            return sum(n for w, n in self.worker_devices.items()
+                       if w not in self._lost)
+
+    def _target_master(self) -> Optional[str]:
+        n = self.surviving_devices()
+        if n is None:
+            return None  # keep the configured master; rebuild re-enumerates
+        if n < self.min_devices:
+            raise MeshDegradedError(
+                f"only {n} devices survive (< min_devices="
+                f"{self.min_devices}); cannot rebuild a viable mesh")
+        if self._master_for is not None:
+            return self._master_for(n)
+        return f"local-mesh[{n}]"
+
+    def recover(self, reason: str = "",
+                lost_workers: Sequence[str] = ()) -> Any:
+        """Rebuild the mesh over the survivors and re-shard. Returns
+        ``on_rebuild``'s result (the caller's rebuilt loss fn, or None)."""
+        for w in lost_workers:
+            self.note_worker_lost(w, reason or "reported by step failure")
+        if self.rebuilds >= self.max_rebuilds:
+            raise MeshDegradedError(
+                f"mesh rebuilt {self.rebuilds} times already "
+                f"(max_rebuilds={self.max_rebuilds}); aborting instead of "
+                f"thrashing")
+        self.rebuilds += 1
+        master = self._target_master()
+        from cycloneml_tpu.parallel.collectives import clear_program_cache
+        clear_program_cache()  # compiled programs close over the dead mesh
+        rt = self.ctx.rebuild_mesh(master)
+        logger.warning("mesh recovery #%d (%s): rebuilt over %d devices",
+                       self.rebuilds, reason or "device loss", rt.n_devices)
+        with self._lock:
+            self._pending = None
+        if self.on_rebuild is not None:
+            return self.on_rebuild(rt)
+        return None
+
+
+def _restore_latest_verified(checkpointer: TrainingCheckpointer,
+                             fingerprint: Optional[str]):
+    """(step, pytree) of the newest VERIFIABLE checkpoint, or None when the
+    directory holds no checkpoints. Raises :class:`CheckpointCorrupt` when
+    checkpoints exist but every one fails verification — a loud abort beats
+    silently restarting from scratch over data the operator thinks is
+    there."""
+    try:
+        step, tree = checkpointer.restore_newest_verifiable()
+    except FileNotFoundError:
+        return None  # empty dir: a fresh run, not a corruption
+    if fingerprint is not None:
+        saved = checkpointer.metadata(step).get("fingerprint")
+        if saved != fingerprint:
+            # missing (None) counts as a mismatch too: a dir written
+            # without fingerprints is unverifiable, and resuming foreign
+            # state silently returns the wrong model
+            raise ValueError(
+                f"checkpoint dir {checkpointer.directory!r} holds state "
+                f"for a DIFFERENT training run (fingerprint {saved} != "
+                f"{fingerprint}); resuming it would silently return the "
+                "wrong model — clear the directory or use a new one")
+    return step, tree
 
 
 def train_with_checkpoints(optimizer, loss_grad, x0,
@@ -279,42 +516,70 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
                            interval: int = 5,
                            max_step_failures: int = 4,
                            on_step: Optional[Callable] = None,
-                           fingerprint: Optional[str] = None):
+                           fingerprint: Optional[str] = None,
+                           supervisor: Optional[MeshSupervisor] = None,
+                           backoff_base_s: float = 0.02,
+                           backoff_max_s: float = 2.0,
+                           seed: int = 0):
     """Drive ``optimizer.iterations`` with periodic state checkpoints and
-    automatic resume from the newest checkpoint.
+    automatic resume from the newest *verifiable* checkpoint.
 
-    On entry: if the checkpointer holds a state, training continues from it
-    (exactly — the full curvature memory is saved). Failed iterations are
-    retried by rebuilding the iteration stream from the last good state,
-    with the budget counted per step across rebuilds (``retry_step`` is the
-    standalone utility for callers retrying idempotent steps directly).
-    Returns the final OptimState.
+    On entry: if the checkpointer holds a verifiable state, training
+    continues from it (exactly — the full curvature memory is saved).
+    Failures are classified (:func:`classify_failure`):
+
+    - **transient**: the iteration stream is rebuilt from the last good
+      state after an exponential backoff (jitter seeded by ``seed`` — a
+      fixed seed replays the identical schedule). The budget counts
+      failures of the SAME step across rebuilds.
+    - **permanent** (TypeError / tracing errors): raised immediately — the
+      step function is broken and every retry re-traces the same bug.
+    - **device loss**: with a :class:`MeshSupervisor`, recovery runs —
+      mesh rebuild over survivors, re-shard via the supervisor's
+      ``on_rebuild`` (whose return value replaces ``loss_grad``), resume
+      from the newest verifiable checkpoint. Without a supervisor it
+      counts against the transient budget and aborts there.
+
+    A pending heartbeat-driven worker loss (``supervisor.note_worker_lost``
+    via an attached receiver) triggers the same recovery before the next
+    step is attempted. Returns the final OptimState.
     """
     from cycloneml_tpu.ml.optim.lbfgs import OptimState
 
+    rng = random.Random(seed)
     resume = None
-    latest = checkpointer.latest_step()
-    if latest is not None:
-        if fingerprint is not None:
-            saved = checkpointer.metadata(latest).get("fingerprint")
-            if saved != fingerprint:
-                # missing (None) counts as a mismatch too: a dir written
-                # without fingerprints is unverifiable, and resuming foreign
-                # state silently returns the wrong model
-                raise ValueError(
-                    f"checkpoint dir {checkpointer.directory!r} holds state "
-                    f"for a DIFFERENT training run (fingerprint {saved} != "
-                    f"{fingerprint}); resuming it would silently return the "
-                    "wrong model — clear the directory or use a new one")
-        resume = OptimState.from_pytree(checkpointer.restore(latest))
-        logger.info("resuming training from checkpoint step %d", latest)
+    restored = _restore_latest_verified(checkpointer, fingerprint)
+    if restored is not None:
+        step, tree = restored
+        resume = OptimState.from_pytree(tree)
+        logger.info("resuming training from checkpoint step %d", step)
+
+    def _recover(reason: str, lost: Sequence[str] = ()):
+        """Mesh rebuild + re-shard + reload from checkpoint; returns the
+        rebuilt (loss_grad, resume_state)."""
+        new_loss = supervisor.recover(reason=reason, lost_workers=lost)
+        got = _restore_latest_verified(checkpointer, fingerprint)
+        if got is not None:
+            st = OptimState.from_pytree(got[1])
+            logger.info("post-recovery resume from checkpoint step %d",
+                        got[0])
+        else:
+            st = state  # no checkpoint yet: host-side state is still valid
+        return (new_loss if new_loss is not None else loss_grad), st
 
     it = optimizer.iterations(loss_grad, x0, resume=resume)
     # the resume state was already delivered (checkpointed + on_step'd) by
     # the previous run; its re-yield below is skipped, not re-announced
     state = resume
+    # steps at or below this were announced (on_step) by a previous run or
+    # before a device-loss replay — never announce them twice
+    last_announced = resume.iteration if resume is not None else -1
     fail_count = 0
     while True:
+        if supervisor is not None and supervisor.pending_loss():
+            loss_grad, state = _recover(supervisor.pending_loss())
+            it = optimizer.iterations(loss_grad, x0, resume=state)
+            fail_count = 0
         try:
             s = next(it, None)
         except Exception as e:
@@ -322,6 +587,17 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
             # budget counts failures of the SAME step across stream rebuilds
             # (a rebuilt stream re-yields its resume point, which must not
             # reset the count — that would retry a permanent failure forever)
+            kind = classify_failure(e)
+            if kind == "permanent":
+                logger.error("step failed permanently (%s: %s); aborting",
+                             type(e).__name__, e)
+                raise
+            if kind == "device_loss" and supervisor is not None:
+                loss_grad, state = _recover(
+                    str(e), getattr(e, "lost_workers", ()))
+                it = optimizer.iterations(loss_grad, x0, resume=state)
+                fail_count = 0
+                continue
             fail_count += 1
             logger.warning("step failed (attempt %d/%d): %s",
                            fail_count, max_step_failures, e)
@@ -330,6 +606,8 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
                     f"step failed {max_step_failures} times; aborting job "
                     f"(≈ TaskSetManager 'Task failed {max_step_failures} "
                     f"times')") from e
+            time.sleep(backoff_delay(fail_count - 1, backoff_base_s,
+                                     backoff_max_s, rng))
             it = optimizer.iterations(loss_grad, x0, resume=state)
             continue
         if s is None:
@@ -338,8 +616,9 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
             continue  # re-yield of the resume point after a rebuild
         state = s
         fail_count = 0  # real progress resets the per-step budget
-        if on_step is not None:
+        if on_step is not None and state.iteration > last_announced:
             on_step(state)
+        last_announced = max(last_announced, state.iteration)
         if state.iteration > 0 and state.iteration % interval == 0:
             checkpointer.save(state.iteration, state.to_pytree(),
                               metadata={"loss": state.value,

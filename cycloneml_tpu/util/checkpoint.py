@@ -8,10 +8,24 @@ improvement for TPU training, where recovery is checkpoint-based (lineage
 recomputation does not translate, §5.3). This is an orbax-style checkpoint
 manager specialised to host-resident numpy/JAX pytrees: atomic step
 directories, a retention policy, and latest-step discovery for resume.
+
+Durability contract (chaos-tested by tests/test_chaos.py):
+
+- every payload file is fsync'd before the commit rename, and the parent
+  directory is fsync'd after it — a crash at ANY point leaves either a
+  fully-readable checkpoint or an invisible ``.tmp`` leftover, never a
+  half-written visible one;
+- ``METADATA.json`` records a sha256 + byte count per payload file, so a
+  checkpoint that was committed but later damaged (truncation, bit rot) is
+  *detectable*;
+- ``restore()`` with no explicit step falls back to the newest
+  **verifiable** step, raising :class:`CheckpointCorrupt` only when every
+  candidate fails verification.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
@@ -20,6 +34,15 @@ import tempfile
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CheckpointCorrupt(Exception):
+    """A committed checkpoint failed verification (checksum mismatch,
+    truncated or unpicklable payload)."""
 
 
 def _to_host(tree: Any) -> Any:
@@ -34,14 +57,53 @@ def _to_host(tree: Any) -> Any:
     return tree
 
 
+class _HashingWriter:
+    """File-object wrapper feeding every written chunk into a digest, so
+    the checksum costs no second pass over a multi-GB state file."""
+
+    def __init__(self, fh, digest):
+        self._fh = fh
+        self._digest = digest
+
+    def write(self, b):
+        self._digest.update(b)
+        return self._fh.write(b)
+
+    def flush(self):
+        self._fh.flush()
+
+
+def _fsync_write(path: str, write_fn) -> str:
+    """Write a file through ``write_fn(fh)``, fsync it, return its sha256
+    (computed inline during the write)."""
+    digest = hashlib.sha256()
+    with open(path, "wb") as fh:
+        write_fn(_HashingWriter(fh, digest))
+        fh.flush()
+        os.fsync(fh.fileno())
+    return digest.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: rename is still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class TrainingCheckpointer:
-    """Atomic step-directory checkpoints with retention.
+    """Atomic step-directory checkpoints with retention and verification.
 
     Layout: ``<dir>/step_<n>/{state.pkl, METADATA.json}``; a step directory
-    is renamed into place only after its contents are fully written, so a
-    crash mid-save never leaves a readable-but-corrupt checkpoint (the same
-    commit discipline as the reference's CheckpointFileManager atomic
-    rename, sql/.../streaming/CheckpointFileManager.scala).
+    is renamed into place only after its contents are fully written and
+    fsync'd, so a crash mid-save never leaves a readable-but-corrupt
+    checkpoint (the same commit discipline as the reference's
+    CheckpointFileManager atomic rename,
+    sql/.../streaming/CheckpointFileManager.scala).
     """
 
     def __init__(self, directory: str, keep_last: int = 3):
@@ -70,32 +132,120 @@ class TrainingCheckpointer:
 
     def save(self, step: int, state: Any,
              metadata: Optional[Dict[str, Any]] = None) -> str:
+        from cycloneml_tpu.parallel import faults
+        faults.inject("checkpoint.save", step=step)
         target = self._step_dir(step)
         if os.path.exists(target):
             return target  # idempotent re-save after a replayed step
         tmp = tempfile.mkdtemp(dir=self.directory,
                                prefix=f"step_{step:012d}.tmp")
         try:
-            with open(os.path.join(tmp, "state.pkl"), "wb") as fh:
-                pickle.dump(_to_host(state), fh,
-                            protocol=pickle.HIGHEST_PROTOCOL)
-            with open(os.path.join(tmp, "METADATA.json"), "w") as fh:
-                json.dump({"step": step, **(metadata or {})}, fh)
+            state_path = os.path.join(tmp, "state.pkl")
+            sha = _fsync_write(state_path, lambda fh: pickle.dump(
+                _to_host(state), fh, protocol=pickle.HIGHEST_PROTOCOL))
+            meta = {"step": step, **(metadata or {}),
+                    "files": {"state.pkl": {
+                        "sha256": sha,
+                        "bytes": os.path.getsize(state_path)}}}
+            _fsync_write(os.path.join(tmp, "METADATA.json"),
+                         lambda fh: fh.write(json.dumps(meta).encode()))
+            # a crash between here and the rename orphans the tmp dir —
+            # invisible to steps() — which is exactly the contract
+            faults.inject("checkpoint.commit", step=step)
             os.replace(tmp, target)
+            _fsync_dir(self.directory)  # durably publish the rename
         finally:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)
         self._retain()
         return target
 
+    def verify(self, step: int) -> bool:
+        """True iff the committed checkpoint for ``step`` passes its
+        recorded checksums (legacy checkpoints without checksums pass when
+        the payload unpickles)."""
+        try:
+            self._verified_load(step)
+            return True
+        except (CheckpointCorrupt, FileNotFoundError, OSError):
+            return False
+
+    def _verified_load(self, step: int) -> Any:
+        sdir = self._step_dir(step)
+        state_path = os.path.join(sdir, "state.pkl")
+        try:
+            meta = self.metadata(step)
+        except (FileNotFoundError, json.JSONDecodeError) as e:
+            raise CheckpointCorrupt(
+                f"checkpoint step {step}: unreadable METADATA.json ({e})") \
+                from e
+        recorded = meta.get("files", {}).get("state.pkl")
+        if recorded is not None:
+            digest = hashlib.sha256()
+            try:
+                with open(state_path, "rb") as fh:
+                    for chunk in iter(lambda: fh.read(1 << 20), b""):
+                        digest.update(chunk)
+            except FileNotFoundError as e:
+                raise CheckpointCorrupt(
+                    f"checkpoint step {step}: state.pkl missing") from e
+            if digest.hexdigest() != recorded["sha256"]:
+                raise CheckpointCorrupt(
+                    f"checkpoint step {step}: state.pkl checksum mismatch "
+                    f"(truncated or damaged after commit)")
+        try:
+            with open(state_path, "rb") as fh:
+                return pickle.load(fh)
+        except FileNotFoundError:
+            raise
+        except (EOFError, pickle.UnpicklingError, ValueError,
+                AttributeError, ImportError) as e:
+            # legacy (pre-checksum) checkpoints land here on truncation
+            raise CheckpointCorrupt(
+                f"checkpoint step {step}: state.pkl does not unpickle "
+                f"({type(e).__name__}: {e})") from e
+
+    def latest_verifiable_step(self) -> Optional[int]:
+        """Newest step that passes verification (None when none do)."""
+        for step in reversed(self.steps()):
+            if self.verify(step):
+                return step
+        return None
+
+    def restore_newest_verifiable(self) -> tuple:
+        """``(step, state)`` of the newest checkpoint that passes
+        verification, in ONE read+hash+unpickle pass per candidate.
+        Damaged steps are logged and skipped; raises
+        :class:`CheckpointCorrupt` when checkpoints exist but none verify,
+        ``FileNotFoundError`` when the directory holds none at all."""
+        steps = self.steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        last_err: Optional[Exception] = None
+        for s in reversed(steps):
+            try:
+                return s, self._verified_load(s)
+            except (CheckpointCorrupt, FileNotFoundError, OSError) as e:
+                last_err = e
+                logger.warning(
+                    "checkpoint step %d failed verification (%s); "
+                    "falling back to the previous step", s, e)
+        raise CheckpointCorrupt(
+            f"all {len(steps)} checkpoints under {self.directory} failed "
+            f"verification; newest error: {last_err}") from last_err
+
     def restore(self, step: Optional[int] = None) -> Any:
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}")
-        with open(os.path.join(self._step_dir(step), "state.pkl"), "rb") as fh:
-            return pickle.load(fh)
+        """Load a checkpoint state.
+
+        With an explicit ``step``: verify and load it, raising
+        :class:`CheckpointCorrupt` on damage. With ``step=None``: the
+        newest *verifiable* state (see :meth:`restore_newest_verifiable`).
+        """
+        from cycloneml_tpu.parallel import faults
+        faults.inject("checkpoint.restore", step=step)
+        if step is not None:
+            return self._verified_load(step)
+        return self.restore_newest_verifiable()[1]
 
     def metadata(self, step: int) -> Dict[str, Any]:
         with open(os.path.join(self._step_dir(step), "METADATA.json")) as fh:

@@ -1,0 +1,388 @@
+"""Chaos harness: seeded fault injection driven through the real train loop.
+
+Five injected fault classes (ISSUE 2 acceptance), each deterministic under a
+fixed seed, each either RECOVERED (train_with_checkpoints lands on the same
+final state as a fault-free run) or CLEANLY ABORTED (a loud, classified
+error) — never silently wrong:
+
+1. transient collective failure  -> backoff + stream rebuild, exact recovery
+2. device loss                   -> MeshSupervisor mesh rebuild + re-shard
+                                    + resume-from-checkpoint
+3. mid-save crash                -> atomic-commit contract: no corrupt
+                                    checkpoint visible; resume recovers
+4. corrupt latest checkpoint     -> checksum fallback to newest verifiable
+5. heartbeat-driven worker loss  -> receiver expiry feeds the same recovery
+                                    path as step failures
+
+Plus the TCP leg (injected connection resets must not kill a worker) and
+the determinism contract of the schedule itself.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.ml.optim.lbfgs import LBFGS
+from cycloneml_tpu.parallel.faults import (DeviceLostError, FaultInjector,
+                                           FaultSchedule,
+                                           InjectedConnectionReset,
+                                           MidSaveCrash,
+                                           TransientCollectiveError)
+from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                               MeshDegradedError,
+                                               MeshSupervisor,
+                                               train_with_checkpoints)
+from cycloneml_tpu.util.checkpoint import CheckpointCorrupt, TrainingCheckpointer
+
+
+def _quadratic(d=6, seed=3):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(d, d)
+    h = a @ a.T + d * np.eye(d)
+    b = rng.randn(d)
+
+    def f(x):
+        return 0.5 * x @ h @ x - b @ x, h @ x - b
+
+    return f, np.zeros(d)
+
+
+def _logistic_problem(ctx, n=256, d=6, seed=0):
+    """Distributed logistic loss over the ctx mesh — every evaluation is a
+    real tree_aggregate dispatch through the collectives.step injection
+    point."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.loss import DistributedLossFunction
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+
+    def make_loss(dataset):
+        return DistributedLossFunction(
+            dataset, aggregators.binary_logistic(d, fit_intercept=False))
+
+    return ds, make_loss, np.zeros(d)
+
+
+# -- fault class 1: transient collective failure --------------------------------
+
+def test_transient_collective_failure_recovers(ctx, tmp_path):
+    """Flaky DCN hops at scheduled dispatches: the loop backs off, rebuilds
+    the stream from the last good state, and lands EXACTLY on the fault-free
+    trajectory — twice, identically, under the same seed."""
+    ds, make_loss, x0 = _logistic_problem(ctx)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(ds), x0)
+
+    runs = []
+    for attempt in ("a", "b"):  # same seed twice: the determinism contract
+        sched = FaultSchedule(seed=7)
+        sched.at("collectives.step", [4, 5],
+                 TransientCollectiveError("injected DCN flake"))
+        ck = TrainingCheckpointer(str(tmp_path / f"ck-{attempt}"))
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(ds), x0, ck,
+                interval=5, max_step_failures=3, backoff_base_s=0.001,
+                seed=7)
+        assert [(p, n) for p, n, _ in inj.log] == \
+            [("collectives.step", 4), ("collectives.step", 5)]
+        runs.append(final)
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-10)
+        assert final.iteration == baseline.iteration
+
+    np.testing.assert_array_equal(runs[0].x, runs[1].x)
+    assert runs[0].loss_history == runs[1].loss_history
+
+
+def test_slow_step_fault_delays_but_does_not_corrupt(ctx):
+    """A delay fault (degraded interconnect) slows the step without
+    changing the result."""
+    ds, make_loss, x0 = _logistic_problem(ctx)
+    loss = make_loss(ds)
+    want = loss(x0)
+    sched = FaultSchedule().at("collectives.step", 1, delay_s=0.2)
+    t0 = time.monotonic()
+    with FaultInjector(sched) as inj:
+        got = loss(x0)
+    assert time.monotonic() - t0 >= 0.2
+    assert inj.log == [("collectives.step", 1, "SlowStep")]
+    assert got[0] == want[0]
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+# -- fault class 2: device loss -> mesh rebuild ---------------------------------
+
+def test_device_loss_rebuilds_mesh_and_resumes(ctx, tmp_path):
+    """A lost worker's DeviceLostError mid-step: the supervisor clears the
+    program cache, rebuilds local-mesh[4] over the survivors, re-shards the
+    dataset from its checkpoint, and training resumes from the optimizer
+    checkpoint — same answer as the undisturbed 8-device run."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    ds8, make_loss, x0 = _logistic_problem(ctx)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(ds8), x0)
+    data_ck = str(tmp_path / "data")
+    ds8.checkpoint(data_ck)
+    opt_ck = TrainingCheckpointer(str(tmp_path / "opt"))
+
+    sup = ctx.mesh_supervisor(
+        worker_devices={"h0": 4, "h1": 4},
+        on_rebuild=lambda rt: make_loss(InstanceDataset.restore(ctx, data_ck)))
+    sched = FaultSchedule(seed=1)
+    sched.at("collectives.step", 12,
+             DeviceLostError("ICI link down", lost_workers=["h1"]))
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(ds8), x0, opt_ck,
+                interval=2, supervisor=sup, backoff_base_s=0.001, seed=1)
+        assert inj.log == [("collectives.step", 12, "DeviceLostError")]
+        assert sup.rebuilds == 1
+        assert "h1" in sup.lost_workers()
+        assert ctx.mesh_runtime.n_devices == 4  # degraded but alive
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5, atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")  # restore fixture invariant
+
+
+def test_device_loss_without_supervisor_aborts_cleanly(tmp_path):
+    """No supervisor: device loss burns the transient budget and aborts
+    with the classified step-failure error, never spinning forever."""
+    f, x0 = _quadratic()
+    calls = {"n": 0}
+
+    def lossy(x):
+        calls["n"] += 1
+        if calls["n"] >= 4:
+            raise DeviceLostError("slice gone")
+        return f(x)
+
+    ck = TrainingCheckpointer(str(tmp_path))
+    with pytest.raises(RuntimeError, match="failed 2 times"):
+        train_with_checkpoints(LBFGS(max_iter=30, tol=1e-10), lossy, x0, ck,
+                               interval=2, max_step_failures=2,
+                               backoff_base_s=0.0)
+
+
+def test_mesh_rebuild_budget_exhaustion(ctx, tmp_path):
+    """Device loss recurring past max_rebuilds must abort with
+    MeshDegradedError instead of thrashing rebuilds forever."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    ds8, make_loss, x0 = _logistic_problem(ctx)
+    data_ck = str(tmp_path / "data")
+    ds8.checkpoint(data_ck)
+    sup = MeshSupervisor(
+        ctx, worker_devices={"h0": 4, "h1": 4}, max_rebuilds=1,
+        on_rebuild=lambda rt: make_loss(InstanceDataset.restore(ctx, data_ck)))
+    sched = FaultSchedule(seed=2)
+    # inv 6 kills the first mesh; inv 7 is the rebuilt loss's weight-sum
+    # dispatch inside recover(), so the relapse window starts at 8 — the
+    # first TRAINING dispatch on the rebuilt mesh
+    sched.at("collectives.step", 6,
+             DeviceLostError("flapping link", lost_workers=["h1"]))
+    sched.window("collectives.step", 8, 10_000,
+                 DeviceLostError("flapping link", lost_workers=["h1"]))
+    try:
+        with FaultInjector(sched):
+            with pytest.raises(MeshDegradedError, match="max_rebuilds"):
+                train_with_checkpoints(
+                    LBFGS(max_iter=30, tol=1e-9), make_loss(ds8), x0,
+                    TrainingCheckpointer(str(tmp_path / "opt")), interval=2,
+                    supervisor=sup, backoff_base_s=0.0, seed=2)
+        assert sup.rebuilds == 1
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+# -- fault class 3: mid-save crash ----------------------------------------------
+
+def test_mid_save_crash_never_leaves_corrupt_checkpoint(tmp_path):
+    """Crash between writing checkpoint files and the commit rename: the
+    run aborts, the half-written step is INVISIBLE, and a resumed run lands
+    on the fault-free answer."""
+    f, x0 = _quadratic(d=8, seed=11)
+    baseline = LBFGS(max_iter=40, tol=1e-12).minimize(f, x0)
+    ck = TrainingCheckpointer(str(tmp_path), keep_last=5)
+
+    sched = FaultSchedule().at("checkpoint.commit", 2,
+                               MidSaveCrash("power cut mid-save"))
+    with FaultInjector(sched) as inj:
+        with pytest.raises(MidSaveCrash):
+            train_with_checkpoints(LBFGS(max_iter=40, tol=1e-12), f, x0, ck,
+                                   interval=2)
+    assert inj.log == [("checkpoint.commit", 2, "MidSaveCrash")]
+    assert ck.steps() == [2]  # the crashed save (step 4) never surfaced
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp" in n]
+    assert not leftovers  # no orphaned tmp dirs either
+    assert ck.verify(2)
+
+    final = train_with_checkpoints(LBFGS(max_iter=40, tol=1e-12), f, x0, ck,
+                                   interval=2)
+    np.testing.assert_allclose(final.x, baseline.x, rtol=1e-12, atol=1e-12)
+    assert final.loss_history == pytest.approx(baseline.loss_history)
+
+
+# -- fault class 4: corrupt latest checkpoint -----------------------------------
+
+def test_corrupt_latest_checkpoint_falls_back_to_verifiable(tmp_path):
+    """Truncate the newest committed checkpoint after the fact (bit rot /
+    torn disk): resume detects the checksum mismatch, falls back to the
+    newest VERIFIABLE step, and still converges to the fault-free answer."""
+    f, x0 = _quadratic(d=10, seed=5)
+    baseline = LBFGS(max_iter=50, tol=1e-12).minimize(f, x0)
+    ck = TrainingCheckpointer(str(tmp_path), keep_last=5)
+    final = train_with_checkpoints(LBFGS(max_iter=50, tol=1e-12), f, x0, ck,
+                                   interval=2)
+    latest = ck.latest_step()
+    assert latest == final.iteration and len(ck.steps()) >= 2
+
+    pkl = os.path.join(tmp_path, f"step_{latest:012d}", "state.pkl")
+    with open(pkl, "r+b") as fh:  # truncate to half: commit happened, then rot
+        fh.truncate(os.path.getsize(pkl) // 2)
+
+    assert not ck.verify(latest)
+    with pytest.raises(CheckpointCorrupt, match="checksum mismatch"):
+        ck.restore(latest)
+    fallback = ck.latest_verifiable_step()
+    assert fallback is not None and fallback < latest
+    ck.restore()  # step=None walks back to the verifiable one — no raise
+
+    resumed = train_with_checkpoints(LBFGS(max_iter=50, tol=1e-12), f, x0,
+                                     ck, interval=2)
+    np.testing.assert_allclose(resumed.x, baseline.x, rtol=1e-12, atol=1e-12)
+    assert resumed.iteration == baseline.iteration
+
+
+def test_all_checkpoints_corrupt_aborts_loudly(tmp_path):
+    """When every checkpoint fails verification, resuming must raise
+    CheckpointCorrupt — not silently restart from scratch."""
+    f, x0 = _quadratic()
+    ck = TrainingCheckpointer(str(tmp_path), keep_last=3)
+    train_with_checkpoints(LBFGS(max_iter=40, tol=1e-12), f, x0, ck,
+                           interval=2)
+    for step in ck.steps():
+        pkl = os.path.join(tmp_path, f"step_{step:012d}", "state.pkl")
+        with open(pkl, "wb") as fh:
+            fh.write(b"garbage")
+    with pytest.raises(CheckpointCorrupt, match="failed verification"):
+        train_with_checkpoints(LBFGS(max_iter=40, tol=1e-12), f, x0, ck,
+                               interval=2)
+
+
+# -- fault class 5: heartbeat-driven worker loss --------------------------------
+
+def test_heartbeat_worker_loss_triggers_recovery(ctx, tmp_path):
+    """The liveness leg: a worker stops heartbeating mid-training, the
+    receiver expires it, the supervisor picks the loss up BEFORE the next
+    step and runs the same rebuild+resume path — same final answer."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+
+    ds8, make_loss, x0 = _logistic_problem(ctx)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(ds8), x0)
+    data_ck = str(tmp_path / "data")
+    ds8.checkpoint(data_ck)
+    opt_ck = TrainingCheckpointer(str(tmp_path / "opt"))
+
+    recv = HeartbeatReceiver(timeout_s=0.05)  # swept manually: deterministic
+    sup = MeshSupervisor(
+        ctx, worker_devices={"h0": 4, "h1": 4},
+        on_rebuild=lambda rt: make_loss(InstanceDataset.restore(ctx, data_ck))
+    ).attach(recv)
+    recv.register("h0")
+    recv.register("h1")
+
+    tripped = {"done": False}
+
+    def maybe_kill_h1(s):
+        if s.iteration == 6 and not tripped["done"]:
+            tripped["done"] = True
+            time.sleep(0.06)        # both workers go stale...
+            recv.heartbeat("h0")    # ...h0's ping arrives in time...
+            recv.check_now()        # ...h1 is expired -> supervisor notified
+
+    try:
+        final = train_with_checkpoints(
+            LBFGS(max_iter=30, tol=1e-9), make_loss(ds8), x0, opt_ck,
+            interval=2, on_step=maybe_kill_h1, supervisor=sup,
+            backoff_base_s=0.001, seed=3)
+        assert tripped["done"]
+        assert sup.rebuilds == 1
+        assert "h1" in sup.lost_workers()
+        assert sup.health.is_excluded("h1") is False  # one strike so far
+        assert ctx.mesh_runtime.n_devices == 4
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5, atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+# -- the TCP leg: injected connection resets ------------------------------------
+
+def test_heartbeat_connection_resets_do_not_kill_worker():
+    """Scheduled connection resets on the sender's pings: the sender
+    retries at the next interval (the production contract for a flaky
+    driver link) and the worker never expires."""
+    from cycloneml_tpu.parallel.resilience import (HeartbeatSender,
+                                                   HeartbeatServer)
+
+    recv = HeartbeatReceiver(timeout_s=5.0)
+    server = HeartbeatServer(recv)
+    sched = FaultSchedule()
+    sched.window("heartbeat.send", 2, 4,
+                 InjectedConnectionReset("peer reset"))
+    try:
+        with FaultInjector(sched) as inj:
+            sender = HeartbeatSender("w0", server.address, interval_s=0.05)
+            deadline = time.time() + 5
+            while inj.counts.get("heartbeat.send", 0) < 6:
+                assert time.time() < deadline
+                time.sleep(0.02)
+            sender.stop()
+        assert [(p, n) for p, n, _ in inj.log] == [
+            ("heartbeat.send", 2), ("heartbeat.send", 3),
+            ("heartbeat.send", 4)]
+        assert recv.live_workers() == ["w0"]  # survived all three resets
+        assert not recv.lost_workers()
+    finally:
+        server.stop()
+
+
+# -- schedule determinism --------------------------------------------------------
+
+def test_probabilistic_schedule_is_deterministic_under_seed():
+    """A probabilistic fault window replays the identical fire pattern for
+    the same seed — the property every chaos test above leans on."""
+    from cycloneml_tpu.parallel import faults
+
+    def drive(seed):
+        sched = FaultSchedule(seed=seed)
+        sched.window("p", 1, 40, TransientCollectiveError("x"), p=0.35)
+        fired = []
+        with FaultInjector(sched) as inj:
+            for i in range(40):
+                try:
+                    faults.inject("p")
+                except TransientCollectiveError:
+                    fired.append(i)
+        assert [n for _, n, _ in inj.log] == [i + 1 for i in fired]
+        return fired
+
+    a, b = drive(seed=123), drive(seed=123)
+    assert a == b and 0 < len(a) < 40  # fired some, not all
+
+
+def test_injector_installs_exclusively():
+    inj = FaultInjector(FaultSchedule())
+    with inj:
+        with pytest.raises(RuntimeError, match="already installed"):
+            FaultInjector(FaultSchedule()).__enter__()
+    # uninstalled on exit: a fresh injector can install now
+    with FaultInjector(FaultSchedule()):
+        pass

@@ -82,6 +82,42 @@ def test_replay_of_finished_job_is_noop(tmp_path):
     assert again.iteration == final.iteration and again.converged
 
 
+def test_truncated_legacy_checkpoint_surfaces_checkpoint_corrupt(tmp_path):
+    """A pre-checksum checkpoint whose state.pkl was truncated must raise
+    CheckpointCorrupt (not EOFError/UnpicklingError) and restore() must
+    fall back to an older intact step."""
+    import json
+
+    from cycloneml_tpu.util.checkpoint import CheckpointCorrupt
+
+    ck = TrainingCheckpointer(str(tmp_path))
+    ck.save(2, {"x": np.arange(4.0)})
+    # hand-build a LEGACY (no checksums) newest step with a torn payload
+    legacy = tmp_path / "step_000000000005"
+    os.makedirs(legacy)
+    import pickle
+    blob = pickle.dumps({"x": np.arange(8.0)})
+    (legacy / "state.pkl").write_bytes(blob[: len(blob) // 2])
+    (legacy / "METADATA.json").write_text(json.dumps({"step": 5}))
+
+    assert ck.latest_step() == 5
+    with pytest.raises(CheckpointCorrupt, match="does not unpickle"):
+        ck.restore(5)
+    assert ck.latest_verifiable_step() == 2
+    np.testing.assert_array_equal(ck.restore()["x"], np.arange(4.0))
+
+
+def test_checkpoint_metadata_records_checksums(tmp_path):
+    ck = TrainingCheckpointer(str(tmp_path))
+    ck.save(1, {"w": np.arange(3.0)})
+    files = ck.metadata(1)["files"]
+    assert set(files) == {"state.pkl"}
+    assert len(files["state.pkl"]["sha256"]) == 64
+    assert files["state.pkl"]["bytes"] == os.path.getsize(
+        tmp_path / "step_000000000001" / "state.pkl")
+    assert ck.verify(1)
+
+
 def test_checkpointer_device_arrays(ctx, tmp_path):
     import jax.numpy as jnp
     ck = TrainingCheckpointer(str(tmp_path))
@@ -148,7 +184,63 @@ def test_retry_step_gives_up():
         raise RuntimeError("broken")
 
     with pytest.raises(RuntimeError, match="failed 3 times"):
-        retry_step(always, max_failures=3)
+        retry_step(always, max_failures=3, backoff_base_s=0.0)
+
+
+def test_retry_step_fails_fast_on_permanent():
+    """TypeError (and tracing errors) mean the step function itself is
+    broken: no retries, the original error propagates untouched."""
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise TypeError("jit got a bad argument")
+
+    with pytest.raises(TypeError, match="bad argument"):
+        retry_step(broken, max_failures=5)
+    assert calls["n"] == 1  # zero retries
+
+
+def test_retry_step_fails_fast_on_tracer_error():
+    import jax
+
+    def traced_branch():
+        @jax.jit
+        def f(x):
+            if x > 0:  # python branch on a tracer
+                return x
+            return -x
+        return f(1.0)
+
+    with pytest.raises(jax.errors.TracerBoolConversionError):
+        retry_step(traced_branch, max_failures=5)
+
+
+def test_failure_classification():
+    from cycloneml_tpu.parallel.faults import (DeviceLostError,
+                                               TransientCollectiveError)
+    from cycloneml_tpu.parallel.resilience import classify_failure
+
+    assert classify_failure(TransientCollectiveError("x")) == "transient"
+    assert classify_failure(OSError("conn reset")) == "transient"
+    assert classify_failure(DeviceLostError("gone")) == "device_loss"
+    assert classify_failure(RuntimeError("DATA_LOSS: chip fell over")) == \
+        "device_loss"
+    assert classify_failure(TypeError("bad arg")) == "permanent"
+
+
+def test_backoff_is_exponential_and_seed_deterministic():
+    import random
+
+    from cycloneml_tpu.parallel.resilience import backoff_delay
+
+    a = [backoff_delay(i, 0.1, 5.0, random.Random(42)) for i in range(6)]
+    b = [backoff_delay(i, 0.1, 5.0, random.Random(42)) for i in range(6)]
+    assert a == b  # same seed, same jitter schedule
+    for i, d in enumerate(a):
+        lo, hi = 0.05 * 2 ** i, min(5.0, 0.1 * 2 ** i)
+        assert lo <= d <= hi
+    assert backoff_delay(3, 0.0) == 0.0  # disabled backoff sleeps nothing
 
 
 # -- exact optimizer resume -----------------------------------------------------
@@ -429,3 +521,82 @@ def test_heartbeat_over_the_wire():
     finally:
         server.stop()
         bus.stop()
+
+
+def _hb_roundtrip(address: str, line: str) -> str:
+    """One raw-socket request against a HeartbeatServer (no auth)."""
+    import socket
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        s.sendall((line + "\n").encode())
+        f = s.makefile("r")
+        try:
+            return f.readline().strip()
+        finally:
+            f.close()
+
+
+def test_heartbeat_wire_protocol_expiry(monkeypatch):
+    """Raw wire protocol: REG→OK, HB→OK, HB after expiry→EXPIRED, re-REG
+    revives, garbage→ERR."""
+    import time
+    from cycloneml_tpu.parallel.resilience import HeartbeatServer
+
+    monkeypatch.delenv("CYCLONE_AUTH_SECRET", raising=False)
+    recv = HeartbeatReceiver(timeout_s=0.0)  # everything expires on sweep
+    server = HeartbeatServer(recv)
+    try:
+        assert _hb_roundtrip(server.address, "REG w9") == "OK"
+        assert _hb_roundtrip(server.address, "HB w9") == "OK"
+        time.sleep(0.01)
+        recv.check_now()  # w9 expires
+        assert _hb_roundtrip(server.address, "HB w9") == "EXPIRED"
+        assert _hb_roundtrip(server.address, "REG w9") == "OK"  # revival
+        assert _hb_roundtrip(server.address, "HB w9") == "OK"
+        assert _hb_roundtrip(server.address, "BOGUS") == "ERR"
+        assert _hb_roundtrip(server.address, "HB a b c") == "ERR"
+    finally:
+        server.stop()
+
+
+def test_heartbeat_sender_stops_on_missing_secret(monkeypatch):
+    """Server requires the fabric secret, sender resolves none: the first
+    reply is the auth challenge, the sender fails loudly (PermissionError)
+    and STOPS its loop instead of spinning forever."""
+    import time
+    from cycloneml_tpu.parallel.resilience import (HeartbeatSender,
+                                                   HeartbeatServer)
+
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", "right-secret")
+    recv = HeartbeatReceiver(timeout_s=30.0)
+    server = HeartbeatServer(recv)  # binds WITH the secret
+    try:
+        monkeypatch.delenv("CYCLONE_AUTH_SECRET")
+        sender = HeartbeatSender("w0", server.address, interval_s=0.05)
+        sender._thread.join(timeout=5)
+        assert not sender._thread.is_alive()  # loop stopped itself
+        assert recv.live_workers() == []      # never authenticated
+        sender.stop()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_sender_stops_on_wrong_secret(monkeypatch):
+    """A sender with the WRONG secret is denied by the mutual handshake and
+    stops its loop (retrying can never succeed)."""
+    import time
+    from cycloneml_tpu.parallel.resilience import (HeartbeatSender,
+                                                   HeartbeatServer)
+
+    monkeypatch.setenv("CYCLONE_AUTH_SECRET", "right-secret")
+    recv = HeartbeatReceiver(timeout_s=30.0)
+    server = HeartbeatServer(recv)
+    try:
+        monkeypatch.setenv("CYCLONE_AUTH_SECRET", "wrong-secret")
+        sender = HeartbeatSender("w0", server.address, interval_s=0.05)
+        sender._thread.join(timeout=5)
+        assert not sender._thread.is_alive()
+        assert recv.live_workers() == []
+        sender.stop()
+    finally:
+        server.stop()
