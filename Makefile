@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-json test chaos
+.PHONY: lint lint-json test chaos obs-demo
 
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
@@ -18,3 +18,7 @@ test:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
 	    -p no:cacheprovider
+
+# small traced fit -> exported Chrome trace -> schema + profile validation
+obs-demo:
+	JAX_PLATFORMS=cpu python scripts/obs_demo.py

@@ -112,7 +112,10 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     ctx = CycloneContext.get_or_create(
         CycloneConf().set("cyclone.app.name", "bench")
         # whole 25-iteration budget in ONE device dispatch
-        .set("cyclone.ml.lbfgs.deviceChunk", str(iters + 8)))
+        .set("cyclone.ml.lbfgs.deviceChunk", str(iters + 8))
+        # trace the WARM-UP fit only: its FitProfile attributes the
+        # trace/compile phase; tracing is disabled before the timed trials
+        .set("cyclone.trace.enabled", "true"))
     t0 = time.perf_counter()
     ds = generate_classification(ctx, n, d, seed=0)
     gen_s = time.perf_counter() - t0
@@ -140,6 +143,12 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     warm_s = time.perf_counter() - t0
     print(f"info: warm-up fit (compiles + relay warmup) took {warm_s:.2f}s",
           file=sys.stderr)
+    # per-fit profile of the warm-up fit: how much of warm_s was staging
+    # (trace + XLA compile) vs dispatch vs readback
+    from cycloneml_tpu.observe import tracing as _tracing
+    ctx.listener_bus.wait_until_empty()
+    warm_profile = ctx.fit_profile() or {}
+    _tracing.disable()  # timed trials below run with tracing off
     # >=3 timed trials, MEDIAN reported: the relay shows ~15% run-to-run
     # spread, so a single-trial headline is not quotable (r4 verdict)
     trials = max(3, int(os.environ.get("BENCH_TRIALS", 3)))
@@ -159,14 +168,33 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     its = model.summary.total_iterations
     evals = getattr(model.summary, "total_evals", None)
     dispatches = getattr(model.summary, "total_dispatches", None)
-    return dt, its, evals, dispatches, n, d, ceiling_bw
+    phases = {
+        "warm_fit_s": round(warm_s, 3),
+        "compile_s": round(warm_profile.get("compile_seconds", 0.0), 3),
+        "compile_count": warm_profile.get("compile_count", 0),
+        "cache_hits": warm_profile.get("cache_hits", 0),
+        "cache_misses": warm_profile.get("cache_misses", 0),
+        "steady_fit_s": round(dt, 3),
+        "steady_per_iter_ms": round(dt / max(its, 1) * 1e3, 2),
+        "transfer_s": round(warm_profile.get("transfer_seconds", 0.0), 4),
+        "transfer_bytes": warm_profile.get("transfer_bytes", 0),
+    }
+    print(f"info: phase breakdown: warm fit {phases['warm_fit_s']}s "
+          f"(compile {phases['compile_s']}s over "
+          f"{phases['compile_count']} program(s), program cache "
+          f"{phases['cache_hits']} hits / {phases['cache_misses']} misses) "
+          f"vs steady-state {phases['steady_fit_s']}s "
+          f"({phases['steady_per_iter_ms']} ms/iter)", file=sys.stderr)
+    return dt, its, evals, dispatches, n, d, ceiling_bw, phases
 
 
 def main() -> None:
     err = None
     ceiling_bw = None
+    phases = None
     try:
-        fit_s, its, evals, dispatches, n, d, ceiling_bw = bench_logreg_fit()
+        (fit_s, its, evals, dispatches, n, d, ceiling_bw,
+         phases) = bench_logreg_fit()
     except Exception as e:  # bench must still emit its line
         err = e
         fit_s = None
@@ -218,6 +246,7 @@ def main() -> None:
             "value": round(mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(mops / REF_DGEMM_MOPS, 2),
+            "phases": phases,
         }))
     elif gemm_mops is not None:
         print(f"info: logreg bench failed: {err}", file=sys.stderr)

@@ -534,3 +534,30 @@ PROMETHEUS_PORT = (
          "(ref: PrometheusServlet.scala).")
     .int_conf(0)
 )
+
+TRACE_ENABLED = (
+    ConfigBuilder("cyclone.trace.enabled")
+    .doc("Enable step-level tracing (observe/): hierarchical spans over "
+         "compile/dispatch/collective/transfer/checkpoint, per-fit "
+         "FitProfiles in the status store, Chrome-trace export. Off by "
+         "default; the disabled cost at every instrumentation site is one "
+         "module-global read. The CYCLONE_TRACE env var (any truthy value) "
+         "also enables it.")
+    .bool_conf(False)
+)
+
+TRACE_DIR = (
+    ConfigBuilder("cyclone.trace.dir")
+    .doc("When set (and tracing is enabled), the context exports "
+         "<dir>/<app_id>.trace.json — Chrome Trace Event Format, loadable "
+         "in Perfetto — on stop().")
+    .str_conf("")
+)
+
+TRACE_MAX_SPANS = (
+    ConfigBuilder("cyclone.trace.maxSpans")
+    .doc("Span buffer bound; past it new spans are dropped (and counted) "
+         "rather than growing without limit.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(100_000)
+)

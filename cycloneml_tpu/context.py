@@ -26,9 +26,11 @@ from cycloneml_tpu.conf import (
     EVENT_LOG_DIR, EVENT_LOG_ENABLED, MASTER, METRICS_CSV_DIR,
     METRICS_PERIOD_S, METRICS_SINKS, PROMETHEUS_PORT,
 )
+from cycloneml_tpu.observe import tracing as _tracing
 from cycloneml_tpu.util.events import (
     ApplicationEnd, ApplicationStart, BlocksMigrated, CycloneEvent,
-    EventJournal, JobEnd, JobStart, ListenerBus, MeshUp, StepCompleted,
+    EventJournal, FitProfileCompleted, JobEnd, JobStart, ListenerBus, MeshUp,
+    StepCompleted,
 )
 from cycloneml_tpu.util.metrics import ConsoleSink, CsvSink, MetricsSystem
 from cycloneml_tpu.util.status import AppStatusListener
@@ -196,6 +198,19 @@ class CycloneContext:
             "listenerBus.queued", lambda: self.listener_bus.metrics["queued"])
         self.metrics.start()
 
+        # step-level tracing (observe/): conf or CYCLONE_TRACE env var; the
+        # context only disables a tracer it installed itself, so a tracer
+        # enabled programmatically (tests, bench) survives ctx teardown
+        from cycloneml_tpu.conf import TRACE_ENABLED, TRACE_MAX_SPANS
+        self._trace_owner = False
+        want_trace = self.conf.get(TRACE_ENABLED) or \
+            os.environ.get("CYCLONE_TRACE", "").lower() not in \
+            ("", "0", "false", "no")
+        if want_trace and _tracing.active() is None:
+            _tracing.enable(max_spans=self.conf.get(TRACE_MAX_SPANS),
+                            registry=self.metrics.registry)
+            self._trace_owner = True
+
         from cycloneml_tpu.conf import PLUGINS
         from cycloneml_tpu.plugin import load_plugins
         self._plugins = load_plugins(
@@ -250,7 +265,19 @@ class CycloneContext:
             self._active_jobs += 1
         self._next_job += 1
         jid = self._next_job
-        self.listener_bus.post(JobStart(job_id=jid, description=description))
+        # traced jobs open a root 'job' span; every span the fit opens in
+        # this thread nests under it, and the rollup posts as a FitProfile
+        tracer = _tracing.active()
+        job_span = tracer.span("job", description) if tracer is not None \
+            else None
+        sid = ""
+        mark = 0
+        if job_span is not None:
+            mark = tracer.mark()  # rollup scans only this job's spans
+            job_span.__enter__()
+            sid = job_span.span_id
+        self.listener_bus.post(JobStart(job_id=jid, description=description,
+                                        span_id=sid))
         self._job_stack.append(jid)
         self.metrics.registry.counter("jobs.started").inc()
         try:
@@ -265,6 +292,16 @@ class CycloneContext:
             with self._job_cond:
                 self._active_jobs -= 1
                 self._job_cond.notify_all()
+            if job_span is not None:
+                job_span.__exit__(None, None, None)
+                try:
+                    prof = tracer.profile_for(sid, since=mark)
+                    prof.job_id = jid
+                    prof.description = description
+                    self.listener_bus.post(FitProfileCompleted(
+                        job_id=jid, profile=prof.to_dict()))
+                except Exception:
+                    logger.exception("fit profile rollup failed")
         self.listener_bus.post(JobEnd(job_id=jid, succeeded=True))
         self.metrics.registry.counter("jobs.succeeded").inc()
         return out
@@ -296,8 +333,9 @@ class CycloneContext:
         jid = self.current_job_id
         step = self._job_steps.get(jid, 0)
         self._job_steps[jid] = step + 1
-        self.listener_bus.post(StepCompleted(job_id=jid, step=step,
-                                             metrics=dict(step_metrics)))
+        self.listener_bus.post(StepCompleted(
+            job_id=jid, step=step, metrics=dict(step_metrics),
+            span_id=_tracing.current_span_id()))
         reg = self.metrics.registry
         reg.counter("steps.completed").inc()
         for k, v in step_metrics.items():
@@ -468,6 +506,25 @@ class CycloneContext:
         import jax
         return jax.profiler.trace(log_dir)
 
+    def export_trace(self, path: str) -> str:
+        """Write the step-level Chrome trace (observe/) collected so far to
+        ``path``; requires tracing to be enabled (cyclone.trace.enabled /
+        CYCLONE_TRACE). Load the file in Perfetto or chrome://tracing."""
+        tracer = _tracing.active()
+        if tracer is None:
+            raise RuntimeError(
+                "tracing is not enabled; set cyclone.trace.enabled=true "
+                "(or CYCLONE_TRACE=1) before creating the context")
+        return tracer.export_chrome_trace(path)
+
+    def fit_profile(self, job_id: Optional[int] = None):
+        """FitProfile dict for ``job_id`` (default: the most recent job
+        that has one), or {} when tracing was off."""
+        store = self.status_store
+        if job_id is not None:
+            return store.profile(job_id)
+        return store.latest_profile()
+
     @property
     def checkpoint_dir(self) -> str:
         return self.conf.get(CHECKPOINT_DIR)
@@ -513,6 +570,20 @@ class CycloneContext:
                     _ExchangeServer.close_address(addrs[rank])
         except Exception:
             logger.exception("exchange server shutdown failed")
+        if getattr(self, "_trace_owner", False):
+            tracer = _tracing.active()
+            if tracer is not None:
+                from cycloneml_tpu.conf import TRACE_DIR
+                d = self.conf.get(TRACE_DIR)
+                if d:
+                    try:
+                        os.makedirs(d, exist_ok=True)
+                        path = os.path.join(d, f"{self.app_id}.trace.json")
+                        tracer.export_chrome_trace(path)
+                        logger.info("trace exported to %s", path)
+                    except Exception:
+                        logger.exception("trace export failed")
+                _tracing.disable()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

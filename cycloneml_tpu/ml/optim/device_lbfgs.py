@@ -29,6 +29,7 @@ from typing import Optional
 import numpy as np
 
 from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OptimState
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.parallel.collectives import BoundedProgramCache
 
 _program_cache = BoundedProgramCache(32)
@@ -191,7 +192,8 @@ class DeviceLBFGS(LBFGS):
         key = ("lbfgs_chunk", f._agg_call.compiled, l2_t, self.m, self.chunk,
                float(self.c1), float(self.c2), int(self.max_ls), cdt.str)
         prog = _program_cache.get(key)
-        if prog is None:
+        fresh = prog is None  # first dispatch below pays trace + compile
+        if fresh:
             prog = _build_chunk(f._agg_call.compiled, l2_t, self.m,
                                 self.chunk, self.c1, self.c2, self.max_ls,
                                 cdt)
@@ -239,15 +241,26 @@ class DeviceLBFGS(LBFGS):
             # dispatch; the full f64 state materializes on yield only when
             # a consumer touches the arrays (np.asarray forces the copy)
             base_iter = state.iteration if state is not None else 0
-            (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d, evals_d,
-             code_d, f0_d, g0_d) = prog(
-                *arrays, coef, S_d, Y_d, k_d, f_d, g_d,
-                np.bool_(first), cdt.type(f.weight_sum),
-                cdt.type(self.tol), cdt.type(self.grad_tol),
-                np.int32(max(self.max_iter - base_iter, 0)),
-                np.bool_(need_init))
-            f_h, losses, it, evals, code, k_h, f0_h = jax.device_get(
-                (f_d, losses_d, it_d, evals_d, code_d, k_d, f0_d))
+            args = (*arrays, coef, S_d, Y_d, k_d, f_d, g_d,
+                    np.bool_(first), cdt.type(f.weight_sum),
+                    cdt.type(self.tol), cdt.type(self.grad_tol),
+                    np.int32(max(self.max_iter - base_iter, 0)),
+                    np.bool_(need_init))
+            with tracing.span("dispatch", "lbfgs.chunk") as dsp:
+                if fresh:
+                    with tracing.span("compile", "lbfgs.chunk"):
+                        (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d,
+                         evals_d, code_d, f0_d, g0_d) = prog(*args)
+                    fresh = False
+                else:
+                    (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d,
+                     evals_d, code_d, f0_d, g0_d) = prog(*args)
+                with tracing.span("transfer", "lbfgs.readback") as tsp:
+                    f_h, losses, it, evals, code, k_h, f0_h = jax.device_get(
+                        (f_d, losses_d, it_d, evals_d, code_d, k_d, f0_d))
+                    tsp.annotate_bytes(
+                        (f_h, losses, it, evals, code, k_h, f0_h))
+            dsp.annotate(evals=int(evals))
             coef = coef_d
             first = False
             f.n_evals += int(evals)

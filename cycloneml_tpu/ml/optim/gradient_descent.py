@@ -16,6 +16,7 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -117,9 +118,13 @@ class GradientDescent:
                                       self.reg_param)
         updates = 0
         for t in range(1, self.num_iterations + 1):
-            # one transfer for count+loss+grad, not three (graftlint JX001)
-            out = jax.device_get(compiled(jnp.asarray(w, jnp.float32),
-                                          jnp.asarray(t, jnp.int32)))
+            with tracing.span("dispatch", "gd.step", evals=1):
+                out_dev = compiled(jnp.asarray(w, jnp.float32),
+                                   jnp.asarray(t, jnp.int32))
+                # one transfer for count+loss+grad, not three (JX001)
+                with tracing.span("transfer", "gd.readback") as tsp:
+                    out = jax.device_get(out_dev)
+                    tsp.annotate_bytes(out)
             count = float(out["count"])
             if count <= 0:
                 # empty mini-batch: no update, no history entry (the

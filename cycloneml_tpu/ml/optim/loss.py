@@ -16,6 +16,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from cycloneml_tpu.dataset.dataset import InstanceDataset
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.parallel import collectives
 
 
@@ -77,7 +78,11 @@ class DistributedLossFunction:
         self.n_evals += 1
         self.n_dispatches += 1
         import jax
-        out = jax.device_get(self._agg_call(coef))  # one transfer, not two
+        with tracing.span("dispatch", "loss.eval", evals=1):
+            out_dev = self._agg_call(coef)  # 'collective' span inside
+            with tracing.span("transfer", "loss.readback") as tsp:
+                out = jax.device_get(out_dev)  # one transfer, not two
+                tsp.annotate_bytes(out)
         loss = float(out["loss"]) / self.weight_sum
         grad = np.asarray(out["grad"], dtype=np.float64) / self.weight_sum
         if self.l2_reg_fn is not None:
@@ -130,19 +135,30 @@ class DistributedLossFunction:
         key = (self._agg_call.compiled, l2_t, float(c1), float(c2),
                int(max_evals), cdt.str)
         fn = _ls_program_cache.get(key)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             fn = _build_line_search(self._agg_call.compiled, l2_t,
                                     c1, c2, max_evals, cdt)
             # bounded: standardization=False fits key on a fresh l2 fn per
             # fit and would otherwise grow this without limit
             _ls_program_cache.put(key, fn)
-        out = jax.device_get(fn(*arrays,
-                                np.asarray(x, dtype=cdt),
-                                np.asarray(direction, dtype=cdt),
-                                cdt.type(value), cdt.type(dg0),
-                                cdt.type(init_alpha),
-                                cdt.type(self.weight_sum)))
+        args = (*arrays,
+                np.asarray(x, dtype=cdt),
+                np.asarray(direction, dtype=cdt),
+                cdt.type(value), cdt.type(dg0),
+                cdt.type(init_alpha),
+                cdt.type(self.weight_sum))
+        with tracing.span("dispatch", "lbfgs.line_search") as dsp:
+            if fresh:
+                with tracing.span("compile", "lbfgs.line_search"):
+                    res = fn(*args)
+            else:
+                res = fn(*args)
+            with tracing.span("transfer", "line_search.readback") as tsp:
+                out = jax.device_get(res)
+                tsp.annotate_bytes(out)
         alpha, v, g, evals = out
+        dsp.annotate(evals=int(evals))
         self.n_evals += int(evals)
         self.n_dispatches += 1
         loss = float(v)

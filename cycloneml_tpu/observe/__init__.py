@@ -1,0 +1,22 @@
+"""Observability: step-level tracing, per-fit profiles, Chrome-trace export.
+
+See docs/observability.md for the span taxonomy, the metric catalogue and
+how to capture a trace from a fit. Import-light on purpose: nothing here
+touches jax, so the analysis tooling and pure-host paths can import it
+freely.
+"""
+
+from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe.export import (chrome_trace, export_chrome_trace,
+                                          span_kinds, validate_chrome_trace)
+from cycloneml_tpu.observe.profile import FitProfile
+from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
+                                           current_span_id, disable, enable,
+                                           instant, span)
+
+__all__ = [
+    "tracing", "Span", "Tracer", "FitProfile",
+    "enable", "disable", "active", "span", "instant", "current_span_id",
+    "chrome_trace", "export_chrome_trace", "validate_chrome_trace",
+    "span_kinds",
+]

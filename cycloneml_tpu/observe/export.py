@@ -1,0 +1,128 @@
+"""Chrome-trace (Trace Event Format) export.
+
+Writes the tracer's spans as the JSON Object Format chrome://tracing and
+Perfetto both load: ``{"traceEvents": [...], "displayTimeUnit": "ms"}``
+with complete (``ph: "X"``) events for spans and instant (``ph: "i"``)
+events for annotations. Timestamps are wall-clock microseconds (the
+tracer anchors its monotonic clock to ``time.time`` at construction), so
+traces from cooperating processes line up on one timeline.
+
+``validate_chrome_trace`` is the schema check ``make obs-demo`` and the
+tier-1 tests run over an exported file — it pins the invariants Perfetto
+needs rather than trusting the writer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Union
+
+REQUIRED_TOP = "traceEvents"
+DURATION_PH = "X"
+INSTANT_PH = "i"
+METADATA_PH = "M"
+
+
+def chrome_trace(tracer) -> Dict[str, Any]:
+    """Render a tracer's spans to a Trace Event Format object."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": METADATA_PH, "pid": pid, "tid": 0,
+        "args": {"name": "cycloneml-tpu"},
+    }]
+    base = tracer.epoch_wall - tracer.epoch_perf
+    for s in tracer.snapshot():
+        ts_us = (base + s.t0) * 1e6
+        args = {"span_id": s.span_id}
+        if s.parent_id:
+            args["parent_id"] = s.parent_id
+        args.update(s.attrs)
+        if s.kind == "instant":
+            events.append({
+                "name": s.name, "cat": "instant", "ph": INSTANT_PH,
+                "ts": ts_us, "pid": pid, "tid": s.tid, "s": "t",
+                "args": args,
+            })
+        else:
+            events.append({
+                "name": s.name, "cat": s.kind, "ph": DURATION_PH,
+                "ts": ts_us,
+                # zero-duration X events render invisibly; floor at 1ns
+                "dur": max((s.t1 - s.t0) * 1e6, 0.001),
+                "pid": pid, "tid": s.tid, "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(tracer, path: str) -> str:
+    """Write the trace JSON to ``path`` (returns the path)."""
+    obj = chrome_trace(tracer)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, default=str)
+    os.replace(tmp, path)  # readers never see a half-written trace
+    return path
+
+
+def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
+                          ) -> List[str]:
+    """Return schema violations (empty list = loads in Perfetto).
+
+    Checks: top-level ``traceEvents`` list; every event has ``name``/
+    ``ph``/``pid``; duration events carry numeric ``ts`` and ``dur >= 0``;
+    instant events carry numeric ``ts``; ``args`` (when present) is an
+    object.
+    """
+    if isinstance(obj_or_path, str):
+        with open(obj_or_path, encoding="utf-8") as fh:
+            try:
+                obj = json.load(fh)
+            except json.JSONDecodeError as e:
+                return [f"not valid JSON: {e}"]
+    else:
+        obj = obj_or_path
+    errors: List[str] = []
+    if not isinstance(obj, dict) or REQUIRED_TOP not in obj:
+        return [f"top level must be an object with a {REQUIRED_TOP!r} list"]
+    events = obj[REQUIRED_TOP]
+    if not isinstance(events, list):
+        return [f"{REQUIRED_TOP!r} must be a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        for req in ("name", "ph", "pid"):
+            if req not in ev:
+                errors.append(f"{where}: missing {req!r}")
+        ph = ev.get("ph")
+        if ph == METADATA_PH:
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"{where}: non-numeric 'ts'")
+        if ph == DURATION_PH:
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: X event needs numeric 'dur' >= 0")
+        elif ph != INSTANT_PH:
+            errors.append(f"{where}: unexpected ph {ph!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where}: 'args' must be an object")
+    return errors
+
+
+def span_kinds(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[str, int]:
+    """Count events per category — the obs-demo's >= 4 distinct-kinds
+    acceptance check reads this."""
+    if isinstance(obj_or_path, str):
+        with open(obj_or_path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    else:
+        obj = obj_or_path
+    out: Dict[str, int] = {}
+    for ev in obj.get(REQUIRED_TOP, []):
+        if isinstance(ev, dict) and ev.get("ph") != METADATA_PH:
+            cat = ev.get("cat", "")
+            out[cat] = out.get(cat, 0) + 1
+    return out

@@ -1,0 +1,155 @@
+"""Per-fit profile aggregation over recorded spans.
+
+The TPU-native analog of the reference's ``TaskMetrics`` rollup (ref:
+executor/TaskMetrics.scala aggregated per stage by AppStatusListener): one
+:class:`FitProfile` summarises where a fit's wall clock went — staging
+(trace + XLA compile) vs steady-state dispatch vs device→host transfer —
+plus the reliability counters a chaos run cares about (faults, retries,
+mesh rebuilds). ``CycloneContext.run_job`` computes one per job when
+tracing is enabled and posts it as a ``FitProfileCompleted`` event, so the
+status store / web UI / history replay all carry it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclass
+class FitProfile:
+    """Aggregate of one fit's spans (see tracing.py for the kind taxonomy).
+
+    ``eval_count`` sums the ``evals`` attr on dispatch spans — it matches
+    the optimizer's ``n_evals`` ledger (``bench.py``'s "loss/grad evals")
+    the same way ``dispatch_count`` matches ``n_dispatches``.
+    ``steady_seconds`` is dispatch time excluding dispatches that paid a
+    compile (their wall time is staging, not steady state).
+    """
+
+    job_id: int = 0
+    description: str = ""
+    wall_seconds: float = 0.0
+    compile_count: int = 0
+    compile_seconds: float = 0.0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    dispatch_count: int = 0
+    dispatch_seconds: float = 0.0
+    steady_seconds: float = 0.0
+    eval_count: int = 0
+    collective_count: int = 0
+    collective_seconds: float = 0.0
+    transfer_count: int = 0
+    transfer_seconds: float = 0.0
+    transfer_bytes: int = 0
+    checkpoint_saves: int = 0
+    checkpoint_restores: int = 0
+    checkpoint_seconds: float = 0.0
+    retries: int = 0
+    rebuilds: int = 0
+    faults_injected: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FitProfile":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @classmethod
+    def from_spans(cls, spans: Sequence[Any],
+                   root_id: Optional[str] = None) -> "FitProfile":
+        """Fold spans into a profile. With ``root_id``, only spans whose
+        parent chain reaches that span (plus the root itself) count — the
+        per-job scoping ``run_job`` uses."""
+        if root_id:
+            parent = {s.span_id: s.parent_id for s in spans}
+            selected: List[Any] = []
+            member: Dict[str, bool] = {root_id: True}
+
+            def in_tree(sid: str) -> bool:
+                chain = []
+                while sid and sid not in member:
+                    chain.append(sid)
+                    sid = parent.get(sid, "")
+                verdict = bool(sid) and member[sid]
+                for c in chain:
+                    member[c] = verdict
+                return verdict
+
+            for s in spans:
+                if s.span_id == root_id or in_tree(s.span_id):
+                    selected.append(s)
+            spans = selected
+
+        p = cls()
+        compiles: List[Any] = []
+        dispatches: List[Any] = []
+        for s in spans:
+            dur = s.duration_s
+            k = s.kind
+            if k == "job":
+                if root_id is None or s.span_id == root_id:
+                    p.wall_seconds = max(p.wall_seconds, dur)
+                    p.description = p.description or s.name
+            elif k == "compile":
+                p.compile_count += 1
+                p.compile_seconds += dur
+                compiles.append(s)
+            elif k == "dispatch":
+                p.dispatch_count += 1
+                p.dispatch_seconds += dur
+                p.eval_count += int(s.attrs.get("evals", 0))
+                dispatches.append(s)
+            elif k == "collective":
+                p.collective_count += 1
+                p.collective_seconds += dur
+            elif k == "transfer":
+                p.transfer_count += 1
+                p.transfer_seconds += dur
+                p.transfer_bytes += int(s.attrs.get("bytes", 0))
+            elif k == "checkpoint":
+                if s.name == "save":
+                    p.checkpoint_saves += 1
+                    p.checkpoint_seconds += dur
+                elif s.name == "restore":
+                    p.checkpoint_restores += 1
+                    p.checkpoint_seconds += dur
+            elif k == "rebuild":
+                p.rebuilds += 1
+            elif k == "instant":
+                if s.name == "fault":
+                    p.faults_injected += 1
+                elif s.name == "retry":
+                    p.retries += 1
+                elif s.name == "cache.hit":
+                    p.cache_hits += 1
+                elif s.name == "cache.miss":
+                    p.cache_misses += 1
+        # steady state = dispatches that did not pay a compile anywhere in
+        # their subtree. A compile may nest more than one level down
+        # (loss.eval dispatch → tree_aggregate collective → compile), so
+        # every ANCESTOR of a compile span is staging, not steady state.
+        parents = {s.span_id: s.parent_id for s in spans}
+        staging = set()
+        for c in compiles:
+            sid = c.parent_id
+            while sid and sid not in staging:
+                staging.add(sid)
+                sid = parents.get(sid, "")
+        p.steady_seconds = sum(
+            s.duration_s for s in dispatches if s.span_id not in staging)
+        return p
+
+    def phase_summary(self) -> Dict[str, float]:
+        """The compile-vs-steady-state breakdown bench.py prints."""
+        return {
+            "compile_s": round(self.compile_seconds, 4),
+            "steady_s": round(self.steady_seconds, 4),
+            "transfer_s": round(self.transfer_seconds, 4),
+            "checkpoint_s": round(self.checkpoint_seconds, 4),
+            "wall_s": round(self.wall_seconds, 4),
+        }

@@ -1,0 +1,314 @@
+"""Step-level tracing: hierarchical spans over the trace→compile→dispatch→
+collective path.
+
+The framework's performance story lives in one narrow boundary (estimator →
+block aggregator → ``tree_aggregate`` → psum), yet tracing-JIT systems hide
+exactly where a fit's wall clock goes: staging costs (trace + XLA compile)
+happen once, silently, and dominate small fits (Frostig et al., SysML 2018),
+while steady-state cost is per-dispatch latency plus device→host readbacks.
+This module makes those phases visible the way Dapper makes RPC trees
+visible (Sigelman et al. 2010, PAPERS.md): every instrumented boundary opens
+a :class:`Span` (kind + name + wall window + attrs) nested under the
+current thread's open span, and the process-global :class:`Tracer` collects
+them for per-fit :class:`~cycloneml_tpu.observe.profile.FitProfile`
+aggregation and Chrome-trace export
+(:mod:`cycloneml_tpu.observe.export` — loads in Perfetto / chrome://tracing).
+
+Span kind taxonomy (docs/observability.md has the full catalogue):
+
+=============  ==============================================================
+kind           opened around
+=============  ==============================================================
+``job``        a ``ctx.run_job`` bracket (one estimator ``fit``)
+``dispatch``   one optimizer-level device dispatch (loss eval, fused line
+               search, L-BFGS chunk, GD step); ``evals`` attr carries the
+               loss/grad evaluations the dispatch performed
+``collective`` one dispatch of a ``tree_aggregate`` psum program
+``compile``    the FIRST dispatch of a freshly built program — the call that
+               pays tracing + XLA compilation (program-cache misses)
+``transfer``   a blocking ``jax.device_get`` readback; ``bytes`` attr
+``checkpoint`` ``TrainingCheckpointer`` save / commit / restore
+``rebuild``    a ``MeshSupervisor.recover`` mesh rebuild
+``instant``    zero-duration annotations: injected faults, step retries,
+               program-cache hits/misses
+=============  ==============================================================
+
+Off by default with near-zero disabled cost: every instrumentation site
+performs ONE module-global read (the same pattern ``faults.inject`` uses)
+and :func:`span` returns a shared no-op context manager — no allocation, no
+clock read. Enabled via :func:`enable` (``CycloneContext`` does this when
+``cyclone.trace.enabled`` / ``CYCLONE_TRACE`` is set).
+
+Tracer-awareness contract: instrumentation sites that can be reached at
+JAX trace time (a program inlined into a larger jitted program) must NOT
+open spans there — a span records host wall clock, which is meaningless
+inside tracing and would bake host work into the program (see
+``collectives._instrument_dispatch`` and the graftlint JX001 fixture
+``tests/fixtures/graftlint/jx001_tracing_pass.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Span", "Tracer", "enable", "disable", "active", "span", "instant",
+    "current_span_id", "nbytes",
+]
+
+
+class Span:
+    """One closed (or instant) trace span. ``t0``/``t1`` are
+    ``time.perf_counter`` readings; the owning tracer anchors them to wall
+    time for export."""
+
+    __slots__ = ("span_id", "parent_id", "kind", "name", "t0", "t1", "tid",
+                 "attrs")
+
+    def __init__(self, span_id: str, parent_id: str, kind: str, name: str,
+                 tid: int, attrs: Dict[str, Any]):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.kind = kind
+        self.name = name
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.tid = tid
+        self.attrs = attrs
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.t1 - self.t0, 0.0)
+
+    def __repr__(self) -> str:  # debugging/test readability only
+        return (f"Span({self.kind}:{self.name} id={self.span_id} "
+                f"parent={self.parent_id or '-'} dur={self.duration_s:.6f})")
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the entire disabled-tracing API surface."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def annotate(self, **attrs) -> None:
+        pass
+
+    def annotate_bytes(self, tree) -> None:
+        # no nbytes walk on the disabled path
+        pass
+
+    @property
+    def span_id(self) -> str:
+        return ""
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _LiveSpan:
+    """Context manager recording one span into its tracer."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> "_LiveSpan":
+        stack = self._tracer._stack()
+        if stack and not self.span.parent_id:
+            self.span.parent_id = stack[-1].span_id
+        stack.append(self.span)
+        self.span.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.span.t1 = time.perf_counter()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self.span:
+            stack.pop()
+        self._tracer._record(self.span)
+        return False
+
+    def annotate(self, **attrs) -> None:
+        """Attach attributes (usable during AND after the ``with`` block —
+        the recorded span holds the same attrs dict)."""
+        self.span.attrs.update(attrs)
+
+    def annotate_bytes(self, tree) -> None:
+        self.span.attrs["bytes"] = nbytes(tree)
+
+    @property
+    def span_id(self) -> str:
+        return self.span.span_id
+
+
+class Tracer:
+    """Collects spans process-wide; thread-safe.
+
+    Context propagation is per-thread (a thread-local span stack), so
+    nested fits and concurrent fits in different threads each get a correct
+    parent chain. Cross-thread propagation is explicit: capture
+    :meth:`current_span_id` in the submitting thread and pass it as
+    ``parent`` to :meth:`span` in the worker.
+
+    ``registry`` (a :class:`~cycloneml_tpu.util.metrics.MetricsRegistry`)
+    bridges spans into the metrics system: every closed span updates
+    ``span.<kind>`` (a Timer) and every instant bumps ``trace.<name>`` (a
+    Counter) — visible through the Prometheus endpoint.
+    """
+
+    def __init__(self, max_spans: int = 100_000, registry=None):
+        self.max_spans = max(1, int(max_spans))
+        self.registry = registry
+        # wall anchor: perf_counter offsets map onto real time for export
+        self.epoch_wall = time.time()
+        self.epoch_perf = time.perf_counter()
+        self._spans: List[Span] = []
+        self.dropped = 0
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- context ---------------------------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_span_id(self) -> str:
+        stack = self._stack()
+        return stack[-1].span_id if stack else ""
+
+    # -- recording -------------------------------------------------------------
+    def span(self, kind: str, name: str = "", parent: str = "",
+             **attrs) -> _LiveSpan:
+        s = Span(f"s{next(self._ids)}", parent, kind, name or kind,
+                 threading.get_ident(), attrs)
+        return _LiveSpan(self, s)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration annotation under the current span (faults,
+        retries, cache hits/misses)."""
+        s = Span(f"s{next(self._ids)}", self.current_span_id(), "instant",
+                 name, threading.get_ident(), attrs)
+        s.t0 = s.t1 = time.perf_counter()
+        self._record(s)
+
+    def _record(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(s)
+            else:
+                self.dropped += 1
+        reg = self.registry
+        if reg is not None:
+            try:
+                if s.kind == "instant":
+                    reg.counter(f"trace.{s.name}").inc()
+                else:
+                    reg.timer(f"span.{s.kind}").update(s.duration_s)
+            except Exception:
+                pass  # a broken metrics bridge must not kill the step
+
+    # -- reading ---------------------------------------------------------------
+    def snapshot(self, since: int = 0) -> List[Span]:
+        with self._lock:
+            return self._spans[since:] if since else list(self._spans)
+
+    def mark(self) -> int:
+        """Current buffer position — pass to :meth:`profile_for` as
+        ``since`` so a per-job rollup scans only the spans that job
+        recorded, not the whole process history."""
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def profile_for(self, root_id: Optional[str] = None, since: int = 0):
+        """A :class:`FitProfile` over the spans descending from ``root_id``
+        (or every recorded span when None), starting at buffer position
+        ``since`` (a :meth:`mark` taken before the root span opened)."""
+        from cycloneml_tpu.observe.profile import FitProfile
+        return FitProfile.from_spans(self.snapshot(since), root_id=root_id)
+
+    def export_chrome_trace(self, path: str) -> str:
+        from cycloneml_tpu.observe.export import export_chrome_trace
+        return export_chrome_trace(self, path)
+
+
+# -- process-global switch -----------------------------------------------------
+# The disabled hot path is ONE read of this module global (the same
+# discipline as faults._active); no lock, no allocation.
+_lock = threading.Lock()
+_tracer: Optional[Tracer] = None
+
+
+def enable(max_spans: int = 100_000, registry=None) -> Tracer:
+    """Install (or return the already-installed) process-global tracer."""
+    global _tracer
+    with _lock:
+        if _tracer is None:
+            _tracer = Tracer(max_spans=max_spans, registry=registry)
+        return _tracer
+
+
+def disable() -> Optional[Tracer]:
+    """Uninstall and return the global tracer (None when already off). The
+    returned tracer stays readable — export after disabling is fine."""
+    global _tracer
+    with _lock:
+        t, _tracer = _tracer, None
+        return t
+
+
+def active() -> Optional[Tracer]:
+    return _tracer
+
+
+def span(kind: str, name: str = "", **attrs):
+    """Open a span under the current thread's context; a shared no-op when
+    tracing is disabled (one global read, zero allocation)."""
+    t = _tracer
+    if t is None:
+        return NOOP_SPAN
+    return t.span(kind, name, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, **attrs)
+
+
+def current_span_id() -> str:
+    t = _tracer
+    if t is None:
+        return ""
+    return t.current_span_id()
+
+
+def nbytes(tree: Any) -> int:
+    """Byte size of a host pytree (dicts/lists/tuples of arrays+scalars) —
+    used to annotate ``transfer`` spans after a ``jax.device_get``."""
+    if isinstance(tree, dict):
+        return sum(nbytes(v) for v in tree.values())
+    if isinstance(tree, (list, tuple)):
+        return sum(nbytes(v) for v in tree)
+    n = getattr(tree, "nbytes", None)
+    if n is not None:
+        return int(n)
+    return 8 if isinstance(tree, (int, float, complex, bool)) else 0

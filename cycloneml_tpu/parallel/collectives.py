@@ -22,6 +22,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence
 
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
+from cycloneml_tpu.observe import tracing
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -78,6 +79,13 @@ class BoundedProgramCache:
         v = self._d.get(key)
         if v is not None:
             self._d.move_to_end(key)
+        tr = tracing.active()  # one global read when tracing is off
+        if tr is not None:
+            # a miss is the event that buys a fresh trace + XLA compile on
+            # the program's first dispatch — FitProfile pairs these counts
+            # with the 'compile' spans that first dispatch opens
+            tr.instant("cache.hit" if v is not None else "cache.miss",
+                       cache="program")
         return v
 
     def put(self, key, value) -> None:
@@ -92,25 +100,44 @@ class BoundedProgramCache:
         return len(self._d)
 
 
-def _instrument_dispatch(jitted):
+def _instrument_dispatch(jitted, name: str = "tree_aggregate"):
     """Route every dispatch of an aggregation program through the chaos
-    harness's ``collectives.step`` injection point (faults.py). When no
-    injector is installed the cost is one global read per step; the raw
-    program stays reachable as ``__wrapped__`` for callers that inline it
-    into larger jitted programs (e.g. the device-resident line search)."""
+    harness's ``collectives.step`` injection point (faults.py) and, when
+    tracing is enabled, open a ``collective`` span per step (a ``compile``
+    span nests inside the first dispatch — the call that pays trace + XLA
+    compilation). When neither is installed the cost is two global reads
+    per step; the raw program stays reachable as ``__wrapped__`` for
+    callers that inline it into larger jitted programs (e.g. the
+    device-resident line search)."""
     import jax
 
     from cycloneml_tpu.parallel import faults
+
+    first = [True]
 
     @functools.wraps(jitted)
     def dispatch(*args, **kwargs):
         # trace-time calls (this program inlined into a larger jitted
         # program, e.g. the fused line search) must not count as a step:
         # compiles are cached across fits, so counting them would make the
-        # fault schedule depend on compile-cache state
-        if not any(isinstance(a, jax.core.Tracer) for a in args):
-            faults.inject("collectives.step")
-        return jitted(*args, **kwargs)
+        # fault schedule depend on compile-cache state. The SAME guard is
+        # the tracer-awareness contract — a span here would record host
+        # wall clock during tracing (see jx001_tracing_pass fixture).
+        if any(isinstance(a, jax.core.Tracer) for a in args):
+            return jitted(*args, **kwargs)
+        # inject BEFORE consuming the first-dispatch flag: a chaos fault
+        # raised here leaves the flag set, so the RETRY (the dispatch that
+        # actually pays trace + compile) still records its compile span
+        faults.inject("collectives.step")
+        was_first, first[0] = first[0], False
+        tr = tracing.active()
+        if tr is None:
+            return jitted(*args, **kwargs)
+        with tr.span("collective", name):
+            if was_first:
+                with tr.span("compile", name):
+                    return jitted(*args, **kwargs)
+            return jitted(*args, **kwargs)
 
     dispatch.__wrapped__ = jitted
     return dispatch

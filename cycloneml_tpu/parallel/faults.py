@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -180,6 +181,9 @@ class FaultInjector:
                     else getattr(fault, "__name__", "SlowStep"))
             self.log.append((point, n, name))
         logger.warning("chaos: injecting %s at %s#%d", name, point, n)
+        # fired faults become trace annotations: a chaos run's timeline
+        # shows each injection inside the span it interrupted
+        tracing.instant("fault", point=point, invocation=n, fault=name)
         if spec.delay_s:
             time.sleep(spec.delay_s)
         if fault is None:

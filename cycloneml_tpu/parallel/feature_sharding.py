@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.parallel.collectives import (BoundedProgramCache,
                                                 psum_over_mesh,
                                                 shard_map_compat)
@@ -197,9 +198,13 @@ class FeatureShardedLossFunction:
         self.n_dispatches += 1
         cdt = np.dtype(self._x.dtype)
         beta, b0 = self._split(coef, cdt)
-        loss_t, gb_t, gb0_t, _ = jax.device_get(
-            self._prog(self._x, self._y, self._w, beta, b0,
-                       self._inv_std, self._scaled_mean))  # one transfer
+        with tracing.span("dispatch", "tp.loss.eval", evals=1):
+            out_dev = self._prog(self._x, self._y, self._w, beta, b0,
+                                 self._inv_std, self._scaled_mean)
+            with tracing.span("transfer", "tp.loss.readback") as tsp:
+                loss_t, gb_t, gb0_t, _ = jax.device_get(
+                    out_dev)  # one transfer
+                tsp.annotate_bytes((loss_t, gb_t, gb0_t))
         loss = float(loss_t) / self.weight_sum
         gb = np.asarray(gb_t, dtype=np.float64) / self.weight_sum
         if self.fit_intercept:
@@ -232,17 +237,27 @@ class FeatureShardedLossFunction:
         key = ("tp_ls", self._rt.mesh, float(c1), float(c2),
                int(max_evals), cdt.str)
         prog = _cache_get(key)
-        if prog is None:
+        fresh = prog is None
+        if fresh:
             prog = _build_tp_line_search(self._rt, c1, c2, max_evals, cdt)
             _cache_put(key, prog)
         beta0, b0 = self._split(x, cdt)
         dbeta, db0 = self._split(direction, cdt)
-        out = jax.device_get(prog(
-            self._x, self._y, self._w, beta0, b0, dbeta, db0,
-            cdt.type(value), cdt.type(dg0), cdt.type(init_alpha),
-            cdt.type(self.weight_sum), cdt.type(reg),
-            self._inv_std, self._scaled_mean))
+        args = (self._x, self._y, self._w, beta0, b0, dbeta, db0,
+                cdt.type(value), cdt.type(dg0), cdt.type(init_alpha),
+                cdt.type(self.weight_sum), cdt.type(reg),
+                self._inv_std, self._scaled_mean)
+        with tracing.span("dispatch", "tp.line_search") as dsp:
+            if fresh:
+                with tracing.span("compile", "tp.line_search"):
+                    res = prog(*args)
+            else:
+                res = prog(*args)
+            with tracing.span("transfer", "tp.line_search.readback") as tsp:
+                out = jax.device_get(res)
+                tsp.annotate_bytes(out)
         alpha, v, gb, gb0, evals = out
+        dsp.annotate(evals=int(evals))
         self.n_evals += int(evals)
         self.n_dispatches += 1
         self.n_fused_searches += 1

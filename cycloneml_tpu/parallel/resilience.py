@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.util.checkpoint import CheckpointCorrupt, TrainingCheckpointer
 from cycloneml_tpu.util.events import WorkerLost
 from cycloneml_tpu.util.logging import get_logger
@@ -360,6 +361,8 @@ def retry_step(fn: Callable[[], Any], max_failures: int = 4,
             last = e
             logger.warning("step failed (attempt %d/%d): %s",
                            attempt + 1, max_failures, e)
+            tracing.instant("retry", attempt=attempt + 1,
+                            error=type(e).__name__)
             if on_failure is not None:
                 on_failure(attempt, e)
             if attempt + 1 < max_failures:
@@ -475,15 +478,18 @@ class MeshSupervisor:
         self.rebuilds += 1
         master = self._target_master()
         from cycloneml_tpu.parallel.collectives import clear_program_cache
-        clear_program_cache()  # compiled programs close over the dead mesh
-        rt = self.ctx.rebuild_mesh(master)
-        logger.warning("mesh recovery #%d (%s): rebuilt over %d devices",
-                       self.rebuilds, reason or "device loss", rt.n_devices)
-        with self._lock:
-            self._pending = None
-        if self.on_rebuild is not None:
-            return self.on_rebuild(rt)
-        return None
+        with tracing.span("rebuild", reason or "device loss",
+                          rebuild=self.rebuilds):
+            clear_program_cache()  # compiled programs close over dead mesh
+            rt = self.ctx.rebuild_mesh(master)
+            logger.warning("mesh recovery #%d (%s): rebuilt over %d devices",
+                           self.rebuilds, reason or "device loss",
+                           rt.n_devices)
+            with self._lock:
+                self._pending = None
+            if self.on_rebuild is not None:
+                return self.on_rebuild(rt)
+            return None
 
 
 def _restore_latest_verified(checkpointer: TrainingCheckpointer,
@@ -601,6 +607,8 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
             fail_count += 1
             logger.warning("step failed (attempt %d/%d): %s",
                            fail_count, max_step_failures, e)
+            tracing.instant("retry", attempt=fail_count,
+                            error=type(e).__name__)
             if fail_count >= max_step_failures:
                 raise RuntimeError(
                     f"step failed {max_step_failures} times; aborting job "

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from cycloneml_tpu.observe import tracing
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -133,32 +134,34 @@ class TrainingCheckpointer:
     def save(self, step: int, state: Any,
              metadata: Optional[Dict[str, Any]] = None) -> str:
         from cycloneml_tpu.parallel import faults
-        faults.inject("checkpoint.save", step=step)
-        target = self._step_dir(step)
-        if os.path.exists(target):
-            return target  # idempotent re-save after a replayed step
-        tmp = tempfile.mkdtemp(dir=self.directory,
-                               prefix=f"step_{step:012d}.tmp")
-        try:
-            state_path = os.path.join(tmp, "state.pkl")
-            sha = _fsync_write(state_path, lambda fh: pickle.dump(
-                _to_host(state), fh, protocol=pickle.HIGHEST_PROTOCOL))
-            meta = {"step": step, **(metadata or {}),
-                    "files": {"state.pkl": {
-                        "sha256": sha,
-                        "bytes": os.path.getsize(state_path)}}}
-            _fsync_write(os.path.join(tmp, "METADATA.json"),
-                         lambda fh: fh.write(json.dumps(meta).encode()))
-            # a crash between here and the rename orphans the tmp dir —
-            # invisible to steps() — which is exactly the contract
-            faults.inject("checkpoint.commit", step=step)
-            os.replace(tmp, target)
-            _fsync_dir(self.directory)  # durably publish the rename
-        finally:
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp, ignore_errors=True)
-        self._retain()
-        return target
+        with tracing.span("checkpoint", "save", step=step):
+            faults.inject("checkpoint.save", step=step)
+            target = self._step_dir(step)
+            if os.path.exists(target):
+                return target  # idempotent re-save after a replayed step
+            tmp = tempfile.mkdtemp(dir=self.directory,
+                                   prefix=f"step_{step:012d}.tmp")
+            try:
+                state_path = os.path.join(tmp, "state.pkl")
+                sha = _fsync_write(state_path, lambda fh: pickle.dump(
+                    _to_host(state), fh, protocol=pickle.HIGHEST_PROTOCOL))
+                meta = {"step": step, **(metadata or {}),
+                        "files": {"state.pkl": {
+                            "sha256": sha,
+                            "bytes": os.path.getsize(state_path)}}}
+                _fsync_write(os.path.join(tmp, "METADATA.json"),
+                             lambda fh: fh.write(json.dumps(meta).encode()))
+                # a crash between here and the rename orphans the tmp dir —
+                # invisible to steps() — which is exactly the contract
+                with tracing.span("checkpoint", "commit", step=step):
+                    faults.inject("checkpoint.commit", step=step)
+                    os.replace(tmp, target)
+                    _fsync_dir(self.directory)  # durably publish the rename
+            finally:
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp, ignore_errors=True)
+            self._retain()
+            return target
 
     def verify(self, step: int) -> bool:
         """True iff the committed checkpoint for ``step`` passes its
@@ -242,10 +245,12 @@ class TrainingCheckpointer:
         newest *verifiable* state (see :meth:`restore_newest_verifiable`).
         """
         from cycloneml_tpu.parallel import faults
-        faults.inject("checkpoint.restore", step=step)
-        if step is not None:
-            return self._verified_load(step)
-        return self.restore_newest_verifiable()[1]
+        with tracing.span("checkpoint", "restore",
+                          step=-1 if step is None else step):
+            faults.inject("checkpoint.restore", step=step)
+            if step is not None:
+                return self._verified_load(step)
+            return self.restore_newest_verifiable()[1]
 
     def metadata(self, step: int) -> Dict[str, Any]:
         with open(os.path.join(self._step_dir(step), "METADATA.json")) as fh:

@@ -60,6 +60,9 @@ class BlocksMigrated(CycloneEvent):
 class JobStart(CycloneEvent):
     job_id: int = 0
     description: str = ""
+    # root span of the job's trace tree when tracing is enabled ("" when
+    # off) — lets a consumer join the event timeline onto a Chrome trace
+    span_id: str = ""
 
 
 @dataclass
@@ -76,6 +79,17 @@ class StepCompleted(CycloneEvent):
     job_id: int = 0
     step: int = 0
     metrics: Dict[str, float] = field(default_factory=dict)
+    span_id: str = ""  # enclosing trace span at record time ("" when off)
+
+
+@dataclass
+class FitProfileCompleted(CycloneEvent):
+    """Per-fit tracing profile (observe.FitProfile.to_dict()), posted when
+    a traced ``run_job`` bracket closes — the step-level TaskMetrics rollup
+    the status store / web UI / history replay serve per job."""
+
+    job_id: int = 0
+    profile: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

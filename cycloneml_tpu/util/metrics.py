@@ -11,9 +11,11 @@ PrometheusServlet analog without a servlet container.
 
 from __future__ import annotations
 
+import collections
 import http.server
 import math
 import os
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -53,7 +55,11 @@ class Histogram:
 
     def __init__(self, window: int = 1024):
         self._window = window
-        self._samples: List[float] = []
+        # deque(maxlen=window): O(1) eviction — this is hot once dispatch
+        # spans feed a timer every step (list.pop(0) was O(window) per
+        # sample past the window)
+        self._samples: "collections.deque[float]" = collections.deque(
+            maxlen=max(1, window))
         self._count = 0
         self._sum = 0.0
         self._lock = threading.Lock()
@@ -63,8 +69,6 @@ class Histogram:
             self._count += 1
             self._sum += v
             self._samples.append(v)
-            if len(self._samples) > self._window:
-                self._samples.pop(0)
 
     @property
     def count(self) -> int:
@@ -137,6 +141,22 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.pop(name, None)
 
+    def types(self) -> Dict[str, str]:
+        """name → Prometheus metric type (counter / gauge / summary) for
+        ``prometheus_text``'s ``# TYPE`` lines. Timers are Histograms and
+        report as summaries."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, str] = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = "counter"
+            elif isinstance(m, Gauge):
+                out[name] = "gauge"
+            elif isinstance(m, Histogram):
+                out[name] = "summary"
+        return out
+
     def values(self) -> Dict[str, float]:
         """Flatten to name → scalar(s) for sinks."""
         out: Dict[str, float] = {}
@@ -173,10 +193,19 @@ class CsvSink(Sink):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
 
+    @staticmethod
+    def _safe_filename(name: str) -> str:
+        """Metric names are caller-supplied; a '/' (or an absolute path, or
+        a '..' stem) in one must not escape the sink directory or crash
+        ``open``. Everything outside [A-Za-z0-9_.-] becomes '_'; leading
+        dots are stripped so no name can produce a dotfile or '..'."""
+        safe = re.sub(r"[^A-Za-z0-9_.\-]", "_", name)
+        return safe.lstrip(".") or "_"
+
     def report(self, values: Dict[str, float]) -> None:
         now = int(time.time())
         for k, v in values.items():
-            path = os.path.join(self.directory, f"{k}.csv")
+            path = os.path.join(self.directory, f"{self._safe_filename(k)}.csv")
             new = not os.path.exists(path)
             with open(path, "a", encoding="utf-8") as fh:
                 if new:
@@ -184,16 +213,53 @@ class CsvSink(Sink):
                 fh.write(f"{now},{v}\n")
 
 
-def prometheus_text(values: Dict[str, float], prefix: str = "cyclone") -> str:
+def _finite(v) -> bool:
+    # NaN *and* ±inf: real Prometheus scrapers reject non-finite samples
+    return not (isinstance(v, float) and not math.isfinite(v))
+
+
+def prometheus_text(values: Dict[str, float], prefix: str = "cyclone",
+                    types: Optional[Dict[str, str]] = None) -> str:
     """Prometheus exposition format (ref: PrometheusServlet.scala /
-    PrometheusResource.scala)."""
-    lines = []
-    for k in sorted(values):
-        v = values[k]
-        safe = f"{prefix}_{k}".replace(".", "_").replace("-", "_")
-        if isinstance(v, float) and math.isnan(v):
+    PrometheusResource.scala).
+
+    With ``types`` (``MetricsRegistry.types()``), ``# TYPE`` lines are
+    emitted so real scrapers ingest the endpoint cleanly; summary-typed
+    names render the canonical quantile/_sum/_count form from the
+    histogram's flattened ``.count/.mean/.p50/...`` values.
+    """
+    def safe(k: str) -> str:
+        return f"{prefix}_{k}".replace(".", "_").replace("-", "_")
+
+    types = types or {}
+    lines: List[str] = []
+    consumed = set()
+    for base in sorted(n for n, t in types.items() if t == "summary"):
+        cnt = values.get(f"{base}.count")
+        consumed.update(f"{base}.{k}"
+                        for k in ("count", "mean", "p50", "p95", "max"))
+        if cnt is None or not _finite(cnt):
             continue
-        lines.append(f"{safe} {v}")
+        s = safe(base)
+        lines.append(f"# TYPE {s} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("1", "max")):
+            v = values.get(f"{base}.{key}")
+            if v is not None and _finite(v):
+                lines.append(f'{s}{{quantile="{q}"}} {v}')
+        mean = values.get(f"{base}.mean", 0.0)
+        if _finite(mean):
+            lines.append(f"{s}_sum {mean * cnt}")
+        lines.append(f"{s}_count {int(cnt)}")
+    for k in sorted(values):
+        if k in consumed:
+            continue
+        v = values[k]
+        if not _finite(v):
+            continue
+        t = types.get(k)
+        if t in ("counter", "gauge"):
+            lines.append(f"# TYPE {safe(k)} {t}")
+        lines.append(f"{safe(k)} {v}")
     return "\n".join(lines) + "\n"
 
 
@@ -210,7 +276,8 @@ class PrometheusEndpoint(Sink):
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = prometheus_text(reg.values()).encode()
+                body = prometheus_text(reg.values(),
+                                       types=reg.types()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")

@@ -28,6 +28,9 @@ class AppStatusStore:
         self.jobs: Dict[int, Dict[str, Any]] = {}
         self.checkpoints: List[Dict[str, Any]] = []
         self.worker_failures: List[Dict[str, Any]] = []
+        # job_id -> FitProfile dict (tracing's per-fit rollup; empty when
+        # tracing was off for the run)
+        self.profiles: Dict[int, Dict[str, Any]] = {}
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -51,6 +54,18 @@ class AppStatusStore:
     def steps(self, job_id: int) -> List[Dict[str, Any]]:
         j = self.jobs.get(job_id)
         return list(j.get("steps", [])) if j else []
+
+    def profile(self, job_id: int) -> Dict[str, Any]:
+        """The job's FitProfile dict, or {} (untraced run / unknown job)."""
+        with self._lock:
+            return dict(self.profiles.get(job_id, {}))
+
+    def latest_profile(self) -> Dict[str, Any]:
+        """The highest-job-id FitProfile dict, or {} when none exist."""
+        with self._lock:
+            if not self.profiles:
+                return {}
+            return dict(self.profiles[max(self.profiles)])
 
 
 class AppStatusListener:
@@ -102,7 +117,11 @@ class AppStatusListener:
                 j = self._ensure_job(e.get("job_id", 0))
                 j["steps"].append({"step": e.get("step"),
                                    "metrics": e.get("metrics", {}),
-                                   "time": e.get("time_ms")})
+                                   "time": e.get("time_ms"),
+                                   "spanId": e.get("span_id", "")})
+        elif kind == "FitProfileCompleted":
+            with s._lock:
+                s.profiles[e.get("job_id", 0)] = dict(e.get("profile", {}))
         elif kind == "CheckpointWritten":
             s.checkpoints.append({"path": e.get("path"),
                                   "step": e.get("step"),
@@ -146,7 +165,7 @@ def api_v1(store: AppStatusStore, route: str,
            job_id: Optional[int] = None) -> Any:
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
-    'checkpoints', 'workers/failures'."""
+    'jobs/<id>/profile', 'checkpoints', 'workers/failures'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -155,6 +174,8 @@ def api_v1(store: AppStatusStore, route: str,
         return store.job(job_id)
     if route == "jobs/<id>/steps":
         return store.steps(job_id)
+    if route == "jobs/<id>/profile":
+        return store.profile(job_id)
     if route == "checkpoints":
         return list(store.checkpoints)
     if route == "workers/failures":

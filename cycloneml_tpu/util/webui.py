@@ -59,6 +59,12 @@ async function refresh() {
     if (steps.length)
       html += '<h2>Job ' + job.jobId + ' steps</h2>' +
               table(steps.slice(-20), Object.keys(steps[0]));
+    const prof = await j('jobs/' + job.jobId + '/profile');
+    if (prof && Object.keys(prof).length) {
+      const rows = Object.entries(prof).map(([k, v]) => ({field: k, value: v}));
+      html += '<h2>Job ' + job.jobId + ' fit profile</h2>' +
+              table(rows, ['field', 'value']);
+    }
   }
   document.getElementById('jobs').innerHTML = html;
   const st = await j('storage');
@@ -138,6 +144,8 @@ class StatusWebUI:
                 return api_v1(self.store, "jobs/<id>", job_id)
             if parts[2] == "steps":
                 return api_v1(self.store, "jobs/<id>/steps", job_id)
+            if parts[2] == "profile":
+                return api_v1(self.store, "jobs/<id>/profile", job_id)
         if parts == ["workers", "failures"]:
             return api_v1(self.store, "workers/failures")
         raise KeyError(route)

@@ -1,0 +1,112 @@
+"""obs-demo: run a small traced fit, export + validate its Chrome trace.
+
+The executable form of the observability acceptance contract
+(docs/observability.md):
+
+1. a ``LogisticRegression.fit`` with tracing enabled exports a
+   Chrome-trace JSON that passes ``validate_chrome_trace`` (loads in
+   Perfetto),
+2. the trace contains >= 4 distinct span kinds out of
+   {compile, dispatch, collective, transfer, checkpoint, job},
+3. the fit's ``FitProfile`` dispatch/eval counts agree with the ledger the
+   model summary (and bench.py) already reports.
+
+Run via ``make obs-demo``. Exits non-zero on any violation.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import (FitProfile, span_kinds, tracing,
+                                       validate_chrome_trace)
+
+    work = tempfile.mkdtemp(prefix="cyclone-obs-demo-")
+    conf = (CycloneConf()
+            .set("cyclone.master", "local-mesh[8]")
+            .set("cyclone.app.name", "obs-demo")
+            .set("cyclone.trace.enabled", "true"))
+    ctx = CycloneContext(conf)
+    try:
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 8)
+        y = (x @ rng.randn(8) > 0).astype(float)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        # checkpointDir adds the checkpoint span family to the trace
+        lr = LogisticRegression(maxIter=8, regParam=0.01, tol=0.0,
+                                checkpointDir=os.path.join(work, "ckpt"),
+                                checkpointInterval=2)
+        model = lr.fit(frame)
+        ctx.listener_bus.wait_until_empty()
+
+        trace_path = os.path.join(work, "fit.trace.json")
+        ctx.export_trace(trace_path)
+        profile = FitProfile.from_dict(ctx.fit_profile())
+
+        errors = validate_chrome_trace(trace_path)
+        if errors:
+            print("FAIL: trace schema violations:", file=sys.stderr)
+            for e in errors[:20]:
+                print(f"  - {e}", file=sys.stderr)
+            return 1
+        kinds = span_kinds(trace_path)
+        print(f"trace: {trace_path}")
+        print(f"span kinds: { {k: v for k, v in sorted(kinds.items())} }")
+        want = {"compile", "dispatch", "collective", "transfer",
+                "checkpoint", "job"}
+        got = want & set(kinds)
+        if len(got) < 4:
+            print(f"FAIL: only {len(got)} of the span kinds {sorted(want)} "
+                  f"present: {sorted(got)}", file=sys.stderr)
+            return 1
+
+        summary = model.summary
+        print(f"FitProfile: dispatches={profile.dispatch_count} "
+              f"evals={profile.eval_count} compiles={profile.compile_count} "
+              f"({profile.compile_seconds:.3f}s) "
+              f"transfers={profile.transfer_count} "
+              f"({profile.transfer_bytes} B) "
+              f"checkpoints={profile.checkpoint_saves} "
+              f"steady={profile.steady_seconds:.3f}s "
+              f"wall={profile.wall_seconds:.3f}s")
+        print(f"summary:    dispatches={summary.total_dispatches} "
+              f"evals={summary.total_evals}")
+        if profile.dispatch_count != summary.total_dispatches:
+            print(f"FAIL: profile dispatch_count {profile.dispatch_count} "
+                  f"!= summary total_dispatches {summary.total_dispatches}",
+                  file=sys.stderr)
+            return 1
+        if profile.eval_count != summary.total_evals:
+            print(f"FAIL: profile eval_count {profile.eval_count} "
+                  f"!= summary total_evals {summary.total_evals}",
+                  file=sys.stderr)
+            return 1
+        if profile.checkpoint_saves < 1:
+            print("FAIL: no checkpoint spans recorded", file=sys.stderr)
+            return 1
+        print("OK: trace validates, >=4 span kinds, profile counts agree "
+              "with the model summary")
+        return 0
+    finally:
+        ctx.stop()
+        tracing.disable()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

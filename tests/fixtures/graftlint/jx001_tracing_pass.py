@@ -1,0 +1,54 @@
+"""PASS fixture: tracer-aware instrumentation around a jitted dispatch.
+
+Pins the observe/ instrumentation contract: a dispatch wrapper whose
+program can be INLINED into a larger jitted program (so the wrapper runs
+at trace time with tracer arguments) must branch on the tracer check
+BEFORE touching the host-side tracing API — no span, no host clock, no
+fault counting on the traced path. The whole file must stay clean under
+the full graftlint rule pack (JX001 especially: the span bodies and the
+single batched device_get below are host-only code).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from cycloneml_tpu.observe import tracing
+
+
+def make_instrumented_program():
+    @jax.jit
+    def step(x):
+        return {"loss": jnp.sum(x * x), "grad": 2.0 * x}
+
+    def dispatch(x):
+        if isinstance(x, jax.core.Tracer):
+            # trace time (inlined into an outer jit): spans would record
+            # meaningless host wall clock and bake host work into tracing
+            return step(x)
+        with tracing.span("collective", "fixture.step"):
+            return step(x)
+
+    dispatch.__wrapped__ = step
+    return dispatch
+
+
+def host_driver(x):
+    prog = make_instrumented_program()
+    with tracing.span("dispatch", "fixture.eval", evals=1):
+        out_dev = prog(x)
+        with tracing.span("transfer", "fixture.readback") as tsp:
+            out = jax.device_get(out_dev)  # ONE batched pull
+            tsp.annotate_bytes(out)
+    return out["loss"], out["grad"]
+
+
+def outer_fused(x):
+    # the instrumented program inlined into a larger jitted program: the
+    # wrapper's tracer branch keeps trace time span-free
+    prog = make_instrumented_program()
+
+    @jax.jit
+    def fused(x):
+        return prog(x)["loss"] * 2.0
+
+    return fused(x)

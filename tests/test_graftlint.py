@@ -64,6 +64,17 @@ def test_pass_fixture_is_totally_clean(rule):
         f"{f.rule}@{f.line}: {f.message}" for f in findings]
 
 
+def test_tracer_aware_instrumentation_is_clean():
+    """The observe/ instrumentation pattern — tracer check BEFORE any span
+    on a path reachable at trace time, spans + one batched device_get in
+    host code — must be clean under the whole rule pack (the PR-3
+    tentpole's JX001 contract)."""
+    path = os.path.join(FIXTURES, "jx001_tracing_pass.py")
+    findings = analyze_paths([path])
+    assert findings == [], [
+        f"{f.rule}@{f.line}: {f.message}" for f in findings]
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_inline_suppression(tmp_path):

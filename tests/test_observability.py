@@ -80,10 +80,71 @@ def test_csv_sink(tmp_path):
     assert lines[1].endswith(",1.5") and lines[2].endswith(",2.5")
 
 
+def test_csv_sink_sanitizes_hostile_metric_names(tmp_path):
+    """Names with path separators / traversal / absolute paths must stay
+    inside the sink directory and must not crash open()."""
+    sink = CsvSink(str(tmp_path / "sink"))
+    sink.report({
+        "sql/exchange/bytes": 1.0,
+        "../escape": 2.0,
+        "/etc/passwd": 3.0,
+        "..": 4.0,
+    })
+    written = sorted(os.listdir(tmp_path / "sink"))
+    assert written == ["_.csv", "_escape.csv", "_etc_passwd.csv",
+                       "sql_exchange_bytes.csv"]
+    # nothing escaped the sink directory
+    assert sorted(os.listdir(tmp_path)) == ["sink"]
+
+
+def test_histogram_sliding_window_evicts_in_order():
+    """deque(maxlen) window: totals keep counting, quantiles see only the
+    newest `window` samples."""
+    from cycloneml_tpu.util.metrics import Histogram
+    h = Histogram(window=4)
+    for i in range(10):
+        h.update(float(i))
+    assert h.count == 10  # lifetime count, not window count
+    assert h.quantile(0.25) == 6.0 and h.quantile(1.0) == 9.0
+    snap = h.snapshot()
+    assert snap["max"] == 9.0 and snap["count"] == 10
+
+
 def test_prometheus_text_format():
     text = prometheus_text({"jobs.started": 3, "step.loss.mean": 0.25})
     assert "cyclone_jobs_started 3" in text
     assert "cyclone_step_loss_mean 0.25" in text
+
+
+def test_prometheus_text_skips_non_finite_and_emits_types():
+    values = {"ok": 1.0, "bad_nan": float("nan"), "bad_inf": float("inf"),
+              "bad_ninf": float("-inf"), "hits": 5,
+              "lat.count": 2, "lat.mean": 0.5, "lat.p50": 0.4,
+              "lat.p95": 0.9, "lat.max": 1.0}
+    text = prometheus_text(values, types={"hits": "counter", "ok": "gauge",
+                                          "lat": "summary"})
+    assert "bad_nan" not in text and "bad_inf" not in text \
+        and "bad_ninf" not in text
+    assert "# TYPE cyclone_hits counter" in text
+    assert "# TYPE cyclone_ok gauge" in text
+    assert "# TYPE cyclone_lat summary" in text
+    assert 'cyclone_lat{quantile="0.5"} 0.4' in text
+    assert "cyclone_lat_sum 1.0" in text and "cyclone_lat_count 2" in text
+    # summary stats are not double-emitted as flat gauges
+    assert "cyclone_lat_mean" not in text
+    # untyped callers (no types arg) keep the flat legacy format
+    legacy = prometheus_text(values)
+    assert "# TYPE" not in legacy and "cyclone_lat_mean 0.5" in legacy
+
+
+def test_registry_types():
+    reg = MetricsRegistry()
+    reg.counter("c")
+    reg.gauge("g", lambda: 1.0)
+    reg.histogram("h")
+    reg.timer("t")
+    assert reg.types() == {"c": "counter", "g": "gauge",
+                           "h": "summary", "t": "summary"}
 
 
 def test_prometheus_http_endpoint():
@@ -98,6 +159,60 @@ def test_prometheus_http_endpoint():
             urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=5)
     finally:
         ms.stop()
+
+
+def test_prometheus_endpoint_under_concurrent_scrape_and_updates():
+    """ThreadingHTTPServer path under contention: N scrapers hammer
+    /metrics while M writers update counters/timers — every response must
+    be a complete, parseable exposition (HTTP 200, terminated by a
+    newline, no interleaving corruption), and no request may error."""
+    import threading as th
+    ms = MetricsSystem("driver", period_s=100)
+    reg = ms.registry
+    reg.counter("hits").inc()
+    port = ms.start_prometheus(0)
+    stop = th.Event()
+    errors = []
+    bodies = []
+
+    def writer(i):
+        while not stop.is_set():
+            reg.counter("hits").inc()
+            reg.timer(f"lat{i}").update(0.001)
+            reg.histogram("shared").update(float(i))
+
+    def scraper():
+        try:
+            for _ in range(20):
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ).read().decode()
+                bodies.append(body)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    writers = [th.Thread(target=writer, args=(i,)) for i in range(3)]
+    scrapers = [th.Thread(target=scraper) for _ in range(4)]
+    for t in writers + scrapers:
+        t.start()
+    for t in scrapers:
+        t.join(timeout=60)
+    stop.set()
+    for t in writers:
+        t.join(timeout=10)
+    ms.stop()
+    assert not errors
+    assert len(bodies) == 80
+    for body in bodies:
+        assert body.endswith("\n")
+        assert "cyclone_hits" in body
+        for line in body.strip().split("\n"):
+            # every line is a comment or "name value" with a finite value
+            if line.startswith("#"):
+                continue
+            name, value = line.rsplit(" ", 1)
+            float(value)  # parseable, and
+            assert value.lower() not in ("nan", "inf", "-inf")
 
 
 def test_metrics_system_periodic_report():

@@ -1,0 +1,315 @@
+"""Step-level tracing tests: span API, FitProfile, Chrome export, and the
+end-to-end acceptance contract (a traced LogisticRegression.fit exports a
+valid Chrome trace with >= 4 span kinds whose FitProfile counts agree with
+the model summary's dispatch/eval ledger).
+
+The tracer is process-global state like faults._active: every test that
+enables it disables it in a finally block so the rest of the suite keeps
+the zero-overhead disabled path.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.observe import (FitProfile, chrome_trace,
+                                   export_chrome_trace, span_kinds, tracing,
+                                   validate_chrome_trace)
+
+
+@pytest.fixture
+def tracer():
+    tracing.disable()  # defend against a leak from a dirty test
+    t = tracing.enable(max_spans=10_000)
+    yield t
+    tracing.disable()
+
+
+# -- disabled path ---------------------------------------------------------------
+
+def test_span_api_is_noop_when_disabled():
+    tracing.disable()
+    assert tracing.active() is None
+    s1 = tracing.span("dispatch", "a")
+    s2 = tracing.span("collective", "b", attr=1)
+    # one shared object, no allocation per call — the zero-overhead contract
+    assert s1 is s2 is tracing.NOOP_SPAN
+    with s1 as s:
+        s.annotate(evals=1)
+        s.annotate_bytes({"x": np.zeros(8)})  # must not walk the tree
+        assert s.span_id == ""
+    tracing.instant("fault", point="collectives.step")
+    assert tracing.current_span_id() == ""
+
+
+def test_instrumented_sites_record_nothing_when_disabled(ctx):
+    """A tree_aggregate dispatch with tracing off must leave no trace state
+    behind — then the same program dispatched under a tracer records a
+    collective span (cache already warm: no compile span)."""
+    import jax.numpy as jnp
+    from cycloneml_tpu.parallel.collectives import tree_aggregate
+
+    def agg(x):
+        return {"s": jnp.sum(x)}
+
+    rt = ctx.mesh_runtime
+    data = rt.device_put_sharded_rows(np.ones((64, 2), dtype=np.float32))
+    prog = tree_aggregate(agg, rt, data)
+    prog(data)  # disabled: nothing recorded anywhere
+    t = tracing.enable(max_spans=100)
+    try:
+        prog(data)
+        kinds = {s.kind for s in t.snapshot()}
+        assert "collective" in kinds
+    finally:
+        tracing.disable()
+
+
+# -- span recording --------------------------------------------------------------
+
+def test_spans_nest_and_annotate(tracer):
+    with tracer.span("job", "fit") as job:
+        with tracer.span("dispatch", "loss.eval", evals=1) as d:
+            tracer.instant("cache.miss")
+            d.annotate(extra=7)
+    spans = tracer.snapshot()
+    by_kind = {s.kind: s for s in spans}
+    assert by_kind["dispatch"].parent_id == job.span_id
+    assert by_kind["instant"].parent_id == by_kind["dispatch"].span_id
+    assert by_kind["dispatch"].attrs == {"evals": 1, "extra": 7}
+    assert by_kind["job"].t1 >= by_kind["dispatch"].t1 >= \
+        by_kind["dispatch"].t0
+    assert tracing.current_span_id() == ""  # stack fully unwound
+
+
+def test_span_buffer_bound():
+    t = tracing.Tracer(max_spans=3)
+    for i in range(5):
+        t.instant("x", i=i)
+    assert len(t.snapshot()) == 3 and t.dropped == 2
+
+
+def test_threads_get_independent_context(tracer):
+    seen = {}
+
+    def worker(name):
+        with tracer.span("job", name) as sp:
+            seen[name] = sp.span_id
+
+    ts = [threading.Thread(target=worker, args=(f"j{i}",)) for i in range(4)]
+    for x in ts:
+        x.start()
+    for x in ts:
+        x.join()
+    roots = [s for s in tracer.snapshot() if s.kind == "job"]
+    assert len(roots) == 4
+    assert all(not s.parent_id for s in roots)  # no cross-thread bleed
+
+
+# -- FitProfile ------------------------------------------------------------------
+
+def test_fit_profile_scopes_to_root(tracer):
+    with tracer.span("job", "fit-A") as a:
+        with tracer.span("dispatch", "loss.eval", evals=3):
+            pass
+        with tracer.span("transfer", "rb") as t:
+            t.annotate(bytes=128)
+        tracer.instant("fault", point="collectives.step")
+    with tracer.span("job", "fit-B"):
+        with tracer.span("dispatch", "loss.eval", evals=5):
+            pass
+    prof = tracer.profile_for(a.span_id)
+    assert prof.dispatch_count == 1 and prof.eval_count == 3
+    assert prof.transfer_count == 1 and prof.transfer_bytes == 128
+    assert prof.faults_injected == 1
+    assert prof.description == "fit-A" and prof.wall_seconds > 0
+    everything = tracer.profile_for(None)
+    assert everything.dispatch_count == 2 and everything.eval_count == 8
+
+
+def test_fit_profile_compile_vs_steady(tracer):
+    import time
+    with tracer.span("dispatch", "lbfgs.chunk", evals=2):
+        with tracer.span("compile", "lbfgs.chunk"):
+            pass
+    with tracer.span("dispatch", "lbfgs.chunk", evals=2) as steady:
+        pass
+    prof = tracer.profile_for(None)
+    assert prof.compile_count == 1
+    assert prof.dispatch_count == 2
+    # steady excludes the dispatch that paid the compile
+    assert prof.steady_seconds == pytest.approx(steady.span.duration_s)
+
+
+def test_fit_profile_excludes_deeply_nested_compiles_from_steady(tracer):
+    """The host L-BFGS shape: dispatch → collective → compile. The compile
+    is TWO levels below the dispatch, whose wall time includes the staging
+    — it must not count as steady state."""
+    import time
+    with tracer.span("dispatch", "loss.eval", evals=1):
+        with tracer.span("collective", "tree_aggregate"):
+            with tracer.span("compile", "tree_aggregate"):
+                time.sleep(0.01)
+    with tracer.span("dispatch", "loss.eval", evals=1) as steady:
+        pass
+    prof = tracer.profile_for(None)
+    assert prof.compile_count == 1 and prof.dispatch_count == 2
+    assert prof.steady_seconds == pytest.approx(steady.span.duration_s)
+    assert prof.steady_seconds < 0.01  # staging time fully excluded
+
+
+def test_fit_profile_roundtrips_dict(tracer):
+    with tracer.span("dispatch", "x", evals=1):
+        pass
+    prof = tracer.profile_for(None)
+    again = FitProfile.from_dict(prof.to_dict())
+    assert again == prof
+
+
+# -- Chrome export ---------------------------------------------------------------
+
+def test_chrome_trace_exports_and_validates(tracer, tmp_path):
+    with tracer.span("job", "fit"):
+        with tracer.span("dispatch", "loss.eval", evals=1):
+            tracer.instant("cache.hit")
+    path = str(tmp_path / "t.trace.json")
+    export_chrome_trace(tracer, path)
+    assert validate_chrome_trace(path) == []
+    obj = json.load(open(path))
+    kinds = span_kinds(obj)
+    assert kinds == {"job": 1, "dispatch": 1, "instant": 1}
+    evs = {e["name"]: e for e in obj["traceEvents"] if e["ph"] != "M"}
+    assert evs["loss.eval"]["args"]["evals"] == 1
+    assert evs["loss.eval"]["args"]["parent_id"] == \
+        evs["fit"]["args"]["span_id"]
+    assert evs["cache.hit"]["ph"] == "i"
+
+
+def test_validator_rejects_malformed_traces():
+    assert validate_chrome_trace({"nope": []})
+    assert validate_chrome_trace({"traceEvents": [{"ph": "X"}]})
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "X", "pid": 1, "ts": 0.0}]}
+    )  # X without dur
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "X", "pid": 1, "ts": 0.0,
+                          "dur": 1.0}]}) == []
+
+
+# -- end-to-end acceptance -------------------------------------------------------
+
+def _fit_traced(ctx, tmp_path, **lr_kwargs):
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 6)
+    y = (x @ rng.randn(6) > 0).astype(float)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    model = LogisticRegression(maxIter=6, regParam=0.01, tol=0.0,
+                               **lr_kwargs).fit(frame)
+    assert ctx.listener_bus.wait_until_empty()
+    return model
+
+
+def test_traced_fit_exports_chrome_trace_with_4_kinds(ctx, tmp_path):
+    """The ISSUE acceptance: one traced LogisticRegression.fit ->
+    Chrome-trace JSON with >= 4 distinct span kinds that validates, and a
+    FitProfile whose dispatch/eval counts agree with the ledger bench.py
+    logs (summary.total_dispatches / total_evals)."""
+    tracing.disable()
+    tracer = tracing.enable(max_spans=50_000)
+    try:
+        model = _fit_traced(
+            ctx, tmp_path,
+            checkpointDir=str(tmp_path / "ckpt"), checkpointInterval=2)
+        jobs = [j for j in ctx.status_store.job_list()
+                if "LogisticRegression.fit" in j["description"]]
+        jid = jobs[-1]["jobId"]
+        prof = FitProfile.from_dict(ctx.status_store.profile(jid))
+
+        path = str(tmp_path / "fit.trace.json")
+        ctx.export_trace(path)
+        assert validate_chrome_trace(path) == []
+        kinds = set(span_kinds(path))
+        want = {"compile", "dispatch", "collective", "transfer",
+                "checkpoint", "job"}
+        assert len(kinds & want) >= 4, f"only {sorted(kinds & want)}"
+        # the per-fit profile agrees with the counts the summary logs
+        assert prof.dispatch_count == model.summary.total_dispatches
+        assert prof.eval_count == model.summary.total_evals
+        assert prof.checkpoint_saves >= 1
+        assert prof.transfer_count >= prof.dispatch_count
+        assert prof.wall_seconds > 0
+        # events carry span ids joinable onto the trace
+        steps = ctx.status_store.steps(jid)
+        assert steps and all(st["spanId"] for st in steps)
+    finally:
+        tracing.disable()
+
+
+def test_traced_fit_profile_via_webui(ctx, tmp_path):
+    """The per-fit profile is served by the REST/web UI surface."""
+    import urllib.request
+    tracing.disable()
+    tracing.enable(max_spans=50_000)
+    try:
+        _fit_traced(ctx, tmp_path)
+        jobs = [j for j in ctx.status_store.job_list()
+                if "LogisticRegression.fit" in j["description"]]
+        jid = jobs[-1]["jobId"]
+        from cycloneml_tpu.util.webui import StatusWebUI
+        ui = StatusWebUI(ctx.status_store)
+        try:
+            body = urllib.request.urlopen(
+                f"{ui.url}api/v1/jobs/{jid}/profile", timeout=5).read()
+            prof = json.loads(body)
+            assert prof["dispatch_count"] >= 1
+            assert prof["eval_count"] >= 1
+        finally:
+            ui.stop()
+    finally:
+        tracing.disable()
+
+
+def test_chaos_fault_lands_in_trace(ctx, tmp_path):
+    """A chaos run's injected fault + retry become annotations inside the
+    training timeline (the readable-chaos-trace contract)."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.lbfgs import LBFGS
+    from cycloneml_tpu.ml.optim.loss import DistributedLossFunction
+    from cycloneml_tpu.parallel.faults import (FaultInjector, FaultSchedule,
+                                               TransientCollectiveError)
+    from cycloneml_tpu.parallel.resilience import train_with_checkpoints
+    from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+
+    rng = np.random.RandomState(0)
+    d = 6
+    x = rng.randn(256, d)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    tracing.disable()
+    tracer = tracing.enable(max_spans=50_000)
+    try:
+        sched = FaultSchedule(seed=7)
+        sched.at("collectives.step", [4],
+                 TransientCollectiveError("injected DCN flake"))
+        ck = TrainingCheckpointer(str(tmp_path / "ck"))
+        loss = DistributedLossFunction(
+            ds, aggregators.binary_logistic(d, fit_intercept=False))
+        with FaultInjector(sched) as inj:
+            train_with_checkpoints(
+                LBFGS(max_iter=20, tol=1e-9), loss, np.zeros(d), ck,
+                interval=5, max_step_failures=3, backoff_base_s=0.001,
+                seed=7)
+        assert inj.log  # the fault fired
+        names = {s.name for s in tracer.snapshot() if s.kind == "instant"}
+        assert "fault" in names and "retry" in names
+        prof = tracer.profile_for(None)
+        assert prof.faults_injected >= 1 and prof.retries >= 1
+        assert prof.checkpoint_saves >= 1
+    finally:
+        tracing.disable()
