@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-json test chaos obs-demo
+.PHONY: lint lint-json test chaos obs-demo bench
 
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
@@ -22,3 +22,8 @@ chaos:
 # small traced fit -> exported Chrome trace -> schema + profile validation
 obs-demo:
 	JAX_PLATFORMS=cpu python scripts/obs_demo.py
+
+# one JSON line: e2e LR throughput + phases + the multi-class OvR
+# stacked-vs-serial comparison (ovr_stacked_speedup, models_per_compile)
+bench:
+	python bench.py

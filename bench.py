@@ -188,6 +188,106 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     return dt, its, evals, dispatches, n, d, ceiling_bw, phases
 
 
+def bench_ovr_stacked(n: int | None = None, d: int | None = None,
+                      k: int | None = None, iters: int = 100):
+    """Multi-class OneVsRest: stacked (vmapped model-axis, ONE SPMD
+    program) vs the serialized PR-2 path (K back-to-back binary fits).
+
+    Reports models-per-compile (the compile-amortization the stacked
+    engine buys: K models share one optimizer-step compile) and the
+    end-to-end stacked-vs-serial speedup. Both paths run ``tol=0`` with a
+    budget generous enough to reach the per-model fixed point, so the
+    comparison is step-aligned AND the coefficient agreement is a
+    fixed-point comparison (acceptance: ≤ 1e-5; a mid-descent cutoff would
+    instead measure L-BFGS trajectory sensitivity to last-ulp noise).
+    Note the serialized path also re-places X once per class (each
+    relabeled sub-frame carries its own device cache) — cost the shared
+    design matrix of the stacked path simply does not have.
+    """
+    from cycloneml_tpu import CycloneConf, CycloneContext
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression, OneVsRest
+    from cycloneml_tpu.observe import tracing as _tracing
+
+    # modest by default: the serialized path re-places X once per class per
+    # fit (each relabeled sub-frame carries its own device cache), and
+    # through a TPU relay that transfer should bound, not dominate, the run
+    n = n or int(os.environ.get("BENCH_OVR_N", 20_000))
+    d = d or int(os.environ.get("BENCH_OVR_D", 64))
+    k = k or int(os.environ.get("BENCH_OVR_K", 8))
+    iters = int(os.environ.get("BENCH_OVR_ITERS", iters))
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.app.name", "bench"))
+    rng = np.random.RandomState(7)
+    centers = rng.randn(k, d).astype(np.float32) * 3.0
+    y = rng.randint(0, k, n).astype(np.float64)
+    x = centers[y.astype(int)] + rng.randn(n, d).astype(np.float32)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    clf = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
+
+    # warm + traced stacked fit: proves the one-compile-for-K contract
+    tracer = _tracing.enable()
+    mark = tracer.mark()
+    try:
+        stacked_model = OneVsRest(classifier=clf, parallelism=k).fit(frame)
+        prof = tracer.profile_for(since=mark)
+        step_compiles = sum(
+            1 for s in tracer.snapshot(mark)
+            if s.kind == "compile" and s.name == "lbfgs.stacked_chunk")
+    finally:
+        # a failed fit must not leave process-global tracing on for the
+        # rest of the bench (it would skew every later timed section)
+        _tracing.disable()
+
+    trials = max(3, int(os.environ.get("BENCH_TRIALS", 3)))
+    import statistics
+
+    def timed(est):
+        times = []
+        model = None
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            model = est.fit(frame)
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), model
+
+    stacked_s, stacked_model = timed(OneVsRest(classifier=clf,
+                                               parallelism=k))
+    # serialized PR-2 path: parallelism=1 → K back-to-back fits
+    serial_est = OneVsRest(classifier=clf, parallelism=1)
+    serial_est.fit(frame)  # warm its programs too
+    serial_s, serial_model = timed(serial_est)
+
+    coef_diff = max(
+        float(np.abs(ms._coef - mr._coef).max())
+        for ms, mr in zip(stacked_model.models, serial_model.models))
+    # relative agreement: the absolute diff rides the data-tier dtype (f32
+    # here accumulates ~1e-5 abs at these coefficient scales; the x64
+    # equivalence suite in tests/test_stacked.py pins ~1e-9)
+    coef_rel = max(
+        float((np.abs(ms._coef - mr._coef)
+               / np.maximum(np.abs(mr._coef), 1.0)).max())
+        for ms, mr in zip(stacked_model.models, serial_model.models))
+    speedup = serial_s / stacked_s if stacked_s > 0 else 0.0
+    out = {
+        "n": n, "d": d, "n_models": k, "iters": iters,
+        "stacked_fit_s": round(stacked_s, 3),
+        "serial_fit_s": round(serial_s, 3),
+        "ovr_stacked_speedup": round(speedup, 2),
+        "optimizer_step_compiles": step_compiles,
+        "models_per_compile": round(k / max(step_compiles, 1), 1),
+        "profile_n_models": prof.n_models,
+        "coef_max_abs_diff": float(coef_diff),
+        "coef_max_rel_diff": float(coef_rel),
+    }
+    print(f"info: OneVsRest n={n} d={d} K={k}: stacked {stacked_s:.2f}s vs "
+          f"serialized {serial_s:.2f}s ({speedup:.2f}x), "
+          f"{out['models_per_compile']} models/compile "
+          f"(profile n_models={prof.n_models}), "
+          f"max coef diff {coef_diff:.2e}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     err = None
     ceiling_bw = None
@@ -198,6 +298,12 @@ def main() -> None:
     except Exception as e:  # bench must still emit its line
         err = e
         fit_s = None
+    ovr = None
+    if os.environ.get("BENCH_OVR", "1") != "0":
+        try:
+            ovr = bench_ovr_stacked()
+        except Exception as e:
+            print(f"info: ovr stacked bench failed: {e}", file=sys.stderr)
     try:
         gemm_mops = bench_gemm()
         print(f"info: device_gemm_f32 {gemm_mops:.1f} M ops/s "
@@ -247,6 +353,7 @@ def main() -> None:
             "unit": "M ops/s",
             "vs_baseline": round(mops / REF_DGEMM_MOPS, 2),
             "phases": phases,
+            "ovr": ovr,
         }))
     elif gemm_mops is not None:
         print(f"info: logreg bench failed: {err}", file=sys.stderr)
@@ -255,6 +362,7 @@ def main() -> None:
             "value": round(gemm_mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
+            "ovr": ovr,
         }))
     else:
         # both benches errored: say so instead of faking a 0.0 measurement

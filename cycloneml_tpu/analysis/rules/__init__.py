@@ -15,9 +15,12 @@ from cycloneml_tpu.analysis.rules.jx004_fp64_drift import FP64DriftRule
 from cycloneml_tpu.analysis.rules.jx005_collective_axes import \
     CollectiveAxisRule
 from cycloneml_tpu.analysis.rules.jx006_jit_mutation import JitMutationRule
+from cycloneml_tpu.analysis.rules.jx007_thread_dispatch import \
+    ThreadDispatchRule
 
 ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
-             FP64DriftRule, CollectiveAxisRule, JitMutationRule)
+             FP64DriftRule, CollectiveAxisRule, JitMutationRule,
+             ThreadDispatchRule)
 
 
 def default_rules():

@@ -1,0 +1,186 @@
+"""JX007 — thread-pool / thread dispatch of jit/SPMD entry points.
+
+Every jitted step is a gang-scheduled SPMD program over the WHOLE mesh.
+Dispatching such programs concurrently from a ``ThreadPoolExecutor`` (or
+a raw ``threading.Thread``) interleaves the per-device executions of
+different programs and deadlocks XLA's collective rendezvous — the
+``OneVsRest(parallelism=4)`` hang PR 2 root-caused and
+``mesh.safe_fit_parallelism`` guards at runtime; this rule mechanizes the
+pattern statically. A submit/map/Thread-target callable is flagged when
+it (transitively, within the module) reaches an SPMD dispatch surface:
+an estimator/optimizer ``.fit`` / ``.fit_stacked`` / ``.minimize`` /
+``.optimize``, a ``tree_aggregate`` family call, or a program built by
+``jax.jit``/``pjit``/``tree_aggregate_fn`` in an enclosing scope.
+
+The sanctioned parallel path is the STACKED fit engine (vmapped model
+axis — one program, one gang schedule; docs/multi-model.md); host-tier
+pools over plain Python work are untouched, as are callables the
+analyzer cannot resolve (e.g. function-valued parameters).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, iter_own_statements,
+                                            last_component)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+POOL_TYPES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+THREAD_TYPES = {"Thread", "Timer"}
+DISPATCH_METHODS = {"fit", "fit_stacked", "minimize", "optimize",
+                    "optimize_stacked", "device_line_search"}
+DISPATCH_CALLS = {"tree_aggregate", "tree_aggregate_fn",
+                  "tree_aggregate_with_state", "all_gather_hosts",
+                  "psum_over_mesh", "all_to_all_repartition"}
+# names bound from these hold a compiled SPMD program: calling one IS a
+# dispatch (same set JX001 tracks for batched-readback analysis)
+PROGRAM_BUILDERS = {"tree_aggregate_fn", "tree_aggregate",
+                    "tree_aggregate_with_state", "jit", "pjit"}
+_MAX_DEPTH = 3
+
+
+class ThreadDispatchRule(Rule):
+    rule_id = "JX007"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        toplevel: Dict[str, FunctionInfo] = {}
+        methods: Dict[Tuple[str, str], FunctionInfo] = {}
+        children: Dict[str, List[FunctionInfo]] = {}
+        for fn in mod.functions:
+            simple = fn.qualname.rsplit(".", 1)[-1]
+            if fn.parent is None and fn.class_name is None:
+                toplevel[simple] = fn
+            if fn.class_name is not None and fn.parent is None:
+                methods[(fn.class_name, simple)] = fn
+            if fn.parent is not None:
+                children.setdefault(fn.parent.qualname, []).append(fn)
+        tables = (toplevel, methods, children)
+        for fn in mod.functions:
+            yield from self._check_function(mod, fn, tables)
+
+    # -- per-function scan ----------------------------------------------------
+    def _check_function(self, mod: ModuleInfo, fn: FunctionInfo,
+                        tables) -> Iterator[Finding]:
+        pools: Set[str] = set()
+        programs = _program_names(fn.node)
+        for node in iter_own_statements(fn.node):
+            # pool bindings: `pool = cf.ThreadPoolExecutor(...)` and
+            # `with cf.ThreadPoolExecutor(...) as pool:`
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_component(call_name(node.value)) in POOL_TYPES:
+                for t in node.targets:
+                    pools.update(assigned_names(t))
+            elif isinstance(node, ast.withitem) \
+                    and isinstance(node.context_expr, ast.Call) \
+                    and last_component(call_name(node.context_expr)) \
+                    in POOL_TYPES \
+                    and node.optional_vars is not None:
+                pools.update(assigned_names(node.optional_vars))
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._submitted_callable(node, pools)
+            if target is None:
+                continue
+            kind, expr = target
+            if self._dispatches_spmd(expr, mod, fn, tables, programs,
+                                     set(), _MAX_DEPTH):
+                yield self.finding(
+                    mod, node,
+                    f"{kind} dispatches a jit/SPMD entry point from a "
+                    f"worker thread; concurrent SPMD programs deadlock the "
+                    f"shared mesh's collective rendezvous — use the "
+                    f"stacked (vmapped model-axis) fit engine or run "
+                    f"serially (mesh.safe_fit_parallelism)",
+                    fn.qualname)
+
+    @staticmethod
+    def _submitted_callable(node: ast.Call, pools: Set[str]):
+        """(description, callable expr) for pool.map/submit and
+        Thread(target=...) calls, else None."""
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("map", "submit") \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in pools \
+                and node.args:
+            return (f"`.{node.func.attr}()` on a thread pool", node.args[0])
+        if last_component(call_name(node)) in THREAD_TYPES:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    return ("`threading.Thread(target=...)`", kw.value)
+        return None
+
+    # -- does the callable reach an SPMD dispatch surface? --------------------
+    def _dispatches_spmd(self, expr: ast.AST, mod: ModuleInfo,
+                         scope: FunctionInfo, tables, programs: Set[str],
+                         visited: Set[int], depth: int) -> bool:
+        if depth <= 0:
+            return False
+        info = self._resolve(expr, scope, tables)
+        if info is not None:
+            if id(info) in visited:
+                return False
+            visited.add(id(info))
+            body: ast.AST = info.node
+        elif isinstance(expr, ast.Lambda):
+            body = expr
+        else:
+            return False  # unresolvable (parameter, import, builtin)
+        # programs bound in the callable itself count too
+        local_programs = programs | _program_names(body)
+        for sub in (iter_own_statements(body)
+                    if not isinstance(body, ast.Lambda)
+                    else ast.walk(body.body)):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = call_name(sub)
+            base = last_component(name)
+            if base in DISPATCH_METHODS or base in DISPATCH_CALLS:
+                return True
+            if isinstance(sub.func, ast.Name) \
+                    and sub.func.id in local_programs:
+                return True
+            # transitive: resolve local/self calls one level down
+            owner = info if info is not None else scope
+            if self._dispatches_spmd(sub.func, mod, owner, tables,
+                                     local_programs, visited, depth - 1):
+                return True
+        return False
+
+    @staticmethod
+    def _resolve(expr: ast.AST, scope: FunctionInfo,
+                 tables) -> Optional[FunctionInfo]:
+        toplevel, methods, children = tables
+        if isinstance(expr, ast.Name):
+            walk = scope
+            while walk is not None:
+                for child in children.get(walk.qualname, []):
+                    if child.qualname.rsplit(".", 1)[-1] == expr.id:
+                        return child
+                walk = walk.parent
+            return toplevel.get(expr.id)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls") \
+                and scope is not None and scope.class_name:
+            return methods.get((scope.class_name, expr.attr))
+        return None
+
+
+def _program_names(fn_node: ast.AST) -> Set[str]:
+    """Names bound from a compiled-program factory in this function's own
+    body (``prog = ds.tree_aggregate_fn(f)`` / ``go = jax.jit(f)``)."""
+    out: Set[str] = set()
+    stmts = (iter_own_statements(fn_node)
+             if not isinstance(fn_node, ast.Lambda) else ())
+    for node in stmts:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and last_component(call_name(node.value)) in PROGRAM_BUILDERS:
+            for t in node.targets:
+                out.update(assigned_names(t))
+    return out

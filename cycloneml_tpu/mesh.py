@@ -188,26 +188,40 @@ class MeshRuntime:
         return jax.device_put(tree, self.replicated())
 
 
-def safe_fit_parallelism(requested: int) -> int:
-    """Cap thread-parallel estimator fits for the active mesh.
+def safe_fit_parallelism(requested: int, stacked_width: int = 0) -> int:
+    """Effective parallelism for concurrent estimator fits on the active
+    mesh; returns the width the caller may actually use (and report).
 
-    Every jitted step is a gang-scheduled SPMD program over the WHOLE mesh;
-    two programs dispatched concurrently from different threads interleave
-    their per-device executions and deadlock XLA's collective rendezvous
-    (observed: OneVsRest(parallelism=4) hanging the suite on local-mesh[8]
-    once shard_map was un-broken). A >1 pool is therefore only honored on
-    single-device meshes, where no cross-device rendezvous exists; the
+    THREAD pools are still capped: every jitted step is a gang-scheduled
+    SPMD program over the WHOLE mesh; two programs dispatched concurrently
+    from different threads interleave their per-device executions and
+    deadlock XLA's collective rendezvous (observed: OneVsRest(parallelism=4)
+    hanging the suite on local-mesh[8] once shard_map was un-broken; now
+    mechanized as graftlint JX007). A >1 width is returned only on
+    single-device meshes, where no cross-device rendezvous exists — though
+    the in-repo estimators no longer build pools at all (they stack or run
+    serially, and call this for the cap log + effective-width report); the
     reference's ``parallelism`` param parallelizes independent Spark jobs
     across a cluster, a resource this mesh model does not have.
+
+    STACKED fits are the sanctioned parallel path: ``stacked_width > 0``
+    declares that the caller runs that many models as ONE vmapped SPMD
+    program — a single gang-scheduled dispatch with a leading model axis
+    (docs/multi-model.md), so no cross-program rendezvous exists and full
+    model-parallelism is safe on any mesh size. The stacked width is
+    returned so callers can report the effective parallelism they achieved.
     """
+    if stacked_width > 0:
+        return stacked_width
     if requested <= 1:
         return requested
     rt = active()
     if rt is not None and rt.n_devices > 1:
         logger.info(
-            "capping fit parallelism %d -> 1: concurrent SPMD dispatch "
-            "onto a shared %d-device mesh would deadlock its collectives",
-            requested, rt.n_devices)
+            "capping thread-pool fit parallelism %d -> 1: concurrent SPMD "
+            "dispatch onto a shared %d-device mesh would deadlock its "
+            "collectives; stacked fits (vmapped model axis, one program) "
+            "are the sanctioned parallel path", requested, rt.n_devices)
         return 1
     return requested
 

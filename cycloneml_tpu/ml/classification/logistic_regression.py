@@ -207,6 +207,153 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             self.get("weightCol") or None)  # f64 under x64 config, else f32
         return self._fit_dataset(ds)
 
+    # -- stacked (model-axis) fits -------------------------------------------
+    def can_fit_stacked(self) -> bool:
+        """Param-level eligibility for the stacked (vmapped model-axis)
+        fit: binomial objective, pure L2 (``elasticNetParam == 0``), no
+        coefficient bounds, no mid-training checkpointing — the same
+        preconditions as the chunked device optimizer the stacked engine
+        drives. Data-level checks ({0, 1} labels, dense tier) happen inside
+        :meth:`fit_stacked`."""
+        return (self.get("family") != "multinomial"
+                and float(self.get("elasticNetParam")) == 0.0
+                and not self._has_bounds()
+                and not self.get("checkpointDir"))
+
+    def fit_stacked(self, frame, y_stack=None, reg_params=None):
+        """Fit K binomial models over ONE shared design matrix as ONE
+        gang-scheduled SPMD program (the sanctioned parallel path — see
+        ``mesh.safe_fit_parallelism`` and docs/multi-model.md).
+
+        ``vmap`` pushes a model axis through the staged optimizer step
+        mechanically (Frostig et al. 2018; GSPMD, Xu et al. 2021): the K
+        fits share one trace + XLA compile, every ``tree_aggregate`` psum
+        carries all K gradients, and per-model convergence masks freeze
+        early-converged models on device. No cross-program collective
+        rendezvous exists, so — unlike thread-pool fan-out (the PR-2
+        deadlock) — full model-parallelism is safe on any mesh.
+
+        ``y_stack``: (K, n) per-model {0, 1} label vectors (OneVsRest's
+        relabelings); default is the frame's own label column tiled K
+        times. ``reg_params``: per-model L2 strength (CrossValidator's
+        regParam grid); default is this estimator's ``regParam`` tiled.
+        At least one of the two must be given. Returns a list of K
+        :class:`LogisticRegressionModel` (summaries carry ``n_models``).
+        """
+        import jax.numpy as jnp
+
+        from cycloneml_tpu.dataset.sparse import SparseInstanceDataset
+        from cycloneml_tpu.ml.optim.device_lbfgs import StackedDeviceLBFGS
+        from cycloneml_tpu.ml.optim.loss import (
+            StackedDistributedLossFunction, inv_std_vector,
+            stacked_l2_scale, validate_binary_labels,
+        )
+
+        if not self.can_fit_stacked():
+            raise ValueError(
+                "fit_stacked requires a binomial, pure-L2, unbounded, "
+                "non-checkpointed configuration (can_fit_stacked)")
+        if isinstance(frame, SparseInstanceDataset):
+            raise ValueError("stacked fits are dense-tier only")
+        ds = frame.to_instance_dataset(
+            self.get("featuresCol"), self.get("labelCol"),
+            self.get("weightCol") or None)
+        if y_stack is None and reg_params is None:
+            raise ValueError("fit_stacked needs y_stack or reg_params")
+        if y_stack is None:
+            y = np.asarray(ds.unpad(ds.y_host()), dtype=np.float64)
+            y_stack = np.broadcast_to(y, (len(reg_params), len(y)))
+        y_stack = np.asarray(y_stack)
+        n_models = y_stack.shape[0]
+        if y_stack.shape[1] != ds.n_rows:
+            raise ValueError(
+                f"y_stack has {y_stack.shape[1]} rows per model; dataset "
+                f"has {ds.n_rows}")
+        validate_binary_labels(y_stack, "fit_stacked")
+        reg = self.get("regParam")
+        if reg_params is None:
+            reg_params = np.full(n_models, float(reg))
+        reg_params = np.asarray(reg_params, dtype=np.float64)
+        if len(reg_params) != n_models:
+            raise ValueError("reg_params length != number of stacked models")
+
+        d = ds.n_features
+        stats = Summarizer.summarize(ds)
+        features_std = stats.std
+        weight_sum = stats.weight_sum
+        fit_intercept = self.get("fitIntercept")
+        standardize = self.get("standardization")
+        fit_with_mean = fit_intercept  # bounds are excluded by eligibility
+        inv_std = inv_std_vector(features_std)
+        scaled_mean = stats.mean * inv_std if fit_with_mean else np.zeros(d)
+
+        n_coef = d + (1 if fit_intercept else 0)
+        x0 = np.zeros((n_models, n_coef))
+        if fit_intercept:
+            w_real = np.asarray(ds.unpad(ds.w_host()), dtype=np.float64)
+            pos = y_stack @ w_real  # per-model weighted positive mass
+            ok = (pos > 0) & (pos < weight_sum)
+            p1 = np.where(ok, pos / weight_sum, 0.5)
+            x0[:, d] = np.where(ok, np.log(p1 / (1.0 - p1)), 0.0)
+
+        # the stacked (n_pad, K) label matrix rides the dataset's row
+        # sharding in the data-tier dtype; X itself is SHARED via derive —
+        # no second feature copy exists
+        xdt = np.dtype(str(ds.x.dtype))
+        y_pad = np.zeros((len(ds.y_host()), n_models), dtype=xdt)
+        y_pad[ds.valid_indices()] = y_stack.T.astype(xdt)
+        rt = ds.ctx.mesh_runtime
+        ds_stacked = ds.derive(y=rt.device_put_sharded_rows(y_pad))
+
+        agg = aggregators.stack_scaled_aggregator(
+            aggregators.binary_logistic_scaled(d, fit_intercept))
+        l2s = stacked_l2_scale(d, n_coef, features_std, standardize)
+        loss_fn = StackedDistributedLossFunction(
+            ds_stacked, agg, n_models, reg=reg_params, l2_scale=l2s,
+            weight_sum=weight_sum,
+            extra_args=(jnp.asarray(inv_std.astype(xdt)),
+                        jnp.asarray(scaled_mean.astype(xdt))))
+
+        from cycloneml_tpu.conf import LBFGS_DEVICE_CHUNK
+        chunk = int(ds.ctx.conf.get(LBFGS_DEVICE_CHUNK)) \
+            if hasattr(ds.ctx, "conf") else 0
+        # deviceChunk=0 means "one dispatch per iteration"; the stacked
+        # engine has no host loop, so honor it as chunk=1 (per-iteration
+        # dispatches) rather than silently running the default chunk
+        opt = StackedDeviceLBFGS(max_iter=self.get("maxIter"),
+                                 tol=self.get("tol"),
+                                 chunk=max(chunk, 1))
+        res = opt.minimize(loss_fn, x0)
+        n_unconverged = sum(
+            1 for r in res.converged_reasons if r == "max iterations reached")
+        if n_unconverged:
+            logger.warning(
+                "stacked LogisticRegression: %d of %d models did not "
+                "converge in %d iterations", n_unconverged, n_models,
+                self.get("maxIter"))
+
+        models = []
+        for kk in range(n_models):
+            sol = res.x[kk]
+            beta = sol[:d] * inv_std
+            icpt = float(sol[d]) if fit_intercept else 0.0
+            if fit_with_mean:
+                icpt -= float(sol[:d] @ scaled_mean)
+            model = LogisticRegressionModel(
+                coefficient_matrix=beta[None, :],
+                intercept_vector=np.array([icpt]),
+                num_classes=2, is_multinomial=False)
+            self._copy_values(model)
+            model._set_parent(self)
+            model.summary = LogisticRegressionTrainingSummary(
+                objective_history=list(res.loss_histories[kk]),
+                total_iterations=int(res.iterations[kk]),
+                total_evals=int(res.evals[kk]),
+                total_dispatches=loss_fn.n_dispatches,
+                n_models=n_models)
+            models.append(model)
+        return models
+
     def _fit_sparse(self, ds) -> "LogisticRegressionModel":
         """Binomial logistic regression over the sparse (ELL / ELL+COO
         hybrid) tier: same statistical semantics as the dense path —
@@ -640,7 +787,7 @@ class LogisticRegressionTrainingSummary:
     binary metrics come from ``model.evaluate(frame)``)."""
 
     def __init__(self, objective_history, total_iterations,
-                 total_evals=None, total_dispatches=None):
+                 total_evals=None, total_dispatches=None, n_models=1):
         self.objective_history = objective_history
         self.total_iterations = total_iterations
         # optimizer-path telemetry: loss/grad evaluations and host->device
@@ -648,6 +795,9 @@ class LogisticRegressionTrainingSummary:
         # not ~ evals)
         self.total_evals = total_evals
         self.total_dispatches = total_dispatches
+        # >1 when this model trained inside a stacked (vmapped model-axis)
+        # fit: its compiles AND dispatches were shared by n_models models
+        self.n_models = n_models
 
 
 class BinaryLogisticRegressionSummary:

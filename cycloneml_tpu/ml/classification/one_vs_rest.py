@@ -3,13 +3,22 @@
 Re-design of the reference (ref: ml/classification/OneVsRest.scala — fits
 one binary copy of the base classifier per class over relabeled data, with
 a ``parallelism`` thread pool; the model picks the class whose binary
-margin is largest). The relabel is a host-side column swap; each binary fit
-runs the base estimator's own SPMD program.
+margin is largest). The relabel is a host-side column swap.
+
+``parallelism > 1`` routes through the STACKED fit engine when the base
+classifier supports it (``fit_stacked``): the K binary fits share one
+design matrix, so ``vmap`` runs them as ONE gang-scheduled SPMD program —
+one trace + compile amortized over all K models, one psum per step
+carrying K gradients, per-model convergence masks. The reference's thread
+pool (and this repo's pre-stacking port of it) dispatched K concurrent
+SPMD programs onto the shared mesh and deadlocked XLA's collective
+rendezvous (graftlint JX007 now mechanizes that hazard); the serial loop
+remains as the fallback for classifiers/configs the stacked engine does
+not cover. See docs/multi-model.md.
 """
 
 from __future__ import annotations
 
-import concurrent.futures as cf
 from typing import List, Optional
 
 import numpy as np
@@ -65,24 +74,49 @@ class OneVsRest(Estimator, _OVRParams, MLWritable, MLReadable):
         y = np.asarray(frame[label_col])
         num_classes = int(y.max()) + 1
 
-        def fit_one(c: int):
-            binary = (y == c).astype(np.float64)
-            sub = frame.with_column("_ovr_label", binary)
-            clf = self.classifier.copy()
-            clf.set("labelCol", "_ovr_label")
+        from cycloneml_tpu.dataset.instance import compute_dtype
+
+        def _configure(clf):
             clf.set("featuresCol", self.get("featuresCol"))
             wc = self.get("weightCol")
             if wc and "weightCol" in clf._params:
                 clf.set("weightCol", wc)
-            return clf.fit(sub)
+            return clf
 
         from cycloneml_tpu.mesh import safe_fit_parallelism
-        par = safe_fit_parallelism(self.get("parallelism"))
-        if par > 1:
-            with cf.ThreadPoolExecutor(max_workers=par) as pool:
-                models = list(pool.map(fit_one, range(num_classes)))
+        requested = self.get("parallelism")
+        clf = _configure(self.classifier.copy())
+        stackable = (requested > 1 and num_classes > 1
+                     and hasattr(clf, "fit_stacked")
+                     and clf.can_fit_stacked()
+                     and hasattr(frame, "to_instance_dataset"))
+        if stackable:
+            effective = safe_fit_parallelism(requested,
+                                             stacked_width=num_classes)
+            logger.info(
+                "OneVsRest: fitting %d binary models as ONE stacked SPMD "
+                "program (effective parallelism %d)", num_classes, effective)
+            clf.set("labelCol", label_col)
+            # ONE (K, n) binary label matrix in the data-tier dtype — not
+            # K fp64 host vectors (JX004 data-tier discipline); the stacked
+            # engine consumes all K rows at once
+            y_stack = (np.arange(num_classes)[:, None]
+                       == y[None, :]).astype(compute_dtype())
+            models = clf.fit_stacked(frame, y_stack)
         else:
-            models = [fit_one(c) for c in range(num_classes)]
+            # serial fallback: SPMD fits stay on this thread (a >1 thread
+            # pool deadlocks the shared mesh — mesh.safe_fit_parallelism);
+            # relabels are one TRANSIENT data-tier-dtype vector per class
+            # (a full (n, K) matrix would sit in host memory for all K
+            # sequential fits for no reader)
+            safe_fit_parallelism(requested)
+            models = []
+            for c in range(num_classes):
+                binary = (y == c).astype(compute_dtype())
+                sub = frame.with_column("_ovr_label", binary)
+                one = _configure(self.classifier.copy())
+                one.set("labelCol", "_ovr_label")
+                models.append(one.fit(sub))
 
         model = OneVsRestModel(models, uid=self.uid)
         self._copy_values(model)

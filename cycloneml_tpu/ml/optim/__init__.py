@@ -5,3 +5,19 @@ from cycloneml_tpu.ml.optim import aggregators
 
 __all__ = ["LBFGS", "LBFGSB", "OWLQN", "OptimState", "WeightedLeastSquares",
            "WeightedLeastSquaresModel", "aggregators"]
+
+
+def __getattr__(name):
+    # stacked-fit engine entry points, imported lazily (they pull in the
+    # device modules, which the light host-only users of this package —
+    # e.g. the WLS normal-equation path — never need)
+    if name in ("StackedDeviceLBFGS", "StackedOptimResult"):
+        from cycloneml_tpu.ml.optim import device_lbfgs
+        return getattr(device_lbfgs, name)
+    if name in ("StackedGradientDescent", "GradientDescent"):
+        from cycloneml_tpu.ml.optim import gradient_descent
+        return getattr(gradient_descent, name)
+    if name == "StackedDistributedLossFunction":
+        from cycloneml_tpu.ml.optim import loss
+        return loss.StackedDistributedLossFunction
+    raise AttributeError(name)

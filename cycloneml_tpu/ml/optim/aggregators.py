@@ -284,6 +284,31 @@ def _huber(d: int, fit_intercept: bool, epsilon: float, prec) -> Agg:
     return agg
 
 
+@functools.lru_cache(maxsize=None)
+def stack_aggregator(agg: Agg) -> Agg:
+    """Model-axis twin of a plain ``(x, y, w, coef)`` aggregator.
+
+    ``vmap`` pushes a leading model axis through the block matmuls
+    mechanically (Frostig, Johnson & Leary, SysML 2018): the stacked twin
+    takes a ``(b, K)`` label matrix (axis 1 — labels stay ROW-sharded like
+    every other dataset array) and ``(K, n_coef)`` coefficients, with
+    ``x``/``w`` shared, and returns ``{loss (K,), grad (K, n_coef),
+    count (K,)}`` — so ``tree_aggregate`` reduces all K models' partials in
+    ONE psum with a leading model axis. lru-cached on the base aggregator so
+    repeated stacked fits keep program-cache identity (one XLA compile per
+    (mesh, K, shapes), amortized over all K models)."""
+    return jax.vmap(agg, in_axes=(None, 1, None, 0))
+
+
+@functools.lru_cache(maxsize=None)
+def stack_scaled_aggregator(agg: Agg) -> Agg:
+    """Model-axis twin of a scaled aggregator
+    ``(x, y, w, inv_std, scaled_mean, coef)`` (standardization folded into
+    the read): labels vmap over axis 1, coefficients over axis 0, everything
+    else — including the shared standardization vectors — broadcasts."""
+    return jax.vmap(agg, in_axes=(None, 1, None, None, None, 0))
+
+
 def autodiff_check(agg_loss_only: Callable, d: int):
     """Return jax.grad of a loss-only aggregator — used in tests to verify the
     hand-derived gradients above (SURVEY §7 step 5: 'where jax.grad can

@@ -24,7 +24,8 @@ the host path), f32 on TPU (last-ulp drift; the convergence thresholds are
 
 from __future__ import annotations
 
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 import numpy as np
 
@@ -308,3 +309,301 @@ class DeviceLBFGS(LBFGS):
             if state.converged:
                 return
             f_d = cdt.type(f_h)
+
+
+# -- stacked (model-axis) variant ---------------------------------------------
+
+def _build_stacked_chunk(compiled, m: int, K_iters: int, c1: float, c2: float,
+                         max_ls: int, cdt: np.dtype):
+    """jit program: up to ``K_iters`` L-BFGS iterations for a STACK of
+    models inside one dispatch.
+
+    Every piece of optimizer state carries a leading model axis — coef
+    ``(K, n)``, curvature ring buffers ``(K, m, n)``, per-model f/g/history
+    count — and the objective is the stacked aggregation (one psum, model
+    axis leading). The strong-Wolfe machine is ``loss.wolfe_search`` in its
+    batched form: each model walks its own bracket+zoom trajectory in
+    lockstep evaluation steps and freezes when ITS search terminates.
+    Per-model convergence codes freeze early-converged models (state
+    selected through unchanged) instead of stopping — or lockstepping —
+    the rest; the chunk ends when every model converged or the iteration
+    budget is spent.
+
+    The L2 penalty is runtime data (``reg (K,)`` per model + the shared
+    per-coordinate ``l2_scale``), NOT baked in, so one compiled program
+    serves every reg vector (CV folds over a λ grid reuse one compile).
+
+    Args: ``(*arrays, coef, S, Y, k_hist, f0, g0, first, ws, reg, l2s,
+    tol, grad_tol, it_limit, need_init, code_in)`` →
+    ``(coef, S, Y, k_hist, f, g, losses (K, K_iters), steps, iters (K,),
+    evals (K,), evals_global, code (K,), f_init)``. ``code_in`` carries the
+    previous chunk's per-model convergence codes back in — a model frozen
+    in chunk t must START chunk t+1 frozen, or every chunk boundary would
+    un-freeze it for one spurious iteration and the result would depend on
+    the chunk size.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.ml.optim.loss import wolfe_search
+
+    def two_loop_one(S, Y, k, g):
+        idxs_bwd = jnp.arange(m - 1, -1, -1)
+
+        def bwd(q, i):
+            valid = i >= m - k
+            sy = jnp.dot(Y[i], S[i])
+            rho = jnp.where(valid, 1.0 / jnp.where(valid, sy, 1.0), 0.0)
+            a = rho * jnp.dot(S[i], q)
+            return q - a * Y[i], (a, rho)
+
+        q, (alphas, rhos) = jax.lax.scan(bwd, g, idxs_bwd)
+        last_sy = jnp.dot(S[m - 1], Y[m - 1])
+        last_yy = jnp.dot(Y[m - 1], Y[m - 1])
+        gamma = jnp.where(k > 0, last_sy / jnp.maximum(last_yy, 1e-300), 1.0)
+        r = gamma * q
+
+        def fwd(r, inp):
+            i, a, rho = inp
+            beta = rho * jnp.dot(Y[i], r)
+            return r + (a - beta) * S[i], None
+
+        r, _ = jax.lax.scan(
+            fwd, r, (idxs_bwd[::-1], alphas[::-1], rhos[::-1]))
+        return -r
+
+    two_loop = jax.vmap(two_loop_one)
+
+    def program(*args):
+        (arrays, coef0, S0, Y0, k0, f_in, g_in, first,
+         ws, reg, l2s, tol, grad_tol, it_limit, need_init, code_in) = \
+            (args[:-15], *args[-15:])
+
+        def f_and_g(coef):
+            out = compiled(*arrays, coef)
+            loss = (out["loss"] / ws).astype(cdt)
+            grad = (out["grad"] / ws).astype(cdt)
+            # runtime-data L2 (same math as l2_regularization's traceable
+            # twin, vectorized over the model axis). A vmapped dot, not a
+            # masked sum-reduce: it lowers like the serial twin's
+            # ``jnp.dot(beta, beta)`` (zero intercept products are exact),
+            # so stacked and serial trajectories stay bit-aligned instead
+            # of flipping iterations at the convergence-tol boundary.
+            loss = loss + 0.5 * reg * jax.vmap(jnp.dot)(coef * l2s[None, :],
+                                                        coef)
+            grad = grad + reg[:, None] * coef * l2s[None, :]
+            return loss, grad
+
+        def body(carry):
+            (coef, S, Y, k, f, g, step, iters, ev_pm, ev_g, code,
+             losses) = carry
+            live = code == 0
+            d = two_loop(S, Y, k, g)
+            dg0 = jnp.sum(d * g, axis=1)
+            bad = dg0 >= 0
+            d = jnp.where(bad[:, None], -g, d)
+            k = jnp.where(bad, 0, k)
+            gg = jnp.sum(g * g, axis=1)
+            dg0 = jnp.where(bad, -gg, dg0)
+            gnorm = jnp.sqrt(jnp.maximum(gg, 1e-300))
+            init_alpha = jnp.where(
+                (first & (step == 0)) | bad,
+                jnp.minimum(1.0, 1.0 / gnorm), cdt.type(1.0)).astype(cdt)
+
+            def phi(alpha):
+                v, grad = f_and_g(coef + alpha[:, None] * d)
+                return v, grad, jnp.sum(d * grad, axis=1)
+
+            alpha, f_new, g_new, ev = wolfe_search(
+                phi, jnp.zeros_like(g), f, dg0, init_alpha,
+                c1, c2, max_ls, cdt, active=live)
+            s_vec = alpha[:, None] * d
+            y_vec = g_new - g
+            keep = live & (jnp.sum(s_vec * y_vec, axis=1)
+                           > 1e-10 * jnp.sum(y_vec * y_vec, axis=1))
+            S = jnp.where(keep[:, None, None],
+                          jnp.roll(S, -1, axis=1).at[:, -1].set(s_vec), S)
+            Y = jnp.where(keep[:, None, None],
+                          jnp.roll(Y, -1, axis=1).at[:, -1].set(y_vec), Y)
+            k = jnp.where(keep, jnp.minimum(k + 1, m), k)
+            denom = jnp.maximum(jnp.maximum(jnp.abs(f_new), jnp.abs(f)),
+                                1e-6)
+            f_conv = jnp.abs(f - f_new) <= tol * denom
+            gn = jnp.sqrt(jnp.maximum(jnp.sum(g_new * g_new, axis=1), 0.0))
+            xn = jnp.sqrt(jnp.maximum(
+                jnp.sum((coef + s_vec) ** 2, axis=1), 0.0))
+            g_conv = gn <= grad_tol * jnp.maximum(xn, 1.0)
+            code_new = jnp.where(f_conv, 1,
+                                 jnp.where(g_conv, 2, 0)).astype(jnp.int32)
+            losses = losses.at[:, step].set(
+                jnp.where(live, f_new, jnp.nan).astype(cdt))
+            return (jnp.where(live[:, None], coef + s_vec, coef),
+                    S, Y, k,
+                    jnp.where(live, f_new, f),
+                    jnp.where(live[:, None], g_new, g),
+                    step + 1,
+                    iters + live.astype(jnp.int32),
+                    ev_pm + ev,
+                    ev_g + jnp.max(ev),
+                    jnp.where(live, code_new, code),
+                    losses)
+
+        def cond(carry):
+            step, code = carry[6], carry[10]
+            return (step < jnp.minimum(K_iters, it_limit)) \
+                & jnp.any(code == 0)
+
+        K = coef0.shape[0]
+        f_init, g_init = jax.lax.cond(need_init,
+                                      lambda: f_and_g(coef0),
+                                      lambda: (f_in, g_in))
+        ev0 = jnp.where(need_init, 1, 0).astype(jnp.int32)
+        init = (coef0, S0, Y0, k0, f_init, g_init, jnp.int32(0),
+                jnp.zeros((K,), jnp.int32), jnp.full((K,), ev0),
+                ev0, code_in,
+                jnp.full((K, K_iters), jnp.nan, cdt))
+        (coef, S, Y, k, f, g, step, iters, ev_pm, ev_g, code, losses) = \
+            jax.lax.while_loop(cond, body, init)
+        return (coef, S, Y, k, f, g, losses, step, iters, ev_pm, ev_g,
+                code, f_init)
+
+    return jax.jit(program)
+
+
+@dataclass
+class StackedOptimResult:
+    """Terminal state of one stacked fit: every field carries the model
+    axis; histories/reasons are per model (the per-model analog of the
+    serial path's OptimState + converged_reason)."""
+
+    x: np.ndarray                       # (K, n) float64
+    values: np.ndarray                  # (K,)
+    iterations: np.ndarray              # (K,) int — per-model LIVE iters
+    converged_reasons: List[str] = field(default_factory=list)
+    loss_histories: List[List[float]] = field(default_factory=list)
+    evals: Optional[np.ndarray] = None  # (K,) per-model loss/grad evals
+
+
+class StackedDeviceLBFGS:
+    """Chunked L-BFGS over a stack of K models sharing one design matrix.
+
+    The model-axis variant of :class:`DeviceLBFGS`: one dispatch advances
+    ALL models up to ``chunk`` iterations (batched objective = one psum with
+    a leading model axis), per-model convergence masks freeze
+    early-converged models on device, and the host sees one small readback
+    per chunk. Preconditions match the serial chunked path: dense replicated
+    tier, standardized-or-original-space uniform L2 carried as runtime data
+    (``StackedDistributedLossFunction.reg``/``l2_scale``), no bounds/L1.
+    """
+
+    def __init__(self, max_iter: int = 100, m: int = 10, tol: float = 1e-6,
+                 grad_tol: Optional[float] = None, chunk: int = 8,
+                 c1: float = 1e-4, c2: float = 0.9, max_ls: int = 30):
+        self.max_iter = max_iter
+        self.m = m
+        self.tol = tol
+        self.grad_tol = grad_tol if grad_tol is not None else tol
+        self.chunk = max(int(chunk), 1)
+        self.c1, self.c2, self.max_ls = c1, c2, max_ls
+
+    def minimize(self, f, x0: np.ndarray) -> StackedOptimResult:
+        """``f`` is a ``StackedDistributedLossFunction``; ``x0`` is the
+        (K, n_coef) stacked start point."""
+        import jax
+        import jax.numpy as jnp
+
+        x0 = np.asarray(x0, dtype=np.float64)
+        K, n = x0.shape
+        if K != f.n_models:
+            raise ValueError(
+                f"x0 stacks {K} models but the loss carries {f.n_models}")
+        arrays = f._agg_call.arrays()
+        cdt = np.dtype(arrays[2].dtype)  # w — the data-tier dtype
+        key = ("stacked_lbfgs_chunk", f._agg_call.compiled, self.m,
+               self.chunk, float(self.c1), float(self.c2), int(self.max_ls),
+               cdt.str)
+        prog = _program_cache.get(key)
+        fresh = prog is None
+        if fresh:
+            prog = _build_stacked_chunk(f._agg_call.compiled, self.m,
+                                        self.chunk, self.c1, self.c2,
+                                        self.max_ls, cdt)
+            _program_cache.put(key, prog)
+
+        coef = jnp.asarray(x0.astype(cdt))
+        S_d = jnp.zeros((K, self.m, n), cdt)
+        Y_d = jnp.zeros((K, self.m, n), cdt)
+        k_d = jnp.zeros((K,), jnp.int32)
+        f_d = jnp.zeros((K,), cdt)
+        g_d = jnp.zeros((K, n), cdt)
+        reg_d = jnp.asarray(f.reg.astype(cdt))
+        l2s = (f.l2_scale if f.l2_scale is not None else np.zeros(n))
+        l2s_d = jnp.asarray(l2s.astype(cdt))
+        first, need_init = True, True
+        total_iter = 0
+        iters_total = np.zeros(K, dtype=np.int64)
+        evals_total = np.zeros(K, dtype=np.int64)
+        histories: List[List[float]] = [[] for _ in range(K)]
+        code_h = np.zeros(K, dtype=np.int64)
+        while True:
+            args = (*arrays, coef, S_d, Y_d, k_d, f_d, g_d,
+                    np.bool_(first), cdt.type(f.weight_sum), reg_d, l2s_d,
+                    cdt.type(self.tol), cdt.type(self.grad_tol),
+                    np.int32(max(self.max_iter - total_iter, 0)),
+                    np.bool_(need_init),
+                    code_h.astype(np.int32))
+            with tracing.span("dispatch", "lbfgs.stacked_chunk",
+                              n_models=K) as dsp:
+                if fresh:
+                    with tracing.span("compile", "lbfgs.stacked_chunk"):
+                        (coef, S_d, Y_d, k_d, f_d, g_d, losses_d, step_d,
+                         it_d, ev_d, evg_d, code_d, f0_d) = prog(*args)
+                    fresh = False
+                else:
+                    (coef, S_d, Y_d, k_d, f_d, g_d, losses_d, step_d,
+                     it_d, ev_d, evg_d, code_d, f0_d) = prog(*args)
+                with tracing.span("transfer", "lbfgs.readback") as tsp:
+                    (losses, steps, iters, ev_pm, ev_g, code_h,
+                     f0_h) = jax.device_get(
+                        (losses_d, step_d, it_d, ev_d, evg_d, code_d, f0_d))
+                    tsp.annotate_bytes(
+                        (losses, steps, iters, ev_pm, ev_g, code_h, f0_h))
+            dsp.annotate(evals=int(ev_g))
+            f.n_evals += int(ev_g)
+            f.n_dispatches += 1
+            if need_init:
+                for kk in range(K):
+                    histories[kk].append(float(f0_h[kk]))
+                need_init = False
+            first = False
+            for kk in range(K):
+                for v in losses[kk, :int(steps)]:
+                    if not np.isnan(v):
+                        histories[kk].append(float(v))
+            iters_total += np.asarray(iters, dtype=np.int64)
+            evals_total += np.asarray(ev_pm, dtype=np.int64)
+            total_iter += int(steps)
+            if hasattr(f, "_ctx") and hasattr(f._ctx, "record_step"):
+                f._ctx.record_step({
+                    "loss": float(np.nanmean(losses[:, :max(int(steps), 1)]))
+                    if int(steps) else float(np.mean(f0_h)),
+                    "chunk_iterations": int(steps), "n_models": K})
+            if (code_h != 0).all() or total_iter >= self.max_iter:
+                break
+        # budget stop outranks the value/gradient tests, as in the serial
+        # paths (the estimator's non-convergence warning keys off this)
+        reasons = []
+        for kk in range(K):
+            if code_h[kk] == 1:
+                reasons.append("function value converged")
+            elif code_h[kk] == 2:
+                reasons.append("gradient converged")
+            else:
+                reasons.append("max iterations reached")
+        return StackedOptimResult(
+            x=np.asarray(coef, dtype=np.float64),
+            values=np.asarray(f_d, dtype=np.float64),
+            iterations=iters_total,
+            converged_reasons=reasons,
+            loss_histories=histories,
+            evals=evals_total)

@@ -150,3 +150,85 @@ class GradientDescent:
                     logger.info("GradientDescent converged at iteration %d", t)
                     break
         return w, history
+
+class StackedGradientDescent(GradientDescent):
+    """Model-axis (vmapped) mini-batch SGD: K models over ONE design matrix.
+
+    The stacked twin of :meth:`GradientDescent.optimize` — the dataset
+    carries a ``(n_pad, K)`` label matrix as ``y``, the aggregator is
+    vmapped over the model axis (``aggregators.stack_aggregator``), and
+    every step is ONE batched psum producing K gradients. Per-model
+    convergence masks freeze early-converged models (no weight update, no
+    history entry — exactly where their serial run would have stopped)
+    while the rest keep stepping; the per-step Bernoulli mask is keyed on
+    step+seed only, so each model sees the SAME sample sequence its serial
+    run would.
+    """
+
+    def optimize_stacked(self, dataset, agg: Callable, x0: np.ndarray
+                         ) -> Tuple[np.ndarray, list]:
+        """``x0`` is (K, n); returns ``(weights (K, n), histories)`` where
+        ``histories[k]`` is model k's stochastic loss history (what serial
+        ``optimize`` returns per model)."""
+        import jax
+        import jax.numpy as jnp
+
+        from cycloneml_tpu.mesh import DATA_AXIS, REPLICA_AXIS
+        from cycloneml_tpu.ml.optim import aggregators
+
+        stacked = aggregators.stack_aggregator(agg)
+        frac = self.mini_batch_fraction
+
+        def fn(*args):
+            *rows, w, coef, step = args
+            if frac < 1.0:
+                key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+                key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(REPLICA_AXIS))
+                w = w * (jax.random.uniform(key, w.shape) < frac)
+            return stacked(*rows, w, coef)
+
+        compiled = dataset.tree_aggregate_fn(fn)
+
+        W = np.asarray(x0, dtype=np.float64).copy()
+        n_models = W.shape[0]
+        histories: list = [[] for _ in range(n_models)]
+        regs = np.zeros(n_models)
+        for kk in range(n_models):
+            _, regs[kk] = self.updater.compute(
+                W[kk], np.zeros_like(W[kk]), 0.0, 1, self.reg_param)
+        live = np.ones(n_models, dtype=bool)
+        updates = np.zeros(n_models, dtype=np.int64)
+        for t in range(1, self.num_iterations + 1):
+            if not live.any():
+                break
+            with tracing.span("dispatch", "gd.step", evals=1,
+                              n_models=n_models):
+                out_dev = compiled(jnp.asarray(W, jnp.float32),
+                                   jnp.asarray(t, jnp.int32))
+                with tracing.span("transfer", "gd.readback") as tsp:
+                    out = jax.device_get(out_dev)
+                    tsp.annotate_bytes(out)
+            count = np.asarray(out["count"], dtype=np.float64)
+            if float(count.max()) <= 0:
+                # empty mini-batch (shared sample mask): no model updates
+                continue
+            loss = np.asarray(out["loss"], dtype=np.float64) / count
+            grad = np.asarray(out["grad"], dtype=np.float64) / count[:, None]
+            for kk in np.nonzero(live)[0]:
+                histories[kk].append(loss[kk] + regs[kk])
+                prev = W[kk].copy()
+                W[kk], regs[kk] = self.updater.compute(
+                    W[kk], grad[kk], self.step_size, t, self.reg_param)
+                updates[kk] += 1
+                if self.convergence_tol > 0 and updates[kk] > 1:
+                    delta = float(np.linalg.norm(W[kk] - prev))
+                    if delta < self.convergence_tol * max(
+                            float(np.linalg.norm(prev)), 1.0):
+                        live[kk] = False
+                        logger.info(
+                            "StackedGradientDescent: model %d converged at "
+                            "iteration %d (%d/%d still live)", kk, t,
+                            int(live.sum()), n_models)
+        return W, histories

@@ -167,6 +167,95 @@ class DistributedLossFunction:
         return float(alpha), loss, np.asarray(g, dtype=np.float64)
 
 
+def stacked_l2_scale(d: int, n_coef: int,
+                     features_std: Optional[np.ndarray] = None,
+                     standardize: bool = True) -> np.ndarray:
+    """Per-coordinate scale for the stacked L2 penalty
+    ``0.5 · reg_k · Σ_j coef_kj² · scale_j`` — the runtime-argument form of
+    :func:`l2_regularization` (feature coords 1 — or 1/std² when
+    ``standardization=false`` computes the penalty in original space —
+    intercept coords 0), so ONE compiled stacked program serves every
+    per-model reg vector instead of forking the program cache per λ."""
+    scale = np.zeros(n_coef)
+    if standardize or features_std is None:
+        scale[:d] = 1.0
+    else:
+        s = np.where(features_std > 0, features_std, 1.0)
+        scale[:d] = 1.0 / (s * s)
+    return scale
+
+
+class StackedDistributedLossFunction:
+    """Model-axis (vmapped) twin of :class:`DistributedLossFunction`.
+
+    Callable ``(coef_stack (K, n_coef)) -> (loss (K,), grad (K, n_coef))``
+    in host float64. ``dataset`` must carry the stacked ``(n_pad, K)`` label
+    matrix as its ``y`` (see ``InstanceDataset.derive``) and ``agg`` the
+    vmapped aggregator twin (``aggregators.stack_scaled_aggregator``), so K
+    independent binomial objectives over ONE shared design matrix evaluate
+    as a single SPMD program — one psum with a leading model axis, never K
+    rendezvous-prone concurrent programs (the PR-2 deadlock).
+
+    The L2 term is carried as runtime data — per-model ``reg`` ``(K,)`` plus
+    the shared per-coordinate ``l2_scale`` from :func:`stacked_l2_scale` —
+    both host-side here and inlined by the stacked chunk program, keeping
+    program-cache identity across reg vectors (CV folds reuse one compile).
+    """
+
+    def __init__(self, dataset: InstanceDataset, agg: Callable,
+                 n_models: int, reg: Optional[np.ndarray] = None,
+                 l2_scale: Optional[np.ndarray] = None,
+                 weight_sum: Optional[float] = None,
+                 extra_args: tuple = ()):
+        base = dataset.tree_aggregate_fn(agg)
+        if extra_args:
+            extra = tuple(extra_args)
+
+            def call(*coef):
+                return base(*extra, *coef)
+
+            call.compiled = base.compiled
+            call.arrays = lambda: base.arrays() + extra
+            self._agg_call = call
+        else:
+            self._agg_call = base
+        self._ctx = dataset.ctx
+        self.n_models = int(n_models)
+        self.reg = (np.zeros(self.n_models) if reg is None
+                    else np.asarray(reg, dtype=np.float64))
+        self.l2_scale = (None if l2_scale is None
+                         else np.asarray(l2_scale, dtype=np.float64))
+        if weight_sum is None:
+            ws = dataset.tree_aggregate_fn(_weight_sum_agg)()
+            weight_sum = float(ws["ws"])
+        self.weight_sum = weight_sum
+        self.n_evals = 0        # batched objective evaluations (each covers
+        self.n_dispatches = 0   # all K models); host->device round trips
+
+    def __call__(self, coef_stack: np.ndarray):
+        self.n_evals += 1
+        self.n_dispatches += 1
+        import jax
+        with tracing.span("dispatch", "loss.eval", evals=1,
+                          n_models=self.n_models):
+            out_dev = self._agg_call(coef_stack)
+            with tracing.span("transfer", "loss.readback") as tsp:
+                out = jax.device_get(out_dev)
+                tsp.annotate_bytes(out)
+        loss = np.asarray(out["loss"], dtype=np.float64) / self.weight_sum
+        grad = np.asarray(out["grad"], dtype=np.float64) / self.weight_sum
+        if self.l2_scale is not None and np.any(self.reg > 0):
+            cs = np.asarray(coef_stack, dtype=np.float64)
+            loss = loss + 0.5 * self.reg * np.sum(
+                cs * cs * self.l2_scale[None, :], axis=1)
+            grad = grad + self.reg[:, None] * cs * self.l2_scale[None, :]
+        if hasattr(self._ctx, "record_step"):
+            # one batched gradient evaluation ≈ one stage over all K models
+            self._ctx.record_step({"loss": float(np.mean(loss)),
+                                   "n_models": self.n_models})
+        return loss, grad
+
+
 _ls_program_cache = collectives.BoundedProgramCache(64)
 
 
@@ -200,8 +289,20 @@ def _build_line_search(compiled, l2_t, c1: float, c2: float, max_evals: int,
     return jax.jit(program)
 
 
+def _select_bcast(mask, a, b):
+    """``jnp.where`` with the mask right-padded to the operand rank — lets
+    one boolean select both scalar state fields and gradient pytree leaves
+    (rank 0/1 unbatched; leading model axis + trailing coord axes when the
+    search runs batched). Ranks are static trace-time metadata."""
+    import jax.numpy as jnp
+    extra = a.ndim - mask.ndim
+    if extra > 0:
+        mask = mask.reshape(mask.shape + (1,) * extra)
+    return jnp.where(mask, a, b)
+
+
 def wolfe_search(phi, g_zero, value0, dg0, init_alpha,
-                 c1: float, c2: float, max_evals: int, cdt):
+                 c1: float, c2: float, max_evals: int, cdt, active=None):
     """Traced strong-Wolfe bracket+zoom (Nocedal-Wright alg 3.5/3.6) as a
     ``lax.while_loop`` state machine — the device-resident twin of the host
     search in ``lbfgs._strong_wolfe``.
@@ -210,25 +311,39 @@ def wolfe_search(phi, g_zero, value0, dg0, init_alpha,
     matching the gradient structure (any sharding — the feature-sharded
     path threads a (beta_sharded, b0_scalar) pair through unchanged).
     Returns ``(alpha, value, grad_pytree, evals)``.
+
+    Batched (model-axis) form: when ``value0``/``dg0``/``init_alpha`` carry a
+    leading ``(K,)`` axis (and ``g_zero`` leaves a leading ``K``), each model
+    runs its OWN bracket+zoom trajectory in lockstep evaluation steps — one
+    batched ``phi`` per step — and models whose search terminates freeze
+    (state selected through, no further effect) instead of forcing the rest
+    to stop. ``active`` (``(K,)`` bool, optional) marks models that must not
+    search at all (already-converged models in a stacked fit): they start in
+    the done phase with zero evals. Per-model ``evals`` counts only live
+    steps, so the batched search's global step count is ``evals.max()``.
     """
     import jax
     import jax.numpy as jnp
 
-    zero = cdt.type(0.0)
+    value0 = jnp.asarray(value0, cdt)
+    zero = jnp.zeros(jnp.shape(value0), cdt)
+    izero = jnp.zeros(jnp.shape(value0), jnp.int32)
+    phase0 = izero if active is None else \
+        jnp.where(active, 0, 2).astype(jnp.int32)
     state = dict(
-        phase=jnp.int32(0),   # 0 bracket, 1 zoom, 2 done
-        evals=jnp.int32(0), bi=jnp.int32(0), zj=jnp.int32(0),
-        alpha_prev=zero, v_prev=value0, d_prev=dg0,
-        alpha_next=init_alpha,
+        phase=phase0,   # 0 bracket, 1 zoom, 2 done
+        evals=izero, bi=izero, zj=izero,
+        alpha_prev=zero, v_prev=value0 + zero, d_prev=dg0 + zero,
+        alpha_next=init_alpha + zero,
         lo=zero, hi=zero,
         v_lo=zero, d_lo=zero,
         v_hi=zero,
-        res_alpha=zero, res_v=value0,
+        res_alpha=zero, res_v=value0 + zero,
         res_g=g_zero,
     )
 
     def cond(s):
-        return s["phase"] < 2
+        return jnp.any(s["phase"] < 2)
 
     def body(s):
         in_bracket = s["phase"] == 0
@@ -284,7 +399,7 @@ def wolfe_search(phi, g_zero, value0, dg0, init_alpha,
         # result: bracket records only on termination; zoom records
         # every eval (the host zoom's running ``best``)
         set_res = jnp.where(in_bracket, b_done | b_exhaust, True)
-        return dict(
+        new = dict(
             phase=phase,
             evals=s["evals"] + 1,
             bi=s["bi"] + in_bracket.astype(jnp.int32),
@@ -299,9 +414,21 @@ def wolfe_search(phi, g_zero, value0, dg0, init_alpha,
             res_alpha=jnp.where(set_res, alpha, s["res_alpha"]),
             res_v=jnp.where(set_res, v, s["res_v"]),
             res_g=jax.tree_util.tree_map(
-                lambda gn, gs: jnp.where(set_res, gn, gs),
+                lambda gn, gs: _select_bcast(set_res, gn, gs),
                 g, s["res_g"]),
         )
+        # per-model freeze: a lane whose search already terminated keeps its
+        # state verbatim (the batched while runs until EVERY lane is done;
+        # without the select its result would keep moving). Unbatched, the
+        # while cond makes `live` trivially true — XLA folds the selects.
+        live = s["phase"] < 2
+        return {
+            key: (jax.tree_util.tree_map(
+                lambda nv, ov: _select_bcast(live, nv, ov),
+                nv_, s[key]) if key == "res_g"
+                else _select_bcast(live, nv_, s[key]))
+            for key, nv_ in new.items()
+        }
 
     final = jax.lax.while_loop(cond, body, state)
     return (final["res_alpha"], final["res_v"], final["res_g"],

@@ -1,13 +1,24 @@
 """Model selection / hyperparameter tuning.
 
 Parity with ref ml/tuning: ParamGridBuilder, CrossValidator.scala:80
-(k-fold, fits folds concurrently via a thread pool sized by ``parallelism``
-— setParallelism:119; same here), TrainValidationSplit.scala.
+(k-fold; ``parallelism`` — setParallelism:119), TrainValidationSplit.scala.
+
+The reference's ``parallelism`` thread pool fanned independent Spark jobs
+across a cluster; here every fit is an SPMD program over ONE shared mesh,
+so a thread pool deadlocks XLA's collective rendezvous (the PR-2 hang,
+now mechanized as graftlint JX007). Instead, ``parallelism > 1`` routes
+grid points through the STACKED fit engine when the param maps differ
+only in vmappable scalars (regParam) and the estimator supports
+``fit_stacked``: all K grid points of one fold train as ONE vmapped SPMD
+program — one compile for the whole grid (the stacked chunk program takes
+the reg vector as runtime data, so every fold reuses it), one psum per
+step carrying K gradients. Heterogeneous maps (structure-changing params,
+elastic net, non-binary labels) fall back to the serial loop. See
+docs/multi-model.md.
 """
 
 from __future__ import annotations
 
-import concurrent.futures as cf
 from itertools import product
 from typing import List, Optional
 
@@ -71,6 +82,57 @@ class _ValidatorParams(HasSeed):
         model = self._estimator.fit(train, pm)
         return self._evaluator.evaluate(model.transform(valid))
 
+    # -- stacked (model-axis) grid evaluation --------------------------------
+    def _stack_plan(self, frame: MLFrame):
+        """``(base_estimator, reg_vector)`` when the whole grid can train as
+        ONE stacked SPMD program per fold: every param map touches the same
+        params, only ``regParam`` (a vmappable scalar) varies, the
+        estimator supports stacked fits in its configured state, and the
+        labels are binary. Anything else returns None — heterogeneous maps
+        fall back to the serial path."""
+        maps = getattr(self, "_param_maps", None)
+        est = getattr(self, "_estimator", None)
+        if (not maps or len(maps) < 2 or est is None
+                or not hasattr(est, "fit_stacked")):
+            return None
+        keys = set(maps[0])
+        if any(set(pm) != keys for pm in maps[1:]):
+            return None
+        reg_param = next((p for p in keys if p.name == "regParam"), None)
+        if reg_param is None:
+            return None
+
+        def differs(a, b):
+            # array-valued params (e.g. coefficient bounds) compare
+            # elementwise; any doubt means "not provably constant" → serial
+            try:
+                return bool(np.any(np.asarray(a != b)))
+            except Exception:
+                return True
+
+        for p in keys:
+            if p is reg_param:
+                continue
+            v0 = maps[0].get(p)
+            if any(differs(pm.get(p), v0) for pm in maps[1:]):
+                return None  # a non-vmappable param varies across the grid
+        base = est.copy(maps[0])
+        if not (hasattr(base, "can_fit_stacked") and base.can_fit_stacked()):
+            return None
+        try:
+            y = np.asarray(frame[base.get("labelCol")])
+        except Exception:
+            return None
+        if not np.isin(y, (0.0, 1.0)).all():
+            return None  # stacked fits are binomial
+        return base, np.array([float(pm.get(reg_param)) for pm in maps])
+
+    def _fit_score_stacked(self, base, reg_vec, train: MLFrame,
+                           valid: MLFrame) -> np.ndarray:
+        models = base.fit_stacked(train, reg_params=reg_vec)
+        return np.array([self._evaluator.evaluate(m.transform(valid))
+                         for m in models])
+
 
 class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
     """(ref CrossValidator.scala:80)."""
@@ -102,23 +164,26 @@ class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
             folds = rng.randint(0, n_folds, frame.n_rows)
         maps = self._param_maps
         metrics = np.zeros(len(maps))
-        jobs = []
-        for f in range(n_folds):
-            train = frame.filter_rows(folds != f)
-            valid = frame.filter_rows(folds == f)
-            for mi, pm in enumerate(maps):
-                jobs.append((mi, pm, train, valid))
         from cycloneml_tpu.mesh import safe_fit_parallelism
-        par = safe_fit_parallelism(self.get("parallelism"))
-        if par > 1:
-            with cf.ThreadPoolExecutor(max_workers=par) as pool:
-                results = list(pool.map(
-                    lambda j: (j[0], self._fit_score_one(j[1], j[2], j[3])), jobs))
+        requested = self.get("parallelism")
+        plan = self._stack_plan(frame) if requested > 1 else None
+        if plan is not None:
+            base, reg_vec = plan
+            safe_fit_parallelism(requested, stacked_width=len(maps))
+            for f in range(n_folds):
+                train = frame.filter_rows(folds != f)
+                valid = frame.filter_rows(folds == f)
+                metrics += self._fit_score_stacked(base, reg_vec,
+                                                   train, valid)
         else:
-            results = [(mi, self._fit_score_one(pm, tr, va))
-                       for mi, pm, tr, va in jobs]
-        for mi, score in results:
-            metrics[mi] += score
+            # serial fallback: SPMD fits stay on this thread (a >1 thread
+            # pool deadlocks the shared mesh — mesh.safe_fit_parallelism)
+            safe_fit_parallelism(requested)
+            for f in range(n_folds):
+                train = frame.filter_rows(folds != f)
+                valid = frame.filter_rows(folds == f)
+                for mi, pm in enumerate(maps):
+                    metrics[mi] += self._fit_score_one(pm, train, valid)
         metrics /= n_folds
         best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
                        else np.argmin(metrics))
@@ -183,14 +248,16 @@ class TrainValidationSplit(Estimator, _ValidatorParams, MLWritable, MLReadable):
         train, valid = frame.filter_rows(mask), frame.filter_rows(~mask)
         maps = self._param_maps
         from cycloneml_tpu.mesh import safe_fit_parallelism
-        par = safe_fit_parallelism(self.get("parallelism"))
-        if par > 1:
-            with cf.ThreadPoolExecutor(max_workers=par) as pool:
-                metrics = list(pool.map(
-                    lambda pm: self._fit_score_one(pm, train, valid), maps))
+        requested = self.get("parallelism")
+        plan = self._stack_plan(frame) if requested > 1 else None
+        if plan is not None:
+            base, reg_vec = plan
+            safe_fit_parallelism(requested, stacked_width=len(maps))
+            metrics = self._fit_score_stacked(base, reg_vec, train, valid)
         else:
-            metrics = [self._fit_score_one(pm, train, valid) for pm in maps]
-        metrics = np.asarray(metrics)
+            safe_fit_parallelism(requested)
+            metrics = np.asarray(
+                [self._fit_score_one(pm, train, valid) for pm in maps])
         best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
                        else np.argmin(metrics))
         best = self._estimator.fit(frame, maps[best_idx])

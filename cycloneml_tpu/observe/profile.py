@@ -26,6 +26,9 @@ class FitProfile:
     the same way ``dispatch_count`` matches ``n_dispatches``.
     ``steady_seconds`` is dispatch time excluding dispatches that paid a
     compile (their wall time is staging, not steady state).
+    ``n_models`` is the model-axis width of the fit's dispatches (stacked
+    fits — ``n_models`` > 1 — amortize every compile in this profile over
+    that many models; see docs/multi-model.md).
     """
 
     job_id: int = 0
@@ -50,6 +53,7 @@ class FitProfile:
     retries: int = 0
     rebuilds: int = 0
     faults_injected: int = 0
+    n_models: int = 1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -103,6 +107,8 @@ class FitProfile:
                 p.dispatch_count += 1
                 p.dispatch_seconds += dur
                 p.eval_count += int(s.attrs.get("evals", 0))
+                p.n_models = max(p.n_models,
+                                 int(s.attrs.get("n_models", 1)))
                 dispatches.append(s)
             elif k == "collective":
                 p.collective_count += 1

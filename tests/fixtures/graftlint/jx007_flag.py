@@ -1,0 +1,49 @@
+"""JX007 flag fixture: thread-pool / thread dispatch of SPMD entry points
+(the OneVsRest(parallelism=4) collective-rendezvous deadlock pattern)."""
+
+import concurrent.futures as cf
+import threading
+
+import jax
+
+
+def fit_one(est, frame):
+    return est.fit(frame)
+
+
+def pool_map_fit(est, frames):
+    with cf.ThreadPoolExecutor(max_workers=4) as pool:
+        return list(pool.map(fit_one, frames))  # JX007
+
+
+def pool_submit_fit(est, frame):
+    pool = cf.ThreadPoolExecutor(2)
+    fut = pool.submit(fit_one, est, frame)  # JX007
+    return fut.result()
+
+
+def pool_lambda_program(ds, agg, coefs):
+    prog = ds.tree_aggregate_fn(agg)
+    with cf.ThreadPoolExecutor() as pool:
+        return list(pool.map(lambda c: prog(c), coefs))  # JX007
+
+
+def thread_target_jit(step, x):
+    prog = jax.jit(step)
+    t = threading.Thread(target=lambda: prog(x))  # JX007
+    t.start()
+    return t
+
+
+class GridSearch:
+    def __init__(self, est, evaluator):
+        self.est = est
+        self.evaluator = evaluator
+
+    def _score(self, pair):
+        model = self.est.fit(pair[0])
+        return self.evaluator.evaluate(model.transform(pair[1]))
+
+    def fan_out(self, pairs):
+        with cf.ThreadPoolExecutor(max_workers=8) as pool:
+            return list(pool.map(self._score, pairs))  # JX007

@@ -1,0 +1,46 @@
+"""JX007 pass fixture: host-tier thread pools, serial SPMD loops, and
+service threads are all fine — only thread-dispatched SPMD entry points
+are the deadlock hazard."""
+
+import concurrent.futures as cf
+import threading
+
+
+def count_rows(part):
+    return len(part)
+
+
+def pool_host_work(parts):
+    # host-tier partition work: the callable never touches SPMD dispatch
+    with cf.ThreadPoolExecutor(max_workers=8) as pool:
+        return list(pool.map(count_rows, parts))
+
+
+def pool_unresolved(f, parts):
+    # function-valued parameter: unresolvable, never flagged
+    with cf.ThreadPoolExecutor() as pool:
+        return list(pool.map(f, parts))
+
+
+def serial_fits(est, frames):
+    # the sanctioned serial fallback: SPMD fits stay on the caller thread
+    return [est.fit(f) for f in frames]
+
+
+class HeartbeatSender:
+    def __init__(self, address):
+        self.address = address
+        self.running = False
+
+    def _send(self):
+        return self.address
+
+    def _loop(self):
+        while self.running:
+            self._send()
+
+    def start(self):
+        self.running = True
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+        return t

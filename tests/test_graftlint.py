@@ -30,7 +30,7 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
 PACKAGE = os.path.join(REPO, "cycloneml_tpu")
 BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
 
-RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006")
+RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007")
 
 
 def marker_lines(path: str, rule: str):
