@@ -31,21 +31,47 @@ REF_DGEMM_MOPS = 2409.7  # BLASBenchmark-results.txt:158-169 (java best)
 
 
 def device_peaks():
-    """(matmul peak flop/s, HBM bytes/s) for the attached device, or
-    (None, None) when the platform has no published figure (CPU test runs).
-    Sources: TPU v5e 197 Tflop/s bf16 / 819 GB/s; v4 275 Tflop/s / 1228 GB/s
-    (public spec sheets, same figures the scaling book uses)."""
+    """(matmul peak flop/s, HBM bytes/s) per device — the roofline table
+    lives in observe/costs.py (one table for bench, FitProfile and docs;
+    None/None on backends with no published figure, e.g. CPU test runs)."""
+    from cycloneml_tpu.observe import costs
+    return costs.backend_peaks()
+
+
+def hardware_meta():
+    """The BENCH json ``hardware`` block: backend, device count, dtype
+    tier, roofline peaks and live-telemetry availability — the denominator
+    context that makes the perf trajectory utilization-denominated."""
     import jax
-    kind = jax.devices()[0].device_kind.lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12, 819e9
-    if "v5p" in kind or "v5" in kind:
-        return 459e12, 2765e9
-    if "v4" in kind:
-        return 275e12, 1228e9
-    if "v6" in kind or "trillium" in kind:
-        return 918e12, 1640e9
-    return None, None
+    from cycloneml_tpu.observe import costs
+    dev = jax.devices()[0]
+    peak_flops, peak_bw = costs.backend_peaks()
+    return {
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "dtype": "float64" if jax.config.jax_enable_x64 else "float32",
+        "peak_flops_per_device": peak_flops,
+        "peak_hbm_bytes_per_s": peak_bw,
+        "memory_stats_available": costs.memory_stats_available(),
+    }
+
+
+def profile_cost_fields(profile) -> dict:
+    """flops / hbm_peak_bytes / achieved_flops for a benchmark's BENCH
+    json block, read from the SAME observe/costs.py rollup the FitProfile
+    carries — no second harvesting path. ``profile`` is a FitProfile dict
+    (or FitProfile); None values mean the backend reported nothing."""
+    if hasattr(profile, "to_dict"):
+        profile = profile.to_dict()
+    profile = profile or {}
+    return {
+        "flops": profile.get("total_flops"),
+        "hbm_peak_bytes": profile.get("hbm_peak_bytes"),
+        "achieved_flops": profile.get("achieved_flops"),
+        "arithmetic_intensity": profile.get("arithmetic_intensity"),
+        "roofline_fraction": profile.get("roofline_fraction"),
+    }
 
 
 def bench_gemm(dim: int = 2048, iters: int = 400) -> float:
@@ -179,6 +205,7 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
         "transfer_s": round(warm_profile.get("transfer_seconds", 0.0), 4),
         "transfer_bytes": warm_profile.get("transfer_bytes", 0),
     }
+    phases.update(profile_cost_fields(warm_profile))
     print(f"info: phase breakdown: warm fit {phases['warm_fit_s']}s "
           f"(compile {phases['compile_s']}s over "
           f"{phases['compile_count']} program(s), program cache "
@@ -280,6 +307,7 @@ def bench_ovr_stacked(n: int | None = None, d: int | None = None,
         "coef_max_abs_diff": float(coef_diff),
         "coef_max_rel_diff": float(coef_rel),
     }
+    out.update(profile_cost_fields(prof))
     print(f"info: OneVsRest n={n} d={d} K={k}: stacked {stacked_s:.2f}s vs "
           f"serialized {serial_s:.2f}s ({speedup:.2f}x), "
           f"{out['models_per_compile']} models/compile "
@@ -292,6 +320,11 @@ def main() -> None:
     err = None
     ceiling_bw = None
     phases = None
+    try:
+        hardware = hardware_meta()
+    except Exception as e:
+        hardware = None
+        print(f"info: hardware meta failed: {e}", file=sys.stderr)
     try:
         (fit_s, its, evals, dispatches, n, d, ceiling_bw,
          phases) = bench_logreg_fit()
@@ -352,6 +385,7 @@ def main() -> None:
             "value": round(mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(mops / REF_DGEMM_MOPS, 2),
+            "hardware": hardware,
             "phases": phases,
             "ovr": ovr,
         }))
@@ -362,6 +396,7 @@ def main() -> None:
             "value": round(gemm_mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
+            "hardware": hardware,
             "ovr": ovr,
         }))
     else:
@@ -371,6 +406,7 @@ def main() -> None:
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
+            "hardware": hardware,
         }))
 
 

@@ -535,6 +535,47 @@ PROMETHEUS_PORT = (
     .int_conf(0)
 )
 
+MEMORY_BUDGET_FRACTION = (
+    ConfigBuilder("cyclone.memory.budgetFraction")
+    .doc("Compile-time memory budget guard: when a program's predicted "
+         "peak HBM (XLA memory_analysis: arguments + outputs + "
+         "temporaries + generated code, per device) exceeds this fraction "
+         "of device memory, a MemoryBudgetExceeded event is posted and "
+         "the chunked L-BFGS paths shrink deviceChunk proportionally "
+         "instead of OOMing. Warn-only by default (see "
+         "cyclone.memory.budgetAction). Scope: the chunked L-BFGS "
+         "programs are guarded whenever this key is set explicitly or "
+         "tracing is enabled; tree_aggregate and fused line-search "
+         "programs are checked as part of the tracing harvest only — "
+         "their untraced dispatch path stays one global read and never "
+         "calls XLA's cost analysis.")
+    .check_value(lambda v: 0 < v <= 1.0, "must be in (0, 1]")
+    .float_conf(0.9)
+)
+
+MEMORY_BUDGET_ACTION = (
+    ConfigBuilder("cyclone.memory.budgetAction")
+    .doc("What an exceeded memory budget does beyond the event + chunk "
+         "degradation: 'warn' (default) never raises; 'raise' throws "
+         "MemoryBudgetError once degradation options are exhausted (the "
+         "chunked L-BFGS guard degrades first and raises only if chunk 1 "
+         "is still over budget; sites with nothing to degrade raise "
+         "before dispatching the oversized program).")
+    .check_value(lambda v: v in ("warn", "raise"),
+                 "must be 'warn' or 'raise'")
+    .str_conf("warn")
+)
+
+MEMORY_DEVICE_BYTES = (
+    ConfigBuilder("cyclone.memory.deviceBytes")
+    .doc("Per-device memory bytes the budget guard divides into. 0 (the "
+         "default) auto-detects: device.memory_stats()['bytes_limit'] "
+         "where the backend reports it (TPU/GPU), total host RAM for "
+         "host-platform devices (CPU).")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(0)
+)
+
 TRACE_ENABLED = (
     ConfigBuilder("cyclone.trace.enabled")
     .doc("Enable step-level tracing (observe/): hierarchical spans over "

@@ -196,6 +196,11 @@ class CycloneContext:
                                     lambda: self.mesh_runtime.n_devices)
         self.metrics.registry.gauge(
             "listenerBus.queued", lambda: self.listener_bus.metrics["queued"])
+        # live device-memory telemetry (HBM gauges where the backend
+        # reports memory_stats; always a 1/0 availability gauge — CPU has
+        # none, see docs/observability.md backend matrix)
+        from cycloneml_tpu.observe import costs as _costs
+        _costs.register_memory_gauges(self.metrics.registry)
         self.metrics.start()
 
         # step-level tracing (observe/): conf or CYCLONE_TRACE env var; the

@@ -30,10 +30,71 @@ from typing import List, Optional
 import numpy as np
 
 from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OptimState
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import costs, tracing
 from cycloneml_tpu.parallel.collectives import BoundedProgramCache
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
 
 _program_cache = BoundedProgramCache(32)
+
+
+def _budget_guarded_chunk(name: str, key, prog, args, chunk: int, ctx,
+                          build):
+    """Compile-time memory budget guard for a chunk program: harvest its
+    predicted peak HBM (XLA memory_analysis via observe/costs.py), post
+    ``MemoryBudgetExceeded`` when it exceeds ``cyclone.memory.budgetFraction``
+    × device memory, and degrade to a smaller chunk instead of OOMing.
+
+    Much of the footprint is chunk-INDEPENDENT (data arrays, coefficients,
+    curvature history), so a proportional guess is only a starting point:
+    each candidate is rebuilt via ``build(chunk)`` and RE-ANALYZED, and the
+    loop caps every guess at half the previous chunk so it makes progress
+    even when shrinking barely helps, terminating at chunk 1 (per-iteration
+    dispatches — warn-only proceeds there even if still over budget; there
+    is no smaller program to degrade to). Chunk size never changes the
+    trajectory (chunk-size-invariance tests), only dispatch granularity.
+
+    Returns ``(chunk, key, prog, fresh)`` — unchanged inputs when the
+    guard is disarmed, the backend reports nothing, or the budget holds.
+    """
+    fresh = None
+    conf = getattr(ctx, "conf", None)
+    if conf is None or not costs.guard_armed(conf):
+        return chunk, key, prog, fresh
+    bus = getattr(ctx, "listener_bus", None)
+    pid = costs.ensure(name, key, prog, args)
+    # degradation comes FIRST even under budgetAction=raise: raising is
+    # the terminal escalation once no smaller chunk remains, not a veto
+    # on the degradation the guard exists to perform
+    verdict = costs.check_budget(pid, conf=conf, bus=bus, allow_raise=False)
+    while verdict is not None and verdict.exceeded and chunk > 1:
+        new_chunk = min(costs.select_chunk(chunk, verdict.predicted_bytes,
+                                           verdict.budget_bytes),
+                        max(1, chunk // 2))
+        logger.warning(
+            "%s: predicted peak HBM %d B/device over budget %d B — "
+            "degrading deviceChunk %d -> %d",
+            name, verdict.predicted_bytes, verdict.budget_bytes, chunk,
+            new_chunk)
+        chunk = new_chunk
+        key, prog, fresh = build(chunk)
+        pid = costs.ensure(name, key, prog, args)
+        verdict = costs.check_budget(pid, conf=conf, bus=bus,
+                                     allow_raise=False)
+    if verdict is not None and verdict.exceeded:
+        if verdict.action == "raise":
+            raise costs.MemoryBudgetError(
+                f"{name}: still {verdict.predicted_bytes} bytes/device over "
+                f"the {verdict.budget_bytes}-byte budget at deviceChunk "
+                f"{chunk} — no smaller program to degrade to "
+                f"(cyclone.memory.budgetAction=raise)")
+        logger.warning(
+            "%s: still %d B/device over the %d B budget at deviceChunk %d — "
+            "proceeding (warn-only); the footprint is dominated by "
+            "chunk-independent state", name, verdict.predicted_bytes,
+            verdict.budget_bytes, chunk)
+    return chunk, key, prog, fresh
 
 
 def _build_chunk(compiled, l2_t, m: int, K: int, c1: float, c2: float,
@@ -190,15 +251,21 @@ class DeviceLBFGS(LBFGS):
             raise ValueError(
                 "DeviceLBFGS needs a regularizer with a traceable (jnp) "
                 "twin; use the host LBFGS otherwise")
-        key = ("lbfgs_chunk", f._agg_call.compiled, l2_t, self.m, self.chunk,
-               float(self.c1), float(self.c2), int(self.max_ls), cdt.str)
-        prog = _program_cache.get(key)
-        fresh = prog is None  # first dispatch below pays trace + compile
-        if fresh:
-            prog = _build_chunk(f._agg_call.compiled, l2_t, self.m,
-                                self.chunk, self.c1, self.c2, self.max_ls,
-                                cdt)
-            _program_cache.put(key, prog)
+        chunk = self.chunk
+        self.effective_chunk = chunk
+
+        def build(k):
+            key = ("lbfgs_chunk", f._agg_call.compiled, l2_t, self.m, k,
+                   float(self.c1), float(self.c2), int(self.max_ls), cdt.str)
+            prog = _program_cache.get(key)
+            fresh = prog is None  # first dispatch pays trace + compile
+            if fresh:
+                prog = _build_chunk(f._agg_call.compiled, l2_t, self.m,
+                                    k, self.c1, self.c2, self.max_ls, cdt)
+                _program_cache.put(key, prog)
+            return key, prog, fresh
+
+        key, prog, fresh = build(chunk)
 
         if resume is not None:
             from cycloneml_tpu.ml.optim.lbfgs import _reopen
@@ -236,6 +303,8 @@ class DeviceLBFGS(LBFGS):
 
         S_d, Y_d = jnp.asarray(S), jnp.asarray(Y)
         k_d = jnp.int32(k_hist)
+        guarded = False
+        pid = None
         while True:
             # big state (coef/S/Y/grad) stays ON DEVICE between chunks —
             # only scalars and the per-iteration loss vector come back per
@@ -247,6 +316,16 @@ class DeviceLBFGS(LBFGS):
                     cdt.type(self.tol), cdt.type(self.grad_tol),
                     np.int32(max(self.max_iter - base_iter, 0)),
                     np.bool_(need_init))
+            if not guarded:
+                # args are chunk-size-independent, so a degraded program
+                # dispatches the same operands — only K shrinks
+                guarded = True
+                chunk, key, prog, new_fresh = _budget_guarded_chunk(
+                    "lbfgs.chunk", key, prog, args, chunk,
+                    getattr(f, "_ctx", None), build)
+                if new_fresh is not None:
+                    fresh = new_fresh
+                    self.effective_chunk = chunk
             with tracing.span("dispatch", "lbfgs.chunk") as dsp:
                 if fresh:
                     with tracing.span("compile", "lbfgs.chunk"):
@@ -262,6 +341,12 @@ class DeviceLBFGS(LBFGS):
                     tsp.annotate_bytes(
                         (f_h, losses, it, evals, code, k_h, f0_h))
             dsp.annotate(evals=int(evals))
+            tr = tracing.active()
+            if tr is not None:
+                if pid is None:
+                    pid = costs.ensure("lbfgs.chunk", key, prog, args)
+                dsp.annotate(program=pid)
+                costs.note_execution(tr, pid)
             coef = coef_d
             first = False
             f.n_evals += int(evals)
@@ -519,16 +604,23 @@ class StackedDeviceLBFGS:
                 f"x0 stacks {K} models but the loss carries {f.n_models}")
         arrays = f._agg_call.arrays()
         cdt = np.dtype(arrays[2].dtype)  # w — the data-tier dtype
-        key = ("stacked_lbfgs_chunk", f._agg_call.compiled, self.m,
-               self.chunk, float(self.c1), float(self.c2), int(self.max_ls),
-               cdt.str)
-        prog = _program_cache.get(key)
-        fresh = prog is None
-        if fresh:
-            prog = _build_stacked_chunk(f._agg_call.compiled, self.m,
-                                        self.chunk, self.c1, self.c2,
-                                        self.max_ls, cdt)
-            _program_cache.put(key, prog)
+        chunk = self.chunk
+        self.effective_chunk = chunk
+
+        def build(kc):
+            key = ("stacked_lbfgs_chunk", f._agg_call.compiled, self.m,
+                   kc, float(self.c1), float(self.c2), int(self.max_ls),
+                   cdt.str)
+            prog = _program_cache.get(key)
+            fresh = prog is None
+            if fresh:
+                prog = _build_stacked_chunk(f._agg_call.compiled, self.m,
+                                            kc, self.c1, self.c2,
+                                            self.max_ls, cdt)
+                _program_cache.put(key, prog)
+            return key, prog, fresh
+
+        key, prog, fresh = build(chunk)
 
         coef = jnp.asarray(x0.astype(cdt))
         S_d = jnp.zeros((K, self.m, n), cdt)
@@ -545,6 +637,8 @@ class StackedDeviceLBFGS:
         evals_total = np.zeros(K, dtype=np.int64)
         histories: List[List[float]] = [[] for _ in range(K)]
         code_h = np.zeros(K, dtype=np.int64)
+        guarded = False
+        pid = None
         while True:
             args = (*arrays, coef, S_d, Y_d, k_d, f_d, g_d,
                     np.bool_(first), cdt.type(f.weight_sum), reg_d, l2s_d,
@@ -552,6 +646,14 @@ class StackedDeviceLBFGS:
                     np.int32(max(self.max_iter - total_iter, 0)),
                     np.bool_(need_init),
                     code_h.astype(np.int32))
+            if not guarded:
+                guarded = True
+                chunk, key, prog, new_fresh = _budget_guarded_chunk(
+                    "lbfgs.stacked_chunk", key, prog, args, chunk,
+                    getattr(f, "_ctx", None), build)
+                if new_fresh is not None:
+                    fresh = new_fresh
+                    self.effective_chunk = chunk
             with tracing.span("dispatch", "lbfgs.stacked_chunk",
                               n_models=K) as dsp:
                 if fresh:
@@ -569,6 +671,13 @@ class StackedDeviceLBFGS:
                     tsp.annotate_bytes(
                         (losses, steps, iters, ev_pm, ev_g, code_h, f0_h))
             dsp.annotate(evals=int(ev_g))
+            tr = tracing.active()
+            if tr is not None:
+                if pid is None:
+                    pid = costs.ensure("lbfgs.stacked_chunk", key, prog,
+                                       args)
+                dsp.annotate(program=pid)
+                costs.note_execution(tr, pid)
             f.n_evals += int(ev_g)
             f.n_dispatches += 1
             if need_init:

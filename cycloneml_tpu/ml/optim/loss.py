@@ -148,6 +148,17 @@ class DistributedLossFunction:
                 cdt.type(value), cdt.type(dg0),
                 cdt.type(init_alpha),
                 cdt.type(self.weight_sum))
+        pid = None
+        tr = tracing.active()
+        if tr is not None:
+            # cost harvest BEFORE the dispatch (registry-cached once per
+            # program identity): a raise-mode budget guard must fire before
+            # the oversized program executes, and the AOT analyze must not
+            # land inside the dispatch/compile spans
+            from cycloneml_tpu.observe import costs
+            pid = costs.ensure("lbfgs.line_search", key, fn, args)
+            if fresh:
+                costs.check_budget(pid)
         with tracing.span("dispatch", "lbfgs.line_search") as dsp:
             if fresh:
                 with tracing.span("compile", "lbfgs.line_search"):
@@ -159,6 +170,10 @@ class DistributedLossFunction:
                 tsp.annotate_bytes(out)
         alpha, v, g, evals = out
         dsp.annotate(evals=int(evals))
+        if tr is not None:
+            from cycloneml_tpu.observe import costs
+            dsp.annotate(program=pid)
+            costs.note_execution(tr, pid)
         self.n_evals += int(evals)
         self.n_dispatches += 1
         loss = float(v)

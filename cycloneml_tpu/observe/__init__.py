@@ -6,7 +6,8 @@ touches jax, so the analysis tooling and pure-host paths can import it
 freely.
 """
 
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.observe.costs import ProgramCost
 from cycloneml_tpu.observe.export import (chrome_trace, export_chrome_trace,
                                           span_kinds, validate_chrome_trace)
 from cycloneml_tpu.observe.profile import FitProfile
@@ -15,7 +16,7 @@ from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
                                            instant, span)
 
 __all__ = [
-    "tracing", "Span", "Tracer", "FitProfile",
+    "tracing", "costs", "Span", "Tracer", "FitProfile", "ProgramCost",
     "enable", "disable", "active", "span", "instant", "current_span_id",
     "chrome_trace", "export_chrome_trace", "validate_chrome_trace",
     "span_kinds",

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Union
 REQUIRED_TOP = "traceEvents"
 DURATION_PH = "X"
 INSTANT_PH = "i"
+COUNTER_PH = "C"
 METADATA_PH = "M"
 
 
@@ -38,7 +39,15 @@ def chrome_trace(tracer) -> Dict[str, Any]:
         if s.parent_id:
             args["parent_id"] = s.parent_id
         args.update(s.attrs)
-        if s.kind == "instant":
+        if s.kind == "counter":
+            # Perfetto renders "C" events as a per-name counter track —
+            # the HBM / cumulative-FLOPs timeline next to the spans
+            events.append({
+                "name": s.name, "cat": "counter", "ph": COUNTER_PH,
+                "ts": ts_us, "pid": pid, "tid": s.tid,
+                "args": {"value": s.attrs.get("value", 0)},
+            })
+        elif s.kind == "instant":
             events.append({
                 "name": s.name, "cat": "instant", "ph": INSTANT_PH,
                 "ts": ts_us, "pid": pid, "tid": s.tid, "s": "t",
@@ -71,8 +80,9 @@ def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
 
     Checks: top-level ``traceEvents`` list; every event has ``name``/
     ``ph``/``pid``; duration events carry numeric ``ts`` and ``dur >= 0``;
-    instant events carry numeric ``ts``; ``args`` (when present) is an
-    object.
+    instant events carry numeric ``ts``; counter (``"C"``) events carry a
+    numeric ``ts`` and an args object of numeric series values; ``args``
+    (when present) is an object.
     """
     if isinstance(obj_or_path, str):
         with open(obj_or_path, encoding="utf-8") as fh:
@@ -105,6 +115,13 @@ def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 errors.append(f"{where}: X event needs numeric 'dur' >= 0")
+        elif ph == COUNTER_PH:
+            cargs = ev.get("args")
+            if not isinstance(cargs, dict) or not cargs or not all(
+                    isinstance(v, (int, float)) for v in cargs.values()):
+                errors.append(
+                    f"{where}: C event needs an args object of numeric "
+                    f"series values")
         elif ph != INSTANT_PH:
             errors.append(f"{where}: unexpected ph {ph!r}")
         if "args" in ev and not isinstance(ev["args"], dict):

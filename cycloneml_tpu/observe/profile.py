@@ -29,6 +29,25 @@ class FitProfile:
     ``n_models`` is the model-axis width of the fit's dispatches (stacked
     fits — ``n_models`` > 1 — amortize every compile in this profile over
     that many models; see docs/multi-model.md).
+
+    The cost block comes from XLA's own accounting (``observe.costs``;
+    docs/observability.md has the units + backend availability matrix):
+    ``programs`` holds one entry per program-cache identity the fit
+    dispatched (executions × what XLA reports per execution);
+    ``total_flops`` / ``total_bytes_accessed`` are the mesh-wide totals;
+    ``hbm_peak_bytes`` is the largest per-device footprint (arguments +
+    outputs + temporaries + generated code) of any dispatched program —
+    the OOM-relevant number; ``achieved_flops`` is the steady-state
+    executions' FLOPs over those same executions' dispatch time (staging
+    executions excluded from both sides); ``arithmetic_intensity`` is
+    FLOPs per byte
+    accessed; ``roofline_fraction`` scores achieved FLOP/s against the
+    per-backend roofline ``min(peak_flops, peak_bw × intensity)`` (Williams
+    et al. 2009). Every cost field is ``None`` — explicitly "unavailable" —
+    when the backend (or an untraced run) cannot report it;
+    ``cost_availability`` summarizes (``full`` / ``flops_only`` /
+    ``unavailable``) and ``memory_stats_available`` records whether live
+    ``device.memory_stats()`` telemetry existed.
     """
 
     job_id: int = 0
@@ -54,6 +73,21 @@ class FitProfile:
     rebuilds: int = 0
     faults_injected: int = 0
     n_models: int = 1
+    # -- XLA cost & HBM accounting (None = unavailable on this backend) --
+    total_flops: Optional[float] = None
+    total_bytes_accessed: Optional[float] = None
+    hbm_peak_bytes: Optional[int] = None
+    hbm_argument_bytes: Optional[int] = None
+    hbm_output_bytes: Optional[int] = None
+    hbm_temp_bytes: Optional[int] = None
+    achieved_flops: Optional[float] = None
+    arithmetic_intensity: Optional[float] = None
+    roofline_fraction: Optional[float] = None
+    n_devices: int = 0
+    cost_availability: str = "unavailable"
+    memory_stats_available: bool = False
+    programs: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -65,10 +99,14 @@ class FitProfile:
 
     @classmethod
     def from_spans(cls, spans: Sequence[Any],
-                   root_id: Optional[str] = None) -> "FitProfile":
+                   root_id: Optional[str] = None,
+                   cost_lookup: Optional[Any] = None) -> "FitProfile":
         """Fold spans into a profile. With ``root_id``, only spans whose
         parent chain reaches that span (plus the root itself) count — the
-        per-job scoping ``run_job`` uses."""
+        per-job scoping ``run_job`` uses. ``cost_lookup`` maps a program id
+        (the ``program`` attr harvest puts on dispatch/collective spans) to
+        its registered cost entry; defaults to the process-global
+        ``observe.costs`` registry."""
         if root_id:
             parent = {s.span_id: s.parent_id for s in spans}
             selected: List[Any] = []
@@ -148,7 +186,91 @@ class FitProfile:
                 sid = parents.get(sid, "")
         p.steady_seconds = sum(
             s.duration_s for s in dispatches if s.span_id not in staging)
+        p._fold_costs(spans, cost_lookup, staging)
         return p
+
+    def _fold_costs(self, spans: Sequence[Any], cost_lookup,
+                    staging) -> None:
+        """Join the spans' per-program execution counts onto the harvested
+        XLA cost registry and derive the roofline fields.
+
+        ``achieved_flops`` keeps numerator and denominator consistent:
+        steady-state executions' FLOPs over those same spans' wall time.
+        Staging executions (a compile in the span's subtree) are excluded
+        from BOTH sides — counting their flops against steady time would
+        inflate the rate ~2x on short fits — and the denominator is the
+        cost-carrying spans' own durations, so programs dispatched outside
+        any optimizer dispatch span (summary/weight-sum aggregations)
+        cannot contribute flops without contributing time."""
+        execs: Dict[str, int] = {}
+        steady_execs: Dict[str, int] = {}
+        steady_cost_seconds = all_cost_seconds = 0.0
+        for s in spans:
+            if s.kind in ("dispatch", "collective"):
+                pid = s.attrs.get("program")
+                if pid:
+                    execs[pid] = execs.get(pid, 0) + 1
+                    all_cost_seconds += s.duration_s
+                    if s.span_id not in staging:
+                        steady_execs[pid] = steady_execs.get(pid, 0) + 1
+                        steady_cost_seconds += s.duration_s
+        if not execs:
+            return
+        from cycloneml_tpu.observe import costs as _costs
+        if cost_lookup is None:
+            cost_lookup = _costs.lookup
+        self.memory_stats_available = _costs.memory_stats_available()
+        flops_total = bytes_total = steady_flops = 0.0
+        any_flops = any_mem = False
+        for pid, n in sorted(execs.items()):
+            entry = cost_lookup(pid)
+            if entry is None:
+                self.programs[pid] = {"executions": n,
+                                      "cost_available": False}
+                continue
+            entry = dict(entry)
+            entry["executions"] = n
+            self.programs[pid] = entry
+            self.n_devices = max(self.n_devices,
+                                 int(entry.get("n_devices") or 0))
+            if entry.get("flops_total"):
+                any_flops = True
+                flops_total += entry["flops_total"] * n
+                steady_flops += entry["flops_total"] * steady_execs.get(pid, 0)
+            if entry.get("bytes_accessed_total"):
+                bytes_total += entry["bytes_accessed_total"] * n
+            peak = entry.get("peak_bytes")
+            if peak is not None and (self.hbm_peak_bytes is None
+                                     or peak > self.hbm_peak_bytes):
+                any_mem = True
+                self.hbm_peak_bytes = int(peak)
+                self.hbm_argument_bytes = entry.get("argument_bytes")
+                self.hbm_output_bytes = entry.get("output_bytes")
+                self.hbm_temp_bytes = entry.get("temp_bytes")
+        if any_flops:
+            self.total_flops = flops_total
+            if bytes_total:
+                self.total_bytes_accessed = bytes_total
+                self.arithmetic_intensity = flops_total / bytes_total
+            # steady executions over steady cost-span time; a fit whose
+            # every cost-carrying dispatch paid a compile falls back to
+            # total work over total cost-span time (still consistent)
+            if steady_flops and steady_cost_seconds > 0:
+                self.achieved_flops = steady_flops / steady_cost_seconds
+            elif all_cost_seconds > 0:
+                self.achieved_flops = flops_total / all_cost_seconds
+            peak_flops, peak_bw = _costs.backend_peaks()
+            if (self.achieved_flops and peak_flops and self.n_devices
+                    and self.arithmetic_intensity):
+                ceiling = min(peak_flops,
+                              (peak_bw or peak_flops)
+                              * self.arithmetic_intensity)
+                self.roofline_fraction = (
+                    self.achieved_flops / self.n_devices / ceiling)
+        self.cost_availability = (
+            "full" if any_flops and any_mem
+            else "flops_only" if any_flops
+            else "unavailable")
 
     def phase_summary(self) -> Dict[str, float]:
         """The compile-vs-steady-state breakdown bench.py prints."""

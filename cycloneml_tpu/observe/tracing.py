@@ -31,6 +31,9 @@ kind           opened around
 ``rebuild``    a ``MeshSupervisor.recover`` mesh rebuild
 ``instant``    zero-duration annotations: injected faults, step retries,
                program-cache hits/misses
+``counter``    a Perfetto counter sample (Chrome-trace ``"C"`` phase):
+               ``hbm.bytes_in_use`` / ``hbm.predicted_peak_bytes`` /
+               ``flops.cumulative`` timelines from ``observe.costs``
 =============  ==============================================================
 
 Off by default with near-zero disabled cost: every instrumentation site
@@ -56,7 +59,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "Span", "Tracer", "enable", "disable", "active", "span", "instant",
-    "current_span_id", "nbytes",
+    "counter", "current_span_id", "nbytes",
 ]
 
 
@@ -205,6 +208,15 @@ class Tracer:
         s.t0 = s.t1 = time.perf_counter()
         self._record(s)
 
+    def counter(self, name: str, value: float) -> None:
+        """One sample of a Perfetto counter track (exported as a
+        Chrome-trace ``"C"``-phase event): device-memory / cumulative-FLOP
+        timelines render as graphs next to the spans."""
+        s = Span(f"s{next(self._ids)}", "", "counter", name,
+                 threading.get_ident(), {"value": float(value)})
+        s.t0 = s.t1 = time.perf_counter()
+        self._record(s)
+
     def _record(self, s: Span) -> None:
         with self._lock:
             if len(self._spans) < self.max_spans:
@@ -216,7 +228,10 @@ class Tracer:
             try:
                 if s.kind == "instant":
                     reg.counter(f"trace.{s.name}").inc()
-                else:
+                elif s.kind != "counter":
+                    # counter samples have live gauges on the metrics side
+                    # already (costs.register_memory_gauges) — a zero-
+                    # duration timer entry would only skew span.* stats
                     reg.timer(f"span.{s.kind}").update(s.duration_s)
             except Exception:
                 pass  # a broken metrics bridge must not kill the step
@@ -292,6 +307,12 @@ def instant(name: str, **attrs) -> None:
     t = _tracer
     if t is not None:
         t.instant(name, **attrs)
+
+
+def counter(name: str, value: float) -> None:
+    t = _tracer
+    if t is not None:
+        t.counter(name, value)
 
 
 def current_span_id() -> str:

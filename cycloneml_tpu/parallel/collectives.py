@@ -22,7 +22,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence
 
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import costs, tracing
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -100,20 +100,25 @@ class BoundedProgramCache:
         return len(self._d)
 
 
-def _instrument_dispatch(jitted, name: str = "tree_aggregate"):
+def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
     """Route every dispatch of an aggregation program through the chaos
     harness's ``collectives.step`` injection point (faults.py) and, when
     tracing is enabled, open a ``collective`` span per step (a ``compile``
     span nests inside the first dispatch — the call that pays trace + XLA
-    compilation). When neither is installed the cost is two global reads
-    per step; the raw program stays reachable as ``__wrapped__`` for
-    callers that inline it into larger jitted programs (e.g. the
-    device-resident line search)."""
+    compilation) plus the XLA cost harvest (observe/costs.py): the first
+    traced dispatch registers the program's FLOPs/bytes/peak-HBM under its
+    program-cache identity (``key``), checks the memory budget, and every
+    traced dispatch carries a ``program`` attr so FitProfile can join
+    executions onto costs. When neither faults nor tracing is installed
+    the cost is two global reads per step; the raw program stays reachable
+    as ``__wrapped__`` for callers that inline it into larger jitted
+    programs (e.g. the device-resident line search)."""
     import jax
 
     from cycloneml_tpu.parallel import faults
 
     first = [True]
+    pid_ref = [None]
 
     @functools.wraps(jitted)
     def dispatch(*args, **kwargs):
@@ -133,11 +138,21 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate"):
         tr = tracing.active()
         if tr is None:
             return jitted(*args, **kwargs)
-        with tr.span("collective", name):
+        if pid_ref[0] is None:
+            # harvest BEFORE the first dispatch and OUTSIDE the spans: the
+            # AOT lower+compile feeding cost_analysis must not inflate
+            # compile_seconds, and a budgetAction=raise guard must fire
+            # before the oversized program ever executes
+            pid_ref[0] = costs.ensure(name, key, jitted, args)
+            costs.check_budget(pid_ref[0])
+        with tr.span("collective", name, program=pid_ref[0]):
             if was_first:
                 with tr.span("compile", name):
-                    return jitted(*args, **kwargs)
-            return jitted(*args, **kwargs)
+                    out = jitted(*args, **kwargs)
+            else:
+                out = jitted(*args, **kwargs)
+        costs.note_execution(tr, pid_ref[0])
+        return out
 
     dispatch.__wrapped__ = jitted
     return dispatch
@@ -148,9 +163,12 @@ _program_cache = BoundedProgramCache(256)
 
 
 def clear_program_cache() -> None:
-    """Drop ALL cached programs everywhere (mesh teardown/rebuild)."""
+    """Drop ALL cached programs everywhere (mesh teardown/rebuild). The
+    cost registry goes with them: its ids embed the old mesh/program
+    identities, so every entry is stale once the programs rebuild."""
     for cache in BoundedProgramCache._instances:
         cache.clear()
+    costs.clear()
 
 
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
@@ -204,7 +222,7 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         out_specs = (P(), row_spec) if with_state else P()
         return shard_map_compat(local, mesh, in_specs, out_specs)(*all_args)
 
-    jitted = _instrument_dispatch(jax.jit(sharded))
+    jitted = _instrument_dispatch(jax.jit(sharded), key=key)
     if key is not None:
         _program_cache.put(key, jitted)
     return jitted

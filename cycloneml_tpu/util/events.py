@@ -21,6 +21,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
 
 @dataclass
 class CycloneEvent:
@@ -90,6 +94,21 @@ class FitProfileCompleted(CycloneEvent):
 
     job_id: int = 0
     profile: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MemoryBudgetExceeded(CycloneEvent):
+    """The compile-time budget guard (observe/costs.py) predicted a
+    program's peak HBM over ``cyclone.memory.budgetFraction`` × device
+    memory. Warn-only by default; the chunked L-BFGS paths respond by
+    shrinking ``deviceChunk``. All byte fields are per device."""
+
+    program: str = ""
+    predicted_bytes: int = 0
+    budget_bytes: int = 0
+    limit_bytes: int = 0
+    fraction: float = 0.0
+    action: str = "warn"
 
 
 @dataclass
@@ -203,11 +222,25 @@ class EventJournal:
 
     @staticmethod
     def replay(path: str) -> List[Dict[str, Any]]:
-        """Read a journal back (history-server analog, ref: FsHistoryProvider.scala:84)."""
+        """Read a journal back (history-server analog, ref:
+        FsHistoryProvider.scala:84).
+
+        Corrupt lines are skipped with a warning instead of raising: a
+        process killed mid-``write`` leaves a truncated trailing line (the
+        torn-write artifact the chaos harness produces), and one bad line
+        must not make the whole application's history unloadable — the
+        reference's replay likewise tolerates a half-written tail
+        (ReplayListenerBus maybeTruncated)."""
         events = []
         with open(path, encoding="utf-8") as fh:
-            for line in fh:
+            for lineno, line in enumerate(fh, start=1):
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    logger.warning(
+                        "skipping corrupt journal line %d in %s "
+                        "(torn write at crash time?)", lineno, path)
         return events

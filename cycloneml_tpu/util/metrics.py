@@ -41,10 +41,17 @@ class Gauge:
     def __init__(self, fn: Callable[[], float]):
         self._fn = fn
 
+    def poll(self) -> float:
+        """Raw read — raises whatever the callback raises. The registry
+        scrape catches and SKIPS a poisoned gauge (a device whose
+        memory_stats endpoint starts failing must not turn every sink
+        report and Prometheus scrape into NaN rows, let alone kill them)."""
+        return float(self._fn())
+
     @property
     def value(self) -> float:
         try:
-            return float(self._fn())
+            return self.poll()
         except Exception:
             return float("nan")
 
@@ -158,7 +165,9 @@ class MetricsRegistry:
         return out
 
     def values(self) -> Dict[str, float]:
-        """Flatten to name → scalar(s) for sinks."""
+        """Flatten to name → scalar(s) for sinks. A gauge whose callback
+        raises is skipped (not reported as NaN, not fatal): one poisoned
+        gauge must not kill the whole scrape and every ``Sink.report``."""
         out: Dict[str, float] = {}
         with self._lock:
             items = list(self._metrics.items())
@@ -166,7 +175,10 @@ class MetricsRegistry:
             if isinstance(m, Counter):
                 out[name] = m.count
             elif isinstance(m, Gauge):
-                out[name] = m.value
+                try:
+                    out[name] = m.poll()
+                except Exception:
+                    continue
             elif isinstance(m, Histogram):
                 for k, v in m.snapshot().items():
                     out[f"{name}.{k}"] = v

@@ -31,6 +31,8 @@ class AppStatusStore:
         # job_id -> FitProfile dict (tracing's per-fit rollup; empty when
         # tracing was off for the run)
         self.profiles: Dict[int, Dict[str, Any]] = {}
+        # MemoryBudgetExceeded events (observe/costs.py budget guard)
+        self.memory_warnings: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -122,6 +124,15 @@ class AppStatusListener:
         elif kind == "FitProfileCompleted":
             with s._lock:
                 s.profiles[e.get("job_id", 0)] = dict(e.get("profile", {}))
+        elif kind == "MemoryBudgetExceeded":
+            s.memory_warnings.append({
+                "program": e.get("program"),
+                "predictedBytes": e.get("predicted_bytes"),
+                "budgetBytes": e.get("budget_bytes"),
+                "limitBytes": e.get("limit_bytes"),
+                "fraction": e.get("fraction"),
+                "action": e.get("action"),
+                "time": e.get("time_ms")})
         elif kind == "CheckpointWritten":
             s.checkpoints.append({"path": e.get("path"),
                                   "step": e.get("step"),
@@ -165,7 +176,8 @@ def api_v1(store: AppStatusStore, route: str,
            job_id: Optional[int] = None) -> Any:
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
-    'jobs/<id>/profile', 'checkpoints', 'workers/failures'."""
+    'jobs/<id>/profile', 'checkpoints', 'workers/failures',
+    'memory/warnings'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -180,4 +192,6 @@ def api_v1(store: AppStatusStore, route: str,
         return list(store.checkpoints)
     if route == "workers/failures":
         return list(store.worker_failures)
+    if route == "memory/warnings":
+        return list(store.memory_warnings)
     raise KeyError(f"unknown route {route!r}")

@@ -39,13 +39,24 @@ _PAGE = """<!DOCTYPE html>
 <h2>Worker failures</h2><div id="fails" class="muted">none</div>
 <script>
 async function j(r) { return (await fetch('/api/v1/' + r)).json(); }
+function esc(v) {
+  // values land in innerHTML; program-cache identities legitimately
+  // contain '<' (numpy dtype strings like '<f8') and must not open tags
+  return String(v).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+                  .replace(/>/g, '&gt;');
+}
 function table(rows, cols) {
   if (!rows.length) return '<span class="muted">none</span>';
-  let h = '<table><tr>' + cols.map(c => '<th>' + c + '</th>').join('') +
+  let h = '<table><tr>' + cols.map(c => '<th>' + esc(c) + '</th>').join('') +
           '</tr>';
   for (const r of rows)
-    h += '<tr>' + cols.map(c => '<td>' + (r[c] ?? '') + '</td>').join('') +
-         '</tr>';
+    h += '<tr>' + cols.map(c => {
+      let v = r[c];
+      // nested objects (the profile's per-program cost entries) render as
+      // JSON rather than "[object Object]"
+      if (v !== null && typeof v === 'object') v = JSON.stringify(v);
+      return '<td>' + (v == null ? '' : esc(v)) + '</td>';
+    }).join('') + '</tr>';
   return h + '</table>';
 }
 async function refresh() {
@@ -148,6 +159,8 @@ class StatusWebUI:
                 return api_v1(self.store, "jobs/<id>/profile", job_id)
         if parts == ["workers", "failures"]:
             return api_v1(self.store, "workers/failures")
+        if parts == ["memory", "warnings"]:
+            return api_v1(self.store, "memory/warnings")
         raise KeyError(route)
 
     @property

@@ -7,9 +7,16 @@ The executable form of the observability acceptance contract
    Chrome-trace JSON that passes ``validate_chrome_trace`` (loads in
    Perfetto),
 2. the trace contains >= 4 distinct span kinds out of
-   {compile, dispatch, collective, transfer, checkpoint, job},
+   {compile, dispatch, collective, transfer, checkpoint, job}, plus
+   counter ("C"-phase) events — the HBM/FLOPs timeline tracks,
 3. the fit's ``FitProfile`` dispatch/eval counts agree with the ledger the
-   model summary (and bench.py) already reports.
+   model summary (and bench.py) already reports,
+4. the profile carries the XLA cost rollup: non-null total FLOPs,
+   per-program cost entries keyed by program-cache identity, and memory
+   fields either populated or explicitly marked unavailable
+   (``cost_availability`` / ``memory_stats_available`` record the
+   backend matrix — CPU has cost+memory analysis but no live
+   ``memory_stats``).
 
 Run via ``make obs-demo``. Exits non-zero on any violation.
 """
@@ -100,8 +107,50 @@ def main() -> int:
         if profile.checkpoint_saves < 1:
             print("FAIL: no checkpoint spans recorded", file=sys.stderr)
             return 1
-        print("OK: trace validates, >=4 span kinds, profile counts agree "
-              "with the model summary")
+
+        # -- XLA cost & HBM accounting acceptance --
+        if kinds.get("counter", 0) < 1:
+            print("FAIL: no counter ('C'-phase) events in the trace",
+                  file=sys.stderr)
+            return 1
+        print(f"cost:       availability={profile.cost_availability} "
+              f"flops={profile.total_flops} "
+              f"hbm_peak_bytes={profile.hbm_peak_bytes} "
+              f"achieved_flops={profile.achieved_flops} "
+              f"intensity={profile.arithmetic_intensity} "
+              f"roofline={profile.roofline_fraction if profile.roofline_fraction is not None else 'unavailable'} "
+              f"memory_stats="
+              f"{'live' if profile.memory_stats_available else 'unavailable'}")
+        if profile.total_flops is None or profile.total_flops <= 0:
+            print("FAIL: FitProfile.total_flops is null — the compile-span "
+                  "harvest did not run", file=sys.stderr)
+            return 1
+        if not profile.programs:
+            print("FAIL: no per-program cost entries in the profile",
+                  file=sys.stderr)
+            return 1
+        for pid, entry in profile.programs.items():
+            print(f"  program {pid}: execs={entry.get('executions')} "
+                  f"flops={entry.get('flops')} "
+                  f"peak_bytes={entry.get('peak_bytes')}")
+            if entry.get("executions", 0) < 1:
+                print(f"FAIL: program {pid} has no executions",
+                      file=sys.stderr)
+                return 1
+        # memory fields: populated, or EXPLICITLY marked unavailable
+        d = profile.to_dict()
+        for key in ("hbm_peak_bytes", "hbm_argument_bytes", "hbm_temp_bytes"):
+            if key not in d:
+                print(f"FAIL: profile lacks the {key} field", file=sys.stderr)
+                return 1
+        if d["hbm_peak_bytes"] is None and profile.cost_availability == "full":
+            print("FAIL: cost_availability=full but hbm_peak_bytes is null",
+                  file=sys.stderr)
+            return 1
+        print("OK: trace validates (incl. counter events), >=4 span kinds, "
+              "profile counts agree with the model summary, cost rollup "
+              "present (FLOPs + memory fields or explicit unavailable "
+              "markers)")
         return 0
     finally:
         ctx.stop()

@@ -215,6 +215,41 @@ def test_prometheus_endpoint_under_concurrent_scrape_and_updates():
             assert value.lower() not in ("nan", "inf", "-inf")
 
 
+def test_poisoned_gauge_is_skipped_not_fatal(tmp_path):
+    """A gauge whose callback raises must be SKIPPED by the scrape — not
+    reported as NaN, and never allowed to kill registry.values(), every
+    Sink.report, or the Prometheus endpoint (the device-memory gauges
+    poll live backend state that can start failing mid-run)."""
+    reg = MetricsRegistry()
+    reg.counter("ok.counter").inc(3)
+    reg.gauge("ok.gauge", lambda: 1.5)
+
+    def poisoned():
+        raise RuntimeError("device went away")
+
+    reg.gauge("bad.gauge", poisoned)
+    vals = reg.values()
+    assert "bad.gauge" not in vals  # skipped, not NaN
+    assert vals["ok.counter"] == 3 and vals["ok.gauge"] == 1.5
+    # sinks keep reporting the healthy metrics
+    sink = CsvSink(str(tmp_path))
+    sink.report(vals)
+    assert sorted(os.listdir(tmp_path)) == ["ok.counter.csv", "ok.gauge.csv"]
+    # a full MetricsSystem scrape + prometheus exposition stays alive
+    ms = MetricsSystem("driver", period_s=100)
+    ms.registry.gauge("bad", poisoned)
+    ms.registry.counter("alive").inc()
+    ms.register_sink(CsvSink(str(tmp_path / "sys")))
+    port = ms.start_prometheus(0)
+    try:
+        ms.report()  # must not raise
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "cyclone_alive 1" in body and "bad" not in body
+    finally:
+        ms.stop()
+
+
 def test_metrics_system_periodic_report():
     ms = MetricsSystem("driver", period_s=0.02)
     seen = []
@@ -311,6 +346,81 @@ def test_history_provider_replays_journal(tmp_path):
     assert store.application_info()["id"] == "app-1"
     assert store.job(1)["status"] == "SUCCEEDED"
     assert [st["metrics"]["loss"] for st in store.steps(1)] == [0.69, 0.42]
+
+
+def test_history_provider_tolerates_torn_journal_lines(tmp_path):
+    """A process killed mid-write leaves a truncated trailing JSONL line —
+    the exact artifact the chaos harness produces. load() must skip it
+    (with a warning) and still serve everything before it; a corrupt line
+    in the MIDDLE is likewise skipped rather than truncating the replay."""
+    from cycloneml_tpu.util.events import EventJournal
+    path = tmp_path / "app-torn.jsonl"
+    journal = EventJournal(str(path))
+    _feed(journal)
+    journal.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"Event": "StepCompleted", "job_id": 1, "st')  # torn tail
+
+    hp = HistoryProvider(str(tmp_path))
+    store = hp.load("app-torn")
+    assert store.application_info()["id"] == "app-1"
+    assert store.job(1)["status"] == "SUCCEEDED"
+    assert [st["metrics"]["loss"] for st in store.steps(1)] == [0.69, 0.42]
+
+    # corrupt middle line: later events still replay
+    lines = open(path, encoding="utf-8").read().splitlines()
+    lines[2] = lines[2][: len(lines[2]) // 2]
+    broken = tmp_path / "app-mid.jsonl"
+    broken.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    events = EventJournal.replay(str(broken))
+    assert len(events) == len(lines) - 2  # both bad lines skipped
+    assert events[-1]["Event"] == "ApplicationEnd"
+
+
+def test_journal_roundtrip_matches_live_store_for_traced_fit(ctx, tmp_path):
+    """History-server fidelity for the full observability surface: replay
+    a traced fit's on-disk journal into a fresh store and the job, its
+    steps and its FitProfile — including PR 4's n_models and the cost
+    fields — must match the live store exactly."""
+    import numpy as np
+    from cycloneml_tpu.observe import tracing
+    from cycloneml_tpu.util.events import EventJournal
+
+    path = tmp_path / "roundtrip.jsonl"
+    journal = EventJournal(str(path))
+    tracing.disable()
+    tracing.enable(max_spans=50_000)
+    ctx.listener_bus.add_listener(journal)
+    try:
+        from cycloneml_tpu.dataset.frame import MLFrame
+        from cycloneml_tpu.ml.classification import LogisticRegression
+        rng = np.random.RandomState(13)
+        x = rng.randn(128, 6)
+        y = (x @ rng.randn(6) > 0).astype(float)
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        LogisticRegression(maxIter=5, regParam=0.01, tol=0.0).fit(frame)
+        assert ctx.listener_bus.wait_until_empty()
+    finally:
+        ctx.listener_bus.remove_listener(journal)
+        journal.close()
+        tracing.disable()
+
+    live = ctx.status_store
+    jid = max(j["jobId"] for j in live.job_list()
+              if "LogisticRegression.fit" in j["description"])
+    replayed = AppStatusListener()
+    for e in EventJournal.replay(str(path)):
+        replayed.on_event(e)
+    rs = replayed.store
+    assert rs.job(jid) == live.job(jid)
+    assert rs.steps(jid) == live.steps(jid)
+    live_prof = live.profile(jid)
+    assert rs.profile(jid) == live_prof
+    # the profile that travelled through disk really carries the rollup
+    assert live_prof["n_models"] == 1
+    assert live_prof["total_flops"] and live_prof["total_flops"] > 0
+    assert live_prof["programs"]
+    assert "hbm_peak_bytes" in live_prof  # populated-or-explicitly-null
 
 
 # -- end-to-end: a real fit shows up in status + metrics ------------------------
