@@ -1,7 +1,7 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-json test chaos obs-demo bench
+.PHONY: lint lint-json test chaos obs-demo bench bench-bytes
 
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
@@ -27,3 +27,8 @@ obs-demo:
 # stacked-vs-serial comparison (ovr_stacked_speedup, models_per_compile)
 bench:
 	python bench.py
+
+# standalone sweep-byte check: bf16 data-tier sweep must access < 60% of
+# the fp32 sweep's bytes (XLA cost-analysis ground truth, lower-only)
+bench-bytes:
+	python scripts/bench_bytes.py

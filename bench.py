@@ -43,6 +43,7 @@ def hardware_meta():
     tier, roofline peaks and live-telemetry availability — the denominator
     context that makes the perf trajectory utilization-denominated."""
     import jax
+    from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
     from cycloneml_tpu.observe import costs
     dev = jax.devices()[0]
     peak_flops, peak_bw = costs.backend_peaks()
@@ -50,7 +51,10 @@ def hardware_meta():
         "backend": dev.platform,
         "device_kind": dev.device_kind,
         "device_count": jax.device_count(),
-        "dtype": "float64" if jax.config.jax_enable_x64 else "float32",
+        # the two precision tiers: accumulator (optimizer state, psums)
+        # and data (what a materialized X is stored as — bf16 by default)
+        "dtype": str(np.dtype(compute_dtype())),
+        "data_dtype": str(np.dtype(data_dtype())),
         "peak_flops_per_device": peak_flops,
         "peak_hbm_bytes_per_s": peak_bw,
         "memory_stats_available": costs.memory_stats_available(),
@@ -119,15 +123,17 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     measures steady-state training — data placement included, compilation
     excluded.
 
-    Default shape n=2M × d=1280: one loss/grad eval streams the 10.2 GB
-    feature block ONCE — at this scale ``usePallasKernels=auto`` selects
-    the fused single-pass Pallas kernel (margin + loss + gradient in one
-    VMEM-resident row pass, Kahan-compensated accumulation; see
+    Default shape n=2M × d=1280: one loss/grad eval streams the feature
+    block ONCE at the data tier's width — 5.1 GB at the default bf16 tier
+    (10.2 GB with cyclone.data.dtype=float32) — and
+    ``usePallasKernels=auto`` makes the fused single-pass Pallas kernel
+    the sweep (margin + loss + gradient in one VMEM-resident row pass,
+    storage-width reads, fp32 accumulation, Kahan-compensated grid; see
     benchmarks/PALLAS_AB.md) with standardization folded into the read —
     so the fit is HBM-bound, the honest ceiling for a generalized-linear
     sweep on any hardware. No standardized copy exists
     (r4: binary_logistic_scaled), so X itself is the working set and n can
-    fill one chip's 16 GB HBM.
+    fill one chip's 16 GB HBM twice over at bf16.
     """
     from cycloneml_tpu import CycloneConf, CycloneContext
     from cycloneml_tpu.dataset.random import generate_classification
@@ -159,9 +165,32 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
     for _ in range(4):
         r = sum_fn(ds.x)
     jax.block_until_ready(r)
-    ceiling_bw = n * d * 4 * 4 / (time.perf_counter() - t0)
+    # bytes at the DATA tier's width (bf16 X streams 2 bytes/element)
+    x_item = np.dtype(str(ds.x.dtype)).itemsize
+    ceiling_bw = n * d * x_item * 4 / (time.perf_counter() - t0)
     print(f"info: measured streaming ceiling (jit sum over X): "
           f"{ceiling_bw / 1e9:.0f} GB/s", file=sys.stderr)
+
+    # bytes-accessed ground truth for ONE optimizer sweep at the live data
+    # tier (observe/costs.py rollup — the sweep-byte reduction is a
+    # first-class BENCH metric per PR). Lower-only: XLA analyzes the jnp
+    # aggregator program at the dataset's dtypes, nothing executes.
+    import jax.numpy as jnp
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.observe import costs
+    adt = compute_dtype()
+    sweep = costs.sweep_cost(
+        ds.tree_aggregate_fn(aggregators.binary_logistic_scaled(d, True)),
+        jnp.ones(d, adt), jnp.zeros(d, adt), jnp.zeros(d + 1, adt),
+        name="bench.sweep")
+    bytes_per_sweep = sweep.bytes_accessed_total
+    data_dtype = str(ds.x.dtype)
+    if bytes_per_sweep:
+        print(f"info: bytes_per_sweep={bytes_per_sweep / 1e9:.3f} GB at "
+              f"data_dtype={data_dtype} (X alone is "
+              f"{n * d * np.dtype(data_dtype).itemsize / 1e9:.3f} GB)",
+              file=sys.stderr)
 
     lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
     t0 = time.perf_counter()
@@ -204,6 +233,8 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
         "steady_per_iter_ms": round(dt / max(its, 1) * 1e3, 2),
         "transfer_s": round(warm_profile.get("transfer_seconds", 0.0), 4),
         "transfer_bytes": warm_profile.get("transfer_bytes", 0),
+        "bytes_per_sweep": bytes_per_sweep,
+        "data_dtype": data_dtype,
     }
     phases.update(profile_cost_fields(warm_profile))
     print(f"info: phase breakdown: warm fit {phases['warm_fit_s']}s "
@@ -367,10 +398,13 @@ def main() -> None:
                   f"(end-to-end fit flops vs device matmul peak "
                   f"{peak_flops / 1e12:.0f} Tflop/s)", file=sys.stderr)
         if peak_bw:
-            # X is streamed ONCE per eval: the scaled aggregator reads raw
-            # blocks and XLA fuses margin+gradient per tile (verified: a
-            # standalone eval costs ~a pure jnp.sum sweep of X)
-            bw = 1.0 * n * d * 4 * evals_n / fit_s
+            # X is streamed ONCE per eval at the DATA tier's width: the
+            # scaled aggregator reads raw blocks and XLA fuses
+            # margin+gradient per tile (verified: a standalone eval costs
+            # ~a pure jnp.sum sweep of X)
+            x_item = np.dtype(phases.get("data_dtype", "float32")).itemsize \
+                if phases else 4
+            bw = 1.0 * n * d * x_item * evals_n / fit_s
             line = (f"info: hbm_bandwidth={bw / 1e9:.1f} GB/s "
                     f"({bw / peak_bw * 100:.1f}% of {peak_bw / 1e9:.0f} "
                     f"GB/s paper peak")

@@ -1,12 +1,22 @@
-"""JX004 — fp64 literal/dtype drift in device code.
+"""JX004 — dtype drift across the data/accumulator tier boundary.
 
-Without ``jax.config.update("jax_enable_x64", True)``, JAX silently
-downcasts every float64 request to float32 — so device code that asks
-for ``jnp.float64`` / ``dtype="float64"`` is either a silent downcast
-(TPU default) or, where x64 IS enabled, a 2x memory + severe MXU perf
-hit smuggled into a hot path. Either way an explicit module-level guard
-(any mention of ``jax_enable_x64``) is required context for fp64 in
-jit-reachable code; absent that, it's flagged.
+Two hazards, one boundary (docs/mixed-precision.md):
+
+**fp64 drift.** Without ``jax.config.update("jax_enable_x64", True)``,
+JAX silently downcasts every float64 request to float32 — so device code
+that asks for ``jnp.float64`` / ``dtype="float64"`` is either a silent
+downcast (TPU default) or, where x64 IS enabled, a 2x memory + severe
+MXU perf hit smuggled into a hot path. Either way an explicit
+module-level guard (any mention of ``jax_enable_x64``) is required
+context for fp64 in jit-reachable code; absent that, it's flagged.
+
+**Narrow accumulation.** The other direction of the same boundary: bf16
+(``cyclone.data.dtype``) is legal STORAGE — design matrices live there —
+but the tier ends at the kernel: every cross-device reduction must carry
+the fp32 accumulator (``cyclone.compute.dtype``). A ``psum`` whose
+operand is explicitly cast to bf16/f16 accumulates at storage width —
+8 mantissa bits across the whole mesh — and is flagged regardless of any
+x64 guard (the guard legitimizes fp64, not narrow reductions).
 
 ``np.float64`` on the HOST side (optimizer state, readbacks) is idiomatic
 and untouched — only jit-reachable functions are scanned.
@@ -26,26 +36,49 @@ F64_DOTTED = {"jnp.float64", "jax.numpy.float64", "np.float64",
               "numpy.float64", "jnp.complex128", "jax.numpy.complex128"}
 F64_STRINGS = {"float64", "f64", "complex128"}
 
+NARROW_DOTTED = {"jnp.bfloat16", "jax.numpy.bfloat16", "ml_dtypes.bfloat16",
+                 "jnp.float16", "jax.numpy.float16", "np.float16",
+                 "numpy.float16"}
+NARROW_STRINGS = {"bfloat16", "bf16", "float16", "f16"}
+
+PSUM_CALLS = {"jax.lax.psum", "lax.psum", "psum", "psum_over_mesh",
+              "collectives.psum_over_mesh", "jax.lax.pmean", "lax.pmean",
+              "pmean"}
+
 
 class FP64DriftRule(Rule):
     rule_id = "JX004"
 
     def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
-        if mod.has_x64_guard:
-            return
         for fn in mod.functions:
             if not fn.jit_reachable:
                 continue
             for node in iter_own_statements(fn.node):
-                hit = self._f64_use(node)
+                if not mod.has_x64_guard:
+                    hit = self._f64_use(node)
+                    if hit:
+                        yield self.finding(
+                            mod, node,
+                            f"{hit} in jit-reachable code without a "
+                            f"`jax_enable_x64` guard in the module — silently "
+                            f"downcast to float32 on default TPU configs (or a "
+                            f"2x HBM + MXU perf hit where x64 is on); pass the "
+                            f"dtype in from the data tier or guard the module",
+                            fn.qualname)
+                        continue
+                # narrow-accumulator check runs regardless of the x64
+                # guard: the guard legitimizes fp64 storage, not bf16 sums
+                # across the mesh
+                hit = self._narrow_psum(node)
                 if hit:
                     yield self.finding(
                         mod, node,
-                        f"{hit} in jit-reachable code without a "
-                        f"`jax_enable_x64` guard in the module — silently "
-                        f"downcast to float32 on default TPU configs (or a "
-                        f"2x HBM + MXU perf hit where x64 is on); pass the "
-                        f"dtype in from the data tier or guard the module",
+                        f"psum of a {hit} value — the collective "
+                        f"accumulates at storage width (8 mantissa bits "
+                        f"mesh-wide); bf16 is a STORAGE tier "
+                        f"(cyclone.data.dtype) and ends at the kernel: "
+                        f"upcast to the fp32 accumulator "
+                        f"(cyclone.compute.dtype) before the psum",
                         fn.qualname)
 
     @staticmethod
@@ -72,4 +105,43 @@ class FP64DriftRule(Rule):
                     return f"`.astype({aname})`"
                 if isinstance(arg, ast.Constant) and arg.value in F64_STRINGS:
                     return f'`.astype("{arg.value}")`'
+        return None
+
+    @classmethod
+    def _narrow_psum(cls, node: ast.AST) -> Optional[str]:
+        """A psum/pmean whose operand is an EXPLICIT narrow cast — the
+        direct-evidence form of storage-width accumulation (a deeper
+        dataflow pass would chase names; the paired fixtures pin this
+        rule's precision at the cast-at-the-callsite pattern)."""
+        if not isinstance(node, ast.Call):
+            return None
+        if call_name(node) not in PSUM_CALLS or not node.args:
+            return None
+        return cls._narrow_value(node.args[0])
+
+    @staticmethod
+    def _narrow_value(expr: ast.AST) -> Optional[str]:
+        if not isinstance(expr, ast.Call):
+            return None
+        name = call_name(expr)
+        if name in NARROW_DOTTED:
+            return f"`{name}(...)`-cast"
+        # x.astype(bf16-ish)
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "astype" and expr.args):
+            arg = expr.args[0]
+            aname = dotted_name(arg)
+            if aname in NARROW_DOTTED:
+                return f"`.astype({aname})`"
+            if isinstance(arg, ast.Constant) and arg.value in NARROW_STRINGS:
+                return f'`.astype("{arg.value}")`'
+        # jnp.asarray(x, dtype=bf16) / jnp.zeros(..., dtype="bfloat16")
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                kname = dotted_name(kw.value)
+                if kname in NARROW_DOTTED:
+                    return f"`dtype={kname}`"
+                if isinstance(kw.value, ast.Constant) \
+                        and kw.value.value in NARROW_STRINGS:
+                    return f'`dtype="{kw.value.value}"`'
         return None

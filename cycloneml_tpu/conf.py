@@ -265,6 +265,29 @@ DEVICE_DTYPE = (
     .str_conf("float32")
 )
 
+DATA_DTYPE = (
+    ConfigBuilder("cyclone.data.dtype")
+    .doc("Storage dtype of the DATA tier — every materialized design "
+         "matrix (dataset blocks, managed-tier spills, OvR label stacks). "
+         "'auto' (default) is bfloat16 — fits are bandwidth-bound "
+         "(BENCH r03-r05: 71% of the measured HBM streaming ceiling at "
+         "0.096% MFU), so halving X's bytes halves the sweep — EXCEPT "
+         "under jax x64 (the CPU parity/test config), where it resolves "
+         "to float64 so reference-parity suites are untouched. All "
+         "aggregators and kernels upcast to the float32 accumulator "
+         "(cyclone.compute.dtype) inside the kernel; X is never "
+         "materialized wider than this tier. 'float32' opts out and "
+         "restores the pre-bf16 byte-identical sweep; 'float64' is only "
+         "meaningful under x64 (silently canonicalized to f32 otherwise "
+         "— graftlint JX004 polices that drift). Resolved when a dataset "
+         "is materialized; mutable for the next dataset, not "
+         "retroactively.")
+    .check_value(lambda v: v in ("auto", "bfloat16", "float32", "float64"),
+                 "must be auto, bfloat16, float32 or float64")
+    .mutable()
+    .str_conf("auto")
+)
+
 EVENT_LOG_ENABLED = (
     ConfigBuilder("cyclone.eventLog.enabled")
     .doc("Write the structured event journal to disk "
@@ -325,25 +348,23 @@ LBFGS_DEVICE_CHUNK = (
 
 USE_PALLAS_KERNELS = (
     ConfigBuilder("cyclone.ml.usePallasKernels")
-    .doc("Route the binomial LogisticRegression aggregator and the KMeans "
-         "assignment step through the hand-written Pallas kernels "
-         "(ops/kernels.py) instead of the XLA-fused jnp aggregators. "
-         "'auto' (default) uses the fused single-pass logistic kernel for "
-         "HBM-scale dense binomial fits on natively-lowered backends "
-         "(TPU), where the committed head-to-head (benchmarks/PALLAS_AB.md) "
-         "shows it ~10-16% faster end-to-end than the XLA path, and the "
-         "XLA path everywhere else (small shapes are within noise and the "
-         "interpreted kernel is slow on CPU). 'true'/'false' force one "
-         "path for both estimators.")
+    .doc("Route the eligible dense sweeps — binomial LogisticRegression "
+         "(serial AND stacked), the LinearRegression l-bfgs objective, "
+         "the RowMatrix Gramian and the KMeans assignment step — through "
+         "the hand-written fused Pallas kernels (ops/kernels.py) instead "
+         "of the XLA-fused jnp aggregators. 'auto' (default) makes the "
+         "fused kernels the DEFAULT sweep on natively-lowered backends "
+         "(TPU): one VMEM-resident row pass per loss/grad evaluation, "
+         "narrow (bf16) blocks read at storage width with fp32 in-kernel "
+         "accumulation, ~10-16% faster end-to-end at HBM scale "
+         "(benchmarks/PALLAS_AB.md; small shapes are within relay noise "
+         "either way). Everywhere else 'auto' keeps the XLA path — the "
+         "interpreted kernels exist for tests, not speed. 'true'/'false' "
+         "force one path for every eligible estimator.")
     .check_value(lambda v: str(v).lower() in ("auto", "true", "false"),
                  "must be auto, true or false")
     .str_conf("auto")
 )
-
-# elements of X above which the fused Pallas logistic kernel wins on
-# real hardware (the 2026-07-31 head-to-heads at n=2M x d=1280 = 2.56e9;
-# the committed small-shape A/B (~6.7e7) shows XLA/pallas within noise)
-PALLAS_AUTO_MIN_ELEMENTS = 1 << 28
 
 SHUFFLE_SPILL_ROW_BUDGET = (
     ConfigBuilder("cyclone.shuffle.spill.rowBudget")

@@ -329,6 +329,23 @@ class PartitionedDataset:
         return InstanceDataset.from_numpy(self.ctx, x, y, w)
 
 
+def _npz_pack(x: np.ndarray):
+    """numpy's npz format silently drops extension dtypes — a bf16 block
+    written directly loads back as raw ``|V2`` bytes. Pack narrow extension
+    floats as a uint16 bit-view plus a dtype tag (returned as
+    ``(packed, dtype_str)``); plain float arrays pass through untagged."""
+    if np.dtype(x.dtype).kind == "V":
+        return x.view(np.uint16), str(x.dtype)
+    return x, ""
+
+
+def _npz_unpack(x: np.ndarray, dtype_str) -> np.ndarray:
+    tag = str(dtype_str)
+    if tag:
+        return x.view(np.dtype(tag))
+    return x
+
+
 class InstanceDataset:
     """Numeric tier: row-sharded device arrays with static shapes.
 
@@ -355,9 +372,17 @@ class InstanceDataset:
         self._array_parent = None      # weakref: dataset we share arrays with
         self._derived_children = None  # WeakSet of datasets sharing ours
         # padded geometry captured up-front so storage accounting never
-        # has to touch (and possibly restore) the device arrays
+        # has to touch (and possibly restore) the device arrays; X and the
+        # (y, w) vectors can sit in DIFFERENT tiers (bf16 data tier vs the
+        # fp32/f64 accumulator tier), so both itemsizes are recorded
         self._n_pad = int(x.shape[0]) if x is not None else 0
         self._itemsize = int(np.dtype(str(x.dtype)).itemsize) if x is not None else 4
+        self._yw_itemsize = int(np.dtype(str(y.dtype)).itemsize) \
+            if y is not None else self._itemsize
+        # y can be a stacked (n_pad, K) label matrix (fit_stacked derives
+        # one); storage accounting must count all K columns
+        self._y_cols = (int(np.prod(y.shape[1:]))
+                        if y is not None and len(y.shape) > 1 else 1)
         self.n_rows = n_rows
         self.n_features = n_features
 
@@ -442,9 +467,12 @@ class InstanceDataset:
             # block and re-place it on the mesh transparently
             z = np.load(self._disk_path)
             rt = self.ctx.mesh_runtime
-            self._x = rt.device_put_sharded_rows(z["x"])
-            self._y = rt.device_put_sharded_rows(z["y"])
-            self._w = rt.device_put_sharded_rows(z["w"])
+            self._x = rt.device_put_sharded_rows(
+                _npz_unpack(z["x"], z.get("x_dtype", "")))
+            self._y = rt.device_put_sharded_rows(
+                _npz_unpack(z["y"], z.get("y_dtype", "")))
+            self._w = rt.device_put_sharded_rows(
+                _npz_unpack(z["w"], z.get("w_dtype", "")))
             restored = True
         if restored and self._storage_cb is not None:
             # lazy restores must reach the StorageManager's accounting, or
@@ -476,8 +504,14 @@ class InstanceDataset:
                        np.asarray(self.w))
         extra = ({"valid_mask": self._valid_mask}
                  if self._valid_mask is not None else {})
-        np.savez(path, x=x, y=y, w=w, n_rows=self.n_rows,
-                 n_features=self.n_features, **extra)
+        # y rides the data tier too when it carries a stacked label matrix
+        # (fit_stacked derives y at X's dtype) — pack all three
+        x_packed, x_dtype = _npz_pack(x)
+        y_packed, y_dtype = _npz_pack(y)
+        w_packed, w_dtype = _npz_pack(w)
+        np.savez(path, x=x_packed, x_dtype=x_dtype, y=y_packed,
+                 y_dtype=y_dtype, w=w_packed, w_dtype=w_dtype,
+                 n_rows=self.n_rows, n_features=self.n_features, **extra)
         self._disk_path = path if path.endswith(".npz") else path + ".npz"
         self._host = None
         if self._x is not None:
@@ -487,7 +521,8 @@ class InstanceDataset:
     def padded_bytes(self) -> int:
         """Storage footprint of the padded block (metadata only — never
         touches, and so never restores, the arrays)."""
-        return self._n_pad * (self.n_features + 2) * self._itemsize
+        return self._n_pad * (self.n_features * self._itemsize
+                              + (self._y_cols + 1) * self._yw_itemsize)
 
     @property
     def x(self):
@@ -507,11 +542,15 @@ class InstanceDataset:
     @classmethod
     def from_numpy(cls, ctx, x: np.ndarray, y: Optional[np.ndarray] = None,
                    w: Optional[np.ndarray] = None, dtype=None) -> "InstanceDataset":
+        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
         if dtype is None:
-            from cycloneml_tpu.dataset.instance import compute_dtype
-            dtype = compute_dtype()
+            # X lands in the data tier (bf16 by default off-x64); y/w stay
+            # at accumulator width — see blockify_arrays
+            dtype = data_dtype(getattr(ctx, "conf", None))
         rt = ctx.mesh_runtime
-        x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism, dtype=dtype)
+        x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism,
+                                           dtype=dtype,
+                                           yw_dtype=compute_dtype())
         ds = cls(ctx,
                  rt.device_put_sharded_rows(x_p),
                  rt.device_put_sharded_rows(y_p),
@@ -540,9 +579,10 @@ class InstanceDataset:
         training rows are exchangeable, padding carries w=0)."""
         import jax
         import jax.numpy as jnp
+        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
         if dtype is None:
-            from cycloneml_tpu.dataset.instance import compute_dtype
-            dtype = compute_dtype()
+            dtype = data_dtype(getattr(ctx, "conf", None))
+        yw_dt = compute_dtype()
         rt = ctx.mesh_runtime
         if rt.mesh.devices.shape[2] != 1:
             raise ValueError(
@@ -561,10 +601,10 @@ class InstanceDataset:
                 raise ValueError(
                     f"chunk {ci} has shape {cx.shape}, expected "
                     f"(rows, {n_features})")
-            cy = (np.zeros(m, dtype=dtype) if cy is None
-                  else np.asarray(cy, dtype=dtype))
-            cw = (np.ones(m, dtype=dtype) if cw is None
-                  else np.asarray(cw, dtype=dtype))
+            cy = (np.zeros(m, dtype=yw_dt) if cy is None
+                  else np.asarray(cy, dtype=yw_dt))
+            cw = (np.ones(m, dtype=yw_dt) if cw is None
+                  else np.asarray(cw, dtype=yw_dt))
             if len(cy) != m or len(cw) != m:
                 # a silent mismatch would shift every later label in the
                 # shard against its features
@@ -610,9 +650,10 @@ class InstanceDataset:
         x = jax.make_array_from_single_device_arrays(
             (n_pad, n_features), rt.data_sharding(1), shards)
         # (n,) label/weight vectors assembled host-side in shard order —
-        # tiny next to X, and estimators want the host twins anyway
-        y_pad = np.zeros(n_pad, dtype=dtype)
-        w_pad = np.zeros(n_pad, dtype=dtype)
+        # tiny next to X (accumulator tier), and estimators want the host
+        # twins anyway
+        y_pad = np.zeros(n_pad, dtype=yw_dt)
+        w_pad = np.zeros(n_pad, dtype=yw_dt)
         valid = np.zeros(n_pad, dtype=bool)
         for di in range(n_dev):
             off = di * shard_rows
@@ -695,18 +736,25 @@ class InstanceDataset:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         extra = ({"valid_mask": self._valid_mask}
                  if self._valid_mask is not None else {})
-        np.savez(path, x=np.asarray(self.x), y=np.asarray(self.y),
-                 w=np.asarray(self.w), n_rows=self.n_rows,
-                 n_features=self.n_features, **extra)
+        x_packed, x_dtype = _npz_pack(np.asarray(self.x))
+        y_packed, y_dtype = _npz_pack(np.asarray(self.y))
+        w_packed, w_dtype = _npz_pack(np.asarray(self.w))
+        np.savez(path, x=x_packed, x_dtype=x_dtype, y=y_packed,
+                 y_dtype=y_dtype, w=w_packed, w_dtype=w_dtype,
+                 n_rows=self.n_rows, n_features=self.n_features, **extra)
         return path
 
     @classmethod
     def restore(cls, ctx, path: str) -> "InstanceDataset":
         z = np.load(path if path.endswith(".npz") else path + ".npz")
         rt = ctx.mesh_runtime
-        ds = cls(ctx, rt.device_put_sharded_rows(z["x"]),
-                 rt.device_put_sharded_rows(z["y"]),
-                 rt.device_put_sharded_rows(z["w"]),
+        ds = cls(ctx,
+                 rt.device_put_sharded_rows(
+                     _npz_unpack(z["x"], z.get("x_dtype", ""))),
+                 rt.device_put_sharded_rows(
+                     _npz_unpack(z["y"], z.get("y_dtype", ""))),
+                 rt.device_put_sharded_rows(
+                     _npz_unpack(z["w"], z.get("w_dtype", ""))),
                  int(z["n_rows"]), int(z["n_features"]))
         if "valid_mask" in z:
             ds._valid_mask = z["valid_mask"]

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from cycloneml_tpu.dataset.dataset import InstanceDataset
-from cycloneml_tpu.dataset.instance import compute_dtype, rows_to_dense
+from cycloneml_tpu.dataset.instance import rows_to_dense
 from cycloneml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
 
 
@@ -150,7 +150,11 @@ class MLFrame:
                             weight_col: Optional[str] = None,
                             dtype=None) -> InstanceDataset:
         if dtype is None:
-            dtype = compute_dtype()
+            # the design matrix lands in the DATA tier (bf16 by default
+            # off-x64); labels/weights stay at accumulator width inside
+            # InstanceDataset.from_numpy
+            from cycloneml_tpu.dataset.instance import data_dtype
+            dtype = data_dtype(getattr(self.ctx, "conf", None))
         # cached per column selection: the frame is immutable, so repeated
         # fits on the same frame (grid search, CV, warmed benchmarks) reuse
         # one device placement instead of re-paying the host→device transfer

@@ -25,14 +25,56 @@ from cycloneml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
 
 
 def compute_dtype():
-    """The effective device float dtype: float64 only when jax x64 is enabled
-    (CPU parity tests); on TPU the MXU path is float32 and requesting f64
-    would silently canonicalize anyway — this makes the choice explicit."""
+    """The ACCUMULATOR float dtype (``cyclone.compute.dtype`` tier): float64
+    only when jax x64 is enabled (CPU parity tests); on TPU the MXU path is
+    float32 and requesting f64 would silently canonicalize anyway — this
+    makes the choice explicit. Labels, weights, optimizer state and every
+    psum accumulator live here; the design matrix lives in the (possibly
+    narrower) data tier — see :func:`data_dtype`."""
     try:
         import jax
         return np.float64 if jax.config.jax_enable_x64 else np.float32
     except Exception:
         return np.float32
+
+
+def data_dtype(conf=None):
+    """The DATA-tier storage dtype (``cyclone.data.dtype``): what a
+    materialized design matrix is stored as. Default ('auto') is bfloat16 —
+    the sweeps are bandwidth-bound, so X's width IS the fit's speed — except
+    under jax x64 (the parity/test config), where auto resolves to float64
+    so golden suites see pre-tier numerics. Aggregators/kernels upcast to
+    :func:`compute_dtype` INSIDE the kernel; nothing re-materializes X
+    wider than this. ``conf`` defaults to the active context's."""
+    from cycloneml_tpu.conf import DATA_DTYPE
+    name = "auto"
+    if conf is None:
+        try:
+            from cycloneml_tpu import context as _c
+            if _c._active_context is not None:
+                conf = _c._active_context.conf
+        except Exception:
+            conf = None
+    if conf is not None:
+        name = str(conf.get(DATA_DTYPE))
+    if name == "auto":
+        if compute_dtype() is np.float64:
+            return np.float64  # x64 parity runs keep the full-width tier
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    if name == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.dtype(name).type
+
+
+def is_narrow_dtype(dt) -> bool:
+    """True for sub-float32 storage dtypes (bf16/f16) — the tier boundary
+    where fp32 accumulation becomes mandatory (Micikevicius et al. 2018)."""
+    try:
+        return np.dtype(dt).itemsize < 4
+    except TypeError:
+        return False
 
 
 @dataclass
@@ -55,24 +97,31 @@ def blockify_arrays(
     n_shards: int,
     rows_multiple: int = 8,
     dtype=np.float32,
+    yw_dtype=None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Pad (x, y, w) to a shard-divisible row count with zero-weight rows.
 
     Returns (x_pad, y_pad, w_pad, n_true). Row count is padded to a multiple
-    of ``n_shards * rows_multiple`` (sublane-friendly shards).
+    of ``n_shards * rows_multiple`` (sublane-friendly shards). ``dtype`` is
+    the DATA tier (X only); ``y``/``w`` are blockified in ``yw_dtype``
+    (default :func:`compute_dtype`) — the (n,) vectors are noise next to X,
+    and keeping them at accumulator width keeps weight sums, label moments
+    and the optimizers' state dtype exact across tiers.
     """
     n = x.shape[0]
+    if yw_dtype is None:
+        yw_dtype = compute_dtype()
     if y is None:
-        y = np.zeros(n, dtype=dtype)
+        y = np.zeros(n, dtype=yw_dtype)
     if w is None:
-        w = np.ones(n, dtype=dtype)
+        w = np.ones(n, dtype=yw_dtype)
     target = max(_round_up(n, n_shards * rows_multiple), n_shards * rows_multiple)
     pad = target - n
     x_pad = np.zeros((target, x.shape[1]), dtype=dtype)
     x_pad[:n] = x
-    y_pad = np.zeros(target, dtype=dtype)
+    y_pad = np.zeros(target, dtype=yw_dtype)
     y_pad[:n] = y
-    w_pad = np.zeros(target, dtype=dtype)
+    w_pad = np.zeros(target, dtype=yw_dtype)
     w_pad[:n] = w
     return x_pad, y_pad, w_pad, n
 

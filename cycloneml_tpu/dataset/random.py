@@ -57,13 +57,15 @@ def _shard_generate(ctx, n_rows: int, seed: int, local_fn: Callable,
 def _generate(ctx, n_rows: int, n_cols: int, seed: int,
               sampler: Callable) -> InstanceDataset:
     """Run ``sampler(key, shape)`` per shard; returns an InstanceDataset with
-    padding rows masked out via w=0 (the blockify invariant)."""
-    from cycloneml_tpu.dataset.instance import compute_dtype
+    padding rows masked out via w=0 (the blockify invariant). X lands in the
+    data-tier dtype (generated at f32 then narrowed ON DEVICE — no host
+    round trip); y/w stay at accumulator width."""
+    from cycloneml_tpu.dataset.instance import data_dtype
 
-    dt = compute_dtype()
+    xdt = data_dtype(getattr(ctx, "conf", None))
     x, w, total, dt = _shard_generate(
         ctx, n_rows, seed,
-        lambda key, per: sampler(key, (per, n_cols)).astype(dt), n_out=1)
+        lambda key, per: sampler(key, (per, n_cols)).astype(xdt), n_out=1)
     rt = ctx.mesh_runtime
     return InstanceDataset(ctx, x, rt.device_put_sharded_rows(np.zeros(total, dtype=dt)),
                            rt.device_put_sharded_rows(w), n_rows, n_cols)
@@ -91,10 +93,11 @@ def generate_classification(ctx, n_rows: int, n_cols: int, seed: int = 0,
         x = jax.random.normal(kx, (per, n_cols), dtype=jnp.float32)
         margin = x @ beta + noise * jax.random.normal(ke, (per,),
                                                       dtype=jnp.float32)
-        return x.astype(dt), (margin > 0).astype(dt)
+        return x.astype(xdt), (margin > 0).astype(dt)
 
-    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
     dt = compute_dtype()
+    xdt = data_dtype(getattr(ctx, "conf", None))
     (x, y), w, total, dt = _shard_generate(ctx, n_rows, seed, local, n_out=2)
     rt = ctx.mesh_runtime
     ds = InstanceDataset(ctx, x, y, rt.device_put_sharded_rows(w),
@@ -114,8 +117,9 @@ def generate_regression(ctx, n_rows: int, n_cols: int, seed: int = 0,
     import jax
     import jax.numpy as jnp
 
-    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
     dt = compute_dtype()
+    xdt = data_dtype(getattr(ctx, "conf", None))
 
     def local(key, per):
         kx, ke = jax.random.split(key)
@@ -125,7 +129,7 @@ def generate_regression(ctx, n_rows: int, n_cols: int, seed: int = 0,
         x = jax.random.normal(kx, (per, n_cols), dtype=jnp.float32)
         y = x @ beta + noise * jax.random.normal(ke, (per,),
                                                  dtype=jnp.float32)
-        return x.astype(dt), y.astype(dt)
+        return x.astype(xdt), y.astype(dt)
 
     (x, y), w, total, dt = _shard_generate(ctx, n_rows, seed, local, n_out=2)
     rt = ctx.mesh_runtime

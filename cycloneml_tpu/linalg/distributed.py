@@ -107,10 +107,25 @@ class RowMatrix:
             out = self.dataset.tree_aggregate_fn(agg)()
             return DenseMatrix.from_array(np.asarray(out, dtype=np.float64))
 
-        out = self.dataset.tree_aggregate_fn(
-            lambda x, y, w: jnp.einsum(
-                "bi,bj->ij", x * (w > 0)[:, None].astype(x.dtype), x,
-                precision=jax.lax.Precision.HIGHEST))()
+        from cycloneml_tpu.ops.kernels import fused_gramian, use_fused_kernels
+        if use_fused_kernels(self.dataset.ctx):
+            # fused Pallas sweep: per-tile MXU matmul into a revisited VMEM
+            # accumulator, presence mask applied in-kernel — one storage-
+            # width read of X, no masked copy
+            out = self.dataset.tree_aggregate_fn(
+                lambda x, y, w: fused_gramian(x, w=w))()
+        else:
+            def agg(x, y, w):
+                # presence-masked XᵀX; narrow (bf16) blocks keep their
+                # storage dtype as the einsum operands ({0,1} mask is
+                # exact) and accumulate into f32
+                from cycloneml_tpu.dataset.instance import is_narrow_dtype
+                acc = jnp.float32 if is_narrow_dtype(x.dtype) else x.dtype
+                return jnp.einsum(
+                    "bi,bj->ij", x * (w > 0)[:, None].astype(x.dtype), x,
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=acc)
+            out = self.dataset.tree_aggregate_fn(agg)()
         return DenseMatrix.from_array(np.asarray(out, dtype=np.float64))
 
     def compute_gramian_sharded(self):
@@ -125,7 +140,10 @@ class RowMatrix:
         m = fs.model_parallelism(rt)
         if m <= 1 or d % m != 0:
             return None
-        x_tp = fs.feature_sharded_put(rt, self.dataset.x)
+        # the ppermute ring accumulates in X's dtype; narrow data-tier
+        # blocks upcast at the TP boundary (fs.accumulator_width)
+        x_tp = fs.feature_sharded_put(
+            rt, fs.accumulator_width(self.dataset.x))
         return fs.gramian_feature_sharded(rt, x_tp, w=self.dataset.w)
 
     # -- covariance / pca ------------------------------------------------------

@@ -263,13 +263,19 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         if y_stack is None:
             y = np.asarray(ds.unpad(ds.y_host()), dtype=np.float64)
             y_stack = np.broadcast_to(y, (len(reg_params), len(y)))
+        # keep the caller's storage (OvR hands a data-tier bf16 stack — at
+        # target scale a full (K, n) f64 clone would be 4x the stack it
+        # was narrowed to save); host-side math below converts ONE (n,)
+        # model row at a time, which is lossless for {0, 1} labels
         y_stack = np.asarray(y_stack)
         n_models = y_stack.shape[0]
         if y_stack.shape[1] != ds.n_rows:
             raise ValueError(
                 f"y_stack has {y_stack.shape[1]} rows per model; dataset "
                 f"has {ds.n_rows}")
-        validate_binary_labels(y_stack, "fit_stacked")
+        for kk in range(n_models):
+            validate_binary_labels(
+                np.asarray(y_stack[kk], dtype=np.float64), "fit_stacked")
         reg = self.get("regParam")
         if reg_params is None:
             reg_params = np.full(n_models, float(reg))
@@ -291,28 +297,41 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         x0 = np.zeros((n_models, n_coef))
         if fit_intercept:
             w_real = np.asarray(ds.unpad(ds.w_host()), dtype=np.float64)
-            pos = y_stack @ w_real  # per-model weighted positive mass
+            # per-model weighted positive mass, one f64 row at a time
+            pos = np.array([np.asarray(y_stack[kk], dtype=np.float64)
+                            @ w_real for kk in range(n_models)])
             ok = (pos > 0) & (pos < weight_sum)
             p1 = np.where(ok, pos / weight_sum, 0.5)
             x0[:, d] = np.where(ok, np.log(p1 / (1.0 - p1)), 0.0)
 
         # the stacked (n_pad, K) label matrix rides the dataset's row
-        # sharding in the data-tier dtype; X itself is SHARED via derive —
-        # no second feature copy exists
+        # sharding in the data-tier dtype ({0, 1} is exact in bf16, and at
+        # large K the stack is a real per-sweep byte cost); X itself is
+        # SHARED via derive — no second feature copy exists
         xdt = np.dtype(str(ds.x.dtype))
         y_pad = np.zeros((len(ds.y_host()), n_models), dtype=xdt)
-        y_pad[ds.valid_indices()] = y_stack.T.astype(xdt)
+        valid = ds.valid_indices()
+        for kk in range(n_models):
+            y_pad[valid, kk] = np.asarray(y_stack[kk], dtype=xdt)
         rt = ds.ctx.mesh_runtime
         ds_stacked = ds.derive(y=rt.device_put_sharded_rows(y_pad))
 
-        agg = aggregators.stack_scaled_aggregator(
-            aggregators.binary_logistic_scaled(d, fit_intercept))
+        # stacked fits ride the fused Pallas kernel wherever the serial
+        # path would (vmap batches the kernel's row pass mechanically);
+        # the vmapped jnp aggregator is the fallback
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        from cycloneml_tpu.ops.kernels import use_fused_kernels
+        base_agg = (aggregators.binary_logistic_pallas_scaled(d, fit_intercept)
+                    if use_fused_kernels(ds.ctx)
+                    else aggregators.binary_logistic_scaled(d, fit_intercept))
+        agg = aggregators.stack_scaled_aggregator(base_agg)
         l2s = stacked_l2_scale(d, n_coef, features_std, standardize)
+        adt = compute_dtype()  # standardization vectors: accumulator tier
         loss_fn = StackedDistributedLossFunction(
             ds_stacked, agg, n_models, reg=reg_params, l2_scale=l2s,
             weight_sum=weight_sum,
-            extra_args=(jnp.asarray(inv_std.astype(xdt)),
-                        jnp.asarray(scaled_mean.astype(xdt))))
+            extra_args=(jnp.asarray(inv_std.astype(adt)),
+                        jnp.asarray(scaled_mean.astype(adt))))
 
         from cycloneml_tpu.conf import LBFGS_DEVICE_CHUNK
         chunk = int(ds.ctx.conf.get(LBFGS_DEVICE_CHUNK)) \
@@ -498,22 +517,16 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                                            "upperBoundsOnIntercepts"))
 
         rt = ds.ctx.mesh_runtime
-        from cycloneml_tpu.conf import (PALLAS_AUTO_MIN_ELEMENTS,
-                                        USE_PALLAS_KERNELS)
-        from cycloneml_tpu.ops.kernels import pallas_available
+        from cycloneml_tpu.ops.kernels import use_fused_kernels
         from cycloneml_tpu.parallel import feature_sharding as fs
         m = fs.model_parallelism(rt)
         tp_active = (not is_multinomial) and m > 1 and d % m == 0
-        pal_conf = (str(ds.ctx.conf.get(USE_PALLAS_KERNELS)).lower()
-                    if hasattr(ds.ctx, "conf") else "false")
-        # auto: the fused one-pass kernel wins on real hardware once X is
-        # HBM-scale (committed head-to-head, benchmarks/PALLAS_AB.md);
-        # below that the two paths are within relay noise and the XLA
-        # path keeps CPU tests off the slow interpreter
-        use_pallas = (not is_multinomial) and (
-            pal_conf == "true"
-            or (pal_conf == "auto" and pallas_available()
-                and ds.n_rows * d >= PALLAS_AUTO_MIN_ELEMENTS))
+        # fused Pallas kernels are the DEFAULT sweep on natively-lowered
+        # backends (usePallasKernels=auto): one VMEM-resident row pass per
+        # evaluation, bf16 blocks read at storage width with fp32 in-kernel
+        # accumulation; the XLA-fused jnp aggregator stays as the fallback
+        # (and the only path on CPU, where the interpreter is for tests)
+        use_pallas = (not is_multinomial) and use_fused_kernels(ds.ctx)
         # EVERY fit path folds standardization (and fitWithMean centering)
         # INTO the aggregator read — no standardized copy exists anywhere:
         # replicated binomial/multinomial since r4; the feature-sharded TP
@@ -559,19 +572,27 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             # model axis present: feature-shard the RAW blocks, the
             # coefficients, AND the standardization vectors (SURVEY §5.7a
             # — the path for d beyond one device's HBM; binomial only, the
-            # multinomial aggregator stays replicated for now).
-            x_tp = fs.feature_sharded_put(rt, ds.x)
+            # multinomial aggregator stays replicated for now). Narrow
+            # data-tier blocks upcast at the TP boundary
+            # (fs.accumulator_width — the engine keys optimizer state off
+            # X's dtype).
+            x_tp = fs.feature_sharded_put(rt, fs.accumulator_width(ds.x))
             loss_fn = fs.FeatureShardedLossFunction(
                 rt, x_tp, ds.y, ds.w, d, fit_intercept, l2_fn,
                 weight_sum, ctx=ds.ctx, inv_std=inv_std,
                 scaled_mean=mu_or_zero)
         else:
             import jax.numpy as jnp
-            xdt = ds.x.dtype
+            from cycloneml_tpu.dataset.instance import compute_dtype
+            # standardization vectors ride in the ACCUMULATOR tier: (d,)
+            # replicated vectors are free next to X, and the fold's
+            # corrections (inv_std∘g − μ̂·Σmult) must not round through the
+            # bf16 data tier
+            adt = compute_dtype()
             loss_fn = DistributedLossFunction(
                 ds, agg, l2_fn, weight_sum,
-                extra_args=(jnp.asarray(inv_std.astype(xdt)),
-                            jnp.asarray(mu_or_zero.astype(xdt))))
+                extra_args=(jnp.asarray(inv_std.astype(adt)),
+                            jnp.asarray(mu_or_zero.astype(adt))))
 
         if self._has_bounds():
             # box-constrained path (ref createOptimizer selects BreezeLBFGSB

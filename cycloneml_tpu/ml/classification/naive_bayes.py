@@ -67,15 +67,15 @@ class NaiveBayes(Predictor, MLWritable, MLReadable):
             # nonneg check mirrors requireNonnegativeValues (ref :must be
             # nonzero counts); done in the same pass
             def stats(x, y, w, _z):
-                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype)
+                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=w.dtype)
                 ow = onehot * w[:, None]
                 return {"feat": jnp.dot(ow.T, x, precision=hi),    # (k, d)
                         "wsum": jnp.sum(ow, axis=0),
                         "neg": jnp.sum(jnp.where(x < 0, 1.0, 0.0))}
         elif model_type == "bernoulli":
             def stats(x, y, w, _z):
-                xb = (x != 0).astype(x.dtype)
-                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype)
+                xb = (x != 0).astype(w.dtype)
+                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=w.dtype)
                 ow = onehot * w[:, None]
                 bad = jnp.sum(jnp.where(
                     jnp.logical_and(x != 0, x != 1), 1.0, 0.0))
@@ -83,13 +83,13 @@ class NaiveBayes(Predictor, MLWritable, MLReadable):
                         "wsum": jnp.sum(ow, axis=0), "neg": bad}
         else:  # gaussian
             def stats(x, y, w, _z):
-                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype)
+                onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=w.dtype)
                 ow = onehot * w[:, None]
                 return {"feat": jnp.dot(ow.T, x, precision=hi),
                         "sq": jnp.dot(ow.T, x * x, precision=hi),
                         "wsum": jnp.sum(ow, axis=0), "neg": jnp.zeros(())}
 
-        out = ds.tree_aggregate_fn(stats)(jnp.zeros((), ds.x.dtype))
+        out = ds.tree_aggregate_fn(stats)(jnp.zeros((), ds.w.dtype))
         if float(out["neg"]) > 0:
             kind = ("zero-or-one" if model_type == "bernoulli"
                     else "nonnegative")

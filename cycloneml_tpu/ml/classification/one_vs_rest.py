@@ -74,7 +74,7 @@ class OneVsRest(Estimator, _OVRParams, MLWritable, MLReadable):
         y = np.asarray(frame[label_col])
         num_classes = int(y.max()) + 1
 
-        from cycloneml_tpu.dataset.instance import compute_dtype
+        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
 
         def _configure(clf):
             clf.set("featuresCol", self.get("featuresCol"))
@@ -97,11 +97,12 @@ class OneVsRest(Estimator, _OVRParams, MLWritable, MLReadable):
                 "OneVsRest: fitting %d binary models as ONE stacked SPMD "
                 "program (effective parallelism %d)", num_classes, effective)
             clf.set("labelCol", label_col)
-            # ONE (K, n) binary label matrix in the data-tier dtype — not
-            # K fp64 host vectors (JX004 data-tier discipline); the stacked
-            # engine consumes all K rows at once
+            # ONE (K, n) binary label matrix in the DATA-tier dtype ({0, 1}
+            # is exact in bf16) — not K fp64 host vectors (JX004 data-tier
+            # discipline); the stacked engine consumes all K rows at once
             y_stack = (np.arange(num_classes)[:, None]
-                       == y[None, :]).astype(compute_dtype())
+                       == y[None, :]).astype(
+                           data_dtype(getattr(frame.ctx, "conf", None)))
             models = clf.fit_stacked(frame, y_stack)
         else:
             # serial fallback: SPMD fits stay on this thread (a >1 thread

@@ -12,16 +12,32 @@ _NORM_EPS = 1e-12
 
 
 def pairwise_sq_dists(xp, x, c, precision=None):
-    """(n, k) squared euclidean distances via one matmul; ``xp`` is np or jnp."""
-    if precision is None:
-        dot = xp.dot(x, c.T)
-    else:
-        dot = xp.dot(x, c.T, precision=precision)
-    return (xp.sum(x * x, axis=1)[:, None]
-            + xp.sum(c * c, axis=1)[None, :] - 2.0 * dot)
+    """(n, k) squared euclidean distances via one matmul; ``xp`` is np or
+    jnp. Narrow (bf16 data-tier) operands keep storage width as the dot's
+    multiplicands but ACCUMULATE in f32 (preferred_element_type) — both
+    the matmul and the norms; distances rounded at 8 mantissa bits would
+    swamp the near-tie argmins. numpy has no mixed-precision dot, so the
+    (host-side, rare) narrow case upcasts there instead."""
+    narrow = x.dtype.itemsize < 4 or c.dtype.itemsize < 4
+    if narrow and xp.__name__.startswith("numpy"):
+        x, c = x.astype(xp.float32), c.astype(xp.float32)
+        narrow = False
+    kw = {} if precision is None else {"precision": precision}
+    if narrow:
+        kw["preferred_element_type"] = xp.float32
+    dot = xp.dot(x, c.T, **kw)
+    xw = x if x.dtype == dot.dtype else x.astype(dot.dtype)
+    cw = c if c.dtype == dot.dtype else c.astype(dot.dtype)
+    return (xp.sum(xw * xw, axis=1)[:, None]
+            + xp.sum(cw * cw, axis=1)[None, :] - 2.0 * dot)
 
 
 def normalize_rows(xp, x):
-    """Rows scaled to unit L2 norm (cosine-distance preprocessing)."""
-    n = xp.sqrt(xp.sum(x * x, axis=1))[:, None]
-    return x / xp.maximum(n, _NORM_EPS)
+    """Rows scaled to unit L2 norm (cosine-distance preprocessing). Norms
+    square/reduce at accumulator width (f32) for narrow (bf16) rows —
+    same discipline as pairwise_sq_dists — and the result returns to the
+    input's storage tier (the normalized copy must not silently widen)."""
+    xw = x if x.dtype.itemsize >= 4 else x.astype(xp.float32)
+    out = xw / xp.maximum(xp.sqrt(xp.sum(xw * xw, axis=1))[:, None],
+                          _NORM_EPS)
+    return out if out.dtype == x.dtype else out.astype(x.dtype)

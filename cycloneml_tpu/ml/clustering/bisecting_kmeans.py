@@ -81,7 +81,7 @@ class BisectingKMeans(Estimator, _BKMParams, MLWritable, MLReadable):
         k = self.get("k")
         cosine = self.get("distanceMeasure") == "cosine"
         rng = np.random.RandomState(self.get("seed"))
-        dtype = ds.x.dtype
+        dtype = ds.w.dtype  # accumulator tier: X may store bf16
         hi = jax.lax.Precision.HIGHEST
 
         if cosine:
@@ -96,7 +96,7 @@ class BisectingKMeans(Estimator, _BKMParams, MLWritable, MLReadable):
         # root stats: weighted mean, row count, and cost about the mean
         def root_stats(x, y, w, center):
             s = jnp.dot(w[None, :], x, precision=hi)[0]
-            real = (w > 0).astype(x.dtype)
+            real = (w > 0).astype(w.dtype)
             d2 = jnp.sum((x - center[None, :]) ** 2, axis=1)
             return {"sum": s, "wsum": jnp.sum(w), "count": jnp.sum(real),
                     "cost": jnp.sum(w * d2)}
@@ -134,9 +134,9 @@ class BisectingKMeans(Estimator, _BKMParams, MLWritable, MLReadable):
             side = (d_right < d_left).astype(jnp.int32)            # 0/1
             cidx = jnp.where(active, 2 * sl + side, 0)
             wm = w * active.astype(w.dtype)
-            onehot = jax.nn.one_hot(cidx, cc.shape[0], dtype=x.dtype)
+            onehot = jax.nn.one_hot(cidx, cc.shape[0], dtype=w.dtype)
             onehot_w = onehot * wm[:, None]
-            real = jnp.logical_and(active, w > 0).astype(x.dtype)
+            real = jnp.logical_and(active, w > 0).astype(w.dtype)
             sums = jnp.dot(onehot_w.T, x, precision=hi)            # (2m, d)
             wsums = jnp.sum(onehot_w, axis=0)
             counts = jnp.sum(onehot * real[:, None], axis=0)       # row counts

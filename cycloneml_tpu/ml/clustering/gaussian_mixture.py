@@ -87,7 +87,7 @@ class GaussianMixture(Estimator, _GMMParams, MLWritable, MLReadable):
         import jax.numpy as jnp
 
         k, d = self.get("k"), ds.n_features
-        dtype = ds.x.dtype
+        dtype = ds.w.dtype  # accumulator tier: X may store bf16
 
         weights, means, covs = self._init_params(ds, k)
 
@@ -174,12 +174,12 @@ class GaussianMixture(Estimator, _GMMParams, MLWritable, MLReadable):
         else:
             # degenerate slices fall back to global moments (one-pass)
             def moments(x, y, w, _z):
-                real = (w > 0).astype(x.dtype)
+                real = (w > 0).astype(w.dtype)
                 return {"s1": jnp.sum(x * real[:, None], axis=0),
                         "s2": jnp.sum(x * x * real[:, None], axis=0),
                         "n": jnp.sum(real)}
 
-            mo = ds.tree_aggregate_fn(moments)(jnp.zeros((), ds.x.dtype))
+            mo = ds.tree_aggregate_fn(moments)(jnp.zeros((), ds.w.dtype))
             cnt = max(float(mo["n"]), 1.0)
             mean_all = np.asarray(mo["s1"], np.float64) / cnt
             var0 = np.maximum(np.asarray(mo["s2"], np.float64) / cnt

@@ -87,7 +87,11 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
         k = self.get("k")
         cosine = self.get("distanceMeasure") == "cosine"
-        dtype = ds.x.dtype  # metadata read, no device->host transfer
+        # centers are a replicated (k, d) vector set — they ride the
+        # ACCUMULATOR tier (f32/f64) even when X stores bf16; distances
+        # upcast X per tile inside the kernels, never in HBM
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        dtype = compute_dtype()
 
         if cosine:
             # cosine distance clusters on the unit sphere: normalize once
@@ -110,20 +114,22 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
             def lloyd_step(x, y, w, c):
                 # fused distance+argmin kernel (the (T, k) tile never
-                # leaves VMEM), then segment-sum center updates
+                # leaves VMEM; bf16 X read at storage width with f32
+                # distance accumulation), then segment-sum center updates —
+                # w stays in its accumulator dtype so the sums do too
                 best, dist = fused_kmeans_assign(x, c)
-                wv = w.astype(x.dtype)
+                wv = w
                 sums = jax.ops.segment_sum(x * wv[:, None], best,
                                            num_segments=k)
                 counts = jax.ops.segment_sum(wv, best, num_segments=k)
-                cost = jnp.sum(wv * dist.astype(x.dtype))
+                cost = jnp.sum(wv * dist.astype(wv.dtype))
                 return {"sums": sums, "counts": counts, "cost": cost}
         else:
             def lloyd_step(x, y, w, c):
                 # (b,k) squared distances via the MXU
                 d2 = pairwise_sq_dists(jnp, x, c, precision=hi)
                 assign = jnp.argmin(d2, axis=1)
-                onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * w[:, None]
+                onehot = jax.nn.one_hot(assign, k, dtype=w.dtype) * w[:, None]
                 sums = jnp.dot(onehot.T, x, precision=hi)    # (k,d) center sums
                 counts = jnp.sum(onehot, axis=0)              # (k,)
                 cost = jnp.sum(w * jnp.maximum(jnp.min(d2, axis=1), 0.0))
@@ -188,7 +194,9 @@ class KMeans(Estimator, _KMeansParams, MLWritable, MLReadable):
 
         centers = [ds.gather_rows([valid[rng.randint(n)]])[0]]
         l_factor = 2 * k
-        dtype = np.dtype(str(ds.x.dtype))
+        # candidate centers ride the accumulator tier (see _fit_dataset)
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        dtype = np.dtype(compute_dtype())
         for _ in range(self.get("initSteps")):
             c_arr = np.asarray(centers, dtype=dtype)
             d2 = collective_row_values(ds, min_d2, c_arr)  # (n_pad,)

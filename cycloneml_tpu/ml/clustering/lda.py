@@ -107,7 +107,7 @@ class LDA(Estimator, _LDAParams, MLWritable, MLReadable):
         n_docs = ds.n_rows
         tau0 = self.get("learningOffset")
         kappa = self.get("learningDecay")
-        dtype = ds.x.dtype
+        dtype = ds.w.dtype  # accumulator tier: X may store bf16
 
         rng = np.random.RandomState(self.get("seed"))
         # lambda init ~ Gamma(100, 1/100) as in Hoffman et al. / the reference
@@ -125,9 +125,9 @@ class LDA(Estimator, _LDAParams, MLWritable, MLReadable):
                     jax.random.fold_in(subsample_key,
                                        jax.lax.axis_index(DATA_AXIS)),
                     jax.lax.axis_index(REPLICA_AXIS))
-                u = jax.random.uniform(shard_key, w.shape, dtype=x.dtype)
+                u = jax.random.uniform(shard_key, w.shape, dtype=w.dtype)
                 keep = jnp.logical_and(keep, u < frac)
-            keep_f = keep.astype(x.dtype)
+            keep_f = keep.astype(w.dtype)
 
             Elogbeta = (jax.scipy.special.digamma(lam_in)
                         - jax.scipy.special.digamma(
@@ -135,7 +135,7 @@ class LDA(Estimator, _LDAParams, MLWritable, MLReadable):
             expElogbeta = jnp.exp(Elogbeta)                        # (k, V)
 
             cts = x * keep_f[:, None]                              # (b, V)
-            gamma0 = jnp.full((x.shape[0], k), 1.0, dtype=x.dtype)
+            gamma0 = jnp.full((x.shape[0], k), 1.0, dtype=w.dtype)
 
             def gamma_iter(_, gamma):
                 Elogtheta = (jax.scipy.special.digamma(gamma)

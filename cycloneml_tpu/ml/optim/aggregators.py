@@ -57,6 +57,36 @@ def _split_coef(coef, d, fit_intercept):
     return coef, jnp.zeros((), coef.dtype)
 
 
+def _narrow(dt) -> bool:
+    from cycloneml_tpu.dataset.instance import is_narrow_dtype
+    return is_narrow_dtype(dt)
+
+
+def _tier_dot(a, b, prec, acc=None):
+    """``jnp.dot`` across the data/accumulator tier boundary.
+
+    Full-width (f32/f64) operands take the pre-tier path UNCHANGED — the
+    ``cyclone.data.dtype=float32`` opt-out is bit-identical by
+    construction. When either operand is narrow (bf16/f16 data tier), the
+    other is cast DOWN to the storage width (dtype promotion would
+    otherwise upcast — and re-materialize — the whole X block) and the dot
+    accumulates into ``acc`` via ``preferred_element_type``: narrow
+    multiplicands, fp32 accumulation — the Micikevicius et al. (2018)
+    mixed-precision recipe, natively an MXU bf16×bf16→f32 matmul on TPU.
+    ``acc`` defaults to the full-width operand's dtype (the optimizer's
+    accumulator tier: f32, or f64 under x64).
+    """
+    if not (_narrow(a.dtype) or _narrow(b.dtype)):
+        return jnp.dot(a, b, precision=prec)
+    if acc is None:
+        acc = b.dtype if _narrow(a.dtype) else a.dtype
+        if _narrow(acc):
+            acc = jnp.float32
+    nt = a.dtype if _narrow(a.dtype) else b.dtype
+    return jnp.dot(a.astype(nt), b.astype(nt), precision=prec,
+                   preferred_element_type=acc)
+
+
 def binary_logistic(d: int, fit_intercept: bool = True) -> Agg:
     """Binomial logistic loss (ref BinaryLogisticBlockAggregator.scala:41).
 
@@ -74,10 +104,10 @@ def _binary_logistic(d: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        margin = jnp.dot(x, beta, precision=prec) + b0          # forward gemv:97
+        margin = _tier_dot(x, beta, prec) + b0                  # forward gemv:97
         loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
         multiplier = w * (jax.nn.sigmoid(margin) - y)          # :112 multiplier
-        g = jnp.dot(x.T, multiplier, precision=prec)            # backward gemv:130
+        g = _tier_dot(x.T, multiplier, prec)                    # backward gemv:130
         grad = jnp.concatenate([g, jnp.sum(multiplier)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
 
@@ -108,12 +138,12 @@ def _binary_logistic_scaled(d: int, fit_intercept: bool, prec) -> Agg:
     def agg(x, y, w, inv_std, scaled_mean, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
         sb = inv_std * beta
-        margin = (jnp.dot(x, sb, precision=prec)
+        margin = (_tier_dot(x, sb, prec)
                   - jnp.dot(scaled_mean, beta, precision=prec) + b0)
         loss = jnp.sum(w * (jax.nn.softplus(margin) - y * margin))
         multiplier = w * (jax.nn.sigmoid(margin) - y)
         msum = jnp.sum(multiplier)
-        g = (inv_std * jnp.dot(x.T, multiplier, precision=prec)
+        g = (inv_std * _tier_dot(x.T, multiplier, prec)
              - scaled_mean * msum)
         grad = jnp.concatenate([g, msum[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
@@ -139,7 +169,7 @@ def _multinomial_logistic(d: int, k: int, fit_intercept: bool, prec) -> Agg:
         else:
             wmat = coef.reshape(k, d)
             b = jnp.zeros((k,), coef.dtype)
-        margins = jnp.dot(x, wmat.T, precision=prec) + b        # (bsz, k)
+        margins = _tier_dot(x, wmat.T, prec) + b                # (bsz, k)
         log_z = jax.nn.logsumexp(margins, axis=1)
         y_idx = y.astype(jnp.int32)
         picked = jnp.take_along_axis(margins, y_idx[:, None], axis=1)[:, 0]
@@ -147,7 +177,7 @@ def _multinomial_logistic(d: int, k: int, fit_intercept: bool, prec) -> Agg:
         probs = jax.nn.softmax(margins, axis=1)
         onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
         mult = w[:, None] * (probs - onehot)                   # (bsz, k)
-        gw = jnp.dot(mult.T, x, precision=prec)                 # (k, d)
+        gw = _tier_dot(mult.T, x, prec)                         # (k, d)
         if fit_intercept:
             grad = jnp.concatenate([gw.reshape(-1), jnp.sum(mult, axis=0)])
         else:
@@ -181,7 +211,7 @@ def _multinomial_logistic_scaled(d: int, k: int, fit_intercept: bool,
             b = jnp.zeros((k,), coef.dtype)
         wmat_s = wmat * inv_std[None, :]
         offset = jnp.dot(wmat, scaled_mean, precision=prec)      # (k,)
-        margins = (jnp.dot(x, wmat_s.T, precision=prec)
+        margins = (_tier_dot(x, wmat_s.T, prec)
                    - offset[None, :] + b)                        # (bsz, k)
         log_z = jax.nn.logsumexp(margins, axis=1)
         y_idx = y.astype(jnp.int32)
@@ -191,7 +221,7 @@ def _multinomial_logistic_scaled(d: int, k: int, fit_intercept: bool,
         onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
         mult = w[:, None] * (probs - onehot)                     # (bsz, k)
         msum = jnp.sum(mult, axis=0)                             # (k,)
-        gw = (jnp.dot(mult.T, x, precision=prec) * inv_std[None, :]
+        gw = (_tier_dot(mult.T, x, prec) * inv_std[None, :]
               - msum[:, None] * scaled_mean[None, :])            # (k, d)
         if fit_intercept:
             grad = jnp.concatenate([gw.reshape(-1), msum])
@@ -212,12 +242,53 @@ def _least_squares(d: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        err = jnp.dot(x, beta, precision=prec) + b0 - y
+        err = _tier_dot(x, beta, prec) + b0 - y
         loss = 0.5 * jnp.sum(w * err * err)
         mult = w * err
-        g = jnp.dot(x.T, mult, precision=prec)
+        g = _tier_dot(x.T, mult, prec)
         grad = jnp.concatenate([g, jnp.sum(mult)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
+
+    return agg
+
+
+def least_squares_scaled(d: int) -> Agg:
+    """Least-squares twin of :func:`binary_logistic_scaled`: squared loss
+    over RAW feature blocks with the doubly-standardized objective folded
+    into the read. The LinearRegression l-bfgs path trains on
+    x̂ = (x−μ)/σ_x (centered only when fitting an intercept) against
+    ŷ = y/σ_y − ȳ̂; with ``sb = inv_std∘β`` the residual is
+
+      err = x·sb − (μ̂·β − ȳ̂) − y·(1/σ_y)        (μ̂ = scaled mean; the
+                                                 whole centering is a scalar
+                                                 offset outside the row pass)
+      grad_β̂ = inv_std∘(xᵀmult) − μ̂·Σmult
+
+    so neither the standardized X copy nor the scaled-y copy ever
+    materializes — the fit's HBM working set is the raw data tier itself.
+
+    Signature ``agg(x, y, w, inv_std, scaled_mean, y_pars, coef)`` with
+    ``y_pars = [1/σ_y, ȳ̂]`` riding as a replicated (2,) runtime argument
+    (program identity is dataset-generic, like inv_std/scaled_mean). Pass
+    ``scaled_mean = zeros`` and ``y_pars[1] = 0`` for the no-intercept
+    (uncentered) objective. No intercept coordinate exists: the intercept
+    is recovered in closed form ȳ − β·x̄ after optimization.
+    """
+    return _least_squares_scaled(d, matmul_precision())
+
+
+@functools.lru_cache(maxsize=None)
+def _least_squares_scaled(d: int, prec) -> Agg:
+
+    def agg(x, y, w, inv_std, scaled_mean, y_pars, coef):
+        sb = inv_std * coef
+        off = jnp.dot(scaled_mean, coef, precision=prec) - y_pars[1]
+        err = _tier_dot(x, sb, prec) - off - y * y_pars[0]
+        loss = 0.5 * jnp.sum(w * err * err)
+        mult = w * err
+        msum = jnp.sum(mult)
+        g = inv_std * _tier_dot(x.T, mult, prec) - scaled_mean * msum
+        return {"loss": loss, "grad": g, "count": jnp.sum(w)}
 
     return agg
 
@@ -233,12 +304,12 @@ def _hinge(d: int, fit_intercept: bool, prec) -> Agg:
 
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef, d, fit_intercept)
-        margin = jnp.dot(x, beta, precision=prec) + b0
+        margin = _tier_dot(x, beta, prec) + b0
         ysign = 2.0 * y - 1.0
         active = (1.0 - ysign * margin) > 0
         loss = jnp.sum(w * jnp.maximum(0.0, 1.0 - ysign * margin))
         mult = jnp.where(active, -ysign * w, 0.0)
-        g = jnp.dot(x.T, mult, precision=prec)
+        g = _tier_dot(x.T, mult, prec)
         grad = jnp.concatenate([g, jnp.sum(mult)[None]]) if fit_intercept else g
         return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
 
@@ -258,7 +329,7 @@ def _huber(d: int, fit_intercept: bool, epsilon: float, prec) -> Agg:
     def agg(x, y, w, coef):
         beta, b0 = _split_coef(coef[:-1], d, fit_intercept)
         sigma = coef[-1]
-        mu = jnp.dot(x, beta, precision=prec) + b0
+        mu = _tier_dot(x, beta, prec) + b0
         r = (y - mu) / sigma
         abs_r = jnp.abs(r)
         outlier = abs_r > epsilon
@@ -270,7 +341,7 @@ def _huber(d: int, fit_intercept: bool, epsilon: float, prec) -> Agg:
         # d/dmu and d/dsigma — matches the reference's piecewise gradients
         dmu = jnp.where(outlier, -2.0 * epsilon * jnp.sign(r), -2.0 * r)
         mult = w * dmu
-        g = jnp.dot(x.T, mult, precision=prec)
+        g = _tier_dot(x.T, mult, prec)
         dsig_i = jnp.where(outlier,
                            1.0 - epsilon * epsilon,
                            1.0 - r * r)
@@ -331,5 +402,25 @@ def _binary_logistic_pallas_scaled(d: int, fit_intercept: bool) -> Agg:
     def agg(x, y, w, inv_std, scaled_mean, coef):
         return fused_binary_logistic_scaled(
             x, y, w, inv_std, scaled_mean, coef, d, fit_intercept)
+
+    return agg
+
+
+def least_squares_pallas_scaled(d: int) -> Agg:
+    """Pallas twin of :func:`least_squares_scaled`: the residual sweep
+    (margin → err → loss/mult/grad) runs as one VMEM-resident row pass
+    (ops/kernels.fused_least_squares_scaled); standardization and the
+    label scaling are algebra outside it, so the kernel reads the raw
+    data-tier blocks exactly once per evaluation."""
+    return _least_squares_pallas_scaled(d)
+
+
+@functools.lru_cache(maxsize=None)
+def _least_squares_pallas_scaled(d: int) -> Agg:
+    from cycloneml_tpu.ops.kernels import fused_least_squares_scaled
+
+    def agg(x, y, w, inv_std, scaled_mean, y_pars, coef):
+        return fused_least_squares_scaled(
+            x, y, w, inv_std, scaled_mean, y_pars, coef, d)
 
     return agg

@@ -17,7 +17,7 @@ path here pays 1 dispatch per iteration; this path pays 1/K.
 
 Semantics match ``lbfgs.LBFGS`` (same Wolfe machine, same two-loop, same
 curvature condition sᵀy > 1e-10·yᵀy, same convergence tests) computed in
-the data tier's dtype — f64 under the CPU test config (trajectories match
+the accumulator tier's dtype — f64 under the CPU test config (trajectories match
 the host path), f32 on TPU (last-ulp drift; the convergence thresholds are
 ~1e-6 relative, within f32's resolution for these well-scaled problems).
 """
@@ -243,7 +243,10 @@ class DeviceLBFGS(LBFGS):
         import jax.numpy as jnp
 
         arrays = f._agg_call.arrays()
-        cdt = np.dtype(arrays[-1].dtype)
+        # optimizer state lives in the ACCUMULATOR tier (f32 / f64-under-
+        # x64), never the possibly-bf16 data tier X is stored in
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        cdt = np.dtype(compute_dtype())
         n = len(np.asarray(x0))
         l2_t = getattr(f.l2_reg_fn, "traceable", None) \
             if f.l2_reg_fn is not None else None
@@ -603,7 +606,8 @@ class StackedDeviceLBFGS:
             raise ValueError(
                 f"x0 stacks {K} models but the loss carries {f.n_models}")
         arrays = f._agg_call.arrays()
-        cdt = np.dtype(arrays[2].dtype)  # w — the data-tier dtype
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        cdt = np.dtype(compute_dtype())  # accumulator tier, == w's dtype
         chunk = self.chunk
         self.effective_chunk = chunk
 

@@ -60,7 +60,7 @@ def train_fm(ds: InstanceDataset, d: int, loss_type: str, factor_size: int,
             shard_key = jax.random.fold_in(
                 jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS)),
                 jax.lax.axis_index(REPLICA_AXIS))
-            u = jax.random.uniform(shard_key, w.shape, dtype=x.dtype)
+            u = jax.random.uniform(shard_key, w.shape, dtype=w.dtype)
             keep = jnp.logical_and(keep, u < frac)
         wm = w * keep.astype(w.dtype)
 
@@ -90,7 +90,7 @@ def train_fm(ds: InstanceDataset, d: int, loss_type: str, factor_size: int,
     else:  # gd
         opt = optax.sgd(step_size)
 
-    dtype = ds.x.dtype
+    dtype = ds.w.dtype  # accumulator tier: X may store bf16
     opt_state = opt.init(jnp.asarray(coef, dtype))
     coef_j = jnp.asarray(coef, dtype)
 

@@ -122,9 +122,12 @@ class DistributedLossFunction:
         # these steps — fire it here, once per fused dispatch
         faults.inject("collectives.step")
         arrays = self._agg_call.arrays()
-        # line-search arithmetic follows the data tier's dtype: f32 on TPU,
-        # f64 under x64 tests (where it then matches the host path exactly)
-        cdt = np.dtype(arrays[-1].dtype)
+        # line-search arithmetic lives in the ACCUMULATOR tier — f32 on
+        # TPU, f64 under x64 tests (matching the host path exactly) — never
+        # the (possibly bf16) data tier: optimizer state at storage width
+        # would destroy the Wolfe tests' resolution
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        cdt = np.dtype(compute_dtype())
         l2_t = getattr(self.l2_reg_fn, "traceable", None) \
             if self.l2_reg_fn is not None else None
         # the cache is module-level and keyed on PROGRAM identity (the cached
@@ -457,7 +460,10 @@ def _get_scale_rows():
     global _scale_rows
     if _scale_rows is None:
         import jax
-        _scale_rows = jax.jit(lambda x, s: x * s)
+        # .astype(x.dtype): the standardized copy stays IN the data tier —
+        # a bf16 block scaled by an f32/f64 vector would otherwise promote
+        # and re-materialize X at 2-4x its storage width
+        _scale_rows = jax.jit(lambda x, s: (x * s).astype(x.dtype))
     return _scale_rows
 
 
@@ -468,7 +474,8 @@ def _get_center_scale_rows():
     global _center_scale_rows
     if _center_scale_rows is None:
         import jax
-        _center_scale_rows = jax.jit(lambda x, s, mu: (x - mu) * s)
+        _center_scale_rows = jax.jit(
+            lambda x, s, mu: ((x - mu) * s).astype(x.dtype))
     return _center_scale_rows
 
 

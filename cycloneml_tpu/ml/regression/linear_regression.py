@@ -183,34 +183,34 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         x_mean, x_std = stats.mean, stats.std
         inv_std = np.where(x_std > 0, 1.0 / np.where(x_std > 0, x_std, 1.0), 0.0)
 
-        # scale features and label on device; center via the aggregator using
-        # the offset trick below (padding rows keep w=0 so centering is safe)
-        mu = jnp.asarray(x_mean * inv_std)  # mean of standardized features
-        scaled_x = jax.jit(lambda x, s: x * s)(ds.x, jnp.asarray(inv_std))
-        scaled_y = jax.jit(lambda y: y * (1.0 / y_std))(ds.y)
-        ds_std = InstanceDataset(ds.ctx, scaled_x, scaled_y, ds.w, ds.n_rows, d)
-        y_mean_std = y_mean / y_std
-
-        if fit_intercept:
-            def agg(x, y, w, coef):
-                err = jnp.dot(x - mu[None, :], coef,
-                              precision=jax.lax.Precision.HIGHEST) - (y - y_mean_std)
-                loss = 0.5 * jnp.sum(w * err * err)  # w=0 padding is neutral
-                mult = w * err
-                grad = jnp.dot((x - mu[None, :]).T, mult,
-                               precision=jax.lax.Precision.HIGHEST)
-                return {"loss": loss, "grad": grad, "count": jnp.sum(w)}
-        else:
-            base = aggregators.least_squares(d, fit_intercept=False)
-
-            def agg(x, y, w, coef):
-                return base(x, y, w, coef)
+        # the doubly-standardized objective folds INTO the aggregator read
+        # (aggregators.least_squares_scaled): err = x·(inv_std∘β) −
+        # (μ̂·β − ȳ̂) − y/σ_y, grad unscales by inv_std — algebraically the
+        # aggregation over (x̂−μ̂, ŷ−ȳ̂) without EVER materializing the
+        # standardized X copy or the scaled-y vector (pre-tier this path
+        # re-wrote both, a full read+write X sweep and 2x the HBM working
+        # set per fit). Raw data-tier blocks (bf16 by default) are read at
+        # storage width with fp32 accumulation inside the kernel; the
+        # fused Pallas kernel is the default sweep on native backends.
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        from cycloneml_tpu.ops.kernels import use_fused_kernels
+        adt = compute_dtype()
+        scaled_mean = (x_mean * inv_std) if fit_intercept else np.zeros(d)
+        y_mean_std = (y_mean / y_std) if fit_intercept else 0.0
+        y_pars = np.array([1.0 / y_std, y_mean_std])
+        agg = (aggregators.least_squares_pallas_scaled(d)
+               if use_fused_kernels(ds.ctx)
+               else aggregators.least_squares_scaled(d))
 
         l2 = (1.0 - alpha) * reg
         l1 = alpha * reg
         l2_fn = l2_regularization(l2, d, False, features_std=x_std,
                                   standardize=standardize) if l2 > 0 else None
-        loss_fn = DistributedLossFunction(ds_std, agg, l2_fn, stats.weight_sum)
+        loss_fn = DistributedLossFunction(
+            ds, agg, l2_fn, stats.weight_sum,
+            extra_args=(jnp.asarray(inv_std.astype(adt)),
+                        jnp.asarray(scaled_mean.astype(adt)),
+                        jnp.asarray(y_pars.astype(adt))))
 
         if l1 > 0:
             l1_vec = np.full(d, l1)

@@ -69,6 +69,11 @@ def _moments(x, y, w):
     import jax.numpy as jnp
     wcol = w[:, None]
     present = (wcol > 0)
+    # w carries the ACCUMULATOR dtype (f32/f64 — dataset.blockify keeps
+    # y/w at full width even when X stores bf16), so every sum below
+    # promotes to it; counts accumulated in a bf16 X's dtype would stop
+    # being exact integers at 256 (8 mantissa bits)
+    acc = w.dtype
     s1 = jnp.sum(wcol * x, axis=0)
     s2 = jnp.sum(wcol * x * x, axis=0)
     neg_inf = jnp.asarray(-jnp.inf, x.dtype)
@@ -78,8 +83,8 @@ def _moments(x, y, w):
         "s2": s2,
         "w": jnp.sum(w),
         "w2": jnp.sum(w * w),
-        "cnt": jnp.sum(present.astype(x.dtype)),
-        "nnz": jnp.sum(jnp.where(present & (x != 0), 1.0, 0.0), axis=0),
+        "cnt": jnp.sum(present.astype(acc)),
+        "nnz": jnp.sum((present & (x != 0)).astype(acc), axis=0),
         "mx": jnp.max(jnp.where(present, x, neg_inf), axis=0),
         "mn": jnp.min(jnp.where(present, x, pos_inf), axis=0),
         "l1": jnp.sum(wcol * jnp.abs(x), axis=0),

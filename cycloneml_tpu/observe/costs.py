@@ -53,7 +53,7 @@ __all__ = [
     "program_id", "analyze", "ensure", "lookup", "snapshot", "clear",
     "analyze_call_count", "note_execution", "check_budget", "guard_armed",
     "select_chunk", "backend_peaks", "device_memory_limit",
-    "memory_stats_available", "register_memory_gauges",
+    "memory_stats_available", "register_memory_gauges", "sweep_cost",
 ]
 
 
@@ -270,6 +270,28 @@ def note_execution(tr, pid: str) -> None:
     sample = sample_memory()
     if sample is not None:
         tr.counter("hbm.bytes_in_use", sample)
+
+
+def sweep_cost(call, *extras, name: str = "sweep") -> ProgramCost:
+    """XLA's accounting for ONE optimizer sweep — the canonical
+    ``bytes_per_sweep`` measurement (bench.py, ``make bench-bytes`` and the
+    tier-1 byte-regression test all read this one implementation).
+
+    ``call`` is a ``tree_aggregate_fn`` call object (``.compiled`` +
+    ``.arrays()``); ``extras`` are the replicated arguments the aggregator
+    takes after the sharded arrays (standardization vectors, coefficients).
+    Lower-only: the program is ANALYZED at its operands' avals, never
+    executed — cheap enough for CI, exact enough to be ground truth
+    (``bytes_accessed`` is per partition; ``bytes_accessed_total`` is the
+    mesh-wide sweep). Explicit calls count toward :func:`analyze_call_count`
+    — the zero-cost-when-untraced discipline binds the instrumentation
+    sites, not deliberate measurement."""
+    compiled = getattr(call, "compiled", call)
+    # the program cache hands back the _instrument_dispatch wrapper; the
+    # raw jitted program (the thing with .lower) rides its __wrapped__
+    compiled = getattr(compiled, "__wrapped__", compiled)
+    arrays = call.arrays() if hasattr(call, "arrays") else ()
+    return analyze(compiled, (*arrays, *extras), name=name)
 
 
 # -- live device-memory telemetry ----------------------------------------------

@@ -8,8 +8,13 @@ in VMEM across a row-tile grid, so HBM sees each instance block exactly once
 per L-BFGS evaluation instead of once per op.
 """
 
-from cycloneml_tpu.ops.kernels import (fused_binary_logistic, fused_gramian,
-                                       fused_kmeans_assign, pallas_available)
+from cycloneml_tpu.ops.kernels import (fused_binary_logistic,
+                                       fused_binary_logistic_scaled,
+                                       fused_gramian, fused_kmeans_assign,
+                                       fused_least_squares_scaled,
+                                       pallas_available, use_fused_kernels)
 
-__all__ = ["fused_binary_logistic", "fused_gramian", "fused_kmeans_assign",
-           "pallas_available"]
+__all__ = ["fused_binary_logistic", "fused_binary_logistic_scaled",
+           "fused_gramian", "fused_kmeans_assign",
+           "fused_least_squares_scaled", "pallas_available",
+           "use_fused_kernels"]

@@ -48,6 +48,39 @@ def pallas_available() -> bool:
         return False
 
 
+def use_fused_kernels(ctx) -> bool:
+    """Whether the eligible dense sweeps route through the fused Pallas
+    kernels: ``cyclone.ml.usePallasKernels`` 'auto' (default) says yes on
+    natively-lowered backends (TPU) — the fused kernels ARE the default
+    sweep there — and no elsewhere (the interpreter exists for tests, not
+    speed); 'true'/'false' force one path everywhere."""
+    try:
+        from cycloneml_tpu.conf import USE_PALLAS_KERNELS
+        conf = getattr(ctx, "conf", None)
+        mode = (str(conf.get(USE_PALLAS_KERNELS)).lower()
+                if conf is not None else "auto")
+    except Exception:
+        mode = "auto"
+    if mode == "true":
+        return True
+    if mode == "false":
+        return False
+    return pallas_available()
+
+
+def _storage_width(x):
+    """Keep narrow (bf16/f16) DATA-tier blocks at storage width — the
+    whole point of the tier is that HBM sees 2 bytes per element — and
+    cast full-width inputs to the kernels' f32 accumulator dtype. The
+    kernels upcast narrow tiles to f32 INSIDE VMEM (a vector convert per
+    tile, never an HBM materialization)."""
+    from cycloneml_tpu.dataset.instance import is_narrow_dtype
+    x = jnp.asarray(x)
+    if is_narrow_dtype(x.dtype):
+        return x
+    return x.astype(jnp.float32)
+
+
 def _pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -94,11 +127,14 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
                           interpret: Optional[bool] = None,
                           row_tile: int = ROW_TILE) -> Dict[str, jnp.ndarray]:
     """Drop-in for the ``aggregators.binary_logistic`` block math: one pass
-    over HBM computing {loss, grad, count} sums for the shard."""
+    over HBM computing {loss, grad, count} sums for the shard. Narrow
+    (bf16) data-tier blocks are read at storage width and upcast to the
+    f32 accumulator per VMEM tile — half the HBM traffic of an f32 sweep,
+    no wide X copy anywhere."""
     if interpret is None:
         interpret = not pallas_available()
     dtype = jnp.float32
-    x = jnp.asarray(x, dtype)
+    x = _storage_width(x)
     y = jnp.asarray(y, dtype)
     w = jnp.asarray(w, dtype)
     coef = jnp.asarray(coef, dtype)
@@ -109,10 +145,10 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
     beta_p = jnp.pad(beta, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
 
-    kernel = functools.partial(_run_logistic, row_tile=row_tile, d_pad=d_pad,
-                               grid=grid, interpret=interpret)
+    kernel = functools.partial(_run_glm, kind="logistic", row_tile=row_tile,
+                               d_pad=d_pad, grid=grid, interpret=interpret)
     loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
-                                 beta_p, b0)
+                                 beta_p, b0, jnp.zeros((), dtype))
     g = grad_row[0, :d]
     if fit_intercept:
         grad = jnp.concatenate([g, aux[0, 0][None]])
@@ -142,7 +178,7 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
     if interpret is None:
         interpret = not pallas_available()
     dtype = jnp.float32
-    x = jnp.asarray(x, dtype)
+    x = _storage_width(x)
     y = jnp.asarray(y, dtype)
     w = jnp.asarray(w, dtype)
     coef = jnp.asarray(coef, dtype)
@@ -156,10 +192,10 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
     x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
     beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
-    kernel = functools.partial(_run_logistic, row_tile=row_tile, d_pad=d_pad,
-                               grid=grid, interpret=interpret)
+    kernel = functools.partial(_run_glm, kind="logistic", row_tile=row_tile,
+                               d_pad=d_pad, grid=grid, interpret=interpret)
     loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
-                                 beta_p, off)
+                                 beta_p, off, jnp.zeros((), dtype))
     msum = aux[0, 0]
     g = inv_std * grad_row[0, :d] - scaled_mean * msum
     if fit_intercept:
@@ -169,8 +205,57 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
     return {"loss": loss[0, 0], "grad": grad, "count": aux[0, 1]}
 
 
-def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
-    def kern(b0_ref, x_ref, y_ref, w_ref, beta_ref,
+def fused_least_squares_scaled(x, y, w, inv_std, scaled_mean, y_pars, coef,
+                               d: int, interpret: Optional[bool] = None,
+                               row_tile: int = ROW_TILE
+                               ) -> Dict[str, jnp.ndarray]:
+    """Fused least-squares loss/grad sweep — the kernel twin of
+    ``aggregators.least_squares_scaled`` (the LinearRegression l-bfgs
+    objective). The kernel reads RAW data-tier rows once (margin → residual
+    → loss/multiplier/grad in one VMEM-resident pass); the doubly-
+    standardized objective is algebra OUTSIDE the row pass:
+
+      margin = x·(inv_std∘β) − (scaled_mean·β − ȳ̂)   (β/offset slots)
+      err    = margin − y·(1/σ_y)                      (ys scalar slot)
+      grad_β̂ = inv_std∘(Σ mult·x) − scaled_mean·Σmult
+
+    ``y_pars = [1/σ_y, ȳ̂]``; no intercept coordinate exists (recovered in
+    closed form by the caller). Same Kahan-compensated grid accumulation
+    as the logistic kernel."""
+    if interpret is None:
+        interpret = not pallas_available()
+    dtype = jnp.float32
+    x = _storage_width(x)
+    y = jnp.asarray(y, dtype)
+    w = jnp.asarray(w, dtype)
+    coef = jnp.asarray(coef, dtype)
+    inv_std = jnp.asarray(inv_std, dtype)
+    scaled_mean = jnp.asarray(scaled_mean, dtype)
+    y_pars = jnp.asarray(y_pars, dtype)
+    sb = inv_std * coef
+    off = y_pars[1] - jnp.dot(scaled_mean, coef)  # rides the b0 slot
+
+    x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
+    beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
+    grid = (n_pad // row_tile,)
+    kernel = functools.partial(_run_glm, kind="squared", row_tile=row_tile,
+                               d_pad=d_pad, grid=grid, interpret=interpret)
+    loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
+                                 beta_p, off, y_pars[0])
+    msum = aux[0, 0]
+    g = inv_std * grad_row[0, :d] - scaled_mean * msum
+    return {"loss": loss[0, 0], "grad": g, "count": aux[0, 1]}
+
+
+def _run_glm(x, y, w, beta_p, b0, ys, *, kind, row_tile, d_pad, grid,
+             interpret):
+    """Shared one-pass GLM row sweep: margin → per-row loss/multiplier →
+    grad, with ``kind`` selecting the link ("logistic" softplus/sigmoid,
+    "squared" residual). ``ys`` is the label scale (squared only; the
+    logistic path carries a zero). X tiles arrive at STORAGE width (bf16
+    when the data tier is narrow) and upcast to the f32 accumulator in
+    VMEM — the bytes HBM sees per sweep are exactly the tier's."""
+    def kern(b0_ref, ys_ref, x_ref, y_ref, w_ref, beta_ref,
              loss_ref, grad_ref, aux_ref, closs_ref, cgrad_ref, caux_ref):
         i = pl.program_id(0)
 
@@ -184,7 +269,9 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
             cgrad_ref[:] = jnp.zeros_like(cgrad_ref)
             caux_ref[:] = jnp.zeros_like(caux_ref)
 
-        xv = x_ref[:]
+        # fp32 accumulator tier from here on: the convert is a VPU op on a
+        # VMEM-resident tile, not an HBM materialization
+        xv = x_ref[:].astype(jnp.float32)
         yv = y_ref[:]          # (T, 1) — Mosaic rejects 1-D blocks that
         wv = w_ref[:]          # don't align to the T(1024) XLA layout
         # matvecs with a width-1 output don't lower to the MXU (Mosaic:
@@ -192,9 +279,14 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
         # the VPU instead — the pass is HBM-bound, not FLOP-bound
         margin = jnp.sum(xv * beta_ref[:], axis=1,
                          keepdims=True) + b0_ref[0, 0]       # (T, 1)
-        mult = wv * (jax.nn.sigmoid(margin) - yv)
-        v_loss = jnp.sum(wv * (jax.nn.softplus(margin)
-                               - yv * margin)).reshape(1, 1)
+        if kind == "logistic":
+            mult = wv * (jax.nn.sigmoid(margin) - yv)
+            v_loss = jnp.sum(wv * (jax.nn.softplus(margin)
+                                   - yv * margin)).reshape(1, 1)
+        else:  # squared (least-squares residual)
+            err = margin - ys_ref[0, 0] * yv
+            mult = wv * err
+            v_loss = (0.5 * jnp.sum(wv * err * err)).reshape(1, 1)
         v_aux = jnp.concatenate(
             [jnp.sum(mult)[None], jnp.sum(wv)[None]]).reshape(1, 2)
         v_grad = jnp.sum(mult * xv, axis=0, keepdims=True)
@@ -217,7 +309,8 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # b0
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # b0 / -offset
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # label scale
             pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
             pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
@@ -240,7 +333,7 @@ def _run_logistic(x, y, w, beta_p, b0, *, row_tile, d_pad, grid, interpret):
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
         ],
         interpret=interpret,
-    )(b0.reshape(1, 1), x, y, w, beta_p)
+    )(b0.reshape(1, 1), ys.reshape(1, 1), x, y, w, beta_p)
     return outs[:3]
 
 
@@ -250,10 +343,13 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
                         row_tile: int = ROW_TILE):
     """Nearest-center assignment: returns (best_idx (n,), min_dist² (n,)).
     Fuses ‖x‖² − 2x·cᵀ + ‖c‖² with the argmin so the (T, k) distance tile
-    never leaves VMEM (ref: DistanceMeasure.findClosest:123)."""
+    never leaves VMEM (ref: DistanceMeasure.findClosest:123). bf16 point
+    blocks stay at storage width in HBM — the tile upcasts to f32 in VMEM
+    for the distance accumulation, so narrowing the tier no longer costs a
+    full-X fp32 materialization per Lloyd step."""
     if interpret is None:
         interpret = not pallas_available()
-    x = jnp.asarray(x, jnp.float32)
+    x = _storage_width(x)
     centers = jnp.asarray(centers, jnp.float32)
     n, d = x.shape
     k = centers.shape[0]
@@ -269,7 +365,7 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
          jnp.full((k_pad - k,), jnp.inf, jnp.float32)]).reshape(1, k_pad)
 
     def kern(x_ref, c_ref, cn_ref, best_ref, dist_ref):
-        xv = x_ref[:]                                          # (T, d_pad)
+        xv = x_ref[:].astype(jnp.float32)                      # (T, d_pad)
         # HIGHEST = multi-pass f32 on the MXU; default bf16 multiplies lose
         # near-tie argmins at ~1e-4 relative distance (ref computes in f64)
         prod = jnp.dot(xv, c_ref[:].T,
@@ -303,37 +399,46 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
 
 # -- fused Gramian --------------------------------------------------------------
 
-def fused_gramian(x, interpret: Optional[bool] = None,
+def fused_gramian(x, w=None, interpret: Optional[bool] = None,
                   row_tile: int = ROW_TILE):
     """XᵀX over row tiles, accumulated in a revisited VMEM block (ref:
     RowMatrix.computeGramianMatrix:130 — spr rank-1 updates become one MXU
-    matmul per tile)."""
+    matmul per tile). bf16 blocks are read at storage width and upcast per
+    VMEM tile into the f32 accumulator. ``w`` (optional per-row weights)
+    masks padding/invalid rows by presence (w > 0) INSIDE the kernel — the
+    jnp path's ``x * (w > 0)`` row mask without the masked X copy."""
     if interpret is None:
         interpret = not pallas_available()
-    x = jnp.asarray(x, jnp.float32)
+    x = _storage_width(x)
     n, d = x.shape
+    if w is None:
+        w = jnp.ones((n,), jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
     row_tile = _auto_row_tile(n, row_tile)
     n_pad = _pad_to(max(n, row_tile), row_tile)
     d_pad = _pad_to(d, LANE)
     x_p = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
+    w_p = jnp.pad(w, (0, n_pad - n)).reshape(-1, 1)
 
-    def kern(x_ref, out_ref):
+    def kern(x_ref, w_ref, out_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        xv = x_ref[:]
+        xv = x_ref[:].astype(jnp.float32)
+        xv = xv * (w_ref[:] > 0).astype(jnp.float32)
         out_ref[:] += jnp.dot(xv.T, xv, preferred_element_type=jnp.float32,
                               precision=jax.lax.Precision.HIGHEST)
 
     g = pl.pallas_call(
         kern,
         grid=(n_pad // row_tile,),
-        in_specs=[pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0))],
+        in_specs=[pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
+                  pl.BlockSpec((row_tile, 1), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((d_pad, d_pad), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32),
         interpret=interpret,
-    )(x_p)
+    )(x_p, w_p)
     return g[:d, :d]

@@ -26,6 +26,7 @@ coefficient broadcast (RDDLossFunction.scala:56).
 
 from __future__ import annotations
 
+import functools as _functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -42,6 +43,25 @@ from cycloneml_tpu.parallel.collectives import (BoundedProgramCache,
 _program_cache = BoundedProgramCache(64)
 _cache_put = _program_cache.put
 _cache_get = _program_cache.get
+
+
+@_functools.lru_cache(maxsize=None)
+def _upcast_program(dt):
+    import jax
+    return jax.jit(lambda a: a.astype(dt))
+
+
+def accumulator_width(x):
+    """Upcast a narrow (bf16 data-tier) block to the accumulator dtype at
+    the TP boundary. The feature-sharded engine keys its coefficient/
+    optimizer dtype off X's dtype and re-materializes X into the
+    feature-sharded layout anyway, so the upcast costs no extra sweep
+    class; narrowing the TP tier itself is future work. The jitted upcast
+    is cached per dtype — a fresh jit per call would retrace every fit."""
+    from cycloneml_tpu.dataset.instance import compute_dtype, is_narrow_dtype
+    if not is_narrow_dtype(x.dtype):
+        return x
+    return _upcast_program(np.dtype(compute_dtype()))(x)
 
 
 def model_parallelism(runtime: MeshRuntime) -> int:

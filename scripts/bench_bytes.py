@@ -1,0 +1,99 @@
+"""bench-bytes: the sweep-byte check, standalone.
+
+The executable form of the mixed-precision acceptance contract
+(docs/mixed-precision.md): the bf16 data tier must actually move fewer
+bytes per optimizer sweep, measured by XLA's own accounting
+(``observe/costs.sweep_cost`` — the same rollup bench.py and the tier-1
+regression test read), not inferred from dtype widths.
+
+1. build the SAME (n, d) dataset once per tier (float32, then bfloat16),
+2. lower the binomial logistic sweep program at each tier (nothing
+   executes — this is compile-time ground truth, CI-cheap),
+3. report ``{fp32_bytes, bf16_bytes, ratio}`` as one JSON line and exit
+   non-zero unless the bf16 sweep accesses < 60% of the fp32 sweep's
+   bytes (the ISSUE-6 acceptance threshold).
+
+Run via ``make bench-bytes``. Shapes default to n=4096, d=256 (wide
+enough that X dominates the (n,)-vector temporaries); override with
+BENCH_BYTES_N / BENCH_BYTES_D.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+THRESHOLD = 0.60
+
+
+def sweep_bytes(ctx, x, y, tier: str):
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.observe import costs
+
+    ctx.conf.set("cyclone.data.dtype", tier)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    d = ds.n_features
+    adt = compute_dtype()
+    cost = costs.sweep_cost(
+        ds.tree_aggregate_fn(aggregators.binary_logistic_scaled(d, True)),
+        jnp.ones(d, adt), jnp.zeros(d, adt), jnp.zeros(d + 1, adt),
+        name=f"bench_bytes.{tier}")
+    return cost.bytes_accessed_total, str(ds.x.dtype)
+
+
+def main() -> int:
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+
+    n = int(os.environ.get("BENCH_BYTES_N", 4096))
+    d = int(os.environ.get("BENCH_BYTES_D", 256))
+    master = os.environ.get("CYCLONE_MASTER", "local-mesh[8]")
+    ctx = CycloneContext(CycloneConf()
+                         .set("cyclone.master", master)
+                         .set("cyclone.app.name", "bench-bytes"))
+    try:
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, d)
+        y = (rng.rand(n) > 0.5).astype(np.float64)
+        fp32_bytes, fp32_dt = sweep_bytes(ctx, x, y, "float32")
+        bf16_bytes, bf16_dt = sweep_bytes(ctx, x, y, "bfloat16")
+    finally:
+        ctx.conf.set("cyclone.data.dtype", "auto")
+        ctx.stop()
+    if not fp32_bytes or not bf16_bytes:
+        print(json.dumps({"metric": "sweep_bytes", "error":
+                          "cost_analysis unavailable on this backend"}))
+        return 1
+    ratio = bf16_bytes / fp32_bytes
+    ok = ratio < THRESHOLD
+    print(f"info: fp32 sweep ({fp32_dt}) {fp32_bytes / 1e6:.2f} MB vs "
+          f"bf16 sweep ({bf16_dt}) {bf16_bytes / 1e6:.2f} MB — "
+          f"ratio {ratio:.3f} (threshold {THRESHOLD})", file=sys.stderr)
+    print(json.dumps({
+        "metric": "sweep_bytes_ratio",
+        "value": round(ratio, 4),
+        "unit": "bf16/fp32 bytes-accessed",
+        "n": n, "d": d,
+        "fp32_bytes": fp32_bytes,
+        "bf16_bytes": bf16_bytes,
+        "threshold": THRESHOLD,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,3 +17,15 @@ def f64_string_dtype(x):
 @jax.jit
 def f64_cast_call(x):
     return jnp.float64(1.5) * x                      # JX004
+
+
+# the OTHER direction of the tier boundary: bf16 storage is legal, but a
+# psum operand at storage width accumulates in 8 mantissa bits mesh-wide
+@jax.jit
+def narrow_psum_astype(x):
+    return jax.lax.psum(x.astype(jnp.bfloat16), "data")        # JX004
+
+
+@jax.jit
+def narrow_psum_asarray(x):
+    return jax.lax.psum(jnp.asarray(x, dtype="bfloat16"), "data")  # JX004

@@ -21,3 +21,17 @@ def dtype_from_data(x):
 def host_readback(out):
     # np.float64 on the HOST side of the boundary is idiomatic
     return np.asarray(out, dtype=np.float64)
+
+
+@jax.jit
+def bf16_storage_is_legal(x):
+    # narrow STORAGE is the data tier's contract (cyclone.data.dtype);
+    # only narrow ACCUMULATION across the mesh is the hazard
+    return jnp.zeros(x.shape, dtype=jnp.bfloat16) + x.astype(jnp.bfloat16)
+
+
+@jax.jit
+def fp32_accumulated_psum(x):
+    # the tier ends at the kernel: upcast BEFORE the collective
+    acc = jnp.sum(x.astype(jnp.float32))
+    return jax.lax.psum(acc, "data")

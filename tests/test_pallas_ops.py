@@ -8,8 +8,10 @@ themselves tested against sklearn/scipy golden numbers elsewhere.
 import numpy as np
 import pytest
 
-from cycloneml_tpu.ops import (fused_binary_logistic, fused_gramian,
-                               fused_kmeans_assign)
+from cycloneml_tpu.ops import (fused_binary_logistic,
+                               fused_binary_logistic_scaled, fused_gramian,
+                               fused_kmeans_assign,
+                               fused_least_squares_scaled)
 from cycloneml_tpu.ml.optim import aggregators
 
 
@@ -90,6 +92,115 @@ def test_fused_gramian(ctx):
     np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-3)
     # symmetry is exact, not approximate
     np.testing.assert_array_equal(np.asarray(g), np.asarray(g).T)
+
+
+def test_fused_gramian_weight_mask(ctx):
+    """w masks rows by presence INSIDE the kernel — the jnp path's
+    x * (w > 0) row mask without the masked X copy."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(120, 11)
+    w = np.ones(120)
+    w[60:] = 0.0  # masked rows must contribute nothing
+    g = fused_gramian(x, w=w, interpret=True, row_tile=64)
+    ref = x[:60].T @ x[:60]
+    np.testing.assert_allclose(np.asarray(g), ref, rtol=1e-4, atol=1e-3)
+
+
+# -- bf16 data tier: storage-width reads, fp32 in-kernel accumulation --------
+
+def _bf16(a):
+    import ml_dtypes
+    return np.asarray(a, dtype=ml_dtypes.bfloat16)
+
+
+def test_fused_logistic_bf16_inputs(data, ctx):
+    """bf16 X stays at storage width through the kernel (no fp32 X
+    materialization); accumulation is f32, so parity with the f32
+    aggregator over the SAME bf16-rounded values is kernel-tight."""
+    x, y, w, = data
+    d = x.shape[1]
+    rng = np.random.RandomState(0)
+    coef = rng.randn(d + 1)
+    xbf = _bf16(x)
+    ref = aggregators.binary_logistic(d, True)(
+        np.asarray(xbf, np.float32), np.asarray(y, np.float32),
+        np.asarray(w, np.float32), np.asarray(coef, np.float32))
+    got = fused_binary_logistic(xbf, y, w, coef, d, True,
+                                interpret=True, row_tile=128)
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=5e-3, atol=5e-3)
+
+
+def test_fused_logistic_scaled_bf16_inputs(data, ctx):
+    x, y, w = data
+    d = x.shape[1]
+    rng = np.random.RandomState(2)
+    coef = rng.randn(d + 1)
+    inv_std = rng.rand(d) + 0.5
+    mu = rng.randn(d)
+    xbf = _bf16(x)
+    ref = aggregators.binary_logistic_scaled(d, True)(
+        np.asarray(xbf, np.float32), np.asarray(y, np.float32),
+        np.asarray(w, np.float32), np.asarray(inv_std, np.float32),
+        np.asarray(mu, np.float32), np.asarray(coef, np.float32))
+    got = fused_binary_logistic_scaled(xbf, y, w, inv_std, mu, coef, d, True,
+                                       interpret=True, row_tile=128)
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("narrow", [False, True])
+def test_fused_least_squares_scaled_matches_aggregator(data, narrow, ctx):
+    x, y, w = data
+    d = x.shape[1]
+    rng = np.random.RandomState(6)
+    coef = rng.randn(d)
+    inv_std = rng.rand(d) + 0.5
+    mu = rng.randn(d)
+    y_pars = np.array([1.7, 0.3])  # [1/sigma_y, scaled y mean]
+    xin = _bf16(x) if narrow else x
+    xref = np.asarray(xin, np.float32)
+    ref = aggregators.least_squares_scaled(d)(
+        xref, np.asarray(y, np.float32), np.asarray(w, np.float32),
+        np.asarray(inv_std, np.float32), np.asarray(mu, np.float32),
+        np.asarray(y_pars, np.float32), np.asarray(coef, np.float32))
+    got = fused_least_squares_scaled(xin, y, w, inv_std, mu, y_pars, coef, d,
+                                     interpret=True, row_tile=128)
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(float(got["count"]), float(ref["count"]),
+                               rtol=1e-6)
+
+
+def test_fused_kmeans_assign_bf16_points(ctx):
+    """bf16 points with f32 distance accumulation: assignments match the
+    f64 reference computed over the SAME bf16-rounded values (the tier
+    rounds the data once; the kernel must not round the accumulation)."""
+    rng = np.random.RandomState(9)
+    xbf = _bf16(rng.randn(300, 17))
+    centers = rng.randn(5, 17)
+    best, dist = fused_kmeans_assign(xbf, centers, interpret=True,
+                                     row_tile=128)
+    xf = np.asarray(xbf, np.float64)
+    d2 = ((xf[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(best), d2.argmin(1))
+    np.testing.assert_allclose(np.asarray(dist), d2.min(1), rtol=1e-2,
+                               atol=1e-2)
+
+
+def test_fused_gramian_bf16(ctx):
+    rng = np.random.RandomState(10)
+    xbf = _bf16(rng.randn(256, 13))
+    g = fused_gramian(xbf, interpret=True, row_tile=128)
+    xf = np.asarray(xbf, np.float64)
+    np.testing.assert_allclose(np.asarray(g), xf.T @ xf, rtol=1e-3,
+                               atol=1e-2)
 
 
 def test_estimators_run_on_pallas_kernels(ctx):
