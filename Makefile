@@ -1,15 +1,30 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-json test chaos obs-demo bench bench-bytes
+.PHONY: lint lint-fast lint-json lint-sarif test chaos obs-demo bench \
+	bench-bytes
 
+# the full interprocedural pass (JX001-JX010); fails on any finding not
+# grandfathered in baseline.json (which a PR may shrink, never grow)
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
+	    --baseline cycloneml_tpu/analysis/baseline.json
+
+# incremental gate for the edit loop: full call-graph facts, but checks
+# and reports only files changed per `git diff` plus their (transitive)
+# callers' modules (parse cache reused)
+lint-fast:
+	python -m cycloneml_tpu.analysis --changed \
 	    --baseline cycloneml_tpu/analysis/baseline.json
 
 lint-json:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
 	    --baseline cycloneml_tpu/analysis/baseline.json --json
+
+# SARIF 2.1.0 for CI/code-review inline rendering
+lint-sarif:
+	python -m cycloneml_tpu.analysis cycloneml_tpu \
+	    --baseline cycloneml_tpu/analysis/baseline.json --sarif
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -3,13 +3,26 @@
 ::
 
     python -m cycloneml_tpu.analysis <paths...> [options]
+    python -m cycloneml_tpu.analysis --changed          # incremental mode
 
 Options:
     --json                 machine-readable output
+    --sarif                SARIF 2.1.0 output (CI/code-review inline
+                           rendering)
     --baseline FILE        subtract grandfathered findings (exit 0 when
                            everything new is clean)
     --write-baseline FILE  write the current findings as the new baseline
-                           and exit 0 (regeneration workflow)
+                           and exit 0 (regeneration workflow; refuses to
+                           GROW the baseline past its ratchet)
+    --grow-baseline        escape hatch: allow --write-baseline to grow
+                           the baseline (justify in the PR description)
+    --changed [BASE]       analyze the full tree for call-graph facts but
+                           CHECK/report only files changed per git
+                           (worktree+index vs HEAD, plus BASE...HEAD when
+                           a ref is given); paths default to cycloneml_tpu
+    --cache FILE           parse-cache pickle for --changed
+                           (default: .graftlint-cache.pkl)
+    --no-cache             disable the parse cache
     --rules JX001,JX003    run a subset of the rule pack
     --list-rules           print the rule pack and exit
 
@@ -19,22 +32,31 @@ Exit codes: 0 clean (after baseline), 1 findings, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from cycloneml_tpu.analysis import baseline as baseline_mod
 from cycloneml_tpu.analysis.engine import analyze_paths, collect_files
-from cycloneml_tpu.analysis.report import render_json, render_text
+from cycloneml_tpu.analysis.report import (render_json, render_sarif,
+                                           render_text)
 from cycloneml_tpu.analysis.rules import ALL_RULES, default_rules, rules_by_id
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m cycloneml_tpu.analysis",
-        description="graftlint: AST-based JAX/TPU hazard analyzer")
+        description="graftlint: AST + interprocedural-dataflow JAX/TPU "
+                    "hazard analyzer")
     parser.add_argument("paths", nargs="*", help="files or directories")
     parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--sarif", action="store_true", dest="as_sarif")
     parser.add_argument("--baseline", metavar="FILE", default=None)
     parser.add_argument("--write-baseline", metavar="FILE", default=None)
+    parser.add_argument("--grow-baseline", action="store_true")
+    parser.add_argument("--changed", nargs="?", const="", default=None,
+                        metavar="BASE")
+    parser.add_argument("--cache", metavar="FILE", default=None)
+    parser.add_argument("--no-cache", action="store_true")
     parser.add_argument("--rules", metavar="IDS", default=None,
                         help="comma-separated rule ids to run")
     parser.add_argument("--list-rules", action="store_true")
@@ -46,9 +68,23 @@ def main(argv=None) -> int:
             first_line = doc.splitlines()[0] if doc else ""
             print(f"{cls.rule_id}  {first_line}")
         return 0
-    if not args.paths:
-        parser.print_usage(sys.stderr)
+    if args.as_json and args.as_sarif:
+        print("--json and --sarif are mutually exclusive", file=sys.stderr)
         return 2
+    if args.changed is not None and args.write_baseline:
+        # a git-scoped run only carries the changed files' findings —
+        # writing those as the baseline would silently drop every
+        # grandfathered entry for unchanged files (and ratchet down past
+        # what the full gate still reports)
+        print("--write-baseline needs a full-scope run; drop --changed",
+              file=sys.stderr)
+        return 2
+    paths = args.paths
+    if not paths:
+        if args.changed is None:
+            parser.print_usage(sys.stderr)
+            return 2
+        paths = ["cycloneml_tpu"]   # the tree the gate lints
 
     if args.rules:
         wanted = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
@@ -64,27 +100,88 @@ def main(argv=None) -> int:
     else:
         rules = default_rules()
 
-    findings = analyze_paths(args.paths, rules=rules)
+    only_paths = None
+    cache = None
+    if args.changed is not None:
+        from cycloneml_tpu.analysis.incremental import (DEFAULT_CACHE,
+                                                        ParseCache,
+                                                        changed_report_set,
+                                                        git_changed_files,
+                                                        git_toplevel)
+        # the default/relative roots are repo-root-relative by convention;
+        # from a subdirectory they would resolve to nothing and the gate
+        # would silently lint zero files — anchor them to the toplevel,
+        # and treat a root that still doesn't exist as a usage error
+        top = git_toplevel()
+        if top is not None:
+            paths = [os.path.join(top, p)
+                     if not os.path.exists(p)
+                     and os.path.exists(os.path.join(top, p)) else p
+                     for p in paths]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"--changed: analyzed path(s) do not exist: {missing}",
+                  file=sys.stderr)
+            return 2
+        try:
+            changed = git_changed_files(base=args.changed or None)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if changed is None:
+            print("--changed: git unavailable, falling back to a full run",
+                  file=sys.stderr)
+        else:
+            only_paths = changed_report_set(paths, changed)
+            if not only_paths:
+                print("0 changed file(s) under the analyzed paths; "
+                      "nothing to lint")
+                return 0
+        if not args.no_cache:
+            cache = ParseCache(args.cache or DEFAULT_CACHE)
+
+    findings = analyze_paths(
+        paths, rules=rules, only_paths=only_paths,
+        module_loader=cache.load_module if cache is not None else None)
+    if cache is not None:
+        cache.save()
 
     if args.write_baseline:
-        baseline_mod.write_baseline(args.write_baseline, findings)
+        try:
+            baseline_mod.write_baseline(args.write_baseline, findings,
+                                        allow_grow=args.grow_baseline)
+        except baseline_mod.BaselineRatchetError as e:
+            print(str(e), file=sys.stderr)
+            return 2
         print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
         return 0
 
     grandfathered = 0
     if args.baseline:
         try:
+            # the ratchet is enforced on the READ path too: a hand-edited
+            # grown baseline must fail the gate it exists to protect, not
+            # silently grandfather new debt
+            baseline_mod.check_ratchet(args.baseline)
             known = baseline_mod.load_baseline(args.baseline)
+        except baseline_mod.BaselineRatchetError as e:
+            print(str(e), file=sys.stderr)
+            return 2
         except (OSError, ValueError, KeyError) as e:
             print(f"cannot read baseline {args.baseline}: {e}",
                   file=sys.stderr)
             return 2
         findings, grandfathered = baseline_mod.apply_baseline(findings, known)
 
-    out = (render_json(findings, grandfathered) if args.as_json
-           else render_text(findings, grandfathered,
-                            len(collect_files(args.paths))))
-    print(out, end="" if args.as_json else "\n")
+    if args.as_sarif:
+        out = render_sarif(findings, grandfathered)
+    elif args.as_json:
+        out = render_json(findings, grandfathered)
+    else:
+        scanned = (len(only_paths) if only_paths is not None
+                   else len(collect_files(paths)))
+        out = render_text(findings, grandfathered, scanned)
+    print(out, end="" if (args.as_json or args.as_sarif) else "\n")
     return 1 if findings else 0
 
 

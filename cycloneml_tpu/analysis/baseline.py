@@ -7,6 +7,15 @@ across unrelated edits; a refactor that *adds* occurrences inside an
 already-baselined function still fails, which is the intent — new hazards
 in old code are still new hazards.
 
+**The ratchet.** The file also carries ``"ratchet"``: the total number of
+grandfathered occurrences the baseline is ALLOWED to hold. Regenerating
+may shrink the baseline freely (the ratchet follows it down), but never
+grow it past the committed ratchet — technical debt only monotonically
+decreases. Growing requires the explicit ``--grow-baseline`` escape
+hatch, with the justification in the PR description. The committed
+baseline is empty with ratchet 0: every finding so far has been FIXED,
+and the ratchet keeps it that way.
+
 Regenerate with::
 
     python -m cycloneml_tpu.analysis cycloneml_tpu --write-baseline \
@@ -17,9 +26,14 @@ from __future__ import annotations
 
 import collections
 import json
-from typing import Dict, List, Tuple
+import os
+from typing import Dict, List, Optional, Tuple
 
 from cycloneml_tpu.analysis.engine import Finding
+
+
+class BaselineRatchetError(ValueError):
+    """A regeneration tried to GROW the baseline past its ratchet."""
 
 
 def load_baseline(path: str) -> Dict[str, int]:
@@ -32,16 +46,58 @@ def load_baseline(path: str) -> Dict[str, int]:
     return out
 
 
-def write_baseline(path: str, findings: List[Finding]) -> None:
+def load_ratchet(path: str) -> Optional[int]:
+    """The committed ratchet, or the entry total for pre-ratchet files
+    (a PR touching such a file adopts its current size as the ceiling).
+    None when the file does not exist."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if "ratchet" in data:
+        return int(data["ratchet"])
+    return sum(int(e.get("count", 1)) for e in data.get("findings", []))
+
+
+def check_ratchet(path: str) -> Tuple[int, int]:
+    """(total grandfathered occurrences, ratchet) for a baseline file;
+    raises :class:`BaselineRatchetError` when the entries exceed the
+    ratchet (a hand-edit grew the baseline without the escape hatch)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    total = sum(int(e.get("count", 1)) for e in data.get("findings", []))
+    ratchet = int(data.get("ratchet", total))
+    if total > ratchet:
+        raise BaselineRatchetError(
+            f"baseline {path} holds {total} grandfathered occurrence(s) "
+            f"but its ratchet is {ratchet} — the baseline may shrink, "
+            f"never grow (regenerate with --grow-baseline and justify in "
+            f"the PR if this is deliberate)")
+    return total, ratchet
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   allow_grow: bool = False) -> None:
     counts = collections.Counter(f.fingerprint for f in findings)
+    total = sum(counts.values())
+    ratchet = load_ratchet(path)
+    if ratchet is not None and total > ratchet and not allow_grow:
+        raise BaselineRatchetError(
+            f"refusing to grow the baseline: {total} occurrence(s) > "
+            f"ratchet {ratchet} ({path}). Fix the findings, or pass "
+            f"--grow-baseline and justify the new debt in the PR")
     entries = []
     for fp in sorted(counts):
         rule, fpath, function = fp.split(":", 2)
         entries.append({"rule": rule, "path": fpath, "function": function,
                         "count": counts[fp]})
+    # the ratchet follows the baseline DOWN; growing resets it only
+    # through the explicit escape hatch
+    new_ratchet = (total if ratchet is None or allow_grow
+                   else min(ratchet, total))
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1, "findings": entries}, fh, indent=2,
-                  sort_keys=True)
+        json.dump({"version": 1, "findings": entries,
+                   "ratchet": new_ratchet}, fh, indent=2, sort_keys=True)
         fh.write("\n")
 
 

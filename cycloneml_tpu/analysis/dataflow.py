@@ -1,0 +1,483 @@
+"""Interprocedural dataflow: abstract facts propagated over the call graph.
+
+PR 1's rule pack was per-function pattern matching — good enough for
+hazards whose evidence sits on one line, blind to anything split across a
+call boundary. This module adds the missing layer: a **function-summary
+dataflow engine** in the classic worklist style. Each participating rule
+(:class:`~cycloneml_tpu.analysis.rules.base.DataflowRule`) contributes a
+transfer function computing ONE summary fact per function from the
+function's own body plus its callees' current summaries; the engine
+iterates bottom-up over the :class:`CallGraph` (re-queuing CALLERS of any
+function whose summary changed) until a fixpoint. Rules then run their
+usual per-module ``check()`` with the converged summaries available via
+``ctx.dataflow``.
+
+Facts live in small, explicitly bounded lattices so the fixpoint provably
+terminates:
+
+* bools join with ``or`` (monotone, height 2);
+* parameter-index sets join with union, **widened** to the absorbing
+  :data:`TOP` element once they outgrow :data:`SET_WIDEN_LIMIT`;
+* a per-function visit budget (:data:`MAX_VISITS`) hard-widens to the
+  rule's ``top()`` as a backstop against a non-monotone transfer bug —
+  a wrong summary must degrade to "unknown", never to an endless loop.
+
+``TOP`` always means *any/unknown* — membership tests succeed, so rules
+degrade toward (possibly noisy) conservatism rather than silence; in
+practice the limits are never hit by real code (a function with 32
+distinct hazard-carrying parameters is its own finding).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
+                                            iter_own_statements,
+                                            last_component)
+from cycloneml_tpu.analysis.reachability import CallResolver
+
+# -- lattice primitives -------------------------------------------------------
+
+SET_WIDEN_LIMIT = 32   # parameter-index sets wider than this widen to TOP
+MAX_VISITS = 24        # per-function transfer budget before hard-widening
+
+COMPREHENSION_NODES = (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+
+
+class _Top:
+    """The absorbing "any/unknown" lattice element (singleton)."""
+
+    _instance: Optional["_Top"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "TOP"
+
+    def __contains__(self, item):   # `x in TOP` is always true
+        return True
+
+
+TOP = _Top()
+
+EMPTY = frozenset()
+
+
+def join_sets(a, b, limit: int = SET_WIDEN_LIMIT):
+    """Join two powerset elements (``frozenset | TOP``): union, widened to
+    :data:`TOP` past ``limit``. TOP is absorbing."""
+    if a is TOP or b is TOP:
+        return TOP
+    u = frozenset(a) | frozenset(b)
+    return TOP if len(u) > limit else u
+
+
+def set_contains(s, item) -> bool:
+    """Membership under the powerset-with-TOP lattice."""
+    return s is TOP or item in s
+
+
+def join_bools(a: bool, b: bool) -> bool:
+    return bool(a) or bool(b)
+
+
+# -- call graph ---------------------------------------------------------------
+
+@dataclass
+class CallSite:
+    """One resolved-or-not call expression inside a function's own body."""
+
+    node: ast.Call
+    name: str                              # dotted callee as written
+    targets: Tuple[FunctionInfo, ...]      # () when unresolvable
+
+    def arg_for_param(self, target: FunctionInfo, index: int
+                      ) -> Optional[ast.AST]:
+        """The argument expression feeding ``target``'s parameter at
+        positional ``index``, accounting for the bound-method offset
+        (``self.m(x)`` feeds ``x`` to param 1). None when the mapping is
+        out of range or obscured by ``*args``."""
+        for i, expr in self.param_map(target):
+            if i == index:
+                return expr
+        return None
+
+    def param_map(self, target: FunctionInfo
+                  ) -> List[Tuple[int, ast.AST]]:
+        """(callee param index, argument expr) pairs for one resolved
+        target. Starred args end the positional mapping (everything after
+        them is unknown); keywords map by parameter name."""
+        params = _ordered_params(target)
+        offset = 0
+        if isinstance(self.node.func, ast.Attribute) and params[:1] in (
+                ["self"], ["cls"]):
+            offset = 1
+        out: List[Tuple[int, ast.AST]] = []
+        for pos, arg in enumerate(self.node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            out.append((pos + offset, arg))
+        for kw in self.node.keywords:
+            if kw.arg is not None and kw.arg in params:
+                out.append((params.index(kw.arg), kw.value))
+        return out
+
+
+def _ordered_params(fn: FunctionInfo) -> List[str]:
+    args = getattr(fn.node, "args", None)
+    if args is None:
+        return []
+    return [a.arg for a in (list(args.posonlyargs) + list(args.args)
+                            + list(args.kwonlyargs))]
+
+
+def param_index(fn: FunctionInfo) -> Dict[str, int]:
+    """name -> position over posonly+pos+kwonly — the same ordering
+    :meth:`CallSite.param_map` emits, so positions line up."""
+    return {name: i for i, name in enumerate(_ordered_params(fn))}
+
+
+class ProgramBindingsCache:
+    """name -> :class:`JitParams` visible inside a function: the
+    module-level ``prog = jax.jit(f, ...)`` bindings plus the function's
+    own local ones, cached at both levels. Shared by every rule that
+    needs to know which names dispatch jit programs (JX008/JX009) — one
+    implementation, one cache discipline."""
+
+    def __init__(self):
+        self._mod: Dict[str, Dict[str, JitParams]] = {}
+        self._fn: Dict[FunctionInfo, Dict[str, JitParams]] = {}
+
+    def bindings_for(self, fn: FunctionInfo, ctx,
+                     graph: "CallGraph") -> Dict[str, JitParams]:
+        got = self._fn.get(fn)
+        if got is not None:
+            return got
+        mod = ctx.modules.get(fn.module_path)
+        if fn.module_path not in self._mod:
+            self._mod[fn.module_path] = (
+                module_program_bindings(mod) if mod is not None else {})
+        table = dict(self._mod[fn.module_path])
+        collect_program_bindings(graph.index(fn).assigns, table)
+        self._fn[fn] = table
+        return table
+
+
+@dataclass
+class FunctionIndex:
+    """One-walk node index for a function's own body, in SOURCE order.
+
+    Transfer functions run many times per function during the fixpoint;
+    anything that re-walks the AST per visit turns the engine quadratic
+    in practice. Rules read these pre-collected lists instead."""
+
+    calls: List[ast.Call] = field(default_factory=list)
+    assigns: List[ast.Assign] = field(default_factory=list)
+    returns: List[ast.Return] = field(default_factory=list)
+    loops: List[ast.AST] = field(default_factory=list)
+    branches: List[ast.AST] = field(default_factory=list)
+
+
+class CallGraph:
+    """Per-function call sites with resolved targets + reverse edges.
+
+    Built once per analysis on top of the reachability pass's
+    :class:`CallResolver`; both directions are needed — forward edges for
+    transfer functions (a summary reads its callees'), reverse edges for
+    the worklist (a changed summary re-queues its callers).
+    """
+
+    def __init__(self, modules: Dict[str, "object"],
+                 resolver: Optional[CallResolver] = None):
+        self.modules = modules
+        self.resolver = resolver or CallResolver(modules)
+        self.all_functions: List[FunctionInfo] = []
+        self.callsites: Dict[FunctionInfo, List[CallSite]] = {}
+        self.callers: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+        self._sites_map: Dict[FunctionInfo, Dict[int, CallSite]] = {}
+        self._index: Dict[FunctionInfo, FunctionIndex] = {}
+        for mod in modules.values():
+            for fn in mod.functions:
+                self.all_functions.append(fn)
+                sites: List[CallSite] = []
+                for node in iter_own_statements(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node)
+                    if not name:
+                        continue
+                    targets = tuple(self.resolver.resolve(fn, name))
+                    sites.append(CallSite(node, name, targets))
+                    for t in targets:
+                        self.callers.setdefault(t, set()).add(fn)
+                self.callsites[fn] = sites
+
+    def sites(self, fn: FunctionInfo) -> List[CallSite]:
+        return self.callsites.get(fn, [])
+
+    def sites_map(self, fn: FunctionInfo) -> Dict[int, CallSite]:
+        """id(call node) -> CallSite, cached per function."""
+        got = self._sites_map.get(fn)
+        if got is None:
+            got = {id(s.node): s for s in self.callsites.get(fn, [])}
+            self._sites_map[fn] = got
+        return got
+
+    def index(self, fn: FunctionInfo) -> FunctionIndex:
+        got = self._index.get(fn)
+        if got is None:
+            got = FunctionIndex()
+            for node in own_nodes_in_order(fn.node):
+                if isinstance(node, ast.Call):
+                    got.calls.append(node)
+                elif isinstance(node, ast.Assign):
+                    got.assigns.append(node)
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    # `y: jax.Array = ...` binds exactly like `y = ...`
+                    got.assigns.append(node)
+                elif isinstance(node, ast.Return):
+                    got.returns.append(node)
+                elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    got.loops.append(node)
+                    if isinstance(node, ast.While):
+                        got.branches.append(node)
+                elif isinstance(node, COMPREHENSION_NODES):
+                    # comprehensions iterate too — `[prog(x, i) for i in
+                    # ns]` recompiles exactly like the spelled-out loop
+                    got.loops.append(node)
+                elif isinstance(node, (ast.If, ast.IfExp)):
+                    got.branches.append(node)
+            self._index[fn] = got
+        return got
+
+    def callers_of(self, fn: FunctionInfo) -> Set[FunctionInfo]:
+        return self.callers.get(fn, set())
+
+
+# -- fixpoint engine ----------------------------------------------------------
+
+class DataflowResult:
+    """Converged per-rule function summaries, handed to rules via
+    ``ctx.dataflow``."""
+
+    def __init__(self, graph: Optional[CallGraph] = None):
+        self.graph = graph
+        self._summaries: Dict[str, Dict[FunctionInfo, object]] = {}
+
+    def summary(self, analysis_id: str, fn: FunctionInfo, default=None):
+        return self._summaries.get(analysis_id, {}).get(fn, default)
+
+    def summaries(self, analysis_id: str) -> Dict[FunctionInfo, object]:
+        return self._summaries.get(analysis_id, {})
+
+
+def run_dataflow(graph: CallGraph, clients: Sequence["object"],
+                 ctx) -> DataflowResult:
+    """Iterate every client's transfer function to a fixpoint.
+
+    ``clients`` are :class:`DataflowRule` instances (duck-typed: need
+    ``analysis_id``, ``initial``, ``transfer``, ``top``). Each client's
+    facts converge independently — summaries of one rule never feed
+    another's transfer, which keeps per-rule precision reasoning local.
+    """
+    result = DataflowResult(graph)
+    for client in clients:
+        facts: Dict[FunctionInfo, object] = {}
+        for fn in graph.all_functions:
+            facts[fn] = client.initial(fn, graph, ctx)
+        work = deque(graph.all_functions)
+        queued = set(id(fn) for fn in graph.all_functions)
+        visits: Dict[int, int] = {}
+        while work:
+            fn = work.popleft()
+            queued.discard(id(fn))
+            new = client.transfer(fn, facts, graph, ctx)
+            if new == facts[fn]:
+                continue
+            visits[id(fn)] = visits.get(id(fn), 0) + 1
+            if visits[id(fn)] > MAX_VISITS:
+                new = client.top(fn, graph, ctx)   # hard widen: terminate
+                if new == facts[fn]:
+                    continue
+            facts[fn] = new
+            for caller in graph.callers_of(fn):
+                if id(caller) not in queued:
+                    queued.add(id(caller))
+                    work.append(caller)
+        result._summaries[client.analysis_id] = facts
+    return result
+
+
+def own_nodes_in_order(fn_node: ast.AST):
+    """Every node of a function body in SOURCE order (DFS pre-order),
+    without descending into nested function/class defs.
+
+    :func:`~cycloneml_tpu.analysis.astutil.iter_own_statements` walks
+    breadth-first — fine for the two-pass taint fixpoint, wrong for scans
+    that track rebinding (``y = narrow(); y = wide(); use(y)`` must see
+    the re-widening LAST)."""
+    stack: List[ast.AST] = list(reversed(getattr(fn_node, "body", [])))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+# -- shared jit-call parsing (used by JX008/JX009) ----------------------------
+
+JIT_WRAPPERS = {"jit", "pjit"}
+# program factories with NO static/donate semantics of their own: every
+# argument position of the resulting program is a traced operand
+TRACED_PROGRAM_FACTORIES = {"tree_aggregate_fn", "tree_aggregate_with_state"}
+
+
+@dataclass(frozen=True)
+class JitParams:
+    """Compile-cache-relevant parameters parsed off a ``jax.jit(...)``
+    call (or decorator). ``statics_known`` is False when a static/donate
+    spec exists but is not a literal we can read — rules must then skip
+    static-position reasoning rather than guess."""
+
+    static_argnums: frozenset = EMPTY
+    static_argnames: frozenset = EMPTY
+    donate_argnums: frozenset = EMPTY
+    statics_known: bool = True
+    #: dotted name of the wrapped callable (``jax.jit(_kernel, ...)`` →
+    #: ``"_kernel"``) when readable — lets rules map KEYWORD calls onto
+    #: static_argnums positions via the wrapped signature
+    wrapped: Optional[str] = None
+
+
+def _literal_ints(node: ast.AST) -> Optional[frozenset]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+            else:
+                return None
+        return frozenset(out)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[frozenset]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+            else:
+                return None
+        return frozenset(out)
+    return None
+
+
+def parse_jit_params(call: ast.Call) -> JitParams:
+    """JitParams off a ``jax.jit(f, static_argnums=..., donate_argnums=...)``
+    call node. Non-literal specs degrade to ``statics_known=False``."""
+    statics: frozenset = EMPTY
+    names: frozenset = EMPTY
+    donate: frozenset = EMPTY
+    known = True
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            got = _literal_ints(kw.value)
+            statics, known = (got, known) if got is not None else (EMPTY,
+                                                                   False)
+        elif kw.arg == "static_argnames":
+            got = _literal_strs(kw.value)
+            names, known = (got, known) if got is not None else (EMPTY, False)
+        elif kw.arg == "donate_argnums":
+            got = _literal_ints(kw.value)
+            donate, known = (got, known) if got is not None else (EMPTY,
+                                                                  False)
+    wrapped: Optional[str] = None
+    if call.args:
+        from cycloneml_tpu.analysis.astutil import dotted_name
+        w = dotted_name(call.args[0])
+        # decorator spellings (@jax.jit(...) / @partial(jax.jit, ...))
+        # put the wrapper itself in args[0] — that is not the wrapped fn
+        if w and last_component(w) not in JIT_WRAPPERS:
+            wrapped = w
+    return JitParams(statics, names, donate, known, wrapped)
+
+
+def jit_params_of_function(fn: FunctionInfo) -> Optional[JitParams]:
+    """JitParams for a jit-DECORATED function (``@jax.jit``,
+    ``@partial(jax.jit, static_argnums=...)``), else None."""
+    if not fn.is_jit_decorated:
+        return None
+    for dec in getattr(fn.node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        name = call_name(dec)
+        base = last_component(name)
+        if base in JIT_WRAPPERS:            # @jax.jit(static_argnums=...)
+            return parse_jit_params(dec)
+        if base == "partial" and dec.args:  # @partial(jax.jit, ...)
+            from cycloneml_tpu.analysis.astutil import dotted_name
+            inner = dotted_name(dec.args[0])
+            if inner and last_component(inner) in JIT_WRAPPERS:
+                return parse_jit_params(dec)
+    return JitParams()                       # bare @jax.jit: no statics
+
+
+def assign_targets(stmt: ast.AST) -> List[ast.AST]:
+    """Targets of an ``Assign`` OR ``AnnAssign`` (annotated assignments
+    bind exactly one target) — every source-order binding scan must see
+    both spellings."""
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.target]
+    return list(getattr(stmt, "targets", []))
+
+
+def collect_program_bindings(stmts, into: Optional[Dict[str, JitParams]]
+                             = None) -> Dict[str, JitParams]:
+    """Names bound to compiled programs in a statement sequence:
+    ``prog = jax.jit(f, ...)`` / ``run = ds.tree_aggregate_fn(kernel)``.
+    ``stmts`` is a module body or a function's own-statement iterator."""
+    from cycloneml_tpu.analysis.astutil import assigned_names
+    out = into if into is not None else {}
+    for node in stmts:
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                and isinstance(getattr(node, "value", None), ast.Call)):
+            continue
+        base = last_component(call_name(node.value))
+        if base in JIT_WRAPPERS:
+            params = parse_jit_params(node.value)
+        elif base in TRACED_PROGRAM_FACTORIES or base == "tree_aggregate":
+            params = JitParams()
+        else:
+            continue
+        for t in assign_targets(node):
+            for n in assigned_names(t):
+                out[n] = params
+    return out
+
+
+def module_program_bindings(mod) -> Dict[str, JitParams]:
+    """Program bindings at MODULE level (``_step = jax.jit(_update, ...)``),
+    visible to every function in the module."""
+    body = []
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        body.append(stmt)
+    return collect_program_bindings(body)

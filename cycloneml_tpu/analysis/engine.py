@@ -30,6 +30,11 @@ class Finding:
     col: int
     message: str
     function: str = ""   # enclosing function qualname ("" = module level)
+    end_line: int = 0    # last physical line of the flagged statement
+                         # (0 = single-line; suppressions match the extent)
+    start_line: int = 0  # FIRST physical line of the flagged statement —
+                         # the finding may anchor on an inner expression
+                         # lines below it (0 = same as `line`)
 
     @property
     def fingerprint(self) -> str:
@@ -37,8 +42,8 @@ class Finding:
 
     def to_dict(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "function": self.function,
-                "message": self.message}
+                "col": self.col, "end_line": max(self.end_line, self.line),
+                "function": self.function, "message": self.message}
 
 
 @dataclass
@@ -60,6 +65,10 @@ class AnalysisContext:
     valid_axes: Sequence[str] = DEFAULT_AXES
     # names of module-level constants that hold a valid axis name
     axis_constant_names: Set[str] = field(default_factory=set)
+    # interprocedural layer (set by analyze_paths): the resolved call
+    # graph and the converged DataflowRule summaries
+    callgraph: Optional[object] = None
+    dataflow: Optional[object] = None
 
 
 def _discover_axes(modules: Dict[str, ModuleInfo]):
@@ -124,37 +133,96 @@ def _relpath(path: str, roots: Sequence[str]) -> str:
     return path.replace(os.sep, "/")
 
 
+def _is_suppressed(mod: ModuleInfo, finding: Finding) -> bool:
+    """Inline suppressions match the WHOLE statement extent: a
+    ``# graftlint: disable=RULE`` on any physical line of a multi-line
+    call covers a finding anchored to the statement's first line."""
+    end = max(finding.end_line, finding.line)
+    start = min(finding.start_line or finding.line, finding.line)
+    # a directive on the line ABOVE the statement already projects onto
+    # the statement's first line via collect_suppressions' own-line
+    # handling
+    for ln in range(start, end + 1):
+        sup = mod.suppressions.get(ln)
+        if sup and (finding.rule in sup or "ALL" in sup):
+            return True
+    return False
+
+
 def analyze_paths(paths: Sequence[str], rules=None,
-                  valid_axes: Optional[Sequence[str]] = None) -> List[Finding]:
+                  valid_axes: Optional[Sequence[str]] = None,
+                  only_paths: Optional[Set[str]] = None,
+                  module_loader=None) -> List[Finding]:
     """Run the rule pack over ``paths`` (files or directories).
 
     Returns findings AFTER inline-suppression filtering, sorted by
     (path, line). Baseline filtering is the caller's business
     (:mod:`.baseline`) so reporters can show both views.
+
+    ``only_paths`` (repo-relative posix paths) restricts which modules
+    the rules CHECK — the parse, reachability, call-graph, and dataflow
+    passes still cover the full file set so interprocedural facts stay
+    correct (incremental ``--changed`` mode). The check set is widened
+    over REVERSE call edges: a change in a callee can create findings in
+    its (transitive) callers — `advance()` growing `donate_argnums`
+    makes an untouched caller's `state.sum()` a use-after-donate — so
+    those callers' modules are checked too, keeping the incremental gate
+    as strict as the full one. ``module_loader`` replaces
+    :func:`load_module` (the parse cache hook); it must accept the same
+    ``(path, rel)`` signature.
     """
     if rules is None:
         from cycloneml_tpu.analysis.rules import default_rules
         rules = default_rules()
+    loader = module_loader or load_module
 
     modules: Dict[str, ModuleInfo] = {}
     for f in collect_files(paths):
-        mod = load_module(f, _relpath(f, paths))
+        mod = loader(f, _relpath(f, paths))
         if mod is not None:
             modules[mod.path] = mod
-    compute_reachability(modules)
+
+    from cycloneml_tpu.analysis.dataflow import CallGraph, run_dataflow
+    from cycloneml_tpu.analysis.reachability import CallResolver
+    resolver = CallResolver(modules)
+    compute_reachability(modules, resolver)
+    graph = CallGraph(modules, resolver)
 
     axes, axis_names = _discover_axes(modules)
     ctx = AnalysisContext(
         modules=modules,
         valid_axes=tuple(valid_axes) if valid_axes is not None else axes,
-        axis_constant_names=axis_names)
+        axis_constant_names=axis_names,
+        callgraph=graph)
+
+    from cycloneml_tpu.analysis.rules.base import DataflowRule
+    ctx.dataflow = run_dataflow(
+        graph, [r for r in rules if isinstance(r, DataflowRule)], ctx)
+
+    check_paths: Optional[Set[str]] = None
+    if only_paths is not None:
+        from collections import deque
+        check_paths = set(only_paths)
+        seed = [fn for path in only_paths if path in modules
+                for fn in modules[path].functions]
+        work = deque(seed)
+        seen = {id(fn) for fn in seed}
+        while work:
+            fn = work.popleft()
+            for caller in graph.callers_of(fn):
+                if id(caller) in seen:
+                    continue
+                seen.add(id(caller))
+                check_paths.add(caller.module_path)
+                work.append(caller)
 
     findings: List[Finding] = []
     for mod in modules.values():
+        if check_paths is not None and mod.path not in check_paths:
+            continue
         for rule in rules:
             for finding in rule.check(mod, ctx):
-                suppressed = mod.suppressions.get(finding.line, set())
-                if finding.rule in suppressed or "ALL" in suppressed:
+                if _is_suppressed(mod, finding):
                     continue
                 findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,173 @@
+"""Incremental (``--changed``) analysis: git-scoped reporting + parse cache.
+
+The interprocedural engine needs the WHOLE file set — a changed wrapper
+can create a hazard whose finding lands in an unchanged caller, and the
+call graph/reachability/dataflow passes are only correct globally. What
+``--changed`` narrows is the expensive part: per-module rule checks run
+(and findings are reported) only for files touched per ``git diff``,
+while parsing reuses a pickled module cache keyed on content hash. Net:
+the lint gate's cost tracks the size of the CHANGE, not the repo.
+
+The cache stores fully parsed :class:`~.engine.ModuleInfo` objects
+(AST + function table + suppressions). Reachability mutates
+``FunctionInfo.jit_reachable`` in place, so cached entries are reset on
+reuse — the flags are a per-run verdict, not a parse artifact. Any cache
+trouble (version skew, pickle errors, truncation) falls back to a fresh
+parse; the cache is an accelerator, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import subprocess
+from typing import Dict, List, Optional, Sequence, Set
+
+from cycloneml_tpu.analysis.engine import ModuleInfo, load_module
+
+CACHE_VERSION = 2   # bump when ModuleInfo/FunctionInfo shape changes
+DEFAULT_CACHE = ".graftlint-cache.pkl"
+
+
+def git_toplevel(cwd: Optional[str] = None) -> Optional[str]:
+    """The repo root per ``git rev-parse --show-toplevel``; None when git
+    (or a repo) is unavailable."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=cwd,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out = proc.stdout.strip()
+    return out or None
+
+
+def git_changed_files(base: Optional[str] = None,
+                      cwd: Optional[str] = None) -> Optional[Set[str]]:
+    """ABSOLUTE paths of changed ``.py`` files: worktree + index changes
+    against HEAD (or ``base...HEAD`` when a base ref is given) plus
+    untracked files. git emits repo-root-relative names whatever
+    directory it runs from, so they are resolved against ``git rev-parse
+    --show-toplevel`` — NOT the process cwd, which may be a subdirectory.
+    None when git is unavailable — the caller must fall back to a full
+    run, not silently lint nothing. Raises ``ValueError`` when git works
+    but ``base`` is not a resolvable ref (a typo, or a path mistaken for
+    the BASE argument) — that is a usage error, not a fallback case."""
+    def run(*args: str) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ["git", *args], cwd=cwd, capture_output=True, text=True,
+                timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [ln.strip() for ln in proc.stdout.splitlines() if ln.strip()]
+
+    top = run("rev-parse", "--show-toplevel")
+    if not top:
+        return None
+    root = top[0]
+    if base and run("rev-parse", "--verify", "--quiet",
+                    f"{base}^{{commit}}") is None:
+        hint = (" (it names a path — analyzed paths are positional "
+                "arguments, BASE is a git ref)" if os.path.exists(base)
+                else "")
+        raise ValueError(f"--changed: {base!r} is not a git ref{hint}")
+    out: Set[str] = set()
+    diffs = run("diff", "--name-only", "HEAD")
+    if diffs is None:
+        return None
+    out.update(diffs)
+    if base:
+        merged = run("diff", "--name-only", f"{base}...HEAD")
+        if merged is None:
+            return None
+        out.update(merged)
+    untracked = run("ls-files", "--others", "--exclude-standard")
+    if untracked is not None:
+        out.update(untracked)
+    return {os.path.join(root, p) for p in out if p.endswith(".py")}
+
+
+class ParseCache:
+    """Content-hash-keyed pickle cache of parsed modules."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._entries: Dict[str, tuple] = {}   # rel -> (sha, ModuleInfo)
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "rb") as fh:
+                payload = pickle.load(fh)
+            if payload.get("version") == CACHE_VERSION:
+                self._entries = payload.get("modules", {})
+        except (OSError, pickle.PickleError, EOFError, AttributeError,
+                KeyError, ValueError, ImportError):
+            # ImportError: a refactor moved/renamed a pickled class out
+            # from under a stale cache — fall back to a fresh parse, the
+            # cache is an accelerator, never a correctness dependency
+            self._entries = {}
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump({"version": CACHE_VERSION,
+                             "modules": self._entries}, fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except (OSError, pickle.PickleError, RecursionError):
+            pass   # cache write failure never fails the lint
+
+    def load_module(self, path: str, rel: str) -> Optional[ModuleInfo]:
+        """Drop-in for :func:`~.engine.load_module` with cache reuse."""
+        try:
+            with open(path, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()
+        except OSError:
+            return None
+        hit = self._entries.get(rel)
+        if hit is not None and hit[0] == digest:
+            self.hits += 1
+            mod = hit[1]
+            for fn in mod.functions:
+                # per-run verdicts, recomputed by the reachability pass
+                fn.jit_reachable = False
+                fn.passed_to_tracer = False
+            return mod
+        self.misses += 1
+        mod = load_module(path, rel)
+        if mod is not None:
+            self._entries[rel] = (digest, mod)
+            self._dirty = True
+        return mod
+
+
+def changed_report_set(paths: Sequence[str],
+                       changed: Set[str]) -> Set[str]:
+    """Map changed files (ABSOLUTE paths, from :func:`git_changed_files`)
+    onto the engine's module-path convention (relative to the parent of
+    each analyzed root). Only files at or under an analyzed root match:
+    the roots scope the gate — a changed file elsewhere in the repo is
+    not part of this lint run and must not inflate its file count."""
+    out: Set[str] = set()
+    roots = [os.path.realpath(p) for p in paths]
+    for ch in changed:
+        ach = os.path.realpath(ch)
+        for root in roots:
+            r = root.rstrip(os.sep)
+            if ach == r or ach.startswith(r + os.sep):
+                base = os.path.dirname(r)
+                out.add(os.path.relpath(ach, base).replace(os.sep, "/"))
+    return out

@@ -33,7 +33,7 @@ a false edge would spray host-only rules across driver code.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
                                             dotted_name, iter_own_statements,
@@ -183,51 +183,73 @@ def _names_in_returns(fn_node: ast.AST) -> Set[str]:
     return out
 
 
-def compute_reachability(modules: Dict[str, "object"]) -> None:
-    """Mark ``jit_reachable`` on every FunctionInfo across the file set.
+class CallResolver:
+    """Name -> FunctionInfo resolution across the analyzed file set.
 
-    ``modules`` maps path -> ModuleInfo (engine.ModuleInfo: needs
-    ``.functions`` (List[FunctionInfo]), ``.mf`` (ModuleFunctions)).
+    One instance serves both the reachability pass and the
+    interprocedural dataflow engine (:mod:`.dataflow`): the resolution
+    tables (same-module top-level names, class methods, nested-scope
+    chains, ``from mod import name`` edges) are built once per analysis.
+    Resolution is deliberately conservative — no match-any-same-name
+    fallback; an unresolvable callee returns ``[]``.
     """
-    # resolution tables
-    by_module_toplevel: Dict[str, Dict[str, FunctionInfo]] = {}
-    by_module_class: Dict[str, Dict[str, FunctionInfo]] = {}
-    for path, mod in modules.items():
-        top: Dict[str, FunctionInfo] = {}
-        meth: Dict[str, FunctionInfo] = {}
-        for fn in mod.functions:
-            simple = fn.qualname.rsplit(".", 1)[-1]
-            if fn.parent is None and fn.class_name is None:
-                top[simple] = fn
-            if fn.class_name is not None and fn.parent is None:
-                meth[f"{fn.class_name}.{simple}"] = fn
-                meth.setdefault(simple, fn)
-        by_module_toplevel[path] = top
-        by_module_class[path] = meth
 
-    # module-name index for `from pkg.mod import f` resolution
-    modname_to_path: Dict[str, str] = {}
-    for path in modules:
-        dotted = path[:-3].replace("/", ".") if path.endswith(".py") else path
-        modname_to_path[dotted] = path
-        if dotted.endswith(".__init__"):
-            modname_to_path[dotted[: -len(".__init__")]] = path
+    def __init__(self, modules: Dict[str, "object"]):
+        self.modules = modules
+        self.by_module_toplevel: Dict[str, Dict[str, FunctionInfo]] = {}
+        self.by_module_class: Dict[str, Dict[str, FunctionInfo]] = {}
+        for path, mod in modules.items():
+            top: Dict[str, FunctionInfo] = {}
+            meth: Dict[str, FunctionInfo] = {}
+            for fn in mod.functions:
+                simple = fn.qualname.rsplit(".", 1)[-1]
+                if fn.parent is None and fn.class_name is None:
+                    top[simple] = fn
+                if fn.class_name is not None and fn.parent is None:
+                    meth[f"{fn.class_name}.{simple}"] = fn
+                    meth.setdefault(simple, fn)
+            self.by_module_toplevel[path] = top
+            self.by_module_class[path] = meth
 
-    # parent qualname -> nested children, built once per module (resolve()
-    # runs once per call edge — rebuilding this there would be O(F*E))
-    children_by_module: Dict[str, Dict[str, List[FunctionInfo]]] = {}
-    for path, mod in modules.items():
-        children: Dict[str, List[FunctionInfo]] = {}
-        for fn in mod.functions:
-            if fn.parent is not None:
-                children.setdefault(fn.parent.qualname, []).append(fn)
-        children_by_module[path] = children
+        # module-name index for `from pkg.mod import f` resolution
+        self.modname_to_path: Dict[str, str] = {}
+        for path in modules:
+            dotted = (path[:-3].replace("/", ".") if path.endswith(".py")
+                      else path)
+            self.modname_to_path[dotted] = path
+            if dotted.endswith(".__init__"):
+                self.modname_to_path[dotted[: -len(".__init__")]] = path
 
-    def resolve(caller: FunctionInfo, mod, callee: str) -> List[FunctionInfo]:
+        # parent qualname -> nested children, built once per module
+        # (resolve() runs once per call edge — rebuilding there is O(F*E))
+        self.children_by_module: Dict[str, Dict[str, List[FunctionInfo]]] = {}
+        for path, mod in modules.items():
+            children: Dict[str, List[FunctionInfo]] = {}
+            for fn in mod.functions:
+                if fn.parent is not None:
+                    children.setdefault(fn.parent.qualname, []).append(fn)
+            self.children_by_module[path] = children
+
+        # resolution is a pure function of the tables above, and both the
+        # reachability worklist and CallGraph construction resolve the
+        # same (caller, name) edges — memoize so the second pass is a
+        # dict hit instead of a repeated scope-chain walk
+        self._memo: Dict[Tuple[int, str], List[FunctionInfo]] = {}
+
+    def resolve(self, caller: FunctionInfo, callee: str) -> List[FunctionInfo]:
+        key = (id(caller), callee)
+        got = self._memo.get(key)
+        if got is None:
+            got = self._resolve(caller, callee)
+            self._memo[key] = got
+        return got
+
+    def _resolve(self, caller: FunctionInfo,
+                 callee: str) -> List[FunctionInfo]:
         simple = last_component(callee)
         # scope chain: nested siblings and enclosing functions' children
         scope = caller
-        children = children_by_module[caller.module_path]
+        children = self.children_by_module[caller.module_path]
         while scope is not None:
             for child in children.get(scope.qualname, []):
                 if child.qualname.rsplit(".", 1)[-1] == simple:
@@ -235,15 +257,16 @@ def compute_reachability(modules: Dict[str, "object"]) -> None:
             scope = scope.parent
         # self.method() / cls.method()
         if callee.startswith(("self.", "cls.")) and caller.class_name:
-            hit = by_module_class[caller.module_path].get(
+            hit = self.by_module_class[caller.module_path].get(
                 f"{caller.class_name}.{simple}")
             if hit is not None:
                 return [hit]
         # module-level function, same module
-        hit = by_module_toplevel[caller.module_path].get(simple)
+        hit = self.by_module_toplevel[caller.module_path].get(simple)
         if hit is not None and "." not in callee:
             return [hit]
         # explicit from-import
+        mod = self.modules[caller.module_path]
         src = mod.mf.imports.get(simple if "." not in callee
                                  else callee.split(".", 1)[0])
         if src is not None:
@@ -251,12 +274,23 @@ def compute_reachability(modules: Dict[str, "object"]) -> None:
                 target_mod, target_fn = src, simple
             else:
                 target_mod, _, target_fn = src.rpartition(".")
-            tpath = modname_to_path.get(target_mod)
+            tpath = self.modname_to_path.get(target_mod)
             if tpath is not None:
-                hit = by_module_toplevel[tpath].get(target_fn)
+                hit = self.by_module_toplevel[tpath].get(target_fn)
                 if hit is not None:
                     return [hit]
         return []
+
+
+def compute_reachability(modules: Dict[str, "object"],
+                         resolver: Optional[CallResolver] = None) -> None:
+    """Mark ``jit_reachable`` on every FunctionInfo across the file set.
+
+    ``modules`` maps path -> ModuleInfo (engine.ModuleInfo: needs
+    ``.functions`` (List[FunctionInfo]), ``.mf`` (ModuleFunctions)).
+    """
+    if resolver is None:
+        resolver = CallResolver(modules)
 
     # seeds
     worklist: List[FunctionInfo] = []
@@ -279,9 +313,8 @@ def compute_reachability(modules: Dict[str, "object"]) -> None:
     while True:
         while worklist:
             fn = worklist.pop()
-            mod = modules[fn.module_path]
             for callee in fn.calls:
-                for target in resolve(fn, mod, callee):
+                for target in resolver.resolve(fn, callee):
                     if not target.jit_reachable:
                         target.jit_reachable = True
                         worklist.append(target)

@@ -1,12 +1,24 @@
-"""Finding reporters: human-readable text and machine-readable JSON."""
+"""Finding reporters: human text, machine JSON, and SARIF 2.1.0.
+
+SARIF is the interchange format CI/code-review tooling renders inline
+(GitHub code scanning, VS Code SARIF viewer): one ``run`` per invocation,
+rule metadata in ``tool.driver.rules``, one ``result`` per finding with a
+physical location region. The stable ``(rule, path, function)``
+fingerprint rides along in ``partialFingerprints`` so baselining on the
+consumer side matches graftlint's own."""
 
 from __future__ import annotations
 
 import collections
 import json
+import sys
 from typing import List, Optional
 
 from cycloneml_tpu.analysis.engine import Finding
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
 
 
 def render_text(findings: List[Finding], grandfathered: int = 0,
@@ -34,3 +46,56 @@ def render_json(findings: List[Finding], grandfathered: int = 0) -> str:
          "grandfathered": grandfathered,
          "count": len(findings)},
         indent=2, sort_keys=True) + "\n"
+
+
+def _rule_descriptions() -> List[dict]:
+    """SARIF rule metadata from the registry's module docstrings (the
+    first line is the one-sentence rule summary)."""
+    from cycloneml_tpu.analysis.rules import ALL_RULES
+    out = []
+    for cls in ALL_RULES:
+        doc = (sys.modules[cls.__module__].__doc__ or "").strip()
+        first = doc.splitlines()[0] if doc else cls.rule_id
+        out.append({
+            "id": cls.rule_id,
+            "name": cls.__name__,
+            "shortDescription": {"text": first},
+            "helpUri": "docs/graftlint.md",
+        })
+    return out
+
+
+def render_sarif(findings: List[Finding], grandfathered: int = 0) -> str:
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,   # SARIF is 1-based
+                        "endLine": max(f.end_line, f.line),
+                    },
+                },
+            }],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint},
+        })
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/graftlint.md",
+                "rules": _rule_descriptions(),
+            }},
+            "results": results,
+            "properties": {"grandfathered": grandfathered},
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"

@@ -1,12 +1,14 @@
 """graftlint rule registry.
 
-Adding a rule: subclass :class:`~cycloneml_tpu.analysis.rules.base.Rule`,
-give it the next ``JXnnn`` id, and list it here. Each rule ships with a
-paired should-flag / should-pass fixture under
+Adding a rule: subclass :class:`~cycloneml_tpu.analysis.rules.base.Rule`
+(pattern rule) or :class:`~cycloneml_tpu.analysis.rules.base.DataflowRule`
+(adds an interprocedural transfer function — see docs/graftlint.md,
+"dataflow engine"), give it the next ``JXnnn`` id, and list it here.
+Each rule ships with a paired should-flag / should-pass fixture under
 ``tests/fixtures/graftlint/`` pinning its precision.
 """
 
-from cycloneml_tpu.analysis.rules.base import Rule
+from cycloneml_tpu.analysis.rules.base import DataflowRule, Rule
 from cycloneml_tpu.analysis.rules.jx001_host_sync import HostSyncRule
 from cycloneml_tpu.analysis.rules.jx002_traced_control_flow import \
     TracedControlFlowRule
@@ -17,10 +19,16 @@ from cycloneml_tpu.analysis.rules.jx005_collective_axes import \
 from cycloneml_tpu.analysis.rules.jx006_jit_mutation import JitMutationRule
 from cycloneml_tpu.analysis.rules.jx007_thread_dispatch import \
     ThreadDispatchRule
+from cycloneml_tpu.analysis.rules.jx008_recompile import RecompileHazardRule
+from cycloneml_tpu.analysis.rules.jx009_use_after_donate import \
+    UseAfterDonateRule
+from cycloneml_tpu.analysis.rules.jx010_collective_divergence import \
+    CollectiveDivergenceRule
 
 ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
              FP64DriftRule, CollectiveAxisRule, JitMutationRule,
-             ThreadDispatchRule)
+             ThreadDispatchRule, RecompileHazardRule, UseAfterDonateRule,
+             CollectiveDivergenceRule)
 
 
 def default_rules():
@@ -32,4 +40,5 @@ def rules_by_id(ids):
     return [cls() for cls in ALL_RULES if cls.rule_id in wanted]
 
 
-__all__ = ["Rule", "ALL_RULES", "default_rules", "rules_by_id"]
+__all__ = ["Rule", "DataflowRule", "ALL_RULES", "default_rules",
+           "rules_by_id"]

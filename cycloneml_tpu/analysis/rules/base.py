@@ -1,4 +1,20 @@
-"""Rule protocol: one class per rule id, registered in rules/__init__."""
+"""Rule protocols: pattern rules and interprocedural dataflow rules.
+
+Two kinds of rule, one registry:
+
+* :class:`Rule` — the PR-1 contract, unchanged: per-module ``check()``
+  with cross-module context via ``ctx``. Every existing rule keeps
+  working without modification.
+* :class:`DataflowRule` — adds a transfer function. Before any
+  ``check()`` runs, the engine (:mod:`..dataflow`) iterates every
+  dataflow rule's ``transfer`` over the call graph to a fixpoint; the
+  converged per-function summaries are then readable in ``check()`` via
+  :meth:`DataflowRule.summary`. Migration for rule authors: keep your
+  ``check()`` exactly as it was, move any would-be cross-function logic
+  into ``initial``/``transfer``, and consult the summary where you
+  previously only had the local AST (docs/graftlint.md, "dataflow
+  engine").
+"""
 
 from __future__ import annotations
 
@@ -20,7 +36,88 @@ class Rule:
 
     def finding(self, mod: ModuleInfo, node: ast.AST, message: str,
                 function: str = "") -> Finding:
+        stmt = _enclosing_statement(mod, node)
         return Finding(rule=self.rule_id, path=mod.path,
                        line=getattr(node, "lineno", 0),
                        col=getattr(node, "col_offset", 0),
+                       end_line=_statement_extent(stmt),
+                       start_line=getattr(stmt, "lineno", 0) or 0,
                        message=message, function=function)
+
+
+def _enclosing_statement(mod: ModuleInfo, node: ast.AST) -> ast.AST:
+    """The innermost STATEMENT containing ``node``. A finding may anchor
+    on an inner expression (the ``float(...)`` operand of a larger
+    assignment); the suppression contract covers any physical line of
+    the enclosing statement, not just the flagged node's own span.
+    Findings are rare, so the per-finding tree walk is cheap."""
+    line = getattr(node, "lineno", None)
+    end = getattr(node, "end_lineno", None) or line
+    tree = getattr(mod, "tree", None)
+    if line is None or tree is None:
+        return node
+    best, best_key = node, None
+    for stmt in ast.walk(tree):
+        if not isinstance(stmt, ast.stmt):
+            continue
+        s0 = getattr(stmt, "lineno", None)
+        s1 = getattr(stmt, "end_lineno", None)
+        if s0 is None or s1 is None or s0 > line or s1 < end:
+            continue
+        # innermost: smallest line span, then deepest indentation
+        key = (s1 - s0, -getattr(stmt, "col_offset", 0))
+        if best_key is None or key < best_key:
+            best, best_key = stmt, key
+    return best
+
+
+def _statement_extent(node: ast.AST) -> int:
+    """Last physical line an inline suppression for this finding may sit
+    on. For compound statements (if/while/for) only the HEADER counts —
+    a ``disable`` buried in the body must not silence a finding on the
+    branch itself."""
+    if isinstance(node, (ast.If, ast.While)):
+        return getattr(node.test, "end_lineno", 0) or 0
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return getattr(node.iter, "end_lineno", 0) or 0
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return getattr(node, "lineno", 0) or 0
+    return getattr(node, "end_lineno", 0) or 0
+
+
+class DataflowRule(Rule):
+    """A rule with an interprocedural summary.
+
+    The dataflow engine computes one abstract fact per function by
+    iterating :meth:`transfer` to a fixpoint over the call graph
+    (callee summaries feed caller summaries, callers re-queued on
+    change). Facts must come from a small join-semilattice —
+    use the primitives in :mod:`..dataflow` (bools, ``frozenset | TOP``)
+    so the fixpoint terminates; ``top()`` is the hard-widening backstop.
+    """
+
+    #: summaries are keyed by this id; defaults to the rule id
+    @property
+    def analysis_id(self) -> str:
+        return self.rule_id
+
+    def initial(self, fn, graph, ctx):
+        """Seed facts from ``fn``'s own body (no callee knowledge)."""
+        raise NotImplementedError
+
+    def transfer(self, fn, facts, graph, ctx):
+        """Recompute ``fn``'s summary from its body + ``facts`` of its
+        callees. MUST be monotone w.r.t. the fact lattice."""
+        raise NotImplementedError
+
+    def top(self, fn, graph, ctx):
+        """The "anything possible" summary, used to hard-widen when the
+        per-function visit budget is exhausted."""
+        from cycloneml_tpu.analysis.dataflow import TOP
+        return TOP
+
+    def summary(self, ctx: AnalysisContext, fn, default=None):
+        if ctx.dataflow is None:
+            return default
+        return ctx.dataflow.summary(self.analysis_id, fn, default)

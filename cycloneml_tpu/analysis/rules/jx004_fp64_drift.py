@@ -14,9 +14,21 @@ context for fp64 in jit-reachable code; absent that, it's flagged.
 (``cyclone.data.dtype``) is legal STORAGE — design matrices live there —
 but the tier ends at the kernel: every cross-device reduction must carry
 the fp32 accumulator (``cyclone.compute.dtype``). A ``psum`` whose
-operand is explicitly cast to bf16/f16 accumulates at storage width —
-8 mantissa bits across the whole mesh — and is flagged regardless of any
-x64 guard (the guard legitimizes fp64, not narrow reductions).
+operand is narrow accumulates at storage width — 8 mantissa bits across
+the whole mesh — and is flagged regardless of any x64 guard (the guard
+legitimizes fp64, not narrow reductions).
+
+Narrowness is a DATAFLOW fact, not a callsite pattern: the PR-6 audit
+had to hand-check five estimators precisely because the original rule
+only saw casts written literally at the psum. The rule now carries a
+``returns_narrow`` summary per function (a return value that is an
+explicit bf16/f16 cast, transitively through call chains) and a
+source-order scan of local names, so both forms are caught::
+
+    y = x.astype(jnp.bfloat16)
+    jax.lax.psum(y, "data")              # flagged (local name)
+
+    jax.lax.psum(_to_storage(x), "data") # flagged (helper returns narrow)
 
 ``np.float64`` on the HOST side (optimizer state, readbacks) is idiomatic
 and untouched — only jit-reachable functions are scanned.
@@ -25,12 +37,15 @@ and untouched — only jit-reachable functions are scanned.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional, Set
 
-from cycloneml_tpu.analysis.astutil import (call_name, dotted_name,
+from cycloneml_tpu.analysis.astutil import (assigned_names, call_name,
+                                            dotted_name,
                                             iter_own_statements)
+from cycloneml_tpu.analysis.dataflow import (assign_targets,
+                                             own_nodes_in_order)
 from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
-from cycloneml_tpu.analysis.rules.base import Rule
+from cycloneml_tpu.analysis.rules.base import DataflowRule
 
 F64_DOTTED = {"jnp.float64", "jax.numpy.float64", "np.float64",
               "numpy.float64", "jnp.complex128", "jax.numpy.complex128"}
@@ -46,13 +61,78 @@ PSUM_CALLS = {"jax.lax.psum", "lax.psum", "psum", "psum_over_mesh",
               "pmean"}
 
 
-class FP64DriftRule(Rule):
+class FP64DriftRule(DataflowRule):
     rule_id = "JX004"
 
+    # -- dataflow summary: does this function RETURN a narrow value? ---------
+    def initial(self, fn, graph, ctx) -> bool:
+        return self._returns_narrow(graph.index(fn), None, None)
+
+    def transfer(self, fn, facts, graph, ctx) -> bool:
+        if facts.get(fn, False):
+            return True
+        return self._returns_narrow(graph.index(fn), graph.sites_map(fn),
+                                    facts)
+
+    def top(self, fn, graph, ctx) -> bool:
+        return True
+
+    def _returns_narrow(self, idx, sites, facts) -> bool:
+        for stmt in idx.returns:
+            if stmt.value is None:
+                continue
+            # narrowness AT the return site: assigns textually after an
+            # early return must not leak backwards into its verdict
+            narrow_names = self._narrow_names(idx, sites, facts,
+                                              upto=stmt.lineno)
+            if self._expr_narrow(stmt.value, narrow_names, sites, facts):
+                return True
+        return False
+
+    def _narrow_names(self, idx, sites, facts,
+                      upto: Optional[int] = None) -> Set[str]:
+        """Local names holding a narrow value at line ``upto`` (end of
+        function when None), tracked in source order (``idx.assigns`` is
+        source-ordered) so re-widening (``y = y.astype(jnp.float32)``)
+        clears the mark — and a narrowing AFTER the queried line doesn't
+        count against it."""
+        out: Set[str] = set()
+        for node in idx.assigns:
+            if upto is not None and node.lineno >= upto:
+                break
+            narrow = self._expr_narrow(node.value, out, sites, facts)
+            for t in assign_targets(node):
+                for name in assigned_names(t):
+                    if narrow:
+                        out.add(name)
+                    else:
+                        out.discard(name)
+        return out
+
+    def _expr_narrow(self, expr: ast.AST, narrow_names: Set[str],
+                     sites, facts) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in narrow_names
+        if self._narrow_value(expr):
+            return True
+        if isinstance(expr, ast.Call) and sites is not None \
+                and facts is not None:
+            site = sites.get(id(expr))
+            if site is not None and any(
+                    facts.get(t, False) for t in site.targets):
+                return True
+        return False
+
+    # -- the check -----------------------------------------------------------
     def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
         for fn in mod.functions:
             if not fn.jit_reachable:
                 continue
+            sites = graph.sites_map(fn) if graph is not None else None
+            idx = graph.index(fn) if graph is not None else None
             for node in iter_own_statements(fn.node):
                 if not mod.has_x64_guard:
                     hit = self._f64_use(node)
@@ -69,7 +149,7 @@ class FP64DriftRule(Rule):
                 # narrow-accumulator check runs regardless of the x64
                 # guard: the guard legitimizes fp64 storage, not bf16 sums
                 # across the mesh
-                hit = self._narrow_psum(node)
+                hit = self._narrow_psum(node, idx, sites, facts)
                 if hit:
                     yield self.finding(
                         mod, node,
@@ -107,17 +187,31 @@ class FP64DriftRule(Rule):
                     return f'`.astype("{arg.value}")`'
         return None
 
-    @classmethod
-    def _narrow_psum(cls, node: ast.AST) -> Optional[str]:
-        """A psum/pmean whose operand is an EXPLICIT narrow cast — the
-        direct-evidence form of storage-width accumulation (a deeper
-        dataflow pass would chase names; the paired fixtures pin this
-        rule's precision at the cast-at-the-callsite pattern)."""
+    def _narrow_psum(self, node: ast.AST, idx, sites,
+                     facts) -> Optional[str]:
+        """A psum/pmean whose operand is narrow: an explicit cast at the
+        callsite, a local name assigned narrow (source-order tracked AT
+        the callsite — a narrowing after the psum doesn't taint it), or
+        a call into a returns-narrow function — the last two are the
+        dataflow upgrades over the PR-1 cast-at-the-callsite pattern."""
         if not isinstance(node, ast.Call):
             return None
         if call_name(node) not in PSUM_CALLS or not node.args:
             return None
-        return cls._narrow_value(node.args[0])
+        operand = node.args[0]
+        direct = self._narrow_value(operand)
+        if direct:
+            return direct
+        if isinstance(operand, ast.Name) and idx is not None \
+                and operand.id in self._narrow_names(idx, sites, facts,
+                                                     upto=node.lineno):
+            return f"narrow-assigned (`{operand.id}`)"
+        if isinstance(operand, ast.Call) and sites is not None:
+            site = sites.get(id(operand))
+            if site is not None and any(
+                    facts.get(t, False) for t in site.targets):
+                return (f"`{call_name(operand)}(...)`-returned narrow")
+        return None
 
     @staticmethod
     def _narrow_value(expr: ast.AST) -> Optional[str]:

@@ -1,0 +1,401 @@
+"""JX008 — compile-cache explosion at a jit entry point.
+
+Staged computation means the Python callsite is a CACHE LOOKUP: jit
+programs are keyed on abstract shapes/dtypes plus the concrete values of
+static arguments. Feed that key something that varies per loop iteration
+and every "dispatch" silently pays a full trace + XLA compile — seconds
+per step instead of microseconds, unbounded cache growth, and the
+compile-once discipline the serving engine depends on is gone. The
+retracing pitfalls are exactly Frostig et al.'s staged-programming
+hazards; this rule mechanizes them:
+
+* a **loop-varying value in a static position** (``static_argnums`` /
+  ``static_argnames``) — one compile per distinct value;
+* a **loop-varying shape** in a traced position (``prog(x[:i])``,
+  ``jnp.arange(i)`` operands) — one compile per distinct shape; pad to
+  bucketed shapes or lift the loop into the program (``lax.scan``);
+* an **unhashable static argument** (list/dict/set literal) — fails the
+  cache lookup outright (TypeError at every call);
+* a **program built inside a loop** (``jax.jit(...)`` /
+  ``tree_aggregate_fn(...)`` in the body) — a fresh, empty cache each
+  iteration defeats caching even for identical shapes.
+
+Dataflow summaries make the check interprocedural: each function's
+summary records which of its OWN parameters land (transitively, through
+wrappers) in a value-keyed position (``value_keyed``) or flow whole into
+a traced operand slot (``shape_keyed``) of some jit entry. The loop scan
+then flags a call like ``run_one(x, i)`` even though the ``static_argnums``
+entry point is two frames away.
+
+Only host driver code is scanned for the loop hazards: a Python loop
+inside a traced function unrolls into ONE program — its per-iteration
+"calls" are trace-time inlining, not cache lookups.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, last_component)
+from cycloneml_tpu.analysis.dataflow import (COMPREHENSION_NODES, EMPTY, TOP,
+                                             assign_targets,
+                                             CallSite, JitParams,
+                                             ProgramBindingsCache,
+                                             jit_params_of_function,
+                                             join_sets, param_index,
+                                             set_contains)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+
+PROGRAM_BUILD_CALLS = {"jit", "pjit", "tree_aggregate_fn",
+                       "tree_aggregate_with_state"}
+SHAPE_BUILDER_CALLS = {"zeros", "ones", "full", "empty", "arange",
+                       "linspace", "eye"}
+UNHASHABLE_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                    ast.SetComp)
+
+
+class RecompileHazardRule(DataflowRule):
+    rule_id = "JX008"
+
+    def __init__(self):
+        self._bindings = ProgramBindingsCache()
+        self._static_sinks: Dict[FunctionInfo,
+                                 Tuple[frozenset, frozenset]] = {}
+
+    # -- summaries -----------------------------------------------------------
+    # facts: (value_keyed, shape_keyed) — param-index sets (frozenset|TOP)
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        return self._scan_static(fn, graph, ctx)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        vk0, sk0 = self._scan_static(fn, graph, ctx)
+        params = param_index(fn)
+        vk: Set[int] = set()
+        sk: Set[int] = set()
+        if params:
+            # facts-dependent part: params flowing into wrapper callees'
+            # sink positions (sites only — no AST re-walk per visit)
+            for site in graph.sites(fn):
+                for target in site.targets:
+                    if jit_params_of_function(target) is not None:
+                        continue   # handled by the static scan
+                    summary = facts.get(target)
+                    if summary is None:
+                        continue
+                    tvk, tsk = summary
+                    for pi, expr in site.param_map(target):
+                        if set_contains(tvk, pi):
+                            _sink_value(expr, params, vk)
+                        elif set_contains(tsk, pi):
+                            _sink_shape(expr, params, vk, sk)
+        old_vk, old_sk = facts.get(fn, (EMPTY, EMPTY))
+        return (join_sets(join_sets(vk0, frozenset(vk)), old_vk),
+                join_sets(join_sets(sk0, frozenset(sk)), old_sk))
+
+    def top(self, fn, graph, ctx):
+        return (TOP, TOP)
+
+    def _bindings_for(self, fn: FunctionInfo, ctx,
+                      graph) -> Dict[str, JitParams]:
+        return self._bindings.bindings_for(fn, ctx, graph)
+
+    def _scan_static(self, fn: FunctionInfo, graph, ctx
+                     ) -> Tuple[frozenset, frozenset]:
+        """Facts-independent sinks: params feeding bound jit programs and
+        jit-decorated callees directly (cached; the fixpoint revisits
+        only the wrapper part)."""
+        got = self._static_sinks.get(fn)
+        if got is not None:
+            return got
+        params = param_index(fn)
+        if not params:
+            self._static_sinks[fn] = (EMPTY, EMPTY)
+            return (EMPTY, EMPTY)
+        bindings = self._bindings_for(fn, ctx, graph)
+        sites = graph.sites_map(fn)
+        resolve = _resolver_for(fn, graph)
+        vk: Set[int] = set()
+        sk: Set[int] = set()
+        for node in graph.index(fn).calls:
+            for pos_kind, expr in _entry_arg_kinds(node, bindings,
+                                                   sites.get(id(node)),
+                                                   None, resolve):
+                if pos_kind == "static":
+                    _sink_value(expr, params, vk)
+                else:
+                    _sink_shape(expr, params, vk, sk)
+        result = (frozenset(vk), frozenset(sk))
+        self._static_sinks[fn] = result
+        return result
+
+    # -- the check -----------------------------------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            bindings = self._bindings_for(fn, ctx, graph)
+            sites = graph.sites_map(fn)
+            resolve = _resolver_for(fn, graph)
+            # unhashable statics fail regardless of loops or reachability
+            yield from self._check_unhashable(mod, fn, bindings, sites,
+                                              graph, resolve)
+            if fn.jit_reachable:
+                continue   # a loop inside a trace unrolls into ONE program
+            flagged: Set[int] = set()
+            for node in graph.index(fn).loops:
+                varying = _loop_varying_names(node)
+                if varying:
+                    yield from self._check_loop(
+                        mod, fn, node, varying, bindings, sites, facts,
+                        flagged, resolve)
+                yield from self._check_builds_in_loop(mod, fn, node,
+                                                      flagged)
+
+    def _check_unhashable(self, mod, fn, bindings, sites, graph, resolve
+                          ) -> Iterator[Finding]:
+        for node in graph.index(fn).calls:
+            for kind, expr in _entry_arg_kinds(node, bindings,
+                                               sites.get(id(node)), None,
+                                               resolve):
+                if kind == "static" and isinstance(expr, UNHASHABLE_NODES):
+                    yield self.finding(
+                        mod, node,
+                        "unhashable static argument (list/dict/set) to a "
+                        "jit entry point — the compile-cache lookup raises "
+                        "TypeError at every call; pass a tuple or other "
+                        "hashable config",
+                        fn.qualname)
+
+    def _check_loop(self, mod, fn, loop, varying: Set[str], bindings,
+                    sites, facts, flagged: Set[int], resolve
+                    ) -> Iterator[Finding]:
+        for node in _loop_body_nodes(loop):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            for kind, expr in _entry_arg_kinds(node, bindings,
+                                               sites.get(id(node)), facts,
+                                               resolve):
+                if kind == "static":
+                    hit = _names_in(expr) & varying
+                    if hit:
+                        flagged.add(id(node))
+                        yield self.finding(
+                            mod, node,
+                            f"loop-varying value `{sorted(hit)[0]}` feeds a "
+                            f"compile-cache-keyed (static) position of a "
+                            f"jit entry point — a NEW program is traced and "
+                            f"compiled every iteration (cache-key "
+                            f"explosion); hoist the static out of the loop "
+                            f"or make it a traced operand",
+                            fn.qualname)
+                        break
+                else:
+                    hit = _shape_determinant_names(expr) & varying
+                    if hit:
+                        flagged.add(id(node))
+                        yield self.finding(
+                            mod, node,
+                            f"loop-varying shape (`{sorted(hit)[0]}` sizes "
+                            f"an operand) fed to a jit entry point — each "
+                            f"distinct shape recompiles; pad to fixed "
+                            f"shape buckets or lift the loop into the "
+                            f"program (lax.scan/fori_loop)",
+                            fn.qualname)
+                        break
+
+    def _check_builds_in_loop(self, mod, fn, loop, flagged: Set[int]
+                              ) -> Iterator[Finding]:
+        for node in _loop_body_nodes(loop):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            base = last_component(call_name(node))
+            if base in PROGRAM_BUILD_CALLS:
+                flagged.add(id(node))
+                yield self.finding(
+                    mod, node,
+                    f"`{base}(...)` builds a jit program INSIDE a loop — "
+                    f"each iteration gets a fresh, empty compile cache, so "
+                    f"even identical shapes recompile; build once outside "
+                    f"the loop and dispatch the bound program",
+                    fn.qualname)
+
+
+# -- helpers ------------------------------------------------------------------
+
+def _resolver_for(fn, graph):
+    """Callee-name resolution bound to ``fn``'s scope (memoized by the
+    shared CallResolver)."""
+    return lambda name: graph.resolver.resolve(fn, name)
+
+
+def _sink_value(expr: ast.AST, params: Dict[str, int],
+                vk: Set[int]) -> None:
+    """Params named anywhere in ``expr`` feed a value-keyed cache slot."""
+    for name in _names_in(expr):
+        if name in params:
+            vk.add(params[name])
+
+
+def _sink_shape(expr: ast.AST, params: Dict[str, int], vk: Set[int],
+                sk: Set[int]) -> None:
+    """A param passed WHOLE into a traced slot is shape-keyed; a param
+    sizing the operand (slice bound / constructor size) is value-keyed —
+    its value picks the shape."""
+    if isinstance(expr, ast.Name) and expr.id in params:
+        sk.add(params[expr.id])
+    for name in _shape_determinant_names(expr):
+        if name in params:
+            vk.add(params[name])
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _shape_determinant_names(expr: ast.AST) -> Set[str]:
+    """Names whose VALUE determines the shape of ``expr``'s result:
+    slice bounds (``x[:i]``) and size arguments of array constructors
+    (``jnp.arange(i)``, ``jnp.zeros((i, d))``)."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Subscript):
+            slices = [node.slice]
+            if isinstance(node.slice, ast.Tuple):
+                slices = list(node.slice.elts)
+            for sl in slices:
+                if isinstance(sl, ast.Slice):
+                    for bound in (sl.lower, sl.upper, sl.step):
+                        if bound is not None:
+                            out.update(_names_in(bound))
+        elif isinstance(node, ast.Call):
+            if last_component(call_name(node)) in SHAPE_BUILDER_CALLS:
+                shape_args = list(node.args[:1]) + [
+                    kw.value for kw in node.keywords if kw.arg == "shape"]
+                for a in shape_args:
+                    out.update(_names_in(a))
+    return out
+
+
+def _kw_static_names(jp: JitParams, resolve) -> frozenset:
+    """Param NAMES behind ``static_argnums`` when the wrapped function
+    resolves — JAX keys a keyword call onto the static position just
+    like the positional form (``prog(x, width=i)`` recompiles per
+    distinct ``i``), so the classification must too."""
+    if not jp.static_argnums or jp.wrapped is None or resolve is None:
+        return EMPTY
+    targets = resolve(jp.wrapped)
+    if len(targets) != 1:
+        return EMPTY
+    params = param_index(targets[0])
+    return frozenset(n for n, i in params.items()
+                     if i in jp.static_argnums)
+
+
+def _entry_arg_kinds(call: ast.Call, bindings: Dict[str, JitParams],
+                     site: Optional[CallSite], facts, resolve=None
+                     ) -> List[Tuple[str, ast.AST]]:
+    """Classify this call's arguments against jit-entry semantics:
+    ("static", expr) for value-keyed positions, ("traced", expr) for
+    traced operand positions. Empty when the callee is not a known jit
+    entry / hazard-carrying wrapper. ``resolve`` (name ->
+    [FunctionInfo]) maps keyword calls onto static_argnums positions
+    via the wrapped function's signature."""
+    out: List[Tuple[str, ast.AST]] = []
+    # 1) a bound program name: prog = jax.jit(f, static_argnums=...)
+    jp: Optional[JitParams] = None
+    if isinstance(call.func, ast.Name) and call.func.id in bindings:
+        jp = bindings[call.func.id]
+    if jp is not None:
+        if jp.statics_known:
+            for pos, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    break
+                out.append(("static" if pos in jp.static_argnums
+                            else "traced", arg))
+            kw_static = _kw_static_names(jp, resolve)
+            for kw in call.keywords:
+                if kw.arg is not None:
+                    out.append(("static" if (kw.arg in jp.static_argnames
+                                             or kw.arg in kw_static)
+                                else "traced", kw.value))
+        return out
+    if site is None:
+        return out
+    for target in site.targets:
+        tjp = jit_params_of_function(target)
+        if tjp is not None:
+            # a jit-decorated function called directly
+            if not tjp.statics_known:
+                continue
+            params = param_index(target)
+            static_idx = set(tjp.static_argnums) | {
+                params[n] for n in tjp.static_argnames if n in params}
+            for pi, expr in site.param_map(target):
+                out.append(("static" if pi in static_idx else "traced",
+                            expr))
+        elif facts is not None:
+            # 3) a wrapper whose summary carries sink positions
+            summary = facts.get(target)
+            if summary is None:
+                continue
+            vk, sk = summary
+            for pi, expr in site.param_map(target):
+                if set_contains(vk, pi):
+                    out.append(("static", expr))
+                elif set_contains(sk, pi):
+                    out.append(("traced", expr))
+    return out
+
+
+def _loop_varying_names(loop: ast.AST) -> Set[str]:
+    """Names that take a new value each iteration: the for-target (or
+    every comprehension generator target, plus one derivation pass over
+    body assignments), or counters aug-assigned in a while body."""
+    varying: Set[str] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        varying.update(assigned_names(loop.target))
+    elif isinstance(loop, COMPREHENSION_NODES):
+        for gen in loop.generators:
+            varying.update(assigned_names(gen.target))
+    for node in _loop_body_nodes(loop):
+        if isinstance(node, ast.AugAssign):
+            varying.update(assigned_names(node.target))
+    # one derivation pass: names assigned from varying expressions
+    for node in _loop_body_nodes(loop):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                and getattr(node, "value", None) is not None:
+            if _names_in(node.value) & varying:
+                for t in assign_targets(node):
+                    varying.update(assigned_names(t))
+    return varying
+
+
+def _loop_body_nodes(loop: ast.AST):
+    """All nodes under a loop body (orelse excluded — it runs once),
+    nested defs excluded. For comprehensions the per-iteration body is
+    the element expression(s) plus inner generators' iterables and every
+    `if` filter (the FIRST iterable is evaluated once, outside)."""
+    if isinstance(loop, COMPREHENSION_NODES):
+        stack = ([loop.key, loop.value] if isinstance(loop, ast.DictComp)
+                 else [loop.elt])
+        for i, gen in enumerate(loop.generators):
+            if i > 0:
+                stack.append(gen.iter)
+            stack.extend(gen.ifs)
+    else:
+        stack = list(loop.body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,389 @@
+"""JX009 — read of a buffer after it was donated to a jit program.
+
+``donate_argnums`` hands an input buffer to XLA for in-place reuse — the
+safety net the out-of-core streaming engine needs to overlap transfer
+with compute without doubling HBM. The price: the donated ``jax.Array``
+is DELETED the moment the program dispatches, and any later read raises
+``RuntimeError: Array has been deleted`` — but only at runtime, only on
+backends where the donation was usable, and possibly only on the code
+path that re-reads. This rule proves the discipline statically.
+
+Dataflow summary: the set of a function's OWN parameter positions that
+end up donated when it is called — seeded from direct
+``jax.jit(..., donate_argnums=...)`` program calls (module- or
+function-local bindings and donate-decorated functions) and propagated
+through wrappers (``advance(state)`` that internally feeds ``state`` into
+a donating dispatch donates ITS caller's buffer just as surely). The
+per-function check then runs a source-order deadness scan: a name read
+after flowing into a donated position — with no rebinding in between —
+is flagged, as is a donation inside a loop whose name is never rebound
+from the program's result (the second iteration re-dispatches a deleted
+buffer).
+
+The idiomatic pattern stays silent::
+
+    state = step(state, x)     # donated AND rebound: old buffer was dead
+
+Only reachable-in-host-driver code is scanned: inside a traced region a
+"donation" is an inner-jit no-op on tracers, not a buffer hand-off.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, last_component)
+from cycloneml_tpu.analysis.dataflow import (COMPREHENSION_NODES, EMPTY, TOP,
+                                             CallSite, JitParams,
+                                             ProgramBindingsCache,
+                                             jit_params_of_function,
+                                             join_sets, param_index,
+                                             set_contains)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+
+# aval-level metadata survives deletion: a donated jax.Array keeps its
+# shape/dtype/etc — only the BUFFER is gone, so these reads are legal
+METADATA_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "nbytes",
+                            "sharding", "aval", "is_deleted"})
+
+
+class UseAfterDonateRule(DataflowRule):
+    rule_id = "JX009"
+
+    def __init__(self):
+        self._bindings = ProgramBindingsCache()
+        self._own_donations: Dict[FunctionInfo, frozenset] = {}
+
+    # -- summaries: which of MY params get donated when I'm called? ----------
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        return self._static_donations(fn, graph, ctx)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        out = set()
+        params = param_index(fn)
+        if params:
+            # facts-dependent part: params handed whole into a donated
+            # position of a resolved callee (sites only — no AST re-walk)
+            for site in graph.sites(fn):
+                for target in site.targets:
+                    summary = facts.get(target)
+                    if not summary or summary is TOP:
+                        continue
+                    for pi, expr in site.param_map(target):
+                        if set_contains(summary, pi) \
+                                and isinstance(expr, ast.Name) \
+                                and expr.id in params:
+                            out.add(params[expr.id])
+        return join_sets(
+            join_sets(self._static_donations(fn, graph, ctx),
+                      frozenset(out)),
+            facts.get(fn, EMPTY))
+
+    def _bindings_for(self, fn: FunctionInfo, ctx,
+                      graph) -> Dict[str, JitParams]:
+        return self._bindings.bindings_for(fn, ctx, graph)
+
+    def _static_donations(self, fn: FunctionInfo, graph, ctx) -> frozenset:
+        """Facts-independent donations of ``fn``'s own params: the
+        donate-decorator contract plus flows into bound donating programs
+        (cached — the fixpoint revisits only the sites part)."""
+        got = self._own_donations.get(fn)
+        if got is not None:
+            return got
+        jp = jit_params_of_function(fn)
+        out: Set[int] = set(jp.donate_argnums) if jp else set()
+        params = param_index(fn)
+        if params:
+            bindings = self._bindings_for(fn, ctx, graph)
+            for node in graph.index(fn).calls:
+                for name in _donated_names(node, bindings, None, None):
+                    if name in params:
+                        out.add(params[name])
+        result = frozenset(out)
+        self._own_donations[fn] = result
+        return result
+
+    # -- the check: source-order deadness scan -------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if fn.jit_reachable:
+                continue
+            yield from self._check_fn(mod, fn, ctx)
+
+    def _check_fn(self, mod: ModuleInfo, fn: FunctionInfo,
+                  ctx: AnalysisContext) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        bindings = self._bindings_for(fn, ctx, graph)
+        sites = graph.sites_map(fn)
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        findings: List[Finding] = []
+        dead: Dict[str, ast.Call] = {}   # name -> donation site
+
+        def visit_expr(expr: ast.AST) -> None:
+            """In-order expression walk: reads checked against the dead
+            set; donation marks apply AFTER the donating call's own
+            argument evaluation (left-to-right, like the runtime)."""
+            if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
+                if expr.id in dead:
+                    don = dead[expr.id]
+                    findings.append(self.finding(
+                        mod, expr,
+                        f"`{expr.id}` is read after being donated to a jit "
+                        f"program at line {don.lineno} "
+                        f"(`donate_argnums`) — the buffer is deleted by "
+                        f"that dispatch; read before dispatching, or bind "
+                        f"a fresh value from the program's result",
+                        fn.qualname))
+                    dead.pop(expr.id, None)   # one finding per hazard
+                return
+            if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(expr, COMPREHENSION_NODES):
+                visit_comprehension(expr)
+                return
+            if isinstance(expr, ast.Attribute) \
+                    and expr.attr in METADATA_ATTRS \
+                    and isinstance(expr.value, ast.Name):
+                # x.shape / x.dtype after donation never touches the
+                # deleted buffer — telemetry reads stay legal
+                return
+            if isinstance(expr, ast.Call):
+                for child in ast.iter_child_nodes(expr):
+                    visit_expr(child)
+                for name in _donated_names(expr, bindings,
+                                           sites.get(id(expr)), facts):
+                    dead[name] = expr
+                return
+            for child in ast.iter_child_nodes(expr):
+                visit_expr(child)
+
+        def visit_comprehension(comp: ast.AST) -> None:
+            """A comprehension iterates: a donation in its body that is
+            not rebound per-iteration (comprehensions CANNOT rebind an
+            outer name) re-dispatches a deleted buffer on iteration two —
+            the spelled-out-loop hazard in its most idiomatic form."""
+            bound: Set[str] = set()
+            for i, gen in enumerate(comp.generators):
+                visit_expr(gen.iter)
+                bound.update(assigned_names(gen.target))
+            before = set(dead)
+            body = ([comp.key, comp.value]
+                    if isinstance(comp, ast.DictComp) else [comp.elt])
+            for gen in comp.generators:
+                body.extend(gen.ifs)
+            for part in body:
+                visit_expr(part)
+            for name, don in list(dead.items()):
+                if name in before or name in bound:
+                    continue
+                findings.append(self.finding(
+                    mod, don,
+                    f"`{name}` is donated inside this comprehension but "
+                    f"cannot be rebound from the program's result — the "
+                    f"next iteration dispatches a deleted buffer; use a "
+                    f"spelled-out loop with `{name} = prog({name}, ...)` "
+                    f"or lax.scan",
+                    fn.qualname))
+                dead.pop(name, None)
+
+        def bind(target: ast.AST) -> None:
+            for n in assigned_names(target):
+                dead.pop(n, None)
+
+        def run_block(body) -> Optional[str]:
+            """Process statements in order. Returns how the block
+            terminates: ``"exit"`` (return/raise — control leaves the
+            function, so post-loop code never sees this path),
+            ``"break"`` (leaves the loop but FALLS INTO post-loop code),
+            ``"loop"`` (continue — the next iteration still runs), or
+            None (falls through). Terminated branches don't merge their
+            deadness back."""
+            terminated: Optional[str] = None
+            for stmt in body:
+                if terminated:
+                    break
+                terminated = run_stmt(stmt)
+            return terminated
+
+        def run_stmt(stmt: ast.AST) -> Optional[str]:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return False
+            if isinstance(stmt, ast.Assign):
+                visit_expr(stmt.value)
+                for t in stmt.targets:
+                    bind(t)
+                return False
+            if isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    visit_expr(stmt.value)
+                bind(stmt.target)
+                return False
+            if isinstance(stmt, ast.AugAssign):
+                visit_expr(stmt.value)
+                # `x += v` READS x before rebinding it
+                name = _aug_name(stmt)
+                if name is not None:
+                    read = ast.copy_location(
+                        ast.Name(id=name, ctx=ast.Load()), stmt.target)
+                    visit_expr(read)
+                bind(stmt.target)
+                return False
+            if isinstance(stmt, (ast.Expr, ast.Return, ast.Yield)):
+                value = getattr(stmt, "value", None)
+                if value is not None:
+                    visit_expr(value)
+                return "exit" if isinstance(stmt, ast.Return) else None
+            if isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
+                if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                    visit_expr(stmt.exc)
+                # continue still reaches the NEXT iteration; return/raise/
+                # break leave the loop — and break (unlike return/raise)
+                # carries its deadness into the post-loop code
+                if isinstance(stmt, ast.Continue):
+                    return "loop"
+                return "break" if isinstance(stmt, ast.Break) else "exit"
+            if isinstance(stmt, ast.If):
+                visit_expr(stmt.test)
+                before = dict(dead)
+                t_body = run_block(stmt.body)
+                after_body = dict(dead)
+                dead.clear()
+                dead.update(before)
+                t_else = run_block(stmt.orelse)
+                after_else = dict(dead)
+                # may-dead merge; a branch that terminated (return/raise/
+                # break/continue) contributes nothing to the fall-through
+                dead.clear()
+                if not t_body:
+                    dead.update(after_body)
+                if not t_else:
+                    dead.update(after_else)
+                if t_body and t_else:
+                    # weakest terminator wins: a "loop" path means the
+                    # next iteration is still reachable; a "break" path
+                    # means post-loop code is
+                    for kind in ("loop", "break", "exit"):
+                        if kind in (t_body, t_else):
+                            return kind
+                return None
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    visit_expr(stmt.iter)
+                    bind(stmt.target)
+                else:
+                    visit_expr(stmt.test)
+                before_loop = dict(dead)
+                donated_before = set(dead)
+                term = run_block(stmt.body)
+                # a name donated INSIDE the loop and still dead at the end
+                # of the body is re-read by the donating dispatch on the
+                # next iteration — unless every body path leaves the loop
+                # (return/raise/break): then no second iteration exists
+                # ("continue" paths DO re-iterate and stay checked)
+                for name, don in ([] if term in ("exit", "break")
+                                  else list(dead.items())):
+                    if name in donated_before:
+                        continue
+                    if don.lineno >= stmt.lineno:
+                        findings.append(self.finding(
+                            mod, don,
+                            f"`{name}` is donated inside this loop but "
+                            f"never rebound from the program's result — "
+                            f"the next iteration dispatches a deleted "
+                            f"buffer; use `{name} = prog({name}, ...)` "
+                            f"so the donation consumes a dead value",
+                            fn.qualname))
+                        dead.pop(name, None)
+                if term == "exit":
+                    # every body path returns/raises: post-loop code is
+                    # only reachable via the zero-iteration path, where
+                    # none of the body's donations happened ("break"
+                    # paths DO fall into post-loop code and keep theirs)
+                    dead.clear()
+                    dead.update(before_loop)
+                run_block(stmt.orelse)
+                return False
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    visit_expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        bind(item.optional_vars)
+                # `with` neither catches nor redirects control flow — a
+                # return inside the span idiom still terminates the loop
+                return run_block(stmt.body)
+            if isinstance(stmt, ast.Try):
+                t_body = run_block(stmt.body)
+                handler_terms = [run_block(h.body) for h in stmt.handlers]
+                t_orelse = run_block(stmt.orelse)
+                t_final = run_block(stmt.finalbody)
+                if t_final:
+                    return t_final
+                # no-exception path terminates via body or orelse; each
+                # caught-exception path via its handler — the try
+                # terminates only when EVERY path does (weakest kind wins)
+                terms = [t_body or t_orelse] + handler_terms
+                if all(terms):
+                    for kind in ("loop", "break", "exit"):
+                        if kind in terms:
+                            return kind
+                return False
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    bind(t)
+                return False
+            for child in ast.iter_child_nodes(stmt):
+                visit_expr(child)
+            return False
+
+        run_block(getattr(fn.node, "body", []))
+        yield from findings
+
+
+def _aug_name(stmt: ast.AugAssign) -> Optional[str]:
+    return stmt.target.id if isinstance(stmt.target, ast.Name) else None
+
+
+def _donated_names(call: ast.Call, bindings: Dict[str, JitParams],
+                   site: Optional[CallSite], facts) -> List[str]:
+    """Plain names this call donates: via a bound donating program
+    (``prog = jax.jit(f, donate_argnums=...)``), an inline
+    ``jax.jit(f, donate_argnums=...)(args)`` dispatch, or a resolved
+    callee whose summary says it donates that parameter."""
+    out: List[str] = []
+    donate: frozenset = EMPTY
+    if isinstance(call.func, ast.Name) and call.func.id in bindings:
+        donate = bindings[call.func.id].donate_argnums
+    elif isinstance(call.func, ast.Call) \
+            and last_component(call_name(call.func)) in ("jit", "pjit"):
+        donate = parse_inline(call.func)
+    if donate:
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if pos in donate and isinstance(arg, ast.Name):
+                out.append(arg.id)
+    if site is not None and facts is not None:
+        for target in site.targets:
+            summary = facts.get(target)
+            if not summary or summary is TOP:
+                # TOP only arises from hard widening; treating it as
+                # donate-nothing keeps the rule quiet over noise
+                continue
+            for pi, expr in site.param_map(target):
+                if set_contains(summary, pi) and isinstance(expr, ast.Name):
+                    out.append(expr.id)
+    return out
+
+
+def parse_inline(jit_call: ast.Call) -> frozenset:
+    from cycloneml_tpu.analysis.dataflow import parse_jit_params
+    return parse_jit_params(jit_call).donate_argnums

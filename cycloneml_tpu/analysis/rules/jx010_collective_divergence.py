@@ -1,0 +1,223 @@
+"""JX010 — collective reachable under host-divergent branching.
+
+Every collective is a RENDEZVOUS: all mesh participants must execute the
+same program in the same order, or the straggler side blocks forever (the
+PR-2 ``OneVsRest`` deadlock class, this time across hosts instead of
+threads). GSPMD's program-uniformity invariant says the *structure* of
+the dispatched program may not depend on values that differ per process.
+A Python branch whose condition derives from a host-LOCAL source —
+``jax.process_index()``, wall-clock time, ``random``, pids, hostnames,
+environment variables — violates exactly that when a collective is
+reachable under it: process 0 dispatches the psum program, process 1
+never shows up, and the mesh hangs at 3 a.m. with no traceback.
+
+Two dataflow summaries make the rule interprocedural:
+
+* ``reaches_collective`` — the function (transitively, through resolved
+  callees) dispatches a collective (``psum``-family, ``tree_aggregate``
+  family, ``all_gather_hosts``, ...).
+* ``returns_divergent`` — its return value derives from a host-local
+  source, so ``if is_primary():`` is as hazardous as
+  ``if jax.process_index() == 0:``.
+
+Uniform branches stay silent: config flags, shape checks, values reduced
+THROUGH a collective (already mesh-uniform by construction), and
+divergent branches that only guard host-local work (logging, primary-only
+checkpoint writes) are all fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, last_component)
+from cycloneml_tpu.analysis.dataflow import assign_targets
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+
+# dispatch surfaces that rendezvous the mesh (jax.lax collectives + the
+# repo's own aggregate waists)
+COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                    "psum_over_mesh", "tree_aggregate",
+                    "tree_aggregate_with_state", "all_gather_hosts",
+                    "all_to_all_repartition"}
+
+# host-local value sources: full dotted form (module functions whose bare
+# name would be too common) ...
+DIVERGENT_DOTTED = {
+    "jax.process_index", "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "random.random", "random.randint",
+    "random.uniform", "random.choice", "random.sample", "random.shuffle",
+    "random.getrandbits", "os.getenv", "os.getpid", "os.urandom",
+    "os.environ.get", "socket.gethostname", "uuid.uuid1", "uuid.uuid4",
+    "secrets.token_bytes", "secrets.token_hex",
+}
+# ... and bare names that are unambiguous however imported
+DIVERGENT_BARE = {"process_index", "host_id", "monotonic", "perf_counter",
+                  "getpid", "gethostname", "uuid4"}
+
+
+class CollectiveDivergenceRule(DataflowRule):
+    rule_id = "JX010"
+
+    # facts: (reaches_collective, returns_divergent)
+    def initial(self, fn: FunctionInfo, graph, ctx) -> Tuple[bool, bool]:
+        idx = graph.index(fn)
+        return (_own_collective(fn),
+                _returns_divergent(idx, set(), lambda call: False))
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx
+                 ) -> Tuple[bool, bool]:
+        reaches, div = facts.get(fn, (False, False))
+        reaches = reaches or _own_collective(fn)
+        sites = graph.sites_map(fn)
+        idx = graph.index(fn)
+
+        def callee_divergent(call: ast.Call) -> bool:
+            site = sites.get(id(call))
+            return site is not None and any(
+                facts.get(t, (False, False))[1] for t in site.targets)
+
+        if not reaches:
+            for site in graph.sites(fn):
+                if any(facts.get(t, (False, False))[0]
+                       for t in site.targets):
+                    reaches = True
+                    break
+        if not div:
+            div_names = _divergent_names(idx, callee_divergent)
+            div = _returns_divergent(idx, div_names, callee_divergent)
+        return (reaches, div)
+
+    def top(self, fn, graph, ctx):
+        return (True, True)
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            idx = graph.index(fn)
+            if not idx.branches:
+                continue
+            sites = graph.sites_map(fn)
+
+            def callee_divergent(call: ast.Call) -> bool:
+                site = sites.get(id(call))
+                return site is not None and any(
+                    facts.get(t, (False, False))[1] for t in site.targets)
+
+            def call_reaches_collective(call: ast.Call) -> bool:
+                if last_component(call_name(call)) in COLLECTIVE_CALLS:
+                    return True
+                site = sites.get(id(call))
+                return site is not None and any(
+                    facts.get(t, (False, False))[0] for t in site.targets)
+
+            div_names = _divergent_names(idx, callee_divergent)
+            for node in idx.branches:
+                if not _expr_divergent(node.test, div_names,
+                                       callee_divergent):
+                    continue
+                hit = _branch_collective(node, call_reaches_collective)
+                if hit is None:
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f"collective `{_describe(hit)}` is reachable under a "
+                    f"branch on a host-local value — mesh participants can "
+                    f"disagree on program structure and deadlock the "
+                    f"rendezvous (every process must dispatch the same "
+                    f"collectives in the same order); hoist the collective "
+                    f"out of the branch or derive the condition from a "
+                    f"mesh-uniform value",
+                    fn.qualname)
+
+
+def _describe(call: ast.Call) -> str:
+    return call_name(call) or "<call>"
+
+
+def _own_collective(fn: FunctionInfo) -> bool:
+    for name in fn.calls:
+        if last_component(name) in COLLECTIVE_CALLS:
+            return True
+    return False
+
+
+def _call_divergent_source(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name is None:
+        return False
+    if name in DIVERGENT_DOTTED:
+        return True
+    base = last_component(name)
+    return base in DIVERGENT_BARE
+
+
+def _expr_divergent(expr: ast.AST, div_names: Set[str],
+                    callee_divergent) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in div_names
+    if isinstance(expr, ast.Call):
+        if last_component(call_name(expr)) in COLLECTIVE_CALLS:
+            # a value reduced THROUGH a collective is mesh-uniform by
+            # construction — `pmax(elapsed)` launders a host-local input
+            # (every participant sees the same reduced result)
+            return False
+        if _call_divergent_source(expr) or callee_divergent(expr):
+            return True
+    if isinstance(expr, ast.Subscript):
+        # os.environ["..."] reads
+        from cycloneml_tpu.analysis.astutil import dotted_name
+        if dotted_name(expr.value) == "os.environ":
+            return True
+    return any(_expr_divergent(child, div_names, callee_divergent)
+               for child in ast.iter_child_nodes(expr))
+
+
+def _divergent_names(idx, callee_divergent) -> Set[str]:
+    """Names assigned from host-divergent expressions, two-pass
+    (loop-carried assignments converge on the second pass)."""
+    out: Set[str] = set()
+    for _ in range(2):
+        for stmt in idx.assigns:
+            if _expr_divergent(stmt.value, out, callee_divergent):
+                for t in assign_targets(stmt):
+                    out.update(assigned_names(t))
+    return out
+
+
+def _returns_divergent(idx, div_names: Set[str],
+                       callee_divergent) -> bool:
+    for stmt in idx.returns:
+        if stmt.value is not None and _expr_divergent(
+                stmt.value, div_names, callee_divergent):
+            return True
+    return False
+
+
+def _branch_collective(node: ast.AST, call_reaches_collective):
+    """First collective-reaching call under a branch (its own statements
+    only, nested defs excluded), else None. ``IfExp`` arms are single
+    expressions — the one-line `agg(x) if primary else None` spelling
+    deadlocks exactly like the block form."""
+    body = node.body if isinstance(node.body, list) else [node.body]
+    orelse = getattr(node, "orelse", [])
+    orelse = orelse if isinstance(orelse, list) else [orelse]
+    stack = body + orelse
+    while stack:
+        sub = stack.pop(0)
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(sub, ast.Call) and call_reaches_collective(sub):
+            return sub
+        stack.extend(ast.iter_child_nodes(sub))
+    return None

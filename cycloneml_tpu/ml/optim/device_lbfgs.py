@@ -98,7 +98,7 @@ def _budget_guarded_chunk(name: str, key, prog, args, chunk: int, ctx,
 
 
 def _build_chunk(compiled, l2_t, m: int, K: int, c1: float, c2: float,
-                 max_ls: int, cdt: np.dtype):
+                 max_ls: int, cdt: np.dtype, *, n_arrays: int):
     """jit program: K L-BFGS iterations on device.
 
     Args: (*arrays, coef, S, Y, k_hist, f0, g0, first, ws, tol, grad_tol,
@@ -106,6 +106,18 @@ def _build_chunk(compiled, l2_t, m: int, K: int, c1: float, c2: float,
     evals, converged_code, f0, g0). ``l2_t`` is the penalty's jnp twin
     (``l2_regularization(...).traceable``) — the SAME implementation the
     fused line search inlines, so the two device paths cannot drift.
+
+    The big state operands — coef ``(n,)`` and the two ``(m, n)``
+    curvature ring buffers plus the gradient — are DONATED: each chunk
+    consumes the previous chunk's output, so the old buffers are dead the
+    moment the dispatch leaves the host (graftlint JX009 is the static
+    safety net for exactly this discipline). XLA aliases them onto the
+    matching outputs, shaving ``2·m·n + 2·n`` accumulator-width elements
+    off the program's peak HBM — visible as an ``hbm_peak_bytes`` drop in
+    the cost rollup (`alias_size_in_bytes` is subtracted at the
+    observe/costs.py waist). ``n_arrays`` positions the donated argnums
+    past the data arrays, which are REUSED across dispatches and must
+    never be donated.
     """
     import jax
     import jax.numpy as jnp
@@ -216,7 +228,16 @@ def _build_chunk(compiled, l2_t, m: int, K: int, c1: float, c2: float,
             jax.lax.while_loop(cond, body, init)
         return coef, S, Y, k, f, g, losses, it, evals, code, f0, g0
 
-    return jax.jit(program)
+    # donate the S/Y ring buffers (positions past the data arrays) — at
+    # 2·m·n they dominate the optimizer state's HBM, and the driver only
+    # ever exposes SLICES of them (hist_s/hist_y are fresh gather
+    # outputs), so no caller can hold the donated buffers. coef/grad are
+    # deliberately NOT donated: the generator yields them as
+    # OptimState.x/.grad and the resilience retry/checkpoint path retains
+    # those states across chunk dispatches — donating them would delete
+    # the retained state's buffers behind the caller's back (exactly the
+    # JX009 hazard class, one dispatch later)
+    return jax.jit(program, donate_argnums=(n_arrays + 1, n_arrays + 2))
 
 
 class DeviceLBFGS(LBFGS):
@@ -264,7 +285,8 @@ class DeviceLBFGS(LBFGS):
             fresh = prog is None  # first dispatch pays trace + compile
             if fresh:
                 prog = _build_chunk(f._agg_call.compiled, l2_t, self.m,
-                                    k, self.c1, self.c2, self.max_ls, cdt)
+                                    k, self.c1, self.c2, self.max_ls, cdt,
+                                    n_arrays=len(arrays))
                 _program_cache.put(key, prog)
             return key, prog, fresh
 
@@ -288,9 +310,14 @@ class DeviceLBFGS(LBFGS):
             yield state
             if state.converged:
                 return
-            coef = jnp.asarray(state.x, cdt)
+            # jnp.array (copy=True), NOT asarray: a resume state may hand
+            # us live device arrays; the copy keeps the generator's
+            # working buffers disjoint from whatever the caller retains
+            # (coef/grad are never donated — see _build_chunk — but the
+            # resume contract shouldn't depend on that)
+            coef = jnp.array(state.x, cdt)
             f_d = cdt.type(state.value)
-            g_d = jnp.asarray(state.grad, cdt)
+            g_d = jnp.array(state.grad, cdt)
         else:
             # fresh fit: f(x0) is computed INSIDE the first chunk dispatch;
             # the iteration-0 state is yielded when that chunk returns
@@ -402,7 +429,7 @@ class DeviceLBFGS(LBFGS):
 # -- stacked (model-axis) variant ---------------------------------------------
 
 def _build_stacked_chunk(compiled, m: int, K_iters: int, c1: float, c2: float,
-                         max_ls: int, cdt: np.dtype):
+                         max_ls: int, cdt: np.dtype, *, n_arrays: int):
     """jit program: up to ``K_iters`` L-BFGS iterations for a STACK of
     models inside one dispatch.
 
@@ -555,7 +582,14 @@ def _build_stacked_chunk(compiled, m: int, K_iters: int, c1: float, c2: float,
         return (coef, S, Y, k, f, g, losses, step, iters, ev_pm, ev_g,
                 code, f_init)
 
-    return jax.jit(program)
+    # donate the FULL stacked state — coef (K,n), S/Y (K,m,n), g (K,n):
+    # unlike the serial generator, minimize() is not resumable and never
+    # yields mid-run, so these buffers cannot be retained by a caller —
+    # the driver rebinds all four from the outputs every chunk and the
+    # inputs really are dead on dispatch; the (K,m,n) ring buffers
+    # dominate the optimizer state's HBM at stacked widths
+    return jax.jit(program, donate_argnums=(
+        n_arrays, n_arrays + 1, n_arrays + 2, n_arrays + 5))
 
 
 @dataclass
@@ -620,7 +654,8 @@ class StackedDeviceLBFGS:
             if fresh:
                 prog = _build_stacked_chunk(f._agg_call.compiled, self.m,
                                             kc, self.c1, self.c2,
-                                            self.max_ls, cdt)
+                                            self.max_ls, cdt,
+                                            n_arrays=len(arrays))
                 _program_cache.put(key, prog)
             return key, prog, fresh
 

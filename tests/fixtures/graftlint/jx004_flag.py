@@ -29,3 +29,39 @@ def narrow_psum_astype(x):
 @jax.jit
 def narrow_psum_asarray(x):
     return jax.lax.psum(jnp.asarray(x, dtype="bfloat16"), "data")  # JX004
+
+
+# narrowness is a dataflow fact, not a callsite pattern: the cast can
+# hide behind a local name ...
+@jax.jit
+def narrow_psum_via_name(x):
+    y = x.astype(jnp.bfloat16)
+    return jax.lax.psum(y, "data")                   # JX004
+
+
+# ... and the mark is judged AT the psum: re-widening afterwards doesn't
+# retroactively clean the narrow accumulation that already happened
+@jax.jit
+def narrow_at_psum_rewidened_later(x):
+    y = x.astype(jnp.bfloat16)
+    acc = jax.lax.psum(y, "data")                    # JX004
+    y = y.astype(jnp.float32)
+    return acc + y
+
+
+# ... or behind a helper function (interprocedural: the hazard is split
+# across two defs — the single-function scan PR 6 hand-audited around)
+def _to_storage(x):
+    return x.astype(jnp.bfloat16)
+
+
+@jax.jit
+def narrow_psum_via_helper(x):
+    return jax.lax.psum(_to_storage(x), "data")      # JX004
+
+
+# ... and an ANNOTATED assignment narrows exactly like the bare form
+@jax.jit
+def narrow_via_annassign(x):
+    y: jax.Array = x.astype(jnp.bfloat16)
+    return jax.lax.psum(y, "data")                   # JX004

@@ -35,3 +35,39 @@ def fp32_accumulated_psum(x):
     # the tier ends at the kernel: upcast BEFORE the collective
     acc = jnp.sum(x.astype(jnp.float32))
     return jax.lax.psum(acc, "data")
+
+
+@jax.jit
+def rewidened_name_is_clean(x):
+    # source-order tracking: the re-widening clears the narrow mark
+    y = x.astype(jnp.bfloat16)
+    y = y.astype(jnp.float32)
+    return jax.lax.psum(y, "data")
+
+
+@jax.jit
+def narrowed_after_psum_is_clean(x):
+    # position matters: y is WIDE at the collective; the narrowing below
+    # it is a later, separate binding (a final-state scan would flag this)
+    y = x * 2.0
+    acc = jax.lax.psum(y, "data")
+    y = x.astype(jnp.bfloat16)
+    return acc + y.astype(jnp.float32)
+
+
+def _to_accumulator(x):
+    # helper returns the WIDE tier: psum of its result is legal
+    return x.astype(jnp.float32)
+
+
+@jax.jit
+def psum_of_wide_helper(x):
+    return jax.lax.psum(_to_accumulator(x), "data")
+
+
+@jax.jit
+def rewiden_via_annassign(x):
+    # an ANNOTATED assignment re-widens exactly like the bare form
+    y = x.astype(jnp.bfloat16)
+    y: jax.Array = y.astype(jnp.float32)
+    return jax.lax.psum(y, "data")

@@ -1,0 +1,86 @@
+"""JX008 should-flag fixtures: compile-cache explosion at jit entries."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x, k):
+    return x * k
+
+
+_prog = jax.jit(_kernel, static_argnums=(1,))
+
+
+def varying_static_in_loop(x, n):
+    out = []
+    for i in range(n):
+        out.append(_prog(x, i))                    # JX008
+    return out
+
+
+def varying_shape_in_loop(x, n):
+    total = 0.0
+    for i in range(n):
+        total += float(_prog(x[:i], 0))            # JX008
+    return total
+
+
+def derived_varying_static(x, steps):
+    for t in range(steps):
+        scale = t * 2
+        x = _prog(x, scale)                        # JX008
+    return x
+
+
+def unhashable_static(x):
+    return _prog(x, [1, 2, 3])                     # JX008
+
+
+def program_built_in_loop(xs):
+    outs = []
+    for x in xs:
+        prog = jax.jit(_kernel)                    # JX008
+        outs.append(prog(x, 2))
+    return outs
+
+
+def varying_static_in_comprehension(x, n):
+    # a comprehension iterates exactly like the spelled-out loop
+    return [_prog(x, i) for i in range(n)]         # JX008
+
+
+def varying_static_by_keyword(x, n):
+    # JAX keys a keyword call onto the static position just like the
+    # positional form
+    out = []
+    for i in range(n):
+        out.append(_prog(x, k=i))                  # JX008
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _decorated(x, width):
+    return jnp.reshape(x, (width, -1))
+
+
+def decorated_varying_static(x, n):
+    acc = []
+    for w in range(1, n):
+        acc.append(_decorated(x, w))               # JX008
+    return acc
+
+
+# -- interprocedural: the jit entry is one call away --------------------------
+
+def _run_one(x, k):
+    # k lands in _prog's static position: calling _run_one with a
+    # loop-varying k is a per-iteration recompile, two frames away
+    return _prog(x, k)
+
+
+def sweep_through_wrapper(x, n):
+    out = []
+    for i in range(n):
+        out.append(_run_one(x, i))                 # JX008
+    return out

@@ -1,0 +1,69 @@
+"""JX008 should-pass fixtures: compile-once dispatch discipline."""
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(x, k):
+    return x * k
+
+
+_prog = jax.jit(_kernel, static_argnums=(1,))
+
+
+def loop_invariant_static(x, n, width):
+    # the static is hoisted: ONE compile serves every iteration
+    out = []
+    for _ in range(n):
+        out.append(_prog(x, width))
+    return out
+
+
+def varying_traced_scalar(x, n):
+    # a Python scalar in a TRACED position is cached on (shape, dtype),
+    # not the value — no recompile however it varies
+    total = x
+    for i in range(n):
+        total = _prog(total, 2) + i
+    return total
+
+
+def fixed_shape_slice(x, n, limit):
+    # the slice bound is loop-invariant: one shape, one compile
+    head = x[:limit]
+    out = []
+    for _ in range(n):
+        out.append(_prog(head, 0))
+    return out
+
+
+def program_built_once(xs):
+    # compile-once discipline: build outside, dispatch inside
+    prog = jax.jit(_kernel)
+    return [prog(x, 2) for x in xs]
+
+
+def hashable_static(x):
+    # tuples hash: a legal static config
+    return _prog(x, (1, 2, 3))
+
+
+def varying_traced_by_keyword(x, n):
+    # a keyword onto a TRACED position still caches on (shape, dtype)
+    plain = jax.jit(_kernel)
+    out = []
+    for i in range(n):
+        out.append(plain(x, k=i))
+    return out
+
+
+def _run_fixed(x):
+    # wrapper passes only traced operands through — no cache-keyed
+    # position is reachable from its parameters' VALUES
+    return _prog(x, 0)
+
+
+def sweep_fixed_through_wrapper(x, n):
+    out = []
+    for _ in range(n):
+        out.append(_run_fixed(x))
+    return out

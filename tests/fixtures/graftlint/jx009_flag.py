@@ -1,0 +1,67 @@
+"""JX009 should-flag fixtures: reads of donated (deleted) buffers."""
+import jax
+import jax.numpy as jnp
+
+
+def _update(state, x):
+    return state * 0.9 + x
+
+
+_step = jax.jit(_update, donate_argnums=(0,))
+
+
+def read_after_donate(state, x):
+    new_state = _step(state, x)
+    drift = state - new_state                      # JX009
+    return new_state, drift
+
+
+def donated_in_loop_without_rebind(state, xs):
+    outs = []
+    for x in xs:
+        outs.append(_step(state, x))               # JX009
+    return outs
+
+
+def donated_in_loop_with_continue(state, xs, outs):
+    # `continue` is NOT a loop exit: the next iteration still dispatches
+    # the deleted buffer
+    for x in xs:
+        outs.append(_step(state, x))               # JX009
+        continue
+    return outs
+
+
+def donated_in_comprehension(state, xs):
+    # a comprehension cannot rebind `state` per iteration — iteration
+    # two dispatches the deleted buffer
+    return [_step(state, x) for x in xs]           # JX009
+
+
+def donated_then_break_then_read(state, xs):
+    # `break` (unlike `return`) falls INTO the post-loop code, carrying
+    # the deleted buffer with it
+    for x in xs:
+        out = _step(state, x)
+        break
+    return state                                   # JX009
+
+
+def read_in_later_branch(state, x, debug):
+    new_state = _step(state, x)
+    if debug:
+        print(state.sum())                         # JX009
+    return new_state
+
+
+# -- interprocedural: the donation happens one call away ----------------------
+
+def _advance(state, x):
+    # donates ITS caller's buffer: state flows into _step's donated slot
+    return _step(state, x)
+
+
+def read_after_wrapped_donate(state, x):
+    new_state = _advance(state, x)
+    stale = jnp.linalg.norm(state)                 # JX009
+    return new_state, stale

@@ -1,0 +1,80 @@
+"""JX009 should-pass fixtures: donation discipline done right."""
+import jax
+import jax.numpy as jnp
+
+
+def _update(state, x):
+    return state * 0.9 + x
+
+
+_step = jax.jit(_update, donate_argnums=(0,))
+_plain = jax.jit(_update)
+
+
+def rebound_from_result(state, xs):
+    # the idiom: the donated name is rebound from the program's result,
+    # so every dispatch consumes an already-dead buffer
+    for x in xs:
+        state = _step(state, x)
+    return state
+
+
+def read_before_donate(state, x):
+    # reads strictly precede the dispatch that kills the buffer
+    norm = jnp.linalg.norm(state)
+    state = _step(state, x)
+    return state, norm
+
+
+def no_donation_no_constraint(state, xs):
+    # an undonated program leaves its inputs alive
+    outs = []
+    for x in xs:
+        outs.append(_plain(state, x))
+    return outs, state
+
+
+def comprehension_over_undonated(state, xs):
+    # an undonated program in a comprehension leaves its inputs alive
+    return [_plain(state, x) for x in xs]
+
+
+def comprehension_donates_its_own_variable(states, x):
+    # each iteration donates a FRESH buffer from the iterable — the
+    # comprehension variable is rebound per iteration by construction
+    return [_step(s, x) for s in states]
+
+
+def probe_first_item(state, xs):
+    # every body path LEAVES the loop on iteration one — there is no
+    # second iteration to dispatch the deleted buffer
+    for x in xs:
+        return _step(state, x)
+    return state
+
+
+def probe_first_item_under_span(state, xs, tracer):
+    # a `with` block neither catches nor redirects control flow: the
+    # return inside the span idiom still exits the loop on iteration one
+    for x in xs:
+        with tracer.span("dispatch"):
+            return _step(state, x)
+    return state
+
+
+def metadata_read_after_donate(state, x):
+    # aval metadata survives deletion — shape/dtype telemetry after the
+    # dispatch never touches the donated buffer
+    out = _step(state, x)
+    return out, state.shape, state.dtype, state.ndim
+
+
+def _advance(state, x):
+    return _step(state, x)
+
+
+def wrapped_donate_rebound(state, xs):
+    # interprocedural donation, but correctly rebound each iteration
+    for x in xs:
+        state = _advance(state, x)
+    return state

@@ -1,0 +1,53 @@
+"""JX010 should-flag fixtures: collectives under host-divergent branches."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def primary_only_aggregate(dataset, coef):
+    if jax.process_index() == 0:                                # JX010
+        return dataset.tree_aggregate(coef)
+    return None
+
+
+def timeout_guarded_psum(x, t0, budget):
+    if time.monotonic() - t0 > budget:                          # JX010
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def divergent_name_guard(x):
+    deadline = time.time() + 5.0
+    while time.time() < deadline:                               # JX010
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def primary_only_ternary(dataset, coef):
+    # the one-line spelling deadlocks exactly like the block form
+    return (dataset.tree_aggregate(coef)                        # JX010
+            if jax.process_index() == 0 else None)
+
+
+def env_gated_collective(dataset, coef):
+    import os
+    if os.environ.get("CYCLONE_FAST_PATH"):                     # JX010
+        return dataset.tree_aggregate(coef)
+    return dataset.slow_aggregate(coef)
+
+
+# -- interprocedural: divergent source and collective both one call away ------
+
+def _is_primary():
+    return jax.process_index() == 0
+
+
+def _reduce_all(x):
+    return jax.lax.psum(x, "data")
+
+
+def wrapped_divergence(x):
+    if _is_primary():                                           # JX010
+        return _reduce_all(x)
+    return x

@@ -1,0 +1,53 @@
+"""JX010 should-pass fixtures: mesh-uniform branching around collectives."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def config_uniform_branch(dataset, coef, use_fast_path):
+    # config flags are identical on every process: the branch is
+    # mesh-uniform, every participant dispatches the same program
+    if use_fast_path:
+        return dataset.tree_aggregate(coef)
+    return dataset.slow_aggregate(coef)
+
+
+def primary_only_host_work(result, path):
+    # host-local work under a divergent branch is the LEGAL pattern —
+    # no rendezvous is reachable, only process 0 writes the artifact
+    if jax.process_index() == 0:
+        with open(path, "w") as fh:
+            fh.write(str(result))
+    return result
+
+
+def timing_around_uniform_dispatch(dataset, coef):
+    # wall-clock read for TELEMETRY, not control flow: the collective
+    # dispatch itself is unconditional
+    t0 = time.monotonic()
+    out = dataset.tree_aggregate(coef)
+    elapsed = time.monotonic() - t0
+    return out, elapsed
+
+
+def collective_launders_host_value(dataset, t0, coef):
+    # a value reduced THROUGH a collective is mesh-uniform by
+    # construction: every participant branches on the same pmax result —
+    # the canonical budget-based early-stop idiom
+    elapsed = time.monotonic() - t0
+    slowest = dataset.tree_aggregate(elapsed)
+    if slowest > 1.0:
+        return dataset.tree_aggregate(coef)
+    return None
+
+
+def _log_progress(step):
+    print("step", step)
+
+
+def divergent_branch_host_only_helper(step):
+    # the helper under the divergent branch never reaches a collective
+    if time.monotonic() % 2 < 1:
+        _log_progress(step)
+    return step

@@ -273,3 +273,83 @@ def test_select_chunk_policy():
     # always strictly smaller when over budget (never returns the chunk
     # that was just predicted not to fit)
     assert costs.select_chunk(8, predicted_bytes=201, budget_bytes=200) == 7
+
+
+# -- buffer donation at the chunk dispatches (JX009-proven) -------------------
+
+def test_chunk_program_donates_state_buffers(ctx):
+    """The serial L-BFGS chunk program donates the S/Y ring buffers —
+    the driver rebinds both from the outputs every chunk and only ever
+    exposes slices of them (the discipline graftlint JX009 checks
+    statically), so XLA aliases them in place. coef/grad stay undonated:
+    yielded OptimStates carry them and the resilience retry path retains
+    those states across dispatches. Pinned via the program's own
+    memory_analysis: the alias covers the ring buffers (2·m·n
+    accumulator-width elements)."""
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.ml.optim.device_lbfgs import _build_chunk
+    f, d = _loss(ctx, seed=41)
+    cdt = np.dtype(compute_dtype())
+    arrays = f._agg_call.arrays()
+    m, chunk, n = 10, 8, d + 1
+    args = (*arrays, jnp.zeros(n, cdt), jnp.zeros((m, n), cdt),
+            jnp.zeros((m, n), cdt), jnp.int32(0), cdt.type(0.0),
+            jnp.zeros(n, cdt), np.bool_(True), cdt.type(f.weight_sum),
+            cdt.type(1e-6), cdt.type(1e-6), np.int32(chunk),
+            np.bool_(True))
+    donated = _build_chunk(f._agg_call.compiled, None, m, chunk,
+                           1e-4, 0.9, 30, cdt, n_arrays=len(arrays))
+    ma = donated.lower(*args).compile().memory_analysis()
+    state_bytes = 2 * m * n * cdt.itemsize
+    assert int(ma.alias_size_in_bytes) >= state_bytes
+
+
+def test_traced_chunk_fit_peak_reflects_donation(ctx, tracer):
+    """End-to-end: a traced DeviceLBFGS fit's cost rollup reports the
+    chunk program's peak NET of the donated state — predicted peak
+    (args+out+temp+gen-alias) sits below the gross sum by at least the
+    donated state bytes. This is the measurable HBM win the donation
+    buys, read through the same observe/costs.py waist bench.py and
+    obs-demo report."""
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    f, d = _loss(ctx, seed=42)
+    opt = DeviceLBFGS(max_iter=8, tol=0.0, chunk=4)
+    opt.minimize(f, np.zeros(d + 1))
+    snap = costs.snapshot()
+    chunk_entries = [e for pid, e in snap.items()
+                     if pid.startswith("lbfgs.chunk")]
+    assert chunk_entries, "chunk program missing from the cost registry"
+    e = chunk_entries[-1]
+    cdt = np.dtype(compute_dtype())
+    m, n = 10, d + 1
+    state_bytes = 2 * m * n * cdt.itemsize
+    gross = (e["argument_bytes"] + e["output_bytes"] + e["temp_bytes"]
+             + (e["generated_code_bytes"] or 0))
+    assert e["peak_bytes"] <= gross - state_bytes
+
+
+def test_yielded_state_survives_later_dispatches(ctx):
+    """The resilience retry path retains a yielded OptimState and may
+    resume from it AFTER the generator has dispatched further chunks
+    (parallel/resilience.py's transient-failure loop). Every retained
+    state's arrays must therefore stay readable — donation of coef/grad
+    would delete them behind the caller's back."""
+    f, d = _loss(ctx, seed=43)
+    opt = DeviceLBFGS(max_iter=12, tol=0.0, chunk=2)
+    states = []
+    for s in opt.iterations(f, np.zeros(d + 1)):
+        states.append(s)
+        if len(states) >= 3:
+            break
+    assert len(states) >= 2
+    for s in states:
+        np.asarray(s.x)       # raises "Array has been deleted" if donated
+        np.asarray(s.grad)
+        for h in (*s.hist_s, *s.hist_y):
+            np.asarray(h)
+    # and the retained (non-latest) state actually resumes
+    resumed = next(iter(opt.iterations(f, np.zeros(d + 1),
+                                       resume=states[0])))
+    assert resumed.iteration == states[0].iteration

@@ -30,7 +30,8 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
 PACKAGE = os.path.join(REPO, "cycloneml_tpu")
 BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
 
-RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007")
+RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
+         "JX008", "JX009", "JX010")
 
 
 def marker_lines(path: str, rule: str):
@@ -111,6 +112,86 @@ def test_suppression_is_rule_specific(tmp_path):
     assert [f.rule for f in analyze_paths([str(p)])] == ["JX001"]
 
 
+def test_multiline_statement_suppression(tmp_path):
+    """A `# graftlint: disable=RULE` on ANY physical line of a multi-line
+    statement covers a finding anchored to the statement's first line."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(\n"
+        "        jnp.max(\n"
+        "            x))  # graftlint: disable=JX001\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+    # without the directive the same source flags
+    p.write_text(src.replace("  # graftlint: disable=JX001", ""))
+    assert [f.rule for f in analyze_paths([str(p)])] == ["JX001"]
+
+
+def test_suppression_covers_statement_beyond_flagged_node(tmp_path):
+    """The directive may sit on a physical line of the ENCLOSING
+    statement past the flagged node's own extent — the finding anchors
+    on the first coercion, the disable on the statement's last line."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "def _agg(x, w):\n"
+        "    return jnp.max(x), jnp.sum(w)\n"
+        "def pulls(x, w):\n"
+        "    run = jax.jit(_agg)\n"
+        "    out = run(x, w)\n"
+        "    total = float(\n"
+        "        out[0]\n"
+        "    ) + int(\n"
+        "        out[1])  # graftlint: disable=JX001\n"
+        "    return total\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+    p.write_text(src.replace("  # graftlint: disable=JX001", ""))
+    assert [f.rule for f in analyze_paths([str(p)])] == ["JX001"]
+
+
+def test_suppression_on_line_above_flagged_expression(tmp_path):
+    """The directive may also sit on a physical line of the statement
+    ABOVE where the finding anchors — coverage is the whole statement,
+    both directions."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "def _agg(x, w):\n"
+        "    return jnp.max(x), jnp.sum(w)\n"
+        "def pulls(x, w):\n"
+        "    run = jax.jit(_agg)\n"
+        "    out = run(x, w)\n"
+        "    total = (1.0 +  # graftlint: disable=JX001\n"
+        "             float(out[0]) + int(out[1]))\n"
+        "    return total\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []
+    p.write_text(src.replace("  # graftlint: disable=JX001", ""))
+    assert [f.rule for f in analyze_paths([str(p)])] == ["JX001"]
+
+
+def test_suppression_inside_branch_body_does_not_cover_the_branch(tmp_path):
+    """Statement-extent suppression stops at a compound statement's
+    HEADER: a disable buried in the body must not silence a finding on
+    the branch itself."""
+    src = (
+        "import jax\n"
+        "def agg(dataset, coef):\n"
+        "    if jax.process_index() == 0:\n"
+        "        return dataset.tree_aggregate(coef)"
+        "  # graftlint: disable=JX010\n"
+        "    return None\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    # the finding anchors to the `if` (line 3); the directive sits on the
+    # body line and does not reach it
+    assert [f.rule for f in analyze_paths([str(p)])] == ["JX010"]
+
+
 # -- baseline ---------------------------------------------------------------
 
 def test_baseline_roundtrip(tmp_path):
@@ -131,6 +212,64 @@ def test_baseline_does_not_cover_new_occurrences(tmp_path):
     write_baseline(str(bl), findings[:-1])
     new, _ = apply_baseline(findings, load_baseline(str(bl)))
     assert len(new) == 1
+
+
+# -- the ratchet ------------------------------------------------------------
+
+def test_baseline_ratchet_shrinks_but_never_grows(tmp_path):
+    from cycloneml_tpu.analysis.baseline import (BaselineRatchetError,
+                                                 check_ratchet)
+    flag = os.path.join(FIXTURES, "jx001_flag.py")
+    findings = analyze_paths([flag])
+    assert len(findings) >= 2
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings[:2])
+    assert check_ratchet(str(bl)) == (2, 2)
+    # growing past the ratchet refuses ...
+    with pytest.raises(BaselineRatchetError):
+        write_baseline(str(bl), findings[:2] + findings[:1])
+    # ... shrinking is free, and the ratchet FOLLOWS the baseline down
+    write_baseline(str(bl), findings[:1])
+    assert check_ratchet(str(bl)) == (1, 1)
+    # once shrunk, even the old size is a violation
+    with pytest.raises(BaselineRatchetError):
+        write_baseline(str(bl), findings[:2])
+    # the explicit escape hatch allows deliberate debt, and resets
+    write_baseline(str(bl), findings[:2], allow_grow=True)
+    assert check_ratchet(str(bl)) == (2, 2)
+
+
+def test_hand_grown_baseline_fails_ratchet_check(tmp_path):
+    from cycloneml_tpu.analysis.baseline import (BaselineRatchetError,
+                                                 check_ratchet)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "version": 1, "ratchet": 0,
+        "findings": [{"rule": "JX001", "path": "x.py", "function": "f",
+                      "count": 1}]}))
+    with pytest.raises(BaselineRatchetError):
+        check_ratchet(str(bl))
+
+
+def test_cli_enforces_ratchet_on_baseline_read(tmp_path, capsys):
+    """A hand-grown baseline must fail `make lint` itself — the gate the
+    ratchet protects — not just the direct check_ratchet tests."""
+    flag = os.path.join(FIXTURES, "jx001_flag.py")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "version": 1, "ratchet": 0,
+        "findings": [{"rule": "JX001", "path": "x.py", "function": "f",
+                      "count": 1}]}))
+    assert graftlint_main([flag, "--baseline", str(bl)]) == 2
+    assert "ratchet" in capsys.readouterr().err
+
+
+def test_committed_baseline_is_empty_with_zero_ratchet():
+    """The standing contract: all self-run findings are FIXED, none
+    baselined — and the ratchet pins it at zero so no future PR can
+    quietly grandfather new debt."""
+    from cycloneml_tpu.analysis.baseline import check_ratchet
+    assert check_ratchet(BASELINE) == (0, 0)
 
 
 # -- CLI --------------------------------------------------------------------
@@ -154,6 +293,158 @@ def test_cli_rule_subset(capsys):
     assert graftlint_main([flag, "--rules", "JX005"]) == 0
     assert graftlint_main([flag, "--rules", "JX001"]) == 1
     capsys.readouterr()
+
+
+def test_cli_sarif_schema_shape(tmp_path, capsys):
+    """SARIF 2.1.0 shape: schema/version headers, a run with tool.driver
+    rule metadata for the whole pack, and results whose locations carry
+    1-based regions + the graftlint fingerprint."""
+    flag = os.path.join(FIXTURES, "jx009_flag.py")
+    assert graftlint_main([flag, "--sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert set(RULES) <= rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    assert run["results"], "flag fixture must produce results"
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] == "error"
+        assert res["message"]["text"]
+        (loc,) = res["locations"]
+        region = loc["physicalLocation"]["region"]
+        assert region["startLine"] >= 1
+        assert region["startColumn"] >= 1
+        assert region["endLine"] >= region["startLine"]
+        assert loc["physicalLocation"]["artifactLocation"]["uri"].endswith(
+            "jx009_flag.py")
+        assert res["partialFingerprints"]["graftlint/v1"].startswith("JX")
+
+
+def test_cli_changed_mode(tmp_path, capsys):
+    """--changed in a scratch git repo: only the touched file is checked,
+    but the interprocedural facts still come from the whole set."""
+    import shutil
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    helper = (
+        "import jax\n"
+        "def _update(state, x):\n"
+        "    return state * 0.9 + x\n"
+        "_step = jax.jit(_update, donate_argnums=(0,))\n"
+        "def advance(state, x):\n"
+        "    return _step(state, x)\n")
+    clean_caller = (
+        "from pkg.helper import advance\n"
+        "def driver(state, x):\n"
+        "    return advance(state, x)\n")
+    bad_caller = (
+        "from pkg.helper import advance\n"
+        "def driver(state, x):\n"
+        "    out = advance(state, x)\n"
+        "    return out + state.sum()\n")
+    pkg = repo / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(helper)
+    (pkg / "caller.py").write_text(clean_caller)
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+
+    old = os.getcwd()
+    os.chdir(repo)
+    try:
+        # nothing changed -> nothing to lint, exit 0
+        assert graftlint_main(["pkg", "--changed", "--no-cache"]) == 0
+        assert "0 changed file(s)" in capsys.readouterr().out
+        # introduce a use-after-donate in the CALLER only
+        (pkg / "caller.py").write_text(bad_caller)
+        assert graftlint_main(["pkg", "--changed", "--no-cache",
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in payload["findings"]] == ["JX009"]
+        assert payload["findings"][0]["path"].endswith("caller.py")
+        # the cache round-trips: a second run reuses parsed modules
+        assert graftlint_main(["pkg", "--changed",
+                               "--cache", str(tmp_path / "c.pkl"),
+                               "--json"]) == 1
+        capsys.readouterr()
+        assert graftlint_main(["pkg", "--changed",
+                               "--cache", str(tmp_path / "c.pkl"),
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in payload["findings"]] == ["JX009"]
+        # a change OUTSIDE the analyzed roots is not part of this gate:
+        # it must not inflate the checked-file set (nor get linted)
+        (repo / "scratch.py").write_text("x = 1\n")
+        assert graftlint_main(["pkg", "--changed", "--no-cache",
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert {f["path"] for f in payload["findings"]} \
+            == {"pkg/caller.py"}
+        # cwd-independence: git emits repo-root-relative paths whatever
+        # directory the CLI runs from — resolving them against the cwd
+        # instead of the git toplevel silently linted NOTHING from a
+        # subdirectory
+        os.chdir(repo / "pkg")
+        assert graftlint_main([str(repo / "pkg"), "--changed",
+                               "--no-cache", "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in payload["findings"]] == ["JX009"]
+        # ... and the DEFAULT repo-root-relative root anchors to the git
+        # toplevel — from a subdirectory it must find the finding, not
+        # print "0 changed file(s)" and exit 0 (a false-green gate)
+        assert graftlint_main(["pkg", "--changed", "--no-cache",
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in payload["findings"]] == ["JX009"]
+        # a root that exists nowhere is a usage error, not a silent pass
+        assert graftlint_main(["no_such_pkg", "--changed",
+                               "--no-cache"]) == 2
+        # a BASE that isn't a git ref is a usage error with a real
+        # diagnosis — NOT a silent "git unavailable" full-run fallback
+        os.chdir(repo)
+        assert graftlint_main(["pkg", "--changed", "pkg",
+                               "--no-cache"]) == 2
+        assert "not a git ref" in capsys.readouterr().err
+        assert graftlint_main(["pkg", "--changed", "no-such-ref",
+                               "--no-cache"]) == 2
+        capsys.readouterr()
+        # the check set widens over reverse call edges: with the bad
+        # caller COMMITTED, a diff touching only the helper must still
+        # report the caller's finding — not green-light it
+        git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+        git("-c", "user.email=t@t", "-c", "user.name=t", "commit",
+            "-qm", "y")
+        with open(pkg / "helper.py", "a") as fh:
+            fh.write("# touched\n")
+        assert graftlint_main(["pkg", "--changed", "--no-cache",
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in payload["findings"]] == ["JX009"]
+        assert payload["findings"][0]["path"] == "pkg/caller.py"
+    finally:
+        os.chdir(old)
+
+
+def test_cli_changed_rejects_write_baseline(tmp_path):
+    """--changed carries only the changed files' findings; writing those
+    as the baseline would drop every grandfathered entry for unchanged
+    files. The combination is a usage error, not a silent rewrite."""
+    rc = graftlint_main(["pkg", "--changed",
+                         "--write-baseline", str(tmp_path / "b.json")])
+    assert rc == 2
+    assert not (tmp_path / "b.json").exists()
 
 
 def test_cli_runs_as_module():
