@@ -2,7 +2,7 @@
 # also enforced by tests/test_graftlint.py) and `make test`.
 
 .PHONY: lint lint-fast lint-json lint-sarif test chaos obs-demo bench \
-	bench-bytes
+	bench-bytes serve-demo
 
 # the full interprocedural pass (JX001-JX010); fails on any finding not
 # grandfathered in baseline.json (which a PR may shrink, never grow)
@@ -47,3 +47,8 @@ bench:
 # the fp32 sweep's bytes (XLA cost-analysis ground truth, lower-only)
 bench-bytes:
 	python scripts/bench_bytes.py
+
+# serving acceptance demo: 2 models, concurrent request storm, asserts
+# compile-count == bucket-count and p99 under the window bound
+serve-demo:
+	JAX_PLATFORMS=cpu python scripts/serve_demo.py

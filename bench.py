@@ -347,6 +347,105 @@ def bench_ovr_stacked(n: int | None = None, d: int | None = None,
     return out
 
 
+def bench_serving(d: int | None = None, n_requests: int | None = None,
+                  n_threads: int | None = None):
+    """The ``serving`` BENCH block: two fitted models behind the model
+    server, concurrent mixed-size requests through the micro-batcher.
+
+    Reports what the serving SLO cares about: p50/p99 request latency
+    (milliseconds), sustained requests/s and rows/s, the batch-size
+    distribution the window actually achieved (coalescing evidence), and
+    the compile ledger — compiles must equal the bucket count, all paid at
+    registration, zero during the request storm.
+    """
+    import threading
+
+    from cycloneml_tpu import CycloneConf, CycloneContext
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.serving import ModelServer, bucket_sizes
+
+    d = d or int(os.environ.get("BENCH_SERVE_D", 64))
+    n_requests = n_requests or int(os.environ.get("BENCH_SERVE_REQS", 400))
+    n_threads = n_threads or int(os.environ.get("BENCH_SERVE_THREADS", 8))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAXBATCH", 64))
+    window_ms = float(os.environ.get("BENCH_SERVE_WINDOW_MS", 2.0))
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.app.name", "bench"))
+    rng = np.random.RandomState(11)
+    n_fit = 4096
+    x = rng.randn(n_fit, d).astype(np.float32)
+    w = rng.randn(d)
+    y = (x @ w + 0.3 * rng.randn(n_fit) > 0).astype(np.float64)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+    model_a = LogisticRegression(maxIter=15, regParam=0.01).fit(frame)
+    model_b = LogisticRegression(maxIter=15, regParam=0.1).fit(frame)
+
+    srv = ModelServer(ctx=ctx, max_batch=max_batch, window_ms=window_ms)
+    srv.register("a", model_a)
+    srv.register("b", model_b)
+    sizes = [1, 2, 3, 5, 8, 13]
+    reqs = [(("a", "b")[i % 2], rng.randn(sizes[i % len(sizes)], d))
+            for i in range(n_requests)]
+    it = iter(reqs)
+    it_lock = threading.Lock()
+    errors: list = []
+
+    def client():
+        while True:
+            with it_lock:
+                job = next(it, None)
+            if job is None:
+                return
+            try:
+                srv.predict(job[0], job[1])
+            except Exception as e:  # noqa: BLE001 — reported in the block
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = srv.stats()
+    srv.stop()
+    totals = stats["totals"]
+    lat_ms = {}
+    for m in stats["models"].values():
+        for k2, v in m["latencyMs"].items():
+            lat_ms[k2] = max(lat_ms.get(k2, 0.0), v)  # worst model
+    batch_rows = srv.registry.histogram("serving.batchRows").snapshot()
+    batch_reqs = srv.registry.histogram("serving.batchRequests").snapshot()
+    out = {
+        "requests": totals["requests"],
+        "rows": totals["rows"],
+        "wall_seconds": round(wall, 3),
+        "requests_per_s": round(totals["requests"] / wall, 1),
+        "rows_per_s": round(totals["rows"] / wall, 1),
+        "p50_ms": round(lat_ms.get("p50", 0.0), 3),
+        "p99_ms": round(lat_ms.get("p99", 0.0), 3),
+        "window_ms": window_ms,
+        "batches": totals["batches"],
+        "coalesced_requests": totals["coalesced"],
+        "batch_rows": {k2: round(v, 2) for k2, v in batch_rows.items()},
+        "batch_requests": {k2: round(v, 2) for k2, v in batch_reqs.items()},
+        "compiles": totals["compiles"],
+        "buckets": len(bucket_sizes(max_batch)),
+        "models": totals["models"],
+        "shed": totals["shed"],
+        "errors": errors[:3],
+    }
+    print(f"info: serving {totals['requests']} requests "
+          f"({totals['rows']} rows) in {wall:.2f}s: "
+          f"{out['requests_per_s']} req/s, p50 {out['p50_ms']:.2f} ms, "
+          f"p99 {out['p99_ms']:.2f} ms, {totals['batches']} batches, "
+          f"{totals['compiles']} compiles over {out['buckets']} buckets "
+          f"x {totals['models']} models", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     err = None
     ceiling_bw = None
@@ -368,6 +467,12 @@ def main() -> None:
             ovr = bench_ovr_stacked()
         except Exception as e:
             print(f"info: ovr stacked bench failed: {e}", file=sys.stderr)
+    serving = None
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        try:
+            serving = bench_serving()
+        except Exception as e:
+            print(f"info: serving bench failed: {e}", file=sys.stderr)
     try:
         gemm_mops = bench_gemm()
         print(f"info: device_gemm_f32 {gemm_mops:.1f} M ops/s "
@@ -422,6 +527,7 @@ def main() -> None:
             "hardware": hardware,
             "phases": phases,
             "ovr": ovr,
+            "serving": serving,
         }))
     elif gemm_mops is not None:
         print(f"info: logreg bench failed: {err}", file=sys.stderr)
@@ -432,6 +538,7 @@ def main() -> None:
             "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
             "hardware": hardware,
             "ovr": ovr,
+            "serving": serving,
         }))
     else:
         # both benches errored: say so instead of faking a 0.0 measurement

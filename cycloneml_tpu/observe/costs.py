@@ -54,6 +54,7 @@ __all__ = [
     "analyze_call_count", "note_execution", "check_budget", "guard_armed",
     "select_chunk", "backend_peaks", "device_memory_limit",
     "memory_stats_available", "register_memory_gauges", "sweep_cost",
+    "sample_device_peak",
 ]
 
 
@@ -318,6 +319,21 @@ def sample_memory() -> Optional[int]:
     try:
         import jax
         return sum(int((d.memory_stats() or {}).get("bytes_in_use", 0))
+                   for d in jax.local_devices())
+    except Exception:
+        return None
+
+
+def sample_device_peak() -> Optional[int]:
+    """MAX ``bytes_in_use`` over local devices — the admission-relevant
+    occupancy: a plain-jit dispatch allocates on one device, so averaging
+    the total across an 8-device host would understate the hot device by
+    up to 8x. ``None`` when the backend does not report."""
+    if not memory_stats_available():
+        return None
+    try:
+        import jax
+        return max(int((d.memory_stats() or {}).get("bytes_in_use", 0))
                    for d in jax.local_devices())
     except Exception:
         return None

@@ -208,6 +208,20 @@ class Tracer:
         s.t0 = s.t1 = time.perf_counter()
         self._record(s)
 
+    def record_span(self, kind: str, name: str = "", t0: float = 0.0,
+                    t1: float = 0.0, parent: str = "", **attrs) -> Span:
+        """Record an already-timed span retroactively (``t0``/``t1`` are
+        ``perf_counter`` readings). For producers whose phases span
+        threads — the serving batcher times a request's queue phase on
+        the submitting thread and its dispatch on the worker, then
+        records one request span after the fact; a context-manager span
+        could not bracket that lifetime."""
+        s = Span(f"s{next(self._ids)}", parent, kind, name or kind,
+                 threading.get_ident(), attrs)
+        s.t0, s.t1 = t0, t1
+        self._record(s)
+        return s
+
     def counter(self, name: str, value: float) -> None:
         """One sample of a Perfetto counter track (exported as a
         Chrome-trace ``"C"``-phase event): device-memory / cumulative-FLOP

@@ -20,6 +20,10 @@ point                    fired from
                          atomic rename (a crash here = orphaned tmp dir)
 ``checkpoint.restore``   ``TrainingCheckpointer.restore`` entry
 ``heartbeat.send``       every ``HeartbeatSender._send`` TCP round trip
+``serving.dispatch``     every model-server batch dispatch
+                         (``serving/batcher.py`` — transient faults
+                         retry with backoff, permanent faults shed the
+                         batch with a 5xx ServingError, never a hang)
 ======================== =================================================
 
 Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the

@@ -1,0 +1,34 @@
+"""Low-latency model serving on the program-cache waist.
+
+The training subsystems compile once and dispatch many times; this
+package gives inference the same discipline (ROADMAP open item 4, built
+the way Clipper structured serving — Crankshaw et al., NSDI 2017):
+
+- :mod:`~cycloneml_tpu.serving.servable` — the model-abstraction layer:
+  fitted estimators (and K-model gangs, via the PR-4 vmap idiom) behind
+  one device-kernel + host-postprocess interface.
+- :mod:`~cycloneml_tpu.serving.buckets` — power-of-two padded shape
+  buckets; registration warm-up pays every compile, requests never do.
+- :mod:`~cycloneml_tpu.serving.batcher` — Clipper-style latency-bounded
+  micro-batching, admission control against the PR-5 HBM accounting,
+  chaos-instrumented dispatch (``serving.dispatch``).
+- :mod:`~cycloneml_tpu.serving.server` — the ModelServer façade.
+- :mod:`~cycloneml_tpu.serving.streaming` — featurize→predict→sink:
+  score a streaming query (e.g. a Kafka source) through the same batcher.
+
+See docs/serving.md for the architecture and conf keys.
+"""
+
+from cycloneml_tpu.serving.batcher import ServingError, ServingOverloaded
+from cycloneml_tpu.serving.buckets import bucket_for, bucket_sizes, pad_rows
+from cycloneml_tpu.serving.servable import (
+    GangServable, Servable, as_servable, serving_dtype,
+)
+from cycloneml_tpu.serving.server import ModelServer
+from cycloneml_tpu.serving.streaming import ScoringSink
+
+__all__ = [
+    "ModelServer", "ServingError", "ServingOverloaded", "Servable",
+    "GangServable", "as_servable", "serving_dtype", "bucket_for",
+    "bucket_sizes", "pad_rows", "ScoringSink",
+]

@@ -1,0 +1,460 @@
+"""Dynamic micro-batching: one lane (queue + worker) per registered model.
+
+Clipper's adaptive batching contract (Crankshaw et al., NSDI 2017 §4.3):
+batch to amortize dispatch overhead, but bound the wait — a request waits
+at most ``cyclone.serving.windowMs`` for co-riders before its batch
+dispatches, and a batch never exceeds ``cyclone.serving.maxBatch`` rows.
+Coalesced rows pad up to a power-of-two bucket (buckets.py) so the
+steady state replays AOT-warmed programs and never compiles.
+
+Before every dispatch the lane runs admission control against the PR-5
+memory accounting: the bucket program's XLA-predicted peak HBM (harvested
+at registration) plus live ``device.memory_stats`` occupancy, compared to
+the ``cyclone.memory.budgetFraction`` budget. An over-budget batch is
+requeued (backpressure) and re-checked each window until its oldest
+request has waited ``cyclone.serving.shedAfterMs``, then shed with a
+503-style :class:`~cycloneml_tpu.serving.ServingOverloaded` — the guard
+path never raises ``MemoryBudgetError`` and never dispatches a program
+predicted to OOM.
+
+Dispatch rides the chaos harness (``serving.dispatch`` injection point):
+transient failures retry with backoff up to ``cyclone.serving.maxRetries``;
+permanent failures fail every request in the batch with a 5xx
+:class:`~cycloneml_tpu.serving.ServingError`. Every outcome completes the
+request futures — a fault can shed a request but never hang it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.serving.buckets import bucket_for, bucket_sizes, pad_rows
+from cycloneml_tpu.serving.servable import GangServable
+from cycloneml_tpu.util.logging import get_logger
+from cycloneml_tpu.util.metrics import Histogram
+
+logger = get_logger(__name__)
+
+
+class ServingError(RuntimeError):
+    """A request the server could not answer — carries an HTTP-shaped
+    ``status`` (5xx) so wire frontends map it without string matching."""
+
+    def __init__(self, msg: str, status: int = 500,
+                 cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.status = int(status)
+        self.cause = cause
+
+
+class ServingOverloaded(ServingError):
+    """Load was shed: queue full, or admission control could not fit the
+    dispatch within the memory budget before the shed deadline (503)."""
+
+    def __init__(self, msg: str, cause: Optional[BaseException] = None):
+        super().__init__(msg, status=503, cause=cause)
+
+
+class _Request:
+    __slots__ = ("x", "n", "future", "t_enq")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.n = x.shape[0]
+        self.future: "Future" = Future()
+        self.t_enq = time.perf_counter()
+
+
+class ModelLane:
+    """Queue + worker thread + AOT-warmed bucket programs for ONE
+    registered (model | gang) entry."""
+
+    def __init__(self, name: str, servable, server):
+        self.name = name
+        self.servable = servable
+        self.server = server
+        self.is_gang = isinstance(servable, GangServable)
+        self.buckets = bucket_sizes(server.max_batch)
+        self.program = server._program_for(servable)
+        self._params = servable.params(server.dtype)
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # per-lane tallies (ints under the cv; scrape-side metrics live in
+        # the server's shared MetricsRegistry)
+        self.compiles = 0
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.coalesced = 0      # requests that shared a dispatch with >=1 other
+        self.shed = 0
+        self.retries = 0
+        self.requeues = 0
+        self.latency = Histogram(window=4096)   # seconds, request e2e
+        self.pids = {}          # bucket -> costs program id (when harvested)
+        # bucket -> BudgetVerdict from the FIRST admission check. The
+        # predicted-peak side of a verdict is compile-time static, so
+        # re-checks (the requeue loop runs one per window) reuse it and
+        # only re-sample LIVE occupancy — one MemoryBudgetExceeded event
+        # + warning per bucket, not one per 5 ms (the PR-5 cadence)
+        self._verdicts = {}
+
+    # -- registration-time AOT warm-up ---------------------------------------
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return int(self.program._cache_size())
+        except Exception:
+            return None
+
+    def warm_up(self) -> None:
+        """Touch every bucket once: the whole compile bill is paid here,
+        before the first request. Each bucket that actually compiles (the
+        per-shape jit cache missed — a same-signature model registered
+        earlier may have paid already) bumps the compile ledger and gets a
+        ``compile`` span; the steady state is pinned to add zero."""
+        import jax
+        d = self.servable.n_features
+        tr = tracing.active()
+        # guard_armed already includes "tracing active" in its policy
+        harvest = costs.guard_armed(self.server.conf)
+        for b in self.buckets:
+            x0 = np.zeros((b, d), dtype=self.server.dtype)
+            before = self._cache_size()
+            with (tr.span("compile", f"serving/{self.name}", bucket=b)
+                  if tr else tracing.NOOP_SPAN) as sp:
+                out = self.program(*self._params, x0)
+                jax.block_until_ready(out)
+            after = self._cache_size()
+            compiled = (after is None or before is None or after > before)
+            if compiled:
+                self.compiles += 1
+                self.server.registry.counter("serving.compiles").inc()
+            sp.annotate(compiled=compiled)
+            if harvest:
+                # keyed on the servable SIGNATURE (not the lane name):
+                # a second same-signature model must reuse the registry
+                # entry, not re-pay analyze()'s AOT compile per bucket
+                self.pids[b] = costs.ensure(
+                    "serving", (self.servable.signature, b, str(x0.dtype)),
+                    self.program, (*self._params, x0))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"cyclone-serve-{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        for r in pending:
+            r.future.set_exception(
+                ServingOverloaded(f"model server stopped while "
+                                  f"{self.name!r} request was queued"))
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- request side ---------------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> "Future":
+        if x.shape[0] > self.server.max_batch:
+            # a request _collect can never pop would wedge the lane in a
+            # hot spin; ModelServer.predict pre-splits, so reaching this
+            # is a direct-ModelLane caller's bug — fail it, loudly
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds maxBatch "
+                f"{self.server.max_batch}; split it (ModelServer.predict "
+                f"does) or raise cyclone.serving.maxBatch")
+        req = _Request(x)
+        with self._cv:
+            if self._stop:
+                raise ServingError("model server is stopped", status=503)
+            if len(self._queue) >= self.server.max_queue:
+                self.shed += 1
+                self.server.registry.counter("serving.shed").inc()
+                raise ServingOverloaded(
+                    f"{self.name!r} queue is full "
+                    f"({self.server.max_queue} requests) — backpressure")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    def try_cancel(self, fut: "Future") -> bool:
+        """Remove a still-queued request and fail its future with a 503
+        (ModelServer.predict unwinds a multi-chunk submission whose later
+        chunk hit backpressure — already-queued siblings must not burn a
+        dispatch computing results nobody will read). False when the
+        request already left the queue (its dispatch is in flight)."""
+        with self._cv:
+            for r in self._queue:
+                if r.future is fut:
+                    self._queue.remove(r)
+                    break
+            else:
+                return False
+            self.shed += 1  # a 503 like every other shed path — counted
+        self.server.registry.counter("serving.shed").inc()
+        fut.set_exception(ServingOverloaded(
+            f"{self.name!r}: sibling sub-request hit backpressure; "
+            f"multi-chunk request shed as a unit"))
+        return True
+
+    # -- worker ----------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            got = self._collect()
+            if got is None:
+                return
+            batch, rows = got
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch, rows)
+            except Exception as e:  # belt-and-braces: never hang a future
+                logger.exception("serving lane %s: unexpected dispatch "
+                                 "failure", self.name)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            ServingError(f"internal serving failure: {e}",
+                                         status=500, cause=e))
+
+    def _collect(self):
+        """Assemble the next batch: up to maxBatch rows, waiting at most
+        windowMs past the FIRST queued request's arrival (a worker that
+        fell behind dispatches immediately — the window bounds added
+        latency, it is never a mandatory sleep)."""
+        with self._cv:
+            while not self._queue and not self._stop:
+                self._cv.wait(timeout=0.1)
+            if self._stop:
+                # anything that slipped in after stop() drained the queue
+                # must still complete its future (the no-hang contract)
+                leftovers = list(self._queue)
+                self._queue.clear()
+                for r in leftovers:
+                    r.future.set_exception(ServingOverloaded(
+                        f"model server stopped while {self.name!r} "
+                        f"request was queued"))
+                return None
+            deadline = self._queue[0].t_enq + self.server.window_s
+            batch: List[_Request] = []
+            rows = 0
+            while True:
+                while (self._queue
+                       and rows + self._queue[0].n <= self.server.max_batch):
+                    r = self._queue.popleft()
+                    batch.append(r)
+                    rows += r.n
+                if rows >= self.server.max_batch or self._stop:
+                    break
+                if self._queue:
+                    break  # head does not fit this batch — dispatch now
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            return batch, rows
+
+    def _requeue_front(self, batch: List[_Request]) -> None:
+        with self._cv:
+            if not self._stop:
+                for r in reversed(batch):
+                    self._queue.appendleft(r)
+                self.requeues += 1
+                self.server.registry.counter("serving.requeued").inc()
+                return
+        # stop() already drained the queue — requeueing now would strand
+        # these futures in a dead lane; give them the same 503 it gave
+        # every other queued request
+        for r in batch:
+            r.future.set_exception(ServingOverloaded(
+                f"model server stopped while {self.name!r} request "
+                f"was queued"))
+
+    # -- admission control -----------------------------------------------------
+
+    def _admitted(self, bucket: int) -> bool:
+        """Predict the dispatch's per-device peak HBM before running it.
+        Unknown (guard unarmed, CPU cost gaps) admits — the guard refines
+        behaviour when armed, it never blocks an unbudgeted deployment."""
+        pid = self.pids.get(bucket)
+        if pid is None:
+            return True
+        verdict = self._verdicts.get(bucket)
+        if verdict is None:
+            # never raises: serving degrades to queue/shed even under
+            # cyclone.memory.budgetAction=raise — the 5xx IS the
+            # escalation. First check per bucket only: the event +
+            # warning it may post must not repeat every requeue window.
+            verdict = costs.check_budget(pid, conf=self.server.conf,
+                                         bus=self.server.bus,
+                                         allow_raise=False)
+            if verdict is not None:
+                self._verdicts[bucket] = verdict
+        if verdict is None:
+            return True
+        if verdict.exceeded:
+            return False
+        if verdict.budget_bytes and verdict.predicted_bytes:
+            # hottest DEVICE, not the host average: a plain-jit dispatch
+            # allocates on one device, and it is that device that OOMs
+            live = costs.sample_device_peak()
+            if live is not None and (
+                    live + verdict.predicted_bytes > verdict.budget_bytes):
+                return False
+        return True
+
+    def _shed_or_requeue(self, batch: List[_Request]) -> None:
+        """Over-budget batch: shed members past the shed deadline with a
+        503, requeue the rest (front of the queue) and wait one window for
+        memory conditions to change."""
+        now = time.perf_counter()
+        keep: List[_Request] = []
+        for r in batch:
+            if now - r.t_enq >= self.server.shed_after_s:
+                with self._cv:  # submit() bumps this tally under the cv too
+                    self.shed += 1
+                self.server.registry.counter("serving.shed").inc()
+                r.future.set_exception(ServingOverloaded(
+                    f"{self.name!r}: admission control predicts the "
+                    f"dispatch exceeds the device memory budget "
+                    f"(cyclone.memory.budgetFraction); request shed after "
+                    f"{self.server.shed_after_s * 1e3:.0f} ms"))
+            else:
+                keep.append(r)
+        if keep:
+            self._requeue_front(keep)
+            with self._cv:
+                if not self._stop:
+                    self._cv.wait(timeout=max(self.server.window_s, 0.005))
+
+    # -- dispatch ---------------------------------------------------------------
+
+    def _dispatch(self, batch: List[_Request], rows: int) -> None:
+        from cycloneml_tpu.parallel import faults
+        from cycloneml_tpu.parallel.resilience import (
+            backoff_delay, classify_failure,
+        )
+        t_batch = time.perf_counter()
+        bucket = bucket_for(rows, self.server.max_batch)
+        if not self._admitted(bucket):
+            self._shed_or_requeue(batch)
+            return
+        x = (batch[0].x if len(batch) == 1
+             else np.concatenate([r.x for r in batch], axis=0))
+        xpad = pad_rows(x, bucket)
+        tr = tracing.active()
+        span = (tr.span("serving", self.name, rows=rows, bucket=bucket,
+                        n_requests=len(batch),
+                        program=self.pids.get(bucket, ""))
+                if tr else tracing.NOOP_SPAN)
+        attempt = 0
+        with span:
+            while True:
+                try:
+                    faults.inject("serving.dispatch", model=self.name,
+                                  bucket=bucket)
+                    out = self.program(*self._params, xpad)
+                    # ONE host pull per dispatch (the JX001 discipline)
+                    margins = np.asarray(out)
+                    break
+                except Exception as e:
+                    kind = classify_failure(e)
+                    if (kind == "transient"
+                            and attempt < self.server.max_retries):
+                        attempt += 1
+                        self.retries += 1
+                        self.server.registry.counter("serving.retries").inc()
+                        tracing.instant("retry", point="serving.dispatch",
+                                        attempt=attempt, model=self.name)
+                        time.sleep(backoff_delay(attempt - 1, base_s=0.01,
+                                                 max_s=0.2))
+                        continue
+                    status = 503 if kind == "transient" else 500
+                    err = ServingError(
+                        f"{self.name!r} dispatch failed ({kind}) after "
+                        f"{attempt} retries: {e}", status=status, cause=e)
+                    for r in batch:
+                        r.future.set_exception(err)
+                    self.server.registry.counter("serving.failed").inc(
+                        len(batch))
+                    return
+        t_done = time.perf_counter()
+        dispatch_s = t_done - t_batch
+        if self.is_gang:
+            margins = margins[:, :rows, :]     # (K, rows, Km)
+        else:
+            margins = margins[:rows, :]        # (rows, Km)
+        # every tally/metric/span BEFORE any future completes: a caller
+        # reading stats() the moment predict() returns must see this batch
+        reg = self.server.registry
+        with self._cv:
+            self.requests += len(batch)
+            self.rows += rows
+            self.batches += 1
+            if len(batch) > 1:
+                self.coalesced += len(batch)
+        reg.counter("serving.requests").inc(len(batch))
+        reg.counter("serving.rows").inc(rows)
+        reg.counter("serving.batches").inc()
+        reg.timer("serving.dispatch").update(dispatch_s)
+        reg.histogram("serving.batchRows").update(float(rows))
+        reg.histogram("serving.batchRequests").update(float(len(batch)))
+        for r in batch:
+            e2e = t_done - r.t_enq
+            self.latency.update(e2e)
+            reg.timer("serving.latency").update(e2e)
+            reg.timer("serving.queue").update(max(t_batch - r.t_enq, 0.0))
+            if tr is not None:
+                tr.record_span("serving", "request", t0=r.t_enq, t1=t_done,
+                               parent=span.span_id, model=self.name,
+                               rows=r.n, bucket=bucket,
+                               queue_s=max(t_batch - r.t_enq, 0.0),
+                               dispatch_s=dispatch_s)
+        off = 0
+        for r in batch:
+            part = (margins[:, off:off + r.n, :] if self.is_gang
+                    else margins[off:off + r.n, :])
+            off += r.n
+            try:
+                r.future.set_result(self.servable.postprocess(part))
+            except Exception as e:
+                r.future.set_exception(ServingError(
+                    f"postprocessing failed for {self.name!r}: {e}",
+                    status=500, cause=e))
+        self.server._post_stats()
+
+    # -- introspection ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        lat = self.latency.snapshot()
+        return {
+            "buckets": list(self.buckets),
+            "compiles": self.compiles,
+            "gang": self.servable.n_models if self.is_gang else 0,
+            "nFeatures": self.servable.n_features,
+            "requests": self.requests,
+            "rows": self.rows,
+            "batches": self.batches,
+            "coalesced": self.coalesced,
+            "shed": self.shed,
+            "retries": self.retries,
+            "requeues": self.requeues,
+            "latencyMs": {k: (v * 1e3 if k != "count" else v)
+                          for k, v in lat.items()},
+        }

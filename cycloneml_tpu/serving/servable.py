@@ -1,0 +1,218 @@
+"""The model-abstraction layer: fitted estimators as servable programs.
+
+Clipper's core move (Crankshaw et al., NSDI 2017) is a model-abstraction
+layer between the serving frontend and the frameworks behind it: the
+frontend batches and dispatches against one narrow interface, and each
+model plugs in by describing how to compute its scores. Here the
+interface is deliberately TPU-shaped: a servable exposes (a) device
+parameters (arrays passed as program arguments, never closed over — so
+one compiled program serves every model of the same signature) and (b) a
+host-side postprocessing step that reuses the fitted model's OWN
+reference numpy link/threshold code (``_raw_to_prediction``), keeping
+serving semantics bit-compatible with ``model.predict``.
+
+The device kernel computes linear margins as a broadcast-multiply-reduce
+(``sum(x[:, None, :] * coef[None, :, :], -1)``) rather than a ``dot``:
+each row's reduction is then independent of the batch dimension, so XLA
+produces bitwise-identical per-row results in EVERY shape bucket —
+zero-padding is numerically invisible, which the bucket-parity tests pin.
+A gang of K homogeneous servables stacks its parameters on a leading
+model axis and runs the vmapped twin of the same kernel: ONE program, K
+models, per-row results bitwise-equal to K serial dispatches (the PR-4
+stacked engine's serving-side life).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def serving_dtype(conf=None):
+    """Resolve ``cyclone.serving.dtype``: 'auto' means the accumulator
+    tier (float64 under jax x64, else float32). Request batches never ride
+    the bf16 data tier — serving is latency-bound, not bandwidth-bound.
+
+    An explicit 'float64' without jax x64 downgrades to float32 with a
+    warning: XLA would silently canonicalize every f64 argument to f32,
+    so honoring the name while computing narrower would misreport the
+    served precision (the same reasoning as ``compute_dtype``).
+    """
+    from cycloneml_tpu.conf import SERVING_DTYPE
+    from cycloneml_tpu.dataset.instance import compute_dtype
+    name = "auto"
+    if conf is not None:
+        name = str(conf.get(SERVING_DTYPE))
+    if name == "auto":
+        return np.dtype(compute_dtype())
+    if name == "float64":
+        try:
+            import jax
+            if not jax.config.jax_enable_x64:
+                logger.warning(
+                    "cyclone.serving.dtype=float64 requires jax x64 "
+                    "(jax would canonicalize f64 inputs to f32 silently); "
+                    "serving at float32")
+                return np.dtype(np.float32)
+        except Exception:
+            pass
+    return np.dtype(name)
+
+
+def linear_margins(coef, icpt, x):
+    """Device predict kernel: (Km, d), (Km,), (B, d) -> (B, Km) margins.
+
+    Broadcast-multiply-reduce on purpose (NOT ``x @ coef.T``): XLA picks
+    different gemm strategies per shape, so a dot's last-ulp results vary
+    with the batch dimension — this form reduces each row independently,
+    making bucket padding bitwise-neutral (pinned by the parity tests).
+    The (B, Km, d) product never materializes; XLA fuses it into one pass.
+    """
+    import jax.numpy as jnp
+    return jnp.sum(x[:, None, :] * coef[None, :, :], axis=-1) + icpt[None, :]
+
+
+def stacked_linear_margins(coefs, icpts, x):
+    """Gang kernel: (K, Km, d), (K, Km), (B, d) -> (K, B, Km) — the
+    vmapped twin of :func:`linear_margins` over a leading model axis; one
+    compiled program scores all K models of a gang."""
+    import jax
+    return jax.vmap(linear_margins, in_axes=(0, 0, None))(coefs, icpts, x)
+
+
+class Servable:
+    """One fitted model behind the serving interface.
+
+    ``raw_format`` maps device margins back into the model's raw-
+    prediction convention so the model's own numpy postprocessing runs
+    unchanged: ``pair`` (binary margin m -> raw (-m, m): logistic, SVC),
+    ``identity`` (multinomial margins ARE the raw), ``scalar``
+    (regression: the margin is the prediction).
+    """
+
+    def __init__(self, model: Any, coef: np.ndarray, icpt: np.ndarray,
+                 raw_format: str):
+        if raw_format not in ("pair", "identity", "scalar"):
+            raise ValueError(f"unknown raw_format {raw_format!r}")
+        self.model = model
+        self._coef = np.atleast_2d(np.asarray(coef, dtype=np.float64))
+        self._icpt = np.atleast_1d(np.asarray(icpt, dtype=np.float64))
+        if self._icpt.shape[0] != self._coef.shape[0]:
+            raise ValueError("coefficient rows and intercepts disagree")
+        self.raw_format = raw_format
+
+    @property
+    def n_features(self) -> int:
+        return self._coef.shape[1]
+
+    @property
+    def n_margins(self) -> int:
+        return self._coef.shape[0]
+
+    @property
+    def signature(self) -> Tuple:
+        """Homogeneity class: gangs require identical signatures, and the
+        serving program cache keys on it (shapes below it are handled by
+        jit's own per-shape cache)."""
+        return (type(self.model).__name__, self.raw_format,
+                self.n_margins, self.n_features)
+
+    def params(self, dtype) -> Tuple[np.ndarray, np.ndarray]:
+        """(coef, icpt) at the serving dtype — program ARGUMENTS, so every
+        same-signature model shares one compiled program."""
+        return (self._coef.astype(dtype, copy=False),
+                self._icpt.astype(dtype, copy=False))
+
+    def margins_to_raw(self, margins: np.ndarray) -> np.ndarray:
+        if self.raw_format == "pair":
+            m = margins[:, 0]
+            return np.stack([-m, m], axis=1)
+        return margins
+
+    def postprocess(self, margins: np.ndarray) -> np.ndarray:
+        """Margins (n, Km) -> final predictions (n,), via the fitted
+        model's own reference numpy link/threshold code."""
+        if self.raw_format == "scalar":
+            return margins[:, 0]
+        return self.model._raw_to_prediction(self.margins_to_raw(margins))
+
+    def host_margins(self, x: np.ndarray) -> np.ndarray:
+        """Reference host-numpy margins (float64) — the parity baseline."""
+        return x.astype(np.float64) @ self._coef.T + self._icpt[None, :]
+
+
+class GangServable:
+    """K homogeneous servables served from ONE vmapped program."""
+
+    def __init__(self, members: Sequence[Servable]):
+        members = list(members)
+        if not members:
+            raise ValueError("a gang needs at least one model")
+        sig = members[0].signature
+        for m in members[1:]:
+            if m.signature != sig:
+                raise ValueError(
+                    f"gang members must be homogeneous: {m.signature} != "
+                    f"{sig} (same model type, raw format, classes and "
+                    f"feature count)")
+        self.members: List[Servable] = members
+        self._coefs = np.stack([m._coef for m in members])   # (K, Km, d)
+        self._icpts = np.stack([m._icpt for m in members])   # (K, Km)
+
+    @property
+    def n_models(self) -> int:
+        return len(self.members)
+
+    @property
+    def n_features(self) -> int:
+        return self.members[0].n_features
+
+    @property
+    def signature(self) -> Tuple:
+        return ("gang", self.n_models) + self.members[0].signature
+
+    def params(self, dtype) -> Tuple[np.ndarray, np.ndarray]:
+        return (self._coefs.astype(dtype, copy=False),
+                self._icpts.astype(dtype, copy=False))
+
+    def postprocess(self, margins: np.ndarray) -> List[np.ndarray]:
+        """Stacked margins (K, n, Km) -> per-model predictions
+        [(n,), ...] through each member's own postprocessing."""
+        return [m.postprocess(margins[k])
+                for k, m in enumerate(self.members)]
+
+
+def as_servable(model: Any) -> Servable:
+    """Adapt a fitted estimator to the serving interface.
+
+    Linear-form models are supported (their predict is one fused matvec —
+    the latency-serving sweet spot): LogisticRegressionModel (binomial and
+    multinomial), LinearSVCModel, LinearRegressionModel, and anything
+    already wrapped as a :class:`Servable`.
+    """
+    if isinstance(model, (Servable, GangServable)):
+        return model
+    from cycloneml_tpu.ml.classification.linear_svc import LinearSVCModel
+    from cycloneml_tpu.ml.classification.logistic_regression import (
+        LogisticRegressionModel,
+    )
+    from cycloneml_tpu.ml.regression.linear_regression import (
+        LinearRegressionModel,
+    )
+    if isinstance(model, LogisticRegressionModel):
+        if model._is_multinomial:
+            return Servable(model, model._coef, model._icpt, "identity")
+        return Servable(model, model._coef[0], model._icpt[:1], "pair")
+    if isinstance(model, LinearSVCModel):
+        return Servable(model, model._coef, [model._icpt], "pair")
+    if isinstance(model, LinearRegressionModel):
+        return Servable(model, model._coef, [model._icpt], "scalar")
+    raise TypeError(
+        f"no servable adapter for {type(model).__name__}; supported: "
+        f"LogisticRegressionModel, LinearSVCModel, LinearRegressionModel, "
+        f"or a prebuilt Servable")

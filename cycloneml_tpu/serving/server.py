@@ -1,0 +1,311 @@
+"""ModelServer: low-latency inference on the program-cache waist.
+
+The training side compiles once per program identity and dispatches many
+times; serving inherits exactly that discipline. Registration (never a
+request) pays every compile: the server AOT-warms one predict program per
+power-of-two row bucket through the shared
+:class:`~cycloneml_tpu.parallel.collectives.BoundedProgramCache` idiom —
+a module-level cache keyed by servable SIGNATURE holds the jitted kernel
+(two models with the same shape share one program outright), and jit's
+per-shape cache under it holds the per-bucket executables. A request's
+life is: queue -> coalesce (batcher window) -> pad to bucket -> admission
+check -> replay a warmed program -> split results. Steady-state compiles
+are pinned to zero by the serving tests.
+
+K homogeneous models register as a GANG: one vmapped program scores all K
+per dispatch (the PR-4 stacked engine's serving-side life), so a model
+zoo multiplies throughput, not compile count or dispatch overhead.
+
+Observability: every request gets a ``serving`` span (queue/dispatch
+phases), latency/throughput feed the MetricsRegistry (p50/p95/p99 via the
+canonical summary path), and a rolled-up stats dict rides
+``ServingStatsUpdated`` events into the status store (``/api/v1/serving``
+and the web UI).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from cycloneml_tpu.parallel.collectives import BoundedProgramCache
+from cycloneml_tpu.serving.batcher import ModelLane, ServingError
+from cycloneml_tpu.serving.buckets import bucket_sizes
+from cycloneml_tpu.serving.servable import (
+    GangServable, Servable, as_servable, linear_margins, serving_dtype,
+    stacked_linear_margins,
+)
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+# servable signature -> jitted predict kernel. Module-level like the
+# collectives program cache: programs survive server restarts and are
+# cleared with clear_program_cache() on mesh teardown.
+_predict_programs = BoundedProgramCache(128)
+
+
+class ModelServer:
+    """Registry + micro-batcher + admission control over servable models.
+
+    ``ctx`` (a CycloneContext) supplies conf, metrics registry and the
+    listener bus; all three degrade gracefully when the server runs
+    standalone (defaults conf, private registry, no events). Keyword
+    overrides beat conf — tests and demos tune windows without touching
+    global conf state.
+    """
+
+    def __init__(self, ctx=None, *, conf=None, max_batch: Optional[int] = None,
+                 window_ms: Optional[float] = None, dtype=None,
+                 max_queue: Optional[int] = None,
+                 shed_after_ms: Optional[float] = None,
+                 max_retries: Optional[int] = None, registry=None):
+        from cycloneml_tpu.conf import (
+            SERVING_MAX_BATCH, SERVING_MAX_QUEUE, SERVING_MAX_RETRIES,
+            SERVING_SHED_AFTER_MS, SERVING_WINDOW_MS, CycloneConf,
+        )
+        if ctx is None:
+            from cycloneml_tpu.context import active_context
+            ctx = active_context()
+        self.ctx = ctx
+        if conf is not None:
+            self.conf = conf  # explicit conf wins (budget-guard tests)
+        else:
+            self.conf = ctx.conf if ctx is not None else CycloneConf()
+        self.bus = ctx.listener_bus if ctx is not None else None
+        if registry is not None:
+            self.registry = registry
+        elif ctx is not None:
+            self.registry = ctx.metrics.registry
+        else:
+            from cycloneml_tpu.util.metrics import MetricsRegistry
+            self.registry = MetricsRegistry()
+        self.max_batch = int(max_batch if max_batch is not None
+                             else self.conf.get(SERVING_MAX_BATCH))
+        self.window_s = float(window_ms if window_ms is not None
+                              else self.conf.get(SERVING_WINDOW_MS)) / 1e3
+        self.max_queue = int(max_queue if max_queue is not None
+                             else self.conf.get(SERVING_MAX_QUEUE))
+        self.shed_after_s = float(
+            shed_after_ms if shed_after_ms is not None
+            else self.conf.get(SERVING_SHED_AFTER_MS)) / 1e3
+        self.max_retries = int(max_retries if max_retries is not None
+                               else self.conf.get(SERVING_MAX_RETRIES))
+        self.dtype = (np.dtype(dtype) if dtype is not None
+                      else serving_dtype(self.conf))
+        self._lanes: Dict[str, ModelLane] = {}
+        # names whose warm-up is in flight: _install releases the lock
+        # during the (slow) AOT warm-up, so the duplicate-name check must
+        # cover in-progress registrations too, not just finished ones
+        self._registering: set = set()
+        self._lock = threading.Lock()
+        self._stats_last = 0.0
+        self._stopped = False
+
+    # -- program cache ----------------------------------------------------------
+
+    def _program_for(self, servable: Union[Servable, GangServable]):
+        """One jitted kernel per (gang?, dtype) — shapes (and therefore
+        buckets) live in jit's own cache below this key, so the ledger of
+        real XLA compiles is ``program._cache_size()``."""
+        import jax
+        key = ("serving.linear_margins", isinstance(servable, GangServable),
+               self.dtype.str)
+        prog = _predict_programs.get(key)
+        if prog is None:
+            kernel = (stacked_linear_margins
+                      if isinstance(servable, GangServable)
+                      else linear_margins)
+            prog = jax.jit(kernel)
+            _predict_programs.put(key, prog)
+        return prog
+
+    # -- registration -----------------------------------------------------------
+
+    def register(self, name: str, model: Any) -> Dict[str, Any]:
+        """Adapt + AOT-warm ``model`` under ``name``. Every shape bucket
+        compiles here (or proves already cached); returns the entry's
+        stats, including the compile ledger."""
+        return self._install(name, as_servable(model))
+
+    def register_gang(self, name: str, models: Sequence[Any]
+                      ) -> Dict[str, Any]:
+        """Register K homogeneous models as ONE vmapped program.
+        ``predict`` on a gang returns a list of K per-model results."""
+        gang = GangServable([as_servable(m) for m in models])
+        return self._install(name, gang)
+
+    def _install(self, name: str, servable) -> Dict[str, Any]:
+        with self._lock:
+            if self._stopped:
+                raise ServingError("model server is stopped", status=503)
+            if name in self._lanes or name in self._registering:
+                raise ValueError(f"model {name!r} already registered")
+            self._registering.add(name)
+            lane = ModelLane(name, servable, self)
+        try:
+            t0 = time.perf_counter()
+            lane.warm_up()
+            logger.info(
+                "serving: registered %r (%s, d=%d): %d buckets warmed, %d "
+                "compiles, %.1f ms", name,
+                "gang[%d]" % servable.n_models if lane.is_gang else "serial",
+                servable.n_features, len(lane.buckets), lane.compiles,
+                (time.perf_counter() - t0) * 1e3)
+            with self._lock:
+                # re-check under the lock: stop() may have run while the
+                # (slow, unlocked) warm-up was in flight — installing now
+                # would leave a live worker on a "stopped" server
+                if self._stopped:
+                    raise ServingError("model server stopped during "
+                                       "registration", status=503)
+                lane.start()
+                self._lanes[name] = lane
+        finally:
+            with self._lock:
+                self._registering.discard(name)
+        self._post_stats(force=True)
+        return lane.stats()
+
+    # -- request path -----------------------------------------------------------
+
+    def predict(self, name: str, x, timeout: Optional[float] = None):
+        """Score ``x`` (row vector or (n, d) matrix) against ``name``.
+
+        Blocks until the micro-batcher answers; requests larger than
+        ``maxBatch`` rows split into maxBatch-row sub-requests and
+        reassemble transparently. Serial models return an (n,) prediction
+        array; gangs return a list of K per-model arrays.
+        """
+        lane = self._lane(name)
+        x2 = np.asarray(x, dtype=self.dtype)
+        if x2.ndim == 1:
+            # a single feature row — except a 0-length 1-D array, which is
+            # how an empty wire payload (rows: []) arrives: that is an
+            # empty REQUEST, not a d=0 row
+            x2 = (x2.reshape(0, lane.servable.n_features) if x2.size == 0
+                  else x2[None, :])
+        if x2.ndim != 2 or x2.shape[1] != lane.servable.n_features:
+            raise ValueError(
+                f"model {name!r} expects (n, {lane.servable.n_features}) "
+                f"features, got {x2.shape}")
+        if x2.shape[0] == 0:
+            empty = np.zeros((0,), dtype=np.float64)
+            return ([empty] * lane.servable.n_models if lane.is_gang
+                    else empty)
+        futures = []
+        try:
+            for i in range(0, x2.shape[0], self.max_batch):
+                futures.append(lane.submit(x2[i:i + self.max_batch]))
+        except ServingError:
+            # shed the whole request as a unit: a sibling chunk that hit
+            # backpressure must not leave earlier chunks burning device
+            # time on results the caller will never read
+            for f in futures:
+                lane.try_cancel(f)
+            raise
+        if timeout is None:
+            # worst honest wait: window + shed patience + dispatch slack
+            # per sub-request — a hung future is a bug, not a wait
+            timeout = (self.window_s + self.shed_after_s
+                       + 30.0) * len(futures)
+        # ONE total deadline: an explicit timeout=5 means the caller gets
+        # an answer (or a 504) within ~5 s, not 5 s per chunk
+        deadline = time.monotonic() + timeout
+        parts = []
+        try:
+            for f in futures:
+                parts.append(f.result(
+                    timeout=max(0.0, deadline - time.monotonic())))
+        except BaseException as e:
+            # one chunk failed: the caller gets nothing, so still-queued
+            # siblings must not burn dispatches (same unwind as the
+            # submit-time backpressure path)
+            for f in futures:
+                if not f.done():
+                    lane.try_cancel(f)
+            import concurrent.futures as _cf
+            if isinstance(e, _cf.TimeoutError):
+                raise ServingError(
+                    f"model {name!r} request timed out after {timeout:.1f}s",
+                    status=504, cause=e) from e
+            raise
+        if lane.is_gang:
+            if len(parts) == 1:
+                return parts[0]
+            return [np.concatenate([p[k] for p in parts])
+                    for k in range(lane.servable.n_models)]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def _lane(self, name: str) -> ModelLane:
+        with self._lock:
+            lane = self._lanes.get(name)
+        if lane is None:
+            raise KeyError(
+                f"no model {name!r} registered (have: "
+                f"{sorted(self._lanes) or 'none'})")
+        return lane
+
+    # -- introspection ----------------------------------------------------------
+
+    @property
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._lanes)
+
+    def n_features(self, name: str) -> int:
+        return self._lane(name).servable.n_features
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Per-model XLA compiles paid at registration — the serving
+        tests pin this == the bucket count (and flat thereafter)."""
+        with self._lock:
+            return {n: lane.compiles for n, lane in self._lanes.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lanes = dict(self._lanes)
+        models = {n: lane.stats() for n, lane in lanes.items()}
+        totals = {k: sum(m[k] for m in models.values())
+                  for k in ("requests", "rows", "batches", "shed",
+                            "retries", "compiles", "coalesced")}
+        totals["models"] = len(models)
+        totals["buckets"] = len(bucket_sizes(self.max_batch))
+        return {"models": models, "totals": totals,
+                "maxBatch": self.max_batch,
+                "windowMs": self.window_s * 1e3,
+                "dtype": self.dtype.name}
+
+    def _post_stats(self, force: bool = False) -> None:
+        """Fold the rolled-up stats into the status store via the event
+        bus, throttled so a hot serving loop does not flood the journal."""
+        if self.bus is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._stats_last < 0.5:
+            return
+        self._stats_last = now
+        from cycloneml_tpu.util.events import ServingStatsUpdated
+        try:
+            self.bus.post(ServingStatsUpdated(stats=self.stats()))
+        except Exception:
+            pass  # a stopped bus must not fail the dispatch path
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            lane.stop()
+        self._post_stats(force=True)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -12,9 +12,19 @@ with a DB-API-ish Python client. What carries over is the functional
 contract: concurrent remote clients, shared catalog, per-connection
 session state, statement-at-a-time execution, typed errors.
 
-Requests:  ``{"sql": "..."}``
-Responses: ``{"ok": true, "columns": [...], "rows": [[...], ...]}`` or
+Requests:  ``{"sql": "..."}`` or — when a model server is attached —
+           ``{"predict": {"model": "name", "rows": [[...], ...]}}``
+Responses: ``{"ok": true, "columns": [...], "rows": [[...], ...]}``,
+           ``{"ok": true, "model": "name", "predictions": [...]}`` or
            ``{"ok": false, "error": "...", "kind": "AnalysisException"}``
+           (serving errors additionally carry their 5xx ``"status"``)
+
+The scoring endpoint is the Clipper-frontend role folded into the
+existing wire surface: prediction requests ride the SAME connection and
+framing as SQL, and land in the attached
+:class:`~cycloneml_tpu.serving.ModelServer`'s micro-batcher — concurrent
+clients coalesce into bucketed dispatches exactly like in-process
+callers.
 """
 
 from __future__ import annotations
@@ -59,8 +69,11 @@ class CycloneSQLServer:
     reference's shared HiveThriftServer2 SQLContext)."""
 
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None, model_server=None):
         self.session = session
+        # optional serving backend: {"predict": ...} requests score
+        # through its micro-batcher; None keeps the server SQL-only
+        self.model_server = model_server
         # statements serialize: the session catalog is a plain dict with
         # check-then-act DDL/DML sequences (the same discipline as
         # MasterDaemon._dispatch; HiveServer2's sync mode likewise runs
@@ -79,10 +92,16 @@ class CycloneSQLServer:
                         continue
                     try:
                         req = json.loads(line)
-                        reply = server._run(req["sql"], sess)
+                        if "predict" in req:
+                            reply = server._predict(req["predict"])
+                        else:
+                            reply = server._run(req["sql"], sess)
                     except Exception as e:
                         reply = {"ok": False, "error": str(e),
                                  "kind": type(e).__name__}
+                        status = getattr(e, "status", None)
+                        if status is not None:  # serving 5xx classes
+                            reply["status"] = int(status)
                     self.wfile.write(
                         (json.dumps(reply) + "\n").encode())
                     self.wfile.flush()
@@ -103,6 +122,23 @@ class CycloneSQLServer:
                     else df.columns)  # plan schema, no re-execution
         rows = [[_json_value(v) for v in r._values] for r in collected]
         return {"ok": True, "columns": cols, "rows": rows}
+
+    def _predict(self, spec: dict) -> dict:
+        """Scoring request — routed through the attached model server's
+        batcher (NOT under the statement lock: predictions are
+        read-only over registered models and coalescing concurrent
+        scorers is the whole point)."""
+        if self.model_server is None:
+            raise RuntimeError("no model server attached to this SQL "
+                               "server (pass model_server=)")
+        name = spec["model"]
+        rows = np.asarray(spec["rows"], dtype=np.float64)
+        preds = self.model_server.predict(name, rows)
+        if isinstance(preds, list):  # gang: per-model prediction lists
+            payload = [[_json_value(v) for v in p] for p in preds]
+        else:
+            payload = [_json_value(v) for v in preds]
+        return {"ok": True, "model": name, "predictions": payload}
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -127,14 +163,14 @@ class SQLClient:
         self._fh = self._sock.makefile("rw")
         self._broken = False
 
-    def execute(self, sql: str) -> Tuple[List[str], List[list]]:
+    def _roundtrip(self, req: dict) -> dict:
         if self._broken:
             raise IOError("connection desynchronized by an earlier "
                           "timeout; open a new SQLClient")
         try:
             # a SEND-side timeout can leave a partial request on the wire
             # — just as fatal to framing as a missed reply
-            self._fh.write(json.dumps({"sql": sql}) + "\n")
+            self._fh.write(json.dumps(req) + "\n")
             self._fh.flush()
             line = self._fh.readline()
         except (socket.timeout, TimeoutError):
@@ -150,8 +186,29 @@ class SQLClient:
             if kind == "AnalysisException":
                 from cycloneml_tpu.sql.analyzer import AnalysisException
                 raise AnalysisException(rep.get("error"))
+            if kind in ("ServingError", "ServingOverloaded"):
+                from cycloneml_tpu.serving.batcher import (
+                    ServingError, ServingOverloaded,
+                )
+                cls = (ServingOverloaded if kind == "ServingOverloaded"
+                       else ServingError)
+                raise cls(str(rep.get("error")),
+                          **({} if kind == "ServingOverloaded"
+                             else {"status": int(rep.get("status", 500))}))
             raise RuntimeError(f"{kind}: {rep.get('error')}")
+        return rep
+
+    def execute(self, sql: str) -> Tuple[List[str], List[list]]:
+        rep = self._roundtrip({"sql": sql})
         return rep["columns"], rep["rows"]
+
+    def predict(self, model: str, rows) -> list:
+        """Score ``rows`` against a registered model on the server's
+        attached ModelServer; serving errors re-raise typed (a shed
+        request surfaces as ServingOverloaded, status 503)."""
+        rows = [[float(v) for v in r] for r in rows]
+        rep = self._roundtrip({"predict": {"model": model, "rows": rows}})
+        return rep["predictions"]
 
     def close(self) -> None:
         try:

@@ -521,6 +521,7 @@ class DataStreamWriter:
         self._trigger: Dict[str, Any] = {"processingTime": 0.1}
         self._name = ""
         self._foreach: Optional[Callable] = None
+        self._custom_sink: Optional[Sink] = None
         self.sink: Optional[Sink] = None
 
     def output_mode(self, mode: str) -> "DataStreamWriter":
@@ -560,6 +561,15 @@ class DataStreamWriter:
         self._format = "foreach_batch"
         return self
 
+    def sink_to(self, sink: Sink) -> "DataStreamWriter":
+        """Write to a caller-constructed Sink instance (e.g. a
+        ``serving.ScoringSink`` wrapping a MemorySink — the
+        featurize→predict→sink pipeline). The sink owns idempotence per
+        batch id, like every other sink."""
+        self._custom_sink = sink
+        self._format = "custom"
+        return self
+
     def start(self, path: Optional[str] = None) -> StreamingQuery:
         session = self._df.session
         ckpt = self._options.get("checkpointLocation") or tempfile.mkdtemp(
@@ -572,6 +582,8 @@ class DataStreamWriter:
             sink = FileSink(path or self._options["path"], self._format)
         elif self._format == "foreach_batch":
             sink = ForeachBatchSink(self._foreach, session)
+        elif self._format == "custom" and self._custom_sink is not None:
+            sink = self._custom_sink
         else:
             raise ValueError(f"unknown sink format {self._format!r}")
         self.sink = sink

@@ -112,6 +112,16 @@ class MemoryBudgetExceeded(CycloneEvent):
 
 
 @dataclass
+class ServingStatsUpdated(CycloneEvent):
+    """Model-server rollup (ModelServer.stats(): per-model request/
+    latency/compile/shed tallies + totals), posted on registration and
+    throttled batch completions. The status store keeps the latest, so
+    ``/api/v1/serving`` and history replay see the same shape."""
+
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class CheckpointWritten(CycloneEvent):
     path: str = ""
     step: int = 0

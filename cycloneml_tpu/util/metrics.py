@@ -85,17 +85,32 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    @staticmethod
+    def _rank(s: List[float], q: float) -> float:
+        """Nearest-rank quantile over pre-sorted samples (the ONE
+        formula both quantile() and snapshot() use)."""
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+
     def quantile(self, q: float) -> float:
         with self._lock:
-            if not self._samples:
-                return 0.0
             s = sorted(self._samples)
-            return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+        return self._rank(s, q)
 
     def snapshot(self) -> Dict[str, float]:
-        return {"count": self.count, "mean": self.mean,
-                "p50": self.quantile(0.5), "p95": self.quantile(0.95),
-                "max": self.quantile(1.0)}
+        # p99 rides the same window as p50/p95: serving latency SLOs are
+        # quoted at the 99th percentile (Clipper's objective), and the
+        # summary exposition renders all three quantiles. One sorted copy
+        # serves every quantile — snapshot runs per scrape and per
+        # serving stats rollup, so four independent sorts would be 4x
+        # wasted O(n log n) on a recurring path.
+        with self._lock:
+            count, total = self._count, self._sum
+            s = sorted(self._samples)
+        return {"count": count, "mean": (total / count if count else 0.0),
+                "p50": self._rank(s, 0.5), "p95": self._rank(s, 0.95),
+                "p99": self._rank(s, 0.99), "max": self._rank(s, 1.0)}
 
 
 class Timer(Histogram):
@@ -249,12 +264,14 @@ def prometheus_text(values: Dict[str, float], prefix: str = "cyclone",
     for base in sorted(n for n, t in types.items() if t == "summary"):
         cnt = values.get(f"{base}.count")
         consumed.update(f"{base}.{k}"
-                        for k in ("count", "mean", "p50", "p95", "max"))
+                        for k in ("count", "mean", "p50", "p95", "p99",
+                                  "max"))
         if cnt is None or not _finite(cnt):
             continue
         s = safe(base)
         lines.append(f"# TYPE {s} summary")
-        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("1", "max")):
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"),
+                       ("1", "max")):
             v = values.get(f"{base}.{key}")
             if v is not None and _finite(v):
                 lines.append(f'{s}{{quantile="{q}"}} {v}')

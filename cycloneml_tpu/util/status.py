@@ -33,6 +33,9 @@ class AppStatusStore:
         self.profiles: Dict[int, Dict[str, Any]] = {}
         # MemoryBudgetExceeded events (observe/costs.py budget guard)
         self.memory_warnings: List[Dict[str, Any]] = []
+        # latest ServingStatsUpdated rollup (serving/server.py), {} until
+        # a model server posts
+        self.serving: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -61,6 +64,11 @@ class AppStatusStore:
         """The job's FitProfile dict, or {} (untraced run / unknown job)."""
         with self._lock:
             return dict(self.profiles.get(job_id, {}))
+
+    def serving_stats(self) -> Dict[str, Any]:
+        """The latest model-server rollup, or {} when nothing serves."""
+        with self._lock:
+            return dict(self.serving)
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -124,6 +132,9 @@ class AppStatusListener:
         elif kind == "FitProfileCompleted":
             with s._lock:
                 s.profiles[e.get("job_id", 0)] = dict(e.get("profile", {}))
+        elif kind == "ServingStatsUpdated":
+            with s._lock:
+                s.serving = dict(e.get("stats", {}))
         elif kind == "MemoryBudgetExceeded":
             s.memory_warnings.append({
                 "program": e.get("program"),
@@ -177,7 +188,7 @@ def api_v1(store: AppStatusStore, route: str,
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
-    'memory/warnings'."""
+    'memory/warnings', 'serving'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -194,4 +205,6 @@ def api_v1(store: AppStatusStore, route: str,
         return list(store.worker_failures)
     if route == "memory/warnings":
         return list(store.memory_warnings)
+    if route == "serving":
+        return store.serving_stats()
     raise KeyError(f"unknown route {route!r}")

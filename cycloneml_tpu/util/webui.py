@@ -34,6 +34,7 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>Cyclone <span id="app" class="muted"></span></h1>
 <h2>Jobs</h2><div id="jobs" class="muted">loading…</div>
+<h2>Serving</h2><div id="serving" class="muted">none</div>
 <h2>Storage</h2><div id="storage" class="muted">none</div>
 <h2>Checkpoints</h2><div id="ckpts" class="muted">none</div>
 <h2>Worker failures</h2><div id="fails" class="muted">none</div>
@@ -78,6 +79,14 @@ async function refresh() {
     }
   }
   document.getElementById('jobs').innerHTML = html;
+  const srv = await j('serving');
+  if (srv && srv.models && Object.keys(srv.models).length) {
+    const rows = Object.entries(srv.models).map(([k, v]) =>
+      Object.assign({model: k}, v));
+    document.getElementById('serving').innerHTML =
+      table(rows, ['model', 'gang', 'requests', 'rows', 'batches',
+                   'coalesced', 'shed', 'compiles', 'latencyMs']);
+  }
   const st = await j('storage');
   if (st.length) document.getElementById('storage').innerHTML =
     table(st, ['tier', 'bytes']);

@@ -386,3 +386,82 @@ def test_injector_installs_exclusively():
     # uninstalled on exit: a fresh injector can install now
     with FaultInjector(FaultSchedule()):
         pass
+
+
+# -- serving dispatch faults (ISSUE 8) ------------------------------------------
+
+def _serving_fixture(d, **kw):
+    from cycloneml_tpu.ml.classification.logistic_regression import (
+        LogisticRegressionModel,
+    )
+    from cycloneml_tpu.serving import ModelServer
+    r = np.random.default_rng(0)
+    model = LogisticRegressionModel(r.normal(size=(1, d)),
+                                    r.normal(size=(1,)), 2, False)
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0, **kw)
+    srv.register("m", model)
+    return srv, model
+
+
+def test_serving_transient_dispatch_fault_is_retried():
+    """A DCN-flake-class fault on serving.dispatch is retried with
+    backoff: the request still gets the CORRECT answer, and the retry is
+    visible in both the injector log and the lane's retry ledger."""
+    d = 41
+    srv, model = _serving_fixture(d)
+    sched = FaultSchedule(seed=0)
+    sched.at("serving.dispatch", 1,
+             TransientCollectiveError("injected serving flake"))
+    x = np.random.default_rng(1).normal(size=(3, d))
+    with FaultInjector(sched) as inj:
+        preds = srv.predict("m", x, timeout=30)
+    assert np.array_equal(preds, model._predict_batch(x))
+    assert inj.log == [("serving.dispatch", 1, "TransientCollectiveError")]
+    st = srv.stats()["models"]["m"]
+    assert st["retries"] >= 1 and st["requests"] == 1
+    srv.stop()
+
+
+def test_serving_permanent_dispatch_fault_sheds_5xx_never_hangs():
+    """A permanent fault (broken step function class: TypeError) must NOT
+    be retried: every request in the batch fails fast with a 5xx
+    ServingError carrying the cause — and the lane stays alive for the
+    next request. A hang here would strand client futures forever."""
+    from cycloneml_tpu.serving import ServingError
+    d = 42
+    srv, model = _serving_fixture(d)
+    sched = FaultSchedule(seed=0)
+    sched.at("serving.dispatch", 1, TypeError("injected broken dispatch"))
+    x = np.random.default_rng(2).normal(size=(2, d))
+    t0 = time.perf_counter()
+    with FaultInjector(sched) as inj:
+        with pytest.raises(ServingError) as ei:
+            srv.predict("m", x, timeout=30)
+        assert 500 <= ei.value.status < 600
+        assert isinstance(ei.value.cause, TypeError)
+        assert time.perf_counter() - t0 < 10  # shed, not hung
+        # not retried: exactly one injection, zero retry ledger entries
+        assert len(inj.log) == 1
+        assert srv.stats()["models"]["m"]["retries"] == 0
+        # the worker survived and keeps serving
+        preds = srv.predict("m", x, timeout=30)
+    assert np.array_equal(preds, model._predict_batch(x))
+    srv.stop()
+
+
+def test_serving_transient_faults_exhaust_to_5xx():
+    """Transient faults past cyclone.serving.maxRetries stop retrying and
+    shed with a 5xx — bounded recovery, no infinite retry loop."""
+    from cycloneml_tpu.serving import ServingError
+    d = 43
+    srv, model = _serving_fixture(d, max_retries=2)
+    sched = FaultSchedule(seed=0)
+    sched.at("serving.dispatch", [1, 2, 3, 4],
+             TransientCollectiveError("persistent flake"))
+    x = np.random.default_rng(3).normal(size=(1, d))
+    with FaultInjector(sched) as inj:
+        with pytest.raises(ServingError) as ei:
+            srv.predict("m", x, timeout=30)
+    assert 500 <= ei.value.status < 600
+    assert len(inj.log) == 3  # initial attempt + maxRetries, then shed
+    srv.stop()

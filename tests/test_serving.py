@@ -1,0 +1,617 @@
+"""Model-server acceptance suite (ISSUE 8).
+
+Pins the serving contract end to end: one XLA compile per shape bucket
+(paid at registration, never by a request), window-bounded coalescing of
+concurrent requests into one dispatch, admission control that queues/
+sheds under a tiny memory budget instead of raising from XLA, gang
+serving bit-equal to serial predicts, bucket-padding numeric parity, and
+the ingestion surfaces (SQL scoring endpoint, streaming ScoringSink).
+
+Compile-count determinism note: the serving program cache and jit's
+per-shape cache are process-global, so every test here uses a DISTINCT
+feature count (d) — a reused (d, dtype) shape would legitimately reuse an
+earlier test's executable and report zero compiles.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.conf import CycloneConf
+from cycloneml_tpu.ml.classification.logistic_regression import (
+    LogisticRegressionModel,
+)
+from cycloneml_tpu.ml.regression.linear_regression import LinearRegressionModel
+from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.serving import (
+    ModelServer, ServingError, ServingOverloaded, as_servable, bucket_for,
+    bucket_sizes, pad_rows,
+)
+
+rng = np.random.default_rng(7)
+
+
+def _binary_lr(d, seed=0):
+    r = np.random.default_rng(seed)
+    return LogisticRegressionModel(r.normal(size=(1, d)),
+                                   r.normal(size=(1,)), 2, False)
+
+
+# -- buckets --------------------------------------------------------------------
+
+def test_bucket_helpers():
+    assert bucket_sizes(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_sizes(100) == (1, 2, 4, 8, 16, 32, 64, 128)
+    assert bucket_for(1, 64) == 1
+    assert bucket_for(33, 64) == 64
+    assert bucket_for(100, 100) == 128
+    with pytest.raises(ValueError):
+        bucket_for(65, 64)
+    with pytest.raises(ValueError):
+        bucket_for(0, 64)
+    x = np.ones((3, 2))
+    p = pad_rows(x, 8)
+    assert p.shape == (8, 2) and np.all(p[3:] == 0) and np.all(p[:3] == 1)
+    assert pad_rows(x, 3) is x  # exact fit: no copy
+
+
+# -- compile-once-per-bucket -----------------------------------------------------
+
+def test_one_compile_per_bucket_never_per_request():
+    """N concurrent mixed-row-count requests leave the compile ledger
+    exactly where registration warm-up put it: one compile per bucket,
+    pinned via the jit program cache size AND the warm-up compile spans."""
+    d = 23  # unique to this test (see module docstring)
+    tracer = tracing.enable()
+    try:
+        srv = ModelServer(ctx=None, max_batch=16, window_ms=2)
+        srv.register("m", _binary_lr(d))
+        lane = srv._lane("m")
+        n_buckets = len(lane.buckets)
+        assert lane.buckets == (1, 2, 4, 8, 16)
+        compile_spans = [s for s in tracer.snapshot()
+                         if s.kind == "compile" and s.name == "serving/m"]
+        assert len(compile_spans) == n_buckets
+        assert all(s.attrs.get("compiled") for s in compile_spans)
+        assert srv.compile_counts()["m"] == n_buckets
+        cache_after_warmup = lane._cache_size()
+
+        errors = []
+
+        def fire(n_rows):
+            try:
+                srv.predict("m", rng.normal(size=(n_rows, d)))
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=fire, args=(n,))
+                   for n in (1, 2, 3, 5, 7, 8, 11, 16, 1, 4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        # steady state: zero new compiles, by every ledger
+        assert srv.compile_counts()["m"] == n_buckets
+        assert lane._cache_size() == cache_after_warmup
+        assert len([s for s in tracer.snapshot()
+                    if s.kind == "compile"
+                    and s.name == "serving/m"]) == n_buckets
+        srv.stop()
+    finally:
+        tracing.disable()
+
+
+# -- coalescing ------------------------------------------------------------------
+
+def test_batcher_coalesces_concurrent_requests():
+    d = 24
+    srv = ModelServer(ctx=None, max_batch=64, window_ms=150)
+    srv.register("m", _binary_lr(d))
+    model = srv._lane("m").servable.model
+    x = rng.normal(size=(2, d))
+    ref = model._predict_batch(x)
+    results, errors = [], []
+    barrier = threading.Barrier(4)
+
+    def fire():
+        try:
+            barrier.wait(timeout=10)
+            results.append(srv.predict("m", x))
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=fire) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors and len(results) == 4
+    for r in results:  # split-back correctness: everyone gets THEIR answer
+        assert np.array_equal(r, ref)
+    st = srv.stats()["models"]["m"]
+    assert st["requests"] == 4
+    # the 150 ms window coalesced barrier-released requests into fewer
+    # dispatches, at least one of them carrying >= 2 requests
+    assert st["batches"] < 4
+    assert st["coalesced"] >= 2
+    srv.stop()
+
+
+# -- admission control -----------------------------------------------------------
+
+def test_admission_queues_then_sheds_under_tiny_budget():
+    """An impossible memory budget (budgetFraction over one byte of
+    'device memory') must shed with a 503-style ServingOverloaded after
+    queued patience — never a MemoryBudgetError (even under
+    budgetAction=raise), never an XLA OOM, never a hang."""
+    d = 25
+    conf = (CycloneConf()
+            .set("cyclone.memory.budgetFraction", 0.5)
+            .set("cyclone.memory.deviceBytes", 1)
+            .set("cyclone.memory.budgetAction", "raise"))
+    srv = ModelServer(ctx=None, conf=conf, max_batch=8, window_ms=5,
+                      shed_after_ms=80)
+    srv.register("m", _binary_lr(d))
+    lane = srv._lane("m")
+    assert lane.pids, "budget conf must arm the warm-up cost harvest"
+    t0 = time.perf_counter()
+    with pytest.raises(ServingOverloaded) as ei:
+        srv.predict("m", rng.normal(size=(3, d)), timeout=30)
+    assert ei.value.status == 503
+    assert time.perf_counter() - t0 < 20  # shed, not hung
+    st = srv.stats()["models"]["m"]
+    assert st["shed"] >= 1
+    assert st["requeues"] >= 1  # it QUEUED (backpressure) before shedding
+    assert st["batches"] == 0   # the over-budget program never dispatched
+    srv.stop()
+
+
+def test_admission_verdict_cached_and_harvest_shared(monkeypatch):
+    """The requeue loop must not re-post MemoryBudgetExceeded every
+    window: check_budget runs ONCE per bucket (verdict cached; only live
+    occupancy re-samples). And a second same-signature model reuses the
+    cost-registry entries — zero extra AOT analyze calls."""
+    from cycloneml_tpu.observe import costs
+    d = 19
+    calls = []
+    real = costs.check_budget
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(costs, "check_budget", counting)
+    conf = (CycloneConf()
+            .set("cyclone.memory.budgetFraction", 0.5)
+            .set("cyclone.memory.deviceBytes", 1))
+    srv = ModelServer(ctx=None, conf=conf, max_batch=8, window_ms=2,
+                      shed_after_ms=60)
+    srv.register("a", _binary_lr(d, seed=1))
+    before = costs.analyze_call_count()
+    srv.register("b", _binary_lr(d, seed=2))   # same signature as "a"
+    assert costs.analyze_call_count() == before  # registry entries reused
+    with pytest.raises(ServingOverloaded):
+        srv.predict("a", rng.normal(size=(2, d)), timeout=30)
+    assert srv.stats()["models"]["a"]["requeues"] >= 1
+    assert len(calls) == 1  # one verdict for the one touched bucket
+    srv.stop()
+
+
+def test_try_cancel_fails_queued_sibling():
+    from cycloneml_tpu.serving.batcher import ModelLane
+    d = 20
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0)
+    srv.register("m", _binary_lr(d))
+    # a lane whose worker never starts: submissions stay queued, which is
+    # exactly the state predict()'s unwind path sees
+    lane = ModelLane("probe", srv._lane("m").servable, srv)
+    fut = lane.submit(np.zeros((2, d)))
+    assert lane.try_cancel(fut)
+    with pytest.raises(ServingOverloaded, match="shed as a unit"):
+        fut.result(timeout=1)
+    assert not lane.try_cancel(fut)  # already gone
+    # a requeue racing stop() fails the futures instead of stranding them
+    # in a dead lane (admission's _shed_or_requeue path)
+    from cycloneml_tpu.serving.batcher import _Request
+    req = _Request(np.zeros((1, d)))
+    lane._stop = True
+    lane._requeue_front([req])
+    with pytest.raises(ServingOverloaded, match="stopped"):
+        req.future.result(timeout=1)
+    srv.stop()
+
+
+def test_queue_full_backpressure_sheds_fast():
+    d = 26
+    from cycloneml_tpu.parallel.faults import FaultInjector, FaultSchedule
+    sched = FaultSchedule(seed=0)
+    # slow every dispatch so the queue can actually fill
+    sched.window("serving.dispatch", 1, 1000, delay_s=0.05)
+    srv = ModelServer(ctx=None, max_batch=1, window_ms=0, max_queue=2)
+    srv.register("m", _binary_lr(d))
+    outcomes = []
+
+    def fire():
+        try:
+            srv.predict("m", rng.normal(size=(1, d)))
+            outcomes.append("ok")
+        except ServingOverloaded:
+            outcomes.append("shed")
+
+    with FaultInjector(sched):
+        threads = [threading.Thread(target=fire) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert len(outcomes) == 12
+    assert "shed" in outcomes   # bounded queue pushed back
+    assert "ok" in outcomes     # while admitted requests kept serving
+    srv.stop()
+
+
+# -- gang serving ----------------------------------------------------------------
+
+def test_gang_serving_matches_serial_predict():
+    d, k = 27, 3
+    models = [_binary_lr(d, seed=s) for s in range(k)]
+    srv = ModelServer(ctx=None, max_batch=16, window_ms=2)
+    info = srv.register_gang("gang", models)
+    assert info["gang"] == k
+    x = rng.normal(size=(9, d))
+    preds = srv.predict("gang", x)
+    assert isinstance(preds, list) and len(preds) == k
+    for kk in range(k):
+        assert np.array_equal(preds[kk], models[kk]._predict_batch(x))
+    # one vmapped program: K models, ONE bucket set worth of compiles
+    assert srv.compile_counts()["gang"] == len(bucket_sizes(16))
+    srv.stop()
+
+
+def test_gang_requires_homogeneous_models():
+    from cycloneml_tpu.serving import GangServable
+    with pytest.raises(ValueError, match="homogeneous"):
+        GangServable([as_servable(_binary_lr(5)), as_servable(_binary_lr(6))])
+    with pytest.raises(TypeError, match="no servable adapter"):
+        as_servable(object())
+
+
+def test_duplicate_and_oversize_guards():
+    d = 18
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0)
+    srv.register("m", _binary_lr(d))
+    with pytest.raises(ValueError, match="already registered"):
+        srv.register("m", _binary_lr(d))
+    # a direct ModelLane.submit past maxBatch must fail, not wedge the
+    # lane (ModelServer.predict pre-splits; this guards other callers)
+    with pytest.raises(ValueError, match="exceeds maxBatch"):
+        srv._lane("m").submit(np.zeros((9, d)))
+    # the lane is still healthy afterwards
+    x = rng.normal(size=(3, d))
+    assert srv.predict("m", x).shape == (3,)
+    srv.stop()
+
+
+def test_stream_writer_custom_format_without_sink_rejected():
+    from cycloneml_tpu.sql.session import CycloneSession
+    from cycloneml_tpu.streaming.sources import MemoryStream
+    s = CycloneSession()
+    ms = MemoryStream(["f"])
+    with pytest.raises(ValueError, match="unknown sink format"):
+        ms.to_df(s).write_stream.format("custom").start()
+
+
+# -- bucket-padding parity -------------------------------------------------------
+
+def _bucketed_margins(lane, x, bucket, dtype):
+    xpad = pad_rows(np.asarray(x, dtype=dtype), bucket)
+    return np.asarray(lane.program(*lane._params, xpad))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_bucket_padding_parity_serial(dtype):
+    """A row's margins are BITWISE identical whatever bucket carries it
+    (n=1, n=bucket, n=bucket+1 all hit different programs), and the final
+    predictions match direct model.predict: exactly for the thresholded
+    labels, <= 1e-6 for the f32-tier scores against the float64 host
+    reference (f64-tier scores match to accumulator precision)."""
+    d = 28 if dtype == "float32" else 29
+    model = _binary_lr(d, seed=3)
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0, dtype=dtype)
+    srv.register("m", model)
+    lane = srv._lane("m")
+    B = 8
+    x = rng.normal(size=(B + 1, d))
+    # n=1 -> bucket 1, n=B -> bucket B (exact fit), n=B+1 -> split by the
+    # batcher; compare the shared rows across ALL bucket programs
+    m1 = _bucketed_margins(lane, x[:1], 1, dtype)
+    mB = _bucketed_margins(lane, x[:B], B, dtype)
+    m_pad = _bucketed_margins(lane, x[:3], B, dtype)[:3]  # padded dispatch
+    assert np.array_equal(m1[0], mB[0])
+    assert np.array_equal(mB[:3], m_pad)
+    # the served predictions agree with the direct host predict
+    preds = srv.predict("m", x)
+    assert np.array_equal(preds, model._predict_batch(x))
+    host = model._predict_batch(x)  # labels; margins below
+    host_margins = lane.servable.host_margins(x)
+    tol = 1e-6 if dtype == "float32" else 1e-12
+    mfull = np.concatenate(
+        [mB, _bucketed_margins(lane, x[B:], 1, dtype)])
+    assert np.max(np.abs(mfull - host_margins)) <= tol * max(
+        1.0, np.max(np.abs(host_margins)))
+    assert host.shape == preds.shape
+    srv.stop()
+
+
+def test_bucket_padding_parity_bf16_tier_fit():
+    """A model FIT under the bf16 data tier serves through the f32
+    serving kernel within 1e-6 of its own host predict — the data tier
+    narrows training storage, never serving numerics."""
+    d = 30
+    r = np.random.default_rng(5)
+    # coefficients as a bf16-tier fit would leave them: float64 master
+    # copies of values learned from bf16-stored data
+    import jax.numpy as jnp
+    coef = np.asarray(r.normal(size=(1, d)).astype(jnp.bfloat16),
+                      dtype=np.float64)
+    model = LogisticRegressionModel(coef, r.normal(size=(1,)), 2, False)
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0, dtype="float32")
+    srv.register("m", model)
+    lane = srv._lane("m")
+    x = r.normal(size=(9, d))
+    got = np.concatenate([
+        _bucketed_margins(lane, x[:8], 8, "float32")[:8],
+        _bucketed_margins(lane, x[8:], 1, "float32")])
+    host = lane.servable.host_margins(x)
+    assert np.max(np.abs(got - host)) <= 1e-6 * max(
+        1.0, np.max(np.abs(host)))
+    assert np.array_equal(srv.predict("m", x), model._predict_batch(x))
+    srv.stop()
+
+
+def test_bucket_padding_parity_stacked():
+    """Stacked (gang) margins: bitwise bucket-invariant per row AND
+    bitwise equal to the serial program's margins for every member."""
+    d, k = 31, 3
+    models = [_binary_lr(d, seed=10 + s) for s in range(k)]
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0, dtype="float32")
+    srv.register_gang("g", models)
+    for m_i, m in enumerate(models):
+        srv.register(f"s{m_i}", m)
+    glane = srv._lane("g")
+    x = rng.normal(size=(9, d)).astype("float32")
+    g1 = _bucketed_margins(glane, x[:1], 1, "float32")      # (k, 1, 1)
+    g8 = _bucketed_margins(glane, x[:8], 8, "float32")      # (k, 8, 1)
+    gpad = _bucketed_margins(glane, x[:3], 8, "float32")[:, :3, :]
+    assert np.array_equal(g1[:, 0], g8[:, 0])
+    assert np.array_equal(g8[:, :3], gpad)
+    for m_i in range(k):
+        slane = srv._lane(f"s{m_i}")
+        serial = _bucketed_margins(slane, x[:8], 8, "float32")
+        assert np.array_equal(g8[m_i], serial)
+    # end to end: gang predictions == per-model serial predictions
+    gp = srv.predict("g", x)
+    for m_i in range(k):
+        assert np.array_equal(gp[m_i], srv.predict(f"s{m_i}", x))
+    srv.stop()
+
+
+# -- servable coverage ------------------------------------------------------------
+
+def test_multinomial_and_regression_servables():
+    d, k = 13, 4
+    r = np.random.default_rng(11)
+    mn = LogisticRegressionModel(r.normal(size=(k, d)), r.normal(size=(k,)),
+                                 k, True)
+    reg = LinearRegressionModel(r.normal(size=(d,)), 0.25)
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0)
+    srv.register("mn", mn)
+    srv.register("reg", reg)
+    x = r.normal(size=(6, d))
+    assert np.array_equal(srv.predict("mn", x), mn._predict_batch(x))
+    assert np.allclose(srv.predict("reg", x), reg._predict_batch(x),
+                       rtol=0, atol=1e-9)
+    # single-row convenience + empty batch
+    assert srv.predict("reg", x[0]).shape == (1,)
+    assert srv.predict("reg", np.zeros((0, d))).shape == (0,)
+    with pytest.raises(ValueError, match="expects"):
+        srv.predict("reg", np.zeros((2, d + 1)))
+    with pytest.raises(KeyError, match="no model"):
+        srv.predict("nope", x)
+    srv.stop()
+
+
+# -- observability ----------------------------------------------------------------
+
+def test_request_spans_and_latency_metrics():
+    from cycloneml_tpu.util.metrics import MetricsRegistry
+    d = 32
+    tracer = tracing.enable()
+    try:
+        # private registry: under the full suite an active session context
+        # exists and ModelServer would otherwise share ITS registry, where
+        # earlier serving tests already fed serving.latency
+        srv = ModelServer(ctx=None, max_batch=8, window_ms=2,
+                          registry=MetricsRegistry())
+        srv.register("m", _binary_lr(d))
+        srv.predict("m", rng.normal(size=(3, d)))
+        spans = tracer.snapshot()
+        batch_spans = [s for s in spans
+                       if s.kind == "serving" and s.name == "m"]
+        req_spans = [s for s in spans
+                     if s.kind == "serving" and s.name == "request"]
+        assert batch_spans and req_spans
+        rs = req_spans[0]
+        assert rs.parent_id == batch_spans[0].span_id
+        assert rs.attrs["model"] == "m" and rs.attrs["rows"] == 3
+        assert rs.attrs["queue_s"] >= 0 and rs.attrs["dispatch_s"] > 0
+        assert rs.duration_s >= rs.attrs["dispatch_s"]
+        lat = srv.registry.timer("serving.latency").snapshot()
+        assert lat["count"] == 1 and lat["p99"] >= lat["p50"] > 0
+        srv.stop()
+    finally:
+        tracing.disable()
+
+
+def test_histogram_p99_and_prometheus_summary():
+    from cycloneml_tpu.util.metrics import (
+        MetricsRegistry, prometheus_text,
+    )
+    reg = MetricsRegistry()
+    t = reg.timer("serving.latency")
+    for i in range(100):
+        t.update(i / 1000.0)
+    snap = t.snapshot()
+    assert snap["p50"] <= snap["p95"] <= snap["p99"] <= snap["max"]
+    assert snap["p99"] == 0.098  # 99th of 0..99 ms
+    text = prometheus_text(reg.values(), types=reg.types())
+    assert 'cyclone_serving_latency{quantile="0.5"}' in text
+    assert 'cyclone_serving_latency{quantile="0.99"} 0.098' in text
+    # quantile components are consumed by the summary, not re-emitted flat
+    assert "cyclone_serving_latency_p99" not in text
+
+
+def test_serving_stats_reach_status_store(ctx):
+    d = 14
+    srv = ModelServer(ctx=ctx, max_batch=8, window_ms=2)
+    srv.register("store-m", _binary_lr(d))
+    srv.predict("store-m", rng.normal(size=(2, d)))
+    srv.stop()  # force-posts the final rollup
+    assert ctx.listener_bus.wait_until_empty(timeout=10)
+    from cycloneml_tpu.util.status import api_v1
+    stats = api_v1(ctx.status_store, "serving")
+    assert "store-m" in stats["models"]
+    m = stats["models"]["store-m"]
+    assert m["requests"] >= 1 and m["compiles"] >= 1
+    assert stats["totals"]["models"] >= 1
+    assert m["latencyMs"]["p99"] >= m["latencyMs"]["p50"] > 0
+
+
+# -- ingestion surfaces -----------------------------------------------------------
+
+def test_sql_server_scoring_endpoint():
+    from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+    from cycloneml_tpu.sql.session import CycloneSession
+    d = 15
+    model = _binary_lr(d, seed=21)
+    srv = ModelServer(ctx=None, max_batch=16, window_ms=2)
+    srv.register("lr", model)
+    session = CycloneSession()
+    session.register_temp_view("t", session.create_data_frame(
+        {"v": np.array([1.0, 2.0, 3.0])}))
+    sql = CycloneSQLServer(session, model_server=srv)
+    try:
+        with SQLClient(sql.address) as c:
+            x = rng.normal(size=(5, d))
+            preds = c.predict("lr", x.tolist())
+            assert preds == [float(v) for v in model._predict_batch(x)]
+            assert c.predict("lr", []) == []  # empty payload, empty result
+            # SQL and scoring share the connection and framing
+            cols, rows = c.execute("SELECT COUNT(*) AS n FROM t")
+            assert cols == ["n"] and rows == [[3]]
+            with pytest.raises(RuntimeError, match="no model"):
+                c.predict("nope", x.tolist())
+            # the connection survives a scoring error
+            assert c.predict("lr", x[:1].tolist())
+    finally:
+        sql.stop()
+        srv.stop()
+
+
+def test_sql_scoring_overload_maps_to_503():
+    from cycloneml_tpu.sql.server import CycloneSQLServer, SQLClient
+    from cycloneml_tpu.sql.session import CycloneSession
+    d = 16
+    conf = (CycloneConf()
+            .set("cyclone.memory.budgetFraction", 0.5)
+            .set("cyclone.memory.deviceBytes", 1))
+    srv = ModelServer(ctx=None, conf=conf, max_batch=8, window_ms=2,
+                      shed_after_ms=50)
+    srv.register("lr", _binary_lr(d))
+    sql = CycloneSQLServer(CycloneSession(), model_server=srv)
+    try:
+        with SQLClient(sql.address) as c:
+            with pytest.raises(ServingOverloaded):
+                c.predict("lr", rng.normal(size=(2, d)).tolist())
+    finally:
+        sql.stop()
+        srv.stop()
+
+
+def test_streaming_featurize_predict_sink_kafka():
+    """Kafka source -> cast featurize -> ScoringSink -> memory: one
+    streaming pipeline scoring through the same micro-batcher."""
+    from types import SimpleNamespace
+
+    from cycloneml_tpu.serving.streaming import ScoringSink
+    from cycloneml_tpu.sql.column import col
+    from cycloneml_tpu.sql.dataframe import DataFrame
+    from cycloneml_tpu.sql.session import CycloneSession
+    from cycloneml_tpu.streaming.kafka import KafkaSource
+    from cycloneml_tpu.streaming.sinks import MemorySink
+    from cycloneml_tpu.streaming.sources import StreamingScan
+
+    class FakeConsumer:
+        def __init__(self):
+            self._pending = []
+            self.committed = 0
+
+        def feed(self, *records):
+            self._pending.extend(records)
+
+        def poll(self, timeout_ms=0):
+            out, self._pending = {"tp0": list(self._pending)}, []
+            return out
+
+        def commit(self):
+            self.committed += 1
+
+    model = LinearRegressionModel(np.array([2.0]), 1.0)  # y = 2x + 1
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0)
+    srv.register("m", model)
+    consumer = FakeConsumer()
+    src = KafkaSource("t", consumer_factory=lambda: consumer)
+    s = CycloneSession()
+    df = DataFrame(StreamingScan(src, "kafka"), s)
+    inner = MemorySink()
+    sink = ScoringSink(srv, "m", ["f"], inner)
+    q = (df.select(col("value").cast("double").alias("f"))
+         .write_stream.sink_to(sink).start())
+    try:
+        consumer.feed(
+            SimpleNamespace(key=b"a", value=b"1.5", topic="t", partition=0,
+                            offset=0, timestamp=0),
+            SimpleNamespace(key=b"b", value=b"-2.0", topic="t", partition=0,
+                            offset=1, timestamp=0))
+        q.process_all_available()
+        batch = inner.to_batch()
+        assert sorted(batch) == ["f", "prediction"]
+        got = dict(zip(batch["f"], batch["prediction"]))
+        assert got[1.5] == pytest.approx(4.0, abs=1e-9)
+        assert got[-2.0] == pytest.approx(-3.0, abs=1e-9)
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_streaming_scoring_sink_gang_and_empty():
+    from cycloneml_tpu.serving.streaming import ScoringSink
+    from cycloneml_tpu.streaming.sinks import MemorySink
+    d, k = 17, 2
+    models = [_binary_lr(d, seed=30 + s) for s in range(k)]
+    srv = ModelServer(ctx=None, max_batch=8, window_ms=0)
+    srv.register_gang("g", models)
+    inner = MemorySink()
+    sink = ScoringSink(srv, "g", [f"f{i}" for i in range(d)], inner)
+    x = rng.normal(size=(3, d))
+    batch = {f"f{i}": x[:, i] for i in range(d)}
+    sink.add_batch(0, batch, "append")
+    sink.add_batch(1, {f"f{i}": np.array([]) for i in range(d)}, "append")
+    out = inner.to_batch()
+    for kk in range(k):
+        assert np.array_equal(out[f"prediction.{kk}"],
+                              models[kk]._predict_batch(x))
+    srv.stop()
