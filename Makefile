@@ -4,8 +4,9 @@
 .PHONY: lint lint-fast lint-json lint-sarif test chaos obs-demo bench \
 	bench-bytes serve-demo
 
-# the full interprocedural pass (JX001-JX010); fails on any finding not
-# grandfathered in baseline.json (which a PR may shrink, never grow)
+# the full interprocedural pass (JX001-JX014, concurrency rules
+# included); fails on any finding not grandfathered in baseline.json
+# (which a PR may shrink, never grow)
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
 	    --baseline cycloneml_tpu/analysis/baseline.json

@@ -85,6 +85,19 @@ def set_contains(s, item) -> bool:
     return s is TOP or item in s
 
 
+def meet_sets(a, b):
+    """Meet (intersection) of two powerset elements; TOP is the identity.
+
+    Must-analyses (JX011's locks-held-at-entry: a lock counts only when
+    EVERY call path holds it) iterate downward from TOP with this, where
+    the join-based facts iterate upward from EMPTY."""
+    if a is TOP:
+        return b
+    if b is TOP:
+        return a
+    return frozenset(a) & frozenset(b)
+
+
 def join_bools(a: bool, b: bool) -> bool:
     return bool(a) or bool(b)
 
@@ -288,9 +301,19 @@ def run_dataflow(graph: CallGraph, clients: Sequence["object"],
     ``analysis_id``, ``initial``, ``transfer``, ``top``). Each client's
     facts converge independently — summaries of one rule never feed
     another's transfer, which keeps per-rule precision reasoning local.
+
+    Propagation direction is per-client (``client.direction``):
+
+    * ``"up"`` (the default): a summary reads its CALLEES' facts, so a
+      change re-queues the function's callers — donated params, blocking
+      helpers, collective reachability all flow bottom-up.
+    * ``"down"``: the fact describes what the CALL CONTEXTS establish
+      (JX011's locks-held-at-entry), so the transfer reads the callers'
+      facts and a change re-queues the function's CALLEES.
     """
     result = DataflowResult(graph)
     for client in clients:
+        down = getattr(client, "direction", "up") == "down"
         facts: Dict[FunctionInfo, object] = {}
         for fn in graph.all_functions:
             facts[fn] = client.initial(fn, graph, ctx)
@@ -309,10 +332,15 @@ def run_dataflow(graph: CallGraph, clients: Sequence["object"],
                 if new == facts[fn]:
                     continue
             facts[fn] = new
-            for caller in graph.callers_of(fn):
-                if id(caller) not in queued:
-                    queued.add(id(caller))
-                    work.append(caller)
+            if down:
+                requeue = {t for site in graph.sites(fn)
+                           for t in site.targets}
+            else:
+                requeue = graph.callers_of(fn)
+            for nxt in requeue:
+                if id(nxt) not in queued:
+                    queued.add(id(nxt))
+                    work.append(nxt)
         result._summaries[client.analysis_id] = facts
     return result
 

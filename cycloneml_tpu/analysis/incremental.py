@@ -26,8 +26,23 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from cycloneml_tpu.analysis.engine import ModuleInfo, load_module
 
-CACHE_VERSION = 2   # bump when ModuleInfo/FunctionInfo shape changes
+CACHE_VERSION = 3   # bump when ModuleInfo/FunctionInfo shape changes
 DEFAULT_CACHE = ".graftlint-cache.pkl"
+
+
+def summary_schema() -> str:
+    """Fingerprint of the fact kinds the current rule pack derives from a
+    parsed module: every dataflow analysis id, sorted. Cached modules are
+    only parse artifacts — summaries are recomputed per run — but a
+    cache written by an OLDER analyzer may predate fields the NEWER
+    fact extraction reads off ``ModuleInfo``/``FunctionInfo`` (v3's
+    lockset/acquisition/obligation kinds); keying the cache on the
+    schema makes that impossible by construction instead of by audit."""
+    from cycloneml_tpu.analysis.rules import ALL_RULES
+    from cycloneml_tpu.analysis.rules.base import DataflowRule
+    ids = sorted(cls.rule_id for cls in ALL_RULES
+                 if issubclass(cls, DataflowRule))
+    return ",".join(ids)
 
 
 def git_toplevel(cwd: Optional[str] = None) -> Optional[str]:
@@ -108,7 +123,8 @@ class ParseCache:
         try:
             with open(self.path, "rb") as fh:
                 payload = pickle.load(fh)
-            if payload.get("version") == CACHE_VERSION:
+            if payload.get("version") == CACHE_VERSION \
+                    and payload.get("schema") == summary_schema():
                 self._entries = payload.get("modules", {})
         except (OSError, pickle.PickleError, EOFError, AttributeError,
                 KeyError, ValueError, ImportError):
@@ -124,6 +140,7 @@ class ParseCache:
             tmp = f"{self.path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as fh:
                 pickle.dump({"version": CACHE_VERSION,
+                             "schema": summary_schema(),
                              "modules": self._entries}, fh,
                             protocol=pickle.HIGHEST_PROTOCOL)
             os.replace(tmp, self.path)

@@ -1,0 +1,319 @@
+"""Shared lock modeling for the concurrency rules (JX011–JX014).
+
+Python threading code carries no annotations: a guard is just a ``with
+self._lock:`` block, and which lock guards which field is a convention in
+the author's head. This module recovers the convention syntactically, one
+place for every concurrency rule to share:
+
+* **Lock identity.** A lock is named by where it lives, abstracted over
+  instances (the RacerD move): ``with self._cv:`` inside a ``ModelLane``
+  method is the lock ``ModelLane._cv`` whatever instance holds it;
+  ``_lock = threading.Lock()`` at module level is ``<module>::_lock``.
+  Two instances of one class are conflated by design — the rules reason
+  about the locking *discipline* of the class, not a heap.
+* **Lock discovery.** A ``with`` block is a lock region when its context
+  expression is a plain name/attribute that either (a) was observed being
+  bound to a ``threading.Lock/RLock/Condition/Semaphore`` anywhere in the
+  analyzed set, or (b) has a lock-ish name (``*lock*``, ``_cv``, ``cond``,
+  ``mutex``). ``with tracer.span(...)`` and other call-shaped contexts are
+  never locks.
+* **Per-function regions.** :meth:`LockModel.info` walks a function once
+  and records, in source order: every ``self.<field>`` access with the
+  lockset held around it, every call with the lockset held around it, and
+  every lock-``with`` with its enclosing lockset (the *acquisition edge*
+  raw material). Cached per function — the dataflow fixpoint revisits
+  functions many times and must not re-walk ASTs.
+
+``Condition`` objects count as their underlying lock (``with self._cv:``
+acquires it); ``cv.wait()`` *releasing* the lock while blocked is modeled
+by the rules that care (JX014's wait-idiom exemption), not here.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from cycloneml_tpu.analysis.astutil import FunctionInfo, dotted_name
+
+#: threading factories whose result is a lockable (``with``-able) object
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+
+#: methods excluded from guard accounting: the object is under
+#: construction/destruction and unpublished — no other thread can race it
+#: (RacerD's ownership exclusion, in its cheapest form)
+OWNERSHIP_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+FROZEN_EMPTY = frozenset()
+
+_NESTED_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def lockish_name(name: str) -> bool:
+    """Does ``name`` look like a lock field by convention alone?"""
+    low = name.lower().lstrip("_")
+    return ("lock" in low or "mutex" in low
+            or low in ("cv", "cond", "mu", "sem")
+            or low.endswith("_cv") or low.endswith("cond"))
+
+
+@dataclass
+class SelfAccess:
+    """One ``self.<field>`` read or write inside a method, with the locks
+    held lexically around it (entry locks are the dataflow layer's
+    business — see JX011)."""
+
+    field: str
+    is_write: bool
+    node: ast.AST
+    fn: FunctionInfo
+    locks: frozenset
+
+
+@dataclass
+class LockWith:
+    """One lock acquisition: which lock, where, and what was already
+    held when it was taken (a non-empty ``held`` makes it a nested
+    acquisition — a lock-order edge). Covers both ``with lock:`` blocks
+    and bare ``lock.acquire()`` calls; for the latter the held-region is
+    unknown (no ``release()`` pairing is attempted) so only the
+    acquisition EDGE is modeled, never an extended lockset."""
+
+    lock: str
+    node: ast.AST            # the With statement / the acquire() Call
+    item_expr: ast.AST       # the context expression (for line anchoring)
+    held: frozenset          # locks held when this one was acquired
+    fn: FunctionInfo
+
+
+@dataclass
+class FnLocks:
+    """One function's lock-relevant facts, collected in a single walk."""
+
+    accesses: List[SelfAccess] = field(default_factory=list)
+    withs: List[LockWith] = field(default_factory=list)
+    #: id(Call node) -> locks held lexically around that call
+    call_locks: Dict[int, frozenset] = field(default_factory=dict)
+    #: every distinct lock this function acquires itself
+    acquired: frozenset = FROZEN_EMPTY
+
+
+class LockModel:
+    """Lazily built, per-analysis-run view of the file set's locks.
+
+    Construct one per rule instance per run (cheap); the expensive parts
+    (per-function walks, the global lock-field scan) are cached inside.
+    """
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._fn: Dict[FunctionInfo, FnLocks] = {}
+        self._fields: Optional[Dict[str, Dict[str, str]]] = None
+        self._module_locks: Optional[Dict[str, Dict[str, str]]] = None
+
+    # -- discovery -----------------------------------------------------------
+
+    def _discover(self) -> None:
+        """One pass over every module: ``self.<f> = threading.<Factory>()``
+        assignments (per class) and module-level lock bindings."""
+        fields: Dict[str, Dict[str, str]] = {}
+        mod_locks: Dict[str, Dict[str, str]] = {}
+        for path, mod in self.ctx.modules.items():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = _lock_factory_kind(node.value)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    tname = dotted_name(tgt)
+                    if tname is None:
+                        continue
+                    parts = tname.split(".")
+                    if parts[0] in ("self", "cls") and len(parts) == 2:
+                        cls = _enclosing_class_of(mod, node)
+                        if cls:
+                            fields.setdefault(cls, {})[parts[1]] = kind
+                    elif len(parts) == 1:
+                        mod_locks.setdefault(path, {})[parts[0]] = kind
+        self._fields = fields
+        self._module_locks = mod_locks
+
+    @property
+    def lock_fields(self) -> Dict[str, Dict[str, str]]:
+        if self._fields is None:
+            self._discover()
+        return self._fields
+
+    @property
+    def module_locks(self) -> Dict[str, Dict[str, str]]:
+        if self._module_locks is None:
+            self._discover()
+        return self._module_locks
+
+    def is_reentrant(self, lock_id: str) -> bool:
+        """RLock-backed locks may be re-acquired by the holding thread —
+        a self-edge on one is not a self-deadlock. Default-constructed
+        ``Condition()`` wraps an RLock, so it is reentrant too."""
+        cls_or_mod, _, tail = lock_id.partition("::")
+        if tail:   # module-level lock
+            kind = self.module_locks.get(cls_or_mod, {}).get(tail)
+        else:
+            cls, _, fld = lock_id.partition(".")
+            kind = self.lock_fields.get(cls, {}).get(fld)
+        return kind in ("RLock", "Condition")
+
+    # -- lock identity -------------------------------------------------------
+
+    def lock_id(self, expr: ast.AST, fn: FunctionInfo) -> Optional[str]:
+        """Canonical lock name for a with-context expression, or None when
+        the expression is not (recognizably) a lock."""
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and fn.class_name:
+            fields = self.lock_fields.get(fn.class_name, {})
+            fld = parts[1] if len(parts) == 2 else parts[-1]
+            if (len(parts) == 2 and parts[1] in fields) or lockish_name(fld):
+                return f"{fn.class_name}.{'.'.join(parts[1:])}"
+            return None
+        if len(parts) == 1:
+            known = self.module_locks.get(fn.module_path, {})
+            if parts[0] in known or lockish_name(parts[0]):
+                return f"{fn.module_path}::{parts[0]}"
+            return None
+        # foreign chain (s._lock where s is a local): keep it scoped to the
+        # observing class/module — a distinct node, never unified across
+        # classes (type inference is out of scope; call summaries unify
+        # the common acquire-via-method pattern instead)
+        if lockish_name(parts[-1]):
+            scope = fn.class_name or fn.module_path
+            return f"{scope}.{name}"
+        return None
+
+    # -- per-function walk ---------------------------------------------------
+
+    def info(self, fn: FunctionInfo) -> FnLocks:
+        got = self._fn.get(fn)
+        if got is not None:
+            return got
+        out = FnLocks()
+        acquired = set()
+        self._walk(getattr(fn.node, "body", []), FROZEN_EMPTY, fn, out,
+                   acquired)
+        out.acquired = frozenset(acquired)
+        self._fn[fn] = out
+        return out
+
+    def _walk(self, body, held: frozenset, fn: FunctionInfo,
+              out: FnLocks, acquired: set) -> None:
+        for stmt in body:
+            self._walk_node(stmt, held, fn, out, acquired)
+
+    def _walk_node(self, node: ast.AST, held: frozenset, fn: FunctionInfo,
+                   out: FnLocks, acquired: set) -> None:
+        if isinstance(node, _NESTED_DEFS):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                # the context expression evaluates under the OUTER lockset
+                self._walk_node(item.context_expr, inner, fn, out, acquired)
+                lid = self.lock_id(item.context_expr, fn)
+                if lid is not None:
+                    out.withs.append(LockWith(lid, node, item.context_expr,
+                                              inner, fn))
+                    acquired.add(lid)
+                    inner = inner | {lid}
+                if item.optional_vars is not None:
+                    self._walk_node(item.optional_vars, inner, fn, out,
+                                    acquired)
+            self._walk(node.body, inner, fn, out, acquired)
+            return
+        if isinstance(node, ast.Call):
+            out.call_locks[id(node)] = held
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                # bare `lock.acquire()` is an acquisition edge too —
+                # `with A: A.acquire()` is the guaranteed self-deadlock
+                # a with-only model would miss
+                lid = self.lock_id(node.func.value, fn)
+                if lid is not None:
+                    out.withs.append(LockWith(lid, node, node.func.value,
+                                              held, fn))
+                    acquired.add(lid)
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, (ast.Store, ast.Del))
+              and isinstance(node.value, ast.Attribute)
+              and isinstance(node.value.value, ast.Name)
+              and node.value.value.id == "self"):
+            # `self._data[k] = v` MUTATES the field: a write for guard
+            # inference, though the attribute itself is only loaded
+            out.accesses.append(SelfAccess(
+                node.value.attr, True, node.value, fn, held))
+            self._walk_node(node.slice, held, fn, out, acquired)
+            return
+        elif (isinstance(node, ast.Attribute)
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "self"):
+            out.accesses.append(SelfAccess(
+                node.attr, isinstance(node.ctx, (ast.Store, ast.Del)),
+                node, fn, held))
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(child, held, fn, out, acquired)
+
+
+def model_for(ctx) -> LockModel:
+    """The shared per-run LockModel, cached on the AnalysisContext: three
+    concurrency rules read the same lock regions — walking 170+ modules'
+    functions once per RULE would triple the lint's lock-analysis cost."""
+    model = getattr(ctx, "_lock_model", None)
+    if model is None or model.ctx is not ctx:
+        model = LockModel(ctx)
+        ctx._lock_model = model
+    return model
+
+
+def pretty_lock(lock_id: str) -> str:
+    """Human form of a lock id: `Class.field` stays as-is (the class
+    matters — it may not be the reader's), module locks render as
+    `file.py:name`."""
+    cls_or_mod, _, tail = lock_id.partition("::")
+    if tail:
+        return f"{cls_or_mod.rsplit('/', 1)[-1]}:{tail}"
+    return lock_id
+
+
+def _lock_factory_kind(value: ast.AST) -> Optional[str]:
+    """'Lock' / 'RLock' / 'Condition' / ... when ``value`` is a
+    ``threading.<Factory>()`` call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[-1] in LOCK_FACTORIES and (len(parts) == 1
+                                        or parts[0] in ("threading", "th")):
+        return parts[-1]
+    return None
+
+
+def _enclosing_class_of(mod, node: ast.AST) -> Optional[str]:
+    """The innermost class whose span contains ``node`` (line-range based:
+    cheap and good enough for lock-field discovery)."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return None
+    best: Optional[Tuple[int, str]] = None
+    for cand in ast.walk(mod.tree):
+        if not isinstance(cand, ast.ClassDef):
+            continue
+        c0, c1 = cand.lineno, getattr(cand, "end_lineno", cand.lineno)
+        if c0 <= line <= c1 and (best is None or c1 - c0 < best[0]):
+            best = (c1 - c0, cand.name)
+    return best[1] if best else None

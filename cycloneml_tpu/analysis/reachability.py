@@ -25,9 +25,12 @@ Propagation
 ``f -> g`` edges when ``f``'s body calls ``g`` resolved through (in
 order): the lexical scope chain (nested siblings / enclosing function
 locals), same-class methods via ``self.m()`` / ``cls.m()``, module-level
-functions, and explicit ``from mod import name`` imports across the
-analyzed file set. There is NO global match-any-same-name fallback —
-a false edge would spray host-only rules across driver code.
+functions, explicit ``from mod import name`` imports across the analyzed
+file set, and constructor-typed receivers — ``x.m()`` where ``x`` is a
+function local (or ``self.f.m()`` where ``f`` is an instance field)
+observed being bound to ``ClassName(...)`` for a class in the analyzed
+set resolves to ``ClassName.m``. There is NO global match-any-same-name
+fallback — a false edge would spray host-only rules across driver code.
 """
 
 from __future__ import annotations
@@ -230,6 +233,23 @@ class CallResolver:
                     children.setdefault(fn.parent.qualname, []).append(fn)
             self.children_by_module[path] = children
 
+        # class name -> {method simple name -> [FunctionInfo]} across the
+        # whole set (same-named classes in different modules merge; the
+        # resolver returns every candidate and lets rules join)
+        self.by_class: Dict[str, Dict[str, List[FunctionInfo]]] = {}
+        for path, mod in modules.items():
+            for fn in mod.functions:
+                if fn.class_name is not None and fn.parent is None:
+                    simple = fn.qualname.rsplit(".", 1)[-1]
+                    self.by_class.setdefault(fn.class_name, {}) \
+                        .setdefault(simple, []).append(fn)
+
+        # constructor-typed receivers, built lazily on first x.m() miss:
+        # per-class instance-field types (`self.f = ClassName(...)`) and
+        # per-function local types (`x = ClassName(...)`)
+        self._field_types: Optional[Dict[str, Dict[str, str]]] = None
+        self._local_types: Dict[int, Dict[str, str]] = {}
+
         # resolution is a pure function of the tables above, and both the
         # reachability worklist and CallGraph construction resolve the
         # same (caller, name) edges — memoize so the second pass is a
@@ -255,8 +275,14 @@ class CallResolver:
                 if child.qualname.rsplit(".", 1)[-1] == simple:
                     return [child]
             scope = scope.parent
-        # self.method() / cls.method()
-        if callee.startswith(("self.", "cls.")) and caller.class_name:
+        # self.method() / cls.method() — exactly two components: a deeper
+        # chain (`self._spans.clear()`) is a call on a FIELD, and
+        # resolving it by its last component would hand `list.clear` to
+        # `Tracer.clear` (false self-edges in every lock/reachability
+        # analysis); field chains resolve below, by constructor type
+        if callee.count(".") == 1 \
+                and callee.startswith(("self.", "cls.")) \
+                and caller.class_name:
             hit = self.by_module_class[caller.module_path].get(
                 f"{caller.class_name}.{simple}")
             if hit is not None:
@@ -279,7 +305,78 @@ class CallResolver:
                 hit = self.by_module_toplevel[tpath].get(target_fn)
                 if hit is not None:
                     return [hit]
+        # constructor-typed receiver: `x.m()` / `self.f.m()` where the
+        # receiver was observed bound to `ClassName(...)`
+        parts = callee.split(".")
+        cls: Optional[str] = None
+        if len(parts) == 2 and parts[0] not in ("self", "cls"):
+            cls = self._locals_of(caller).get(parts[0])
+        elif len(parts) == 3 and parts[0] in ("self", "cls") \
+                and caller.class_name:
+            cls = self._fields_of().get(caller.class_name, {}).get(parts[1])
+        if cls is not None:
+            return list(self.by_class.get(cls, {}).get(simple, []))
         return []
+
+    def _constructed_class(self, value: ast.AST) -> Optional[str]:
+        """ClassName when ``value`` is `ClassName(...)` (possibly dotted)
+        for a class defined in the analyzed set, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = last_component(call_name(value))
+        return name if name in self.by_class else None
+
+    def _locals_of(self, caller: FunctionInfo) -> Dict[str, str]:
+        got = self._local_types.get(id(caller))
+        if got is None:
+            got = {}
+            for stmt in iter_own_statements(caller.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                cls = self._constructed_class(stmt.value)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        if cls is None or (tgt.id in got
+                                           and got[tgt.id] != cls):
+                            got.pop(tgt.id, None)   # rebound/ambiguous
+                        else:
+                            got[tgt.id] = cls
+            self._local_types[id(caller)] = got
+        return got
+
+    def _fields_of(self) -> Dict[str, Dict[str, str]]:
+        if self._field_types is None:
+            types: Dict[str, Dict[str, str]] = {}
+            dropped: Set[Tuple[str, str]] = set()
+            for mod in self.modules.values():
+                for fn in mod.functions:
+                    if fn.class_name is None:
+                        continue
+                    for stmt in iter_own_statements(fn.node):
+                        if not isinstance(stmt, ast.Assign):
+                            continue
+                        cls = self._constructed_class(stmt.value)
+                        if cls is None:
+                            continue
+                        for tgt in stmt.targets:
+                            tn = dotted_name(tgt)
+                            if tn is None or not tn.startswith("self.") \
+                                    or tn.count(".") != 1:
+                                continue
+                            fld = tn.split(".", 1)[1]
+                            key = (fn.class_name, fld)
+                            fields = types.setdefault(fn.class_name, {})
+                            if key in dropped:
+                                continue
+                            if fields.get(fld, cls) != cls:
+                                # two constructors for one field:
+                                # ambiguous, resolve neither
+                                fields.pop(fld, None)
+                                dropped.add(key)
+                            else:
+                                fields[fld] = cls
+            self._field_types = types
+        return self._field_types
 
 
 def compute_reachability(modules: Dict[str, "object"],

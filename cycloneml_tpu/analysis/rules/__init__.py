@@ -24,11 +24,18 @@ from cycloneml_tpu.analysis.rules.jx009_use_after_donate import \
     UseAfterDonateRule
 from cycloneml_tpu.analysis.rules.jx010_collective_divergence import \
     CollectiveDivergenceRule
+from cycloneml_tpu.analysis.rules.jx011_lockset_race import LocksetRaceRule
+from cycloneml_tpu.analysis.rules.jx012_lock_order import LockOrderRule
+from cycloneml_tpu.analysis.rules.jx013_obligation_leak import \
+    ObligationLeakRule
+from cycloneml_tpu.analysis.rules.jx014_blocking_under_lock import \
+    BlockingUnderLockRule
 
 ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
              FP64DriftRule, CollectiveAxisRule, JitMutationRule,
              ThreadDispatchRule, RecompileHazardRule, UseAfterDonateRule,
-             CollectiveDivergenceRule)
+             CollectiveDivergenceRule, LocksetRaceRule, LockOrderRule,
+             ObligationLeakRule, BlockingUnderLockRule)
 
 
 def default_rules():

@@ -31,7 +31,7 @@ Only reachable-in-host-driver code is scanned: inside a traced region a
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterator, List, Optional, Set
 
 from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
                                             call_name, last_component)
@@ -43,6 +43,7 @@ from cycloneml_tpu.analysis.dataflow import (COMPREHENSION_NODES, EMPTY, TOP,
                                              set_contains)
 from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
 from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.walker import BlockWalker
 
 # aval-level metadata survives deletion: a donated jax.Array keeps its
 # shape/dtype/etc — only the BUFFER is gone, so these reads are legal
@@ -123,233 +124,114 @@ class UseAfterDonateRule(DataflowRule):
         sites = graph.sites_map(fn)
         facts = (ctx.dataflow.summaries(self.analysis_id)
                  if ctx.dataflow is not None else {})
-        findings: List[Finding] = []
-        dead: Dict[str, ast.Call] = {}   # name -> donation site
+        w = _DonationWalker(self, mod, fn, bindings, sites, facts)
+        w.walk(getattr(fn.node, "body", []))
+        yield from w.findings
 
-        def visit_expr(expr: ast.AST) -> None:
-            """In-order expression walk: reads checked against the dead
-            set; donation marks apply AFTER the donating call's own
-            argument evaluation (left-to-right, like the runtime)."""
-            if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
-                if expr.id in dead:
-                    don = dead[expr.id]
-                    findings.append(self.finding(
-                        mod, expr,
-                        f"`{expr.id}` is read after being donated to a jit "
-                        f"program at line {don.lineno} "
-                        f"(`donate_argnums`) — the buffer is deleted by "
-                        f"that dispatch; read before dispatching, or bind "
-                        f"a fresh value from the program's result",
-                        fn.qualname))
-                    dead.pop(expr.id, None)   # one finding per hazard
-                return
-            if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda, ast.ClassDef)):
-                return
-            if isinstance(expr, COMPREHENSION_NODES):
-                visit_comprehension(expr)
-                return
-            if isinstance(expr, ast.Attribute) \
-                    and expr.attr in METADATA_ATTRS \
-                    and isinstance(expr.value, ast.Name):
-                # x.shape / x.dtype after donation never touches the
-                # deleted buffer — telemetry reads stay legal
-                return
-            if isinstance(expr, ast.Call):
-                for child in ast.iter_child_nodes(expr):
-                    visit_expr(child)
-                for name in _donated_names(expr, bindings,
-                                           sites.get(id(expr)), facts):
-                    dead[name] = expr
-                return
+
+class _DonationWalker(BlockWalker):
+    """Source-order deadness scan on the shared terminator walker.
+    ``state`` maps name -> the donating Call that deleted its buffer."""
+
+    def __init__(self, rule: UseAfterDonateRule, mod: ModuleInfo,
+                 fn: FunctionInfo, bindings, sites, facts):
+        super().__init__()
+        self.rule, self.mod, self.fn = rule, mod, fn
+        self.bindings, self.sites, self.facts = bindings, sites, facts
+        self.findings: List[Finding] = []
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        """In-order expression walk: reads checked against the dead set;
+        donation marks apply AFTER the donating call's own argument
+        evaluation (left-to-right, like the runtime)."""
+        dead = self.state
+        if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
+            if expr.id in dead:
+                don = dead[expr.id]
+                self.findings.append(self.rule.finding(
+                    self.mod, expr,
+                    f"`{expr.id}` is read after being donated to a jit "
+                    f"program at line {don.lineno} "
+                    f"(`donate_argnums`) — the buffer is deleted by "
+                    f"that dispatch; read before dispatching, or bind "
+                    f"a fresh value from the program's result",
+                    self.fn.qualname))
+                dead.pop(expr.id, None)   # one finding per hazard
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(expr, COMPREHENSION_NODES):
+            self._visit_comprehension(expr)
+            return
+        if isinstance(expr, ast.Attribute) \
+                and expr.attr in METADATA_ATTRS \
+                and isinstance(expr.value, ast.Name):
+            # x.shape / x.dtype after donation never touches the
+            # deleted buffer — telemetry reads stay legal
+            return
+        if isinstance(expr, ast.Call):
             for child in ast.iter_child_nodes(expr):
-                visit_expr(child)
+                self.visit_expr(child)
+            for name in _donated_names(expr, self.bindings,
+                                       self.sites.get(id(expr)),
+                                       self.facts):
+                dead[name] = expr
+            return
+        for child in ast.iter_child_nodes(expr):
+            self.visit_expr(child)
 
-        def visit_comprehension(comp: ast.AST) -> None:
-            """A comprehension iterates: a donation in its body that is
-            not rebound per-iteration (comprehensions CANNOT rebind an
-            outer name) re-dispatches a deleted buffer on iteration two —
-            the spelled-out-loop hazard in its most idiomatic form."""
-            bound: Set[str] = set()
-            for i, gen in enumerate(comp.generators):
-                visit_expr(gen.iter)
-                bound.update(assigned_names(gen.target))
-            before = set(dead)
-            body = ([comp.key, comp.value]
-                    if isinstance(comp, ast.DictComp) else [comp.elt])
-            for gen in comp.generators:
-                body.extend(gen.ifs)
-            for part in body:
-                visit_expr(part)
-            for name, don in list(dead.items()):
-                if name in before or name in bound:
-                    continue
-                findings.append(self.finding(
-                    mod, don,
-                    f"`{name}` is donated inside this comprehension but "
-                    f"cannot be rebound from the program's result — the "
-                    f"next iteration dispatches a deleted buffer; use a "
-                    f"spelled-out loop with `{name} = prog({name}, ...)` "
-                    f"or lax.scan",
-                    fn.qualname))
+    def _visit_comprehension(self, comp: ast.AST) -> None:
+        """A comprehension iterates: a donation in its body that is not
+        rebound per-iteration (comprehensions CANNOT rebind an outer
+        name) re-dispatches a deleted buffer on iteration two — the
+        spelled-out-loop hazard in its most idiomatic form."""
+        dead = self.state
+        bound: Set[str] = set()
+        for gen in comp.generators:
+            self.visit_expr(gen.iter)
+            bound.update(assigned_names(gen.target))
+        before = set(dead)
+        body = ([comp.key, comp.value]
+                if isinstance(comp, ast.DictComp) else [comp.elt])
+        for gen in comp.generators:
+            body.extend(gen.ifs)
+        for part in body:
+            self.visit_expr(part)
+        for name, don in list(dead.items()):
+            if name in before or name in bound:
+                continue
+            self.findings.append(self.rule.finding(
+                self.mod, don,
+                f"`{name}` is donated inside this comprehension but "
+                f"cannot be rebound from the program's result — the "
+                f"next iteration dispatches a deleted buffer; use a "
+                f"spelled-out loop with `{name} = prog({name}, ...)` "
+                f"or lax.scan",
+                self.fn.qualname))
+            dead.pop(name, None)
+
+    def on_loop_body_end(self, stmt: ast.AST, term, entered_with) -> None:
+        # a name donated INSIDE the loop and still dead at the end of the
+        # body is re-read by the donating dispatch on the next iteration —
+        # unless every body path leaves the loop (return/raise/break):
+        # then no second iteration exists ("continue" paths DO re-iterate
+        # and stay checked)
+        dead = self.state
+        for name, don in ([] if term in ("exit", "break")
+                          else list(dead.items())):
+            if name in entered_with:
+                continue
+            if don.lineno >= stmt.lineno:
+                self.findings.append(self.rule.finding(
+                    self.mod, don,
+                    f"`{name}` is donated inside this loop but "
+                    f"never rebound from the program's result — "
+                    f"the next iteration dispatches a deleted "
+                    f"buffer; use `{name} = prog({name}, ...)` "
+                    f"so the donation consumes a dead value",
+                    self.fn.qualname))
                 dead.pop(name, None)
-
-        def bind(target: ast.AST) -> None:
-            for n in assigned_names(target):
-                dead.pop(n, None)
-
-        def run_block(body) -> Optional[str]:
-            """Process statements in order. Returns how the block
-            terminates: ``"exit"`` (return/raise — control leaves the
-            function, so post-loop code never sees this path),
-            ``"break"`` (leaves the loop but FALLS INTO post-loop code),
-            ``"loop"`` (continue — the next iteration still runs), or
-            None (falls through). Terminated branches don't merge their
-            deadness back."""
-            terminated: Optional[str] = None
-            for stmt in body:
-                if terminated:
-                    break
-                terminated = run_stmt(stmt)
-            return terminated
-
-        def run_stmt(stmt: ast.AST) -> Optional[str]:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                return False
-            if isinstance(stmt, ast.Assign):
-                visit_expr(stmt.value)
-                for t in stmt.targets:
-                    bind(t)
-                return False
-            if isinstance(stmt, ast.AnnAssign):
-                if stmt.value is not None:
-                    visit_expr(stmt.value)
-                bind(stmt.target)
-                return False
-            if isinstance(stmt, ast.AugAssign):
-                visit_expr(stmt.value)
-                # `x += v` READS x before rebinding it
-                name = _aug_name(stmt)
-                if name is not None:
-                    read = ast.copy_location(
-                        ast.Name(id=name, ctx=ast.Load()), stmt.target)
-                    visit_expr(read)
-                bind(stmt.target)
-                return False
-            if isinstance(stmt, (ast.Expr, ast.Return, ast.Yield)):
-                value = getattr(stmt, "value", None)
-                if value is not None:
-                    visit_expr(value)
-                return "exit" if isinstance(stmt, ast.Return) else None
-            if isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
-                if isinstance(stmt, ast.Raise) and stmt.exc is not None:
-                    visit_expr(stmt.exc)
-                # continue still reaches the NEXT iteration; return/raise/
-                # break leave the loop — and break (unlike return/raise)
-                # carries its deadness into the post-loop code
-                if isinstance(stmt, ast.Continue):
-                    return "loop"
-                return "break" if isinstance(stmt, ast.Break) else "exit"
-            if isinstance(stmt, ast.If):
-                visit_expr(stmt.test)
-                before = dict(dead)
-                t_body = run_block(stmt.body)
-                after_body = dict(dead)
-                dead.clear()
-                dead.update(before)
-                t_else = run_block(stmt.orelse)
-                after_else = dict(dead)
-                # may-dead merge; a branch that terminated (return/raise/
-                # break/continue) contributes nothing to the fall-through
-                dead.clear()
-                if not t_body:
-                    dead.update(after_body)
-                if not t_else:
-                    dead.update(after_else)
-                if t_body and t_else:
-                    # weakest terminator wins: a "loop" path means the
-                    # next iteration is still reachable; a "break" path
-                    # means post-loop code is
-                    for kind in ("loop", "break", "exit"):
-                        if kind in (t_body, t_else):
-                            return kind
-                return None
-            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
-                if isinstance(stmt, (ast.For, ast.AsyncFor)):
-                    visit_expr(stmt.iter)
-                    bind(stmt.target)
-                else:
-                    visit_expr(stmt.test)
-                before_loop = dict(dead)
-                donated_before = set(dead)
-                term = run_block(stmt.body)
-                # a name donated INSIDE the loop and still dead at the end
-                # of the body is re-read by the donating dispatch on the
-                # next iteration — unless every body path leaves the loop
-                # (return/raise/break): then no second iteration exists
-                # ("continue" paths DO re-iterate and stay checked)
-                for name, don in ([] if term in ("exit", "break")
-                                  else list(dead.items())):
-                    if name in donated_before:
-                        continue
-                    if don.lineno >= stmt.lineno:
-                        findings.append(self.finding(
-                            mod, don,
-                            f"`{name}` is donated inside this loop but "
-                            f"never rebound from the program's result — "
-                            f"the next iteration dispatches a deleted "
-                            f"buffer; use `{name} = prog({name}, ...)` "
-                            f"so the donation consumes a dead value",
-                            fn.qualname))
-                        dead.pop(name, None)
-                if term == "exit":
-                    # every body path returns/raises: post-loop code is
-                    # only reachable via the zero-iteration path, where
-                    # none of the body's donations happened ("break"
-                    # paths DO fall into post-loop code and keep theirs)
-                    dead.clear()
-                    dead.update(before_loop)
-                run_block(stmt.orelse)
-                return False
-            if isinstance(stmt, ast.With):
-                for item in stmt.items:
-                    visit_expr(item.context_expr)
-                    if item.optional_vars is not None:
-                        bind(item.optional_vars)
-                # `with` neither catches nor redirects control flow — a
-                # return inside the span idiom still terminates the loop
-                return run_block(stmt.body)
-            if isinstance(stmt, ast.Try):
-                t_body = run_block(stmt.body)
-                handler_terms = [run_block(h.body) for h in stmt.handlers]
-                t_orelse = run_block(stmt.orelse)
-                t_final = run_block(stmt.finalbody)
-                if t_final:
-                    return t_final
-                # no-exception path terminates via body or orelse; each
-                # caught-exception path via its handler — the try
-                # terminates only when EVERY path does (weakest kind wins)
-                terms = [t_body or t_orelse] + handler_terms
-                if all(terms):
-                    for kind in ("loop", "break", "exit"):
-                        if kind in terms:
-                            return kind
-                return False
-            if isinstance(stmt, ast.Delete):
-                for t in stmt.targets:
-                    bind(t)
-                return False
-            for child in ast.iter_child_nodes(stmt):
-                visit_expr(child)
-            return False
-
-        run_block(getattr(fn.node, "body", []))
-        yield from findings
-
-
-def _aug_name(stmt: ast.AugAssign) -> Optional[str]:
-    return stmt.target.id if isinstance(stmt.target, ast.Name) else None
 
 
 def _donated_names(call: ast.Call, bindings: Dict[str, JitParams],

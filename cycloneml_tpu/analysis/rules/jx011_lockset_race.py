@@ -1,0 +1,174 @@
+"""JX011 — field read/written outside its inferred guarding lock.
+
+Python locks are annotation-free: nothing in the source says which lock
+guards ``self._count``. The rule recovers the discipline two ways at once
+(RacerD's lockset summaries + Engler's "bugs as deviant behavior"
+inference): for each class, every ``self.<field>`` access is paired with
+the lockset held around it — lexically (``with self._lock:`` blocks) and
+interprocedurally (a helper only ever called with the lock held inherits
+*locks-held-at-entry* through the call graph, a must-analysis iterated
+downward over callers) — and each field's guard is inferred from the
+**majority** of its accesses. An access with an empty lockset where the
+majority holds the inferred guard is a deviant: a data race window.
+
+Writes are the severe case (lost updates, torn multi-field invariants);
+unguarded reads still flag (a reader can observe a half-updated pair like
+``_sum``/``_count``) with read severity in the message.
+
+What stays silent, by design:
+
+* fields with no write outside ``__init__``-style ownership methods
+  (publish-then-read-only is safe without locks);
+* classes whose accesses never hold a lock (single-threaded by
+  convention — inferring a guard needs evidence one exists);
+* the double-checked fast path: an unguarded *read* in a function that
+  ALSO accesses the same field under the inferred guard (the re-check
+  idiom: cheap racy peek, then decide under the lock);
+* lock fields themselves, and accesses whose effective lockset is
+  non-empty but merely different (a field consistently guarded by two
+  locks in different phases is a design smell, not this rule's race).
+
+Suppress a deliberate racy read (e.g. a monotonic stats peek) with
+``# graftlint: disable=JX011`` and a comment saying why it is benign.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterator, List, Tuple
+
+from cycloneml_tpu.analysis.astutil import FunctionInfo
+from cycloneml_tpu.analysis.dataflow import EMPTY, TOP, meet_sets
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.locks import (OWNERSHIP_METHODS, SelfAccess,
+                                          lockish_name, model_for,
+                                          pretty_lock)
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+
+
+def _method_name(fn: FunctionInfo) -> str:
+    return fn.qualname.rsplit(".", 1)[-1]
+
+
+class LocksetRaceRule(DataflowRule):
+    rule_id = "JX011"
+    #: the fact is what CALL CONTEXTS establish — propagate caller->callee
+    direction = "down"
+
+    # -- summary: locks guaranteed held at entry (must-analysis) -------------
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        # greatest fixpoint: start optimistic (TOP = "all locks") and meet
+        # downward over call contexts; a function with no resolved callers
+        # is an entry point — nothing is guaranteed held
+        return TOP if graph.callers_of(fn) else EMPTY
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        callers = graph.callers_of(fn)
+        if not callers:
+            return EMPTY
+        model = model_for(ctx)
+        entry = TOP
+        for caller in callers:
+            if _method_name(caller) in OWNERSHIP_METHODS:
+                # a call from __init__ runs pre-publication — that
+                # context is single-threaded and must not weaken the
+                # meet (`_load_state` called bare from __init__ AND
+                # under the lock from the elector thread is guarded
+                # where it matters)
+                continue
+            caller_entry = facts.get(caller, EMPTY)
+            info = model.info(caller)
+            for site in graph.sites(caller):
+                if fn not in site.targets:
+                    continue
+                held = info.call_locks.get(id(site.node), EMPTY)
+                if caller_entry is TOP:
+                    contrib = TOP
+                else:
+                    contrib = frozenset(caller_entry) | held
+                entry = meet_sets(entry, contrib)
+                if entry is not TOP and not entry:
+                    return EMPTY    # already bottom — stop early
+        # every caller is an ownership context: the accesses are owned
+        # (TOP = "treat as guarded"), not racy
+        return entry
+
+    def top(self, fn, graph, ctx):
+        # widening for a must-analysis degrades to "assume guarded":
+        # silence over noise when the fixpoint budget blows
+        return TOP
+
+    # -- the check: per-class guard inference --------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        model = model_for(ctx)
+        entry_of = (ctx.dataflow.summaries(self.analysis_id)
+                    if ctx.dataflow is not None else {})
+
+        by_class: Dict[str, List[FunctionInfo]] = defaultdict(list)
+        for fn in mod.functions:
+            if fn.class_name is not None and fn.parent is None:
+                by_class[fn.class_name].append(fn)
+
+        for cls, methods in by_class.items():
+            lock_fields = model.lock_fields.get(cls, {})
+            # field -> [(access, effective lockset | TOP)]
+            records: Dict[str, List[Tuple[SelfAccess, object]]] = \
+                defaultdict(list)
+            for fn in methods:
+                if _method_name(fn) in OWNERSHIP_METHODS:
+                    continue
+                entry = entry_of.get(fn, EMPTY)
+                for acc in model.info(fn).accesses:
+                    if acc.field in lock_fields or lockish_name(acc.field):
+                        continue
+                    if entry is TOP:
+                        eff = TOP
+                    else:
+                        eff = acc.locks | frozenset(entry)
+                    records[acc.field].append((acc, eff))
+            for field, recs in records.items():
+                yield from self._check_field(mod, cls, field, recs)
+
+    def _check_field(self, mod: ModuleInfo, cls: str, field: str,
+                     recs) -> Iterator[Finding]:
+        if not any(acc.is_write for acc, _ in recs):
+            return
+        # candidate guards: every concrete lock seen on any access
+        candidates = set()
+        for _, eff in recs:
+            if eff is not TOP:
+                candidates.update(eff)
+        if not candidates:
+            return
+        guard, guarded = None, -1
+        for lock in sorted(candidates):
+            n = sum(1 for _, eff in recs
+                    if eff is TOP or lock in eff)
+            if n > guarded:
+                guard, guarded = lock, n
+        unguarded = len(recs) - guarded
+        # the majority must hold the guard — deviants are the minority
+        if guarded < max(unguarded, 1):
+            return
+        # functions that touch the field under the guard (for the
+        # double-checked-read exemption)
+        checked_fns = {acc.fn for acc, eff in recs
+                       if eff is TOP or guard in eff}
+        for acc, eff in recs:
+            if eff is TOP or eff:
+                continue          # guarded, or held under SOME lock
+            if not acc.is_write and acc.fn in checked_fns:
+                continue          # double-checked fast path
+            kind = "write" if acc.is_write else "read"
+            severity = ("lost updates / torn invariants"
+                        if acc.is_write
+                        else "can observe a half-updated state")
+            yield self.finding(
+                mod, acc.node,
+                f"unguarded {kind} of `self.{field}`: {guarded} of "
+                f"{len(recs)} accesses of `{cls}.{field}` hold "
+                f"`{pretty_lock(guard)}`, this one holds no lock — "
+                f"{severity}; take the lock here (or suppress with a "
+                f"comment saying why this racy {kind} is benign)",
+                acc.fn.qualname)

@@ -1,0 +1,251 @@
+"""JX012 — lock-order inversion: cyclic acquisition = potential deadlock.
+
+Deadlock needs four conditions; the only one a codebase can engineer away
+statically is *circular wait*: if every thread acquires locks in one
+global order, no cycle of "holds A, wants B" can close. The rule builds
+that order's witness — a **lock acquisition graph** with an edge A → B
+for every place B is acquired while A is held:
+
+* lexically: ``with self._lock:`` containing ``with self._cv:``;
+* interprocedurally: a call under ``with A:`` whose (transitively
+  resolved) callee acquires B — the callee's *acquired-locks* summary is
+  a bottom-up dataflow fact, so a lock taken three helpers deep still
+  draws the edge at the outermost call site.
+
+Locks are named by where they live, abstracted over instances
+(``ModelLane._cv``, ``module.py::_round_lock``) — the rule checks the
+class-level *discipline*, not a heap. Any cycle in the graph is reported
+at every participating acquisition site; a same-lock self-edge on a
+non-reentrant lock (plain ``threading.Lock``) is the degenerate cycle —
+self-deadlock on re-entry. ``RLock`` and default-constructed
+``Condition`` (RLock-backed) self-edges are exempt.
+
+The clean idioms stay silent: a consistent global order draws an acyclic
+graph; the snapshot-then-call pattern (copy shared state under the lock,
+*release*, then call into another lock's owner) draws no edge at all —
+that is exactly why it is the recommended fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from cycloneml_tpu.analysis.astutil import FunctionInfo
+from cycloneml_tpu.analysis.dataflow import EMPTY, TOP, join_sets
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.locks import model_for, pretty_lock
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "node", "fn", "mod_path", "via")
+
+    def __init__(self, src: str, dst: str, node: ast.AST,
+                 fn: FunctionInfo, via: Optional[str] = None):
+        self.src, self.dst = src, dst
+        self.node, self.fn = node, fn
+        self.mod_path = fn.module_path
+        self.via = via      # callee qualname when the edge is a call edge
+
+
+class LockOrderRule(DataflowRule):
+    rule_id = "JX012"
+
+    def __init__(self):
+        self._edges: Optional[List[_Edge]] = None
+        self._cyclic: Dict[Tuple[str, str], str] = {}
+
+    # -- summary: locks this function (transitively) acquires ----------------
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        return model_for(ctx).info(fn).acquired
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        out = model_for(ctx).info(fn).acquired
+        for site in graph.sites(fn):
+            for target in site.targets:
+                got = facts.get(target, EMPTY)
+                if got is TOP:
+                    continue    # widened: degrade to no-edge, not all-edge
+                out = join_sets(out, got)
+                if out is TOP:
+                    return TOP
+        return out
+
+    # -- the check: build the graph once, report cyclic edges per module -----
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        if self._edges is None:
+            self._build(ctx)
+        for edge in self._edges:
+            if edge.mod_path != mod.path:
+                continue
+            cycle = self._cyclic.get((edge.src, edge.dst))
+            if cycle is None:
+                continue
+            how = (f"via `{edge.via}`, which acquires it transitively"
+                   if edge.via else "nested acquisition")
+            if edge.src == edge.dst:
+                yield self.finding(
+                    mod, edge.node,
+                    f"`{_pretty(edge.src)}` is re-acquired while already "
+                    f"held ({how}) — it is not reentrant "
+                    f"(`threading.Lock`): the thread deadlocks on itself; "
+                    f"use an RLock only if the recursion is intended, "
+                    f"otherwise restructure so the inner path does not "
+                    f"re-take the lock",
+                    edge.fn.qualname)
+            else:
+                yield self.finding(
+                    mod, edge.node,
+                    f"lock-order inversion: `{_pretty(edge.dst)}` is "
+                    f"acquired while holding `{_pretty(edge.src)}` "
+                    f"({how}), but the reverse order also exists — "
+                    f"cycle {cycle}; two threads taking the two paths "
+                    f"concurrently deadlock. Pick one global order, or "
+                    f"snapshot under one lock, release, then call",
+                    edge.fn.qualname)
+
+    def _build(self, ctx: AnalysisContext) -> None:
+        model = model_for(ctx)
+        graph = ctx.callgraph
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        edges: List[_Edge] = []
+        seen = set()   # (src, dst, fn, line) dedup
+
+        def add(src: str, dst: str, node: ast.AST, fn: FunctionInfo,
+                via: Optional[str] = None) -> None:
+            if src == dst and model.is_reentrant(src):
+                return
+            key = (src, dst, id(fn), getattr(node, "lineno", 0))
+            if key in seen:
+                return
+            seen.add(key)
+            edges.append(_Edge(src, dst, node, fn, via))
+
+        if graph is not None:
+            for fn in graph.all_functions:
+                info = model.info(fn)
+                for lw in info.withs:
+                    for held in lw.held:
+                        add(held, lw.lock, lw.node, fn)
+                sites = graph.sites_map(fn)
+                for call_id, held in info.call_locks.items():
+                    if not held:
+                        continue
+                    site = sites.get(call_id)
+                    if site is None:
+                        continue
+                    for target in site.targets:
+                        got = facts.get(target, EMPTY)
+                        if got is TOP or not got:
+                            continue
+                        for dst in got:
+                            for src in held:
+                                add(src, dst, site.node, fn,
+                                    via=target.qualname)
+        self._edges = edges
+        self._cyclic = _cyclic_edges(edges)
+
+
+def _cyclic_edges(edges: List[_Edge]) -> Dict[Tuple[str, str], str]:
+    """(src, dst) pairs that sit inside a cycle of the acquisition graph,
+    mapped to a printable representative cycle. Tarjan SCCs: an edge is
+    cyclic iff both endpoints share an SCC (self-loops trivially so)."""
+    adj: Dict[str, set] = defaultdict(set)
+    for e in edges:
+        adj[e.src].add(e.dst)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    comp: Dict[str, int] = {}
+    stack: List[str] = []
+    on_stack: set = set()
+    counter = [0]
+    comp_id = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                cid = comp_id[0]
+                comp_id[0] += 1
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp[w] = cid
+                    if w == v:
+                        break
+
+    nodes = set(adj) | {e.dst for e in edges}
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+
+    members: Dict[int, List[str]] = defaultdict(list)
+    for n, cid in comp.items():
+        members[cid].append(n)
+
+    out: Dict[Tuple[str, str], str] = {}
+    for e in edges:
+        if e.src == e.dst:
+            out[(e.src, e.dst)] = f"{_pretty(e.src)} → {_pretty(e.src)}"
+            continue
+        if comp.get(e.src) != comp.get(e.dst):
+            continue
+        if len(members[comp[e.src]]) < 2:
+            continue
+        cyc = _find_cycle(adj, e.src, e.dst)
+        out[(e.src, e.dst)] = cyc
+    return out
+
+
+def _find_cycle(adj: Dict[str, set], src: str, dst: str) -> str:
+    """A printable representative cycle through edge src→dst: BFS a path
+    dst ⇝ src, then close it."""
+    from collections import deque
+    prev: Dict[str, Optional[str]] = {dst: None}
+    q = deque([dst])
+    while q:
+        v = q.popleft()
+        if v == src:
+            break
+        for w in sorted(adj.get(v, ())):
+            if w not in prev:
+                prev[w] = v
+                q.append(w)
+    if src not in prev:
+        return f"{_pretty(src)} → {_pretty(dst)} → … → {_pretty(src)}"
+    path = [src]
+    while prev[path[-1]] is not None:
+        path.append(prev[path[-1]])
+    path.reverse()                      # dst ... src
+    names = [_pretty(n) for n in [src] + path]
+    return " → ".join(names)
+
+
+_pretty = pretty_lock

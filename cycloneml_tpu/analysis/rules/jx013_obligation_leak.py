@@ -1,0 +1,321 @@
+"""JX013 — request/future obligation leaked on a path to function exit.
+
+The serving batcher's whole no-hang contract is one sentence: *every
+request popped from a lane queue completes its future* — ``set_result``,
+``set_exception``, or a requeue — on **every** path, including the error
+paths. PR-8's reviews hand-fixed exactly this bug four times (requeue
+racing ``stop()``, mid-split backpressure, post-stop slip-ins, permanent
+dispatch failures). This rule proves the discipline statically, as a
+typestate obligation: a value popped from a lane/queue acquires an
+obligation that must be *discharged* before the function exits.
+
+Obligation sources: ``x = <queue>.popleft() / .get() / .get_nowait() /
+.pop()`` where the receiver is queue-shaped by name (``*queue*``, ``q``,
+``lane``, ``pending``, ``inbox``). Discharges:
+
+* completing: ``x.set_result(...)``, ``x.set_exception(...)``,
+  ``x.cancel()`` — on ``x`` or anything reached through it
+  (``x.future.set_exception(e)``);
+* requeueing/handing off: ``x`` passed bare to an ``append`` /
+  ``appendleft`` / ``put`` / ``submit`` / ``push``-shaped call;
+* escaping: ``x`` returned, yielded, re-assigned, or stored into a
+  container/attribute (someone else now holds it);
+* interprocedural: ``x`` passed bare to a resolved callee whose
+  bottom-up summary says that parameter position is discharged
+  (``self._fail_batch(batch, err)``); an *unresolvable* call discharges
+  conservatively — silence over noise.
+
+The walk reuses the shared terminator machinery (branch may-merges,
+loop/with/try semantics — :mod:`..walker`): a pending obligation at a
+``return``, an uncaught ``raise``, or the end of the body is reported at
+the **pop site**, naming the leaking exit. A ``raise`` under a ``try``
+with handlers or a ``finally`` is not reported — the handler may still
+complete the future (and usually does; that is the idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, last_component)
+from cycloneml_tpu.analysis.dataflow import (EMPTY, TOP, join_sets,
+                                             param_index, set_contains)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.walker import BlockWalker
+
+#: pop-shaped methods that transfer ownership of a queued request
+SOURCE_METHODS = {"popleft", "pop", "get", "get_nowait"}
+
+#: receiver names that make a pop an obligation source (NOT "work"/"jobs":
+#: worklist-pattern deques are pervasive and carry no futures)
+def _queueish(receiver: Optional[str]) -> bool:
+    if not receiver:
+        return False
+    last = receiver.rsplit(".", 1)[-1].lower().lstrip("_")
+    return ("queue" in last or "inbox" in last or "backlog" in last
+            or last in ("q", "lane", "pending", "inflight"))
+
+#: completion methods on the obligated value (or through its attributes)
+DISCHARGE_METHODS = {"set_result", "set_exception", "cancel"}
+
+#: call names that take ownership when the value is passed bare
+HANDOFF_WORDS = ("append", "appendleft", "put", "push", "submit", "enqueue",
+                 "requeue", "add", "extend", "insert", "send", "emit",
+                 "complete", "fail", "cancel", "resolve", "publish")
+
+
+class ObligationLeakRule(DataflowRule):
+    rule_id = "JX013"
+
+    # -- summary: which of MY param positions do I discharge? ----------------
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        params = param_index(fn)
+        if not params:
+            return EMPTY
+        discharged = _own_discharged_names(fn, graph)
+        return frozenset(params[n] for n in discharged if n in params)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        out = facts.get(fn, EMPTY)
+        if out is TOP:
+            return TOP
+        params = param_index(fn)
+        if not params:
+            return out
+        add = set()
+        for site in graph.sites(fn):
+            for target in site.targets:
+                summary = facts.get(target)
+                if not summary or summary is TOP:
+                    continue
+                for pi, expr in site.param_map(target):
+                    if set_contains(summary, pi) \
+                            and isinstance(expr, ast.Name) \
+                            and expr.id in params:
+                        add.add(params[expr.id])
+        return join_sets(out, frozenset(add))
+
+    # -- the check -----------------------------------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        if not _module_completes_futures(mod):
+            # evidence gate (bugs-as-deviant-behavior): a queue is only a
+            # REQUEST queue if this module somewhere completes futures —
+            # worklist deques and event pumps never do, and obligating
+            # them would be pure noise
+            return
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            if fn.jit_reachable:
+                continue
+            w = _ObligationWalker(self, mod, fn, graph.sites_map(fn), facts)
+            w.walk(getattr(fn.node, "body", []))
+            yield from w.findings
+
+
+class _ObligationWalker(BlockWalker):
+    """``state`` maps name -> the pop Call that created its obligation."""
+
+    def __init__(self, rule: ObligationLeakRule, mod: ModuleInfo,
+                 fn: FunctionInfo, sites, facts):
+        super().__init__()
+        self.rule, self.mod, self.fn = rule, mod, fn
+        self.sites, self.facts = sites, facts
+        self.findings: List[Finding] = []
+        self._reported: Set[int] = set()
+
+    # -- sources -------------------------------------------------------------
+    def run_stmt(self, stmt: ast.AST):
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            if _is_source(value):
+                for t in stmt.targets:
+                    self.bind(t)
+                names = [n for t in stmt.targets
+                         for n in assigned_names(t)]
+                if len(names) == 1:
+                    self.state[names[0]] = value
+                return None
+            # escaping/aliasing assignment discharges bare mentions:
+            # someone else holds the value now
+            self.visit_expr(value)
+            for name in _bare_names(value):
+                self.state.pop(name, None)
+            for t in stmt.targets:
+                self.bind(t)
+            return None
+        if isinstance(stmt, ast.Return):
+            # returning the value escapes it to the caller — discharge
+            # BEFORE the exit check (the base walker only visits)
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+                for name in _bare_names(stmt.value):
+                    self.state.pop(name, None)
+            # a clean return runs no except handler — only an enclosing
+            # `finally` (which may discharge) protects it
+            if not self._return_protected():
+                self.on_exit(stmt, "return")
+            return "exit"
+        return super().run_stmt(stmt)
+
+    # -- expression scan: discharges -----------------------------------------
+    def visit_expr(self, expr: ast.AST) -> None:
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(expr, ast.Call):
+            for child in ast.iter_child_nodes(expr):
+                self.visit_expr(child)
+            self._visit_call(expr)
+            return
+        if isinstance(expr, (ast.Return, ast.Yield, ast.YieldFrom)):
+            value = getattr(expr, "value", None)
+            if value is not None:
+                self.visit_expr(value)
+                for name in _bare_names(value):
+                    self.state.pop(name, None)   # escaped to the caller
+            return
+        for child in ast.iter_child_nodes(expr):
+            self.visit_expr(child)
+
+    def _visit_call(self, call: ast.Call) -> None:
+        state = self.state
+        name = call_name(call)
+        base = last_component(name)
+        # completion through the value: r.future.set_exception(e)
+        if base in DISCHARGE_METHODS and isinstance(call.func, ast.Attribute):
+            root = _root_name(call.func.value)
+            if root is not None:
+                state.pop(root, None)
+                return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        bare = [n for a in args for n in _bare_names(a) if n in state]
+        if not bare:
+            return
+        if base is not None and any(w in base.lower()
+                                    for w in HANDOFF_WORDS):
+            for n in bare:
+                state.pop(n, None)
+            return
+        site = self.sites.get(id(call))
+        if site is not None and site.targets:
+            # resolved: trust the callee's summary for bare Name args ...
+            for target in site.targets:
+                summary = self.facts.get(target, EMPTY)
+                for pi, expr in site.param_map(target):
+                    if isinstance(expr, ast.Name) and expr.id in state \
+                            and set_contains(summary, pi):
+                        state.pop(expr.id, None)
+            # ... but a mention wrapped in a container ([r], (r, err)) is
+            # an opaque hand-off even to a resolved callee — silence wins
+            for a in args:
+                if not isinstance(a, ast.Name):
+                    for n in _bare_names(a):
+                        state.pop(n, None)
+            return
+        # unresolvable call: assume it takes ownership (silence > noise)
+        for n in bare:
+            state.pop(n, None)
+
+    # -- exits ---------------------------------------------------------------
+    def on_exit(self, stmt: Optional[ast.AST], kind: str) -> None:
+        where = {"return": "this `return`",
+                 "raise": "this `raise` (the error path)",
+                 "end": "the end of the function"}[kind]
+        line = getattr(stmt, "lineno", None)
+        at = f" at line {line}" if line is not None else ""
+        for name, src in list(self.state.items()):
+            if id(src) in self._reported:
+                continue
+            self._reported.add(id(src))
+            self.findings.append(self.rule.finding(
+                self.mod, src,
+                f"`{name}` is popped from the queue here but can reach "
+                f"{where}{at} without `set_result`/`set_exception`/"
+                f"requeue — a stranded request: its caller blocks on the "
+                f"future forever; complete or requeue it on every path "
+                f"(error paths included)",
+                self.fn.qualname))
+
+
+# -- helpers ------------------------------------------------------------------
+
+_COMPLETION_METHODS = {"set_result", "set_exception"}
+
+
+def _module_completes_futures(mod: ModuleInfo) -> bool:
+    got = getattr(mod, "_jx013_evidence", None)
+    if got is None:
+        got = any(isinstance(n, ast.Attribute)
+                  and n.attr in _COMPLETION_METHODS
+                  for n in ast.walk(mod.tree))
+        mod._jx013_evidence = got   # cached on the module record itself
+    return got
+
+
+def _is_source(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call) \
+            or not isinstance(value.func, ast.Attribute):
+        return False
+    if value.func.attr not in SOURCE_METHODS:
+        return False
+    from cycloneml_tpu.analysis.astutil import dotted_name
+    return _queueish(dotted_name(value.func.value))
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _bare_names(expr: ast.AST) -> Iterator[str]:
+    """Names occurring in ``expr`` OUTSIDE pure attribute-receiver
+    position: `r` and `[r, s]` yield, `r.n` does not (reading a field is
+    not a hand-off)."""
+    if isinstance(expr, ast.Name):
+        yield expr.id
+        return
+    if isinstance(expr, ast.Attribute):
+        return
+    for child in ast.iter_child_nodes(expr):
+        yield from _bare_names(child)
+
+
+def _own_discharged_names(fn: FunctionInfo, graph) -> Set[str]:
+    """Names this function's own body visibly discharges (completion
+    calls, hand-off calls, loops over them discharging the element) —
+    the facts-independent seed of the summary."""
+    out: Set[str] = set()
+    idx = graph.index(fn)
+    for _ in range(2):   # element-of-loop discharge needs a second pass
+        for call in idx.calls:
+            name = call_name(call)
+            base = last_component(name)
+            if base in DISCHARGE_METHODS \
+                    and isinstance(call.func, ast.Attribute):
+                root = _root_name(call.func.value)
+                if root is not None:
+                    out.add(root)
+                continue
+            if base is not None and any(w in base.lower()
+                                        for w in HANDOFF_WORDS):
+                for a in call.args:
+                    if isinstance(a, ast.Name):
+                        out.add(a.id)
+        for loop in idx.loops:
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            targets = set(assigned_names(loop.target))
+            if targets & out and isinstance(loop.iter, ast.Name):
+                # `for r in batch: r.future.set_exception(e)` discharges
+                # every element — the container is discharged
+                out.add(loop.iter.id)
+    return out

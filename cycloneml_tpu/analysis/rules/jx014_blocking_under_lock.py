@@ -1,0 +1,199 @@
+"""JX014 — blocking call inside a held-lock region.
+
+A lock held across a blocking operation turns one slow thing into a
+convoy: every thread that wants the lock now waits for the sleep, the
+``Future.result()``, the thread ``join()``, the compiled-program
+dispatch, or — worst — a mesh collective (then the lock's critical
+section is gated on a cross-process rendezvous, and a lock+rendezvous
+pair in two orders is the PR-2 deadlock). The rule flags blocking calls
+whose lexical lockset is non-empty, interprocedurally: a helper that
+blocks taints its callers — ``with self._lock: self._drain()`` flags when
+``_drain`` (transitively) sleeps, three calls deep.
+
+Blocking primitives: ``time.sleep``, ``Future.result()``, thread-shaped
+``.join()`` (receiver named ``*thread*``/``*worker*``/``*proc*`` — string
+and ``os.path`` joins are not locks' business), ``block_until_ready``,
+``device_get`` (host sync), blocking queue ``.get()`` on a queue-shaped
+receiver, ``Event``/``Condition`` ``.wait()``, collective dispatch
+(``psum``-family, ``tree_aggregate``-family), and calls to names bound to
+``jax.jit`` programs (a dispatch can hide a compile).
+
+The one sanctioned blocking-wait-under-lock is the condition-variable
+loop — ``with self._cv: while not ready: self._cv.wait()`` — because
+``wait`` RELEASES the lock it blocks on: waiting on the lock you hold is
+exempt; waiting on anything else while holding a lock still flags. The
+exemption extends to the *may-block summary*: a ``.wait()`` whose
+receiver resolves to a known lock/cv does not make its function a
+blocker, because a Condition wait REQUIRES holding that cv (working code
+always holds it) and releases it while blocked — so the factored wait
+loop (``with self._cv: self._wait_ready()``) stays clean. Known
+limitation, chosen deliberately: a helper cv-wait made while the caller
+holds a SECOND lock is missed (the second lock is NOT released); the
+ratchet-0 gate makes the false positive the costlier error. Bare
+``lock.acquire()`` is not "blocking" here — self/cyclic re-acquisition
+is JX012's finding, drawn from the same acquisition model.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
+                                            last_component)
+from cycloneml_tpu.analysis.dataflow import ProgramBindingsCache
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.locks import LockModel, model_for
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.rules.jx010_collective_divergence import \
+    COLLECTIVE_CALLS
+from cycloneml_tpu.analysis.rules.jx013_obligation_leak import _queueish
+
+BLOCKING_SIMPLE = {"sleep", "block_until_ready", "device_get"}
+THREADISH = ("thread", "worker", "proc")
+
+
+class BlockingUnderLockRule(DataflowRule):
+    rule_id = "JX014"
+
+    def __init__(self):
+        self._bindings = ProgramBindingsCache()
+
+    # -- summary: may this function block? (bottom-up bool) ------------------
+    def initial(self, fn: FunctionInfo, graph, ctx) -> bool:
+        bindings = self._bindings.bindings_for(fn, ctx, graph)
+        model = model_for(ctx)
+        for call in graph.index(fn).calls:
+            if _is_lock_wait(call, model, fn):
+                # waiting on a cv you (necessarily) hold releases it —
+                # the factored wait-loop helper is not a blocker
+                continue
+            if _blocking_reason(call, bindings) is not None:
+                return True
+        return False
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx) -> bool:
+        out = facts.get(fn, False)
+        if out:
+            return True
+        for site in graph.sites(fn):
+            if any(facts.get(t, False) is True for t in site.targets):
+                return True
+        return out
+
+    def top(self, fn, graph, ctx):
+        return True
+
+    # -- the check -----------------------------------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        model = model_for(ctx)
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            if fn.jit_reachable:
+                continue   # traced code has no host locks to convoy
+            info = model.info(fn)
+            if not info.call_locks:
+                continue
+            bindings = self._bindings.bindings_for(fn, ctx, graph)
+            sites = graph.sites_map(fn)
+            for call in graph.index(fn).calls:
+                held = info.call_locks.get(id(call))
+                if not held:
+                    continue
+                if _is_wait_on_held(call, held, model, fn):
+                    continue   # cv-wait releases the lock it blocks on
+                reason = _blocking_reason(call, bindings)
+                if reason is not None:
+                    yield self.finding(
+                        mod, call,
+                        f"{reason} while holding "
+                        f"{_pretty_locks(held)} — every thread wanting "
+                        f"the lock now waits out the blocking call "
+                        f"(convoy; a collective here can deadlock the "
+                        f"mesh); move the blocking call outside the "
+                        f"critical section (snapshot under the lock, "
+                        f"release, then block)",
+                        fn.qualname)
+                    continue
+                site = sites.get(id(call))
+                if site is None:
+                    continue
+                blocker = next((t for t in site.targets
+                                if facts.get(t, False) is True), None)
+                if blocker is not None:
+                    yield self.finding(
+                        mod, call,
+                        f"`{blocker.qualname}` can block (sleep/wait/"
+                        f"dispatch, transitively) and is called while "
+                        f"holding {_pretty_locks(held)} — the lock is "
+                        f"held across the wait (convoy / deadlock "
+                        f"exposure); call it after releasing the lock",
+                        fn.qualname)
+
+
+def _blocking_reason(call: ast.Call,
+                     bindings) -> Optional[str]:
+    """A human-readable reason when ``call`` is a blocking primitive."""
+    name = call_name(call)
+    base = last_component(name)
+    if base is None:
+        return None
+    if base in BLOCKING_SIMPLE:
+        return f"`{name}` blocks"
+    if base in COLLECTIVE_CALLS:
+        return f"collective `{name}` rendezvouses the whole mesh"
+    receiver = None
+    if isinstance(call.func, ast.Attribute):
+        from cycloneml_tpu.analysis.astutil import dotted_name
+        receiver = dotted_name(call.func.value)
+    if base == "result":
+        return f"`{name}()` blocks until the future completes"
+    if base == "join":
+        low = (receiver or "").lower()
+        if any(t in low for t in THREADISH):
+            return f"`{name}()` blocks until the thread exits"
+        return None
+    if base == "wait":
+        return f"`{name}()` blocks until signaled"
+    if base in ("get", "popleft") and receiver is None:
+        return None
+    if base == "get" and _queueish(receiver) and not call.keywords \
+            and len(call.args) == 0:
+        return f"queue `{name}()` blocks until an item arrives"
+    if isinstance(call.func, ast.Name) and call.func.id in bindings:
+        return (f"compiled-program dispatch `{call.func.id}(...)` can "
+                f"block (and hide a compile)")
+    return None
+
+
+def _is_wait_on_held(call: ast.Call, held, model: LockModel,
+                     fn: FunctionInfo) -> bool:
+    # NOT `acquire`: Lock.acquire releases nothing — re-acquiring a held
+    # lock is the JX012 self-deadlock, and acquiring another lock under
+    # one is a JX012 ordering edge, never an exemption here
+    if not isinstance(call.func, ast.Attribute) \
+            or call.func.attr not in ("wait", "wait_for",
+                                      "notify", "notify_all"):
+        return False
+    if call.func.attr in ("notify", "notify_all"):
+        return True   # notify never blocks
+    lid = model.lock_id(call.func.value, fn)
+    return lid is not None and lid in held
+
+
+def _is_lock_wait(call: ast.Call, model: LockModel,
+                  fn: FunctionInfo) -> bool:
+    """`X.wait()` where X resolves to a known lock/cv — a Condition wait
+    requires holding X and releases it while blocked."""
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("wait", "wait_for")
+            and model.lock_id(call.func.value, fn) is not None)
+
+
+def _pretty_locks(held) -> str:
+    return ", ".join(f"`{h}`" for h in sorted(held))

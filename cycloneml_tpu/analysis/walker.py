@@ -1,0 +1,242 @@
+"""Terminator-aware source-order block walker (shared CFG-lite).
+
+JX009 (use-after-donate) grew, fixture by fixture, a careful source-order
+scan over one function body: branch may-merges where terminated arms
+contribute nothing, loop second-iteration reasoning, ``with`` transparency,
+``try`` terminating only when every path does, ``break``/``continue``/
+``return``/``raise`` distinguished because they reach *different* code.
+JX013 (future-obligation leak) needs exactly the same machinery with the
+opposite polarity — obligations *pending* instead of buffers *dead* — so
+the walker now lives here, once, as a base class with rule hooks.
+
+The abstract state is ``self.state``: a ``name -> AST node`` map (the
+hazard site for that name). The contract both rules share:
+
+* ``visit_expr`` (hook) scans an expression in evaluation order and
+  mutates ``state`` (JX009: reads checked + donations added; JX013:
+  obligation sources added + discharges removed).
+* Rebinding a name drops it from ``state`` (``bind``; override to change).
+* ``If`` merges branches with a **may-union**; a branch that terminated
+  (return/raise/break/continue) contributes nothing to the fall-through.
+* Loops snapshot state, run the body once, and hand the rule the result
+  via ``on_loop_body_end`` (JX009's "second iteration re-dispatches"
+  check); when every body path exits the function, fall-through state is
+  the zero-iteration snapshot.
+* ``with`` neither catches nor redirects control flow.
+* ``try`` terminates only when the no-exception path AND every handler
+  do; ``finally`` dominates. Protection is control-flow-accurate: an
+  explicit ``raise`` is protected by an enclosing ``try`` with handlers
+  OR a ``finally`` (either may yet do the right thing), but a ``return``
+  is protected ONLY by a ``finally`` — handlers never run on a clean
+  return, so a hazard reaching a ``return`` inside ``try/except`` is as
+  real as one outside.
+* ``on_exit`` (hook) fires at every unprotected function exit: each
+  ``return`` (after its value is visited), each unprotected ``raise``,
+  and the end-of-body fall-through — where JX013 reports what is still
+  pending. JX009 leaves it empty.
+
+Terminator kinds returned by ``run_block``/``run_stmt``: ``"exit"``
+(return/raise), ``"break"``, ``"loop"`` (continue), or None (falls
+through). "Weakest terminator wins" when merging: a ``loop`` path means
+the next iteration is still reachable, a ``break`` path means post-loop
+code is.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from cycloneml_tpu.analysis.astutil import assigned_names
+
+NESTED_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+#: merge order for competing terminators — weakest (most code still
+#: reachable) first
+TERMINATOR_ORDER = ("loop", "break", "exit")
+
+
+def weakest(kinds) -> Optional[str]:
+    for kind in TERMINATOR_ORDER:
+        if kind in kinds:
+            return kind
+    return None
+
+
+class BlockWalker:
+    """Subclass, implement ``visit_expr`` (and the hooks you need), then
+    call :meth:`walk` with a function body."""
+
+    def __init__(self):
+        self.state: Dict[str, ast.AST] = {}
+        self._handler_depth = 0   # enclosing trys with except handlers
+        self._finally_depth = 0   # enclosing trys with a finally
+
+    def _return_protected(self) -> bool:
+        """A clean return runs ONLY enclosing ``finally`` blocks."""
+        return self._finally_depth > 0
+
+    def _raise_protected(self) -> bool:
+        """A raise may be caught by a handler or cleaned up in finally."""
+        return self._handler_depth > 0 or self._finally_depth > 0
+
+    # -- hooks ---------------------------------------------------------------
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        """Scan one expression in evaluation order, mutating ``state``."""
+        raise NotImplementedError
+
+    def bind(self, target: ast.AST) -> None:
+        """An assignment target rebinding names: default drops them."""
+        for n in assigned_names(target):
+            self.state.pop(n, None)
+
+    def on_loop_body_end(self, stmt: ast.AST, term: Optional[str],
+                         entered_with: set) -> None:
+        """After one abstract body iteration of ``stmt`` (For/While).
+        ``entered_with`` is the set of names in ``state`` when the loop
+        was entered; ``term`` is how the body terminated."""
+
+    def on_exit(self, stmt: Optional[ast.AST], kind: str) -> None:
+        """An unprotected function exit: ``kind`` is ``"return"``,
+        ``"raise"``, or ``"end"`` (fall-through; ``stmt`` is None)."""
+
+    # -- driver --------------------------------------------------------------
+
+    def walk(self, body) -> Optional[str]:
+        term = self.run_block(body)
+        if not term:
+            self.on_exit(None, "end")
+        return term
+
+    def run_block(self, body) -> Optional[str]:
+        terminated: Optional[str] = None
+        for stmt in body:
+            if terminated:
+                break
+            terminated = self.run_stmt(stmt)
+        return terminated
+
+    def run_stmt(self, stmt: ast.AST) -> Optional[str]:
+        state = self.state
+        if isinstance(stmt, NESTED_DEFS):
+            return None
+        if isinstance(stmt, ast.Assign):
+            self.visit_expr(stmt.value)
+            for t in stmt.targets:
+                self.bind(t)
+            return None
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+            self.bind(stmt.target)
+            return None
+        if isinstance(stmt, ast.AugAssign):
+            self.visit_expr(stmt.value)
+            # `x += v` READS x before rebinding it
+            if isinstance(stmt.target, ast.Name):
+                read = ast.copy_location(
+                    ast.Name(id=stmt.target.id, ctx=ast.Load()), stmt.target)
+                self.visit_expr(read)
+            self.bind(stmt.target)
+            return None
+        if isinstance(stmt, (ast.Expr, ast.Return, ast.Yield)):
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self.visit_expr(value)
+            if isinstance(stmt, ast.Return):
+                if not self._return_protected():
+                    self.on_exit(stmt, "return")
+                return "exit"
+            return None
+        if isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                self.visit_expr(stmt.exc)
+            # continue still reaches the NEXT iteration; return/raise/
+            # break leave the loop — and break (unlike return/raise)
+            # carries its state into the post-loop code
+            if isinstance(stmt, ast.Continue):
+                return "loop"
+            if isinstance(stmt, ast.Break):
+                return "break"
+            if not self._raise_protected():
+                self.on_exit(stmt, "raise")
+            return "exit"
+        if isinstance(stmt, ast.If):
+            self.visit_expr(stmt.test)
+            before = dict(state)
+            t_body = self.run_block(stmt.body)
+            after_body = dict(state)
+            state.clear()
+            state.update(before)
+            t_else = self.run_block(stmt.orelse)
+            after_else = dict(state)
+            # may merge; a terminated branch contributes nothing to the
+            # fall-through
+            state.clear()
+            if not t_body:
+                state.update(after_body)
+            if not t_else:
+                state.update(after_else)
+            if t_body and t_else:
+                return weakest((t_body, t_else))
+            return None
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.visit_expr(stmt.iter)
+                self.bind(stmt.target)
+            else:
+                self.visit_expr(stmt.test)
+            before_loop = dict(state)
+            entered_with = set(state)
+            term = self.run_block(stmt.body)
+            self.on_loop_body_end(stmt, term, entered_with)
+            if term == "exit":
+                # every body path returns/raises: post-loop code is only
+                # reachable via the zero-iteration path ("break" paths DO
+                # fall into post-loop code and keep theirs)
+                state.clear()
+                state.update(before_loop)
+            self.run_block(stmt.orelse)
+            return None
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars)
+            # `with` neither catches nor redirects control flow — a
+            # return inside the span idiom still terminates the loop
+            return self.run_block(stmt.body)
+        if isinstance(stmt, ast.Try):
+            has_handlers = bool(stmt.handlers)
+            has_finally = bool(stmt.finalbody)
+            if has_finally:
+                self._finally_depth += 1
+            # handlers cover the BODY only; finally covers body, handlers
+            # and orelse alike
+            if has_handlers:
+                self._handler_depth += 1
+            t_body = self.run_block(stmt.body)
+            if has_handlers:
+                self._handler_depth -= 1
+            handler_terms = [self.run_block(h.body) for h in stmt.handlers]
+            t_orelse = self.run_block(stmt.orelse)
+            if has_finally:
+                self._finally_depth -= 1
+            t_final = self.run_block(stmt.finalbody)
+            if t_final:
+                return t_final
+            # no-exception path terminates via body or orelse; each
+            # caught-exception path via its handler — the try terminates
+            # only when EVERY path does (weakest kind wins)
+            terms = [t_body or t_orelse] + handler_terms
+            if all(terms):
+                return weakest(terms)
+            return None
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self.bind(t)
+            return None
+        for child in ast.iter_child_nodes(stmt):
+            self.visit_expr(child)
+        return None

@@ -96,7 +96,8 @@ class Accumulator:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def reset(self) -> None:
         with self._lock:
@@ -540,22 +541,33 @@ class CycloneContext:
 
     def stop(self) -> None:
         global _active_context
-        if self._stopped:
-            return
-        self._stopped = True
+        # stopped-flag flip AND heartbeat-machinery capture in ONE lock
+        # acquisition, pairing with the lazy creators: two concurrent
+        # stop() calls race the unguarded check-then-act (double
+        # ApplicationEnd, double plugin shutdown), and a creator between
+        # the flag flip and the old unguarded `self._hb_server` read
+        # leaves an orphaned server thread. The (blocking) .stop() joins
+        # run AFTER release — holding `_hb_lock` across a thread join
+        # convoys every heartbeat_receiver caller.
+        with self._hb_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            heartbeats, self._heartbeats = self._heartbeats, None
+            hb_sender, self._hb_sender = self._hb_sender, None
+            hb_server, self._hb_server = self._hb_server, None
         self.listener_bus.post(ApplicationEnd(app_id=self.app_id))
         for p in getattr(self, "_plugins", []):
             try:
                 p.shutdown()
             except Exception:
                 logger.exception("plugin shutdown failed")
-        with self._hb_lock:  # pairs with lazy create: no post-stop starts
-            if self._heartbeats is not None:
-                self._heartbeats.stop()
-        if self._hb_sender is not None:
-            self._hb_sender.stop()
-        if self._hb_server is not None:
-            self._hb_server.stop()
+        if heartbeats is not None:
+            heartbeats.stop()
+        if hb_sender is not None:
+            hb_sender.stop()
+        if hb_server is not None:
+            hb_server.stop()
         if getattr(self, "_web_ui", None) is not None:
             self._web_ui.stop()
         if getattr(self, "storage", None) is not None:

@@ -174,8 +174,9 @@ class StorageManager:
             _unlink_spill(e["path"])
 
     def level_of(self, ds) -> Optional[str]:
-        e = self._entries.get(id(ds))
-        return e["level"] if e else None
+        with self._lock:   # evict/spill rewrite entries concurrently
+            e = self._entries.get(id(ds))
+            return e["level"] if e else None
 
     def usage(self) -> Dict[str, int]:
         with self._lock:

@@ -133,7 +133,8 @@ class MasterDaemon:
     # -- HA leader election (file-lock ZooKeeper analog) -------------------
     @property
     def is_leader(self) -> bool:
-        return self._leader
+        with self._lock:   # flipped by the elector thread under the lock
+            return self._leader
 
     def _try_acquire_leadership(self) -> None:
         import fcntl
@@ -189,7 +190,7 @@ class MasterDaemon:
     # -- protocol -----------------------------------------------------------
     def _dispatch(self, msg: dict) -> dict:
         kind = msg.get("kind")
-        if not self._leader:
+        if not self.is_leader:   # locked read; released before re-entry
             # standby: every caller (worker poll rotation, HA-aware
             # clients) treats this as "try the next master"
             return {"ok": False, "error": "not-leader", "retryable": True}
@@ -428,9 +429,12 @@ class MasterDaemon:
         # order matters for split-brain safety: drop leadership FIRST (so
         # in-flight handlers stop persisting — _save_state is
         # leader-guarded), stop serving, and only then release the flock
-        # the next leader is waiting on
+        # the next leader is waiting on. The flip takes the lock: it must
+        # not interleave with an in-flight handler's locked persist, and
+        # the elector's locked `_leader = True` must not be lost under it.
         self._stopped = True
-        self._leader = False
+        with self._lock:
+            self._leader = False
         self._server.shutdown()
         self._server.server_close()
         if self._lock_fh is not None:

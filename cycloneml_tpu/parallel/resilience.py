@@ -170,9 +170,13 @@ class HeartbeatReceiver:
         return [w for w, _ in expired]
 
     def start(self) -> None:
-        if self._thread is None:
+        with self._lock:   # atomic double-start check (stop() races us)
+            if self._thread is not None:
+                return
             self._thread = threading.Thread(
                 target=self._loop, name="cyclone-heartbeat", daemon=True)
+            # started INSIDE the lock (non-blocking): publishing a
+            # not-yet-started thread would hand stop() an unjoinable one
             self._thread.start()
 
     def _loop(self) -> None:
@@ -184,9 +188,10 @@ class HeartbeatReceiver:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)   # blocking join after release
 
 
 class HeartbeatServer:

@@ -107,7 +107,8 @@ class ResourceProfileManager:
             return registered
 
     def get(self, pid: int) -> ResourceProfile:
-        return self._profiles[pid]
+        with self._lock:   # register() rewrites the map concurrently
+            return self._profiles[pid]
 
     @staticmethod
     def default_profile() -> ResourceProfile:

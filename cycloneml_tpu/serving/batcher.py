@@ -136,7 +136,8 @@ class ModelLane:
             after = self._cache_size()
             compiled = (after is None or before is None or after > before)
             if compiled:
-                self.compiles += 1
+                with self._cv:   # tallies are cv-guarded, warm-up included
+                    self.compiles += 1
                 self.server.registry.counter("serving.compiles").inc()
             sp.annotate(compiled=compiled)
             if harvest:
@@ -160,12 +161,13 @@ class ModelLane:
             pending = list(self._queue)
             self._queue.clear()
             self._cv.notify_all()
+            worker = self._thread   # captured under the cv like the rest
         for r in pending:
             r.future.set_exception(
                 ServingOverloaded(f"model server stopped while "
                                   f"{self.name!r} request was queued"))
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        if worker is not None:
+            worker.join(timeout=10)   # blocking join AFTER release
 
     # -- request side ---------------------------------------------------------
 
@@ -378,7 +380,8 @@ class ModelLane:
                     if (kind == "transient"
                             and attempt < self.server.max_retries):
                         attempt += 1
-                        self.retries += 1
+                        with self._cv:   # tallies are cv-guarded
+                            self.retries += 1
                         self.server.registry.counter("serving.retries").inc()
                         tracing.instant("retry", point="serving.dispatch",
                                         attempt=attempt, model=self.name)
@@ -443,18 +446,26 @@ class ModelLane:
 
     def stats(self) -> dict:
         lat = self.latency.snapshot()
+        with self._cv:
+            # one cv acquisition for the whole tally row: the worker
+            # updates these under the cv, and a scrape racing a dispatch
+            # must not pair this batch's `rows` with last batch's
+            # `batches` (torn rollup)
+            tallies = {
+                "compiles": self.compiles,
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "coalesced": self.coalesced,
+                "shed": self.shed,
+                "retries": self.retries,
+                "requeues": self.requeues,
+            }
         return {
             "buckets": list(self.buckets),
-            "compiles": self.compiles,
             "gang": self.servable.n_models if self.is_gang else 0,
             "nFeatures": self.servable.n_features,
-            "requests": self.requests,
-            "rows": self.rows,
-            "batches": self.batches,
-            "coalesced": self.coalesced,
-            "shed": self.shed,
-            "retries": self.retries,
-            "requeues": self.requeues,
+            **tallies,
             "latencyMs": {k: (v * 1e3 if k != "count" else v)
                           for k, v in lat.items()},
         }

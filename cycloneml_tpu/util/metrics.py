@@ -32,7 +32,8 @@ class Counter:
 
     @property
     def count(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Gauge:
@@ -79,11 +80,17 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def mean(self) -> float:
-        return self._sum / self._count if self._count else 0.0
+        # BOTH moments under one lock acquisition: the unguarded version
+        # could pair a fresh `_sum` with a stale `_count` mid-`update`
+        # (observe-while-snapshot races from batcher worker threads) —
+        # with every sample == v the torn mean is visibly != v
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
 
     @staticmethod
     def _rank(s: List[float], q: float) -> float:

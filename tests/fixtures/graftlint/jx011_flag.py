@@ -1,0 +1,53 @@
+"""JX011 should-flag fixtures: accesses outside the inferred guard."""
+import threading
+
+
+class Tally:
+    """Majority of `_count` accesses hold `_lock`; the deviants race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+
+    def add(self, v):
+        with self._lock:
+            self._count += 1
+            self._total += v
+
+    def add_many(self, vs):
+        with self._lock:
+            self._count += len(vs)
+            self._total += sum(vs)
+
+    def racy_reset(self):
+        self._count = 0                      # JX011 (unguarded write)
+
+    def racy_mean(self):
+        return self._total / self._count     # JX011 JX011 (torn pair read)
+
+
+class Pipeline:
+    """Interprocedural: `_append` is only ever called with the lock held,
+    so its access is guarded via locks-held-at-entry — but `peek_racy`
+    reads the list with no lock at all."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+
+    def push(self, v):
+        with self._lock:
+            self._append(v)
+
+    def _append(self, v):
+        self._pending.append(v)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._pending)
+            self._pending = []
+        return out
+
+    def size_racy(self):
+        return len(self._pending)            # JX011 (unguarded read)

@@ -1,0 +1,94 @@
+"""JX011 should-pass fixtures: the locking idioms that must stay silent."""
+import threading
+
+
+class Disciplined:
+    """Every access of every mutable field holds the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._n = 0
+
+    def add(self, v):
+        with self._lock:
+            self._items.append(v)
+            self._n += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items), self._n
+
+
+class DoubleChecked:
+    """The sanctioned racy fast path: peek without the lock, RE-CHECK
+    under it before acting — the unguarded read is exempt because the
+    same function also reads the field under the inferred guard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+
+    def maybe_run(self, work):
+        if self._stopped:          # benign peek: re-checked below
+            return None
+        with self._lock:
+            if self._stopped:
+                return None
+            return work()
+
+
+class PublishThenRead:
+    """Fields written only during construction need no guard — reads
+    race with nothing."""
+
+    def __init__(self, conf):
+        self._lock = threading.Lock()
+        self.window = conf["window"]
+        self._things = {}
+
+    def get_window(self):
+        return self.window
+
+    def put(self, k, v):
+        with self._lock:
+            self._things[k] = v
+
+
+class NoLocksAtAll:
+    """Single-threaded by convention: no lock evidence, no inference."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+    def read(self):
+        return self.count
+
+
+class GuardedHelper:
+    """The helper's accesses are guarded interprocedurally — every call
+    path holds the lock, so nothing here is a deviant."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def update(self, k, v):
+        with self._lock:
+            self._apply(k, v)
+
+    def replace(self, items):
+        with self._lock:
+            self._state.clear()
+            for k, v in items:
+                self._apply(k, v)
+
+    def _apply(self, k, v):
+        self._state[k] = v

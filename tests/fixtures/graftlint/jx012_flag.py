@@ -1,0 +1,56 @@
+"""JX012 should-flag fixtures: lock-order cycles and self-deadlocks."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def grab_ab():
+    with _a:
+        with _b:                     # JX012 (edge a->b of the a/b cycle)
+            pass
+
+
+def grab_ba():
+    with _b:
+        with _a:                     # JX012 (edge b->a closes the cycle)
+            pass
+
+
+def reacquire_same():
+    with _a:
+        with _a:                     # JX012 (non-reentrant self-deadlock)
+            pass
+
+
+# -- interprocedural: the inner acquisition is two calls away ----------------
+
+_x = threading.Lock()
+_y = threading.Lock()
+
+
+def _takes_y():
+    with _y:
+        pass
+
+
+def _indirect_y():
+    _takes_y()
+
+
+def outer_xy():
+    with _x:
+        _indirect_y()                # JX012 (x->y via summary, 2 hops)
+
+
+def outer_yx():
+    with _y:
+        with _x:                     # JX012 (y->x closes the x/y cycle)
+            pass
+
+
+def reacquire_via_bare_acquire():
+    # `.acquire()` is an acquisition too: a with-only model would let
+    # this guaranteed self-deadlock through
+    with _a:
+        _a.acquire()                 # JX012 (acquire of a held Lock)

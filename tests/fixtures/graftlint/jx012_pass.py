@@ -1,0 +1,68 @@
+"""JX012 should-pass fixtures: acyclic and reentrant acquisition."""
+import threading
+
+_first = threading.Lock()
+_second = threading.Lock()
+_rl = threading.RLock()
+
+
+def ordered_one():
+    # consistent global order: first, then second — everywhere
+    with _first:
+        with _second:
+            pass
+
+
+def ordered_two():
+    with _first:
+        with _second:
+            pass
+
+
+def second_alone():
+    with _second:
+        pass
+
+
+def reentrant_ok():
+    # RLock self-nesting is the documented recursion pattern
+    with _rl:
+        with _rl:
+            pass
+
+
+class SnapshotThenCall:
+    """The recommended inversion fix: copy under the lock, RELEASE, then
+    call into the other lock's owner — no edge is ever drawn."""
+
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self._items = []
+        self.other = other
+
+    def flush(self):
+        with self._lock:
+            snapshot = list(self._items)
+            self._items = []
+        for item in snapshot:
+            self.other.consume(item)
+
+
+class CvLoop:
+    """Condition() is RLock-backed — re-entry by the holding thread is
+    legal, and the wait loop is the canonical consumer."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = []
+
+    def put(self, v):
+        with self._cv:
+            self._ready.append(v)
+            self._cv.notify_all()
+
+    def take(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+            return self._ready.pop(0)

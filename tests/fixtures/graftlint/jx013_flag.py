@@ -1,0 +1,60 @@
+"""JX013 should-flag fixtures: queue pops stranded on a path to exit."""
+import collections
+
+
+class Lane:
+    def __init__(self):
+        self._queue = collections.deque()
+
+    def leaks_on_error_path(self, err):
+        r = self._queue.popleft()            # JX013 (stranded on the raise)
+        if err:
+            raise RuntimeError("dispatch failed")
+        r.future.set_result(1)
+
+    def leaks_on_fallthrough(self, flag):
+        r = self._queue.popleft()            # JX013 (else path never completes)
+        if flag:
+            r.future.set_result(0)
+
+    def leaks_on_early_return(self, stopped):
+        r = self._queue.popleft()            # JX013 (returns without completing)
+        if stopped:
+            return None
+        r.future.set_exception(RuntimeError("stopped"))
+        return r.n
+
+    def loop_never_completes(self, rows):
+        while self._queue:
+            r = self._queue.popleft()        # JX013 (counted, never completed)
+            rows += r.n
+
+
+def _log_only(r):
+    print(r)
+
+
+class Lane2:
+    def __init__(self):
+        self._queue = collections.deque()
+
+    def helper_never_completes(self):
+        r = self._queue.popleft()            # JX013 (helper only logs it)
+        _log_only(r)
+
+
+class Lane3:
+    def __init__(self):
+        self._queue = collections.deque()
+
+    def leaks_on_return_inside_try(self, stopped):
+        # a clean `return` runs NO except handler — the handler
+        # completing the future does not cover this path
+        r = self._queue.popleft()            # JX013 (return skips handler)
+        try:
+            if stopped:
+                return None
+            r.future.set_result(1)
+        except ValueError as e:
+            r.future.set_exception(e)
+        return r.n

@@ -1,0 +1,90 @@
+"""JX013 should-pass fixtures: every pop discharged on every path."""
+import collections
+
+
+class GoodLane:
+    def __init__(self):
+        self._queue = collections.deque()
+        self._results = {}
+
+    def complete_both_paths(self, ok):
+        r = self._queue.popleft()
+        if ok:
+            r.future.set_result(1)
+        else:
+            r.future.set_exception(RuntimeError("no"))
+
+    def error_path_completes_then_raises(self, err):
+        r = self._queue.popleft()
+        if err:
+            r.future.set_exception(err)
+            raise RuntimeError("failed, but the future is complete")
+        r.future.set_result(0)
+
+    def requeue_under_backpressure(self, overloaded):
+        r = self._queue.popleft()
+        if overloaded:
+            self._queue.appendleft(r)       # requeue IS the discharge
+            return False
+        r.future.set_result(1)
+        return True
+
+    def handler_completes(self, prog):
+        r = self._queue.popleft()
+        try:
+            r.future.set_result(prog(r.n))
+        except Exception as e:
+            r.future.set_exception(e)
+
+    def transfer_to_caller(self):
+        # returning the request transfers the obligation with it
+        return self._queue.popleft()
+
+    def store_for_later(self, key):
+        r = self._queue.popleft()
+        self._results[key] = r              # escaped: someone holds it
+
+    def drain_loop(self):
+        while self._queue:
+            r = self._queue.popleft()
+            r.future.set_result(None)
+
+
+def _settle(req, err):
+    req.future.set_exception(err)
+
+
+def _batchwise(batch, err):
+    for r in batch:
+        r.future.set_exception(err)
+
+
+class DelegatingLane:
+    def __init__(self):
+        self._queue = collections.deque()
+
+    def helper_completes(self, err):
+        # resolved callee whose summary discharges parameter 0
+        r = self._queue.popleft()
+        _settle(r, err)
+
+    def helper_completes_batch(self, err):
+        # container hand-off to a resolved batch helper
+        r = self._queue.popleft()
+        _batchwise([r], err)
+
+
+class FinallyLane:
+    def __init__(self):
+        self._queue = collections.deque()
+
+    def finally_completes_on_every_return(self, stopped):
+        # `finally` runs on BOTH returns — the obligation is discharged
+        # whichever way the body exits
+        r = self._queue.popleft()
+        try:
+            if stopped:
+                return False
+            return True
+        finally:
+            r.future.set_result(stopped)

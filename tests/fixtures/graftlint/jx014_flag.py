@@ -1,0 +1,54 @@
+"""JX014 should-flag fixtures: blocking calls inside held-lock regions."""
+import threading
+import time
+
+import jax
+
+_lock = threading.Lock()
+
+
+def sleeps_under_lock():
+    with _lock:
+        time.sleep(0.05)                    # JX014
+
+
+def waits_on_future_under_lock(fut):
+    with _lock:
+        return fut.result(timeout=5)        # JX014
+
+
+def joins_thread_under_lock(worker_thread):
+    with _lock:
+        worker_thread.join()                # JX014
+
+
+def syncs_device_under_lock(out):
+    with _lock:
+        jax.block_until_ready(out)          # JX014
+
+
+def collective_under_lock(ds, coef):
+    with _lock:
+        return ds.tree_aggregate(coef)      # JX014 (mesh rendezvous)
+
+
+def _backoff():
+    time.sleep(0.01)
+
+
+def _retry_with_backoff():
+    _backoff()
+
+
+class Lane:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._evt = threading.Event()
+
+    def helper_blocks_transitively(self):
+        with self._cv:
+            _retry_with_backoff()           # JX014 (2 hops to the sleep)
+
+    def waits_on_other_primitive(self):
+        with self._cv:
+            self._evt.wait(1.0)             # JX014 (not the held lock)

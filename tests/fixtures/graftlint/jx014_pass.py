@@ -1,0 +1,72 @@
+"""JX014 should-pass fixtures: blocking done right around locks."""
+import os
+import threading
+import time
+
+
+class WaitLoop:
+    """The canonical condition-variable consumer: `wait` RELEASES the
+    lock it blocks on — blocking under your own cv is the idiom."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, v):
+        with self._cv:
+            self._items.append(v)
+            self._cv.notify_all()
+
+    def take(self, deadline):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout=deadline)
+            return self._items.pop(0)
+
+
+class SnapshotThenBlock:
+    """Copy under the lock, release, then do the slow thing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._thread = None
+
+    def flush_slowly(self):
+        with self._lock:
+            batch = list(self._pending)
+            self._pending = []
+        time.sleep(0.01)        # blocking, but no lock held
+        return batch
+
+    def stop(self):
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=5)   # join AFTER the lock is released
+
+
+def string_and_path_joins_are_fine(parts, root):
+    lock = threading.Lock()
+    with lock:
+        joined = ", ".join(str(p) for p in parts)
+        return os.path.join(root, joined)
+
+
+class FactoredWaitLoop:
+    """The sanctioned cv wait loop FACTORED INTO A HELPER: `wait`
+    releases the cv the caller holds, so the helper is not a blocker."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = []
+
+    def _wait_ready(self):
+        while not self._ready:
+            self._cv.wait(0.1)
+
+    def take(self):
+        with self._cv:
+            self._wait_ready()
+            return self._ready.pop(0)

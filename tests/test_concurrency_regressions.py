@@ -1,0 +1,213 @@
+"""Concurrency regressions pinned by the graftlint JX011–JX014 self-run.
+
+Each test pins a product fix the PR-9 self-run surfaced (see
+docs/graftlint.md, "the self-run ledger"): torn metric moments, context
+double-stop, heartbeat start/stop races. These are real-schedule tests —
+they hammer the fixed path from threads and assert the invariant the lock
+now guarantees. Post-fix they are deterministic passes; pre-fix the
+metric ones fail with high probability and the rest are racy-by-schedule.
+"""
+
+import threading
+
+import pytest
+
+from cycloneml_tpu.util.metrics import Counter, Histogram
+
+
+def _hammer(n_threads, fn):
+    stop = threading.Event()
+    errs = []
+
+    def run():
+        try:
+            while not stop.is_set():
+                fn()
+        except Exception as e:   # pragma: no cover - the failure path
+            errs.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=run, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    return stop, threads, errs
+
+
+def test_histogram_mean_is_never_torn_under_concurrent_observe():
+    """Histogram.mean used to read `_sum` then `_count` without the lock:
+    an update between the two reads pairs a stale sum with a fresh count.
+    With every sample == 1.0 the true mean is exactly 1.0 ALWAYS — any
+    other value is a torn read."""
+    h = Histogram(window=64)
+    h.update(1.0)
+    stop, threads, errs = _hammer(4, lambda: h.update(1.0))
+    try:
+        for _ in range(20000):
+            m = h.mean
+            assert m == 1.0, f"torn mean {m!r} (sum/count mismatch)"
+            c = h.count
+            assert c >= 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert not errs
+    snap = h.snapshot()
+    assert snap["mean"] == 1.0 and snap["p50"] == 1.0
+
+
+def test_counter_count_reads_under_the_lock():
+    c = Counter()
+    n_threads, per = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def run():
+        barrier.wait()
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    seen = 0
+    for _ in range(1000):
+        now = c.count
+        assert now >= seen   # monotone under concurrent inc
+        seen = now
+    for t in threads:
+        t.join(timeout=10)
+    assert c.count == n_threads * per
+
+
+def test_context_stop_is_idempotent_under_concurrent_calls():
+    """stop() used to check-then-act on `_stopped` without a lock: two
+    concurrent stop() calls both passed the check and double-posted
+    ApplicationEnd (and double-shutdown plugins). Now the flag flips
+    under `_hb_lock` — exactly one ApplicationEnd however many threads
+    race the call."""
+    from cycloneml_tpu import context as ctx_mod
+    from cycloneml_tpu.context import CycloneContext
+
+    # run a private context beside whatever the session fixture holds
+    with ctx_mod._active_lock:
+        old = ctx_mod._active_context
+        ctx_mod._active_context = None
+    try:
+        # same master as the session fixture: a second mesh master would
+        # refuse to initialise beside the active local-mesh
+        ctx = CycloneContext(master="local-mesh[8]", app_name="stop-race")
+        ends = []
+        ctx.listener_bus.add_listener(
+            lambda e: ends.append(e)
+            if type(e).__name__ == "ApplicationEnd" else None)
+        barrier = threading.Barrier(8)
+
+        def race():
+            barrier.wait()
+            ctx.stop()
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        ctx.listener_bus.wait_until_empty()
+        assert len(ends) == 1, f"stop() ran {len(ends)} times"
+    finally:
+        with ctx_mod._active_lock:
+            ctx_mod._active_context = old
+
+
+def test_heartbeat_receiver_start_stop_race_leaves_no_thread():
+    """start() used to check-then-create `_thread` without the lock
+    (double-start orphans a sweep thread); stop() read and nulled it
+    unguarded. Both now hold the receiver's own lock; after any
+    interleaving of concurrent start/stop + a final stop, no sweep
+    thread survives."""
+    from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
+
+    # other fixtures (the session context) legitimately run their own
+    # sweep thread — only threads born in THIS test count as leaks
+    pre_existing = {id(t) for t in threading.enumerate()
+                    if t.name == "cyclone-heartbeat"}
+    for _ in range(20):
+        hb = HeartbeatReceiver(timeout_s=30.0, check_interval_s=30.0)
+        barrier = threading.Barrier(4)
+
+        def flip(i, hb=hb, barrier=barrier):
+            barrier.wait()
+            (hb.start if i % 2 == 0 else hb.stop)()
+
+        threads = [threading.Thread(target=flip, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        hb.stop()
+        assert hb._thread is None
+    alive = [t.name for t in threading.enumerate()
+             if t.name == "cyclone-heartbeat" and t.is_alive()
+             and id(t) not in pre_existing]
+    assert not alive, f"orphaned sweep threads: {alive}"
+
+
+def test_lane_tallies_exact_after_concurrent_predicts():
+    """ModelLane.stats() used to read the tally fields one by one with no
+    lock while the worker updated them under the cv — a scrape racing a
+    dispatch could pair this batch's `rows` with last batch's `batches`.
+    Now the whole tally row is one cv acquisition: every snapshot obeys
+    the tally invariants, and the final counts are exact."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("jax")
+    from cycloneml_tpu.ml.classification.logistic_regression import (
+        LogisticRegressionModel,
+    )
+    from cycloneml_tpu.serving.server import ModelServer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 4))
+    model = LogisticRegressionModel(rng.normal(size=(1, 4)),
+                                    rng.normal(size=(1,)), 2, False)
+
+    with ModelServer(ctx=None, max_batch=8, window_ms=1.0,
+                     max_queue=256) as server:
+        server.register("m", model)
+        n_threads, per = 4, 25
+        rows_each = 2
+        errs = []
+        snapshots = []
+        done = threading.Event()
+
+        def client():
+            try:
+                for _ in range(per):
+                    server.predict("m", X[:rows_each])
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        def scraper():
+            while not done.is_set():
+                s = server.stats()["models"]["m"]
+                # tally-row invariants: a torn read can violate these
+                assert s["requests"] >= s["batches"] >= 0
+                assert s["rows"] >= s["requests"] * 0  # non-negative
+                assert s["coalesced"] <= s["requests"]
+                snapshots.append(s)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        sc = threading.Thread(target=scraper, daemon=True)
+        sc.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        done.set()
+        sc.join(timeout=10)
+        assert not errs
+        final = server.stats()["models"]["m"]
+        assert final["requests"] == n_threads * per
+        assert final["rows"] == n_threads * per * rows_each
+        assert snapshots, "scraper never ran"

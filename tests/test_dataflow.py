@@ -392,3 +392,60 @@ def test_interprocedural_finding_lands_in_unchanged_caller(tmp_path):
     findings = analyze_paths(files, only_paths={"helper.py"})
     assert [f.rule for f in findings] == ["JX009"]
     assert findings[0].path.endswith("caller.py")
+
+
+# -- lockset entry summaries (JX011, the down-direction analysis) -------------
+
+LOCK_CHAIN = """
+    import threading
+
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._data = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._mid(k, v)
+
+        def _mid(self, k, v):
+            self._leaf(k, v)
+
+        def _leaf(self, k, v):
+            self._data[k] = v
+
+        def racy_size(self):
+            return len(self._data)
+"""
+
+
+def test_lockset_entry_summary_propagates_two_hops(tmp_path):
+    """JX011's locks-held-at-entry is a DOWN-direction must-analysis: the
+    lock taken in `put` reaches `_leaf` through the 2-hop helper chain
+    (put -> _mid -> _leaf), so the write in `_leaf` counts as guarded."""
+    from cycloneml_tpu.analysis.rules.jx011_lockset_race import \
+        LocksetRaceRule
+    modules, graph = _modules_from(tmp_path, {"locks.py": LOCK_CHAIN})
+    rule = LocksetRaceRule()
+    _, result = _converge(modules, graph, rule)
+    held = frozenset({"Store._lock"})
+    assert result.summary("JX011", _fn(modules, "locks.py",
+                                       "Store._mid")) == held
+    assert result.summary("JX011", _fn(modules, "locks.py",
+                                       "Store._leaf")) == held
+    # `put` itself is an entry point: nothing guaranteed at ITS entry
+    assert result.summary("JX011", _fn(modules, "locks.py",
+                                       "Store.put")) == EMPTY
+
+
+def test_lockset_two_hop_guard_drives_the_inference(tmp_path):
+    """End-to-end: `_leaf`'s 2-hop-guarded write is the majority evidence
+    that `_data` is lock-guarded — which is exactly what convicts the
+    unguarded `racy_size` read. If entry propagation broke, there would
+    be NO guarded access and the rule would stay silent."""
+    p = tmp_path / "locks.py"
+    p.write_text(textwrap.dedent(LOCK_CHAIN))
+    findings = [f for f in analyze_paths([str(p)]) if f.rule == "JX011"]
+    assert len(findings) == 1
+    assert findings[0].function == "Store.racy_size"

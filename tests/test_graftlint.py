@@ -31,7 +31,7 @@ PACKAGE = os.path.join(REPO, "cycloneml_tpu")
 BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
 
 RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-         "JX008", "JX009", "JX010")
+         "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014")
 
 
 def marker_lines(path: str, rule: str):
@@ -479,3 +479,111 @@ def test_mesh_axes_discovered_from_source():
     axes, names = _discover_axes({mod.path: mod})
     assert set(axes) == {"data", "replica", "model"}
     assert names == {"DATA_AXIS", "REPLICA_AXIS", "MODEL_AXIS"}
+
+
+# -- golden CLI output for the concurrency rules (JX011/JX013) ---------------
+
+def test_cli_json_golden_jx011(capsys):
+    """Stable machine-readable JX011 output: rule ids, functions, and
+    region lines (pinned via the fixture's own marker lines, the same
+    contract the precision tests enforce)."""
+    flag = os.path.join(FIXTURES, "jx011_flag.py")
+    assert graftlint_main([flag, "--rules", "JX011", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] == 4        # racy_reset, racy_mean×2, size_racy
+    assert {f["rule"] for f in payload["findings"]} == {"JX011"}
+    assert {f["function"] for f in payload["findings"]} == {
+        "Tally.racy_reset", "Tally.racy_mean", "Pipeline.size_racy"}
+    assert {f["line"] for f in payload["findings"]} \
+        == marker_lines(flag, "JX011")
+    for f in payload["findings"]:
+        assert f["end_line"] >= f["line"]
+        assert "unguarded" in f["message"]
+
+
+def test_cli_sarif_golden_jx013(capsys):
+    """Stable SARIF for JX013: ruleId, 1-based regions on the pop lines,
+    and the rule:path:function partialFingerprints baselining keys on."""
+    flag = os.path.join(FIXTURES, "jx013_flag.py")
+    assert graftlint_main([flag, "--rules", "JX013", "--sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    (run,) = doc["runs"]
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"JX013"}
+    lines = {r["locations"][0]["physicalLocation"]["region"]["startLine"]
+             for r in results}
+    assert lines == marker_lines(flag, "JX013")
+    fps = {r["partialFingerprints"]["graftlint/v1"] for r in results}
+    assert "JX013:jx013_flag.py:Lane.leaks_on_error_path" in fps
+    assert "JX013:jx013_flag.py:Lane2.helper_never_completes" in fps
+    rule_meta = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"JX011", "JX012", "JX013", "JX014"} <= rule_meta
+
+
+# -- fixture sweep: the registry and the test sweep cannot drift -------------
+
+def test_rule_registry_matches_fixture_sweep():
+    """Every registered rule is in this file's RULES sweep (so its flag
+    fixture is proven to fire and its pass fixture to stay silent), and
+    both fixture files exist on disk. A rule added without fixtures
+    fails here, not silently skips the gate."""
+    from cycloneml_tpu.analysis.rules import ALL_RULES
+    assert tuple(cls.rule_id for cls in ALL_RULES) == RULES
+    for rule in RULES:
+        for suffix in ("flag", "pass"):
+            path = os.path.join(FIXTURES, f"{rule.lower()}_{suffix}.py")
+            assert os.path.exists(path), f"missing fixture {path}"
+
+
+# -- parse cache: schema-keyed invalidation ----------------------------------
+
+def test_parse_cache_rejects_pre_v3_schema(tmp_path):
+    """A cache pickle written before the concurrency rules (old version,
+    or same version but a different dataflow-rule schema) must be
+    DISCARDED — stale lockset/obligation facts served from a pre-v3
+    cache would silently weaken the gate."""
+    import pickle
+
+    from cycloneml_tpu.analysis.incremental import (CACHE_VERSION,
+                                                    ParseCache,
+                                                    summary_schema)
+    src = tmp_path / "m.py"
+    src.write_text("import threading\n_lock = threading.Lock()\n")
+    cache_path = tmp_path / "cache.pkl"
+
+    c1 = ParseCache(str(cache_path))
+    assert c1.load_module(str(src), "m.py") is not None
+    assert (c1.hits, c1.misses) == (0, 1)
+    c1.save()
+
+    # same version + same schema: entries are served
+    c2 = ParseCache(str(cache_path))
+    assert c2.load_module(str(src), "m.py") is not None
+    assert (c2.hits, c2.misses) == (1, 0)
+
+    def rewrite(**patch):
+        with open(cache_path, "rb") as fh:
+            payload = pickle.load(fh)
+        payload.update(patch)
+        for k, v in list(patch.items()):
+            if v is None:
+                payload.pop(k, None)
+        with open(cache_path, "wb") as fh:
+            pickle.dump(payload, fh)
+
+    # a pre-v3 cache: old version field, no schema field
+    rewrite(version=2, schema=None)
+    c3 = ParseCache(str(cache_path))
+    assert c3.load_module(str(src), "m.py") is not None
+    assert (c3.hits, c3.misses) == (0, 1)   # fresh parse, nothing served
+
+    # version matches but the rule pack's dataflow schema differs (a
+    # future rule added/removed): likewise discarded
+    rewrite(version=CACHE_VERSION, schema="JX004,JX999")
+    c4 = ParseCache(str(cache_path))
+    assert c4.load_module(str(src), "m.py") is not None
+    assert (c4.hits, c4.misses) == (0, 1)
+
+    # sanity: the live schema names the concurrency analyses
+    assert {"JX011", "JX012", "JX013", "JX014"} <= set(
+        summary_schema().split(","))
