@@ -1,12 +1,13 @@
 # Developer entry points. Tier-1 CI runs `make lint` (graftlint gate,
 # also enforced by tests/test_graftlint.py) and `make test`.
 
-.PHONY: lint lint-fast lint-json lint-sarif test chaos obs-demo bench \
-	bench-bytes serve-demo
+.PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
+	bench bench-bytes serve-demo
 
-# the full interprocedural pass (JX001-JX014, concurrency rules
-# included); fails on any finding not grandfathered in baseline.json
-# (which a PR may shrink, never grow)
+# the full interprocedural pass (JX001-JX019, concurrency + abstract
+# shape/sharding rules included); fails on any finding not grandfathered
+# in baseline.json (which a PR may shrink, never grow). The tail line
+# prints the top-3 slowest rules so rule authors see their cost.
 lint:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
 	    --baseline cycloneml_tpu/analysis/baseline.json
@@ -26,6 +27,13 @@ lint-json:
 lint-sarif:
 	python -m cycloneml_tpu.analysis cycloneml_tpu \
 	    --baseline cycloneml_tpu/analysis/baseline.json --sarif
+
+# the CI job: full run, SARIF artifact at a stable path
+# (artifacts/graftlint.sarif; override GRAFTLINT_SARIF_OUT), parse cache
+# relocatable via CYCLONE_LINT_CACHE, nonzero exit on any unsuppressed
+# finding
+lint-ci:
+	bash scripts/ci_lint.sh
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

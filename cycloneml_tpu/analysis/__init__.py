@@ -20,6 +20,15 @@ package encodes those failure modes as enforced lint rules:
   ``cycloneml_tpu/mesh.py``.
 - **JX006** jitted function mutating ``self`` / ``global`` / ``nonlocal``
   state (the side effect runs once at trace time, then silently freezes).
+- **JX007–JX010** interprocedural dataflow rules (thread-dispatched SPMD
+  entry points, recompile hazards, use-after-donate, collectives under
+  host-divergent branches), **JX011–JX014** the compositional
+  concurrency pack (lockset races, lock-order cycles, obligation leaks,
+  blocking under locks), **JX015–JX018** the abstract shape & sharding
+  pack (:mod:`.shapes`: shard_map spec consistency, provable
+  shape/padding hazards, cross-mesh program reuse, O(n) host
+  materialization on fit paths), and **JX019** conf-key typo checking
+  against the ``conf.py`` registry.
 
 Rules fire only where they matter: a call-graph pass
 (:mod:`.reachability`) computes which functions are jit-reachable, seeded

@@ -20,8 +20,12 @@ Options:
                            CHECK/report only files changed per git
                            (worktree+index vs HEAD, plus BASE...HEAD when
                            a ref is given); paths default to cycloneml_tpu
-    --cache FILE           parse-cache pickle for --changed
-                           (default: .graftlint-cache.pkl)
+    --cache FILE           parse-cache pickle (default for --changed:
+                           .graftlint-cache.pkl; full runs use a cache
+                           only when --cache or CYCLONE_LINT_CACHE names
+                           one). The CYCLONE_LINT_CACHE env var relocates
+                           the cache — CI jobs point it at their restored
+                           cache directory
     --no-cache             disable the parse cache
     --rules JX001,JX003    run a subset of the rule pack
     --list-rules           print the rule pack and exit
@@ -100,6 +104,7 @@ def main(argv=None) -> int:
     else:
         rules = default_rules()
 
+    env_cache = os.environ.get("CYCLONE_LINT_CACHE") or None
     only_paths = None
     cache = None
     if args.changed is not None:
@@ -138,11 +143,18 @@ def main(argv=None) -> int:
                       "nothing to lint")
                 return 0
         if not args.no_cache:
-            cache = ParseCache(args.cache or DEFAULT_CACHE)
+            cache = ParseCache(args.cache or env_cache or DEFAULT_CACHE)
+    elif (args.cache or env_cache) and not args.no_cache:
+        # full-scope runs reuse the parse cache too when one is named —
+        # CI restores it across jobs via CYCLONE_LINT_CACHE
+        from cycloneml_tpu.analysis.incremental import ParseCache
+        cache = ParseCache(args.cache or env_cache)
 
+    timings: dict = {}
     findings = analyze_paths(
         paths, rules=rules, only_paths=only_paths,
-        module_loader=cache.load_module if cache is not None else None)
+        module_loader=cache.load_module if cache is not None else None,
+        timings=timings)
     if cache is not None:
         cache.save()
 
@@ -176,11 +188,12 @@ def main(argv=None) -> int:
     if args.as_sarif:
         out = render_sarif(findings, grandfathered)
     elif args.as_json:
-        out = render_json(findings, grandfathered)
+        out = render_json(findings, grandfathered, timings=timings)
     else:
         scanned = (len(only_paths) if only_paths is not None
                    else len(collect_files(paths)))
-        out = render_text(findings, grandfathered, scanned)
+        out = render_text(findings, grandfathered, scanned,
+                          timings=timings)
     print(out, end="" if (args.as_json or args.as_sarif) else "\n")
     return 1 if findings else 0
 

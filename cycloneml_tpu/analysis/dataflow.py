@@ -294,7 +294,8 @@ class DataflowResult:
 
 
 def run_dataflow(graph: CallGraph, clients: Sequence["object"],
-                 ctx) -> DataflowResult:
+                 ctx, timings: Optional[Dict[str, float]] = None
+                 ) -> DataflowResult:
     """Iterate every client's transfer function to a fixpoint.
 
     ``clients`` are :class:`DataflowRule` instances (duck-typed: need
@@ -311,8 +312,10 @@ def run_dataflow(graph: CallGraph, clients: Sequence["object"],
       (JX011's locks-held-at-entry), so the transfer reads the callers'
       facts and a change re-queues the function's CALLEES.
     """
+    import time as _time
     result = DataflowResult(graph)
     for client in clients:
+        t0 = _time.perf_counter()
         down = getattr(client, "direction", "up") == "down"
         facts: Dict[FunctionInfo, object] = {}
         for fn in graph.all_functions:
@@ -342,6 +345,10 @@ def run_dataflow(graph: CallGraph, clients: Sequence["object"],
                     queued.add(id(nxt))
                     work.append(nxt)
         result._summaries[client.analysis_id] = facts
+        if timings is not None:
+            timings[client.analysis_id] = (
+                timings.get(client.analysis_id, 0.0)
+                + _time.perf_counter() - t0)
     return result
 
 

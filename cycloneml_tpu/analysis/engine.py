@@ -57,6 +57,10 @@ class ModuleInfo:
     has_x64_guard: bool = False
 
 
+DEFAULT_AXIS_CONSTANTS = {"DATA_AXIS": "data", "REPLICA_AXIS": "replica",
+                          "MODEL_AXIS": "model"}
+
+
 @dataclass
 class AnalysisContext:
     """Cross-module state every rule receives."""
@@ -65,6 +69,9 @@ class AnalysisContext:
     valid_axes: Sequence[str] = DEFAULT_AXES
     # names of module-level constants that hold a valid axis name
     axis_constant_names: Set[str] = field(default_factory=set)
+    # constant name -> axis value (DATA_AXIS -> "data"): the abstract
+    # interpreter resolves P((REPLICA_AXIS, DATA_AXIS)) specs through it
+    axis_constants: Dict[str, str] = field(default_factory=dict)
     # interprocedural layer (set by analyze_paths): the resolved call
     # graph and the converged DataflowRule summaries
     callgraph: Optional[object] = None
@@ -73,9 +80,11 @@ class AnalysisContext:
 
 def _discover_axes(modules: Dict[str, ModuleInfo]):
     """Pull the declared mesh axis names out of ``mesh.py`` if it is part
-    of the analyzed set: module-level ``X_AXIS = "name"`` assignments."""
+    of the analyzed set: module-level ``X_AXIS = "name"`` assignments.
+    Returns (axis values, constant names, constant->value mapping)."""
     axes: List[str] = []
     names: Set[str] = set()
+    mapping: Dict[str, str] = {}
     for path, mod in modules.items():
         if os.path.basename(path) != "mesh.py":
             continue
@@ -87,8 +96,10 @@ def _discover_axes(modules: Dict[str, ModuleInfo]):
                     and isinstance(stmt.value.value, str)):
                 axes.append(stmt.value.value)
                 names.add(stmt.targets[0].id)
+                mapping[stmt.targets[0].id] = stmt.value.value
     return (tuple(axes) if axes else DEFAULT_AXES,
-            names or {"DATA_AXIS", "REPLICA_AXIS", "MODEL_AXIS"})
+            names or set(DEFAULT_AXIS_CONSTANTS),
+            mapping or dict(DEFAULT_AXIS_CONSTANTS))
 
 
 def load_module(path: str, rel: str) -> Optional[ModuleInfo]:
@@ -152,7 +163,9 @@ def _is_suppressed(mod: ModuleInfo, finding: Finding) -> bool:
 def analyze_paths(paths: Sequence[str], rules=None,
                   valid_axes: Optional[Sequence[str]] = None,
                   only_paths: Optional[Set[str]] = None,
-                  module_loader=None) -> List[Finding]:
+                  module_loader=None,
+                  timings: Optional[Dict[str, float]] = None
+                  ) -> List[Finding]:
     """Run the rule pack over ``paths`` (files or directories).
 
     Returns findings AFTER inline-suppression filtering, sorted by
@@ -170,7 +183,15 @@ def analyze_paths(paths: Sequence[str], rules=None,
     as strict as the full one. ``module_loader`` replaces
     :func:`load_module` (the parse cache hook); it must accept the same
     ``(path, rel)`` signature.
+
+    ``timings``, when a dict is passed, is filled with per-rule wall
+    time in seconds: one entry per rule id (its ``check()`` over every
+    module, plus its dataflow fixpoint when it owns one) and one entry
+    per SHARED dataflow analysis (``JXSHAPE``, the abstract shape
+    domain serving JX015–JX018) — rule authors see their cost on every
+    ``--json`` run.
     """
+    import time as _time
     if rules is None:
         from cycloneml_tpu.analysis.rules import default_rules
         rules = default_rules()
@@ -188,16 +209,26 @@ def analyze_paths(paths: Sequence[str], rules=None,
     compute_reachability(modules, resolver)
     graph = CallGraph(modules, resolver)
 
-    axes, axis_names = _discover_axes(modules)
+    axes, axis_names, axis_map = _discover_axes(modules)
     ctx = AnalysisContext(
         modules=modules,
         valid_axes=tuple(valid_axes) if valid_axes is not None else axes,
         axis_constant_names=axis_names,
+        axis_constants=axis_map,
         callgraph=graph)
 
     from cycloneml_tpu.analysis.rules.base import DataflowRule
-    ctx.dataflow = run_dataflow(
-        graph, [r for r in rules if isinstance(r, DataflowRule)], ctx)
+    # rules may SHARE one dataflow analysis (the JX015-018 shape rules
+    # all read the JXSHAPE summaries) — dedupe by analysis_id so the
+    # shared fixpoint runs once, not once per rule
+    clients, seen_ids = [], set()
+    for r in rules:
+        if isinstance(r, DataflowRule) and r.analysis_id not in seen_ids:
+            seen_ids.add(r.analysis_id)
+            clients.append(r)
+    dataflow_timings: Dict[str, float] = {}
+    ctx.dataflow = run_dataflow(graph, clients, ctx,
+                                timings=dataflow_timings)
 
     check_paths: Optional[Set[str]] = None
     if only_paths is not None:
@@ -217,13 +248,35 @@ def analyze_paths(paths: Sequence[str], rules=None,
                 work.append(caller)
 
     findings: List[Finding] = []
+    rule_seconds: Dict[str, float] = {r.rule_id: 0.0 for r in rules}
     for mod in modules.values():
         if check_paths is not None and mod.path not in check_paths:
             continue
         for rule in rules:
+            credit0 = dict(getattr(ctx, "shared_time_credit", None) or {})
+            t0 = _time.perf_counter()
             for finding in rule.check(mod, ctx):
                 if _is_suppressed(mod, finding):
                     continue
                 findings.append(finding)
+            elapsed = _time.perf_counter() - t0
+            # shared lazily-built analyses (the JXSHAPE check-time
+            # interpretation) record what they cost inside a check via
+            # ctx.shared_time_credit — re-attribute that to the shared
+            # analysis, not to whichever rule happened to touch the
+            # cache first
+            credit1 = getattr(ctx, "shared_time_credit", None) or {}
+            for key, total in credit1.items():
+                delta = total - credit0.get(key, 0.0)
+                if delta > 0:
+                    rule_seconds[key] = rule_seconds.get(key, 0.0) + delta
+                    elapsed -= delta
+            rule_seconds[rule.rule_id] += max(elapsed, 0.0)
+    if timings is not None:
+        # a rule that owns its analysis folds the fixpoint into its
+        # total; shared analyses (JXSHAPE) get their own entry
+        for aid, secs in dataflow_timings.items():
+            rule_seconds[aid] = rule_seconds.get(aid, 0.0) + secs
+        timings.update({k: round(v, 4) for k, v in rule_seconds.items()})
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

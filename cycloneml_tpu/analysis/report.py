@@ -21,10 +21,19 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 SARIF_VERSION = "2.1.0"
 
 
+def _sorted(findings: List[Finding]) -> List[Finding]:
+    """Deterministic report order: (path, line, rule) first — pinned so
+    CI diffs and SARIF fingerprint ordering never churn on unrelated
+    edits — with col/function/message breaking any remaining ties."""
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.col,
+                                           f.function, f.message))
+
+
 def render_text(findings: List[Finding], grandfathered: int = 0,
-                total_files: Optional[int] = None) -> str:
+                total_files: Optional[int] = None,
+                timings: Optional[dict] = None) -> str:
     lines = []
-    for f in findings:
+    for f in _sorted(findings):
         where = f"  [{f.function}]" if f.function else ""
         lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}{where}")
     by_rule = collections.Counter(f.rule for f in findings)
@@ -37,15 +46,24 @@ def render_text(findings: List[Finding], grandfathered: int = 0,
     if total_files is not None:
         tail += f"; {total_files} file(s) scanned"
     lines.append(tail)
+    if timings:
+        top = sorted(timings.items(), key=lambda kv: -kv[1])[:3]
+        lines.append("slowest rules: " + " · ".join(
+            f"{rid} {secs:.2f}s" for rid, secs in top))
     return "\n".join(lines)
 
 
-def render_json(findings: List[Finding], grandfathered: int = 0) -> str:
-    return json.dumps(
-        {"findings": [f.to_dict() for f in findings],
-         "grandfathered": grandfathered,
-         "count": len(findings)},
-        indent=2, sort_keys=True) + "\n"
+def render_json(findings: List[Finding], grandfathered: int = 0,
+                timings: Optional[dict] = None) -> str:
+    payload = {"findings": [f.to_dict() for f in _sorted(findings)],
+               "grandfathered": grandfathered,
+               "count": len(findings)}
+    if timings is not None:
+        # per-rule wall time (seconds): check() over every module plus
+        # the rule's dataflow fixpoint; shared analyses (JXSHAPE) get
+        # their own entry
+        payload["timings"] = dict(timings)
+    return json.dumps(payload, indent=2, sort_keys=True) + "\n"
 
 
 def _rule_descriptions() -> List[dict]:
@@ -67,7 +85,7 @@ def _rule_descriptions() -> List[dict]:
 
 def render_sarif(findings: List[Finding], grandfathered: int = 0) -> str:
     results = []
-    for f in findings:
+    for f in _sorted(findings):
         results.append({
             "ruleId": f.rule,
             "level": "error",

@@ -30,12 +30,20 @@ from cycloneml_tpu.analysis.rules.jx013_obligation_leak import \
     ObligationLeakRule
 from cycloneml_tpu.analysis.rules.jx014_blocking_under_lock import \
     BlockingUnderLockRule
+from cycloneml_tpu.analysis.rules.jx015_sharding_spec import ShardingSpecRule
+from cycloneml_tpu.analysis.rules.jx016_shape_padding import ShapePaddingRule
+from cycloneml_tpu.analysis.rules.jx017_cross_mesh import CrossMeshReuseRule
+from cycloneml_tpu.analysis.rules.jx018_host_materialize import \
+    HostMaterializeRule
+from cycloneml_tpu.analysis.rules.jx019_conf_keys import ConfKeyRule
 
 ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
              FP64DriftRule, CollectiveAxisRule, JitMutationRule,
              ThreadDispatchRule, RecompileHazardRule, UseAfterDonateRule,
              CollectiveDivergenceRule, LocksetRaceRule, LockOrderRule,
-             ObligationLeakRule, BlockingUnderLockRule)
+             ObligationLeakRule, BlockingUnderLockRule, ShardingSpecRule,
+             ShapePaddingRule, CrossMeshReuseRule, HostMaterializeRule,
+             ConfKeyRule)
 
 
 def default_rules():

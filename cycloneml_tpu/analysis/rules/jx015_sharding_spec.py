@@ -1,0 +1,210 @@
+"""JX015 — sharding-spec consistency at the shard_map/pjit boundary.
+
+The whole SPMD contract of this repo funnels through a handful of
+``shard_map`` bindings (``collectives.shard_map_compat``): the in/out
+``PartitionSpec``\\ s are the *declared* sharding of every operand and
+result, and nothing at trace time checks them against what the body
+actually computes (``check_vma``/``check_rep`` is explicitly disabled
+for jax<0.5 compat). GSPMD treats sharding as a propagatable dataflow
+fact; this rule propagates it statically and flags the four
+inconsistency classes that turn into silent wrong numbers or
+downstream reshard chaos:
+
+* **unknown axis** — a spec naming a mesh axis that the binding mesh
+  does not declare (``P("batch")`` against the ``(replica, data,
+  model)`` mesh of ``mesh.py``); axis names are discovered from the
+  analyzed ``mesh.py``, the same source JX005 validates collectives
+  against.
+* **duplicate axis** — one mesh axis bound to two different tensor
+  dims in a single spec (``P("data", "data")``): each mesh axis can
+  partition at most one dim.
+* **rank overflow** — an in_spec with more partitioned entries than
+  the operand's abstract rank (a ``P("data", None)`` spec applied to a
+  1-D operand), caught when the shard_map result is applied directly
+  and the operand's rank is known to the abstract interpreter.
+* **out_spec claims a reduced axis** — the body ``psum``\\ s a value
+  over an axis (making it replicated over that axis *by construction*)
+  but the out_spec still claims the axis partitions the result. With
+  replication checking off, XLA emits whatever the spec says — each
+  shard keeps a full copy and downstream consumers read sharded
+  garbage. The body's psummed-axes fact is the JXSHAPE ``ret_psummed``
+  summary, so a body that reduces through a helper
+  (``_reduce -> psum_over_mesh``) is still seen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from cycloneml_tpu.analysis import shapes
+from cycloneml_tpu.analysis.astutil import call_name, last_component
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.shapes import (AArray, ShapeRuleBase, SpecVal,
+                                           TupleVal, UNKNOWN_ENTRY,
+                                           resolve_spec, iter_spec_literals,
+                                           summary_of)
+
+
+class ShardingSpecRule(ShapeRuleBase, DataflowRule):
+    rule_id = "JX015"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        consts = getattr(ctx, "axis_constants", {}) or {}
+        valid = set(ctx.valid_axes)
+        facts = self.facts(ctx)
+        for fn in mod.functions:
+            state = self.state_of(ctx, fn)
+            if state is None:
+                continue
+            flagged_specs: Set[int] = set()
+
+            # 1/2: internal validity of EVERY P(...) literal in the body
+            for call in graph.index(fn).calls:
+                base = last_component(call_name(call) or "")
+                if base not in ("P", "PartitionSpec"):
+                    continue
+                spec = shapes.parse_spec(call, consts)
+                yield from self._validate_spec(mod, fn, spec, valid,
+                                               flagged_specs)
+
+            apply_by_inner = {
+                id(ev.payload["inner"]): ev
+                for ev in state.events if ev.kind == "shard_apply"}
+            for ev in state.events:
+                if ev.kind != "shard_map":
+                    continue
+                in_expr = ev.payload.get("in_specs")
+                out_expr = ev.payload.get("out_specs")
+                # specs reachable only through bound names still get
+                # internal validation
+                for expr in (in_expr, out_expr):
+                    for spec in iter_spec_literals(expr, state.env, consts):
+                        yield from self._validate_spec(
+                            mod, fn, spec, valid, flagged_specs)
+
+                # 3: in_spec rank vs the applied operands' abstract rank
+                applied = apply_by_inner.get(id(ev.node))
+                if applied is not None and not applied.payload["has_star"]:
+                    in_val = resolve_spec(in_expr, state.env, consts)
+                    yield from self._check_ranks(
+                        mod, fn, applied, in_val)
+
+                # 4: out_spec claiming an axis the body psummed away
+                yield from self._check_out_psummed(
+                    mod, fn, ev, out_expr, state, consts, graph, facts)
+
+    # -- spec internal validity ----------------------------------------------
+    def _validate_spec(self, mod, fn, spec: SpecVal, valid,
+                       flagged: Set[int]):
+        if spec.node is None or id(spec.node) in flagged:
+            return
+        seen_axes: Set[str] = set()
+        for entry in spec.entries:
+            if not isinstance(entry, frozenset):
+                continue
+            for axis in sorted(entry):
+                if axis not in valid:
+                    flagged.add(id(spec.node))
+                    yield self.finding(
+                        mod, spec.node,
+                        f"PartitionSpec names mesh axis '{axis}' which the "
+                        f"mesh does not declare (axes: "
+                        f"{', '.join(sorted(valid))}) — the spec silently "
+                        f"partitions nothing (or raises at dispatch on "
+                        f"newer jax); use a declared axis",
+                        fn.qualname)
+                elif axis in seen_axes:
+                    flagged.add(id(spec.node))
+                    yield self.finding(
+                        mod, spec.node,
+                        f"PartitionSpec binds mesh axis '{axis}' to two "
+                        f"different tensor dims — one mesh axis can "
+                        f"partition at most one dim; use a different axis "
+                        f"or merge the dims",
+                        fn.qualname)
+            seen_axes |= {a for a in entry}
+
+    # -- rank alignment -------------------------------------------------------
+    def _check_ranks(self, mod, fn, applied, in_val):
+        arg_avals = applied.payload["arg_avals"]
+        pairs = []
+        if isinstance(in_val, TupleVal):
+            if len(in_val.items) == len(arg_avals):
+                pairs = list(zip(in_val.items, arg_avals, range(
+                    len(arg_avals))))
+        elif isinstance(in_val, SpecVal):
+            pairs = [(in_val, a, i) for i, a in enumerate(arg_avals)]
+        for spec, aval, pos in pairs:
+            if not isinstance(spec, SpecVal) \
+                    or not isinstance(aval, AArray):
+                continue
+            rank = aval.rank()
+            if not isinstance(rank, int):
+                continue
+            entries = [e for e in spec.entries if e is not UNKNOWN_ENTRY]
+            if len(spec.entries) != len(entries):
+                continue
+            if len(entries) > rank:
+                yield self.finding(
+                    mod, applied.node,
+                    f"in_spec for operand {pos} declares "
+                    f"{len(entries)} partitioned dim(s) but the operand's "
+                    f"abstract rank is {rank} — the spec cannot bind; "
+                    f"align the spec with the operand's shape",
+                    fn.qualname)
+
+    # -- out_spec vs psummed return -------------------------------------------
+    def _check_out_psummed(self, mod, fn, ev, out_expr, state, consts,
+                           graph, facts):
+        body = ev.payload.get("body")
+        if not isinstance(body, ast.Name):
+            return
+        targets = graph.resolver.resolve(fn, body.id)
+        if not targets:
+            return
+        # ambiguous body resolution (multiple candidates) counts only
+        # when every candidate agrees — a conflict stays a conflict no
+        # matter how many more targets follow
+        psummed = None
+        for t in targets:
+            vec = summary_of(facts, t).ret_psummed
+            if psummed is None:
+                psummed = vec
+            elif psummed != vec:
+                return
+        if psummed is None:
+            return
+        out_val = resolve_spec(out_expr, state.env, consts)
+        if isinstance(out_val, SpecVal):
+            out_vec = (out_val,)
+        elif isinstance(out_val, TupleVal) and all(
+                isinstance(i, SpecVal) for i in out_val.items):
+            out_vec = out_val.items
+        else:
+            return
+        if len(out_vec) != len(psummed):
+            if len(out_vec) == 1 and len(psummed) > 1:
+                # single spec broadcast over a tuple return
+                psummed = (frozenset.intersection(*psummed),)
+            else:
+                return
+        for i, (spec, axes) in enumerate(zip(out_vec, psummed)):
+            claimed = sorted(spec.axes() & axes)
+            if claimed:
+                which = f" (output {i})" if len(out_vec) > 1 else ""
+                yield self.finding(
+                    mod, ev.node,
+                    f"out_spec claims axis "
+                    f"{', '.join(repr(a) for a in claimed)} partitions the "
+                    f"result{which}, but the body already psum-reduced the "
+                    f"value over that axis — it is replicated by "
+                    f"construction, and with replication checking disabled "
+                    f"the spec silently re-declares it sharded; use a "
+                    f"replicated out_spec (P()) for reduced outputs",
+                    fn.qualname)

@@ -1,0 +1,89 @@
+"""JX016 — shape and padding hazards: provable dim conflicts, unmasked
+reductions over padded dims.
+
+Two hazard classes, both invisible at the callsite and both currently
+pinned only by tests:
+
+**Provable shape mismatches.** The abstract interpreter carries symbolic
+and concrete dims through constructors, broadcasting, and matmul; when
+two *concrete* dims provably conflict (``jnp.zeros((4, d)) +
+jnp.zeros((8, d))``, a matmul whose inner dims are unequal ints) the
+program either fails at trace time deep inside a dispatch stack — far
+from the line that built the wrong buffer — or, worse, broadcasts a
+``1`` where a real dim was meant. Only provable conflicts are reported:
+two distinct *symbols* may be equal at runtime and stay silent.
+
+**Unmasked mean over a padded dim.** The repo pads everywhere rows meet
+a fixed program shape: serving buckets pad request batches up to the
+power-of-two bucket, ``deviceChunk`` pads the last L-BFGS chunk,
+``blockify_arrays`` pads blocks to multiples. The invariant that makes
+padding bitwise-neutral is that every reduction over the padded dim is
+*masked* (weighted sums with w=0 pads, sum/count with explicit counts)
+— a raw ``jnp.mean(x, axis=0)`` divides by the padded row count and
+silently shifts every statistic. The interpreter marks dims padded at
+``jnp.pad``/``np.pad``, the ``buf = np.zeros((bucket, d)); buf[:k] =
+rows`` store idiom, and ``.at[:k].set(rows)``; slicing the dim back
+down (``buf[:k]``) clears the mark. The check is interprocedural: a
+kernel whose summary says "takes an unmasked mean over param 2's dim 0"
+convicts the *caller* that passes a padded buffer, which is where the
+fix belongs (mask the kernel or pass the true count).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Set
+
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.shapes import AArray, ShapeRuleBase
+
+
+class ShapePaddingRule(ShapeRuleBase, DataflowRule):
+    rule_id = "JX016"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        if ctx.callgraph is None:
+            return
+        for fn in mod.functions:
+            state = self.state_of(ctx, fn)
+            if state is None:
+                continue
+            reported: Set[tuple] = set()
+            for ev in state.events:
+                if ev.kind == "mismatch":
+                    key = ("mismatch", id(ev.node), ev.detail)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield self.finding(
+                        mod, ev.node,
+                        f"provable shape mismatch: {ev.detail} — this "
+                        f"either fails at trace time deep inside the "
+                        f"dispatch stack or broadcasts a 1 where a real "
+                        f"dim was meant; fix the operand shapes here",
+                        fn.qualname)
+                elif ev.kind == "mean":
+                    aval = ev.aval
+                    if not isinstance(aval, AArray) or not aval.padded:
+                        continue
+                    axes = ev.axes or frozenset()
+                    hit = sorted(aval.padded) if not axes else sorted(
+                        aval.padded & {a for a in axes
+                                       if isinstance(a, int) and a >= 0})
+                    if not hit:
+                        continue
+                    key = ("mean", id(ev.node))
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    via = f" ({ev.detail})" if ev.detail.startswith("via") \
+                        else ""
+                    yield self.finding(
+                        mod, ev.node,
+                        f"unmasked mean over padded dim "
+                        f"{', '.join(map(str, hit))}{via} — the divisor "
+                        f"counts the zero pad rows, silently shifting the "
+                        f"statistic; mask the reduction (weighted sum / "
+                        f"explicit count) or slice the padding off first",
+                        fn.qualname)

@@ -1,0 +1,246 @@
+"""JX017 — SPMD program reused across a mesh rebuild.
+
+Every compiled program in this repo closes over the mesh it was built
+under: ``tree_aggregate`` keys its cache on ``runtime.mesh``,
+``shard_map`` bakes the device assignment into the executable, and the
+serving layer AOT-warms bucket programs against the registration-time
+mesh. When ``MeshSupervisor`` rebuilds after device loss (or elastic
+scheduling resizes the mesh, ROADMAP item 5), every one of those
+programs is stale — dispatching one either crashes on dead devices or
+silently runs on the OLD device set. ``clear_program_cache`` exists
+precisely to prevent this — but it only empties the *caches*; a local
+or field that still **holds** a program object keeps dispatching it.
+This rule checks the invariant statically.
+
+The abstract fact is a **mesh-identity token**: an epoch counter that
+advances at every rebuild event (``mesh.reset()``, ``rebuild_mesh``,
+or a call into a helper whose JXSHAPE summary says it transitively
+rebuilds — ``MeshSupervisor.recover`` counts through any number of
+hops). A name bound to a program (a ``tree_aggregate``/``shard_map``
+builder call, or a call into a helper whose summary says it *returns*
+a program) carries the epoch at its build; dispatching it under a
+later epoch is the finding. The check is interprocedural on both
+sides: the program may be built in a helper and the rebuild buried in
+another, with the conviction landing in the caller that holds the
+stale reference.
+
+Loop bodies are walked twice, so the second-iteration hazard —
+program built before the loop, a recovery path inside it — is caught
+even though the dispatch textually precedes the rebuild.
+
+The sanctioned idiom stays silent: clear the cache, rebuild the mesh,
+then REBUILD the program before dispatching (``MeshSupervisor.recover``
+does exactly this) — a binding re-established after the rebuild
+carries the current epoch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from cycloneml_tpu.analysis.astutil import (call_name, dotted_name,
+                                            last_component)
+from cycloneml_tpu.analysis.dataflow import assign_targets
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.shapes import (PROGRAM_BUILDERS, REBUILD_DOTTED,
+                                           REBUILD_LAST, ShapeRuleBase,
+                                           summary_of)
+
+
+class CrossMeshReuseRule(ShapeRuleBase, DataflowRule):
+    rule_id = "JX017"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        facts = self.facts(ctx)
+        for fn in mod.functions:
+            walker = _EpochWalker(fn, graph, facts)
+            walker.run()
+            for node, name in walker.findings:
+                yield self.finding(
+                    mod, node,
+                    f"program `{name}` was built under a previous mesh "
+                    f"and is dispatched after a mesh rebuild — compiled "
+                    f"programs close over their mesh's device assignment, "
+                    f"so this runs on dead/old devices; rebuild the "
+                    f"program after the rebuild (clear_program_cache + "
+                    f"re-invoke the builder), the MeshSupervisor.recover "
+                    f"idiom",
+                    fn.qualname)
+
+
+class _EpochWalker:
+    """Source-order mesh-epoch tracking over one function's own body."""
+
+    def __init__(self, fn, graph, facts):
+        self.fn = fn
+        self.graph = graph
+        self.facts = facts
+        self.sites = graph.sites_map(fn)
+        self.epoch = 0
+        self.bindings: Dict[str, int] = {}   # name / "self.x" -> build epoch
+        self.findings: List[tuple] = []
+        self._seen: Set[int] = set()
+
+    def run(self):
+        self._walk(getattr(self.fn.node, "body", []))
+
+    # -- call classification --------------------------------------------------
+    def _call_rebuilds(self, call: ast.Call) -> bool:
+        name = call_name(call) or ""
+        base = last_component(name) or ""
+        if base in REBUILD_LAST or name in REBUILD_DOTTED:
+            return True
+        if name.endswith(".reset") and "mesh" in name.split(".")[0].lower():
+            return True
+        site = self.sites.get(id(call))
+        if site is not None:
+            return any(summary_of(self.facts, t).rebuilds
+                       for t in site.targets)
+        return False
+
+    def _call_builds(self, call: ast.Call) -> bool:
+        base = last_component(call_name(call) or "") or ""
+        if base in PROGRAM_BUILDERS:
+            return True
+        site = self.sites.get(id(call))
+        if site is not None:
+            return any(summary_of(self.facts, t).returns_program
+                       for t in site.targets)
+        return False
+
+    # -- walking --------------------------------------------------------------
+    def _walk(self, stmts):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _scan_calls(self, expr: ast.AST):
+        """Visit every call inside an expression in source order:
+        dispatches of tracked bindings are checked, rebuild events
+        advance the epoch."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._binding_name(node.func)
+            if target is not None and target in self.bindings:
+                if self.bindings[target] < self.epoch \
+                        and id(node) not in self._seen:
+                    self._seen.add(id(node))
+                    self.findings.append((node, target))
+            if self._call_rebuilds(node):
+                self.epoch += 1
+
+    @staticmethod
+    def _binding_name(func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return func.id
+        name = dotted_name(func)
+        if name is not None and name.startswith("self.") \
+                and name.count(".") == 1:
+            return name
+        return None
+
+    def _stmt(self, stmt: ast.AST):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = getattr(stmt, "value", None)
+            if value is None:
+                return
+            self._scan_calls(value)
+            builds = isinstance(value, ast.Call) and self._call_builds(value)
+            for target in assign_targets(stmt):
+                name = self._target_name(target)
+                if name is None:
+                    continue
+                if builds:
+                    self.bindings[name] = self.epoch
+                else:
+                    self.bindings.pop(name, None)
+        elif isinstance(stmt, ast.AugAssign):
+            self._scan_calls(stmt.value)
+        elif isinstance(stmt, (ast.Expr, ast.Return, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_calls(child)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_calls(stmt.iter)
+            # twice: a rebuild late in the body precedes the next
+            # iteration's dispatch
+            self._walk(stmt.body)
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._scan_calls(stmt.test)
+            self._walk(stmt.body)
+            self._scan_calls(stmt.test)
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            # the branches are EXCLUSIVE: a rebuild in the then-branch
+            # must not convict a dispatch in the else-branch (the
+            # `if mesh_dead: recover() else: dispatch` supervisor
+            # shape). Code AFTER the If merges the max epoch of the
+            # arms that can FALL THROUGH — a branch that returns/raises
+            # never reaches the code below, so its rebuild does not
+            # either (`if dead: recover(); return` then dispatch).
+            self._scan_calls(stmt.test)
+            before = self.epoch
+            self._walk(stmt.body)
+            after_body = self.epoch
+            self.epoch = before
+            self._walk(stmt.orelse)
+            after_orelse = self.epoch
+            merged = before
+            if not _terminates(stmt.body):
+                merged = max(merged, after_body)
+            if not (stmt.orelse and _terminates(stmt.orelse)):
+                merged = max(merged, after_orelse)
+            self.epoch = merged
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_calls(item.context_expr)
+            self._walk(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._walk(stmt.body)
+            for h in stmt.handlers:
+                self._walk(h.body)
+            self._walk(stmt.orelse)
+            self._walk(stmt.finalbody)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                name = self._target_name(t)
+                if name is not None:
+                    self.bindings.pop(name, None)
+
+    @staticmethod
+    def _target_name(target: ast.AST) -> Optional[str]:
+        return _target_name(target)
+
+
+def _terminates(stmts) -> bool:
+    """Does this block definitely NOT fall through (ends in
+    return/raise/continue/break on every path)?"""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+            return True
+        if isinstance(s, ast.If) and s.orelse and _terminates(s.body) \
+                and _terminates(s.orelse):
+            return True
+    return False
+
+
+def _target_name(target: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Name):
+        return target.id
+    name = dotted_name(target)
+    if name is not None and name.startswith("self.") \
+            and name.count(".") == 1:
+        return name
+    return None

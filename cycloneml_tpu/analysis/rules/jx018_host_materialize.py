@@ -1,0 +1,91 @@
+"""JX018 — unbounded host materialization of dataset-sized arrays on a
+fit path.
+
+The scale contract behind out-of-core training (ROADMAP item 2) is that
+the *fit path* never materializes O(n) data on the host: the design
+matrix streams/shards onto the mesh, aggregation reduces it to O(d)
+stats, and only those stats cross back. One ``np.asarray(resid)`` of an
+``(n,)`` residual vector in a fit driver silently reintroduces the
+ceiling — it works in every test (n is small), then OOMs the host the
+first time a dataset exceeds RAM, which is exactly the regime the
+streaming engine exists for.
+
+The abstract interpreter tracks the **dataset dim** ``n`` symbolically:
+
+* dims of arrays passed as the row-sharded operands of
+  ``tree_aggregate``/``tree_aggregate_with_state`` (the row-sharded dim
+  *is* the dataset dim, by construction of the dispatch boundary), and
+* ``.shape`` unpacks binding a conventional row-count name (``n``,
+  ``n_rows``, ``num_rows``, ``n_samples``, ``n_pad``) in the leading
+  position.
+
+A **host materializer** — ``jax.device_get``, ``np.asarray`` /
+``np.array`` (``jnp.asarray`` is device-side and exempt), ``.tolist()``
+— whose operand's abstract shape contains a dataset dim (or is a
+dataset-sharded operand itself, shape-preserved) is flagged, but only
+in functions on the **fit path**: the JXSHAPE summary's transitive
+``reaches_aggregate`` fact, or a ``fit``/``train`` qualname. Predict
+and transform drivers returning n-sized results to the caller are the
+API contract and stay silent; O(d)/O(K) pulls of coefficients and stats
+stay silent (their shapes don't contain ``n``).
+
+Interprocedural through ``materializes_params``: a helper that hands
+its parameter to ``np.asarray`` convicts the fit-path caller passing an
+n-sized array two hops up.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Set
+
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.shapes import AArray, ShapeRuleBase, summary_of
+
+FIT_NAME_TOKENS = ("fit", "train")
+
+
+class HostMaterializeRule(ShapeRuleBase, DataflowRule):
+    rule_id = "JX018"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        if ctx.callgraph is None:
+            return
+        facts = self.facts(ctx)
+        for fn in mod.functions:
+            summary = summary_of(facts, fn)
+            lowq = fn.qualname.lower()
+            on_fit_path = summary.reaches_aggregate or any(
+                tok in lowq for tok in FIT_NAME_TOKENS)
+            if not on_fit_path:
+                continue
+            state = self.state_of(ctx, fn)
+            if state is None or not (state.dataset_syms
+                                     or state.dataset_roots):
+                continue
+            reported: Set[int] = set()
+            for ev in state.events:
+                if ev.kind != "materialize":
+                    continue
+                aval = ev.aval
+                if not isinstance(aval, AArray):
+                    continue
+                n_hit = aval.dims_contained() & state.dataset_syms
+                root_hit = aval.roots & state.dataset_roots
+                if not n_hit and not root_hit:
+                    continue
+                if id(ev.node) in reported:
+                    continue
+                reported.add(id(ev.node))
+                what = ev.detail or "host materializer"
+                dim = next(iter(sorted(
+                    (s.label for s in n_hit)))) if n_hit else "n"
+                yield self.finding(
+                    mod, ev.node,
+                    f"`{what}` materializes an array whose shape contains "
+                    f"the dataset dim `{dim}` on a fit path — this is "
+                    f"O(n) host memory and reintroduces the scale ceiling "
+                    f"out-of-core training removes; keep the value on "
+                    f"device, reduce it first, or stream it in chunks",
+                    fn.qualname)

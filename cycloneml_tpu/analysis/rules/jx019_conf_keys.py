@@ -1,0 +1,152 @@
+"""JX019 — `cyclone.*` conf-key literals validated against the registry.
+
+``CycloneConf.get`` falls back to the registered default for any key it
+does not recognize — so a typo'd ``conf.set("cyclone.serving.windwMs",
+5)`` / ``conf.get("cyclone.serving.windwMs")`` silently configures
+nothing and silently reads the default. Every real key is registered
+exactly once through ``ConfigBuilder("cyclone....")`` (conf.py's
+centralized registry, plus ``with_alternative`` legacy spellings); this
+rule collects that registry from the analyzed file set and validates
+every key-shaped string literal against it.
+
+A literal is key-shaped when it fullmatches ``cyclone.seg(.seg)*`` —
+prose mentioning a key inside a doc/error string never fullmatches, and
+f-string fragments are not literals. Two exemptions keep the rule
+quiet on legitimate dynamic use:
+
+* the registration sites themselves (``ConfigBuilder(...)`` /
+  ``.with_alternative(...)`` arguments ARE the registry), and
+* literals that are a strict PREFIX of a registered key
+  (``key.startswith("cyclone.sql.")`` namespace checks).
+
+When no registry is visible in the analyzed set the rule stays silent
+— there is nothing to validate against.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Optional, Set
+
+from cycloneml_tpu.analysis.astutil import (call_name, iter_own_statements,
+                                            last_component)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import Rule
+
+KEY_RE = re.compile(r"cyclone\.[A-Za-z0-9_]+(\.[A-Za-z0-9_]+)*")
+
+
+class ConfKeyRule(Rule):
+    rule_id = "JX019"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        keys = _registered_keys(ctx)
+        if not keys:
+            return
+        candidates = [node for node in ast.walk(mod.tree)
+                      if isinstance(node, ast.Constant)
+                      and isinstance(node.value, str)
+                      and KEY_RE.fullmatch(node.value)
+                      and node.value not in keys]
+        if not candidates:
+            return
+        registration_args = _registration_arg_ids(mod)
+        owner = _constant_owners(mod)
+        for node in candidates:
+            value = node.value
+            if id(node) in registration_args:
+                continue
+            if any(k.startswith(value) for k in keys):
+                # namespace-prefix use (`key.startswith("cyclone.sql.")`)
+                continue
+            close = _closest(value, keys)
+            hint = f"; did you mean '{close}'?" if close else ""
+            yield self.finding(
+                mod, node,
+                f"'{value}' is not a registered conf key — CycloneConf "
+                f"silently takes the default for unknown keys, so a typo "
+                f"configures nothing{hint} (registry: conf.py "
+                f"ConfigBuilder entries)",
+                owner.get(id(node), ""))
+
+
+def _registered_keys(ctx: AnalysisContext) -> Set[str]:
+    """Keys registered anywhere in the analyzed set (cached per ctx)."""
+    cached = getattr(ctx, "_conf_keys", None)
+    if cached is not None and getattr(ctx, "_conf_keys_ctx", None) is ctx:
+        return cached
+    keys: Set[str] = set()
+    for mod in ctx.modules.values():
+        # cheap text gate before the tree walk: registries are rare
+        if not any("ConfigBuilder" in ln for ln in mod.source_lines):
+            continue
+        for node in ast.walk(mod.tree):
+            key = _registration_key(node)
+            if key is not None:
+                keys.add(key)
+    ctx._conf_keys = keys
+    ctx._conf_keys_ctx = ctx
+    return keys
+
+
+def _registration_key(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    base = last_component(call_name(node) or "")
+    if not base and isinstance(node.func, ast.Attribute):
+        # `.with_alternative(...)` chained onto a ConfigBuilder CALL has
+        # no resolvable dotted name — the attr is still the dispatch key
+        base = node.func.attr
+    if base not in ("ConfigBuilder", "with_alternative"):
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _registration_arg_ids(mod: ModuleInfo) -> Set[int]:
+    out: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        if _registration_key(node) is not None:
+            out.add(id(node.args[0]))
+    return out
+
+
+def _constant_owners(mod: ModuleInfo) -> Dict[int, str]:
+    """id(Constant) -> enclosing function qualname for finding
+    attribution."""
+    out: Dict[int, str] = {}
+    for fn in mod.functions:
+        for node in iter_own_statements(fn.node):
+            if isinstance(node, ast.Constant):
+                out[id(node)] = fn.qualname
+    return out
+
+
+def _closest(value: str, keys: Set[str]) -> Optional[str]:
+    """The registered key with the same segment count and the smallest
+    per-segment mismatch — a cheap typo suggestion, no quadratic edit
+    distance."""
+    segs = value.split(".")
+    best, best_score = None, 0.0
+    for key in keys:
+        ks = key.split(".")
+        if len(ks) != len(segs):
+            continue
+        same = sum(1 for a, b in zip(segs, ks) if a == b)
+        if same < len(segs) - 1:
+            continue
+        # one differing segment: score by shared prefix length
+        diff = next((i for i, (a, b) in enumerate(zip(segs, ks))
+                     if a != b), None)
+        if diff is None:
+            continue
+        a, b = segs[diff], ks[diff]
+        prefix = len([1 for x, y in zip(a, b) if x == y])
+        score = same + prefix / max(len(a), len(b), 1)
+        if score > best_score:
+            best, best_score = key, score
+    return best

@@ -1,0 +1,1378 @@
+"""Abstract shape & sharding interpretation — the SPMD array-fact domain.
+
+GSPMD (Xu et al., PAPERS.md) treats sharding as a *propagatable dataflow
+fact*; Cousot-style abstract interpretation is the classic machinery for
+propagating such facts soundly. This module is that machinery for
+graftlint: a small abstract domain of array facts evaluated over each
+function body in source order, summarized per function, and propagated
+through the PR-7 call graph by the dataflow engine. Four rules consume
+it (JX015 sharding-spec consistency, JX016 shape/padding hazards, JX017
+cross-mesh program reuse, JX018 unbounded host materialization).
+
+The domain — one :class:`AArray` per abstract value:
+
+* **symbolic dims** (:class:`Sym`): sizes read off ``.shape`` become
+  interned symbols (``n, d = x.shape`` names ``x``'s dims), concrete
+  ints stay concrete, anything else is ``TOP``. Equality of symbols is
+  identity — two reads of the same array's axis 0 agree, two different
+  arrays' dims never do (sound for mismatch detection: only *provable*
+  conflicts — unequal concrete ints — are reported).
+* **dtype tier**: ``narrow`` (bf16/f16 storage) / ``accum`` (fp32/f64)
+  / TOP, reusing JX004's classification of cast targets. The tier rides
+  along so shape rules and the JX004 dataflow client share one notion
+  of the data/accumulator boundary.
+* **sharding state**: ``psummed`` — the set of mesh axes a value has
+  been reduced over (``psum``/``pmean``/``psum_over_mesh``); a psummed
+  value is replicated over those axes *by construction*, which is
+  exactly what JX015's out_spec check needs. Joins take the
+  intersection (must-analysis: an axis counts only when every path
+  reduced over it).
+* **mesh-identity token**: program values (``tree_aggregate`` /
+  ``shard_map`` results) are tracked with an abstract mesh *epoch*;
+  rebuild events (``mesh.reset`` / ``rebuild_mesh`` / a callee whose
+  summary rebuilds) advance the epoch, and JX017 flags dispatch of a
+  program built under an older epoch.
+* **padding** (``padded``): dim indices that carry padding — from
+  ``jnp.pad``/``np.pad``, the bucket idiom (``buf = np.zeros((bucket,
+  d)); buf[:k] = rows``) and ``.at[:k].set(rows)``. Slicing the dim
+  back down (``buf[:k]``) removes the mark.
+* **param roots** (``roots``): which of the function's parameters a
+  value derives from through shape-preserving ops — the carrier for
+  interprocedural facts ("this callee takes an unmasked mean over
+  param 2's dim 0", "this helper hands param 0 to ``np.asarray``").
+
+Transfer functions cover the jnp/lax surface the repo actually uses:
+constructors, elementwise broadcasting (with concrete-dim conflict
+events), matmul/dot, reductions (mean/average recorded as events with
+their axes), reshape/transpose/indexing, ``jnp.pad``, ``.astype``,
+``.at[...].set``, the psum family, ``shard_map``/``shard_map_compat``
+spec bindings (:class:`SpecVal` parses ``P(...)`` literals, resolving
+axis constants discovered from ``mesh.py``), the ``tree_aggregate``
+builder family, and host materializers (``jax.device_get`` /
+``np.asarray`` / ``.tolist``).
+
+One dataflow client (:data:`ANALYSIS_ID` = ``"JXSHAPE"``) serves all
+four rules: the engine dedupes clients by ``analysis_id``, so the
+fixpoint runs once and each rule reads the converged
+:class:`ShapeSummary` facts. Per-function interpretation is gated by a
+cheap relevance scan (functions whose own calls touch none of the
+interesting surfaces and whose callees all have empty summaries get
+:data:`EMPTY_SUMMARY` without a walk) — the full self-run stays within
+the lint wall-time budget.
+
+Degradation discipline: facts that *trigger findings* (psummed axes,
+mean/materialize param sets) widen toward silence; facts that only
+*propagate* (returns_program, rebuilds, reaches_aggregate) widen toward
+``True`` so the fixpoint terminates. A wrong summary therefore costs
+recall, never precision.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, dotted_name,
+                                            last_component)
+from cycloneml_tpu.analysis.dataflow import (TOP, _ordered_params,
+                                             assign_targets)
+
+ANALYSIS_ID = "JXSHAPE"
+
+ACCUM_STRINGS = {"float32", "f32", "float64", "f64"}
+ACCUM_DOTTED = {"jnp.float32", "jax.numpy.float32", "np.float32",
+                "numpy.float32", "jnp.float64", "jax.numpy.float64",
+                "np.float64", "numpy.float64"}
+
+TIER_NARROW, TIER_ACCUM = "narrow", "accum"
+
+# -- call surfaces ------------------------------------------------------------
+
+CONSTRUCTORS = {"zeros", "ones", "empty", "full", "zeros_like", "ones_like",
+                "empty_like", "full_like"}
+ZERO_ORIGIN = {"zeros", "empty", "zeros_like", "empty_like"}
+REDUCERS = {"sum", "max", "min", "prod", "std", "var", "median", "nansum",
+            "amax", "amin", "nanmax", "nanmin", "count_nonzero", "all", "any"}
+MEAN_CALLS = {"mean", "average", "nanmean"}
+PSUM_CALLS = {"psum", "pmean", "pmax", "pmin"}
+MATMUL_CALLS = {"dot", "matmul", "vdot"}
+ELEMWISE_PREFIXES = ("jnp.", "jax.numpy.", "jax.nn.", "jax.lax.", "lax.",
+                    "jax.scipy.", "np.", "numpy.")
+
+# program builders: results are SPMD programs bound to the mesh they were
+# built under (the dispatch boundary JX017 polices)
+PROGRAM_BUILDERS = {"tree_aggregate", "tree_aggregate_with_state",
+                    "tree_aggregate_fn", "shard_map_compat", "shard_map"}
+SHARD_MAP_CALLS = {"shard_map", "shard_map_compat"}
+AGGREGATE_CALLS = {"tree_aggregate", "tree_aggregate_with_state",
+                   "all_gather_hosts", "psum", "pmean", "psum_over_mesh"}
+
+# mesh-rebuild surfaces: the events that invalidate every program built
+# under the previous mesh (MeshSupervisor.recover reaches rebuild_mesh
+# transitively; `mesh.reset()` is the module-level teardown)
+REBUILD_LAST = {"rebuild_mesh"}
+REBUILD_DOTTED = {"mesh.reset"}
+
+# host materializers: the full-array device->host sinks JX018 polices
+# (jnp.asarray is device-side and NOT one of these)
+MATERIALIZER_DOTTED = {"jax.device_get", "device_get"}
+NP_MATERIALIZER_LAST = {"asarray", "array"}
+
+# names whose `.shape` unpack binds the dataset row dim (the out-of-core
+# scale dim; heuristic complement to the sharded-aggregate-operand rule)
+DATASET_DIM_NAMES = {"n", "n_rows", "num_rows", "n_samples", "n_pad"}
+
+_INTERESTING_LAST = (CONSTRUCTORS | MEAN_CALLS | PSUM_CALLS
+                     | PROGRAM_BUILDERS | REBUILD_LAST | {"psum_over_mesh"}
+                     | {"pad", "tolist", "device_get", "asarray", "array",
+                        "all_gather_hosts", "reset"})
+
+
+# -- dims ---------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Sym:
+    """One symbolic dimension. Identity (uid) is equality; the label is
+    for messages only (`n`, `x@0`)."""
+
+    uid: int
+    label: str
+
+    def __repr__(self):
+        return self.label
+
+
+def dims_equal(a, b) -> bool:
+    return a is not TOP and b is not TOP and a == b
+
+
+def join_dim(a, b):
+    return a if dims_equal(a, b) else TOP
+
+
+# -- abstract values ----------------------------------------------------------
+
+_EMPTY: FrozenSet = frozenset()
+
+
+@dataclass(frozen=True)
+class AArray:
+    """Abstract array fact: shape x tier x sharding x provenance."""
+
+    shape: object = TOP                 # tuple[Dim,...] | TOP
+    dim0: object = None                 # known leading dim when shape is TOP
+    tier: object = TOP                  # "narrow" | "accum" | TOP
+    psummed: FrozenSet[str] = _EMPTY    # mesh axes reduced over (must)
+    padded: FrozenSet[int] = _EMPTY     # dim indices carrying padding
+    roots: FrozenSet[int] = _EMPTY      # param indices (shape-preserving)
+    kind: str = "array"                 # "array" | "program"
+    origin: str = ""                    # "zeros" for paddable buffers
+
+    def rank(self):
+        return len(self.shape) if isinstance(self.shape, tuple) else TOP
+
+    def dim(self, i: int):
+        if isinstance(self.shape, tuple):
+            return self.shape[i] if 0 <= i < len(self.shape) else TOP
+        return self.dim0 if (i == 0 and self.dim0 is not None) else TOP
+
+    def dims_contained(self) -> FrozenSet[Sym]:
+        out = set()
+        if isinstance(self.shape, tuple):
+            out.update(d for d in self.shape if isinstance(d, Sym))
+        if isinstance(self.dim0, Sym):
+            out.add(self.dim0)
+        return frozenset(out)
+
+
+@dataclass(frozen=True)
+class DimVal:
+    """A host int holding an array size."""
+
+    dim: object
+
+
+@dataclass(frozen=True)
+class TupleVal:
+    items: tuple
+
+
+@dataclass(frozen=True)
+class ShapeVal:
+    """The ``x.shape`` object of one abstract array (owner name kept so
+    an unpack can refine the array's own dims)."""
+
+    owner: Optional[str]
+    arr: AArray
+
+
+UNKNOWN_ENTRY = object()   # an unresolvable element inside a P(...) spec
+
+
+@dataclass(frozen=True)
+class SpecVal:
+    """A parsed ``PartitionSpec`` literal: one entry per tensor dim —
+    a frozenset of mesh-axis names, None (replicated), or
+    :data:`UNKNOWN_ENTRY`."""
+
+    entries: tuple
+    node: object = None
+
+    def axes(self) -> FrozenSet[str]:
+        out: Set[str] = set()
+        for e in self.entries:
+            if isinstance(e, frozenset):
+                out |= e
+        return frozenset(out)
+
+
+class _Other:
+    """Unknown non-array value (modules, strings, host objects). Distinct
+    from ``AArray()`` so a module name never masquerades as an array
+    receiver."""
+
+    def __repr__(self):
+        return "OTHER"
+
+
+OTHER = _Other()
+
+
+def join_avals(a, b):
+    """Join two abstract values (branch merge)."""
+    if isinstance(a, AArray) and isinstance(b, AArray):
+        if isinstance(a.shape, tuple) and isinstance(b.shape, tuple) \
+                and len(a.shape) == len(b.shape):
+            shape = tuple(join_dim(x, y) for x, y in zip(a.shape, b.shape))
+        else:
+            shape = TOP
+        return AArray(shape=shape,
+                      dim0=a.dim0 if dims_equal(a.dim0, b.dim0) else None,
+                      tier=a.tier if a.tier == b.tier else TOP,
+                      psummed=a.psummed & b.psummed,
+                      padded=a.padded | b.padded,
+                      roots=a.roots | b.roots,
+                      kind=a.kind if a.kind == b.kind else "array",
+                      origin=a.origin if a.origin == b.origin else "")
+    if isinstance(a, DimVal) and isinstance(b, DimVal):
+        return DimVal(join_dim(a.dim, b.dim))
+    return OTHER
+
+
+# -- function summary ---------------------------------------------------------
+
+#: encodes "reduced over every dim" in (param, axis) pairs. None, NOT a
+#: negative int: a literal ``axis=-1`` must never alias the sentinel (a
+#: helper's last-dim mean is not an all-dims mean)
+ALL_AXES = None
+
+
+@dataclass(frozen=True)
+class ShapeSummary:
+    """Converged per-function facts (the JXSHAPE dataflow lattice)."""
+
+    #: per-return-element mesh axes the value is psum-reduced over
+    #: (must: intersection across return paths); length-1 for single
+    #: returns, longer for literal tuple returns
+    ret_psummed: tuple = (frozenset(),)
+    #: returns an SPMD program bound to the mesh it was built under
+    returns_program: bool = False
+    #: (transitively) tears down / rebuilds the device mesh
+    rebuilds: bool = False
+    #: (transitively) dispatches a collective aggregation — the fit path
+    reaches_aggregate: bool = False
+    #: (param index, axis|ALL_AXES) pairs reduced by an unmasked mean
+    unmasked_mean_params: FrozenSet[Tuple[int, int]] = _EMPTY
+    #: param indices handed (shape-preserving) to a host materializer
+    materializes_params: FrozenSet[int] = _EMPTY
+
+
+EMPTY_SUMMARY = ShapeSummary()
+
+#: the hard-widening backstop: propagation facts degrade to True (the
+#: fixpoint must terminate), finding-triggering facts degrade to silent
+TOP_SUMMARY = ShapeSummary(ret_psummed=(frozenset(),), returns_program=True,
+                           rebuilds=True, reaches_aggregate=True)
+
+
+def summary_of(facts, fn) -> ShapeSummary:
+    got = facts.get(fn) if facts else None
+    return got if isinstance(got, ShapeSummary) else EMPTY_SUMMARY
+
+
+# -- events -------------------------------------------------------------------
+
+@dataclass
+class Event:
+    kind: str          # mean | mismatch | materialize | psum | shard_map |
+                       # shard_apply | build | agg_args
+    node: ast.AST
+    aval: object = None
+    axes: object = None        # mean: frozenset[int] (empty = all dims)
+                               # psum: frozenset[str]
+    detail: str = ""
+    payload: dict = field(default_factory=dict)
+
+
+class ShapeState:
+    """The interpretation result for one function."""
+
+    def __init__(self):
+        self.env: Dict[str, object] = {}
+        self.events: List[Event] = []
+        self.returns: List[Tuple[ast.AST, object]] = []
+        self.dataset_syms: Set[Sym] = set()
+        self.dataset_roots: Set[int] = set()
+
+
+# -- the interpreter ----------------------------------------------------------
+
+class _Interp:
+    """Source-order abstract interpretation of ONE function's own body.
+
+    Two passes, TaintTracker-style: pass 1 establishes loop-carried
+    bindings, pass 2 re-walks recording events and returns — so a name
+    bound late in a loop body still has its fact at an earlier use.
+    """
+
+    def __init__(self, fn: FunctionInfo, graph, ctx, facts=None):
+        self.fn = fn
+        self.graph = graph
+        self.ctx = ctx
+        self.facts = facts or {}
+        self.sites = graph.sites_map(fn)
+        self.state = ShapeState()
+        self._uid = 0
+        self._recording = False
+        self._seed_params()
+        body = getattr(fn.node, "body", [])
+        self._walk(body)
+        self._recording = True
+        self._walk(body)
+
+    # -- plumbing -------------------------------------------------------------
+    def _sym(self, label: str) -> Sym:
+        self._uid += 1
+        return Sym(self._uid, label)
+
+    def _seed_params(self):
+        for i, name in enumerate(_ordered_params(self.fn)):
+            if name in ("self", "cls"):
+                self.state.env[name] = OTHER
+            else:
+                self.state.env[name] = AArray(roots=frozenset({i}))
+
+    def _event(self, kind, node, aval=None, axes=None, detail="",
+               payload=None):
+        if self._recording:
+            self.state.events.append(
+                Event(kind, node, aval, axes, detail, payload or {}))
+
+    def _axis_names(self, expr) -> object:
+        """Mesh-axis names off a collective's axis argument: string
+        literals, mesh.py axis constants, tuples of either; TOP when
+        unresolvable."""
+        consts = getattr(self.ctx, "axis_constants", {}) or {}
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return frozenset({expr.value})
+        if isinstance(expr, ast.Name) and expr.id in consts:
+            return frozenset({consts[expr.id]})
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                got = self._axis_names(e)
+                if got is TOP:
+                    return TOP
+                out |= got
+            return frozenset(out)
+        return TOP
+
+    # -- statement walk -------------------------------------------------------
+    def _walk(self, stmts: Sequence[ast.AST]):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.AST):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = getattr(stmt, "value", None)
+            if value is None:
+                return
+            aval = self.eval(value)
+            for target in assign_targets(stmt):
+                self._bind(target, aval, value)
+        elif isinstance(stmt, ast.AugAssign):
+            aval = self._binop_join(self.eval_name_or_other(stmt.target),
+                                    self.eval(stmt.value), stmt)
+            if isinstance(stmt.target, ast.Name):
+                self.state.env[stmt.target.id] = aval
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                aval = self.eval(stmt.value)
+            else:
+                aval = OTHER
+            if self._recording:
+                self.state.returns.append((stmt, aval))
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval(stmt.iter)
+            for n in assigned_names(stmt.target):
+                self.state.env[n] = OTHER
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test)
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test)
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    for n in assigned_names(item.optional_vars):
+                        self.state.env[n] = OTHER
+            self._walk(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._walk(stmt.body)
+            for h in stmt.handlers:
+                self._walk(h.body)
+            self._walk(stmt.orelse)
+            self._walk(stmt.finalbody)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.eval(child)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.state.env.pop(t.id, None)
+
+    def eval_name_or_other(self, expr):
+        if isinstance(expr, ast.Name):
+            return self.state.env.get(expr.id, OTHER)
+        return OTHER
+
+    # -- binding --------------------------------------------------------------
+    def _bind(self, target: ast.AST, aval, value_expr: ast.AST):
+        if isinstance(target, ast.Name):
+            self.state.env[target.id] = aval
+            if isinstance(aval, DimVal) and isinstance(aval.dim, Sym) \
+                    and target.id in DATASET_DIM_NAMES:
+                # `n = x.shape[0]` — the spelled-out row-count binding
+                self.state.dataset_syms.add(aval.dim)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            self._bind_unpack(target, aval)
+            return
+        if isinstance(target, ast.Subscript):
+            # `buf[:k] = rows` — slice-store into a zeros buffer is the
+            # bucket-padding idiom: the tail rows stay zero
+            base = target.value
+            if isinstance(base, ast.Name):
+                cur = self.state.env.get(base.id)
+                if isinstance(cur, AArray) and cur.origin == "zeros" \
+                        and isinstance(target.slice, ast.Slice):
+                    self.state.env[base.id] = replace(
+                        cur, padded=cur.padded | {0})
+
+    def _bind_unpack(self, target, aval):
+        elts = target.elts
+        if isinstance(aval, ShapeVal):
+            # `n, d = x.shape` — name x's dims after the targets and
+            # refine x's own abstract shape
+            dims = []
+            known = aval.arr.shape if isinstance(aval.arr.shape, tuple) \
+                else None
+            for i, elt in enumerate(elts):
+                if known is not None and i < len(known) \
+                        and known[i] is not TOP:
+                    d = known[i]
+                elif isinstance(elt, ast.Name):
+                    d = self._sym(elt.id)
+                else:
+                    d = self._sym(f"{aval.owner or '?'}@{i}")
+                dims.append(d)
+                if isinstance(elt, ast.Name):
+                    self.state.env[elt.id] = DimVal(d)
+                    if elt.id in DATASET_DIM_NAMES and i == 0 \
+                            and isinstance(d, Sym):
+                        self.state.dataset_syms.add(d)
+            if aval.owner is not None:
+                arr = self.state.env.get(aval.owner)
+                if isinstance(arr, AArray):
+                    self.state.env[aval.owner] = replace(
+                        arr, shape=tuple(dims), dim0=dims[0])
+            return
+        if isinstance(aval, TupleVal) and len(aval.items) == len(elts):
+            for elt, item in zip(elts, aval.items):
+                self._bind(elt, item, target)
+            return
+        for elt in elts:
+            for n in assigned_names(elt):
+                self.state.env[n] = OTHER
+
+    # -- expression evaluation ------------------------------------------------
+    def eval(self, expr: ast.AST):
+        if isinstance(expr, ast.Name):
+            return self.state.env.get(expr.id, OTHER)
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, int) and not isinstance(expr.value,
+                                                              bool):
+                return DimVal(expr.value)
+            return OTHER
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return TupleVal(tuple(self.eval(e) for e in expr.elts))
+        if isinstance(expr, ast.Attribute):
+            return self._attribute(expr)
+        if isinstance(expr, ast.Subscript):
+            return self._subscript(expr)
+        if isinstance(expr, ast.Call):
+            return self._call(expr)
+        if isinstance(expr, ast.BinOp):
+            return self._binop(expr)
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            self.eval(expr.test)
+            return join_avals(self.eval(expr.body), self.eval(expr.orelse))
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                self.eval(v)
+            return OTHER
+        if isinstance(expr, ast.Compare):
+            self.eval(expr.left)
+            for c in expr.comparators:
+                self.eval(c)
+            return OTHER
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value)
+        if isinstance(expr, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.Lambda)):
+            return OTHER
+        if isinstance(expr, ast.JoinedStr):
+            return OTHER
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.eval(child)
+        return OTHER
+
+    def _attribute(self, expr: ast.Attribute):
+        if expr.attr == "shape":
+            base = self.eval(expr.value)
+            owner = expr.value.id if isinstance(expr.value, ast.Name) \
+                else None
+            if isinstance(base, AArray):
+                return ShapeVal(owner, base)
+            return ShapeVal(owner, AArray())
+        if expr.attr == "T":
+            base = self.eval(expr.value)
+            if isinstance(base, AArray) and isinstance(base.shape, tuple):
+                return AArray(shape=tuple(reversed(base.shape)),
+                              tier=base.tier)
+            return OTHER
+        self.eval(expr.value)
+        return OTHER
+
+    def _subscript(self, expr: ast.Subscript):
+        base = self.eval(expr.value)
+        idx = expr.slice
+        if isinstance(base, ShapeVal):
+            # x.shape[i] — a dim read; invent + attach a symbol when the
+            # shape is still opaque
+            if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+                i = idx.value
+                d = base.arr.dim(i)
+                if d is TOP and base.owner is not None:
+                    d = self._sym(f"{base.owner}@{i}")
+                    arr = self.state.env.get(base.owner)
+                    if isinstance(arr, AArray) and i == 0:
+                        self.state.env[base.owner] = replace(arr, dim0=d)
+                return DimVal(d)
+            return DimVal(TOP)
+        if not isinstance(base, AArray):
+            self.eval_index(idx)
+            return OTHER
+        if isinstance(idx, ast.Slice):
+            # x[:k] — leading-dim slice; an explicit bound sheds any
+            # padding mark (the un-pad read) and renames dim0
+            upper = self.eval(idx.upper) if idx.upper is not None else None
+            dim0 = upper.dim if isinstance(upper, DimVal) else (
+                base.dim(0) if idx.upper is None else TOP)
+            shape = base.shape
+            if isinstance(shape, tuple) and shape:
+                shape = (dim0,) + shape[1:]
+            padded = base.padded if idx.upper is None \
+                else base.padded - {0}
+            # an explicit bound also ends dataset-dim provenance: x[:64]
+            # is no longer the param's full extent
+            roots = base.roots if idx.upper is None else _EMPTY
+            return replace(base, shape=shape, dim0=dim0 if dim0 is not TOP
+                           else None, padded=padded, roots=roots)
+        if isinstance(idx, ast.Tuple):
+            for e in idx.elts:
+                self.eval_index(e)
+            return AArray(tier=base.tier)
+        # scalar index: drop the leading dim
+        self.eval_index(idx)
+        if isinstance(base.shape, tuple) and base.shape:
+            return AArray(shape=base.shape[1:], tier=base.tier)
+        return AArray(tier=base.tier)
+
+    def eval_index(self, idx):
+        if isinstance(idx, ast.Slice):
+            for p in (idx.lower, idx.upper, idx.step):
+                if p is not None:
+                    self.eval(p)
+        elif isinstance(idx, ast.expr):
+            self.eval(idx)
+
+    # -- binary ops -----------------------------------------------------------
+    def _binop(self, expr: ast.BinOp):
+        left = self.eval(expr.left)
+        right = self.eval(expr.right)
+        if isinstance(expr.op, ast.MatMult):
+            return self._matmul(left, right, expr)
+        if isinstance(left, DimVal) or isinstance(right, DimVal):
+            # int arithmetic on dims: n * d, n + pad — result unknown dim
+            if isinstance(left, DimVal) and isinstance(right, DimVal):
+                if isinstance(left.dim, int) and isinstance(right.dim, int):
+                    try:
+                        return DimVal(_int_op(expr.op, left.dim, right.dim))
+                    except Exception:
+                        return DimVal(TOP)
+                return DimVal(TOP)
+        return self._binop_join(left, right, expr)
+
+    def _binop_join(self, left, right, node):
+        la = left if isinstance(left, AArray) else None
+        ra = right if isinstance(right, AArray) else None
+        if la is None and ra is None:
+            return OTHER
+        if la is None or ra is None:
+            return la or ra
+        # broadcast: align trailing dims; provable conflicts (two unequal
+        # concrete ints, neither 1) are shape-mismatch events
+        sa, sb = la.shape, ra.shape
+        shape = TOP
+        if isinstance(sa, tuple) and isinstance(sb, tuple):
+            out = []
+            for i in range(1, max(len(sa), len(sb)) + 1):
+                da = sa[-i] if i <= len(sa) else 1
+                db = sb[-i] if i <= len(sb) else 1
+                if isinstance(da, int) and isinstance(db, int) \
+                        and da != db and 1 not in (da, db):
+                    self._event("mismatch", node,
+                                detail=f"broadcast of dims {da} and {db}")
+                if da == 1:
+                    out.append(db)
+                elif db == 1:
+                    out.append(da)
+                else:
+                    out.append(join_dim(da, db))
+            shape = tuple(reversed(out))
+        return AArray(shape=shape,
+                      dim0=la.dim0 if dims_equal(la.dim0, ra.dim0) else None,
+                      tier=la.tier if la.tier == ra.tier else TOP,
+                      psummed=la.psummed & ra.psummed,
+                      padded=la.padded | ra.padded,
+                      roots=la.roots | ra.roots)
+
+    def _matmul(self, left, right, node):
+        la = left if isinstance(left, AArray) else AArray()
+        ra = right if isinstance(right, AArray) else AArray()
+        sa = la.shape if isinstance(la.shape, tuple) else None
+        sb = ra.shape if isinstance(ra.shape, tuple) else None
+        if sa and sb:
+            inner_a = sa[-1]
+            inner_b = sb[-2] if len(sb) >= 2 else sb[0]
+            if isinstance(inner_a, int) and isinstance(inner_b, int) \
+                    and inner_a != inner_b:
+                self._event("mismatch", node,
+                            detail=f"matmul inner dims {inner_a} and "
+                                   f"{inner_b}")
+            if len(sa) == 2 and len(sb) == 2:
+                return AArray(shape=(sa[0], sb[1]),
+                              padded=la.padded & {0})
+            if len(sa) == 2 and len(sb) == 1:
+                return AArray(shape=(sa[0],), padded=la.padded & {0})
+            if len(sa) == 1 and len(sb) == 2:
+                return AArray(shape=(sb[1],))
+            if len(sa) == 1 and len(sb) == 1:
+                return AArray(shape=())
+        return OTHER
+
+    # -- calls ----------------------------------------------------------------
+    def _call(self, expr: ast.Call):
+        name = call_name(expr) or ""
+        base = last_component(name) or ""
+        if not base and isinstance(expr.func, ast.Attribute):
+            # method on a non-name receiver (`zeros(...).at[:k].set(x)`,
+            # `run(x).tolist()`) — dotted_name gives up, the attr is
+            # still the dispatch key
+            base = expr.func.attr
+
+        # f(...)(...) — an applied shard_map: record the operand ranks
+        # against the inner call's specs
+        if isinstance(expr.func, ast.Call):
+            inner_name = last_component(call_name(expr.func) or "")
+            inner = self._call(expr.func)
+            arg_avals = [self.eval(a) for a in expr.args
+                         if not isinstance(a, ast.Starred)]
+            has_star = any(isinstance(a, ast.Starred) for a in expr.args)
+            for a in expr.args:
+                if isinstance(a, ast.Starred):
+                    self.eval(a.value)
+            if inner_name in SHARD_MAP_CALLS:
+                self._event("shard_apply", expr, payload={
+                    "inner": expr.func, "arg_avals": arg_avals,
+                    "has_star": has_star})
+            return inner if isinstance(inner, AArray) else OTHER
+
+        # P(...) / PartitionSpec(...) literals parse into SpecVals
+        if base in ("P", "PartitionSpec"):
+            return self._parse_spec(expr)
+
+        # method chains that need the receiver's abstract value
+        recv = None
+        if isinstance(expr.func, ast.Attribute):
+            recv = self.eval(expr.func.value)
+
+        arg_avals = [self.eval(a) if not isinstance(a, ast.Starred)
+                     else self.eval(a.value) for a in expr.args]
+        kw_avals = {kw.arg: self.eval(kw.value) for kw in expr.keywords
+                    if kw.arg is not None}
+        for kw in expr.keywords:
+            if kw.arg is None:
+                self.eval(kw.value)
+
+        # `x.at[:k].set(rows)` — functional update; zeros-origin + slice
+        # target marks padding
+        if base == "set" and isinstance(expr.func, ast.Attribute) \
+                and isinstance(expr.func.value, ast.Subscript):
+            at = expr.func.value
+            if isinstance(at.value, ast.Attribute) and at.value.attr == "at":
+                buf = self.eval(at.value.value)
+                if isinstance(buf, AArray):
+                    if buf.origin == "zeros" \
+                            and isinstance(at.slice, ast.Slice):
+                        return replace(buf, padded=buf.padded | {0})
+                    return buf
+            return OTHER
+
+        if base in SHARD_MAP_CALLS:
+            return self._shard_map_call(expr, name)
+
+        if base in PROGRAM_BUILDERS:
+            self._event("build", expr, detail=base)
+            if base in ("tree_aggregate", "tree_aggregate_with_state") \
+                    and len(expr.args) > 2:
+                shard_avals = [a for a in arg_avals[2:]
+                               if isinstance(a, AArray)]
+                self._event("agg_args", expr, payload={"avals": shard_avals})
+                for a in shard_avals:
+                    d0 = a.dim(0)
+                    if isinstance(d0, Sym):
+                        self.state.dataset_syms.add(d0)
+                    self.state.dataset_roots |= a.roots
+            return AArray(kind="program")
+
+        if base == "all_gather_hosts":
+            self._event("agg_args", expr, payload={
+                "avals": [a for a in arg_avals[2:] if isinstance(a, AArray)]})
+            return OTHER
+
+        if base in PSUM_CALLS or base == "psum_over_mesh":
+            return self._psum_call(expr, base, arg_avals)
+
+        if base == "tree_map" and expr.args \
+                and isinstance(expr.args[0], ast.Lambda):
+            return self._tree_map_lambda(expr)
+
+        if base in CONSTRUCTORS and _is_numeric_lib(name):
+            return self._constructor(base, expr, arg_avals)
+
+        if base == "pad" and _is_numeric_lib(name):
+            return self._pad_call(expr, arg_avals)
+
+        if base in MEAN_CALLS or base in REDUCERS:
+            return self._reduction(expr, base, recv, arg_avals, kw_avals)
+
+        if base == "reshape":
+            target = recv if isinstance(recv, AArray) else (
+                arg_avals[0] if arg_avals and isinstance(arg_avals[0], AArray)
+                else None)
+            shape_expr = expr.args[-1] if expr.args else None
+            dims = self._dims_from_shape_arg(shape_expr)
+            return AArray(shape=dims,
+                          tier=target.tier if target is not None else TOP)
+
+        if base == "astype" and isinstance(recv, AArray):
+            tier = _tier_of_dtype_expr(expr.args[0]) if expr.args else TOP
+            return replace(recv, tier=tier if tier is not None else recv.tier)
+
+        if base in MATMUL_CALLS and _is_numeric_lib(name):
+            if len(arg_avals) >= 2:
+                return self._matmul(arg_avals[0], arg_avals[1], expr)
+            if recv is not None and arg_avals:
+                return self._matmul(recv, arg_avals[0], expr)
+            return OTHER
+
+        if base == "tolist" and isinstance(recv, AArray):
+            self._event("materialize", expr, recv, detail=".tolist()")
+            return OTHER
+
+        if name in MATERIALIZER_DOTTED or (
+                base in NP_MATERIALIZER_LAST
+                and name.startswith(("np.", "numpy."))):
+            target = arg_avals[0] if arg_avals else OTHER
+            if isinstance(target, AArray):
+                self._event("materialize", expr, target, detail=name)
+                return target
+            return OTHER
+
+        # resolved user call: consult callee summaries
+        site = self.sites.get(id(expr))
+        if site is not None and site.targets:
+            return self._user_call(expr, site)
+
+        # generic jnp/np elementwise fallback: one array in, same fact out
+        if name.startswith(ELEMWISE_PREFIXES):
+            arrays = [a for a in list(arg_avals) + list(kw_avals.values())
+                      if isinstance(a, AArray) and a is not OTHER]
+            if isinstance(recv, AArray) and recv is not OTHER:
+                arrays.insert(0, recv)
+            if len(arrays) == 1:
+                return replace(arrays[0], psummed=_EMPTY, origin="")
+            if len(arrays) > 1:
+                out = arrays[0]
+                for a in arrays[1:]:
+                    out = join_avals(out, a)
+                return replace(out, psummed=_EMPTY, origin="") \
+                    if isinstance(out, AArray) else OTHER
+        return OTHER
+
+    def _user_call(self, expr, site):
+        kind = "array"
+        psummed = None
+        for target in site.targets:
+            s = summary_of(self.facts, target)
+            if s.returns_program:
+                kind = "program"
+            first = s.ret_psummed[0] if s.ret_psummed else frozenset()
+            psummed = first if psummed is None else (psummed & first)
+            # interprocedural mean/materialize: project the callee's
+            # param facts onto this site's arguments
+            pm = s.unmasked_mean_params
+            mm = s.materializes_params
+            if pm or mm:
+                for pos, arg in site.param_map(target):
+                    # the argument was already evaluated (events recorded)
+                    # when the call's operands were walked — re-evaluate
+                    # silently just to read its abstract value
+                    saved, self._recording = self._recording, False
+                    aval = self.eval(arg)
+                    self._recording = saved
+                    if not isinstance(aval, AArray):
+                        continue
+                    axes = {ax for (p, ax) in pm if p == pos}
+                    if axes:
+                        # ALL_AXES projects as an empty event-axes set
+                        # (the "every dim" spelling mean events use)
+                        self._event(
+                            "mean", expr, aval,
+                            frozenset(a for a in axes if a is not ALL_AXES),
+                            detail=f"via {target.qualname}()")
+                    if pos in mm:
+                        self._event("materialize", expr, aval,
+                                    detail=f"via {target.qualname}()")
+        return AArray(kind=kind, psummed=psummed or _EMPTY)
+
+    def _psum_call(self, expr, base, arg_avals):
+        operand = arg_avals[0] if arg_avals else OTHER
+        if base == "psum_over_mesh":
+            if len(expr.args) > 1:
+                axes = self._axis_names(expr.args[1])
+            else:
+                valid = set(getattr(self.ctx, "valid_axes", ()) or ())
+                axes = frozenset({"data", "replica"} & valid) \
+                    or frozenset(valid)
+        else:
+            axes = self._axis_names(expr.args[1]) if len(expr.args) > 1 \
+                else TOP
+        axes = axes if axes is not TOP else _EMPTY
+        self._event("psum", expr, operand, axes, detail=base)
+        if isinstance(operand, AArray):
+            return replace(operand, psummed=operand.psummed | axes)
+        return AArray(psummed=frozenset(axes))
+
+    def _tree_map_lambda(self, expr):
+        lam = expr.args[0]
+        operand = self.eval(expr.args[1]) if len(expr.args) > 1 else OTHER
+        params = [a.arg for a in lam.args.args]
+        saved = {p: self.state.env.get(p) for p in params}
+        if params:
+            self.state.env[params[0]] = operand
+        out = self.eval(lam.body)
+        for p, v in saved.items():
+            if v is None:
+                self.state.env.pop(p, None)
+            else:
+                self.state.env[p] = v
+        return out
+
+    def _constructor(self, base, expr, arg_avals):
+        origin = "zeros" if base in ZERO_ORIGIN else base
+        if base.endswith("_like"):
+            src = arg_avals[0] if arg_avals else OTHER
+            if isinstance(src, AArray):
+                return AArray(shape=src.shape, dim0=src.dim0, tier=src.tier,
+                              origin=origin)
+            return AArray(origin=origin)
+        dims = self._dims_from_shape_arg(expr.args[0]) if expr.args else TOP
+        return AArray(shape=dims, origin=origin)
+
+    def _dims_from_shape_arg(self, shape_expr) -> object:
+        if shape_expr is None:
+            return TOP
+        aval = self.eval(shape_expr)
+        if isinstance(aval, DimVal):
+            return (aval.dim,)
+        if isinstance(aval, TupleVal):
+            dims = []
+            for item in aval.items:
+                if isinstance(item, DimVal):
+                    dims.append(item.dim)
+                else:
+                    dims.append(TOP)
+            return tuple(dims)
+        return TOP
+
+    def _pad_call(self, expr, arg_avals):
+        target = arg_avals[0] if arg_avals else OTHER
+        if not isinstance(target, AArray):
+            return OTHER
+        padded = self._padded_dims(expr.args[1] if len(expr.args) > 1
+                                   else None, target)
+        return replace(target, padded=target.padded | padded, origin="")
+
+    @staticmethod
+    def _padded_dims(width_expr, target) -> FrozenSet[int]:
+        """Dims a pad_width literal actually pads; unresolvable entries
+        pad conservatively."""
+        rank = target.rank()
+        all_dims = frozenset(range(rank)) if isinstance(rank, int) \
+            else frozenset({0})
+        if width_expr is None:
+            return all_dims
+        if isinstance(width_expr, ast.Constant):
+            return all_dims if width_expr.value else frozenset()
+        if isinstance(width_expr, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for i, entry in enumerate(width_expr.elts):
+                if isinstance(entry, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant) and e.value == 0
+                        for e in entry.elts):
+                    continue
+                out.add(i)
+            return frozenset(out)
+        return all_dims
+
+    def _reduction(self, expr, base, recv, arg_avals, kw_avals):
+        # operand: method receiver, else first positional array
+        if isinstance(recv, AArray):
+            operand = recv
+            axis_expr = expr.args[0] if expr.args else _kwarg(expr, "axis")
+        else:
+            name = call_name(expr) or ""
+            if not name.startswith(ELEMWISE_PREFIXES):
+                return OTHER
+            operand = arg_avals[0] if arg_avals else OTHER
+            axis_expr = expr.args[1] if len(expr.args) > 1 \
+                else _kwarg(expr, "axis")
+        if not isinstance(operand, AArray):
+            return OTHER
+        axes = _literal_axes(axis_expr)
+        if base in MEAN_CALLS:
+            self._event("mean", expr, operand,
+                        axes if axes is not TOP else frozenset(),
+                        detail=base)
+        # result: reduced dims removed when known, provenance dropped
+        if axes is TOP or not isinstance(operand.shape, tuple):
+            return AArray(tier=operand.tier)
+        if not axes:   # full reduction -> scalar
+            return AArray(shape=(), tier=operand.tier)
+        rank = len(operand.shape)
+        norm = {a % rank for a in axes if isinstance(a, int)} \
+            if rank else set()
+        shape = tuple(d for i, d in enumerate(operand.shape)
+                      if i not in norm)
+        return AArray(shape=shape, tier=operand.tier)
+
+    def _shard_map_call(self, expr, name):
+        args = list(expr.args)
+        kws = {kw.arg: kw.value for kw in expr.keywords if kw.arg}
+        body = args[0] if args else kws.get("f")
+        mesh = args[1] if len(args) > 1 else kws.get("mesh")
+        in_specs = args[2] if len(args) > 2 else kws.get("in_specs")
+        out_specs = args[3] if len(args) > 3 else kws.get("out_specs")
+        for e in (mesh, in_specs, out_specs):
+            if e is not None:
+                self.eval(e)
+        self._event("shard_map", expr, payload={
+            "body": body, "mesh": mesh, "in_specs": in_specs,
+            "out_specs": out_specs})
+        self._event("build", expr, detail=last_component(name) or name)
+        return AArray(kind="program")
+
+    def _parse_spec(self, expr: ast.Call) -> SpecVal:
+        consts = getattr(self.ctx, "axis_constants", {}) or {}
+        return parse_spec(expr, consts)
+
+
+def parse_spec(expr: ast.Call, consts) -> SpecVal:
+    """``P(...)`` / ``PartitionSpec(...)`` literal -> :class:`SpecVal`,
+    resolving mesh-axis constants (``DATA_AXIS``) through ``consts``."""
+    return SpecVal(tuple(_spec_entry(arg, consts) for arg in expr.args),
+                   expr)
+
+
+def resolve_spec(expr, env, consts) -> object:
+    """A SpecVal / TupleVal-of-SpecVals for a spec expression, through
+    local name bindings; None when unresolvable structurally."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Name):
+        got = env.get(expr.id)
+        return got if isinstance(got, (SpecVal, TupleVal)) else None
+    if isinstance(expr, ast.Call):
+        base = last_component(call_name(expr) or "")
+        if base in ("P", "PartitionSpec"):
+            return parse_spec(expr, consts)
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        items = []
+        for e in expr.elts:
+            got = resolve_spec(e, env, consts)
+            if got is None:
+                return None
+            items.append(got)
+        return TupleVal(tuple(items))
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+        # `(row_spec,) * k` — a uniform spec of unknown count
+        for side in (expr.left, expr.right):
+            got = resolve_spec(side, env, consts)
+            if isinstance(got, TupleVal) and len(got.items) == 1:
+                return got.items[0]
+            if isinstance(got, SpecVal):
+                return got
+    return None
+
+
+def iter_spec_literals(expr, env, consts):
+    """Every P(...)-shaped SpecVal syntactically reachable from a spec
+    expression — the loose sweep for `tuple([row_spec]*n + [P()]*m)`
+    style constructions where structural resolution gives up. Name
+    references resolve through ``env`` so the bound literal is validated
+    too."""
+    if expr is None:
+        return
+    seen: Set[int] = set()
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            base = last_component(call_name(node) or "")
+            if base in ("P", "PartitionSpec") and id(node) not in seen:
+                seen.add(id(node))
+                yield parse_spec(node, consts)
+        if isinstance(node, ast.Name):
+            got = env.get(node.id)
+            if isinstance(got, SpecVal) and id(got.node) not in seen:
+                seen.add(id(got.node))
+                yield got
+            elif isinstance(got, TupleVal):
+                for item in got.items:
+                    if isinstance(item, SpecVal) \
+                            and id(item.node) not in seen:
+                        seen.add(id(item.node))
+                        yield item
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _spec_entry(arg, consts):
+    if isinstance(arg, ast.Constant):
+        if arg.value is None:
+            return None
+        if isinstance(arg.value, str):
+            return frozenset({arg.value})
+        return UNKNOWN_ENTRY
+    if isinstance(arg, ast.Name):
+        if arg.id in consts:
+            return frozenset({consts[arg.id]})
+        return UNKNOWN_ENTRY
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        axes: Set[str] = set()
+        for e in arg.elts:
+            got = _spec_entry(e, consts)
+            if not isinstance(got, frozenset):
+                return UNKNOWN_ENTRY
+            axes |= got
+        return frozenset(axes)
+    return UNKNOWN_ENTRY
+
+
+def _kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _literal_axes(axis_expr) -> object:
+    """axis= argument -> frozenset of int axes; empty = ALL dims (no
+    axis), TOP = unresolvable."""
+    if axis_expr is None:
+        return frozenset()
+    if isinstance(axis_expr, ast.Constant):
+        if axis_expr.value is None:
+            return frozenset()
+        if isinstance(axis_expr.value, int):
+            return frozenset({axis_expr.value})
+        return TOP
+    if isinstance(axis_expr, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in axis_expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+            else:
+                return TOP
+        return frozenset(out)
+    if isinstance(axis_expr, ast.UnaryOp) \
+            and isinstance(axis_expr.op, ast.USub) \
+            and isinstance(axis_expr.operand, ast.Constant) \
+            and isinstance(axis_expr.operand.value, int):
+        return frozenset({-axis_expr.operand.value})
+    return TOP
+
+
+def _int_op(op, a, b):
+    import operator
+    table = {ast.Add: operator.add, ast.Sub: operator.sub,
+             ast.Mult: operator.mul, ast.FloorDiv: operator.floordiv,
+             ast.Mod: operator.mod}
+    fn = table.get(type(op))
+    if fn is None:
+        raise ValueError
+    return fn(a, b)
+
+
+def _is_numeric_lib(name: str) -> bool:
+    return name.startswith(("jnp.", "jax.numpy.", "np.", "numpy.",
+                            "jax.lax.", "lax."))
+
+
+def _tier_of_dtype_expr(expr) -> Optional[str]:
+    # the narrow half of the tier lattice is JX004's (one boundary, one
+    # definition); imported lazily — rules/__init__ imports the shape
+    # rules which import this module
+    from cycloneml_tpu.analysis.rules.jx004_fp64_drift import (
+        NARROW_DOTTED, NARROW_STRINGS)
+    name = dotted_name(expr)
+    if name in NARROW_DOTTED:
+        return TIER_NARROW
+    if name in ACCUM_DOTTED:
+        return TIER_ACCUM
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        if expr.value in NARROW_STRINGS:
+            return TIER_NARROW
+        if expr.value in ACCUM_STRINGS:
+            return TIER_ACCUM
+    return None
+
+
+# -- summarization ------------------------------------------------------------
+
+def _relevant(fn: FunctionInfo) -> bool:
+    for name in fn.calls:
+        base = last_component(name)
+        if base in _INTERESTING_LAST:
+            return True
+        if name in REBUILD_DOTTED or name in MATERIALIZER_DOTTED:
+            return True
+    return False
+
+
+def _has_math(fn: FunctionInfo) -> bool:
+    if _relevant(fn):
+        return True
+    for name in fn.calls:
+        if name.startswith(ELEMWISE_PREFIXES):
+            return True
+    return False
+
+
+def _own_rebuild(fn: FunctionInfo) -> bool:
+    for name in fn.calls:
+        if last_component(name) in REBUILD_LAST:
+            return True
+        if name in REBUILD_DOTTED:
+            return True
+        if name.endswith(".reset") and "mesh" in name.split(".")[0].lower():
+            return True
+    return False
+
+
+def _own_aggregate(fn: FunctionInfo) -> bool:
+    return any(last_component(name) in AGGREGATE_CALLS for name in fn.calls)
+
+
+def compute_summary(fn: FunctionInfo, graph, ctx, facts) -> ShapeSummary:
+    """One transfer-function application: interpret the body with the
+    callees' current facts and distill the summary lattice element."""
+    callee_nontrivial = False
+    for site in graph.sites(fn):
+        for t in site.targets:
+            if summary_of(facts, t) != EMPTY_SUMMARY:
+                callee_nontrivial = True
+                break
+        if callee_nontrivial:
+            break
+    if not _relevant(fn) and not callee_nontrivial:
+        if _own_aggregate(fn) or _own_rebuild(fn):
+            return ShapeSummary(rebuilds=_own_rebuild(fn),
+                                reaches_aggregate=_own_aggregate(fn))
+        return EMPTY_SUMMARY
+
+    interp = _Interp(fn, graph, ctx, facts)
+    st = interp.state
+
+    # returns: psummed axes per element, must across return paths
+    vectors: List[tuple] = []
+    returns_program = False
+    for _, aval in st.returns:
+        if isinstance(aval, TupleVal):
+            vec = tuple(a.psummed if isinstance(a, AArray) else frozenset()
+                        for a in aval.items)
+            if any(isinstance(a, AArray) and a.kind == "program"
+                   for a in aval.items):
+                returns_program = True
+        elif isinstance(aval, AArray):
+            vec = (aval.psummed,)
+            if aval.kind == "program":
+                returns_program = True
+        else:
+            vec = (frozenset(),)
+        vectors.append(vec)
+    if not vectors:
+        ret_psummed: tuple = (frozenset(),)
+    elif all(len(v) == len(vectors[0]) for v in vectors):
+        ret_psummed = tuple(
+            frozenset.intersection(*(v[i] for v in vectors))
+            for i in range(len(vectors[0])))
+    else:
+        flat = frozenset.intersection(*(frozenset().union(*v) if v
+                                        else frozenset() for v in vectors))
+        ret_psummed = (flat,)
+
+    rebuilds = _own_rebuild(fn)
+    reaches = _own_aggregate(fn)
+    for site in graph.sites(fn):
+        for t in site.targets:
+            s = summary_of(facts, t)
+            rebuilds = rebuilds or s.rebuilds
+            reaches = reaches or s.reaches_aggregate
+
+    mean_params: Set[Tuple[int, int]] = set()
+    mat_params: Set[int] = set()
+    for ev in st.events:
+        if ev.kind == "mean" and isinstance(ev.aval, AArray):
+            axes = ev.axes if ev.axes else frozenset({ALL_AXES})
+            for root in ev.aval.roots:
+                for ax in axes:
+                    # negative literal axes are dropped: without the
+                    # operand's rank they name no concrete dim, and they
+                    # must not alias ALL_AXES (a helper's axis=-1 mean
+                    # is NOT an all-dims mean)
+                    if ax is ALL_AXES or (isinstance(ax, int) and ax >= 0):
+                        mean_params.add((root, ax))
+        elif ev.kind == "materialize" and isinstance(ev.aval, AArray):
+            mat_params |= ev.aval.roots
+
+    return ShapeSummary(ret_psummed=ret_psummed,
+                        returns_program=returns_program,
+                        rebuilds=rebuilds,
+                        reaches_aggregate=reaches,
+                        unmasked_mean_params=frozenset(mean_params),
+                        materializes_params=frozenset(mat_params))
+
+
+# -- shared dataflow client + per-ctx state cache -----------------------------
+
+class ShapeRuleBase:
+    """Mixin giving a rule the shared JXSHAPE analysis. The engine
+    dedupes dataflow clients by ``analysis_id``, so however many shape
+    rules are active, the fixpoint runs once."""
+
+    analysis_id = ANALYSIS_ID
+
+    def initial(self, fn, graph, ctx):
+        return compute_summary(fn, graph, ctx, None)
+
+    def transfer(self, fn, facts, graph, ctx):
+        return compute_summary(fn, graph, ctx, facts)
+
+    def top(self, fn, graph, ctx):
+        return TOP_SUMMARY
+
+    # -- converged facts + cached check-time states ---------------------------
+    @staticmethod
+    def facts(ctx) -> Dict[FunctionInfo, ShapeSummary]:
+        if ctx.dataflow is None:
+            return {}
+        return ctx.dataflow.summaries(ANALYSIS_ID)
+
+    @staticmethod
+    def state_of(ctx, fn: FunctionInfo) -> Optional[ShapeState]:
+        """The function's final interpretation under the CONVERGED
+        summaries, computed once per run and shared by every shape
+        rule's check()."""
+        cache = getattr(ctx, "_shape_states", None)
+        if cache is None or getattr(ctx, "_shape_states_ctx", None) \
+                is not ctx:
+            cache = {}
+            ctx._shape_states = cache
+            ctx._shape_states_ctx = ctx
+        if fn in cache:
+            return cache[fn]
+        graph = ctx.callgraph
+        if graph is None:
+            cache[fn] = None
+            return None
+        facts = ShapeRuleBase.facts(ctx)
+        if not _has_math(fn) and not any(
+                summary_of(facts, t) != EMPTY_SUMMARY
+                for site in graph.sites(fn) for t in site.targets):
+            cache[fn] = None
+            return None
+        import time as _time
+        t0 = _time.perf_counter()
+        state = _Interp(fn, graph, ctx, facts).state
+        # charge the lazily-built shared interpretation to JXSHAPE, not
+        # to whichever rule's check() touched this function first — the
+        # engine re-attributes via ctx.shared_time_credit
+        credit = getattr(ctx, "shared_time_credit", None)
+        if credit is None:
+            credit = {}
+            ctx.shared_time_credit = credit
+        credit[ANALYSIS_ID] = credit.get(ANALYSIS_ID, 0.0) \
+            + _time.perf_counter() - t0
+        cache[fn] = state
+        return state

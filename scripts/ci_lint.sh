@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# CI lint gate: one full graftlint run, SARIF artifact at a stable path,
+# nonzero exit on any unsuppressed finding.
+#
+#   GRAFTLINT_SARIF_OUT   where the SARIF artifact lands
+#                         (default: artifacts/graftlint.sarif)
+#   CYCLONE_LINT_CACHE    relocates the ParseCache pickle so CI cache
+#                         restore/save steps can persist it between runs
+#                         (unset: full runs parse fresh)
+#
+# Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/ratchet error.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+SARIF_OUT="${GRAFTLINT_SARIF_OUT:-artifacts/graftlint.sarif}"
+mkdir -p "$(dirname "$SARIF_OUT")"
+
+python -m cycloneml_tpu.analysis cycloneml_tpu \
+    --baseline cycloneml_tpu/analysis/baseline.json \
+    --sarif > "$SARIF_OUT"
+rc=$?
+
+# exit 2 = usage/ratchet error: the real diagnostic is already on
+# stderr and the artifact is empty — don't bury it under a
+# JSONDecodeError traceback from the summary step
+if [ "$rc" -gt 1 ]; then
+    echo "graftlint: analyzer error (exit $rc); no SARIF artifact" >&2
+    rm -f "$SARIF_OUT"
+    exit "$rc"
+fi
+
+# human-readable tail for the CI log (result count from the artifact —
+# no second analysis run). An unparseable artifact (the analyzer died
+# mid-run) degrades to a one-line note — the analyzer's own stderr and
+# exit code carry the real diagnosis.
+python - "$SARIF_OUT" <<'PY'
+import json, sys
+try:
+    doc = json.load(open(sys.argv[1]))
+except Exception as e:
+    print(f"graftlint: no valid SARIF artifact ({e})", file=sys.stderr)
+    sys.exit(0)
+run = doc["runs"][0]
+results = run["results"]
+grandfathered = run.get("properties", {}).get("grandfathered", 0)
+print(f"graftlint: {len(results)} finding(s), {grandfathered} baselined; "
+      f"SARIF artifact: {sys.argv[1]}")
+for r in results[:20]:
+    loc = r["locations"][0]["physicalLocation"]
+    print(f"  {loc['artifactLocation']['uri']}:{loc['region']['startLine']}"
+          f": {r['ruleId']} {r['message']['text'][:100]}")
+PY
+
+exit "$rc"

@@ -1,0 +1,45 @@
+"""JX015 should-flag fixtures: inconsistent shard_map partition specs."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _ident(x):
+    return x
+
+
+def _local_sum(x):
+    return jax.lax.psum(x, "data")
+
+
+def _local_stats(xb):
+    # the collectives.py hierarchical-reduction idiom: local partial,
+    # psum over ICI then DCN
+    part = jnp.sum(xb, axis=0)
+    return psum_over_mesh(part, ("data", "replica"))
+
+
+def unknown_axis(mesh, xs):
+    spec = P("batch")                                           # JX015
+    return shard_map_compat(_ident, mesh, (spec,), P())(xs)
+
+
+def duplicate_axis(mesh, xs):
+    spec = P("data", "data")                                    # JX015
+    return shard_map_compat(_ident, mesh, (spec,), P())(xs)
+
+
+def rank_overflow(mesh):
+    rows = jnp.zeros((8,))
+    return shard_map_compat(_ident, mesh, (P("data", None),), P())(rows)  # JX015
+
+
+def psummed_out_spec(mesh, xs):
+    return shard_map_compat(_local_sum, mesh, (P("data"),), P("data"))(xs)  # JX015
+
+
+def hierarchical_wrong_out(mesh, xb):
+    # the mesh-rebuild-era hazard: the body reduced over BOTH axes, the
+    # out_spec still claims the row sharding
+    row_spec = P(("replica", "data"))
+    return shard_map_compat(_local_stats, mesh, (row_spec,), row_spec)(xb)  # JX015

@@ -1,0 +1,40 @@
+"""JX015 should-pass fixtures: the repo's shard_map spec idioms."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _local_psummed(x):
+    return jax.lax.psum(jnp.sum(x, axis=0), "data")
+
+
+def _local_state(x):
+    # with_state shape: psummed stats + row-sharded state
+    stats = jax.lax.psum(jnp.sum(x, axis=0), "data")
+    return stats, x
+
+
+def reduced_out_replicated(mesh, xs):
+    # psummed body + replicated out_spec: the canonical aggregate
+    spec = P((REPLICA_AXIS, DATA_AXIS))
+    return shard_map_compat(_local_psummed, mesh, (spec,), P())(xs)
+
+
+def state_keeps_row_sharding(mesh, xs):
+    # out element 0 replicated (psummed), element 1 keeps row sharding
+    row_spec = P((REPLICA_AXIS, DATA_AXIS))
+    out_specs = (P(), row_spec)
+    return shard_map_compat(_local_state, mesh, (row_spec,), out_specs)(xs)
+
+
+def uniform_specs_unknown_count(mesh, arrays):
+    # `(spec,) * len(...)` — uniform spec over an unknown operand count
+    row_spec = P((REPLICA_AXIS, DATA_AXIS))
+    return shard_map_compat(_local_psummed, mesh,
+                            (row_spec,) * len(arrays), P())(*arrays)
+
+
+def rank_matches(mesh):
+    rows = jnp.zeros((8, 4))
+    return shard_map_compat(_local_psummed, mesh,
+                            (P("data", None),), P())(rows)

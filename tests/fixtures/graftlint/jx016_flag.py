@@ -1,0 +1,42 @@
+"""JX016 should-flag fixtures: provable dim conflicts, unmasked means
+over padded buffers."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def broadcast_conflict():
+    a = jnp.zeros((4, 16))
+    b = jnp.zeros((8, 16))
+    return a + b                                                # JX016
+
+
+def matmul_inner_conflict():
+    x = jnp.zeros((4, 8))
+    w = jnp.zeros((16,))
+    return x @ w                                                # JX016
+
+
+def bucket_mean(rows):
+    # the serving-bucket idiom gone wrong: rows padded up to the bucket,
+    # then a raw mean divides by the bucket size
+    k, d = rows.shape
+    buf = np.zeros((64, 4))
+    buf[:k] = rows
+    return jnp.mean(buf, axis=0)                                # JX016
+
+
+def at_set_mean(rows):
+    k, d = rows.shape
+    buf = jnp.zeros((64, 4)).at[:k].set(rows)
+    return buf.mean(0)                                          # JX016
+
+
+def _kernel_mean(x):
+    return jnp.mean(x, axis=0)
+
+
+def padded_call_mean(rows):
+    # interprocedural: the kernel means over dim 0, the CALLER pads it
+    padded = jnp.pad(rows, ((0, 8), (0, 0)))
+    return _kernel_mean(padded)                                 # JX016

@@ -1,0 +1,48 @@
+"""JX016 should-pass fixtures: masked reductions over padded buffers,
+compatible broadcasts."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def device_chunk_masked(rows, w):
+    # the deviceChunk idiom: pad the last chunk, mask with w=0 — the
+    # reductions carry the mask, so padding is bitwise-neutral
+    k, d = rows.shape
+    buf = np.zeros((64, 8))
+    buf[:k] = rows
+    wbuf = np.zeros((64,))
+    wbuf[:k] = w
+    total = jnp.sum(buf * wbuf[:, None], axis=0)
+    count = jnp.sum(wbuf)
+    return total / count
+
+
+def sliced_mean(rows):
+    # slicing the padding off before the reduction is fine
+    k, d = rows.shape
+    buf = np.zeros((64, 8))
+    buf[:k] = rows
+    return jnp.mean(buf[:k], axis=0)
+
+
+def feature_mean_of_row_padded(rows):
+    # mean over the FEATURE dim of a row-padded buffer never touches the
+    # pad rows' count
+    k, d = rows.shape
+    buf = np.zeros((64, 8))
+    buf[:k] = rows
+    return jnp.mean(buf, axis=1)[:k]
+
+
+def compatible_broadcast():
+    a = jnp.zeros((4, 16))
+    b = jnp.zeros((16,))
+    return a + b
+
+
+def symbolic_dims_stay_silent(x, y):
+    # distinct symbols MAY be equal at runtime — only provable (concrete)
+    # conflicts flag
+    n, d = x.shape
+    m, k = y.shape
+    return jnp.zeros((n, d)) + jnp.zeros((n, d)), jnp.zeros((m, k))

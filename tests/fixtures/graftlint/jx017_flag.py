@@ -1,0 +1,59 @@
+"""JX017 should-flag fixtures: programs dispatched across a mesh rebuild."""
+import jax
+import jax.numpy as jnp
+
+
+def _sum_kernel(xb, coef):
+    return jnp.sum(xb, axis=0)
+
+
+def _build_step(runtime, xb):
+    # helper returns the compiled aggregation program
+    return tree_aggregate(_sum_kernel, runtime, xb)
+
+
+def _recover(supervisor):
+    # helper that (transitively) rebuilds the mesh
+    supervisor.rebuild_mesh()
+
+
+def stale_after_helper_recover(runtime, supervisor, xb, coef):
+    # the MeshSupervisor-rebuild hazard, interprocedural on BOTH sides:
+    # the program comes from one helper, the rebuild hides in another,
+    # and this untouched caller holds the stale reference
+    step = _build_step(runtime, xb)
+    _recover(supervisor)
+    return step(xb, coef)                                       # JX017
+
+
+def stale_after_reset(runtime, xb, coef):
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    mesh.reset()
+    return step(xb, coef)                                       # JX017
+
+
+def loop_rebuild_second_iteration(runtime, supervisor, xb, coef):
+    # textually the dispatch precedes the recovery — but the SECOND
+    # iteration dispatches the pre-rebuild program
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    out = None
+    for _ in range(3):
+        out = step(xb, coef)                                    # JX017
+        _recover(supervisor)
+    return out
+
+
+def rebuild_in_branch_then_dispatch(runtime, supervisor, xb, coef, dead):
+    # the rebuild arm FALLS THROUGH: the dispatch below runs after a
+    # rebuild on the dead path
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    if dead:
+        _recover(supervisor)
+    return step(xb, coef)                                       # JX017
+
+
+class Trainer:
+    def fit(self, runtime, supervisor, xb, coef):
+        self._step = tree_aggregate(_sum_kernel, runtime, xb)
+        _recover(supervisor)
+        return self._step(xb, coef)                             # JX017

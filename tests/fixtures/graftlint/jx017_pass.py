@@ -1,0 +1,57 @@
+"""JX017 should-pass fixtures: the clear-then-rebuild recovery idiom."""
+import jax
+import jax.numpy as jnp
+
+
+def _sum_kernel(xb, coef):
+    return jnp.sum(xb, axis=0)
+
+
+def _recover(supervisor):
+    supervisor.rebuild_mesh()
+
+
+def recover_then_rebuild(runtime, supervisor, xb, coef):
+    # the MeshSupervisor.recover idiom: drop the caches, rebuild the
+    # mesh, then REBUILD the program before dispatching
+    clear_program_cache()
+    supervisor.rebuild_mesh()
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    return step(xb, coef)
+
+
+def rebind_after_rebuild(runtime, supervisor, xb, coef):
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    out = step(xb, coef)
+    _recover(supervisor)
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    return out + step(xb, coef)
+
+
+def no_rebuild_in_sight(runtime, xb, coef):
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    out = None
+    for _ in range(3):
+        out = step(xb, coef)
+    return out
+
+
+def exclusive_branch_recover(runtime, supervisor, xb, coef, dead):
+    # the branches are exclusive: the rebuild arm RETURNS, so the
+    # dispatch arm only runs when no rebuild happened
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    if dead:
+        _recover(supervisor)
+        return None
+    return step(xb, coef)
+
+
+def loop_rebinds_each_iteration(runtime, supervisor, xb, coef):
+    # per-iteration rebuild is safe when the program is REBUILT at the
+    # top of every iteration (tree_aggregate's cache makes this cheap)
+    out = None
+    for _ in range(3):
+        step = tree_aggregate(_sum_kernel, runtime, xb)
+        out = step(xb, coef)
+        _recover(supervisor)
+    return out

@@ -1,0 +1,38 @@
+"""JX018 should-flag fixtures: O(n) host materialization on fit paths."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sum_kernel(xb, yb, coef):
+    return jnp.sum(xb, axis=0)
+
+
+def fit_pulls_residuals(runtime, xb, yb, coef):
+    step = tree_aggregate(_sum_kernel, runtime, xb, yb)
+    stats = step(xb, yb, coef)
+    n, d = xb.shape
+    resid = jnp.zeros((n,))
+    host = np.asarray(resid)                                    # JX018
+    return stats, host
+
+
+def fit_spills_design_matrix(runtime, xb, yb, coef):
+    # the out-of-core spill-path hazard: the WHOLE sharded design matrix
+    # pulled to host inside the fit loop
+    step = tree_aggregate(_sum_kernel, runtime, xb, yb)
+    stats = step(xb, yb, coef)
+    spill = xb.tolist()                                         # JX018
+    return stats, spill
+
+
+def _pull(v):
+    return np.asarray(v)
+
+
+def train_epoch(runtime, xb, coef):
+    # interprocedural: the materializer hides in a helper
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    n = xb.shape[0]
+    preds = jnp.zeros((n,))
+    return step(xb, coef), _pull(preds)                         # JX018

@@ -1,0 +1,35 @@
+"""JX019 should-flag fixtures: typo'd cyclone.* conf keys."""
+
+
+class ConfigBuilder:
+    def __init__(self, key):
+        self._key = key
+
+    def doc(self, d):
+        return self
+
+    def int_conf(self, default=None):
+        return self
+
+    def with_alternative(self, key):
+        return self
+
+
+WINDOW_MS = ConfigBuilder("cyclone.serving.windowMs").int_conf(25)
+MAX_BATCH = (ConfigBuilder("cyclone.serving.maxBatch")
+             .with_alternative("cyclone.serving.batchMax")
+             .int_conf(512))
+
+
+def read_window(conf):
+    # one dropped letter: silently reads the default forever
+    return conf.get("cyclone.serving.windwMs")                  # JX019
+
+
+def set_bad_key(conf):
+    conf.set("cyclone.serving.maxBach", 256)                    # JX019
+
+
+def tuple_pair(pairs):
+    # submit.py-style (key, value) pair building
+    pairs.append(("cyclone.servng.windowMs", 5))                # JX019

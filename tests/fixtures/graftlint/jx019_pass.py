@@ -1,0 +1,35 @@
+"""JX019 should-pass fixtures: registered keys, prefixes, dynamic keys."""
+
+
+class ConfigBuilder:
+    def __init__(self, key):
+        self._key = key
+
+    def doc(self, d):
+        return self
+
+    def int_conf(self, default=None):
+        return self
+
+
+WINDOW_MS = ConfigBuilder("cyclone.serving.windowMs").int_conf(25)
+MAX_BATCH = ConfigBuilder("cyclone.serving.maxBatch").int_conf(512)
+
+
+def read_registered(conf):
+    return conf.get("cyclone.serving.windowMs")
+
+
+def namespace_scan(conf):
+    # a strict PREFIX of a registered key: the startswith idiom
+    return [k for k in conf if k.startswith("cyclone.serving.")]
+
+
+def dynamic_key(conf, name):
+    # dynamic keys are not literals — out of scope by construction
+    return conf.get(f"cyclone.serving.{name}")
+
+
+def prose_mention():
+    # keys inside prose never fullmatch
+    raise ValueError("cyclone.serving.windowMs must be positive, got -1")

@@ -31,7 +31,8 @@ PACKAGE = os.path.join(REPO, "cycloneml_tpu")
 BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
 
 RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-         "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014")
+         "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014",
+         "JX015", "JX016", "JX017", "JX018", "JX019")
 
 
 def marker_lines(path: str, rule: str):
@@ -476,9 +477,13 @@ def test_mesh_axes_discovered_from_source():
                                                load_module)
     mesh_py = os.path.join(PACKAGE, "mesh.py")
     mod = load_module(mesh_py, "cycloneml_tpu/mesh.py")
-    axes, names = _discover_axes({mod.path: mod})
+    axes, names, mapping = _discover_axes({mod.path: mod})
     assert set(axes) == {"data", "replica", "model"}
     assert names == {"DATA_AXIS", "REPLICA_AXIS", "MODEL_AXIS"}
+    # the constant->value map feeds the abstract interpreter's spec
+    # resolution (P((REPLICA_AXIS, DATA_AXIS)))
+    assert mapping == {"DATA_AXIS": "data", "REPLICA_AXIS": "replica",
+                       "MODEL_AXIS": "model"}
 
 
 # -- golden CLI output for the concurrency rules (JX011/JX013) ---------------
@@ -533,6 +538,101 @@ def test_rule_registry_matches_fixture_sweep():
         for suffix in ("flag", "pass"):
             path = os.path.join(FIXTURES, f"{rule.lower()}_{suffix}.py")
             assert os.path.exists(path), f"missing fixture {path}"
+
+
+def test_rule_registry_matches_docs():
+    """Every registered rule has a `### JXnnn` section in
+    docs/graftlint.md — docs drift used to go uncaught; a rule added
+    without its docs page fails here."""
+    docs = os.path.join(REPO, "docs", "graftlint.md")
+    with open(docs, encoding="utf-8") as fh:
+        text = fh.read()
+    from cycloneml_tpu.analysis.rules import ALL_RULES
+    missing = [cls.rule_id for cls in ALL_RULES
+               if not re.search(rf"^### {cls.rule_id}\b", text,
+                                flags=re.MULTILINE)]
+    assert missing == [], f"rules without docs/graftlint.md sections: " \
+                          f"{missing}"
+
+
+# -- deterministic report ordering (golden) ----------------------------------
+
+def test_report_ordering_is_deterministic():
+    """--json and --sarif emit findings sorted by (path, line, rule)
+    regardless of discovery order — CI diffs and SARIF fingerprint
+    ordering must not churn when unrelated rules reorder."""
+    from cycloneml_tpu.analysis.engine import Finding
+    from cycloneml_tpu.analysis.report import render_json, render_sarif
+    shuffled = [
+        Finding("JX009", "b.py", 4, 0, "m3"),
+        Finding("JX001", "b.py", 4, 0, "m2"),
+        Finding("JX002", "a.py", 9, 0, "m1"),
+        Finding("JX001", "a.py", 2, 0, "m0"),
+    ]
+    payload = json.loads(render_json(shuffled))
+    assert [(f["path"], f["line"], f["rule"])
+            for f in payload["findings"]] == [
+        ("a.py", 2, "JX001"), ("a.py", 9, "JX002"),
+        ("b.py", 4, "JX001"), ("b.py", 4, "JX009")]
+    doc = json.loads(render_sarif(shuffled))
+    results = doc["runs"][0]["results"]
+    keys = [(r["locations"][0]["physicalLocation"]["artifactLocation"]
+             ["uri"],
+             r["locations"][0]["physicalLocation"]["region"]["startLine"],
+             r["ruleId"]) for r in results]
+    assert keys == sorted(keys)
+    # golden: byte-identical output for the same findings in any order
+    assert render_json(shuffled) == render_json(list(reversed(shuffled)))
+    assert render_sarif(shuffled) == render_sarif(list(reversed(shuffled)))
+
+
+# -- per-rule timings ---------------------------------------------------------
+
+def test_json_carries_per_rule_timings(capsys):
+    """--json gains a per-rule wall-time block: one entry per rule id
+    plus the shared JXSHAPE analysis, all non-negative floats."""
+    flag = os.path.join(FIXTURES, "jx002_flag.py")
+    assert graftlint_main([flag, "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    timings = payload["timings"]
+    for rule in RULES:
+        assert rule in timings, f"no timing entry for {rule}"
+        assert timings[rule] >= 0.0
+    assert "JXSHAPE" in timings   # the shared abstract shape analysis
+
+
+def test_text_output_prints_slowest_rules(capsys):
+    """`make lint` (the plain text reporter) surfaces the top-3 slowest
+    rules so rule authors see their cost on every run."""
+    clean = os.path.join(FIXTURES, "jx002_pass.py")
+    assert graftlint_main([clean]) == 0
+    out = capsys.readouterr().out
+    assert "slowest rules:" in out
+
+
+# -- full-run parse cache (CI reuse via CYCLONE_LINT_CACHE) ------------------
+
+def test_full_run_cache_via_env(tmp_path, monkeypatch, capsys):
+    """A full-scope run reuses the ParseCache when CYCLONE_LINT_CACHE
+    names one (CI restores the pickle between jobs); the second run
+    serves parses from the cache."""
+    from cycloneml_tpu.analysis.incremental import ParseCache
+    cache_file = tmp_path / "ci-cache.pkl"
+    monkeypatch.setenv("CYCLONE_LINT_CACHE", str(cache_file))
+    flag = os.path.join(FIXTURES, "jx002_flag.py")
+    assert graftlint_main([flag]) == 1
+    capsys.readouterr()
+    assert cache_file.exists()
+    assert graftlint_main([flag]) == 1
+    capsys.readouterr()
+    probe = ParseCache(str(cache_file))
+    rel = [k for k in probe._entries]
+    assert any(k.endswith("jx002_flag.py") for k in rel)
+    # --no-cache still disables it
+    monkeypatch.setenv("CYCLONE_LINT_CACHE", str(tmp_path / "other.pkl"))
+    assert graftlint_main([flag, "--no-cache"]) == 1
+    capsys.readouterr()
+    assert not (tmp_path / "other.pkl").exists()
 
 
 # -- parse cache: schema-keyed invalidation ----------------------------------

