@@ -2,7 +2,7 @@
 # also enforced by tests/test_graftlint.py) and `make test`.
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
-	bench bench-bytes serve-demo
+	bench bench-bytes bench-oocore serve-demo
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -56,6 +56,13 @@ bench:
 # the fp32 sweep's bytes (XLA cost-analysis ground truth, lower-only)
 bench-bytes:
 	python scripts/bench_bytes.py
+
+# out-of-core acceptance: streamed vs in-core wall time, epoch sweep
+# bytes + O(shard) peak via costs.streamed_sweep_cost, and the
+# transfer/compute overlap fraction from the stream spans — exits
+# nonzero if overlap < 30% on the 8-device CPU smoke
+bench-oocore:
+	python scripts/bench_oocore.py
 
 # serving acceptance demo: 2 models, concurrent request storm, asserts
 # compile-count == bucket-count and p99 under the window bound

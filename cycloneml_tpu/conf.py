@@ -665,6 +665,64 @@ SERVING_MAX_RETRIES = (
     .int_conf(3)
 )
 
+OOCORE_MODE = (
+    ConfigBuilder("cyclone.oocore.mode")
+    .doc("Out-of-core streaming fit mode (oocore/): 'auto' (default) keeps "
+         "in-core fits but DEGRADES to the streaming epoch engine when the "
+         "memory budget guard's chunk-halving bottoms out at deviceChunk=1 "
+         "with the program still over budget (instead of warn/raise); "
+         "'force' routes every eligible dense fit through the streaming "
+         "path (each loss/grad evaluation is one double-buffered epoch "
+         "over host shards); 'off' disables streaming entirely — the "
+         "guard's pre-oocore warn/raise behavior applies.")
+    .check_value(lambda v: v in ("auto", "force", "off"),
+                 "must be auto, force or off")
+    .mutable()
+    .str_conf("auto")
+)
+
+OOCORE_SHARD_ROWS = (
+    ConfigBuilder("cyclone.oocore.shardRows")
+    .doc("Rows per out-of-core shard. Every shard is padded to ONE fixed "
+         "(padRows, d) geometry (zero-weight padding rows, masked out of "
+         "the psums), so a single compiled per-shard program serves the "
+         "whole epoch; host staging peaks at O(shardRows · d), never "
+         "O(n · d). Sized so one shard's device footprint is well under "
+         "the memory budget while staying large enough that transfer "
+         "latency amortizes (the double buffer hides it behind compute).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(65536)
+)
+
+OOCORE_PREFETCH_DEPTH = (
+    ConfigBuilder("cyclone.oocore.prefetchDepth")
+    .doc("Staged shards in flight ahead of compute (the pinned ring): 2 = "
+         "classic double buffering — shard N+1's host read + h2d transfer "
+         "overlaps shard N's compute. Device-resident shard copies are "
+         "bounded by depth + 1; higher values only help when staging "
+         "jitter exceeds one shard's compute time.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(2)
+)
+
+OOCORE_MAX_RETRIES = (
+    ConfigBuilder("cyclone.oocore.maxRetries")
+    .doc("Retries for a TRANSIENT shard-staging failure (resilience "
+         "classification; seeded backoff) before the epoch aborts. "
+         "Permanent failures abort immediately with the stream drained "
+         "and the staging thread released — never a hang.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(3)
+)
+
+OOCORE_DIR = (
+    ConfigBuilder("cyclone.oocore.dir")
+    .doc("Directory for out-of-core shard files (npz, data-tier packed). "
+         "Empty = the system temp dir. Shard sets built by the engine own "
+         "their files and remove them on close/GC.")
+    .str_conf("")
+)
+
 TRACE_ENABLED = (
     ConfigBuilder("cyclone.trace.enabled")
     .doc("Enable step-level tracing (observe/): hierarchical spans over "

@@ -88,6 +88,13 @@ def read_libsvm(ctx, path: str, n_features: Optional[int] = None,
     return InstanceDataset.from_numpy(ctx, x, y)
 
 
+def iter_libsvm_chunks(path: str, n_features: int, chunk_rows: int = 65536):
+    """Public alias of the dense libsvm chunk stream — the ``(x, y, w)``
+    chunk contract shared by ``InstanceDataset.from_dense_chunks`` and the
+    out-of-core shard builder (``oocore.StreamingDataset.from_chunks``)."""
+    return _libsvm_dense_chunks(path, n_features, chunk_rows)
+
+
 def _libsvm_dense_chunks(path: str, n_features: int,
                          chunk_rows: int = 65536):
     """Yield (x, y, None) dense blocks from the bounded-memory CSR streamer;
@@ -106,14 +113,10 @@ def _libsvm_dense_chunks(path: str, n_features: int,
         yield x, cy, None
 
 
-def read_npy_chunked(ctx, path: str, label_col: Optional[int] = None,
-                     chunk_rows: int = 65536) -> InstanceDataset:
-    """Out-of-core ingest of a .npy 2-D array: chunks are read with plain
-    ``file.read`` (no mmap — mapped pages would count toward driver RSS and
-    defeat the bounded-memory contract) and placed on the mesh as they
-    arrive. ``label_col`` splits one column off as the label."""
+def npy_header(path: str):
+    """``(n_rows, n_cols, dtype)`` of a C-order 2-D .npy file — the shape
+    probe the chunked/streamed readers size themselves from."""
     import numpy.lib.format as npf
-
     with open(path, "rb") as fh:
         version = npf.read_magic(fh)
         if version == (1, 0):
@@ -122,28 +125,83 @@ def read_npy_chunked(ctx, path: str, label_col: Optional[int] = None,
             shape, fortran, dt = npf.read_array_header_2_0(fh)
         else:
             shape, fortran, dt = npf._read_array_header(fh, version)
-        if fortran or len(shape) != 2:
-            raise ValueError("read_npy_chunked requires a C-order 2-D array")
-        n, d_file = shape
-        d = d_file - (1 if label_col is not None else 0)
-        row_bytes = d_file * dt.itemsize
+    if fortran or len(shape) != 2:
+        raise ValueError("chunked .npy ingest requires a C-order 2-D array")
+    return shape[0], shape[1], dt
 
-        def chunks():
-            done = 0
-            while done < n:
-                m = min(chunk_rows, n - done)
-                buf = fh.read(m * row_bytes)
-                if len(buf) != m * row_bytes:
-                    raise IOError(f"truncated .npy payload in {path!r}")
-                block = np.frombuffer(buf, dtype=dt).reshape(m, d_file)
-                if label_col is None:
-                    yield block, None, None
-                else:
-                    y = block[:, label_col].astype(np.float64)
-                    yield np.delete(block, label_col, axis=1), y, None
-                done += m
 
-        return InstanceDataset.from_dense_chunks(ctx, chunks(), d)
+def iter_npy_chunks(path: str, label_col: Optional[int] = None,
+                    chunk_rows: int = 65536):
+    """Yield ``(x, y_or_None, None)`` blocks of a 2-D .npy file with plain
+    ``file.read`` (no mmap — mapped pages would count toward driver RSS and
+    defeat the bounded-memory contract). The chunk contract shared by
+    ``read_npy_chunked`` and the out-of-core shard builder."""
+    import numpy.lib.format as npf
+    n, d_file, dt = npy_header(path)
+    row_bytes = d_file * dt.itemsize
+    with open(path, "rb") as fh:
+        version = npf.read_magic(fh)
+        if version == (1, 0):
+            npf.read_array_header_1_0(fh)
+        elif version == (2, 0):
+            npf.read_array_header_2_0(fh)
+        else:
+            npf._read_array_header(fh, version)
+        done = 0
+        while done < n:
+            m = min(chunk_rows, n - done)
+            buf = fh.read(m * row_bytes)
+            if len(buf) != m * row_bytes:
+                raise IOError(f"truncated .npy payload in {path!r}")
+            block = np.frombuffer(buf, dtype=dt).reshape(m, d_file)
+            if label_col is None:
+                yield block, None, None
+            else:
+                y = block[:, label_col].astype(np.float64)
+                yield np.delete(block, label_col, axis=1), y, None
+            done += m
+
+
+def read_npy_chunked(ctx, path: str, label_col: Optional[int] = None,
+                     chunk_rows: int = 65536) -> InstanceDataset:
+    """Out-of-core ingest of a .npy 2-D array: chunks stream through
+    :func:`iter_npy_chunks` and land on the mesh as they arrive.
+    ``label_col`` splits one column off as the label."""
+    _, d_file, _ = npy_header(path)
+    d = d_file - (1 if label_col is not None else 0)
+    return InstanceDataset.from_dense_chunks(
+        ctx, iter_npy_chunks(path, label_col, chunk_rows), d)
+
+
+def _first_data_line(fh, skip_header: bool):
+    if skip_header:
+        fh.readline()
+    for line in fh:  # blank lines anywhere (incl. leading) are skipped
+        if line.strip():
+            return line
+    return None
+
+
+def iter_csv_chunks(path: str, label_col: int = 0, delimiter: str = ",",
+                    skip_header: bool = False, chunk_rows: int = 65536):
+    """Yield ``(x, y, None)`` blocks of a CSV file, one line batch at a
+    time — the chunk contract shared by ``read_csv_chunked`` and the
+    out-of-core shard builder."""
+    with open(path) as fh:
+        first = _first_data_line(fh, skip_header)
+        if first is None:
+            return
+        d_file = len(first.split(delimiter))
+        batch = [first]
+        for line in fh:
+            if not line.strip():
+                continue
+            batch.append(line)
+            if len(batch) >= chunk_rows:
+                yield _csv_block(batch, delimiter, d_file, label_col)
+                batch = []
+        if batch:
+            yield _csv_block(batch, delimiter, d_file, label_col)
 
 
 def read_csv_chunked(ctx, path: str, label_col: int = 0, delimiter: str = ",",
@@ -151,38 +209,15 @@ def read_csv_chunked(ctx, path: str, label_col: int = 0, delimiter: str = ",",
                      chunk_rows: int = 65536) -> InstanceDataset:
     """Out-of-core CSV ingest: parse line batches and place each block on
     the mesh as it is read; driver peak memory is one block."""
-    def first_data_line(fh):
-        if skip_header:
-            fh.readline()
-        for line in fh:  # blank lines anywhere (incl. leading) are skipped
-            if line.strip():
-                return line
-        return None
-
-    def chunks():
-        with open(path) as fh:
-            first = first_data_line(fh)
-            if first is None:
-                return
-            d_file = len(first.split(delimiter))
-            batch = [first]
-            for line in fh:
-                if not line.strip():
-                    continue
-                batch.append(line)
-                if len(batch) >= chunk_rows:
-                    yield _csv_block(batch, delimiter, d_file, label_col)
-                    batch = []
-            if batch:
-                yield _csv_block(batch, delimiter, d_file, label_col)
-
     # peek the width for from_dense_chunks without consuming the stream
     with open(path) as fh:
-        head = first_data_line(fh)
+        head = _first_data_line(fh, skip_header)
     if head is None:
         raise ValueError(f"{path!r} has no data rows")
     d = len(head.split(delimiter)) - 1
-    return InstanceDataset.from_dense_chunks(ctx, chunks(), d)
+    return InstanceDataset.from_dense_chunks(
+        ctx, iter_csv_chunks(path, label_col, delimiter, skip_header,
+                             chunk_rows), d)
 
 
 def _csv_block(lines, delimiter, d_file, label_col):

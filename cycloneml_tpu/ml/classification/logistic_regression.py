@@ -477,16 +477,36 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         import jax
         import jax.numpy as jnp
 
+        from cycloneml_tpu.oocore import StreamingDataset, streaming_mode
+        streamed = isinstance(ds, StreamingDataset)
+        if not streamed and \
+                streaming_mode(getattr(ds.ctx, "conf", None)) == "force":
+            # explicit streaming mode: spill the in-core dataset to shards
+            # and run the same fit over streamed epochs; the spill is owned
+            # by THIS fit, so its files are removed once the model is built
+            from cycloneml_tpu.oocore import shard_dataset
+            sds = shard_dataset(ds)
+            try:
+                return self._fit_dataset(sds)
+            finally:
+                sds.close()
+
         d = ds.n_features
-        stats = Summarizer.summarize(ds)
+        # streamed datasets carry their Summarizer moments and the label
+        # histogram from the shard WRITE pass — no stats epoch is paid
+        stats = ds.summary() if streamed else Summarizer.summarize(ds)
         features_std = stats.std
         weight_sum = stats.weight_sum
 
         # label histogram via one psum pass (≈ the summary treeAggregate at
         # LogisticRegression.scala:515 area)
-        y_host = ds.y_host()
-        w_host = ds.w_host()
-        num_classes = int(y_host.max()) + 1 if ds.n_rows else 2
+        if streamed:
+            hist = ds.label_histogram()
+            num_classes = max(len(hist), 2) if ds.n_rows else 2
+        else:
+            y_host = ds.y_host()
+            w_host = ds.w_host()
+            num_classes = int(y_host.max()) + 1 if ds.n_rows else 2
         family = self.get("family")
         if family == "auto":
             is_multinomial = num_classes > 2
@@ -497,8 +517,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                     f"Binomial family requires <= 2 label classes, found "
                     f"{num_classes} (the reference rejects this too)")
             num_classes = max(num_classes, 2)
-        histogram = np.bincount(y_host.astype(np.int64), weights=w_host,
-                                minlength=num_classes)[:num_classes]
+        if streamed:
+            histogram = np.zeros(num_classes)
+            histogram[:len(hist)] = hist[:num_classes]
+        else:
+            histogram = np.bincount(y_host.astype(np.int64), weights=w_host,
+                                    minlength=num_classes)[:num_classes]
 
         fit_intercept = self.get("fitIntercept")
         standardize = self.get("standardization")
@@ -520,7 +544,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         from cycloneml_tpu.ops.kernels import use_fused_kernels
         from cycloneml_tpu.parallel import feature_sharding as fs
         m = fs.model_parallelism(rt)
-        tp_active = (not is_multinomial) and m > 1 and d % m == 0
+        tp_active = (not is_multinomial) and m > 1 and d % m == 0 \
+            and not streamed
         # fused Pallas kernels are the DEFAULT sweep on natively-lowered
         # backends (usePallasKernels=auto): one VMEM-resident row pass per
         # evaluation, bf16 blocks read at storage width with fp32 in-kernel
@@ -589,10 +614,18 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             # corrections (inv_std∘g − μ̂·Σmult) must not round through the
             # bf16 data tier
             adt = compute_dtype()
-            loss_fn = DistributedLossFunction(
-                ds, agg, l2_fn, weight_sum,
-                extra_args=(jnp.asarray(inv_std.astype(adt)),
-                            jnp.asarray(mu_or_zero.astype(adt))))
+            extras = (jnp.asarray(inv_std.astype(adt)),
+                      jnp.asarray(mu_or_zero.astype(adt)))
+            if streamed:
+                # the streamed twin: SAME aggregator, same extras, same
+                # normalization — one loss/grad evaluation is one
+                # double-buffered epoch over the shard set
+                from cycloneml_tpu.oocore import StreamingLossFunction
+                loss_fn = StreamingLossFunction(
+                    ds, agg, l2_fn, weight_sum, extra_args=extras)
+            else:
+                loss_fn = DistributedLossFunction(
+                    ds, agg, l2_fn, weight_sum, extra_args=extras)
 
         if self._has_bounds():
             # box-constrained path (ref createOptimizer selects BreezeLBFGSB
@@ -635,14 +668,31 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 from cycloneml_tpu.ml.optim.device_lbfgs import DeviceLBFGS
                 opt = DeviceLBFGS(max_iter=self.get("maxIter"),
                                   tol=self.get("tol"), chunk=chunk)
+                # this fit HAS a streaming twin: when chunk-halving bottoms
+                # out still over budget, degrade to it instead of
+                # warn-proceeding toward an OOM (cyclone.oocore.mode=auto)
+                opt.oocore_fallback = True
 
-        state = self._optimize(opt, loss_fn, x0, (
-            ds.n_rows, d, num_classes, float(weight_sum),
-            np.asarray(histogram).round(6).tolist(),
-            np.asarray(features_std).round(6).tolist(),
-            reg, alpha, self.get("tol"), fit_intercept, standardize,
-            fit_with_mean,
-        ))
+        from cycloneml_tpu.observe.costs import OutOfCoreRequired
+        try:
+            state = self._optimize(opt, loss_fn, x0, (
+                ds.n_rows, d, num_classes, float(weight_sum),
+                np.asarray(histogram).round(6).tolist(),
+                np.asarray(features_std).round(6).tolist(),
+                reg, alpha, self.get("tol"), fit_intercept, standardize,
+                fit_with_mean,
+            ))
+        except OutOfCoreRequired as e:
+            # the budget guard's terminal degradation: re-route the whole
+            # fit through the streaming epoch engine (same objective, host
+            # optimizer, O(shard) peak HBM) instead of OOMing/raising
+            logger.warning("LogisticRegression: %s", e)
+            from cycloneml_tpu.oocore import shard_dataset
+            sds = shard_dataset(ds)
+            try:
+                return self._fit_dataset(sds)
+            finally:
+                sds.close()
 
         sol = state.x
         if is_multinomial:
@@ -686,7 +736,8 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             objective_history=list(state.loss_history),
             total_iterations=state.iteration,
             total_evals=loss_fn.n_evals,
-            total_dispatches=loss_fn.n_dispatches)
+            total_dispatches=loss_fn.n_dispatches,
+            streamed=streamed)
         return model
 
     def copy(self, extra=None) -> "LogisticRegression":
@@ -808,7 +859,8 @@ class LogisticRegressionTrainingSummary:
     binary metrics come from ``model.evaluate(frame)``)."""
 
     def __init__(self, objective_history, total_iterations,
-                 total_evals=None, total_dispatches=None, n_models=1):
+                 total_evals=None, total_dispatches=None, n_models=1,
+                 streamed=False):
         self.objective_history = objective_history
         self.total_iterations = total_iterations
         # optimizer-path telemetry: loss/grad evaluations and host->device
@@ -819,6 +871,10 @@ class LogisticRegressionTrainingSummary:
         # >1 when this model trained inside a stacked (vmapped model-axis)
         # fit: its compiles AND dispatches were shared by n_models models
         self.n_models = n_models
+        # True when the fit ran on the out-of-core streaming engine —
+        # explicitly (oocore.mode=force / a StreamingDataset input) or by
+        # budget-guard degradation; dispatches then count SHARD dispatches
+        self.streamed = streamed
 
 
 class BinaryLogisticRegressionSummary:

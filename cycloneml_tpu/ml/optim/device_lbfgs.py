@@ -40,11 +40,20 @@ _program_cache = BoundedProgramCache(32)
 
 
 def _budget_guarded_chunk(name: str, key, prog, args, chunk: int, ctx,
-                          build):
+                          build, allow_stream: bool = False):
     """Compile-time memory budget guard for a chunk program: harvest its
     predicted peak HBM (XLA memory_analysis via observe/costs.py), post
     ``MemoryBudgetExceeded`` when it exceeds ``cyclone.memory.budgetFraction``
     × device memory, and degrade to a smaller chunk instead of OOMing.
+
+    ``allow_stream=True`` declares that the CALLER has an out-of-core
+    fallback (estimators set ``DeviceLBFGS.oocore_fallback``): when the
+    halving bottoms out at chunk 1 with the program still over budget and
+    ``cyclone.oocore.mode`` permits, the guard raises
+    ``costs.OutOfCoreRequired`` — the estimator catches it and re-routes
+    the fit through the streaming epoch engine instead of warn-proceeding
+    (or raising under ``budgetAction=raise``). Direct optimizer users
+    (no fallback declared) keep the pre-oocore warn/raise contract.
 
     Much of the footprint is chunk-INDEPENDENT (data arrays, coefficients,
     curvature history), so a proportional guess is only a starting point:
@@ -83,6 +92,13 @@ def _budget_guarded_chunk(name: str, key, prog, args, chunk: int, ctx,
         verdict = costs.check_budget(pid, conf=conf, bus=bus,
                                      allow_raise=False)
     if verdict is not None and verdict.exceeded:
+        if allow_stream:
+            from cycloneml_tpu.oocore.engine import degrade_allowed
+            if degrade_allowed(ctx):
+                # graceful at any data:memory ratio: the estimator owns a
+                # streaming twin of this fit — hand the decision back up
+                # instead of warn-proceeding toward an OOM or raising
+                raise costs.OutOfCoreRequired(name, verdict)
         if verdict.action == "raise":
             raise costs.MemoryBudgetError(
                 f"{name}: still {verdict.predicted_bytes} bytes/device over "
@@ -257,6 +273,10 @@ class DeviceLBFGS(LBFGS):
         super().__init__(max_iter, m, tol, grad_tol)
         self.chunk = max(int(chunk), 1)
         self.c1, self.c2, self.max_ls = c1, c2, max_ls
+        # set by estimators that own a streaming twin of the fit: lets the
+        # budget guard raise OutOfCoreRequired (caught by the estimator)
+        # when chunk-halving bottoms out still over budget
+        self.oocore_fallback = False
 
     def iterations(self, f, x0: np.ndarray,
                    resume: Optional[OptimState] = None):
@@ -352,7 +372,8 @@ class DeviceLBFGS(LBFGS):
                 guarded = True
                 chunk, key, prog, new_fresh = _budget_guarded_chunk(
                     "lbfgs.chunk", key, prog, args, chunk,
-                    getattr(f, "_ctx", None), build)
+                    getattr(f, "_ctx", None), build,
+                    allow_stream=self.oocore_fallback)
                 if new_fresh is not None:
                     fresh = new_fresh
                     self.effective_chunk = chunk

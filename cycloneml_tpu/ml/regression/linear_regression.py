@@ -90,13 +90,34 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         import jax
         import jax.numpy as jnp
 
+        from cycloneml_tpu.oocore import StreamingDataset, streaming_mode
+        streamed = isinstance(ds, StreamingDataset)
+        force = not streamed and \
+            streaming_mode(getattr(ds.ctx, "conf", None)) == "force"
+
         d = ds.n_features
         reg = self.get("regParam")
         alpha = self.get("elasticNetParam")
         solver = self.get("solver")
         if solver == "auto":
-            solver = "normal" if (alpha * reg == 0.0 and d <= MAX_FEATURES_FOR_NORMAL) \
-                else "l-bfgs"
+            # streamed fits always take the quasi-Newton path: the normal
+            # solver's moment system wants the in-core design matrix
+            solver = "normal" if (alpha * reg == 0.0
+                                  and d <= MAX_FEATURES_FOR_NORMAL
+                                  and not (streamed or force)) else "l-bfgs"
+        if (streamed or force) and solver == "normal":
+            # validated BEFORE any force-mode spill: an explicit normal
+            # request must not pay an O(n·d) shard write just to raise
+            raise ValueError(
+                "solver='normal' requires an in-core dataset; streamed "
+                "fits use solver='l-bfgs' (or 'auto')")
+        if force:
+            from cycloneml_tpu.oocore import shard_dataset
+            sds = shard_dataset(ds)
+            try:
+                return self._fit_dataset(sds)
+            finally:
+                sds.close()
 
         if solver == "normal":
             # delegate to the WLS COMPONENT exactly as the reference does
@@ -123,14 +144,20 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
                 max(len(wm.objective_history) - 1, 0))
             return model
 
-        stats = Summarizer.summarize(ds)
+        stats = ds.summary() if streamed else Summarizer.summarize(ds)
         x_mean, x_std = stats.mean, stats.std
         w_sum = stats.weight_sum
 
-        # label moments via one psum pass
-        ymom = ds.tree_aggregate_fn(
-            lambda x, y, w: {"s1": jnp.sum(w * y), "s2": jnp.sum(w * y * y),
-                             "w2": jnp.sum(w * w)})()
+        # label moments: one psum pass in-core; already harvested in the
+        # shard write pass for streamed datasets
+        if streamed:
+            s1y, s2y, w2y = ds.y_moments()
+            ymom = {"s1": s1y, "s2": s2y, "w2": w2y}
+        else:
+            ymom = ds.tree_aggregate_fn(
+                lambda x, y, w: {"s1": jnp.sum(w * y),
+                                 "s2": jnp.sum(w * y * y),
+                                 "w2": jnp.sum(w * w)})()
         y_mean = float(ymom["s1"]) / w_sum
         denom = w_sum - float(ymom["w2"]) / w_sum
         y_var = max((float(ymom["s2"]) - w_sum * y_mean ** 2) / denom, 0.0) if denom > 0 else 0.0
@@ -169,7 +196,8 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         model = LinearRegressionModel(coef, icpt, uid=self.uid)
         self._copy_values(model)
         model._set_parent(self)
-        model.summary = LinearRegressionTrainingSummary(history, max(len(history) - 1, 0))
+        model.summary = LinearRegressionTrainingSummary(
+            history, max(len(history) - 1, 0), streamed=streamed)
         return model
 
     # -- quasi-Newton in doubly standardized space -----------------------------
@@ -206,11 +234,21 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         l1 = alpha * reg
         l2_fn = l2_regularization(l2, d, False, features_std=x_std,
                                   standardize=standardize) if l2 > 0 else None
-        loss_fn = DistributedLossFunction(
-            ds, agg, l2_fn, stats.weight_sum,
-            extra_args=(jnp.asarray(inv_std.astype(adt)),
-                        jnp.asarray(scaled_mean.astype(adt)),
-                        jnp.asarray(y_pars.astype(adt))))
+        extras = (jnp.asarray(inv_std.astype(adt)),
+                  jnp.asarray(scaled_mean.astype(adt)),
+                  jnp.asarray(y_pars.astype(adt)))
+        from cycloneml_tpu.oocore import StreamingDataset
+        if isinstance(ds, StreamingDataset):
+            # the streamed twin: same scaled aggregator, same extras —
+            # each loss/grad evaluation is one double-buffered epoch
+            from cycloneml_tpu.oocore import StreamingLossFunction
+            loss_fn = StreamingLossFunction(ds, agg, l2_fn,
+                                            stats.weight_sum,
+                                            extra_args=extras)
+        else:
+            loss_fn = DistributedLossFunction(ds, agg, l2_fn,
+                                              stats.weight_sum,
+                                              extra_args=extras)
 
         if l1 > 0:
             l1_vec = np.full(d, l1)
@@ -282,6 +320,8 @@ class LinearRegressionModel(PredictionModel, _LinearRegressionParams,
 
 
 class LinearRegressionTrainingSummary:
-    def __init__(self, objective_history, total_iterations):
+    def __init__(self, objective_history, total_iterations, streamed=False):
         self.objective_history = objective_history
         self.total_iterations = total_iterations
+        # True when the fit ran on the out-of-core streaming engine
+        self.streamed = streamed

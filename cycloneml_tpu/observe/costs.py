@@ -49,18 +49,36 @@ from cycloneml_tpu.util.logging import get_logger
 logger = get_logger(__name__)
 
 __all__ = [
-    "ProgramCost", "MemoryBudgetError", "BudgetVerdict",
+    "ProgramCost", "MemoryBudgetError", "BudgetVerdict", "OutOfCoreRequired",
     "program_id", "analyze", "ensure", "lookup", "snapshot", "clear",
     "analyze_call_count", "note_execution", "check_budget", "guard_armed",
     "select_chunk", "backend_peaks", "device_memory_limit",
     "memory_stats_available", "register_memory_gauges", "sweep_cost",
-    "sample_device_peak",
+    "streamed_sweep_cost", "sample_device_peak",
 ]
 
 
 class MemoryBudgetError(RuntimeError):
     """Raised when ``cyclone.memory.budgetAction=raise`` and a program's
     predicted peak HBM exceeds the configured budget."""
+
+
+class OutOfCoreRequired(RuntimeError):
+    """Internal degradation signal: the budget guard walked deviceChunk
+    down to 1 and the program STILL exceeds the budget, but the caller
+    declared a streaming fallback (``cyclone.oocore.mode=auto``) — the fit
+    should re-route through the out-of-core epoch engine instead of
+    warn-proceeding or raising. Carries the terminal :class:`BudgetVerdict`
+    so the streaming path can log what it degraded from. Estimators catch
+    this; it must never escape to user code."""
+
+    def __init__(self, name: str, verdict: "BudgetVerdict"):
+        super().__init__(
+            f"{name}: {verdict.predicted_bytes} bytes/device predicted over "
+            f"the {verdict.budget_bytes}-byte budget at deviceChunk 1 — "
+            f"degrading to the out-of-core streaming engine")
+        self.name = name
+        self.verdict = verdict
 
 
 @dataclass
@@ -293,6 +311,30 @@ def sweep_cost(call, *extras, name: str = "sweep") -> ProgramCost:
     compiled = getattr(compiled, "__wrapped__", compiled)
     arrays = call.arrays() if hasattr(call, "arrays") else ()
     return analyze(compiled, (*arrays, *extras), name=name)
+
+
+def streamed_sweep_cost(prog, shard_args: tuple, n_shards: int,
+                        name: str = "oocore.sweep") -> ProgramCost:
+    """XLA's accounting for ONE STREAMED optimizer sweep — the out-of-core
+    extension of :func:`sweep_cost` (``make bench-oocore`` reads this).
+
+    ``prog`` is the per-shard aggregation program (the
+    ``_instrument_dispatch`` wrapper or the raw jitted program) and
+    ``shard_args`` one representative operand tuple at the padded shard
+    geometry. Work fields (``flops`` / ``bytes_accessed`` and their
+    ``*_total`` mesh-wide twins) are scaled by ``n_shards`` — the whole
+    epoch's traffic; MEMORY fields stay per-dispatch, because that is the
+    point of the streamed sweep: peak HBM is O(shard) no matter how many
+    shards the epoch walks. Lower-only, never executes."""
+    compiled = getattr(prog, "__wrapped__", prog)
+    cost = analyze(compiled, shard_args, name=name)
+    k = max(int(n_shards), 1)
+    for f in ("flops", "bytes_accessed", "flops_total",
+              "bytes_accessed_total"):
+        v = getattr(cost, f)
+        if v is not None:
+            setattr(cost, f, v * k)
+    return cost
 
 
 # -- live device-memory telemetry ----------------------------------------------

@@ -1,0 +1,35 @@
+"""Out-of-core streaming epoch engine: train on datasets larger than HBM.
+
+The in-core fit paths require the full design matrix resident on the mesh;
+this subsystem removes that ceiling. A dataset becomes a sequence of
+bounded host shards (``shards.StreamingDataset`` — npz files at data-tier
+width, with the fit statistics harvested in the same write pass); an epoch
+streams them through a double-buffered host→device pipeline
+(``stream.ShardStream`` — staging overlaps compute, shard operands donated
+so HBM is reclaimed per dispatch); the objective folds per-shard masked
+psum partials into one accumulator-tier sum
+(``objective.StreamingLossFunction`` — the same aggregators, the same
+normalization, seeded-parity with the in-core fit up to summation order);
+and routing (``engine``) makes streaming a first-class fit mode: explicit
+via ``cyclone.oocore.mode=force`` or a ``StreamingDataset`` handed to
+``fit``, automatic when the memory budget guard's chunk-halving bottoms
+out and the program still exceeds budget — degrade, don't OOM.
+
+docs/out-of-core.md is the architecture document; conf keys live under
+``cyclone.oocore.*``; the ``oocore.stage`` chaos point covers mid-epoch
+transfer failure.
+"""
+
+from cycloneml_tpu.observe.costs import OutOfCoreRequired
+from cycloneml_tpu.oocore.engine import (StreamingGradientDescent,
+                                         degrade_allowed, shard_dataset,
+                                         streaming_mode)
+from cycloneml_tpu.oocore.objective import StreamingLossFunction
+from cycloneml_tpu.oocore.shards import StreamingDataset
+from cycloneml_tpu.oocore.stream import ShardStream
+
+__all__ = [
+    "StreamingDataset", "ShardStream", "StreamingLossFunction",
+    "StreamingGradientDescent", "OutOfCoreRequired", "shard_dataset",
+    "streaming_mode", "degrade_allowed",
+]

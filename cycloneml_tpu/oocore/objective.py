@@ -1,0 +1,162 @@
+"""Streamed objective: partial-sweep gradient/loss accumulation.
+
+The out-of-core twin of ``ml/optim/loss.DistributedLossFunction``: one
+loss/grad evaluation is one EPOCH — every shard staged (double-buffered),
+dispatched through the SAME block aggregator the in-core fit uses, its
+psummed ``{loss, grad, count}`` partial folded into a host float64
+accumulator, and the total normalized by the weight sum exactly like the
+in-core path. Because the per-shard math is the identical aggregator over
+identically-masked padded blocks, a streamed fit's objective differs from
+the in-core fit's only by floating-point summation ORDER (shard partials
+vs device partials) — ~1e-15 relative under the f64 test config, the
+parity envelope docs/out-of-core.md documents.
+
+There is deliberately NO ``device_line_search`` here: the strong-Wolfe
+search runs on the host with each φ(α) evaluation a full streamed epoch —
+the line search over streamed objectives the out-of-core regime implies
+(evaluations cost I/O, so the optimizer's eval count is the fit's epoch
+count; L-BFGS' ~2-3 evals/iteration keeps that civilized).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.oocore.stream import ShardStream
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StreamingLossFunction:
+    """Callable ``(coef) -> (loss, grad)`` in host float64 over a
+    :class:`~cycloneml_tpu.oocore.shards.StreamingDataset`.
+
+    - ``agg``: the SAME block aggregator the in-core fit would use
+      (``aggregators.*`` — sums, not means; signature
+      ``(x, y, w, *extras, coef)``)
+    - ``extra_args``: replicated arguments before the coefficients
+      (inv_std / scaled_mean / y_pars), identical to the in-core
+      ``DistributedLossFunction(extra_args=...)`` contract
+    - ``l2_reg_fn``: the driver-side penalty, applied once per epoch
+    - the weight sum comes from the shard set's write-pass moments — no
+      extra epoch is spent measuring it
+    """
+
+    def __init__(self, sds, agg: Callable,
+                 l2_reg_fn: Optional[Callable] = None,
+                 weight_sum: Optional[float] = None,
+                 extra_args: tuple = ()):
+        from cycloneml_tpu.parallel import collectives
+        self._sds = sds
+        self._ctx = sds.ctx
+        rt = sds.ctx.mesh_runtime
+        # ONE per-shard program for the whole fit: compiled before any
+        # shard exists (n_sharded names the row-sharded args), with the
+        # staged shard operands DONATED — they are consumed exactly once,
+        # and donation frees their HBM for the next in-flight transfer
+        self._prog = collectives.tree_aggregate(agg, rt, n_sharded=3,
+                                                donate_rows=True)
+        self._extras = tuple(extra_args)
+        self.l2_reg_fn = l2_reg_fn
+        self.weight_sum = float(weight_sum) if weight_sum is not None \
+            else float(sds.weight_sum)
+        self.n_evals = 0
+        self.n_dispatches = 0   # shard dispatches (n_shards per epoch)
+        self.epochs = 0
+
+    # -- the streamed sweep ----------------------------------------------------
+    def sweep(self, *call_args, per_shard=None) -> dict:
+        """One epoch: stage every shard, dispatch the per-shard program,
+        fold the psummed partials into host float64 sums. Returns the raw
+        accumulated pytree (sums — the caller normalizes), mirroring what
+        one in-core ``tree_aggregate`` dispatch returns. ``per_shard(i)``
+        optionally supplies extra replicated arguments appended per shard
+        dispatch (the streamed SGD's shard-index mask key)."""
+        import jax
+        acc: Optional[dict] = None
+        self.epochs += 1
+        with tracing.span("dispatch", "oocore.sweep",
+                          shards=self._sds.n_shards) as sweep_sp:
+            with ShardStream(self._sds) as stream:
+                for i, xs, ys, ws in stream:
+                    args = call_args if per_shard is None \
+                        else (*call_args, *per_shard(i))
+                    with tracing.span("dispatch", "oocore.shard", shard=i):
+                        out_dev = self._prog(xs, ys, ws, *args)
+                        del xs, ys, ws  # donated: dead on dispatch
+                        with tracing.span("transfer",
+                                          "oocore.readback") as tsp:
+                            out = jax.device_get(out_dev)
+                            tsp.annotate_bytes(out)
+                    self.n_dispatches += 1
+                    if acc is None:
+                        acc = {k: np.asarray(v, dtype=np.float64)
+                               for k, v in out.items()}
+                    else:
+                        for k, v in out.items():
+                            acc[k] = acc[k] + np.asarray(v, dtype=np.float64)
+            sweep_sp.annotate(bytes_staged=stream.bytes_staged)
+        if acc is None:
+            raise RuntimeError("streamed sweep saw zero shards")
+        return acc
+
+    def __call__(self, coef: np.ndarray) -> Tuple[float, np.ndarray]:
+        self.n_evals += 1
+        out = self.sweep(*self._extras, np.asarray(coef))
+        loss = float(out["loss"]) / self.weight_sum
+        grad = np.asarray(out["grad"], dtype=np.float64) / self.weight_sum
+        if self.l2_reg_fn is not None:
+            rl, rg = self.l2_reg_fn(coef)
+            loss += float(rl)
+            grad += np.asarray(rg, dtype=np.float64)
+        if hasattr(self._ctx, "record_step"):
+            # one streamed epoch ≈ one stage's TaskMetrics
+            self._ctx.record_step({"loss": loss,
+                                   "oocore_shards": self._sds.n_shards})
+        return loss, grad
+
+    # -- accounting ------------------------------------------------------------
+    def _shard_avals(self, n_coef: int, concrete: bool = False) -> tuple:
+        """Representative per-shard operands at the padded geometry.
+        Abstract ``ShapeDtypeStruct``s by default — ``lower()`` only needs
+        avals, and a real O(shard) allocation here would compete for the
+        very HBM the streamed fit bounds; ``concrete=True`` is the
+        fallback for jax versions whose structs cannot carry sharding."""
+        import jax
+        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
+        sds = self._sds
+        xdt = np.dtype(data_dtype(getattr(sds.ctx, "conf", None)))
+        adt = np.dtype(compute_dtype())
+        rt = sds.ctx.mesh_runtime
+        if concrete:
+            x = rt.device_put_sharded_rows(
+                np.zeros((sds.pad_rows, sds.n_features), dtype=xdt))
+            y = rt.device_put_sharded_rows(np.zeros(sds.pad_rows, dtype=adt))
+            w = rt.device_put_sharded_rows(np.zeros(sds.pad_rows, dtype=adt))
+        else:
+            x = jax.ShapeDtypeStruct((sds.pad_rows, sds.n_features), xdt,
+                                     sharding=rt.data_sharding(1))
+            y = jax.ShapeDtypeStruct((sds.pad_rows,), adt,
+                                     sharding=rt.data_sharding(0))
+            w = jax.ShapeDtypeStruct((sds.pad_rows,), adt,
+                                     sharding=rt.data_sharding(0))
+        return (x, y, w, *self._extras,
+                np.zeros(n_coef, dtype=np.float64))
+
+    def sweep_cost(self, n_coef: int) -> costs.ProgramCost:
+        """:func:`observe.costs.streamed_sweep_cost` over this fit's
+        per-shard program at the padded shard geometry — the whole-epoch
+        bytes/FLOPs with the O(shard) per-dispatch memory footprint."""
+        cost = costs.streamed_sweep_cost(
+            self._prog, self._shard_avals(n_coef), self._sds.n_shards)
+        if not cost.cost_available:
+            # lower() rejected the abstract operands (older jax): pay the
+            # one concrete staging for the measurement
+            cost = costs.streamed_sweep_cost(
+                self._prog, self._shard_avals(n_coef, concrete=True),
+                self._sds.n_shards)
+        return cost

@@ -1,0 +1,359 @@
+"""Host shard store: the out-of-core dataset representation.
+
+A :class:`StreamingDataset` is what an estimator trains on when the design
+matrix must never fully materialize in device memory — the analog of the
+reference's disk-backed block store feeding tasks one partition at a time
+(ref BlockManager / UnifiedMemoryManager spill discipline, PAPER.md layer
+3c). It is a sequence of bounded npz shard files (data-tier packed X,
+accumulator-tier y/w) plus the ONE-pass statistics every fit path needs
+(Summarizer moments, label histogram, label moments, weight sum) —
+harvested while the shards are WRITTEN, so no extra epoch is spent on
+stats and no O(n) host vector survives construction.
+
+Geometry contract: every shard is padded — at STAGE time, not on disk —
+to one fixed ``(pad_rows, d)`` block (zero-weight rows, masked out of the
+psums exactly like the in-core padding), so a single compiled per-shard
+aggregation program serves the whole epoch and host staging peaks at
+O(pad_rows · d), never O(n · d).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: labels above this are not class indices — histogram harvesting stops
+_MAX_CLASSES = 4096
+
+
+@dataclass
+class _Moments:
+    """f64 running sums mirroring ``ml/stat/summarizer._moments`` (same
+    masking: rows with w > 0 are 'present') plus the label-side sums the
+    fit paths read (histogram for classifiers, y moments for regressors)."""
+
+    d: int
+    s1: np.ndarray = None
+    s2: np.ndarray = None
+    l1: np.ndarray = None
+    nnz: np.ndarray = None
+    mx: np.ndarray = None
+    mn: np.ndarray = None
+    w: float = 0.0
+    w2: float = 0.0
+    cnt: float = 0.0
+    s1y: float = 0.0
+    s2y: float = 0.0
+    histogram: Optional[np.ndarray] = None
+    integral_labels: bool = True
+
+    def __post_init__(self):
+        self.s1 = np.zeros(self.d)
+        self.s2 = np.zeros(self.d)
+        self.l1 = np.zeros(self.d)
+        self.nnz = np.zeros(self.d)
+        self.mx = np.full(self.d, -np.inf)
+        self.mn = np.full(self.d, np.inf)
+        self.histogram = np.zeros(0)
+
+    def update(self, x: np.ndarray, y: np.ndarray, w: np.ndarray) -> None:
+        # moments are taken from the DATA-TIER view of the rows (x is
+        # already cast to storage width), so streamed stats match what an
+        # in-core Summarizer pass over the same stored blocks computes
+        x64 = np.asarray(x, dtype=np.float64)
+        y64 = np.asarray(y, dtype=np.float64)
+        w64 = np.asarray(w, dtype=np.float64)
+        wcol = w64[:, None]
+        present = w64 > 0
+        self.s1 += (wcol * x64).sum(axis=0)
+        self.s2 += (wcol * x64 * x64).sum(axis=0)
+        self.l1 += (wcol * np.abs(x64)).sum(axis=0)
+        self.w += float(w64.sum())
+        self.w2 += float((w64 * w64).sum())
+        self.cnt += float(present.sum())
+        if present.any():
+            xp = x64[present]
+            self.nnz += (xp != 0).sum(axis=0)
+            self.mx = np.maximum(self.mx, xp.max(axis=0))
+            self.mn = np.minimum(self.mn, xp.min(axis=0))
+        self.s1y += float((w64 * y64).sum())
+        self.s2y += float((w64 * y64 * y64).sum())
+        if self.integral_labels:
+            yp = y64[present]
+            if yp.size and (np.any(yp != np.round(yp)) or yp.min() < 0
+                            or yp.max() >= _MAX_CLASSES):
+                self.integral_labels = False
+            elif yp.size:
+                hist = np.bincount(yp.astype(np.int64),
+                                   weights=w64[present],
+                                   minlength=len(self.histogram))
+                if len(hist) > len(self.histogram):
+                    self.histogram = np.pad(
+                        self.histogram, (0, len(hist) - len(self.histogram)))
+                self.histogram = self.histogram + hist
+
+
+@dataclass
+class _Shard:
+    path: str
+    rows: int
+
+
+class StreamingDataset:
+    """Disk-backed shard sequence + one-pass fit statistics.
+
+    Quacks like the corner of :class:`InstanceDataset` the dense fit paths
+    touch (``n_rows`` / ``n_features`` / ``shape`` / ``ctx`` /
+    ``to_instance_dataset`` returning self), so ``est.fit(streaming_ds)``
+    routes through the normal estimator entry and ``_fit_dataset``
+    dispatches on the type. Shard files are OWNED: removed on
+    :meth:`close` or GC.
+    """
+
+    def __init__(self, ctx, shards: List[_Shard], n_features: int,
+                 pad_rows: int, moments: _Moments, spill_dir: str,
+                 owns_dir: bool):
+        self.ctx = ctx
+        self._shards = shards
+        self.n_features = int(n_features)
+        self.n_rows = int(sum(s.rows for s in shards))
+        self.pad_rows = int(pad_rows)
+        self._moments = moments
+        self._dir = spill_dir
+        self._owns_dir = owns_dir
+        self._closed = False
+
+    # -- construction ----------------------------------------------------------
+    @classmethod
+    def from_chunks(cls, ctx, chunks: Iterable, n_features: int,
+                    shard_rows: Optional[int] = None,
+                    spill_dir: Optional[str] = None) -> "StreamingDataset":
+        """Build from an iterator of ``(x, y_or_None, w_or_None)`` host
+        chunks — the ``dataset/io.py`` chunked-reader contract — WITHOUT
+        ever holding more than one shard of rows host-side. Chunks are
+        re-blocked to ``cyclone.oocore.shardRows`` boundaries; X is cast to
+        the data tier before it is written (bf16 shards carry half the
+        bytes of f32, so the host→device stream — the out-of-core fit's
+        bandwidth bill — is halved too, docs/mixed-precision.md)."""
+        from cycloneml_tpu.conf import OOCORE_DIR, OOCORE_SHARD_ROWS
+        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
+        conf = getattr(ctx, "conf", None)
+        if shard_rows is None:
+            shard_rows = int(conf.get(OOCORE_SHARD_ROWS)) if conf is not None \
+                else 65536
+        shard_rows = max(int(shard_rows), 1)
+        base = (conf.get(OOCORE_DIR) if conf is not None else "") or ""
+        # only a dir we minted ourselves is removed on close; a
+        # caller-provided directory is theirs
+        owns_dir = spill_dir is None
+        spill_dir = spill_dir or tempfile.mkdtemp(
+            prefix="oocore-", dir=base or None)
+        os.makedirs(spill_dir, exist_ok=True)
+
+        xdt = np.dtype(data_dtype(conf))
+        ydt = np.dtype(compute_dtype())
+        moments = _Moments(int(n_features))
+        shards: List[_Shard] = []
+        carry: List[tuple] = []   # [(x, y, w)] pieces, < shard_rows total
+        carry_rows = 0
+
+        def flush(pieces, rows):
+            xs = np.concatenate([p[0] for p in pieces]) if len(pieces) > 1 \
+                else pieces[0][0]
+            ys = np.concatenate([p[1] for p in pieces]) if len(pieces) > 1 \
+                else pieces[0][1]
+            ws = np.concatenate([p[2] for p in pieces]) if len(pieces) > 1 \
+                else pieces[0][2]
+            path = os.path.join(spill_dir, f"shard-{len(shards):06d}.npz")
+            from cycloneml_tpu.dataset.dataset import _npz_pack
+            x_packed, x_dtype = _npz_pack(xs)
+            np.savez(path, x=x_packed, x_dtype=x_dtype, y=ys, w=ws)
+            shards.append(_Shard(path, rows))
+            moments.update(xs, ys, ws)
+
+        for ci, (cx, cy, cw) in enumerate(chunks):
+            cx = np.ascontiguousarray(cx, dtype=xdt)
+            m = cx.shape[0]
+            if cx.ndim != 2 or cx.shape[1] != n_features:
+                raise ValueError(f"chunk {ci} has shape {cx.shape}, "
+                                 f"expected (rows, {n_features})")
+            cy = (np.zeros(m, dtype=ydt) if cy is None
+                  else np.asarray(cy, dtype=ydt))
+            cw = (np.ones(m, dtype=ydt) if cw is None
+                  else np.asarray(cw, dtype=ydt))
+            if len(cy) != m or len(cw) != m:
+                raise ValueError(
+                    f"chunk {ci}: y/w lengths ({len(cy)}/{len(cw)}) != "
+                    f"x rows ({m})")
+            lo = 0
+            while lo < m:
+                take = min(m - lo, shard_rows - carry_rows)
+                carry.append((cx[lo:lo + take], cy[lo:lo + take],
+                              cw[lo:lo + take]))
+                carry_rows += take
+                lo += take
+                if carry_rows >= shard_rows:
+                    flush(carry, carry_rows)
+                    carry, carry_rows = [], 0
+        if carry_rows:
+            flush(carry, carry_rows)
+        if not shards:
+            raise ValueError("empty chunk stream: nothing to shard")
+
+        pad_rows = _pad_geometry(ctx, max(s.rows for s in shards))
+        return cls(ctx, shards, n_features, pad_rows, moments, spill_dir,
+                   owns_dir)
+
+    @classmethod
+    def from_dataset(cls, ds, shard_rows: Optional[int] = None,
+                     spill_dir: Optional[str] = None) -> "StreamingDataset":
+        """Spill an in-core :class:`InstanceDataset` into a shard set (the
+        budget-guard degradation path: the DATA already fits — it is the
+        fit PROGRAM whose predicted peak HBM does not). Rows are pulled in
+        bounded per-shard slices — O(shard) host staging, the graftlint
+        JX018 pass idiom — with interleaved padding rows dropped via the
+        dataset's own valid mask."""
+        from cycloneml_tpu.conf import OOCORE_SHARD_ROWS
+        conf = getattr(ds.ctx, "conf", None)
+        if shard_rows is None:
+            shard_rows = int(conf.get(OOCORE_SHARD_ROWS)) if conf is not None \
+                else 65536
+        shard_rows = max(int(shard_rows), 1)
+
+        n_pad = int(ds.x.shape[0])
+        mask = ds._valid_mask
+        y_host = ds.y_host()
+        w_host = ds.w_host()
+
+        def chunks():
+            for lo in range(0, n_pad, shard_rows):
+                hi = lo + min(shard_rows, n_pad - lo)
+                xs = np.asarray(ds.x[lo:hi])
+                ys = np.asarray(y_host[lo:hi], dtype=np.float64)
+                ws = np.asarray(w_host[lo:hi], dtype=np.float64)
+                if mask is not None:
+                    keep = mask[lo:hi]
+                else:
+                    keep = np.zeros(hi - lo, dtype=bool)
+                    keep[: max(0, min(ds.n_rows, hi) - lo)] = True
+                if not keep.all():
+                    xs, ys, ws = xs[keep], ys[keep], ws[keep]
+                if len(ys):
+                    yield xs, ys, ws
+
+        return cls.from_chunks(ds.ctx, chunks(), ds.n_features,
+                               shard_rows=shard_rows, spill_dir=spill_dir)
+
+    # -- InstanceDataset-shaped surface ---------------------------------------
+    @property
+    def shape(self):
+        return (self.n_rows, self.n_features)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shards)
+
+    def to_instance_dataset(self, features_col=None, label_col=None,
+                            weight_col=None, dtype=None) -> "StreamingDataset":
+        """Estimator bridge parity with :class:`InstanceDataset`: a
+        StreamingDataset is already placed (on disk); column/dtype concepts
+        do not apply."""
+        return self
+
+    # -- one-pass statistics ---------------------------------------------------
+    @property
+    def weight_sum(self) -> float:
+        return self._moments.w
+
+    def summary(self):
+        """Summarizer-equivalent :class:`SummaryStats` from the write-pass
+        moments — the streamed fit never pays a stats epoch."""
+        from cycloneml_tpu.ml.stat.summarizer import SummaryStats
+        m = self._moments
+        mean = m.s1 / m.w if m.w > 0 else np.zeros(self.n_features)
+        denom = m.w - m.w2 / m.w if m.w > 0 else 0.0
+        if denom > 0:
+            variance = np.maximum((m.s2 - m.w * mean * mean) / denom, 0.0)
+        else:
+            variance = np.zeros_like(mean)
+        return SummaryStats(
+            mean=mean, variance=variance, count=int(round(m.cnt)),
+            num_nonzeros=m.nnz.copy(), max=m.mx.copy(), min=m.mn.copy(),
+            norm_l1=m.l1.copy(), norm_l2=np.sqrt(np.maximum(m.s2, 0.0)),
+            sum=m.s1.copy(), weight_sum=m.w)
+
+    def label_histogram(self) -> np.ndarray:
+        """Weighted class histogram (f64) when labels are class indices;
+        raises for non-integral labels (regression datasets)."""
+        if not self._moments.integral_labels:
+            raise ValueError(
+                "labels are not class indices; streamed classification "
+                "requires integral labels in [0, 4096)")
+        return self._moments.histogram.copy()
+
+    @property
+    def num_classes(self) -> int:
+        return max(len(self._moments.histogram), 2) \
+            if self._moments.integral_labels else 0
+
+    def y_moments(self):
+        """``(Σwy, Σwy², Σw²)`` — what the LinearRegression label-std pass
+        computes in-core with one psum."""
+        m = self._moments
+        return m.s1y, m.s2y, m.w2
+
+    # -- shard access (the stream's supplier) ---------------------------------
+    def load_shard(self, i: int):
+        """Host arrays of shard ``i`` (unpadded; X at data-tier width)."""
+        from cycloneml_tpu.dataset.dataset import _npz_unpack
+        s = self._shards[i]
+        z = np.load(s.path)
+        x = _npz_unpack(z["x"], z.get("x_dtype", ""))
+        return x, z["y"], z["w"]
+
+    def shard_nbytes(self, i: int) -> int:
+        s = self._shards[i]
+        try:
+            return os.path.getsize(s.path)
+        except OSError:
+            return 0
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for s in self._shards:
+            try:
+                os.unlink(s.path)
+            except OSError:
+                pass
+        if self._owns_dir:
+            try:
+                os.rmdir(self._dir)
+            except OSError:
+                pass
+
+    def __del__(self):  # dropped shard sets must not leak the spill dir
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _pad_geometry(ctx, max_shard_rows: int) -> int:
+    """Padded rows per staged shard: the max shard rounded up to a
+    sublane-friendly multiple of the mesh's data parallelism, so
+    ``device_put_sharded_rows`` splits every staged block evenly and one
+    compiled program serves every shard."""
+    rt = ctx.mesh_runtime
+    unit = 8 * int(rt.data_parallelism)
+    return ((max(int(max_shard_rows), 1) + unit - 1) // unit) * unit

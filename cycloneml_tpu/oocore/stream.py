@@ -1,0 +1,188 @@
+"""Double-buffered host→device shard pipeline.
+
+The staging thread walks the shard files, pads each into the fixed
+``(pad_rows, d)`` geometry and places it row-sharded on the mesh while the
+consumer computes over the PREVIOUS shard — the roofline rationale
+(Williams et al. 2009, PAPERS.md): an out-of-core sweep whose transfer
+overlaps compute is bandwidth-bound, one that alternates them is
+latency-bound. The bounded queue IS the ring: ``prefetchDepth`` staged
+shards in flight, so device-resident copies are bounded at depth + 1 and
+host staging at O(shard).
+
+Fault surface: every staging attempt fires the ``oocore.stage`` injection
+point (parallel/faults.py). Transient failures retry with seeded backoff
+mid-epoch; permanent failures (resilience classification) abort the epoch
+cleanly — the error surfaces on the consumer, the queue is drained, and
+the staging thread exits. Never a hang, never a leaked thread.
+
+Observability: each staged shard records a ``transfer``-kind
+``oocore.stage`` span on the staging thread's timeline and each consumed
+shard a ``dispatch``-kind ``oocore.shard`` span on the consumer's — in the
+Chrome trace the two rows interleave, making the transfer/compute overlap
+directly visible; ``oocore.bytes_staged`` is the cumulative byte counter
+track (``make bench-oocore`` computes the overlap fraction from exactly
+these spans).
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from typing import Optional
+
+import numpy as np
+
+from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_DONE = object()
+
+
+class ShardStream:
+    """Iterate device-placed ``(i, x, y, w)`` shards with prefetch.
+
+    One pass over the shard set = one epoch. The consumer owns each
+    yielded shard exactly once — the per-shard aggregation program DONATES
+    the arrays (collectives.tree_aggregate(donate_rows=True)), so a shard's
+    HBM is reclaimed the moment its dispatch leaves the host and the next
+    shard's in-flight transfer lands in freed memory.
+    """
+
+    def __init__(self, sds, depth: Optional[int] = None,
+                 max_retries: Optional[int] = None):
+        from cycloneml_tpu.conf import (OOCORE_MAX_RETRIES,
+                                        OOCORE_PREFETCH_DEPTH)
+        conf = getattr(sds.ctx, "conf", None)
+        if depth is None:
+            depth = int(conf.get(OOCORE_PREFETCH_DEPTH)) \
+                if conf is not None else 2
+        if max_retries is None:
+            max_retries = int(conf.get(OOCORE_MAX_RETRIES)) \
+                if conf is not None else 3
+        self._sds = sds
+        self._max_retries = max(int(max_retries), 0)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop = threading.Event()
+        self.bytes_staged = 0
+        self._rng = random.Random(1)  # seeded: chaos replays exactly
+        self._thread = threading.Thread(
+            target=self._produce, name="cyclone-oocore-stage", daemon=True)
+        self._thread.start()
+
+    # -- staging thread --------------------------------------------------------
+    def _produce(self) -> None:
+        from cycloneml_tpu.parallel.resilience import (backoff_delay,
+                                                       classify_failure)
+        try:
+            for i in range(self._sds.n_shards):
+                attempt = 0
+                while True:
+                    if self._stop.is_set():
+                        return
+                    try:
+                        item = self._stage(i)
+                        break
+                    except Exception as exc:
+                        kind = classify_failure(exc)
+                        if kind == "transient" and attempt < self._max_retries:
+                            attempt += 1
+                            logger.warning(
+                                "oocore: transient staging failure on shard "
+                                "%d (attempt %d/%d): %s — backing off",
+                                i, attempt, self._max_retries, exc)
+                            tracing.instant("oocore.stage_retry", shard=i,
+                                            attempt=attempt)
+                            self._stop.wait(
+                                backoff_delay(attempt, rng=self._rng))
+                            continue
+                        logger.error(
+                            "oocore: %s staging failure on shard %d — "
+                            "aborting the epoch: %s", kind, i, exc)
+                        self._put((None, exc))
+                        return
+                if not self._put(item):
+                    return
+            self._put((_DONE, None))
+        except BaseException as exc:  # staging thread must never die silent
+            self._put((None, exc))
+
+    def _stage(self, i: int):
+        from cycloneml_tpu.parallel import faults
+        faults.inject("oocore.stage", shard=i)
+        sds = self._sds
+        rt = sds.ctx.mesh_runtime
+        with tracing.span("transfer", "oocore.stage", shard=i) as sp:
+            x, y, w = sds.load_shard(i)
+            m = x.shape[0]
+            pad = sds.pad_rows - m
+            if pad:
+                # fresh padded blocks per shard (zero-weight tail rows,
+                # masked out of every psum) — a reused staging buffer could
+                # still be read by an in-flight transfer
+                x = np.concatenate(
+                    [x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
+                y = np.concatenate([y, np.zeros(pad, dtype=y.dtype)])
+                w = np.concatenate([w, np.zeros(pad, dtype=w.dtype)])
+            xs = rt.device_put_sharded_rows(x)
+            ys = rt.device_put_sharded_rows(y)
+            ws = rt.device_put_sharded_rows(w)
+            n_bytes = x.nbytes + y.nbytes + w.nbytes
+            sp.annotate(bytes=n_bytes, rows=m)
+        self.bytes_staged += n_bytes
+        tracing.counter("oocore.bytes_staged", self.bytes_staged)
+        return (i, xs, ys, ws)
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer --------------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, tuple) and len(item) == 2:
+            tag, err = item
+            if tag is _DONE:
+                self.close()
+                raise StopIteration
+            if tag is None:
+                self.close()
+                raise err
+        return item
+
+    def close(self) -> None:
+        """Stop staging, drain the queue (releasing device shard refs),
+        join the thread. Idempotent; safe mid-epoch (the abort path).
+        Drains again AFTER the join: a put already in flight when stop was
+        set can land after the first drain, and a retained tuple would
+        keep one staged shard's device buffers alive past close()."""
+        self._stop.set()
+        self._drain()
+        self._thread.join(timeout=10.0)
+        self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "ShardStream":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -172,7 +172,9 @@ def clear_program_cache() -> None:
 
 
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
-                   auto_psum: bool = True, with_state: bool = False):
+                   auto_psum: bool = True, with_state: bool = False,
+                   n_sharded: Optional[int] = None,
+                   donate_rows: bool = False):
     """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
 
     ``arrays`` fixes how many leading arguments are row-sharded; the returned
@@ -185,6 +187,19 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
     With ``with_state=True``, ``fn`` returns ``(stats, rows)``: ``stats`` is
     psum'd (replicated result) while ``rows`` keeps the input row sharding
     (e.g. an updated per-row assignment vector).
+
+    ``n_sharded`` names the row-sharded argument count without sample
+    arrays (the out-of-core path compiles its per-shard program before any
+    shard exists). ``donate_rows=True`` donates the sharded arguments to
+    XLA: correct ONLY for single-shot operands — the streaming engine's
+    staged shards are consumed exactly once per dispatch, so their buffers
+    are dead the moment the dispatch leaves the host and donation releases
+    the HBM for the next shard's in-flight transfer (the data-path
+    extension of the L-BFGS state donation; graftlint JX009 polices the
+    single-use discipline). In-core datasets redispatch the same arrays
+    every iteration and must NEVER donate. On host-platform (CPU) meshes
+    donation is skipped — XLA:CPU does not implement it and would warn on
+    every program.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -192,9 +207,11 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         # stats would be emitted unreduced under a replicated out_spec —
         # silently wrong with check_vma disabled
         raise ValueError("with_state=True requires auto_psum=True")
-    n_sharded = len(arrays)
+    if n_sharded is None:
+        n_sharded = len(arrays)
+    donate = bool(donate_rows) and runtime.platform != "cpu"
     try:
-        key = (fn, runtime.mesh, n_sharded, auto_psum, with_state)
+        key = (fn, runtime.mesh, n_sharded, auto_psum, with_state, donate)
         cached = _program_cache.get(key)
     except TypeError:  # unhashable fn: build uncached
         key, cached = None, None
@@ -222,7 +239,10 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         out_specs = (P(), row_spec) if with_state else P()
         return shard_map_compat(local, mesh, in_specs, out_specs)(*all_args)
 
-    jitted = _instrument_dispatch(jax.jit(sharded), key=key)
+    jitted = _instrument_dispatch(
+        jax.jit(sharded,
+                donate_argnums=tuple(range(n_sharded)) if donate else ()),
+        key=key)
     if key is not None:
         _program_cache.put(key, jitted)
     return jitted

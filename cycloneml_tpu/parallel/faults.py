@@ -24,6 +24,13 @@ point                    fired from
                          (``serving/batcher.py`` — transient faults
                          retry with backoff, permanent faults shed the
                          batch with a 5xx ServingError, never a hang)
+``oocore.stage``         every out-of-core shard staging attempt
+                         (``oocore/stream.py`` — host read + pad +
+                         device placement on the prefetch thread;
+                         transient faults retry with seeded backoff
+                         mid-epoch, permanent faults abort the epoch
+                         cleanly with the stream drained and the
+                         staging thread released)
 ======================== =================================================
 
 Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the

@@ -1,0 +1,170 @@
+"""bench-oocore: the streaming epoch engine's acceptance numbers.
+
+The executable form of the out-of-core contract (docs/out-of-core.md):
+
+1. build the SAME seeded (n, d) problem twice — an out-of-core
+   :class:`StreamingDataset` (shards on disk) and the in-core
+   ``InstanceDataset`` it replaces,
+2. run a seeded LogisticRegression fit on each and measure wall time,
+3. measure the whole-epoch sweep bytes with XLA's own accounting
+   (``observe/costs.streamed_sweep_cost`` vs ``costs.sweep_cost``) and the
+   O(shard) per-dispatch peak that makes the streamed fit OOM-proof,
+4. compute the transfer/compute OVERLAP FRACTION from the stream spans —
+   how much of the smaller phase (staging vs shard compute) the double
+   buffer actually hid behind the other:
+       overlap = Σ |stage_i ∩ shard_j| / min(Σ stage, Σ shard)
+   1.0 = the pipeline fully hides one phase; 0.0 = strictly serial.
+
+Emits one JSON line (the BENCH "oocore" block) and exits non-zero unless
+the overlap fraction reaches OVERLAP_FLOOR on the 8-device CPU smoke —
+a pipeline that stopped overlapping is a regression even when results
+stay correct. Override shapes with BENCH_OOCORE_N / _D / _SHARD / _ITERS.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+OVERLAP_FLOOR = 0.30
+
+
+def _merge_intervals(intervals):
+    """Sorted, overlap-merged copy of (lo, hi) intervals."""
+    merged = []
+    for lo, hi in sorted(intervals):
+        if merged and lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return merged
+
+
+def overlap_fraction(spans):
+    """Σ|stage ∩ (∪ shard)| / min(Σstage, Σshard) over the stream spans."""
+    stage = [(s.t0, s.t1) for s in spans if s.name == "oocore.stage"]
+    shard = [(s.t0, s.t1) for s in spans if s.name == "oocore.shard"]
+    if not stage or not shard:
+        return 0.0, 0.0, 0.0
+    stage_total = sum(hi - lo for lo, hi in stage)
+    shard_total = sum(hi - lo for lo, hi in shard)
+    # intersect each stage interval with the union of shard intervals
+    shard_u = _merge_intervals(shard)
+    inter = 0.0
+    for lo, hi in stage:
+        for ulo, uhi in shard_u:
+            inter += max(0.0, min(hi, uhi) - max(lo, ulo))
+    denom = min(stage_total, shard_total)
+    return (inter / denom if denom > 0 else 0.0), stage_total, shard_total
+
+
+def main() -> int:
+    n = int(os.environ.get("BENCH_OOCORE_N", 160_000))
+    d = int(os.environ.get("BENCH_OOCORE_D", 128))
+    shard_rows = int(os.environ.get("BENCH_OOCORE_SHARD", 16384))
+    max_iter = int(os.environ.get("BENCH_OOCORE_ITERS", 5))
+
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import tracing
+    from cycloneml_tpu.oocore import StreamingDataset
+
+    ctx = CycloneContext(CycloneConf().set("cyclone.master", "local-mesh[*]"))
+    rng = np.random.RandomState(0)
+    beta = rng.randn(d)
+
+    def chunks():
+        done, r = 0, np.random.RandomState(1)
+        while done < n:
+            m = min(32768, n - done)
+            xc = r.randn(m, d).astype(np.float32)
+            yc = (xc @ beta + 0.3 * r.randn(m) > 0).astype(np.float64)
+            yield xc, yc, None
+            done += m
+
+    t0 = time.perf_counter()
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d,
+                                       shard_rows=shard_rows)
+    shard_build_s = time.perf_counter() - t0
+
+    est = lambda: LogisticRegression(maxIter=max_iter, regParam=0.1)  # noqa: E731
+    # warm the per-shard program so the streamed wall below is steady-state
+    est().fit(sds)
+
+    tr = tracing.enable()
+    mark = tr.mark()
+    t0 = time.perf_counter()
+    m_stream = est().fit(sds)
+    streamed_s = time.perf_counter() - t0
+    spans = tr.snapshot(since=mark)
+    tracing.disable()
+    assert m_stream.summary.streamed
+    frac, stage_s, shard_s = overlap_fraction(spans)
+
+    # epoch sweep bytes: XLA's accounting of the per-shard program at the
+    # padded geometry × shard count; peak stays per-dispatch (O(shard))
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore import StreamingLossFunction
+    f = StreamingLossFunction(
+        sds, aggregators.binary_logistic(d, fit_intercept=False))
+    cost = f.sweep_cost(n_coef=d)
+
+    # the in-core twin: same rows, one resident matrix
+    xs, ys = [], []
+    for cx, cy, _ in chunks():
+        xs.append(cx)
+        ys.append(cy)
+    x_full = np.concatenate(xs)
+    y_full = np.concatenate(ys)
+    del xs, ys
+    ds = InstanceDataset.from_numpy(ctx, x_full, y_full)
+    est().fit(ds)  # warm
+    t0 = time.perf_counter()
+    m_ref = est().fit(ds)
+    incore_s = time.perf_counter() - t0
+    coef_drift = float(np.abs(np.asarray(m_stream._coef)
+                              - np.asarray(m_ref._coef)).max())
+
+    block = {
+        "metric": "oocore",
+        "n": n, "d": d,
+        "shards": sds.n_shards, "shard_rows": shard_rows,
+        "pad_rows": sds.pad_rows,
+        "shard_build_s": round(shard_build_s, 3),
+        "streamed_fit_s": round(streamed_s, 3),
+        "incore_fit_s": round(incore_s, 3),
+        "streamed_vs_incore": round(streamed_s / max(incore_s, 1e-9), 2),
+        "epochs": m_stream.summary.total_evals,
+        "shard_dispatches": m_stream.summary.total_dispatches,
+        "bytes_per_sweep": cost.bytes_accessed_total,
+        "peak_bytes_per_dispatch": cost.peak_bytes,
+        "overlap_fraction": round(frac, 3),
+        "stage_seconds": round(stage_s, 3),
+        "compute_seconds": round(shard_s, 3),
+        "coef_max_abs_drift": coef_drift,
+    }
+    print(json.dumps(block))
+    ctx.stop()
+    sds.close()
+    if frac < OVERLAP_FLOOR:
+        print(f"FAIL: transfer/compute overlap {frac:.3f} < "
+              f"{OVERLAP_FLOOR} — the double buffer is not overlapping",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,3 +36,16 @@ def train_epoch(runtime, xb, coef):
     n = xb.shape[0]
     preds = jnp.zeros((n,))
     return step(xb, coef), _pull(preds)                         # JX018
+
+
+def fit_accumulates_all_shards(runtime, xb, yb, coef):
+    # the naive out-of-core anti-pattern the streaming engine exists to
+    # avoid: per-shard partials are bounded, but the host-side epoch
+    # buffer re-materializes EVERY shard as one O(n) matrix — the working
+    # set the spill was supposed to remove comes straight back
+    step = tree_aggregate(_sum_kernel, runtime, xb, yb)
+    out = step(xb, yb, coef)
+    n, d = xb.shape
+    epoch_buf = jnp.zeros((n, d))
+    collected = np.asarray(epoch_buf)                           # JX018
+    return out, collected

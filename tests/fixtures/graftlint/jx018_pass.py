@@ -31,3 +31,16 @@ def fit_pulls_bounded_preview(runtime, xb, yb, coef):
     out = step(xb, yb, coef)
     head = np.asarray(xb[:64])
     return out, head
+
+
+def fit_stages_bounded_shards(runtime, xb, yb, coef, shard_rows):
+    # the streaming engine's idiom (oocore/): per-shard bounded host
+    # staging — every staged slice carries an explicit upper bound, so
+    # dataset-dim provenance ends at the shard and the epoch's host
+    # working set stays O(shard), never O(n)
+    step = tree_aggregate(_grad_kernel, runtime, xb, yb)
+    total = step(xb, yb, coef)
+    for lo in range(0, xb.shape[0], shard_rows):
+        staged = np.asarray(xb[lo:lo + shard_rows])
+        jax.device_put(staged)
+    return total

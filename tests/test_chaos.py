@@ -465,3 +465,91 @@ def test_serving_transient_faults_exhaust_to_5xx():
     assert 500 <= ei.value.status < 600
     assert len(inj.log) == 3  # initial attempt + maxRetries, then shed
     srv.stop()
+
+
+# -- out-of-core shard staging faults (ISSUE 11) --------------------------------
+
+def _oocore_fixture(ctx, n=1200, d=6, seed=9, shard_rows=400):
+    from cycloneml_tpu.oocore import StreamingDataset
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    y = (x[:, 0] + x[:, 1] > 0).astype(float)
+
+    def chunks():
+        for lo in range(0, n, 300):
+            yield x[lo:lo + 300], y[lo:lo + 300], None
+
+    return StreamingDataset.from_chunks(ctx, chunks(), d,
+                                        shard_rows=shard_rows)
+
+
+def test_oocore_transient_stage_fault_retries_mid_epoch(ctx):
+    """A transient shard-staging failure (DCN flake class) retries with
+    backoff MID-EPOCH: the streamed fit completes and lands on the exact
+    fault-free coefficients — the retry re-stages the same shard, so the
+    epoch's accumulated partials are untouched."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    sds = _oocore_fixture(ctx)
+    try:
+        ref = LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+        sched = FaultSchedule(seed=0)
+        sched.at("oocore.stage", 2,
+                 TransientCollectiveError("mid-epoch transfer flake"))
+        with FaultInjector(sched) as inj:
+            m = LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+        assert inj.log == [("oocore.stage", 2, "TransientCollectiveError")]
+        assert m.summary.streamed
+        np.testing.assert_array_equal(np.asarray(m._coef),
+                                      np.asarray(ref._coef))
+    finally:
+        sds.close()
+
+
+def test_oocore_permanent_stage_fault_aborts_cleanly(ctx):
+    """A permanent staging failure aborts the epoch LOUDLY: the original
+    error surfaces on the consumer, the prefetch queue is drained (device
+    shard refs released) and the staging thread exits — never a hang,
+    never a leaked thread — and the shard set stays usable afterwards."""
+    import threading
+
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    sds = _oocore_fixture(ctx)
+    try:
+        sched = FaultSchedule(seed=0)
+        sched.at("oocore.stage", 2, TypeError("injected corrupt shard"))
+        with FaultInjector(sched) as inj:
+            with pytest.raises(TypeError, match="corrupt shard"):
+                LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+        assert inj.log == [("oocore.stage", 2, "TypeError")]
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(
+                t.name.startswith("cyclone-oocore")
+                for t in threading.enumerate()):
+            time.sleep(0.05)
+        assert not any(t.name.startswith("cyclone-oocore")
+                       for t in threading.enumerate())
+        # state released, stream machinery reusable: a fault-free fit runs
+        m = LogisticRegression(maxIter=4, regParam=0.1).fit(sds)
+        assert m.summary.streamed
+    finally:
+        sds.close()
+
+
+def test_oocore_transient_faults_exhaust_to_abort(ctx):
+    """Transient staging faults past cyclone.oocore.maxRetries stop
+    retrying and abort — bounded recovery, no infinite retry loop; the
+    injector ledger pins initial attempt + maxRetries firings."""
+    from cycloneml_tpu.conf import OOCORE_MAX_RETRIES
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    sds = _oocore_fixture(ctx)
+    try:
+        sched = FaultSchedule(seed=0)
+        sched.window("oocore.stage", 1, 100,
+                     TransientCollectiveError("persistent flake"))
+        with FaultInjector(sched) as inj:
+            with pytest.raises(TransientCollectiveError):
+                LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+        max_retries = int(ctx.conf.get(OOCORE_MAX_RETRIES))
+        assert len(inj.log) == 1 + max_retries
+    finally:
+        sds.close()

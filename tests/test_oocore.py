@@ -256,6 +256,297 @@ def test_read_csv_chunked_leading_blank_lines(ctx, tmp_path):
     np.testing.assert_allclose(sorted(y.tolist()), [0.0, 1.0])
 
 
+# -- streaming fit mode (oocore/: the out-of-core epoch engine) ---------------
+
+
+def _binary_problem(n=3000, d=10, seed=11):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    y = (x @ rng.randn(d) + 0.3 * rng.randn(n) > 0).astype(float)
+    return x, y
+
+
+def _streaming_ds(ctx, x, y, shard_rows=700):
+    from cycloneml_tpu.oocore import StreamingDataset
+
+    def chunks():
+        for lo in range(0, len(x), 450):  # chunk != shard boundaries
+            yield x[lo:lo + 450], y[lo:lo + 450], None
+
+    return StreamingDataset.from_chunks(ctx, chunks(), x.shape[1],
+                                        shard_rows=shard_rows)
+
+
+def test_streaming_dataset_stats_match_summarizer(ctx):
+    """The shard WRITE pass harvests the Summarizer moment set: mean/std/
+    weight_sum (and the label histogram) must match the in-core psum pass
+    over the same rows."""
+    from cycloneml_tpu.ml.stat import Summarizer
+    x, y = _binary_problem()
+    sds = _streaming_ds(ctx, x, y)
+    try:
+        ref = Summarizer.summarize(InstanceDataset.from_numpy(ctx, x, y))
+        got = sds.summary()
+        np.testing.assert_allclose(got.mean, ref.mean, rtol=1e-12)
+        np.testing.assert_allclose(got.std, ref.std, rtol=1e-12)
+        assert got.weight_sum == ref.weight_sum
+        assert got.count == ref.count
+        np.testing.assert_allclose(got.max, ref.max)
+        np.testing.assert_allclose(got.min, ref.min)
+        hist = sds.label_histogram()
+        np.testing.assert_allclose(
+            hist, np.bincount(y.astype(int), minlength=2))
+        assert sds.num_classes == 2
+    finally:
+        sds.close()
+
+
+def test_streamed_logreg_matches_incore(ctx):
+    """Fit-mode acceptance: a streamed LogisticRegression fit (each loss/
+    grad evaluation = one double-buffered epoch over shards) lands on the
+    in-core coefficients. Under the f64 CPU test config the only
+    difference is summation ORDER (shard partials vs device partials), so
+    the envelope is ulp-level; under bf16 storage (TPU default tier) the
+    documented envelope is the mixed-precision suite's ~1e-3 relative
+    (docs/out-of-core.md)."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    x, y = _binary_problem()
+    sds = _streaming_ds(ctx, x, y)
+    try:
+        est = LogisticRegression(maxIter=25, regParam=0.05)
+        m_stream = est.fit(sds)
+        m_ref = LogisticRegression(maxIter=25, regParam=0.05).fit(
+            InstanceDataset.from_numpy(ctx, x, y))
+        assert m_stream.summary.streamed
+        assert not m_ref.summary.streamed
+        np.testing.assert_allclose(np.asarray(m_stream._coef),
+                                   np.asarray(m_ref._coef),
+                                   rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(m_stream._icpt),
+                                   np.asarray(m_ref._icpt),
+                                   rtol=1e-9, atol=1e-12)
+        # one sweep dispatches one program per shard; evals count epochs
+        assert m_stream.summary.total_dispatches \
+            >= m_stream.summary.total_evals * sds.n_shards
+    finally:
+        sds.close()
+
+
+def test_streamed_linreg_matches_incore(ctx):
+    from cycloneml_tpu.ml.regression import LinearRegression
+    rng = np.random.RandomState(12)
+    n, d = 2500, 8
+    x = rng.randn(n, d)
+    y = x @ rng.randn(d) + 0.1 * rng.randn(n)
+    sds = _streaming_ds(ctx, x, y)
+    try:
+        m_stream = LinearRegression(maxIter=25, regParam=0.1,
+                                    solver="l-bfgs").fit(sds)
+        m_ref = LinearRegression(maxIter=25, regParam=0.1,
+                                 solver="l-bfgs").fit(
+            InstanceDataset.from_numpy(ctx, x, y))
+        assert m_stream.summary.streamed
+        np.testing.assert_allclose(np.asarray(m_stream._coef),
+                                   np.asarray(m_ref._coef),
+                                   rtol=1e-9, atol=1e-12)
+        # the normal solver needs the in-core matrix: explicit request fails
+        # loudly, auto routes to l-bfgs
+        with pytest.raises(ValueError, match="in-core"):
+            LinearRegression(solver="normal").fit(sds)
+        auto = LinearRegression(maxIter=25, solver="auto").fit(sds)
+        assert auto.summary.streamed
+    finally:
+        sds.close()
+
+
+def test_streamed_gradient_descent_matches_incore(ctx):
+    """Partial-sweep SGD accumulation: the streamed optimizer folds every
+    shard's psummed partial into one accumulator-tier gradient per step —
+    the same update math as the in-core full-batch GradientDescent."""
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.gradient_descent import (GradientDescent,
+                                                         SquaredL2Updater)
+    from cycloneml_tpu.oocore import StreamingGradientDescent
+    x, y = _binary_problem(n=1500, d=6, seed=13)
+    sds = _streaming_ds(ctx, x, y, shard_rows=400)
+    try:
+        agg = aggregators.binary_logistic(6, fit_intercept=False)
+        kw = dict(step_size=1.0, num_iterations=25, reg_param=0.01,
+                  updater=SquaredL2Updater(), seed=3)
+        w_s, hist_s = StreamingGradientDescent(**kw).optimize(
+            sds, agg, np.zeros(6))
+        w_r, hist_r = GradientDescent(**kw).optimize(
+            InstanceDataset.from_numpy(ctx, x, y), agg, np.zeros(6))
+        assert len(hist_s) == len(hist_r)
+        np.testing.assert_allclose(w_s, w_r, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(hist_s, hist_r, rtol=1e-9)
+    finally:
+        sds.close()
+
+
+def test_over_budget_fit_degrades_to_streaming(ctx):
+    """The acceptance pin: an in-core fit whose chunk program exceeds the
+    memory budget at deviceChunk=1 DEGRADES to the streaming engine and
+    completes — even under budgetAction=raise — matching the unbudgeted
+    coefficients; cyclone.oocore.mode=off restores the raise."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe.costs import MemoryBudgetError
+    x, y = _binary_problem(n=1200, d=6, seed=14)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    est = lambda: LogisticRegression(maxIter=12, regParam=0.1)  # noqa: E731
+    ref = est().fit(ds)
+    assert not ref.summary.streamed
+    warnings_before = len(ctx.status_store.memory_warnings)
+    ctx.conf.set("cyclone.memory.budgetFraction", "1e-12")
+    ctx.conf.set("cyclone.memory.budgetAction", "raise")
+    try:
+        m = est().fit(ds)
+        assert m.summary.streamed  # degraded, not OOM'd, not raised
+        np.testing.assert_allclose(np.asarray(m._coef),
+                                   np.asarray(ref._coef),
+                                   rtol=1e-9, atol=1e-12)
+        assert ctx.listener_bus.wait_until_empty()
+        warns = ctx.status_store.memory_warnings[warnings_before:]
+        assert warns  # the exceeded-budget events still posted
+        ctx.conf.set("cyclone.oocore.mode", "off")
+        with pytest.raises(MemoryBudgetError):
+            est().fit(ds)
+    finally:
+        ctx.conf.remove("cyclone.memory.budgetFraction")
+        ctx.conf.remove("cyclone.memory.budgetAction")
+        ctx.conf.remove("cyclone.oocore.mode")
+
+
+def test_oocore_mode_force_streams_eligible_fits(ctx):
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    x, y = _binary_problem(n=1000, d=5, seed=15)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    ref = LogisticRegression(maxIter=10, regParam=0.1).fit(ds)
+    ctx.conf.set("cyclone.oocore.mode", "force")
+    try:
+        m = LogisticRegression(maxIter=10, regParam=0.1).fit(ds)
+        assert m.summary.streamed
+        np.testing.assert_allclose(np.asarray(m._coef),
+                                   np.asarray(ref._coef),
+                                   rtol=1e-9, atol=1e-12)
+    finally:
+        ctx.conf.remove("cyclone.oocore.mode")
+
+
+def test_streamed_sweep_cost_is_o_shard(ctx):
+    """costs.streamed_sweep_cost: whole-epoch WORK scales with the shard
+    count while the per-dispatch MEMORY footprint stays O(shard) — the
+    reason the streamed fit cannot OOM."""
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore import StreamingLossFunction
+    x, y = _binary_problem(n=2000, d=8, seed=16)
+    sds = _streaming_ds(ctx, x, y, shard_rows=500)
+    try:
+        f = StreamingLossFunction(
+            sds, aggregators.binary_logistic(8, fit_intercept=False))
+        cost = f.sweep_cost(n_coef=8)
+        assert cost.cost_available and cost.memory_available
+        per_shard_x_bytes = sds.pad_rows * 8 * np.dtype(np.float64).itemsize
+        # epoch bytes cover all shards' X at least once...
+        assert cost.bytes_accessed_total >= sds.n_shards * per_shard_x_bytes
+        # ...but peak HBM is one padded shard's program, not the epoch
+        assert cost.peak_bytes < 3 * per_shard_x_bytes
+    finally:
+        sds.close()
+
+
+def test_stream_spans_show_stage_and_compute(ctx):
+    """Stream-phase observability: a traced streamed fit records
+    ``oocore.stage`` transfer spans (staging thread, bytes annotated),
+    ``oocore.shard`` dispatch spans (consumer thread) and the cumulative
+    ``oocore.bytes_staged`` counter track — the spans the bench's overlap
+    measurement reads."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import tracing
+    x, y = _binary_problem(n=1200, d=6, seed=17)
+    sds = _streaming_ds(ctx, x, y, shard_rows=400)
+    tr = tracing.enable()
+    mark = tr.mark()
+    try:
+        LogisticRegression(maxIter=4, regParam=0.1).fit(sds)
+        spans = tr.snapshot(since=mark)
+        stage = [s for s in spans if s.name == "oocore.stage"]
+        shard = [s for s in spans if s.name == "oocore.shard"]
+        counters = [s for s in spans if s.name == "oocore.bytes_staged"]
+        assert stage and shard and counters
+        assert all(s.kind == "transfer" for s in stage)
+        assert all(s.attrs.get("bytes", 0) > 0 for s in stage)
+        # staging runs on its own thread — the overlap is observable
+        assert {s.tid for s in stage} != {s.tid for s in shard}
+        per_epoch = sds.n_shards
+        assert len(shard) % per_epoch == 0
+    finally:
+        tracing.disable()
+        sds.close()
+
+
+_STREAM_RSS_SCRIPT = textwrap.dedent("""
+    import os, resource, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.oocore import StreamingDataset
+
+    n, d, shard_rows = (int(a) for a in sys.argv[1:4])
+    ctx = CycloneContext(CycloneConf().set("cyclone.master", "local-mesh[8]"))
+    rng = np.random.RandomState(4)
+    beta = rng.randn(d)
+
+    def chunks():
+        done = 0
+        while done < n:
+            m = min(32768, n - done)
+            xc = rng.randn(m, d).astype(np.float32)
+            yc = (xc @ beta > 0).astype(np.float64)
+            yield xc, yc, None
+            done += m
+
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d,
+                                       shard_rows=shard_rows)
+    model = LogisticRegression(maxIter=3, regParam=0.1).fit(sds)
+    assert model.summary.streamed
+    assert sds.n_rows == n
+    sds.close()
+    print("PEAK_RSS_KB", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+""")
+
+
+def test_streamed_fit_rss_is_shard_bounded(tmp_path):
+    """A FULL streamed fit in a fresh subprocess: generate → shard → fit
+    without the matrix ever materializing. Peak RSS over an identical
+    tiny-problem baseline must stay well under the dataset's own f32
+    bytes — the fit's host working set is O(shard), the shards live on
+    disk, and on the CPU test platform 'device' memory IS process RAM, so
+    this bounds the device residency too (depth+1 padded shards)."""
+    n, d, shard_rows = 320_000, 64, 32768
+    ds_bytes = n * d * 4
+    env = dict(os.environ)
+
+    def run(n_):
+        out = subprocess.run(
+            [sys.executable, "-c", _STREAM_RSS_SCRIPT, str(n_), str(d),
+             str(shard_rows)],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return int(out.stdout.split("PEAK_RSS_KB")[1])
+
+    base_kb = run(4096)
+    peak_kb = run(n)
+    extra = (peak_kb - base_kb) * 1024
+    assert extra < 0.5 * ds_bytes, (base_kb, peak_kb, ds_bytes)
+
+
 def test_chunked_dataset_trains_tree_mlp_svc(ctx):
     """Estimators that read labels/features back to host must honor the
     interleaved padding mask (review r3: trees/MLP/SVC sliced [:n_rows])."""
