@@ -347,6 +347,78 @@ def bench_ovr_stacked(n: int | None = None, d: int | None = None,
     return out
 
 
+def bench_trace_overhead(n: int | None = None, d: int | None = None,
+                         iters: int = 12):
+    """The ``trace_overhead`` BENCH block: the SAME warmed fit timed
+    untraced, under the flight-recorder-only ring, and fully traced.
+
+    This pins the "always-on is cheap" claim as a number instead of
+    prose: ``flight_overhead_pct`` is the steady-state cost of the
+    always-on flight recorder (span ring only — no XLA cost harvest, no
+    metrics bridge; the acceptance bar is < 3%), ``traced_overhead_pct``
+    is full tracing's (cost harvest + rollups + metrics, expected
+    higher). Medians over BENCH_TRIALS fits per mode on one warmed
+    program set.
+    """
+    import statistics
+
+    from cycloneml_tpu import CycloneConf, CycloneContext
+    from cycloneml_tpu.dataset.random import generate_classification
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import flight, tracing
+
+    n = n or int(os.environ.get("BENCH_TRACE_N", 200_000))
+    d = d or int(os.environ.get("BENCH_TRACE_D", 128))
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.app.name", "bench"))
+    ds = generate_classification(ctx, n, d, seed=3)
+    lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
+    trials = max(3, int(os.environ.get("BENCH_TRIALS", 3)))
+
+    def timed():
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            lr.fit(ds)
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    # warm compiles once; every mode then replays the same programs
+    tracing.disable()
+    flight.disable()
+    lr.fit(ds)
+    untraced_s = timed()
+    flight.enable()
+    try:
+        flight_s = timed()
+    finally:
+        flight.disable()
+    # full tracing as a real context runs it: WITH the metrics bridge
+    # (per-span timer updates), so the reported overhead is honest
+    tracing.enable(registry=ctx.metrics.registry)
+    try:
+        traced_s = timed()
+    finally:
+        tracing.disable()
+
+    def pct(x):
+        return round((x / untraced_s - 1.0) * 100.0, 2) if untraced_s else None
+
+    out = {
+        "n": n, "d": d, "iters": iters, "trials": trials,
+        "untraced_s": round(untraced_s, 4),
+        "flight_s": round(flight_s, 4),
+        "traced_s": round(traced_s, 4),
+        "flight_overhead_pct": pct(flight_s),
+        "traced_overhead_pct": pct(traced_s),
+    }
+    print(f"info: trace overhead n={n} d={d}: untraced {untraced_s:.3f}s, "
+          f"flight-only {flight_s:.3f}s ({out['flight_overhead_pct']}%), "
+          f"traced {traced_s:.3f}s ({out['traced_overhead_pct']}%)",
+          file=sys.stderr)
+    return out
+
+
 def bench_serving(d: int | None = None, n_requests: int | None = None,
                   n_threads: int | None = None):
     """The ``serving`` BENCH block: two fitted models behind the model
@@ -473,6 +545,12 @@ def main() -> None:
             serving = bench_serving()
         except Exception as e:
             print(f"info: serving bench failed: {e}", file=sys.stderr)
+    trace_overhead = None
+    if os.environ.get("BENCH_TRACE_OVERHEAD", "1") != "0":
+        try:
+            trace_overhead = bench_trace_overhead()
+        except Exception as e:
+            print(f"info: trace overhead bench failed: {e}", file=sys.stderr)
     try:
         gemm_mops = bench_gemm()
         print(f"info: device_gemm_f32 {gemm_mops:.1f} M ops/s "
@@ -528,6 +606,7 @@ def main() -> None:
             "phases": phases,
             "ovr": ovr,
             "serving": serving,
+            "trace_overhead": trace_overhead,
         }))
     elif gemm_mops is not None:
         print(f"info: logreg bench failed: {err}", file=sys.stderr)
@@ -539,6 +618,7 @@ def main() -> None:
             "hardware": hardware,
             "ovr": ovr,
             "serving": serving,
+            "trace_overhead": trace_overhead,
         }))
     else:
         # both benches errored: say so instead of faking a 0.0 measurement

@@ -744,8 +744,142 @@ TRACE_DIR = (
 
 TRACE_MAX_SPANS = (
     ConfigBuilder("cyclone.trace.maxSpans")
-    .doc("Span buffer bound; past it new spans are dropped (and counted) "
-         "rather than growing without limit.")
+    .doc("Span buffer bound (a RING: past it the OLDEST span is dropped "
+         "and counted — spans_dropped in the export header and "
+         "FitProfile — so a long job always keeps its recent window).")
     .check_value(lambda v: v >= 1, "must be >= 1")
     .int_conf(100_000)
+)
+
+FLIGHT_ENABLED = (
+    ConfigBuilder("cyclone.telemetry.flight.enabled")
+    .doc("Always-on flight recorder (observe/flight.py): when full "
+         "tracing is off, the context installs a bounded ring of recent "
+         "spans that records at near-zero cost (no XLA cost harvest, no "
+         "metrics bridge — the trace_overhead BENCH field pins the "
+         "number) and freezes/dumps its window on triggers: chaos fault "
+         "firing, MeshSupervisor rebuild, serving shed, SLO breach. "
+         "Dumps are written under cyclone.trace.dir when set; the last "
+         "few stay readable in memory either way.")
+    .bool_conf(True)
+)
+
+FLIGHT_RING_SPANS = (
+    ConfigBuilder("cyclone.telemetry.flight.ringSpans")
+    .doc("Flight-recorder ring size in spans — the window a triggered "
+         "dump preserves.")
+    .check_value(lambda v: v >= 16, "must be >= 16")
+    .int_conf(2048)
+)
+
+FLIGHT_MIN_INTERVAL_MS = (
+    ConfigBuilder("cyclone.telemetry.flight.minIntervalMs")
+    .doc("Flight-dump throttle: triggers within this window of the "
+         "previous dump only count, they do not re-dump (a shed burst "
+         "freezes ONE window, not one per 503).")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .float_conf(1000.0)
+)
+
+COLLECT_ADDRESS = (
+    ConfigBuilder("cyclone.telemetry.collect.address")
+    .doc("host:port of a TraceCollector (observe/collect.py). When set, "
+         "the context enables tracing (if not already on), adopts the "
+         "CYCLONE_TRACE_ID / CYCLONE_TRACE_PARENT distributed-trace "
+         "context from the environment, and runs a SpanShipper that "
+         "drains the span ring to the collector — deploy.submit_app "
+         "seeds this (env conf channel) for every launched app when the "
+         "submitting process runs a collector. Empty = no shipping.")
+    .str_conf("")
+)
+
+COLLECT_INTERVAL_MS = (
+    ConfigBuilder("cyclone.telemetry.collect.intervalMs")
+    .doc("SpanShipper drain/ship period in milliseconds.")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(500.0)
+)
+
+COLLECT_MAX_BATCH = (
+    ConfigBuilder("cyclone.telemetry.collect.maxBatch")
+    .doc("Spans per shipped batch; an unreachable collector buffers up "
+         "to 16x this, then drops oldest (drop-counted) — shipping never "
+         "blocks a recording site.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(4096)
+)
+
+SKEW_ENABLED = (
+    ConfigBuilder("cyclone.telemetry.skew.enabled")
+    .doc("Online straggler/skew detection (observe/skew.py): rolling "
+         "median + MAD over per-lane step times (out-of-core shard "
+         "staging, serving model lanes, per-worker heartbeat RTT). "
+         "Latched StragglerDetected / SloBreach events post to the "
+         "listener bus (status store 'skew' list, /api/v1/skew, web UI) "
+         "and to subscribers (MeshSupervisor.attach_skew — the elastic "
+         "scheduler's mitigation input, ROADMAP item 4).")
+    .bool_conf(True)
+)
+
+SKEW_WINDOW = (
+    ConfigBuilder("cyclone.telemetry.skew.window")
+    .doc("Rolling samples kept per (group, lane) for the skew medians.")
+    .check_value(lambda v: v >= 4, "must be >= 4")
+    .int_conf(64)
+)
+
+SKEW_MIN_SAMPLES = (
+    ConfigBuilder("cyclone.telemetry.skew.minSamples")
+    .doc("Samples a lane needs before it participates in straggler "
+         "comparison — below it the detector stays silent (cold lanes "
+         "must not convict or be convicted).")
+    .check_value(lambda v: v >= 2, "must be >= 2")
+    .int_conf(8)
+)
+
+SKEW_MAD_FACTOR = (
+    ConfigBuilder("cyclone.telemetry.skew.madFactor")
+    .doc("A lane is a straggler only when its rolling median exceeds the "
+         "group median by this many MADs (AND by relFactor x the median "
+         "— both gates must pass; see docs/observability.md tuning).")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(4.0)
+)
+
+SKEW_REL_FACTOR = (
+    ConfigBuilder("cyclone.telemetry.skew.relFactor")
+    .doc("Relative gate for straggler detection: the lane median must "
+         "also exceed relFactor x the group median, so microscopic "
+         "jitter in a tight group (MAD near 0) cannot convict.")
+    .check_value(lambda v: v >= 1.0, "must be >= 1.0")
+    .float_conf(1.5)
+)
+
+SKEW_MIN_GAP_MS = (
+    ConfigBuilder("cyclone.telemetry.skew.minGapMs")
+    .doc("Absolute-gap floor for straggler detection: a lane's rolling "
+         "median must exceed the group median by at least this many "
+         "milliseconds (on top of the MAD and relative gates). At "
+         "millisecond step times benign jitter exceeds any relative "
+         "factor; below this gap, mitigation could not pay for itself.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .float_conf(10.0)
+)
+
+SLO_STEP_MS = (
+    ConfigBuilder("cyclone.telemetry.slo.stepMs")
+    .doc("Step-duration SLO in milliseconds for collective dispatches "
+         "(group collectives.step): a sample over target fires ONE "
+         "latched SloBreach event + a flight-recorder dump until a "
+         "sample recovers. 0 disables.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .float_conf(0.0)
+)
+
+SLO_SERVING_MS = (
+    ConfigBuilder("cyclone.telemetry.slo.servingMs")
+    .doc("Serving-dispatch SLO in milliseconds (group serving.dispatch); "
+         "same latch/dump semantics as slo.stepMs. 0 disables.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .float_conf(0.0)
 )

@@ -206,16 +206,75 @@ class CycloneContext:
 
         # step-level tracing (observe/): conf or CYCLONE_TRACE env var; the
         # context only disables a tracer it installed itself, so a tracer
-        # enabled programmatically (tests, bench) survives ctx teardown
-        from cycloneml_tpu.conf import TRACE_ENABLED, TRACE_MAX_SPANS
+        # enabled programmatically (tests, bench) survives ctx teardown.
+        # A configured trace-collector address (the deploy launch env's
+        # conf seed) also demands full tracing — the submitting process
+        # asked for a distributed trace of this app.
+        from cycloneml_tpu.conf import (
+            COLLECT_ADDRESS, COLLECT_INTERVAL_MS, COLLECT_MAX_BATCH,
+            FLIGHT_ENABLED, FLIGHT_MIN_INTERVAL_MS, FLIGHT_RING_SPANS,
+            SKEW_ENABLED, TRACE_ENABLED, TRACE_MAX_SPANS,
+        )
         self._trace_owner = False
-        want_trace = self.conf.get(TRACE_ENABLED) or \
+        collect_addr = self.conf.get(COLLECT_ADDRESS)
+        want_trace = self.conf.get(TRACE_ENABLED) or bool(collect_addr) or \
             os.environ.get("CYCLONE_TRACE", "").lower() not in \
             ("", "0", "false", "no")
-        if want_trace and _tracing.active() is None:
+        if want_trace and _tracing.full_active() is None:
+            # enable() also UPGRADES an installed flight ring to full
             _tracing.enable(max_spans=self.conf.get(TRACE_MAX_SPANS),
                             registry=self.metrics.registry)
             self._trace_owner = True
+
+        # always-on flight recorder: a bounded span ring when full tracing
+        # is off, dumped to cyclone.trace.dir on triggers (fault firing,
+        # mesh rebuild, serving shed, SLO breach) — observe/flight.py
+        from cycloneml_tpu.observe import flight as _flight
+        self._flight_owner = False
+        if self.conf.get(FLIGHT_ENABLED) and _tracing.active() is None:
+            _flight.enable(ring_spans=self.conf.get(FLIGHT_RING_SPANS))
+            self._flight_owner = True
+        from cycloneml_tpu.conf import TRACE_DIR as _TRACE_DIR
+        _flight.configure(
+            dump_dir=self.conf.get(_TRACE_DIR) or None,
+            min_interval_s=self.conf.get(FLIGHT_MIN_INTERVAL_MS) / 1e3)
+
+        # distributed-trace adoption + span shipping (observe/collect.py):
+        # a deploy-launched app joins the submitting process's trace
+        tracer = _tracing.active()
+        trace_env_id = os.environ.get("CYCLONE_TRACE_ID", "")
+        if tracer is not None and trace_env_id:
+            tracer.set_trace_context(
+                trace_env_id, os.environ.get("CYCLONE_TRACE_PARENT", ""))
+        self._shipper = None
+        if collect_addr and tracer is not None:
+            from cycloneml_tpu.conf import WORKER_ID as _WORKER_ID
+            from cycloneml_tpu.observe.collect import SpanShipper
+            label = self.conf.get(_WORKER_ID)
+            if not label:
+                proc_id = os.environ.get("CYCLONE_PROC_ID", "")
+                label = f"proc{proc_id}" if proc_id else \
+                    f"{__import__('socket').gethostname()}:{os.getpid()}"
+            batch = self.conf.get(COLLECT_MAX_BATCH)
+            self._shipper = SpanShipper(
+                collect_addr, host_label=label,
+                interval_s=self.conf.get(COLLECT_INTERVAL_MS) / 1e3,
+                max_batch=batch,
+                # the conf contract: a collector outage buffers 16x a
+                # batch before drop-counting oldest
+                max_buffer=16 * batch)
+
+        # online skew/straggler detection (observe/skew.py): installed
+        # process-globally so the oocore/serving/heartbeat lanes feed it
+        # with one global read per sample
+        from cycloneml_tpu.observe import skew as _skew
+        self._skew_owner = False
+        if self.conf.get(SKEW_ENABLED) and _skew.active() is None:
+            _skew.install(_skew.SkewDetector.from_conf(
+                self.conf, bus=self.listener_bus,
+                registry=self.metrics.registry))
+            self._skew_owner = True
+        self.skew_detector = _skew.active()
 
         from cycloneml_tpu.conf import PLUGINS
         from cycloneml_tpu.plugin import load_plugins
@@ -300,6 +359,10 @@ class CycloneContext:
                 self._job_cond.notify_all()
             if job_span is not None:
                 job_span.__exit__(None, None, None)
+            if job_span is not None and tracer.full:
+                # profile rollups are a FULL-tracing feature: the flight
+                # ring records the job span for post-hoc dumps but must
+                # not pay a per-job scan/event (the always-on contract)
                 try:
                     prof = tracer.profile_for(sid, since=mark)
                     prof.job_id = jid
@@ -381,6 +444,10 @@ class CycloneContext:
         from cycloneml_tpu.parallel.resilience import MeshSupervisor
         sup = MeshSupervisor(self, **kw)
         sup.attach(self.heartbeat_receiver)
+        if self.skew_detector is not None:
+            # straggler verdicts land in sup.stragglers() — the elastic
+            # scheduler's mitigation input (detection now, ROADMAP item 4)
+            sup.attach_skew(self.skew_detector)
         return sup
 
     def start_ui(self, host: str = "127.0.0.1", port: int = 0):
@@ -587,8 +654,21 @@ class CycloneContext:
                     _ExchangeServer.close_address(addrs[rank])
         except Exception:
             logger.exception("exchange server shutdown failed")
+        if getattr(self, "_shipper", None) is not None:
+            # final flush BEFORE any tracer teardown: the collector must
+            # see every span this app recorded, including ApplicationEnd's
+            self._shipper.stop(flush=True)
+            self._shipper = None
+        if getattr(self, "_skew_owner", False):
+            from cycloneml_tpu.observe import skew as _skew
+            _skew.uninstall()
+        if getattr(self, "_flight_owner", False):
+            from cycloneml_tpu.observe import flight as _flight
+            _flight.disable()
         if getattr(self, "_trace_owner", False):
-            tracer = _tracing.active()
+            # full_active: the full tracer this context installed (never a
+            # flight ring someone else slipped in after a disable)
+            tracer = _tracing.full_active()
             if tracer is not None:
                 from cycloneml_tpu.conf import TRACE_DIR
                 d = self.conf.get(TRACE_DIR)

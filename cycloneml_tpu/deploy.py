@@ -613,18 +613,40 @@ def submit_app(master_addr: str, app_path: str, n_procs: int = 1,
                retries: int = 10, retry_wait_s: float = 0.5) -> str:
     """Client-side submit (ref deploy/Client.scala): returns the app id.
 
+    Trace context propagates over this wire (the Dapper join,
+    observe/collect.py): when this process runs a TraceCollector and a
+    tracer, the submit opens a ``deploy`` span and injects the collector's
+    launch env — trace id, the submit span's host-qualified id as remote
+    parent, and the collector address — into the app env the Master
+    schedules and the Worker hands to the launched process, whose
+    CycloneContext then adopts the context and ships its spans back.
+    Explicit ``env`` keys win over the injected ones.
+
     Retryable rejections (a remote worker's probed-port pool momentarily
     drained, an HA election in progress) are retried here so callers see
     them only when persistent."""
-    for attempt in range(retries + 1):
-        rep = _send_ha(master_addr, {"kind": "submit", "app_path": app_path,
-                                     "n_procs": n_procs, "args": args or [],
-                                     "env": env or {}})
-        if rep.get("ok"):
-            return rep["app_id"]
-        if not rep.get("retryable") or attempt == retries:
-            raise RuntimeError(f"submit rejected: {rep.get('error')}")
-        time.sleep(retry_wait_s)
+    from cycloneml_tpu.observe import collect, tracing
+    submit_env = dict(env or {})
+    tr = tracing.active()
+    col = collect.active_collector()
+    span = tr.span("deploy", f"submit {os.path.basename(app_path)}",
+                   n_procs=n_procs) if tr is not None else tracing.NOOP_SPAN
+    with span as sp:
+        if col is not None:
+            injected = col.launch_env(parent_span_id=sp.span_id)
+            for k, v in injected.items():
+                submit_env.setdefault(k, v)
+        for attempt in range(retries + 1):
+            rep = _send_ha(master_addr,
+                           {"kind": "submit", "app_path": app_path,
+                            "n_procs": n_procs, "args": args or [],
+                            "env": submit_env})
+            if rep.get("ok"):
+                sp.annotate(app_id=rep["app_id"])
+                return rep["app_id"]
+            if not rep.get("retryable") or attempt == retries:
+                raise RuntimeError(f"submit rejected: {rep.get('error')}")
+            time.sleep(retry_wait_s)
     raise AssertionError("unreachable")
 
 

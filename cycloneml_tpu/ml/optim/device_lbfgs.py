@@ -392,7 +392,9 @@ class DeviceLBFGS(LBFGS):
                     tsp.annotate_bytes(
                         (f_h, losses, it, evals, code, k_h, f0_h))
             dsp.annotate(evals=int(evals))
-            tr = tracing.active()
+            # cost harvest only under a FULL tracer: the flight-recorder
+            # ring records spans and must not pay an AOT analyze
+            tr = tracing.full_active()
             if tr is not None:
                 if pid is None:
                     pid = costs.ensure("lbfgs.chunk", key, prog, args)
@@ -731,7 +733,8 @@ class StackedDeviceLBFGS:
                     tsp.annotate_bytes(
                         (losses, steps, iters, ev_pm, ev_g, code_h, f0_h))
             dsp.annotate(evals=int(ev_g))
-            tr = tracing.active()
+            # full tracer only: no AOT analyze under the flight ring
+            tr = tracing.full_active()
             if tr is not None:
                 if pid is None:
                     pid = costs.ensure("lbfgs.stacked_chunk", key, prog,

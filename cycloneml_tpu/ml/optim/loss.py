@@ -152,7 +152,9 @@ class DistributedLossFunction:
                 cdt.type(init_alpha),
                 cdt.type(self.weight_sum))
         pid = None
-        tr = tracing.active()
+        # full tracer only: the flight-recorder ring must not trigger the
+        # AOT cost analyze / budget check
+        tr = tracing.full_active()
         if tr is not None:
             # cost harvest BEFORE the dispatch (registry-cached once per
             # program identity): a raise-mode budget guard must fire before

@@ -6,18 +6,20 @@ touches jax, so the analysis tooling and pure-host paths can import it
 freely.
 """
 
-from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.observe import costs, flight, skew, tracing
 from cycloneml_tpu.observe.costs import ProgramCost
 from cycloneml_tpu.observe.export import (chrome_trace, export_chrome_trace,
+                                          merged_chrome_trace, process_lanes,
                                           span_kinds, validate_chrome_trace)
 from cycloneml_tpu.observe.profile import FitProfile
 from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
                                            current_span_id, disable, enable,
-                                           instant, span)
+                                           full_active, instant, span)
 
 __all__ = [
-    "tracing", "costs", "Span", "Tracer", "FitProfile", "ProgramCost",
-    "enable", "disable", "active", "span", "instant", "current_span_id",
-    "chrome_trace", "export_chrome_trace", "validate_chrome_trace",
+    "tracing", "costs", "flight", "skew", "Span", "Tracer", "FitProfile",
+    "ProgramCost", "enable", "disable", "active", "full_active", "span",
+    "instant", "current_span_id", "chrome_trace", "export_chrome_trace",
+    "merged_chrome_trace", "process_lanes", "validate_chrome_trace",
     "span_kinds",
 ]

@@ -1,0 +1,424 @@
+"""Cross-process trace collection: shipper, collector, clock alignment.
+
+Dapper's model (Sigelman et al. 2010, PAPERS.md) applied to the deploy
+fabric: every process records spans into its local tracer; a
+:class:`SpanShipper` on each worker drains the ring (bounded, batched,
+drop-counted) and ships completed spans over TCP to a
+:class:`TraceCollector` on the master, which merges everything into ONE
+Chrome trace with a process lane per host
+(:func:`~cycloneml_tpu.observe.export.merged_chrome_trace`).
+
+Trace context rides the deploy wire: ``deploy.submit_app`` opens a
+``deploy`` span and injects the active collector's launch env
+(:meth:`TraceCollector.launch_env`) — trace id, the submit span's
+host-qualified id as the remote parent, and the collector address (a
+``cyclone.telemetry.collect.address`` conf seed) — into the app env the
+Master schedules and the Worker passes to the launched process. The
+launched ``CycloneContext`` adopts the context
+(``Tracer.set_trace_context``) and starts a shipper, so a master-submitted
+step correlates with its worker-side dispatch spans by construction.
+
+Clock alignment: wall clocks differ across hosts, so the collector
+estimates a per-host offset from the EXTENDED heartbeat pings
+(``parallel/resilience.py``): each round trip yields an NTP-style sample
+``offset = (t_send + t_recv)/2 - t_server`` whose error is bounded by
+RTT/2 (the true send→server and server→recv legs each lie inside the
+measured RTT). The sender records samples here
+(:func:`record_offset_sample`); the shipper forwards the recent window
+with every batch; the collector takes the **median of the lowest-RTT
+samples** — robust to the asymmetric-delay outliers a loaded fabric
+produces — and corrects that host's timestamps by a constant, which
+preserves per-lane monotonicity. Hosts that never heartbeat merge at
+offset 0 with an explicit ``offset_err_s: None``.
+
+Wire protocol: one JSON line per connection on the shared authed TCP
+fabric (util/tcp.py — the deploy/heartbeat handshake covers this channel
+too): ``{"kind": "spans", "host": ..., "pid": ..., "trace_id": ...,
+"dropped": ..., "offset_samples": [[offset_s, rtt_s], ...],
+"tid_names": {...}, "spans": [...]}`` → ``{"ok": true}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from cycloneml_tpu.observe import export, tracing
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: offset samples retained process-wide / forwarded per batch
+MAX_OFFSET_SAMPLES = 128
+SHIPPED_OFFSET_SAMPLES = 32
+#: lowest-RTT samples the collector's median runs over
+OFFSET_ESTIMATE_K = 5
+#: per-host span bound on the collector (drop-counted past it)
+MAX_SPANS_PER_HOST = 200_000
+
+
+# -- clock-offset sample registry (fed by HeartbeatSender._ping) ---------------
+_offset_lock = threading.Lock()
+_offset_samples: "deque[Tuple[float, float]]" = deque(
+    maxlen=MAX_OFFSET_SAMPLES)
+
+
+def record_offset_sample(offset_s: float, rtt_s: float) -> None:
+    """One NTP-style (offset, rtt) sample of this process's clock vs the
+    heartbeat server's; |true offset - offset_s| <= rtt_s / 2."""
+    with _offset_lock:
+        _offset_samples.append((float(offset_s), float(rtt_s)))
+
+
+def offset_samples(limit: int = SHIPPED_OFFSET_SAMPLES
+                   ) -> List[Tuple[float, float]]:
+    with _offset_lock:
+        samples = list(_offset_samples)
+    return samples[-limit:]
+
+
+def clear_offset_samples() -> None:
+    with _offset_lock:
+        _offset_samples.clear()
+
+
+def estimate_offset(samples) -> Tuple[float, Optional[float]]:
+    """(offset_s, error_bound_s) from (offset, rtt) samples: the median of
+    the :data:`OFFSET_ESTIMATE_K` lowest-RTT samples, bounded by the worst
+    RTT/2 among those used. (0.0, None) when there are no samples."""
+    samples = [(float(o), float(r)) for o, r in (samples or [])]
+    if not samples:
+        return 0.0, None
+    best = sorted(samples, key=lambda s: s[1])[:OFFSET_ESTIMATE_K]
+    offset = statistics.median(o for o, _ in best)
+    err = max(r for _, r in best) / 2.0
+    return offset, err
+
+
+# -- span wire encoding --------------------------------------------------------
+
+def encode_spans(spans, wall_base: float) -> List[Dict[str, Any]]:
+    """Span objects -> JSON-able wire dicts with WALL-clock t0/t1 (the
+    shipper converts; the collector only ever sees absolute times)."""
+    out = []
+    for s in spans:
+        out.append({"id": s.span_id, "parent": s.parent_id, "kind": s.kind,
+                    "name": s.name, "t0": wall_base + s.t0,
+                    "t1": wall_base + s.t1, "tid": s.tid,
+                    "attrs": dict(s.attrs)})
+    return out
+
+
+# -- collector (master side) ---------------------------------------------------
+
+_active_lock = threading.Lock()
+_active_collector: Optional["TraceCollector"] = None
+
+
+def active_collector() -> Optional["TraceCollector"]:
+    """The process-global collector (deploy.submit_app injects its launch
+    env automatically when one is running)."""
+    with _active_lock:
+        return _active_collector
+
+
+class TraceCollector:
+    """TCP endpoint receiving span batches; merges every host's spans —
+    plus this process's own tracer — into one Chrome trace."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 host_label: str = "master", tracer=None):
+        import socketserver
+        self.host_label = host_label
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, Dict[str, Any]] = {}
+        self.batches = 0
+        self.dropped = 0      # spans past the per-host bound
+        collector = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    self.request.settimeout(10.0)
+                    line = self.rfile.readline(16 * 1024 * 1024)
+                    if not line.strip():
+                        return
+                    reply = collector._ingest(json.loads(line))
+                except Exception as e:  # malformed batch must not kill us
+                    reply = {"ok": False, "error": repr(e)}
+                self.wfile.write((json.dumps(reply) + "\n").encode())
+
+        from cycloneml_tpu.util.tcp import start_tcp_server
+        self._server = start_tcp_server(host, port, Handler,
+                                        "cyclone-trace-collector")
+        self.address = f"{host}:{self._server.server_address[1]}"
+        global _active_collector
+        with _active_lock:
+            if _active_collector is None:
+                _active_collector = self
+        logger.info("trace collector listening on %s", self.address)
+
+    # -- ingestion -------------------------------------------------------------
+    def _ingest(self, msg: dict) -> dict:
+        if msg.get("kind") != "spans":
+            return {"ok": False, "error": f"unknown kind {msg.get('kind')!r}"}
+        host = str(msg.get("host") or "unknown")
+        # sanitize BEFORE storing: a malformed batch must fail ITS reply,
+        # never poison hosts()/merged_trace() with a deferred ValueError
+        # on every later read (the bad record would sit in _hosts forever)
+        spans = []
+        for w in msg.get("spans") or []:
+            try:
+                spans.append({
+                    "id": str(w.get("id", "")), "parent":
+                        str(w.get("parent", "")),
+                    "kind": str(w.get("kind", "span")),
+                    "name": str(w.get("name", "")),
+                    "t0": float(w.get("t0", 0.0)),
+                    "t1": float(w.get("t1", 0.0)),
+                    "tid": int(w.get("tid", 0)),
+                    "attrs": dict(w.get("attrs") or {})})
+            except (TypeError, ValueError, AttributeError):
+                continue  # skip the torn span, keep the batch
+        samples = []
+        for pair in msg.get("offset_samples") or []:
+            try:
+                o, r = pair
+                samples.append((float(o), float(r)))
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            rec = self._hosts.setdefault(host, {
+                "host": host, "pid": msg.get("pid"), "trace_id": "",
+                # worker-reported drops (ring + ship buffer; a running
+                # total, so each batch REPLACES it) are tracked apart
+                # from drops the collector itself takes past the
+                # per-host bound (a local running sum) — "dropped" in
+                # hosts()/the merged header is their sum
+                "ship_dropped": 0, "local_dropped": 0,
+                "offset_samples": [], "tid_names": {}, "spans": []})
+            rec["pid"] = msg.get("pid") or rec["pid"]
+            if msg.get("trace_id"):
+                rec["trace_id"] = str(msg["trace_id"])
+            try:
+                rec["ship_dropped"] = int(msg.get("dropped") or 0)
+            except (TypeError, ValueError):
+                pass
+            rec["offset_samples"].extend(samples)
+            rec["offset_samples"] = rec["offset_samples"][-MAX_OFFSET_SAMPLES:]
+            try:
+                rec["tid_names"].update(
+                    {int(k): str(v)
+                     for k, v in (msg.get("tid_names") or {}).items()})
+            except (TypeError, ValueError):
+                pass
+            rec["spans"].extend(spans)
+            over = len(rec["spans"]) - MAX_SPANS_PER_HOST
+            if over > 0:
+                # oldest-dropped, same ring discipline as the tracer
+                del rec["spans"][:over]
+                rec["local_dropped"] += over
+                self.dropped += over
+            self.batches += 1
+        return {"ok": True, "received": len(spans)}
+
+    # -- reading ---------------------------------------------------------------
+    def hosts(self) -> Dict[str, Dict[str, Any]]:
+        """Per-host ingest state: pid, trace_id, span/drop counts, and the
+        current clock-offset estimate."""
+        out = {}
+        with self._lock:
+            items = [(h, dict(rec, spans=len(rec["spans"])))
+                     for h, rec in self._hosts.items()]
+        for host, rec in items:
+            offset, err = estimate_offset(rec.pop("offset_samples"))
+            rec["offset_s"], rec["offset_err_s"] = offset, err
+            rec["dropped"] = (rec.pop("ship_dropped")
+                              + rec.pop("local_dropped"))
+            out[host] = rec
+        return out
+
+    def _records(self) -> List[Dict[str, Any]]:
+        records: List[Dict[str, Any]] = []
+        tr = self._tracer if self._tracer is not None else tracing.active()
+        if tr is not None:
+            # the collector's own process is lane 1, offset 0 by definition
+            records.append({
+                "host": self.host_label, "pid": os.getpid(),
+                "offset_s": 0.0, "offset_err_s": 0.0,
+                "trace_id": tr.trace_id, "dropped": tr.dropped,
+                "tid_names": tr.thread_names(),
+                "spans": encode_spans(tr.snapshot(), tr.wall_base)})
+        with self._lock:
+            hosts = [dict(rec, spans=list(rec["spans"]),
+                          offset_samples=list(rec["offset_samples"]))
+                     for rec in self._hosts.values()]
+        for rec in sorted(hosts, key=lambda r: r["host"]):
+            offset, err = estimate_offset(rec.pop("offset_samples"))
+            rec["offset_s"], rec["offset_err_s"] = offset, err
+            rec["dropped"] = (rec.pop("ship_dropped")
+                              + rec.pop("local_dropped"))
+            records.append(rec)
+        return records
+
+    def merged_trace(self) -> Dict[str, Any]:
+        """ONE Chrome-trace object: a process lane per host, span ids
+        host-qualified, timestamps clock-offset corrected."""
+        return export.merged_chrome_trace(self._records())
+
+    def export(self, path: str) -> str:
+        return export.write_chrome_trace(self.merged_trace(), path)
+
+    # -- launch-env propagation ------------------------------------------------
+    def launch_env(self, parent_span_id: str = "",
+                   trace_id: str = "") -> Dict[str, str]:
+        """Env vars that make a launched process join this collector's
+        distributed trace: adopted trace id + remote parent
+        (``CYCLONE_TRACE_ID``/``CYCLONE_TRACE_PARENT``) and the collector
+        address via the normal conf env channel, which also auto-enables
+        tracing in the launched context."""
+        tr = self._tracer if self._tracer is not None else tracing.active()
+        tid = trace_id or (tr.trace_id if tr is not None else "")
+        env = {
+            "CYCLONE_CONF_cyclone__telemetry__collect__address":
+                self.address,
+        }
+        if tid:
+            env["CYCLONE_TRACE_ID"] = tid
+        if parent_span_id:
+            env["CYCLONE_TRACE_PARENT"] = export._qualify(
+                parent_span_id, self.host_label)
+        return env
+
+    def stop(self) -> None:
+        global _active_collector
+        with _active_lock:
+            if _active_collector is self:
+                _active_collector = None
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- shipper (worker side) -----------------------------------------------------
+
+class SpanShipper:
+    """Periodically drains the active tracer and ships span batches to a
+    collector. Bounded and drop-counted: an unreachable collector buffers
+    up to ``max_buffer`` wire spans (oldest dropped past it) and retries
+    each interval; shipping never blocks a recording site.
+
+    Single-threaded by design: the buffer and cursor are touched ONLY by
+    the shipper thread (plus the final flush, which runs after the thread
+    is joined) — no lock, no lock-ordering surface.
+    """
+
+    def __init__(self, address: str, host_label: str,
+                 interval_s: float = 0.5, max_batch: int = 4096,
+                 max_buffer: int = 65536, tracer=None):
+        host, _, port = str(address).rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.host_label = host_label
+        self.interval_s = max(float(interval_s), 0.01)
+        self.max_batch = max(int(max_batch), 1)
+        self.max_buffer = max(int(max_buffer), self.max_batch)
+        self._tracer = tracer
+        self._since = 0
+        self._buf: List[Dict[str, Any]] = []
+        self.shipped = 0
+        self.dropped = 0      # buffer overflow while the collector was away
+        # spans the RING evicted before a drain reached them — the only
+        # tracer-side loss that is DELIVERY loss. tr.dropped alone counts
+        # every ring rotation, which on a long healthy job is huge while
+        # actual loss is zero (the cursor passes spans before eviction).
+        self.ring_missed = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="cyclone-trace-ship", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._ship_once()
+            except Exception:
+                logger.exception("span shipper tick failed")
+
+    def _ship_once(self) -> int:
+        tr = self._tracer if self._tracer is not None else tracing.active()
+        if tr is None:
+            return 0
+        prev = self._since
+        spans, self._since = tr.drain(self._since)
+        # positions advanced past vs spans delivered: the difference fell
+        # off the ring floor between drains — true delivery loss
+        self.ring_missed += max(0, (self._since - prev) - len(spans))
+        if spans:
+            self._buf.extend(encode_spans(spans, tr.wall_base))
+            over = len(self._buf) - self.max_buffer
+            if over > 0:
+                del self._buf[:over]
+                self.dropped += over
+        if not self._buf:
+            return 0
+        sent = 0
+        while self._buf:
+            batch, rest = (self._buf[:self.max_batch],
+                           self._buf[self.max_batch:])
+            msg = {"kind": "spans", "host": self.host_label,
+                   "pid": os.getpid(), "trace_id": tr.trace_id,
+                   # DELIVERY loss only: ring evictions the cursor missed
+                   # plus ship-buffer overflow — NOT tr.dropped, which
+                   # counts every rotation of a ring the cursor outruns
+                   "dropped": self.ring_missed + self.dropped,
+                   "offset_samples": offset_samples(),
+                   "tid_names": tr.thread_names(), "spans": batch}
+            try:
+                reply = self._send(msg)
+            except (OSError, ValueError):
+                break  # collector away: keep buffering, retry next tick
+            if not reply.get("ok"):
+                logger.warning("span batch rejected: %s", reply.get("error"))
+                break
+            self._buf = rest
+            sent += len(batch)
+            self.shipped += len(batch)
+        return sent
+
+    def _send(self, msg: dict) -> dict:
+        from cycloneml_tpu.util.tcp import check_not_challenge, connect_authed
+        with connect_authed(self._addr[0], self._addr[1], timeout=10) as s:
+            s.sendall((json.dumps(msg, default=str) + "\n").encode())
+            fh = s.makefile("r")
+            try:
+                line = fh.readline()
+            finally:
+                fh.close()
+        check_not_challenge(line)
+        return json.loads(line) if line.strip() else {}
+
+    def flush(self) -> int:
+        """Final synchronous ship — call AFTER :meth:`stop` (the loop
+        thread is then joined, so the single-owner discipline holds)."""
+        return self._ship_once()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # the loop thread is wedged mid-_send (hung collector):
+            # flushing NOW would break the single-owner discipline on
+            # _buf/_since (double-delivery or a corrupted cursor) —
+            # skip, loudly
+            logger.warning("span shipper thread still busy after stop; "
+                           "skipping the final flush")
+            return
+        if flush:
+            try:
+                self._ship_once()
+            except Exception:
+                logger.exception("final span flush failed")

@@ -466,10 +466,12 @@ def device_memory_limit(conf=None) -> Optional[int]:
 def guard_armed(conf) -> bool:
     """The guard costs an AOT analysis, so it arms only when someone asked
     for it: an explicit ``cyclone.memory.budgetFraction`` in the conf, or
-    tracing already on (the harvest is then already paid)."""
+    FULL tracing already on (the harvest is then already paid). The
+    always-on flight-recorder ring (``Tracer.full`` False) does NOT arm it
+    — flight mode's whole contract is recording spans at near-zero cost."""
     from cycloneml_tpu.conf import MEMORY_BUDGET_FRACTION
     return (conf.contains_raw(MEMORY_BUDGET_FRACTION.key)
-            or tracing.active() is not None)
+            or tracing.full_active() is not None)
 
 
 def check_budget(pid: str, conf=None, bus=None,

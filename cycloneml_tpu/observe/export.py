@@ -1,11 +1,21 @@
-"""Chrome-trace (Trace Event Format) export.
+"""Chrome-trace (Trace Event Format) export — single- and multi-process.
 
-Writes the tracer's spans as the JSON Object Format chrome://tracing and
-Perfetto both load: ``{"traceEvents": [...], "displayTimeUnit": "ms"}``
-with complete (``ph: "X"``) events for spans and instant (``ph: "i"``)
-events for annotations. Timestamps are wall-clock microseconds (the
-tracer anchors its monotonic clock to ``time.time`` at construction), so
-traces from cooperating processes line up on one timeline.
+Writes spans as the JSON Object Format chrome://tracing and Perfetto both
+load: ``{"traceEvents": [...], "displayTimeUnit": "ms"}`` with complete
+(``ph: "X"``) events for spans, instant (``ph: "i"``) events for
+annotations, counter (``ph: "C"``) samples, and metadata (``ph: "M"``)
+``process_name``/``thread_name`` events so Perfetto labels every lane by
+host and thread instead of bare pids. Timestamps are wall-clock
+microseconds (each tracer anchors its monotonic clock to ``time.time`` at
+construction); the top-level ``otherData`` object carries the trace id,
+host label and the ring's ``spans_dropped`` count.
+
+``merged_chrome_trace`` folds several processes' shipped span batches
+(``observe/collect.py``) into ONE trace: every host gets its own process
+lane, its span/parent ids are qualified as ``host/sN`` so they stay unique
+across processes, and its timestamps are corrected by the collector's
+per-host clock-offset estimate (heartbeat RTT midpoints; docs/
+observability.md has the math and its error bound).
 
 ``validate_chrome_trace`` is the schema check ``make obs-demo`` and the
 tier-1 tests run over an exported file — it pins the invariants Perfetto
@@ -16,7 +26,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 REQUIRED_TOP = "traceEvents"
 DURATION_PH = "X"
@@ -25,53 +35,146 @@ COUNTER_PH = "C"
 METADATA_PH = "M"
 
 
-def chrome_trace(tracer) -> Dict[str, Any]:
-    """Render a tracer's spans to a Trace Event Format object."""
-    pid = os.getpid()
+def _qualify(sid: str, host: str) -> str:
+    """Host-qualify a span id for a merged trace; ids that already carry a
+    host label (a remote parent propagated through the deploy env) pass
+    through untouched."""
+    if not sid or "/" in sid:
+        return sid
+    return f"{host}/{sid}"
+
+
+def _span_event(kind: str, name: str, ts_us: float, dur_us: float,
+                pid: int, tid: int, args: Dict[str, Any]) -> Dict[str, Any]:
+    if kind == "counter":
+        # Perfetto renders "C" events as a per-name counter track —
+        # the HBM / cumulative-FLOPs timeline next to the spans
+        return {"name": name, "cat": "counter", "ph": COUNTER_PH,
+                "ts": ts_us, "pid": pid, "tid": tid,
+                "args": {"value": args.get("value", 0)}}
+    if kind == "instant":
+        return {"name": name, "cat": "instant", "ph": INSTANT_PH,
+                "ts": ts_us, "pid": pid, "tid": tid, "s": "t", "args": args}
+    return {"name": name, "cat": kind, "ph": DURATION_PH, "ts": ts_us,
+            # zero-duration X events render invisibly; floor at 1ns
+            "dur": max(dur_us, 0.001), "pid": pid, "tid": tid, "args": args}
+
+
+def _metadata_events(pid: int, process_name: str,
+                     tid_names: Dict[int, str]) -> List[Dict[str, Any]]:
     events: List[Dict[str, Any]] = [{
         "name": "process_name", "ph": METADATA_PH, "pid": pid, "tid": 0,
-        "args": {"name": "cycloneml-tpu"},
+        "args": {"name": process_name},
     }]
-    base = tracer.epoch_wall - tracer.epoch_perf
-    for s in tracer.snapshot():
+    for tid, tname in sorted(tid_names.items()):
+        events.append({"name": "thread_name", "ph": METADATA_PH,
+                       "pid": pid, "tid": tid, "args": {"name": tname}})
+    return events
+
+
+def _events_for_spans(spans, base: float, pid: int,
+                      host: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Render Span objects to events. ``base`` maps perf_counter readings
+    onto wall time; ``host`` (merged traces) qualifies span/parent ids."""
+    events: List[Dict[str, Any]] = []
+    for s in spans:
         ts_us = (base + s.t0) * 1e6
-        args = {"span_id": s.span_id}
-        if s.parent_id:
-            args["parent_id"] = s.parent_id
+        sid = s.span_id
+        parent = s.parent_id
+        if host is not None:
+            sid = _qualify(sid, host)
+            parent = _qualify(parent, host)
+        args = {"span_id": sid}
+        if parent:
+            args["parent_id"] = parent
         args.update(s.attrs)
-        if s.kind == "counter":
-            # Perfetto renders "C" events as a per-name counter track —
-            # the HBM / cumulative-FLOPs timeline next to the spans
-            events.append({
-                "name": s.name, "cat": "counter", "ph": COUNTER_PH,
-                "ts": ts_us, "pid": pid, "tid": s.tid,
-                "args": {"value": s.attrs.get("value", 0)},
-            })
-        elif s.kind == "instant":
-            events.append({
-                "name": s.name, "cat": "instant", "ph": INSTANT_PH,
-                "ts": ts_us, "pid": pid, "tid": s.tid, "s": "t",
-                "args": args,
-            })
-        else:
-            events.append({
-                "name": s.name, "cat": s.kind, "ph": DURATION_PH,
-                "ts": ts_us,
-                # zero-duration X events render invisibly; floor at 1ns
-                "dur": max((s.t1 - s.t0) * 1e6, 0.001),
-                "pid": pid, "tid": s.tid, "args": args,
-            })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+        events.append(_span_event(s.kind, s.name, ts_us,
+                                  (s.t1 - s.t0) * 1e6, pid, s.tid, args))
+    return events
+
+
+def chrome_trace(tracer, spans=None,
+                 other: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Render a tracer's spans (or an explicit ``spans`` window — the
+    flight recorder's dump path) to a Trace Event Format object."""
+    pid = os.getpid()
+    label = f"cycloneml-tpu (pid {pid})"
+    events = _metadata_events(pid, label, tracer.thread_names())
+    events.extend(_events_for_spans(
+        spans if spans is not None else tracer.snapshot(),
+        tracer.epoch_wall - tracer.epoch_perf, pid))
+    meta: Dict[str, Any] = {"trace_id": tracer.trace_id,
+                            "spans_dropped": tracer.dropped}
+    if other:
+        meta.update(other)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": meta}
+
+
+def merged_chrome_trace(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """ONE trace from several processes' span records.
+
+    Each record: ``{"host": label, "spans": [wire span dicts with
+    wall-clock t0/t1], "offset_s": clock offset vs the collector,
+    "offset_err_s": its error bound, "trace_id": ..., "dropped": ...,
+    "tid_names": {tid: name}, "pid": source OS pid}``. Hosts get synthetic
+    lane pids (1..N, collector order) — OS pids can collide across hosts —
+    with the real pid kept in the process_name label. Per-host timestamps
+    are corrected onto the collector's clock (``t - offset_s``); the
+    correction is a constant per host, so per-lane ordering is preserved.
+    """
+    events: List[Dict[str, Any]] = []
+    meta: Dict[str, Any] = {"hosts": {}}
+    trace_ids = set()
+    for lane, rec in enumerate(records, start=1):
+        host = str(rec.get("host") or f"proc{lane}")
+        offset = float(rec.get("offset_s") or 0.0)
+        src_pid = rec.get("pid")
+        label = f"{host} (pid {src_pid})" if src_pid else host
+        tid_names = {int(k): str(v)
+                     for k, v in (rec.get("tid_names") or {}).items()}
+        events.extend(_metadata_events(lane, label, tid_names))
+        for w in rec.get("spans", []):
+            args = {"span_id": _qualify(str(w.get("id", "")), host)}
+            parent = _qualify(str(w.get("parent", "")), host)
+            if parent:
+                args["parent_id"] = parent
+            args.update(w.get("attrs") or {})
+            t0 = float(w.get("t0", 0.0)) - offset
+            t1 = float(w.get("t1", t0)) - offset
+            events.append(_span_event(
+                str(w.get("kind", "span")), str(w.get("name", "")),
+                t0 * 1e6, (t1 - t0) * 1e6, lane, int(w.get("tid", 0)),
+                args))
+        if rec.get("trace_id"):
+            trace_ids.add(str(rec["trace_id"]))
+        meta["hosts"][host] = {
+            "lane_pid": lane, "pid": src_pid,
+            "offset_s": offset,
+            "offset_err_s": rec.get("offset_err_s"),
+            "trace_id": rec.get("trace_id"),
+            "spans_dropped": int(rec.get("dropped") or 0),
+        }
+    meta["spans_dropped"] = sum(h["spans_dropped"]
+                                for h in meta["hosts"].values())
+    if len(trace_ids) == 1:
+        meta["trace_id"] = next(iter(trace_ids))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": meta}
+
+
+def write_chrome_trace(obj: Dict[str, Any], path: str) -> str:
+    """Atomic trace write (readers never see a half-written file)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, default=str)
+    os.replace(tmp, path)
+    return path
 
 
 def export_chrome_trace(tracer, path: str) -> str:
     """Write the trace JSON to ``path`` (returns the path)."""
-    obj = chrome_trace(tracer)
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(obj, fh, default=str)
-    os.replace(tmp, path)  # readers never see a half-written trace
-    return path
+    return write_chrome_trace(chrome_trace(tracer), path)
 
 
 def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
@@ -81,8 +184,9 @@ def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
     Checks: top-level ``traceEvents`` list; every event has ``name``/
     ``ph``/``pid``; duration events carry numeric ``ts`` and ``dur >= 0``;
     instant events carry numeric ``ts``; counter (``"C"``) events carry a
-    numeric ``ts`` and an args object of numeric series values; ``args``
-    (when present) is an object.
+    numeric ``ts`` and an args object of numeric series values; metadata
+    (``"M"``) events are ``process_name``/``thread_name``-style with a
+    string ``args.name``; ``args`` (when present) is an object.
     """
     if isinstance(obj_or_path, str):
         with open(obj_or_path, encoding="utf-8") as fh:
@@ -108,6 +212,11 @@ def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
                 errors.append(f"{where}: missing {req!r}")
         ph = ev.get("ph")
         if ph == METADATA_PH:
+            # Perfetto lane labels: args.name is the displayed string
+            margs = ev.get("args")
+            if not isinstance(margs, dict) or \
+                    not isinstance(margs.get("name"), str):
+                errors.append(f"{where}: M event needs args.name string")
             continue
         if not isinstance(ev.get("ts"), (int, float)):
             errors.append(f"{where}: non-numeric 'ts'")
@@ -131,7 +240,8 @@ def validate_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]
 
 def span_kinds(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[str, int]:
     """Count events per category — the obs-demo's >= 4 distinct-kinds
-    acceptance check reads this."""
+    acceptance check reads this. Metadata (``M``) lane labels are not
+    spans and are excluded."""
     if isinstance(obj_or_path, str):
         with open(obj_or_path, encoding="utf-8") as fh:
             obj = json.load(fh)
@@ -142,4 +252,21 @@ def span_kinds(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[str, int]:
         if isinstance(ev, dict) and ev.get("ph") != METADATA_PH:
             cat = ev.get("cat", "")
             out[cat] = out.get(cat, 0) + 1
+    return out
+
+
+def process_lanes(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[int, str]:
+    """pid -> process_name label from the trace's metadata events (the
+    merged-trace acceptance counts these)."""
+    if isinstance(obj_or_path, str):
+        with open(obj_or_path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    else:
+        obj = obj_or_path
+    out: Dict[int, str] = {}
+    for ev in obj.get(REQUIRED_TOP, []):
+        if (isinstance(ev, dict) and ev.get("ph") == METADATA_PH
+                and ev.get("name") == "process_name"):
+            out[int(ev.get("pid", 0))] = str(
+                (ev.get("args") or {}).get("name", ""))
     return out

@@ -73,6 +73,10 @@ class FitProfile:
     rebuilds: int = 0
     faults_injected: int = 0
     n_models: int = 1
+    # ring overflow during this tracer's lifetime (tracing.Tracer.dropped,
+    # oldest-dropped): > 0 means the rollup undercounts — the profile saw
+    # only the surviving window
+    spans_dropped: int = 0
     # -- XLA cost & HBM accounting (None = unavailable on this backend) --
     total_flops: Optional[float] = None
     total_bytes_accessed: Optional[float] = None

@@ -1,0 +1,333 @@
+"""Online straggler/skew detection over per-lane step times.
+
+The reference's DAGScheduler decides speculation from per-task timing skew
+(PAPER.md layer 3a); the TPU analog has no per-task granularity — one SPMD
+dispatch is the whole mesh — but it DOES have repeating per-lane work whose
+times are separable on the host: out-of-core shard staging (one lane per
+shard slot), serving model lanes (one dispatch per lane), and per-worker
+heartbeat round trips. This module watches those durations online:
+
+- ``observe(group, position, seconds)`` feeds one sample. Instrumented
+  sites: ``oocore/stream.py`` (group ``oocore.stage``, position
+  ``shard<i>``), ``serving/batcher.py`` (group ``serving.dispatch``,
+  position = lane name), ``parallel/resilience.py`` (group
+  ``heartbeat.rtt``, position = worker id — a sender-side, process-local
+  sample: SLO-style monitoring only, see :data:`STRAGGLER_GROUPS`), and
+  ``collectives._instrument_dispatch`` (group ``collectives.step``,
+  position = program name — SLO-only, see below; compile-paying first
+  dispatches excluded).
+- Detection is rolling **median + MAD** across a group's positions: a
+  position whose rolling median exceeds the group median by
+  ``madFactor`` × MAD AND ``relFactor`` × median is a straggler. Both
+  conditions must hold: MAD alone fires on microscopic jitter when the
+  group is tight (MAD → 0), the relative factor alone misses skew on top
+  of a wide spread. The verdict LATCHES — one ``StragglerDetected`` event
+  per episode, not one per sample — and unlatches when the lane recovers.
+- Groups in :data:`STRAGGLER_GROUPS` get cross-lane comparison; every
+  group additionally gets an SLO check (``cyclone.telemetry.slo.*`` —
+  0 disables): a sample over target fires ONE latched ``SloBreach`` (and a
+  flight-recorder dump) until a sample comes back under target.
+  ``collectives.step`` positions are program names — comparing different
+  programs' times against each other is meaningless, so that group is
+  SLO-only by construction.
+
+Events go to the listener bus (status store ``skew`` list →
+``/api/v1/skew`` → the web UI table, replayable from the journal) and to
+subscribers: ``MeshSupervisor.attach_skew`` records stragglers so the
+elastic scheduler (ROADMAP item 4) can re-dispatch a slow lane's work —
+detection lands here, mitigation plugs into the subscription.
+
+Disabled discipline: ``skew.observe`` is one module-global read when no
+detector is installed (the ``faults.inject`` pattern); the context
+installs one by default (``cyclone.telemetry.skew.enabled``).
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: groups whose positions are comparable lanes (cross-lane straggler
+#: detection applies); everything else is SLO-only. ``heartbeat.rtt`` is
+#: deliberately NOT here: the sample is taken SENDER-side, and a real
+#: deployment runs one sender per process — its process-local detector
+#: only ever sees one lane, so the cross-worker comparison would be
+#: structurally dead. Master-side per-worker RTT comparison (the
+#: receiver would need its own timing leg) is the elastic-scheduler
+#: follow-up (ROADMAP item 4), not a silent promise here.
+STRAGGLER_GROUPS = frozenset({"oocore.stage", "serving.dispatch"})
+
+#: bound on distinct positions tracked per group — a pathological caller
+#: (unbounded lane names) degrades to ignoring NEW lanes, never to
+#: unbounded memory
+MAX_POSITIONS_PER_GROUP = 256
+
+#: shard indices fold into this many oocore lanes (``shard<i % N>``): skew
+#: detection needs repeated samples per lane, and a 10k-shard epoch would
+#: otherwise give every lane one sample per epoch and the detector none
+OOCORE_SKEW_LANES = 64
+
+MAX_KEPT_EVENTS = 64
+
+
+class SkewDetector:
+    """Rolling per-(group, position) duration windows + online skew/SLO
+    verdicts. Thread-safe; event emission happens outside the lock."""
+
+    def __init__(self, bus=None, window: int = 64, min_samples: int = 8,
+                 mad_factor: float = 4.0, rel_factor: float = 1.5,
+                 min_gap_s: float = 0.010,
+                 slo_s: Optional[Dict[str, float]] = None, registry=None):
+        self.bus = bus
+        self.registry = registry
+        self.window = max(int(window), 4)
+        self.min_samples = max(int(min_samples), 2)
+        self.mad_factor = float(mad_factor)
+        self.rel_factor = float(rel_factor)
+        # absolute-gap floor: at millisecond scale, benign jitter easily
+        # exceeds any RELATIVE factor — a lane only convicts when it is
+        # also materially slower in absolute terms (mitigation below this
+        # gap could never pay for itself anyway)
+        self.min_gap_s = float(min_gap_s)
+        self._slo = dict(slo_s or {})
+        self._lock = threading.Lock()
+        self._samples: Dict[str, Dict[str, deque]] = {}
+        # cached rolling median per (group -> position), invalidated only
+        # for the lane that just received a sample: one O(W log W) median
+        # for that lane + one O(P log P) group median/MAD per observe,
+        # NOT a full O(P·W log W) recomputation
+        self._medians: Dict[str, Dict[str, float]] = {}
+        self._flagged: set = set()          # latched (group, position)
+        self._slo_breached: set = set()     # latched (group, position)
+        self._subs: List[Callable[[Any], None]] = []
+        self._events: List[Any] = []        # bounded recent-event record
+
+    @classmethod
+    def from_conf(cls, conf, bus=None, registry=None) -> "SkewDetector":
+        from cycloneml_tpu.conf import (
+            SKEW_MAD_FACTOR, SKEW_MIN_GAP_MS, SKEW_MIN_SAMPLES,
+            SKEW_REL_FACTOR, SKEW_WINDOW, SLO_SERVING_MS, SLO_STEP_MS,
+        )
+        slo: Dict[str, float] = {}
+        step_ms = float(conf.get(SLO_STEP_MS))
+        if step_ms > 0:
+            slo["collectives.step"] = step_ms / 1e3
+        serving_ms = float(conf.get(SLO_SERVING_MS))
+        if serving_ms > 0:
+            slo["serving.dispatch"] = serving_ms / 1e3
+        return cls(bus=bus, registry=registry,
+                   window=conf.get(SKEW_WINDOW),
+                   min_samples=conf.get(SKEW_MIN_SAMPLES),
+                   mad_factor=conf.get(SKEW_MAD_FACTOR),
+                   rel_factor=conf.get(SKEW_REL_FACTOR),
+                   min_gap_s=conf.get(SKEW_MIN_GAP_MS) / 1e3, slo_s=slo)
+
+    # -- subscription (MeshSupervisor / future elastic scheduler) ------------
+    def subscribe(self, fn: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+    # -- feeding ---------------------------------------------------------------
+    def observe(self, group: str, position: str, seconds: float) -> None:
+        """One duration sample; fires latched events when a verdict
+        flips. Cheap by construction: ONE median over the sampled lane's
+        ``window`` plus one median/MAD over the cached per-lane medians —
+        never a full recomputation of every lane's window."""
+        fire: List[Any] = []
+        with self._lock:
+            positions = self._samples.setdefault(group, {})
+            dq = positions.get(position)
+            if dq is None:
+                if len(positions) >= MAX_POSITIONS_PER_GROUP:
+                    return
+                dq = positions[position] = deque(maxlen=self.window)
+            dq.append(float(seconds))
+            if group in STRAGGLER_GROUPS and len(dq) >= self.min_samples:
+                self._medians.setdefault(group, {})[position] = \
+                    statistics.median(dq)
+            self._check_slo(group, position, float(seconds), fire)
+            if group in STRAGGLER_GROUPS:
+                self._check_straggler(group, position, fire)
+        for ev in fire:
+            self._emit(ev)
+
+    def _check_slo(self, group: str, position: str, seconds: float,
+                   fire: List[Any]) -> None:
+        target = self._slo.get(group)
+        if not target:
+            return
+        key = (group, position)
+        if seconds > target:
+            if key not in self._slo_breached:
+                self._slo_breached.add(key)
+                from cycloneml_tpu.util.events import SloBreach
+                fire.append(SloBreach(group=group, position=position,
+                                      observed_s=seconds, target_s=target))
+        else:
+            self._slo_breached.discard(key)   # recovered: re-arm the latch
+
+    def _check_straggler(self, group: str, position: str,
+                         fire: List[Any]) -> None:
+        # cached per-lane medians (only the sampled lane was recomputed)
+        eligible = self._medians.get(group, {})
+        if len(eligible) < 2 or position not in eligible:
+            return
+        meds = list(eligible.values())
+        med = statistics.median(meds)
+        mad = statistics.median([abs(m - med) for m in meds])
+        mine = eligible[position]
+        is_straggler = (mine > med + self.mad_factor * mad
+                        and mine > self.rel_factor * med and med > 0
+                        and mine - med > self.min_gap_s)
+        key = (group, position)
+        if is_straggler:
+            if key not in self._flagged:
+                self._flagged.add(key)
+                from cycloneml_tpu.util.events import StragglerDetected
+                fire.append(StragglerDetected(
+                    group=group, position=position, observed_s=mine,
+                    median_s=med, mad_s=mad,
+                    n_samples=len(self._samples[group][position])))
+        else:
+            self._flagged.discard(key)        # recovered: re-arm the latch
+
+    # -- emission (outside the lock) -------------------------------------------
+    def _emit(self, ev) -> None:
+        from cycloneml_tpu.util.events import SloBreach, StragglerDetected
+        with self._lock:
+            self._events.append(ev)
+            while len(self._events) > MAX_KEPT_EVENTS:
+                self._events.pop(0)
+            subs = list(self._subs)
+        if isinstance(ev, StragglerDetected):
+            logger.warning(
+                "skew: straggler %s in group %s — rolling median %.4fs vs "
+                "group median %.4fs (MAD %.4fs, %d samples)",
+                ev.position, ev.group, ev.observed_s, ev.median_s, ev.mad_s,
+                ev.n_samples)
+        elif isinstance(ev, SloBreach):
+            logger.warning("skew: SLO breach in %s (%s): %.4fs > %.4fs",
+                           ev.group, ev.position, ev.observed_s, ev.target_s)
+            from cycloneml_tpu.observe import flight
+            flight.trigger("slo.breach", group=ev.group,
+                           position=ev.position, observed_s=ev.observed_s)
+        reg = self.registry
+        if reg is not None:
+            try:
+                reg.counter(f"skew.{type(ev).__name__}").inc()
+            except Exception:
+                pass  # a broken metrics bridge must not kill the step
+        if self.bus is not None:
+            try:
+                self.bus.post(ev)
+            except Exception:
+                pass  # a stopped bus must not fail the observing site
+        for fn in subs:
+            try:
+                fn(ev)
+            except Exception:
+                logger.exception("skew subscriber failed")
+
+    # -- introspection ---------------------------------------------------------
+    def stragglers(self) -> List[Tuple[str, str]]:
+        """Currently latched (group, position) straggler verdicts."""
+        with self._lock:
+            return sorted(self._flagged)
+
+    def events(self) -> List[Any]:
+        with self._lock:
+            return list(self._events)
+
+    def reset(self, group: Optional[str] = None) -> None:
+        with self._lock:
+            if group is None:
+                self._samples.clear()
+                self._medians.clear()
+                self._flagged.clear()
+                self._slo_breached.clear()
+            else:
+                self._samples.pop(group, None)
+                self._medians.pop(group, None)
+                self._flagged = {k for k in self._flagged
+                                 if k[0] != group}
+                self._slo_breached = {k for k in self._slo_breached
+                                      if k[0] != group}
+
+
+# -- process-global switch (the faults._active / tracing._tracer pattern) -----
+_lock = threading.Lock()
+_detector: Optional[SkewDetector] = None
+
+
+def install(detector: SkewDetector) -> Optional[SkewDetector]:
+    """Install the process-global detector; returns the PREVIOUS one (the
+    caller restores it when replacing temporarily, e.g. tests)."""
+    global _detector
+    with _lock:
+        prev, _detector = _detector, detector
+        return prev
+
+
+def uninstall(detector: Optional[SkewDetector] = None) -> None:
+    global _detector
+    with _lock:
+        if detector is None or _detector is detector:
+            _detector = None
+
+
+def active() -> Optional[SkewDetector]:
+    return _detector
+
+
+def observe(group: str, position: str, seconds: float) -> None:
+    """Instrumentation-site entry: one module-global read when no detector
+    is installed."""
+    det = _detector
+    if det is not None:
+        det.observe(group, position, seconds)
+
+
+def timed_observe(group: str, position: str):
+    """Context manager timing a block into :func:`observe`; the shared
+    no-op when no detector is installed."""
+    if _detector is None:
+        return _NOOP_TIMER
+    return _Timer(group, position)
+
+
+class _Timer:
+    __slots__ = ("_group", "_position", "_t0")
+
+    def __init__(self, group: str, position: str):
+        self._group = group
+        self._position = position
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if exc[0] is None:  # a failed lane's time is not a skew sample
+            observe(self._group, self._position,
+                    time.perf_counter() - self._t0)
+        return False
+
+
+class _NoopTimer:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopTimer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_TIMER = _NoopTimer()

@@ -52,14 +52,17 @@ inside tracing and would bake host work into the program (see
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
-    "Span", "Tracer", "enable", "disable", "active", "span", "instant",
-    "counter", "current_span_id", "nbytes",
+    "Span", "Tracer", "enable", "disable", "active", "full_active",
+    "install_if_absent", "span", "instant", "counter", "current_span_id",
+    "nbytes",
 ]
 
 
@@ -130,6 +133,11 @@ class _LiveSpan:
         stack = self._tracer._stack()
         if stack and not self.span.parent_id:
             self.span.parent_id = stack[-1].span_id
+        elif not stack and not self.span.parent_id:
+            # root span in a process that adopted a distributed trace
+            # context: parent to the submitting process's span (a
+            # host-qualified id, or "" when no context was adopted)
+            self.span.parent_id = self._tracer.parent_span_id
         stack.append(self.span)
         self.span.t0 = time.perf_counter()
         return self
@@ -162,7 +170,19 @@ class Tracer:
     nested fits and concurrent fits in different threads each get a correct
     parent chain. Cross-thread propagation is explicit: capture
     :meth:`current_span_id` in the submitting thread and pass it as
-    ``parent`` to :meth:`span` in the worker.
+    ``parent`` to :meth:`span` in the worker. Cross-PROCESS propagation is
+    the trace context (:meth:`set_trace_context`): ``trace_id`` names the
+    distributed trace this process participates in and ``parent_span_id``
+    (a host-qualified id from the submitting process) becomes the parent
+    of every root span recorded here — the Dapper join
+    (``observe/collect.py`` merges the per-process traces).
+
+    The buffer is a RING: past ``max_spans`` the OLDEST span is dropped
+    (and counted in ``dropped``), so a long job always retains its most
+    recent window — the flight-recorder semantics. Buffer positions are
+    monotonic sequence numbers (``mark``/``snapshot(since)``/``drain``
+    speak seq, not list index), so readers see exact once-each delivery
+    across wrap-arounds.
 
     ``registry`` (a :class:`~cycloneml_tpu.util.metrics.MetricsRegistry`)
     bridges spans into the metrics system: every closed span updates
@@ -170,17 +190,49 @@ class Tracer:
     Counter) — visible through the Prometheus endpoint.
     """
 
+    #: False on the flight-recorder tracer (observe/flight.py): sites that
+    #: pay real money when traced (XLA cost harvest, budget analysis,
+    #: per-job profile rollups) run only under a FULL tracer — the flight
+    #: ring records spans and nothing else.
+    full = True
+
     def __init__(self, max_spans: int = 100_000, registry=None):
         self.max_spans = max(1, int(max_spans))
         self.registry = registry
         # wall anchor: perf_counter offsets map onto real time for export
         self.epoch_wall = time.time()
         self.epoch_perf = time.perf_counter()
-        self._spans: List[Span] = []
-        self.dropped = 0
+        self._spans: "collections.deque[Span]" = collections.deque()
+        self._base = 0          # seq of the oldest span still in the ring
+        self.dropped = 0        # ring overflow: oldest-dropped count
+        self.trace_id = uuid.uuid4().hex[:16]
+        self.parent_span_id = ""   # remote parent for root spans ("" = none)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._local = threading.local()
+        self._tid_names: Dict[int, str] = {}
+
+    @property
+    def wall_base(self) -> float:
+        """Offset mapping a span's ``perf_counter`` reading onto wall
+        time: ``wall = wall_base + t``."""
+        return self.epoch_wall - self.epoch_perf
+
+    def set_trace_context(self, trace_id: str, parent_span_id: str = ""
+                          ) -> None:
+        """Adopt a distributed trace context (the deploy launch env's
+        ``CYCLONE_TRACE_ID`` / ``CYCLONE_TRACE_PARENT``): subsequent ROOT
+        spans parent to ``parent_span_id`` — a host-qualified id
+        (``label/sN``) minted by the submitting process."""
+        if trace_id:
+            self.trace_id = str(trace_id)
+        self.parent_span_id = str(parent_span_id or "")
+
+    def thread_names(self) -> Dict[int, str]:
+        """tid -> thread name for every thread that recorded a span (the
+        Chrome-trace ``thread_name`` metadata source)."""
+        with self._lock:
+            return dict(self._tid_names)
 
     # -- context ---------------------------------------------------------------
     def _stack(self) -> List[Span]:
@@ -233,10 +285,19 @@ class Tracer:
 
     def _record(self, s: Span) -> None:
         with self._lock:
-            if len(self._spans) < self.max_spans:
-                self._spans.append(s)
-            else:
+            self._spans.append(s)
+            while len(self._spans) > self.max_spans:
+                # oldest-dropped: a bounded job keeps its RECENT window
+                # (the flight-recorder contract); the count is surfaced in
+                # the export header and FitProfile.spans_dropped
+                self._spans.popleft()
+                self._base += 1
                 self.dropped += 1
+            if s.tid not in self._tid_names:
+                # _record always runs on the thread whose ident stamps the
+                # span (context-manager exit / instant / retroactive
+                # record_span all execute on the recording thread)
+                self._tid_names[s.tid] = threading.current_thread().name
         reg = self.registry
         if reg is not None:
             try:
@@ -251,19 +312,44 @@ class Tracer:
                 pass  # a broken metrics bridge must not kill the step
 
     # -- reading ---------------------------------------------------------------
+    def _window(self, since: int) -> List[Span]:
+        # callers hold self._lock
+        start = max(0, since - self._base)
+        if start <= 0:
+            return list(self._spans)
+        if start >= len(self._spans):
+            return []
+        return list(itertools.islice(self._spans, start, None))
+
     def snapshot(self, since: int = 0) -> List[Span]:
+        """Spans recorded at sequence position >= ``since`` that are still
+        in the ring (a stale ``since`` below the ring floor returns the
+        whole surviving window)."""
         with self._lock:
-            return self._spans[since:] if since else list(self._spans)
+            return self._window(since)
 
     def mark(self) -> int:
-        """Current buffer position — pass to :meth:`profile_for` as
-        ``since`` so a per-job rollup scans only the spans that job
-        recorded, not the whole process history."""
+        """Current buffer position (monotonic sequence number — survives
+        ring wrap-around) — pass to :meth:`profile_for` as ``since`` so a
+        per-job rollup scans only the spans that job recorded, not the
+        whole process history."""
         with self._lock:
-            return len(self._spans)
+            return self._base + len(self._spans)
+
+    def drain(self, since: int) -> Tuple[List[Span], int]:
+        """Atomic ``(snapshot(since), mark())``: the spans at position >=
+        ``since`` plus the position to resume from. The one-lock read is
+        what makes a collector loop exact — a concurrent producer between
+        a separate ``mark()`` and ``snapshot()`` would be delivered twice.
+        Spans are never removed; the returned mark is the cursor."""
+        with self._lock:
+            return self._window(since), self._base + len(self._spans)
 
     def clear(self) -> None:
         with self._lock:
+            # sequence positions stay monotonic: a mark taken before
+            # clear() yields only post-clear spans, never a replay
+            self._base += len(self._spans)
             self._spans.clear()
             self.dropped = 0
 
@@ -272,7 +358,12 @@ class Tracer:
         (or every recorded span when None), starting at buffer position
         ``since`` (a :meth:`mark` taken before the root span opened)."""
         from cycloneml_tpu.observe.profile import FitProfile
-        return FitProfile.from_spans(self.snapshot(since), root_id=root_id)
+        with self._lock:
+            spans = self._window(since)
+            dropped = self.dropped
+        prof = FitProfile.from_spans(spans, root_id=root_id)
+        prof.spans_dropped = dropped
+        return prof
 
     def export_chrome_trace(self, path: str) -> str:
         from cycloneml_tpu.observe.export import export_chrome_trace
@@ -287,11 +378,26 @@ _tracer: Optional[Tracer] = None
 
 
 def enable(max_spans: int = 100_000, registry=None) -> Tracer:
-    """Install (or return the already-installed) process-global tracer."""
+    """Install (or return the already-installed) process-global FULL
+    tracer. An installed flight-recorder ring (``Tracer.full`` False) is
+    UPGRADED: replaced by a fresh full tracer — full tracing supersedes
+    the always-on ring, whose recent window is discarded (it exists to
+    cover the runs that did not pay for this)."""
+    global _tracer
+    with _lock:
+        if _tracer is None or not _tracer.full:
+            _tracer = Tracer(max_spans=max_spans, registry=registry)
+        return _tracer
+
+
+def install_if_absent(tracer: Tracer) -> Tracer:
+    """Install ``tracer`` only when no tracer is active; returns whichever
+    tracer is installed afterwards (observe/flight.py uses this so the
+    ring never displaces a full tracer)."""
     global _tracer
     with _lock:
         if _tracer is None:
-            _tracer = Tracer(max_spans=max_spans, registry=registry)
+            _tracer = tracer
         return _tracer
 
 
@@ -306,6 +412,18 @@ def disable() -> Optional[Tracer]:
 
 def active() -> Optional[Tracer]:
     return _tracer
+
+
+def full_active() -> Optional[Tracer]:
+    """The active tracer ONLY when it is a full one — the gate for sites
+    whose traced path costs real work (XLA cost harvest, budget checks,
+    per-job profile rollups). Under the flight-recorder ring this returns
+    None: flight mode records spans and nothing else, which is what keeps
+    always-on cheap."""
+    t = _tracer
+    if t is None or not t.full:
+        return None
+    return t
 
 
 def span(kind: str, name: str = "", **attrs):

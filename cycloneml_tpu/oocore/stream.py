@@ -29,6 +29,7 @@ from __future__ import annotations
 import queue
 import random
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -110,7 +111,15 @@ class ShardStream:
             self._put((None, exc))
 
     def _stage(self, i: int):
+        from cycloneml_tpu.observe import skew
         from cycloneml_tpu.parallel import faults
+        # per-shard-lane staging time feeds the online straggler detector:
+        # shard i revisits lane shard<i mod N> every epoch, so a lane that
+        # is consistently slow (one bad disk/NIC/host in the staging path)
+        # separates from the group median within a few epochs. The window
+        # covers the WHOLE attempt — the chaos injection point included,
+        # so an injected slow lane is observable skew, as a real one is.
+        t_skew = time.perf_counter()
         faults.inject("oocore.stage", shard=i)
         sds = self._sds
         rt = sds.ctx.mesh_runtime
@@ -133,6 +142,8 @@ class ShardStream:
             sp.annotate(bytes=n_bytes, rows=m)
         self.bytes_staged += n_bytes
         tracing.counter("oocore.bytes_staged", self.bytes_staged)
+        skew.observe("oocore.stage", f"shard{i % skew.OOCORE_SKEW_LANES}",
+                     time.perf_counter() - t_skew)
         return (i, xs, ys, ws)
 
     def _put(self, item) -> bool:

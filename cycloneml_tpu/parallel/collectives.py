@@ -22,7 +22,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence
 
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
-from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.observe import costs, skew, tracing
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -137,21 +137,37 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
         was_first, first[0] = first[0], False
         tr = tracing.active()
         if tr is None:
-            return jitted(*args, **kwargs)
-        if pid_ref[0] is None:
+            # untraced, but an installed skew detector still gets the
+            # step-time sample for the SLO latch (one more global read).
+            # The FIRST dispatch pays trace + XLA compile — seconds, not
+            # a step time — and would fire a spurious SloBreach.
+            if was_first:
+                return jitted(*args, **kwargs)
+            with skew.timed_observe("collectives.step", name):
+                return jitted(*args, **kwargs)
+        # cost harvest + budget guard only under a FULL tracer: the
+        # flight-recorder ring records spans and must stay cheap — no AOT
+        # analyze, no counter tracks (the always-on contract)
+        full = tr.full
+        if full and pid_ref[0] is None:
             # harvest BEFORE the first dispatch and OUTSIDE the spans: the
             # AOT lower+compile feeding cost_analysis must not inflate
             # compile_seconds, and a budgetAction=raise guard must fire
             # before the oversized program ever executes
             pid_ref[0] = costs.ensure(name, key, jitted, args)
             costs.check_budget(pid_ref[0])
-        with tr.span("collective", name, program=pid_ref[0]):
+        with tr.span("collective", name, program=pid_ref[0]) as csp:
             if was_first:
                 with tr.span("compile", name):
                     out = jitted(*args, **kwargs)
             else:
                 out = jitted(*args, **kwargs)
-        costs.note_execution(tr, pid_ref[0])
+        if not was_first:
+            # compile-paying first dispatches are staging, not step time —
+            # they must not trip the SLO latch
+            skew.observe("collectives.step", name, csp.span.duration_s)
+        if full:
+            costs.note_execution(tr, pid_ref[0])
         return out
 
     dispatch.__wrapped__ = jitted

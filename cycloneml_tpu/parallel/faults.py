@@ -195,6 +195,11 @@ class FaultInjector:
         # fired faults become trace annotations: a chaos run's timeline
         # shows each injection inside the span it interrupted
         tracing.instant("fault", point=point, invocation=n, fault=name)
+        # ... and flight-recorder triggers: the always-on ring freezes the
+        # spans PRECEDING the fault (recorded AFTER the instant above, so
+        # the dump contains the injection marker too)
+        from cycloneml_tpu.observe import flight
+        flight.trigger("fault", point=point, invocation=n, fault=name)
         if spec.delay_s:
             time.sleep(spec.delay_s)
         if fault is None:

@@ -112,6 +112,7 @@ class HeartbeatReceiver:
         self.listener_bus = listener_bus
         self._last: Dict[str, float] = {}
         self._lost: Dict[str, str] = {}
+        self._trace_ids: Dict[str, str] = {}
         self._callbacks: List[Callable[[str, str], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -121,6 +122,17 @@ class HeartbeatReceiver:
         with self._lock:
             self._last[worker_id] = time.monotonic()
             self._lost.pop(worker_id, None)  # re-registration revives
+
+    def note_trace(self, worker_id: str, trace_id: str) -> None:
+        """Record the distributed-trace id a worker's extended heartbeat
+        announced — the master-side join between liveness and the
+        telemetry plane (observe/collect.py)."""
+        with self._lock:
+            self._trace_ids[worker_id] = trace_id
+
+    def trace_ids(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._trace_ids)
 
     def heartbeat(self, worker_id: str) -> bool:
         """Returns False if the worker was already expired (it must
@@ -203,6 +215,16 @@ class HeartbeatServer:
       ``REG <worker_id>`` → ``OK``         register / revive
       ``HB <worker_id>``  → ``OK`` | ``EXPIRED``   expired workers must
       re-register, exactly as the reference asks executors to re-register.
+      ``HB <worker_id> <t_send> [trace_id]`` → ``OK <t_server>`` |
+      ``EXPIRED <t_server>``   the EXTENDED ping: ``t_send`` is the
+      sender's wall clock (must parse as a float — anything else is
+      ``ERR``), the reply echoes the server's wall clock, and the sender
+      derives an NTP-style clock-offset sample from the RTT midpoint
+      (observe/collect.py; the trace collector corrects per-host
+      timestamps with the median of these samples). ``trace_id``
+      announces which distributed trace the worker participates in
+      (:meth:`HeartbeatReceiver.trace_ids`). Legacy 2-token pings get the
+      legacy 1-token replies, byte for byte.
     """
 
     def __init__(self, receiver: HeartbeatReceiver, host: str = "127.0.0.1",
@@ -219,16 +241,31 @@ class HeartbeatServer:
                     self.request.settimeout(5.0)
                     line = self.rfile.readline(256).decode("utf-8", "replace")
                     parts = line.split()
-                    if len(parts) != 2:
+                    if len(parts) < 2:
                         self.wfile.write(b"ERR\n")
                         return
-                    cmd, worker = parts
-                    if cmd == "REG":
+                    cmd, worker = parts[0], parts[1]
+                    if cmd == "REG" and len(parts) == 2:
                         recv.register(worker)
                         self.wfile.write(b"OK\n")
-                    elif cmd == "HB":
+                    elif cmd == "HB" and len(parts) == 2:
                         ok = recv.heartbeat(worker)
                         self.wfile.write(b"OK\n" if ok else b"EXPIRED\n")
+                    elif cmd == "HB" and len(parts) in (3, 4):
+                        # extended ping: 3rd token must be the sender's
+                        # wall clock (garbage stays ERR — the legacy
+                        # malformed-line contract)
+                        try:
+                            float(parts[2])
+                        except ValueError:
+                            self.wfile.write(b"ERR\n")
+                            return
+                        if len(parts) == 4:
+                            recv.note_trace(worker, parts[3])
+                        ok = recv.heartbeat(worker)
+                        word = "OK" if ok else "EXPIRED"
+                        self.wfile.write(
+                            f"{word} {time.time():.6f}\n".encode())
                     else:
                         self.wfile.write(b"ERR\n")
                 except OSError:
@@ -287,6 +324,36 @@ class HeartbeatSender:
         check_not_challenge(reply)
         return reply
 
+    def _ping(self) -> str:
+        """One EXTENDED heartbeat round trip: the ping carries this
+        process's wall clock (and its trace id, when tracing is on), the
+        reply carries the server's; the RTT midpoint yields one NTP-style
+        clock-offset sample for the trace collector
+        (``observe/collect.py`` — error bound RTT/2) and the RTT itself
+        feeds the per-worker skew lane."""
+        from cycloneml_tpu.observe import collect, skew, tracing
+        # announce only a FULL tracer's id: the always-on flight ring's
+        # uuid corresponds to no collectable trace and would pollute the
+        # receiver's liveness↔telemetry join with meaningless ids
+        tr = tracing.full_active()
+        trace_suffix = f" {tr.trace_id}" if tr is not None else ""
+        t0 = time.time()
+        reply = self._send(f"HB {self.worker_id} {t0:.6f}{trace_suffix}")
+        t3 = time.time()
+        parts = reply.split()
+        if len(parts) == 2 and parts[0] in ("OK", "EXPIRED"):
+            try:
+                t_server = float(parts[1])
+            except ValueError:
+                pass
+            else:
+                # offset := this clock - server clock, sampled at the RTT
+                # midpoint; |error| <= RTT/2
+                collect.record_offset_sample((t0 + t3) / 2.0 - t_server,
+                                             max(t3 - t0, 0.0))
+        skew.observe("heartbeat.rtt", self.worker_id, max(t3 - t0, 0.0))
+        return parts[0] if parts else reply
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -294,7 +361,7 @@ class HeartbeatSender:
                     if self._send(f"REG {self.worker_id}") == "OK":
                         self._registered = True
                 else:
-                    if self._send(f"HB {self.worker_id}") == "EXPIRED":
+                    if self._ping() == "EXPIRED":
                         self._registered = False  # re-register next tick
                         continue
             except PermissionError:
@@ -421,6 +488,7 @@ class MeshSupervisor:
         self.max_rebuilds = max_rebuilds
         self.rebuilds = 0
         self._lost: Dict[str, str] = {}
+        self._stragglers: Dict[str, dict] = {}
         self._pending: Optional[str] = None
         self._lock = threading.Lock()
 
@@ -429,6 +497,33 @@ class MeshSupervisor:
         loss detection feeding the same recovery path as step errors)."""
         receiver.on_worker_lost(self.note_worker_lost)
         return self
+
+    def attach_skew(self, detector) -> "MeshSupervisor":
+        """Subscribe to an ``observe.skew.SkewDetector``: latched
+        ``StragglerDetected`` verdicts are RECORDED here (``stragglers()``)
+        — the hook the elastic scheduler's mitigation (re-dispatch a slow
+        lane's remaining work, ROADMAP item 4) consumes. Detection never
+        triggers a rebuild by itself: a slow lane is degraded, not lost."""
+        detector.subscribe(self._note_skew)
+        return self
+
+    def _note_skew(self, ev) -> None:
+        from cycloneml_tpu.util.events import StragglerDetected
+        if not isinstance(ev, StragglerDetected):
+            return
+        with self._lock:
+            self._stragglers[f"{ev.group}:{ev.position}"] = {
+                "group": ev.group, "position": ev.position,
+                "observed_s": ev.observed_s, "median_s": ev.median_s,
+            }
+        logger.warning("mesh supervisor: straggler noted at %s:%s "
+                       "(%.4fs vs group median %.4fs)",
+                       ev.group, ev.position, ev.observed_s, ev.median_s)
+
+    def stragglers(self) -> Dict[str, dict]:
+        """Straggler verdicts noted since construction (mitigation input)."""
+        with self._lock:
+            return dict(self._stragglers)
 
     def note_worker_lost(self, worker_id: str, reason: str) -> None:
         """Record a lost worker; the rebuild itself happens on the training
@@ -482,6 +577,12 @@ class MeshSupervisor:
                 f"thrashing")
         self.rebuilds += 1
         master = self._target_master()
+        # freeze the flight-recorder window BEFORE teardown: the ring
+        # holds what the mesh was doing as it degraded — diagnosable
+        # after the fact even when full tracing was never on
+        from cycloneml_tpu.observe import flight
+        flight.trigger("mesh.rebuild", cause=reason or "device loss",
+                       rebuild=self.rebuilds)
         from cycloneml_tpu.parallel.collectives import clear_program_cache
         with tracing.span("rebuild", reason or "device loss",
                           rebuild=self.rebuilds):

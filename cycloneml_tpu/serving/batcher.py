@@ -34,7 +34,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.observe import costs, flight, skew, tracing
 from cycloneml_tpu.serving.buckets import bucket_for, bucket_sizes, pad_rows
 from cycloneml_tpu.serving.servable import GangServable
 from cycloneml_tpu.util.logging import get_logger
@@ -339,6 +339,11 @@ class ModelLane:
                     f"{self.server.shed_after_s * 1e3:.0f} ms"))
             else:
                 keep.append(r)
+        shed_n = len(batch) - len(keep)
+        if shed_n:
+            # a shed burst is a flight-recorder trigger (throttled): the
+            # ring shows what the lanes were doing when admission gave up
+            flight.trigger("serving.shed", model=self.name, shed=shed_n)
         if keep:
             self._requeue_front(keep)
             with self._cv:
@@ -399,6 +404,10 @@ class ModelLane:
                     return
         t_done = time.perf_counter()
         dispatch_s = t_done - t_batch
+        # per-lane dispatch time feeds the straggler detector: one model
+        # whose dispatches run long (cold bucket mix, contended device)
+        # separates from the other lanes' rolling medians
+        skew.observe("serving.dispatch", self.name, dispatch_s)
         if self.is_gang:
             margins = margins[:, :rows, :]     # (K, rows, Km)
         else:

@@ -32,7 +32,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from cycloneml_tpu.parallel.collectives import BoundedProgramCache
-from cycloneml_tpu.serving.batcher import ModelLane, ServingError
+from cycloneml_tpu.serving.batcher import (ModelLane, ServingError,
+                                           ServingOverloaded)
 from cycloneml_tpu.serving.buckets import bucket_sizes
 from cycloneml_tpu.serving.servable import (
     GangServable, Servable, as_servable, linear_margins, serving_dtype,
@@ -200,12 +201,17 @@ class ModelServer:
         try:
             for i in range(0, x2.shape[0], self.max_batch):
                 futures.append(lane.submit(x2[i:i + self.max_batch]))
-        except ServingError:
+        except ServingError as e:
             # shed the whole request as a unit: a sibling chunk that hit
             # backpressure must not leave earlier chunks burning device
             # time on results the caller will never read
             for f in futures:
                 lane.try_cancel(f)
+            if isinstance(e, ServingOverloaded):
+                # backpressure shed: freeze the flight-recorder window
+                # (throttled) so a 503 burst is diagnosable after the fact
+                from cycloneml_tpu.observe import flight
+                flight.trigger("serving.shed", model=name)
             raise
         if timeout is None:
             # worst honest wait: window + shed patience + dispatch slack

@@ -122,6 +122,34 @@ class ServingStatsUpdated(CycloneEvent):
 
 
 @dataclass
+class StragglerDetected(CycloneEvent):
+    """The online skew detector (observe/skew.py) latched a slow lane:
+    ``position``'s rolling median exceeds the group median by both the MAD
+    and the relative threshold. One event per episode (latched); the
+    mitigation consumer is ``MeshSupervisor.attach_skew`` and, later, the
+    elastic scheduler (ROADMAP item 4)."""
+
+    group: str = ""
+    position: str = ""
+    observed_s: float = 0.0
+    median_s: float = 0.0
+    mad_s: float = 0.0
+    n_samples: int = 0
+
+
+@dataclass
+class SloBreach(CycloneEvent):
+    """A step/serving duration exceeded its ``cyclone.telemetry.slo.*``
+    target (latched per lane until a sample recovers); also a
+    flight-recorder dump trigger."""
+
+    group: str = ""
+    position: str = ""
+    observed_s: float = 0.0
+    target_s: float = 0.0
+
+
+@dataclass
 class CheckpointWritten(CycloneEvent):
     path: str = ""
     step: int = 0

@@ -36,6 +36,13 @@ class AppStatusStore:
         # latest ServingStatsUpdated rollup (serving/server.py), {} until
         # a model server posts
         self.serving: Dict[str, Any] = {}
+        # StragglerDetected / SloBreach events (observe/skew.py), newest
+        # last — the /api/v1/skew + web UI surface. Bounded: a lane
+        # oscillating around its SLO target re-arms the latch on every
+        # recovery, and a days-long job must not grow driver memory with
+        # it (the UI renders the tail anyway)
+        self.skew: List[Dict[str, Any]] = []
+        self.max_skew_events = 200
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -69,6 +76,11 @@ class AppStatusStore:
         """The latest model-server rollup, or {} when nothing serves."""
         with self._lock:
             return dict(self.serving)
+
+    def skew_events(self) -> List[Dict[str, Any]]:
+        """Recorded straggler/SLO-breach events, newest last."""
+        with self._lock:
+            return [dict(e) for e in self.skew]
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -152,6 +164,29 @@ class AppStatusListener:
             s.worker_failures.append({"workerId": e.get("worker_id"),
                                       "reason": e.get("reason"),
                                       "time": e.get("time_ms")})
+        elif kind == "StragglerDetected":
+            self._append_skew(s, {"kind": "straggler",
+                                  "group": e.get("group"),
+                                  "position": e.get("position"),
+                                  "observedS": e.get("observed_s"),
+                                  "medianS": e.get("median_s"),
+                                  "madS": e.get("mad_s"),
+                                  "nSamples": e.get("n_samples"),
+                                  "time": e.get("time_ms")})
+        elif kind == "SloBreach":
+            self._append_skew(s, {"kind": "slo-breach",
+                                  "group": e.get("group"),
+                                  "position": e.get("position"),
+                                  "observedS": e.get("observed_s"),
+                                  "targetS": e.get("target_s"),
+                                  "time": e.get("time_ms")})
+
+    @staticmethod
+    def _append_skew(s: AppStatusStore, row: Dict[str, Any]) -> None:
+        with s._lock:
+            s.skew.append(row)
+            while len(s.skew) > s.max_skew_events:
+                s.skew.pop(0)
 
 
 class HistoryProvider:
@@ -188,7 +223,7 @@ def api_v1(store: AppStatusStore, route: str,
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
-    'memory/warnings', 'serving'."""
+    'memory/warnings', 'serving', 'skew'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -207,4 +242,6 @@ def api_v1(store: AppStatusStore, route: str,
         return list(store.memory_warnings)
     if route == "serving":
         return store.serving_stats()
+    if route == "skew":
+        return store.skew_events()
     raise KeyError(f"unknown route {route!r}")

@@ -34,6 +34,7 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>Cyclone <span id="app" class="muted"></span></h1>
 <h2>Jobs</h2><div id="jobs" class="muted">loading…</div>
+<h2>Skew / stragglers</h2><div id="skew" class="muted">none</div>
 <h2>Serving</h2><div id="serving" class="muted">none</div>
 <h2>Storage</h2><div id="storage" class="muted">none</div>
 <h2>Checkpoints</h2><div id="ckpts" class="muted">none</div>
@@ -79,6 +80,10 @@ async function refresh() {
     }
   }
   document.getElementById('jobs').innerHTML = html;
+  const skew = await j('skew');
+  if (skew.length) document.getElementById('skew').innerHTML =
+    table(skew.slice(-20), ['kind', 'group', 'position', 'observedS',
+                            'medianS', 'targetS', 'time']);
   const srv = await j('serving');
   if (srv && srv.models && Object.keys(srv.models).length) {
     const rows = Object.entries(srv.models).map(([k, v]) =>

@@ -151,10 +151,107 @@ def main() -> int:
               "profile counts agree with the model summary, cost rollup "
               "present (FLOPs + memory fields or explicit unavailable "
               "markers)")
+
+        # -- distributed telemetry: merged 2-process trace --------------
+        # a child process runs its own traced fit and ships spans back to
+        # a collector here; the merged export must validate and hold BOTH
+        # process lanes (the ISSUE-12 obs-demo acceptance)
+        rc = _merged_trace_demo(work)
+        if rc != 0:
+            return rc
         return 0
     finally:
         ctx.stop()
         tracing.disable()
+
+
+_CHILD = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cycloneml_tpu.conf import CycloneConf
+from cycloneml_tpu.context import CycloneContext
+from cycloneml_tpu.dataset.frame import MLFrame
+from cycloneml_tpu.ml.classification import LogisticRegression
+
+# collector address + trace context arrive via the environment (the same
+# channel the deploy harness injects for launched apps)
+conf = (CycloneConf().set("cyclone.master", "local-mesh[2]")
+        .set("cyclone.worker.id", "demo-worker")
+        .set("cyclone.telemetry.collect.intervalMs", "100"))
+ctx = CycloneContext(conf)
+rng = np.random.RandomState(1)
+x = rng.randn(96, 4)
+y = (x @ rng.randn(4) > 0).astype(float)
+LogisticRegression(maxIter=3, regParam=0.01, tol=0.0).fit(
+    MLFrame(ctx, {"features": x, "label": y}))
+ctx.stop()   # flushes the span shipper
+"""
+
+
+def _merged_trace_demo(work: str) -> int:
+    import subprocess
+    import time
+
+    from cycloneml_tpu.observe import (process_lanes, tracing,
+                                       validate_chrome_trace)
+    from cycloneml_tpu.observe.collect import TraceCollector
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tracer = tracing.active()
+    col = TraceCollector(host_label="demo-master", tracer=tracer)
+    child_py = os.path.join(work, "child_fit.py")
+    with open(child_py, "w", encoding="utf-8") as fh:
+        fh.write(_CHILD)
+    try:
+        span = tracer.span("deploy", "submit child_fit.py")
+        with span:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            env.update(col.launch_env(parent_span_id=span.span_id))
+            r = subprocess.run(
+                [sys.executable, child_py], env=env, timeout=240,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if r.returncode != 0:
+            print("FAIL: child fit process failed:\n"
+                  + r.stdout.decode()[-2000:], file=sys.stderr)
+            return 1
+        deadline = time.time() + 30
+        while not any(rec["spans"] for rec in col.hosts().values()):
+            if time.time() > deadline:
+                print("FAIL: no span batches arrived from the child",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+        merged_path = os.path.join(work, "merged.trace.json")
+        col.export(merged_path)
+        errors = validate_chrome_trace(merged_path)
+        if errors:
+            print("FAIL: merged trace schema violations:", file=sys.stderr)
+            for e in errors[:20]:
+                print(f"  - {e}", file=sys.stderr)
+            return 1
+        lanes = process_lanes(merged_path)
+        if len(lanes) < 2:
+            print(f"FAIL: merged trace has {len(lanes)} process lane(s), "
+                  f"need >= 2: {lanes}", file=sys.stderr)
+            return 1
+        hosts = col.hosts()
+        child = hosts.get("demo-worker", {})
+        if child.get("trace_id") != tracer.trace_id:
+            print(f"FAIL: child trace_id {child.get('trace_id')!r} != "
+                  f"master {tracer.trace_id!r}", file=sys.stderr)
+            return 1
+        print(f"merged trace: {merged_path}")
+        print(f"process lanes: { {k: v for k, v in sorted(lanes.items())} }")
+        print("OK: merged 2-process trace validates, >=2 labeled process "
+              "lanes, one shared trace id")
+        return 0
+    finally:
+        col.stop()
 
 
 if __name__ == "__main__":

@@ -458,3 +458,145 @@ def test_fit_tracked_in_status_store(ctx):
     assert vals["steps.completed"] >= len(steps)
     assert vals["jobs.succeeded"] >= 1
     assert vals["mesh.devices"] == 8
+
+
+# -- the 2-process deploy-harness acceptance (ISSUE 12 tentpole) -----------------
+
+import textwrap  # noqa: E402  (section-local: the telemetry acceptance)
+
+from cycloneml_tpu.observe import (process_lanes, tracing,  # noqa: E402
+                                   validate_chrome_trace)
+from cycloneml_tpu.observe.collect import (TraceCollector,  # noqa: E402
+                                           clear_offset_samples)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_APP = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+
+    pid = os.environ.get("CYCLONE_PROC_ID", "0")
+    # the telemetry plane needs no jax.distributed: each proc runs its own
+    # local mesh; trace context + collector address + heartbeat target all
+    # arrive through the deploy launch env
+    conf = (CycloneConf().set("cyclone.master", "local-mesh[2]")
+            .set("cyclone.worker.id", f"proc{pid}")
+            .set("cyclone.telemetry.collect.intervalMs", "100"))
+    ctx = CycloneContext(conf)
+    rng = np.random.RandomState(int(pid))
+    x = rng.randn(96, 4)
+    y = (x @ rng.randn(4) > 0).astype(float)
+    LogisticRegression(maxIter=3, regParam=0.01, tol=0.0).fit(
+        MLFrame(ctx, {"features": x, "label": y}))
+    ctx.stop()   # flushes the span shipper
+    print(f"proc {pid} done", flush=True)
+""")
+
+
+def test_deploy_two_process_merged_trace(tmp_path):
+    """THE acceptance: a 2-process deploy-harness run produces ONE merged
+    Chrome trace that validates, holds span lanes from both processes
+    (plus the master), correlates the master-side submit span to
+    worker-side spans by trace id + parent link, and keeps per-lane
+    timestamps monotonic after clock-offset correction."""
+    from cycloneml_tpu.deploy import (MasterDaemon, WorkerDaemon, submit_app,
+                                      wait_for_app)
+    from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                                   HeartbeatServer)
+
+    tracing.disable()
+    tracer = tracing.enable(max_spans=50_000)
+    recv = HeartbeatReceiver(timeout_s=60.0, check_interval_s=5.0)
+    hb = HeartbeatServer(recv)
+    col = TraceCollector(host_label="master", tracer=tracer)
+    master = MasterDaemon(port=0, state_path=str(tmp_path / "master.json"))
+    workers = [WorkerDaemon(master.address, worker_id=f"w{i}")
+               for i in range(2)]
+    app_py = tmp_path / "traced_app.py"
+    app_py.write_text(_APP)
+    env = {
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # extended heartbeats at 100 ms feed the clock-offset estimate
+        "CYCLONE_CONF_cyclone__driver__heartbeatAddress": hb.address,
+        "CYCLONE_CONF_cyclone__executor__heartbeatInterval": "100",
+    }
+    try:
+        app_id = submit_app(master.address, str(app_py), n_procs=2, env=env)
+        assert wait_for_app(master.address, app_id,
+                            timeout_s=240) == "FINISHED"
+        # both workers' final flushes may trail the FINISHED report
+        deadline = time.time() + 30
+        while True:
+            hosts = col.hosts()
+            got = {h for h, rec in hosts.items() if rec["spans"]}
+            if {"proc0", "proc1"} <= got:
+                break
+            assert time.time() < deadline, f"hosts seen: {hosts}"
+            time.sleep(0.2)
+
+        # every process joined ONE distributed trace
+        hosts = col.hosts()
+        assert {hosts["proc0"]["trace_id"],
+                hosts["proc1"]["trace_id"]} == {tracer.trace_id}
+        # heartbeat-fed clock offsets exist, with their error bound
+        for h in ("proc0", "proc1"):
+            assert hosts[h]["offset_err_s"] is not None, \
+                f"{h} merged without offset samples"
+
+        path = str(tmp_path / "merged.trace.json")
+        col.export(path)
+        assert validate_chrome_trace(path) == []
+        obj = json.load(open(path))
+        lanes = process_lanes(obj)
+        assert len(lanes) >= 3  # master + proc0 + proc1, labeled
+        labels = " ".join(lanes.values())
+        assert "proc0" in labels and "proc1" in labels
+
+        # correlation: the master-submitted step's span id parents the
+        # worker-side root (job) spans, whose subtrees hold the dispatches
+        xevents = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+        submits = [e for e in xevents if e.get("cat") == "deploy"]
+        assert submits, "no master-side submit span in the merged trace"
+        submit_id = submits[0]["args"]["span_id"]
+        assert submit_id.startswith("master/")
+        worker_pids = [p for p, label in lanes.items()
+                       if "proc0" in label or "proc1" in label]
+        for wpid in worker_pids:
+            jobs = [e for e in xevents if e["pid"] == wpid
+                    and e.get("cat") == "job"
+                    and e["args"].get("parent_id") == submit_id]
+            assert jobs, f"lane {lanes[wpid]} has no job span parented " \
+                         f"to the submit span"
+            # and that job has worker-side dispatch spans under it
+            jid = jobs[0]["args"]["span_id"]
+            children = [e for e in xevents if e["pid"] == wpid
+                        and e["args"].get("parent_id") == jid]
+            assert children, f"job span {jid} has no children"
+
+        # per-lane monotonic close times after clock-offset correction
+        # (record order IS close order per thread; the correction is a
+        # constant per host, so order must survive)
+        by_lane = {}
+        for e in xevents:
+            by_lane.setdefault((e["pid"], e["tid"]), []).append(
+                e["ts"] + e["dur"])
+        for lane, ends in by_lane.items():
+            assert ends == sorted(ends), f"lane {lane} not monotonic"
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        col.stop()
+        hb.stop()
+        recv.stop()
+        tracing.disable()
+        clear_offset_samples()

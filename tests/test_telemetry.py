@@ -1,0 +1,472 @@
+"""Distributed telemetry plane (ISSUE 12): cross-process trace
+aggregation, the always-on flight recorder, and straggler/skew detection.
+
+The headline 2-process deploy-harness acceptance
+(test_deploy_two_process_merged_trace) lives in test_observability.py —
+this file holds the telemetry plane's unit and in-process integration
+surface: clock-offset estimation, shipper/collector round trips, the
+flight recorder's ring/dump/throttle contracts, and the skew detector's
+latched verdicts at the real oocore site under seeded chaos.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.observe import (flight, process_lanes, skew, tracing,
+                                   validate_chrome_trace)
+from cycloneml_tpu.observe.collect import (SpanShipper, TraceCollector,
+                                           clear_offset_samples,
+                                           estimate_offset, offset_samples)
+from cycloneml_tpu.observe.skew import SkewDetector
+from cycloneml_tpu.util.events import SloBreach, StragglerDetected
+
+
+# -- clock-offset estimation -----------------------------------------------------
+
+def test_estimate_offset_prefers_low_rtt_samples():
+    """The median over the lowest-RTT samples rejects the asymmetric-delay
+    outlier a loaded fabric produces; the bound is the worst used RTT/2."""
+    samples = [(0.50, 0.0010), (0.52, 0.0020), (0.48, 0.0015),
+               (0.51, 0.0012), (0.49, 0.0011),
+               (5.00, 0.5000)]   # one congested round trip
+    off, err = estimate_offset(samples)
+    assert abs(off - 0.50) < 0.02
+    assert err is not None and err <= 0.0010  # outlier excluded entirely
+    assert estimate_offset([]) == (0.0, None)
+
+
+def test_merged_trace_corrects_offsets_and_qualifies_ids():
+    """Two hosts with a known 10 s clock skew merge onto one timeline:
+    per-host lanes labeled, span ids host-qualified, remote parent ids
+    passed through, timestamps corrected by the per-host offset."""
+    from cycloneml_tpu.observe.export import merged_chrome_trace
+    t = 1_000_000.0
+    records = [
+        {"host": "master", "pid": 11, "offset_s": 0.0, "trace_id": "T",
+         "dropped": 0, "tid_names": {1: "main"},
+         "spans": [{"id": "s1", "parent": "", "kind": "deploy",
+                    "name": "submit", "t0": t, "t1": t + 2.0, "tid": 1,
+                    "attrs": {}}]},
+        {"host": "w0", "pid": 12, "offset_s": 10.0, "trace_id": "T",
+         "dropped": 3, "tid_names": {7: "MainThread"},
+         "spans": [{"id": "s1", "parent": "master/s1", "kind": "job",
+                    "name": "fit", "t0": t + 10.5, "t1": t + 11.5,
+                    "tid": 7, "attrs": {}}]},
+    ]
+    obj = merged_chrome_trace(records)
+    assert validate_chrome_trace(obj) == []
+    lanes = process_lanes(obj)
+    assert len(lanes) == 2 and any("w0" in v for v in lanes.values())
+    evs = {e["args"]["span_id"]: e for e in obj["traceEvents"]
+           if e.get("ph") == "X"}
+    # ids are host-qualified; the remote parent survives unmangled
+    assert set(evs) == {"master/s1", "w0/s1"}
+    assert evs["w0/s1"]["args"]["parent_id"] == "master/s1"
+    # the worker's 10 s skew is corrected out: its span lands INSIDE the
+    # master span's window on the merged timeline
+    sub, job = evs["master/s1"], evs["w0/s1"]
+    assert sub["ts"] <= job["ts"] <= sub["ts"] + sub["dur"]
+    assert obj["otherData"]["trace_id"] == "T"
+    assert obj["otherData"]["spans_dropped"] == 3
+
+
+# -- shipper/collector round trip ------------------------------------------------
+
+def test_shipper_collector_roundtrip(tmp_path):
+    """A worker-side tracer drains through the shipper into the collector;
+    the merged export validates and carries the worker's spans under its
+    own labeled lane."""
+    tr = tracing.Tracer(max_spans=1000)
+    with tr.span("job", "worker-fit"):
+        with tr.span("dispatch", "loss.eval", evals=2):
+            pass
+    col = TraceCollector(host_label="primary", tracer=tr)  # local lane too
+    ship = None
+    try:
+        ship = SpanShipper(col.address, "w0", interval_s=0.05, tracer=tr)
+        deadline = time.time() + 10
+        while not col.hosts().get("w0", {}).get("spans"):
+            assert time.time() < deadline, "no batch arrived"
+            time.sleep(0.05)
+        # spans recorded AFTER the first drain ship too (cursor semantics)
+        with tr.span("dispatch", "late", evals=1):
+            pass
+        ship.stop(flush=True)
+        assert ship.shipped >= 3 and ship.dropped == 0
+        path = str(tmp_path / "merged.trace.json")
+        col.export(path)
+        assert validate_chrome_trace(path) == []
+        obj = json.load(open(path))
+        lanes = process_lanes(obj)
+        assert len(lanes) == 2  # primary (local tracer) + w0
+        names = {e["name"] for e in obj["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert {"worker-fit", "loss.eval", "late"} <= names
+    finally:
+        if ship is not None:
+            ship.stop(flush=False)
+        col.stop()
+
+
+def test_shipper_buffers_and_drops_bounded_when_collector_away():
+    """Drop-counted bounded buffering: with no collector listening the
+    shipper retains at most max_buffer wire spans and counts the rest."""
+    tr = tracing.Tracer(max_spans=10_000)
+    ship = SpanShipper("127.0.0.1:9", "w0", interval_s=0.02,
+                       max_batch=8, max_buffer=16, tracer=tr)
+    try:
+        for i in range(100):
+            tr.instant("x", i=i)
+        deadline = time.time() + 10
+        while ship.dropped == 0:
+            assert time.time() < deadline, "no drops counted"
+            time.sleep(0.02)
+    finally:
+        ship.stop(flush=False)
+    assert ship.shipped == 0
+    assert ship.dropped >= 100 - 16
+
+
+# -- heartbeat-fed clock offset --------------------------------------------------
+
+def test_extended_heartbeat_feeds_offset_samples_and_trace_id():
+    """The extended ping round trip yields NTP-style offset samples (same
+    machine -> offset ~ 0 within the RTT bound) and announces the sender's
+    trace id to the receiver."""
+    from cycloneml_tpu.parallel.resilience import (HeartbeatReceiver,
+                                                   HeartbeatSender,
+                                                   HeartbeatServer)
+    tracing.disable()
+    tr = tracing.enable(max_spans=1000)
+    clear_offset_samples()
+    recv = HeartbeatReceiver(timeout_s=30.0, check_interval_s=5.0)
+    server = HeartbeatServer(recv)
+    sender = HeartbeatSender("wskew", server.address, interval_s=0.05)
+    try:
+        deadline = time.time() + 10
+        while not offset_samples() or "wskew" not in recv.trace_ids():
+            assert time.time() < deadline, "no extended-ping evidence"
+            time.sleep(0.05)
+        assert recv.trace_ids()["wskew"] == tr.trace_id
+        off, err = estimate_offset(offset_samples())
+        assert err is not None
+        assert abs(off) <= max(err, 0.05)  # one clock: offset ~ 0
+    finally:
+        sender.stop()
+        server.stop()
+        recv.stop()
+        tracing.disable()
+        clear_offset_samples()
+
+
+# -- flight recorder -------------------------------------------------------------
+
+def _collective_prog(ctx):
+    import jax.numpy as jnp
+    from cycloneml_tpu.parallel.collectives import tree_aggregate
+
+    rt = ctx.mesh_runtime
+    data = rt.device_put_sharded_rows(np.ones((64, 2), dtype=np.float64))
+    return tree_aggregate(lambda x: {"s": jnp.sum(x)}, rt, data), data
+
+
+def test_flight_recorder_dumps_ring_on_fault(ctx, tmp_path):
+    """Acceptance: with full tracing DISABLED, an injected fault at an
+    existing faults.py point dumps the ring — the spans PRECEDING the
+    fault plus the injection marker — as a valid Chrome trace."""
+    from cycloneml_tpu.parallel.faults import (FaultInjector, FaultSchedule,
+                                               TransientCollectiveError)
+
+    tracing.disable()
+    flight.reset()
+    rec = flight.enable(ring_spans=64)
+    flight.configure(dump_dir=str(tmp_path), min_interval_s=0.0)
+    try:
+        assert tracing.active() is rec and not rec.full
+        prog, data = _collective_prog(ctx)
+        for _ in range(6):   # the history the dump must preserve
+            prog(data)
+        sched = FaultSchedule(seed=0)
+        sched.at("collectives.step", 1,
+                 TransientCollectiveError("injected flake"))
+        with FaultInjector(sched):
+            with pytest.raises(TransientCollectiveError):
+                prog(data)
+        dumps = flight.dumps()
+        assert len(dumps) == 1 and dumps[0]["reason"] == "fault"
+        path = dumps[0]["path"]
+        assert path and os.path.exists(path)
+        assert validate_chrome_trace(path) == []
+        obj = json.load(open(path))
+        assert obj["otherData"]["flight_reason"] == "fault"
+        kinds = {}
+        for e in obj["traceEvents"]:
+            if e.get("ph") != "M":
+                kinds[e.get("cat")] = kinds.get(e.get("cat"), 0) + 1
+        # >= 6 preceding collective dispatches + the fault instant
+        assert kinds.get("collective", 0) >= 6
+        faults_in_dump = [e for e in obj["traceEvents"]
+                          if e.get("cat") == "instant"
+                          and e.get("name") == "fault"]
+        assert len(faults_in_dump) == 1
+    finally:
+        flight.disable()
+        flight.configure(dump_dir=None, min_interval_s=1.0)
+        flight.reset()
+
+
+def test_flight_only_mode_pays_no_cost_analysis(ctx):
+    """The always-on-is-cheap contract: under the flight ring (full
+    tracing off) no XLA cost analysis runs, the budget guard stays
+    unarmed, and per-job profile rollups do not post."""
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import costs
+
+    tracing.disable()
+    flight.enable(ring_spans=256)
+    try:
+        before_analyze = costs.analyze_call_count()
+        before_profiles = len(ctx.status_store.profiles)
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 6)
+        y = (x @ rng.randn(6) > 0).astype(float)
+        LogisticRegression(maxIter=4, regParam=0.01, tol=0.0).fit(
+            MLFrame(ctx, {"features": x, "label": y}))
+        ctx.listener_bus.wait_until_empty()
+        assert costs.analyze_call_count() == before_analyze
+        assert len(ctx.status_store.profiles) == before_profiles
+        assert not costs.guard_armed(CycloneConf())
+        # ...but the ring DID record the fit's spans
+        tr = tracing.active()
+        assert tr is not None and not tr.full
+        kinds = {s.kind for s in tr.snapshot()}
+        assert kinds & {"collective", "dispatch"}, kinds
+    finally:
+        flight.disable()
+
+
+def test_tracing_enable_upgrades_flight_ring():
+    tracing.disable()
+    flight.enable(ring_spans=64)
+    ring = tracing.active()
+    assert ring is not None and not ring.full
+    t = tracing.enable(max_spans=1000)
+    try:
+        assert t.full and tracing.active() is t and t is not ring
+        assert flight.active() is None  # the ring lost to full tracing
+        # flight.disable must NOT remove a full tracer
+        flight.disable()
+        assert tracing.active() is t
+    finally:
+        tracing.disable()
+
+
+def test_flight_trigger_throttle():
+    tracing.disable()
+    flight.reset()
+    flight.enable(ring_spans=64)
+    flight.configure(dump_dir=None, min_interval_s=60.0)
+    try:
+        tracing.instant("x")
+        assert flight.trigger("serving.shed") is not None
+        assert flight.trigger("serving.shed") is None  # throttled
+        assert flight.trigger_count() == 2              # ...but counted
+    finally:
+        flight.disable()
+        flight.configure(min_interval_s=1.0)
+        flight.reset()
+
+
+# -- skew detector units ---------------------------------------------------------
+
+def test_skew_detector_latches_slow_lane_once():
+    det = SkewDetector(window=16, min_samples=4, mad_factor=4.0,
+                       rel_factor=1.5)
+    events = []
+    det.subscribe(events.append)
+    for i in range(8):
+        for lane in ("a", "b", "c"):
+            det.observe("serving.dispatch", lane, 0.010 + 0.0001 * i)
+        det.observe("serving.dispatch", "d", 0.050)
+    stragglers = [e for e in events if isinstance(e, StragglerDetected)]
+    assert len(stragglers) == 1           # latched: ONE event per episode
+    assert stragglers[0].position == "d"
+    assert stragglers[0].group == "serving.dispatch"
+    assert ("serving.dispatch", "d") in det.stragglers()
+    # recovery unlatches (a later relapse may fire again)
+    for _ in range(16):
+        det.observe("serving.dispatch", "d", 0.010)
+    assert det.stragglers() == []
+
+
+def test_skew_detector_balanced_run_stays_silent():
+    """False-positive guard: jittered-but-balanced lanes never convict."""
+    det = SkewDetector(window=16, min_samples=4, mad_factor=4.0,
+                       rel_factor=1.5)
+    events = []
+    det.subscribe(events.append)
+    rng = np.random.RandomState(7)
+    for _ in range(40):
+        for lane in ("a", "b", "c", "d"):
+            det.observe("oocore.stage", lane,
+                        0.010 * (1.0 + 0.2 * rng.rand()))
+    assert events == [] and det.stragglers() == []
+
+
+def test_skew_slo_breach_latches_and_rearms():
+    det = SkewDetector(slo_s={"collectives.step": 0.010})
+    events = []
+    det.subscribe(events.append)
+    det.observe("collectives.step", "prog", 0.020)
+    det.observe("collectives.step", "prog", 0.020)   # latched: no refire
+    assert len(events) == 1 and isinstance(events[0], SloBreach)
+    assert events[0].target_s == pytest.approx(0.010)
+    det.observe("collectives.step", "prog", 0.005)   # recovery re-arms
+    det.observe("collectives.step", "prog", 0.020)
+    assert len(events) == 2
+
+
+def test_skew_slo_only_groups_never_convict_stragglers():
+    """collectives.step positions are different PROGRAMS — comparing
+    their times cross-lane is meaningless, so the group is SLO-only."""
+    det = SkewDetector(window=8, min_samples=2)
+    events = []
+    det.subscribe(events.append)
+    for _ in range(8):
+        det.observe("collectives.step", "fast_prog", 0.001)
+        det.observe("collectives.step", "slow_prog", 1.000)
+    assert not any(isinstance(e, StragglerDetected) for e in events)
+
+
+def test_skew_detector_bounds_positions():
+    det = SkewDetector(window=8, min_samples=2)
+    for i in range(600):
+        det.observe("serving.dispatch", f"lane{i}", 0.01)
+    assert len(det._samples["serving.dispatch"]) <= 256
+
+
+# -- chaos-injected slow lane (the acceptance path) ------------------------------
+
+def _streaming_fixture(ctx, n=96, d=4, shard_rows=16):
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.oocore.shards import StreamingDataset
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, d)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    return StreamingDataset.from_dataset(ds, shard_rows=shard_rows)
+
+
+def test_oocore_chaos_slow_lane_raises_one_straggler(ctx, tmp_path):
+    """Acceptance: a seeded chaos-delayed shard lane (every epoch's visit
+    to shard 2 is slowed) raises EXACTLY ONE StragglerDetected with the
+    correct position, visible via /api/v1/skew, status-store journal
+    replay, and the web UI."""
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore.objective import StreamingLossFunction
+    from cycloneml_tpu.parallel.faults import FaultInjector, FaultSchedule
+    from cycloneml_tpu.util.events import EventJournal
+    from cycloneml_tpu.util.status import AppStatusListener, api_v1
+    from cycloneml_tpu.util.webui import StatusWebUI
+
+    det = SkewDetector(bus=ctx.listener_bus, window=32, min_samples=4,
+                       mad_factor=4.0, rel_factor=1.5)
+    prev = skew.install(det)
+    journal_path = str(tmp_path / "events.jsonl")
+    journal = EventJournal(journal_path)
+    ctx.listener_bus.add_listener(journal)
+    sds = _streaming_fixture(ctx)
+    try:
+        n_shards = sds.n_shards
+        assert n_shards == 6
+        loss = StreamingLossFunction(
+            sds, aggregators.binary_logistic(4, fit_intercept=False))
+        epochs = 10
+        # the staging thread walks shards in order, so oocore.stage
+        # invocation i is shard (i-1) % n_shards — delaying invocations
+        # 3, 9, 15, ... slows EXACTLY the shard-2 lane, every epoch
+        sched = FaultSchedule(seed=0)
+        sched.at("oocore.stage",
+                 range(3, epochs * n_shards + 1, n_shards), None,
+                 delay_s=0.03)
+        with FaultInjector(sched) as inj:
+            for _ in range(epochs):
+                loss(np.zeros(4))
+        assert len(inj.log) == epochs  # the delay fired every epoch
+        ctx.listener_bus.wait_until_empty()
+
+        events = [e for e in ctx.status_store.skew_events()
+                  if e["group"] == "oocore.stage"]
+        stragglers = [e for e in events if e["kind"] == "straggler"]
+        assert len(stragglers) == 1, f"expected one event, got {events}"
+        assert stragglers[0]["position"] == "shard2"
+        assert stragglers[0]["observedS"] > stragglers[0]["medianS"]
+        # the REST route serves the same rows
+        assert api_v1(ctx.status_store, "skew") == \
+            ctx.status_store.skew_events()
+        # journal replay rebuilds the verdict (history-server path)
+        replayed = AppStatusListener()
+        for e in EventJournal.replay(journal_path):
+            replayed.on_event(e)
+        rep = [e for e in replayed.store.skew_events()
+               if e["kind"] == "straggler" and e["group"] == "oocore.stage"]
+        assert len(rep) == 1 and rep[0]["position"] == "shard2"
+        # the web UI serves the table data and the page section
+        ui = StatusWebUI(ctx.status_store)
+        try:
+            rows = json.loads(urllib.request.urlopen(
+                f"{ui.url}api/v1/skew", timeout=5).read())
+            assert any(r.get("position") == "shard2" for r in rows)
+            page = urllib.request.urlopen(ui.url, timeout=5).read().decode()
+            assert 'id="skew"' in page
+        finally:
+            ui.stop()
+        # the MeshSupervisor subscription hook received the verdict
+        det2 = SkewDetector(window=32, min_samples=4)
+        sup = ctx.mesh_supervisor()
+        sup.attach_skew(det2)
+        for i in range(8):
+            for lane in ("a", "b"):
+                det2.observe("oocore.stage", lane, 0.001)
+            det2.observe("oocore.stage", "c", 0.050)
+        assert "oocore.stage:c" in sup.stragglers()
+    finally:
+        ctx.listener_bus.remove_listener(journal)
+        journal.close()
+        sds.close()
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)
+
+
+def test_oocore_balanced_run_raises_no_straggler(ctx):
+    """The false-positive guard at the REAL site: a balanced streamed run
+    (no chaos) must keep the detector silent."""
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore.objective import StreamingLossFunction
+
+    det = SkewDetector(bus=None, window=32, min_samples=4,
+                       mad_factor=4.0, rel_factor=1.5)
+    prev = skew.install(det)
+    sds = _streaming_fixture(ctx)
+    try:
+        loss = StreamingLossFunction(
+            sds, aggregators.binary_logistic(4, fit_intercept=False))
+        for _ in range(10):
+            loss(np.zeros(4))
+        assert det.stragglers() == []
+        assert not any(isinstance(e, StragglerDetected)
+                       for e in det.events())
+    finally:
+        sds.close()
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)

@@ -91,6 +91,107 @@ def test_span_buffer_bound():
     assert len(t.snapshot()) == 3 and t.dropped == 2
 
 
+def test_span_ring_drops_oldest_and_keeps_monotonic_marks():
+    """Overflow semantics pin (ISSUE 12 satellite): the ring drops the
+    OLDEST spans, counts them, and sequence positions survive the wrap —
+    a mark taken before the wrap still reads exactly the survivors past
+    it, never a replay and never a skip."""
+    t = tracing.Tracer(max_spans=4)
+    for i in range(4):
+        t.instant("x", i=i)
+    mark = t.mark()
+    assert mark == 4
+    for i in range(4, 10):
+        t.instant("x", i=i)
+    # the RECENT window survives; the oldest 6 were dropped and counted
+    assert [s.attrs["i"] for s in t.snapshot()] == [6, 7, 8, 9]
+    assert t.dropped == 6
+    assert t.mark() == 10
+    # the pre-wrap mark: positions 4..5 fell off the ring floor, so the
+    # read returns the SURVIVING suffix (6..9), not a stale replay
+    assert [s.attrs["i"] for s in t.snapshot(since=mark)] == [6, 7, 8, 9]
+    assert [s.attrs["i"] for s in t.snapshot(since=8)] == [8, 9]
+    assert t.snapshot(since=10) == []
+    # clear keeps positions monotonic: an old cursor yields only new spans
+    t.clear()
+    assert t.dropped == 0
+    t.instant("x", i=99)
+    assert [s.attrs["i"] for s in t.snapshot(since=mark)] == [99]
+
+
+def test_concurrent_producers_and_drain_lose_and_duplicate_nothing():
+    """ISSUE 12 satellite: N threads record while a collector drains via
+    the atomic ``drain(since)`` cursor — every span delivered exactly
+    once. (A separate mark()-then-snapshot() pair would double-deliver
+    spans recorded between the two calls.)"""
+    t = tracing.Tracer(max_spans=100_000)
+    n_threads, per_thread = 4, 500
+    done = threading.Event()
+    collected = []
+
+    def producer(k):
+        for i in range(per_thread):
+            t.instant("p", k=k, i=i)
+
+    def collector():
+        since = 0
+        while True:
+            spans, since = t.drain(since)
+            collected.extend(spans)
+            if done.is_set():
+                spans, since = t.drain(since)  # final sweep
+                collected.extend(spans)
+                return
+
+    col = threading.Thread(target=collector)
+    col.start()
+    producers = [threading.Thread(target=producer, args=(k,))
+                 for k in range(n_threads)]
+    for p in producers:
+        p.start()
+    for p in producers:
+        p.join()
+    done.set()
+    col.join()
+    keys = [(s.attrs["k"], s.attrs["i"]) for s in collected]
+    assert len(keys) == n_threads * per_thread      # none lost...
+    assert len(set(keys)) == len(keys)              # ...none double-shipped
+    assert t.dropped == 0
+
+
+def test_export_emits_process_and_thread_metadata(tracer, tmp_path):
+    """ISSUE 12 satellite: the Chrome export labels lanes with M-phase
+    process_name/thread_name events (Perfetto shows names, not bare
+    pids/tids) and the validator accepts them."""
+    with tracer.span("job", "fit"):
+        pass
+    obj = chrome_trace(tracer)
+    assert validate_chrome_trace(obj) == []
+    meta = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+    names = {e["name"] for e in meta}
+    assert names == {"process_name", "thread_name"}
+    threads = [e for e in meta if e["name"] == "thread_name"]
+    assert any(e["args"]["name"] == threading.current_thread().name
+               for e in threads)
+    # validator rejects a malformed metadata event
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "process_name", "ph": "M", "pid": 1,
+                          "args": {}}]})
+
+
+def test_export_header_and_profile_carry_spans_dropped(tmp_path):
+    t = tracing.Tracer(max_spans=2)
+    for i in range(5):
+        t.instant("x", i=i)
+    obj = chrome_trace(t)
+    assert obj["otherData"]["spans_dropped"] == 3
+    assert obj["otherData"]["trace_id"] == t.trace_id
+    prof = t.profile_for(None)
+    assert prof.spans_dropped == 3
+    # the count survives the dict round trip (status store / journal)
+    assert FitProfile.from_dict(prof.to_dict()).spans_dropped == 3
+
+
 def test_threads_get_independent_context(tracer):
     seen = {}
 
