@@ -2,7 +2,7 @@
 # also enforced by tests/test_graftlint.py) and `make test`.
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
-	bench bench-bytes bench-oocore serve-demo
+	bench bench-bytes bench-oocore serve-demo multihost
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -41,6 +41,15 @@ test:
 
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+	    -p no:cacheprovider
+
+# the 2-process deploy/multihost harness standalone: real Master/Worker
+# daemons, real jax.distributed rendezvous, the kill-a-worker recovery
+# loop. Hard timeout: a wedged cross-process rendezvous must kill the
+# run loudly, never hang CI.
+multihost:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+	    tests/test_multihost.py tests/test_deploy.py -q \
 	    -p no:cacheprovider
 
 # small traced fit -> exported Chrome trace -> schema + profile validation

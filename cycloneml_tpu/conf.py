@@ -883,3 +883,45 @@ SLO_SERVING_MS = (
     .check_value(lambda v: v >= 0, "must be >= 0")
     .float_conf(0.0)
 )
+
+
+MULTIHOST_REPLICAS = (
+    ConfigBuilder("cyclone.multihost.replicas")
+    .doc("Replica (DCN) rows of the hierarchical mesh. 0 (default) is "
+         "auto: one replica row per process, so every cross-process "
+         "collective is confined to the replica axis and the data/model "
+         "axes stay on ICI (multihost/hierarchy.py). An explicit value "
+         "is honoured — with a warning when rows would straddle a "
+         "process boundary.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(0)
+)
+
+MULTIHOST_MODEL_PARALLELISM = (
+    ConfigBuilder("cyclone.multihost.modelParallelism")
+    .doc("Model (feature-TP) axis width of the hierarchical mesh; stays "
+         "inside one process's ICI domain.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(1)
+)
+
+MULTIHOST_CPU_COLLECTIVES = (
+    ConfigBuilder("cyclone.multihost.cpuCollectives")
+    .doc("Cross-process collectives implementation for CPU-backend "
+         "multihost meshes (the 2-process smoke of the DCN hop): 'gloo' "
+         "(default) enables real cross-process psums on XLA:CPU; 'none' "
+         "leaves stock XLA behavior (multi-process CPU programs fail at "
+         "dispatch). Ignored on TPU, whose fabric needs no helper.")
+    .check_value(lambda v: v in ("gloo", "none"), "must be gloo or none")
+    .str_conf("gloo")
+)
+
+MULTIHOST_BARRIER_TIMEOUT_MS = (
+    ConfigBuilder("cyclone.multihost.barrierTimeoutMs")
+    .doc("Teardown-barrier timeout in ms: context stop on a multihost "
+         "mesh syncs every process at a coordination-service barrier "
+         "before disconnecting (no process tears down the backend while "
+         "a peer is mid-collective); a dead peer bounds the wait here.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(10000)
+)

@@ -133,7 +133,25 @@ class CycloneContext:
         self._status_listener = AppStatusListener()
         self.listener_bus.add_listener(self._status_listener)
 
-        self.mesh_runtime = mesh_mod.get_or_create(self.conf.get(MASTER))
+        # multihost conf (cyclone.multihost.*) feeds the bootstrap defaults
+        # and the hierarchical mesh shape; a mesh built ahead of the
+        # context (the worker-script idiom) is adopted as-is
+        from cycloneml_tpu.conf import (MULTIHOST_BARRIER_TIMEOUT_MS,
+                                        MULTIHOST_CPU_COLLECTIVES,
+                                        MULTIHOST_MODEL_PARALLELISM,
+                                        MULTIHOST_REPLICAS)
+        from cycloneml_tpu.multihost import bootstrap as _bootstrap
+        _bootstrap.configure(
+            cpu_collectives=self.conf.get(MULTIHOST_CPU_COLLECTIVES),
+            barrier_timeout_ms=self.conf.get(MULTIHOST_BARRIER_TIMEOUT_MS))
+        mesh_kw: Dict[str, Any] = {}
+        if self.conf.get(MULTIHOST_REPLICAS):
+            mesh_kw["n_replicas"] = self.conf.get(MULTIHOST_REPLICAS)
+        if self.conf.get(MULTIHOST_MODEL_PARALLELISM) > 1:
+            mesh_kw["model_parallelism"] = \
+                self.conf.get(MULTIHOST_MODEL_PARALLELISM)
+        self.mesh_runtime = mesh_mod.get_or_create(self.conf.get(MASTER),
+                                                   **mesh_kw)
 
         # context-owned storage tiers (BlockManager analog): every
         # persisted/cached numeric dataset registers here, so conf budgets
@@ -659,6 +677,16 @@ class CycloneContext:
             # see every span this app recorded, including ApplicationEnd's
             self._shipper.stop(flush=True)
             self._shipper = None
+        if getattr(self.mesh_runtime, "is_multihost", False):
+            # barriered multihost teardown: sync every process before
+            # disconnecting so no peer exits while another is
+            # mid-collective; a dead peer bounds the wait at
+            # cyclone.multihost.barrierTimeoutMs
+            try:
+                from cycloneml_tpu.multihost import bootstrap as _bootstrap
+                _bootstrap.shutdown(barrier_first=True)
+            except Exception:
+                logger.exception("multihost teardown failed")
         if getattr(self, "_skew_owner", False):
             from cycloneml_tpu.observe import skew as _skew
             _skew.uninstall()

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import json
 import os
-import socket
 import socketserver
 import subprocess
 import sys
@@ -59,20 +58,11 @@ COORD_PORT_TTL_S = 30.0
 
 
 def _probe_free_ports(n: int) -> List[int]:
-    """``n`` DISTINCT free ports on this machine: all sockets are held open
-    while collecting so the kernel cannot hand the same ephemeral port
-    twice. (Briefly unreserved after close — the same window every launcher
-    that assigns ports ahead of bind accepts.)"""
-    socks = []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.bind(("", 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+    """``n`` DISTINCT free ports on this machine — the coordinator-port
+    probe shared with the multihost runtime (one implementation of the
+    hold-all-sockets-open discipline; see bootstrap.probe_free_ports)."""
+    from cycloneml_tpu.multihost.bootstrap import probe_free_ports
+    return probe_free_ports(n)
 
 
 class MasterDaemon:

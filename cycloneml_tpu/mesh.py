@@ -19,6 +19,13 @@ whose collectives ride ICI; ``replica`` crosses slices/hosts over DCN and is
 1 on a single slice. ``tree_aggregate`` maps to a psum over ``data`` followed
 by a psum over ``replica`` — the hierarchical ICI-then-DCN reduction that
 replaces the reference's log-depth ``treeAggregate`` (ref: RDD.scala:1223).
+
+Multi-process masters route through :mod:`cycloneml_tpu.multihost`:
+``bootstrap`` owns the ``jax.distributed`` lifecycle (version-compat
+``is_initialized``, CPU-smoke gloo collectives, coordinator preflight,
+barriered teardown) and ``hierarchy`` builds the device grid so replica
+rows align with process (DCN) boundaries — ``n_replicas=None`` defaults
+to one replica row per process.
 """
 
 from __future__ import annotations
@@ -84,7 +91,8 @@ def _disable_compilation_cache(jax) -> None:
 class MeshRuntime:
     """Owns the global device mesh and sharding helpers."""
 
-    def __init__(self, master: str = "tpu", n_replicas: int = 1,
+    def __init__(self, master: str = "tpu",
+                 n_replicas: Optional[int] = None,
                  model_parallelism: int = 1):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -102,29 +110,47 @@ class MeshRuntime:
             # cache, or the CPU mesh inherits the TPU mesh's cache dir and
             # hits the exact AOT hazard above
             _disable_compilation_cache(jax)
-        n = len(devices)
-        if n % (n_replicas * model_parallelism) != 0:
-            raise ValueError(
-                f"{n} devices not divisible by replicas({n_replicas}) x "
-                f"model({model_parallelism})")
-        data = n // (n_replicas * model_parallelism)
-        dev_grid = np.array(devices).reshape(n_replicas, data, model_parallelism)
+        from cycloneml_tpu.multihost import hierarchy
+        dev_grid, n_replicas = hierarchy.build_device_grid(
+            devices, n_replicas, model_parallelism)
         self.mesh = Mesh(dev_grid, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS))
         self.master = master
-        self.n_devices = n
+        self.n_devices = len(devices)
+        self.n_replicas = n_replicas
+        topo = hierarchy.describe(dev_grid)
+        self.n_processes = topo["n_processes"]
+        self.dcn_aligned = topo["dcn_aligned"]
         self.platform = devices[0].platform
         self._P = PartitionSpec
         self._NamedSharding = NamedSharding
-        logger.info("Mesh up: %d %s devices, shape %s", n, self.platform,
+        logger.info("Mesh up: %d %s devices over %d process(es), shape %s",
+                    self.n_devices, self.platform, self.n_processes,
                     dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
+
+    @property
+    def is_multihost(self) -> bool:
+        """True when the mesh spans processes — collectives over the
+        ``replica`` axis cross DCN (or its CPU-smoke stand-in)."""
+        return self.n_processes > 1
+
+    @property
+    def process_index(self) -> int:
+        from cycloneml_tpu.multihost import bootstrap
+        return bootstrap.process_index()
 
     @staticmethod
     def _resolve_devices(master: str):
         import jax
+
+        from cycloneml_tpu.multihost import bootstrap
         m = _LOCAL_MESH_RE.fullmatch(master)
         if m is not None:
             want = m.group(1)
-            devices = jax.devices()
+            # LOCAL devices by definition: under an initialized
+            # jax.distributed runtime (e.g. a survivor rebuilding after
+            # host loss) jax.devices() still lists the dead peers'
+            # devices — a local mesh must never include them
+            devices = jax.local_devices()
             if want != "*":
                 want_n = int(want)
                 if len(devices) < want_n:
@@ -135,20 +161,18 @@ class MeshRuntime:
                 devices = devices[:want_n]
             return devices
         if master == "multihost":
-            if not jax.distributed.is_initialized():
-                jax.distributed.initialize()  # env/cloud auto-detection
-            return jax.devices()
+            bootstrap.initialize()  # env/cloud auto-detection
+            return bootstrap.global_devices()
         m = _MULTIHOST_RE.fullmatch(master)
         if m is not None:
             # explicit form for local-cluster-style testing and bare-metal
             # pods: multihost[<coordinator host:port>,<num_procs>,<proc_id>]
             # (≈ the reference's local-cluster[n,c,m] master,
             # SparkContext.scala:3058 — real separate processes, one mesh)
-            if not jax.distributed.is_initialized():
-                jax.distributed.initialize(coordinator_address=m.group(1),
-                                           num_processes=int(m.group(2)),
-                                           process_id=int(m.group(3)))
-            return jax.devices()
+            bootstrap.initialize(coordinator_address=m.group(1),
+                                 num_processes=int(m.group(2)),
+                                 process_id=int(m.group(3)))
+            return bootstrap.global_devices()
         if master == "tpu":
             try:
                 return jax.devices("tpu")

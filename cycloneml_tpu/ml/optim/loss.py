@@ -118,8 +118,11 @@ class DistributedLossFunction:
         from cycloneml_tpu.parallel import faults
 
         # the fused program dispatches the aggregation from INSIDE one XLA
-        # program, so the tree_aggregate-level injection point never sees
-        # these steps — fire it here, once per fused dispatch
+        # program, so the tree_aggregate-level injection points never see
+        # these steps — fire them here, once per fused dispatch
+        # (multihost.host first, mirroring _instrument_dispatch: a dead
+        # peer host surfaces as the collective that cannot complete)
+        faults.inject("multihost.host")
         faults.inject("collectives.step")
         arrays = self._agg_call.arrays()
         # line-search arithmetic lives in the ACCUMULATOR tier — f32 on

@@ -1,0 +1,28 @@
+"""Multi-host runtime: process bootstrap + hierarchical DCN×ICI meshes.
+
+The scale-out limb of the mesh layer (ROADMAP item 2; PAPER.md layers 1-3
+— the transport/RPC/deploy capabilities being matched). Two modules:
+
+- :mod:`~cycloneml_tpu.multihost.bootstrap` — ``jax.distributed``
+  lifecycle: initialization driven by the deploy environment the Worker
+  injects (coordinator address, process count/index), CPU-smoke
+  cross-process collectives (gloo), barriered teardown, and the
+  failure-path teardown MeshSupervisor uses after a host dies. A
+  single-process run never touches ``jax.distributed`` — every in-core
+  fit is untouched.
+- :mod:`~cycloneml_tpu.multihost.hierarchy` — hierarchical mesh
+  construction: the ``replica`` (DCN) axis strides across PROCESS
+  boundaries, the ``data``/``model`` (ICI) axes stay inside one
+  process's local devices. On the CPU smoke the process boundary stands
+  in for DCN and local virtual devices for ICI; on a TPU pod the same
+  grid maps replica→DCN slices and data/model→ICI (GSPMD sharding
+  propagation composes over the hierarchy without per-level rewrites,
+  PAPERS.md Xu et al.).
+
+``mesh.MeshRuntime`` consumes both; ``parallel/collectives.py`` realizes
+the reference's ``RDD.treeAggregate`` depth parameter over the resulting
+two-level topology (psum inside a slice over ICI, then the cross-slice
+combine over DCN). See docs/multihost.md.
+"""
+
+from cycloneml_tpu.multihost import bootstrap, hierarchy  # noqa: F401

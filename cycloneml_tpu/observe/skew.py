@@ -10,12 +10,14 @@ heartbeat round trips. This module watches those durations online:
 - ``observe(group, position, seconds)`` feeds one sample. Instrumented
   sites: ``oocore/stream.py`` (group ``oocore.stage``, position
   ``shard<i>``), ``serving/batcher.py`` (group ``serving.dispatch``,
-  position = lane name), ``parallel/resilience.py`` (group
-  ``heartbeat.rtt``, position = worker id — a sender-side, process-local
-  sample: SLO-style monitoring only, see :data:`STRAGGLER_GROUPS`), and
-  ``collectives._instrument_dispatch`` (group ``collectives.step``,
-  position = program name — SLO-only, see below; compile-paying first
-  dispatches excluded).
+  position = lane name), ``parallel/resilience.py``
+  (``HeartbeatReceiver.note_rtt`` — group ``heartbeat.rtt``, position =
+  worker id: the MASTER-side lane fed by each worker's reported round
+  trip over the extended heartbeat wire, so every worker's samples land
+  in ONE detector and cross-host RTT skew is a real cross-lane
+  comparison), and ``collectives._instrument_dispatch`` (group
+  ``collectives.step``, position = program name — SLO-only, see below;
+  compile-paying first dispatches excluded).
 - Detection is rolling **median + MAD** across a group's positions: a
   position whose rolling median exceeds the group median by
   ``madFactor`` × MAD AND ``relFactor`` × median is a straggler. Both
@@ -55,14 +57,15 @@ from cycloneml_tpu.util.logging import get_logger
 logger = get_logger(__name__)
 
 #: groups whose positions are comparable lanes (cross-lane straggler
-#: detection applies); everything else is SLO-only. ``heartbeat.rtt`` is
-#: deliberately NOT here: the sample is taken SENDER-side, and a real
-#: deployment runs one sender per process — its process-local detector
-#: only ever sees one lane, so the cross-worker comparison would be
-#: structurally dead. Master-side per-worker RTT comparison (the
-#: receiver would need its own timing leg) is the elastic-scheduler
-#: follow-up (ROADMAP item 4), not a silent promise here.
-STRAGGLER_GROUPS = frozenset({"oocore.stage", "serving.dispatch"})
+#: detection applies); everything else is SLO-only. ``heartbeat.rtt``
+#: earned its place back (PR 12 removed it): the lanes are now fed
+#: MASTER-side — each worker reports its measured round trip over the
+#: extended heartbeat wire and ``HeartbeatReceiver.note_rtt`` lands
+#: every worker's samples in the receiver process's ONE detector, so
+#: the cross-worker comparison is structurally live (the sender-side
+#: sample it replaces saw only its own lane).
+STRAGGLER_GROUPS = frozenset({"oocore.stage", "serving.dispatch",
+                              "heartbeat.rtt"})
 
 #: bound on distinct positions tracked per group — a pathological caller
 #: (unbounded lane names) degrades to ignoring NEW lanes, never to

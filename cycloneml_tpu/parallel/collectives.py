@@ -38,18 +38,44 @@ def shard_map_compat(f, mesh, in_specs, out_specs):
                    check_rep=False)
 
 
-def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS)):
-    """Hierarchical psum: intra-slice (ICI) first, then cross-slice (DCN).
+def psum_over_mesh(x, axes: Sequence[str] = (DATA_AXIS, REPLICA_AXIS),
+                   *, depth: int = 2):
+    """Topology-aware psum: intra-slice (ICI) first, then cross-slice (DCN).
 
-    Inside shard_map only. Two psums rather than one over a tuple of axes so
-    XLA schedules the ICI reduction before the (slower) DCN hop — the analog
-    of treeAggregate's ``depth`` levels.
+    Inside shard_map only. ``depth`` is the reference's ``treeAggregate``
+    depth parameter realized on the two-tier mesh topology: ``depth >= 2``
+    (default) reduces level by level — a psum over ``data`` (ICI, inside
+    one process/slice) followed by a psum over ``replica`` (DCN, across
+    slices) — so XLA schedules the fast intra-slice reduction before the
+    slower DCN hop and only one partial per slice crosses the wire.
+    ``depth=1`` is the flat single-level reduction: ONE psum over the
+    joint axis tuple (the ``treeAggregate(depth=1)`` analog). The mesh
+    has exactly two interconnect tiers, so depths beyond 2 reduce to the
+    hierarchical form.
     """
     import jax
     out = x
-    for ax in axes:
-        out = jax.lax.psum(out, ax)
+    for level in _level_axes(tuple(axes), depth):
+        out = jax.lax.psum(out, level)
     return out
+
+
+def _level_axes(axes: tuple, depth: int) -> tuple:
+    """Axis groups per reduction level — one joint group at depth 1
+    (flat), one group per axis at depth >= 2 (hierarchical). Static host
+    structure: the depth decision happens before tracing, outside the
+    lax-calling function."""
+    if depth <= 1:
+        return (axes,)
+    return tuple((ax,) for ax in axes)
+
+
+def reduction_levels(depth: int) -> tuple:
+    """(tier, axes) levels a ``depth`` reduction performs — the structure
+    annotation the dispatch spans carry to the trace collector."""
+    if depth <= 1:
+        return (("flat", f"{DATA_AXIS}+{REPLICA_AXIS}"),)
+    return (("ici", DATA_AXIS), ("dcn", REPLICA_AXIS))
 
 
 class BoundedProgramCache:
@@ -100,7 +126,8 @@ class BoundedProgramCache:
         return len(self._d)
 
 
-def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
+def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None,
+                         levels: tuple = ()):
     """Route every dispatch of an aggregation program through the chaos
     harness's ``collectives.step`` injection point (faults.py) and, when
     tracing is enabled, open a ``collective`` span per step (a ``compile``
@@ -119,6 +146,10 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
 
     first = [True]
     pid_ref = [None]
+    # reduction-structure annotation, built once: the collective spans
+    # carry the per-level topology (ici/dcn axes) to the trace collector
+    level_attrs = {f"level.{i}": f"{tier}:{axes}"
+                   for i, (tier, axes) in enumerate(levels)}
 
     @functools.wraps(jitted)
     def dispatch(*args, **kwargs):
@@ -132,7 +163,11 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
             return jitted(*args, **kwargs)
         # inject BEFORE consuming the first-dispatch flag: a chaos fault
         # raised here leaves the flag set, so the RETRY (the dispatch that
-        # actually pays trace + compile) still records its compile span
+        # actually pays trace + compile) still records its compile span.
+        # `multihost.host` fires first: a lost HOST surfaces to the train
+        # loop as the collective that can no longer complete — scheduling
+        # a HostLostError here is the chaos stand-in for a dead peer
+        faults.inject("multihost.host")
         faults.inject("collectives.step")
         was_first, first[0] = first[0], False
         tr = tracing.active()
@@ -156,7 +191,8 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None):
             # before the oversized program ever executes
             pid_ref[0] = costs.ensure(name, key, jitted, args)
             costs.check_budget(pid_ref[0])
-        with tr.span("collective", name, program=pid_ref[0]) as csp:
+        with tr.span("collective", name, program=pid_ref[0],
+                     **level_attrs) as csp:
             if was_first:
                 with tr.span("compile", name):
                     out = jitted(*args, **kwargs)
@@ -190,7 +226,8 @@ def clear_program_cache() -> None:
 def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
                    auto_psum: bool = True, with_state: bool = False,
                    n_sharded: Optional[int] = None,
-                   donate_rows: bool = False):
+                   donate_rows: bool = False,
+                   depth: Optional[int] = None):
     """Aggregate ``fn(local_rows..., extras...) -> pytree`` over row-sharded arrays.
 
     ``arrays`` fixes how many leading arguments are row-sharded; the returned
@@ -216,6 +253,16 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
     every iteration and must NEVER donate. On host-platform (CPU) meshes
     donation is skipped — XLA:CPU does not implement it and would warn on
     every program.
+
+    ``depth`` is the reference's ``treeAggregate`` depth parameter mapped
+    onto the two-tier mesh topology (see :func:`psum_over_mesh`):
+    ``depth>=2`` (default) reduces hierarchically — psum over ``data``
+    inside each slice (ICI), then the cross-slice combine over
+    ``replica`` (DCN) — while ``depth=1`` emits one flat psum over the
+    joint axes. ``None`` resolves ``cyclone.treeAggregate.depth`` from
+    the active context (default 2). The two forms are numerically
+    equivalent at the ulp level (only the reduction grouping differs);
+    the hierarchical form keeps DCN traffic to one partial per slice.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -225,9 +272,12 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
         raise ValueError("with_state=True requires auto_psum=True")
     if n_sharded is None:
         n_sharded = len(arrays)
+    if depth is None:
+        depth = _default_depth()
     donate = bool(donate_rows) and runtime.platform != "cpu"
     try:
-        key = (fn, runtime.mesh, n_sharded, auto_psum, with_state, donate)
+        key = (fn, runtime.mesh, n_sharded, auto_psum, with_state, donate,
+               depth)
         cached = _program_cache.get(key)
     except TypeError:  # unhashable fn: build uncached
         key, cached = None, None
@@ -241,7 +291,8 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
             # fn performs its own collectives (e.g. pmax/pmin stats)
             return partial
         return jax.tree_util.tree_map(
-            lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS)), partial)
+            lambda t: psum_over_mesh(t, (DATA_AXIS, REPLICA_AXIS),
+                                     depth=depth), partial)
 
     def sharded(*all_args):
         def local(*a):
@@ -258,10 +309,21 @@ def tree_aggregate(fn: Callable, runtime: MeshRuntime, *arrays,
     jitted = _instrument_dispatch(
         jax.jit(sharded,
                 donate_argnums=tuple(range(n_sharded)) if donate else ()),
-        key=key)
+        key=key, levels=reduction_levels(depth) if auto_psum else ())
     if key is not None:
         _program_cache.put(key, jitted)
     return jitted
+
+
+def _default_depth() -> int:
+    """``cyclone.treeAggregate.depth`` from the active context, else the
+    hierarchical default (2)."""
+    from cycloneml_tpu.context import active_context
+    ctx = active_context()
+    if ctx is not None:
+        from cycloneml_tpu.conf import AGGREGATION_DEPTH
+        return int(ctx.conf.get(AGGREGATION_DEPTH))
+    return 2
 
 
 def tree_aggregate_with_state(fn: Callable, runtime: MeshRuntime, *arrays):

@@ -31,6 +31,16 @@ point                    fired from
                          mid-epoch, permanent faults abort the epoch
                          cleanly with the stream drained and the
                          staging thread released)
+``multihost.host``       every aggregation dispatch, ahead of
+                         ``collectives.step`` — where the loss of a
+                         whole HOST first surfaces to the training
+                         loop (the collective its devices can no
+                         longer complete). Schedule a
+                         :class:`HostLostError` here to chaos-test
+                         MeshSupervisor's host-loss recovery: flight
+                         dump, program-cache clear, distributed
+                         teardown, mesh rebuild over the surviving
+                         hosts, re-shard, resume-from-checkpoint.
 ======================== =================================================
 
 Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the
@@ -84,6 +94,23 @@ class DeviceLostError(FaultInjected):
                  lost_workers: Sequence[str] = ()):
         super().__init__(msg)
         self.lost_workers = list(lost_workers)
+
+
+class HostLostError(DeviceLostError):
+    """A whole HOST (one process of the multihost mesh, with every device
+    it contributes) is gone: missed heartbeats, a dead deploy worker, a
+    preempted pod slice. Same recovery class as device loss — the
+    compiled programs and the distributed runtime itself are dead — but
+    the supervisor additionally abandons the ``jax.distributed``
+    rendezvous (the coordinator may be the casualty) before rebuilding
+    over the surviving hosts. ``lost_workers`` aliases ``lost_hosts`` so
+    the generic recovery plumbing (``train_with_checkpoints`` →
+    ``MeshSupervisor.recover``) routes it unchanged."""
+
+    def __init__(self, msg: str = "host lost",
+                 lost_hosts: Sequence[str] = ()):
+        super().__init__(msg, lost_workers=lost_hosts)
+        self.lost_hosts = list(lost_hosts)
 
 
 class MidSaveCrash(FaultInjected):

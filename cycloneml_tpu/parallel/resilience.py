@@ -113,6 +113,7 @@ class HeartbeatReceiver:
         self._last: Dict[str, float] = {}
         self._lost: Dict[str, str] = {}
         self._trace_ids: Dict[str, str] = {}
+        self._rtts: Dict[str, float] = {}
         self._callbacks: List[Callable[[str, str], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -133,6 +134,25 @@ class HeartbeatReceiver:
     def trace_ids(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._trace_ids)
+
+    def note_rtt(self, worker_id: str, rtt_s: float) -> None:
+        """Record a worker-reported heartbeat round-trip time — the
+        MASTER-side straggler lane (observe/skew.py): every worker's RTT
+        samples land in THIS process's detector, so cross-host RTT skew
+        is a real cross-lane comparison (the sender-side sample PR 12
+        demoted was process-local — one lane per process, structurally
+        dead). A worker whose rolling RTT median pulls away from the
+        fleet latches StragglerDetected."""
+        rtt_s = float(rtt_s)
+        with self._lock:
+            self._rtts[worker_id] = rtt_s
+        from cycloneml_tpu.observe import skew
+        skew.observe("heartbeat.rtt", worker_id, rtt_s)
+
+    def rtts(self) -> Dict[str, float]:
+        """Last reported round-trip time per worker."""
+        with self._lock:
+            return dict(self._rtts)
 
     def heartbeat(self, worker_id: str) -> bool:
         """Returns False if the worker was already expired (it must
@@ -215,7 +235,7 @@ class HeartbeatServer:
       ``REG <worker_id>`` → ``OK``         register / revive
       ``HB <worker_id>``  → ``OK`` | ``EXPIRED``   expired workers must
       re-register, exactly as the reference asks executors to re-register.
-      ``HB <worker_id> <t_send> [trace_id]`` → ``OK <t_server>`` |
+      ``HB <worker_id> <t_send> [trace_id] [rtt]`` → ``OK <t_server>`` |
       ``EXPIRED <t_server>``   the EXTENDED ping: ``t_send`` is the
       sender's wall clock (must parse as a float — anything else is
       ``ERR``), the reply echoes the server's wall clock, and the sender
@@ -223,8 +243,13 @@ class HeartbeatServer:
       (observe/collect.py; the trace collector corrects per-host
       timestamps with the median of these samples). ``trace_id``
       announces which distributed trace the worker participates in
-      (:meth:`HeartbeatReceiver.trace_ids`). Legacy 2-token pings get the
-      legacy 1-token replies, byte for byte.
+      (:meth:`HeartbeatReceiver.trace_ids`); the placeholder ``-`` means
+      "no trace" and is required when ``rtt`` follows. ``rtt`` is the
+      sender's PREVIOUS measured round trip in seconds (must parse as a
+      float — else ``ERR``), fed to :meth:`HeartbeatReceiver.note_rtt`
+      so cross-worker RTT skew is compared master-side (observe/skew.py
+      straggler lanes). Legacy 2-token pings get the legacy 1-token
+      replies, byte for byte.
     """
 
     def __init__(self, receiver: HeartbeatReceiver, host: str = "127.0.0.1",
@@ -251,18 +276,33 @@ class HeartbeatServer:
                     elif cmd == "HB" and len(parts) == 2:
                         ok = recv.heartbeat(worker)
                         self.wfile.write(b"OK\n" if ok else b"EXPIRED\n")
-                    elif cmd == "HB" and len(parts) in (3, 4):
+                    elif cmd == "HB" and len(parts) in (3, 4, 5):
                         # extended ping: 3rd token must be the sender's
                         # wall clock (garbage stays ERR — the legacy
-                        # malformed-line contract)
+                        # malformed-line contract); optional 4th is the
+                        # trace id ('-' = none), optional 5th the
+                        # sender's previous RTT (float, else ERR)
                         try:
                             float(parts[2])
                         except ValueError:
                             self.wfile.write(b"ERR\n")
                             return
-                        if len(parts) == 4:
+                        rtt = None
+                        if len(parts) == 5:
+                            try:
+                                rtt = float(parts[4])
+                            except ValueError:
+                                self.wfile.write(b"ERR\n")
+                                return
+                        if len(parts) >= 4 and parts[3] != "-":
                             recv.note_trace(worker, parts[3])
                         ok = recv.heartbeat(worker)
+                        if ok and rtt is not None:
+                            # only LIVE workers feed the straggler lanes:
+                            # an expired worker's pings (it must
+                            # re-register) must not let a dead lane latch
+                            # verdicts the liveness layer already settled
+                            recv.note_rtt(worker, rtt)
                         word = "OK" if ok else "EXPIRED"
                         self.wfile.write(
                             f"{word} {time.time():.6f}\n".encode())
@@ -303,6 +343,7 @@ class HeartbeatSender:
         self._addr = (host or "127.0.0.1", int(port))
         self.interval_s = interval_s
         self._registered = False
+        self._last_rtt_s: Optional[float] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name=f"cyclone-heartbeat-{worker_id}",
@@ -326,20 +367,28 @@ class HeartbeatSender:
 
     def _ping(self) -> str:
         """One EXTENDED heartbeat round trip: the ping carries this
-        process's wall clock (and its trace id, when tracing is on), the
-        reply carries the server's; the RTT midpoint yields one NTP-style
+        process's wall clock (its trace id, when tracing is on, and the
+        PREVIOUS round trip's measured RTT), the reply carries the
+        server's clock; the RTT midpoint yields one NTP-style
         clock-offset sample for the trace collector
-        (``observe/collect.py`` — error bound RTT/2) and the RTT itself
-        feeds the per-worker skew lane."""
-        from cycloneml_tpu.observe import collect, skew, tracing
+        (``observe/collect.py`` — error bound RTT/2). The RTT itself is
+        reported to the RECEIVER, whose detector sees every worker's
+        lane — cross-host skew is a master-side comparison, not the
+        process-local sample this sender could take alone."""
+        from cycloneml_tpu.observe import collect, tracing
         # announce only a FULL tracer's id: the always-on flight ring's
         # uuid corresponds to no collectable trace and would pollute the
         # receiver's liveness↔telemetry join with meaningless ids
         tr = tracing.full_active()
-        trace_suffix = f" {tr.trace_id}" if tr is not None else ""
+        if self._last_rtt_s is not None:
+            trace_tok = tr.trace_id if tr is not None else "-"
+            suffix = f" {trace_tok} {self._last_rtt_s:.6f}"
+        else:
+            suffix = f" {tr.trace_id}" if tr is not None else ""
         t0 = time.time()
-        reply = self._send(f"HB {self.worker_id} {t0:.6f}{trace_suffix}")
+        reply = self._send(f"HB {self.worker_id} {t0:.6f}{suffix}")
         t3 = time.time()
+        self._last_rtt_s = max(t3 - t0, 0.0)
         parts = reply.split()
         if len(parts) == 2 and parts[0] in ("OK", "EXPIRED"):
             try:
@@ -351,7 +400,6 @@ class HeartbeatSender:
                 # midpoint; |error| <= RTT/2
                 collect.record_offset_sample((t0 + t3) / 2.0 - t_server,
                                              max(t3 - t0, 0.0))
-        skew.observe("heartbeat.rtt", self.worker_id, max(t3 - t0, 0.0))
         return parts[0] if parts else reply
 
     def _loop(self) -> None:
@@ -458,11 +506,16 @@ class MeshSupervisor:
     from a :class:`HeartbeatReceiver` (and ``DeviceLostError``s raised by a
     step) mark workers dead in a :class:`HealthTracker`; ``recover()`` then
 
-    1. drops every compiled program (``clear_program_cache`` — they close
-       over the dead mesh),
-    2. rebuilds the mesh over the surviving devices
-       (``ctx.rebuild_mesh``), and
-    3. calls ``on_rebuild(runtime)`` so the caller re-shards its data onto
+    1. freezes the flight-recorder window (the ring shows what the mesh
+       was doing as it degraded) and drops every compiled program
+       (``clear_program_cache`` — they close over the dead mesh),
+    2. on a MULTIHOST mesh, abandons the ``jax.distributed`` rendezvous
+       (:func:`multihost.bootstrap.abandon` — no barrier, the dead host
+       cannot arrive; bounded wait, the coordinator may be the casualty),
+    3. rebuilds the mesh over the surviving devices
+       (``ctx.rebuild_mesh`` — ``local-mesh[n]`` selects LOCAL devices,
+       so a survivor never re-adopts the dead peers' devices), and
+    4. calls ``on_rebuild(runtime)`` so the caller re-shards its data onto
        the new mesh — its return value (if not None) becomes the new loss
        function for :func:`train_with_checkpoints`, which resumes from the
        newest verifiable checkpoint.
@@ -470,17 +523,27 @@ class MeshSupervisor:
     ``worker_devices`` maps worker ids to the device count each one
     contributes; without it the supervisor rebuilds onto whatever the
     master URL still resolves (re-enumeration — right for ``tpu`` masters
-    where the runtime discovers survivors itself).
+    where the runtime discovers survivors itself). ``worker_hosts`` maps
+    worker ids to HOST ids for whole-host failure semantics: when every
+    worker of a host is lost — or :meth:`note_host_lost` reports the host
+    directly — the loss is recorded at host granularity too
+    (:meth:`lost_hosts`). Without the map each worker is its own host,
+    which matches the deploy layer's one-process-per-worker model. The
+    ``multihost.host`` chaos fault point (faults.py) makes the whole
+    path — flight dump, teardown, rebuild, re-shard, resume —
+    deterministically testable.
     """
 
     def __init__(self, ctx, *,
                  worker_devices: Optional[Dict[str, int]] = None,
+                 worker_hosts: Optional[Dict[str, str]] = None,
                  master_for: Optional[Callable[[int], str]] = None,
                  health: Optional["HealthTracker"] = None,
                  on_rebuild: Optional[Callable[[Any], Any]] = None,
                  min_devices: int = 1, max_rebuilds: int = 2):
         self.ctx = ctx
         self.worker_devices = dict(worker_devices or {})
+        self.worker_hosts = dict(worker_hosts or {})
         self._master_for = master_for
         self.health = health if health is not None else HealthTracker()
         self.on_rebuild = on_rebuild
@@ -488,6 +551,7 @@ class MeshSupervisor:
         self.max_rebuilds = max_rebuilds
         self.rebuilds = 0
         self._lost: Dict[str, str] = {}
+        self._lost_hosts: Dict[str, str] = {}
         self._stragglers: Dict[str, dict] = {}
         self._pending: Optional[str] = None
         self._lock = threading.Lock()
@@ -528,12 +592,32 @@ class MeshSupervisor:
     def note_worker_lost(self, worker_id: str, reason: str) -> None:
         """Record a lost worker; the rebuild itself happens on the training
         thread (``recover``), never on the heartbeat sweep thread — tearing
-        down the mesh under a running step would race the step itself."""
+        down the mesh under a running step would race the step itself.
+        When the worker's HOST has no surviving workers the loss is
+        recorded at host granularity too (whole-host loss — on the
+        one-process-per-worker deploy model, immediately)."""
         self.health.record_failure(worker_id)
+        host = self.worker_hosts.get(worker_id, worker_id)
         with self._lock:
             self._lost[worker_id] = reason
             self._pending = f"worker {worker_id} lost: {reason}"
+            siblings = [w for w, h in self.worker_hosts.items() if h == host]
+            if all(w in self._lost for w in siblings):  # [] -> host==worker
+                self._lost_hosts[host] = reason
         logger.warning("mesh degraded: worker %s lost (%s)", worker_id, reason)
+
+    def note_host_lost(self, host: str, reason: str) -> None:
+        """Record the loss of a whole HOST: every worker it ran (per
+        ``worker_hosts``; the host id itself when unmapped) is marked
+        lost, so surviving-device math and health exclusion see the full
+        casualty list from one event (a missed-heartbeat host, a
+        HostLostError's ``lost_hosts``)."""
+        workers = [w for w, h in self.worker_hosts.items() if h == host] \
+            or [host]
+        for w in workers:
+            self.note_worker_lost(w, reason)
+        with self._lock:
+            self._lost_hosts[host] = reason
 
     def pending_loss(self) -> Optional[str]:
         with self._lock:
@@ -542,6 +626,11 @@ class MeshSupervisor:
     def lost_workers(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._lost)
+
+    def lost_hosts(self) -> Dict[str, str]:
+        """Hosts with no surviving workers (whole-host casualties)."""
+        with self._lock:
+            return dict(self._lost_hosts)
 
     def surviving_devices(self) -> Optional[int]:
         """Devices contributed by workers not known to be lost; None when
@@ -567,9 +656,17 @@ class MeshSupervisor:
     def recover(self, reason: str = "",
                 lost_workers: Sequence[str] = ()) -> Any:
         """Rebuild the mesh over the survivors and re-shard. Returns
-        ``on_rebuild``'s result (the caller's rebuilt loss fn, or None)."""
+        ``on_rebuild``'s result (the caller's rebuilt loss fn, or None).
+        Ids naming a mapped HOST (``worker_hosts`` values — e.g. a
+        ``HostLostError.lost_hosts`` entry) are recorded as whole-host
+        losses; anything else as a single worker."""
+        hosts = set(self.worker_hosts.values())
         for w in lost_workers:
-            self.note_worker_lost(w, reason or "reported by step failure")
+            why = reason or "reported by step failure"
+            if w in hosts:
+                self.note_host_lost(w, why)
+            else:
+                self.note_worker_lost(w, why)
         if self.rebuilds >= self.max_rebuilds:
             raise MeshDegradedError(
                 f"mesh rebuilt {self.rebuilds} times already "
@@ -579,14 +676,23 @@ class MeshSupervisor:
         master = self._target_master()
         # freeze the flight-recorder window BEFORE teardown: the ring
         # holds what the mesh was doing as it degraded — diagnosable
-        # after the fact even when full tracing was never on
+        # after the fact even when full tracing was never on. Host-loss
+        # recoveries ride the same pre-teardown dump (pinned by test).
         from cycloneml_tpu.observe import flight
         flight.trigger("mesh.rebuild", cause=reason or "device loss",
-                       rebuild=self.rebuilds)
+                       rebuild=self.rebuilds,
+                       lost_hosts=",".join(sorted(self.lost_hosts())))
         from cycloneml_tpu.parallel.collectives import clear_program_cache
         with tracing.span("rebuild", reason or "device loss",
                           rebuild=self.rebuilds):
             clear_program_cache()  # compiled programs close over dead mesh
+            if getattr(self.ctx.mesh_runtime, "is_multihost", False):
+                # whole-host loss on a multi-process mesh: the
+                # jax.distributed rendezvous died with the host (maybe
+                # the coordinator itself) — abandon it, bounded, before
+                # bringing up the survivor topology
+                from cycloneml_tpu.multihost import bootstrap
+                bootstrap.abandon()
             rt = self.ctx.rebuild_mesh(master)
             logger.warning("mesh recovery #%d (%s): rebuilt over %d devices",
                            self.rebuilds, reason or "device loss",

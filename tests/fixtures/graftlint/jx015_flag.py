@@ -43,3 +43,16 @@ def hierarchical_wrong_out(mesh, xb):
     # out_spec still claims the row sharding
     row_spec = P(("replica", "data"))
     return shard_map_compat(_local_stats, mesh, (row_spec,), row_spec)(xb)  # JX015
+
+
+def _local_flat(xb):
+    # the depth=1 flat reduction: ONE psum over the joint axis tuple
+    return jax.lax.psum(jnp.sum(xb, axis=0), ("data", "replica"))
+
+
+def flat_depth1_wrong_out(mesh, xb):
+    # the multihost depth=1 spelling of the same hazard: the flat tuple
+    # psum reduced over both mesh axes at once, the out_spec still
+    # claims the hierarchical row sharding
+    row_spec = P(("replica", "data"))
+    return shard_map_compat(_local_flat, mesh, (row_spec,), row_spec)(xb)  # JX015

@@ -38,3 +38,15 @@ def rank_matches(mesh):
     rows = jnp.zeros((8, 4))
     return shard_map_compat(_local_psummed, mesh,
                             (P("data", None),), P())(rows)
+
+
+def _local_flat_psummed(x):
+    # the depth=1 flat reduction: one psum over the joint axis tuple
+    return jax.lax.psum(jnp.sum(x, axis=0), ("data", "replica"))
+
+
+def flat_depth1_replicated_out(mesh, xs):
+    # the multihost depth=1 idiom: hierarchical row in_spec over BOTH
+    # mesh axes, flat tuple psum in the body, replicated out_spec
+    spec = P((REPLICA_AXIS, DATA_AXIS))
+    return shard_map_compat(_local_flat_psummed, mesh, (spec,), P())(xs)

@@ -57,3 +57,18 @@ class Trainer:
         self._step = tree_aggregate(_sum_kernel, runtime, xb)
         _recover(supervisor)
         return self._step(xb, coef)                             # JX017
+
+
+def _recover_host_loss(bootstrap, supervisor):
+    # the host-loss recovery helper: abandon the dead rendezvous, then
+    # rebuild over the survivors — transitively a mesh rebuild
+    bootstrap.abandon()
+    supervisor.rebuild_mesh()
+
+
+def stale_after_host_loss(runtime, bootstrap, supervisor, xb, coef):
+    # the multihost hazard: a whole HOST died, recovery rebuilt the mesh
+    # over the survivors, and the pre-loss program is dispatched anyway
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    _recover_host_loss(bootstrap, supervisor)
+    return step(xb, coef)                                       # JX017

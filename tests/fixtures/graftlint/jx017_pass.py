@@ -55,3 +55,14 @@ def loop_rebinds_each_iteration(runtime, supervisor, xb, coef):
         out = step(xb, coef)
         _recover(supervisor)
     return out
+
+
+def host_loss_recover_then_rebuild(runtime, bootstrap, supervisor, xb, coef):
+    # the MeshSupervisor host-loss idiom: drop the caches, abandon the
+    # dead jax.distributed rendezvous, rebuild the mesh over survivors,
+    # then REBUILD the program before dispatching
+    clear_program_cache()
+    bootstrap.abandon()
+    supervisor.rebuild_mesh()
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    return step(xb, coef)

@@ -553,3 +553,85 @@ def test_oocore_transient_faults_exhaust_to_abort(ctx):
         assert len(inj.log) == 1 + max_retries
     finally:
         sds.close()
+
+
+# -- fault class 6: whole-HOST loss (multihost.host) ----------------------------
+
+def test_host_loss_rebuilds_mesh_and_resumes(ctx, tmp_path):
+    """Seeded chaos host loss (ISSUE 13 acceptance): a HostLostError at
+    the ``multihost.host`` fault point mid-fit — the chaos stand-in for a
+    killed worker process — runs the whole recovery: flight-ring dump
+    PRE-teardown, program-cache clear, mesh rebuild over the surviving
+    host's devices, re-shard, resume-from-checkpoint; the resumed fit's
+    coefficients match an uninterrupted run at the documented parity
+    tolerance (docs/multihost.md)."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.observe import flight
+    from cycloneml_tpu.parallel.faults import HostLostError
+
+    ds8, make_loss, x0 = _logistic_problem(ctx)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(ds8), x0)
+    data_ck = str(tmp_path / "data")
+    ds8.checkpoint(data_ck)
+    opt_ck = TrainingCheckpointer(str(tmp_path / "opt"))
+
+    sup = ctx.mesh_supervisor(
+        worker_devices={"w0": 4, "w1": 4},
+        worker_hosts={"w0": "hostA", "w1": "hostB"},
+        on_rebuild=lambda rt: make_loss(InstanceDataset.restore(ctx, data_ck)))
+    sched = FaultSchedule(seed=7)
+    sched.at("multihost.host", 9,
+             HostLostError("host hostB unreachable", lost_hosts=["hostB"]))
+    from cycloneml_tpu.observe import tracing
+    own_ring = tracing.active() is None  # an earlier test may have
+    # disabled the ctx-installed flight ring; the dump pin needs one
+    if own_ring:
+        flight.enable()
+    flight.reset()
+    flight.configure(min_interval_s=0.0)  # the fault fires a dump first;
+    # un-throttle so the recovery's own pre-teardown dump is visible too
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(ds8), x0, opt_ck,
+                interval=2, supervisor=sup, backoff_base_s=0.001, seed=7)
+        assert inj.log == [("multihost.host", 9, "HostLostError")]
+        assert sup.rebuilds == 1
+        # host granularity: the whole host and its worker are casualties
+        assert "hostB" in sup.lost_hosts()
+        assert "w1" in sup.lost_workers()
+        assert "hostA" not in sup.lost_hosts()
+        assert ctx.mesh_runtime.n_devices == 4  # survivors only
+        # flight recorder satellite: host-loss recovery dumped the ring
+        # PRE-teardown, exactly like device-loss recovery
+        reasons = [d["reason"] for d in flight.dumps()]
+        assert "mesh.rebuild" in reasons
+        rebuild_dump = next(d for d in flight.dumps()
+                            if d["reason"] == "mesh.rebuild")
+        assert rebuild_dump["attrs"]["lost_hosts"] == "hostB"
+        assert rebuild_dump["n_spans"] >= 1
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5, atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        flight.configure(min_interval_s=1.0)
+        if own_ring:
+            flight.disable()
+        ctx.rebuild_mesh("local-mesh[8]")  # restore fixture invariant
+
+
+def test_host_loss_via_heartbeat_marks_whole_host(ctx):
+    """note_host_lost (the missed-heartbeat-host path) marks every worker
+    the host ran, feeds the health tracker, and arms pending recovery —
+    without touching the mesh until the training thread recovers."""
+    sup = MeshSupervisor(
+        ctx, worker_devices={"w0": 2, "w1": 2, "w2": 4},
+        worker_hosts={"w0": "hostA", "w1": "hostA", "w2": "hostB"})
+    sup.note_worker_lost("w0", "no heartbeat")
+    # hostA still has w1 alive: not a whole-host loss yet
+    assert "hostA" not in sup.lost_hosts()
+    assert sup.surviving_devices() == 6
+    sup.note_host_lost("hostA", "host unreachable")
+    assert sup.lost_hosts() == {"hostA": "host unreachable"}
+    assert set(sup.lost_workers()) == {"w0", "w1"}
+    assert sup.surviving_devices() == 4
+    assert sup.pending_loss() is not None

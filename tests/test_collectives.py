@@ -63,3 +63,97 @@ def test_sharding_is_distributed(ctx):
     x = np.zeros((64, 2))
     xs = rt.device_put_sharded_rows(x)
     assert len(xs.sharding.device_set) == 8
+
+
+# -- treeAggregate depth: hierarchical (ICI->DCN) vs flat reduction -------------
+
+def test_tree_aggregate_depth_parity_ulp(ctx):
+    """The 2-level reduction (psum over data/ICI then replica/DCN) and the
+    flat depth=1 psum agree at the ulp level in f64: only the reduction
+    GROUPING differs (ISSUE 13 satellite). Seeded non-trivial values so a
+    grouping bug cannot hide behind symmetric inputs."""
+    import jax.numpy as jnp
+    rt = ctx.mesh_runtime
+    rng = np.random.RandomState(11)
+    x = rng.randn(64, 8)
+    xs = rt.device_put_sharded_rows(x)
+
+    hier = collectives.tree_aggregate(
+        lambda a: jnp.sum(a, axis=0), rt, xs, depth=2)
+    flat = collectives.tree_aggregate(
+        lambda a: jnp.sum(a, axis=0), rt, xs, depth=1)
+    out2 = np.asarray(hier(xs))
+    out1 = np.asarray(flat(xs))
+    np.testing.assert_array_almost_equal_nulp(out1, out2, nulp=2)
+    np.testing.assert_allclose(out2, x.sum(axis=0), rtol=1e-12)
+
+
+def test_tree_aggregate_depth_forks_program_identity(ctx):
+    """depth participates in program-cache identity: the flat and
+    hierarchical reductions are DIFFERENT compiled programs (an XLA
+    schedule property), while repeated same-depth calls share one."""
+    import jax.numpy as jnp
+    rt = ctx.mesh_runtime
+
+    def kernel(a):
+        return jnp.sum(a)
+
+    xs = rt.device_put_sharded_rows(np.ones((16, 2)))
+    a2 = collectives.tree_aggregate(kernel, rt, xs, depth=2)
+    a1 = collectives.tree_aggregate(kernel, rt, xs, depth=1)
+    again = collectives.tree_aggregate(kernel, rt, xs, depth=2)
+    assert a1 is not a2
+    assert again is a2
+    assert float(a1(xs)) == float(a2(xs)) == 32.0
+
+
+def test_tree_aggregate_depth_default_from_conf(ctx):
+    """depth=None resolves cyclone.treeAggregate.depth from the active
+    context — the conf key is live, not API decoration."""
+    import jax.numpy as jnp
+
+    rt = ctx.mesh_runtime
+
+    def kernel(a):
+        return jnp.sum(a)
+
+    xs = rt.device_put_sharded_rows(np.ones((16, 2)))
+    default = collectives.tree_aggregate(kernel, rt, xs)
+    assert default is collectives.tree_aggregate(kernel, rt, xs, depth=2)
+    old = ctx.conf.get("cyclone.treeAggregate.depth")
+    try:
+        ctx.conf.set("cyclone.treeAggregate.depth", 1)
+        assert collectives.tree_aggregate(kernel, rt, xs) is \
+            collectives.tree_aggregate(kernel, rt, xs, depth=1)
+    finally:
+        ctx.conf.set("cyclone.treeAggregate.depth", old)
+
+
+def test_tree_aggregate_depth_preserves_contracts(ctx):
+    """depth composes with the n_sharded/with_state contracts (the oocore
+    compile-before-operands path and the kmeans state path keep working
+    at depth=1)."""
+    import jax.numpy as jnp
+    rt = ctx.mesh_runtime
+    x = np.arange(32.0).reshape(16, 2)
+    xs = rt.device_put_sharded_rows(x)
+    # n_sharded: compile before operands exist
+    agg = collectives.tree_aggregate(
+        lambda a: jnp.sum(a, axis=0), rt, n_sharded=1, depth=1)
+    np.testing.assert_allclose(np.asarray(agg(xs)), x.sum(axis=0))
+    # with_state: psummed stats + row-sharded state
+    agg_st = collectives.tree_aggregate(
+        lambda a: (jnp.sum(a), a + 1.0), rt, xs,
+        with_state=True, depth=1)
+    stats, rows = agg_st(xs)
+    assert float(stats) == x.sum()
+    np.testing.assert_allclose(np.asarray(rows), x + 1.0)
+
+
+def test_reduction_levels_annotation():
+    """The per-level structure the dispatch spans ship to the collector."""
+    assert collectives.reduction_levels(2) == (
+        ("ici", "data"), ("dcn", "replica"))
+    assert collectives.reduction_levels(5) == (
+        ("ici", "data"), ("dcn", "replica"))  # two tiers exist
+    assert collectives.reduction_levels(1) == (("flat", "data+replica"),)

@@ -55,7 +55,10 @@ def test_submit_two_process_mesh(cluster):
     """The deploy layer forms a REAL 2-process x 4-device mesh: each
     Worker-launched process reads CYCLONE_MASTER_URL and joins the same
     jax.distributed coordinator (the reference's executor allocation
-    collapsed into mesh formation)."""
+    collapsed into mesh formation). The app also runs the seeded
+    2-process tree_aggregate depth parity (ISSUE 13 satellite): the
+    hierarchical ICI→DCN reduction (depth=2) and the flat depth=1 psum
+    agree across a REAL process boundary."""
     m, workers, tmp_path = cluster
     app = tmp_path / "mesh_app.py"
     app.write_text(textwrap.dedent(f"""
@@ -65,20 +68,28 @@ def test_submit_two_process_mesh(cluster):
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         import jax
         jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
         import numpy as np
         import cycloneml_tpu.mesh as mesh_mod
         master = os.environ["CYCLONE_MASTER_URL"]
         rt = mesh_mod.get_or_create(master, n_replicas=2)
         from cycloneml_tpu.parallel import collectives
         import jax.numpy as jnp
-        x = rt.device_put_sharded_rows(np.ones(8, dtype=np.float64))
-        total = collectives.tree_aggregate(
-            lambda v: jnp.sum(v), rt, x)(x)
+        rng = np.random.RandomState(7)
+        vals = rng.randn(8)
+        x = rt.device_put_sharded_rows(vals)
+        hier = collectives.tree_aggregate(
+            lambda v: jnp.sum(v), rt, x, depth=2)(x)
+        flat = collectives.tree_aggregate(
+            lambda v: jnp.sum(v), rt, x, depth=1)(x)
         pid = os.environ["CYCLONE_PROC_ID"]
         with open(os.path.join({str(tmp_path)!r}, f"mesh_{{pid}}.json"),
                   "w") as fh:
             json.dump({{"n_devices": rt.n_devices,
-                        "total": float(total)}}, fh)
+                        "n_processes": rt.n_processes,
+                        "dcn_aligned": rt.dcn_aligned,
+                        "hier": float(hier), "flat": float(flat),
+                        "expect": float(vals.sum())}}, fh)
     """))
     env = {k: "" for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
     app_id = submit_app(m.address, str(app), n_procs=2, env=env)
@@ -86,7 +97,15 @@ def test_submit_two_process_mesh(cluster):
     results = [__import__("json").load(open(tmp_path / f"mesh_{i}.json"))
                for i in range(2)]
     assert all(r["n_devices"] == 8 for r in results)
-    assert all(abs(r["total"] - 8.0) < 1e-9 for r in results)
+    # one replica row per process: every replica-axis psum is the DCN hop
+    assert all(r["n_processes"] == 2 and r["dcn_aligned"] for r in results)
+    for r in results:
+        # hierarchical vs flat: same sum, ulp-level (f64; only the
+        # reduction grouping differs), and both match the host answer
+        assert abs(r["hier"] - r["flat"]) <= 1e-12 * max(1.0, abs(r["hier"]))
+        assert abs(r["hier"] - r["expect"]) < 1e-9
+    # both processes observed the identical replicated result
+    assert results[0]["hier"] == results[1]["hier"]
 
 
 def test_cluster_app_joins_via_conf_path(cluster):

@@ -297,3 +297,96 @@ def test_kill_worker_detect_and_resume(ctx, tmp_path):
                 p.kill()
         server.stop()
         recv.stop()
+
+
+# -- in-process units: hierarchy + bootstrap (no subprocesses) ------------------
+
+def test_hierarchy_grid_auto_replicas_single_process(ctx):
+    """Auto (None/0) replicas = one row per process: in-process that is 1
+    replica row — every collective stays on the ICI stand-in."""
+    from cycloneml_tpu.multihost import hierarchy
+    devs = list(ctx.mesh_runtime.mesh.devices.ravel())
+    grid, n_rep = hierarchy.build_device_grid(devs, None, 1)
+    assert n_rep == 1 and grid.shape == (1, 8, 1)
+    assert hierarchy.dcn_aligned(grid)
+    d = hierarchy.describe(grid)
+    assert d == {"n_processes": 1, "dcn_aligned": True,
+                 "replicas": 1, "data": 8, "model": 1}
+
+
+def test_hierarchy_grid_explicit_replicas_and_errors(ctx):
+    """Explicit replicas are honoured (the single-process slice stand-in)
+    and the divisibility contract raises the classic message."""
+    from cycloneml_tpu.multihost import hierarchy
+    devs = list(ctx.mesh_runtime.mesh.devices.ravel())
+    grid, n_rep = hierarchy.build_device_grid(devs, 2, 1)
+    assert n_rep == 2 and grid.shape == (2, 4, 1)
+    assert hierarchy.local_replica_rows(grid, 0) == [0, 1]
+    with pytest.raises(ValueError, match="not divisible"):
+        hierarchy.build_device_grid(devs, 3, 1)
+
+
+def test_mesh_runtime_topology_properties(ctx):
+    """MeshRuntime surfaces the hierarchy: in-process = 1 process, DCN
+    aligned, not multihost."""
+    rt = ctx.mesh_runtime
+    assert rt.n_processes == 1
+    assert rt.n_replicas == 1
+    assert rt.dcn_aligned is True
+    assert rt.is_multihost is False
+    assert rt.process_index == 0
+
+
+def test_bootstrap_env_contract():
+    """from_env parses exactly the deploy launch env the Worker injects
+    (CYCLONE_MASTER_URL, or the conf channel seed) — and nothing else:
+    the single-process no-op path."""
+    from cycloneml_tpu.multihost import bootstrap
+    assert bootstrap.from_env({}) is None
+    assert bootstrap.from_env(
+        {"CYCLONE_MASTER_URL": "multihost[h0:1234,2,1]"}) == ("h0:1234", 2, 1)
+    assert bootstrap.from_env(
+        {"CYCLONE_CONF_cyclone__master": "multihost[10.0.0.2:555,4,3]"}) \
+        == ("10.0.0.2:555", 4, 3)
+    # non-multihost masters are the no-op path
+    assert bootstrap.from_env(
+        {"CYCLONE_MASTER_URL": "local-mesh[8]"}) is None
+    assert bootstrap.from_env(
+        {"CYCLONE_CONF_cyclone__master": "cyclone://h0:7077"}) is None
+
+
+def test_bootstrap_single_process_noop():
+    """In a plain in-core process nothing touches jax.distributed:
+    is_initialized stays False and barrier/shutdown are no-ops returning
+    False — every in-core fit is untouched by the multihost runtime."""
+    from cycloneml_tpu.multihost import bootstrap
+    assert bootstrap.is_initialized() is False
+    assert bootstrap.process_count() == 1
+    assert bootstrap.process_index() == 0
+    assert bootstrap.barrier() is False
+    assert bootstrap.shutdown() is False
+    assert bootstrap.abandon() is False
+    assert bootstrap.ensure_from_env() is False
+
+
+def test_bootstrap_probe_free_ports():
+    from cycloneml_tpu.multihost import bootstrap
+    ports = bootstrap.probe_free_ports(4)
+    assert len(ports) == len(set(ports)) == 4
+    assert all(1024 <= p <= 65535 for p in ports)
+
+
+def test_coordinator_port_preflight_raises_cleanly():
+    """A taken coordinator port is a classifiable RuntimeError BEFORE
+    jax.distributed ever sees it (the gRPC server would die natively):
+    the deploy master's relaunch machinery gets a clean failure."""
+    from cycloneml_tpu.multihost import bootstrap
+    with socket.socket() as blocker:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        with pytest.raises(RuntimeError, match="coordinator port"):
+            bootstrap._preflight_coordinator_port(f"127.0.0.1:{port}")
+    # a free port passes silently
+    free = bootstrap.probe_free_ports(1)[0]
+    bootstrap._preflight_coordinator_port(f"127.0.0.1:{free}")

@@ -600,3 +600,58 @@ def test_heartbeat_sender_stops_on_wrong_secret(monkeypatch):
         sender.stop()
     finally:
         server.stop()
+
+
+def test_heartbeat_wire_rtt_report(monkeypatch):
+    """The 5-token extended ping ``HB <id> <t> <trace|-> <rtt>`` lands the
+    worker's reported round trip in the RECEIVER (master-side straggler
+    lane); garbage rtt stays ERR, and '-' means no trace id."""
+    from cycloneml_tpu.parallel.resilience import HeartbeatServer
+
+    monkeypatch.delenv("CYCLONE_AUTH_SECRET", raising=False)
+    recv = HeartbeatReceiver(timeout_s=30.0)
+    server = HeartbeatServer(recv)
+    try:
+        assert _hb_roundtrip(server.address, "REG wr") == "OK"
+        rep = _hb_roundtrip(server.address, "HB wr 123.5 - 0.0042")
+        assert rep.split()[0] == "OK"  # extended reply carries t_server
+        assert recv.rtts() == {"wr": 0.0042}
+        assert recv.trace_ids() == {}  # '-' is the no-trace placeholder
+        rep = _hb_roundtrip(server.address, "HB wr 123.6 tr-abc 0.0099")
+        assert rep.split()[0] == "OK"
+        assert recv.rtts()["wr"] == 0.0099
+        assert recv.trace_ids() == {"wr": "tr-abc"}
+        # malformed rtt is the legacy ERR contract, and the sample is kept out
+        assert _hb_roundtrip(server.address, "HB wr 123.7 - junk") == "ERR"
+        assert recv.rtts()["wr"] == 0.0099
+        # only LIVE workers feed the lanes: an unregistered/expired
+        # sender's rtt never reaches the straggler detector
+        rep = _hb_roundtrip(server.address, "HB ghost 1.0 - 0.5")
+        assert rep.split()[0] == "EXPIRED"
+        assert "ghost" not in recv.rtts()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_sender_reports_rtt_to_receiver(monkeypatch):
+    """End to end: from the second ping on, the sender's measured RTT of
+    the PREVIOUS round trip arrives at the receiver — the data feeding
+    cross-host RTT skew comparison (observe/skew.py heartbeat.rtt)."""
+    import time
+    from cycloneml_tpu.parallel.resilience import (HeartbeatSender,
+                                                   HeartbeatServer)
+
+    monkeypatch.delenv("CYCLONE_AUTH_SECRET", raising=False)
+    recv = HeartbeatReceiver(timeout_s=30.0)
+    server = HeartbeatServer(recv)
+    sender = HeartbeatSender("wrtt", server.address, interval_s=0.05)
+    try:
+        deadline = time.time() + 10
+        while "wrtt" not in recv.rtts():
+            assert time.time() < deadline, "no RTT report arrived"
+            time.sleep(0.02)
+        rtt = recv.rtts()["wrtt"]
+        assert 0.0 <= rtt < 5.0  # a real loopback round trip
+    finally:
+        sender.stop()
+        server.stop()

@@ -470,3 +470,37 @@ def test_oocore_balanced_run_raises_no_straggler(ctx):
         skew.uninstall(det)
         if prev is not None:
             skew.install(prev)
+
+
+def test_master_side_rtt_skew_latches_straggler():
+    """ISSUE 13 satellite: the receiver's per-worker RTT lanes (fed by
+    the workers' reported round trips over the extended heartbeat wire)
+    are a real cross-lane straggler group — one worker whose RTT median
+    pulls away from the fleet latches EXACTLY ONE StragglerDetected,
+    which a MeshSupervisor subscription records as mitigation input."""
+    from cycloneml_tpu.observe import skew
+    from cycloneml_tpu.parallel.resilience import HeartbeatReceiver
+
+    det = SkewDetector(window=16, min_samples=4, mad_factor=4.0,
+                       rel_factor=1.5, min_gap_s=0.010)
+    events = []
+    det.subscribe(events.append)
+    prev = skew.install(det)
+    recv = HeartbeatReceiver(timeout_s=30.0)
+    try:
+        for i in range(8):
+            for w in ("w0", "w1", "w2"):
+                recv.note_rtt(w, 0.004 + 0.0002 * i)   # healthy fleet
+            recv.note_rtt("w3", 0.120)                 # congested host
+        stragglers = [e for e in events if isinstance(e, StragglerDetected)]
+        assert len(stragglers) == 1
+        assert stragglers[0].group == "heartbeat.rtt"
+        assert stragglers[0].position == "w3"
+        assert ("heartbeat.rtt", "w3") in det.stragglers()
+        # balanced fleets stay silent: no latch for the healthy workers
+        assert not any(s.position in ("w0", "w1", "w2") for s in stragglers)
+    finally:
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)
+        recv.stop()
