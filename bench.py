@@ -55,6 +55,10 @@ def hardware_meta():
         # and data (what a materialized X is stored as — bf16 by default)
         "dtype": str(np.dtype(compute_dtype())),
         "data_dtype": str(np.dtype(data_dtype())),
+        # the second rung: what an fp8-capable fit's X resolves to under
+        # the live conf (== data_dtype unless cyclone.data.dtype is
+        # auto8/float8)
+        "data_dtype_fp8": str(np.dtype(data_dtype(None, fp8_capable=True))),
         "peak_flops_per_device": peak_flops,
         "peak_hbm_bytes_per_s": peak_bw,
         "memory_stats_available": costs.memory_stats_available(),
@@ -191,6 +195,44 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
               f"data_dtype={data_dtype} (X alone is "
               f"{n * d * np.dtype(data_dtype).itemsize / 1e9:.3f} GB)",
               file=sys.stderr)
+    # per-tier sweep bytes at a small PROBE shape (lower-only; building
+    # three full-size datasets just to lower them would dwarf the bench):
+    # the ratios are shape-stable once X dominates the (n,)-temporaries,
+    # which d>=256 guarantees — the same ground truth `make bench-bytes`
+    # gates on
+    bytes_by_tier = {}
+    try:
+        from cycloneml_tpu.dataset.dataset import InstanceDataset
+        from cycloneml_tpu.dataset.instance import data_dtype as _dd
+        rngp = np.random.RandomState(0)
+        n_probe, d_probe = 4096, max(min(d, 256), 128)
+        xp = rngp.randn(n_probe, d_probe)
+        yp = (rngp.rand(n_probe) > 0.5).astype(np.float64)
+        from cycloneml_tpu.conf import DATA_DTYPE
+        saved_tier = str(ctx.conf.get(DATA_DTYPE))
+        try:
+            for tier in ("float32", "bfloat16", "float8"):
+                ctx.conf.set("cyclone.data.dtype", tier)
+                dsp = InstanceDataset.from_numpy(
+                    ctx, xp, yp, dtype=_dd(ctx.conf, fp8_capable=True))
+                c = costs.sweep_cost(
+                    dsp.tree_aggregate_fn(
+                        aggregators.binary_logistic_scaled(d_probe, True)),
+                    jnp.ones(d_probe, adt), jnp.zeros(d_probe, adt),
+                    jnp.zeros(d_probe + 1, adt), name=f"bench.sweep.{tier}")
+                if c.bytes_accessed_total:
+                    bytes_by_tier[tier] = c.bytes_accessed_total
+        finally:
+            # a mid-loop failure must not leave the rest of the BENCH
+            # run pinned to a probe tier
+            ctx.conf.set("cyclone.data.dtype", saved_tier)
+        if bytes_by_tier.get("float32"):
+            ratios = {t: round(v / bytes_by_tier["float32"], 4)
+                      for t, v in bytes_by_tier.items()}
+            print(f"info: per-tier sweep bytes (probe n={n_probe} "
+                  f"d={d_probe}): {ratios}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the probe must not fail BENCH
+        print(f"info: per-tier sweep probe failed: {e}", file=sys.stderr)
 
     lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
     t0 = time.perf_counter()
@@ -235,6 +277,9 @@ def bench_logreg_fit(n: int | None = None, d: int | None = None,
         "transfer_bytes": warm_profile.get("transfer_bytes", 0),
         "bytes_per_sweep": bytes_per_sweep,
         "data_dtype": data_dtype,
+        # per-tier ground truth at the probe shape (f32/bf16/fp8) — the
+        # storage-rung trajectory in one dict
+        "bytes_per_sweep_by_tier": bytes_by_tier,
     }
     phases.update(profile_cost_fields(warm_profile))
     print(f"info: phase breakdown: warm fit {phases['warm_fit_s']}s "
@@ -419,6 +464,58 @@ def bench_trace_overhead(n: int | None = None, d: int | None = None,
     return out
 
 
+def _serving_admission(d: int, budget_peaks: float = 4.0) -> dict:
+    """Admission capacity under the quantized predict tier: the largest
+    gang width whose single-row-bucket program peak fits a fixed HBM
+    budget, plain vs quantized — XLA memory-analysis ground truth (the
+    same ``observe/costs`` accounting the PR-8 admission path consults).
+    The budget is ``budget_peaks`` x the plain K=16 peak, so the two
+    counts are directly comparable; peaks grow ~linearly in K, so two
+    analyze() calls per mode suffice."""
+    import jax
+
+    from cycloneml_tpu.observe import costs
+    from cycloneml_tpu.serving.servable import (
+        _quantize_rows, stacked_linear_margins,
+        stacked_quantized_linear_margins,
+    )
+    rng = np.random.RandomState(3)
+    bucket = 1
+
+    def peak(k: int, quant: bool):
+        coefs = rng.randn(k, 1, d)
+        icpts = rng.randn(k, 1)
+        x0 = np.zeros((bucket, d))
+        if quant:
+            q = _quantize_rows(coefs, icpts, np.float64)
+            c = costs.analyze(jax.jit(stacked_quantized_linear_margins),
+                              (*q, x0), name=f"serve.adm.q{k}")
+        else:
+            c = costs.analyze(jax.jit(stacked_linear_margins),
+                              (coefs, icpts, x0), name=f"serve.adm.p{k}")
+        return c.peak_bytes
+
+    def admitted(quant: bool, budget: float) -> int:
+        base = peak(1, quant)
+        p17 = peak(17, quant)
+        if base is None or p17 is None or base > budget:
+            return 0
+        marginal = max((p17 - base) / 16.0, 1.0)
+        return 1 + int((budget - base) // marginal)
+
+    p16 = peak(16, False)
+    if not p16:
+        return {"admission_available": False}
+    budget = budget_peaks * p16
+    return {
+        "admission_available": True,
+        "admission_bucket": bucket,
+        "admission_budget_bytes": int(budget),
+        "admitted_models_plain": admitted(False, budget),
+        "admitted_models_quantized": admitted(True, budget),
+    }
+
+
 def bench_serving(d: int | None = None, n_requests: int | None = None,
                   n_threads: int | None = None):
     """The ``serving`` BENCH block: two fitted models behind the model
@@ -453,36 +550,65 @@ def bench_serving(d: int | None = None, n_requests: int | None = None,
     model_a = LogisticRegression(maxIter=15, regParam=0.01).fit(frame)
     model_b = LogisticRegression(maxIter=15, regParam=0.1).fit(frame)
 
-    srv = ModelServer(ctx=ctx, max_batch=max_batch, window_ms=window_ms)
-    srv.register("a", model_a)
-    srv.register("b", model_b)
     sizes = [1, 2, 3, 5, 8, 13]
     reqs = [(("a", "b")[i % 2], rng.randn(sizes[i % len(sizes)], d))
             for i in range(n_requests)]
-    it = iter(reqs)
-    it_lock = threading.Lock()
     errors: list = []
 
-    def client():
-        while True:
-            with it_lock:
-                job = next(it, None)
-            if job is None:
-                return
-            try:
-                srv.predict(job[0], job[1])
-            except Exception as e:  # noqa: BLE001 — reported in the block
-                errors.append(repr(e))
+    def storm(srv):
+        it = iter(reqs)
+        it_lock = threading.Lock()
 
-    threads = [threading.Thread(target=client) for _ in range(n_threads)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+        def client():
+            while True:
+                with it_lock:
+                    job = next(it, None)
+                if job is None:
+                    return
+                try:
+                    srv.predict(job[0], job[1])
+                except Exception as e:  # noqa: BLE001 — reported below
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    srv = ModelServer(ctx=ctx, max_batch=max_batch, window_ms=window_ms)
+    srv.register("a", model_a)
+    srv.register("b", model_b)
+    wall = storm(srv)
     stats = srv.stats()
     srv.stop()
+
+    # the QUANTIZED tier's leg: same models, same storm, fp8 coefficient
+    # codes + per-row scales in the predict programs
+    # (cyclone.serving.quantize) — p99 must hold while the per-bucket
+    # peaks (and so the HBM admission budget's model capacity) shrink
+    srv_q = ModelServer(ctx=ctx, max_batch=max_batch, window_ms=window_ms,
+                        quantize=True)
+    srv_q.register("a", model_a)
+    srv_q.register("b", model_b)
+    wall_q = storm(srv_q)
+    stats_q = srv_q.stats()
+    srv_q.stop()
+    lat_q = {}
+    for m in stats_q["models"].values():
+        for k2, v in m["latencyMs"].items():
+            lat_q[k2] = max(lat_q.get(k2, 0.0), v)
+    quantized = {
+        "requests_per_s": round(
+            stats_q["totals"]["requests"] / wall_q, 1),
+        "p50_ms": round(lat_q.get("p50", 0.0), 3),
+        "p99_ms": round(lat_q.get("p99", 0.0), 3),
+        "compiles": stats_q["totals"]["compiles"],
+    }
+    quantized.update(_serving_admission(d))
     totals = stats["totals"]
     lat_ms = {}
     for m in stats["models"].values():
@@ -507,8 +633,15 @@ def bench_serving(d: int | None = None, n_requests: int | None = None,
         "buckets": len(bucket_sizes(max_batch)),
         "models": totals["models"],
         "shed": totals["shed"],
+        "quantized": quantized,
         "errors": errors[:3],
     }
+    print(f"info: serving quantized leg: "
+          f"{quantized['requests_per_s']} req/s, "
+          f"p99 {quantized['p99_ms']:.2f} ms, admitted gang models "
+          f"{quantized.get('admitted_models_plain')} plain -> "
+          f"{quantized.get('admitted_models_quantized')} quantized "
+          f"under the same budget", file=sys.stderr)
     print(f"info: serving {totals['requests']} requests "
           f"({totals['rows']} rows) in {wall:.2f}s: "
           f"{out['requests_per_s']} req/s, p50 {out['p50_ms']:.2f} ms, "

@@ -11,12 +11,13 @@ module-level guard (any mention of ``jax_enable_x64``) is required
 context for fp64 in jit-reachable code; absent that, it's flagged.
 
 **Narrow accumulation.** The other direction of the same boundary: bf16
-(``cyclone.data.dtype``) is legal STORAGE — design matrices live there —
-but the tier ends at the kernel: every cross-device reduction must carry
-the fp32 accumulator (``cyclone.compute.dtype``). A ``psum`` whose
-operand is narrow accumulates at storage width — 8 mantissa bits across
-the whole mesh — and is flagged regardless of any x64 guard (the guard
-legitimizes fp64, not narrow reductions).
+and fp8 (``cyclone.data.dtype``) are legal STORAGE — design matrices
+live there — but the tier ends at the kernel: every cross-device
+reduction must carry the fp32 accumulator (``cyclone.compute.dtype``).
+A ``psum`` whose operand is narrow accumulates at storage width — 8
+mantissa bits (bf16) or 3 (``float8_e4m3fn``) / 2 (``float8_e5m2``)
+across the whole mesh — and is flagged regardless of any x64 guard (the
+guard legitimizes fp64, not narrow reductions).
 
 Narrowness is a DATAFLOW fact, not a callsite pattern: the PR-6 audit
 had to hand-check five estimators precisely because the original rule
@@ -53,8 +54,16 @@ F64_STRINGS = {"float64", "f64", "complex128"}
 
 NARROW_DOTTED = {"jnp.bfloat16", "jax.numpy.bfloat16", "ml_dtypes.bfloat16",
                  "jnp.float16", "jax.numpy.float16", "np.float16",
-                 "numpy.float16"}
-NARROW_STRINGS = {"bfloat16", "bf16", "float16", "f16"}
+                 "numpy.float16",
+                 # the fp8 storage rung: 3 (e4m3) / 2 (e5m2) mantissa bits
+                 # — a psum at this width is even less an accumulator
+                 # than bf16's 8
+                 "jnp.float8_e4m3fn", "jax.numpy.float8_e4m3fn",
+                 "ml_dtypes.float8_e4m3fn",
+                 "jnp.float8_e5m2", "jax.numpy.float8_e5m2",
+                 "ml_dtypes.float8_e5m2"}
+NARROW_STRINGS = {"bfloat16", "bf16", "float16", "f16",
+                  "float8_e4m3fn", "float8_e5m2", "float8", "f8"}
 
 PSUM_CALLS = {"jax.lax.psum", "lax.psum", "psum", "psum_over_mesh",
               "collectives.psum_over_mesh", "jax.lax.pmean", "lax.pmean",

@@ -281,9 +281,20 @@ DATA_DTYPE = (
          "meaningful under x64 (silently canonicalized to f32 otherwise "
          "— graftlint JX004 polices that drift). Resolved when a dataset "
          "is materialized; mutable for the next dataset, not "
-         "retroactively.")
-    .check_value(lambda v: v in ("auto", "bfloat16", "float32", "float64"),
-                 "must be auto, bfloat16, float32 or float64")
+         "retroactively. The SECOND precision rung: 'auto8' resolves to "
+         "float8_e4m3fn (1 byte, per-column scales at accumulator width, "
+         "fp32 in-kernel accumulation) for fp8-capable estimators "
+         "(LogisticRegression, LinearRegression l-bfgs) and to bfloat16 "
+         "for everything else — except under x64, where it keeps the "
+         "parity tier like 'auto'; 'float8' forces the same split through "
+         "parity configs (the acceptance suites use it). fp8-capable fits "
+         "carry a pre-fit envelope probe that falls back to bf16 (event "
+         "PrecisionFallback + FitProfile.fp8_fallbacks) when e4m3's 3-bit "
+         "mantissa would break the documented accuracy envelope — see "
+         "docs/mixed-precision.md.")
+    .check_value(lambda v: v in ("auto", "auto8", "bfloat16", "float8",
+                                 "float32", "float64"),
+                 "must be auto, auto8, bfloat16, float8, float32 or float64")
     .mutable()
     .str_conf("auto")
 )
@@ -665,6 +676,22 @@ SERVING_MAX_RETRIES = (
     .int_conf(3)
 )
 
+SERVING_QUANTIZE = (
+    ConfigBuilder("cyclone.serving.quantize")
+    .doc("Serve QUANTIZED predict programs: coefficient tensors stored "
+         "fp8 (e4m3) with per-margin-row scales at serving dtype, "
+         "dequantized inside the compiled kernel (one elementwise "
+         "multiply — the per-row reduction stays independent of the "
+         "batch dim, so bucket padding remains bitwise-neutral). Cuts "
+         "each bucket program's parameter HBM ~4-8x, so the PR-5/PR-8 "
+         "admission path fits strictly more gang models under the same "
+         "cyclone.memory.budgetFraction. Margins round to e4m3's 3-bit "
+         "mantissa (~6 percent relative per coefficient) — predictions at the "
+         "decision boundary can flip; see docs/serving.md for the "
+         "envelope. Off by default.")
+    .bool_conf(False)
+)
+
 OOCORE_MODE = (
     ConfigBuilder("cyclone.oocore.mode")
     .doc("Out-of-core streaming fit mode (oocore/): 'auto' (default) keeps "
@@ -703,6 +730,18 @@ OOCORE_PREFETCH_DEPTH = (
          "jitter exceeds one shard's compute time.")
     .check_value(lambda v: v >= 1, "must be >= 1")
     .int_conf(2)
+)
+
+OOCORE_SHUFFLE = (
+    ConfigBuilder("cyclone.oocore.shuffle")
+    .doc("Shuffle shard ORDER per streamed-SGD epoch (seeded permutation "
+         "keyed on the optimizer seed x step, so a fixed seed replays "
+         "exactly). The epoch's accumulated gradient is order-invariant "
+         "up to float summation order — parity against a fixed-order run "
+         "is pinned — but staged shards hit the device in permuted order, "
+         "the reference's sample-without-materialize story. Off keeps "
+         "the fixed sequential order.")
+    .bool_conf(False)
 )
 
 OOCORE_MAX_RETRIES = (

@@ -332,18 +332,96 @@ class PartitionedDataset:
 def _npz_pack(x: np.ndarray):
     """numpy's npz format silently drops extension dtypes — a bf16 block
     written directly loads back as raw ``|V2`` bytes. Pack narrow extension
-    floats as a uint16 bit-view plus a dtype tag (returned as
+    floats as an unsigned bit-view (uint16 for the 2-byte bf16 tier, uint8
+    for the 1-byte fp8 tier) plus a dtype tag (returned as
     ``(packed, dtype_str)``); plain float arrays pass through untagged."""
-    if np.dtype(x.dtype).kind == "V":
-        return x.view(np.uint16), str(x.dtype)
+    dt = np.dtype(x.dtype)
+    if dt.kind == "V" or str(dt).startswith("float8"):
+        view = np.uint8 if dt.itemsize == 1 else np.uint16
+        return x.view(view), str(x.dtype)
     return x, ""
 
 
 def _npz_unpack(x: np.ndarray, dtype_str) -> np.ndarray:
     tag = str(dtype_str)
-    if tag:
-        return x.view(np.dtype(tag))
-    return x
+    if not tag:
+        return x
+    try:
+        dt = np.dtype(tag)
+    except TypeError as e:
+        # a torn/corrupt tag must be a loud load error, never silently
+        # reinterpreted bytes
+        raise ValueError(
+            f"corrupt npz dtype tag {tag!r}: not a known dtype") from e
+    if dt.itemsize != x.dtype.itemsize:
+        raise ValueError(
+            f"corrupt npz dtype tag {tag!r}: itemsize {dt.itemsize} does "
+            f"not match the packed {x.dtype} payload")
+    return x.view(dt)
+
+
+def fp8_fallback(ds: "InstanceDataset", estimator: str,
+                 reason: str) -> "InstanceDataset":
+    """Leave the fp8 storage tier for THIS fit: dequantize to bf16 and
+    surface the decision — a ``PrecisionFallback`` event on the context
+    bus and a ``precision.fallback`` tracing instant (the
+    ``FitProfile.fp8_fallbacks`` counter). The estimator keeps training;
+    only the storage rung changes."""
+    from cycloneml_tpu.observe import tracing
+    from_dt = str(ds.x.dtype)
+    logger.warning("%s: falling back from %s to bfloat16 storage — %s",
+                   estimator, from_dt, reason)
+    tracing.instant("precision.fallback", estimator=estimator,
+                    reason=reason, from_dtype=from_dt)
+    bus = getattr(ds.ctx, "listener_bus", None)
+    if bus is not None:
+        from cycloneml_tpu.util.events import PrecisionFallback
+        try:
+            bus.post(PrecisionFallback(estimator=estimator,
+                                       from_dtype=from_dt,
+                                       to_dtype="bfloat16", reason=reason))
+        except Exception:
+            pass  # a stopped bus must not fail the fit
+    return ds.dequantized()
+
+
+def resolve_fp8_fit(ds: "InstanceDataset", stats,
+                    estimator: str) -> "InstanceDataset":
+    """The per-fit fp8 safety rail: run the cheap envelope probe
+    (``instance.fp8_probe_ok`` — condition/scale heuristics on the
+    one-pass Summarizer moments, zero extra data passes) and fall back to
+    bf16 storage when e4m3 would break the documented accuracy envelope.
+    No-op for non-quantized datasets."""
+    if ds.x_scale is None:
+        return ds
+    from cycloneml_tpu.dataset.instance import fp8_probe_ok
+    w_max = None
+    try:
+        w_host = ds.w_host()
+        if w_host is not None and len(w_host):
+            w_max = float(np.max(w_host))
+    except Exception:
+        w_max = None
+    reason = fp8_probe_ok(stats, w_max,
+                          probe_ratio=ds._fp8_probe_ratio)
+    if reason is None:
+        return ds
+    return fp8_fallback(ds, estimator, reason)
+
+
+@functools.lru_cache(maxsize=None)
+def _widen_prog(dtype_str: str):
+    """Jitted fp8 dequantization pass, cached per target dtype so repeated
+    fallbacks replay one compiled program per (shape, mesh)."""
+    import jax
+    import jax.numpy as jnp
+    dt = np.dtype(dtype_str)
+
+    @jax.jit
+    def widen(x, s):
+        return (x.astype(jnp.float32) * s[None, :]).astype(dt)
+
+    return widen
 
 
 class InstanceDataset:
@@ -354,11 +432,25 @@ class InstanceDataset:
     """
 
     def __init__(self, ctx, x, y, w, n_rows: int, n_features: int,
-                 valid_mask: Optional[np.ndarray] = None):
+                 valid_mask: Optional[np.ndarray] = None,
+                 x_scale: Optional[np.ndarray] = None):
         self.ctx = ctx
         self._x = x
         self._y = y
         self._w = w
+        # fp8 storage tier: per-column dequantization scales (float64,
+        # accumulator width — host-resident, (d,)). x holds e4m3 CODES;
+        # the real value is x * x_scale[None, :]. None for every wider
+        # tier. Consumers fold the scale into their replicated (d,)
+        # vectors (inv_std, kernel scale operands) — the wide X never
+        # re-materializes.
+        self._x_scale: Optional[np.ndarray] = (
+            np.asarray(x_scale, dtype=np.float64)
+            if x_scale is not None else None)
+        # materialization-time per-column absmax/std of the RAW data —
+        # the fp8 envelope probe's condition input (post-quantization
+        # stats cannot witness a collapsed column); rides the scales
+        self._fp8_probe_ratio: Optional[np.ndarray] = None
         self._host: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
         # (y, w) host twins kept when construction started from numpy —
         # estimators read label histograms/weights every fit, and a
@@ -403,7 +495,14 @@ class InstanceDataset:
                              self.n_rows,
                              self.n_features if n_features is None
                              else n_features,
-                             valid_mask=self._valid_mask)
+                             valid_mask=self._valid_mask,
+                             # quantization scales describe X: they follow
+                             # an unchanged X and are dropped with a
+                             # replaced one (the replacement is presumed
+                             # dequantized — see dequantized())
+                             x_scale=self._x_scale if x is None else None)
+        if x is None:
+            ds._fp8_probe_ratio = self._fp8_probe_ratio
         if y is None and w is None:
             ds._yw_host = self._yw_host
         # derived datasets SHARE unchanged device arrays with this one;
@@ -435,11 +534,18 @@ class InstanceDataset:
         return self
 
     def to_instance_dataset(self, features_col=None, label_col=None,
-                            weight_col=None, dtype=None) -> "InstanceDataset":
+                            weight_col=None, dtype=None,
+                            fp8_capable: bool = False) -> "InstanceDataset":
         """An InstanceDataset is already device-placed instance blocks:
         every estimator's ``frame.to_instance_dataset(...)`` bridge accepts
-        one transparently (column names and dtype are frame concepts and are
-        ignored — the data is used as placed)."""
+        one transparently (column names and dtype are frame concepts and
+        are ignored — the data is used as placed). A quantized (fp8)
+        dataset handed to a NON-capable estimator dequantizes to bf16
+        first — raw e4m3 codes must never be read as values."""
+        if self._x_scale is not None and not fp8_capable:
+            return fp8_fallback(
+                self, "to_instance_dataset",
+                "estimator is not fp8-capable; dequantizing its view")
         return self
 
     def y_host(self) -> np.ndarray:
@@ -504,6 +610,11 @@ class InstanceDataset:
                        np.asarray(self.w))
         extra = ({"valid_mask": self._valid_mask}
                  if self._valid_mask is not None else {})
+        if self._x_scale is not None:
+            # the codes are meaningless without their scales — spill both
+            extra["x_scale"] = self._x_scale
+            if self._fp8_probe_ratio is not None:
+                extra["x_probe_ratio"] = self._fp8_probe_ratio
         # y rides the data tier too when it carries a stacked label matrix
         # (fit_stacked derives y at X's dtype) — pack all three
         x_packed, x_dtype = _npz_pack(x)
@@ -530,6 +641,30 @@ class InstanceDataset:
         return self._x
 
     @property
+    def x_scale(self) -> Optional[np.ndarray]:
+        """Per-column fp8 dequantization scales (float64 host (d,)), or
+        None for every non-quantized tier. ``x`` stores codes; the value
+        is ``x * x_scale``."""
+        return self._x_scale
+
+    def dequantized(self, dtype=None) -> "InstanceDataset":
+        """A derived dataset with X dequantized out of the fp8 tier —
+        the per-fit bf16 FALLBACK path (``dtype`` defaults to bfloat16,
+        the next rung down). One elementwise device pass
+        (``codes.astype(f32) * scale -> dtype``); sharding is preserved
+        and y/w/metadata ride through ``derive``. No-op (self) when this
+        dataset is not quantized."""
+        if self._x_scale is None:
+            return self
+        import jax.numpy as jnp
+        if dtype is None:
+            import ml_dtypes
+            dtype = ml_dtypes.bfloat16
+        widen = _widen_prog(str(np.dtype(dtype)))
+        return self.derive(
+            x=widen(self.x, jnp.asarray(self._x_scale, jnp.float32)))
+
+    @property
     def y(self):
         self._restore_device()
         return self._y
@@ -542,11 +677,19 @@ class InstanceDataset:
     @classmethod
     def from_numpy(cls, ctx, x: np.ndarray, y: Optional[np.ndarray] = None,
                    w: Optional[np.ndarray] = None, dtype=None) -> "InstanceDataset":
-        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
+        from cycloneml_tpu.dataset.instance import (compute_dtype,
+                                                    data_dtype, is_fp8_dtype,
+                                                    quantize_fp8)
         if dtype is None:
             # X lands in the data tier (bf16 by default off-x64); y/w stay
             # at accumulator width — see blockify_arrays
             dtype = data_dtype(getattr(ctx, "conf", None))
+        x_scale = probe_ratio = None
+        if is_fp8_dtype(dtype):
+            # the fp8 rung quantizes at materialization: per-column scales
+            # keep every stored code finite (e4m3fn overflows to NaN) and
+            # fold into the consumers' replicated vectors at fit time
+            x, x_scale, probe_ratio = quantize_fp8(x, dtype)
         rt = ctx.mesh_runtime
         x_p, y_p, w_p, n = blockify_arrays(x, y, w, rt.data_parallelism,
                                            dtype=dtype,
@@ -555,7 +698,8 @@ class InstanceDataset:
                  rt.device_put_sharded_rows(x_p),
                  rt.device_put_sharded_rows(y_p),
                  rt.device_put_sharded_rows(w_p),
-                 n, x.shape[1])
+                 n, x.shape[1], x_scale=x_scale)
+        ds._fp8_probe_ratio = probe_ratio
         ds._yw_host = (y_p, w_p)
         return ds
 
@@ -736,6 +880,10 @@ class InstanceDataset:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         extra = ({"valid_mask": self._valid_mask}
                  if self._valid_mask is not None else {})
+        if self._x_scale is not None:
+            extra["x_scale"] = self._x_scale
+            if self._fp8_probe_ratio is not None:
+                extra["x_probe_ratio"] = self._fp8_probe_ratio
         x_packed, x_dtype = _npz_pack(np.asarray(self.x))
         y_packed, y_dtype = _npz_pack(np.asarray(self.y))
         w_packed, w_dtype = _npz_pack(np.asarray(self.w))
@@ -758,6 +906,11 @@ class InstanceDataset:
                  int(z["n_rows"]), int(z["n_features"]))
         if "valid_mask" in z:
             ds._valid_mask = z["valid_mask"]
+        if "x_scale" in z:
+            ds._x_scale = np.asarray(z["x_scale"], dtype=np.float64)
+        if "x_probe_ratio" in z:
+            ds._fp8_probe_ratio = np.asarray(z["x_probe_ratio"],
+                                             dtype=np.float64)
         return ds
 
     def valid_indices(self) -> np.ndarray:
@@ -810,17 +963,29 @@ class InstanceDataset:
                 local = ii - shard.astype(ii.dtype) * per
                 ok = (local >= 0) & (local < per)
                 rows = jnp.take(xl, jnp.clip(local, 0, per - 1), axis=0)
-                return jnp.where(ok[:, None], rows, 0)
+                # gathered rows ride the psum at ACCUMULATOR width: the
+                # reduction is exact (one shard contributes, the rest
+                # zeros) and fp8 codes refuse implicit promotion anyway
+                return jnp.where(ok[:, None], rows.astype(wl.dtype), 0)
 
             call = self._gather_call = self.tree_aggregate_fn(pick)
-        out = call(jnp.asarray(idx_pad))
-        return np.asarray(out)[:m]
+        out = np.asarray(call(jnp.asarray(idx_pad)))[:m]
+        if self._x_scale is not None:
+            # fp8 codes -> values at the host boundary (O(m * d), host)
+            out = out.astype(np.float64) * self._x_scale[None, :]
+        return out
 
     def to_numpy(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Unpadded host copies."""
+        """Unpadded host copies (fp8 codes dequantized — host readbacks
+        always see VALUES; only the device tier holds codes)."""
         if self._valid_mask is not None:
             m = self._valid_mask
-            return (np.asarray(self.x)[m], np.asarray(self.y)[m],
-                    np.asarray(self.w)[m])
-        n = self.n_rows
-        return (np.asarray(self.x)[:n], np.asarray(self.y)[:n], np.asarray(self.w)[:n])
+            x, y, w = (np.asarray(self.x)[m], np.asarray(self.y)[m],
+                       np.asarray(self.w)[m])
+        else:
+            n = self.n_rows
+            x, y, w = (np.asarray(self.x)[:n], np.asarray(self.y)[:n],
+                       np.asarray(self.w)[:n])
+        if self._x_scale is not None:
+            x = x.astype(np.float64) * self._x_scale[None, :]
+        return x, y, w

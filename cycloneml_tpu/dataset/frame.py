@@ -148,19 +148,27 @@ class MLFrame:
     def to_instance_dataset(self, features_col: str = "features",
                             label_col: Optional[str] = "label",
                             weight_col: Optional[str] = None,
-                            dtype=None) -> InstanceDataset:
+                            dtype=None,
+                            fp8_capable: bool = False) -> InstanceDataset:
         if dtype is None:
             # the design matrix lands in the DATA tier (bf16 by default
             # off-x64); labels/weights stay at accumulator width inside
-            # InstanceDataset.from_numpy
+            # InstanceDataset.from_numpy. fp8_capable is the second
+            # rung's opt-in: only estimators that fold the per-column
+            # dequant scales into their aggregator read may see e4m3
+            # codes — everyone else gets bf16 under the fp8 tiers
             from cycloneml_tpu.dataset.instance import data_dtype
-            dtype = data_dtype(getattr(self.ctx, "conf", None))
+            dtype = data_dtype(getattr(self.ctx, "conf", None),
+                               fp8_capable=fp8_capable)
         # cached per column selection: the frame is immutable, so repeated
         # fits on the same frame (grid search, CV, warmed benchmarks) reuse
         # one device placement instead of re-paying the host→device transfer
         # each time — the analog of the reference persisting its instance
-        # blocks once (LogisticRegression.scala:968 MEMORY_AND_DISK)
-        key = (features_col, label_col, weight_col, np.dtype(dtype).str)
+        # blocks once (LogisticRegression.scala:968 MEMORY_AND_DISK).
+        # Keyed on the dtype NAME: the fp8 extension dtypes share numpy's
+        # '|V1' struct str, and a quantized dataset must never be handed
+        # to a caller that asked for the bf16 rung
+        key = (features_col, label_col, weight_col, str(np.dtype(dtype)))
         ds = self._ds_cache.get(key)
         if ds is not None:
             return ds

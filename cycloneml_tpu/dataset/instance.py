@@ -38,14 +38,23 @@ def compute_dtype():
         return np.float32
 
 
-def data_dtype(conf=None):
+def data_dtype(conf=None, fp8_capable: bool = False):
     """The DATA-tier storage dtype (``cyclone.data.dtype``): what a
     materialized design matrix is stored as. Default ('auto') is bfloat16 —
     the sweeps are bandwidth-bound, so X's width IS the fit's speed — except
     under jax x64 (the parity/test config), where auto resolves to float64
     so golden suites see pre-tier numerics. Aggregators/kernels upcast to
     :func:`compute_dtype` INSIDE the kernel; nothing re-materializes X
-    wider than this. ``conf`` defaults to the active context's."""
+    wider than this. ``conf`` defaults to the active context's.
+
+    ``fp8_capable`` is the SECOND precision rung's opt-in: the 'float8'
+    and 'auto8' tiers resolve to ``float8_e4m3fn`` only for callers that
+    declare they understand quantized storage (per-column scales on the
+    dataset, dequant folded into the aggregator read — LogisticRegression
+    and the LinearRegression l-bfgs path). Everything else automatically
+    gets the bf16 rung under those tiers: an estimator that would read
+    raw e4m3 codes as values must never see them.
+    """
     from cycloneml_tpu.conf import DATA_DTYPE
     name = "auto"
     if conf is None:
@@ -62,6 +71,19 @@ def data_dtype(conf=None):
             return np.float64  # x64 parity runs keep the full-width tier
         import ml_dtypes
         return ml_dtypes.bfloat16
+    if name == "auto8":
+        # the fp8 twin of 'auto': parity (x64) runs stay full-width, and
+        # non-capable consumers land on the bf16 rung
+        if compute_dtype() is np.float64:
+            return np.float64
+        import ml_dtypes
+        return ml_dtypes.float8_e4m3fn if fp8_capable else ml_dtypes.bfloat16
+    if name == "float8":
+        # forced (test/measurement) form: fp8 even under x64 for capable
+        # callers; non-capable consumers get forced bf16, mirroring how
+        # 'bfloat16' forces the narrow tier through parity configs
+        import ml_dtypes
+        return ml_dtypes.float8_e4m3fn if fp8_capable else ml_dtypes.bfloat16
     if name == "bfloat16":
         import ml_dtypes
         return ml_dtypes.bfloat16
@@ -69,12 +91,114 @@ def data_dtype(conf=None):
 
 
 def is_narrow_dtype(dt) -> bool:
-    """True for sub-float32 storage dtypes (bf16/f16) — the tier boundary
-    where fp32 accumulation becomes mandatory (Micikevicius et al. 2018)."""
+    """True for sub-float32 storage dtypes (bf16/f16/fp8) — the tier
+    boundary where fp32 accumulation becomes mandatory (Micikevicius et
+    al. 2018)."""
     try:
         return np.dtype(dt).itemsize < 4
     except TypeError:
         return False
+
+
+#: largest finite float8_e4m3fn value. The e4m3fn encoding has NO inf —
+#: casting past ±448 produces NaN — so every fp8 materialization scales
+#: into this range first (see quantize_fp8).
+FP8_MAX = 448.0
+
+#: envelope-probe threshold (see fp8_probe_ok): per-column
+#: absmax/std above this predicts that e4m3's 3 mantissa bits inject
+#: more than ~2 sigma of rounding noise per standardized element, which
+#: breaks the documented coefficient envelope — the fit falls back to
+#: the bf16 rung instead.
+FP8_PROBE_RATIO = 32.0
+
+
+def is_fp8_dtype(dt) -> bool:
+    """True for the 1-byte float8 storage dtypes (e4m3fn / e5m2)."""
+    try:
+        return str(np.dtype(dt)).startswith("float8")
+    except TypeError:
+        return False
+
+
+def quantize_fp8(x: np.ndarray, dtype=None):
+    """Quantize a host design matrix to fp8 with PER-COLUMN scales.
+
+    Returns ``(x8, scale, probe_ratio)`` where ``x8[i, j] ~=
+    x[i, j] / scale[j]`` as ``float8_e4m3fn``, ``scale`` is float64 at
+    the accumulator tier — ``scale[j] = absmax_j / FP8_MAX`` (1.0 for
+    all-zero columns), so every stored code is finite by construction
+    (the e4m3fn overflow value is NaN, not a saturate) — and
+    ``probe_ratio`` is the per-column ``absmax_j / std_j`` of the RAW
+    data, the envelope probe's condition heuristic. It must be captured
+    HERE: once quantized, a near-constant offset column collapses to one
+    code and its post-quantization std can no longer witness the damage.
+    Dequantization never materializes: the per-column scale folds into
+    the replicated (d,) vectors every consumer already carries —
+    ``inv_std`` for the scaled aggregators, the kernel-side ``scale``
+    operand for gramian/kmeans — so HBM only ever sees the 1-byte codes.
+    """
+    import ml_dtypes
+    if dtype is None:
+        dtype = ml_dtypes.float8_e4m3fn
+    xf = np.asarray(x, dtype=np.float64)
+    if xf.shape[0]:
+        absmax = np.max(np.abs(xf), axis=0)
+        std = np.std(xf, axis=0)
+    else:
+        absmax = np.zeros(xf.shape[1])
+        std = np.zeros(xf.shape[1])
+    scale = np.where(absmax > 0, absmax / FP8_MAX, 1.0)
+    probe_ratio = np.where(std > 0, absmax / np.where(std > 0, std, 1.0),
+                           0.0)
+    x8 = (xf / scale[None, :]).astype(dtype)
+    return x8, scale, probe_ratio
+
+
+def fp8_probe_ok(stats, w_max: Optional[float] = None,
+                 probe_ratio: Optional[np.ndarray] = None) -> Optional[str]:
+    """The cheap pre-fit envelope probe: decide from already-harvested
+    statistics whether e4m3 storage will hold the documented accuracy
+    envelope, WITHOUT another data pass.
+
+    Two heuristics, both about where the 3-bit mantissa breaks:
+
+    - **scale spread**: after standardization the per-element rounding
+      noise is ~``2^-4 * absmax_j / std_j`` sigmas; columns whose absmax
+      dwarfs their std (near-constant offsets, wild outliers) push that
+      past any useful envelope. The ratio comes from the
+      materialization-time RAW moments when available (``probe_ratio``
+      from :func:`quantize_fp8` — post-quantization stats cannot witness
+      a collapsed column), else from the Summarizer moments in
+      ``stats``. Columns with zero variance are exempt — standardization
+      drops them entirely.
+    - **multiplier overflow**: the backward sweep quantizes the per-row
+      multiplier ``w * residual`` to e4m3 in-kernel; weights beyond the
+      e4m3 range would overflow to NaN mid-fit.
+
+    Returns ``None`` when fp8 is safe, else a human-readable reason (the
+    ``PrecisionFallback`` event carries it verbatim).
+    """
+    if probe_ratio is not None:
+        ratio = np.asarray(probe_ratio, dtype=np.float64)
+        live = ratio > 0
+    else:
+        std = np.asarray(stats.std, dtype=np.float64)
+        absmax = np.maximum(np.abs(np.asarray(stats.max)),
+                            np.abs(np.asarray(stats.min)))
+        live = std > 0
+        ratio = np.where(live, absmax / np.where(live, std, 1.0), 0.0)
+    if live.any():
+        worst = float(ratio[live].max())
+        if worst > FP8_PROBE_RATIO:
+            j = int(np.argmax(np.where(live, ratio, -np.inf)))
+            return (f"column {j} has absmax/std {worst:.1f} > "
+                    f"{FP8_PROBE_RATIO:g}: e4m3 rounding would exceed the "
+                    f"documented envelope after standardization")
+    if w_max is not None and w_max > FP8_MAX:
+        return (f"max instance weight {w_max:.1f} > {FP8_MAX:g}: the "
+                f"backward multiplier would overflow e4m3's finite range")
+    return None
 
 
 @dataclass

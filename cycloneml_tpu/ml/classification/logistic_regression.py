@@ -202,9 +202,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             # the reference trains transparently on sparse vectors; here
             # the sparse tier has its own fit path (ELL/hybrid aggregators)
             return self._fit_sparse(frame)
+        # fp8-capable: the scaled aggregators fold the per-column dequant
+        # scales into inv_std, so this fit may ride the e4m3 rung of the
+        # data tier (cyclone.data.dtype=auto8/float8)
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"),
-            self.get("weightCol") or None)  # f64 under x64 config, else f32
+            self.get("weightCol") or None, fp8_capable=True)
         return self._fit_dataset(ds)
 
     # -- stacked (model-axis) fits -------------------------------------------
@@ -257,7 +260,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             raise ValueError("stacked fits are dense-tier only")
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"),
-            self.get("weightCol") or None)
+            self.get("weightCol") or None, fp8_capable=True)
         if y_stack is None and reg_params is None:
             raise ValueError("fit_stacked needs y_stack or reg_params")
         if y_stack is None:
@@ -285,6 +288,9 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
 
         d = ds.n_features
         stats = Summarizer.summarize(ds)
+        from cycloneml_tpu.dataset.dataset import resolve_fp8_fit
+        ds = resolve_fp8_fit(ds, stats, "LogisticRegression(stacked)")
+        fp8_scale = ds.x_scale
         features_std = stats.std
         weight_sum = stats.weight_sum
         fit_intercept = self.get("fitIntercept")
@@ -292,6 +298,10 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         fit_with_mean = fit_intercept  # bounds are excluded by eligibility
         inv_std = inv_std_vector(features_std)
         scaled_mean = stats.mean * inv_std if fit_with_mean else np.zeros(d)
+        # fp8: dequant folds into the aggregator-side inv_std (see
+        # _fit_dataset); unscaling below keeps the original
+        inv_std_agg = inv_std * fp8_scale if fp8_scale is not None \
+            else inv_std
 
         n_coef = d + (1 if fit_intercept else 0)
         x0 = np.zeros((n_models, n_coef))
@@ -307,8 +317,14 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         # the stacked (n_pad, K) label matrix rides the dataset's row
         # sharding in the data-tier dtype ({0, 1} is exact in bf16, and at
         # large K the stack is a real per-sweep byte cost); X itself is
-        # SHARED via derive — no second feature copy exists
+        # SHARED via derive — no second feature copy exists. Under the
+        # fp8 tier the stack stays at the bf16 rung: labels mix
+        # elementwise with f32 margins, and jax (deliberately) refuses
+        # implicit 8-bit float promotion
         xdt = np.dtype(str(ds.x.dtype))
+        if fp8_scale is not None:
+            import ml_dtypes
+            xdt = np.dtype(ml_dtypes.bfloat16)
         y_pad = np.zeros((len(ds.y_host()), n_models), dtype=xdt)
         valid = ds.valid_indices()
         for kk in range(n_models):
@@ -330,7 +346,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         loss_fn = StackedDistributedLossFunction(
             ds_stacked, agg, n_models, reg=reg_params, l2_scale=l2s,
             weight_sum=weight_sum,
-            extra_args=(jnp.asarray(inv_std.astype(adt)),
+            extra_args=(jnp.asarray(inv_std_agg.astype(adt)),
                         jnp.asarray(scaled_mean.astype(adt))))
 
         from cycloneml_tpu.conf import LBFGS_DEVICE_CHUNK
@@ -343,6 +359,13 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                                  tol=self.get("tol"),
                                  chunk=max(chunk, 1))
         res = opt.minimize(loss_fn, x0)
+        if fp8_scale is not None \
+                and not np.all(np.isfinite(np.asarray(res.x))):
+            from cycloneml_tpu.dataset.dataset import fp8_fallback
+            return self.fit_stacked(
+                fp8_fallback(ds, "LogisticRegression(stacked)",
+                             "non-finite fp8 solution"),
+                y_stack=y_stack, reg_params=reg_params)
         n_unconverged = sum(
             1 for r in res.converged_reasons if r == "max iterations reached")
         if n_unconverged:
@@ -495,6 +518,12 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         # streamed datasets carry their Summarizer moments and the label
         # histogram from the shard WRITE pass — no stats epoch is paid
         stats = ds.summary() if streamed else Summarizer.summarize(ds)
+        if not streamed:
+            # fp8 safety rail: the envelope probe may swap the quantized
+            # dataset for its bf16 dequantization (event + profile field)
+            from cycloneml_tpu.dataset.dataset import resolve_fp8_fit
+            ds = resolve_fp8_fit(ds, stats, "LogisticRegression")
+        fp8_scale = getattr(ds, "x_scale", None)
         features_std = stats.std
         weight_sum = stats.weight_sum
 
@@ -562,6 +591,13 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         from cycloneml_tpu.ml.optim.loss import inv_std_vector
         inv_std = inv_std_vector(features_std)
         scaled_mean = stats.mean * inv_std if fit_with_mean else None
+        # fp8 tier: dequantization folds into the replicated inv_std the
+        # aggregators already carry — x̂ = (codes∘scale − μ)/σ =
+        # codes∘(scale/σ) − μ/σ, so the AGGREGATOR sees scale∘inv_std
+        # while scaled_mean (μ/σ) and the final unscaling (β/σ) keep the
+        # original inv_std. The wide X never re-materializes.
+        inv_std_agg = inv_std * fp8_scale if fp8_scale is not None \
+            else inv_std
 
         if is_multinomial:
             # always the scaled aggregator: the TP/pallas alternatives are
@@ -604,7 +640,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             x_tp = fs.feature_sharded_put(rt, fs.accumulator_width(ds.x))
             loss_fn = fs.FeatureShardedLossFunction(
                 rt, x_tp, ds.y, ds.w, d, fit_intercept, l2_fn,
-                weight_sum, ctx=ds.ctx, inv_std=inv_std,
+                weight_sum, ctx=ds.ctx, inv_std=inv_std_agg,
                 scaled_mean=mu_or_zero)
         else:
             import jax.numpy as jnp
@@ -614,7 +650,7 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
             # corrections (inv_std∘g − μ̂·Σmult) must not round through the
             # bf16 data tier
             adt = compute_dtype()
-            extras = (jnp.asarray(inv_std.astype(adt)),
+            extras = (jnp.asarray(inv_std_agg.astype(adt)),
                       jnp.asarray(mu_or_zero.astype(adt)))
             if streamed:
                 # the streamed twin: SAME aggregator, same extras, same
@@ -693,6 +729,14 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 return self._fit_dataset(sds)
             finally:
                 sds.close()
+
+        if fp8_scale is not None and not np.all(np.isfinite(state.x)):
+            # e4m3 has no inf: an overflowing fp8 fit surfaces as NaN in
+            # the solution — refit on the bf16 rung (belt to the probe's
+            # braces; same event + profile surfacing)
+            from cycloneml_tpu.dataset.dataset import fp8_fallback
+            return self._fit_dataset(fp8_fallback(
+                ds, "LogisticRegression", "non-finite fp8 solution"))
 
         sol = state.x
         if is_multinomial:

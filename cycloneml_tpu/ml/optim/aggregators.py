@@ -67,14 +67,29 @@ def _tier_dot(a, b, prec, acc=None):
 
     Full-width (f32/f64) operands take the pre-tier path UNCHANGED — the
     ``cyclone.data.dtype=float32`` opt-out is bit-identical by
-    construction. When either operand is narrow (bf16/f16 data tier), the
-    other is cast DOWN to the storage width (dtype promotion would
+    construction. When either operand is narrow (bf16/f16/fp8 data tier),
+    the other is cast DOWN to the storage width (dtype promotion would
     otherwise upcast — and re-materialize — the whole X block) and the dot
     accumulates into ``acc`` via ``preferred_element_type``: narrow
     multiplicands, fp32 accumulation — the Micikevicius et al. (2018)
     mixed-precision recipe, natively an MXU bf16×bf16→f32 matmul on TPU.
     ``acc`` defaults to the full-width operand's dtype (the optimizer's
     accumulator tier: f32, or f64 under x64).
+
+    The fp8 rung (``float8_e4m3fn``) rides the SAME recipe one step
+    narrower: X holds per-column-scaled e4m3 codes (the scale folds into
+    the replicated ``inv_std`` operand — dequant-in-kernel, no wide X
+    anywhere), and the vector operand (coefficients forward, multipliers
+    backward) is cast to e4m3 per evaluation. That cast is the fp8 tier's
+    accuracy boundary — ~2^-4 relative rounding per element, NaN past
+    ±448 (e4m3fn has no inf) — which is exactly what the per-fit envelope
+    probe (``instance.fp8_probe_ok``) and the bf16 fallback police; the
+    byte ledger (``costs.sweep_cost``) is why no in-graph clamp exists
+    here: any extra (n,)-pass would cost the very bytes the tier saves.
+    When the two operands sit in DIFFERENT narrow tiers (fp8 X against a
+    bf16 label stack), the dot runs at the NARROWEST width — bf16→e4m3 is
+    the only lossy direction, and it is the one the recipe already takes
+    for f32 operands.
     """
     if not (_narrow(a.dtype) or _narrow(b.dtype)):
         return jnp.dot(a, b, precision=prec)
@@ -82,7 +97,11 @@ def _tier_dot(a, b, prec, acc=None):
         acc = b.dtype if _narrow(a.dtype) else a.dtype
         if _narrow(acc):
             acc = jnp.float32
-    nt = a.dtype if _narrow(a.dtype) else b.dtype
+    if _narrow(a.dtype) and _narrow(b.dtype):
+        nt = a.dtype if (jnp.dtype(a.dtype).itemsize
+                         <= jnp.dtype(b.dtype).itemsize) else b.dtype
+    else:
+        nt = a.dtype if _narrow(a.dtype) else b.dtype
     return jnp.dot(a.astype(nt), b.astype(nt), precision=prec,
                    preferred_element_type=acc)
 
@@ -175,7 +194,7 @@ def _multinomial_logistic(d: int, k: int, fit_intercept: bool, prec) -> Agg:
         picked = jnp.take_along_axis(margins, y_idx[:, None], axis=1)[:, 0]
         loss = jnp.sum(w * (log_z - picked))
         probs = jax.nn.softmax(margins, axis=1)
-        onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
+        onehot = jax.nn.one_hot(y_idx, k, dtype=probs.dtype)  # {0,1} exact; fp8 x refuses implicit promotion
         mult = w[:, None] * (probs - onehot)                   # (bsz, k)
         gw = _tier_dot(mult.T, x, prec)                         # (k, d)
         if fit_intercept:
@@ -218,7 +237,7 @@ def _multinomial_logistic_scaled(d: int, k: int, fit_intercept: bool,
         picked = jnp.take_along_axis(margins, y_idx[:, None], axis=1)[:, 0]
         loss = jnp.sum(w * (log_z - picked))
         probs = jax.nn.softmax(margins, axis=1)
-        onehot = jax.nn.one_hot(y_idx, k, dtype=x.dtype)
+        onehot = jax.nn.one_hot(y_idx, k, dtype=probs.dtype)  # {0,1} exact; fp8 x refuses implicit promotion
         mult = w[:, None] * (probs - onehot)                     # (bsz, k)
         msum = jnp.sum(mult, axis=0)                             # (k,)
         gw = (_tier_dot(mult.T, x, prec) * inv_std[None, :]

@@ -81,9 +81,12 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         return self.set("solver", v)
 
     def _fit(self, frame: MLFrame) -> "LinearRegressionModel":
+        # fp8-capable: the l-bfgs path folds the per-column dequant scales
+        # into inv_std; the normal (WLS) solver is NOT fp8-eligible and
+        # dequantizes back to bf16 below (a visible PrecisionFallback)
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"),
-            self.get("weightCol") or None)
+            self.get("weightCol") or None, fp8_capable=True)
         return self._fit_dataset(ds)
 
     def _fit_dataset(self, ds: InstanceDataset) -> "LinearRegressionModel":
@@ -120,6 +123,12 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
                 sds.close()
 
         if solver == "normal":
+            if getattr(ds, "x_scale", None) is not None:
+                # the moment solver reads ds.x directly; e4m3 codes are
+                # not values — leave the fp8 rung, visibly
+                from cycloneml_tpu.dataset.dataset import fp8_fallback
+                ds = fp8_fallback(ds, "LinearRegression",
+                                  "solver='normal' is not fp8-eligible")
             # delegate to the WLS COMPONENT exactly as the reference does
             # (LinearRegression.scala:446-448: WeightedLeastSquares with
             # solverType=Auto, standardizeLabel=true) — population-weighted
@@ -145,6 +154,10 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
             return model
 
         stats = ds.summary() if streamed else Summarizer.summarize(ds)
+        if not streamed:
+            # fp8 safety rail: envelope probe, bf16 fallback on failure
+            from cycloneml_tpu.dataset.dataset import resolve_fp8_fit
+            ds = resolve_fp8_fit(ds, stats, "LinearRegression")
         x_mean, x_std = stats.mean, stats.std
         w_sum = stats.weight_sum
 
@@ -226,6 +239,12 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         scaled_mean = (x_mean * inv_std) if fit_intercept else np.zeros(d)
         y_mean_std = (y_mean / y_std) if fit_intercept else 0.0
         y_pars = np.array([1.0 / y_std, y_mean_std])
+        # fp8 tier: the per-column dequant scale folds into the
+        # aggregator-side inv_std (x̂ = codes∘(scale/σ) − μ/σ); the final
+        # unscaling keeps the original inv_std
+        fp8_scale = getattr(ds, "x_scale", None)
+        inv_std_agg = inv_std * fp8_scale if fp8_scale is not None \
+            else inv_std
         agg = (aggregators.least_squares_pallas_scaled(d)
                if use_fused_kernels(ds.ctx)
                else aggregators.least_squares_scaled(d))
@@ -234,7 +253,7 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         l1 = alpha * reg
         l2_fn = l2_regularization(l2, d, False, features_std=x_std,
                                   standardize=standardize) if l2 > 0 else None
-        extras = (jnp.asarray(inv_std.astype(adt)),
+        extras = (jnp.asarray(inv_std_agg.astype(adt)),
                   jnp.asarray(scaled_mean.astype(adt)),
                   jnp.asarray(y_pars.astype(adt)))
         from cycloneml_tpu.oocore import StreamingDataset
@@ -262,6 +281,13 @@ class LinearRegression(Predictor, _LinearRegressionParams, MLWritable, MLReadabl
         if state.converged_reason == "max iterations reached":
             logger.warning("LinearRegression did not converge in %d iterations",
                            self.get("maxIter"))
+        if fp8_scale is not None and not np.all(np.isfinite(state.x)):
+            # overflowed e4m3 surfaces as NaN — refit on the bf16 rung
+            from cycloneml_tpu.dataset.dataset import fp8_fallback
+            return self._solve_quasi_newton(
+                fp8_fallback(ds, "LinearRegression",
+                             "non-finite fp8 solution"),
+                stats, y_mean, y_std, reg, alpha)
 
         beta_hat = state.x  # standardized-space coefficients
         coef = beta_hat * inv_std * y_std

@@ -74,10 +74,18 @@ def _moments(x, y, w):
     # promotes to it; counts accumulated in a bf16 X's dtype would stop
     # being exact integers at 256 (8 mantissa bits)
     acc = w.dtype
+    if str(x.dtype).startswith("float8"):
+        # fp8 codes refuse implicit promotion (by design — jax makes the
+        # 8-bit cast explicit); the one-shot stats pass upcasts in-graph
+        # and _finalize rescales by the stored per-column scales
+        x = x.astype(acc)
     s1 = jnp.sum(wcol * x, axis=0)
     s2 = jnp.sum(wcol * x * x, axis=0)
-    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
-    pos_inf = jnp.asarray(jnp.inf, x.dtype)
+    # sentinels live at ACCUMULATOR width: the fp8 storage tier has no
+    # inf (e4m3fn overflows to NaN), and the promoted where/max is exact
+    # for every narrower tier anyway
+    neg_inf = jnp.asarray(-jnp.inf, acc)
+    pos_inf = jnp.asarray(jnp.inf, acc)
     return {
         "s1": s1,
         "s2": s2,
@@ -134,6 +142,23 @@ def _finalize(out, dataset: InstanceDataset) -> SummaryStats:
     w = float(out["w"])
     s1 = np.asarray(out["s1"], dtype=np.float64)
     s2 = np.asarray(out["s2"], dtype=np.float64)
+    mx = np.asarray(out["mx"], dtype=np.float64)
+    mn = np.asarray(out["mn"], dtype=np.float64)
+    l1 = np.asarray(out["l1"], dtype=np.float64)
+    scale = getattr(dataset, "x_scale", None)
+    if scale is not None:
+        # fp8 storage tier: the device pass summed e4m3 CODES; every
+        # per-column statistic dequantizes by the stored scale on the
+        # host — an O(d) rescale, no second data pass. Moments are then
+        # the moments OF the quantized values (x8 * scale), which is the
+        # self-consistent tier the fit actually trains on. nnz is exact
+        # on codes (quantized-to-zero == zero). Scales are positive, so
+        # max/min keep their order.
+        s1 = s1 * scale
+        s2 = s2 * scale * scale
+        mx = mx * scale
+        mn = mn * scale
+        l1 = l1 * scale
     mean = s1 / w
     # unbiased weighted variance — the reference's formula
     # (MultivariateOnlineSummarizer.variance): (s2 - w*mean^2) * w/(w - w2/w)
@@ -147,9 +172,9 @@ def _finalize(out, dataset: InstanceDataset) -> SummaryStats:
         variance=variance,
         count=int(round(float(out["cnt"]))),
         num_nonzeros=np.asarray(out["nnz"], dtype=np.float64),
-        max=np.asarray(out["mx"], dtype=np.float64),
-        min=np.asarray(out["mn"], dtype=np.float64),
-        norm_l1=np.asarray(out["l1"], dtype=np.float64),
+        max=mx,
+        min=mn,
+        norm_l1=l1,
         norm_l2=np.sqrt(s2),
         sum=s1,
         weight_sum=w,

@@ -73,6 +73,10 @@ class FitProfile:
     rebuilds: int = 0
     faults_injected: int = 0
     n_models: int = 1
+    # fp8 tier fallbacks during this fit: the envelope probe (or a
+    # non-finite fp8 solution) re-routed the fit to bf16 storage — see
+    # docs/mixed-precision.md and the PrecisionFallback event
+    fp8_fallbacks: int = 0
     # ring overflow during this tracer's lifetime (tracing.Tracer.dropped,
     # oldest-dropped): > 0 means the rollup undercounts — the profile saw
     # only the surviving window
@@ -177,6 +181,8 @@ class FitProfile:
                     p.cache_hits += 1
                 elif s.name == "cache.miss":
                     p.cache_misses += 1
+                elif s.name == "precision.fallback":
+                    p.fp8_fallbacks += 1
         # steady state = dispatches that did not pay a compile anywhere in
         # their subtree. A compile may nest more than one level down
         # (loss.eval dispatch → tree_aggregate collective → compile), so

@@ -70,7 +70,8 @@ class StreamingGradientDescent:
 
     def __init__(self, step_size: float = 1.0, num_iterations: int = 100,
                  reg_param: float = 0.0, mini_batch_fraction: float = 1.0,
-                 updater=None, convergence_tol: float = 0.001, seed: int = 0):
+                 updater=None, convergence_tol: float = 0.001, seed: int = 0,
+                 shuffle: Optional[bool] = None):
         from cycloneml_tpu.ml.optim.gradient_descent import SimpleUpdater
         self.step_size = step_size
         self.num_iterations = num_iterations
@@ -79,6 +80,11 @@ class StreamingGradientDescent:
         self.updater = updater or SimpleUpdater()
         self.convergence_tol = convergence_tol
         self.seed = seed
+        # per-epoch shard-order shuffling (cyclone.oocore.shuffle when
+        # None): a seeded permutation keyed on seed x step — fixed seed
+        # replays exactly; the epoch-accumulated gradient is
+        # order-invariant up to float summation order (parity-pinned)
+        self.shuffle = shuffle
 
     def optimize(self, sds: StreamingDataset, agg: Callable, x0: np.ndarray
                  ) -> Tuple[np.ndarray, list]:
@@ -92,6 +98,21 @@ class StreamingGradientDescent:
 
         frac = self.mini_batch_fraction
         seed = self.seed
+        shuffle = self.shuffle
+        if shuffle is None:
+            from cycloneml_tpu.conf import OOCORE_SHUFFLE
+            conf = getattr(sds.ctx, "conf", None)
+            shuffle = bool(conf.get(OOCORE_SHUFFLE)) \
+                if conf is not None else False
+
+        def epoch_order(step: int):
+            if not shuffle:
+                return None
+            # keyed on seed x step: every epoch walks its own seeded
+            # permutation, and a re-run at the same seed replays it
+            return np.random.RandomState(
+                (seed * 1000003 + step) % (2 ** 32)).permutation(
+                    sds.n_shards)
 
         if frac < 1.0:
             def fn(x, y, w, coef, step, shard):
@@ -115,13 +136,16 @@ class StreamingGradientDescent:
             with tracing.span("dispatch", "gd.step", evals=1, streamed=True):
                 if frac < 1.0:
                     # step + shard index ride as per-dispatch arguments so
-                    # each shard samples its own Bernoulli mask
+                    # each shard samples its own Bernoulli mask (keyed on
+                    # the TRUE shard index — shuffle-invariant)
                     out = loss_fn.sweep(
                         jnp.asarray(w, jnp.float32),
                         jnp.asarray(t, jnp.int32),
-                        per_shard=lambda i: (jnp.asarray(i, jnp.int32),))
+                        per_shard=lambda i: (jnp.asarray(i, jnp.int32),),
+                        order=epoch_order(t))
                 else:
-                    out = loss_fn.sweep(jnp.asarray(w, jnp.float32))
+                    out = loss_fn.sweep(jnp.asarray(w, jnp.float32),
+                                        order=epoch_order(t))
             count = float(out["count"])
             if count <= 0:
                 continue  # empty mini-batch: no update, no history entry

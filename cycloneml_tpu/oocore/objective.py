@@ -69,19 +69,23 @@ class StreamingLossFunction:
         self.epochs = 0
 
     # -- the streamed sweep ----------------------------------------------------
-    def sweep(self, *call_args, per_shard=None) -> dict:
+    def sweep(self, *call_args, per_shard=None, order=None) -> dict:
         """One epoch: stage every shard, dispatch the per-shard program,
         fold the psummed partials into host float64 sums. Returns the raw
         accumulated pytree (sums — the caller normalizes), mirroring what
         one in-core ``tree_aggregate`` dispatch returns. ``per_shard(i)``
         optionally supplies extra replicated arguments appended per shard
-        dispatch (the streamed SGD's shard-index mask key)."""
+        dispatch (the streamed SGD's shard-index mask key — keyed on the
+        TRUE shard index, so it is order-invariant). ``order`` optionally
+        permutes the staging order for this epoch (streamed-SGD
+        shuffling); the accumulated sums differ only by float summation
+        order."""
         import jax
         acc: Optional[dict] = None
         self.epochs += 1
         with tracing.span("dispatch", "oocore.sweep",
                           shards=self._sds.n_shards) as sweep_sp:
-            with ShardStream(self._sds) as stream:
+            with ShardStream(self._sds, order=order) as stream:
                 for i, xs, ys, ws in stream:
                     args = call_args if per_shard is None \
                         else (*call_args, *per_shard(i))

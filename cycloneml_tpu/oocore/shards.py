@@ -223,6 +223,16 @@ class StreamingDataset:
         dataset's own valid mask."""
         from cycloneml_tpu.conf import OOCORE_SHARD_ROWS
         conf = getattr(ds.ctx, "conf", None)
+        if getattr(ds, "x_scale", None) is not None:
+            # the streaming engine shards at the bf16 rung: the per-shard
+            # slices below read ds.x as VALUES, and fp8 e4m3 codes are
+            # not values — spilling them unscaled would train a silently
+            # per-column-mis-scaled model. Leave the fp8 tier visibly
+            # (PrecisionFallback event) before sharding.
+            from cycloneml_tpu.dataset.dataset import fp8_fallback
+            ds = fp8_fallback(
+                ds, "StreamingDataset.from_dataset",
+                "the streaming engine shards at the bf16 rung")
         if shard_rows is None:
             shard_rows = int(conf.get(OOCORE_SHARD_ROWS)) if conf is not None \
                 else 65536
@@ -262,10 +272,12 @@ class StreamingDataset:
         return len(self._shards)
 
     def to_instance_dataset(self, features_col=None, label_col=None,
-                            weight_col=None, dtype=None) -> "StreamingDataset":
+                            weight_col=None, dtype=None,
+                            fp8_capable: bool = False) -> "StreamingDataset":
         """Estimator bridge parity with :class:`InstanceDataset`: a
-        StreamingDataset is already placed (on disk); column/dtype concepts
-        do not apply."""
+        StreamingDataset is already placed (on disk); column/dtype
+        concepts (including the fp8 opt-in — shards stay at the bf16
+        rung) do not apply."""
         return self
 
     # -- one-pass statistics ---------------------------------------------------

@@ -53,7 +53,7 @@ class ShardStream:
     """
 
     def __init__(self, sds, depth: Optional[int] = None,
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None, order=None):
         from cycloneml_tpu.conf import (OOCORE_MAX_RETRIES,
                                         OOCORE_PREFETCH_DEPTH)
         conf = getattr(sds.ctx, "conf", None)
@@ -64,6 +64,16 @@ class ShardStream:
             max_retries = int(conf.get(OOCORE_MAX_RETRIES)) \
                 if conf is not None else 3
         self._sds = sds
+        # staging ORDER for this epoch (seeded permutation for streamed
+        # SGD shuffling); each yielded item still carries the TRUE shard
+        # index, so per-shard mask keys are order-invariant
+        if order is None:
+            self._order = list(range(sds.n_shards))
+        else:
+            self._order = [int(i) for i in order]
+            if sorted(self._order) != list(range(sds.n_shards)):
+                raise ValueError(
+                    f"order must be a permutation of range({sds.n_shards})")
         self._max_retries = max(int(max_retries), 0)
         self._q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
         self._stop = threading.Event()
@@ -78,7 +88,7 @@ class ShardStream:
         from cycloneml_tpu.parallel.resilience import (backoff_delay,
                                                        classify_failure)
         try:
-            for i in range(self._sds.n_shards):
+            for i in self._order:
                 attempt = 0
                 while True:
                     if self._stop.is_set():

@@ -69,11 +69,13 @@ def use_fused_kernels(ctx) -> bool:
 
 
 def _storage_width(x):
-    """Keep narrow (bf16/f16) DATA-tier blocks at storage width — the
-    whole point of the tier is that HBM sees 2 bytes per element — and
+    """Keep narrow (bf16/f16/fp8) DATA-tier blocks at storage width — the
+    whole point of the tier is that HBM sees 1-2 bytes per element — and
     cast full-width inputs to the kernels' f32 accumulator dtype. The
     kernels upcast narrow tiles to f32 INSIDE VMEM (a vector convert per
-    tile, never an HBM materialization)."""
+    tile, never an HBM materialization); fp8 tiles additionally apply
+    their per-column dequantization scale per VMEM block (the ``x_scale``
+    operand — one VPU multiply on a resident tile)."""
     from cycloneml_tpu.dataset.instance import is_narrow_dtype
     x = jnp.asarray(x)
     if is_narrow_dtype(x.dtype):
@@ -106,6 +108,15 @@ def _auto_row_tile(n: int, row_tile: int) -> int:
     return row_tile
 
 
+def _pad_scale(scale, d: int, d_pad: int):
+    """Per-column fp8 dequant scales as a (1, d_pad) f32 block (padding
+    columns carry 1.0 — their x entries are zero anyway)."""
+    s = jnp.asarray(scale, jnp.float32).reshape(-1)
+    if s.shape[0] != d:
+        raise ValueError(f"x_scale has {s.shape[0]} entries, expected {d}")
+    return jnp.pad(s, (0, d_pad - d), constant_values=1.0).reshape(1, d_pad)
+
+
 def _pad_rows_cols(x, y, w, row_tile: int):
     """Zero-pad rows to the tile multiple and features to the lane multiple;
     padding rows carry w=0 so they contribute nothing to any sum. The row
@@ -125,12 +136,15 @@ def _pad_rows_cols(x, y, w, row_tile: int):
 
 def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
                           interpret: Optional[bool] = None,
-                          row_tile: int = ROW_TILE) -> Dict[str, jnp.ndarray]:
+                          row_tile: int = ROW_TILE,
+                          x_scale=None) -> Dict[str, jnp.ndarray]:
     """Drop-in for the ``aggregators.binary_logistic`` block math: one pass
     over HBM computing {loss, grad, count} sums for the shard. Narrow
-    (bf16) data-tier blocks are read at storage width and upcast to the
-    f32 accumulator per VMEM tile — half the HBM traffic of an f32 sweep,
-    no wide X copy anywhere."""
+    (bf16/fp8) data-tier blocks are read at storage width and upcast to
+    the f32 accumulator per VMEM tile — half (bf16) or a quarter (fp8) of
+    the HBM traffic of an f32 sweep, no wide X copy anywhere. ``x_scale``
+    is the fp8 tier's per-column dequantization vector, applied in-kernel
+    per VMEM block."""
     if interpret is None:
         interpret = not pallas_available()
     dtype = jnp.float32
@@ -145,8 +159,10 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
     beta_p = jnp.pad(beta, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
 
-    kernel = functools.partial(_run_glm, kind="logistic", row_tile=row_tile,
-                               d_pad=d_pad, grid=grid, interpret=interpret)
+    kernel = functools.partial(
+        _run_glm, kind="logistic", row_tile=row_tile, d_pad=d_pad,
+        grid=grid, interpret=interpret,
+        scale=None if x_scale is None else _pad_scale(x_scale, d, d_pad))
     loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
                                  beta_p, b0, jnp.zeros((), dtype))
     g = grad_row[0, :d]
@@ -160,8 +176,8 @@ def fused_binary_logistic(x, y, w, coef, d: int, fit_intercept: bool = True,
 def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
                                  d: int, fit_intercept: bool = True,
                                  interpret: Optional[bool] = None,
-                                 row_tile: int = ROW_TILE
-                                 ) -> Dict[str, jnp.ndarray]:
+                                 row_tile: int = ROW_TILE,
+                                 x_scale=None) -> Dict[str, jnp.ndarray]:
     """Folded-standardization twin of :func:`fused_binary_logistic`: the
     kernel reads RAW feature rows — no standardized copy — because the
     scaling is algebra OUTSIDE the row pass:
@@ -192,8 +208,10 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
     x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
     beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
-    kernel = functools.partial(_run_glm, kind="logistic", row_tile=row_tile,
-                               d_pad=d_pad, grid=grid, interpret=interpret)
+    kernel = functools.partial(
+        _run_glm, kind="logistic", row_tile=row_tile, d_pad=d_pad,
+        grid=grid, interpret=interpret,
+        scale=None if x_scale is None else _pad_scale(x_scale, d, d_pad))
     loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
                                  beta_p, off, jnp.zeros((), dtype))
     msum = aux[0, 0]
@@ -207,8 +225,8 @@ def fused_binary_logistic_scaled(x, y, w, inv_std, scaled_mean, coef,
 
 def fused_least_squares_scaled(x, y, w, inv_std, scaled_mean, y_pars, coef,
                                d: int, interpret: Optional[bool] = None,
-                               row_tile: int = ROW_TILE
-                               ) -> Dict[str, jnp.ndarray]:
+                               row_tile: int = ROW_TILE,
+                               x_scale=None) -> Dict[str, jnp.ndarray]:
     """Fused least-squares loss/grad sweep — the kernel twin of
     ``aggregators.least_squares_scaled`` (the LinearRegression l-bfgs
     objective). The kernel reads RAW data-tier rows once (margin → residual
@@ -238,8 +256,10 @@ def fused_least_squares_scaled(x, y, w, inv_std, scaled_mean, y_pars, coef,
     x, y, w, n_pad, d_pad, row_tile = _pad_rows_cols(x, y, w, row_tile)
     beta_p = jnp.pad(sb, (0, d_pad - d)).reshape(1, d_pad)
     grid = (n_pad // row_tile,)
-    kernel = functools.partial(_run_glm, kind="squared", row_tile=row_tile,
-                               d_pad=d_pad, grid=grid, interpret=interpret)
+    kernel = functools.partial(
+        _run_glm, kind="squared", row_tile=row_tile, d_pad=d_pad,
+        grid=grid, interpret=interpret,
+        scale=None if x_scale is None else _pad_scale(x_scale, d, d_pad))
     loss, grad_row, aux = kernel(x, y.reshape(-1, 1), w.reshape(-1, 1),
                                  beta_p, off, y_pars[0])
     msum = aux[0, 0]
@@ -248,15 +268,29 @@ def fused_least_squares_scaled(x, y, w, inv_std, scaled_mean, y_pars, coef,
 
 
 def _run_glm(x, y, w, beta_p, b0, ys, *, kind, row_tile, d_pad, grid,
-             interpret):
+             interpret, scale=None):
     """Shared one-pass GLM row sweep: margin → per-row loss/multiplier →
     grad, with ``kind`` selecting the link ("logistic" softplus/sigmoid,
     "squared" residual). ``ys`` is the label scale (squared only; the
     logistic path carries a zero). X tiles arrive at STORAGE width (bf16
-    when the data tier is narrow) and upcast to the f32 accumulator in
-    VMEM — the bytes HBM sees per sweep are exactly the tier's."""
-    def kern(b0_ref, ys_ref, x_ref, y_ref, w_ref, beta_ref,
-             loss_ref, grad_ref, aux_ref, closs_ref, cgrad_ref, caux_ref):
+    or fp8 when the data tier is narrow) and upcast to the f32
+    accumulator in VMEM — the bytes HBM sees per sweep are exactly the
+    tier's. ``scale`` (optional, (1, d_pad)) is the fp8 tier's per-column
+    dequantization vector, applied to every upcast VMEM block (one VPU
+    broadcast-multiply per tile); ``scale=None`` compiles the pre-fp8
+    kernel byte-for-byte."""
+    has_scale = scale is not None
+
+    def kern(*refs):
+        if has_scale:
+            (b0_ref, ys_ref, x_ref, y_ref, w_ref, beta_ref, s_ref,
+             loss_ref, grad_ref, aux_ref,
+             closs_ref, cgrad_ref, caux_ref) = refs
+        else:
+            (b0_ref, ys_ref, x_ref, y_ref, w_ref, beta_ref,
+             loss_ref, grad_ref, aux_ref,
+             closs_ref, cgrad_ref, caux_ref) = refs
+            s_ref = None
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -272,6 +306,9 @@ def _run_glm(x, y, w, beta_p, b0, ys, *, kind, row_tile, d_pad, grid,
         # fp32 accumulator tier from here on: the convert is a VPU op on a
         # VMEM-resident tile, not an HBM materialization
         xv = x_ref[:].astype(jnp.float32)
+        if s_ref is not None:
+            # fp8 dequant per VMEM block: codes * per-column scale
+            xv = xv * s_ref[:]
         yv = y_ref[:]          # (T, 1) — Mosaic rejects 1-D blocks that
         wv = w_ref[:]          # don't align to the T(1024) XLA layout
         # matvecs with a width-1 output don't lower to the MXU (Mosaic:
@@ -305,17 +342,22 @@ def _run_glm(x, y, w, beta_p, b0, ys, *, kind, row_tile, d_pad, grid,
             comp[:] = (t - acc[:]) - yk
             acc[:] = t
 
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda i: (0, 0)),          # b0 / -offset
+        pl.BlockSpec((1, 1), lambda i: (0, 0)),          # label scale
+        pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
+        pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+        pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+        pl.BlockSpec((1, d_pad), lambda i: (0, 0)),      # beta
+    ]
+    args = [b0.reshape(1, 1), ys.reshape(1, 1), x, y, w, beta_p]
+    if has_scale:
+        in_specs.append(pl.BlockSpec((1, d_pad), lambda i: (0, 0)))
+        args.append(scale)
     outs = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # b0 / -offset
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # label scale
-            pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, d_pad), lambda i: (0, 0)),      # beta
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0)),
             pl.BlockSpec((1, d_pad), lambda i: (0, 0)),
@@ -333,20 +375,23 @@ def _run_glm(x, y, w, beta_p, b0, ys, *, kind, row_tile, d_pad, grid,
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
         ],
         interpret=interpret,
-    )(b0.reshape(1, 1), ys.reshape(1, 1), x, y, w, beta_p)
+    )(*args)
     return outs[:3]
 
 
 # -- fused KMeans assignment ----------------------------------------------------
 
 def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
-                        row_tile: int = ROW_TILE):
+                        row_tile: int = ROW_TILE, x_scale=None):
     """Nearest-center assignment: returns (best_idx (n,), min_dist² (n,)).
     Fuses ‖x‖² − 2x·cᵀ + ‖c‖² with the argmin so the (T, k) distance tile
     never leaves VMEM (ref: DistanceMeasure.findClosest:123). bf16 point
     blocks stay at storage width in HBM — the tile upcasts to f32 in VMEM
     for the distance accumulation, so narrowing the tier no longer costs a
-    full-X fp32 materialization per Lloyd step."""
+    full-X fp32 materialization per Lloyd step. fp8 point blocks pass
+    their per-column dequant vector as ``x_scale``, applied to every
+    upcast VMEM block before the distance math (centers stay f32 in
+    original space)."""
     if interpret is None:
         interpret = not pallas_available()
     x = _storage_width(x)
@@ -363,9 +408,18 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
     c_norm = jnp.concatenate(
         [jnp.sum(c_p[:k] * c_p[:k], axis=1),
          jnp.full((k_pad - k,), jnp.inf, jnp.float32)]).reshape(1, k_pad)
+    has_scale = x_scale is not None
+    s_p = _pad_scale(x_scale, d, d_pad) if has_scale else None
 
-    def kern(x_ref, c_ref, cn_ref, best_ref, dist_ref):
+    def kern(*refs):
+        if has_scale:
+            x_ref, c_ref, cn_ref, s_ref, best_ref, dist_ref = refs
+        else:
+            x_ref, c_ref, cn_ref, best_ref, dist_ref = refs
+            s_ref = None
         xv = x_ref[:].astype(jnp.float32)                      # (T, d_pad)
+        if s_ref is not None:
+            xv = xv * s_ref[:]          # fp8 dequant per VMEM block
         # HIGHEST = multi-pass f32 on the MXU; default bf16 multiplies lose
         # near-tie argmins at ~1e-4 relative distance (ref computes in f64)
         prod = jnp.dot(xv, c_ref[:].T,
@@ -376,14 +430,19 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
         best_ref[:] = jnp.argmin(d2, axis=1).astype(jnp.int32).reshape(-1, 1)
         dist_ref[:] = jnp.min(d2, axis=1).reshape(-1, 1)
 
+    in_specs = [
+        pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
+        pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0)),
+        pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
+    ]
+    args = [x_p, c_p, c_norm]
+    if has_scale:
+        in_specs.append(pl.BlockSpec((1, d_pad), lambda i: (0, 0)))
+        args.append(s_p)
     best, dist = pl.pallas_call(
         kern,
         grid=(n_pad // row_tile,),
-        in_specs=[
-            pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
-            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0)),
-            pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
@@ -393,20 +452,23 @@ def fused_kmeans_assign(x, centers, interpret: Optional[bool] = None,
             jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(x_p, c_p, c_norm)
+    )(*args)
     return best[:n, 0], jnp.maximum(dist[:n, 0], 0.0)
 
 
 # -- fused Gramian --------------------------------------------------------------
 
 def fused_gramian(x, w=None, interpret: Optional[bool] = None,
-                  row_tile: int = ROW_TILE):
+                  row_tile: int = ROW_TILE, x_scale=None):
     """XᵀX over row tiles, accumulated in a revisited VMEM block (ref:
     RowMatrix.computeGramianMatrix:130 — spr rank-1 updates become one MXU
     matmul per tile). bf16 blocks are read at storage width and upcast per
-    VMEM tile into the f32 accumulator. ``w`` (optional per-row weights)
-    masks padding/invalid rows by presence (w > 0) INSIDE the kernel — the
-    jnp path's ``x * (w > 0)`` row mask without the masked X copy."""
+    VMEM tile into the f32 accumulator; fp8 blocks additionally apply
+    their per-column ``x_scale`` to each upcast VMEM block, so the
+    accumulated Gramian is already in value space. ``w`` (optional
+    per-row weights) masks padding/invalid rows by presence (w > 0)
+    INSIDE the kernel — the jnp path's ``x * (w > 0)`` row mask without
+    the masked X copy."""
     if interpret is None:
         interpret = not pallas_available()
     x = _storage_width(x)
@@ -419,8 +481,15 @@ def fused_gramian(x, w=None, interpret: Optional[bool] = None,
     d_pad = _pad_to(d, LANE)
     x_p = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
     w_p = jnp.pad(w, (0, n_pad - n)).reshape(-1, 1)
+    has_scale = x_scale is not None
+    s_p = _pad_scale(x_scale, d, d_pad) if has_scale else None
 
-    def kern(x_ref, w_ref, out_ref):
+    def kern(*refs):
+        if has_scale:
+            x_ref, w_ref, s_ref, out_ref = refs
+        else:
+            x_ref, w_ref, out_ref = refs
+            s_ref = None
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -428,17 +497,24 @@ def fused_gramian(x, w=None, interpret: Optional[bool] = None,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         xv = x_ref[:].astype(jnp.float32)
+        if s_ref is not None:
+            xv = xv * s_ref[:]          # fp8 dequant per VMEM block
         xv = xv * (w_ref[:] > 0).astype(jnp.float32)
         out_ref[:] += jnp.dot(xv.T, xv, preferred_element_type=jnp.float32,
                               precision=jax.lax.Precision.HIGHEST)
 
+    in_specs = [pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
+                pl.BlockSpec((row_tile, 1), lambda i: (i, 0))]
+    args = [x_p, w_p]
+    if has_scale:
+        in_specs.append(pl.BlockSpec((1, d_pad), lambda i: (0, 0)))
+        args.append(s_p)
     g = pl.pallas_call(
         kern,
         grid=(n_pad // row_tile,),
-        in_specs=[pl.BlockSpec((row_tile, d_pad), lambda i: (i, 0)),
-                  pl.BlockSpec((row_tile, 1), lambda i: (i, 0))],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((d_pad, d_pad), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32),
         interpret=interpret,
-    )(x_p, w_p)
+    )(*args)
     return g[:d, :d]

@@ -83,7 +83,12 @@ class ModelLane:
         self.is_gang = isinstance(servable, GangServable)
         self.buckets = bucket_sizes(server.max_batch)
         self.program = server._program_for(servable)
-        self._params = servable.params(server.dtype)
+        # quantized tier: fp8 codes + per-row scales instead of wide
+        # coefficients — the per-bucket program peak the admission path
+        # accounts shrinks with them
+        self._params = (servable.quantized_params(server.dtype)
+                        if server.quantize
+                        else servable.params(server.dtype))
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -143,9 +148,12 @@ class ModelLane:
             if harvest:
                 # keyed on the servable SIGNATURE (not the lane name):
                 # a second same-signature model must reuse the registry
-                # entry, not re-pay analyze()'s AOT compile per bucket
+                # entry, not re-pay analyze()'s AOT compile per bucket.
+                # The quantized tier forks the key — its per-bucket peak
+                # is the smaller one the admission path must account
                 self.pids[b] = costs.ensure(
-                    "serving", (self.servable.signature, b, str(x0.dtype)),
+                    "serving", (self.servable.signature, b, str(x0.dtype),
+                                self.server.quantize),
                     self.program, (*self._params, x0))
 
     # -- lifecycle -----------------------------------------------------------
@@ -473,6 +481,7 @@ class ModelLane:
         return {
             "buckets": list(self.buckets),
             "gang": self.servable.n_models if self.is_gang else 0,
+            "quantized": bool(self.server.quantize),
             "nFeatures": self.servable.n_features,
             **tallies,
             "latencyMs": {k: (v * 1e3 if k != "count" else v)

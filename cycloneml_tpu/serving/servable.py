@@ -85,6 +85,45 @@ def stacked_linear_margins(coefs, icpts, x):
     return jax.vmap(linear_margins, in_axes=(0, 0, None))(coefs, icpts, x)
 
 
+def quantized_linear_margins(coef8, scale, icpt, x):
+    """Quantized predict kernel (``cyclone.serving.quantize``): the
+    coefficient tensor arrives as fp8 (e4m3) CODES plus a per-margin-row
+    scale at serving dtype; dequantization is one elementwise multiply on
+    the (Km, d) tensor — O(model), not O(batch) — fused into the same
+    broadcast-multiply-reduce as :func:`linear_margins`. The per-row
+    reduction stays independent of the batch dimension, so bucket padding
+    remains bitwise-neutral (pinned by the quantized parity tests).
+    Coefficient HBM per program: 1 byte/element instead of 4-8 — the
+    admission-path win that lets the same budget admit more gang models.
+    """
+    import jax.numpy as jnp
+    c = coef8.astype(x.dtype) * scale[:, None]
+    return jnp.sum(x[:, None, :] * c[None, :, :], axis=-1) + icpt[None, :]
+
+
+def stacked_quantized_linear_margins(coef8s, scales, icpts, x):
+    """Gang twin of :func:`quantized_linear_margins`:
+    (K, Km, d) codes, (K, Km) scales, (K, Km) icpts, (B, d) ->
+    (K, B, Km)."""
+    import jax
+    return jax.vmap(quantized_linear_margins,
+                    in_axes=(0, 0, 0, None))(coef8s, scales, icpts, x)
+
+
+def _quantize_rows(coef: np.ndarray, icpt: np.ndarray, dtype):
+    """Per-margin-row fp8 quantization of a coefficient tensor: codes at
+    e4m3, scales at the serving dtype. Works on (Km, d) (serial) and
+    (K, Km, d) (gang) tensors — the scale is per LAST-BUT-ONE axis row."""
+    import ml_dtypes
+    from cycloneml_tpu.dataset.instance import FP8_MAX
+    c = np.asarray(coef, dtype=np.float64)
+    absmax = np.max(np.abs(c), axis=-1)
+    scale = np.where(absmax > 0, absmax / FP8_MAX, 1.0)
+    codes = (c / scale[..., None]).astype(ml_dtypes.float8_e4m3fn)
+    return (codes, scale.astype(dtype, copy=False),
+            np.asarray(icpt).astype(dtype, copy=False))
+
+
 class Servable:
     """One fitted model behind the serving interface.
 
@@ -127,6 +166,14 @@ class Servable:
         same-signature model shares one compiled program."""
         return (self._coef.astype(dtype, copy=False),
                 self._icpt.astype(dtype, copy=False))
+
+    def quantized_params(self, dtype):
+        """(coef8, scale, icpt) for the quantized predict tier: e4m3
+        codes with one scale per margin row (``scale_k = absmax_k /
+        FP8_MAX``, 1.0 for an all-zero row — every code finite by
+        construction), scale/icpt at the serving dtype. Intercepts stay
+        wide: they are O(Km) and additive."""
+        return _quantize_rows(self._coef, self._icpt, dtype)
 
     def margins_to_raw(self, margins: np.ndarray) -> np.ndarray:
         if self.raw_format == "pair":
@@ -179,6 +226,11 @@ class GangServable:
     def params(self, dtype) -> Tuple[np.ndarray, np.ndarray]:
         return (self._coefs.astype(dtype, copy=False),
                 self._icpts.astype(dtype, copy=False))
+
+    def quantized_params(self, dtype):
+        """(coef8s (K, Km, d), scales (K, Km), icpts (K, Km)) — the gang
+        form of :meth:`Servable.quantized_params`."""
+        return _quantize_rows(self._coefs, self._icpts, dtype)
 
     def postprocess(self, margins: np.ndarray) -> List[np.ndarray]:
         """Stacked margins (K, n, Km) -> per-model predictions

@@ -36,8 +36,9 @@ from cycloneml_tpu.serving.batcher import (ModelLane, ServingError,
                                            ServingOverloaded)
 from cycloneml_tpu.serving.buckets import bucket_sizes
 from cycloneml_tpu.serving.servable import (
-    GangServable, Servable, as_servable, linear_margins, serving_dtype,
-    stacked_linear_margins,
+    GangServable, Servable, as_servable, linear_margins,
+    quantized_linear_margins, serving_dtype, stacked_linear_margins,
+    stacked_quantized_linear_margins,
 )
 from cycloneml_tpu.util.logging import get_logger
 
@@ -63,10 +64,12 @@ class ModelServer:
                  window_ms: Optional[float] = None, dtype=None,
                  max_queue: Optional[int] = None,
                  shed_after_ms: Optional[float] = None,
-                 max_retries: Optional[int] = None, registry=None):
+                 max_retries: Optional[int] = None, registry=None,
+                 quantize: Optional[bool] = None):
         from cycloneml_tpu.conf import (
             SERVING_MAX_BATCH, SERVING_MAX_QUEUE, SERVING_MAX_RETRIES,
-            SERVING_SHED_AFTER_MS, SERVING_WINDOW_MS, CycloneConf,
+            SERVING_QUANTIZE, SERVING_SHED_AFTER_MS, SERVING_WINDOW_MS,
+            CycloneConf,
         )
         if ctx is None:
             from cycloneml_tpu.context import active_context
@@ -97,6 +100,11 @@ class ModelServer:
                                else self.conf.get(SERVING_MAX_RETRIES))
         self.dtype = (np.dtype(dtype) if dtype is not None
                       else serving_dtype(self.conf))
+        # quantized predict tier: fp8 coefficient codes + per-row scales
+        # (docs/serving.md) — smaller per-bucket peaks, so the admission
+        # path fits more gang models under the same budgetFraction
+        self.quantize = bool(quantize if quantize is not None
+                             else self.conf.get(SERVING_QUANTIZE))
         self._lanes: Dict[str, ModelLane] = {}
         # names whose warm-up is in flight: _install releases the lock
         # during the (slow) AOT warm-up, so the duplicate-name check must
@@ -109,17 +117,21 @@ class ModelServer:
     # -- program cache ----------------------------------------------------------
 
     def _program_for(self, servable: Union[Servable, GangServable]):
-        """One jitted kernel per (gang?, dtype) — shapes (and therefore
-        buckets) live in jit's own cache below this key, so the ledger of
-        real XLA compiles is ``program._cache_size()``."""
+        """One jitted kernel per (gang?, dtype, quantized?) — shapes (and
+        therefore buckets) live in jit's own cache below this key, so the
+        ledger of real XLA compiles is ``program._cache_size()``."""
         import jax
-        key = ("serving.linear_margins", isinstance(servable, GangServable),
-               self.dtype.str)
+        is_gang = isinstance(servable, GangServable)
+        key = ("serving.linear_margins", is_gang, self.dtype.str,
+               self.quantize)
         prog = _predict_programs.get(key)
         if prog is None:
-            kernel = (stacked_linear_margins
-                      if isinstance(servable, GangServable)
-                      else linear_margins)
+            if self.quantize:
+                kernel = (stacked_quantized_linear_margins if is_gang
+                          else quantized_linear_margins)
+            else:
+                kernel = (stacked_linear_margins if is_gang
+                          else linear_margins)
             prog = jax.jit(kernel)
             _predict_programs.put(key, prog)
         return prog
@@ -283,7 +295,8 @@ class ModelServer:
         return {"models": models, "totals": totals,
                 "maxBatch": self.max_batch,
                 "windowMs": self.window_s * 1e3,
-                "dtype": self.dtype.name}
+                "dtype": self.dtype.name,
+                "quantize": self.quantize}
 
     def _post_stats(self, force: bool = False) -> None:
         """Fold the rolled-up stats into the status store via the event

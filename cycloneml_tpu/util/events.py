@@ -97,6 +97,21 @@ class FitProfileCompleted(CycloneEvent):
 
 
 @dataclass
+class PrecisionFallback(CycloneEvent):
+    """An fp8-capable fit declined (or abandoned) the fp8 storage tier
+    and fell back to bf16: the pre-fit envelope probe
+    (``instance.fp8_probe_ok``) predicted e4m3's 3-bit mantissa breaks
+    the documented accuracy envelope, or the fp8 fit came back
+    non-finite. One event per fallback; the same decision lands in
+    ``FitProfile.fp8_fallbacks`` via a ``precision.fallback`` instant."""
+
+    estimator: str = ""
+    from_dtype: str = "float8_e4m3fn"
+    to_dtype: str = "bfloat16"
+    reason: str = ""
+
+
+@dataclass
 class MemoryBudgetExceeded(CycloneEvent):
     """The compile-time budget guard (observe/costs.py) predicted a
     program's peak HBM over ``cyclone.memory.budgetFraction`` × device

@@ -1,17 +1,20 @@
 """bench-bytes: the sweep-byte check, standalone.
 
 The executable form of the mixed-precision acceptance contract
-(docs/mixed-precision.md): the bf16 data tier must actually move fewer
-bytes per optimizer sweep, measured by XLA's own accounting
+(docs/mixed-precision.md): each narrower data tier must actually move
+fewer bytes per optimizer sweep, measured by XLA's own accounting
 (``observe/costs.sweep_cost`` — the same rollup bench.py and the tier-1
 regression test read), not inferred from dtype widths.
 
-1. build the SAME (n, d) dataset once per tier (float32, then bfloat16),
+1. build the SAME (n, d) dataset once per tier (float32, bfloat16,
+   float8),
 2. lower the binomial logistic sweep program at each tier (nothing
    executes — this is compile-time ground truth, CI-cheap),
-3. report ``{fp32_bytes, bf16_bytes, ratio}`` as one JSON line and exit
-   non-zero unless the bf16 sweep accesses < 60% of the fp32 sweep's
-   bytes (the ISSUE-6 acceptance threshold).
+3. report ``{fp32_bytes, bf16_bytes, fp8_bytes, ratios}`` as one JSON
+   line and exit non-zero unless the bf16 sweep accesses < 60% of the
+   fp32 sweep's bytes (the ISSUE-6 acceptance threshold) AND the fp8
+   sweep < 45% (the ISSUE-14 regression gate; the measured value at the
+   default shape is ~0.35).
 
 Run via ``make bench-bytes``. Shapes default to n=4096, d=256 (wide
 enough that X dominates the (n,)-vector temporaries); override with
@@ -33,18 +36,25 @@ if "xla_force_host_platform_device_count" not in _flags:
 import numpy as np  # noqa: E402
 
 THRESHOLD = 0.60
+THRESHOLD_FP8 = 0.45
 
 
 def sweep_bytes(ctx, x, y, tier: str):
     import jax.numpy as jnp
 
     from cycloneml_tpu.dataset.dataset import InstanceDataset
-    from cycloneml_tpu.dataset.instance import compute_dtype
+    from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
     from cycloneml_tpu.ml.optim import aggregators
     from cycloneml_tpu.observe import costs
 
     ctx.conf.set("cyclone.data.dtype", tier)
-    ds = InstanceDataset.from_numpy(ctx, x, y)
+    # fp8_capable mirrors the ESTIMATOR's materialization request — the
+    # float8 tier quantizes with per-column scales, and the measured
+    # program is the same fp8x fp8 dot-with-f32-accumulation the fit runs
+    # (the scale fold rides the replicated inv_std operand, so the
+    # program identity is value-independent)
+    ds = InstanceDataset.from_numpy(
+        ctx, x, y, dtype=data_dtype(ctx.conf, fp8_capable=True))
     d = ds.n_features
     adt = compute_dtype()
     cost = costs.sweep_cost(
@@ -70,26 +80,33 @@ def main() -> int:
         y = (rng.rand(n) > 0.5).astype(np.float64)
         fp32_bytes, fp32_dt = sweep_bytes(ctx, x, y, "float32")
         bf16_bytes, bf16_dt = sweep_bytes(ctx, x, y, "bfloat16")
+        fp8_bytes, fp8_dt = sweep_bytes(ctx, x, y, "float8")
     finally:
         ctx.conf.set("cyclone.data.dtype", "auto")
         ctx.stop()
-    if not fp32_bytes or not bf16_bytes:
+    if not fp32_bytes or not bf16_bytes or not fp8_bytes:
         print(json.dumps({"metric": "sweep_bytes", "error":
                           "cost_analysis unavailable on this backend"}))
         return 1
     ratio = bf16_bytes / fp32_bytes
-    ok = ratio < THRESHOLD
+    ratio8 = fp8_bytes / fp32_bytes
+    ok = ratio < THRESHOLD and ratio8 < THRESHOLD_FP8
     print(f"info: fp32 sweep ({fp32_dt}) {fp32_bytes / 1e6:.2f} MB vs "
-          f"bf16 sweep ({bf16_dt}) {bf16_bytes / 1e6:.2f} MB — "
-          f"ratio {ratio:.3f} (threshold {THRESHOLD})", file=sys.stderr)
+          f"bf16 ({bf16_dt}) {bf16_bytes / 1e6:.2f} MB vs "
+          f"fp8 ({fp8_dt}) {fp8_bytes / 1e6:.2f} MB — ratios "
+          f"bf16 {ratio:.3f} (threshold {THRESHOLD}), "
+          f"fp8 {ratio8:.3f} (threshold {THRESHOLD_FP8})", file=sys.stderr)
     print(json.dumps({
         "metric": "sweep_bytes_ratio",
         "value": round(ratio, 4),
-        "unit": "bf16/fp32 bytes-accessed",
+        "fp8_value": round(ratio8, 4),
+        "unit": "tier/fp32 bytes-accessed",
         "n": n, "d": d,
         "fp32_bytes": fp32_bytes,
         "bf16_bytes": bf16_bytes,
+        "fp8_bytes": fp8_bytes,
         "threshold": THRESHOLD,
+        "fp8_threshold": THRESHOLD_FP8,
         "ok": ok,
     }))
     return 0 if ok else 1

@@ -65,3 +65,21 @@ def narrow_psum_via_helper(x):
 def narrow_via_annassign(x):
     y: jax.Array = x.astype(jnp.bfloat16)
     return jax.lax.psum(y, "data")                   # JX004
+
+
+# the SECOND precision rung: fp8 storage (e4m3/e5m2) psummed un-upcast
+# accumulates in 3 (e4m3) or 2 (e5m2) mantissa bits mesh-wide
+@jax.jit
+def fp8_psum_astype(x):
+    return jax.lax.psum(x.astype(jnp.float8_e4m3fn), "data")   # JX004
+
+
+@jax.jit
+def fp8_e5m2_psum_asarray(x):
+    return jax.lax.psum(jnp.asarray(x, dtype="float8_e5m2"), "data")  # JX004
+
+
+@jax.jit
+def fp8_psum_via_name(x):
+    y = x.astype(jnp.float8_e4m3fn)
+    return jax.lax.psum(y, "data")                   # JX004

@@ -71,3 +71,19 @@ def rewiden_via_annassign(x):
     y = x.astype(jnp.bfloat16)
     y: jax.Array = y.astype(jnp.float32)
     return jax.lax.psum(y, "data")
+
+
+@jax.jit
+def fp8_storage_is_legal(x):
+    # fp8 STORAGE is the second rung of the data tier
+    # (cyclone.data.dtype=auto8/float8); only narrow ACCUMULATION across
+    # the mesh is the hazard
+    return jnp.zeros(x.shape, dtype=jnp.float8_e4m3fn)
+
+
+@jax.jit
+def fp32_accumulated_fp8_psum(x):
+    # the tier ends at the kernel, fp8 included: upcast BEFORE the psum
+    y = x.astype(jnp.float8_e4m3fn)
+    acc = jnp.sum(y.astype(jnp.float32))
+    return jax.lax.psum(acc, "data")

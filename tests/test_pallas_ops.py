@@ -237,3 +237,83 @@ def test_estimators_run_on_pallas_kernels(ctx):
     c_ref = np.asarray(sorted(refk.cluster_centers, key=lambda c: tuple(c)))
     c_pal = np.asarray(sorted(palk.cluster_centers, key=lambda c: tuple(c)))
     np.testing.assert_allclose(c_pal, c_ref, rtol=1e-4, atol=1e-5)
+
+
+# -- fp8 data tier: 1-byte codes + per-VMEM-block dequant scales --------------
+
+def _fp8_cols(x):
+    """Quantize columns the way the dataset tier does: per-column scales
+    into e4m3's finite range."""
+    from cycloneml_tpu.dataset.instance import quantize_fp8
+    return quantize_fp8(x)[:2]
+
+
+def test_fused_logistic_fp8_scale_operand(data, ctx):
+    """fp8 codes + the in-kernel per-column scale reproduce the f32
+    aggregator over the SAME dequantized values, kernel-tight: the scale
+    multiply runs per VMEM block, after the tile upcast."""
+    x, y, w = data
+    d = x.shape[1]
+    rng = np.random.RandomState(8)
+    coef = rng.randn(d + 1)
+    x8, scale = _fp8_cols(x)
+    deq = np.asarray(x8, np.float32) * scale[None, :].astype(np.float32)
+    ref = aggregators.binary_logistic(d, True)(
+        deq, np.asarray(y, np.float32), np.asarray(w, np.float32),
+        np.asarray(coef, np.float32))
+    got = fused_binary_logistic(x8, y, w, coef, d, True,
+                                interpret=True, row_tile=128,
+                                x_scale=scale)
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=5e-3, atol=5e-3)
+
+
+def test_fused_least_squares_fp8_scale_operand(data, ctx):
+    x, y, w = data
+    d = x.shape[1]
+    rng = np.random.RandomState(9)
+    coef = rng.randn(d)
+    inv_std = rng.rand(d) + 0.5
+    mu = rng.randn(d)
+    y_pars = np.array([1.7, 0.3])
+    x8, scale = _fp8_cols(x)
+    deq = np.asarray(x8, np.float32) * scale[None, :].astype(np.float32)
+    ref = aggregators.least_squares_scaled(d)(
+        deq, np.asarray(y, np.float32), np.asarray(w, np.float32),
+        np.asarray(inv_std, np.float32), np.asarray(mu, np.float32),
+        np.asarray(y_pars, np.float32), np.asarray(coef, np.float32))
+    got = fused_least_squares_scaled(x8, y, w, inv_std, mu, y_pars, coef,
+                                     d, interpret=True, row_tile=128,
+                                     x_scale=scale)
+    np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got["grad"]),
+                               np.asarray(ref["grad"]), rtol=5e-3, atol=5e-3)
+
+
+def test_fused_gramian_fp8(ctx):
+    rng = np.random.RandomState(10)
+    x = rng.randn(96, 9) * np.array([1.0, 4.0, 0.5, 2.0, 1.0, 3.0, 1.0,
+                                     0.25, 1.0])
+    x8, scale = _fp8_cols(x)
+    deq = np.asarray(x8, np.float64) * scale[None, :]
+    g = fused_gramian(x8, interpret=True, row_tile=32, x_scale=scale)
+    np.testing.assert_allclose(np.asarray(g), deq.T @ deq,
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_fused_kmeans_assign_fp8(ctx):
+    rng = np.random.RandomState(11)
+    centers = rng.randn(5, 8) * 2.0
+    x = centers[rng.randint(0, 5, 200)] + 0.05 * rng.randn(200, 8)
+    x8, scale = _fp8_cols(x)
+    deq = np.asarray(x8, np.float64) * scale[None, :]
+    best, dist = fused_kmeans_assign(x8, centers, interpret=True,
+                                     row_tile=64, x_scale=scale)
+    # reference assignment on the dequantized points
+    d2 = ((deq[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(best), d2.argmin(1))
+    np.testing.assert_allclose(np.asarray(dist), d2.min(1),
+                               rtol=1e-4, atol=1e-4)

@@ -615,3 +615,109 @@ def test_streaming_scoring_sink_gang_and_empty():
         assert np.array_equal(out[f"prediction.{kk}"],
                               models[kk]._predict_batch(x))
     srv.stop()
+
+
+# -- quantized predict tier (cyclone.serving.quantize) ---------------------------
+
+def test_quantized_predictions_within_envelope():
+    """fp8 coefficient codes + per-row scales: regression margins agree
+    with the unquantized server within e4m3's documented envelope (a few
+    percent of the margin scale), and classification predictions agree
+    away from the decision boundary."""
+    from cycloneml_tpu.serving.servable import Servable
+    d = 41
+    r = np.random.default_rng(3)
+    coef, icpt = r.normal(size=(1, d)), r.normal(size=(1,))
+    x = r.normal(size=(13, d))
+    srv_p = ModelServer(ctx=None, max_batch=16, window_ms=0)
+    srv_p.register("m", Servable(None, coef, icpt, "scalar"))
+    plain = srv_p.predict("m", x)
+    srv_p.stop()
+    srv_q = ModelServer(ctx=None, max_batch=16, window_ms=0, quantize=True)
+    srv_q.register("m", Servable(None, coef, icpt, "scalar"))
+    quant = srv_q.predict("m", x)
+    assert srv_q.stats()["quantize"] is True
+    assert srv_q.stats()["models"]["m"]["quantized"] is True
+    srv_q.stop()
+    scale = max(float(np.abs(plain).max()), 1e-9)
+    assert float(np.abs(quant - plain).max()) / scale < 0.06
+
+
+def test_quantized_bucket_padding_is_bitwise_stable():
+    """The dequant multiply is per margin row — independent of the batch
+    dim — so the bucket-padding bitwise-neutrality contract survives
+    quantization: the same row scores identically in every bucket."""
+    d = 43
+    srv = ModelServer(ctx=None, max_batch=16, window_ms=0, quantize=True)
+    srv.register("m", _binary_lr(d, seed=5))
+    x = rng.normal(size=(5, d))
+    whole = srv.predict("m", x)
+    singles = np.concatenate([srv.predict("m", x[i:i + 1])
+                              for i in range(len(x))])
+    assert np.array_equal(whole, singles)
+    srv.stop()
+
+
+def test_quantized_gang_admits_more_models_per_budget():
+    """THE admission acceptance: the quantized gang program's
+    XLA-predicted per-bucket peak is strictly smaller, so a fixed HBM
+    budget admits strictly more gang models — measured by the same
+    observe/costs accounting the admission path consults."""
+    import jax
+
+    from cycloneml_tpu.observe import costs
+    from cycloneml_tpu.serving.servable import (
+        _quantize_rows, stacked_linear_margins,
+        stacked_quantized_linear_margins,
+    )
+    r = np.random.default_rng(11)
+    d, bucket = 128, 1
+
+    def peak(k, quant):
+        coefs, icpts = r.normal(size=(k, 1, d)), r.normal(size=(k, 1))
+        x0 = np.zeros((bucket, d))
+        if quant:
+            q = _quantize_rows(coefs, icpts, np.float64)
+            c = costs.analyze(jax.jit(stacked_quantized_linear_margins),
+                              (*q, x0), name=f"t.adm.q{k}")
+        else:
+            c = costs.analyze(jax.jit(stacked_linear_margins),
+                              (coefs, icpts, x0), name=f"t.adm.p{k}")
+        return c.peak_bytes
+
+    p_plain, p_quant = peak(16, False), peak(16, True)
+    if not p_plain or not p_quant:
+        pytest.skip("memory analysis unavailable on this backend")
+    assert p_quant < p_plain
+    budget = 4 * p_plain
+
+    def admitted(quant):
+        base, p17 = peak(1, quant), peak(17, quant)
+        marginal = max((p17 - base) / 16.0, 1.0)
+        return 1 + int((budget - base) // marginal)
+
+    assert admitted(True) > admitted(False)
+
+
+def test_quantized_gang_matches_plain_gang():
+    """Gang quantized scoring: one vmapped program, per-model results
+    within the envelope of the plain gang, same compile discipline (one
+    compile per bucket, zero steady-state)."""
+    d, k = 37, 4
+    models = [_binary_lr(d, seed=20 + s) for s in range(k)]
+    x = rng.normal(size=(6, d))
+    srv_q = ModelServer(ctx=None, max_batch=8, window_ms=0, quantize=True)
+    srv_q.register_gang("gq", models)
+    before = srv_q.compile_counts()["gq"]
+    assert before == len(bucket_sizes(8))
+    preds = srv_q.predict("gq", x)
+    assert srv_q.compile_counts()["gq"] == before  # zero steady compiles
+    srv_q.stop()
+    # margins (via model predict parity) — predictions may flip only at
+    # the threshold; compare against each model's own margins instead
+    for kk in range(k):
+        m = models[kk]
+        margins = x @ m._coef[0] + m._icpt[0]
+        away = np.abs(margins) > 0.25  # away from the decision boundary
+        ref = (margins > 0).astype(np.float64)
+        assert np.array_equal(preds[kk][away], ref[away])
