@@ -2,7 +2,7 @@
 # also enforced by tests/test_graftlint.py) and `make test`.
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
-	bench bench-bytes bench-oocore serve-demo multihost
+	bench bench-bytes bench-oocore bench-elastic serve-demo multihost
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -74,6 +74,13 @@ bench-bytes:
 # nonzero if overlap < 30% on the 8-device CPU smoke
 bench-oocore:
 	python scripts/bench_oocore.py
+
+# elastic acceptance: time-to-resume for the same full->half mesh
+# transition, reshard-in-place (memory) vs checkpoint round-trip
+# (disk + sha256) on the 8-device CPU smoke — exits nonzero unless the
+# reshard path is strictly faster
+bench-elastic:
+	python scripts/bench_elastic.py
 
 # serving acceptance demo: 2 models, concurrent request storm, asserts
 # compile-count == bucket-count and p99 under the window bound

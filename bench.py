@@ -516,6 +516,130 @@ def _serving_admission(d: int, budget_peaks: float = 4.0) -> dict:
     }
 
 
+def bench_elastic(n: int | None = None, d: int | None = None):
+    """The ``elastic`` BENCH block: TIME-TO-RESUME after a mesh-shape
+    change, reshard-in-place vs checkpoint round-trip (ISSUE 15).
+
+    One seeded fit runs to completion with optimizer checkpoints on disk;
+    then the SAME full→half transition is timed two ways, trials×
+    medians:
+
+    - **reshard**: host-bounce the live optimizer state, apply a
+      CapacityEvent through ``MeshSupervisor.reshape`` (in-memory dataset
+      migration + program-cache clear + rebuild), rebuild the loss from
+      LIVE host data, and run the first post-transition loss/grad eval.
+    - **checkpoint**: ``MeshSupervisor.recover`` (the crash path: rebuild
+      over survivors, dataset restored from its npz checkpoint), restore
+      the newest VERIFIABLE optimizer checkpoint (read + sha256 verify),
+      and run the same first eval.
+
+    Both legs pay the new mesh's program compile; the difference is pure
+    state-motion cost — memory vs disk+hash. The checkpoint leg runs
+    SECOND each trial, giving it any warm-page-cache advantage, so the
+    ``make bench-elastic`` gate (reshard strictly faster) is
+    conservative. Returns None (with a reason on stderr) on single-device
+    meshes, where no half-shape exists.
+    """
+    import statistics
+    import tempfile
+
+    import jax
+
+    from cycloneml_tpu import CycloneConf, CycloneContext
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.elastic import CapacityEvent, host_bounce_state
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OptimState
+    from cycloneml_tpu.ml.optim.loss import DistributedLossFunction
+    from cycloneml_tpu.parallel.resilience import MeshSupervisor
+    from cycloneml_tpu.util.checkpoint import TrainingCheckpointer
+    from cycloneml_tpu.parallel.resilience import train_with_checkpoints
+
+    n = n or int(os.environ.get("BENCH_ELASTIC_N", 400_000))
+    d = d or int(os.environ.get("BENCH_ELASTIC_D", 64))
+    trials = max(3, int(os.environ.get("BENCH_TRIALS", 3)))
+    n_dev = len(jax.local_devices())
+    if n_dev < 2:
+        print("info: elastic bench skipped: needs >= 2 local devices "
+              "(run `make bench-elastic` for the 8-device CPU smoke)",
+              file=sys.stderr)
+        return None
+    full = f"local-mesh[{n_dev}]"
+    half = f"local-mesh[{n_dev // 2}]"
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.app.name", "bench"))
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, d)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ctx.rebuild_mesh(full)
+        # the LIVE dataset is PERSISTED (registered with the storage
+        # manager): reshape() migrates its already-blockified device
+        # blocks to the host tier and re-places them on the new mesh —
+        # the decommission block-migration hop, no re-ingest, no disk
+        ds_live = InstanceDataset.from_numpy(ctx, x, y).persist()
+
+        def live_loss(_rt=None):
+            return DistributedLossFunction(
+                ds_live, aggregators.binary_logistic(d, fit_intercept=False))
+
+        data_ck = os.path.join(tmp, "data")
+        ds_live.checkpoint(data_ck)
+        opt_ck = TrainingCheckpointer(os.path.join(tmp, "opt"))
+        state = train_with_checkpoints(
+            LBFGS(max_iter=12, tol=1e-12), live_loss(), np.zeros(d),
+            opt_ck, interval=2)
+
+        sup = MeshSupervisor(ctx, on_reshard=live_loss,
+                             max_reshapes=trials + 1)
+        sup_ck = MeshSupervisor(
+            ctx, worker_devices={"h0": n_dev - n_dev // 2,
+                                 "h1": n_dev // 2},
+            on_rebuild=lambda rt: DistributedLossFunction(
+                InstanceDataset.restore(ctx, data_ck),
+                aggregators.binary_logistic(d, fit_intercept=False)),
+            max_rebuilds=trials + 1)
+
+        reshard_s, checkpoint_s = [], []
+        try:
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                st = host_bounce_state(state)
+                loss_a = sup.reshape(CapacityEvent(master=half,
+                                                   reason="bench"))
+                loss_a(st.x)
+                reshard_s.append(time.perf_counter() - t0)
+                ctx.rebuild_mesh(full)
+
+                t0 = time.perf_counter()
+                loss_b = sup_ck.recover("bench transition",
+                                        lost_workers=["h0"])
+                step, tree = opt_ck.restore_newest_verifiable()
+                st2 = OptimState.from_pytree(tree)
+                loss_b(st2.x)
+                checkpoint_s.append(time.perf_counter() - t0)
+                ctx.rebuild_mesh(full)
+        finally:
+            ds_live.unpersist()
+            ctx.rebuild_mesh()   # back to the conf master
+
+    out = {
+        "reshard_resume_s": round(statistics.median(reshard_s), 4),
+        "checkpoint_resume_s": round(statistics.median(checkpoint_s), 4),
+        "resume_speedup": round(statistics.median(checkpoint_s)
+                                / max(statistics.median(reshard_s), 1e-9),
+                                2),
+        "n": n, "d": d, "trials": trials,
+        "devices_from": n_dev, "devices_to": n_dev // 2,
+    }
+    print(f"info: elastic time-to-resume {full}->{half}: reshard-in-place "
+          f"{out['reshard_resume_s'] * 1e3:.0f} ms vs checkpoint "
+          f"round-trip {out['checkpoint_resume_s'] * 1e3:.0f} ms "
+          f"({out['resume_speedup']}x)", file=sys.stderr)
+    return out
+
+
 def bench_serving(d: int | None = None, n_requests: int | None = None,
                   n_threads: int | None = None):
     """The ``serving`` BENCH block: two fitted models behind the model
@@ -684,6 +808,12 @@ def main() -> None:
             trace_overhead = bench_trace_overhead()
         except Exception as e:
             print(f"info: trace overhead bench failed: {e}", file=sys.stderr)
+    elastic = None
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        try:
+            elastic = bench_elastic()
+        except Exception as e:
+            print(f"info: elastic bench failed: {e}", file=sys.stderr)
     try:
         gemm_mops = bench_gemm()
         print(f"info: device_gemm_f32 {gemm_mops:.1f} M ops/s "
@@ -740,6 +870,7 @@ def main() -> None:
             "ovr": ovr,
             "serving": serving,
             "trace_overhead": trace_overhead,
+            "elastic": elastic,
         }))
     elif gemm_mops is not None:
         print(f"info: logreg bench failed: {err}", file=sys.stderr)
@@ -752,6 +883,7 @@ def main() -> None:
             "ovr": ovr,
             "serving": serving,
             "trace_overhead": trace_overhead,
+            "elastic": elastic,
         }))
     else:
         # both benches errored: say so instead of faking a 0.0 measurement

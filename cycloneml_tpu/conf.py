@@ -964,3 +964,43 @@ MULTIHOST_BARRIER_TIMEOUT_MS = (
     .check_value(lambda v: v >= 1, "must be >= 1")
     .int_conf(10000)
 )
+
+ELASTIC_MAX_RESHAPES = (
+    ConfigBuilder("cyclone.elastic.maxReshapes")
+    .doc("Planned mesh-shape changes (CapacityEvents) a MeshSupervisor "
+         "applies before aborting with MeshDegradedError — the elastic "
+         "twin of the max_rebuilds recovery budget, kept SEPARATE so a "
+         "flapping autoscaler cannot eat the budget a real failure "
+         "needs. Each reshape migrates cached datasets in memory, "
+         "rebuilds the mesh at the event's master URL and resumes the "
+         "fit in place from live optimizer state (no checkpoint "
+         "round-trip); see docs/resilience.md 'Elasticity'.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(4)
+)
+
+ELASTIC_DRAIN_WINDOW_MS = (
+    ConfigBuilder("cyclone.elastic.drainWindowMs")
+    .doc("Default drain window for a preemption notice that names none: "
+         "the in-memory optimizer-state handoff must complete within "
+         "this budget of the notice for the rebuild to resume from the "
+         "drained state; past it the handoff is DISCARDED and recovery "
+         "falls back to the newest verifiable checkpoint — expired "
+         "state is never silently resumed.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(5000)
+)
+
+ELASTIC_SPECULATION = (
+    ConfigBuilder("cyclone.elastic.speculation")
+    .doc("Arm Spark-style speculative re-dispatch for lanes with latched "
+         "straggler verdicts (observe/skew.py -> supervisor.stragglers())"
+         ": a convicted lane's next work runs with a duplicate copy — "
+         "concurrent for host-side lanes (oocore shard staging), serial "
+         "on the idle mesh for SPMD fit lanes — first result wins, the "
+         "duplicate dedups bitwise. Off by default: speculation spends "
+         "duplicate work, exactly as the reference's "
+         "spark.speculation=false default does.")
+    .mutable()
+    .bool_conf(False)
+)

@@ -181,6 +181,7 @@ class CycloneContext:
         self._accumulators: List[Accumulator] = []
         self._heartbeats = None
         self._hb_lock = threading.Lock()
+        self._speculators: List[Any] = []  # armed by mesh_supervisor()
 
         # cross-process liveness: when a driver heartbeat address is
         # configured, this process pings it over TCP (the wire leg of
@@ -453,19 +454,39 @@ class CycloneContext:
             return self._heartbeats
 
     def mesh_supervisor(self, **kw):
-        """Degraded-mesh recovery supervisor wired to this context's
-        heartbeat receiver: worker loss (heartbeat expiry or a step's
+        """Degraded-mesh recovery + elastic-scheduling supervisor wired to
+        this context: worker loss (heartbeat expiry or a step's
         DeviceLostError) → program-cache clear + mesh rebuild over the
-        survivors + re-shard + resume-from-checkpoint. Pass the result as
+        survivors + re-shard + resume-from-checkpoint; capacity events
+        (the process-global elastic channel) → in-place reshape; latched
+        straggler verdicts → speculative re-dispatch when
+        ``cyclone.elastic.speculation`` is set. Pass the result as
         ``train_with_checkpoints(..., supervisor=...)``; see
-        docs/resilience.md for the failure model."""
+        docs/resilience.md for the failure and elasticity models."""
+        from cycloneml_tpu.conf import (ELASTIC_DRAIN_WINDOW_MS,
+                                        ELASTIC_MAX_RESHAPES,
+                                        ELASTIC_SPECULATION)
+        from cycloneml_tpu.elastic import capacity as _capacity
+        from cycloneml_tpu.elastic import speculation as _speculation
         from cycloneml_tpu.parallel.resilience import MeshSupervisor
+        kw.setdefault("max_reshapes", self.conf.get(ELASTIC_MAX_RESHAPES))
+        kw.setdefault("drain_window_s",
+                      self.conf.get(ELASTIC_DRAIN_WINDOW_MS) / 1e3)
+        # scale announcements (API / SIGTERM / elastic.capacity chaos
+        # point) reach the training loop through the process-global
+        # channel unless the caller wired its own
+        kw.setdefault("capacity", _capacity.channel())
         sup = MeshSupervisor(self, **kw)
         sup.attach(self.heartbeat_receiver)
         if self.skew_detector is not None:
             # straggler verdicts land in sup.stragglers() — the elastic
-            # scheduler's mitigation input (detection now, ROADMAP item 4)
+            # re-dispatch's mitigation input (ROADMAP item 4)
             sup.attach_skew(self.skew_detector)
+        if self.conf.get(ELASTIC_SPECULATION) \
+                and _speculation.active() is None:
+            sp = _speculation.Speculator(sup.stragglers)
+            _speculation.install(sp)
+            self._speculators.append(sp)  # disarmed + closed on stop
         return sup
 
     def start_ui(self, host: str = "127.0.0.1", port: int = 0):
@@ -687,6 +708,16 @@ class CycloneContext:
                 _bootstrap.shutdown(barrier_first=True)
             except Exception:
                 logger.exception("multihost teardown failed")
+        for sp in getattr(self, "_speculators", []):
+            # disarm BEFORE closing: a staging thread mid-race keeps its
+            # already-submitted backup; new sites fall back to plain work
+            from cycloneml_tpu.elastic import speculation as _speculation
+            _speculation.uninstall(sp)
+            try:
+                sp.close()
+            except Exception:
+                logger.exception("speculator shutdown failed")
+        self._speculators = []
         if getattr(self, "_skew_owner", False):
             from cycloneml_tpu.observe import skew as _skew
             _skew.uninstall()

@@ -1,0 +1,40 @@
+"""Elastic meshes: live scale-up/down, preemption-aware draining, and
+straggler re-dispatch (ROADMAP item 4 — elasticity as a SCHEDULING
+primitive, not just crash recovery).
+
+Three limbs, all seeded-deterministic under the chaos harness:
+
+- :mod:`~cycloneml_tpu.elastic.capacity` — the :class:`CapacityEvent`
+  channel. Scale decisions (API / SIGTERM / the ``elastic.capacity``
+  chaos point) land at SAFE step boundaries: ``MeshSupervisor.reshape``
+  migrates cached datasets off the device tier, clears the program
+  cache, rebuilds the mesh at the new shape, and the loop resumes IN
+  PLACE from its live (host-bounced) optimizer state — zero checkpoint
+  restores on the reshape path.
+- :mod:`~cycloneml_tpu.elastic.reshard` — live-state motion: one
+  batched host bounce for device-resident leaves (coef/grad/S-Y rings),
+  re-placed by the resumed program's sharding on the new topology.
+- :mod:`~cycloneml_tpu.elastic.speculation` — Spark-style speculative
+  re-dispatch consuming ``supervisor.stragglers()``: a latched lane's
+  next work runs with a duplicate copy, first result wins, the
+  duplicate dedups bitwise.
+
+Preemption-aware draining (``multihost.preempt_notice`` →
+:class:`~cycloneml_tpu.parallel.faults.PreemptionNotice` →
+``MeshSupervisor.drain``) sits in ``parallel/resilience.py`` with the
+rest of the recovery stack; the runtime stale-program guard
+(``collectives.StaleProgramError`` over ``mesh.mesh_epoch``) polices
+every transition. See docs/resilience.md "Elasticity".
+"""
+
+from cycloneml_tpu.elastic.capacity import (CapacityChannel, CapacityEvent,
+                                            channel, scale_to)
+from cycloneml_tpu.elastic.reshard import host_bounce, host_bounce_state
+from cycloneml_tpu.elastic.speculation import (Speculator, bitwise_equal,
+                                               maybe_speculate)
+
+__all__ = [
+    "CapacityChannel", "CapacityEvent", "channel", "scale_to",
+    "host_bounce", "host_bounce_state",
+    "Speculator", "bitwise_equal", "maybe_speculate",
+]

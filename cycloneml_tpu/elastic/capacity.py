@@ -1,0 +1,147 @@
+"""Capacity events: the control channel that makes elasticity a
+SCHEDULING primitive instead of a crash response.
+
+``MeshSupervisor`` has always reacted to *loss* (device death, host
+death). A :class:`CapacityEvent` is the planned twin: the platform (or an
+operator, or an autoscaler) announces that the mesh SHOULD change shape —
+a spare slice came up, a reservation is shrinking, a preempted slice's
+replacement arrived — and the training loop re-shards its live state onto
+the new mesh at the next safe step boundary and resumes in place. No
+checkpoint round-trip: the reference's decommission block-migration
+(Zaharia et al. NSDI 2012 lineage + the BlockManagerDecommissioner
+follow-on; PAPER.md layer 3a) moves blocks to survivors while the old
+executors still breathe, and this channel does the same for optimizer
+state + cached datasets.
+
+Delivery surfaces:
+
+- **API**: ``channel().announce(CapacityEvent(master="local-mesh[4]"))``
+  from any thread; ``train_with_checkpoints`` consumes it through
+  ``MeshSupervisor.pending_capacity()`` at step boundaries only — a
+  reshape never tears the mesh down under a running step.
+- **Signal**: ``multihost.bootstrap.install_preemption_handler`` routes
+  SIGTERM into an announcement on real pods.
+- **Chaos**: the ``elastic.capacity`` fault point fires at every safe
+  step boundary; schedule :func:`scale_to` as the fault action and the
+  announcement lands at a seeded-deterministic invocation —
+  every elastic transition is replayable under a fixed seed.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class CapacityEvent:
+    """One announced mesh-shape change.
+
+    ``master`` is the target master URL (``local-mesh[4]``, ``tpu``,
+    ``multihost[...]``) — the same grammar every rebuild speaks.
+    ``returning`` names workers expected BACK on this event (a scale-up
+    restoring a previously drained host): the supervisor re-arms their
+    liveness state so they start with a fresh window instead of
+    inheriting their stale expired verdicts.
+    """
+
+    master: str
+    reason: str = ""
+    returning: List[str] = field(default_factory=list)
+
+    def __str__(self) -> str:  # the reshape log line / flight attr
+        tail = f" (returning: {','.join(self.returning)})" \
+            if self.returning else ""
+        return f"capacity -> {self.master}" + \
+            (f": {self.reason}" if self.reason else "") + tail
+
+
+class CapacityChannel:
+    """Thread-safe FIFO of pending :class:`CapacityEvent`s.
+
+    Producers (API callers, signal handlers, chaos actions) ``announce``;
+    the training loop ``peek``s at step boundaries and ``take``s the
+    event it is about to apply. Coalescing is deliberate-NOT: two
+    announcements apply in order (scale-down then scale-up is the
+    preemption-replacement dance, and collapsing them would skip the
+    intermediate mesh the test parity pins).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[CapacityEvent] = []
+
+    def announce(self, event: CapacityEvent) -> None:
+        with self._lock:
+            self._events.append(event)
+        logger.info("capacity event announced: %s", event)
+
+    def peek(self) -> Optional[CapacityEvent]:
+        with self._lock:
+            return self._events[0] if self._events else None
+
+    def take(self) -> Optional[CapacityEvent]:
+        with self._lock:
+            return self._events.pop(0) if self._events else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+# -- process-global channel (the faults._active / skew._detector pattern) -----
+_lock = threading.Lock()
+_channel: Optional[CapacityChannel] = None
+
+
+def channel() -> CapacityChannel:
+    """The process-global channel, created on first use — supervisors
+    attach it by default so ``channel().announce(...)`` reaches a live
+    training loop with no handle-threading."""
+    global _channel
+    with _lock:
+        if _channel is None:
+            _channel = CapacityChannel()
+        return _channel
+
+
+def install(ch: CapacityChannel) -> Optional[CapacityChannel]:
+    """Replace the process-global channel; returns the previous one
+    (tests restore it)."""
+    global _channel
+    with _lock:
+        prev, _channel = _channel, ch
+        return prev
+
+
+def uninstall(ch: Optional[CapacityChannel] = None) -> None:
+    global _channel
+    with _lock:
+        if ch is None or _channel is ch:
+            _channel = None
+
+
+def scale_to(master: str, reason: str = "chaos capacity event",
+             returning: Optional[List[str]] = None):
+    """A ``FaultSchedule`` ACTION announcing a capacity event when fired:
+    ``sched.at("elastic.capacity", 5, scale_to("local-mesh[4]"))`` makes
+    the scale-down land at exactly the 5th safe step boundary — the
+    seeded-deterministic chaos form of the API announcement."""
+
+    def _announce(point: str, invocation: int, **info) -> None:
+        channel().announce(CapacityEvent(
+            master=master,
+            reason=f"{reason} ({point}#{invocation})",
+            returning=list(returning or [])))
+
+    _announce.__name__ = f"scale_to[{master}]"
+    return _announce

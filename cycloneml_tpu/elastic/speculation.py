@@ -1,0 +1,310 @@
+"""Straggler mitigation: speculative re-dispatch with first-result-wins.
+
+The DETECTION half landed in PR 12 (``observe/skew.py``): rolling
+median+MAD verdicts per lane, latched as ``StragglerDetected`` and
+recorded by ``MeshSupervisor.attach_skew`` — ``supervisor.stragglers()``
+is the mitigation input. This module is the mitigation: when a lane with
+a latched verdict comes up for more work, the work is RE-DISPATCHED —
+Spark's speculation model (Zaharia et al., NSDI 2012: re-run the
+straggling task elsewhere, commit whichever copy finishes first) — with
+**first-result-wins** and a **bitwise dedup** of the duplicate result.
+
+Two dispatch modes, matching where lanes physically run here:
+
+- ``concurrent=True`` — HOST-side lane work (out-of-core shard staging:
+  disk/NIC read + pad). BOTH copies run on a small worker pool and the
+  caller returns with the FIRST successful result — lane latency is
+  min(primary, backup), the actual Spark-speculation payoff — while the
+  loser dedups bitwise OFF the critical path when it lands (identical
+  by construction for deterministic lane work — a mismatch is logged
+  loudly and counted). A failed first completion waits (bounded) for
+  the other copy — the classic rescue: the lane's work still lands.
+- ``concurrent=False`` — SPMD lane work (stacked/CV fit lanes). Two
+  programs dispatched concurrently onto ONE gang-scheduled mesh would
+  deadlock its collectives (mesh.safe_fit_parallelism; graftlint JX007),
+  so the duplicate dispatch runs on the same thread immediately after
+  the primary, in the gap where the mesh would otherwise idle between
+  lanes — on a pod with a spare slice the same call is where the remote
+  placement plugs in. First-result-wins degenerates to the primary
+  (unless it FAILED, in which case the re-dispatch rescues the lane);
+  the duplicate is still deduped bitwise, which doubles as a
+  determinism check on the convicted lane.
+
+Disabled discipline: ``maybe_speculate`` is one module-global read when
+nothing is armed (the ``faults.inject`` pattern); the context arms a
+:class:`Speculator` when ``cyclone.elastic.speculation`` is set.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: speculative re-dispatches allowed per latched lane — a permanently
+#: convicted lane must not double its work forever (Spark bounds
+#: speculatable copies the same way)
+MAX_REDISPATCH_PER_LANE = 16
+
+
+def bitwise_equal(a: Any, b: Any) -> bool:
+    """True when two lane results are BITWISE identical: numpy arrays
+    compare by buffer bytes (NaN == NaN at the bit level, unlike ==),
+    containers recurse, everything else falls back to ==."""
+    if isinstance(a, (tuple, list)):
+        return (isinstance(b, (tuple, list)) and len(a) == len(b)
+                and all(bitwise_equal(x, y) for x, y in zip(a, b)))
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and a.keys() == b.keys()
+                and all(bitwise_equal(v, b[k]) for k, v in a.items()))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a_arr, b_arr = np.asarray(a), np.asarray(b)
+        return (a_arr.dtype == b_arr.dtype and a_arr.shape == b_arr.shape
+                and a_arr.tobytes() == b_arr.tobytes())
+    if isinstance(a, float) and isinstance(b, float):
+        return np.float64(a).tobytes() == np.float64(b).tobytes()
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
+
+
+class _Attempt:
+    """One copy's outcome: completion time, value or error."""
+
+    __slots__ = ("name", "t_done", "value", "error")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t_done: Optional[float] = None
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+
+    def run(self, work: Callable[[], Any]) -> None:
+        try:
+            self.value = work()
+        except BaseException as e:
+            self.error = e
+            self.t_done = time.perf_counter()
+            if not isinstance(e, Exception):
+                raise  # interrupts must never be swallowed by the arbiter
+            return
+        self.t_done = time.perf_counter()
+
+    @property
+    def ok(self) -> bool:
+        return self.t_done is not None and self.error is None
+
+
+class Speculator:
+    """Re-dispatch work for lanes with latched straggler verdicts.
+
+    ``stragglers_fn`` returns the latched lane keys — typically
+    ``lambda: supervisor.stragglers()`` (keys are ``"group:position"``).
+    The ledger (``stats()``) records every re-dispatch, which copy won,
+    and whether the duplicate deduped bitwise.
+    """
+
+    def __init__(self, stragglers_fn: Callable[[], Any],
+                 max_backups: int = 2, loser_wait_s: float = 30.0,
+                 max_per_lane: int = MAX_REDISPATCH_PER_LANE):
+        self._stragglers_fn = stragglers_fn
+        self._loser_wait_s = float(loser_wait_s)
+        self._max_per_lane = int(max_per_lane)
+        # both copies of a raced lane run on this pool (the caller only
+        # waits), so a single race needs 2 workers to actually overlap;
+        # saturation degrades to queueing, never deadlock — the waiting
+        # caller is not a pool thread
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(int(max_backups), 2),
+            thread_name_prefix="cyclone-speculate")
+        self._lock = threading.Lock()
+        self._per_lane: Dict[str, int] = {}
+        self._ledger: List[dict] = []
+        self._dedup_hits = 0
+        self._mismatches = 0
+        self._rescues = 0
+
+    # -- verdict consumption ---------------------------------------------------
+    def latched(self, group: str, position: str) -> bool:
+        """True when the lane has a recorded straggler verdict AND its
+        re-dispatch budget is not exhausted."""
+        key = f"{group}:{position}"
+        try:
+            keys = self._stragglers_fn()
+        except Exception:
+            logger.exception("straggler provider failed; lane not latched")
+            return False
+        if key not in keys:
+            return False
+        with self._lock:
+            return self._per_lane.get(key, 0) < self._max_per_lane
+
+    # -- the race --------------------------------------------------------------
+    def speculate(self, group: str, position: str,
+                  work: Callable[[], Any], *, concurrent: bool = True,
+                  eq: Callable[[Any, Any], bool] = bitwise_equal) -> Any:
+        """Run ``work`` for a LATCHED lane with a speculative duplicate;
+        FIRST result wins, the duplicate is deduped via ``eq``. Callers
+        guard with :meth:`latched` (or go through
+        :func:`maybe_speculate`, which does).
+
+        ``concurrent=True`` submits BOTH copies to the worker pool and
+        returns as soon as the FIRST succeeds — the caller's latency is
+        min(primary, backup), the actual Spark-speculation payoff — with
+        the loser deduped off the critical path when it lands (a loser
+        that outlives ``loser_wait_s`` is left to its pool thread; it
+        can no longer affect the returned result). Only when the first
+        completion FAILED does the caller wait (bounded) for the other
+        copy — the rescue path. ``concurrent=False`` runs both copies
+        on the calling thread (SPMD lanes; see the module docstring).
+        """
+        import concurrent.futures as cf
+        key = f"{group}:{position}"
+        with self._lock:
+            self._per_lane[key] = self._per_lane.get(key, 0) + 1
+        primary, backup = _Attempt("primary"), _Attempt("backup")
+        if not concurrent:
+            # SPMD lane: serial duplicate on the idle mesh, same thread
+            primary.run(work)
+            backup.run(work)
+            return self._arbitrate(key, primary, backup, eq)
+        futs = {self._pool.submit(a.run, work): a
+                for a in (primary, backup)}
+        done, pending = cf.wait(futs, return_when=cf.FIRST_COMPLETED)
+        finished = [futs[f] for f in done]
+        if not any(a.ok for a in finished) and pending:
+            # first completion FAILED: wait (bounded) for the other copy
+            # — the rescue window
+            done2, pending = cf.wait(pending, timeout=self._loser_wait_s)
+            finished += [futs[f] for f in done2]
+        winners = sorted((a for a in finished if a.ok),
+                         key=lambda a: a.t_done)
+        if winners and pending:
+            # healthy winner, loser still running: dedup when it lands —
+            # NEVER block the lane on its own straggling duplicate
+            entry = self._record(key, winners[0], None)
+            loser = next(futs[f] for f in pending)
+            next(iter(pending)).add_done_callback(
+                lambda _f, w=winners[0], l=loser, e=entry:
+                    self._settle_loser(key, w, l, e, eq))
+            return winners[0].value
+        return self._arbitrate(key, primary, backup, eq)
+
+    # -- arbitration + ledger --------------------------------------------------
+    def _record(self, key: str, winner: Optional[_Attempt],
+                dedup: Optional[bool], rescued: bool = False) -> dict:
+        entry = {"lane": key,
+                 "winner": winner.name if winner is not None else None,
+                 "dedup": dedup, "rescued": rescued}
+        with self._lock:
+            if dedup is True:
+                self._dedup_hits += 1
+            elif dedup is False and winner is not None and not rescued:
+                self._mismatches += 1
+            if rescued:
+                self._rescues += 1
+            self._ledger.append(entry)
+        return entry
+
+    def _settle_loser(self, key: str, winner: _Attempt, loser: _Attempt,
+                      entry: dict, eq) -> None:
+        """Off-critical-path dedup once a late loser lands."""
+        if not loser.ok:
+            return  # nothing to dedup; the winner's result already won
+        dedup = bool(eq(winner.value, loser.value))
+        with self._lock:
+            entry["dedup"] = dedup
+            if dedup:
+                self._dedup_hits += 1
+            else:
+                self._mismatches += 1
+        if not dedup:
+            logger.warning(
+                "speculation: duplicate result for lane %s does not "
+                "dedup bitwise; the first result was kept", key)
+
+    def _arbitrate(self, key: str, primary: _Attempt, backup: _Attempt,
+                   eq: Callable[[Any, Any], bool]) -> Any:
+        if primary.ok and backup.ok:
+            winner, loser = ((primary, backup)
+                            if primary.t_done <= backup.t_done
+                            else (backup, primary))
+            dedup = bool(eq(winner.value, loser.value))
+            self._record(key, winner, dedup)
+            if not dedup:
+                # first-result-wins holds, but a convicted lane whose
+                # duplicate DISAGREES is nondeterministic work — loud
+                logger.warning(
+                    "speculation: duplicate result for lane %s does not "
+                    "dedup bitwise; keeping the first result", key)
+            return winner.value
+        if primary.ok or backup.ok:
+            winner = primary if primary.ok else backup
+            self._record(key, winner, None, rescued=winner is backup)
+            return winner.value
+        self._record(key, None, None)
+        # neither copy landed a result in time: surface the primary's
+        # error when it has one (an unfinished primary means the bounded
+        # rescue wait expired — a classified timeout, not a hang)
+        if primary.error is not None:
+            raise primary.error
+        if backup.error is not None:
+            raise backup.error
+        raise TimeoutError(
+            f"speculation: neither copy of lane {key} completed within "
+            f"{self._loser_wait_s}s")
+
+    # -- introspection / lifecycle ---------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"re_dispatches": [dict(e) for e in self._ledger],
+                    "per_lane": dict(self._per_lane),
+                    "dedup_hits": self._dedup_hits,
+                    "mismatches": self._mismatches,
+                    "rescues": self._rescues}
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+
+# -- process-global arming (the faults._active pattern) ------------------------
+_lock = threading.Lock()
+_speculator: Optional[Speculator] = None
+
+
+def install(sp: Speculator) -> Optional[Speculator]:
+    global _speculator
+    with _lock:
+        prev, _speculator = _speculator, sp
+        return prev
+
+
+def uninstall(sp: Optional[Speculator] = None) -> None:
+    global _speculator
+    with _lock:
+        if sp is None or _speculator is sp:
+            _speculator = None
+
+
+def active() -> Optional[Speculator]:
+    return _speculator
+
+
+def maybe_speculate(group: str, position: str, work: Callable[[], Any],
+                    *, concurrent: bool = True,
+                    eq: Callable[[Any, Any], bool] = bitwise_equal) -> Any:
+    """Instrumentation-site entry: plain ``work()`` (one module-global
+    read) unless a speculator is armed AND the lane carries a latched
+    straggler verdict."""
+    sp = _speculator
+    if sp is None or not sp.latched(group, position):
+        return work()
+    return sp.speculate(group, position, work, concurrent=concurrent, eq=eq)

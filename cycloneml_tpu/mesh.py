@@ -266,6 +266,20 @@ _active: Optional[MeshRuntime] = None
 
 _active_lock = __import__("threading").Lock()
 
+# monotonic mesh GENERATION: bumped by every reset() (rebuild, elastic
+# reshape, decommission). Compiled aggregation programs capture the epoch
+# they were built under and collectives._instrument_dispatch refuses to
+# dispatch a program across a bump (StaleProgramError) — the RUNTIME twin
+# of graftlint JX017's static cross-mesh check: on CPU a stale program
+# silently runs on the old virtual devices and on TPU it dies deep inside
+# XLA; the guard turns both into one classified, actionable error.
+_mesh_epoch = 0
+
+
+def mesh_epoch() -> int:
+    """Current mesh generation (advances on every teardown/rebuild)."""
+    return _mesh_epoch
+
 
 def get_or_create(master: str = "tpu", **kw) -> MeshRuntime:
     global _active
@@ -285,7 +299,8 @@ def active() -> Optional[MeshRuntime]:
 
 
 def reset() -> None:
-    global _active
+    global _active, _mesh_epoch
     _active = None
+    _mesh_epoch += 1
     from cycloneml_tpu.parallel import collectives
     collectives.clear_program_cache()

@@ -120,8 +120,11 @@ class DistributedLossFunction:
         # the fused program dispatches the aggregation from INSIDE one XLA
         # program, so the tree_aggregate-level injection points never see
         # these steps — fire them here, once per fused dispatch
-        # (multihost.host first, mirroring _instrument_dispatch: a dead
-        # peer host surfaces as the collective that cannot complete)
+        # (preempt_notice then multihost.host first, mirroring
+        # _instrument_dispatch: a decommission notice precedes the loss
+        # it announces, and a dead peer host surfaces as the collective
+        # that cannot complete)
+        faults.inject("multihost.preempt_notice")
         faults.inject("multihost.host")
         faults.inject("collectives.step")
         arrays = self._agg_call.arrays()

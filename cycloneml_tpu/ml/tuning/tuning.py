@@ -78,9 +78,31 @@ class _ValidatorParams(HasSeed):
         self._evaluator = ev
         return self
 
-    def _fit_score_one(self, pm: ParamMap, train: MLFrame, valid: MLFrame) -> float:
-        model = self._estimator.fit(train, pm)
-        return self._evaluator.evaluate(model.transform(valid))
+    def _fit_score_one(self, pm: ParamMap, train: MLFrame, valid: MLFrame,
+                       lane: str = "") -> float:
+        """One grid point's fit+score. With a ``lane`` label the work is
+        a STRAGGLER LANE (group ``fit.lane``, one position per grid
+        point, sampled once per fold/split): its duration feeds the
+        online skew detector, and once the lane carries a latched
+        verdict the armed speculation layer re-dispatches its next work
+        — serially on the between-lanes idle mesh, NOT on a thread (two
+        concurrent SPMD programs deadlock the shared mesh's gang
+        collectives: mesh.safe_fit_parallelism / graftlint JX007) —
+        with first-result-wins and a bitwise dedup of the duplicate
+        (elastic/speculation.py)."""
+        from cycloneml_tpu.elastic import speculation
+        from cycloneml_tpu.observe import skew
+
+        def work() -> float:
+            with skew.timed_observe("fit.lane", lane):
+                model = self._estimator.fit(train, pm)
+                return float(self._evaluator.evaluate(model.transform(valid)))
+
+        if not lane:
+            model = self._estimator.fit(train, pm)
+            return self._evaluator.evaluate(model.transform(valid))
+        return speculation.maybe_speculate("fit.lane", lane, work,
+                                           concurrent=False)
 
     # -- stacked (model-axis) grid evaluation --------------------------------
     def _stack_plan(self, frame: MLFrame):
@@ -129,9 +151,24 @@ class _ValidatorParams(HasSeed):
 
     def _fit_score_stacked(self, base, reg_vec, train: MLFrame,
                            valid: MLFrame) -> np.ndarray:
+        from cycloneml_tpu.elastic import speculation
+        from cycloneml_tpu.observe import skew
         models = base.fit_stacked(train, reg_params=reg_vec)
-        return np.array([self._evaluator.evaluate(m.transform(valid))
-                         for m in models])
+        # the K fits ran as ONE gang program (no per-model fit lane
+        # exists); per-model SCORING is host-separable work, so each
+        # grid point's scoring is its straggler lane — same group, same
+        # re-dispatch semantics as the serial path
+        out = []
+        for mi, m in enumerate(models):
+            lane = f"grid{mi}"
+
+            def work(m=m, lane=lane) -> float:
+                with skew.timed_observe("fit.lane", lane):
+                    return float(self._evaluator.evaluate(m.transform(valid)))
+
+            out.append(speculation.maybe_speculate("fit.lane", lane, work,
+                                                   concurrent=False))
+        return np.array(out)
 
 
 class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
@@ -183,7 +220,8 @@ class CrossValidator(Estimator, _ValidatorParams, MLWritable, MLReadable):
                 train = frame.filter_rows(folds != f)
                 valid = frame.filter_rows(folds == f)
                 for mi, pm in enumerate(maps):
-                    metrics[mi] += self._fit_score_one(pm, train, valid)
+                    metrics[mi] += self._fit_score_one(pm, train, valid,
+                                                       lane=f"grid{mi}")
         metrics /= n_folds
         best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
                        else np.argmin(metrics))
@@ -257,7 +295,8 @@ class TrainValidationSplit(Estimator, _ValidatorParams, MLWritable, MLReadable):
         else:
             safe_fit_parallelism(requested)
             metrics = np.asarray(
-                [self._fit_score_one(pm, train, valid) for pm in maps])
+                [self._fit_score_one(pm, train, valid, lane=f"grid{mi}")
+                 for mi, pm in enumerate(maps)])
         best_idx = int(np.argmax(metrics) if self._evaluator.is_larger_better
                        else np.argmin(metrics))
         best = self._estimator.fit(frame, maps[best_idx])

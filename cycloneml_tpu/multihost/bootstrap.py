@@ -282,6 +282,48 @@ def shutdown(barrier_first: bool = True) -> bool:
     return True
 
 
+def install_preemption_handler(fn, signals: Optional[Tuple[int, ...]] = None
+                               ) -> bool:
+    """Route the platform's decommission signal into ``fn()``.
+
+    On real pods a slice preemption arrives as SIGTERM (the ``tpu``
+    master's advance notice); this installs a handler that calls ``fn``
+    — typically ``lambda: channel.announce(CapacityEvent(...))`` or a
+    supervisor's drain trigger — and then CHAINS to any previously
+    installed handler, so the process's own shutdown hooks still run.
+    Returns False (and installs nothing) off the main thread — Python
+    only allows signal handlers there — or when no usable signal exists;
+    the CPU smoke models the notice with the ``multihost.preempt_notice``
+    fault point instead, which is also the deterministic test surface.
+    """
+    import signal as _signal
+    if threading.current_thread() is not threading.main_thread():
+        logger.warning("preemption handler not installed: signal handlers "
+                       "require the main thread")
+        return False
+    sigs = signals if signals is not None else (_signal.SIGTERM,)
+    installed = False
+    for sig in sigs:
+        try:
+            prev = _signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                logger.warning("preemption signal %s received: draining",
+                               signum)
+                try:
+                    fn()
+                finally:
+                    if callable(_prev):
+                        _prev(signum, frame)
+
+            _signal.signal(sig, _handler)
+            installed = True
+        except (ValueError, OSError) as e:
+            logger.warning("cannot install preemption handler for signal "
+                           "%s: %s", sig, e)
+    return installed
+
+
 def abandon(timeout_s: float = 5.0) -> bool:
     """Failure-path teardown after a HOST died: no barrier (the peer
     cannot arrive), and the disconnect itself runs on a daemon thread

@@ -64,8 +64,12 @@ logger = get_logger(__name__)
 #: every worker's samples in the receiver process's ONE detector, so
 #: the cross-worker comparison is structurally live (the sender-side
 #: sample it replaces saw only its own lane).
+#: ``fit.lane`` joined with the elastic re-dispatch (ISSUE 15): stacked/
+#: CV tuning lanes (one position per grid point, sampled once per fold/
+#: split) — a grid point whose fit time separates from the grid's median
+#: latches, and the speculation layer re-dispatches its next lane work
 STRAGGLER_GROUPS = frozenset({"oocore.stage", "serving.dispatch",
-                              "heartbeat.rtt"})
+                              "heartbeat.rtt", "fit.lane"})
 
 #: bound on distinct positions tracked per group — a pathological caller
 #: (unbounded lane names) degrades to ignoring NEW lanes, never to
@@ -247,6 +251,19 @@ class SkewDetector:
     def events(self) -> List[Any]:
         with self._lock:
             return list(self._events)
+
+    def reset_position(self, group: str, position: str) -> None:
+        """Forget ONE lane: samples, cached median and latched verdicts.
+        The liveness re-arm hook (MeshSupervisor.readmit) — a worker
+        returning on scale-up starts a fresh RTT lane instead of
+        inheriting samples (and possibly a latched verdict) from its
+        pre-departure placement."""
+        key = (group, position)
+        with self._lock:
+            self._samples.get(group, {}).pop(position, None)
+            self._medians.get(group, {}).pop(position, None)
+            self._flagged.discard(key)
+            self._slo_breached.discard(key)
 
     def reset(self, group: Optional[str] = None) -> None:
         with self._lock:

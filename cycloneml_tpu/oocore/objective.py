@@ -58,6 +58,7 @@ class StreamingLossFunction:
         # shard exists (n_sharded names the row-sharded args), with the
         # staged shard operands DONATED — they are consumed exactly once,
         # and donation frees their HBM for the next in-flight transfer
+        self._sds_agg = agg  # kept so reshard() can rebind on a new mesh
         self._prog = collectives.tree_aggregate(agg, rt, n_sharded=3,
                                                 donate_rows=True)
         self._extras = tuple(extra_args)
@@ -67,6 +68,30 @@ class StreamingLossFunction:
         self.n_evals = 0
         self.n_dispatches = 0   # shard dispatches (n_shards per epoch)
         self.epochs = 0
+
+    def reshard(self, runtime=None) -> "StreamingLossFunction":
+        """Rebind this streamed objective to the (rebuilt) mesh — the
+        out-of-core leg of an elastic reshape: the held per-shard program
+        closes over the OLD mesh (the runtime StaleProgramError guard
+        would refuse it), so it recompiles against the new runtime while
+        every host-side position — epoch/eval/dispatch counters, the
+        weight sum, the shard set itself — carries over untouched.
+        Shards re-stage lazily on the new topology at the next sweep;
+        the fixed ``(padRows, d)`` geometry must divide the new mesh's
+        data parallelism (padRows is a multiple of 8× the SPILL-time
+        parallelism, so power-of-two scale-downs and moderate scale-ups
+        always fit) — an indivisible shape raises before any dispatch."""
+        from cycloneml_tpu.parallel import collectives
+        rt = runtime if runtime is not None else self._ctx.mesh_runtime
+        dp = rt.data_parallelism
+        if self._sds.pad_rows % dp:
+            raise ValueError(
+                f"shard geometry padRows={self._sds.pad_rows} does not "
+                f"divide the reshaped mesh's data parallelism {dp}; "
+                f"re-spill the shard set for this topology")
+        self._prog = collectives.tree_aggregate(
+            self._sds_agg, rt, n_sharded=3, donate_rows=True)
+        return self
 
     # -- the streamed sweep ----------------------------------------------------
     def sweep(self, *call_args, per_shard=None, order=None) -> dict:

@@ -120,7 +120,28 @@ class ShardStream:
         except BaseException as exc:  # staging thread must never die silent
             self._put((None, exc))
 
+    def _host_stage(self, i: int):
+        """Host half of one staging attempt: shard read + pad. This is
+        the per-LANE work (disk/NIC — one bad spindle makes one slow
+        lane), so it is what the elastic speculation layer races: both
+        copies read the same shard file, making first-result-wins
+        dedup bitwise by construction."""
+        sds = self._sds
+        x, y, w = sds.load_shard(i)
+        m = x.shape[0]
+        pad = sds.pad_rows - m
+        if pad:
+            # fresh padded blocks per shard (zero-weight tail rows,
+            # masked out of every psum) — a reused staging buffer could
+            # still be read by an in-flight transfer
+            x = np.concatenate(
+                [x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
+            y = np.concatenate([y, np.zeros(pad, dtype=y.dtype)])
+            w = np.concatenate([w, np.zeros(pad, dtype=w.dtype)])
+        return x, y, w, m
+
     def _stage(self, i: int):
+        from cycloneml_tpu.elastic import speculation
         from cycloneml_tpu.observe import skew
         from cycloneml_tpu.parallel import faults
         # per-shard-lane staging time feeds the online straggler detector:
@@ -129,22 +150,18 @@ class ShardStream:
         # separates from the group median within a few epochs. The window
         # covers the WHOLE attempt — the chaos injection point included,
         # so an injected slow lane is observable skew, as a real one is.
+        lane = f"shard{i % skew.OOCORE_SKEW_LANES}"
         t_skew = time.perf_counter()
         faults.inject("oocore.stage", shard=i)
-        sds = self._sds
-        rt = sds.ctx.mesh_runtime
+        rt = self._sds.ctx.mesh_runtime
         with tracing.span("transfer", "oocore.stage", shard=i) as sp:
-            x, y, w = sds.load_shard(i)
-            m = x.shape[0]
-            pad = sds.pad_rows - m
-            if pad:
-                # fresh padded blocks per shard (zero-weight tail rows,
-                # masked out of every psum) — a reused staging buffer could
-                # still be read by an in-flight transfer
-                x = np.concatenate(
-                    [x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
-                y = np.concatenate([y, np.zeros(pad, dtype=y.dtype)])
-                w = np.concatenate([w, np.zeros(pad, dtype=w.dtype)])
+            # speculation gate (one global read when disarmed): a lane
+            # with a latched straggler verdict re-dispatches its HOST
+            # work concurrently — first result wins, duplicate deduped
+            # bitwise (Spark speculation; elastic/speculation.py). The
+            # device placement below happens ONCE, on the winner.
+            x, y, w, m = speculation.maybe_speculate(
+                "oocore.stage", lane, lambda: self._host_stage(i))
             xs = rt.device_put_sharded_rows(x)
             ys = rt.device_put_sharded_rows(y)
             ws = rt.device_put_sharded_rows(w)
@@ -152,8 +169,7 @@ class ShardStream:
             sp.annotate(bytes=n_bytes, rows=m)
         self.bytes_staged += n_bytes
         tracing.counter("oocore.bytes_staged", self.bytes_staged)
-        skew.observe("oocore.stage", f"shard{i % skew.OOCORE_SKEW_LANES}",
-                     time.perf_counter() - t_skew)
+        skew.observe("oocore.stage", lane, time.perf_counter() - t_skew)
         return (i, xs, ys, ws)
 
     def _put(self, item) -> bool:

@@ -21,8 +21,20 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Optional, Sequence
 
+from cycloneml_tpu import mesh as _mesh_mod
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
 from cycloneml_tpu.observe import costs, skew, tracing
+
+
+class StaleProgramError(RuntimeError):
+    """A compiled aggregation program was dispatched across a mesh
+    teardown/rebuild (elastic reshape, device-loss recovery,
+    decommission). The program closes over the OLD mesh: on CPU it
+    silently runs on the torn-down virtual devices, on TPU it dies deep
+    inside XLA — either way the caller must REBUILD the program
+    (``clear_program_cache`` + ``tree_aggregate`` on the new runtime,
+    the idiom graftlint JX017 checks statically). Classified PERMANENT
+    by the resilience layer: retrying dispatches the same dead program."""
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -146,6 +158,11 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None,
 
     first = [True]
     pid_ref = [None]
+    # mesh generation this program was built under: the runtime twin of
+    # graftlint JX017 — a dispatch after ANY mesh teardown/rebuild is a
+    # stale-program bug, surfaced as one classified error instead of a
+    # silent wrong-mesh run (CPU) or a deep XLA crash (TPU)
+    build_epoch = _mesh_mod.mesh_epoch()
     # reduction-structure annotation, built once: the collective spans
     # carry the per-level topology (ici/dcn axes) to the trace collector
     level_attrs = {f"level.{i}": f"{tier}:{axes}"
@@ -161,12 +178,23 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None,
         # wall clock during tracing (see jx001_tracing_pass fixture).
         if any(isinstance(a, jax.core.Tracer) for a in args):
             return jitted(*args, **kwargs)
+        if _mesh_mod.mesh_epoch() != build_epoch:
+            raise StaleProgramError(
+                f"program '{name}' was compiled under mesh epoch "
+                f"{build_epoch} but the mesh is now at epoch "
+                f"{_mesh_mod.mesh_epoch()} (a rebuild/reshape tore its "
+                f"devices down); rebuild the program on the new runtime "
+                f"(clear_program_cache + tree_aggregate) instead of "
+                f"re-dispatching the stale one")
         # inject BEFORE consuming the first-dispatch flag: a chaos fault
         # raised here leaves the flag set, so the RETRY (the dispatch that
         # actually pays trace + compile) still records its compile span.
-        # `multihost.host` fires first: a lost HOST surfaces to the train
-        # loop as the collective that can no longer complete — scheduling
-        # a HostLostError here is the chaos stand-in for a dead peer
+        # `multihost.preempt_notice` fires first — a decommission NOTICE
+        # precedes the loss it announces — then `multihost.host`: a lost
+        # HOST surfaces to the train loop as the collective that can no
+        # longer complete. Scheduling a PreemptionNotice / HostLostError
+        # here is the chaos stand-in for a preempted / dead peer
+        faults.inject("multihost.preempt_notice")
         faults.inject("multihost.host")
         faults.inject("collectives.step")
         was_first, first[0] = first[0], False

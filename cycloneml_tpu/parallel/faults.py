@@ -41,6 +41,31 @@ point                    fired from
                          dump, program-cache clear, distributed
                          teardown, mesh rebuild over the surviving
                          hosts, re-shard, resume-from-checkpoint.
+``multihost.preempt_notice``
+                         every aggregation dispatch, ahead of
+                         ``multihost.host`` — the CPU-smoke model of
+                         the ``tpu`` master's decommission signal
+                         (a preempted slice announces itself BEFORE
+                         teardown; on real pods the same notice
+                         arrives as SIGTERM —
+                         ``multihost.bootstrap.install_preemption_handler``).
+                         Schedule a :class:`PreemptionNotice` here to
+                         chaos-test preemption-aware DRAINING:
+                         flight dump + in-memory optimizer-state
+                         handoff before the rebuild, resume from the
+                         drained state inside the drain window,
+                         checkpoint fallback outside it
+                         (docs/resilience.md "Elasticity").
+``elastic.capacity``     every safe step boundary of
+                         ``train_with_checkpoints`` (before the
+                         pending-loss/capacity checks). Schedule a
+                         CALLABLE here — e.g.
+                         ``elastic.capacity.scale_to("local-mesh[4]")``
+                         — to announce a seeded-deterministic
+                         :class:`~cycloneml_tpu.elastic.capacity.CapacityEvent`:
+                         the loop re-shards live optimizer state onto
+                         the new mesh at that boundary and resumes in
+                         place, no checkpoint restore.
 ======================== =================================================
 
 Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the
@@ -111,6 +136,27 @@ class HostLostError(DeviceLostError):
                  lost_hosts: Sequence[str] = ()):
         super().__init__(msg, lost_workers=lost_hosts)
         self.lost_hosts = list(lost_hosts)
+
+
+class PreemptionNotice(FaultInjected):
+    """A decommission NOTICE, not a loss: the platform announced that
+    ``lost_hosts`` will be reclaimed after ``drain_window_s`` seconds (the
+    ``tpu`` master's slice-preemption signal; SIGTERM on bare pods). The
+    mesh is still alive when this surfaces, so the drain path
+    (``MeshSupervisor.drain``) hands the LIVE optimizer state off in
+    memory before teardown and the rebuild resumes from it — the
+    checkpoint round-trip is the fallback for an expired window, not the
+    plan. Deliberately NOT a ``DeviceLostError`` subclass: classifying a
+    notice as a loss would route it through the restore-from-checkpoint
+    recovery the drain exists to avoid."""
+
+    def __init__(self, msg: str = "preemption notice",
+                 lost_hosts: Sequence[str] = (),
+                 drain_window_s: Optional[float] = None):
+        super().__init__(msg)
+        self.lost_hosts = list(lost_hosts)
+        # None = resolve cyclone.elastic.drainWindowMs at drain time
+        self.drain_window_s = drain_window_s
 
 
 class MidSaveCrash(FaultInjected):

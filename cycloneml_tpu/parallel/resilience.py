@@ -54,6 +54,10 @@ def _permanent_types() -> tuple:
     """Exception types a retry can never fix: the step function itself is
     wrong, and re-running it re-traces the same bug."""
     types: list = [TypeError, SyntaxError, NameError]
+    # a stale program (dispatched across a mesh rebuild/reshape) re-raises
+    # identically on every retry — the caller must REBUILD it, not retry
+    from cycloneml_tpu.parallel.collectives import StaleProgramError
+    types.append(StaleProgramError)
     try:
         import jax
         types.append(jax.errors.JAXTypeError)  # Tracer/Concretization family
@@ -115,6 +119,7 @@ class HeartbeatReceiver:
         self._trace_ids: Dict[str, str] = {}
         self._rtts: Dict[str, float] = {}
         self._callbacks: List[Callable[[str, str], None]] = []
+        self._reg_callbacks: List[Callable[[str], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -123,6 +128,15 @@ class HeartbeatReceiver:
         with self._lock:
             self._last[worker_id] = time.monotonic()
             self._lost.pop(worker_id, None)  # re-registration revives
+        # announce OUTSIDE the lock (the worker-lost convention): an
+        # attached supervisor re-arms the worker's liveness/health state —
+        # a worker returning on scale-up gets a FRESH window, never its
+        # stale expired verdicts (docs/resilience.md "Elasticity")
+        for fn in self._reg_callbacks:
+            try:
+                fn(worker_id)
+            except Exception:
+                logger.exception("worker-registered callback failed")
 
     def note_trace(self, worker_id: str, trace_id: str) -> None:
         """Record the distributed-trace id a worker's extended heartbeat
@@ -167,6 +181,11 @@ class HeartbeatReceiver:
 
     def on_worker_lost(self, fn: Callable[[str, str], None]) -> None:
         self._callbacks.append(fn)
+
+    def on_worker_registered(self, fn: Callable[[str], None]) -> None:
+        """Subscribe to (re-)registrations — the scale-up/revival leg of
+        the liveness loop, as ``on_worker_lost`` is the loss leg."""
+        self._reg_callbacks.append(fn)
 
     def live_workers(self) -> List[str]:
         with self._lock:
@@ -444,6 +463,13 @@ class HealthTracker:
         with self._lock:
             self._failures.pop(worker_id, None)
 
+    def forgive(self, worker_id: str) -> None:
+        """Erase the worker's failure history — the scale-up re-arm: a
+        worker that LEFT on a planned scale-down and returns on scale-up
+        was never unhealthy, and inheriting its pre-departure strikes
+        would exclude it after one hiccup on the new mesh."""
+        self.record_success(worker_id)
+
     def is_excluded(self, worker_id: str) -> bool:
         with self._lock:
             return self._failures.get(worker_id, 0) >= self.max_failures
@@ -540,16 +566,35 @@ class MeshSupervisor:
                  master_for: Optional[Callable[[int], str]] = None,
                  health: Optional["HealthTracker"] = None,
                  on_rebuild: Optional[Callable[[Any], Any]] = None,
-                 min_devices: int = 1, max_rebuilds: int = 2):
+                 on_reshard: Optional[Callable[[Any], Any]] = None,
+                 min_devices: int = 1, max_rebuilds: int = 2,
+                 max_reshapes: int = 4, drain_window_s: float = 5.0,
+                 capacity=None):
         self.ctx = ctx
         self.worker_devices = dict(worker_devices or {})
         self.worker_hosts = dict(worker_hosts or {})
         self._master_for = master_for
         self.health = health if health is not None else HealthTracker()
         self.on_rebuild = on_rebuild
+        # re-shard hook for PLANNED reshapes (capacity events): rebuild
+        # the loss/programs on the new runtime from LIVE data — no
+        # checkpoint read. Falls back to on_rebuild when unset (the two
+        # hooks often coincide; they differ when recovery must restore
+        # the dataset from a checkpoint but a reshape can re-place it).
+        self.on_reshard = on_reshard
         self.min_devices = min_devices
         self.max_rebuilds = max_rebuilds
+        # reshape budget, SEPARATE from the rebuild budget: planned
+        # elasticity is routine (autoscaler breathing), unplanned loss is
+        # not — a flapping autoscaler must abort loudly without eating the
+        # recovery budget a real failure will need
+        self.max_reshapes = max_reshapes
+        self.drain_window_s = float(drain_window_s)
         self.rebuilds = 0
+        self.reshapes = 0
+        self.drain_resumes = 0
+        self.drain_expired = 0
+        self._capacity = capacity
         self._lost: Dict[str, str] = {}
         self._lost_hosts: Dict[str, str] = {}
         self._stragglers: Dict[str, dict] = {}
@@ -558,9 +603,28 @@ class MeshSupervisor:
 
     def attach(self, receiver: "HeartbeatReceiver") -> "MeshSupervisor":
         """Subscribe to a receiver's worker-lost events (heartbeat-driven
-        loss detection feeding the same recovery path as step errors)."""
+        loss detection feeding the same recovery path as step errors) AND
+        its registration events (a returning worker's liveness re-arms —
+        the scale-up leg)."""
         receiver.on_worker_lost(self.note_worker_lost)
+        receiver.on_worker_registered(self.readmit)
         return self
+
+    def attach_capacity(self, channel) -> "MeshSupervisor":
+        """Consume capacity events (elastic/capacity.py) — the training
+        loop polls ``pending_capacity()`` at safe step boundaries and
+        applies :meth:`reshape` there, never mid-step."""
+        self._capacity = channel
+        return self
+
+    def pending_capacity(self):
+        """The next announced :class:`CapacityEvent`, or None."""
+        ch = self._capacity
+        return ch.peek() if ch is not None else None
+
+    def take_capacity(self):
+        ch = self._capacity
+        return ch.take() if ch is not None else None
 
     def attach_skew(self, detector) -> "MeshSupervisor":
         """Subscribe to an ``observe.skew.SkewDetector``: latched
@@ -618,6 +682,36 @@ class MeshSupervisor:
             self.note_worker_lost(w, reason)
         with self._lock:
             self._lost_hosts[host] = reason
+
+    def readmit(self, worker_id: str) -> None:
+        """Re-arm a worker's liveness state: called when a worker
+        (re-)registers — typically one that LEFT on a scale-down/drain
+        and returned on scale-up. Its lost marker, its host's whole-host
+        marker, its health strikes and its straggler RTT lane are all
+        cleared, so it starts with a FRESH window instead of inheriting
+        stale expired verdicts (the pre-fix bug: a returning worker was
+        forever excluded from surviving-device math and one heartbeat
+        hiccup re-excluded it via its inherited strikes)."""
+        self.health.forgive(worker_id)
+        host = self.worker_hosts.get(worker_id, worker_id)
+        with self._lock:
+            was_lost = self._lost.pop(worker_id, None) is not None
+            self._lost_hosts.pop(host, None)
+            if not self._lost:
+                # every recorded loss has been revived: nothing left to
+                # recover from — a rebuild now would tear down a whole mesh
+                self._pending = None
+        if was_lost:
+            # the heartbeat-RTT straggler lane restarts too: pre-departure
+            # samples (and a latched verdict) describe the OLD placement
+            from cycloneml_tpu.observe import skew
+            det = skew.active()
+            if det is not None:
+                det.reset_position("heartbeat.rtt", worker_id)
+            with self._lock:
+                self._stragglers.pop(f"heartbeat.rtt:{worker_id}", None)
+            logger.info("mesh supervisor: worker %s readmitted with a "
+                        "fresh liveness window", worker_id)
 
     def pending_loss(self) -> Optional[str]:
         with self._lock:
@@ -702,6 +796,116 @@ class MeshSupervisor:
             if self.on_rebuild is not None:
                 return self.on_rebuild(rt)
             return None
+
+    def reshape(self, event) -> Any:
+        """PLANNED mesh-shape change (a :class:`CapacityEvent`): the old
+        mesh is still ALIVE, so everything moves through memory —
+
+        1. cached device-tier datasets migrate to the host tier while
+           their devices still answer (the decommission block-migration
+           hop, Zaharia et al. NSDI 2012 / PAPER.md layer 3a),
+        2. every compiled program is dropped and the mesh epoch advances
+           (``clear_program_cache`` + rebuild — the JX017 idiom; the
+           runtime ``StaleProgramError`` guard enforces it for any
+           holdout reference),
+        3. the mesh rebuilds at the event's master URL and the migrated
+           datasets re-place eagerly on the new topology,
+        4. workers the event names as ``returning`` re-arm
+           (:meth:`readmit`),
+        5. ``on_reshard`` (else ``on_rebuild``) rebuilds the caller's
+           loss/programs from the LIVE data — its return value replaces
+           the loss function and training resumes IN PLACE from its
+           host-bounced optimizer state. Zero checkpoint restores on
+           this path, pinned by the chaos suite.
+
+        Budgeted by ``max_reshapes`` (separate from ``max_rebuilds``):
+        a flapping autoscaler aborts loudly as a flapping mesh does.
+        """
+        if self.reshapes >= self.max_reshapes:
+            raise MeshDegradedError(
+                f"mesh reshaped {self.reshapes} times already "
+                f"(max_reshapes={self.max_reshapes}); refusing further "
+                f"capacity events instead of thrashing")
+        self.reshapes += 1
+        from cycloneml_tpu.observe import flight
+        flight.trigger("mesh.reshape", cause=str(event),
+                       reshape=self.reshapes)
+        from cycloneml_tpu.parallel.collectives import clear_program_cache
+        with tracing.span("reshape", str(event), reshape=self.reshapes):
+            migrated, moved_bytes = [], 0
+            storage = getattr(self.ctx, "storage", None)
+            if storage is not None:
+                # raises BEFORE any teardown if a dataset cannot leave
+                # the device tier — the old mesh stays intact on failure
+                migrated, moved_bytes = storage.migrate_device_to_host()
+            clear_program_cache()
+            rt = self.ctx.rebuild_mesh(event.master)
+            for ds in migrated:
+                ds.x  # eager re-place on the new topology
+            for w in getattr(event, "returning", ()):
+                self.readmit(w)
+            bus = getattr(self.ctx, "listener_bus", None)
+            if bus is not None and migrated:
+                from cycloneml_tpu.util.events import BlocksMigrated
+                bus.post(BlocksMigrated(n_datasets=len(migrated),
+                                        bytes=moved_bytes,
+                                        n_devices=rt.n_devices))
+            logger.warning(
+                "mesh reshape #%d (%s): %d devices, %d datasets migrated "
+                "in place (%d bytes), no checkpoint round-trip",
+                self.reshapes, event, rt.n_devices, len(migrated),
+                moved_bytes)
+            hook = self.on_reshard if self.on_reshard is not None \
+                else self.on_rebuild
+            return hook(rt) if hook is not None else None
+
+    def drain(self, notice, live_state=None):
+        """Preemption-aware draining: a decommission NOTICE arrived (the
+        ``tpu`` master's slice-preemption signal; the
+        ``multihost.preempt_notice`` chaos point on the CPU smoke) —
+        the doomed hosts are still breathing, so hand the LIVE optimizer
+        state off through memory BEFORE teardown and resume the rebuild
+        from it. Returns ``(new_loss_or_None, state_or_None)``:
+        a non-None state is the drained handoff (resume in place, no
+        checkpoint read); None means the drain window expired before the
+        handoff landed and the caller must fall back to the newest
+        VERIFIABLE checkpoint — stale drained state is discarded, never
+        silently resumed.
+        """
+        window_s = notice.drain_window_s \
+            if getattr(notice, "drain_window_s", None) is not None \
+            else self.drain_window_s
+        deadline = time.monotonic() + max(float(window_s), 0.0)
+        hosts = list(getattr(notice, "lost_hosts", ()) or ())
+        # freeze the flight ring while the doomed mesh still answers: the
+        # dump shows what it was doing when the notice landed
+        from cycloneml_tpu.observe import flight
+        flight.trigger("preempt.drain", hosts=",".join(sorted(hosts)),
+                       window_s=float(window_s))
+        # opportunistic in-memory handoff BEFORE teardown: one batched
+        # host bounce of the live state (coef/grad/S-Y rings). The
+        # window budgets THIS handoff — the part racing the doomed
+        # host — not the survivor-side rebuild below, which can take
+        # arbitrarily long without invalidating a handoff that landed
+        # in time.
+        from cycloneml_tpu.elastic import reshard
+        drained = reshard.host_bounce_state(live_state)
+        handoff_done = time.monotonic()
+        new_loss = self.recover(reason=f"preemption notice: {notice}",
+                                lost_workers=hosts)
+        if drained is not None and handoff_done <= deadline:
+            self.drain_resumes += 1
+            logger.warning(
+                "preempt drain: resuming from handed-off in-memory state "
+                "(iteration %d) — no checkpoint restore",
+                getattr(drained, "iteration", -1))
+            return new_loss, drained
+        self.drain_expired += 1
+        logger.warning(
+            "preempt drain: window (%.3fs) expired before the handoff "
+            "completed; falling back to the newest verifiable checkpoint",
+            float(window_s))
+        return new_loss, None
 
 
 def _restore_latest_verified(checkpointer: TrainingCheckpointer,
@@ -792,8 +996,30 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
     # steps at or below this were announced (on_step) by a previous run or
     # before a device-loss replay — never announce them twice
     last_announced = resume.iteration if resume is not None else -1
+    from cycloneml_tpu.parallel import faults as _faults
     fail_count = 0
     while True:
+        # SAFE STEP BOUNDARY: capacity decisions land here, never
+        # mid-step. The chaos point lets a FaultSchedule announce a
+        # seeded-deterministic CapacityEvent (elastic.capacity.scale_to)
+        # at an exact boundary number.
+        _faults.inject("elastic.capacity",
+                       iteration=state.iteration if state is not None
+                       else -1)
+        if supervisor is not None:
+            # take, don't peek-then-take: two loops sharing one channel
+            # must never apply the same event twice / drop its sibling
+            ev = supervisor.take_capacity()
+            if ev is not None:
+                # live in-place reshard: host-bounce the optimizer state
+                # while the OLD mesh still answers, reshape, resume from
+                # that state — NO checkpoint restore on this path
+                from cycloneml_tpu.elastic import reshard as _reshard
+                state = _reshard.host_bounce_state(state)
+                new_loss = supervisor.reshape(ev)
+                loss_grad = new_loss if new_loss is not None else loss_grad
+                it = optimizer.iterations(loss_grad, x0, resume=state)
+                fail_count = 0
         if supervisor is not None and supervisor.pending_loss():
             loss_grad, state = _recover(supervisor.pending_loss())
             it = optimizer.iterations(loss_grad, x0, resume=state)
@@ -805,6 +1031,33 @@ def train_with_checkpoints(optimizer, loss_grad, x0,
             # budget counts failures of the SAME step across stream rebuilds
             # (a rebuilt stream re-yields its resume point, which must not
             # reset the count — that would retry a permanent failure forever)
+            from cycloneml_tpu.parallel.faults import PreemptionNotice
+            if isinstance(e, PreemptionNotice) and supervisor is not None:
+                # decommission NOTICE, checked before classification: the
+                # mesh is still alive, so the drain hands the live state
+                # off in memory; checkpoint restore only when the drain
+                # window expired (supervisor.drain returns state=None)
+                new_loss, st = supervisor.drain(e, state)
+                loss_grad = new_loss if new_loss is not None else loss_grad
+                if st is None:
+                    got = _restore_latest_verified(checkpointer, fingerprint)
+                    if got is not None:
+                        st = OptimState.from_pytree(got[1])
+                        logger.info("post-drain resume from checkpoint "
+                                    "step %d", got[0])
+                    else:
+                        # no checkpoint yet: the DRIVER-side live state is
+                        # still valid (the _recover contract) — restarting
+                        # from scratch would silently discard real progress
+                        st = state
+                        logger.warning(
+                            "post-drain fallback: no verifiable checkpoint "
+                            "exists; resuming from the live driver-side "
+                            "state instead of restarting")
+                state = st
+                it = optimizer.iterations(loss_grad, x0, resume=state)
+                fail_count = 0
+                continue
             kind = classify_failure(e)
             if kind == "permanent":
                 logger.error("step failed permanently (%s: %s); aborting",

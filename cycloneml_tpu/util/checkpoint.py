@@ -224,15 +224,23 @@ class TrainingCheckpointer:
         steps = self.steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        last_err: Optional[Exception] = None
-        for s in reversed(steps):
-            try:
-                return s, self._verified_load(s)
-            except (CheckpointCorrupt, FileNotFoundError, OSError) as e:
-                last_err = e
-                logger.warning(
-                    "checkpoint step %d failed verification (%s); "
-                    "falling back to the previous step", s, e)
+        from cycloneml_tpu.parallel import faults
+        with tracing.span("checkpoint", "restore", step=-1):
+            # the chaos point counts REAL restore attempts (state exists
+            # and a load begins) — an empty dir raised above without
+            # firing, so the elastic suite can pin ZERO firings on the
+            # reshape / drain-resume paths against >=1 on the
+            # drain-expired checkpoint fallback
+            faults.inject("checkpoint.restore", step=None)
+            last_err: Optional[Exception] = None
+            for s in reversed(steps):
+                try:
+                    return s, self._verified_load(s)
+                except (CheckpointCorrupt, FileNotFoundError, OSError) as e:
+                    last_err = e
+                    logger.warning(
+                        "checkpoint step %d failed verification (%s); "
+                        "falling back to the previous step", s, e)
         raise CheckpointCorrupt(
             f"all {len(steps)} checkpoints under {self.directory} failed "
             f"verification; newest error: {last_err}") from last_err
@@ -242,15 +250,15 @@ class TrainingCheckpointer:
 
         With an explicit ``step``: verify and load it, raising
         :class:`CheckpointCorrupt` on damage. With ``step=None``: the
-        newest *verifiable* state (see :meth:`restore_newest_verifiable`).
-        """
-        from cycloneml_tpu.parallel import faults
-        with tracing.span("checkpoint", "restore",
-                          step=-1 if step is None else step):
-            faults.inject("checkpoint.restore", step=step)
-            if step is not None:
-                return self._verified_load(step)
+        newest *verifiable* state (see :meth:`restore_newest_verifiable`,
+        which owns the restore span + chaos point for that path — one
+        firing per restore attempt, never two)."""
+        if step is None:
             return self.restore_newest_verifiable()[1]
+        from cycloneml_tpu.parallel import faults
+        with tracing.span("checkpoint", "restore", step=step):
+            faults.inject("checkpoint.restore", step=step)
+            return self._verified_load(step)
 
     def metadata(self, step: int) -> Dict[str, Any]:
         with open(os.path.join(self._step_dir(step), "METADATA.json")) as fh:

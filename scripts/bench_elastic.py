@@ -1,0 +1,66 @@
+"""bench-elastic: time-to-resume for an elastic mesh reshape (ISSUE 15).
+
+The executable form of the elasticity contract (docs/resilience.md
+"Elasticity"): the same full→half mesh transition timed two ways on the
+8-device CPU smoke —
+
+1. **reshard-in-place** — host-bounce the live optimizer state, apply a
+   CapacityEvent through ``MeshSupervisor.reshape`` (in-memory dataset
+   migration, program-cache clear, rebuild) and run the first
+   post-transition loss/grad eval, vs
+2. **checkpoint round-trip** — ``MeshSupervisor.recover`` (dataset
+   restored from its npz checkpoint) + newest-verifiable optimizer
+   checkpoint restore (read + sha256 verify) + the same first eval.
+
+Both legs pay the new mesh's compile; the difference is state motion
+through memory vs disk+hash. Emits one JSON line (the BENCH "elastic"
+block, the same rollup ``bench.py`` embeds) and exits NON-ZERO unless the
+reshard path is strictly faster — the reason the reshape path exists is
+that it beats the restore it replaces, and a regression here means it no
+longer does. Override shapes with BENCH_ELASTIC_N / _D, trial count with
+BENCH_TRIALS. The checkpoint leg runs second each trial (warm page
+cache), so the gate is conservative.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main() -> int:
+    from cycloneml_tpu import CycloneConf, CycloneContext
+
+    import bench
+
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.master", "local-mesh[8]")
+        .set("cyclone.app.name", "bench-elastic"))
+    try:
+        out = bench.bench_elastic()
+    finally:
+        ctx.stop()
+    if out is None:
+        print("error: elastic bench produced no measurement", file=sys.stderr)
+        return 2
+    print(json.dumps({"metric": "elastic_time_to_resume",
+                      "value": out["reshard_resume_s"],
+                      "unit": "s", **{"elastic": out}}))
+    if out["reshard_resume_s"] >= out["checkpoint_resume_s"]:
+        print(f"error: reshard-in-place resume "
+              f"({out['reshard_resume_s']}s) is not faster than the "
+              f"checkpoint round-trip ({out['checkpoint_resume_s']}s) — "
+              f"the in-place path regressed", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,3 +72,20 @@ def stale_after_host_loss(runtime, bootstrap, supervisor, xb, coef):
     step = tree_aggregate(_sum_kernel, runtime, xb)
     _recover_host_loss(bootstrap, supervisor)
     return step(xb, coef)                                       # JX017
+
+
+def _apply_capacity_event(ctx, event):
+    # the elastic re-shard helper: a planned capacity event rebuilds the
+    # mesh at the event's target shape — transitively a mesh rebuild
+    clear_program_cache()
+    ctx.rebuild_mesh(event.master)
+
+
+def stale_after_capacity_reshape(runtime, ctx, event, xb, coef):
+    # the ELASTIC hazard (resume-on-new-mesh): a scale event reshaped the
+    # mesh mid-fit and the loop resumes with the pre-reshape program —
+    # the re-shard helper rebuilt the MESH but this caller never rebuilt
+    # the PROGRAM
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    _apply_capacity_event(ctx, event)
+    return step(xb, coef)                                       # JX017

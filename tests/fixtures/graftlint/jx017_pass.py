@@ -66,3 +66,20 @@ def host_loss_recover_then_rebuild(runtime, bootstrap, supervisor, xb, coef):
     supervisor.rebuild_mesh()
     step = tree_aggregate(_sum_kernel, runtime, xb)
     return step(xb, coef)
+
+
+def _apply_capacity_event(ctx, event):
+    # the elastic re-shard helper: clear, rebuild at the target shape
+    clear_program_cache()
+    ctx.rebuild_mesh(event.master)
+
+
+def capacity_reshape_then_rebind(runtime, ctx, event, xb, coef):
+    # the ELASTIC resume-on-new-mesh idiom (MeshSupervisor.reshape):
+    # clear the cache, reshape the mesh, REBUILD the program on the new
+    # runtime, then resume dispatching — the reshard helper's contract
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    out = step(xb, coef)
+    _apply_capacity_event(ctx, event)
+    step = tree_aggregate(_sum_kernel, runtime, xb)
+    return out + step(xb, coef)

@@ -635,3 +635,290 @@ def test_host_loss_via_heartbeat_marks_whole_host(ctx):
     assert set(sup.lost_workers()) == {"w0", "w1"}
     assert sup.surviving_devices() == 4
     assert sup.pending_loss() is not None
+
+
+# -- elastic meshes (ISSUE 15): scale, drain, re-dispatch ------------------------
+
+def _elastic_problem(ctx, n=256, d=6, seed=0):
+    """Problem whose dataset can be rebuilt from LIVE host memory on
+    whatever mesh is active — the in-place re-shard hook (no checkpoint
+    anywhere on the path)."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.loss import DistributedLossFunction
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+
+    def make_loss(_rt=None):
+        ds = InstanceDataset.from_numpy(ctx, x, y)
+        return DistributedLossFunction(
+            ds, aggregators.binary_logistic(d, fit_intercept=False))
+
+    return make_loss, np.zeros(d)
+
+
+def test_elastic_scale_down_then_up_resumes_in_place(ctx, tmp_path):
+    """THE ISSUE-15 acceptance e2e: a seeded `elastic.capacity` event
+    scales the mesh 8 -> 4 mid-fit, a second one scales it back 4 -> 8;
+    each lands at a SAFE step boundary, re-shards the live optimizer
+    state + dataset through memory, and resumes IN PLACE. Zero
+    checkpoint restores anywhere on the path (the chaos point counts
+    them), and the final coefficients match the uninterrupted 8-device
+    run at the documented tolerance."""
+    from cycloneml_tpu.elastic import capacity as ecap
+
+    make_loss, x0 = _elastic_problem(ctx)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(), x0)
+
+    chan = ecap.channel()
+    chan.clear()
+    sup = MeshSupervisor(ctx, on_reshard=lambda rt: make_loss(rt),
+                         capacity=chan, max_reshapes=4)
+    sched = FaultSchedule(seed=5)
+    sched.at("elastic.capacity", 6,
+             ecap.scale_to("local-mesh[4]", reason="capacity reclaimed"))
+    sched.at("elastic.capacity", 14,
+             ecap.scale_to("local-mesh[8]", reason="replacement slice up"))
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=5,
+                supervisor=sup, backoff_base_s=0.001, seed=5)
+        # both transitions fired at their seeded boundaries, nothing else
+        assert [(p, n) for p, n, _ in inj.log] == \
+            [("elastic.capacity", 6), ("elastic.capacity", 14)]
+        assert sup.reshapes == 2
+        assert sup.rebuilds == 0          # planned, not a failure
+        # IN PLACE: the reshape path never touched a checkpoint
+        assert inj.counts.get("checkpoint.restore", 0) == 0
+        assert ctx.mesh_runtime.n_devices == 8  # scaled back up
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5,
+                                   atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        chan.clear()
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_elastic_pure_reshard_matches_at_ulp(ctx, tmp_path):
+    """The pure-reshard leg: a capacity event onto the SAME shape (a
+    replacement slice) moves state through the host bounce and recompiled
+    programs only — under the f64 test config the resumed trajectory is
+    ulp-identical to the uninterrupted run, proving the reshard itself
+    adds no numeric drift."""
+    from cycloneml_tpu.elastic import capacity as ecap
+
+    make_loss, x0 = _elastic_problem(ctx, seed=3)
+    baseline = LBFGS(max_iter=25, tol=1e-9).minimize(make_loss(), x0)
+
+    chan = ecap.channel()
+    chan.clear()
+    sup = MeshSupervisor(ctx, on_reshard=lambda rt: make_loss(rt),
+                         capacity=chan)
+    sched = FaultSchedule(seed=11)
+    sched.at("elastic.capacity", 5,
+             ecap.scale_to("local-mesh[8]", reason="slice replacement"))
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=25, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=5,
+                supervisor=sup, backoff_base_s=0.001, seed=11)
+        assert [(p, n) for p, n, _ in inj.log] == [("elastic.capacity", 5)]
+        assert sup.reshapes == 1
+        assert inj.counts.get("checkpoint.restore", 0) == 0
+        np.testing.assert_array_max_ulp(final.x, baseline.x, maxulp=2)
+        assert final.iteration == baseline.iteration
+    finally:
+        chan.clear()
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_preempt_notice_drain_resumes_from_handoff(ctx, tmp_path):
+    """Preemption-aware draining: a PreemptionNotice at the
+    `multihost.preempt_notice` point (the tpu decommission signal's CPU
+    stand-in) triggers a flight dump + in-memory state handoff BEFORE
+    teardown; the rebuild over the survivors resumes from the drained
+    state — zero checkpoint restores — and matches the uninterrupted
+    run."""
+    from cycloneml_tpu.observe import flight, tracing
+    from cycloneml_tpu.parallel.faults import PreemptionNotice
+
+    make_loss, x0 = _elastic_problem(ctx, seed=7)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(), x0)
+
+    sup = MeshSupervisor(
+        ctx, worker_devices={"w0": 4, "w1": 4},
+        worker_hosts={"w0": "hostA", "w1": "hostB"},
+        on_rebuild=lambda rt: make_loss(rt))
+    sched = FaultSchedule(seed=7)
+    sched.at("multihost.preempt_notice", 9,
+             PreemptionNotice("slice hostB scheduled for reclaim",
+                              lost_hosts=["hostB"], drain_window_s=60.0))
+    own_ring = tracing.active() is None
+    if own_ring:
+        flight.enable()
+    flight.reset()
+    flight.configure(min_interval_s=0.0)
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=2,
+                supervisor=sup, backoff_base_s=0.001, seed=7)
+        assert inj.log == \
+            [("multihost.preempt_notice", 9, "PreemptionNotice")]
+        assert sup.rebuilds == 1           # the drain's rebuild
+        assert sup.drain_resumes == 1 and sup.drain_expired == 0
+        # resumed from the in-memory handoff, not a checkpoint
+        assert inj.counts.get("checkpoint.restore", 0) == 0
+        assert "hostB" in sup.lost_hosts()
+        assert ctx.mesh_runtime.n_devices == 4
+        # the drain froze the flight ring BEFORE teardown
+        reasons = [d["reason"] for d in flight.dumps()]
+        assert "preempt.drain" in reasons
+        drain_dump = next(d for d in flight.dumps()
+                          if d["reason"] == "preempt.drain")
+        assert drain_dump["attrs"]["hosts"] == "hostB"
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5,
+                                   atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        flight.configure(min_interval_s=1.0)
+        if own_ring:
+            flight.disable()
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_preempt_drain_window_expired_falls_back_to_checkpoint(ctx,
+                                                               tmp_path):
+    """The drain-window contract: a notice whose window has already
+    expired (drain_window_s=0) DISCARDS the handed-off state — stale
+    drained state is never silently resumed — and recovery falls back to
+    the newest VERIFIABLE checkpoint (the restore chaos point counts
+    exactly that), still landing on the uninterrupted answer."""
+    from cycloneml_tpu.parallel.faults import PreemptionNotice
+
+    make_loss, x0 = _elastic_problem(ctx, seed=9)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(), x0)
+
+    sup = MeshSupervisor(
+        ctx, worker_devices={"w0": 4, "w1": 4},
+        worker_hosts={"w0": "hostA", "w1": "hostB"},
+        on_rebuild=lambda rt: make_loss(rt))
+    sched = FaultSchedule(seed=9)
+    sched.at("multihost.preempt_notice", 9,
+             PreemptionNotice("hostB reclaimed NOW", lost_hosts=["hostB"],
+                              drain_window_s=0.0))
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=2,
+                supervisor=sup, backoff_base_s=0.001, seed=9)
+        assert inj.log == \
+            [("multihost.preempt_notice", 9, "PreemptionNotice")]
+        assert sup.drain_expired == 1 and sup.drain_resumes == 0
+        # the fallback really read a checkpoint
+        assert inj.counts.get("checkpoint.restore", 0) >= 1
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5,
+                                   atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_elastic_straggler_lane_redispatch_first_result_wins(ctx):
+    """Straggler re-dispatch e2e (Spark speculation): a seeded chaos
+    delay slows one oocore shard lane until the detector latches it;
+    `supervisor.stragglers()` feeds the armed Speculator, the lane's
+    NEXT staging re-dispatches a concurrent duplicate, the first result
+    wins and the duplicate dedups BITWISE — and the fit's numbers are
+    bit-identical to the unspeculated run."""
+    from cycloneml_tpu.elastic import speculation
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.observe import skew
+    from cycloneml_tpu.oocore.objective import StreamingLossFunction
+
+    det = skew.SkewDetector(window=32, min_samples=4)
+    prev = skew.install(det)
+    sds = _oocore_fixture(ctx)   # 1200 rows / 400-row shards = 3 lanes
+    sup = MeshSupervisor(ctx).attach_skew(det)
+    sp = speculation.Speculator(sup.stragglers)
+    speculation.install(sp)
+    try:
+        n_shards = sds.n_shards
+        assert n_shards == 3
+        d = 6
+        loss = StreamingLossFunction(
+            sds, aggregators.binary_logistic(d, fit_intercept=False))
+        coef = np.zeros(d)
+        ref = loss(coef)         # clean epoch: the bitwise reference
+        epochs = 8
+        # staging walks shards in order: delaying invocations 3, 6, 9...
+        # (1-based, counted from the injector install) slows EXACTLY the
+        # shard-2 lane every epoch
+        sched = FaultSchedule(seed=0)
+        sched.at("oocore.stage",
+                 range(n_shards, epochs * n_shards + 1, n_shards), None,
+                 delay_s=0.03)
+        with FaultInjector(sched) as inj:
+            for _ in range(epochs):
+                loss(coef)
+        assert len(inj.log) == epochs
+        # detection latched and reached the supervisor's mitigation input
+        assert "oocore.stage:shard2" in sup.stragglers()
+        # the NEXT epoch re-dispatches the latched lane's staging
+        out = loss(coef)
+        st = sp.stats()
+        lanes = [r["lane"] for r in st["re_dispatches"]]
+        assert "oocore.stage:shard2" in lanes
+        # the losing duplicate dedups off the critical path — poll
+        deadline = time.time() + 5.0
+        while sp.stats()["dedup_hits"] < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        st = sp.stats()
+        assert st["dedup_hits"] >= 1      # duplicate deduped bitwise
+        assert st["mismatches"] == 0
+        # first-result-wins changed NOTHING: bit-identical epoch numbers
+        assert out[0] == ref[0]
+        np.testing.assert_array_equal(out[1], ref[1])
+    finally:
+        speculation.uninstall(sp)
+        sp.close()
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)
+        sds.close()
+
+
+def test_elastic_max_reshapes_budget_exhaustion(ctx, tmp_path):
+    """Capacity events past max_reshapes abort with MeshDegradedError —
+    a flapping autoscaler is refused loudly, exactly as a flapping mesh
+    is, WITHOUT eating the failure-recovery rebuild budget."""
+    from cycloneml_tpu.elastic import capacity as ecap
+
+    make_loss, x0 = _elastic_problem(ctx, seed=4)
+    chan = ecap.channel()
+    chan.clear()
+    sup = MeshSupervisor(ctx, on_reshard=lambda rt: make_loss(rt),
+                         capacity=chan, max_reshapes=1)
+    sched = FaultSchedule(seed=4)
+    sched.at("elastic.capacity", 4, ecap.scale_to("local-mesh[4]"))
+    sched.at("elastic.capacity", 8, ecap.scale_to("local-mesh[8]"))
+    try:
+        with FaultInjector(sched):
+            with pytest.raises(MeshDegradedError, match="max_reshapes"):
+                train_with_checkpoints(
+                    LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                    TrainingCheckpointer(str(tmp_path / "opt")),
+                    interval=5, supervisor=sup, backoff_base_s=0.001,
+                    seed=4)
+        assert sup.reshapes == 1
+        assert sup.rebuilds == 0   # the reshape budget is its own
+    finally:
+        chan.clear()
+        ctx.rebuild_mesh("local-mesh[8]")

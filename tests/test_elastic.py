@@ -1,0 +1,456 @@
+"""Elastic-mesh unit tests (ISSUE 15): capacity channel, live-state
+motion, the runtime stale-program guard, speculative re-dispatch, and
+the streamed objective's reshard — the chaos e2e legs live in
+tests/test_chaos.py."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.elastic import (CapacityChannel, CapacityEvent,
+                                   Speculator, bitwise_equal, host_bounce,
+                                   host_bounce_state)
+from cycloneml_tpu.elastic import capacity as ecap
+from cycloneml_tpu.elastic import speculation
+
+
+# -- capacity channel ------------------------------------------------------------
+
+def test_capacity_channel_is_fifo():
+    ch = CapacityChannel()
+    assert ch.peek() is None and ch.take() is None and len(ch) == 0
+    a = CapacityEvent(master="local-mesh[4]", reason="reclaim")
+    b = CapacityEvent(master="local-mesh[8]", returning=["w1"])
+    ch.announce(a)
+    ch.announce(b)
+    assert len(ch) == 2
+    assert ch.peek() is a          # peek does not consume
+    assert ch.take() is a          # FIFO: no coalescing — a scale-down
+    assert ch.take() is b          # then scale-up applies in order
+    ch.announce(a)
+    ch.clear()
+    assert len(ch) == 0
+
+
+def test_scale_to_announces_on_global_channel():
+    ch = ecap.channel()
+    ch.clear()
+    try:
+        action = ecap.scale_to("local-mesh[4]", reason="test",
+                               returning=["w1"])
+        # the FaultInjector calls actions with (point, invocation, **info)
+        action(point="elastic.capacity", invocation=7, iteration=6)
+        ev = ch.take()
+        assert ev is not None and ev.master == "local-mesh[4]"
+        assert "elastic.capacity#7" in ev.reason
+        assert ev.returning == ["w1"]
+    finally:
+        ch.clear()
+
+
+# -- bitwise dedup comparator ----------------------------------------------------
+
+def test_bitwise_equal_semantics():
+    a = np.arange(6, dtype=np.float64)
+    assert bitwise_equal(a, a.copy())
+    assert not bitwise_equal(a, a.astype(np.float32))      # dtype differs
+    assert bitwise_equal(float("nan"), float("nan"))       # bit-level
+    assert bitwise_equal((a, {"k": 1.0}), (a.copy(), {"k": 1.0}))
+    assert not bitwise_equal((a, 1.0), (a, 2.0))
+    assert not bitwise_equal({"k": a}, {"j": a})
+
+
+# -- speculator ------------------------------------------------------------------
+
+def _always_latched():
+    return {"g:p": {}}
+
+
+def _wait_for(predicate, timeout_s=5.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_speculate_concurrent_race_dedups_bitwise():
+    sp = Speculator(_always_latched)
+    try:
+        out = sp.speculate("g", "p", lambda: np.arange(4) * 2.0)
+        np.testing.assert_array_equal(out, np.arange(4) * 2.0)
+        # the loser dedups OFF the caller's critical path — poll
+        assert _wait_for(lambda: sp.stats()["dedup_hits"] == 1)
+        st = sp.stats()
+        assert st["mismatches"] == 0
+        assert st["re_dispatches"][0]["lane"] == "g:p"
+        assert st["re_dispatches"][0]["dedup"] is True
+    finally:
+        sp.close()
+
+
+def test_speculate_backup_rescues_failed_primary():
+    """The classic speculation win: the primary copy dies, the duplicate
+    still lands the lane's work."""
+    sp = Speculator(_always_latched)
+    calls = {"n": 0}
+
+    def flaky():
+        with sp._lock:  # deterministic: first caller fails
+            calls["n"] += 1
+            first = calls["n"] == 1
+        if first:
+            raise OSError("bad spindle")
+        return np.ones(3)
+
+    try:
+        out = sp.speculate("g", "p", flaky)
+        np.testing.assert_array_equal(out, np.ones(3))
+        st = sp.stats()
+        assert st["re_dispatches"][0]["winner"] in ("primary", "backup")
+        assert st["dedup_hits"] == 0   # only one result to dedup against
+    finally:
+        sp.close()
+
+
+def test_speculate_both_copies_fail_raises_primary_error():
+    sp = Speculator(_always_latched)
+
+    def dead():
+        raise ValueError("lane is broken, not slow")
+
+    try:
+        with pytest.raises(ValueError, match="broken"):
+            sp.speculate("g", "p", dead)
+        assert sp.stats()["re_dispatches"][0]["winner"] is None
+    finally:
+        sp.close()
+
+
+def test_speculate_mismatch_keeps_first_result_and_counts():
+    """Nondeterministic lane work cannot dedup: first-result-wins holds,
+    the mismatch is counted (and logged) instead of silently merged."""
+    sp = Speculator(_always_latched)
+    seq = iter([np.zeros(2), np.ones(2)])
+    lock = threading.Lock()
+
+    def nondet():
+        with lock:
+            return next(seq)
+
+    try:
+        out = sp.speculate("g", "p", nondet, concurrent=False)
+        np.testing.assert_array_equal(out, np.zeros(2))  # first wins
+        st = sp.stats()
+        assert st["mismatches"] == 1 and st["dedup_hits"] == 0
+    finally:
+        sp.close()
+
+
+def test_speculation_budget_per_lane_saturates():
+    """A permanently convicted lane stops doubling its work after
+    max_per_lane re-dispatches (Spark bounds speculative copies too)."""
+    sp = Speculator(_always_latched, max_per_lane=2)
+    try:
+        assert sp.latched("g", "p")
+        sp.speculate("g", "p", lambda: 1.0, concurrent=False)
+        sp.speculate("g", "p", lambda: 1.0, concurrent=False)
+        assert not sp.latched("g", "p")    # budget spent
+        # maybe_speculate now runs the work PLAIN
+        prev = speculation.install(sp)
+        try:
+            out = speculation.maybe_speculate("g", "p", lambda: 7.0)
+            assert out == 7.0
+            assert len(sp.stats()["re_dispatches"]) == 2  # unchanged
+        finally:
+            speculation.uninstall(sp)
+            if prev is not None:
+                speculation.install(prev)
+    finally:
+        sp.close()
+
+
+def test_maybe_speculate_disarmed_is_plain_call():
+    assert speculation.active() is None
+    assert speculation.maybe_speculate("g", "p", lambda: 42) == 42
+
+
+# -- live-state motion -----------------------------------------------------------
+
+def test_host_bounce_pulls_device_leaves_once(ctx):
+    import jax
+    dev = ctx.mesh_runtime.device_put_replicated(
+        {"a": np.arange(8.0), "b": np.ones((2, 3))})
+    tree = {"dev": dev, "host": np.full(3, 7.0), "scalar": 1.5}
+    out = host_bounce(tree)
+    assert isinstance(out["dev"]["a"], np.ndarray)
+    assert not isinstance(out["dev"]["a"], jax.Array)
+    np.testing.assert_array_equal(out["dev"]["a"], np.arange(8.0))
+    assert out["host"] is tree["host"]       # host leaves pass through
+    assert out["scalar"] == 1.5
+
+
+def test_host_bounce_state_roundtrips_optimstate_bitwise():
+    from cycloneml_tpu.ml.optim.lbfgs import OptimState
+    st = OptimState(x=np.arange(4.0), value=0.5, grad=np.ones(4),
+                    iteration=3, loss_history=[1.0, 0.5],
+                    hist_s=[np.arange(4.0)], hist_y=[np.ones(4)])
+    out = host_bounce_state(st)
+    assert out.iteration == 3 and out.value == 0.5
+    np.testing.assert_array_equal(out.x, st.x)
+    np.testing.assert_array_equal(out.hist_s[0], st.hist_s[0])
+    assert host_bounce_state(None) is None
+
+
+# -- runtime stale-program guard (the JX017 twin) --------------------------------
+
+def test_stale_program_dispatch_raises_classified_error(ctx):
+    from cycloneml_tpu import mesh as mesh_mod
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.parallel.collectives import StaleProgramError
+    from cycloneml_tpu.parallel.resilience import classify_failure
+
+    rng = np.random.RandomState(0)
+    ds = InstanceDataset.from_numpy(ctx, rng.randn(64, 4),
+                                    (rng.randn(64) > 0).astype(float))
+
+    def agg(x, y, w):
+        import jax.numpy as jnp
+        return {"s": jnp.sum(x * w[:, None])}
+
+    call = ds.tree_aggregate_fn(agg)
+    before = call()            # live mesh: dispatches fine
+    epoch0 = mesh_mod.mesh_epoch()
+    try:
+        ctx.rebuild_mesh("local-mesh[8]")   # same shape, NEW generation
+        assert mesh_mod.mesh_epoch() > epoch0
+        with pytest.raises(StaleProgramError, match="mesh epoch"):
+            call.compiled(ds.x, ds.y, ds.w)
+        # the guard is classified PERMANENT: retrying a stale program
+        # re-raises identically — the caller must rebuild it
+        try:
+            call.compiled(ds.x, ds.y, ds.w)
+        except StaleProgramError as e:
+            assert classify_failure(e) == "permanent"
+        # the sanctioned idiom: REBUILD on the new runtime
+        fresh = ds.tree_aggregate_fn(agg)
+        after = fresh()
+        np.testing.assert_allclose(float(after["s"]), float(before["s"]),
+                                   rtol=1e-12)
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+# -- streamed objective reshard --------------------------------------------------
+
+def test_streaming_loss_reshard_rebinds_across_reshape(ctx):
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore import StreamingDataset
+    from cycloneml_tpu.oocore.objective import StreamingLossFunction
+    from cycloneml_tpu.parallel.collectives import StaleProgramError
+
+    rng = np.random.RandomState(5)
+    n, d = 900, 5
+    x = rng.randn(n, d)
+    y = (x[:, 0] > 0).astype(float)
+
+    def chunks():
+        for lo in range(0, n, 300):
+            yield x[lo:lo + 300], y[lo:lo + 300], None
+
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d, shard_rows=300)
+    try:
+        loss = StreamingLossFunction(
+            sds, aggregators.binary_logistic(d, fit_intercept=False))
+        coef = np.zeros(d)
+        ref = loss(coef)
+        epochs_before = loss.epochs
+        ctx.rebuild_mesh("local-mesh[4]")
+        # the held per-shard program closes over the OLD mesh: the
+        # runtime guard refuses it instead of running on dead devices
+        with pytest.raises(StaleProgramError):
+            loss(coef)
+        loss.reshard()
+        out = loss(coef)
+        # stream position (epoch/eval counters) carried over untouched;
+        # only psum grouping differs (4 vs 8 devices) -> f64 ulp noise
+        assert loss.epochs > epochs_before
+        assert out[0] == pytest.approx(ref[0], rel=1e-12)
+        np.testing.assert_allclose(out[1], ref[1], rtol=1e-9)
+    finally:
+        ctx.rebuild_mesh("local-mesh[8]")
+        sds.close()
+
+
+def test_streaming_loss_reshard_rejects_indivisible_geometry(ctx):
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.oocore import StreamingDataset
+    from cycloneml_tpu.oocore.objective import StreamingLossFunction
+
+    rng = np.random.RandomState(6)
+    x = rng.randn(200, 3)
+    y = (x[:, 0] > 0).astype(float)
+    sds = StreamingDataset.from_chunks(
+        ctx, iter([(x, y, None)]), 3, shard_rows=200)
+    try:
+        loss = StreamingLossFunction(
+            sds, aggregators.binary_logistic(3, fit_intercept=False))
+
+        class _FakeRT:
+            data_parallelism = 7   # does not divide padRows (mult. of 64)
+
+        with pytest.raises(ValueError, match="does not divide"):
+            loss.reshard(_FakeRT())
+    finally:
+        sds.close()
+
+
+# -- conf-armed wiring through the context ---------------------------------------
+
+def test_mesh_supervisor_arms_speculation_from_conf(ctx):
+    from cycloneml_tpu.conf import ELASTIC_SPECULATION
+    assert speculation.active() is None
+    ctx.conf.set(ELASTIC_SPECULATION, True)
+    try:
+        sup = ctx.mesh_supervisor()
+        sp = speculation.active()
+        assert sp is not None
+        # the armed provider consumes the SUPERVISOR's verdict record
+        assert not sp.latched("oocore.stage", "shard0")
+        # default capacity channel attached: the process-global one
+        ch = ecap.channel()
+        ch.clear()
+        ch.announce(CapacityEvent(master="local-mesh[8]"))
+        assert sup.pending_capacity() is not None
+        ch.clear()
+    finally:
+        ctx.conf.set(ELASTIC_SPECULATION, False)
+        sp = speculation.active()
+        if sp is not None:
+            speculation.uninstall(sp)
+            sp.close()
+        if sp in getattr(ctx, "_speculators", []):
+            ctx._speculators.remove(sp)
+
+
+# -- stacked/CV fit lanes --------------------------------------------------------
+
+def test_fit_lane_straggler_redispatch_serial_dedup(ctx):
+    """A tuning grid point with a latched fit.lane verdict re-dispatches
+    its next fit+score SERIALLY (two concurrent SPMD programs would
+    deadlock the shared mesh) with first-result-wins; the duplicate
+    dedups bitwise and the selected model is unchanged."""
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.evaluation import RegressionEvaluator
+    from cycloneml_tpu.ml.regression import LinearRegression
+    from cycloneml_tpu.ml.tuning import (ParamGridBuilder,
+                                         TrainValidationSplit)
+
+    rng = np.random.RandomState(8)
+    x = rng.randn(160, 3)
+    y = x @ np.array([1.0, -2.0, 0.5]) + 0.1 * rng.randn(160)
+    frame = MLFrame(ctx, {"features": x, "label": y})
+
+    def build():
+        linreg = LinearRegression()
+        grid = (ParamGridBuilder()
+                .add_grid(linreg.get_param("regParam"), [0.0, 50.0])
+                .build())
+        return TrainValidationSplit(
+            estimator=linreg, estimator_param_maps=grid,
+            evaluator=RegressionEvaluator(metricName="rmse"), seed=42)
+
+    reference = build().fit(frame)
+
+    sp = Speculator(lambda: {"fit.lane:grid1"})
+    prev = speculation.install(sp)
+    try:
+        model = build().fit(frame)
+        st = sp.stats()
+        lanes = [r["lane"] for r in st["re_dispatches"]]
+        assert "fit.lane:grid1" in lanes       # the latched lane re-ran
+        assert "fit.lane:grid0" not in lanes   # unconvicted lane did not
+        assert st["dedup_hits"] >= 1 and st["mismatches"] == 0
+        assert model.best_model.get("regParam") == \
+            reference.best_model.get("regParam")
+        assert model.avg_metrics == reference.avg_metrics
+    finally:
+        speculation.uninstall(sp)
+        sp.close()
+
+
+def test_fit_lanes_feed_skew_detector(ctx):
+    """Serial tuning lanes record fit.lane samples — the detection input
+    the re-dispatch consumes (one position per grid point)."""
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.evaluation import RegressionEvaluator
+    from cycloneml_tpu.ml.regression import LinearRegression
+    from cycloneml_tpu.ml.tuning import (ParamGridBuilder,
+                                         TrainValidationSplit)
+    from cycloneml_tpu.observe import skew
+
+    det = skew.SkewDetector(window=16, min_samples=2)
+    prev = skew.install(det)
+    try:
+        rng = np.random.RandomState(9)
+        x = rng.randn(120, 3)
+        y = x @ np.array([1.0, -2.0, 0.5])
+        frame = MLFrame(ctx, {"features": x, "label": y})
+        linreg = LinearRegression()
+        grid = (ParamGridBuilder()
+                .add_grid(linreg.get_param("regParam"), [0.0, 1.0])
+                .build())
+        TrainValidationSplit(
+            estimator=linreg, estimator_param_maps=grid,
+            evaluator=RegressionEvaluator(metricName="rmse"),
+            seed=42).fit(frame)
+        lanes = det._samples.get("fit.lane", {})
+        assert set(lanes) == {"grid0", "grid1"}
+        assert all(len(dq) == 1 for dq in lanes.values())
+    finally:
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)
+
+
+# -- the preemption signal hook --------------------------------------------------
+
+def test_preemption_signal_routes_to_capacity_channel():
+    from cycloneml_tpu.multihost import bootstrap
+
+    ch = CapacityChannel()
+    prev = signal.getsignal(signal.SIGUSR1)
+    try:
+        ok = bootstrap.install_preemption_handler(
+            lambda: ch.announce(CapacityEvent(
+                master="local-mesh[4]", reason="preempt signal")),
+            signals=(signal.SIGUSR1,))
+        assert ok
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 2.0
+        while len(ch) == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(ch) == 1
+        assert ch.take().reason == "preempt signal"
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_preemption_handler_refuses_off_main_thread():
+    from cycloneml_tpu.multihost import bootstrap
+
+    out = {}
+
+    def run():
+        out["ok"] = bootstrap.install_preemption_handler(
+            lambda: None, signals=(signal.SIGUSR1,))
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["ok"] is False

@@ -655,3 +655,84 @@ def test_heartbeat_sender_reports_rtt_to_receiver(monkeypatch):
     finally:
         sender.stop()
         server.stop()
+
+
+# -- elastic liveness re-arm (ISSUE 15 satellite) --------------------------------
+
+def test_returning_worker_rearms_liveness_after_scale_up(ctx):
+    """REGRESSION: a worker that left on scale-down and re-registers on
+    scale-up must get a FRESH liveness window. Pre-fix, the supervisor
+    kept its lost marker forever (surviving-device math never recovered)
+    and the HealthTracker kept its strike, so ONE new hiccup on the new
+    mesh hit max_failures=2 and excluded the returning worker."""
+    import time
+
+    from cycloneml_tpu.parallel.resilience import MeshSupervisor
+
+    recv = HeartbeatReceiver(timeout_s=0.05)  # swept manually
+    sup = MeshSupervisor(
+        ctx, worker_devices={"w0": 4, "w1": 4},
+        worker_hosts={"w0": "hostA", "w1": "hostB"}).attach(recv)
+    recv.register("w0")
+    recv.register("w1")
+    time.sleep(0.06)            # both stale...
+    recv.heartbeat("w0")        # ...w0's ping arrives in time...
+    recv.check_now()            # ...w1 expires -> supervisor notified
+    assert "w1" in sup.lost_workers()
+    assert "hostB" in sup.lost_hosts()
+    assert sup.surviving_devices() == 4
+    assert sup.pending_loss() is not None
+    assert recv.heartbeat("w1") is False   # expired: must re-register
+
+    # scale-up: w1 returns and re-registers -> everything re-arms
+    recv.register("w1")
+    assert "w1" not in sup.lost_workers()
+    assert sup.lost_hosts() == {}
+    assert sup.surviving_devices() == 8
+    assert sup.pending_loss() is None      # nothing left to recover from
+    assert recv.heartbeat("w1") is True    # fresh receiver window too
+
+    # fresh failure budget: one NEW strike must not exclude (the pre-fix
+    # inherited strike plus this one reached max_failures=2)
+    sup.note_worker_lost("w1", "fresh hiccup on the new mesh")
+    assert sup.health.is_excluded("w1") is False
+
+
+def test_readmit_resets_heartbeat_rtt_straggler_lane(ctx):
+    """readmit() also restarts the returning worker's heartbeat-RTT
+    straggler lane: pre-departure samples (and a latched verdict)
+    describe the OLD placement and must not convict the fresh one."""
+    from cycloneml_tpu.observe import skew
+    from cycloneml_tpu.parallel.resilience import MeshSupervisor
+
+    det = skew.SkewDetector(window=16, min_samples=4)
+    prev = skew.install(det)
+    try:
+        sup = MeshSupervisor(ctx, worker_devices={"w9": 4}).attach_skew(det)
+        for _ in range(8):
+            det.observe("heartbeat.rtt", "a", 0.001)
+            det.observe("heartbeat.rtt", "b", 0.001)
+            det.observe("heartbeat.rtt", "w9", 0.050)
+        assert ("heartbeat.rtt", "w9") in det.stragglers()
+        assert "heartbeat.rtt:w9" in sup.stragglers()
+        sup.note_worker_lost("w9", "drained on scale-down")
+        sup.readmit("w9")
+        # lane forgotten in the DETECTOR and in the supervisor's record
+        assert ("heartbeat.rtt", "w9") not in det.stragglers()
+        assert "heartbeat.rtt:w9" not in sup.stragglers()
+        assert "w9" not in sup.lost_workers()
+    finally:
+        skew.uninstall(det)
+        if prev is not None:
+            skew.install(prev)
+
+
+def test_health_tracker_forgive():
+    """forgive() clears the strike history — the readmission primitive."""
+    h = HealthTracker(max_failures=2)
+    h.record_failure("w")
+    h.record_failure("w")
+    assert h.is_excluded("w")
+    h.forgive("w")
+    assert not h.is_excluded("w")
+    assert h.excluded() == []
