@@ -186,7 +186,7 @@ def main(argv=None) -> int:
         findings, grandfathered = baseline_mod.apply_baseline(findings, known)
 
     if args.as_sarif:
-        out = render_sarif(findings, grandfathered)
+        out = render_sarif(findings, grandfathered, timings=timings)
     elif args.as_json:
         out = render_json(findings, grandfathered, timings=timings)
     else:

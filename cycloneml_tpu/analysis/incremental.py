@@ -26,7 +26,9 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from cycloneml_tpu.analysis.engine import ModuleInfo, load_module
 
-CACHE_VERSION = 3   # bump when ModuleInfo/FunctionInfo shape changes
+CACHE_VERSION = 4   # bump when ModuleInfo/FunctionInfo shape changes
+# (v4: JX020-JX023 summary schemas — JXFAULT reachability + JX022
+# teardown-param sets joined the pickled per-module fact surface)
 DEFAULT_CACHE = ".graftlint-cache.pkl"
 
 

@@ -1,0 +1,359 @@
+"""Registry extractors for the v5 coverage rules (JX020-JX023).
+
+The distributed-runtime subsystems keep three registries the v5 rules
+cross-check code against, none of which lives in an importable constant:
+
+* the **fault-point table** — the reST table in ``parallel/faults.py``'s
+  module docstring is the authoritative list of injection points (the
+  docs, the chaos tests and the sites all reference it);
+* the **event registry** — every ``CycloneEvent`` subclass, discovered
+  from class bases across the analyzed set;
+* the **lifecycle registry** — classes with a stop/close/shutdown
+  discipline, discovered from methods that latch a stop flag
+  (``self._stop = True`` / ``self._stop.set()``) and from sibling
+  methods that test the flag and raise.
+
+Everything here is pure ``ast`` over already-parsed modules, cached per
+:class:`~.engine.AnalysisContext` (the jx019 conf-registry pattern): one
+extraction pass serves every rule and every module's ``check()``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (call_name, dotted_name,
+                                            iter_own_statements,
+                                            last_component)
+
+# -- fault-point registry ------------------------------------------------------
+
+#: a table row: the backticked point name anchored at column 0, dotted
+#: (prose mentions like ````inject()```` carry no dot / carry parens)
+_ROW_RE = re.compile(r"^``([A-Za-z0-9_]+(?:\.[A-Za-z0-9_]+)+)``(?:\s|$)")
+_DELIM_RE = re.compile(r"^=+\s+=+\s*$")
+
+#: call names that fire an injection point (``faults.inject`` is the
+#: public site API; ``fire`` is the injector's internal dispatch)
+SITE_CALLS = {"inject", "fire"}
+
+
+@dataclass
+class FaultPoint:
+    name: str
+    module_path: str
+    line: int           # 1-based file line of the table row
+
+
+@dataclass
+class InjectionSite:
+    point: str
+    node: ast.Call
+    module_path: str
+    function: str       # enclosing function qualname ("" = module level)
+
+
+@dataclass
+class FaultRegistry:
+    points: Dict[str, FaultPoint] = field(default_factory=dict)
+    #: the module(s) hosting a table — findings for unfired points anchor
+    #: on the table row in its own module
+    table_modules: Set[str] = field(default_factory=set)
+
+
+def _module_docstring(tree: ast.Module) -> Optional[ast.Constant]:
+    if tree.body and isinstance(tree.body[0], ast.Expr) \
+            and isinstance(tree.body[0].value, ast.Constant) \
+            and isinstance(tree.body[0].value.value, str):
+        return tree.body[0].value
+    return None
+
+
+def _hosts_fault_table(tree: ast.Module) -> bool:
+    """A module owns a fault-point table when it defines the injection
+    machinery itself — the public ``inject`` entry or the injector."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.FunctionDef) and stmt.name == "inject":
+            return True
+        if isinstance(stmt, ast.ClassDef) and stmt.name == "FaultInjector":
+            return True
+    return False
+
+
+def parse_fault_table(doc: str, first_line: int) -> List[Tuple[str, int]]:
+    """``(point, file_line)`` rows of the ====-delimited docstring table.
+
+    Rows are only read between the table delimiters (the opening rule,
+    the header rule, the closing rule) so backticked names elsewhere in
+    the docstring never register points."""
+    out: List[Tuple[str, int]] = []
+    delims = 0
+    for i, line in enumerate(doc.split("\n")):
+        if _DELIM_RE.match(line.strip()):
+            delims += 1
+            continue
+        if not 1 <= delims <= 2:
+            continue
+        m = _ROW_RE.match(line)
+        if m:
+            out.append((m.group(1), first_line + i))
+    return out
+
+
+def fault_registry(ctx) -> FaultRegistry:
+    """Fault points registered anywhere in the analyzed set (cached)."""
+    cached = getattr(ctx, "_fault_registry", None)
+    if cached is not None and getattr(ctx, "_fault_registry_ctx", None) is ctx:
+        return cached
+    reg = FaultRegistry()
+    for mod in ctx.modules.values():
+        # cheap text gate: tables are rare, backticks + '=' rules rarer
+        if not any("====" in ln for ln in mod.source_lines):
+            continue
+        doc = _module_docstring(mod.tree)
+        if doc is None or not _hosts_fault_table(mod.tree):
+            continue
+        reg.table_modules.add(mod.path)
+        for name, line in parse_fault_table(doc.value, doc.lineno):
+            reg.points.setdefault(name, FaultPoint(name, mod.path, line))
+    ctx._fault_registry = reg
+    ctx._fault_registry_ctx = ctx
+    return reg
+
+
+def is_injection_call(node: ast.AST) -> Optional[str]:
+    """The dotted point name when ``node`` is ``faults.inject("a.b", ...)``
+    / ``inj.fire("a.b", ...)`` — a dotted string literal as the first
+    argument; ``fire(point, **info)`` forwarding a variable is not a
+    site."""
+    if not isinstance(node, ast.Call):
+        return None
+    base = last_component(call_name(node) or "")
+    if base not in SITE_CALLS:
+        return None
+    if not node.args or not isinstance(node.args[0], ast.Constant) \
+            or not isinstance(node.args[0].value, str):
+        return None
+    point = node.args[0].value
+    return point if "." in point else None
+
+
+def injection_sites(ctx) -> List[InjectionSite]:
+    """Every literal injection site in the analyzed set (cached)."""
+    cached = getattr(ctx, "_fault_sites", None)
+    if cached is not None and getattr(ctx, "_fault_sites_ctx", None) is ctx:
+        return cached
+    sites: List[InjectionSite] = []
+    for mod in ctx.modules.values():
+        if not any(".inject(" in ln or ".fire(" in ln or "inject(" in ln
+                   for ln in mod.source_lines):
+            continue
+        owners = _node_owners(mod)
+        for node in ast.walk(mod.tree):
+            point = is_injection_call(node)
+            if point is not None:
+                sites.append(InjectionSite(point, node, mod.path,
+                                           owners.get(id(node), "")))
+    ctx._fault_sites = sites
+    ctx._fault_sites_ctx = ctx
+    return sites
+
+
+def _node_owners(mod) -> Dict[int, str]:
+    """id(node) -> enclosing function qualname, for finding attribution."""
+    out: Dict[int, str] = {}
+    for fn in mod.functions:
+        for node in iter_own_statements(fn.node):
+            out[id(node)] = fn.qualname
+    return out
+
+
+# -- event registry ------------------------------------------------------------
+
+EVENT_BASE = "CycloneEvent"
+
+
+def event_registry(ctx) -> Dict[str, str]:
+    """Event class name -> defining module path: the transitive subclass
+    closure of ``CycloneEvent`` across the analyzed set (cached). Empty
+    when the base class itself is not in the set — no registry, nothing
+    to cross-check."""
+    cached = getattr(ctx, "_event_registry", None)
+    if cached is not None and getattr(ctx, "_event_registry_ctx", None) is ctx:
+        return cached
+    bases_of: Dict[str, Set[str]] = {}
+    defined_in: Dict[str, str] = {}
+    base_defined = False
+    # no text pre-gate here: a second-level subclass
+    # (``class Ghost(BlocksMigrated)``) lives in a module that never
+    # spells the base name — only the closure below can see it
+    for mod in ctx.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name == EVENT_BASE:
+                base_defined = True
+                continue
+            names = {last_component(dotted_name(b)) for b in node.bases}
+            bases_of.setdefault(node.name, set()).update(
+                n for n in names if n)
+            defined_in.setdefault(node.name, mod.path)
+    registry: Dict[str, str] = {}
+    if base_defined:
+        known = {EVENT_BASE}
+        changed = True
+        while changed:     # transitive: PrecisionFallback(CycloneEvent) ...
+            changed = False
+            for name, bases in bases_of.items():
+                if name not in registry and bases & known:
+                    registry[name] = defined_in[name]
+                    known.add(name)
+                    changed = True
+    ctx._event_registry = registry
+    ctx._event_registry_ctx = ctx
+    return registry
+
+
+def handled_event_names(ctx) -> Set[str]:
+    """Event names that appear as an exact string literal anywhere in the
+    analyzed set — the handled set (status-store ``elif`` branches,
+    journal filters, webui rollups all dispatch on the literal type
+    name; ``to_json`` writes it as ``d["Event"]``)."""
+    cached = getattr(ctx, "_event_handled", None)
+    if cached is not None and getattr(ctx, "_event_handled_ctx", None) is ctx:
+        return cached
+    registry = event_registry(ctx)
+    handled: Set[str] = set()
+    if registry:
+        names = set(registry)
+        for mod in ctx.modules.values():
+            if not any(n in ln for ln in mod.source_lines for n in names):
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in names:
+                    handled.add(node.value)
+            if handled == names:
+                break
+    ctx._event_handled = handled
+    ctx._event_handled_ctx = ctx
+    return handled
+
+
+# -- lifecycle registry --------------------------------------------------------
+
+STOP_METHOD_NAMES = {"stop", "close", "shutdown"}
+
+
+@dataclass
+class LifecycleClass:
+    name: str
+    module_path: str
+    #: flag attribute -> "bool" (``self._stop = True``) or "event"
+    #: (``self._stop.set()``)
+    flags: Dict[str, str] = field(default_factory=dict)
+    #: methods that latch a stop flag (the teardown entry points)
+    stop_methods: Set[str] = field(default_factory=set)
+    #: method name -> the flag it tests before raising (dispatch guards)
+    guarded: Dict[str, str] = field(default_factory=dict)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` for a ``self.X`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _flag_transitions(method: ast.AST) -> Dict[str, str]:
+    """Flags this method latches: ``self.X = True`` -> bool flag,
+    ``self.X.set()`` -> event flag."""
+    out: Dict[str, str] = {}
+    for node in iter_own_statements(method):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and node.value.value is True:
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out[attr] = "bool"
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set" and not node.args:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                out[attr] = "event"
+    return out
+
+
+def _guard_flag(method: ast.AST, flags: Dict[str, str]) -> Optional[str]:
+    """The stop flag this method tests before raising, if any: an ``if``
+    whose test reads ``self.X`` (bool) / ``self.X.is_set()`` (event) and
+    whose body raises — the dispatch-after-stop rejection idiom."""
+    for node in iter_own_statements(method):
+        if not isinstance(node, ast.If):
+            continue
+        tested: Optional[str] = None
+        for sub in ast.walk(node.test):
+            attr = _self_attr(sub)
+            if attr in flags and flags[attr] == "bool":
+                tested = attr
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "is_set":
+                attr = _self_attr(sub.func.value)
+                if attr in flags:
+                    tested = attr
+        if tested is None:
+            continue
+        if any(isinstance(s, ast.Raise) for s in ast.walk(node)):
+            return tested
+    return None
+
+
+def lifecycle_registry(ctx) -> Dict[str, LifecycleClass]:
+    """Class name -> lifecycle model, discovered from the stop/close
+    discipline across the analyzed set (cached). A class qualifies when
+    a stop/close/shutdown method latches a flag; same-named classes in
+    different modules keep the first discovery (the resolver's own
+    merge policy for ambiguous names)."""
+    cached = getattr(ctx, "_lifecycle_registry", None)
+    if cached is not None \
+            and getattr(ctx, "_lifecycle_registry_ctx", None) is ctx:
+        return cached
+    registry: Dict[str, LifecycleClass] = {}
+    for mod in ctx.modules.values():
+        if not any("def stop" in ln or "def close" in ln
+                   or "def shutdown" in ln for ln in mod.source_lines):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [s for s in node.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            lc = LifecycleClass(node.name, mod.path)
+            for m in methods:
+                if m.name in STOP_METHOD_NAMES:
+                    latched = _flag_transitions(m)
+                    if latched:
+                        lc.flags.update(latched)
+                        lc.stop_methods.add(m.name)
+            if not lc.stop_methods:
+                continue
+            for m in methods:
+                if m.name in lc.stop_methods:
+                    continue
+                flag = _guard_flag(m, lc.flags)
+                if flag is not None:
+                    lc.guarded[m.name] = flag
+            registry.setdefault(node.name, lc)
+    ctx._lifecycle_registry = registry
+    ctx._lifecycle_registry_ctx = ctx
+    return registry

@@ -83,7 +83,13 @@ def _rule_descriptions() -> List[dict]:
     return out
 
 
-def render_sarif(findings: List[Finding], grandfathered: int = 0) -> str:
+def render_sarif(findings: List[Finding], grandfathered: int = 0,
+                 timings: Optional[dict] = None) -> str:
+    properties: dict = {"grandfathered": grandfathered}
+    if timings:
+        # CI's budget gate reads these straight off the artifact — no
+        # second analysis run just to name the slow rules on a breach
+        properties["timings"] = dict(timings)
     results = []
     for f in _sorted(findings):
         results.append({
@@ -113,7 +119,7 @@ def render_sarif(findings: List[Finding], grandfathered: int = 0) -> str:
                 "rules": _rule_descriptions(),
             }},
             "results": results,
-            "properties": {"grandfathered": grandfathered},
+            "properties": properties,
         }],
     }
     return json.dumps(doc, indent=2, sort_keys=True) + "\n"

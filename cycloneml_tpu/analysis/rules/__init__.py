@@ -36,14 +36,23 @@ from cycloneml_tpu.analysis.rules.jx017_cross_mesh import CrossMeshReuseRule
 from cycloneml_tpu.analysis.rules.jx018_host_materialize import \
     HostMaterializeRule
 from cycloneml_tpu.analysis.rules.jx019_conf_keys import ConfKeyRule
+from cycloneml_tpu.analysis.rules.jx020_fault_coverage import \
+    FaultCoverageRule
+from cycloneml_tpu.analysis.rules.jx021_event_drift import EventDriftRule
+from cycloneml_tpu.analysis.rules.jx022_lifecycle import LifecycleRule
+from cycloneml_tpu.analysis.rules.jx023_seeded_determinism import \
+    SeededDeterminismRule
 
+# JX020 precedes JX023 so it is the registered JXFAULT fixpoint client
+# (the engine runs one client per analysis_id; JX023 reads the summaries)
 ALL_RULES = (HostSyncRule, TracedControlFlowRule, PRNGReuseRule,
              FP64DriftRule, CollectiveAxisRule, JitMutationRule,
              ThreadDispatchRule, RecompileHazardRule, UseAfterDonateRule,
              CollectiveDivergenceRule, LocksetRaceRule, LockOrderRule,
              ObligationLeakRule, BlockingUnderLockRule, ShardingSpecRule,
              ShapePaddingRule, CrossMeshReuseRule, HostMaterializeRule,
-             ConfKeyRule)
+             ConfKeyRule, FaultCoverageRule, EventDriftRule, LifecycleRule,
+             SeededDeterminismRule)
 
 
 def default_rules():

@@ -21,6 +21,15 @@ quiet on legitimate dynamic use:
 
 When no registry is visible in the analyzed set the rule stays silent
 — there is nothing to validate against.
+
+The rule also checks **conf-default drift**: ``conf.get("cyclone.x",
+<literal>)`` carries an inline fallback that ``CycloneConf.get`` only
+uses when the key is *unset* — if it disagrees with the default
+registered by the ``ConfigBuilder`` chain's typed terminal
+(``.int_conf(64)`` etc.), the two defaults silently diverge and the
+code path behaves differently depending on whether the conf was
+materialized. The registered default wins; the inline literal must
+match it exactly (value AND type — ``1`` is not ``True``).
 """
 
 from __future__ import annotations
@@ -45,6 +54,11 @@ class ConfKeyRule(Rule):
         keys = _registered_keys(ctx)
         if not keys:
             return
+        yield from self._unknown_keys(mod, ctx, keys)
+        yield from self._default_drift(mod, ctx)
+
+    def _unknown_keys(self, mod: ModuleInfo, ctx: AnalysisContext,
+                      keys: Set[str]) -> Iterator[Finding]:
         candidates = [node for node in ast.walk(mod.tree)
                       if isinstance(node, ast.Constant)
                       and isinstance(node.value, str)
@@ -70,6 +84,40 @@ class ConfKeyRule(Rule):
                 f"configures nothing{hint} (registry: conf.py "
                 f"ConfigBuilder entries)",
                 owner.get(id(node), ""))
+
+    def _default_drift(self, mod: ModuleInfo, ctx: AnalysisContext
+                       ) -> Iterator[Finding]:
+        defaults = _registered_defaults(ctx)
+        if not defaults:
+            return
+        owner = None
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and len(node.args) == 2 and not node.keywords):
+                continue
+            key, inline = node.args
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value in defaults
+                    and isinstance(inline, ast.Constant)):
+                continue
+            registered = defaults[key.value]
+            if type(inline.value) is type(registered) \
+                    and inline.value == registered:
+                continue
+            if owner is None:
+                owner = _constant_owners(mod)
+            yield self.finding(
+                mod, node,
+                f"inline default {inline.value!r} for '{key.value}' "
+                f"disagrees with the registered default {registered!r} "
+                f"(conf.py) — the inline value only applies when the "
+                f"conf never materialized the key, so the two paths "
+                f"silently diverge; match the registered default or "
+                f"drop the fallback",
+                owner.get(id(key), ""))
 
 
 def _registered_keys(ctx: AnalysisContext) -> Set[str]:
@@ -104,6 +152,59 @@ def _registration_key(node: ast.AST) -> Optional[str]:
     if node.args and isinstance(node.args[0], ast.Constant) \
             and isinstance(node.args[0].value, str):
         return node.args[0].value
+    return None
+
+
+#: ConfigBuilder chain terminals carrying a literal default
+_TYPED_TERMINALS = ("int_conf", "float_conf", "bool_conf", "str_conf")
+
+
+def _registered_defaults(ctx: AnalysisContext) -> Dict[str, object]:
+    """key -> literal default from ``ConfigBuilder("key")....int_conf(v)``
+    chains anywhere in the analyzed set (cached per ctx)."""
+    cached = getattr(ctx, "_conf_defaults", None)
+    if cached is not None \
+            and getattr(ctx, "_conf_defaults_ctx", None) is ctx:
+        return cached
+    out: Dict[str, object] = {}
+    for mod in ctx.modules.values():
+        if not any("ConfigBuilder" in ln for ln in mod.source_lines):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _TYPED_TERMINALS):
+                continue
+            default = None
+            if len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Constant):
+                default = node.args[0]
+            else:
+                default = next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "default"
+                     and isinstance(kw.value, ast.Constant)), None)
+            if default is None:
+                continue
+            key = _builder_root_key(node.func.value)
+            if key is not None:
+                out[key] = default.value
+    ctx._conf_defaults = out
+    ctx._conf_defaults_ctx = ctx
+    return out
+
+
+def _builder_root_key(expr: ast.AST) -> Optional[str]:
+    """Walk a builder chain (`.doc(...).check_value(...)`) down to the
+    ``ConfigBuilder("key")`` root and return the key."""
+    while isinstance(expr, ast.Call):
+        if last_component(call_name(expr) or "") == "ConfigBuilder" \
+                and expr.args \
+                and isinstance(expr.args[0], ast.Constant) \
+                and isinstance(expr.args[0].value, str):
+            return expr.args[0].value
+        expr = expr.func.value \
+            if isinstance(expr.func, ast.Attribute) else None
     return None
 
 

@@ -1,0 +1,155 @@
+"""JX020 — fault-point table and injection sites cross-checked both ways.
+
+The fault-point table in ``parallel/faults.py``'s docstring is the
+contract the chaos suite, the resilience docs and the runtime sites all
+reference — and nothing enforced it. Three deviations convict (Engler's
+cross-checking: infer the belief from N sites, flag the odd one out):
+
+1. a **registered point no site fires** — the table promises an
+   injection point that cannot inject; a chaos test scheduling it waits
+   forever (reported on the table row itself);
+2. an **injection site naming an unregistered point** — a typo'd
+   ``faults.inject("serving.dispach", ...)`` silently never fires (the
+   schedule matches on the exact string), with a closest-name suggestion
+   in the JX019 style;
+3. a **retry boundary without a reachable fault point** — a function
+   that classifies/retries failures (``classify_failure`` /
+   ``retry_step``) but cannot reach any ``faults.inject`` site holds the
+   belief "this path fails transiently" while being untestable under the
+   chaos harness. Higher-order wrappers that retry a callable parameter
+   (``retry_step(fn)`` itself) are exempt — the injectable site lives in
+   the callable they are handed.
+
+"Reaches a fault point" is the shared bottom-up ``JXFAULT`` dataflow
+fact (this rule is its fixpoint client; JX023 scopes on the same
+summaries): a function's summary is True when its own body holds a
+literal injection site or any resolved callee's summary is True.
+
+When no fault-point table is in the analyzed set the rule stays silent —
+there is no registry to check against.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
+                                            last_component)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.registries import (fault_registry,
+                                               injection_sites,
+                                               is_injection_call)
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.rules.jx019_conf_keys import _closest
+
+#: call names that mark a retried/classified dispatch boundary
+RETRY_BOUNDARY_CALLS = {"retry_step", "classify_failure"}
+
+FAULT_ANALYSIS = "JXFAULT"
+
+
+def fault_initial(fn: FunctionInfo, graph) -> bool:
+    """Does ``fn``'s own body hold a literal injection site?"""
+    return any(is_injection_call(call) is not None
+               for call in graph.index(fn).calls)
+
+
+def fault_transfer(fn: FunctionInfo, facts, graph) -> bool:
+    out = facts.get(fn, False)
+    if out:
+        return True
+    for site in graph.sites(fn):
+        if any(facts.get(t, False) is True for t in site.targets):
+            return True
+    return out
+
+
+def _calls_own_param(fn: FunctionInfo, graph) -> bool:
+    """``fn`` invokes one of its own parameters — a higher-order wrapper
+    whose injectable site is the callable it was handed."""
+    return any(isinstance(call.func, ast.Name) and call.func.id in fn.params
+               for call in graph.index(fn).calls)
+
+
+class FaultCoverageRule(DataflowRule):
+    rule_id = "JX020"
+
+    @property
+    def analysis_id(self) -> str:
+        return FAULT_ANALYSIS
+
+    # -- shared JXFAULT summary: may this function reach an inject site? -----
+    def initial(self, fn: FunctionInfo, graph, ctx) -> bool:
+        return fault_initial(fn, graph)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx) -> bool:
+        return fault_transfer(fn, facts, graph)
+
+    def top(self, fn, graph, ctx) -> bool:
+        return True
+
+    # -- the check -----------------------------------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        registry = fault_registry(ctx)
+        if not registry.points:
+            return
+        sites = injection_sites(ctx)
+        fired = {s.point for s in sites}
+
+        # 1. registered points no site fires, anchored on the table row
+        if mod.path in registry.table_modules:
+            for point in registry.points.values():
+                if point.module_path != mod.path or point.name in fired:
+                    continue
+                anchor = ast.Constant(value=point.name)
+                anchor.lineno = anchor.end_lineno = point.line
+                anchor.col_offset = anchor.end_col_offset = 0
+                yield self.finding(
+                    mod, anchor,
+                    f"fault point '{point.name}' is registered in this "
+                    f"table but NO injection site fires it — a chaos "
+                    f"schedule targeting it waits forever; add a "
+                    f"faults.inject('{point.name}', ...) at the boundary "
+                    f"it documents, or drop the row")
+
+        # 2. sites naming unregistered points (typos never fire)
+        registered = set(registry.points)
+        for site in sites:
+            if site.module_path != mod.path or site.point in registered:
+                continue
+            close = _closest(site.point, registered)
+            hint = f"; did you mean '{close}'?" if close else ""
+            yield self.finding(
+                mod, site.node,
+                f"'{site.point}' is not in the fault-point table "
+                f"(parallel/faults.py) — schedules match on the exact "
+                f"string, so this site can never fire{hint}",
+                site.function)
+
+        # 3. retry boundaries that cannot reach any fault point
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            if facts.get(fn, False) is True:
+                continue
+            boundary = next(
+                (call for call in graph.index(fn).calls
+                 if last_component(call_name(call) or "")
+                 in RETRY_BOUNDARY_CALLS), None)
+            if boundary is None:
+                continue
+            if _calls_own_param(fn, graph):
+                continue
+            yield self.finding(
+                mod, boundary,
+                f"`{fn.qualname}` classifies/retries failures but cannot "
+                f"reach any faults.inject site — the retry path is "
+                f"untestable under the chaos harness (every other "
+                f"retried boundary carries a fault point); add one at "
+                f"the dispatch this retry protects",
+                fn.qualname)

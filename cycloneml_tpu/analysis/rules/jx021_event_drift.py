@@ -1,0 +1,62 @@
+"""JX021 — events emitted but handled nowhere (telemetry pipeline drift).
+
+Every ``CycloneEvent`` rides one pipeline: posted on the ListenerBus,
+folded into the status store by ``AppStatusListener.on_event``'s
+dispatch on the literal type name, journaled by ``to_json`` (which
+writes the name under ``"Event"``), replayed by the history provider and
+rolled up by the REST/webui surface. A subclass added without a handler
+branch drifts silently: the post succeeds, the journal grows, and the
+event reaches no store field, no REST route, no replay — PR 12's
+``BlocksMigrated`` did exactly this.
+
+The registry is the ``CycloneEvent`` subclass closure discovered from
+class bases across the analyzed set; an event is **handled** when its
+exact class name appears as a string literal anywhere in the set (the
+``elif kind == "JobStart"`` idiom — journal filters and webui rollups
+dispatch on the same literal). A constructor call of an event no literal
+mentions convicts at the emit site.
+
+When the ``CycloneEvent`` base itself is not in the analyzed set the
+rule stays silent — no registry, nothing to cross-check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cycloneml_tpu.analysis.astutil import call_name, last_component
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.registries import (_node_owners, event_registry,
+                                               handled_event_names)
+from cycloneml_tpu.analysis.rules.base import Rule
+
+
+class EventDriftRule(Rule):
+    rule_id = "JX021"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        registry = event_registry(ctx)
+        if not registry:
+            return
+        # cheap text gate: most modules construct no events at all
+        if not any(n in ln for ln in mod.source_lines for n in registry):
+            return
+        handled = handled_event_names(ctx)
+        owners = _node_owners(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = last_component(call_name(node) or "")
+            if name not in registry or name in handled:
+                continue
+            yield self.finding(
+                mod, node,
+                f"`{name}` is emitted here but its type name appears in "
+                f"no handler in the analyzed set — the event reaches no "
+                f"status-store field, no REST route, no history replay "
+                f"(AppStatusListener.on_event dispatches on the literal "
+                f"name); add the on_event branch (util/status.py) and "
+                f"surface it, or drop the event",
+                owners.get(id(node), ""))

@@ -1,0 +1,380 @@
+"""JX022 — lifecycle typestate: stop/close discipline on runtime objects.
+
+The distributed runtime's long-lived objects (ModelLane, ShardStream,
+the heartbeat pair, SpanShipper, the context itself) share one protocol:
+construct -> use -> stop/close, where stop latches a flag and guarded
+methods reject dispatch afterwards. PR 8/11/13 each hand-fixed a
+violation of it. This rule infers the per-class state machine from the
+code (:func:`~..registries.lifecycle_registry`: a stop/close/shutdown
+method that latches ``self._stop = True`` / ``self._stop.set()``;
+guarded methods test the flag and raise) and convicts three deviations:
+
+* **dispatch-after-stop** — a guarded method called on an instance a
+  path has already stopped (`lane.stop(); lane.submit(x)` raises by
+  construction). Interprocedural: passing the instance to a callee whose
+  bottom-up summary says it tears that parameter down counts as the
+  stop.
+* **teardown leak** — a locally constructed lifecycle instance that can
+  reach a function exit neither stopped nor escaped (returned, stored,
+  handed off); the thread/queue it owns outlives the function. The walk
+  is the JX013 obligation machinery on the shared
+  :class:`~..walker.BlockWalker` — branch may-merges, loop/try/finally
+  semantics, escape-before-exit — with stop/close as the discharge.
+* **unlocked double-transition** — a method that tests a bool stop flag
+  and writes it with either access outside a lock-ish ``with``: the
+  check-then-act pair races a concurrent stop (two threads both observe
+  "not stopped" and both run the teardown body). Event flags are exempt
+  (``Event.is_set``/``set`` are atomic); JX011's lockset facts see the
+  field accesses but not the transition pairing.
+
+The summary (``frozenset`` of parameter positions torn down) propagates
+bottom-up exactly like JX013's discharge summary, so
+``_teardown(lane)``-style helpers both discharge the obligation and mark
+the instance stopped in their callers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, assigned_names,
+                                            call_name, dotted_name,
+                                            last_component)
+from cycloneml_tpu.analysis.dataflow import (EMPTY, TOP, join_sets,
+                                             param_index, set_contains)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.registries import (STOP_METHOD_NAMES,
+                                               LifecycleClass, _self_attr,
+                                               lifecycle_registry)
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.walker import BlockWalker
+
+#: with-context names that make a flag access lock-protected
+_LOCKISH = ("lock", "mutex", "cv", "cond")
+
+
+def _lockish_with(item_expr: ast.AST) -> bool:
+    name = dotted_name(item_expr)
+    if name is None and isinstance(item_expr, ast.Call):
+        name = call_name(item_expr)
+    last = (last_component(name) or "").lstrip("_").lower()
+    return any(w in last for w in _LOCKISH)
+
+
+class LifecycleRule(DataflowRule):
+    rule_id = "JX022"
+
+    # -- summary: which of MY param positions do I tear down? ----------------
+    def initial(self, fn: FunctionInfo, graph, ctx):
+        params = param_index(fn)
+        if not params:
+            return EMPTY
+        torn = set()
+        for call in graph.index(fn).calls:
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in STOP_METHOD_NAMES \
+                    and isinstance(call.func.value, ast.Name) \
+                    and call.func.value.id in params:
+                torn.add(params[call.func.value.id])
+        return frozenset(torn)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx):
+        out = facts.get(fn, EMPTY)
+        if out is TOP:
+            return TOP
+        params = param_index(fn)
+        if not params:
+            return out
+        add = set()
+        for site in graph.sites(fn):
+            for target in site.targets:
+                summary = facts.get(target)
+                if not summary or summary is TOP:
+                    continue
+                for pi, expr in site.param_map(target):
+                    if set_contains(summary, pi) \
+                            and isinstance(expr, ast.Name) \
+                            and expr.id in params:
+                        add.add(params[expr.id])
+        return join_sets(out, frozenset(add))
+
+    # -- the check -----------------------------------------------------------
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        registry = lifecycle_registry(ctx)
+        if not registry:
+            return
+        yield from self._flag_races(mod, registry)
+        graph = ctx.callgraph
+        if graph is None:
+            return
+        if not any(name in ln for ln in mod.source_lines
+                   for name in registry):
+            return
+        facts = (ctx.dataflow.summaries(self.analysis_id)
+                 if ctx.dataflow is not None else {})
+        for fn in mod.functions:
+            if fn.jit_reachable:
+                continue
+            w = _LifecycleWalker(self, mod, fn, graph.sites_map(fn), facts,
+                                 registry)
+            w.walk(getattr(fn.node, "body", []))
+            yield from w.findings
+
+    # -- (c): unlocked flag check-then-act -----------------------------------
+    def _flag_races(self, mod: ModuleInfo,
+                    registry: Dict[str, LifecycleClass]
+                    ) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            lc = registry.get(node.name)
+            if lc is None or lc.module_path != mod.path:
+                continue
+            bool_flags = {f for f, kind in lc.flags.items()
+                          if kind == "bool"}
+            if not bool_flags:
+                continue
+            for m in node.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                reads, writes = _flag_accesses(m, bool_flags)
+                for flag in bool_flags:
+                    fread = [r for r in reads if r[0] == flag]
+                    fwrite = [w for w in writes if w[0] == flag]
+                    if not fread or not fwrite:
+                        continue
+                    unlocked = [n for _, n, locked in fwrite + fread
+                                if not locked]
+                    if not unlocked:
+                        continue
+                    qual = f"{node.name}.{m.name}"
+                    yield self.finding(
+                        mod, unlocked[0],
+                        f"`{qual}` tests `self.{flag}` and writes it, "
+                        f"with this access outside any lock — the "
+                        f"check-then-act pair races a concurrent "
+                        f"{'/'.join(sorted(lc.stop_methods))}(): two "
+                        f"threads can both observe 'not stopped' and "
+                        f"both run the transition body; hold one lock "
+                        f"across the test AND the write",
+                        qual)
+
+
+def _flag_accesses(method: ast.AST, flags: Set[str]
+                   ) -> Tuple[List[tuple], List[tuple]]:
+    """``(reads, writes)`` of bool stop flags in one method body, each a
+    ``(flag, node, locked)`` triple; ``locked`` = lexically inside a
+    lock-ish ``with``."""
+    reads: List[tuple] = []
+    writes: List[tuple] = []
+
+    def scan(stmts, locked: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = locked or any(_lockish_with(i.context_expr)
+                                    for i in stmt.items)
+                scan(stmt.body, now)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                for sub in ast.walk(stmt.test):
+                    attr = _self_attr(sub)
+                    if attr in flags:
+                        reads.append((attr, stmt, locked))
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, bool):
+                for t in stmt.targets:
+                    attr = _self_attr(t)
+                    if attr in flags:
+                        writes.append((attr, stmt, locked))
+            for name in ("body", "orelse", "finalbody"):
+                scan(getattr(stmt, name, []) or [], locked)
+            for h in getattr(stmt, "handlers", []) or []:
+                scan(h.body, locked)
+
+    scan(getattr(method, "body", []), False)
+    return reads, writes
+
+
+class _LifecycleWalker(BlockWalker):
+    """(a) dispatch-after-stop and (b) teardown leaks over one body.
+
+    ``state`` maps a local name to the constructor Call that created its
+    live lifecycle instance (the pending teardown obligation);
+    ``stopped`` is the sticky may-analysis record of names a walked path
+    has torn down, with the stop site and class."""
+
+    def __init__(self, rule: LifecycleRule, mod: ModuleInfo,
+                 fn: FunctionInfo, sites, facts,
+                 registry: Dict[str, LifecycleClass]):
+        super().__init__()
+        self.rule, self.mod, self.fn = rule, mod, fn
+        self.sites, self.facts, self.registry = sites, facts, registry
+        self.findings: List[Finding] = []
+        self._reported: Set[int] = set()
+        #: name -> (stop node, class name, how) — sticky across merges
+        self.stopped: Dict[str, tuple] = {}
+
+    def _class_of(self, name: str) -> Optional[str]:
+        src = self.state.get(name)
+        if src is None:
+            return None
+        return last_component(call_name(src) or "")
+
+    def bind(self, target: ast.AST) -> None:
+        for n in assigned_names(target):
+            self.state.pop(n, None)
+            self.stopped.pop(n, None)
+
+    # -- sources / escapes ---------------------------------------------------
+    def run_stmt(self, stmt: ast.AST):
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            cls = self._constructed(value)
+            self.visit_expr(value)
+            if cls is not None:
+                for t in stmt.targets:
+                    self.bind(t)
+                names = [n for t in stmt.targets
+                         for n in assigned_names(t)]
+                if len(names) == 1 and all(
+                        isinstance(t, ast.Name) for t in stmt.targets):
+                    self.state[names[0]] = value
+                return None
+            # escaping/aliasing assignment: someone else holds it now
+            for name in _bare_names(value):
+                self.state.pop(name, None)
+            for t in stmt.targets:
+                self.bind(t)
+            return None
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+                for name in _bare_names(stmt.value):
+                    self.state.pop(name, None)   # escaped to the caller
+            if not self._return_protected():
+                self.on_exit(stmt, "return")
+            return "exit"
+        return super().run_stmt(stmt)
+
+    def _constructed(self, value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            cls = last_component(call_name(value) or "")
+            if cls in self.registry:
+                return cls
+        return None
+
+    # -- expression scan -----------------------------------------------------
+    def visit_expr(self, expr: ast.AST) -> None:
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(expr, ast.Call):
+            for child in ast.iter_child_nodes(expr):
+                self.visit_expr(child)
+            self._visit_call(expr)
+            return
+        if isinstance(expr, (ast.Yield, ast.YieldFrom)):
+            value = getattr(expr, "value", None)
+            if value is not None:
+                self.visit_expr(value)
+                for name in _bare_names(value):
+                    self.state.pop(name, None)
+            return
+        for child in ast.iter_child_nodes(expr):
+            self.visit_expr(child)
+
+    def _visit_call(self, call: ast.Call) -> None:
+        state = self.state
+        # method call on a tracked instance: x.stop() / x.submit(...)
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name):
+            recv = call.func.value.id
+            meth = call.func.attr
+            if recv in state:
+                cls = self._class_of(recv)
+                if meth in STOP_METHOD_NAMES:
+                    state.pop(recv, None)
+                    self.stopped[recv] = (call, cls, f"{cls}.{meth}")
+                    return
+            elif recv in self.stopped:
+                _, cls, how = self.stopped[recv]
+                lc = self.registry.get(cls or "")
+                if lc is not None and meth in lc.guarded \
+                        and id(call) not in self._reported:
+                    self._reported.add(id(call))
+                    self.findings.append(self.rule.finding(
+                        self.mod, call,
+                        f"`{recv}.{meth}()` dispatches on a {cls} a "
+                        f"path has already stopped ({how} above) — "
+                        f"`{meth}` tests the stop flag and raises; "
+                        f"reorder the teardown or re-check liveness "
+                        f"before dispatching",
+                        self.fn.qualname))
+                return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        bare = [n for a in args for n in _bare_names(a) if n in state]
+        if not bare:
+            return
+        site = self.sites.get(id(call))
+        if site is not None and site.targets:
+            # resolved: a callee whose summary tears the param down both
+            # discharges the obligation AND marks the instance stopped
+            for target in site.targets:
+                summary = self.facts.get(target, EMPTY)
+                for pi, expr in site.param_map(target):
+                    if isinstance(expr, ast.Name) and expr.id in state \
+                            and set_contains(summary, pi):
+                        cls = self._class_of(expr.id)
+                        state.pop(expr.id, None)
+                        self.stopped[expr.id] = (
+                            call, cls, f"{target.qualname}()")
+            # container-wrapped mentions are an opaque hand-off
+            for a in args:
+                if not isinstance(a, ast.Name):
+                    for n in _bare_names(a):
+                        state.pop(n, None)
+            return
+        # unresolvable call: assume it takes ownership (silence > noise)
+        for n in bare:
+            state.pop(n, None)
+
+    # -- exits ---------------------------------------------------------------
+    def on_exit(self, stmt: Optional[ast.AST], kind: str) -> None:
+        where = {"return": "this `return`",
+                 "raise": "this `raise` (the error path)",
+                 "end": "the end of the function"}[kind]
+        line = getattr(stmt, "lineno", None)
+        at = f" at line {line}" if line is not None else ""
+        for name, src in list(self.state.items()):
+            if id(src) in self._reported:
+                continue
+            self._reported.add(id(src))
+            cls = last_component(call_name(src) or "")
+            stops = "/".join(sorted(self.registry[cls].stop_methods)) \
+                if cls in self.registry else "stop"
+            self.findings.append(self.rule.finding(
+                self.mod, src,
+                f"`{name}` ({cls}) is constructed here but can reach "
+                f"{where}{at} without `{stops}()` — the thread/queue it "
+                f"owns outlives the function (teardown leak); stop it "
+                f"on every path, use `with`, or hand it off",
+                self.fn.qualname))
+
+
+def _bare_names(expr: ast.AST):
+    """Names in ``expr`` outside pure attribute-receiver position (the
+    JX013 escape notion: `x` and `[x]` yield, `x.field` does not)."""
+    if isinstance(expr, ast.Name):
+        yield expr.id
+        return
+    if isinstance(expr, ast.Attribute):
+        return
+    for child in ast.iter_child_nodes(expr):
+        yield from _bare_names(child)

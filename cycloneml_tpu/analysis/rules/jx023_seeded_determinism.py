@@ -1,0 +1,230 @@
+"""JX023 — chaos paths must stay deterministic under a seeded replay.
+
+Every chaos test in ``tests/test_chaos.py`` pins the same invariant:
+with a seeded ``FaultSchedule``, the run replays bit-identically —
+retries land in the same order, backoff jitter repeats, the journal
+matches. That only holds if the code *between* the fault points is
+itself deterministic. This rule enforces it at the source, scoped to
+functions whose shared ``JXFAULT`` summary says they transitively reach
+a ``faults.inject`` site (JX020 owns the fixpoint; this rule only reads
+the summaries):
+
+1. **module-global random** — ``random.random()`` & friends draw from
+   the process-global generator any other thread advances; use the
+   component's seeded ``random.Random(seed)`` instance;
+2. **dropped rng plumbing** — a call to a helper that *offers* an
+   ``rng=None`` parameter (``backoff_delay`` style) without passing one
+   falls back to the global generator inside the helper — the plumbing
+   exists and the call declines it;
+3. **clock-derived branching** — ``time.time()``/``monotonic()`` inside
+   a branch test makes control flow depend on wall-clock scheduling;
+   deadline/timeout bookkeeping is exempt (a timeout compare is the
+   *point* of reading the clock), keyed on deadline/timeout/budget/
+   expiry names in the test;
+4. **unordered iteration** — ``for x in {...}`` / ``set(...)`` iterates
+   in hash order, which varies across processes (PYTHONHASHSEED) and
+   so re-orders dispatch between a run and its replay; sort first.
+
+Functions outside chaos scope are never checked — ordinary code may use
+the global generator freely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from cycloneml_tpu.analysis.astutil import (FunctionInfo, call_name,
+                                            dotted_name)
+from cycloneml_tpu.analysis.engine import AnalysisContext, Finding, ModuleInfo
+from cycloneml_tpu.analysis.rules.base import DataflowRule
+from cycloneml_tpu.analysis.rules.jx020_fault_coverage import (FAULT_ANALYSIS,
+                                                               fault_initial,
+                                                               fault_transfer)
+
+#: module-global draws from ``random`` (seeded-instance methods excluded)
+UNSEEDED_RANDOM = frozenset({
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "expovariate", "normalvariate",
+    "triangular", "betavariate",
+})
+
+#: wall-clock reads that make a branch test scheduling-dependent
+CLOCK_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time",
+})
+
+#: names that mark a clock read as deadline bookkeeping (exempt)
+_DEADLINE_WORDS = ("deadline", "timeout", "budget", "expir")
+
+
+def _rng_param(fn: FunctionInfo) -> Optional[int]:
+    """Position of an ``rng`` parameter defaulting to ``None``, if any."""
+    args = getattr(fn.node, "args", None)
+    if args is None:
+        return None
+    pos = list(getattr(args, "posonlyargs", [])) + list(args.args)
+    defaults = list(args.defaults)
+    for i, arg in enumerate(pos):
+        if arg.arg != "rng":
+            continue
+        di = i - (len(pos) - len(defaults))
+        if 0 <= di < len(defaults) \
+                and isinstance(defaults[di], ast.Constant) \
+                and defaults[di].value is None:
+            return i
+    for j, arg in enumerate(args.kwonlyargs):
+        default = args.kw_defaults[j]
+        if arg.arg == "rng" and isinstance(default, ast.Constant) \
+                and default.value is None:
+            return len(pos) + j
+    return None
+
+
+def _dynamic_args(call: ast.Call) -> bool:
+    return any(isinstance(a, ast.Starred) for a in call.args) \
+        or any(kw.arg is None for kw in call.keywords)
+
+
+def _deadline_test(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        name = sub.id if isinstance(sub, ast.Name) else \
+            sub.attr if isinstance(sub, ast.Attribute) else None
+        if name and any(w in name.lower() for w in _DEADLINE_WORDS):
+            return True
+    return False
+
+
+def _unordered(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call) \
+            and call_name(expr) in ("set", "frozenset"):
+        return True
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return _unordered(expr.left) or _unordered(expr.right)
+    return False
+
+
+def _own_nodes(fn: FunctionInfo):
+    """Walk ``fn``'s body without descending into nested defs (those
+    carry their own JXFAULT fact and are checked on their own)."""
+    stack = list(getattr(fn.node, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SeededDeterminismRule(DataflowRule):
+    rule_id = "JX023"
+
+    # shares the JXFAULT fixpoint JX020 registers; the engine runs one
+    # client per analysis_id, so these only define the fact for safety
+    @property
+    def analysis_id(self) -> str:
+        return FAULT_ANALYSIS
+
+    def initial(self, fn: FunctionInfo, graph, ctx) -> bool:
+        return fault_initial(fn, graph)
+
+    def transfer(self, fn: FunctionInfo, facts, graph, ctx) -> bool:
+        return fault_transfer(fn, facts, graph)
+
+    def top(self, fn, graph, ctx) -> bool:
+        return True
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> Iterator[Finding]:
+        graph = ctx.callgraph
+        if graph is None or ctx.dataflow is None:
+            return
+        facts = ctx.dataflow.summaries(self.analysis_id)
+        for fn in mod.functions:
+            if facts.get(fn) is not True or fn.jit_reachable:
+                continue
+            index = graph.index(fn)
+            sites = graph.sites_map(fn)
+
+            for call in index.calls:
+                dotted = dotted_name(call.func)
+                # 1. process-global random draws
+                if dotted is not None and "." in dotted:
+                    head, _, meth = dotted.partition(".")
+                    if head == "random" and meth in UNSEEDED_RANDOM:
+                        yield self.finding(
+                            mod, call,
+                            f"`{dotted}()` draws from the process-global "
+                            f"generator on a chaos path (this function "
+                            f"reaches a faults.inject site) — any other "
+                            f"thread's draw shifts the sequence and the "
+                            f"seeded replay diverges; use a component "
+                            f"`random.Random(seed)` instance",
+                            fn.qualname)
+                        continue
+                # 2. declined rng plumbing
+                if _dynamic_args(call):
+                    continue
+                site = sites.get(id(call))
+                if site is None:
+                    continue
+                for target in site.targets:
+                    ri = _rng_param(target)
+                    if ri is None:
+                        continue
+                    provided = {pi for pi, _ in site.param_map(target)}
+                    if ri not in provided:
+                        yield self.finding(
+                            mod, call,
+                            f"`{target.qualname}` offers an `rng=None` "
+                            f"parameter but this chaos-path call omits "
+                            f"it, so the helper falls back to the "
+                            f"process-global generator and the seeded "
+                            f"replay diverges; pass the component's "
+                            f"seeded rng",
+                            fn.qualname)
+                        break
+
+            # 3. clock reads deciding a branch
+            for branch in index.branches:
+                test = getattr(branch, "test", None)
+                if test is None or _deadline_test(test):
+                    continue
+                clock = next(
+                    (c for c in ast.walk(test)
+                     if isinstance(c, ast.Call)
+                     and dotted_name(c.func) in CLOCK_CALLS), None)
+                if clock is not None:
+                    yield self.finding(
+                        mod, branch,
+                        f"branch test reads the wall clock "
+                        f"(`{dotted_name(clock.func)}()`) on a chaos "
+                        f"path — control flow depends on scheduling and "
+                        f"the seeded replay diverges; branch on counted "
+                        f"state, or name the bound a deadline/timeout "
+                        f"if this is genuine deadline bookkeeping",
+                        fn.qualname)
+
+            # 4. hash-order iteration feeding dispatch
+            for node in _own_nodes(fn):
+                iters = []
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    if _unordered(it):
+                        yield self.finding(
+                            mod, node,
+                            f"iterating a set on a chaos path visits "
+                            f"elements in hash order, which varies with "
+                            f"PYTHONHASHSEED across processes — the "
+                            f"replay dispatches in a different order "
+                            f"than the recorded run; wrap in sorted()",
+                            fn.qualname)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 from dataclasses import dataclass
 from typing import Iterable, List, Optional
 
@@ -130,6 +131,7 @@ class StreamingDataset:
         self._dir = spill_dir
         self._owns_dir = owns_dir
         self._closed = False
+        self._close_lock = threading.Lock()
 
     # -- construction ----------------------------------------------------------
     @classmethod
@@ -340,9 +342,12 @@ class StreamingDataset:
 
     # -- lifecycle -------------------------------------------------------------
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        # latch under the lock: explicit close races __del__ (GC thread),
+        # and both passing the check would double-unlink the spill files
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         for s in self._shards:
             try:
                 os.unlink(s.path)

@@ -27,6 +27,7 @@ request futures — a fault can shed a request but never hang it.
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -92,6 +93,9 @@ class ModelLane:
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._cv = threading.Condition()
         self._stop = False
+        # per-lane seeded jitter (stable across processes — str hash is
+        # salted): chaos replays of the retry backoff stay deterministic
+        self._rng = random.Random(sum(name.encode()))
         self._thread: Optional[threading.Thread] = None
         # per-lane tallies (ints under the cv; scrape-side metrics live in
         # the server's shared MetricsRegistry)
@@ -399,7 +403,8 @@ class ModelLane:
                         tracing.instant("retry", point="serving.dispatch",
                                         attempt=attempt, model=self.name)
                         time.sleep(backoff_delay(attempt - 1, base_s=0.01,
-                                                 max_s=0.2))
+                                                 max_s=0.2,
+                                                 rng=self._rng))
                         continue
                     status = 503 if kind == "transient" else 500
                     err = ServingError(

@@ -43,6 +43,11 @@ class AppStatusStore:
         # it (the UI renders the tail anyway)
         self.skew: List[Dict[str, Any]] = []
         self.max_skew_events = 200
+        # BlocksMigrated events (elastic decommission / host-loss block
+        # moves), newest last — the /api/v1/migrations surface
+        self.migrations: List[Dict[str, Any]] = []
+        # PrecisionFallback events (fp8 tier declined/abandoned per fit)
+        self.precision_fallbacks: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -81,6 +86,16 @@ class AppStatusStore:
         """Recorded straggler/SLO-breach events, newest last."""
         with self._lock:
             return [dict(e) for e in self.skew]
+
+    def migration_events(self) -> List[Dict[str, Any]]:
+        """Recorded block-migration events, newest last."""
+        with self._lock:
+            return [dict(e) for e in self.migrations]
+
+    def precision_events(self) -> List[Dict[str, Any]]:
+        """Recorded fp8→bf16 precision fallbacks, newest last."""
+        with self._lock:
+            return [dict(e) for e in self.precision_fallbacks]
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -180,6 +195,20 @@ class AppStatusListener:
                                   "observedS": e.get("observed_s"),
                                   "targetS": e.get("target_s"),
                                   "time": e.get("time_ms")})
+        elif kind == "BlocksMigrated":
+            with s._lock:
+                s.migrations.append({"nDatasets": e.get("n_datasets"),
+                                     "bytes": e.get("bytes"),
+                                     "nDevices": e.get("n_devices"),
+                                     "time": e.get("time_ms")})
+        elif kind == "PrecisionFallback":
+            with s._lock:
+                s.precision_fallbacks.append({
+                    "estimator": e.get("estimator"),
+                    "fromDtype": e.get("from_dtype"),
+                    "toDtype": e.get("to_dtype"),
+                    "reason": e.get("reason"),
+                    "time": e.get("time_ms")})
 
     @staticmethod
     def _append_skew(s: AppStatusStore, row: Dict[str, Any]) -> None:
@@ -223,7 +252,7 @@ def api_v1(store: AppStatusStore, route: str,
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
-    'memory/warnings', 'serving', 'skew'."""
+    'memory/warnings', 'serving', 'skew', 'migrations', 'precision'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -244,4 +273,8 @@ def api_v1(store: AppStatusStore, route: str,
         return store.serving_stats()
     if route == "skew":
         return store.skew_events()
+    if route == "migrations":
+        return store.migration_events()
+    if route == "precision":
+        return store.precision_events()
     raise KeyError(f"unknown route {route!r}")

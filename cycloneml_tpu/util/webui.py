@@ -39,6 +39,8 @@ _PAGE = """<!DOCTYPE html>
 <h2>Storage</h2><div id="storage" class="muted">none</div>
 <h2>Checkpoints</h2><div id="ckpts" class="muted">none</div>
 <h2>Worker failures</h2><div id="fails" class="muted">none</div>
+<h2>Block migrations</h2><div id="migr" class="muted">none</div>
+<h2>Precision fallbacks</h2><div id="prec" class="muted">none</div>
 <script>
 async function j(r) { return (await fetch('/api/v1/' + r)).json(); }
 function esc(v) {
@@ -101,6 +103,13 @@ async function refresh() {
   const fails = await j('workers/failures');
   if (fails.length) document.getElementById('fails').innerHTML =
     table(fails, Object.keys(fails[0]));
+  const migr = await j('migrations');
+  if (migr.length) document.getElementById('migr').innerHTML =
+    table(migr.slice(-20), ['nDatasets', 'bytes', 'nDevices', 'time']);
+  const prec = await j('precision');
+  if (prec.length) document.getElementById('prec').innerHTML =
+    table(prec.slice(-20), ['estimator', 'fromDtype', 'toDtype',
+                            'reason', 'time']);
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>

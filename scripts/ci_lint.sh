@@ -7,8 +7,14 @@
 #   CYCLONE_LINT_CACHE    relocates the ParseCache pickle so CI cache
 #                         restore/save steps can persist it between runs
 #                         (unset: full runs parse fresh)
+#   GRAFTLINT_BUDGET_S    wall-clock budget for the full-tree run
+#                         (default 20 s); on breach the top-3 slowest
+#                         rules print (from the artifact's timings) and
+#                         the gate exits 3, so new fixpoint clients
+#                         can't silently eat the tier-1 budget
 #
-# Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/ratchet error.
+# Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/ratchet
+# error, 3 time-budget breach.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -16,10 +22,14 @@ cd "$(dirname "$0")/.."
 SARIF_OUT="${GRAFTLINT_SARIF_OUT:-artifacts/graftlint.sarif}"
 mkdir -p "$(dirname "$SARIF_OUT")"
 
+BUDGET_S="${GRAFTLINT_BUDGET_S:-20}"
+
+t0=$(python -c 'import time; print(time.monotonic())')
 python -m cycloneml_tpu.analysis cycloneml_tpu \
     --baseline cycloneml_tpu/analysis/baseline.json \
     --sarif > "$SARIF_OUT"
 rc=$?
+t1=$(python -c 'import time; print(time.monotonic())')
 
 # exit 2 = usage/ratchet error: the real diagnostic is already on
 # stderr and the artifact is empty — don't bury it under a
@@ -51,5 +61,31 @@ for r in results[:20]:
     print(f"  {loc['artifactLocation']['uri']}:{loc['region']['startLine']}"
           f": {r['ruleId']} {r['message']['text'][:100]}")
 PY
+
+# wall-clock budget gate: the run itself (parse + fixpoints + checks)
+# must fit the budget; breach names the rules to go look at first
+breach=$(python - "$SARIF_OUT" "$t0" "$t1" "$BUDGET_S" <<'PY'
+import json, sys
+artifact, t0, t1, budget = sys.argv[1:5]
+elapsed = float(t1) - float(t0)
+if elapsed <= float(budget):
+    print(f"graftlint: {elapsed:.1f}s (budget {budget}s)",
+          file=sys.stderr)
+    sys.exit(0)
+print(f"graftlint: BUDGET BREACH {elapsed:.1f}s > {budget}s",
+      file=sys.stderr)
+try:
+    doc = json.load(open(artifact))
+    timings = doc["runs"][0].get("properties", {}).get("timings", {})
+except Exception:
+    timings = {}
+for rid, secs in sorted(timings.items(), key=lambda kv: -kv[1])[:3]:
+    print(f"  slowest: {rid} {secs:.2f}s", file=sys.stderr)
+print("breach")
+PY
+)
+if [ "$breach" = "breach" ]; then
+    exit 3
+fi
 
 exit "$rc"

@@ -33,3 +33,13 @@ def set_bad_key(conf):
 def tuple_pair(pairs):
     # submit.py-style (key, value) pair building
     pairs.append(("cyclone.servng.windowMs", 5))                # JX019
+
+
+def drifted_default(conf):
+    # registered default is 25: the inline fallback silently diverges
+    return conf.get("cyclone.serving.windowMs", 50)             # JX019
+
+
+def type_drifted_default(conf):
+    # right value, wrong type: 512.0 is not the registered int 512
+    return conf.get("cyclone.serving.maxBatch", 512.0)          # JX019

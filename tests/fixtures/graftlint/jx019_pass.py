@@ -33,3 +33,13 @@ def dynamic_key(conf, name):
 def prose_mention():
     # keys inside prose never fullmatch
     raise ValueError("cyclone.serving.windowMs must be positive, got -1")
+
+
+def matching_default(conf):
+    # inline fallback agrees with the registered default exactly
+    return conf.get("cyclone.serving.windowMs", 25)
+
+
+def computed_default(conf, fallback):
+    # dynamic defaults are not literals — out of scope by construction
+    return conf.get("cyclone.serving.maxBatch", fallback)

@@ -1,0 +1,36 @@
+"""JX020 should-flag fixtures: fault-table drift in every direction.
+
+==========================  =============================================
+point                       fired from
+==========================  =============================================
+``demo.used``               the staged dispatch below
+``demo.ghost``              registered here, fired nowhere        # JX020
+==========================  =============================================
+"""
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def classify_failure(exc):
+    return "transient"
+
+
+def staged_dispatch(shard):
+    inject("demo.used", shard=shard)
+    return shard
+
+
+def typod_site(shard):
+    # one dropped letter: the schedule matches exact strings, never fires
+    inject("demo.usedd", shard=shard)                           # JX020
+    return shard
+
+
+def untestable_retry(e):
+    # retried boundary with no reachable fault point: chaos can't test it
+    kind = classify_failure(e)                                  # JX020
+    if kind == "transient":
+        return True
+    return False

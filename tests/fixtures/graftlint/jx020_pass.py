@@ -1,0 +1,52 @@
+"""JX020 should-pass fixtures: a fault table and its sites in agreement.
+
+=================  ==============================================
+point              fired from
+=================  ==============================================
+``demo.dispatch``  the retried dispatch below
+``demo.stage``     the staging helper
+=================  ==============================================
+"""
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def classify_failure(exc):
+    return "transient"
+
+
+def retry_step(fn, attempts=3):
+    # higher-order wrapper: the injectable site lives in the callable it
+    # is handed, so the retry-boundary belief does not apply to it
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if classify_failure(e) != "transient":
+                raise
+            last = e
+    raise last
+
+
+def stage(shard):
+    inject("demo.stage", shard=shard)
+    return shard
+
+
+def dispatch(batch):
+    # the boundary carries its own fault point: retried AND injectable
+    inject("demo.dispatch", n=len(batch))
+    return retry_step(lambda: batch)
+
+
+class FaultInjector:
+    def fire(self, point, **info):
+        return (point, info)
+
+
+def refire(inj, point):
+    # dynamic point names are a schedule replay, not an injection site
+    return inj.fire(point)

@@ -1,0 +1,30 @@
+"""JX021 should-flag fixtures: an event emitted but handled nowhere."""
+
+
+class CycloneEvent:
+    def to_json(self):
+        return {"Event": type(self).__name__}
+
+
+class JobStart(CycloneEvent):
+    def __init__(self, job_id=0):
+        self.job_id = job_id
+
+
+class BlocksMoved(CycloneEvent):
+    def __init__(self, n=0):
+        self.n = n
+
+
+def on_event(e):
+    # the status-store fold dispatches on the literal type name; only
+    # JobStart has a branch, so BlocksMoved drifts silently
+    kind = e.get("Event")
+    if kind == "JobStart":
+        return "job"
+    return None
+
+
+def post_all(bus):
+    bus.post(JobStart(job_id=1))
+    bus.post(BlocksMoved(n=3))                                  # JX021

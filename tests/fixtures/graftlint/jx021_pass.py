@@ -1,0 +1,36 @@
+"""JX021 should-pass fixtures: every emitted event has a handler branch."""
+
+
+class CycloneEvent:
+    def to_json(self):
+        return {"Event": type(self).__name__}
+
+
+class JobStart(CycloneEvent):
+    def __init__(self, job_id=0):
+        self.job_id = job_id
+
+
+class StepDone(CycloneEvent):
+    def __init__(self, step=0):
+        self.step = step
+
+
+def on_event(e):
+    kind = e.get("Event")
+    if kind == "JobStart":
+        return "job"
+    if kind == "StepDone":
+        return "step"
+    return None
+
+
+def replay_filter(events):
+    # journal filters dispatching on the same literals also count as
+    # handlers — the name reaches a consumer either way
+    return [e for e in events if e.get("Event") in ("JobStart", "StepDone")]
+
+
+def post_all(bus):
+    bus.post(JobStart(job_id=1))
+    bus.post(StepDone(step=2))

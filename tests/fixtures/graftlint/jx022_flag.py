@@ -1,0 +1,59 @@
+"""JX022 should-flag fixtures: lifecycle typestate violations."""
+import threading
+
+
+class Lane:
+    """Queue-lane shape: stop() latches the flag, submit() guards on it."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = False
+
+    def submit(self, item):
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("stopped")
+        return item
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+
+
+class Channel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def close(self):
+        # check-then-act with no lock held: two closers both pass the
+        # check and both run the teardown body
+        if self._closed:
+            return
+        self._closed = True                                     # JX022
+        self._lock = None
+
+
+def drain_then_submit(items):
+    lane = Lane()
+    for it in items:
+        lane.submit(it)
+    lane.stop()
+    return lane.submit(None)                                    # JX022
+
+
+def leaky_worker(items):
+    lane = Lane()                                               # JX022
+    for it in items:
+        lane.submit(it)
+    return len(items)
+
+
+def shutdown_lane(lane):
+    lane.stop()
+
+
+def interprocedural_dispatch(items):
+    lane = Lane()
+    shutdown_lane(lane)
+    return lane.submit(items)                                   # JX022

@@ -1,0 +1,74 @@
+"""JX022 should-pass fixtures: disciplined lifecycle use."""
+import threading
+
+
+class Lane:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = False
+
+    def submit(self, item):
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("stopped")
+        return item
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+
+
+class Channel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def close(self):
+        # the latch is atomic: check AND transition under one lock
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._teardown()
+
+    def _teardown(self):
+        return None
+
+
+def disciplined(items):
+    lane = Lane()
+    try:
+        for it in items:
+            lane.submit(it)
+    finally:
+        lane.stop()
+
+
+def builder():
+    # escape to the caller: the obligation travels with the instance
+    lane = Lane()
+    return lane
+
+
+def registered(server):
+    # aliasing store: someone else owns the teardown now
+    lane = Lane()
+    server.lanes["x"] = lane
+    return "ok"
+
+
+def handed_off(pool):
+    # opaque consumer: assume it takes ownership (silence over noise)
+    lane = Lane()
+    pool.adopt(lane)
+    return "ok"
+
+
+def restarted(items):
+    # stop-then-reconstruct: the new instance is live again
+    lane = Lane()
+    lane.stop()
+    lane = Lane()
+    for it in items:
+        lane.submit(it)
+    lane.stop()

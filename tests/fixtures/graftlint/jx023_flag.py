@@ -1,0 +1,46 @@
+"""JX023 should-flag fixtures: nondeterminism on chaos paths.
+
+===============  ==========================================
+point            fired from
+===============  ==========================================
+``demo.step``    every function below
+===============  ==========================================
+"""
+import random
+import time
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def backoff_delay(attempt, base_s=0.05, max_s=5.0, rng=None):
+    r = rng if rng is not None else random
+    return min(max_s, base_s * (2 ** attempt)) * r.random()
+
+
+def jittered_step(shard):
+    inject("demo.step", shard=shard)
+    return random.uniform(0.0, 1.0)                             # JX023
+
+
+def retry_with_default_rng(shard, attempt):
+    inject("demo.step", shard=shard)
+    # the helper OFFERS rng plumbing; declining it falls back to the
+    # process-global generator inside
+    return backoff_delay(attempt)                               # JX023
+
+
+def clock_branched(shard, t0):
+    inject("demo.step", shard=shard)
+    if time.monotonic() - t0 > 0.5:                             # JX023
+        return "slow"
+    return "fast"
+
+
+def hash_ordered_dispatch(shards):
+    inject("demo.step", n=len(shards))
+    out = []
+    for s in {1, 2, 3} | set(shards):                           # JX023
+        out.append(s)
+    return out

@@ -1,0 +1,49 @@
+"""JX023 should-pass fixtures: chaos paths that replay deterministically.
+
+===============  ==========================================
+point            fired from
+===============  ==========================================
+``demo.step``    every seeded function below
+===============  ==========================================
+"""
+import random
+import time
+
+_RNG = random.Random(7)
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def backoff_delay(attempt, base_s=0.05, max_s=5.0, rng=None):
+    r = rng if rng is not None else random
+    return min(max_s, base_s * (2 ** attempt)) * r.random()
+
+
+def seeded_jitter(shard):
+    inject("demo.step", shard=shard)
+    return _RNG.uniform(0.0, 1.0)
+
+
+def retry_with_seeded_rng(shard, attempt):
+    inject("demo.step", shard=shard)
+    return backoff_delay(attempt, rng=_RNG)
+
+
+def deadline_check(shard, deadline_s):
+    # timeout bookkeeping is the POINT of the clock read — exempt
+    inject("demo.step", shard=shard)
+    if time.monotonic() > deadline_s:
+        return "expired"
+    return "live"
+
+
+def sorted_dispatch(shards):
+    inject("demo.step", n=len(shards))
+    return [s for s in sorted(set(shards))]
+
+
+def unseeded_off_chaos_path(n):
+    # reaches no fault point: ordinary code may use the global generator
+    return [random.random() for _ in range(n)]

@@ -922,3 +922,79 @@ def test_elastic_max_reshapes_budget_exhaustion(ctx, tmp_path):
     finally:
         chan.clear()
         ctx.rebuild_mesh("local-mesh[8]")
+
+
+# -- checkpoint save/restore entry points ---------------------------------------
+
+def test_save_entry_fault_leaves_prior_checkpoint_intact(tmp_path):
+    """A crash at the checkpoint.save entry (before any file is written):
+    the prior committed step stays the newest verifiable one and nothing
+    half-written surfaces."""
+    ck = TrainingCheckpointer(str(tmp_path), keep_last=3)
+    ck.save(1, {"x": 1})
+    sched = FaultSchedule().at("checkpoint.save", 1,
+                               MidSaveCrash("died before writing"))
+    with FaultInjector(sched) as inj:
+        with pytest.raises(MidSaveCrash):
+            ck.save(2, {"x": 2})
+    assert inj.log == [("checkpoint.save", 1, "MidSaveCrash")]
+    assert ck.steps() == [1]
+    assert ck.verify(1)
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp" in n]
+    assert not leftovers
+
+
+def test_restore_entry_fault_surfaces_not_swallowed(tmp_path):
+    """An injected failure at the checkpoint.restore point surfaces to
+    the caller — resume never silently restarts from scratch."""
+    ck = TrainingCheckpointer(str(tmp_path), keep_last=3)
+    ck.save(1, {"x": 1})
+    sched = FaultSchedule().at("checkpoint.restore", 1,
+                               TransientCollectiveError("torn read"))
+    with FaultInjector(sched) as inj:
+        with pytest.raises(TransientCollectiveError):
+            ck.restore(1)
+    assert inj.log == [("checkpoint.restore", 1,
+                        "TransientCollectiveError")]
+
+
+# -- the table <-> suite correspondence sweep -----------------------------------
+
+def test_every_fault_point_has_a_chaos_case():
+    """JX020's pytest twin: every point registered in the faults.py
+    docstring table is SCHEDULED (a `.at(...)` / `.window(...)` literal)
+    by at least one case in this file, so the chaos suite cannot
+    silently fall behind the table — and vice versa: every scheduled
+    dotted point must be a registered one (a typo'd schedule waits
+    forever)."""
+    import ast as pyast
+
+    from cycloneml_tpu.analysis.registries import parse_fault_table
+    from cycloneml_tpu.parallel import faults as faults_mod
+
+    table = {name for name, _ in
+             parse_fault_table(faults_mod.__doc__ or "", 1)}
+    assert table, "fault-point table went missing from faults.py"
+
+    with open(__file__, encoding="utf-8") as fh:
+        tree = pyast.parse(fh.read())
+    scheduled = set()
+    for node in pyast.walk(tree):
+        if isinstance(node, pyast.Call) \
+                and isinstance(node.func, pyast.Attribute) \
+                and node.func.attr in ("at", "window") \
+                and node.args \
+                and isinstance(node.args[0], pyast.Constant) \
+                and isinstance(node.args[0].value, str):
+            scheduled.add(node.args[0].value)
+
+    unexercised = sorted(table - scheduled)
+    assert unexercised == [], (
+        f"fault points registered in the faults.py table but scheduled "
+        f"by no chaos case: {unexercised}")
+    dotted = {p for p in scheduled if "." in p}
+    phantom = sorted(dotted - table)
+    assert phantom == [], (
+        f"chaos cases schedule points missing from the faults.py table "
+        f"(the schedule matches exact strings and waits forever): "
+        f"{phantom}")

@@ -32,7 +32,8 @@ BASELINE = os.path.join(PACKAGE, "analysis", "baseline.json")
 
 RULES = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
          "JX008", "JX009", "JX010", "JX011", "JX012", "JX013", "JX014",
-         "JX015", "JX016", "JX017", "JX018", "JX019")
+         "JX015", "JX016", "JX017", "JX018", "JX019", "JX020", "JX021",
+         "JX022", "JX023")
 
 
 def marker_lines(path: str, rule: str):
@@ -438,6 +439,65 @@ def test_cli_changed_mode(tmp_path, capsys):
         os.chdir(old)
 
 
+def test_cli_changed_fault_table_diff_rechecks_site_modules(tmp_path,
+                                                            capsys):
+    """Registry-edge widening for JX020: a diff touching ONLY the
+    fault-table module must re-check every module holding an injection
+    site — renaming a table row orphans the untouched sites, and the
+    incremental gate has to say so, not green-light them."""
+    table = (
+        '"""Fault points.\n'
+        "\n"
+        "===============  ==========\n"
+        "point            fired from\n"
+        "===============  ==========\n"
+        "``demo.stage``   site.py\n"
+        "===============  ==========\n"
+        '"""\n'
+        "def inject(point, **info):\n"
+        "    return None\n")
+    site = (
+        "from pkg.faults import inject\n"
+        "def stage(shard):\n"
+        "    inject('demo.stage', shard=shard)\n"
+        "    return shard\n")
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "faults.py").write_text(table)
+    (pkg / "site.py").write_text(site)
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+
+    old = os.getcwd()
+    os.chdir(repo)
+    try:
+        assert graftlint_main(["pkg", "--changed", "--no-cache"]) == 0
+        capsys.readouterr()
+        # rename the registered point IN THE TABLE ONLY: site.py still
+        # fires the old name, which now never matches a schedule
+        (pkg / "faults.py").write_text(
+            table.replace("``demo.stage``   site.py",
+                          "``demo.staging``  site.py"))
+        assert graftlint_main(["pkg", "--changed", "--no-cache",
+                               "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        by_path = {f["path"]: f["rule"] for f in payload["findings"]}
+        # the UNTOUCHED site module was re-checked and convicted...
+        assert by_path.get("pkg/site.py") == "JX020"
+        # ...and the renamed row itself is unfired, anchored on the table
+        assert by_path.get("pkg/faults.py") == "JX020"
+    finally:
+        os.chdir(old)
+
+
 def test_cli_changed_rejects_write_baseline(tmp_path):
     """--changed carries only the changed files' findings; writing those
     as the baseline would drop every grandfathered entry for unchanged
@@ -522,7 +582,14 @@ def test_cli_sarif_golden_jx013(capsys):
     assert "JX013:jx013_flag.py:Lane.leaks_on_error_path" in fps
     assert "JX013:jx013_flag.py:Lane2.helper_never_completes" in fps
     rule_meta = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"JX011", "JX012", "JX013", "JX014"} <= rule_meta
+    assert {"JX011", "JX012", "JX013", "JX014",
+            "JX020", "JX021", "JX022", "JX023"} <= rule_meta
+    # every driver rule ships a non-empty shortDescription (module
+    # docstring first line) — the v5 rules included, ordering pinned
+    driver_rules = run["tool"]["driver"]["rules"]
+    assert [r["id"] for r in driver_rules] == sorted(rule_meta)
+    for r in driver_rules:
+        assert r["shortDescription"]["text"].strip()
 
 
 # -- fixture sweep: the registry and the test sweep cannot drift -------------
@@ -599,6 +666,7 @@ def test_json_carries_per_rule_timings(capsys):
         assert rule in timings, f"no timing entry for {rule}"
         assert timings[rule] >= 0.0
     assert "JXSHAPE" in timings   # the shared abstract shape analysis
+    assert "JXFAULT" in timings   # the shared fault-reachability fixpoint
 
 
 def test_text_output_prints_slowest_rules(capsys):
@@ -687,3 +755,22 @@ def test_parse_cache_rejects_pre_v3_schema(tmp_path):
     # sanity: the live schema names the concurrency analyses
     assert {"JX011", "JX012", "JX013", "JX014"} <= set(
         summary_schema().split(","))
+
+
+def test_jx021_transitive_subclass_without_base_text(tmp_path):
+    """A second-level event subclass (`class Ghost(BlocksMoved)`) lives
+    in a module that never spells `CycloneEvent` — registry discovery
+    must scan every module's class bases, not text-gate on the base
+    name, or the subclass silently never enters the closure."""
+    (tmp_path / "events.py").write_text(
+        "class CycloneEvent:\n    pass\n\n\n"
+        "class BlocksMoved(CycloneEvent):\n    pass\n\n\n"
+        "def handle(kind):\n    return kind == 'BlocksMoved'\n")
+    (tmp_path / "emit.py").write_text(
+        "from events import BlocksMoved\n\n\n"
+        "class GhostEvent(BlocksMoved):\n    pass\n\n\n"
+        "def post(bus):\n    bus.post(GhostEvent())\n")
+    found = [f for f in analyze_paths([str(tmp_path)])
+             if f.rule == "JX021"]
+    assert [os.path.basename(f.path) for f in found] == ["emit.py"]
+    assert "GhostEvent" in found[0].message

@@ -632,3 +632,45 @@ def test_shuffle_conf_key_and_order_validation(ctx):
             ShardStream(sds, order=[0, 0, 1]).close()
     finally:
         sds.close()
+
+
+def test_streaming_dataset_close_race_single_unlink(ctx, monkeypatch):
+    """Explicit close races ``__del__`` (GC runs finalizers on another
+    thread's allocation path): the ``_closed`` latch is taken under a
+    lock, so concurrent closers unlink each spill file EXACTLY once —
+    never a double-unlink that could tear down a path a new dataset just
+    reused. Pinned from a graftlint JX022 check-then-act self-run
+    finding."""
+    import threading
+    from collections import Counter
+
+    x, y = _binary_problem(n=600, d=4)
+    sds = _streaming_ds(ctx, x, y, shard_rows=200)
+    paths = [s.path for s in sds._shards]
+    assert paths and all(os.path.exists(p) for p in paths)
+
+    counts: Counter = Counter()
+    count_lock = threading.Lock()
+    real_unlink = os.unlink
+
+    def counted(p, *a, **k):
+        with count_lock:
+            counts[p] += 1
+        return real_unlink(p, *a, **k)
+
+    monkeypatch.setattr(os, "unlink", counted)
+    barrier = threading.Barrier(4)
+
+    def closer():
+        barrier.wait()
+        sds.close()
+
+    threads = [threading.Thread(target=closer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert {counts[p] for p in paths} == {1}
+    assert not any(os.path.exists(p) for p in paths)
+    sds.close()   # idempotent after the race: the latch stays down
+    assert {counts[p] for p in paths} == {1}

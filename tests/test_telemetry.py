@@ -504,3 +504,43 @@ def test_master_side_rtt_skew_latches_straggler():
         if prev is not None:
             skew.install(prev)
         recv.stop()
+
+
+def test_migration_and_precision_events_reach_status_api():
+    """BlocksMigrated and PrecisionFallback must fold into the status
+    store and surface via the /api/v1/migrations and /api/v1/precision
+    routes + web UI sections (graftlint JX021 caught both emitted but
+    dropped on the listener floor)."""
+    from cycloneml_tpu.util.events import BlocksMigrated, PrecisionFallback
+    from cycloneml_tpu.util.status import AppStatusListener, api_v1
+    from cycloneml_tpu.util.webui import StatusWebUI
+
+    lst = AppStatusListener()
+    lst.on_event(BlocksMigrated(n_datasets=2, bytes=4096, n_devices=3,
+                                time_ms=7).to_json())
+    lst.on_event(PrecisionFallback(estimator="LinearRegression",
+                                   reason="envelope risk 0.31 > 0.25",
+                                   time_ms=9).to_json())
+    store = lst.store
+    assert api_v1(store, "migrations") == [
+        {"nDatasets": 2, "bytes": 4096, "nDevices": 3, "time": 7}]
+    assert api_v1(store, "precision") == [
+        {"estimator": "LinearRegression", "fromDtype": "float8_e4m3fn",
+         "toDtype": "bfloat16", "reason": "envelope risk 0.31 > 0.25",
+         "time": 9}]
+    # accessors hand out copies — a caller mutating a row must not
+    # corrupt the store
+    api_v1(store, "migrations")[0]["bytes"] = 0
+    assert store.migration_events()[0]["bytes"] == 4096
+    ui = StatusWebUI(store)
+    try:
+        rows = json.loads(urllib.request.urlopen(
+            f"{ui.url}api/v1/migrations", timeout=5).read())
+        assert rows and rows[0]["nDatasets"] == 2
+        prec = json.loads(urllib.request.urlopen(
+            f"{ui.url}api/v1/precision", timeout=5).read())
+        assert prec and prec[0]["estimator"] == "LinearRegression"
+        page = urllib.request.urlopen(ui.url, timeout=5).read().decode()
+        assert 'id="migr"' in page and 'id="prec"' in page
+    finally:
+        ui.stop()
