@@ -2,7 +2,8 @@
 # also enforced by tests/test_graftlint.py) and `make test`.
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
-	bench bench-bytes bench-oocore bench-elastic serve-demo multihost
+	bench bench-bytes bench-oocore bench-elastic serve-demo multihost \
+	autoscale-sim
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -86,3 +87,11 @@ bench-elastic:
 # compile-count == bucket-count and p99 under the window bound
 serve-demo:
 	JAX_PLATFORMS=cpu python scripts/serve_demo.py
+
+# autoscale control-plane gate: replay the committed signal trace
+# through the production policy twice — byte-identical logs
+# (determinism) AND byte-equal to the committed golden (drift). A diff
+# here IS the policy-change review artifact; regenerate deliberately
+# with `python scripts/autoscale_sim.py --update`. Pure host-side, <1s.
+autoscale-sim:
+	python scripts/autoscale_sim.py

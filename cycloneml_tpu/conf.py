@@ -1004,3 +1004,80 @@ ELASTIC_SPECULATION = (
     .mutable()
     .bool_conf(False)
 )
+
+AUTOSCALE_ENABLED = (
+    ConfigBuilder("cyclone.autoscale.enabled")
+    .doc("Arm the autoscaler control loop (elastic/autoscale.py): "
+         "context.mesh_supervisor() starts a sampler thread that feeds "
+         "skew/SLO/occupancy signals through the hysteresis policy and "
+         "announces CapacityEvents on the elastic channel. Off by "
+         "default: the control plane is opt-in, exactly as "
+         "spark.dynamicAllocation.enabled=false is.")
+    .bool_conf(False)
+)
+
+AUTOSCALE_TARGET_P99_MS = (
+    ConfigBuilder("cyclone.autoscale.targetP99Ms")
+    .doc("Serving p99 latency target in milliseconds, judged against "
+         "the serving.dispatch timer histogram each tick: sustained "
+         "breach (scaleUpAfterN consecutive ticks) votes scale-up. "
+         "0 disables the serving leg; training pressure (stragglers, "
+         "stepMs SLO) still drives the loop.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .float_conf(0.0)
+)
+
+AUTOSCALE_SCALE_UP_AFTER = (
+    ConfigBuilder("cyclone.autoscale.scaleUpAfterN")
+    .doc("Hysteresis window for growth: consecutive breached ticks "
+         "(serving p99 over target, latched stragglers, or step-SLO "
+         "latch) before ONE scale-up decision fires. Any healthy tick "
+         "resets the streak — a flapping signal never reaches a "
+         "verdict.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(3)
+)
+
+AUTOSCALE_SCALE_DOWN_AFTER = (
+    ConfigBuilder("cyclone.autoscale.scaleDownAfterN")
+    .doc("Hysteresis window for shrink: consecutive idle ticks "
+         "(occupancy below the idle fraction with no breach) before a "
+         "scale-down decision. Deliberately longer than scaleUpAfterN "
+         "by default: shedding capacity too eagerly is the expensive "
+         "mistake.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(6)
+)
+
+AUTOSCALE_COOLDOWN_MS = (
+    ConfigBuilder("cyclone.autoscale.cooldownMs")
+    .doc("Per-direction cooldown after an applied decision, in LOGICAL "
+         "milliseconds (Signals.t_ms — replay-stable): the same "
+         "direction is suppressed until it elapses, so a persistent "
+         "breach re-decides at a bounded rate instead of storming the "
+         "reshape budget.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(30000)
+)
+
+AUTOSCALE_ACQUIRE_TIMEOUT_MS = (
+    ConfigBuilder("cyclone.autoscale.acquireTimeoutMs")
+    .doc("Bounded deadline for the scale-up capacity acquisition "
+         "(parallel/allocation.acquire_devices): past it the decision "
+         "degrades to a logged no-op + CapacityAcquired(ok=False) event "
+         "and the train loop never wedges waiting on capacity that is "
+         "not coming.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(5000)
+)
+
+AUTOSCALE_MAX_DECISIONS = (
+    ConfigBuilder("cyclone.autoscale.maxDecisions")
+    .doc("Applied-decision budget for one autoscaler life, SEPARATE "
+         "from cyclone.elastic.maxReshapes: an exhausted policy "
+         "degrades to one latched warn-hold decision and then holds — "
+         "a misbehaving controller warns, it never thrashes the mesh "
+         "or eats the reshape budget a real failure needs.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .int_conf(8)
+)

@@ -182,6 +182,7 @@ class CycloneContext:
         self._heartbeats = None
         self._hb_lock = threading.Lock()
         self._speculators: List[Any] = []  # armed by mesh_supervisor()
+        self._autoscalers: List[Any] = []  # built by autoscaler()
 
         # cross-process liveness: when a driver heartbeat address is
         # configured, this process pings it over TCP (the wire leg of
@@ -487,7 +488,42 @@ class CycloneContext:
             sp = _speculation.Speculator(sup.stragglers)
             _speculation.install(sp)
             self._speculators.append(sp)  # disarmed + closed on stop
+        from cycloneml_tpu.conf import AUTOSCALE_ENABLED
+        if self.conf.get(AUTOSCALE_ENABLED) and not self._autoscalers:
+            # close the elastic loop: sensors (skew/SLO/occupancy) →
+            # policy → this supervisor's capacity channel. Opt-in, one
+            # per context; stopped (latched) before supervisors on stop()
+            self.autoscaler().start()
         return sup
+
+    def autoscaler(self, **kw):
+        """Build the SLO control loop (elastic/autoscale.py) wired to
+        this context's signal plane: serving p99 from the metrics
+        registry, straggler pressure + step-SLO latches from the skew
+        detector, occupancy from the memory gauges — announcing on the
+        process-global capacity channel. Returned unstarted (call
+        ``.start()`` for the daemon loop, or drive ``tick()`` yourself);
+        stopped with the context. ``cyclone.autoscale.enabled`` makes
+        ``mesh_supervisor()`` arm one automatically."""
+        from cycloneml_tpu.conf import AUTOSCALE_ACQUIRE_TIMEOUT_MS
+        from cycloneml_tpu.elastic import autoscale as _autoscale
+        from cycloneml_tpu.elastic import capacity as _capacity
+        from cycloneml_tpu.elastic.policy import AutoscalePolicy
+        policy = kw.pop("policy", None)
+        if policy is None:
+            policy = AutoscalePolicy.from_conf(self.conf)
+        kw.setdefault("channel", _capacity.channel())
+        kw.setdefault("detector", self.skew_detector)
+        kw.setdefault("registry", self.metrics.registry)
+        kw.setdefault("bus", self.listener_bus)
+        kw.setdefault("used_fn", lambda: self.mesh_runtime.n_devices)
+        kw.setdefault("acquire_timeout_s",
+                      self.conf.get(AUTOSCALE_ACQUIRE_TIMEOUT_MS) / 1e3)
+        kw.setdefault("occupancy_fn",
+                      lambda: _autoscale.occupancy_fraction(self.conf))
+        auto = _autoscale.Autoscaler(policy, **kw)
+        self._autoscalers.append(auto)
+        return auto
 
     def start_ui(self, host: str = "127.0.0.1", port: int = 0):
         """Serve the live status web UI (≈ SparkUI.scala:40 — jobs/steps/
@@ -708,6 +744,14 @@ class CycloneContext:
                 _bootstrap.shutdown(barrier_first=True)
             except Exception:
                 logger.exception("multihost teardown failed")
+        for a in getattr(self, "_autoscalers", []):
+            # stop the control plane BEFORE the supervisors it feeds:
+            # the latch guarantees no decision lands on a stopping mesh
+            try:
+                a.stop()
+            except Exception:
+                logger.exception("autoscaler shutdown failed")
+        self._autoscalers = []
         for sp in getattr(self, "_speculators", []):
             # disarm BEFORE closing: a staging thread mid-race keeps its
             # already-submitted backup; new sites fall back to plain work

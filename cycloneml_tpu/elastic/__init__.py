@@ -1,8 +1,9 @@
-"""Elastic meshes: live scale-up/down, preemption-aware draining, and
-straggler re-dispatch (ROADMAP item 4 — elasticity as a SCHEDULING
-primitive, not just crash recovery).
+"""Elastic meshes: live scale-up/down, preemption-aware draining,
+straggler re-dispatch, and the SLO control plane that drives them
+(ROADMAP item 4 — elasticity as a SCHEDULING primitive, not just crash
+recovery; ROADMAP item 2 — self-operating, not merely elastic-capable).
 
-Three limbs, all seeded-deterministic under the chaos harness:
+Four limbs, all seeded-deterministic under the chaos harness:
 
 - :mod:`~cycloneml_tpu.elastic.capacity` — the :class:`CapacityEvent`
   channel. Scale decisions (API / SIGTERM / the ``elastic.capacity``
@@ -18,6 +19,13 @@ Three limbs, all seeded-deterministic under the chaos harness:
   re-dispatch consuming ``supervisor.stragglers()``: a latched lane's
   next work runs with a duplicate copy, first result wins, the
   duplicate dedups bitwise.
+- :mod:`~cycloneml_tpu.elastic.autoscale` (+ :mod:`~.policy`,
+  :mod:`~.simulate`) — the autoscaler closing the loop: skew/SLO/
+  occupancy signals → hysteresis + cooldown + budget policy →
+  bounded-deadline capacity acquisition → channel announcement. The
+  policy is pure (logical time, no randomness), so
+  :func:`~cycloneml_tpu.elastic.simulate.replay` re-runs any recorded
+  signal trace byte-for-byte (``make autoscale-sim`` gates drift).
 
 Preemption-aware draining (``multihost.preempt_notice`` →
 :class:`~cycloneml_tpu.parallel.faults.PreemptionNotice` →
@@ -27,9 +35,13 @@ rest of the recovery stack; the runtime stale-program guard
 every transition. See docs/resilience.md "Elasticity".
 """
 
+from cycloneml_tpu.elastic.autoscale import (Autoscaler, drop_decision,
+                                             duplicate_decision)
 from cycloneml_tpu.elastic.capacity import (CapacityChannel, CapacityEvent,
                                             channel, scale_to)
+from cycloneml_tpu.elastic.policy import AutoscalePolicy, Decision, Signals
 from cycloneml_tpu.elastic.reshard import host_bounce, host_bounce_state
+from cycloneml_tpu.elastic.simulate import PolicySimulator, replay
 from cycloneml_tpu.elastic.speculation import (Speculator, bitwise_equal,
                                                maybe_speculate)
 
@@ -37,4 +49,6 @@ __all__ = [
     "CapacityChannel", "CapacityEvent", "channel", "scale_to",
     "host_bounce", "host_bounce_state",
     "Speculator", "bitwise_equal", "maybe_speculate",
+    "Autoscaler", "AutoscalePolicy", "Decision", "Signals",
+    "PolicySimulator", "replay", "drop_decision", "duplicate_decision",
 ]

@@ -1,0 +1,316 @@
+"""Autoscaler: the control loop that makes elasticity SELF-OPERATING.
+
+PR 12 built the sensors (skew/straggler verdicts, SLO latches, occupancy
+gauges) and the capacity channel is the actuator (CapacityEvent →
+reshape at a safe step boundary); this module closes the loop. Each tick
+samples the signal plane into one :class:`~.policy.Signals` snapshot —
+
+- **serving p99** from the ``serving.dispatch`` timer histogram, judged
+  against ``cyclone.autoscale.targetP99Ms`` (the Clipper contract:
+  latency SLO drives replica count);
+- **straggler pressure** + **step-time SLO** from the
+  :class:`~cycloneml_tpu.observe.skew.SkewDetector` latches;
+- **HBM occupancy** from the :mod:`~cycloneml_tpu.observe.costs` gauges
+  (−1 when the backend exposes none — CPU smoke never "looks idle") —
+
+feeds it to the :class:`~.policy.AutoscalePolicy`, and APPLIES the
+verdict: scale-up first ACQUIRES capacity through
+:func:`~cycloneml_tpu.parallel.allocation.acquire_devices` with a
+bounded deadline (expiry → graceful no-op + ``CapacityAcquired(ok=False)``
+event, never a wedged train loop), then announces on the channel;
+scale-down announces a half-size mesh directly (shrinking onto a subset
+needs no new capacity).
+
+Chaos: every policy verdict passes the seeded ``autoscale.decide`` fault
+point before application. Schedule ``delay_s`` for a late decision,
+:func:`drop_decision` for a dropped one, or :func:`duplicate_decision`
+for a doubled one — the loop must survive its own controller
+misbehaving, and test_chaos.py pins that it does.
+
+Lifecycle: ``stop()`` latches; the apply path re-checks the latch and
+announces under the SAME lock acquisition, so a concurrent shutdown can
+never land a decision on a stopped supervisor (the JX022 discipline —
+the graftlint fixture pair encodes exactly this idiom).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from cycloneml_tpu.elastic import capacity as _capacity
+from cycloneml_tpu.elastic.policy import AutoscalePolicy, Decision, Signals, \
+    canonical
+from cycloneml_tpu.parallel import allocation as _allocation
+from cycloneml_tpu.parallel import faults as _faults
+from cycloneml_tpu.util.events import AutoscaleDecision, CapacityAcquired
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: skew-detector groups whose latched stragglers count as TRAINING
+#: pressure (serving.dispatch stragglers are the serving leg's business,
+#: already covered by the p99 signal)
+TRAIN_STRAGGLER_GROUPS = ("oocore.stage", "heartbeat.rtt", "fit.lane")
+
+
+def occupancy_fraction(conf=None) -> float:
+    """Peak device-memory occupancy as a fraction of the per-device
+    limit, or -1.0 when the backend exposes no memory stats (CPU) or no
+    limit — the scale-down signal for :class:`~.policy.AutoscalePolicy`."""
+    from cycloneml_tpu.observe import costs as _costs
+    try:
+        if not _costs.memory_stats_available():
+            return -1.0
+        peak = _costs.sample_device_peak()
+        limit = _costs.device_memory_limit(conf)
+        if not peak or not limit:
+            return -1.0
+        return min(1.0, float(peak) / float(limit))
+    except Exception:   # a broken gauge must not kill the control loop
+        logger.exception("autoscale: occupancy sample failed")
+        return -1.0
+
+
+# -- fault ACTIONS for the autoscale.decide point -------------------------
+
+
+def drop_decision(point: str, invocation: int, control=None, **info) -> None:
+    """Chaos action: the controller's decision evaporates in flight —
+    ``sched.at("autoscale.decide", 1, drop_decision)`` proves a lost
+    decision degrades to "breach persists, policy re-decides after
+    cooldown", never a wedged loop."""
+    if control is not None:
+        control["applications"] = 0
+
+
+def duplicate_decision(point: str, invocation: int, control=None,
+                       **info) -> None:
+    """Chaos action: the decision applies TWICE (a controller retry bug).
+    The second application is a same-shape reshape or a bounded acquire
+    no-op — survivable either way, and the test pins the reshape count."""
+    if control is not None:
+        control["applications"] = 2
+
+
+class Autoscaler:
+    """Samples the signal plane, runs the policy, applies the verdict.
+
+    All collaborators are injectable (the simulate/test seam); defaults
+    wire the process-global capacity channel and the platform device
+    count. ``start()`` runs a daemon tick loop; ``tick(now_ms=...)``
+    drives one deterministic step (the chaos tests tick it from the
+    ``elastic.capacity`` boundary with logical time, so the whole closed
+    loop replays under a seed). ``record_path`` appends each tick's
+    Signals as canonical JSONL — the trace ``simulate.replay`` consumes.
+    """
+
+    def __init__(self, policy: AutoscalePolicy, *,
+                 channel: Optional[_capacity.CapacityChannel] = None,
+                 detector=None, registry=None, bus=None,
+                 used_fn: Optional[Callable[[], int]] = None,
+                 master_for: Optional[Callable[[int], str]] = None,
+                 acquire: Optional[Callable] = None,
+                 acquire_timeout_s: float = 5.0,
+                 interval_s: float = 1.0, min_devices: int = 1,
+                 occupancy_fn: Optional[Callable[[], float]] = None,
+                 record_path: Optional[str] = None,
+                 straggler_groups: Iterable[str] = TRAIN_STRAGGLER_GROUPS):
+        self.policy = policy
+        self.acquire_timeout_s = float(acquire_timeout_s)
+        self.interval_s = float(interval_s)
+        self.min_devices = max(1, int(min_devices))
+        self._channel = channel if channel is not None \
+            else _capacity.channel()
+        self._detector = detector
+        self._registry = registry
+        self._bus = bus
+        self._used_fn = used_fn or self._default_used
+        self._master_for = master_for or (lambda n: f"local-mesh[{n}]")
+        self._acquire = acquire or _allocation.acquire_devices
+        self._occupancy_fn = occupancy_fn or occupancy_fraction
+        self._groups = tuple(straggler_groups)
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._record_lock = threading.Lock()
+        self._record_fh = open(record_path, "a", encoding="utf-8") \
+            if record_path else None
+
+    @staticmethod
+    def _default_used() -> int:
+        import jax
+        return len(jax.devices())
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample(self, now_ms: Optional[int] = None) -> Signals:
+        """One snapshot of the signal plane. ``now_ms`` overrides the
+        wall clock with logical time (chaos/replay determinism)."""
+        t_ms = int(now_ms) if now_ms is not None \
+            else int(time.time() * 1000)
+        p99_ms = 0.0
+        if self._registry is not None:
+            try:
+                snap = self._registry.timer("serving.dispatch").snapshot()
+                p99_ms = float(snap.get("p99", 0.0)) * 1e3
+            except Exception:
+                logger.exception("autoscale: serving p99 sample failed")
+        pressure = 0
+        step_breached = False
+        if self._detector is not None:
+            try:
+                pressure = self._detector.straggler_pressure(self._groups)
+                step_breached = bool(
+                    self._detector.slo_breaches("collectives.step"))
+            except Exception:
+                logger.exception("autoscale: skew sample failed")
+        return Signals(t_ms=t_ms, serving_p99_ms=p99_ms,
+                       straggler_pressure=pressure,
+                       step_slo_breached=step_breached,
+                       occupancy_fraction=float(self._occupancy_fn()))
+
+    def _record(self, signals: Signals) -> None:
+        with self._record_lock:
+            fh = self._record_fh
+            if fh is None:
+                return
+            fh.write(canonical(signals.to_json()) + "\n")
+            fh.flush()
+
+    # -- the control loop -------------------------------------------------
+
+    def tick(self, now_ms: Optional[int] = None) -> Optional[Decision]:
+        """One sample → decide → apply step; returns the Decision (or
+        None). Never raises on signal/apply trouble — a control plane
+        that crashes the loop it supervises is worse than no control
+        plane."""
+        with self._lock:
+            if self._stopped:
+                return None
+        signals = self.sample(now_ms)
+        self._record(signals)
+        decision = self.policy.decide(signals)
+        if decision is None:
+            return None
+        # the controller-misbehaving fault point: actions mutate
+        # control["applications"] (0 = dropped, 2 = duplicated); an
+        # exception fault drops the decision too — either way the loop
+        # continues and the policy re-decides after its cooldown
+        control = {"applications": 1}
+        try:
+            _faults.inject("autoscale.decide", decision=decision.to_json(),
+                           control=control)
+        except _faults.FaultInjected as exc:
+            logger.warning("autoscale: decision #%d lost to injected "
+                           "fault: %s", decision.seq, exc)
+            control["applications"] = 0
+        if decision.action == "warn-hold":
+            outcome = "warn-hold"
+            logger.warning(
+                "autoscale: decision budget exhausted (%d applied) — "
+                "holding; raise cyclone.autoscale.maxDecisions or "
+                "investigate the flapping signal", self.policy.max_decisions)
+        elif control["applications"] <= 0:
+            outcome = "dropped"
+            logger.warning("autoscale: decision #%d dropped",
+                           decision.seq)
+        else:
+            outcome = "held"
+            for _ in range(int(control["applications"])):
+                outcome = self._apply(decision)
+        self._post(AutoscaleDecision(
+            seq=decision.seq, action=decision.action,
+            direction=decision.direction, reason=decision.reason,
+            outcome=outcome, breach_streak=decision.breach_streak,
+            idle_streak=decision.idle_streak))
+        return decision
+
+    def _apply(self, decision: Decision) -> str:
+        used = max(1, int(self._used_fn()))
+        if decision.direction == "up":
+            start = time.monotonic()
+            n = self._acquire(used + 1, self.acquire_timeout_s,
+                              cancel=self._stop_event)
+            waited_ms = (time.monotonic() - start) * 1e3
+            if n is None:
+                # acquire deadline expired: graceful no-op + event; the
+                # policy's cooldown retries later if the breach persists
+                logger.warning(
+                    "autoscale: capacity acquire timed out after %.0fms "
+                    "(decision #%d, wanted >%d devices) — holding",
+                    waited_ms, decision.seq, used)
+                self._post(CapacityAcquired(
+                    ok=False, n_devices=0, waited_ms=waited_ms,
+                    reason=decision.reason))
+                return "acquire-timeout"
+            target = n
+            self._post(CapacityAcquired(
+                ok=True, master=self._master_for(target), n_devices=target,
+                waited_ms=waited_ms, reason=decision.reason))
+        else:
+            target = max(self.min_devices, used // 2)
+            if target >= used:
+                return "held"   # already at the floor: nothing to shed
+        event = _capacity.CapacityEvent(
+            master=self._master_for(target),
+            reason=f"autoscale: {decision.reason} (#{decision.seq})")
+        # latch discipline: re-check stop and announce under the SAME
+        # lock hold, so a concurrent stop() can never interleave between
+        # the check and the announcement (JX022)
+        with self._lock:
+            if self._stopped:
+                logger.info("autoscale: stopped — decision #%d not "
+                            "announced", decision.seq)
+                return "held"
+            self._channel.announce(event)
+        return "announced"
+
+    def _post(self, event) -> None:
+        if self._bus is None:
+            return
+        try:
+            self._bus.post(event)
+        except Exception:
+            logger.exception("autoscale: event post failed")
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        """Run the tick loop on a daemon thread. Raises once stopped —
+        an autoscaler does not reincarnate (build a new one)."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("autoscaler is stopped")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="cyclone-autoscale",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:   # the loop never dies to a bad tick
+                logger.exception("autoscale: tick failed")
+
+    def stop(self) -> None:
+        """Latch shutdown, wake + join the loop, close the recorder.
+        Idempotent; in-flight decisions observe the latch before they
+        can announce."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            thread, self._thread = self._thread, None
+        self._stop_event.set()
+        if thread is not None:
+            thread.join(timeout=5)
+        with self._record_lock:
+            fh, self._record_fh = self._record_fh, None
+        if fh is not None:
+            fh.close()

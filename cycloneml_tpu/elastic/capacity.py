@@ -73,7 +73,15 @@ class CapacityChannel:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock, deliberately: install_preemption_handler's SIGTERM
+        # handler runs ON the main thread between bytecodes — if the
+        # main thread is inside announce() when the signal lands, the
+        # handler's own announce() re-enters the lock on the SAME
+        # thread, and a plain Lock would self-deadlock the process at
+        # the exact moment it must drain. Cross-thread producers (the
+        # autoscaler loop racing the handler) still serialize normally,
+        # FIFO, non-coalescing.
+        self._lock = threading.RLock()
         self._events: List[CapacityEvent] = []
 
     def announce(self, event: CapacityEvent) -> None:

@@ -1,0 +1,238 @@
+"""Autoscale policy: the pure, deterministic decision core.
+
+The control plane splits controller from actuator the way the chaos
+harness splits schedule from injector: this module is the POLICY — a
+pure state machine over :class:`Signals` snapshots with no clock reads,
+no global randomness and no device work — and
+:mod:`~cycloneml_tpu.elastic.autoscale` is the runtime that samples the
+PR-12 signal plane, feeds it, and applies what it returns. Purity is the
+point: :mod:`~cycloneml_tpu.elastic.simulate` replays a recorded signal
+trace through the EXACT production policy object and gets a
+byte-identical decision log under a fixed seed, so every policy change
+is reviewable as a decision-log diff (the Zaharia NSDI'12 lesson —
+speculation/decommission policy must be budgeted and deterministic to
+be trustworthy; Clipper, Crankshaw NSDI'17, supplies the SLO-driven
+adaptation contract the serving leg implements).
+
+Robustness semantics (docs/resilience.md "Autoscaling"):
+
+- **per-direction hysteresis**: a scale-up needs ``scale_up_after``
+  CONSECUTIVE breach ticks, a scale-down ``scale_down_after``
+  consecutive idle ticks; any contrary sample resets the streak, so a
+  flapping signal never reaches a verdict.
+- **per-direction cooldowns**: after a decision, the same direction is
+  suppressed for ``cooldown_ms`` of *logical* time (``Signals.t_ms`` —
+  never the wall clock, or replay would diverge).
+- **decision budget**: ``max_decisions`` applied decisions, SEPARATE
+  from ``MeshSupervisor.max_reshapes`` — an exhausted policy degrades
+  to ONE latched ``warn-hold`` decision and then holds silently; it
+  never thrashes the mesh or eats the budget a real failure needs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+#: occupancy fraction below which a tick counts as idle (the scale-down
+#: signal); occupancy < 0 means "unavailable" and never counts as idle
+IDLE_OCCUPANCY_FRACTION = 0.3
+
+
+def canonical(obj: Any) -> str:
+    """Canonical JSON line — sorted keys, no whitespace — so equal
+    decisions serialize to equal BYTES (the simulation-determinism and
+    golden-log contracts compare bytes, not parsed trees)."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+@dataclass(frozen=True)
+class Signals:
+    """One sampled snapshot of the PR-12 signal plane.
+
+    ``t_ms`` is the tick's logical timestamp — supplied by the sampler
+    (wall clock at record time, invocation count under chaos, trace
+    field on replay); the policy itself never reads a clock.
+    ``serving_p99_ms`` is 0 when nothing serves; ``occupancy_fraction``
+    is -1 when the backend exposes no memory stats (CPU smoke) — an
+    unavailable gauge can never vote for scale-down.
+    """
+
+    t_ms: int = 0
+    serving_p99_ms: float = 0.0
+    straggler_pressure: int = 0
+    step_slo_breached: bool = False
+    occupancy_fraction: float = -1.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"t_ms": self.t_ms,
+                "serving_p99_ms": self.serving_p99_ms,
+                "straggler_pressure": self.straggler_pressure,
+                "step_slo_breached": self.step_slo_breached,
+                "occupancy_fraction": self.occupancy_fraction}
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Signals":
+        return cls(
+            t_ms=int(d.get("t_ms", 0)),
+            serving_p99_ms=float(d.get("serving_p99_ms", 0.0)),
+            straggler_pressure=int(d.get("straggler_pressure", 0)),
+            step_slo_breached=bool(d.get("step_slo_breached", False)),
+            occupancy_fraction=float(d.get("occupancy_fraction", -1.0)))
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One policy verdict. ``action`` is ``scale-up`` / ``scale-down``
+    / ``warn-hold`` (budget exhausted — announced once, applied never);
+    streak fields record the hysteresis evidence at verdict time."""
+
+    seq: int = 0
+    t_ms: int = 0
+    action: str = ""
+    direction: str = ""
+    reason: str = ""
+    breach_streak: int = 0
+    idle_streak: int = 0
+    budget_left: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"seq": self.seq, "t_ms": self.t_ms, "action": self.action,
+                "direction": self.direction, "reason": self.reason,
+                "breach_streak": self.breach_streak,
+                "idle_streak": self.idle_streak,
+                "budget_left": self.budget_left}
+
+
+class AutoscalePolicy:
+    """Hysteresis + cooldown + budget over a stream of :class:`Signals`.
+
+    NOT thread-safe by itself — the runtime serializes ``decide`` calls
+    (one tick at a time), and the simulator is single-threaded by
+    construction. ``seed`` pins the replay identity: the policy draws no
+    randomness, but the seed travels in the decision-log header so a log
+    diff always says which replay universe produced it.
+    """
+
+    def __init__(self, *, target_p99_ms: float = 0.0,
+                 scale_up_after: int = 3, scale_down_after: int = 6,
+                 cooldown_ms: int = 30000, max_decisions: int = 8,
+                 idle_occupancy: float = IDLE_OCCUPANCY_FRACTION,
+                 seed: int = 0):
+        self.target_p99_ms = float(target_p99_ms)
+        self.scale_up_after = max(1, int(scale_up_after))
+        self.scale_down_after = max(1, int(scale_down_after))
+        self.cooldown_ms = max(0, int(cooldown_ms))
+        self.max_decisions = max(0, int(max_decisions))
+        self.idle_occupancy = float(idle_occupancy)
+        self.seed = int(seed)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_ms: Dict[str, Optional[int]] = {"up": None, "down": None}
+        self._decisions = 0
+        self._warned = False
+        self._log: List[Decision] = []
+
+    @classmethod
+    def from_conf(cls, conf, seed: int = 0) -> "AutoscalePolicy":
+        from cycloneml_tpu.conf import (AUTOSCALE_COOLDOWN_MS,
+                                        AUTOSCALE_MAX_DECISIONS,
+                                        AUTOSCALE_SCALE_DOWN_AFTER,
+                                        AUTOSCALE_SCALE_UP_AFTER,
+                                        AUTOSCALE_TARGET_P99_MS)
+        return cls(target_p99_ms=conf.get(AUTOSCALE_TARGET_P99_MS),
+                   scale_up_after=conf.get(AUTOSCALE_SCALE_UP_AFTER),
+                   scale_down_after=conf.get(AUTOSCALE_SCALE_DOWN_AFTER),
+                   cooldown_ms=conf.get(AUTOSCALE_COOLDOWN_MS),
+                   max_decisions=conf.get(AUTOSCALE_MAX_DECISIONS),
+                   seed=seed)
+
+    def params(self) -> Dict[str, Any]:
+        """The policy's knobs, for the decision-log header — two logs
+        are only comparable when their headers match."""
+        return {"target_p99_ms": self.target_p99_ms,
+                "scale_up_after": self.scale_up_after,
+                "scale_down_after": self.scale_down_after,
+                "cooldown_ms": self.cooldown_ms,
+                "max_decisions": self.max_decisions,
+                "idle_occupancy": self.idle_occupancy}
+
+    @property
+    def log(self) -> List[Decision]:
+        """Every decision made, in order (warn-hold included)."""
+        return list(self._log)
+
+    @property
+    def decisions_applied(self) -> int:
+        """Applied (budget-consuming) decisions so far."""
+        return self._decisions
+
+    @property
+    def budget_exhausted(self) -> bool:
+        return self._decisions >= self.max_decisions
+
+    def breach_reason(self, s: Signals) -> Optional[str]:
+        """Why this tick votes scale-up, or None. Serving p99 outranks
+        training pressure: a violated latency SLO is user-visible."""
+        if self.target_p99_ms > 0 and s.serving_p99_ms > self.target_p99_ms:
+            return "serving-p99"
+        if s.straggler_pressure > 0:
+            return "straggler-pressure"
+        if s.step_slo_breached:
+            return "step-slo"
+        return None
+
+    def decide(self, signals: Signals) -> Optional[Decision]:
+        """Feed one tick; a Decision when the hysteresis window closes,
+        else None. Pure in the replay sense: the same Signals sequence
+        always yields the same Decision sequence."""
+        reason = self.breach_reason(signals)
+        idle = (reason is None and
+                0.0 <= signals.occupancy_fraction < self.idle_occupancy)
+        if reason is not None:
+            self._up_streak += 1
+            self._down_streak = 0
+            direction, streak, need = "up", self._up_streak, \
+                self.scale_up_after
+        elif idle:
+            self._down_streak += 1
+            self._up_streak = 0
+            direction, streak, need = "down", self._down_streak, \
+                self.scale_down_after
+            reason = "idle-occupancy"
+        else:
+            # neither breached nor idle: every streak restarts from here
+            self._up_streak = 0
+            self._down_streak = 0
+            return None
+        if streak < need:
+            return None
+        last = self._last_ms[direction]
+        if last is not None and signals.t_ms - last < self.cooldown_ms:
+            return None   # cooldown: sustained pressure re-decides later
+        up, down = self._up_streak, self._down_streak
+        if self._decisions >= self.max_decisions:
+            if self._warned:
+                return None
+            # budget exhausted: degrade to ONE latched warn-hold — the
+            # flapping-policy failure mode is a warning, never a thrash
+            self._warned = True
+            return self._record(Decision(
+                seq=len(self._log) + 1, t_ms=signals.t_ms,
+                action="warn-hold", direction=direction, reason=reason,
+                breach_streak=up, idle_streak=down, budget_left=0))
+        self._decisions += 1
+        self._last_ms[direction] = signals.t_ms
+        self._up_streak = 0
+        self._down_streak = 0
+        return self._record(Decision(
+            seq=len(self._log) + 1, t_ms=signals.t_ms,
+            action="scale-up" if direction == "up" else "scale-down",
+            direction=direction, reason=reason,
+            breach_streak=up, idle_streak=down,
+            budget_left=self.max_decisions - self._decisions))
+
+    def _record(self, d: Decision) -> Decision:
+        self._log.append(d)
+        return d

@@ -1,0 +1,86 @@
+"""Deterministic policy simulation: recorded trace in, decision log out.
+
+The autoscaler's ``record_path`` (and the flight recorder's signal
+dumps) produce a JSONL trace of :class:`~.policy.Signals` snapshots.
+This harness replays such a trace through the EXACT production
+:class:`~.policy.AutoscalePolicy` object — same class, same ``decide``,
+no simulation-only fork to drift — and emits the decision log as
+canonical JSON lines. Because the policy is pure (logical ``t_ms`` only,
+no clocks, no global randomness) the output is BYTE-identical across
+runs under a fixed seed: ``scripts/autoscale_sim.py`` (``make
+autoscale-sim``) gates CI on drift against a committed golden log, so
+every policy change shows up as a reviewable decision-log diff.
+
+Trace grammar: one canonical-JSON object per line with at least a
+``t_ms`` field (:meth:`Signals.to_json` shape). Lines without ``t_ms``
+are metadata and skipped; blank and torn lines are tolerated the same
+way :meth:`EventJournal.replay` tolerates a truncated tail — a trace
+recorded up to a crash still replays.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+from cycloneml_tpu.elastic.policy import AutoscalePolicy, Signals, canonical
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PolicySimulator:
+    """Feeds trace lines to a policy; collects canonical decision lines.
+
+    The first output line is a header pinning the policy's knobs and
+    seed — two logs are only comparable when their headers match, and a
+    golden-log diff that starts at line 1 says "the policy changed", not
+    "the trace changed".
+    """
+
+    def __init__(self, policy: AutoscalePolicy):
+        self.policy = policy
+
+    def run(self, lines: Iterable[str]) -> List[str]:
+        out = [canonical({"kind": "autoscale.decisions", "version": 1,
+                          "seed": self.policy.seed,
+                          "policy": self.policy.params()})]
+        fed = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue   # torn tail / partial write: tolerated
+            if not isinstance(d, dict) or "t_ms" not in d:
+                continue   # metadata/header line
+            fed += 1
+            decision = self.policy.decide(Signals.from_json(d))
+            if decision is not None:
+                out.append(canonical(decision.to_json()))
+        logger.info("autoscale sim: %d signal ticks -> %d decisions",
+                    fed, len(out) - 1)
+        return out
+
+
+def replay(trace_path: str, policy: Optional[AutoscalePolicy] = None,
+           conf=None, seed: int = 0) -> List[str]:
+    """Replay a recorded signal trace; returns the decision-log lines
+    (header first). A fresh policy is built from ``conf`` (or defaults)
+    when none is given — pass an explicit policy to replay mid-life
+    state."""
+    if policy is None:
+        policy = AutoscalePolicy.from_conf(conf, seed=seed) \
+            if conf is not None else AutoscalePolicy(seed=seed)
+    with open(trace_path, encoding="utf-8") as fh:
+        return PolicySimulator(policy).run(fh)
+
+
+def write_decision_log(lines: Iterable[str], path: str) -> None:
+    """Write decision-log lines with a trailing newline each — the byte
+    layout the golden comparison pins."""
+    with open(path, "w", encoding="utf-8") as fh:
+        for line in lines:
+            fh.write(line + "\n")

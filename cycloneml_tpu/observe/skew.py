@@ -252,6 +252,29 @@ class SkewDetector:
         with self._lock:
             return list(self._events)
 
+    def straggler_pressure(self, groups=None) -> int:
+        """Count of currently latched straggler verdicts, optionally
+        restricted to ``groups`` — the autoscaler's training-pressure
+        signal (elastic/autoscale.py samples it each tick; latching
+        means pressure holds until the lane actually recovers, so the
+        hysteresis streak measures sustained trouble, not one spike)."""
+        with self._lock:
+            if groups is None:
+                return len(self._flagged)
+            wanted = set(groups)
+            return sum(1 for g, _ in self._flagged if g in wanted)
+
+    def slo_breaches(self, group: Optional[str] = None
+                     ) -> List[Tuple[str, str]]:
+        """Currently latched (group, position) SLO breaches, sorted;
+        filter by ``group`` (e.g. ``collectives.step`` for the
+        autoscaler's step-time leg)."""
+        with self._lock:
+            keys = sorted(self._slo_breached)
+        if group is None:
+            return keys
+        return [k for k in keys if k[0] == group]
+
     def reset_position(self, group: str, position: str) -> None:
         """Forget ONE lane: samples, cached median and latched verdicts.
         The liveness re-arm hook (MeshSupervisor.readmit) — a worker

@@ -18,11 +18,49 @@ optimizer checkpoint — the same recovery contract as the downsize path.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def acquire_devices(min_devices: int, timeout_s: float,
+                    poll_interval_s: float = 0.05,
+                    available_fn: Optional[Callable[[], int]] = None,
+                    cancel: Optional[threading.Event] = None
+                    ) -> Optional[int]:
+    """Bounded-deadline capacity request — the autoscaler's acquire leg.
+
+    Polls the platform's visible device count until it reaches
+    ``min_devices`` or the deadline expires; returns the available count
+    on success, ``None`` on expiry or when ``cancel`` (an Event — e.g.
+    an autoscaler's shutdown latch) is set mid-wait. Callers MUST treat
+    ``None`` as a graceful no-op: the whole point of the bounded wait is
+    that a capacity request can fail without wedging a train loop.
+    """
+    avail_fn = available_fn or ExecutorAllocationManager._available
+    deadline = time.monotonic() + max(0.0, float(timeout_s))
+    while True:
+        if cancel is not None and cancel.is_set():
+            return None
+        try:
+            n = avail_fn()
+        except Exception:
+            logger.exception("acquire_devices: availability poll failed")
+            n = 0
+        if n >= min_devices:
+            return int(n)
+        timeout_left = deadline - time.monotonic()
+        if timeout_left <= 0:
+            return None
+        wait_s = min(poll_interval_s, timeout_left)
+        if cancel is not None:
+            if cancel.wait(wait_s):
+                return None
+        else:
+            time.sleep(wait_s)
 
 
 class ExecutorAllocationManager:
@@ -107,6 +145,16 @@ class ExecutorAllocationManager:
         # under multihost every process must re-form ONE coordinated
         # mesh from its own conf, never a per-process local-mesh
         return self.ctx.rebuild_mesh()
+
+    def acquire(self, min_devices: int, timeout_s: float,
+                cancel: Optional[threading.Event] = None) -> Optional[int]:
+        """Instance form of :func:`acquire_devices` — a capacity event
+        can request devices and wait with a bounded deadline before the
+        supervisor commits to the reshape."""
+        return acquire_devices(min_devices, timeout_s,
+                               poll_interval_s=min(self.poll_interval_s,
+                                                   0.25),
+                               cancel=cancel)
 
     def stop(self) -> None:
         self._stop.set()

@@ -66,6 +66,19 @@ point                    fired from
                          the loop re-shards live optimizer state onto
                          the new mesh at that boundary and resumes in
                          place, no checkpoint restore.
+``autoscale.decide``     every autoscaler policy verdict, between the
+                         decision and its application
+                         (``elastic/autoscale.py`` — the controller
+                         misbehaving as a first-class fault).
+                         Schedule a ``delay`` for a late decision,
+                         :func:`~cycloneml_tpu.elastic.autoscale.drop_decision`
+                         for a lost one (the breach persists and the
+                         policy re-decides after its cooldown), or
+                         ``duplicate_decision`` for a doubled one
+                         (the second application is a same-shape
+                         reshape or a bounded acquire no-op) — the
+                         elastic loop must survive its own control
+                         plane.
 ======================== =================================================
 
 Faults are *scheduled*, not sprayed: a :class:`FaultSchedule` names the

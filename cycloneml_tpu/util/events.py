@@ -165,6 +165,40 @@ class SloBreach(CycloneEvent):
 
 
 @dataclass
+class AutoscaleDecision(CycloneEvent):
+    """The autoscaler policy reached a verdict (elastic/autoscale.py).
+    ``action`` is scale-up / scale-down / warn-hold (decision budget
+    exhausted); ``outcome`` records what the actuator did with it —
+    announced, acquire-timeout, dropped (injected fault), warn-hold, or
+    held (stopped / at the floor). The streak fields are the hysteresis
+    evidence at verdict time, so the webui decisions table answers
+    "why" without the flight recorder."""
+
+    seq: int = 0
+    action: str = ""
+    direction: str = ""
+    reason: str = ""
+    outcome: str = ""
+    breach_streak: int = 0
+    idle_streak: int = 0
+
+
+@dataclass
+class CapacityAcquired(CycloneEvent):
+    """A scale-up decision's bounded capacity acquisition resolved.
+    ``ok=True``: the platform showed ``n_devices`` within the deadline
+    and a CapacityEvent for ``master`` was announced. ``ok=False``: the
+    deadline expired — the decision degraded to a graceful no-op (the
+    loop is explicitly allowed to want capacity that never comes)."""
+
+    master: str = ""
+    n_devices: int = 0
+    waited_ms: float = 0.0
+    ok: bool = True
+    reason: str = ""
+
+
+@dataclass
 class CheckpointWritten(CycloneEvent):
     path: str = ""
     step: int = 0

@@ -48,6 +48,11 @@ class AppStatusStore:
         self.migrations: List[Dict[str, Any]] = []
         # PrecisionFallback events (fp8 tier declined/abandoned per fit)
         self.precision_fallbacks: List[Dict[str, Any]] = []
+        # AutoscaleDecision / CapacityAcquired events (elastic/autoscale
+        # control plane), newest last — the /api/v1/autoscale + web UI
+        # surface. Bounded like skew: a long-lived loop ticks forever
+        self.autoscale: List[Dict[str, Any]] = []
+        self.max_autoscale_events = 200
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -96,6 +101,12 @@ class AppStatusStore:
         """Recorded fp8→bf16 precision fallbacks, newest last."""
         with self._lock:
             return [dict(e) for e in self.precision_fallbacks]
+
+    def autoscale_events(self) -> List[Dict[str, Any]]:
+        """Recorded autoscaler decisions + capacity acquisitions,
+        newest last."""
+        with self._lock:
+            return [dict(e) for e in self.autoscale]
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -209,6 +220,24 @@ class AppStatusListener:
                     "toDtype": e.get("to_dtype"),
                     "reason": e.get("reason"),
                     "time": e.get("time_ms")})
+        elif kind == "AutoscaleDecision":
+            self._append_autoscale(s, {"kind": "decision",
+                                       "seq": e.get("seq"),
+                                       "action": e.get("action"),
+                                       "direction": e.get("direction"),
+                                       "reason": e.get("reason"),
+                                       "outcome": e.get("outcome"),
+                                       "breachStreak": e.get("breach_streak"),
+                                       "idleStreak": e.get("idle_streak"),
+                                       "time": e.get("time_ms")})
+        elif kind == "CapacityAcquired":
+            self._append_autoscale(s, {"kind": "capacity",
+                                       "master": e.get("master"),
+                                       "nDevices": e.get("n_devices"),
+                                       "waitedMs": e.get("waited_ms"),
+                                       "ok": e.get("ok"),
+                                       "reason": e.get("reason"),
+                                       "time": e.get("time_ms")})
 
     @staticmethod
     def _append_skew(s: AppStatusStore, row: Dict[str, Any]) -> None:
@@ -216,6 +245,13 @@ class AppStatusListener:
             s.skew.append(row)
             while len(s.skew) > s.max_skew_events:
                 s.skew.pop(0)
+
+    @staticmethod
+    def _append_autoscale(s: AppStatusStore, row: Dict[str, Any]) -> None:
+        with s._lock:
+            s.autoscale.append(row)
+            while len(s.autoscale) > s.max_autoscale_events:
+                s.autoscale.pop(0)
 
 
 class HistoryProvider:
@@ -252,7 +288,8 @@ def api_v1(store: AppStatusStore, route: str,
     """Tiny REST dispatcher shaped like status/api/v1 paths:
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
-    'memory/warnings', 'serving', 'skew', 'migrations', 'precision'."""
+    'memory/warnings', 'serving', 'skew', 'migrations', 'precision',
+    'autoscale'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -277,4 +314,6 @@ def api_v1(store: AppStatusStore, route: str,
         return store.migration_events()
     if route == "precision":
         return store.precision_events()
+    if route == "autoscale":
+        return store.autoscale_events()
     raise KeyError(f"unknown route {route!r}")

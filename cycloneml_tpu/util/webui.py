@@ -41,6 +41,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Worker failures</h2><div id="fails" class="muted">none</div>
 <h2>Block migrations</h2><div id="migr" class="muted">none</div>
 <h2>Precision fallbacks</h2><div id="prec" class="muted">none</div>
+<h2>Autoscaler decisions</h2><div id="autoscale" class="muted">none</div>
 <script>
 async function j(r) { return (await fetch('/api/v1/' + r)).json(); }
 function esc(v) {
@@ -110,6 +111,10 @@ async function refresh() {
   if (prec.length) document.getElementById('prec').innerHTML =
     table(prec.slice(-20), ['estimator', 'fromDtype', 'toDtype',
                             'reason', 'time']);
+  const asc = await j('autoscale');
+  if (asc.length) document.getElementById('autoscale').innerHTML =
+    table(asc.slice(-20), ['kind', 'seq', 'action', 'direction', 'reason',
+                           'outcome', 'master', 'nDevices', 'ok', 'time']);
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""CI gate for autoscale-policy drift: replay the committed fixture
+signal trace through the production policy and compare the decision log
+BYTE-FOR-BYTE against the committed golden.
+
+Two checks, both required (``make autoscale-sim``):
+
+1. determinism — the same trace replayed twice through two fresh policy
+   objects must produce byte-identical logs (a clock read or global
+   random sneaking onto the decision path fails here first);
+2. drift — the log must equal the committed golden. A failing diff is
+   the REVIEW ARTIFACT: commit the new golden (``--update``) only when
+   the decision changes are intended.
+
+Exit 0 on pass, 1 on drift/nondeterminism. Pure host-side (no jax, no
+devices) — cheap enough for every CI run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cycloneml_tpu.elastic.policy import AutoscalePolicy          # noqa: E402
+from cycloneml_tpu.elastic.simulate import replay, \
+    write_decision_log                                            # noqa: E402
+
+TRACE = os.path.join(REPO, "tests", "fixtures", "autoscale",
+                     "trace.jsonl")
+GOLDEN = os.path.join(REPO, "tests", "fixtures", "autoscale",
+                      "golden_decisions.jsonl")
+
+
+def golden_policy() -> AutoscalePolicy:
+    """The pinned policy the golden log was produced with. Change these
+    knobs and the golden MUST be regenerated (--update) — the header
+    line diff makes that explicit."""
+    return AutoscalePolicy(target_p99_ms=50.0, scale_up_after=3,
+                           scale_down_after=4, cooldown_ms=5000,
+                           max_decisions=3, seed=17)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", default=TRACE)
+    ap.add_argument("--golden", default=GOLDEN)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the golden from this replay")
+    args = ap.parse_args()
+
+    first = replay(args.trace, policy=golden_policy())
+    second = replay(args.trace, policy=golden_policy())
+    if first != second:
+        print("FAIL: two replays of the same trace diverged — the "
+              "decision path is nondeterministic", file=sys.stderr)
+        return 1
+
+    if args.update:
+        write_decision_log(first, args.golden)
+        print(f"golden updated: {args.golden} ({len(first) - 1} decisions)")
+        return 0
+
+    try:
+        with open(args.golden, encoding="utf-8") as fh:
+            golden = [line.rstrip("\n") for line in fh]
+    except FileNotFoundError:
+        print(f"FAIL: no golden at {args.golden} (run --update once)",
+              file=sys.stderr)
+        return 1
+
+    if first == golden:
+        print(f"OK: {len(first) - 1} decisions, byte-identical to golden")
+        return 0
+    print("FAIL: decision log drifted from golden:", file=sys.stderr)
+    for i, (got, want) in enumerate(
+            __import__("itertools").zip_longest(first, golden)):
+        if got != want:
+            print(f"  line {i + 1}:\n    got:  {got}\n    want: {want}",
+                  file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

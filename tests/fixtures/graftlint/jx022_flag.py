@@ -57,3 +57,31 @@ def interprocedural_dispatch(items):
     lane = Lane()
     shutdown_lane(lane)
     return lane.submit(items)                                   # JX022
+
+
+class ScaleSupervisor:
+    """Autoscale-actuator shape (ISSUE 17): announce() guards on the
+    stop latch; a decision landing after stop() must die, not thrash a
+    torn-down supervisor."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = False
+
+    def announce(self, decision):
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("supervisor stopped")
+        return decision
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+
+
+def decide_after_shutdown(events):
+    sup = ScaleSupervisor()
+    for ev in events:
+        sup.announce(ev)
+    sup.stop()
+    return sup.announce("scale-up")                             # JX022

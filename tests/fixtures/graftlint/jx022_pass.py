@@ -72,3 +72,54 @@ def restarted(items):
     for it in items:
         lane.submit(it)
     lane.stop()
+
+
+class ScaleSupervisor:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = False
+
+    def announce(self, decision):
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("supervisor stopped")
+        return decision
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+
+
+class LatchedAutoscaler:
+    """The ISSUE-17 idiom: the decision re-checks the shutdown latch
+    and announces under the SAME lock hold, so stop() can never
+    interleave between the check and the dispatch."""
+
+    def __init__(self, supervisor):
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._supervisor = supervisor
+
+    def apply(self, decision):
+        with self._lock:
+            if self._stopped:
+                return "held"
+            self._supervisor.announce(decision)
+        return "announced"
+
+    def stop(self):
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._supervisor.stop()
+
+
+def disciplined_decide(events):
+    sup = ScaleSupervisor()
+    auto = LatchedAutoscaler(sup)
+    try:
+        for ev in events:
+            auto.apply(ev)
+    finally:
+        auto.stop()

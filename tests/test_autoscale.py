@@ -1,0 +1,507 @@
+"""Autoscaler + SLO control plane (ISSUE 17): policy hysteresis,
+cooldowns, the decision budget's warn-and-hold degradation, bounded
+capacity acquisition, the channel's concurrent-producer contract, the
+lifecycle latch, event plumbing (store/route/journal), and the
+simulation harness's byte-determinism + golden gate.
+
+The closed-loop chaos e2es (breach -> announce -> reshape -> parity)
+live in test_chaos.py next to the rest of the elastic suite; this file
+pins the control plane's pieces in isolation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cycloneml_tpu.elastic.autoscale import Autoscaler
+from cycloneml_tpu.elastic.capacity import CapacityChannel, CapacityEvent
+from cycloneml_tpu.elastic.policy import (AutoscalePolicy, Signals,
+                                          canonical)
+from cycloneml_tpu.elastic.simulate import (PolicySimulator, replay,
+                                            write_decision_log)
+from cycloneml_tpu.parallel.allocation import acquire_devices
+from cycloneml_tpu.util.events import (AutoscaleDecision, CapacityAcquired,
+                                       EventJournal, ListenerBus)
+from cycloneml_tpu.util.status import (AppStatusListener, HistoryProvider,
+                                       api_v1)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "autoscale")
+
+
+def _breach(t_ms, **kw):
+    kw.setdefault("serving_p99_ms", 120.0)
+    return Signals(t_ms=t_ms, **kw)
+
+
+def _healthy(t_ms, **kw):
+    kw.setdefault("serving_p99_ms", 20.0)
+    kw.setdefault("occupancy_fraction", 0.6)
+    return Signals(t_ms=t_ms, **kw)
+
+
+def _policy(**kw):
+    kw.setdefault("target_p99_ms", 50.0)
+    kw.setdefault("scale_up_after", 3)
+    kw.setdefault("scale_down_after", 4)
+    kw.setdefault("cooldown_ms", 5000)
+    kw.setdefault("max_decisions", 8)
+    return AutoscalePolicy(**kw)
+
+
+# -- policy hysteresis (satellite 4) ----------------------------------------
+
+def test_hysteresis_breach_recover_breach_pins_exactly_n():
+    """The flap-proof contract: two sustained breach episodes separated
+    by a recovery produce EXACTLY two decisions — the recovery resets
+    the streak, and in-episode extra breach ticks are absorbed by the
+    post-decision streak reset + cooldown."""
+    p = _policy()
+    decisions = []
+    t = 0
+    for phase, n in (("breach", 6), ("healthy", 4), ("breach", 6)):
+        for _ in range(n):
+            t += 1000
+            s = _breach(t) if phase == "breach" else _healthy(t)
+            d = p.decide(s)
+            if d is not None:
+                decisions.append(d)
+    assert [d.action for d in decisions] == ["scale-up", "scale-up"]
+    assert [d.t_ms for d in decisions] == [3000, 13000]
+    assert all(d.reason == "serving-p99" for d in decisions)
+    assert all(d.breach_streak == 3 for d in decisions)
+
+
+def test_alternating_flap_never_reaches_a_verdict():
+    """A signal oscillating every tick never builds a streak: zero
+    decisions over any horizon — the hysteresis window IS the flap
+    filter, no budget even gets consumed."""
+    p = _policy(scale_up_after=2, scale_down_after=2)
+    for i in range(1, 101):
+        s = _breach(i * 1000) if i % 2 else \
+            Signals(t_ms=i * 1000, serving_p99_ms=20.0,
+                    occupancy_fraction=0.1)
+        assert p.decide(s) is None
+    assert p.decisions_applied == 0
+    assert p.log == []
+
+
+def test_cooldown_suppresses_refire_until_elapsed():
+    """Sustained breach: after a decision the same direction re-fires no
+    earlier than cooldown_ms of LOGICAL time later, even though the
+    streak requirement is long since met again."""
+    p = _policy(scale_up_after=2, cooldown_ms=4000)
+    fired = [p.decide(_breach(t * 1000)) for t in range(1, 11)]
+    times = [d.t_ms for d in fired if d is not None]
+    # t2 (streak 2), then earliest eligible is t6 (6000-2000 >= 4000),
+    # then t10 — never the t4/t8 a pure-streak policy would emit
+    assert times == [2000, 6000, 10000]
+
+
+def test_budget_exhaustion_degrades_to_one_latched_warn_hold():
+    """Past max_decisions the policy emits EXACTLY ONE warn-hold
+    decision and then holds silently — it neither thrashes nor spams."""
+    p = _policy(scale_up_after=1, cooldown_ms=1000, max_decisions=2)
+    log = [p.decide(_breach(t * 1000)) for t in range(1, 21)]
+    fired = [d for d in log if d is not None]
+    assert [d.action for d in fired] == \
+        ["scale-up", "scale-up", "warn-hold"]
+    assert fired[-1].budget_left == 0
+    assert p.budget_exhausted
+    # the hold is latched: nothing more, ever
+    assert all(p.decide(_breach(t * 1000)) is None for t in range(21, 41))
+
+
+def test_scale_down_needs_sustained_idle_and_real_gauge():
+    """The down leg: occupancy below the idle fraction for
+    scale_down_after CONSECUTIVE ticks → one scale-down; an unavailable
+    gauge (-1, the CPU smoke) can never vote idle."""
+    p = _policy(scale_down_after=3)
+    idle = [p.decide(Signals(t_ms=t * 1000, occupancy_fraction=0.1))
+            for t in range(1, 5)]
+    fired = [d for d in idle if d is not None]
+    assert [d.action for d in fired] == ["scale-down"]
+    assert fired[0].reason == "idle-occupancy"
+    assert fired[0].idle_streak == 3
+
+    p2 = _policy(scale_down_after=2)
+    assert all(p2.decide(Signals(t_ms=t * 1000, occupancy_fraction=-1.0))
+               is None for t in range(1, 20))
+
+
+def test_breach_priority_serving_over_stragglers_over_step():
+    """Reason ranking when several legs breach at once: the
+    user-visible serving SLO wins, then straggler pressure, then the
+    step-time SLO."""
+    p = _policy(scale_up_after=1)
+    d = p.decide(Signals(t_ms=1000, serving_p99_ms=120.0,
+                         straggler_pressure=3, step_slo_breached=True))
+    assert d.reason == "serving-p99"
+    p = _policy(scale_up_after=1)
+    d = p.decide(Signals(t_ms=1000, straggler_pressure=3,
+                         step_slo_breached=True))
+    assert d.reason == "straggler-pressure"
+    p = _policy(scale_up_after=1)
+    d = p.decide(Signals(t_ms=1000, step_slo_breached=True))
+    assert d.reason == "step-slo"
+
+
+# -- bounded acquisition (the allocation tie-in) -----------------------------
+
+def test_acquire_devices_returns_count_when_capacity_arrives():
+    """The poll loop sees capacity appear mid-wait and returns the
+    available count before the deadline."""
+    calls = []
+
+    def avail():
+        calls.append(1)
+        return 8 if len(calls) >= 3 else 4
+
+    assert acquire_devices(5, timeout_s=5.0, poll_interval_s=0.001,
+                           available_fn=avail) == 8
+
+
+def test_acquire_devices_deadline_expiry_returns_none():
+    start = time.monotonic()
+    assert acquire_devices(99, timeout_s=0.05, poll_interval_s=0.005,
+                           available_fn=lambda: 4) is None
+    assert time.monotonic() - start < 2.0   # bounded, not wedged
+
+
+def test_acquire_devices_cancel_event_aborts_the_wait():
+    cancel = threading.Event()
+    cancel.set()
+    assert acquire_devices(99, timeout_s=30.0, poll_interval_s=0.01,
+                           available_fn=lambda: 4, cancel=cancel) is None
+
+
+# -- the autoscaler runtime --------------------------------------------------
+
+class _Det:
+    """Stub skew detector with the snapshot API the autoscaler samples."""
+
+    def __init__(self):
+        self.pressure = 0
+        self.step = False
+
+    def straggler_pressure(self, groups=None):
+        return self.pressure
+
+    def slo_breaches(self, group=None):
+        return [("collectives.step", "prog")] if self.step else []
+
+
+def _autoscaler(policy=None, **kw):
+    kw.setdefault("channel", CapacityChannel())
+    kw.setdefault("detector", _Det())
+    kw.setdefault("used_fn", lambda: 4)
+    kw.setdefault("acquire", lambda n, t, cancel=None: 8)
+    kw.setdefault("occupancy_fn", lambda: -1.0)
+    return Autoscaler(policy or _policy(scale_up_after=2,
+                                        cooldown_ms=2000), **kw)
+
+
+def test_tick_scale_up_acquires_then_announces():
+    chan = CapacityChannel()
+    det = _Det()
+    bus = ListenerBus()
+    listener = AppStatusListener()
+    bus.add_listener(listener)          # unstarted bus: synchronous
+    auto = _autoscaler(channel=chan, detector=det, bus=bus)
+    det.pressure = 2
+    assert auto.tick(now_ms=1000) is None          # streak 1
+    d = auto.tick(now_ms=2000)                     # streak 2 -> decide
+    assert d is not None and d.action == "scale-up"
+    ev = chan.take()
+    assert ev is not None and ev.master == "local-mesh[8]"
+    rows = listener.store.autoscale_events()
+    assert [r["kind"] for r in rows] == ["capacity", "decision"]
+    assert rows[0]["ok"] is True and rows[0]["nDevices"] == 8
+    assert rows[1]["outcome"] == "announced"
+
+
+def test_acquire_deadline_expiry_is_a_clean_noop_and_loop_resumes():
+    """Satellite 4's expiry leg: acquire returns None -> no channel
+    event, a CapacityAcquired(ok=False) records the attempt, and the
+    loop keeps ticking — the NEXT eligible decision (post-cooldown)
+    proceeds normally."""
+    chan = CapacityChannel()
+    det = _Det()
+    bus = ListenerBus()
+    listener = AppStatusListener()
+    bus.add_listener(listener)
+    attempts = []                       # first acquire expires, rest ok
+
+    def flaky_acquire(n, t, cancel=None):
+        attempts.append(n)
+        return None if len(attempts) == 1 else 8
+
+    auto = _autoscaler(channel=chan, detector=det, bus=bus,
+                       acquire=flaky_acquire)
+    det.pressure = 1
+    for t in range(1, 4):
+        auto.tick(now_ms=t * 1000)      # decision #1 at t2: expiry
+    assert len(chan) == 0               # no half-applied capacity event
+    for t in range(4, 6):
+        auto.tick(now_ms=t * 1000)      # decision #2 at t4 (cooldown
+    assert len(chan) == 1               # elapsed): announced normally
+    caps = [r for r in listener.store.autoscale_events()
+            if r["kind"] == "capacity"]
+    assert [c["ok"] for c in caps] == [False, True]
+    outs = [r["outcome"] for r in listener.store.autoscale_events()
+            if r["kind"] == "decision"]
+    assert outs == ["acquire-timeout", "announced"]
+
+
+def test_warn_hold_posts_event_with_outcome():
+    bus = ListenerBus()
+    listener = AppStatusListener()
+    bus.add_listener(listener)
+    det = _Det()
+    auto = _autoscaler(policy=_policy(scale_up_after=1, cooldown_ms=1000,
+                                      max_decisions=1),
+                       detector=det, bus=bus)
+    det.pressure = 1
+    for t in range(1, 6):
+        auto.tick(now_ms=t * 1000)
+    outs = [r["outcome"] for r in listener.store.autoscale_events()
+            if r["kind"] == "decision"]
+    assert outs == ["announced", "warn-hold"]
+
+
+def test_stop_latch_blocks_ticks_and_restart():
+    chan = CapacityChannel()
+    det = _Det()
+    auto = _autoscaler(channel=chan, detector=det)
+    det.pressure = 1
+    auto.stop()
+    auto.stop()                          # idempotent
+    assert auto.tick(now_ms=1000) is None
+    assert auto.tick(now_ms=2000) is None
+    assert len(chan) == 0
+    with pytest.raises(RuntimeError, match="stopped"):
+        auto.start()
+
+
+def test_stop_between_decide_and_announce_never_lands_on_supervisor():
+    """The JX022 race, pinned deterministically: stop() lands while the
+    decision is mid-apply (inside the acquire wait) — the announce path
+    re-checks the latch under the lock and the decision dies there, so
+    a stopped supervisor NEVER receives it."""
+    chan = CapacityChannel()
+    det = _Det()
+    holder = {}
+
+    def acquire_then_stopped(n, t, cancel=None):
+        holder["auto"].stop()            # shutdown interleaves mid-apply
+        return 8                         # capacity even arrived — too late
+
+    auto = _autoscaler(channel=chan, detector=det,
+                       acquire=acquire_then_stopped)
+    holder["auto"] = auto
+    det.pressure = 1
+    auto.tick(now_ms=1000)
+    d = auto.tick(now_ms=2000)           # decides, then hits the latch
+    assert d is not None and d.action == "scale-up"
+    assert len(chan) == 0                # the decision did NOT land
+
+
+def test_started_loop_ticks_and_stop_joins():
+    chan = CapacityChannel()
+    det = _Det()
+    det.pressure = 1
+    auto = _autoscaler(policy=_policy(scale_up_after=1, cooldown_ms=0),
+                       channel=chan, detector=det, interval_s=0.01)
+    auto.start()
+    deadline = time.monotonic() + 5.0
+    while len(chan) == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    auto.stop()
+    assert len(chan) > 0
+    assert auto._thread is None
+
+
+# -- the capacity channel's concurrent-producer contract (satellite 3) -------
+
+def test_channel_concurrent_producers_fifo_non_coalescing():
+    """N producers (autoscaler thread, SIGTERM handler, API callers)
+    announcing simultaneously: every event arrives (non-coalescing) and
+    each producer's own sequence stays FIFO."""
+    chan = CapacityChannel()
+    n_producers, per = 8, 50
+    start = threading.Barrier(n_producers)
+
+    def produce(pid):
+        start.wait()
+        for i in range(per):
+            chan.announce(CapacityEvent(master=f"m{pid}-{i}",
+                                        reason=f"p{pid}"))
+
+    threads = [threading.Thread(target=produce, args=(pid,))
+               for pid in range(n_producers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(chan) == n_producers * per
+    seen = {pid: [] for pid in range(n_producers)}
+    while True:
+        ev = chan.take()
+        if ev is None:
+            break
+        pid, i = ev.master[1:].split("-")
+        seen[int(pid)].append(int(i))
+    for pid in range(n_producers):
+        assert seen[pid] == list(range(per)), \
+            f"producer {pid} order not FIFO"
+
+
+def test_channel_reentrant_announce_does_not_deadlock():
+    """The SIGTERM-handler hazard: a signal handler runs on the MAIN
+    thread between bytecodes, so its announce() can re-enter the lock
+    an in-flight announce on the same thread already holds. With the
+    RLock this completes; with a plain Lock it deadlocks the process at
+    the moment it must drain. Run in a worker and join with a timeout so
+    a regression fails fast instead of hanging the suite."""
+    chan = CapacityChannel()
+    done = threading.Event()
+
+    def handler_during_announce():
+        with chan._lock:                 # the in-flight announce's hold
+            chan.announce(CapacityEvent(master="preempt",
+                                        reason="SIGTERM"))
+        done.set()
+
+    t = threading.Thread(target=handler_during_announce, daemon=True)
+    t.start()
+    assert done.wait(5.0), \
+        "reentrant announce deadlocked — CapacityChannel lock must be " \
+        "reentrant for signal-handler producers"
+    assert len(chan) == 1
+
+
+# -- event plumbing: store, route, journal round-trip (satellite 2) ----------
+
+def _feed_autoscale(post):
+    post(AutoscaleDecision(seq=1, action="scale-up", direction="up",
+                           reason="serving-p99", outcome="announced",
+                           breach_streak=3))
+    post(CapacityAcquired(master="local-mesh[8]", n_devices=8,
+                          waited_ms=12.5, ok=True, reason="serving-p99"))
+    post(AutoscaleDecision(seq=2, action="warn-hold", direction="up",
+                           reason="serving-p99", outcome="warn-hold",
+                           breach_streak=4))
+
+
+def test_autoscale_events_fold_into_store_and_route():
+    listener = AppStatusListener()
+    _feed_autoscale(listener)
+    rows = api_v1(listener.store, "autoscale")
+    assert [r["kind"] for r in rows] == ["decision", "capacity",
+                                        "decision"]
+    assert rows[0]["action"] == "scale-up"
+    assert rows[0]["breachStreak"] == 3
+    assert rows[1]["master"] == "local-mesh[8]"
+    assert rows[1]["waitedMs"] == 12.5
+    assert rows[2]["outcome"] == "warn-hold"
+
+
+def test_autoscale_events_journal_replay_round_trip(tmp_path):
+    """History-server parity: the journal replay rebuilds the same
+    autoscale rows the live bus produced."""
+    path = tmp_path / "app-asc.jsonl"
+    journal = EventJournal(str(path))
+    bus = ListenerBus()
+    live = AppStatusListener()
+    bus.add_listener(journal)
+    bus.add_listener(live)
+    _feed_autoscale(bus.post)            # unstarted bus: synchronous
+    journal.close()
+
+    store = HistoryProvider(str(tmp_path)).load("app-asc")
+    assert store.autoscale_events() == live.store.autoscale_events()
+    assert len(store.autoscale_events()) == 3
+
+
+def test_autoscale_store_is_bounded():
+    listener = AppStatusListener()
+    listener.store.max_autoscale_events = 10
+    for i in range(50):
+        listener(AutoscaleDecision(seq=i, action="scale-up",
+                                   outcome="announced"))
+    rows = listener.store.autoscale_events()
+    assert len(rows) == 10
+    assert rows[-1]["seq"] == 49         # newest kept, oldest dropped
+
+
+# -- simulation determinism (acceptance) -------------------------------------
+
+def _fixture_policy():
+    # pinned to scripts/autoscale_sim.py golden_policy(); the golden
+    # bytes fail both if either drifts alone
+    return AutoscalePolicy(target_p99_ms=50.0, scale_up_after=3,
+                           scale_down_after=4, cooldown_ms=5000,
+                           max_decisions=3, seed=17)
+
+
+def test_simulation_replay_is_byte_identical():
+    trace = os.path.join(FIXTURES, "trace.jsonl")
+    first = replay(trace, policy=_fixture_policy())
+    second = replay(trace, policy=_fixture_policy())
+    assert "\n".join(first) == "\n".join(second)
+    assert len(first) > 1                # header + decisions
+
+
+def test_simulation_matches_committed_golden(tmp_path):
+    """The in-suite twin of `make autoscale-sim`: replaying the
+    committed trace must reproduce the committed golden BYTES."""
+    trace = os.path.join(FIXTURES, "trace.jsonl")
+    golden = os.path.join(FIXTURES, "golden_decisions.jsonl")
+    lines = replay(trace, policy=_fixture_policy())
+    out = tmp_path / "got.jsonl"
+    write_decision_log(lines, str(out))
+    with open(golden, "rb") as fh:
+        want = fh.read()
+    with open(out, "rb") as fh:
+        got = fh.read()
+    assert got == want, "decision log drifted from committed golden " \
+        "(scripts/autoscale_sim.py --update if intended)"
+
+
+def test_simulator_tolerates_torn_and_metadata_lines():
+    sim = PolicySimulator(_policy(scale_up_after=1))
+    out = sim.run([
+        canonical({"trace": "autoscale.signals", "version": 1}),
+        "",
+        canonical(_breach(1000).to_json()),
+        '{"t_ms": 2000, "serving_p99_',     # torn tail
+    ])
+    assert len(out) == 2                    # header + the one decision
+    assert json.loads(out[1])["action"] == "scale-up"
+
+
+def test_live_recorded_trace_replays_to_the_same_decisions(tmp_path):
+    """The flight-recorder contract end to end: an autoscaler recording
+    its own signal trace produces a file whose REPLAY through a fresh
+    policy (same knobs) reproduces the live decision log byte-for-byte —
+    recorded incidents are debuggable offline."""
+    record = tmp_path / "signals.jsonl"
+    det = _Det()
+    live_policy = _policy(scale_up_after=2, cooldown_ms=2000, seed=3)
+    auto = _autoscaler(policy=live_policy, detector=det,
+                       record_path=str(record))
+    det.pressure = 1
+    for t in range(1, 8):
+        if t == 5:
+            det.pressure = 0             # mid-run recovery, recorded too
+        auto.tick(now_ms=t * 1000)
+    auto.stop()
+
+    fresh = _policy(scale_up_after=2, cooldown_ms=2000, seed=3)
+    with open(record, encoding="utf-8") as fh:
+        PolicySimulator(fresh).run(fh)
+    live = [canonical(d.to_json()) for d in live_policy.log]
+    replayed = [canonical(d.to_json()) for d in fresh.log]
+    assert live and live == replayed

@@ -924,6 +924,150 @@ def test_elastic_max_reshapes_budget_exhaustion(ctx, tmp_path):
         ctx.rebuild_mesh("local-mesh[8]")
 
 
+# -- the autoscale control plane (ISSUE 17): sensors -> policy -> actuator ------
+
+def test_autoscale_closed_loop_scales_up_on_slo_breach(ctx, tmp_path):
+    """THE ISSUE-17 acceptance e2e, fully closed loop: an injected
+    step-SLO breach latches in the skew detector, the autoscaler (ticked
+    deterministically at every safe step boundary with LOGICAL time)
+    accumulates the hysteresis streak, decides scale-up, ACQUIRES the
+    platform's 8 visible devices within the bounded deadline, announces
+    on the capacity channel — and the supervisor reshapes 4 -> 8 at that
+    same boundary with zero checkpoint restores and rtol<=1e-5 parity.
+    The breach then PERSISTS: cooldown bounds the re-decide rate, the
+    second decision's acquire (wanting >8 devices) expires to a graceful
+    no-op, the third attempt hits the decision budget and degrades to
+    ONE latched warn-hold — so the whole flapping run costs 1 reshape
+    against a max_reshapes=4 budget that is never threatened."""
+    from cycloneml_tpu.elastic import capacity as ecap
+    from cycloneml_tpu.elastic.autoscale import Autoscaler
+    from cycloneml_tpu.elastic.policy import AutoscalePolicy
+    from cycloneml_tpu.observe.skew import SkewDetector
+
+    ctx.rebuild_mesh("local-mesh[4]")
+    make_loss, x0 = _elastic_problem(ctx, seed=7)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(), x0)
+
+    chan = ecap.channel()
+    chan.clear()
+    det = SkewDetector(slo_s={"collectives.step": 0.05}, min_samples=2)
+    policy = AutoscalePolicy(scale_up_after=2, cooldown_ms=3000,
+                             max_decisions=2, seed=7)
+    auto = Autoscaler(policy, channel=chan, detector=det,
+                      used_fn=lambda: ctx.mesh_runtime.n_devices,
+                      acquire_timeout_s=0.05)
+    sup = MeshSupervisor(ctx, on_reshard=lambda rt: make_loss(rt),
+                         capacity=chan, max_reshapes=4)
+
+    def _drive(point, invocation, **info):
+        # the sensor leg: healthy step times for 2 boundaries, then a
+        # sustained breach; the SLO latch holds while samples stay over
+        # target, so the policy streak measures real persistence
+        det.observe("collectives.step", "prog",
+                    0.2 if invocation >= 3 else 0.001)
+        auto.tick(now_ms=invocation * 1000)
+
+    sched = FaultSchedule(seed=7)
+    sched.window("elastic.capacity", 1, 99, _drive)
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=5,
+                supervisor=sup, backoff_base_s=0.001, seed=7)
+        # the policy's whole life, pinned: breach at t3/t4 -> scale-up
+        # (applied, 4->8); persisting breach re-decides after cooldown
+        # -> scale-up whose acquire expires (no 9th device exists);
+        # budget exhausted -> one warn-hold; then silence
+        assert [d.action for d in policy.log] == \
+            ["scale-up", "scale-up", "warn-hold"]
+        assert [d.t_ms for d in policy.log] == [4000, 7000, 10000]
+        assert policy.decisions_applied == 2
+        assert sup.reshapes == 1           # one real mesh change
+        assert sup.rebuilds == 0           # planned, not a failure
+        assert inj.counts.get("checkpoint.restore", 0) == 0
+        assert ctx.mesh_runtime.n_devices == 8
+        assert len(chan) == 0              # nothing left un-consumed
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5,
+                                   atol=1e-8)
+        assert final.iteration == baseline.iteration
+    finally:
+        auto.stop()
+        chan.clear()
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
+def test_autoscale_decide_faults_drop_duplicate_delay(ctx, tmp_path):
+    """The controller-misbehaving leg: the seeded `autoscale.decide`
+    point drops the first decision (the loop survives and re-decides
+    after cooldown), DUPLICATES the second (two announcements -> a real
+    4->8 reshape plus a same-shape reshape, both absorbed), and delays
+    the third (which then gracefully times out its acquire) — training
+    still lands on baseline parity with zero checkpoint restores, and
+    straggler pressure (not SLO this time) is the breach signal."""
+    from cycloneml_tpu.elastic import capacity as ecap
+    from cycloneml_tpu.elastic.autoscale import (Autoscaler, drop_decision,
+                                                 duplicate_decision)
+    from cycloneml_tpu.elastic.policy import AutoscalePolicy
+    from cycloneml_tpu.observe.skew import SkewDetector
+
+    ctx.rebuild_mesh("local-mesh[4]")
+    make_loss, x0 = _elastic_problem(ctx, seed=9)
+    baseline = LBFGS(max_iter=30, tol=1e-9).minimize(make_loss(), x0)
+
+    chan = ecap.channel()
+    chan.clear()
+    det = SkewDetector(min_samples=2, window=8)
+    policy = AutoscalePolicy(scale_up_after=2, cooldown_ms=2000,
+                             max_decisions=3, seed=9)
+    auto = Autoscaler(policy, channel=chan, detector=det,
+                      used_fn=lambda: ctx.mesh_runtime.n_devices,
+                      acquire_timeout_s=0.05)
+    sup = MeshSupervisor(ctx, on_reshard=lambda rt: make_loss(rt),
+                         capacity=chan, max_reshapes=4)
+
+    def _drive(point, invocation, **info):
+        # three fit lanes, one persistently slow: the straggler verdict
+        # latches once medians exist (boundary 2) and holds — sustained
+        # training pressure, the tentpole's second signal leg
+        det.observe("fit.lane", "a", 0.01)
+        det.observe("fit.lane", "c", 0.01)
+        det.observe("fit.lane", "b", 0.2)
+        auto.tick(now_ms=invocation * 1000)
+
+    sched = FaultSchedule(seed=9)
+    sched.at("autoscale.decide", 1, drop_decision)
+    sched.at("autoscale.decide", 2, duplicate_decision)
+    sched.at("autoscale.decide", 3, None, delay_s=0.01)
+    sched.window("elastic.capacity", 1, 99, _drive)
+    try:
+        with FaultInjector(sched) as inj:
+            final = train_with_checkpoints(
+                LBFGS(max_iter=30, tol=1e-9), make_loss(), x0,
+                TrainingCheckpointer(str(tmp_path / "opt")), interval=5,
+                supervisor=sup, backoff_base_s=0.001, seed=9)
+        decide_log = [(p, n, f) for p, n, f in inj.log
+                      if p == "autoscale.decide"]
+        assert decide_log == [
+            ("autoscale.decide", 1, "drop_decision"),
+            ("autoscale.decide", 2, "duplicate_decision"),
+            ("autoscale.decide", 3, "SlowStep")]
+        # decision 1 dropped (no reshape), decision 2 doubled (4->8 then
+        # a same-shape reshape), decision 3 delayed then acquire-expired
+        assert [d.action for d in policy.log][:3] == \
+            ["scale-up", "scale-up", "scale-up"]
+        assert sup.reshapes == 2
+        assert sup.rebuilds == 0
+        assert inj.counts.get("checkpoint.restore", 0) == 0
+        assert ctx.mesh_runtime.n_devices == 8
+        np.testing.assert_allclose(final.x, baseline.x, rtol=1e-5,
+                                   atol=1e-8)
+    finally:
+        auto.stop()
+        chan.clear()
+        ctx.rebuild_mesh("local-mesh[8]")
+
+
 # -- checkpoint save/restore entry points ---------------------------------------
 
 def test_save_entry_fault_leaves_prior_checkpoint_intact(tmp_path):
