@@ -3,7 +3,7 @@
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
 	bench bench-bytes bench-oocore bench-elastic serve-demo multihost \
-	autoscale-sim
+	autoscale-sim usage-demo
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -56,6 +56,12 @@ multihost:
 # small traced fit -> exported Chrome trace -> schema + profile validation
 obs-demo:
 	JAX_PLATFORMS=cpu python scripts/obs_demo.py
+
+# usage-attribution acceptance: two scoped jobs (a fit + a serving
+# storm), per-scope device-seconds/FLOPs/bytes must sum to the global
+# ledger within 1% and /api/v1/usage must serve both rows
+usage-demo:
+	JAX_PLATFORMS=cpu python scripts/usage_demo.py
 
 # one JSON line: e2e LR throughput + phases + the multi-class OvR
 # stacked-vs-serial comparison (ovr_stacked_speedup, models_per_compile)

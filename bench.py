@@ -464,6 +464,92 @@ def bench_trace_overhead(n: int | None = None, d: int | None = None,
     return out
 
 
+def bench_usage(n: int | None = None, d: int | None = None,
+                iters: int = 12):
+    """The ``usage`` BENCH block: the SAME warmed fit timed with usage
+    attribution off, enabled-but-unscoped, and enabled-with-a-scope.
+
+    Pins the attribution hot-path discipline as numbers: with the ledger
+    off the dispatch path pays ONE module-global read
+    (``off_overhead_pct`` vs the pre-change baseline is definitionally ~0
+    — they run identical code); ``unscoped_overhead_pct`` adds a
+    thread-local peek; ``scoped_overhead_pct`` is the full metering cost
+    (two clock reads + one locked ledger add per dispatch; the < 3% bar
+    matches the flight recorder's). Also cross-checks the ledger sum
+    invariant: the scoped run's per-scope rows must sum to the totals row
+    within 1% on every additive field."""
+    import statistics
+
+    from cycloneml_tpu import CycloneConf, CycloneContext
+    from cycloneml_tpu.dataset.random import generate_classification
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import attribution, flight, tracing
+
+    n = n or int(os.environ.get("BENCH_USAGE_N", 200_000))
+    d = d or int(os.environ.get("BENCH_USAGE_D", 128))
+    ctx = CycloneContext.get_or_create(
+        CycloneConf().set("cyclone.app.name", "bench"))
+    ds = generate_classification(ctx, n, d, seed=3)
+    lr = LogisticRegression(maxIter=iters, regParam=0.01, tol=0.0)
+    trials = max(3, int(os.environ.get("BENCH_TRIALS", 3)))
+
+    def timed(scope_name=None):
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            if scope_name is None:
+                lr.fit(ds)
+            else:
+                with attribution.scope(scope_name):
+                    lr.fit(ds)
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    # isolate the attribution cost: no tracer, no flight ring
+    tracing.disable()
+    flight.disable()
+    attribution.disable()
+    lr.fit(ds)          # warm compiles once; every mode replays
+    off_s = timed()
+    attribution.enable()
+    try:
+        unscoped_s = timed()
+        scoped_s = timed("bench-usage")
+        snap = attribution.active().snapshot()
+    finally:
+        attribution.disable()
+
+    # sum invariant: per-scope additive fields vs the totals row
+    totals = snap.pop(attribution.TOTALS)
+    sums_ok = True
+    for fld in ("deviceSeconds", "dispatches", "flops", "bytesAccessed",
+                "h2dBytes"):
+        want = totals.get(fld, 0)
+        got = sum(row.get(fld, 0) for row in snap.values())
+        if want and abs(got - want) / want > 0.01:
+            sums_ok = False
+            print(f"info: usage sum invariant VIOLATED on {fld}: "
+                  f"scopes sum {got} vs totals {want}", file=sys.stderr)
+
+    def pct(x):
+        return round((x / off_s - 1.0) * 100.0, 2) if off_s else None
+
+    out = {
+        "n": n, "d": d, "iters": iters, "trials": trials,
+        "off_s": round(off_s, 4),
+        "unscoped_s": round(unscoped_s, 4),
+        "scoped_s": round(scoped_s, 4),
+        "unscoped_overhead_pct": pct(unscoped_s),
+        "scoped_overhead_pct": pct(scoped_s),
+        "sum_invariant_ok": sums_ok,
+    }
+    print(f"info: usage attribution n={n} d={d}: off {off_s:.3f}s, "
+          f"unscoped {unscoped_s:.3f}s ({out['unscoped_overhead_pct']}%), "
+          f"scoped {scoped_s:.3f}s ({out['scoped_overhead_pct']}%), "
+          f"sums {'ok' if sums_ok else 'VIOLATED'}", file=sys.stderr)
+    return out
+
+
 def _serving_admission(d: int, budget_peaks: float = 4.0) -> dict:
     """Admission capacity under the quantized predict tier: the largest
     gang width whose single-row-bucket program peak fits a fixed HBM
@@ -808,6 +894,12 @@ def main() -> None:
             trace_overhead = bench_trace_overhead()
         except Exception as e:
             print(f"info: trace overhead bench failed: {e}", file=sys.stderr)
+    usage = None
+    if os.environ.get("BENCH_USAGE", "1") != "0":
+        try:
+            usage = bench_usage()
+        except Exception as e:
+            print(f"info: usage bench failed: {e}", file=sys.stderr)
     elastic = None
     if os.environ.get("BENCH_ELASTIC", "1") != "0":
         try:
@@ -870,6 +962,7 @@ def main() -> None:
             "ovr": ovr,
             "serving": serving,
             "trace_overhead": trace_overhead,
+            "usage": usage,
             "elastic": elastic,
         }))
     elif gemm_mops is not None:
@@ -883,6 +976,7 @@ def main() -> None:
             "ovr": ovr,
             "serving": serving,
             "trace_overhead": trace_overhead,
+            "usage": usage,
             "elastic": elastic,
         }))
     else:

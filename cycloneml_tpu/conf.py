@@ -923,6 +923,50 @@ SLO_SERVING_MS = (
     .float_conf(0.0)
 )
 
+USAGE_ENABLED = (
+    ConfigBuilder("cyclone.usage.enabled")
+    .doc("Per-job / per-tenant usage attribution (observe/attribution.py): "
+         "work dispatched inside attribution.scope(job, tenant=...) "
+         "charges device-seconds, FLOPs / bytes-accessed / HBM-peak "
+         "(joined from the observe.costs registry), host->device staging "
+         "bytes, serving requests / dispatch-seconds / sheds and "
+         "supervisor/autoscaler actions to a bounded process-global "
+         "UsageLedger. Periodic UsageReport events feed the status store "
+         "(/api/v1/usage, web UI, history replay), labeled Prometheus "
+         "gauges, and FitProfile.job_usage; per-host ledgers ride shipped "
+         "span batches so the TraceCollector merges them cross-host. Off "
+         "by default; the disabled cost at every instrumentation site is "
+         "one module-global read (the usage BENCH block pins it).")
+    .bool_conf(False)
+)
+
+USAGE_MAX_SCOPES = (
+    ConfigBuilder("cyclone.usage.maxScopes")
+    .doc("UsageLedger scope-row bound: past it the oldest scope folds "
+         "into the '(evicted)' row (sums still match the totals row) and "
+         "its labeled gauges unregister.")
+    .check_value(lambda v: v >= 2, "must be >= 2")
+    .int_conf(256)
+)
+
+USAGE_MAX_MODELS = (
+    ConfigBuilder("cyclone.usage.maxModels")
+    .doc("Per-scope serving model-table bound; overflow models share one "
+         "'(other)' bucket.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(64)
+)
+
+USAGE_REPORT_INTERVAL_MS = (
+    ConfigBuilder("cyclone.usage.reportIntervalMs")
+    .doc("UsageReport / TelemetryStatsUpdated posting period in "
+         "milliseconds. Reports carry CUMULATIVE snapshots, so the "
+         "status store folds them by replacement and a lost report "
+         "costs staleness, not data.")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(2000.0)
+)
+
 
 MULTIHOST_REPLICAS = (
     ConfigBuilder("cyclone.multihost.replicas")

@@ -296,6 +296,32 @@ class CycloneContext:
             self._skew_owner = True
         self.skew_detector = _skew.active()
 
+        # usage attribution (observe/attribution.py): the per-job /
+        # per-tenant metering ledger + its periodic UsageReport feed. The
+        # context only disables a ledger it installed itself (tests and
+        # bench enable programmatically). The reporter also carries the
+        # telemetry drop-counter rollup so the status store / REST / web
+        # UI see span loss without a scrape.
+        from cycloneml_tpu.conf import (USAGE_ENABLED,
+                                        USAGE_REPORT_INTERVAL_MS)
+        from cycloneml_tpu.observe import attribution as _attribution
+        self._usage_owner = False
+        if self.conf.get(USAGE_ENABLED) and _attribution.active() is None:
+            _attribution.enable(self.conf, registry=self.metrics.registry)
+            self._usage_owner = True
+        self._usage_reporter = None
+        if _attribution.active() is not None:
+            from cycloneml_tpu.conf import WORKER_ID as _WID
+            host = self.conf.get(_WID)
+            if not host:
+                proc_id = os.environ.get("CYCLONE_PROC_ID", "")
+                host = f"proc{proc_id}" if proc_id else ""
+            self._usage_reporter = _attribution.UsageReporter(
+                self.listener_bus,
+                interval_s=self.conf.get(USAGE_REPORT_INTERVAL_MS) / 1e3,
+                host=host, telemetry_fn=self._telemetry_stats)
+            self._usage_reporter.start()
+
         from cycloneml_tpu.conf import PLUGINS
         from cycloneml_tpu.plugin import load_plugins
         self._plugins = load_plugins(
@@ -361,6 +387,21 @@ class CycloneContext:
             mark = tracer.mark()  # rollup scans only this job's spans
             job_span.__enter__()
             sid = job_span.span_id
+        # usage attribution bracket: an un-scoped job gets an automatic
+        # "job-{id}" scope (a caller's explicit attribution.scope wins),
+        # and the scope row's delta across the fit lands on the profile
+        from cycloneml_tpu.observe import attribution as _attribution
+        led = _attribution.active()
+        job_scope = None
+        usage_key = ""
+        usage_before = None
+        if led is not None:
+            sc = _attribution.current_scope()
+            if sc is None:
+                job_scope = _attribution.scope(f"job-{jid}")
+                sc = job_scope.__enter__()
+            usage_key = sc.key
+            usage_before = led.row(usage_key)
         self.listener_bus.post(JobStart(job_id=jid, description=description,
                                         span_id=sid))
         self._job_stack.append(jid)
@@ -377,6 +418,8 @@ class CycloneContext:
             with self._job_cond:
                 self._active_jobs -= 1
                 self._job_cond.notify_all()
+            if job_scope is not None:
+                job_scope.__exit__(None, None, None)
             if job_span is not None:
                 job_span.__exit__(None, None, None)
             if job_span is not None and tracer.full:
@@ -387,6 +430,9 @@ class CycloneContext:
                     prof = tracer.profile_for(sid, since=mark)
                     prof.job_id = jid
                     prof.description = description
+                    if usage_before is not None:
+                        prof.job_usage = _attribution.usage_delta(
+                            usage_before, led.row(usage_key))
                     self.listener_bus.post(FitProfileCompleted(
                         job_id=jid, profile=prof.to_dict()))
                 except Exception:
@@ -432,6 +478,21 @@ class CycloneContext:
                 reg.histogram(f"step.{k}").update(float(v))
             except (TypeError, ValueError):
                 pass
+
+    def _telemetry_stats(self) -> Dict[str, Any]:
+        """Drop-counter rollup across this process's telemetry stack —
+        tracer ring overflow, span-shipper delivery loss, bus queue depth
+        — the ``TelemetryStatsUpdated`` payload the usage reporter posts.
+        A lossy pipeline must say so where the usage numbers are read."""
+        stats: Dict[str, Any] = {
+            "busQueued": int(self.listener_bus.metrics["queued"])}
+        tracer = _tracing.active()
+        if tracer is not None:
+            stats["spansDropped"] = int(tracer.spans_dropped)
+        shipper = getattr(self, "_shipper", None)
+        if shipper is not None:
+            stats["shipper"] = shipper.delivery_stats()
+        return stats
 
     @property
     def status_store(self):
@@ -529,11 +590,21 @@ class CycloneContext:
         """Serve the live status web UI (≈ SparkUI.scala:40 — jobs/steps/
         failures over the status store). Returns the server; ``.url`` is the
         address. Stopped automatically with the context."""
+        from cycloneml_tpu.observe import attribution as _attribution
         from cycloneml_tpu.util.webui import StatusWebUI
+
+        def _live_usage():
+            # live ledger beats the store's last periodic UsageReport;
+            # with attribution off the store (possibly replayed) serves
+            led = _attribution.active()
+            return led.snapshot() if led is not None \
+                else self.status_store.usage_rollup()
+
         if getattr(self, "_web_ui", None) is None:
             self._web_ui = StatusWebUI(
                 self.status_store, host, port,
-                storage_usage=self.storage.usage)
+                storage_usage=self.storage.usage,
+                usage=_live_usage, telemetry=self._telemetry_stats)
         return self._web_ui
 
     def start_heartbeat_server(self, host: str = "127.0.0.1", port: int = 0):
@@ -733,7 +804,16 @@ class CycloneContext:
             # final flush BEFORE any tracer teardown: the collector must
             # see every span this app recorded, including ApplicationEnd's
             self._shipper.stop(flush=True)
-            self._shipper = None
+        if getattr(self, "_usage_reporter", None) is not None:
+            # final UsageReport flush while the tracer/shipper still
+            # exist: the journal carries the complete ledger for replay
+            # and the last TelemetryStatsUpdated still sees span loss
+            try:
+                self._usage_reporter.stop()
+            except Exception:
+                logger.exception("usage reporter shutdown failed")
+            self._usage_reporter = None
+        self._shipper = None
         if getattr(self.mesh_runtime, "is_multihost", False):
             # barriered multihost teardown: sync every process before
             # disconnecting so no peer exits while another is
@@ -784,6 +864,9 @@ class CycloneContext:
                     except Exception:
                         logger.exception("trace export failed")
                 _tracing.disable()
+        if getattr(self, "_usage_owner", False):
+            from cycloneml_tpu.observe import attribution as _attribution
+            _attribution.disable()
         self.metrics.stop()
         self.listener_bus.stop()
         if self._journal is not None:

@@ -42,6 +42,7 @@ from typing import Callable, Iterable, Optional
 from cycloneml_tpu.elastic import capacity as _capacity
 from cycloneml_tpu.elastic.policy import AutoscalePolicy, Decision, Signals, \
     canonical
+from cycloneml_tpu.observe import attribution
 from cycloneml_tpu.parallel import allocation as _allocation
 from cycloneml_tpu.parallel import faults as _faults
 from cycloneml_tpu.util.events import AutoscaleDecision, CapacityAcquired
@@ -135,6 +136,9 @@ class Autoscaler:
         self._stopped = False
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # reshape actions bill the scope that OWNED the autoscaler at
+        # construction (the loop thread has no scope stack of its own)
+        self._scope = attribution.current_scope()
         self._record_lock = threading.Lock()
         self._record_fh = open(record_path, "a", encoding="utf-8") \
             if record_path else None
@@ -266,6 +270,7 @@ class Autoscaler:
                             "announced", decision.seq)
                 return "held"
             self._channel.announce(event)
+        attribution.charge(self._scope, autoscaleActions=1)
         return "announced"
 
     def _post(self, event) -> None:

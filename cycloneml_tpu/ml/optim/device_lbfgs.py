@@ -30,7 +30,7 @@ from typing import List, Optional
 import numpy as np
 
 from cycloneml_tpu.ml.optim.lbfgs import LBFGS, OptimState
-from cycloneml_tpu.observe import costs, tracing
+from cycloneml_tpu.observe import attribution, costs, tracing
 from cycloneml_tpu.parallel.collectives import BoundedProgramCache
 from cycloneml_tpu.util.logging import get_logger
 
@@ -377,29 +377,37 @@ class DeviceLBFGS(LBFGS):
                 if new_fresh is not None:
                     fresh = new_fresh
                     self.effective_chunk = chunk
-            with tracing.span("dispatch", "lbfgs.chunk") as dsp:
-                if fresh:
-                    with tracing.span("compile", "lbfgs.chunk"):
+            win = attribution.dispatch_window()
+            with win:
+                with tracing.span("dispatch", "lbfgs.chunk") as dsp:
+                    if fresh:
+                        with tracing.span("compile", "lbfgs.chunk"):
+                            (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d,
+                             evals_d, code_d, f0_d, g0_d) = prog(*args)
+                        fresh = False
+                    else:
                         (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d,
                          evals_d, code_d, f0_d, g0_d) = prog(*args)
-                    fresh = False
-                else:
-                    (coef_d, S_d, Y_d, k_d, f_d, g_d, losses_d, it_d,
-                     evals_d, code_d, f0_d, g0_d) = prog(*args)
-                with tracing.span("transfer", "lbfgs.readback") as tsp:
-                    f_h, losses, it, evals, code, k_h, f0_h = jax.device_get(
-                        (f_d, losses_d, it_d, evals_d, code_d, k_d, f0_d))
-                    tsp.annotate_bytes(
-                        (f_h, losses, it, evals, code, k_h, f0_h))
-            dsp.annotate(evals=int(evals))
-            # cost harvest only under a FULL tracer: the flight-recorder
-            # ring records spans and must not pay an AOT analyze
-            tr = tracing.full_active()
-            if tr is not None:
-                if pid is None:
+                    with tracing.span("transfer", "lbfgs.readback") as tsp:
+                        f_h, losses, it, evals, code, k_h, f0_h = \
+                            jax.device_get(
+                                (f_d, losses_d, it_d, evals_d, code_d, k_d,
+                                 f0_d))
+                        tsp.annotate_bytes(
+                            (f_h, losses, it, evals, code, k_h, f0_h))
+                dsp.annotate(evals=int(evals))
+                # cost harvest only under a FULL tracer OR a live
+                # attribution window: the flight-recorder ring records
+                # spans and must not pay an AOT analyze, but a scoped fit
+                # buys the FLOPs/bytes join (shared registry, one harvest
+                # per program either way)
+                tr = tracing.full_active()
+                if (tr is not None or win.live) and pid is None:
                     pid = costs.ensure("lbfgs.chunk", key, prog, args)
-                dsp.annotate(program=pid)
-                costs.note_execution(tr, pid)
+                win.annotate_program(pid)
+                if tr is not None:
+                    dsp.annotate(program=pid)
+                    costs.note_execution(tr, pid)
             coef = coef_d
             first = False
             f.n_evals += int(evals)
@@ -716,31 +724,37 @@ class StackedDeviceLBFGS:
                 if new_fresh is not None:
                     fresh = new_fresh
                     self.effective_chunk = chunk
-            with tracing.span("dispatch", "lbfgs.stacked_chunk",
-                              n_models=K) as dsp:
-                if fresh:
-                    with tracing.span("compile", "lbfgs.stacked_chunk"):
+            win = attribution.dispatch_window()
+            with win:
+                with tracing.span("dispatch", "lbfgs.stacked_chunk",
+                                  n_models=K) as dsp:
+                    if fresh:
+                        with tracing.span("compile", "lbfgs.stacked_chunk"):
+                            (coef, S_d, Y_d, k_d, f_d, g_d, losses_d, step_d,
+                             it_d, ev_d, evg_d, code_d, f0_d) = prog(*args)
+                        fresh = False
+                    else:
                         (coef, S_d, Y_d, k_d, f_d, g_d, losses_d, step_d,
                          it_d, ev_d, evg_d, code_d, f0_d) = prog(*args)
-                    fresh = False
-                else:
-                    (coef, S_d, Y_d, k_d, f_d, g_d, losses_d, step_d,
-                     it_d, ev_d, evg_d, code_d, f0_d) = prog(*args)
-                with tracing.span("transfer", "lbfgs.readback") as tsp:
-                    (losses, steps, iters, ev_pm, ev_g, code_h,
-                     f0_h) = jax.device_get(
-                        (losses_d, step_d, it_d, ev_d, evg_d, code_d, f0_d))
-                    tsp.annotate_bytes(
-                        (losses, steps, iters, ev_pm, ev_g, code_h, f0_h))
-            dsp.annotate(evals=int(ev_g))
-            # full tracer only: no AOT analyze under the flight ring
-            tr = tracing.full_active()
-            if tr is not None:
-                if pid is None:
+                    with tracing.span("transfer", "lbfgs.readback") as tsp:
+                        (losses, steps, iters, ev_pm, ev_g, code_h,
+                         f0_h) = jax.device_get(
+                            (losses_d, step_d, it_d, ev_d, evg_d, code_d,
+                             f0_d))
+                        tsp.annotate_bytes(
+                            (losses, steps, iters, ev_pm, ev_g, code_h, f0_h))
+                dsp.annotate(evals=int(ev_g))
+                # full tracer only: no AOT analyze under the flight ring —
+                # but a live attribution window buys the same one-time
+                # harvest for the scope's FLOPs/bytes join
+                tr = tracing.full_active()
+                if (tr is not None or win.live) and pid is None:
                     pid = costs.ensure("lbfgs.stacked_chunk", key, prog,
                                        args)
-                dsp.annotate(program=pid)
-                costs.note_execution(tr, pid)
+                win.annotate_program(pid)
+                if tr is not None:
+                    dsp.annotate(program=pid)
+                    costs.note_execution(tr, pid)
             f.n_evals += int(ev_g)
             f.n_dispatches += 1
             if need_init:

@@ -16,7 +16,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from cycloneml_tpu.dataset.dataset import InstanceDataset
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import attribution, tracing
 from cycloneml_tpu.parallel import collectives
 
 
@@ -159,26 +159,30 @@ class DistributedLossFunction:
                 cdt.type(self.weight_sum))
         pid = None
         # full tracer only: the flight-recorder ring must not trigger the
-        # AOT cost analyze / budget check
+        # AOT cost analyze / budget check. A live attribution window buys
+        # the harvest too (scoped fits join FLOPs/bytes on the program id).
+        win = attribution.dispatch_window()
         tr = tracing.full_active()
-        if tr is not None:
+        if tr is not None or win.live:
             # cost harvest BEFORE the dispatch (registry-cached once per
             # program identity): a raise-mode budget guard must fire before
             # the oversized program executes, and the AOT analyze must not
             # land inside the dispatch/compile spans
             from cycloneml_tpu.observe import costs
             pid = costs.ensure("lbfgs.line_search", key, fn, args)
-            if fresh:
+            if fresh and tr is not None:
                 costs.check_budget(pid)
-        with tracing.span("dispatch", "lbfgs.line_search") as dsp:
-            if fresh:
-                with tracing.span("compile", "lbfgs.line_search"):
+        win.annotate_program(pid)
+        with win:
+            with tracing.span("dispatch", "lbfgs.line_search") as dsp:
+                if fresh:
+                    with tracing.span("compile", "lbfgs.line_search"):
+                        res = fn(*args)
+                else:
                     res = fn(*args)
-            else:
-                res = fn(*args)
-            with tracing.span("transfer", "line_search.readback") as tsp:
-                out = jax.device_get(res)
-                tsp.annotate_bytes(out)
+                with tracing.span("transfer", "line_search.readback") as tsp:
+                    out = jax.device_get(res)
+                    tsp.annotate_bytes(out)
         alpha, v, g, evals = out
         dsp.annotate(evals=int(evals))
         if tr is not None:

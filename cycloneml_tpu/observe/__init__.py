@@ -6,7 +6,8 @@ touches jax, so the analysis tooling and pure-host paths can import it
 freely.
 """
 
-from cycloneml_tpu.observe import costs, flight, skew, tracing
+from cycloneml_tpu.observe import attribution, costs, flight, skew, tracing
+from cycloneml_tpu.observe.attribution import Scope, UsageLedger, UsageReporter
 from cycloneml_tpu.observe.costs import ProgramCost
 from cycloneml_tpu.observe.export import (chrome_trace, export_chrome_trace,
                                           merged_chrome_trace, process_lanes,
@@ -17,6 +18,7 @@ from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
                                            full_active, instant, span)
 
 __all__ = [
+    "attribution", "Scope", "UsageLedger", "UsageReporter",
     "tracing", "costs", "flight", "skew", "Span", "Tracer", "FitProfile",
     "ProgramCost", "enable", "disable", "active", "full_active", "span",
     "instant", "current_span_id", "chrome_trace", "export_chrome_trace",

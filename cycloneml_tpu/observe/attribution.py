@@ -1,0 +1,533 @@
+"""Usage attribution: per-job / per-tenant metering over the telemetry waists.
+
+The rest of the observability plane answers "what is the mesh doing";
+this module answers "who is it doing it for". A :func:`scope` pushes a
+``(job, tenant)`` attribution context onto a thread-local stack; every
+existing narrow waist — ``_instrument_dispatch``, the chunked
+L-BFGS/line-search loops, oocore staging, serving lanes, the supervisor
+and autoscaler — charges the active scope without new instrumentation
+sites. Rollups accumulate in one process-global :class:`UsageLedger`
+(bounded, every ``_rows`` access under ``_lock`` — the JX011
+discipline), ride shipped span batches cross-host so the master's
+``TraceCollector`` can merge per-host ledgers, and surface as periodic
+``UsageReport`` events (status store / ``/api/v1/usage`` / web UI /
+history replay), labeled Prometheus gauges, and ``FitProfile.job_usage``.
+
+Cost discipline matches the flight recorder: attribution off means every
+site pays ONE module-global read (:data:`_ledger` is ``None`` →
+:data:`NOOP_WINDOW`); an active ledger with no scope on the calling
+thread pays that read plus one thread-local peek. The ``usage`` BENCH
+block pins both numbers. Cross-thread work (oocore staging threads,
+serving batcher workers, the autoscaler daemon) CAPTURES the
+constructing/submitting thread's scope and charges it explicitly — the
+same retroactive idiom ``Tracer.record_span`` uses for serving lanes.
+
+FLOPs / bytes-accessed / HBM-peak are not measured twice: the window
+joins the ``program`` identity its site already computes onto the PR-5
+``observe.costs`` registry (one harvest per program, shared with
+tracing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from cycloneml_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: ledger row key charged when work carries no scope (explicit charges
+#: from un-scoped control-plane actions; the dispatch hot path skips
+#: charging entirely instead — see :func:`dispatch_window`)
+UNSCOPED = "(unscoped)"
+#: row key absorbing evicted scopes, so per-scope sums keep matching the
+#: global totals even after the bounded ledger rotates
+EVICTED = "(evicted)"
+#: snapshot key of the process-global totals row
+TOTALS = "_totals"
+
+#: fields that merge by max, not sum (a peak is a high-water mark)
+_MAX_FIELDS = frozenset(("hbmPeakBytes",))
+
+#: per-scope gauge surface: ledger fields exported as labeled Prometheus
+#: gauges when a registry is attached (bounded by the ledger bound)
+_GAUGE_FIELDS = ("deviceSeconds", "flops", "bytesAccessed", "hbmPeakBytes",
+                 "h2dBytes", "requests", "sheds")
+
+
+def _zero_row(key: str, tenant: str) -> Dict[str, Any]:
+    return {"scope": key, "tenant": tenant,
+            "deviceSeconds": 0.0, "dispatches": 0,
+            "flops": 0.0, "bytesAccessed": 0.0, "hbmPeakBytes": 0,
+            "h2dBytes": 0, "requests": 0, "rows": 0,
+            "servingSeconds": 0.0, "sheds": 0,
+            "reshapes": 0, "recoveries": 0, "autoscaleActions": 0,
+            "models": {}}
+
+
+class Scope:
+    """Immutable attribution identity: a job id plus an optional tenant.
+
+    The ledger key is ``tenant/job`` (or bare ``job``), so two tenants'
+    identically-named jobs stay separate rows.
+    """
+
+    __slots__ = ("job", "tenant", "key")
+
+    def __init__(self, job: Any, tenant: str = ""):
+        self.job = str(job)
+        self.tenant = str(tenant or "")
+        self.key = f"{self.tenant}/{self.job}" if self.tenant else self.job
+
+    def __repr__(self) -> str:
+        return f"Scope({self.key!r})"
+
+
+class _ScopeStack(threading.local):
+    def __init__(self):
+        self.stack: List[Scope] = []
+
+
+_scopes = _ScopeStack()
+
+
+def current_scope() -> Optional[Scope]:
+    """Innermost scope on the calling thread, or None."""
+    stack = _scopes.stack
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def scope(job: Any, tenant: str = ""):
+    """Attribute everything dispatched inside the block to ``job``
+    (optionally under ``tenant``). Nests; the innermost scope wins.
+    Cheap enough to use unconditionally — pushing while attribution is
+    disabled costs a list append."""
+    sc = Scope(job, tenant)
+    _scopes.stack.append(sc)
+    try:
+        yield sc
+    finally:
+        _scopes.stack.pop()
+
+
+@contextlib.contextmanager
+def adopt(sc: Optional[Scope]):
+    """Re-enter a captured scope on another thread (the cross-thread
+    leg: capture ``current_scope()`` where work is SUBMITTED, adopt it
+    where work RUNS). ``None`` adopts nothing and charges fall through
+    to whatever the running thread has."""
+    if sc is None:
+        yield None
+        return
+    _scopes.stack.append(sc)
+    try:
+        yield sc
+    finally:
+        _scopes.stack.pop()
+
+
+class UsageLedger:
+    """Bounded per-scope usage rollups plus one global totals row.
+
+    Lock discipline (JX011): every ``_rows`` / ``_totals`` access holds
+    ``_lock``; snapshots are deep copies so readers never alias live
+    rows. Bounded like the status store's event lists: past
+    ``max_scopes`` the oldest scope row folds into :data:`EVICTED`
+    (additively — per-scope sums still match the totals row) and its
+    gauges unregister. Per-scope ``models`` sub-tables are bounded by
+    ``max_models`` with an ``(other)`` overflow bucket.
+    """
+
+    def __init__(self, max_scopes: int = 256, max_models: int = 64,
+                 registry=None):
+        self._lock = threading.Lock()
+        self._rows: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._totals = _zero_row(TOTALS, "")
+        self.max_scopes = max(2, int(max_scopes))
+        self.max_models = max(1, int(max_models))
+        self._registry = registry
+        self.scopes_evicted = 0
+
+    # -- charging ---------------------------------------------------------
+
+    def charge(self, scope: Optional[Scope], **fields) -> None:
+        """Add ``fields`` to the scope's row AND the totals row (so the
+        global ledger is always the sum of what was handed out).
+        ``hbmPeakBytes`` merges by max. ``scope=None`` charges the
+        :data:`UNSCOPED` row."""
+        key = scope.key if scope is not None else UNSCOPED
+        tenant = scope.tenant if scope is not None else ""
+        with self._lock:
+            row, created, evicted = self._row_locked(key, tenant)
+            self._add(row, fields)
+            self._add(self._totals, fields)
+        self._sync_gauges(key, tenant, created, evicted)
+
+    def charge_model(self, scope: Optional[Scope], model: str,
+                     **fields) -> None:
+        """Serving-lane charge: ``fields`` land on the scope row (and
+        totals) AND on the scope's per-model sub-row."""
+        key = scope.key if scope is not None else UNSCOPED
+        tenant = scope.tenant if scope is not None else ""
+        with self._lock:
+            row, created, evicted = self._row_locked(key, tenant)
+            self._add(row, fields)
+            self._add(self._totals, fields)
+            models = row["models"]
+            m = models.get(model)
+            if m is None:
+                if len(models) >= self.max_models:
+                    model = "(other)"
+                m = models.setdefault(model, {})
+            self._add(m, fields)
+        self._sync_gauges(key, tenant, created, evicted)
+
+    @staticmethod
+    def _add(row: Dict[str, Any], fields: Dict[str, Any]) -> None:
+        for k, v in fields.items():
+            if k in _MAX_FIELDS:
+                if v > row.get(k, 0):
+                    row[k] = v
+            else:
+                row[k] = row.get(k, 0) + v
+
+    def _row_locked(self, key: str, tenant: str):
+        """Caller holds ``_lock``. Returns (row, created?,
+        (evicted_key, evicted_tenant) | None) — the victim's tenant
+        travels out so gauge unregistration rebuilds the SAME labeled
+        name registration used."""
+        row = self._rows.get(key)
+        if row is not None:
+            return row, False, None
+        row = _zero_row(key, tenant)
+        self._rows[key] = row
+        evicted = None
+        if len(self._rows) > self.max_scopes:
+            for victim in self._rows:
+                if victim not in (key, EVICTED):
+                    break
+            else:   # pragma: no cover — bound >= 2 makes this unreachable
+                return row, True, None
+            old = self._rows.pop(victim)
+            sink, _, _ = self._row_locked(EVICTED, "")
+            self._fold_locked(sink, old)
+            self.scopes_evicted += 1
+            evicted = (victim, str(old.get("tenant", "")))
+        return row, True, evicted
+
+    @classmethod
+    def _fold_locked(cls, dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+        cls._add(dst, {k: v for k, v in src.items()
+                       if isinstance(v, (int, float)) and not
+                       isinstance(v, bool)})
+        for model, sub in src.get("models", {}).items():
+            cls._add(dst.setdefault("models", {}).setdefault(model, {}), sub)
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Deep copy of every scope row plus the totals row under
+        :data:`TOTALS` — the shape ``UsageReport`` events, shipped span
+        batches and the REST route all carry."""
+        with self._lock:
+            out = {k: self._copy_row(r) for k, r in self._rows.items()}
+            out[TOTALS] = self._copy_row(self._totals)
+        return out
+
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._copy_row(self._totals)
+
+    def row(self, key: str) -> Dict[str, Any]:
+        """Copy of one scope's row, or a zero row for an unknown key
+        (so bracket-delta consumers never special-case 'not charged
+        yet')."""
+        with self._lock:
+            r = self._rows.get(key)
+            return self._copy_row(r) if r is not None else _zero_row(key, "")
+
+    def peek(self, key: str, fld: str) -> float:
+        """One field of one row — the gauge-callback read."""
+        with self._lock:
+            r = self._rows.get(key)
+            return float(r.get(fld, 0)) if r is not None else 0.0
+
+    @staticmethod
+    def _copy_row(row: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(row)
+        out["models"] = {m: dict(sub) for m, sub in row["models"].items()}
+        return out
+
+    # -- labeled Prometheus gauges ---------------------------------------
+
+    def _sync_gauges(self, key: str, tenant: str, created: bool,
+                     evicted: Optional[str]) -> None:
+        """Register/unregister per-scope gauges OUTSIDE the ledger lock
+        (the registry has its own; nesting the two would order-invert
+        against a scrape that polls back into ``peek``)."""
+        reg = self._registry
+        if reg is None or not (created or evicted):
+            return
+        if created:
+            for fld in _GAUGE_FIELDS:
+                reg.gauge(self._gauge_name(fld, key, tenant),
+                          lambda k=key, f=fld: self.peek(k, f))
+        if evicted:
+            ekey, etenant = evicted
+            for fld in _GAUGE_FIELDS:
+                reg.remove(self._gauge_name(fld, ekey, etenant))
+
+    @staticmethod
+    def _gauge_name(fld: str, key: str, tenant: str) -> str:
+        esc = key.replace("\\", "\\\\").replace('"', '\\"')
+        labels = f'scope="{esc}"'
+        if tenant:
+            t = tenant.replace("\\", "\\\\").replace('"', '\\"')
+            labels += f',tenant="{t}"'
+        return f"usage.{fld}{{{labels}}}"
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, Dict[str, Any]]]
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Merge per-host ledger snapshots (the collector's cross-host
+    rollup): additive fields sum per scope key, peaks take the max,
+    per-model sub-tables merge the same way."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        for key, row in snap.items():
+            if not isinstance(row, dict):
+                continue
+            dst = out.setdefault(key, _zero_row(
+                key, str(row.get("tenant", ""))))
+            UsageLedger._fold_locked(dst, row)
+    return out
+
+
+def usage_delta(before: Dict[str, Any], after: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    """Additive-field delta of one scope row across a bracket (the
+    ``FitProfile.job_usage`` shape). Peaks keep the bracket-end value —
+    a high-water mark has no meaningful difference."""
+    out: Dict[str, Any] = {}
+    for k, v in after.items():
+        if k in _MAX_FIELDS:
+            if v:
+                out[k] = v
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        else:
+            d = v - before.get(k, 0)
+            if d:
+                out[k] = d
+    return out
+
+
+# -- the module-global switch (one read on every hot path) ----------------
+
+_ledger: Optional[UsageLedger] = None
+
+
+def enable(conf=None, registry=None) -> UsageLedger:
+    """Install the process-global ledger (idempotent — an existing one
+    is kept, the way ``tracing.enable`` behaves). Bounds come from
+    ``cyclone.usage.*`` conf."""
+    global _ledger
+    if _ledger is not None:
+        return _ledger
+    from cycloneml_tpu.conf import USAGE_MAX_MODELS, USAGE_MAX_SCOPES
+    max_scopes = int(conf.get(USAGE_MAX_SCOPES)) if conf is not None else 256
+    max_models = int(conf.get(USAGE_MAX_MODELS)) if conf is not None else 64
+    _ledger = UsageLedger(max_scopes=max_scopes, max_models=max_models,
+                          registry=registry)
+    return _ledger
+
+
+def disable() -> None:
+    global _ledger
+    _ledger = None
+
+
+def active() -> Optional[UsageLedger]:
+    return _ledger
+
+
+def charge(sc: Optional[Scope], **fields) -> None:
+    """Charge ``fields`` to ``sc`` (or the calling thread's scope, or
+    :data:`UNSCOPED`). One global read when attribution is off."""
+    led = _ledger
+    if led is None:
+        return
+    led.charge(sc if sc is not None else current_scope(), **fields)
+
+
+def charge_model(sc: Optional[Scope], model: str, **fields) -> None:
+    led = _ledger
+    if led is None:
+        return
+    led.charge_model(sc if sc is not None else current_scope(), model,
+                     **fields)
+
+
+class _NoopWindow:
+    """Shared do-nothing window: no clock read, no allocation. The
+    ``live`` flag lets a site extend its cost-harvest condition
+    (``tracing.full_active() or win.live``) without consulting this
+    module twice."""
+
+    __slots__ = ()
+    live = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate_program(self, pid) -> None:
+        pass
+
+
+NOOP_WINDOW = _NoopWindow()
+
+
+class _Window:
+    """Live dispatch window: times the block, charges device-seconds +
+    one dispatch, and joins an annotated program id onto the costs
+    registry for FLOPs / bytes-accessed / HBM-peak."""
+
+    __slots__ = ("_ledger", "_scope", "_pid", "_t0")
+    live = True
+
+    def __init__(self, ledger: UsageLedger, sc: Scope):
+        self._ledger = ledger
+        self._scope = sc
+        self._pid = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def annotate_program(self, pid) -> None:
+        self._pid = pid
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        fields: Dict[str, Any] = {"deviceSeconds": dt, "dispatches": 1}
+        if self._pid:
+            from cycloneml_tpu.observe import costs
+            c = costs.lookup(self._pid)
+            if c:
+                if c.get("flops_total"):
+                    fields["flops"] = float(c["flops_total"])
+                if c.get("bytes_accessed_total"):
+                    fields["bytesAccessed"] = float(c["bytes_accessed_total"])
+                if c.get("peak_bytes"):
+                    fields["hbmPeakBytes"] = int(c["peak_bytes"])
+        self._ledger.charge(self._scope, **fields)
+        return False
+
+
+def dispatch_window(sc: Optional[Scope] = None):
+    """The hot-path entry: a context manager around one device dispatch.
+
+    Attribution off → the shared :data:`NOOP_WINDOW` after ONE global
+    read; no scope on the thread (and none passed) → same, after one
+    thread-local peek. Only a scoped dispatch under an active ledger
+    pays the two clock reads."""
+    led = _ledger
+    if led is None:
+        return NOOP_WINDOW
+    if sc is None:
+        sc = current_scope()
+        if sc is None:
+            return NOOP_WINDOW
+    return _Window(led, sc)
+
+
+# -- periodic reporting ---------------------------------------------------
+
+class UsageReporter:
+    """Posts cumulative ``UsageReport`` snapshots (and, when a
+    ``telemetry_fn`` is wired, ``TelemetryStatsUpdated`` drop-counter
+    rollups) to the listener bus on a period, plus a final flush on
+    ``stop()``. Stop latch discipline: the posting path re-checks the
+    latch under the same lock acquisition (the JX022 idiom), so a
+    report can never land on a stopped bus."""
+
+    def __init__(self, bus, interval_s: float = 2.0, host: str = "",
+                 telemetry_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        self._bus = bus
+        self.interval_s = max(0.05, float(interval_s))
+        self.host = host
+        self._telemetry_fn = telemetry_fn
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "UsageReporter":
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("usage reporter is stopped")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="cyclone-usage-report",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._wake.wait(self.interval_s):
+            try:
+                self.flush()
+            except Exception:   # a broken report must not kill the loop
+                logger.exception("usage: report failed")
+
+    def flush(self) -> None:
+        """Post one report now (no-op when attribution is off or the
+        reporter is stopped)."""
+        led = _ledger
+        events = []
+        if led is not None:
+            from cycloneml_tpu.util.events import UsageReport
+            events.append(UsageReport(usage=led.snapshot(), host=self.host))
+        if self._telemetry_fn is not None:
+            from cycloneml_tpu.util.events import TelemetryStatsUpdated
+            try:
+                stats = self._telemetry_fn()
+            except Exception:
+                logger.exception("usage: telemetry stats sample failed")
+                stats = None
+            if stats:
+                events.append(TelemetryStatsUpdated(stats=stats))
+        with self._lock:
+            if self._stopped:
+                return
+            for ev in events:
+                try:
+                    self._bus.post(ev)
+                except Exception:
+                    pass    # a stopping bus must not fail the reporter
+
+    def stop(self) -> None:
+        """Final flush, then latch. Idempotent."""
+        try:
+            self.flush()
+        except Exception:
+            pass
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            thread, self._thread = self._thread, None
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout=5)

@@ -35,7 +35,12 @@ Wire protocol: one JSON line per connection on the shared authed TCP
 fabric (util/tcp.py — the deploy/heartbeat handshake covers this channel
 too): ``{"kind": "spans", "host": ..., "pid": ..., "trace_id": ...,
 "dropped": ..., "offset_samples": [[offset_s, rtt_s], ...],
-"tid_names": {...}, "spans": [...]}`` → ``{"ok": true}``.
+"tid_names": {...}, "spans": [...], "usage": {...}}`` → ``{"ok": true}``.
+The optional ``usage`` field is the worker's cumulative attribution
+ledger snapshot (``observe/attribution.py``): like ``dropped`` it is a
+running total, so the collector folds it by REPLACEMENT per host and
+:meth:`TraceCollector.merged_usage` sums per-scope rows across hosts —
+the cross-host accounting join.
 """
 
 from __future__ import annotations
@@ -201,7 +206,8 @@ class TraceCollector:
                 # per-host bound (a local running sum) — "dropped" in
                 # hosts()/the merged header is their sum
                 "ship_dropped": 0, "local_dropped": 0,
-                "offset_samples": [], "tid_names": {}, "spans": []})
+                "offset_samples": [], "tid_names": {}, "spans": [],
+                "usage": {}})
             rec["pid"] = msg.get("pid") or rec["pid"]
             if msg.get("trace_id"):
                 rec["trace_id"] = str(msg["trace_id"])
@@ -209,6 +215,12 @@ class TraceCollector:
                 rec["ship_dropped"] = int(msg.get("dropped") or 0)
             except (TypeError, ValueError):
                 pass
+            usage = msg.get("usage")
+            if isinstance(usage, dict):
+                # cumulative ledger snapshot: REPLACE, like ship_dropped
+                rec["usage"] = {str(k): dict(v)
+                                for k, v in usage.items()
+                                if isinstance(v, dict)}
             rec["offset_samples"].extend(samples)
             rec["offset_samples"] = rec["offset_samples"][-MAX_OFFSET_SAMPLES:]
             try:
@@ -270,6 +282,31 @@ class TraceCollector:
         """ONE Chrome-trace object: a process lane per host, span ids
         host-qualified, timestamps clock-offset corrected."""
         return export.merged_chrome_trace(self._records())
+
+    def merged_usage(self) -> Dict[str, Dict[str, Any]]:
+        """Cross-host attribution rollup: every shipped per-host ledger
+        snapshot (cumulative, REPLACE-folded per host) plus this
+        process's own live ledger, merged per scope key — additive
+        fields sum, peaks take the max."""
+        from cycloneml_tpu.observe import attribution
+        snaps = []
+        led = attribution.active()
+        if led is not None:
+            snaps.append(led.snapshot())
+        with self._lock:
+            snaps.extend(dict(rec["usage"]) for rec in self._hosts.values()
+                         if rec.get("usage"))
+        return attribution.merge_snapshots(snaps)
+
+    def ingest_stats(self) -> Dict[str, Any]:
+        """Collector-side loss accounting for the telemetry drop-counter
+        surface: batches ingested, spans evicted past the per-host bound
+        here, and the workers' self-reported delivery loss."""
+        with self._lock:
+            ship = sum(int(r.get("ship_dropped") or 0)
+                       for r in self._hosts.values())
+            return {"hosts": len(self._hosts), "batches": self.batches,
+                    "ingestDropped": self.dropped, "shipDropped": ship}
 
     def export(self, path: str) -> str:
         return export.write_chrome_trace(self.merged_trace(), path)
@@ -365,6 +402,12 @@ class SpanShipper:
                 self.dropped += over
         if not self._buf:
             return 0
+        # tag batches with this process's cumulative attribution ledger
+        # (scope ids + rollups): the collector REPLACE-folds it per host,
+        # so usage flows cross-host on the channel spans already ride
+        from cycloneml_tpu.observe import attribution
+        led = attribution.active()
+        usage = led.snapshot() if led is not None else None
         sent = 0
         while self._buf:
             batch, rest = (self._buf[:self.max_batch],
@@ -377,6 +420,8 @@ class SpanShipper:
                    "dropped": self.ring_missed + self.dropped,
                    "offset_samples": offset_samples(),
                    "tid_names": tr.thread_names(), "spans": batch}
+            if usage is not None:
+                msg["usage"] = usage
             try:
                 reply = self._send(msg)
             except (OSError, ValueError):
@@ -400,6 +445,14 @@ class SpanShipper:
                 fh.close()
         check_not_challenge(line)
         return json.loads(line) if line.strip() else {}
+
+    def delivery_stats(self) -> Dict[str, Any]:
+        """Delivery-loss accounting for the telemetry drop-counter
+        surface: spans shipped, ship-buffer overflow, and ring evictions
+        the cursor missed (true loss — not ``tr.dropped``, which counts
+        every rotation of a ring the cursor outruns)."""
+        return {"shipped": self.shipped, "bufferDropped": self.dropped,
+                "ringMissed": self.ring_missed, "buffered": len(self._buf)}
 
     def flush(self) -> int:
         """Final synchronous ship — call AFTER :meth:`stop` (the loop

@@ -96,6 +96,10 @@ class FitProfile:
     memory_stats_available: bool = False
     programs: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    # this job's usage-ledger delta (observe.attribution): what the job's
+    # scope row gained between run_job entry and exit — device-seconds,
+    # FLOPs, h2d bytes etc. Empty when attribution was off for the fit.
+    job_usage: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

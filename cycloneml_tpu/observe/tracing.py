@@ -234,6 +234,15 @@ class Tracer:
         with self._lock:
             return dict(self._tid_names)
 
+    @property
+    def spans_dropped(self) -> int:
+        """Ring-overflow drop count as a first-class telemetry reading
+        (the drop-counter rollup in ``TelemetryStatsUpdated`` and
+        ``/api/v1/telemetry`` reads this; previously visible only in the
+        trace export header)."""
+        with self._lock:
+            return self.dropped
+
     # -- context ---------------------------------------------------------------
     def _stack(self) -> List[Span]:
         stack = getattr(self._local, "stack", None)

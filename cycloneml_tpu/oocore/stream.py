@@ -34,7 +34,7 @@ from typing import Optional
 
 import numpy as np
 
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import attribution, tracing
 from cycloneml_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -78,6 +78,10 @@ class ShardStream:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
         self._stop = threading.Event()
         self.bytes_staged = 0
+        # capture the CONSTRUCTING thread's attribution scope: staging runs
+        # on the background thread, which never sees the job's scope stack
+        # (same cross-thread capture as Tracer.record_span)
+        self._scope = attribution.current_scope()
         self._rng = random.Random(1)  # seeded: chaos replays exactly
         self._thread = threading.Thread(
             target=self._produce, name="cyclone-oocore-stage", daemon=True)
@@ -169,6 +173,7 @@ class ShardStream:
             sp.annotate(bytes=n_bytes, rows=m)
         self.bytes_staged += n_bytes
         tracing.counter("oocore.bytes_staged", self.bytes_staged)
+        attribution.charge(self._scope, h2dBytes=n_bytes)
         skew.observe("oocore.stage", lane, time.perf_counter() - t_skew)
         return (i, xs, ys, ws)
 

@@ -23,7 +23,7 @@ from typing import Any, Callable, Optional, Sequence
 
 from cycloneml_tpu import mesh as _mesh_mod
 from cycloneml_tpu.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS, MeshRuntime
-from cycloneml_tpu.observe import costs, skew, tracing
+from cycloneml_tpu.observe import attribution, costs, skew, tracing
 
 
 class StaleProgramError(RuntimeError):
@@ -198,34 +198,52 @@ def _instrument_dispatch(jitted, name: str = "tree_aggregate", key=None,
         faults.inject("multihost.host")
         faults.inject("collectives.step")
         was_first, first[0] = first[0], False
+        # attribution window: one global read when usage metering is off,
+        # one thread-local peek more when no scope is active — the same
+        # disabled-path discipline as the tracer/faults reads above
+        win = attribution.dispatch_window()
         tr = tracing.active()
         if tr is None:
+            if win.live and pid_ref[0] is None:
+                # a scoped dispatch wants the FLOPs/bytes join even with
+                # tracing off: harvest once per program (shared registry)
+                pid_ref[0] = costs.ensure(name, key, jitted, args)
+            win.annotate_program(pid_ref[0])
             # untraced, but an installed skew detector still gets the
             # step-time sample for the SLO latch (one more global read).
             # The FIRST dispatch pays trace + XLA compile — seconds, not
-            # a step time — and would fire a spurious SloBreach.
+            # a step time — and would fire a spurious SloBreach. The
+            # attribution window still wraps it: compile time is device
+            # capacity the scope consumed, and the ledger's per-scope and
+            # totals rows move together so the sum invariant holds.
             if was_first:
-                return jitted(*args, **kwargs)
-            with skew.timed_observe("collectives.step", name):
-                return jitted(*args, **kwargs)
+                with win:
+                    return jitted(*args, **kwargs)
+            with win:
+                with skew.timed_observe("collectives.step", name):
+                    return jitted(*args, **kwargs)
         # cost harvest + budget guard only under a FULL tracer: the
         # flight-recorder ring records spans and must stay cheap — no AOT
-        # analyze, no counter tracks (the always-on contract)
+        # analyze, no counter tracks (the always-on contract). A live
+        # attribution window buys the harvest too — the scope's
+        # FLOPs/bytes column joins on the same program identity.
         full = tr.full
-        if full and pid_ref[0] is None:
+        if (full or win.live) and pid_ref[0] is None:
             # harvest BEFORE the first dispatch and OUTSIDE the spans: the
             # AOT lower+compile feeding cost_analysis must not inflate
             # compile_seconds, and a budgetAction=raise guard must fire
             # before the oversized program ever executes
             pid_ref[0] = costs.ensure(name, key, jitted, args)
             costs.check_budget(pid_ref[0])
-        with tr.span("collective", name, program=pid_ref[0],
-                     **level_attrs) as csp:
-            if was_first:
-                with tr.span("compile", name):
+        win.annotate_program(pid_ref[0])
+        with win:
+            with tr.span("collective", name, program=pid_ref[0],
+                         **level_attrs) as csp:
+                if was_first:
+                    with tr.span("compile", name):
+                        out = jitted(*args, **kwargs)
+                else:
                     out = jitted(*args, **kwargs)
-            else:
-                out = jitted(*args, **kwargs)
         if not was_first:
             # compile-paying first dispatches are staging, not step time —
             # they must not trip the SLO latch

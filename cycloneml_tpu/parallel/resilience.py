@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from cycloneml_tpu.observe import tracing
+from cycloneml_tpu.observe import attribution, tracing
 from cycloneml_tpu.util.checkpoint import CheckpointCorrupt, TrainingCheckpointer
 from cycloneml_tpu.util.events import WorkerLost
 from cycloneml_tpu.util.logging import get_logger
@@ -767,6 +767,9 @@ class MeshSupervisor:
                 f"(max_rebuilds={self.max_rebuilds}); aborting instead of "
                 f"thrashing")
         self.rebuilds += 1
+        # recovery work bills the TRAINING thread's scope (recover runs on
+        # it): a tenant whose job rides a flaky slice sees its own row grow
+        attribution.charge(None, recoveries=1)
         master = self._target_master()
         # freeze the flight-recorder window BEFORE teardown: the ring
         # holds what the mesh was doing as it degraded — diagnosable
@@ -827,6 +830,7 @@ class MeshSupervisor:
                 f"(max_reshapes={self.max_reshapes}); refusing further "
                 f"capacity events instead of thrashing")
         self.reshapes += 1
+        attribution.charge(None, reshapes=1)
         from cycloneml_tpu.observe import flight
         flight.trigger("mesh.reshape", cause=str(event),
                        reshape=self.reshapes)

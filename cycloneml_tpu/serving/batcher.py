@@ -35,7 +35,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from cycloneml_tpu.observe import costs, flight, skew, tracing
+from cycloneml_tpu.observe import attribution, costs, flight, skew, tracing
 from cycloneml_tpu.serving.buckets import bucket_for, bucket_sizes, pad_rows
 from cycloneml_tpu.serving.servable import GangServable
 from cycloneml_tpu.util.logging import get_logger
@@ -64,13 +64,17 @@ class ServingOverloaded(ServingError):
 
 
 class _Request:
-    __slots__ = ("x", "n", "future", "t_enq")
+    __slots__ = ("x", "n", "future", "t_enq", "scope")
 
     def __init__(self, x: np.ndarray):
         self.x = x
         self.n = x.shape[0]
         self.future: "Future" = Future()
         self.t_enq = time.perf_counter()
+        # the SUBMITTING thread's attribution scope rides the request:
+        # the lane worker that eventually dispatches it never sees the
+        # caller's scope stack (same cross-thread capture as record_span)
+        self.scope = attribution.current_scope()
 
 
 class ModelLane:
@@ -199,6 +203,7 @@ class ModelLane:
             if len(self._queue) >= self.server.max_queue:
                 self.shed += 1
                 self.server.registry.counter("serving.shed").inc()
+                attribution.charge_model(req.scope, self.name, sheds=1)
                 raise ServingOverloaded(
                     f"{self.name!r} queue is full "
                     f"({self.server.max_queue} requests) — backpressure")
@@ -221,6 +226,7 @@ class ModelLane:
                 return False
             self.shed += 1  # a 503 like every other shed path — counted
         self.server.registry.counter("serving.shed").inc()
+        attribution.charge_model(r.scope, self.name, sheds=1)
         fut.set_exception(ServingOverloaded(
             f"{self.name!r}: sibling sub-request hit backpressure; "
             f"multi-chunk request shed as a unit"))
@@ -344,6 +350,7 @@ class ModelLane:
                 with self._cv:  # submit() bumps this tally under the cv too
                     self.shed += 1
                 self.server.registry.counter("serving.shed").inc()
+                attribution.charge_model(r.scope, self.name, sheds=1)
                 r.future.set_exception(ServingOverloaded(
                     f"{self.name!r}: admission control predicts the "
                     f"dispatch exceeds the device memory budget "
@@ -445,6 +452,11 @@ class ModelLane:
             self.latency.update(e2e)
             reg.timer("serving.latency").update(e2e)
             reg.timer("serving.queue").update(max(t_batch - r.t_enq, 0.0))
+            # dispatch wall time split across co-riders by row share: the
+            # per-scope servingSeconds sum equals the lane's dispatch time
+            attribution.charge_model(r.scope, self.name, requests=1,
+                                     rows=r.n,
+                                     servingSeconds=dispatch_s * r.n / rows)
             if tr is not None:
                 tr.record_span("serving", "request", t0=r.t_enq, t1=t_done,
                                parent=span.span_id, model=self.name,

@@ -199,6 +199,31 @@ class CapacityAcquired(CycloneEvent):
 
 
 @dataclass
+class UsageReport(CycloneEvent):
+    """Cumulative per-scope usage ledger snapshot
+    (``observe.attribution.UsageLedger.snapshot()``: scope key → row of
+    device-seconds / FLOPs / bytes / HBM-peak / serving + control-plane
+    tallies, totals under ``_totals``), posted periodically and on
+    context stop. Snapshots are cumulative, so the status store folds
+    by replacement per ``host`` and journal replay reconverges from the
+    last surviving line."""
+
+    usage: Dict[str, Any] = field(default_factory=dict)
+    host: str = ""
+
+
+@dataclass
+class TelemetryStatsUpdated(CycloneEvent):
+    """Telemetry-plane drop-counter rollup (tracer spans dropped,
+    span-shipper delivery loss, collector ingest drops, listener-bus
+    tallies) — the lossiness of the observability pipe itself, visible
+    without exporting a trace. Cumulative; folded by replacement like
+    ``ServingStatsUpdated``."""
+
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class CheckpointWritten(CycloneEvent):
     path: str = ""
     step: int = 0

@@ -252,6 +252,44 @@ def _finite(v) -> bool:
     return not (isinstance(v, float) and not math.isfinite(v))
 
 
+# one k="v" pair inside a metric name's label block; values may carry
+# \" \\ \n escapes (the exposition format's own escape set)
+_LABEL_PAIR_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_.\-]*)="((?:[^"\\]|\\.)*)"')
+_LABEL_ESC_RE = re.compile(r"\\(.)")
+
+
+def _unescape_label(v: str) -> str:
+    return _LABEL_ESC_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def _escape_label(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _split_labels(name: str):
+    """``'req.total{model="a",tenant="t"}'`` → ``('req.total',
+    [('model', 'a'), ('tenant', 't')])``; a plain or malformed name →
+    ``(name, None)`` (malformed label blocks flatten into the sanitized
+    metric name rather than emitting broken exposition)."""
+    i = name.find("{")
+    if i < 0 or not name.endswith("}"):
+        return name, None
+    block, pairs, pos = name[i + 1:-1], [], 0
+    while pos < len(block):
+        m = _LABEL_PAIR_RE.match(block, pos)
+        if m is None:
+            return name, None
+        pairs.append((m.group(1), _unescape_label(m.group(2))))
+        pos = m.end()
+        if pos < len(block):
+            if block[pos] != ",":
+                return name, None
+            pos += 1
+    return name[:i], pairs
+
+
 def prometheus_text(values: Dict[str, float], prefix: str = "cyclone",
                     types: Optional[Dict[str, str]] = None) -> str:
     """Prometheus exposition format (ref: PrometheusServlet.scala /
@@ -261,9 +299,15 @@ def prometheus_text(values: Dict[str, float], prefix: str = "cyclone",
     emitted so real scrapers ingest the endpoint cleanly; summary-typed
     names render the canonical quantile/_sum/_count form from the
     histogram's flattened ``.count/.mean/.p50/...`` values.
+
+    Names carrying a ``{k="v"}`` suffix (the attribution ledger's
+    per-scope gauges) emit canonical labeled series: the label block is
+    parsed, values are re-escaped, and series of one family group under
+    ONE ``# TYPE`` line — labeled and unlabeled series of the same base
+    name are one family.
     """
     def safe(k: str) -> str:
-        return f"{prefix}_{k}".replace(".", "_").replace("-", "_")
+        return re.sub(r"[^A-Za-z0-9_:]", "_", f"{prefix}_{k}")
 
     types = types or {}
     lines: List[str] = []
@@ -286,16 +330,32 @@ def prometheus_text(values: Dict[str, float], prefix: str = "cyclone",
         if _finite(mean):
             lines.append(f"{s}_sum {mean * cnt}")
         lines.append(f"{s}_count {int(cnt)}")
-    for k in sorted(values):
-        if k in consumed:
+    # remaining series, grouped by FAMILY (base name without labels) so
+    # a labeled family renders one # TYPE header, then its series
+    series = []
+    for k, v in values.items():
+        if k in consumed or not _finite(v):
             continue
-        v = values[k]
-        if not _finite(v):
-            continue
-        t = types.get(k)
-        if t in ("counter", "gauge"):
-            lines.append(f"# TYPE {safe(k)} {t}")
-        lines.append(f"{safe(k)} {v}")
+        base, pairs = _split_labels(k)
+        if pairs:
+            lbl = "{" + ",".join(
+                f'{re.sub(r"[^A-Za-z0-9_]", "_", lk)}="{_escape_label(lv)}"'
+                for lk, lv in pairs) + "}"
+        else:
+            lbl = ""
+        series.append((safe(base), lbl, types.get(k) or types.get(base), v))
+    series.sort(key=lambda s: (s[0], s[1]))
+    fam_type: Dict[str, str] = {}
+    for fam, _, t, _ in series:
+        if t in ("counter", "gauge") and fam not in fam_type:
+            fam_type[fam] = t
+    prev_fam = None
+    for fam, lbl, _, v in series:
+        if fam != prev_fam:
+            prev_fam = fam
+            if fam in fam_type:
+                lines.append(f"# TYPE {fam} {fam_type[fam]}")
+        lines.append(f"{fam}{lbl} {v}")
     return "\n".join(lines) + "\n"
 
 

@@ -53,6 +53,13 @@ class AppStatusStore:
         # surface. Bounded like skew: a long-lived loop ticks forever
         self.autoscale: List[Dict[str, Any]] = []
         self.max_autoscale_events = 200
+        # latest UsageReport snapshot per reporting host (cumulative
+        # attribution ledgers — observe/attribution.py), folded by
+        # replacement; the /api/v1/usage surface merges across hosts
+        self.usage_hosts: Dict[str, Dict[str, Any]] = {}
+        # latest TelemetryStatsUpdated rollup (drop counters of the
+        # telemetry pipe itself), {} until one posts
+        self.telemetry: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -107,6 +114,22 @@ class AppStatusStore:
         newest last."""
         with self._lock:
             return [dict(e) for e in self.autoscale]
+
+    def usage_rollup(self) -> Dict[str, Dict[str, Any]]:
+        """Per-scope usage rows merged across reporting hosts (scope key
+        → row; global totals under '_totals'), or {} when no
+        UsageReport ever posted."""
+        with self._lock:
+            snaps = [dict(s) for s in self.usage_hosts.values()]
+        if not snaps:
+            return {}
+        from cycloneml_tpu.observe.attribution import merge_snapshots
+        return merge_snapshots(snaps)
+
+    def telemetry_stats(self) -> Dict[str, Any]:
+        """The latest telemetry drop-counter rollup, or {}."""
+        with self._lock:
+            return dict(self.telemetry)
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -230,6 +253,13 @@ class AppStatusListener:
                                        "breachStreak": e.get("breach_streak"),
                                        "idleStreak": e.get("idle_streak"),
                                        "time": e.get("time_ms")})
+        elif kind == "UsageReport":
+            with s._lock:
+                s.usage_hosts[str(e.get("host", ""))] = dict(
+                    e.get("usage", {}))
+        elif kind == "TelemetryStatsUpdated":
+            with s._lock:
+                s.telemetry = dict(e.get("stats", {}))
         elif kind == "CapacityAcquired":
             self._append_autoscale(s, {"kind": "capacity",
                                        "master": e.get("master"),
@@ -289,7 +319,7 @@ def api_v1(store: AppStatusStore, route: str,
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
     'memory/warnings', 'serving', 'skew', 'migrations', 'precision',
-    'autoscale'."""
+    'autoscale', 'usage', 'telemetry'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -316,4 +346,8 @@ def api_v1(store: AppStatusStore, route: str,
         return store.precision_events()
     if route == "autoscale":
         return store.autoscale_events()
+    if route == "usage":
+        return store.usage_rollup()
+    if route == "telemetry":
+        return store.telemetry_stats()
     raise KeyError(f"unknown route {route!r}")

@@ -34,6 +34,8 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>Cyclone <span id="app" class="muted"></span></h1>
 <h2>Jobs</h2><div id="jobs" class="muted">loading…</div>
+<h2>Usage</h2><div id="usage" class="muted">none</div>
+<h2>Telemetry</h2><div id="telemetry" class="muted">none</div>
 <h2>Skew / stragglers</h2><div id="skew" class="muted">none</div>
 <h2>Serving</h2><div id="serving" class="muted">none</div>
 <h2>Storage</h2><div id="storage" class="muted">none</div>
@@ -83,6 +85,25 @@ async function refresh() {
     }
   }
   document.getElementById('jobs').innerHTML = html;
+  const usage = await j('usage');
+  if (usage && Object.keys(usage).length) {
+    // "_totals" sorts first; per-scope rows follow — the reader eyeballs
+    // that the scope column sums to the totals row
+    const rows = Object.entries(usage).sort().map(([k, v]) => {
+      const r = Object.assign({}, v); delete r.models; return r;
+    });
+    document.getElementById('usage').innerHTML =
+      table(rows, ['scope', 'tenant', 'deviceSeconds', 'dispatches',
+                   'flops', 'bytesAccessed', 'hbmPeakBytes', 'h2dBytes',
+                   'requests', 'servingSeconds', 'sheds', 'reshapes',
+                   'recoveries', 'autoscaleActions']);
+  }
+  const tele = await j('telemetry');
+  if (tele && Object.keys(tele).length) {
+    const rows = Object.entries(tele).map(([k, v]) => ({field: k, value: v}));
+    document.getElementById('telemetry').innerHTML =
+      table(rows, ['field', 'value']);
+  }
   const skew = await j('skew');
   if (skew.length) document.getElementById('skew').innerHTML =
     table(skew.slice(-20), ['kind', 'group', 'position', 'observedS',
@@ -125,10 +146,16 @@ class StatusWebUI:
     """Serves the page at ``/`` and JSON under ``/api/v1/...``."""
 
     def __init__(self, store: AppStatusStore, host: str = "127.0.0.1",
-                 port: int = 0, storage_usage=None):
+                 port: int = 0, storage_usage=None, usage=None,
+                 telemetry=None):
         # live storage-tier accounting (≈ the reference's Storage tab over
         # the BlockManager): a zero-arg callable returning {tier: bytes}
         self._storage_usage = storage_usage
+        # live usage-ledger / telemetry-stats callables: fresher than the
+        # status store's last periodic UsageReport; when absent the routes
+        # fall through to the store (the history-server replay path)
+        self._usage = usage
+        self._telemetry = telemetry
         ui = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -172,6 +199,10 @@ class StatusWebUI:
                 return []
             return [{"tier": k, "bytes": v}
                     for k, v in self._storage_usage().items()]
+        if parts == ["usage"] and self._usage is not None:
+            return self._usage()
+        if parts == ["telemetry"] and self._telemetry is not None:
+            return self._telemetry()
         if len(parts) == 1:
             return api_v1(self.store, parts[0])
         if len(parts) in (2, 3) and parts[0] == "jobs":

@@ -1,0 +1,151 @@
+"""usage-demo: two concurrently-scoped jobs metered by the attribution plane.
+
+The executable form of the accounting acceptance contract
+(docs/observability.md "Attribution & accounting"):
+
+1. two scoped workloads run in one process — a training fit under
+   ``attribution.scope("train-job", tenant="acme")`` and a serving storm
+   under ``attribution.scope("serve-job", tenant="beta")``,
+2. the ledger's per-scope rows for device-seconds, FLOPs and
+   bytes-accessed sum to the unscoped global totals row within 1% (the
+   charge path adds to both sides of the invariant atomically, so this
+   pins that no charge site bypasses either),
+3. the serving scope carries per-model request counts and row-weighted
+   dispatch-seconds; the training scope carries the cost-registry join
+   (FLOPs / bytes / HBM peak on the fit's program identities),
+4. the ``/api/v1/usage`` REST route (web UI) serves BOTH scope rows plus
+   the totals row, straight from the live ledger.
+
+Run via ``make usage-demo``. Exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+ADDITIVE_FIELDS = ("deviceSeconds", "flops", "bytesAccessed", "h2dBytes",
+                   "dispatches", "requests", "servingSeconds")
+
+
+def _check_sum_invariant(snap) -> int:
+    from cycloneml_tpu.observe import attribution
+    totals = snap[attribution.TOTALS]
+    rc = 0
+    for fld in ADDITIVE_FIELDS:
+        want = totals.get(fld, 0)
+        got = sum(row.get(fld, 0) for key, row in snap.items()
+                  if key != attribution.TOTALS)
+        if want and abs(got - want) / want > 0.01:
+            print(f"FAIL: scope rows sum to {got} on {fld!r} but the "
+                  f"global totals row says {want} (> 1% apart)",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f"sum invariant: {fld} scopes={got:.6g} "
+                  f"totals={want:.6g} ok")
+    return rc
+
+
+def main() -> int:
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.dataset.frame import MLFrame
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import attribution
+    from cycloneml_tpu.serving import ModelServer
+
+    conf = (CycloneConf()
+            .set("cyclone.master", "local-mesh[8]")
+            .set("cyclone.app.name", "usage-demo")
+            .set("cyclone.usage.enabled", "true")
+            .set("cyclone.usage.reportIntervalMs", "200"))
+    ctx = CycloneContext(conf)
+    try:
+        led = attribution.active()
+        if led is None:
+            print("FAIL: cyclone.usage.enabled did not install a ledger",
+                  file=sys.stderr)
+            return 1
+
+        # -- job 1: a training fit under the "acme" tenant ----------------
+        rng = np.random.RandomState(0)
+        x = rng.randn(512, 16)
+        y = (x @ rng.randn(16) > 0).astype(float)
+        with attribution.scope("train-job", tenant="acme"):
+            LogisticRegression(maxIter=6, regParam=0.01, tol=0.0).fit(
+                MLFrame(ctx, {"features": x, "label": y}))
+
+        # -- job 2: a serving storm under the "beta" tenant ---------------
+        srv = ModelServer(ctx=None, max_batch=16, window_ms=2)
+        from cycloneml_tpu.ml.classification import LogisticRegressionModel
+        r = np.random.default_rng(1)
+        srv.register("storm", LogisticRegressionModel(
+            r.normal(size=(1, 16)), r.normal(size=(1,)), 2, False))
+        with attribution.scope("serve-job", tenant="beta"):
+            for i in range(40):
+                srv.predict("storm", r.normal(size=(1 + i % 7, 16)))
+        srv.stop()
+
+        snap = led.snapshot()
+        train = snap.get("acme/train-job")
+        serve = snap.get("beta/serve-job")
+        if train is None or serve is None:
+            print(f"FAIL: expected both scope rows, ledger has "
+                  f"{sorted(snap)}", file=sys.stderr)
+            return 1
+        print(f"train-job: deviceSeconds={train['deviceSeconds']:.4f} "
+              f"dispatches={train['dispatches']} flops={train['flops']:.6g} "
+              f"bytesAccessed={train['bytesAccessed']:.6g} "
+              f"hbmPeak={train['hbmPeakBytes']}")
+        print(f"serve-job: requests={serve['requests']} "
+              f"servingSeconds={serve['servingSeconds']:.4f} "
+              f"models={sorted(serve['models'])}")
+        if train["dispatches"] < 1 or train["flops"] <= 0:
+            print("FAIL: the fit charged no dispatches/FLOPs to its scope",
+                  file=sys.stderr)
+            return 1
+        if serve["requests"] != 40 or "storm" not in serve["models"]:
+            print("FAIL: the serving storm's 40 requests did not land on "
+                  "the serve-job scope's per-model table", file=sys.stderr)
+            return 1
+        if serve["models"]["storm"].get("servingSeconds", 0) <= 0:
+            print("FAIL: no row-weighted dispatch-seconds on the model row",
+                  file=sys.stderr)
+            return 1
+
+        rc = _check_sum_invariant(snap)
+        if rc:
+            return rc
+
+        # -- the REST surface serves both rows ----------------------------
+        ui = ctx.start_ui()
+        with urllib.request.urlopen(ui.url + "api/v1/usage",
+                                    timeout=10) as resp:
+            served = json.loads(resp.read().decode())
+        missing = {"acme/train-job", "beta/serve-job",
+                   attribution.TOTALS} - set(served)
+        if missing:
+            print(f"FAIL: /api/v1/usage is missing rows {sorted(missing)}; "
+                  f"served {sorted(served)}", file=sys.stderr)
+            return 1
+        print(f"/api/v1/usage rows: {sorted(served)}")
+        print("OK: two scoped jobs metered, per-scope sums match the "
+              "global ledger within 1%, REST route serves both rows")
+        return 0
+    finally:
+        ctx.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,3 +51,33 @@ class Pipeline:
 
     def size_racy(self):
         return len(self._pending)            # JX011 (unguarded read)
+
+
+class RacyRollup:
+    """A usage-ledger shape that bills only one side of the invariant
+    under the lock: charges move rows and totals together guarded, but
+    the eviction fold and the totals peek touch the maps bare — exactly
+    the races the real UsageLedger's single-lock discipline forbids."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self._totals = {}
+
+    def charge(self, scope, field, v):
+        with self._lock:
+            row = self._rows.setdefault(scope, {})
+            row[field] = row.get(field, 0) + v
+            self._totals[field] = self._totals.get(field, 0) + v
+
+    def snapshot(self):
+        with self._lock:
+            out = {k: dict(v) for k, v in self._rows.items()}
+            out["_totals"] = dict(self._totals)
+            return out
+
+    def evict_racy(self, scope):
+        del self._rows[scope]                # JX011 (unguarded write)
+
+    def peek_racy(self, field):
+        return self._totals.get(field, 0)    # JX011 (unguarded read)

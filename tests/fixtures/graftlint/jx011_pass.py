@@ -72,6 +72,31 @@ class NoLocksAtAll:
         return self.count
 
 
+class LedgerRollup:
+    """The attribution-ledger idiom (observe/attribution.UsageLedger):
+    one lock covers BOTH sides of the sum invariant — the scope row and
+    the global totals row move together under it, so a reader can never
+    observe one side without the other."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self._totals = {}
+
+    def charge(self, scope, **fields):
+        with self._lock:
+            row = self._rows.setdefault(scope, {})
+            for k, v in fields.items():
+                row[k] = row.get(k, 0) + v
+                self._totals[k] = self._totals.get(k, 0) + v
+
+    def snapshot(self):
+        with self._lock:
+            out = {k: dict(v) for k, v in self._rows.items()}
+            out["_totals"] = dict(self._totals)
+            return out
+
+
 class GuardedHelper:
     """The helper's accesses are guarded interprocedurally — every call
     path holds the lock, so nothing here is a deviant."""

@@ -16,21 +16,35 @@ class StepDone(CycloneEvent):
         self.step = step
 
 
+class UsageReport(CycloneEvent):
+    """Periodic ledger rollup (observe/attribution.UsageReporter): the
+    journal-side consumer REPLACE-folds it per host, so the literal must
+    reach a handler like any other event."""
+
+    def __init__(self, host="", rollup=None):
+        self.host = host
+        self.rollup = rollup or {}
+
+
 def on_event(e):
     kind = e.get("Event")
     if kind == "JobStart":
         return "job"
     if kind == "StepDone":
         return "step"
+    if kind == "UsageReport":
+        return "usage"
     return None
 
 
 def replay_filter(events):
     # journal filters dispatching on the same literals also count as
     # handlers — the name reaches a consumer either way
-    return [e for e in events if e.get("Event") in ("JobStart", "StepDone")]
+    return [e for e in events
+            if e.get("Event") in ("JobStart", "StepDone", "UsageReport")]
 
 
 def post_all(bus):
     bus.post(JobStart(job_id=1))
     bus.post(StepDone(step=2))
+    bus.post(UsageReport(host="h0", rollup={"_totals": {}}))

@@ -1,0 +1,376 @@
+"""Attribution-plane tests: scope stack, usage ledger, noop discipline,
+UsageReport journal replay, cross-host snapshot merge.
+
+The accounting contract (docs/observability.md "Attribution &
+accounting"): every charge lands on the scope row AND the totals row
+under one lock, so per-scope sums always match the global ledger;
+disabled hot paths pay one module-global read; cumulative UsageReport
+events make the rollup journal-replayable.
+"""
+
+import threading
+
+import pytest
+
+from cycloneml_tpu.observe import attribution
+from cycloneml_tpu.observe.attribution import (EVICTED, NOOP_WINDOW, TOTALS,
+                                               UNSCOPED, Scope, UsageLedger,
+                                               UsageReporter, merge_snapshots,
+                                               usage_delta)
+
+ADDITIVE = ("deviceSeconds", "flops", "bytesAccessed", "h2dBytes",
+            "dispatches", "requests", "servingSeconds", "sheds")
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_ledger():
+    """Module-global hygiene: no test leaks an installed ledger (or an
+    abandoned scope) into the next."""
+    attribution.disable()
+    assert attribution.current_scope() is None
+    yield
+    attribution.disable()
+    assert attribution.current_scope() is None
+
+
+def _sum_matches_totals(snap, fields=ADDITIVE, tol=0.01):
+    totals = snap[TOTALS]
+    for fld in fields:
+        want = totals.get(fld, 0)
+        got = sum(row.get(fld, 0) for key, row in snap.items()
+                  if key != TOTALS)
+        if want and abs(got - want) / want > tol:
+            return False
+    return True
+
+
+# -- scope stack -----------------------------------------------------------------
+
+def test_scope_nesting_innermost_wins_and_keys_namespace_tenants():
+    assert attribution.current_scope() is None
+    with attribution.scope("j1", tenant="acme") as outer:
+        assert attribution.current_scope() is outer
+        assert outer.key == "acme/j1"
+        with attribution.scope("j1", tenant="beta") as inner:
+            # same job name, different tenant: distinct ledger rows
+            assert inner.key == "beta/j1"
+            assert attribution.current_scope() is inner
+        assert attribution.current_scope() is outer
+    assert attribution.current_scope() is None
+    assert Scope("solo").key == "solo"  # tenantless keys stay bare
+
+
+def test_adopt_reenters_a_captured_scope_on_another_thread():
+    """The cross-thread leg: capture where work is SUBMITTED, adopt where
+    it RUNS (the ShardStream/batcher idiom)."""
+    with attribution.scope("xthread", tenant="t") as sc:
+        captured = attribution.current_scope()
+    seen = []
+
+    def worker():
+        assert attribution.current_scope() is None  # fresh thread-local
+        with attribution.adopt(captured):
+            seen.append(attribution.current_scope())
+        with attribution.adopt(None):  # None adopts nothing
+            seen.append(attribution.current_scope())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen == [sc, None]
+
+
+# -- the disabled / unscoped hot path --------------------------------------------
+
+def test_disabled_hot_path_is_one_shared_noop_window():
+    assert attribution.active() is None
+    # off: the shared singleton, even under a scope — no allocation
+    assert attribution.dispatch_window() is NOOP_WINDOW
+    with attribution.scope("ignored"):
+        assert attribution.dispatch_window() is NOOP_WINDOW
+    # charges fall on the floor without a ledger
+    attribution.charge(None, dispatches=1)
+    attribution.charge_model(None, "m", requests=1)
+    assert attribution.active() is None
+    assert NOOP_WINDOW.live is False
+    with NOOP_WINDOW as w:  # a usable no-op context manager
+        w.annotate_program("pid")
+
+
+def test_enabled_but_unscoped_dispatch_returns_noop_window():
+    attribution.enable()
+    try:
+        assert attribution.dispatch_window() is NOOP_WINDOW
+        with attribution.scope("sc"):
+            win = attribution.dispatch_window()
+            assert win is not NOOP_WINDOW and win.live
+    finally:
+        attribution.disable()
+
+
+def test_enable_is_idempotent():
+    led = attribution.enable()
+    assert attribution.enable() is led
+
+
+# -- window charging + costs join ------------------------------------------------
+
+def test_window_charges_device_seconds_and_joins_costs_registry():
+    from cycloneml_tpu.observe import costs
+    led = attribution.enable()
+    pid = "test-attribution-pid"
+    with costs._lock:
+        costs._registry[pid] = {"flops_total": 120.0,
+                                "bytes_accessed_total": 64.0,
+                                "peak_bytes": 4096}
+    try:
+        with attribution.scope("fit", tenant="acme"):
+            with attribution.dispatch_window() as win:
+                win.annotate_program(pid)
+        row = led.row("acme/fit")
+        assert row["dispatches"] == 1 and row["deviceSeconds"] > 0
+        assert row["flops"] == 120.0 and row["bytesAccessed"] == 64.0
+        assert row["hbmPeakBytes"] == 4096
+        # an unknown program id still charges time, just no cost join
+        with attribution.scope("fit", tenant="acme"):
+            with attribution.dispatch_window() as win:
+                win.annotate_program("no-such-pid")
+        row = led.row("acme/fit")
+        assert row["dispatches"] == 2 and row["flops"] == 120.0
+        assert _sum_matches_totals(led.snapshot())
+    finally:
+        with costs._lock:
+            costs._registry.pop(pid, None)
+        attribution.disable()
+
+
+# -- ledger semantics ------------------------------------------------------------
+
+def test_charge_lands_on_row_and_totals_atomically():
+    led = UsageLedger()
+    led.charge(Scope("a"), deviceSeconds=1.5, dispatches=2)
+    led.charge(Scope("b", tenant="t"), deviceSeconds=0.5, dispatches=1)
+    led.charge(None, reshapes=1)  # scope=None -> the UNSCOPED row
+    snap = led.snapshot()
+    assert snap["a"]["dispatches"] == 2
+    assert snap["t/b"]["tenant"] == "t"
+    assert snap[UNSCOPED]["reshapes"] == 1
+    assert snap[TOTALS]["deviceSeconds"] == pytest.approx(2.0)
+    assert snap[TOTALS]["dispatches"] == 3 and snap[TOTALS]["reshapes"] == 1
+    assert _sum_matches_totals(snap)
+
+
+def test_hbm_peak_merges_by_max_not_sum():
+    led = UsageLedger()
+    led.charge(Scope("a"), hbmPeakBytes=100)
+    led.charge(Scope("a"), hbmPeakBytes=40)   # lower: ignored
+    led.charge(Scope("b"), hbmPeakBytes=250)
+    snap = led.snapshot()
+    assert snap["a"]["hbmPeakBytes"] == 100
+    assert snap[TOTALS]["hbmPeakBytes"] == 250  # high-water mark, not 350
+
+
+def test_concurrent_charges_keep_the_sum_invariant():
+    """The 1% acceptance bar, exercised from 8 threads: the single-lock
+    both-sides charge means the invariant holds EXACTLY."""
+    led = UsageLedger()
+    n, per = 8, 200
+
+    def worker(i):
+        sc = Scope(f"job-{i % 4}", tenant=f"t{i % 2}")
+        for _ in range(per):
+            led.charge(sc, deviceSeconds=0.001, dispatches=1, flops=10.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = led.snapshot()
+    assert snap[TOTALS]["dispatches"] == n * per
+    scope_sum = sum(r["dispatches"] for k, r in snap.items() if k != TOTALS)
+    assert scope_sum == n * per
+    assert _sum_matches_totals(snap, tol=1e-9)
+
+
+def test_eviction_folds_into_evicted_row_preserving_sums():
+    led = UsageLedger(max_scopes=3)
+    for i in range(6):
+        led.charge(Scope(f"s{i}"), dispatches=1, deviceSeconds=1.0)
+    snap = led.snapshot()
+    assert led.scopes_evicted > 0 and EVICTED in snap
+    assert len([k for k in snap if k != TOTALS]) <= led.max_scopes + 1
+    # evicted work is folded, not lost: sums still match the totals row
+    assert snap[TOTALS]["dispatches"] == 6
+    assert _sum_matches_totals(snap, tol=1e-9)
+
+
+def test_charge_model_bounded_with_other_overflow():
+    led = UsageLedger(max_models=2)
+    sc = Scope("serve", tenant="beta")
+    for m in ("m0", "m1", "m2", "m3"):
+        led.charge_model(sc, m, requests=5)
+    row = led.row("beta/serve")
+    assert set(row["models"]) == {"m0", "m1", "(other)"}
+    assert row["models"]["(other)"]["requests"] == 10  # m2 + m3 folded
+    assert row["requests"] == 20  # the scope row still carries everything
+    assert led.totals()["requests"] == 20
+
+
+def test_row_returns_zero_row_for_unknown_key():
+    led = UsageLedger()
+    row = led.row("never-charged")
+    assert row["dispatches"] == 0 and row["models"] == {}
+    # the bracket-delta consumer: zero row before, real row after
+    led.charge(Scope("never-charged"), dispatches=3)
+    assert usage_delta(row, led.row("never-charged")) == {"dispatches": 3}
+
+
+def test_usage_delta_drops_zero_fields_and_keeps_peaks():
+    before = {"deviceSeconds": 1.0, "dispatches": 2, "hbmPeakBytes": 50,
+              "flops": 10.0, "scope": "a", "models": {}}
+    after = {"deviceSeconds": 1.5, "dispatches": 2, "hbmPeakBytes": 80,
+             "flops": 25.0, "scope": "a", "models": {}}
+    d = usage_delta(before, after)
+    assert d == {"deviceSeconds": 0.5, "flops": 15.0, "hbmPeakBytes": 80}
+
+
+# -- cross-host merge -------------------------------------------------------------
+
+def test_merge_snapshots_sums_rows_and_maxes_peaks_across_hosts():
+    h0, h1 = UsageLedger(), UsageLedger()
+    h0.charge(Scope("fit", tenant="acme"), deviceSeconds=1.0, dispatches=2,
+              hbmPeakBytes=100)
+    h1.charge(Scope("fit", tenant="acme"), deviceSeconds=0.5, dispatches=1,
+              hbmPeakBytes=300)
+    h1.charge(Scope("other"), dispatches=4)
+    merged = merge_snapshots([h0.snapshot(), h1.snapshot()])
+    assert merged["acme/fit"]["dispatches"] == 3
+    assert merged["acme/fit"]["deviceSeconds"] == pytest.approx(1.5)
+    assert merged["acme/fit"]["hbmPeakBytes"] == 300
+    assert merged["other"]["dispatches"] == 4
+    assert merged[TOTALS]["dispatches"] == 7
+    assert _sum_matches_totals(merged, tol=1e-9)
+    # hostile shapes (a torn wire payload) are skipped, not fatal
+    assert merge_snapshots([None, {"x": "not-a-row"}, h0.snapshot()])[
+        "acme/fit"]["dispatches"] == 2
+
+
+# -- UsageReport journal replay ---------------------------------------------------
+
+def _reported_store(events):
+    from cycloneml_tpu.util.status import AppStatusListener
+    listener = AppStatusListener()
+    for e in events:
+        listener.on_event(e if isinstance(e, dict) else e.to_json())
+    return listener.store
+
+
+def test_usage_report_replay_matches_live_rollup(tmp_path):
+    """History-server fidelity for the accounting plane: replay the
+    journal into a fresh store and usage_rollup() equals the live
+    ledger snapshot (UsageReport is cumulative + REPLACE-folded, so the
+    last surviving line is the whole state)."""
+    from cycloneml_tpu.util.events import EventJournal, ListenerBus
+    from cycloneml_tpu.util.status import AppStatusListener
+
+    led = attribution.enable()
+    try:
+        led.charge(Scope("fit", tenant="acme"), deviceSeconds=2.0,
+                   dispatches=3, flops=99.0)
+        led.charge_model(Scope("serve", tenant="beta"), "storm",
+                         requests=7, servingSeconds=0.25)
+
+        path = tmp_path / "usage.jsonl"
+        journal = EventJournal(str(path))
+        bus = ListenerBus()
+        live = AppStatusListener()
+        bus.add_listener(journal)
+        bus.add_listener(live)
+        rep = UsageReporter(bus, interval_s=60, host="h0")
+        rep.flush()           # intermediate cumulative report
+        led.charge(Scope("fit", tenant="acme"), dispatches=1)
+        rep.stop()            # final flush on stop
+        journal.close()
+
+        live_rollup = live.store.usage_rollup()
+        assert live_rollup["acme/fit"]["dispatches"] == 4
+        assert live_rollup["beta/serve"]["models"]["storm"]["requests"] == 7
+        assert live_rollup == led.snapshot()  # REPLACE-fold == cumulative
+
+        replayed = _reported_store(EventJournal.replay(str(path)))
+        assert replayed.usage_rollup() == live_rollup
+    finally:
+        attribution.disable()
+
+
+def test_usage_report_replay_tolerates_torn_tail(tmp_path):
+    """A process killed mid-write tears the LAST UsageReport line; replay
+    must fall back to the previous surviving report, not die or serve
+    nothing."""
+    from cycloneml_tpu.util.events import EventJournal, ListenerBus
+
+    led = attribution.enable()
+    try:
+        led.charge(Scope("fit"), dispatches=2)
+        path = tmp_path / "torn.jsonl"
+        journal = EventJournal(str(path))
+        bus = ListenerBus()
+        bus.add_listener(journal)
+        rep = UsageReporter(bus, interval_s=60, host="h0")
+        rep.flush()
+        led.charge(Scope("fit"), dispatches=5)
+        rep.flush()
+        journal.close()
+
+        lines = open(path, encoding="utf-8").read().splitlines()
+        torn = tmp_path / "torn2.jsonl"
+        torn.write_text("\n".join(lines[:-1]) + "\n"
+                        + lines[-1][: len(lines[-1]) // 2],
+                        encoding="utf-8")
+        replayed = _reported_store(EventJournal.replay(str(torn)))
+        rollup = replayed.usage_rollup()
+        # the surviving (earlier, cumulative) report still serves
+        assert rollup["fit"]["dispatches"] == 2
+        assert rollup[TOTALS]["dispatches"] == 2
+    finally:
+        attribution.disable()
+
+
+def test_usage_reports_fold_per_host_not_cumulatively_per_line():
+    """Two hosts' cumulative reports REPLACE per host and SUM across
+    hosts — posting the same host twice must not double-count."""
+    from cycloneml_tpu.util.events import UsageReport
+    snap_a1 = {"fit": {"scope": "fit", "tenant": "", "dispatches": 1},
+               TOTALS: {"scope": TOTALS, "tenant": "", "dispatches": 1}}
+    snap_a2 = {"fit": {"scope": "fit", "tenant": "", "dispatches": 5},
+               TOTALS: {"scope": TOTALS, "tenant": "", "dispatches": 5}}
+    snap_b = {"fit": {"scope": "fit", "tenant": "", "dispatches": 2},
+              TOTALS: {"scope": TOTALS, "tenant": "", "dispatches": 2}}
+    store = _reported_store([UsageReport(usage=snap_a1, host="a"),
+                             UsageReport(usage=snap_b, host="b"),
+                             UsageReport(usage=snap_a2, host="a")])
+    rollup = store.usage_rollup()
+    assert rollup["fit"]["dispatches"] == 7  # a's latest (5) + b (2)
+    assert rollup[TOTALS]["dispatches"] == 7
+
+
+def test_usage_reporter_stop_latch_blocks_late_posts():
+    """JX022 latch: flush() after stop() must not land on the bus."""
+    posted = []
+
+    class _Bus:
+        def post(self, ev):
+            posted.append(ev)
+
+    attribution.enable().charge(Scope("x"), dispatches=1)
+    try:
+        rep = UsageReporter(_Bus(), interval_s=60, host="h")
+        rep.stop()          # final flush posts exactly once
+        n = len(posted)
+        assert n == 1
+        rep.flush()         # latched: silently dropped
+        rep.stop()          # idempotent
+        assert len(posted) == n
+    finally:
+        attribution.disable()

@@ -464,3 +464,94 @@ def test_coordinator_port_race_auto_relaunch(cluster):
         assert out.read_text() == "ran on attempt"
     finally:
         blocker.close()
+
+
+# -- cross-host usage attribution (accounting-plane acceptance) ------------------
+
+def test_two_process_usage_merges_across_hosts(cluster):
+    """The accounting plane's cross-host leg: two deploy-harness procs
+    each meter scoped work into their own ledger; shipped span batches
+    carry cumulative snapshots; the master's collector REPLACE-folds per
+    host and merged_usage() sums per scope key — a scope charged on BOTH
+    procs rolls up, per-proc scopes keep their own rows, and the merged
+    totals row equals the sum of merged scope rows within 1%."""
+    from cycloneml_tpu.observe import attribution, tracing
+    from cycloneml_tpu.observe.attribution import TOTALS
+    from cycloneml_tpu.observe.collect import (TraceCollector,
+                                               clear_offset_samples)
+
+    m, workers, tmp_path = cluster
+    attribution.disable()
+    tracing.disable()
+    col = TraceCollector(host_label="master")  # becomes the active one:
+    # submit_app injects its address into the launch env automatically
+    app = tmp_path / "usage_app.py"
+    app.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import numpy as np
+        from cycloneml_tpu.conf import CycloneConf
+        from cycloneml_tpu.context import CycloneContext
+        from cycloneml_tpu.dataset.frame import MLFrame
+        from cycloneml_tpu.ml.classification import LogisticRegression
+        from cycloneml_tpu.observe import attribution
+
+        pid = os.environ.get("CYCLONE_PROC_ID", "0")
+        conf = (CycloneConf().set("cyclone.master", "local-mesh[2]")
+                .set("cyclone.worker.id", "proc" + pid)
+                .set("cyclone.usage.enabled", "true")
+                .set("cyclone.telemetry.collect.intervalMs", "100"))
+        ctx = CycloneContext(conf)
+        rng = np.random.RandomState(int(pid))
+        x = rng.randn(96, 4)
+        y = (x @ rng.randn(4) > 0).astype(float)
+        # one scope shared by BOTH procs (merges) + one per-proc scope
+        with attribution.scope("shared-fit", tenant="acme"):
+            LogisticRegression(maxIter=3, regParam=0.01, tol=0.0).fit(
+                MLFrame(ctx, {{"features": x, "label": y}}))
+        with attribution.scope("solo-" + pid):
+            LogisticRegression(maxIter=2, regParam=0.01, tol=0.0).fit(
+                MLFrame(ctx, {{"features": x, "label": y}}))
+        led = attribution.active()
+        assert led.row("acme/shared-fit")["dispatches"] >= 1
+        ctx.stop()   # final shipper flush carries the last snapshot
+        print("proc", pid, "done", flush=True)
+    """))
+    try:
+        app_id = submit_app(m.address, str(app), n_procs=2)
+        assert wait_for_app(m.address, app_id,
+                            timeout_s=240) == "FINISHED"
+        deadline = time.time() + 30
+        while True:
+            merged = col.merged_usage()
+            if {"solo-0", "solo-1", "acme/shared-fit"} <= set(merged):
+                break
+            assert time.time() < deadline, \
+                f"usage rows seen: {sorted(merged)}"
+            time.sleep(0.2)
+
+        shared = merged["acme/shared-fit"]
+        assert shared["tenant"] == "acme"
+        # both procs' fits landed on the one shared row: at least one
+        # dispatch each, and strictly more than either alone could charge
+        solo = [merged["solo-0"], merged["solo-1"]]
+        assert all(r["dispatches"] >= 1 for r in solo)
+        assert shared["dispatches"] >= 2
+        assert shared["deviceSeconds"] > 0 and shared["flops"] > 0
+        # the 1% acceptance bar on the MERGED ledger
+        totals = merged[TOTALS]
+        for fld in ("deviceSeconds", "dispatches", "flops",
+                    "bytesAccessed"):
+            want = totals.get(fld, 0)
+            got = sum(row.get(fld, 0) for key, row in merged.items()
+                      if key != TOTALS)
+            assert want > 0, f"{fld} never charged"
+            assert abs(got - want) / want <= 0.01, \
+                f"{fld}: scope rows sum {got} vs totals {want}"
+    finally:
+        col.stop()
+        clear_offset_samples()
+        attribution.disable()
+        tracing.disable()

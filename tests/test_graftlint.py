@@ -555,10 +555,12 @@ def test_cli_json_golden_jx011(capsys):
     flag = os.path.join(FIXTURES, "jx011_flag.py")
     assert graftlint_main([flag, "--rules", "JX011", "--json"]) == 1
     payload = json.loads(capsys.readouterr().out)
-    assert payload["count"] == 4        # racy_reset, racy_mean×2, size_racy
+    # racy_reset, racy_mean×2, size_racy, evict_racy, peek_racy
+    assert payload["count"] == 6
     assert {f["rule"] for f in payload["findings"]} == {"JX011"}
     assert {f["function"] for f in payload["findings"]} == {
-        "Tally.racy_reset", "Tally.racy_mean", "Pipeline.size_racy"}
+        "Tally.racy_reset", "Tally.racy_mean", "Pipeline.size_racy",
+        "RacyRollup.evict_racy", "RacyRollup.peek_racy"}
     assert {f["line"] for f in payload["findings"]} \
         == marker_lines(flag, "JX011")
     for f in payload["findings"]:

@@ -137,6 +137,61 @@ def test_prometheus_text_skips_non_finite_and_emits_types():
     assert "# TYPE" not in legacy and "cyclone_lat_mean 0.5" in legacy
 
 
+def test_prometheus_text_labeled_series():
+    """Names carrying a `{k="v"}` suffix (the attribution ledger's
+    per-scope gauges) render canonical labeled series: values re-escaped,
+    labeled + unlabeled series of one base name under ONE # TYPE line."""
+    values = {'usage.deviceSeconds{scope="acme/fit",tenant="acme"}': 1.5,
+              'usage.deviceSeconds{scope="solo"}': 0.5,
+              "usage.deviceSeconds": 2.0}
+    text = prometheus_text(values, types={"usage.deviceSeconds": "gauge"})
+    assert text.count("# TYPE cyclone_usage_deviceSeconds gauge") == 1
+    assert ('cyclone_usage_deviceSeconds'
+            '{scope="acme/fit",tenant="acme"} 1.5') in text
+    assert 'cyclone_usage_deviceSeconds{scope="solo"} 0.5' in text
+    assert "\ncyclone_usage_deviceSeconds 2.0" in text  # unlabeled sibling
+
+
+def test_prometheus_text_escapes_hostile_label_values():
+    """Quotes/backslashes in a scope key must not break the exposition
+    line; hostile label KEYS sanitize to metric-name charset; an
+    outright malformed label block flattens into the metric name rather
+    than emitting broken exposition."""
+    values = {'usage.requests{scope="a\\"b\\\\c",bad.key="v"}': 3}
+    text = prometheus_text(values, types={"usage.requests": "counter"})
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("cyclone_usage_requests{")][0]
+    assert 'scope="a\\"b\\\\c"' in line
+    assert 'bad_key="v"' in line and "bad.key" not in line
+    assert line.endswith(" 3")
+    mangled = prometheus_text({'usage.requests{scope=unquoted}': 1})
+    assert "{" not in mangled  # flattened, never half-parsed
+
+
+def test_ledger_gauges_register_and_unregister_with_scope_rows():
+    """The attribution ledger's per-scope gauge surface: a new scope row
+    registers labeled gauges reading live ledger values; eviction
+    unregisters the victim's family so the registry stays bounded."""
+    from cycloneml_tpu.observe.attribution import Scope, UsageLedger
+    reg = MetricsRegistry()
+    led = UsageLedger(max_scopes=2, registry=reg)
+    led.charge(Scope("j1", tenant="acme"), deviceSeconds=1.25, requests=2)
+    vals = reg.values()
+    key = 'usage.deviceSeconds{scope="acme/j1",tenant="acme"}'
+    assert vals[key] == 1.25
+    assert vals['usage.requests{scope="acme/j1",tenant="acme"}'] == 2
+    # the gauge is a live read, not a snapshot
+    led.charge(Scope("j1", tenant="acme"), deviceSeconds=0.75)
+    assert reg.values()[key] == 2.0
+    # evicting acme/j1 (bound 2: j2 + j3 push it out) drops its gauges
+    led.charge(Scope("j2"), requests=1)
+    led.charge(Scope("j3"), requests=1)
+    assert key not in reg.values()
+    # and the whole surface exports cleanly through the text format
+    text = prometheus_text(reg.values(), types=reg.types())
+    assert 'cyclone_usage_requests{scope="j3"} 1.0' in text
+
+
 def test_registry_types():
     reg = MetricsRegistry()
     reg.counter("c")

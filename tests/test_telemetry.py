@@ -132,6 +132,101 @@ def test_shipper_buffers_and_drops_bounded_when_collector_away():
     assert ship.dropped >= 100 - 16
 
 
+def test_drop_counters_reach_status_store_and_rest(monkeypatch):
+    """Telemetry drop-counter surface (accounting plane satellite): tracer
+    ring overflow, shipper delivery loss and collector ingest drops roll
+    into ONE TelemetryStatsUpdated payload that the status store folds by
+    replacement and /api/v1/telemetry serves."""
+    from cycloneml_tpu.observe import collect
+    from cycloneml_tpu.observe.attribution import UsageReporter
+    from cycloneml_tpu.util.events import ListenerBus
+    from cycloneml_tpu.util.status import AppStatusListener, api_v1
+
+    # tracer ring overflow: visible without exporting a trace
+    tr = tracing.Tracer(max_spans=8)
+    for i in range(32):
+        tr.instant("burst", i=i)
+    assert tr.spans_dropped > 0
+
+    # shipper delivery loss: collector away, bounded buffer overflows
+    ship = SpanShipper("127.0.0.1:9", "w0", interval_s=0.02,
+                       max_batch=8, max_buffer=16, tracer=tr)
+    try:
+        deadline = time.time() + 10
+        while True:
+            d = ship.delivery_stats()
+            # ringMissed: the 24 pre-shipper evictions the cursor never
+            # saw; bufferDropped: overflow of the bounded ship buffer
+            if d["bufferDropped"] > 0 and d["ringMissed"] > 0:
+                break
+            assert time.time() < deadline, f"no delivery loss counted: {d}"
+            for i in range(8):
+                tr.instant("more", i=i)
+            time.sleep(0.02)
+    finally:
+        ship.stop(flush=False)
+    dstats = ship.delivery_stats()
+    assert dstats["bufferDropped"] > 0 and dstats["buffered"] <= 16
+
+    # collector ingest drops: per-host bound exceeded counts evictions,
+    # and the worker's self-reported delivery loss is tracked apart
+    monkeypatch.setattr(collect, "MAX_SPANS_PER_HOST", 4)
+    col = TraceCollector(host_label="primary")
+    try:
+        wire = [{"id": f"s{i}", "parent": "", "kind": "dispatch",
+                 "name": f"n{i}", "t0": float(i), "t1": float(i) + 0.5,
+                 "tid": 1, "attrs": {}} for i in range(10)]
+        reply = col._ingest({"kind": "spans", "host": "w0", "pid": 1,
+                             "trace_id": "t", "dropped": 5, "spans": wire})
+        assert reply["ok"] and reply["received"] == 10
+        istats = col.ingest_stats()
+        assert istats["ingestDropped"] == 6      # 10 past a bound of 4
+        assert istats["shipDropped"] == 5        # worker-reported, apart
+        assert istats["batches"] == 1 and istats["hosts"] == 1
+
+        # one rollup payload -> bus -> status store -> REST route
+        def stats_fn():
+            return {"spansDropped": int(tr.spans_dropped),
+                    "shipper": ship.delivery_stats(),
+                    "collector": col.ingest_stats()}
+
+        listener = AppStatusListener()
+        bus = ListenerBus()
+        bus.add_listener(listener)
+        rep = UsageReporter(bus, interval_s=60, host="primary",
+                            telemetry_fn=stats_fn)
+        rep.stop()  # final flush posts the rollup
+        served = api_v1(listener.store, "telemetry")
+        assert served == listener.store.telemetry_stats()
+        assert served["spansDropped"] == tr.spans_dropped
+        assert served["shipper"]["bufferDropped"] > 0
+        assert served["collector"]["ingestDropped"] == 6
+    finally:
+        col.stop()
+
+
+def test_collector_replace_folds_cumulative_usage_per_host():
+    """Shipped ledger snapshots are CUMULATIVE: re-ingesting the same
+    host must REPLACE its usage, never double-count, and merged_usage
+    sums across distinct hosts only."""
+    from cycloneml_tpu.observe.attribution import TOTALS
+
+    def _snap(n):
+        return {"fit": {"scope": "fit", "tenant": "", "dispatches": n},
+                TOTALS: {"scope": TOTALS, "tenant": "", "dispatches": n}}
+
+    col = TraceCollector(host_label="primary")
+    try:
+        for host, n in (("w0", 1), ("w1", 2), ("w0", 4)):
+            col._ingest({"kind": "spans", "host": host, "pid": 1,
+                         "trace_id": "t", "spans": [], "usage": _snap(n)})
+        merged = col.merged_usage()
+        assert merged["fit"]["dispatches"] == 6      # w0 latest (4) + w1 (2)
+        assert merged[TOTALS]["dispatches"] == 6
+    finally:
+        col.stop()
+
+
 # -- heartbeat-fed clock offset --------------------------------------------------
 
 def test_extended_heartbeat_feeds_offset_samples_and_trace_id():
