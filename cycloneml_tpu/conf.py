@@ -762,6 +762,41 @@ OOCORE_DIR = (
     .str_conf("")
 )
 
+OOCORE_STREAM_DTYPE = (
+    ConfigBuilder("cyclone.oocore.streamDtype")
+    .doc("Storage dtype for out-of-core shards — the PRECISION RUNG of the "
+         "host→device stream (docs/out-of-core.md 'Precision rungs'). "
+         "'auto' (default) follows cyclone.data.dtype, including the fp8 "
+         "tiers: under auto8/float8 the spill-time envelope probe "
+         "(instance.fp8_probe_ok over the write-pass moments) decides "
+         "fp8-vs-bf16 per shard SET — one geometry, one program — with "
+         "the bf16 fallback surfaced as a PrecisionFallback event. "
+         "'bfloat16' pins the bf16 rung; 'float8' requests e4m3 codes + "
+         "per-column scales whenever the probe allows (the probe still "
+         "gates — codes that would break the documented envelope fall "
+         "back visibly, never silently).")
+    .check_value(lambda v: v in ("auto", "bfloat16", "float8"),
+                 "must be auto, bfloat16 or float8")
+    .mutable()
+    .str_conf("auto")
+)
+
+OOCORE_CACHE_BYTES = (
+    ConfigBuilder("cyclone.oocore.cacheBytes")
+    .doc("Byte bound for the shard-set reuse cache (oocore/cache.py): "
+         "spilled shard sets are keyed by content hash (source dataset "
+         "identity + stream tier + pad geometry), so CV folds, "
+         "TrainValidationSplit and warm-start re-fits ATTACH to the "
+         "existing spill instead of re-blocking and re-writing it — the "
+         "second fit re-streams 0 spill-write bytes. LRU-evicted past the "
+         "bound; live streams pin their entries (refcount), and every "
+         "attach is integrity-checked per shard (sha256 — a corrupt entry "
+         "is evicted and rebuilt, chaos-covered). 0 disables reuse.")
+    .check_value(lambda v: v >= 0, "must be >= 0")
+    .mutable()
+    .int_conf(1 << 30)
+)
+
 TRACE_ENABLED = (
     ConfigBuilder("cyclone.trace.enabled")
     .doc("Enable step-level tracing (observe/): hierarchical spans over "

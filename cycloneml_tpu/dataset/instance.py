@@ -121,7 +121,7 @@ def is_fp8_dtype(dt) -> bool:
         return False
 
 
-def quantize_fp8(x: np.ndarray, dtype=None):
+def quantize_fp8(x: np.ndarray, dtype=None, scale: Optional[np.ndarray] = None):
     """Quantize a host design matrix to fp8 with PER-COLUMN scales.
 
     Returns ``(x8, scale, probe_ratio)`` where ``x8[i, j] ~=
@@ -137,6 +137,13 @@ def quantize_fp8(x: np.ndarray, dtype=None):
     the replicated (d,) vectors every consumer already carries —
     ``inv_std`` for the scaled aggregators, the kernel-side ``scale``
     operand for gramian/kmeans — so HBM only ever sees the 1-byte codes.
+
+    Pass ``scale`` to quantize against an EXTERNALLY fixed per-column
+    scale — the out-of-core shard store requantizes every shard with ONE
+    set-level scale (one geometry, one dequant fold, one program per
+    epoch), so the per-block absmax must not win. Codes beyond the
+    provided scale's range would overflow to NaN (e4m3fn has no inf), so
+    a set-level scale must dominate every block's absmax.
     """
     import ml_dtypes
     if dtype is None:
@@ -148,7 +155,10 @@ def quantize_fp8(x: np.ndarray, dtype=None):
     else:
         absmax = np.zeros(xf.shape[1])
         std = np.zeros(xf.shape[1])
-    scale = np.where(absmax > 0, absmax / FP8_MAX, 1.0)
+    if scale is None:
+        scale = np.where(absmax > 0, absmax / FP8_MAX, 1.0)
+    else:
+        scale = np.asarray(scale, dtype=np.float64)
     probe_ratio = np.where(std > 0, absmax / np.where(std > 0, std, 1.0),
                            0.0)
     x8 = (xf / scale[None, :]).astype(dtype)

@@ -261,6 +261,19 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
         ds = frame.to_instance_dataset(
             self.get("featuresCol"), self.get("labelCol"),
             self.get("weightCol") or None, fp8_capable=True)
+        # streamed stacked fits: ONE double-buffered epoch serves all K
+        # models (the K-model grid/OvR fit reads the spill once per
+        # optimizer round instead of K times)
+        from cycloneml_tpu.oocore import (StreamingDataset, shard_dataset,
+                                          streaming_mode)
+        if isinstance(ds, StreamingDataset):
+            return self._fit_stacked_streamed(ds, y_stack, reg_params)
+        if streaming_mode(getattr(ds.ctx, "conf", None)) == "force":
+            sds = shard_dataset(ds)
+            try:
+                return self._fit_stacked_streamed(sds, y_stack, reg_params)
+            finally:
+                sds.close()
         if y_stack is None and reg_params is None:
             raise ValueError("fit_stacked needs y_stack or reg_params")
         if y_stack is None:
@@ -393,6 +406,157 @@ class LogisticRegression(Predictor, _LogisticRegressionParams,
                 total_evals=int(res.evals[kk]),
                 total_dispatches=loss_fn.n_dispatches,
                 n_models=n_models)
+            models.append(model)
+        return models
+
+    def _fit_stacked_streamed(self, sds, y_stack=None, reg_params=None):
+        """The out-of-core leg of :meth:`fit_stacked`: K binomial models
+        over ONE shard set, each optimizer round ONE streamed epoch whose
+        per-shard program is the vmapped scaled aggregator
+        (``StackedStreamingLossFunction``) — so the spill is read once
+        per round, not once per model. The optimizer is
+        :class:`StackedHostLBFGS`: K serial L-BFGS coroutines whose
+        pending trial points batch into each epoch, every model making
+        exactly the decisions its serial streamed fit would (the parity
+        test pins rtol 1e-9 under the f64 config)."""
+        import jax.numpy as jnp
+
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        from cycloneml_tpu.ml.optim.device_lbfgs import StackedHostLBFGS
+        from cycloneml_tpu.ml.optim.loss import (inv_std_vector,
+                                                 stacked_l2_scale,
+                                                 validate_binary_labels)
+        from cycloneml_tpu.oocore import StackedStreamingLossFunction
+
+        if y_stack is None and reg_params is None:
+            raise ValueError("fit_stacked needs y_stack or reg_params")
+        d = sds.n_features
+        stats = sds.summary()   # write-pass moments: no stats epoch
+        weight_sum = stats.weight_sum
+        # the fp8 decision already ran at spill time (the
+        # materialization-time envelope probe in shards._finalize_fp8);
+        # the dequant scale folds into inv_std exactly like in-core
+        fp8_scale = getattr(sds, "x_scale", None)
+
+        if y_stack is None:
+            # tiled grid fit over the shard set's own labels: binary-ness
+            # comes from the write-pass histogram, positives from the
+            # label moments — zero label epochs
+            hist = sds.label_histogram()
+            if len(hist) > 2:
+                raise ValueError(
+                    f"fit_stacked requires binary {{0, 1}} labels; the "
+                    f"shard set carries {len(hist)} classes")
+            n_models = len(reg_params)
+            pos = np.full(n_models, sds.y_moments()[0])
+        else:
+            y_stack = np.asarray(y_stack)
+            n_models = y_stack.shape[0]
+            if y_stack.shape[1] != sds.n_rows:
+                raise ValueError(
+                    f"y_stack has {y_stack.shape[1]} rows per model; the "
+                    f"shard set has {sds.n_rows}")
+            for kk in range(n_models):
+                validate_binary_labels(
+                    np.asarray(y_stack[kk], dtype=np.float64),
+                    "fit_stacked")
+            # per-model weighted positive mass from the shards' w members
+            # only (npz members load lazily: the packed X bytes stay on
+            # disk) — one O(n) host vector, matching the caller's own
+            # O(K·n) stack
+            w_all = np.concatenate([
+                np.asarray(np.load(s.path)["w"], dtype=np.float64)
+                for s in sds._shards])
+            pos = np.array([
+                np.asarray(y_stack[kk], dtype=np.float64) @ w_all
+                for kk in range(n_models)])
+        reg = self.get("regParam")
+        if reg_params is None:
+            reg_params = np.full(n_models, float(reg))
+        reg_params = np.asarray(reg_params, dtype=np.float64)
+        if len(reg_params) != n_models:
+            raise ValueError("reg_params length != number of stacked models")
+
+        features_std = stats.std
+        fit_intercept = self.get("fitIntercept")
+        standardize = self.get("standardization")
+        fit_with_mean = fit_intercept  # bounds are excluded by eligibility
+        inv_std = inv_std_vector(features_std)
+        scaled_mean = stats.mean * inv_std if fit_with_mean else np.zeros(d)
+        inv_std_agg = inv_std * fp8_scale if fp8_scale is not None \
+            else inv_std
+
+        n_coef = d + (1 if fit_intercept else 0)
+        x0 = np.zeros((n_models, n_coef))
+        if fit_intercept:
+            ok = (pos > 0) & (pos < weight_sum)
+            p1 = np.where(ok, pos / weight_sum, 0.5)
+            x0[:, d] = np.where(ok, np.log(p1 / (1.0 - p1)), 0.0)
+
+        from cycloneml_tpu.ops.kernels import use_fused_kernels
+        base_agg = (aggregators.binary_logistic_pallas_scaled(d,
+                                                              fit_intercept)
+                    if use_fused_kernels(sds.ctx)
+                    else aggregators.binary_logistic_scaled(d, fit_intercept))
+        agg = aggregators.stack_scaled_aggregator(base_agg)
+        l2s = stacked_l2_scale(d, n_coef, features_std, standardize)
+        adt = compute_dtype()
+        # the staged (rows, K) label stack: {0, 1} is exact in bf16, and
+        # f64 under the x64 parity config keeps streamed-vs-serial
+        # summation identical; never fp8 — labels mix with f32 margins
+        if adt is np.float64:
+            ydt = np.float64
+        else:
+            import ml_dtypes
+            ydt = ml_dtypes.bfloat16
+        loss_fn = StackedStreamingLossFunction(
+            sds, agg, n_models, reg=reg_params, l2_scale=l2s,
+            weight_sum=weight_sum,
+            extra_args=(jnp.asarray(inv_std_agg.astype(adt)),
+                        jnp.asarray(scaled_mean.astype(adt))),
+            y_stack=y_stack, y_dtype=ydt)
+
+        opt = StackedHostLBFGS(max_iter=self.get("maxIter"),
+                               tol=self.get("tol"))
+        res = opt.minimize(loss_fn, x0)
+        if fp8_scale is not None \
+                and not np.all(np.isfinite(np.asarray(res.x))):
+            # e4m3 has no inf: overflow surfaces as NaN — re-spill the
+            # shard set at the bf16 rung (PrecisionFallback event) and
+            # refit
+            bf16 = sds.to_instance_dataset(fp8_capable=False)
+            try:
+                return self._fit_stacked_streamed(
+                    bf16, y_stack=y_stack, reg_params=reg_params)
+            finally:
+                bf16.close()
+        n_unconverged = sum(
+            1 for r in res.converged_reasons if r == "max iterations reached")
+        if n_unconverged:
+            logger.warning(
+                "stacked LogisticRegression (streamed): %d of %d models did "
+                "not converge in %d iterations", n_unconverged, n_models,
+                self.get("maxIter"))
+
+        models = []
+        for kk in range(n_models):
+            sol = res.x[kk]
+            beta = sol[:d] * inv_std
+            icpt = float(sol[d]) if fit_intercept else 0.0
+            if fit_with_mean:
+                icpt -= float(sol[:d] @ scaled_mean)
+            model = LogisticRegressionModel(
+                coefficient_matrix=beta[None, :],
+                intercept_vector=np.array([icpt]),
+                num_classes=2, is_multinomial=False)
+            self._copy_values(model)
+            model._set_parent(self)
+            model.summary = LogisticRegressionTrainingSummary(
+                objective_history=list(res.loss_histories[kk]),
+                total_iterations=int(res.iterations[kk]),
+                total_evals=int(res.evals[kk]),
+                total_dispatches=loss_fn.n_dispatches,
+                n_models=n_models, streamed=True)
             models.append(model)
         return models
 

@@ -793,3 +793,189 @@ class StackedDeviceLBFGS:
             converged_reasons=reasons,
             loss_histories=histories,
             evals=evals_total)
+
+
+# -- streamed stacked L-BFGS: K host optimizers, one epoch per round ----------
+
+def _phi_eval(x, direction, alpha):
+    """One φ(α) evaluation as a sub-generator: yields the trial point,
+    receives ``(value, grad)`` from the driver's batched evaluation."""
+    v, g = yield x + alpha * direction
+    g = np.asarray(g, dtype=np.float64)
+    return float(v), g, float(np.dot(direction, g))
+
+
+def _zoom_gen(x, direction, value, d_dot_g0, lo, hi, v_lo, d_lo, v_hi,
+              c1, c2, max_evals):
+    # lbfgs._strong_wolfe's zoom, verbatim, with phi as a yield point
+    best = None
+    for _ in range(max_evals):
+        alpha = 0.5 * (lo + hi)
+        v, g, dg = yield from _phi_eval(x, direction, alpha)
+        if v > value + c1 * alpha * d_dot_g0 or v >= v_lo:
+            hi, v_hi = alpha, v
+        else:
+            if abs(dg) <= -c2 * d_dot_g0:
+                return alpha, v, g
+            if dg * (hi - lo) >= 0:
+                hi, v_hi = lo, v_lo
+            lo, v_lo, d_lo = alpha, v, dg
+        best = (alpha, v, g)
+        if abs(hi - lo) < 1e-12:
+            break
+    return best
+
+
+def _strong_wolfe_gen(x, value, grad, direction, init_alpha,
+                      c1=1e-4, c2=0.9, max_evals=30):
+    """Generator twin of ``lbfgs._strong_wolfe`` (bracket + bisection
+    zoom, identical branch structure and constants) with every φ(α)
+    evaluation a ``yield`` — so K concurrent searches can be serviced by
+    ONE batched objective evaluation per round. There is deliberately no
+    fused device path here: the streamed objective has none (each eval
+    is an epoch), which is exactly why the searches batch across models
+    instead."""
+    d_dot_g0 = float(np.dot(direction, grad))
+    if d_dot_g0 >= 0:
+        raise ValueError("direction is not a descent direction")
+    alpha_prev, v_prev, d_prev = 0.0, value, d_dot_g0
+    alpha = init_alpha
+    for i in range(max_evals):
+        v, g, dg = yield from _phi_eval(x, direction, alpha)
+        if v > value + c1 * alpha * d_dot_g0 or (i > 0 and v >= v_prev):
+            out = yield from _zoom_gen(x, direction, value, d_dot_g0,
+                                       alpha_prev, alpha, v_prev, d_prev, v,
+                                       c1, c2, max_evals)
+            if out is None:
+                break
+            return out
+        if abs(dg) <= -c2 * d_dot_g0:
+            return alpha, v, g
+        if dg >= 0:
+            out = yield from _zoom_gen(x, direction, value, d_dot_g0,
+                                       alpha, alpha_prev, v, dg, v_prev,
+                                       c1, c2, max_evals)
+            if out is None:
+                break
+            return out
+        alpha_prev, v_prev, d_prev = alpha, v, dg
+        alpha *= 2.0
+    v, g, _ = yield from _phi_eval(x, direction, alpha)
+    return alpha, v, g
+
+
+def _lbfgs_gen(x0, max_iter, m, tol, grad_tol, c1, c2, max_ls):
+    """One model's L-BFGS as a coroutine: mirrors ``lbfgs.LBFGS``
+    decision-for-decision (curvature condition, two-loop direction,
+    init-alpha rule, non-descent reset-and-retry, Breeze convergence
+    tests in the same precedence), with every loss/grad evaluation a
+    ``yield x`` answered by ``send((value, grad))``. Identical (v, g)
+    replies therefore reproduce the serial trajectory bit-for-bit —
+    the streamed-stacked parity test pins exactly this. Returns
+    ``(x, value, iterations, reason, loss_history)`` via StopIteration."""
+    from cycloneml_tpu.ml.optim.lbfgs import _History
+    x = np.asarray(x0, dtype=np.float64).copy()
+    v, g = yield x
+    value = float(v)
+    grad = np.asarray(g, dtype=np.float64)
+    loss_history = [value]
+    hist = _History(m)
+    iteration = 0
+    while True:
+        d = hist.direction(grad)
+        init_alpha = 1.0 if iteration > 0 else \
+            min(1.0, 1.0 / max(float(np.linalg.norm(grad)), 1e-12))
+        try:
+            alpha, v_new, g_new = yield from _strong_wolfe_gen(
+                x, value, grad, d, init_alpha, c1, c2, max_ls)
+        except ValueError:
+            hist = _History(m)  # reset on non-descent (Breeze retries)
+            d = -grad
+            alpha, v_new, g_new = yield from _strong_wolfe_gen(
+                x, value, grad, d,
+                min(1.0, 1.0 / max(float(np.linalg.norm(grad)), 1e-12)),
+                c1, c2, max_ls)
+        x_new = x + alpha * d
+        g_new = np.asarray(g_new, dtype=np.float64)
+        hist.update(x_new - x, g_new - grad)
+        f_old = value
+        x, value, grad = x_new, float(v_new), g_new
+        iteration += 1
+        loss_history.append(value)
+        # LBFGS._converged, same precedence: budget, then value, then grad
+        if iteration >= max_iter:
+            return x, value, iteration, "max iterations reached", \
+                loss_history
+        denom = max(abs(value), abs(f_old), 1e-6)
+        if abs(f_old - value) <= tol * denom:
+            return x, value, iteration, "function value converged", \
+                loss_history
+        gnorm = float(np.linalg.norm(grad))
+        if gnorm <= grad_tol * max(float(np.linalg.norm(x)), 1.0):
+            return x, value, iteration, "gradient converged", loss_history
+
+
+class StackedHostLBFGS:
+    """Host-driven L-BFGS over a stack of K models whose objective is
+    EXPENSIVE per evaluation and cheap per model — the streamed regime,
+    where one evaluation is a whole double-buffered epoch.
+
+    K serial optimizers run as coroutines (:func:`_lbfgs_gen`); each
+    round stacks their pending trial points into one ``(K, n)`` matrix
+    and makes ONE call to the stacked objective
+    (``StackedStreamingLossFunction`` — one epoch serves every model),
+    then feeds each model its row back. A converged model's slot keeps
+    repeating its terminal point (vmapped programs take no ragged axis;
+    the replies are ignored), so total epochs = max over models of that
+    model's serial eval count, not the sum — the per-model epoch cost
+    drops ~K× for homogeneous grids. Device-chunked state never appears:
+    unlike :class:`StackedDeviceLBFGS` this driver is pure host float64,
+    which is what lets it ride an objective that is itself a host fold.
+    """
+
+    def __init__(self, max_iter: int = 100, m: int = 10, tol: float = 1e-6,
+                 grad_tol: Optional[float] = None, c1: float = 1e-4,
+                 c2: float = 0.9, max_ls: int = 30):
+        self.max_iter = max_iter
+        self.m = m
+        self.tol = tol
+        self.grad_tol = grad_tol if grad_tol is not None else tol
+        self.c1, self.c2, self.max_ls = c1, c2, max_ls
+
+    def minimize(self, f, x0: np.ndarray) -> StackedOptimResult:
+        """``f`` maps a ``(K, n)`` stack to ``((K,), (K, n))`` host-f64
+        loss/grad (the ``StackedStreamingLossFunction`` contract)."""
+        x0 = np.asarray(x0, dtype=np.float64)
+        K, n = x0.shape
+        gens = [_lbfgs_gen(x0[kk], self.max_iter, self.m, self.tol,
+                           self.grad_tol, self.c1, self.c2, self.max_ls)
+                for kk in range(K)]
+        pending = np.zeros((K, n))
+        done: List[Optional[tuple]] = [None] * K
+        evals = np.zeros(K, dtype=np.int64)
+        for kk, gen in enumerate(gens):
+            pending[kk] = next(gen)  # prime: first yield is the start point
+        rounds = 0
+        while any(d is None for d in done):
+            with tracing.span("dispatch", "lbfgs.stacked_host",
+                              n_models=K, round=rounds,
+                              live=sum(d is None for d in done)):
+                L, G = f(pending)
+            rounds += 1
+            for kk, gen in enumerate(gens):
+                if done[kk] is not None:
+                    continue  # frozen slot: reply ignored
+                evals[kk] += 1
+                try:
+                    pending[kk] = gen.send(
+                        (float(L[kk]), np.asarray(G[kk], dtype=np.float64)))
+                except StopIteration as fin:
+                    done[kk] = fin.value
+                    pending[kk] = fin.value[0]  # terminal point rides along
+        return StackedOptimResult(
+            x=np.stack([d[0] for d in done]),
+            values=np.asarray([d[1] for d in done], dtype=np.float64),
+            iterations=np.asarray([d[2] for d in done], dtype=np.int64),
+            converged_reasons=[d[3] for d in done],
+            loss_histories=[list(d[4]) for d in done],
+            evals=evals)

@@ -215,6 +215,22 @@ def stacked_l2_scale(d: int, n_coef: int,
     return scale
 
 
+def stacked_host_l2(loss: np.ndarray, grad: np.ndarray,
+                    coef_stack: np.ndarray, reg: np.ndarray,
+                    l2_scale: Optional[np.ndarray]):
+    """Apply the per-model L2 penalty to a stacked host-f64 (loss, grad)
+    pair: ``loss_k += 0.5·reg_k·Σ_j coef_kj²·scale_j``. Runtime data, not
+    program structure — one compiled stacked program serves every reg
+    vector. Shared by the in-core stacked loss and its streamed twin so
+    their penalties are bit-identical for the parity suites."""
+    if l2_scale is None or not np.any(reg > 0):
+        return loss, grad
+    cs = np.asarray(coef_stack, dtype=np.float64)
+    loss = loss + 0.5 * reg * np.sum(cs * cs * l2_scale[None, :], axis=1)
+    grad = grad + reg[:, None] * cs * l2_scale[None, :]
+    return loss, grad
+
+
 class StackedDistributedLossFunction:
     """Model-axis (vmapped) twin of :class:`DistributedLossFunction`.
 
@@ -274,11 +290,8 @@ class StackedDistributedLossFunction:
                 tsp.annotate_bytes(out)
         loss = np.asarray(out["loss"], dtype=np.float64) / self.weight_sum
         grad = np.asarray(out["grad"], dtype=np.float64) / self.weight_sum
-        if self.l2_scale is not None and np.any(self.reg > 0):
-            cs = np.asarray(coef_stack, dtype=np.float64)
-            loss = loss + 0.5 * self.reg * np.sum(
-                cs * cs * self.l2_scale[None, :], axis=1)
-            grad = grad + self.reg[:, None] * cs * self.l2_scale[None, :]
+        loss, grad = stacked_host_l2(loss, grad, coef_stack, self.reg,
+                                     self.l2_scale)
         if hasattr(self._ctx, "record_step"):
             # one batched gradient evaluation ≈ one stage over all K models
             self._ctx.record_step({"loss": float(np.mean(loss)),

@@ -56,15 +56,20 @@ _MAX_FIELDS = frozenset(("hbmPeakBytes",))
 #: per-scope gauge surface: ledger fields exported as labeled Prometheus
 #: gauges when a registry is attached (bounded by the ledger bound)
 _GAUGE_FIELDS = ("deviceSeconds", "flops", "bytesAccessed", "hbmPeakBytes",
-                 "h2dBytes", "requests", "sheds")
+                 "h2dBytes", "requests", "sheds", "cacheHits")
 
 
 def _zero_row(key: str, tenant: str) -> Dict[str, Any]:
+    # h2dBytes is charged from the staged host arrays' OWN nbytes (see
+    # oocore/stream.ShardStream._stage), so narrow tiers bill at their
+    # true itemsize — an fp8 shard charges 1 byte/element, never the
+    # bf16 width it replaced. cacheHits counts shard-set cache attaches
+    # (oocore/cache.py): a hit re-streams zero spill-write bytes.
     return {"scope": key, "tenant": tenant,
             "deviceSeconds": 0.0, "dispatches": 0,
             "flops": 0.0, "bytesAccessed": 0.0, "hbmPeakBytes": 0,
             "h2dBytes": 0, "requests": 0, "rows": 0,
-            "servingSeconds": 0.0, "sheds": 0,
+            "servingSeconds": 0.0, "sheds": 0, "cacheHits": 0,
             "reshapes": 0, "recoveries": 0, "autoscaleActions": 0,
             "models": {}}
 

@@ -21,15 +21,18 @@ transfer failure.
 """
 
 from cycloneml_tpu.observe.costs import OutOfCoreRequired
+from cycloneml_tpu.oocore.cache import ShardSetCache, shard_set_cache
 from cycloneml_tpu.oocore.engine import (StreamingGradientDescent,
                                          degrade_allowed, shard_dataset,
                                          streaming_mode)
-from cycloneml_tpu.oocore.objective import StreamingLossFunction
+from cycloneml_tpu.oocore.objective import (StackedStreamingLossFunction,
+                                            StreamingLossFunction)
 from cycloneml_tpu.oocore.shards import StreamingDataset
 from cycloneml_tpu.oocore.stream import ShardStream
 
 __all__ = [
     "StreamingDataset", "ShardStream", "StreamingLossFunction",
-    "StreamingGradientDescent", "OutOfCoreRequired", "shard_dataset",
-    "streaming_mode", "degrade_allowed",
+    "StackedStreamingLossFunction", "StreamingGradientDescent",
+    "OutOfCoreRequired", "shard_dataset", "streaming_mode",
+    "degrade_allowed", "ShardSetCache", "shard_set_cache",
 ]

@@ -49,9 +49,14 @@ def shard_dataset(ds, shard_rows: Optional[int] = None,
                   spill_dir: Optional[str] = None) -> StreamingDataset:
     """Spill an in-core dataset to an out-of-core shard set (the degrade
     path's bridge; bounded per-shard staging — see
-    :meth:`StreamingDataset.from_dataset`)."""
-    return StreamingDataset.from_dataset(ds, shard_rows=shard_rows,
-                                         spill_dir=spill_dir)
+    :meth:`StreamingDataset.from_dataset`). Routed through the
+    content-hash shard-set cache: a CV fold or warm-start re-fit over the
+    same dataset ATTACHES to the existing spill — 0 spill-write bytes —
+    instead of re-blocking it (``cyclone.oocore.cacheBytes=0`` restores
+    the direct build-and-own path)."""
+    from cycloneml_tpu.oocore.cache import shard_set_cache
+    return shard_set_cache().attach(ds, shard_rows=shard_rows,
+                                    spill_dir=spill_dir)
 
 
 class StreamingGradientDescent:
@@ -165,3 +170,108 @@ class StreamingGradientDescent:
                         t)
                     break
         return w, history
+
+    def optimize_stacked(self, sds: StreamingDataset, agg: Callable,
+                         x0: np.ndarray,
+                         y_stack: Optional[np.ndarray] = None
+                         ) -> Tuple[np.ndarray, list]:
+        """Model-axis twin of :meth:`optimize` — the streamed analog of
+        ``StackedGradientDescent``: ``x0`` is ``(K, n)``, each step is ONE
+        double-buffered epoch whose per-shard program is the vmapped
+        aggregator, so K models ride every staged shard. ``y_stack``
+        (``(K, n)``, optional) supplies per-model labels (OvR
+        relabelings); without it every model sees the shard's own labels
+        (grid fits). Per-model convergence masks freeze early-converged
+        models exactly where their serial streamed run would stop, while
+        the epochs keep serving the rest. Returns ``(weights (K, n),
+        histories)``."""
+        import jax
+        import jax.numpy as jnp
+
+        from cycloneml_tpu.mesh import DATA_AXIS, REPLICA_AXIS
+        from cycloneml_tpu.ml.optim import aggregators
+        from cycloneml_tpu.observe import tracing
+        from cycloneml_tpu.oocore.objective import \
+            StackedStreamingLossFunction
+
+        frac = self.mini_batch_fraction
+        seed = self.seed
+        shuffle = self.shuffle
+        if shuffle is None:
+            from cycloneml_tpu.conf import OOCORE_SHUFFLE
+            conf = getattr(sds.ctx, "conf", None)
+            shuffle = bool(conf.get(OOCORE_SHUFFLE)) \
+                if conf is not None else False
+
+        def epoch_order(step: int):
+            if not shuffle:
+                return None
+            return np.random.RandomState(
+                (seed * 1000003 + step) % (2 ** 32)).permutation(
+                    sds.n_shards)
+
+        W = np.asarray(x0, dtype=np.float64).copy()
+        n_models = W.shape[0]
+        stacked = aggregators.stack_aggregator(agg)
+
+        if frac < 1.0:
+            def fn(x, y, w, coef, step, shard):
+                # the row mask is drawn ONCE and shared across the model
+                # axis (keyed on the TRUE shard index — shuffle- and
+                # stack-invariant): each model sees the same sample
+                # sequence its serial streamed run would
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+                key = jax.random.fold_in(key, shard)
+                key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(REPLICA_AXIS))
+                w = w * (jax.random.uniform(key, w.shape) < frac)
+                return stacked(x, y, w, coef)
+            loss_fn = StackedStreamingLossFunction(
+                sds, fn, n_models, y_stack=y_stack)
+        else:
+            loss_fn = StackedStreamingLossFunction(
+                sds, stacked, n_models, y_stack=y_stack)
+
+        histories: list = [[] for _ in range(n_models)]
+        regs = np.zeros(n_models)
+        for kk in range(n_models):
+            _, regs[kk] = self.updater.compute(
+                W[kk], np.zeros_like(W[kk]), 0.0, 1, self.reg_param)
+        live = np.ones(n_models, dtype=bool)
+        updates = np.zeros(n_models, dtype=np.int64)
+        for t in range(1, self.num_iterations + 1):
+            if not live.any():
+                break
+            with tracing.span("dispatch", "gd.step", evals=1, streamed=True,
+                              n_models=n_models):
+                if frac < 1.0:
+                    out = loss_fn.sweep(
+                        jnp.asarray(W, jnp.float32),
+                        jnp.asarray(t, jnp.int32),
+                        per_shard=lambda i: (jnp.asarray(i, jnp.int32),),
+                        order=epoch_order(t))
+                else:
+                    out = loss_fn.sweep(jnp.asarray(W, jnp.float32),
+                                        order=epoch_order(t))
+            count = np.asarray(out["count"], dtype=np.float64)
+            if float(count.max()) <= 0:
+                continue  # empty mini-batch: no model updates
+            loss = np.asarray(out["loss"], dtype=np.float64) / count
+            grad = np.asarray(out["grad"], dtype=np.float64) / count[:, None]
+            for kk in np.nonzero(live)[0]:
+                histories[kk].append(loss[kk] + regs[kk])
+                prev = W[kk].copy()
+                W[kk], regs[kk] = self.updater.compute(
+                    W[kk], grad[kk], self.step_size, t, self.reg_param)
+                updates[kk] += 1
+                if self.convergence_tol > 0 and updates[kk] > 1:
+                    delta = float(np.linalg.norm(W[kk] - prev))
+                    if delta < self.convergence_tol * max(
+                            float(np.linalg.norm(prev)), 1.0):
+                        live[kk] = False
+                        logger.info(
+                            "StreamingGradientDescent: model %d converged "
+                            "at iteration %d (%d/%d still live)", kk, t,
+                            int(live.sum()), n_models)
+        return W, histories

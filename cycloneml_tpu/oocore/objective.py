@@ -156,9 +156,12 @@ class StreamingLossFunction:
         very HBM the streamed fit bounds; ``concrete=True`` is the
         fallback for jax versions whose structs cannot carry sharding."""
         import jax
-        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
+        from cycloneml_tpu.dataset.instance import compute_dtype
         sds = self._sds
-        xdt = np.dtype(data_dtype(getattr(sds.ctx, "conf", None)))
+        # the ACTUAL stream dtype: fp8 shard sets stage 1-byte codes, and
+        # the cost model must bill them at that width (bench-bytes gates
+        # the fp8 stream at < 0.55x the bf16 stream)
+        xdt = np.dtype(getattr(sds, "x_dtype", np.float64))
         adt = np.dtype(compute_dtype())
         rt = sds.ctx.mesh_runtime
         if concrete:
@@ -189,3 +192,164 @@ class StreamingLossFunction:
                 self._prog, self._shard_avals(n_coef, concrete=True),
                 self._sds.n_shards)
         return cost
+
+
+class _StackedShardView:
+    """StreamingDataset facade carrying the per-shard ``(rows, K)`` label
+    stack, built host-side at stage time — the stacked streamed fit never
+    materializes the whole ``(n, K)`` matrix anywhere: each shard's stack
+    is O(shard · K), staged once, donated like every other shard operand.
+
+    Two label sources, mirroring the in-core ``fit_stacked`` inputs:
+
+    - :meth:`tiled` — the shard's own labels broadcast across K models
+      (CV grids: same data, K reg strengths);
+    - :meth:`from_stack` — column slices of a caller ``(K, n)`` stack in
+      shard row order (OneVsRest relabelings; ``from_chunks`` preserves
+      row order, so shard offsets index the stack directly).
+    """
+
+    def __init__(self, sds, n_models: int, y_fn, y_dtype):
+        self._sds = sds
+        self.n_models = int(n_models)
+        self._y_fn = y_fn
+        self.y_dtype = np.dtype(y_dtype)
+
+    @classmethod
+    def tiled(cls, sds, n_models: int, y_dtype) -> "_StackedShardView":
+        ydt = np.dtype(y_dtype)
+
+        def y_fn(i, y):
+            y = np.asarray(y, dtype=ydt)
+            return np.ascontiguousarray(
+                np.broadcast_to(y[:, None], (len(y), n_models)))
+
+        return cls(sds, n_models, y_fn, ydt)
+
+    @classmethod
+    def from_stack(cls, sds, y_stack: np.ndarray,
+                   y_dtype) -> "_StackedShardView":
+        ydt = np.dtype(y_dtype)
+        offsets = np.cumsum([0] + [s.rows for s in sds._shards])
+        if y_stack.shape[1] != sds.n_rows:
+            raise ValueError(
+                f"y_stack has {y_stack.shape[1]} rows per model; the "
+                f"shard set has {sds.n_rows}")
+
+        def y_fn(i, y):
+            lo, hi = offsets[i], offsets[i + 1]
+            return np.ascontiguousarray(
+                np.asarray(y_stack[:, lo:hi]).T.astype(ydt))
+
+        return cls(sds, len(y_stack), y_fn, ydt)
+
+    # -- delegated surface (what ShardStream + the objective touch) -----------
+    @property
+    def ctx(self):
+        return self._sds.ctx
+
+    @property
+    def n_shards(self) -> int:
+        return self._sds.n_shards
+
+    @property
+    def n_rows(self) -> int:
+        return self._sds.n_rows
+
+    @property
+    def n_features(self) -> int:
+        return self._sds.n_features
+
+    @property
+    def pad_rows(self) -> int:
+        return self._sds.pad_rows
+
+    @property
+    def weight_sum(self) -> float:
+        return self._sds.weight_sum
+
+    @property
+    def x_dtype(self):
+        return getattr(self._sds, "x_dtype", np.dtype(np.float64))
+
+    @property
+    def x_scale(self):
+        return getattr(self._sds, "x_scale", None)
+
+    def load_shard(self, i: int):
+        x, y, w = self._sds.load_shard(i)
+        return x, self._y_fn(i, y), w
+
+
+class StackedStreamingLossFunction(StreamingLossFunction):
+    """Model-axis twin of :class:`StreamingLossFunction` — the streamed
+    analog of ``loss.StackedDistributedLossFunction``.
+
+    Callable ``(coef_stack (K, n_coef)) -> (loss (K,), grad (K, n_coef))``
+    in host float64; one evaluation is ONE double-buffered epoch whose
+    per-shard program is the vmapped stacked aggregator — every staged
+    shard serves all K models, so a K-model grid/OvR fit over spilled
+    data reads the data once per iteration instead of K times. Per-model
+    L2 is host-side runtime data (``stacked_host_l2`` — shared with the
+    in-core stacked loss, so penalties are bit-identical).
+    """
+
+    def __init__(self, sds, agg, n_models: int,
+                 reg: Optional[np.ndarray] = None,
+                 l2_scale: Optional[np.ndarray] = None,
+                 weight_sum: Optional[float] = None,
+                 extra_args: tuple = (), y_stack: Optional[np.ndarray] = None,
+                 y_dtype=None):
+        if y_dtype is None:
+            from cycloneml_tpu.dataset.instance import compute_dtype
+            y_dtype = compute_dtype()
+        view = (_StackedShardView.tiled(sds, n_models, y_dtype)
+                if y_stack is None
+                else _StackedShardView.from_stack(sds, y_stack, y_dtype))
+        super().__init__(view, agg, l2_reg_fn=None, weight_sum=weight_sum,
+                         extra_args=extra_args)
+        self.n_models = int(n_models)
+        self.reg = (np.zeros(self.n_models) if reg is None
+                    else np.asarray(reg, dtype=np.float64))
+        self.l2_scale = (None if l2_scale is None
+                         else np.asarray(l2_scale, dtype=np.float64))
+
+    def __call__(self, coef_stack: np.ndarray):
+        from cycloneml_tpu.ml.optim.loss import stacked_host_l2
+        self.n_evals += 1
+        out = self.sweep(*self._extras, np.asarray(coef_stack))
+        loss = np.asarray(out["loss"], dtype=np.float64) / self.weight_sum
+        grad = np.asarray(out["grad"], dtype=np.float64) / self.weight_sum
+        loss, grad = stacked_host_l2(loss, grad, coef_stack, self.reg,
+                                     self.l2_scale)
+        if hasattr(self._ctx, "record_step"):
+            # one streamed epoch serves all K models
+            self._ctx.record_step({"loss": float(np.mean(loss)),
+                                   "n_models": self.n_models,
+                                   "oocore_shards": self._sds.n_shards})
+        return loss, grad
+
+    def _shard_avals(self, n_coef: int, concrete: bool = False) -> tuple:
+        import jax
+        from cycloneml_tpu.dataset.instance import compute_dtype
+        view = self._sds
+        xdt = np.dtype(view.x_dtype)
+        ydt = np.dtype(view.y_dtype)
+        adt = np.dtype(compute_dtype())
+        rt = view.ctx.mesh_runtime
+        K = self.n_models
+        if concrete:
+            x = rt.device_put_sharded_rows(
+                np.zeros((view.pad_rows, view.n_features), dtype=xdt))
+            y = rt.device_put_sharded_rows(
+                np.zeros((view.pad_rows, K), dtype=ydt))
+            w = rt.device_put_sharded_rows(np.zeros(view.pad_rows, dtype=adt))
+        else:
+            x = jax.ShapeDtypeStruct((view.pad_rows, view.n_features), xdt,
+                                     sharding=rt.data_sharding(1))
+            y = jax.ShapeDtypeStruct((view.pad_rows, K), ydt,
+                                     sharding=rt.data_sharding(1))
+            w = jax.ShapeDtypeStruct((view.pad_rows,), adt,
+                                     sharding=rt.data_sharding(0))
+        return (x, y, w, *self._extras,
+                np.zeros((K, n_coef), dtype=np.float64))

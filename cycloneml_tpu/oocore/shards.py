@@ -53,6 +53,8 @@ class _Moments:
     cnt: float = 0.0
     s1y: float = 0.0
     s2y: float = 0.0
+    w_max: float = 0.0
+    abs_all: np.ndarray = None
     histogram: Optional[np.ndarray] = None
     integral_labels: bool = True
 
@@ -63,6 +65,7 @@ class _Moments:
         self.nnz = np.zeros(self.d)
         self.mx = np.full(self.d, -np.inf)
         self.mn = np.full(self.d, np.inf)
+        self.abs_all = np.zeros(self.d)
         self.histogram = np.zeros(0)
 
     def update(self, x: np.ndarray, y: np.ndarray, w: np.ndarray) -> None:
@@ -85,8 +88,17 @@ class _Moments:
             self.nnz += (xp != 0).sum(axis=0)
             self.mx = np.maximum(self.mx, xp.max(axis=0))
             self.mn = np.minimum(self.mn, xp.min(axis=0))
+        if x64.shape[0]:
+            # ALL-row absmax (zero-weight rows included): the fp8 set
+            # scale must dominate every stored value — an out-of-range
+            # code is NaN, and 0 · NaN would still poison the psum
+            self.abs_all = np.maximum(self.abs_all, np.abs(x64).max(axis=0))
         self.s1y += float((w64 * y64).sum())
         self.s2y += float((w64 * y64 * y64).sum())
+        if w64.size:
+            # max instance weight feeds the fp8 envelope probe's
+            # multiplier-overflow heuristic (instance.fp8_probe_ok)
+            self.w_max = max(self.w_max, float(w64.max()))
         if self.integral_labels:
             yp = y64[present]
             if yp.size and (np.any(yp != np.round(yp)) or yp.min() < 0
@@ -121,7 +133,8 @@ class StreamingDataset:
 
     def __init__(self, ctx, shards: List[_Shard], n_features: int,
                  pad_rows: int, moments: _Moments, spill_dir: str,
-                 owns_dir: bool):
+                 owns_dir: bool, x_dtype=None,
+                 x_scale: Optional[np.ndarray] = None):
         self.ctx = ctx
         self._shards = shards
         self.n_features = int(n_features)
@@ -130,6 +143,14 @@ class StreamingDataset:
         self._moments = moments
         self._dir = spill_dir
         self._owns_dir = owns_dir
+        # the STREAM dtype: what load_shard/ShardStream stage (fp8 shard
+        # sets stage 1-byte e4m3 codes); per-column dequant scale rides
+        # alongside, folded into the aggregator read as in-core fp8 fits do
+        self.x_dtype = np.dtype(x_dtype) if x_dtype is not None \
+            else np.dtype(np.float64)
+        self.x_scale: Optional[np.ndarray] = (
+            np.asarray(x_scale, dtype=np.float64)
+            if x_scale is not None else None)
         self._closed = False
         self._close_lock = threading.Lock()
 
@@ -137,16 +158,37 @@ class StreamingDataset:
     @classmethod
     def from_chunks(cls, ctx, chunks: Iterable, n_features: int,
                     shard_rows: Optional[int] = None,
-                    spill_dir: Optional[str] = None) -> "StreamingDataset":
+                    spill_dir: Optional[str] = None,
+                    stream_dtype: Optional[str] = None,
+                    x_scale: Optional[np.ndarray] = None
+                    ) -> "StreamingDataset":
         """Build from an iterator of ``(x, y_or_None, w_or_None)`` host
         chunks — the ``dataset/io.py`` chunked-reader contract — WITHOUT
         ever holding more than one shard of rows host-side. Chunks are
         re-blocked to ``cyclone.oocore.shardRows`` boundaries; X is cast to
-        the data tier before it is written (bf16 shards carry half the
-        bytes of f32, so the host→device stream — the out-of-core fit's
-        bandwidth bill — is halved too, docs/mixed-precision.md)."""
+        the stream tier before it is written (bf16 shards carry half the
+        bytes of f32, fp8 shards half again, so the host→device stream —
+        the out-of-core fit's bandwidth bill — halves per rung,
+        docs/mixed-precision.md).
+
+        ``stream_dtype`` overrides ``cyclone.oocore.streamDtype`` for this
+        build. When the resolved rung is fp8, the write pass stays one
+        rung wider (the set-level absmax is unknown mid-stream) and a
+        FINALIZE pass requantizes every shard with ONE set-level
+        per-column scale — decided by the materialization-time envelope
+        probe over the write-pass moments, per shard SET, not per shard:
+        one geometry, one dequant fold, one compiled program per epoch.
+        A probe refusal stays at the wider rung, surfaced as a
+        ``PrecisionFallback`` event — automatic and visible, never silent.
+
+        ``x_scale`` is the PRE-QUANTIZED spill contract
+        (:meth:`from_dataset` over an fp8 in-core dataset): chunks carry
+        e4m3 codes whose real value is ``code * x_scale``; they are
+        written through unchanged, the moments are harvested from the
+        dequantized VIEW (fit statistics are about values, not codes),
+        and the probe is skipped — the in-core rail already ran it."""
         from cycloneml_tpu.conf import OOCORE_DIR, OOCORE_SHARD_ROWS
-        from cycloneml_tpu.dataset.instance import compute_dtype, data_dtype
+        from cycloneml_tpu.dataset.instance import compute_dtype
         conf = getattr(ctx, "conf", None)
         if shard_rows is None:
             shard_rows = int(conf.get(OOCORE_SHARD_ROWS)) if conf is not None \
@@ -160,7 +202,13 @@ class StreamingDataset:
             prefix="oocore-", dir=base or None)
         os.makedirs(spill_dir, exist_ok=True)
 
-        xdt = np.dtype(data_dtype(conf))
+        if x_scale is not None:
+            import ml_dtypes
+            xdt = np.dtype(ml_dtypes.float8_e4m3fn)
+            fp8_candidate = False
+            x_scale = np.asarray(x_scale, dtype=np.float64)
+        else:
+            xdt, fp8_candidate = _resolve_stream_dtype(conf, stream_dtype)
         ydt = np.dtype(compute_dtype())
         moments = _Moments(int(n_features))
         shards: List[_Shard] = []
@@ -179,6 +227,9 @@ class StreamingDataset:
             x_packed, x_dtype = _npz_pack(xs)
             np.savez(path, x=x_packed, x_dtype=x_dtype, y=ys, w=ws)
             shards.append(_Shard(path, rows))
+            if x_scale is not None:
+                # codes are not values: stats come from the dequant view
+                xs = np.asarray(xs, dtype=np.float64) * x_scale[None, :]
             moments.update(xs, ys, ws)
 
         for ci, (cx, cy, cw) in enumerate(chunks):
@@ -211,8 +262,11 @@ class StreamingDataset:
             raise ValueError("empty chunk stream: nothing to shard")
 
         pad_rows = _pad_geometry(ctx, max(s.rows for s in shards))
-        return cls(ctx, shards, n_features, pad_rows, moments, spill_dir,
-                   owns_dir)
+        sds = cls(ctx, shards, n_features, pad_rows, moments, spill_dir,
+                  owns_dir, x_dtype=xdt, x_scale=x_scale)
+        if fp8_candidate:
+            _finalize_fp8(sds)
+        return sds
 
     @classmethod
     def from_dataset(cls, ds, shard_rows: Optional[int] = None,
@@ -222,19 +276,26 @@ class StreamingDataset:
         fit PROGRAM whose predicted peak HBM does not). Rows are pulled in
         bounded per-shard slices — O(shard) host staging, the graftlint
         JX018 pass idiom — with interleaved padding rows dropped via the
-        dataset's own valid mask."""
+        dataset's own valid mask.
+
+        An fp8 in-core dataset spills its 1-byte e4m3 CODES directly,
+        carrying the per-column dequant scale onto the shard set — the
+        in-core envelope probe already admitted this data to the fp8
+        rung, so the stream keeps it (and keeps the halved byte bill).
+        Only a ``streamDtype=bfloat16`` pin forces the codes back up,
+        visibly (``PrecisionFallback``)."""
         from cycloneml_tpu.conf import OOCORE_SHARD_ROWS
         conf = getattr(ds.ctx, "conf", None)
-        if getattr(ds, "x_scale", None) is not None:
-            # the streaming engine shards at the bf16 rung: the per-shard
-            # slices below read ds.x as VALUES, and fp8 e4m3 codes are
-            # not values — spilling them unscaled would train a silently
-            # per-column-mis-scaled model. Leave the fp8 tier visibly
-            # (PrecisionFallback event) before sharding.
+        x_scale = getattr(ds, "x_scale", None)
+        if x_scale is not None and _stream_intent(conf) == "bfloat16":
+            # the stream is PINNED to the bf16 rung: the codes must leave
+            # the fp8 tier before sharding — visibly, never silently
             from cycloneml_tpu.dataset.dataset import fp8_fallback
             ds = fp8_fallback(
                 ds, "StreamingDataset.from_dataset",
-                "the streaming engine shards at the bf16 rung")
+                "cyclone.oocore.streamDtype=bfloat16 pins the stream to "
+                "the bf16 rung")
+            x_scale = None
         if shard_rows is None:
             shard_rows = int(conf.get(OOCORE_SHARD_ROWS)) if conf is not None \
                 else 65536
@@ -262,7 +323,8 @@ class StreamingDataset:
                     yield xs, ys, ws
 
         return cls.from_chunks(ds.ctx, chunks(), ds.n_features,
-                               shard_rows=shard_rows, spill_dir=spill_dir)
+                               shard_rows=shard_rows, spill_dir=spill_dir,
+                               x_scale=x_scale)
 
     # -- InstanceDataset-shaped surface ---------------------------------------
     @property
@@ -278,8 +340,28 @@ class StreamingDataset:
                             fp8_capable: bool = False) -> "StreamingDataset":
         """Estimator bridge parity with :class:`InstanceDataset`: a
         StreamingDataset is already placed (on disk); column/dtype
-        concepts (including the fp8 opt-in — shards stay at the bf16
-        rung) do not apply."""
+        concepts do not apply. The fp8 opt-in DOES: an fp8 shard set
+        handed to a consumer that has not declared quantized-storage
+        capability re-spills at the bf16 rung (PrecisionFallback event) —
+        an estimator that would read raw e4m3 codes as values must never
+        see them, the same contract as ``instance.data_dtype``."""
+        if self.x_scale is not None and not fp8_capable:
+            _precision_fallback_event(
+                self.ctx, "StreamingDataset.to_instance_dataset",
+                "the consumer is not fp8-capable: e4m3 codes would be "
+                "read as values", str(self.x_dtype), "bfloat16")
+            scale = self.x_scale
+
+            def chunks():
+                for i in range(self.n_shards):
+                    x, y, w = self.load_shard(i)
+                    yield (np.asarray(x, dtype=np.float64) * scale[None, :],
+                           y, w)
+
+            return StreamingDataset.from_chunks(
+                self.ctx, chunks(), self.n_features,
+                shard_rows=max(s.rows for s in self._shards),
+                stream_dtype="bfloat16")
         return self
 
     # -- one-pass statistics ---------------------------------------------------
@@ -374,3 +456,117 @@ def _pad_geometry(ctx, max_shard_rows: int) -> int:
     rt = ctx.mesh_runtime
     unit = 8 * int(rt.data_parallelism)
     return ((max(int(max_shard_rows), 1) + unit - 1) // unit) * unit
+
+
+def _stream_intent(conf, override: Optional[str] = None) -> str:
+    """The configured stream rung: 'auto' | 'bfloat16' | 'float8'."""
+    if override is not None:
+        return str(override)
+    if conf is None:
+        return "auto"
+    from cycloneml_tpu.conf import OOCORE_STREAM_DTYPE
+    return str(conf.get(OOCORE_STREAM_DTYPE))
+
+
+def _resolve_stream_dtype(conf, override: Optional[str] = None):
+    """Resolve ``cyclone.oocore.streamDtype`` to ``(write_dtype,
+    fp8_candidate)`` for a fresh spill. ``write_dtype`` is what the WRITE
+    pass stores — one rung wider than fp8 when fp8 is the candidate,
+    because the set-level scale does not exist until every row has passed
+    through the moments; the finalize pass requantizes (or refuses, per
+    the envelope probe). 'auto' follows ``cyclone.data.dtype`` including
+    its fp8 tiers — the stream is an fp8-capable consumer: the dequant
+    scale folds into the aggregator read exactly as the in-core fit's."""
+    from cycloneml_tpu.dataset.instance import (compute_dtype, data_dtype,
+                                                is_fp8_dtype)
+    intent = _stream_intent(conf, override)
+    if intent == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16), False
+    if intent == "float8":
+        fp8 = True
+    else:  # auto: follow the data tier, fp8-capable
+        fp8 = is_fp8_dtype(data_dtype(conf, fp8_capable=True))
+        if not fp8:
+            return np.dtype(data_dtype(conf)), False
+    # fp8 candidate: write one rung wider (f64 under the x64 parity
+    # config so requantization sees pre-tier values, bf16 otherwise)
+    if compute_dtype() is np.float64:
+        return np.dtype(np.float64), fp8
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16), fp8
+
+
+def _finalize_fp8(sds: StreamingDataset) -> None:
+    """The materialization-time envelope probe + set-level requantize.
+
+    Decides fp8-vs-bf16 for the shard SET, not per shard: ONE per-column
+    scale (``absmax / FP8_MAX`` from the write-pass moments) serves every
+    shard, so one geometry and one compiled program serve the epoch and
+    the dequant fold is a single replicated (d,) vector — exactly the
+    in-core fp8 fit's arrangement. The probe runs on the same write-pass
+    moments (``instance.fp8_probe_ok``: scale-spread + multiplier
+    overflow, zero extra data passes); a refusal keeps the shards at the
+    write rung and posts ``PrecisionFallback`` — automatic and visible.
+    On success each shard is rewritten in place, one shard resident at a
+    time (O(shard) host peak, the JX018 bound)."""
+    from cycloneml_tpu.dataset.dataset import _npz_pack
+    from cycloneml_tpu.dataset.instance import (FP8_MAX, fp8_probe_ok,
+                                                quantize_fp8)
+    m = sds._moments
+    absmax = np.maximum(np.abs(m.mx), np.abs(m.mn))
+    absmax = np.where(np.isfinite(absmax), absmax, 0.0)
+    stats = sds.summary()
+    std = np.sqrt(np.asarray(stats.variance, dtype=np.float64))
+    probe_ratio = np.where(std > 0, absmax / np.where(std > 0, std, 1.0),
+                           0.0)
+    reason = fp8_probe_ok(stats, w_max=m.w_max or None,
+                          probe_ratio=probe_ratio)
+    if reason is not None:
+        _precision_fallback_event(
+            sds.ctx, "StreamingDataset", reason, "float8_e4m3fn",
+            str(sds.x_dtype))
+        return
+    scale = np.where(m.abs_all > 0, m.abs_all / FP8_MAX, 1.0)
+    # re-harvest the moments from the DEQUANTIZED view in the same pass:
+    # fit statistics must describe the values the fit will actually read
+    # (codes ∘ scale), exactly as the in-core Summarizer sees a quantized
+    # dataset — write-rung stats would hand the optimizer a subtly
+    # different standardization than the data it streams
+    requant = _Moments(sds.n_features)
+    for i, s in enumerate(sds._shards):
+        x, y, w = sds.load_shard(i)
+        x8, _, _ = quantize_fp8(x, scale=scale)
+        x_packed, x_dtype = _npz_pack(x8)
+        np.savez(s.path, x=x_packed, x_dtype=x_dtype, y=y, w=w)
+        requant.update(np.asarray(x8, dtype=np.float64) * scale[None, :],
+                       y, w)
+    sds._moments = requant
+    sds.x_scale = scale
+    sds.x_dtype = np.dtype(x8.dtype)
+    logger.info(
+        "oocore: shard set requantized to float8_e4m3fn (%d shards, "
+        "set-level per-column scale)", sds.n_shards)
+
+
+def _precision_fallback_event(ctx, estimator: str, reason: str,
+                              from_dtype: str, to_dtype: str) -> None:
+    """Surface a streaming-tier precision decision the way the in-core
+    ``dataset.fp8_fallback`` does — warning log, ``precision.fallback``
+    tracing instant (the ``FitProfile.fp8_fallbacks`` counter), and a
+    ``PrecisionFallback`` event on the context bus — without requiring an
+    :class:`InstanceDataset` to dequantize."""
+    from cycloneml_tpu.observe import tracing
+    logger.warning("%s: falling back from %s to %s storage — %s",
+                   estimator, from_dtype, to_dtype, reason)
+    tracing.instant("precision.fallback", estimator=estimator,
+                    reason=reason, from_dtype=from_dtype)
+    bus = getattr(ctx, "listener_bus", None)
+    if bus is not None:
+        from cycloneml_tpu.util.events import PrecisionFallback
+        try:
+            bus.post(PrecisionFallback(estimator=estimator,
+                                       from_dtype=from_dtype,
+                                       to_dtype=to_dtype, reason=reason))
+        except Exception:
+            pass  # a stopped bus must not fail the fit

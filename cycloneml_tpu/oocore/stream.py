@@ -137,10 +137,13 @@ class ShardStream:
         if pad:
             # fresh padded blocks per shard (zero-weight tail rows,
             # masked out of every psum) — a reused staging buffer could
-            # still be read by an in-flight transfer
+            # still be read by an in-flight transfer. y may be 2-D (the
+            # stacked (rows, K) label matrix): pad rows, keep the model
+            # axis
             x = np.concatenate(
                 [x, np.zeros((pad, x.shape[1]), dtype=x.dtype)])
-            y = np.concatenate([y, np.zeros(pad, dtype=y.dtype)])
+            y = np.concatenate(
+                [y, np.zeros((pad,) + y.shape[1:], dtype=y.dtype)])
             w = np.concatenate([w, np.zeros(pad, dtype=w.dtype)])
         return x, y, w, m
 

@@ -14,7 +14,13 @@ regression test read), not inferred from dtype widths.
    line and exit non-zero unless the bf16 sweep accesses < 60% of the
    fp32 sweep's bytes (the ISSUE-6 acceptance threshold) AND the fp8
    sweep < 45% (the ISSUE-14 regression gate; the measured value at the
-   default shape is ~0.35).
+   default shape is ~0.35),
+4. the STREAMED leg (ISSUE-19): spill the same problem at the bf16 and
+   fp8 stream rungs and measure one epoch's ACTUAL staged host→device
+   bytes from the ``oocore.stage`` transfer spans — the fp8 stream must
+   move < 55% of the bf16 stream's bytes (1-byte e4m3 codes vs 2-byte
+   bf16, y/w at the accumulator tier in both; the measured value at the
+   default shape is ~0.51).
 
 Run via ``make bench-bytes``. Shapes default to n=4096, d=256 (wide
 enough that X dominates the (n,)-vector temporaries); override with
@@ -37,6 +43,40 @@ import numpy as np  # noqa: E402
 
 THRESHOLD = 0.60
 THRESHOLD_FP8 = 0.45
+THRESHOLD_FP8_STREAM = 0.55
+
+
+def staged_epoch_bytes(ctx, x, y, stream_dtype: str) -> int:
+    """One streamed epoch's measured host→device bytes at ``stream_dtype``
+    — summed from the ``oocore.stage`` transfer spans, i.e. the bytes the
+    staging thread actually moved (padded geometry, y/w included), not a
+    dtype-width inference."""
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.observe import tracing
+    from cycloneml_tpu.oocore import StreamingDataset, StreamingLossFunction
+
+    d = x.shape[1]
+
+    def chunks():
+        for lo in range(0, len(x), 1024):
+            yield x[lo:lo + 1024], y[lo:lo + 1024], None
+
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d, shard_rows=1024,
+                                       stream_dtype=stream_dtype)
+    try:
+        f = StreamingLossFunction(
+            sds, aggregators.binary_logistic(d, fit_intercept=False))
+        tr = tracing.enable()
+        mark = tr.mark()
+        f.sweep(jnp.zeros(d, jnp.float32))
+        spans = tr.snapshot(since=mark)
+        tracing.disable()
+        return sum(s.attrs.get("bytes", 0) for s in spans
+                   if s.name == "oocore.stage"), str(sds.x_dtype)
+    finally:
+        sds.close()
 
 
 def sweep_bytes(ctx, x, y, tier: str):
@@ -81,6 +121,9 @@ def main() -> int:
         fp32_bytes, fp32_dt = sweep_bytes(ctx, x, y, "float32")
         bf16_bytes, bf16_dt = sweep_bytes(ctx, x, y, "bfloat16")
         fp8_bytes, fp8_dt = sweep_bytes(ctx, x, y, "float8")
+        stream_bf16, stream_bf16_dt = staged_epoch_bytes(ctx, x, y,
+                                                         "bfloat16")
+        stream_fp8, stream_fp8_dt = staged_epoch_bytes(ctx, x, y, "float8")
     finally:
         ctx.conf.set("cyclone.data.dtype", "auto")
         ctx.stop()
@@ -90,12 +133,18 @@ def main() -> int:
         return 1
     ratio = bf16_bytes / fp32_bytes
     ratio8 = fp8_bytes / fp32_bytes
-    ok = ratio < THRESHOLD and ratio8 < THRESHOLD_FP8
+    stream_ratio = stream_fp8 / max(stream_bf16, 1)
+    ok = (ratio < THRESHOLD and ratio8 < THRESHOLD_FP8
+          and stream_ratio < THRESHOLD_FP8_STREAM)
     print(f"info: fp32 sweep ({fp32_dt}) {fp32_bytes / 1e6:.2f} MB vs "
           f"bf16 ({bf16_dt}) {bf16_bytes / 1e6:.2f} MB vs "
           f"fp8 ({fp8_dt}) {fp8_bytes / 1e6:.2f} MB — ratios "
           f"bf16 {ratio:.3f} (threshold {THRESHOLD}), "
           f"fp8 {ratio8:.3f} (threshold {THRESHOLD_FP8})", file=sys.stderr)
+    print(f"info: streamed epoch staged bytes bf16 ({stream_bf16_dt}) "
+          f"{stream_bf16 / 1e6:.2f} MB vs fp8 ({stream_fp8_dt}) "
+          f"{stream_fp8 / 1e6:.2f} MB — ratio {stream_ratio:.3f} "
+          f"(threshold {THRESHOLD_FP8_STREAM})", file=sys.stderr)
     print(json.dumps({
         "metric": "sweep_bytes_ratio",
         "value": round(ratio, 4),
@@ -107,6 +156,10 @@ def main() -> int:
         "fp8_bytes": fp8_bytes,
         "threshold": THRESHOLD,
         "fp8_threshold": THRESHOLD_FP8,
+        "stream_bf16_bytes": stream_bf16,
+        "stream_fp8_bytes": stream_fp8,
+        "stream_ratio": round(stream_ratio, 4),
+        "stream_threshold": THRESHOLD_FP8_STREAM,
         "ok": ok,
     }))
     return 0 if ok else 1

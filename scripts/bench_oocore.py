@@ -13,12 +13,23 @@ The executable form of the out-of-core contract (docs/out-of-core.md):
    how much of the smaller phase (staging vs shard compute) the double
    buffer actually hid behind the other:
        overlap = Σ |stage_i ∩ shard_j| / min(Σ stage, Σ shard)
-   1.0 = the pipeline fully hides one phase; 0.0 = strictly serial.
+   1.0 = the pipeline fully hides one phase; 0.0 = strictly serial,
+5. time one K=8 STACKED streamed sweep (``StackedStreamingLossFunction``
+   — every staged shard serves all K models) against one single-model
+   sweep: the stacked epoch must cost ≤ STACKED_CEIL × the single epoch
+   (ISSUE-19; serial K-model streaming would cost ~K×),
+6. attach the in-core twin to the shard-set cache TWICE
+   (``shard_dataset``): the second attach must be a cache hit with ZERO
+   spill-write bytes — a re-blocking cache miss on identical content is
+   a regression.
 
 Emits one JSON line (the BENCH "oocore" block) and exits non-zero unless
-the overlap fraction reaches OVERLAP_FLOOR on the 8-device CPU smoke —
-a pipeline that stopped overlapping is a regression even when results
-stay correct. Override shapes with BENCH_OOCORE_N / _D / _SHARD / _ITERS.
+the overlap fraction reaches OVERLAP_FLOOR on the 8-device CPU smoke,
+the stacked-epoch ratio stays under STACKED_CEIL, and the cache re-attach
+restreams 0 bytes — a pipeline that stopped overlapping, a stacked epoch
+that degenerated to serial, or a cache that stopped hitting is a
+regression even when results stay correct. Override shapes with
+BENCH_OOCORE_N / _D / _SHARD / _ITERS / _STACK.
 """
 
 import json
@@ -37,6 +48,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 import numpy as np  # noqa: E402
 
 OVERLAP_FLOOR = 0.30
+STACKED_CEIL = 1.4
 
 
 def _merge_intervals(intervals):
@@ -121,6 +133,29 @@ def main() -> int:
         sds, aggregators.binary_logistic(d, fit_intercept=False))
     cost = f.sweep_cost(n_coef=d)
 
+    # stacked streamed epoch: K models ride the SAME staged shards, so
+    # the K-model sweep should cost ~1 epoch of staging, not K
+    import jax.numpy as jnp
+
+    from cycloneml_tpu.oocore import StackedStreamingLossFunction
+    k_stack = int(os.environ.get("BENCH_OOCORE_STACK", 8))
+    fs = StackedStreamingLossFunction(
+        sds, aggregators.stack_aggregator(
+            aggregators.binary_logistic(d, fit_intercept=False)), k_stack)
+    z1 = jnp.zeros(d, jnp.float32)
+    zk = jnp.zeros((k_stack, d), jnp.float32)
+    f.sweep(z1)   # warm the single-model per-shard program
+    fs.sweep(zk)  # warm the stacked per-shard program
+    single_sweep_s = stacked_sweep_s = float("inf")
+    for _ in range(2):  # best-of-2: one staging hiccup shouldn't gate
+        t0 = time.perf_counter()
+        f.sweep(z1)
+        single_sweep_s = min(single_sweep_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fs.sweep(zk)
+        stacked_sweep_s = min(stacked_sweep_s, time.perf_counter() - t0)
+    stacked_ratio = stacked_sweep_s / max(single_sweep_s, 1e-9)
+
     # the in-core twin: same rows, one resident matrix
     xs, ys = [], []
     for cx, cy, _ in chunks():
@@ -137,11 +172,26 @@ def main() -> int:
     coef_drift = float(np.abs(np.asarray(m_stream._coef)
                               - np.asarray(m_ref._coef)).max())
 
+    # shard-set cache: the second attach over identical content must hit
+    # — 0 spill-write bytes restreamed (a CV fold / warm re-fit re-uses
+    # the spill instead of re-blocking the dataset)
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+    cache = shard_set_cache()
+    s1 = shard_dataset(ds, shard_rows=shard_rows)
+    mid = cache.stats()
+    s2 = shard_dataset(ds, shard_rows=shard_rows)
+    end = cache.stats()
+    cache_hit_restream_bytes = end["spillWriteBytes"] - mid["spillWriteBytes"]
+    cache_hits = end["hits"] - mid["hits"]
+    s2.close()
+    s1.close()
+
     block = {
         "metric": "oocore",
         "n": n, "d": d,
         "shards": sds.n_shards, "shard_rows": shard_rows,
         "pad_rows": sds.pad_rows,
+        "stream_dtype": str(sds.x_dtype),
         "shard_build_s": round(shard_build_s, 3),
         "streamed_fit_s": round(streamed_s, 3),
         "incore_fit_s": round(incore_s, 3),
@@ -154,16 +204,36 @@ def main() -> int:
         "stage_seconds": round(stage_s, 3),
         "compute_seconds": round(shard_s, 3),
         "coef_max_abs_drift": coef_drift,
+        "stacked_models_per_epoch": k_stack,
+        "single_sweep_s": round(single_sweep_s, 3),
+        "stacked_sweep_s": round(stacked_sweep_s, 3),
+        "stacked_vs_single_sweep": round(stacked_ratio, 3),
+        "stacked_ceil": STACKED_CEIL,
+        "cache_hits": cache_hits,
+        "cache_hit_restream_bytes": cache_hit_restream_bytes,
     }
     print(json.dumps(block))
     ctx.stop()
     sds.close()
+    rc = 0
     if frac < OVERLAP_FLOOR:
         print(f"FAIL: transfer/compute overlap {frac:.3f} < "
               f"{OVERLAP_FLOOR} — the double buffer is not overlapping",
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if stacked_ratio > STACKED_CEIL:
+        print(f"FAIL: K={k_stack} stacked sweep cost {stacked_ratio:.2f}× "
+              f"a single sweep (ceil {STACKED_CEIL}) — the stacked epoch "
+              "is no longer amortizing staging across models",
+              file=sys.stderr)
+        rc = 1
+    if cache_hits < 1 or cache_hit_restream_bytes != 0:
+        print(f"FAIL: second shard_dataset attach restreamed "
+              f"{cache_hit_restream_bytes} bytes (hits {cache_hits}) — "
+              "the shard-set cache stopped reusing identical content",
+              file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

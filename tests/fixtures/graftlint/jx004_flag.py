@@ -83,3 +83,12 @@ def fp8_e5m2_psum_asarray(x):
 def fp8_psum_via_name(x):
     y = x.astype(jnp.float8_e4m3fn)
     return jax.lax.psum(y, "data")                   # JX004
+
+
+# the fp8 STREAM's dequant fold gone wrong: dequantizing to f32 and then
+# re-narrowing the partial back to codes before the collective puts the
+# mesh-wide accumulation back in 3 mantissa bits — the fold must END wide
+@jax.jit
+def dequant_fold_renarrowed_psum(x8, scale):
+    part = (x8.astype(jnp.float32) * scale).astype(jnp.float8_e4m3fn)
+    return jax.lax.psum(part, "data")                # JX004

@@ -87,3 +87,12 @@ def fp32_accumulated_fp8_psum(x):
     y = x.astype(jnp.float8_e4m3fn)
     acc = jnp.sum(y.astype(jnp.float32))
     return jax.lax.psum(acc, "data")
+
+
+@jax.jit
+def streamed_dequant_fold_psum(x8, scale):
+    # the fp8 shard stream's aggregator read (oocore/): codes dequantize
+    # inside the kernel — the set-level scale folds into the f32 upcast
+    # and the psum operand is the WIDE reduced partial, never the codes
+    part = jnp.sum(x8.astype(jnp.float32) * scale, axis=0)
+    return jax.lax.psum(part, "data")

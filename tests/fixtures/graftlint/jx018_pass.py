@@ -44,3 +44,17 @@ def fit_stages_bounded_shards(runtime, xb, yb, coef, shard_rows):
         staged = np.asarray(xb[lo:lo + shard_rows])
         jax.device_put(staged)
     return total
+
+
+def fit_attaches_cached_shard_set(runtime, xb, yb, coef, shard_rows):
+    # the shard-set cache idiom (oocore/cache): a fit re-attaching to an
+    # existing spill still stages per-shard bounded slices — the cache
+    # changes WHERE shards come from, not the O(shard) staging contract
+    sds = shard_set_cache().attach(xb, shard_rows=shard_rows)
+    step = tree_aggregate(_grad_kernel, runtime, xb, yb)
+    total = step(xb, yb, coef)
+    for lo in range(0, xb.shape[0], shard_rows):
+        staged = np.asarray(xb[lo:lo + shard_rows])
+        jax.device_put(staged)
+    sds.release()
+    return total

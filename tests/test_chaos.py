@@ -555,6 +555,78 @@ def test_oocore_transient_faults_exhaust_to_abort(ctx):
         sds.close()
 
 
+def test_oocore_fp8_transient_stage_fault_retries_bitwise(ctx):
+    """The fp8 stream under a transient staging fault (ISSUE 19): the
+    retry re-stages the SAME 1-byte e4m3 codes with the same set-level
+    dequant scale, so the epoch's accumulated partials are untouched and
+    the fit lands bitwise on the fault-free coefficients — precision
+    rung and recovery path compose."""
+    import ml_dtypes
+
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    ctx.conf.set("cyclone.oocore.streamDtype", "float8")
+    try:
+        sds = _oocore_fixture(ctx)
+        try:
+            assert sds.x_dtype == np.dtype(ml_dtypes.float8_e4m3fn)
+            assert sds.x_scale is not None
+            ref = LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+            sched = FaultSchedule(seed=0)
+            sched.at("oocore.stage", 2,
+                     TransientCollectiveError("fp8 stream flake"))
+            with FaultInjector(sched) as inj:
+                m = LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+            assert inj.log == [("oocore.stage", 2,
+                                "TransientCollectiveError")]
+            assert m.summary.streamed
+            np.testing.assert_array_equal(np.asarray(m._coef),
+                                          np.asarray(ref._coef))
+        finally:
+            sds.close()
+    finally:
+        ctx.conf.remove("cyclone.oocore.streamDtype")
+
+
+def test_oocore_corrupt_cached_shard_evicts_and_rebuilds(ctx):
+    """Shard-set cache integrity (ISSUE 19): a cached spill whose bytes
+    rot on disk (torn write, disk rot — injected here by flipping bytes
+    in a shard file directly) must never be trained on. The attach-time
+    per-shard sha256 check catches the mismatch, evicts the entry,
+    rebuilds from the source dataset, and the fit completes bitwise on
+    the clean-spill coefficients."""
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+
+    cache = shard_set_cache()
+    cache.clear()
+    rng = np.random.RandomState(10)
+    x = rng.randn(1000, 6)
+    y = (x[:, 0] > 0).astype(float)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    st0 = cache.stats()
+    first = shard_dataset(ds, shard_rows=300)
+    ref = LogisticRegression(maxIter=8, regParam=0.1).fit(first)
+    victim = first._shards[1].path
+    first.close()   # ref released; the entry stays cached
+    with open(victim, "r+b") as fh:
+        fh.seek(64)
+        fh.write(b"\xff" * 32)
+    again = shard_dataset(ds, shard_rows=300)
+    try:
+        st = cache.stats()
+        assert st["evictionsCorrupt"] == st0["evictionsCorrupt"] + 1
+        assert st["hits"] == st0["hits"]          # the rot never served
+        assert st["misses"] == st0["misses"] + 2  # build + rebuild
+        assert not os.path.exists(victim)         # corrupt files removed
+        m = LogisticRegression(maxIter=8, regParam=0.1).fit(again)
+        np.testing.assert_array_equal(np.asarray(m._coef),
+                                      np.asarray(ref._coef))
+    finally:
+        again.close()
+        cache.clear()
+
+
 # -- fault class 6: whole-HOST loss (multihost.host) ----------------------------
 
 def test_host_loss_rebuilds_mesh_and_resumes(ctx, tmp_path):

@@ -615,15 +615,21 @@ def test_ovr_stacked_rides_fp8(ctx, tier):
         assert _norm_rel(a._coef, b._coef) < FP8_COEF_NORMREL
 
 
-def test_fp8_streamed_fit_dequantizes_before_sharding(ctx, tier):
+def test_fp8_streamed_fit_streams_codes(ctx, tier):
     """A quantized dataset routed to the streaming engine (oocore force
-    mode / budget-guard degradation) must NOT spill raw e4m3 codes as
-    values: StreamingDataset.from_dataset leaves the fp8 tier (visible
-    PrecisionFallback) before sharding, so the streamed fit matches the
-    in-core one instead of training on x/scale."""
+    mode / budget-guard degradation) keeps its e4m3 CODES on the shard
+    set — the in-core envelope probe already admitted this data to the
+    fp8 rung, the stream stages 1-byte codes, and the per-column dequant
+    scale folds into the aggregator read exactly like the in-core fp8
+    fit — so the streamed coefficients land ulp-close to the in-core fp8
+    ones and the host→device byte bill stays halved. Only a
+    ``streamDtype=bfloat16`` pin forces the codes back up, visibly
+    (PrecisionFallback)."""
     from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.oocore import shard_set_cache
     from cycloneml_tpu.util.events import PrecisionFallback
     tier("float8")
+    shard_set_cache().clear()
     rng = np.random.RandomState(31)
     n, d = 900, 6
     x = rng.randn(n, d) * np.array([1.0, 8.0, 0.5, 2.0, 1.0, 4.0])
@@ -636,17 +642,26 @@ def test_fp8_streamed_fit_dequantizes_before_sharding(ctx, tier):
     try:
         m_streamed = est.fit(_fresh_frame(ctx, x, y))
         ctx.listener_bus.wait_until_empty()
+        # the codes spilled AS codes: no precision fallback fired
+        assert not [e for e in events if isinstance(e, PrecisionFallback)]
+        assert m_streamed.summary.streamed
+        # same codes, same set-level scale, same stats → the streamed
+        # fit agrees with the in-core fp8 fit far inside the envelope
+        c_in = np.asarray(m_incore.coefficients.to_array())
+        c_st = np.asarray(m_streamed.coefficients.to_array())
+        assert _norm_rel(c_st, c_in) < 1e-6, _norm_rel(c_st, c_in)
+        # pinning the stream to the bf16 rung forces the codes up — the
+        # dequant leaves the fp8 tier visibly, never silently
+        ctx.conf.set("cyclone.oocore.streamDtype", "bfloat16")
+        m_pinned = est.fit(_fresh_frame(ctx, x, y))
+        ctx.listener_bus.wait_until_empty()
+        assert any(isinstance(e, PrecisionFallback)
+                   and e.estimator == "StreamingDataset.from_dataset"
+                   for e in events)
+        c_pin = np.asarray(m_pinned.coefficients.to_array())
+        assert _norm_rel(c_pin, c_in) < FP8_COEF_NORMREL
     finally:
         ctx.conf.set("cyclone.oocore.mode", "auto")
+        ctx.conf.remove("cyclone.oocore.streamDtype")
         ctx.listener_bus.remove_listener(events.append)
-    assert m_streamed.summary.streamed
-    # the spill left the fp8 tier, visibly
-    assert any(isinstance(e, PrecisionFallback)
-               and e.estimator == "StreamingDataset.from_dataset"
-               for e in events)
-    # and the streamed coefficients agree with the in-core fp8 fit to
-    # the bf16-vs-fp8 cross-rung envelope (mis-scaled columns would be
-    # off by absmax/448 factors, orders of magnitude outside this)
-    c_in = np.asarray(m_incore.coefficients.to_array())
-    c_st = np.asarray(m_streamed.coefficients.to_array())
-    assert _norm_rel(c_st, c_in) < FP8_COEF_NORMREL
+        shard_set_cache().clear()

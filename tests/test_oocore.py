@@ -674,3 +674,336 @@ def test_streaming_dataset_close_race_single_unlink(ctx, monkeypatch):
     assert not any(os.path.exists(p) for p in paths)
     sds.close()   # idempotent after the race: the latch stays down
     assert {counts[p] for p in paths} == {1}
+
+
+# -- fp8 shard stream + stacked streamed epochs + shard-set cache (ISSUE 19) --
+
+
+def test_fp8_shard_stream_matches_incore_fp8(ctx):
+    """Tentpole leg (a): under ``streamDtype=float8`` the spill stores
+    e4m3 codes + ONE set-level per-column dequant scale — the identical
+    codes and scale an in-core fp8 quantization of the same rows
+    produces — so the streamed fit lands ulp-close to the in-core fp8
+    fit (the only difference is summation order), and the staged X bytes
+    drop to 1 per element."""
+    import ml_dtypes
+    from cycloneml_tpu.dataset.instance import data_dtype
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    x, y = _binary_problem(n=1500, d=8, seed=31)
+    ctx.conf.set("cyclone.oocore.streamDtype", "float8")
+    ctx.conf.set("cyclone.data.dtype", "float8")
+    try:
+        sds = _streaming_ds(ctx, x, y)
+        try:
+            assert sds.x_dtype == np.dtype(ml_dtypes.float8_e4m3fn)
+            assert sds.x_scale is not None and sds.x_scale.shape == (8,)
+            est = lambda: LogisticRegression(maxIter=30,  # noqa: E731
+                                             regParam=0.01, tol=1e-10)
+            m_st = est().fit(sds)
+            assert m_st.summary.streamed
+            ds8 = InstanceDataset.from_numpy(
+                ctx, x, y, dtype=data_dtype(ctx.conf, fp8_capable=True))
+            # the finalize pass and the in-core quantizer agree bitwise
+            # on the set-level scale
+            np.testing.assert_array_equal(sds.x_scale,
+                                          np.asarray(ds8.x_scale))
+            m_in = est().fit(ds8)
+            np.testing.assert_allclose(np.asarray(m_st._coef),
+                                       np.asarray(m_in._coef),
+                                       rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(np.asarray(m_st._icpt),
+                                       np.asarray(m_in._icpt),
+                                       rtol=1e-9, atol=1e-12)
+            # the staged stream really is 1-byte codes
+            x0, _, _ = sds.load_shard(0)
+            assert x0.dtype == np.dtype(ml_dtypes.float8_e4m3fn)
+            assert x0.itemsize == 1
+        finally:
+            sds.close()
+    finally:
+        ctx.conf.remove("cyclone.oocore.streamDtype")
+        ctx.conf.set("cyclone.data.dtype", "auto")
+
+
+def test_fp8_stream_probe_refusal_stays_wide_and_visible(ctx):
+    """The fp8 stream's safety rail: an ill-conditioned column (absmax
+    >> std) makes the materialization-time envelope probe refuse the fp8
+    rung for the shard SET — the spill stays at the write rung, the fit
+    completes, and the decision surfaces as a PrecisionFallback event
+    (automatic and visible, never silent)."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.util.events import PrecisionFallback
+    x, y = _binary_problem(n=900, d=6, seed=32)
+    x[:, 2] = 1000.0 + 0.01 * np.random.RandomState(1).randn(900)
+    events = []
+    ctx.listener_bus.add_listener(events.append)
+    ctx.conf.set("cyclone.oocore.streamDtype", "float8")
+    try:
+        sds = _streaming_ds(ctx, x, y)
+        try:
+            ctx.listener_bus.wait_until_empty()
+            assert sds.x_scale is None  # the requantize was refused
+            assert sds.x_dtype.itemsize > 1
+            falls = [e for e in events if isinstance(e, PrecisionFallback)]
+            assert len(falls) == 1
+            assert falls[0].from_dtype == "float8_e4m3fn"
+            assert "absmax/std" in falls[0].reason
+            m = LogisticRegression(maxIter=8, regParam=0.1).fit(sds)
+            assert m.summary.streamed
+            assert np.all(np.isfinite(np.asarray(m._coef)))
+        finally:
+            sds.close()
+    finally:
+        ctx.conf.remove("cyclone.oocore.streamDtype")
+        ctx.listener_bus.remove_listener(events.append)
+
+
+def test_streamed_stacked_fit_matches_serial_streamed(ctx):
+    """Tentpole leg (b): ``fit_stacked`` over a StreamingDataset drives K
+    models through ONE double-buffered epoch per optimizer round (vmap
+    over the per-shard partials, per-model convergence masks on the host
+    fold). Coefficient parity with K serial streamed fits at matched
+    regs is 1e-9, and the stacked run's epoch count is the MAX of the
+    serial counts, not their sum."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    x, y = _binary_problem(n=2000, d=8, seed=33)
+    sds = _streaming_ds(ctx, x, y)
+    regs = [0.0, 0.01, 0.1, 1.0]
+    try:
+        models = LogisticRegression(maxIter=40, tol=1e-9).fit_stacked(
+            sds, reg_params=regs)
+        assert len(models) == len(regs)
+        serial_evals = []
+        for kk, r in enumerate(regs):
+            m_ref = LogisticRegression(maxIter=40, tol=1e-9,
+                                       regParam=r).fit(sds)
+            np.testing.assert_allclose(np.asarray(models[kk]._coef),
+                                       np.asarray(m_ref._coef),
+                                       rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(np.asarray(models[kk]._icpt),
+                                       np.asarray(m_ref._icpt),
+                                       rtol=1e-9, atol=1e-12)
+            serial_evals.append(m_ref.summary.total_evals)
+        s = models[0].summary
+        assert s.streamed and s.n_models == len(regs)
+        # ONE streamed epoch serves all K models per round
+        assert s.total_evals <= max(serial_evals)
+        assert s.total_evals < sum(serial_evals)
+    finally:
+        sds.close()
+
+
+def test_streamed_stacked_sgd_matches_serial(ctx):
+    """``optimize_stacked`` is the model-axis twin of the streamed SGD:
+    per-model labels via ``y_stack`` (OvR relabelings), a shared
+    mini-batch mask keyed on the true shard index, and per-model
+    convergence — each model's trajectory matches its serial streamed
+    run at matched seeds."""
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.ml.optim.gradient_descent import SquaredL2Updater
+    from cycloneml_tpu.oocore import StreamingGradientDescent
+    x, y = _binary_problem(n=1200, d=6, seed=34)
+    sds = _streaming_ds(ctx, x, y, shard_rows=400)
+    sds_flip = _streaming_ds(ctx, x, 1.0 - y, shard_rows=400)
+    try:
+        agg = aggregators.binary_logistic(6, fit_intercept=False)
+        kw = dict(step_size=1.0, num_iterations=15, reg_param=0.01,
+                  updater=SquaredL2Updater(), seed=7,
+                  mini_batch_fraction=0.6)
+        y_stack = np.stack([y, 1.0 - y])
+        W, hists = StreamingGradientDescent(**kw).optimize_stacked(
+            sds, agg, np.zeros((2, 6)), y_stack=y_stack)
+        w0, h0 = StreamingGradientDescent(**kw).optimize(
+            sds, agg, np.zeros(6))
+        w1, h1 = StreamingGradientDescent(**kw).optimize(
+            sds_flip, agg, np.zeros(6))
+        np.testing.assert_allclose(W[0], w0, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(W[1], w1, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(hists[0], h0, rtol=1e-9)
+        np.testing.assert_allclose(hists[1], h1, rtol=1e-9)
+    finally:
+        sds.close()
+        sds_flip.close()
+
+
+def test_shard_set_cache_attach_hit_zero_respill(ctx):
+    """Tentpole leg (c): the second attach over the same dataset is a
+    HIT — a shared view onto the existing spill files, ZERO spill-write
+    bytes — and closing one handle releases its refcount without tearing
+    the cached files down from under the other."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+    cache = shard_set_cache()
+    cache.clear()
+    x, y = _binary_problem(n=900, d=5, seed=35)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    st0 = cache.stats()
+    s1 = shard_dataset(ds, shard_rows=300)
+    st1 = cache.stats()
+    assert st1["misses"] == st0["misses"] + 1
+    wrote = st1["spillWriteBytes"] - st0["spillWriteBytes"]
+    assert wrote > 0
+    try:
+        s2 = shard_dataset(ds, shard_rows=300)
+        st2 = cache.stats()
+        assert st2["hits"] == st1["hits"] + 1
+        assert st2["spillWriteBytes"] == st1["spillWriteBytes"]  # 0 re-spill
+        assert [a.path for a in s2._shards] == [a.path for a in s1._shards]
+        m = LogisticRegression(maxIter=6, regParam=0.1).fit(s2)
+        assert m.summary.streamed
+        s2.close()
+        # s1 still holds a ref: the files survive s2's close
+        assert all(os.path.exists(a.path) for a in s1._shards)
+        m2 = LogisticRegression(maxIter=6, regParam=0.1).fit(s1)
+        np.testing.assert_array_equal(np.asarray(m2._coef),
+                                      np.asarray(m._coef))
+    finally:
+        s1.close()
+        cache.clear()
+
+
+def test_shard_set_cache_keying_negatives(ctx):
+    """The content key covers everything that changes the spilled bytes:
+    different data, different shard geometry, and a different stream
+    tier each MISS — attaching never serves a spill built for other
+    bytes."""
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+    cache = shard_set_cache()
+    cache.clear()
+    x, y = _binary_problem(n=800, d=5, seed=36)
+    x2 = x.copy()
+    x2[0, 0] += 1.0
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    ds2 = InstanceDataset.from_numpy(ctx, x2, y)
+    st0 = cache.stats()
+    handles = [shard_dataset(ds, shard_rows=300)]
+    try:
+        handles.append(shard_dataset(ds, shard_rows=128))  # geometry
+        handles.append(shard_dataset(ds2, shard_rows=300))  # content
+        ctx.conf.set("cyclone.oocore.streamDtype", "float8")
+        try:
+            handles.append(shard_dataset(ds, shard_rows=300))  # tier
+        finally:
+            ctx.conf.remove("cyclone.oocore.streamDtype")
+        st = cache.stats()
+        assert st["hits"] == st0["hits"]
+        assert st["misses"] == st0["misses"] + 4
+    finally:
+        for h in handles:
+            h.close()
+        cache.clear()
+
+
+def test_shard_set_cache_eviction_pins_live_streams(ctx):
+    """The byte bound LRU-evicts — but NEVER an entry with a live handle:
+    under a bound that fits one entry, the pinned set survives two
+    further builds (the released one is the victim) and still serves a
+    fit afterwards."""
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+    cache = shard_set_cache()
+    cache.clear()
+    probs = [_binary_problem(n=900, d=6, seed=s) for s in (37, 38, 39)]
+    dss = [InstanceDataset.from_numpy(ctx, x, y) for x, y in probs]
+    st0 = cache.stats()
+    live = shard_dataset(dss[0], shard_rows=300)
+    nb = cache.stats()["bytes"]
+    assert nb > 0
+    ctx.conf.set("cyclone.oocore.cacheBytes", str(nb))  # one entry fits
+    try:
+        other = shard_dataset(dss[1], shard_rows=300)
+        other_paths = [s.path for s in other._shards]
+        other.close()   # refs 0 → evictable; live stays pinned
+        third = shard_dataset(dss[2], shard_rows=300)
+        third.close()
+        st = cache.stats()
+        assert st["evictionsLru"] >= st0["evictionsLru"] + 1
+        # the released entry's files are gone, the pinned one's remain
+        assert not any(os.path.exists(p) for p in other_paths)
+        assert all(os.path.exists(s.path) for s in live._shards)
+        m = LogisticRegression(maxIter=5, regParam=0.1).fit(live)
+        assert m.summary.streamed
+    finally:
+        ctx.conf.remove("cyclone.oocore.cacheBytes")
+        live.close()
+        cache.clear()
+
+
+def test_shard_set_cache_bypass_modes(ctx):
+    """cacheBytes=0 and an explicit spill_dir both restore the pre-cache
+    contract: a direct build that OWNS its files (closed → unlinked)."""
+    from cycloneml_tpu.oocore import shard_dataset, shard_set_cache
+    cache = shard_set_cache()
+    cache.clear()
+    x, y = _binary_problem(n=600, d=4, seed=40)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    ctx.conf.set("cyclone.oocore.cacheBytes", "0")
+    try:
+        st0 = cache.stats()
+        sds = shard_dataset(ds, shard_rows=200)
+        assert cache.stats() == st0    # the cache never saw it
+        paths = [s.path for s in sds._shards]
+        sds.close()
+        assert not any(os.path.exists(p) for p in paths)  # owned + removed
+    finally:
+        ctx.conf.remove("cyclone.oocore.cacheBytes")
+        cache.clear()
+
+
+def test_fp8_stream_attribution_bytes_and_cache_hits(ctx):
+    """Usage attribution across the new planes: staged h2dBytes bill at
+    the staged arrays' ACTUAL itemsize — an fp8 epoch's X stream bills 1
+    byte/element where the bf16 rung bills 2 — and shard-set cache hits
+    land on the calling scope's ``cacheHits`` ledger field."""
+    import jax.numpy as jnp
+    from cycloneml_tpu.ml.optim import aggregators
+    from cycloneml_tpu.observe import attribution
+    from cycloneml_tpu.oocore import (StreamingDataset, StreamingLossFunction,
+                                      shard_dataset, shard_set_cache)
+    d = 64
+    x, y = _binary_problem(n=1600, d=d, seed=41)
+
+    def chunks():
+        for lo in range(0, len(x), 400):
+            yield x[lo:lo + 400], y[lo:lo + 400], None
+
+    attribution.disable()
+    led = attribution.enable()
+    cache = shard_set_cache()
+    cache.clear()
+    try:
+        staged = {}
+        for tier in ("bfloat16", "float8"):
+            sds = StreamingDataset.from_chunks(ctx, chunks(), d,
+                                               shard_rows=400,
+                                               stream_dtype=tier)
+            try:
+                agg = aggregators.binary_logistic(d, fit_intercept=False)
+                f = StreamingLossFunction(sds, agg)
+                with attribution.scope(f"epoch-{tier}"):
+                    f.sweep(jnp.zeros(d, jnp.float32))
+                staged[tier] = led.row(f"epoch-{tier}")["h2dBytes"]
+                geom = (sds.n_shards, sds.pad_rows)
+            finally:
+                sds.close()
+        assert staged["float8"] > 0
+        assert staged["float8"] < staged["bfloat16"]
+        # exact byte math: X bytes halve (1 vs 2 per element) while y/w
+        # ride the accumulator tier in both, so the delta is EXACTLY one
+        # epoch of X at one byte per element over the padded geometry —
+        # the ledger bills the staged arrays' actual itemsize, not an
+        # assumed bf16 width
+        n_shards, pad_rows = geom
+        assert staged["bfloat16"] - staged["float8"] \
+            == n_shards * pad_rows * d
+        ds = InstanceDataset.from_numpy(ctx, *_binary_problem(
+            n=600, d=4, seed=42))
+        with attribution.scope("cache-job"):
+            a = shard_dataset(ds, shard_rows=200)
+            b = shard_dataset(ds, shard_rows=200)
+        assert led.row("cache-job")["cacheHits"] == 1
+        a.close()
+        b.close()
+    finally:
+        cache.clear()
+        attribution.disable()
