@@ -3,7 +3,7 @@
 
 .PHONY: lint lint-fast lint-json lint-sarif lint-ci test chaos obs-demo \
 	bench bench-bytes bench-oocore bench-elastic serve-demo multihost \
-	autoscale-sim usage-demo
+	autoscale-sim usage-demo doctor doctor-demo bench-regress
 
 # the full interprocedural pass (JX001-JX019, concurrency + abstract
 # shape/sharding rules included); fails on any finding not grandfathered
@@ -64,9 +64,31 @@ usage-demo:
 	JAX_PLATFORMS=cpu python scripts/usage_demo.py
 
 # one JSON line: e2e LR throughput + phases + the multi-class OvR
-# stacked-vs-serial comparison (ovr_stacked_speedup, models_per_compile)
+# stacked-vs-serial comparison (ovr_stacked_speedup, models_per_compile).
+# Tee'd to artifacts/ so `make bench bench-regress` gates the run it made.
 bench:
-	python bench.py
+	@mkdir -p artifacts
+	python bench.py | tee artifacts/bench_last.json
+
+# regression sentinel: backfill BENCH_r*.json into the append-only
+# artifacts/bench_history.jsonl ledger, ingest artifacts/bench_last.json
+# if present, judge each metric's newest row against median+MAD of its
+# comparable history (cyclone.regress.*) — nonzero on any regression.
+# Self-test of the gate itself: `... bench_regress.py --inject-regression`
+bench-regress:
+	python scripts/bench_regress.py --ingest artifacts/bench_last.json
+
+# offline bottleneck diagnosis over a Chrome trace or flight dump:
+# make doctor TRACE=artifacts/trace.json — exit 2 when anything fires
+doctor:
+	python -m cycloneml_tpu.observe.doctor $(TRACE)
+
+# performance-doctor acceptance: clean warm fit => ZERO findings,
+# pathological fit (forced recompiles + delayed staging lane + 1-byte
+# shard cache) => >= 4 distinct evidence-backed finding kinds, and the
+# doctor CLI --json byte-identical across two runs over the same trace
+doctor-demo:
+	JAX_PLATFORMS=cpu python scripts/doctor_demo.py
 
 # standalone sweep-byte check, BOTH narrow legs: the bf16 data-tier
 # sweep must access < 60% of the fp32 sweep's bytes and the fp8 (e4m3)

@@ -38,6 +38,29 @@ def device_peaks():
     return costs.backend_peaks()
 
 
+def bench_meta():
+    """The BENCH json ``meta`` block: run identity for the regression
+    sentinel's history ledger (observe/regress.py). Deliberately NO
+    wall-clock field — the gated path must stay byte-deterministic for
+    a given (env, git) state, so ordering comes from the caller-supplied
+    logical timestamp (BENCH_T_LOGICAL), not a clock read."""
+    sha = os.environ.get("BENCH_GIT_SHA")
+    if sha is None:
+        try:
+            import subprocess
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+        except Exception:
+            sha = ""
+    t_logical = int(os.environ.get("BENCH_T_LOGICAL", "0"))
+    run_id = os.environ.get("BENCH_RUN_ID") or f"{sha or 'local'}-t{t_logical}"
+    return {"schema_version": 1, "run_id": run_id, "git_sha": sha,
+            "t_logical": t_logical}
+
+
 def hardware_meta():
     """The BENCH json ``hardware`` block: backend, device count, dtype
     tier, roofline peaks and live-telemetry availability — the denominator
@@ -865,6 +888,7 @@ def main() -> None:
     err = None
     ceiling_bw = None
     phases = None
+    meta = bench_meta()
     try:
         hardware = hardware_meta()
     except Exception as e:
@@ -957,6 +981,7 @@ def main() -> None:
             "value": round(mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(mops / REF_DGEMM_MOPS, 2),
+            "meta": meta,
             "hardware": hardware,
             "phases": phases,
             "ovr": ovr,
@@ -972,6 +997,7 @@ def main() -> None:
             "value": round(gemm_mops, 1),
             "unit": "M ops/s",
             "vs_baseline": round(gemm_mops / REF_DGEMM_MOPS, 2),
+            "meta": meta,
             "hardware": hardware,
             "ovr": ovr,
             "serving": serving,
@@ -986,6 +1012,7 @@ def main() -> None:
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
+            "meta": meta,
             "hardware": hardware,
         }))
 

@@ -1003,6 +1003,131 @@ USAGE_REPORT_INTERVAL_MS = (
 )
 
 
+DOCTOR_RECOMPILE_MIN = (
+    ConfigBuilder("cyclone.doctor.recompileMin")
+    .doc("Recompile-storm conviction floor for observe/diagnose.py: the "
+         "total number of EXCESS compile spans (beyond the first per "
+         "program-cache identity) in the analyzed window before the "
+         "doctor files a recompile-storm finding. The first compile of "
+         "each program is warm-up, never evidence.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(2)
+)
+
+DOCTOR_TRANSFER_STALL_FRACTION = (
+    ConfigBuilder("cyclone.doctor.transferStallFraction")
+    .doc("Host-transfer stall threshold: non-streaming transfer-span "
+         "seconds must reach this fraction of dispatch+collective "
+         "seconds before the doctor convicts (the runtime twin of "
+         "JX001's per-element device_get rule). oocore.* staging spans "
+         "are excluded — streaming health is the overlap rule's job.")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(0.5)
+)
+
+DOCTOR_TRANSFER_MIN_COUNT = (
+    ConfigBuilder("cyclone.doctor.transferMinCount")
+    .doc("Minimum non-streaming transfer spans in the window before the "
+         "transfer-stall rule may fire: one big final readback is a "
+         "result fetch, not a stall pattern.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(8)
+)
+
+DOCTOR_OVERLAP_MIN = (
+    ConfigBuilder("cyclone.doctor.overlapMin")
+    .doc("Under-lapped-streaming threshold: the stage/compute overlap "
+         "fraction (same interval math as scripts/bench_oocore.py) "
+         "below which the doctor flags the double buffer as not "
+         "hiding staging. Mirrors the bench gate's 0.30 floor.")
+    .check_value(lambda v: 0.0 <= v <= 1.0, "must be in [0, 1]")
+    .float_conf(0.30)
+)
+
+DOCTOR_MIN_STREAM_SPANS = (
+    ConfigBuilder("cyclone.doctor.minStreamSpans")
+    .doc("Minimum oocore.stage AND oocore.shard span count before the "
+         "overlap rule judges a window; tiny streams have no steady "
+         "state to measure.")
+    .check_value(lambda v: v >= 2, "must be >= 2")
+    .int_conf(8)
+)
+
+DOCTOR_SHED_MIN = (
+    ConfigBuilder("cyclone.doctor.shedMin")
+    .doc("Serving-pressure conviction floor: total shed requests in the "
+         "serving stats snapshot at or above this files a finding.")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(1)
+)
+
+DOCTOR_FALLBACK_MIN = (
+    ConfigBuilder("cyclone.doctor.fallbackMin")
+    .doc("Precision-envelope churn floor: precision.fallback events in "
+         "the window at or above this files a finding (the fp8 "
+         "envelope is re-proving itself instead of staying settled).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(1)
+)
+
+DOCTOR_ROOFLINE_FRACTION = (
+    ConfigBuilder("cyclone.doctor.rooflineFraction")
+    .doc("Roofline classification threshold: a profile at or above this "
+         "fraction of its measured memory/compute ceiling is classified "
+         "bandwidth- or compute-bound (by arithmetic intensity vs the "
+         "ridge point); below it the fit is host-bound and the other "
+         "rules explain why. Abstains when costs carry no peaks (CPU).")
+    .check_value(lambda v: 0.0 < v <= 1.0, "must be in (0, 1]")
+    .float_conf(0.5)
+)
+
+DOCTOR_FLIGHT_DIAGNOSIS = (
+    ConfigBuilder("cyclone.doctor.flightDiagnosis")
+    .doc("Auto-attach a DiagnosisReport to every flight-recorder dump: "
+         "the doctor runs over the captured ring (spans only, no live "
+         "sources) so a post-mortem dump arrives pre-triaged. Failures "
+         "in the doctor never break the dump itself.")
+    .bool_conf(True)
+)
+
+REGRESS_WINDOW = (
+    ConfigBuilder("cyclone.regress.window")
+    .doc("Bench-drift window: the newest row of each metric is judged "
+         "against the median+MAD of up to this many preceding "
+         "comparable rows in artifacts/bench_history.jsonl.")
+    .check_value(lambda v: v >= 2, "must be >= 2")
+    .int_conf(5)
+)
+
+REGRESS_MAD_FACTOR = (
+    ConfigBuilder("cyclone.regress.madFactor")
+    .doc("Robust drift threshold: a candidate beyond "
+         "median +/- max(madFactor*MAD, relTol*median) in the bad "
+         "direction is a regression; beyond it in the good direction "
+         "is an improvement.")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(4.0)
+)
+
+REGRESS_REL_TOL = (
+    ConfigBuilder("cyclone.regress.relTol")
+    .doc("Relative floor under the MAD threshold: with a near-zero MAD "
+         "(identical historical runs) drift under relTol*median still "
+         "passes, so the gate never flags noise-free jitter.")
+    .check_value(lambda v: v > 0, "must be > 0")
+    .float_conf(0.05)
+)
+
+REGRESS_MIN_RUNS = (
+    ConfigBuilder("cyclone.regress.minRuns")
+    .doc("Minimum comparable history rows before a metric is gated; "
+         "with fewer the verdict is insufficient-history (ok, never "
+         "a nonzero exit).")
+    .check_value(lambda v: v >= 1, "must be >= 1")
+    .int_conf(3)
+)
+
+
 MULTIHOST_REPLICAS = (
     ConfigBuilder("cyclone.multihost.replicas")
     .doc("Replica (DCN) rows of the hierarchical mesh. 0 (default) is "

@@ -254,10 +254,12 @@ class CycloneContext:
         if self.conf.get(FLIGHT_ENABLED) and _tracing.active() is None:
             _flight.enable(ring_spans=self.conf.get(FLIGHT_RING_SPANS))
             self._flight_owner = True
+        from cycloneml_tpu.conf import DOCTOR_FLIGHT_DIAGNOSIS as _DOCTOR_FD
         from cycloneml_tpu.conf import TRACE_DIR as _TRACE_DIR
         _flight.configure(
             dump_dir=self.conf.get(_TRACE_DIR) or None,
-            min_interval_s=self.conf.get(FLIGHT_MIN_INTERVAL_MS) / 1e3)
+            min_interval_s=self.conf.get(FLIGHT_MIN_INTERVAL_MS) / 1e3,
+            diagnose=self.conf.get(_DOCTOR_FD))
 
         # distributed-trace adoption + span shipping (observe/collect.py):
         # a deploy-launched app joins the submitting process's trace
@@ -743,6 +745,27 @@ class CycloneContext:
         if job_id is not None:
             return store.profile(job_id)
         return store.latest_profile()
+
+    def diagnose(self, spans=None):
+        """Run the performance doctor (observe/diagnose.py) over the
+        live telemetry plane: the active tracer's spans (or ``spans``),
+        the installed SkewDetector's lane snapshot, the latest serving
+        rollup and the shard-set cache stats. Posts a
+        ``DiagnosisCompleted`` event so ``/api/v1/diagnosis``, the web
+        UI and journal replay all see the report; returns it."""
+        from cycloneml_tpu.observe.diagnose import diagnose as _diagnose
+        from cycloneml_tpu.util.events import DiagnosisCompleted
+        if spans is None:
+            tracer = _tracing.active()
+            spans = tracer.snapshot() if tracer is not None else []
+        report = _diagnose(
+            spans=spans, conf=self.conf,
+            serving_stats=self.status_store.serving_stats() or None,
+            source="live")
+        self.listener_bus.post(DiagnosisCompleted(
+            source=report.source, n_findings=len(report.findings),
+            report=report.to_dict()))
+        return report
 
     @property
     def checkpoint_dir(self) -> str:

@@ -6,12 +6,16 @@ touches jax, so the analysis tooling and pure-host paths can import it
 freely.
 """
 
-from cycloneml_tpu.observe import attribution, costs, flight, skew, tracing
+from cycloneml_tpu.observe import (attribution, costs, flight, regress, skew,
+                                   tracing)
 from cycloneml_tpu.observe.attribution import Scope, UsageLedger, UsageReporter
 from cycloneml_tpu.observe.costs import ProgramCost
+from cycloneml_tpu.observe.diagnose import (DiagnosisReport, Finding,
+                                            diagnose)
 from cycloneml_tpu.observe.export import (chrome_trace, export_chrome_trace,
                                           merged_chrome_trace, process_lanes,
-                                          span_kinds, validate_chrome_trace)
+                                          span_kinds, spans_from_chrome_trace,
+                                          validate_chrome_trace)
 from cycloneml_tpu.observe.profile import FitProfile
 from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
                                            current_span_id, disable, enable,
@@ -19,9 +23,10 @@ from cycloneml_tpu.observe.tracing import (Span, Tracer, active,
 
 __all__ = [
     "attribution", "Scope", "UsageLedger", "UsageReporter",
-    "tracing", "costs", "flight", "skew", "Span", "Tracer", "FitProfile",
-    "ProgramCost", "enable", "disable", "active", "full_active", "span",
-    "instant", "current_span_id", "chrome_trace", "export_chrome_trace",
-    "merged_chrome_trace", "process_lanes", "validate_chrome_trace",
-    "span_kinds",
+    "tracing", "costs", "flight", "skew", "regress", "Span", "Tracer",
+    "FitProfile", "ProgramCost", "enable", "disable", "active",
+    "full_active", "span", "instant", "current_span_id", "chrome_trace",
+    "export_chrome_trace", "merged_chrome_trace", "process_lanes",
+    "validate_chrome_trace", "span_kinds", "spans_from_chrome_trace",
+    "diagnose", "DiagnosisReport", "Finding",
 ]

@@ -1,0 +1,92 @@
+"""``python -m cycloneml_tpu.observe.doctor`` — the doctor's CLI.
+
+Diagnoses an exported Chrome trace (or a flight-recorder dump JSON)
+OFFLINE: no live process sources are consulted, so the same file
+produces a byte-identical ``--json`` report on every run (the
+determinism gate in scripts/doctor_demo.py pins this).
+
+    python -m cycloneml_tpu.observe.doctor trace.json
+    python -m cycloneml_tpu.observe.doctor trace.json --json
+    python -m cycloneml_tpu.observe.doctor dump.json \\
+        --set cyclone.doctor.overlapMin=0.5
+
+Exit code: 0 on a healthy report, 2 when any warning/critical finding
+fires (info-only reports stay 0) — so `make doctor` can gate.
+Import-light: reads JSON, never imports jax.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, List, Optional
+
+
+def _coerce(raw: str) -> Any:
+    if raw.lower() in ("true", "false"):
+        return raw.lower() == "true"
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except ValueError:
+            continue
+    return raw
+
+
+def _load_spans(path: str):
+    from cycloneml_tpu.observe.export import spans_from_chrome_trace
+    with open(path, "r", encoding="utf-8") as fh:
+        obj = json.load(fh)
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        return spans_from_chrome_trace(obj), "trace"
+    if isinstance(obj, dict) and "spans" in obj:
+        # a flight-recorder dump: spans are serialized dicts
+        from cycloneml_tpu.observe.tracing import Span
+        spans = []
+        for d in obj["spans"]:
+            s = Span(str(d.get("span_id", "")), str(d.get("parent_id", "")),
+                     d.get("kind", ""), d.get("name", ""),
+                     int(d.get("tid", 0)), dict(d.get("attrs", {})))
+            s.t0 = float(d.get("t0", 0.0))
+            s.t1 = float(d.get("t1", s.t0))
+            spans.append(s)
+        return spans, "flight"
+    raise SystemExit(f"doctor: {path} is neither a Chrome trace "
+                     f"(traceEvents) nor a flight dump (spans)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cycloneml_tpu.observe.doctor",
+        description="diagnose an exported trace / flight dump offline")
+    ap.add_argument("trace", help="Chrome trace or flight-dump JSON file")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the canonical-JSON report (byte-stable)")
+    ap.add_argument("--set", action="append", default=[], metavar="K=V",
+                    help="cyclone.doctor.* / skew / SLO conf override")
+    ns = ap.parse_args(argv)
+
+    conf = None
+    if ns.set:
+        from cycloneml_tpu.conf import CycloneConf
+        conf = CycloneConf()
+        for kv in ns.set:
+            key, _, raw = kv.partition("=")
+            if not _ or not key:
+                raise SystemExit(f"doctor: --set expects K=V, got {kv!r}")
+            conf.set(key, _coerce(raw))
+
+    spans, source = _load_spans(ns.trace)
+    from cycloneml_tpu.observe.diagnose import diagnose
+    report = diagnose(spans=spans, skew=None, cache_stats=None,
+                      serving_stats=None, conf=conf, source=source)
+    if ns.as_json:
+        sys.stdout.write(report.to_json() + "\n")
+    else:
+        sys.stdout.write(report.render_text() + "\n")
+    worst = any(f.severity in ("warning", "critical")
+                for f in report.findings)
+    return 2 if worst else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,43 @@ def span_kinds(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[str, int]:
     return out
 
 
+def spans_from_chrome_trace(obj_or_path: Union[str, Dict[str, Any]]):
+    """Invert the export: rebuild ``Span`` objects from an exported
+    Chrome trace so the doctor can diagnose a trace FILE as readily as a
+    live ring. ``args.span_id``/``parent_id`` round-trip; everything
+    else in ``args`` becomes ``attrs``; ``ts``/``dur`` (wall-anchored
+    microseconds) become ``t0``/``t1`` seconds — absolute epoch differs
+    from the original perf_counter readings but every rule the doctor
+    runs is duration/interval arithmetic, which the shift preserves.
+    Metadata lane labels are not spans and are dropped."""
+    from cycloneml_tpu.observe.tracing import Span
+    if isinstance(obj_or_path, str):
+        with open(obj_or_path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    else:
+        obj = obj_or_path
+    spans = []
+    for ev in obj.get(REQUIRED_TOP, []):
+        if not isinstance(ev, dict):
+            continue
+        ph = ev.get("ph")
+        if ph == METADATA_PH:
+            continue
+        args = dict(ev.get("args") or {})
+        sid = str(args.pop("span_id", ""))
+        parent = str(args.pop("parent_id", ""))
+        kind = ("counter" if ph == COUNTER_PH
+                else "instant" if ph == INSTANT_PH
+                else str(ev.get("cat", "")))
+        s = Span(sid, parent, kind, str(ev.get("name", "")),
+                 int(ev.get("tid", 0)), args)
+        s.t0 = float(ev.get("ts", 0.0)) / 1e6
+        s.t1 = s.t0 + (float(ev.get("dur", 0.0)) / 1e6
+                       if ph == DURATION_PH else 0.0)
+        spans.append(s)
+    return spans
+
+
 def process_lanes(obj_or_path: Union[str, Dict[str, Any]]) -> Dict[int, str]:
     """pid -> process_name label from the trace's metadata events (the
     merged-trace acceptance counts these)."""

@@ -69,6 +69,7 @@ class FlightTracer(tracing.Tracer):
 _lock = threading.Lock()
 _dump_dir: Optional[str] = None
 _min_interval_s = 1.0
+_diagnose_dumps = True
 _last_trigger = 0.0
 _trigger_count = 0
 _dumps: List[Dict[str, Any]] = []
@@ -99,16 +100,20 @@ def active() -> Optional[tracing.Tracer]:
 _KEEP = object()
 
 
-def configure(dump_dir=_KEEP, min_interval_s: Optional[float] = None) -> None:
+def configure(dump_dir=_KEEP, min_interval_s: Optional[float] = None,
+              diagnose: Optional[bool] = None) -> None:
     """Set where triggered dumps are written (``None``/empty = in-memory
-    records only; omit the argument to keep the current directory) and
-    the trigger throttle."""
-    global _dump_dir, _min_interval_s
+    records only; omit the argument to keep the current directory), the
+    trigger throttle, and whether dumps auto-attach a doctor report
+    (``cyclone.doctor.flightDiagnosis``)."""
+    global _dump_dir, _min_interval_s, _diagnose_dumps
     with _lock:
         if dump_dir is not _KEEP:
             _dump_dir = dump_dir or None
         if min_interval_s is not None:
             _min_interval_s = max(float(min_interval_s), 0.0)
+        if diagnose is not None:
+            _diagnose_dumps = bool(diagnose)
 
 
 def trigger(reason: str, **attrs) -> Optional[Dict[str, Any]]:
@@ -131,6 +136,7 @@ def trigger(reason: str, **attrs) -> Optional[Dict[str, Any]]:
             return None
         _last_trigger = now
         dump_dir = _dump_dir
+        diagnose_dump = _diagnose_dumps
     window = DEFAULT_RING_SPANS if tr.full else tr.max_spans
     # tail-limited read: under a FULL 100k-span tracer a whole-buffer
     # snapshot would copy everything under the tracer lock on the
@@ -141,16 +147,29 @@ def trigger(reason: str, **attrs) -> Optional[Dict[str, Any]]:
         "trigger": count, "time": time.time(), "path": None,
         "spans": spans,
     }
+    if diagnose_dump:
+        # the dump arrives pre-triaged: the doctor runs over the frozen
+        # ring (spans only, no live sources — deterministic for a given
+        # window) and a doctor failure must never break the dump itself
+        try:
+            from cycloneml_tpu.observe.diagnose import diagnose
+            dump["diagnosis"] = diagnose(
+                spans=spans, skew=None, cache_stats=None,
+                source="flight").to_dict()
+        except Exception:
+            logger.exception("flight recorder: dump diagnosis failed")
     if dump_dir:
         from cycloneml_tpu.observe import export
         slug = re.sub(r"[^A-Za-z0-9_.-]+", "_", reason)[:48] or "trigger"
         path = os.path.join(dump_dir, f"flight-{count:04d}-{slug}.trace.json")
         try:
             os.makedirs(dump_dir, exist_ok=True)
-            obj = export.chrome_trace(
-                tr, spans=spans,
-                other={"flight_reason": reason, "flight_trigger": count,
-                       **{f"flight_{k}": v for k, v in attrs.items()}})
+            other = {"flight_reason": reason, "flight_trigger": count,
+                     **{f"flight_{k}": v for k, v in attrs.items()}}
+            if "diagnosis" in dump:
+                # the on-disk post-mortem carries its own triage
+                other["diagnosis"] = dump["diagnosis"]
+            obj = export.chrome_trace(tr, spans=spans, other=other)
             export.write_chrome_trace(obj, path)
             dump["path"] = path
             logger.warning("flight recorder: dumped %d spans to %s (%s)",

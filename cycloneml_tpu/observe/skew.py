@@ -252,6 +252,39 @@ class SkewDetector:
         with self._lock:
             return list(self._events)
 
+    def lane_snapshot(self, group: Optional[str] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+        """Per-lane medians/MAD/verdicts for the doctor, gathered in ONE
+        lock acquisition so a scrape racing ``observe`` can never pair
+        one lane's fresh median with another lane's stale latch (the
+        torn-rollup discipline batcher stats follow). Keys and lanes are
+        sorted; verdict flags are the LATCHED sets, exactly what
+        :meth:`stragglers`/:meth:`slo_breaches` report."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            groups = ([group] if group is not None
+                      else sorted(self._samples))
+            for g in groups:
+                positions = self._samples.get(g, {})
+                meds = self._medians.get(g, {})
+                med_values = [meds[p] for p in sorted(meds)]
+                group_med = (statistics.median(med_values)
+                             if med_values else 0.0)
+                mad = (statistics.median(
+                    [abs(v - group_med) for v in med_values])
+                    if med_values else 0.0)
+                lanes = {}
+                for pos in sorted(positions):
+                    lanes[pos] = {
+                        "n": len(positions[pos]),
+                        "medianS": meds.get(pos),
+                        "straggler": (g, pos) in self._flagged,
+                        "sloBreached": (g, pos) in self._slo_breached,
+                    }
+                out[g] = {"groupMedianS": group_med, "madS": mad,
+                          "lanes": lanes}
+        return out
+
     def straggler_pressure(self, groups=None) -> int:
         """Count of currently latched straggler verdicts, optionally
         restricted to ``groups`` — the autoscaler's training-pressure

@@ -199,6 +199,18 @@ class CapacityAcquired(CycloneEvent):
 
 
 @dataclass
+class DiagnosisCompleted(CycloneEvent):
+    """One performance-doctor run (``observe/diagnose.py``): the full
+    ``DiagnosisReport.to_dict()`` payload plus where it ran. The status
+    store keeps a bounded history, so ``/api/v1/diagnosis``, the web-UI
+    table and journal replay all see the same ranked findings."""
+
+    source: str = ""
+    n_findings: int = 0
+    report: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class UsageReport(CycloneEvent):
     """Cumulative per-scope usage ledger snapshot
     (``observe.attribution.UsageLedger.snapshot()``: scope key → row of

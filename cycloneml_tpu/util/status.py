@@ -60,6 +60,11 @@ class AppStatusStore:
         # latest TelemetryStatsUpdated rollup (drop counters of the
         # telemetry pipe itself), {} until one posts
         self.telemetry: Dict[str, Any] = {}
+        # DiagnosisCompleted reports (observe/diagnose.py), newest last
+        # — the /api/v1/diagnosis + web UI surface. Bounded: the doctor
+        # may run per flight dump on a long-lived job
+        self.diagnoses: List[Dict[str, Any]] = []
+        self.max_diagnoses = 20
         self._lock = threading.Lock()
 
     # -- REST-shaped accessors (≈ status/api/v1) ------------------------------
@@ -130,6 +135,11 @@ class AppStatusStore:
         """The latest telemetry drop-counter rollup, or {}."""
         with self._lock:
             return dict(self.telemetry)
+
+    def diagnosis_reports(self) -> List[Dict[str, Any]]:
+        """Recorded performance-doctor reports, newest last."""
+        with self._lock:
+            return [dict(r) for r in self.diagnoses]
 
     def latest_profile(self) -> Dict[str, Any]:
         """The highest-job-id FitProfile dict, or {} when none exist."""
@@ -268,6 +278,14 @@ class AppStatusListener:
                                        "ok": e.get("ok"),
                                        "reason": e.get("reason"),
                                        "time": e.get("time_ms")})
+        elif kind == "DiagnosisCompleted":
+            with s._lock:
+                s.diagnoses.append({"source": e.get("source"),
+                                    "nFindings": e.get("n_findings"),
+                                    "report": dict(e.get("report", {})),
+                                    "time": e.get("time_ms")})
+                while len(s.diagnoses) > s.max_diagnoses:
+                    s.diagnoses.pop(0)
 
     @staticmethod
     def _append_skew(s: AppStatusStore, row: Dict[str, Any]) -> None:
@@ -319,7 +337,7 @@ def api_v1(store: AppStatusStore, route: str,
     'applications', 'jobs', 'jobs/<id>', 'jobs/<id>/steps',
     'jobs/<id>/profile', 'checkpoints', 'workers/failures',
     'memory/warnings', 'serving', 'skew', 'migrations', 'precision',
-    'autoscale', 'usage', 'telemetry'."""
+    'autoscale', 'usage', 'telemetry', 'diagnosis'."""
     if route == "applications":
         return [store.application_info()]
     if route == "jobs":
@@ -350,4 +368,6 @@ def api_v1(store: AppStatusStore, route: str,
         return store.usage_rollup()
     if route == "telemetry":
         return store.telemetry_stats()
+    if route == "diagnosis":
+        return store.diagnosis_reports()
     raise KeyError(f"unknown route {route!r}")

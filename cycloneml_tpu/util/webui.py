@@ -44,6 +44,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Block migrations</h2><div id="migr" class="muted">none</div>
 <h2>Precision fallbacks</h2><div id="prec" class="muted">none</div>
 <h2>Autoscaler decisions</h2><div id="autoscale" class="muted">none</div>
+<h2>Doctor</h2><div id="doctor" class="muted">none</div>
 <script>
 async function j(r) { return (await fetch('/api/v1/' + r)).json(); }
 function esc(v) {
@@ -136,6 +137,19 @@ async function refresh() {
   if (asc.length) document.getElementById('autoscale').innerHTML =
     table(asc.slice(-20), ['kind', 'seq', 'action', 'direction', 'reason',
                            'outcome', 'master', 'nDevices', 'ok', 'time']);
+  const diags = await j('diagnosis');
+  if (diags.length) {
+    // newest report's ranked findings; a healthy run renders as such
+    const last = diags[diags.length - 1];
+    const rows = (last.report && last.report.findings || []).map(f => ({
+      severity: f.severity, kind: f.kind, summary: f.summary,
+      evidence: JSON.stringify(f.evidence)}));
+    document.getElementById('doctor').innerHTML =
+      '<p>' + esc(last.source) + ': ' + esc(last.nFindings) +
+      ' finding(s)</p>' +
+      (rows.length ? table(rows, ['severity', 'kind', 'summary',
+                                  'evidence']) : '');
+  }
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>

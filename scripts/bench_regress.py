@@ -1,0 +1,129 @@
+"""bench-regress: the regression sentinel's gate (observe/regress.py).
+
+Three steps, all deterministic:
+
+1. BACKFILL: ingest the committed BENCH_r*.json runs (the 13.9 -> 190
+   G ops/s trajectory) into ``artifacts/bench_history.jsonl``. Those
+   pre-meta files carry no run identity, so the backfill synthesizes it
+   from the run number (``run_id=rNN``, ``t_logical=NN``). Idempotent:
+   rows are keyed by (run_id, metric), so re-running appends nothing —
+   artifacts/ is gitignored and this re-seeds it on every fresh checkout.
+2. INGEST (optional): ``--ingest FILE`` appends the BENCH JSON line a
+   fresh ``python bench.py > FILE`` run produced (its own ``meta`` block
+   is the row identity). `make bench` tees stdout to
+   artifacts/bench_last.json, so `make bench bench-regress` gates the
+   run it just made.
+3. GATE: judge each metric's newest row against the median+MAD of its
+   comparable history (cyclone.regress.* thresholds) and exit nonzero
+   on any regression verdict. ``--inject-regression`` appends a
+   synthetic 40%-of-median headline row to a THROWAWAY copy of the
+   ledger and asserts the gate trips — the sentinel's own self-test
+   (the committed history itself must stay green).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(REPO, "artifacts", "bench_history.jsonl")
+
+
+def backfill(ledger: str) -> int:
+    from cycloneml_tpu.observe import regress
+    rows = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        num = int(m.group(1))
+        with open(path, "r", encoding="utf-8") as fh:
+            rec = json.load(fh)
+        block = rec.get("parsed")
+        if not isinstance(block, dict) or "metric" not in block:
+            continue
+        rows.extend(regress.rows_from_bench(
+            block, meta={"run_id": f"r{num:02d}", "git_sha": "",
+                         "t_logical": num}))
+    return regress.append(ledger, rows)
+
+
+def ingest(ledger: str, path: str) -> int:
+    from cycloneml_tpu.observe import regress
+    with open(path, "r", encoding="utf-8") as fh:
+        block = json.loads(fh.read().strip().splitlines()[-1])
+    return regress.append(ledger, regress.rows_from_bench(block))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="bench history drift gate")
+    ap.add_argument("--ledger", default=LEDGER)
+    ap.add_argument("--ingest", metavar="FILE",
+                    help="BENCH JSON line (e.g. artifacts/bench_last.json)")
+    ap.add_argument("--inject-regression", action="store_true",
+                    help="self-test: gate a throwaway ledger copy with a "
+                         "synthetic 40%%-of-median regression row appended")
+    ns = ap.parse_args()
+
+    from cycloneml_tpu.observe import regress
+
+    n_backfilled = backfill(ns.ledger)
+    n_ingested = 0
+    if ns.ingest and os.path.exists(ns.ingest):
+        n_ingested = ingest(ns.ledger, ns.ingest)
+    rows = regress.load(ns.ledger)
+    print(f"info: ledger {ns.ledger}: {len(rows)} row(s) "
+          f"(+{n_backfilled} backfilled, +{n_ingested} ingested)",
+          file=sys.stderr)
+
+    if ns.inject_regression:
+        # the synthetic row rides a throwaway copy: the REAL ledger's
+        # history must never contain a fabricated measurement
+        headline = [r for r in rows
+                    if r["metric"] == "logreg_fit_e2e_throughput"]
+        if not headline:
+            print("FAIL: no headline history to inject against",
+                  file=sys.stderr)
+            return 1
+        med = sorted(float(r["value"]) for r in headline)[len(headline) // 2]
+        synthetic = dict(headline[-1], value=round(med * 0.4, 1),
+                         run_id="synthetic-regress",
+                         t_logical=max(int(r.get("t_logical", 0))
+                                       for r in rows) + 1)
+        scratch = ns.ledger + ".selftest"
+        try:
+            with open(scratch, "w", encoding="utf-8") as fh:
+                for r in rows + [synthetic]:
+                    fh.write(regress.canonical_row(r) + "\n")
+            verdicts = regress.detect(regress.load(scratch))
+        finally:
+            if os.path.exists(scratch):
+                os.remove(scratch)
+        rc, bad = regress.gate(verdicts)
+        for v in verdicts:
+            print(json.dumps(v, sort_keys=True))
+        if rc == 0 or "logreg_fit_e2e_throughput" not in bad:
+            print("FAIL: synthetic 40% regression row did not trip the "
+                  "gate", file=sys.stderr)
+            return 1
+        print("info: synthetic regression correctly tripped the gate",
+              file=sys.stderr)
+        return 0
+
+    verdicts = regress.detect(rows)
+    for v in verdicts:
+        print(json.dumps(v, sort_keys=True))
+    rc, bad = regress.gate(verdicts)
+    if rc:
+        print(f"FAIL: regression in {', '.join(bad)} — the newest run "
+              f"drifted past median+MAD of its history", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

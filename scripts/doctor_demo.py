@@ -1,0 +1,186 @@
+"""doctor-demo: the performance doctor's acceptance gate.
+
+Three phases, one process, 8 virtual CPU devices:
+
+1. CLEAN: a warmed in-core LogisticRegression fit, traced. The doctor
+   must return ZERO findings — every rule abstains (no recompiles past
+   warm-up, one readback, no streaming, no faults, no skew latches, no
+   costs peaks on CPU). A finding here is a false positive by
+   construction.
+2. PATHOLOGICAL: the same problem driven badly, deliberately —
+   - forced recompiles: ``clear_program_cache()`` between fits inside
+     the traced window (recompile-storm),
+   - an unmasked straggler: a seeded FaultSchedule delays shard 0's
+     staging lane every epoch of a streamed fit (straggler via the live
+     SkewDetector lane snapshot, fault-pressure via the chaos instants),
+   - a thrashing shard-set cache: ``cyclone.oocore.cacheBytes=1`` with
+     alternating attaches (cache-restream).
+   The doctor must convict >= 4 DISTINCT finding kinds, each carrying
+   evidence.
+3. DETERMINISM: the pathological window exports to a Chrome trace and
+   ``python -m cycloneml_tpu.observe.doctor <trace> --json`` runs twice
+   — byte-identical output (the autoscale-sim idiom: same input, same
+   bytes, no wall-clock in the report).
+
+Exits nonzero on any violated gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    from cycloneml_tpu.conf import (OOCORE_CACHE_BYTES, SKEW_MIN_SAMPLES,
+                                    CycloneConf)
+    from cycloneml_tpu.context import CycloneContext
+    from cycloneml_tpu.dataset.dataset import InstanceDataset
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import export, tracing
+    from cycloneml_tpu.observe.diagnose import diagnose
+    from cycloneml_tpu.oocore import StreamingDataset, shard_dataset
+
+    conf = (CycloneConf()
+            .set("cyclone.master", "local-mesh[*]")
+            .set("cyclone.trace.enabled", True)
+            # streamed lanes get ~1 sample per epoch; a short demo fit
+            # must still accumulate a verdict-worthy window
+            .set(SKEW_MIN_SAMPLES.key, 2)
+            # a 1-byte budget: every attach over-runs it, so alternating
+            # content keys evict each other — the thrash the doctor flags
+            .set(OOCORE_CACHE_BYTES.key, 1))
+    ctx = CycloneContext(conf)
+    tr = tracing.active()
+    assert tr is not None, "trace.enabled must install a tracer"
+
+    rng = np.random.RandomState(0)
+    n, d = 8192, 32
+    x = rng.randn(n, d).astype(np.float32)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+    ds = InstanceDataset.from_numpy(ctx, x, y)
+    est = lambda: LogisticRegression(maxIter=4, regParam=0.1)  # noqa: E731
+
+    rc = 0
+
+    # -- phase 1: clean warm fit => zero findings -----------------------------
+    est().fit(ds)                      # warm the program cache
+    mark = tr.mark()
+    est().fit(ds)
+    clean_spans = tr.snapshot(since=mark)
+    clean = diagnose(spans=clean_spans, conf=ctx.conf, source="live")
+    print(f"info: clean fit: {len(clean.findings)} finding(s) over "
+          f"{clean.n_spans} spans", file=sys.stderr)
+    if clean.findings:
+        print("FAIL: the doctor convicted a clean warm fit:\n"
+              + clean.render_text(), file=sys.stderr)
+        rc = 1
+
+    # -- phase 2: pathological fit => >= 4 distinct kinds ---------------------
+    from cycloneml_tpu.parallel.collectives import clear_program_cache
+    from cycloneml_tpu.parallel.faults import FaultInjector, FaultSchedule
+
+    n_shards = 16
+    shard_rows = n // n_shards
+
+    def chunks():
+        for i in range(0, n, shard_rows):
+            yield x[i:i + shard_rows], y[i:i + shard_rows], None
+
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d,
+                                       shard_rows=shard_rows)
+    est().fit(sds)                     # warm the per-shard program
+
+    mark = tr.mark()
+    # recompile storm: the SAME program re-enters compilation 3x (excess 2)
+    for _ in range(3):
+        clear_program_cache()
+        est().fit(ds)
+    # unmasked straggler: shard 0's staging lane pays +40 ms every epoch
+    # (deterministic: shuffle is off, so staging invocation k*n_shards+1
+    # is always shard 0); each delay fires a chaos instant too
+    sched = FaultSchedule(seed=0)
+    sched.at("oocore.stage", [1 + k * n_shards for k in range(64)],
+             delay_s=0.04)
+    with FaultInjector(sched) as inj:
+        est().fit(sds)
+    # cache thrash: alternating content on a 1-byte budget
+    x2 = rng.randn(2048, d).astype(np.float32)
+    ds2 = InstanceDataset.from_numpy(ctx, x2,
+                                     (x2 @ rng.randn(d) > 0).astype(
+                                         np.float64))
+    small = InstanceDataset.from_numpy(ctx, x[:2048], y[:2048])
+    for victim in (small, ds2, small):
+        shard_dataset(victim, shard_rows=512).close()
+    from cycloneml_tpu.oocore import shard_set_cache
+    cache_stats = shard_set_cache().stats()
+
+    patho_spans = tr.snapshot(since=mark)
+    patho = diagnose(spans=patho_spans, conf=ctx.conf,
+                     cache_stats=cache_stats, source="live")
+    kinds = sorted(set(patho.kinds))
+    print(f"info: pathological fit: {len(patho.findings)} finding(s), "
+          f"kinds={kinds}, {len(inj.log)} fault(s) fired", file=sys.stderr)
+    print(patho.render_text(), file=sys.stderr)
+    if len(kinds) < 4:
+        print(f"FAIL: expected >= 4 distinct finding kinds, got {kinds}",
+              file=sys.stderr)
+        rc = 1
+    if any(not f.evidence for f in patho.findings):
+        print("FAIL: a finding carries no evidence", file=sys.stderr)
+        rc = 1
+    for expected in ("recompile-storm", "straggler", "fault-pressure",
+                     "cache-restream"):
+        if expected not in kinds:
+            print(f"FAIL: expected a {expected} finding", file=sys.stderr)
+            rc = 1
+
+    # -- phase 3: byte-identical --json over the exported trace ---------------
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "patho.trace.json")
+        export.write_chrome_trace(
+            export.chrome_trace(tr, spans=patho_spans), trace_path)
+        outs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, "-m", "cycloneml_tpu.observe.doctor",
+                 trace_path, "--json"],
+                capture_output=True, cwd=REPO,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            if proc.returncode not in (0, 2):
+                print(f"FAIL: doctor CLI crashed rc={proc.returncode}: "
+                      f"{proc.stderr.decode()[-500:]}", file=sys.stderr)
+                rc = 1
+            outs.append(proc.stdout)
+        if outs[0] != outs[1]:
+            print("FAIL: --json reports differ across two runs over the "
+                  "same trace", file=sys.stderr)
+            rc = 1
+        else:
+            offline = json.loads(outs[0].decode())
+            print(f"info: offline CLI report byte-identical twice "
+                  f"({len(offline['findings'])} finding(s) from the trace "
+                  f"alone)", file=sys.stderr)
+
+    sds.close()
+    ctx.stop()
+    if rc == 0:
+        print("doctor-demo: all gates green", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""JX018/JX001 should-pass fixture: the performance doctor's read-only
+span walk. Diagnosis runs over an already-captured span window — pure
+host arithmetic, no dispatch, no device pulls, no clocks — so the whole
+rule pack must stay silent on it (the observe/diagnose contract)."""
+
+
+def _median(values):
+    vs = sorted(values)
+    n = len(vs)
+    mid = n // 2
+    return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def walk_compile_spans(spans):
+    # evidence join #1: recompiles past warm-up, grouped by program name
+    per_program = {}
+    for s in spans:
+        if s.kind == "compile":
+            per_program[s.name] = per_program.get(s.name, 0) + 1
+    return {name: count - 1 for name, count in sorted(per_program.items())
+            if count > 1}
+
+
+def walk_lane_medians(spans, n_lanes):
+    # evidence join #2: per-lane staging medians from the trace alone
+    lanes = {}
+    for s in spans:
+        if s.kind == "transfer" and s.name == "oocore.stage":
+            shard = s.attrs.get("shard")
+            if shard is None:
+                continue
+            lanes.setdefault(int(shard) % n_lanes, []).append(s.duration_s)
+    return {pos: _median(vals) for pos, vals in sorted(lanes.items())}
+
+
+def convict_stragglers(lane_medians, mad_factor, rel_factor):
+    # pure-host conviction: every gate is arithmetic over the join above
+    meds = sorted(lane_medians.values())
+    if not meds:
+        return []
+    group = _median(meds)
+    mad = _median([abs(v - group) for v in meds])
+    return [pos for pos, med in sorted(lane_medians.items())
+            if med > group + mad_factor * mad and med > rel_factor * group]

@@ -1,0 +1,44 @@
+"""JX023 should-flag fixture: a bench-history ledger append whose row
+order (and flush jitter) is not canonical — replaying the same runs
+produces a different file.
+
+===============  ==========================================
+point            fired from
+===============  ==========================================
+``demo.append``  every function below
+===============  ==========================================
+"""
+import json
+import random
+import time
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def append_rows_hash_ordered(ledger, rows):
+    # rows arrive as a dedup SET; iterating it writes the ledger in
+    # hash order — the append-only file is no longer byte-stable
+    inject("demo.append", n=len(rows))
+    out = []
+    for row in set(rows):                                       # JX023
+        out.append(json.dumps(row))
+    ledger.extend(out)
+    return out
+
+
+def append_with_flush_jitter(ledger, row):
+    inject("demo.append", metric=row)
+    ledger.append(json.dumps(row))
+    return random.uniform(0.0, 0.01)                            # JX023
+
+
+def append_unless_slow(ledger, row, t0):
+    inject("demo.append", metric=row)
+    # dropping rows based on a wall-clock read makes ledger CONTENT
+    # depend on host speed, not on the measured runs
+    if time.monotonic() - t0 > 0.5:                             # JX023
+        return 0
+    ledger.append(json.dumps(row))
+    return 1

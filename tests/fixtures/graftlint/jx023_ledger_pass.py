@@ -1,0 +1,36 @@
+"""JX023 should-pass fixture: the canonical ledger append — sorted row
+order, sorted JSON keys, no clocks, no unseeded jitter. Replaying the
+same runs rewrites the same bytes (the observe/regress contract).
+
+===============  ==========================================
+point            fired from
+===============  ==========================================
+``demo.append``  every function below
+===============  ==========================================
+"""
+import json
+
+
+def inject(point, **info):
+    """Fixture stand-in for parallel.faults.inject (hosts the table)."""
+
+
+def canonical_row(row):
+    return json.dumps(row, sort_keys=True, separators=(",", ":"))
+
+
+def append_rows_canonical(ledger, rows):
+    # dedup via a set is fine for MEMBERSHIP; the write order comes
+    # from sorted(), so the ledger is byte-stable across replays
+    inject("demo.append", n=len(rows))
+    out = [canonical_row(r) for r in sorted(set(rows))]
+    ledger.extend(out)
+    return out
+
+
+def append_if_fresh(ledger, row, seen):
+    inject("demo.append", metric=row)
+    if row in seen:
+        return 0
+    ledger.append(canonical_row(row))
+    return 1

@@ -1,0 +1,545 @@
+"""Performance doctor + regression sentinel (observe/diagnose, regress).
+
+Three planes of coverage:
+
+- rule units over SYNTHETIC spans: each rule fires on its pathology and
+  abstains on healthy input (the clean-fit == zero-findings contract),
+- plumbing: canonical-JSON determinism, Chrome-trace round-trip,
+  DiagnosisCompleted -> store -> /api/v1/diagnosis -> journal replay,
+  SkewDetector.lane_snapshot (one-lock consistency + torn-read hammer),
+- the ledger: rows_from_bench meta joins, idempotent append, median+MAD
+  drift verdicts in both directions, the non-stationary-history cap,
+  and the chaos leg: a seeded fault-injected streamed fit diagnoses to
+  EXACTLY the injected pathologies — nothing else.
+"""
+
+import itertools
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cycloneml_tpu.observe import regress, tracing
+from cycloneml_tpu.observe.diagnose import (DiagnosisReport, DoctorConfig,
+                                            Finding, diagnose,
+                                            lane_stats_from_spans,
+                                            overlap_fraction)
+from cycloneml_tpu.observe.skew import SkewDetector
+from cycloneml_tpu.observe.tracing import Span
+
+_ids = itertools.count()
+
+
+def mk(kind, name, t0=0.0, dur=0.001, **attrs):
+    s = Span(f"s{next(_ids)}", "", kind, name, 0, attrs)
+    s.t0 = t0
+    s.t1 = t0 + dur
+    return s
+
+
+def instant(name, t=0.0, **attrs):
+    return mk("instant", name, t0=t, dur=0.0, **attrs)
+
+
+def _clean_window():
+    """What a warm in-core fit's span window looks like."""
+    return [mk("job", "LogisticRegression.fit", 0.0, 0.1),
+            mk("dispatch", "lbfgs.chunk", 0.01, 0.08),
+            mk("transfer", "lbfgs.readback", 0.09, 0.001),
+            instant("cache.hit", 0.005, cache="program")]
+
+
+# -- rule units ----------------------------------------------------------------
+
+def test_clean_window_diagnoses_to_zero_findings():
+    report = diagnose(spans=_clean_window(), skew=None, cache_stats=None)
+    assert report.findings == []
+    assert report.n_spans == 4
+    assert "spans" in report.inputs and "profile" in report.inputs
+
+
+def test_recompile_storm_fires_past_warmup_and_abstains_below():
+    warm = [mk("compile", "lbfgs.chunk", 0.0, 0.5)]        # excess 0
+    assert diagnose(spans=_clean_window() + warm, skew=None,
+                    cache_stats=None).findings == []
+    storm = [mk("compile", "lbfgs.chunk", i * 1.0, 0.5) for i in range(3)]
+    report = diagnose(spans=_clean_window() + storm, skew=None,
+                      cache_stats=None)
+    assert report.kinds == ["recompile-storm"]
+    (f,) = report.findings
+    assert f.severity == "warning"
+    assert f.evidence["excess_compiles"] == {"lbfgs.chunk": 2}
+    assert f.evidence["total_excess"] == 2
+
+
+def test_transfer_stall_fires_on_readbacks_not_streaming():
+    dispatch = [mk("dispatch", "lbfgs.chunk", i * 0.1, 0.01)
+                for i in range(10)]
+    readbacks = [mk("transfer", "lbfgs.readback", i * 0.1 + 0.05, 0.02)
+                 for i in range(10)]
+    report = diagnose(spans=dispatch + readbacks, skew=None,
+                      cache_stats=None)
+    assert "transfer-stall" in report.kinds
+    f = report.findings[report.kinds.index("transfer-stall")]
+    assert f.evidence["transfer_count"] == 10
+    assert f.evidence["transfer_seconds"] == pytest.approx(0.2)
+    # the SAME seconds as oocore.stage staging spans: overlap's problem,
+    # not a stall — the rule must exclude the streaming plane
+    staging = [mk("transfer", "oocore.stage", i * 0.1 + 0.05, 0.02,
+                  shard=i) for i in range(10)]
+    assert "transfer-stall" not in diagnose(
+        spans=dispatch + staging, skew=None, cache_stats=None).kinds
+
+
+def test_straggler_convicted_from_trace_spans_alone():
+    spans = []
+    t = 0.0
+    for _ in range(8):                      # 8 samples per lane
+        for shard in range(4):
+            dur = 0.050 if shard == 0 else 0.005
+            spans.append(mk("transfer", "oocore.stage", t, dur, shard=shard))
+            t += 0.06
+    cfg_spans = spans + _clean_window()
+    report = diagnose(spans=cfg_spans, skew=None, cache_stats=None,
+                      conf=None)
+    # default skew_min_samples=8 is exactly met
+    assert "straggler" in report.kinds
+    f = report.findings[report.kinds.index("straggler")]
+    assert f.evidence["detector"] == "trace"
+    assert [b["lane"] for b in f.evidence["outliers"]] == ["shard0"]
+    lanes = lane_stats_from_spans(spans)
+    assert len(lanes["shard0"]) == 8
+
+
+def test_straggler_from_live_snapshot_dedups_trace_lane():
+    snap = {"oocore.stage": {
+        "groupMedianS": 0.005, "madS": 0.0002,
+        "lanes": {"shard0": {"n": 8, "medianS": 0.05, "straggler": True,
+                             "sloBreached": False},
+                  "shard1": {"n": 8, "medianS": 0.005, "straggler": False,
+                             "sloBreached": False}}}}
+    spans = []
+    t = 0.0
+    for _ in range(8):
+        for shard in range(4):
+            dur = 0.050 if shard == 0 else 0.005
+            spans.append(mk("transfer", "oocore.stage", t, dur, shard=shard))
+            t += 0.06
+    report = diagnose(spans=spans, skew=snap, cache_stats=None)
+    stragglers = [f for f in report.findings if f.kind == "straggler"]
+    # ONE finding: the live latch wins, the trace echo of the SAME lane
+    # must not double-report
+    assert len(stragglers) == 1
+    assert stragglers[0].evidence["detector"] == "live"
+    assert stragglers[0].evidence["lanes"] == ["shard0"]
+
+
+def test_underlap_fires_on_serialized_stream_and_passes_overlapped():
+    serial, overlapped = [], []
+    for i in range(8):
+        serial.append(mk("transfer", "oocore.stage", i * 0.02, 0.01,
+                         shard=i))
+        serial.append(mk("dispatch", "oocore.shard", i * 0.02 + 0.01, 0.01,
+                         shard=i))
+        overlapped.append(mk("transfer", "oocore.stage", i * 0.01, 0.01,
+                             shard=i))
+        overlapped.append(mk("dispatch", "oocore.shard", i * 0.01 + 0.001,
+                             0.01, shard=i))
+    report = diagnose(spans=serial, skew=None, cache_stats=None)
+    assert "under-lapped-streaming" in report.kinds
+    f = report.findings[report.kinds.index("under-lapped-streaming")]
+    assert f.evidence["overlap_fraction"] < 0.30
+    frac, *_ = overlap_fraction(overlapped)
+    assert frac > 0.30
+    assert "under-lapped-streaming" not in diagnose(
+        spans=overlapped, skew=None, cache_stats=None).kinds
+
+
+def test_serving_pressure_on_shed_and_slo():
+    stats = {"models": {"m": {"latencyMs": {"p99": 40.0}}},
+             "totals": {"shed": 3, "requests": 100}}
+    report = diagnose(spans=[], serving_stats=stats, skew=None,
+                      cache_stats=None)
+    assert report.kinds == ["serving-pressure"]
+    assert report.findings[0].evidence["shed"] == 3
+    # healthy batcher: no shed, no SLO configured
+    ok = {"models": {"m": {"latencyMs": {"p99": 40.0}}},
+          "totals": {"shed": 0, "requests": 100}}
+    assert diagnose(spans=[], serving_stats=ok, skew=None,
+                    cache_stats=None).findings == []
+    # p99 over a configured SLO convicts even with zero shed
+    from cycloneml_tpu.observe.diagnose import _rule_serving
+    cfg = DoctorConfig(slo_serving_ms=25.0)
+    (f,) = _rule_serving(ok, cfg)
+    assert f.evidence["worst_p99_ms"] == 40.0
+    assert f.evidence["worst_model"] == "m"
+
+
+def test_precision_churn_counts_fallback_instants():
+    spans = _clean_window() + [instant("precision.fallback", 0.02,
+                                       dtype="float8_e4m3")]
+    report = diagnose(spans=spans, skew=None, cache_stats=None)
+    assert report.kinds == ["precision-churn"]
+    assert report.findings[0].evidence["fp8_fallbacks"] == 1
+
+
+def test_cache_restream_fires_on_thrash_not_on_healthy_reuse():
+    thrash = {"hits": 0, "misses": 3, "evictionsLru": 2,
+              "evictionsCorrupt": 0}
+    report = diagnose(spans=[], cache_stats=thrash, skew=None)
+    assert report.kinds == ["cache-restream"]
+    assert report.findings[0].evidence["misses"] == 3
+    healthy = {"hits": 9, "misses": 1, "evictionsLru": 1,
+               "evictionsCorrupt": 0}
+    assert diagnose(spans=[], cache_stats=healthy, skew=None).findings == []
+
+
+def test_fault_pressure_joins_chaos_instants_and_stage_retries():
+    spans = [instant("fault", 0.01, point="oocore.stage", invocation=1,
+                     fault="SlowStep"),
+             instant("fault", 0.02, point="oocore.stage", invocation=17,
+                     fault="SlowStep"),
+             instant("oocore.stage_retry", 0.03, shard=2, attempt=1)]
+    report = diagnose(spans=spans, skew=None, cache_stats=None)
+    assert report.kinds == ["fault-pressure"]
+    ev = report.findings[0].evidence
+    assert ev["faults_injected"] == 2
+    assert ev["retries"] == 1
+    assert ev["points"] == {"oocore.stage": 2}
+
+
+# -- report plumbing -----------------------------------------------------------
+
+def test_report_canonical_json_is_deterministic_and_round_trips():
+    spans = _clean_window() + [
+        mk("compile", "lbfgs.chunk", i * 1.0, 0.5) for i in range(3)]
+    a = diagnose(spans=spans, skew=None, cache_stats=None, source="trace")
+    b = diagnose(spans=spans, skew=None, cache_stats=None, source="trace")
+    assert a.to_json() == b.to_json()          # byte-identical
+    back = DiagnosisReport.from_dict(json.loads(a.to_json()))
+    assert back == a                           # dataclass round-trip
+    assert back.findings[0] == Finding.from_dict(
+        a.findings[0].to_dict())
+
+
+def test_chrome_trace_round_trip_preserves_diagnosis():
+    """Export the window to Trace Event Format, parse it back, diagnose:
+    the offline CLI's path must convict the same kinds with the same
+    lanes as the in-process window."""
+    from cycloneml_tpu.observe.export import (chrome_trace,
+                                              spans_from_chrome_trace)
+    spans = [mk("compile", "lbfgs.chunk", i * 1.0, 0.5) for i in range(3)]
+    t = 10.0
+    for _ in range(8):
+        for shard in range(4):
+            dur = 0.050 if shard == 0 else 0.005
+            spans.append(mk("transfer", "oocore.stage", t, dur, shard=shard))
+            t += 0.06
+    spans.append(instant("fault", 20.0, point="oocore.stage", invocation=1,
+                         fault="SlowStep"))
+    live = diagnose(spans=spans, skew=None, cache_stats=None)
+
+    tracer = tracing.Tracer(max_spans=1000)
+    parsed = spans_from_chrome_trace(chrome_trace(tracer, spans=spans))
+    offline = diagnose(spans=parsed, skew=None, cache_stats=None)
+    assert offline.kinds == live.kinds
+    assert sorted(set(offline.kinds)) == ["fault-pressure",
+                                          "recompile-storm", "straggler"]
+    off_straggler = offline.findings[offline.kinds.index("straggler")]
+    assert [b["lane"] for b in off_straggler.evidence["outliers"]] \
+        == ["shard0"]
+    # and the parsed window itself re-diagnoses byte-identically — the
+    # CLI invariant `make doctor` leans on
+    again = diagnose(spans=spans_from_chrome_trace(
+        chrome_trace(tracer, spans=spans)), skew=None, cache_stats=None)
+    assert again.to_json() == offline.to_json()
+
+
+def test_diagnosis_event_reaches_store_api_and_survives_replay(tmp_path):
+    from cycloneml_tpu.util.events import (DiagnosisCompleted, EventJournal,
+                                           ListenerBus)
+    from cycloneml_tpu.util.status import AppStatusListener, api_v1
+
+    report = diagnose(spans=_clean_window() + [
+        mk("compile", "lbfgs.chunk", i * 1.0, 0.5) for i in range(3)],
+        skew=None, cache_stats=None, source="live")
+    path = tmp_path / "events.jsonl"
+    journal = EventJournal(str(path))
+    live = AppStatusListener()
+    bus = ListenerBus()
+    bus.add_listener(journal)
+    bus.add_listener(live)
+    bus.post(DiagnosisCompleted(source=report.source,
+                                n_findings=len(report.findings),
+                                report=report.to_dict()))
+    bus.stop()
+    journal.close()
+
+    rows = live.store.diagnosis_reports()
+    assert len(rows) == 1
+    assert rows[0]["nFindings"] == 1
+    assert rows[0]["report"]["findings"][0]["kind"] == "recompile-storm"
+    assert api_v1(live.store, "diagnosis") == rows
+    # history-server fidelity: replay rebuilds the identical rows
+    replayed = AppStatusListener()
+    for e in EventJournal.replay(str(path)):
+        replayed.on_event(e)
+    assert replayed.store.diagnosis_reports() == rows
+    # the replayed dict round-trips into the same report object
+    assert DiagnosisReport.from_dict(
+        replayed.store.diagnosis_reports()[0]["report"]) == report
+
+
+# -- SkewDetector.lane_snapshot (satellite: one-lock consistency) ---------------
+
+def test_lane_snapshot_reports_medians_and_latched_verdicts():
+    det = SkewDetector(window=16, min_samples=4, mad_factor=4.0,
+                       rel_factor=1.5)
+    for _ in range(8):
+        for lane in ("shard1", "shard2", "shard3"):
+            det.observe("oocore.stage", lane, 0.010)
+        det.observe("oocore.stage", "shard0", 0.050)
+    snap = det.lane_snapshot()
+    g = snap["oocore.stage"]
+    assert g["groupMedianS"] == pytest.approx(0.010)
+    assert set(g["lanes"]) == {"shard0", "shard1", "shard2", "shard3"}
+    assert g["lanes"]["shard0"]["straggler"] is True
+    assert g["lanes"]["shard0"]["medianS"] == pytest.approx(0.050)
+    assert g["lanes"]["shard1"]["straggler"] is False
+    assert g["lanes"]["shard1"]["n"] == 8
+    # the snapshot is exactly what the doctor convicts on
+    report = diagnose(spans=[], skew=snap, cache_stats=None)
+    assert report.kinds == ["straggler"]
+    assert report.findings[0].evidence["lanes"] == ["shard0"]
+
+
+def test_lane_snapshot_group_filter():
+    det = SkewDetector(window=16, min_samples=2)
+    for _ in range(4):
+        det.observe("oocore.stage", "shard0", 0.01)
+        det.observe("serving.dispatch", "m0", 0.02)
+    assert set(det.lane_snapshot()) == {"oocore.stage", "serving.dispatch"}
+    only = det.lane_snapshot(group="oocore.stage")
+    assert set(only) == {"oocore.stage"}
+
+
+def test_lane_snapshot_hammer_no_torn_reads():
+    """Writers observe() while a reader snapshots: every snapshot must be
+    internally consistent (a lane present => its stats all present, n
+    bounded by the window) — the one-lock contract."""
+    det = SkewDetector(window=16, min_samples=2)
+    stop = threading.Event()
+    errs = []
+
+    def writer(lane):
+        rng = np.random.RandomState(hash(lane) % 2**31)
+        while not stop.is_set():
+            det.observe("oocore.stage", lane, 0.005 + 0.001 * rng.rand())
+
+    threads = [threading.Thread(target=writer, args=(f"shard{i}",),
+                                daemon=True) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            snap = det.lane_snapshot()
+            try:
+                for group, g in snap.items():
+                    assert set(g) == {"groupMedianS", "madS", "lanes"}
+                    for lane, row in g["lanes"].items():
+                        assert set(row) == {"n", "medianS", "straggler",
+                                            "sloBreached"}
+                        assert 0 < row["n"] <= 16
+                        assert row["medianS"] is None or row["medianS"] > 0
+            except AssertionError as exc:   # pragma: no cover
+                errs.append(exc)
+                break
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert errs == []
+
+
+# -- the regression sentinel ----------------------------------------------------
+
+def _bench_block(value, run_id, t, serving=None):
+    block = {"metric": "logreg_fit_e2e_throughput", "value": value,
+             "unit": "rows_per_s",
+             "meta": {"schema_version": 1, "run_id": run_id,
+                      "git_sha": "abc1234", "t_logical": t},
+             "hardware": {"platform": "cpu", "device_kind": "cpu",
+                          "n_devices": 8}}
+    if serving:
+        block["serving"] = serving
+    return block
+
+
+def test_rows_from_bench_joins_meta_and_gated_submetrics():
+    rows = regress.rows_from_bench(_bench_block(
+        1000.0, "r10", 10,
+        serving={"requests_per_s": 500.0, "p99_ms": 12.5}))
+    assert [r["metric"] for r in rows] == [
+        "logreg_fit_e2e_throughput", "serving.requests_per_s",
+        "serving.p99_ms"]
+    head = rows[0]
+    assert head["run_id"] == "r10" and head["t_logical"] == 10
+    assert head["git_sha"] == "abc1234"
+    assert head["hw"] == {"platform": "cpu", "device": "cpu",
+                          "n_devices": 8}
+    assert head["direction"] == "higher"
+    assert rows[2]["direction"] == "lower"      # p99: lower is better
+    # canonical rows are byte-stable
+    assert regress.canonical_row(head) == regress.canonical_row(
+        json.loads(regress.canonical_row(head)))
+
+
+def test_append_is_idempotent_keyed_by_run_and_metric(tmp_path):
+    ledger = str(tmp_path / "hist.jsonl")
+    rows = regress.rows_from_bench(_bench_block(1000.0, "r10", 10))
+    assert regress.append(ledger, rows) == 1
+    assert regress.append(ledger, rows) == 0    # replay adds nothing
+    rows2 = regress.rows_from_bench(_bench_block(1100.0, "r11", 11))
+    assert regress.append(ledger, rows2) == 1
+    assert len(regress.load(ledger)) == 2
+
+
+def test_detect_verdicts_in_both_directions():
+    def series(*values, metric="m", direction="higher"):
+        return [{"metric": metric, "value": v, "run_id": f"r{i}",
+                 "t_logical": i, "hw": None, "direction": direction}
+                for i, v in enumerate(values)]
+
+    # stable history, candidate inside the band
+    (v,) = regress.detect(series(100.0, 101.0, 99.0, 100.0, 100.5))
+    assert v["verdict"] == "ok" and v["window_n"] == 4
+    # a drop past max(4*MAD, 5%) regresses
+    (v,) = regress.detect(series(100.0, 101.0, 99.0, 100.0, 60.0))
+    assert v["verdict"] == "regression"
+    # a jump up is an improvement, never a failure
+    (v,) = regress.detect(series(100.0, 101.0, 99.0, 100.0, 160.0))
+    assert v["verdict"] == "improvement"
+    assert regress.gate(regress.detect(
+        series(100.0, 101.0, 99.0, 100.0, 160.0))) == (0, [])
+    # lower-is-better metrics invert: p99 doubling IS the regression
+    (v,) = regress.detect(series(10.0, 10.2, 9.9, 10.1, 20.0,
+                                 direction="lower"))
+    assert v["verdict"] == "regression"
+    rc, bad = regress.gate([v])
+    assert rc == 1 and bad == ["m"]
+    # too little history abstains
+    (v,) = regress.detect(series(100.0, 95.0))
+    assert v["verdict"] == "insufficient-history"
+
+
+def test_detect_caps_threshold_on_nonstationary_history():
+    """A fast-improving history (the committed r02->r05 is 13.9x) has a
+    MAD so wide that 4*MAD exceeds the median — uncapped, NO drop could
+    ever trip the gate. The cap keeps the sentinel honest."""
+    rows = [{"metric": "m", "value": v, "run_id": f"r{i}", "t_logical": i,
+             "hw": None, "direction": "higher"}
+            for i, v in enumerate([10.0, 40.0, 80.0, 160.0, 20.0])]
+    (v,) = regress.detect(rows)
+    assert v["verdict"] == "regression"
+    assert v["threshold"] <= 0.5 * v["median"]
+
+
+def test_detect_separates_incomparable_hardware():
+    """Rows from different hardware never judge each other."""
+    base = {"metric": "m", "direction": "higher"}
+    cpu = {"platform": "cpu", "device": "cpu", "n_devices": 8}
+    tpu = {"platform": "tpu", "device": "v5e", "n_devices": 8}
+    rows = [dict(base, value=100.0 + i, run_id=f"c{i}", t_logical=i, hw=cpu)
+            for i in range(4)]
+    # the newest row is TPU: its comparable history is empty
+    rows.append(dict(base, value=5.0, run_id="t0", t_logical=9, hw=tpu))
+    (v,) = regress.detect(rows)
+    assert v["verdict"] == "insufficient-history"
+
+
+def test_ctx_diagnose_posts_report_to_live_status_plane(ctx):
+    """The ctx.diagnose() surface: report returned AND visible at
+    /api/v1/diagnosis via the event plumbing."""
+    from cycloneml_tpu.util.status import api_v1
+
+    storm = _clean_window() + [
+        mk("compile", "lbfgs.chunk", i * 1.0, 0.5) for i in range(3)]
+    report = ctx.diagnose(spans=storm)
+    assert "recompile-storm" in report.kinds
+    assert ctx.listener_bus.wait_until_empty()
+    rows = api_v1(ctx.status_store, "diagnosis")
+    assert rows and rows[-1]["report"] == report.to_dict()
+    assert rows[-1]["nFindings"] == len(report.findings)
+
+
+# -- chaos: injected pathologies and NOTHING else -------------------------------
+
+def test_doctor_over_seeded_chaos_run_flags_exactly_the_injections(ctx):
+    """A streamed fit under a seeded FaultSchedule (a delayed staging
+    lane + one transient connection reset) must diagnose to EXACTLY
+    {straggler, fault-pressure}: the chaos shows up, nothing else false-
+    positives, and the same window re-diagnoses byte-identically."""
+    from cycloneml_tpu.conf import CycloneConf
+    from cycloneml_tpu.ml.classification import LogisticRegression
+    from cycloneml_tpu.observe import skew as skew_mod
+    from cycloneml_tpu.oocore import StreamingDataset
+    from cycloneml_tpu.parallel.faults import (FaultInjector, FaultSchedule,
+                                               InjectedConnectionReset)
+
+    rng = np.random.RandomState(3)
+    n, d, shard_rows = 4096, 16, 256
+    n_shards = n // shard_rows
+    x = rng.randn(n, d).astype(np.float32)
+    y = (x @ rng.randn(d) > 0).astype(np.float64)
+
+    def chunks():
+        for i in range(0, n, shard_rows):
+            yield x[i:i + shard_rows], y[i:i + shard_rows], None
+
+    sds = StreamingDataset.from_chunks(ctx, chunks(), d,
+                                       shard_rows=shard_rows)
+    det = SkewDetector(window=64, min_samples=2, mad_factor=4.0,
+                       rel_factor=1.5, min_gap_s=0.010)
+    prev = skew_mod.install(det)
+    tr = tracing.enable(max_spans=50_000)
+    # overlap over a chaos window measures the fault schedule, not the
+    # double buffer — gate it off for the exactness assertion
+    conf = CycloneConf().set("cyclone.doctor.overlapMin", 0.0)
+    try:
+        LogisticRegression(maxIter=3, regParam=0.1).fit(sds)   # warm
+        sched = FaultSchedule(seed=7)
+        # shuffle is off, so invocation order IS shard order — but every
+        # retry attempt consumes an invocation number too. The reset at
+        # #5 (shard 4, epoch 1) retries once, so epoch 1 spans
+        # invocations 1..17 and epoch k >= 2 starts at 18+(k-2)*16:
+        # these delays all land on shard 0, the unmasked straggler
+        sched.at("oocore.stage", [1] + [18 + k * n_shards
+                                        for k in range(32)],
+                 delay_s=0.04)
+        # one transient reset mid-epoch: staging must retry, not die
+        sched.at("oocore.stage", 5, InjectedConnectionReset("peer reset"))
+        mark = tr.mark()
+        with FaultInjector(sched) as inj:
+            model = LogisticRegression(maxIter=3, regParam=0.1).fit(sds)
+        assert model.summary.streamed
+        assert ("oocore.stage", 5, "InjectedConnectionReset") in inj.log
+        spans = tr.snapshot(since=mark)
+
+        report = diagnose(spans=spans, skew=det, cache_stats=None,
+                          conf=conf, source="live")
+        assert sorted(set(report.kinds)) == ["fault-pressure", "straggler"]
+        straggler = report.findings[report.kinds.index("straggler")]
+        assert straggler.evidence["detector"] == "live"
+        assert straggler.evidence["lanes"] == ["shard0"]
+        faults = report.findings[report.kinds.index("fault-pressure")]
+        assert faults.evidence["retries"] >= 1            # the reset
+        assert faults.evidence["points"]["oocore.stage"] >= 2
+        # determinism: the same window re-diagnoses to the same bytes
+        again = diagnose(spans=spans, skew=det.lane_snapshot(),
+                         cache_stats=None, conf=conf, source="live")
+        assert again.to_json() == report.to_json()
+    finally:
+        tracing.disable()
+        skew_mod.install(prev)
+        sds.close()

@@ -78,6 +78,37 @@ def test_tracer_aware_instrumentation_is_clean():
         f"{f.rule}@{f.line}: {f.message}" for f in findings]
 
 
+def test_doctor_span_walk_is_clean():
+    """The performance doctor's shape — a read-only walk over captured
+    spans joining evidence with pure host arithmetic — must be clean
+    under the whole pack (the observe/diagnose contract)."""
+    path = os.path.join(FIXTURES, "jx018_doctor_pass.py")
+    findings = analyze_paths([path])
+    assert findings == [], [
+        f"{f.rule}@{f.line}: {f.message}" for f in findings]
+
+
+def test_noncanonical_ledger_append_flags_exactly_the_marked_lines():
+    """A bench-ledger append whose row order / content depends on hash
+    order, unseeded jitter or a wall-clock read is a JX023 determinism
+    hazard — the replayed ledger would not be byte-stable."""
+    path = os.path.join(FIXTURES, "jx023_ledger_flag.py")
+    expected = marker_lines(path, "JX023")
+    assert expected, f"fixture {path} has no marker lines"
+    got = {f.line for f in findings_for(path, "JX023")}
+    assert got == expected, (
+        f"JX023: flagged lines {sorted(got)} != marked {sorted(expected)}")
+
+
+def test_canonical_ledger_append_is_clean():
+    """sorted() row order + sort_keys JSON (the observe/regress idiom)
+    must pass the whole pack."""
+    path = os.path.join(FIXTURES, "jx023_ledger_pass.py")
+    findings = analyze_paths([path])
+    assert findings == [], [
+        f"{f.rule}@{f.line}: {f.message}" for f in findings]
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_inline_suppression(tmp_path):
